@@ -1,0 +1,29 @@
+"""accelerate_tpu — a TPU-native (JAX/XLA/pjit/Pallas) training & inference framework.
+
+Brand-new implementation of the capabilities of HuggingFace Accelerate (reference mounted at
+/root/reference, v1.6.0.dev0), re-designed for TPU: a named device mesh + GSPMD sharding
+replaces process groups; jitted functional train steps replace mutated torch modules; XLA
+collectives over ICI/DCN replace NCCL; Pallas kernels supply attention/fp8/quant paths.
+
+See SURVEY.md for the full blueprint and the reference-parity map.
+"""
+
+__version__ = "0.1.0"
+
+from .state import AcceleratorState, GradientState, PartialState
+from .logging import get_logger
+from .utils import (
+    DataLoaderConfiguration,
+    DistributedType,
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    MixedPrecisionPolicy,
+    ProjectConfiguration,
+)
+from .parallel import MeshConfig, build_mesh
+
+# Facade import is deliberately lazy-tolerant during early build stages.
+try:  # noqa: SIM105
+    from .accelerator import Accelerator  # noqa: F401
+except ImportError:  # pragma: no cover - facade lands in L3 build stage
+    pass

@@ -1,0 +1,66 @@
+"""Multi-process-aware logging.
+
+Analog of reference ``logging.py`` (/root/reference/src/accelerate/logging.py):
+``MultiProcessAdapter`` (:22) with ``main_process_only``/``in_order`` kwargs, ``get_logger``
+(:85), env knob ``ACCELERATE_LOG_LEVEL``.
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+__all__ = ["get_logger", "MultiProcessAdapter"]
+
+
+class MultiProcessAdapter(logging.LoggerAdapter):
+    """LoggerAdapter that drops records on non-main processes unless asked otherwise.
+
+    ``logger.info(msg, main_process_only=False)`` logs everywhere;
+    ``in_order=True`` logs process-by-process behind a barrier (debug aid).
+    """
+
+    @staticmethod
+    def _should_log(main_process_only: bool) -> bool:
+        from .state import PartialState
+
+        state = PartialState()
+        return not main_process_only or state.is_main_process
+
+    def log(self, level, msg, *args, **kwargs):
+        if not self.isEnabledFor(level):
+            return
+        main_process_only = kwargs.pop("main_process_only", True)
+        in_order = kwargs.pop("in_order", False)
+        kwargs.setdefault("stacklevel", 2)
+
+        if not in_order:
+            if self._should_log(main_process_only):
+                msg, kwargs = self.process(msg, kwargs)
+                self.logger.log(level, msg, *args, **kwargs)
+            return
+
+        from .state import PartialState
+
+        state = PartialState()
+        for i in range(state.num_processes):
+            if i == state.process_index:
+                msg_p, kwargs_p = self.process(msg, kwargs)
+                self.logger.log(level, msg_p, *args, **kwargs_p)
+            state.wait_for_everyone()
+
+    @functools.lru_cache(None)
+    def warning_once(self, *args, **kwargs):
+        self.warning(*args, **kwargs)
+
+
+def get_logger(name: str, log_level: str | None = None) -> MultiProcessAdapter:
+    """Return a multi-process logger (reference ``logging.py:85``)."""
+    logger = logging.getLogger(name)
+    if log_level is None:
+        log_level = os.environ.get("ACCELERATE_LOG_LEVEL", None)
+    if log_level is not None:
+        logger.setLevel(log_level.upper())
+        logger.root.setLevel(log_level.upper())
+    return MultiProcessAdapter(logger, {})

@@ -1,0 +1,16 @@
+"""Compute & collective ops: in-jit collectives and Pallas kernels."""
+
+from .collectives import (
+    all_gather,
+    all_to_all,
+    axis_index,
+    axis_size,
+    grad_pmean,
+    grad_psum,
+    pmax,
+    pmean,
+    pmin,
+    ppermute,
+    psum,
+    reduce_scatter,
+)

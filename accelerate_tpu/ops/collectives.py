@@ -1,0 +1,115 @@
+"""In-jit collectives — the compiled-path counterpart of ``utils/operations.py``.
+
+These are thin, named wrappers over XLA collective HLOs (``psum``/``all_gather``/``ppermute``/
+``all_to_all``), the TPU-native replacement for the reference's NCCL calls (SURVEY.md §2.7).
+They are meaningful only inside ``shard_map``/``pmap``-style traced code where mesh axis names
+are bound. Defaults target the batch axes ``("dp", "fsdp")`` so a plain ``grad_psum`` matches
+DDP's gradient all-reduce (reference ``optimizer.py:148-154`` / torch DDP reducer).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..utils.constants import BATCH_AXES
+
+__all__ = [
+    "psum",
+    "pmean",
+    "pmax",
+    "pmin",
+    "all_gather",
+    "reduce_scatter",
+    "ppermute",
+    "all_to_all",
+    "axis_index",
+    "axis_size",
+    "grad_psum",
+    "grad_pmean",
+]
+
+AxisNames = Any  # str | tuple[str, ...]
+
+
+def _axes(axis_name: Optional[AxisNames]) -> AxisNames:
+    return BATCH_AXES if axis_name is None else axis_name
+
+
+def psum(x, axis_name: Optional[AxisNames] = None):
+    return jax.tree_util.tree_map(lambda t: lax.psum(t, _axes(axis_name)), x)
+
+
+def pmean(x, axis_name: Optional[AxisNames] = None):
+    return jax.tree_util.tree_map(lambda t: lax.pmean(t, _axes(axis_name)), x)
+
+
+def pmax(x, axis_name: Optional[AxisNames] = None):
+    return jax.tree_util.tree_map(lambda t: lax.pmax(t, _axes(axis_name)), x)
+
+
+def pmin(x, axis_name: Optional[AxisNames] = None):
+    return jax.tree_util.tree_map(lambda t: lax.pmin(t, _axes(axis_name)), x)
+
+
+def all_gather(x, axis_name: Optional[AxisNames] = None, axis: int = 0, tiled: bool = True):
+    return jax.tree_util.tree_map(
+        lambda t: lax.all_gather(t, _axes(axis_name), axis=axis, tiled=tiled), x
+    )
+
+
+def reduce_scatter(x, axis_name: Optional[AxisNames] = None, scatter_dimension: int = 0):
+    return jax.tree_util.tree_map(
+        lambda t: lax.psum_scatter(t, _axes(axis_name), scatter_dimension=scatter_dimension, tiled=True),
+        x,
+    )
+
+
+def ppermute(x, perm: Sequence[tuple[int, int]], axis_name: Optional[AxisNames] = None):
+    return jax.tree_util.tree_map(lambda t: lax.ppermute(t, _axes(axis_name), perm), x)
+
+
+def all_to_all(x, axis_name: Optional[AxisNames] = None, split_axis: int = 0, concat_axis: int = 0):
+    return jax.tree_util.tree_map(
+        lambda t: lax.all_to_all(t, _axes(axis_name), split_axis, concat_axis, tiled=True), x
+    )
+
+
+def axis_index(axis_name: Optional[AxisNames] = None):
+    return lax.axis_index(_axes(axis_name))
+
+
+def axis_size(axis_name: Optional[AxisNames] = None):
+    return lax.axis_size(_axes(axis_name))
+
+
+def grad_psum(grads, axis_name: Optional[AxisNames] = None, reduce_dtype=None):
+    """Gradient all-reduce with optional compressed-dtype reduction.
+
+    Casting to ``reduce_dtype`` (e.g. bf16) before the psum is the TPU analog of the
+    reference's DDP fp16/bf16 compression comm hooks (``dataclasses.py:128-222``): it halves
+    ICI bytes and upcasts back afterwards.
+    """
+
+    def _reduce(g):
+        orig = g.dtype
+        if reduce_dtype is not None and g.dtype != reduce_dtype:
+            g = g.astype(reduce_dtype)
+        g = lax.psum(g, _axes(axis_name))
+        return g.astype(orig)
+
+    return jax.tree_util.tree_map(_reduce, grads)
+
+
+def grad_pmean(grads, axis_name: Optional[AxisNames] = None, reduce_dtype=None):
+    def _reduce(g):
+        orig = g.dtype
+        if reduce_dtype is not None and g.dtype != reduce_dtype:
+            g = g.astype(reduce_dtype)
+        g = lax.pmean(g, _axes(axis_name))
+        return g.astype(orig)
+
+    return jax.tree_util.tree_map(_reduce, grads)

@@ -1,0 +1,10 @@
+"""Parallelism strategies over the device mesh (SURVEY.md §2.2)."""
+
+from .mesh import (
+    MeshConfig,
+    batch_pspec,
+    batch_sharding,
+    build_mesh,
+    mesh_batch_size_divisor,
+    replicated,
+)

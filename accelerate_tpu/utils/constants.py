@@ -1,0 +1,43 @@
+"""Framework-wide constants.
+
+Analog of reference ``utils/constants.py`` (/root/reference/src/accelerate/utils/constants.py:18-31
+for checkpoint file names). We keep the same on-disk checkpoint naming contract so tooling built
+around Accelerate checkpoints keeps working, with JAX-native formats substituted where torch
+pickles were used.
+"""
+
+MODEL_NAME = "model"
+OPTIMIZER_NAME = "optimizer"
+SCHEDULER_NAME = "scheduler"
+SAMPLER_NAME = "sampler"
+DATALOADER_NAME = "dataloader"
+RNG_STATE_NAME = "random_states"
+CUSTOM_OBJECT_NAME = "custom_checkpoint"
+PROFILE_PATTERN_NAME = "profile_{suffix}.json"
+
+# Safetensors / msgpack artifact names inside a checkpoint folder.
+SAFE_WEIGHTS_NAME = f"{MODEL_NAME}.safetensors"
+SAFE_WEIGHTS_INDEX_NAME = f"{MODEL_NAME}.safetensors.index.json"
+WEIGHTS_NAME = f"{MODEL_NAME}.msgpack"
+OPTIMIZER_STATE_NAME = f"{OPTIMIZER_NAME}.msgpack"
+SCHEDULER_STATE_NAME = f"{SCHEDULER_NAME}.json"
+SAMPLER_STATE_NAME = f"{SAMPLER_NAME}.json"
+
+# Sharded (tensorstore/orbax) checkpoint directory name.
+SHARDED_STATE_DIR = "sharded_state"
+
+# Mesh axis names — the canonical 6-way parallelism decomposition (SURVEY.md §2.2).
+DATA_AXIS = "dp"
+FSDP_AXIS = "fsdp"
+TENSOR_AXIS = "tp"
+SEQUENCE_AXIS = "sp"
+PIPELINE_AXIS = "pp"
+EXPERT_AXIS = "ep"
+MESH_AXIS_NAMES = (DATA_AXIS, FSDP_AXIS, TENSOR_AXIS, SEQUENCE_AXIS, PIPELINE_AXIS, EXPERT_AXIS)
+# Axes over which the global batch is sharded.
+BATCH_AXES = (DATA_AXIS, FSDP_AXIS)
+
+# Env-var wire protocol namespace (SURVEY.md §1 "load-bearing design decision").
+ENV_PREFIX = "ACCELERATE_"
+
+ELASTIC_LOG_LINE_PREFIX_TEMPLATE = "[rank{rank}]: "

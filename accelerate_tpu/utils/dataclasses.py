@@ -1,0 +1,384 @@
+"""Enums, plugin dataclasses and kwargs handlers — the config layer (L4).
+
+TPU-native analog of reference ``utils/dataclasses.py``
+(/root/reference/src/accelerate/utils/dataclasses.py): ``DistributedType`` (:552),
+``GradientAccumulationPlugin`` (:920), ``FullyShardedDataParallelPlugin`` (:1449),
+``TorchTensorParallelPlugin`` (:1863), ``DeepSpeedPlugin`` (:1019), ``ProjectConfiguration``
+(:857), ``DataLoaderConfiguration`` (:762), kwargs handlers (:62-551).
+
+Where the reference's plugins configure external engines (DeepSpeed JSON, FSDP wrap policies,
+Megatron args), ours configure **mesh axes and GSPMD sharding rules** — the single TPU-native
+mechanism that subsumes DDP/ZeRO/FSDP/TP/PP/SP/EP (SURVEY.md §7 equivalence table).
+"""
+
+from __future__ import annotations
+
+import copy
+import enum
+import functools
+import os
+import warnings
+from dataclasses import dataclass, field
+from datetime import timedelta
+from typing import Any, Callable, Iterable, Optional
+
+import jax.numpy as jnp
+
+from .environment import parse_flag_from_env, str_to_bool
+
+
+class KwargsHandler:
+    """Base mixin for kwargs dataclasses; mirrors reference ``dataclasses.py:62``."""
+
+    def to_dict(self) -> dict[str, Any]:
+        return copy.deepcopy(self.__dict__)
+
+    def to_kwargs(self) -> dict[str, Any]:
+        """Return only the fields that differ from the dataclass defaults."""
+        default = self.__class__()
+        return {k: v for k, v in self.to_dict().items() if getattr(default, k) != v}
+
+
+class EnumWithContains(enum.EnumMeta):
+    def __contains__(cls, item):
+        try:
+            cls(item)
+        except ValueError:
+            return False
+        return True
+
+
+class BaseEnum(str, enum.Enum, metaclass=EnumWithContains):
+    def __str__(self):
+        return self.value
+
+    @classmethod
+    def list(cls):
+        return list(map(str, cls))
+
+
+class DistributedType(BaseEnum):
+    """Which parallelism mode the Accelerator is driving.
+
+    Reference enum at ``dataclasses.py:552-586`` enumerates *device kinds*
+    (MULTI_GPU/MULTI_NPU/...); on TPU there is a single device kind, so ours enumerates
+    *sharding strategies*. ``MULTI_DEVICE`` is plain data parallelism (the DDP analog).
+    """
+
+    NO = "NO"
+    MULTI_DEVICE = "MULTI_DEVICE"
+    FSDP = "FSDP"
+    TP = "TP"
+    PP = "PP"
+    SP = "SP"
+    EP = "EP"
+    HYBRID = "HYBRID"  # any >=2-axis combination (the Megatron-LM 3D analog)
+    MULTI_HOST = "MULTI_HOST"
+
+
+class PrecisionType(BaseEnum):
+    NO = "no"
+    BF16 = "bf16"
+    FP16 = "fp16"
+    FP8 = "fp8"
+
+
+class RNGType(BaseEnum):
+    JAX = "jax"
+    NUMPY = "numpy"
+    PYTHON = "python"
+    GENERATOR = "generator"  # torch CPU generator (data-order RNG when torch is present)
+    TORCH = "torch"
+
+
+class ZeroStage(enum.IntEnum):
+    """DeepSpeed-ZeRO stage analog: what gets sharded along the fsdp axis.
+
+    Stage 1 shards optimizer state; stage 2 additionally uses reduce-scatter for gradients;
+    stage 3 additionally shards parameters (== torch FSDP FULL_SHARD). On TPU all three are
+    sharding annotations on the train-state pytree (SURVEY.md §2.2 ZeRO row).
+    """
+
+    ZERO_0 = 0  # pure replication (DDP)
+    ZERO_1 = 1
+    ZERO_2 = 2
+    ZERO_3 = 3
+
+
+class FSDPShardingStrategy(BaseEnum):
+    """Reference FSDP strategy names (``utils/constants.py:36``) → mesh layouts."""
+
+    FULL_SHARD = "FULL_SHARD"          # ZeRO-3 on the fsdp axis
+    SHARD_GRAD_OP = "SHARD_GRAD_OP"    # ZeRO-2
+    NO_SHARD = "NO_SHARD"              # DDP
+    HYBRID_SHARD = "HYBRID_SHARD"      # shard within ICI slice, replicate across DCN
+    HYBRID_SHARD_ZERO2 = "HYBRID_SHARD_ZERO2"
+
+
+@dataclass
+class AutocastKwargs(KwargsHandler):
+    """Reference ``dataclasses.py:107``. Controls the compute-dtype cast inside the step."""
+
+    enabled: bool = True
+    cache_enabled: bool = True  # accepted for API parity; caching is XLA's job
+
+
+@dataclass
+class GradScalerKwargs(KwargsHandler):
+    """Dynamic loss-scaling config (reference ``dataclasses.py:226``).
+
+    On TPU fp16 is rare (bf16 needs no scaling) but the functional dynamic-scale path is
+    implemented for API parity: ``init_scale``/``growth_factor``/``backoff_factor``/
+    ``growth_interval`` drive ``precision.DynamicScale``.
+    """
+
+    init_scale: float = 65536.0
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+    enabled: bool = True
+
+
+@dataclass
+class DistributedInitKwargs(KwargsHandler):
+    """``jax.distributed.initialize`` arguments (reference ``InitProcessGroupKwargs`` :257)."""
+
+    coordinator_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+    local_device_ids: Optional[list[int]] = None
+    timeout: timedelta = field(default_factory=lambda: timedelta(seconds=1800))
+
+
+@dataclass
+class GradientAccumulationPlugin(KwargsHandler):
+    """Reference ``dataclasses.py:920``."""
+
+    num_steps: int = 1
+    adjust_scheduler: bool = True
+    sync_with_dataloader: bool = True
+    sync_each_batch: bool = False
+
+
+@dataclass
+class ProfileKwargs(KwargsHandler):
+    """Profiler configuration → ``jax.profiler`` (reference ``dataclasses.py:436``).
+
+    The reference builds a ``torch.profiler.profile`` with a wait/warmup/active schedule; we
+    drive ``jax.profiler.start_trace``/``stop_trace`` with the same schedule semantics and write
+    a TensorBoard/perfetto-compatible trace directory.
+    """
+
+    activities: Optional[list[str]] = None
+    schedule_option: Optional[dict[str, int]] = None
+    on_trace_ready: Optional[Callable] = None
+    record_shapes: bool = False
+    profile_memory: bool = False
+    with_stack: bool = False
+    with_flops: bool = False
+    with_modules: bool = False
+    output_trace_dir: Optional[str] = None
+
+
+@dataclass
+class DataLoaderConfiguration(KwargsHandler):
+    """Reference ``dataclasses.py:762``."""
+
+    split_batches: bool = False
+    dispatch_batches: Optional[bool] = None
+    even_batches: bool = True
+    use_seedable_sampler: bool = True
+    data_seed: Optional[int] = None
+    non_blocking: bool = False      # async host→device transfer
+    use_stateful_dataloader: bool = False
+    prefetch_size: int = 2          # device-transfer double buffering depth
+
+
+@dataclass
+class ProjectConfiguration(KwargsHandler):
+    """Checkpoint/output folder layout + rotation (reference ``dataclasses.py:857``)."""
+
+    project_dir: Optional[str] = None
+    logging_dir: Optional[str] = None
+    automatic_checkpoint_naming: bool = False
+    total_limit: Optional[int] = None
+    iteration: int = 0
+    save_on_each_node: bool = False
+
+    def set_directories(self, project_dir: Optional[str] = None):
+        self.project_dir = project_dir
+        if self.logging_dir is None:
+            self.logging_dir = project_dir
+
+    def __post_init__(self):
+        if self.logging_dir is None:
+            self.logging_dir = self.project_dir
+
+
+@dataclass
+class MixedPrecisionPolicy(KwargsHandler):
+    """The dtype quadruple governing a jitted step.
+
+    Replaces torch autocast + GradScaler (reference ``accelerator.py:528-576``): params are kept
+    in ``param_dtype`` (master weights), cast to ``compute_dtype`` for the forward/backward,
+    outputs cast to ``output_dtype`` (the ``convert_outputs_to_fp32`` analog,
+    reference ``operations.py:815``), and cross-device gradient reductions run in
+    ``reduce_dtype`` (the DDP bf16-compression-hook analog, reference ``dataclasses.py:128``).
+    """
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.float32
+    output_dtype: Any = jnp.float32
+    reduce_dtype: Any = jnp.float32
+
+    @classmethod
+    def from_precision(cls, precision: str | PrecisionType) -> "MixedPrecisionPolicy":
+        precision = PrecisionType(str(precision))
+        if precision == PrecisionType.NO:
+            return cls()
+        if precision == PrecisionType.BF16:
+            return cls(compute_dtype=jnp.bfloat16, reduce_dtype=jnp.bfloat16)
+        if precision == PrecisionType.FP16:
+            return cls(compute_dtype=jnp.float16, reduce_dtype=jnp.float16)
+        if precision == PrecisionType.FP8:
+            # fp8 matmul inputs; accumulation still bf16. Fine-grained control in ops/fp8.py.
+            return cls(compute_dtype=jnp.bfloat16, reduce_dtype=jnp.bfloat16)
+        raise ValueError(f"unknown precision {precision}")
+
+
+@dataclass
+class FullyShardedDataParallelPlugin(KwargsHandler):
+    """ZeRO/FSDP sharding along the ``fsdp`` mesh axis (reference ``dataclasses.py:1449``).
+
+    One plugin covers both the reference's DeepSpeed-ZeRO and torch-FSDP paths: on TPU both are
+    GSPMD sharding of the (param, grad, opt-state) pytrees. ``min_weight_size`` is the analog of
+    FSDP's size-based auto-wrap policy: parameters smaller than it stay replicated.
+    """
+
+    sharding_strategy: FSDPShardingStrategy | str = FSDPShardingStrategy.FULL_SHARD
+    zero_stage: Optional[int] = None          # overrides sharding_strategy if set
+    min_weight_size: int = 2**10              # params with fewer elements stay replicated
+    shard_axis: str = "fsdp"
+    state_dict_type: str = "SHARDED_STATE_DICT"  # or FULL_STATE_DICT on save
+    cpu_offload: bool = False                 # params live in host memory, streamed per-step
+    backward_prefetch: bool = True            # informational; XLA schedules prefetch itself
+    use_orig_params: bool = True              # API parity; always true functionally
+    activation_checkpointing: bool = False    # jax.checkpoint on block boundaries
+    cpu_ram_efficient_loading: bool = True    # init on host rank0, shard-scatter to devices
+    sync_module_states: bool = True
+
+    def __post_init__(self):
+        self.sharding_strategy = FSDPShardingStrategy(str(self.sharding_strategy))
+        env_stage = os.environ.get("ACCELERATE_FSDP_ZERO_STAGE")
+        if self.zero_stage is None and env_stage is not None:
+            self.zero_stage = int(env_stage)
+        if self.zero_stage is None:
+            self.zero_stage = {
+                FSDPShardingStrategy.FULL_SHARD: 3,
+                FSDPShardingStrategy.SHARD_GRAD_OP: 2,
+                FSDPShardingStrategy.NO_SHARD: 0,
+                FSDPShardingStrategy.HYBRID_SHARD: 3,
+                FSDPShardingStrategy.HYBRID_SHARD_ZERO2: 2,
+            }[self.sharding_strategy]
+
+    @property
+    def shards_params(self) -> bool:
+        return self.zero_stage >= 3
+
+    @property
+    def shards_grads(self) -> bool:
+        return self.zero_stage >= 2
+
+    @property
+    def shards_optimizer(self) -> bool:
+        return self.zero_stage >= 1
+
+
+@dataclass
+class TensorParallelPlugin(KwargsHandler):
+    """Megatron-style tensor parallelism along the ``tp`` axis
+    (reference ``TorchTensorParallelPlugin`` ``dataclasses.py:1863``)."""
+
+    tp_size: int = 1
+    plan: Optional[str] = None  # name of a registered TP plan; None = model's default
+
+
+@dataclass
+class PipelineParallelPlugin(KwargsHandler):
+    """GPipe-style pipeline parallelism along the ``pp`` axis (reference ``inference.py``)."""
+
+    pp_size: int = 1
+    num_microbatches: int = 1
+    schedule: str = "gpipe"  # or "1f1b"
+
+
+@dataclass
+class SequenceParallelPlugin(KwargsHandler):
+    """Context/sequence parallelism along the ``sp`` axis.
+
+    The reference has NO native implementation (SURVEY.md §5 long-context gap) — only a Megatron
+    flag. Here it is first-class: ``mode='ring'`` rotates KV blocks around the ICI ring
+    (ring attention via ppermute), ``mode='ulysses'`` all-to-alls heads↔sequence.
+    """
+
+    sp_size: int = 1
+    mode: str = "ring"  # "ring" | "ulysses" | "allgather"
+
+
+@dataclass
+class ExpertParallelPlugin(KwargsHandler):
+    """MoE expert parallelism along the ``ep`` axis (reference: DeepSpeed-MoE fields only)."""
+
+    ep_size: int = 1
+    num_experts: int = 1
+    capacity_factor: float = 1.25
+
+
+@dataclass
+class MegatronLMPlugin(KwargsHandler):
+    """3D-parallel trainer config (reference ``dataclasses.py:1899``): one object bundling the
+    tp/pp/sp/dp degrees the integrated mesh trainer uses."""
+
+    tp_degree: int = 1
+    pp_degree: int = 1
+    num_micro_batches: int = 1
+    sequence_parallelism: bool = False
+    gradient_clipping: float = 1.0
+    use_distributed_optimizer: bool = True  # == ZeRO-1 on the dp axis
+
+
+@dataclass
+class TorchDynamoPlugin(KwargsHandler):
+    """API-parity stub (reference ``dataclasses.py:969``): under JAX, ``jax.jit`` is always on.
+
+    ``backend`` and modes are accepted and recorded; ``use_regional_compilation`` maps to
+    per-block ``jax.checkpoint``/scan-compilation of repeated layers.
+    """
+
+    backend: str = "inductor"
+    mode: Optional[str] = None
+    fullgraph: bool = True
+    dynamic: Optional[bool] = None
+    use_regional_compilation: bool = False
+
+
+class TensorInformation:
+    """Shape/dtype record used by object-collectives (reference ``dataclasses.py``)."""
+
+    def __init__(self, shape, dtype):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+    def __repr__(self):
+        return f"TensorInformation(shape={self.shape}, dtype={self.dtype})"
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, TensorInformation)
+            and self.shape == other.shape
+            and self.dtype == other.dtype
+        )
+
+
+def add_model_config_to_megatron_parser(*args, **kwargs):  # pragma: no cover
+    raise NotImplementedError("Megatron arg-parsing has no TPU analog; use MegatronLMPlugin.")

@@ -1,0 +1,117 @@
+"""Environment-variable parsing and manipulation helpers.
+
+TPU-native analog of the reference's ``utils/environment.py``
+(/root/reference/src/accelerate/utils/environment.py:59-99 for the parsers,
+:291-361 for the context managers). The ``ACCELERATE_*`` env-var namespace is
+the wire protocol between the launcher CLI and the library (SURVEY.md §1), and
+these helpers are the single place it is parsed.
+"""
+
+from __future__ import annotations
+
+import os
+from contextlib import contextmanager
+from typing import Any
+
+__all__ = [
+    "str_to_bool",
+    "get_int_from_env",
+    "parse_flag_from_env",
+    "parse_choice_from_env",
+    "are_libraries_initialized",
+    "clear_environment",
+    "patch_environment",
+    "purge_accelerate_environment",
+]
+
+_TRUE = {"1", "true", "yes", "y", "on"}
+_FALSE = {"0", "false", "no", "n", "off", ""}
+
+
+def str_to_bool(value: str) -> int:
+    """Convert a string to 1/0, raising on unrecognized values."""
+    value = str(value).lower().strip()
+    if value in _TRUE:
+        return 1
+    if value in _FALSE:
+        return 0
+    raise ValueError(f"invalid truth value {value!r}")
+
+
+def get_int_from_env(env_keys, default: int) -> int:
+    """Return the first defined integer value among ``env_keys``."""
+    for key in env_keys:
+        val = int(os.environ.get(key, -1))
+        if val >= 0:
+            return val
+    return default
+
+
+def parse_flag_from_env(key: str, default: bool = False) -> bool:
+    value = os.environ.get(key, str(default))
+    try:
+        return bool(str_to_bool(value))
+    except ValueError:
+        return default
+
+
+def parse_choice_from_env(key: str, default: str = "no") -> str:
+    return os.environ.get(key, str(default))
+
+
+def are_libraries_initialized(*library_names: str) -> list[str]:
+    """Return the subset of ``library_names`` already imported in this process."""
+    import sys
+
+    return [lib for lib in library_names if lib in sys.modules]
+
+
+@contextmanager
+def clear_environment():
+    """Temporarily run with a completely empty ``os.environ``."""
+    saved = dict(os.environ)
+    os.environ.clear()
+    try:
+        yield
+    finally:
+        os.environ.clear()
+        os.environ.update(saved)
+
+
+@contextmanager
+def patch_environment(**kwargs: Any):
+    """Temporarily set env vars (upper-cased keys); restores prior values on exit."""
+    saved: dict[str, str | None] = {}
+    for key, value in kwargs.items():
+        key = key.upper()
+        saved[key] = os.environ.get(key)
+        os.environ[key] = str(value)
+    try:
+        yield
+    finally:
+        for key, value in saved.items():
+            if value is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = value
+
+
+def purge_accelerate_environment(func):
+    """Decorator: run ``func`` with every ``ACCELERATE_*`` env var removed, then restore.
+
+    Mirrors the hermetic-test helper at reference ``utils/environment.py:362``.
+    """
+    import functools
+
+    @functools.wraps(func)
+    def wrapper(*args, **kwargs):
+        saved = {k: v for k, v in os.environ.items() if k.startswith("ACCELERATE_")}
+        for k in saved:
+            del os.environ[k]
+        try:
+            return func(*args, **kwargs)
+        finally:
+            for k, v in saved.items():
+                os.environ[k] = v
+
+    return wrapper

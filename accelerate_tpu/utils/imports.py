@@ -1,0 +1,94 @@
+"""Optional-dependency availability registry.
+
+Analog of reference ``utils/imports.py`` (/root/reference/src/accelerate/utils/imports.py, ~55
+``is_*_available`` probes). Every optional integration is gated through one of these probes so the
+core framework never hard-imports anything beyond jax/numpy.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import functools
+
+__all__ = [
+    "is_available",
+    "is_torch_available",
+    "is_flax_available",
+    "is_optax_available",
+    "is_orbax_available",
+    "is_safetensors_available",
+    "is_tensorboard_available",
+    "is_wandb_available",
+    "is_mlflow_available",
+    "is_comet_ml_available",
+    "is_clearml_available",
+    "is_aim_available",
+    "is_dvclive_available",
+    "is_swanlab_available",
+    "is_transformers_available",
+    "is_datasets_available",
+    "is_tqdm_available",
+    "is_rich_available",
+    "is_pandas_available",
+    "is_einops_available",
+    "is_chex_available",
+    "is_yaml_available",
+    "is_tpu_available",
+    "is_multihost",
+]
+
+
+@functools.lru_cache(maxsize=None)
+def is_available(name: str) -> bool:
+    """True if module ``name`` is importable (spec found, not imported)."""
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ImportError, ValueError, ModuleNotFoundError):
+        return False
+
+
+def _probe(module_name: str):
+    def probe() -> bool:
+        return is_available(module_name)
+
+    probe.__name__ = f"is_{module_name}_available"
+    return probe
+
+
+is_torch_available = _probe("torch")
+is_flax_available = _probe("flax")
+is_optax_available = _probe("optax")
+is_orbax_available = _probe("orbax.checkpoint")
+is_safetensors_available = _probe("safetensors")
+is_tensorboard_available = _probe("tensorboard")
+is_wandb_available = _probe("wandb")
+is_mlflow_available = _probe("mlflow")
+is_comet_ml_available = _probe("comet_ml")
+is_clearml_available = _probe("clearml")
+is_aim_available = _probe("aim")
+is_dvclive_available = _probe("dvclive")
+is_swanlab_available = _probe("swanlab")
+is_transformers_available = _probe("transformers")
+is_datasets_available = _probe("datasets")
+is_tqdm_available = _probe("tqdm")
+is_rich_available = _probe("rich")
+is_pandas_available = _probe("pandas")
+is_einops_available = _probe("einops")
+is_chex_available = _probe("chex")
+is_yaml_available = _probe("yaml")
+
+
+def is_tpu_available() -> bool:
+    """True if any attached JAX device is a TPU-class accelerator."""
+    import jax
+
+    try:
+        return any(d.platform in ("tpu", "axon") for d in jax.devices())
+    except RuntimeError:
+        return False
+
+
+def is_multihost() -> bool:
+    import jax
+
+    return jax.process_count() > 1

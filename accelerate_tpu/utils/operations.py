@@ -1,0 +1,553 @@
+"""Pytree collectives & tensor operations (L1).
+
+TPU-native analog of reference ``utils/operations.py``
+(/root/reference/src/accelerate/utils/operations.py): ``recursively_apply`` (:84),
+``send_to_device`` (:135), ``gather`` (:419), ``broadcast`` (:539), ``broadcast_object_list``
+(:560), ``pad_across_processes`` (:628), ``reduce`` (:724), fp32 output conversion (:765-825),
+and debug-mode shape verification ``verify_operation`` (:364).
+
+Two tiers:
+- **Host-level** ops here operate on concrete values (np/jax arrays, possibly sharded global
+  jax.Arrays) *outside* jit — the reference's semantics where "process" = rank. A sharded
+  global ``jax.Array`` already holds the all-rank data, so ``gather`` just assembles it;
+  per-host values go through ``multihost_utils`` (XLA collectives on the fly).
+- **In-jit** collectives (``psum``/``all_gather``/``ppermute``/…) live in
+  ``accelerate_tpu/ops/collectives.py`` and are what compiled train steps use.
+
+Host-level gathers return **numpy** arrays (device-independent, ready for metrics) — a
+deliberate divergence from the reference, which returns on-device torch tensors.
+"""
+
+from __future__ import annotations
+
+import pickle
+from functools import update_wrapper, wraps
+from typing import Any, Callable, Mapping, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .constants import BATCH_AXES
+from .dataclasses import TensorInformation
+
+__all__ = [
+    "is_tensor",
+    "is_namedtuple",
+    "honor_type",
+    "recursively_apply",
+    "send_to_device",
+    "get_data_structure",
+    "get_shape",
+    "initialize_tensors",
+    "find_batch_size",
+    "ignorant_find_batch_size",
+    "listify",
+    "gather",
+    "gather_object",
+    "reduce",
+    "broadcast",
+    "broadcast_object_list",
+    "pad_across_processes",
+    "pad_input_tensors",
+    "concatenate",
+    "slice_tensors",
+    "convert_to_fp32",
+    "ConvertOutputsToFp32",
+    "convert_outputs_to_fp32",
+    "DistributedOperationException",
+    "verify_operation",
+    "chained_operation",
+]
+
+
+def is_tensor(obj: Any) -> bool:
+    return isinstance(obj, (jax.Array, np.ndarray)) or hasattr(obj, "__jax_array__")
+
+
+def is_namedtuple(obj: Any) -> bool:
+    return isinstance(obj, tuple) and hasattr(obj, "_fields") and hasattr(obj, "_asdict")
+
+
+def honor_type(obj, generator):
+    """Re-wrap ``generator`` in ``type(obj)`` (named tuples included).
+
+    Reference ``operations.py:70``."""
+    if is_namedtuple(obj):
+        return type(obj)(*list(generator))
+    return type(obj)(generator)
+
+
+def recursively_apply(
+    func: Callable,
+    data: Any,
+    *args,
+    test_type: Callable = is_tensor,
+    error_on_other_type: bool = False,
+    **kwargs,
+):
+    """Apply ``func`` to every leaf of nested list/tuple/namedtuple/Mapping structures.
+
+    Reference ``operations.py:84`` — the backbone of every pytree op below. We keep the
+    reference's structural walk (rather than ``jax.tree_util``) because it must preserve
+    arbitrary Mapping subclasses and pass through non-tensor leaves untouched.
+    """
+    if isinstance(data, (tuple, list)):
+        return honor_type(
+            data,
+            (
+                recursively_apply(
+                    func, o, *args, test_type=test_type,
+                    error_on_other_type=error_on_other_type, **kwargs,
+                )
+                for o in data
+            ),
+        )
+    if isinstance(data, Mapping):
+        return type(data)(
+            {
+                k: recursively_apply(
+                    func, v, *args, test_type=test_type,
+                    error_on_other_type=error_on_other_type, **kwargs,
+                )
+                for k, v in data.items()
+            }
+        )
+    if test_type(data):
+        return func(data, *args, **kwargs)
+    if error_on_other_type:
+        raise TypeError(
+            f"Unsupported type {type(data)} passed to {func.__name__}: only nested "
+            "list/tuple/dicts of objects satisfying the test_type are supported."
+        )
+    return data
+
+
+# --------------------------------------------------------------------------- device movement
+def send_to_device(tensor, device, non_blocking: bool = False, skip_keys=None):
+    """Recursively move/commit a batch to a device or sharding (reference ``operations.py:135``).
+
+    ``device`` may be a ``jax.Device``, a ``NamedSharding``, or a ``Mesh`` (in which case the
+    batch dim is sharded over the mesh's batch axes). Torch tensors (CPU dataloaders) are
+    converted to numpy first.
+    """
+    if isinstance(device, Mesh):
+        device = NamedSharding(device, PartitionSpec(BATCH_AXES))
+    if isinstance(skip_keys, str):
+        skip_keys = [skip_keys]
+    skip_keys = set(skip_keys or ())
+
+    def _send(t):
+        t = _to_numpy_if_torch(t)
+        try:
+            return jax.device_put(t, device)
+        except (ValueError, TypeError):
+            # Unshardable shapes (e.g. scalar with batch sharding) → replicate.
+            if isinstance(device, NamedSharding):
+                return jax.device_put(t, NamedSharding(device.mesh, PartitionSpec()))
+            raise
+
+    # Manual walk (not recursively_apply) so skip_keys is honored at every Mapping level,
+    # matching reference operations.py:135 semantics.
+    def _walk(obj):
+        if isinstance(obj, (tuple, list)):
+            return honor_type(obj, (_walk(o) for o in obj))
+        if isinstance(obj, Mapping):
+            return type(obj)(
+                {k: (v if k in skip_keys else _walk(v)) for k, v in obj.items()}
+            )
+        if _is_transferable(obj):
+            return _send(obj)
+        return obj
+
+    return _walk(tensor)
+
+
+def _is_transferable(obj) -> bool:
+    if is_tensor(obj):
+        return True
+    return type(obj).__module__.startswith("torch") and hasattr(obj, "numpy")
+
+
+def _to_numpy_if_torch(t):
+    if type(t).__module__.startswith("torch"):
+        return t.detach().cpu().numpy()
+    return t
+
+
+# ----------------------------------------------------------------- structure (de)construction
+def get_data_structure(data):
+    """Pytree of ``TensorInformation`` leaves (reference ``operations.py:184``)."""
+
+    def _info(tensor):
+        return TensorInformation(shape=np.shape(tensor), dtype=np.asarray(tensor).dtype)
+
+    return recursively_apply(_info, data)
+
+
+def get_shape(data):
+    return recursively_apply(lambda t: list(np.shape(t)), data)
+
+
+def initialize_tensors(data_structure):
+    """Materialize zeros from a ``get_data_structure`` result (reference ``operations.py:221``)."""
+
+    def _init(info):
+        return np.zeros(info.shape, dtype=info.dtype)
+
+    return recursively_apply(_init, data_structure, test_type=lambda o: isinstance(o, TensorInformation))
+
+
+def find_batch_size(data) -> Optional[int]:
+    """Batch size (dim-0 length) of the first tensor leaf (reference ``operations.py:235``)."""
+    if isinstance(data, (tuple, list)):
+        for o in data:
+            result = find_batch_size(o)
+            if result is not None:
+                return result
+        return None
+    if isinstance(data, Mapping):
+        for v in data.values():
+            result = find_batch_size(v)
+            if result is not None:
+                return result
+        return None
+    if is_tensor(data) and np.ndim(data) > 0:
+        return np.shape(data)[0]
+    return None
+
+
+def ignorant_find_batch_size(data) -> Optional[int]:
+    try:
+        return find_batch_size(data)
+    except (TypeError, IndexError):
+        return None
+
+
+def listify(data):
+    """Convert tensor leaves to plain python lists (reference ``operations.py:256``)."""
+
+    def _listify(tensor):
+        return np.asarray(tensor).tolist()
+
+    return recursively_apply(_listify, data)
+
+
+# ------------------------------------------------------------------------------- collectives
+def _process_count() -> int:
+    return jax.process_count()
+
+
+def _assemble_global(x: jax.Array) -> np.ndarray:
+    """Assemble a (possibly sharded) jax.Array into a host numpy array with all-rank data."""
+    if x.is_fully_addressable:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+
+
+def gather(tensor):
+    """All-gather along dim 0 (reference ``operations.py:419``).
+
+    A batch-sharded global ``jax.Array`` already contains every rank's rows — assembling it
+    *is* the gather. Per-host numpy values are stacked across hosts via an XLA all-gather.
+    Returns numpy leaves.
+    """
+
+    def _gather(x):
+        if isinstance(x, jax.Array):
+            return _assemble_global(x)
+        if _process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            return np.asarray(multihost_utils.process_allgather(np.asarray(x), tiled=True))
+        return np.asarray(x)
+
+    with verify_operation("gather", tensor):
+        return recursively_apply(_gather, tensor)
+
+
+def gather_object(object: Any):
+    """Pickle-level all-gather of arbitrary objects (reference ``operations.py:474``)."""
+    if _process_count() == 1:
+        return [object]
+    payloads = _allgather_bytes(pickle.dumps(object))
+    return [pickle.loads(p) for p in payloads]
+
+
+def reduce(tensor, reduction: str = "mean", scale: float = 1.0):
+    """Elementwise reduce across ranks (reference ``operations.py:724``).
+
+    For a batch-sharded array, each device shard plays the role of a rank's tensor: the
+    leading dim is interpreted as ``(world, per_rank)`` and reduced over world. Replicated /
+    unsharded arrays on a single process are returned (optionally scaled) unchanged, matching
+    the reference's single-process behavior.
+    """
+
+    def _reduce(x):
+        if isinstance(x, jax.Array) and not _is_replicated(x):
+            n = _num_batch_shards(x)
+            full = _assemble_global(x)
+            if n > 1 and full.shape[0] % n == 0:
+                stacked = full.reshape((n, full.shape[0] // n) + full.shape[1:])
+                out = stacked.sum(axis=0)
+                if reduction == "mean":
+                    out = out / n
+                return out * scale
+            return full * scale
+        x_np = np.asarray(_to_numpy_if_torch(x))
+        if _process_count() > 1:
+            from jax.experimental import multihost_utils
+
+            stacked = np.asarray(multihost_utils.process_allgather(x_np, tiled=False))
+            out = stacked.sum(axis=0)
+            if reduction == "mean":
+                out = out / _process_count()
+            return out * scale
+        return x_np * scale
+
+    with verify_operation("reduce", tensor):
+        return recursively_apply(_reduce, tensor)
+
+
+def _is_replicated(x: jax.Array) -> bool:
+    try:
+        return x.sharding.is_fully_replicated
+    except Exception:
+        return True
+
+
+def _num_batch_shards(x: jax.Array) -> int:
+    try:
+        spec = x.sharding.spec  # NamedSharding only
+    except AttributeError:
+        return 1
+    if not spec or spec[0] is None:
+        return 1
+    axes = spec[0] if isinstance(spec[0], (tuple, list)) else (spec[0],)
+    n = 1
+    for a in axes:
+        n *= x.sharding.mesh.shape[a]
+    return n
+
+
+def broadcast(tensor, from_process: int = 0):
+    """Broadcast leaves from one host process to all (reference ``operations.py:539``)."""
+
+    def _broadcast(x):
+        x_np = np.asarray(_to_numpy_if_torch(x)) if not isinstance(x, jax.Array) else _assemble_global(x)
+        if _process_count() == 1:
+            return x_np
+        from jax.experimental import multihost_utils
+
+        return np.asarray(
+            multihost_utils.broadcast_one_to_all(
+                x_np, is_source=jax.process_index() == from_process
+            )
+        )
+
+    with verify_operation("broadcast", tensor):
+        return recursively_apply(_broadcast, tensor)
+
+
+def broadcast_object_list(object_list: list, from_process: int = 0) -> list:
+    """In-place broadcast of a list of picklable objects (reference ``operations.py:560``)."""
+    if _process_count() == 1:
+        return object_list
+    payload = pickle.dumps(list(object_list)) if jax.process_index() == from_process else b""
+    data = _broadcast_bytes(payload, from_process)
+    received = pickle.loads(data)
+    for i, v in enumerate(received):
+        object_list[i] = v
+    return object_list
+
+
+def _broadcast_bytes(payload: bytes, from_process: int) -> bytes:
+    from jax.experimental import multihost_utils
+
+    is_source = jax.process_index() == from_process
+    length = multihost_utils.broadcast_one_to_all(
+        np.array([len(payload)], dtype=np.int64), is_source=is_source
+    )
+    buf = np.zeros(int(length[0]), dtype=np.uint8)
+    if is_source:
+        buf[:] = np.frombuffer(payload, dtype=np.uint8)
+    out = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
+    return np.asarray(out).tobytes()
+
+
+def _allgather_bytes(payload: bytes) -> list[bytes]:
+    from jax.experimental import multihost_utils
+
+    n = _process_count()
+    lengths = multihost_utils.process_allgather(
+        np.array([len(payload)], dtype=np.int64), tiled=False
+    ).reshape(-1)
+    max_len = int(lengths.max())
+    buf = np.zeros(max_len, dtype=np.uint8)
+    buf[: len(payload)] = np.frombuffer(payload, dtype=np.uint8)
+    gathered = multihost_utils.process_allgather(buf, tiled=False).reshape(n, max_len)
+    return [gathered[i, : int(lengths[i])].tobytes() for i in range(n)]
+
+
+def pad_across_processes(tensor, dim: int = 0, pad_index: int = 0, pad_first: bool = False):
+    """Pad each process's tensor to the max size along ``dim`` (reference ``operations.py:628``)."""
+
+    def _pad(x):
+        x_np = np.asarray(_to_numpy_if_torch(x))
+        if x_np.ndim == 0 or _process_count() == 1:
+            return x_np
+        from jax.experimental import multihost_utils
+
+        sizes = multihost_utils.process_allgather(
+            np.array([x_np.shape[dim]], dtype=np.int64), tiled=False
+        ).reshape(-1)
+        max_size = int(sizes.max())
+        if max_size == x_np.shape[dim]:
+            return x_np
+        pad_width = [(0, 0)] * x_np.ndim
+        delta = max_size - x_np.shape[dim]
+        pad_width[dim] = (delta, 0) if pad_first else (0, delta)
+        return np.pad(x_np, pad_width, constant_values=pad_index)
+
+    with verify_operation("pad_across_processes", tensor):
+        return recursively_apply(_pad, tensor)
+
+
+def pad_input_tensors(tensor, batch_size: int, num_processes: int, dim: int = 0):
+    """Pad batch so it divides evenly across processes (reference ``operations.py:677``,
+    the ``even_batches=False`` fixup used by ``split_between_processes``)."""
+
+    def _pad(x):
+        x_np = np.asarray(_to_numpy_if_torch(x))
+        remainder = batch_size % num_processes
+        if remainder == 0 or x_np.shape[dim] == 0:
+            return x_np
+        target = batch_size + (num_processes - remainder)
+        # Repeat the final row rather than zero-pad so model forward stays well-defined.
+        last = x_np[tuple(slice(None) if i != dim else slice(-1, None) for i in range(x_np.ndim))]
+        pads = np.repeat(last, target - x_np.shape[dim], axis=dim)
+        return np.concatenate([x_np, pads], axis=dim)
+
+    return recursively_apply(_pad, tensor)
+
+
+def concatenate(data, dim: int = 0):
+    """Concatenate a list of pytrees leafwise (reference ``operations.py:697``)."""
+    if isinstance(data[0], (tuple, list)):
+        return honor_type(data[0], (concatenate([d[i] for d in data], dim=dim) for i in range(len(data[0]))))
+    if isinstance(data[0], Mapping):
+        return type(data[0])({k: concatenate([d[k] for d in data], dim=dim) for k in data[0].keys()})
+    if not is_tensor(data[0]):
+        raise TypeError(f"Can only concatenate tensors but got {type(data[0])}")
+    arrs = [np.asarray(_to_numpy_if_torch(d)) for d in data]
+    return np.concatenate(arrs, axis=dim)
+
+
+def slice_tensors(data, tensor_slice, process_index=None, num_processes=None):
+    """Slice every tensor leaf (reference ``operations.py:691``)."""
+
+    def _slice(x):
+        return x[tensor_slice]
+
+    return recursively_apply(_slice, data)
+
+
+# ------------------------------------------------------------------------- dtype conversion
+def convert_to_fp32(tensor):
+    """Upcast half-precision leaves to fp32 (reference ``operations.py:765``)."""
+
+    def _convert(x):
+        return jnp.asarray(x, dtype=jnp.float32) if isinstance(x, jax.Array) else np.asarray(x, dtype=np.float32)
+
+    def _is_half(x):
+        if not is_tensor(x):
+            return False
+        dtype = np.asarray(x).dtype if not isinstance(x, jax.Array) else x.dtype
+        return dtype in (jnp.float16, jnp.bfloat16)
+
+    return recursively_apply(_convert, tensor, test_type=_is_half)
+
+
+class ConvertOutputsToFp32:
+    """Picklable forward-wrapper upcasting outputs (reference ``operations.py:785``)."""
+
+    def __init__(self, model_forward):
+        self.model_forward = model_forward
+        update_wrapper(self, model_forward)
+
+    def __call__(self, *args, **kwargs):
+        return convert_to_fp32(self.model_forward(*args, **kwargs))
+
+    def __getstate__(self):
+        raise pickle.PicklingError(
+            "Cannot pickle a prepared model with automatic mixed precision; unwrap with "
+            "Accelerator.unwrap_model first."
+        )
+
+
+def convert_outputs_to_fp32(model_forward):
+    model_forward = ConvertOutputsToFp32(model_forward)
+
+    def forward(*args, **kwargs):
+        return model_forward(*args, **kwargs)
+
+    forward.__wrapped__ = model_forward
+    return forward
+
+
+# ----------------------------------------------------------------------------- debug mode
+class DistributedOperationException(Exception):
+    """Raised when ranks disagree on collective operands (reference ``operations.py:355``)."""
+
+
+class _VerifyOperation:
+    """Debug-mode shape verification (reference ``verify_operation`` :364).
+
+    When ``ACCELERATE_DEBUG_MODE=1``, every host-level collective first all-gathers the pytree
+    *shape structure* across processes and raises ``DistributedOperationException`` on any
+    mismatch — turning a silent desync/hang into an immediate, explanatory error.
+    """
+
+    def __init__(self, operation: str, tensor):
+        self.operation = operation
+        self.tensor = tensor
+
+    def __enter__(self):
+        from ..state import PartialState
+
+        state = PartialState._shared_state
+        if not state.get("debug", False) or _process_count() == 1:
+            return self
+        shapes = get_shape(self.tensor)
+        all_shapes = gather_object(shapes)
+        if not all(s == all_shapes[0] for s in all_shapes):
+            raise DistributedOperationException(
+                f"Mismatch in operands for `{self.operation}` across processes: "
+                + "; ".join(f"process {i}: {s}" for i, s in enumerate(all_shapes))
+            )
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def verify_operation(operation: str, tensor) -> _VerifyOperation:
+    return _VerifyOperation(operation, tensor)
+
+
+def chained_operation(func):
+    """Re-raise DistributedOperationException with call context (reference :399)."""
+
+    @wraps(func)
+    def wrapper(*args, **kwargs):
+        try:
+            return func(*args, **kwargs)
+        except DistributedOperationException as e:
+            raise DistributedOperationException(
+                f"Error found while calling `{func.__name__}`: {e}"
+            ) from e
+
+    return wrapper

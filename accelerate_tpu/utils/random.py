@@ -1,0 +1,97 @@
+"""Seeding and cross-process RNG synchronization.
+
+Analog of reference ``utils/random.py`` (/root/reference/src/accelerate/utils/random.py):
+``set_seed`` (:39), ``synchronize_rng_states`` (:78 — broadcast rank-0 RNG to all ranks).
+
+JAX divergence: model-side randomness is explicit (``jax.random.PRNGKey`` threaded through the
+step), so it never desyncs and needs no broadcasting. What still needs sync is *data-order*
+randomness living in host-side generators (python/numpy/torch). ``synchronize_rng_states``
+broadcasts those states from process 0 before each dataloader epoch
+(reference ``data_loader.py:559``).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Iterable, Optional
+
+import numpy as np
+import jax
+
+from .dataclasses import RNGType
+from .imports import is_torch_available
+
+__all__ = ["set_seed", "make_rng", "synchronize_rng_state", "synchronize_rng_states"]
+
+
+def set_seed(seed: int, device_specific: bool = False, deterministic: bool = False) -> int:
+    """Seed python/numpy/torch and return the (possibly rank-offset) seed.
+
+    ``device_specific=True`` offsets by process index (reference ``random.py:49``) so each host
+    draws distinct data noise while remaining reproducible.
+    """
+    if device_specific:
+        seed += jax.process_index()
+    random.seed(seed)
+    np.random.seed(seed % (2**32))
+    if is_torch_available():
+        import torch
+
+        torch.manual_seed(seed)
+        if deterministic:
+            torch.use_deterministic_algorithms(True)
+    return seed
+
+
+def make_rng(seed: int) -> jax.Array:
+    """The JAX-side seed: a PRNG key to be threaded through jitted steps."""
+    return jax.random.PRNGKey(seed)
+
+
+def _get_state(rng_type: RNGType, generator=None):
+    if rng_type == RNGType.PYTHON:
+        return random.getstate()
+    if rng_type == RNGType.NUMPY:
+        return np.random.get_state()
+    if rng_type in (RNGType.TORCH, RNGType.GENERATOR) and is_torch_available():
+        import torch
+
+        if rng_type == RNGType.GENERATOR:
+            if generator is None:
+                raise ValueError("generator RNG sync requested but no generator passed")
+            return generator.get_state()
+        return torch.get_rng_state()
+    return None
+
+
+def _set_state(rng_type: RNGType, state, generator=None):
+    if rng_type == RNGType.PYTHON:
+        random.setstate(state)
+    elif rng_type == RNGType.NUMPY:
+        np.random.set_state(state)
+    elif rng_type in (RNGType.TORCH, RNGType.GENERATOR) and is_torch_available():
+        import torch
+
+        if rng_type == RNGType.GENERATOR:
+            generator.set_state(state)
+        else:
+            torch.set_rng_state(state)
+
+
+def synchronize_rng_state(rng_type: Optional[RNGType] = None, generator=None) -> None:
+    """Broadcast process 0's host RNG state to all processes (reference ``random.py:78``)."""
+    if rng_type is None or jax.process_count() == 1:
+        return
+    rng_type = RNGType(str(rng_type))
+    if rng_type == RNGType.JAX:
+        return  # explicit keys cannot desync
+    from .operations import broadcast_object_list
+
+    payload = [_get_state(rng_type, generator)]
+    broadcast_object_list(payload, from_process=0)
+    _set_state(rng_type, payload[0], generator)
+
+
+def synchronize_rng_states(rng_types: Iterable[str], generator=None) -> None:
+    for rng_type in rng_types:
+        synchronize_rng_state(RNGType(str(rng_type)), generator=generator)

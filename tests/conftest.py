@@ -1,0 +1,46 @@
+"""Test backbone: run everything on an 8-device virtual CPU mesh.
+
+This is the faithful multi-device simulator the reference lacks (SURVEY.md §4): XLA's
+``--xla_force_host_platform_device_count=8`` gives 8 real XLA devices on one CPU host, so
+sharding, collectives and mesh logic run exactly as on an 8-chip TPU slice.
+
+Env vars MUST be set before jax initializes its backends — hence module top, before imports.
+"""
+
+import os
+
+# Force CPU even when a real TPU (JAX_PLATFORMS=axon) is attached: tests exercise the
+# 8-device simulator; bench.py and __graft_entry__ run on the real chip.
+# sitecustomize may have imported jax already (capturing JAX_PLATFORMS=axon), so the env var
+# alone is not enough — update jax.config too, before any backend initializes.
+os.environ["JAX_PLATFORMS"] = "cpu"
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = f"{prev} --xla_force_host_platform_device_count=8".strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_singletons():
+    """Keep the shared-dict singletons hermetic between tests
+    (reference ``AccelerateTestCase``, testing.py:595-605)."""
+    yield
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+@pytest.fixture
+def mesh8():
+    import jax
+    from accelerate_tpu.parallel import MeshConfig, build_mesh
+
+    assert jax.device_count() == 8, "conftest failed to create 8 virtual devices"
+    return build_mesh(MeshConfig())

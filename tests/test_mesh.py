@@ -1,0 +1,68 @@
+"""Tests for the mesh factory (parallel/mesh.py)."""
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec
+
+from accelerate_tpu.parallel import MeshConfig, build_mesh, batch_sharding, mesh_batch_size_divisor
+from accelerate_tpu.utils import FullyShardedDataParallelPlugin, TensorParallelPlugin
+
+
+def shape_of(mesh):
+    return dict(zip(mesh.axis_names, mesh.devices.shape))
+
+
+def test_default_mesh_all_dp():
+    mesh = build_mesh(MeshConfig())
+    assert shape_of(mesh) == {"dp": 8, "fsdp": 1, "tp": 1, "sp": 1, "pp": 1, "ep": 1}
+    assert mesh_batch_size_divisor(mesh) == 8
+
+
+def test_explicit_sizes():
+    mesh = build_mesh(MeshConfig(dp=2, fsdp=2, tp=2))
+    assert shape_of(mesh) == {"dp": 2, "fsdp": 2, "tp": 2, "sp": 1, "pp": 1, "ep": 1}
+    assert mesh_batch_size_divisor(mesh) == 4
+
+
+def test_fill_axis():
+    mesh = build_mesh(MeshConfig(dp=1, fsdp=-1, tp=2))
+    assert shape_of(mesh)["fsdp"] == 4
+
+
+def test_bad_product_raises():
+    with pytest.raises(ValueError):
+        build_mesh(MeshConfig(dp=3))
+    with pytest.raises(ValueError):
+        MeshConfig(dp=-1, fsdp=-1).resolved_sizes(8)
+
+
+def test_from_plugins_fsdp():
+    cfg = MeshConfig.from_plugins(fsdp_plugin=FullyShardedDataParallelPlugin())
+    mesh = build_mesh(cfg)
+    assert shape_of(mesh)["fsdp"] == 8
+    assert shape_of(mesh)["dp"] == 1
+
+
+def test_from_plugins_tp_and_fsdp():
+    cfg = MeshConfig.from_plugins(
+        fsdp_plugin=FullyShardedDataParallelPlugin(), tp_plugin=TensorParallelPlugin(tp_size=2)
+    )
+    mesh = build_mesh(cfg)
+    assert shape_of(mesh)["tp"] == 2
+    assert shape_of(mesh)["fsdp"] == 4
+
+
+def test_batch_sharding_places_data():
+    mesh = build_mesh(MeshConfig(dp=4, fsdp=2))
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    arr = jax.device_put(x, batch_sharding(mesh))
+    assert arr.sharding.is_equivalent_to(NamedSharding(mesh, PartitionSpec(("dp", "fsdp"))), 2)
+    np.testing.assert_array_equal(np.asarray(arr), x)
+    # each device holds 1 row
+    assert arr.addressable_shards[0].data.shape == (1, 8)
+
+
+def test_from_plugins_indivisible_tp_raises():
+    with pytest.raises(ValueError, match="does not divide"):
+        MeshConfig.from_plugins(tp_plugin=TensorParallelPlugin(tp_size=3))

@@ -1,0 +1,192 @@
+"""Tests for L1 pytree ops (reference parity: test_utils/scripts/test_ops.py + test_utils.py)."""
+
+import collections
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec
+
+from accelerate_tpu.parallel import MeshConfig, build_mesh, batch_sharding
+from accelerate_tpu.utils import operations as ops
+
+Point = collections.namedtuple("Point", ["x", "y"])
+
+
+def test_recursively_apply_structures():
+    data = {"a": np.ones(2), "b": [np.zeros(3), (np.ones(1),)], "c": "keep", "p": Point(np.ones(2), 5)}
+    out = ops.recursively_apply(lambda t: t + 1, data)
+    assert isinstance(out["p"], Point)
+    np.testing.assert_array_equal(out["a"], np.full(2, 2.0))
+    np.testing.assert_array_equal(out["b"][0], np.ones(3))
+    assert out["c"] == "keep"
+    assert out["p"].y == 5
+    np.testing.assert_array_equal(out["p"].x, np.full(2, 2.0))
+
+
+def test_honor_type_namedtuple():
+    p = Point(1, 2)
+    assert ops.honor_type(p, iter([3, 4])) == Point(3, 4)
+
+
+def test_send_to_device_mesh(mesh8):
+    batch = {"x": np.arange(16, dtype=np.float32).reshape(8, 2), "label": np.arange(8)}
+    out = ops.send_to_device(batch, mesh8)
+    assert isinstance(out["x"], jax.Array)
+    assert out["x"].sharding.is_equivalent_to(batch_sharding(mesh8), 2)
+    np.testing.assert_array_equal(np.asarray(out["label"]), batch["label"])
+
+
+def test_send_to_device_skip_keys(mesh8):
+    batch = {"x": np.ones((8, 2)), "meta": np.ones(3)}
+    out = ops.send_to_device(batch, mesh8, skip_keys=["meta"])
+    assert isinstance(out["meta"], np.ndarray)
+
+
+def test_send_to_device_unshardable_falls_back_to_replicated(mesh8):
+    batch = {"x": np.ones((3, 2))}  # 3 not divisible by 8
+    out = ops.send_to_device(batch, mesh8)
+    assert out["x"].sharding.is_fully_replicated
+
+
+def test_find_batch_size():
+    assert ops.find_batch_size({"a": [np.ones((4, 2))]}) == 4
+    assert ops.find_batch_size([np.float64(1.0), np.ones((2,))]) == 2
+    assert ops.find_batch_size(["str"]) is None
+
+
+def test_gather_sharded_array(mesh8):
+    x = np.arange(32, dtype=np.float32).reshape(8, 4)
+    arr = jax.device_put(x, batch_sharding(mesh8))
+    out = ops.gather({"t": arr})["t"]
+    np.testing.assert_array_equal(out, x)
+
+
+def test_gather_numpy_single_process():
+    out = ops.gather(np.ones(3))
+    np.testing.assert_array_equal(out, np.ones(3))
+
+
+def test_gather_object_single():
+    assert ops.gather_object({"k": 1}) == [{"k": 1}]
+
+
+def test_reduce_sharded(mesh8):
+    # 8 shards of shape (1, 2): reduce sums across shards like ranks.
+    x = np.ones((8, 2), dtype=np.float32)
+    arr = jax.device_put(x, batch_sharding(mesh8))
+    out = ops.reduce(arr, reduction="sum")
+    np.testing.assert_array_equal(out, np.full((1, 2), 8.0))
+    out_mean = ops.reduce(arr, reduction="mean")
+    np.testing.assert_array_equal(out_mean, np.ones((1, 2)))
+
+
+def test_reduce_replicated_noop(mesh8):
+    x = np.ones((4,), dtype=np.float32)
+    arr = jax.device_put(x, NamedSharding(mesh8, PartitionSpec()))
+    out = ops.reduce(arr, reduction="sum", scale=2.0)
+    np.testing.assert_array_equal(out, x * 2)
+
+
+def test_broadcast_single_process():
+    out = ops.broadcast({"x": np.arange(4)})
+    np.testing.assert_array_equal(out["x"], np.arange(4))
+
+
+def test_broadcast_object_list_single():
+    objs = [1, "two", {"three": 3}]
+    assert ops.broadcast_object_list(objs) == [1, "two", {"three": 3}]
+
+
+def test_pad_across_processes_single_noop():
+    x = np.ones((2, 3))
+    np.testing.assert_array_equal(ops.pad_across_processes(x), x)
+
+
+def test_pad_input_tensors():
+    x = np.arange(6, dtype=np.float32).reshape(6, 1)
+    out = ops.pad_input_tensors(x, batch_size=6, num_processes=4)
+    assert out.shape == (8, 1)
+    np.testing.assert_array_equal(out[6:], np.full((2, 1), 5.0))
+
+
+def test_concatenate():
+    a = {"x": np.ones((2, 3)), "y": [np.zeros((2,))]}
+    b = {"x": np.ones((4, 3)), "y": [np.ones((1,))]}
+    out = ops.concatenate([a, b])
+    assert out["x"].shape == (6, 3)
+    assert out["y"][0].shape == (3,)
+
+
+def test_slice_tensors():
+    data = {"x": np.arange(10)}
+    out = ops.slice_tensors(data, slice(2, 5))
+    np.testing.assert_array_equal(out["x"], np.arange(2, 5))
+
+
+def test_convert_to_fp32():
+    data = {"h": jnp.ones(2, dtype=jnp.bfloat16), "f": jnp.ones(2, dtype=jnp.float32), "i": jnp.ones(2, dtype=jnp.int32)}
+    out = ops.convert_to_fp32(data)
+    assert out["h"].dtype == jnp.float32
+    assert out["f"].dtype == jnp.float32
+    assert out["i"].dtype == jnp.int32
+
+
+def test_convert_outputs_to_fp32_not_picklable():
+    import pickle
+
+    fn = ops.convert_outputs_to_fp32(lambda x: jnp.asarray(x, dtype=jnp.bfloat16))
+    out = fn(np.ones(2, dtype=np.float32))
+    assert out.dtype == jnp.float32
+    with pytest.raises(Exception):
+        pickle.dumps(fn.__wrapped__)
+
+
+def test_get_data_structure_and_initialize():
+    data = {"x": np.ones((2, 3), dtype=np.float32)}
+    info = ops.get_data_structure(data)
+    assert info["x"].shape == (2, 3)
+    zeros = ops.initialize_tensors(info)
+    assert zeros["x"].shape == (2, 3)
+    assert zeros["x"].dtype == np.float32
+
+
+def test_listify():
+    assert ops.listify({"x": np.arange(3)}) == {"x": [0, 1, 2]}
+
+
+def test_in_jit_collectives_shard_map(mesh8):
+    from jax import shard_map
+    from accelerate_tpu.ops import grad_pmean, psum, axis_size
+
+    x = jax.device_put(np.ones((8, 4), dtype=np.float32), batch_sharding(mesh8))
+
+    def f(xs):
+        s = psum(jnp.sum(xs), axis_name=("dp", "fsdp"))
+        m = grad_pmean({"g": xs}, axis_name=("dp", "fsdp"), reduce_dtype=jnp.bfloat16)
+        return s, m["g"]
+
+    f_mapped = shard_map(
+        f,
+        mesh=mesh8,
+        in_specs=PartitionSpec(("dp", "fsdp")),
+        out_specs=(PartitionSpec(), PartitionSpec(("dp", "fsdp"))),
+    )
+    total, mean_g = jax.jit(f_mapped)(x)
+    assert float(total) == 32.0
+    assert mean_g.dtype == jnp.float32
+    np.testing.assert_array_equal(np.asarray(mean_g), np.ones((8, 4)))
+
+
+def test_send_to_device_skip_keys_nested(mesh8):
+    batch = {"outer": {"meta": np.ones(3), "x": np.ones((8, 2))}, "y": np.ones((8,))}
+    out = ops.send_to_device(batch, mesh8, skip_keys="meta")
+    assert isinstance(out["outer"]["meta"], np.ndarray)
+    assert isinstance(out["outer"]["x"], jax.Array)
+    assert isinstance(out["y"], jax.Array)
+
+
+def test_pad_input_tensors_empty_dim():
+    x = np.zeros((0, 3), dtype=np.float32)
+    out = ops.pad_input_tensors(x, batch_size=6, num_processes=4)
+    assert out.shape == (0, 3)
