@@ -1,0 +1,816 @@
+"""The Accelerator facade (L3) — one object that prepares everything for the mesh.
+
+TPU-native analog of reference ``accelerator.py`` (/root/reference/src/accelerate/accelerator.py,
+3769 LoC): ``__init__`` (:266), ``prepare`` (:1283), ``backward`` (:2357), ``accumulate``
+(:1116), ``clip_grad_norm_`` (:2485), ``gather_for_metrics`` (:2601), ``autocast`` (:3587).
+
+**The central design inversion** (SURVEY.md §7): the reference mutates user objects — wraps the
+model in DDP, patches ``forward``, wraps the optimizer so ``step()`` no-ops during
+accumulation. Under jit that object-graph choreography cannot exist; instead the Accelerator
+owns a **functional train step compiled once over the mesh**:
+
+    accelerator = Accelerator(mixed_precision="bf16", gradient_accumulation_steps=4)
+    params, optimizer, dataloader = accelerator.prepare(params, optax.adamw(1e-4), dataloader)
+    state = accelerator.create_train_state(params, optimizer)
+    step = accelerator.build_train_step(loss_fn)     # jitted; GSPMD handles DP/FSDP/TP comms
+    for batch in dataloader:
+        state, metrics = step(state, batch)          # grad-accum & clipping inside
+
+Gradient synchronization is *not* an explicit collective: batches are sharded over the
+``(dp, fsdp)`` mesh axes while params are replicated (DDP) or fsdp-sharded (ZeRO-3), so XLA
+derives the all-reduce / reduce-scatter from the shardings — the entire DDP reducer +
+DeepSpeed engine + FSDP wrapper surface of the reference collapses into ``jax.device_put``
+placements plus one ``jax.jit``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import inspect
+import math
+import os
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Union
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from .data_loader import DataLoaderDispatcher, DataLoaderShard, prepare_data_loader, skip_first_batches
+from .logging import get_logger
+from .optimizer import AcceleratedOptimizer
+from .parallel.fsdp import get_fsdp_shardings, shard_params
+from .parallel.mesh import MeshConfig, batch_sharding
+from .scheduler import AcceleratedScheduler
+from .state import AcceleratorState, GradientState, PartialState
+from .utils.constants import BATCH_AXES
+from .utils.dataclasses import (
+    DataLoaderConfiguration,
+    DistributedType,
+    FullyShardedDataParallelPlugin,
+    GradientAccumulationPlugin,
+    MixedPrecisionPolicy,
+    ProjectConfiguration,
+)
+from .utils.operations import (
+    convert_to_fp32,
+    gather,
+    gather_object,
+    pad_across_processes,
+    recursively_apply,
+    reduce,
+    send_to_device,
+    slice_tensors,
+)
+
+logger = get_logger(__name__)
+
+__all__ = ["Accelerator", "TrainState", "cast_floating"]
+
+
+def cast_floating(tree: Any, dtype) -> Any:
+    """Cast floating leaves of a pytree to ``dtype`` (ints/bools untouched)."""
+
+    def _cast(x):
+        if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating):
+            return x.astype(dtype)
+        return x
+
+    return jax.tree_util.tree_map(_cast, tree)
+
+
+@jax.tree_util.register_dataclass
+@dataclass
+class TrainState:
+    """The sharded training carry: everything a train step reads and writes.
+
+    The functional replacement for the reference's (model, optimizer, scaler) object trio.
+    ``grad_accum`` holds the running gradient sum between sync steps (the ``no_sync``
+    mechanism, reference ``accelerator.py:1001``); ``step`` counts *optimizer* steps only.
+    """
+
+    params: Any
+    opt_state: Any
+    step: jax.Array
+    grad_accum: Any = None
+    rng: Any = None
+    micro: jax.Array = None  # micro-steps since last apply (unique RNG per micro-batch)
+
+    def replace(self, **kwargs) -> "TrainState":
+        import dataclasses
+
+        return dataclasses.replace(self, **kwargs)
+
+
+class _TrainStep:
+    """Callable produced by ``Accelerator.build_train_step``.
+
+    Two compiled variants — accumulate-only and accumulate+apply — dispatched host-side from
+    the gradient-accumulation counter. This keeps each variant free of data-dependent control
+    flow (XLA-friendly) while preserving the reference's ``sync_gradients`` semantics exactly.
+    """
+
+    def __init__(self, accelerator: "Accelerator", micro_fn, apply_fn, optimizer=None):
+        self.accelerator = accelerator
+        self.micro_fn = micro_fn
+        self.apply_fn = apply_fn
+        self.optimizer = optimizer
+        self.micro_count = 0
+
+    def __call__(self, state: TrainState, batch) -> tuple[TrainState, Any]:
+        acc = self.accelerator
+        gs = acc.gradient_state
+        if acc._in_accumulate_ctx:
+            do_sync = gs.sync_gradients  # accumulate() ctx already decided
+        else:
+            at_end = gs.sync_with_dataloader and gs.end_of_dataloader
+            do_sync = ((self.micro_count + 1) % acc.gradient_accumulation_steps == 0) or at_end
+            gs._set_sync_gradients(do_sync)
+        if do_sync:
+            state, metrics = self.apply_fn(state, batch)
+            self.micro_count = 0
+        else:
+            state, metrics = self.micro_fn(state, batch)
+            self.micro_count += 1
+        acc.step += 1
+        if self.optimizer is not None:
+            self.optimizer.step()
+        return state, metrics
+
+
+class Accelerator:
+    """One facade for device placement, parallelism, precision, accumulation and IO."""
+
+    def __init__(
+        self,
+        device_placement: bool = True,
+        split_batches: bool = False,
+        mixed_precision: Optional[str] = None,
+        gradient_accumulation_steps: int = 1,
+        cpu: bool = False,
+        dataloader_config: Optional[DataLoaderConfiguration] = None,
+        mesh_config: Optional[MeshConfig] = None,
+        fsdp_plugin: Optional[FullyShardedDataParallelPlugin] = None,
+        tp_plugin=None,
+        pp_plugin=None,
+        sp_plugin=None,
+        ep_plugin=None,
+        megatron_lm_plugin=None,
+        rng_types: Optional[list[str]] = None,
+        log_with=None,
+        project_dir: Optional[str] = None,
+        project_config: Optional[ProjectConfiguration] = None,
+        gradient_accumulation_plugin: Optional[GradientAccumulationPlugin] = None,
+        step_scheduler_with_optimizer: bool = True,
+        kwargs_handlers: Optional[list] = None,
+        dynamo_plugin=None,
+    ):
+        self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
+        if project_dir is not None and self.project_configuration.project_dir is None:
+            self.project_configuration.set_directories(project_dir)
+
+        # Plugins may also arrive via the env wire protocol (launcher sets ACCELERATE_*).
+        if fsdp_plugin is None and os.environ.get("ACCELERATE_USE_FSDP", "false").lower() == "true":
+            fsdp_plugin = FullyShardedDataParallelPlugin()
+
+        self.state = AcceleratorState(
+            mixed_precision=mixed_precision,
+            cpu=cpu,
+            mesh_config=mesh_config,
+            fsdp_plugin=fsdp_plugin,
+            tp_plugin=tp_plugin,
+            pp_plugin=pp_plugin,
+            sp_plugin=sp_plugin,
+            ep_plugin=ep_plugin,
+            megatron_lm_plugin=megatron_lm_plugin,
+        )
+
+        if gradient_accumulation_plugin is None:
+            env_steps = int(os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", "-1"))
+            if env_steps > 0:
+                gradient_accumulation_steps = env_steps
+            gradient_accumulation_plugin = GradientAccumulationPlugin(
+                num_steps=gradient_accumulation_steps
+            )
+        self.gradient_state = GradientState(gradient_accumulation_plugin)
+
+        self.device_placement = device_placement
+        self.split_batches = split_batches
+        self.dataloader_config = dataloader_config or DataLoaderConfiguration(
+            split_batches=split_batches
+        )
+        self.rng_types = rng_types or ["generator"]
+        self.step_scheduler_with_optimizer = step_scheduler_with_optimizer
+        self.log_with = log_with
+        self.trackers: list = []
+
+        self.step = 0
+        self._in_accumulate_ctx = False
+        self._accumulate_count = 0
+        self._max_grad_norm: Optional[float] = None
+        self._models: list = []
+        self._optimizers: list[AcceleratedOptimizer] = []
+        self._schedulers: list = []
+        self._dataloaders: list = []
+        self._custom_objects: list = []
+        self._save_model_hooks: list[Callable] = []
+        self._load_model_hooks: list[Callable] = []
+
+        self.flag_tensor = None
+
+    # ------------------------------------------------------------------------ properties
+    @property
+    def mesh(self) -> Mesh:
+        return self.state.mesh
+
+    @property
+    def distributed_type(self) -> DistributedType:
+        return self.state.distributed_type
+
+    @property
+    def num_processes(self) -> int:
+        return self.state.num_processes
+
+    @property
+    def process_index(self) -> int:
+        return self.state.process_index
+
+    @property
+    def local_process_index(self) -> int:
+        return self.state.local_process_index
+
+    @property
+    def device(self):
+        return self.state.device
+
+    @property
+    def is_main_process(self) -> bool:
+        return self.state.is_main_process
+
+    @property
+    def is_local_main_process(self) -> bool:
+        return self.state.is_local_main_process
+
+    @property
+    def is_last_process(self) -> bool:
+        return self.state.is_last_process
+
+    @property
+    def mixed_precision(self) -> str:
+        return self.state.mixed_precision
+
+    @property
+    def mixed_precision_policy(self) -> MixedPrecisionPolicy:
+        return self.state.mixed_precision_policy
+
+    @property
+    def use_distributed(self) -> bool:
+        return self.state.use_distributed
+
+    @property
+    def gradient_accumulation_steps(self) -> int:
+        return self.gradient_state.num_steps
+
+    @gradient_accumulation_steps.setter
+    def gradient_accumulation_steps(self, value: int):
+        self.gradient_state.plugin_kwargs.update({"num_steps": value})
+
+    @property
+    def sync_gradients(self) -> bool:
+        return self.gradient_state.sync_gradients
+
+    @property
+    def project_dir(self):
+        return self.project_configuration.project_dir
+
+    # ------------------------------------------------------------------- process control
+    def wait_for_everyone(self):
+        self.state.wait_for_everyone()
+
+    def print(self, *args, **kwargs):
+        self.state.print(*args, **kwargs)
+
+    def split_between_processes(self, inputs, apply_padding: bool = False):
+        return self.state.split_between_processes(inputs, apply_padding=apply_padding)
+
+    def on_main_process(self, function):
+        return self.state.on_main_process(function)
+
+    def on_local_main_process(self, function):
+        return self.state.on_local_main_process(function)
+
+    def on_process(self, function=None, process_index=None):
+        return self.state.on_process(function, process_index=process_index)
+
+    def on_last_process(self, function):
+        return self.state.on_last_process(function)
+
+    # ------------------------------------------------------------------------- prepare
+    def prepare(self, *args, device_placement: Optional[list[bool]] = None):
+        """Prepare each object for the mesh, preserving order (reference ``:1283``).
+
+        Dispatch by duck type: dataloaders are sharded; optax transformations become
+        ``AcceleratedOptimizer``; param pytrees are sharded per the FSDP plugin; stateful
+        schedulers become ``AcceleratedScheduler``; flax modules pass through (their params
+        are what need preparing).
+        """
+        if device_placement is None:
+            device_placement = [None] * len(args)
+        result = tuple(
+            self._prepare_one(obj, device_placement=dp) for obj, dp in zip(args, device_placement)
+        )
+        return result if len(result) > 1 else result[0]
+
+    def _prepare_one(self, obj, device_placement=None):
+        if _is_dataloader_like(obj):
+            return self.prepare_data_loader(obj)
+        if _is_optax_transformation(obj):
+            return self.prepare_optimizer(obj)
+        if isinstance(obj, AcceleratedOptimizer):
+            if obj not in self._optimizers:
+                self._optimizers.append(obj)
+            return obj
+        if _is_stateful_scheduler(obj):
+            return self.prepare_scheduler(obj)
+        if _is_flax_module(obj):
+            self._models.append(obj)
+            return obj
+        if _is_torch_module(obj):
+            raise NotImplementedError(
+                "torch nn.Module preparation requires the torch bridge "
+                "(accelerate_tpu.interop) — define the model in flax or pass a param pytree."
+            )
+        if _is_params_pytree(obj):
+            return self.prepare_params(obj)
+        return obj
+
+    def prepare_params(self, params):
+        """Shard a param pytree over the mesh (the ``prepare_model`` analog, reference :1421).
+
+        Casts to the policy's param dtype (fp32 master weights) and applies ZeRO-3/FSDP
+        sharding when active; otherwise replicates (DDP layout).
+        """
+        policy = self.mixed_precision_policy
+        params = cast_floating(params, policy.param_dtype)
+        return shard_params(params, self.mesh, self.state.fsdp_plugin)
+
+    prepare_model = prepare_params  # reference-name alias for pytree models
+
+    def prepare_data_loader(self, data_loader, device_placement=None, slice_fn_for_dispatch=None):
+        if isinstance(data_loader, (DataLoaderShard, DataLoaderDispatcher)):
+            self._dataloaders.append(data_loader)
+            return data_loader
+        cfg = self.dataloader_config
+        device = self.mesh if (device_placement if device_placement is not None else self.device_placement) else None
+        prepared = prepare_data_loader(
+            data_loader,
+            device=device,
+            split_batches=cfg.split_batches,
+            put_on_device=device is not None,
+            rng_types=self.rng_types,
+            dispatch_batches=cfg.dispatch_batches,
+            even_batches=cfg.even_batches,
+            use_seedable_sampler=cfg.use_seedable_sampler,
+            data_seed=cfg.data_seed,
+            non_blocking=cfg.non_blocking,
+        )
+        self._dataloaders.append(prepared)
+        return prepared
+
+    def prepare_optimizer(self, optimizer, device_placement=None) -> AcceleratedOptimizer:
+        wrapped = AcceleratedOptimizer(optimizer, device_placement=device_placement or True)
+        self._optimizers.append(wrapped)
+        return wrapped
+
+    def prepare_scheduler(self, scheduler) -> AcceleratedScheduler:
+        wrapped = AcceleratedScheduler(
+            scheduler,
+            optimizers=self._optimizers,
+            step_with_optimizer=self.step_scheduler_with_optimizer,
+            split_batches=self.dataloader_config.split_batches,
+        )
+        self._schedulers.append(wrapped)
+        return wrapped
+
+    # -------------------------------------------------------------------- train state/step
+    def create_train_state(
+        self,
+        params,
+        optimizer: Union[AcceleratedOptimizer, Any],
+        rng: Optional[jax.Array] = None,
+    ) -> TrainState:
+        """Build the sharded training carry.
+
+        Params are prepared (cast + sharded); optimizer state is initialized *from the sharded
+        params*, so each opt-state leaf inherits its param's sharding — that placement IS
+        ZeRO-1 when params are fsdp-sharded, with zero further code.
+        """
+        if not isinstance(optimizer, AcceleratedOptimizer):
+            optimizer = self.prepare_optimizer(optimizer)
+        params = self.prepare_params(params)
+        opt_state = optimizer.init(params)
+        optimizer._opt_state_ref = opt_state
+        accum = None
+        if self.gradient_accumulation_steps > 1:
+            accum = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return TrainState(
+            params=params,
+            opt_state=opt_state,
+            step=jnp.zeros((), dtype=jnp.int32),
+            grad_accum=accum,
+            rng=rng,
+            micro=jnp.zeros((), dtype=jnp.int32),
+        )
+
+    def build_train_step(
+        self,
+        loss_fn: Callable,
+        optimizer: Optional[Union[AcceleratedOptimizer, Any]] = None,
+        max_grad_norm: Optional[float] = None,
+        has_aux: bool = False,
+        donate: bool = True,
+    ) -> _TrainStep:
+        """Compile the training step (the reference hot loop, SURVEY.md §3.4, as one XLA program).
+
+        ``loss_fn(params, batch)`` or ``loss_fn(params, batch, rng)`` returns a scalar loss
+        (or ``(loss, aux)`` with ``has_aux=True``). Mixed precision: params are cast to the
+        compute dtype *inside* the step so gradients/master weights stay fp32 (the
+        autocast + GradScaler-free equivalent of reference ``:1462-1473``).
+        """
+        if optimizer is None:
+            if not self._optimizers:
+                raise ValueError("No optimizer prepared; pass one to build_train_step.")
+            optimizer = self._optimizers[-1]
+        if not isinstance(optimizer, AcceleratedOptimizer):
+            optimizer = self.prepare_optimizer(optimizer)
+        tx = optimizer.optimizer
+        policy = self.mixed_precision_policy
+        if max_grad_norm is None:
+            max_grad_norm = self._max_grad_norm
+        accum_steps = self.gradient_accumulation_steps
+        wants_rng = _loss_fn_wants_rng(loss_fn)
+
+        def compute(state: TrainState, batch):
+            step_rng = None
+            if state.rng is not None:
+                # Unique key per micro-batch: step alone would repeat dropout masks across
+                # an accumulation window.
+                micro = state.micro if state.micro is not None else 0
+                step_rng = jax.random.fold_in(state.rng, state.step * accum_steps + micro)
+
+            def wrapped(params):
+                cparams = cast_floating(params, policy.compute_dtype)
+                out = loss_fn(cparams, batch, step_rng) if wants_rng else loss_fn(cparams, batch)
+                loss, aux = out if has_aux else (out, None)
+                return jnp.asarray(loss, dtype=jnp.float32), aux
+
+            (loss, aux), grads = jax.value_and_grad(wrapped, has_aux=True)(state.params)
+            return loss, aux, grads
+
+        def micro_step(state: TrainState, batch):
+            loss, aux, grads = compute(state, batch)
+            if state.grad_accum is None:
+                # First no_sync() use with accumulation disabled: adopt grads as the buffer
+                # (structure change → one retrace, then stable).
+                accum = grads
+            else:
+                accum = jax.tree_util.tree_map(jnp.add, state.grad_accum, grads)
+            metrics = {"loss": loss}
+            if has_aux:
+                metrics["aux"] = aux
+            micro = (state.micro if state.micro is not None else 0) + 1
+            return state.replace(grad_accum=accum, micro=jnp.asarray(micro, jnp.int32)), metrics
+
+        def apply_step(state: TrainState, batch):
+            loss, aux, grads = compute(state, batch)
+            if state.grad_accum is not None:
+                grads = jax.tree_util.tree_map(jnp.add, state.grad_accum, grads)
+            if accum_steps > 1:
+                grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
+            metrics = {"loss": loss}
+            if max_grad_norm is not None:
+                gnorm = _global_norm(grads)
+                scale = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
+                grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+                metrics["grad_norm"] = gnorm
+            import optax
+
+            updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+            new_params = optax.apply_updates(state.params, updates)
+            new_accum = state.grad_accum
+            if new_accum is not None:
+                new_accum = jax.tree_util.tree_map(jnp.zeros_like, new_accum)
+            if has_aux:
+                metrics["aux"] = aux
+            return (
+                state.replace(
+                    params=new_params,
+                    opt_state=new_opt_state,
+                    step=state.step + 1,
+                    grad_accum=new_accum,
+                    micro=jnp.zeros((), jnp.int32) if state.micro is not None else None,
+                ),
+                metrics,
+            )
+
+        donate_args = (0,) if donate else ()
+        jit_micro = jax.jit(micro_step, donate_argnums=donate_args)
+        jit_apply = jax.jit(apply_step, donate_argnums=donate_args)
+        return _TrainStep(self, jit_micro, jit_apply, optimizer=optimizer)
+
+    def build_eval_step(self, eval_fn: Callable, donate: bool = False) -> Callable:
+        """Jit an eval function ``eval_fn(params, batch) -> outputs`` with compute-dtype cast."""
+        policy = self.mixed_precision_policy
+
+        def wrapped(params, batch):
+            cparams = cast_floating(params, policy.compute_dtype)
+            out = eval_fn(cparams, batch)
+            if policy.output_dtype == jnp.float32:
+                out = cast_floating(out, jnp.float32)
+            return out
+
+        return jax.jit(wrapped)
+
+    # -------------------------------------------------------- accumulation / sync contexts
+    @contextlib.contextmanager
+    def accumulate(self, *models):
+        """Gradient-accumulation context (reference ``:1116``).
+
+        Counts entries; ``sync_gradients`` is True every ``gradient_accumulation_steps``-th
+        entry or at end-of-dataloader (``sync_with_dataloader``). The jitted step built by
+        ``build_train_step`` reads the flag host-side to pick the accumulate vs apply program.
+        """
+        self._accumulate_count += 1
+        at_end = self.gradient_state.sync_with_dataloader and self.gradient_state.end_of_dataloader
+        do_sync = (
+            (self._accumulate_count % self.gradient_accumulation_steps == 0)
+            or at_end
+            or self.gradient_state.sync_each_batch
+        )
+        self.gradient_state._set_sync_gradients(do_sync)
+        self._in_accumulate_ctx = True
+        try:
+            yield
+        finally:
+            self._in_accumulate_ctx = False
+
+    @contextlib.contextmanager
+    def no_sync(self, model=None):
+        """Force-skip gradient sync (reference ``:1001``). Under GSPMD this only toggles the
+        host flag — the compiled accumulate-variant performs no cross-device grad traffic."""
+        prev = self.gradient_state.sync_gradients
+        self.gradient_state._set_sync_gradients(False)
+        self._in_accumulate_ctx = True
+        try:
+            yield
+        finally:
+            self.gradient_state._set_sync_gradients(prev)
+            self._in_accumulate_ctx = False
+
+    @contextlib.contextmanager
+    def autocast(self, autocast_handler=None):
+        """API-parity context (reference ``:3587``): under JAX the compute-dtype cast happens
+        inside the compiled step; this context exists so reference-style code runs unchanged."""
+        yield
+
+    @contextlib.contextmanager
+    def join_uneven_inputs(self, joinables, even_batches: Optional[bool] = None):
+        """Reference ``:1197``: with mesh-global batches, uneven inputs are already handled by
+        the dataloader's even_batches padding; honor an override for this block."""
+        cfg = self.dataloader_config
+        prev = cfg.even_batches
+        if even_batches is not None:
+            cfg.even_batches = even_batches
+        try:
+            yield
+        finally:
+            cfg.even_batches = prev
+
+    # ----------------------------------------------------------------- gradient utilities
+    def backward(self, loss, **kwargs):
+        raise RuntimeError(
+            "JAX has no backward tape: gradients are computed inside the compiled train step. "
+            "Use `step = accelerator.build_train_step(loss_fn)` and call "
+            "`state, metrics = step(state, batch)` — or `accelerator.value_and_grad(loss_fn)` "
+            "for manual loops."
+        )
+
+    def value_and_grad(self, loss_fn: Callable, has_aux: bool = False) -> Callable:
+        """Mixed-precision-aware ``jax.value_and_grad`` for manual training loops."""
+        policy = self.mixed_precision_policy
+
+        def wrapped(params, *args, **kwargs):
+            def inner(p):
+                return loss_fn(cast_floating(p, policy.compute_dtype), *args, **kwargs)
+
+            return jax.value_and_grad(inner, has_aux=has_aux)(params)
+
+        return wrapped
+
+    def clip_grad_norm_(self, max_grad_norm: float):
+        """Record the global-norm clip applied inside subsequently-built train steps
+        (reference ``:2485``; returns None — the realized norm is in step metrics)."""
+        self._max_grad_norm = float(max_grad_norm)
+
+    def clip_grad_value_(self, *args, **kwargs):
+        raise NotImplementedError("Use clip_grad_norm_; value clipping is not yet implemented.")
+
+    # ---------------------------------------------------------------------- metrics / ops
+    def gather(self, tensor):
+        return gather(tensor)
+
+    def gather_for_metrics(self, input_data, use_gather_object: bool = False):
+        """Gather + drop the duplicate tail samples of the final batch (reference ``:2601``).
+
+        The dataloader's even_batches padding duplicates samples in the last global batch;
+        ``GradientState.remainder`` (set by the prepared dataloader) says how many are real.
+        """
+        try:
+            recursively_apply(lambda x: x, input_data, error_on_other_type=True)
+            all_tensors = True
+        except TypeError:
+            all_tensors = False
+
+        if use_gather_object or not all_tensors:
+            data = gather_object(input_data)
+        else:
+            data = gather(input_data)
+
+        try:
+            if self.gradient_state.end_of_dataloader:
+                remainder = self.gradient_state.remainder
+                if remainder > 0:
+
+                    def _trim(tensor):
+                        return tensor[:remainder]
+
+                    if use_gather_object or not all_tensors:
+                        return data[:remainder]
+                    return recursively_apply(_trim, data)
+            return data
+        except Exception:
+            return data
+
+    def reduce(self, tensor, reduction: str = "sum", scale: float = 1.0):
+        return reduce(tensor, reduction=reduction, scale=scale)
+
+    def pad_across_processes(self, tensor, dim: int = 0, pad_index: int = 0, pad_first: bool = False):
+        return pad_across_processes(tensor, dim=dim, pad_index=pad_index, pad_first=pad_first)
+
+    # ----------------------------------------------------------------------- model utils
+    def unwrap_model(self, model, keep_fp32_wrapper: bool = True):
+        return model
+
+    def get_state_dict(self, model, unwrap: bool = True):
+        """Full (unsharded) host state dict of a param pytree (reference ``:3500``)."""
+        from .parallel.fsdp import gather_full_params
+
+        return gather_full_params(model)
+
+    def free_memory(self, *objects):
+        """Release references + device buffers (reference ``:3545``)."""
+        self._models.clear()
+        self._optimizers.clear()
+        self._schedulers.clear()
+        self._dataloaders.clear()
+        self.step = 0
+        jax.clear_caches()
+        return objects
+
+    def clear(self, *objects):
+        return self.free_memory(*objects)
+
+    # ------------------------------------------------------------------- checkpoint hooks
+    def register_for_checkpointing(self, *objects):
+        """Register custom stateful objects for save_state/load_state (reference ``:3067``)."""
+        invalid = [o for o in objects if not (hasattr(o, "state_dict") and hasattr(o, "load_state_dict"))]
+        if invalid:
+            raise ValueError(
+                f"Objects {invalid} lack state_dict/load_state_dict and cannot be registered."
+            )
+        self._custom_objects.extend(objects)
+
+    def register_save_state_pre_hook(self, hook: Callable):
+        self._save_model_hooks.append(hook)
+        return _RemovableHandle(self._save_model_hooks, hook)
+
+    def register_load_state_pre_hook(self, hook: Callable):
+        self._load_model_hooks.append(hook)
+        return _RemovableHandle(self._load_model_hooks, hook)
+
+    def save_state(self, output_dir: Optional[str] = None, train_state: Optional[TrainState] = None, **save_kwargs):
+        from .checkpointing import save_accelerator_state
+
+        return save_accelerator_state(self, output_dir, train_state=train_state, **save_kwargs)
+
+    def load_state(self, input_dir: Optional[str] = None, train_state: Optional[TrainState] = None, **load_kwargs):
+        from .checkpointing import load_accelerator_state
+
+        return load_accelerator_state(self, input_dir, train_state=train_state, **load_kwargs)
+
+    def skip_first_batches(self, dataloader, num_batches: int = 0):
+        return skip_first_batches(dataloader, num_batches=num_batches)
+
+    # ------------------------------------------------------------------------ trackers
+    def init_trackers(self, project_name: str, config: Optional[dict] = None, init_kwargs: dict = None):
+        from .tracking import filter_trackers
+
+        self.trackers = filter_trackers(self.log_with, self.logging_dir, project_name, config, init_kwargs or {})
+
+    @property
+    def logging_dir(self):
+        return self.project_configuration.logging_dir
+
+    def log(self, values: dict, step: Optional[int] = None, log_kwargs: dict = None):
+        if self.is_main_process:
+            for tracker in self.trackers:
+                tracker.log(values, step=step, **(log_kwargs or {}).get(tracker.name, {}))
+
+    def get_tracker(self, name: str, unwrap: bool = False):
+        for tracker in self.trackers:
+            if tracker.name == name:
+                return tracker.tracker if unwrap else tracker
+        raise ValueError(f"Tracker {name} not initialized")
+
+    def end_training(self):
+        for tracker in self.trackers:
+            tracker.finish()
+        self.wait_for_everyone()
+
+    def __repr__(self):
+        return (
+            f"Accelerator(distributed_type={self.distributed_type}, "
+            f"mixed_precision={self.mixed_precision!r}, "
+            f"grad_accum={self.gradient_accumulation_steps}, "
+            f"mesh={dict(zip(self.mesh.axis_names, self.mesh.devices.shape))})"
+        )
+
+
+class _RemovableHandle:
+    def __init__(self, container: list, item):
+        self.container = container
+        self.item = item
+
+    def remove(self):
+        if self.item in self.container:
+            self.container.remove(self.item)
+
+
+# ------------------------------------------------------------------------- type sniffing
+def _is_dataloader_like(obj) -> bool:
+    if isinstance(obj, (DataLoaderShard, DataLoaderDispatcher)):
+        return True
+    if type(obj).__module__.startswith("torch.utils.data"):
+        return True
+    return hasattr(obj, "__iter__") and (hasattr(obj, "batch_sampler") or hasattr(obj, "dataset"))
+
+
+def _is_optax_transformation(obj) -> bool:
+    return (
+        hasattr(obj, "init")
+        and hasattr(obj, "update")
+        and not hasattr(obj, "apply")
+        and not isinstance(obj, type)
+        and not _is_params_pytree(obj)
+    )
+
+
+def _is_stateful_scheduler(obj) -> bool:
+    return hasattr(obj, "step") and hasattr(obj, "state_dict") and not hasattr(obj, "update")
+
+
+def _is_flax_module(obj) -> bool:
+    mod = type(obj).__module__
+    return mod.startswith("flax") and hasattr(obj, "apply")
+
+
+def _is_torch_module(obj) -> bool:
+    mod = type(obj).__module__
+    return mod.startswith("torch") and hasattr(obj, "forward")
+
+
+def _is_params_pytree(obj) -> bool:
+    if not isinstance(obj, dict) or not obj:
+        return False
+    leaves = jax.tree_util.tree_leaves(obj)
+    return len(leaves) > 0 and all(isinstance(l, (jax.Array, np.ndarray)) for l in leaves)
+
+
+def _loss_fn_wants_rng(loss_fn) -> bool:
+    try:
+        sig = inspect.signature(loss_fn)
+    except (TypeError, ValueError):
+        return False
+    params = [
+        p
+        for p in sig.parameters.values()
+        if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+    ]
+    return len(params) >= 3 or "rng" in sig.parameters
+
+
+def _global_norm(tree) -> jax.Array:
+    leaves = jax.tree_util.tree_leaves(tree)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves))

@@ -1,0 +1,254 @@
+"""Checkpoint / resume (L7).
+
+TPU-native analog of reference ``checkpointing.py`` (/root/reference/src/accelerate/
+checkpointing.py): ``save_accelerator_state`` (:57), ``load_accelerator_state`` (:175),
+custom-object hooks (:303,313); plus the ``Accelerator.save_state``/``load_state`` directory
+contract (reference ``accelerator.py:3106,3272``) with automatic naming + rotation
+(``ProjectConfiguration``, pruning at reference ``accelerator.py:3149-3163``).
+
+Format divergences from the reference (torch pickles):
+- The sharded ``TrainState`` (params / optimizer state / counters) is saved with **orbax /
+  tensorstore** — every host writes only its own shards (the SHARDED_STATE_DICT analog,
+  reference ``utils/fsdp_utils.py:96-107``), and restore re-shards to the current mesh.
+- Host-side bits keep the reference's file naming: ``random_states_{rank}.pkl`` (python/numpy/
+  torch RNG), ``custom_checkpoint_{i}.pkl``, ``scheduler.json``/``sampler.json`` metadata.
+- ``model.safetensors`` can additionally be exported for interchange (``safe_serialization``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+import random
+import shutil
+from pathlib import Path
+from typing import Any, Optional
+
+import numpy as np
+import jax
+
+from .logging import get_logger
+from .utils.constants import (
+    CUSTOM_OBJECT_NAME,
+    MODEL_NAME,
+    RNG_STATE_NAME,
+    SAFE_WEIGHTS_NAME,
+    SAMPLER_STATE_NAME,
+    SCHEDULER_STATE_NAME,
+    SHARDED_STATE_DIR,
+)
+from .utils.imports import is_safetensors_available, is_torch_available
+
+logger = get_logger(__name__)
+
+__all__ = ["save_accelerator_state", "load_accelerator_state", "save_custom_state", "load_custom_state"]
+
+
+def _checkpoint_dir(accelerator, output_dir: Optional[str], for_save: bool) -> Path:
+    project = accelerator.project_configuration
+    if output_dir is None:
+        if project.project_dir is None:
+            raise ValueError("No output_dir given and no project_dir configured.")
+        base = Path(project.project_dir) / "checkpoints"
+        if for_save:
+            target = base / f"checkpoint_{project.iteration}"
+        else:
+            # Load the latest checkpoint (reference load_state default behavior :3290).
+            existing = sorted(
+                base.glob("checkpoint_*"), key=lambda p: int(p.name.split("_")[-1])
+            )
+            if not existing:
+                raise FileNotFoundError(f"No checkpoints found under {base}")
+            target = existing[-1]
+        return target
+    return Path(output_dir)
+
+
+def _rotate_checkpoints(accelerator, base: Path) -> None:
+    limit = accelerator.project_configuration.total_limit
+    if limit is None:
+        return
+    existing = sorted(base.parent.glob("checkpoint_*"), key=lambda p: int(p.name.split("_")[-1]))
+    while len(existing) >= max(limit, 1) + 0 and len(existing) > limit - 1:
+        victim = existing.pop(0)
+        logger.info(f"Deleting old checkpoint {victim} (total_limit={limit})")
+        shutil.rmtree(victim, ignore_errors=True)
+
+
+def save_accelerator_state(
+    accelerator,
+    output_dir: Optional[str] = None,
+    train_state=None,
+    safe_serialization: bool = False,
+) -> str:
+    """Write a full resumable snapshot. Returns the checkpoint path."""
+    project = accelerator.project_configuration
+    automatic = output_dir is None and project.automatic_checkpoint_naming
+    if automatic:
+        _rotate_checkpoints(accelerator, Path(project.project_dir) / "checkpoints" / "x")
+    path = _checkpoint_dir(accelerator, output_dir, for_save=True)
+    path.mkdir(parents=True, exist_ok=True)
+
+    for hook in accelerator._save_model_hooks:
+        hook(accelerator._models, train_state, str(path))
+
+    # 1. Sharded train state via orbax (params + opt state + counters + rng).
+    if train_state is not None:
+        import orbax.checkpoint as ocp
+
+        ckpt_path = (path / SHARDED_STATE_DIR).absolute()
+        if ckpt_path.exists():
+            shutil.rmtree(ckpt_path)
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(ckpt_path, train_state)
+        # 1b. Optional interchange export: consolidated safetensors of the params.
+        if safe_serialization and accelerator.is_main_process:
+            _export_safetensors(train_state.params, path / SAFE_WEIGHTS_NAME)
+
+    # 2. Host-side objects (main process writes shared files; every process its RNG).
+    meta: dict[str, Any] = {
+        "step": accelerator.step,
+        "iteration": project.iteration,
+        "optimizers": [opt.state_dict() for opt in accelerator._optimizers],
+    }
+    schedulers = []
+    for sched in accelerator._schedulers:
+        try:
+            schedulers.append(sched.state_dict())
+        except Exception:
+            schedulers.append(None)
+    meta["schedulers"] = schedulers
+    samplers = []
+    for dl in accelerator._dataloaders:
+        samplers.append({"iteration": getattr(dl, "iteration", 0)})
+    meta["dataloaders"] = samplers
+    if accelerator.is_main_process:
+        (path / SCHEDULER_STATE_NAME).write_text(json.dumps(meta, indent=2))
+        (path / SAMPLER_STATE_NAME).write_text(json.dumps(samplers))
+
+    for i, obj in enumerate(accelerator._custom_objects):
+        save_custom_state(obj, str(path), i)
+
+    # 3. Per-process host RNG states (reference checkpointing.py:148-171).
+    states: dict[str, Any] = {
+        "random_state": random.getstate(),
+        "numpy_random_seed": np.random.get_state(),
+    }
+    if is_torch_available():
+        import torch
+
+        states["torch_manual_seed"] = torch.get_rng_state()
+    with open(path / f"{RNG_STATE_NAME}_{accelerator.process_index}.pkl", "wb") as f:
+        pickle.dump(states, f)
+
+    if automatic:
+        project.iteration += 1
+    logger.info(f"Saved accelerator state to {path}")
+    return str(path)
+
+
+def load_accelerator_state(
+    accelerator,
+    input_dir: Optional[str] = None,
+    train_state=None,
+    load_optimizer_states: bool = True,
+):
+    """Restore a snapshot. Returns the restored TrainState (or None if none was given)."""
+    path = _checkpoint_dir(accelerator, input_dir, for_save=False)
+    if not path.exists():
+        raise FileNotFoundError(f"Checkpoint {path} does not exist")
+
+    for hook in accelerator._load_model_hooks:
+        hook(accelerator._models, train_state, str(path))
+
+    restored = None
+    if train_state is not None:
+        import orbax.checkpoint as ocp
+
+        with ocp.StandardCheckpointer() as ckptr:
+            abstract = jax.tree_util.tree_map(_abstractify, train_state)
+            restored = ckptr.restore((path / SHARDED_STATE_DIR).absolute(), abstract)
+
+    meta_file = path / SCHEDULER_STATE_NAME
+    if meta_file.exists():
+        meta = json.loads(meta_file.read_text())
+        accelerator.step = meta.get("step", 0)
+        if load_optimizer_states:
+            for opt, sd in zip(accelerator._optimizers, meta.get("optimizers", [])):
+                opt.load_state_dict(sd)
+        for sched, sd in zip(accelerator._schedulers, meta.get("schedulers", [])):
+            if sd is not None:
+                try:
+                    sched.load_state_dict(sd)
+                except Exception:
+                    logger.warning("Could not restore a scheduler state", main_process_only=True)
+        for dl, sd in zip(accelerator._dataloaders, meta.get("dataloaders", [])):
+            if hasattr(dl, "set_epoch"):
+                dl.set_epoch(sd.get("iteration", 0))
+
+    for i, obj in enumerate(accelerator._custom_objects):
+        load_custom_state(obj, str(path), i)
+
+    rng_file = path / f"{RNG_STATE_NAME}_{accelerator.process_index}.pkl"
+    if rng_file.exists():
+        with open(rng_file, "rb") as f:
+            states = pickle.load(f)
+        random.setstate(states["random_state"])
+        np.random.set_state(states["numpy_random_seed"])
+        if is_torch_available() and "torch_manual_seed" in states:
+            import torch
+
+            torch.set_rng_state(states["torch_manual_seed"])
+
+    logger.info(f"Loaded accelerator state from {path}")
+    return restored
+
+
+def _abstractify(leaf):
+    if isinstance(leaf, jax.Array):
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=leaf.sharding)
+    return leaf
+
+
+def _export_safetensors(params, file_path: Path) -> None:
+    """Consolidated (unsharded) safetensors export with flattened slash-joined keys."""
+    if not is_safetensors_available():
+        logger.warning("safetensors unavailable; skipping interchange export")
+        return
+    from safetensors.numpy import save_file
+
+    from .parallel.fsdp import gather_full_params
+
+    flat = {}
+    host_params = gather_full_params(params)
+    for keypath, leaf in jax.tree_util.tree_flatten_with_path(host_params)[0]:
+        name = "/".join(_key_str(k) for k in keypath)
+        arr = np.asarray(leaf)
+        if arr.dtype.name == "bfloat16":  # ml_dtypes bf16 is not a safetensors-numpy dtype
+            arr = arr.astype(np.float32)
+        flat[name] = arr
+    save_file(flat, str(file_path))
+
+
+def _key_str(k) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    return str(k)
+
+
+def save_custom_state(obj, path: str, index: int = 0, save_on_each_node: bool = False) -> None:
+    """Pickle ``obj.state_dict()`` (reference ``checkpointing.py:303``)."""
+    load_location = Path(path) / f"{CUSTOM_OBJECT_NAME}_{index}.pkl"
+    with open(load_location, "wb") as f:
+        pickle.dump(obj.state_dict(), f)
+
+
+def load_custom_state(obj, path: str, index: int = 0) -> None:
+    """Load into ``obj.load_state_dict`` (reference ``checkpointing.py:313``)."""
+    load_location = Path(path) / f"{CUSTOM_OBJECT_NAME}_{index}.pkl"
+    if load_location.exists():
+        with open(load_location, "rb") as f:
+            obj.load_state_dict(pickle.load(f))

@@ -1,0 +1,102 @@
+"""A compact pure-JAX transformer LM used by bench.py and the driver entry points.
+
+This is NOT the flagship model family (see ``models/llama.py`` / ``models/bert.py``) — it is a
+dependency-free decoder stack with the canonical TPU-friendly shapes (d_model multiple of 128,
+bf16 matmuls on the MXU) used for smoke benchmarks and multi-chip dry runs.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["TransformerConfig", "init_params", "forward", "loss_fn"]
+
+
+class TransformerConfig:
+    def __init__(
+        self,
+        vocab_size: int = 32000,
+        d_model: int = 512,
+        n_heads: int = 8,
+        n_layers: int = 4,
+        d_ff: int = 2048,
+        max_seq: int = 512,
+        dtype=jnp.bfloat16,
+    ):
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.n_heads = n_heads
+        self.n_layers = n_layers
+        self.d_ff = d_ff
+        self.max_seq = max_seq
+        self.dtype = dtype
+        self.head_dim = d_model // n_heads
+
+
+def init_params(cfg: TransformerConfig, seed: int = 0) -> dict:
+    key = jax.random.PRNGKey(seed)
+    keys = jax.random.split(key, cfg.n_layers * 6 + 3)
+    scale = 1.0 / math.sqrt(cfg.d_model)
+    params: dict[str, Any] = {
+        "embed": jax.random.normal(keys[0], (cfg.vocab_size, cfg.d_model), jnp.float32) * scale,
+        "pos": jax.random.normal(keys[1], (cfg.max_seq, cfg.d_model), jnp.float32) * scale,
+        "ln_f": jnp.ones((cfg.d_model,), jnp.float32),
+        "layers": [],
+    }
+    k = 2
+    for _ in range(cfg.n_layers):
+        params["layers"].append(
+            {
+                "ln1": jnp.ones((cfg.d_model,), jnp.float32),
+                "wqkv": jax.random.normal(keys[k], (cfg.d_model, 3 * cfg.d_model), jnp.float32) * scale,
+                "wo": jax.random.normal(keys[k + 1], (cfg.d_model, cfg.d_model), jnp.float32) * scale,
+                "ln2": jnp.ones((cfg.d_model,), jnp.float32),
+                "w1": jax.random.normal(keys[k + 2], (cfg.d_model, cfg.d_ff), jnp.float32) * scale,
+                "w2": jax.random.normal(keys[k + 3], (cfg.d_ff, cfg.d_model), jnp.float32) * scale,
+            }
+        )
+        k += 4
+    return params
+
+
+def _rms_norm(x, g):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6)).astype(x.dtype) * g.astype(x.dtype)
+
+
+def forward(params: dict, tokens: jax.Array, cfg: TransformerConfig) -> jax.Array:
+    """Causal LM forward: tokens [B, S] int32 → logits [B, S, V]."""
+    B, S = tokens.shape
+    dtype = cfg.dtype
+    x = params["embed"].astype(dtype)[tokens] + params["pos"].astype(dtype)[:S]
+    mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))
+    for layer in params["layers"]:
+        h = _rms_norm(x, layer["ln1"])
+        qkv = h @ layer["wqkv"].astype(dtype)
+        q, k_, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+        k_ = k_.reshape(B, S, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+        v = v.reshape(B, S, cfg.n_heads, cfg.head_dim).transpose(0, 2, 1, 3)
+        scores = (q @ k_.transpose(0, 1, 3, 2)) / math.sqrt(cfg.head_dim)
+        scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+        attn = (probs @ v).transpose(0, 2, 1, 3).reshape(B, S, cfg.d_model)
+        x = x + attn @ layer["wo"].astype(dtype)
+        h = _rms_norm(x, layer["ln2"])
+        x = x + jax.nn.gelu(h @ layer["w1"].astype(dtype)) @ layer["w2"].astype(dtype)
+    x = _rms_norm(x, params["ln_f"])
+    return (x @ params["embed"].astype(dtype).T).astype(jnp.float32)
+
+
+def loss_fn(params: dict, batch: dict, cfg: TransformerConfig) -> jax.Array:
+    """Next-token cross-entropy on batch {'tokens': [B, S]}."""
+    tokens = batch["tokens"]
+    logits = forward(params, tokens[:, :-1], cfg)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    return -jnp.mean(ll)

@@ -1,0 +1,100 @@
+"""Optimizer wrapper (reference ``optimizer.py``, 212 LoC).
+
+``AcceleratedOptimizer`` wraps an ``optax.GradientTransformation``. The reference's core
+behaviors map as follows:
+
+- *skip step during accumulation* (reference ``:161``): the jitted train step only applies the
+  optax update on sync steps, so the wrapper's ``step()`` is bookkeeping — it mirrors
+  ``GradientState.sync_gradients`` and advances the host-side step counter for schedulers.
+- *XLA grad all-reduce before step* (reference ``:148-154``): obsolete — GSPMD inserts the
+  gradient psum/reduce-scatter automatically from the shardings.
+- *GradScaler skipped-step detection* (reference ``:161-176``): the functional dynamic-scale
+  path (``precision.DynamicScale``) records ``optimizer_step_was_skipped`` into the train
+  state; the wrapper exposes it.
+- *device placement of optimizer state* (reference ``:68-74``): opt state is created sharded
+  (inherits param shardings — ZeRO-1) by ``Accelerator.prepare``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from .state import AcceleratorState, GradientState
+
+__all__ = ["AcceleratedOptimizer"]
+
+
+def _is_optax_transformation(obj) -> bool:
+    return hasattr(obj, "init") and hasattr(obj, "update") and not hasattr(obj, "apply")
+
+
+class AcceleratedOptimizer:
+    """Facade over an optax transformation, carrying Accelerate's optimizer API surface.
+
+    The actual ``update`` runs inside the jitted train step (``Accelerator.build_train_step``);
+    this object owns the transformation, the host-side step counter, and param-group-style
+    hyperparameter access (via ``optax.inject_hyperparams`` when present).
+    """
+
+    def __init__(self, optimizer, device_placement: bool = True, scaler=None):
+        self.optimizer = optimizer  # optax.GradientTransformation
+        self.scaler = scaler
+        self.accelerator_state = AcceleratorState() if AcceleratorState._shared_state else None
+        self.gradient_state = GradientState()
+        self.device_placement = device_placement
+        self._step_count = 0
+        self._is_overflow = False
+        self._opt_state_ref = None  # set by Accelerator after train-state creation
+
+    # ------------------------------------------------------------------ optax delegation
+    def init(self, params):
+        return self.optimizer.init(params)
+
+    def update(self, grads, opt_state, params=None, **kwargs):
+        return self.optimizer.update(grads, opt_state, params, **kwargs)
+
+    # --------------------------------------------------------------- torch-like surface
+    @property
+    def state(self):
+        return self._opt_state_ref
+
+    @property
+    def param_groups(self):
+        """Hyperparameters, when the transformation was built with inject_hyperparams."""
+        hp = getattr(self._opt_state_ref, "hyperparams", None)
+        if hp is not None:
+            return [dict(hp)]
+        return []
+
+    def step(self, closure=None) -> None:
+        """Host-side mirror of the in-jit conditional update.
+
+        Counts an optimizer step only on sync steps — exactly the reference's skip behavior
+        (``optimizer.py:161``), so scheduler logic downstream agrees with the device.
+        """
+        if self.gradient_state.sync_gradients:
+            self._step_count += 1
+            self._is_overflow = False
+
+    def zero_grad(self, set_to_none: bool = True) -> None:
+        """No-op: gradients are function outputs under JAX, never stored fields."""
+
+    @property
+    def step_was_skipped(self) -> bool:
+        """Whether the last step was skipped (dynamic-scale overflow or accumulation)."""
+        return self._is_overflow or not self.gradient_state.sync_gradients
+
+    @property
+    def optimizer_step_was_skipped(self) -> bool:  # reference property name
+        return self.step_was_skipped
+
+    def state_dict(self):
+        return {"step_count": self._step_count}
+
+    def load_state_dict(self, state_dict):
+        self._step_count = state_dict.get("step_count", 0)
+
+    def __repr__(self):
+        return f"AcceleratedOptimizer({self.optimizer!r}, steps={self._step_count})"

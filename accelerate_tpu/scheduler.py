@@ -1,0 +1,69 @@
+"""Scheduler wrapper (reference ``scheduler.py``, 98 LoC).
+
+Two scheduler styles are supported:
+
+- **optax schedules** (functions ``step -> lr``) baked into the transformation: nothing to
+  wrap — the schedule reads the optimizer step count, which only advances on sync steps, so
+  the reference's "don't step the LR during accumulation" behavior (:54) is automatic.
+- **stateful schedulers** (objects with ``.step()``/``.get_last_lr()``, e.g. torch or
+  user-written): ``AcceleratedScheduler`` steps them only when the optimizer really stepped,
+  and ``num_processes``× when the batch size scales with world size
+  (``split_batches=False``, reference ``:70-82``).
+"""
+
+from __future__ import annotations
+
+from .state import AcceleratorState, GradientState
+
+__all__ = ["AcceleratedScheduler"]
+
+
+class AcceleratedScheduler:
+    def __init__(
+        self,
+        scheduler,
+        optimizers,
+        step_with_optimizer: bool = True,
+        split_batches: bool = False,
+    ):
+        self.scheduler = scheduler
+        self.optimizers = optimizers if isinstance(optimizers, (list, tuple)) else [optimizers]
+        self.split_batches = split_batches
+        self.step_with_optimizer = step_with_optimizer
+        self.gradient_state = GradientState()
+
+    def step(self, *args, **kwargs):
+        if not self.step_with_optimizer:
+            self.scheduler.step(*args, **kwargs)
+            return
+        if not self.gradient_state.sync_gradients:
+            # Keep torch-style schedulers' internal call counter in step with the number of
+            # .step() calls even when the LR update is skipped (reference scheduler.py:63).
+            if self.gradient_state.adjust_scheduler and hasattr(self.scheduler, "_step_count"):
+                self.scheduler._step_count += 1
+            return
+        # Skip if any wrapped optimizer skipped (overflow).
+        for opt in self.optimizers:
+            if getattr(opt, "step_was_skipped", False):
+                return
+        if self.split_batches:
+            self.scheduler.step(*args, **kwargs)
+        else:
+            num_processes = AcceleratorState().num_processes if AcceleratorState._shared_state else 1
+            for _ in range(num_processes):
+                self.scheduler.step(*args, **kwargs)
+
+    def get_last_lr(self):
+        return self.scheduler.get_last_lr()
+
+    def state_dict(self):
+        return self.scheduler.state_dict()
+
+    def load_state_dict(self, state_dict):
+        self.scheduler.load_state_dict(state_dict)
+
+    def get_lr(self):
+        return self.scheduler.get_lr()
+
+    def __getattr__(self, name):
+        return getattr(self.__dict__["scheduler"], name)

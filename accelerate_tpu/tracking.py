@@ -1,0 +1,449 @@
+"""Experiment trackers (L8).
+
+Analog of reference ``tracking.py`` (/root/reference/src/accelerate/tracking.py):
+``GeneralTracker`` ABC (:91) with ``store_init_configuration``/``log``/``finish`` and the
+``main_process_only`` attribute (:108), concrete trackers (:165-1023), ``filter_trackers``
+(:1024). Every integration is gated on availability probes; a dependency-free ``jsonl``
+tracker is always available (and is what tests use).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from abc import ABC, abstractmethod
+from pathlib import Path
+from typing import Any, Optional, Union
+
+from .logging import get_logger
+from .state import PartialState
+from .utils.imports import (
+    is_aim_available,
+    is_clearml_available,
+    is_comet_ml_available,
+    is_dvclive_available,
+    is_mlflow_available,
+    is_tensorboard_available,
+    is_wandb_available,
+)
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "GeneralTracker",
+    "JSONLTracker",
+    "TensorBoardTracker",
+    "WandBTracker",
+    "MLflowTracker",
+    "CometMLTracker",
+    "AimTracker",
+    "ClearMLTracker",
+    "DVCLiveTracker",
+    "filter_trackers",
+    "on_main_process",
+]
+
+
+def on_main_process(function):
+    """Run only on the main process (reference ``tracking.py:67``)."""
+
+    def wrapper(self, *args, **kwargs):
+        if getattr(self, "main_process_only", True) and not PartialState().is_main_process:
+            return None
+        return function(self, *args, **kwargs)
+
+    return wrapper
+
+
+class GeneralTracker(ABC):
+    """Base tracker API (reference ``tracking.py:91``). Subclass and pass instances to
+    ``Accelerator(log_with=[...])`` to integrate custom trackers."""
+
+    main_process_only: bool = True
+
+    def __init__(self, _blank: bool = False):
+        if not _blank:
+            err = []
+            if not hasattr(self, "name"):
+                err.append("`name`")
+            if not hasattr(self, "requires_logging_directory"):
+                err.append("`requires_logging_directory`")
+            if "tracker" not in dir(self):
+                err.append("`tracker`")
+            if err:
+                raise NotImplementedError(
+                    f"The implementation for this tracker class is missing: {', '.join(err)}."
+                )
+
+    @abstractmethod
+    def store_init_configuration(self, values: dict):
+        ...
+
+    @abstractmethod
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        ...
+
+    def finish(self):
+        pass
+
+
+class JSONLTracker(GeneralTracker):
+    """Dependency-free tracker: one JSON line per log call into ``<dir>/metrics.jsonl``."""
+
+    name = "jsonl"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: Union[str, os.PathLike] = "."):
+        super().__init__()
+        self.run_name = run_name
+        self.logging_dir = Path(logging_dir) / run_name
+        self.logging_dir.mkdir(parents=True, exist_ok=True)
+        self._file = open(self.logging_dir / "metrics.jsonl", "a")
+
+    @property
+    def tracker(self):
+        return self._file
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        (self.logging_dir / "config.json").write_text(json.dumps(values, default=str, indent=2))
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        record = {"_step": step, "_time": time.time(), **values}
+        self._file.write(json.dumps(record, default=float) + "\n")
+        self._file.flush()
+
+    @on_main_process
+    def finish(self):
+        self._file.close()
+
+
+class TensorBoardTracker(GeneralTracker):
+    """Reference ``tracking.py:165``; writes via tensorboardX or torch SummaryWriter."""
+
+    name = "tensorboard"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: Union[str, os.PathLike] = ".", **kwargs):
+        super().__init__()
+        try:
+            from torch.utils import tensorboard
+        except ImportError:
+            import tensorboardX as tensorboard
+        self.run_name = run_name
+        self.logging_dir = os.path.join(logging_dir, run_name)
+        self.writer = tensorboard.SummaryWriter(self.logging_dir, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer.add_hparams(_flatten_scalars(values), metric_dict={})
+        self.writer.flush()
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                self.writer.add_scalar(k, v, global_step=step, **kwargs)
+            elif isinstance(v, str):
+                self.writer.add_text(k, v, global_step=step, **kwargs)
+            elif isinstance(v, dict):
+                self.writer.add_scalars(k, v, global_step=step, **kwargs)
+        self.writer.flush()
+
+    @on_main_process
+    def finish(self):
+        self.writer.close()
+
+
+class WandBTracker(GeneralTracker):
+    """Reference ``tracking.py:276``."""
+
+    name = "wandb"
+    requires_logging_directory = False
+    main_process_only = True
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        import wandb
+
+        self.run = wandb.init(project=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        import wandb
+
+        wandb.config.update(values, allow_val_change=True)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.run.finish()
+
+
+class MLflowTracker(GeneralTracker):
+    """Reference ``tracking.py:579``."""
+
+    name = "mlflow"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, experiment_name: str = None, logging_dir: Optional[str] = None, **kwargs):
+        super().__init__()
+        import mlflow
+
+        self._mlflow = mlflow
+        experiment_name = os.environ.get("MLFLOW_EXPERIMENT_NAME", experiment_name)
+        if experiment_name:
+            mlflow.set_experiment(experiment_name)
+        self.active_run = mlflow.start_run(**kwargs)
+
+    @property
+    def tracker(self):
+        return self.active_run
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        for name, value in list(values.items()):
+            if len(str(value)) > 500:
+                values.pop(name)
+        self._mlflow.log_params(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        metrics = {k: v for k, v in values.items() if isinstance(v, (int, float))}
+        self._mlflow.log_metrics(metrics, step=step)
+
+    @on_main_process
+    def finish(self):
+        self._mlflow.end_run()
+
+
+class CometMLTracker(GeneralTracker):
+    name = "comet_ml"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str, **kwargs):
+        super().__init__()
+        from comet_ml import start
+
+        self.run_name = run_name
+        self.writer = start(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer.log_parameters(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        if step is not None:
+            self.writer.set_step(step)
+        self.writer.log_metrics(values, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.writer.end()
+
+
+class AimTracker(GeneralTracker):
+    name = "aim"
+    requires_logging_directory = True
+
+    @on_main_process
+    def __init__(self, run_name: str, logging_dir: str = ".", **kwargs):
+        super().__init__()
+        from aim import Run
+
+        self.writer = Run(repo=logging_dir, **kwargs)
+        self.writer.name = run_name
+
+    @property
+    def tracker(self):
+        return self.writer
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.writer["hparams"] = values
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        for key, value in values.items():
+            self.writer.track(value, name=key, step=step, **kwargs)
+
+    @on_main_process
+    def finish(self):
+        self.writer.close()
+
+
+class ClearMLTracker(GeneralTracker):
+    name = "clearml"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str = None, **kwargs):
+        super().__init__()
+        from clearml import Task
+
+        self.task = Task.init(project_name=run_name, **kwargs)
+
+    @property
+    def tracker(self):
+        return self.task
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.task.connect_configuration(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        clearml_logger = self.task.get_logger()
+        for k, v in values.items():
+            if isinstance(v, (int, float)):
+                if step is None:
+                    clearml_logger.report_single_value(name=k, value=v, **kwargs)
+                else:
+                    title, _, series = k.partition("/")
+                    clearml_logger.report_scalar(
+                        title=title, series=series or title, value=v, iteration=step, **kwargs
+                    )
+
+    @on_main_process
+    def finish(self):
+        self.task.close()
+
+
+class DVCLiveTracker(GeneralTracker):
+    name = "dvclive"
+    requires_logging_directory = False
+
+    @on_main_process
+    def __init__(self, run_name: str = None, live=None, **kwargs):
+        super().__init__()
+        from dvclive import Live
+
+        self.live = live if live is not None else Live(**kwargs)
+
+    @property
+    def tracker(self):
+        return self.live
+
+    @on_main_process
+    def store_init_configuration(self, values: dict):
+        self.live.log_params(values)
+
+    @on_main_process
+    def log(self, values: dict, step: Optional[int] = None, **kwargs):
+        if step is not None:
+            self.live.step = step
+        for k, v in values.items():
+            self.live.log_metric(k, v, **kwargs)
+        self.live.next_step()
+
+    @on_main_process
+    def finish(self):
+        self.live.end()
+
+
+LOGGER_TYPE_TO_CLASS = {
+    "jsonl": JSONLTracker,
+    "tensorboard": TensorBoardTracker,
+    "wandb": WandBTracker,
+    "mlflow": MLflowTracker,
+    "comet_ml": CometMLTracker,
+    "aim": AimTracker,
+    "clearml": ClearMLTracker,
+    "dvclive": DVCLiveTracker,
+}
+
+_AVAILABILITY = {
+    "jsonl": lambda: True,
+    "tensorboard": lambda: is_tensorboard_available() or _has_torch_tb(),
+    "wandb": is_wandb_available,
+    "mlflow": is_mlflow_available,
+    "comet_ml": is_comet_ml_available,
+    "aim": is_aim_available,
+    "clearml": is_clearml_available,
+    "dvclive": is_dvclive_available,
+}
+
+
+def _has_torch_tb() -> bool:
+    from .utils.imports import is_available
+
+    return is_available("torch.utils.tensorboard")
+
+
+def filter_trackers(
+    log_with,
+    logging_dir: Optional[str] = None,
+    project_name: str = "accelerate_tpu",
+    config: Optional[dict] = None,
+    init_kwargs: Optional[dict] = None,
+) -> list[GeneralTracker]:
+    """Resolve ``log_with`` into initialized trackers (reference ``tracking.py:1024``)."""
+    init_kwargs = init_kwargs or {}
+    if log_with is None:
+        return []
+    if not isinstance(log_with, (list, tuple)):
+        log_with = [log_with]
+    trackers: list[GeneralTracker] = []
+    names: list[str] = []
+    for entry in log_with:
+        if isinstance(entry, GeneralTracker):
+            trackers.append(entry)
+        elif str(entry).lower() == "all":
+            names.extend(n for n, avail in _AVAILABILITY.items() if avail())
+        else:
+            names.append(str(entry).lower())
+    for name in dict.fromkeys(names):
+        if name not in LOGGER_TYPE_TO_CLASS:
+            raise ValueError(
+                f"Unknown tracker {name!r}; options: {sorted(LOGGER_TYPE_TO_CLASS)}"
+            )
+        if not _AVAILABILITY[name]():
+            logger.warning(f"Tracker {name!r} requested but its library is not installed; skipping")
+            continue
+        cls = LOGGER_TYPE_TO_CLASS[name]
+        kwargs = dict(init_kwargs.get(name, {}))
+        if getattr(cls, "requires_logging_directory", False):
+            if logging_dir is None:
+                logging_dir = "."
+            kwargs.setdefault("logging_dir", logging_dir)
+        tracker = cls(project_name, **kwargs)
+        if config:
+            tracker.store_init_configuration(config)
+        trackers.append(tracker)
+    return trackers
+
+
+def _flatten_scalars(values: dict, prefix: str = "") -> dict:
+    out = {}
+    for k, v in values.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten_scalars(v, prefix=f"{key}/"))
+        elif isinstance(v, (int, float, str, bool)):
+            out[key] = v
+        else:
+            out[key] = str(v)
+    return out

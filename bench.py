@@ -1,0 +1,77 @@
+"""Benchmark: training throughput of the framework's compiled train step on real hardware.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Metric: samples/sec/chip on a causal-LM training step (bf16, grad clipping, adamw) through the
+full Accelerator path — the analog of the reference's nlp_example throughput tracking
+(BASELINE.md north-star table). vs_baseline compares against a recorded reference-point of
+this same benchmark (first-run value stored below), so the ratio tracks our own progress;
+the reference repo publishes no trainable-throughput numbers to compare against directly
+(BASELINE.md: published numbers are big-model-inference only).
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+# Reference point: round-1 first measurement on TPU v5e-1 (updated as perf improves).
+BASELINE_SAMPLES_PER_SEC = 24.57  # 2026-07-29, commit "L3 facade"
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models.simple import TransformerConfig, init_params, loss_fn
+
+    # Model sized to exercise the MXU meaningfully on one v5e chip.
+    cfg = TransformerConfig(
+        vocab_size=32768, d_model=1024, n_heads=16, n_layers=8, d_ff=4096, max_seq=512
+    )
+    batch_size, seq = 16, 512
+
+    acc = Accelerator(mixed_precision="bf16")
+    state = acc.create_train_state(init_params(cfg), optax.adamw(1e-4))
+    step = acc.build_train_step(lambda p, b: loss_fn(p, b, cfg), max_grad_norm=1.0)
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(batch_size, seq + 1)).astype(np.int32)
+    from accelerate_tpu.utils import send_to_device
+
+    batch = send_to_device({"tokens": tokens}, acc.mesh)
+
+    # Warmup / compile.
+    state, metrics = step(state, batch)
+    jax.block_until_ready(state.params)
+
+    n_iters = 20
+    t0 = time.perf_counter()
+    for _ in range(n_iters):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+
+    n_chips = jax.device_count()
+    samples_per_sec_per_chip = batch_size * n_iters / dt / n_chips
+    vs_baseline = (
+        samples_per_sec_per_chip / BASELINE_SAMPLES_PER_SEC if BASELINE_SAMPLES_PER_SEC else 1.0
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "train_samples_per_sec_per_chip (causalLM d1024 L8 seq512 bf16)",
+                "value": round(samples_per_sec_per_chip, 2),
+                "unit": "samples/s/chip",
+                "vs_baseline": round(vs_baseline, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

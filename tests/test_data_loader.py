@@ -1,0 +1,298 @@
+"""Exhaustive index-math tests for the L2 data layer (parity model:
+reference tests/test_data_loader.py, 867 LoC of BatchSamplerShard combinatorics)."""
+
+import math
+
+import numpy as np
+import jax
+import pytest
+
+from accelerate_tpu.data_loader import (
+    BatchSampler,
+    BatchSamplerShard,
+    DataLoader,
+    DataLoaderDispatcher,
+    DataLoaderShard,
+    IterableDatasetShard,
+    SeedableRandomSampler,
+    SequentialSampler,
+    SkipBatchSampler,
+    default_collate,
+    prepare_data_loader,
+    skip_first_batches,
+)
+from accelerate_tpu.state import GradientState
+from accelerate_tpu.parallel import batch_sharding
+
+
+def make_batch_sampler(n, batch_size, drop_last=False):
+    return BatchSampler(SequentialSampler(range(n)), batch_size, drop_last)
+
+
+# --------------------------------------------------------------------- BatchSamplerShard
+@pytest.mark.parametrize("n", [24, 22, 21, 8, 7, 3, 2, 1])
+@pytest.mark.parametrize("batch_size", [3, 4])
+@pytest.mark.parametrize("num_processes", [1, 2, 3])
+def test_batch_sampler_shard_even_batches_invariants(n, batch_size, num_processes):
+    shards = [
+        BatchSamplerShard(
+            make_batch_sampler(n, batch_size), num_processes, p, split_batches=False, even_batches=True
+        )
+        for p in range(num_processes)
+    ]
+    outputs = [list(s) for s in shards]
+    # 1. Every process yields the same number of batches, all full-size.
+    counts = {len(o) for o in outputs}
+    assert len(counts) == 1
+    for o in outputs:
+        for b in o:
+            assert len(b) == batch_size
+    # 2. len() agrees with the actual iteration count.
+    for s, o in zip(shards, outputs):
+        assert len(s) == len(o)
+    # 3. Round-robin interleave reconstructs the dataset order (then wraps to the start).
+    interleaved = []
+    for i in range(len(outputs[0])):
+        for p in range(num_processes):
+            interleaved.extend(outputs[p][i])
+    assert interleaved[:n] == list(range(n))
+    for j, v in enumerate(interleaved[n:]):
+        assert v == j % n
+
+
+@pytest.mark.parametrize("n", [24, 22, 21, 7])
+@pytest.mark.parametrize("num_processes", [2, 3])
+def test_batch_sampler_shard_uneven(n, num_processes):
+    batch_size = 4
+    shards = [
+        BatchSamplerShard(
+            make_batch_sampler(n, batch_size), num_processes, p, even_batches=False
+        )
+        for p in range(num_processes)
+    ]
+    outputs = [list(s) for s in shards]
+    # No duplication, no loss.
+    seen = sorted(i for o in outputs for b in o for i in b)
+    assert seen == list(range(n))
+
+
+@pytest.mark.parametrize("n", [24, 22, 21, 7])
+@pytest.mark.parametrize("num_processes", [2, 3])
+def test_batch_sampler_shard_drop_last(n, num_processes):
+    batch_size = 4
+    shards = [
+        BatchSamplerShard(
+            make_batch_sampler(n, batch_size, drop_last=True), num_processes, p
+        )
+        for p in range(num_processes)
+    ]
+    outputs = [list(s) for s in shards]
+    counts = {len(o) for o in outputs}
+    assert len(counts) == 1
+    n_full_batches = (n // batch_size) // num_processes * num_processes
+    total = sum(len(b) for o in outputs for b in o)
+    assert total == n_full_batches * batch_size
+
+
+@pytest.mark.parametrize("n", [24, 22, 8])
+@pytest.mark.parametrize("num_processes", [2, 4])
+def test_batch_sampler_shard_split_batches(n, num_processes):
+    batch_size = 8  # global batch
+    shards = [
+        BatchSamplerShard(
+            make_batch_sampler(n, batch_size), num_processes, p, split_batches=True
+        )
+        for p in range(num_processes)
+    ]
+    outputs = [list(s) for s in shards]
+    counts = {len(o) for o in outputs}
+    assert len(counts) == 1
+    # Concatenating the p-slices of batch i reconstructs global batch i.
+    for i in range(len(outputs[0])):
+        combined = [x for p in range(num_processes) for x in outputs[p][i]]
+        expected_start = i * batch_size
+        for j, v in enumerate(combined):
+            assert v == (expected_start + j) % n
+
+
+def test_batch_sampler_shard_split_batches_indivisible_raises():
+    with pytest.raises(ValueError):
+        BatchSamplerShard(make_batch_sampler(24, 3), 2, 0, split_batches=True)
+
+
+def test_batch_sampler_shard_explicit_reference_case():
+    # 24 elements, batch 3, 2 processes: reference test_data_loader.py canonical example.
+    s0 = list(BatchSamplerShard(make_batch_sampler(24, 3), 2, 0))
+    s1 = list(BatchSamplerShard(make_batch_sampler(24, 3), 2, 1))
+    assert s0 == [[0, 1, 2], [6, 7, 8], [12, 13, 14], [18, 19, 20]]
+    assert s1 == [[3, 4, 5], [9, 10, 11], [15, 16, 17], [21, 22, 23]]
+
+
+def test_batch_sampler_shard_tail_padding_explicit():
+    # 22 elements, batch 3, 2 processes: tail = [21] → padded from the epoch start.
+    s0 = list(BatchSamplerShard(make_batch_sampler(22, 3), 2, 0))
+    s1 = list(BatchSamplerShard(make_batch_sampler(22, 3), 2, 1))
+    assert s0[-1] == [18, 19, 20]
+    assert s1[-1] == [21, 0, 1]
+
+
+# ------------------------------------------------------------------- IterableDatasetShard
+@pytest.mark.parametrize("n", [24, 22, 21, 7, 2])
+@pytest.mark.parametrize("num_processes", [1, 2, 3])
+@pytest.mark.parametrize("drop_last", [False, True])
+def test_iterable_dataset_shard(n, num_processes, drop_last):
+    batch_size = 4
+    shards = [
+        IterableDatasetShard(
+            list(range(n)), batch_size=batch_size, drop_last=drop_last,
+            num_processes=num_processes, process_index=p,
+        )
+        for p in range(num_processes)
+    ]
+    outputs = [list(s) for s in shards]
+    counts = {len(o) for o in outputs}
+    assert len(counts) == 1
+    real = batch_size * num_processes
+    if drop_last:
+        expected_total = (n // real) * real
+    else:
+        expected_total = math.ceil(n / real) * real if n else 0
+    assert sum(len(o) for o in outputs) == expected_total
+    # Interleave per global batch reconstructs order.
+    per = batch_size
+    interleaved = []
+    num_global = len(outputs[0]) // per
+    for g in range(num_global):
+        for p in range(num_processes):
+            interleaved.extend(outputs[p][g * per : (g + 1) * per])
+    for j, v in enumerate(interleaved):
+        assert v == j % n
+
+
+# -------------------------------------------------------------------------- seedable rng
+def test_seedable_random_sampler_deterministic():
+    s = SeedableRandomSampler(range(100), seed=12)
+    a = list(s)
+    b = list(s)
+    assert a == b
+    s.set_epoch(1)
+    c = list(s)
+    assert a != c
+    s2 = SeedableRandomSampler(range(100), seed=12, epoch=1)
+    assert list(s2) == c
+    assert sorted(a) == list(range(100))
+
+
+# ----------------------------------------------------------------------- DataLoaderShard
+class DictDataset:
+    def __init__(self, n):
+        self.x = np.arange(n, dtype=np.float32).reshape(n, 1)
+        self.y = np.arange(n)
+
+    def __len__(self):
+        return len(self.y)
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+def test_dataloader_shard_gradient_state_tracking(mesh8):
+    dl = DataLoader(DictDataset(16), batch_size=8)
+    prepared = prepare_data_loader(dl, device=mesh8)
+    gs = GradientState()
+    seen = []
+    for batch in prepared:
+        assert gs.in_dataloader
+        seen.append(gs.end_of_dataloader)
+        assert isinstance(batch["x"], jax.Array)
+        assert batch["x"].sharding.is_equivalent_to(batch_sharding(mesh8), 2)
+    assert seen == [False, True]
+    assert not gs.in_dataloader
+
+
+def test_dataloader_shard_remainder(mesh8):
+    # 20 samples, batch 8 → last global batch has 4 → remainder 4.
+    dl = DataLoader(DictDataset(20), batch_size=8)
+    prepared = prepare_data_loader(dl, device=None)
+    gs = GradientState()
+    remainders = []
+    for _ in prepared:
+        remainders.append(gs.remainder)
+    assert remainders[-1] == 4
+    assert remainders[:-1] == [-1] * (len(remainders) - 1)
+
+
+def test_dataloader_len_and_total_batch_size():
+    dl = DataLoader(DictDataset(24), batch_size=6)
+    prepared = prepare_data_loader(dl)
+    assert len(prepared) == 4
+    assert prepared.total_dataset_length == 24
+
+
+def test_skip_first_batches():
+    dl = DataLoader(DictDataset(24), batch_size=6)
+    prepared = prepare_data_loader(dl)
+    skipped = skip_first_batches(prepared, 2)
+    batches = list(skipped)
+    assert len(batches) == 2
+    np.testing.assert_array_equal(batches[0]["y"], np.arange(12, 18))
+
+
+def test_skip_batch_sampler():
+    bs = SkipBatchSampler(make_batch_sampler(24, 4), skip_batches=3)
+    assert len(bs) == 3
+    assert list(bs)[0] == [12, 13, 14, 15]
+
+
+def test_prepare_torch_dataloader():
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import DataLoader as TorchDL, TensorDataset
+
+    ds = TensorDataset(torch.arange(20, dtype=torch.float32).reshape(20, 1))
+    tdl = TorchDL(ds, batch_size=5, shuffle=False)
+    prepared = prepare_data_loader(tdl)
+    batches = list(prepared)
+    assert len(batches) == 4
+    assert isinstance(batches[0][0], np.ndarray)
+    np.testing.assert_array_equal(batches[0][0].ravel(), np.arange(5, dtype=np.float32))
+
+
+def test_prepare_torch_dataloader_shuffled_deterministic():
+    torch = pytest.importorskip("torch")
+    from torch.utils.data import DataLoader as TorchDL, TensorDataset
+
+    ds = TensorDataset(torch.arange(20, dtype=torch.float32))
+    tdl = TorchDL(ds, batch_size=5, shuffle=True)
+    p1 = prepare_data_loader(tdl, data_seed=7)
+    p2 = prepare_data_loader(tdl, data_seed=7)
+    b1 = [b[0].tolist() for b in p1]
+    b2 = [b[0].tolist() for b in p2]
+    assert b1 == b2
+    flat = sorted(x for b in b1 for x in b)
+    assert flat == list(range(20))
+
+
+def test_dispatcher_single_process(mesh8):
+    dl = DataLoader(DictDataset(16), batch_size=8)
+    prepared = prepare_data_loader(dl, device=mesh8, dispatch_batches=True)
+    batches = list(prepared)
+    assert len(batches) == 2
+    assert isinstance(batches[0]["x"], jax.Array)
+    np.testing.assert_array_equal(np.asarray(batches[1]["y"]), np.arange(8, 16))
+
+
+def test_dataloader_set_epoch_changes_order():
+    dl = DataLoader(DictDataset(16), batch_size=4, shuffle=True, generator_seed=3)
+    prepared = prepare_data_loader(dl)
+    first = [b["y"].tolist() for b in prepared]
+    prepared.set_epoch(1)
+    second = [b["y"].tolist() for b in prepared]
+    assert first != second
+    assert sorted(x for b in first for x in b) == list(range(16))
+    assert sorted(x for b in second for x in b) == list(range(16))
+
+
+def test_default_collate_nested():
+    out = default_collate([{"a": (1, np.ones(2))}, {"a": (2, np.zeros(2))}])
+    assert out["a"][0].tolist() == [1, 2]
+    assert out["a"][1].shape == (2, 2)

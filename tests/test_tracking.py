@@ -1,0 +1,55 @@
+"""Tracker tests (reference parity: tests/test_tracking.py jsonl/tensorboard subset)."""
+
+import json
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.tracking import GeneralTracker, JSONLTracker, filter_trackers
+
+
+def test_jsonl_tracker_end_to_end(tmp_path):
+    acc = Accelerator(log_with="jsonl", project_dir=str(tmp_path))
+    acc.init_trackers("myrun", config={"lr": 0.1})
+    acc.log({"loss": 1.5}, step=0)
+    acc.log({"loss": 0.5}, step=1)
+    acc.end_training()
+    run_dir = tmp_path / "myrun"
+    lines = [json.loads(l) for l in (run_dir / "metrics.jsonl").read_text().splitlines()]
+    assert [l["loss"] for l in lines] == [1.5, 0.5]
+    assert json.loads((run_dir / "config.json").read_text())["lr"] == 0.1
+
+
+def test_filter_trackers_unknown_raises():
+    import pytest
+
+    with pytest.raises(ValueError, match="Unknown tracker"):
+        filter_trackers("nope")
+
+
+def test_custom_tracker_instance_passthrough():
+    class MyTracker(GeneralTracker):
+        name = "my"
+        requires_logging_directory = False
+
+        def __init__(self):
+            super().__init__(_blank=True)
+            self.logged = []
+
+        @property
+        def tracker(self):
+            return None
+
+        def store_init_configuration(self, values):
+            self.config = values
+
+        def log(self, values, step=None, **kwargs):
+            self.logged.append((step, values))
+
+    t = MyTracker()
+    out = filter_trackers([t])
+    assert out == [t]
+
+
+def test_get_tracker():
+    acc = Accelerator(log_with="jsonl", project_dir="/tmp/trk_test")
+    acc.init_trackers("r1")
+    assert acc.get_tracker("jsonl").name == "jsonl"
