@@ -128,12 +128,14 @@ class _TrainStep:
             at_end = gs.sync_with_dataloader and gs.end_of_dataloader
             do_sync = ((self.micro_count + 1) % acc.gradient_accumulation_steps == 0) or at_end
             gs._set_sync_gradients(do_sync)
-        if do_sync:
-            state, metrics = self.apply_fn(state, batch)
-            self.micro_count = 0
-        else:
-            state, metrics = self.micro_fn(state, batch)
-            self.micro_count += 1
+        # Mesh context lets model code use bare PartitionSpecs in sharding constraints.
+        with jax.set_mesh(acc.mesh):
+            if do_sync:
+                state, metrics = self.apply_fn(state, batch)
+                self.micro_count = 0
+            else:
+                state, metrics = self.micro_fn(state, batch)
+                self.micro_count += 1
         acc.step += 1
         if self.optimizer is not None:
             self.optimizer.step()
@@ -346,14 +348,21 @@ class Accelerator:
             return self.prepare_params(obj)
         return obj
 
-    def prepare_params(self, params):
+    def prepare_params(self, params, partition_specs=None):
         """Shard a param pytree over the mesh (the ``prepare_model`` analog, reference :1421).
 
-        Casts to the policy's param dtype (fp32 master weights) and applies ZeRO-3/FSDP
-        sharding when active; otherwise replicates (DDP layout).
+        Casts to the policy's param dtype (fp32 master weights) and applies the combined
+        sharding: model TP specs (``partition_specs``, e.g. ``models.llama.partition_specs``)
+        first, ZeRO-3/FSDP on the remaining free axes, replicated otherwise (DDP layout).
         """
         policy = self.mixed_precision_policy
         params = cast_floating(params, policy.param_dtype)
+        if partition_specs is not None:
+            from .parallel.tp import apply_tensor_parallel
+
+            return apply_tensor_parallel(
+                params, self.mesh, specs=partition_specs, fsdp_plugin=self.state.fsdp_plugin
+            )
         return shard_params(params, self.mesh, self.state.fsdp_plugin)
 
     prepare_model = prepare_params  # reference-name alias for pytree models
@@ -400,6 +409,7 @@ class Accelerator:
         params,
         optimizer: Union[AcceleratedOptimizer, Any],
         rng: Optional[jax.Array] = None,
+        partition_specs=None,
     ) -> TrainState:
         """Build the sharded training carry.
 
@@ -409,7 +419,7 @@ class Accelerator:
         """
         if not isinstance(optimizer, AcceleratedOptimizer):
             optimizer = self.prepare_optimizer(optimizer)
-        params = self.prepare_params(params)
+        params = self.prepare_params(params, partition_specs=partition_specs)
         opt_state = optimizer.init(params)
         optimizer._opt_state_ref = opt_state
         accum = None
@@ -531,7 +541,15 @@ class Accelerator:
                 out = cast_floating(out, jnp.float32)
             return out
 
-        return jax.jit(wrapped)
+        jitted = jax.jit(wrapped)
+        mesh = self.mesh
+
+        @functools.wraps(wrapped)
+        def with_mesh(params, batch):
+            with jax.set_mesh(mesh):
+                return jitted(params, batch)
+
+        return with_mesh
 
     # -------------------------------------------------------- accumulation / sync contexts
     @contextlib.contextmanager

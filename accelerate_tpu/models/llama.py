@@ -1,0 +1,297 @@
+"""Llama-family decoder LM — the flagship model (BASELINE.md north star: Llama-3-8B FSDP
+fine-tune at ≥0.4 MFU on v5e-256).
+
+The reference framework ships no models (it prepares arbitrary ``transformers`` modules); this
+framework ships first-class model families because the TPU-native path needs models whose
+**sharding is part of their definition**. Every param leaf here has a matching
+``PartitionSpec`` in ``partition_specs()`` implementing the Megatron tensor-parallel layout
+(column-parallel up-projections, row-parallel down-projections — the torch-TP plan analog,
+reference ``dataclasses.py:1863`` / ``accelerator.py:1545-1554``), composable with fsdp-axis
+sharding (``parallel/fsdp.py``) and sequence-axis activation sharding.
+
+Pure-functional: ``init_params(cfg, key) -> pytree``; ``forward(params, tokens, cfg)``.
+Attention dispatches to the Pallas flash kernel on TPU (``ops/flash_attention.py``) and a pure
+XLA reference path elsewhere (``attn_impl``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..utils.constants import BATCH_AXES, SEQUENCE_AXIS, TENSOR_AXIS
+
+__all__ = ["LlamaConfig", "init_params", "forward", "loss_fn", "partition_specs", "CONFIGS"]
+
+
+@dataclasses.dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    d_model: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    d_ff: int = 14336
+    max_seq: int = 8192
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = False
+    attn_impl: str = "auto"  # "auto" | "flash" | "xla"
+    remat: bool = True       # jax.checkpoint each block (activation checkpointing)
+    scan_layers: bool = False  # lax.scan over stacked layer params (fast compile)
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.n_heads // self.n_kv_heads
+
+
+CONFIGS = {
+    "llama3-8b": LlamaConfig(
+        vocab_size=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8, d_ff=14336
+    ),
+    "llama3-70b": LlamaConfig(
+        vocab_size=128256, d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8, d_ff=28672
+    ),
+    "llama2-7b": LlamaConfig(
+        vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=32, d_ff=11008,
+        rope_theta=10000.0, max_seq=4096,
+    ),
+    "tiny": LlamaConfig(
+        vocab_size=256, d_model=128, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=256,
+        max_seq=128, remat=False,
+    ),
+    "debug": LlamaConfig(
+        vocab_size=512, d_model=256, n_layers=4, n_heads=8, n_kv_heads=4, d_ff=512,
+        max_seq=512, remat=False,
+    ),
+}
+
+
+# --------------------------------------------------------------------------------- params
+def _layer_params(cfg: LlamaConfig, key) -> dict:
+    k = jax.random.split(key, 7)
+    D, H, K, hd, F = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff
+    s_in = 1.0 / math.sqrt(D)
+    s_ff = 1.0 / math.sqrt(F)
+    return {
+        "ln_attn": jnp.ones((D,), jnp.float32),
+        "wq": jax.random.normal(k[0], (D, H * hd), jnp.float32) * s_in,
+        "wk": jax.random.normal(k[1], (D, K * hd), jnp.float32) * s_in,
+        "wv": jax.random.normal(k[2], (D, K * hd), jnp.float32) * s_in,
+        "wo": jax.random.normal(k[3], (H * hd, D), jnp.float32) * s_in,
+        "ln_mlp": jnp.ones((D,), jnp.float32),
+        "w_gate": jax.random.normal(k[4], (D, F), jnp.float32) * s_in,
+        "w_up": jax.random.normal(k[5], (D, F), jnp.float32) * s_in,
+        "w_down": jax.random.normal(k[6], (F, D), jnp.float32) * s_ff,
+    }
+
+
+def init_params(cfg: LlamaConfig, key: Optional[jax.Array] = None) -> dict:
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    keys = jax.random.split(key, cfg.n_layers + 2)
+    scale = 1.0 / math.sqrt(cfg.d_model)
+    params = {
+        "embed": jax.random.normal(keys[0], (cfg.vocab_size, cfg.d_model), jnp.float32) * scale,
+        "layers": [_layer_params(cfg, keys[i + 1]) for i in range(cfg.n_layers)],
+        "ln_f": jnp.ones((cfg.d_model,), jnp.float32),
+    }
+    if cfg.scan_layers:
+        params["layers"] = jax.tree_util.tree_map(
+            lambda *ls: jnp.stack(ls), *params["layers"]
+        )
+    if not cfg.tie_embeddings:
+        params["lm_head"] = (
+            jax.random.normal(keys[-1], (cfg.d_model, cfg.vocab_size), jnp.float32) * scale
+        )
+    return params
+
+
+def partition_specs(cfg: LlamaConfig) -> dict:
+    """Megatron-layout PartitionSpecs, same structure as the params pytree.
+
+    Column-parallel: wq/wk/wv/w_gate/w_up split their output dim over ``tp``.
+    Row-parallel: wo/w_down split their input dim over ``tp`` (GSPMD inserts the psum).
+    Embedding/lm_head shard the vocab dim (logits stay tp-sharded until the loss psum).
+    """
+    layer = {
+        "ln_attn": P(),
+        "wq": P(None, TENSOR_AXIS),
+        "wk": P(None, TENSOR_AXIS),
+        "wv": P(None, TENSOR_AXIS),
+        "wo": P(TENSOR_AXIS, None),
+        "ln_mlp": P(),
+        "w_gate": P(None, TENSOR_AXIS),
+        "w_up": P(None, TENSOR_AXIS),
+        "w_down": P(TENSOR_AXIS, None),
+    }
+    if cfg.scan_layers:
+        layer = {k: P(None, *v) for k, v in layer.items()}  # leading stacked-layer dim
+        layers: Any = layer
+    else:
+        layers = [dict(layer) for _ in range(cfg.n_layers)]
+    specs = {
+        "embed": P(TENSOR_AXIS, None),
+        "layers": layers,
+        "ln_f": P(),
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, TENSOR_AXIS)
+    return specs
+
+
+# -------------------------------------------------------------------------------- forward
+def _maybe_shard(x: jax.Array, spec: P) -> jax.Array:
+    """Apply a sharding constraint only when a mesh context is active (jax.set_mesh);
+    lets the same model code run in plain single-device baselines."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return x
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def _rms_norm(x: jax.Array, gamma: jax.Array, eps: float) -> jax.Array:
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    normed = x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
+    return (normed * gamma.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding: x [B, S, H, hd], positions [B, S]."""
+    hd = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, hd/2]
+    cos = jnp.cos(angles)[:, :, None, :]
+    sin = jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _attention_xla(q, k, v, mask, cfg: LlamaConfig):
+    """Reference attention path: q [B,S,H,hd], kv [B,S,K,hd] → [B,S,H,hd]."""
+    B, S, H, hd = q.shape
+    K = k.shape[2]
+    if H != K:
+        k = jnp.repeat(k, cfg.q_per_kv, axis=2)
+        v = jnp.repeat(v, cfg.q_per_kv, axis=2)
+    scores = jnp.einsum("bshd,bthd->bhst", q, k) / math.sqrt(hd)
+    scores = jnp.where(mask[:, None, :, :], scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+def _attention(q, k, v, mask, cfg: LlamaConfig):
+    impl = cfg.attn_impl
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() in ("tpu", "axon") else "xla"
+    if impl == "flash":
+        try:
+            from ..ops.flash_attention import flash_attention
+
+            return flash_attention(q, k, v, causal=True)
+        except Exception:  # pragma: no cover - kernel unavailable on this backend
+            pass
+    return _attention_xla(q, k, v, mask, cfg)
+
+
+def _block(x, layer, positions, mask, cfg: LlamaConfig):
+    B, S, D = x.shape
+    dtype = cfg.dtype
+    h = _rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+    q = (h @ layer["wq"].astype(dtype)).reshape(B, S, cfg.n_heads, cfg.head_dim)
+    k = (h @ layer["wk"].astype(dtype)).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+    v = (h @ layer["wv"].astype(dtype)).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    attn = _attention(q, k, v, mask, cfg).reshape(B, S, cfg.n_heads * cfg.head_dim)
+    x = x + attn @ layer["wo"].astype(dtype)
+    h = _rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
+    gate = jax.nn.silu(h @ layer["w_gate"].astype(dtype))
+    up = h @ layer["w_up"].astype(dtype)
+    x = x + (gate * up) @ layer["w_down"].astype(dtype)
+    return x
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    positions: Optional[jax.Array] = None,
+    shard_activations: bool = True,
+) -> jax.Array:
+    """Causal LM: tokens [B, S] → logits [B, S, V] (fp32).
+
+    Activation sharding constraints pin the batch dim to ``(dp, fsdp)`` and the sequence dim
+    to ``sp`` so GSPMD propagates a consistent layout through every block (naive sequence
+    parallelism; ring attention in ``ops/ring_attention.py`` upgrades the attention part).
+    """
+    B, S = tokens.shape
+    dtype = cfg.dtype
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    x = params["embed"].astype(dtype)[tokens]
+    if shard_activations:
+        x = _maybe_shard(x, P(BATCH_AXES, SEQUENCE_AXIS, None))
+    mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
+
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(_block, static_argnums=(4,))
+
+    if cfg.scan_layers:
+        def scan_body(carry, layer):
+            out = block(carry, layer, positions, mask, cfg)
+            if shard_activations:
+                out = _maybe_shard(out, P(BATCH_AXES, SEQUENCE_AXIS, None))
+            return out, None
+
+        x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    else:
+        for layer in params["layers"]:
+            x = block(x, layer, positions, mask, cfg)
+            if shard_activations:
+                x = _maybe_shard(x, P(BATCH_AXES, SEQUENCE_AXIS, None))
+    x = _rms_norm(x, params["ln_f"], cfg.norm_eps)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = x @ head.astype(dtype)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(
+    params: dict,
+    batch: dict,
+    cfg: LlamaConfig,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Next-token cross-entropy over batch {'tokens': [B, S+1]} with optional 'mask'."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inputs, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    if "mask" in batch:
+        mask = batch["mask"][:, 1:].astype(jnp.float32)
+        return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    return -jnp.mean(ll)
+
+
+def num_params(cfg: LlamaConfig) -> int:
+    """Analytic parameter count (used by MFU computation in bench)."""
+    D, F, V, H, K, hd = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    per_layer = D * H * hd + 2 * D * K * hd + H * hd * D + 3 * D * F + 2 * D
+    total = V * D + cfg.n_layers * per_layer + D
+    if not cfg.tie_embeddings:
+        total += D * V
+    return total

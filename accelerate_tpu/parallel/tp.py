@@ -1,0 +1,100 @@
+"""Tensor-parallel plan registry + application (reference ``TorchTensorParallelPlugin``,
+``dataclasses.py:1863``, applied at ``accelerator.py:1545-1554`` via DTensor device meshes).
+
+A "plan" maps a model's param pytree to PartitionSpecs over the ``tp`` axis. Models shipped
+with the framework define their own (``models/llama.py:partition_specs``); external pytrees
+can register plans here or rely on ``plan_from_rules`` (regex → spec), the analog of the
+HF `tp_plan` dicts consumed by `model.tensor_parallel()`.
+
+Application composes three sharding sources, in priority order:
+    model TP spec  >  fsdp auto-spec on remaining free axes  >  replicate.
+GSPMD then derives every collective (column-parallel matmul → no comm; row-parallel matmul →
+psum; vocab-sharded logits → psum at the loss) from these placements.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Callable, Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..utils.constants import TENSOR_AXIS
+from ..utils.dataclasses import FullyShardedDataParallelPlugin
+from .fsdp import get_fsdp_shardings
+
+__all__ = [
+    "register_tp_plan",
+    "get_tp_plan",
+    "plan_from_rules",
+    "apply_tensor_parallel",
+]
+
+_TP_PLANS: dict[str, Callable] = {}
+
+
+def register_tp_plan(name: str, plan_fn: Callable) -> None:
+    """Register ``plan_fn(params) -> spec pytree`` under ``name``."""
+    _TP_PLANS[name] = plan_fn
+
+
+def get_tp_plan(name: str) -> Callable:
+    if name not in _TP_PLANS:
+        raise KeyError(f"No TP plan {name!r} registered; have {sorted(_TP_PLANS)}")
+    return _TP_PLANS[name]
+
+
+def plan_from_rules(rules: list[tuple[str, PartitionSpec]]) -> Callable:
+    """Build a plan from (regex, spec) pairs matched against '/'-joined param paths.
+
+    The analog of HF-style ``tp_plan`` dicts ({"layers.*.wq": "colwise"}).
+    First matching rule wins; unmatched leaves get a free spec (None → fsdp may fill).
+    """
+
+    def plan(params):
+        flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+        specs = []
+        for keypath, leaf in flat:
+            path = "/".join(_key_str(k) for k in keypath)
+            spec = None
+            for pattern, pspec in rules:
+                if re.fullmatch(pattern, path):
+                    spec = pspec
+                    break
+            if spec is None:
+                spec = PartitionSpec(*([None] * getattr(leaf, "ndim", 0)))
+            specs.append(spec)
+        return jax.tree_util.tree_unflatten(treedef, specs)
+
+    return plan
+
+
+def _key_str(k) -> str:
+    if hasattr(k, "key"):
+        return str(k.key)
+    if hasattr(k, "idx"):
+        return str(k.idx)
+    return str(k)
+
+
+def apply_tensor_parallel(
+    params: Any,
+    mesh: Mesh,
+    specs: Any = None,
+    plan: Optional[str] = None,
+    fsdp_plugin: Optional[FullyShardedDataParallelPlugin] = None,
+) -> Any:
+    """Place params with TP specs (+ fsdp on free axes). Returns the sharded pytree."""
+    if specs is None:
+        if plan is None:
+            raise ValueError("Pass either a spec pytree or a registered plan name")
+        specs = get_tp_plan(plan)(params)
+    shardings = get_fsdp_shardings(params, mesh, fsdp_plugin, specs=specs)
+
+    def _put(leaf, sharding):
+        if isinstance(leaf, jax.Array):
+            return jax.jit(lambda x: x, out_shardings=sharding)(leaf)
+        return jax.device_put(leaf, sharding)
+
+    return jax.tree_util.tree_map(_put, params, shardings)
