@@ -142,6 +142,68 @@ class _TrainStep:
         return state, metrics
 
 
+class _FusedTrainStep:
+    """M train steps per dispatch via ``lax.scan`` (``build_train_step(fused_steps=M)``).
+
+    One compiled program advances M micro-steps (optimizer applies every
+    ``gradient_accumulation_steps``-th) — amortizing host dispatch over M steps, which on TPU
+    removes the host-side bottleneck the reference's per-batch Python loop suffers from.
+    Call with a list of M batches or a pytree stacked on a leading M dim; metrics come back
+    stacked [M, ...].
+    """
+
+    def __init__(self, accelerator: "Accelerator", fused_fn, fused_steps: int, optimizer=None):
+        self.accelerator = accelerator
+        self.fused_fn = fused_fn
+        self.fused_steps = fused_steps
+        self.optimizer = optimizer
+
+    def _stack(self, batches):
+        if isinstance(batches, (list, tuple)):
+            if len(batches) != self.fused_steps:
+                raise ValueError(f"expected {self.fused_steps} batches, got {len(batches)}")
+            import numpy as _np
+
+            stacked = jax.tree_util.tree_map(
+                lambda *leaves: _np.stack([_np.asarray(l) for l in leaves]), *batches
+            )
+        else:
+            stacked = batches
+            for leaf in jax.tree_util.tree_leaves(stacked):
+                if np.ndim(leaf) < 1 or np.shape(leaf)[0] != self.fused_steps:
+                    raise ValueError(
+                        f"pre-stacked batch leaves must have leading dim {self.fused_steps}, "
+                        f"got shape {np.shape(leaf)}"
+                    )
+        sharding = NamedSharding(self.accelerator.mesh, PartitionSpec(None, BATCH_AXES))
+
+        def _put(leaf):
+            if isinstance(leaf, jax.Array) and not leaf.is_fully_addressable:
+                return leaf
+            if np.ndim(leaf) < 2:
+                # Scalars / per-step vectors can't take the (step, batch) sharding.
+                return jax.device_put(
+                    leaf, NamedSharding(self.accelerator.mesh, PartitionSpec())
+                )
+            return jax.device_put(leaf, sharding)
+
+        return jax.tree_util.tree_map(_put, stacked)
+
+    def __call__(self, state: TrainState, batches) -> tuple[TrainState, Any]:
+        acc = self.accelerator
+        stacked = self._stack(batches)
+        with jax.set_mesh(acc.mesh):
+            state, metrics = self.fused_fn(state, stacked)
+        acc.step += self.fused_steps
+        applies = self.fused_steps // acc.gradient_accumulation_steps
+        if self.optimizer is not None:
+            self.optimizer._step_count += applies
+        acc.gradient_state._set_sync_gradients(
+            self.fused_steps % acc.gradient_accumulation_steps == 0
+        )
+        return state, metrics
+
+
 class Accelerator:
     """One facade for device placement, parallelism, precision, accumulation and IO."""
 
@@ -441,6 +503,7 @@ class Accelerator:
         max_grad_norm: Optional[float] = None,
         has_aux: bool = False,
         donate: bool = True,
+        fused_steps: int = 1,
     ) -> _TrainStep:
         """Compile the training step (the reference hot loop, SURVEY.md §3.4, as one XLA program).
 
@@ -504,7 +567,7 @@ class Accelerator:
                 gnorm = _global_norm(grads)
                 scale = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
                 grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
-                metrics["grad_norm"] = gnorm
+                metrics["grad_norm"] = jnp.asarray(gnorm, jnp.float32)
             import optax
 
             updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
@@ -526,6 +589,38 @@ class Accelerator:
             )
 
         donate_args = (0,) if donate else ()
+        if fused_steps > 1:
+            if fused_steps % accum_steps != 0:
+                raise ValueError(
+                    f"fused_steps ({fused_steps}) must be a multiple of "
+                    f"gradient_accumulation_steps ({accum_steps})"
+                )
+
+            def micro_step_padded(st, batch):
+                # lax.cond branches need identical metric structures; pad the micro branch
+                # with the keys only apply_step produces.
+                new_st, metrics = micro_step(st, batch)
+                if max_grad_norm is not None:
+                    metrics["grad_norm"] = jnp.zeros((), jnp.float32)
+                return new_st, metrics
+
+            def fused(state: TrainState, batches):
+                def body(st, batch):
+                    if accum_steps == 1:
+                        new_st, metrics = apply_step(st, batch)
+                    else:
+                        micro = st.micro if st.micro is not None else jnp.zeros((), jnp.int32)
+                        is_sync = (micro + 1) % accum_steps == 0
+                        new_st, metrics = jax.lax.cond(
+                            is_sync, apply_step, micro_step_padded, st, batch
+                        )
+                    return new_st, metrics
+
+                return jax.lax.scan(body, state, batches)
+
+            jit_fused = jax.jit(fused, donate_argnums=donate_args)
+            return _FusedTrainStep(self, jit_fused, fused_steps, optimizer=optimizer)
+
         jit_micro = jax.jit(micro_step, donate_argnums=donate_args)
         jit_apply = jax.jit(apply_step, donate_argnums=donate_args)
         return _TrainStep(self, jit_micro, jit_apply, optimizer=optimizer)

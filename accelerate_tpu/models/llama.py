@@ -194,6 +194,16 @@ def _attention_xla(q, k, v, mask, cfg: LlamaConfig):
 
 def _attention(q, k, v, mask, cfg: LlamaConfig):
     impl = cfg.attn_impl
+    if impl in ("ring", "ulysses", "allgather"):
+        # Sequence-parallel attention over the sp mesh axis (requires an active mesh
+        # context with sp > 1; falls back to local attention otherwise).
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh is not None and not mesh.empty and mesh.shape.get(SEQUENCE_AXIS, 1) > 1:
+            from ..parallel.sequence import make_sp_attention
+
+            attn = make_sp_attention(mesh, mode=impl, axis_name=SEQUENCE_AXIS, causal=True)
+            return attn(q, k, v)
+        impl = "auto"
     if impl == "auto":
         impl = "flash" if jax.default_backend() in ("tpu", "axon") else "xla"
     if impl == "flash":

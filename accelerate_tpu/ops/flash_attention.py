@@ -1,0 +1,444 @@
+"""FlashAttention-2 for TPU in Pallas (forward + backward custom VJP).
+
+The reference outsources attention entirely to torch/CUDA libraries; on TPU this kernel is the
+framework's hot-path attention (SURVEY.md §7: "Pallas flash/splash attention"). Standard
+online-softmax tiling: the (S×T) score matrix never materializes in HBM — per-block partial
+maxima/sums ride in VMEM scratch across the kv-grid dimension (FlashAttention-2 schedule).
+
+Layout: q [B, H, S, hd], k/v [B, H, T, hd] (the public wrapper handles the user-facing
+[B, S, H, hd] layout + GQA head repetition). Sequence lengths are padded to block multiples;
+padded keys are masked via global column indices, padded query rows sliced off by the wrapper.
+
+**Position offsets**: the kernels take traced ``q_offset``/``kv_offset`` scalars (SMEM) giving
+the global position of the local block — this is what lets ``ops/ring_attention.py`` reuse
+these exact kernels per ring step with correct cross-device causal masking. The raw ``_fwd`` /
+``_bwd_dq`` / ``_bwd_dkv`` entry points (returning/consuming lse and delta) are the building
+blocks for the ring; ``flash_attention`` is the single-device public API.
+
+Runs in interpreter mode on CPU (tests) and compiled on TPU. Block sizes default to 128×128
+(MXU-shaped); hd should be a multiple of 128 for peak efficiency (llama3: hd=128).
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["flash_attention"]
+
+_NEG_INF = -1e30
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _scalar(x) -> jax.Array:
+    return jnp.asarray(x, dtype=jnp.int32).reshape(1, 1)
+
+
+def _smem_scalar_spec():
+    return pl.BlockSpec(memory_space=pltpu.SMEM)
+
+
+# ------------------------------------------------------------------------------ forward
+def _fwd_kernel(
+    q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
+    *, sm_scale, causal, block_q, block_k, kv_len,
+):
+    i = pl.program_id(2)  # q block
+    j = pl.program_id(3)  # kv block
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q_start = i * block_q
+    k_start = j * block_k
+    q_off = q_off_ref[0, 0]
+    kv_off = kv_off_ref[0, 0]
+    # Causal: skip kv blocks strictly above the diagonal band (in global positions).
+    needed = jnp.logical_or(
+        jnp.asarray(not causal),
+        kv_off + k_start <= q_off + q_start + block_q - 1,
+    )
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)  # [block_q, hd]
+        k = k_ref[0, 0].astype(jnp.float32)  # [block_k, hd]
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale  # [block_q, block_k]
+
+        col_local = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = col_local < kv_len
+        if causal:
+            row = q_off + q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, kv_off + col_local <= row)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_ref[:]                       # [block_q, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        m_ref[:] = m_new
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        # lse = -inf where no key attended (fully-masked row) so ring merging ignores it.
+        lse = jnp.where(l == 0.0, _NEG_INF, m_ref[:] + jnp.log(l_safe))
+        lse_ref[0, 0] = lse  # [block_q, 1]
+
+
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, q_offset=0, kv_offset=0):
+    """Raw forward: [B,H,S,hd] → (o [B,H,S,hd], lse [B,H,S] fp32). Differentiation-free."""
+    B, H, S, hd = q.shape
+    T = k.shape[2]
+    nq = pl.cdiv(S, block_q)
+    nk = pl.cdiv(T, block_k)
+    Sp, Tp = nq * block_q, nk * block_k
+    q = _pad_seq(q, Sp)
+    k = _pad_seq(k, Tp)
+    v = _pad_seq(v, Tp)
+
+    kernel = functools.partial(
+        _fwd_kernel,
+        sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k, kv_len=T,
+    )
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, nq, nk),
+        in_specs=[
+            _smem_scalar_spec(),
+            _smem_scalar_spec(),
+            pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sp, hd), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sp, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, hd), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(_scalar(q_offset), _scalar(kv_offset), q, k, v)
+    return o[:, :, :S], lse[:, :, :S, 0]
+
+
+# ------------------------------------------------------------------------------ backward
+def _bwd_dq_kernel(
+    q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
+    *, sm_scale, causal, block_q, block_k, kv_len,
+):
+    i = pl.program_id(2)
+    j = pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_start = i * block_q
+    k_start = j * block_k
+    q_off = q_off_ref[0, 0]
+    kv_off = kv_off_ref[0, 0]
+    needed = jnp.logical_or(
+        jnp.asarray(not causal),
+        kv_off + k_start <= q_off + q_start + block_q - 1,
+    )
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]                    # [block_q, 1]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        col_local = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = col_local < kv_len
+        if causal:
+            row = q_off + q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+            mask = jnp.logical_and(mask, kv_off + col_local <= row)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(j == nk - 1)
+    def _finalize():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    dk_ref, dv_ref, dk_acc, dv_acc,
+    *, sm_scale, causal, block_q, block_k, kv_len, q_len,
+):
+    j = pl.program_id(2)  # kv block (outer)
+    i = pl.program_id(3)  # q block (inner)
+    ni = pl.num_programs(3)
+
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_start = i * block_q
+    k_start = j * block_k
+    q_off = q_off_ref[0, 0]
+    kv_off = kv_off_ref[0, 0]
+    needed = jnp.logical_or(
+        jnp.asarray(not causal),
+        q_off + q_start + block_q - 1 >= kv_off + k_start,
+    )
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0]
+        delta = delta_ref[0, 0]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * sm_scale
+        col_local = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        row_local = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        mask = jnp.logical_and(col_local < kv_len, row_local < q_len)
+        if causal:
+            mask = jnp.logical_and(mask, kv_off + col_local <= q_off + row_local)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - delta) * sm_scale
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(i == ni - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
+            q_offset=0, kv_offset=0):
+    """dq for local q against one kv block (ring building block)."""
+    B, H, S, hd = q.shape
+    T = k.shape[2]
+    nq = pl.cdiv(S, block_q)
+    nk = pl.cdiv(T, block_k)
+    Sp, Tp = nq * block_q, nk * block_k
+    qp, dop = _pad_seq(q, Sp), _pad_seq(do, Sp)
+    kp, vp = _pad_seq(k, Tp), _pad_seq(v, Tp)
+    lsep = _pad_seq(lse[..., None], Sp)
+    deltap = _pad_seq(delta[..., None], Sp)
+    kernel = functools.partial(
+        _bwd_dq_kernel,
+        sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k, kv_len=T,
+    )
+    dq = pl.pallas_call(
+        kernel,
+        grid=(B, H, nq, nk),
+        in_specs=[
+            _smem_scalar_spec(),
+            _smem_scalar_spec(),
+            pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, H, Sp, hd), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
+        interpret=interpret,
+    )(_scalar(q_offset), _scalar(kv_offset), qp, kp, vp, dop, lsep, deltap)
+    return dq[:, :, :S]
+
+
+def _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
+             q_offset=0, kv_offset=0):
+    """(dk, dv) for one kv block against local q (ring building block)."""
+    B, H, S, hd = q.shape
+    T = k.shape[2]
+    nq = pl.cdiv(S, block_q)
+    nk = pl.cdiv(T, block_k)
+    Sp, Tp = nq * block_q, nk * block_k
+    qp, dop = _pad_seq(q, Sp), _pad_seq(do, Sp)
+    kp, vp = _pad_seq(k, Tp), _pad_seq(v, Tp)
+    lsep = _pad_seq(lse[..., None], Sp)
+    deltap = _pad_seq(delta[..., None], Sp)
+    kernel = functools.partial(
+        _bwd_dkv_kernel,
+        sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k,
+        kv_len=T, q_len=S,
+    )
+    dk, dv = pl.pallas_call(
+        kernel,
+        grid=(B, H, nk, nq),
+        in_specs=[
+            _smem_scalar_spec(),
+            _smem_scalar_spec(),
+            pl.BlockSpec((1, 1, block_q, hd), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_q, hd), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, j, i: (b, h, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Tp, hd), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Tp, hd), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, hd), jnp.float32),
+            pltpu.VMEM((block_k, hd), jnp.float32),
+        ],
+        interpret=interpret,
+    )(_scalar(q_offset), _scalar(kv_offset), qp, kp, vp, dop, lsep, deltap)
+    return dk[:, :, :T], dv[:, :, :T]
+
+
+def _pad_seq(x, target):
+    S = x.shape[2]
+    if S == target:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, target - S), (0, 0)))
+
+
+def _fit_block(block: int, seq: int) -> int:
+    if seq >= block:
+        return block
+    return max(16, 1 << (seq - 1).bit_length())
+
+
+# ----------------------------------------------------------------------------- public API
+# Offsets travel as float32 scalars so the custom_vjp has well-defined (zero) cotangents for
+# them; kernels receive them as int32. This is what lets shard_map callers (ring/allgather SP)
+# pass traced global positions.
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _flash_bhsd(q, k, v, q_off, kv_off, causal, sm_scale, block_q, block_k, interpret):
+    o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
+                q_offset=q_off.astype(jnp.int32), kv_offset=kv_off.astype(jnp.int32))
+    return o
+
+
+def _flash_bhsd_fwd(q, k, v, q_off, kv_off, causal, sm_scale, block_q, block_k, interpret):
+    o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
+                  q_offset=q_off.astype(jnp.int32), kv_offset=kv_off.astype(jnp.int32))
+    return o, (q, k, v, q_off, kv_off, o, lse)
+
+
+def _flash_bhsd_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, do):
+    q, k, v, q_off, kv_off, o, lse = residuals
+    qo = q_off.astype(jnp.int32)
+    ko = kv_off.astype(jnp.int32)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [B,H,S]
+    dq = _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
+                 q_offset=qo, kv_offset=ko)
+    dk, dv = _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
+                      q_offset=qo, kv_offset=ko)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+
+
+_flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
+
+
+def _flash_bhsd_offset(q, k, v, q_offset=0, kv_offset=0, causal=True, sm_scale=None,
+                       block_q=128, block_k=128, interpret=None):
+    """Offset-aware flash attention over user layout [B, S, H, hd] (shard_map helper)."""
+    B, S, H, hd = q.shape
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(hd)
+    if interpret is None:
+        interpret = _interpret_default()
+    qT = q.transpose(0, 2, 1, 3)
+    kT = k.transpose(0, 2, 1, 3)
+    vT = v.transpose(0, 2, 1, 3)
+    bq = _fit_block(block_q, S)
+    bk = _fit_block(block_k, k.shape[1])
+    o = _flash_bhsd(qT, kT, vT,
+                    jnp.asarray(q_offset, jnp.float32), jnp.asarray(kv_offset, jnp.float32),
+                    causal, sm_scale, bq, bk, interpret)
+    return o.transpose(0, 2, 1, 3)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Flash attention over user layout q [B, S, H, hd], k/v [B, T, K, hd] (GQA: K ≤ H).
+
+    Returns [B, S, H, hd] in q's dtype. Differentiable (custom VJP with flash backward).
+    """
+    B, S, H, hd = q.shape
+    K = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(hd)
+    if interpret is None:
+        interpret = _interpret_default()
+    if H != K:
+        reps = H // K
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+    # [B, S, H, hd] → [B, H, S, hd]
+    qT = q.transpose(0, 2, 1, 3)
+    kT = k.transpose(0, 2, 1, 3)
+    vT = v.transpose(0, 2, 1, 3)
+    block_q = _fit_block(block_q, S)
+    block_k = _fit_block(block_k, k.shape[1])
+    zero = jnp.zeros((), jnp.float32)
+    o = _flash_bhsd(qT, kT, vT, zero, zero, causal, sm_scale, block_q, block_k, interpret)
+    return o.transpose(0, 2, 1, 3)

@@ -1,0 +1,160 @@
+"""Ring attention — exact causal attention over a sequence-sharded mesh axis.
+
+The reference has NO long-context implementation (SURVEY.md §5: only a Megatron passthrough
+flag); this module is the first-class TPU-native answer. Each device holds a local sequence
+chunk of q/k/v; kv chunks rotate around the ``sp`` ring via ``ppermute`` (riding ICI
+neighbor links) while every device computes flash-attention partials of its local q against
+the visiting kv chunk, merged with numerically-stable online-softmax weights. Communication
+overlaps compute and HBM never sees an S_global×S_global score matrix — context length scales
+linearly with ring size (Ring Attention, Liu et al. 2023).
+
+Backward: (k, v, dk, dv) rotate together; each device adds its local-q contribution to the
+visiting kv block's gradients; after a full revolution the gradients are home. dq accumulates
+locally. Both passes reuse the Pallas flash kernels (``ops/flash_attention._fwd/_bwd_*``)
+with global position offsets for cross-device causal masking.
+
+Use inside ``shard_map`` (manual axes must include the ring axis), e.g. via
+``parallel.sequence.sequence_parallel_attention``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .flash_attention import _bwd_dq, _bwd_dkv, _fwd, _interpret_default
+
+__all__ = ["ring_attention"]
+
+
+def _merge(o_run, lse_run, o_b, lse_b):
+    """Online-softmax merge of two normalized partial outputs ([B,H,S,hd], [B,H,S])."""
+    m = jnp.maximum(lse_run, lse_b)
+    w_run = jnp.exp(lse_run - m)
+    w_b = jnp.exp(lse_b - m)
+    denom = w_run + w_b
+    denom_safe = jnp.where(denom == 0.0, 1.0, denom)
+    o = (o_run * w_run[..., None] + o_b.astype(jnp.float32) * w_b[..., None]) / denom_safe[..., None]
+    return o, m + jnp.log(denom_safe)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _ring_bhsd(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret):
+    o, _ = _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret)
+    return o
+
+
+def _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret):
+    block_q, block_k = block_sizes
+    B, H, S_local, hd = q.shape
+    idx = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    q_off = idx * S_local
+
+    def body(carry, t):
+        k_cur, v_cur, o_run, lse_run = carry
+        kv_idx = (idx - t) % n
+        o_b, lse_b = _fwd(
+            q, k_cur, v_cur, causal, sm_scale, block_q, block_k, interpret,
+            q_offset=q_off, kv_offset=kv_idx * S_local,
+        )
+        o_run, lse_run = _merge(o_run, lse_run, o_b, lse_b)
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        return (k_next, v_next, o_run, lse_run), None
+
+    o0 = jnp.zeros((B, H, S_local, hd), jnp.float32)
+    lse0 = jnp.full((B, H, S_local), -1e30, jnp.float32)
+    (k_home, v_home, o, lse), _ = lax.scan(body, (k, v, o0, lse0), jnp.arange(n))
+    return o.astype(q.dtype), lse
+
+
+def _ring_fwd(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret):
+    o, lse = _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _ring_bwd(axis_name, causal, sm_scale, block_sizes, interpret, residuals, do):
+    block_q, block_k = block_sizes
+    q, k, v, o, lse = residuals
+    B, H, S_local, hd = q.shape
+    idx = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    q_off = idx * S_local
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    def body(carry, t):
+        k_cur, v_cur, dk_cur, dv_cur, dq_run = carry
+        kv_idx = (idx - t) % n
+        kv_off = kv_idx * S_local
+        dq_b = _bwd_dq(
+            q, k_cur, v_cur, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
+            q_offset=q_off, kv_offset=kv_off,
+        )
+        dk_b, dv_b = _bwd_dkv(
+            q, k_cur, v_cur, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
+            q_offset=q_off, kv_offset=kv_off,
+        )
+        dq_run = dq_run + dq_b
+        dk_cur = dk_cur + dk_b
+        dv_cur = dv_cur + dv_b
+        # Rotate kv AND its gradient accumulators together: after n steps they're home.
+        k_next = lax.ppermute(k_cur, axis_name, perm)
+        v_next = lax.ppermute(v_cur, axis_name, perm)
+        dk_next = lax.ppermute(dk_cur, axis_name, perm)
+        dv_next = lax.ppermute(dv_cur, axis_name, perm)
+        return (k_next, v_next, dk_next, dv_next, dq_run), None
+
+    zeros_kv = jnp.zeros((B, H, S_local, hd), jnp.float32)
+    (k_home, v_home, dk, dv, dq), _ = lax.scan(
+        body, (k, v, zeros_kv, zeros_kv, jnp.zeros((B, H, S_local, hd), jnp.float32)),
+        jnp.arange(n),
+    )
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_bhsd.defvjp(_ring_fwd, _ring_bwd)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = "sp",
+    causal: bool = True,
+    sm_scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Exact ring attention for use inside shard_map; user layout q [B, S_loc, H, hd].
+
+    k/v [B, S_loc, K, hd] with K ≤ H (GQA repeat handled here; gradients sum back through
+    the repeat automatically). Returns [B, S_loc, H, hd].
+    """
+    B, S_local, H, hd = q.shape
+    K = k.shape[2]
+    if sm_scale is None:
+        sm_scale = 1.0 / math.sqrt(hd)
+    if interpret is None:
+        interpret = _interpret_default()
+    if H != K:
+        reps = H // K
+        k = jnp.repeat(k, reps, axis=2)
+        v = jnp.repeat(v, reps, axis=2)
+    qT = q.transpose(0, 2, 1, 3)
+    kT = k.transpose(0, 2, 1, 3)
+    vT = v.transpose(0, 2, 1, 3)
+    from .flash_attention import _fit_block
+
+    bq = _fit_block(block_q, S_local)
+    bk = _fit_block(block_k, S_local)
+    o = _ring_bhsd(qT, kT, vT, axis_name, causal, sm_scale, (bq, bk), interpret)
+    return o.transpose(0, 2, 1, 3)

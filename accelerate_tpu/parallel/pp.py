@@ -1,0 +1,132 @@
+"""Pipeline parallelism over the ``pp`` mesh axis (GPipe schedule).
+
+TPU-native analog of the reference's pipeline path (reference ``inference.py``: torch
+``ScheduleGPipe`` :82-96, microbatch forward ``pippy_forward`` :99-121, split-point
+auto-balancing :164-168) — but usable for TRAINING too, which the reference never supports
+(its pipelining is inference-only).
+
+Formulation: SPMD circular pipeline. Stage params are stacked on a leading ``n_stages`` dim
+sharded over ``pp``; inside shard_map every device runs the same per-tick program for
+``M + n - 1`` ticks (M microbatches): stage 0 ingests microbatch t, others consume the
+activation ``ppermute``d from their predecessor; the last stage banks its outputs. Because the
+whole schedule is one differentiable ``lax.scan``, **jax AD derives the backward pipeline
+automatically** (activations rematerialized per ``jax.checkpoint`` policy), so the same
+machinery trains — the torch version needs a separate runtime for that.
+
+Bubble fraction is the GPipe (n-1)/(M+n-1); raise ``num_microbatches`` to amortize.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..utils.constants import PIPELINE_AXIS
+
+__all__ = ["pipeline_apply", "make_pipeline_fn", "stack_stage_params", "split_params_into_stages"]
+
+
+def stack_stage_params(stage_param_list: list[Any]) -> Any:
+    """Stack per-stage param pytrees along a new leading stage dim (shard it over pp)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *stage_param_list)
+
+
+def split_params_into_stages(layer_params: Any, n_stages: int) -> Any:
+    """Group a stacked-layers pytree [L, ...] into [n_stages, L/n_stages, ...]."""
+
+    def _split(leaf):
+        L = leaf.shape[0]
+        if L % n_stages != 0:
+            raise ValueError(f"layer count {L} not divisible by {n_stages} stages")
+        return leaf.reshape(n_stages, L // n_stages, *leaf.shape[1:])
+
+    return jax.tree_util.tree_map(_split, layer_params)
+
+
+def pipeline_apply(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,
+    axis_name: str = PIPELINE_AXIS,
+):
+    """Run the GPipe schedule inside shard_map.
+
+    - ``stage_fn(params_for_one_stage, x) -> y`` with y.shape == x.shape (inter-stage
+      activations must be shape-stable; wrap embed/head outside the pipeline).
+    - ``stage_params``: local slice, leading dim 1 (shard_map over P('pp', ...)).
+    - ``microbatches``: [M, B_m, ...] replicated across pp.
+
+    Returns [M, B_m, ...] outputs (replicated across pp after a masked psum).
+    """
+    idx = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+    M = microbatches.shape[0]
+    local_params = jax.tree_util.tree_map(lambda x: x[0], stage_params)
+    perm = [(i, i + 1) for i in range(n - 1)]  # forward chain, no wraparound
+
+    x0 = jnp.zeros_like(microbatches[0])
+    out_buf0 = jnp.zeros_like(microbatches)
+
+    def tick(carry, t):
+        recv, out_buf = carry
+        # Stage 0 ingests microbatch t (clamped; masked out-of-range ticks are dead compute).
+        ingest = microbatches[jnp.clip(t, 0, M - 1)]
+        x = jnp.where(idx == 0, ingest, recv)
+        y = stage_fn(local_params, x)
+        # Last stage banks microbatch (t - n + 1) when valid.
+        out_t = t - (n - 1)
+        valid = jnp.logical_and(idx == n - 1, jnp.logical_and(out_t >= 0, out_t < M))
+        out_buf = jnp.where(
+            valid,
+            lax.dynamic_update_index_in_dim(out_buf, y, jnp.clip(out_t, 0, M - 1), 0),
+            out_buf,
+        )
+        nxt = lax.ppermute(y, axis_name, perm)
+        return (nxt, out_buf), None
+
+    (last, out_buf), _ = lax.scan(tick, (x0, out_buf0), jnp.arange(M + n - 1))
+    # Replicate the last stage's banked outputs to every stage.
+    out = lax.psum(jnp.where(idx == n - 1, out_buf, jnp.zeros_like(out_buf)), axis_name)
+    return out
+
+
+def make_pipeline_fn(
+    mesh,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    axis_name: str = PIPELINE_AXIS,
+    num_microbatches: Optional[int] = None,
+):
+    """GSPMD-embeddable pipeline: ``fn(stacked_stage_params, x [B, ...]) -> y [B, ...]``.
+
+    Splits the batch into microbatches, runs the GPipe schedule manual-over-``pp`` only
+    (other mesh axes stay auto), and reassembles. ``stacked_stage_params`` leading dim =
+    n_stages, sharded P('pp', ...).
+    """
+    n_stages = mesh.shape[axis_name]
+    if num_microbatches is None:
+        num_microbatches = n_stages
+
+    def fn(stage_params, x):
+        B = x.shape[0]
+        if B % num_microbatches != 0:
+            raise ValueError(f"batch {B} not divisible by {num_microbatches} microbatches")
+        mb = x.reshape(num_microbatches, B // num_microbatches, *x.shape[1:])
+
+        specs_params = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
+        mapped = jax.shard_map(
+            functools.partial(pipeline_apply, stage_fn, axis_name=axis_name),
+            mesh=mesh,
+            in_specs=(specs_params, P()),
+            out_specs=P(),
+            axis_names={axis_name},
+            check_vma=False,
+        )
+        out = mapped(stage_params, mb)
+        return out.reshape(B, *out.shape[2:])
+
+    return fn

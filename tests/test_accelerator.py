@@ -321,3 +321,45 @@ def test_register_for_checkpointing_validation():
     acc = make_accelerator()
     with pytest.raises(ValueError):
         acc.register_for_checkpointing(object())
+
+
+def test_fused_train_step_parity():
+    """M fused steps in one dispatch == M sequential steps (incl. accumulation)."""
+    ds = RegressionDataset(64)
+    batches = [
+        {"x": ds.x[i : i + 8], "y": ds.y[i : i + 8]} for i in range(0, 64, 8)
+    ]
+    # Sequential reference with accum=2.
+    acc = make_accelerator(gradient_accumulation_steps=2)
+    state_seq = acc.create_train_state(init_params(), optax.sgd(0.1))
+    step = acc.build_train_step(loss_fn, max_grad_norm=10.0)
+    seq_losses = []
+    for b in batches:
+        jb = {k: jnp.asarray(v) for k, v in b.items()}
+        state_seq, m = step(state_seq, jb)
+        seq_losses.append(float(m["loss"]))
+
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc2 = make_accelerator(gradient_accumulation_steps=2)
+    state_f = acc2.create_train_state(init_params(), optax.sgd(0.1))
+    fused = acc2.build_train_step(loss_fn, max_grad_norm=10.0, fused_steps=8)
+    state_f, metrics = fused(state_f, batches)
+    fused_losses = [float(x) for x in metrics["loss"]]
+    np.testing.assert_allclose(fused_losses, seq_losses, rtol=2e-5)
+    assert int(state_f.step) == int(state_seq.step) == 4
+    for k in state_seq.params:
+        np.testing.assert_allclose(
+            np.asarray(state_f.params[k]), np.asarray(state_seq.params[k]), rtol=2e-5, atol=1e-6
+        )
+    assert acc2._optimizers[-1]._step_count == 4
+
+
+def test_fused_steps_requires_multiple():
+    acc = make_accelerator(gradient_accumulation_steps=3)
+    acc.prepare(optax.sgd(0.1))
+    with pytest.raises(ValueError, match="multiple"):
+        acc.build_train_step(loss_fn, fused_steps=4)

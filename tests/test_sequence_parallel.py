@@ -1,0 +1,165 @@
+"""Sequence-parallelism tests: ring / ulysses / allgather attention must exactly match
+single-device attention, forward AND backward, on a real sp-sharded mesh."""
+
+import math
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from accelerate_tpu.parallel import MeshConfig, build_mesh
+from accelerate_tpu.parallel.sequence import make_sp_attention, sequence_parallel_attention
+
+
+def reference_attention(q, k, v, causal=True):
+    B, S, H, hd = q.shape
+    K = k.shape[2]
+    if H != K:
+        k = jnp.repeat(k, H // K, axis=2)
+        v = jnp.repeat(v, H // K, axis=2)
+    scores = jnp.einsum("bshd,bthd->bhst", q, k) / math.sqrt(hd)
+    if causal:
+        mask = jnp.tril(jnp.ones((S, S), dtype=bool))
+        scores = jnp.where(mask, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+def make_qkv(B=2, S=256, H=8, K=8, hd=32, seed=0):
+    rng = np.random.default_rng(seed)
+    q = jnp.asarray(rng.normal(size=(B, S, H, hd)), dtype=jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, K, hd)), dtype=jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, K, hd)), dtype=jnp.float32)
+    return q, k, v
+
+
+@pytest.fixture
+def sp_mesh():
+    return build_mesh(MeshConfig(dp=1, sp=8))
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses", "allgather"])
+@pytest.mark.parametrize("causal", [True, False])
+def test_sp_attention_forward_parity(sp_mesh, mode, causal):
+    q, k, v = make_qkv()
+    attn = make_sp_attention(sp_mesh, mode=mode, causal=causal)
+    sharded = NamedSharding(sp_mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, sharded) for x in (q, k, v))
+    with jax.set_mesh(sp_mesh):
+        out = jax.jit(attn)(qs, ks, vs)
+    ref = reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses"])
+def test_sp_attention_gqa(sp_mesh, mode):
+    q, k, v = make_qkv(H=8, K=2)
+    attn = make_sp_attention(sp_mesh, mode=mode, causal=True)
+    sharded = NamedSharding(sp_mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, sharded) for x in (q, k, v))
+    with jax.set_mesh(sp_mesh):
+        out = jax.jit(attn)(qs, ks, vs)
+    ref = reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses", "allgather"])
+def test_sp_attention_gradient_parity(sp_mesh, mode):
+    q, k, v = make_qkv(B=1, S=128, H=8, K=8, hd=32)
+    attn = make_sp_attention(sp_mesh, mode=mode, causal=True)
+    sharded = NamedSharding(sp_mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, sharded) for x in (q, k, v))
+
+    def loss_sp(q, k, v):
+        return jnp.sum(attn(q, k, v) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(reference_attention(q, k, v, causal=True) ** 2)
+
+    with jax.set_mesh(sp_mesh):
+        gs = jax.jit(jax.grad(loss_sp, argnums=(0, 1, 2)))(qs, ks, vs)
+    gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gs, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-3, err_msg=f"d{name} mismatch ({mode})"
+        )
+
+
+def test_ring_attention_used_in_training_step(sp_mesh):
+    """End-to-end: a toy attention model trains under sp=8 with ring attention, matching
+    the same model trained single-device."""
+    import optax
+
+    B, S, H, hd = 2, 128, 4, 32
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(B, S, H * hd)), dtype=jnp.float32)
+    y = jnp.asarray(rng.normal(size=(B, S, H * hd)), dtype=jnp.float32)
+    w0 = jnp.asarray(rng.normal(size=(H * hd, 3 * H * hd)) * 0.05, dtype=jnp.float32)
+
+    def model(w, x, attn_fn):
+        qkv = x @ w
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(B, S, H, hd)
+        k = k.reshape(B, S, H, hd)
+        v = v.reshape(B, S, H, hd)
+        o = attn_fn(q, k, v).reshape(B, S, H * hd)
+        return jnp.mean((o - y) ** 2)
+
+    ring_fn = make_sp_attention(sp_mesh, mode="ring", causal=True)
+    ref_fn = lambda q, k, v: reference_attention(q, k, v, causal=True)
+
+    tx = optax.sgd(0.1)
+
+    def train(attn_fn, w, n=3, mesh=None):
+        opt = tx.init(w)
+        losses = []
+        for _ in range(n):
+            if mesh is not None:
+                with jax.set_mesh(mesh):
+                    loss, g = jax.jit(jax.value_and_grad(lambda w: model(w, x, attn_fn)))(w)
+            else:
+                loss, g = jax.value_and_grad(lambda w: model(w, x, attn_fn))(w)
+            u, opt = tx.update(g, opt, w)
+            w = optax.apply_updates(w, u)
+            losses.append(float(loss))
+        return losses, w
+
+    losses_ring, w_ring = train(ring_fn, w0, mesh=sp_mesh)
+    losses_ref, w_ref = train(ref_fn, w0)
+    np.testing.assert_allclose(losses_ring, losses_ref, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(w_ring), np.asarray(w_ref), atol=1e-5)
+
+
+def test_llama_with_ring_attention_parity():
+    """Full llama training step with attn_impl='ring' on an sp mesh == xla baseline."""
+    import dataclasses
+    import optax
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.utils import send_to_device
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    cfg_ring = dataclasses.replace(
+        llama.CONFIGS["tiny"], dtype=jnp.float32, attn_impl="ring")
+    cfg_ref = dataclasses.replace(cfg_ring, attn_impl="xla")
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg_ring.vocab_size, size=(4, 65)).astype(np.int32)
+
+    losses = {}
+    for name, cfg, mesh_kwargs in [
+        ("ring", cfg_ring, dict(dp=2, sp=4)),
+        ("ref", cfg_ref, dict(dp=8)),
+    ]:
+        AcceleratorState._reset_state(); GradientState._reset_state(); PartialState._reset_state()
+        acc = Accelerator(mesh_config=MeshConfig(**mesh_kwargs))
+        state = acc.create_train_state(llama.init_params(cfg), optax.sgd(0.05))
+        step = acc.build_train_step(lambda p, b: llama.loss_fn(p, b, cfg))
+        batch = send_to_device({"tokens": tokens}, acc.mesh)
+        ls = []
+        for _ in range(3):
+            state, m = step(state, batch)
+            ls.append(float(m["loss"]))
+        losses[name] = ls
+    np.testing.assert_allclose(losses["ring"], losses["ref"], rtol=1e-4)
