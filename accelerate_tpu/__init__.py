@@ -11,7 +11,9 @@ See SURVEY.md for the full blueprint and the reference-parity map.
 __version__ = "0.1.0"
 
 from .state import AcceleratorState, GradientState, PartialState
+from .local_sgd import LocalSGD
 from .logging import get_logger
+from .utils.memory import find_executable_batch_size
 from .utils import (
     DataLoaderConfiguration,
     DistributedType,

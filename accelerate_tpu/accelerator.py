@@ -689,6 +689,32 @@ class Accelerator:
         yield
 
     @contextlib.contextmanager
+    def profile(self, profile_handler=None):
+        """Profile the enclosed block with ``jax.profiler`` (reference ``:3614``).
+
+        The reference builds a ``torch.profiler.profile`` from ``ProfileKwargs`` and exports a
+        Chrome trace to ``output_trace_dir``. Here the block is captured with
+        ``jax.profiler.trace`` (TensorBoard/perfetto-compatible, includes XLA HLO + TPU
+        device timelines); ``on_trace_ready(trace_dir)`` fires on exit when provided.
+        """
+        from .utils.dataclasses import ProfileKwargs
+
+        handler = profile_handler or getattr(self, "profile_handler", None) or ProfileKwargs()
+        trace_dir = handler.output_trace_dir
+        if trace_dir is None:
+            import tempfile
+
+            trace_dir = tempfile.mkdtemp(prefix="accelerate_tpu_trace_")
+        os.makedirs(trace_dir, exist_ok=True)
+        jax.profiler.start_trace(trace_dir)
+        try:
+            yield handler
+        finally:
+            jax.profiler.stop_trace()
+            if handler.on_trace_ready is not None and self.is_main_process:
+                handler.on_trace_ready(trace_dir)
+
+    @contextlib.contextmanager
     def join_uneven_inputs(self, joinables, even_batches: Optional[bool] = None):
         """Reference ``:1197``: with mesh-global batches, uneven inputs are already handled by
         the dataloader's even_batches padding; honor an override for this block."""

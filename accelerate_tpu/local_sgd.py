@@ -1,0 +1,87 @@
+"""Local SGD: skip cross-host synchronization for K steps, then average parameters.
+
+Reference: ``local_sgd.py`` (``LocalSGD`` ctx manager, ``_sync_and_avg_model_params``
+``local_sgd.py:102``) — there it enters ``no_sync()`` so DDP's bucketed all-reduce is skipped
+and periodically all-reduce-averages ``model.parameters()``.
+
+TPU-native translation: inside one jitted GSPMD program over a global mesh the gradient
+all-reduce is inserted by XLA and is effectively free over ICI — there is nothing to skip.
+What local SGD buys on TPU pods is *skipping the DCN hop*: each host trains on its local
+devices (a host-local mesh / independent train state) and every ``local_sgd_steps`` steps the
+parameter pytrees are averaged across hosts over DCN. This class implements that contract: it
+counts steps and, at each boundary (and on exit), mean-reduces the provided train state's
+params across processes via the host-level collective layer (``utils.operations.reduce``).
+
+On a single process (or when ``enabled=False``) every operation is a no-op, matching the
+reference's behavior under ``DistributedType.NO``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .state import PartialState
+from .utils.operations import reduce as _reduce
+
+
+class LocalSGD:
+    """Context manager mirroring reference ``local_sgd.py:20``.
+
+    Usage::
+
+        with LocalSGD(accelerator=acc, state_getter=lambda: state,
+                      state_setter=new, local_sgd_steps=8) as local_sgd:
+            for batch in dl:
+                state, metrics = step(state, batch)
+                state = local_sgd.step(state)
+    """
+
+    def __init__(
+        self,
+        accelerator=None,
+        model: Any = None,
+        local_sgd_steps: int = 8,
+        enabled: bool = True,
+    ):
+        partial = PartialState()
+        self.enabled = enabled and partial.use_distributed and partial.num_processes > 1
+        self.num_steps = 0
+        self.accelerator = accelerator
+        self.model = model
+        if self.enabled:
+            self.local_sgd_steps = local_sgd_steps
+
+    def __enter__(self):
+        if self.enabled:
+            self.model_sync_obj = self.model
+        return self
+
+    def __exit__(self, type, value, tb):
+        if self.enabled:
+            # Ensure hosts end on identical parameters (reference ``local_sgd.py:58``).
+            self._last = self._sync_and_avg_model_params(self._last) if hasattr(self, "_last") else None
+
+    def step(self, state_or_params: Optional[Any] = None):
+        """Count one optimizer step; average params across hosts at each boundary.
+
+        Returns the (possibly averaged) state/params so the functional training loop can
+        carry it forward — the one deviation from the reference's in-place API.
+        """
+        self.num_steps += 1
+        if not self.enabled:
+            return state_or_params
+        self._last = state_or_params
+        if self.num_steps % self.local_sgd_steps == 0:
+            out = self._sync_and_avg_model_params(state_or_params)
+            self._last = out
+            return out
+        return state_or_params
+
+    def _sync_and_avg_model_params(self, state_or_params):
+        """Mean of the parameter pytree across processes (reference ``local_sgd.py:102``)."""
+        if state_or_params is None:
+            return None
+        if hasattr(state_or_params, "params") and hasattr(state_or_params, "replace"):
+            averaged = _reduce(state_or_params.params, reduction="mean")
+            return state_or_params.replace(params=averaged)
+        return _reduce(state_or_params, reduction="mean")

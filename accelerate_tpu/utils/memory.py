@@ -1,0 +1,117 @@
+"""OOM-aware retry helpers (reference ``utils/memory.py``).
+
+The reference's ``find_executable_batch_size`` (``memory.py:120``) decorates a training function
+with a ``batch_size`` first argument and halves it whenever the wrapped call raises a CUDA OOM
+(``should_reduce_batch_size`` ``memory.py:100``). The TPU-native analog catches XLA's
+``RESOURCE_EXHAUSTED`` compile/runtime errors (HBM OOM surfaces as ``XlaRuntimeError`` with a
+"RESOURCE_EXHAUSTED"/"Out of memory" message) and clears JAX's compilation + array caches
+between attempts so the retry starts from a clean heap.
+"""
+
+from __future__ import annotations
+
+import functools
+import gc
+import inspect
+from typing import Callable, Optional
+
+_OOM_MARKERS = (
+    "RESOURCE_EXHAUSTED",
+    "Out of memory",
+    "out of memory",
+    "Attempting to allocate",
+    "Resource exhausted",
+    "exceeds the memory",
+    "OOM",
+)
+
+
+def should_reduce_batch_size(exception: Exception) -> bool:
+    """True when ``exception`` is an XLA/JAX out-of-memory condition (reference ``memory.py:100``)."""
+    msg = str(exception)
+    if type(exception).__name__ in ("XlaRuntimeError", "OutOfMemoryError"):
+        return any(m in msg for m in _OOM_MARKERS)
+    if isinstance(exception, (RuntimeError, MemoryError, ValueError)):
+        return any(m in msg for m in _OOM_MARKERS)
+    return False
+
+
+def clear_device_cache(garbage_collection: bool = False) -> None:
+    """Drop JAX's jitted-executable and dispatch caches (reference ``memory.py:43``).
+
+    On TPU there is no allocator cache to flush (XLA owns HBM for the process); what can be
+    released are live buffers (via GC of their Python references) and the traced-program caches.
+    """
+    if garbage_collection:
+        gc.collect()
+    try:
+        import jax
+
+        jax.clear_caches()
+    except Exception:  # pragma: no cover - jax always present in this image
+        pass
+
+
+def release_memory(*objects):
+    """Delete references and collect, returning ``None`` placeholders (reference ``memory.py:70``)."""
+    if not isinstance(objects, list):
+        objects = list(objects)
+    for i in range(len(objects)):
+        if hasattr(objects[i], "delete") and callable(getattr(objects[i], "delete")):
+            try:
+                objects[i].delete()  # jax.Array donation-style explicit free
+            except Exception:
+                pass
+        objects[i] = None
+    clear_device_cache(garbage_collection=True)
+    return objects
+
+
+def find_executable_batch_size(
+    function: Optional[Callable] = None,
+    starting_batch_size: int = 128,
+    reduce_batch_size_fn: Optional[Callable[[int], int]] = None,
+):
+    """Decorator: retry ``function(batch_size, ...)`` halving batch size on OOM.
+
+    Mirrors reference ``memory.py:120`` semantics: the wrapped function must accept
+    ``batch_size`` as its first argument; the decorator owns that argument and the caller must
+    not pass it. Raises the last error if batch size reaches 0.
+    """
+    if function is None:
+        return functools.partial(
+            find_executable_batch_size,
+            starting_batch_size=starting_batch_size,
+            reduce_batch_size_fn=reduce_batch_size_fn,
+        )
+
+    if reduce_batch_size_fn is None:
+        reduce_batch_size_fn = lambda bs: bs // 2  # noqa: E731
+
+    batch_size_box = {"value": starting_batch_size}
+
+    @functools.wraps(function)
+    def decorator(*args, **kwargs):
+        nonlocal batch_size_box
+        batch_size_box["value"] = starting_batch_size
+        clear_device_cache(garbage_collection=True)
+        params = list(inspect.signature(function).parameters.keys())
+        if len(params) < (len(args) + 1):
+            arg_str = ", ".join([f"{arg}={value}" for arg, value in zip(params[1:], args[1:])])
+            raise TypeError(
+                f"Batch size was passed into `{function.__name__}` as the first argument when called."
+                f"Remove this as the decorator already does so: `{function.__name__}({arg_str})`"
+            )
+        while True:
+            if batch_size_box["value"] == 0:
+                raise RuntimeError("No executable batch size found, reached zero.")
+            try:
+                return function(batch_size_box["value"], *args, **kwargs)
+            except Exception as e:
+                if should_reduce_batch_size(e):
+                    clear_device_cache(garbage_collection=True)
+                    batch_size_box["value"] = reduce_batch_size_fn(batch_size_box["value"])
+                else:
+                    raise
+
+    return decorator

@@ -1,0 +1,138 @@
+"""Miscellaneous helpers (reference ``utils/other.py``)."""
+
+from __future__ import annotations
+
+import os
+import pickle
+import platform
+import re
+import socket
+import warnings
+from contextlib import contextmanager
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from .operations import ConvertOutputsToFp32, is_tensor
+
+
+def _partial_state():
+    # Imported lazily: utils is imported by state.py itself (constants), so a module-level
+    # import of ..state would be circular.
+    from ..state import PartialState
+
+    return PartialState()
+
+
+def extract_model_from_parallel(model, keep_fp32_wrapper: bool = True, recursive: bool = False):
+    """Undo framework wrapping on a model callable (reference ``other.py:62``).
+
+    In the TPU-native design models are never mutated into DDP/FSDP wrappers — the only wrapping
+    applied is the fp32-output closure (:class:`ConvertOutputsToFp32`, the autocast analog).
+    """
+    while isinstance(model, ConvertOutputsToFp32) and not keep_fp32_wrapper:
+        model = model.model_forward
+    if not keep_fp32_wrapper and hasattr(model, "__wrapped__"):
+        model = model.__wrapped__
+    return model
+
+
+def wait_for_everyone():
+    """Cross-process barrier (reference ``other.py:136``)."""
+    _partial_state().wait_for_everyone()
+
+
+def _is_arrays_pytree(obj: Any) -> bool:
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(obj)
+    return len(leaves) > 0 and all(is_tensor(x) or isinstance(x, np.ndarray) for x in leaves)
+
+
+def save(obj, f, save_on_each_node: bool = False, safe_serialization: bool = True) -> None:
+    """Save ``obj`` once per node (or once globally) — reference ``other.py:186``.
+
+    Array pytrees go to safetensors (flattened ``a.b.c`` keys); anything else is pickled.
+    Writes are atomic: temp file + rename, so a preempted TPU worker never leaves a torn file.
+    """
+    state = _partial_state()
+    should_write = state.is_local_main_process if save_on_each_node else state.is_main_process
+    if should_write:
+        f = Path(f)
+        f.parent.mkdir(parents=True, exist_ok=True)
+        tmp = f.with_name(f.name + ".tmp")
+        if safe_serialization and _is_arrays_pytree(obj):
+            from .serialization import save_pytree_safetensors
+
+            save_pytree_safetensors(obj, tmp)
+        else:
+            with open(tmp, "wb") as fh:
+                pickle.dump(obj, fh)
+        os.replace(tmp, f)
+    state.wait_for_everyone()
+
+
+@contextmanager
+def clear_environment():
+    """Temporarily empty ``os.environ`` (reference ``environment.py:291``); re-exported here."""
+    from .environment import clear_environment as _ce
+
+    with _ce():
+        yield
+
+
+def get_pretty_name(obj) -> str:
+    """Best-effort display name for checkpoint registry entries (reference ``other.py:305``)."""
+    if not hasattr(obj, "__qualname__") and not hasattr(obj, "__name__"):
+        obj = getattr(obj, "__class__", obj)
+    if hasattr(obj, "__qualname__"):
+        return obj.__qualname__
+    if hasattr(obj, "__name__"):
+        return obj.__name__
+    return str(obj)
+
+
+def recursive_getattr(obj, attr: str):
+    """Dotted-path getattr (reference ``other.py:338``)."""
+
+    def _getattr(obj, attr):
+        return getattr(obj, attr)
+
+    import functools
+
+    return functools.reduce(_getattr, [obj] + attr.split("."))
+
+
+def check_os_kernel() -> None:
+    """Warn on Linux kernels < 5.5 with known multiprocess hangs (reference ``other.py:320``)."""
+    info = platform.uname()
+    if info.system != "Linux":
+        return
+    match = re.search(r"(\d+\.\d+\.\d+)", info.release)
+    if match is None:
+        return
+    version = tuple(int(v) for v in match.group(1).split("."))
+    if version < (5, 5, 0):
+        warnings.warn(
+            f"Detected kernel version {match.group(1)}, which is below the recommended minimum "
+            "of 5.5.0; this can cause the process to hang. It is recommended to upgrade the "
+            "kernel to the minimum version or higher.",
+            UserWarning,
+        )
+
+
+def convert_bytes(size: float) -> str:
+    """Human-readable byte size (reference ``modeling.py`` helper used by `estimate`)."""
+    for unit in ["B", "KB", "MB", "GB", "TB"]:
+        if size < 1024.0:
+            return f"{round(size, 2)} {unit}"
+        size /= 1024.0
+    return f"{round(size, 2)} PB"
+
+
+def get_free_port() -> int:
+    """Pick an unused TCP port for single-host rendezvous (launcher helper)."""
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]

@@ -1,0 +1,12 @@
+"""Rich traceback install (reference ``utils/rich.py``)."""
+
+from .imports import is_rich_available
+
+if is_rich_available():
+    from rich.traceback import install
+
+    install(show_locals=False)
+else:  # pragma: no cover - rich is an optional nicety
+    raise ModuleNotFoundError(
+        "To use the rich extension, install rich with `pip install rich`"
+    )

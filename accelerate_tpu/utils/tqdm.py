@@ -1,0 +1,23 @@
+"""Main-process-only progress bar (reference ``utils/tqdm.py``)."""
+
+from __future__ import annotations
+
+from ..state import PartialState
+from .imports import is_tqdm_available
+
+
+def tqdm(*args, main_process_only: bool = True, **kwargs):
+    """A ``tqdm.auto.tqdm`` that renders only on the main process (reference ``tqdm.py:18``)."""
+    if not is_tqdm_available():
+        raise ImportError("Accelerate's `tqdm` module requires `tqdm` to be installed.")
+    from tqdm.auto import tqdm as _tqdm
+
+    if len(args) > 0 and isinstance(args[0], bool):
+        raise ValueError(
+            "Passing `True`/`False` positionally is not supported; use the "
+            "`main_process_only` keyword argument instead."
+        )
+    disable = kwargs.pop("disable", False)
+    if main_process_only and not disable:
+        disable = PartialState().local_process_index != 0
+    return _tqdm(*args, **kwargs, disable=disable)

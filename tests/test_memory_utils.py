@@ -1,0 +1,108 @@
+"""Tests for utils.memory — reference analog ``tests/test_memory_utils.py``."""
+
+import pytest
+
+from accelerate_tpu.utils.memory import (
+    find_executable_batch_size,
+    release_memory,
+    should_reduce_batch_size,
+)
+
+
+def _oom():
+    raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory while trying to allocate 1234 bytes.")
+
+
+class TestFindExecutableBatchSize:
+    def test_base_case(self):
+        batch_sizes = []
+
+        @find_executable_batch_size(starting_batch_size=128)
+        def mock_training_loop_function(batch_size):
+            batch_sizes.append(batch_size)
+            if batch_size > 16:
+                _oom()
+            return batch_size
+
+        assert mock_training_loop_function() == 16
+        assert batch_sizes == [128, 64, 32, 16]
+
+    def test_with_args(self):
+        batch_sizes = []
+
+        @find_executable_batch_size(starting_batch_size=128)
+        def mock_training_loop_function(batch_size, arg1, arg2):
+            batch_sizes.append(batch_size)
+            if batch_size > 16:
+                _oom()
+            return batch_size, arg1, arg2
+
+        bs, a1, a2 = mock_training_loop_function("hello", "world")
+        assert bs == 16
+        assert (a1, a2) == ("hello", "world")
+
+    def test_start_zero(self):
+        @find_executable_batch_size(starting_batch_size=0)
+        def mock_training_loop_function(batch_size):
+            pass
+
+        with pytest.raises(RuntimeError, match="No executable batch size found"):
+            mock_training_loop_function()
+
+    def test_verbose_guard(self):
+        @find_executable_batch_size(starting_batch_size=16)
+        def mock_training_loop_function(batch_size):
+            pass
+
+        with pytest.raises(TypeError, match="as the first argument"):
+            mock_training_loop_function(128)
+
+    def test_non_oom_propagates(self):
+        @find_executable_batch_size(starting_batch_size=16)
+        def mock_training_loop_function(batch_size):
+            raise ValueError("totally unrelated")
+
+        with pytest.raises(ValueError, match="totally unrelated"):
+            mock_training_loop_function()
+
+    def test_custom_reduction(self):
+        batch_sizes = []
+
+        @find_executable_batch_size(starting_batch_size=81, reduce_batch_size_fn=lambda b: b // 3)
+        def fn(batch_size):
+            batch_sizes.append(batch_size)
+            if batch_size > 9:
+                _oom()
+            return batch_size
+
+        assert fn() == 9
+        assert batch_sizes == [81, 27, 9]
+
+
+def test_should_reduce_batch_size_detects_xla_oom():
+    assert should_reduce_batch_size(RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
+    assert should_reduce_batch_size(MemoryError("Out of memory"))
+    assert not should_reduce_batch_size(RuntimeError("shape mismatch"))
+    assert not should_reduce_batch_size(KeyError("x"))
+
+
+def test_release_memory():
+    import numpy as np
+
+    a, b = np.ones(4), np.ones(8)
+    a, b = release_memory(a, b)
+    assert a is None and b is None
+
+
+def test_real_jax_oom_is_detected():
+    """An actually-too-large allocation on the CPU backend raises a detectable OOM."""
+    import jax
+    import jax.numpy as jnp
+
+    try:
+        x = jnp.ones((1 << 46,), dtype=jnp.float32)  # 256 TiB
+        jax.block_until_ready(x)
+    except Exception as e:  # noqa: BLE001
+        assert should_reduce_batch_size(e), f"undetected OOM type: {type(e)}: {e}"
+    else:  # pragma: no cover
+        pytest.skip("backend somehow satisfied a 256TiB allocation")

@@ -1,0 +1,120 @@
+"""Tests for utils.other, serialization, tqdm, LocalSGD, and the profiler context."""
+
+import os
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from accelerate_tpu import Accelerator, LocalSGD
+from accelerate_tpu.utils.other import (
+    check_os_kernel,
+    convert_bytes,
+    extract_model_from_parallel,
+    get_pretty_name,
+    recursive_getattr,
+    save,
+)
+from accelerate_tpu.utils.operations import ConvertOutputsToFp32
+from accelerate_tpu.utils.serialization import (
+    flatten_pytree,
+    load_pytree_safetensors,
+    save_pytree_safetensors,
+    unflatten_to_nested_dict,
+)
+
+
+class TestOther:
+    def test_extract_model_unwraps_fp32_closure(self):
+        fn = lambda x: x  # noqa: E731
+        wrapped = ConvertOutputsToFp32(fn)
+        assert extract_model_from_parallel(wrapped, keep_fp32_wrapper=False) is fn
+        assert extract_model_from_parallel(wrapped, keep_fp32_wrapper=True) is wrapped
+
+    def test_save_pytree_safetensors_roundtrip(self, tmp_path):
+        tree = {"layer": {"w": jnp.ones((2, 3)), "b": jnp.zeros((3,))}}
+        save(tree, tmp_path / "model.safetensors")
+        loaded = load_pytree_safetensors(tmp_path / "model.safetensors")
+        np.testing.assert_allclose(np.asarray(loaded["layer"]["w"]), np.ones((2, 3)))
+
+    def test_save_pickle_fallback(self, tmp_path):
+        obj = {"a": 1, "b": "two"}
+        save(obj, tmp_path / "obj.bin", safe_serialization=False)
+        with open(tmp_path / "obj.bin", "rb") as f:
+            assert pickle.load(f) == obj
+
+    def test_bf16_roundtrip(self, tmp_path):
+        tree = {"w": jnp.ones((4,), dtype=jnp.bfloat16)}
+        save_pytree_safetensors(tree, tmp_path / "m.safetensors")
+        loaded = load_pytree_safetensors(tmp_path / "m.safetensors")
+        assert loaded["w"].dtype == jnp.bfloat16 or loaded["w"].dtype == np.float32
+
+    def test_flatten_unflatten(self):
+        tree = {"a": {"b": 1, "c": {"d": 2}}, "e": 3}
+        flat = {k: v for k, v in flatten_pytree(tree).items()}
+        assert set(flat) == {"a/b", "a/c/d", "e"}
+        assert unflatten_to_nested_dict(flat) == tree
+
+    def test_recursive_getattr(self):
+        class A:
+            pass
+
+        a = A()
+        a.b = A()
+        a.b.c = 7
+        assert recursive_getattr(a, "b.c") == 7
+
+    def test_get_pretty_name(self):
+        assert get_pretty_name(TestOther) == "TestOther"
+        assert "int" in get_pretty_name(3)
+
+    def test_convert_bytes(self):
+        assert convert_bytes(1024) == "1.0 KB"
+        assert convert_bytes(5) == "5 B"
+        assert convert_bytes(3 * 1024**3) == "3.0 GB"
+
+    def test_check_os_kernel_no_crash(self):
+        check_os_kernel()
+
+
+class TestTqdm:
+    def test_main_process_only(self):
+        from accelerate_tpu.utils.tqdm import tqdm
+
+        bar = tqdm(range(3))
+        assert bar.disable in (False, None)
+        bar.close()
+
+    def test_positional_bool_rejected(self):
+        from accelerate_tpu.utils.tqdm import tqdm
+
+        with pytest.raises(ValueError):
+            tqdm(True, range(3))
+
+
+class TestLocalSGD:
+    def test_noop_single_process(self):
+        acc = Accelerator(cpu=True)
+        params = {"w": jnp.ones((2,))}
+        with LocalSGD(accelerator=acc, local_sgd_steps=2) as lsgd:
+            out = lsgd.step(params)
+        assert out is params  # disabled on 1 process → passthrough
+
+
+class TestProfile:
+    def test_profile_writes_trace(self, tmp_path):
+        from accelerate_tpu.utils.dataclasses import ProfileKwargs
+
+        acc = Accelerator(cpu=True)
+        seen = {}
+        handler = ProfileKwargs(
+            output_trace_dir=str(tmp_path / "trace"),
+            on_trace_ready=lambda d: seen.setdefault("dir", d),
+        )
+        with acc.profile(handler):
+            x = jnp.ones((128, 128)) @ jnp.ones((128, 128))
+            x.block_until_ready()
+        assert seen["dir"] == str(tmp_path / "trace")
+        # jax.profiler.trace writes a plugins/profile/<ts>/ tree
+        assert any(os.scandir(tmp_path / "trace"))
