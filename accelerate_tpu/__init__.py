@@ -11,6 +11,14 @@ See SURVEY.md for the full blueprint and the reference-parity map.
 __version__ = "0.1.0"
 
 from .state import AcceleratorState, GradientState, PartialState
+from .big_modeling import (
+    cpu_offload,
+    disk_offload,
+    dispatch_model,
+    init_empty_weights,
+    init_on_device,
+    load_checkpoint_and_dispatch,
+)
 from .local_sgd import LocalSGD
 from .logging import get_logger
 from .utils.memory import find_executable_batch_size

@@ -212,31 +212,15 @@ def _abstractify(leaf):
 
 
 def _export_safetensors(params, file_path: Path) -> None:
-    """Consolidated (unsharded) safetensors export with flattened slash-joined keys."""
+    """Consolidated (unsharded) safetensors export, shared flattening convention
+    (``utils/serialization.py``)."""
     if not is_safetensors_available():
         logger.warning("safetensors unavailable; skipping interchange export")
         return
-    from safetensors.numpy import save_file
-
     from .parallel.fsdp import gather_full_params
+    from .utils.serialization import save_pytree_safetensors
 
-    flat = {}
-    host_params = gather_full_params(params)
-    for keypath, leaf in jax.tree_util.tree_flatten_with_path(host_params)[0]:
-        name = "/".join(_key_str(k) for k in keypath)
-        arr = np.asarray(leaf)
-        if arr.dtype.name == "bfloat16":  # ml_dtypes bf16 is not a safetensors-numpy dtype
-            arr = arr.astype(np.float32)
-        flat[name] = arr
-    save_file(flat, str(file_path))
-
-
-def _key_str(k) -> str:
-    if hasattr(k, "key"):
-        return str(k.key)
-    if hasattr(k, "idx"):
-        return str(k.idx)
-    return str(k)
+    save_pytree_safetensors(gather_full_params(params), file_path)
 
 
 def save_custom_state(obj, path: str, index: int = 0, save_on_each_node: bool = False) -> None:

@@ -8,9 +8,10 @@ TPU-native translation: inside one jitted GSPMD program over a global mesh the g
 all-reduce is inserted by XLA and is effectively free over ICI — there is nothing to skip.
 What local SGD buys on TPU pods is *skipping the DCN hop*: each host trains on its local
 devices (a host-local mesh / independent train state) and every ``local_sgd_steps`` steps the
-parameter pytrees are averaged across hosts over DCN. This class implements that contract: it
-counts steps and, at each boundary (and on exit), mean-reduces the provided train state's
-params across processes via the host-level collective layer (``utils.operations.reduce``).
+parameter pytrees are averaged across hosts over DCN. The averaging is a host-level collective
+on fully process-addressable leaves (device_get → byte all-gather → mean → device_put back with
+each leaf's original sharding), so it is correct for leaves that are sharded across the host's
+local devices — unlike a batch-style ``reduce``, which reinterprets the leading dim.
 
 On a single process (or when ``enabled=False``) every operation is a no-op, matching the
 reference's behavior under ``DistributedType.NO``.
@@ -18,10 +19,12 @@ reference's behavior under ``DistributedType.NO``.
 
 from __future__ import annotations
 
+import pickle
 from typing import Any, Optional
 
+import numpy as np
+
 from .state import PartialState
-from .utils.operations import reduce as _reduce
 
 
 class LocalSGD:
@@ -29,11 +32,15 @@ class LocalSGD:
 
     Usage::
 
-        with LocalSGD(accelerator=acc, state_getter=lambda: state,
-                      state_setter=new, local_sgd_steps=8) as local_sgd:
+        with LocalSGD(accelerator=acc, local_sgd_steps=8) as local_sgd:
             for batch in dl:
                 state, metrics = step(state, batch)
                 state = local_sgd.step(state)
+        state = local_sgd.final_state or state  # hosts end on identical parameters
+
+    The functional deviation from the reference's in-place API: ``step`` *returns* the
+    (possibly averaged) state, and the exit-time final sync is exposed as ``final_state``
+    (a context manager's ``__exit__`` cannot rebind the caller's variable).
     """
 
     def __init__(
@@ -48,6 +55,8 @@ class LocalSGD:
         self.num_steps = 0
         self.accelerator = accelerator
         self.model = model
+        self.final_state = None
+        self._last = None
         if self.enabled:
             self.local_sgd_steps = local_sgd_steps
 
@@ -57,15 +66,16 @@ class LocalSGD:
         return self
 
     def __exit__(self, type, value, tb):
-        if self.enabled:
+        if self.enabled and self._last is not None:
             # Ensure hosts end on identical parameters (reference ``local_sgd.py:58``).
-            self._last = self._sync_and_avg_model_params(self._last) if hasattr(self, "_last") else None
+            # Exposed as .final_state — callers carry it into their loop variable.
+            self.final_state = self._sync_and_avg_model_params(self._last)
 
     def step(self, state_or_params: Optional[Any] = None):
         """Count one optimizer step; average params across hosts at each boundary.
 
         Returns the (possibly averaged) state/params so the functional training loop can
-        carry it forward — the one deviation from the reference's in-place API.
+        carry it forward.
         """
         self.num_steps += 1
         if not self.enabled:
@@ -77,11 +87,45 @@ class LocalSGD:
             return out
         return state_or_params
 
+    def sync(self, state_or_params):
+        """Force a cross-host parameter average now (explicit final-sync helper)."""
+        out = self._sync_and_avg_model_params(state_or_params)
+        self._last = out
+        return out
+
     def _sync_and_avg_model_params(self, state_or_params):
         """Mean of the parameter pytree across processes (reference ``local_sgd.py:102``)."""
-        if state_or_params is None:
-            return None
+        if state_or_params is None or not self.enabled:
+            return state_or_params
         if hasattr(state_or_params, "params") and hasattr(state_or_params, "replace"):
-            averaged = _reduce(state_or_params.params, reduction="mean")
+            averaged = _mean_params_across_processes(state_or_params.params)
             return state_or_params.replace(params=averaged)
-        return _reduce(state_or_params, reduction="mean")
+        return _mean_params_across_processes(state_or_params)
+
+
+def _mean_params_across_processes(params):
+    """Sharding-preserving cross-process mean of a parameter pytree.
+
+    Each leaf is pulled to host (host-local meshes are fully addressable per process),
+    byte-all-gathered over the process-level collective layer, averaged in fp32, and put back
+    with the leaf's original sharding.
+    """
+    import jax
+
+    from .utils.operations import _allgather_bytes
+
+    def _avg(leaf):
+        if not hasattr(leaf, "shape"):
+            return leaf
+        arr = np.asarray(jax.device_get(leaf))
+        gathered = [pickle.loads(p) for p in _allgather_bytes(pickle.dumps(arr))]
+        if len(gathered) == 1:
+            return leaf
+        mean = np.mean(
+            np.stack([a.astype(np.float32) for a in gathered]), axis=0
+        ).astype(arr.dtype)
+        if isinstance(leaf, jax.Array):
+            return jax.device_put(mean, leaf.sharding)
+        return mean
+
+    return jax.tree_util.tree_map(_avg, params)

@@ -27,7 +27,15 @@ from jax.sharding import PartitionSpec as P
 
 from ..utils.constants import BATCH_AXES, SEQUENCE_AXIS, TENSOR_AXIS
 
-__all__ = ["LlamaConfig", "init_params", "forward", "loss_fn", "partition_specs", "CONFIGS"]
+__all__ = [
+    "LlamaConfig",
+    "init_params",
+    "forward",
+    "forward_streamed",
+    "loss_fn",
+    "partition_specs",
+    "CONFIGS",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -295,6 +303,56 @@ def loss_fn(
         mask = batch["mask"][:, 1:].astype(jnp.float32)
         return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
     return -jnp.mean(ll)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _block_jit(x, layer, positions, mask, cfg):
+    return _block(x, layer, positions, mask, cfg)
+
+
+def _jitted_block(cfg: LlamaConfig):
+    """Stable-identity jitted block so repeated forward_streamed calls reuse the compile cache
+    (LlamaConfig is frozen/hashable → one compilation per config/shape)."""
+    return partial(_block_jit, cfg=cfg)
+
+
+def forward_streamed(
+    dispatched,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    positions: Optional[jax.Array] = None,
+    prefetch: int = 2,
+) -> jax.Array:
+    """Big-model inference forward: block weights streamed from host RAM / disk.
+
+    The L6 path (``big_modeling.dispatch_model`` + ``stream_blocks``): runs a model whose
+    params exceed HBM by fetching one transformer block at a time onto the main device, with a
+    background thread prefetching the next block while the current one computes. Equivalent in
+    role to the reference's ``AlignDevicesHook`` forward (``hooks.py:329``), functional instead
+    of module-patching. Requires ``cfg.scan_layers=False`` (blocks addressed as ``layers/<i>``).
+    """
+    from ..big_modeling import stream_blocks
+
+    if cfg.scan_layers:
+        raise ValueError("forward_streamed requires per-layer (non-scanned) params.")
+    B, S = tokens.shape
+    dtype = cfg.dtype
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
+
+    block_fn = _jitted_block(cfg)
+
+    embed = dispatched.fetch("embed")
+    x = embed.astype(dtype)[tokens]
+    prefixes = [f"layers/{i}" for i in range(cfg.n_layers)]
+    for _, layer in stream_blocks(dispatched, prefixes, prefetch=prefetch):
+        x = block_fn(x, layer, positions, mask)
+    ln_f = dispatched.fetch("ln_f")
+    x = _rms_norm(x, ln_f, cfg.norm_eps)
+    head = embed.T if cfg.tie_embeddings else dispatched.fetch("lm_head")
+    logits = x @ head.astype(dtype)
+    return logits.astype(jnp.float32)
 
 
 def num_params(cfg: LlamaConfig) -> int:

@@ -13,6 +13,7 @@ from __future__ import annotations
 import functools
 import gc
 import inspect
+import re
 from typing import Callable, Optional
 
 _OOM_MARKERS = (
@@ -22,17 +23,23 @@ _OOM_MARKERS = (
     "Attempting to allocate",
     "Resource exhausted",
     "exceeds the memory",
-    "OOM",
 )
+# "OOM" only as a standalone word — a bare substring match would swallow unrelated errors
+# mentioning e.g. "BLOOM" or "ZOOM".
+_OOM_WORD = re.compile(r"\bOOM\b")
+
+
+def _is_oom_message(msg: str) -> bool:
+    return any(m in msg for m in _OOM_MARKERS) or _OOM_WORD.search(msg) is not None
 
 
 def should_reduce_batch_size(exception: Exception) -> bool:
     """True when ``exception`` is an XLA/JAX out-of-memory condition (reference ``memory.py:100``)."""
     msg = str(exception)
     if type(exception).__name__ in ("XlaRuntimeError", "OutOfMemoryError"):
-        return any(m in msg for m in _OOM_MARKERS)
+        return _is_oom_message(msg)
     if isinstance(exception, (RuntimeError, MemoryError, ValueError)):
-        return any(m in msg for m in _OOM_MARKERS)
+        return _is_oom_message(msg)
     return False
 
 

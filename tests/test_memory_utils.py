@@ -82,8 +82,12 @@ class TestFindExecutableBatchSize:
 def test_should_reduce_batch_size_detects_xla_oom():
     assert should_reduce_batch_size(RuntimeError("RESOURCE_EXHAUSTED: out of memory"))
     assert should_reduce_batch_size(MemoryError("Out of memory"))
+    assert should_reduce_batch_size(RuntimeError("OOM while allocating tensor"))
     assert not should_reduce_batch_size(RuntimeError("shape mismatch"))
     assert not should_reduce_batch_size(KeyError("x"))
+    # "OOM" must match as a word, not a substring of unrelated identifiers.
+    assert not should_reduce_batch_size(RuntimeError("error in BLOOM tokenizer config"))
+    assert not should_reduce_batch_size(ValueError("ZOOM factor invalid"))
 
 
 def test_release_memory():
