@@ -1,0 +1,5 @@
+"""``python -m accelerate_tpu <command>`` → the CLI root (no install needed)."""
+
+from .commands.accelerate_cli import main
+
+raise SystemExit(main())

@@ -212,7 +212,7 @@ class Accelerator:
         device_placement: bool = True,
         split_batches: bool = False,
         mixed_precision: Optional[str] = None,
-        gradient_accumulation_steps: int = 1,
+        gradient_accumulation_steps: Optional[int] = None,
         cpu: bool = False,
         dataloader_config: Optional[DataLoaderConfiguration] = None,
         mesh_config: Optional[MeshConfig] = None,
@@ -252,9 +252,10 @@ class Accelerator:
         )
 
         if gradient_accumulation_plugin is None:
-            env_steps = int(os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", "-1"))
-            if env_steps > 0:
-                gradient_accumulation_steps = env_steps
+            # Priority: explicit Python arg (any int, including 1) > env wire protocol > 1.
+            if gradient_accumulation_steps is None:
+                env_steps = int(os.environ.get("ACCELERATE_GRADIENT_ACCUMULATION_STEPS", "-1"))
+                gradient_accumulation_steps = env_steps if env_steps > 0 else 1
             gradient_accumulation_plugin = GradientAccumulationPlugin(
                 num_steps=gradient_accumulation_steps
             )

@@ -279,6 +279,8 @@ def load_checkpoint_and_dispatch(
     destined for ``"disk"`` flow checkpoint→memmap without a device hop.
     """
     if isinstance(device_map, str):
+        if device_map not in ("auto", "balanced", "balanced_low_0", "sequential"):
+            raise ValueError(f"Unknown device_map policy {device_map!r}")
         if device_map.startswith("balanced"):
             max_memory = get_balanced_memory(
                 abstract_tree, max_memory, low_zero=device_map.endswith("low_0")

@@ -1,0 +1,4 @@
+"""CLI layer (L9): ``accelerate-tpu {config,env,launch,estimate-memory,merge-weights,test,tpu-config}``.
+
+Reference analog: ``commands/`` (/root/reference/src/accelerate/commands/accelerate_cli.py:27-48).
+"""

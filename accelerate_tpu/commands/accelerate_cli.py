@@ -1,0 +1,46 @@
+"""CLI root: the ``accelerate-tpu`` console entry (reference ``commands/accelerate_cli.py:27-48``)."""
+
+from __future__ import annotations
+
+import argparse
+
+from .config import config_command_parser
+from .env import env_command_parser
+from .estimate import estimate_command_parser
+from .launch import launch_command_parser
+from .merge import merge_command_parser
+from .test import test_command_parser
+from .tpu import tpu_command_parser
+
+__all__ = ["main", "get_parser"]
+
+
+def get_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        "accelerate-tpu",
+        usage="accelerate-tpu <command> [<args>]",
+        allow_abbrev=False,
+    )
+    subparsers = parser.add_subparsers(help="accelerate-tpu command helpers", dest="command")
+    config_command_parser(subparsers=subparsers)
+    env_command_parser(subparsers=subparsers)
+    estimate_command_parser(subparsers=subparsers)
+    launch_command_parser(subparsers=subparsers)
+    merge_command_parser(subparsers=subparsers)
+    test_command_parser(subparsers=subparsers)
+    tpu_command_parser(subparsers=subparsers)
+    return parser
+
+
+def main(argv=None) -> int:
+    parser = get_parser()
+    args = parser.parse_args(argv)
+    if not hasattr(args, "func"):
+        parser.print_help()
+        return 1
+    result = args.func(args)
+    return result if isinstance(result, int) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

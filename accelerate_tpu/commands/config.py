@@ -1,0 +1,169 @@
+"""``accelerate-tpu config`` — questionnaire → YAML default config.
+
+TPU-native analog of reference ``commands/config/`` (cluster.py's prompt tree, config_args.py's
+dataclass config objects with yaml/json IO, default path at
+``~/.cache/huggingface/accelerate/default_config.yaml`` — reference ``config_args.py:30-40``).
+
+The config file feeds ``accelerate-tpu launch`` defaults, which serializes it into the
+``ACCELERATE_*`` env wire protocol (``utils/launch.py``). Interactive mode asks a compact
+question tree (machines, processes, mesh axes, precision); ``config default`` writes sane
+defaults non-interactively; ``config update`` rewrites an old file with current fields.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Optional
+
+__all__ = [
+    "ClusterConfig",
+    "default_config_file",
+    "load_config_from_file",
+    "save_config",
+    "config_command",
+    "config_command_parser",
+]
+
+cache_dir = os.environ.get(
+    "ACCELERATE_TPU_CACHE", os.path.join(os.path.expanduser("~"), ".cache", "accelerate_tpu")
+)
+default_yaml_config_file = os.path.join(cache_dir, "default_config.yaml")
+default_json_config_file = os.path.join(cache_dir, "default_config.json")
+
+
+def default_config_file() -> str:
+    return default_yaml_config_file if not os.path.isfile(default_json_config_file) else default_json_config_file
+
+
+@dataclass
+class ClusterConfig:
+    """The whole launch-relevant configuration (reference ``config_args.py`` ClusterConfig).
+
+    ``num_processes`` counts host processes (one per TPU VM host); per-chip parallelism is the
+    mesh axes. ``-1`` on a mesh axis means fill-remaining (``MeshConfig`` semantics).
+    """
+
+    compute_environment: str = "LOCAL_MACHINE"  # or TPU_POD
+    distributed_type: str = "NO"  # NO | MULTI_DEVICE | MULTI_HOST
+    num_machines: int = 1
+    num_processes: int = 1
+    machine_rank: int = 0
+    main_process_ip: Optional[str] = None
+    main_process_port: Optional[int] = None
+    mixed_precision: str = "no"  # no | bf16 | fp16 | fp8
+    use_cpu: bool = False
+    debug: bool = False
+    # Mesh axes (chip parallelism).
+    dp: int = -1
+    fsdp: int = 1
+    tp: int = 1
+    sp: int = 1
+    pp: int = 1
+    ep: int = 1
+    # FSDP/ZeRO.
+    fsdp_zero_stage: int = 0
+    # Gradient accumulation.
+    gradient_accumulation_steps: int = 1
+    # Pod fan-out (tpu-config / multi-host launch).
+    tpu_name: Optional[str] = None
+    tpu_zone: Optional[str] = None
+
+    def to_dict(self) -> dict:
+        out = dataclasses.asdict(self)
+        return {k: v for k, v in out.items() if v is not None}
+
+    def save(self, path: Optional[str] = None) -> str:
+        return save_config(self, path)
+
+
+def save_config(config: ClusterConfig, path: Optional[str] = None) -> str:
+    path = path or default_yaml_config_file
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    data = config.to_dict()
+    if str(path).endswith(".json"):
+        Path(path).write_text(json.dumps(data, indent=2) + "\n")
+    else:
+        import yaml
+
+        Path(path).write_text(yaml.safe_dump(data, sort_keys=False))
+    return str(path)
+
+
+def load_config_from_file(path: Optional[str] = None) -> ClusterConfig:
+    path = path or default_config_file()
+    if not os.path.isfile(path):
+        raise FileNotFoundError(
+            f"No config file at {path}. Run `accelerate-tpu config` first or pass flags explicitly."
+        )
+    text = Path(path).read_text()
+    if str(path).endswith(".json"):
+        data = json.loads(text)
+    else:
+        import yaml
+
+        data = yaml.safe_load(text)
+    known = {f.name for f in dataclasses.fields(ClusterConfig)}
+    return ClusterConfig(**{k: v for k, v in (data or {}).items() if k in known})
+
+
+def _ask(prompt: str, default, cast=str):
+    raw = input(f"{prompt} [{default}]: ").strip()  # noqa: S322 - interactive CLI
+    if not raw:
+        return default
+    if cast is bool:
+        return raw.lower() in ("1", "true", "yes", "y")
+    return cast(raw)
+
+
+def _interactive_config() -> ClusterConfig:
+    """Compact prompt tree (reference ``commands/config/cluster.py`` questionnaire)."""
+    cfg = ClusterConfig()
+    cfg.compute_environment = _ask("Compute environment (LOCAL_MACHINE/TPU_POD)", "LOCAL_MACHINE")
+    cfg.num_machines = _ask("How many machines (TPU hosts)?", 1, int)
+    if cfg.num_machines > 1:
+        cfg.machine_rank = _ask("Rank of this machine", 0, int)
+        cfg.main_process_ip = _ask("Coordinator (rank-0) IP", "127.0.0.1")
+        cfg.main_process_port = _ask("Coordinator port", 29500, int)
+    cfg.num_processes = _ask("Total host processes", cfg.num_machines, int)
+    cfg.mixed_precision = _ask("Mixed precision (no/bf16/fp16/fp8)", "bf16")
+    cfg.fsdp_zero_stage = _ask("ZeRO/FSDP stage (0=off, 1/2/3)", 0, int)
+    if cfg.fsdp_zero_stage > 0:
+        cfg.fsdp = _ask("fsdp axis size (-1 = all devices)", -1, int)
+        cfg.dp = 1
+    cfg.tp = _ask("Tensor-parallel degree", 1, int)
+    cfg.sp = _ask("Sequence-parallel degree", 1, int)
+    cfg.pp = _ask("Pipeline-parallel degree", 1, int)
+    cfg.gradient_accumulation_steps = _ask("Gradient accumulation steps", 1, int)
+    if cfg.num_machines > 1:
+        cfg.distributed_type = "MULTI_HOST"
+    return cfg
+
+
+def config_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Create the default config file for accelerate-tpu launch."
+    if subparsers is not None:
+        parser = subparsers.add_parser("config", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu config", description=description)
+    parser.add_argument("subcommand", nargs="?", choices=[None, "default", "update"], default=None)
+    parser.add_argument("--config_file", default=None, help="Where to write the YAML/JSON config.")
+    if subparsers is not None:
+        parser.set_defaults(func=config_command)
+    return parser
+
+
+def config_command(args) -> str:
+    if args.subcommand == "default":
+        cfg = ClusterConfig(mixed_precision="bf16")
+    elif args.subcommand == "update":
+        cfg = load_config_from_file(args.config_file)
+    else:
+        cfg = _interactive_config()
+    path = save_config(cfg, args.config_file)
+    print(f"accelerate-tpu configuration saved at {path}")
+    return path

@@ -1,0 +1,67 @@
+"""``accelerate-tpu env`` — environment report (reference ``commands/env.py``)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import platform
+
+__all__ = ["env_command", "env_command_parser"]
+
+
+def env_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Print the accelerate-tpu environment report (attach to bug reports)."
+    if subparsers is not None:
+        parser = subparsers.add_parser("env", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu env", description=description)
+    parser.add_argument("--config_file", default=None)
+    if subparsers is not None:
+        parser.set_defaults(func=env_command)
+    return parser
+
+
+def env_command(args) -> dict:
+    import jax
+
+    import accelerate_tpu
+
+    info = {
+        "accelerate_tpu version": accelerate_tpu.__version__,
+        "Platform": platform.platform(),
+        "Python version": platform.python_version(),
+        "jax version": jax.__version__,
+        "Backend": jax.default_backend(),
+        "Device count": jax.device_count(),
+        "Process count": jax.process_count(),
+        "Devices": ", ".join(str(d) for d in jax.local_devices()[:8]),
+    }
+    try:
+        import flax
+
+        info["flax version"] = flax.__version__
+    except ImportError:
+        pass
+    try:
+        import optax
+
+        info["optax version"] = optax.__version__
+    except ImportError:
+        pass
+    accelerate_env = {k: v for k, v in os.environ.items() if k.startswith("ACCELERATE_")}
+    info["ACCELERATE_* env"] = accelerate_env or "not set"
+
+    from .config import default_config_file
+
+    path = args.config_file or default_config_file()
+    if os.path.isfile(path):
+        from .config import load_config_from_file
+
+        info["Default config"] = load_config_from_file(path).to_dict()
+    else:
+        info["Default config"] = "not found"
+
+    print("\nCopy-and-paste the text below in your GitHub issue\n")
+    for key, value in info.items():
+        print(f"- `{key}`: {value}")
+    return info

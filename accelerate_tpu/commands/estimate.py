@@ -1,0 +1,122 @@
+"""``accelerate-tpu estimate-memory`` — per-dtype model memory table.
+
+TPU-native analog of reference ``commands/estimate.py`` (:288 ``estimate_command``): load a
+model *abstractly* (zero bytes — ``jax.eval_shape``, the meta-device analog) and print its
+total / largest-layer / per-dtype sizes plus an Adam-training estimate.
+
+Sources: the framework's model registry (``accelerate_tpu.models``: llama CONFIGS names), or —
+when ``transformers`` is importable — a Hub model id resolved through its config (params counted
+analytically, nothing downloaded but the config json).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from ..utils.modeling import calculate_maximum_sizes
+from ..utils.other import convert_bytes
+
+__all__ = ["estimate_command", "estimate_command_parser", "gather_data"]
+
+_DTYPE_BYTES = {"float32": 4, "bfloat16": 2, "float16": 2, "int8": 1, "int4": 0.5}
+
+
+def estimate_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Estimate memory to load/train a model, per dtype."
+    if subparsers is not None:
+        parser = subparsers.add_parser("estimate-memory", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu estimate-memory", description=description)
+    parser.add_argument("model_name", help="Registry name (e.g. llama3-8b) or HF Hub id.")
+    parser.add_argument(
+        "--dtypes", nargs="+", default=["float32", "bfloat16", "int8", "int4"],
+        choices=list(_DTYPE_BYTES),
+    )
+    parser.add_argument("--json", action="store_true", dest="as_json", help="Print JSON instead of a table.")
+    if subparsers is not None:
+        parser.set_defaults(func=estimate_command)
+    return parser
+
+
+def _registry_model_sizes(name: str):
+    """(total_bytes_fp32, largest_layer_bytes_fp32) from the in-repo model registry."""
+    from ..models import llama
+
+    if name in llama.CONFIGS:
+        import jax
+
+        from ..big_modeling import init_empty_weights
+
+        cfg = llama.CONFIGS[name]
+        abstract = init_empty_weights(llama.init_params, cfg, jax.random.PRNGKey(0))
+        total, (largest, _) = calculate_maximum_sizes(abstract)
+        return total, largest
+    return None
+
+
+def _hub_model_sizes(name: str):
+    try:
+        from transformers import AutoConfig
+    except ImportError:
+        return None
+    try:
+        config = AutoConfig.from_pretrained(name, trust_remote_code=False)
+    except Exception:
+        return None
+    # Analytic decoder-LM parameter count from common config fields.
+    d = getattr(config, "hidden_size", None)
+    L = getattr(config, "num_hidden_layers", None)
+    V = getattr(config, "vocab_size", None)
+    if not (d and L and V):
+        return None
+    ff = getattr(config, "intermediate_size", 4 * d)
+    heads = getattr(config, "num_attention_heads", 1) or 1
+    kv = getattr(config, "num_key_value_heads", heads) or heads
+    hd = d // heads
+    per_layer = d * heads * hd + 2 * d * kv * hd + heads * hd * d + 3 * d * ff + 2 * d
+    total_params = V * d * 2 + L * per_layer + d
+    return total_params * 4, max(V * d, per_layer) * 4
+
+
+def gather_data(args) -> list[list]:
+    sizes = _registry_model_sizes(args.model_name) or _hub_model_sizes(args.model_name)
+    if sizes is None:
+        raise ValueError(
+            f"Could not resolve {args.model_name!r}: not in the model registry and not an "
+            "accessible transformers config."
+        )
+    total_fp32, largest_fp32 = sizes
+    rows = []
+    for dtype in args.dtypes:
+        scale = _DTYPE_BYTES[dtype] / 4
+        total = int(total_fp32 * scale)
+        largest = int(largest_fp32 * scale)
+        # Adam training: params + grads + 2 fp32 moments (+ fp32 master when low-precision).
+        training = int(total * (4 if dtype == "float32" else 6))
+        rows.append([dtype, largest, total, training])
+    return rows
+
+
+def estimate_command(args) -> list[list]:
+    rows = gather_data(args)
+    if args.as_json:
+        print(json.dumps([
+            {
+                "dtype": r[0],
+                "largest_layer": r[1],
+                "total_size": r[2],
+                "training_with_adam": r[3],
+            }
+            for r in rows
+        ]))
+        return rows
+    headers = ["dtype", "Largest Layer", "Total Size", "Training w/ Adam"]
+    widths = [max(len(h), 12) for h in headers]
+    print(f"Memory Usage for loading `{args.model_name}`:")
+    print(" | ".join(h.ljust(w) for h, w in zip(headers, widths)))
+    print("-+-".join("-" * w for w in widths))
+    for r in rows:
+        cells = [r[0], convert_bytes(r[1]), convert_bytes(r[2]), convert_bytes(r[3])]
+        print(" | ".join(str(c).ljust(w) for c, w in zip(cells, widths)))
+    return rows

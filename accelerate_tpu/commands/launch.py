@@ -1,0 +1,249 @@
+"""``accelerate-tpu launch`` — run a training script with the serialized env protocol.
+
+TPU-native analog of reference ``commands/launch.py`` (launch_command_parser :142,
+launch_command :1169, simple_launcher :773, multi_gpu_launcher :785, tpu_pod_launcher :909,
+_validate_launch_command :988).
+
+Dispatch modes:
+- **simple** (default): one process, env-serialized flags, ``subprocess`` exec. On a TPU VM
+  this one process drives every local chip through the mesh — the common case.
+- **multi-process** (``--num-processes N --multi-process``): N local processes doing a JAX
+  distributed rendezvous over a localhost coordinator (the faithful multi-*host* simulation,
+  and the actual per-host entry on pods when an external agent starts one process per host).
+- **pod fan-out** (``--tpu-pod``): ssh each worker of a GCE TPU pod and re-invoke
+  ``accelerate-tpu launch`` there with per-host rank env (reference ``tpu_pod_launcher``).
+  ``--dry-run`` prints the per-host commands instead of executing.
+
+There is no torchrun analog to shell out to: restart/elastic supervision is the launcher's own
+``--max-restarts`` loop around the child process group.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+from typing import Optional
+
+from ..utils.launch import (
+    prepare_multi_process_env,
+    prepare_simple_launcher_cmd_env,
+)
+from .config import ClusterConfig, default_config_file, load_config_from_file
+
+__all__ = ["launch_command", "launch_command_parser"]
+
+
+def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Launch a script on TPU (or the CPU simulator) with accelerate-tpu."
+    if subparsers is not None:
+        parser = subparsers.add_parser("launch", description=description, add_help=True)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu launch", description=description)
+
+    hw = parser.add_argument_group("Hardware selection")
+    hw.add_argument("--cpu", "--use_cpu", dest="cpu", action="store_true", help="Force CPU backend.")
+    hw.add_argument(
+        "--num-virtual-devices", "--num_virtual_devices", type=int, default=None,
+        help="CPU simulator: XLA virtual device count (sets JAX_PLATFORMS=cpu).",
+    )
+
+    res = parser.add_argument_group("Resource selection")
+    res.add_argument("--num-processes", "--num_processes", type=int, default=None,
+                     help="Total host processes (1 per TPU VM host).")
+    res.add_argument("--num-machines", "--num_machines", type=int, default=None)
+    res.add_argument("--machine-rank", "--machine_rank", type=int, default=None)
+    res.add_argument("--main-process-ip", "--main_process_ip", default=None)
+    res.add_argument("--main-process-port", "--main_process_port", type=int, default=None)
+    res.add_argument("--multi-process", "--multi_process", action="store_true",
+                     help="Spawn --num-processes local processes with a JAX distributed rendezvous.")
+    res.add_argument("--max-restarts", "--max_restarts", type=int, default=0,
+                     help="Elastic supervision: restart the (local) launch this many times on failure.")
+
+    mesh = parser.add_argument_group("Mesh / parallelism (chip axes)")
+    for axis, doc in (
+        ("dp", "data"), ("fsdp", "ZeRO/FSDP"), ("tp", "tensor"),
+        ("sp", "sequence"), ("pp", "pipeline"), ("ep", "expert"),
+    ):
+        mesh.add_argument(f"--{axis}", type=int, default=None, help=f"{doc}-parallel degree.")
+    mesh.add_argument("--use-fsdp", "--use_fsdp", action="store_true")
+    mesh.add_argument("--fsdp-zero-stage", "--fsdp_zero_stage", type=int, default=None)
+
+    train = parser.add_argument_group("Training")
+    train.add_argument("--mixed-precision", "--mixed_precision", default=None,
+                       choices=[None, "no", "bf16", "fp16", "fp8"])
+    train.add_argument("--gradient-accumulation-steps", "--gradient_accumulation_steps",
+                       type=int, default=None)
+    train.add_argument("--debug", action="store_true", help="Enable collective shape verification.")
+
+    pod = parser.add_argument_group("TPU pod")
+    pod.add_argument("--tpu-pod", "--tpu_pod", action="store_true", help="ssh fan-out to pod workers.")
+    pod.add_argument("--tpu-name", "--tpu_name", default=None)
+    pod.add_argument("--tpu-zone", "--tpu_zone", default=None)
+    pod.add_argument("--dry-run", "--dry_run", action="store_true",
+                     help="Print the commands/env instead of executing.")
+
+    parser.add_argument("--config-file", "--config_file", default=None)
+    parser.add_argument("-m", "--module", action="store_true",
+                        help="Interpret training_script as a python module (python -m).")
+    parser.add_argument("--no-python", "--no_python", action="store_true",
+                        help="Run training_script directly (it has a shebang).")
+    parser.add_argument("training_script", help="Script (or module) to launch.")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER, default=[])
+    if subparsers is not None:
+        parser.set_defaults(func=launch_command)
+    return parser
+
+
+def _apply_config_defaults(args) -> None:
+    """YAML defaults < CLI flags (reference ``_validate_launch_command`` merge order)."""
+    path = args.config_file or (default_config_file() if os.path.isfile(default_config_file()) else None)
+    if path is None:
+        return
+    cfg: ClusterConfig = load_config_from_file(path)
+    defaults = {
+        "num_processes": cfg.num_processes,
+        "num_machines": cfg.num_machines,
+        "machine_rank": cfg.machine_rank,
+        "main_process_ip": cfg.main_process_ip,
+        "main_process_port": cfg.main_process_port,
+        "mixed_precision": None if cfg.mixed_precision == "no" else cfg.mixed_precision,
+        # 1 is the neutral default — don't serialize it into the child env, where it would
+        # shadow the script's own explicit gradient_accumulation_steps argument.
+        "gradient_accumulation_steps": cfg.gradient_accumulation_steps
+        if cfg.gradient_accumulation_steps != 1
+        else None,
+        "fsdp_zero_stage": cfg.fsdp_zero_stage or None,
+        "dp": cfg.dp if cfg.dp != -1 else None,
+        "fsdp": cfg.fsdp if cfg.fsdp != 1 else None,
+        "tp": cfg.tp if cfg.tp != 1 else None,
+        "sp": cfg.sp if cfg.sp != 1 else None,
+        "pp": cfg.pp if cfg.pp != 1 else None,
+        "ep": cfg.ep if cfg.ep != 1 else None,
+        "tpu_name": cfg.tpu_name,
+        "tpu_zone": cfg.tpu_zone,
+    }
+    for key, value in defaults.items():
+        if getattr(args, key, None) in (None, 0) and value is not None:
+            setattr(args, key, value)
+    if cfg.use_cpu:
+        args.cpu = True
+    if cfg.debug:
+        args.debug = True
+
+
+def simple_launcher(args) -> int:
+    """One-process exec (reference ``simple_launcher`` :773)."""
+    cmd, env = prepare_simple_launcher_cmd_env(args)
+    if args.dry_run:
+        _print_plan([(cmd, {k: v for k, v in env.items() if k.startswith(("ACCELERATE_", "XLA_", "JAX_"))})])
+        return 0
+    attempts = args.max_restarts + 1
+    for attempt in range(attempts):
+        proc = subprocess.run(cmd, env=env)
+        if proc.returncode == 0:
+            return 0
+        if attempt < attempts - 1:
+            print(f"[accelerate-tpu] child exited {proc.returncode}; restart {attempt + 1}/{args.max_restarts}")
+            time.sleep(1.0)
+    if proc.returncode != 0:
+        raise subprocess.CalledProcessError(returncode=proc.returncode, cmd=cmd)
+    return proc.returncode
+
+
+def multi_process_launcher(args) -> int:
+    """Spawn N local processes with a shared JAX coordinator (multi-host semantics)."""
+    num = int(args.num_processes or 1)
+    cmd, _ = prepare_simple_launcher_cmd_env(args)
+    plans = []
+    for pid in range(num):
+        env = prepare_multi_process_env(args, process_id=pid, num_processes=num)
+        plans.append((cmd, {k: v for k, v in env.items() if k.startswith(("ACCELERATE_", "XLA_", "JAX_"))}))
+    if args.dry_run:
+        _print_plan(plans)
+        return 0
+    attempts = args.max_restarts + 1
+    for attempt in range(attempts):
+        procs = []
+        for pid in range(num):
+            env = prepare_multi_process_env(args, process_id=pid, num_processes=num)
+            procs.append(subprocess.Popen(cmd, env=env))
+        codes = [p.wait() for p in procs]
+        if all(c == 0 for c in codes):
+            return 0
+        if attempt < attempts - 1:
+            print(f"[accelerate-tpu] exit codes {codes}; restart {attempt + 1}/{args.max_restarts}")
+            time.sleep(1.0)
+    raise subprocess.CalledProcessError(returncode=max(codes), cmd=cmd)
+
+
+def tpu_pod_launcher(args) -> int:
+    """ssh each pod worker and re-invoke ``accelerate-tpu launch`` with per-host rank env.
+
+    Reference analog: ``tpu_pod_launcher`` (``commands/launch.py:909``) driving
+    ``gcloud compute tpus tpu-vm ssh --worker=all``. We build the same fan-out; ``--dry-run``
+    prints it (CI has no gcloud).
+    """
+    if not args.tpu_name:
+        raise ValueError("--tpu-pod requires --tpu-name (and usually --tpu-zone).")
+    num_hosts = int(args.num_machines or args.num_processes or 1)
+    inner_flags = []
+    if args.mixed_precision:
+        inner_flags += ["--mixed-precision", args.mixed_precision]
+    for axis in ("dp", "fsdp", "tp", "sp", "pp", "ep"):
+        v = getattr(args, axis, None)
+        if v is not None:
+            inner_flags += [f"--{axis}", str(v)]
+    plans = []
+    for rank in range(num_hosts):
+        inner = (
+            f"ACCELERATE_COORDINATOR_ADDRESS={args.main_process_ip or '$(hostname -i)'}:"
+            f"{args.main_process_port or 29500} "
+            f"ACCELERATE_NUM_PROCESSES={num_hosts} ACCELERATE_PROCESS_ID={rank} "
+            f"accelerate-tpu launch {' '.join(inner_flags)} {args.training_script} "
+            + " ".join(args.training_script_args or [])
+        )
+        cmd = [
+            "gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu_name,
+            f"--worker={rank}",
+            *(["--zone", args.tpu_zone] if args.tpu_zone else []),
+            "--command", inner.strip(),
+        ]
+        plans.append((cmd, {}))
+    if args.dry_run:
+        _print_plan(plans)
+        return 0
+    procs = [subprocess.Popen(cmd) for cmd, _ in plans]
+    codes = [p.wait() for p in procs]
+    if any(codes):
+        raise subprocess.CalledProcessError(returncode=max(codes), cmd=plans[0][0])
+    return 0
+
+
+def _print_plan(plans) -> None:
+    for i, (cmd, env) in enumerate(plans):
+        print(f"--- process {i} ---")
+        for k in sorted(env):
+            print(f"  {k}={env[k]}")
+        print("  " + " ".join(map(str, cmd)))
+
+
+def launch_command(args) -> int:
+    _apply_config_defaults(args)
+    if args.tpu_pod:
+        return tpu_pod_launcher(args)
+    if args.multi_process and int(args.num_processes or 1) > 1:
+        return multi_process_launcher(args)
+    return simple_launcher(args)
+
+
+def main():
+    parser = launch_command_parser()
+    args = parser.parse_args()
+    sys.exit(launch_command(args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,55 @@
+"""``accelerate-tpu merge-weights`` — consolidate a sharded checkpoint into safetensors.
+
+TPU-native analog of reference ``commands/merge.py`` (backed by ``merge_fsdp_weights``,
+``utils/fsdp_utils.py:275``): the reference merges torch distributed-checkpoint shards; here a
+checkpoint directory holds an orbax/tensorstore ``sharded_state`` tree (written by
+``save_accelerator_state``) which is restored host-side (no mesh needed — tensorstore
+reassembles shards transparently) and re-exported as one interchange safetensors file (HF
+sharding convention when it exceeds ``--max-shard-size``).
+"""
+
+from __future__ import annotations
+
+import argparse
+from pathlib import Path
+
+__all__ = ["merge_command", "merge_command_parser", "merge_weights"]
+
+
+def merge_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Merge a sharded accelerate-tpu checkpoint into consolidated safetensors."
+    if subparsers is not None:
+        parser = subparsers.add_parser("merge-weights", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu merge-weights", description=description)
+    parser.add_argument("checkpoint_dir", help="Checkpoint dir (containing sharded_state/) or the sharded_state dir itself.")
+    parser.add_argument("output_dir", help="Where to write model.safetensors[.index.json].")
+    parser.add_argument("--max-shard-size", "--max_shard_size", default="5GB")
+    parser.add_argument("--params-only", "--params_only", action="store_true", default=True,
+                        help="Export only the params subtree (default).")
+    if subparsers is not None:
+        parser.set_defaults(func=merge_command)
+    return parser
+
+
+def merge_weights(checkpoint_dir: str, output_dir: str, max_shard_size: str = "5GB") -> dict:
+    """Restore the orbax sharded state on host and write consolidated safetensors."""
+    import orbax.checkpoint as ocp
+
+    from ..utils.constants import SHARDED_STATE_DIR
+    from ..utils.modeling import save_sharded_checkpoint
+
+    path = Path(checkpoint_dir).absolute()
+    if (path / SHARDED_STATE_DIR).exists():
+        path = path / SHARDED_STATE_DIR
+    with ocp.StandardCheckpointer() as ckptr:
+        state = ckptr.restore(path)
+    params = state.get("params", state) if isinstance(state, dict) else getattr(state, "params", state)
+    return save_sharded_checkpoint(params, output_dir, max_shard_size=max_shard_size)
+
+
+def merge_command(args) -> dict:
+    index = merge_weights(args.checkpoint_dir, args.output_dir, max_shard_size=args.max_shard_size)
+    n = len(set(index["weight_map"].values()))
+    print(f"Merged checkpoint written to {args.output_dir} ({n} safetensors file(s)).")
+    return index

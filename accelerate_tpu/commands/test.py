@@ -1,0 +1,65 @@
+"""``accelerate-tpu test`` — run the bundled sanity suite through the launcher.
+
+Reference analog: ``commands/test.py`` (:44) — launches the shipped
+``test_utils/scripts/test_script.py`` so any install can self-verify. Defaults to the 8-device
+CPU simulator so it validates mesh/collective behavior even on a machine with no TPU.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+import sys
+from pathlib import Path
+
+__all__ = ["test_command", "test_command_parser"]
+
+
+def test_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Run the accelerate-tpu self-test suite."
+    if subparsers is not None:
+        parser = subparsers.add_parser("test", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu test", description=description)
+    parser.add_argument("--config_file", default=None)
+    parser.add_argument("--on-device", "--on_device", action="store_true",
+                        help="Run on the real backend instead of the 8-device CPU simulator.")
+    if subparsers is not None:
+        parser.set_defaults(func=test_command)
+    return parser
+
+
+def test_command(args) -> int:
+    script = Path(__file__).parent.parent / "test_utils" / "scripts" / "test_script.py"
+    from types import SimpleNamespace
+
+    from .launch import launch_command
+
+    launch_args = SimpleNamespace(
+        cpu=not args.on_device,
+        num_virtual_devices=None if args.on_device else 8,
+        num_processes=1, num_machines=1, machine_rank=0,
+        main_process_ip=None, main_process_port=None,
+        multi_process=False, max_restarts=0,
+        dp=None, fsdp=None, tp=None, sp=None, pp=None, ep=None,
+        use_fsdp=False, fsdp_zero_stage=None,
+        mixed_precision="no",  # the parity check is fp32-exact; don't inherit config bf16
+        gradient_accumulation_steps=None, debug=False,
+        tpu_pod=False, tpu_name=None, tpu_zone=None, dry_run=False,
+        config_file=args.config_file, module=False, no_python=False,
+        training_script=str(script), training_script_args=[],
+    )
+    result = launch_command(launch_args)
+    if result == 0:
+        print("Test is a success! You are ready for your distributed training!")
+    return result
+
+
+def main():
+    parser = test_command_parser()
+    args = parser.parse_args()
+    sys.exit(test_command(args))
+
+
+if __name__ == "__main__":
+    main()

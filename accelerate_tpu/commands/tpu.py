@@ -1,0 +1,79 @@
+"""``accelerate-tpu tpu-config`` — fan setup commands out to every worker of a GCE TPU pod.
+
+Reference analog: ``commands/tpu.py`` (:157) — builds a
+``gcloud compute tpus tpu-vm ssh <name> --worker=all --command="..."`` invocation from the
+config file + flags. ``--dry-run`` (the reference has the same flag) prints the command; that is
+also the testable path in environments without gcloud.
+"""
+
+from __future__ import annotations
+
+import argparse
+import subprocess
+
+from .config import default_config_file, load_config_from_file
+
+__all__ = ["tpu_command_parser", "tpu_command_launcher"]
+
+
+def tpu_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = "Run setup commands on every worker of a TPU pod."
+    if subparsers is not None:
+        parser = subparsers.add_parser("tpu-config", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu tpu-config", description=description)
+    parser.add_argument("--config_file", default=None)
+    parser.add_argument("--tpu_name", default=None)
+    parser.add_argument("--tpu_zone", default=None)
+    parser.add_argument("--command", action="append", default=None,
+                        help="Command to run on each worker (repeatable).")
+    parser.add_argument("--command_file", default=None, help="File with one command per line.")
+    parser.add_argument("--install_accelerate", action="store_true",
+                        help="Prepend a pip install of this framework.")
+    parser.add_argument("--accelerate_version", default="latest")
+    parser.add_argument("--debug", action="store_true", help="Print the command instead of running it.")
+    parser.add_argument("--dry-run", "--dry_run", dest="debug", action="store_true")
+    if subparsers is not None:
+        parser.set_defaults(func=tpu_command_launcher)
+    return parser
+
+
+def tpu_command_launcher(args):
+    import os
+
+    defaults = None
+    path = args.config_file or default_config_file()
+    if os.path.isfile(path):
+        defaults = load_config_from_file(path)
+        args.tpu_name = args.tpu_name or defaults.tpu_name
+        args.tpu_zone = args.tpu_zone or defaults.tpu_zone
+    if args.tpu_name is None:
+        raise ValueError("You must specify a TPU name (--tpu_name or via `accelerate-tpu config`).")
+
+    commands = list(args.command or [])
+    if args.command_file:
+        with open(args.command_file) as f:
+            commands += [line.strip() for line in f if line.strip()]
+    if args.install_accelerate:
+        version = (
+            "accelerate-tpu"
+            if args.accelerate_version == "latest"
+            else f"accelerate-tpu=={args.accelerate_version}"
+        )
+        commands.insert(0, f"pip install {version}")
+    if not commands:
+        raise ValueError("No commands given (--command / --command_file).")
+
+    joined = "; ".join(commands)
+    cmd = [
+        "gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu_name,
+        *(["--zone", args.tpu_zone] if args.tpu_zone else []),
+        "--command", joined,
+        "--worker=all",
+    ]
+    if args.debug:
+        print(f"Running {' '.join(cmd)}")
+        return cmd
+    subprocess.run(cmd, check=True)
+    print("Successfully setup pod.")
+    return cmd

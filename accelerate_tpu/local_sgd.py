@@ -106,26 +106,28 @@ class LocalSGD:
 def _mean_params_across_processes(params):
     """Sharding-preserving cross-process mean of a parameter pytree.
 
-    Each leaf is pulled to host (host-local meshes are fully addressable per process),
-    byte-all-gathered over the process-level collective layer, averaged in fp32, and put back
-    with the leaf's original sharding.
+    All leaves are pulled to host (host-local meshes are fully addressable per process) and
+    byte-all-gathered in ONE collective — a whole-pytree payload, not one round-trip per leaf —
+    then averaged in fp32 and put back with each leaf's original sharding.
     """
     import jax
 
     from .utils.operations import _allgather_bytes
 
-    def _avg(leaf):
-        if not hasattr(leaf, "shape"):
-            return leaf
-        arr = np.asarray(jax.device_get(leaf))
-        gathered = [pickle.loads(p) for p in _allgather_bytes(pickle.dumps(arr))]
-        if len(gathered) == 1:
-            return leaf
-        mean = np.mean(
-            np.stack([a.astype(np.float32) for a in gathered]), axis=0
-        ).astype(arr.dtype)
-        if isinstance(leaf, jax.Array):
-            return jax.device_put(mean, leaf.sharding)
-        return mean
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    host = [np.asarray(jax.device_get(x)) if hasattr(x, "shape") else x for x in leaves]
+    gathered = [pickle.loads(p) for p in _allgather_bytes(pickle.dumps(host))]
+    if len(gathered) == 1:
+        return params
 
-    return jax.tree_util.tree_map(_avg, params)
+    averaged = []
+    for i, leaf in enumerate(leaves):
+        if not hasattr(leaf, "shape"):
+            averaged.append(leaf)
+            continue
+        stack = np.stack([np.asarray(g[i], dtype=np.float32) for g in gathered])
+        mean = np.mean(stack, axis=0).astype(host[i].dtype)
+        if isinstance(leaf, jax.Array):
+            mean = jax.device_put(mean, leaf.sharding)
+        averaged.append(mean)
+    return jax.tree_util.tree_unflatten(treedef, averaged)

@@ -307,13 +307,9 @@ def loss_fn(
 
 @partial(jax.jit, static_argnames=("cfg",))
 def _block_jit(x, layer, positions, mask, cfg):
+    """Module-level jit: stable identity → one compilation per (config, shapes) across
+    repeated forward_streamed calls."""
     return _block(x, layer, positions, mask, cfg)
-
-
-def _jitted_block(cfg: LlamaConfig):
-    """Stable-identity jitted block so repeated forward_streamed calls reuse the compile cache
-    (LlamaConfig is frozen/hashable → one compilation per config/shape)."""
-    return partial(_block_jit, cfg=cfg)
 
 
 def forward_streamed(
@@ -341,13 +337,11 @@ def forward_streamed(
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
 
-    block_fn = _jitted_block(cfg)
-
     embed = dispatched.fetch("embed")
     x = embed.astype(dtype)[tokens]
     prefixes = [f"layers/{i}" for i in range(cfg.n_layers)]
     for _, layer in stream_blocks(dispatched, prefixes, prefetch=prefetch):
-        x = block_fn(x, layer, positions, mask)
+        x = _block_jit(x, layer, positions, mask, cfg=cfg)
     ln_f = dispatched.fetch("ln_f")
     x = _rms_norm(x, ln_f, cfg.norm_eps)
     head = embed.T if cfg.tie_embeddings else dispatched.fetch("lm_head")

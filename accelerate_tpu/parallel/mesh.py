@@ -91,6 +91,23 @@ class MeshConfig:
         return sizes
 
     @classmethod
+    def from_env(cls) -> Optional["MeshConfig"]:
+        """Deserialize ``ACCELERATE_MESH_{DP,FSDP,TP,SP,PP,EP}`` set by the launcher.
+
+        Returns None when no mesh env var is present (the launcher wire protocol,
+        ``utils/launch.py``). ``-1`` keeps its fill-remaining meaning.
+        """
+        import os
+
+        values = {}
+        for field_name in ("dp", "fsdp", "tp", "sp", "pp", "ep"):
+            raw = os.environ.get(f"ACCELERATE_MESH_{field_name.upper()}")
+            if raw is not None:
+                values[field_name] = int(raw)
+        # Unset axes keep their dataclass defaults (dp=-1 fill-remaining, others 1).
+        return cls(**values) if values else None
+
+    @classmethod
     def from_plugins(
         cls,
         fsdp_plugin=None,

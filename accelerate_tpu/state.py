@@ -348,6 +348,13 @@ class AcceleratorState:
         self.megatron_lm_plugin = megatron_lm_plugin
         from .parallel.mesh import MeshConfig, build_mesh
 
+        no_plugins = all(
+            p is None for p in (fsdp_plugin, tp_plugin, pp_plugin, sp_plugin, ep_plugin)
+        )
+        if mesh_config is None and no_plugins:
+            # Launcher wire protocol: ACCELERATE_MESH_* env takes effect only when neither an
+            # explicit mesh nor plugins were passed in Python (explicit args > env, §5 order).
+            mesh_config = MeshConfig.from_env()
         if mesh_config is None:
             mesh_config = MeshConfig.from_plugins(
                 fsdp_plugin=fsdp_plugin,

@@ -1,0 +1,19 @@
+"""Shipped test harness (L10) — mirrors reference ``test_utils/`` so any install can self-test.
+
+Reference analog: /root/reference/src/accelerate/test_utils/ (testing.py's ``require_*`` gates,
+``AccelerateTestCase`` singleton reset, RegressionModel fixtures, bundled device-agnostic
+scripts under ``scripts/`` run by ``accelerate test``).
+"""
+
+from .testing import (
+    AccelerateTestCase,
+    TempDirTestCase,
+    device_count,
+    execute_subprocess_async,
+    get_launch_command,
+    require_multi_device,
+    require_tpu,
+    skip,
+    slow,
+)
+from .training import RegressionDataset, RegressionModel4XPU, linear_regression_loss, make_regression_state

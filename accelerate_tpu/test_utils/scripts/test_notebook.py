@@ -1,0 +1,34 @@
+"""Spawn targets for notebook/debug launcher tests (reference ``test_utils/scripts/test_notebook.py``).
+
+Functions here are module-level so ``multiprocessing`` spawn children can unpickle them by
+import path from the installed package.
+"""
+
+from __future__ import annotations
+
+
+def basic_function():
+    """Child body: init the distributed state and verify the rendezvous topology."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from accelerate_tpu import PartialState
+
+    state = PartialState()
+    assert state.num_processes == jax.process_count()
+    print(f"child process {state.process_index}/{state.num_processes} OK", flush=True)
+
+
+def function_with_args(value: int):
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from accelerate_tpu import PartialState
+
+    state = PartialState()
+    assert value == 42, value
+    print(f"child {state.process_index} got value {value}", flush=True)
+
+
+if __name__ == "__main__":
+    basic_function()

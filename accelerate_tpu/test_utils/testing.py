@@ -1,0 +1,156 @@
+"""Test-harness utilities shipped with the package.
+
+Reference analog: ``test_utils/testing.py`` — ``require_*`` decorators (:146-560),
+``AccelerateTestCase`` (:595), ``TempDirTestCase`` (:562), ``execute_subprocess_async`` (:671),
+``get_launch_command`` (:105). JAX version: hardware gates probe ``jax.devices()``; subprocess
+launches go through ``accelerate-tpu launch`` / ``python -m accelerate_tpu launch``.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import unittest
+from pathlib import Path
+from typing import Optional
+
+__all__ = [
+    "device_count",
+    "skip",
+    "slow",
+    "require_tpu",
+    "require_multi_device",
+    "require_multihost",
+    "AccelerateTestCase",
+    "TempDirTestCase",
+    "MockingTestCase",
+    "execute_subprocess_async",
+    "get_launch_command",
+]
+
+
+def device_count() -> int:
+    import jax
+
+    return jax.device_count()
+
+
+def _backend() -> str:
+    import jax
+
+    return jax.default_backend()
+
+
+try:
+    import pytest
+
+    skip = pytest.mark.skip
+    _skipif = pytest.mark.skipif
+except ImportError:  # pragma: no cover - pytest always present in dev envs
+    skip = unittest.skip
+    _skipif = lambda cond, reason=None: unittest.skipIf(cond, reason)  # noqa: E731
+
+
+def slow(test_case):
+    """Gate on ``RUN_SLOW=1`` (reference ``testing.py:245``)."""
+    from ..utils.environment import parse_flag_from_env
+
+    return unittest.skipUnless(parse_flag_from_env("RUN_SLOW", False), "test is slow")(test_case)
+
+
+def require_tpu(test_case):
+    return unittest.skipUnless(_backend() not in ("cpu",), "test requires TPU")(test_case)
+
+
+def require_multi_device(test_case):
+    return unittest.skipUnless(device_count() > 1, "test requires multiple devices")(test_case)
+
+
+def require_multihost(test_case):
+    import jax
+
+    return unittest.skipUnless(jax.process_count() > 1, "test requires multiple hosts")(test_case)
+
+
+class AccelerateTestCase(unittest.TestCase):
+    """Resets the shared-state singletons between tests (reference ``testing.py:595-605``)."""
+
+    def tearDown(self):
+        super().tearDown()
+        from ..state import AcceleratorState, GradientState, PartialState
+
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+
+
+class TempDirTestCase(unittest.TestCase):
+    """Class-scoped temp dir, emptied between tests (reference ``testing.py:562``)."""
+
+    clear_on_setup = True
+
+    @classmethod
+    def setUpClass(cls):
+        cls.tmpdir = Path(tempfile.mkdtemp(prefix="accelerate_tpu_test_"))
+
+    @classmethod
+    def tearDownClass(cls):
+        shutil.rmtree(cls.tmpdir, ignore_errors=True)
+
+    def setUp(self):
+        if self.clear_on_setup:
+            for path in self.tmpdir.glob("**/*"):
+                if path.is_file():
+                    path.unlink()
+                elif path.is_dir():
+                    shutil.rmtree(path, ignore_errors=True)
+
+
+class MockingTestCase(unittest.TestCase):
+    """Auto-stopping mock registry (reference ``testing.py:608``)."""
+
+    def add_mocks(self, mocks):
+        self._test_mocks = mocks if isinstance(mocks, (list, tuple)) else [mocks]
+        for m in self._test_mocks:
+            m.start()
+            self.addCleanup(m.stop)
+
+
+def get_launch_command(
+    num_processes: int = 1,
+    num_virtual_devices: Optional[int] = 8,
+    multi_process: bool = False,
+    **kwargs,
+) -> list[str]:
+    """Build an ``accelerate-tpu launch`` argv prefix (reference ``testing.py:105``)."""
+    cmd = [sys.executable, "-m", "accelerate_tpu", "launch"]
+    if num_virtual_devices:
+        cmd += ["--num-virtual-devices", str(num_virtual_devices)]
+    if num_processes and num_processes > 1:
+        cmd += ["--num-processes", str(num_processes), "--multi-process"]
+    elif multi_process:
+        cmd += ["--multi-process"]
+    for key, value in kwargs.items():
+        flag = "--" + key.replace("_", "-")
+        if value is True:
+            cmd.append(flag)
+        elif value not in (None, False):
+            cmd += [flag, str(value)]
+    return cmd
+
+
+def execute_subprocess_async(cmd: list[str], env: Optional[dict] = None, timeout: int = 600) -> str:
+    """Run a child process, raising with its full output on failure (reference ``testing.py:671``)."""
+    child_env = dict(os.environ if env is None else env)
+    result = subprocess.run(
+        list(map(str, cmd)), capture_output=True, text=True, timeout=timeout, env=child_env
+    )
+    if result.returncode != 0:
+        raise RuntimeError(
+            f"Command {' '.join(map(str, cmd))} failed with code {result.returncode}\n"
+            f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+        )
+    return result.stdout

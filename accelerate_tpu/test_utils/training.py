@@ -1,0 +1,53 @@
+"""Tiny synthetic fixtures (reference ``test_utils/training.py``: RegressionModel/-Dataset)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "RegressionDataset",
+    "RegressionModel4XPU",
+    "make_regression_state",
+    "linear_regression_loss",
+]
+
+
+class RegressionDataset:
+    """y = 2x + 1 + noise — list-style dataset of dicts (reference ``training.py:31``)."""
+
+    def __init__(self, a: float = 2.0, b: float = 1.0, length: int = 64, seed: int = 42):
+        rng = np.random.default_rng(seed)
+        self.length = length
+        self.x = rng.normal(size=(length,)).astype(np.float32)
+        self.y = (a * self.x + b + 0.05 * rng.normal(size=(length,))).astype(np.float32)
+
+    def __len__(self):
+        return self.length
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+def make_regression_state(a: float = 0.0, b: float = 0.0):
+    """Params pytree for the 1-D linear model."""
+    import jax.numpy as jnp
+
+    return {"a": jnp.asarray(a, jnp.float32), "b": jnp.asarray(b, jnp.float32)}
+
+
+def linear_regression_loss(params, batch):
+    """MSE of y ≈ a·x + b (jit-friendly; the training-parity workhorse)."""
+    import jax.numpy as jnp
+
+    pred = params["a"] * batch["x"] + params["b"]
+    return jnp.mean((pred - batch["y"]) ** 2)
+
+
+class RegressionModel4XPU:
+    """Callable-model flavor of the fixture (reference ``RegressionModel``)."""
+
+    def __init__(self, a: float = 0.0, b: float = 0.0):
+        self.params = make_regression_state(a, b)
+
+    def __call__(self, params, x):
+        return params["a"] * x + params["b"]

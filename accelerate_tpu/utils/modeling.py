@@ -59,7 +59,8 @@ def dtype_byte_size(dtype) -> float:
     name = getattr(dtype, "name", None) or str(dtype)
     if name in ("bool", "bool_"):
         return 1 / 8
-    m = re.search(r"(\d+)$", name.replace("fn", "").replace("fnuz", ""))
+    # First digit group = the bit width ("float8_e4m3fn" → 8, not the e4m3 suffix digits).
+    m = re.search(r"[^\d](\d+)", name)
     if m is None:
         raise ValueError(f"`dtype` is not a valid dtype: {dtype}.")
     return int(m.group(1)) / 8

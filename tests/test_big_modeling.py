@@ -59,6 +59,10 @@ def test_dtype_byte_size():
     assert dtype_byte_size(jnp.bfloat16.dtype) == 2
     assert dtype_byte_size(np.dtype("int8")) == 1
     assert dtype_byte_size(np.dtype("bool")) == 1 / 8
+    # fp8: the bit width is the FIRST digit group, not the e4m3/e5m2 suffix digits.
+    assert dtype_byte_size(jnp.float8_e4m3fn.dtype) == 1
+    assert dtype_byte_size(jnp.float8_e5m2.dtype) == 1
+    assert dtype_byte_size(np.dtype("int4")) == 0.5
 
 
 def test_compute_module_sizes_abstract_matches_concrete():

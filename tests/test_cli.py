@@ -1,0 +1,291 @@
+"""L9 CLI tests (reference parity: tests/test_cli.py — config fixtures, launch arg parsing,
+env serialization; test_utils/scripts self-test invariants run in-process elsewhere)."""
+
+import json
+import os
+import subprocess
+import sys
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from accelerate_tpu.commands.accelerate_cli import get_parser
+from accelerate_tpu.commands.config import ClusterConfig, load_config_from_file, save_config
+from accelerate_tpu.commands.estimate import gather_data
+from accelerate_tpu.commands.launch import (
+    _apply_config_defaults,
+    launch_command,
+    launch_command_parser,
+)
+from accelerate_tpu.commands.tpu import tpu_command_launcher, tpu_command_parser
+from accelerate_tpu.test_utils import get_launch_command
+from accelerate_tpu.utils.launch import (
+    mesh_env_from_args,
+    prepare_multi_process_env,
+    prepare_simple_launcher_cmd_env,
+)
+
+
+# ------------------------------------------------------------------------------ config
+def test_cluster_config_yaml_roundtrip(tmp_path):
+    cfg = ClusterConfig(num_processes=4, mixed_precision="bf16", tp=2, fsdp_zero_stage=3)
+    path = save_config(cfg, str(tmp_path / "cfg.yaml"))
+    loaded = load_config_from_file(path)
+    assert loaded.num_processes == 4
+    assert loaded.mixed_precision == "bf16"
+    assert loaded.tp == 2
+    assert loaded.fsdp_zero_stage == 3
+
+
+def test_cluster_config_json_roundtrip(tmp_path):
+    cfg = ClusterConfig(num_machines=2, main_process_ip="10.0.0.1", main_process_port=1234)
+    path = save_config(cfg, str(tmp_path / "cfg.json"))
+    loaded = load_config_from_file(path)
+    assert loaded.num_machines == 2
+    assert loaded.main_process_ip == "10.0.0.1"
+
+
+def test_config_default_subcommand(tmp_path, capsys):
+    parser = get_parser()
+    args = parser.parse_args(["config", "default", "--config_file", str(tmp_path / "d.yaml")])
+    args.func(args)
+    loaded = load_config_from_file(str(tmp_path / "d.yaml"))
+    assert loaded.mixed_precision == "bf16"
+
+
+def test_config_unknown_keys_ignored(tmp_path):
+    (tmp_path / "old.yaml").write_text("num_processes: 2\nsome_future_field: 7\n")
+    loaded = load_config_from_file(str(tmp_path / "old.yaml"))
+    assert loaded.num_processes == 2
+
+
+# ----------------------------------------------------------------------- env serialization
+def _launch_args(extra=()):
+    parser = launch_command_parser()
+    return parser.parse_args([*extra, "script.py"])
+
+
+def test_mesh_env_serialization():
+    args = _launch_args(["--tp", "2", "--fsdp", "4", "--sp", "1"])
+    env = mesh_env_from_args(args)
+    assert env == {
+        "ACCELERATE_MESH_TP": "2",
+        "ACCELERATE_MESH_FSDP": "4",
+        "ACCELERATE_MESH_SP": "1",
+    }
+
+
+def test_simple_launcher_env():
+    args = _launch_args(["--mixed-precision", "bf16", "--debug", "--gradient-accumulation-steps", "4"])
+    cmd, env = prepare_simple_launcher_cmd_env(args)
+    assert cmd[-1] == "script.py"
+    assert env["ACCELERATE_MIXED_PRECISION"] == "bf16"
+    assert env["ACCELERATE_DEBUG_MODE"] == "true"
+    assert env["ACCELERATE_GRADIENT_ACCUMULATION_STEPS"] == "4"
+
+
+def test_virtual_device_env():
+    args = _launch_args(["--num-virtual-devices", "8"])
+    _, env = prepare_simple_launcher_cmd_env(args)
+    assert "xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env["ACCELERATE_USE_CPU"] == "true"
+
+
+def test_multi_process_env_rendezvous():
+    args = _launch_args(["--num-processes", "4", "--main-process-port", "12355"])
+    env = prepare_multi_process_env(args, process_id=2)
+    assert env["ACCELERATE_COORDINATOR_ADDRESS"] == "127.0.0.1:12355"
+    assert env["ACCELERATE_NUM_PROCESSES"] == "4"
+    assert env["ACCELERATE_PROCESS_ID"] == "2"
+
+
+def test_module_and_no_python_flags():
+    args = _launch_args(["-m"])
+    cmd, _ = prepare_simple_launcher_cmd_env(args)
+    assert cmd[:2] == [sys.executable, "-m"]
+    args = _launch_args(["--no-python"])
+    cmd, _ = prepare_simple_launcher_cmd_env(args)
+    assert cmd[0] == "script.py"
+
+
+def test_config_defaults_merge_order(tmp_path):
+    path = save_config(ClusterConfig(mixed_precision="bf16", tp=2, num_processes=2), str(tmp_path / "c.yaml"))
+    args = _launch_args(["--config-file", path, "--tp", "4"])
+    _apply_config_defaults(args)
+    assert args.tp == 4  # CLI flag wins
+    assert args.mixed_precision == "bf16"  # YAML fills the gap
+    assert args.num_processes == 2
+
+
+def test_default_grad_accum_not_serialized(tmp_path):
+    """A neutral gradient_accumulation_steps=1 in YAML must not reach the child env."""
+    path = save_config(ClusterConfig(gradient_accumulation_steps=1), str(tmp_path / "c.yaml"))
+    args = _launch_args(["--config-file", path])
+    _apply_config_defaults(args)
+    _, env = prepare_simple_launcher_cmd_env(args)
+    assert "ACCELERATE_GRADIENT_ACCUMULATION_STEPS" not in env
+
+
+# ----------------------------------------------------------------------------- dry runs
+def test_launch_dry_run_single(capsys):
+    args = _launch_args(["--dry-run", "--mixed-precision", "bf16"])
+    assert launch_command(args) == 0
+    out = capsys.readouterr().out
+    assert "ACCELERATE_MIXED_PRECISION=bf16" in out
+    assert "script.py" in out
+
+
+def test_launch_dry_run_multi_process(capsys):
+    args = _launch_args(["--dry-run", "--multi-process", "--num-processes", "2"])
+    assert launch_command(args) == 0
+    out = capsys.readouterr().out
+    assert "--- process 0 ---" in out and "--- process 1 ---" in out
+    assert "ACCELERATE_PROCESS_ID=1" in out
+
+
+def test_tpu_pod_dry_run(capsys):
+    args = _launch_args([
+        "--dry-run", "--tpu-pod", "--tpu-name", "my-pod", "--tpu-zone", "us-central2-b",
+        "--num-machines", "2", "--main-process-ip", "10.0.0.2",
+    ])
+    assert launch_command(args) == 0
+    out = capsys.readouterr().out
+    assert "gcloud compute tpus tpu-vm ssh my-pod" in out
+    assert "--worker=0" in out and "--worker=1" in out
+    assert "ACCELERATE_PROCESS_ID=1" in out
+
+
+def test_tpu_config_debug_builds_gcloud_cmd(capsys):
+    parser = tpu_command_parser()
+    args = parser.parse_args([
+        "--tpu_name", "pod", "--tpu_zone", "z", "--command", "echo hi", "--debug",
+        "--config_file", "/nonexistent",
+    ])
+    cmd = tpu_command_launcher(args)
+    assert cmd[:6] == ["gcloud", "compute", "tpus", "tpu-vm", "ssh", "pod"]
+    assert "--worker=all" in cmd
+
+
+def test_tpu_config_requires_commands():
+    parser = tpu_command_parser()
+    args = parser.parse_args(["--tpu_name", "pod", "--debug", "--config_file", "/nonexistent"])
+    with pytest.raises(ValueError, match="No commands"):
+        tpu_command_launcher(args)
+
+
+# ----------------------------------------------------------------------------- estimate
+def test_estimate_registry_model():
+    args = SimpleNamespace(model_name="tiny", dtypes=["float32", "bfloat16", "int4"], as_json=False)
+    rows = gather_data(args)
+    assert [r[0] for r in rows] == ["float32", "bfloat16", "int4"]
+    fp32_total = rows[0][2]
+    assert rows[1][2] == fp32_total // 2  # bf16 halves
+    assert rows[2][2] == fp32_total // 8  # int4 is 1/8
+    assert rows[0][3] == 4 * fp32_total  # Adam fp32: params+grads+2 moments
+
+
+def test_estimate_unknown_model_raises():
+    args = SimpleNamespace(model_name="no-such-model-xyz", dtypes=["float32"], as_json=False)
+    with pytest.raises(ValueError, match="Could not resolve"):
+        gather_data(args)
+
+
+# ------------------------------------------------------------------------- merge-weights
+def test_merge_weights_roundtrip(tmp_path):
+    import jax
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.commands.merge import merge_weights
+    from accelerate_tpu.utils.serialization import load_pytree_safetensors
+
+    acc = Accelerator()
+    params = {"w": np.ones((4, 4), np.float32) * 3, "b": np.zeros((4,), np.float32)}
+    state = acc.create_train_state(jax.tree_util.tree_map(np.asarray, params), optax.sgd(0.1))
+    ckpt = tmp_path / "ckpt"
+    acc.save_state(str(ckpt), train_state=state)
+    out = tmp_path / "merged"
+    index = merge_weights(str(ckpt), str(out))
+    assert set(index["weight_map"]) == {"w", "b"}
+    merged = load_pytree_safetensors(out / "model.safetensors")
+    np.testing.assert_array_equal(merged["w"], params["w"])
+
+
+# ------------------------------------------------------------------------ harness helpers
+def test_get_launch_command():
+    cmd = get_launch_command(num_processes=2, num_virtual_devices=4, mixed_precision="bf16")
+    assert cmd[:4] == [sys.executable, "-m", "accelerate_tpu", "launch"]
+    assert "--num-processes" in cmd and "--multi-process" in cmd
+    assert "--mixed-precision" in cmd and "bf16" in cmd
+
+
+def test_cli_help_lists_subcommands(capsys):
+    parser = get_parser()
+    with pytest.raises(SystemExit):
+        parser.parse_args(["--help"])
+    out = capsys.readouterr().out
+    for sub in ("config", "env", "estimate-memory", "launch", "merge-weights", "test", "tpu-config"):
+        assert sub in out
+
+
+def test_env_command_reports(capsys):
+    from accelerate_tpu.commands.env import env_command
+
+    info = env_command(SimpleNamespace(config_file="/nonexistent"))
+    assert "jax version" in info
+    assert info["Device count"] >= 1
+
+
+# --------------------------------------------------------------------- subprocess launch
+def test_subprocess_simple_launch_env_propagation(tmp_path):
+    """Full exec path: child sees the serialized ACCELERATE_* env (no jax import, fast)."""
+    script = tmp_path / "child.py"
+    script.write_text(
+        "import os, json\n"
+        "print(json.dumps({k: v for k, v in os.environ.items() if k.startswith('ACCELERATE_')}))\n"
+    )
+    result = subprocess.run(
+        [
+            sys.executable, "-m", "accelerate_tpu", "launch",
+            "--mixed-precision", "bf16", "--tp", "2", str(script),
+        ],
+        capture_output=True, text=True, timeout=240,
+        env={**os.environ, "PYTHONPATH": "/root/repo:" + os.environ.get("PYTHONPATH", "")},
+    )
+    assert result.returncode == 0, result.stderr
+    env = json.loads(result.stdout.strip().splitlines()[-1])
+    assert env["ACCELERATE_MIXED_PRECISION"] == "bf16"
+    assert env["ACCELERATE_MESH_TP"] == "2"
+
+
+# ----------------------------------------------------------------------- mesh env protocol
+def test_mesh_config_from_env(monkeypatch):
+    from accelerate_tpu.parallel import MeshConfig
+
+    monkeypatch.setenv("ACCELERATE_MESH_TP", "2")
+    monkeypatch.setenv("ACCELERATE_MESH_FSDP", "4")
+    cfg = MeshConfig.from_env()
+    assert cfg.tp == 2 and cfg.fsdp == 4 and cfg.dp == -1
+    sizes = cfg.resolved_sizes(8)
+    assert sizes["tp"] == 2 and sizes["fsdp"] == 4 and sizes["dp"] == 1
+
+
+def test_mesh_config_from_env_absent(monkeypatch):
+    from accelerate_tpu.parallel import MeshConfig
+
+    for axis in ("DP", "FSDP", "TP", "SP", "PP", "EP"):
+        monkeypatch.delenv(f"ACCELERATE_MESH_{axis}", raising=False)
+    assert MeshConfig.from_env() is None
+
+
+def test_accelerator_state_reads_mesh_env(monkeypatch):
+    import jax
+
+    from accelerate_tpu.state import AcceleratorState
+
+    monkeypatch.setenv("ACCELERATE_MESH_TP", "2")
+    state = AcceleratorState()
+    assert dict(zip(state.mesh.axis_names, state.mesh.devices.shape))["tp"] == 2
+    assert state.distributed_type.value in ("TP", "HYBRID", "MULTI_DEVICE")

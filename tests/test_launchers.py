@@ -1,0 +1,28 @@
+"""Function launchers (reference tests/test_multigpu.py + test_notebook.py equivalents)."""
+
+import numpy as np
+import pytest
+
+from accelerate_tpu import notebook_launcher
+from accelerate_tpu.launchers import debug_launcher
+from accelerate_tpu.test_utils.scripts.test_notebook import basic_function, function_with_args
+
+
+def test_notebook_launcher_single_process_runs_inline():
+    calls = []
+    notebook_launcher(lambda v: calls.append(v), ("x",), num_processes=1)
+    assert calls == ["x"]
+
+
+def test_debug_launcher_two_processes_rendezvous():
+    """Spawns 2 real processes with a JAX distributed handshake (reference debug_launcher)."""
+    debug_launcher(basic_function, num_processes=2)
+
+
+def test_notebook_launcher_forwards_args():
+    debug_launcher(function_with_args, args=(42,), num_processes=2)
+
+
+def test_notebook_launcher_surfaces_child_failure():
+    with pytest.raises(RuntimeError, match="exit codes"):
+        debug_launcher(function_with_args, args=(7,), num_processes=2)  # asserts value == 42
