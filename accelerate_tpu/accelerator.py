@@ -239,7 +239,40 @@ class Accelerator:
         if fsdp_plugin is None and os.environ.get("ACCELERATE_USE_FSDP", "false").lower() == "true":
             fsdp_plugin = FullyShardedDataParallelPlugin()
 
+        # Kwargs handler dispatch (reference accelerator.py:425-450).
+        self.fp8_recipe = None
+        self.autocast_handler = None
+        self.profile_handler = None
+        self.scaler_handler = None
+        distributed_init_kwargs = None
+        for handler in kwargs_handlers or []:
+            from .utils.dataclasses import (
+                AutocastKwargs,
+                DistributedInitKwargs,
+                FP8RecipeKwargs,
+                GradScalerKwargs,
+                ProfileKwargs,
+            )
+
+            if isinstance(handler, FP8RecipeKwargs):
+                self.fp8_recipe = handler
+            elif isinstance(handler, AutocastKwargs):
+                self.autocast_handler = handler
+            elif isinstance(handler, ProfileKwargs):
+                self.profile_handler = handler
+            elif isinstance(handler, GradScalerKwargs):
+                self.scaler_handler = handler  # API parity; moot under bf16/fp8 on TPU
+            elif isinstance(handler, DistributedInitKwargs):
+                distributed_init_kwargs = handler
+            else:
+                raise ValueError(f"Unsupported kwargs handler: {handler!r}")
+        if mixed_precision == "fp8" and self.fp8_recipe is None:
+            from .utils.dataclasses import FP8RecipeKwargs
+
+            self.fp8_recipe = FP8RecipeKwargs()
+
         self.state = AcceleratorState(
+            **({"distributed_init_kwargs": distributed_init_kwargs} if distributed_init_kwargs else {}),
             mixed_precision=mixed_precision,
             cpu=cpu,
             mesh_config=mesh_config,

@@ -126,7 +126,8 @@ def _apply_config_defaults(args) -> None:
         "tpu_zone": cfg.tpu_zone,
     }
     for key, value in defaults.items():
-        if getattr(args, key, None) in (None, 0) and value is not None:
+        # Only fill truly-unset (None) args — an explicit 0 (e.g. --machine-rank 0) must win.
+        if getattr(args, key, None) is None and value is not None:
             setattr(args, key, value)
     if cfg.use_cpu:
         args.cpu = True
@@ -176,7 +177,7 @@ def multi_process_launcher(args) -> int:
         if attempt < attempts - 1:
             print(f"[accelerate-tpu] exit codes {codes}; restart {attempt + 1}/{args.max_restarts}")
             time.sleep(1.0)
-    raise subprocess.CalledProcessError(returncode=max(codes), cmd=cmd)
+    raise subprocess.CalledProcessError(returncode=_first_failure(codes), cmd=cmd)
 
 
 def tpu_pod_launcher(args) -> int:
@@ -189,6 +190,11 @@ def tpu_pod_launcher(args) -> int:
     if not args.tpu_name:
         raise ValueError("--tpu-pod requires --tpu-name (and usually --tpu-zone).")
     num_hosts = int(args.num_machines or args.num_processes or 1)
+    if num_hosts > 1 and not args.main_process_ip:
+        # A shell default like $(hostname -i) would expand per-worker — every host would
+        # nominate itself coordinator and the rendezvous would never form.
+        raise ValueError("--tpu-pod with multiple hosts requires --main-process-ip "
+                         "(the internal IP of worker 0).")
     inner_flags = []
     if args.mixed_precision:
         inner_flags += ["--mixed-precision", args.mixed_precision]
@@ -196,10 +202,20 @@ def tpu_pod_launcher(args) -> int:
         v = getattr(args, axis, None)
         if v is not None:
             inner_flags += [f"--{axis}", str(v)]
+    if getattr(args, "gradient_accumulation_steps", None):
+        inner_flags += ["--gradient-accumulation-steps", str(args.gradient_accumulation_steps)]
+    if getattr(args, "fsdp_zero_stage", None):
+        inner_flags += ["--fsdp-zero-stage", str(args.fsdp_zero_stage)]
+    if getattr(args, "use_fsdp", False):
+        inner_flags += ["--use-fsdp"]
+    if getattr(args, "debug", False):
+        inner_flags += ["--debug"]
+    if getattr(args, "cpu", False):
+        inner_flags += ["--cpu"]
     plans = []
     for rank in range(num_hosts):
         inner = (
-            f"ACCELERATE_COORDINATOR_ADDRESS={args.main_process_ip or '$(hostname -i)'}:"
+            f"ACCELERATE_COORDINATOR_ADDRESS={args.main_process_ip or '127.0.0.1'}:"
             f"{args.main_process_port or 29500} "
             f"ACCELERATE_NUM_PROCESSES={num_hosts} ACCELERATE_PROCESS_ID={rank} "
             f"accelerate-tpu launch {' '.join(inner_flags)} {args.training_script} "
@@ -218,8 +234,13 @@ def tpu_pod_launcher(args) -> int:
     procs = [subprocess.Popen(cmd) for cmd, _ in plans]
     codes = [p.wait() for p in procs]
     if any(codes):
-        raise subprocess.CalledProcessError(returncode=max(codes), cmd=plans[0][0])
+        raise subprocess.CalledProcessError(returncode=_first_failure(codes), cmd=plans[0][0])
     return 0
+
+
+def _first_failure(codes: list[int]) -> int:
+    """First nonzero exit code — max() would report 0 when a child died from a signal (<0)."""
+    return next((c for c in codes if c != 0), 1)
 
 
 def _print_plan(plans) -> None:

@@ -25,14 +25,17 @@ def merge_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("checkpoint_dir", help="Checkpoint dir (containing sharded_state/) or the sharded_state dir itself.")
     parser.add_argument("output_dir", help="Where to write model.safetensors[.index.json].")
     parser.add_argument("--max-shard-size", "--max_shard_size", default="5GB")
-    parser.add_argument("--params-only", "--params_only", action="store_true", default=True,
-                        help="Export only the params subtree (default).")
+    parser.add_argument("--full-state", "--full_state", action="store_true",
+                        help="Export the whole train state (optimizer moments, counters) "
+                             "instead of only the params subtree.")
     if subparsers is not None:
         parser.set_defaults(func=merge_command)
     return parser
 
 
-def merge_weights(checkpoint_dir: str, output_dir: str, max_shard_size: str = "5GB") -> dict:
+def merge_weights(
+    checkpoint_dir: str, output_dir: str, max_shard_size: str = "5GB", params_only: bool = True
+) -> dict:
     """Restore the orbax sharded state on host and write consolidated safetensors."""
     import orbax.checkpoint as ocp
 
@@ -44,12 +47,17 @@ def merge_weights(checkpoint_dir: str, output_dir: str, max_shard_size: str = "5
         path = path / SHARDED_STATE_DIR
     with ocp.StandardCheckpointer() as ckptr:
         state = ckptr.restore(path)
-    params = state.get("params", state) if isinstance(state, dict) else getattr(state, "params", state)
-    return save_sharded_checkpoint(params, output_dir, max_shard_size=max_shard_size)
+    tree = state
+    if params_only:
+        tree = state.get("params", state) if isinstance(state, dict) else getattr(state, "params", state)
+    return save_sharded_checkpoint(tree, output_dir, max_shard_size=max_shard_size)
 
 
 def merge_command(args) -> dict:
-    index = merge_weights(args.checkpoint_dir, args.output_dir, max_shard_size=args.max_shard_size)
+    index = merge_weights(
+        args.checkpoint_dir, args.output_dir,
+        max_shard_size=args.max_shard_size, params_only=not args.full_state,
+    )
     n = len(set(index["weight_map"].values()))
     print(f"Merged checkpoint written to {args.output_dir} ({n} safetensors file(s)).")
     return index

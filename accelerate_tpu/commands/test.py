@@ -30,10 +30,15 @@ def test_command_parser(subparsers=None) -> argparse.ArgumentParser:
 
 
 def test_command(args) -> int:
+    import os
+
     script = Path(__file__).parent.parent / "test_utils" / "scripts" / "test_script.py"
     from types import SimpleNamespace
 
     from .launch import launch_command
+
+    if args.on_device:
+        os.environ["ACCELERATE_SELF_TEST_ON_DEVICE"] = "1"
 
     launch_args = SimpleNamespace(
         cpu=not args.on_device,

@@ -54,6 +54,8 @@ class LlamaConfig:
     attn_impl: str = "auto"  # "auto" | "flash" | "xla"
     remat: bool = True       # jax.checkpoint each block (activation checkpointing)
     scan_layers: bool = False  # lax.scan over stacked layer params (fast compile)
+    use_fp8: bool = False    # fp8-quantized projections (ops/fp8.py, the TE-swap analog)
+    fp8_format: str = "HYBRID"
 
     @property
     def head_dim(self) -> int:
@@ -224,21 +226,34 @@ def _attention(q, k, v, mask, cfg: LlamaConfig):
     return _attention_xla(q, k, v, mask, cfg)
 
 
+def _proj(h, w, cfg: LlamaConfig):
+    """Projection matmul: plain bf16, fp8-quantized (cfg.use_fp8, the TE-swap analog), or a
+    fused dequant-matmul when the weight leaf is int8/int4-quantized (the bnb-swap analog)."""
+    from ..ops.quantization import QuantizedWeight, quant_matmul
+
+    if isinstance(w, QuantizedWeight):
+        return quant_matmul(h, w, out_dtype=cfg.dtype)
+    if cfg.use_fp8:
+        from ..ops.fp8 import fp8_dot
+
+        return fp8_dot(h, w, cfg.fp8_format)
+    return h @ w.astype(cfg.dtype)
+
+
 def _block(x, layer, positions, mask, cfg: LlamaConfig):
     B, S, D = x.shape
-    dtype = cfg.dtype
     h = _rms_norm(x, layer["ln_attn"], cfg.norm_eps)
-    q = (h @ layer["wq"].astype(dtype)).reshape(B, S, cfg.n_heads, cfg.head_dim)
-    k = (h @ layer["wk"].astype(dtype)).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
-    v = (h @ layer["wv"].astype(dtype)).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+    q = _proj(h, layer["wq"], cfg).reshape(B, S, cfg.n_heads, cfg.head_dim)
+    k = _proj(h, layer["wk"], cfg).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+    v = _proj(h, layer["wv"], cfg).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     attn = _attention(q, k, v, mask, cfg).reshape(B, S, cfg.n_heads * cfg.head_dim)
-    x = x + attn @ layer["wo"].astype(dtype)
+    x = x + _proj(attn, layer["wo"], cfg)
     h = _rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
-    gate = jax.nn.silu(h @ layer["w_gate"].astype(dtype))
-    up = h @ layer["w_up"].astype(dtype)
-    x = x + (gate * up) @ layer["w_down"].astype(dtype)
+    gate = jax.nn.silu(_proj(h, layer["w_gate"], cfg))
+    up = _proj(h, layer["w_up"], cfg)
+    x = x + _proj(gate * up, layer["w_down"], cfg)
     return x
 
 

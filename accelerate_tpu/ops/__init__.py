@@ -1,5 +1,15 @@
-"""Compute & collective ops: in-jit collectives and Pallas kernels."""
+"""Compute & collective ops: in-jit collectives, Pallas kernels, fp8 and quantized matmuls."""
 
+from .fp8 import DelayedScalingState, delayed_scales, fp8_dot, fp8_linear
+from .quantization import (
+    BnbQuantizationConfig,
+    QuantizedWeight,
+    dequantize_model,
+    dequantize_weight,
+    load_and_quantize_model,
+    quant_matmul,
+    quantize_weight,
+)
 from .collectives import (
     all_gather,
     all_to_all,

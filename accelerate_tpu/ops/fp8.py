@@ -1,0 +1,225 @@
+"""FP8 training ops — the TPU-native replacement for TransformerEngine / torchao / MS-AMP.
+
+Reference delegation points this file replaces with first-class XLA:
+- ``utils/transformer_engine.py`` (convert_model Linear→te.Linear, fp8 recipes
+  ``dataclasses.py:314-388``) — module swap onto CUDA kernels.
+- ``utils/ao.py`` ``convert_model_to_fp8_ao``; ``_prepare_msamp`` (``accelerator.py:2164``).
+
+TPU-native design: XLA has native fp8 dtypes (``float8_e4m3fn`` forward / ``float8_e5m2``
+gradient — the "HYBRID" recipe) and ``lax.dot_general`` on fp8 inputs lowers to the hardware
+scaled-matmul where the generation supports it (emulated in bf16 otherwise, still halving HBM
+traffic for weights/activations that are stored quantized). There is no module swap: models
+call :func:`fp8_dot` (a ``custom_vjp``) in place of ``@``.
+
+Two scaling modes, mirroring TE's recipes:
+- **current scaling** (default, stateless): per-tensor scale from the tensor's own amax.
+- **delayed scaling** (:class:`DelayedScalingState`): scales derived from a rolling amax
+  history (window ``amax_history_len``, reduction ``amax_compute_algo``), updated once per
+  step — the state threads through the train step as a pytree, replacing TE's module buffers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "FP8_MAX",
+    "Format",
+    "compute_scale",
+    "quantize",
+    "dequantize",
+    "fp8_dot",
+    "fp8_linear",
+    "DelayedScalingState",
+    "delayed_scales",
+]
+
+# Maximum representable magnitude per fp8 format.
+FP8_MAX = {
+    jnp.float8_e4m3fn: 448.0,
+    jnp.float8_e5m2: 57344.0,
+}
+
+
+class Format:
+    """Recipe formats (reference ``dataclasses.py:314`` fp8_format choices)."""
+
+    E4M3 = "E4M3"      # e4m3 everywhere
+    HYBRID = "HYBRID"  # e4m3 forward, e5m2 backward (the TE default)
+
+
+def _fmt_dtypes(fp8_format: str):
+    if fp8_format == Format.E4M3:
+        return jnp.float8_e4m3fn, jnp.float8_e4m3fn
+    if fp8_format == Format.HYBRID:
+        return jnp.float8_e4m3fn, jnp.float8_e5m2
+    raise ValueError(f"unknown fp8 format {fp8_format!r}")
+
+
+def compute_scale(amax: jax.Array, fp8_dtype, margin: int = 0) -> jax.Array:
+    """TE-style scale: largest power of two with ``amax * scale <= fp8_max / 2**margin``."""
+    fp8_max = FP8_MAX[fp8_dtype]
+    amax = jnp.maximum(amax.astype(jnp.float32), 1e-12)
+    exp = jnp.floor(jnp.log2(fp8_max / amax)) - margin
+    return jnp.exp2(exp)
+
+
+def quantize(x: jax.Array, scale: jax.Array, fp8_dtype) -> jax.Array:
+    """Scale then saturate-cast to fp8. ``scale`` multiplies x into the representable range."""
+    fp8_max = FP8_MAX[fp8_dtype]
+    scaled = jnp.clip(x.astype(jnp.float32) * scale, -fp8_max, fp8_max)
+    return scaled.astype(fp8_dtype)
+
+
+def dequantize(x: jax.Array, scale: jax.Array, dtype=jnp.float32) -> jax.Array:
+    return (x.astype(jnp.float32) / scale).astype(dtype)
+
+
+def _scaled_dot(x_q, w_q, x_scale, w_scale, out_dtype):
+    """fp8 × fp8 dot with fp32 accumulation, rescaled back to real magnitude.
+
+    ``preferred_element_type=float32`` lets XLA pick the native fp8 MXU path when the TPU
+    generation has one; elsewhere it widens — numerics are identical either way.
+    """
+    y = jax.lax.dot_general(
+        x_q, w_q,
+        dimension_numbers=(((x_q.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return (y / (x_scale * w_scale)).astype(out_dtype)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _fp8_dot_impl(x, w, scales, fp8_format: str, margin: int):
+    """``scales``: fp32 [3] array (x, w, grad) — NaN entries mean "current scaling"."""
+    y, _ = _fp8_dot_fwd(x, w, scales, fp8_format, margin)
+    return y
+
+
+def _pick_scale(provided, tensor, fp8_dtype, margin):
+    current = compute_scale(jnp.max(jnp.abs(tensor)), fp8_dtype, margin)
+    return jnp.where(jnp.isnan(provided), current, provided)
+
+
+def _fp8_dot_fwd(x, w, scales, fp8_format, margin):
+    fwd_dtype, _ = _fmt_dtypes(fp8_format)
+    x_scale = _pick_scale(scales[0], x, fwd_dtype, margin)
+    w_scale = _pick_scale(scales[1], w, fwd_dtype, margin)
+    x_q = quantize(x, x_scale, fwd_dtype)
+    w_q = quantize(w, w_scale, fwd_dtype)
+    y = _scaled_dot(x_q, w_q, x_scale, w_scale, x.dtype)
+    # Zero-size carriers keep the primal dtypes through the residual pytree (dtype objects
+    # themselves are not valid pytree leaves under jit).
+    x_tag = jnp.zeros((0,), x.dtype)
+    w_tag = jnp.zeros((0,), w.dtype)
+    return y, (x_q, w_q, x_scale, w_scale, scales[2], x_tag, w_tag)
+
+
+def _fp8_dot_bwd(fp8_format, margin, residuals, g):
+    _, bwd_dtype = _fmt_dtypes(fp8_format)
+    x_q, w_q, x_scale, w_scale, g_scale_in, x_tag, w_tag = residuals
+    x_dtype, w_dtype = x_tag.dtype, w_tag.dtype
+    g_scale = _pick_scale(g_scale_in, g, bwd_dtype, margin)
+    g_q = quantize(g, g_scale, bwd_dtype)
+    # dx = g @ w.T : contract g's last dim with w's output dim.
+    dx = jax.lax.dot_general(
+        g_q, w_q,
+        dimension_numbers=(((g_q.ndim - 1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) / (g_scale * w_scale)
+    # dw = x.T @ g : contract every batch dim.
+    batch_dims = tuple(range(x_q.ndim - 1))
+    dw = jax.lax.dot_general(
+        x_q, g_q,
+        dimension_numbers=((batch_dims, batch_dims), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) / (x_scale * g_scale)
+    # Cotangent dtypes must match the primal dtypes (bf16 activations under mixed precision).
+    return dx.astype(x_dtype), dw.astype(w_dtype), jnp.zeros((3,), jnp.float32)
+
+
+_fp8_dot_impl.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)
+
+def fp8_dot(
+    x: jax.Array,
+    w: jax.Array,
+    fp8_format: str = Format.HYBRID,
+    margin: int = 0,
+    scales: Optional[jax.Array] = None,
+):
+    """``x @ w`` with fp8-quantized operands (forward e4m3; backward per ``fp8_format``).
+
+    ``scales``: optional fp32 ``[3]`` array ``(x_scale, w_scale, grad_scale)`` from
+    :func:`delayed_scales`; None selects current scaling (each tensor's own amax, stateless).
+    """
+    if scales is None:
+        scales = jnp.full((3,), jnp.nan, jnp.float32)
+    return _fp8_dot_impl(x, w, scales, fp8_format, margin)
+
+
+def fp8_linear(x, w, b=None, fp8_format: str = Format.HYBRID, margin: int = 0, scales=None):
+    """Linear layer on :func:`fp8_dot` (the ``te.Linear`` swap target)."""
+    y = fp8_dot(x, w, fp8_format, margin, scales)
+    if b is not None:
+        y = y + b.astype(y.dtype)
+    return y
+
+
+# ------------------------------------------------------------------------ delayed scaling
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class DelayedScalingState:
+    """Rolling amax history per quantized tensor role (x / w / grad).
+
+    The functional replacement for TE's per-module fp8 buffers: carried in the user's train
+    state, updated once per step with the step's observed amaxes.
+    ``history``: [3, amax_history_len] fp32 (rows: x, w, grad).
+    """
+
+    history: jax.Array
+    step: jax.Array
+
+    @classmethod
+    def init(cls, amax_history_len: int = 16) -> "DelayedScalingState":
+        return cls(
+            history=jnp.zeros((3, amax_history_len), jnp.float32),
+            step=jnp.zeros((), jnp.int32),
+        )
+
+    def update(self, x_amax, w_amax, g_amax) -> "DelayedScalingState":
+        idx = self.step % self.history.shape[1]
+        new = self.history.at[:, idx].set(jnp.stack([x_amax, w_amax, g_amax]).astype(jnp.float32))
+        return DelayedScalingState(history=new, step=self.step + 1)
+
+
+def delayed_scales(
+    state: DelayedScalingState,
+    fp8_format: str = Format.HYBRID,
+    margin: int = 0,
+    amax_compute_algo: str = "max",
+):
+    """fp32 [3] scales (x, w, grad) from the history (``amax_compute_algo``: max|most_recent).
+
+    Suitable to pass straight to :func:`fp8_dot`'s ``scales``. Positions whose history is still
+    all-zero come out NaN, which :func:`fp8_dot` treats as "fall back to current scaling" — the
+    warm-up behavior TE gets from its ``interval`` bootstrapping.
+    """
+    fwd_dtype, bwd_dtype = _fmt_dtypes(fp8_format)
+    if amax_compute_algo == "max":
+        amaxes = jnp.max(state.history, axis=1)
+    elif amax_compute_algo == "most_recent":
+        idx = (state.step - 1) % state.history.shape[1]
+        amaxes = state.history[:, idx]
+    else:
+        raise ValueError(f"unknown amax_compute_algo {amax_compute_algo!r}")
+    scales = jnp.stack([
+        compute_scale(amaxes[0], fwd_dtype, margin),
+        compute_scale(amaxes[1], fwd_dtype, margin),
+        compute_scale(amaxes[2], bwd_dtype, margin),
+    ])
+    return jnp.where(amaxes > 0, scales, jnp.nan)

@@ -20,9 +20,20 @@ import sys
 
 
 def _ensure_backend():
-    # When run bare (not via the launcher), default to the 8-device CPU simulator.
-    if "ACCELERATE_USE_CPU" not in os.environ and os.environ.get("JAX_PLATFORMS") != "cpu":
+    """Default to the 8-device CPU simulator unless explicitly told to stay on-device.
+
+    ``accelerate-tpu test --on-device`` sets ACCELERATE_SELF_TEST_ON_DEVICE; otherwise — bare
+    runs included — the suite exercises real 8-way mesh/collective behavior on CPU. The
+    device-count XLA flag takes effect at backend-client creation, so setting it here works
+    even when a sitecustomize imported jax earlier, as long as no devices were touched yet.
+    """
+    if os.environ.get("ACCELERATE_SELF_TEST_ON_DEVICE"):
         return
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        # Bare run: default to 8 devices. A launcher-provided count is respected.
+        os.environ["XLA_FLAGS"] = f"{flags} --xla_force_host_platform_device_count=8".strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
     import jax
 
     jax.config.update("jax_platforms", "cpu")

@@ -151,6 +151,31 @@ class DistributedInitKwargs(KwargsHandler):
 
 
 @dataclass
+class FP8RecipeKwargs(KwargsHandler):
+    """fp8 recipe knobs (reference ``dataclasses.py:295-434`` TE/ao/msamp recipe kwargs).
+
+    Consumed by ``ops/fp8.py`` instead of a CUDA library: ``fp8_format`` picks the dtype pair
+    (HYBRID = e4m3 fwd / e5m2 bwd), ``margin`` backs the scale off by 2^margin,
+    ``amax_history_len``/``amax_compute_algo`` parameterize delayed scaling
+    (``DelayedScalingState``). ``use_delayed_scaling=False`` = stateless current scaling.
+    """
+
+    fp8_format: str = "HYBRID"  # HYBRID | E4M3
+    margin: int = 0
+    interval: int = 1
+    amax_history_len: int = 16
+    amax_compute_algo: str = "max"  # max | most_recent
+    use_delayed_scaling: bool = False
+
+    def __post_init__(self):
+        self.fp8_format = self.fp8_format.upper()
+        if self.fp8_format not in ("HYBRID", "E4M3"):
+            raise ValueError("`fp8_format` must be HYBRID or E4M3.")
+        if self.amax_compute_algo not in ("max", "most_recent"):
+            raise ValueError("`amax_compute_algo` must be max or most_recent.")
+
+
+@dataclass
 class GradientAccumulationPlugin(KwargsHandler):
     """Reference ``dataclasses.py:920``."""
 

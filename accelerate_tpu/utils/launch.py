@@ -64,9 +64,12 @@ def _common_env(args: Any) -> dict[str, str]:
     # Virtual-device CPU simulation (--num-virtual-devices): the test backbone.
     nvd = getattr(args, "num_virtual_devices", None)
     if nvd:
-        prev = os.environ.get("XLA_FLAGS", "")
-        if "xla_force_host_platform_device_count" not in prev:
-            env["XLA_FLAGS"] = f"{prev} --xla_force_host_platform_device_count={nvd}".strip()
+        # Replace any inherited device-count flag — the explicit CLI value must win.
+        prev = [
+            f for f in os.environ.get("XLA_FLAGS", "").split()
+            if "xla_force_host_platform_device_count" not in f
+        ]
+        env["XLA_FLAGS"] = " ".join([*prev, f"--xla_force_host_platform_device_count={nvd}"])
         env[f"{ENV_PREFIX}USE_CPU"] = "true"
         env["JAX_PLATFORMS"] = "cpu"
     return env
