@@ -270,6 +270,17 @@ class Accelerator:
             from .utils.dataclasses import FP8RecipeKwargs
 
             self.fp8_recipe = FP8RecipeKwargs()
+        if self.fp8_recipe is not None:
+            # Install the recipe as the process default consulted by ops.fp8.fp8_dot.
+            from .ops.fp8 import set_default_recipe
+
+            set_default_recipe(self.fp8_recipe.fp8_format, self.fp8_recipe.margin)
+            if self.fp8_recipe.use_delayed_scaling:
+                logger.warning(
+                    "FP8RecipeKwargs.use_delayed_scaling: delayed scaling is stateful — thread "
+                    "a DelayedScalingState through your step and pass delayed_scales(state) to "
+                    "fp8_dot; the flag alone does not enable it."
+                )
 
         self.state = AcceleratorState(
             **({"distributed_init_kwargs": distributed_init_kwargs} if distributed_init_kwargs else {}),

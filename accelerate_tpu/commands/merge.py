@@ -28,6 +28,8 @@ def merge_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("--full-state", "--full_state", action="store_true",
                         help="Export the whole train state (optimizer moments, counters) "
                              "instead of only the params subtree.")
+    parser.add_argument("--params-only", "--params_only", action="store_true",
+                        help="Deprecated no-op (params-only is the default; see --full-state).")
     if subparsers is not None:
         parser.set_defaults(func=merge_command)
     return parser

@@ -55,7 +55,12 @@ class LlamaConfig:
     remat: bool = True       # jax.checkpoint each block (activation checkpointing)
     scan_layers: bool = False  # lax.scan over stacked layer params (fast compile)
     use_fp8: bool = False    # fp8-quantized projections (ops/fp8.py, the TE-swap analog)
-    fp8_format: str = "HYBRID"
+    fp8_format: Optional[str] = None  # None → the process recipe (FP8RecipeKwargs) decides
+    # Mixture-of-Experts (Mixtral-style): 0 = dense MLP. Experts shard over the mesh "ep" axis.
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -85,26 +90,46 @@ CONFIGS = {
         vocab_size=512, d_model=256, n_layers=4, n_heads=8, n_kv_heads=4, d_ff=512,
         max_seq=512, remat=False,
     ),
+    "mixtral-8x7b": LlamaConfig(
+        vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8, d_ff=14336,
+        rope_theta=1e6, max_seq=32768, moe_experts=8, moe_top_k=2,
+    ),
+    "moe-tiny": LlamaConfig(
+        vocab_size=256, d_model=64, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=128,
+        max_seq=128, remat=False, moe_experts=4, moe_top_k=2,
+    ),
 }
 
 
 # --------------------------------------------------------------------------------- params
 def _layer_params(cfg: LlamaConfig, key) -> dict:
-    k = jax.random.split(key, 7)
+    k = jax.random.split(key, 8)
     D, H, K, hd, F = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff
     s_in = 1.0 / math.sqrt(D)
     s_ff = 1.0 / math.sqrt(F)
-    return {
+    params = {
         "ln_attn": jnp.ones((D,), jnp.float32),
         "wq": jax.random.normal(k[0], (D, H * hd), jnp.float32) * s_in,
         "wk": jax.random.normal(k[1], (D, K * hd), jnp.float32) * s_in,
         "wv": jax.random.normal(k[2], (D, K * hd), jnp.float32) * s_in,
         "wo": jax.random.normal(k[3], (H * hd, D), jnp.float32) * s_in,
         "ln_mlp": jnp.ones((D,), jnp.float32),
-        "w_gate": jax.random.normal(k[4], (D, F), jnp.float32) * s_in,
-        "w_up": jax.random.normal(k[5], (D, F), jnp.float32) * s_in,
-        "w_down": jax.random.normal(k[6], (F, D), jnp.float32) * s_ff,
     }
+    if cfg.moe_experts > 0:
+        E = cfg.moe_experts
+        params["moe"] = {
+            "w_router": jax.random.normal(k[7], (D, E), jnp.float32) * s_in,
+            "w_gate": jax.random.normal(k[4], (E, D, F), jnp.float32) * s_in,
+            "w_up": jax.random.normal(k[5], (E, D, F), jnp.float32) * s_in,
+            "w_down": jax.random.normal(k[6], (E, F, D), jnp.float32) * s_ff,
+        }
+    else:
+        params.update({
+            "w_gate": jax.random.normal(k[4], (D, F), jnp.float32) * s_in,
+            "w_up": jax.random.normal(k[5], (D, F), jnp.float32) * s_in,
+            "w_down": jax.random.normal(k[6], (F, D), jnp.float32) * s_ff,
+        })
+    return params
 
 
 def init_params(cfg: LlamaConfig, key: Optional[jax.Array] = None) -> dict:
@@ -142,12 +167,22 @@ def partition_specs(cfg: LlamaConfig) -> dict:
         "wv": P(None, TENSOR_AXIS),
         "wo": P(TENSOR_AXIS, None),
         "ln_mlp": P(),
-        "w_gate": P(None, TENSOR_AXIS),
-        "w_up": P(None, TENSOR_AXIS),
-        "w_down": P(TENSOR_AXIS, None),
     }
+    if cfg.moe_experts > 0:
+        from ..ops.moe import expert_partition_specs
+
+        layer["moe"] = expert_partition_specs()
+    else:
+        layer.update({
+            "w_gate": P(None, TENSOR_AXIS),
+            "w_up": P(None, TENSOR_AXIS),
+            "w_down": P(TENSOR_AXIS, None),
+        })
     if cfg.scan_layers:
-        layer = {k: P(None, *v) for k, v in layer.items()}  # leading stacked-layer dim
+        # Leading stacked-layer dim on every leaf spec (handles the nested moe subtree).
+        layer = jax.tree_util.tree_map(
+            lambda spec: P(None, *spec), layer, is_leaf=lambda s: isinstance(s, P)
+        )
         layers: Any = layer
     else:
         layers = [dict(layer) for _ in range(cfg.n_layers)]
@@ -163,12 +198,9 @@ def partition_specs(cfg: LlamaConfig) -> dict:
 
 # -------------------------------------------------------------------------------- forward
 def _maybe_shard(x: jax.Array, spec: P) -> jax.Array:
-    """Apply a sharding constraint only when a mesh context is active (jax.set_mesh);
-    lets the same model code run in plain single-device baselines."""
-    mesh = jax.sharding.get_abstract_mesh()
-    if mesh is None or mesh.empty:
-        return x
-    return jax.lax.with_sharding_constraint(x, spec)
+    from ..ops.collectives import maybe_shard
+
+    return maybe_shard(x, spec)
 
 
 def _rms_norm(x: jax.Array, gamma: jax.Array, eps: float) -> jax.Array:
@@ -241,6 +273,7 @@ def _proj(h, w, cfg: LlamaConfig):
 
 
 def _block(x, layer, positions, mask, cfg: LlamaConfig):
+    """One transformer block → (x, moe_aux_loss) (aux is 0.0 for dense MLPs)."""
     B, S, D = x.shape
     h = _rms_norm(x, layer["ln_attn"], cfg.norm_eps)
     q = _proj(h, layer["wq"], cfg).reshape(B, S, cfg.n_heads, cfg.head_dim)
@@ -251,10 +284,19 @@ def _block(x, layer, positions, mask, cfg: LlamaConfig):
     attn = _attention(q, k, v, mask, cfg).reshape(B, S, cfg.n_heads * cfg.head_dim)
     x = x + _proj(attn, layer["wo"], cfg)
     h = _rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
+    if cfg.moe_experts > 0:
+        from ..ops.moe import moe_mlp
+
+        y, aux = moe_mlp(
+            h, layer["moe"], layer["moe"]["w_router"],
+            top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+            compute_dtype=cfg.dtype,
+        )
+        return x + y, aux
     gate = jax.nn.silu(_proj(h, layer["w_gate"], cfg))
     up = _proj(h, layer["w_up"], cfg)
     x = x + _proj(gate * up, layer["w_down"], cfg)
-    return x
+    return x, jnp.zeros((), jnp.float32)
 
 
 def forward(
@@ -263,8 +305,10 @@ def forward(
     cfg: LlamaConfig,
     positions: Optional[jax.Array] = None,
     shard_activations: bool = True,
-) -> jax.Array:
-    """Causal LM: tokens [B, S] → logits [B, S, V] (fp32).
+    return_aux: bool = False,
+):
+    """Causal LM: tokens [B, S] → logits [B, S, V] (fp32); with ``return_aux``, also the summed
+    MoE load-balancing loss.
 
     Activation sharding constraints pin the batch dim to ``(dp, fsdp)`` and the sequence dim
     to ``sp`` so GSPMD propagates a consistent layout through every block (naive sequence
@@ -283,23 +327,28 @@ def forward(
     if cfg.remat:
         block = jax.checkpoint(_block, static_argnums=(4,))
 
+    aux_total = jnp.zeros((), jnp.float32)
     if cfg.scan_layers:
         def scan_body(carry, layer):
-            out = block(carry, layer, positions, mask, cfg)
+            out, aux = block(carry, layer, positions, mask, cfg)
             if shard_activations:
                 out = _maybe_shard(out, P(BATCH_AXES, SEQUENCE_AXIS, None))
-            return out, None
+            return out, aux
 
-        x, _ = jax.lax.scan(scan_body, x, params["layers"])
+        x, auxes = jax.lax.scan(scan_body, x, params["layers"])
+        aux_total = jnp.sum(auxes)
     else:
         for layer in params["layers"]:
-            x = block(x, layer, positions, mask, cfg)
+            x, aux = block(x, layer, positions, mask, cfg)
+            aux_total = aux_total + aux
             if shard_activations:
                 x = _maybe_shard(x, P(BATCH_AXES, SEQUENCE_AXIS, None))
     x = _rms_norm(x, params["ln_f"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = x @ head.astype(dtype)
-    return logits.astype(jnp.float32)
+    logits = (x @ head.astype(dtype)).astype(jnp.float32)
+    if return_aux:
+        return logits, aux_total
+    return logits
 
 
 def loss_fn(
@@ -311,13 +360,17 @@ def loss_fn(
     """Next-token cross-entropy over batch {'tokens': [B, S+1]} with optional 'mask'."""
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(params, inputs, cfg)
+    logits, aux = forward(params, inputs, cfg, return_aux=True)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
     if "mask" in batch:
         mask = batch["mask"][:, 1:].astype(jnp.float32)
-        return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
-    return -jnp.mean(ll)
+        ce = -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    else:
+        ce = -jnp.mean(ll)
+    if cfg.moe_experts > 0:
+        return ce + cfg.moe_aux_weight * aux
+    return ce
 
 
 @partial(jax.jit, static_argnames=("cfg",))
@@ -356,7 +409,7 @@ def forward_streamed(
     x = embed.astype(dtype)[tokens]
     prefixes = [f"layers/{i}" for i in range(cfg.n_layers)]
     for _, layer in stream_blocks(dispatched, prefixes, prefetch=prefetch):
-        x = _block_jit(x, layer, positions, mask, cfg=cfg)
+        x, _ = _block_jit(x, layer, positions, mask, cfg=cfg)
     ln_f = dispatched.fetch("ln_f")
     x = _rms_norm(x, ln_f, cfg.norm_eps)
     head = embed.T if cfg.tie_embeddings else dispatched.fetch("lm_head")
@@ -367,7 +420,8 @@ def forward_streamed(
 def num_params(cfg: LlamaConfig) -> int:
     """Analytic parameter count (used by MFU computation in bench)."""
     D, F, V, H, K, hd = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    per_layer = D * H * hd + 2 * D * K * hd + H * hd * D + 3 * D * F + 2 * D
+    mlp = 3 * D * F if cfg.moe_experts == 0 else cfg.moe_experts * 3 * D * F + D * cfg.moe_experts
+    per_layer = D * H * hd + 2 * D * K * hd + H * hd * D + mlp + 2 * D
     total = V * D + cfg.n_layers * per_layer + D
     if not cfg.tie_embeddings:
         total += D * V

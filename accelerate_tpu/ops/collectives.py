@@ -43,6 +43,20 @@ def psum(x, axis_name: Optional[AxisNames] = None):
     return jax.tree_util.tree_map(lambda t: lax.psum(t, _axes(axis_name)), x)
 
 
+def maybe_shard(x, spec, require_axis: Optional[str] = None):
+    """Apply a sharding constraint only when a mesh context is active (``jax.set_mesh``) —
+    and, if ``require_axis`` is given, only when that axis exists in the mesh. Lets the same
+    model code run in plain single-device baselines."""
+    import jax
+
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is None or mesh.empty:
+        return x
+    if require_axis is not None and require_axis not in mesh.shape:
+        return x
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
 def pmean(x, axis_name: Optional[AxisNames] = None):
     return jax.tree_util.tree_map(lambda t: lax.pmean(t, _axes(axis_name)), x)
 

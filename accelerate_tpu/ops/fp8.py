@@ -53,6 +53,26 @@ class Format:
     HYBRID = "HYBRID"  # e4m3 forward, e5m2 backward (the TE default)
 
 
+# Process-wide recipe defaults, set by Accelerator(mixed_precision="fp8",
+# kwargs_handlers=[FP8RecipeKwargs(...)]) — consulted whenever a call site doesn't pass
+# explicit format/margin (the functional analog of TE's fp8_autocast recipe context).
+_DEFAULT_RECIPE = {"fp8_format": Format.HYBRID, "margin": 0}
+
+
+def set_default_recipe(fp8_format: Optional[str] = None, margin: Optional[int] = None) -> None:
+    if fp8_format is not None:
+        _DEFAULT_RECIPE["fp8_format"] = fp8_format.upper()
+    if margin is not None:
+        _DEFAULT_RECIPE["margin"] = int(margin)
+
+
+def _resolve(fp8_format, margin):
+    return (
+        _DEFAULT_RECIPE["fp8_format"] if fp8_format is None else fp8_format,
+        _DEFAULT_RECIPE["margin"] if margin is None else margin,
+    )
+
+
 def _fmt_dtypes(fp8_format: str):
     if fp8_format == Format.E4M3:
         return jnp.float8_e4m3fn, jnp.float8_e4m3fn
@@ -148,21 +168,23 @@ _fp8_dot_impl.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)
 def fp8_dot(
     x: jax.Array,
     w: jax.Array,
-    fp8_format: str = Format.HYBRID,
-    margin: int = 0,
+    fp8_format: Optional[str] = None,
+    margin: Optional[int] = None,
     scales: Optional[jax.Array] = None,
 ):
     """``x @ w`` with fp8-quantized operands (forward e4m3; backward per ``fp8_format``).
 
+    ``fp8_format``/``margin`` default to the process recipe (:func:`set_default_recipe`).
     ``scales``: optional fp32 ``[3]`` array ``(x_scale, w_scale, grad_scale)`` from
     :func:`delayed_scales`; None selects current scaling (each tensor's own amax, stateless).
     """
+    fp8_format, margin = _resolve(fp8_format, margin)
     if scales is None:
         scales = jnp.full((3,), jnp.nan, jnp.float32)
     return _fp8_dot_impl(x, w, scales, fp8_format, margin)
 
 
-def fp8_linear(x, w, b=None, fp8_format: str = Format.HYBRID, margin: int = 0, scales=None):
+def fp8_linear(x, w, b=None, fp8_format: Optional[str] = None, margin: Optional[int] = None, scales=None):
     """Linear layer on :func:`fp8_dot` (the ``te.Linear`` swap target)."""
     y = fp8_dot(x, w, fp8_format, margin, scales)
     if b is not None:

@@ -1,0 +1,132 @@
+"""Mixture-of-Experts layer with expert parallelism over the mesh "ep" axis.
+
+Reference delegation points this replaces (SURVEY.md §2.2 EP row): the reference only
+*recognizes* DeepSpeed MoE modules (``transformer_moe_cls_names`` ``dataclasses.py:1105``) and
+defers all routing/dispatch to DeepSpeed's CUDA all-to-all. Here MoE is first-class and
+TPU-idiomatic: routing builds dense one-hot dispatch/combine tensors (the GSPMD MoE pattern —
+einsums the MXU loves, no ragged scatter), expert weights carry an explicit PartitionSpec on
+the "ep" axis, and a ``with_sharding_constraint`` on the dispatched activations makes XLA
+insert the token all-to-all over ICI — the NCCL a2a analog is a compiler-inserted collective,
+not a library call.
+
+Components: top-k softmax router with capacity dropping, Switch/Mixtral-style load-balancing
+auxiliary loss, batched expert FFN (SwiGLU, matching the dense MLP).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..utils.constants import EXPERT_AXIS
+
+__all__ = ["router_topk", "load_balancing_loss", "moe_mlp", "expert_partition_specs"]
+
+
+def router_topk(
+    x: jax.Array, w_router: jax.Array, top_k: int
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k softmax routing.
+
+    x [T, D], w_router [D, E] → (logits [T, E], gates [T, k] renormalized, idx [T, k]).
+    Router math in fp32 regardless of compute dtype (routing is precision-sensitive).
+    """
+    logits = x.astype(jnp.float32) @ w_router.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, idx = jax.lax.top_k(probs, top_k)
+    gates = gates / jnp.maximum(jnp.sum(gates, axis=-1, keepdims=True), 1e-9)
+    return logits, gates, idx
+
+
+def load_balancing_loss(logits: jax.Array, idx: jax.Array, num_experts: int) -> jax.Array:
+    """Switch-Transformer auxiliary loss: E · Σ_e f_e · p_e.
+
+    f_e = fraction of tokens whose top-1 lands on expert e; p_e = mean router probability of
+    e. Minimized (=1) at uniform balance.
+    """
+    probs = jax.nn.softmax(logits, axis=-1)
+    top1 = idx[..., 0]
+    f = jnp.mean(jax.nn.one_hot(top1, num_experts, dtype=jnp.float32), axis=0)
+    p = jnp.mean(probs, axis=0)
+    return num_experts * jnp.sum(f * p)
+
+
+def _capacity(tokens: int, num_experts: int, top_k: int, capacity_factor: float) -> int:
+    cap = int(tokens * top_k * capacity_factor / num_experts)
+    return max(cap, 1)
+
+
+def moe_mlp(
+    x: jax.Array,
+    experts: dict,
+    w_router: jax.Array,
+    top_k: int = 2,
+    capacity_factor: float = 1.25,
+    compute_dtype=jnp.bfloat16,
+    shard: bool = True,
+) -> tuple[jax.Array, jax.Array]:
+    """MoE SwiGLU FFN. x [B, S, D]; experts {w_gate/w_up [E, D, F], w_down [E, F, D]}.
+
+    Returns (y [B, S, D], aux_loss scalar). Tokens beyond an expert's capacity are dropped
+    (contribute zero through that expert) — the standard fixed-shape TPU formulation; with
+    ``capacity_factor ≥ top_k·E/…`` nothing drops.
+    """
+    B, S, D = x.shape
+    T = B * S
+    E = experts["w_gate"].shape[0]
+    C = _capacity(T, E, top_k, capacity_factor)
+
+    flat = x.reshape(T, D)
+    logits, gates, idx = router_topk(flat, w_router, top_k)
+    aux = load_balancing_loss(logits, idx, E)
+
+    # Position of each (token, choice) in its expert's buffer, via cumulative count over the
+    # flattened (k-major) assignment order; entries beyond capacity are dropped.
+    onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)           # [T, k, E]
+    flat_oh = onehot.transpose(1, 0, 2).reshape(T * top_k, E)  # k-major: top-1s claim slots first
+    pos_flat = jnp.cumsum(flat_oh, axis=0) - flat_oh           # [T*k, E]
+    pos = pos_flat.reshape(top_k, T, E).transpose(1, 0, 2)     # [T, k, E]
+    pos_tk = jnp.sum(pos * onehot, axis=-1)                    # [T, k] slot within chosen expert
+    keep = pos_tk < C
+
+    # Dense dispatch/combine tensors (GSPMD MoE): dispatch [T, E, C] bool, combine [T, E, C].
+    slot_oh = jax.nn.one_hot(jnp.where(keep, pos_tk, C), C + 1, dtype=compute_dtype)[..., :C]
+    dispatch = jnp.einsum("tke,tkc->tec", onehot.astype(compute_dtype), slot_oh)
+    combine = jnp.einsum("tk,tke,tkc->tec", gates.astype(compute_dtype),
+                         onehot.astype(compute_dtype), slot_oh)
+
+    xin = jnp.einsum("td,tec->ecd", flat.astype(compute_dtype), dispatch)  # [E, C, D]
+    if shard:
+        xin = _maybe_shard(xin, P(EXPERT_AXIS, None, None))
+
+    # Batched expert SwiGLU — expert dim sharded on "ep": XLA turns the dispatch einsum above
+    # into the token all-to-all, and each device computes only its local experts.
+    gate = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xin, experts["w_gate"].astype(compute_dtype)))
+    up = jnp.einsum("ecd,edf->ecf", xin, experts["w_up"].astype(compute_dtype))
+    out = jnp.einsum("ecf,efd->ecd", gate * up, experts["w_down"].astype(compute_dtype))
+    if shard:
+        out = _maybe_shard(out, P(EXPERT_AXIS, None, None))
+
+    y = jnp.einsum("ecd,tec->td", out, combine)  # combine: weighted return all-to-all
+    return y.reshape(B, S, D).astype(x.dtype), aux
+
+
+def expert_partition_specs() -> dict:
+    """PartitionSpecs for the expert weight dict: expert dim on "ep", ffn dim on "tp"."""
+    from ..utils.constants import TENSOR_AXIS
+
+    return {
+        "w_gate": P(EXPERT_AXIS, None, TENSOR_AXIS),
+        "w_up": P(EXPERT_AXIS, None, TENSOR_AXIS),
+        "w_down": P(EXPERT_AXIS, TENSOR_AXIS, None),
+        "w_router": P(),
+    }
+
+
+def _maybe_shard(x: jax.Array, spec: P) -> jax.Array:
+    from .collectives import maybe_shard
+
+    return maybe_shard(x, spec, require_axis=EXPERT_AXIS)

@@ -150,9 +150,9 @@ def dequantize_weight(qw: QuantizedWeight, dtype=jnp.float32) -> jax.Array:
 
 
 # -------------------------------------------------------------------------- pallas matmul
-def _int8_matmul_kernel(x_ref, w_ref, s_ref, o_ref, *, block_k, k_total):
+def _int8_matmul_kernel(x_ref, w_ref, s_ref, o_ref):
     """Tile matmul dequantizing int8 w in VMEM: HBM traffic stays int8."""
-    from jax.experimental import pallas as pl  # noqa: F401 (imported for clarity)
+    from jax.experimental import pallas as pl
 
     j = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -186,7 +186,7 @@ def _quant_matmul_pallas_int8(x, qw: QuantizedWeight, block_m=128, block_k=128, 
 
     grid = (xp.shape[0] // bm, wp.shape[1] // bn, xp.shape[1] // bk)
     out = pl.pallas_call(
-        partial(_int8_matmul_kernel, block_k=bk, k_total=xp.shape[1]),
+        _int8_matmul_kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, k: (i, k)),

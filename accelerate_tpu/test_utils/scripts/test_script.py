@@ -29,14 +29,17 @@ def _ensure_backend():
     """
     if os.environ.get("ACCELERATE_SELF_TEST_ON_DEVICE"):
         return
+    # Make the *host* platform 8-wide regardless — this flag does not force the cpu backend,
+    # it only sizes the CPU platform if that is what jax ends up on (read at client init).
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" not in flags:
-        # Bare run: default to 8 devices. A launcher-provided count is respected.
         os.environ["XLA_FLAGS"] = f"{flags} --xla_force_host_platform_device_count=8".strip()
-    os.environ["JAX_PLATFORMS"] = "cpu"
-    import jax
+    # Force cpu only when the launch context asked for it (accelerate-tpu test default /
+    # --cpu); a bare run on a TPU VM keeps validating the real device backend.
+    if os.environ.get("ACCELERATE_USE_CPU") or os.environ.get("JAX_PLATFORMS") == "cpu":
+        import jax
 
-    jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_platforms", "cpu")
 
 
 _ensure_backend()
