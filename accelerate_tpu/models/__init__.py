@@ -1,3 +1,3 @@
 """Model families shipped with the framework (flagship: llama; plus bert, gpt2, simple)."""
 
-from . import simple
+from . import bert, llama, simple

@@ -1,0 +1,202 @@
+"""BERT-family encoder for sequence classification — the ``nlp_example.py`` workhorse.
+
+The reference framework trains ``bert-base-cased`` on GLUE/MRPC in its flagship example
+(reference ``examples/nlp_example.py``) through ``transformers``; this framework ships the
+encoder natively so the same example runs TPU-first (sharding in the model definition, jitted
+step). Architecture: standard BERT-base — learned position/type embeddings, post-LN
+transformer blocks, GELU MLP, tanh pooler, classification head.
+
+Weights are compatible in shape with HF ``bert-base-*`` checkpoints (vocab 30522, d=768,
+L=12, H=12, ff=3072), loadable via ``utils/modeling.load_checkpoint_in_model`` after key-path
+mapping. ``partition_specs`` gives the Megatron TP layout; batch/sequence activation sharding
+matches llama's.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..utils.constants import BATCH_AXES, TENSOR_AXIS
+
+__all__ = ["BertConfig", "init_params", "forward", "loss_fn", "partition_specs", "CONFIGS"]
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq: int = 512
+    type_vocab_size: int = 2
+    num_labels: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: Any = jnp.bfloat16
+    remat: bool = False
+
+    @property
+    def head_dim(self) -> int:
+        return self.d_model // self.n_heads
+
+
+CONFIGS = {
+    "bert-base": BertConfig(),
+    "bert-large": BertConfig(d_model=1024, n_layers=24, n_heads=16, d_ff=4096),
+    "tiny": BertConfig(
+        vocab_size=512, d_model=64, n_layers=2, n_heads=4, d_ff=128, max_seq=128
+    ),
+}
+
+
+def _layer_params(cfg: BertConfig, key) -> dict:
+    k = jax.random.split(key, 6)
+    D, F = cfg.d_model, cfg.d_ff
+    s = 0.02
+    return {
+        "wq": jax.random.normal(k[0], (D, D), jnp.float32) * s,
+        "bq": jnp.zeros((D,), jnp.float32),
+        "wk": jax.random.normal(k[1], (D, D), jnp.float32) * s,
+        "bk": jnp.zeros((D,), jnp.float32),
+        "wv": jax.random.normal(k[2], (D, D), jnp.float32) * s,
+        "bv": jnp.zeros((D,), jnp.float32),
+        "wo": jax.random.normal(k[3], (D, D), jnp.float32) * s,
+        "bo": jnp.zeros((D,), jnp.float32),
+        "ln1": {"gamma": jnp.ones((D,), jnp.float32), "beta": jnp.zeros((D,), jnp.float32)},
+        "w_in": jax.random.normal(k[4], (D, F), jnp.float32) * s,
+        "b_in": jnp.zeros((F,), jnp.float32),
+        "w_out": jax.random.normal(k[5], (F, D), jnp.float32) * s,
+        "b_out": jnp.zeros((D,), jnp.float32),
+        "ln2": {"gamma": jnp.ones((D,), jnp.float32), "beta": jnp.zeros((D,), jnp.float32)},
+    }
+
+
+def init_params(cfg: BertConfig, key: Optional[jax.Array] = None) -> dict:
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    keys = jax.random.split(key, cfg.n_layers + 4)
+    s = 0.02
+    D = cfg.d_model
+    return {
+        "embed": {
+            "tokens": jax.random.normal(keys[0], (cfg.vocab_size, D), jnp.float32) * s,
+            "positions": jax.random.normal(keys[1], (cfg.max_seq, D), jnp.float32) * s,
+            "types": jax.random.normal(keys[2], (cfg.type_vocab_size, D), jnp.float32) * s,
+            "ln": {"gamma": jnp.ones((D,), jnp.float32), "beta": jnp.zeros((D,), jnp.float32)},
+        },
+        "layers": [_layer_params(cfg, keys[i + 3]) for i in range(cfg.n_layers)],
+        "pooler": {
+            "w": jax.random.normal(keys[-1], (D, D), jnp.float32) * s,
+            "b": jnp.zeros((D,), jnp.float32),
+        },
+        "classifier": {
+            "w": jnp.zeros((D, cfg.num_labels), jnp.float32),
+            "b": jnp.zeros((cfg.num_labels,), jnp.float32),
+        },
+    }
+
+
+def partition_specs(cfg: BertConfig) -> dict:
+    """Megatron TP layout: QKV/in column-parallel, O/out row-parallel."""
+    col, row = P(None, TENSOR_AXIS), P(TENSOR_AXIS, None)
+    ln = {"gamma": P(), "beta": P()}
+    layer = {
+        "wq": col, "bq": P(TENSOR_AXIS), "wk": col, "bk": P(TENSOR_AXIS),
+        "wv": col, "bv": P(TENSOR_AXIS), "wo": row, "bo": P(),
+        "ln1": dict(ln),
+        "w_in": col, "b_in": P(TENSOR_AXIS), "w_out": row, "b_out": P(),
+        "ln2": dict(ln),
+    }
+    return {
+        "embed": {"tokens": P(TENSOR_AXIS, None), "positions": P(), "types": P(), "ln": dict(ln)},
+        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "pooler": {"w": P(), "b": P()},
+        "classifier": {"w": P(), "b": P()},
+    }
+
+
+def _layer_norm(x, ln, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    normed = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (normed * ln["gamma"] + ln["beta"]).astype(x.dtype)
+
+
+def _maybe_shard(x):
+    from ..ops.collectives import maybe_shard
+
+    return maybe_shard(x, P(BATCH_AXES, None, None))
+
+
+def _block(x, layer, attn_mask, cfg: BertConfig):
+    B, S, D = x.shape
+    dtype = cfg.dtype
+    q = (x @ layer["wq"].astype(dtype) + layer["bq"].astype(dtype)).reshape(B, S, cfg.n_heads, cfg.head_dim)
+    k = (x @ layer["wk"].astype(dtype) + layer["bk"].astype(dtype)).reshape(B, S, cfg.n_heads, cfg.head_dim)
+    v = (x @ layer["wv"].astype(dtype) + layer["bv"].astype(dtype)).reshape(B, S, cfg.n_heads, cfg.head_dim)
+    scores = jnp.einsum("bshd,bthd->bhst", q, k) / math.sqrt(cfg.head_dim)
+    scores = jnp.where(attn_mask[:, None, None, :], scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(dtype)
+    attn = jnp.einsum("bhst,bthd->bshd", probs, v).reshape(B, S, D)
+    x = _layer_norm(x + attn @ layer["wo"].astype(dtype) + layer["bo"].astype(dtype), layer["ln1"], cfg.layer_norm_eps)
+    h = jax.nn.gelu(x @ layer["w_in"].astype(dtype) + layer["b_in"].astype(dtype), approximate=False)
+    x = _layer_norm(x + h @ layer["w_out"].astype(dtype) + layer["b_out"].astype(dtype), layer["ln2"], cfg.layer_norm_eps)
+    return x
+
+
+def forward(
+    params: dict,
+    input_ids: jax.Array,
+    attention_mask: Optional[jax.Array] = None,
+    token_type_ids: Optional[jax.Array] = None,
+    cfg: BertConfig = CONFIGS["bert-base"],
+) -> jax.Array:
+    """[B, S] ids → [B, num_labels] classification logits (fp32)."""
+    B, S = input_ids.shape
+    dtype = cfg.dtype
+    if attention_mask is None:
+        attention_mask = jnp.ones((B, S), jnp.bool_)
+    else:
+        attention_mask = attention_mask.astype(jnp.bool_)
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros((B, S), jnp.int32)
+    emb = params["embed"]
+    x = (
+        emb["tokens"][input_ids]
+        + emb["positions"][jnp.arange(S)][None, :, :]
+        + emb["types"][token_type_ids]
+    ).astype(dtype)
+    x = _layer_norm(x, emb["ln"], cfg.layer_norm_eps)
+    x = _maybe_shard(x)
+
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(_block, static_argnums=(3,))
+    for layer in params["layers"]:
+        x = block(x, layer, attention_mask, cfg)
+        x = _maybe_shard(x)
+
+    pooled = jnp.tanh(x[:, 0, :] @ params["pooler"]["w"].astype(dtype) + params["pooler"]["b"].astype(dtype))
+    logits = pooled @ params["classifier"]["w"].astype(dtype) + params["classifier"]["b"].astype(dtype)
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(params: dict, batch: dict, cfg: BertConfig) -> jax.Array:
+    """Cross-entropy over batch {input_ids, attention_mask?, token_type_ids?, labels}."""
+    logits = forward(
+        params,
+        batch["input_ids"],
+        batch.get("attention_mask"),
+        batch.get("token_type_ids"),
+        cfg,
+    )
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, batch["labels"][:, None], axis=-1).squeeze(-1)
+    return -jnp.mean(ll)

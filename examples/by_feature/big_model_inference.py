@@ -1,0 +1,63 @@
+"""by_feature: big-model inference (reference ``examples/big_model_inference`` benchmarks) —
+abstract init, auto device map with a deliberately tight budget, disk/host offload, and the
+double-buffered streamed forward.
+
+  python examples/by_feature/big_model_inference.py --smoke
+"""
+
+import argparse
+import dataclasses
+import tempfile
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from accelerate_tpu import dispatch_model, init_empty_weights
+from accelerate_tpu.models import llama
+from accelerate_tpu.utils.modeling import compute_module_sizes
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--model", default="debug", choices=list(llama.CONFIGS))
+    args = parser.parse_args()
+
+    cfg = dataclasses.replace(
+        llama.CONFIGS["tiny" if args.smoke else args.model], attn_impl="xla"
+    )
+    abstract = init_empty_weights(llama.init_params, cfg, jax.random.PRNGKey(0))
+    sizes = compute_module_sizes(abstract)
+    print(f"model size: {sizes[''] / 1e6:.1f} MB (abstract init allocated 0 bytes)")
+
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    # Budget: device fits the embed + one block; the rest spills to host RAM then disk.
+    budget = {0: sizes["embed"] + sizes["layers/0"] + 1, "cpu": 2 * sizes["layers/0"] + 1}
+    with tempfile.TemporaryDirectory() as offload_dir:
+        dispatched = dispatch_model(
+            params, "auto", max_memory=budget, offload_dir=offload_dir,
+            no_split_prefixes=[f"layers/{i}" for i in range(cfg.n_layers)],
+        )
+        print("placement footprint:", dispatched.memory_footprint())
+
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, cfg.vocab_size, size=(1, 32)), jnp.int32
+        )
+        t0 = time.perf_counter()
+        logits = llama.forward_streamed(dispatched, tokens, cfg)
+        _ = np.asarray(logits)
+        t1 = time.perf_counter()
+        logits2 = llama.forward_streamed(dispatched, tokens, cfg)
+        _ = np.asarray(logits2)
+        t2 = time.perf_counter()
+        print(f"streamed forward: cold {t1 - t0:.3f}s, warm {t2 - t1:.3f}s (prefetch pipeline)")
+
+        full = llama.forward(params, tokens, cfg, shard_activations=False)
+        err = float(jnp.max(jnp.abs(logits - full)))
+        print(f"max |streamed - resident| = {err:.4f} (bf16 noise)")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""by_feature: ZeRO-3/FSDP-equivalent sharded training + device memory tracking (reference
+``examples/by_feature/fsdp_with_peak_mem_tracking.py``). Params/grads/optimizer state shard
+over the "fsdp" mesh axis via GSPMD; memory comes from the PJRT ``memory_stats`` probe.
+
+  accelerate-tpu launch --num-virtual-devices 8 examples/by_feature/fsdp_with_peak_mem_tracking.py
+"""
+
+import argparse
+import os
+import sys
+
+import jax
+import optax
+
+from accelerate_tpu import Accelerator, FullyShardedDataParallelPlugin
+from accelerate_tpu.models import bert
+from accelerate_tpu.utils import set_seed
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from nlp_example import get_dataloaders  # noqa: E402
+
+
+def _device_mem_bytes() -> int:
+    stats = jax.local_devices()[0].memory_stats() or {}
+    return int(stats.get("bytes_in_use", 0) or stats.get("peak_bytes_in_use", 0) or 0)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--cpu", action="store_true")
+    args = parser.parse_args()
+
+    accelerator = Accelerator(
+        cpu=args.cpu,
+        mixed_precision="bf16",
+        fsdp_plugin=FullyShardedDataParallelPlugin(min_weight_size=1),
+    )
+    set_seed(42)
+    cfg = bert.CONFIGS["tiny"]
+    train_dl, _ = get_dataloaders(accelerator, 8, cfg, smoke=True)
+
+    before = _device_mem_bytes()
+    params = bert.init_params(cfg, jax.random.PRNGKey(0))
+    state = accelerator.create_train_state(
+        params, optax.adam(1e-3), partition_specs=bert.partition_specs(cfg)
+    )
+    embed = state.params["embed"]["tokens"]
+    accelerator.print(
+        f"distributed_type={accelerator.distributed_type} "
+        f"embed sharding replicated={embed.sharding.is_fully_replicated}"
+    )
+    train_dl = accelerator.prepare_data_loader(train_dl)
+    step = accelerator.build_train_step(lambda p, b: bert.loss_fn(p, b, cfg), max_grad_norm=1.0)
+    for batch in train_dl:
+        state, metrics = step(state, batch)
+    after = _device_mem_bytes()
+    accelerator.print(
+        f"loss={float(metrics['loss']):.4f}; device mem before={before} after={after} "
+        f"(delta {(after - before) / 1e6:.1f} MB — sharded state is 1/{accelerator.num_processes or 1} "
+        "of full per device)"
+    )
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,58 @@
+"""by_feature: gradient accumulation (reference
+``examples/by_feature/gradient_accumulation.py``). The jitted step accumulates N micro-batch
+gradients in its carry and applies the optimizer once per N — ``sync_gradients`` semantics
+preserved without DDP's ``no_sync``.
+
+  accelerate-tpu launch examples/by_feature/gradient_accumulation.py --smoke
+"""
+
+import argparse
+import os
+import sys
+
+import jax
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import bert
+from accelerate_tpu.utils import set_seed
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from nlp_example import get_dataloaders  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=4)
+    args = parser.parse_args()
+
+    accelerator = Accelerator(
+        cpu=args.cpu, gradient_accumulation_steps=args.gradient_accumulation_steps
+    )
+    set_seed(42)
+    cfg = bert.CONFIGS["tiny"]
+    train_dl, _ = get_dataloaders(accelerator, 8, cfg, smoke=True)
+
+    params = bert.init_params(cfg, jax.random.PRNGKey(0))
+    params, tx, train_dl = accelerator.prepare(params, optax.adam(1e-3), train_dl)
+    state = accelerator.create_train_state(params, tx)
+    step = accelerator.build_train_step(lambda p, b: bert.loss_fn(p, b, cfg))
+
+    micro_steps = 0
+    for batch in train_dl:
+        state, metrics = step(state, batch)
+        micro_steps += 1
+    applied = int(state.step)
+    expected = micro_steps // args.gradient_accumulation_steps
+    accelerator.print(
+        f"{micro_steps} micro-batches → {applied} optimizer steps "
+        f"(accumulation={args.gradient_accumulation_steps}); loss={float(metrics['loss']):.4f}"
+    )
+    assert applied == expected, (applied, expected)
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""by_feature: Local SGD (reference ``examples/by_feature/local_sgd.py``) — steps run without
+cross-host sync; parameters are averaged over DCN every ``local_sgd_steps``.
+
+  accelerate-tpu launch examples/by_feature/local_sgd.py --smoke
+"""
+
+import argparse
+import os
+import sys
+
+import jax
+import optax
+
+from accelerate_tpu import Accelerator, LocalSGD
+from accelerate_tpu.models import bert
+from accelerate_tpu.utils import set_seed
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from nlp_example import get_dataloaders  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--local_sgd_steps", type=int, default=8)
+    args = parser.parse_args()
+
+    accelerator = Accelerator(cpu=args.cpu)
+    set_seed(42)
+    cfg = bert.CONFIGS["tiny"]
+    train_dl, _ = get_dataloaders(accelerator, 8, cfg, smoke=True)
+
+    params = bert.init_params(cfg, jax.random.PRNGKey(0))
+    params, tx, train_dl = accelerator.prepare(params, optax.adam(1e-3), train_dl)
+    state = accelerator.create_train_state(params, tx)
+    step = accelerator.build_train_step(lambda p, b: bert.loss_fn(p, b, cfg))
+
+    with LocalSGD(accelerator=accelerator, local_sgd_steps=args.local_sgd_steps) as local_sgd:
+        for batch in train_dl:
+            state, metrics = step(state, batch)
+            state = local_sgd.step(state)
+    state = local_sgd.final_state or state
+    accelerator.print(f"final loss={float(metrics['loss']):.4f} after {int(state.step)} steps")
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""by_feature: automatic OOM batch-size finder (reference ``examples/by_feature/memory.py``) —
+``find_executable_batch_size`` halves the batch size whenever the wrapped body hits an XLA
+RESOURCE_EXHAUSTED, clearing compilation caches between attempts.
+
+  accelerate-tpu launch examples/by_feature/memory.py --smoke
+"""
+
+import argparse
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator, find_executable_batch_size
+from accelerate_tpu.models import bert
+from accelerate_tpu.utils import set_seed
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--starting_batch_size", type=int, default=64)
+    args = parser.parse_args()
+
+    accelerator = Accelerator(cpu=args.cpu)
+    set_seed(42)
+    cfg = bert.CONFIGS["tiny"]
+    rng = np.random.default_rng(0)
+
+    attempts = []
+
+    @find_executable_batch_size(starting_batch_size=args.starting_batch_size)
+    def inner_training_loop(batch_size):
+        attempts.append(batch_size)
+        # Simulate an OOM for oversized batches on the smoke path so the retry is visible.
+        if args.smoke and batch_size > 16:
+            raise RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating activations")
+        params = bert.init_params(cfg, jax.random.PRNGKey(0))
+        params, tx = accelerator.prepare(params, optax.adam(1e-3))
+        state = accelerator.create_train_state(params, tx)
+        step = accelerator.build_train_step(lambda p, b: bert.loss_fn(p, b, cfg))
+        batch = {
+            "input_ids": rng.integers(0, cfg.vocab_size, size=(batch_size, 32)).astype(np.int32),
+            "labels": rng.integers(0, 2, size=(batch_size,)).astype(np.int32),
+        }
+        state, metrics = step(state, batch)
+        return batch_size, float(metrics["loss"])
+
+    batch_size, loss = inner_training_loop()
+    accelerator.print(f"attempts={attempts} → executable batch size {batch_size}, loss={loss:.4f}")
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""by_feature: exact metrics under data parallelism (reference
+``examples/by_feature/multi_process_metrics.py``) — ``gather_for_metrics`` trims the
+end-of-dataloader duplicate padding so eval counts every sample exactly once.
+
+  accelerate-tpu launch --num-virtual-devices 8 examples/by_feature/multi_process_metrics.py
+"""
+
+import argparse
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import bert
+from accelerate_tpu.utils import set_seed
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from nlp_example import get_dataloaders  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--cpu", action="store_true")
+    args = parser.parse_args()
+
+    accelerator = Accelerator(cpu=args.cpu)
+    set_seed(42)
+    cfg = bert.CONFIGS["tiny"]
+    train_dl, eval_dl = get_dataloaders(accelerator, 8, cfg, smoke=True)
+
+    params = bert.init_params(cfg, jax.random.PRNGKey(0))
+    params, tx, train_dl, eval_dl = accelerator.prepare(params, optax.adam(1e-3), train_dl, eval_dl)
+    state = accelerator.create_train_state(params, tx)
+    step = accelerator.build_train_step(lambda p, b: bert.loss_fn(p, b, cfg))
+    eval_step = accelerator.build_eval_step(
+        lambda p, b: jnp.argmax(
+            bert.forward(p, b["input_ids"], b.get("attention_mask"), b.get("token_type_ids"), cfg),
+            axis=-1,
+        )
+    )
+    for batch in train_dl:
+        state, _ = step(state, batch)
+
+    n_samples = 0
+    correct = 0
+    for batch in eval_dl:
+        preds = eval_step(state.params, batch)
+        preds, refs = accelerator.gather_for_metrics((preds, batch["labels"]))
+        n_samples += int(np.asarray(refs).size)
+        correct += int(np.sum(np.asarray(preds) == np.asarray(refs)))
+
+    expected = eval_dl.total_dataset_length
+    accelerator.print(
+        f"evaluated {n_samples} samples (dataset has {expected}) — "
+        f"accuracy={correct / max(n_samples, 1):.4f}"
+    )
+    assert n_samples == expected, "gather_for_metrics must trim duplicate padding exactly"
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

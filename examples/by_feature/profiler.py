@@ -1,0 +1,59 @@
+"""by_feature: profiling (reference ``examples/by_feature/profiler.py``) — captures the train
+step with ``jax.profiler`` (TensorBoard/perfetto-compatible trace incl. XLA HLO + device
+timelines) via the ``accelerator.profile`` context and ``ProfileKwargs``.
+
+  accelerate-tpu launch examples/by_feature/profiler.py --smoke --trace_dir /tmp/trace
+"""
+
+import argparse
+import os
+import tempfile
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import bert
+from accelerate_tpu.utils import set_seed
+from accelerate_tpu.utils.dataclasses import ProfileKwargs
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--trace_dir", default=None)
+    args = parser.parse_args()
+
+    accelerator = Accelerator(cpu=args.cpu)
+    set_seed(42)
+    cfg = bert.CONFIGS["tiny"]
+    trace_dir = args.trace_dir or tempfile.mkdtemp(prefix="profile_example_")
+
+    params = bert.init_params(cfg, jax.random.PRNGKey(0))
+    params, tx = accelerator.prepare(params, optax.adam(1e-3))
+    state = accelerator.create_train_state(params, tx)
+    step = accelerator.build_train_step(lambda p, b: bert.loss_fn(p, b, cfg))
+    rng = np.random.default_rng(0)
+    batch = {
+        "input_ids": rng.integers(0, cfg.vocab_size, size=(8, 32)).astype(np.int32),
+        "labels": rng.integers(0, 2, size=(8,)).astype(np.int32),
+    }
+    state, _ = step(state, batch)  # compile outside the trace
+
+    handler = ProfileKwargs(
+        output_trace_dir=trace_dir,
+        on_trace_ready=lambda d: accelerator.print(f"trace ready at {d}"),
+    )
+    with accelerator.profile(handler):
+        for _ in range(3):
+            state, metrics = step(state, batch)
+        jax.block_until_ready(metrics["loss"])
+    assert any(os.scandir(trace_dir)), "no trace written"
+    accelerator.print(f"profiled 3 steps; loss={float(metrics['loss']):.4f}")
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

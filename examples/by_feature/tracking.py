@@ -1,0 +1,59 @@
+"""by_feature: experiment tracking (reference ``examples/by_feature/tracking.py``) — tensorboard
+by default; swap ``log_with`` for wandb/mlflow/etc. (``accelerate_tpu.tracking``).
+
+  accelerate-tpu launch examples/by_feature/tracking.py --smoke --project_dir /tmp/track
+"""
+
+import argparse
+import os
+import sys
+import tempfile
+
+import jax
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import bert
+from accelerate_tpu.utils import ProjectConfiguration, set_seed
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from nlp_example import get_dataloaders  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--project_dir", default=None)
+    parser.add_argument("--log_with", default="tensorboard")
+    args = parser.parse_args()
+
+    project_dir = args.project_dir or tempfile.mkdtemp(prefix="tracking_example_")
+    accelerator = Accelerator(
+        cpu=args.cpu,
+        log_with=args.log_with,
+        project_config=ProjectConfiguration(project_dir=project_dir, logging_dir=project_dir),
+    )
+    set_seed(42)
+    accelerator.init_trackers("by_feature_tracking", config={"lr": 1e-3, "epochs": 2})
+
+    cfg = bert.CONFIGS["tiny"]
+    train_dl, _ = get_dataloaders(accelerator, 8, cfg, smoke=True)
+    params = bert.init_params(cfg, jax.random.PRNGKey(0))
+    params, tx, train_dl = accelerator.prepare(params, optax.adam(1e-3), train_dl)
+    state = accelerator.create_train_state(params, tx)
+    step = accelerator.build_train_step(lambda p, b: bert.loss_fn(p, b, cfg))
+
+    overall = 0
+    for epoch in range(2):
+        train_dl.set_epoch(epoch)
+        for batch in train_dl:
+            state, metrics = step(state, batch)
+            overall += 1
+            accelerator.log({"train_loss": float(metrics["loss"])}, step=overall)
+    accelerator.print(f"logged {overall} steps to {project_dir}")
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

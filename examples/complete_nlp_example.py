@@ -1,0 +1,125 @@
+"""Complete NLP example: everything the flagship example does, plus checkpointing (resumable
+mid-training), experiment tracking, LR scheduling, and CLI control — the reference's
+``examples/complete_nlp_example.py`` re-expressed TPU-native.
+
+  accelerate-tpu launch examples/complete_nlp_example.py --checkpointing_steps epoch \
+      --with_tracking --project_dir ./out
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import bert
+from accelerate_tpu.utils import ProjectConfiguration, set_seed
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from nlp_example import MAX_TPU_BATCH_SIZE, get_dataloaders  # noqa: E402
+
+
+def training_function(config, args):
+    project_config = ProjectConfiguration(
+        project_dir=args.project_dir, automatic_checkpoint_naming=False
+    )
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        cpu=args.cpu,
+        log_with="tensorboard" if args.with_tracking else None,
+        project_config=project_config,
+        gradient_accumulation_steps=args.gradient_accumulation_steps,
+    )
+    if args.with_tracking:
+        accelerator.init_trackers("complete_nlp_example", config)
+
+    set_seed(int(config["seed"]))
+    cfg = bert.CONFIGS["tiny"] if args.smoke else bert.CONFIGS["bert-base"]
+    train_dl, eval_dl = get_dataloaders(accelerator, int(config["batch_size"]), cfg, smoke=args.smoke)
+
+    params = bert.init_params(cfg, jax.random.PRNGKey(int(config["seed"])))
+    steps_per_epoch = len(train_dl)
+    schedule = optax.linear_schedule(config["lr"], 0.0, config["num_epochs"] * steps_per_epoch, 0)
+    tx = optax.adamw(schedule, weight_decay=0.01)
+
+    params, tx, train_dl, eval_dl = accelerator.prepare(params, tx, train_dl, eval_dl)
+    state = accelerator.create_train_state(params, tx, partition_specs=bert.partition_specs(cfg))
+    step = accelerator.build_train_step(lambda p, b: bert.loss_fn(p, b, cfg))
+    eval_step = accelerator.build_eval_step(
+        lambda p, b: jnp.argmax(
+            bert.forward(p, b["input_ids"], b.get("attention_mask"), b.get("token_type_ids"), cfg),
+            axis=-1,
+        )
+    )
+
+    starting_epoch = 0
+    if args.resume_from_checkpoint:
+        accelerator.print(f"Resuming from {args.resume_from_checkpoint}")
+        state = accelerator.load_state(args.resume_from_checkpoint, train_state=state)
+        starting_epoch = int(os.environ.get("ACCELERATE_RESUME_EPOCH", "0"))
+
+    overall_step = 0
+    for epoch in range(starting_epoch, int(config["num_epochs"])):
+        train_dl.set_epoch(epoch)
+        total_loss = 0.0
+        for batch in train_dl:
+            state, metrics = step(state, batch)
+            total_loss += float(metrics["loss"])
+            overall_step += 1
+            if args.checkpointing_steps not in (None, "epoch") and overall_step % int(args.checkpointing_steps) == 0:
+                accelerator.save_state(
+                    os.path.join(args.project_dir or ".", f"step_{overall_step}"), train_state=state
+                )
+        correct = total = 0
+        for batch in eval_dl:
+            preds = eval_step(state.params, batch)
+            preds, refs = accelerator.gather_for_metrics((preds, batch["labels"]))
+            correct += int(np.sum(np.asarray(preds) == np.asarray(refs)))
+            total += int(np.asarray(refs).size)
+        acc = correct / max(total, 1)
+        accelerator.print(f"epoch {epoch}: accuracy={acc:.4f}")
+        if args.with_tracking:
+            accelerator.log(
+                {"accuracy": acc, "train_loss": total_loss / max(steps_per_epoch, 1)},
+                step=epoch,
+            )
+        if args.checkpointing_steps == "epoch":
+            accelerator.save_state(
+                os.path.join(args.project_dir or ".", f"epoch_{epoch}"), train_state=state
+            )
+    accelerator.end_training()
+    return acc
+
+
+def main():
+    parser = argparse.ArgumentParser(description="Complete TPU-native NLP example.")
+    parser.add_argument("--mixed_precision", default=None, choices=[None, "no", "bf16", "fp16", "fp8"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--checkpointing_steps", default=None,
+                        help="'epoch', an integer step count, or omitted for no checkpoints.")
+    parser.add_argument("--resume_from_checkpoint", default=None)
+    parser.add_argument("--with_tracking", action="store_true")
+    parser.add_argument("--project_dir", default=None)
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=None)
+    parser.add_argument("--lr", type=float, default=2e-5)
+    parser.add_argument("--num_epochs", type=int, default=3)
+    parser.add_argument("--batch_size", type=int, default=MAX_TPU_BATCH_SIZE)
+    parser.add_argument("--seed", type=int, default=42)
+    args = parser.parse_args()
+    if args.smoke:
+        args.lr, args.num_epochs = 1e-3, 2
+    config = {
+        "lr": args.lr, "num_epochs": args.num_epochs,
+        "seed": args.seed, "batch_size": args.batch_size,
+    }
+    training_function(config, args)
+
+
+if __name__ == "__main__":
+    main()

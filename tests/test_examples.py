@@ -1,0 +1,79 @@
+"""Example-regression tier (reference tests/test_examples.py): every shipped example must run
+end-to-end in smoke mode.
+
+One flagship script runs as a real subprocess (fresh interpreter — the exact path a user hits);
+the rest run in-process via runpy for speed (the conftest fixture resets the state singletons
+between tests, reference ``AccelerateTestCase`` semantics).
+"""
+
+import os
+import runpy
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+EXAMPLES = Path(__file__).parent.parent / "examples"
+
+
+@pytest.fixture(autouse=True)
+def _examples_on_path(monkeypatch):
+    monkeypatch.syspath_prepend(str(EXAMPLES))
+
+
+def _run_inline(script: Path, *flags: str, capsys=None, monkeypatch=None) -> str:
+    monkeypatch.setattr(sys, "argv", [script.name, "--smoke", "--cpu", *flags])
+    runpy.run_path(str(script), run_name="__main__")
+    return capsys.readouterr().out
+
+
+def test_nlp_example_subprocess():
+    env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "ACCELERATE_USE_CPU": "true",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "PYTHONPATH": str(EXAMPLES.parent) + ":" + os.environ.get("PYTHONPATH", ""),
+    }
+    result = subprocess.run(
+        [sys.executable, str(EXAMPLES / "nlp_example.py"), "--smoke", "--cpu"],
+        capture_output=True, text=True, timeout=420, env=env, cwd=str(EXAMPLES.parent),
+    )
+    assert result.returncode == 0, f"nlp_example failed:\n{result.stdout}\n{result.stderr}"
+    assert "accuracy=" in result.stdout
+
+
+def test_complete_nlp_example(tmp_path, capsys, monkeypatch):
+    out = _run_inline(
+        EXAMPLES / "complete_nlp_example.py",
+        "--checkpointing_steps", "epoch", "--project_dir", str(tmp_path),
+        capsys=capsys, monkeypatch=monkeypatch,
+    )
+    assert "accuracy=" in out
+    assert (tmp_path / "epoch_0").exists()
+
+
+@pytest.mark.parametrize(
+    "name, expect",
+    [
+        ("checkpointing.py", "resume verified"),
+        ("gradient_accumulation.py", "optimizer steps"),
+        ("tracking.py", "logged"),
+        ("memory.py", "executable batch size"),
+        ("profiler.py", "profiled 3 steps"),
+        ("multi_process_metrics.py", "evaluated"),
+        ("fsdp_with_peak_mem_tracking.py", "loss="),
+        ("local_sgd.py", "final loss="),
+    ],
+)
+def test_by_feature(name, expect, capsys, monkeypatch):
+    out = _run_inline(EXAMPLES / "by_feature" / name, capsys=capsys, monkeypatch=monkeypatch)
+    assert expect in out, out
+
+
+def test_big_model_inference_example(capsys, monkeypatch):
+    monkeypatch.setattr(sys, "argv", ["big_model_inference.py", "--smoke"])
+    runpy.run_path(str(EXAMPLES / "by_feature" / "big_model_inference.py"), run_name="__main__")
+    out = capsys.readouterr().out
+    assert "streamed forward" in out
