@@ -1,57 +1,150 @@
-"""Benchmark: training throughput of the framework's compiled train step on real hardware.
+"""Benchmark: training MFU of the framework's compiled train step on real TPU hardware.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...extras}.
 
-Metric: samples/sec/chip training a llama-architecture causal LM (bf16 compute, fp32 master
-weights, adamw, global-norm clipping) through the full Accelerator path with the framework's
-TPU-idiomatic fast path: scanned layers + fused multi-step dispatch
-(``build_train_step(fused_steps=N)``). Timing forces materialization of the final loss, so the
-whole step chain must have executed (plain ``block_until_ready`` is unreliable through the
-remote-tunnel PJRT used in this environment).
+Workload (VERDICT.md round-1 #2): a representative llama-architecture causal LM — ~0.9B params
+(llama3-8B-shaped slice: d_model 2048, GQA 16q/8kv, SwiGLU ff 8192, scanned layers), seq 2048,
+remat ON, Pallas flash attention, bf16 compute with fp32 master weights, adamw, global-norm
+clipping, fused multi-step dispatch (``build_train_step(fused_steps=N)``) with donated buffers.
+This is the config the framework exists for, not a toy.
 
-vs_baseline compares against the recorded round-1 first measurement of this same benchmark
-(the reference repo publishes no trainable-throughput numbers — BASELINE.md: its published
-numbers are big-model-inference only).
+Metric: **MFU** — model FLOP/s divided by the chip's peak bf16 FLOP/s.  Model FLOPs per token
+use the standard 6·N + 6·L·S·D causal-attention accounting (PaLM appendix B convention, causal
+halves the 12·L·S·D full-attention term).  ``vs_baseline`` is MFU / 0.40, the BASELINE.md
+north-star target (the reference publishes no trainable-throughput numbers of its own —
+its published baselines are big-model inference only, covered by examples/inference).
+
+Robustness (VERDICT.md round-1 #1): the remote-TPU tunnel used in this environment can throw
+transient ``UNAVAILABLE`` during backend init or the first compile — backend init retries with
+backoff (clearing jax's cached init failure between attempts), a transient failure mid-run
+restarts the whole run with fresh state (buffers are donated, so a half-executed step cannot be
+replayed), and any unrecoverable failure still prints a structured JSON line (never a bare
+traceback).  OOM (RESOURCE_EXHAUSTED) halves the batch size and retries.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import json
+import sys
 import time
+import traceback
 
 import numpy as np
 
-# Round-1 first real-hardware measurement (v5e-1, pre-optimization path), for vs_baseline.
-BASELINE_SAMPLES_PER_SEC = 24.57  # 2026-07-29, simple-transformer unfused path
+NORTH_STAR_MFU = 0.40  # BASELINE.md: Llama-3-8B FSDP fine-tune target on v5e
+
+# Peak dense bf16 TFLOP/s per chip by device kind (public cloud.google.com/tpu docs;
+# per-chip, i.e. both cores/tensorcores of the chip where applicable).
+PEAK_TFLOPS = {
+    "TPU v2": 22.5,
+    "TPU v3": 61.5,
+    "TPU v4": 275.0,
+    "TPU v5 lite": 196.6,
+    "TPU v5e": 196.6,
+    "TPU v5p": 459.0,
+    "TPU v5": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+    "cpu": 0.5,  # so a CPU fallback run still yields a finite (meaningless) MFU
+}
+
+_TRANSIENT = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "Unable to initialize backend", "Connection reset")
 
 
-def main():
+def _is_transient(exc: BaseException) -> bool:
+    return any(s in f"{type(exc).__name__}: {exc}" for s in _TRANSIENT)
+
+
+def _peak_tflops(device) -> float:
+    kind = str(getattr(device, "device_kind", "cpu")).lower()
+    best = None
+    for key, val in PEAK_TFLOPS.items():
+        if key.lower() in kind and (best is None or len(key) > best[0]):
+            best = (len(key), val)  # longest match wins ("TPU v5 lite" over "TPU v5")
+    return best[1] if best else 196.6  # assume v5e, the BASELINE.md hardware
+
+
+def _init_backend(attempts: int = 5, base_delay: float = 3.0):
+    """jax.devices() with retry; clears jax's cached per-platform init failure between
+    attempts (without that, every retry just re-raises the first error instantly)."""
+    import jax
+
+    for i in range(attempts):
+        try:
+            return jax.devices()
+        except Exception as e:  # noqa: BLE001
+            if not _is_transient(e) or i == attempts - 1:
+                raise
+            delay = base_delay * (2**i)
+            print(f"bench: backend init failed (attempt {i + 1}/{attempts}): "
+                  f"{str(e).splitlines()[0][:200]}; retrying in {delay:.0f}s", file=sys.stderr)
+            time.sleep(delay)
+            try:
+                jax.clear_backends()
+            except Exception:
+                from jax._src import xla_bridge
+
+                xla_bridge.backends.cache_clear()
+
+
+def _fail_json(metric: str, stage: str, exc: BaseException) -> None:
+    print(json.dumps({
+        "metric": metric,
+        "value": None,
+        "unit": "MFU",
+        "vs_baseline": None,
+        "error": f"{stage}: {type(exc).__name__}: {str(exc).splitlines()[0][:300]}",
+    }))
+    traceback.print_exc(file=sys.stderr)
+
+
+def _make_config(S: int, preset: str | None):
+    import jax
+
+    from accelerate_tpu.models import llama
+
+    cfg = dataclasses.replace(
+        llama.CONFIGS["llama3-8b"],
+        vocab_size=32768,
+        d_model=2048,
+        n_layers=12,
+        n_heads=16,
+        n_kv_heads=8,
+        d_ff=8192,
+        max_seq=S,
+        remat=True,
+        scan_layers=True,
+        attn_impl="flash" if jax.default_backend() in ("tpu", "axon") else "xla",
+    )
+    if preset == "smoke":  # CI/CPU logic check, not a perf number
+        cfg = dataclasses.replace(
+            cfg, d_model=128, n_layers=2, n_heads=4, n_kv_heads=2, d_ff=256, vocab_size=512
+        )
+    return cfg
+
+
+def run(B: int, S: int, fuse: int, preset: str | None, metric: str):
     import jax
     import optax
 
     from accelerate_tpu import Accelerator
     from accelerate_tpu.models import llama
 
-    B, S, FUSE = 16, 512, 10
-    cfg = dataclasses.replace(
-        llama.CONFIGS["debug"],
-        d_model=1024, n_layers=8, n_heads=16, n_kv_heads=16, d_ff=4096,
-        vocab_size=32768, max_seq=S, remat=False, scan_layers=True, attn_impl="xla",
-    )
+    cfg = _make_config(S, preset)
+    n_params = llama.num_params(cfg)
 
     acc = Accelerator(mixed_precision="bf16")
     state = acc.create_train_state(llama.init_params(cfg), optax.adamw(1e-4))
     step = acc.build_train_step(
-        lambda p, b: llama.loss_fn(p, b, cfg), max_grad_norm=1.0, fused_steps=FUSE
+        lambda p, b: llama.loss_fn(p, b, cfg), max_grad_norm=1.0, fused_steps=fuse
     )
 
     rng = np.random.default_rng(0)
-    stacked = {
-        "tokens": rng.integers(0, cfg.vocab_size, size=(FUSE, B, S + 1)).astype(np.int32)
-    }
+    stacked = {"tokens": rng.integers(0, cfg.vocab_size, size=(fuse, B, S + 1)).astype(np.int32)}
 
-    # Warmup / compile.
+    # Warmup / compile.  No in-place retry here: the step donates its input state, so a
+    # half-executed dispatch cannot be replayed — transient failures restart run() from main().
     state, metrics = step(state, stacked)
     _ = float(np.asarray(metrics["loss"])[-1])
 
@@ -59,24 +152,78 @@ def main():
     t0 = time.perf_counter()
     for _ in range(n_rounds):
         state, metrics = step(state, stacked)
-    _ = float(np.asarray(metrics["loss"])[-1])  # forces the full chain
+    _ = float(np.asarray(metrics["loss"])[-1])  # forces the full chain through the tunnel
     dt = time.perf_counter() - t0
 
-    n_steps = n_rounds * FUSE
+    n_steps = n_rounds * fuse
     n_chips = jax.device_count()
-    samples_per_sec_per_chip = B * n_steps / dt / n_chips
-    vs_baseline = samples_per_sec_per_chip / BASELINE_SAMPLES_PER_SEC
-    print(
-        json.dumps(
-            {
-                "metric": "train_samples_per_sec_per_chip (llama-arch d1024 L8 seq512 bf16 fused)",
-                "value": round(samples_per_sec_per_chip, 2),
-                "unit": "samples/s/chip",
-                "vs_baseline": round(vs_baseline, 3),
-            }
-        )
-    )
+    tokens_per_sec = B * S * n_steps / dt / n_chips
+    samples_per_sec = B * n_steps / dt / n_chips
+    # 6N matmul + causal-attention 6·L·S·D FLOPs per token.
+    flops_per_token = 6 * n_params + 6 * cfg.n_layers * S * cfg.d_model
+    peak = _peak_tflops(jax.devices()[0]) * 1e12
+    tflops = tokens_per_sec * flops_per_token / 1e12
+    mfu = tflops * 1e12 / peak
+    out = {
+        "metric": metric,
+        "value": round(mfu, 4),
+        "unit": "MFU",
+        "vs_baseline": round(mfu / NORTH_STAR_MFU, 3),
+        "model_params": n_params,
+        "batch": B,
+        "seq": S,
+        "fused_steps": fuse,
+        "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+        "samples_per_sec_per_chip": round(samples_per_sec, 2),
+        "achieved_tflops_per_chip": round(tflops, 2),
+        "peak_tflops_assumed": round(peak / 1e12, 1),
+        "device_kind": str(getattr(jax.devices()[0], "device_kind", "unknown")),
+    }
+    if preset:
+        out["preset"] = preset
+    print(json.dumps(out))
+
+
+def main():
+    import os
+
+    preset = os.environ.get("BENCH_PRESET")
+    B, S, fuse = 8, 2048, 4
+    metric = "train_mfu (llama-0.9B seq2048 bf16 flash remat fused)"
+    if preset:
+        metric = f"train_mfu [{preset} preset — not a perf number]"
+
+    try:
+        _init_backend()
+    except Exception as e:  # noqa: BLE001
+        _fail_json(metric, "backend init", e)
+        return 0  # structured output was produced; don't fail the driver parse
+
+    transient_left = 3
+    while True:
+        try:
+            run(B, S, fuse, preset, metric)
+            return 0
+        except Exception as e:  # noqa: BLE001
+            from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+            AcceleratorState._reset_state()
+            GradientState._reset_state()
+            PartialState._reset_state()
+            if "RESOURCE_EXHAUSTED" in str(e) and B > 1:
+                B //= 2
+                print(f"bench: OOM, retrying with batch {B}", file=sys.stderr)
+                continue
+            if _is_transient(e) and transient_left > 0:
+                transient_left -= 1
+                print(f"bench: transient failure, restarting run "
+                      f"({transient_left} restarts left): "
+                      f"{str(e).splitlines()[0][:200]}", file=sys.stderr)
+                time.sleep(10)
+                continue
+            _fail_json(metric, "bench run", e)
+            return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())
