@@ -19,6 +19,7 @@ from .big_modeling import (
     init_on_device,
     load_checkpoint_and_dispatch,
 )
+from .generation import GenerationConfig, generate_loop, sample_logits
 from .launchers import debug_launcher, notebook_launcher
 from .local_sgd import LocalSGD
 from .logging import get_logger

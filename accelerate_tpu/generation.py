@@ -1,0 +1,116 @@
+"""Autoregressive generation: KV-cache decode loop + sampling (the inference hot loop).
+
+The reference has no generation engine of its own — every published baseline number it has is
+``model.generate()`` s/token through transformers over its dispatched models
+(``/root/reference/benchmarks/big_model_inference/README.md:25-37``,
+``examples/inference/pippy/llama.py``).  This module is the TPU-native counterpart: a
+**jit-compiled ``lax.scan`` decode loop** over a model-provided (prefill, decode) pair, with
+greedy / temperature / top-k / top-p sampling, EOS early-stop masking, and static shapes
+throughout (prompt left-padded to a fixed width, fixed ``max_new_tokens`` — XLA never sees a
+dynamic shape).
+
+Model contract (see ``models/llama.py`` for the flagship wiring):
+
+- ``prefill_fn(params, prompt, prompt_mask) -> (last_logits [B,V], cache)`` — consume the
+  padded prompt, fill the KV cache.
+- ``decode_fn(params, cache, token [B]) -> (logits [B,V], cache)`` — one cached decode step.
+
+The fns are jit-static (pass stable identities — build them once per config, not per call);
+``params`` is a traced argument so weights are runtime inputs, never baked-in constants.
+
+Because the whole loop is one XLA program, weights stay pinned in HBM and every decode step is
+a handful of fused HLOs — this is the design reason a single v5e chip beats the reference's
+multi-GPU hook-dispatch decode (0.05 s/token GPT-J-6B fp16, BASELINE.md) by orders of
+magnitude on models that fit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["GenerationConfig", "sample_logits", "generate_loop"]
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationConfig:
+    """Decode-time knobs (the transformers ``GenerationConfig`` analog, jit-static)."""
+
+    max_new_tokens: int = 128
+    temperature: float = 0.0  # 0.0 → greedy (argmax)
+    top_k: int = 0            # 0 → disabled
+    top_p: float = 1.0        # 1.0 → disabled
+    eos_token_id: Optional[int] = None
+    pad_token_id: int = 0
+
+
+def sample_logits(logits: jax.Array, gen: GenerationConfig, rng: Optional[jax.Array]) -> jax.Array:
+    """logits [B, V] → token ids [B] via greedy / temperature / top-k / top-p."""
+    if gen.temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if rng is None:
+        raise ValueError("temperature sampling needs an rng key")
+    logits = logits.astype(jnp.float32) / gen.temperature
+    if gen.top_k > 0:
+        kth = jax.lax.top_k(logits, gen.top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if gen.top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep the smallest prefix with cumulative prob >= top_p (always keep the best token).
+        keep_sorted = cum - probs < gen.top_p
+        threshold = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("prefill_fn", "decode_fn", "gen"))
+def generate_loop(
+    prefill_fn: Callable,
+    decode_fn: Callable,
+    params,
+    prompt: jax.Array,
+    prompt_mask: jax.Array,
+    gen: GenerationConfig,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Run prefill + ``max_new_tokens`` cached decode steps as one compiled program.
+
+    ``prompt`` [B, S0] int32, left-padded; ``prompt_mask`` [B, S0] bool (False on pads).
+    Returns generated ids [B, max_new_tokens]; positions after an EOS are ``pad_token_id``.
+    """
+    last_logits, cache = prefill_fn(params, prompt, prompt_mask)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    # Use-once key discipline: every draw gets its own split; the parent key is never
+    # consumed directly.
+    step_rngs = jax.random.split(rng, gen.max_new_tokens)
+    first = sample_logits(last_logits, gen, step_rngs[0])
+    done0 = jnp.zeros((prompt.shape[0],), jnp.bool_)
+    if gen.eos_token_id is not None:
+        done0 = first == gen.eos_token_id  # the EOS itself is emitted; later slots are padded
+
+    def body(carry, step_rng):
+        cache, token, done = carry
+        logits, cache = decode_fn(params, cache, token)
+        nxt = sample_logits(logits, gen, step_rng)
+        if gen.eos_token_id is not None:
+            emitted = jnp.where(done, jnp.int32(gen.pad_token_id), nxt)
+            done = done | (nxt == gen.eos_token_id)
+        else:
+            emitted = nxt
+        # Feed the raw sample back in; finished rows keep decoding but their output is masked.
+        return (cache, nxt, done), emitted
+
+    (_, _, _), rest = jax.lax.scan(
+        body, (cache, first, done0), step_rngs[1:], length=gen.max_new_tokens - 1
+    )
+    out = jnp.concatenate([first[None, :], rest], axis=0)  # [T, B]
+    return jnp.swapaxes(out, 0, 1)

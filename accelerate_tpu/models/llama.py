@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+from collections import OrderedDict
 from functools import partial
 from typing import Any, Optional
 
@@ -35,6 +36,10 @@ __all__ = [
     "loss_fn",
     "partition_specs",
     "CONFIGS",
+    "init_cache",
+    "forward_cached",
+    "generate",
+    "generate_streamed",
 ]
 
 
@@ -415,6 +420,284 @@ def forward_streamed(
     head = embed.T if cfg.tie_embeddings else dispatched.fetch("lm_head")
     logits = x @ head.astype(dtype)
     return logits.astype(jnp.float32)
+
+
+# ----------------------------------------------------------------------- cached generation
+def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int, dtype=None) -> dict:
+    """Allocate an empty KV cache for ``batch_size`` sequences of up to ``max_len`` tokens.
+
+    Layout: ``{"layers": [{"k": [B,C,K,hd], "v": ...}, ...], "valid": [B,C] bool,
+    "index": int32}`` — ``valid`` marks filled, non-pad slots (False on left-pads), ``index``
+    is the next write slot.  With ``cfg.scan_layers`` the per-layer dicts are stacked on a
+    leading layer dim, matching the stacked param layout.  The reference's decode baselines
+    come from transformers' cache via hook dispatch (``benchmarks/big_model_inference``);
+    here the cache is an explicit pytree so the whole decode loop jits.
+    """
+    dtype = dtype or cfg.dtype
+    kv_shape = (batch_size, max_len, cfg.n_kv_heads, cfg.head_dim)
+    one = lambda: {"k": jnp.zeros(kv_shape, dtype), "v": jnp.zeros(kv_shape, dtype)}  # noqa: E731
+    if cfg.scan_layers:
+        layers = jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x, (cfg.n_layers, *x.shape)), one()
+        )
+    else:
+        layers = [one() for _ in range(cfg.n_layers)]
+    return {
+        "layers": layers,
+        "valid": jnp.zeros((batch_size, max_len), jnp.bool_),
+        "index": jnp.zeros((), jnp.int32),
+    }
+
+
+def _attention_cached(q, ck, cv, q_positions, valid, cfg: LlamaConfig):
+    """q [B,T,H,hd] against the full cache ck/cv [B,C,K,hd]; ``valid`` [B,C] marks live keys.
+
+    Causality: key slot j may be seen by the query at absolute slot p iff ``j <= p``.
+    Single-token decode (T=1) is a pure HBM-bandwidth gather — the XLA path is the right
+    kernel; flash only pays off for the (uncached) training/prefill shapes.
+    """
+    B, T, H, hd = q.shape
+    C = ck.shape[1]
+    if H != ck.shape[2]:
+        ck = jnp.repeat(ck, cfg.q_per_kv, axis=2)
+        cv = jnp.repeat(cv, cfg.q_per_kv, axis=2)
+    scores = jnp.einsum("bthd,bchd->bhtc", q, ck) / math.sqrt(hd)
+    causal = jnp.arange(C)[None, None, :] <= q_positions[:, :, None]  # [B,T,C]
+    mask = (causal & valid[:, None, :])[:, None, :, :]  # [B,1,T,C]
+    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhtc,bchd->bthd", probs, cv)
+
+
+def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig):
+    """One block with KV-cache read/write → (x, new_kv)."""
+    B, T, D = x.shape
+    h = _rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+    q = _proj(h, layer["wq"], cfg).reshape(B, T, cfg.n_heads, cfg.head_dim)
+    k = _proj(h, layer["wk"], cfg).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    v = _proj(h, layer["wv"], cfg).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    new_k = jax.lax.dynamic_update_slice(kv["k"], k.astype(kv["k"].dtype), (0, index, 0, 0))
+    new_v = jax.lax.dynamic_update_slice(kv["v"], v.astype(kv["v"].dtype), (0, index, 0, 0))
+    attn = _attention_cached(q, new_k, new_v, positions, valid, cfg)
+    x = x + _proj(attn.reshape(B, T, cfg.n_heads * cfg.head_dim), layer["wo"], cfg)
+    h = _rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
+    if cfg.moe_experts > 0:
+        from ..ops.moe import moe_mlp, moe_mlp_dense
+
+        if T == 1:
+            # Decode: drop-free dense routing — capacity pooling over a single-token step
+            # would drop tokens whenever a step's rows collide on an expert (training's
+            # fixed-shape load-management artifact, wrong for inference).
+            y = moe_mlp_dense(
+                h, layer["moe"], layer["moe"]["w_router"],
+                top_k=cfg.moe_top_k, compute_dtype=cfg.dtype,
+            )
+        else:
+            # Prefill: identical pooled formulation (and token set) as the training forward.
+            y, _ = moe_mlp(
+                h, layer["moe"], layer["moe"]["w_router"],
+                top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
+                compute_dtype=cfg.dtype,
+            )
+        return x + y, {"k": new_k, "v": new_v}
+    gate = jax.nn.silu(_proj(h, layer["w_gate"], cfg))
+    up = _proj(h, layer["w_up"], cfg)
+    x = x + _proj(gate * up, layer["w_down"], cfg)
+    return x, {"k": new_k, "v": new_v}
+
+
+def _cache_advance(cache: dict, tokens: jax.Array, token_mask: Optional[jax.Array]):
+    """Shared cache bookkeeping for the in-memory and streamed cached-forward paths:
+    (write index, absolute rope positions [B,T], updated valid mask [B,C])."""
+    B, T = tokens.shape
+    index = cache["index"]
+    positions = index + jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    if token_mask is None:
+        token_mask = jnp.ones((B, T), jnp.bool_)
+    valid = jax.lax.dynamic_update_slice(cache["valid"], token_mask, (0, index))
+    return index, positions, valid
+
+
+def forward_cached(
+    params: dict,
+    tokens: jax.Array,
+    cache: dict,
+    cfg: LlamaConfig,
+    token_mask: Optional[jax.Array] = None,
+    last_only: bool = False,
+) -> tuple[jax.Array, dict]:
+    """Write ``tokens`` [B,T] into the cache at its current index and return
+    (logits fp32, updated cache) — logits [B,T,V], or [B,1,V] with ``last_only`` (prefill
+    wants only the final position; skipping the [B,T,V] vocab matmul saves S0× head compute
+    and HBM).
+
+    Prefill passes the left-padded prompt with ``token_mask`` False on pads; decode passes a
+    single token per row (T=1, mask omitted).  Rope positions are the absolute cache slots —
+    rotary attention only depends on position *differences*, so left-pad offsets cancel.
+    """
+    B, T = tokens.shape
+    dtype = cfg.dtype
+    index, positions, valid = _cache_advance(cache, tokens, token_mask)
+
+    x = params["embed"].astype(dtype)[tokens]
+    if cfg.scan_layers:
+        def scan_body(carry, layer_and_kv):
+            layer, kv = layer_and_kv
+            out, new_kv = _block_cached(carry, layer, kv, index, positions, valid, cfg)
+            return out, new_kv
+
+        x, new_layers = jax.lax.scan(scan_body, x, (params["layers"], cache["layers"]))
+    else:
+        new_layers = []
+        for layer, kv in zip(params["layers"], cache["layers"]):
+            x, new_kv = _block_cached(x, layer, kv, index, positions, valid, cfg)
+            new_layers.append(new_kv)
+    x = _rms_norm(x, params["ln_f"], cfg.norm_eps)
+    if last_only:
+        x = x[:, -1:, :]
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head.astype(dtype)).astype(jnp.float32)
+    new_cache = {"layers": new_layers, "valid": valid, "index": index + T}
+    return logits, new_cache
+
+
+def _make_gen_fns(cfg: LlamaConfig, max_len: int):
+    """Stable-identity (prefill, decode) pair for ``generation.generate_loop`` (jit-static)."""
+
+    def prefill_fn(params, prompt, prompt_mask):
+        cache = init_cache(cfg, prompt.shape[0], max_len)
+        logits, cache = forward_cached(
+            params, prompt, cache, cfg, token_mask=prompt_mask, last_only=True
+        )
+        return logits[:, -1, :], cache
+
+    def decode_fn(params, cache, token):
+        logits, cache = forward_cached(params, token[:, None], cache, cfg)
+        return logits[:, -1, :], cache
+
+    return prefill_fn, decode_fn
+
+
+# Bounded cache of (prefill, decode) closure pairs: stable identities keep generate_loop's
+# jit cache warm, the bound keeps a long-running server from pinning one executable pair per
+# distinct prompt length forever (max_len is bucketed below for the same reason).
+_GEN_FNS: OrderedDict = OrderedDict()
+_GEN_FNS_MAX = 16
+
+
+def generate(
+    params: dict,
+    prompt: jax.Array,
+    cfg: LlamaConfig,
+    gen=None,
+    rng: Optional[jax.Array] = None,
+    prompt_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Autoregressive generation: one compiled prefill + decode-scan program.
+
+    ``prompt`` [B,S0] int32 (left-padded; pass ``prompt_mask`` False on pads).  Returns
+    [B, max_new_tokens].  The reference-side analog is ``model.generate()`` over a dispatched
+    model (``/root/reference/benchmarks/big_model_inference/README.md:25``).
+    """
+    from ..generation import GenerationConfig, generate_loop
+
+    gen = gen or GenerationConfig()
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt_mask is None:
+        prompt_mask = jnp.ones(prompt.shape, jnp.bool_)
+    # Bucket the cache length so nearby prompt lengths share one compiled program (the
+    # valid-mask/index machinery makes an over-long cache semantically identical).
+    max_len = prompt.shape[1] + gen.max_new_tokens
+    max_len = -(-max_len // 64) * 64
+    key = (cfg, max_len)
+    if key not in _GEN_FNS:
+        _GEN_FNS[key] = _make_gen_fns(cfg, max_len)
+        while len(_GEN_FNS) > _GEN_FNS_MAX:
+            _GEN_FNS.popitem(last=False)
+    _GEN_FNS.move_to_end(key)
+    prefill_fn, decode_fn = _GEN_FNS[key]
+    return generate_loop(prefill_fn, decode_fn, params, prompt, prompt_mask, gen, rng)
+
+
+def generate_streamed(
+    dispatched,
+    prompt: jax.Array,
+    cfg: LlamaConfig,
+    gen=None,
+    rng: Optional[jax.Array] = None,
+    prompt_mask: Optional[jax.Array] = None,
+    prefetch: int = 2,
+) -> jax.Array:
+    """Generation for models bigger than HBM: every forward streams blocks from host/disk.
+
+    The reference's offloaded ``generate`` re-loads each layer per *token* through
+    ``AlignDevicesHook.pre_forward`` (hooks.py:329) — its OPT-30B disk number is 33.9 s/token
+    (BASELINE.md).  This path does the same amount of traffic but overlaps each block's H2D
+    copy with the previous block's compute (``stream_blocks`` double-buffering).  Use
+    ``generate`` whenever the params fit — streamed decode is HBM-bandwidth-bound by design.
+    """
+    from ..big_modeling import stream_blocks
+    from ..generation import GenerationConfig, sample_logits
+
+    if cfg.scan_layers:
+        raise ValueError("generate_streamed requires per-layer (non-scanned) params.")
+    gen = gen or GenerationConfig()
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, S0 = prompt.shape
+    if prompt_mask is None:
+        prompt_mask = jnp.ones((B, S0), jnp.bool_)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    max_len = S0 + gen.max_new_tokens
+    cache = init_cache(cfg, B, max_len)
+    prefixes = [f"layers/{i}" for i in range(cfg.n_layers)]
+
+    def one_pass(tokens, cache, token_mask):
+        index, positions, valid = _cache_advance(cache, tokens, token_mask)
+        embed = dispatched.fetch("embed")
+        x = embed.astype(cfg.dtype)[tokens]
+        new_layers = []
+        for i, layer in stream_blocks(dispatched, prefixes, prefetch=prefetch):
+            idx = int(i.split("/")[1])
+            x, new_kv = _block_cached_jit(
+                x, layer, cache["layers"][idx], index, positions, valid, cfg=cfg
+            )
+            new_layers.append(new_kv)
+        x = _rms_norm(x, dispatched.fetch("ln_f"), cfg.norm_eps)
+        head = embed.T if cfg.tie_embeddings else dispatched.fetch("lm_head")
+        logits = (x[:, -1, :] @ head.astype(cfg.dtype)).astype(jnp.float32)
+        return logits, {"layers": new_layers, "valid": valid, "index": index + tokens.shape[1]}
+
+    step_rngs = jax.random.split(rng, gen.max_new_tokens)
+    logits, cache = one_pass(prompt, cache, prompt_mask)
+    token = sample_logits(logits, gen, step_rngs[0])
+    done = (
+        token == gen.eos_token_id if gen.eos_token_id is not None
+        else jnp.zeros((B,), jnp.bool_)
+    )
+    out = [token]
+    for t in range(1, gen.max_new_tokens):
+        logits, cache = one_pass(token[:, None], cache, jnp.ones((B, 1), jnp.bool_))
+        nxt = sample_logits(logits, gen, step_rngs[t])
+        if gen.eos_token_id is not None:
+            out.append(jnp.where(done, jnp.int32(gen.pad_token_id), nxt))
+            done = done | (nxt == gen.eos_token_id)
+            if bool(jnp.all(done)):
+                pad = jnp.full((B,), gen.pad_token_id, jnp.int32)
+                out.extend([pad] * (gen.max_new_tokens - len(out)))
+                break
+        else:
+            out.append(nxt)
+        token = nxt
+    return jnp.stack(out, axis=1)
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _block_cached_jit(x, layer, kv, index, positions, valid, cfg):
+    """Module-level jit identity: one compile per shape across streamed decode steps."""
+    return _block_cached(x, layer, kv, index, positions, valid, cfg)
 
 
 def num_params(cfg: LlamaConfig) -> int:

@@ -23,7 +23,7 @@ from jax.sharding import PartitionSpec as P
 
 from ..utils.constants import EXPERT_AXIS
 
-__all__ = ["router_topk", "load_balancing_loss", "moe_mlp", "expert_partition_specs"]
+__all__ = ["router_topk", "load_balancing_loss", "moe_mlp", "moe_mlp_dense", "expert_partition_specs"]
 
 
 def router_topk(
@@ -112,6 +112,36 @@ def moe_mlp(
 
     y = jnp.einsum("ecd,tec->td", out, combine)  # combine: weighted return all-to-all
     return y.reshape(B, S, D).astype(x.dtype), aux
+
+
+def moe_mlp_dense(
+    x: jax.Array,
+    experts: dict,
+    w_router: jax.Array,
+    top_k: int = 2,
+    compute_dtype=jnp.bfloat16,
+) -> jax.Array:
+    """Drop-free MoE FFN: every expert computed on every token, combined by top-k gates.
+
+    Exact inference semantics — no capacity dropping (the training formulation's fixed-shape
+    load-management artifact, ``moe_mlp``).  Cost is E× the FFN over the given tokens, which
+    is the right trade only when T is tiny: single-token decode steps, where the FFN is
+    HBM-bandwidth-bound anyway and a ragged per-expert gather would defeat jit.
+    """
+    B, S, D = x.shape
+    T = B * S
+    E = experts["w_gate"].shape[0]
+    flat = x.reshape(T, D).astype(compute_dtype)
+    _, gates, idx = router_topk(x.reshape(T, D), w_router, top_k)
+    # [T, E] combine weights: renormalized gate mass on each chosen expert, 0 elsewhere.
+    weights = jnp.sum(
+        gates[..., None] * jax.nn.one_hot(idx, E, dtype=jnp.float32), axis=1
+    ).astype(compute_dtype)
+    gate = jax.nn.silu(jnp.einsum("td,edf->etf", flat, experts["w_gate"].astype(compute_dtype)))
+    up = jnp.einsum("td,edf->etf", flat, experts["w_up"].astype(compute_dtype))
+    out = jnp.einsum("etf,efd->etd", gate * up, experts["w_down"].astype(compute_dtype))
+    y = jnp.einsum("etd,te->td", out, weights)
+    return y.reshape(B, S, D).astype(x.dtype)
 
 
 def expert_partition_specs() -> dict:
