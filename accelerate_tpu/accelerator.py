@@ -316,6 +316,11 @@ class Accelerator:
         self.trackers: list = []
 
         self.step = 0
+        # ZeRO-1/2 spec trees, filled by create_train_state when the fsdp plugin requests
+        # optimizer/gradient sharding with replicated params (zero_stage 1/2).
+        self._zero_opt_specs = None
+        self._zero_grad_specs = None
+        self._zero_param_specs = None
         self._in_accumulate_ctx = False
         self._accumulate_count = 0
         self._max_grad_norm: Optional[float] = None
@@ -521,17 +526,51 @@ class Accelerator:
         """Build the sharded training carry.
 
         Params are prepared (cast + sharded); optimizer state is initialized *from the sharded
-        params*, so each opt-state leaf inherits its param's sharding — that placement IS
-        ZeRO-1 when params are fsdp-sharded, with zero further code.
+        params*, so each opt-state leaf inherits its param's sharding. ZeRO stages 1/2
+        (``zero_stage`` on the fsdp plugin, reference DeepSpeed partitioned optimizer
+        ``utils/dataclasses.py:1019-1448``) additionally shard the optimizer state (stage 1)
+        and the gradient-accumulation buffers (stage 2) over the fsdp axis while params stay
+        replicated — the train step then reduce-scatters grads and all-gathers updates.
         """
         if not isinstance(optimizer, AcceleratedOptimizer):
             optimizer = self.prepare_optimizer(optimizer)
         params = self.prepare_params(params, partition_specs=partition_specs)
         opt_state = optimizer.init(params)
+
+        from .utils.constants import FSDP_AXIS
+
+        plugin = self.state.fsdp_plugin
+        self._zero_opt_specs = None
+        self._zero_grad_specs = None
+        if (
+            plugin is not None
+            and plugin.shards_optimizer
+            and not plugin.shards_params
+            and self.mesh.shape[FSDP_AXIS] > 1
+        ):
+            from .parallel.fsdp import get_zero_specs, shard_tree
+
+            self._zero_opt_specs = get_zero_specs(opt_state, self.mesh, plugin)
+            opt_state = shard_tree(opt_state, self.mesh, self._zero_opt_specs)
+            # Pin the param layout in the apply step: without this, GSPMD propagates the
+            # sharded updates into the output params, silently turning stage 1/2 into 3.
+            self._zero_param_specs = jax.tree_util.tree_map(
+                lambda leaf: leaf.sharding.spec
+                if isinstance(leaf, jax.Array) and isinstance(leaf.sharding, NamedSharding)
+                else PartitionSpec(),
+                params,
+            )
+            if plugin.shards_grads:
+                self._zero_grad_specs = get_zero_specs(params, self.mesh, plugin)
+
         optimizer._opt_state_ref = opt_state
         accum = None
         if self.gradient_accumulation_steps > 1:
             accum = jax.tree_util.tree_map(jnp.zeros_like, params)
+            if self._zero_grad_specs is not None:
+                from .parallel.fsdp import shard_tree
+
+                accum = shard_tree(accum, self.mesh, self._zero_grad_specs)
         return TrainState(
             params=params,
             opt_state=opt_state,
@@ -549,6 +588,7 @@ class Accelerator:
         has_aux: bool = False,
         donate: bool = True,
         fused_steps: int = 1,
+        cast_params: bool = True,
     ) -> _TrainStep:
         """Compile the training step (the reference hot loop, SURVEY.md §3.4, as one XLA program).
 
@@ -556,6 +596,12 @@ class Accelerator:
         (or ``(loss, aux)`` with ``has_aux=True``). Mixed precision: params are cast to the
         compute dtype *inside* the step so gradients/master weights stay fp32 (the
         autocast + GradScaler-free equivalent of reference ``:1462-1473``).
+
+        ``cast_params=False`` skips that whole-tree cast — pass it when the model casts each
+        weight at its point of use (``models.llama`` does, via ``cfg.dtype``): the upfront cast
+        materializes a full low-precision copy of the parameters in HBM (and, with scanned
+        layers, matching zero-init buffers in the scan backward), which on a 16 GB chip is the
+        difference between fitting a ~1B-param adamw step and OOM.
         """
         if optimizer is None:
             if not self._optimizers:
@@ -579,12 +625,20 @@ class Accelerator:
                 step_rng = jax.random.fold_in(state.rng, state.step * accum_steps + micro)
 
             def wrapped(params):
-                cparams = cast_floating(params, policy.compute_dtype)
+                cparams = cast_floating(params, policy.compute_dtype) if cast_params else params
                 out = loss_fn(cparams, batch, step_rng) if wants_rng else loss_fn(cparams, batch)
                 loss, aux = out if has_aux else (out, None)
                 return jnp.asarray(loss, dtype=jnp.float32), aux
 
             (loss, aux), grads = jax.value_and_grad(wrapped, has_aux=True)(state.params)
+            if self._zero_grad_specs is not None:
+                # ZeRO-2: constrain grads onto the fsdp axis — GSPMD lowers the data-axis
+                # all-reduce into a reduce-scatter and keeps grads partitioned.
+                from .ops.collectives import maybe_shard
+
+                grads = jax.tree_util.tree_map(
+                    lambda g, s: maybe_shard(g, s), grads, self._zero_grad_specs
+                )
             return loss, aux, grads
 
         def micro_step(state: TrainState, batch):
@@ -616,10 +670,30 @@ class Accelerator:
             import optax
 
             updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+            if self._zero_opt_specs is not None:
+                # ZeRO-1/2: keep optimizer state partitioned over the fsdp axis across steps
+                # (params replicated; GSPMD all-gathers the sharded updates below).
+                from .ops.collectives import maybe_shard
+
+                new_opt_state = jax.tree_util.tree_map(
+                    lambda o, s: maybe_shard(o, s), new_opt_state, self._zero_opt_specs
+                )
             new_params = optax.apply_updates(state.params, updates)
+            if self._zero_param_specs is not None:
+                from .ops.collectives import maybe_shard
+
+                new_params = jax.tree_util.tree_map(
+                    lambda p, s: maybe_shard(p, s), new_params, self._zero_param_specs
+                )
             new_accum = state.grad_accum
             if new_accum is not None:
                 new_accum = jax.tree_util.tree_map(jnp.zeros_like, new_accum)
+                if self._zero_grad_specs is not None:
+                    from .ops.collectives import maybe_shard
+
+                    new_accum = jax.tree_util.tree_map(
+                        lambda a, s: maybe_shard(a, s), new_accum, self._zero_grad_specs
+                    )
             if has_aux:
                 metrics["aux"] = aux
             return (

@@ -32,6 +32,7 @@ __all__ = [
     "LlamaConfig",
     "init_params",
     "forward",
+    "forward_hidden",
     "forward_streamed",
     "loss_fn",
     "partition_specs",
@@ -66,6 +67,10 @@ class LlamaConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
     moe_aux_weight: float = 0.01
+    # Cross-entropy chunking (memory): compute logits+logsumexp per sequence chunk of this
+    # many tokens under remat instead of materializing fp32 [B,S,V] logits. 0 = auto
+    # (chunk only when S*V is large enough to matter), -1 = never chunk.
+    loss_chunk: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -304,16 +309,14 @@ def _block(x, layer, positions, mask, cfg: LlamaConfig):
     return x, jnp.zeros((), jnp.float32)
 
 
-def forward(
+def forward_hidden(
     params: dict,
     tokens: jax.Array,
     cfg: LlamaConfig,
     positions: Optional[jax.Array] = None,
     shard_activations: bool = True,
-    return_aux: bool = False,
-):
-    """Causal LM: tokens [B, S] → logits [B, S, V] (fp32); with ``return_aux``, also the summed
-    MoE load-balancing loss.
+) -> tuple[jax.Array, jax.Array]:
+    """Backbone: tokens [B, S] → (final hidden states [B, S, D] after ln_f, MoE aux loss).
 
     Activation sharding constraints pin the batch dim to ``(dp, fsdp)`` and the sequence dim
     to ``sp`` so GSPMD propagates a consistent layout through every block (naive sequence
@@ -349,11 +352,73 @@ def forward(
             if shard_activations:
                 x = _maybe_shard(x, P(BATCH_AXES, SEQUENCE_AXIS, None))
     x = _rms_norm(x, params["ln_f"], cfg.norm_eps)
+    return x, aux_total
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    positions: Optional[jax.Array] = None,
+    shard_activations: bool = True,
+    return_aux: bool = False,
+):
+    """Causal LM: tokens [B, S] → logits [B, S, V] (fp32); with ``return_aux``, also the summed
+    MoE load-balancing loss."""
+    x, aux_total = forward_hidden(params, tokens, cfg, positions, shard_activations)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x @ head.astype(dtype)).astype(jnp.float32)
+    logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
     if return_aux:
         return logits, aux_total
     return logits
+
+
+def _loss_chunk_size(cfg: LlamaConfig, S: int) -> int:
+    """Resolve the chunked-CE chunk length (0 tokens = don't chunk).
+
+    Auto mode chunks only when the fp32 logits would exceed ~256 MB per step — below that the
+    simple fused path is both faster and already cheap.
+    """
+    if cfg.loss_chunk == -1:
+        return 0
+    if cfg.loss_chunk > 0:
+        return min(cfg.loss_chunk, S)
+    # auto: threshold on S*V; 2**24 elements = 64 MB of fp32 logits per example row.
+    if S * cfg.vocab_size <= 2**24:
+        return 0
+    chunk = 512
+    while chunk > 1 and S % chunk != 0:
+        chunk //= 2
+    return chunk
+
+
+def _chunked_ce(x, head, targets, mask, chunk: int, dtype):
+    """Memory-efficient cross-entropy: per-chunk head matmul + logsumexp under remat.
+
+    ``x`` [B,S,D] (post-ln_f hidden), ``head`` [D,V]; returns (sum of -log p(target) over
+    unmasked positions, mask count). The fp32 [B,S,V] logits are never materialized — each
+    scan step computes one [B,chunk,V] block and the backward pass recomputes it
+    (``jax.checkpoint``), so peak memory drops from O(S·V) to O(chunk·V).
+    """
+    B, S, D = x.shape
+    n = S // chunk
+    xs = x.reshape(B, n, chunk, D).swapaxes(0, 1)            # [n, B, c, D]
+    ts = targets.reshape(B, n, chunk).swapaxes(0, 1)         # [n, B, c]
+    ms = mask.reshape(B, n, chunk).swapaxes(0, 1)            # [n, B, c]
+
+    @jax.checkpoint
+    def chunk_loss(xc, tc, mc):
+        logits = (xc @ head.astype(dtype)).astype(jnp.float32)   # [B, c, V]
+        lse = jax.nn.logsumexp(logits, axis=-1)                  # [B, c]
+        tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1).squeeze(-1)
+        return -((tgt - lse) * mc).sum()
+
+    def body(carry, xtm):
+        xc, tc, mc = xtm
+        return carry + chunk_loss(xc, tc, mc), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xs, ts, ms))
+    return total
 
 
 def loss_fn(
@@ -362,17 +427,30 @@ def loss_fn(
     cfg: LlamaConfig,
     rng: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Next-token cross-entropy over batch {'tokens': [B, S+1]} with optional 'mask'."""
+    """Next-token cross-entropy over batch {'tokens': [B, S+1]} with optional 'mask'.
+
+    Large-vocab models use the chunked-CE path (``cfg.loss_chunk``): the reference's torch
+    loop materializes full fp32 logits, which alone OOMs a 16 GB chip at B8/S2048/V32k.
+    """
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits, aux = forward(params, inputs, cfg, return_aux=True)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
-    if "mask" in batch:
-        mask = batch["mask"][:, 1:].astype(jnp.float32)
-        ce = -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+    B, S = inputs.shape
+    mask = (
+        batch["mask"][:, 1:].astype(jnp.float32)
+        if "mask" in batch
+        else jnp.ones((B, S), jnp.float32)
+    )
+    denom = jnp.maximum(mask.sum(), 1.0)
+    chunk = _loss_chunk_size(cfg, S)
+    if chunk > 0 and S % chunk == 0:
+        x, aux = forward_hidden(params, inputs, cfg)
+        head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+        ce = _chunked_ce(x, head, targets, mask, chunk, cfg.dtype) / denom
     else:
-        ce = -jnp.mean(ll)
+        logits, aux = forward(params, inputs, cfg, return_aux=True)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+        ce = -(ll * mask).sum() / denom
     if cfg.moe_experts > 0:
         return ce + cfg.moe_aux_weight * aux
     return ce

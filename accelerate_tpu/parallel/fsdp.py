@@ -30,6 +30,8 @@ from ..utils.dataclasses import FullyShardedDataParallelPlugin
 __all__ = [
     "infer_fsdp_spec",
     "get_fsdp_shardings",
+    "get_zero_specs",
+    "shard_tree",
     "shard_params",
     "gather_full_params",
 ]
@@ -86,6 +88,45 @@ def get_fsdp_shardings(
             lambda leaf, spec: _leaf(None, leaf, spec), params, specs
         )
     return jax.tree_util.tree_map(lambda leaf: _leaf(None, leaf), params)
+
+
+def get_zero_specs(
+    tree: Any,
+    mesh: Mesh,
+    plugin: Optional[FullyShardedDataParallelPlugin] = None,
+) -> Any:
+    """PartitionSpec tree sharding *any* state pytree over the fsdp axis (ZeRO-1/2).
+
+    Unlike ``get_fsdp_shardings`` this ignores ``plugin.shards_params`` — it is the mechanism
+    behind ZeRO stages 1/2, where params stay replicated but optimizer state (stage 1) and
+    gradient buffers (stage 2) are partitioned along the data/fsdp axis (the DeepSpeed
+    partitioned-optimizer analog, reference ``utils/dataclasses.py:1019-1448``). Each leaf's
+    existing sharding (e.g. tensor-parallel dims) is composed with, not overwritten.
+    """
+    plugin = plugin or FullyShardedDataParallelPlugin()
+    fsdp_size = mesh.shape[FSDP_AXIS]
+
+    def _leaf(leaf):
+        existing = None
+        if isinstance(leaf, jax.Array) and isinstance(leaf.sharding, NamedSharding):
+            existing = leaf.sharding.spec
+        return infer_fsdp_spec(
+            np.shape(leaf), fsdp_size, plugin.min_weight_size, existing_spec=existing
+        )
+
+    return jax.tree_util.tree_map(_leaf, tree)
+
+
+def shard_tree(tree: Any, mesh: Mesh, specs: Any) -> Any:
+    """Re-place a pytree of arrays according to a PartitionSpec tree (fresh buffers)."""
+
+    def _put(leaf, spec):
+        sharding = NamedSharding(mesh, spec)
+        if isinstance(leaf, jax.Array):
+            return jax.jit(lambda x: x, out_shardings=sharding)(leaf)
+        return jax.device_put(leaf, sharding)
+
+    return jax.tree_util.tree_map(_put, tree, specs)
 
 
 def shard_params(

@@ -175,3 +175,29 @@ def test_loss_mask():
     batch["mask"][:, 8:] = 0
     l_half = llama.loss_fn(params, {k: jnp.asarray(v) for k, v in batch.items()}, CFG)
     assert not np.isclose(float(l_full), float(l_half))
+
+
+def test_chunked_ce_matches_full():
+    """Chunked cross-entropy (memory path) must equal the full-logits path, incl. grads."""
+    params = llama.init_params(CFG)
+    batch = make_batch(2, 32)
+    batch["mask"] = np.ones_like(batch["tokens"])
+    batch["mask"][:, 20:] = 0
+    jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+    cfg_chunk = dataclasses.replace(CFG, loss_chunk=8)
+    cfg_full = dataclasses.replace(CFG, loss_chunk=-1)
+    l_chunk, g_chunk = jax.value_and_grad(lambda p: llama.loss_fn(p, jbatch, cfg_chunk))(params)
+    l_full, g_full = jax.value_and_grad(lambda p: llama.loss_fn(p, jbatch, cfg_full))(params)
+    np.testing.assert_allclose(float(l_chunk), float(l_full), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5),
+        g_chunk, g_full,
+    )
+
+
+def test_chunked_ce_tied_embeddings():
+    cfg = dataclasses.replace(CFG, tie_embeddings=True, loss_chunk=8)
+    params = llama.init_params(cfg)
+    batch = {k: jnp.asarray(v) for k, v in make_batch(2, 16).items()}
+    loss = llama.loss_fn(params, batch, cfg)
+    assert np.isfinite(float(loss))
