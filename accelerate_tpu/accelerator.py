@@ -128,14 +128,25 @@ class _TrainStep:
             at_end = gs.sync_with_dataloader and gs.end_of_dataloader
             do_sync = ((self.micro_count + 1) % acc.gradient_accumulation_steps == 0) or at_end
             gs._set_sync_gradients(do_sync)
+        offload = acc._opt_device_shardings is not None
         # Mesh context lets model code use bare PartitionSpecs in sharding constraints.
         with jax.set_mesh(acc.mesh):
+            state = acc._offload_fetch(state, opt=do_sync)
             if do_sync:
                 state, metrics = self.apply_fn(state, batch)
                 self.micro_count = 0
             else:
+                # Micro steps never touch the optimizer state: detach it so the host-resident
+                # moments neither transit PCIe nor occupy HBM during the activation-heavy
+                # fwd/bwd (and the jit never sees host-memory-kind inputs).
+                host_opt = state.opt_state if offload else None
+                if offload:
+                    state = state.replace(opt_state=None)
                 state, metrics = self.micro_fn(state, batch)
+                if offload:
+                    state = state.replace(opt_state=host_opt)
                 self.micro_count += 1
+            state = acc._offload_stash(state, opt=do_sync)
         acc.step += 1
         if self.optimizer is not None:
             self.optimizer.step()
@@ -193,7 +204,9 @@ class _FusedTrainStep:
         acc = self.accelerator
         stacked = self._stack(batches)
         with jax.set_mesh(acc.mesh):
+            state = acc._offload_fetch(state, opt=True)
             state, metrics = self.fused_fn(state, stacked)
+            state = acc._offload_stash(state, opt=True)
         acc.step += self.fused_steps
         applies = self.fused_steps // acc.gradient_accumulation_steps
         if self.optimizer is not None:
@@ -238,6 +251,38 @@ class Accelerator:
         # Plugins may also arrive via the env wire protocol (launcher sets ACCELERATE_*).
         if fsdp_plugin is None and os.environ.get("ACCELERATE_USE_FSDP", "false").lower() == "true":
             fsdp_plugin = FullyShardedDataParallelPlugin()
+
+        # A MegatronLMPlugin is a bundle: expand it into the individual plugins it implies
+        # (reference _prepare_megatron_lm, accelerator.py:2011; our mesh subsumes the engine).
+        self._megatron_grad_clip = None
+        if megatron_lm_plugin is not None:
+            from .utils.dataclasses import (
+                PipelineParallelPlugin,
+                SequenceParallelPlugin,
+                TensorParallelPlugin,
+            )
+
+            if tp_plugin is None and megatron_lm_plugin.tp_degree > 1:
+                tp_plugin = TensorParallelPlugin(tp_size=megatron_lm_plugin.tp_degree)
+            if pp_plugin is None and megatron_lm_plugin.pp_degree > 1:
+                pp_plugin = PipelineParallelPlugin(
+                    pp_size=megatron_lm_plugin.pp_degree,
+                    num_microbatches=megatron_lm_plugin.num_micro_batches,
+                )
+            if sp_plugin is None and megatron_lm_plugin.sp_degree > 1:
+                sp_plugin = SequenceParallelPlugin(sp_size=megatron_lm_plugin.sp_degree)
+            if fsdp_plugin is None and megatron_lm_plugin.use_distributed_optimizer:
+                fsdp_plugin = FullyShardedDataParallelPlugin(zero_stage=1)
+            if (
+                megatron_lm_plugin.pp_degree == 1
+                and megatron_lm_plugin.num_micro_batches
+                and gradient_accumulation_steps is None
+                and gradient_accumulation_plugin is None
+            ):
+                # Megatron micro-batching implies gradient accumulation independent of
+                # pipeline depth; without a pipe the microbatches become accum steps.
+                gradient_accumulation_steps = megatron_lm_plugin.num_micro_batches
+            self._megatron_grad_clip = megatron_lm_plugin.gradient_clipping
 
         # Kwargs handler dispatch (reference accelerator.py:425-450).
         self.fp8_recipe = None
@@ -321,9 +366,16 @@ class Accelerator:
         self._zero_opt_specs = None
         self._zero_grad_specs = None
         self._zero_param_specs = None
+        # cpu_offload sharding trees (host/device variants), filled by create_train_state.
+        self._opt_host_shardings = None
+        self._opt_device_shardings = None
+        self._accum_host_shardings = None
+        self._accum_device_shardings = None
         self._in_accumulate_ctx = False
         self._accumulate_count = 0
-        self._max_grad_norm: Optional[float] = None
+        self._max_grad_norm: Optional[float] = (
+            float(self._megatron_grad_clip) if self._megatron_grad_clip is not None else None
+        )
         self._models: list = []
         self._optimizers: list[AcceleratedOptimizer] = []
         self._schedulers: list = []
@@ -382,6 +434,16 @@ class Accelerator:
     @property
     def use_distributed(self) -> bool:
         return self.state.use_distributed
+
+    @property
+    def num_microbatches(self) -> int:
+        """Pipeline microbatch count: plugin value, else n_stages (minimum full pipe)."""
+        from .utils.constants import PIPELINE_AXIS
+
+        plugin = self.state.pp_plugin
+        if plugin is not None and plugin.num_microbatches is not None:
+            return plugin.num_microbatches
+        return self.mesh.shape[PIPELINE_AXIS]
 
     @property
     def gradient_accumulation_steps(self) -> int:
@@ -516,6 +578,36 @@ class Accelerator:
         return wrapped
 
     # -------------------------------------------------------------------- train state/step
+    def _offload_fetch(self, state: TrainState, opt: bool) -> TrainState:
+        """cpu_offload: stream host-resident optimizer/accum state into device HBM for one
+        step dispatch. Transfers happen OUTSIDE jit (XLA CPU cannot annotate host placement
+        on jit outputs); between steps the state lives in pinned host RAM, so HBM holds the
+        optimizer moments only during the (activation-free) apply phase."""
+        if self._opt_device_shardings is None:
+            return state
+        updates = {}
+        if opt:
+            # Single device_put over the whole tree: the runtime batches/overlaps the
+            # transfers instead of serializing one PCIe copy per leaf.
+            updates["opt_state"] = jax.device_put(state.opt_state, self._opt_device_shardings)
+        if state.grad_accum is not None and self._accum_device_shardings is not None:
+            updates["grad_accum"] = jax.device_put(
+                state.grad_accum, self._accum_device_shardings
+            )
+        return state.replace(**updates) if updates else state
+
+    def _offload_stash(self, state: TrainState, opt: bool) -> TrainState:
+        if self._opt_device_shardings is None:
+            return state
+        updates = {}
+        if opt:
+            updates["opt_state"] = jax.device_put(state.opt_state, self._opt_host_shardings)
+        if state.grad_accum is not None and self._accum_host_shardings is not None:
+            updates["grad_accum"] = jax.device_put(
+                state.grad_accum, self._accum_host_shardings
+            )
+        return state.replace(**updates) if updates else state
+
     def create_train_state(
         self,
         params,
@@ -563,7 +655,6 @@ class Accelerator:
             if plugin.shards_grads:
                 self._zero_grad_specs = get_zero_specs(params, self.mesh, plugin)
 
-        optimizer._opt_state_ref = opt_state
         accum = None
         if self.gradient_accumulation_steps > 1:
             accum = jax.tree_util.tree_map(jnp.zeros_like, params)
@@ -571,6 +662,32 @@ class Accelerator:
                 from .parallel.fsdp import shard_tree
 
                 accum = shard_tree(accum, self.mesh, self._zero_grad_specs)
+
+        if plugin is not None and plugin.cpu_offload:
+            # ZeRO-Offload layout (reference DeepSpeed offload fields, dataclasses.py:1078):
+            # optimizer state and accumulation buffers live in pinned host RAM; the apply
+            # step streams them through device HBM (SURVEY.md §7 equivalence table).
+            def _kinds(tree):
+                def _spec(leaf):
+                    sh = getattr(leaf, "sharding", None)
+                    return sh.spec if isinstance(sh, NamedSharding) else PartitionSpec()
+
+                dev = jax.tree_util.tree_map(
+                    lambda l: NamedSharding(self.mesh, _spec(l), memory_kind="device"), tree
+                )
+                host = jax.tree_util.tree_map(
+                    lambda l: NamedSharding(self.mesh, _spec(l), memory_kind="pinned_host"),
+                    tree,
+                )
+                return host, dev
+
+            self._opt_host_shardings, self._opt_device_shardings = _kinds(opt_state)
+            opt_state = jax.device_put(opt_state, self._opt_host_shardings)
+            if accum is not None:
+                self._accum_host_shardings, self._accum_device_shardings = _kinds(accum)
+                accum = jax.device_put(accum, self._accum_host_shardings)
+
+        optimizer._opt_state_ref = opt_state
         return TrainState(
             params=params,
             opt_state=opt_state,
@@ -713,6 +830,13 @@ class Accelerator:
                 raise ValueError(
                     f"fused_steps ({fused_steps}) must be a multiple of "
                     f"gradient_accumulation_steps ({accum_steps})"
+                )
+            if self._schedulers:
+                raise ValueError(
+                    "fused_steps>1 compiles the optimizer applies into one XLA program, so a "
+                    "host-stepped scheduler cannot fire between them. Encode the schedule in "
+                    "the optimizer instead (e.g. optax.warmup_cosine_decay_schedule passed to "
+                    "adamw) — it is traced per-step from the optimizer state's count."
                 )
 
             def micro_step_padded(st, batch):
@@ -896,20 +1020,28 @@ class Accelerator:
         else:
             data = gather(input_data)
 
-        try:
-            if self.gradient_state.end_of_dataloader:
-                remainder = self.gradient_state.remainder
-                if remainder > 0:
+        if self.gradient_state.end_of_dataloader:
+            remainder = self.gradient_state.remainder
+            if remainder > 0:
 
-                    def _trim(tensor):
-                        return tensor[:remainder]
+                def _trim(tensor):
+                    return tensor[:remainder]
 
+                try:
                     if use_gather_object or not all_tensors:
                         return data[:remainder]
                     return recursively_apply(_trim, data)
-            return data
-        except Exception:
-            return data
+                except (TypeError, IndexError):
+                    # Unsliceable payload (objects without __getitem__ → TypeError, 0-d
+                    # scalar tensors → IndexError): fall back to untrimmed, matching the
+                    # reference's behavior of only trimming indexable containers. Real
+                    # errors propagate.
+                    logger.warning(
+                        "gather_for_metrics could not trim the duplicate tail of the last "
+                        "batch; returning untrimmed data"
+                    )
+                    return data
+        return data
 
     def reduce(self, tensor, reduction: str = "sum", scale: float = 1.0):
         return reduce(tensor, reduction=reduction, scale=scale)

@@ -93,15 +93,39 @@ def save_accelerator_state(
     for hook in accelerator._save_model_hooks:
         hook(accelerator._models, train_state, str(path))
 
-    # 1. Sharded train state via orbax (params + opt state + counters + rng).
+    # 1. Train state: SHARDED (orbax/tensorstore, every host writes its shards) or FULL
+    # (all-gather + consolidated single-file state on rank 0 — reference FSDP
+    # FULL_STATE_DICT, utils/fsdp_utils.py:66-107), chosen by the fsdp plugin's
+    # ``state_dict_type``.
     if train_state is not None:
-        import orbax.checkpoint as ocp
+        full = (
+            getattr(accelerator.state, "fsdp_plugin", None) is not None
+            and accelerator.state.fsdp_plugin.state_dict_type == "FULL_STATE_DICT"
+        )
+        full_file = path / f"{MODEL_NAME}_full.pkl"
+        sharded_dir = (path / SHARDED_STATE_DIR).absolute()
+        if full:
+            from .parallel.fsdp import gather_full_params
 
-        ckpt_path = (path / SHARDED_STATE_DIR).absolute()
-        if ckpt_path.exists():
-            shutil.rmtree(ckpt_path)
-        with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(ckpt_path, train_state)
+            # The allgather is a collective — EVERY process must run it; only rank 0 writes
+            # (FULL checkpoints therefore assume a filesystem readable by all ranks at load
+            # time, the same contract as the reference's FULL_STATE_DICT).
+            host_state = gather_full_params(train_state)
+            if accelerator.is_main_process:
+                if sharded_dir.exists():  # don't leave a stale other-format snapshot behind
+                    shutil.rmtree(sharded_dir)
+                with open(full_file, "wb") as f:
+                    pickle.dump(host_state, f)
+            accelerator.wait_for_everyone()
+        else:
+            import orbax.checkpoint as ocp
+
+            if sharded_dir.exists():
+                shutil.rmtree(sharded_dir)
+            if full_file.exists() and accelerator.is_main_process:
+                full_file.unlink()  # same: a stale FULL file would shadow this save on load
+            with ocp.StandardCheckpointer() as ckptr:
+                ckptr.save(sharded_dir, train_state)
         # 1b. Optional interchange export: consolidated safetensors of the params.
         if safe_serialization and accelerator.is_main_process:
             _export_safetensors(train_state.params, path / SAFE_WEIGHTS_NAME)
@@ -164,11 +188,33 @@ def load_accelerator_state(
 
     restored = None
     if train_state is not None:
-        import orbax.checkpoint as ocp
+        # Format dispatch follows the plugin when one is configured (identical on every rank
+        # — a per-host file probe would diverge across ranks without a shared filesystem);
+        # the file probe is only the single-process/no-plugin fallback.
+        full_file = path / f"{MODEL_NAME}_full.pkl"
+        plugin = getattr(accelerator.state, "fsdp_plugin", None)
+        if plugin is not None:
+            use_full = plugin.state_dict_type == "FULL_STATE_DICT"
+        else:
+            use_full = full_file.exists()
+        if use_full:
+            # FULL_STATE_DICT: re-place the consolidated host pytree onto the current mesh
+            # with the live state's shardings (works across mesh-shape changes).
+            with open(full_file, "rb") as f:
+                host_state = pickle.load(f)
+            restored = jax.tree_util.tree_map(
+                lambda live, loaded: jax.device_put(loaded, live.sharding)
+                if isinstance(live, jax.Array)
+                else loaded,
+                train_state,
+                host_state,
+            )
+        else:
+            import orbax.checkpoint as ocp
 
-        with ocp.StandardCheckpointer() as ckptr:
-            abstract = jax.tree_util.tree_map(_abstractify, train_state)
-            restored = ckptr.restore((path / SHARDED_STATE_DIR).absolute(), abstract)
+            with ocp.StandardCheckpointer() as ckptr:
+                abstract = jax.tree_util.tree_map(_abstractify, train_state)
+                restored = ckptr.restore((path / SHARDED_STATE_DIR).absolute(), abstract)
 
     meta_file = path / SCHEDULER_STATE_NAME
     if meta_file.exists():

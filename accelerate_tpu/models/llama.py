@@ -33,8 +33,10 @@ __all__ = [
     "init_params",
     "forward",
     "forward_hidden",
+    "forward_pp",
     "forward_streamed",
     "loss_fn",
+    "loss_fn_pp",
     "partition_specs",
     "CONFIGS",
     "init_cache",
@@ -59,6 +61,10 @@ class LlamaConfig:
     tie_embeddings: bool = False
     attn_impl: str = "auto"  # "auto" | "flash" | "xla"
     remat: bool = True       # jax.checkpoint each block (activation checkpointing)
+    # Remat policy: "full" recomputes everything (min memory), "dots" saves matmul outputs
+    # and recomputes only cheap elementwise ops (jax.checkpoint_policies — trades HBM for
+    # ~25-30% less recompute FLOPs), "offload" offloads block inputs to host memory.
+    remat_policy: str = "full"
     scan_layers: bool = False  # lax.scan over stacked layer params (fast compile)
     use_fp8: bool = False    # fp8-quantized projections (ops/fp8.py, the TE-swap analog)
     fp8_format: Optional[str] = None  # None → the process recipe (FP8RecipeKwargs) decides
@@ -163,12 +169,19 @@ def init_params(cfg: LlamaConfig, key: Optional[jax.Array] = None) -> dict:
     return params
 
 
-def partition_specs(cfg: LlamaConfig) -> dict:
+def partition_specs(cfg: LlamaConfig, pp: bool = False) -> dict:
     """Megatron-layout PartitionSpecs, same structure as the params pytree.
 
     Column-parallel: wq/wk/wv/w_gate/w_up split their output dim over ``tp``.
     Row-parallel: wo/w_down split their input dim over ``tp`` (GSPMD inserts the psum).
     Embedding/lm_head shard the vocab dim (logits stay tp-sharded until the loss psum).
+
+    ``pp=True``: layer params are stage-stacked ``[n_stages, L/n_stages, ...]``
+    (``parallel.pp.split_params_into_stages``) with the stage dim sharded over ``pp`` — each
+    pipeline stage holds only its own blocks. Embed/ln_f/head stay outside the pipeline
+    (replicated over pp; the reference pins them to first/last rank instead —
+    ``inference.py:164-168`` — but under GSPMD replicating the cheap ends costs less than the
+    extra transfer ticks).
     """
     layer = {
         "ln_attn": P(),
@@ -188,21 +201,39 @@ def partition_specs(cfg: LlamaConfig) -> dict:
             "w_up": P(None, TENSOR_AXIS),
             "w_down": P(TENSOR_AXIS, None),
         })
-    if cfg.scan_layers:
+    if pp:
+        if not cfg.scan_layers:
+            raise ValueError("pipeline parallelism requires cfg.scan_layers=True")
+        from ..utils.constants import PIPELINE_AXIS
+
+        layer = jax.tree_util.tree_map(
+            lambda spec: P(PIPELINE_AXIS, None, *spec),
+            layer,
+            is_leaf=lambda s: isinstance(s, P),
+        )
+        layers: Any = layer
+    elif cfg.scan_layers:
         # Leading stacked-layer dim on every leaf spec (handles the nested moe subtree).
         layer = jax.tree_util.tree_map(
             lambda spec: P(None, *spec), layer, is_leaf=lambda s: isinstance(s, P)
         )
-        layers: Any = layer
+        layers = layer
     else:
         layers = [dict(layer) for _ in range(cfg.n_layers)]
+    from ..utils.constants import FSDP_AXIS
+
+    # Vocab dim sharded over (tp, fsdp) together: Megatron vocab-parallel embedding composed
+    # with ZeRO-3 memory sharding on the SAME dim. Sharding d_model instead (what fsdp
+    # auto-composition would pick) makes the token-lookup gather unshardable — XLA's SPMD
+    # partitioner falls back to "involuntary full rematerialization" (replicate + repartition)
+    # on every embedding lookup under a dp×fsdp×tp×sp mesh.
     specs = {
-        "embed": P(TENSOR_AXIS, None),
+        "embed": P((TENSOR_AXIS, FSDP_AXIS), None),
         "layers": layers,
         "ln_f": P(),
     }
     if not cfg.tie_embeddings:
-        specs["lm_head"] = P(None, TENSOR_AXIS)
+        specs["lm_head"] = P(None, (TENSOR_AXIS, FSDP_AXIS))
     return specs
 
 
@@ -309,6 +340,25 @@ def _block(x, layer, positions, mask, cfg: LlamaConfig):
     return x, jnp.zeros((), jnp.float32)
 
 
+def _maybe_remat_block(cfg: LlamaConfig):
+    """The block fn under the config's activation-checkpointing policy (validated)."""
+    if not cfg.remat:
+        return _block
+    if cfg.remat_policy == "full":
+        policy = None
+    elif cfg.remat_policy == "dots":
+        policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    elif cfg.remat_policy == "offload":
+        policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host"
+        )
+    else:
+        raise ValueError(
+            f"remat_policy={cfg.remat_policy!r}: expected 'full', 'dots' or 'offload'"
+        )
+    return jax.checkpoint(_block, static_argnums=(4,), policy=policy)
+
+
 def forward_hidden(
     params: dict,
     tokens: jax.Array,
@@ -331,9 +381,7 @@ def forward_hidden(
         x = _maybe_shard(x, P(BATCH_AXES, SEQUENCE_AXIS, None))
     mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
 
-    block = _block
-    if cfg.remat:
-        block = jax.checkpoint(_block, static_argnums=(4,))
+    block = _maybe_remat_block(cfg)
 
     aux_total = jnp.zeros((), jnp.float32)
     if cfg.scan_layers:
@@ -376,8 +424,9 @@ def forward(
 def _loss_chunk_size(cfg: LlamaConfig, S: int) -> int:
     """Resolve the chunked-CE chunk length (0 tokens = don't chunk).
 
-    Auto mode chunks only when the fp32 logits would exceed ~256 MB per step — below that the
-    simple fused path is both faster and already cheap.
+    An explicit ``loss_chunk`` is always honored (``_chunked_ce`` pads S up to a chunk
+    multiple, so divisibility never silently disables it). Auto mode chunks at 512 only when
+    the fp32 logits would be large enough to matter (> 64 MB per example row).
     """
     if cfg.loss_chunk == -1:
         return 0
@@ -386,21 +435,25 @@ def _loss_chunk_size(cfg: LlamaConfig, S: int) -> int:
     # auto: threshold on S*V; 2**24 elements = 64 MB of fp32 logits per example row.
     if S * cfg.vocab_size <= 2**24:
         return 0
-    chunk = 512
-    while chunk > 1 and S % chunk != 0:
-        chunk //= 2
-    return chunk
+    return min(512, S)
 
 
 def _chunked_ce(x, head, targets, mask, chunk: int, dtype):
     """Memory-efficient cross-entropy: per-chunk head matmul + logsumexp under remat.
 
-    ``x`` [B,S,D] (post-ln_f hidden), ``head`` [D,V]; returns (sum of -log p(target) over
-    unmasked positions, mask count). The fp32 [B,S,V] logits are never materialized — each
-    scan step computes one [B,chunk,V] block and the backward pass recomputes it
-    (``jax.checkpoint``), so peak memory drops from O(S·V) to O(chunk·V).
+    ``x`` [B,S,D] (post-ln_f hidden), ``head`` [D,V]; returns the sum of -log p(target) over
+    unmasked positions. The fp32 [B,S,V] logits are never materialized — each scan step
+    computes one [B,chunk,V] block and the backward pass recomputes it (``jax.checkpoint``),
+    so peak memory drops from O(S·V) to O(chunk·V). S is padded up to a chunk multiple with
+    masked positions, so any chunk works for any sequence length.
     """
     B, S, D = x.shape
+    if S % chunk:
+        pad = chunk - S % chunk
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+        S += pad
     n = S // chunk
     xs = x.reshape(B, n, chunk, D).swapaxes(0, 1)            # [n, B, c, D]
     ts = targets.reshape(B, n, chunk).swapaxes(0, 1)         # [n, B, c]
@@ -419,6 +472,20 @@ def _chunked_ce(x, head, targets, mask, chunk: int, dtype):
 
     total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xs, ts, ms))
     return total
+
+
+def _ce_from_hidden(x, params, targets, mask, cfg: LlamaConfig) -> jax.Array:
+    """Cross-entropy from post-ln_f hidden states (chunked when ``cfg.loss_chunk`` says so)."""
+    S = x.shape[1]
+    denom = jnp.maximum(mask.sum(), 1.0)
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    chunk = _loss_chunk_size(cfg, S)  # always divides S when nonzero
+    if chunk > 0:
+        return _chunked_ce(x, head, targets, mask, chunk, cfg.dtype) / denom
+    logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    return -(ll * mask).sum() / denom
 
 
 def loss_fn(
@@ -440,20 +507,79 @@ def loss_fn(
         if "mask" in batch
         else jnp.ones((B, S), jnp.float32)
     )
-    denom = jnp.maximum(mask.sum(), 1.0)
-    chunk = _loss_chunk_size(cfg, S)
-    if chunk > 0 and S % chunk == 0:
-        x, aux = forward_hidden(params, inputs, cfg)
-        head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-        ce = _chunked_ce(x, head, targets, mask, chunk, cfg.dtype) / denom
-    else:
-        logits, aux = forward(params, inputs, cfg, return_aux=True)
-        logp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
-        ce = -(ll * mask).sum() / denom
+    x, aux = forward_hidden(params, inputs, cfg)
+    ce = _ce_from_hidden(x, params, targets, mask, cfg)
     if cfg.moe_experts > 0:
         return ce + cfg.moe_aux_weight * aux
     return ce
+
+
+# --------------------------------------------------------------- pipeline-parallel training
+def forward_pp(
+    params: dict,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mesh,
+    num_microbatches: Optional[int] = None,
+    shard_activations: bool = True,
+) -> jax.Array:
+    """Causal LM forward with the transformer blocks run as a GPipe pipeline over ``pp``.
+
+    ``params["layers"]`` must be stage-stacked ``[n_stages, L/n, ...]`` (scan_layers params
+    through ``parallel.pp.split_params_into_stages``; specs from ``partition_specs(cfg,
+    pp=True)``). Embed and head run outside the pipeline on every device (cheap vs blocks).
+    The whole schedule is one differentiable scan, so the same function trains — unlike the
+    reference, whose pipelining is inference-only (``inference.py:82-121``).
+    MoE aux losses are not collected on this path (dense MLP configs only for now).
+    """
+    from ..parallel.pp import make_pipeline_fn
+
+    if cfg.moe_experts > 0:
+        raise NotImplementedError("pipeline parallelism currently supports dense MLPs only")
+    B, S = tokens.shape
+    dtype = cfg.dtype
+    block = _maybe_remat_block(cfg)
+
+    def stage_fn(stage_layers, x):
+        # x: one microbatch [B_m, S, D]; positions/mask rebuilt locally (identical rows).
+        pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (x.shape[0], S))
+        mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
+
+        def body(carry, layer):
+            out, _ = block(carry, layer, pos, mask, cfg)
+            return out, None
+
+        out, _ = jax.lax.scan(body, x, stage_layers)
+        return out
+
+    x = params["embed"].astype(dtype)[tokens]
+    if shard_activations:
+        x = _maybe_shard(x, P(BATCH_AXES, None, None))
+    pipe = make_pipeline_fn(mesh, stage_fn, num_microbatches=num_microbatches)
+    x = pipe(params["layers"], x)
+    x = _rms_norm(x, params["ln_f"], cfg.norm_eps)
+    return x
+
+
+def loss_fn_pp(
+    params: dict,
+    batch: dict,
+    cfg: LlamaConfig,
+    mesh,
+    num_microbatches: Optional[int] = None,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Pipeline-parallel next-token cross-entropy (same contract as ``loss_fn``)."""
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    B, S = inputs.shape
+    mask = (
+        batch["mask"][:, 1:].astype(jnp.float32)
+        if "mask" in batch
+        else jnp.ones((B, S), jnp.float32)
+    )
+    x = forward_pp(params, inputs, cfg, mesh, num_microbatches=num_microbatches)
+    return _ce_from_hidden(x, params, targets, mask, cfg)
 
 
 @partial(jax.jit, static_argnames=("cfg",))

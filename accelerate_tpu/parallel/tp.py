@@ -91,6 +91,9 @@ def apply_tensor_parallel(
             raise ValueError("Pass either a spec pytree or a registered plan name")
         specs = get_tp_plan(plan)(params)
     shardings = get_fsdp_shardings(params, mesh, fsdp_plugin, specs=specs)
+    from .fsdp import _log_sharding_summary
+
+    _log_sharding_summary(params, shardings, mesh)
 
     def _put(leaf, sharding):
         if isinstance(leaf, jax.Array):

@@ -284,13 +284,21 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
     zero_stage: Optional[int] = None          # overrides sharding_strategy if set
     min_weight_size: int = 2**10              # params with fewer elements stay replicated
     shard_axis: str = "fsdp"
-    state_dict_type: str = "SHARDED_STATE_DICT"  # or FULL_STATE_DICT on save
-    cpu_offload: bool = False                 # params live in host memory, streamed per-step
-    backward_prefetch: bool = True            # informational; XLA schedules prefetch itself
+    # Checkpoint layout on save_state: SHARDED keeps orbax per-shard tensorstore files;
+    # FULL gathers to a single consolidated state on rank 0 (reference FSDP StateDictType,
+    # utils/constants.py:39). Consumed by checkpointing.save_accelerator_state.
+    state_dict_type: str = "SHARDED_STATE_DICT"
+    # ZeRO-Offload: optimizer state + grad-accum buffers live in pinned host RAM and are
+    # streamed through HBM inside the apply step (consumed by create_train_state /
+    # build_train_step). Reference: DeepSpeed offload fields, dataclasses.py:1078-1093.
+    cpu_offload: bool = False
     use_orig_params: bool = True              # API parity; always true functionally
-    activation_checkpointing: bool = False    # jax.checkpoint on block boundaries
     cpu_ram_efficient_loading: bool = True    # init on host rank0, shard-scatter to devices
     sync_module_states: bool = True
+    # NOTE deliberately absent vs the reference plugin (accepted-but-ignored flags are worse
+    # than errors): ``backward_prefetch`` (XLA's scheduler owns prefetch; nothing to toggle)
+    # and ``activation_checkpointing`` (a model-definition concern under jax — use
+    # ``jax.checkpoint``/``LlamaConfig.remat``/``remat_policy``).
 
     def __post_init__(self):
         self.sharding_strategy = FSDPShardingStrategy(str(self.sharding_strategy))
@@ -330,11 +338,26 @@ class TensorParallelPlugin(KwargsHandler):
 
 @dataclass
 class PipelineParallelPlugin(KwargsHandler):
-    """GPipe-style pipeline parallelism along the ``pp`` axis (reference ``inference.py``)."""
+    """GPipe-style pipeline parallelism along the ``pp`` axis (reference ``inference.py``).
+
+    Only the GPipe schedule exists: the pipeline is one differentiable ``lax.scan``
+    (``parallel/pp.py``) whose backward schedule jax AD derives, so a hand-written 1F1B
+    interleave has no seam to plug into — its memory benefit is obtained with
+    ``remat``/offload policies instead. Requesting "1f1b" raises rather than silently
+    running GPipe.
+    """
 
     pp_size: int = 1
-    num_microbatches: int = 1
-    schedule: str = "gpipe"  # or "1f1b"
+    num_microbatches: Optional[int] = None  # None → n_stages (min for a full pipe)
+    schedule: str = "gpipe"
+
+    def __post_init__(self):
+        if self.schedule != "gpipe":
+            raise ValueError(
+                f"schedule={self.schedule!r} is not supported: the scan-based pipeline "
+                "derives its backward schedule via jax AD (GPipe); bound activation memory "
+                "with remat/offload policies instead of 1F1B."
+            )
 
 
 @dataclass
@@ -361,15 +384,30 @@ class ExpertParallelPlugin(KwargsHandler):
 
 @dataclass
 class MegatronLMPlugin(KwargsHandler):
-    """3D-parallel trainer config (reference ``dataclasses.py:1899``): one object bundling the
-    tp/pp/sp/dp degrees the integrated mesh trainer uses."""
+    """3D-parallel trainer config (reference ``dataclasses.py:1899``): one object bundling
+    the tp/pp/sp degrees + distributed optimizer + clipping of the integrated mesh trainer.
+
+    Consumed by ``Accelerator.__init__``, which expands it into the individual plugins:
+    ``tp_degree``→TensorParallelPlugin, ``pp_degree``/``num_micro_batches``→
+    PipelineParallelPlugin, ``sp_degree``→SequenceParallelPlugin,
+    ``use_distributed_optimizer``→ZeRO-1 (fsdp plugin, reference ``dataclasses.py:2015``),
+    ``gradient_clipping``→the default max_grad_norm of built train steps.
+
+    Divergence from Megatron: its sequence parallelism reuses the tp ranks for norm/dropout
+    activations only; here ``sp_degree`` is a real context-parallel mesh axis (ring/Ulysses
+    attention, ``parallel/sequence.py``) — strictly more capable.
+    """
 
     tp_degree: int = 1
     pp_degree: int = 1
-    num_micro_batches: int = 1
-    sequence_parallelism: bool = False
-    gradient_clipping: float = 1.0
-    use_distributed_optimizer: bool = True  # == ZeRO-1 on the dp axis
+    sp_degree: int = 1
+    num_micro_batches: Optional[int] = None
+    gradient_clipping: Optional[float] = 1.0
+    use_distributed_optimizer: bool = True  # == ZeRO-1 on the data axis
+
+    @property
+    def sequence_parallelism(self) -> bool:
+        return self.sp_degree > 1
 
 
 @dataclass

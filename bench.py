@@ -100,6 +100,8 @@ def _fail_json(metric: str, stage: str, exc: BaseException) -> None:
 
 
 def _make_config(S: int, preset: str | None):
+    import os
+
     import jax
 
     from accelerate_tpu.models import llama
@@ -113,9 +115,13 @@ def _make_config(S: int, preset: str | None):
         n_kv_heads=8,
         d_ff=8192,
         max_seq=S,
-        remat=True,
+        remat=os.environ.get("BENCH_REMAT", "1") == "1",
+        remat_policy=os.environ.get("BENCH_REMAT_POLICY", "full"),
         scan_layers=True,
-        attn_impl="flash" if jax.default_backend() in ("tpu", "axon") else "xla",
+        attn_impl=os.environ.get(
+            "BENCH_ATTN",
+            "flash" if jax.default_backend() in ("tpu", "axon") else "xla",
+        ),
     )
     if preset == "smoke":  # CI/CPU logic check, not a perf number
         cfg = dataclasses.replace(
@@ -124,7 +130,7 @@ def _make_config(S: int, preset: str | None):
     return cfg
 
 
-def run(B: int, S: int, fuse: int, preset: str | None, metric: str):
+def run(B: int, S: int, fuse: int, preset: str | None):
     import jax
     import optax
 
@@ -133,6 +139,7 @@ def run(B: int, S: int, fuse: int, preset: str | None, metric: str):
 
     cfg = _make_config(S, preset)
     n_params = llama.num_params(cfg)
+    metric = _metric_label(B, S, fuse, preset, cfg)
 
     acc = Accelerator(mixed_precision="bf16")
     state = acc.create_train_state(llama.init_params(cfg), optax.adamw(1e-4))
@@ -187,14 +194,24 @@ def run(B: int, S: int, fuse: int, preset: str | None, metric: str):
     print(json.dumps(out))
 
 
+def _metric_label(B: int, S: int, fuse: int, preset: str | None, cfg=None) -> str:
+    """Label encodes the actual benchmarked config (env overrides included) so sweep rows
+    stay distinguishable."""
+    if preset:
+        return f"train_mfu [{preset} preset — not a perf number]"
+    attn = cfg.attn_impl if cfg is not None else "?"
+    remat = (f"remat-{cfg.remat_policy}" if cfg.remat else "noremat") if cfg is not None else "?"
+    return f"train_mfu (llama-0.9B b{B} seq{S} bf16 {attn} {remat} fused{fuse})"
+
+
 def main():
     import os
 
     preset = os.environ.get("BENCH_PRESET")
-    B, S, fuse = 4, 2048, 4
-    metric = "train_mfu (llama-0.9B seq2048 bf16 flash remat fused)"
-    if preset:
-        metric = f"train_mfu [{preset} preset — not a perf number]"
+    B = int(os.environ.get("BENCH_B", "4"))
+    S = int(os.environ.get("BENCH_S", "2048"))
+    fuse = int(os.environ.get("BENCH_FUSE", "4"))
+    metric = _metric_label(B, S, fuse, preset)
 
     try:
         _init_backend()
@@ -205,7 +222,7 @@ def main():
     transient_left = 3
     while True:
         try:
-            run(B, S, fuse, preset, metric)
+            run(B, S, fuse, preset)
             return 0
         except Exception as e:  # noqa: BLE001
             from accelerate_tpu.state import AcceleratorState, GradientState, PartialState

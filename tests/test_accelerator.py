@@ -363,3 +363,66 @@ def test_fused_steps_requires_multiple():
     acc.prepare(optax.sgd(0.1))
     with pytest.raises(ValueError, match="multiple"):
         acc.build_train_step(loss_fn, fused_steps=4)
+
+
+def test_fused_rejects_prepared_scheduler():
+    """A host-stepped scheduler cannot fire inside the fused scan — must raise, not ignore."""
+    acc = make_accelerator()
+    acc.create_train_state(init_params(), optax.sgd(0.1))
+
+    class Sched:
+        def __init__(self):
+            self.lr = 0.1
+        def step(self):
+            self.lr *= 0.9
+        def state_dict(self):
+            return {"lr": self.lr}
+        def load_state_dict(self, sd):
+            self.lr = sd["lr"]
+
+    acc.prepare_scheduler(Sched())
+    with pytest.raises(ValueError, match="optax"):
+        acc.build_train_step(loss_fn, fused_steps=4)
+
+
+def test_fused_optax_schedule_matches_sequential():
+    """LR schedules in fused mode ride the optimizer state: fused == sequential exactly."""
+    ds = RegressionDataset(32)
+    batches = [{"x": ds.x[i : i + 8], "y": ds.y[i : i + 8]} for i in range(0, 32, 8)]
+    sched = optax.linear_schedule(0.2, 0.02, transition_steps=4)
+
+    acc = make_accelerator()
+    state_seq = acc.create_train_state(init_params(), optax.sgd(sched))
+    step = acc.build_train_step(loss_fn)
+    for b in batches:
+        state_seq, _ = step(state_seq, {k: jnp.asarray(v) for k, v in b.items()})
+
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc2 = make_accelerator()
+    state_f = acc2.create_train_state(init_params(), optax.sgd(sched))
+    fused = acc2.build_train_step(loss_fn, fused_steps=4)
+    state_f, _ = fused(state_f, batches)
+    for k in state_seq.params:
+        np.testing.assert_allclose(
+            np.asarray(state_f.params[k]), np.asarray(state_seq.params[k]), rtol=2e-5, atol=1e-6
+        )
+
+
+def test_gather_for_metrics_scalar_payload_no_crash():
+    """0-d tensors at end-of-dataloader with a remainder must not crash the trim path."""
+    acc = make_accelerator()
+
+    class FakeDL:
+        end_of_dataloader = True
+        remainder = 3
+
+    acc.gradient_state._add_dataloader(FakeDL())
+    try:
+        out = acc.gather_for_metrics(jnp.asarray(1.25))
+    finally:
+        acc.gradient_state._remove_dataloader(acc.gradient_state.active_dataloader)
+    assert float(np.asarray(out).reshape(-1)[0]) == 1.25

@@ -201,3 +201,39 @@ def test_chunked_ce_tied_embeddings():
     batch = {k: jnp.asarray(v) for k, v in make_batch(2, 16).items()}
     loss = llama.loss_fn(params, batch, cfg)
     assert np.isfinite(float(loss))
+
+
+def test_chunk_size_resolution():
+    from accelerate_tpu.models.llama import _loss_chunk_size
+
+    cfg = dataclasses.replace(CFG, loss_chunk=512)
+    assert _loss_chunk_size(cfg, 1000) == 512  # explicit request honored (S padded)
+    assert _loss_chunk_size(dataclasses.replace(CFG, loss_chunk=8), 32) == 8
+    cfg_auto = dataclasses.replace(CFG, vocab_size=32768, loss_chunk=0)
+    assert _loss_chunk_size(cfg_auto, 2047) == 512  # awkward S: padded, not per-token
+    assert _loss_chunk_size(cfg_auto, 2048) == 512
+    assert _loss_chunk_size(dataclasses.replace(CFG, loss_chunk=-1), 4096) == 0
+
+
+def test_chunked_ce_nondivisible_seq_matches_full():
+    """Odd S with an explicit chunk: the padded chunked path equals full logits exactly."""
+    params = llama.init_params(CFG)
+    batch = make_batch(2, 30)  # S=30, chunk=8 → padded to 32
+    jbatch = {k: jnp.asarray(v) for k, v in batch.items()}
+    cfg_chunk = dataclasses.replace(CFG, loss_chunk=8)
+    cfg_full = dataclasses.replace(CFG, loss_chunk=-1)
+    l_chunk, g_chunk = jax.value_and_grad(lambda p: llama.loss_fn(p, jbatch, cfg_chunk))(params)
+    l_full, g_full = jax.value_and_grad(lambda p: llama.loss_fn(p, jbatch, cfg_full))(params)
+    np.testing.assert_allclose(float(l_chunk), float(l_full), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5),
+        g_chunk, g_full,
+    )
+
+
+def test_remat_policy_validated():
+    cfg = dataclasses.replace(CFG, remat=True, remat_policy="dot")  # typo
+    params = llama.init_params(cfg)
+    tokens = jnp.asarray(make_batch(1, 8)["tokens"][:, :-1])
+    with pytest.raises(ValueError, match="remat_policy"):
+        llama.forward(params, tokens, cfg, shard_activations=False)

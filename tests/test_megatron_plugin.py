@@ -1,0 +1,70 @@
+"""MegatronLMPlugin wiring: one bundle expands into tp/pp/sp plugins + ZeRO-1 + clipping.
+
+Reference: ``MegatronLMPlugin`` (``dataclasses.py:1899``), distributed optimizer (:2015),
+``_prepare_megatron_lm`` (``accelerator.py:2011``) — here the 3D mesh + GSPMD subsume the
+engine, so the plugin's job is mesh derivation + optimizer partitioning + clip defaults.
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import llama
+from accelerate_tpu.utils import send_to_device
+from accelerate_tpu.utils.dataclasses import DistributedType, MegatronLMPlugin
+
+
+def test_megatron_plugin_builds_3d_mesh_and_zero1():
+    plugin = MegatronLMPlugin(tp_degree=2, gradient_clipping=0.5)
+    acc = Accelerator(megatron_lm_plugin=plugin)
+    shape = dict(zip(acc.mesh.axis_names, acc.mesh.devices.shape))
+    assert shape["tp"] == 2
+    assert shape["fsdp"] == 4  # distributed optimizer: remaining devices on the zero-1 axis
+    assert acc.distributed_type == DistributedType.HYBRID
+    assert acc._max_grad_norm == 0.5
+    assert acc.state.fsdp_plugin.zero_stage == 1
+
+    cfg = dataclasses.replace(llama.CONFIGS["tiny"], attn_impl="xla", dtype=jnp.float32)
+    state = acc.create_train_state(
+        llama.init_params(cfg), optax.adamw(1e-3), partition_specs=llama.partition_specs(cfg)
+    )
+    # ZeRO-1: optimizer moments sharded, params not fsdp-sharded beyond their tp spec.
+    mu = state.opt_state[0].mu
+    assert not mu["layers"][0]["w_gate"].sharding.is_fully_replicated
+    wq_spec = state.params["layers"][0]["wq"].sharding.spec
+    assert "fsdp" not in jax.tree_util.tree_leaves(list(wq_spec))
+
+    step = acc.build_train_step(lambda p, b: llama.loss_fn(p, b, cfg))
+    toks = np.random.default_rng(0).integers(0, cfg.vocab_size, size=(8, 17)).astype(np.int32)
+    state, m = step(state, send_to_device({"tokens": toks}, acc.mesh))
+    assert np.isfinite(float(m["loss"]))
+    assert "grad_norm" in m  # clipping active by default from the plugin
+
+
+def test_megatron_plugin_pp_and_microbatches():
+    plugin = MegatronLMPlugin(tp_degree=1, pp_degree=4, num_micro_batches=8,
+                              use_distributed_optimizer=False)
+    acc = Accelerator(megatron_lm_plugin=plugin)
+    shape = dict(zip(acc.mesh.axis_names, acc.mesh.devices.shape))
+    assert shape["pp"] == 4 and shape["dp"] == 2
+    assert acc.num_microbatches == 8
+
+
+def test_megatron_sequence_parallelism_property():
+    assert not MegatronLMPlugin().sequence_parallelism
+    assert MegatronLMPlugin(sp_degree=2).sequence_parallelism
+
+
+def test_megatron_microbatches_become_accum_without_pipe():
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    for s in (AcceleratorState, GradientState, PartialState):
+        s._reset_state()
+    plugin = MegatronLMPlugin(pp_degree=1, num_micro_batches=8,
+                              use_distributed_optimizer=False)
+    acc = Accelerator(megatron_lm_plugin=plugin)
+    assert acc.gradient_accumulation_steps == 8

@@ -106,3 +106,106 @@ def test_zero2_parity_with_accumulation():
     baseline = _train(zero_stage=0, accum=2)[2]
     losses = _train(zero_stage=2, accum=2)[2]
     np.testing.assert_allclose(losses, baseline, rtol=2e-5)
+
+
+def test_cpu_offload_opt_state_in_host_memory():
+    """ZeRO-Offload: opt state lives in pinned_host, training still works + matches."""
+    baseline = _train(zero_stage=0, steps=3)[2]
+
+    _reset()
+    params, batch, loss_fn = _make_problem()
+    acc = Accelerator(
+        mesh_config=MeshConfig(),
+        fsdp_plugin=FullyShardedDataParallelPlugin(
+            zero_stage=0, cpu_offload=True, min_weight_size=1
+        ),
+    )
+    state = acc.create_train_state(params, optax.adamw(1e-2))
+    assert state.opt_state[0].mu["w1"].sharding.memory_kind == "pinned_host"
+    step = acc.build_train_step(loss_fn)
+    dbatch = send_to_device(batch, acc.mesh)
+    losses = []
+    for _ in range(3):
+        state, m = step(state, dbatch)
+        losses.append(float(m["loss"]))
+    np.testing.assert_allclose(losses, baseline, rtol=2e-5)
+    # The updated opt state must come back to host memory each step.
+    assert state.opt_state[0].mu["w1"].sharding.memory_kind == "pinned_host"
+
+
+def test_cpu_offload_with_accumulation():
+    baseline = _train(zero_stage=0, steps=2, accum=2)[2]
+    _reset()
+    params, batch, loss_fn = _make_problem()
+    acc = Accelerator(
+        mesh_config=MeshConfig(),
+        fsdp_plugin=FullyShardedDataParallelPlugin(cpu_offload=True, zero_stage=0),
+        gradient_accumulation_steps=2,
+    )
+    state = acc.create_train_state(params, optax.adamw(1e-2))
+    assert state.grad_accum["w1"].sharding.memory_kind == "pinned_host"
+    step = acc.build_train_step(loss_fn)
+    dbatch = send_to_device(batch, acc.mesh)
+    losses = []
+    for _ in range(4):
+        state, m = step(state, dbatch)
+        losses.append(float(m["loss"]))
+    np.testing.assert_allclose(losses, baseline, rtol=2e-5)
+    assert state.grad_accum["w1"].sharding.memory_kind == "pinned_host"
+
+
+def test_full_state_dict_checkpoint_roundtrip(tmp_path):
+    """state_dict_type=FULL_STATE_DICT saves a consolidated file and restores exactly."""
+    _reset()
+    params, batch, loss_fn = _make_problem()
+    acc = Accelerator(
+        mesh_config=MeshConfig(dp=1, fsdp=8),
+        fsdp_plugin=FullyShardedDataParallelPlugin(
+            zero_stage=3, min_weight_size=1, state_dict_type="FULL_STATE_DICT"
+        ),
+    )
+    state = acc.create_train_state(params, optax.adamw(1e-2))
+    step = acc.build_train_step(loss_fn)
+    dbatch = send_to_device(batch, acc.mesh)
+    state, _ = step(state, dbatch)
+    acc.save_state(str(tmp_path / "ckpt"), train_state=state)
+    assert (tmp_path / "ckpt" / "model_full.pkl").exists(), "consolidated file missing"
+    assert not (tmp_path / "ckpt" / "sharded_state").exists()
+
+    restored = acc.load_state(str(tmp_path / "ckpt"), train_state=state)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        state.params, restored.params,
+    )
+    # Restored arrays keep the live sharding (fsdp-sharded).
+    assert restored.params["w1"].sharding.spec == state.params["w1"].sharding.spec
+
+
+def test_checkpoint_format_switch_no_stale_shadow(tmp_path):
+    """Re-saving the same dir in the other state_dict_type must not leave a stale file that
+    shadows the newer snapshot on load."""
+    _reset()
+    params, batch, loss_fn = _make_problem()
+    acc = Accelerator(
+        mesh_config=MeshConfig(dp=1, fsdp=8),
+        fsdp_plugin=FullyShardedDataParallelPlugin(
+            zero_stage=3, min_weight_size=1, state_dict_type="FULL_STATE_DICT"
+        ),
+    )
+    state = acc.create_train_state(params, optax.adamw(1e-2))
+    step = acc.build_train_step(loss_fn)
+    dbatch = send_to_device(batch, acc.mesh)
+    state, _ = step(state, dbatch)
+    ckpt = str(tmp_path / "ckpt")
+    acc.save_state(ckpt, train_state=state)
+
+    # Advance, switch to SHARDED, save into the same dir.
+    state, _ = step(state, dbatch)
+    acc.state.fsdp_plugin.state_dict_type = "SHARDED_STATE_DICT"
+    acc.save_state(ckpt, train_state=state)
+    assert not (tmp_path / "ckpt" / "model_full.pkl").exists()
+
+    restored = acc.load_state(ckpt, train_state=state)
+    np.testing.assert_array_equal(
+        np.asarray(restored.params["w1"]), np.asarray(state.params["w1"])
+    )
