@@ -32,6 +32,7 @@ def notebook_launcher(
     num_nodes: int = 1,
     max_restarts: int = 0,
     monitor_interval: float = 0.1,
+    devices_per_process: Optional[int] = None,
     **kwargs: Any,
 ) -> None:
     """Launch ``function(*args)`` for (notebook) training.
@@ -39,6 +40,9 @@ def notebook_launcher(
     - TPU backend present → run in-process: the mesh already spans every local chip.
     - ``num_processes > 1`` on CPU → spawn that many processes with a JAX distributed
       rendezvous (faithful multi-host simulation; reference ``launchers.py:40`` spawns GPUs).
+    - ``devices_per_process``: virtual CPU devices per child
+      (``--xla_force_host_platform_device_count``) — N processes × M devices simulates an
+      N-host M-chip pod, the test substrate for true multi-process collectives.
     """
     in_colab_or_kaggle = "KAGGLE_KERNEL_RUN_TYPE" in os.environ or "COLAB_GPU" in os.environ
     _ = in_colab_or_kaggle  # same environments supported; no special-casing needed under JAX
@@ -63,7 +67,11 @@ def notebook_launcher(
     port = use_port or get_free_port()
     coordinator = f"{master_addr}:{port}"
     launcher = PrepareForLaunch(
-        function, num_processes=num_processes, coordinator_address=coordinator, use_cpu=True
+        function,
+        num_processes=num_processes,
+        coordinator_address=coordinator,
+        use_cpu=True,
+        devices_per_process=devices_per_process,
     )
     ctx = multiprocessing.get_context("spawn")
     for attempt in range(max_restarts + 1):

@@ -479,7 +479,7 @@ def _ce_from_hidden(x, params, targets, mask, cfg: LlamaConfig) -> jax.Array:
     S = x.shape[1]
     denom = jnp.maximum(mask.sum(), 1.0)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    chunk = _loss_chunk_size(cfg, S)  # always divides S when nonzero
+    chunk = _loss_chunk_size(cfg, S)  # may exceed/not divide S; _chunked_ce pads
     if chunk > 0:
         return _chunked_ce(x, head, targets, mask, chunk, cfg.dtype) / denom
     logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)

@@ -109,6 +109,14 @@ class PartialState:
         _maybe_init_distributed(init_kwargs)
         if self._cpu:
             os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            # The env var alone is defeated by any sitecustomize that imported jax earlier
+            # with its own jax_platforms (this environment's axon plugin does — round 1's
+            # subprocess hangs). The config update wins as long as no backend has
+            # initialized; if one has, we must not (and cannot) switch it.
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:  # pragma: no cover - backend already up; keep it
+                pass
         self.num_processes = jax.process_count()
         self.process_index = jax.process_index()
         # One JAX process per host ⇒ every process is its node's local-main.

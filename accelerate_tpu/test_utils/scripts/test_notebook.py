@@ -30,5 +30,29 @@ def function_with_args(value: int):
     print(f"child {state.process_index} got value {value}", flush=True)
 
 
+def run_full_self_test():
+    """Child body for the multi-process tier: the ENTIRE bundled self-test suite with
+    ``process_count() > 1`` — collectives take the real cross-process transport
+    (``_allgather_bytes``/``broadcast_object_list``), the dispatcher broadcasts batches,
+    RNG sync crosses ranks, and training parity holds against the 1-process baseline."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from accelerate_tpu import PartialState
+    from accelerate_tpu.test_utils.scripts import test_script
+
+    import os
+
+    PartialState()  # initializes jax.distributed from the launcher's rendezvous env
+    assert jax.process_count() > 1, "multi-process tier ran single-process"
+    per_proc = int(os.environ.get("ACCELERATE_DEVICES_PER_PROCESS", "0"))
+    if per_proc:
+        expected = per_proc * jax.process_count()
+        assert jax.device_count() == expected, (
+            f"pod-sim topology wrong: {jax.device_count()} global devices, expected {expected}"
+        )
+    test_script.main()
+
+
 if __name__ == "__main__":
     basic_function()

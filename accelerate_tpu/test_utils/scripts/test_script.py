@@ -59,32 +59,66 @@ def test_state_and_split():
     return acc
 
 
-def test_rng_sync():
-    from accelerate_tpu.utils import set_seed, synchronize_rng_states
+def test_rng_sync(acc):
+    from accelerate_tpu.utils import gather_object, set_seed, synchronize_rng_states
 
     set_seed(42)
     before = np.random.random(4)
     set_seed(42)
     after = np.random.random(4)
     assert np.array_equal(before, after), "set_seed not reproducible"
-    synchronize_rng_states(["generator"])
+    # Deliberately desync each rank, then broadcast rank 0's state and check convergence
+    # (reference test_script.py:174 rng_sync_check).
+    set_seed(1000 + acc.process_index)
+    synchronize_rng_states(["numpy", "python"])
+    draws = gather_object(np.random.random(4).tolist())
+    assert all(d == draws[0] for d in draws), f"numpy RNG desynced after sync: {draws}"
     print("rng sync: OK")
 
 
 def test_ops(acc):
     import jax.numpy as jnp
 
-    from accelerate_tpu.utils import broadcast, gather, pad_across_processes, reduce, send_to_device
+    from accelerate_tpu.utils import (
+        broadcast,
+        broadcast_object_list,
+        gather,
+        gather_object,
+        pad_across_processes,
+        reduce,
+        send_to_device,
+    )
 
+    n = acc.num_processes
     x = jnp.arange(8, dtype=jnp.float32).reshape(2, 4) + acc.process_index
     g = gather(x)
-    assert g.shape[0] >= x.shape[0]
+    assert g.shape[0] == 2 * n, f"gather shape {g.shape} for {n} processes"
+    if n > 1:
+        # Row block i must carry rank i's +i offset (exercises _allgather_bytes transport).
+        for rank in range(n):
+            block = np.asarray(g[2 * rank : 2 * rank + 2])
+            assert np.allclose(block, np.arange(8, dtype=np.float32).reshape(2, 4) + rank), (
+                f"gather block for rank {rank} wrong"
+            )
     r = reduce(x, reduction="sum")
     assert r.shape[-1] == 4
+    if n > 1:
+        want = np.arange(8, dtype=np.float32).reshape(2, 4) * n + sum(range(n))
+        assert np.allclose(np.asarray(r), want), "cross-process reduce incorrect"
     b = broadcast(x)
-    assert b.shape == x.shape
-    p = pad_across_processes(jnp.ones((2, 3)), dim=1)
-    assert p.shape[1] >= 3
+    # After broadcast every rank holds rank 0's tensor (offset 0).
+    assert np.allclose(np.asarray(b), np.arange(8, dtype=np.float32).reshape(2, 4)), (
+        "broadcast did not propagate rank 0's tensor"
+    )
+    p = pad_across_processes(jnp.ones((2, 3 + acc.process_index)), dim=1)
+    assert p.shape[1] == 3 + (n - 1), "pad_across_processes wrong target length"
+    # Object (pickle) collectives over the distributed KV store / allgather transport.
+    objs = gather_object({"rank": acc.process_index, "payload": [acc.process_index] * 2})
+    assert [o["rank"] for o in objs] == list(range(n)), objs
+    blist = broadcast_object_list(
+        ["from-rank-0", acc.process_index] if acc.is_main_process else [None, None]
+    )
+    assert blist[0] == "from-rank-0" and blist[1] == 0, blist
     batch = send_to_device({"x": np.ones((4, 2), np.float32)}, acc.device)
     assert batch["x"].shape == (4, 2)
     print("collective ops: OK")
@@ -100,21 +134,23 @@ def test_dataloader_sharding(acc):
         def __getitem__(self, i):
             return {"idx": np.int32(i)}
 
+    from accelerate_tpu.utils import gather_object
+
     dl = DataLoader(Dataset(), batch_size=4)
     prepared = prepare_data_loader(dl, device=acc.device, put_on_device=False)
     seen = []
     for batch in prepared:
         seen.extend(np.asarray(batch["idx"]).reshape(-1).tolist())
-    # Single process: every sample exactly once. Multi-process: the union across ranks
-    # covers the dataset (verified per-rank by cardinality here).
-    if acc.num_processes == 1:
-        assert sorted(seen) == list(range(30)), f"shard mode lost samples: {sorted(seen)[:10]}"
+    # Every sample must be seen across the union of ranks (each rank may also carry
+    # even_batches padding duplicates at the tail).
+    union = sorted(set(i for rank in gather_object(seen) for i in rank))
+    assert union == list(range(30)), f"shard mode lost samples: {union[:10]}"
     dispatched = prepare_data_loader(dl, device=acc.device, dispatch_batches=True, put_on_device=False)
     seen_d = []
     for batch in dispatched:
         seen_d.extend(np.asarray(batch["idx"]).reshape(-1).tolist())
-    if acc.num_processes == 1:
-        assert sorted(seen_d) == list(range(30)), "dispatch mode lost samples"
+    union_d = sorted(set(i for rank in gather_object(seen_d) for i in rank))
+    assert union_d == list(range(30)), "dispatch mode lost samples"
     print("dataloader shard + dispatch: OK")
 
 
@@ -203,7 +239,7 @@ def main():
     from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
 
     acc = test_state_and_split()
-    test_rng_sync()
+    test_rng_sync(acc)
     test_ops(acc)
     test_dataloader_sharding(acc)
     test_seedable_sampler()

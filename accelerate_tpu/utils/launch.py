@@ -129,18 +129,32 @@ class PrepareForLaunch:
         coordinator_address: str,
         use_cpu: bool = True,
         debug: bool = False,
+        devices_per_process: int | None = None,
     ):
         self.launcher = launcher
         self.num_processes = num_processes
         self.coordinator_address = coordinator_address
         self.use_cpu = use_cpu
         self.debug = debug
+        self.devices_per_process = devices_per_process
 
     def __call__(self, index: int, *args):
         os.environ[f"{ENV_PREFIX}COORDINATOR_ADDRESS"] = self.coordinator_address
         os.environ[f"{ENV_PREFIX}NUM_PROCESSES"] = str(self.num_processes)
         os.environ[f"{ENV_PREFIX}PROCESS_ID"] = str(index)
         os.environ["FORK_LAUNCHED"] = "true"
+        if self.devices_per_process:
+            import re
+
+            # Override (not skip) any inherited count — e.g. the pytest parent's 8-device
+            # conftest flag — so an explicit per-child topology always wins.
+            flags = os.environ.get("XLA_FLAGS", "")
+            flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "", flags).strip()
+            os.environ["XLA_FLAGS"] = (
+                f"{flags} --xla_force_host_platform_device_count="
+                f"{self.devices_per_process}"
+            ).strip()
+            os.environ["ACCELERATE_DEVICES_PER_PROCESS"] = str(self.devices_per_process)
         if self.use_cpu:
             os.environ[f"{ENV_PREFIX}USE_CPU"] = "true"
             os.environ["JAX_PLATFORMS"] = "cpu"

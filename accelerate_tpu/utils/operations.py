@@ -257,9 +257,12 @@ def gather(tensor):
     """
 
     def _gather(x):
-        if isinstance(x, jax.Array):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            # Global sharded array: every rank's rows are already in it.
             return _assemble_global(x)
         if _process_count() > 1:
+            # Host-local value (numpy or a process-local jax.Array): true cross-process
+            # all-gather, concatenating along dim 0.
             from jax.experimental import multihost_utils
 
             return np.asarray(multihost_utils.process_allgather(np.asarray(x), tiled=True))
