@@ -97,6 +97,7 @@ class TrainState:
     grad_accum: Any = None
     rng: Any = None
     micro: jax.Array = None  # micro-steps since last apply (unique RNG per micro-batch)
+    fp8_state: Any = None    # DelayedScalingState when the fp8 recipe uses delayed scaling
 
     def replace(self, **kwargs) -> "TrainState":
         import dataclasses
@@ -317,15 +318,12 @@ class Accelerator:
             self.fp8_recipe = FP8RecipeKwargs()
         if self.fp8_recipe is not None:
             # Install the recipe as the process default consulted by ops.fp8.fp8_dot.
+            # Delayed scaling is wired automatically: create_train_state seeds a
+            # DelayedScalingState into TrainState.fp8_state and build_train_step threads it
+            # through every fp8_dot via ops.fp8.autoscale_ctx.
             from .ops.fp8 import set_default_recipe
 
             set_default_recipe(self.fp8_recipe.fp8_format, self.fp8_recipe.margin)
-            if self.fp8_recipe.use_delayed_scaling:
-                logger.warning(
-                    "FP8RecipeKwargs.use_delayed_scaling: delayed scaling is stateful — thread "
-                    "a DelayedScalingState through your step and pass delayed_scales(state) to "
-                    "fp8_dot; the flag alone does not enable it."
-                )
 
         self.state = AcceleratorState(
             **({"distributed_init_kwargs": distributed_init_kwargs} if distributed_init_kwargs else {}),
@@ -357,6 +355,8 @@ class Accelerator:
         )
         self.rng_types = rng_types or ["generator"]
         self.step_scheduler_with_optimizer = step_scheduler_with_optimizer
+        if log_with is None and os.environ.get("ACCELERATE_LOG_WITH"):
+            log_with = os.environ["ACCELERATE_LOG_WITH"]
         self.log_with = log_with
         self.trackers: list = []
 
@@ -437,12 +437,15 @@ class Accelerator:
 
     @property
     def num_microbatches(self) -> int:
-        """Pipeline microbatch count: plugin value, else n_stages (minimum full pipe)."""
+        """Pipeline microbatch count: plugin value > launcher env > n_stages (min full pipe)."""
         from .utils.constants import PIPELINE_AXIS
 
         plugin = self.state.pp_plugin
         if plugin is not None and plugin.num_microbatches is not None:
             return plugin.num_microbatches
+        env_mb = os.environ.get("ACCELERATE_PP_MICROBATCHES")
+        if env_mb:
+            return int(env_mb)
         return self.mesh.shape[PIPELINE_AXIS]
 
     @property
@@ -687,6 +690,12 @@ class Accelerator:
                 self._accum_host_shardings, self._accum_device_shardings = _kinds(accum)
                 accum = jax.device_put(accum, self._accum_host_shardings)
 
+        fp8_state = None
+        if self.fp8_recipe is not None and self.fp8_recipe.use_delayed_scaling:
+            from .ops.fp8 import DelayedScalingState
+
+            fp8_state = DelayedScalingState.init(self.fp8_recipe.amax_history_len)
+
         optimizer._opt_state_ref = opt_state
         return TrainState(
             params=params,
@@ -695,6 +704,7 @@ class Accelerator:
             grad_accum=accum,
             rng=rng,
             micro=jnp.zeros((), dtype=jnp.int32),
+            fp8_state=fp8_state,
         )
 
     def build_train_step(
@@ -747,7 +757,38 @@ class Accelerator:
                 loss, aux = out if has_aux else (out, None)
                 return jnp.asarray(loss, dtype=jnp.float32), aux
 
-            (loss, aux), grads = jax.value_and_grad(wrapped, has_aux=True)(state.params)
+            if state.fp8_state is not None:
+                # Delayed-scaling fp8: thread the rolling-history scales into every fp8_dot.
+                # Forward x/w amaxes are observed exactly (global-per-role granularity vs
+                # TE's per-module buffers); the GRAD role stays on current scaling — the
+                # output cotangent g is quantized inside the custom_vjp, so no faithfully
+                # observed g-amax exists at this level, and any proxy (e.g. the dw amax,
+                # ~10^3× larger) would underflow small cotangents to zero in e5m2.
+                from .ops.fp8 import autoscale_ctx, delayed_scales
+
+                recipe = self.fp8_recipe
+                scales = delayed_scales(
+                    state.fp8_state, recipe.fp8_format, recipe.margin,
+                    recipe.amax_compute_algo,
+                ).at[2].set(jnp.nan)  # NaN → fp8_dot falls back to current scaling for g
+
+                def wrapped_fp8(params):
+                    # The ctx must open INSIDE the differentiated function: its collected
+                    # amaxes are inner-trace values and must leave as aux outputs, not by
+                    # escaping through the context dict (tracer leak).
+                    with autoscale_ctx(scales) as ctx:
+                        loss, aux = wrapped(params)
+                        return loss, (aux, ctx["amax"])
+
+                (loss, (aux, fwd_amax)), grads = jax.value_and_grad(
+                    wrapped_fp8, has_aux=True
+                )(state.params)
+                new_fp8 = state.fp8_state.update(
+                    fwd_amax[0], fwd_amax[1], jnp.zeros((), jnp.float32)
+                )
+            else:
+                (loss, aux), grads = jax.value_and_grad(wrapped, has_aux=True)(state.params)
+                new_fp8 = None
             if self._zero_grad_specs is not None:
                 # ZeRO-2: constrain grads onto the fsdp axis — GSPMD lowers the data-axis
                 # all-reduce into a reduce-scatter and keeps grads partitioned.
@@ -756,10 +797,10 @@ class Accelerator:
                 grads = jax.tree_util.tree_map(
                     lambda g, s: maybe_shard(g, s), grads, self._zero_grad_specs
                 )
-            return loss, aux, grads
+            return loss, aux, grads, new_fp8
 
         def micro_step(state: TrainState, batch):
-            loss, aux, grads = compute(state, batch)
+            loss, aux, grads, new_fp8 = compute(state, batch)
             if state.grad_accum is None:
                 # First no_sync() use with accumulation disabled: adopt grads as the buffer
                 # (structure change → one retrace, then stable).
@@ -770,10 +811,15 @@ class Accelerator:
             if has_aux:
                 metrics["aux"] = aux
             micro = (state.micro if state.micro is not None else 0) + 1
-            return state.replace(grad_accum=accum, micro=jnp.asarray(micro, jnp.int32)), metrics
+            return (
+                state.replace(
+                    grad_accum=accum, micro=jnp.asarray(micro, jnp.int32), fp8_state=new_fp8
+                ),
+                metrics,
+            )
 
         def apply_step(state: TrainState, batch):
-            loss, aux, grads = compute(state, batch)
+            loss, aux, grads, new_fp8 = compute(state, batch)
             if state.grad_accum is not None:
                 grads = jax.tree_util.tree_map(jnp.add, state.grad_accum, grads)
             if accum_steps > 1:
@@ -820,6 +866,7 @@ class Accelerator:
                     step=state.step + 1,
                     grad_accum=new_accum,
                     micro=jnp.zeros((), jnp.int32) if state.micro is not None else None,
+                    fp8_state=new_fp8,
                 ),
                 metrics,
             )
@@ -1000,6 +1047,19 @@ class Accelerator:
         raise NotImplementedError("Use clip_grad_norm_; value clipping is not yet implemented.")
 
     # ---------------------------------------------------------------------- metrics / ops
+    def set_trigger(self):
+        """Arm the cross-process breakpoint flag (reference ``accelerator.py:2569``):
+        any process may set it; ``check_trigger`` fires on ALL processes."""
+        self.flag_tensor = 1
+
+    def check_trigger(self) -> bool:
+        """True on every process if any process called ``set_trigger`` since the last check
+        (reference ``:2583``) — the synchronized early-stopping primitive."""
+        local = np.asarray([self.flag_tensor or 0], dtype=np.float32)
+        fired = float(np.asarray(reduce(local, reduction="sum")).reshape(-1)[0]) > 0
+        self.flag_tensor = None
+        return fired
+
     def gather(self, tensor):
         return gather(tensor)
 

@@ -67,8 +67,30 @@ class ClusterConfig:
     ep: int = 1
     # FSDP/ZeRO.
     fsdp_zero_stage: int = 0
+    fsdp_cpu_offload: bool = False
+    fsdp_min_weight_size: int = 1024
+    fsdp_state_dict_type: str = "SHARDED_STATE_DICT"
+    # Sequence parallelism flavor (ring attention / Ulysses all-to-all / allgather).
+    sp_mode: str = "ring"
+    # Pipeline microbatching.
+    pp_num_microbatches: Optional[int] = None
+    # fp8 recipe (when mixed_precision == fp8).
+    fp8_format: str = "HYBRID"
+    fp8_margin: int = 0
+    fp8_amax_history_len: int = 16
+    fp8_use_delayed_scaling: bool = False
     # Gradient accumulation.
     gradient_accumulation_steps: int = 1
+    # Dataloader behavior.
+    dispatch_batches: Optional[bool] = None
+    even_batches: bool = True
+    use_seedable_sampler: bool = True
+    # Checkpointing / tracking defaults.
+    project_dir: Optional[str] = None
+    checkpoint_total_limit: Optional[int] = None
+    log_with: Optional[str] = None
+    # CPU simulator.
+    num_virtual_devices: Optional[int] = None
     # Pod fan-out (tpu-config / multi-host launch).
     tpu_name: Optional[str] = None
     tpu_zone: Optional[str] = None
@@ -111,36 +133,106 @@ def load_config_from_file(path: Optional[str] = None) -> ClusterConfig:
     return ClusterConfig(**{k: v for k, v in (data or {}).items() if k in known})
 
 
-def _ask(prompt: str, default, cast=str):
-    raw = input(f"{prompt} [{default}]: ").strip()  # noqa: S322 - interactive CLI
-    if not raw:
-        return default
-    if cast is bool:
-        return raw.lower() in ("1", "true", "yes", "y")
-    return cast(raw)
-
-
 def _interactive_config() -> ClusterConfig:
-    """Compact prompt tree (reference ``commands/config/cluster.py`` questionnaire)."""
+    """Per-mode prompt tree (reference ``commands/config/cluster.py``'s 856-line
+    questionnaire + ``commands/menu/`` TUI, compressed to the knobs this runtime has).
+
+    Every multi-choice question is a cursor menu on a TTY (numbered prompt on pipes);
+    numeric/boolean questions are free-form with defaults. Sub-trees only open when the
+    parent answer makes them relevant — the reference's questionnaire structure.
+    """
+    from .menu import ask, ask_bool, ask_int, select
+
     cfg = ClusterConfig()
-    cfg.compute_environment = _ask("Compute environment (LOCAL_MACHINE/TPU_POD)", "LOCAL_MACHINE")
-    cfg.num_machines = _ask("How many machines (TPU hosts)?", 1, int)
+
+    # ---- compute environment -------------------------------------------------
+    cfg.compute_environment = select(
+        "In which environment are you running?",
+        ["LOCAL_MACHINE", "TPU_POD", "CPU_SIMULATOR"],
+    )
+    if cfg.compute_environment == "CPU_SIMULATOR":
+        cfg.use_cpu = True
+        cfg.num_virtual_devices = ask_int("How many virtual devices?", 8)
+    if cfg.compute_environment == "TPU_POD":
+        cfg.tpu_name = ask("TPU pod name (gcloud)", None) or None
+        cfg.tpu_zone = ask("TPU zone", None) or None
+        cfg.num_machines = ask_int("How many hosts (TPU VMs) in the pod?", 1)
+    else:
+        cfg.num_machines = ask_int("How many machines (TPU hosts)?", 1)
     if cfg.num_machines > 1:
-        cfg.machine_rank = _ask("Rank of this machine", 0, int)
-        cfg.main_process_ip = _ask("Coordinator (rank-0) IP", "127.0.0.1")
-        cfg.main_process_port = _ask("Coordinator port", 29500, int)
-    cfg.num_processes = _ask("Total host processes", cfg.num_machines, int)
-    cfg.mixed_precision = _ask("Mixed precision (no/bf16/fp16/fp8)", "bf16")
-    cfg.fsdp_zero_stage = _ask("ZeRO/FSDP stage (0=off, 1/2/3)", 0, int)
-    if cfg.fsdp_zero_stage > 0:
-        cfg.fsdp = _ask("fsdp axis size (-1 = all devices)", -1, int)
-        cfg.dp = 1
-    cfg.tp = _ask("Tensor-parallel degree", 1, int)
-    cfg.sp = _ask("Sequence-parallel degree", 1, int)
-    cfg.pp = _ask("Pipeline-parallel degree", 1, int)
-    cfg.gradient_accumulation_steps = _ask("Gradient accumulation steps", 1, int)
-    if cfg.num_machines > 1:
+        cfg.machine_rank = ask_int("Rank of this machine", 0)
+        cfg.main_process_ip = ask("Coordinator (rank-0 internal) IP", "127.0.0.1")
+        cfg.main_process_port = ask_int("Coordinator port", 29500)
         cfg.distributed_type = "MULTI_HOST"
+    cfg.num_processes = ask_int("Total host processes (one per host)", cfg.num_machines)
+
+    # ---- precision -----------------------------------------------------------
+    cfg.mixed_precision = select(
+        "Mixed precision?", ["bf16", "no", "fp16", "fp8"], default=0
+    )
+    if cfg.mixed_precision == "fp8":
+        cfg.fp8_format = select("fp8 format?", ["HYBRID", "E4M3"])
+        cfg.fp8_margin = ask_int("fp8 scale margin (powers of 2 backed off)", 0)
+        cfg.fp8_use_delayed_scaling = ask_bool("Use delayed (history-based) scaling?", False)
+        if cfg.fp8_use_delayed_scaling:
+            cfg.fp8_amax_history_len = ask_int("fp8 amax history length", 16)
+
+    # ---- ZeRO / FSDP ----------------------------------------------------------
+    stage = select(
+        "ZeRO/FSDP sharding stage?",
+        [
+            "0 — replicated params (plain data parallel)",
+            "1 — shard optimizer state",
+            "2 — + reduce-scatter gradients",
+            "3 — + shard parameters (FSDP FULL_SHARD)",
+        ],
+    )
+    cfg.fsdp_zero_stage = int(stage.split(" ")[0])
+    if cfg.fsdp_zero_stage > 0:
+        cfg.fsdp = ask_int("fsdp axis size (-1 = all remaining devices)", -1)
+        cfg.dp = 1
+        cfg.fsdp_cpu_offload = ask_bool(
+            "Offload optimizer state to host RAM (ZeRO-Offload)?", False
+        )
+        cfg.fsdp_min_weight_size = ask_int(
+            "Min parameter size to shard (smaller stay replicated)", 1024
+        )
+        cfg.fsdp_state_dict_type = select(
+            "Checkpoint layout?", ["SHARDED_STATE_DICT", "FULL_STATE_DICT"]
+        )
+
+    # ---- model parallelism ----------------------------------------------------
+    cfg.tp = ask_int("Tensor-parallel degree", 1)
+    cfg.sp = ask_int("Sequence/context-parallel degree (long-context)", 1)
+    if cfg.sp > 1:
+        cfg.sp_mode = select(
+            "Sequence-parallel mode?",
+            ["ring", "ulysses", "allgather"],
+        )
+    cfg.pp = ask_int("Pipeline-parallel degree", 1)
+    if cfg.pp > 1:
+        mb = ask_int("Pipeline microbatches (0 = one per stage)", 0)
+        cfg.pp_num_microbatches = mb or None
+    cfg.ep = ask_int("Expert-parallel degree (MoE)", 1)
+
+    # ---- training loop --------------------------------------------------------
+    cfg.gradient_accumulation_steps = ask_int("Gradient accumulation steps", 1)
+    if ask_bool("Configure dataloader behavior?", False):
+        cfg.dispatch_batches = ask_bool(
+            "Dispatch batches from the main process (IterableDataset mode)?", False
+        )
+        cfg.even_batches = ask_bool("Pad uneven final batches (even_batches)?", True)
+        cfg.use_seedable_sampler = ask_bool("Use the seedable sampler?", True)
+    if ask_bool("Configure checkpointing/tracking defaults?", False):
+        cfg.project_dir = ask("Project directory (checkpoints/logs)", None) or None
+        limit = ask_int("Max checkpoints to keep (0 = unlimited)", 0)
+        cfg.checkpoint_total_limit = limit or None
+        tracker = select(
+            "Experiment tracker?",
+            ["none", "tensorboard", "wandb", "mlflow", "jsonl"],
+        )
+        cfg.log_with = None if tracker == "none" else tracker
+    cfg.debug = ask_bool("Enable collective debug (shape verification)?", False)
     return cfg
 
 

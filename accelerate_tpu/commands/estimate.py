@@ -61,7 +61,12 @@ def _hub_model_sizes(name: str):
     except ImportError:
         return None
     try:
-        config = AutoConfig.from_pretrained(name, trust_remote_code=False)
+        from ..utils.environment import patch_environment
+
+        # Bound hub latency: default HF timeouts retry for ~25 s in egress-less
+        # environments before failing; an estimate CLI should fail fast instead.
+        with patch_environment(HF_HUB_DOWNLOAD_TIMEOUT="3", HF_HUB_ETAG_TIMEOUT="3"):
+            config = AutoConfig.from_pretrained(name, trust_remote_code=False)
     except Exception:
         return None
     # Analytic decoder-LM parameter count from common config fields.

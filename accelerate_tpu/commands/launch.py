@@ -70,6 +70,15 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
         mesh.add_argument(f"--{axis}", type=int, default=None, help=f"{doc}-parallel degree.")
     mesh.add_argument("--use-fsdp", "--use_fsdp", action="store_true")
     mesh.add_argument("--fsdp-zero-stage", "--fsdp_zero_stage", type=int, default=None)
+    mesh.add_argument("--fsdp-cpu-offload", "--fsdp_cpu_offload", action="store_true",
+                      default=None, help="ZeRO-Offload: optimizer state in host RAM.")
+    mesh.add_argument("--fsdp-state-dict-type", "--fsdp_state_dict_type", default=None,
+                      choices=[None, "SHARDED_STATE_DICT", "FULL_STATE_DICT"])
+    mesh.add_argument("--fsdp-min-weight-size", "--fsdp_min_weight_size", type=int, default=None)
+    mesh.add_argument("--sp-mode", "--sp_mode", default=None,
+                      choices=[None, "ring", "ulysses", "allgather"])
+    mesh.add_argument("--fp8-format", "--fp8_format", default=None,
+                      choices=[None, "HYBRID", "E4M3"])
 
     train = parser.add_argument_group("Training")
     train.add_argument("--mixed-precision", "--mixed_precision", default=None,
@@ -116,6 +125,28 @@ def _apply_config_defaults(args) -> None:
         if cfg.gradient_accumulation_steps != 1
         else None,
         "fsdp_zero_stage": cfg.fsdp_zero_stage or None,
+        "fsdp_cpu_offload": cfg.fsdp_cpu_offload or None,
+        "fsdp_state_dict_type": (
+            cfg.fsdp_state_dict_type if cfg.fsdp_state_dict_type != "SHARDED_STATE_DICT" else None
+        ),
+        "fsdp_min_weight_size": (
+            cfg.fsdp_min_weight_size if cfg.fsdp_min_weight_size != 1024 else None
+        ),
+        "sp_mode": cfg.sp_mode if cfg.sp_mode != "ring" else None,
+        "fp8_format": cfg.fp8_format if cfg.fp8_format != "HYBRID" else None,
+        "fp8_margin": cfg.fp8_margin or None,
+        "fp8_amax_history_len": cfg.fp8_amax_history_len if cfg.fp8_amax_history_len != 16 else None,
+        "fp8_use_delayed_scaling": cfg.fp8_use_delayed_scaling or None,
+        "pp_num_microbatches": cfg.pp_num_microbatches,
+        "dispatch_batches": cfg.dispatch_batches,
+        "even_batches": cfg.even_batches if cfg.even_batches is not True else None,
+        "use_seedable_sampler": (
+            cfg.use_seedable_sampler if cfg.use_seedable_sampler is not True else None
+        ),
+        "project_dir": cfg.project_dir,
+        "checkpoint_total_limit": cfg.checkpoint_total_limit,
+        "log_with": cfg.log_with,
+        "num_virtual_devices": cfg.num_virtual_devices,
         "dp": cfg.dp if cfg.dp != -1 else None,
         "fsdp": cfg.fsdp if cfg.fsdp != 1 else None,
         "tp": cfg.tp if cfg.tp != 1 else None,
@@ -155,29 +186,43 @@ def simple_launcher(args) -> int:
 
 
 def multi_process_launcher(args) -> int:
-    """Spawn N local processes with a shared JAX coordinator (multi-host semantics)."""
+    """Spawn N local processes with a shared JAX coordinator (multi-host semantics).
+
+    Elastic supervision via ``ElasticSupervisor``: any worker death tears the gang down and
+    relaunches it on a FRESH coordinator (JAX rendezvous cannot re-admit single workers),
+    up to ``--max-restarts`` times (the torchrun-elastic analog).
+    """
+    from ..elastic import ElasticSupervisor, WorkerFailure
+
     num = int(args.num_processes or 1)
     cmd, _ = prepare_simple_launcher_cmd_env(args)
-    plans = []
-    for pid in range(num):
-        env = prepare_multi_process_env(args, process_id=pid, num_processes=num)
-        plans.append((cmd, {k: v for k, v in env.items() if k.startswith(("ACCELERATE_", "XLA_", "JAX_"))}))
-    if args.dry_run:
-        _print_plan(plans)
-        return 0
-    attempts = args.max_restarts + 1
-    for attempt in range(attempts):
-        procs = []
+
+    def make_plan(coordinator: str):
+        plans = []
         for pid in range(num):
             env = prepare_multi_process_env(args, process_id=pid, num_processes=num)
-            procs.append(subprocess.Popen(cmd, env=env))
-        codes = [p.wait() for p in procs]
-        if all(c == 0 for c in codes):
-            return 0
-        if attempt < attempts - 1:
-            print(f"[accelerate-tpu] exit codes {codes}; restart {attempt + 1}/{args.max_restarts}")
-            time.sleep(1.0)
-    raise subprocess.CalledProcessError(returncode=_first_failure(codes), cmd=cmd)
+            env["ACCELERATE_COORDINATOR_ADDRESS"] = coordinator
+            plans.append((cmd, env))
+        return plans
+
+    if args.dry_run:
+        _print_plan([
+            (c, {k: v for k, v in e.items() if k.startswith(("ACCELERATE_", "XLA_", "JAX_"))})
+            for c, e in make_plan(
+                f"{args.main_process_ip or '127.0.0.1'}:{args.main_process_port or 29500}"
+            )
+        ])
+        return 0
+    supervisor = ElasticSupervisor(
+        make_plan,
+        max_restarts=args.max_restarts,
+        coordinator_host=args.main_process_ip or "127.0.0.1",
+        coordinator_port=args.main_process_port,
+    )
+    try:
+        return supervisor.run()
+    except WorkerFailure as e:
+        raise subprocess.CalledProcessError(returncode=_first_failure(e.exit_codes), cmd=cmd)
 
 
 def tpu_pod_launcher(args) -> int:
@@ -186,6 +231,13 @@ def tpu_pod_launcher(args) -> int:
     Reference analog: ``tpu_pod_launcher`` (``commands/launch.py:909``) driving
     ``gcloud compute tpus tpu-vm ssh --worker=all``. We build the same fan-out; ``--dry-run``
     prints it (CI has no gcloud).
+
+    **Preemption story**: pod workers are supervised by ``ElasticSupervisor`` — when a
+    worker's ssh session dies (host preempted, script crashed, network cut), the whole gang
+    is torn down and re-fanned-out with a fresh coordinator port, up to ``--max-restarts``
+    times. The relaunched run resumes from the newest checkpoint
+    (``Accelerator.load_state()`` with no argument loads the latest; pair with
+    ``skip_first_batches`` for mid-epoch resume).
     """
     if not args.tpu_name:
         raise ValueError("--tpu-pod requires --tpu-name (and usually --tpu-zone).")
@@ -208,34 +260,57 @@ def tpu_pod_launcher(args) -> int:
         inner_flags += ["--fsdp-zero-stage", str(args.fsdp_zero_stage)]
     if getattr(args, "use_fsdp", False):
         inner_flags += ["--use-fsdp"]
+    if getattr(args, "fsdp_cpu_offload", None):
+        inner_flags += ["--fsdp-cpu-offload"]
+    if getattr(args, "fsdp_state_dict_type", None):
+        inner_flags += ["--fsdp-state-dict-type", str(args.fsdp_state_dict_type)]
+    if getattr(args, "fsdp_min_weight_size", None):
+        inner_flags += ["--fsdp-min-weight-size", str(args.fsdp_min_weight_size)]
+    if getattr(args, "sp_mode", None):
+        inner_flags += ["--sp-mode", str(args.sp_mode)]
+    if getattr(args, "fp8_format", None):
+        inner_flags += ["--fp8-format", str(args.fp8_format)]
     if getattr(args, "debug", False):
         inner_flags += ["--debug"]
     if getattr(args, "cpu", False):
         inner_flags += ["--cpu"]
-    plans = []
-    for rank in range(num_hosts):
-        inner = (
-            f"ACCELERATE_COORDINATOR_ADDRESS={args.main_process_ip or '127.0.0.1'}:"
-            f"{args.main_process_port or 29500} "
-            f"ACCELERATE_NUM_PROCESSES={num_hosts} ACCELERATE_PROCESS_ID={rank} "
-            f"accelerate-tpu launch {' '.join(inner_flags)} {args.training_script} "
-            + " ".join(args.training_script_args or [])
-        )
-        cmd = [
-            "gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu_name,
-            f"--worker={rank}",
-            *(["--zone", args.tpu_zone] if args.tpu_zone else []),
-            "--command", inner.strip(),
-        ]
-        plans.append((cmd, {}))
+    def make_plan(coordinator: str):
+        plans = []
+        for rank in range(num_hosts):
+            inner = (
+                f"ACCELERATE_COORDINATOR_ADDRESS={coordinator} "
+                f"ACCELERATE_NUM_PROCESSES={num_hosts} ACCELERATE_PROCESS_ID={rank} "
+                f"accelerate-tpu launch {' '.join(inner_flags)} {args.training_script} "
+                + " ".join(args.training_script_args or [])
+            )
+            cmd = [
+                "gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu_name,
+                f"--worker={rank}",
+                *(["--zone", args.tpu_zone] if args.tpu_zone else []),
+                "--command", inner.strip(),
+            ]
+            plans.append((cmd, None))  # None env: inherit (gcloud auth lives there)
+        return plans
+
+    coordinator_host = args.main_process_ip or "127.0.0.1"
     if args.dry_run:
-        _print_plan(plans)
+        _print_plan(make_plan(f"{coordinator_host}:{args.main_process_port or 29500}"))
         return 0
-    procs = [subprocess.Popen(cmd) for cmd, _ in plans]
-    codes = [p.wait() for p in procs]
-    if any(codes):
-        raise subprocess.CalledProcessError(returncode=_first_failure(codes), cmd=plans[0][0])
-    return 0
+    from ..elastic import ElasticSupervisor, WorkerFailure
+
+    supervisor = ElasticSupervisor(
+        make_plan,
+        max_restarts=args.max_restarts,
+        monitor_interval=1.0,
+        coordinator_host=coordinator_host,
+        coordinator_port=args.main_process_port,
+    )
+    try:
+        return supervisor.run()
+    except WorkerFailure as e:
+        raise subprocess.CalledProcessError(
+            returncode=_first_failure(e.exit_codes), cmd=make_plan("unreached")[0][0]
+        )
 
 
 def _first_failure(codes: list[int]) -> int:
@@ -246,7 +321,7 @@ def _first_failure(codes: list[int]) -> int:
 def _print_plan(plans) -> None:
     for i, (cmd, env) in enumerate(plans):
         print(f"--- process {i} ---")
-        for k in sorted(env):
+        for k in sorted(env or {}):
             print(f"  {k}={env[k]}")
         print("  " + " ".join(map(str, cmd)))
 

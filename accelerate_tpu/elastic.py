@@ -1,0 +1,129 @@
+"""Elastic process-group supervision — the torchrun-elastic-agent analog (L9).
+
+The reference delegates failure recovery to ``torch.distributed.run``'s elastic agent
+(``/root/reference/src/accelerate/commands/launch.py:785-816``: rdzv backend, max_restarts,
+monitor_interval) — restart machinery this framework must own (SURVEY.md §5 "failure
+detection / elastic recovery", §7 hard parts: "restart on preemption — TPU preemptions are
+routine").
+
+**Why whole-group restarts**: a JAX distributed rendezvous is formed once — the coordinator
+does not re-admit a replacement process into a live process group the way torchrun's
+c10d rendezvous can. The correct (and, on TPU pods, standard) elastic semantics are
+therefore *gang* semantics: detect any worker death (crash, preemption SIGKILL, non-zero
+exit), tear down the survivors, pick a fresh coordinator port, and relaunch the whole
+group, up to ``max_restarts`` times. Training resumes from the last checkpoint via
+``Accelerator.load_state`` + ``skip_first_batches`` (the checkpoint/resume contract, §5).
+
+The supervisor is transport-agnostic: workers are arbitrary subprocess command plans, so
+the same loop supervises local multi-process launches and ``gcloud ... ssh`` pod fan-outs
+(``commands/launch.py``).
+"""
+
+from __future__ import annotations
+
+import subprocess
+import time
+from typing import Callable, Optional, Sequence
+
+from .logging import get_logger
+from .utils.other import get_free_port
+
+logger = get_logger(__name__)
+
+__all__ = ["ElasticSupervisor", "WorkerFailure"]
+
+
+class WorkerFailure(RuntimeError):
+    """Raised when the group exhausted its restart budget."""
+
+    def __init__(self, message: str, exit_codes: Sequence[Optional[int]]):
+        super().__init__(message)
+        self.exit_codes = list(exit_codes)
+
+
+class ElasticSupervisor:
+    """Supervise a gang of worker processes with restart-on-failure.
+
+    ``make_plan(coordinator_address) -> list[(cmd, env)]`` builds the per-worker launch
+    plans for one attempt; it is called again with a FRESH coordinator (new port) on every
+    restart so stale rendezvous state can never poison the new group.
+
+    - Health: liveness polling every ``monitor_interval`` seconds. A worker that exits
+      non-zero or dies from a signal (preemption shows up as SIGKILL, returncode < 0)
+      triggers a group teardown + restart.
+    - ``grace_period``: SIGTERM the survivors, escalate to SIGKILL after this many seconds.
+    - ``on_restart(attempt, codes)``: hook for logging/metrics (tested for invocation).
+    """
+
+    def __init__(
+        self,
+        make_plan: Callable[[str], list[tuple[list[str], Optional[dict]]]],
+        max_restarts: int = 0,
+        monitor_interval: float = 0.2,
+        grace_period: float = 5.0,
+        coordinator_host: str = "127.0.0.1",
+        coordinator_port: Optional[int] = None,
+        on_restart: Optional[Callable[[int, list], None]] = None,
+    ):
+        self.make_plan = make_plan
+        self.max_restarts = max_restarts
+        self.monitor_interval = monitor_interval
+        self.grace_period = grace_period
+        self.coordinator_host = coordinator_host
+        self.coordinator_port = coordinator_port
+        self.on_restart = on_restart
+        self.attempts_used = 0
+
+    def _coordinator(self) -> str:
+        port = self.coordinator_port or get_free_port()
+        self.coordinator_port = None  # fresh port on every subsequent attempt
+        return f"{self.coordinator_host}:{port}"
+
+    def _spawn(self, plans) -> list[subprocess.Popen]:
+        procs = []
+        for cmd, env in plans:
+            procs.append(subprocess.Popen(cmd, env=env))
+        return procs
+
+    def _teardown(self, procs: list[subprocess.Popen]) -> None:
+        for p in procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.monotonic() + self.grace_period
+        for p in procs:
+            while p.poll() is None and time.monotonic() < deadline:
+                time.sleep(0.05)
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+
+    def run(self) -> int:
+        """Run the gang to completion. Returns 0, or raises ``WorkerFailure``."""
+        codes: list[Optional[int]] = []
+        for attempt in range(self.max_restarts + 1):
+            self.attempts_used = attempt + 1
+            coordinator = self._coordinator()
+            procs = self._spawn(self.make_plan(coordinator))
+            failed = False
+            while True:
+                codes = [p.poll() for p in procs]
+                if any(c is not None and c != 0 for c in codes):
+                    failed = True
+                    break
+                if all(c == 0 for c in codes):
+                    return 0
+                time.sleep(self.monitor_interval)
+            # A worker died (crash or preemption): gang teardown, then maybe restart.
+            self._teardown(procs)
+            codes = [p.returncode for p in procs]
+            logger.warning(
+                f"worker group failed with exit codes {codes} "
+                f"(attempt {attempt + 1}/{self.max_restarts + 1})"
+            )
+            if self.on_restart is not None and attempt < self.max_restarts:
+                self.on_restart(attempt, codes)
+        raise WorkerFailure(
+            f"worker group failed after {self.max_restarts + 1} attempts "
+            f"(last exit codes {codes})",
+            codes,
+        )

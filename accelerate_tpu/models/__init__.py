@@ -1,3 +1,3 @@
-"""Model families shipped with the framework (flagship: llama; plus bert, gpt2, simple)."""
+"""Model families shipped with the framework (flagship: llama; plus bert, resnet, simple)."""
 
-from . import bert, llama, simple
+from . import bert, llama, resnet, simple

@@ -1,0 +1,185 @@
+"""Vision model family: functional ResNet with GroupNorm (the ``cv_example`` backbone).
+
+The reference's CV examples fine-tune a timm ``resnet50d`` (``/root/reference/examples/
+cv_example.py``); the framework ships its own TPU-native ResNet because the mesh runtime
+needs models whose sharding is part of their definition (same rationale as ``llama.py``).
+
+TPU-first choices:
+- **GroupNorm instead of BatchNorm**: batch statistics are cross-device state that would
+  need ``psum``s in the forward and running-stat mutation outside the functional step;
+  GroupNorm is stateless, batch-size-independent and jit-trivial — the standard swap for
+  functional vision stacks.
+- NHWC layout (XLA:TPU's native convolution layout, feeds the MXU without transposes).
+- ``partition_specs`` shard conv filters over their output-channel dim (column-parallel
+  analog) so TP/FSDP composition works exactly like the llama plans.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..utils.constants import TENSOR_AXIS
+
+__all__ = [
+    "ResNetConfig",
+    "CONFIGS",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "partition_specs",
+    "num_params",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    num_classes: int = 10
+    widths: tuple = (64, 128, 256, 512)
+    blocks_per_stage: tuple = (2, 2, 2, 2)  # resnet18-shaped
+    in_channels: int = 3
+    groups: int = 8           # GroupNorm groups
+    dtype: Any = jnp.float32
+
+
+CONFIGS = {
+    "resnet18": ResNetConfig(),
+    "resnet34": ResNetConfig(blocks_per_stage=(3, 4, 6, 3)),
+    "tiny": ResNetConfig(widths=(8, 16), blocks_per_stage=(1, 1), groups=4),
+}
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    return jax.random.normal(key, (kh, kw, cin, cout), jnp.float32) * math.sqrt(2.0 / fan_in)
+
+
+def _block_params(cfg: ResNetConfig, key, cin: int, cout: int) -> dict:
+    k = jax.random.split(key, 3)
+    p = {
+        "conv1": _conv_init(k[0], 3, 3, cin, cout),
+        "gn1": {"scale": jnp.ones((cout,)), "bias": jnp.zeros((cout,))},
+        "conv2": _conv_init(k[1], 3, 3, cout, cout),
+        "gn2": {"scale": jnp.ones((cout,)), "bias": jnp.zeros((cout,))},
+    }
+    if cin != cout:
+        p["proj"] = _conv_init(k[2], 1, 1, cin, cout)
+    return p
+
+
+def init_params(cfg: ResNetConfig, key: Optional[jax.Array] = None) -> dict:
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    n_blocks = sum(cfg.blocks_per_stage)
+    keys = jax.random.split(key, n_blocks + 2)
+    params: dict = {
+        "stem": _conv_init(keys[0], 3, 3, cfg.in_channels, cfg.widths[0]),
+        "stem_gn": {"scale": jnp.ones((cfg.widths[0],)), "bias": jnp.zeros((cfg.widths[0],))},
+        "stages": [],
+    }
+    ki = 1
+    cin = cfg.widths[0]
+    for width, n in zip(cfg.widths, cfg.blocks_per_stage):
+        stage = []
+        for _ in range(n):
+            stage.append(_block_params(cfg, keys[ki], cin, width))
+            cin = width
+            ki += 1
+        params["stages"].append(stage)
+    params["head"] = {
+        "w": jax.random.normal(keys[-1], (cin, cfg.num_classes), jnp.float32) / math.sqrt(cin),
+        "b": jnp.zeros((cfg.num_classes,)),
+    }
+    return params
+
+
+def partition_specs(cfg: ResNetConfig) -> dict:
+    """Conv filters column-parallel on output channels; head row/col like an MLP."""
+    gn = {"scale": P(), "bias": P()}
+
+    def block_spec(has_proj: bool) -> dict:
+        s = {
+            "conv1": P(None, None, None, TENSOR_AXIS),
+            "gn1": dict(gn),
+            "conv2": P(None, None, None, TENSOR_AXIS),
+            "gn2": dict(gn),
+        }
+        if has_proj:
+            s["proj"] = P(None, None, None, TENSOR_AXIS)
+        return s
+
+    stages = []
+    cin = cfg.widths[0]
+    for width, n in zip(cfg.widths, cfg.blocks_per_stage):
+        stage = []
+        for _ in range(n):
+            stage.append(block_spec(cin != width))
+            cin = width
+        stages.append(stage)
+    return {
+        "stem": P(None, None, None, TENSOR_AXIS),
+        "stem_gn": dict(gn),
+        "stages": stages,
+        "head": {"w": P(None, TENSOR_AXIS), "b": P(TENSOR_AXIS)},
+    }
+
+
+def _group_norm(x, gn, groups: int, eps: float = 1e-5):
+    B, H, W, C = x.shape
+    g = min(groups, C)
+    xg = x.reshape(B, H, W, g, C // g).astype(jnp.float32)
+    mean = xg.mean(axis=(1, 2, 4), keepdims=True)
+    var = xg.var(axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mean) * jax.lax.rsqrt(var + eps)
+    x = xg.reshape(B, H, W, C)
+    return (x * gn["scale"] + gn["bias"]).astype(x.dtype)
+
+
+def _conv(x, w, stride: int = 1):
+    return jax.lax.conv_general_dilated(
+        x, w.astype(x.dtype), (stride, stride), "SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _block(x, p, cfg: ResNetConfig, stride: int):
+    h = _conv(x, p["conv1"], stride)
+    h = jax.nn.relu(_group_norm(h, p["gn1"], cfg.groups))
+    h = _conv(h, p["conv2"])
+    h = _group_norm(h, p["gn2"], cfg.groups)
+    if "proj" in p:
+        x = _conv(x, p["proj"], stride)
+    elif stride != 1:
+        x = x[:, ::stride, ::stride, :]
+    return jax.nn.relu(x + h)
+
+
+def forward(params: dict, images: jax.Array, cfg: ResNetConfig) -> jax.Array:
+    """images [B, H, W, C] (NHWC, float) → logits [B, num_classes] fp32."""
+    x = images.astype(cfg.dtype)
+    x = jax.nn.relu(_group_norm(_conv(x, params["stem"]), params["stem_gn"], cfg.groups))
+    for si, stage in enumerate(params["stages"]):
+        for bi, block in enumerate(stage):
+            stride = 2 if (si > 0 and bi == 0) else 1
+            x = _block(x, block, cfg, stride)
+    x = x.mean(axis=(1, 2))  # global average pool
+    head = params["head"]
+    return (x @ head["w"].astype(x.dtype) + head["b"].astype(x.dtype)).astype(jnp.float32)
+
+
+def loss_fn(params: dict, batch: dict, cfg: ResNetConfig) -> jax.Array:
+    """Cross-entropy over batch {'image': [B,H,W,C], 'label': [B]}."""
+    logits = forward(params, batch["image"], cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, batch["label"][:, None].astype(jnp.int32), axis=-1)
+    return -jnp.mean(ll)
+
+
+def num_params(cfg: ResNetConfig) -> int:
+    return sum(int(np.prod(np.shape(l))) for l in jax.tree_util.tree_leaves(init_params(cfg)))

@@ -37,6 +37,7 @@ __all__ = [
     "fp8_linear",
     "DelayedScalingState",
     "delayed_scales",
+    "autoscale_ctx",
 ]
 
 # Maximum representable magnitude per fp8 format.
@@ -165,6 +166,33 @@ def _fp8_dot_bwd(fp8_format, margin, residuals, g):
 
 _fp8_dot_impl.defvjp(_fp8_dot_fwd, _fp8_dot_bwd)
 
+import contextlib
+
+# Active delayed-scaling context: {"scales": fp32[3] tracer, "amax": fp32[2] tracer or None}.
+# Set by autoscale_ctx during train-step tracing; consulted by fp8_dot when no explicit
+# scales are passed (the functional analog of TE's fp8_autocast context).
+_AUTOSCALE: dict = {"scales": None, "amax": None}
+
+
+@contextlib.contextmanager
+def autoscale_ctx(scales: jax.Array):
+    """Route ``scales`` to every :func:`fp8_dot` in the block and collect observed forward
+    amaxes (elementwise max across call sites) — used by
+    ``Accelerator.build_train_step`` to wire :class:`DelayedScalingState` automatically.
+
+    Read ``ctx["amax"]`` INSIDE the block (it holds trace-local values; nothing is retained
+    after exit — retaining it would leak tracers out of the enclosing jit trace).
+    """
+    prev = dict(_AUTOSCALE)
+    _AUTOSCALE["scales"] = scales
+    _AUTOSCALE["amax"] = jnp.zeros((2,), jnp.float32)
+    try:
+        yield _AUTOSCALE
+    finally:
+        _AUTOSCALE["scales"] = prev["scales"]
+        _AUTOSCALE["amax"] = prev["amax"]
+
+
 def fp8_dot(
     x: jax.Array,
     w: jax.Array,
@@ -176,9 +204,19 @@ def fp8_dot(
 
     ``fp8_format``/``margin`` default to the process recipe (:func:`set_default_recipe`).
     ``scales``: optional fp32 ``[3]`` array ``(x_scale, w_scale, grad_scale)`` from
-    :func:`delayed_scales`; None selects current scaling (each tensor's own amax, stateless).
+    :func:`delayed_scales`; None selects the active :func:`autoscale_ctx`'s scales if one is
+    set, else current scaling (each tensor's own amax, stateless).
     """
     fp8_format, margin = _resolve(fp8_format, margin)
+    if scales is None and _AUTOSCALE["scales"] is not None:
+        scales = _AUTOSCALE["scales"]
+        _AUTOSCALE["amax"] = jnp.maximum(
+            _AUTOSCALE["amax"],
+            jnp.stack([
+                jnp.max(jnp.abs(x)).astype(jnp.float32),
+                jnp.max(jnp.abs(w)).astype(jnp.float32),
+            ]),
+        )
     if scales is None:
         scales = jnp.full((3,), jnp.nan, jnp.float32)
     return _fp8_dot_impl(x, w, scales, fp8_format, margin)

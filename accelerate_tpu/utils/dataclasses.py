@@ -160,14 +160,23 @@ class FP8RecipeKwargs(KwargsHandler):
     (``DelayedScalingState``). ``use_delayed_scaling=False`` = stateless current scaling.
     """
 
-    fp8_format: str = "HYBRID"  # HYBRID | E4M3
-    margin: int = 0
+    fp8_format: Optional[str] = None       # HYBRID | E4M3; None → env > HYBRID
+    margin: Optional[int] = None           # None → env > 0
     interval: int = 1
-    amax_history_len: int = 16
+    amax_history_len: Optional[int] = None  # None → env > 16
     amax_compute_algo: str = "max"  # max | most_recent
-    use_delayed_scaling: bool = False
+    use_delayed_scaling: Optional[bool] = None  # None → env > False
 
     def __post_init__(self):
+        # Explicit arg > ACCELERATE_FP8_* env > built-in (None is the unset sentinel).
+        if self.fp8_format is None:
+            self.fp8_format = os.environ.get("ACCELERATE_FP8_FORMAT", "HYBRID")
+        if self.margin is None:
+            self.margin = int(os.environ.get("ACCELERATE_FP8_MARGIN", 0))
+        if self.amax_history_len is None:
+            self.amax_history_len = int(os.environ.get("ACCELERATE_FP8_AMAX_HISTORY_LEN", 16))
+        if self.use_delayed_scaling is None:
+            self.use_delayed_scaling = parse_flag_from_env("ACCELERATE_FP8_DELAYED_SCALING")
         self.fp8_format = self.fp8_format.upper()
         if self.fp8_format not in ("HYBRID", "E4M3"):
             raise ValueError("`fp8_format` must be HYBRID or E4M3.")
@@ -207,16 +216,33 @@ class ProfileKwargs(KwargsHandler):
 
 @dataclass
 class DataLoaderConfiguration(KwargsHandler):
-    """Reference ``dataclasses.py:762``."""
+    """Reference ``dataclasses.py:762``. None-sentinel fields resolve launcher env
+    (``ACCELERATE_DISPATCH_BATCHES``/``EVEN_BATCHES``/``USE_SEEDABLE_SAMPLER``) > built-in."""
 
     split_batches: bool = False
     dispatch_batches: Optional[bool] = None
-    even_batches: bool = True
-    use_seedable_sampler: bool = True
+    even_batches: Optional[bool] = None         # built-in True
+    use_seedable_sampler: Optional[bool] = None  # built-in True
     data_seed: Optional[int] = None
     non_blocking: bool = False      # async host→device transfer
     use_stateful_dataloader: bool = False
     prefetch_size: int = 2          # device-transfer double buffering depth
+
+    def __post_init__(self):
+        if self.dispatch_batches is None and "ACCELERATE_DISPATCH_BATCHES" in os.environ:
+            self.dispatch_batches = parse_flag_from_env("ACCELERATE_DISPATCH_BATCHES")
+        if self.even_batches is None:
+            self.even_batches = (
+                parse_flag_from_env("ACCELERATE_EVEN_BATCHES")
+                if "ACCELERATE_EVEN_BATCHES" in os.environ
+                else True
+            )
+        if self.use_seedable_sampler is None:
+            self.use_seedable_sampler = (
+                parse_flag_from_env("ACCELERATE_USE_SEEDABLE_SAMPLER")
+                if "ACCELERATE_USE_SEEDABLE_SAMPLER" in os.environ
+                else True
+            )
 
 
 @dataclass
@@ -236,6 +262,10 @@ class ProjectConfiguration(KwargsHandler):
             self.logging_dir = project_dir
 
     def __post_init__(self):
+        if self.project_dir is None and os.environ.get("ACCELERATE_PROJECT_DIR"):
+            self.project_dir = os.environ["ACCELERATE_PROJECT_DIR"]
+        if self.total_limit is None and os.environ.get("ACCELERATE_CHECKPOINT_TOTAL_LIMIT"):
+            self.total_limit = int(os.environ["ACCELERATE_CHECKPOINT_TOTAL_LIMIT"])
         if self.logging_dir is None:
             self.logging_dir = self.project_dir
 
@@ -282,12 +312,14 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
 
     sharding_strategy: FSDPShardingStrategy | str = FSDPShardingStrategy.FULL_SHARD
     zero_stage: Optional[int] = None          # overrides sharding_strategy if set
-    min_weight_size: int = 2**10              # params with fewer elements stay replicated
+    # None defaults resolve env > built-in in __post_init__ (None-sentinel pattern: an
+    # EXPLICIT value, even one equal to the built-in default, always beats launcher env).
+    min_weight_size: Optional[int] = None     # built-in 1024; smaller params stay replicated
     shard_axis: str = "fsdp"
     # Checkpoint layout on save_state: SHARDED keeps orbax per-shard tensorstore files;
     # FULL gathers to a single consolidated state on rank 0 (reference FSDP StateDictType,
     # utils/constants.py:39). Consumed by checkpointing.save_accelerator_state.
-    state_dict_type: str = "SHARDED_STATE_DICT"
+    state_dict_type: Optional[str] = None     # built-in SHARDED_STATE_DICT
     # ZeRO-Offload: optimizer state + grad-accum buffers live in pinned host RAM and are
     # streamed through HBM inside the apply step (consumed by create_train_state /
     # build_train_step). Reference: DeepSpeed offload fields, dataclasses.py:1078-1093.
@@ -305,6 +337,16 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
         env_stage = os.environ.get("ACCELERATE_FSDP_ZERO_STAGE")
         if self.zero_stage is None and env_stage is not None:
             self.zero_stage = int(env_stage)
+        # Launcher wire protocol for the remaining fsdp knobs (explicit arg > env > built-in,
+        # §5 priority order — None is the "unset" sentinel).
+        if not self.cpu_offload and parse_flag_from_env("ACCELERATE_FSDP_CPU_OFFLOAD"):
+            self.cpu_offload = True
+        if self.state_dict_type is None:
+            self.state_dict_type = os.environ.get(
+                "ACCELERATE_FSDP_STATE_DICT_TYPE", "SHARDED_STATE_DICT"
+            )
+        if self.min_weight_size is None:
+            self.min_weight_size = int(os.environ.get("ACCELERATE_FSDP_MIN_WEIGHT_SIZE", 2**10))
         if self.zero_stage is None:
             self.zero_stage = {
                 FSDPShardingStrategy.FULL_SHARD: 3,
@@ -370,7 +412,13 @@ class SequenceParallelPlugin(KwargsHandler):
     """
 
     sp_size: int = 1
-    mode: str = "ring"  # "ring" | "ulysses" | "allgather"
+    mode: Optional[str] = None  # "ring" | "ulysses" | "allgather"; None → env > "ring"
+
+    def __post_init__(self):
+        if self.mode is None:
+            self.mode = os.environ.get("ACCELERATE_SP_MODE", "ring")
+        if self.mode not in ("ring", "ulysses", "allgather"):
+            raise ValueError(f"sp mode must be ring|ulysses|allgather, got {self.mode!r}")
 
 
 @dataclass

@@ -60,6 +60,36 @@ def _common_env(args: Any) -> dict[str, str]:
     if getattr(args, "fsdp_zero_stage", None):
         env[f"{ENV_PREFIX}FSDP_ZERO_STAGE"] = str(args.fsdp_zero_stage)
         env.setdefault(f"{ENV_PREFIX}USE_FSDP", "true")
+    if getattr(args, "fsdp_cpu_offload", False):
+        env[f"{ENV_PREFIX}FSDP_CPU_OFFLOAD"] = "true"
+    if getattr(args, "fsdp_state_dict_type", None):
+        env[f"{ENV_PREFIX}FSDP_STATE_DICT_TYPE"] = str(args.fsdp_state_dict_type)
+    if getattr(args, "fsdp_min_weight_size", None):
+        env[f"{ENV_PREFIX}FSDP_MIN_WEIGHT_SIZE"] = str(args.fsdp_min_weight_size)
+    if getattr(args, "sp_mode", None):
+        env[f"{ENV_PREFIX}SP_MODE"] = str(args.sp_mode)
+    if getattr(args, "fp8_format", None):
+        env[f"{ENV_PREFIX}FP8_FORMAT"] = str(args.fp8_format)
+    if getattr(args, "fp8_margin", None) is not None:
+        env[f"{ENV_PREFIX}FP8_MARGIN"] = str(args.fp8_margin)
+    if getattr(args, "fp8_amax_history_len", None):
+        env[f"{ENV_PREFIX}FP8_AMAX_HISTORY_LEN"] = str(args.fp8_amax_history_len)
+    if getattr(args, "fp8_use_delayed_scaling", None):
+        env[f"{ENV_PREFIX}FP8_DELAYED_SCALING"] = "true"
+    if getattr(args, "pp_num_microbatches", None):
+        env[f"{ENV_PREFIX}PP_MICROBATCHES"] = str(args.pp_num_microbatches)
+    if getattr(args, "dispatch_batches", None) is not None:
+        env[f"{ENV_PREFIX}DISPATCH_BATCHES"] = _str_flag(args.dispatch_batches)
+    if getattr(args, "even_batches", None) is not None:
+        env[f"{ENV_PREFIX}EVEN_BATCHES"] = _str_flag(args.even_batches)
+    if getattr(args, "use_seedable_sampler", None) is not None:
+        env[f"{ENV_PREFIX}USE_SEEDABLE_SAMPLER"] = _str_flag(args.use_seedable_sampler)
+    if getattr(args, "project_dir", None):
+        env[f"{ENV_PREFIX}PROJECT_DIR"] = str(args.project_dir)
+    if getattr(args, "checkpoint_total_limit", None):
+        env[f"{ENV_PREFIX}CHECKPOINT_TOTAL_LIMIT"] = str(args.checkpoint_total_limit)
+    if getattr(args, "log_with", None):
+        env[f"{ENV_PREFIX}LOG_WITH"] = str(args.log_with)
     env.update(mesh_env_from_args(args))
     # Virtual-device CPU simulation (--num-virtual-devices): the test backbone.
     nvd = getattr(args, "num_virtual_devices", None)

@@ -1,0 +1,77 @@
+"""by_feature: automatic gradient accumulation (reference
+``examples/by_feature/automatic_gradient_accumulation.py``).
+
+Combines ``find_executable_batch_size`` (OOM retry, halving) with compensating gradient
+accumulation: when the per-device batch halves, the accumulation steps double, keeping the
+EFFECTIVE batch size — and therefore the optimization trajectory — constant.
+
+  accelerate-tpu launch examples/by_feature/automatic_gradient_accumulation.py --smoke
+"""
+
+import argparse
+import os
+import sys
+
+import jax
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.data_loader import DataLoader
+from accelerate_tpu.models import bert
+from accelerate_tpu.utils import find_executable_batch_size, set_seed
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from nlp_example import SyntheticMRPC  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--observed_batch_size", type=int, default=32,
+                        help="Effective batch size to preserve across OOM retries.")
+    parser.add_argument("--simulate_oom_above", type=int, default=None,
+                        help="Testing hook: raise a fake OOM when batch_size exceeds this.")
+    args = parser.parse_args()
+
+    set_seed(42)
+    cfg = bert.CONFIGS["tiny"]
+    dataset = SyntheticMRPC(cfg, n=64 if args.smoke else 256, seed=0, seq_len=32)
+
+    @find_executable_batch_size(starting_batch_size=args.observed_batch_size)
+    def inner_training_loop(batch_size):
+        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        if args.simulate_oom_above and batch_size > args.simulate_oom_above:
+            raise RuntimeError("RESOURCE_EXHAUSTED: simulated out-of-memory")
+        accumulation = max(args.observed_batch_size // batch_size, 1)
+        accelerator = Accelerator(cpu=args.cpu, gradient_accumulation_steps=accumulation)
+        accelerator.print(
+            f"trying batch_size={batch_size} with accumulation={accumulation} "
+            f"(effective {batch_size * accumulation})"
+        )
+        train_dl = DataLoader(dataset, batch_size=batch_size, shuffle=True, drop_last=True)
+        params = bert.init_params(cfg, jax.random.PRNGKey(0))
+        params, tx, train_dl = accelerator.prepare(params, optax.adam(1e-3), train_dl)
+        state = accelerator.create_train_state(params, tx)
+        step = accelerator.build_train_step(lambda p, b: bert.loss_fn(p, b, cfg))
+        for batch in train_dl:
+            state, metrics = step(state, batch)
+        accelerator.print(
+            f"done: batch_size={batch_size} optimizer_steps={int(state.step)} "
+            f"loss={float(metrics['loss']):.4f}"
+        )
+        accelerator.end_training()
+        return batch_size
+
+    used = inner_training_loop()
+    if args.simulate_oom_above:
+        assert used <= args.simulate_oom_above, (used, args.simulate_oom_above)
+        print(f"auto-recovered to batch_size={used}")
+
+
+if __name__ == "__main__":
+    main()

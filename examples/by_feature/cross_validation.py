@@ -1,0 +1,97 @@
+"""by_feature: k-fold cross-validation (reference ``examples/by_feature/cross_validation.py``).
+
+Each fold trains a fresh state on k-1 shards and evaluates on the held-out shard;
+per-fold predictions are gathered with ``gather_for_metrics`` and the final score averages
+the folds. The fold loop is plain host Python — only the steps are compiled.
+
+  accelerate-tpu launch examples/by_feature/cross_validation.py --smoke
+"""
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+import jax
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.data_loader import DataLoader
+from accelerate_tpu.models import bert
+from accelerate_tpu.utils import set_seed
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from nlp_example import SyntheticMRPC  # noqa: E402
+
+
+class Subset:
+    def __init__(self, base, ids):
+        self.base, self.ids = base, list(ids)
+
+    def __len__(self):
+        return len(self.ids)
+
+    def __getitem__(self, i):
+        return self.base[self.ids[i]]
+
+
+def run_fold(accelerator, cfg, dataset, fold_ids, train_ids, args):
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    train_dl = DataLoader(
+        Subset(dataset, train_ids), batch_size=8, shuffle=True, drop_last=True
+    )
+    eval_dl = DataLoader(Subset(dataset, fold_ids), batch_size=8)
+    params = bert.init_params(cfg, jax.random.PRNGKey(args.seed))
+    params, tx, train_dl, eval_dl = accelerator.prepare(
+        params, optax.adam(1e-3), train_dl, eval_dl
+    )
+    state = accelerator.create_train_state(params, tx)
+    step = accelerator.build_train_step(lambda p, b: bert.loss_fn(p, b, cfg))
+    eval_step = accelerator.build_eval_step(
+        lambda p, b: bert.forward(p, b["input_ids"], b["token_type_ids"], b["attention_mask"], cfg)
+    )
+    for _ in range(args.epochs_per_fold):
+        for batch in train_dl:
+            state, _ = step(state, batch)
+    correct = total = 0
+    for batch in eval_dl:
+        logits = eval_step(state.params, batch)
+        preds = np.asarray(logits).argmax(-1)
+        labels = np.asarray(batch["labels"]).reshape(-1)
+        preds, labels = accelerator.gather_for_metrics((preds[: len(labels)], labels))
+        correct += int((preds == labels).sum())
+        total += len(labels)
+    return correct / max(total, 1)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--num_folds", type=int, default=3)
+    parser.add_argument("--epochs_per_fold", type=int, default=2)
+    parser.add_argument("--seed", type=int, default=42)
+    args = parser.parse_args()
+
+    accelerator = Accelerator(cpu=args.cpu)
+    set_seed(args.seed)
+    cfg = bert.CONFIGS["tiny"]
+    dataset = SyntheticMRPC(cfg, n=96 if args.smoke else 384, seed=0, seq_len=32)
+
+    ids = np.arange(len(dataset))
+    np.random.default_rng(args.seed).shuffle(ids)
+    folds = np.array_split(ids, args.num_folds)
+    scores = []
+    for k in range(args.num_folds):
+        train_ids = np.concatenate([f for i, f in enumerate(folds) if i != k])
+        score = run_fold(accelerator, cfg, dataset, folds[k].tolist(), train_ids.tolist(), args)
+        scores.append(score)
+        accelerator.print(f"fold {k}: accuracy={score:.3f}")
+    accelerator.print(f"cross-validation accuracy={np.mean(scores):.3f} over {args.num_folds} folds")
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

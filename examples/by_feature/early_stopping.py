@@ -1,0 +1,81 @@
+"""by_feature: early stopping (reference ``examples/by_feature/early_stopping.py``).
+
+The synchronization primitive is ``accelerator.set_trigger()`` / ``check_trigger()``
+(reference ``accelerator.py:2569,2583``): any process may arm the flag (e.g. only rank 0
+computes the validation metric) and EVERY process sees it fire, so the whole group breaks
+out of the loop together — no divergent control flow across ranks.
+
+  accelerate-tpu launch examples/by_feature/early_stopping.py --smoke
+"""
+
+import argparse
+import os
+import sys
+
+import jax
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import bert
+from accelerate_tpu.utils import set_seed
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+from nlp_example import get_dataloaders  # noqa: E402
+
+
+class EarlyStopper:
+    def __init__(self, patience: int = 2, min_delta: float = 1e-3):
+        self.patience = patience
+        self.min_delta = min_delta
+        self.best = float("inf")
+        self.bad_epochs = 0
+
+    def should_stop(self, loss: float) -> bool:
+        if loss < self.best - self.min_delta:
+            self.best = loss
+            self.bad_epochs = 0
+        else:
+            self.bad_epochs += 1
+        return self.bad_epochs >= self.patience
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--patience", type=int, default=2)
+    parser.add_argument("--num_epochs", type=int, default=20)
+    args = parser.parse_args()
+
+    accelerator = Accelerator(cpu=args.cpu)
+    set_seed(42)
+    cfg = bert.CONFIGS["tiny"]
+    train_dl, _ = get_dataloaders(accelerator, 8, cfg, smoke=True)
+
+    params = bert.init_params(cfg, jax.random.PRNGKey(0))
+    params, tx, train_dl = accelerator.prepare(params, optax.adam(1e-3), train_dl)
+    state = accelerator.create_train_state(params, tx)
+    step = accelerator.build_train_step(lambda p, b: bert.loss_fn(p, b, cfg))
+
+    stopper = EarlyStopper(patience=args.patience)
+    stopped_at = None
+    for epoch in range(args.num_epochs):
+        epoch_loss = 0.0
+        for batch in train_dl:
+            state, metrics = step(state, batch)
+            epoch_loss += float(metrics["loss"])
+        epoch_loss /= max(len(train_dl), 1)
+        # Only the main process evaluates the stopping criterion; the trigger synchronizes.
+        if accelerator.is_main_process and stopper.should_stop(epoch_loss):
+            accelerator.set_trigger()
+        accelerator.print(f"epoch {epoch}: loss={epoch_loss:.4f}")
+        if accelerator.check_trigger():
+            stopped_at = epoch
+            accelerator.print(f"early stopping at epoch {epoch} (patience={args.patience})")
+            break
+    assert stopped_at is None or stopped_at < args.num_epochs
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

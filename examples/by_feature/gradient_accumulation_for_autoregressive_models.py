@@ -1,0 +1,90 @@
+"""by_feature: gradient accumulation for autoregressive models (reference
+``examples/by_feature/gradient_accumulation_for_autoregressive_models.py``).
+
+The subtlety the reference example teaches: with variable numbers of VALID tokens per
+micro-batch, averaging each micro-loss then averaging across micro-batches weights tokens
+unequally. The fix is to normalize by the TOTAL token count of the whole accumulation
+window: each micro-step contributes ``sum(ce) / total_tokens`` so the accumulated gradient
+equals the one a single big batch would produce.
+
+  accelerate-tpu launch examples/by_feature/gradient_accumulation_for_autoregressive_models.py --smoke
+"""
+
+import argparse
+import dataclasses
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import llama
+from accelerate_tpu.utils import set_seed
+
+
+def make_batches(cfg, n_batches, batch, seq, seed=0):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_batches):
+        tokens = rng.integers(0, cfg.vocab_size, size=(batch, seq + 1)).astype(np.int32)
+        lengths = rng.integers(seq // 2, seq + 1, size=batch)
+        mask = (np.arange(seq + 1)[None, :] < lengths[:, None]).astype(np.int32)
+        out.append({"tokens": tokens, "mask": mask})
+    return out
+
+
+def token_weighted_loss(params, batch, cfg, total_tokens):
+    """Per-window normalization: sum of masked CE over this micro-batch / window tokens."""
+    tokens, mask = batch["tokens"], batch["mask"][:, 1:].astype(jnp.float32)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = llama.forward(params, inputs, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    return -(ll * mask).sum() / total_tokens
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--gradient_accumulation_steps", type=int, default=4)
+    args = parser.parse_args()
+
+    accum = args.gradient_accumulation_steps
+    accelerator = Accelerator(cpu=args.cpu, gradient_accumulation_steps=accum)
+    set_seed(42)
+    cfg = dataclasses.replace(llama.CONFIGS["tiny"], attn_impl="xla", dtype=jnp.float32)
+
+    batches = make_batches(cfg, n_batches=accum * 2, batch=4, seq=32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    tx = accelerator.prepare(optax.adamw(1e-3))
+    state = accelerator.create_train_state(params, tx)
+
+    # The window's total valid-token count is data-dependent: compute it host-side per
+    # window and bake it into the micro losses (a fresh closure keeps the step compiled
+    # once — total_tokens enters as a traced scalar).
+    def loss_fn(p, b):
+        return token_weighted_loss(p, b, cfg, b["total_tokens"])
+
+    step = accelerator.build_train_step(loss_fn)
+
+    for window_start in range(0, len(batches), accum):
+        window = batches[window_start : window_start + accum]
+        total = float(sum(b["mask"][:, 1:].sum() for b in window))
+        for micro in window:
+            micro = {**micro, "total_tokens": np.float32(total)}
+            state, metrics = step(state, micro)
+        accelerator.print(
+            f"window tokens={int(total)} loss_contrib={float(metrics['loss']):.5f} "
+            f"optimizer_steps={int(state.step)}"
+        )
+    # The accumulated loss scale: metrics['loss'] is the micro contribution (sum/total),
+    # so one window's contributions sum to the true token-weighted mean CE.
+    assert int(state.step) == len(batches) // accum
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,106 @@
+"""Complete CV example: ``cv_example`` + checkpointing / resume / tracking — the reference's
+``examples/complete_cv_example.py`` re-expressed TPU-native.
+
+  accelerate-tpu launch examples/complete_cv_example.py --checkpointing_steps epoch \
+      --with_tracking --project_dir ./out
+"""
+
+import argparse
+import os
+import sys
+
+import jax
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import resnet
+from accelerate_tpu.utils import ProjectConfiguration, set_seed
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from cv_example import evaluate, get_dataloaders  # noqa: E402
+
+
+def training_function(args):
+    project_config = ProjectConfiguration(
+        project_dir=args.project_dir, automatic_checkpoint_naming=False
+    )
+    accelerator = Accelerator(
+        mixed_precision=args.mixed_precision,
+        cpu=args.cpu,
+        log_with="tensorboard" if args.with_tracking else None,
+        project_config=project_config,
+    )
+    if args.with_tracking:
+        accelerator.init_trackers("complete_cv_example", config=vars(args))
+    set_seed(args.seed)
+
+    import dataclasses as dc
+
+    base = resnet.CONFIGS["tiny"] if args.smoke else resnet.CONFIGS["resnet18"]
+    train_dl, eval_dl, n_classes = get_dataloaders(accelerator, args)
+    cfg = dc.replace(base, num_classes=n_classes)
+
+    params = resnet.init_params(cfg, jax.random.PRNGKey(args.seed))
+    tx = optax.adamw(args.lr)
+    params, tx, train_dl, eval_dl = accelerator.prepare(params, tx, train_dl, eval_dl)
+    state = accelerator.create_train_state(params, tx)
+    step = accelerator.build_train_step(lambda p, b: resnet.loss_fn(p, b, cfg))
+    eval_step = accelerator.build_eval_step(lambda p, b: resnet.forward(p, b["image"], cfg))
+
+    starting_epoch = 0
+    if args.resume_from_checkpoint:
+        accelerator.print(f"Resuming from {args.resume_from_checkpoint}")
+        state = accelerator.load_state(args.resume_from_checkpoint, train_state=state)
+        base_name = os.path.basename(args.resume_from_checkpoint.rstrip("/"))
+        if base_name.startswith("epoch_"):
+            starting_epoch = int(base_name.split("_")[-1]) + 1
+
+    overall_step = 0
+    for epoch in range(starting_epoch, args.num_epochs):
+        total_loss = 0.0
+        for batch in train_dl:
+            state, metrics = step(state, batch)
+            total_loss += float(metrics["loss"])
+            overall_step += 1
+            if args.checkpointing_steps not in (None, "epoch") and overall_step % int(args.checkpointing_steps) == 0:
+                accelerator.save_state(
+                    os.path.join(args.project_dir or ".", f"step_{overall_step}"),
+                    train_state=state,
+                )
+        acc = evaluate(accelerator, eval_step, state, eval_dl, cfg)
+        accelerator.print(f"epoch {epoch}: loss={float(metrics['loss']):.4f} accuracy={acc:.3f}")
+        if args.with_tracking:
+            accelerator.log(
+                {"accuracy": acc, "train_loss": total_loss / max(len(train_dl), 1)}, step=epoch
+            )
+        if args.checkpointing_steps == "epoch":
+            accelerator.save_state(
+                os.path.join(args.project_dir or ".", f"epoch_{epoch}"), train_state=state
+            )
+    accelerator.end_training()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--data-dir", "--data_dir", default=None)
+    parser.add_argument("--image-size", "--image_size", type=int, default=32)
+    parser.add_argument("--mixed_precision", default=None, choices=[None, "no", "bf16", "fp16"])
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--batch_size", type=int, default=16)
+    parser.add_argument("--lr", type=float, default=3e-3)
+    parser.add_argument("--num_epochs", type=int, default=3)
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--checkpointing_steps", default=None,
+                        help="'epoch' or an integer step interval.")
+    parser.add_argument("--resume_from_checkpoint", default=None)
+    parser.add_argument("--with_tracking", action="store_true")
+    parser.add_argument("--project_dir", default=None)
+    args = parser.parse_args()
+    if args.smoke:
+        args.num_epochs = min(args.num_epochs, 2)
+    training_function(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -289,3 +289,130 @@ def test_accelerator_state_reads_mesh_env(monkeypatch):
     state = AcceleratorState()
     assert dict(zip(state.mesh.axis_names, state.mesh.devices.shape))["tp"] == 2
     assert state.distributed_type.value in ("TP", "HYBRID", "MULTI_DEVICE")
+
+
+# --------------------------------------------------------------- deep config questionnaire
+def test_interactive_config_deep_tree(tmp_path, monkeypatch):
+    """Scripted walk through the questionnaire: ZeRO-2 + offload + fp8 + sp sub-trees."""
+    import io
+
+    from accelerate_tpu.commands.config import _interactive_config
+
+    answers = iter([
+        "0",        # environment: LOCAL_MACHINE
+        "1",        # num machines
+        "1",        # num processes
+        "3",        # mixed precision: fp8
+        "0",        # fp8 format HYBRID
+        "1",        # fp8 margin
+        "yes",      # delayed scaling
+        "32",       # amax history
+        "2",        # zero stage 2
+        "-1",       # fsdp axis
+        "yes",      # cpu offload
+        "2048",     # min weight size
+        "1",        # state dict type: FULL
+        "2",        # tp
+        "2",        # sp
+        "1",        # sp mode: ulysses
+        "1",        # pp
+        "1",        # ep
+        "4",        # grad accum
+        "no",       # dataloader config?
+        "yes",      # checkpointing/tracking?
+        "/tmp/proj",  # project dir
+        "3",        # total limit
+        "1",        # tracker: tensorboard
+        "no",       # debug
+    ])
+    monkeypatch.setattr("builtins.input", lambda prompt="": next(answers))
+    cfg = _interactive_config()
+    assert cfg.mixed_precision == "fp8" and cfg.fp8_margin == 1
+    assert cfg.fp8_use_delayed_scaling and cfg.fp8_amax_history_len == 32
+    assert cfg.fsdp_zero_stage == 2 and cfg.fsdp_cpu_offload
+    assert cfg.fsdp_min_weight_size == 2048
+    assert cfg.fsdp_state_dict_type == "FULL_STATE_DICT"
+    assert cfg.tp == 2 and cfg.sp == 2 and cfg.sp_mode == "ulysses"
+    assert cfg.gradient_accumulation_steps == 4
+    assert cfg.project_dir == "/tmp/proj" and cfg.checkpoint_total_limit == 3
+    assert cfg.log_with == "tensorboard"
+    # Round-trips through YAML.
+    path = cfg.save(str(tmp_path / "cfg.yaml"))
+    from accelerate_tpu.commands.config import load_config_from_file
+
+    loaded = load_config_from_file(path)
+    assert loaded.fsdp_cpu_offload and loaded.sp_mode == "ulysses"
+
+
+def test_fsdp_env_wire_protocol(monkeypatch):
+    """Launcher env → plugin fields (the ACCELERATE_* deserialization side)."""
+    from accelerate_tpu.utils.dataclasses import (
+        FullyShardedDataParallelPlugin,
+        SequenceParallelPlugin,
+    )
+
+    monkeypatch.setenv("ACCELERATE_FSDP_CPU_OFFLOAD", "true")
+    monkeypatch.setenv("ACCELERATE_FSDP_STATE_DICT_TYPE", "FULL_STATE_DICT")
+    monkeypatch.setenv("ACCELERATE_FSDP_MIN_WEIGHT_SIZE", "4096")
+    monkeypatch.setenv("ACCELERATE_SP_MODE", "allgather")
+    plugin = FullyShardedDataParallelPlugin()
+    assert plugin.cpu_offload and plugin.state_dict_type == "FULL_STATE_DICT"
+    assert plugin.min_weight_size == 4096
+    assert SequenceParallelPlugin().mode == "allgather"
+    # Explicit Python args still win over env.
+    explicit = FullyShardedDataParallelPlugin(min_weight_size=64, state_dict_type="SHARDED_STATE_DICT")
+    assert explicit.min_weight_size == 64
+
+
+def test_launch_serializes_fsdp_extras(tmp_path):
+    """Config file → launch dry-run env (the serialization side)."""
+    from accelerate_tpu.commands.config import ClusterConfig
+    from accelerate_tpu.commands.launch import launch_command_parser, launch_command
+
+    cfg = ClusterConfig(
+        fsdp_zero_stage=2, fsdp_cpu_offload=True, fsdp_state_dict_type="FULL_STATE_DICT",
+        sp_mode="ulysses", sp=2,
+    )
+    path = cfg.save(str(tmp_path / "cfg.yaml"))
+    script = tmp_path / "noop.py"
+    script.write_text("print('hi')\n")
+    parser = launch_command_parser()
+    args = parser.parse_args(["--config-file", path, "--dry-run", str(script)])
+    import contextlib, io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        launch_command(args)
+    out = buf.getvalue()
+    assert "ACCELERATE_FSDP_CPU_OFFLOAD=true" in out
+    assert "ACCELERATE_FSDP_STATE_DICT_TYPE=FULL_STATE_DICT" in out
+    assert "ACCELERATE_SP_MODE=ulysses" in out
+
+
+def test_full_config_env_consumers(monkeypatch):
+    """Every questionnaire knob has a consumer: env → the object that reads it."""
+    from accelerate_tpu.utils.dataclasses import (
+        DataLoaderConfiguration,
+        FP8RecipeKwargs,
+        ProjectConfiguration,
+    )
+
+    monkeypatch.setenv("ACCELERATE_FP8_MARGIN", "2")
+    monkeypatch.setenv("ACCELERATE_FP8_AMAX_HISTORY_LEN", "8")
+    monkeypatch.setenv("ACCELERATE_FP8_DELAYED_SCALING", "true")
+    recipe = FP8RecipeKwargs()
+    assert recipe.margin == 2 and recipe.amax_history_len == 8 and recipe.use_delayed_scaling
+
+    monkeypatch.setenv("ACCELERATE_DISPATCH_BATCHES", "true")
+    monkeypatch.setenv("ACCELERATE_EVEN_BATCHES", "false")
+    monkeypatch.setenv("ACCELERATE_USE_SEEDABLE_SAMPLER", "false")
+    dl_cfg = DataLoaderConfiguration()
+    assert dl_cfg.dispatch_batches is True
+    assert dl_cfg.even_batches is False and dl_cfg.use_seedable_sampler is False
+    # Explicit argument wins over env.
+    assert DataLoaderConfiguration(even_batches=True).even_batches is True
+
+    monkeypatch.setenv("ACCELERATE_PROJECT_DIR", "/tmp/proj_env")
+    monkeypatch.setenv("ACCELERATE_CHECKPOINT_TOTAL_LIMIT", "5")
+    proj = ProjectConfiguration()
+    assert proj.project_dir == "/tmp/proj_env" and proj.total_limit == 5

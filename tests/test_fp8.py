@@ -184,3 +184,51 @@ def test_llama_fp8_forward_and_training_step():
         losses.append(float(metrics["loss"]))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0], f"fp8 training did not reduce loss: {losses}"
+
+
+def test_delayed_scaling_auto_threaded():
+    """Accelerator-wired delayed scaling: fp8_state carried in TrainState, history fills."""
+    import dataclasses
+
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import send_to_device
+    from accelerate_tpu.utils.dataclasses import FP8RecipeKwargs
+
+    for s in (AcceleratorState, GradientState, PartialState):
+        s._reset_state()
+    acc = Accelerator(
+        mixed_precision="fp8",
+        kwargs_handlers=[FP8RecipeKwargs(use_delayed_scaling=True, amax_history_len=4)],
+    )
+    cfg = dataclasses.replace(llama.CONFIGS["tiny"], attn_impl="xla", use_fp8=True)
+    state = acc.create_train_state(llama.init_params(cfg), optax.adam(1e-3))
+    assert state.fp8_state is not None
+    step = acc.build_train_step(lambda p, b: llama.loss_fn(p, b, cfg))
+    toks = np.random.default_rng(0).integers(0, cfg.vocab_size, size=(8, 17)).astype(np.int32)
+    batch = send_to_device({"tokens": toks}, acc.mesh)
+    losses = []
+    for _ in range(3):
+        state, m = step(state, batch)
+        losses.append(float(m["loss"]))
+    assert all(np.isfinite(losses)), losses
+    assert int(state.fp8_state.step) == 3
+    hist = np.asarray(state.fp8_state.history)
+    assert (hist[:2, :3] > 0).all(), f"fwd amax history not recorded: {hist}"
+    assert (hist[2] == 0).all(), "grad role must stay on current scaling (zero history)"
+
+
+def test_delayed_scaling_state_not_created_without_flag():
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    for s in (AcceleratorState, GradientState, PartialState):
+        s._reset_state()
+    acc = Accelerator(mixed_precision="fp8")  # current scaling (default recipe)
+    state = acc.create_train_state({"w": jnp.ones((8, 8))}, optax.sgd(0.1))
+    assert state.fp8_state is None

@@ -91,6 +91,10 @@ class TestCachedDecodeParity:
         assert not bool(jnp.any(new_cache["valid"][:, 8:]))
 
 
+@pytest.mark.skipif(
+    __import__("os").environ.get("RUN_SLOW", "0") not in ("1", "true", "yes"),
+    reason="MoE cached-decode parity compiles a full MoE decode graph (~40 s); RUN_SLOW=1",
+)
 class TestMoECachedDecode:
     def test_moe_cached_equals_uncached_when_nothing_drops(self):
         """Decode uses drop-free dense routing; with a capacity factor generous enough that
