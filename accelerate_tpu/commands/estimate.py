@@ -12,6 +12,7 @@ analytically, nothing downloaded but the config json).
 from __future__ import annotations
 
 import argparse
+import os
 import json
 
 from ..utils.modeling import calculate_maximum_sizes
@@ -56,17 +57,39 @@ def _registry_model_sizes(name: str):
 
 
 def _hub_model_sizes(name: str):
+    # Bound hub latency: default HF timeouts retry for ~25 s in egress-less environments
+    # before failing; an estimate CLI should fail fast instead. huggingface_hub binds these
+    # env vars into module constants AT IMPORT, so they must be set before transformers (and
+    # thus huggingface_hub) is first imported — plus a best-effort constant override for
+    # processes that imported it earlier.
+    os.environ.setdefault("HF_HUB_DOWNLOAD_TIMEOUT", "3")
+    os.environ.setdefault("HF_HUB_ETAG_TIMEOUT", "3")
+    # The timeouts above don't bound DNS/connect stalls in egress-less sandboxes (and
+    # huggingface_hub may have bound its constants at an earlier import), so gate the hub
+    # path on a hard-bounded reachability probe: a daemon thread covers getaddrinfo hangs.
+    import socket
+    import threading
+
+    reachable: list[bool] = []
+
+    def _probe():
+        try:
+            socket.create_connection(("huggingface.co", 443), timeout=2).close()
+            reachable.append(True)
+        except OSError:
+            pass
+
+    t = threading.Thread(target=_probe, daemon=True)
+    t.start()
+    t.join(3.0)
+    if not reachable:
+        return None
     try:
         from transformers import AutoConfig
     except ImportError:
         return None
     try:
-        from ..utils.environment import patch_environment
-
-        # Bound hub latency: default HF timeouts retry for ~25 s in egress-less
-        # environments before failing; an estimate CLI should fail fast instead.
-        with patch_environment(HF_HUB_DOWNLOAD_TIMEOUT="3", HF_HUB_ETAG_TIMEOUT="3"):
-            config = AutoConfig.from_pretrained(name, trust_remote_code=False)
+        config = AutoConfig.from_pretrained(name, trust_remote_code=False)
     except Exception:
         return None
     # Analytic decoder-LM parameter count from common config fields.

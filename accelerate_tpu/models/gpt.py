@@ -1,0 +1,380 @@
+"""GPT family (GPT-2 / GPT-J / GPT-NeoX shaped) — the reference's inference-baseline models.
+
+Every published reference baseline is a GPT-family model (GPT-J-6B, GPT-NeoX-20B —
+``/root/reference/benchmarks/big_model_inference/README.md:25-37``), so the framework ships
+the family natively: same functional contract as ``llama.py`` (init_params / forward /
+loss_fn / partition_specs / cached generate), with the GPT architectural differences:
+
+- LayerNorm with bias (not RMSNorm); biased projections.
+- GELU MLP (not SwiGLU) — 2 matmuls per MLP instead of 3.
+- Positions: learned embeddings (``pos="learned"``, GPT-2) or rotary (GPT-J/NeoX).
+- Optional parallel residual (``parallel_residual``, GPT-J/NeoX): attention and MLP both
+  read the same layernorm and add into the residual together — one fewer serial dependency,
+  which on TPU lets XLA overlap the two matmul chains.
+
+Sharding: Megatron column/row layout identical to llama's, composable with fsdp/ZeRO.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..utils.constants import BATCH_AXES, FSDP_AXIS, SEQUENCE_AXIS, TENSOR_AXIS
+
+__all__ = [
+    "GPTConfig",
+    "CONFIGS",
+    "init_params",
+    "forward",
+    "loss_fn",
+    "partition_specs",
+    "init_cache",
+    "forward_cached",
+    "generate",
+    "num_params",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50257
+    d_model: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    d_ff: int = 3072
+    max_seq: int = 2048
+    pos: str = "learned"          # "learned" (gpt2) | "rotary" (gpt-j/neox)
+    rope_theta: float = 10000.0
+    parallel_residual: bool = False  # gpt-j/neox style
+    norm_eps: float = 1e-5
+    dtype: Any = jnp.bfloat16
+    remat: bool = True
+    scan_layers: bool = False
+    tie_embeddings: bool = True   # gpt2 ties lm_head to wte
+
+
+CONFIGS = {
+    "gpt2": GPTConfig(),
+    "gpt2-xl": GPTConfig(d_model=1600, n_layers=48, n_heads=25, d_ff=6400),
+    "gptj-6b": GPTConfig(
+        vocab_size=50400, d_model=4096, n_layers=28, n_heads=16, d_ff=16384,
+        pos="rotary", parallel_residual=True, tie_embeddings=False,
+    ),
+    "gpt-neox-20b": GPTConfig(
+        vocab_size=50432, d_model=6144, n_layers=44, n_heads=64, d_ff=24576,
+        pos="rotary", parallel_residual=True, tie_embeddings=False,
+    ),
+    "tiny": GPTConfig(
+        vocab_size=256, d_model=128, n_layers=2, n_heads=4, d_ff=256, max_seq=128,
+        remat=False,
+    ),
+}
+
+
+def _layer_params(cfg: GPTConfig, key) -> dict:
+    k = jax.random.split(key, 4)
+    D, F = cfg.d_model, cfg.d_ff
+    s = 1.0 / math.sqrt(D)
+    return {
+        "ln_attn": {"scale": jnp.ones((D,), jnp.float32), "bias": jnp.zeros((D,), jnp.float32)},
+        "wqkv": jax.random.normal(k[0], (D, 3 * D), jnp.float32) * s,
+        "b_qkv": jnp.zeros((3 * D,), jnp.float32),
+        "wo": jax.random.normal(k[1], (D, D), jnp.float32) * s,
+        "b_o": jnp.zeros((D,), jnp.float32),
+        "ln_mlp": {"scale": jnp.ones((D,), jnp.float32), "bias": jnp.zeros((D,), jnp.float32)},
+        "w_up": jax.random.normal(k[2], (D, F), jnp.float32) * s,
+        "b_up": jnp.zeros((F,), jnp.float32),
+        "w_down": jax.random.normal(k[3], (F, D), jnp.float32) / math.sqrt(F),
+        "b_down": jnp.zeros((D,), jnp.float32),
+    }
+
+
+def init_params(cfg: GPTConfig, key: Optional[jax.Array] = None) -> dict:
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    keys = jax.random.split(key, cfg.n_layers + 3)
+    scale = 1.0 / math.sqrt(cfg.d_model)
+    params: dict = {
+        "wte": jax.random.normal(keys[0], (cfg.vocab_size, cfg.d_model), jnp.float32) * scale,
+        "layers": [_layer_params(cfg, keys[i + 2]) for i in range(cfg.n_layers)],
+        "ln_f": {
+            "scale": jnp.ones((cfg.d_model,), jnp.float32),
+            "bias": jnp.zeros((cfg.d_model,), jnp.float32),
+        },
+    }
+    if cfg.pos == "learned":
+        params["wpe"] = (
+            jax.random.normal(keys[1], (cfg.max_seq, cfg.d_model), jnp.float32) * scale * 0.1
+        )
+    if cfg.scan_layers:
+        params["layers"] = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *params["layers"])
+    if not cfg.tie_embeddings:
+        params["lm_head"] = (
+            jax.random.normal(keys[-1], (cfg.d_model, cfg.vocab_size), jnp.float32) * scale
+        )
+    return params
+
+
+def partition_specs(cfg: GPTConfig) -> dict:
+    """Megatron layout: qkv/up column-parallel, o/down row-parallel, vocab over (tp, fsdp)."""
+    ln = {"scale": P(), "bias": P()}
+    layer = {
+        "ln_attn": dict(ln),
+        "wqkv": P(None, TENSOR_AXIS),
+        "b_qkv": P(TENSOR_AXIS),
+        "wo": P(TENSOR_AXIS, None),
+        "b_o": P(),
+        "ln_mlp": dict(ln),
+        "w_up": P(None, TENSOR_AXIS),
+        "b_up": P(TENSOR_AXIS),
+        "w_down": P(TENSOR_AXIS, None),
+        "b_down": P(),
+    }
+    if cfg.scan_layers:
+        layer = jax.tree_util.tree_map(
+            lambda spec: P(None, *spec), layer, is_leaf=lambda s: isinstance(s, P)
+        )
+        layers: Any = layer
+    else:
+        layers = [dict(layer) for _ in range(cfg.n_layers)]
+    specs = {
+        "wte": P((TENSOR_AXIS, FSDP_AXIS), None),
+        "layers": layers,
+        "ln_f": dict(ln),
+    }
+    if cfg.pos == "learned":
+        specs["wpe"] = P(None, None)
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, (TENSOR_AXIS, FSDP_AXIS))
+    return specs
+
+
+def _layer_norm(x, ln, eps):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=-1, keepdims=True)
+    var = xf.var(axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (out * ln["scale"] + ln["bias"]).astype(x.dtype)
+
+
+def _rope(x, positions, theta):
+    hd = x.shape[-1]
+    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    angles = positions[..., None].astype(jnp.float32) * freqs
+    cos, sin = jnp.cos(angles)[:, :, None, :], jnp.sin(angles)[:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+
+
+def _qkv(h, layer, positions, cfg: GPTConfig):
+    B, T, D = h.shape
+    hd = cfg.d_model // cfg.n_heads
+    qkv = h @ layer["wqkv"].astype(h.dtype) + layer["b_qkv"].astype(h.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, T, cfg.n_heads, hd)
+    k = k.reshape(B, T, cfg.n_heads, hd)
+    v = v.reshape(B, T, cfg.n_heads, hd)
+    if cfg.pos == "rotary":
+        q, k = _rope(q, positions, cfg.rope_theta), _rope(k, positions, cfg.rope_theta)
+    return q, k, v
+
+
+def _attn_out(probs_v, layer, cfg: GPTConfig, B, T):
+    out = probs_v.reshape(B, T, cfg.d_model)
+    return out @ layer["wo"].astype(out.dtype) + layer["b_o"].astype(out.dtype)
+
+
+def _attention(q, k, v, mask):
+    hd = q.shape[-1]
+    scores = jnp.einsum("bshd,bthd->bhst", q, k) / math.sqrt(hd)
+    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+def _mlp(h, layer, dtype):
+    up = h @ layer["w_up"].astype(dtype) + layer["b_up"].astype(dtype)
+    return jax.nn.gelu(up) @ layer["w_down"].astype(dtype) + layer["b_down"].astype(dtype)
+
+
+def _block(x, layer, positions, mask, cfg: GPTConfig):
+    B, T, D = x.shape
+    h = _layer_norm(x, layer["ln_attn"], cfg.norm_eps)
+    q, k, v = _qkv(h, layer, positions, cfg)
+    attn = _attn_out(_attention(q, k, v, mask[:, None, :, :]), layer, cfg, B, T)
+    if cfg.parallel_residual:
+        # GPT-J/NeoX: MLP reads the SAME pre-norm stream; both branches add at once.
+        h2 = _layer_norm(x, layer["ln_mlp"], cfg.norm_eps)
+        return x + attn + _mlp(h2, layer, x.dtype)
+    x = x + attn
+    h2 = _layer_norm(x, layer["ln_mlp"], cfg.norm_eps)
+    return x + _mlp(h2, layer, x.dtype)
+
+
+def _embed(params, tokens, positions, cfg: GPTConfig):
+    x = params["wte"].astype(cfg.dtype)[tokens]
+    if cfg.pos == "learned":
+        x = x + params["wpe"].astype(cfg.dtype)[positions]
+    return x
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,
+    cfg: GPTConfig,
+    positions: Optional[jax.Array] = None,
+    shard_activations: bool = True,
+) -> jax.Array:
+    """Causal LM: tokens [B, S] → logits [B, S, V] fp32."""
+    from .llama import _maybe_shard
+
+    B, S = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    x = _embed(params, tokens, positions, cfg)
+    if shard_activations:
+        x = _maybe_shard(x, P(BATCH_AXES, SEQUENCE_AXIS, None))
+    mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
+    block = jax.checkpoint(_block, static_argnums=(4,)) if cfg.remat else _block
+    if cfg.scan_layers:
+        def body(carry, layer):
+            out = block(carry, layer, positions, mask, cfg)
+            if shard_activations:
+                out = _maybe_shard(out, P(BATCH_AXES, SEQUENCE_AXIS, None))
+            return out, None
+
+        x, _ = jax.lax.scan(body, x, params["layers"])
+    else:
+        for layer in params["layers"]:
+            x = block(x, layer, positions, mask, cfg)
+    x = _layer_norm(x, params["ln_f"], cfg.norm_eps)
+    head = params["wte"].T if cfg.tie_embeddings else params["lm_head"]
+    return (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+
+
+def loss_fn(params: dict, batch: dict, cfg: GPTConfig, rng=None) -> jax.Array:
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inputs, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    if "mask" in batch:
+        m = batch["mask"][:, 1:].astype(jnp.float32)
+        return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
+    return -jnp.mean(ll)
+
+
+# ----------------------------------------------------------------------- cached generation
+def init_cache(cfg: GPTConfig, batch_size: int, max_len: int, dtype=None) -> dict:
+    dtype = dtype or cfg.dtype
+    hd = cfg.d_model // cfg.n_heads
+    shape = (batch_size, max_len, cfg.n_heads, hd)
+    one = lambda: {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}  # noqa: E731
+    layers = (
+        jax.tree_util.tree_map(lambda x: jnp.broadcast_to(x, (cfg.n_layers, *x.shape)), one())
+        if cfg.scan_layers
+        else [one() for _ in range(cfg.n_layers)]
+    )
+    return {
+        "layers": layers,
+        "valid": jnp.zeros((batch_size, max_len), jnp.bool_),
+        "index": jnp.zeros((), jnp.int32),
+    }
+
+
+def _block_cached(x, layer, kv, index, positions, valid, cfg: GPTConfig):
+    B, T, D = x.shape
+    h = _layer_norm(x, layer["ln_attn"], cfg.norm_eps)
+    q, k, v = _qkv(h, layer, positions, cfg)
+    new_k = jax.lax.dynamic_update_slice(kv["k"], k.astype(kv["k"].dtype), (0, index, 0, 0))
+    new_v = jax.lax.dynamic_update_slice(kv["v"], v.astype(kv["v"].dtype), (0, index, 0, 0))
+    C = new_k.shape[1]
+    hd = q.shape[-1]
+    scores = jnp.einsum("bthd,bchd->bhtc", q, new_k) / math.sqrt(hd)
+    causal = jnp.arange(C)[None, None, :] <= positions[:, :, None]
+    m = (causal & valid[:, None, :])[:, None, :, :]
+    probs = jax.nn.softmax(
+        jnp.where(m, scores, jnp.finfo(scores.dtype).min).astype(jnp.float32), axis=-1
+    ).astype(q.dtype)
+    attn = _attn_out(jnp.einsum("bhtc,bchd->bthd", probs, new_v), layer, cfg, B, T)
+    if cfg.parallel_residual:
+        h2 = _layer_norm(x, layer["ln_mlp"], cfg.norm_eps)
+        out = x + attn + _mlp(h2, layer, x.dtype)
+    else:
+        x = x + attn
+        h2 = _layer_norm(x, layer["ln_mlp"], cfg.norm_eps)
+        out = x + _mlp(h2, layer, x.dtype)
+    return out, {"k": new_k, "v": new_v}
+
+
+def forward_cached(
+    params: dict,
+    tokens: jax.Array,
+    cache: dict,
+    cfg: GPTConfig,
+    token_mask: Optional[jax.Array] = None,
+    last_only: bool = False,
+) -> tuple[jax.Array, dict]:
+    from .llama import _cache_advance
+
+    B, T = tokens.shape
+    index, positions, valid = _cache_advance(cache, tokens, token_mask)
+    x = _embed(params, tokens, positions, cfg)
+    if cfg.scan_layers:
+        def body(carry, layer_and_kv):
+            layer, kv = layer_and_kv
+            out, new_kv = _block_cached(carry, layer, kv, index, positions, valid, cfg)
+            return out, new_kv
+
+        x, new_layers = jax.lax.scan(body, x, (params["layers"], cache["layers"]))
+    else:
+        new_layers = []
+        for layer, kv in zip(params["layers"], cache["layers"]):
+            x, new_kv = _block_cached(x, layer, kv, index, positions, valid, cfg)
+            new_layers.append(new_kv)
+    x = _layer_norm(x, params["ln_f"], cfg.norm_eps)
+    if last_only:
+        x = x[:, -1:, :]
+    head = params["wte"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+    return logits, {"layers": new_layers, "valid": valid, "index": index + T}
+
+
+def generate(
+    params: dict,
+    prompt: jax.Array,
+    cfg: GPTConfig,
+    gen=None,
+    rng: Optional[jax.Array] = None,
+    prompt_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Autoregressive generation (one compiled prefill + decode scan), llama-identical API."""
+    from ..generation import GenerationConfig, generate_loop
+
+    gen = gen or GenerationConfig()
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt_mask is None:
+        prompt_mask = jnp.ones(prompt.shape, jnp.bool_)
+    max_len = -(-(prompt.shape[1] + gen.max_new_tokens) // 64) * 64
+
+    def prefill_fn(p, pr, pm):
+        cache = init_cache(cfg, pr.shape[0], max_len)
+        logits, cache = forward_cached(p, pr, cache, cfg, token_mask=pm, last_only=True)
+        return logits[:, -1, :], cache
+
+    def decode_fn(p, cache, token):
+        logits, cache = forward_cached(p, token[:, None], cache, cfg)
+        return logits[:, -1, :], cache
+
+    return generate_loop(prefill_fn, decode_fn, params, prompt, prompt_mask, gen, rng)
+
+
+def num_params(cfg: GPTConfig) -> int:
+    return sum(int(np.prod(np.shape(l))) for l in jax.tree_util.tree_leaves(init_params(cfg)))

@@ -132,13 +132,14 @@ def partition_specs(cfg: ResNetConfig) -> dict:
 
 def _group_norm(x, gn, groups: int, eps: float = 1e-5):
     B, H, W, C = x.shape
+    out_dtype = x.dtype  # stats in fp32; the output must return to the compute dtype
     g = min(groups, C)
     xg = x.reshape(B, H, W, g, C // g).astype(jnp.float32)
     mean = xg.mean(axis=(1, 2, 4), keepdims=True)
     var = xg.var(axis=(1, 2, 4), keepdims=True)
     xg = (xg - mean) * jax.lax.rsqrt(var + eps)
     x = xg.reshape(B, H, W, C)
-    return (x * gn["scale"] + gn["bias"]).astype(x.dtype)
+    return (x * gn["scale"] + gn["bias"]).astype(out_dtype)
 
 
 def _conv(x, w, stride: int = 1):

@@ -37,8 +37,6 @@ class Subset:
 
 
 def run_fold(accelerator, cfg, dataset, fold_ids, train_ids, args):
-    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
-
     train_dl = DataLoader(
         Subset(dataset, train_ids), batch_size=8, shuffle=True, drop_last=True
     )

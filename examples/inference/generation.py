@@ -23,16 +23,20 @@ import time
 
 
 def build_config(name: str):
-    from accelerate_tpu.models import llama
+    """Named configs: llama presets, a ~0.9B slice, or gpt family (``gpt:<preset>`` — the
+    reference baselines' own architecture family, e.g. ``gpt:gptj-6b``)."""
+    from accelerate_tpu.models import gpt, llama
 
+    if name.startswith("gpt:"):
+        return gpt, gpt.CONFIGS[name.split(":", 1)[1]]
     if name == "1b":
         # The bench.py model: llama3-8B-shaped ~0.9B slice.
-        return dataclasses.replace(
+        return llama, dataclasses.replace(
             llama.CONFIGS["llama3-8b"],
             vocab_size=32768, d_model=2048, n_layers=12, n_heads=16, n_kv_heads=8,
             d_ff=8192, remat=False,
         )
-    return dataclasses.replace(llama.CONFIGS[name], attn_impl="xla")
+    return llama, dataclasses.replace(llama.CONFIGS[name], attn_impl="xla")
 
 
 def main():
@@ -50,19 +54,18 @@ def main():
 
     from accelerate_tpu.big_modeling import cpu_offload, disk_offload
     from accelerate_tpu.generation import GenerationConfig
-    from accelerate_tpu.models import llama
 
-    cfg = build_config(args.config)
+    model, cfg = build_config(args.config)
     gen = GenerationConfig(max_new_tokens=args.max_new_tokens, temperature=0.0)
     prompt = np.random.default_rng(0).integers(
         1, cfg.vocab_size, size=(args.batch, args.prompt_len)
     ).astype(np.int32)
 
     t0 = time.perf_counter()
-    params = llama.init_params(cfg)
+    params = model.init_params(cfg)
     params = jax.block_until_ready(params)
     load_s = time.perf_counter() - t0
-    n_params = llama.num_params(cfg)
+    n_params = model.num_params(cfg)
     print(f"model: {args.config} ({n_params/1e9:.2f}B params) load={load_s:.1f}s "
           f"device={jax.devices()[0].device_kind}")
 
@@ -96,9 +99,15 @@ def main():
     if args.mode in ("all", "memory"):
         ref = report(
             "in-memory",
-            lambda: llama.generate(params, prompt, cfg, gen),
-            lambda: llama.generate(params, prompt, cfg, gen1),
+            lambda: model.generate(params, prompt, cfg, gen),
+            lambda: model.generate(params, prompt, cfg, gen1),
         )
+
+    from accelerate_tpu.models import llama
+
+    if model is not llama and args.mode != "memory":
+        print("offload modes currently stream llama-family blocks; gpt runs in-memory only")
+        args.mode = "memory"
 
     if args.mode in ("all", "cpu"):
         dispatched = cpu_offload(params)
