@@ -518,8 +518,10 @@ class Accelerator:
             return obj
         if _is_torch_module(obj):
             raise NotImplementedError(
-                "torch nn.Module preparation requires the torch bridge "
-                "(accelerate_tpu.interop) — define the model in flax or pass a param pytree."
+                "A live torch nn.Module cannot run under the mesh/jit runtime; migrate its "
+                "STATE instead: accelerate_tpu.interop.torch_module_to_pytree(module) for "
+                "generic state dicts, or models.hf_interop for exact llama/gpt2 conversion "
+                "— then pass the pytree with a JAX forward."
             )
         if _is_params_pytree(obj):
             return self.prepare_params(obj)

@@ -42,17 +42,18 @@ def estimate_command_parser(subparsers=None) -> argparse.ArgumentParser:
 
 def _registry_model_sizes(name: str):
     """(total_bytes_fp32, largest_layer_bytes_fp32) from the in-repo model registry."""
-    from ..models import llama
+    from ..models import gpt, llama
 
-    if name in llama.CONFIGS:
-        import jax
+    for family in (llama, gpt):
+        if name in family.CONFIGS:
+            import jax
 
-        from ..big_modeling import init_empty_weights
+            from ..big_modeling import init_empty_weights
 
-        cfg = llama.CONFIGS[name]
-        abstract = init_empty_weights(llama.init_params, cfg, jax.random.PRNGKey(0))
-        total, (largest, _) = calculate_maximum_sizes(abstract)
-        return total, largest
+            cfg = family.CONFIGS[name]
+            abstract = init_empty_weights(family.init_params, cfg, jax.random.PRNGKey(0))
+            total, (largest, _) = calculate_maximum_sizes(abstract)
+            return total, largest
     return None
 
 
@@ -64,34 +65,43 @@ def _hub_model_sizes(name: str):
     # processes that imported it earlier.
     os.environ.setdefault("HF_HUB_DOWNLOAD_TIMEOUT", "3")
     os.environ.setdefault("HF_HUB_ETAG_TIMEOUT", "3")
-    # The timeouts above don't bound DNS/connect stalls in egress-less sandboxes (and
-    # huggingface_hub may have bound its constants at an earlier import), so gate the hub
-    # path on a hard-bounded reachability probe: a daemon thread covers getaddrinfo hangs.
-    import socket
-    import threading
-
-    reachable: list[bool] = []
-
-    def _probe():
-        try:
-            socket.create_connection(("huggingface.co", 443), timeout=2).close()
-            reachable.append(True)
-        except OSError:
-            pass
-
-    t = threading.Thread(target=_probe, daemon=True)
-    t.start()
-    t.join(3.0)
-    if not reachable:
-        return None
     try:
         from transformers import AutoConfig
     except ImportError:
         return None
+    # Zero-network paths first: a local directory or an already-cached hub config resolve
+    # without touching the network (works fully offline).
+    config = None
     try:
-        config = AutoConfig.from_pretrained(name, trust_remote_code=False)
+        config = AutoConfig.from_pretrained(name, trust_remote_code=False, local_files_only=True)
     except Exception:
-        return None
+        pass
+    if config is None:
+        # Network path, gated on a hard-bounded reachability probe (the env timeouts above
+        # don't cover DNS/connect stalls in egress-less sandboxes, and huggingface_hub may
+        # have bound its constants at an earlier import; the daemon thread bounds
+        # getaddrinfo hangs too).
+        import socket
+        import threading
+
+        reachable: list[bool] = []
+
+        def _probe():
+            try:
+                socket.create_connection(("huggingface.co", 443), timeout=2).close()
+                reachable.append(True)
+            except OSError:
+                pass
+
+        t = threading.Thread(target=_probe, daemon=True)
+        t.start()
+        t.join(3.0)
+        if not reachable:
+            return None
+        try:
+            config = AutoConfig.from_pretrained(name, trust_remote_code=False)
+        except Exception:
+            return None
     # Analytic decoder-LM parameter count from common config fields.
     d = getattr(config, "hidden_size", None)
     L = getattr(config, "num_hidden_layers", None)

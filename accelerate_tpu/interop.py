@@ -1,0 +1,98 @@
+"""torch ↔ JAX interop bridge — state-dict conversion for migrating reference users.
+
+The reference prepares live torch modules; under a jit/mesh runtime the *computation* must
+be a JAX function, so what migrates is the STATE: these helpers convert any torch module's
+parameters to a numpy/JAX pytree (nested by the module tree, linear weights transposed to
+the ``x @ w`` convention on request) and back. For the shipped model families use the
+exact, logits-parity-tested converters in ``models.hf_interop`` instead
+(LlamaForCausalLM, GPT2LMHeadModel).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional
+
+import numpy as np
+
+from .utils.imports import is_torch_available
+
+__all__ = [
+    "torch_state_dict_to_pytree",
+    "pytree_to_torch_state_dict",
+    "torch_module_to_pytree",
+    "linear_weight_keys",
+]
+
+
+def torch_state_dict_to_pytree(
+    state_dict: Mapping[str, Any],
+    sep: str = ".",
+    linear_keys: Optional[set[str]] = None,
+) -> dict:
+    """Flat ``{"a.b.weight": tensor}`` → nested ``{"a": {"b": {"weight": array}}}``.
+
+    ``linear_keys``: full key names whose tensors are torch ``Linear`` weights (``[out,
+    in]``) to transpose into the ``x @ w`` convention. It must be explicit — "every 2-D
+    'weight'" would also transpose embeddings and similar tables, which silently corrupts
+    lookups. :func:`torch_module_to_pytree` derives the set from the module types.
+    """
+    linear_keys = linear_keys or set()
+    nested: dict = {}
+    for key, value in state_dict.items():
+        arr = value.detach().cpu().numpy() if hasattr(value, "detach") else np.asarray(value)
+        if key in linear_keys:
+            if arr.ndim != 2:
+                raise ValueError(f"linear key {key!r} has ndim {arr.ndim}, expected 2")
+            arr = arr.T
+        node = nested
+        parts = key.split(sep)
+        for part in parts[:-1]:
+            node = node.setdefault(part, {})
+        node[parts[-1]] = arr
+    return nested
+
+
+def pytree_to_torch_state_dict(
+    tree: Any, sep: str = ".", linear_keys: Optional[set[str]] = None
+) -> dict:
+    """Inverse of :func:`torch_state_dict_to_pytree` (returns torch tensors)."""
+    if not is_torch_available():
+        raise ImportError("torch is required for pytree_to_torch_state_dict")
+    import torch
+
+    linear_keys = linear_keys or set()
+    flat: dict = {}
+
+    def walk(node, prefix):
+        if isinstance(node, Mapping):
+            for k, v in node.items():
+                walk(v, f"{prefix}{sep}{k}" if prefix else str(k))
+            return
+        arr = np.asarray(node)
+        if prefix in linear_keys:
+            arr = arr.T
+        flat[prefix] = torch.from_numpy(np.ascontiguousarray(arr))
+
+    walk(tree, "")
+    return flat
+
+
+def linear_weight_keys(module) -> set[str]:
+    """Full state-dict keys of ``nn.Linear`` weights in a module tree."""
+    import torch
+
+    return {
+        f"{name}.weight" if name else "weight"
+        for name, sub in module.named_modules()
+        if isinstance(sub, torch.nn.Linear)
+    }
+
+
+def torch_module_to_pytree(module, transpose_linear: bool = False) -> dict:
+    """``nn.Module`` → nested numpy pytree of its parameters and buffers.
+
+    ``transpose_linear=True`` transposes exactly the ``nn.Linear`` weights (identified from
+    the module types, so embeddings and other 2-D tables are untouched).
+    """
+    keys = linear_weight_keys(module) if transpose_linear else None
+    return torch_state_dict_to_pytree(module.state_dict(), linear_keys=keys)

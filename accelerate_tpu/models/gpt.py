@@ -347,6 +347,28 @@ def forward_cached(
     return logits, {"layers": new_layers, "valid": valid, "index": index + T}
 
 
+def _make_gen_fns(cfg: GPTConfig, max_len: int):
+    def prefill_fn(p, pr, pm):
+        cache = init_cache(cfg, pr.shape[0], max_len)
+        logits, cache = forward_cached(p, pr, cache, cfg, token_mask=pm, last_only=True)
+        return logits[:, -1, :], cache
+
+    def decode_fn(p, cache, token):
+        logits, cache = forward_cached(p, token[:, None], cache, cfg)
+        return logits[:, -1, :], cache
+
+    return prefill_fn, decode_fn
+
+
+# Stable (prefill, decode) closure identities per (cfg, bucketed max_len): generate_loop
+# jit-caches by function identity, so fresh closures per call would recompile every time
+# (same bounded-LRU pattern as llama._GEN_FNS).
+from collections import OrderedDict  # noqa: E402
+
+_GEN_FNS: OrderedDict = OrderedDict()
+_GEN_FNS_MAX = 16
+
+
 def generate(
     params: dict,
     prompt: jax.Array,
@@ -363,18 +385,28 @@ def generate(
     if prompt_mask is None:
         prompt_mask = jnp.ones(prompt.shape, jnp.bool_)
     max_len = -(-(prompt.shape[1] + gen.max_new_tokens) // 64) * 64
-
-    def prefill_fn(p, pr, pm):
-        cache = init_cache(cfg, pr.shape[0], max_len)
-        logits, cache = forward_cached(p, pr, cache, cfg, token_mask=pm, last_only=True)
-        return logits[:, -1, :], cache
-
-    def decode_fn(p, cache, token):
-        logits, cache = forward_cached(p, token[:, None], cache, cfg)
-        return logits[:, -1, :], cache
-
+    key = (cfg, max_len)
+    if key not in _GEN_FNS:
+        _GEN_FNS[key] = _make_gen_fns(cfg, max_len)
+        while len(_GEN_FNS) > _GEN_FNS_MAX:
+            _GEN_FNS.popitem(last=False)
+    _GEN_FNS.move_to_end(key)
+    prefill_fn, decode_fn = _GEN_FNS[key]
     return generate_loop(prefill_fn, decode_fn, params, prompt, prompt_mask, gen, rng)
 
 
 def num_params(cfg: GPTConfig) -> int:
-    return sum(int(np.prod(np.shape(l))) for l in jax.tree_util.tree_leaves(init_params(cfg)))
+    """Analytic parameter count — never materializes the model (gpt-neox-20b is 80 GB fp32)."""
+    D, F, V, L = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_layers
+    per_layer = (
+        D * 3 * D + 3 * D      # wqkv + bias
+        + D * D + D            # wo + bias
+        + 2 * D * F + F + D    # w_up/w_down + biases
+        + 4 * D                # two layernorms (scale + bias)
+    )
+    total = V * D + L * per_layer + 2 * D  # wte + layers + ln_f
+    if cfg.pos == "learned":
+        total += cfg.max_seq * D
+    if not cfg.tie_embeddings:
+        total += D * V
+    return total

@@ -1,0 +1,164 @@
+"""HuggingFace checkpoint interop — load reference-ecosystem weights into the mesh runtime.
+
+A user switching from the reference keeps their checkpoints: ``transformers`` state dicts
+(LlamaForCausalLM, GPT2LMHeadModel) convert to this framework's param pytrees and back.
+Torch linear layers store ``[out, in]`` (transposed here to our ``x @ w`` convention);
+GPT-2's ``Conv1D`` already stores ``[in, out]`` and passes through.
+
+Reference analog: the reference leans on ``transformers`` directly (its models ARE torch
+modules); here the conversion is an explicit, tested mapping. Combine with
+``utils/serialization.load_flat_safetensors`` / ``utils/modeling.load_checkpoint_in_model``
+to stream sharded checkpoint files.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Mapping
+
+import numpy as np
+import jax.numpy as jnp
+
+__all__ = [
+    "llama_config_from_hf",
+    "llama_from_hf",
+    "gpt2_config_from_hf",
+    "gpt2_from_hf",
+]
+
+
+def _np(t) -> np.ndarray:
+    """torch tensor / np array → np array (without importing torch)."""
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().float().numpy()
+    return np.asarray(t)
+
+
+def llama_config_from_hf(hf_config: Any, **overrides):
+    """LlamaConfig from a transformers LlamaConfig (object or dict)."""
+    from .llama import LlamaConfig
+
+    get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, Mapping) else (
+        lambda k, d=None: getattr(hf_config, k, d)
+    )
+    kwargs = dict(
+        vocab_size=get("vocab_size"),
+        d_model=get("hidden_size"),
+        n_layers=get("num_hidden_layers"),
+        n_heads=get("num_attention_heads"),
+        n_kv_heads=get("num_key_value_heads") or get("num_attention_heads"),
+        d_ff=get("intermediate_size"),
+        max_seq=get("max_position_embeddings", 4096),
+        rope_theta=float(get("rope_theta", 10000.0)),
+        norm_eps=float(get("rms_norm_eps", 1e-5)),
+        tie_embeddings=bool(get("tie_word_embeddings", False)),
+    )
+    kwargs.update(overrides)
+    return LlamaConfig(**kwargs)
+
+
+def llama_from_hf(state_dict: Mapping[str, Any], cfg) -> dict:
+    """transformers LlamaForCausalLM state dict → ``models.llama`` params pytree."""
+    sd = {k: v for k, v in state_dict.items()}
+
+    def take(name):
+        return _np(sd[name])
+
+    params: dict = {
+        "embed": take("model.embed_tokens.weight"),
+        "ln_f": take("model.norm.weight"),
+        "layers": [],
+    }
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        params["layers"].append({
+            "ln_attn": take(p + "input_layernorm.weight"),
+            "wq": take(p + "self_attn.q_proj.weight").T,
+            "wk": take(p + "self_attn.k_proj.weight").T,
+            "wv": take(p + "self_attn.v_proj.weight").T,
+            "wo": take(p + "self_attn.o_proj.weight").T,
+            "ln_mlp": take(p + "post_attention_layernorm.weight"),
+            "w_gate": take(p + "mlp.gate_proj.weight").T,
+            "w_up": take(p + "mlp.up_proj.weight").T,
+            "w_down": take(p + "mlp.down_proj.weight").T,
+        })
+    if not cfg.tie_embeddings:
+        head = sd.get("lm_head.weight")
+        params["lm_head"] = (
+            _np(head).T if head is not None else params["embed"].T.copy()
+        )
+    if cfg.scan_layers:
+        params["layers"] = _stack_layers(params["layers"])
+    return _to_jnp(params)
+
+
+def gpt2_config_from_hf(hf_config: Any, **overrides):
+    """GPTConfig from a transformers GPT2Config (object or dict)."""
+    from .gpt import GPTConfig
+
+    get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, Mapping) else (
+        lambda k, d=None: getattr(hf_config, k, d)
+    )
+    kwargs = dict(
+        vocab_size=get("vocab_size"),
+        d_model=get("n_embd"),
+        n_layers=get("n_layer"),
+        n_heads=get("n_head"),
+        d_ff=get("n_inner") or 4 * get("n_embd"),
+        max_seq=get("n_positions", 1024),
+        pos="learned",
+        norm_eps=float(get("layer_norm_epsilon", 1e-5)),
+        tie_embeddings=True,
+    )
+    kwargs.update(overrides)
+    return GPTConfig(**kwargs)
+
+
+def gpt2_from_hf(state_dict: Mapping[str, Any], cfg) -> dict:
+    """transformers GPT2LMHeadModel state dict → ``models.gpt`` params pytree.
+
+    GPT-2's Conv1D stores ``[in, out]`` — no transpose needed, unlike torch Linear.
+    """
+    sd = {re.sub(r"^transformer\.", "", k): v for k, v in state_dict.items()}
+
+    def take(name):
+        return _np(sd[name])
+
+    params: dict = {
+        "wte": take("wte.weight"),
+        "wpe": take("wpe.weight"),
+        "ln_f": {"scale": take("ln_f.weight"), "bias": take("ln_f.bias")},
+        "layers": [],
+    }
+    for i in range(cfg.n_layers):
+        p = f"h.{i}."
+        params["layers"].append({
+            "ln_attn": {"scale": take(p + "ln_1.weight"), "bias": take(p + "ln_1.bias")},
+            "wqkv": take(p + "attn.c_attn.weight"),
+            "b_qkv": take(p + "attn.c_attn.bias"),
+            "wo": take(p + "attn.c_proj.weight"),
+            "b_o": take(p + "attn.c_proj.bias"),
+            "ln_mlp": {"scale": take(p + "ln_2.weight"), "bias": take(p + "ln_2.bias")},
+            "w_up": take(p + "mlp.c_fc.weight"),
+            "b_up": take(p + "mlp.c_fc.bias"),
+            "w_down": take(p + "mlp.c_proj.weight"),
+            "b_down": take(p + "mlp.c_proj.bias"),
+        })
+    if not cfg.tie_embeddings:
+        head = sd.get("lm_head.weight")
+        params["lm_head"] = _np(head).T if head is not None else params["wte"].T.copy()
+    if cfg.scan_layers:
+        params["layers"] = _stack_layers(params["layers"])
+    return _to_jnp(params)
+
+
+def _stack_layers(layers):
+    import jax
+
+    return jax.tree_util.tree_map(lambda *ls: np.stack(ls), *layers)
+
+
+def _to_jnp(tree):
+    import jax
+
+    return jax.tree_util.tree_map(lambda x: jnp.asarray(x), tree)

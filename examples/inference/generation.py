@@ -96,18 +96,19 @@ def main():
         print(json.dumps(row))
         return out
 
+    from accelerate_tpu.models import llama
+
+    if model is not llama and args.mode != "memory":
+        # Must be decided BEFORE dispatching modes, or `--mode cpu` would skip everything.
+        print("offload modes currently stream llama-family blocks; gpt runs in-memory only")
+        args.mode = "memory"
+
     if args.mode in ("all", "memory"):
         ref = report(
             "in-memory",
             lambda: model.generate(params, prompt, cfg, gen),
             lambda: model.generate(params, prompt, cfg, gen1),
         )
-
-    from accelerate_tpu.models import llama
-
-    if model is not llama and args.mode != "memory":
-        print("offload modes currently stream llama-family blocks; gpt runs in-memory only")
-        args.mode = "memory"
 
     if args.mode in ("all", "cpu"):
         dispatched = cpu_offload(params)
