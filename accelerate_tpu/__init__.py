@@ -20,6 +20,7 @@ from .big_modeling import (
     load_checkpoint_and_dispatch,
 )
 from .generation import GenerationConfig, generate_loop, sample_logits
+from .inference import prepare_pippy
 from .launchers import debug_launcher, notebook_launcher
 from .local_sgd import LocalSGD
 from .logging import get_logger

@@ -1,0 +1,110 @@
+"""Pipelined model inference — the ``prepare_pippy`` analog (L6).
+
+Reference ``inference.py`` (/root/reference/src/accelerate/inference.py): ``prepare_pippy``
+(:124) wraps a torch model so its forward runs as a GPipe schedule over
+``torch.distributed.pipelining`` with auto split points (:164) and microbatched forward
+(:99). Here the same capability is a function factory over the mesh's ``pp`` axis: stage
+splitting is a reshape of the scan-stacked layer params (no tracing/split-point search —
+the layer dim IS the split axis), the schedule is the differentiable collective-permute
+pipeline from ``parallel/pp.py``, and the returned callable is one jitted XLA program.
+
+Unlike the reference (inference-only), the same pipeline trains — see
+``models.llama.loss_fn_pp``. This module is the inference-facing wrapper.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from .utils.constants import PIPELINE_AXIS
+
+__all__ = ["prepare_pippy", "pipeline_forward_fn"]
+
+
+def pipeline_forward_fn(
+    stage_fn: Callable,
+    mesh,
+    num_microbatches: Optional[int] = None,
+):
+    """Generic pipelined forward over shape-stable stages (``make_pipeline_fn`` re-export)."""
+    from .parallel.pp import make_pipeline_fn
+
+    return make_pipeline_fn(mesh, stage_fn, num_microbatches=num_microbatches)
+
+
+def prepare_pippy(
+    params: dict,
+    cfg,
+    mesh=None,
+    num_microbatches: Optional[int] = None,
+    split_points: str = "auto",
+):
+    """llama-family params → (stage-sharded params, jitted pipelined logits fn).
+
+    - ``params``: ``models.llama`` params with per-layer list OR scan-stacked layers; they
+      are stage-stacked ``[n_stages, L/n, ...]`` and placed with
+      ``partition_specs(cfg, pp=True)`` (stage dim over the mesh ``pp`` axis).
+    - ``split_points="auto"``: layers divide evenly over stages (the reference's
+      auto-balancing, ``inference.py:164-168``, degenerates to this when blocks are uniform
+      — a transformer's are).
+    - Returns ``(pp_params, forward)`` with ``forward(tokens [B, S]) -> logits [B, S, V]``
+      (fp32), ``B`` divisible by the microbatch count.
+    """
+    import dataclasses
+
+    from jax.sharding import NamedSharding
+    from .models import llama
+    from .parallel.pp import split_params_into_stages, stack_stage_params
+
+    if mesh is None:
+        from .state import AcceleratorState
+
+        mesh = AcceleratorState().mesh
+    n_stages = mesh.shape[PIPELINE_AXIS]
+    if split_points != "auto":
+        raise ValueError("only split_points='auto' (even layer split) is supported")
+    if getattr(cfg, "moe_experts", 0) > 0:
+        # Fail BEFORE stage-stacking + device_put commits HBM for every expert weight
+        # (forward_pp would reject it anyway, but only at first call).
+        raise NotImplementedError("pipeline inference currently supports dense MLPs only")
+
+    if not cfg.scan_layers:
+        cfg = dataclasses.replace(cfg, scan_layers=True)
+    layers = params["layers"]
+    if isinstance(layers, (list, tuple)):
+        layers = stack_stage_params(list(layers))  # [L, ...]
+    pp_params = dict(params)
+    pp_params["layers"] = (
+        layers if _leading(layers) == n_stages and _second_dim_known(layers, cfg, n_stages)
+        else split_params_into_stages(layers, n_stages)
+    )
+    specs = llama.partition_specs(cfg, pp=True)
+    pp_params = jax.tree_util.tree_map(
+        lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)), pp_params, specs
+    )
+
+    def forward(tokens: jax.Array) -> jax.Array:
+        x = llama.forward_pp(
+            pp_params, tokens, cfg, mesh, num_microbatches=num_microbatches
+        )
+        head = pp_params["embed"].T if cfg.tie_embeddings else pp_params["lm_head"]
+        return (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+
+    jitted = jax.jit(forward)
+
+    def with_mesh(tokens):
+        with jax.set_mesh(mesh):
+            return jitted(jnp.asarray(tokens, jnp.int32))
+
+    return pp_params, with_mesh
+
+
+def _leading(tree) -> int:
+    return jax.tree_util.tree_leaves(tree)[0].shape[0]
+
+
+def _second_dim_known(tree, cfg, n_stages: int) -> bool:
+    return jax.tree_util.tree_leaves(tree)[0].shape[1] == cfg.n_layers // n_stages

@@ -78,6 +78,7 @@ def test_complete_nlp_example(tmp_path, capsys, monkeypatch):
         ("gradient_accumulation_for_autoregressive_models.py", "window tokens="),
         ("schedule_free.py", "schedule-free eval params"),
         ("ddp_comm_hook.py", "gradient reduction dtype: bfloat16"),
+        ("sequence_parallelism.py", "long-context training OK"),
     ],
 )
 def test_by_feature(name, expect, capsys, monkeypatch):
