@@ -145,7 +145,12 @@ def save_accelerator_state(
     meta["schedulers"] = schedulers
     samplers = []
     for dl in accelerator._dataloaders:
-        samplers.append({"iteration": getattr(dl, "iteration", 0)})
+        if getattr(dl, "stateful", False) and hasattr(dl, "state_dict"):
+            # Stateful mode: epoch AND mid-epoch position (torchdata StatefulDataLoader
+            # analog, reference checkpointing.py:135-139).
+            samplers.append(dl.state_dict())
+        else:
+            samplers.append({"iteration": getattr(dl, "iteration", 0)})
     meta["dataloaders"] = samplers
     if accelerator.is_main_process:
         (path / SCHEDULER_STATE_NAME).write_text(json.dumps(meta, indent=2))
@@ -230,7 +235,9 @@ def load_accelerator_state(
                 except Exception:
                     logger.warning("Could not restore a scheduler state", main_process_only=True)
         for dl, sd in zip(accelerator._dataloaders, meta.get("dataloaders", [])):
-            if hasattr(dl, "set_epoch"):
+            if getattr(dl, "stateful", False) and hasattr(dl, "load_state_dict"):
+                dl.load_state_dict(sd)
+            elif hasattr(dl, "set_epoch"):
                 dl.set_epoch(sd.get("iteration", 0))
 
     for i, obj in enumerate(accelerator._custom_objects):

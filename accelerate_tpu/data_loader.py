@@ -456,6 +456,7 @@ class DataLoaderShard(_PreparedDataLoader):
         synchronized_generator=None,
         skip_batches: int = 0,
         _non_blocking: bool = False,
+        stateful: bool = False,
         **kwargs,
     ):
         super().__init__(
@@ -467,6 +468,15 @@ class DataLoaderShard(_PreparedDataLoader):
         self.dataloader = dataloader
         self.skip_batches = skip_batches
         self.iteration = 0
+        # Stateful-resume bookkeeping (the torchdata StatefulDataLoader analog, reference
+        # checkpointing.py:135-139): ``batches_yielded`` tracks position within the CURRENT
+        # epoch; ``_resume_batches`` is the ONE-SHOT skip armed exclusively by
+        # load_state_dict (a live counter must never be misread as a resume — peeking a
+        # batch or breaking early would otherwise silently skip data next epoch). Enabled
+        # by prepare_data_loader(use_stateful_dataloader=True).
+        self.stateful = stateful
+        self.batches_yielded = 0
+        self._resume_batches = 0
 
     @property
     def dataset(self):
@@ -477,7 +487,7 @@ class DataLoaderShard(_PreparedDataLoader):
         return getattr(self.dataloader, "batch_sampler", None)
 
     def __len__(self) -> int:
-        return len(self.dataloader) - self.skip_batches
+        return len(self.dataloader) - self.skip_batches - self._resume_batches
 
     @property
     def total_batch_size(self) -> int:
@@ -510,6 +520,12 @@ class DataLoaderShard(_PreparedDataLoader):
             synchronize_rng_states(rng_types, self.synchronized_generator)
         self.begin()
         try:
+            skip = self.skip_batches
+            if self._resume_batches and not self.skip_batches:
+                # Mid-epoch resume armed by load_state_dict; consumed exactly once.
+                skip = self._resume_batches
+                self._resume_batches = 0
+            self.batches_yielded = 0
             dataloader_iter = iter(self.dataloader)
             # Prefetch one batch ahead to detect the end before yielding the last batch.
             try:
@@ -525,15 +541,29 @@ class DataLoaderShard(_PreparedDataLoader):
                 if next_batch is None:
                     self.end_of_dataloader = True
                     self.remainder = self._final_remainder()
-                if batch_index >= self.skip_batches:
+                if batch_index >= skip:
+                    # Count BEFORE the yield: the generator suspends there, so a state_dict
+                    # taken between batches must already include the batch just handed out.
+                    self.batches_yielded = batch_index + 1
                     yield self._place(current_batch)
                 if next_batch is None:
                     break
                 current_batch = next_batch
                 batch_index += 1
             self.iteration += 1
+            self.batches_yielded = 0
         finally:
             self.end()
+
+    def state_dict(self) -> dict:
+        """Resumable position: epoch + batches consumed within it (stateful mode)."""
+        return {"iteration": self.iteration, "batches_yielded": self.batches_yielded}
+
+    def load_state_dict(self, state: dict) -> None:
+        self.iteration = int(state.get("iteration", 0))
+        self.batches_yielded = int(state.get("batches_yielded", 0))
+        self._resume_batches = self.batches_yielded
+        self.set_epoch(self.iteration)
 
     def _final_remainder(self) -> int:
         length = self.total_dataset_length
@@ -730,6 +760,7 @@ def skip_first_batches(dataloader, num_batches: int = 0):
             synchronized_generator=dataloader.synchronized_generator,
             skip_batches=num_batches,
             _non_blocking=dataloader.non_blocking,
+            stateful=dataloader.stateful,
         )
     return SkipDataLoader(dataloader, skip_batches=num_batches)
 
@@ -779,6 +810,13 @@ def prepare_data_loader(
         process_index = state.process_index
     if dispatch_batches is None:
         dispatch_batches = False
+    if dispatch_batches and use_stateful_dataloader:
+        # A silent epoch-granularity degrade would replay trained batches after preemption.
+        raise ValueError(
+            "use_stateful_dataloader (mid-epoch resume) is not implemented for "
+            "dispatch_batches=True loaders; use shard mode or checkpoint at epoch "
+            "boundaries."
+        )
 
     # torch DataLoader → re-wrap into the framework DataLoader with the same pieces.
     synchronized_generator = None
@@ -824,6 +862,7 @@ def prepare_data_loader(
             rng_types=rng_types,
             synchronized_generator=synchronized_generator,
             _non_blocking=non_blocking,
+            stateful=use_stateful_dataloader,
         )
 
     if is_map_style and hasattr(dataloader, "batch_sampler"):
@@ -845,6 +884,7 @@ def prepare_data_loader(
             rng_types=rng_types,
             synchronized_generator=synchronized_generator,
             _non_blocking=non_blocking,
+            stateful=use_stateful_dataloader,
         )
 
     # Iterable dataset path.
@@ -863,6 +903,7 @@ def prepare_data_loader(
         device=device if put_on_device else None,
         rng_types=rng_types,
         _non_blocking=non_blocking,
+        stateful=use_stateful_dataloader,
     )
 
 

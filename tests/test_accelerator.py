@@ -79,7 +79,7 @@ def test_prepare_params_fsdp_sharded():
 def test_prepare_torch_module_raises():
     torch = pytest.importorskip("torch")
     acc = make_accelerator()
-    with pytest.raises(NotImplementedError, match="torch bridge"):
+    with pytest.raises(NotImplementedError, match="torch_module_to_pytree"):
         acc.prepare(torch.nn.Linear(2, 2))
 
 

@@ -296,3 +296,108 @@ def test_default_collate_nested():
     out = default_collate([{"a": (1, np.ones(2))}, {"a": (2, np.zeros(2))}])
     assert out["a"][0].tolist() == [1, 2]
     assert out["a"][1].shape == (2, 2)
+
+
+# ------------------------------------------------------------------ stateful dataloader
+def test_stateful_dataloader_mid_epoch_resume():
+    """use_stateful_dataloader: state_dict captures mid-epoch position; a restored loader
+    resumes at the next batch (torchdata StatefulDataLoader analog)."""
+
+    class DS:
+        def __len__(self):
+            return 24
+
+        def __getitem__(self, i):
+            return {"idx": np.int32(i)}
+
+    dl = DataLoader(DS(), batch_size=4)
+    prepared = prepare_data_loader(dl, put_on_device=False, use_stateful_dataloader=True)
+    assert prepared.stateful
+
+    it = iter(prepared)
+    first = [int(next(it)["idx"][0]) for _ in range(3)]  # consume 3 of 6 batches
+    state = prepared.state_dict()
+    assert state["batches_yielded"] == 3
+
+    # Fresh loader (new process after preemption), restore, resume.
+    resumed = prepare_data_loader(
+        DataLoader(DS(), batch_size=4), put_on_device=False, use_stateful_dataloader=True
+    )
+    resumed.load_state_dict(state)
+    rest = [int(b["idx"][0]) for b in resumed]
+    assert rest == [12, 16, 20], rest  # continues where the original stopped
+    # Next full epoch is NOT skipped.
+    again = [int(b["idx"][0]) for b in resumed]
+    assert len(again) == 6
+
+
+def test_stateful_flag_off_keeps_plain_iteration():
+    class DS:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return {"idx": np.int32(i)}
+
+    prepared = prepare_data_loader(DataLoader(DS(), batch_size=4), put_on_device=False)
+    assert not prepared.stateful
+    _ = [b for b in prepared]
+    assert prepared.state_dict()["batches_yielded"] == 0
+
+
+def test_stateful_peek_or_break_never_skips_data():
+    """Live consumption (peek / early break) must NOT arm a resume skip — only
+    load_state_dict does (one-shot)."""
+
+    class DS:
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return {"idx": np.int32(i)}
+
+    prepared = prepare_data_loader(
+        DataLoader(DS(), batch_size=4), put_on_device=False, use_stateful_dataloader=True
+    )
+    next(iter(prepared))  # peek one batch (shape inference pattern)
+    full = [int(b["idx"][0]) for b in prepared]
+    assert full == [0, 4, 8, 12], full  # nothing skipped
+
+    # Resume skip is one-shot: len() reflects it, and only the first epoch consumes it.
+    prepared.load_state_dict({"iteration": 0, "batches_yielded": 2})
+    assert len(prepared) == 2
+    resumed = [int(b["idx"][0]) for b in prepared]
+    assert resumed == [8, 12]
+    assert len(prepared) == 4
+    again = [int(b["idx"][0]) for b in prepared]
+    assert again == [0, 4, 8, 12]
+
+
+def test_stateful_rejected_for_dispatch_mode():
+    class DS:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return {"idx": np.int32(i)}
+
+    with pytest.raises(ValueError, match="dispatch_batches"):
+        prepare_data_loader(
+            DataLoader(DS(), batch_size=4), put_on_device=False,
+            dispatch_batches=True, use_stateful_dataloader=True,
+        )
+
+
+def test_skip_first_batches_preserves_stateful():
+    class DS:
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return {"idx": np.int32(i)}
+
+    prepared = prepare_data_loader(
+        DataLoader(DS(), batch_size=4), put_on_device=False, use_stateful_dataloader=True
+    )
+    skipped = skip_first_batches(prepared, 2)
+    assert skipped.stateful
