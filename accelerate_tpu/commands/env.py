@@ -51,6 +51,14 @@ def env_command(args) -> dict:
     accelerate_env = {k: v for k, v in os.environ.items() if k.startswith("ACCELERATE_")}
     info["ACCELERATE_* env"] = accelerate_env or "not set"
 
+    from ..utils.environment import get_tpu_info
+
+    tpu = get_tpu_info()
+    for key in ("device_kind", "platform_version", "chip_coords_sample",
+                "hbm_bytes_limit", "hbm_bytes_in_use", "gce_accelerator", "pod_workers"):
+        if key in tpu:
+            info[f"TPU {key}"] = tpu[key]
+
     from .config import default_config_file
 
     path = args.config_file or default_config_file()

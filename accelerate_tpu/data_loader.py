@@ -560,6 +560,12 @@ class DataLoaderShard(_PreparedDataLoader):
         return {"iteration": self.iteration, "batches_yielded": self.batches_yielded}
 
     def load_state_dict(self, state: dict) -> None:
+        if self.skip_batches:
+            raise ValueError(
+                "load_state_dict on a skip_first_batches-wrapped loader is ambiguous "
+                "(two competing resume offsets); restore state on the base loader OR use "
+                "skip_first_batches, not both."
+            )
         self.iteration = int(state.get("iteration", 0))
         self.batches_yielded = int(state.get("batches_yielded", 0))
         self._resume_batches = self.batches_yielded
@@ -816,6 +822,15 @@ def prepare_data_loader(
             "use_stateful_dataloader (mid-epoch resume) is not implemented for "
             "dispatch_batches=True loaders; use shard mode or checkpoint at epoch "
             "boundaries."
+        )
+    if use_stateful_dataloader and not use_seedable_sampler:
+        # Resume-by-count is only sound when the data ORDER is (seed, epoch)-deterministic:
+        # with torch's own generator-driven shuffle, a fresh process reshuffles and the
+        # skipped count lands on different samples (some trained twice, some never).
+        raise ValueError(
+            "use_stateful_dataloader requires use_seedable_sampler=True: mid-epoch resume "
+            "skips by batch count, which is only correct under a deterministic "
+            "(seed, epoch) data order."
         )
 
     # torch DataLoader → re-wrap into the framework DataLoader with the same pieces.

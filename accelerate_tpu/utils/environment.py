@@ -22,6 +22,7 @@ __all__ = [
     "clear_environment",
     "patch_environment",
     "purge_accelerate_environment",
+    "get_tpu_info",
 ]
 
 _TRUE = {"1", "true", "yes", "y", "on"}
@@ -115,3 +116,113 @@ def purge_accelerate_environment(func):
                 os.environ[k] = v
 
     return wrapper
+
+
+# ------------------------------------------------------------------- TPU hardware probes
+def get_tpu_info() -> dict:
+    """TPU topology/metadata introspection (reference's nvidia-smi/NUMA probe analog,
+    ``utils/environment.py:101-290``).
+
+    Sources, all failure-tolerated: live jax devices (kind, coords, memory stats), the
+    TPU_*/JAX_* env contract a TPU VM image sets, and the GCE metadata server when
+    reachable (accelerator-type / pod hostnames — a bounded 1 s probe, skipped offline).
+    """
+    info: dict = {}
+    # jax backend init can block indefinitely (single-client libtpu held by a training
+    # job, or a wedged multi-host rendezvous) — the one scenario a diagnostic command must
+    # survive. Bound it like the metadata probe: daemon thread + timeout.
+    import threading
+
+    probe_result: list = []
+
+    def _jax_probe():
+        try:
+            import jax
+
+            probe_result.append((jax.devices(), jax.default_backend(), jax.device_count(),
+                                 jax.local_device_count(), jax.process_count()))
+        except Exception as e:
+            probe_result.append(e)
+
+    t = threading.Thread(target=_jax_probe, daemon=True)
+    t.start()
+    t.join(20.0)
+    if not probe_result:
+        info["backend_error"] = "jax backend init timed out after 20s (device busy or tunnel down)"
+        probe_result.append(None)
+    try:
+        first = probe_result[0]
+        if isinstance(first, Exception):
+            raise first
+        if first is None:
+            raise RuntimeError(info["backend_error"])
+        devices, backend, dev_count, local_count, proc_count = first
+        info["backend"] = backend
+        info["device_count"] = dev_count
+        info["local_device_count"] = local_count
+        info["process_count"] = proc_count
+        if devices:
+            d = devices[0]
+            info["device_kind"] = getattr(d, "device_kind", "unknown")
+            info["platform_version"] = getattr(d, "client", None) and getattr(
+                d.client, "platform_version", "unknown"
+            )
+            coords = getattr(d, "coords", None)
+            if coords is not None:
+                info["chip_coords_sample"] = tuple(coords)
+            core = getattr(d, "core_on_chip", None)
+            if core is not None:
+                info["core_on_chip_sample"] = core
+            try:
+                stats = d.memory_stats() or {}
+                if "bytes_limit" in stats:
+                    info["hbm_bytes_limit"] = int(stats["bytes_limit"])
+                if "bytes_in_use" in stats:
+                    info["hbm_bytes_in_use"] = int(stats["bytes_in_use"])
+            except Exception:
+                pass
+    except Exception as e:  # pragma: no cover - no backend in exotic environments
+        info["backend_error"] = str(e).splitlines()[0][:200]
+
+    tpu_env = {
+        k: v
+        for k, v in os.environ.items()
+        if k.startswith(("TPU_", "JAX_", "LIBTPU", "XLA_FLAGS"))
+    }
+    if tpu_env:
+        info["tpu_env"] = tpu_env
+
+    # Only the TPU-specific attribute: a machine-type fallback would mislabel plain GCE
+    # VMs as TPU hardware in bug reports.
+    meta = _gce_metadata("instance/attributes/accelerator-type")
+    if meta:
+        info["gce_accelerator"] = meta.rsplit("/", 1)[-1]
+        workers = _gce_metadata("instance/attributes/worker-network-endpoints")
+        if workers:
+            info["pod_workers"] = workers
+    return info
+
+
+def _gce_metadata(path: str, timeout: float = 1.0):
+    """Bounded GCE metadata-server read; None when unreachable (non-GCE / offline)."""
+    import threading
+
+    result: list = []
+
+    def _probe():
+        try:
+            import urllib.request
+
+            req = urllib.request.Request(
+                f"http://metadata.google.internal/computeMetadata/v1/{path}",
+                headers={"Metadata-Flavor": "Google"},
+            )
+            with urllib.request.urlopen(req, timeout=timeout) as resp:  # noqa: S310
+                result.append(resp.read().decode())
+        except Exception:
+            pass
+
+    t = threading.Thread(target=_probe, daemon=True)
+    t.start()
+    t.join(timeout + 0.5)
+    return result[0] if result else None

@@ -401,3 +401,34 @@ def test_skip_first_batches_preserves_stateful():
     )
     skipped = skip_first_batches(prepared, 2)
     assert skipped.stateful
+
+
+def test_stateful_requires_deterministic_order():
+    class DS:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return {"idx": np.int32(i)}
+
+    with pytest.raises(ValueError, match="seedable"):
+        prepare_data_loader(
+            DataLoader(DS(), batch_size=4), put_on_device=False,
+            use_stateful_dataloader=True, use_seedable_sampler=False,
+        )
+
+
+def test_stateful_restore_refused_on_skip_wrapped_loader():
+    class DS:
+        def __len__(self):
+            return 16
+
+        def __getitem__(self, i):
+            return {"idx": np.int32(i)}
+
+    prepared = prepare_data_loader(
+        DataLoader(DS(), batch_size=4), put_on_device=False, use_stateful_dataloader=True
+    )
+    skipped = skip_first_batches(prepared, 2)
+    with pytest.raises(ValueError, match="ambiguous"):
+        skipped.load_state_dict({"iteration": 0, "batches_yielded": 1})

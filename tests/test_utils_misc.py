@@ -118,3 +118,14 @@ class TestProfile:
         assert seen["dir"] == str(tmp_path / "trace")
         # jax.profiler.trace writes a plugins/profile/<ts>/ tree
         assert any(os.scandir(tmp_path / "trace"))
+
+
+def test_get_tpu_info_probes():
+    from accelerate_tpu.utils.environment import get_tpu_info
+
+    info = get_tpu_info()
+    assert info["backend"] == "cpu"
+    assert info["device_count"] == 8
+    assert "device_kind" in info
+    # GCE metadata is absent in this sandbox — bounded probe must not raise or hang.
+    assert "gce_accelerator" not in info or isinstance(info["gce_accelerator"], str)
