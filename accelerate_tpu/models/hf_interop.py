@@ -24,6 +24,8 @@ __all__ = [
     "llama_from_hf",
     "gpt2_config_from_hf",
     "gpt2_from_hf",
+    "t5_config_from_hf",
+    "t5_from_hf",
 ]
 
 
@@ -162,3 +164,91 @@ def _to_jnp(tree):
     import jax
 
     return jax.tree_util.tree_map(lambda x: jnp.asarray(x), tree)
+
+
+def t5_config_from_hf(hf_config: Any, **overrides):
+    """T5Config from a transformers T5Config (object or dict)."""
+    from .t5 import T5Config
+
+    get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, Mapping) else (
+        lambda k, d=None: getattr(hf_config, k, d)
+    )
+    proj = str(get("feed_forward_proj", "relu"))
+    if proj not in ("relu", "gated-gelu"):
+        raise NotImplementedError(
+            f"feed_forward_proj={proj!r}: models.t5 implements 'relu' and 'gated-gelu' "
+            "(the T5 / v1.1-T0 lineages); converting would silently change the activation."
+        )
+    kwargs = dict(
+        vocab_size=get("vocab_size"),
+        d_model=get("d_model"),
+        d_kv=get("d_kv"),
+        d_ff=get("d_ff"),
+        n_layers=get("num_layers"),
+        n_decoder_layers=get("num_decoder_layers") or get("num_layers"),
+        n_heads=get("num_heads"),
+        rel_buckets=get("relative_attention_num_buckets", 32),
+        rel_max_distance=get("relative_attention_max_distance", 128),
+        gated_ff="gated" in str(proj),
+        norm_eps=float(get("layer_norm_epsilon", 1e-6)),
+        tie_embeddings=bool(get("tie_word_embeddings", True)),
+        decoder_start_token_id=get("decoder_start_token_id", 0) or 0,
+    )
+    kwargs.update(overrides)
+    return T5Config(**kwargs)
+
+
+def t5_from_hf(state_dict: Mapping[str, Any], cfg) -> dict:
+    """transformers T5ForConditionalGeneration state dict → ``models.t5`` params pytree."""
+    sd = dict(state_dict)
+
+    def take(name):
+        return _np(sd[name])
+
+    def attn(prefix, with_rel):
+        p = {
+            "q": take(prefix + "q.weight").T,
+            "k": take(prefix + "k.weight").T,
+            "v": take(prefix + "v.weight").T,
+            "o": take(prefix + "o.weight").T,
+        }
+        if with_rel:
+            p["rel_bias"] = take(prefix + "relative_attention_bias.weight")
+        return p
+
+    def ff(prefix):
+        if cfg.gated_ff:
+            return {
+                "wi_0": take(prefix + "wi_0.weight").T,
+                "wi_1": take(prefix + "wi_1.weight").T,
+                "wo": take(prefix + "wo.weight").T,
+            }
+        return {"wi": take(prefix + "wi.weight").T, "wo": take(prefix + "wo.weight").T}
+
+    params: dict = {
+        "shared": take("shared.weight"),
+        "encoder": {"blocks": [], "ln_f": take("encoder.final_layer_norm.weight")},
+        "decoder": {"blocks": [], "ln_f": take("decoder.final_layer_norm.weight")},
+    }
+    for i in range(cfg.n_layers):
+        b = f"encoder.block.{i}."
+        params["encoder"]["blocks"].append({
+            "ln_attn": take(b + "layer.0.layer_norm.weight"),
+            "attn": attn(b + "layer.0.SelfAttention.", i == 0),
+            "ln_ff": take(b + "layer.1.layer_norm.weight"),
+            "ff": ff(b + "layer.1.DenseReluDense."),
+        })
+    for i in range(cfg.dec_layers):
+        b = f"decoder.block.{i}."
+        params["decoder"]["blocks"].append({
+            "ln_attn": take(b + "layer.0.layer_norm.weight"),
+            "attn": attn(b + "layer.0.SelfAttention.", i == 0),
+            "ln_cross": take(b + "layer.1.layer_norm.weight"),
+            "cross": attn(b + "layer.1.EncDecAttention.", False),
+            "ln_ff": take(b + "layer.2.layer_norm.weight"),
+            "ff": ff(b + "layer.2.DenseReluDense."),
+        })
+    if not cfg.tie_embeddings:
+        head = sd.get("lm_head.weight")
+        params["lm_head"] = _np(head).T if head is not None else params["shared"].T.copy()
+    return _to_jnp(params)

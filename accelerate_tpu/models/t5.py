@@ -1,0 +1,343 @@
+"""T5 encoder-decoder family — the reference baseline table's T0pp (11B) architecture.
+
+Reference baselines cover decoder-only (GPT-J/NeoX) AND encoder-decoder models (T0pp,
+``/root/reference/benchmarks/big_model_inference/README.md:35``); this module supplies the
+latter natively with the T5 conventions that differ from the other families:
+
+- T5 LayerNorm: RMS, scale-only, NO mean subtraction and NO bias, computed in fp32.
+- Relative position bias (bucketed, log-spaced): a [num_buckets, n_heads] table held by the
+  FIRST block of the encoder and of the decoder, shared by all their blocks; no positional
+  embeddings anywhere else.
+- Attention scores are NOT scaled by 1/sqrt(head_dim) (absorbed into init).
+- Feed-forward: gated-GELU (``wi_0``·gelu × ``wi_1`` → ``wo``, T5 v1.1/T0 lineage) or ReLU.
+- Tied embeddings rescale decoder output by ``d_model**-0.5`` before the vocab projection.
+
+``hf_interop.t5_from_hf`` maps transformers ``T5ForConditionalGeneration`` weights; parity
+is asserted against transformers itself in ``tests/test_hf_interop.py``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..utils.constants import BATCH_AXES, FSDP_AXIS, TENSOR_AXIS
+
+__all__ = [
+    "T5Config",
+    "CONFIGS",
+    "init_params",
+    "encode",
+    "decode",
+    "forward",
+    "loss_fn",
+    "partition_specs",
+    "generate",
+    "num_params",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    d_model: int = 512
+    d_kv: int = 64            # per-head dim (NOT d_model // n_heads in general!)
+    d_ff: int = 1024
+    n_layers: int = 6         # encoder depth
+    n_decoder_layers: Optional[int] = None  # None → n_layers
+    n_heads: int = 8
+    rel_buckets: int = 32
+    rel_max_distance: int = 128
+    gated_ff: bool = True     # gated-gelu (v1.1/T0); False → relu
+    norm_eps: float = 1e-6
+    dtype: Any = jnp.bfloat16
+    tie_embeddings: bool = True
+    remat: bool = False
+    decoder_start_token_id: int = 0
+
+    @property
+    def dec_layers(self) -> int:
+        return self.n_decoder_layers or self.n_layers
+
+
+CONFIGS = {
+    "t5-small-v1_1": T5Config(),
+    "t5-base-v1_1": T5Config(d_model=768, d_ff=2048, n_layers=12, n_heads=12),
+    # T0pp / t5-v1.1-xxl shape — the reference's 11B baseline model.
+    "t0pp": T5Config(d_model=4096, d_kv=64, d_ff=10240, n_layers=24, n_heads=64),
+    "tiny": T5Config(vocab_size=128, d_model=32, d_kv=8, d_ff=64, n_layers=2, n_heads=4),
+}
+
+
+def _attn_params(cfg: T5Config, key, with_rel_bias: bool) -> dict:
+    k = jax.random.split(key, 5)
+    D, inner = cfg.d_model, cfg.n_heads * cfg.d_kv
+    p = {
+        "q": jax.random.normal(k[0], (D, inner), jnp.float32) * (D * cfg.d_kv) ** -0.5,
+        "k": jax.random.normal(k[1], (D, inner), jnp.float32) * D**-0.5,
+        "v": jax.random.normal(k[2], (D, inner), jnp.float32) * D**-0.5,
+        "o": jax.random.normal(k[3], (inner, D), jnp.float32) * inner**-0.5,
+    }
+    if with_rel_bias:
+        p["rel_bias"] = jax.random.normal(
+            k[4], (cfg.rel_buckets, cfg.n_heads), jnp.float32
+        ) * 0.1
+    return p
+
+
+def _ff_params(cfg: T5Config, key) -> dict:
+    k = jax.random.split(key, 3)
+    D, F = cfg.d_model, cfg.d_ff
+    p = {"wo": jax.random.normal(k[2], (F, D), jnp.float32) * F**-0.5}
+    if cfg.gated_ff:
+        p["wi_0"] = jax.random.normal(k[0], (D, F), jnp.float32) * D**-0.5
+        p["wi_1"] = jax.random.normal(k[1], (D, F), jnp.float32) * D**-0.5
+    else:
+        p["wi"] = jax.random.normal(k[0], (D, F), jnp.float32) * D**-0.5
+    return p
+
+
+def init_params(cfg: T5Config, key: Optional[jax.Array] = None) -> dict:
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    n_enc, n_dec = cfg.n_layers, cfg.dec_layers
+    keys = jax.random.split(key, 2 + 2 * n_enc + 3 * n_dec)
+    ki = iter(range(len(keys)))
+    params: dict = {
+        "shared": jax.random.normal(keys[next(ki)], (cfg.vocab_size, cfg.d_model), jnp.float32),
+        "encoder": {"blocks": [], "ln_f": jnp.ones((cfg.d_model,), jnp.float32)},
+        "decoder": {"blocks": [], "ln_f": jnp.ones((cfg.d_model,), jnp.float32)},
+    }
+    for i in range(n_enc):
+        params["encoder"]["blocks"].append({
+            "ln_attn": jnp.ones((cfg.d_model,), jnp.float32),
+            "attn": _attn_params(cfg, keys[next(ki)], with_rel_bias=(i == 0)),
+            "ln_ff": jnp.ones((cfg.d_model,), jnp.float32),
+            "ff": _ff_params(cfg, keys[next(ki)]),
+        })
+    for i in range(n_dec):
+        params["decoder"]["blocks"].append({
+            "ln_attn": jnp.ones((cfg.d_model,), jnp.float32),
+            "attn": _attn_params(cfg, keys[next(ki)], with_rel_bias=(i == 0)),
+            "ln_cross": jnp.ones((cfg.d_model,), jnp.float32),
+            "cross": _attn_params(cfg, keys[next(ki)], with_rel_bias=False),
+            "ln_ff": jnp.ones((cfg.d_model,), jnp.float32),
+            "ff": _ff_params(cfg, keys[next(ki)]),
+        })
+    if not cfg.tie_embeddings:
+        params["lm_head"] = jax.random.normal(
+            keys[next(ki)], (cfg.d_model, cfg.vocab_size), jnp.float32
+        ) * cfg.d_model**-0.5
+    return params
+
+
+def partition_specs(cfg: T5Config) -> dict:
+    """Megatron layout: q/k/v/wi column-parallel, o/wo row-parallel, vocab over (tp,fsdp)."""
+    def attn_spec(with_rel: bool) -> dict:
+        s = {"q": P(None, TENSOR_AXIS), "k": P(None, TENSOR_AXIS),
+             "v": P(None, TENSOR_AXIS), "o": P(TENSOR_AXIS, None)}
+        if with_rel:
+            s["rel_bias"] = P(None, TENSOR_AXIS)
+        return s
+
+    def ff_spec() -> dict:
+        s = {"wo": P(TENSOR_AXIS, None)}
+        if cfg.gated_ff:
+            s.update({"wi_0": P(None, TENSOR_AXIS), "wi_1": P(None, TENSOR_AXIS)})
+        else:
+            s["wi"] = P(None, TENSOR_AXIS)
+        return s
+
+    enc = [
+        {"ln_attn": P(), "attn": attn_spec(i == 0), "ln_ff": P(), "ff": ff_spec()}
+        for i in range(cfg.n_layers)
+    ]
+    dec = [
+        {"ln_attn": P(), "attn": attn_spec(i == 0), "ln_cross": P(),
+         "cross": attn_spec(False), "ln_ff": P(), "ff": ff_spec()}
+        for i in range(cfg.dec_layers)
+    ]
+    specs = {
+        "shared": P((TENSOR_AXIS, FSDP_AXIS), None),
+        "encoder": {"blocks": enc, "ln_f": P()},
+        "decoder": {"blocks": dec, "ln_f": P()},
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = P(None, (TENSOR_AXIS, FSDP_AXIS))
+    return specs
+
+
+def _t5_norm(x, scale, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps) * scale).astype(x.dtype)
+
+
+def _relative_bucket(rel_pos, bidirectional: bool, num_buckets: int, max_distance: int):
+    """HF T5's bucketing: half the buckets for sign (bidirectional), log-spaced far bins."""
+    ret = jnp.zeros_like(rel_pos)
+    n = rel_pos
+    if bidirectional:
+        num_buckets //= 2
+        ret = ret + (n > 0).astype(jnp.int32) * num_buckets
+        n = jnp.abs(n)
+    else:
+        n = -jnp.minimum(n, 0)
+    max_exact = num_buckets // 2
+    is_small = n < max_exact
+    large = max_exact + (
+        jnp.log(n.astype(jnp.float32) / max_exact + 1e-6)
+        / math.log(max_distance / max_exact)
+        * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    large = jnp.minimum(large, num_buckets - 1)
+    return ret + jnp.where(is_small, n, large)
+
+
+def _rel_bias(table, q_len: int, k_len: int, bidirectional: bool, cfg: T5Config):
+    """[1, heads, q_len, k_len] additive attention bias from the bucket table."""
+    ctx = jnp.arange(q_len)[:, None]
+    mem = jnp.arange(k_len)[None, :]
+    buckets = _relative_bucket(
+        mem - ctx, bidirectional, cfg.rel_buckets, cfg.rel_max_distance
+    )
+    bias = table[buckets]  # [q, k, heads]
+    return jnp.transpose(bias, (2, 0, 1))[None].astype(jnp.float32)
+
+
+def _attention(h_q, h_kv, p, cfg: T5Config, bias, mask):
+    """T5 attention: UNscaled scores + additive (rel + mask) fp32 bias."""
+    B, Q, D = h_q.shape
+    K = h_kv.shape[1]
+    dtype = h_q.dtype
+    q = (h_q @ p["q"].astype(dtype)).reshape(B, Q, cfg.n_heads, cfg.d_kv)
+    k = (h_kv @ p["k"].astype(dtype)).reshape(B, K, cfg.n_heads, cfg.d_kv)
+    v = (h_kv @ p["v"].astype(dtype)).reshape(B, K, cfg.n_heads, cfg.d_kv)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    if bias is not None:
+        scores = scores + bias
+    if mask is not None:
+        scores = jnp.where(mask, scores, -1e9)
+    probs = jax.nn.softmax(scores, axis=-1).astype(dtype)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, Q, cfg.n_heads * cfg.d_kv)
+    return out @ p["o"].astype(dtype)
+
+
+def _ff(h, p, cfg: T5Config):
+    dtype = h.dtype
+    if cfg.gated_ff:
+        inner = jax.nn.gelu(h @ p["wi_0"].astype(dtype), approximate=False) * (
+            h @ p["wi_1"].astype(dtype)
+        )
+    else:
+        inner = jax.nn.relu(h @ p["wi"].astype(dtype))
+    return inner @ p["wo"].astype(dtype)
+
+
+def encode(params: dict, input_ids: jax.Array, cfg: T5Config,
+           attention_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Encoder: input_ids [B, S] → hidden [B, S, D]."""
+    from .llama import _maybe_shard
+
+    B, S = input_ids.shape
+    x = params["shared"].astype(cfg.dtype)[input_ids]
+    x = _maybe_shard(x, P(BATCH_AXES, None, None))
+    rel_table = params["encoder"]["blocks"][0]["attn"]["rel_bias"]
+    bias = _rel_bias(rel_table, S, S, bidirectional=True, cfg=cfg)
+    mask = None
+    if attention_mask is not None:
+        mask = attention_mask[:, None, None, :].astype(bool)
+    for blk in params["encoder"]["blocks"]:
+        h = _t5_norm(x, blk["ln_attn"], cfg.norm_eps)
+        x = x + _attention(h, h, blk["attn"], cfg, bias, mask)
+        h = _t5_norm(x, blk["ln_ff"], cfg.norm_eps)
+        x = x + _ff(h, blk["ff"], cfg)
+    return _t5_norm(x, params["encoder"]["ln_f"], cfg.norm_eps)
+
+
+def decode(params: dict, decoder_input_ids: jax.Array, enc_out: jax.Array, cfg: T5Config,
+           enc_mask: Optional[jax.Array] = None) -> jax.Array:
+    """Decoder: ids [B, T] + encoder hidden → logits [B, T, V] fp32."""
+    B, T = decoder_input_ids.shape
+    x = params["shared"].astype(cfg.dtype)[decoder_input_ids]
+    rel_table = params["decoder"]["blocks"][0]["attn"]["rel_bias"]
+    bias = _rel_bias(rel_table, T, T, bidirectional=False, cfg=cfg)
+    causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
+    cmask = None
+    if enc_mask is not None:
+        cmask = enc_mask[:, None, None, :].astype(bool)
+    for blk in params["decoder"]["blocks"]:
+        h = _t5_norm(x, blk["ln_attn"], cfg.norm_eps)
+        x = x + _attention(h, h, blk["attn"], cfg, bias, causal)
+        h = _t5_norm(x, blk["ln_cross"], cfg.norm_eps)
+        x = x + _attention(h, enc_out, blk["cross"], cfg, None, cmask)
+        h = _t5_norm(x, blk["ln_ff"], cfg.norm_eps)
+        x = x + _ff(h, blk["ff"], cfg)
+    x = _t5_norm(x, params["decoder"]["ln_f"], cfg.norm_eps)
+    if cfg.tie_embeddings:
+        x = x * (cfg.d_model**-0.5)
+        head = params["shared"].T
+    else:
+        head = params["lm_head"]
+    return (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+
+
+def forward(params: dict, input_ids: jax.Array, decoder_input_ids: jax.Array,
+            cfg: T5Config, attention_mask: Optional[jax.Array] = None) -> jax.Array:
+    enc = encode(params, input_ids, cfg, attention_mask)
+    return decode(params, decoder_input_ids, enc, cfg, attention_mask)
+
+
+def loss_fn(params: dict, batch: dict, cfg: T5Config, rng=None) -> jax.Array:
+    """Seq2seq cross-entropy over {'input_ids', 'labels'} (+optional 'attention_mask').
+
+    Decoder inputs are the labels shifted right with ``decoder_start_token_id`` (the HF
+    ``_shift_right`` convention); label positions equal to -100 are ignored.
+    """
+    labels = batch["labels"]
+    start = jnp.full((labels.shape[0], 1), cfg.decoder_start_token_id, labels.dtype)
+    dec_in = jnp.concatenate([start, jnp.maximum(labels[:, :-1], 0)], axis=1)
+    logits = forward(params, batch["input_ids"], dec_in, cfg, batch.get("attention_mask"))
+    mask = (labels >= 0).astype(jnp.float32)
+    safe = jnp.maximum(labels, 0)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, safe[..., None], axis=-1).squeeze(-1)
+    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def generate(params: dict, input_ids: jax.Array, cfg: T5Config,
+             max_new_tokens: int = 32, attention_mask: Optional[jax.Array] = None,
+             eos_token_id: int = 1) -> jax.Array:
+    """Greedy seq2seq generation: encoder runs once, decoder re-runs on the growing prefix
+    (O(T²) decode — adequate for eval loops; a cached incremental decoder is the llama/gpt
+    families' pattern and can be grafted when T5 decode becomes a hot path)."""
+    enc = encode(params, input_ids, cfg, attention_mask)
+    B = input_ids.shape[0]
+    dec = jnp.full((B, 1), cfg.decoder_start_token_id, jnp.int32)
+    done = jnp.zeros((B,), bool)
+    for _ in range(max_new_tokens):
+        logits = decode(params, dec, enc, cfg, attention_mask)
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        nxt = jnp.where(done, eos_token_id, nxt)
+        done = done | (nxt == eos_token_id)
+        dec = jnp.concatenate([dec, nxt[:, None]], axis=1)
+        if bool(jnp.all(done)):
+            break
+    return dec[:, 1:]
+
+
+def num_params(cfg: T5Config) -> int:
+    D, F, V, H, kv = cfg.d_model, cfg.d_ff, cfg.vocab_size, cfg.n_heads, cfg.d_kv
+    inner = H * kv
+    attn = 3 * D * inner + inner * D
+    ff = (2 * D * F if cfg.gated_ff else D * F) + F * D
+    enc = cfg.n_layers * (attn + ff + 2 * D) + D + cfg.rel_buckets * H
+    dec = cfg.dec_layers * (2 * attn + ff + 3 * D) + D + cfg.rel_buckets * H
+    total = V * D + enc + dec
+    if not cfg.tie_embeddings:
+        total += D * V
+    return total
