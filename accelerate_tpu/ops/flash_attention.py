@@ -15,14 +15,16 @@ these exact kernels per ring step with correct cross-device causal masking. The 
 ``_bwd_dq`` / ``_bwd_dkv`` entry points (returning/consuming lse and delta) are the building
 blocks for the ring; ``flash_attention`` is the single-device public API.
 
-Runs in interpreter mode on CPU (tests) and compiled on TPU. Block sizes default to 128×128
-(MXU-shaped); hd should be a multiple of 128 for peak efficiency (llama3: hd=128).
+Runs in interpreter mode on CPU (tests) and compiled on TPU. Block sizes default to 256×512
+(see ``_DEFAULT_BLOCK_Q/K``); hd should be a multiple of 128 for peak efficiency (llama3:
+hd=128).
 """
 
 from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -33,6 +35,25 @@ from jax.experimental.pallas import tpu as pltpu
 __all__ = ["flash_attention"]
 
 _NEG_INF = -1e30
+
+# Default tile sizes. The grid iterates sequentially on the TensorCore, so per-step fixed
+# overhead (semaphores, block DMA setup) is paid nq*nk times per (batch, head): 128x128 tiles
+# at S=2048 mean 256 steps/head of mostly overhead. 256x512 cuts the step count 8x while the
+# working set (q 64KB + k/v 2x128KB bf16 + fp32 acc/s ~0.7MB) stays far under VMEM.
+# Env overrides allow per-chip tuning without code changes (used by bench sweeps).
+def _env_block(name: str, default: int) -> int:
+    raw = os.environ.get(name, "")
+    try:
+        return int(raw) if raw else default
+    except ValueError:
+        import warnings
+
+        warnings.warn(f"{name}={raw!r} is not an int; using default {default}")
+        return default
+
+
+_DEFAULT_BLOCK_Q = _env_block("ACCEL_FLASH_BLOCK_Q", 256)
+_DEFAULT_BLOCK_K = _env_block("ACCEL_FLASH_BLOCK_K", 512)
 
 
 def _interpret_default() -> bool:
@@ -74,12 +95,15 @@ def _fwd_kernel(
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)  # [block_q, hd]
-        k = k_ref[0, 0].astype(jnp.float32)  # [block_k, hd]
-        v = v_ref[0, 0].astype(jnp.float32)
+        # Dots run in the INPUT dtype with fp32 accumulation (preferred_element_type):
+        # bf16 inputs hit the MXU at full bf16 rate (an upfront fp32 cast would halve it);
+        # fp32 inputs keep full-precision parity with the XLA reference path.
+        q = q_ref[0, 0]                      # [block_q, hd]
+        k = k_ref[0, 0]                      # [block_k, hd]
+        v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
-        ) * sm_scale  # [block_q, block_k]
+        ) * sm_scale  # [block_q, block_k] fp32
 
         col_local = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         mask = col_local < kv_len
@@ -91,10 +115,11 @@ def _fwd_kernel(
         m_prev = m_ref[:]                       # [block_q, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)
+        p = jnp.exp(s - m_new)                  # fp32; row-sum in fp32 before any cast
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         m_ref[:] = m_new
 
@@ -175,10 +200,10 @@ def _bwd_dq_kernel(
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]                    # [block_q, 1]
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(
@@ -193,7 +218,7 @@ def _bwd_dq_kernel(
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
         dq_acc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -228,10 +253,10 @@ def _bwd_dkv_kernel(
 
     @pl.when(needed)
     def _compute():
-        q = q_ref[0, 0].astype(jnp.float32)
-        k = k_ref[0, 0].astype(jnp.float32)
-        v = v_ref[0, 0].astype(jnp.float32)
-        do = do_ref[0, 0].astype(jnp.float32)
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
         lse = lse_ref[0, 0]
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(
@@ -244,12 +269,13 @@ def _bwd_dkv_kernel(
             mask = jnp.logical_and(mask, kv_off + col_local <= q_off + row_local)
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dv_acc[:] += jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
         )
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * sm_scale
+        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -391,7 +417,7 @@ _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
 def _flash_bhsd_offset(q, k, v, q_offset=0, kv_offset=0, causal=True, sm_scale=None,
-                       block_q=128, block_k=128, interpret=None):
+                       block_q=None, block_k=None, interpret=None):
     """Offset-aware flash attention over user layout [B, S, H, hd] (shard_map helper)."""
     B, S, H, hd = q.shape
     if sm_scale is None:
@@ -401,8 +427,8 @@ def _flash_bhsd_offset(q, k, v, q_offset=0, kv_offset=0, causal=True, sm_scale=N
     qT = q.transpose(0, 2, 1, 3)
     kT = k.transpose(0, 2, 1, 3)
     vT = v.transpose(0, 2, 1, 3)
-    bq = _fit_block(block_q, S)
-    bk = _fit_block(block_k, k.shape[1])
+    bq = _fit_block(block_q or _DEFAULT_BLOCK_Q, S)
+    bk = _fit_block(block_k or _DEFAULT_BLOCK_K, k.shape[1])
     o = _flash_bhsd(qT, kT, vT,
                     jnp.asarray(q_offset, jnp.float32), jnp.asarray(kv_offset, jnp.float32),
                     causal, sm_scale, bq, bk, interpret)
@@ -415,8 +441,8 @@ def flash_attention(
     v: jax.Array,
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Flash attention over user layout q [B, S, H, hd], k/v [B, T, K, hd] (GQA: K ≤ H).
@@ -437,8 +463,8 @@ def flash_attention(
     qT = q.transpose(0, 2, 1, 3)
     kT = k.transpose(0, 2, 1, 3)
     vT = v.transpose(0, 2, 1, 3)
-    block_q = _fit_block(block_q, S)
-    block_k = _fit_block(block_k, k.shape[1])
+    block_q = _fit_block(block_q or _DEFAULT_BLOCK_Q, S)
+    block_k = _fit_block(block_k or _DEFAULT_BLOCK_K, k.shape[1])
     zero = jnp.zeros((), jnp.float32)
     o = _flash_bhsd(qT, kT, vT, zero, zero, causal, sm_scale, block_q, block_k, interpret)
     return o.transpose(0, 2, 1, 3)

@@ -130,8 +130,8 @@ def ring_attention(
     axis_name: str = "sp",
     causal: bool = True,
     sm_scale: Optional[float] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int | None = None,
+    block_k: int | None = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
     """Exact ring attention for use inside shard_map; user layout q [B, S_loc, H, hd].
@@ -152,9 +152,9 @@ def ring_attention(
     qT = q.transpose(0, 2, 1, 3)
     kT = k.transpose(0, 2, 1, 3)
     vT = v.transpose(0, 2, 1, 3)
-    from .flash_attention import _fit_block
+    from .flash_attention import _DEFAULT_BLOCK_K, _DEFAULT_BLOCK_Q, _fit_block
 
-    bq = _fit_block(block_q, S_local)
-    bk = _fit_block(block_k, S_local)
+    bq = _fit_block(block_q or _DEFAULT_BLOCK_Q, S_local)
+    bk = _fit_block(block_k or _DEFAULT_BLOCK_K, S_local)
     o = _ring_bhsd(qT, kT, vT, axis_name, causal, sm_scale, (bq, bk), interpret)
     return o.transpose(0, 2, 1, 3)

@@ -12,6 +12,7 @@ import sys
 import pytest
 
 from accelerate_tpu.elastic import ElasticSupervisor, WorkerFailure
+from accelerate_tpu.test_utils.testing import slow
 
 CRASH_ONCE = """
 import os, sys, time
@@ -87,6 +88,7 @@ def test_supervisor_exhausts_restart_budget(tmp_path):
     assert sup.attempts_used == 2
 
 
+@slow
 def test_multi_process_launcher_restarts_through_cli(tmp_path):
     """End-to-end: accelerate-tpu launch --multi-process --max-restarts restarts a script
     that crashes on its first run (simulated preemption) and then succeeds."""

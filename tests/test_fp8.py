@@ -20,6 +20,7 @@ from accelerate_tpu.ops.fp8 import (
     quantize,
 )
 from accelerate_tpu.utils.dataclasses import FP8RecipeKwargs
+from accelerate_tpu.test_utils.testing import slow
 
 
 # ------------------------------------------------------------------------------- scaling
@@ -161,6 +162,7 @@ def test_accelerator_fp8_recipe_handler_override():
 
 
 # ---------------------------------------------------------------------- llama end-to-end
+@slow
 def test_llama_fp8_forward_and_training_step():
     import dataclasses
 
@@ -186,6 +188,7 @@ def test_llama_fp8_forward_and_training_step():
     assert losses[-1] < losses[0], f"fp8 training did not reduce loss: {losses}"
 
 
+@slow
 def test_delayed_scaling_auto_threaded():
     """Accelerator-wired delayed scaling: fp8_state carried in TrainState, history fills."""
     import dataclasses

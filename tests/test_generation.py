@@ -15,6 +15,7 @@ import pytest
 
 from accelerate_tpu.generation import GenerationConfig, sample_logits
 from accelerate_tpu.models import llama
+from accelerate_tpu.test_utils.testing import slow
 
 
 @pytest.fixture(scope="module")
@@ -37,6 +38,7 @@ def _uncached_argmax_decode(params, prompt, cfg, steps):
 
 
 class TestCachedDecodeParity:
+    @slow
     def test_cached_equals_uncached_argmax(self, tiny):
         cfg, params = tiny
         prompt = jnp.asarray(
@@ -48,6 +50,7 @@ class TestCachedDecodeParity:
         )
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
+    @slow
     def test_cached_equals_uncached_with_scan_layers(self, tiny):
         cfg, _ = tiny
         scfg = dataclasses.replace(cfg, scan_layers=True)

@@ -13,6 +13,7 @@ from accelerate_tpu.generation import GenerationConfig
 from accelerate_tpu.models import gpt
 from accelerate_tpu.parallel import MeshConfig
 from accelerate_tpu.utils import send_to_device
+from accelerate_tpu.test_utils.testing import slow
 
 CFG = dataclasses.replace(gpt.CONFIGS["tiny"], dtype=jnp.float32)
 
@@ -33,6 +34,7 @@ def test_forward_shapes_and_causality():
 
 
 @pytest.mark.parametrize("variant", ["gpt2-style", "gptj-style"])
+@slow
 def test_training_decreases_loss(variant):
     cfg = CFG if variant == "gpt2-style" else dataclasses.replace(
         CFG, pos="rotary", parallel_residual=True, tie_embeddings=False
@@ -51,6 +53,7 @@ def test_training_decreases_loss(variant):
     assert losses[-1] < losses[0], losses
 
 
+@slow
 def test_tp_sharded_matches_single():
     cfg = CFG
     params = gpt.init_params(cfg)
@@ -67,6 +70,7 @@ def test_tp_sharded_matches_single():
     np.testing.assert_allclose(float(m["loss"]), base, rtol=2e-5)
 
 
+@slow
 def test_cached_decode_matches_uncached_argmax():
     """Greedy decode through the cache == argmax over full re-forward (both variants)."""
     for cfg in (

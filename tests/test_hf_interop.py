@@ -13,8 +13,10 @@ transformers = pytest.importorskip("transformers")
 torch = pytest.importorskip("torch")
 
 from accelerate_tpu.models import gpt, hf_interop, llama  # noqa: E402
+from accelerate_tpu.test_utils.testing import slow
 
 
+@slow
 def test_llama_logits_match_transformers():
     hf_cfg = transformers.LlamaConfig(
         vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
@@ -120,6 +122,7 @@ def test_gpt2_untied_override_gets_head():
     assert logits.shape == (1, 4, 64)
 
 
+@slow
 def test_t5_logits_match_transformers():
     """Encoder-decoder parity: gated-gelu v1.1/T0 lineage (the reference's T0pp family)."""
     hf_cfg = transformers.T5Config(
@@ -147,6 +150,7 @@ def test_t5_logits_match_transformers():
     np.testing.assert_allclose(ours, hf_logits, atol=1e-3, rtol=1e-3)
 
 
+@slow
 def test_t5_relu_untied_variant_matches():
     hf_cfg = transformers.T5Config(
         vocab_size=64, d_model=32, d_kv=8, d_ff=64, num_layers=2, num_decoder_layers=1,
@@ -171,6 +175,7 @@ def test_t5_relu_untied_variant_matches():
     np.testing.assert_allclose(ours, hf_logits, atol=3e-4, rtol=1e-3)
 
 
+@slow
 def test_t5_greedy_generate_matches_transformers():
     hf_cfg = transformers.T5Config(
         vocab_size=64, d_model=32, d_kv=8, d_ff=64, num_layers=2, num_decoder_layers=2,

@@ -16,8 +16,10 @@ from accelerate_tpu import Accelerator
 from accelerate_tpu.models import llama
 from accelerate_tpu.utils import send_to_device
 from accelerate_tpu.utils.dataclasses import DistributedType, MegatronLMPlugin
+from accelerate_tpu.test_utils.testing import slow
 
 
+@slow
 def test_megatron_plugin_builds_3d_mesh_and_zero1():
     plugin = MegatronLMPlugin(tp_degree=2, gradient_clipping=0.5)
     acc = Accelerator(megatron_lm_plugin=plugin)

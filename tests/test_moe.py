@@ -9,6 +9,7 @@ import numpy as np
 import optax
 import pytest
 
+from accelerate_tpu.test_utils.testing import slow
 from accelerate_tpu.models import llama
 from accelerate_tpu.ops.moe import (
     expert_partition_specs,
@@ -102,6 +103,7 @@ def test_moe_mlp_differentiable():
 
 
 # --------------------------------------------------------------------------- llama + mesh
+@slow
 def test_llama_moe_forward_and_loss():
     cfg = dataclasses.replace(llama.CONFIGS["moe-tiny"], attn_impl="xla")
     params = llama.init_params(cfg, jax.random.PRNGKey(0))
@@ -116,6 +118,7 @@ def test_llama_moe_forward_and_loss():
     assert np.isfinite(float(loss))
 
 
+@slow
 def test_llama_moe_expert_parallel_training():
     """Full EP path on the 8-device sim: dp=2 × ep=2 × tp=2 mesh, experts sharded on ep."""
     from accelerate_tpu import Accelerator

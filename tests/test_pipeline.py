@@ -8,6 +8,7 @@ import optax
 import pytest
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from accelerate_tpu.test_utils.testing import slow
 from accelerate_tpu.parallel import MeshConfig, build_mesh
 from accelerate_tpu.parallel.pp import (
     make_pipeline_fn,
@@ -153,6 +154,7 @@ def _llama_pp_setup():
     return cfg, params, batch
 
 
+@slow
 def test_llama_pp_loss_matches_single():
     """forward_pp over a pp=4 mesh == plain forward, for loss and one SGD step."""
     import optax as _optax

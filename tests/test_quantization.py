@@ -8,6 +8,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from accelerate_tpu.test_utils.testing import slow
 from accelerate_tpu.models import llama
 from accelerate_tpu.ops.quantization import (
     BnbQuantizationConfig,
@@ -140,6 +141,7 @@ def test_config_validation():
     assert BnbQuantizationConfig(load_in_4bit=True, bnb_4bit_quant_type="nf4").scheme == "nf4"
 
 
+@slow
 def test_load_and_quantize_model_llama():
     cfg = dataclasses.replace(llama.CONFIGS["tiny"], attn_impl="xla")
     params = llama.init_params(cfg, jax.random.PRNGKey(0))

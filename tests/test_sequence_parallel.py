@@ -11,6 +11,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from accelerate_tpu.parallel import MeshConfig, build_mesh
 from accelerate_tpu.parallel.sequence import make_sp_attention, sequence_parallel_attention
+from accelerate_tpu.test_utils.testing import slow
 
 
 def reference_attention(q, k, v, causal=True):
@@ -87,6 +88,7 @@ def test_sp_attention_gradient_parity(sp_mesh, mode):
         )
 
 
+@slow
 def test_ring_attention_used_in_training_step(sp_mesh):
     """End-to-end: a toy attention model trains under sp=8 with ring attention, matching
     the same model trained single-device."""

@@ -11,6 +11,7 @@ from accelerate_tpu import Accelerator
 from accelerate_tpu.models import t5
 from accelerate_tpu.parallel import MeshConfig
 from accelerate_tpu.utils import send_to_device
+from accelerate_tpu.test_utils.testing import slow
 
 CFG = dataclasses.replace(t5.CONFIGS["tiny"], dtype=jnp.float32)
 
@@ -25,6 +26,7 @@ def make_batch(n=8, src=12, tgt=8, seed=0):
     }
 
 
+@slow
 def test_training_decreases_loss():
     acc = Accelerator(mesh_config=MeshConfig())
     state = acc.create_train_state(
@@ -39,6 +41,7 @@ def test_training_decreases_loss():
     assert losses[-1] < losses[0], losses
 
 
+@slow
 def test_tp_sharded_matches_single():
     params = t5.init_params(CFG)
     batch = make_batch()
