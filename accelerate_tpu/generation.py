@@ -33,7 +33,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["GenerationConfig", "sample_logits", "generate_loop"]
+__all__ = ["GenerationConfig", "sample_logits", "generate_loop", "streamed_generate_loop"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -114,3 +114,49 @@ def generate_loop(
     )
     out = jnp.concatenate([first[None, :], rest], axis=0)  # [T, B]
     return jnp.swapaxes(out, 0, 1)
+
+
+def streamed_generate_loop(
+    one_pass: Callable,
+    prompt: jax.Array,
+    prompt_mask: Optional[jax.Array],
+    gen: GenerationConfig,
+    rng: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Host-driven decode loop for weight-streamed models (shared by the llama/gpt
+    ``generate_streamed`` paths).
+
+    ``one_pass(tokens [B,T], cache_or_None, token_mask [B,T]) -> (last_logits [B,V], cache)``
+    runs a full forward with block weights streamed from host/disk; the first call (cache =
+    None) is the prefill. Unlike ``generate_loop``, this cannot be one compiled scan —
+    weights arrive per block per pass — so EOS handling early-exits the Python loop once
+    every row has finished.
+    """
+    prompt = jnp.asarray(prompt, jnp.int32)
+    B, S0 = prompt.shape
+    if prompt_mask is None:
+        prompt_mask = jnp.ones((B, S0), jnp.bool_)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    step_rngs = jax.random.split(rng, gen.max_new_tokens)
+    logits, cache = one_pass(prompt, None, prompt_mask)
+    token = sample_logits(logits, gen, step_rngs[0])
+    done = (
+        token == gen.eos_token_id if gen.eos_token_id is not None
+        else jnp.zeros((B,), jnp.bool_)
+    )
+    out = [token]
+    for t in range(1, gen.max_new_tokens):
+        logits, cache = one_pass(token[:, None], cache, jnp.ones((B, 1), jnp.bool_))
+        nxt = sample_logits(logits, gen, step_rngs[t])
+        if gen.eos_token_id is not None:
+            out.append(jnp.where(done, jnp.int32(gen.pad_token_id), nxt))
+            done = done | (nxt == gen.eos_token_id)
+            if bool(jnp.all(done)):
+                pad = jnp.full((B,), gen.pad_token_id, jnp.int32)
+                out.extend([pad] * (gen.max_new_tokens - len(out)))
+                break
+        else:
+            out.append(nxt)
+        token = nxt
+    return jnp.stack(out, axis=1)

@@ -38,6 +38,7 @@ __all__ = [
     "init_cache",
     "forward_cached",
     "generate",
+    "generate_streamed",
     "num_params",
 ]
 
@@ -70,6 +71,13 @@ CONFIGS = {
     "gpt-neox-20b": GPTConfig(
         vocab_size=50432, d_model=6144, n_layers=44, n_heads=64, d_ff=24576,
         pos="rotary", parallel_residual=True, tie_embeddings=False,
+    ),
+    # OPT-30B shape (the reference's biggest offload baseline, README.md:36-37): OPT is a
+    # plain GPT decoder with learned positions, sequential residual, ReLU-family MLP —
+    # architecturally GPT-2-shaped at 30B scale.
+    "opt-30b": GPTConfig(
+        vocab_size=50272, d_model=7168, n_layers=48, n_heads=56, d_ff=28672,
+        pos="learned", tie_embeddings=True, max_seq=2048,
     ),
     "tiny": GPTConfig(
         vocab_size=256, d_model=128, n_layers=2, n_heads=4, d_ff=256, max_seq=128,
@@ -393,6 +401,68 @@ def generate(
     _GEN_FNS.move_to_end(key)
     prefill_fn, decode_fn = _GEN_FNS[key]
     return generate_loop(prefill_fn, decode_fn, params, prompt, prompt_mask, gen, rng)
+
+
+def generate_streamed(
+    dispatched,
+    prompt: jax.Array,
+    cfg: GPTConfig,
+    gen=None,
+    rng: Optional[jax.Array] = None,
+    prompt_mask: Optional[jax.Array] = None,
+    prefetch: int = 2,
+) -> jax.Array:
+    """Generation for GPT models bigger than HBM (gpt-neox-20b bf16 = 40 GB, opt-30b = 60 GB):
+    block weights stream from host RAM / disk with double-buffered prefetch.
+
+    Same contract as ``llama.generate_streamed``; this is the TPU-native counterpart of the
+    reference's offloaded ``generate`` over ``AlignDevicesHook`` (``hooks.py:329``) that
+    produced the OPT-30B / GPT-NeoX-20B offload baselines
+    (``benchmarks/big_model_inference/README.md:33-37``).
+    """
+    from .llama import _cache_advance, _streamed_head_jit
+    from ..big_modeling import stream_blocks
+    from ..generation import GenerationConfig, streamed_generate_loop
+
+    if cfg.scan_layers:
+        raise ValueError("generate_streamed requires per-layer (non-scanned) params.")
+    gen = gen or GenerationConfig()
+    B, S0 = jnp.asarray(prompt).shape
+    max_len = S0 + gen.max_new_tokens
+    prefixes = [f"layers/{i}" for i in range(cfg.n_layers)]
+
+    def one_pass(tokens, cache, token_mask):
+        if cache is None:
+            cache = init_cache(cfg, B, max_len)
+        index, positions, valid = _cache_advance(cache, tokens, token_mask)
+        wte = dispatched.fetch("wte")
+        # Gather THEN cast — the loop is host-driven, so casting the whole [V, D] matrix
+        # per pass would dominate (opt-30b: ~720 MB of converts per generated token).
+        x = wte[tokens].astype(cfg.dtype)
+        if cfg.pos == "learned":
+            x = x + dispatched.fetch("wpe")[positions].astype(cfg.dtype)
+        new_layers = []
+        for i, layer in stream_blocks(dispatched, prefixes, prefetch=prefetch):
+            idx = int(i.split("/")[1])
+            x, new_kv = _block_cached_jit(
+                x, layer, cache["layers"][idx], index, positions, valid, cfg=cfg
+            )
+            new_layers.append(new_kv)
+        x = _layer_norm(x, dispatched.fetch("ln_f"), cfg.norm_eps)
+        head = wte if cfg.tie_embeddings else dispatched.fetch("lm_head")
+        logits = _streamed_head_jit(x[:, -1, :], head, transpose=cfg.tie_embeddings)
+        return logits, {"layers": new_layers, "valid": valid, "index": index + tokens.shape[1]}
+
+    return streamed_generate_loop(one_pass, prompt, prompt_mask, gen, rng)
+
+
+from functools import partial as _partial  # noqa: E402
+
+
+@_partial(jax.jit, static_argnames=("cfg",))
+def _block_cached_jit(x, layer, kv, index, positions, valid, cfg):
+    """Module-level jit identity: one compile per shape across streamed decode steps."""
+    return _block_cached(x, layer, kv, index, positions, valid, cfg)
 
 
 def num_params(cfg: GPTConfig) -> int:

@@ -615,15 +615,15 @@ def forward_streamed(
     mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
 
     embed = dispatched.fetch("embed")
-    x = embed.astype(dtype)[tokens]
+    x = embed[tokens].astype(dtype)  # gather then cast (host-driven loop; see generate_streamed)
     prefixes = [f"layers/{i}" for i in range(cfg.n_layers)]
     for _, layer in stream_blocks(dispatched, prefixes, prefetch=prefetch):
         x, _ = _block_jit(x, layer, positions, mask, cfg=cfg)
     ln_f = dispatched.fetch("ln_f")
     x = _rms_norm(x, ln_f, cfg.norm_eps)
-    head = embed.T if cfg.tie_embeddings else dispatched.fetch("lm_head")
-    logits = x @ head.astype(dtype)
-    return logits.astype(jnp.float32)
+    head = embed if cfg.tie_embeddings else dispatched.fetch("lm_head")
+    eq = "bsd,vd->bsv" if cfg.tie_embeddings else "bsd,dv->bsv"
+    return jnp.einsum(eq, x, head.astype(dtype)).astype(jnp.float32)
 
 
 # ----------------------------------------------------------------------- cached generation
@@ -843,25 +843,23 @@ def generate_streamed(
     ``generate`` whenever the params fit — streamed decode is HBM-bandwidth-bound by design.
     """
     from ..big_modeling import stream_blocks
-    from ..generation import GenerationConfig, sample_logits
+    from ..generation import GenerationConfig, streamed_generate_loop
 
     if cfg.scan_layers:
         raise ValueError("generate_streamed requires per-layer (non-scanned) params.")
     gen = gen or GenerationConfig()
-    prompt = jnp.asarray(prompt, jnp.int32)
-    B, S0 = prompt.shape
-    if prompt_mask is None:
-        prompt_mask = jnp.ones((B, S0), jnp.bool_)
-    if rng is None:
-        rng = jax.random.PRNGKey(0)
+    B, S0 = jnp.asarray(prompt).shape
     max_len = S0 + gen.max_new_tokens
-    cache = init_cache(cfg, B, max_len)
     prefixes = [f"layers/{i}" for i in range(cfg.n_layers)]
 
     def one_pass(tokens, cache, token_mask):
+        if cache is None:
+            cache = init_cache(cfg, B, max_len)
         index, positions, valid = _cache_advance(cache, tokens, token_mask)
         embed = dispatched.fetch("embed")
-        x = embed.astype(cfg.dtype)[tokens]
+        # Gather THEN cast: this loop is host-driven (un-jitted between blocks), so
+        # embed.astype(...)[tokens] would eagerly convert the full [V, D] matrix per pass.
+        x = embed[tokens].astype(cfg.dtype)
         new_layers = []
         for i, layer in stream_blocks(dispatched, prefixes, prefetch=prefetch):
             idx = int(i.split("/")[1])
@@ -870,32 +868,19 @@ def generate_streamed(
             )
             new_layers.append(new_kv)
         x = _rms_norm(x, dispatched.fetch("ln_f"), cfg.norm_eps)
-        head = embed.T if cfg.tie_embeddings else dispatched.fetch("lm_head")
-        logits = (x[:, -1, :] @ head.astype(cfg.dtype)).astype(jnp.float32)
+        head = embed if cfg.tie_embeddings else dispatched.fetch("lm_head")
+        logits = _streamed_head_jit(x[:, -1, :], head, transpose=cfg.tie_embeddings)
         return logits, {"layers": new_layers, "valid": valid, "index": index + tokens.shape[1]}
 
-    step_rngs = jax.random.split(rng, gen.max_new_tokens)
-    logits, cache = one_pass(prompt, cache, prompt_mask)
-    token = sample_logits(logits, gen, step_rngs[0])
-    done = (
-        token == gen.eos_token_id if gen.eos_token_id is not None
-        else jnp.zeros((B,), jnp.bool_)
-    )
-    out = [token]
-    for t in range(1, gen.max_new_tokens):
-        logits, cache = one_pass(token[:, None], cache, jnp.ones((B, 1), jnp.bool_))
-        nxt = sample_logits(logits, gen, step_rngs[t])
-        if gen.eos_token_id is not None:
-            out.append(jnp.where(done, jnp.int32(gen.pad_token_id), nxt))
-            done = done | (nxt == gen.eos_token_id)
-            if bool(jnp.all(done)):
-                pad = jnp.full((B,), gen.pad_token_id, jnp.int32)
-                out.extend([pad] * (gen.max_new_tokens - len(out)))
-                break
-        else:
-            out.append(nxt)
-        token = nxt
-    return jnp.stack(out, axis=1)
+    return streamed_generate_loop(one_pass, prompt, prompt_mask, gen, rng)
+
+
+@partial(jax.jit, static_argnames=("transpose",))
+def _streamed_head_jit(x_last, head, transpose: bool):
+    """Final-position vocab projection for streamed decode, fused under one jit so the
+    head-matrix cast/transpose never materializes eagerly ([V,D] when tied, [D,V] when not)."""
+    eq = "bd,vd->bv" if transpose else "bd,dv->bv"
+    return jnp.einsum(eq, x_last, head.astype(x_last.dtype)).astype(jnp.float32)
 
 
 @partial(jax.jit, static_argnames=("cfg",))

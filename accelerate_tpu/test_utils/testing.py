@@ -54,6 +54,18 @@ except ImportError:  # pragma: no cover - pytest always present in dev envs
     _skipif = lambda cond, reason=None: unittest.skipIf(cond, reason)  # noqa: E731
 
 
+def slow_mark():
+    """Mark-form slow gate for ``pytest.param(..., marks=slow_mark())`` — same RUN_SLOW
+    contract as the ``slow`` decorator, defined once for all parametrized tiers."""
+    import pytest
+
+    from ..utils.environment import parse_flag_from_env
+
+    return pytest.mark.skipif(
+        not parse_flag_from_env("RUN_SLOW", False), reason="slow tier; set RUN_SLOW=1"
+    )
+
+
 def slow(test_case):
     """Gate on ``RUN_SLOW=1`` (reference ``testing.py:245``)."""
     from ..utils.environment import parse_flag_from_env

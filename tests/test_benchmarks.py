@@ -1,0 +1,43 @@
+"""Smoke tests for the benchmarks/ suite (reference analog: benchmarks are CI-exercised
+via Makefile targets). Subprocess-driven like test_examples; slow tier."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from accelerate_tpu.test_utils.testing import slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=600):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, *args], capture_output=True, text=True, timeout=timeout,
+        env=env, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+@slow
+@pytest.mark.parametrize("offload", ["none", "host", "disk"])
+def test_big_model_inference_smoke(offload, tmp_path):
+    row = _run([
+        "benchmarks/big_model_inference/inference_tpu.py", "--smoke",
+        "--offload", offload, "--offload-dir", str(tmp_path / "off"),
+        "--new-tokens", "4", "--prompt-len", "8",
+    ])
+    assert row["s_per_token"] > 0
+    assert row["offload"] == offload
+
+
+@slow
+def test_fp8_convergence_smoke():
+    out = _run(["benchmarks/fp8/convergence.py", "--steps", "8"])
+    assert out["pass"] is True

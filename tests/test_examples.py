@@ -16,10 +16,9 @@ import pytest
 
 # Example runs recompile XLA programs per script (~20-90 s each): slow tier, like the
 # reference's example-regression CI (VERDICT r1 weak #7). RUN_SLOW=1 enables.
-pytestmark = pytest.mark.skipif(
-    os.environ.get("RUN_SLOW", "0") not in ("1", "true", "yes"),
-    reason="example-regression tier is slow; set RUN_SLOW=1",
-)
+from accelerate_tpu.test_utils.testing import slow_mark
+
+pytestmark = slow_mark()
 
 EXAMPLES = Path(__file__).parent.parent / "examples"
 

@@ -15,7 +15,7 @@ import pytest
 
 from accelerate_tpu.generation import GenerationConfig, sample_logits
 from accelerate_tpu.models import llama
-from accelerate_tpu.test_utils.testing import slow
+from accelerate_tpu.test_utils.testing import slow, slow_mark
 
 
 @pytest.fixture(scope="module")
@@ -94,10 +94,7 @@ class TestCachedDecodeParity:
         assert not bool(jnp.any(new_cache["valid"][:, 8:]))
 
 
-@pytest.mark.skipif(
-    __import__("os").environ.get("RUN_SLOW", "0") not in ("1", "true", "yes"),
-    reason="MoE cached-decode parity compiles a full MoE decode graph (~40 s); RUN_SLOW=1",
-)
+@slow_mark()
 class TestMoECachedDecode:
     def test_moe_cached_equals_uncached_when_nothing_drops(self):
         """Decode uses drop-free dense routing; with a capacity factor generous enough that

@@ -98,3 +98,25 @@ def test_scan_layers_matches_loop():
     l_loop = gpt.forward(params, tokens, CFG, shard_activations=False)
     l_scan = gpt.forward(stacked, tokens, cfg_scan, shard_activations=False)
     np.testing.assert_allclose(np.asarray(l_loop), np.asarray(l_scan), atol=1e-5)
+
+
+@slow
+def test_generate_streamed_matches_in_memory():
+    """Streamed (host-offloaded) greedy decode == in-memory decode for both position types."""
+    from accelerate_tpu.big_modeling import cpu_offload
+    from accelerate_tpu.generation import GenerationConfig
+
+    for cfg in (
+        gpt.CONFIGS["tiny"],                                     # learned positions, tied head
+        dataclasses.replace(
+            gpt.CONFIGS["tiny"], pos="rotary", parallel_residual=True, tie_embeddings=False
+        ),                                                       # gpt-j/neox variant
+    ):
+        params = gpt.init_params(cfg)
+        prompt = jnp.asarray(
+            np.random.default_rng(3).integers(0, cfg.vocab_size, (2, 7)), jnp.int32
+        )
+        gen = GenerationConfig(max_new_tokens=5, temperature=0.0)
+        want = np.asarray(gpt.generate(params, prompt, cfg, gen))
+        got = np.asarray(gpt.generate_streamed(cpu_offload(params), prompt, cfg, gen))
+        np.testing.assert_array_equal(want, got)

@@ -1,6 +1,5 @@
 """Flagship model tests: correctness, TP/FSDP/hybrid sharded-training parity, scan/remat."""
 
-import os
 import dataclasses
 
 import numpy as np
@@ -15,7 +14,7 @@ from accelerate_tpu.models import llama
 from accelerate_tpu.parallel import MeshConfig
 from accelerate_tpu.parallel.tp import apply_tensor_parallel, plan_from_rules
 from accelerate_tpu.utils import FullyShardedDataParallelPlugin, send_to_device
-from accelerate_tpu.test_utils.testing import slow
+from accelerate_tpu.test_utils.testing import slow, slow_mark
 
 CFG = dataclasses.replace(llama.CONFIGS["tiny"], dtype=jnp.float32)  # fp32 for parity
 
@@ -89,11 +88,7 @@ def baseline_losses(cfg, n_steps=4, lr=0.05):
 
 # Default tier runs the 3-axis case (covers dp+fsdp+tp propagation in one compile);
 # the single-axis and sp layouts run under RUN_SLOW=1 (VERDICT r1 weak #7 tiering).
-from accelerate_tpu.utils.environment import parse_flag_from_env  # noqa: E402
-
-_slow_param = pytest.mark.skipif(
-    not parse_flag_from_env("RUN_SLOW", False), reason="slow tier; set RUN_SLOW=1"
-)
+_slow_param = slow_mark()
 
 
 @pytest.mark.parametrize(

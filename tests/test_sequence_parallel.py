@@ -134,10 +134,7 @@ def test_ring_attention_used_in_training_step(sp_mesh):
     np.testing.assert_allclose(np.asarray(w_ring), np.asarray(w_ref), atol=1e-5)
 
 
-@pytest.mark.skipif(
-    __import__("os").environ.get("RUN_SLOW", "0") not in ("1", "true", "yes"),
-    reason="full-llama ring-attention parity (~35 s); the kernel-level ring parity tests above stay default; RUN_SLOW=1",
-)
+@slow
 def test_llama_with_ring_attention_parity():
     """Full llama training step with attn_impl='ring' on an sp mesh == xla baseline."""
     import dataclasses
