@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import dataclasses
 import math
+from functools import partial
 from typing import Any, Optional
 
 import numpy as np
@@ -430,17 +431,22 @@ def generate_streamed(
     B, S0 = jnp.asarray(prompt).shape
     max_len = S0 + gen.max_new_tokens
     prefixes = [f"layers/{i}" for i in range(cfg.n_layers)]
+    # Hoist the always-resident leaves out of the loop: only transformer BLOCKS stream
+    # per pass; re-fetching wte from disk would cost ~690 MB of I/O per token at opt-30b.
+    wte = dispatched.fetch("wte")
+    wpe = dispatched.fetch("wpe") if cfg.pos == "learned" else None
+    ln_f = dispatched.fetch("ln_f")
+    head = wte if cfg.tie_embeddings else dispatched.fetch("lm_head")
 
     def one_pass(tokens, cache, token_mask):
         if cache is None:
             cache = init_cache(cfg, B, max_len)
         index, positions, valid = _cache_advance(cache, tokens, token_mask)
-        wte = dispatched.fetch("wte")
         # Gather THEN cast — the loop is host-driven, so casting the whole [V, D] matrix
-        # per pass would dominate (opt-30b: ~720 MB of converts per generated token).
+        # per pass would dominate.
         x = wte[tokens].astype(cfg.dtype)
-        if cfg.pos == "learned":
-            x = x + dispatched.fetch("wpe")[positions].astype(cfg.dtype)
+        if wpe is not None:
+            x = x + wpe[positions].astype(cfg.dtype)
         new_layers = []
         for i, layer in stream_blocks(dispatched, prefixes, prefetch=prefetch):
             idx = int(i.split("/")[1])
@@ -448,18 +454,14 @@ def generate_streamed(
                 x, layer, cache["layers"][idx], index, positions, valid, cfg=cfg
             )
             new_layers.append(new_kv)
-        x = _layer_norm(x, dispatched.fetch("ln_f"), cfg.norm_eps)
-        head = wte if cfg.tie_embeddings else dispatched.fetch("lm_head")
+        x = _layer_norm(x, ln_f, cfg.norm_eps)
         logits = _streamed_head_jit(x[:, -1, :], head, transpose=cfg.tie_embeddings)
         return logits, {"layers": new_layers, "valid": valid, "index": index + tokens.shape[1]}
 
     return streamed_generate_loop(one_pass, prompt, prompt_mask, gen, rng)
 
 
-from functools import partial as _partial  # noqa: E402
-
-
-@_partial(jax.jit, static_argnames=("cfg",))
+@partial(jax.jit, static_argnames=("cfg",))
 def _block_cached_jit(x, layer, kv, index, positions, valid, cfg):
     """Module-level jit identity: one compile per shape across streamed decode steps."""
     return _block_cached(x, layer, kv, index, positions, valid, cfg)

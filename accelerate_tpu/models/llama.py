@@ -851,12 +851,16 @@ def generate_streamed(
     B, S0 = jnp.asarray(prompt).shape
     max_len = S0 + gen.max_new_tokens
     prefixes = [f"layers/{i}" for i in range(cfg.n_layers)]
+    # Hoist always-resident leaves out of the loop: only transformer BLOCKS stream per
+    # pass; re-fetching the embedding from host/disk per token would dominate the traffic.
+    embed = dispatched.fetch("embed")
+    ln_f = dispatched.fetch("ln_f")
+    head = embed if cfg.tie_embeddings else dispatched.fetch("lm_head")
 
     def one_pass(tokens, cache, token_mask):
         if cache is None:
             cache = init_cache(cfg, B, max_len)
         index, positions, valid = _cache_advance(cache, tokens, token_mask)
-        embed = dispatched.fetch("embed")
         # Gather THEN cast: this loop is host-driven (un-jitted between blocks), so
         # embed.astype(...)[tokens] would eagerly convert the full [V, D] matrix per pass.
         x = embed[tokens].astype(cfg.dtype)
@@ -867,8 +871,7 @@ def generate_streamed(
                 x, layer, cache["layers"][idx], index, positions, valid, cfg=cfg
             )
             new_layers.append(new_kv)
-        x = _rms_norm(x, dispatched.fetch("ln_f"), cfg.norm_eps)
-        head = embed if cfg.tie_embeddings else dispatched.fetch("lm_head")
+        x = _rms_norm(x, ln_f, cfg.norm_eps)
         logits = _streamed_head_jit(x[:, -1, :], head, transpose=cfg.tie_embeddings)
         return logits, {"layers": new_layers, "valid": valid, "index": index + tokens.shape[1]}
 

@@ -38,6 +38,15 @@ def test_big_model_inference_smoke(offload, tmp_path):
 
 
 @slow
+def test_big_model_inference_t5_smoke(tmp_path):
+    row = _run([
+        "benchmarks/big_model_inference/inference_tpu.py", "t0pp", "--smoke",
+        "--offload", "host", "--new-tokens", "4", "--prompt-len", "8",
+    ])
+    assert row["family"] == "t5" and row["s_per_token"] > 0
+
+
+@slow
 def test_fp8_convergence_smoke():
     out = _run(["benchmarks/fp8/convergence.py", "--steps", "8"])
     assert out["pass"] is True

@@ -73,3 +73,22 @@ def test_ignored_labels_do_not_contribute():
 def test_num_params_analytic():
     counted = sum(int(np.prod(np.shape(l))) for l in jax.tree_util.tree_leaves(t5.init_params(CFG)))
     assert t5.num_params(CFG) == counted
+
+
+@slow
+def test_generate_streamed_matches_in_memory():
+    """Streamed (host-offloaded) greedy seq2seq decode == in-memory decode."""
+    from accelerate_tpu.big_modeling import cpu_offload
+
+    params = t5.init_params(CFG)
+    rng = np.random.default_rng(5)
+    inp = jnp.asarray(rng.integers(0, CFG.vocab_size, (2, 9)), jnp.int32)
+    am = jnp.asarray([[1] * 9, [1] * 6 + [0] * 3], jnp.int32)
+    want = np.asarray(t5.generate(params, inp, CFG, max_new_tokens=6, attention_mask=am))
+    got = np.asarray(
+        t5.generate_streamed(cpu_offload(params), inp, CFG, max_new_tokens=6, attention_mask=am)
+    )
+    # in-memory generate early-exits at all-EOS; streamed pads to max_new_tokens with EOS
+    n = want.shape[1]
+    np.testing.assert_array_equal(want, got[:, :n])
+    assert np.all(got[:, n:] == 1)
