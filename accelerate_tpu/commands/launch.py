@@ -77,8 +77,19 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
     mesh.add_argument("--fsdp-min-weight-size", "--fsdp_min_weight_size", type=int, default=None)
     mesh.add_argument("--sp-mode", "--sp_mode", default=None,
                       choices=[None, "ring", "ulysses", "allgather"])
-    mesh.add_argument("--fp8-format", "--fp8_format", default=None,
-                      choices=[None, "HYBRID", "E4M3"])
+    mesh.add_argument("--pp-num-microbatches", "--pp_num_microbatches", type=int, default=None,
+                      help="GPipe microbatch count for the pp axis.")
+
+    fp8 = parser.add_argument_group("FP8 recipe")
+    fp8.add_argument("--fp8-format", "--fp8_format", default=None,
+                     choices=[None, "HYBRID", "E4M3"])
+    fp8.add_argument("--fp8-margin", "--fp8_margin", type=int, default=None,
+                     help="Back the fp8 scale off by 2^margin.")
+    fp8.add_argument("--fp8-amax-history-len", "--fp8_amax_history_len", type=int, default=None,
+                     help="Delayed-scaling amax rolling-history length.")
+    fp8.add_argument("--fp8-use-delayed-scaling", "--fp8_use_delayed_scaling",
+                     action="store_true", default=None,
+                     help="TE-style delayed scaling instead of per-call current scaling.")
 
     train = parser.add_argument_group("Training")
     train.add_argument("--mixed-precision", "--mixed_precision", default=None,
@@ -86,6 +97,21 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
     train.add_argument("--gradient-accumulation-steps", "--gradient_accumulation_steps",
                        type=int, default=None)
     train.add_argument("--debug", action="store_true", help="Enable collective shape verification.")
+    train.add_argument("--project-dir", "--project_dir", default=None,
+                       help="Checkpoint/log root (ProjectConfiguration).")
+    train.add_argument("--checkpoint-total-limit", "--checkpoint_total_limit", type=int,
+                       default=None, help="Keep at most N checkpoints (rotation).")
+    train.add_argument("--log-with", "--log_with", default=None,
+                       help="Tracker(s) to enable, e.g. tensorboard or wandb.")
+
+    data = parser.add_argument_group("Data loading")
+    data.add_argument("--dispatch-batches", "--dispatch_batches", action="store_true",
+                      default=None, help="Rank 0 reads batches and broadcasts slices.")
+    data.add_argument("--no-even-batches", dest="even_batches", action="store_false",
+                      default=None, help="Allow uneven final batches across processes.")
+    data.add_argument("--no-seedable-sampler", dest="use_seedable_sampler",
+                      action="store_false", default=None,
+                      help="Disable the reproducible seedable sampler.")
 
     pod = parser.add_argument_group("TPU pod")
     pod.add_argument("--tpu-pod", "--tpu_pod", action="store_true", help="ssh fan-out to pod workers.")
@@ -247,33 +273,7 @@ def tpu_pod_launcher(args) -> int:
         # nominate itself coordinator and the rendezvous would never form.
         raise ValueError("--tpu-pod with multiple hosts requires --main-process-ip "
                          "(the internal IP of worker 0).")
-    inner_flags = []
-    if args.mixed_precision:
-        inner_flags += ["--mixed-precision", args.mixed_precision]
-    for axis in ("dp", "fsdp", "tp", "sp", "pp", "ep"):
-        v = getattr(args, axis, None)
-        if v is not None:
-            inner_flags += [f"--{axis}", str(v)]
-    if getattr(args, "gradient_accumulation_steps", None):
-        inner_flags += ["--gradient-accumulation-steps", str(args.gradient_accumulation_steps)]
-    if getattr(args, "fsdp_zero_stage", None):
-        inner_flags += ["--fsdp-zero-stage", str(args.fsdp_zero_stage)]
-    if getattr(args, "use_fsdp", False):
-        inner_flags += ["--use-fsdp"]
-    if getattr(args, "fsdp_cpu_offload", None):
-        inner_flags += ["--fsdp-cpu-offload"]
-    if getattr(args, "fsdp_state_dict_type", None):
-        inner_flags += ["--fsdp-state-dict-type", str(args.fsdp_state_dict_type)]
-    if getattr(args, "fsdp_min_weight_size", None):
-        inner_flags += ["--fsdp-min-weight-size", str(args.fsdp_min_weight_size)]
-    if getattr(args, "sp_mode", None):
-        inner_flags += ["--sp-mode", str(args.sp_mode)]
-    if getattr(args, "fp8_format", None):
-        inner_flags += ["--fp8-format", str(args.fp8_format)]
-    if getattr(args, "debug", False):
-        inner_flags += ["--debug"]
-    if getattr(args, "cpu", False):
-        inner_flags += ["--cpu"]
+    inner_flags = _forwarded_flags(args)
     def make_plan(coordinator: str):
         plans = []
         for rank in range(num_hosts):
@@ -311,6 +311,51 @@ def tpu_pod_launcher(args) -> int:
         raise subprocess.CalledProcessError(
             returncode=_first_failure(e.exit_codes), cmd=make_plan("unreached")[0][0]
         )
+
+
+# (arg attribute, flag, takes a value) — every launch flag a pod worker's re-invoked
+# ``accelerate-tpu launch`` must see. One table so new flags can't silently diverge between
+# single-host (env-serialized by _common_env) and pod (flag-serialized) launches.
+_FORWARDED = [
+    ("mixed_precision", "--mixed-precision", True),
+    ("dp", "--dp", True), ("fsdp", "--fsdp", True), ("tp", "--tp", True),
+    ("sp", "--sp", True), ("pp", "--pp", True), ("ep", "--ep", True),
+    ("gradient_accumulation_steps", "--gradient-accumulation-steps", True),
+    ("use_fsdp", "--use-fsdp", False),
+    ("fsdp_zero_stage", "--fsdp-zero-stage", True),
+    ("fsdp_cpu_offload", "--fsdp-cpu-offload", False),
+    ("fsdp_state_dict_type", "--fsdp-state-dict-type", True),
+    ("fsdp_min_weight_size", "--fsdp-min-weight-size", True),
+    ("sp_mode", "--sp-mode", True),
+    ("pp_num_microbatches", "--pp-num-microbatches", True),
+    ("fp8_format", "--fp8-format", True),
+    ("fp8_margin", "--fp8-margin", True),
+    ("fp8_amax_history_len", "--fp8-amax-history-len", True),
+    ("fp8_use_delayed_scaling", "--fp8-use-delayed-scaling", False),
+    ("project_dir", "--project-dir", True),
+    ("checkpoint_total_limit", "--checkpoint-total-limit", True),
+    ("log_with", "--log-with", True),
+    ("dispatch_batches", "--dispatch-batches", False),
+    ("debug", "--debug", False),
+    ("cpu", "--cpu", False),
+]
+
+
+def _forwarded_flags(args) -> list[str]:
+    flags: list[str] = []
+    for attr, flag, has_value in _FORWARDED:
+        v = getattr(args, attr, None)
+        if v is None or v is False:
+            continue
+        flags.append(flag)
+        if has_value:
+            flags.append(str(v))
+    # store_false flags: only forward when the user turned the default off.
+    if getattr(args, "even_batches", None) is False:
+        flags.append("--no-even-batches")
+    if getattr(args, "use_seedable_sampler", None) is False:
+        flags.append("--no-seedable-sampler")
+    return flags
 
 
 def _first_failure(codes: list[int]) -> int:

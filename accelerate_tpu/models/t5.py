@@ -362,6 +362,7 @@ def generate_streamed(
     not the O(T²) prefix recompute, dominates at these scales.
     """
     from ..big_modeling import stream_blocks
+    from .llama import _streamed_head_jit
 
     input_ids = jnp.asarray(input_ids, jnp.int32)
     B, S = input_ids.shape
@@ -400,7 +401,7 @@ def generate_streamed(
         y_t = _t5_norm(y[:, t, :], dec_ln_f, cfg.norm_eps)
         if cfg.tie_embeddings:
             y_t = y_t * (cfg.d_model**-0.5)
-        logits = _t5_head_jit(y_t, head, transpose=cfg.tie_embeddings)
+        logits = _streamed_head_jit(y_t, head, transpose=cfg.tie_embeddings)
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         nxt = jnp.where(done, eos_token_id, nxt)
         done = done | (nxt == eos_token_id)
@@ -420,12 +421,6 @@ def _enc_block_jit(x, blk, bias, mask, cfg):
 @partial(jax.jit, static_argnames=("cfg",))
 def _dec_block_jit(x, blk, enc_out, bias, causal, cmask, cfg):
     return _dec_block(x, blk, enc_out, bias, causal, cmask, cfg)
-
-
-@partial(jax.jit, static_argnames=("transpose",))
-def _t5_head_jit(y_last, head, transpose: bool):
-    eq = "bd,vd->bv" if transpose else "bd,dv->bv"
-    return jnp.einsum(eq, y_last, head.astype(y_last.dtype)).astype(jnp.float32)
 
 
 def num_params(cfg: T5Config) -> int:

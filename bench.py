@@ -65,14 +65,51 @@ def _peak_tflops(device) -> float:
     return best[1] if best else 196.6  # assume v5e, the BASELINE.md hardware
 
 
-def _init_backend(attempts: int = 5, base_delay: float = 3.0):
-    """jax.devices() with retry; clears jax's cached per-platform init failure between
+class _InitTimeout(RuntimeError):
+    pass
+
+
+def _devices_with_timeout(timeout_s: float):
+    """``jax.devices()`` bounded by a watchdog: when the remote-TPU tunnel is down, backend
+    init doesn't error — it HANGS on the dead socket (round 1: dryrun rc=124). A daemon
+    thread does the init; on timeout the main thread abandons it (the thread dies with the
+    process) and treats the attempt as a transient failure."""
+    import queue
+    import threading
+
+    out: queue.Queue = queue.Queue()
+
+    def target():
+        try:
+            import jax
+
+            out.put(("ok", jax.devices()))
+        except BaseException as e:  # noqa: BLE001
+            out.put(("err", e))
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    try:
+        kind, value = out.get(timeout=timeout_s)
+    except Exception:
+        raise _InitTimeout(f"UNAVAILABLE: backend init hung for {timeout_s:.0f}s")
+    if kind == "err":
+        raise value
+    return value
+
+
+def _init_backend(attempts: int = 4, base_delay: float = 3.0, init_timeout: float = 90.0):
+    """Backend init with retry; clears jax's cached per-platform init failure between
     attempts (without that, every retry just re-raises the first error instantly)."""
     import jax
 
     for i in range(attempts):
         try:
-            return jax.devices()
+            return _devices_with_timeout(init_timeout)
+        except _InitTimeout:
+            # The abandoned thread still holds jax's backend-init lock — any retry would
+            # just block on that lock and time out again. Fail fast with structured JSON.
+            raise
         except Exception as e:  # noqa: BLE001
             if not _is_transient(e) or i == attempts - 1:
                 raise
@@ -88,14 +125,29 @@ def _init_backend(attempts: int = 5, base_delay: float = 3.0):
                 xla_bridge.backends.cache_clear()
 
 
+_SELF_RECORD = "BENCH_SELF.json"  # last successful real-chip result (written on success)
+
+
 def _fail_json(metric: str, stage: str, exc: BaseException) -> None:
-    print(json.dumps({
+    out = {
         "metric": metric,
         "value": None,
         "unit": "MFU",
         "vs_baseline": None,
         "error": f"{stage}: {type(exc).__name__}: {str(exc).splitlines()[0][:300]}",
-    }))
+    }
+    # The remote-TPU tunnel in this environment goes down for hours at a time (it took out
+    # round 1's bench the same way). Attach the last successful self-recorded run so a
+    # transport outage doesn't erase the measurement entirely.
+    try:
+        import os
+
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), _SELF_RECORD)
+        with open(path) as f:
+            out["last_known_good"] = json.load(f)
+    except Exception:
+        pass
+    print(json.dumps(out))
     traceback.print_exc(file=sys.stderr)
 
 
@@ -192,6 +244,19 @@ def run(B: int, S: int, fuse: int, preset: str | None):
     if preset:
         out["preset"] = preset
     print(json.dumps(out))
+    if not preset and jax.default_backend() != "cpu":
+        # Persist the real-chip result for _fail_json's last-known-good fallback.
+        import datetime
+        import os
+
+        rec = dict(out)
+        rec["recorded_at"] = datetime.datetime.now(datetime.timezone.utc).isoformat()
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), _SELF_RECORD)
+        try:
+            with open(path, "w") as f:
+                json.dump(rec, f)
+        except OSError:
+            pass
 
 
 def _metric_label(B: int, S: int, fuse: int, preset: str | None, cfg=None) -> str:
@@ -206,6 +271,7 @@ def _metric_label(B: int, S: int, fuse: int, preset: str | None, cfg=None) -> st
 
 def main():
     import os
+    import threading
 
     preset = os.environ.get("BENCH_PRESET")
     B = int(os.environ.get("BENCH_B", "4"))
@@ -213,16 +279,40 @@ def main():
     fuse = int(os.environ.get("BENCH_FUSE", "4"))
     metric = _metric_label(B, S, fuse, preset)
 
+    if preset == "smoke":
+        # The smoke preset is a CI/CPU logic check by definition — force the CPU backend
+        # past any sitecustomize platform pin so it can never hang on a dead TPU tunnel.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+    # Last-resort watchdog: if ANYTHING (a half-up tunnel can hang mid-compile, after
+    # backend init succeeded) stalls the run, still emit the structured JSON line before
+    # the driver's outer timeout turns the whole round into an unparseable rc=124.
+    done = threading.Event()
+
+    def _watchdog():
+        budget = float(os.environ.get("BENCH_WATCHDOG_S", "900"))
+        if not done.wait(budget):
+            _fail_json(metric, "watchdog", TimeoutError(f"run exceeded {budget:.0f}s"))
+            sys.stdout.flush()
+            os._exit(0)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+
     try:
         _init_backend()
     except Exception as e:  # noqa: BLE001
         _fail_json(metric, "backend init", e)
+        done.set()
         return 0  # structured output was produced; don't fail the driver parse
 
     transient_left = 3
     while True:
         try:
             run(B, S, fuse, preset)
+            done.set()
             return 0
         except Exception as e:  # noqa: BLE001
             from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
@@ -242,6 +332,7 @@ def main():
                 time.sleep(10)
                 continue
             _fail_json(metric, "bench run", e)
+            done.set()
             return 0
 
 
