@@ -277,11 +277,15 @@ def tpu_pod_launcher(args) -> int:
     def make_plan(coordinator: str):
         plans = []
         for rank in range(num_hosts):
+            import shlex
+
+            quoted = " ".join(shlex.quote(f) for f in inner_flags)
+            script_args = " ".join(shlex.quote(a) for a in (args.training_script_args or []))
             inner = (
-                f"ACCELERATE_COORDINATOR_ADDRESS={coordinator} "
+                f"ACCELERATE_COORDINATOR_ADDRESS={shlex.quote(coordinator)} "
                 f"ACCELERATE_NUM_PROCESSES={num_hosts} ACCELERATE_PROCESS_ID={rank} "
-                f"accelerate-tpu launch {' '.join(inner_flags)} {args.training_script} "
-                + " ".join(args.training_script_args or [])
+                f"accelerate-tpu launch {quoted} {shlex.quote(args.training_script)} "
+                + script_args
             )
             cmd = [
                 "gcloud", "compute", "tpus", "tpu-vm", "ssh", args.tpu_name,

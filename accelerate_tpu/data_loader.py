@@ -533,6 +533,8 @@ class DataLoaderShard(_PreparedDataLoader):
             except StopIteration:
                 return
             batch_index = 0
+            if skip == 0:
+                current_batch = self._place(current_batch)
             while True:
                 try:
                     next_batch = next(dataloader_iter)
@@ -541,11 +543,18 @@ class DataLoaderShard(_PreparedDataLoader):
                 if next_batch is None:
                     self.end_of_dataloader = True
                     self.remainder = self._final_remainder()
+                elif batch_index + 1 >= skip:
+                    # Device placement at FETCH time, one batch ahead of the yield:
+                    # jax.device_put is asynchronous, so the next batch's H2D transfer
+                    # overlaps the consumer's current step even when the consumer blocks on
+                    # metrics between steps (the MpDeviceLoaderWrapper background-transfer
+                    # analog, reference data_loader.py:646).
+                    next_batch = self._place(next_batch)
                 if batch_index >= skip:
                     # Count BEFORE the yield: the generator suspends there, so a state_dict
                     # taken between batches must already include the batch just handed out.
                     self.batches_yielded = batch_index + 1
-                    yield self._place(current_batch)
+                    yield current_batch
                 if next_batch is None:
                     break
                 current_batch = next_batch

@@ -127,6 +127,12 @@ def _init_backend(attempts: int = 4, base_delay: float = 3.0, init_timeout: floa
 
 _SELF_RECORD = "BENCH_SELF.json"  # last successful real-chip result (written on success)
 
+import threading as _threading
+
+# Set the instant a result line (success or structured failure) hits stdout: the watchdog
+# must never append a second JSON line after a real one (consumers parse the last line).
+_RESULT_PRINTED = _threading.Event()
+
 
 def _fail_json(metric: str, stage: str, exc: BaseException) -> None:
     out = {
@@ -144,10 +150,15 @@ def _fail_json(metric: str, stage: str, exc: BaseException) -> None:
 
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)), _SELF_RECORD)
         with open(path) as f:
-            out["last_known_good"] = json.load(f)
+            rec = json.load(f)
+        # Same-config records back the failed metric directly; a different config's record
+        # is still worth surfacing but must not read as comparable.
+        key = "last_known_good" if rec.get("metric") == metric else "last_known_good_other_config"
+        out[key] = rec
     except Exception:
         pass
     print(json.dumps(out))
+    _RESULT_PRINTED.set()
     traceback.print_exc(file=sys.stderr)
 
 
@@ -244,6 +255,7 @@ def run(B: int, S: int, fuse: int, preset: str | None):
     if preset:
         out["preset"] = preset
     print(json.dumps(out))
+    _RESULT_PRINTED.set()
     if not preset and jax.default_backend() != "cpu":
         # Persist the real-chip result for _fail_json's last-known-good fallback.
         import datetime
@@ -290,11 +302,9 @@ def main():
     # Last-resort watchdog: if ANYTHING (a half-up tunnel can hang mid-compile, after
     # backend init succeeded) stalls the run, still emit the structured JSON line before
     # the driver's outer timeout turns the whole round into an unparseable rc=124.
-    done = threading.Event()
-
     def _watchdog():
         budget = float(os.environ.get("BENCH_WATCHDOG_S", "900"))
-        if not done.wait(budget):
+        if not _RESULT_PRINTED.wait(budget):
             _fail_json(metric, "watchdog", TimeoutError(f"run exceeded {budget:.0f}s"))
             sys.stdout.flush()
             os._exit(0)
@@ -305,14 +315,12 @@ def main():
         _init_backend()
     except Exception as e:  # noqa: BLE001
         _fail_json(metric, "backend init", e)
-        done.set()
         return 0  # structured output was produced; don't fail the driver parse
 
     transient_left = 3
     while True:
         try:
             run(B, S, fuse, preset)
-            done.set()
             return 0
         except Exception as e:  # noqa: BLE001
             from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
@@ -332,7 +340,6 @@ def main():
                 time.sleep(10)
                 continue
             _fail_json(metric, "bench run", e)
-            done.set()
             return 0
 
 

@@ -1,0 +1,124 @@
+"""MFU sweep: run bench.py across kernel/remat/batch configurations on the real chip.
+
+Drives the repo-root ``bench.py`` (one subprocess per config, so a hung run can't poison the
+next) and appends every JSON result line to ``--out`` (default sweep_results.jsonl at the
+repo root, gitignored). With ``--wait-for-tpu`` it polls until the TPU transport answers a
+small matmul before starting — the remote tunnel in this environment goes down for hours,
+and the sweep should fire the moment it recovers.
+
+Each config is env-var overrides consumed by bench.py / ops.flash_attention:
+    BENCH_B / BENCH_S / BENCH_FUSE / BENCH_REMAT / BENCH_REMAT_POLICY / BENCH_ATTN
+    ACCEL_FLASH_BLOCK_Q / ACCEL_FLASH_BLOCK_K
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (name, env overrides). Ordered: baseline first, then one-knob deltas, then combos.
+CONFIGS = [
+    ("baseline_b4_flash_full_f4", {}),
+    ("attn_xla", {"BENCH_ATTN": "xla"}),
+    ("remat_dots", {"BENCH_REMAT_POLICY": "dots"}),
+    ("blocks_128x128", {"ACCEL_FLASH_BLOCK_Q": "128", "ACCEL_FLASH_BLOCK_K": "128"}),
+    ("blocks_512x512", {"ACCEL_FLASH_BLOCK_Q": "512", "ACCEL_FLASH_BLOCK_K": "512"}),
+    ("blocks_256x1024", {"ACCEL_FLASH_BLOCK_Q": "256", "ACCEL_FLASH_BLOCK_K": "1024"}),
+    ("b8", {"BENCH_B": "8"}),
+    ("fuse8", {"BENCH_FUSE": "8"}),
+    ("b8_dots", {"BENCH_B": "8", "BENCH_REMAT_POLICY": "dots"}),
+    ("noremat_b2", {"BENCH_REMAT": "0", "BENCH_B": "2"}),
+    ("seq4096_b2", {"BENCH_S": "4096", "BENCH_B": "2"}),
+]
+
+
+def tpu_alive(timeout_s: float = 45.0) -> bool:
+    probe = (
+        "import jax, numpy as np, jax.numpy as jnp\n"
+        "y = jnp.ones((256,256), jnp.bfloat16) @ jnp.ones((256,256), jnp.bfloat16)\n"
+        "assert float(np.asarray(y)[0,0]) == 256.0\n"
+        "assert jax.default_backend() != 'cpu'\n"
+        "print('ALIVE')\n"
+    )
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", probe], capture_output=True, text=True, timeout=timeout_s
+        )
+        return "ALIVE" in out.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def run_config(name: str, env_over: dict, per_run_timeout: float) -> dict:
+    env = {**os.environ, **env_over, "BENCH_WATCHDOG_S": str(int(per_run_timeout - 30))}
+    t0 = time.time()
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, text=True, timeout=per_run_timeout, env=env, cwd=REPO,
+        )
+        line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else "{}"
+        row = json.loads(line)
+    except subprocess.TimeoutExpired:
+        row = {"value": None, "error": f"sweep: config timed out after {per_run_timeout}s"}
+    except (json.JSONDecodeError, IndexError):
+        row = {"value": None, "error": "sweep: unparseable bench output"}
+    row["sweep_config"] = name
+    row["sweep_env"] = env_over
+    row["wall_s"] = round(time.time() - t0, 1)
+    return row
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--out", default=os.path.join(REPO, "sweep_results.jsonl"))
+    p.add_argument("--wait-for-tpu", action="store_true",
+                   help="Poll until the TPU answers, then sweep.")
+    p.add_argument("--poll-interval", type=float, default=300.0)
+    p.add_argument("--max-wait-hours", type=float, default=12.0)
+    p.add_argument("--per-run-timeout", type=float, default=600.0)
+    p.add_argument("--only", default=None, help="Comma-separated config-name filter.")
+    args = p.parse_args()
+
+    if args.wait_for_tpu:
+        deadline = time.time() + args.max_wait_hours * 3600
+        while not tpu_alive():
+            if time.time() > deadline:
+                print("sweep: TPU never came back; giving up", file=sys.stderr)
+                return 1
+            print(f"sweep: TPU down, re-probing in {args.poll_interval:.0f}s",
+                  file=sys.stderr, flush=True)
+            time.sleep(args.poll_interval)
+    elif not tpu_alive():
+        print("sweep: TPU not reachable (use --wait-for-tpu to poll)", file=sys.stderr)
+        return 1
+
+    names = set(args.only.split(",")) if args.only else None
+    best = None
+    for name, env_over in CONFIGS:
+        if names and name not in names:
+            continue
+        # Between configs the tunnel can die again; skip fast rather than eat the timeout.
+        if not tpu_alive():
+            print(f"sweep: TPU went away before {name}; stopping", file=sys.stderr)
+            break
+        row = run_config(name, env_over, args.per_run_timeout)
+        with open(args.out, "a") as f:
+            f.write(json.dumps(row) + "\n")
+        mfu = row.get("value")
+        print(f"{name:24s} MFU={mfu}  ({row.get('error', 'ok')})", flush=True)
+        if mfu is not None and (best is None or mfu > best[1]):
+            best = (name, mfu)
+    if best:
+        print(f"sweep: best = {best[0]} at MFU {best[1]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
