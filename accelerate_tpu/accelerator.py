@@ -1187,7 +1187,14 @@ class Accelerator:
                 return tracker.tracker if unwrap else tracker
         raise ValueError(f"Tracker {name} not initialized")
 
+    def wait_for_checkpoint(self):
+        """Join any in-flight ``save_state(async_save=True)`` disk write."""
+        from .checkpointing import wait_for_async_save
+
+        wait_for_async_save()
+
     def end_training(self):
+        self.wait_for_checkpoint()
         for tracker in self.trackers:
             tracker.finish()
         self.wait_for_everyone()

@@ -42,7 +42,13 @@ from .utils.imports import is_safetensors_available, is_torch_available
 
 logger = get_logger(__name__)
 
-__all__ = ["save_accelerator_state", "load_accelerator_state", "save_custom_state", "load_custom_state"]
+__all__ = [
+    "save_accelerator_state",
+    "load_accelerator_state",
+    "save_custom_state",
+    "load_custom_state",
+    "wait_for_async_save",
+]
 
 
 def _checkpoint_dir(accelerator, output_dir: Optional[str], for_save: bool) -> Path:
@@ -76,13 +82,45 @@ def _rotate_checkpoints(accelerator, base: Path) -> None:
         shutil.rmtree(victim, ignore_errors=True)
 
 
+# Persistent async checkpointer (orbax keeps a background thread pool; one per process).
+# Created lazily on the first async save; ``wait_for_async_save`` joins any in-flight write.
+_ASYNC_CKPTR = None
+
+
+def _async_checkpointer():
+    global _ASYNC_CKPTR
+    if _ASYNC_CKPTR is None:
+        import orbax.checkpoint as ocp
+
+        _ASYNC_CKPTR = ocp.StandardCheckpointer()
+    return _ASYNC_CKPTR
+
+
+def wait_for_async_save() -> None:
+    """Block until any in-flight async checkpoint write has committed to disk."""
+    if _ASYNC_CKPTR is not None:
+        _ASYNC_CKPTR.wait_until_finished()
+
+
 def save_accelerator_state(
     accelerator,
     output_dir: Optional[str] = None,
     train_state=None,
     safe_serialization: bool = False,
+    async_save: bool = False,
 ) -> str:
-    """Write a full resumable snapshot. Returns the checkpoint path."""
+    """Write a full resumable snapshot. Returns the checkpoint path.
+
+    ``async_save=True`` (sharded format only): the device→host copy happens synchronously
+    (so donated train steps may immediately reuse the buffers) but the disk write runs in
+    orbax's background threads — training resumes while the snapshot commits. The next
+    save (or :func:`wait_for_async_save` / ``Accelerator.end_training``) joins the write.
+    The reference has no async path (single-file torch pickles, SURVEY.md §5).
+    """
+    # Unconditionally join any in-flight async write FIRST: rotation below may delete the
+    # very directory that write targets, and a sync save to the same path would rmtree it
+    # mid-write — both would corrupt the snapshot.
+    wait_for_async_save()
     project = accelerator.project_configuration
     automatic = output_dir is None and project.automatic_checkpoint_naming
     if automatic:
@@ -105,6 +143,11 @@ def save_accelerator_state(
         full_file = path / f"{MODEL_NAME}_full.pkl"
         sharded_dir = (path / SHARDED_STATE_DIR).absolute()
         if full:
+            if async_save:
+                logger.warning(
+                    "async_save is only supported for the sharded format; "
+                    "FULL_STATE_DICT saves synchronously", main_process_only=True,
+                )
             from .parallel.fsdp import gather_full_params
 
             # The allgather is a collective — EVERY process must run it; only rank 0 writes
@@ -124,8 +167,11 @@ def save_accelerator_state(
                 shutil.rmtree(sharded_dir)
             if full_file.exists() and accelerator.is_main_process:
                 full_file.unlink()  # same: a stale FULL file would shadow this save on load
-            with ocp.StandardCheckpointer() as ckptr:
-                ckptr.save(sharded_dir, train_state)
+            if async_save:
+                _async_checkpointer().save(sharded_dir, train_state)
+            else:
+                with ocp.StandardCheckpointer() as ckptr:
+                    ckptr.save(sharded_dir, train_state)
         # 1b. Optional interchange export: consolidated safetensors of the params.
         if safe_serialization and accelerator.is_main_process:
             _export_safetensors(train_state.params, path / SAFE_WEIGHTS_NAME)
@@ -184,6 +230,7 @@ def load_accelerator_state(
     load_optimizer_states: bool = True,
 ):
     """Restore a snapshot. Returns the restored TrainState (or None if none was given)."""
+    wait_for_async_save()  # never read a directory whose write hasn't committed
     path = _checkpoint_dir(accelerator, input_dir, for_save=False)
     if not path.exists():
         raise FileNotFoundError(f"Checkpoint {path} does not exist")

@@ -274,13 +274,14 @@ def tpu_pod_launcher(args) -> int:
         raise ValueError("--tpu-pod with multiple hosts requires --main-process-ip "
                          "(the internal IP of worker 0).")
     inner_flags = _forwarded_flags(args)
+    import shlex
+
+    quoted = " ".join(shlex.quote(f) for f in inner_flags)
+    script_args = " ".join(shlex.quote(a) for a in (args.training_script_args or []))
+
     def make_plan(coordinator: str):
         plans = []
         for rank in range(num_hosts):
-            import shlex
-
-            quoted = " ".join(shlex.quote(f) for f in inner_flags)
-            script_args = " ".join(shlex.quote(a) for a in (args.training_script_args or []))
             inner = (
                 f"ACCELERATE_COORDINATOR_ADDRESS={shlex.quote(coordinator)} "
                 f"ACCELERATE_NUM_PROCESSES={num_hosts} ACCELERATE_PROCESS_ID={rank} "

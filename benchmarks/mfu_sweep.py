@@ -56,7 +56,8 @@ def tpu_alive(timeout_s: float = 45.0) -> bool:
 
 
 def run_config(name: str, env_over: dict, per_run_timeout: float) -> dict:
-    env = {**os.environ, **env_over, "BENCH_WATCHDOG_S": str(int(per_run_timeout - 30))}
+    env = {**os.environ, **env_over,
+           "BENCH_WATCHDOG_S": str(max(60, int(per_run_timeout - 30)))}
     t0 = time.time()
     try:
         out = subprocess.run(

@@ -13,6 +13,7 @@ from accelerate_tpu import Accelerator
 from accelerate_tpu.parallel import MeshConfig
 from accelerate_tpu.data_loader import DataLoader
 from accelerate_tpu.utils import FullyShardedDataParallelPlugin, ProjectConfiguration
+from accelerate_tpu.utils import send_to_device
 
 from test_accelerator import RegressionDataset, init_params, loss_fn
 
@@ -140,3 +141,31 @@ def test_resume_mid_epoch(tmp_path):
     remaining = list(acc.skip_first_batches(dl, 2))
     assert len(remaining) == 2
     np.testing.assert_allclose(np.asarray(remaining[0]["y"]), ds.y[32:48], rtol=1e-6)
+
+
+def test_async_save_roundtrip(tmp_path):
+    """async_save: donated/overwritten buffers after save must not corrupt the snapshot."""
+    import dataclasses as _dc
+
+    import optax
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import llama
+
+    acc = Accelerator()
+    cfg = _dc.replace(llama.CONFIGS["tiny"], dtype=jnp.float32)
+    state = acc.create_train_state(llama.init_params(cfg), optax.sgd(0.1))
+    step = acc.build_train_step(lambda p, b: llama.loss_fn(p, b, cfg))
+    batch = send_to_device(
+        {"tokens": np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 17)).astype(np.int32)},
+        acc.mesh,
+    )
+    state, _ = step(state, batch)
+    want = jax.tree_util.tree_map(np.asarray, state.params)
+    acc.save_state(str(tmp_path / "ck"), train_state=state, async_save=True)
+    # Immediately train on (donate) the state while the disk write is in flight.
+    for _ in range(3):
+        state, _ = step(state, batch)
+    acc.wait_for_checkpoint()
+    restored = acc.load_state(str(tmp_path / "ck"), train_state=state)
+    for a, b in zip(jax.tree_util.tree_leaves(want), jax.tree_util.tree_leaves(restored.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
