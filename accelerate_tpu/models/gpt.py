@@ -269,6 +269,10 @@ def forward(
 
 
 def loss_fn(params: dict, batch: dict, cfg: GPTConfig, rng=None) -> jax.Array:
+    if "segment_ids" in batch:
+        raise NotImplementedError(
+            "sample packing (segment_ids) is currently supported by the llama family only"
+        )
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     logits = forward(params, inputs, cfg)

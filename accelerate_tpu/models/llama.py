@@ -37,6 +37,8 @@ __all__ = [
     "forward_streamed",
     "loss_fn",
     "loss_fn_pp",
+    "segment_mask",
+    "segment_positions",
     "partition_specs",
     "CONFIGS",
     "init_cache",
@@ -359,18 +361,49 @@ def _maybe_remat_block(cfg: LlamaConfig):
     return jax.checkpoint(_block, static_argnums=(4,), policy=policy)
 
 
+def segment_positions(segment_ids: jax.Array) -> jax.Array:
+    """Per-segment 0-based positions [B, S] from contiguous ``segment_ids`` (packed rows):
+    position = index - index_of_segment_start."""
+    B, S = segment_ids.shape
+    idx = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    change = jnp.concatenate(
+        [jnp.ones((B, 1), bool), segment_ids[:, 1:] != segment_ids[:, :-1]], axis=1
+    )
+    starts = jax.lax.associative_scan(jnp.maximum, jnp.where(change, idx, 0), axis=1)
+    return jnp.where(segment_ids != 0, idx - starts, 0)
+
+
+def segment_mask(segment_ids: jax.Array) -> jax.Array:
+    """Packed-row attention mask [B, S, S]: causal AND same-segment AND not padding.
+
+    ``segment_ids`` [B, S] as produced by ``ops.packing.pack_sequences`` (0 = pad,
+    1..k = packed sequences). Sequences in one row cannot attend to each other.
+    """
+    S = segment_ids.shape[1]
+    causal = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None]
+    same = segment_ids[:, :, None] == segment_ids[:, None, :]
+    live = (segment_ids != 0)[:, None, :]
+    return causal & same & live
+
+
 def forward_hidden(
     params: dict,
     tokens: jax.Array,
     cfg: LlamaConfig,
     positions: Optional[jax.Array] = None,
     shard_activations: bool = True,
+    segment_ids: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, jax.Array]:
     """Backbone: tokens [B, S] → (final hidden states [B, S, D] after ln_f, MoE aux loss).
 
     Activation sharding constraints pin the batch dim to ``(dp, fsdp)`` and the sequence dim
     to ``sp`` so GSPMD propagates a consistent layout through every block (naive sequence
     parallelism; ring attention in ``ops/ring_attention.py`` upgrades the attention part).
+
+    ``segment_ids`` (sample packing, ``ops/packing.py``): attention is restricted to the
+    block-diagonal per-segment causal mask; pass the per-segment ``positions`` alongside so
+    RoPE restarts per sequence. The Pallas flash kernel carries only the causal structure,
+    so packed rows route through the masked XLA attention path.
     """
     B, S = tokens.shape
     dtype = cfg.dtype
@@ -379,7 +412,15 @@ def forward_hidden(
     x = params["embed"].astype(dtype)[tokens]
     if shard_activations:
         x = _maybe_shard(x, P(BATCH_AXES, SEQUENCE_AXIS, None))
-    mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
+    if segment_ids is not None:
+        mask = segment_mask(segment_ids)
+        if cfg.attn_impl != "xla":
+            # Only the masked XLA path honors arbitrary attention masks; flash carries only
+            # causal structure and the sp modes (ring/ulysses) take no mask at all — any of
+            # them would silently attend across packed segments.
+            cfg = dataclasses.replace(cfg, attn_impl="xla")
+    else:
+        mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
 
     block = _maybe_remat_block(cfg)
 
@@ -502,12 +543,31 @@ def loss_fn(
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     B, S = inputs.shape
-    mask = (
-        batch["mask"][:, 1:].astype(jnp.float32)
-        if "mask" in batch
-        else jnp.ones((B, S), jnp.float32)
-    )
-    x, aux = forward_hidden(params, inputs, cfg)
+    if "segment_ids" in batch:
+        # Packed rows (ops/packing.py): a position's next-token target is valid only
+        # when the next slot continues the SAME segment (never across a boundary or
+        # into padding), and attention/positions are per-segment.
+        seg = batch["segment_ids"]
+        mask = ((seg[:, 1:] == seg[:, :-1]) & (seg[:, 1:] != 0)).astype(jnp.float32)
+        if "mask" in batch:
+            mask = mask * batch["mask"][:, 1:].astype(jnp.float32)
+        positions = (
+            batch["positions"][:, :-1]
+            if "positions" in batch
+            # Without explicit positions, derive them — continuous arange positions would
+            # silently run RoPE across segment boundaries.
+            else segment_positions(seg[:, :-1])
+        )
+        x, aux = forward_hidden(
+            params, inputs, cfg, positions=positions, segment_ids=seg[:, :-1]
+        )
+    else:
+        mask = (
+            batch["mask"][:, 1:].astype(jnp.float32)
+            if "mask" in batch
+            else jnp.ones((B, S), jnp.float32)
+        )
+        x, aux = forward_hidden(params, inputs, cfg)
     ce = _ce_from_hidden(x, params, targets, mask, cfg)
     if cfg.moe_experts > 0:
         return ce + cfg.moe_aux_weight * aux

@@ -310,6 +310,10 @@ def loss_fn(params: dict, batch: dict, cfg: T5Config, rng=None) -> jax.Array:
     Decoder inputs are the labels shifted right with ``decoder_start_token_id`` (the HF
     ``_shift_right`` convention); label positions equal to -100 are ignored.
     """
+    if "segment_ids" in batch:
+        raise NotImplementedError(
+            "sample packing (segment_ids) is currently supported by the llama family only"
+        )
     labels = batch["labels"]
     start = jnp.full((labels.shape[0], 1), cfg.decoder_start_token_id, labels.dtype)
     dec_in = jnp.concatenate([start, jnp.maximum(labels[:, :-1], 0)], axis=1)

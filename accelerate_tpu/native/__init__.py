@@ -1,0 +1,7 @@
+"""Native (C++) components, loaded via ctypes with pure-Python fallbacks.
+
+The reference delegates its native-performance concerns to external engines (NCCL,
+DeepSpeed, bitsandbytes, ...); here the device-side equivalents are XLA/Pallas programs,
+and the HOST-side hot loops that remain (data-path work like sequence packing) live in
+this package as small C-ABI libraries built on demand with g++ (``ops/packing.py``).
+"""

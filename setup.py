@@ -8,6 +8,7 @@ setup(
     description="TPU-native (JAX/XLA/pjit/Pallas) training & inference framework with the "
     "capabilities of HuggingFace Accelerate",
     packages=find_packages(include=["accelerate_tpu", "accelerate_tpu.*"]),
+    package_data={"accelerate_tpu.native": ["*.cpp"]},
     python_requires=">=3.10",
     install_requires=["jax", "numpy", "optax", "orbax-checkpoint", "safetensors", "pyyaml"],
     entry_points={
