@@ -277,7 +277,7 @@ def _attention_xla(q, k, v, mask, cfg: LlamaConfig):
     return jnp.einsum("bhst,bthd->bshd", probs, v)
 
 
-def _attention(q, k, v, mask, cfg: LlamaConfig):
+def _attention(q, k, v, mask, cfg: LlamaConfig, segment_ids=None):
     impl = cfg.attn_impl
     if impl in ("ring", "ulysses", "allgather"):
         # Sequence-parallel attention over the sp mesh axis (requires an active mesh
@@ -295,7 +295,8 @@ def _attention(q, k, v, mask, cfg: LlamaConfig):
         try:
             from ..ops.flash_attention import flash_attention
 
-            return flash_attention(q, k, v, causal=True)
+            # Packed rows stay on the flash path: the kernels take segment ids directly.
+            return flash_attention(q, k, v, causal=True, segment_ids=segment_ids)
         except Exception:  # pragma: no cover - kernel unavailable on this backend
             pass
     return _attention_xla(q, k, v, mask, cfg)
@@ -315,7 +316,7 @@ def _proj(h, w, cfg: LlamaConfig):
     return h @ w.astype(cfg.dtype)
 
 
-def _block(x, layer, positions, mask, cfg: LlamaConfig):
+def _block(x, layer, positions, mask, cfg: LlamaConfig, segment_ids=None):
     """One transformer block → (x, moe_aux_loss) (aux is 0.0 for dense MLPs)."""
     B, S, D = x.shape
     h = _rms_norm(x, layer["ln_attn"], cfg.norm_eps)
@@ -324,7 +325,9 @@ def _block(x, layer, positions, mask, cfg: LlamaConfig):
     v = _proj(h, layer["wv"], cfg).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    attn = _attention(q, k, v, mask, cfg).reshape(B, S, cfg.n_heads * cfg.head_dim)
+    attn = _attention(q, k, v, mask, cfg, segment_ids).reshape(
+        B, S, cfg.n_heads * cfg.head_dim
+    )
     x = x + _proj(attn, layer["wo"], cfg)
     h = _rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
     if cfg.moe_experts > 0:
@@ -401,24 +404,28 @@ def forward_hidden(
     parallelism; ring attention in ``ops/ring_attention.py`` upgrades the attention part).
 
     ``segment_ids`` (sample packing, ``ops/packing.py``): attention is restricted to the
-    block-diagonal per-segment causal mask; pass the per-segment ``positions`` alongside so
-    RoPE restarts per sequence. The Pallas flash kernel carries only the causal structure,
-    so packed rows route through the masked XLA attention path.
+    block-diagonal per-segment causal mask — in-kernel on the flash path, via the explicit
+    mask on the XLA path — and positions default to per-segment RoPE restarts (derived from
+    the segment ids when not given). The sequence-parallel modes take no mask and fall back.
     """
     B, S = tokens.shape
     dtype = cfg.dtype
     if positions is None:
-        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        positions = (
+            # Continuous arange positions would silently run RoPE across segment boundaries.
+            segment_positions(segment_ids)
+            if segment_ids is not None
+            else jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        )
     x = params["embed"].astype(dtype)[tokens]
     if shard_activations:
         x = _maybe_shard(x, P(BATCH_AXES, SEQUENCE_AXIS, None))
     if segment_ids is not None:
         mask = segment_mask(segment_ids)
-        if cfg.attn_impl != "xla":
-            # Only the masked XLA path honors arbitrary attention masks; flash carries only
-            # causal structure and the sp modes (ring/ulysses) take no mask at all — any of
-            # them would silently attend across packed segments.
-            cfg = dataclasses.replace(cfg, attn_impl="xla")
+        if cfg.attn_impl in ("ring", "ulysses", "allgather"):
+            # The sp attention modes take no mask and would silently attend across packed
+            # segments; flash handles segments IN-KERNEL, xla takes the mask.
+            cfg = dataclasses.replace(cfg, attn_impl="auto")
     else:
         mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
 
@@ -427,7 +434,7 @@ def forward_hidden(
     aux_total = jnp.zeros((), jnp.float32)
     if cfg.scan_layers:
         def scan_body(carry, layer):
-            out, aux = block(carry, layer, positions, mask, cfg)
+            out, aux = block(carry, layer, positions, mask, cfg, segment_ids)
             if shard_activations:
                 out = _maybe_shard(out, P(BATCH_AXES, SEQUENCE_AXIS, None))
             return out, aux
@@ -436,7 +443,7 @@ def forward_hidden(
         aux_total = jnp.sum(auxes)
     else:
         for layer in params["layers"]:
-            x, aux = block(x, layer, positions, mask, cfg)
+            x, aux = block(x, layer, positions, mask, cfg, segment_ids)
             aux_total = aux_total + aux
             if shard_activations:
                 x = _maybe_shard(x, P(BATCH_AXES, SEQUENCE_AXIS, None))
@@ -629,7 +636,12 @@ def loss_fn_pp(
     num_microbatches: Optional[int] = None,
     rng: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Pipeline-parallel next-token cross-entropy (same contract as ``loss_fn``)."""
+    """Pipeline-parallel next-token cross-entropy (same contract as ``loss_fn``, except
+    sample packing: ``forward_pp`` has no segment-mask plumbing yet)."""
+    if "segment_ids" in batch:
+        raise NotImplementedError(
+            "sample packing (segment_ids) is not supported on the pipeline-parallel path"
+        )
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     B, S = inputs.shape

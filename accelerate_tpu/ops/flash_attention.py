@@ -70,9 +70,14 @@ def _smem_scalar_spec():
 
 # ------------------------------------------------------------------------------ forward
 def _fwd_kernel(
-    q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref,
-    *, sm_scale, causal, block_q, block_k, kv_len,
+    q_off_ref, kv_off_ref, *refs,
+    sm_scale, causal, block_q, block_k, kv_len, has_segments,
 ):
+    if has_segments:
+        (q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref,
+         o_ref, lse_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     i = pl.program_id(2)  # q block
     j = pl.program_id(3)  # kv block
     nk = pl.num_programs(3)
@@ -110,12 +115,20 @@ def _fwd_kernel(
         if causal:
             row = q_off + q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             mask = jnp.logical_and(mask, kv_off + col_local <= row)
+        if has_segments:
+            # Packed rows: attend only within the same segment; segment 0 is padding.
+            sq = q_seg_ref[0][:, None]
+            sk = kv_seg_ref[0][None, :]
+            mask = jnp.logical_and(mask, jnp.logical_and(sq == sk, sk != 0))
         s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[:]                       # [block_q, 1]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
-        p = jnp.exp(s - m_new)                  # fp32; row-sum in fp32 before any cast
+        # Mask p explicitly: on a FULLY-masked row (packed-padding slots) every s equals
+        # _NEG_INF and so does m_new, making exp(s - m_new) = 1 — the row sum l must still
+        # be 0 so the finalize step emits zeros / -inf lse.
+        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)  # fp32; row-sum in fp32 pre-cast
         l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
             p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
@@ -133,7 +146,16 @@ def _fwd_kernel(
         lse_ref[0, 0] = lse  # [block_q, 1]
 
 
-def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, q_offset=0, kv_offset=0):
+def _seg_blocks(segments, Sp, Tp):
+    """Pad + split packed segment ids into (q_seg [B,Sp], kv_seg [B,Tp]) int32 (pad = 0)."""
+    seg = jnp.asarray(segments, jnp.int32)
+    q_seg = jnp.pad(seg, ((0, 0), (0, Sp - seg.shape[1])))
+    kv_seg = jnp.pad(seg, ((0, 0), (0, Tp - seg.shape[1])))
+    return q_seg, kv_seg
+
+
+def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, q_offset=0, kv_offset=0,
+         segments=None):
     """Raw forward: [B,H,S,hd] → (o [B,H,S,hd], lse [B,H,S] fp32). Differentiation-free."""
     B, H, S, hd = q.shape
     T = k.shape[2]
@@ -143,17 +165,28 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, q_offset=0, kv_
     q = _pad_seq(q, Sp)
     k = _pad_seq(k, Tp)
     v = _pad_seq(v, Tp)
+    has_segments = segments is not None
 
     kernel = functools.partial(
         _fwd_kernel,
         sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k, kv_len=T,
+        has_segments=has_segments,
     )
+    seg_specs, seg_args = [], []
+    if has_segments:
+        q_seg, kv_seg = _seg_blocks(segments, Sp, Tp)
+        seg_specs = [
+            pl.BlockSpec((1, block_q), lambda b, h, i, j: (b, i)),
+            pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, j)),
+        ]
+        seg_args = [q_seg, kv_seg]
     o, lse = pl.pallas_call(
         kernel,
         grid=(B, H, nq, nk),
         in_specs=[
             _smem_scalar_spec(),
             _smem_scalar_spec(),
+            *seg_specs,
             pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h, j, 0)),
@@ -172,15 +205,20 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, q_offset=0, kv_
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         interpret=interpret,
-    )(_scalar(q_offset), _scalar(kv_offset), q, k, v)
+    )(_scalar(q_offset), _scalar(kv_offset), *seg_args, q, k, v)
     return o[:, :, :S], lse[:, :, :S, 0]
 
 
 # ------------------------------------------------------------------------------ backward
 def _bwd_dq_kernel(
-    q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc,
-    *, sm_scale, causal, block_q, block_k, kv_len,
+    q_off_ref, kv_off_ref, *refs,
+    sm_scale, causal, block_q, block_k, kv_len, has_segments,
 ):
+    if has_segments:
+        (q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dq_ref, dq_acc) = refs
+    else:
+        q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc = refs
     i = pl.program_id(2)
     j = pl.program_id(3)
     nk = pl.num_programs(3)
@@ -214,6 +252,10 @@ def _bwd_dq_kernel(
         if causal:
             row = q_off + q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
             mask = jnp.logical_and(mask, kv_off + col_local <= row)
+        if has_segments:
+            sq = q_seg_ref[0][:, None]
+            sk = kv_seg_ref[0][None, :]
+            mask = jnp.logical_and(mask, jnp.logical_and(sq == sk, sk != 0))
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -229,10 +271,15 @@ def _bwd_dq_kernel(
 
 
 def _bwd_dkv_kernel(
-    q_off_ref, kv_off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-    dk_ref, dv_ref, dk_acc, dv_acc,
-    *, sm_scale, causal, block_q, block_k, kv_len, q_len,
+    q_off_ref, kv_off_ref, *refs,
+    sm_scale, causal, block_q, block_k, kv_len, q_len, has_segments,
 ):
+    if has_segments:
+        (q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
+    else:
+        (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+         dk_ref, dv_ref, dk_acc, dv_acc) = refs
     j = pl.program_id(2)  # kv block (outer)
     i = pl.program_id(3)  # q block (inner)
     ni = pl.num_programs(3)
@@ -267,6 +314,10 @@ def _bwd_dkv_kernel(
         mask = jnp.logical_and(col_local < kv_len, row_local < q_len)
         if causal:
             mask = jnp.logical_and(mask, kv_off + col_local <= q_off + row_local)
+        if has_segments:
+            sq = q_seg_ref[0][:, None]
+            sk = kv_seg_ref[0][None, :]
+            mask = jnp.logical_and(mask, jnp.logical_and(sq == sk, sk != 0))
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -287,7 +338,7 @@ def _bwd_dkv_kernel(
 
 
 def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
-            q_offset=0, kv_offset=0):
+            q_offset=0, kv_offset=0, segments=None):
     """dq for local q against one kv block (ring building block)."""
     B, H, S, hd = q.shape
     T = k.shape[2]
@@ -298,9 +349,19 @@ def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpr
     kp, vp = _pad_seq(k, Tp), _pad_seq(v, Tp)
     lsep = _pad_seq(lse[..., None], Sp)
     deltap = _pad_seq(delta[..., None], Sp)
+    has_segments = segments is not None
+    seg_specs, seg_args = [], []
+    if has_segments:
+        q_seg, kv_seg = _seg_blocks(segments, Sp, Tp)
+        seg_specs = [
+            pl.BlockSpec((1, block_q), lambda b, h, i, j: (b, i)),
+            pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, j)),
+        ]
+        seg_args = [q_seg, kv_seg]
     kernel = functools.partial(
         _bwd_dq_kernel,
         sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k, kv_len=T,
+        has_segments=has_segments,
     )
     dq = pl.pallas_call(
         kernel,
@@ -308,6 +369,7 @@ def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpr
         in_specs=[
             _smem_scalar_spec(),
             _smem_scalar_spec(),
+            *seg_specs,
             pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h, j, 0)),
             pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h, j, 0)),
@@ -319,12 +381,12 @@ def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpr
         out_shape=jax.ShapeDtypeStruct((B, H, Sp, hd), jnp.float32),
         scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
         interpret=interpret,
-    )(_scalar(q_offset), _scalar(kv_offset), qp, kp, vp, dop, lsep, deltap)
+    )(_scalar(q_offset), _scalar(kv_offset), *seg_args, qp, kp, vp, dop, lsep, deltap)
     return dq[:, :, :S]
 
 
 def _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
-             q_offset=0, kv_offset=0):
+             q_offset=0, kv_offset=0, segments=None):
     """(dk, dv) for one kv block against local q (ring building block)."""
     B, H, S, hd = q.shape
     T = k.shape[2]
@@ -335,10 +397,21 @@ def _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interp
     kp, vp = _pad_seq(k, Tp), _pad_seq(v, Tp)
     lsep = _pad_seq(lse[..., None], Sp)
     deltap = _pad_seq(delta[..., None], Sp)
+    has_segments = segments is not None
+    seg_specs, seg_args = [], []
+    if has_segments:
+        q_seg, kv_seg = _seg_blocks(segments, Sp, Tp)
+        # Grid order here is (b, h, j, i): kv block outer, q block inner.
+        seg_specs = [
+            pl.BlockSpec((1, block_q), lambda b, h, j, i: (b, i)),
+            pl.BlockSpec((1, block_k), lambda b, h, j, i: (b, j)),
+        ]
+        seg_args = [q_seg, kv_seg]
     kernel = functools.partial(
         _bwd_dkv_kernel,
         sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k,
         kv_len=T, q_len=S,
+        has_segments=has_segments,
     )
     dk, dv = pl.pallas_call(
         kernel,
@@ -346,6 +419,7 @@ def _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interp
         in_specs=[
             _smem_scalar_spec(),
             _smem_scalar_spec(),
+            *seg_specs,
             pl.BlockSpec((1, 1, block_q, hd), lambda b, h, j, i: (b, h, i, 0)),
             pl.BlockSpec((1, 1, block_k, hd), lambda b, h, j, i: (b, h, j, 0)),
             pl.BlockSpec((1, 1, block_k, hd), lambda b, h, j, i: (b, h, j, 0)),
@@ -366,7 +440,7 @@ def _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interp
             pltpu.VMEM((block_k, hd), jnp.float32),
         ],
         interpret=interpret,
-    )(_scalar(q_offset), _scalar(kv_offset), qp, kp, vp, dop, lsep, deltap)
+    )(_scalar(q_offset), _scalar(kv_offset), *seg_args, qp, kp, vp, dop, lsep, deltap)
     return dk[:, :, :T], dv[:, :, :T]
 
 
@@ -387,30 +461,39 @@ def _fit_block(block: int, seq: int) -> int:
 # Offsets travel as float32 scalars so the custom_vjp has well-defined (zero) cotangents for
 # them; kernels receive them as int32. This is what lets shard_map callers (ring/allgather SP)
 # pass traced global positions.
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
-def _flash_bhsd(q, k, v, q_off, kv_off, causal, sm_scale, block_q, block_k, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _flash_bhsd(q, k, v, q_off, kv_off, seg_f32, causal, sm_scale, block_q, block_k,
+                interpret, has_segments):
+    segs = seg_f32.astype(jnp.int32) if has_segments else None
     o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
-                q_offset=q_off.astype(jnp.int32), kv_offset=kv_off.astype(jnp.int32))
+                q_offset=q_off.astype(jnp.int32), kv_offset=kv_off.astype(jnp.int32),
+                segments=segs)
     return o
 
 
-def _flash_bhsd_fwd(q, k, v, q_off, kv_off, causal, sm_scale, block_q, block_k, interpret):
+def _flash_bhsd_fwd(q, k, v, q_off, kv_off, seg_f32, causal, sm_scale, block_q, block_k,
+                    interpret, has_segments):
+    segs = seg_f32.astype(jnp.int32) if has_segments else None
     o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
-                  q_offset=q_off.astype(jnp.int32), kv_offset=kv_off.astype(jnp.int32))
-    return o, (q, k, v, q_off, kv_off, o, lse)
+                  q_offset=q_off.astype(jnp.int32), kv_offset=kv_off.astype(jnp.int32),
+                  segments=segs)
+    return o, (q, k, v, q_off, kv_off, seg_f32, o, lse)
 
 
-def _flash_bhsd_bwd(causal, sm_scale, block_q, block_k, interpret, residuals, do):
-    q, k, v, q_off, kv_off, o, lse = residuals
+def _flash_bhsd_bwd(causal, sm_scale, block_q, block_k, interpret, has_segments,
+                    residuals, do):
+    q, k, v, q_off, kv_off, seg_f32, o, lse = residuals
     qo = q_off.astype(jnp.int32)
     ko = kv_off.astype(jnp.int32)
+    segs = seg_f32.astype(jnp.int32) if has_segments else None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [B,H,S]
     dq = _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
-                 q_offset=qo, kv_offset=ko)
+                 q_offset=qo, kv_offset=ko, segments=segs)
     dk, dv = _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
-                      q_offset=qo, kv_offset=ko)
+                      q_offset=qo, kv_offset=ko, segments=segs)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
-            jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+            jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
+            jnp.zeros_like(seg_f32))
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
@@ -431,7 +514,8 @@ def _flash_bhsd_offset(q, k, v, q_offset=0, kv_offset=0, causal=True, sm_scale=N
     bk = _fit_block(block_k or _DEFAULT_BLOCK_K, k.shape[1])
     o = _flash_bhsd(qT, kT, vT,
                     jnp.asarray(q_offset, jnp.float32), jnp.asarray(kv_offset, jnp.float32),
-                    causal, sm_scale, bq, bk, interpret)
+                    jnp.zeros((1, 1), jnp.float32),
+                    causal, sm_scale, bq, bk, interpret, False)
     return o.transpose(0, 2, 1, 3)
 
 
@@ -444,10 +528,16 @@ def flash_attention(
     block_q: Optional[int] = None,
     block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Flash attention over user layout q [B, S, H, hd], k/v [B, T, K, hd] (GQA: K ≤ H).
 
     Returns [B, S, H, hd] in q's dtype. Differentiable (custom VJP with flash backward).
+
+    ``segment_ids`` [B, S] (sample packing, ``ops/packing.py``: 0 = pad, 1..k = packed
+    sequences) restricts attention to same-segment pairs IN-KERNEL — packed training keeps
+    the flash memory/compute profile instead of falling back to masked XLA attention.
+    Requires self-attention shapes (T == S).
     """
     B, S, H, hd = q.shape
     K = k.shape[2]
@@ -455,6 +545,8 @@ def flash_attention(
         sm_scale = 1.0 / math.sqrt(hd)
     if interpret is None:
         interpret = _interpret_default()
+    if segment_ids is not None and k.shape[1] != S:
+        raise ValueError("segment_ids requires self-attention shapes (kv length == q length)")
     if H != K:
         reps = H // K
         k = jnp.repeat(k, reps, axis=2)
@@ -466,5 +558,11 @@ def flash_attention(
     block_q = _fit_block(block_q or _DEFAULT_BLOCK_Q, S)
     block_k = _fit_block(block_k or _DEFAULT_BLOCK_K, k.shape[1])
     zero = jnp.zeros((), jnp.float32)
-    o = _flash_bhsd(qT, kT, vT, zero, zero, causal, sm_scale, block_q, block_k, interpret)
+    has_segments = segment_ids is not None
+    seg_f32 = (
+        jnp.asarray(segment_ids, jnp.float32) if has_segments
+        else jnp.zeros((1, 1), jnp.float32)
+    )
+    o = _flash_bhsd(qT, kT, vT, zero, zero, seg_f32, causal, sm_scale, block_q, block_k,
+                    interpret, has_segments)
     return o.transpose(0, 2, 1, 3)

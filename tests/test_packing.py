@@ -126,3 +126,33 @@ def test_positions_derived_from_segments_matches_explicit():
     # the helper itself
     derived = llama.segment_positions(full["segment_ids"])
     np.testing.assert_array_equal(np.asarray(derived), packed["positions"])
+
+
+def test_packed_flash_matches_xla_path():
+    """Packed forward through the segment-aware flash kernel == masked XLA attention."""
+    cfg_x = dataclasses.replace(llama.CONFIGS["tiny"], dtype=jnp.float32, attn_impl="xla")
+    cfg_f = dataclasses.replace(llama.CONFIGS["tiny"], dtype=jnp.float32, attn_impl="flash")
+    params = llama.init_params(cfg_x)
+    rng = np.random.default_rng(5)
+    seqs = [rng.integers(1, cfg_x.vocab_size, int(n)).astype(np.int32) for n in (10, 7, 4)]
+    packed = packing.pack_sequences(seqs, seq_len=16, use_native=False)
+    args = dict(
+        positions=jnp.asarray(packed["positions"]),
+        segment_ids=jnp.asarray(packed["segment_ids"]),
+        shard_activations=False,
+    )
+    tok = jnp.asarray(packed["tokens"])
+    x_xla, _ = llama.forward_hidden(params, tok, cfg_x, **args)
+    x_flash, _ = llama.forward_hidden(params, tok, cfg_f, **args)
+    # Padding slots legitimately differ (flash zeroes fully-masked rows; xla softmax over
+    # all -1e30 yields a uniform average) — they are loss-masked; compare live slots.
+    live = packed["segment_ids"] != 0
+    np.testing.assert_allclose(
+        np.asarray(x_xla)[live], np.asarray(x_flash)[live], atol=2e-4
+    )
+    batch = {k: jnp.asarray(v) for k, v in packed.items()}
+    np.testing.assert_allclose(
+        float(llama.loss_fn(params, batch, cfg_x)),
+        float(llama.loss_fn(params, batch, cfg_f)),
+        rtol=1e-5,
+    )
