@@ -48,11 +48,15 @@ def _load_native():
                 # processes (multi-process launches, dataloader workers) would otherwise
                 # race g++ on the same output path and CDLL a half-written file.
                 tmp = f"{_SO}.{os.getpid()}.tmp"
-                subprocess.run(
-                    ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", tmp],
-                    check=True, capture_output=True, timeout=120,
-                )
-                os.replace(tmp, _SO)
+                try:
+                    subprocess.run(
+                        ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", tmp],
+                        check=True, capture_output=True, timeout=120,
+                    )
+                    os.replace(tmp, _SO)
+                finally:
+                    if os.path.exists(tmp):  # failed/partial build: don't litter the package
+                        os.unlink(tmp)
             lib = ctypes.CDLL(_SO)
             lib.pack_sequences_ffit.restype = ctypes.c_longlong
             lib.pack_sequences_ffit.argtypes = [

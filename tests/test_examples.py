@@ -79,6 +79,7 @@ def test_complete_nlp_example(tmp_path, capsys, monkeypatch):
         ("ddp_comm_hook.py", "gradient reduction dtype: bfloat16"),
         ("sequence_parallelism.py", "long-context training OK"),
         ("megatron_lm_gpt_pretraining.py", "3D pretraining OK"),
+        ("sample_packing.py", "packed rows"),
     ],
 )
 def test_by_feature(name, expect, capsys, monkeypatch):
