@@ -240,17 +240,32 @@ def forward(
     cfg: GPTConfig,
     positions: Optional[jax.Array] = None,
     shard_activations: bool = True,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
-    """Causal LM: tokens [B, S] → logits [B, S, V] fp32."""
-    from .llama import _maybe_shard
+    """Causal LM: tokens [B, S] → logits [B, S, V] fp32.
+
+    ``segment_ids`` (sample packing, ``ops/packing.py``): attention restricts to the
+    per-segment causal block diagonal and positions default to per-segment restarts —
+    learned position embeddings then index 0.. within each packed sequence, rotary
+    variants restart their phase, matching unpacked behavior exactly.
+    """
+    from .llama import _maybe_shard, segment_mask, segment_positions
 
     B, S = tokens.shape
     if positions is None:
-        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        positions = (
+            segment_positions(segment_ids)
+            if segment_ids is not None
+            else jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        )
     x = _embed(params, tokens, positions, cfg)
     if shard_activations:
         x = _maybe_shard(x, P(BATCH_AXES, SEQUENCE_AXIS, None))
-    mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
+    mask = (
+        segment_mask(segment_ids)
+        if segment_ids is not None
+        else jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
+    )
     block = jax.checkpoint(_block, static_argnums=(4,)) if cfg.remat else _block
     if cfg.scan_layers:
         def body(carry, layer):
@@ -269,19 +284,27 @@ def forward(
 
 
 def loss_fn(params: dict, batch: dict, cfg: GPTConfig, rng=None) -> jax.Array:
-    if "segment_ids" in batch:
-        raise NotImplementedError(
-            "sample packing (segment_ids) is currently supported by the llama family only"
-        )
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
-    logits = forward(params, inputs, cfg)
+    user_mask = batch["mask"][:, 1:].astype(jnp.float32) if "mask" in batch else None
+    if "segment_ids" in batch:
+        # Packed rows: targets valid only when the next slot continues the SAME segment.
+        seg = batch["segment_ids"]
+        m = ((seg[:, 1:] == seg[:, :-1]) & (seg[:, 1:] != 0)).astype(jnp.float32)
+        if user_mask is not None:
+            m = m * user_mask
+        positions = batch["positions"][:, :-1] if "positions" in batch else None
+        logits = forward(
+            params, inputs, cfg, positions=positions, segment_ids=seg[:, :-1]
+        )
+    else:
+        m = user_mask
+        logits = forward(params, inputs, cfg)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
-    if "mask" in batch:
-        m = batch["mask"][:, 1:].astype(jnp.float32)
-        return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
-    return -jnp.mean(ll)
+    if m is None:
+        return -jnp.mean(ll)
+    return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
 
 
 # ----------------------------------------------------------------------- cached generation

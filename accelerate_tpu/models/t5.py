@@ -312,7 +312,8 @@ def loss_fn(params: dict, batch: dict, cfg: T5Config, rng=None) -> jax.Array:
     """
     if "segment_ids" in batch:
         raise NotImplementedError(
-            "sample packing (segment_ids) is currently supported by the llama family only"
+            "sample packing (segment_ids) is supported by the llama/gpt families; "
+            "encoder-decoder packing is not implemented"
         )
     labels = batch["labels"]
     start = jnp.full((labels.shape[0], 1), cfg.decoder_start_token_id, labels.dtype)

@@ -5,8 +5,8 @@ naive answer (pad every sequence to ``max_seq``) wastes compute proportional to 
 fraction — often 2-3× on instruction-tuning mixtures. Packing concatenates multiple
 sequences per row with segment ids, recovering that compute. The reference has no packing
 facility (its data layer only shards/dispatches torch batches); this is a TPU-first
-capability, paired with segment-aware attention masking in the llama family
-(``llama.loss_fn`` consumes ``segment_ids``/``positions`` directly; gpt/t5 reject packed
+capability, paired with segment-aware attention masking in the llama and gpt families
+(their ``loss_fn``s consume ``segment_ids``/``positions`` directly; t5 rejects packed
 batches rather than silently mis-train).
 
 The bin-assignment + scatter hot loop runs natively (``native/packing.cpp``, first-fit,

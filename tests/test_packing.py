@@ -156,3 +156,28 @@ def test_packed_flash_matches_xla_path():
         float(llama.loss_fn(params, batch, cfg_f)),
         rtol=1e-5,
     )
+
+
+def test_gpt_packed_loss_matches_unpacked_sum():
+    """GPT packed CE (learned + rotary variants) == token-weighted per-sequence CE."""
+    from accelerate_tpu.models import gpt
+
+    rng = np.random.default_rng(6)
+    for variant in (
+        gpt.CONFIGS["tiny"],
+        dataclasses.replace(
+            gpt.CONFIGS["tiny"], pos="rotary", parallel_residual=True, tie_embeddings=False
+        ),
+    ):
+        cfg = dataclasses.replace(variant, dtype=jnp.float32)
+        params = gpt.init_params(cfg)
+        seqs = [rng.integers(1, cfg.vocab_size, int(n)).astype(np.int32) for n in (9, 6, 12)]
+        packed = packing.pack_sequences(seqs, seq_len=18, use_native=False)
+        batch = {k: jnp.asarray(v) for k, v in packed.items()}
+        packed_loss = float(gpt.loss_fn(params, batch, cfg))
+        total, count = 0.0, 0
+        for s in seqs:
+            loss = float(gpt.loss_fn(params, {"tokens": jnp.asarray(s[None])}, cfg))
+            total += loss * (len(s) - 1)
+            count += len(s) - 1
+        np.testing.assert_allclose(packed_loss, total / count, rtol=2e-5)
