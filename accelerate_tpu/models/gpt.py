@@ -289,8 +289,10 @@ def loss_fn(params: dict, batch: dict, cfg: GPTConfig, rng=None) -> jax.Array:
     user_mask = batch["mask"][:, 1:].astype(jnp.float32) if "mask" in batch else None
     if "segment_ids" in batch:
         # Packed rows: targets valid only when the next slot continues the SAME segment.
+        from .llama import packed_target_mask
+
         seg = batch["segment_ids"]
-        m = ((seg[:, 1:] == seg[:, :-1]) & (seg[:, 1:] != 0)).astype(jnp.float32)
+        m = packed_target_mask(seg)
         if user_mask is not None:
             m = m * user_mask
         positions = batch["positions"][:, :-1] if "positions" in batch else None

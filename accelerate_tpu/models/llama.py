@@ -37,6 +37,7 @@ __all__ = [
     "forward_streamed",
     "loss_fn",
     "loss_fn_pp",
+    "packed_target_mask",
     "segment_mask",
     "segment_positions",
     "partition_specs",
@@ -364,6 +365,13 @@ def _maybe_remat_block(cfg: LlamaConfig):
     return jax.checkpoint(_block, static_argnums=(4,), policy=policy)
 
 
+def packed_target_mask(segment_ids: jax.Array) -> jax.Array:
+    """Float mask [B, S-1] of valid next-token targets in packed rows: position t's target
+    (slot t+1) counts only when it continues the SAME segment and is not padding."""
+    seg = segment_ids
+    return ((seg[:, 1:] == seg[:, :-1]) & (seg[:, 1:] != 0)).astype(jnp.float32)
+
+
 def segment_positions(segment_ids: jax.Array) -> jax.Array:
     """Per-segment 0-based positions [B, S] from contiguous ``segment_ids`` (packed rows):
     position = index - index_of_segment_start."""
@@ -555,7 +563,7 @@ def loss_fn(
         # when the next slot continues the SAME segment (never across a boundary or
         # into padding), and attention/positions are per-segment.
         seg = batch["segment_ids"]
-        mask = ((seg[:, 1:] == seg[:, :-1]) & (seg[:, 1:] != 0)).astype(jnp.float32)
+        mask = packed_target_mask(seg)
         if "mask" in batch:
             mask = mask * batch["mask"][:, 1:].astype(jnp.float32)
         positions = (

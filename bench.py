@@ -273,11 +273,29 @@ def run(B: int, S: int, fuse: int, preset: str | None):
 
 def _metric_label(B: int, S: int, fuse: int, preset: str | None, cfg=None) -> str:
     """Label encodes the actual benchmarked config (env overrides included) so sweep rows
-    stay distinguishable."""
+    stay distinguishable. Without a built cfg (pre-init failure paths) the label derives
+    from the same env vars the config would — it must match the success-path label exactly
+    or _fail_json demotes a same-config BENCH_SELF record to "other config"."""
+    import os
+
     if preset:
         return f"train_mfu [{preset} preset — not a perf number]"
-    attn = cfg.attn_impl if cfg is not None else "?"
-    remat = (f"remat-{cfg.remat_policy}" if cfg.remat else "noremat") if cfg is not None else "?"
+    if cfg is not None:
+        attn = cfg.attn_impl
+        remat = f"remat-{cfg.remat_policy}" if cfg.remat else "noremat"
+    else:
+        # Mirror _make_config's backend-dependent default WITHOUT touching jax: calling
+        # jax.default_backend() here would initialize the backend, which HANGS on a dead
+        # tunnel before the watchdog exists. Env-only heuristic: records only persist from
+        # non-cpu runs, where the default is "flash".
+        platforms = os.environ.get("JAX_PLATFORMS", "")
+        default_attn = "xla" if platforms.strip() == "cpu" else "flash"
+        attn = os.environ.get("BENCH_ATTN", default_attn)
+        remat = (
+            f"remat-{os.environ.get('BENCH_REMAT_POLICY', 'full')}"
+            if os.environ.get("BENCH_REMAT", "1") == "1"
+            else "noremat"
+        )
     return f"train_mfu (llama-0.9B b{B} seq{S} bf16 {attn} {remat} fused{fuse})"
 
 
