@@ -174,6 +174,13 @@ def partition_specs(cfg: T5Config) -> dict:
     return specs
 
 
+def _segment_pair_mask(q_seg, k_seg):
+    """[B,1,Q,K] bool: query/key in the SAME segment AND key not padding (segment 0)."""
+    same = q_seg[:, :, None] == k_seg[:, None, :]
+    live = (k_seg != 0)[:, None, :]
+    return (same & live)[:, None]
+
+
 def _t5_norm(x, scale, eps):
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x.astype(jnp.float32) * jax.lax.rsqrt(var + eps) * scale).astype(x.dtype)
@@ -259,8 +266,15 @@ def _dec_block(x, blk, enc_out, bias, causal, cmask, cfg: T5Config):
 
 
 def encode(params: dict, input_ids: jax.Array, cfg: T5Config,
-           attention_mask: Optional[jax.Array] = None) -> jax.Array:
-    """Encoder: input_ids [B, S] → hidden [B, S, D]."""
+           attention_mask: Optional[jax.Array] = None,
+           segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """Encoder: input_ids [B, S] → hidden [B, S, D].
+
+    ``segment_ids`` (seq2seq packing, ``ops/packing.pack_seq2seq``): bidirectional
+    attention restricted to same-segment pairs; segment 0 is padding. T5's relative-
+    position bias needs no change — within a contiguous segment, relative distances are
+    shift-invariant, and cross-segment pairs are masked.
+    """
     from .llama import _maybe_shard
 
     B, S = input_ids.shape
@@ -269,7 +283,11 @@ def encode(params: dict, input_ids: jax.Array, cfg: T5Config,
     rel_table = params["encoder"]["blocks"][0]["attn"]["rel_bias"]
     bias = _rel_bias(rel_table, S, S, bidirectional=True, cfg=cfg)
     mask = None
-    if attention_mask is not None:
+    if segment_ids is not None:
+        mask = _segment_pair_mask(segment_ids, segment_ids)
+        if attention_mask is not None:
+            mask = mask & attention_mask[:, None, None, :].astype(bool)
+    elif attention_mask is not None:
         mask = attention_mask[:, None, None, :].astype(bool)
     for blk in params["encoder"]["blocks"]:
         x = _enc_block(x, blk, bias, mask, cfg)
@@ -277,15 +295,34 @@ def encode(params: dict, input_ids: jax.Array, cfg: T5Config,
 
 
 def decode(params: dict, decoder_input_ids: jax.Array, enc_out: jax.Array, cfg: T5Config,
-           enc_mask: Optional[jax.Array] = None) -> jax.Array:
-    """Decoder: ids [B, T] + encoder hidden → logits [B, T, V] fp32."""
+           enc_mask: Optional[jax.Array] = None,
+           dec_segment_ids: Optional[jax.Array] = None,
+           enc_segment_ids: Optional[jax.Array] = None) -> jax.Array:
+    """Decoder: ids [B, T] + encoder hidden → logits [B, T, V] fp32.
+
+    Packed rows (``dec_segment_ids``/``enc_segment_ids``): self-attention restricts to
+    per-segment causal; cross-attention lets decoder segment k attend ONLY encoder
+    segment k (pack_seq2seq assigns pairs the same number on both sides).
+    """
     B, T = decoder_input_ids.shape
     x = params["shared"].astype(cfg.dtype)[decoder_input_ids]
     rel_table = params["decoder"]["blocks"][0]["attn"]["rel_bias"]
     bias = _rel_bias(rel_table, T, T, bidirectional=False, cfg=cfg)
     causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
+    if (dec_segment_ids is None) != (enc_segment_ids is None):
+        # One side alone would leave cross-attention unmasked across packed segments —
+        # silently wrong logits, the exact failure packing support exists to prevent.
+        raise ValueError(
+            "packed decode requires BOTH dec_segment_ids and enc_segment_ids"
+        )
+    if dec_segment_ids is not None:
+        causal = causal & _segment_pair_mask(dec_segment_ids, dec_segment_ids)
     cmask = None
-    if enc_mask is not None:
+    if dec_segment_ids is not None:
+        cmask = _segment_pair_mask(dec_segment_ids, enc_segment_ids)
+        if enc_mask is not None:
+            cmask = cmask & enc_mask[:, None, None, :].astype(bool)
+    elif enc_mask is not None:
         cmask = enc_mask[:, None, None, :].astype(bool)
     for blk in params["decoder"]["blocks"]:
         x = _dec_block(x, blk, enc_out, bias, causal, cmask, cfg)
@@ -309,17 +346,40 @@ def loss_fn(params: dict, batch: dict, cfg: T5Config, rng=None) -> jax.Array:
 
     Decoder inputs are the labels shifted right with ``decoder_start_token_id`` (the HF
     ``_shift_right`` convention); label positions equal to -100 are ignored.
+
+    Packed batches (``ops/packing.pack_seq2seq``: +'enc_segment_ids'/'dec_segment_ids'):
+    the shift-right restarts at every decoder segment boundary (each packed pair begins
+    with the start token), attention restricts per segment on both sides, and
+    cross-attention pairs decoder segment k with encoder segment k.
     """
     if "segment_ids" in batch:
-        raise NotImplementedError(
-            "sample packing (segment_ids) is supported by the llama/gpt families; "
-            "encoder-decoder packing is not implemented"
+        raise ValueError(
+            "seq2seq packing uses pack_seq2seq ('enc_segment_ids'/'dec_segment_ids'), "
+            "not the decoder-only 'segment_ids' layout"
         )
     labels = batch["labels"]
     start = jnp.full((labels.shape[0], 1), cfg.decoder_start_token_id, labels.dtype)
-    dec_in = jnp.concatenate([start, jnp.maximum(labels[:, :-1], 0)], axis=1)
-    logits = forward(params, batch["input_ids"], dec_in, cfg, batch.get("attention_mask"))
-    mask = (labels >= 0).astype(jnp.float32)
+    if "dec_segment_ids" in batch:
+        dec_seg = batch["dec_segment_ids"]
+        enc_seg = batch["enc_segment_ids"]
+        prev = jnp.concatenate([start, jnp.maximum(labels[:, :-1], 0)], axis=1)
+        is_start = jnp.concatenate(
+            [jnp.ones((labels.shape[0], 1), bool), dec_seg[:, 1:] != dec_seg[:, :-1]],
+            axis=1,
+        )
+        dec_in = jnp.where(is_start, jnp.asarray(cfg.decoder_start_token_id, labels.dtype), prev)
+        enc_out = encode(
+            params, batch["input_ids"], cfg, batch.get("attention_mask"), segment_ids=enc_seg
+        )
+        logits = decode(
+            params, dec_in, enc_out, cfg, batch.get("attention_mask"),
+            dec_segment_ids=dec_seg, enc_segment_ids=enc_seg,
+        )
+        mask = ((labels >= 0) & (dec_seg != 0)).astype(jnp.float32)
+    else:
+        dec_in = jnp.concatenate([start, jnp.maximum(labels[:, :-1], 0)], axis=1)
+        logits = forward(params, batch["input_ids"], dec_in, cfg, batch.get("attention_mask"))
+        mask = (labels >= 0).astype(jnp.float32)
     safe = jnp.maximum(labels, 0)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, safe[..., None], axis=-1).squeeze(-1)

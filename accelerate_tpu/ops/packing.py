@@ -5,9 +5,9 @@ naive answer (pad every sequence to ``max_seq``) wastes compute proportional to 
 fraction — often 2-3× on instruction-tuning mixtures. Packing concatenates multiple
 sequences per row with segment ids, recovering that compute. The reference has no packing
 facility (its data layer only shards/dispatches torch batches); this is a TPU-first
-capability, paired with segment-aware attention masking in the llama and gpt families
-(their ``loss_fn``s consume ``segment_ids``/``positions`` directly; t5 rejects packed
-batches rather than silently mis-train).
+capability, paired with segment-aware attention masking in every model family: llama/gpt
+consume ``segment_ids``/``positions`` directly (``pack_sequences``), and t5 consumes the
+paired ``enc_segment_ids``/``dec_segment_ids`` layout (``pack_seq2seq``).
 
 The bin-assignment + scatter hot loop runs natively (``native/packing.cpp``, first-fit,
 loaded via ctypes; built on demand with g++) with a behavior-identical pure-Python
@@ -24,7 +24,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-__all__ = ["pack_sequences", "native_available"]
+__all__ = ["pack_sequences", "pack_seq2seq", "native_available"]
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 _SRC = os.path.join(_NATIVE_DIR, "packing.cpp")
@@ -165,3 +165,75 @@ def pack_sequences(
         result = packed
     tokens, segments, positions = result
     return {"tokens": tokens, "segment_ids": segments, "positions": positions}
+
+
+def pack_seq2seq(
+    inputs: Sequence[np.ndarray],
+    targets: Sequence[np.ndarray],
+    enc_len: int,
+    dec_len: int,
+    max_bins: Optional[int] = None,
+) -> dict:
+    """Pack (encoder input, decoder target) PAIRS into aligned fixed-shape rows (first-fit).
+
+    Pair ``i`` goes to a row only when BOTH its sides fit; the pair receives the SAME
+    segment number on the encoder and decoder side of that row, which is what lets
+    cross-attention match decoder segment k to encoder segment k (``models/t5`` packed
+    path). Returns ``{"input_ids", "enc_segment_ids", "labels", "dec_segment_ids"}``
+    int32 arrays of widths ``enc_len`` / ``dec_len``; padding slots are 0 with segment 0
+    (``labels`` padding is -100, the ignored-label convention).
+    """
+    if len(inputs) != len(targets):
+        raise ValueError(f"{len(inputs)} inputs vs {len(targets)} targets")
+    ins = [np.asarray(s, np.int32).ravel() for s in inputs]
+    tgts = [np.asarray(s, np.int32).ravel() for s in targets]
+    if max_bins is None:
+        max_bins = max(1, len(ins))
+    enc_used: list[int] = []
+    dec_used: list[int] = []
+    n_segs: list[int] = []
+    assignments = []
+    for i, (src, tgt) in enumerate(zip(ins, tgts)):
+        if len(src) > enc_len or len(tgt) > dec_len:
+            raise ValueError(
+                f"pair {i} exceeds capacity (input {len(src)}>{enc_len} or "
+                f"target {len(tgt)}>{dec_len})"
+            )
+        if len(src) == 0 or len(tgt) == 0:
+            continue
+        bin_id = next(
+            (
+                b
+                for b in range(len(enc_used))
+                if enc_used[b] + len(src) <= enc_len and dec_used[b] + len(tgt) <= dec_len
+            ),
+            -1,
+        )
+        if bin_id < 0:
+            if len(enc_used) >= max_bins:
+                raise ValueError(f"max_bins={max_bins} too small")
+            enc_used.append(0)
+            dec_used.append(0)
+            n_segs.append(0)
+            bin_id = len(enc_used) - 1
+        n_segs[bin_id] += 1
+        assignments.append((bin_id, enc_used[bin_id], dec_used[bin_id], n_segs[bin_id], i))
+        enc_used[bin_id] += len(src)
+        dec_used[bin_id] += len(tgt)
+    n_bins = len(enc_used)
+    input_ids = np.zeros((n_bins, enc_len), np.int32)
+    enc_seg = np.zeros((n_bins, enc_len), np.int32)
+    labels = np.full((n_bins, dec_len), -100, np.int32)
+    dec_seg = np.zeros((n_bins, dec_len), np.int32)
+    for bin_id, e0, d0, seg, i in assignments:
+        src, tgt = ins[i], tgts[i]
+        input_ids[bin_id, e0:e0 + len(src)] = src
+        enc_seg[bin_id, e0:e0 + len(src)] = seg
+        labels[bin_id, d0:d0 + len(tgt)] = tgt
+        dec_seg[bin_id, d0:d0 + len(tgt)] = seg
+    return {
+        "input_ids": input_ids,
+        "enc_segment_ids": enc_seg,
+        "labels": labels,
+        "dec_segment_ids": dec_seg,
+    }

@@ -181,3 +181,31 @@ def test_gpt_packed_loss_matches_unpacked_sum():
             total += loss * (len(s) - 1)
             count += len(s) - 1
         np.testing.assert_allclose(packed_loss, total / count, rtol=2e-5)
+
+
+def test_t5_seq2seq_packed_loss_matches_unpacked_sum():
+    """Packed seq2seq CE == token-weighted per-pair CE (enc/dec/cross all segment-masked)."""
+    from accelerate_tpu.models import t5
+
+    cfg = dataclasses.replace(t5.CONFIGS["tiny"], dtype=jnp.float32)
+    params = t5.init_params(cfg)
+    rng = np.random.default_rng(9)
+    pairs = [
+        (rng.integers(1, cfg.vocab_size, int(a)).astype(np.int32),
+         rng.integers(1, cfg.vocab_size, int(b)).astype(np.int32))
+        for a, b in ((7, 5), (4, 8), (9, 3), (5, 4))
+    ]
+    ins = [p[0] for p in pairs]
+    tgts = [p[1] for p in pairs]
+    packed = packing.pack_seq2seq(ins, tgts, enc_len=12, dec_len=10)
+    batch = {k: jnp.asarray(v) for k, v in packed.items()}
+    packed_loss = float(t5.loss_fn(params, batch, cfg))
+
+    total, count = 0.0, 0
+    for src, tgt in pairs:
+        loss = float(t5.loss_fn(
+            params, {"input_ids": jnp.asarray(src[None]), "labels": jnp.asarray(tgt[None])}, cfg
+        ))
+        total += loss * len(tgt)
+        count += len(tgt)
+    np.testing.assert_allclose(packed_loss, total / count, rtol=2e-5)
