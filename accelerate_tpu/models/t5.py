@@ -315,10 +315,9 @@ def decode(params: dict, decoder_input_ids: jax.Array, enc_out: jax.Array, cfg: 
         raise ValueError(
             "packed decode requires BOTH dec_segment_ids and enc_segment_ids"
         )
-    if dec_segment_ids is not None:
-        causal = causal & _segment_pair_mask(dec_segment_ids, dec_segment_ids)
     cmask = None
     if dec_segment_ids is not None:
+        causal = causal & _segment_pair_mask(dec_segment_ids, dec_segment_ids)
         cmask = _segment_pair_mask(dec_segment_ids, enc_segment_ids)
         if enc_mask is not None:
             cmask = cmask & enc_mask[:, None, None, :].astype(bool)

@@ -24,7 +24,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
-__all__ = ["pack_sequences", "pack_seq2seq", "native_available"]
+__all__ = ["pack_sequences", "pack_seq2seq", "packed_batch_iterator", "native_available"]
 
 _NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
 _SRC = os.path.join(_NATIVE_DIR, "packing.cpp")
@@ -75,6 +75,12 @@ def native_available() -> bool:
     return _load_native() is not None
 
 
+# NOTE: the first-fit scan appears three times by design — _pack_python (single capacity,
+# must mirror native/packing.cpp bit for bit), pack_seq2seq (dual enc/dec capacity), and
+# packed_batch_iterator (online, emits mid-stream). They carry different bin state; a
+# predicate-parameterized shared helper was tried and read worse than three plain loops.
+# When changing the fit policy or segment numbering, change ALL THREE (tests assert
+# native==python and per-variant invariants).
 def _pack_python(flat, offsets, capacity, max_bins):
     """Reference implementation: must match native/packing.cpp bit for bit."""
     used: list[int] = []
@@ -199,8 +205,12 @@ def pack_seq2seq(
                 f"pair {i} exceeds capacity (input {len(src)}>{enc_len} or "
                 f"target {len(tgt)}>{dec_len})"
             )
-        if len(src) == 0 or len(tgt) == 0:
+        if len(src) == 0 and len(tgt) == 0:
             continue
+        if len(src) == 0 or len(tgt) == 0:
+            # Dropping only one side would silently discard the other's tokens — surface
+            # the malformed pair instead (oversize pairs raise too).
+            raise ValueError(f"pair {i} has an empty side (input {len(src)}, target {len(tgt)})")
         bin_id = next(
             (
                 b
@@ -237,3 +247,57 @@ def pack_seq2seq(
         "labels": labels,
         "dec_segment_ids": dec_seg,
     }
+
+
+def packed_batch_iterator(
+    documents,
+    seq_len: int,
+    rows_per_batch: int,
+    drop_last: bool = False,
+):
+    """Stream variable-length docs into fixed-shape packed batches (online first-fit).
+
+    Maintains up to ``rows_per_batch`` open rows; each incoming document goes to the first
+    open row it fits (first-fit). When a document fits no open row and all rows are open,
+    the batch is emitted and a fresh one starts — so every yielded batch is exactly
+    ``[rows_per_batch, seq_len]`` (the final partial batch pads with empty rows unless
+    ``drop_last``). This is the data-layer integration of ``pack_sequences``: wrap the
+    per-process document stream AFTER sharding (each process packs its own shard) and feed
+    the yielded dicts straight to a packed-aware ``loss_fn``.
+    """
+    def emit(bins):
+        tokens = np.zeros((rows_per_batch, seq_len), np.int32)
+        segments = np.zeros((rows_per_batch, seq_len), np.int32)
+        positions = np.zeros((rows_per_batch, seq_len), np.int32)
+        for r, docs in enumerate(bins):
+            at = 0
+            for s, doc in enumerate(docs, start=1):
+                n = len(doc)
+                tokens[r, at:at + n] = doc
+                segments[r, at:at + n] = s
+                positions[r, at:at + n] = np.arange(n, dtype=np.int32)
+                at += n
+        return {"tokens": tokens, "segment_ids": segments, "positions": positions}
+
+    bins: list[list[np.ndarray]] = []
+    used: list[int] = []
+    for doc in documents:
+        doc = np.asarray(doc, np.int32).ravel()
+        if len(doc) > seq_len:
+            raise ValueError(f"document of {len(doc)} tokens exceeds seq_len={seq_len}")
+        if len(doc) == 0:
+            continue
+        row = next((b for b in range(len(bins)) if used[b] + len(doc) <= seq_len), -1)
+        if row < 0:
+            if len(bins) < rows_per_batch:
+                bins.append([])
+                used.append(0)
+                row = len(bins) - 1
+            else:
+                yield emit(bins)
+                bins, used = [[]], [0]
+                row = 0
+        bins[row].append(doc)
+        used[row] += len(doc)
+    if bins and not drop_last:
+        yield emit(bins)
