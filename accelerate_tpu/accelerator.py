@@ -28,7 +28,6 @@ from __future__ import annotations
 import contextlib
 import functools
 import inspect
-import math
 import os
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Union
@@ -41,10 +40,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from .data_loader import DataLoaderDispatcher, DataLoaderShard, prepare_data_loader, skip_first_batches
 from .logging import get_logger
 from .optimizer import AcceleratedOptimizer
-from .parallel.fsdp import get_fsdp_shardings, shard_params
-from .parallel.mesh import MeshConfig, batch_sharding
+from .parallel.fsdp import shard_params
+from .parallel.mesh import MeshConfig
 from .scheduler import AcceleratedScheduler
-from .state import AcceleratorState, GradientState, PartialState
+from .state import AcceleratorState, GradientState
 from .utils.constants import BATCH_AXES
 from .utils.dataclasses import (
     DataLoaderConfiguration,

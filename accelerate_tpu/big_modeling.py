@@ -37,7 +37,7 @@ from .utils.modeling import (
     named_parameters,
     placement_for,
 )
-from .utils.offload import OffloadedWeight, OffloadedWeightsLoader, as_jax_array, offload_state_dict
+from .utils.offload import OffloadedWeight, as_jax_array, offload_state_dict
 from .utils.serialization import unflatten_to_nested_dict
 
 __all__ = [

@@ -18,7 +18,6 @@ Format divergences from the reference (torch pickles):
 from __future__ import annotations
 
 import json
-import os
 import pickle
 import random
 import shutil

@@ -25,7 +25,6 @@ import os
 import subprocess
 import sys
 import time
-from typing import Optional
 
 from ..utils.launch import (
     prepare_multi_process_env,

@@ -9,7 +9,7 @@ share the same API so the questionnaire is testable with scripted input.
 from __future__ import annotations
 
 import sys
-from typing import Optional, Sequence
+from typing import Sequence
 
 __all__ = ["BulletMenu", "select", "ask", "ask_bool", "ask_int"]
 

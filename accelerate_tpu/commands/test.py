@@ -8,7 +8,6 @@ CPU simulator so it validates mesh/collective behavior even on a machine with no
 from __future__ import annotations
 
 import argparse
-import subprocess
 import sys
 from pathlib import Path
 

@@ -29,7 +29,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 from .logging import get_logger
 from .state import GradientState, PartialState
 from .utils.constants import BATCH_AXES
-from .utils.dataclasses import RNGType
 from .utils.operations import (
     broadcast,
     broadcast_object_list,

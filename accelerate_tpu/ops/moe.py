@@ -15,7 +15,6 @@ auxiliary loss, batched expert FFN (SwiGLU, matching the dense MLP).
 
 from __future__ import annotations
 
-from typing import Optional
 
 import jax
 import jax.numpy as jnp

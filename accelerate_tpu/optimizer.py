@@ -17,9 +17,7 @@ behaviors map as follows:
 
 from __future__ import annotations
 
-from typing import Any, Optional
 
-import jax
 
 from .state import AcceleratorState, GradientState
 

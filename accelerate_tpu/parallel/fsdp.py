@@ -24,7 +24,7 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
-from ..utils.constants import FSDP_AXIS, TENSOR_AXIS
+from ..utils.constants import FSDP_AXIS
 from ..utils.dataclasses import FullyShardedDataParallelPlugin
 
 __all__ = [

@@ -14,7 +14,7 @@ amortize over the step; pp only nearest-neighbor-permutes activations.
 from __future__ import annotations
 
 import math
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Optional, Sequence
 
 import numpy as np

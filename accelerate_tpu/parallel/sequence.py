@@ -17,7 +17,6 @@ Three interchangeable strategies over the ``sp`` mesh axis, all exact:
 from __future__ import annotations
 
 import functools
-import math
 from typing import Optional
 
 import jax

@@ -18,9 +18,8 @@ import re
 from typing import Any, Callable, Optional
 
 import jax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec
+from jax.sharding import Mesh, PartitionSpec
 
-from ..utils.constants import TENSOR_AXIS
 from ..utils.dataclasses import FullyShardedDataParallelPlugin
 from .fsdp import get_fsdp_shardings
 

@@ -14,7 +14,7 @@ import os
 import time
 from abc import ABC, abstractmethod
 from pathlib import Path
-from typing import Any, Optional, Union
+from typing import Optional, Union
 
 from .logging import get_logger
 from .state import PartialState

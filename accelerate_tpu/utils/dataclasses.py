@@ -15,16 +15,14 @@ from __future__ import annotations
 
 import copy
 import enum
-import functools
 import os
-import warnings
 from dataclasses import dataclass, field
 from datetime import timedelta
-from typing import Any, Callable, Iterable, Optional
+from typing import Any, Callable, Optional
 
 import jax.numpy as jnp
 
-from .environment import parse_flag_from_env, str_to_bool
+from .environment import parse_flag_from_env
 
 
 class KwargsHandler:

@@ -286,8 +286,9 @@ def _metric_label(B: int, S: int, fuse: int, preset: str | None, cfg=None) -> st
     else:
         # Mirror _make_config's backend-dependent default WITHOUT touching jax: calling
         # jax.default_backend() here would initialize the backend, which HANGS on a dead
-        # tunnel before the watchdog exists. Env-only heuristic: records only persist from
-        # non-cpu runs, where the default is "flash".
+        # tunnel before the watchdog exists. Env-only heuristic — exact on the TPU and CPU
+        # paths this benchmark targets; a cuda host (not a target) would label-drift and
+        # merely demote its fallback record to "other config", never corrupt it.
         platforms = os.environ.get("JAX_PLATFORMS", "")
         default_attn = "xla" if platforms.strip() == "cpu" else "flash"
         attn = os.environ.get("BENCH_ATTN", default_attn)
@@ -348,6 +349,10 @@ def main():
             PartialState._reset_state()
             if "RESOURCE_EXHAUSTED" in str(e) and B > 1:
                 B //= 2
+                # Keep the failure-path label in sync with the batch actually being run,
+                # or a post-OOM BENCH_SELF record (labeled with the halved B by run())
+                # could never match a later failure's label.
+                metric = _metric_label(B, S, fuse, preset)
                 print(f"bench: OOM, retrying with batch {B}", file=sys.stderr)
                 continue
             if _is_transient(e) and transient_left > 0:

@@ -219,13 +219,19 @@ def test_packed_batch_iterator_streaming():
     assert all(b["tokens"].shape == (4, 32) for b in batches)
     got = np.sort(np.concatenate([b["tokens"][b["segment_ids"] != 0] for b in batches]))
     np.testing.assert_array_equal(got, np.sort(np.concatenate(docs)))
+    doc_lengths = sorted(len(d) for d in docs)
+    run_lengths = []
     for b in batches:
         for r in range(4):
             seg = b["segment_ids"][r]
-            assert (seg != 0).sum() <= 32  # used tokens never exceed seq_len
             ks = seg[seg != 0]
             if len(ks):
                 assert ks.max() == len(np.unique(ks))  # segments contiguous from 1
+            for s in np.unique(ks):
+                run_lengths.append(int((seg == s).sum()))
+    # every emitted segment run corresponds 1:1 to an input document (an over-committed
+    # row would truncate or merge runs and break this)
+    assert sorted(run_lengths) == doc_lengths
 
 
 def test_packed_batch_iterator_trains():
