@@ -754,7 +754,12 @@ def _attention_cached(q, ck, cv, q_positions, valid, cfg: LlamaConfig):
 
 
 def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig):
-    """One block with KV-cache read/write → (x, new_kv)."""
+    """One block with KV-cache read/write → (x, new_kv).
+
+    ``index`` is the write slot: a SCALAR advances every row together (generate's
+    prefill/decode), a VECTOR [B] gives each row its own slot (the continuous-batching
+    engine, ``serving.py`` — requires T == 1).
+    """
     B, T, D = x.shape
     h = _rms_norm(x, layer["ln_attn"], cfg.norm_eps)
     q = _proj(h, layer["wq"], cfg).reshape(B, T, cfg.n_heads, cfg.head_dim)
@@ -762,8 +767,13 @@ def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig):
     v = _proj(h, layer["wv"], cfg).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    new_k = jax.lax.dynamic_update_slice(kv["k"], k.astype(kv["k"].dtype), (0, index, 0, 0))
-    new_v = jax.lax.dynamic_update_slice(kv["v"], v.astype(kv["v"].dtype), (0, index, 0, 0))
+    if jnp.ndim(index) == 0:
+        new_k = jax.lax.dynamic_update_slice(kv["k"], k.astype(kv["k"].dtype), (0, index, 0, 0))
+        new_v = jax.lax.dynamic_update_slice(kv["v"], v.astype(kv["v"].dtype), (0, index, 0, 0))
+    else:
+        rows = jnp.arange(B)
+        new_k = kv["k"].at[rows, index].set(k[:, 0].astype(kv["k"].dtype))
+        new_v = kv["v"].at[rows, index].set(v[:, 0].astype(kv["v"].dtype))
     attn = _attention_cached(q, new_k, new_v, positions, valid, cfg)
     x = x + _proj(attn.reshape(B, T, cfg.n_heads * cfg.head_dim), layer["wo"], cfg)
     h = _rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
