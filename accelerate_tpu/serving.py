@@ -14,8 +14,10 @@ Design (static shapes throughout):
   prompt left-padded to a bucketed width — one executable per bucket) and the resulting
   cache ROW is scattered into the engine cache at the freed slot (one compiled insert).
 - Decode is ``_decode_step``: embed [B,1] tokens, per-layer scatter-write at
-  ``positions``, attend over each slot's valid prefix, sample greedily. Finished/inactive
-  slots keep computing (their output is ignored) — static shapes beat branchy savings.
+  ``positions``, attend over each slot's valid prefix. Greedy argmax stays fused
+  on-device; sampled requests (per-request ``GenerationConfig`` + private key schedule)
+  draw host-side from the logits row. Finished/inactive slots keep computing (their
+  output is ignored) — static shapes beat branchy savings.
 
 Correctness contract (tested): with requests submitted at staggered times, every finished
 sequence equals ``llama.generate``'s greedy output for that prompt alone (for MoE configs,
@@ -34,6 +36,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .generation import GenerationConfig, sample_logits
 from .models import llama
 from .models.llama import _block_cached, _rms_norm, init_cache
 
@@ -44,16 +47,35 @@ __all__ = ["ContinuousBatcher", "Request"]
 class Request:
     uid: int
     prompt: np.ndarray
-    max_new_tokens: int
-    eos_token_id: Optional[int] = None
+    gen: GenerationConfig
+    rng: Optional[jax.Array] = None      # per-request key schedule (None → greedy-determined)
     # filled by the engine
     tokens: list = dataclasses.field(default_factory=list)
     done: bool = False
 
+    def __post_init__(self):
+        if self.rng is not None and self.gen.temperature > 0.0:
+            # Exactly generate_loop's schedule (generation.py): split(rng, max_new_tokens),
+            # draw i consumes key i — so a sampled request reproduces generate() exactly.
+            self._step_keys = jax.random.split(self.rng, self.gen.max_new_tokens)
+        else:
+            self._step_keys = None
+
+    def _sample(self, logits_row):
+        """Draw this request's next token from a host logits row (sampled requests; the
+        greedy path uses the fused on-device argmax and never calls this)."""
+        if self.gen.temperature <= 0.0:
+            return int(np.argmax(logits_row))
+        key = self._step_keys[len(self.tokens)]
+        return int(np.asarray(sample_logits(logits_row[None], self.gen, key))[0])
+
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
 def _decode_step(params, cache, tokens, positions, cfg):
-    """Advance every slot one token: (next_token [B], new cache). Greedy argmax."""
+    """Advance every slot: (greedy_token [B] int32, logits [B, V] fp32, new cache).
+
+    The greedy argmax stays fused on-device; the logits matrix is only fetched host-side
+    when a sampled (temperature > 0) request is active."""
     B = tokens.shape[0]
     rows = jnp.arange(B)
     valid = cache["valid"].at[rows, positions].set(True)
@@ -75,8 +97,8 @@ def _decode_step(params, cache, tokens, positions, cfg):
     x = _rms_norm(x, params["ln_f"], cfg.norm_eps)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = (x[:, -1, :] @ head.astype(cfg.dtype)).astype(jnp.float32)
-    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return nxt, {"layers": new_layers, "valid": valid, "index": cache["index"]}
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    return greedy, logits, {"layers": new_layers, "valid": valid, "index": cache["index"]}
 
 
 @partial(jax.jit, static_argnames=("slot", "scan_layers"), donate_argnums=(0,))
@@ -104,11 +126,12 @@ def _prefill_jit(params, row, mask, cfg, max_len: int):
     logits, cache = llama.forward_cached(
         params, row, cache, cfg, token_mask=mask, last_only=True
     )
-    return jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32), cache
+    return logits[:, -1, :], cache
 
 
 class ContinuousBatcher:
-    """Greedy continuous-batching decode over ``max_slots`` shared lanes.
+    """Continuous-batching decode over ``max_slots`` shared lanes (greedy or sampled
+    per request).
 
     ``submit()`` queues requests; ``step()`` admits queued requests into free slots
     (compiled prefill + row insert), advances every active slot one token with ONE
@@ -124,25 +147,41 @@ class ContinuousBatcher:
         self.max_len = max_len
         self.prompt_bucket = prompt_bucket
         self.cache = init_cache(cfg, max_slots, max_len)
-        self.tokens = jnp.zeros((max_slots,), jnp.int32)
+        self.tokens = np.zeros((max_slots,), np.int32)  # host-side; uploaded per decode
         self.positions = np.zeros((max_slots,), np.int32)  # next write slot per lane
         self.slot_req: list[Optional[Request]] = [None] * max_slots
         self.queue: deque[Request] = deque()
         self._uid = 0
 
     # ------------------------------------------------------------------ user API
-    def submit(self, prompt, max_new_tokens: int = 32,
-               eos_token_id: Optional[int] = None) -> Request:
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None,
+               gen: Optional[GenerationConfig] = None,
+               rng: Optional[jax.Array] = None) -> Request:
+        """Queue a request. Either pass ``max_new_tokens``/``eos_token_id`` (greedy), or a
+        full ``GenerationConfig`` via ``gen`` — not both (silently preferring one would
+        drop the caller's limits). Temperature sampling needs ``rng``."""
         prompt = np.asarray(prompt, np.int32).ravel()
+        if gen is not None and (max_new_tokens is not None or eos_token_id is not None):
+            raise ValueError(
+                "pass either gen= or max_new_tokens/eos_token_id, not both"
+            )
+        if gen is None:
+            gen = GenerationConfig(
+                max_new_tokens=32 if max_new_tokens is None else max_new_tokens,
+                temperature=0.0, eos_token_id=eos_token_id,
+            )
         if len(prompt) > self.prompt_bucket:
             raise ValueError(
                 f"prompt of {len(prompt)} tokens exceeds prompt_bucket={self.prompt_bucket}"
             )
-        if max_new_tokens < 1:
+        if gen.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the prefill emits the first token)")
-        if self.prompt_bucket + max_new_tokens > self.max_len:
+        if self.prompt_bucket + gen.max_new_tokens > self.max_len:
             raise ValueError("prompt_bucket + max_new_tokens exceeds max_len")
-        req = Request(self._uid, prompt, max_new_tokens, eos_token_id)
+        if gen.temperature > 0.0 and rng is None:
+            raise ValueError("temperature sampling needs a per-request rng key")
+        req = Request(self._uid, prompt, gen, rng)
         self._uid += 1
         self.queue.append(req)
         return req
@@ -153,23 +192,30 @@ class ContinuousBatcher:
         active = [i for i, r in enumerate(self.slot_req) if r is not None]
         if not active:
             return finished_at_admit
-        nxt, self.cache = _decode_step(
-            self.params, self.cache, self.tokens,
+        greedy, logits, self.cache = _decode_step(
+            self.params, self.cache, jnp.asarray(self.tokens),
             jnp.asarray(self.positions), cfg=self.cfg,
         )
-        nxt_host = np.asarray(nxt)
+        greedy_host = np.asarray(greedy)
+        any_sampled = any(
+            self.slot_req[i].gen.temperature > 0.0 for i in active
+        )
+        logits_host = np.asarray(logits) if any_sampled else None
         finished = []
         # Every lane wrote one slot (idle lanes too — static shapes); clamp so an idle
         # lane's position can never run past the cache (its writes then drop out of bounds
         # and its lane is fully re-initialized at the next admit anyway).
         self.positions = np.minimum(self.positions + 1, self.max_len - 1)
-        self.tokens = nxt
         for i in active:
             req = self.slot_req[i]
-            tok = int(nxt_host[i])
+            tok = (
+                int(greedy_host[i]) if req.gen.temperature <= 0.0
+                else req._sample(logits_host[i])
+            )
+            self.tokens[i] = tok
             req.tokens.append(tok)
-            hit_eos = req.eos_token_id is not None and tok == req.eos_token_id
-            if hit_eos or len(req.tokens) >= req.max_new_tokens:
+            hit_eos = req.gen.eos_token_id is not None and tok == req.gen.eos_token_id
+            if hit_eos or len(req.tokens) >= req.gen.max_new_tokens:
                 req.done = True
                 finished.append(req)
                 self.slot_req[i] = None  # slot frees; cache row overwritten on next admit
@@ -198,30 +244,30 @@ class ContinuousBatcher:
             # the inner loop per slot, and such requests are reported like any other.
             while self.slot_req[slot] is None and self.queue:
                 req = self.queue.popleft()
-                row_cache, first = self._prefill(req.prompt)
+                row_cache, prefill_logits = self._prefill(req.prompt)
+                first = req._sample(prefill_logits)
                 self.cache = _insert_row(self.cache, row_cache, slot=slot, scan_layers=self.cfg.scan_layers)
                 self.slot_req[slot] = req
                 self.positions[slot] = self.prompt_bucket  # next write = first decode slot
-                self.tokens = self.tokens.at[slot].set(first)
+                self.tokens[slot] = first
                 req.tokens.append(int(first))
-                hit_eos = req.eos_token_id is not None and int(first) == req.eos_token_id
-                if hit_eos or len(req.tokens) >= req.max_new_tokens:
+                hit_eos = req.gen.eos_token_id is not None and int(first) == req.gen.eos_token_id
+                if hit_eos or len(req.tokens) >= req.gen.max_new_tokens:
                     req.done = True
                     finished.append(req)
                     self.slot_req[slot] = None
         return finished
 
     def _prefill(self, prompt: np.ndarray):
-        """Left-padded single-row prefill at the bucket width → (cache row, first token).
-
-        Compiled: one executable per (cfg, bucket width, max_len)."""
+        """Left-padded single-row prefill at the bucket width → (cache row, final-position
+        logits row [V]). Compiled: one executable per (cfg, bucket width, max_len)."""
         pad = self.prompt_bucket - len(prompt)
         row = np.zeros((1, self.prompt_bucket), np.int32)
         row[0, pad:] = prompt
         mask = np.zeros((1, self.prompt_bucket), bool)
         mask[0, pad:] = True
-        first, cache = _prefill_jit(
+        logits, cache = _prefill_jit(
             self.params, jnp.asarray(row), jnp.asarray(mask), cfg=self.cfg,
             max_len=self.max_len,
         )
-        return cache, int(np.asarray(first)[0])
+        return cache, np.asarray(logits)[0]

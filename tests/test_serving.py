@@ -126,3 +126,25 @@ def test_zero_new_tokens_rejected(setup):
     engine = ContinuousBatcher(params, CFG, max_slots=1, max_len=64, prompt_bucket=8)
     with pytest.raises(ValueError):
         engine.submit(prompts[2], max_new_tokens=0)
+
+
+def test_sampled_request_matches_generate(setup):
+    """A temperature/top-k request with a fixed key reproduces generate() exactly —
+    the engine consumes the identical per-step key schedule."""
+    import jax
+
+    params, prompts = setup
+    gen = GenerationConfig(max_new_tokens=6, temperature=0.8, top_k=12)
+    rngs = [jax.random.PRNGKey(s) for s in (11, 22)]
+    engine = ContinuousBatcher(params, CFG, max_slots=2, max_len=64, prompt_bucket=16)
+    reqs = [engine.submit(p, gen=gen, rng=r) for p, r in zip(prompts[:2], rngs)]
+    engine.run()
+    for req, prompt, rng in zip(reqs, prompts[:2], rngs):
+        pad = 16 - len(prompt)
+        padded = np.zeros((1, 16), np.int32); padded[0, pad:] = prompt
+        pmask = np.zeros((1, 16), bool); pmask[0, pad:] = True
+        want = np.asarray(llama.generate(
+            params, jnp.asarray(padded), CFG, gen,
+            rng=rng, prompt_mask=jnp.asarray(pmask),
+        ))[0].tolist()
+        assert req.tokens == want, (req.tokens, want)
