@@ -37,6 +37,8 @@ import jax
 import jax.numpy as jnp
 
 from .generation import GenerationConfig, sample_logits
+
+_sample_jit = jax.jit(sample_logits, static_argnames=("gen",))
 from .models import llama
 from .models.llama import _block_cached, _rms_norm, init_cache
 
@@ -67,7 +69,7 @@ class Request:
         if self.gen.temperature <= 0.0:
             return int(np.argmax(logits_row))
         key = self._step_keys[len(self.tokens)]
-        return int(np.asarray(sample_logits(logits_row[None], self.gen, key))[0])
+        return int(np.asarray(_sample_jit(jnp.asarray(logits_row)[None], self.gen, key))[0])
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
@@ -126,7 +128,8 @@ def _prefill_jit(params, row, mask, cfg, max_len: int):
     logits, cache = llama.forward_cached(
         params, row, cache, cfg, token_mask=mask, last_only=True
     )
-    return logits[:, -1, :], cache
+    last = logits[:, -1, :]
+    return jnp.argmax(last, axis=-1).astype(jnp.int32), last, cache
 
 
 class ContinuousBatcher:
@@ -166,6 +169,11 @@ class ContinuousBatcher:
             raise ValueError(
                 "pass either gen= or max_new_tokens/eos_token_id, not both"
             )
+        if rng is not None and gen is None:
+            raise ValueError(
+                "rng was given without gen: the default config is greedy and would "
+                "silently ignore the key — pass gen=GenerationConfig(temperature=...)"
+            )
         if gen is None:
             gen = GenerationConfig(
                 max_new_tokens=32 if max_new_tokens is None else max_new_tokens,
@@ -197,10 +205,12 @@ class ContinuousBatcher:
             jnp.asarray(self.positions), cfg=self.cfg,
         )
         greedy_host = np.asarray(greedy)
-        any_sampled = any(
-            self.slot_req[i].gen.temperature > 0.0 for i in active
+        sampled = [i for i in active if self.slot_req[i].gen.temperature > 0.0]
+        # Only the sampled lanes' logits rows travel to host (the greedy path consumes the
+        # fused on-device argmax; at llama vocab sizes the full [B, V] matrix is MBs/token).
+        logits_host = (
+            dict(zip(sampled, np.asarray(logits[jnp.asarray(sampled)]))) if sampled else {}
         )
-        logits_host = np.asarray(logits) if any_sampled else None
         finished = []
         # Every lane wrote one slot (idle lanes too — static shapes); clamp so an idle
         # lane's position can never run past the cache (its writes then drop out of bounds
@@ -211,7 +221,7 @@ class ContinuousBatcher:
             tok = (
                 int(greedy_host[i]) if req.gen.temperature <= 0.0
                 else req._sample(logits_host[i])
-            )
+            )  # logits_host holds exactly the sampled lanes
             self.tokens[i] = tok
             req.tokens.append(tok)
             hit_eos = req.gen.eos_token_id is not None and tok == req.gen.eos_token_id
@@ -244,8 +254,12 @@ class ContinuousBatcher:
             # the inner loop per slot, and such requests are reported like any other.
             while self.slot_req[slot] is None and self.queue:
                 req = self.queue.popleft()
-                row_cache, prefill_logits = self._prefill(req.prompt)
-                first = req._sample(prefill_logits)
+                row_cache, greedy_dev, logits_dev = self._prefill(req.prompt)
+                first = (
+                    int(np.asarray(greedy_dev)[0])       # fused on-device argmax (4 bytes)
+                    if req.gen.temperature <= 0.0
+                    else req._sample(np.asarray(logits_dev)[0])
+                )
                 self.cache = _insert_row(self.cache, row_cache, slot=slot, scan_layers=self.cfg.scan_layers)
                 self.slot_req[slot] = req
                 self.positions[slot] = self.prompt_bucket  # next write = first decode slot
@@ -259,15 +273,16 @@ class ContinuousBatcher:
         return finished
 
     def _prefill(self, prompt: np.ndarray):
-        """Left-padded single-row prefill at the bucket width → (cache row, final-position
-        logits row [V]). Compiled: one executable per (cfg, bucket width, max_len)."""
+        """Left-padded single-row prefill at the bucket width → (cache row, on-device
+        greedy token [1], on-device logits row [1, V]).
+        Compiled: one executable per (cfg, bucket width, max_len)."""
         pad = self.prompt_bucket - len(prompt)
         row = np.zeros((1, self.prompt_bucket), np.int32)
         row[0, pad:] = prompt
         mask = np.zeros((1, self.prompt_bucket), bool)
         mask[0, pad:] = True
-        logits, cache = _prefill_jit(
+        greedy, logits, cache = _prefill_jit(
             self.params, jnp.asarray(row), jnp.asarray(mask), cfg=self.cfg,
             max_len=self.max_len,
         )
-        return cache, np.asarray(logits)[0]
+        return cache, greedy, logits
