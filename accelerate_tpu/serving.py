@@ -37,12 +37,18 @@ import jax
 import jax.numpy as jnp
 
 from .generation import GenerationConfig, sample_logits
-
-_sample_jit = jax.jit(sample_logits, static_argnames=("gen",))
 from .models import llama
 from .models.llama import _block_cached, _rms_norm, init_cache
 
 __all__ = ["ContinuousBatcher", "Request"]
+
+
+@partial(jax.jit, static_argnames=("temperature", "top_k", "top_p"))
+def _draw(logits_row, key, temperature: float, top_k: int, top_p: float):
+    """One sampled draw, jitted on ONLY the fields sampling reads — keying on the whole
+    GenerationConfig would recompile for every distinct max_new_tokens/eos value."""
+    gen = GenerationConfig(temperature=temperature, top_k=top_k, top_p=top_p)
+    return sample_logits(logits_row[None], gen, key)[0]
 
 
 @dataclasses.dataclass
@@ -64,12 +70,15 @@ class Request:
             self._step_keys = None
 
     def _sample(self, logits_row):
-        """Draw this request's next token from a host logits row (sampled requests; the
-        greedy path uses the fused on-device argmax and never calls this)."""
+        """Draw this request's next token from an ON-DEVICE logits row (sampled requests;
+        the greedy path uses the fused argmax and never calls this). Only the drawn int
+        crosses to host."""
         if self.gen.temperature <= 0.0:
-            return int(np.argmax(logits_row))
+            return int(np.asarray(jnp.argmax(logits_row)))
         key = self._step_keys[len(self.tokens)]
-        return int(np.asarray(_sample_jit(jnp.asarray(logits_row)[None], self.gen, key))[0])
+        return int(np.asarray(_draw(
+            logits_row, key, self.gen.temperature, self.gen.top_k, self.gen.top_p
+        )))
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
@@ -169,10 +178,10 @@ class ContinuousBatcher:
             raise ValueError(
                 "pass either gen= or max_new_tokens/eos_token_id, not both"
             )
-        if rng is not None and gen is None:
+        if rng is not None and (gen is None or gen.temperature <= 0.0):
             raise ValueError(
-                "rng was given without gen: the default config is greedy and would "
-                "silently ignore the key — pass gen=GenerationConfig(temperature=...)"
+                "rng was given but the request is greedy (no gen / temperature<=0): the "
+                "key would be silently ignored — pass gen=GenerationConfig(temperature=...)"
             )
         if gen is None:
             gen = GenerationConfig(
@@ -205,12 +214,6 @@ class ContinuousBatcher:
             jnp.asarray(self.positions), cfg=self.cfg,
         )
         greedy_host = np.asarray(greedy)
-        sampled = [i for i in active if self.slot_req[i].gen.temperature > 0.0]
-        # Only the sampled lanes' logits rows travel to host (the greedy path consumes the
-        # fused on-device argmax; at llama vocab sizes the full [B, V] matrix is MBs/token).
-        logits_host = (
-            dict(zip(sampled, np.asarray(logits[jnp.asarray(sampled)]))) if sampled else {}
-        )
         finished = []
         # Every lane wrote one slot (idle lanes too — static shapes); clamp so an idle
         # lane's position can never run past the cache (its writes then drop out of bounds
@@ -220,8 +223,10 @@ class ContinuousBatcher:
             req = self.slot_req[i]
             tok = (
                 int(greedy_host[i]) if req.gen.temperature <= 0.0
-                else req._sample(logits_host[i])
-            )  # logits_host holds exactly the sampled lanes
+                # sampled lane: the device row goes straight into the jitted draw;
+                # only the drawn token id crosses to host
+                else req._sample(logits[i])
+            )
             self.tokens[i] = tok
             req.tokens.append(tok)
             hit_eos = req.gen.eos_token_id is not None and tok == req.gen.eos_token_id
@@ -258,7 +263,7 @@ class ContinuousBatcher:
                 first = (
                     int(np.asarray(greedy_dev)[0])       # fused on-device argmax (4 bytes)
                     if req.gen.temperature <= 0.0
-                    else req._sample(np.asarray(logits_dev)[0])
+                    else req._sample(logits_dev[0])
                 )
                 self.cache = _insert_row(self.cache, row_cache, slot=slot, scan_layers=self.cfg.scan_layers)
                 self.slot_req[slot] = req

@@ -87,6 +87,12 @@ def test_by_feature(name, expect, capsys, monkeypatch):
     assert expect in out, out
 
 
+def test_serving_example(capsys, monkeypatch):
+    out = _run_inline(EXAMPLES / "inference" / "serving.py", "--requests", "10",
+                      capsys=capsys, monkeypatch=monkeypatch)
+    assert "served 10 requests" in out and "tokens/s" in out
+
+
 def test_cv_example(capsys, monkeypatch):
     out = _run_inline(EXAMPLES / "cv_example.py", capsys=capsys, monkeypatch=monkeypatch)
     assert "accuracy=" in out
