@@ -22,6 +22,7 @@ from collections import OrderedDict
 from functools import partial
 from typing import Any, Optional
 
+import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
@@ -45,6 +46,7 @@ __all__ = [
     "init_cache",
     "forward_cached",
     "generate",
+    "generate_speculative",
     "generate_streamed",
 ]
 
@@ -991,3 +993,126 @@ def num_params(cfg: LlamaConfig) -> int:
     if not cfg.tie_embeddings:
         total += D * V
     return total
+
+
+# -------------------------------------------------------------------- speculative decoding
+def _cache_rewind(cache: dict, to_index) -> dict:
+    """Roll a cache back to ``to_index`` written tokens: later slots become invalid (their
+    k/v are garbage from rejected drafts and are masked; the next writes overwrite them)."""
+    C = cache["valid"].shape[1]
+    keep = jnp.arange(C)[None, :] < to_index
+    return {
+        "layers": cache["layers"],
+        "valid": cache["valid"] & keep,
+        "index": jnp.asarray(to_index, jnp.int32),
+    }
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(2,))
+def _spec_forward_jit(params, tokens, cache, cfg):
+    """forward_cached + per-position argmax (used for both the T=K verify and T=1 steps).
+    The input cache is donated — callers always replace their reference with the output."""
+    logits, cache = forward_cached(params, tokens, cache, cfg)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
+
+
+def generate_speculative(
+    target_params: dict,
+    target_cfg: LlamaConfig,
+    draft_params: dict,
+    draft_cfg: LlamaConfig,
+    prompt: jax.Array,
+    max_new_tokens: int = 32,
+    k: int = 4,
+    eos_token_id: Optional[int] = None,
+    prompt_mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Greedy speculative decoding: a small draft model proposes ``k`` tokens per round, the
+    target verifies them in ONE T=k forward, and the longest agreeing prefix is accepted
+    plus the target's correction token — so each round emits 1..k+1 tokens for one target
+    dispatch. Output is PROVABLY identical to the target's plain greedy decode (tested
+    token-for-token); the draft only changes how many target forwards it takes to get there.
+    The reference has no speculative path. Single sequence (B=1): speculation is a
+    latency tool for individual streams; batch throughput is ``serving.ContinuousBatcher``.
+
+    Round invariant: both caches hold EXACTLY the emitted sequence; ``next_target`` /
+    ``next_draft`` are each model's greedy prediction after that context. Verified drafts'
+    k/v already sit in both caches (computed under the same accepted context), so
+    acceptance is a cache REWIND to the accepted length plus one T=1 step on the
+    correction token — rejected suffix slots are just invalidated.
+    """
+    if target_cfg.vocab_size != draft_cfg.vocab_size:
+        raise ValueError("draft and target must share a vocabulary")
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if prompt.ndim == 1:
+        prompt = prompt[None]
+    if prompt.shape[0] != 1:
+        raise ValueError("generate_speculative is single-sequence (B=1)")
+    if prompt_mask is None:
+        prompt_mask = jnp.ones(prompt.shape, jnp.bool_)
+    S0 = prompt.shape[1]
+    # Bucketed like generate(): nearby prompt/k/max_new combinations share one compiled
+    # program per token shape (the valid-mask machinery makes an over-long cache identical).
+    max_len = -(-(S0 + max_new_tokens + k + 1) // 64) * 64
+
+    t_cache = init_cache(target_cfg, 1, max_len)
+    d_cache = init_cache(draft_cfg, 1, max_len)
+    t_logits, t_cache = forward_cached(
+        target_params, prompt, t_cache, target_cfg, token_mask=prompt_mask, last_only=True
+    )
+    d_logits, d_cache = forward_cached(
+        draft_params, prompt, d_cache, draft_cfg, token_mask=prompt_mask, last_only=True
+    )
+    next_target = int(np.asarray(jnp.argmax(t_logits[0, -1])))
+    next_draft = int(np.asarray(jnp.argmax(d_logits[0, -1])))
+
+    out: list[int] = []
+    while len(out) < max_new_tokens:
+        # 1. draft k candidates autoregressively (d_1 is the draft's current prediction).
+        drafts = [next_draft]
+        for _ in range(k - 1):
+            nxt, d_cache = _spec_forward_jit(
+                draft_params, jnp.asarray([[drafts[-1]]], jnp.int32), d_cache, cfg=draft_cfg
+            )
+            drafts.append(int(np.asarray(nxt[0, -1])))
+        base_t = int(np.asarray(t_cache["index"]))  # emitted length (target wrote nothing yet)
+        # Draft wrote drafts[0..k-2] while drafting, so base_d = emitted length + (k-1).
+        base_d = int(np.asarray(d_cache["index"]))
+        # 2. verify all k drafts in one target forward (writes their k/v at base_t..).
+        ys, t_cache = _spec_forward_jit(
+            target_params, jnp.asarray([drafts], jnp.int32), t_cache, cfg=target_cfg
+        )
+        ys = np.asarray(ys[0]).tolist()  # ys[i] = target's greedy token AFTER drafts[i]
+        # 3. longest agreeing prefix.
+        n = 0
+        preds = [next_target] + ys[:-1]  # target's prediction for position i
+        while n < k and drafts[n] == preds[n]:
+            n += 1
+        emitted = drafts[:n] + [ys[n - 1] if n > 0 else next_target]
+        correction = emitted[-1]
+        # 4. rewind both caches to accepted length, then advance past the correction.
+        t_cache = _cache_rewind(t_cache, base_t + n)
+        nt, t_cache = _spec_forward_jit(
+            target_params, jnp.asarray([[correction]], jnp.int32), t_cache, cfg=target_cfg
+        )
+        if n == k:
+            # Full acceptance: the draft never processed d_k (it only wrote d_1..d_{k-1}
+            # while drafting), so feed [d_k, correction] in one T=2 step — a plain
+            # correction-only write would leave an invalid hole at d_k's slot.
+            d_cache = _cache_rewind(d_cache, base_d)
+            nd, d_cache = _spec_forward_jit(
+                draft_params, jnp.asarray([[drafts[-1], correction]], jnp.int32),
+                d_cache, cfg=draft_cfg,
+            )
+        else:
+            d_cache = _cache_rewind(d_cache, base_d - (k - 1) + n)
+            nd, d_cache = _spec_forward_jit(
+                draft_params, jnp.asarray([[correction]], jnp.int32), d_cache, cfg=draft_cfg
+            )
+        next_target = int(np.asarray(nt[0, -1]))
+        next_draft = int(np.asarray(nd[0, -1]))
+        for tok in emitted:
+            out.append(tok)
+            if len(out) >= max_new_tokens or (eos_token_id is not None and tok == eos_token_id):
+                return jnp.asarray([out], jnp.int32)
+    return jnp.asarray([out], jnp.int32)

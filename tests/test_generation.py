@@ -178,3 +178,56 @@ class TestStreamedGeneration:
         want = llama.generate(params, prompt, cfg, gen)
         got = llama.generate_streamed(dispatched, prompt, cfg, gen)
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestSpeculative:
+    """Greedy speculative decoding must equal plain greedy target decode exactly."""
+
+    def _models(self):
+        target_cfg = dataclasses.replace(llama.CONFIGS["tiny"], dtype=jnp.float32)
+        draft_cfg = dataclasses.replace(
+            llama.CONFIGS["tiny"], dtype=jnp.float32, n_layers=1, d_model=64,
+            n_heads=2, n_kv_heads=1, d_ff=128,
+        )
+        return (llama.init_params(target_cfg, jax.random.PRNGKey(0)), target_cfg,
+                llama.init_params(draft_cfg, jax.random.PRNGKey(1)), draft_cfg)
+
+    def test_matches_plain_greedy(self):
+        tp, tc, dp, dc = self._models()
+        rng = np.random.default_rng(0)
+        for trial, (plen, n_new, k) in enumerate(((7, 12, 4), (3, 9, 2), (10, 15, 6))):
+            prompt = rng.integers(1, tc.vocab_size, plen).astype(np.int32)
+            got = np.asarray(llama.generate_speculative(
+                tp, tc, dp, dc, prompt, max_new_tokens=n_new, k=k
+            ))[0].tolist()
+            want = np.asarray(llama.generate(
+                tp, prompt[None], tc, GenerationConfig(max_new_tokens=n_new, temperature=0.0)
+            ))[0].tolist()
+            assert got == want, (trial, got, want)
+
+    def test_perfect_draft_accepts_everything(self):
+        """Draft == target: every round accepts all k and emits k+1 tokens per target call."""
+        tp, tc, _, _ = self._models()
+        rng = np.random.default_rng(1)
+        prompt = rng.integers(1, tc.vocab_size, 6).astype(np.int32)
+        got = np.asarray(llama.generate_speculative(
+            tp, tc, tp, tc, prompt, max_new_tokens=13, k=4
+        ))[0].tolist()
+        want = np.asarray(llama.generate(
+            tp, prompt[None], tc, GenerationConfig(max_new_tokens=13, temperature=0.0)
+        ))[0].tolist()
+        assert got == want
+
+    def test_eos_stops(self):
+        tp, tc, dp, dc = self._models()
+        rng = np.random.default_rng(2)
+        prompt = rng.integers(1, tc.vocab_size, 5).astype(np.int32)
+        full = np.asarray(llama.generate(
+            tp, prompt[None], tc, GenerationConfig(max_new_tokens=10, temperature=0.0)
+        ))[0].tolist()
+        eos = full[3]
+        got = np.asarray(llama.generate_speculative(
+            tp, tc, dp, dc, prompt, max_new_tokens=10, k=3, eos_token_id=eos
+        ))[0].tolist()
+        assert got == full[:got.index(eos) + 1] if eos in got else got == full
+        assert got[-1] == eos or len(got) == 10
