@@ -1026,23 +1026,27 @@ def generate_speculative(
     k: int = 4,
     eos_token_id: Optional[int] = None,
     prompt_mask: Optional[jax.Array] = None,
-) -> jax.Array:
-    """Greedy speculative decoding: a small draft model proposes ``k`` tokens per round, the
-    target verifies them in ONE T=k forward, and the longest agreeing prefix is accepted
-    plus the target's correction token — so each round emits 1..k+1 tokens for one target
-    dispatch. Output is PROVABLY identical to the target's plain greedy decode (tested
-    token-for-token); the draft only changes how many target forwards it takes to get there.
-    The reference has no speculative path. Single sequence (B=1): speculation is a
-    latency tool for individual streams; batch throughput is ``serving.ContinuousBatcher``.
+    return_stats: bool = False,
+):
+    """Greedy speculative decoding: ONE target dispatch per round verifies the pending
+    token plus ``k-1`` draft proposals and emits 1..k tokens (accepted prefix + the
+    target's correction). Output is PROVABLY identical to the target's plain greedy decode
+    (tested token-for-token); the draft only changes how many target forwards it takes.
+    The reference has no speculative path. Single sequence (B=1): speculation is a latency
+    tool for individual streams; batch throughput is ``serving.ContinuousBatcher``.
 
-    Round invariant: both caches hold EXACTLY the emitted sequence; ``next_target`` /
-    ``next_draft`` are each model's greedy prediction after that context. Verified drafts'
-    k/v already sit in both caches (computed under the same accepted context), so
-    acceptance is a cache REWIND to the accepted length plus one T=1 step on the
-    correction token — rejected suffix slots are just invalidated.
+    Round invariant: both caches hold the emitted sequence EXCEPT the newest token
+    (``pending``), which rides as the first input of the next round's forwards — so the
+    correction never costs its own target dispatch. Verified drafts' k/v already sit in
+    the caches; acceptance is a cache REWIND plus bookkeeping.
+
+    ``return_stats=True`` also returns ``{"rounds", "target_dispatches", "tokens"}``
+    (dispatches = rounds + 1 prefill) for tokens-per-dispatch accounting.
     """
     if target_cfg.vocab_size != draft_cfg.vocab_size:
         raise ValueError("draft and target must share a vocabulary")
+    if k < 2:
+        raise ValueError("k must be >= 2 (k-1 draft proposals per round)")
     prompt = jnp.asarray(prompt, jnp.int32)
     if prompt.ndim == 1:
         prompt = prompt[None]
@@ -1060,59 +1064,69 @@ def generate_speculative(
     t_logits, t_cache = forward_cached(
         target_params, prompt, t_cache, target_cfg, token_mask=prompt_mask, last_only=True
     )
-    d_logits, d_cache = forward_cached(
+    _, d_cache = forward_cached(
         draft_params, prompt, d_cache, draft_cfg, token_mask=prompt_mask, last_only=True
     )
-    next_target = int(np.asarray(jnp.argmax(t_logits[0, -1])))
-    next_draft = int(np.asarray(jnp.argmax(d_logits[0, -1])))
+    # ``pending``: emitted but not yet written to either cache.
+    pending = int(np.asarray(jnp.argmax(t_logits[0, -1])))
+    out: list[int] = [pending]
+    rounds = 0
 
-    out: list[int] = []
+    def finish():
+        toks = jnp.asarray([out[:max_new_tokens]], jnp.int32)
+        if return_stats:
+            return toks, {
+                "rounds": rounds, "target_dispatches": rounds + 1, "tokens": min(len(out), max_new_tokens),
+            }
+        return toks
+
+    if eos_token_id is not None and pending == eos_token_id:
+        return finish()
+
     while len(out) < max_new_tokens:
-        # 1. draft k candidates autoregressively (d_1 is the draft's current prediction).
-        drafts = [next_draft]
+        rounds += 1
+        # 1. draft k-1 proposals; the draft's first input is the pending token itself.
+        drafts: list[int] = []
+        tok = pending
         for _ in range(k - 1):
             nxt, d_cache = _spec_forward_jit(
+                draft_params, jnp.asarray([[tok]], jnp.int32), d_cache, cfg=draft_cfg
+            )
+            tok = int(np.asarray(nxt[0, -1]))
+            drafts.append(tok)
+        base_t = int(np.asarray(t_cache["index"]))      # emitted length - 1 (pending unwritten)
+        base_d = int(np.asarray(d_cache["index"])) - (k - 1)  # draft wrote pending + drafts[:-1]
+        # 2. ONE target dispatch (T=k): verify pending + ALL proposals. ys[i] is the
+        # target's token after input i — ys[n] checks drafts[n] for n < k-1, and ys[k-1]
+        # (after the last proposal) is the bonus correction on full acceptance.
+        ys, t_cache = _spec_forward_jit(
+            target_params, jnp.asarray([[pending, *drafts]], jnp.int32), t_cache,
+            cfg=target_cfg,
+        )
+        ys = np.asarray(ys[0]).tolist()
+        # 3. accept the longest prefix of proposals agreeing with the target.
+        n = 0
+        while n < k - 1 and drafts[n] == ys[n]:
+            n += 1
+        emitted = drafts[:n] + [ys[n]]  # correction ys[n] becomes the new pending token
+        # 4. rewind to written-emitted length: target wrote pending+accepted (base_t+1+n);
+        # draft wrote the same prefix (its extra proposal writes are invalidated).
+        t_cache = _cache_rewind(t_cache, base_t + 1 + n)
+        if n == k - 1:
+            # Full acceptance: the draft never processed its own last proposal (it wrote
+            # pending + drafts[:-1]); catch it up with one cheap draft step so the next
+            # round's cache has no invalid hole. Its output is discarded.
+            d_cache = _cache_rewind(d_cache, base_d + n)
+            _, d_cache = _spec_forward_jit(
                 draft_params, jnp.asarray([[drafts[-1]]], jnp.int32), d_cache, cfg=draft_cfg
             )
-            drafts.append(int(np.asarray(nxt[0, -1])))
-        base_t = int(np.asarray(t_cache["index"]))  # emitted length (target wrote nothing yet)
-        # Draft wrote drafts[0..k-2] while drafting, so base_d = emitted length + (k-1).
-        base_d = int(np.asarray(d_cache["index"]))
-        # 2. verify all k drafts in one target forward (writes their k/v at base_t..).
-        ys, t_cache = _spec_forward_jit(
-            target_params, jnp.asarray([drafts], jnp.int32), t_cache, cfg=target_cfg
-        )
-        ys = np.asarray(ys[0]).tolist()  # ys[i] = target's greedy token AFTER drafts[i]
-        # 3. longest agreeing prefix.
-        n = 0
-        preds = [next_target] + ys[:-1]  # target's prediction for position i
-        while n < k and drafts[n] == preds[n]:
-            n += 1
-        emitted = drafts[:n] + [ys[n - 1] if n > 0 else next_target]
-        correction = emitted[-1]
-        # 4. rewind both caches to accepted length, then advance past the correction.
-        t_cache = _cache_rewind(t_cache, base_t + n)
-        nt, t_cache = _spec_forward_jit(
-            target_params, jnp.asarray([[correction]], jnp.int32), t_cache, cfg=target_cfg
-        )
-        if n == k:
-            # Full acceptance: the draft never processed d_k (it only wrote d_1..d_{k-1}
-            # while drafting), so feed [d_k, correction] in one T=2 step — a plain
-            # correction-only write would leave an invalid hole at d_k's slot.
-            d_cache = _cache_rewind(d_cache, base_d)
-            nd, d_cache = _spec_forward_jit(
-                draft_params, jnp.asarray([[drafts[-1], correction]], jnp.int32),
-                d_cache, cfg=draft_cfg,
-            )
         else:
-            d_cache = _cache_rewind(d_cache, base_d - (k - 1) + n)
-            nd, d_cache = _spec_forward_jit(
-                draft_params, jnp.asarray([[correction]], jnp.int32), d_cache, cfg=draft_cfg
-            )
-        next_target = int(np.asarray(nt[0, -1]))
-        next_draft = int(np.asarray(nd[0, -1]))
+            d_cache = _cache_rewind(d_cache, base_d + 1 + n)
+        pending = emitted[-1]
         for tok in emitted:
             out.append(tok)
-            if len(out) >= max_new_tokens or (eos_token_id is not None and tok == eos_token_id):
-                return jnp.asarray([out], jnp.int32)
-    return jnp.asarray([out], jnp.int32)
+            if len(out) >= max_new_tokens or (
+                eos_token_id is not None and tok == eos_token_id
+            ):
+                return finish()
+    return finish()

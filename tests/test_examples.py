@@ -93,6 +93,12 @@ def test_serving_example(capsys, monkeypatch):
     assert "served 10 requests" in out and "tokens/s" in out
 
 
+def test_speculative_example(capsys, monkeypatch):
+    out = _run_inline(EXAMPLES / "inference" / "speculative.py",
+                      capsys=capsys, monkeypatch=monkeypatch)
+    assert "== plain greedy" in out
+
+
 def test_cv_example(capsys, monkeypatch):
     out = _run_inline(EXAMPLES / "cv_example.py", capsys=capsys, monkeypatch=monkeypatch)
     assert "accuracy=" in out
