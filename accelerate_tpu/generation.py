@@ -33,7 +33,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["GenerationConfig", "sample_logits", "generate_loop", "streamed_generate_loop"]
+__all__ = ["GenerationConfig", "sample_logits", "sampling_core", "generate_loop", "streamed_generate_loop"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -48,27 +48,34 @@ class GenerationConfig:
     pad_token_id: int = 0
 
 
+def sampling_core(logits: jax.Array, rng: jax.Array, temperature, top_p, top_k: int) -> jax.Array:
+    """Temperature / top-k / top-p draw with SCALAR-traceable temperature/top_p (only the
+    shape-affecting ``top_k`` must be static). The top-p filter applies unconditionally —
+    it is the identity at ``top_p == 1.0``. Single source for ``sample_logits`` and the
+    serving engine's jitted per-request draw, so their outputs can never drift."""
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Keep the smallest prefix with cumulative prob >= top_p (always keep the best token).
+    keep_sorted = cum - probs < top_p
+    threshold = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
 def sample_logits(logits: jax.Array, gen: GenerationConfig, rng: Optional[jax.Array]) -> jax.Array:
     """logits [B, V] → token ids [B] via greedy / temperature / top-k / top-p."""
     if gen.temperature <= 0.0:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if rng is None:
         raise ValueError("temperature sampling needs an rng key")
-    logits = logits.astype(jnp.float32) / gen.temperature
-    if gen.top_k > 0:
-        kth = jax.lax.top_k(logits, gen.top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if gen.top_p < 1.0:
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # Keep the smallest prefix with cumulative prob >= top_p (always keep the best token).
-        keep_sorted = cum - probs < gen.top_p
-        threshold = jnp.min(
-            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
-        )
-        logits = jnp.where(logits < threshold, -jnp.inf, logits)
-    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+    return sampling_core(logits, rng, gen.temperature, gen.top_p, gen.top_k)
 
 
 @partial(jax.jit, static_argnames=("prefill_fn", "decode_fn", "gen"))

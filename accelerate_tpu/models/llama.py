@@ -1054,6 +1054,10 @@ def generate_speculative(
         raise ValueError("generate_speculative is single-sequence (B=1)")
     if prompt_mask is None:
         prompt_mask = jnp.ones(prompt.shape, jnp.bool_)
+    else:
+        prompt_mask = jnp.asarray(prompt_mask, jnp.bool_)
+        if prompt_mask.ndim == 1:  # mirror the prompt's auto batch dim
+            prompt_mask = prompt_mask[None]
     S0 = prompt.shape[1]
     # Bucketed like generate(): nearby prompt/k/max_new combinations share one compiled
     # program per token shape (the valid-mask machinery makes an over-long cache identical).

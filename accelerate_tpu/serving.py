@@ -36,7 +36,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from .generation import GenerationConfig
+from .generation import GenerationConfig, sampling_core
 from .models import llama
 from .models.llama import _block_cached, _rms_norm, init_cache
 
@@ -45,21 +45,11 @@ __all__ = ["ContinuousBatcher", "Request"]
 
 @partial(jax.jit, static_argnames=("top_k",))
 def _draw(logits_row, key, temperature, top_p, top_k: int):
-    """One sampled draw. Only ``top_k`` is static (it shapes the lax.top_k call);
-    temperature/top_p trace as scalars so arbitrary user values share one executable.
-    Mirrors ``generation.sample_logits`` op-for-op (same key → same draw): the top_p
-    filter applied unconditionally is the identity at top_p == 1.0."""
-    logits = logits_row[None].astype(jnp.float32) / temperature
-    if top_k > 0:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    keep_sorted = cum - probs < top_p
-    threshold = jnp.min(jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True)
-    logits = jnp.where(logits < threshold, -jnp.inf, logits)
-    return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)[0]
+    """One sampled draw over ``generation.sampling_core`` — the SAME code path
+    ``sample_logits`` uses, so batcher output can never drift from generate(). Only
+    ``top_k`` is static (it shapes lax.top_k); temperature/top_p trace as scalars so
+    arbitrary user values share one executable."""
+    return sampling_core(logits_row[None], key, temperature, top_p, top_k)[0]
 
 
 @dataclasses.dataclass

@@ -300,11 +300,42 @@ def _metric_label(B: int, S: int, fuse: int, preset: str | None, cfg=None) -> st
     return f"train_mfu (llama-0.9B b{B} seq{S} bf16 {attn} {remat} fused{fuse})"
 
 
+def _adopt_best_sweep_config() -> None:
+    """If an MFU sweep left results (benchmarks/mfu_sweep.py → sweep_results.jsonl), adopt
+    the best-scoring config's env overrides for any knob not explicitly set — so the
+    scoring run automatically benefits from a sweep that completed earlier."""
+    import os
+
+    if os.environ.get("BENCH_AUTO_BEST", "1") != "1":
+        return
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "sweep_results.jsonl")
+    best = None
+    try:
+        with open(path) as f:
+            for line in f:
+                row = json.loads(line)
+                if row.get("value") is not None and (
+                    best is None or row["value"] > best["value"]
+                ):
+                    best = row
+    except (OSError, json.JSONDecodeError):
+        return
+    if best is None or not best.get("sweep_env"):
+        return
+    applied = {k: v for k, v in best["sweep_env"].items() if k not in os.environ}
+    os.environ.update(applied)
+    if applied:
+        print(f"bench: adopting sweep best '{best.get('sweep_config')}' "
+              f"(MFU {best['value']}): {applied}", file=sys.stderr)
+
+
 def main():
     import os
     import threading
 
     preset = os.environ.get("BENCH_PRESET")
+    if not preset:
+        _adopt_best_sweep_config()
     B = int(os.environ.get("BENCH_B", "4"))
     S = int(os.environ.get("BENCH_S", "2048"))
     fuse = int(os.environ.get("BENCH_FUSE", "4"))

@@ -57,7 +57,11 @@ def tpu_alive(timeout_s: float = 45.0) -> bool:
 
 def run_config(name: str, env_over: dict, per_run_timeout: float) -> dict:
     env = {**os.environ, **env_over,
-           "BENCH_WATCHDOG_S": str(max(60, int(per_run_timeout - 30)))}
+           "BENCH_WATCHDOG_S": str(max(60, int(per_run_timeout - 30))),
+           # Each sweep row must measure EXACTLY its own one-knob delta: without this,
+           # bench's auto-adoption would re-read the sweep's partial output and silently
+           # hybridize later configs with the best-so-far row's env.
+           "BENCH_AUTO_BEST": "0"}
     t0 = time.time()
     try:
         out = subprocess.run(

@@ -148,3 +148,22 @@ def test_sampled_request_matches_generate(setup):
             rng=rng, prompt_mask=jnp.asarray(pmask),
         ))[0].tolist()
         assert req.tokens == want, (req.tokens, want)
+
+
+def test_sampled_top_p_matches_generate(setup):
+    """top_p < 1 exercises the nucleus filter off its identity point."""
+    import jax
+
+    params, prompts = setup
+    gen = GenerationConfig(max_new_tokens=5, temperature=0.7, top_p=0.8)
+    rng = jax.random.PRNGKey(77)
+    engine = ContinuousBatcher(params, CFG, max_slots=1, max_len=64, prompt_bucket=16)
+    req = engine.submit(prompts[0], gen=gen, rng=rng)
+    engine.run()
+    pad = 16 - len(prompts[0])
+    padded = np.zeros((1, 16), np.int32); padded[0, pad:] = prompts[0]
+    pmask = np.zeros((1, 16), bool); pmask[0, pad:] = True
+    want = np.asarray(llama.generate(
+        params, jnp.asarray(padded), CFG, gen, rng=rng, prompt_mask=jnp.asarray(pmask)
+    ))[0].tolist()
+    assert req.tokens == want
