@@ -48,24 +48,31 @@ class GenerationConfig:
     pad_token_id: int = 0
 
 
-def sampling_core(logits: jax.Array, rng: jax.Array, temperature, top_p, top_k: int) -> jax.Array:
+def sampling_core(logits: jax.Array, rng: jax.Array, temperature, top_p, top_k: int,
+                  apply_top_p: bool = True) -> jax.Array:
     """Temperature / top-k / top-p draw with SCALAR-traceable temperature/top_p (only the
-    shape-affecting ``top_k`` must be static). The top-p filter applies unconditionally —
-    it is the identity at ``top_p == 1.0``. Single source for ``sample_logits`` and the
-    serving engine's jitted per-request draw, so their outputs can never drift."""
+    shape-affecting ``top_k`` and ``apply_top_p`` must be static). Single source for
+    ``sample_logits`` and the serving engine's jitted per-request draw, so their outputs
+    can never drift.
+
+    ``apply_top_p=False`` statically traces out the nucleus filter (an O(V log V) sort +
+    softmax/cumsum per token): callers whose top_p is a static 1.0 skip the cost — and the
+    float hazard where a cumsum prefix rounds to exactly 1.0 and masks live tail tokens.
+    The serving engine keeps it on (its per-request top_p is traced)."""
     logits = logits.astype(jnp.float32) / temperature
     if top_k > 0:
         kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
-    sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-    probs = jax.nn.softmax(sorted_logits, axis=-1)
-    cum = jnp.cumsum(probs, axis=-1)
-    # Keep the smallest prefix with cumulative prob >= top_p (always keep the best token).
-    keep_sorted = cum - probs < top_p
-    threshold = jnp.min(
-        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
-    )
-    logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    if apply_top_p:
+        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep the smallest prefix with cumulative prob >= top_p (always keep the best token).
+        keep_sorted = cum - probs < top_p
+        threshold = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -75,7 +82,10 @@ def sample_logits(logits: jax.Array, gen: GenerationConfig, rng: Optional[jax.Ar
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
     if rng is None:
         raise ValueError("temperature sampling needs an rng key")
-    return sampling_core(logits, rng, gen.temperature, gen.top_p, gen.top_k)
+    # gen is jit-static here, so top_p == 1.0 removes the nucleus pass at trace time.
+    return sampling_core(
+        logits, rng, gen.temperature, gen.top_p, gen.top_k, apply_top_p=gen.top_p < 1.0
+    )
 
 
 @partial(jax.jit, static_argnames=("prefill_fn", "decode_fn", "gen"))

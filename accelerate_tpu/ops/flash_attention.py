@@ -5,9 +5,12 @@ framework's hot-path attention (SURVEY.md §7: "Pallas flash/splash attention").
 online-softmax tiling: the (S×T) score matrix never materializes in HBM — per-block partial
 maxima/sums ride in VMEM scratch across the kv-grid dimension (FlashAttention-2 schedule).
 
-Layout: q [B, H, S, hd], k/v [B, H, T, hd] (the public wrapper handles the user-facing
-[B, S, H, hd] layout + GQA head repetition). Sequence lengths are padded to block multiples;
-padded keys are masked via global column indices, padded query rows sliced off by the wrapper.
+Layout: q [B, H, S, hd], k/v [B, K, T, hd] with K dividing H (the public wrapper handles the
+user-facing [B, S, H, hd] layout). GQA is native: the kernels' BlockSpec index maps send q
+head h to kv head h // (H//K), and the dk/dv kernel accumulates each kv head's gradient over
+its whole query group in VMEM — repeated K/V never exist in HBM. Sequence lengths are padded
+to block multiples; padded keys are masked via global column indices, padded query rows
+sliced off by the wrapper.
 
 **Position offsets**: the kernels take traced ``q_offset``/``kv_offset`` scalars (SMEM) giving
 the global position of the local block — this is what lets ``ops/ring_attention.py`` reuse
@@ -156,8 +159,12 @@ def _seg_blocks(segments, Sp, Tp):
 
 def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, q_offset=0, kv_offset=0,
          segments=None):
-    """Raw forward: [B,H,S,hd] → (o [B,H,S,hd], lse [B,H,S] fp32). Differentiation-free."""
+    """Raw forward: q [B,H,S,hd], k/v [B,K,T,hd] (K divides H — GQA resolved IN the BlockSpec
+    index maps, never via a materialized head repeat) → (o [B,H,S,hd], lse [B,H,S] fp32).
+    Differentiation-free."""
     B, H, S, hd = q.shape
+    K = k.shape[1]
+    reps = H // K
     T = k.shape[2]
     nq = pl.cdiv(S, block_q)
     nk = pl.cdiv(T, block_k)
@@ -188,8 +195,8 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, q_offset=0, kv_
             _smem_scalar_spec(),
             *seg_specs,
             pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h // reps, j, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h // reps, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0)),
@@ -272,7 +279,7 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_off_ref, kv_off_ref, *refs,
-    sm_scale, causal, block_q, block_k, kv_len, q_len, has_segments,
+    sm_scale, causal, block_q, block_k, kv_len, q_len, nq, has_segments,
 ):
     if has_segments:
         (q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -281,10 +288,13 @@ def _bwd_dkv_kernel(
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dk_ref, dv_ref, dk_acc, dv_acc) = refs
     j = pl.program_id(2)  # kv block (outer)
-    i = pl.program_id(3)  # q block (inner)
+    # Inner dim walks (GQA group rep, q block) pairs: g = r*nq + i. dk/dv for one kv head
+    # accumulate over every q head in its group, entirely in VMEM scratch.
+    g = pl.program_id(3)
     ni = pl.num_programs(3)
+    i = jax.lax.rem(g, nq)
 
-    @pl.when(i == 0)
+    @pl.when(g == 0)
     def _init():
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
@@ -331,7 +341,7 @@ def _bwd_dkv_kernel(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
-    @pl.when(i == ni - 1)
+    @pl.when(g == ni - 1)
     def _finalize():
         dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
@@ -339,8 +349,11 @@ def _bwd_dkv_kernel(
 
 def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
             q_offset=0, kv_offset=0, segments=None):
-    """dq for local q against one kv block (ring building block)."""
+    """dq for local q against one kv block (ring building block). GQA (K < H kv heads)
+    resolved via the k/v index maps, matching ``_fwd``."""
     B, H, S, hd = q.shape
+    K = k.shape[1]
+    reps = H // K
     T = k.shape[2]
     nq = pl.cdiv(S, block_q)
     nk = pl.cdiv(T, block_k)
@@ -371,8 +384,8 @@ def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpr
             _smem_scalar_spec(),
             *seg_specs,
             pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h // reps, j, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h // reps, j, 0)),
             pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
             pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
@@ -387,8 +400,14 @@ def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpr
 
 def _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
              q_offset=0, kv_offset=0, segments=None):
-    """(dk, dv) for one kv block against local q (ring building block)."""
+    """(dk, dv) [B,K,T,hd] for one kv block against local q (ring building block).
+
+    GQA: the inner grid dim runs ``reps * nq`` steps — every (q head in the kv head's
+    group, q block) pair — so each kv head's gradient accumulates over its whole group in
+    VMEM scratch, without materializing per-q-head dk/dv."""
     B, H, S, hd = q.shape
+    K = k.shape[1]
+    reps = H // K
     T = k.shape[2]
     nq = pl.cdiv(S, block_q)
     nk = pl.cdiv(T, block_k)
@@ -401,39 +420,39 @@ def _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interp
     seg_specs, seg_args = [], []
     if has_segments:
         q_seg, kv_seg = _seg_blocks(segments, Sp, Tp)
-        # Grid order here is (b, h, j, i): kv block outer, q block inner.
+        # Grid order here is (b, kh, j, g): kv block outer, (group rep, q block) inner.
         seg_specs = [
-            pl.BlockSpec((1, block_q), lambda b, h, j, i: (b, i)),
-            pl.BlockSpec((1, block_k), lambda b, h, j, i: (b, j)),
+            pl.BlockSpec((1, block_q), lambda b, kh, j, g: (b, g % nq)),
+            pl.BlockSpec((1, block_k), lambda b, kh, j, g: (b, j)),
         ]
         seg_args = [q_seg, kv_seg]
     kernel = functools.partial(
         _bwd_dkv_kernel,
         sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k,
-        kv_len=T, q_len=S,
+        kv_len=T, q_len=S, nq=nq,
         has_segments=has_segments,
     )
     dk, dv = pl.pallas_call(
         kernel,
-        grid=(B, H, nk, nq),
+        grid=(B, K, nk, reps * nq),
         in_specs=[
             _smem_scalar_spec(),
             _smem_scalar_spec(),
             *seg_specs,
-            pl.BlockSpec((1, 1, block_q, hd), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, j, i: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, j, i: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_q, hd), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, j, i: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, hd), lambda b, kh, j, g: (b, kh * reps + g // nq, g % nq, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, kh, j, g: (b, kh, j, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, kh, j, g: (b, kh, j, 0)),
+            pl.BlockSpec((1, 1, block_q, hd), lambda b, kh, j, g: (b, kh * reps + g // nq, g % nq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, kh, j, g: (b, kh * reps + g // nq, g % nq, 0)),
+            pl.BlockSpec((1, 1, block_q, 1), lambda b, kh, j, g: (b, kh * reps + g // nq, g % nq, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, j, i: (b, h, j, 0)),
-            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, j, i: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, kh, j, g: (b, kh, j, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, kh, j, g: (b, kh, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((B, H, Tp, hd), jnp.float32),
-            jax.ShapeDtypeStruct((B, H, Tp, hd), jnp.float32),
+            jax.ShapeDtypeStruct((B, K, Tp, hd), jnp.float32),
+            jax.ShapeDtypeStruct((B, K, Tp, hd), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, hd), jnp.float32),
@@ -547,10 +566,10 @@ def flash_attention(
         interpret = _interpret_default()
     if segment_ids is not None and k.shape[1] != S:
         raise ValueError("segment_ids requires self-attention shapes (kv length == q length)")
-    if H != K:
-        reps = H // K
-        k = jnp.repeat(k, reps, axis=2)
-        v = jnp.repeat(v, reps, axis=2)
+    if H % K:
+        raise ValueError(f"q heads ({H}) must be a multiple of kv heads ({K})")
+    # GQA needs no head repeat: the kernels map q head h → kv head h // (H//K) in their
+    # BlockSpec index maps, so the repeated K/V never exist in HBM.
     # [B, S, H, hd] → [B, H, S, hd]
     qT = q.transpose(0, 2, 1, 3)
     kT = k.transpose(0, 2, 1, 3)

@@ -112,7 +112,7 @@ def _ring_bwd(axis_name, causal, sm_scale, block_sizes, interpret, residuals, do
         dv_next = lax.ppermute(dv_cur, axis_name, perm)
         return (k_next, v_next, dk_next, dv_next, dq_run), None
 
-    zeros_kv = jnp.zeros((B, H, S_local, hd), jnp.float32)
+    zeros_kv = jnp.zeros(k.shape, jnp.float32)  # [B, K, S_local, hd] — K kv heads, unrepeated
     (k_home, v_home, dk, dv, dq), _ = lax.scan(
         body, (k, v, zeros_kv, zeros_kv, jnp.zeros((B, H, S_local, hd), jnp.float32)),
         jnp.arange(n),
@@ -136,8 +136,9 @@ def ring_attention(
 ) -> jax.Array:
     """Exact ring attention for use inside shard_map; user layout q [B, S_loc, H, hd].
 
-    k/v [B, S_loc, K, hd] with K ≤ H (GQA repeat handled here; gradients sum back through
-    the repeat automatically). Returns [B, S_loc, H, hd].
+    k/v [B, S_loc, K, hd] with K dividing H — GQA is native in the flash kernels, so the
+    ring rotates the UNREPEATED [B, K, S_loc, hd] k/v (and dk/dv): for 16q/8kv that halves
+    the per-step ppermute bytes on the ICI ring. Returns [B, S_loc, H, hd].
     """
     B, S_local, H, hd = q.shape
     K = k.shape[2]
@@ -145,10 +146,8 @@ def ring_attention(
         sm_scale = 1.0 / math.sqrt(hd)
     if interpret is None:
         interpret = _interpret_default()
-    if H != K:
-        reps = H // K
-        k = jnp.repeat(k, reps, axis=2)
-        v = jnp.repeat(v, reps, axis=2)
+    if H % K:
+        raise ValueError(f"q heads ({H}) must be a multiple of kv heads ({K})")
     qT = q.transpose(0, 2, 1, 3)
     kT = k.transpose(0, 2, 1, 3)
     vT = v.transpose(0, 2, 1, 3)

@@ -57,12 +57,19 @@ def ulysses_attention(
 
     Inside shard_map: q/k/v [B, S_local, H, hd] (seq-sharded) → out [B, S_local, H, hd].
     Requires n_heads % axis_size == 0.
+
+    GQA: when the kv-head count divides the sp size's head split (K % n == 0), the
+    UNREPEATED kv rides the all-to-all — each device ends up with H/n q heads and K/n kv
+    heads whose group mapping lines up exactly with the flash kernels' native h → h//(H/K)
+    indexing, so the payload shrinks by H/K vs repeating. Otherwise (K < n after split)
+    kv is repeated up to H first — correct, just bigger.
     """
-    q, k, v = _repeat_gqa(q, k, v)
     n = lax.axis_size(axis_name)
-    H = q.shape[2]
+    H, K = q.shape[2], k.shape[2]
     if H % n != 0:
         raise ValueError(f"ulysses needs n_heads ({H}) divisible by sp size ({n})")
+    if K % n != 0:
+        q, k, v = _repeat_gqa(q, k, v)
     # [B, S_loc, H, hd] → [B, S_global, H/n, hd]: split heads, gather sequence.
     qg = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kg = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
@@ -81,8 +88,10 @@ def allgather_attention(
     sm_scale: Optional[float] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """Naive SP: all-gather kv, attend local q chunk against the full sequence."""
-    q, k, v = _repeat_gqa(q, k, v)
+    """Naive SP: all-gather kv, attend local q chunk against the full sequence.
+
+    GQA needs no repeat on this path: the flash kernels take unrepeated [B, S, K, hd] kv,
+    so the all-gather moves H/K× fewer bytes over ICI."""
     idx = lax.axis_index(axis_name)
     S_local = q.shape[1]
     kg = lax.all_gather(k, axis_name, axis=1, tiled=True)

@@ -300,10 +300,19 @@ def _metric_label(B: int, S: int, fuse: int, preset: str | None, cfg=None) -> st
     return f"train_mfu (llama-0.9B b{B} seq{S} bf16 {attn} {remat} fused{fuse})"
 
 
+# Only pure TUNING knobs may be auto-adopted from sweep results. Workload knobs
+# (BENCH_B/S/FUSE/REMAT) change what is being measured — adopting a bigger batch would
+# report an MFU jump attributable to the workload, not the framework, and break
+# comparability with the tracked b4/seq2048 history.
+_TUNING_KNOBS = {"ACCEL_FLASH_BLOCK_Q", "ACCEL_FLASH_BLOCK_K", "BENCH_ATTN", "BENCH_REMAT_POLICY"}
+
+
 def _adopt_best_sweep_config() -> None:
     """If an MFU sweep left results (benchmarks/mfu_sweep.py → sweep_results.jsonl), adopt
-    the best-scoring config's env overrides for any knob not explicitly set — so the
-    scoring run automatically benefits from a sweep that completed earlier."""
+    the best-scoring config's env overrides for any TUNING knob not explicitly set — so the
+    scoring run automatically benefits from a sweep that completed earlier. Rows whose
+    sweep_env touches workload knobs are skipped entirely (they scored a different
+    workload, so their MFU is not comparable)."""
     import os
 
     if os.environ.get("BENCH_AUTO_BEST", "1") != "1":
@@ -314,6 +323,9 @@ def _adopt_best_sweep_config() -> None:
         with open(path) as f:
             for line in f:
                 row = json.loads(line)
+                env = row.get("sweep_env") or {}
+                if not set(env) <= _TUNING_KNOBS:
+                    continue
                 if row.get("value") is not None and (
                     best is None or row["value"] > best["value"]
                 ):

@@ -1,0 +1,155 @@
+"""Perf decomposition: where does the train-step time go on the real chip?
+
+Times each piece of the bench workload in isolation so the MFU gap can be attributed:
+
+  matmul_peak     — chained bf16 matmuls at MXU-friendly shapes: the achievable ceiling
+  attn_flash_fwd  — Pallas flash forward at bench shapes
+  attn_flash_bwd  — flash forward+backward
+  attn_xla_fwd    — XLA-attention forward (same shapes), for kernel comparison
+  attn_xla_bwd    — XLA-attention forward+backward
+  block_fwd       — one transformer block forward (no remat)
+  fwd             — full model forward (no remat, no loss head)
+  loss_fwd        — full loss_fn forward (adds CE head)
+  fwd_bwd_noremat — loss value_and_grad, remat off (needs batch small enough to fit)
+  fwd_bwd_remat   — loss value_and_grad, remat full
+  fwd_bwd_dots    — loss value_and_grad, remat dots policy
+  opt_step        — adamw update + global-norm clip alone
+
+Each row prints achieved TFLOP/s against its own analytic FLOP count, so the slow
+component is directly visible.  Run on the real chip: `python benchmarks/decompose.py`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+import json
+import math
+import sys
+import time
+
+import numpy as np
+
+REPO = __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def timed(fn, *args, n=3, warmup=1):
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / n
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.ops.flash_attention import flash_attention
+
+    B = int(__import__("os").environ.get("BENCH_B", "4"))
+    S = int(__import__("os").environ.get("BENCH_S", "2048"))
+    cfg = dataclasses.replace(
+        llama.CONFIGS["llama3-8b"],
+        vocab_size=32768, d_model=2048, n_layers=12, n_heads=16, n_kv_heads=8,
+        d_ff=8192, max_seq=S, remat=False, scan_layers=True, attn_impl="flash",
+    )
+    n_params = llama.num_params(cfg)
+    rows = []
+
+    def report(name, dt, flops):
+        tf = flops / dt / 1e12
+        rows.append({"name": name, "ms": round(dt * 1e3, 2), "tflops": round(tf, 2)})
+        print(f"{name:18s} {dt*1e3:9.2f} ms   {tf:8.2f} TFLOP/s", flush=True)
+
+    # --- matmul peak: k chained [8192,8192]x[8192,8192] bf16 matmuls
+    M = 8192
+    a = jnp.ones((M, M), jnp.bfloat16)
+    w = jnp.ones((M, M), jnp.bfloat16)
+
+    @jax.jit
+    def chain(a, w):
+        for _ in range(8):
+            a = a @ w
+        return a
+
+    dt = timed(chain, a, w)
+    report("matmul_peak", dt, 8 * 2 * M * M * M)
+
+    # --- attention at bench shapes (per layer): q [B,S,H,hd]
+    H, K, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+    q = jnp.ones((B, S, H, hd), jnp.bfloat16)
+    k = jnp.ones((B, S, K, hd), jnp.bfloat16)
+    v = jnp.ones((B, S, K, hd), jnp.bfloat16)
+    # causal attention flops fwd: 2 matmuls * B*H*S*S*hd, halved by causality
+    attn_flops = 2 * 2 * B * H * S * S * hd / 2
+
+    f_fwd = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+    dt = timed(f_fwd, q, k, v)
+    report("attn_flash_fwd", dt, attn_flops)
+
+    f_bwd = jax.jit(jax.grad(lambda q, k, v: flash_attention(q, k, v, causal=True).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+    dt = timed(f_bwd, q, k, v)
+    report("attn_flash_bwd", dt, attn_flops * 3.5)  # fwd recompute + 2.5x bwd
+
+    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))[None]
+    x_fwd = jax.jit(lambda q, k, v: llama._attention_xla(q, k, v, mask, cfg))
+    dt = timed(x_fwd, q, k, v)
+    report("attn_xla_fwd", dt, attn_flops * 2)  # xla does the full square
+
+    x_bwd = jax.jit(jax.grad(lambda q, k, v: llama._attention_xla(q, k, v, mask, cfg).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
+    dt = timed(x_bwd, q, k, v)
+    report("attn_xla_bwd", dt, attn_flops * 2 * 3)
+
+    # --- full model forward (no remat) + loss
+    params = llama.init_params(cfg)
+    params = jax.tree_util.tree_map(lambda p: p.astype(jnp.bfloat16), params)
+    tokens = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab_size, (B, S + 1)), jnp.int32)
+    # 2N matmul + causal-attention 2·L·S·D FLOPs per token (bench.py's 6N+6LSD, fwd third).
+    fwd_flops = (2 * n_params + 2 * cfg.n_layers * S * cfg.d_model) * B * S
+
+    fwd = jax.jit(lambda p, t: llama.forward_hidden(p, t[:, :-1], cfg)[0])
+    dt = timed(fwd, params, tokens)
+    report("fwd_hidden", dt, fwd_flops)
+
+    lfn = jax.jit(lambda p, b: llama.loss_fn(p, b, cfg))
+    dt = timed(lfn, params, {"tokens": tokens})
+    report("loss_fwd", dt, fwd_flops)
+
+    for name, policy in (("noremat", cfg), ("remat_full", dataclasses.replace(cfg, remat=True, remat_policy="full")), ("remat_dots", dataclasses.replace(cfg, remat=True, remat_policy="dots"))):
+        c = policy
+        try:
+            g = jax.jit(jax.grad(lambda p, b: llama.loss_fn(p, b, c)))
+            dt = timed(g, params, {"tokens": tokens})
+            report(f"fwd_bwd_{name}", dt, fwd_flops * 3)
+        except Exception as e:  # OOM for noremat at large B
+            print(f"fwd_bwd_{name}: {type(e).__name__}: {str(e).splitlines()[0][:120]}")
+
+    # --- optimizer step alone
+    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(1e-4))
+    params32 = jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), params)
+    opt_state = tx.init(params32)
+
+    @jax.jit
+    def opt_step(p, s):
+        grads = jax.tree_util.tree_map(jnp.ones_like, p)
+        u, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, u), s
+
+    dt = timed(opt_step, params32, opt_state)
+    report("opt_step", dt, 0)
+
+    print(json.dumps({"rows": rows, "config": {"B": B, "S": S, "n_params": n_params}}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

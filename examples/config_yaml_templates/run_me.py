@@ -1,0 +1,14 @@
+"""Print how the current environment is set up (reference `config_yaml_templates/run_me.py`:
+prints the `AcceleratorState` for the chosen config). Run via:
+
+    accelerate-tpu launch --config-file <template>.yaml run_me.py
+"""
+
+from accelerate_tpu import Accelerator
+
+accelerator = Accelerator()
+
+accelerator.print(f"Accelerator state from the current environment:\n{accelerator.state}")
+if accelerator.fp8_recipe is not None:
+    accelerator.print(f"FP8 recipe:\n{accelerator.fp8_recipe}")
+accelerator.end_training()

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Multi-process CPU run (no TPU): each task hosts 4 virtual devices, so launch scripts and
+# collectives can be integration-tested on any SLURM cluster. Reference analog:
+# submit_multicpu.sh (gloo backend → JAX CPU backend + virtual devices).
+
+#SBATCH --job-name=accelerate-tpu-multicpu
+#SBATCH -D .
+#SBATCH --output=O-%x.%j
+#SBATCH --error=E-%x.%j
+#SBATCH --nodes=2
+#SBATCH --ntasks-per-node=1
+#SBATCH --cpus-per-task=16
+#SBATCH --time=00:30:00
+
+source activateEnvironment.sh
+
+head_node_ip=$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n 1)
+
+export LAUNCHER="accelerate-tpu launch \
+    --cpu \
+    --num-virtual-devices 4 \
+    --num-processes $SLURM_NNODES \
+    --num-machines $SLURM_NNODES \
+    --machine-rank \$SLURM_PROCID \
+    --main-process-ip $head_node_ip \
+    --main-process-port 8476 \
+    "
+export SCRIPT="${ACCELERATE_DIR:-/accelerate_tpu}/examples/nlp_example.py"
+
+srun bash -c "$LAUNCHER $SCRIPT"

@@ -1,0 +1,43 @@
+#!/bin/bash
+# Multi-host TPU training under SLURM: one task per TPU host VM, node 0 is the
+# jax.distributed coordinator. TPU-native analog of the reference's submit_multinode.sh
+# (its torchrun --rdzv_backend c10d rendezvous becomes the JAX coordinator address).
+
+#SBATCH --job-name=accelerate-tpu-multinode
+#SBATCH -D .
+#SBATCH --output=O-%x.%j
+#SBATCH --error=E-%x.%j
+#SBATCH --nodes=4                   # TPU host VMs in the slice (v5e-16: 4 hosts)
+#SBATCH --ntasks-per-node=1         # ONE process per host; chips are discovered per host
+#SBATCH --cpus-per-task=96
+#SBATCH --time=01:59:00
+
+######################
+### Set environment ##
+######################
+source activateEnvironment.sh
+
+######################
+#### Set network #####
+######################
+head_node_ip=$(scontrol show hostnames "$SLURM_JOB_NODELIST" | head -n 1)
+export COORDINATOR_PORT=8476
+
+export LAUNCHER="accelerate-tpu launch \
+    --num-processes $SLURM_NNODES \
+    --num-machines $SLURM_NNODES \
+    --machine-rank \$SLURM_PROCID \
+    --main-process-ip $head_node_ip \
+    --main-process-port $COORDINATOR_PORT \
+    --mixed-precision bf16 \
+    --dp -1 \
+    "
+export ACCELERATE_DIR="${ACCELERATE_DIR:-/accelerate_tpu}"
+export SCRIPT="${ACCELERATE_DIR}/examples/complete_nlp_example.py"
+export SCRIPT_ARGS=" \
+    --mixed_precision bf16 \
+    --output_dir ${ACCELERATE_DIR}/examples/output \
+    "
+
+# srun starts one launcher per node; each derives its machine rank from SLURM_PROCID.
+srun bash -c "$LAUNCHER $SCRIPT $SCRIPT_ARGS"

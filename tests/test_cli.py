@@ -60,6 +60,30 @@ def test_config_unknown_keys_ignored(tmp_path):
     assert loaded.num_processes == 2
 
 
+def test_config_zoo_templates_load():
+    """Every shipped config template (examples/config_yaml_templates, examples/slurm) must
+    parse into a ClusterConfig with no unknown-field surprises."""
+    import dataclasses
+    import glob
+
+    import yaml
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    paths = sorted(
+        glob.glob(os.path.join(repo, "examples", "config_yaml_templates", "*.yaml"))
+        + glob.glob(os.path.join(repo, "examples", "slurm", "*.yaml"))
+    )
+    assert len(paths) >= 8
+    known = {f.name for f in dataclasses.fields(ClusterConfig)}
+    for path in paths:
+        with open(path) as f:
+            raw = yaml.safe_load(f)
+        unknown = set(raw) - known
+        assert not unknown, f"{os.path.basename(path)} has unknown fields: {unknown}"
+        cfg = load_config_from_file(path)
+        assert cfg.num_processes >= 1
+
+
 # ----------------------------------------------------------------------- env serialization
 def _launch_args(extra=()):
     parser = launch_command_parser()
