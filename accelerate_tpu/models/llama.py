@@ -70,7 +70,15 @@ class LlamaConfig:
     # and recomputes only cheap elementwise ops (jax.checkpoint_policies — trades HBM for
     # ~25-30% less recompute FLOPs), "offload" offloads block inputs to host memory.
     remat_policy: str = "full"
+    # jax.checkpoint's prevent_cse. None = auto: False under scan_layers (the scan boundary
+    # already isolates the block, and prevent_cse's anti-CSE barriers pessimize XLA's
+    # scheduling inside it — the standard setting for scanned transformer stacks), True
+    # for the unrolled python-loop stack where CSE could defeat rematerialization.
+    remat_prevent_cse: Optional[bool] = None
     scan_layers: bool = False  # lax.scan over stacked layer params (fast compile)
+    # lax.scan unroll for the layer stack: >1 gives XLA a bigger basic block to overlap
+    # DMA with compute across layer boundaries, costing compile time and program size.
+    scan_unroll: int = 1
     use_fp8: bool = False    # fp8-quantized projections (ops/fp8.py, the TE-swap analog)
     fp8_format: Optional[str] = None  # None → the process recipe (FP8RecipeKwargs) decides
     # Mixture-of-Experts (Mixtral-style): 0 = dense MLP. Experts shard over the mesh "ep" axis.
@@ -364,7 +372,10 @@ def _maybe_remat_block(cfg: LlamaConfig):
         raise ValueError(
             f"remat_policy={cfg.remat_policy!r}: expected 'full', 'dots' or 'offload'"
         )
-    return jax.checkpoint(_block, static_argnums=(4,), policy=policy)
+    prevent_cse = (
+        cfg.remat_prevent_cse if cfg.remat_prevent_cse is not None else not cfg.scan_layers
+    )
+    return jax.checkpoint(_block, static_argnums=(4,), policy=policy, prevent_cse=prevent_cse)
 
 
 def packed_target_mask(segment_ids: jax.Array) -> jax.Array:
@@ -449,7 +460,7 @@ def forward_hidden(
                 out = _maybe_shard(out, P(BATCH_AXES, SEQUENCE_AXIS, None))
             return out, aux
 
-        x, auxes = jax.lax.scan(scan_body, x, params["layers"])
+        x, auxes = jax.lax.scan(scan_body, x, params["layers"], unroll=cfg.scan_unroll)
         aux_total = jnp.sum(auxes)
     else:
         for layer in params["layers"]:

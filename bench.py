@@ -180,7 +180,13 @@ def _make_config(S: int, preset: str | None):
         max_seq=S,
         remat=os.environ.get("BENCH_REMAT", "1") == "1",
         remat_policy=os.environ.get("BENCH_REMAT_POLICY", "full"),
+        remat_prevent_cse=(
+            {"0": False, "1": True}[os.environ["BENCH_PREVENT_CSE"]]
+            if "BENCH_PREVENT_CSE" in os.environ
+            else None  # auto: False under scan_layers
+        ),
         scan_layers=True,
+        scan_unroll=int(os.environ.get("BENCH_SCAN_UNROLL", "1")),
         attn_impl=os.environ.get(
             "BENCH_ATTN",
             "flash" if jax.default_backend() in ("tpu", "axon") else "xla",
@@ -304,7 +310,10 @@ def _metric_label(B: int, S: int, fuse: int, preset: str | None, cfg=None) -> st
 # (BENCH_B/S/FUSE/REMAT) change what is being measured — adopting a bigger batch would
 # report an MFU jump attributable to the workload, not the framework, and break
 # comparability with the tracked b4/seq2048 history.
-_TUNING_KNOBS = {"ACCEL_FLASH_BLOCK_Q", "ACCEL_FLASH_BLOCK_K", "BENCH_ATTN", "BENCH_REMAT_POLICY"}
+_TUNING_KNOBS = {
+    "ACCEL_FLASH_BLOCK_Q", "ACCEL_FLASH_BLOCK_K", "BENCH_ATTN", "BENCH_REMAT_POLICY",
+    "BENCH_SCAN_UNROLL", "BENCH_PREVENT_CSE", "XLA_FLAGS",
+}
 
 
 def _adopt_best_sweep_config() -> None:

@@ -35,6 +35,11 @@ CONFIGS = [
     ("b8_dots", {"BENCH_B": "8", "BENCH_REMAT_POLICY": "dots"}),
     ("noremat_b2", {"BENCH_REMAT": "0", "BENCH_B": "2"}),
     ("seq4096_b2", {"BENCH_S": "4096", "BENCH_B": "2"}),
+    ("unroll2", {"BENCH_SCAN_UNROLL": "2"}),
+    ("unroll4", {"BENCH_SCAN_UNROLL": "4"}),
+    ("prevent_cse", {"BENCH_PREVENT_CSE": "1"}),  # pre-change behavior, for comparison
+    ("vmem_128m", {"XLA_FLAGS": "--xla_tpu_scoped_vmem_limit_kib=131072"}),
+    ("dots_unroll2", {"BENCH_REMAT_POLICY": "dots", "BENCH_SCAN_UNROLL": "2"}),
 ]
 
 

@@ -192,6 +192,7 @@ class TestSpeculative:
         return (llama.init_params(target_cfg, jax.random.PRNGKey(0)), target_cfg,
                 llama.init_params(draft_cfg, jax.random.PRNGKey(1)), draft_cfg)
 
+    @slow
     def test_matches_plain_greedy(self):
         tp, tc, dp, dc = self._models()
         rng = np.random.default_rng(0)
@@ -205,6 +206,7 @@ class TestSpeculative:
             ))[0].tolist()
             assert got == want, (trial, got, want)
 
+    @slow
     def test_perfect_draft_accepts_everything(self):
         """Draft == target: every round accepts all k and emits k+1 tokens per target call."""
         tp, tc, _, _ = self._models()

@@ -36,6 +36,7 @@ def test_llama_logits_match_transformers():
     np.testing.assert_allclose(ours, hf_logits, atol=2e-4, rtol=1e-3)
 
 
+@slow
 def test_llama_generate_from_hf_weights():
     hf_cfg = transformers.LlamaConfig(
         vocab_size=64, hidden_size=32, intermediate_size=64, num_hidden_layers=2,

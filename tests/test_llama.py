@@ -223,6 +223,7 @@ def test_chunk_size_resolution():
     assert _loss_chunk_size(dataclasses.replace(CFG, loss_chunk=-1), 4096) == 0
 
 
+@slow
 def test_chunked_ce_nondivisible_seq_matches_full():
     """Odd S with an explicit chunk: the padded chunked path equals full logits exactly."""
     params = llama.init_params(CFG)

@@ -8,6 +8,7 @@ import jax.numpy as jnp
 import pytest
 
 from accelerate_tpu.models import llama
+from accelerate_tpu.test_utils.testing import slow
 from accelerate_tpu.ops import packing
 
 
@@ -57,6 +58,7 @@ def test_oversized_sequence_raises():
         packing.pack_sequences([np.arange(50, dtype=np.int32)], seq_len=32, use_native=False)
 
 
+@slow
 def test_packed_forward_isolates_segments():
     """Logits for a sequence inside a packed row == logits of that sequence alone."""
     cfg = dataclasses.replace(llama.CONFIGS["tiny"], dtype=jnp.float32)
@@ -89,6 +91,7 @@ def test_packed_forward_isolates_segments():
     )
 
 
+@slow
 def test_packed_loss_matches_unpacked_sum():
     """Packed CE == token-weighted CE over the individual sequences."""
     cfg = dataclasses.replace(llama.CONFIGS["tiny"], dtype=jnp.float32)
@@ -183,6 +186,7 @@ def test_gpt_packed_loss_matches_unpacked_sum():
         np.testing.assert_allclose(packed_loss, total / count, rtol=2e-5)
 
 
+@slow
 def test_t5_seq2seq_packed_loss_matches_unpacked_sum():
     """Packed seq2seq CE == token-weighted per-pair CE (enc/dec/cross all segment-masked)."""
     from accelerate_tpu.models import t5
