@@ -1,0 +1,52 @@
+"""Shared model-family machinery: activation-checkpointing (remat) policy resolution.
+
+One implementation of the remat knobs every family config exposes (``remat``,
+``remat_policy``, ``remat_prevent_cse``), so llama/gpt/t5 cannot drift: the reference
+gets the analogous single point from torch's ``checkpoint_wrapper`` applied in
+``accelerator.py:1594-1608``; here the policy maps onto ``jax.checkpoint`` policies.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+
+__all__ = ["remat_wrap"]
+
+
+def remat_wrap(
+    fn: Callable,
+    *,
+    remat: bool,
+    policy: str = "full",
+    prevent_cse: Optional[bool] = None,
+    scan_layers: bool = False,
+    static_argnums: Sequence[int] = (),
+) -> Callable:
+    """``fn`` under the config's activation-checkpointing policy (validated).
+
+    ``policy``: "full" recomputes everything (min memory); "dots" saves matmul outputs and
+    recomputes only elementwise ops; "offload" parks the saved dots in pinned host memory.
+    ``prevent_cse=None`` resolves automatically: False under ``scan_layers`` (the scan
+    boundary already isolates the block, and checkpoint's anti-CSE barriers only pessimize
+    XLA's scheduling inside it), True for an unrolled python-loop stack where CSE could
+    silently defeat rematerialization.
+    """
+    if not remat:
+        return fn
+    if policy == "full":
+        jax_policy = None
+    elif policy == "dots":
+        jax_policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
+    elif policy == "offload":
+        jax_policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
+            "device", "pinned_host"
+        )
+    else:
+        raise ValueError(f"remat_policy={policy!r}: expected 'full', 'dots' or 'offload'")
+    if prevent_cse is None:
+        prevent_cse = not scan_layers
+    return jax.checkpoint(
+        fn, static_argnums=tuple(static_argnums), policy=jax_policy, prevent_cse=prevent_cse
+    )

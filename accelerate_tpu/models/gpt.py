@@ -58,7 +58,10 @@ class GPTConfig:
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
+    remat_policy: str = "full"            # "full" | "dots" | "offload" (see models/common.py)
+    remat_prevent_cse: Optional[bool] = None  # None = auto (False under scan_layers)
     scan_layers: bool = False
+    scan_unroll: int = 1                  # lax.scan unroll for the layer stack
     tie_embeddings: bool = True   # gpt2 ties lm_head to wte
 
 
@@ -266,7 +269,12 @@ def forward(
         if segment_ids is not None
         else jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
     )
-    block = jax.checkpoint(_block, static_argnums=(4,)) if cfg.remat else _block
+    from .common import remat_wrap
+
+    block = remat_wrap(
+        _block, remat=cfg.remat, policy=cfg.remat_policy,
+        prevent_cse=cfg.remat_prevent_cse, scan_layers=cfg.scan_layers, static_argnums=(4,),
+    )
     if cfg.scan_layers:
         def body(carry, layer):
             out = block(carry, layer, positions, mask, cfg)
@@ -274,7 +282,7 @@ def forward(
                 out = _maybe_shard(out, P(BATCH_AXES, SEQUENCE_AXIS, None))
             return out, None
 
-        x, _ = jax.lax.scan(body, x, params["layers"])
+        x, _ = jax.lax.scan(body, x, params["layers"], unroll=cfg.scan_unroll)
     else:
         for layer in params["layers"]:
             x = block(x, layer, positions, mask, cfg)

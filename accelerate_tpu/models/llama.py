@@ -358,24 +358,13 @@ def _block(x, layer, positions, mask, cfg: LlamaConfig, segment_ids=None):
 
 def _maybe_remat_block(cfg: LlamaConfig):
     """The block fn under the config's activation-checkpointing policy (validated)."""
-    if not cfg.remat:
-        return _block
-    if cfg.remat_policy == "full":
-        policy = None
-    elif cfg.remat_policy == "dots":
-        policy = jax.checkpoint_policies.checkpoint_dots_with_no_batch_dims
-    elif cfg.remat_policy == "offload":
-        policy = jax.checkpoint_policies.offload_dot_with_no_batch_dims(
-            "device", "pinned_host"
-        )
-    else:
-        raise ValueError(
-            f"remat_policy={cfg.remat_policy!r}: expected 'full', 'dots' or 'offload'"
-        )
-    prevent_cse = (
-        cfg.remat_prevent_cse if cfg.remat_prevent_cse is not None else not cfg.scan_layers
+    from .common import remat_wrap
+
+    return remat_wrap(
+        _block, remat=cfg.remat, policy=cfg.remat_policy,
+        prevent_cse=cfg.remat_prevent_cse, scan_layers=cfg.scan_layers,
+        static_argnums=(4,),
     )
-    return jax.checkpoint(_block, static_argnums=(4,), policy=policy, prevent_cse=prevent_cse)
 
 
 def packed_target_mask(segment_ids: jax.Array) -> jax.Array:

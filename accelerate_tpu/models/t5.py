@@ -59,7 +59,9 @@ class T5Config:
     norm_eps: float = 1e-6
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = True
-    remat: bool = False
+    remat: bool = False                       # jax.checkpoint each enc/dec block
+    remat_policy: str = "full"                # "full" | "dots" | "offload" (models/common.py)
+    remat_prevent_cse: Optional[bool] = None  # None = auto (True: python-loop stack)
     decoder_start_token_id: int = 0
 
     @property
@@ -289,8 +291,14 @@ def encode(params: dict, input_ids: jax.Array, cfg: T5Config,
             mask = mask & attention_mask[:, None, None, :].astype(bool)
     elif attention_mask is not None:
         mask = attention_mask[:, None, None, :].astype(bool)
+    from .common import remat_wrap
+
+    enc_block = remat_wrap(
+        _enc_block, remat=cfg.remat, policy=cfg.remat_policy,
+        prevent_cse=cfg.remat_prevent_cse, static_argnums=(4,),
+    )
     for blk in params["encoder"]["blocks"]:
-        x = _enc_block(x, blk, bias, mask, cfg)
+        x = enc_block(x, blk, bias, mask, cfg)
     return _t5_norm(x, params["encoder"]["ln_f"], cfg.norm_eps)
 
 
@@ -323,8 +331,14 @@ def decode(params: dict, decoder_input_ids: jax.Array, enc_out: jax.Array, cfg: 
             cmask = cmask & enc_mask[:, None, None, :].astype(bool)
     elif enc_mask is not None:
         cmask = enc_mask[:, None, None, :].astype(bool)
+    from .common import remat_wrap
+
+    dec_block = remat_wrap(
+        _dec_block, remat=cfg.remat, policy=cfg.remat_policy,
+        prevent_cse=cfg.remat_prevent_cse, static_argnums=(6,),
+    )
     for blk in params["decoder"]["blocks"]:
-        x = _dec_block(x, blk, enc_out, bias, causal, cmask, cfg)
+        x = dec_block(x, blk, enc_out, bias, causal, cmask, cfg)
     x = _t5_norm(x, params["decoder"]["ln_f"], cfg.norm_eps)
     if cfg.tie_embeddings:
         x = x * (cfg.d_model**-0.5)

@@ -40,6 +40,8 @@ CONFIGS = [
     ("prevent_cse", {"BENCH_PREVENT_CSE": "1"}),  # pre-change behavior, for comparison
     ("vmem_128m", {"XLA_FLAGS": "--xla_tpu_scoped_vmem_limit_kib=131072"}),
     ("dots_unroll2", {"BENCH_REMAT_POLICY": "dots", "BENCH_SCAN_UNROLL": "2"}),
+    ("combo_b8_dots_unroll2", {"BENCH_B": "8", "BENCH_REMAT_POLICY": "dots",
+                               "BENCH_SCAN_UNROLL": "2"}),
 ]
 
 

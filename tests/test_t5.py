@@ -70,6 +70,21 @@ def test_ignored_labels_do_not_contribute():
     assert not np.isclose(l1, l2)
 
 
+def test_remat_matches_no_remat():
+    """cfg.remat (now consumed via models/common.remat_wrap) must be numerically inert:
+    identical loss with and without activation checkpointing, and grads must flow."""
+    params = t5.init_params(CFG)
+    batch = make_batch(n=2)
+    loss_plain = t5.loss_fn(params, batch, CFG)
+    cfg_r = dataclasses.replace(CFG, remat=True)
+    loss_remat, grads = jax.value_and_grad(lambda p: t5.loss_fn(p, batch, cfg_r))(params)
+    np.testing.assert_allclose(
+        float(loss_plain), float(loss_remat), rtol=1e-6
+    )
+    gnorm = sum(float(jnp.sum(jnp.abs(g))) for g in jax.tree_util.tree_leaves(grads))
+    assert np.isfinite(gnorm) and gnorm > 0
+
+
 def test_num_params_analytic():
     counted = sum(int(np.prod(np.shape(l))) for l in jax.tree_util.tree_leaves(t5.init_params(CFG)))
     assert t5.num_params(CFG) == counted
