@@ -276,16 +276,18 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
 
 
 def _attention_xla(q, k, v, mask, cfg: LlamaConfig):
-    """Reference attention path: q [B,S,H,hd], kv [B,S,K,hd] → [B,S,H,hd]."""
+    """Reference attention path: q [B,S,H,hd], kv [B,S,K,hd] → [B,S,H,hd].
+
+    GQA stays grouped: q reshapes to [B,S,K,G,hd] and both einsums contract against the
+    UNREPEATED kv — the repeated K/V tensors never materialize."""
     B, S, H, hd = q.shape
     K = k.shape[2]
-    if H != K:
-        k = jnp.repeat(k, cfg.q_per_kv, axis=2)
-        v = jnp.repeat(v, cfg.q_per_kv, axis=2)
-    scores = jnp.einsum("bshd,bthd->bhst", q, k) / math.sqrt(hd)
-    scores = jnp.where(mask[:, None, :, :], scores, jnp.finfo(scores.dtype).min)
+    G = H // K
+    qg = q.reshape(B, S, K, G, hd)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, k) / math.sqrt(hd)
+    scores = jnp.where(mask[:, None, None, :, :], scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhst,bthd->bshd", probs, v)
+    return jnp.einsum("bkgst,btkd->bskgd", probs, v).reshape(B, S, H, hd)
 
 
 def _attention(q, k, v, mask, cfg: LlamaConfig, segment_ids=None):
@@ -744,15 +746,17 @@ def _attention_cached(q, ck, cv, q_positions, valid, cfg: LlamaConfig):
     """
     B, T, H, hd = q.shape
     C = ck.shape[1]
-    if H != ck.shape[2]:
-        ck = jnp.repeat(ck, cfg.q_per_kv, axis=2)
-        cv = jnp.repeat(cv, cfg.q_per_kv, axis=2)
-    scores = jnp.einsum("bthd,bchd->bhtc", q, ck) / math.sqrt(hd)
+    K = ck.shape[2]
+    G = H // K
+    # Grouped-query decode: contract against the UNREPEATED cache. Decode (T=1) is an
+    # HBM-bandwidth gather over the cache, so never repeating it reads H/K× fewer bytes.
+    qg = q.reshape(B, T, K, G, hd)
+    scores = jnp.einsum("btkgd,bckd->bkgtc", qg, ck) / math.sqrt(hd)
     causal = jnp.arange(C)[None, None, :] <= q_positions[:, :, None]  # [B,T,C]
-    mask = (causal & valid[:, None, :])[:, None, :, :]  # [B,1,T,C]
+    mask = (causal & valid[:, None, :])[:, None, None, :, :]  # [B,1,1,T,C]
     scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
-    return jnp.einsum("bhtc,bchd->bthd", probs, cv)
+    return jnp.einsum("bkgtc,bckd->btkgd", probs, cv).reshape(B, T, H, hd)
 
 
 def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig):
