@@ -90,6 +90,11 @@ class LlamaConfig:
     # many tokens under remat instead of materializing fp32 [B,S,V] logits. 0 = auto
     # (chunk only when S*V is large enough to matter), -1 = never chunk.
     loss_chunk: int = 0
+    # int8 KV cache (inference): store cached k/v as int8 with a per-(token, kv-head)
+    # scale — half the cache bytes of bf16, so decode (an HBM gather over the cache)
+    # reads half the bytes and a serving engine fits 2× the slots. Dequantization fuses
+    # into the attention einsums; no repeated or fp16 copy ever materializes.
+    kv_quant: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -711,7 +716,10 @@ def forward_streamed(
 
 
 # ----------------------------------------------------------------------- cached generation
-def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int, dtype=None) -> dict:
+def init_cache(
+    cfg: LlamaConfig, batch_size: int, max_len: int, dtype=None,
+    quantized: Optional[bool] = None,
+) -> dict:
     """Allocate an empty KV cache for ``batch_size`` sequences of up to ``max_len`` tokens.
 
     Layout: ``{"layers": [{"k": [B,C,K,hd], "v": ...}, ...], "valid": [B,C] bool,
@@ -720,10 +728,23 @@ def init_cache(cfg: LlamaConfig, batch_size: int, max_len: int, dtype=None) -> d
     leading layer dim, matching the stacked param layout.  The reference's decode baselines
     come from transformers' cache via hook dispatch (``benchmarks/big_model_inference``);
     here the cache is an explicit pytree so the whole decode loop jits.
+
+    ``quantized`` (default ``cfg.kv_quant``): int8 k/v plus per-(token, kv-head) fp32
+    scales — half the cache HBM of bf16. ``_block_cached`` quantizes on write and fuses
+    dequantization into the attention reads.
     """
+    quantized = cfg.kv_quant if quantized is None else quantized
     dtype = dtype or cfg.dtype
     kv_shape = (batch_size, max_len, cfg.n_kv_heads, cfg.head_dim)
-    one = lambda: {"k": jnp.zeros(kv_shape, dtype), "v": jnp.zeros(kv_shape, dtype)}  # noqa: E731
+    scale_shape = (batch_size, max_len, cfg.n_kv_heads, 1)
+    if quantized:
+        one = lambda: {  # noqa: E731
+            "k": jnp.zeros(kv_shape, jnp.int8), "v": jnp.zeros(kv_shape, jnp.int8),
+            "k_scale": jnp.zeros(scale_shape, jnp.float32),
+            "v_scale": jnp.zeros(scale_shape, jnp.float32),
+        }
+    else:
+        one = lambda: {"k": jnp.zeros(kv_shape, dtype), "v": jnp.zeros(kv_shape, dtype)}  # noqa: E731
     if cfg.scan_layers:
         layers = jax.tree_util.tree_map(
             lambda x: jnp.broadcast_to(x, (cfg.n_layers, *x.shape)), one()
@@ -759,6 +780,43 @@ def _attention_cached(q, ck, cv, q_positions, valid, cfg: LlamaConfig):
     return jnp.einsum("bkgtc,bckd->btkgd", probs, cv).reshape(B, T, H, hd)
 
 
+def _quant_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int8 quantization per (batch, token, kv-head): x [B,T,K,hd] →
+    (int8 values, fp32 scales [B,T,K,1]). Scale floor keeps all-zero rows exact."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _write_cache(kv: dict, name: str, val: jax.Array, index) -> dict:
+    """Write ``val`` [B,T,...] into cache plane ``name`` at ``index`` (scalar slot for all
+    rows, or per-row vector with T == 1), quantizing when the cache is int8."""
+    out = {}
+    if f"{name}_scale" in kv:
+        q, scale = _quant_kv(val)
+        planes = ((name, q), (f"{name}_scale", scale))
+    else:
+        planes = ((name, val.astype(kv[name].dtype)),)
+    for key, plane in planes:
+        if jnp.ndim(index) == 0:
+            out[key] = jax.lax.dynamic_update_slice(
+                kv[key], plane.astype(kv[key].dtype), (0, index, 0, 0)
+            )
+        else:
+            rows = jnp.arange(plane.shape[0])
+            out[key] = kv[key].at[rows, index].set(plane[:, 0].astype(kv[key].dtype))
+    return out
+
+
+def _read_cache(new_kv: dict, name: str, dtype) -> jax.Array:
+    """Cache plane as compute dtype; int8 planes dequantize (the convert+scale fuses into
+    the attention einsum, so the full-precision cache never materializes in HBM)."""
+    if f"{name}_scale" in new_kv:
+        return new_kv[name].astype(dtype) * new_kv[f"{name}_scale"].astype(dtype)
+    return new_kv[name]
+
+
 def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig):
     """One block with KV-cache read/write → (x, new_kv).
 
@@ -773,14 +831,11 @@ def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig):
     v = _proj(h, layer["wv"], cfg).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
-    if jnp.ndim(index) == 0:
-        new_k = jax.lax.dynamic_update_slice(kv["k"], k.astype(kv["k"].dtype), (0, index, 0, 0))
-        new_v = jax.lax.dynamic_update_slice(kv["v"], v.astype(kv["v"].dtype), (0, index, 0, 0))
-    else:
-        rows = jnp.arange(B)
-        new_k = kv["k"].at[rows, index].set(k[:, 0].astype(kv["k"].dtype))
-        new_v = kv["v"].at[rows, index].set(v[:, 0].astype(kv["v"].dtype))
-    attn = _attention_cached(q, new_k, new_v, positions, valid, cfg)
+    new_kv = {**_write_cache(kv, "k", k, index), **_write_cache(kv, "v", v, index)}
+    attn = _attention_cached(
+        q, _read_cache(new_kv, "k", cfg.dtype), _read_cache(new_kv, "v", cfg.dtype),
+        positions, valid, cfg,
+    )
     x = x + _proj(attn.reshape(B, T, cfg.n_heads * cfg.head_dim), layer["wo"], cfg)
     h = _rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
     if cfg.moe_experts > 0:
@@ -801,11 +856,11 @@ def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig):
                 top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
                 compute_dtype=cfg.dtype,
             )
-        return x + y, {"k": new_k, "v": new_v}
+        return x + y, new_kv
     gate = jax.nn.silu(_proj(h, layer["w_gate"], cfg))
     up = _proj(h, layer["w_up"], cfg)
     x = x + _proj(gate * up, layer["w_down"], cfg)
-    return x, {"k": new_k, "v": new_v}
+    return x, new_kv
 
 
 def _cache_advance(cache: dict, tokens: jax.Array, token_mask: Optional[jax.Array]):

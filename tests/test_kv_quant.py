@@ -1,0 +1,96 @@
+"""int8 KV cache: quantization error bounds, cached-forward parity, generate/serving paths.
+
+The reference has no KV-cache quantization anywhere; this is a TPU-native addition (half
+the decode HBM bytes). Correctness bar: int8 per-(token, head) symmetric quantization has
+worst-case per-element error scale/2 = max|x|/254, so cached logits stay close to the
+full-precision cache's — asserted with bounds derived from that, not vibes.
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from accelerate_tpu.models import llama
+from accelerate_tpu.models.llama import _quant_kv
+
+CFG = dataclasses.replace(llama.CONFIGS["tiny"], dtype=jnp.float32)
+QCFG = dataclasses.replace(CFG, kv_quant=True)
+
+
+def test_quant_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 16, 4, 32)) * 3.0, jnp.float32)
+    q, scale = _quant_kv(x)
+    assert q.dtype == jnp.int8 and scale.shape == (2, 16, 4, 1)
+    err = np.abs(np.asarray(q.astype(jnp.float32) * scale - x))
+    bound = np.asarray(scale) / 2 + 1e-7
+    assert (err <= bound).all()
+
+
+def test_zero_rows_exact():
+    q, scale = _quant_kv(jnp.zeros((1, 4, 2, 8)))
+    np.testing.assert_array_equal(np.asarray(q), 0)
+
+
+def test_cached_forward_close_to_unquantized():
+    """Prefill + 3 decode steps: int8-cache logits stay close to the fp32-cache logits."""
+    params = llama.init_params(CFG)
+    rng = np.random.default_rng(1)
+    prompt = jnp.asarray(rng.integers(0, CFG.vocab_size, size=(2, 12)), jnp.int32)
+
+    def run(cfg):
+        cache = llama.init_cache(cfg, 2, 32)
+        logits, cache = llama.forward_cached(params, prompt, cache, cfg)
+        outs = [logits[:, -1]]
+        tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        for _ in range(3):
+            logits, cache = llama.forward_cached(params, tok[:, None], cache, cfg)
+            outs.append(logits[:, -1])
+            tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return [np.asarray(o) for o in outs]
+
+    full = run(CFG)
+    quant = run(QCFG)
+    for f, q in zip(full, quant):
+        # int8 kv error is ~0.4% of |kv| per element; logits on the tiny config are O(1).
+        np.testing.assert_allclose(q, f, atol=0.05)
+
+
+def test_generate_with_quantized_cache():
+    params = llama.init_params(QCFG)
+    prompt = jnp.asarray([[3, 5, 7, 9]], jnp.int32)
+    from accelerate_tpu.generation import GenerationConfig
+
+    out = llama.generate(params, prompt, QCFG, GenerationConfig(max_new_tokens=6))
+    assert out.shape == (1, 6)
+    assert ((np.asarray(out) >= 0) & (np.asarray(out) < QCFG.vocab_size)).all()
+
+
+def test_serving_engine_with_quantized_cache():
+    """The continuous batcher inherits int8 caching through cfg.kv_quant (vector-index
+    writes take the per-row .at path)."""
+    from accelerate_tpu.serving import ContinuousBatcher
+
+    params = llama.init_params(QCFG)
+    eng = ContinuousBatcher(params, QCFG, max_slots=2, max_len=64, prompt_bucket=8)
+    req = eng.submit([3, 5, 7], max_new_tokens=4)
+    eng.run()
+    assert req.done and len(req.tokens) == 4
+    assert all(0 <= t < QCFG.vocab_size for t in req.tokens)
+
+
+def test_cache_bytes_halved():
+    full = llama.init_cache(dataclasses.replace(CFG, dtype=jnp.bfloat16), 2, 64)
+    quant = llama.init_cache(QCFG, 2, 64)
+
+    def kv_bytes(c):
+        return sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree_util.tree_leaves(c["layers"])
+        )
+
+    # int8 halves the kv planes; the per-(token, head) fp32 scales add hd/4 : hd overhead.
+    assert kv_bytes(quant) < kv_bytes(full) * 0.6
