@@ -1,9 +1,15 @@
-"""Shared model-family machinery: activation-checkpointing (remat) policy resolution.
+"""Shared model-family machinery: remat policy resolution + KV-cache plane helpers.
 
 One implementation of the remat knobs every family config exposes (``remat``,
 ``remat_policy``, ``remat_prevent_cse``), so llama/gpt/t5 cannot drift: the reference
 gets the analogous single point from torch's ``checkpoint_wrapper`` applied in
 ``accelerator.py:1594-1608``; here the policy maps onto ``jax.checkpoint`` policies.
+
+The KV helpers implement the optional int8 cache shared by the decoder families: caches
+are plane dicts (``k``/``v`` [B,C,heads,hd], plus ``k_scale``/``v_scale`` [B,C,heads,1]
+when quantized); ``write_kv`` quantizes at the write slot, ``read_kv`` dequantizes into
+the attention einsum (XLA fuses the convert+scale, so a full-precision copy never
+materializes in HBM).
 """
 
 from __future__ import annotations
@@ -11,8 +17,9 @@ from __future__ import annotations
 from typing import Callable, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 
-__all__ = ["remat_wrap"]
+__all__ = ["remat_wrap", "kv_planes", "write_kv", "read_kv", "quant_kv"]
 
 
 def remat_wrap(
@@ -50,3 +57,54 @@ def remat_wrap(
     return jax.checkpoint(
         fn, static_argnums=tuple(static_argnums), policy=jax_policy, prevent_cse=prevent_cse
     )
+
+
+# ------------------------------------------------------------------------ KV cache planes
+def kv_planes(batch: int, max_len: int, heads: int, head_dim: int, dtype, quantized: bool):
+    """One layer's empty cache planes: {k, v} (+ {k_scale, v_scale} when int8)."""
+    shape = (batch, max_len, heads, head_dim)
+    if quantized:
+        scale = (batch, max_len, heads, 1)
+        return {
+            "k": jnp.zeros(shape, jnp.int8), "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(scale, jnp.float32),
+            "v_scale": jnp.zeros(scale, jnp.float32),
+        }
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def quant_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Symmetric int8 quantization per (batch, token, head): x [B,T,K,hd] →
+    (int8 values, fp32 scales [B,T,K,1]). Scale floor keeps all-zero rows exact."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def write_kv(kv: dict, name: str, val: jax.Array, index) -> dict:
+    """Write ``val`` [B,T,...] into cache plane ``name`` at ``index`` (scalar slot for all
+    rows, or per-row vector with T == 1), quantizing when the cache is int8."""
+    out = {}
+    if f"{name}_scale" in kv:
+        q, scale = quant_kv(val)
+        planes = ((name, q), (f"{name}_scale", scale))
+    else:
+        planes = ((name, val.astype(kv[name].dtype)),)
+    for key, plane in planes:
+        if jnp.ndim(index) == 0:
+            out[key] = jax.lax.dynamic_update_slice(
+                kv[key], plane.astype(kv[key].dtype), (0, index, 0, 0)
+            )
+        else:
+            rows = jnp.arange(plane.shape[0])
+            out[key] = kv[key].at[rows, index].set(plane[:, 0].astype(kv[key].dtype))
+    return out
+
+
+def read_kv(new_kv: dict, name: str, dtype) -> jax.Array:
+    """Cache plane as compute dtype; int8 planes dequantize (the convert+scale fuses into
+    the attention einsum, so the full-precision cache never materializes in HBM)."""
+    if f"{name}_scale" in new_kv:
+        return new_kv[name].astype(dtype) * new_kv[f"{name}_scale"].astype(dtype)
+    return new_kv[name]

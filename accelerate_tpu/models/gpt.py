@@ -63,6 +63,7 @@ class GPTConfig:
     scan_layers: bool = False
     scan_unroll: int = 1                  # lax.scan unroll for the layer stack
     tie_embeddings: bool = True   # gpt2 ties lm_head to wte
+    kv_quant: bool = False        # int8 KV cache (see models/common.py kv helpers)
 
 
 CONFIGS = {
@@ -318,11 +319,16 @@ def loss_fn(params: dict, batch: dict, cfg: GPTConfig, rng=None) -> jax.Array:
 
 
 # ----------------------------------------------------------------------- cached generation
-def init_cache(cfg: GPTConfig, batch_size: int, max_len: int, dtype=None) -> dict:
+def init_cache(
+    cfg: GPTConfig, batch_size: int, max_len: int, dtype=None,
+    quantized: Optional[bool] = None,
+) -> dict:
+    from .common import kv_planes
+
+    quantized = cfg.kv_quant if quantized is None else quantized
     dtype = dtype or cfg.dtype
     hd = cfg.d_model // cfg.n_heads
-    shape = (batch_size, max_len, cfg.n_heads, hd)
-    one = lambda: {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}  # noqa: E731
+    one = lambda: kv_planes(batch_size, max_len, cfg.n_heads, hd, dtype, quantized)  # noqa: E731
     layers = (
         jax.tree_util.tree_map(lambda x: jnp.broadcast_to(x, (cfg.n_layers, *x.shape)), one())
         if cfg.scan_layers
@@ -336,11 +342,14 @@ def init_cache(cfg: GPTConfig, batch_size: int, max_len: int, dtype=None) -> dic
 
 
 def _block_cached(x, layer, kv, index, positions, valid, cfg: GPTConfig):
+    from .common import read_kv, write_kv
+
     B, T, D = x.shape
     h = _layer_norm(x, layer["ln_attn"], cfg.norm_eps)
     q, k, v = _qkv(h, layer, positions, cfg)
-    new_k = jax.lax.dynamic_update_slice(kv["k"], k.astype(kv["k"].dtype), (0, index, 0, 0))
-    new_v = jax.lax.dynamic_update_slice(kv["v"], v.astype(kv["v"].dtype), (0, index, 0, 0))
+    new_kv = {**write_kv(kv, "k", k, index), **write_kv(kv, "v", v, index)}
+    new_k = read_kv(new_kv, "k", cfg.dtype)
+    new_v = read_kv(new_kv, "v", cfg.dtype)
     C = new_k.shape[1]
     hd = q.shape[-1]
     scores = jnp.einsum("bthd,bchd->bhtc", q, new_k) / math.sqrt(hd)
@@ -357,7 +366,7 @@ def _block_cached(x, layer, kv, index, positions, valid, cfg: GPTConfig):
         x = x + attn
         h2 = _layer_norm(x, layer["ln_mlp"], cfg.norm_eps)
         out = x + _mlp(h2, layer, x.dtype)
-    return out, {"k": new_k, "v": new_v}
+    return out, new_kv
 
 
 def forward_cached(

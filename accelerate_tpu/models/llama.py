@@ -28,6 +28,10 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..utils.constants import BATCH_AXES, SEQUENCE_AXIS, TENSOR_AXIS
+from .common import kv_planes as _kv_planes
+from .common import quant_kv as _quant_kv
+from .common import read_kv as _read_cache
+from .common import write_kv as _write_cache
 
 __all__ = [
     "LlamaConfig",
@@ -735,16 +739,9 @@ def init_cache(
     """
     quantized = cfg.kv_quant if quantized is None else quantized
     dtype = dtype or cfg.dtype
-    kv_shape = (batch_size, max_len, cfg.n_kv_heads, cfg.head_dim)
-    scale_shape = (batch_size, max_len, cfg.n_kv_heads, 1)
-    if quantized:
-        one = lambda: {  # noqa: E731
-            "k": jnp.zeros(kv_shape, jnp.int8), "v": jnp.zeros(kv_shape, jnp.int8),
-            "k_scale": jnp.zeros(scale_shape, jnp.float32),
-            "v_scale": jnp.zeros(scale_shape, jnp.float32),
-        }
-    else:
-        one = lambda: {"k": jnp.zeros(kv_shape, dtype), "v": jnp.zeros(kv_shape, dtype)}  # noqa: E731
+    one = lambda: _kv_planes(  # noqa: E731
+        batch_size, max_len, cfg.n_kv_heads, cfg.head_dim, dtype, quantized
+    )
     if cfg.scan_layers:
         layers = jax.tree_util.tree_map(
             lambda x: jnp.broadcast_to(x, (cfg.n_layers, *x.shape)), one()
@@ -778,43 +775,6 @@ def _attention_cached(q, ck, cv, q_positions, valid, cfg: LlamaConfig):
     scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bkgtc,bckd->btkgd", probs, cv).reshape(B, T, H, hd)
-
-
-def _quant_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
-    """Symmetric int8 quantization per (batch, token, kv-head): x [B,T,K,hd] →
-    (int8 values, fp32 scales [B,T,K,1]). Scale floor keeps all-zero rows exact."""
-    xf = x.astype(jnp.float32)
-    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1, keepdims=True) / 127.0, 1e-8)
-    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
-    return q, scale
-
-
-def _write_cache(kv: dict, name: str, val: jax.Array, index) -> dict:
-    """Write ``val`` [B,T,...] into cache plane ``name`` at ``index`` (scalar slot for all
-    rows, or per-row vector with T == 1), quantizing when the cache is int8."""
-    out = {}
-    if f"{name}_scale" in kv:
-        q, scale = _quant_kv(val)
-        planes = ((name, q), (f"{name}_scale", scale))
-    else:
-        planes = ((name, val.astype(kv[name].dtype)),)
-    for key, plane in planes:
-        if jnp.ndim(index) == 0:
-            out[key] = jax.lax.dynamic_update_slice(
-                kv[key], plane.astype(kv[key].dtype), (0, index, 0, 0)
-            )
-        else:
-            rows = jnp.arange(plane.shape[0])
-            out[key] = kv[key].at[rows, index].set(plane[:, 0].astype(kv[key].dtype))
-    return out
-
-
-def _read_cache(new_kv: dict, name: str, dtype) -> jax.Array:
-    """Cache plane as compute dtype; int8 planes dequantize (the convert+scale fuses into
-    the attention einsum, so the full-precision cache never materializes in HBM)."""
-    if f"{name}_scale" in new_kv:
-        return new_kv[name].astype(dtype) * new_kv[f"{name}_scale"].astype(dtype)
-    return new_kv[name]
 
 
 def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig):

@@ -82,6 +82,27 @@ def test_serving_engine_with_quantized_cache():
     assert all(0 <= t < QCFG.vocab_size for t in req.tokens)
 
 
+def test_gpt_cached_forward_close_to_unquantized():
+    """The GPT family shares the int8 planes through models/common.write_kv/read_kv."""
+    from accelerate_tpu.models import gpt
+
+    gcfg = dataclasses.replace(gpt.CONFIGS["tiny"], dtype=jnp.float32)
+    gqcfg = dataclasses.replace(gcfg, kv_quant=True)
+    params = gpt.init_params(gcfg)
+    rng = np.random.default_rng(2)
+    prompt = jnp.asarray(rng.integers(0, gcfg.vocab_size, size=(2, 10)), jnp.int32)
+
+    def run(cfg):
+        cache = gpt.init_cache(cfg, 2, 32)
+        logits, cache = gpt.forward_cached(params, prompt, cache, cfg)
+        tok = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        logits2, _ = gpt.forward_cached(params, tok[:, None], cache, cfg)
+        return np.asarray(logits[:, -1]), np.asarray(logits2[:, -1])
+
+    for f, q in zip(run(gcfg), run(gqcfg)):
+        np.testing.assert_allclose(q, f, atol=0.05)
+
+
 def test_cache_bytes_halved():
     full = llama.init_cache(dataclasses.replace(CFG, dtype=jnp.bfloat16), 2, 64)
     quant = llama.init_cache(QCFG, 2, 64)
