@@ -99,6 +99,11 @@ class LlamaConfig:
     # reads half the bytes and a serving engine fits 2× the slots. Dequantization fuses
     # into the attention einsums; no repeated or fp16 copy ever materializes.
     kv_quant: bool = False
+    # Sliding-window attention (Mistral-style): position i attends only (i-window, i].
+    # 0 = full causal. The flash kernels SKIP kv tiles outside the band, so long-context
+    # compute scales with S·window instead of S². Not composable with the sp attention
+    # modes (ring/ulysses/allgather) — those raise.
+    sliding_window: int = 0
 
     @property
     def head_dim(self) -> int:
@@ -127,6 +132,10 @@ CONFIGS = {
     "debug": LlamaConfig(
         vocab_size=512, d_model=256, n_layers=4, n_heads=8, n_kv_heads=4, d_ff=512,
         max_seq=512, remat=False,
+    ),
+    "mistral-7b": LlamaConfig(
+        vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8, d_ff=14336,
+        rope_theta=10000.0, max_seq=32768, sliding_window=4096,
     ),
     "mixtral-8x7b": LlamaConfig(
         vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8, d_ff=14336,
@@ -301,6 +310,10 @@ def _attention_xla(q, k, v, mask, cfg: LlamaConfig):
 
 def _attention(q, k, v, mask, cfg: LlamaConfig, segment_ids=None):
     impl = cfg.attn_impl
+    if cfg.sliding_window and impl in ("ring", "ulysses", "allgather"):
+        raise NotImplementedError(
+            "sliding_window is not composable with the sequence-parallel attention modes"
+        )
     if impl in ("ring", "ulysses", "allgather"):
         # Sequence-parallel attention over the sp mesh axis (requires an active mesh
         # context with sp > 1; falls back to local attention otherwise).
@@ -318,7 +331,9 @@ def _attention(q, k, v, mask, cfg: LlamaConfig, segment_ids=None):
             from ..ops.flash_attention import flash_attention
 
             # Packed rows stay on the flash path: the kernels take segment ids directly.
-            return flash_attention(q, k, v, causal=True, segment_ids=segment_ids)
+            return flash_attention(
+                q, k, v, causal=True, segment_ids=segment_ids, window=cfg.sliding_window
+            )
         except Exception:  # pragma: no cover - kernel unavailable on this backend
             pass
     return _attention_xla(q, k, v, mask, cfg)
@@ -449,6 +464,11 @@ def forward_hidden(
             cfg = dataclasses.replace(cfg, attn_impl="auto")
     else:
         mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
+    if cfg.sliding_window:
+        # Band-limit the XLA-path mask to (i-window, i]; the flash kernels apply the same
+        # band in-kernel (and skip out-of-band tiles entirely).
+        idx = jnp.arange(S, dtype=jnp.int32)
+        mask = mask & (idx[None, :] > idx[:, None] - cfg.sliding_window)[None]
 
     block = _maybe_remat_block(cfg)
 
@@ -770,7 +790,10 @@ def _attention_cached(q, ck, cv, q_positions, valid, cfg: LlamaConfig):
     # HBM-bandwidth gather over the cache, so never repeating it reads H/K× fewer bytes.
     qg = q.reshape(B, T, K, G, hd)
     scores = jnp.einsum("btkgd,bckd->bkgtc", qg, ck) / math.sqrt(hd)
-    causal = jnp.arange(C)[None, None, :] <= q_positions[:, :, None]  # [B,T,C]
+    slots = jnp.arange(C)[None, None, :]
+    causal = slots <= q_positions[:, :, None]  # [B,T,C]
+    if cfg.sliding_window:
+        causal = causal & (slots > q_positions[:, :, None] - cfg.sliding_window)
     mask = (causal & valid[:, None, :])[:, None, None, :, :]  # [B,1,1,T,C]
     scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)

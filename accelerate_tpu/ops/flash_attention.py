@@ -74,7 +74,7 @@ def _smem_scalar_spec():
 # ------------------------------------------------------------------------------ forward
 def _fwd_kernel(
     q_off_ref, kv_off_ref, *refs,
-    sm_scale, causal, block_q, block_k, kv_len, has_segments,
+    sm_scale, causal, block_q, block_k, kv_len, has_segments, window,
 ):
     if has_segments:
         (q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref,
@@ -100,6 +100,13 @@ def _fwd_kernel(
         jnp.asarray(not causal),
         kv_off + k_start <= q_off + q_start + block_q - 1,
     )
+    if window:
+        # Sliding window: also skip kv blocks entirely BELOW the band (col <= row - window
+        # for every pair in the block) — long-context Mistral-style attention never touches
+        # those tiles at all.
+        needed = jnp.logical_and(
+            needed, kv_off + k_start + block_k - 1 > q_off + q_start - window
+        )
 
     @pl.when(needed)
     def _compute():
@@ -115,9 +122,12 @@ def _fwd_kernel(
 
         col_local = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         mask = col_local < kv_len
-        if causal:
+        if causal or window:
             row = q_off + q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            mask = jnp.logical_and(mask, kv_off + col_local <= row)
+            if causal:
+                mask = jnp.logical_and(mask, kv_off + col_local <= row)
+            if window:
+                mask = jnp.logical_and(mask, kv_off + col_local > row - window)
         if has_segments:
             # Packed rows: attend only within the same segment; segment 0 is padding.
             sq = q_seg_ref[0][:, None]
@@ -158,7 +168,7 @@ def _seg_blocks(segments, Sp, Tp):
 
 
 def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, q_offset=0, kv_offset=0,
-         segments=None):
+         segments=None, window=0):
     """Raw forward: q [B,H,S,hd], k/v [B,K,T,hd] (K divides H — GQA resolved IN the BlockSpec
     index maps, never via a materialized head repeat) → (o [B,H,S,hd], lse [B,H,S] fp32).
     Differentiation-free."""
@@ -177,7 +187,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, q_offset=0, kv_
     kernel = functools.partial(
         _fwd_kernel,
         sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k, kv_len=T,
-        has_segments=has_segments,
+        has_segments=has_segments, window=window,
     )
     seg_specs, seg_args = [], []
     if has_segments:
@@ -219,7 +229,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, q_offset=0, kv_
 # ------------------------------------------------------------------------------ backward
 def _bwd_dq_kernel(
     q_off_ref, kv_off_ref, *refs,
-    sm_scale, causal, block_q, block_k, kv_len, has_segments,
+    sm_scale, causal, block_q, block_k, kv_len, has_segments, window,
 ):
     if has_segments:
         (q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -242,6 +252,10 @@ def _bwd_dq_kernel(
         jnp.asarray(not causal),
         kv_off + k_start <= q_off + q_start + block_q - 1,
     )
+    if window:
+        needed = jnp.logical_and(
+            needed, kv_off + k_start + block_k - 1 > q_off + q_start - window
+        )
 
     @pl.when(needed)
     def _compute():
@@ -256,9 +270,12 @@ def _bwd_dq_kernel(
         ) * sm_scale
         col_local = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         mask = col_local < kv_len
-        if causal:
+        if causal or window:
             row = q_off + q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            mask = jnp.logical_and(mask, kv_off + col_local <= row)
+            if causal:
+                mask = jnp.logical_and(mask, kv_off + col_local <= row)
+            if window:
+                mask = jnp.logical_and(mask, kv_off + col_local > row - window)
         if has_segments:
             sq = q_seg_ref[0][:, None]
             sk = kv_seg_ref[0][None, :]
@@ -279,7 +296,7 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_off_ref, kv_off_ref, *refs,
-    sm_scale, causal, block_q, block_k, kv_len, q_len, nq, has_segments,
+    sm_scale, causal, block_q, block_k, kv_len, q_len, nq, has_segments, window,
 ):
     if has_segments:
         (q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -307,6 +324,10 @@ def _bwd_dkv_kernel(
         jnp.asarray(not causal),
         q_off + q_start + block_q - 1 >= kv_off + k_start,
     )
+    if window:
+        needed = jnp.logical_and(
+            needed, kv_off + k_start + block_k - 1 > q_off + q_start - window
+        )
 
     @pl.when(needed)
     def _compute():
@@ -324,6 +345,8 @@ def _bwd_dkv_kernel(
         mask = jnp.logical_and(col_local < kv_len, row_local < q_len)
         if causal:
             mask = jnp.logical_and(mask, kv_off + col_local <= q_off + row_local)
+        if window:
+            mask = jnp.logical_and(mask, kv_off + col_local > q_off + row_local - window)
         if has_segments:
             sq = q_seg_ref[0][:, None]
             sk = kv_seg_ref[0][None, :]
@@ -348,7 +371,7 @@ def _bwd_dkv_kernel(
 
 
 def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
-            q_offset=0, kv_offset=0, segments=None):
+            q_offset=0, kv_offset=0, segments=None, window=0):
     """dq for local q against one kv block (ring building block). GQA (K < H kv heads)
     resolved via the k/v index maps, matching ``_fwd``."""
     B, H, S, hd = q.shape
@@ -374,7 +397,7 @@ def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpr
     kernel = functools.partial(
         _bwd_dq_kernel,
         sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k, kv_len=T,
-        has_segments=has_segments,
+        has_segments=has_segments, window=window,
     )
     dq = pl.pallas_call(
         kernel,
@@ -399,7 +422,7 @@ def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpr
 
 
 def _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
-             q_offset=0, kv_offset=0, segments=None):
+             q_offset=0, kv_offset=0, segments=None, window=0):
     """(dk, dv) [B,K,T,hd] for one kv block against local q (ring building block).
 
     GQA: the inner grid dim runs ``reps * nq`` steps — every (q head in the kv head's
@@ -430,7 +453,7 @@ def _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interp
         _bwd_dkv_kernel,
         sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k,
         kv_len=T, q_len=S, nq=nq,
-        has_segments=has_segments,
+        has_segments=has_segments, window=window,
     )
     dk, dv = pl.pallas_call(
         kernel,
@@ -480,26 +503,26 @@ def _fit_block(block: int, seq: int) -> int:
 # Offsets travel as float32 scalars so the custom_vjp has well-defined (zero) cotangents for
 # them; kernels receive them as int32. This is what lets shard_map callers (ring/allgather SP)
 # pass traced global positions.
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12))
 def _flash_bhsd(q, k, v, q_off, kv_off, seg_f32, causal, sm_scale, block_q, block_k,
-                interpret, has_segments):
+                interpret, has_segments, window):
     segs = seg_f32.astype(jnp.int32) if has_segments else None
     o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
                 q_offset=q_off.astype(jnp.int32), kv_offset=kv_off.astype(jnp.int32),
-                segments=segs)
+                segments=segs, window=window)
     return o
 
 
 def _flash_bhsd_fwd(q, k, v, q_off, kv_off, seg_f32, causal, sm_scale, block_q, block_k,
-                    interpret, has_segments):
+                    interpret, has_segments, window):
     segs = seg_f32.astype(jnp.int32) if has_segments else None
     o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
                   q_offset=q_off.astype(jnp.int32), kv_offset=kv_off.astype(jnp.int32),
-                  segments=segs)
+                  segments=segs, window=window)
     return o, (q, k, v, q_off, kv_off, seg_f32, o, lse)
 
 
-def _flash_bhsd_bwd(causal, sm_scale, block_q, block_k, interpret, has_segments,
+def _flash_bhsd_bwd(causal, sm_scale, block_q, block_k, interpret, has_segments, window,
                     residuals, do):
     q, k, v, q_off, kv_off, seg_f32, o, lse = residuals
     qo = q_off.astype(jnp.int32)
@@ -507,9 +530,9 @@ def _flash_bhsd_bwd(causal, sm_scale, block_q, block_k, interpret, has_segments,
     segs = seg_f32.astype(jnp.int32) if has_segments else None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [B,H,S]
     dq = _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
-                 q_offset=qo, kv_offset=ko, segments=segs)
+                 q_offset=qo, kv_offset=ko, segments=segs, window=window)
     dk, dv = _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
-                      q_offset=qo, kv_offset=ko, segments=segs)
+                      q_offset=qo, kv_offset=ko, segments=segs, window=window)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
             jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
             jnp.zeros_like(seg_f32))
@@ -534,7 +557,7 @@ def _flash_bhsd_offset(q, k, v, q_offset=0, kv_offset=0, causal=True, sm_scale=N
     o = _flash_bhsd(qT, kT, vT,
                     jnp.asarray(q_offset, jnp.float32), jnp.asarray(kv_offset, jnp.float32),
                     jnp.zeros((1, 1), jnp.float32),
-                    causal, sm_scale, bq, bk, interpret, False)
+                    causal, sm_scale, bq, bk, interpret, False, 0)
     return o.transpose(0, 2, 1, 3)
 
 
@@ -548,6 +571,7 @@ def flash_attention(
     block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     segment_ids: Optional[jax.Array] = None,
+    window: int = 0,
 ) -> jax.Array:
     """Flash attention over user layout q [B, S, H, hd], k/v [B, T, K, hd] (GQA: K ≤ H).
 
@@ -557,6 +581,10 @@ def flash_attention(
     sequences) restricts attention to same-segment pairs IN-KERNEL — packed training keeps
     the flash memory/compute profile instead of falling back to masked XLA attention.
     Requires self-attention shapes (T == S).
+
+    ``window`` > 0 adds Mistral-style sliding-window masking (position i attends
+    (i-window, i]): kv tiles entirely outside the band are SKIPPED, not just masked, so
+    long-context compute scales with S·window instead of S².
     """
     B, S, H, hd = q.shape
     K = k.shape[2]
@@ -583,5 +611,5 @@ def flash_attention(
         else jnp.zeros((1, 1), jnp.float32)
     )
     o = _flash_bhsd(qT, kT, vT, zero, zero, seg_f32, causal, sm_scale, block_q, block_k,
-                    interpret, has_segments)
+                    interpret, has_segments, int(window))
     return o.transpose(0, 2, 1, 3)
