@@ -48,6 +48,15 @@ def timed(fn, *args, n=3, warmup=1):
 
 
 def main() -> int:
+    import os
+
+    if os.environ.get("BENCH_PRESET") == "smoke":
+        # The smoke preset is a CPU logic check by definition — force the CPU backend past
+        # the sitecustomize platform pin so it can never hang on a dead TPU tunnel.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
     import jax
     import jax.numpy as jnp
     import optax
@@ -55,12 +64,21 @@ def main() -> int:
     from accelerate_tpu.models import llama
     from accelerate_tpu.ops.flash_attention import flash_attention
 
-    B = int(__import__("os").environ.get("BENCH_B", "4"))
-    S = int(__import__("os").environ.get("BENCH_S", "2048"))
+    import os
+
+    smoke = os.environ.get("BENCH_PRESET") == "smoke"  # CPU logic check, not a perf number
+    B = int(os.environ.get("BENCH_B", "1" if smoke else "4"))
+    S = int(os.environ.get("BENCH_S", "256" if smoke else "2048"))
     cfg = dataclasses.replace(
         llama.CONFIGS["llama3-8b"],
-        vocab_size=32768, d_model=2048, n_layers=12, n_heads=16, n_kv_heads=8,
-        d_ff=8192, max_seq=S, remat=False, scan_layers=True, attn_impl="flash",
+        vocab_size=512 if smoke else 32768,
+        d_model=128 if smoke else 2048,
+        n_layers=2 if smoke else 12,
+        n_heads=4 if smoke else 16,
+        n_kv_heads=2 if smoke else 8,
+        d_ff=256 if smoke else 8192,
+        max_seq=S, remat=False, scan_layers=True,
+        attn_impl="xla" if smoke else "flash",
     )
     n_params = llama.num_params(cfg)
     rows = []
@@ -70,8 +88,8 @@ def main() -> int:
         rows.append({"name": name, "ms": round(dt * 1e3, 2), "tflops": round(tf, 2)})
         print(f"{name:18s} {dt*1e3:9.2f} ms   {tf:8.2f} TFLOP/s", flush=True)
 
-    # --- matmul peak: k chained [8192,8192]x[8192,8192] bf16 matmuls
-    M = 8192
+    # --- matmul peak: k chained [M,M]x[M,M] bf16 matmuls
+    M = 256 if smoke else 8192
     a = jnp.ones((M, M), jnp.bfloat16)
     w = jnp.ones((M, M), jnp.bfloat16)
 

@@ -47,6 +47,21 @@ def test_big_model_inference_t5_smoke(tmp_path):
 
 
 @slow
+def test_decompose_smoke():
+    env_extra = {"BENCH_PRESET": "smoke"}
+    env = dict(os.environ, **env_extra)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "benchmarks/decompose.py"], capture_output=True, text=True,
+        timeout=600, env=env, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    data = json.loads(out.stdout.strip().splitlines()[-1])
+    names = {r["name"] for r in data["rows"]}
+    assert {"matmul_peak", "fwd_bwd_remat_full", "opt_step"} <= names
+
+
+@slow
 def test_fp8_convergence_smoke():
     out = _run(["benchmarks/fp8/convergence.py", "--steps", "8"])
     assert out["pass"] is True
