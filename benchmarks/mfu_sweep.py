@@ -42,6 +42,8 @@ CONFIGS = [
     ("dots_unroll2", {"BENCH_REMAT_POLICY": "dots", "BENCH_SCAN_UNROLL": "2"}),
     ("combo_b8_dots_unroll2", {"BENCH_B": "8", "BENCH_REMAT_POLICY": "dots",
                                "BENCH_SCAN_UNROLL": "2"}),
+    ("loss_chunk_off", {"BENCH_LOSS_CHUNK": "-1"}),
+    ("loss_chunk_1024", {"BENCH_LOSS_CHUNK": "1024"}),
 ]
 
 
