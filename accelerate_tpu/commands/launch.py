@@ -67,6 +67,11 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
         ("sp", "sequence"), ("pp", "pipeline"), ("ep", "expert"),
     ):
         mesh.add_argument(f"--{axis}", type=int, default=None, help=f"{doc}-parallel degree.")
+    mesh.add_argument(
+        "--dcn-dp", "--dcn_dp", dest="dcn_dp", type=int, default=None,
+        help="Multi-slice: dp replicas placed across slice boundaries (DCN carries only "
+             "the dp all-reduce; other axes stay on intra-slice ICI). Must divide --dp.",
+    )
     mesh.add_argument("--use-fsdp", "--use_fsdp", action="store_true")
     mesh.add_argument("--fsdp-zero-stage", "--fsdp_zero_stage", type=int, default=None)
     mesh.add_argument("--fsdp-cpu-offload", "--fsdp_cpu_offload", action="store_true",

@@ -57,6 +57,12 @@ class MeshConfig:
     sp: int = 1
     pp: int = 1
     ep: int = 1
+    # Multi-slice: how many of the dp replicas live on DIFFERENT slices (connected by DCN,
+    # not ICI). build_mesh places this factor of the dp axis across slice boundaries via
+    # mesh_utils.create_hybrid_device_mesh, so DCN carries ONLY the dp gradient
+    # all-reduce — fsdp/tp/sp/pp/ep collectives stay on intra-slice ICI. 1 = single slice.
+    # Must divide dp (after -1 resolution).
+    dcn_dp: int = 1
     # Optional explicit device list (tests); None = all global devices.
     devices: Optional[Sequence[jax.Device]] = None
     allow_split_physical_axes: bool = False
@@ -100,7 +106,7 @@ class MeshConfig:
         import os
 
         values = {}
-        for field_name in ("dp", "fsdp", "tp", "sp", "pp", "ep"):
+        for field_name in ("dp", "fsdp", "tp", "sp", "pp", "ep", "dcn_dp"):
             raw = os.environ.get(f"ACCELERATE_MESH_{field_name.upper()}")
             if raw is not None:
                 values[field_name] = int(raw)
@@ -164,6 +170,29 @@ def build_mesh(config: Optional[MeshConfig] = None) -> Mesh:
     devices = list(config.devices) if config.devices is not None else jax.devices()
     sizes = config.resolved_sizes(len(devices))
     shape = tuple(sizes[name] for name in MESH_AXIS_NAMES)
+    if config.dcn_dp > 1:
+        # Multi-slice: split the dp axis into (dcn factor) × (per-slice remainder) and let
+        # create_hybrid_device_mesh place the dcn factor across slice boundaries. Only the
+        # dp gradient all-reduce crosses DCN; every other axis stays on ICI.
+        dp_idx = MESH_AXIS_NAMES.index(DATA_AXIS)
+        if shape[dp_idx] % config.dcn_dp:
+            raise ValueError(
+                f"dcn_dp={config.dcn_dp} must divide the dp axis size {shape[dp_idx]}"
+            )
+        ici_shape = list(shape)
+        ici_shape[dp_idx] //= config.dcn_dp
+        dcn_shape = [1] * len(shape)
+        dcn_shape[dp_idx] = config.dcn_dp
+        try:
+            device_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, dcn_shape, devices=devices,
+                allow_split_physical_axes=config.allow_split_physical_axes,
+            )
+        except (ValueError, NotImplementedError, AttributeError):
+            # No slice metadata (CPU simulator / single-slice): plain reshape keeps the
+            # same global shape and axis order, so programs still compile identically.
+            device_array = np.array(devices).reshape(shape)
+        return Mesh(device_array, MESH_AXIS_NAMES)
     if len(devices) == 1:
         device_array = np.array(devices).reshape(shape)
     else:

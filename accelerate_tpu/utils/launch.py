@@ -28,7 +28,7 @@ __all__ = [
     "PrepareForLaunch",
 ]
 
-_MESH_AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
+_MESH_AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep", "dcn_dp")
 
 
 def _str_flag(value: bool) -> str:

@@ -66,3 +66,32 @@ def test_batch_sharding_places_data():
 def test_from_plugins_indivisible_tp_raises():
     with pytest.raises(ValueError, match="does not divide"):
         MeshConfig.from_plugins(tp_plugin=TensorParallelPlugin(tp_size=3))
+
+
+def test_dcn_dp_mesh_shape_and_training():
+    """Multi-slice layout: dcn_dp splits the dp axis across slices. On the CPU simulator
+    (no slice metadata) build_mesh falls back to a plain reshape with the SAME global
+    shape, so programs compile identically — asserted by running a sharded matmul."""
+    mesh = build_mesh(MeshConfig(dp=4, fsdp=2, dcn_dp=2))
+    assert shape_of(mesh)["dp"] == 4
+    assert shape_of(mesh)["fsdp"] == 2
+    x = jax.device_put(
+        np.ones((8, 16), np.float32),
+        NamedSharding(mesh, PartitionSpec(("dp", "fsdp"), None)),
+    )
+    w = jax.device_put(np.ones((16, 4), np.float32), NamedSharding(mesh, PartitionSpec()))
+    out = jax.jit(lambda x, w: x @ w)(x, w)
+    np.testing.assert_array_equal(np.asarray(out), np.full((8, 4), 16.0))
+
+
+def test_dcn_dp_must_divide_dp():
+    with pytest.raises(ValueError, match="must divide"):
+        build_mesh(MeshConfig(dp=4, fsdp=2, dcn_dp=3))
+
+
+def test_dcn_dp_env_roundtrip(monkeypatch):
+    monkeypatch.setenv("ACCELERATE_MESH_DP", "4")
+    monkeypatch.setenv("ACCELERATE_MESH_FSDP", "2")
+    monkeypatch.setenv("ACCELERATE_MESH_DCN_DP", "2")
+    cfg = MeshConfig.from_env()
+    assert cfg.dp == 4 and cfg.fsdp == 2 and cfg.dcn_dp == 2
