@@ -22,6 +22,8 @@ import jax.numpy as jnp
 __all__ = [
     "llama_config_from_hf",
     "llama_from_hf",
+    "gemma2_config_from_hf",
+    "gemma2_from_hf",
     "gpt2_config_from_hf",
     "gpt2_from_hf",
     "t5_config_from_hf",
@@ -80,6 +82,88 @@ def llama_from_hf(state_dict: Mapping[str, Any], cfg) -> dict:
             "wv": take(p + "self_attn.v_proj.weight").T,
             "wo": take(p + "self_attn.o_proj.weight").T,
             "ln_mlp": take(p + "post_attention_layernorm.weight"),
+            "w_gate": take(p + "mlp.gate_proj.weight").T,
+            "w_up": take(p + "mlp.up_proj.weight").T,
+            "w_down": take(p + "mlp.down_proj.weight").T,
+        })
+    if not cfg.tie_embeddings:
+        head = sd.get("lm_head.weight")
+        params["lm_head"] = (
+            _np(head).T if head is not None else params["embed"].T.copy()
+        )
+    if cfg.scan_layers:
+        params["layers"] = _stack_layers(params["layers"])
+    return _to_jnp(params)
+
+
+def gemma2_config_from_hf(hf_config: Any, **overrides):
+    """LlamaConfig (Gemma-2 knobs set) from a transformers Gemma2Config (object or dict).
+
+    Gemma-2 is the llama family plus: zero-centered (1+w) RMSNorms, post-sublayer norms,
+    GeGLU, sqrt(d) embedding scaling, query_pre_attn_scalar softmax scale, attention and
+    final logit soft-capping, head_dim != d/H, and alternating banded/full layers (HF
+    ``Gemma2DecoderLayer.is_sliding = not layer_idx % 2`` == ``window_every=2``).
+    """
+    from .llama import LlamaConfig
+
+    get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, Mapping) else (
+        lambda k, d=None: getattr(hf_config, k, d)
+    )
+    kwargs = dict(
+        vocab_size=get("vocab_size"),
+        d_model=get("hidden_size"),
+        n_layers=get("num_hidden_layers"),
+        n_heads=get("num_attention_heads"),
+        n_kv_heads=get("num_key_value_heads") or get("num_attention_heads"),
+        d_ff=get("intermediate_size"),
+        head_dim_override=get("head_dim"),
+        max_seq=get("max_position_embeddings", 8192),
+        rope_theta=float(get("rope_theta", 10000.0)),
+        norm_eps=float(get("rms_norm_eps", 1e-6)),
+        tie_embeddings=bool(get("tie_word_embeddings", True)),
+        mlp_act="gelu",
+        post_norm=True,
+        norm_plus_one=True,
+        embed_scale=True,
+        attn_scale=float(get("query_pre_attn_scalar")) ** -0.5,
+        attn_softcap=float(get("attn_logit_softcapping") or 0.0),
+        final_softcap=float(get("final_logit_softcapping") or 0.0),
+        sliding_window=int(get("sliding_window") or 0),
+        window_every=2,
+    )
+    kwargs.update(overrides)
+    return LlamaConfig(**kwargs)
+
+
+def gemma2_from_hf(state_dict: Mapping[str, Any], cfg) -> dict:
+    """transformers Gemma2ForCausalLM state dict → ``models.llama`` params pytree.
+
+    Same projection layout as llama (torch ``[out, in]`` → transposed); the four
+    per-layer norms map input→ln_attn, post_attention→ln_attn_post,
+    pre_feedforward→ln_mlp, post_feedforward→ln_mlp_post (all zero-centered — consumed
+    with the (1+w) convention, ``cfg.norm_plus_one``).
+    """
+    sd = {k: v for k, v in state_dict.items()}
+
+    def take(name):
+        return _np(sd[name])
+
+    params: dict = {
+        "embed": take("model.embed_tokens.weight"),
+        "ln_f": take("model.norm.weight"),
+        "layers": [],
+    }
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}."
+        params["layers"].append({
+            "ln_attn": take(p + "input_layernorm.weight"),
+            "wq": take(p + "self_attn.q_proj.weight").T,
+            "wk": take(p + "self_attn.k_proj.weight").T,
+            "wv": take(p + "self_attn.v_proj.weight").T,
+            "wo": take(p + "self_attn.o_proj.weight").T,
+            "ln_attn_post": take(p + "post_attention_layernorm.weight"),
+            "ln_mlp": take(p + "pre_feedforward_layernorm.weight"),
+            "ln_mlp_post": take(p + "post_feedforward_layernorm.weight"),
             "w_gate": take(p + "mlp.gate_proj.weight").T,
             "w_up": take(p + "mlp.up_proj.weight").T,
             "w_down": take(p + "mlp.down_proj.weight").T,

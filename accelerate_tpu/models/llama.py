@@ -104,10 +104,23 @@ class LlamaConfig:
     # compute scales with S·window instead of S². Not composable with the sp attention
     # modes (ring/ulysses/allgather) — those raise.
     sliding_window: int = 0
+    # Apply the sliding window to every Nth layer only (Gemma-2 alternates banded and
+    # full-attention layers: window_every=2 → even layers banded, odd layers full).
+    # >1 requires scan_layers=False (the layers are no longer a uniform scan body).
+    window_every: int = 1
+    # ---- Gemma-family architectural knobs (all default to llama behavior) ----
+    head_dim_override: Optional[int] = None  # per-head dim when != d_model // n_heads
+    mlp_act: str = "silu"       # "silu" (SwiGLU) | "gelu" (GeGLU, tanh approximation)
+    post_norm: bool = False     # extra RMSNorm on each sublayer OUTPUT before the residual
+    norm_plus_one: bool = False  # RMSNorm weight stored zero-centered: out = x̂·(1 + w)
+    embed_scale: bool = False   # multiply token embeddings by sqrt(d_model)
+    attn_scale: Optional[float] = None  # softmax scale override (query_pre_attn_scalar)
+    attn_softcap: float = 0.0   # tanh-cap attention scores (forces the XLA attn path)
+    final_softcap: float = 0.0  # tanh-cap output logits
 
     @property
     def head_dim(self) -> int:
-        return self.d_model // self.n_heads
+        return self.head_dim_override or self.d_model // self.n_heads
 
     @property
     def q_per_kv(self) -> int:
@@ -137,6 +150,13 @@ CONFIGS = {
         vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8, d_ff=14336,
         rope_theta=10000.0, max_seq=32768, sliding_window=4096,
     ),
+    "gemma2-9b": LlamaConfig(
+        vocab_size=256000, d_model=3584, n_layers=42, n_heads=16, n_kv_heads=8,
+        d_ff=14336, head_dim_override=256, rope_theta=10000.0, max_seq=8192,
+        tie_embeddings=True, mlp_act="gelu", post_norm=True, norm_plus_one=True,
+        embed_scale=True, attn_scale=224.0**-0.5, attn_softcap=50.0, final_softcap=30.0,
+        sliding_window=4096, window_every=2, norm_eps=1e-6,
+    ),
     "mixtral-8x7b": LlamaConfig(
         vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8, d_ff=14336,
         rope_theta=1e6, max_seq=32768, moe_experts=8, moe_top_k=2,
@@ -154,14 +174,18 @@ def _layer_params(cfg: LlamaConfig, key) -> dict:
     D, H, K, hd, F = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff
     s_in = 1.0 / math.sqrt(D)
     s_ff = 1.0 / math.sqrt(F)
+    norm_init = jnp.zeros if cfg.norm_plus_one else jnp.ones  # zero-centered Gemma weights
     params = {
-        "ln_attn": jnp.ones((D,), jnp.float32),
+        "ln_attn": norm_init((D,), jnp.float32),
         "wq": jax.random.normal(k[0], (D, H * hd), jnp.float32) * s_in,
         "wk": jax.random.normal(k[1], (D, K * hd), jnp.float32) * s_in,
         "wv": jax.random.normal(k[2], (D, K * hd), jnp.float32) * s_in,
         "wo": jax.random.normal(k[3], (H * hd, D), jnp.float32) * s_in,
-        "ln_mlp": jnp.ones((D,), jnp.float32),
+        "ln_mlp": norm_init((D,), jnp.float32),
     }
+    if cfg.post_norm:
+        params["ln_attn_post"] = norm_init((D,), jnp.float32)
+        params["ln_mlp_post"] = norm_init((D,), jnp.float32)
     if cfg.moe_experts > 0:
         E = cfg.moe_experts
         params["moe"] = {
@@ -187,7 +211,7 @@ def init_params(cfg: LlamaConfig, key: Optional[jax.Array] = None) -> dict:
     params = {
         "embed": jax.random.normal(keys[0], (cfg.vocab_size, cfg.d_model), jnp.float32) * scale,
         "layers": [_layer_params(cfg, keys[i + 1]) for i in range(cfg.n_layers)],
-        "ln_f": jnp.ones((cfg.d_model,), jnp.float32),
+        "ln_f": (jnp.zeros if cfg.norm_plus_one else jnp.ones)((cfg.d_model,), jnp.float32),
     }
     if cfg.scan_layers:
         params["layers"] = jax.tree_util.tree_map(
@@ -222,6 +246,9 @@ def partition_specs(cfg: LlamaConfig, pp: bool = False) -> dict:
         "wo": P(TENSOR_AXIS, None),
         "ln_mlp": P(),
     }
+    if cfg.post_norm:
+        layer["ln_attn_post"] = P()
+        layer["ln_mlp_post"] = P()
     if cfg.moe_experts > 0:
         from ..ops.moe import expert_partition_specs
 
@@ -275,10 +302,13 @@ def _maybe_shard(x: jax.Array, spec: P) -> jax.Array:
     return maybe_shard(x, spec)
 
 
-def _rms_norm(x: jax.Array, gamma: jax.Array, eps: float) -> jax.Array:
+def _rms_norm(x: jax.Array, gamma: jax.Array, eps: float, plus_one: bool = False) -> jax.Array:
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     normed = x.astype(jnp.float32) * jax.lax.rsqrt(var + eps)
-    return (normed * gamma.astype(jnp.float32)).astype(x.dtype)
+    g = gamma.astype(jnp.float32)
+    if plus_one:  # Gemma convention: weights stored zero-centered
+        g = g + 1.0
+    return (normed * g).astype(x.dtype)
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -293,6 +323,17 @@ def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
     return out.astype(x.dtype)
 
 
+def _sm_scale(cfg: LlamaConfig) -> float:
+    """Softmax scale: 1/sqrt(head_dim) unless the config overrides it (Gemma-2's
+    query_pre_attn_scalar)."""
+    return cfg.attn_scale if cfg.attn_scale is not None else 1.0 / math.sqrt(cfg.head_dim)
+
+
+def _softcap(scores: jax.Array, cap: float) -> jax.Array:
+    """Gemma-style logit capping: cap·tanh(x/cap) (identity when cap == 0)."""
+    return cap * jnp.tanh(scores / cap) if cap else scores
+
+
 def _attention_xla(q, k, v, mask, cfg: LlamaConfig):
     """Reference attention path: q [B,S,H,hd], kv [B,S,K,hd] → [B,S,H,hd].
 
@@ -302,7 +343,8 @@ def _attention_xla(q, k, v, mask, cfg: LlamaConfig):
     K = k.shape[2]
     G = H // K
     qg = q.reshape(B, S, K, G, hd)
-    scores = jnp.einsum("bskgd,btkd->bkgst", qg, k) / math.sqrt(hd)
+    scores = jnp.einsum("bskgd,btkd->bkgst", qg, k) * _sm_scale(cfg)
+    scores = _softcap(scores, cfg.attn_softcap)
     scores = jnp.where(mask[:, None, None, :, :], scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bkgst,btkd->bskgd", probs, v).reshape(B, S, H, hd)
@@ -326,13 +368,18 @@ def _attention(q, k, v, mask, cfg: LlamaConfig, segment_ids=None):
         impl = "auto"
     if impl == "auto":
         impl = "flash" if jax.default_backend() in ("tpu", "axon") else "xla"
+    if cfg.attn_softcap:
+        # Score capping isn't implemented in the flash kernels; the masked XLA path is the
+        # exact reference semantics (Gemma-2).
+        impl = "xla"
     if impl == "flash":
         try:
             from ..ops.flash_attention import flash_attention
 
             # Packed rows stay on the flash path: the kernels take segment ids directly.
             return flash_attention(
-                q, k, v, causal=True, segment_ids=segment_ids, window=cfg.sliding_window
+                q, k, v, causal=True, segment_ids=segment_ids, window=cfg.sliding_window,
+                sm_scale=_sm_scale(cfg),
             )
         except Exception:  # pragma: no cover - kernel unavailable on this backend
             pass
@@ -353,10 +400,19 @@ def _proj(h, w, cfg: LlamaConfig):
     return h @ w.astype(cfg.dtype)
 
 
+def _mlp_gate_act(h: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    if cfg.mlp_act == "silu":
+        return jax.nn.silu(h)
+    if cfg.mlp_act == "gelu":  # GeGLU (tanh approximation — Gemma convention)
+        return jax.nn.gelu(h, approximate=True)
+    raise ValueError(f"mlp_act={cfg.mlp_act!r}: expected 'silu' or 'gelu'")
+
+
 def _block(x, layer, positions, mask, cfg: LlamaConfig, segment_ids=None):
     """One transformer block → (x, moe_aux_loss) (aux is 0.0 for dense MLPs)."""
     B, S, D = x.shape
-    h = _rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+    p1 = cfg.norm_plus_one
+    h = _rms_norm(x, layer["ln_attn"], cfg.norm_eps, p1)
     q = _proj(h, layer["wq"], cfg).reshape(B, S, cfg.n_heads, cfg.head_dim)
     k = _proj(h, layer["wk"], cfg).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
     v = _proj(h, layer["wv"], cfg).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
@@ -365,8 +421,11 @@ def _block(x, layer, positions, mask, cfg: LlamaConfig, segment_ids=None):
     attn = _attention(q, k, v, mask, cfg, segment_ids).reshape(
         B, S, cfg.n_heads * cfg.head_dim
     )
-    x = x + _proj(attn, layer["wo"], cfg)
-    h = _rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
+    attn_out = _proj(attn, layer["wo"], cfg)
+    if cfg.post_norm:  # Gemma-2: normalize the sublayer OUTPUT before the residual add
+        attn_out = _rms_norm(attn_out, layer["ln_attn_post"], cfg.norm_eps, p1)
+    x = x + attn_out
+    h = _rms_norm(x, layer["ln_mlp"], cfg.norm_eps, p1)
     if cfg.moe_experts > 0:
         from ..ops.moe import moe_mlp
 
@@ -376,9 +435,12 @@ def _block(x, layer, positions, mask, cfg: LlamaConfig, segment_ids=None):
             compute_dtype=cfg.dtype,
         )
         return x + y, aux
-    gate = jax.nn.silu(_proj(h, layer["w_gate"], cfg))
+    gate = _mlp_gate_act(_proj(h, layer["w_gate"], cfg), cfg)
     up = _proj(h, layer["w_up"], cfg)
-    x = x + _proj(gate * up, layer["w_down"], cfg)
+    mlp_out = _proj(gate * up, layer["w_down"], cfg)
+    if cfg.post_norm:
+        mlp_out = _rms_norm(mlp_out, layer["ln_mlp_post"], cfg.norm_eps, p1)
+    x = x + mlp_out
     return x, jnp.zeros((), jnp.float32)
 
 
@@ -454,6 +516,8 @@ def forward_hidden(
             else jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
         )
     x = params["embed"].astype(dtype)[tokens]
+    if cfg.embed_scale:
+        x = x * jnp.asarray(math.sqrt(cfg.d_model), dtype)
     if shard_activations:
         x = _maybe_shard(x, P(BATCH_AXES, SEQUENCE_AXIS, None))
     if segment_ids is not None:
@@ -464,12 +528,17 @@ def forward_hidden(
             cfg = dataclasses.replace(cfg, attn_impl="auto")
     else:
         mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
+    full_mask = mask
     if cfg.sliding_window:
         # Band-limit the XLA-path mask to (i-window, i]; the flash kernels apply the same
         # band in-kernel (and skip out-of-band tiles entirely).
         idx = jnp.arange(S, dtype=jnp.int32)
         mask = mask & (idx[None, :] > idx[:, None] - cfg.sliding_window)[None]
 
+    if cfg.sliding_window and cfg.window_every > 1 and cfg.scan_layers:
+        raise NotImplementedError(
+            "window_every > 1 (alternating banded/full layers) requires scan_layers=False"
+        )
     block = _maybe_remat_block(cfg)
 
     aux_total = jnp.zeros((), jnp.float32)
@@ -483,12 +552,19 @@ def forward_hidden(
         x, auxes = jax.lax.scan(scan_body, x, params["layers"], unroll=cfg.scan_unroll)
         aux_total = jnp.sum(auxes)
     else:
-        for layer in params["layers"]:
-            x, aux = block(x, layer, positions, mask, cfg, segment_ids)
+        full_cfg = dataclasses.replace(cfg, sliding_window=0)
+        for i, layer in enumerate(params["layers"]):
+            banded = cfg.sliding_window and i % cfg.window_every == 0
+            x, aux = block(
+                x, layer, positions,
+                mask if banded else full_mask,
+                cfg if banded else full_cfg,
+                segment_ids,
+            )
             aux_total = aux_total + aux
             if shard_activations:
                 x = _maybe_shard(x, P(BATCH_AXES, SEQUENCE_AXIS, None))
-    x = _rms_norm(x, params["ln_f"], cfg.norm_eps)
+    x = _rms_norm(x, params["ln_f"], cfg.norm_eps, cfg.norm_plus_one)
     return x, aux_total
 
 
@@ -505,6 +581,7 @@ def forward(
     x, aux_total = forward_hidden(params, tokens, cfg, positions, shard_activations)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+    logits = _softcap(logits, cfg.final_softcap)
     if return_aux:
         return logits, aux_total
     return logits
@@ -527,7 +604,7 @@ def _loss_chunk_size(cfg: LlamaConfig, S: int) -> int:
     return min(512, S)
 
 
-def _chunked_ce(x, head, targets, mask, chunk: int, dtype):
+def _chunked_ce(x, head, targets, mask, chunk: int, dtype, final_softcap: float = 0.0):
     """Memory-efficient cross-entropy: per-chunk head matmul + logsumexp under remat.
 
     ``x`` [B,S,D] (post-ln_f hidden), ``head`` [D,V]; returns the sum of -log p(target) over
@@ -551,6 +628,7 @@ def _chunked_ce(x, head, targets, mask, chunk: int, dtype):
     @jax.checkpoint
     def chunk_loss(xc, tc, mc):
         logits = (xc @ head.astype(dtype)).astype(jnp.float32)   # [B, c, V]
+        logits = _softcap(logits, final_softcap)
         lse = jax.nn.logsumexp(logits, axis=-1)                  # [B, c]
         tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1).squeeze(-1)
         return -((tgt - lse) * mc).sum()
@@ -570,8 +648,11 @@ def _ce_from_hidden(x, params, targets, mask, cfg: LlamaConfig) -> jax.Array:
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
     chunk = _loss_chunk_size(cfg, S)  # may exceed/not divide S; _chunked_ce pads
     if chunk > 0:
-        return _chunked_ce(x, head, targets, mask, chunk, cfg.dtype) / denom
+        return _chunked_ce(
+            x, head, targets, mask, chunk, cfg.dtype, final_softcap=cfg.final_softcap
+        ) / denom
     logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+    logits = _softcap(logits, cfg.final_softcap)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
     return -(ll * mask).sum() / denom
@@ -789,7 +870,8 @@ def _attention_cached(q, ck, cv, q_positions, valid, cfg: LlamaConfig):
     # Grouped-query decode: contract against the UNREPEATED cache. Decode (T=1) is an
     # HBM-bandwidth gather over the cache, so never repeating it reads H/K× fewer bytes.
     qg = q.reshape(B, T, K, G, hd)
-    scores = jnp.einsum("btkgd,bckd->bkgtc", qg, ck) / math.sqrt(hd)
+    scores = jnp.einsum("btkgd,bckd->bkgtc", qg, ck) * _sm_scale(cfg)
+    scores = _softcap(scores, cfg.attn_softcap)
     slots = jnp.arange(C)[None, None, :]
     causal = slots <= q_positions[:, :, None]  # [B,T,C]
     if cfg.sliding_window:
@@ -808,7 +890,8 @@ def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig):
     engine, ``serving.py`` — requires T == 1).
     """
     B, T, D = x.shape
-    h = _rms_norm(x, layer["ln_attn"], cfg.norm_eps)
+    p1 = cfg.norm_plus_one
+    h = _rms_norm(x, layer["ln_attn"], cfg.norm_eps, p1)
     q = _proj(h, layer["wq"], cfg).reshape(B, T, cfg.n_heads, cfg.head_dim)
     k = _proj(h, layer["wk"], cfg).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
     v = _proj(h, layer["wv"], cfg).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
@@ -819,8 +902,11 @@ def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig):
         q, _read_cache(new_kv, "k", cfg.dtype), _read_cache(new_kv, "v", cfg.dtype),
         positions, valid, cfg,
     )
-    x = x + _proj(attn.reshape(B, T, cfg.n_heads * cfg.head_dim), layer["wo"], cfg)
-    h = _rms_norm(x, layer["ln_mlp"], cfg.norm_eps)
+    attn_out = _proj(attn.reshape(B, T, cfg.n_heads * cfg.head_dim), layer["wo"], cfg)
+    if cfg.post_norm:
+        attn_out = _rms_norm(attn_out, layer["ln_attn_post"], cfg.norm_eps, p1)
+    x = x + attn_out
+    h = _rms_norm(x, layer["ln_mlp"], cfg.norm_eps, p1)
     if cfg.moe_experts > 0:
         from ..ops.moe import moe_mlp, moe_mlp_dense
 
@@ -840,9 +926,12 @@ def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig):
                 compute_dtype=cfg.dtype,
             )
         return x + y, new_kv
-    gate = jax.nn.silu(_proj(h, layer["w_gate"], cfg))
+    gate = _mlp_gate_act(_proj(h, layer["w_gate"], cfg), cfg)
     up = _proj(h, layer["w_up"], cfg)
-    x = x + _proj(gate * up, layer["w_down"], cfg)
+    mlp_out = _proj(gate * up, layer["w_down"], cfg)
+    if cfg.post_norm:
+        mlp_out = _rms_norm(mlp_out, layer["ln_mlp_post"], cfg.norm_eps, cfg.norm_plus_one)
+    x = x + mlp_out
     return x, new_kv
 
 
@@ -880,6 +969,12 @@ def forward_cached(
     index, positions, valid = _cache_advance(cache, tokens, token_mask)
 
     x = params["embed"].astype(dtype)[tokens]
+    if cfg.embed_scale:
+        x = x * jnp.asarray(math.sqrt(cfg.d_model), dtype)
+    if cfg.sliding_window and cfg.window_every > 1 and cfg.scan_layers:
+        raise NotImplementedError(
+            "window_every > 1 (alternating banded/full layers) requires scan_layers=False"
+        )
     if cfg.scan_layers:
         def scan_body(carry, layer_and_kv):
             layer, kv = layer_and_kv
@@ -888,15 +983,19 @@ def forward_cached(
 
         x, new_layers = jax.lax.scan(scan_body, x, (params["layers"], cache["layers"]))
     else:
+        full_cfg = dataclasses.replace(cfg, sliding_window=0)
         new_layers = []
-        for layer, kv in zip(params["layers"], cache["layers"]):
-            x, new_kv = _block_cached(x, layer, kv, index, positions, valid, cfg)
+        for i, (layer, kv) in enumerate(zip(params["layers"], cache["layers"])):
+            banded = cfg.sliding_window and i % cfg.window_every == 0
+            x, new_kv = _block_cached(
+                x, layer, kv, index, positions, valid, cfg if banded else full_cfg
+            )
             new_layers.append(new_kv)
-    x = _rms_norm(x, params["ln_f"], cfg.norm_eps)
+    x = _rms_norm(x, params["ln_f"], cfg.norm_eps, cfg.norm_plus_one)
     if last_only:
         x = x[:, -1:, :]
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x @ head.astype(dtype)).astype(jnp.float32)
+    logits = _softcap((x @ head.astype(dtype)).astype(jnp.float32), cfg.final_softcap)
     new_cache = {"layers": new_layers, "valid": valid, "index": index + T}
     return logits, new_cache
 
