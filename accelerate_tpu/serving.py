@@ -88,10 +88,17 @@ def _decode_step(params, cache, tokens, positions, cfg):
 
     The greedy argmax stays fused on-device; the logits matrix is only fetched host-side
     when a sampled (temperature > 0) request is active."""
+    import dataclasses as _dc
+    import math as _math
+
+    from .models.llama import _softcap
+
     B = tokens.shape[0]
     rows = jnp.arange(B)
     valid = cache["valid"].at[rows, positions].set(True)
     x = params["embed"][tokens].astype(cfg.dtype)[:, None, :]
+    if cfg.embed_scale:
+        x = x * jnp.asarray(_math.sqrt(cfg.d_model), cfg.dtype)
     pos2 = positions[:, None]
     if cfg.scan_layers:
         def body(carry, layer_and_kv):
@@ -102,13 +109,20 @@ def _decode_step(params, cache, tokens, positions, cfg):
 
         x, new_layers = jax.lax.scan(body, x, (params["layers"], cache["layers"]))
     else:
+        # Mirror forward_cached's per-layer banded/full alternation (cfg.window_every).
+        full_cfg = _dc.replace(cfg, sliding_window=0)
         new_layers = []
-        for layer, kv in zip(params["layers"], cache["layers"]):
-            x, new_kv = _block_cached(x, layer, kv, positions, pos2, valid, cfg)
+        for i, (layer, kv) in enumerate(zip(params["layers"], cache["layers"])):
+            banded = cfg.sliding_window and i % cfg.window_every == 0
+            x, new_kv = _block_cached(
+                x, layer, kv, positions, pos2, valid, cfg if banded else full_cfg
+            )
             new_layers.append(new_kv)
-    x = _rms_norm(x, params["ln_f"], cfg.norm_eps)
+    x = _rms_norm(x, params["ln_f"], cfg.norm_eps, cfg.norm_plus_one)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x[:, -1, :] @ head.astype(cfg.dtype)).astype(jnp.float32)
+    logits = _softcap(
+        (x[:, -1, :] @ head.astype(cfg.dtype)).astype(jnp.float32), cfg.final_softcap
+    )
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     return greedy, logits, {"layers": new_layers, "valid": valid, "index": cache["index"]}
 

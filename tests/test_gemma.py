@@ -99,6 +99,33 @@ def test_generate_runs():
     assert out.shape == (1, 5)
 
 
+def test_serving_engine_matches_generate():
+    """The continuous batcher's decode step mirrors forward_cached's Gemma knobs
+    (embed scale, banded/full alternation, (1+w) ln_f, final soft-cap) — its greedy
+    output must equal the standalone compiled generate."""
+    from accelerate_tpu.generation import GenerationConfig
+    from accelerate_tpu.serving import ContinuousBatcher
+
+    cfg = dataclasses.replace(
+        llama.CONFIGS["gemma2-9b"],
+        vocab_size=128, d_model=64, n_layers=4, n_heads=4, n_kv_heads=2, d_ff=128,
+        head_dim_override=16, sliding_window=8, max_seq=128, dtype=jnp.float32,
+        remat=False,
+    )
+    params = llama.init_params(cfg)
+    prompt = [3, 5, 7, 11, 13]
+    ref = np.asarray(
+        llama.generate(
+            params, jnp.asarray([prompt], jnp.int32), cfg,
+            GenerationConfig(max_new_tokens=6),
+        )
+    )[0].tolist()
+    eng = ContinuousBatcher(params, cfg, max_slots=2, max_len=64, prompt_bucket=8)
+    req = eng.submit(prompt, max_new_tokens=6)
+    eng.run()
+    assert req.tokens == ref
+
+
 def test_training_step_decreases_loss():
     import optax
 
