@@ -115,7 +115,7 @@ class LlamaConfig:
     norm_plus_one: bool = False  # RMSNorm weight stored zero-centered: out = x̂·(1 + w)
     embed_scale: bool = False   # multiply token embeddings by sqrt(d_model)
     attn_scale: Optional[float] = None  # softmax scale override (query_pre_attn_scalar)
-    attn_softcap: float = 0.0   # tanh-cap attention scores (forces the XLA attn path)
+    attn_softcap: float = 0.0   # tanh-cap attention scores (in-kernel on the flash path)
     final_softcap: float = 0.0  # tanh-cap output logits
 
     @property
@@ -368,18 +368,15 @@ def _attention(q, k, v, mask, cfg: LlamaConfig, segment_ids=None):
         impl = "auto"
     if impl == "auto":
         impl = "flash" if jax.default_backend() in ("tpu", "axon") else "xla"
-    if cfg.attn_softcap:
-        # Score capping isn't implemented in the flash kernels; the masked XLA path is the
-        # exact reference semantics (Gemma-2).
-        impl = "xla"
     if impl == "flash":
         try:
             from ..ops.flash_attention import flash_attention
 
             # Packed rows stay on the flash path: the kernels take segment ids directly.
+            # Gemma score capping is in-kernel too (with its exact backward chain rule).
             return flash_attention(
                 q, k, v, causal=True, segment_ids=segment_ids, window=cfg.sliding_window,
-                sm_scale=_sm_scale(cfg),
+                sm_scale=_sm_scale(cfg), softcap=cfg.attn_softcap,
             )
         except Exception:  # pragma: no cover - kernel unavailable on this backend
             pass

@@ -74,7 +74,7 @@ def _smem_scalar_spec():
 # ------------------------------------------------------------------------------ forward
 def _fwd_kernel(
     q_off_ref, kv_off_ref, *refs,
-    sm_scale, causal, block_q, block_k, kv_len, has_segments, window,
+    sm_scale, causal, block_q, block_k, kv_len, has_segments, window, softcap,
 ):
     if has_segments:
         (q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref,
@@ -119,6 +119,8 @@ def _fwd_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [block_q, block_k] fp32
+        if softcap:  # Gemma-style capping: s = cap*tanh(s/cap)
+            s = softcap * jnp.tanh(s / softcap)
 
         col_local = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         mask = col_local < kv_len
@@ -168,7 +170,7 @@ def _seg_blocks(segments, Sp, Tp):
 
 
 def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, q_offset=0, kv_offset=0,
-         segments=None, window=0):
+         segments=None, window=0, softcap=0.0):
     """Raw forward: q [B,H,S,hd], k/v [B,K,T,hd] (K divides H — GQA resolved IN the BlockSpec
     index maps, never via a materialized head repeat) → (o [B,H,S,hd], lse [B,H,S] fp32).
     Differentiation-free."""
@@ -187,7 +189,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, q_offset=0, kv_
     kernel = functools.partial(
         _fwd_kernel,
         sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k, kv_len=T,
-        has_segments=has_segments, window=window,
+        has_segments=has_segments, window=window, softcap=softcap,
     )
     seg_specs, seg_args = [], []
     if has_segments:
@@ -229,7 +231,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, q_offset=0, kv_
 # ------------------------------------------------------------------------------ backward
 def _bwd_dq_kernel(
     q_off_ref, kv_off_ref, *refs,
-    sm_scale, causal, block_q, block_k, kv_len, has_segments, window,
+    sm_scale, causal, block_q, block_k, kv_len, has_segments, window, softcap,
 ):
     if has_segments:
         (q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -268,6 +270,9 @@ def _bwd_dq_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
+        if softcap:  # recompute the capped scores AND the cap's local slope
+            t = jnp.tanh(s / softcap)
+            s = softcap * t
         col_local = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         mask = col_local < kv_len
         if causal or window:
@@ -284,7 +289,10 @@ def _bwd_dq_kernel(
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = (p * (dp - delta) * sm_scale).astype(k.dtype)
+        ds = p * (dp - delta) * sm_scale
+        if softcap:  # chain rule through s = cap*tanh(s_raw/cap): d/ds_raw = 1 - t^2
+            ds = ds * (1.0 - t * t)
+        ds = ds.astype(k.dtype)
         dq_acc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -296,7 +304,7 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_off_ref, kv_off_ref, *refs,
-    sm_scale, causal, block_q, block_k, kv_len, q_len, nq, has_segments, window,
+    sm_scale, causal, block_q, block_k, kv_len, q_len, nq, has_segments, window, softcap,
 ):
     if has_segments:
         (q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -340,6 +348,9 @@ def _bwd_dkv_kernel(
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
+        if softcap:
+            t = jnp.tanh(s / softcap)
+            s = softcap * t
         col_local = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
         row_local = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         mask = jnp.logical_and(col_local < kv_len, row_local < q_len)
@@ -359,7 +370,10 @@ def _bwd_dkv_kernel(
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = (p * (dp - delta) * sm_scale).astype(q.dtype)
+        ds = p * (dp - delta) * sm_scale
+        if softcap:  # chain rule through s = cap*tanh(s_raw/cap)
+            ds = ds * (1.0 - t * t)
+        ds = ds.astype(q.dtype)
         dk_acc[:] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
@@ -371,7 +385,7 @@ def _bwd_dkv_kernel(
 
 
 def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
-            q_offset=0, kv_offset=0, segments=None, window=0):
+            q_offset=0, kv_offset=0, segments=None, window=0, softcap=0.0):
     """dq for local q against one kv block (ring building block). GQA (K < H kv heads)
     resolved via the k/v index maps, matching ``_fwd``."""
     B, H, S, hd = q.shape
@@ -397,7 +411,7 @@ def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpr
     kernel = functools.partial(
         _bwd_dq_kernel,
         sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k, kv_len=T,
-        has_segments=has_segments, window=window,
+        has_segments=has_segments, window=window, softcap=softcap,
     )
     dq = pl.pallas_call(
         kernel,
@@ -422,7 +436,7 @@ def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpr
 
 
 def _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
-             q_offset=0, kv_offset=0, segments=None, window=0):
+             q_offset=0, kv_offset=0, segments=None, window=0, softcap=0.0):
     """(dk, dv) [B,K,T,hd] for one kv block against local q (ring building block).
 
     GQA: the inner grid dim runs ``reps * nq`` steps — every (q head in the kv head's
@@ -453,7 +467,7 @@ def _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interp
         _bwd_dkv_kernel,
         sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k,
         kv_len=T, q_len=S, nq=nq,
-        has_segments=has_segments, window=window,
+        has_segments=has_segments, window=window, softcap=softcap,
     )
     dk, dv = pl.pallas_call(
         kernel,
@@ -503,36 +517,37 @@ def _fit_block(block: int, seq: int) -> int:
 # Offsets travel as float32 scalars so the custom_vjp has well-defined (zero) cotangents for
 # them; kernels receive them as int32. This is what lets shard_map callers (ring/allgather SP)
 # pass traced global positions.
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12, 13))
 def _flash_bhsd(q, k, v, q_off, kv_off, seg_f32, causal, sm_scale, block_q, block_k,
-                interpret, has_segments, window):
+                interpret, has_segments, window, softcap):
     segs = seg_f32.astype(jnp.int32) if has_segments else None
     o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
                 q_offset=q_off.astype(jnp.int32), kv_offset=kv_off.astype(jnp.int32),
-                segments=segs, window=window)
+                segments=segs, window=window, softcap=softcap)
     return o
 
 
 def _flash_bhsd_fwd(q, k, v, q_off, kv_off, seg_f32, causal, sm_scale, block_q, block_k,
-                    interpret, has_segments, window):
+                    interpret, has_segments, window, softcap):
     segs = seg_f32.astype(jnp.int32) if has_segments else None
     o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
                   q_offset=q_off.astype(jnp.int32), kv_offset=kv_off.astype(jnp.int32),
-                  segments=segs, window=window)
+                  segments=segs, window=window, softcap=softcap)
     return o, (q, k, v, q_off, kv_off, seg_f32, o, lse)
 
 
 def _flash_bhsd_bwd(causal, sm_scale, block_q, block_k, interpret, has_segments, window,
-                    residuals, do):
+                    softcap, residuals, do):
     q, k, v, q_off, kv_off, seg_f32, o, lse = residuals
     qo = q_off.astype(jnp.int32)
     ko = kv_off.astype(jnp.int32)
     segs = seg_f32.astype(jnp.int32) if has_segments else None
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [B,H,S]
     dq = _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
-                 q_offset=qo, kv_offset=ko, segments=segs, window=window)
+                 q_offset=qo, kv_offset=ko, segments=segs, window=window, softcap=softcap)
     dk, dv = _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
-                      q_offset=qo, kv_offset=ko, segments=segs, window=window)
+                      q_offset=qo, kv_offset=ko, segments=segs, window=window,
+                      softcap=softcap)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
             jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
             jnp.zeros_like(seg_f32))
@@ -557,7 +572,7 @@ def _flash_bhsd_offset(q, k, v, q_offset=0, kv_offset=0, causal=True, sm_scale=N
     o = _flash_bhsd(qT, kT, vT,
                     jnp.asarray(q_offset, jnp.float32), jnp.asarray(kv_offset, jnp.float32),
                     jnp.zeros((1, 1), jnp.float32),
-                    causal, sm_scale, bq, bk, interpret, False, 0)
+                    causal, sm_scale, bq, bk, interpret, False, 0, 0.0)
     return o.transpose(0, 2, 1, 3)
 
 
@@ -572,6 +587,7 @@ def flash_attention(
     interpret: Optional[bool] = None,
     segment_ids: Optional[jax.Array] = None,
     window: int = 0,
+    softcap: float = 0.0,
 ) -> jax.Array:
     """Flash attention over user layout q [B, S, H, hd], k/v [B, T, K, hd] (GQA: K ≤ H).
 
@@ -585,6 +601,10 @@ def flash_attention(
     ``window`` > 0 adds Mistral-style sliding-window masking (position i attends
     (i-window, i]): kv tiles entirely outside the band are SKIPPED, not just masked, so
     long-context compute scales with S·window instead of S².
+
+    ``softcap`` > 0 applies Gemma-style score capping cap·tanh(s/cap) in-kernel, with the
+    exact chain rule (1 − tanh²) in both backward kernels — Gemma-2 trains on the flash
+    path instead of falling back to masked XLA attention.
     """
     B, S, H, hd = q.shape
     K = k.shape[2]
@@ -611,5 +631,5 @@ def flash_attention(
         else jnp.zeros((1, 1), jnp.float32)
     )
     o = _flash_bhsd(qT, kT, vT, zero, zero, seg_f32, causal, sm_scale, block_q, block_k,
-                    interpret, has_segments, int(window))
+                    interpret, has_segments, int(window), float(softcap))
     return o.transpose(0, 2, 1, 3)

@@ -99,6 +99,64 @@ def test_generate_runs():
     assert out.shape == (1, 5)
 
 
+def test_flash_softcap_matches_xla():
+    """The in-kernel score capping (cap·tanh(s/cap), exact (1−t²) backward) must match
+    the masked-XLA reference path — forward and gradients — so Gemma trains on flash."""
+    from accelerate_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(5)
+    S, cap = 64, 3.0  # small cap so the tanh actually bends scores
+    q = jnp.asarray(rng.normal(size=(1, S, 4, 16)) * 2, jnp.float32)
+    k = jnp.asarray(rng.normal(size=(1, S, 2, 16)) * 2, jnp.float32)
+    v = jnp.asarray(rng.normal(size=(1, S, 2, 16)), jnp.float32)
+    scale = 0.37
+
+    def ref(q, k, v):
+        kk = jnp.repeat(k, 2, axis=2)
+        vv = jnp.repeat(v, 2, axis=2)
+        s = jnp.einsum("bshd,bthd->bhst", q, kk) * scale
+        s = cap * jnp.tanh(s / cap)
+        mask = jnp.tril(jnp.ones((S, S), bool))[None, None]
+        s = jnp.where(mask, s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhst,bthd->bshd", p, vv)
+
+    out = flash_attention(q, k, v, causal=True, sm_scale=scale, softcap=cap)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref(q, k, v)), atol=3e-5)
+
+    gf = jax.grad(
+        lambda q, k, v: jnp.sum(
+            flash_attention(q, k, v, causal=True, sm_scale=scale, softcap=cap) ** 2
+        ),
+        argnums=(0, 1, 2),
+    )(q, k, v)
+    gr = jax.grad(lambda q, k, v: jnp.sum(ref(q, k, v) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gf, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-3, err_msg=f"d{name}"
+        )
+
+
+def test_model_flash_equals_xla_with_softcap():
+    """Full Gemma-shaped forward: the flash path (in-kernel capping + banded layers) must
+    equal the masked-XLA path."""
+    cfg = dataclasses.replace(
+        llama.CONFIGS["gemma2-9b"],
+        vocab_size=128, d_model=64, n_layers=4, n_heads=4, n_kv_heads=2, d_ff=128,
+        head_dim_override=16, sliding_window=16, max_seq=128, dtype=jnp.float32,
+        remat=False,
+    )
+    params = llama.init_params(cfg)
+    tokens = jnp.asarray(
+        np.random.default_rng(6).integers(0, cfg.vocab_size, size=(2, 48)), jnp.int32
+    )
+    fl = llama.forward(params, tokens, dataclasses.replace(cfg, attn_impl="flash"),
+                       shard_activations=False)
+    xl = llama.forward(params, tokens, dataclasses.replace(cfg, attn_impl="xla"),
+                       shard_activations=False)
+    np.testing.assert_allclose(np.asarray(fl), np.asarray(xl), atol=2e-4)
+
+
 def test_serving_engine_matches_generate():
     """The continuous batcher's decode step mirrors forward_cached's Gemma knobs
     (embed scale, banded/full alternation, (1+w) ln_f, final soft-cap) — its greedy
