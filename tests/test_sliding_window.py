@@ -122,6 +122,34 @@ def test_cached_decode_matches_uncached_window():
         )
 
 
+def test_mistral_logits_match_transformers():
+    """Mistral == llama keys + sliding window: the llama converter plus
+    cfg.sliding_window must reproduce transformers' MistralForCausalLM logits."""
+    transformers = pytest.importorskip("transformers")
+    import torch
+
+    from accelerate_tpu.models.hf_interop import llama_config_from_hf, llama_from_hf
+
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=256,
+        sliding_window=16, rms_norm_eps=1e-5, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    model = transformers.MistralForCausalLM(hf_cfg).eval()
+    cfg = llama_config_from_hf(
+        hf_cfg, dtype=jnp.float32, remat=False, sliding_window=hf_cfg.sliding_window
+    )
+    params = llama_from_hf(model.state_dict(), cfg)
+    tokens = np.random.default_rng(7).integers(0, hf_cfg.vocab_size, size=(2, 48))
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(tokens)).logits.float().numpy()
+    ours = np.asarray(
+        llama.forward(params, jnp.asarray(tokens, jnp.int32), cfg, shard_activations=False)
+    )
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-4, rtol=1e-3)
+
+
 def test_sliding_window_rejects_sp_modes():
     cfg = dataclasses.replace(CFG, attn_impl="ring")
     params = llama.init_params(cfg)
