@@ -22,6 +22,8 @@ import jax.numpy as jnp
 __all__ = [
     "llama_config_from_hf",
     "llama_from_hf",
+    "qwen2_config_from_hf",
+    "qwen2_from_hf",
     "gemma2_config_from_hf",
     "gemma2_from_hf",
     "gpt2_config_from_hf",
@@ -94,6 +96,31 @@ def llama_from_hf(state_dict: Mapping[str, Any], cfg) -> dict:
     if cfg.scan_layers:
         params["layers"] = _stack_layers(params["layers"])
     return _to_jnp(params)
+
+
+def qwen2_config_from_hf(hf_config: Any, **overrides):
+    """LlamaConfig (qkv_bias set) from a transformers Qwen2Config — Qwen2 is the llama
+    architecture plus biases on the q/k/v projections."""
+    cfg = llama_config_from_hf(hf_config, qkv_bias=True, **overrides)
+    return cfg
+
+
+def qwen2_from_hf(state_dict: Mapping[str, Any], cfg) -> dict:
+    """transformers Qwen2ForCausalLM state dict → ``models.llama`` params pytree
+    (llama layout + per-layer bq/bk/bv)."""
+    params = llama_from_hf(state_dict, cfg)
+    layers = params["layers"]
+    for i in range(cfg.n_layers):
+        p = f"model.layers.{i}.self_attn."
+        bias = {
+            "bq": _np(state_dict[p + "q_proj.bias"]),
+            "bk": _np(state_dict[p + "k_proj.bias"]),
+            "bv": _np(state_dict[p + "v_proj.bias"]),
+        }
+        if cfg.scan_layers:
+            raise NotImplementedError("convert with scan_layers=False, then restack")
+        layers[i].update(_to_jnp(bias))
+    return params
 
 
 def gemma2_config_from_hf(hf_config: Any, **overrides):

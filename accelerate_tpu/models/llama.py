@@ -117,6 +117,8 @@ class LlamaConfig:
     attn_scale: Optional[float] = None  # softmax scale override (query_pre_attn_scalar)
     attn_softcap: float = 0.0   # tanh-cap attention scores (in-kernel on the flash path)
     final_softcap: float = 0.0  # tanh-cap output logits
+    # Qwen2-style biases on the q/k/v projections (o/MLP stay bias-free).
+    qkv_bias: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -157,6 +159,10 @@ CONFIGS = {
         embed_scale=True, attn_scale=224.0**-0.5, attn_softcap=50.0, final_softcap=30.0,
         sliding_window=4096, window_every=2, norm_eps=1e-6,
     ),
+    "qwen2-7b": LlamaConfig(
+        vocab_size=152064, d_model=3584, n_layers=28, n_heads=28, n_kv_heads=4,
+        d_ff=18944, rope_theta=1e6, max_seq=32768, qkv_bias=True, norm_eps=1e-6,
+    ),
     "mixtral-8x7b": LlamaConfig(
         vocab_size=32000, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8, d_ff=14336,
         rope_theta=1e6, max_seq=32768, moe_experts=8, moe_top_k=2,
@@ -186,6 +192,10 @@ def _layer_params(cfg: LlamaConfig, key) -> dict:
     if cfg.post_norm:
         params["ln_attn_post"] = norm_init((D,), jnp.float32)
         params["ln_mlp_post"] = norm_init((D,), jnp.float32)
+    if cfg.qkv_bias:
+        params["bq"] = jnp.zeros((H * hd,), jnp.float32)
+        params["bk"] = jnp.zeros((K * hd,), jnp.float32)
+        params["bv"] = jnp.zeros((K * hd,), jnp.float32)
     if cfg.moe_experts > 0:
         E = cfg.moe_experts
         params["moe"] = {
@@ -249,6 +259,10 @@ def partition_specs(cfg: LlamaConfig, pp: bool = False) -> dict:
     if cfg.post_norm:
         layer["ln_attn_post"] = P()
         layer["ln_mlp_post"] = P()
+    if cfg.qkv_bias:
+        layer["bq"] = P(TENSOR_AXIS)
+        layer["bk"] = P(TENSOR_AXIS)
+        layer["bv"] = P(TENSOR_AXIS)
     if cfg.moe_experts > 0:
         from ..ops.moe import expert_partition_specs
 
@@ -405,14 +419,27 @@ def _mlp_gate_act(h: jax.Array, cfg: LlamaConfig) -> jax.Array:
     raise ValueError(f"mlp_act={cfg.mlp_act!r}: expected 'silu' or 'gelu'")
 
 
+def _qkv_proj(h, layer, cfg: LlamaConfig):
+    """q/k/v projections (+ Qwen2-style biases when ``cfg.qkv_bias``)."""
+    q = _proj(h, layer["wq"], cfg)
+    k = _proj(h, layer["wk"], cfg)
+    v = _proj(h, layer["wv"], cfg)
+    if cfg.qkv_bias:
+        q = q + layer["bq"].astype(q.dtype)
+        k = k + layer["bk"].astype(k.dtype)
+        v = v + layer["bv"].astype(v.dtype)
+    return q, k, v
+
+
 def _block(x, layer, positions, mask, cfg: LlamaConfig, segment_ids=None):
     """One transformer block → (x, moe_aux_loss) (aux is 0.0 for dense MLPs)."""
     B, S, D = x.shape
     p1 = cfg.norm_plus_one
     h = _rms_norm(x, layer["ln_attn"], cfg.norm_eps, p1)
-    q = _proj(h, layer["wq"], cfg).reshape(B, S, cfg.n_heads, cfg.head_dim)
-    k = _proj(h, layer["wk"], cfg).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
-    v = _proj(h, layer["wv"], cfg).reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+    q, k, v = _qkv_proj(h, layer, cfg)
+    q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
+    v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     attn = _attention(q, k, v, mask, cfg, segment_ids).reshape(
@@ -889,9 +916,10 @@ def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig):
     B, T, D = x.shape
     p1 = cfg.norm_plus_one
     h = _rms_norm(x, layer["ln_attn"], cfg.norm_eps, p1)
-    q = _proj(h, layer["wq"], cfg).reshape(B, T, cfg.n_heads, cfg.head_dim)
-    k = _proj(h, layer["wk"], cfg).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-    v = _proj(h, layer["wv"], cfg).reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    q, k, v = _qkv_proj(h, layer, cfg)
+    q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
+    k = k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
+    v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     new_kv = {**_write_cache(kv, "k", k, index), **_write_cache(kv, "v", v, index)}

@@ -36,6 +36,34 @@ def test_llama_logits_match_transformers():
     np.testing.assert_allclose(ours, hf_logits, atol=2e-4, rtol=1e-3)
 
 
+def test_qwen2_logits_match_transformers():
+    """Qwen2 = llama + q/k/v biases: the qwen2 converter must reproduce
+    Qwen2ForCausalLM logits (biases are randomly initialized nonzero by seed)."""
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=64,
+        rms_norm_eps=1e-6, rope_theta=10000.0, tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    # Default-init biases are zeros — randomize so the bias path is actually exercised.
+    with torch.no_grad():
+        for layer in hf_model.model.layers:
+            for proj in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                         layer.self_attn.v_proj):
+                proj.bias.normal_(std=0.5)
+
+    cfg = hf_interop.qwen2_config_from_hf(hf_cfg, dtype=jnp.float32, attn_impl="xla")
+    assert cfg.qkv_bias
+    params = hf_interop.qwen2_from_hf(hf_model.state_dict(), cfg)
+
+    tokens = np.random.default_rng(3).integers(0, 128, size=(2, 12)).astype(np.int32)
+    with torch.no_grad():
+        hf_logits = hf_model(torch.from_numpy(tokens.astype(np.int64))).logits.numpy()
+    ours = np.asarray(llama.forward(params, jnp.asarray(tokens), cfg, shard_activations=False))
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-4, rtol=1e-3)
+
+
 @slow
 def test_llama_generate_from_hf_weights():
     hf_cfg = transformers.LlamaConfig(
