@@ -48,6 +48,31 @@ class GenerationConfig:
     pad_token_id: int = 0
 
 
+def filtered_logits(logits: jax.Array, temperature, top_p, top_k: int,
+                    apply_top_p: bool = True) -> jax.Array:
+    """Temperature / top-k / top-p filtered logits [.., V] fp32 (filtered entries -inf).
+
+    The single source of sampling semantics: ``sampling_core`` draws categorically from
+    these, and speculative sampling compares softmax(filtered) between draft and target —
+    sharing this function is what makes the speculative output distribution provably the
+    target's."""
+    logits = logits.astype(jnp.float32) / temperature
+    if top_k > 0:
+        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if apply_top_p:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep the smallest prefix with cumulative prob >= top_p (always keep the best token).
+        keep_sorted = cum - probs < top_p
+        threshold = jnp.min(
+            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+        )
+        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    return logits
+
+
 def sampling_core(logits: jax.Array, rng: jax.Array, temperature, top_p, top_k: int,
                   apply_top_p: bool = True) -> jax.Array:
     """Temperature / top-k / top-p draw with SCALAR-traceable temperature/top_p (only the
@@ -59,21 +84,33 @@ def sampling_core(logits: jax.Array, rng: jax.Array, temperature, top_p, top_k: 
     softmax/cumsum per token): callers whose top_p is a static 1.0 skip the cost — and the
     float hazard where a cumsum prefix rounds to exactly 1.0 and masks live tail tokens.
     The serving engine keeps it on (its per-request top_p is traced)."""
-    logits = logits.astype(jnp.float32) / temperature
-    if top_k > 0:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
-        logits = jnp.where(logits < kth, -jnp.inf, logits)
-    if apply_top_p:
-        sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(sorted_logits, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # Keep the smallest prefix with cumulative prob >= top_p (always keep the best token).
-        keep_sorted = cum - probs < top_p
-        threshold = jnp.min(
-            jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
-        )
-        logits = jnp.where(logits < threshold, -jnp.inf, logits)
+    logits = filtered_logits(logits, temperature, top_p, top_k, apply_top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def speculative_accept(p_probs: jax.Array, q_probs: jax.Array, draft_token,
+                       key: jax.Array):
+    """One speculative-sampling accept/reject (Leviathan et al. 2022): the draft proposed
+    ``draft_token`` from q; the target distribution is p. Accept with min(1, p/q); on
+    rejection return a token from the residual norm(max(p − q, 0)). The marginal output
+    distribution is EXACTLY p — asserted distributionally in tests.
+
+    Returns (accepted bool[], token int32[]) as 0-d arrays; jit/vmap-friendly."""
+    p_probs = p_probs.astype(jnp.float32)
+    q_probs = q_probs.astype(jnp.float32)
+    k_accept, k_resid = jax.random.split(key)
+    p_tok = p_probs[draft_token]
+    q_tok = q_probs[draft_token]
+    ratio = p_tok / jnp.maximum(q_tok, 1e-30)
+    accepted = jax.random.uniform(k_accept) < jnp.minimum(1.0, ratio)
+    residual = jnp.maximum(p_probs - q_probs, 0.0)
+    # On acceptance the residual draw is unused; guard the degenerate all-zero residual
+    # (p == q exactly) so categorical never sees -inf everywhere.
+    denom = jnp.sum(residual)
+    safe = jnp.where(denom > 0, residual / jnp.maximum(denom, 1e-30), p_probs)
+    resid_tok = jax.random.categorical(k_resid, jnp.log(jnp.maximum(safe, 1e-30)))
+    token = jnp.where(accepted, draft_token, resid_tok).astype(jnp.int32)
+    return accepted, token
 
 
 def sample_logits(logits: jax.Array, gen: GenerationConfig, rng: Optional[jax.Array]) -> jax.Array:

@@ -1182,6 +1182,18 @@ def _spec_forward_jit(params, tokens, cache, cfg):
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
 
+@partial(jax.jit, static_argnames=("cfg", "gen"), donate_argnums=(2,))
+def _spec_probs_jit(params, tokens, cache, cfg, gen):
+    """forward_cached + the SAME temperature/top-k/top-p filtering ``generate`` samples
+    from, as per-position probability rows [B, T, V] — speculative sampling's accept test
+    compares draft and target over these exact distributions."""
+    from ..generation import filtered_logits
+
+    logits, cache = forward_cached(params, tokens, cache, cfg)
+    fl = filtered_logits(logits, gen.temperature, gen.top_p, gen.top_k, gen.top_p < 1.0)
+    return jax.nn.softmax(fl, axis=-1), cache
+
+
 def generate_speculative(
     target_params: dict,
     target_cfg: LlamaConfig,
@@ -1193,13 +1205,20 @@ def generate_speculative(
     eos_token_id: Optional[int] = None,
     prompt_mask: Optional[jax.Array] = None,
     return_stats: bool = False,
+    gen=None,
+    rng: Optional[jax.Array] = None,
 ):
-    """Greedy speculative decoding: ONE target dispatch per round verifies the pending
-    token plus ``k-1`` draft proposals and emits 1..k tokens (accepted prefix + the
-    target's correction). Output is PROVABLY identical to the target's plain greedy decode
-    (tested token-for-token); the draft only changes how many target forwards it takes.
-    The reference has no speculative path. Single sequence (B=1): speculation is a latency
-    tool for individual streams; batch throughput is ``serving.ContinuousBatcher``.
+    """Speculative decoding: ONE target dispatch per round verifies the pending token
+    plus ``k-1`` draft proposals and emits 1..k tokens (accepted prefix + the target's
+    correction). Greedy by default — output PROVABLY identical to the target's plain
+    greedy decode (tested token-for-token). With a ``GenerationConfig`` whose
+    ``temperature > 0`` (plus ``rng``), it runs LOSSLESS SPECULATIVE SAMPLING (Leviathan
+    et al. 2022): each proposal is accepted with min(1, p/q) and rejections re-draw from
+    the residual norm(max(p − q, 0)), so the output distribution is exactly the target's
+    own temperature/top-k/top-p sampling distribution (``generation.speculative_accept``;
+    distribution asserted in tests). The draft only changes how many target forwards it
+    takes. The reference has no speculative path. Single sequence (B=1): speculation is a
+    latency tool for individual streams; batch throughput is ``serving.ContinuousBatcher``.
 
     Round invariant: both caches hold the emitted sequence EXCEPT the newest token
     (``pending``), which rides as the first input of the next round's forwards — so the
@@ -1209,10 +1228,20 @@ def generate_speculative(
     ``return_stats=True`` also returns ``{"rounds", "target_dispatches", "tokens"}``
     (dispatches = rounds + 1 prefill) for tokens-per-dispatch accounting.
     """
+    from ..generation import sample_logits, speculative_accept
+
     if target_cfg.vocab_size != draft_cfg.vocab_size:
         raise ValueError("draft and target must share a vocabulary")
     if k < 2:
         raise ValueError("k must be >= 2 (k-1 draft proposals per round)")
+    sampled = gen is not None and gen.temperature > 0.0
+    if sampled and rng is None:
+        raise ValueError("speculative sampling (gen.temperature > 0) needs an rng key")
+    _key_n = [0]
+
+    def next_key():
+        _key_n[0] += 1
+        return jax.random.fold_in(rng, _key_n[0])
     prompt = jnp.asarray(prompt, jnp.int32)
     if prompt.ndim == 1:
         prompt = prompt[None]
@@ -1238,7 +1267,10 @@ def generate_speculative(
         draft_params, prompt, d_cache, draft_cfg, token_mask=prompt_mask, last_only=True
     )
     # ``pending``: emitted but not yet written to either cache.
-    pending = int(np.asarray(jnp.argmax(t_logits[0, -1])))
+    if sampled:
+        pending = int(np.asarray(sample_logits(t_logits[:, -1, :], gen, next_key()))[0])
+    else:
+        pending = int(np.asarray(jnp.argmax(t_logits[0, -1])))
     out: list[int] = [pending]
     rounds = 0
 
@@ -1257,28 +1289,63 @@ def generate_speculative(
         rounds += 1
         # 1. draft k-1 proposals; the draft's first input is the pending token itself.
         drafts: list[int] = []
+        q_rows = []  # sampled mode: the draft's filtered distribution per proposal
         tok = pending
         for _ in range(k - 1):
-            nxt, d_cache = _spec_forward_jit(
-                draft_params, jnp.asarray([[tok]], jnp.int32), d_cache, cfg=draft_cfg
-            )
-            tok = int(np.asarray(nxt[0, -1]))
+            if sampled:
+                qp, d_cache = _spec_probs_jit(
+                    draft_params, jnp.asarray([[tok]], jnp.int32), d_cache,
+                    cfg=draft_cfg, gen=gen,
+                )
+                q_rows.append(qp[0, -1])
+                tok = int(np.asarray(jax.random.categorical(
+                    next_key(), jnp.log(jnp.maximum(qp[0, -1], 1e-30))
+                )))
+            else:
+                nxt, d_cache = _spec_forward_jit(
+                    draft_params, jnp.asarray([[tok]], jnp.int32), d_cache, cfg=draft_cfg
+                )
+                tok = int(np.asarray(nxt[0, -1]))
             drafts.append(tok)
         base_t = int(np.asarray(t_cache["index"]))      # emitted length - 1 (pending unwritten)
         base_d = int(np.asarray(d_cache["index"])) - (k - 1)  # draft wrote pending + drafts[:-1]
-        # 2. ONE target dispatch (T=k): verify pending + ALL proposals. ys[i] is the
-        # target's token after input i — ys[n] checks drafts[n] for n < k-1, and ys[k-1]
-        # (after the last proposal) is the bonus correction on full acceptance.
-        ys, t_cache = _spec_forward_jit(
-            target_params, jnp.asarray([[pending, *drafts]], jnp.int32), t_cache,
-            cfg=target_cfg,
-        )
-        ys = np.asarray(ys[0]).tolist()
-        # 3. accept the longest prefix of proposals agreeing with the target.
-        n = 0
-        while n < k - 1 and drafts[n] == ys[n]:
-            n += 1
-        emitted = drafts[:n] + [ys[n]]  # correction ys[n] becomes the new pending token
+        # 2. ONE target dispatch (T=k): verify pending + ALL proposals. Position i of the
+        # output is the target's prediction after input i — it checks drafts[i] for
+        # i < k-1, and position k-1 (after the last proposal) backs the bonus token on
+        # full acceptance.
+        if sampled:
+            pp, t_cache = _spec_probs_jit(
+                target_params, jnp.asarray([[pending, *drafts]], jnp.int32), t_cache,
+                cfg=target_cfg, gen=gen,
+            )
+            # 3. stochastic prefix acceptance: accept proposal n w.p. min(1, p/q);
+            # first rejection re-draws from the residual and ends the round.
+            n = 0
+            correction = None
+            while n < k - 1:
+                acc, token = speculative_accept(
+                    pp[0, n], q_rows[n], drafts[n], next_key()
+                )
+                if not bool(np.asarray(acc)):
+                    correction = int(np.asarray(token))
+                    break
+                n += 1
+            if correction is None:  # full acceptance: bonus token from the target's own row
+                correction = int(np.asarray(jax.random.categorical(
+                    next_key(), jnp.log(jnp.maximum(pp[0, k - 1], 1e-30))
+                )))
+        else:
+            ys, t_cache = _spec_forward_jit(
+                target_params, jnp.asarray([[pending, *drafts]], jnp.int32), t_cache,
+                cfg=target_cfg,
+            )
+            ys = np.asarray(ys[0]).tolist()
+            # 3. accept the longest prefix of proposals agreeing with the target.
+            n = 0
+            while n < k - 1 and drafts[n] == ys[n]:
+                n += 1
+            correction = ys[n]
+        emitted = drafts[:n] + [correction]  # correction becomes the new pending token
         # 4. rewind to written-emitted length: target wrote pending+accepted (base_t+1+n);
         # draft wrote the same prefix (its extra proposal writes are invalidated).
         t_cache = _cache_rewind(t_cache, base_t + 1 + n)
