@@ -81,6 +81,8 @@ def main() -> int:
     p.add_argument("--offload-dir", default="/tmp/accel_tpu_offload")
     p.add_argument("--checkpoint", default=None, help="safetensors dir (else random init)")
     p.add_argument("--smoke", action="store_true", help="tiny shapes (CI / CPU)")
+    p.add_argument("--kv-quant", action="store_true",
+                   help="int8 KV cache (half the decode cache bytes; in-HBM path only)")
     p.add_argument("--markdown", action="store_true", help="append a row to results.md")
     args = p.parse_args()
 
@@ -107,6 +109,10 @@ def main() -> int:
 
     dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
     cfg = dataclasses.replace(mod.CONFIGS[model], dtype=dtype)
+    if args.kv_quant:
+        if family == "t5":
+            raise SystemExit("--kv-quant applies to the decoder families (gpt/llama)")
+        cfg = dataclasses.replace(cfg, kv_quant=True)
     n_params = mod.num_params(cfg)
     bytes_per = 2 if args.dtype == "bf16" else 4
     param_gb = n_params * bytes_per / 2**30
@@ -190,6 +196,7 @@ def main() -> int:
         "params_b": round(n_params / 1e9, 2),
         "dtype": args.dtype,
         "offload": offload,
+        "kv_quant": bool(args.kv_quant),
         "load_s": round(load_s, 2),
         "s_per_token": round(s_per_token, 4),
         "first_call_s": round(first_s, 2),
