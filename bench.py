@@ -263,7 +263,11 @@ def run(B: int, S: int, fuse: int, preset: str | None):
         out["preset"] = preset
     print(json.dumps(out))
     _RESULT_PRINTED.set()
-    if not preset and jax.default_backend() != "cpu":
+    import os as _os
+
+    if not preset and jax.default_backend() != "cpu" and _os.environ.get(
+        "BENCH_NO_SELF_RECORD"
+    ) != "1":
         # Persist the real-chip result for _fail_json's last-known-good fallback.
         import datetime
         import os

@@ -70,7 +70,10 @@ def run_config(name: str, env_over: dict, per_run_timeout: float) -> dict:
            # Each sweep row must measure EXACTLY its own one-knob delta: without this,
            # bench's auto-adoption would re-read the sweep's partial output and silently
            # hybridize later configs with the best-so-far row's env.
-           "BENCH_AUTO_BEST": "0"}
+           "BENCH_AUTO_BEST": "0",
+           # Sweep rows must not stomp BENCH_SELF.json (the last-known-good fallback):
+           # a worse row sharing the default label would silently understate it.
+           "BENCH_NO_SELF_RECORD": "1"}
     t0 = time.time()
     try:
         out = subprocess.run(
