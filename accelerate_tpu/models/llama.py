@@ -559,14 +559,44 @@ def forward_hidden(
         idx = jnp.arange(S, dtype=jnp.int32)
         mask = mask & (idx[None, :] > idx[:, None] - cfg.sliding_window)[None]
 
-    if cfg.sliding_window and cfg.window_every > 1 and cfg.scan_layers:
-        raise NotImplementedError(
-            "window_every > 1 (alternating banded/full layers) requires scan_layers=False"
-        )
     block = _maybe_remat_block(cfg)
 
     aux_total = jnp.zeros((), jnp.float32)
-    if cfg.scan_layers:
+    alternating = bool(cfg.sliding_window) and cfg.window_every > 1
+    if cfg.scan_layers and alternating:
+        # Gemma-2 style alternation under scan: group ``window_every`` consecutive layers
+        # into one scan body (layer j of a group is banded iff j == 0 — global index
+        # g·per + j keeps j's parity). Compile time stays O(group), not O(L).
+        per = cfg.window_every
+        if cfg.n_layers % per:
+            raise ValueError(
+                f"window_every={per} must divide n_layers={cfg.n_layers} under scan_layers"
+            )
+        full_cfg = dataclasses.replace(cfg, sliding_window=0)
+        grouped = jax.tree_util.tree_map(
+            lambda a: a.reshape(cfg.n_layers // per, per, *a.shape[1:]), params["layers"]
+        )
+
+        def scan_body(carry, group):
+            out = carry
+            aux_g = jnp.zeros((), jnp.float32)
+            for j in range(per):
+                layer_j = jax.tree_util.tree_map(lambda a, j=j: a[j], group)
+                banded = j == 0
+                out, aux_j = block(
+                    out, layer_j, positions,
+                    mask if banded else full_mask,
+                    cfg if banded else full_cfg,
+                    segment_ids,
+                )
+                if shard_activations:
+                    out = _maybe_shard(out, P(BATCH_AXES, SEQUENCE_AXIS, None))
+                aux_g = aux_g + aux_j
+            return out, aux_g
+
+        x, auxes = jax.lax.scan(scan_body, x, grouped, unroll=cfg.scan_unroll)
+        aux_total = jnp.sum(auxes)
+    elif cfg.scan_layers:
         def scan_body(carry, layer):
             out, aux = block(carry, layer, positions, mask, cfg, segment_ids)
             if shard_activations:
@@ -996,11 +1026,40 @@ def forward_cached(
     x = params["embed"].astype(dtype)[tokens]
     if cfg.embed_scale:
         x = x * jnp.asarray(math.sqrt(cfg.d_model), dtype)
-    if cfg.sliding_window and cfg.window_every > 1 and cfg.scan_layers:
-        raise NotImplementedError(
-            "window_every > 1 (alternating banded/full layers) requires scan_layers=False"
+    alternating = bool(cfg.sliding_window) and cfg.window_every > 1
+    if cfg.scan_layers and alternating:
+        # Same grouped scan as forward_hidden: layer j of each group is banded iff j == 0.
+        per = cfg.window_every
+        if cfg.n_layers % per:
+            raise ValueError(
+                f"window_every={per} must divide n_layers={cfg.n_layers} under scan_layers"
+            )
+        full_cfg = dataclasses.replace(cfg, sliding_window=0)
+        regroup = lambda a: a.reshape(cfg.n_layers // per, per, *a.shape[1:])  # noqa: E731
+        grouped = jax.tree_util.tree_map(
+            regroup, (params["layers"], cache["layers"])
         )
-    if cfg.scan_layers:
+
+        def scan_body(carry, group):
+            layers_g, kv_g = group
+            out = carry
+            new_kvs = []
+            for j in range(per):
+                layer_j = jax.tree_util.tree_map(lambda a, j=j: a[j], layers_g)
+                kv_j = jax.tree_util.tree_map(lambda a, j=j: a[j], kv_g)
+                out, new_kv = _block_cached(
+                    out, layer_j, kv_j, index, positions, valid,
+                    cfg if j == 0 else full_cfg,
+                )
+                new_kvs.append(new_kv)
+            stacked = jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *new_kvs)
+            return out, stacked
+
+        x, new_grouped = jax.lax.scan(scan_body, x, grouped)
+        new_layers = jax.tree_util.tree_map(
+            lambda a: a.reshape(cfg.n_layers, *a.shape[2:]), new_grouped
+        )
+    elif cfg.scan_layers:
         def scan_body(carry, layer_and_kv):
             layer, kv = layer_and_kv
             out, new_kv = _block_cached(carry, layer, kv, index, positions, valid, cfg)

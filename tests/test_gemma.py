@@ -99,6 +99,43 @@ def test_generate_runs():
     assert out.shape == (1, 5)
 
 
+def test_scan_layers_matches_loop_with_alternating_windows():
+    """Gemma under scan_layers: the grouped pair-scan (banded layer + full layer per scan
+    step) must equal the python-loop stack — forward and cached decode."""
+    base = dataclasses.replace(
+        llama.CONFIGS["gemma2-9b"],
+        vocab_size=128, d_model=64, n_layers=4, n_heads=4, n_kv_heads=2, d_ff=128,
+        head_dim_override=16, sliding_window=8, max_seq=128, dtype=jnp.float32,
+        remat=False,
+    )
+    loop_cfg = dataclasses.replace(base, scan_layers=False)
+    scan_cfg = dataclasses.replace(base, scan_layers=True)
+    loop_params = llama.init_params(loop_cfg, jax.random.PRNGKey(3))
+    scan_params = dict(loop_params)
+    scan_params["layers"] = jax.tree_util.tree_map(
+        lambda *ls: jnp.stack(ls), *loop_params["layers"]
+    )
+    tokens = jnp.asarray(
+        np.random.default_rng(4).integers(0, base.vocab_size, size=(2, 24)), jnp.int32
+    )
+    out_loop = llama.forward(loop_params, tokens, loop_cfg, shard_activations=False)
+    out_scan = llama.forward(scan_params, tokens, scan_cfg, shard_activations=False)
+    np.testing.assert_allclose(np.asarray(out_scan), np.asarray(out_loop), atol=2e-5)
+
+    cache = llama.init_cache(scan_cfg, 2, 64)
+    logits_c, cache = llama.forward_cached(scan_params, tokens, cache, scan_cfg)
+    np.testing.assert_allclose(np.asarray(logits_c), np.asarray(out_loop), atol=3e-4)
+    nxt = jnp.argmax(out_loop[:, -1:], axis=-1).astype(jnp.int32)
+    logits_c2, _ = llama.forward_cached(scan_params, nxt, cache, scan_cfg)
+    full2 = llama.forward(
+        loop_params, jnp.concatenate([tokens, nxt], axis=1), loop_cfg,
+        shard_activations=False,
+    )
+    np.testing.assert_allclose(
+        np.asarray(logits_c2[:, -1]), np.asarray(full2[:, -1]), atol=3e-4
+    )
+
+
 def test_flash_softcap_matches_xla():
     """The in-kernel score capping (cap·tanh(s/cap), exact (1−t²) backward) must match
     the masked-XLA reference path — forward and gradients — so Gemma trains on flash."""
