@@ -119,6 +119,14 @@ class LlamaConfig:
     final_softcap: float = 0.0  # tanh-cap output logits
     # Qwen2-style biases on the q/k/v projections (o/MLP stay bias-free).
     qkv_bias: bool = False
+    # RoPE frequency scaling for context extension. "llama3" = the Llama-3.1 scheme
+    # (per-band scaling: high-frequency bands kept, low-frequency bands divided by
+    # ``rope_scaling_factor``, smooth ramp between) — required to load 3.1+ checkpoints.
+    rope_scaling: Optional[str] = None
+    rope_scaling_factor: float = 8.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max: int = 8192
 
     @property
     def head_dim(self) -> int:
@@ -132,6 +140,10 @@ class LlamaConfig:
 CONFIGS = {
     "llama3-8b": LlamaConfig(
         vocab_size=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8, d_ff=14336
+    ),
+    "llama3.1-8b": LlamaConfig(
+        vocab_size=128256, d_model=4096, n_layers=32, n_heads=32, n_kv_heads=8,
+        d_ff=14336, max_seq=131072, rope_scaling="llama3",
     ),
     "llama3-70b": LlamaConfig(
         vocab_size=128256, d_model=8192, n_layers=80, n_heads=64, n_kv_heads=8, d_ff=28672
@@ -325,10 +337,36 @@ def _rms_norm(x: jax.Array, gamma: jax.Array, eps: float, plus_one: bool = False
     return (normed * g).astype(x.dtype)
 
 
-def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+def _rope_freqs(cfg: LlamaConfig, hd: int) -> jax.Array:
+    """Per-band inverse wavelengths, with optional Llama-3.1 context-extension scaling."""
+    freqs = 1.0 / (cfg.rope_theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    if cfg.rope_scaling is None:
+        return freqs
+    if cfg.rope_scaling != "llama3":
+        raise ValueError(f"rope_scaling={cfg.rope_scaling!r}: expected None or 'llama3'")
+    factor = cfg.rope_scaling_factor
+    low_wl = cfg.rope_original_max / cfg.rope_low_freq_factor
+    high_wl = cfg.rope_original_max / cfg.rope_high_freq_factor
+    wavelen = 2.0 * math.pi / freqs
+    smooth = (cfg.rope_original_max / wavelen - cfg.rope_low_freq_factor) / (
+        cfg.rope_high_freq_factor - cfg.rope_low_freq_factor
+    )
+    scaled = jnp.where(
+        wavelen > low_wl,
+        freqs / factor,  # long-wavelength (low-freq) bands: fully scaled
+        jnp.where(
+            wavelen < high_wl,
+            freqs,  # short-wavelength bands: untouched
+            (1.0 - smooth) * freqs / factor + smooth * freqs,  # smooth ramp between
+        ),
+    )
+    return scaled
+
+
+def _rope(x: jax.Array, positions: jax.Array, cfg: LlamaConfig) -> jax.Array:
     """Rotary embedding: x [B, S, H, hd], positions [B, S]."""
     hd = x.shape[-1]
-    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    freqs = _rope_freqs(cfg, hd)
     angles = positions[..., None].astype(jnp.float32) * freqs  # [B, S, hd/2]
     cos = jnp.cos(angles)[:, :, None, :]
     sin = jnp.sin(angles)[:, :, None, :]
@@ -440,8 +478,8 @@ def _block(x, layer, positions, mask, cfg: LlamaConfig, segment_ids=None):
     q = q.reshape(B, S, cfg.n_heads, cfg.head_dim)
     k = k.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
     v = v.reshape(B, S, cfg.n_kv_heads, cfg.head_dim)
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
+    q = _rope(q, positions, cfg)
+    k = _rope(k, positions, cfg)
     attn = _attention(q, k, v, mask, cfg, segment_ids).reshape(
         B, S, cfg.n_heads * cfg.head_dim
     )
@@ -950,8 +988,8 @@ def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig):
     q = q.reshape(B, T, cfg.n_heads, cfg.head_dim)
     k = k.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
     v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
-    q = _rope(q, positions, cfg.rope_theta)
-    k = _rope(k, positions, cfg.rope_theta)
+    q = _rope(q, positions, cfg)
+    k = _rope(k, positions, cfg)
     new_kv = {**_write_cache(kv, "k", k, index), **_write_cache(kv, "v", v, index)}
     attn = _attention_cached(
         q, _read_cache(new_kv, "k", cfg.dtype), _read_cache(new_kv, "v", cfg.dtype),

@@ -36,6 +36,31 @@ def test_llama_logits_match_transformers():
     np.testing.assert_allclose(ours, hf_logits, atol=2e-4, rtol=1e-3)
 
 
+def test_llama31_rope_scaling_logits_match_transformers():
+    """Llama-3.1 rope scaling: positions past the ramp regions must match transformers'
+    per-band scaled frequencies exactly."""
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=128, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, max_position_embeddings=256,
+        rms_norm_eps=1e-5, rope_theta=10000.0, tie_word_embeddings=False,
+        rope_scaling={
+            "rope_type": "llama3", "factor": 8.0, "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0, "original_max_position_embeddings": 64,
+        },
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.LlamaForCausalLM(hf_cfg).eval()
+    cfg = hf_interop.llama_config_from_hf(hf_cfg, dtype=jnp.float32, attn_impl="xla")
+    assert cfg.rope_scaling == "llama3" and cfg.rope_original_max == 64
+    params = hf_interop.llama_from_hf(hf_model.state_dict(), cfg)
+    # Longer than original_max so the scaled low-frequency bands actually matter.
+    tokens = np.random.default_rng(9).integers(0, 128, size=(2, 96)).astype(np.int32)
+    with torch.no_grad():
+        hf_logits = hf_model(torch.from_numpy(tokens.astype(np.int64))).logits.numpy()
+    ours = np.asarray(llama.forward(params, jnp.asarray(tokens), cfg, shard_activations=False))
+    np.testing.assert_allclose(ours, hf_logits, atol=3e-4, rtol=1e-3)
+
+
 def test_qwen2_logits_match_transformers():
     """Qwen2 = llama + q/k/v biases: the qwen2 converter must reproduce
     Qwen2ForCausalLM logits (biases are randomly initialized nonzero by seed)."""
