@@ -42,9 +42,9 @@ def estimate_command_parser(subparsers=None) -> argparse.ArgumentParser:
 
 def _registry_model_sizes(name: str):
     """(total_bytes_fp32, largest_layer_bytes_fp32) from the in-repo model registry."""
-    from ..models import gpt, llama
+    from ..models import gpt, llama, t5
 
-    for family in (llama, gpt):
+    for family in (llama, gpt, t5):
         if name in family.CONFIGS:
             import jax
 
