@@ -156,6 +156,17 @@ def _prefill_jit(params, row, mask, cfg, max_len: int):
     return jnp.argmax(last, axis=-1).astype(jnp.int32), last, cache
 
 
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
+def _prefill_chunk_jit(params, row, mask, cache, cfg):
+    """Chunked prefill continuation: append one bucket-width chunk to an existing row
+    cache. One compiled executable serves every chunk of every long prompt."""
+    logits, cache = llama.forward_cached(
+        params, row, cache, cfg, token_mask=mask, last_only=True
+    )
+    last = logits[:, -1, :]
+    return jnp.argmax(last, axis=-1).astype(jnp.int32), last, cache
+
+
 class ContinuousBatcher:
     """Continuous-batching decode over ``max_slots`` shared lanes (greedy or sampled
     per request).
@@ -203,14 +214,17 @@ class ContinuousBatcher:
                 max_new_tokens=32 if max_new_tokens is None else max_new_tokens,
                 temperature=0.0, eos_token_id=eos_token_id,
             )
-        if len(prompt) > self.prompt_bucket:
-            raise ValueError(
-                f"prompt of {len(prompt)} tokens exceeds prompt_bucket={self.prompt_bucket}"
-            )
         if gen.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the prefill emits the first token)")
-        if self.prompt_bucket + gen.max_new_tokens > self.max_len:
-            raise ValueError("prompt_bucket + max_new_tokens exceeds max_len")
+        # Long prompts prefill in bucket-width chunks (one shared compiled program);
+        # the request just needs its chunks + generation budget to fit the cache.
+        n_chunks = max(1, -(-len(prompt) // self.prompt_bucket))
+        if n_chunks * self.prompt_bucket + gen.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt ({len(prompt)} tokens → {n_chunks} chunks of "
+                f"{self.prompt_bucket}) + max_new_tokens={gen.max_new_tokens} exceeds "
+                f"max_len={self.max_len}"
+            )
         if gen.temperature > 0.0 and rng is None:
             raise ValueError("temperature sampling needs a per-request rng key")
         req = Request(self._uid, prompt, gen, rng)
@@ -274,7 +288,7 @@ class ContinuousBatcher:
             # the inner loop per slot, and such requests are reported like any other.
             while self.slot_req[slot] is None and self.queue:
                 req = self.queue.popleft()
-                row_cache, greedy_dev, logits_dev = self._prefill(req.prompt)
+                row_cache, greedy_dev, logits_dev, prefill_len = self._prefill(req.prompt)
                 first = (
                     int(np.asarray(greedy_dev)[0])       # fused on-device argmax (4 bytes)
                     if req.gen.temperature <= 0.0
@@ -282,7 +296,7 @@ class ContinuousBatcher:
                 )
                 self.cache = _insert_row(self.cache, row_cache, slot=slot, scan_layers=self.cfg.scan_layers)
                 self.slot_req[slot] = req
-                self.positions[slot] = self.prompt_bucket  # next write = first decode slot
+                self.positions[slot] = prefill_len  # next write = first decode slot
                 self.tokens[slot] = first
                 req.tokens.append(int(first))
                 hit_eos = req.gen.eos_token_id is not None and int(first) == req.gen.eos_token_id
@@ -293,16 +307,26 @@ class ContinuousBatcher:
         return finished
 
     def _prefill(self, prompt: np.ndarray):
-        """Left-padded single-row prefill at the bucket width → (cache row, on-device
-        greedy token [1], on-device logits row [1, V]).
-        Compiled: one executable per (cfg, bucket width, max_len)."""
-        pad = self.prompt_bucket - len(prompt)
-        row = np.zeros((1, self.prompt_bucket), np.int32)
+        """Left-padded single-row prefill in bucket-width chunks → (cache row, on-device
+        greedy token [1], on-device logits row [1, V], written length).
+        Compiled: one bucket-width executable per (cfg, max_len) plus one shared
+        chunk-append executable — a 10-chunk prompt compiles nothing new."""
+        bucket = self.prompt_bucket
+        n_chunks = max(1, -(-len(prompt) // bucket))
+        total = n_chunks * bucket
+        pad = total - len(prompt)
+        row = np.zeros((1, total), np.int32)
         row[0, pad:] = prompt
-        mask = np.zeros((1, self.prompt_bucket), bool)
+        mask = np.zeros((1, total), bool)
         mask[0, pad:] = True
         greedy, logits, cache = _prefill_jit(
-            self.params, jnp.asarray(row), jnp.asarray(mask), cfg=self.cfg,
-            max_len=self.max_len,
+            self.params, jnp.asarray(row[:, :bucket]), jnp.asarray(mask[:, :bucket]),
+            cfg=self.cfg, max_len=self.max_len,
         )
-        return cache, greedy, logits
+        for c in range(1, n_chunks):
+            sl = slice(c * bucket, (c + 1) * bucket)
+            greedy, logits, cache = _prefill_chunk_jit(
+                self.params, jnp.asarray(row[:, sl]), jnp.asarray(mask[:, sl]), cache,
+                cfg=self.cfg,
+            )
+        return cache, greedy, logits, total

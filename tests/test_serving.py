@@ -71,10 +71,26 @@ def test_eos_frees_slot(setup):
 
 
 def test_oversized_prompt_rejected(setup):
+    """Long prompts chunk-prefill, so rejection only happens when chunks + generation
+    budget exceed the cache length."""
     params, _ = setup
     engine = ContinuousBatcher(params, CFG, max_slots=1, max_len=64, prompt_bucket=8)
     with pytest.raises(ValueError):
-        engine.submit(np.arange(1, 20, dtype=np.int32), max_new_tokens=4)
+        engine.submit(np.arange(1, 62, dtype=np.int32) % CFG.vocab_size,
+                      max_new_tokens=4)  # 8 chunks * 8 + 4 > 64
+
+
+def test_long_prompt_chunked_prefill_matches_generate(setup):
+    """A prompt spanning 2.5 buckets prefills through the shared chunk-append executable
+    and must still equal the standalone greedy decode."""
+    params, _ = setup
+    rng = np.random.default_rng(42)
+    prompt = rng.integers(1, CFG.vocab_size, 20).astype(np.int32)  # 2.5 buckets of 8
+    engine = ContinuousBatcher(params, CFG, max_slots=2, max_len=64, prompt_bucket=8)
+    req = engine.submit(prompt, max_new_tokens=6)
+    engine.run()
+    assert req.done
+    assert req.tokens == reference_greedy(params, prompt, 6)
 
 
 def test_scan_layers_variant(setup):
