@@ -1,0 +1,50 @@
+#!/bin/bash
+# One TPU work session, run the moment the chip answers (benchmarks/mfu_sweep.py
+# --wait-for-tpu does the polling). Order = value per chip-minute:
+#   1. flash kernel compile sanity (new GQA/window/softcap grids must pass Mosaic)
+#   2. re-baseline bench (new defaults) -> BENCH_SELF refresh
+#   3. the highest-leverage sweep rows (remat/batch/unroll combos)
+#   4. perf decomposition
+#   5. the remaining tuning rows
+# Every stage tolerates the tunnel dying mid-way: each is its own subprocess with a
+# timeout, and the sweep segments re-poll before each row.
+set -u
+cd "$(dirname "$0")/.."
+
+echo "=== waiting for TPU ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+
+echo "=== 1. flash compile sanity ==="
+timeout 420 python - <<'EOF'
+import numpy as np, jax, jax.numpy as jnp
+from accelerate_tpu.ops.flash_attention import flash_attention
+q = jnp.ones((1, 512, 16, 128), jnp.bfloat16)
+k = jnp.ones((1, 512, 8, 128), jnp.bfloat16)
+v = jnp.ones((1, 512, 8, 128), jnp.bfloat16)
+o = flash_attention(q, k, v, causal=True)
+print("fwd ok", float(np.asarray(o.astype(jnp.float32))[0, -1, 0, 0]))
+g = jax.grad(lambda q, k, v: flash_attention(q, k, v, causal=True).astype(jnp.float32).sum(), argnums=(0, 1, 2))(q, k, v)
+print("bwd ok", float(np.asarray(g[1].astype(jnp.float32)).sum()))
+o2 = flash_attention(q, k, v, causal=True, window=256, softcap=50.0)
+print("window+softcap ok", float(np.asarray(o2.astype(jnp.float32))[0, -1, 0, 0]))
+EOF
+echo "flash sanity rc=$?"
+
+echo "=== 2. re-baseline ==="
+BENCH_AUTO_BEST=0 timeout 600 python bench.py
+
+echo "=== 3. high-leverage sweep rows ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 480 \
+  --only remat_dots,b8,b8_dots,dots_unroll2,combo_b8_dots_unroll2,unroll2,fuse8
+
+echo "=== 4. decomposition ==="
+timeout 900 python benchmarks/decompose.py > decompose.json 2>decompose.err
+echo "decompose rc=$?"; tail -2 decompose.json 2>/dev/null | head -1
+
+echo "=== 5. remaining rows ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 480 \
+  --only prevent_cse,vmem_128m,unroll4,loss_chunk_off,loss_chunk_1024,blocks_512x512,blocks_256x1024,seq4096_b2
+
+echo "=== 6. adopt best + final scoring run ==="
+timeout 600 python bench.py
+echo "=== session done ==="
