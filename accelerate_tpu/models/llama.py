@@ -404,18 +404,20 @@ def _attention_xla(q, k, v, mask, cfg: LlamaConfig):
 
 def _attention(q, k, v, mask, cfg: LlamaConfig, segment_ids=None):
     impl = cfg.attn_impl
-    if cfg.sliding_window and impl in ("ring", "ulysses", "allgather"):
-        raise NotImplementedError(
-            "sliding_window is not composable with the sequence-parallel attention modes"
-        )
     if impl in ("ring", "ulysses", "allgather"):
         # Sequence-parallel attention over the sp mesh axis (requires an active mesh
-        # context with sp > 1; falls back to local attention otherwise).
+        # context with sp > 1; falls back to local attention otherwise). Sliding windows
+        # and score capping flow into the kernels with GLOBAL offsets, so they stay
+        # correct across the sequence shards.
         mesh = jax.sharding.get_abstract_mesh()
         if mesh is not None and not mesh.empty and mesh.shape.get(SEQUENCE_AXIS, 1) > 1:
             from ..parallel.sequence import make_sp_attention
 
-            attn = make_sp_attention(mesh, mode=impl, axis_name=SEQUENCE_AXIS, causal=True)
+            attn = make_sp_attention(
+                mesh, mode=impl, axis_name=SEQUENCE_AXIS, causal=True,
+                window=cfg.sliding_window, softcap=cfg.attn_softcap,
+                sm_scale=_sm_scale(cfg),
+            )
             return attn(q, k, v)
         impl = "auto"
     if impl == "auto":

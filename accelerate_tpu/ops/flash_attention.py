@@ -557,7 +557,7 @@ _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
 def _flash_bhsd_offset(q, k, v, q_offset=0, kv_offset=0, causal=True, sm_scale=None,
-                       block_q=None, block_k=None, interpret=None):
+                       block_q=None, block_k=None, interpret=None, window=0, softcap=0.0):
     """Offset-aware flash attention over user layout [B, S, H, hd] (shard_map helper)."""
     B, S, H, hd = q.shape
     if sm_scale is None:
@@ -572,7 +572,7 @@ def _flash_bhsd_offset(q, k, v, q_offset=0, kv_offset=0, causal=True, sm_scale=N
     o = _flash_bhsd(qT, kT, vT,
                     jnp.asarray(q_offset, jnp.float32), jnp.asarray(kv_offset, jnp.float32),
                     jnp.zeros((1, 1), jnp.float32),
-                    causal, sm_scale, bq, bk, interpret, False, 0, 0.0)
+                    causal, sm_scale, bq, bk, interpret, False, int(window), float(softcap))
     return o.transpose(0, 2, 1, 3)
 
 

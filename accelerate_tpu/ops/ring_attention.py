@@ -43,13 +43,16 @@ def _merge(o_run, lse_run, o_b, lse_b):
     return o, m + jnp.log(denom_safe)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _ring_bhsd(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret):
-    o, _ = _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _ring_bhsd(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret, window,
+               softcap):
+    o, _ = _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret,
+                          window, softcap)
     return o
 
 
-def _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret):
+def _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret,
+                   window=0, softcap=0.0):
     block_q, block_k = block_sizes
     B, H, S_local, hd = q.shape
     idx = lax.axis_index(axis_name)
@@ -60,9 +63,11 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret)
     def body(carry, t):
         k_cur, v_cur, o_run, lse_run = carry
         kv_idx = (idx - t) % n
+        # The kernels take GLOBAL offsets, so sliding-window masking (and its tile
+        # skipping) is correct across ring steps without any extra logic here.
         o_b, lse_b = _fwd(
             q, k_cur, v_cur, causal, sm_scale, block_q, block_k, interpret,
-            q_offset=q_off, kv_offset=kv_idx * S_local,
+            q_offset=q_off, kv_offset=kv_idx * S_local, window=window, softcap=softcap,
         )
         o_run, lse_run = _merge(o_run, lse_run, o_b, lse_b)
         k_next = lax.ppermute(k_cur, axis_name, perm)
@@ -75,12 +80,15 @@ def _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret)
     return o.astype(q.dtype), lse
 
 
-def _ring_fwd(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret):
-    o, lse = _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret)
+def _ring_fwd(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret, window,
+              softcap):
+    o, lse = _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret,
+                            window, softcap)
     return o, (q, k, v, o, lse)
 
 
-def _ring_bwd(axis_name, causal, sm_scale, block_sizes, interpret, residuals, do):
+def _ring_bwd(axis_name, causal, sm_scale, block_sizes, interpret, window, softcap,
+              residuals, do):
     block_q, block_k = block_sizes
     q, k, v, o, lse = residuals
     B, H, S_local, hd = q.shape
@@ -96,11 +104,11 @@ def _ring_bwd(axis_name, causal, sm_scale, block_sizes, interpret, residuals, do
         kv_off = kv_idx * S_local
         dq_b = _bwd_dq(
             q, k_cur, v_cur, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
-            q_offset=q_off, kv_offset=kv_off,
+            q_offset=q_off, kv_offset=kv_off, window=window, softcap=softcap,
         )
         dk_b, dv_b = _bwd_dkv(
             q, k_cur, v_cur, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
-            q_offset=q_off, kv_offset=kv_off,
+            q_offset=q_off, kv_offset=kv_off, window=window, softcap=softcap,
         )
         dq_run = dq_run + dq_b
         dk_cur = dk_cur + dk_b
@@ -133,6 +141,8 @@ def ring_attention(
     block_q: int | None = None,
     block_k: int | None = None,
     interpret: Optional[bool] = None,
+    window: int = 0,
+    softcap: float = 0.0,
 ) -> jax.Array:
     """Exact ring attention for use inside shard_map; user layout q [B, S_loc, H, hd].
 
@@ -155,5 +165,6 @@ def ring_attention(
 
     bq = _fit_block(block_q or _DEFAULT_BLOCK_Q, S_local)
     bk = _fit_block(block_k or _DEFAULT_BLOCK_K, S_local)
-    o = _ring_bhsd(qT, kT, vT, axis_name, causal, sm_scale, (bq, bk), interpret)
+    o = _ring_bhsd(qT, kT, vT, axis_name, causal, sm_scale, (bq, bk), interpret,
+                   int(window), float(softcap))
     return o.transpose(0, 2, 1, 3)

@@ -52,6 +52,8 @@ def ulysses_attention(
     causal: bool = True,
     sm_scale: Optional[float] = None,
     interpret: Optional[bool] = None,
+    window: int = 0,
+    softcap: float = 0.0,
 ) -> jax.Array:
     """DeepSpeed-Ulysses: all-to-all seq↔head reshard, then full-sequence flash attention.
 
@@ -74,7 +76,8 @@ def ulysses_attention(
     qg = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kg = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vg = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    og = flash_attention(qg, kg, vg, causal=causal, sm_scale=sm_scale, interpret=interpret)
+    og = flash_attention(qg, kg, vg, causal=causal, sm_scale=sm_scale, interpret=interpret,
+                         window=window, softcap=softcap)
     # back: split sequence, gather heads.
     return lax.all_to_all(og, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
@@ -87,6 +90,8 @@ def allgather_attention(
     causal: bool = True,
     sm_scale: Optional[float] = None,
     interpret: Optional[bool] = None,
+    window: int = 0,
+    softcap: float = 0.0,
 ) -> jax.Array:
     """Naive SP: all-gather kv, attend local q chunk against the full sequence.
 
@@ -97,7 +102,8 @@ def allgather_attention(
     kg = lax.all_gather(k, axis_name, axis=1, tiled=True)
     vg = lax.all_gather(v, axis_name, axis=1, tiled=True)
     if not causal:
-        return flash_attention(q, kg, vg, causal=False, sm_scale=sm_scale, interpret=interpret)
+        return flash_attention(q, kg, vg, causal=False, sm_scale=sm_scale, interpret=interpret,
+                               window=window, softcap=softcap)
     # Causal with a global row offset: emulate by masking kv beyond my chunk's end.
     # flash_attention assumes q starts at position 0, so pass the full-length causal problem
     # for my rows via explicit offsets through the raw kernel path.
@@ -105,7 +111,7 @@ def allgather_attention(
 
     return _flash_bhsd_offset(
         q, kg, vg, q_offset=idx * S_local, causal=causal, sm_scale=sm_scale,
-        interpret=interpret,
+        interpret=interpret, window=window, softcap=softcap,
     )
 
 
@@ -118,24 +124,27 @@ def sequence_parallel_attention(
     causal: bool = True,
     sm_scale: Optional[float] = None,
     interpret: Optional[bool] = None,
+    window: int = 0,
+    softcap: float = 0.0,
 ) -> jax.Array:
-    """Dispatch by mode ("ring" | "ulysses" | "allgather"); shard_map-context required."""
+    """Dispatch by mode ("ring" | "ulysses" | "allgather"); shard_map-context required.
+
+    ``window``/``softcap`` flow into the flash kernels with GLOBAL position offsets, so
+    sliding-window (Mistral) and score-capped (Gemma) attention work across the
+    sequence-sharded mesh axis too."""
+    kwargs = dict(axis_name=axis_name, causal=causal, sm_scale=sm_scale,
+                  interpret=interpret, window=window, softcap=softcap)
     if mode == "ring":
-        return ring_attention(
-            q, k, v, axis_name=axis_name, causal=causal, sm_scale=sm_scale, interpret=interpret
-        )
+        return ring_attention(q, k, v, **kwargs)
     if mode == "ulysses":
-        return ulysses_attention(
-            q, k, v, axis_name=axis_name, causal=causal, sm_scale=sm_scale, interpret=interpret
-        )
+        return ulysses_attention(q, k, v, **kwargs)
     if mode == "allgather":
-        return allgather_attention(
-            q, k, v, axis_name=axis_name, causal=causal, sm_scale=sm_scale, interpret=interpret
-        )
+        return allgather_attention(q, k, v, **kwargs)
     raise ValueError(f"unknown sequence-parallel mode {mode!r}")
 
 
-def make_sp_attention(mesh, mode: str = "ring", axis_name: str = SEQUENCE_AXIS, causal: bool = True):
+def make_sp_attention(mesh, mode: str = "ring", axis_name: str = SEQUENCE_AXIS, causal: bool = True,
+                      window: int = 0, softcap: float = 0.0, sm_scale: Optional[float] = None):
     """Wrap ``sequence_parallel_attention`` for use inside a GSPMD-jitted model.
 
     Returns ``attn(q, k, v) -> o`` over GLOBAL [B, S, H, hd] arrays: shard_map is manual only
@@ -147,7 +156,8 @@ def make_sp_attention(mesh, mode: str = "ring", axis_name: str = SEQUENCE_AXIS, 
 
     def attn(q, k, v):
         fn = functools.partial(
-            sequence_parallel_attention, mode=mode, axis_name=axis_name, causal=causal
+            sequence_parallel_attention, mode=mode, axis_name=axis_name, causal=causal,
+            window=window, softcap=softcap, sm_scale=sm_scale,
         )
         mapped = jax.shard_map(
             fn,

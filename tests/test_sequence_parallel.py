@@ -93,6 +93,43 @@ def test_sp_attention_gqa_gradient_parity(sp_mesh, mode):
 
 
 @pytest.mark.parametrize("mode", ["ring", "ulysses", "allgather"])
+def test_sp_attention_window_softcap_parity(sp_mesh, mode):
+    """Sliding window + score capping across the sp shards (global offsets): forward and
+    gradients must match the banded, capped single-device reference."""
+    window, cap = 48, 3.0
+    q, k, v = make_qkv(B=1, S=128, H=8, K=2, hd=32)
+    attn = make_sp_attention(sp_mesh, mode=mode, causal=True, window=window, softcap=cap)
+    sharded = NamedSharding(sp_mesh, P(None, "sp", None, None))
+    qs, ks, vs = (jax.device_put(x, sharded) for x in (q, k, v))
+
+    def ref(q, k, v):
+        kk = jnp.repeat(k, 4, axis=2)
+        vv = jnp.repeat(v, 4, axis=2)
+        S = q.shape[1]
+        s = jnp.einsum("bshd,bthd->bhst", q, kk) / np.sqrt(q.shape[-1])
+        s = cap * jnp.tanh(s / cap)
+        i = jnp.arange(S)
+        band = (i[None, :] <= i[:, None]) & (i[None, :] > i[:, None] - window)
+        s = jnp.where(band[None, None], s, -1e30)
+        return jnp.einsum("bhst,bthd->bshd", jax.nn.softmax(s, axis=-1), vv)
+
+    with jax.set_mesh(sp_mesh):
+        out = jax.jit(attn)(qs, ks, vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref(q, k, v)), atol=3e-5)
+
+    def loss_sp(q, k, v):
+        return jnp.sum(attn(q, k, v) ** 2)
+
+    with jax.set_mesh(sp_mesh):
+        gs = jax.jit(jax.grad(loss_sp, argnums=(0, 1, 2)))(qs, ks, vs)
+    gr = jax.grad(lambda q, k, v: jnp.sum(ref(q, k, v) ** 2), argnums=(0, 1, 2))(q, k, v)
+    for a, b, name in zip(gs, gr, "qkv"):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=1e-3, err_msg=f"d{name} ({mode})"
+        )
+
+
+@pytest.mark.parametrize("mode", ["ring", "ulysses", "allgather"])
 def test_sp_attention_gradient_parity(sp_mesh, mode):
     q, k, v = make_qkv(B=1, S=128, H=8, K=8, hd=32)
     attn = make_sp_attention(sp_mesh, mode=mode, causal=True)

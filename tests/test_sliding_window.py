@@ -150,9 +150,24 @@ def test_mistral_logits_match_transformers():
     np.testing.assert_allclose(ours, hf_logits, atol=2e-4, rtol=1e-3)
 
 
-def test_sliding_window_rejects_sp_modes():
-    cfg = dataclasses.replace(CFG, attn_impl="ring")
+def test_sliding_window_works_with_sp_modes():
+    """Sliding windows flow into the SP kernels with global offsets: a ring-attention
+    model over an sp=8 mesh must equal the single-device banded forward."""
+    import jax.sharding
+
+    from accelerate_tpu.parallel import MeshConfig, build_mesh
+
+    mesh = build_mesh(MeshConfig(dp=1, sp=8))
+    cfg = dataclasses.replace(CFG, attn_impl="ring", sliding_window=24)
     params = llama.init_params(cfg)
-    tokens = jnp.zeros((1, 16), jnp.int32)
-    with pytest.raises(NotImplementedError):
-        llama.forward(params, tokens, cfg, shard_activations=False)
+    tokens = jnp.asarray(
+        np.random.default_rng(8).integers(0, cfg.vocab_size, size=(1, 64)), jnp.int32
+    )
+    ref = llama.forward(
+        params, tokens, dataclasses.replace(cfg, attn_impl="xla"), shard_activations=False
+    )
+    with jax.set_mesh(mesh):
+        out = jax.jit(
+            lambda p, t: llama.forward(p, t, cfg, shard_activations=True)
+        )(params, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-4)
