@@ -65,15 +65,20 @@ def llama_config_from_hf(hf_config: Any, **overrides):
             lambda k, d=None: getattr(scaling, k, d)
         )
         rope_type = sget("rope_type", sget("type", None))
-        if rope_type != "llama3":
-            raise ValueError(f"unsupported rope_scaling type {rope_type!r} (only 'llama3')")
-        kwargs.update(
-            rope_scaling="llama3",
-            rope_scaling_factor=float(sget("factor", 8.0)),
-            rope_low_freq_factor=float(sget("low_freq_factor", 1.0)),
-            rope_high_freq_factor=float(sget("high_freq_factor", 4.0)),
-            rope_original_max=int(sget("original_max_position_embeddings", 8192)),
-        )
+        if rope_type in (None, "default"):
+            pass  # explicit no-op entry (transformers' "default" rope) — plain RoPE
+        elif rope_type != "llama3":
+            raise ValueError(
+                f"unsupported rope_scaling type {rope_type!r} (only 'llama3'/'default')"
+            )
+        else:
+            kwargs.update(
+                rope_scaling="llama3",
+                rope_scaling_factor=float(sget("factor", 8.0)),
+                rope_low_freq_factor=float(sget("low_freq_factor", 1.0)),
+                rope_high_freq_factor=float(sget("high_freq_factor", 4.0)),
+                rope_original_max=int(sget("original_max_position_embeddings", 8192)),
+            )
     kwargs.update(overrides)
     return LlamaConfig(**kwargs)
 

@@ -1281,15 +1281,17 @@ def _spec_forward_jit(params, tokens, cache, cfg):
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
 
-@partial(jax.jit, static_argnames=("cfg", "gen"), donate_argnums=(2,))
-def _spec_probs_jit(params, tokens, cache, cfg, gen):
+@partial(jax.jit, static_argnames=("cfg", "top_k", "apply_top_p"), donate_argnums=(2,))
+def _spec_probs_jit(params, tokens, cache, cfg, temperature, top_p, top_k, apply_top_p):
     """forward_cached + the SAME temperature/top-k/top-p filtering ``generate`` samples
     from, as per-position probability rows [B, T, V] — speculative sampling's accept test
-    compares draft and target over these exact distributions."""
+    compares draft and target over these exact distributions. Only the shape-affecting
+    knobs (top_k, apply_top_p) are static; temperature/top_p trace as scalars so varying
+    sampling-irrelevant GenerationConfig fields never recompiles the model."""
     from ..generation import filtered_logits
 
     logits, cache = forward_cached(params, tokens, cache, cfg)
-    fl = filtered_logits(logits, gen.temperature, gen.top_p, gen.top_k, gen.top_p < 1.0)
+    fl = filtered_logits(logits, temperature, top_p, top_k, apply_top_p)
     return jax.nn.softmax(fl, axis=-1), cache
 
 
@@ -1394,7 +1396,8 @@ def generate_speculative(
             if sampled:
                 qp, d_cache = _spec_probs_jit(
                     draft_params, jnp.asarray([[tok]], jnp.int32), d_cache,
-                    cfg=draft_cfg, gen=gen,
+                    cfg=draft_cfg, temperature=gen.temperature, top_p=gen.top_p,
+                    top_k=gen.top_k, apply_top_p=gen.top_p < 1.0,
                 )
                 q_rows.append(qp[0, -1])
                 tok = int(np.asarray(jax.random.categorical(
@@ -1415,7 +1418,8 @@ def generate_speculative(
         if sampled:
             pp, t_cache = _spec_probs_jit(
                 target_params, jnp.asarray([[pending, *drafts]], jnp.int32), t_cache,
-                cfg=target_cfg, gen=gen,
+                cfg=target_cfg, temperature=gen.temperature, top_p=gen.top_p,
+                top_k=gen.top_k, apply_top_p=gen.top_p < 1.0,
             )
             # 3. stochastic prefix acceptance: accept proposal n w.p. min(1, p/q);
             # first rejection re-draws from the residual and ends the round.

@@ -101,12 +101,12 @@ def allgather_attention(
     S_local = q.shape[1]
     kg = lax.all_gather(k, axis_name, axis=1, tiled=True)
     vg = lax.all_gather(v, axis_name, axis=1, tiled=True)
-    if not causal:
+    if not causal and not window:
         return flash_attention(q, kg, vg, causal=False, sm_scale=sm_scale, interpret=interpret,
-                               window=window, softcap=softcap)
-    # Causal with a global row offset: emulate by masking kv beyond my chunk's end.
-    # flash_attention assumes q starts at position 0, so pass the full-length causal problem
-    # for my rows via explicit offsets through the raw kernel path.
+                               softcap=softcap)
+    # Causal (or windowed) with a global row offset: flash_attention assumes q starts at
+    # position 0, so route through the raw kernel path with this shard's global offset —
+    # the band/causal masks both use global positions.
     from ..ops.flash_attention import _fit_block, _flash_bhsd_offset
 
     return _flash_bhsd_offset(

@@ -100,7 +100,35 @@ def _decode_step(params, cache, tokens, positions, cfg):
     if cfg.embed_scale:
         x = x * jnp.asarray(_math.sqrt(cfg.d_model), cfg.dtype)
     pos2 = positions[:, None]
-    if cfg.scan_layers:
+    alternating = bool(cfg.sliding_window) and cfg.window_every > 1
+    if cfg.scan_layers and alternating:
+        # Mirror forward_cached's grouped scan: layer j of each window_every-group is
+        # banded iff j == 0 (without this, decode would band-limit the full-attention
+        # layers and diverge from generate()).
+        per = cfg.window_every
+        full_cfg = _dc.replace(cfg, sliding_window=0)
+        regroup = lambda a: a.reshape(cfg.n_layers // per, per, *a.shape[1:])  # noqa: E731
+        grouped = jax.tree_util.tree_map(regroup, (params["layers"], cache["layers"]))
+
+        def body(carry, group):
+            layers_g, kv_g = group
+            out = carry
+            new_kvs = []
+            for j in range(per):
+                layer_j = jax.tree_util.tree_map(lambda a, j=j: a[j], layers_g)
+                kv_j = jax.tree_util.tree_map(lambda a, j=j: a[j], kv_g)
+                out, new_kv = _block_cached(
+                    out, layer_j, kv_j, positions, pos2, valid,
+                    cfg if j == 0 else full_cfg,
+                )
+                new_kvs.append(new_kv)
+            return out, jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *new_kvs)
+
+        x, new_grouped = jax.lax.scan(body, x, grouped)
+        new_layers = jax.tree_util.tree_map(
+            lambda a: a.reshape(cfg.n_layers, *a.shape[2:]), new_grouped
+        )
+    elif cfg.scan_layers:
         def body(carry, layer_and_kv):
             layer, kv = layer_and_kv
             # vector index → per-row write slots (llama._block_cached handles both)

@@ -221,6 +221,32 @@ def test_serving_engine_matches_generate():
     assert req.tokens == ref
 
 
+def test_serving_engine_scan_layers_matches_generate():
+    """Scan-layers Gemma in the batcher: the decode step's grouped scan must alternate
+    banded/full exactly like forward_cached (a plain scan would band every layer)."""
+    from accelerate_tpu.generation import GenerationConfig
+    from accelerate_tpu.serving import ContinuousBatcher
+
+    cfg = dataclasses.replace(
+        llama.CONFIGS["gemma2-9b"],
+        vocab_size=128, d_model=64, n_layers=4, n_heads=4, n_kv_heads=2, d_ff=128,
+        head_dim_override=16, sliding_window=8, max_seq=128, dtype=jnp.float32,
+        remat=False, scan_layers=True,
+    )
+    params = llama.init_params(cfg, jax.random.PRNGKey(5))
+    prompt = [3, 5, 7, 11, 13]
+    ref = np.asarray(
+        llama.generate(
+            params, jnp.asarray([prompt], jnp.int32), cfg,
+            GenerationConfig(max_new_tokens=6),
+        )
+    )[0].tolist()
+    eng = ContinuousBatcher(params, cfg, max_slots=2, max_len=64, prompt_bucket=8)
+    req = eng.submit(prompt, max_new_tokens=6)
+    eng.run()
+    assert req.tokens == ref
+
+
 def test_training_step_decreases_loss():
     import optax
 

@@ -36,6 +36,17 @@ def test_llama_logits_match_transformers():
     np.testing.assert_allclose(ours, hf_logits, atol=2e-4, rtol=1e-3)
 
 
+def test_rope_scaling_default_entry_is_noop():
+    """Some fine-tune configs carry rope_scaling={'rope_type': 'default'} — a valid no-op
+    that must load as plain RoPE, not raise."""
+    cfg = hf_interop.llama_config_from_hf(
+        {"vocab_size": 64, "hidden_size": 32, "num_hidden_layers": 1,
+         "num_attention_heads": 2, "num_key_value_heads": 2, "intermediate_size": 64,
+         "rope_scaling": {"rope_type": "default"}},
+    )
+    assert cfg.rope_scaling is None
+
+
 def test_llama31_rope_scaling_logits_match_transformers():
     """Llama-3.1 rope scaling: positions past the ramp regions must match transformers'
     per-band scaled frequencies exactly."""
