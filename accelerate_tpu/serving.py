@@ -28,7 +28,7 @@ shape-sensitive, so parity is defined at matching padded shapes).
 from __future__ import annotations
 
 import dataclasses
-from collections import deque
+from collections import OrderedDict, deque
 from functools import partial
 from typing import Optional
 
@@ -195,6 +195,24 @@ def _prefill_chunk_jit(params, row, mask, cache, cfg):
     return jnp.argmax(last, axis=-1).astype(jnp.int32), last, cache
 
 
+@partial(jax.jit, static_argnames=("cfg", "max_len"))
+def _prefill_full_logits_jit(params, row, mask, cfg, max_len: int):
+    """Right-aligned prefill (prefix-cache layout): fresh cache + one chunk, returning
+    per-position logits (the caller indexes the real last token, which may sit before
+    trailing pads)."""
+    cache = init_cache(cfg, 1, max_len)
+    logits, cache = llama.forward_cached(params, row, cache, cfg, token_mask=mask)
+    return logits, cache
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def _prefill_chunk_keep_jit(params, row, mask, cache, cfg):
+    """Chunk append WITHOUT donating the input cache — the prefix registry keeps the
+    input state alive for reuse by later prompts sharing this prefix."""
+    logits, cache = llama.forward_cached(params, row, cache, cfg, token_mask=mask)
+    return logits, cache
+
+
 class ContinuousBatcher:
     """Continuous-batching decode over ``max_slots`` shared lanes (greedy or sampled
     per request).
@@ -206,7 +224,7 @@ class ContinuousBatcher:
     """
 
     def __init__(self, params, cfg, max_slots: int = 8, max_len: int = 512,
-                 prompt_bucket: int = 64):
+                 prompt_bucket: int = 64, prefix_cache: int = 0):
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -218,6 +236,16 @@ class ContinuousBatcher:
         self.slot_req: list[Optional[Request]] = [None] * max_slots
         self.queue: deque[Request] = deque()
         self._uid = 0
+        # Prefix caching (opt-in): keep up to ``prefix_cache`` row-cache snapshots keyed
+        # by full-chunk prompt prefixes; a new request sharing a registered prefix skips
+        # recomputing it (the classic shared-system-prompt win). Uses a RIGHT-aligned
+        # prompt layout (prefix always at positions 0..P, so snapshots align for every
+        # prompt length); rotary attention only sees position differences, so outputs
+        # still equal the standalone greedy decode (tested).
+        self.prefix_cache_size = prefix_cache
+        self._prefix_reg: "OrderedDict[bytes, object]" = OrderedDict()
+        self.prefix_hits = 0
+        self.prefix_misses = 0
 
     # ------------------------------------------------------------------ user API
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
@@ -335,10 +363,14 @@ class ContinuousBatcher:
         return finished
 
     def _prefill(self, prompt: np.ndarray):
-        """Left-padded single-row prefill in bucket-width chunks → (cache row, on-device
-        greedy token [1], on-device logits row [1, V], written length).
+        """Single-row prefill in bucket-width chunks → (cache row, on-device greedy token
+        [1], on-device logits row [1, V], decode start position).
         Compiled: one bucket-width executable per (cfg, max_len) plus one shared
-        chunk-append executable — a 10-chunk prompt compiles nothing new."""
+        chunk-append executable — a 10-chunk prompt compiles nothing new. With
+        ``prefix_cache`` enabled, prompts sharing registered full-chunk prefixes skip
+        straight to the first uncached chunk."""
+        if self.prefix_cache_size:
+            return self._prefill_prefix_cached(prompt)
         bucket = self.prompt_bucket
         n_chunks = max(1, -(-len(prompt) // bucket))
         total = n_chunks * bucket
@@ -358,3 +390,94 @@ class ContinuousBatcher:
                 cfg=self.cfg,
             )
         return cache, greedy, logits, total
+
+    def _prefill_prefix_cached(self, prompt: np.ndarray):
+        """RIGHT-aligned chunked prefill with prefix-snapshot reuse.
+
+        The prompt occupies positions [0, len); trailing slots of the last chunk are
+        invalid pads that the first decode writes simply overwrite (decode starts at
+        position len). After each fully-real chunk the row cache is snapshotted into an
+        LRU registry keyed by the prefix bytes; a later prompt starting with the same
+        chunks resumes from the snapshot (the chunk-append executable does not donate its
+        input, so snapshots stay alive)."""
+        bucket = self.prompt_bucket
+        n_chunks = max(1, -(-len(prompt) // bucket))
+        total = n_chunks * bucket
+        row = np.zeros((1, total), np.int32)
+        row[0, :len(prompt)] = prompt
+        mask = np.zeros((1, total), bool)
+        mask[0, :len(prompt)] = True
+        full_chunks = len(prompt) // bucket  # only fully-real chunks are cacheable
+
+        # Longest registered prefix wins.
+        cache = None
+        start = 0
+        for k in range(full_chunks, 0, -1):
+            key = prompt[: k * bucket].tobytes()
+            hit = self._prefix_reg.get(key)
+            if hit is not None:
+                self._prefix_reg.move_to_end(key)
+                cache = hit
+                start = k
+                self.prefix_hits += 1
+                break
+        if cache is None and full_chunks:
+            self.prefix_misses += 1
+
+        logits = None
+        for c in range(start, n_chunks):
+            sl = slice(c * bucket, (c + 1) * bucket)
+            if cache is None:
+                logits, cache = _prefill_full_logits_jit(
+                    self.params, jnp.asarray(row[:, sl]), jnp.asarray(mask[:, sl]),
+                    cfg=self.cfg, max_len=self.max_len,
+                )
+            else:
+                logits, cache = _prefill_chunk_keep_jit(
+                    self.params, jnp.asarray(row[:, sl]), jnp.asarray(mask[:, sl]),
+                    cache, cfg=self.cfg,
+                )
+            if c + 1 <= full_chunks:
+                self._register_prefix(prompt[: (c + 1) * bucket].tobytes(), cache)
+        if logits is None:
+            # Whole prompt was a registered prefix with no partial tail: re-run the last
+            # chunk to recover its logits (cache state is already correct; the rewrite is
+            # idempotent — same tokens into the same slots).
+            sl = slice((start - 1) * bucket, start * bucket)
+            prev_key = prompt[: (start - 1) * bucket].tobytes() if start > 1 else None
+            prev = self._prefix_reg.get(prev_key) if prev_key else None
+            if prev is not None:
+                logits, cache = _prefill_chunk_keep_jit(
+                    self.params, jnp.asarray(row[:, sl]), jnp.asarray(mask[:, sl]),
+                    prev, cfg=self.cfg,
+                )
+            else:
+                logits, cache = _prefill_full_logits_jit(
+                    self.params, jnp.asarray(row[:, sl]), jnp.asarray(mask[:, sl]),
+                    cfg=self.cfg, max_len=self.max_len,
+                ) if start == 1 else self._recompute_all(row, mask, n_chunks)
+        # The real last token may sit before trailing pads: index its logits column.
+        last_col = (len(prompt) - 1) % bucket
+        last = logits[:, last_col, :]
+        greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        return cache, greedy, last, len(prompt)
+
+    def _recompute_all(self, row, mask, n_chunks):
+        bucket = self.prompt_bucket
+        logits, cache = _prefill_full_logits_jit(
+            self.params, jnp.asarray(row[:, :bucket]), jnp.asarray(mask[:, :bucket]),
+            cfg=self.cfg, max_len=self.max_len,
+        )
+        for c in range(1, n_chunks):
+            sl = slice(c * bucket, (c + 1) * bucket)
+            logits, cache = _prefill_chunk_keep_jit(
+                self.params, jnp.asarray(row[:, sl]), jnp.asarray(mask[:, sl]), cache,
+                cfg=self.cfg,
+            )
+        return logits, cache
+
+    def _register_prefix(self, key: bytes, cache) -> None:
+        self._prefix_reg[key] = cache
+        self._prefix_reg.move_to_end(key)
+        while len(self._prefix_reg) > self.prefix_cache_size:
+            self._prefix_reg.popitem(last=False)

@@ -80,6 +80,48 @@ def test_oversized_prompt_rejected(setup):
                       max_new_tokens=4)  # 8 chunks * 8 + 4 > 64
 
 
+def test_prefix_cache_matches_generate_and_hits(setup):
+    """Prefix caching (right-aligned layout): prompts sharing full-chunk prefixes reuse
+    the registered snapshot, and every output still equals standalone greedy decode."""
+    params, _ = setup
+    rng = np.random.default_rng(7)
+    system = rng.integers(1, CFG.vocab_size, 16).astype(np.int32)  # exactly 2 buckets
+    suffix_a = rng.integers(1, CFG.vocab_size, 5).astype(np.int32)
+    suffix_b = rng.integers(1, CFG.vocab_size, 9).astype(np.int32)
+    engine = ContinuousBatcher(params, CFG, max_slots=2, max_len=64, prompt_bucket=8,
+                               prefix_cache=4)
+
+    pa = np.concatenate([system, suffix_a])
+    ra = engine.submit(pa, max_new_tokens=5)
+    engine.run()
+    assert engine.prefix_hits == 0
+    assert ra.tokens == reference_greedy(params, pa, 5)
+
+    pb = np.concatenate([system, suffix_b])
+    rb = engine.submit(pb, max_new_tokens=5)
+    engine.run()
+    assert engine.prefix_hits >= 1  # the 2-bucket system prefix was reused
+    assert rb.tokens == reference_greedy(params, pb, 5)
+
+    # Whole prompt == a registered prefix (exact multiple of the bucket).
+    rc = engine.submit(system, max_new_tokens=5)
+    engine.run()
+    assert rc.tokens == reference_greedy(params, system, 5)
+
+
+def test_prefix_cache_eviction_bounded(setup):
+    params, _ = setup
+    rng = np.random.default_rng(11)
+    engine = ContinuousBatcher(params, CFG, max_slots=1, max_len=64, prompt_bucket=8,
+                               prefix_cache=2)
+    for _ in range(5):
+        p = rng.integers(1, CFG.vocab_size, 10).astype(np.int32)
+        req = engine.submit(p, max_new_tokens=3)
+        engine.run()
+        assert req.tokens == reference_greedy(params, p, 3)
+    assert len(engine._prefix_reg) <= 2
+
+
 def test_long_prompt_chunked_prefill_matches_generate(setup):
     """A prompt spanning 2.5 buckets prefills through the shared chunk-append executable
     and must still equal the standalone greedy decode."""
