@@ -28,24 +28,33 @@ def main():
     parser.add_argument("--requests", type=int, default=24)
     parser.add_argument("--max-new-tokens", type=int, default=64)
     parser.add_argument("--prompt-bucket", type=int, default=128)
+    parser.add_argument("--kv-quant", action="store_true",
+                        help="int8 KV cache: half the cache bytes, ~2x the slots")
+    parser.add_argument("--prefix-cache", type=int, default=0,
+                        help="Keep N prefix snapshots (shared-system-prompt reuse)")
+    parser.add_argument("--shared-prefix", type=int, default=0,
+                        help="Give every prompt this many shared leading tokens")
     args = parser.parse_args()
 
     if args.cpu or args.smoke:
         jax.config.update("jax_platforms", "cpu")
     cfg = llama.CONFIGS["tiny"] if args.smoke else llama.CONFIGS[args.model]
-    cfg = dataclasses.replace(cfg, dtype=jnp.float32 if args.smoke else jnp.bfloat16)
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32 if args.smoke else jnp.bfloat16,
+                              kv_quant=args.kv_quant)
     n_new = 6 if args.smoke else args.max_new_tokens
     bucket = 16 if args.smoke else args.prompt_bucket
     params = llama.init_params(cfg)  # random weights; timing is shape-dependent
 
     rng = np.random.default_rng(0)
+    shared = rng.integers(1, cfg.vocab_size, args.shared_prefix).astype(np.int32)
     prompts = [
-        rng.integers(1, cfg.vocab_size, int(n)).astype(np.int32)
+        np.concatenate([shared, rng.integers(1, cfg.vocab_size, int(n)).astype(np.int32)])
         for n in rng.integers(2, bucket, size=args.requests)
     ]
+    n_buckets = -(-(args.shared_prefix + bucket) // bucket)
     engine = ContinuousBatcher(
-        params, cfg, max_slots=args.slots, max_len=bucket + n_new + 8,
-        prompt_bucket=bucket,
+        params, cfg, max_slots=args.slots, max_len=n_buckets * bucket + n_new + 8,
+        prompt_bucket=bucket, prefix_cache=args.prefix_cache,
     )
     for i, p in enumerate(prompts):
         if i % 2 == 0:
@@ -61,6 +70,8 @@ def main():
     print(
         f"served {len(finished)} requests over {args.slots} lanes: {tps:.1f} tokens/s"
     )
+    if args.prefix_cache:
+        print(f"prefix cache: {engine.prefix_hits} hits / {engine.prefix_misses} misses")
 
 
 if __name__ == "__main__":
