@@ -42,6 +42,8 @@ __all__ = [
     "forward_streamed",
     "loss_fn",
     "loss_fn_pp",
+    "score",
+    "perplexity",
     "packed_target_mask",
     "segment_mask",
     "segment_positions",
@@ -795,6 +797,43 @@ def loss_fn(
     if cfg.moe_experts > 0:
         return ce + cfg.moe_aux_weight * aux
     return ce
+
+
+def score(
+    params: dict,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Per-token log-probabilities log p(token[t+1] | tokens[:t+1]) → [B, S-1] fp32.
+
+    The evaluation companion to ``loss_fn`` (which returns their masked mean negated):
+    use for perplexity, answer scoring, or re-ranking. ``mask`` [B, S] marks real tokens
+    (False on pads); masked target positions score 0.0.
+    """
+    tokens = jnp.asarray(tokens, jnp.int32)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inputs, cfg, shard_activations=False)  # final_softcap applied
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    if mask is not None:
+        ll = ll * mask[:, 1:].astype(ll.dtype)
+    return ll
+
+
+def perplexity(
+    params: dict,
+    tokens: jax.Array,
+    cfg: LlamaConfig,
+    mask: Optional[jax.Array] = None,
+) -> jax.Array:
+    """exp(mean negative log-likelihood over real target positions) — scalar fp32."""
+    ll = score(params, tokens, cfg, mask)
+    if mask is not None:
+        denom = jnp.maximum(mask[:, 1:].sum(), 1)
+    else:
+        denom = ll.size
+    return jnp.exp(-ll.sum() / denom)
 
 
 # --------------------------------------------------------------- pipeline-parallel training

@@ -246,3 +246,24 @@ def test_remat_policy_validated():
     tokens = jnp.asarray(make_batch(1, 8)["tokens"][:, :-1])
     with pytest.raises(ValueError, match="remat_policy"):
         llama.forward(params, tokens, cfg, shard_activations=False)
+
+
+def test_score_matches_loss_fn():
+    """score() log-probs must be consistent with loss_fn (its masked mean, negated) and
+    perplexity must equal exp(loss)."""
+    import dataclasses
+
+    cfg = dataclasses.replace(llama.CONFIGS["tiny"], dtype=jnp.float32, loss_chunk=-1)
+    params = llama.init_params(cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(2, 17)), jnp.int32)
+    mask = jnp.asarray(rng.integers(0, 2, size=(2, 17)), jnp.bool_).at[:, 0].set(True)
+
+    ll = llama.score(params, tokens, cfg, mask)
+    loss = llama.loss_fn(params, {"tokens": tokens, "mask": mask}, cfg)
+    denom = float(np.asarray(mask[:, 1:].sum()))
+    np.testing.assert_allclose(
+        -float(np.asarray(ll).sum()) / denom, float(np.asarray(loss)), rtol=1e-5
+    )
+    ppl = llama.perplexity(params, tokens, cfg, mask)
+    np.testing.assert_allclose(float(np.asarray(ppl)), float(np.exp(np.asarray(loss))), rtol=1e-5)
