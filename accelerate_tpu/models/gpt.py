@@ -35,6 +35,8 @@ __all__ = [
     "init_params",
     "forward",
     "loss_fn",
+    "score",
+    "perplexity",
     "partition_specs",
     "init_cache",
     "forward_cached",
@@ -316,6 +318,26 @@ def loss_fn(params: dict, batch: dict, cfg: GPTConfig, rng=None) -> jax.Array:
     if m is None:
         return -jnp.mean(ll)
     return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+
+def score(params: dict, tokens, cfg: GPTConfig, mask=None) -> jax.Array:
+    """Per-token log-probabilities log p(token[t+1] | tokens[:t+1]) → [B, S-1] fp32
+    (same contract as ``llama.score``; masked target positions score 0.0)."""
+    tokens = jnp.asarray(tokens, jnp.int32)
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    logits = forward(params, inputs, cfg, shard_activations=False)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    if mask is not None:
+        ll = ll * mask[:, 1:].astype(ll.dtype)
+    return ll
+
+
+def perplexity(params: dict, tokens, cfg: GPTConfig, mask=None) -> jax.Array:
+    """exp(mean negative log-likelihood over real target positions) — scalar fp32."""
+    ll = score(params, tokens, cfg, mask)
+    denom = jnp.maximum(mask[:, 1:].sum(), 1) if mask is not None else ll.size
+    return jnp.exp(-ll.sum() / denom)
 
 
 # ----------------------------------------------------------------------- cached generation

@@ -248,6 +248,17 @@ class ContinuousBatcher:
         self.prefix_misses = 0
 
     # ------------------------------------------------------------------ user API
+    def stats(self) -> dict:
+        """Engine observability snapshot: queue depth, busy lanes, prefix-cache counters."""
+        return {
+            "queued": len(self.queue),
+            "active_slots": sum(r is not None for r in self.slot_req),
+            "max_slots": self.max_slots,
+            "prefix_entries": len(self._prefix_reg),
+            "prefix_hits": self.prefix_hits,
+            "prefix_misses": self.prefix_misses,
+        }
+
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = None,
                gen: Optional[GenerationConfig] = None,

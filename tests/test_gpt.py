@@ -120,3 +120,17 @@ def test_generate_streamed_matches_in_memory():
         want = np.asarray(gpt.generate(params, prompt, cfg, gen))
         got = np.asarray(gpt.generate_streamed(cpu_offload(params), prompt, cfg, gen))
         np.testing.assert_array_equal(want, got)
+
+
+def test_score_matches_loss_fn():
+    import dataclasses
+
+    cfg = dataclasses.replace(gpt.CONFIGS["tiny"], dtype=jnp.float32)
+    params = gpt.init_params(cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, size=(2, 15)), jnp.int32)
+    ll = gpt.score(params, tokens, cfg)
+    loss = gpt.loss_fn(params, {"tokens": tokens}, cfg)
+    np.testing.assert_allclose(
+        -float(np.asarray(ll).mean()), float(np.asarray(loss)), rtol=1e-5
+    )
