@@ -37,6 +37,8 @@ __all__ = [
     "decode",
     "forward",
     "loss_fn",
+    "score",
+    "perplexity",
     "partition_specs",
     "generate",
     "generate_streamed",
@@ -397,6 +399,28 @@ def loss_fn(params: dict, batch: dict, cfg: T5Config, rng=None) -> jax.Array:
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, safe[..., None], axis=-1).squeeze(-1)
     return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def score(params: dict, input_ids, labels, cfg: T5Config,
+          attention_mask=None) -> jax.Array:
+    """Per-target-token log-probabilities log p(label[t] | inputs, labels[:t]) → [B, T]
+    fp32 (seq2seq; ignored -100 labels score 0.0). Same contract as ``llama.score``."""
+    labels = jnp.asarray(labels, jnp.int32)
+    start = jnp.full((labels.shape[0], 1), cfg.decoder_start_token_id, labels.dtype)
+    dec_in = jnp.concatenate([start, jnp.maximum(labels[:, :-1], 0)], axis=1)
+    logits = forward(params, input_ids, dec_in, cfg, attention_mask)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, jnp.maximum(labels, 0)[..., None], axis=-1).squeeze(-1)
+    return ll * (labels >= 0).astype(ll.dtype)
+
+
+def perplexity(params: dict, input_ids, labels, cfg: T5Config,
+               attention_mask=None) -> jax.Array:
+    """exp(mean negative log-likelihood over real label positions) — scalar fp32."""
+    labels = jnp.asarray(labels, jnp.int32)
+    ll = score(params, input_ids, labels, cfg, attention_mask)
+    denom = jnp.maximum((labels >= 0).sum(), 1)
+    return jnp.exp(-ll.sum() / denom)
 
 
 def generate(params: dict, input_ids: jax.Array, cfg: T5Config,

@@ -107,3 +107,15 @@ def test_generate_streamed_matches_in_memory():
     n = want.shape[1]
     np.testing.assert_array_equal(want, got[:, :n])
     assert np.all(got[:, n:] == 1)
+
+
+def test_score_matches_loss_fn():
+    params = t5.init_params(CFG)
+    batch = make_batch(n=2)
+    ll = t5.score(params, batch["input_ids"], batch["labels"], CFG)
+    loss = t5.loss_fn(params, batch, CFG)
+    labels = np.asarray(batch["labels"])
+    denom = (labels >= 0).sum()
+    np.testing.assert_allclose(
+        -float(np.asarray(ll).sum()) / denom, float(np.asarray(loss)), rtol=1e-5
+    )
