@@ -473,6 +473,14 @@ class Accelerator:
     def split_between_processes(self, inputs, apply_padding: bool = False):
         return self.state.split_between_processes(inputs, apply_padding=apply_padding)
 
+    def main_process_first(self):
+        """Main host runs the body first, then the rest (reference ``accelerator.py:957``)."""
+        return self.state.main_process_first()
+
+    def local_main_process_first(self):
+        """Per-node variant of :meth:`main_process_first` (reference ``accelerator.py:979``)."""
+        return self.state.local_main_process_first()
+
     def on_main_process(self, function):
         return self.state.on_main_process(function)
 

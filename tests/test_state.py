@@ -38,6 +38,19 @@ def test_main_process_first():
         pass
 
 
+def test_accelerator_facade_delegates_process_control():
+    """The facade exposes the reference Accelerator's context managers (``:957,979``)."""
+    from accelerate_tpu import Accelerator
+
+    accelerator = Accelerator()
+    with accelerator.main_process_first():
+        pass
+    with accelerator.local_main_process_first():
+        pass
+    with accelerator.split_between_processes([1, 2]) as chunk:
+        assert chunk == [1, 2]
+
+
 def test_split_between_processes_single():
     state = PartialState()
     with state.split_between_processes([1, 2, 3]) as x:
