@@ -23,21 +23,52 @@ def test_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("--config_file", default=None)
     parser.add_argument("--on-device", "--on_device", action="store_true",
                         help="Run on the real backend instead of the 8-device CPU simulator.")
+    parser.add_argument("--suite", default="script",
+                        choices=["script", "sync", "data", "all"],
+                        help="Which bundled self-test to run: 'script' (state/ops/dataloader/"
+                             "training parity), 'sync' (gradient accumulation semantics), "
+                             "'data' (distributed data loop), or 'all'.")
     if subparsers is not None:
         parser.set_defaults(func=test_command)
     return parser
 
 
+_SUITES = {
+    "script": "test_script.py",
+    "sync": "test_sync.py",
+    "data": "test_distributed_data_loop.py",
+}
+
+
 def test_command(args) -> int:
     import os
 
-    script = Path(__file__).parent.parent / "test_utils" / "scripts" / "test_script.py"
+    import subprocess
+
+    selected = getattr(args, "suite", "script")
+    suites = list(_SUITES) if selected == "all" else [selected]
+    if args.on_device:
+        os.environ["ACCELERATE_SELF_TEST_ON_DEVICE"] = "1"
+    for suite in suites:
+        try:
+            result = _run_one(
+                args, Path(__file__).parent.parent / "test_utils" / "scripts" / _SUITES[suite]
+            )
+        except subprocess.CalledProcessError as err:
+            # The launcher raises for a failing child; surface a clean failure, not a traceback.
+            print(f"Self-test suite '{suite}' FAILED (exit code {err.returncode}).")
+            return err.returncode or 1
+        if result != 0:
+            print(f"Self-test suite '{suite}' FAILED (exit code {result}).")
+            return result
+    print("Test is a success! You are ready for your distributed training!")
+    return 0
+
+
+def _run_one(args, script: Path) -> int:
     from types import SimpleNamespace
 
     from .launch import launch_command
-
-    if args.on_device:
-        os.environ["ACCELERATE_SELF_TEST_ON_DEVICE"] = "1"
 
     launch_args = SimpleNamespace(
         cpu=not args.on_device,
@@ -53,10 +84,7 @@ def test_command(args) -> int:
         config_file=args.config_file, module=False, no_python=False,
         training_script=str(script), training_script_args=[],
     )
-    result = launch_command(launch_args)
-    if result == 0:
-        print("Test is a success! You are ready for your distributed training!")
-    return result
+    return launch_command(launch_args)
 
 
 def main():

@@ -1,0 +1,194 @@
+"""Bundled distributed data-loop self-test (reference
+``test_utils/scripts/test_distributed_data_loop.py``, 411 LoC).
+
+Reference invariants, re-expressed for the mesh runtime:
+
+- even_batches=True (default): ragged tails are padded with duplicates, every rank sees the
+  same batch count, and ``gather_for_metrics`` trims the duplicates exactly
+- even_batches=False: no padding — the tail batch is genuinely smaller, and
+  ``join_uneven_inputs`` scopes an override of the config flag
+- ``skip_first_batches`` resumes exactly at batch k of the same epoch order
+- stateful dataloader: ``state_dict``/``load_state_dict`` mid-epoch resume yields the
+  untrained remainder, not a reshuffle
+- shard mode and dispatch mode deliver the same global sample multiset
+
+Run standalone (defaults to the 8-device CPU simulator) or under
+``accelerate-tpu launch --num-processes N``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from accelerate_tpu.test_utils.scripts.test_script import _ensure_backend
+
+_ensure_backend()
+
+import numpy as np  # noqa: E402
+
+
+class _IdxDataset:
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return {"idx": np.int32(i)}
+
+
+def _reset():
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def _collect(dl):
+    out = []
+    for batch in dl:
+        out.append(np.asarray(batch["idx"]).reshape(-1).tolist())
+    return out
+
+
+def test_even_batches_padding_and_metric_trim():
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.data_loader import DataLoader
+
+    _reset()
+    acc = Accelerator()
+    n = acc.num_processes
+    # 10 samples with global batch 4n → 3 groups, the tail padded from 2n up to 4n.
+    total = 10 * max(n, 1)
+    # batch_size is per-process (reference semantics) → global batch 4*n, ragged tail padded.
+    dl = acc.prepare_data_loader(
+        DataLoader(_IdxDataset(total), batch_size=4), device_placement=False
+    )
+    gathered = []
+    for batch in dl:
+        gathered.append(np.asarray(acc.gather_for_metrics(batch["idx"])).reshape(-1))
+    flat = np.concatenate(gathered)
+    assert flat.shape[0] == total, f"gather_for_metrics kept duplicates: {flat.shape[0]} != {total}"
+    assert sorted(flat.tolist()) == list(range(total)), "metric trim lost or duplicated samples"
+    print("even_batches padding + gather_for_metrics trim: OK")
+
+
+def test_uneven_batches_and_join():
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.data_loader import DataLoader, prepare_data_loader
+
+    _reset()
+    acc = Accelerator()
+    n = max(acc.num_processes, 1)
+    total = 4 * n + n  # two full global batches of 2n, plus a ragged tail of n
+    dl = prepare_data_loader(
+        DataLoader(_IdxDataset(total), batch_size=2), put_on_device=False, even_batches=False
+    )
+    # No padding means the union of per-rank streams carries each sample EXACTLY once —
+    # manifesting as a short tail batch (1 process) or unequal per-rank batch counts
+    # (reference behavior that torch's join() exists to absorb).
+    from accelerate_tpu.utils import gather_object
+
+    mine = _collect(dl)
+    all_ranks = gather_object(mine)
+    flat = [i for rank in all_ranks for batch in rank for i in batch]
+    assert sorted(flat) == list(range(total)), (
+        f"even_batches=False must deliver each sample exactly once: {sorted(flat)}"
+    )
+    if n == 1:
+        sizes = [len(b) for b in mine]
+        assert sizes[-1] < sizes[0], f"tail batch was padded despite even_batches=False: {sizes}"
+
+    # join_uneven_inputs scopes the flag override (reference `:1197` semantics).
+    prev = acc.dataloader_config.even_batches
+    with acc.join_uneven_inputs([], even_batches=False):
+        assert acc.dataloader_config.even_batches is False
+    assert acc.dataloader_config.even_batches == prev
+    print("even_batches=False tails + join_uneven_inputs: OK")
+
+
+def test_skip_first_batches():
+    from accelerate_tpu.data_loader import DataLoader, prepare_data_loader, skip_first_batches
+
+    _reset()
+    from accelerate_tpu import Accelerator
+
+    acc = Accelerator()
+    n = max(acc.num_processes, 1)
+    dl = prepare_data_loader(DataLoader(_IdxDataset(24 * n), batch_size=4), put_on_device=False)
+    full = _collect(dl)
+    resumed = _collect(skip_first_batches(dl, 2))
+    assert resumed == full[2:], "skip_first_batches did not resume at batch 2"
+    print("skip_first_batches: OK")
+
+
+def test_stateful_mid_epoch_resume():
+    from accelerate_tpu.data_loader import DataLoader, prepare_data_loader
+
+    _reset()
+    from accelerate_tpu import Accelerator
+
+    acc = Accelerator()
+    n = max(acc.num_processes, 1)
+    make = lambda: prepare_data_loader(  # noqa: E731
+        DataLoader(_IdxDataset(16 * n), batch_size=2, shuffle=True),
+        put_on_device=False,
+        use_stateful_dataloader=True,
+        data_seed=11,
+    )
+    dl = make()
+    dl.set_epoch(0)
+    it = iter(dl)
+    head = [np.asarray(next(it)["idx"]).reshape(-1).tolist() for _ in range(3)]
+    snapshot = dl.state_dict()
+
+    fresh = make()
+    fresh.load_state_dict(snapshot)
+    tail = _collect(fresh)
+
+    reference_dl = make()
+    reference_dl.set_epoch(0)
+    want = _collect(reference_dl)
+    assert head + tail == want, "stateful resume replayed or skipped batches"
+    print("stateful mid-epoch resume: OK")
+
+
+def test_shard_vs_dispatch_same_samples():
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.data_loader import DataLoader, prepare_data_loader
+    from accelerate_tpu.utils import gather_object
+
+    _reset()
+    acc = Accelerator()
+    n = max(acc.num_processes, 1)
+    total = 12 * n
+    shard = prepare_data_loader(DataLoader(_IdxDataset(total), batch_size=3), put_on_device=False)
+    dispatch = prepare_data_loader(
+        DataLoader(_IdxDataset(total), batch_size=3), put_on_device=False, dispatch_batches=True
+    )
+    seen_shard = sorted(set(i for rank in gather_object(sum(_collect(shard), [])) for i in rank))
+    seen_dispatch = sorted(set(i for rank in gather_object(sum(_collect(dispatch), [])) for i in rank))
+    assert seen_shard == seen_dispatch == list(range(total)), "shard/dispatch sample sets differ"
+    print("shard == dispatch sample coverage: OK")
+
+
+def main():
+    import jax
+
+    print(
+        f"data-loop self-test: backend={jax.default_backend()} devices={jax.device_count()} "
+        f"processes={jax.process_count()}"
+    )
+    test_even_batches_padding_and_metric_trim()
+    test_uneven_batches_and_join()
+    test_skip_first_batches()
+    test_stateful_mid_epoch_resume()
+    test_shard_vs_dispatch_same_samples()
+    print("All data-loop self-tests passed.")
+
+
+if __name__ == "__main__":
+    sys.argv = sys.argv[:1]
+    main()

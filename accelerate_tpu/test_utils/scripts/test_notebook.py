@@ -54,5 +54,21 @@ def run_full_self_test():
     test_script.main()
 
 
+def run_sync_and_data_loop_self_tests():
+    """Child body: the bundled sync + distributed-data-loop suites under process_count()>1
+    (reference ships these as separate launchable scripts: ``test_sync.py``,
+    ``test_distributed_data_loop.py``)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from accelerate_tpu import PartialState
+    from accelerate_tpu.test_utils.scripts import test_distributed_data_loop, test_sync
+
+    PartialState()
+    assert jax.process_count() > 1, "multi-process tier ran single-process"
+    test_sync.main()
+    test_distributed_data_loop.main()
+
+
 if __name__ == "__main__":
     basic_function()

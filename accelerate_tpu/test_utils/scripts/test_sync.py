@@ -1,0 +1,190 @@
+"""Bundled gradient-accumulation/no_sync self-test (reference
+``test_utils/scripts/test_sync.py``, 410 LoC).
+
+The reference script checks DDP hook semantics: grads must NOT all-reduce on ``no_sync``
+micro-steps and must match a manual-DDP baseline at boundaries. Under the mesh runtime
+there are no hooks — accumulation lives inside the compiled step — so the invariants are
+re-expressed as:
+
+- host flag cadence: ``accumulate()`` raises ``sync_gradients`` every Nth entry, always at
+  ``end_of_dataloader``, and every time under ``sync_each_batch``
+- device semantics: params frozen between boundaries, optimizer ``step`` counts boundaries
+- **parity: accumulated micro-batches == one large batch** (mean-loss scaling correct)
+- scheduler/optimizer wrappers skip on non-sync steps
+
+Run standalone (defaults to the 8-device CPU simulator) or under
+``accelerate-tpu launch --num-processes N``.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from accelerate_tpu.test_utils.scripts.test_script import _ensure_backend
+
+_ensure_backend()
+
+import numpy as np  # noqa: E402
+
+
+def _reset():
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def test_accumulate_flag_cadence():
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.data_loader import DataLoader
+    from accelerate_tpu.test_utils.training import RegressionDataset
+
+    _reset()
+    acc = Accelerator(gradient_accumulation_steps=3)
+    flags = []
+    for _ in range(6):
+        with acc.accumulate():
+            flags.append(acc.sync_gradients)
+    assert flags == [False, False, True, False, False, True], flags
+
+    # end_of_dataloader forces a sync on a short tail group (reference `:289`): 5 global
+    # batches with accumulate=3 → the 5th is a tail micro-step that must still apply.
+    _reset()
+    acc = Accelerator(gradient_accumulation_steps=3)
+    # batch_size is per-process (reference semantics): 5 iterations on every rank.
+    n = max(acc.num_processes, 1)
+    dl = acc.prepare(DataLoader(RegressionDataset(length=20 * n), batch_size=4))
+    flags = []
+    for _batch in dl:
+        with acc.accumulate():
+            flags.append(acc.sync_gradients)
+    assert flags == [False, False, True, False, True], (
+        f"tail group must sync at end_of_dataloader: {flags}"
+    )
+    print("accumulate() flag cadence (incl. end-of-dataloader tail): OK")
+
+
+def test_sync_each_batch_plugin():
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import GradientAccumulationPlugin
+
+    _reset()
+    acc = Accelerator(
+        gradient_accumulation_plugin=GradientAccumulationPlugin(num_steps=4, sync_each_batch=True)
+    )
+    flags = []
+    for _ in range(4):
+        with acc.accumulate():
+            flags.append(acc.sync_gradients)
+    assert flags == [True] * 4, flags
+    print("sync_each_batch: OK")
+
+
+def test_no_sync_suppresses_flag():
+    from accelerate_tpu import Accelerator
+
+    _reset()
+    acc = Accelerator()
+    assert acc.sync_gradients
+    with acc.no_sync():
+        assert not acc.sync_gradients
+    assert acc.sync_gradients
+    print("no_sync(): OK")
+
+
+def test_device_accumulation_and_big_batch_parity():
+    """Accumulated micro-steps must (a) not move params mid-group and (b) equal one
+    large-batch step at the boundary (the reference's manual-DDP comparison)."""
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.test_utils.training import linear_regression_loss, make_regression_state
+
+    accumulate = 4
+    rng = np.random.default_rng(3)
+    xs = rng.normal(size=(accumulate, 8, 16)).astype(np.float32)
+    ys = (2.0 * xs + 1.0).astype(np.float32)
+
+    _reset()
+    acc = Accelerator(gradient_accumulation_steps=accumulate)
+    state = acc.create_train_state(make_regression_state(), optax.sgd(0.1))
+    step = acc.build_train_step(linear_regression_loss)
+    p_before = np.asarray(state.params["a"]).copy()
+    for micro in range(accumulate):
+        batch = {"x": jnp.asarray(xs[micro]), "y": jnp.asarray(ys[micro])}
+        state, _ = step(state, batch)
+        if micro < accumulate - 1:
+            assert np.array_equal(np.asarray(state.params["a"]), p_before), (
+                "params moved on a non-boundary micro-step"
+            )
+    assert int(state.step) == 1, f"expected exactly one optimizer step, got {int(state.step)}"
+
+    # Baseline: one step on the concatenated batch (mean loss ≡ mean of per-micro means
+    # because every micro-batch has equal size).
+    _reset()
+    acc2 = Accelerator()
+    state2 = acc2.create_train_state(make_regression_state(), optax.sgd(0.1))
+    step2 = acc2.build_train_step(linear_regression_loss)
+    big = {"x": jnp.asarray(xs.reshape(-1, 16)), "y": jnp.asarray(ys.reshape(-1, 16))}
+    state2, _ = step2(state2, big)
+    for key in ("a", "b"):
+        got = float(np.asarray(state.params[key]))
+        want = float(np.asarray(state2.params[key]))
+        assert abs(got - want) < 1e-5, f"accumulation != big batch for {key}: {got} vs {want}"
+    print("device accumulation + big-batch parity: OK")
+
+
+def test_wrappers_skip_on_non_sync():
+    import optax
+
+    from accelerate_tpu import Accelerator
+
+    class ToyScheduler:
+        def __init__(self):
+            self.steps = 0
+
+        def step(self):
+            self.steps += 1
+
+        def state_dict(self):
+            return {"steps": self.steps}
+
+        def load_state_dict(self, sd):
+            self.steps = sd["steps"]
+
+    _reset()
+    acc = Accelerator(gradient_accumulation_steps=2)
+    acc.prepare(optax.sgd(0.1))
+    sched = acc.prepare(ToyScheduler())
+    # split_batches=False: each sync advances the scheduler num_processes× (reference
+    # scheduler.py:70-82 — the global batch scales with world size).
+    n = max(acc.num_processes, 1)
+    for expected_steps in (0, n, n, 2 * n):
+        with acc.accumulate():
+            sched.step()
+        assert sched.scheduler.steps == expected_steps, (
+            f"scheduler stepped on a non-sync batch: {sched.scheduler.steps} != {expected_steps}"
+        )
+    print("scheduler skip on non-sync: OK")
+
+
+def main():
+    import jax
+
+    print(
+        f"sync self-test: backend={jax.default_backend()} devices={jax.device_count()} "
+        f"processes={jax.process_count()}"
+    )
+    test_accumulate_flag_cadence()
+    test_sync_each_batch_plugin()
+    test_no_sync_suppresses_flag()
+    test_device_accumulation_and_big_batch_parity()
+    test_wrappers_skip_on_non_sync()
+    print("All sync self-tests passed.")
+
+
+if __name__ == "__main__":
+    sys.argv = sys.argv[:1]
+    main()

@@ -2,6 +2,7 @@
 env serialization; test_utils/scripts self-test invariants run in-process elsewhere)."""
 
 import json
+import pathlib
 import os
 import subprocess
 import sys
@@ -440,3 +441,20 @@ def test_full_config_env_consumers(monkeypatch):
     monkeypatch.setenv("ACCELERATE_CHECKPOINT_TOTAL_LIMIT", "5")
     proj = ProjectConfiguration()
     assert proj.project_dir == "/tmp/proj_env" and proj.total_limit == 5
+
+
+def test_test_command_suite_selection():
+    """`accelerate-tpu test --suite` maps to the bundled scripts (reference commands/test.py)."""
+    from accelerate_tpu.commands.test import _SUITES, test_command_parser
+
+    parser = test_command_parser()
+    assert parser.parse_args([]).suite == "script"
+    assert parser.parse_args(["--suite", "all"]).suite == "all"
+    with pytest.raises(SystemExit):
+        parser.parse_args(["--suite", "nope"])
+    # Resolve from the imported package, mirroring test_command's own path logic.
+    import accelerate_tpu.commands.test as test_mod
+
+    for script in _SUITES.values():
+        path = pathlib.Path(test_mod.__file__).parent.parent / "test_utils" / "scripts" / script
+        assert path.exists(), f"bundled suite script missing: {script}"

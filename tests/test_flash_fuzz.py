@@ -80,7 +80,7 @@ CASES = [_case(s) for s in range(16)]
 
 @pytest.mark.parametrize(
     "case",
-    [pytest.param(c, marks=() if i < 4 else _slow, id=f"s{c['seed']}") for i, c in enumerate(CASES)],
+    [pytest.param(c, marks=() if i < 1 else _slow, id=f"s{c['seed']}") for i, c in enumerate(CASES)],
 )
 def test_flash_fuzz_parity(case):
     q, k, v, mask, segment_ids = _build(case)

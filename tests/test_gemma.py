@@ -16,6 +16,7 @@ import pytest
 
 from accelerate_tpu.models import llama
 from accelerate_tpu.models.hf_interop import gemma2_config_from_hf, gemma2_from_hf
+from accelerate_tpu.test_utils.testing import slow
 
 transformers = pytest.importorskip("transformers")
 
@@ -44,6 +45,7 @@ def _tiny_hf():
     return hf_cfg, model
 
 
+@slow
 def test_logits_match_transformers():
     hf_cfg, model = _tiny_hf()
     cfg = gemma2_config_from_hf(hf_cfg, dtype=jnp.float32, remat=False)
@@ -63,6 +65,7 @@ def test_logits_match_transformers():
     np.testing.assert_allclose(ours, hf_logits, atol=2e-4, rtol=1e-3)
 
 
+@slow
 def test_cached_decode_matches_forward():
     hf_cfg, model = _tiny_hf()
     cfg = gemma2_config_from_hf(hf_cfg, dtype=jnp.float32, remat=False)
@@ -99,6 +102,7 @@ def test_generate_runs():
     assert out.shape == (1, 5)
 
 
+@slow
 def test_scan_layers_matches_loop_with_alternating_windows():
     """Gemma under scan_layers: the grouped pair-scan (banded layer + full layer per scan
     step) must equal the python-loop stack — forward and cached decode."""
@@ -174,6 +178,7 @@ def test_flash_softcap_matches_xla():
         )
 
 
+@slow
 def test_model_flash_equals_xla_with_softcap():
     """Full Gemma-shaped forward: the flash path (in-kernel capping + banded layers) must
     equal the masked-XLA path."""

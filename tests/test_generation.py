@@ -254,6 +254,7 @@ class TestSpeculative:
         counts = np.bincount(np.asarray(tokens), minlength=4) / n
         np.testing.assert_allclose(counts, np.asarray(p), atol=0.005)
 
+    @slow
     def test_sampled_speculative_runs_and_needs_rng(self):
         tp, tc, dp, dc = self._models()
         prompt = np.asarray([3, 5, 7], np.int32)

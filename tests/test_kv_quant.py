@@ -15,6 +15,7 @@ import pytest
 
 from accelerate_tpu.models import llama
 from accelerate_tpu.models.llama import _quant_kv
+from accelerate_tpu.test_utils.testing import slow
 
 CFG = dataclasses.replace(llama.CONFIGS["tiny"], dtype=jnp.float32)
 QCFG = dataclasses.replace(CFG, kv_quant=True)
@@ -82,6 +83,7 @@ def test_serving_engine_with_quantized_cache():
     assert all(0 <= t < QCFG.vocab_size for t in req.tokens)
 
 
+@slow
 def test_gpt_cached_forward_close_to_unquantized():
     """The GPT family shares the int8 planes through models/common.write_kv/read_kv."""
     from accelerate_tpu.models import gpt

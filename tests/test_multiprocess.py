@@ -13,7 +13,11 @@ Reference analog: ``tests/test_multigpu.py`` launching
 import pytest
 
 from accelerate_tpu import notebook_launcher
-from accelerate_tpu.test_utils.scripts.test_notebook import run_full_self_test
+from accelerate_tpu.test_utils.scripts.test_notebook import (
+    run_full_self_test,
+    run_sync_and_data_loop_self_tests,
+)
+from accelerate_tpu.test_utils.testing import slow
 from accelerate_tpu.utils.environment import patch_environment
 
 
@@ -21,4 +25,14 @@ def test_full_self_test_two_processes_eight_devices():
     with patch_environment(ACCELERATE_USE_CPU="true", JAX_PLATFORMS="cpu"):
         notebook_launcher(
             run_full_self_test, num_processes=2, devices_per_process=4
+        )
+
+
+@slow
+def test_sync_and_data_loop_two_processes():
+    """The shipped test_sync/test_distributed_data_loop suites over real 2-process
+    transport (their standalone forms run in the CLI path: ``accelerate-tpu test --suite all``)."""
+    with patch_environment(ACCELERATE_USE_CPU="true", JAX_PLATFORMS="cpu"):
+        notebook_launcher(
+            run_sync_and_data_loop_self_tests, num_processes=2, devices_per_process=4
         )

@@ -112,6 +112,7 @@ def test_packed_loss_matches_unpacked_sum():
     np.testing.assert_allclose(packed_loss, total / count, rtol=2e-5)
 
 
+@slow
 def test_positions_derived_from_segments_matches_explicit():
     """loss_fn without the positions key must derive per-segment positions itself."""
     cfg = dataclasses.replace(llama.CONFIGS["tiny"], dtype=jnp.float32)
@@ -161,6 +162,7 @@ def test_packed_flash_matches_xla_path():
     )
 
 
+@slow
 def test_gpt_packed_loss_matches_unpacked_sum():
     """GPT packed CE (learned + rotary variants) == token-weighted per-sequence CE."""
     from accelerate_tpu.models import gpt

@@ -164,6 +164,7 @@ def test_load_and_quantize_model_llama():
     assert agree > 0.8, f"int8 quantization changed predictions too much (agree={agree})"
 
 
+@slow
 def test_dequantize_model_roundtrip():
     cfg = dataclasses.replace(llama.CONFIGS["tiny"], attn_impl="xla")
     params = llama.init_params(cfg, jax.random.PRNGKey(0))

@@ -67,6 +67,7 @@ def test_sp_attention_gqa(sp_mesh, mode):
 
 
 @pytest.mark.parametrize("mode", ["ring", "ulysses", "allgather"])
+@slow
 def test_sp_attention_gqa_gradient_parity(sp_mesh, mode):
     """GQA (K < H) gradients: covers the unrepeated ring dk/dv carry and the kernels'
     group-accumulating dkv grid — dk/dv must come back [B, S, K, hd], matching reference

@@ -15,6 +15,7 @@ import pytest
 
 from accelerate_tpu.models import llama
 from accelerate_tpu.ops.flash_attention import flash_attention
+from accelerate_tpu.test_utils.testing import slow
 
 CFG = dataclasses.replace(
     llama.CONFIGS["tiny"], dtype=jnp.float32, sliding_window=24, max_seq=128
@@ -48,6 +49,7 @@ def test_flash_window_matches_masked_reference(S, window):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=3e-5)
 
 
+@slow
 def test_flash_window_gradients_match():
     rng = np.random.default_rng(1)
     S, window = 96, 24
@@ -99,6 +101,7 @@ def test_window_changes_logits():
     assert float(jnp.max(jnp.abs(narrow[:, -1] - full[:, -1]))) > 1e-3
 
 
+@slow
 def test_cached_decode_matches_uncached_window():
     """Windowed KV-cache decode == windowed full forward at every step (greedy argmax and
     logits both)."""

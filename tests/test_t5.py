@@ -56,6 +56,7 @@ def test_tp_sharded_matches_single():
     np.testing.assert_allclose(float(m["loss"]), base, rtol=2e-5)
 
 
+@slow
 def test_ignored_labels_do_not_contribute():
     params = t5.init_params(CFG)
     b1 = make_batch(2, 8, 6, seed=1)
@@ -70,6 +71,7 @@ def test_ignored_labels_do_not_contribute():
     assert not np.isclose(l1, l2)
 
 
+@slow
 def test_remat_matches_no_remat():
     """cfg.remat (now consumed via models/common.remat_wrap) must be numerically inert:
     identical loss with and without activation checkpointing, and grads must flow."""
