@@ -25,6 +25,7 @@ __all__ = [
     "default_config_file",
     "load_config_from_file",
     "save_config",
+    "write_basic_config",
     "config_command",
     "config_command_parser",
 ]
@@ -114,6 +115,42 @@ def save_config(config: ClusterConfig, path: Optional[str] = None) -> str:
 
         Path(path).write_text(yaml.safe_dump(data, sort_keys=False))
     return str(path)
+
+
+def write_basic_config(mixed_precision: str = "no", save_location: Optional[str] = None):
+    """Create and save a basic config non-interactively (reference
+    ``commands/config/default.py:36``, exported as ``accelerate.utils.write_basic_config``).
+
+    Probes the local backend for the device count and writes a single-machine config that
+    fills the ``dp`` mesh axis. Returns the path written, or ``False`` if a config already
+    exists there (reference semantics: never override silently).
+    """
+    save_location = save_location or default_yaml_config_file
+    path = Path(save_location)
+    if path.exists():
+        print(
+            f"Configuration already exists at {save_location}, will not override. "
+            "Run `accelerate-tpu config` manually or pass a different `save_location`."
+        )
+        return False
+    mixed_precision = mixed_precision.lower()
+    if mixed_precision not in ("no", "fp16", "bf16", "fp8"):
+        raise ValueError(
+            f"`mixed_precision` should be one of 'no', 'fp16', 'bf16', or 'fp8'; got {mixed_precision}"
+        )
+    try:
+        import jax
+
+        num_devices = jax.local_device_count()
+        use_cpu = jax.default_backend() == "cpu"
+    except Exception:  # backend unavailable (e.g. tunnel down) — still write a sane default
+        num_devices, use_cpu = 1, True
+    config = ClusterConfig(
+        distributed_type="MULTI_DEVICE" if num_devices > 1 else "NO",
+        mixed_precision=mixed_precision,
+        use_cpu=use_cpu,
+    )
+    return save_config(config, str(path))
 
 
 def load_config_from_file(path: Optional[str] = None) -> ClusterConfig:

@@ -7,6 +7,7 @@ core framework never hard-imports anything beyond jax/numpy.
 
 from __future__ import annotations
 
+import importlib.metadata
 import importlib.util
 import functools
 
@@ -35,6 +36,10 @@ __all__ = [
     "is_yaml_available",
     "is_tpu_available",
     "is_multihost",
+    "is_bf16_available",
+    "is_fp8_available",
+    "compare_versions",
+    "is_jax_version",
 ]
 
 
@@ -92,3 +97,46 @@ def is_multihost() -> bool:
     import jax
 
     return jax.process_count() > 1
+
+
+def is_bf16_available(ignore_tpu: bool = False) -> bool:
+    """bf16 capability probe (reference ``imports.py:137``). TPUs compute bf16 natively and
+    the CPU simulator emulates it, so this is effectively always True here; the signature
+    (incl. the vestigial ``ignore_tpu``) is kept for reference API compatibility."""
+    return True
+
+
+def is_fp8_available() -> bool:
+    """fp8 capability probe (reference ``imports.py`` TE/ao/MS-AMP checks). Here fp8 is
+    native (``jnp.float8_e4m3fn`` scaled matmuls in ``ops/fp8.py``), so the probe checks the
+    dtype exists in the installed jax rather than any vendor library."""
+    import jax.numpy as jnp
+
+    return hasattr(jnp, "float8_e4m3fn")
+
+
+def compare_versions(library_or_version, operation: str, requirement_version: str) -> bool:
+    """Compare an installed library's version against ``requirement_version`` (reference
+    ``utils/versions.py:compare_versions``). ``library_or_version`` is a module name or an
+    already-resolved version string; ``operation`` is one of <, <=, ==, !=, >=, >."""
+    import operator
+
+    from packaging.version import parse
+
+    ops = {"<": operator.lt, "<=": operator.le, "==": operator.eq,
+           "!=": operator.ne, ">=": operator.ge, ">": operator.gt}
+    if operation not in ops:
+        raise ValueError(f"operation must be one of {sorted(ops)}, got {operation!r}")
+    if isinstance(library_or_version, str):
+        try:
+            library_or_version = importlib.metadata.version(library_or_version)
+        except importlib.metadata.PackageNotFoundError:
+            pass  # already a version string (or will fail clearly in parse below)
+    return ops[operation](parse(str(library_or_version)), parse(requirement_version))
+
+
+def is_jax_version(operation: str, version: str) -> bool:
+    """``is_torch_version`` analog for the runtime that actually matters here."""
+    import jax
+
+    return compare_versions(jax.__version__, operation, version)

@@ -42,6 +42,7 @@ __all__ = [
     "get_shape",
     "initialize_tensors",
     "find_batch_size",
+    "find_device",
     "ignorant_find_batch_size",
     "listify",
     "gather",
@@ -125,6 +126,26 @@ def recursively_apply(
 
 
 # --------------------------------------------------------------------------- device movement
+def find_device(data):
+    """Device of the first array leaf in a nested structure (reference ``operations.py:827``);
+    ``None`` when no committed array is found."""
+    if isinstance(data, Mapping):
+        for obj in data.values():
+            device = find_device(obj)
+            if device is not None:
+                return device
+    elif isinstance(data, (tuple, list)):
+        for obj in data:
+            device = find_device(obj)
+            if device is not None:
+                return device
+    elif is_tensor(data) and hasattr(data, "devices"):
+        devices = data.devices()
+        if devices:
+            return next(iter(devices))
+    return None
+
+
 def send_to_device(tensor, device, non_blocking: bool = False, skip_keys=None):
     """Recursively move/commit a batch to a device or sharding (reference ``operations.py:135``).
 

@@ -10,7 +10,7 @@ import socket
 import warnings
 from contextlib import contextmanager
 from pathlib import Path
-from typing import Any
+from typing import Any, Optional
 
 import numpy as np
 
@@ -136,3 +136,22 @@ def get_free_port() -> int:
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
         s.bind(("", 0))
         return s.getsockname()[1]
+
+
+def is_port_in_use(port: Optional[int] = None) -> bool:
+    """True if ``port`` is already bound on localhost (reference ``other.py:305``) — used to
+    catch a stale coordinator before a launch rendezvous."""
+    if port is None:
+        port = 29500
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        return s.connect_ex(("localhost", int(port))) == 0
+
+
+def merge_dicts(source: dict, destination: dict) -> dict:
+    """Recursively merge ``source`` into ``destination`` (reference ``other.py:290``)."""
+    for key, value in source.items():
+        if isinstance(value, dict) and isinstance(destination.get(key), dict):
+            merge_dicts(value, destination[key])
+        else:
+            destination[key] = value
+    return destination

@@ -1,0 +1,35 @@
+#!/bin/bash
+# Follow-up TPU work session: the reference's headline big-model-inference table, run after
+# the MFU session (benchmarks/tpu_session.sh) completes. Chained, not merged, because the
+# MFU session script may already be executing (bash reads scripts incrementally — editing a
+# running script corrupts it).
+#
+# Rows mirror /root/reference/benchmarks/big_model_inference/README.md:25-37 mapped to one
+# v5e chip: in-HBM where 16 GB allows, host/disk streaming where it doesn't.
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (MFU session) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 60; done
+fi
+
+echo "=== waiting for TPU ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+
+run_row() {
+  name="$1"; shift
+  echo "=== inference row: $name ==="
+  timeout "${ROW_TIMEOUT:-1200}" python benchmarks/big_model_inference/inference_tpu.py "$@" --markdown
+  echo "row $name rc=$?"
+  # Re-probe between rows; a dead tunnel should skip fast, not eat every timeout.
+  python benchmarks/mfu_sweep.py --per-run-timeout 1 --only __none__ >/dev/null 2>&1 || {
+    echo "TPU went away after $name; re-arming wait"; \
+    python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true; }
+}
+
+run_row gptj6b-bf16      gptj-6b --dtype bf16
+run_row t0pp-bf16-host   t0pp --dtype bf16 --offload host
+run_row neox20b-host     gpt-neox-20b --dtype bf16 --offload host
+run_row opt30b-disk      opt-30b --dtype bf16 --offload disk
+echo "=== inference session done ==="

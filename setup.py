@@ -10,7 +10,7 @@ setup(
     packages=find_packages(include=["accelerate_tpu", "accelerate_tpu.*"]),
     package_data={"accelerate_tpu.native": ["*.cpp"]},
     python_requires=">=3.10",
-    install_requires=["jax", "numpy", "optax", "orbax-checkpoint", "safetensors", "pyyaml"],
+    install_requires=["jax", "numpy", "optax", "orbax-checkpoint", "safetensors", "pyyaml", "packaging"],
     entry_points={
         "console_scripts": [
             "accelerate-tpu = accelerate_tpu.commands.accelerate_cli:main",

@@ -129,3 +129,42 @@ def test_get_tpu_info_probes():
     assert "device_kind" in info
     # GCE metadata is absent in this sandbox — bounded probe must not raise or hang.
     assert "gce_accelerator" not in info or isinstance(info["gce_accelerator"], str)
+
+
+def test_parity_helper_apis(tmp_path):
+    """Reference-parity helpers: find_device, merge_dicts, is_port_in_use, version probes,
+    write_basic_config (reference utils/__init__ surface)."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.commands.config import load_config_from_file, write_basic_config
+    from accelerate_tpu.utils import (
+        compare_versions,
+        find_device,
+        is_bf16_available,
+        is_fp8_available,
+        is_jax_version,
+        is_port_in_use,
+        merge_dicts,
+    )
+
+    assert is_bf16_available() and is_fp8_available()
+    assert compare_versions("numpy", ">=", "1.0")
+    assert is_jax_version(">=", "0.4")
+    with pytest.raises(ValueError):
+        compare_versions("numpy", "~=", "1.0")
+
+    assert find_device({"a": [None, 3], "b": jnp.ones(2)}) is not None
+    assert find_device({"a": [1, "x"]}) is None
+
+    dest = {"a": {"b": 1}, "k": 0}
+    assert merge_dicts({"a": {"c": 2}, "k": 9}, dest) == {"a": {"b": 1, "c": 2}, "k": 9}
+
+    assert isinstance(is_port_in_use(1), bool)
+
+    loc = tmp_path / "basic.yaml"
+    assert write_basic_config("bf16", str(loc))
+    cfg = load_config_from_file(str(loc))
+    assert cfg.mixed_precision == "bf16"
+    assert write_basic_config("bf16", str(loc)) is False  # existing config never overridden
+    with pytest.raises(ValueError):
+        write_basic_config("int3", str(tmp_path / "other.yaml"))
