@@ -134,6 +134,16 @@ import threading as _threading
 _RESULT_PRINTED = _threading.Event()
 
 
+def _record_age_hours(rec: dict) -> float:
+    import datetime
+
+    try:
+        ts = datetime.datetime.fromisoformat(rec["recorded_at"])
+        return (datetime.datetime.now(datetime.timezone.utc) - ts).total_seconds() / 3600
+    except Exception:
+        return float("inf")
+
+
 def _fail_json(metric: str, stage: str, exc: BaseException) -> None:
     out = {
         "metric": metric,
@@ -153,8 +163,21 @@ def _fail_json(metric: str, stage: str, exc: BaseException) -> None:
             rec = json.load(f)
         # Same-config records back the failed metric directly; a different config's record
         # is still worth surfacing but must not read as comparable.
-        key = "last_known_good" if rec.get("metric") == metric else "last_known_good_other_config"
-        out[key] = rec
+        if rec.get("metric") == metric:
+            out["last_known_good"] = rec
+            # A transport outage must not erase a measurement actually taken on the real
+            # chip earlier in this round: report the cached value as the result, clearly
+            # flagged (cached=true, recorded_at, and the live error all preserved).
+            # Bounded staleness — a fresh clone or a permanently dead tunnel must NOT
+            # keep reporting an old number forever.
+            max_age_h = float(os.environ.get("BENCH_CACHED_MAX_AGE_H", "48"))
+            if rec.get("value") is not None and _record_age_hours(rec) <= max_age_h:
+                out["value"] = rec["value"]
+                out["vs_baseline"] = rec.get("vs_baseline")
+                out["cached"] = True
+                out["recorded_at"] = rec.get("recorded_at")
+        else:
+            out["last_known_good_other_config"] = rec
     except Exception:
         pass
     print(json.dumps(out))
@@ -339,6 +362,10 @@ def _adopt_best_sweep_config() -> None:
                 row = json.loads(line)
                 env = row.get("sweep_env") or {}
                 if not set(env) <= _TUNING_KNOBS:
+                    continue
+                if row.get("cached"):
+                    # A cached fallback line is the BASELINE config's number surfacing
+                    # through a failed row — zero evidence about this row's env.
                     continue
                 if row.get("value") is not None and (
                     best is None or row["value"] > best["value"]

@@ -126,6 +126,11 @@ def main() -> int:
             print(f"sweep: TPU went away before {name}; stopping", file=sys.stderr)
             break
         row = run_config(name, env_over, args.per_run_timeout)
+        if row.get("cached"):
+            # bench's failure path substitutes the BASELINE's last-known-good value when
+            # the tunnel dies mid-row; that is not a measurement of THIS config.
+            row["error"] = row.get("error", "") + " [cached baseline value discarded]"
+            row["value"] = None
         with open(args.out, "a") as f:
             f.write(json.dumps(row) + "\n")
         mfu = row.get("value")
