@@ -23,6 +23,7 @@ __all__ = [
     "patch_environment",
     "purge_accelerate_environment",
     "get_tpu_info",
+    "subprocess_probe",
 ]
 
 _TRUE = {"1", "true", "yes", "y", "on"}
@@ -226,3 +227,23 @@ def _gce_metadata(path: str, timeout: float = 1.0):
     t.start()
     t.join(timeout + 0.5)
     return result[0] if result else None
+
+
+def subprocess_probe(code: str, timeout_s: float, sentinel: str = "ALIVE") -> bool:
+    """Run ``code`` in a fresh interpreter; True iff it prints ``sentinel`` within the timeout.
+
+    The one safe way to ask "can the backend initialize?" in this environment: a dead remote
+    tunnel makes backend init block forever with no error, and an in-process attempt would
+    wedge the caller behind jax's backend-init lock. A killed subprocess can't hurt us, and
+    the parent keeps the option of forcing a different platform afterwards.
+    """
+    import subprocess
+    import sys
+
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True, timeout=timeout_s
+        )
+        return sentinel in out.stdout
+    except Exception:
+        return False

@@ -48,20 +48,18 @@ CONFIGS = [
 
 
 def tpu_alive(timeout_s: float = 45.0) -> bool:
-    probe = (
+    sys.path.insert(0, REPO)
+    from accelerate_tpu.utils.environment import subprocess_probe
+
+    # Stricter than a bare init probe: the sweep needs real non-CPU compute to answer.
+    return subprocess_probe(
         "import jax, numpy as np, jax.numpy as jnp\n"
         "y = jnp.ones((256,256), jnp.bfloat16) @ jnp.ones((256,256), jnp.bfloat16)\n"
         "assert float(np.asarray(y)[0,0]) == 256.0\n"
         "assert jax.default_backend() != 'cpu'\n"
-        "print('ALIVE')\n"
+        "print('ALIVE')\n",
+        timeout_s,
     )
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c", probe], capture_output=True, text=True, timeout=timeout_s
-        )
-        return "ALIVE" in out.stdout
-    except subprocess.TimeoutExpired:
-        return False
 
 
 def run_config(name: str, env_over: dict, per_run_timeout: float) -> dict:
@@ -131,6 +129,9 @@ def main() -> int:
             # the tunnel dies mid-row; that is not a measurement of THIS config.
             row["error"] = row.get("error", "") + " [cached baseline value discarded]"
             row["value"] = None
+            row["vs_baseline"] = None
+            row.pop("cached", None)
+            row.pop("recorded_at", None)
         with open(args.out, "a") as f:
             f.write(json.dumps(row) + "\n")
         mfu = row.get("value")
