@@ -707,14 +707,33 @@ class Accelerator:
             fp8_state = DelayedScalingState.init(self.fp8_recipe.amax_history_len)
 
         optimizer._opt_state_ref = opt_state
+        # Scalars are committed mesh-replicated, not left on one device: a checkpoint
+        # restore templates its shardings on these leaves (`_abstractify`), and a
+        # single-device `step` restored into a >1-device mesh context is an error at the
+        # next jitted call (caught by tests/test_elastic.py preemption-resume parity).
+        replicated = NamedSharding(self.mesh, PartitionSpec())
+
+        def _counter():
+            # Distinct buffers: two leaves sharing one donated buffer would alias.
+            return jax.device_put(jnp.zeros((), dtype=jnp.int32), replicated)
+
+        def _replicate(tree):
+            # rng keys / fp8 amax histories get the same treatment as the counters.
+            return jax.tree_util.tree_map(
+                lambda leaf: jax.device_put(leaf, replicated)
+                if isinstance(leaf, (jax.Array, np.ndarray))
+                else leaf,
+                tree,
+            )
+
         return TrainState(
             params=params,
             opt_state=opt_state,
-            step=jnp.zeros((), dtype=jnp.int32),
+            step=_counter(),
             grad_accum=accum,
-            rng=rng,
-            micro=jnp.zeros((), dtype=jnp.int32),
-            fp8_state=fp8_state,
+            rng=_replicate(rng),
+            micro=_counter(),
+            fp8_state=_replicate(fp8_state),
         )
 
     def build_train_step(

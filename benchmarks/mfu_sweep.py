@@ -21,6 +21,8 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
 
 # (name, env overrides). Ordered: baseline first, then one-knob deltas, then combos.
 CONFIGS = [
@@ -48,7 +50,6 @@ CONFIGS = [
 
 
 def tpu_alive(timeout_s: float = 45.0) -> bool:
-    sys.path.insert(0, REPO)
     from accelerate_tpu.utils.environment import subprocess_probe
 
     # Stricter than a bare init probe: the sweep needs real non-CPU compute to answer.

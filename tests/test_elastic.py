@@ -112,3 +112,84 @@ def test_multi_process_launcher_restarts_through_cli(tmp_path):
     )
     assert result.returncode == 0, f"{result.stdout}\n{result.stderr}"
     assert flag.exists()
+
+
+TRAIN_RESUME = '''
+import os, sys
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.data_loader import DataLoader
+from accelerate_tpu.test_utils.training import (
+    RegressionDataset,
+    linear_regression_loss,
+    make_regression_state,
+)
+
+ckpt_dir, out_path, crash_flag = sys.argv[1], sys.argv[2], sys.argv[3]
+
+acc = Accelerator()
+ds = RegressionDataset(length=32)
+dl = acc.prepare(DataLoader(ds, batch_size=4))  # 8 deterministic batches = 8 steps
+state = acc.create_train_state(make_regression_state(), optax.sgd(0.1))
+step_fn = acc.build_train_step(linear_regression_loss)
+
+start = 0
+if os.path.isdir(ckpt_dir) and os.listdir(ckpt_dir):
+    state = acc.load_state(ckpt_dir, train_state=state)
+    start = int(np.asarray(state.step))
+    print(f"resumed from step {start}", flush=True)
+
+for i, batch in enumerate(acc.skip_first_batches(dl, start), start=start):
+    state, metrics = step_fn(state, batch)
+    acc.save_state(ckpt_dir, train_state=state)
+    if crash_flag != "none" and i == 3 and not os.path.exists(crash_flag):
+        open(crash_flag, "w").write("preempted")
+        os._exit(23)  # simulated TPU preemption mid-epoch, after the step-4 checkpoint
+
+np.savez(out_path, a=np.asarray(state.params["a"]), b=np.asarray(state.params["b"]),
+         step=int(np.asarray(state.step)))
+'''
+
+
+def test_preemption_resume_loss_parity(tmp_path):
+    """The full preemption story end-to-end: train → checkpoint each step → worker killed
+    mid-epoch → ElasticSupervisor restarts the gang → resume from the checkpoint
+    (load_state + skip_first_batches) → final params exactly match an uninterrupted run.
+
+    This is the integration of VERDICT r1 next #9 (elastic) with L7 checkpointing —
+    the 'TPU preemptions are routine' contract from SURVEY §7."""
+    import numpy as np
+
+    env = {**os.environ, "JAX_PLATFORMS": "cpu", "ACCELERATE_USE_CPU": "true"}
+
+    # Uninterrupted baseline.
+    base_out = tmp_path / "baseline.npz"
+    subprocess.run(
+        _worker_cmd(TRAIN_RESUME, str(tmp_path / "ckpt_base"), str(base_out), "none"),
+        check=True, env=env, timeout=300,
+    )
+
+    # Preempted + supervised run: attempt 1 dies at step 4, attempt 2 resumes and finishes.
+    crash_flag = tmp_path / "preempted"
+    resumed_out = tmp_path / "resumed.npz"
+
+    def make_plan(coordinator):
+        return [(
+            _worker_cmd(TRAIN_RESUME, str(tmp_path / "ckpt_elastic"), str(resumed_out),
+                        str(crash_flag)),
+            env,
+        )]
+
+    sup = ElasticSupervisor(make_plan, max_restarts=2, monitor_interval=0.1)
+    assert sup.run() == 0
+    assert sup.attempts_used == 2, "the simulated preemption must have triggered a restart"
+    assert crash_flag.exists()
+
+    base, resumed = np.load(base_out), np.load(resumed_out)
+    assert int(resumed["step"]) == int(base["step"]) == 8
+    np.testing.assert_allclose(resumed["a"], base["a"], rtol=0, atol=0)
+    np.testing.assert_allclose(resumed["b"], base["b"], rtol=0, atol=0)
