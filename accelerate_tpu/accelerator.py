@@ -41,7 +41,7 @@ from .data_loader import DataLoaderDispatcher, DataLoaderShard, prepare_data_loa
 from .logging import get_logger
 from .optimizer import AcceleratedOptimizer
 from .parallel.fsdp import shard_params
-from .parallel.mesh import MeshConfig
+from .parallel.mesh import MeshConfig, replicated as _mesh_replicated
 from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState
 from .utils.constants import BATCH_AXES
@@ -711,7 +711,7 @@ class Accelerator:
         # restore templates its shardings on these leaves (`_abstractify`), and a
         # single-device `step` restored into a >1-device mesh context is an error at the
         # next jitted call (caught by tests/test_elastic.py preemption-resume parity).
-        replicated = NamedSharding(self.mesh, PartitionSpec())
+        replicated = _mesh_replicated(self.mesh)
 
         def _counter():
             # Distinct buffers: two leaves sharing one donated buffer would alias.

@@ -24,10 +24,11 @@ def test_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("--on-device", "--on_device", action="store_true",
                         help="Run on the real backend instead of the 8-device CPU simulator.")
     parser.add_argument("--suite", default="script",
-                        choices=["script", "sync", "data", "all"],
+                        choices=["script", "sync", "data", "perf", "all"],
                         help="Which bundled self-test to run: 'script' (state/ops/dataloader/"
                              "training parity), 'sync' (gradient accumulation semantics), "
-                             "'data' (distributed data loop), or 'all'.")
+                             "'data' (distributed data loop), 'perf' (metric parity across "
+                             "parallelism layouts + steps/s), or 'all'.")
     if subparsers is not None:
         parser.set_defaults(func=test_command)
     return parser
@@ -37,6 +38,7 @@ _SUITES = {
     "script": "test_script.py",
     "sync": "test_sync.py",
     "data": "test_distributed_data_loop.py",
+    "perf": "test_performance.py",
 }
 
 

@@ -68,6 +68,9 @@ def run_sync_and_data_loop_self_tests():
     assert jax.process_count() > 1, "multi-process tier ran single-process"
     test_sync.main()
     test_distributed_data_loop.main()
+    from accelerate_tpu.test_utils.scripts import test_performance
+
+    test_performance.main()
 
 
 if __name__ == "__main__":
