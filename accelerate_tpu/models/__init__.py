@@ -1,3 +1,3 @@
 """Model families shipped with the framework (flagship: llama; plus gpt, bert, resnet, simple)."""
 
-from . import bert, gpt, llama, resnet, simple, t5
+from . import bert, gpt, llama, lora, resnet, simple, t5

@@ -129,6 +129,14 @@ class LlamaConfig:
     rope_low_freq_factor: float = 1.0
     rope_high_freq_factor: float = 4.0
     rope_original_max: int = 8192
+    # ---- LoRA (the reference's peft-integration analog, TPU-native) ----
+    # rank>0 adds frozen-base low-rank adapters on ``lora_targets``: the forward computes
+    # x@W + (x@A)@B·(alpha/rank) — the base weight is never materialized in adapted form,
+    # so memory stays base + O(rank) and the optimizer (``models.lora.lora_optimizer``)
+    # holds state only for adapter leaves. Dense projections only (moe experts excluded).
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: tuple = ("wq", "wk", "wv", "wo")
 
     @property
     def head_dim(self) -> int:
@@ -190,6 +198,8 @@ CONFIGS = {
 
 # --------------------------------------------------------------------------------- params
 def _layer_params(cfg: LlamaConfig, key) -> dict:
+    # fold_in (not split) so the base-weight stream is bit-identical with lora off/on.
+    lora_key = jax.random.fold_in(key, 0x10A4)
     k = jax.random.split(key, 8)
     D, H, K, hd, F = cfg.d_model, cfg.n_heads, cfg.n_kv_heads, cfg.head_dim, cfg.d_ff
     s_in = 1.0 / math.sqrt(D)
@@ -224,7 +234,27 @@ def _layer_params(cfg: LlamaConfig, key) -> dict:
             "w_up": jax.random.normal(k[5], (D, F), jnp.float32) * s_in,
             "w_down": jax.random.normal(k[6], (F, D), jnp.float32) * s_ff,
         })
+    if cfg.lora_rank > 0:
+        r = cfg.lora_rank
+        for i, name in enumerate(_lora_target_names(cfg)):
+            d_in, d_out = params[name].shape
+            # Standard LoRA init: A ~ N(0, 1/d_in), B = 0 → the adapted forward starts
+            # exactly equal to the base model.
+            params[f"{name}_lora_a"] = (
+                jax.random.normal(jax.random.fold_in(lora_key, i), (d_in, r), jnp.float32)
+                / math.sqrt(d_in)
+            )
+            params[f"{name}_lora_b"] = jnp.zeros((r, d_out), jnp.float32)
     return params
+
+
+def _lora_target_names(cfg: LlamaConfig) -> tuple:
+    """The subset of ``cfg.lora_targets`` that exists as dense projections."""
+    dense = {"wq", "wk", "wv", "wo"} | (set() if cfg.moe_experts > 0 else {"w_gate", "w_up", "w_down"})
+    unknown = set(cfg.lora_targets) - {"wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down"}
+    if unknown:
+        raise ValueError(f"lora_targets {sorted(unknown)} are not dense projection names")
+    return tuple(t for t in cfg.lora_targets if t in dense)
 
 
 def init_params(cfg: LlamaConfig, key: Optional[jax.Array] = None) -> dict:
@@ -287,6 +317,14 @@ def partition_specs(cfg: LlamaConfig, pp: bool = False) -> dict:
             "w_up": P(None, TENSOR_AXIS),
             "w_down": P(TENSOR_AXIS, None),
         })
+    if cfg.lora_rank > 0:
+        for name in _lora_target_names(cfg):
+            base = layer[name]
+            # A inherits the base's INPUT-dim placement, B its OUTPUT-dim placement, so the
+            # low-rank path reads the same activation shardings as the base matmul (and the
+            # rank dim — tiny — stays unsharded).
+            layer[f"{name}_lora_a"] = P(base[0], None)
+            layer[f"{name}_lora_b"] = P(None, base[1])
     if pp:
         if not cfg.scan_layers:
             raise ValueError("pipeline parallelism requires cfg.scan_layers=True")
@@ -453,6 +491,20 @@ def _proj(h, w, cfg: LlamaConfig):
     return h @ w.astype(cfg.dtype)
 
 
+def _proj_l(h, layer, name, cfg: LlamaConfig):
+    """``_proj`` + the layer's LoRA delta when adapters exist for ``name``.
+
+    The delta is computed low-rank — ``(h @ A) @ B`` — never as a materialized ``W + AB``,
+    so adapted training costs base-weights + O(rank) memory (``models/lora.py``).
+    """
+    out = _proj(h, layer[name], cfg)
+    if cfg.lora_rank > 0 and f"{name}_lora_a" in layer:
+        a = layer[f"{name}_lora_a"].astype(cfg.dtype)
+        b = layer[f"{name}_lora_b"].astype(cfg.dtype)
+        out = out + ((h @ a) @ b) * (cfg.lora_alpha / cfg.lora_rank)
+    return out
+
+
 def _mlp_gate_act(h: jax.Array, cfg: LlamaConfig) -> jax.Array:
     if cfg.mlp_act == "silu":
         return jax.nn.silu(h)
@@ -463,9 +515,9 @@ def _mlp_gate_act(h: jax.Array, cfg: LlamaConfig) -> jax.Array:
 
 def _qkv_proj(h, layer, cfg: LlamaConfig):
     """q/k/v projections (+ Qwen2-style biases when ``cfg.qkv_bias``)."""
-    q = _proj(h, layer["wq"], cfg)
-    k = _proj(h, layer["wk"], cfg)
-    v = _proj(h, layer["wv"], cfg)
+    q = _proj_l(h, layer, "wq", cfg)
+    k = _proj_l(h, layer, "wk", cfg)
+    v = _proj_l(h, layer, "wv", cfg)
     if cfg.qkv_bias:
         q = q + layer["bq"].astype(q.dtype)
         k = k + layer["bk"].astype(k.dtype)
@@ -487,7 +539,7 @@ def _block(x, layer, positions, mask, cfg: LlamaConfig, segment_ids=None):
     attn = _attention(q, k, v, mask, cfg, segment_ids).reshape(
         B, S, cfg.n_heads * cfg.head_dim
     )
-    attn_out = _proj(attn, layer["wo"], cfg)
+    attn_out = _proj_l(attn, layer, "wo", cfg)
     if cfg.post_norm:  # Gemma-2: normalize the sublayer OUTPUT before the residual add
         attn_out = _rms_norm(attn_out, layer["ln_attn_post"], cfg.norm_eps, p1)
     x = x + attn_out
@@ -501,9 +553,9 @@ def _block(x, layer, positions, mask, cfg: LlamaConfig, segment_ids=None):
             compute_dtype=cfg.dtype,
         )
         return x + y, aux
-    gate = _mlp_gate_act(_proj(h, layer["w_gate"], cfg), cfg)
-    up = _proj(h, layer["w_up"], cfg)
-    mlp_out = _proj(gate * up, layer["w_down"], cfg)
+    gate = _mlp_gate_act(_proj_l(h, layer, "w_gate", cfg), cfg)
+    up = _proj_l(h, layer, "w_up", cfg)
+    mlp_out = _proj_l(gate * up, layer, "w_down", cfg)
     if cfg.post_norm:
         mlp_out = _rms_norm(mlp_out, layer["ln_mlp_post"], cfg.norm_eps, p1)
     x = x + mlp_out
@@ -1036,7 +1088,7 @@ def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig):
         q, _read_cache(new_kv, "k", cfg.dtype), _read_cache(new_kv, "v", cfg.dtype),
         positions, valid, cfg,
     )
-    attn_out = _proj(attn.reshape(B, T, cfg.n_heads * cfg.head_dim), layer["wo"], cfg)
+    attn_out = _proj_l(attn.reshape(B, T, cfg.n_heads * cfg.head_dim), layer, "wo", cfg)
     if cfg.post_norm:
         attn_out = _rms_norm(attn_out, layer["ln_attn_post"], cfg.norm_eps, p1)
     x = x + attn_out
@@ -1060,9 +1112,9 @@ def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig):
                 compute_dtype=cfg.dtype,
             )
         return x + y, new_kv
-    gate = _mlp_gate_act(_proj(h, layer["w_gate"], cfg), cfg)
-    up = _proj(h, layer["w_up"], cfg)
-    mlp_out = _proj(gate * up, layer["w_down"], cfg)
+    gate = _mlp_gate_act(_proj_l(h, layer, "w_gate", cfg), cfg)
+    up = _proj_l(h, layer, "w_up", cfg)
+    mlp_out = _proj_l(gate * up, layer, "w_down", cfg)
     if cfg.post_norm:
         mlp_out = _rms_norm(mlp_out, layer["ln_mlp_post"], cfg.norm_eps, cfg.norm_plus_one)
     x = x + mlp_out

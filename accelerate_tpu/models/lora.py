@@ -1,0 +1,195 @@
+"""LoRA fine-tuning helpers — the TPU-native analog of the reference's peft integration.
+
+The reference trains peft-wrapped ``nn.Module``s through Accelerate (``is_peft_model``,
+``extract_model_from_parallel`` unwrap support — reference ``utils/other.py:62``,
+``accelerator.py``). Here adaptation is a property of the params pytree instead of a model
+wrapper: ``LlamaConfig(lora_rank=r)`` makes ``init_params`` add ``{name}_lora_a/b`` leaves
+next to each targeted projection and the forward adds the low-rank delta
+``(x @ A) @ B · alpha/rank`` (``llama._proj_l``) — the adapted weight is never materialized.
+
+The pieces here make partial trainability work through the standard facade:
+
+- ``add_adapters(params, cfg)`` — attach freshly initialized adapters to an EXISTING
+  params tree (an HF-loaded checkpoint via ``models.hf_interop`` — the primary workflow).
+- ``lora_mask(params)`` — bool pytree, True on adapter leaves.
+- ``lora_optimizer(tx)`` — ``optax.multi_transform`` wrapper routing base leaves to
+  ``set_to_zero``: optimizer state exists ONLY for adapter leaves (the memory point of
+  LoRA: the frozen base carries no Adam moments).
+- ``merge_lora(params, cfg)`` — fold adapters into the base weights for export/serving;
+  returns (plain params, cfg with lora off).
+- ``only_lora(params)`` / ``load_lora(params, adapters)`` — adapter-only checkpoint
+  round-trip (the peft ``save_pretrained``/``load_adapter`` analog).
+
+Works with scanned ([L, ...]-stacked) and unrolled layer layouts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "add_adapters",
+    "lora_mask",
+    "lora_optimizer",
+    "merge_lora",
+    "merge_lora_trees",
+    "only_lora",
+    "load_lora",
+]
+
+_LORA_MARKERS = ("_lora_a", "_lora_b")
+
+
+def _is_lora_path(path) -> bool:
+    for entry in path:
+        key = getattr(entry, "key", None)
+        if isinstance(key, str) and key.endswith(_LORA_MARKERS):
+            return True
+    return False
+
+
+def add_adapters(params: dict, cfg, key: Any = None) -> dict:
+    """Attach freshly initialized adapters to an existing params tree.
+
+    The primary LoRA workflow loads a PRETRAINED base (``models.hf_interop`` — which knows
+    nothing about adapters) and then adapts it: this returns a new tree with
+    ``{name}_lora_a`` (A ~ N(0, 1/d_in)) and ``{name}_lora_b`` (zeros) next to each target
+    of ``cfg.lora_targets``, for both unrolled (list) and scan-stacked layer layouts.
+    Forward behavior is exactly the base model until training moves B off zero.
+    """
+    import math
+
+    from .llama import _lora_target_names
+
+    if cfg.lora_rank <= 0:
+        raise ValueError("add_adapters requires cfg.lora_rank > 0")
+    if key is None:
+        key = jax.random.PRNGKey(0)
+    names = _lora_target_names(cfg)
+    r = cfg.lora_rank
+
+    def _one(layer: dict, layer_key) -> dict:
+        out = dict(layer)
+        for i, name in enumerate(names):
+            if f"{name}_lora_a" in layer:
+                raise ValueError(f"params already carry adapters for {name!r}")
+            shape = layer[name].shape  # [d_in, d_out] or scan-stacked [L, d_in, d_out]
+            d_in = shape[-2]
+            a_shape = (*shape[:-1], r)
+            b_shape = (*shape[:-2], r, shape[-1])
+            out[f"{name}_lora_a"] = (
+                jax.random.normal(jax.random.fold_in(layer_key, i), a_shape, jnp.float32)
+                / math.sqrt(d_in)
+            )
+            out[f"{name}_lora_b"] = jnp.zeros(b_shape, jnp.float32)
+        return out
+
+    adapted = dict(params)
+    layers = params["layers"]
+    if isinstance(layers, list):
+        adapted["layers"] = [
+            _one(layer, jax.random.fold_in(key, i)) for i, layer in enumerate(layers)
+        ]
+    else:
+        adapted["layers"] = _one(layers, key)
+    return adapted
+
+
+def lora_mask(params: Any) -> Any:
+    """Bool pytree (same structure as ``params``): True exactly on adapter leaves."""
+    return jax.tree_util.tree_map_with_path(lambda path, _: _is_lora_path(path), params)
+
+
+def lora_optimizer(tx):
+    """Wrap an optax transformation to update ONLY adapter leaves.
+
+    ``optax.multi_transform`` routes adapter leaves to ``tx`` and base leaves to
+    ``set_to_zero`` (``optax.masked`` alone would pass the base's raw gradients through as
+    updates). Optimizer state exists solely for adapter leaves, so the frozen base carries
+    no Adam moments — the LoRA memory win. Pass the result to
+    ``Accelerator.create_train_state`` as usual.
+    """
+    import optax
+
+    def labels(params):
+        return jax.tree_util.tree_map_with_path(
+            lambda path, _: "adapter" if _is_lora_path(path) else "frozen", params
+        )
+
+    return optax.multi_transform({"adapter": tx, "frozen": optax.set_to_zero()}, labels)
+
+
+def only_lora(params: Any) -> dict:
+    """Flat ``{path: leaf}`` dict of just the adapter leaves (tiny — checkpoint this to
+    save adapters separately from the frozen base, peft-style)."""
+    flat = {}
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        if _is_lora_path(path):
+            flat[jax.tree_util.keystr(path)] = leaf
+    return flat
+
+
+def load_lora(params: Any, adapters: dict) -> Any:
+    """Inverse of :func:`only_lora`: replace adapter leaves with checkpointed values.
+
+    ``adapters`` is the ``{keystr(path): leaf}`` dict ``only_lora`` produced; every entry
+    must match an adapter leaf in ``params`` (missing or extra keys raise — a silent
+    partial load would quietly serve the wrong model).
+    """
+    remaining = dict(adapters)
+
+    def _sub(path, leaf):
+        key = jax.tree_util.keystr(path)
+        if _is_lora_path(path):
+            if key not in remaining:
+                raise KeyError(f"adapter checkpoint is missing {key}")
+            new = remaining.pop(key)
+            if new.shape != leaf.shape:
+                raise ValueError(f"{key}: checkpoint shape {new.shape} != params {leaf.shape}")
+            return new
+        return leaf
+
+    out = jax.tree_util.tree_map_with_path(_sub, params)
+    if remaining:
+        raise KeyError(f"adapter checkpoint has extra entries: {sorted(remaining)[:3]}")
+    return out
+
+
+def merge_lora_trees(layer: dict, cfg) -> dict:
+    """Fold one layer dict's adapters into its base weights; drops the adapter leaves."""
+    scale = cfg.lora_alpha / cfg.lora_rank
+    merged = {}
+    for name, leaf in layer.items():
+        if name.endswith(_LORA_MARKERS):
+            continue
+        a = layer.get(f"{name}_lora_a")
+        if a is not None:
+            b = layer[f"{name}_lora_b"]
+            # Works for both [d_in, d_out] and scan-stacked [L, d_in, d_out] leaves.
+            delta = jnp.einsum("...ir,...ro->...io", a, b) * scale
+            leaf = (leaf + delta.astype(leaf.dtype)).astype(leaf.dtype)
+        merged[name] = leaf
+    return merged
+
+
+def merge_lora(params: dict, cfg):
+    """Fold every layer's adapters into the base weights for export/serving.
+
+    Returns ``(plain_params, plain_cfg)`` where ``plain_cfg`` has ``lora_rank=0`` — the
+    merged model is a regular base-architecture checkpoint (usable by ``generate``, the
+    serving engine, ``save_pretrained``-style export, quantization, ...).
+    """
+    if cfg.lora_rank <= 0:
+        return params, cfg
+    out = dict(params)
+    layers = params["layers"]
+    if isinstance(layers, list):
+        out["layers"] = [merge_lora_trees(layer, cfg) for layer in layers]
+    else:
+        out["layers"] = merge_lora_trees(layers, cfg)
+    plain_cfg = dataclasses.replace(cfg, lora_rank=0)
+    return out, plain_cfg

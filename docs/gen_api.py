@@ -65,6 +65,7 @@ MODULES = [
     ("accelerate_tpu.utils.memory", "Memory utilities"),
     ("accelerate_tpu.utils.random", "RNG control"),
     ("accelerate_tpu.models.llama", "Llama family"),
+    ("accelerate_tpu.models.lora", "LoRA fine-tuning"),
     ("accelerate_tpu.models.gpt", "GPT family"),
     ("accelerate_tpu.models.t5", "T5 family"),
 ]
