@@ -223,9 +223,27 @@ def _make_config(S: int, preset: str | None):
     return cfg
 
 
-def run(B: int, S: int, fuse: int, preset: str | None):
-    import jax
+def _make_optimizer(name: str):
+    """BENCH_OPT: optimizer variants for on-hardware attribution of the step-time gap
+    between fwd_bwd alone (~112 model-TFLOP/s, benchmarks/decompose.py) and the full
+    train step. Not auto-adopted (an optimizer change alters training numerics, not just
+    tuning) — the metric label carries the variant name."""
+    import jax.numpy as jnp
     import optax
+
+    return {
+        "adamw": lambda: optax.adamw(1e-4),
+        "adamw_mu_bf16": lambda: optax.adamw(1e-4, mu_dtype=jnp.bfloat16),
+        "sgd": lambda: optax.sgd(1e-4),
+        "adafactor": lambda: optax.adafactor(1e-4),
+        "lion": lambda: optax.lion(1e-5),
+    }[name]()
+
+
+def run(B: int, S: int, fuse: int, preset: str | None):
+    import os
+
+    import jax
 
     from accelerate_tpu import Accelerator
     from accelerate_tpu.models import llama
@@ -235,7 +253,9 @@ def run(B: int, S: int, fuse: int, preset: str | None):
     metric = _metric_label(B, S, fuse, preset, cfg)
 
     acc = Accelerator(mixed_precision="bf16")
-    state = acc.create_train_state(llama.init_params(cfg), optax.adamw(1e-4))
+    state = acc.create_train_state(
+        llama.init_params(cfg), _make_optimizer(os.environ.get("BENCH_OPT", "adamw"))
+    )
     # cast_params=True (default): the whole-tree bf16 pre-cast costs one bf16 param copy but
     # makes the scan-backward gradient carries bf16 too — net ~1.5 GB cheaper at 0.9B params
     # than fp32 grad carries (measured: 15.9G vs 17.3G peak).
@@ -331,7 +351,9 @@ def _metric_label(B: int, S: int, fuse: int, preset: str | None, cfg=None) -> st
             if os.environ.get("BENCH_REMAT", "1") == "1"
             else "noremat"
         )
-    return f"train_mfu (llama-0.9B b{B} seq{S} bf16 {attn} {remat} fused{fuse})"
+    opt = os.environ.get("BENCH_OPT", "adamw")
+    opt_tag = "" if opt == "adamw" else f" {opt}"
+    return f"train_mfu (llama-0.9B b{B} seq{S} bf16 {attn} {remat} fused{fuse}{opt_tag})"
 
 
 # Only pure TUNING knobs may be auto-adopted from sweep results. Workload knobs
@@ -385,6 +407,12 @@ def _adopt_best_sweep_config() -> None:
 def main():
     import os
     import threading
+
+    # Persistent compile cache: sweep rows / retries skip the slow remote compiles for
+    # already-seen programs (harmless if the backend ignores it).
+    _here = os.path.dirname(os.path.abspath(__file__))
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(_here, ".jax_cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
 
     preset = os.environ.get("BENCH_PRESET")
     if not preset:

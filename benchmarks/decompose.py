@@ -13,7 +13,8 @@ Times each piece of the bench workload in isolation so the MFU gap can be attrib
   fwd_bwd_noremat — loss value_and_grad, remat off (needs batch small enough to fit)
   fwd_bwd_remat   — loss value_and_grad, remat full
   fwd_bwd_dots    — loss value_and_grad, remat dots policy
-  opt_step        — adamw update + global-norm clip alone
+  opt_adamw       — adamw update + global-norm clip alone (effective GB/s)
+  opt_adamw_scan4 — 4 chained applies under lax.scan (the fused-path memory pattern)
 
 Each row prints achieved TFLOP/s against its own analytic FLOP count, so the slow
 component is directly visible.  Run on the real chip: `python benchmarks/decompose.py`.
@@ -61,6 +62,10 @@ def timed(fn, *args, n=3, warmup=1):
 def main() -> int:
     import os
 
+    # Persistent compile cache: repeated decompose runs (the tunnel dies mid-session often)
+    # skip the slow remote compiles for already-seen programs.
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
     if os.environ.get("BENCH_PRESET") == "smoke":
         # The smoke preset is a CPU logic check by definition — force the CPU backend past
         # the sitecustomize platform pin so it can never hang on a dead TPU tunnel.
@@ -112,6 +117,71 @@ def main() -> int:
 
     dt = timed(chain, a, w)
     report("matmul_peak", dt, 8 * 2 * M * M * M)
+    del a, w
+
+    # --- optimizer apply alone, FIRST (cleanest memory: nothing else resident).
+    # The full train step runs ~790 ms/step slower than fwd_bwd on the chip (r2
+    # step_attrib.py) — these rows decide whether the adamw apply itself is the sink.
+    # Grads are generated INSIDE jit so only params + m/v are standing state.
+
+    def timed_state2(fn, p, s, n=3):
+        p, s = fn(p, s)  # warmup/compile; state threads through (donation-safe)
+        _materialize(p)
+        t0 = time.perf_counter()
+        for _ in range(n):
+            p, s = fn(p, s)
+        _materialize(p)
+        return (time.perf_counter() - t0) / n
+
+    params32 = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.float32), llama.init_params(cfg)
+    )
+    p_bytes = sum(x.size * 4 for x in jax.tree_util.tree_leaves(params32))
+    tx = optax.adamw(1e-4)
+    opt_state = tx.init(params32)
+
+    def one_opt(p, s):
+        # Clip formula matches Accelerator.build_train_step's apply_step exactly
+        # (min(1, max_norm/(gnorm+eps)) scale), so this times the real transform.
+        grads = jax.tree_util.tree_map(lambda x: jnp.full_like(x, 1e-3), p)
+        gnorm = optax.global_norm(grads)
+        scale = jnp.minimum(1.0, 1.0 / (gnorm + 1e-6))
+        grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+        u, s = tx.update(grads, s, p)
+        return optax.apply_updates(p, u), s
+
+    try:
+        opt_jit = jax.jit(one_opt, donate_argnums=(0, 1))
+        dt = timed_state2(opt_jit, params32, opt_state)
+        # adamw traffic ≈ read p,m,v,g + write p,m,v (7 × p_bytes with fp32 moments)
+        print(f"opt_adamw          {dt*1e3:9.2f} ms   {7*p_bytes/dt/1e9:8.1f} GB/s eff",
+              flush=True)
+        rows.append({"name": "opt_adamw", "ms": round(dt * 1e3, 2),
+                     "gbps": round(7 * p_bytes / dt / 1e9, 1)})
+    except Exception as e:
+        print(f"opt_adamw: {type(e).__name__}: {str(e).splitlines()[0][:120]}")
+
+    try:
+        def scan4(p, s):
+            def body(carry, _):
+                p, s = carry
+                return one_opt(p, s), None
+
+            (p, s), _ = jax.lax.scan(body, (p, s), None, length=4)
+            return p, s
+
+        scan_jit = jax.jit(scan4, donate_argnums=(0, 1))
+        params32 = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.float32), llama.init_params(cfg)
+        )
+        opt_state = tx.init(params32)
+        dt = timed_state2(scan_jit, params32, opt_state)
+        print(f"opt_adamw_scan4    {dt/4*1e3:9.2f} ms/step  (fused-path memory pattern)",
+              flush=True)
+        rows.append({"name": "opt_adamw_scan4", "ms_per_step": round(dt / 4 * 1e3, 2)})
+    except Exception as e:
+        print(f"opt_adamw_scan4: {type(e).__name__}: {str(e).splitlines()[0][:120]}")
+    params32 = opt_state = None  # release before the activation-heavy sections
 
     # --- attention at bench shapes (per layer): q [B,S,H,hd]
     H, K, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
@@ -161,20 +231,6 @@ def main() -> int:
             report(f"fwd_bwd_{name}", dt, fwd_flops * 3)
         except Exception as e:  # OOM for noremat at large B
             print(f"fwd_bwd_{name}: {type(e).__name__}: {str(e).splitlines()[0][:120]}")
-
-    # --- optimizer step alone
-    tx = optax.chain(optax.clip_by_global_norm(1.0), optax.adamw(1e-4))
-    params32 = jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), params)
-    opt_state = tx.init(params32)
-
-    @jax.jit
-    def opt_step(p, s):
-        grads = jax.tree_util.tree_map(jnp.ones_like, p)
-        u, s = tx.update(grads, s, p)
-        return optax.apply_updates(p, u), s
-
-    dt = timed(opt_step, params32, opt_state)
-    report("opt_step", dt, 0)
 
     print(json.dumps({"rows": rows, "config": {"B": B, "S": S, "n_params": n_params}}))
     return 0

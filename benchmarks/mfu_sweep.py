@@ -46,6 +46,23 @@ CONFIGS = [
                                "BENCH_SCAN_UNROLL": "2"}),
     ("loss_chunk_off", {"BENCH_LOSS_CHUNK": "-1"}),
     ("loss_chunk_1024", {"BENCH_LOSS_CHUNK": "1024"}),
+    # --- round-2 second wave: optimizer attribution + combos on the best tuning row.
+    # decompose/step_attrib localized ~790 ms/step outside fwd_bwd; BENCH_OPT rows measure
+    # the optimizer's share directly on the real step (sgd ≈ no opt state, adafactor ≈
+    # factored state, mu_bf16 ≈ 25% less moment traffic). Optimizer rows are labeled
+    # distinctly and never auto-adopted.
+    ("opt_sgd", {"BENCH_OPT": "sgd"}),
+    ("opt_mu_bf16", {"BENCH_OPT": "adamw_mu_bf16"}),
+    ("opt_adafactor", {"BENCH_OPT": "adafactor"}),
+    ("fuse16", {"BENCH_FUSE": "16"}),
+    ("blocks512_lc1024", {"ACCEL_FLASH_BLOCK_Q": "512", "ACCEL_FLASH_BLOCK_K": "512",
+                          "BENCH_LOSS_CHUNK": "1024"}),
+    ("blocks512_b8", {"ACCEL_FLASH_BLOCK_Q": "512", "ACCEL_FLASH_BLOCK_K": "512",
+                      "BENCH_B": "8"}),
+    ("blocks512_fuse16", {"ACCEL_FLASH_BLOCK_Q": "512", "ACCEL_FLASH_BLOCK_K": "512",
+                          "BENCH_FUSE": "16"}),
+    ("blocks512_mu_bf16", {"ACCEL_FLASH_BLOCK_Q": "512", "ACCEL_FLASH_BLOCK_K": "512",
+                           "BENCH_OPT": "adamw_mu_bf16"}),
 ]
 
 

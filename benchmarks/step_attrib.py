@@ -1,0 +1,148 @@
+"""Attribute the train-step MFU gap: fwd_bwd alone reaches ~112 model-TFLOP/s on the chip
+(benchmarks/decompose.py) while the full bench step records ~35 — i.e. ~2.4x of step time
+is NOT the model math. This times the bench's exact step pipeline with components toggled:
+
+  grad_fp32cast   — value_and_grad of the bench loss with fp32 master params + in-step
+                    bf16 cast (the bench's `compute`), no optimizer
+  grad_bf16       — same but params stored bf16, no cast (decompose's fwd_bwd baseline)
+  grad_clip       — + global-norm clip
+  full_sgd        — build_train_step(fuse=1) with optax.sgd (isolates adamw bandwidth)
+  full_adamw_f1   — build_train_step(fuse=1) with adamw (the real thing, unfused)
+  full_adamw_f4   — build_train_step(fuse=4) (the bench config; per-step time reported)
+
+Per-step ms for each row; the first big jump names the culprit.  Run on the real chip.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+
+import numpy as np
+
+REPO = __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import os
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
+
+
+def _materialize(out):
+    import jax
+
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    if leaf.shape:
+        leaf = leaf[tuple(0 for _ in leaf.shape)]
+    return jax.device_get(leaf)
+
+
+def timed_state(fn, state, batch, n=3):
+    """Time a state-donating step honestly: state threads through (donation-safe)."""
+    state, out = fn(state, batch)  # warmup/compile
+    _materialize(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        state, out = fn(state, batch)
+    _materialize(out)
+    return (time.perf_counter() - t0) / n, state
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import llama
+
+    B, S, FUSE = 4, 2048, 4
+    cfg = dataclasses.replace(
+        llama.CONFIGS["llama3-8b"],
+        vocab_size=32768, d_model=2048, n_layers=12, n_heads=16, n_kv_heads=8,
+        d_ff=8192, max_seq=S, remat=True, remat_policy="full", scan_layers=True,
+        attn_impl="flash",
+    )
+    n_params = llama.num_params(cfg)
+    flops_per_token = 6 * n_params + 6 * cfg.n_layers * S * cfg.d_model
+    model_tflop_per_step = flops_per_token * B * S / 1e12
+    rows = []
+
+    def report(name, dt_step):
+        tf = model_tflop_per_step / dt_step
+        rows.append({"name": name, "ms_per_step": round(dt_step * 1e3, 1),
+                     "model_tflops": round(tf, 2)})
+        print(f"{name:16s} {dt_step*1e3:9.1f} ms/step   {tf:8.2f} model-TFLOP/s", flush=True)
+
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, S + 1)), jnp.int32)
+    batch = {"tokens": tokens}
+
+    from accelerate_tpu.accelerator import cast_floating
+
+    # --- grad with bf16-stored params (decompose parity point)
+    params_bf16 = jax.tree_util.tree_map(
+        lambda p: p.astype(jnp.bfloat16), llama.init_params(cfg)
+    )
+    g_bf16 = jax.jit(jax.grad(lambda p, b: llama.loss_fn(p, b, cfg)), donate_argnums=())
+    dt, _ = timed_state(lambda s, b: (s, g_bf16(s, b)), params_bf16, batch)
+    report("grad_bf16", dt)
+
+    # --- grad with fp32 master params + in-step cast (bench's compute, no optimizer)
+    params32 = jax.tree_util.tree_map(lambda p: p.astype(jnp.float32), params_bf16)
+    del params_bf16
+
+    def loss_cast(p, b):
+        return llama.loss_fn(cast_floating(p, jnp.bfloat16), b, cfg)
+
+    g_cast = jax.jit(jax.grad(loss_cast))
+    dt, _ = timed_state(lambda s, b: (s, g_cast(s, b)), params32, batch)
+    report("grad_fp32cast", dt)
+
+    # --- + global-norm clip
+    def grad_clipped(p, b):
+        g = jax.grad(loss_cast)(p, b)
+        gnorm = optax.global_norm(g)
+        scale = jnp.minimum(1.0, 1.0 / (gnorm + 1e-6))
+        return jax.tree_util.tree_map(lambda x: x * scale, g)
+
+    g_clip = jax.jit(grad_clipped)
+    dt, _ = timed_state(lambda s, b: (s, g_clip(s, b)), params32, batch)
+    report("grad_clip", dt)
+    del params32
+
+    # --- full framework step, sgd (no moment bandwidth)
+    for name, tx, fuse in (
+        ("full_sgd_f1", optax.sgd(1e-4), 1),
+        ("full_adamw_f1", optax.adamw(1e-4), 1),
+        ("full_adamw_f4", optax.adamw(1e-4), 4),
+    ):
+        from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        acc = Accelerator(mixed_precision="bf16")
+        state = acc.create_train_state(llama.init_params(cfg), tx)
+        step = acc.build_train_step(
+            lambda p, b: llama.loss_fn(p, b, cfg), max_grad_norm=1.0, fused_steps=fuse
+        )
+        if fuse > 1:
+            stacked = {"tokens": np.asarray(
+                rng.integers(0, cfg.vocab_size, (fuse, B, S + 1)), np.int32)}
+            dt, state = timed_state(step, state, stacked)
+            report(name, dt / fuse)
+        else:
+            dt, state = timed_state(step, state, batch)
+            report(name, dt)
+        del state, step, acc
+
+    print(json.dumps({"rows": rows, "config": {"B": B, "S": S, "n_params": n_params}}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

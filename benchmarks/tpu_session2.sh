@@ -1,0 +1,30 @@
+#!/bin/bash
+# Second TPU work session (round 2): optimizer-apply attribution + second-wave sweep.
+# Context: fwd_bwd alone reaches ~112 model-TFLOP/s on the chip but the full adamw step
+# only ~38 — ~790 ms/step is outside the model math. Value order:
+#   1. decompose (now times opt_adamw / opt_adamw_scan4 FIRST, memory-clean)
+#   2. optimizer-variant sweep rows (sgd / mu_bf16 / adafactor) — direct attribution
+#   3. combo rows on the best tuning config (blocks 512x512)
+#   4. final scoring run (auto-adopts best pure-tuning row)
+# Each stage tolerates the tunnel dying: own subprocess + timeout; sweep re-polls.
+set -u
+cd "$(dirname "$0")/.."
+
+echo "=== waiting for TPU ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+
+echo "=== 1. decompose (opt rows first) ==="
+timeout 1500 python benchmarks/decompose.py > decompose2.json 2>decompose2.err
+echo "decompose rc=$?"; grep -a "opt_adamw" decompose2.json | head -2
+
+echo "=== 2. optimizer attribution rows ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
+  --only opt_sgd,opt_mu_bf16,opt_adafactor
+
+echo "=== 3. combo rows ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
+  --only blocks512_lc1024,blocks512_mu_bf16,fuse16,blocks512_fuse16,blocks512_b8
+
+echo "=== 4. adopt best + final scoring run ==="
+timeout 900 python bench.py
+echo "=== session2 done ==="
