@@ -645,6 +645,13 @@ class Accelerator:
         from .utils.constants import FSDP_AXIS
 
         plugin = self.state.fsdp_plugin
+        # Ground-truth record of whether any param leaf is partitioned across devices
+        # (TP plans, FSDP/ZeRO-3, user partition_specs all included): gates the fused-
+        # optimizer fast path, which cannot run on cross-device-sharded leaves.
+        self._params_cross_sharded = any(
+            isinstance(l, jax.Array) and not l.sharding.is_fully_replicated
+            for l in jax.tree_util.tree_leaves(params)
+        )
         self._zero_opt_specs = None
         self._zero_grad_specs = None
         if (
@@ -854,14 +861,45 @@ class Accelerator:
             if accum_steps > 1:
                 grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
             metrics = {"loss": loss}
+            # Fused single-pass optimizers (ops/fused_optim.FusedAdamW) take the clip
+            # factor as a scalar and fold it into their one HBM pass over the grads —
+            # pre-scaling the tree here would cost an extra full read+write.
+            # Guard: a pallas_call is an unpartitionable custom call under GSPMD, so the
+            # fast path only runs when no state leaf is sharded across devices — single
+            # chip, or multi-chip with replicated params/moments (plain DP). ZeRO-1/2/3
+            # and FSDP fall back to tx.update (FusedAdamW provides the optax protocol
+            # too). TODO(shard_map): partition the kernel per-shard to lift this.
+            fused_opt = getattr(tx, "fused_apply", None)
+            if fused_opt is not None:
+                plugin = self.state.fsdp_plugin
+                sharded = (
+                    self._zero_opt_specs is not None
+                    or self._zero_param_specs is not None
+                    or getattr(self, "_params_cross_sharded", False)
+                    or (plugin is not None and plugin.shards_params
+                        and self.mesh is not None and self.mesh.size > 1)
+                )
+                if sharded:
+                    fused_opt = None
+            grad_scale = None
             if max_grad_norm is not None:
                 gnorm = _global_norm(grads)
                 scale = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
-                grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
                 metrics["grad_norm"] = jnp.asarray(gnorm, jnp.float32)
+                if fused_opt is None:
+                    grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
+                else:
+                    grad_scale = scale
             import optax
 
-            updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+            if fused_opt is not None:
+                new_params, new_opt_state = fused_opt(
+                    grads, state.opt_state, state.params,
+                    grad_scale=1.0 if grad_scale is None else grad_scale,
+                )
+                updates = None
+            else:
+                updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
             if self._zero_opt_specs is not None:
                 # ZeRO-1/2: keep optimizer state partitioned over the fsdp axis across steps
                 # (params replicated; GSPMD all-gathers the sharded updates below).
@@ -870,7 +908,8 @@ class Accelerator:
                 new_opt_state = jax.tree_util.tree_map(
                     lambda o, s: maybe_shard(o, s), new_opt_state, self._zero_opt_specs
                 )
-            new_params = optax.apply_updates(state.params, updates)
+            if updates is not None:
+                new_params = optax.apply_updates(state.params, updates)
             if self._zero_param_specs is not None:
                 from .ops.collectives import maybe_shard
 

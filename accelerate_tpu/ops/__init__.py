@@ -1,6 +1,7 @@
 """Compute & collective ops: in-jit collectives, Pallas kernels, fp8 and quantized matmuls."""
 
 from .fp8 import DelayedScalingState, delayed_scales, fp8_dot, fp8_linear
+from .fused_optim import FusedAdamW, fused_adamw
 from .quantization import (
     BnbQuantizationConfig,
     QuantizedWeight,

@@ -1,0 +1,226 @@
+"""Fused AdamW — single-pass Pallas optimizer kernel (the TPU-native FusedAdam).
+
+Reference delegation points this replaces: the reference ecosystem leans on fused CUDA
+optimizers for the apply step — DeepSpeed's FusedAdam/cpu-Adam behind
+``utils/dataclasses.py:1019-1448`` (DeepSpeedPlugin) and apex ``FusedAdam`` in Megatron mode
+(``utils/megatron_lm.py``).  On TPU the optimizer apply is pure HBM bandwidth: the ideal
+schedule reads each of p/m/v/g exactly once and writes p/m/v exactly once (7 passes over
+param bytes with fp32 moments).  ``optax.adamw`` expresses the update as a chain of
+whole-tree transforms; XLA usually fuses them, but the fusion is at the compiler's mercy —
+measured on the v5e chip this repo benches on, the full train step loses ~790 ms/step to the
+apply phase at 0.9B params (benchmarks/decompose.py, step_attrib.py).  This kernel makes the
+single pass explicit: one Pallas grid over each leaf computes m', v', bias corrections,
+decoupled weight decay, and the parameter update in VMEM, streaming HBM at full rate.
+
+Integration: :class:`FusedAdamW` quacks like an ``optax.GradientTransformation`` (``init`` /
+``update``) so every existing code path works, and additionally exposes
+``fused_apply(grads, state, params) -> (new_params, new_state)`` which
+``Accelerator.build_train_step`` uses when present — fusing what optax's API forces apart
+(``update`` then ``apply_updates`` = one extra full read+write of the update tree).
+
+Layout: a leaf is processed by the kernel when its trailing dimension work-reshapes to
+lanes of 128 (any leaf with ``size % 1024 == 0`` — all matmul weights; stacked scan leaves
+included).  Small/odd leaves (norm gains, biases) fall back to the identical jnp math —
+negligible traffic.  ``mu_dtype=bfloat16`` stores the first moment in bf16 (t5x-style),
+cutting standing optimizer HBM by 25%.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+__all__ = ["FusedAdamW", "fused_adamw"]
+
+_LANES = 1024  # 8 sublanes x 128 lanes: the fp32 VMEM tile; every kernel row is one tile
+
+
+def _interpret_default() -> bool:
+    return jax.default_backend() not in ("tpu", "axon")
+
+
+def _adamw_kernel(
+    sc_ref, p_ref, m_ref, v_ref, g_ref, po_ref, mo_ref, vo_ref, *, b1, b2, eps, wd
+):
+    """One block: m' = b1*m + (1-b1)*g; v' = b2*v + (1-b2)*g^2;
+    p' = p - lr*(mhat/(sqrt(vhat)+eps) + wd*p)  (decoupled AdamW decay).
+
+    ``sc_ref`` (SMEM, [4]) carries the traced scalars: [grad_scale (clip), lr,
+    (1-b1^t), (1-b2^t)] — hyperparameters that vary per step stay out of the
+    compiled kernel constant pool.
+
+    Expression order mirrors ``optax.adamw`` exactly (incl. division by the bias
+    correction), making fp32-moment trajectories bit-identical.  With
+    ``mu_dtype=bfloat16`` the TPU VPU keeps the ``b1 * m`` product in fp32 where optax
+    rounds it to bf16 first — one rounding tighter, so trajectories agree only to bf16
+    ulp (see tests/test_fused_optim.py tolerances).
+    """
+    gscale = sc_ref[0]
+    lr = sc_ref[1]
+    bc1 = sc_ref[2]
+    bc2 = sc_ref[3]
+    g = g_ref[:].astype(jnp.float32) * gscale
+    p = p_ref[:].astype(jnp.float32)
+    m_new = (1.0 - b1) * g + b1 * m_ref[:]   # promotion order = optax update_moment
+    v_new = (1.0 - b2) * (g * g) + b2 * v_ref[:]
+    mhat = m_new / bc1
+    vhat = v_new / bc2
+    update = mhat / (jnp.sqrt(vhat) + eps) + wd * p
+    po_ref[:] = (p - lr * update).astype(po_ref.dtype)
+    mo_ref[:] = m_new.astype(mo_ref.dtype)
+    vo_ref[:] = v_new.astype(vo_ref.dtype)
+
+
+def _leaf_fused(p, m, v, g, scalars, *, b1, b2, eps, wd, block_rows, interpret):
+    """Run the kernel over one leaf reshaped to [rows, 1024]."""
+    shape, dtype = p.shape, p.dtype
+    rows = p.size // _LANES
+    br = min(block_rows, rows)
+    while rows % br:  # largest divisor <= block_rows keeps the grid exact (no masking)
+        br -= 1
+    grid = (rows // br,)
+    p2 = p.reshape(rows, _LANES)
+    m2 = m.reshape(rows, _LANES)
+    v2 = v.reshape(rows, _LANES)
+    g2 = g.reshape(rows, _LANES)
+    kernel = functools.partial(_adamw_kernel, b1=b1, b2=b2, eps=eps, wd=wd)
+    spec = pl.BlockSpec((br, _LANES), lambda i: (i, 0))
+    po, mo, vo = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            spec, spec, spec, spec,
+        ],
+        out_specs=[spec, spec, spec],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, _LANES), dtype),
+            jax.ShapeDtypeStruct((rows, _LANES), m.dtype),
+            jax.ShapeDtypeStruct((rows, _LANES), v.dtype),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(pltpu.PARALLEL,),
+        ),
+        interpret=interpret,
+    )(scalars, p2, m2, v2, g2)
+    return po.reshape(shape), mo.reshape(shape), vo.reshape(shape)
+
+
+def _leaf_xla(p, m, v, g, scalars, *, b1, b2, eps, wd):
+    """Identical math for leaves the kernel layout doesn't cover (small/odd shapes)."""
+    gscale, lr, bc1, bc2 = scalars[0], scalars[1], scalars[2], scalars[3]
+    g = g.astype(jnp.float32) * gscale
+    p32 = p.astype(jnp.float32)
+    m_new = (1.0 - b1) * g + b1 * m     # promotion order = optax update_moment
+    v_new = (1.0 - b2) * (g * g) + b2 * v
+    update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps) + wd * p32
+    p_new = (p32 - lr * update).astype(p.dtype)
+    return p_new, m_new.astype(m.dtype), v_new.astype(v.dtype)
+
+
+@dataclasses.dataclass
+class FusedAdamW:
+    """Drop-in AdamW with a fused Pallas apply.
+
+    Quacks like ``optax.GradientTransformation`` (``init``/``update``) so
+    ``Accelerator.prepare`` / checkpointing / schedulers work unchanged, while
+    ``build_train_step`` detects ``fused_apply`` and uses the single-pass kernel.
+    ``learning_rate`` may be a float or an optax schedule (called on the step count).
+    """
+
+    learning_rate: Union[float, Callable[[Any], Any]] = 1e-3
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 1e-4
+    mu_dtype: Optional[Any] = None
+    block_rows: int = 512
+    interpret: Optional[bool] = None
+
+    # -------------------------------------------------------------- optax-compatible API
+    def init(self, params):
+        mu_dtype = self.mu_dtype or None
+
+        def zeros_like(p):
+            return jnp.zeros(p.shape, dtype=mu_dtype or p.dtype)
+
+        return optax.ScaleByAdamState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree_util.tree_map(zeros_like, params),
+            nu=jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, p.dtype), params),
+        )
+
+    def _scalars(self, count, grad_scale):
+        count_f = (count + 1).astype(jnp.float32)
+        lr = self.learning_rate(count) if callable(self.learning_rate) else self.learning_rate
+        return jnp.stack([
+            jnp.asarray(grad_scale, jnp.float32),
+            jnp.asarray(lr, jnp.float32),
+            1.0 - jnp.asarray(self.b1, jnp.float32) ** count_f,
+            1.0 - jnp.asarray(self.b2, jnp.float32) ** count_f,
+        ])
+
+    def update(self, grads, state, params=None):
+        """optax-protocol path (returns an update tree). Used by code that insists on the
+        two-phase API; the train step prefers :meth:`fused_apply`."""
+        if params is None:
+            raise ValueError("FusedAdamW.update requires params (AdamW decays weights).")
+        new_params, new_state = self.fused_apply(grads, state, params)
+        updates = jax.tree_util.tree_map(
+            lambda n, p: n.astype(jnp.float32) - p.astype(jnp.float32), new_params, params
+        )
+        return updates, new_state
+
+    # ------------------------------------------------------------------ fused fast path
+    def fused_apply(self, grads, state, params, grad_scale=1.0):
+        """Single-pass apply: ``(new_params, new_state)``.
+
+        ``grad_scale`` folds an already-computed global-norm clip factor into the same
+        pass (``build_train_step`` passes it instead of pre-scaling the grad tree, saving
+        one full read+write of the gradients).
+        """
+        interpret = self.interpret if self.interpret is not None else _interpret_default()
+        scalars = self._scalars(state.count, grad_scale)
+        kw = dict(b1=self.b1, b2=self.b2, eps=self.eps, wd=self.weight_decay)
+
+        def one(p, m, v, g):
+            if p.size % _LANES == 0 and p.size > 0:
+                return _leaf_fused(
+                    p, m, v, g, scalars,
+                    block_rows=self.block_rows, interpret=interpret, **kw,
+                )
+            return _leaf_xla(p, m, v, g, scalars, **kw)
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_v = treedef.flatten_up_to(state.nu)
+        flat_g = treedef.flatten_up_to(grads)
+        out = [one(p, m, v, g) for p, m, v, g in zip(flat_p, flat_m, flat_v, flat_g)]
+        new_params = treedef.unflatten([o[0] for o in out])
+        new_mu = treedef.unflatten([o[1] for o in out])
+        new_nu = treedef.unflatten([o[2] for o in out])
+        return new_params, optax.ScaleByAdamState(
+            count=state.count + 1, mu=new_mu, nu=new_nu
+        )
+
+
+def fused_adamw(
+    learning_rate: Union[float, Callable] = 1e-3,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+    weight_decay: float = 1e-4,
+    mu_dtype=None,
+) -> FusedAdamW:
+    """``optax.adamw``-shaped constructor for the fused kernel optimizer."""
+    return FusedAdamW(
+        learning_rate=learning_rate, b1=b1, b2=b2, eps=eps,
+        weight_decay=weight_decay, mu_dtype=mu_dtype,
+    )

@@ -231,9 +231,13 @@ def _make_optimizer(name: str):
     import jax.numpy as jnp
     import optax
 
+    from accelerate_tpu.ops.fused_optim import fused_adamw
+
     return {
         "adamw": lambda: optax.adamw(1e-4),
         "adamw_mu_bf16": lambda: optax.adamw(1e-4, mu_dtype=jnp.bfloat16),
+        "fused_adamw": lambda: fused_adamw(1e-4),
+        "fused_adamw_mu_bf16": lambda: fused_adamw(1e-4, mu_dtype=jnp.bfloat16),
         "sgd": lambda: optax.sgd(1e-4),
         "adafactor": lambda: optax.adafactor(1e-4),
         "lion": lambda: optax.lion(1e-5),

@@ -1,0 +1,33 @@
+"""Shared fencing/timing for on-chip benchmarks (decompose.py, step_attrib.py).
+
+The tunneled axon runtime's ``block_until_ready`` can return before the relay actually
+finishes, which reports impossible TFLOP/s — a VALUE FETCH cannot lie. Executions on one
+chip are serialized in dispatch order, so fetching one element from the LAST call fences
+the whole timed loop. Keep that rule here, in exactly one place.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def materialize(out):
+    """Force completion by fetching a single element of (the first leaf of) ``out``."""
+    import jax
+
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    if leaf.shape:
+        leaf = leaf[tuple(0 for _ in leaf.shape)]
+    return jax.device_get(leaf)
+
+
+def timed(fn, *args, n=3, warmup=1):
+    """Average seconds per call for a side-effect-free fn (args re-used every call)."""
+    for _ in range(warmup):
+        materialize(fn(*args))
+    t0 = time.perf_counter()
+    out = None
+    for _ in range(n):
+        out = fn(*args)
+    materialize(out)
+    return (time.perf_counter() - t0) / n

@@ -35,28 +35,8 @@ REPO = __import__("os").path.dirname(__import__("os").path.dirname(__import__("o
 sys.path.insert(0, REPO)
 
 
-def _materialize(out):
-    """Force completion by fetching one element. Through the tunneled runtime
-    ``block_until_ready`` does not reliably block (it can return before the relay finishes),
-    which reports impossible TFLOP/s; a value fetch cannot lie. Executions on one chip are
-    serialized in dispatch order, so fetching from the LAST call fences the whole loop."""
-    import jax
-
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    if leaf.shape:
-        leaf = leaf[tuple(0 for _ in leaf.shape)]
-    return jax.device_get(leaf)
-
-
-def timed(fn, *args, n=3, warmup=1):
-    for _ in range(warmup):
-        _materialize(fn(*args))
-    t0 = time.perf_counter()
-    out = None
-    for _ in range(n):
-        out = fn(*args)
-    _materialize(out)
-    return (time.perf_counter() - t0) / n
+from bench_timing import materialize as _materialize  # noqa: E402  (tunnel-safe fence)
+from bench_timing import timed  # noqa: E402
 
 
 def main() -> int:

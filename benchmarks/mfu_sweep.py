@@ -63,6 +63,9 @@ CONFIGS = [
                           "BENCH_FUSE": "16"}),
     ("blocks512_mu_bf16", {"ACCEL_FLASH_BLOCK_Q": "512", "ACCEL_FLASH_BLOCK_K": "512",
                            "BENCH_OPT": "adamw_mu_bf16"}),
+    ("opt_fused_adamw", {"BENCH_OPT": "fused_adamw"}),
+    ("blocks512_fused_adamw", {"ACCEL_FLASH_BLOCK_Q": "512", "ACCEL_FLASH_BLOCK_K": "512",
+                               "BENCH_OPT": "fused_adamw"}),
 ]
 
 

@@ -31,13 +31,7 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cach
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
 
 
-def _materialize(out):
-    import jax
-
-    leaf = jax.tree_util.tree_leaves(out)[0]
-    if leaf.shape:
-        leaf = leaf[tuple(0 for _ in leaf.shape)]
-    return jax.device_get(leaf)
+from bench_timing import materialize as _materialize  # noqa: E402  (tunnel-safe fence)
 
 
 def timed_state(fn, state, batch, n=3):

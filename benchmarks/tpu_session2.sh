@@ -17,9 +17,9 @@ echo "=== 1. decompose (opt rows first) ==="
 timeout 1500 python benchmarks/decompose.py > decompose2.json 2>decompose2.err
 echo "decompose rc=$?"; grep -a "opt_adamw" decompose2.json | head -2
 
-echo "=== 2. optimizer attribution rows ==="
+echo "=== 2. optimizer attribution rows (fused kernel first) ==="
 python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
-  --only opt_sgd,opt_mu_bf16,opt_adafactor
+  --only opt_fused_adamw,blocks512_fused_adamw,opt_sgd,opt_mu_bf16,opt_adafactor
 
 echo "=== 3. combo rows ==="
 python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \

@@ -58,7 +58,7 @@ def test_decompose_smoke():
     assert out.returncode == 0, out.stderr[-2000:]
     data = json.loads(out.stdout.strip().splitlines()[-1])
     names = {r["name"] for r in data["rows"]}
-    assert {"matmul_peak", "fwd_bwd_remat_full", "opt_step"} <= names
+    assert {"matmul_peak", "fwd_bwd_remat_full", "opt_adamw", "opt_adamw_scan4"} <= names
 
 
 @slow
