@@ -59,6 +59,20 @@ _DEFAULT_BLOCK_Q = _env_block("ACCEL_FLASH_BLOCK_Q", 256)
 _DEFAULT_BLOCK_K = _env_block("ACCEL_FLASH_BLOCK_K", 512)
 
 
+def _dim_semantics(n_parallel: int, n_arbitrary: int):
+    """Mosaic grid-dimension semantics: the leading (batch/head/row-block) dims carry no
+    scratch state and may be reordered/pipelined freely (PARALLEL); the trailing dims
+    accumulate into VMEM scratch across iterations and must stay sequential (ARBITRARY).
+    Env-gated (ACCEL_FLASH_DIMSEM=1) so the bench sweep can measure it per chip before it
+    becomes a default."""
+    if os.environ.get("ACCEL_FLASH_DIMSEM", "0") != "1":
+        return None
+    return pltpu.CompilerParams(
+        dimension_semantics=(pltpu.PARALLEL,) * n_parallel
+        + (pltpu.ARBITRARY,) * n_arbitrary
+    )
+
+
 def _interpret_default() -> bool:
     return jax.default_backend() not in ("tpu", "axon")
 
@@ -223,6 +237,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, q_offset=0, kv_
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
+        compiler_params=_dim_semantics(3, 1),
         interpret=interpret,
     )(_scalar(q_offset), _scalar(kv_offset), *seg_args, q, k, v)
     return o[:, :, :S], lse[:, :, :S, 0]
@@ -430,6 +445,7 @@ def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpr
         out_specs=pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Sp, hd), jnp.float32),
         scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
+        compiler_params=_dim_semantics(3, 1),
         interpret=interpret,
     )(_scalar(q_offset), _scalar(kv_offset), *seg_args, qp, kp, vp, dop, lsep, deltap)
     return dq[:, :, :S]
@@ -495,6 +511,7 @@ def _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interp
             pltpu.VMEM((block_k, hd), jnp.float32),
             pltpu.VMEM((block_k, hd), jnp.float32),
         ],
+        compiler_params=_dim_semantics(3, 1),
         interpret=interpret,
     )(_scalar(q_offset), _scalar(kv_offset), *seg_args, qp, kp, vp, dop, lsep, deltap)
     return dk[:, :, :T], dv[:, :, :T]
