@@ -645,12 +645,19 @@ class Accelerator:
         from .utils.constants import FSDP_AXIS
 
         plugin = self.state.fsdp_plugin
-        # Ground-truth record of whether any param leaf is partitioned across devices
-        # (TP plans, FSDP/ZeRO-3, user partition_specs all included): gates the fused-
-        # optimizer fast path, which cannot run on cross-device-sharded leaves.
+        # Ground-truth record of the params' cross-device layout (TP plans, FSDP/ZeRO-3,
+        # user partition_specs all included): the fused-optimizer fast path runs sharded
+        # leaves under shard_map with exactly these specs (opt-state moments share the
+        # param layout in this default path).
         self._params_cross_sharded = any(
             isinstance(l, jax.Array) and not l.sharding.is_fully_replicated
             for l in jax.tree_util.tree_leaves(params)
+        )
+        self._param_spec_tree = jax.tree_util.tree_map(
+            lambda l: l.sharding.spec
+            if isinstance(l, jax.Array) and isinstance(l.sharding, NamedSharding)
+            else PartitionSpec(),
+            params,
         )
         self._zero_opt_specs = None
         self._zero_grad_specs = None
@@ -864,23 +871,20 @@ class Accelerator:
             # Fused single-pass optimizers (ops/fused_optim.FusedAdamW) take the clip
             # factor as a scalar and fold it into their one HBM pass over the grads —
             # pre-scaling the tree here would cost an extra full read+write.
-            # Guard: a pallas_call is an unpartitionable custom call under GSPMD, so the
-            # fast path only runs when no state leaf is sharded across devices — single
-            # chip, or multi-chip with replicated params/moments (plain DP). ZeRO-1/2/3
-            # and FSDP fall back to tx.update (FusedAdamW provides the optax protocol
-            # too). TODO(shard_map): partition the kernel per-shard to lift this.
+            # Sharded states: a pallas_call cannot partition under GSPMD, so sharded
+            # leaves run the kernel under shard_map with the recorded param specs (valid
+            # when moments share the param layout — the create_train_state default, i.e.
+            # FSDP/ZeRO-3/TP). ZeRO-1/2 (opt layout differs from params) falls back to
+            # tx.update, which FusedAdamW also provides.
             fused_opt = getattr(tx, "fused_apply", None)
+            fused_specs = None
             if fused_opt is not None:
-                plugin = self.state.fsdp_plugin
-                sharded = (
-                    self._zero_opt_specs is not None
-                    or self._zero_param_specs is not None
-                    or getattr(self, "_params_cross_sharded", False)
-                    or (plugin is not None and plugin.shards_params
-                        and self.mesh is not None and self.mesh.size > 1)
-                )
-                if sharded:
+                if self._zero_opt_specs is not None or self._zero_param_specs is not None:
                     fused_opt = None
+                elif getattr(self, "_params_cross_sharded", False):
+                    fused_specs = getattr(self, "_param_spec_tree", None)
+                    if fused_specs is None:
+                        fused_opt = None
             grad_scale = None
             if max_grad_norm is not None:
                 gnorm = _global_norm(grads)
@@ -896,6 +900,8 @@ class Accelerator:
                 new_params, new_opt_state = fused_opt(
                     grads, state.opt_state, state.params,
                     grad_scale=1.0 if grad_scale is None else grad_scale,
+                    specs=fused_specs,
+                    mesh=self.mesh if fused_specs is not None else None,
                 )
                 updates = None
             else:

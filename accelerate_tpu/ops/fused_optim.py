@@ -148,13 +148,15 @@ class FusedAdamW:
     def init(self, params):
         mu_dtype = self.mu_dtype or None
 
-        def zeros_like(p):
-            return jnp.zeros(p.shape, dtype=mu_dtype or p.dtype)
-
+        # zeros_LIKE, not zeros: each moment leaf must inherit its param's sharding —
+        # create_train_state relies on that invariant, and at 0.9B params an unsharded
+        # fp32 mu+nu is ~7 GB landing on one device.
         return optax.ScaleByAdamState(
             count=jnp.zeros((), jnp.int32),
-            mu=jax.tree_util.tree_map(zeros_like, params),
-            nu=jax.tree_util.tree_map(lambda p: jnp.zeros(p.shape, p.dtype), params),
+            mu=jax.tree_util.tree_map(
+                lambda p: jnp.zeros_like(p, dtype=mu_dtype or p.dtype), params
+            ),
+            nu=jax.tree_util.tree_map(jnp.zeros_like, params),
         )
 
     def _scalars(self, count, grad_scale):
@@ -168,41 +170,96 @@ class FusedAdamW:
         ])
 
     def update(self, grads, state, params=None):
-        """optax-protocol path (returns an update tree). Used by code that insists on the
-        two-phase API; the train step prefers :meth:`fused_apply`."""
+        """optax-protocol path (returns an update tree) in PURE XLA — no Pallas.
+
+        This is the route ``build_train_step`` takes for layouts the kernel cannot
+        partition (ZeRO-1/2, where opt state and params have different shardings), so it
+        must stay an ordinary partitionable XLA program: same math via ``_leaf_xla`` on
+        every leaf, GSPMD free to shard it however the state is laid out.
+        """
         if params is None:
             raise ValueError("FusedAdamW.update requires params (AdamW decays weights).")
-        new_params, new_state = self.fused_apply(grads, state, params)
-        updates = jax.tree_util.tree_map(
-            lambda n, p: n.astype(jnp.float32) - p.astype(jnp.float32), new_params, params
+        scalars = self._scalars(state.count, 1.0)
+        kw = dict(b1=self.b1, b2=self.b2, eps=self.eps, wd=self.weight_decay)
+
+        def one(p, m, v, g):
+            return _leaf_xla(p, m, v, g, scalars, **kw)
+
+        flat_p, treedef = jax.tree_util.tree_flatten(params)
+        out = [
+            one(p, m, v, g)
+            for p, m, v, g in zip(
+                flat_p,
+                treedef.flatten_up_to(state.mu),
+                treedef.flatten_up_to(state.nu),
+                treedef.flatten_up_to(grads),
+            )
+        ]
+        updates = treedef.unflatten(
+            [
+                (n.astype(jnp.float32) - p.astype(jnp.float32)).astype(p.dtype)
+                for (n, _, _), p in zip(out, flat_p)
+            ]
+        )
+        new_state = optax.ScaleByAdamState(
+            count=state.count + 1,
+            mu=treedef.unflatten([o[1] for o in out]),
+            nu=treedef.unflatten([o[2] for o in out]),
         )
         return updates, new_state
 
     # ------------------------------------------------------------------ fused fast path
-    def fused_apply(self, grads, state, params, grad_scale=1.0):
+    def fused_apply(self, grads, state, params, grad_scale=1.0, specs=None, mesh=None):
         """Single-pass apply: ``(new_params, new_state)``.
 
         ``grad_scale`` folds an already-computed global-norm clip factor into the same
         pass (``build_train_step`` passes it instead of pre-scaling the grad tree, saving
         one full read+write of the gradients).
+
+        ``specs``/``mesh``: per-leaf ``PartitionSpec`` tree for cross-device-sharded
+        states (FSDP/ZeRO-3, TP — where p/m/v/g share one layout, the default produced by
+        ``create_train_state``). Sharded leaves run the kernel under ``shard_map``: each
+        device updates exactly its own shard, no gather, no replication — the fused apply
+        IS the ZeRO-3 optimizer step. Leaves whose spec is None/empty run unmapped.
         """
         interpret = self.interpret if self.interpret is not None else _interpret_default()
         scalars = self._scalars(state.count, grad_scale)
         kw = dict(b1=self.b1, b2=self.b2, eps=self.eps, wd=self.weight_decay)
 
-        def one(p, m, v, g):
+        def local(sc, p, m, v, g):
+            # Kernel-vs-fallback decided on the LOCAL (per-shard) shape.
             if p.size % _LANES == 0 and p.size > 0:
                 return _leaf_fused(
-                    p, m, v, g, scalars,
+                    p, m, v, g, sc,
                     block_rows=self.block_rows, interpret=interpret, **kw,
                 )
-            return _leaf_xla(p, m, v, g, scalars, **kw)
+            return _leaf_xla(p, m, v, g, sc, **kw)
+
+        def one(p, m, v, g, spec=None):
+            if spec is not None and mesh is not None and any(a for a in spec):
+                from jax.sharding import PartitionSpec
+
+                mapped = jax.shard_map(
+                    local,
+                    mesh=mesh,
+                    in_specs=(PartitionSpec(), spec, spec, spec, spec),
+                    out_specs=(spec, spec, spec),
+                    check_vma=False,  # pallas_call outputs carry no vma info
+                )
+                return mapped(scalars, p, m, v, g)
+            return local(scalars, p, m, v, g)
 
         flat_p, treedef = jax.tree_util.tree_flatten(params)
         flat_m = treedef.flatten_up_to(state.mu)
         flat_v = treedef.flatten_up_to(state.nu)
         flat_g = treedef.flatten_up_to(grads)
-        out = [one(p, m, v, g) for p, m, v, g in zip(flat_p, flat_m, flat_v, flat_g)]
+        flat_s = (
+            treedef.flatten_up_to(specs) if specs is not None else [None] * len(flat_p)
+        )
+        out = [
+            one(p, m, v, g, s)
+            for p, m, v, g, s in zip(flat_p, flat_m, flat_v, flat_g, flat_s)
+        ]
         new_params = treedef.unflatten([o[0] for o in out])
         new_mu = treedef.unflatten([o[1] for o in out])
         new_nu = treedef.unflatten([o[2] for o in out])

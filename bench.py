@@ -365,8 +365,9 @@ def _metric_label(B: int, S: int, fuse: int, preset: str | None, cfg=None) -> st
 # report an MFU jump attributable to the workload, not the framework, and break
 # comparability with the tracked b4/seq2048 history.
 _TUNING_KNOBS = {
-    "ACCEL_FLASH_BLOCK_Q", "ACCEL_FLASH_BLOCK_K", "BENCH_ATTN", "BENCH_REMAT_POLICY",
-    "BENCH_SCAN_UNROLL", "BENCH_PREVENT_CSE", "BENCH_LOSS_CHUNK", "XLA_FLAGS",
+    "ACCEL_FLASH_BLOCK_Q", "ACCEL_FLASH_BLOCK_K", "ACCEL_FLASH_DIMSEM", "BENCH_ATTN",
+    "BENCH_REMAT_POLICY", "BENCH_SCAN_UNROLL", "BENCH_PREVENT_CSE", "BENCH_LOSS_CHUNK",
+    "XLA_FLAGS",
 }
 
 
@@ -415,8 +416,10 @@ def main():
     # Persistent compile cache: sweep rows / retries skip the slow remote compiles for
     # already-seen programs (harmless if the backend ignores it).
     _here = os.path.dirname(os.path.abspath(__file__))
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(_here, ".jax_cache"))
-    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
+    sys.path.insert(0, os.path.join(_here, "benchmarks"))
+    from bench_timing import enable_compile_cache
+
+    enable_compile_cache(_here)
 
     preset = os.environ.get("BENCH_PRESET")
     if not preset:

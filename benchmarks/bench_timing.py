@@ -8,7 +8,20 @@ the whole timed loop. Keep that rule here, in exactly one place.
 
 from __future__ import annotations
 
+import os
 import time
+
+
+def enable_compile_cache(repo_root: str) -> None:
+    """Point JAX's persistent compile cache at <repo>/.jax_cache (env wins if preset).
+
+    Every bench entry point calls this before importing jax: the tunnel dies mid-session
+    often, and retries should not pay the slow remote compile twice.
+    """
+    os.environ.setdefault(
+        "JAX_COMPILATION_CACHE_DIR", os.path.join(repo_root, ".jax_cache")
+    )
+    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
 
 
 def materialize(out):

@@ -42,10 +42,9 @@ from bench_timing import timed  # noqa: E402
 def main() -> int:
     import os
 
-    # Persistent compile cache: repeated decompose runs (the tunnel dies mid-session often)
-    # skip the slow remote compiles for already-seen programs.
-    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
-    os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
+    from bench_timing import enable_compile_cache
+
+    enable_compile_cache(REPO)
     if os.environ.get("BENCH_PRESET") == "smoke":
         # The smoke preset is a CPU logic check by definition — force the CPU backend past
         # the sitecustomize platform pin so it can never hang on a dead TPU tunnel.

@@ -25,10 +25,9 @@ import numpy as np
 REPO = __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
-import os
+from bench_timing import enable_compile_cache  # noqa: E402
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(REPO, ".jax_cache"))
-os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
+enable_compile_cache(REPO)
 
 
 from bench_timing import materialize as _materialize  # noqa: E402  (tunnel-safe fence)

@@ -100,7 +100,7 @@ def test_two_phase_update_protocol():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
 
 
-def test_build_train_step_uses_fused_apply(accelerator_factory=None):
+def test_build_train_step_uses_fused_apply():
     """Full integration: identical training trajectory fused vs optax, clip active."""
     from accelerate_tpu import Accelerator
     from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
@@ -135,9 +135,9 @@ def test_build_train_step_uses_fused_apply(accelerator_factory=None):
     np.testing.assert_allclose(results["fused"][2], results["optax"][2], rtol=1e-5, atol=1e-7)
 
 
-def test_fused_falls_back_under_fsdp_sharding():
-    """Cross-device-sharded params must route through the optax-protocol fallback (a
-    pallas_call cannot partition under GSPMD) and still match the optax trajectory."""
+def test_fused_shard_map_under_fsdp():
+    """FSDP/ZeRO-3-sharded states run the kernel under shard_map (each device updates its
+    own shard) and must match the optax trajectory AND preserve the sharded layout."""
     from accelerate_tpu import Accelerator
     from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
     from accelerate_tpu.utils.dataclasses import FullyShardedDataParallelPlugin
@@ -164,9 +164,47 @@ def test_fused_falls_back_under_fsdp_sharding():
         step = acc.build_train_step(loss_fn, max_grad_norm=1.0)
         for _ in range(3):
             state, m = step(state, batch)
+        if name == "fused" and acc.mesh.size > 1:
+            # The fused path must not have silently replicated the moments.
+            mu_leaf = jax.tree_util.tree_leaves(state.opt_state.mu)[0]
+            assert not mu_leaf.sharding.is_fully_replicated
         results[name] = (float(m["loss"]), np.asarray(state.params["w"]))
     assert results["fused"][0] == pytest.approx(results["optax"][0], rel=1e-5)
     np.testing.assert_allclose(results["fused"][1], results["optax"][1], rtol=1e-5, atol=1e-7)
+
+
+def test_fused_falls_back_under_zero1():
+    """ZeRO-1 (opt state sharded, params replicated — layouts differ) must route through
+    the optax-protocol fallback and still match plain optax adamw losses."""
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils.dataclasses import FullyShardedDataParallelPlugin
+
+    def loss_fn(params, batch):
+        return jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2)
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "x": jnp.asarray(rng.normal(size=(16, 64)), jnp.float32),
+        "y": jnp.asarray(rng.normal(size=(16, 128)), jnp.float32),
+    }
+    losses = {}
+    for name, tx in (("fused", fused_adamw(1e-2)), ("optax", optax.adamw(1e-2))):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        acc = Accelerator(
+            fsdp_plugin=FullyShardedDataParallelPlugin(zero_stage=1, min_weight_size=0)
+        )
+        params = {"w": jnp.zeros((64, 128), jnp.float32)}
+        state = acc.create_train_state(params, tx)
+        step = acc.build_train_step(loss_fn, max_grad_norm=1.0)
+        run = []
+        for _ in range(3):
+            state, m = step(state, batch)
+            run.append(float(m["loss"]))
+        losses[name] = run
+    np.testing.assert_allclose(losses["fused"], losses["optax"], rtol=1e-5)
 
 
 def test_fused_step_checkpoint_roundtrip(tmp_path):
