@@ -290,9 +290,11 @@ class Accelerator:
         self.profile_handler = None
         self.scaler_handler = None
         distributed_init_kwargs = None
+        ddp_kwargs = None
         for handler in kwargs_handlers or []:
             from .utils.dataclasses import (
                 AutocastKwargs,
+                DistributedDataParallelKwargs,
                 DistributedInitKwargs,
                 FP8RecipeKwargs,
                 GradScalerKwargs,
@@ -309,6 +311,8 @@ class Accelerator:
                 self.scaler_handler = handler  # API parity; moot under bf16/fp8 on TPU
             elif isinstance(handler, DistributedInitKwargs):
                 distributed_init_kwargs = handler
+            elif isinstance(handler, DistributedDataParallelKwargs):
+                ddp_kwargs = handler  # comm_hook → reduce_dtype, applied post-state-init
             else:
                 raise ValueError(f"Unsupported kwargs handler: {handler!r}")
         if mixed_precision == "fp8" and self.fp8_recipe is None:
@@ -337,6 +341,14 @@ class Accelerator:
             megatron_lm_plugin=megatron_lm_plugin,
         )
 
+        if ddp_kwargs is not None and ddp_kwargs.reduce_dtype is not None:
+            # DDP comm_hook analog: compress cross-device gradient reductions.
+            import dataclasses as _dc
+
+            self.state.mixed_precision_policy = _dc.replace(
+                self.state.mixed_precision_policy, reduce_dtype=ddp_kwargs.reduce_dtype
+            )
+
         if gradient_accumulation_plugin is None:
             # Priority: explicit Python arg (any int, including 1) > env wire protocol > 1.
             if gradient_accumulation_steps is None:
@@ -360,6 +372,11 @@ class Accelerator:
         self.trackers: list = []
 
         self.step = 0
+        # Param-layout record for the fused-optimizer fast path. None = unknown (no
+        # create_train_state yet — user-managed TrainStates stay on the safe optax path
+        # when sharding machinery is configured); set to ground truth by create_train_state.
+        self._params_cross_sharded: Optional[bool] = None
+        self._param_spec_tree = None
         # ZeRO-1/2 spec trees, filled by create_train_state when the fsdp plugin requests
         # optimizer/gradient sharding with replicated params (zero_stage 1/2).
         self._zero_opt_specs = None
@@ -654,8 +671,14 @@ class Accelerator:
             for l in jax.tree_util.tree_leaves(params)
         )
         self._param_spec_tree = jax.tree_util.tree_map(
-            lambda l: l.sharding.spec
-            if isinstance(l, jax.Array) and isinstance(l.sharding, NamedSharding)
+            # "opaque" = a layout we can't express as a PartitionSpec; the fused optimizer
+            # routes such leaves through plain (partitionable) XLA math, never the kernel.
+            lambda l: (
+                l.sharding.spec
+                if isinstance(l.sharding, NamedSharding)
+                else (PartitionSpec() if l.sharding.is_fully_replicated else "opaque")
+            )
+            if isinstance(l, jax.Array)
             else PartitionSpec(),
             params,
         )
@@ -785,6 +808,17 @@ class Accelerator:
             max_grad_norm = self._max_grad_norm
         accum_steps = self.gradient_accumulation_steps
         wants_rng = _loss_fn_wants_rng(loss_fn)
+        # Low-precision cross-device gradient reduction (DDP comm-hook analog): honored
+        # when the declared reduce_dtype equals the compute dtype — the grad w.r.t. the
+        # cast tree is bit-identical to the grad w.r.t. master params pre-upcast, so the
+        # only change is where GSPMD places the all-reduce.
+        compress_reduce = (
+            cast_params
+            and policy.reduce_dtype is not None
+            and policy.reduce_dtype == policy.compute_dtype
+            and policy.compute_dtype != jnp.float32
+        )
+        self._reduce_compressed = compress_reduce  # introspection/testing
 
         def compute(state: TrainState, batch):
             step_rng = None
@@ -829,6 +863,27 @@ class Accelerator:
                 new_fp8 = state.fp8_state.update(
                     fwd_amax[0], fwd_amax[1], jnp.zeros((), jnp.float32)
                 )
+            elif compress_reduce:
+                # reduce_dtype consumer (the DDP bf16 comm-hook analog): differentiate
+                # w.r.t. the CAST (compute-dtype) tree and upcast to the master dtype
+                # afterwards. Mathematically identical — the backward of the cast IS that
+                # upcast — but GSPMD now attaches the cross-device gradient all-reduce to
+                # the low-precision tensors, halving the reduction bytes on ICI/DCN.
+                cparams = cast_floating(state.params, policy.compute_dtype)
+
+                def inner(cp):
+                    out = loss_fn(cp, batch, step_rng) if wants_rng else loss_fn(cp, batch)
+                    loss, aux = out if has_aux else (out, None)
+                    return jnp.asarray(loss, dtype=jnp.float32), aux
+
+                (loss, aux), gradsc = jax.value_and_grad(inner, has_aux=True)(cparams)
+                grads = jax.tree_util.tree_map(
+                    lambda g, p: g.astype(p.dtype) if jnp.issubdtype(p.dtype, jnp.floating)
+                    else g,
+                    gradsc,
+                    state.params,
+                )
+                new_fp8 = None
             else:
                 (loss, aux), grads = jax.value_and_grad(wrapped, has_aux=True)(state.params)
                 new_fp8 = None
@@ -879,11 +934,23 @@ class Accelerator:
             fused_opt = getattr(tx, "fused_apply", None)
             fused_specs = None
             if fused_opt is not None:
+                plugin = self.state.fsdp_plugin
                 if self._zero_opt_specs is not None or self._zero_param_specs is not None:
                     fused_opt = None
-                elif getattr(self, "_params_cross_sharded", False):
-                    fused_specs = getattr(self, "_param_spec_tree", None)
+                elif self._params_cross_sharded:
+                    fused_specs = self._param_spec_tree
                     if fused_specs is None:
+                        fused_opt = None
+                elif self._params_cross_sharded is None:
+                    # User-managed TrainState (no create_train_state record): only run the
+                    # unmapped kernel when no multi-device sharding machinery could have
+                    # produced cross-device leaves.
+                    if (
+                        self.mesh is not None
+                        and self.mesh.size > 1
+                        and plugin is not None
+                        and plugin.shards_params
+                    ):
                         fused_opt = None
             grad_scale = None
             if max_grad_norm is not None:

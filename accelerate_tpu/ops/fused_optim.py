@@ -235,8 +235,26 @@ class FusedAdamW:
                 )
             return _leaf_xla(p, m, v, g, sc, **kw)
 
+        def _evenly_divisible(shape, spec) -> bool:
+            for dim, axes in zip(shape, spec):
+                if axes is None:
+                    continue
+                axes = axes if isinstance(axes, tuple) else (axes,)
+                n = 1
+                for a in axes:
+                    n *= mesh.shape[a]
+                if dim % n:
+                    return False
+            return True
+
         def one(p, m, v, g, spec=None):
+            if isinstance(spec, str):  # "opaque": un-expressible layout — plain XLA only
+                return _leaf_xla(p, m, v, g, scalars, **kw)
             if spec is not None and mesh is not None and any(a for a in spec):
+                if not _evenly_divisible(p.shape, spec):
+                    # shard_map needs even shards; GSPMD pads NamedShardings (legal), so
+                    # uneven leaves take the identical partitionable XLA math instead.
+                    return _leaf_xla(p, m, v, g, scalars, **kw)
                 from jax.sharding import PartitionSpec
 
                 mapped = jax.shard_map(

@@ -89,6 +89,30 @@ class RNGType(BaseEnum):
     TORCH = "torch"
 
 
+class LoggerType(BaseEnum):
+    """Tracker names accepted by ``Accelerator(log_with=...)`` (reference
+    ``utils/dataclasses.py:584``); each maps to a class in ``tracking.py``."""
+
+    ALL = "all"
+    TENSORBOARD = "tensorboard"
+    WANDB = "wandb"
+    COMETML = "comet_ml"
+    MLFLOW = "mlflow"
+    AIM = "aim"
+    CLEARML = "clearml"
+    DVCLIVE = "dvclive"
+
+
+class ComputeEnvironment(BaseEnum):
+    """Where the job runs (reference ``utils/dataclasses.py:565``). The TPU-native values
+    mirror the ``accelerate-tpu config`` questionnaire (``commands/config.py:52``):
+    SageMaker is a justified non-port; TPU pods and the CPU simulator take its place."""
+
+    LOCAL_MACHINE = "LOCAL_MACHINE"
+    TPU_POD = "TPU_POD"
+    CPU_SIMULATOR = "CPU_SIMULATOR"
+
+
 class ZeroStage(enum.IntEnum):
     """DeepSpeed-ZeRO stage analog: what gets sharded along the fsdp axis.
 
@@ -146,6 +170,47 @@ class DistributedInitKwargs(KwargsHandler):
     process_id: Optional[int] = None
     local_device_ids: Optional[list[int]] = None
     timeout: timedelta = field(default_factory=lambda: timedelta(seconds=1800))
+
+
+@dataclass
+class DistributedDataParallelKwargs(KwargsHandler):
+    """Reference ``utils/dataclasses.py:128`` (torch-DDP construction knobs).
+
+    On TPU, gradient reduction is GSPMD's psum over the mesh — there are no buckets, no
+    graph re-tracing, no unused-parameter scans. The one knob with a real equivalent is
+    ``comm_hook``: bf16/fp16 gradient compression == ``MixedPrecisionPolicy.reduce_dtype``
+    (the Accelerator applies it when this handler is passed). The remaining fields are
+    accepted at their defaults only — setting them raises, because an accepted-but-ignored
+    flag is worse than an error.
+    """
+
+    comm_hook: str = "none"  # none | bf16 | fp16
+    bucket_cap_mb: int = 25
+    find_unused_parameters: bool = False
+    gradient_as_bucket_view: bool = False
+    static_graph: bool = False
+
+    def __post_init__(self):
+        if self.comm_hook not in ("none", "bf16", "fp16"):
+            raise ValueError(
+                f"comm_hook={self.comm_hook!r}: TPU supports 'none', 'bf16', 'fp16' "
+                "(gradient-compression dtype for the cross-device reduce)"
+            )
+        for name in ("find_unused_parameters", "gradient_as_bucket_view", "static_graph"):
+            if getattr(self, name):
+                raise ValueError(
+                    f"DistributedDataParallelKwargs.{name} is torch-DDP-specific and has "
+                    "no GSPMD equivalent on TPU (reductions are compiled into the step)"
+                )
+        if self.bucket_cap_mb != 25:
+            raise ValueError(
+                "bucket_cap_mb has no GSPMD equivalent: XLA fuses and schedules gradient "
+                "reductions itself"
+            )
+
+    @property
+    def reduce_dtype(self):
+        return {"none": None, "bf16": jnp.bfloat16, "fp16": jnp.float16}[self.comm_hook]
 
 
 @dataclass

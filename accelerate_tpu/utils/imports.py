@@ -27,6 +27,7 @@ __all__ = [
     "is_dvclive_available",
     "is_swanlab_available",
     "is_transformers_available",
+    "is_peft_available",
     "is_datasets_available",
     "is_tqdm_available",
     "is_rich_available",
@@ -74,6 +75,7 @@ is_aim_available = _probe("aim")
 is_dvclive_available = _probe("dvclive")
 is_swanlab_available = _probe("swanlab")
 is_transformers_available = _probe("transformers")
+is_peft_available = _probe("peft")
 is_datasets_available = _probe("datasets")
 is_tqdm_available = _probe("tqdm")
 is_rich_available = _probe("rich")

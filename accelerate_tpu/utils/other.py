@@ -73,6 +73,25 @@ def save(obj, f, save_on_each_node: bool = False, safe_serialization: bool = Tru
     state.wait_for_everyone()
 
 
+class PrefixedDataset:
+    """Wrap a mapping-style dataset so every dict key gains ``prefix`` (reference
+    ``utils/other.py`` PrefixedDataset — used to disambiguate multi-source batches fed
+    through one dataloader). Non-mapping samples pass through unchanged."""
+
+    def __init__(self, dataset, prefix: str):
+        self.dataset = dataset
+        self.prefix = prefix
+
+    def __getitem__(self, index):
+        sample = self.dataset[index]
+        if isinstance(sample, dict):
+            return {self.prefix + k: v for k, v in sample.items()}
+        return sample
+
+    def __len__(self):
+        return len(self.dataset)
+
+
 @contextmanager
 def clear_environment():
     """Temporarily empty ``os.environ`` (reference ``environment.py:291``); re-exported here."""

@@ -173,6 +173,83 @@ def test_fused_shard_map_under_fsdp():
     np.testing.assert_allclose(results["fused"][1], results["optax"][1], rtol=1e-5, atol=1e-7)
 
 
+def test_fused_uneven_shard_spec_falls_back_to_xla_math():
+    """A spec whose sharded dim doesn't divide the mesh axis must not reach shard_map
+    (which would raise at trace time) — such leaves take the identical XLA math. The
+    framework's prepare path rejects uneven layouts upstream (parallel/tp.py), so this
+    guards direct fused_apply callers. Opaque layout sentinels take the same route."""
+    from jax.sharding import PartitionSpec
+
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.parallel import MeshConfig
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    from accelerate_tpu import Accelerator
+
+    acc = Accelerator(mesh_config=MeshConfig(tp=8))
+    params = {"w": jnp.ones((64, 100), jnp.float32),   # 100 % 8 != 0 → XLA fallback
+              "q": jnp.ones((64, 128), jnp.float32)}   # opaque sentinel → XLA fallback
+    g = _grads_like(params)
+    ours = fused_adamw(1e-2)
+    ref = optax.adamw(1e-2)
+    state = ours.init(params)
+    p_fused, _ = ours.fused_apply(
+        g, state, params,
+        specs={"w": PartitionSpec(None, "tp"), "q": "opaque"},
+        mesh=acc.mesh,
+    )
+    u, _ = ref.update(g, ref.init(params), params)
+    p_ref = optax.apply_updates(params, u)
+    for k in params:
+        np.testing.assert_allclose(
+            np.asarray(p_fused[k]), np.asarray(p_ref[k]), rtol=2e-5, atol=2e-6
+        )
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def test_bf16_policy_compresses_gradient_reduce():
+    """With the bf16 policy (reduce_dtype == compute_dtype == bf16), build_train_step
+    must take the compressed-reduce formulation; the trajectory still matches the
+    uncompressed fp32-reduce policy within bf16 reduction rounding."""
+    import dataclasses as dc
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    def loss_fn(params, batch):
+        return jnp.mean((batch["x"] @ params["w"] - batch["y"]) ** 2)
+
+    rng = np.random.default_rng(0)
+    batch = {
+        "x": jnp.asarray(rng.normal(size=(16, 64)), jnp.float32),
+        "y": jnp.asarray(rng.normal(size=(16, 128)), jnp.float32),
+    }
+    losses = {}
+    for mode in ("compressed", "fp32_reduce"):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        acc = Accelerator(mixed_precision="bf16")
+        if mode == "fp32_reduce":
+            acc.state.mixed_precision_policy = dc.replace(
+                acc.state.mixed_precision_policy, reduce_dtype=jnp.float32
+            )
+        params = {"w": jnp.zeros((64, 128), jnp.float32)}
+        state = acc.create_train_state(params, optax.adamw(1e-2))
+        step = acc.build_train_step(loss_fn, max_grad_norm=1.0)
+        assert acc._reduce_compressed is (mode == "compressed")
+        run = []
+        for _ in range(4):
+            state, m = step(state, batch)
+            run.append(float(m["loss"]))
+        losses[mode] = run
+    np.testing.assert_allclose(losses["compressed"], losses["fp32_reduce"], rtol=2e-2)
+
+
 def test_fused_falls_back_under_zero1():
     """ZeRO-1 (opt state sharded, params replicated — layouts differ) must route through
     the optax-protocol fallback and still match plain optax adamw losses."""

@@ -168,3 +168,57 @@ def test_parity_helper_apis(tmp_path):
     assert write_basic_config("bf16", str(loc)) is False  # existing config never overridden
     with pytest.raises(ValueError):
         write_basic_config("int3", str(tmp_path / "other.yaml"))
+
+
+def test_parity_enums_and_ddp_kwargs():
+    """LoggerType / ComputeEnvironment enums + DistributedDataParallelKwargs (reference
+    utils/dataclasses.py:128,565,584): the one DDP knob with a TPU meaning (comm_hook)
+    maps to gradient-compression reduce_dtype; CUDA-only knobs raise loudly."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.utils import (
+        ComputeEnvironment,
+        DistributedDataParallelKwargs,
+        LoggerType,
+        PrefixedDataset,
+        is_peft_available,
+    )
+
+    assert "wandb" in LoggerType and LoggerType("tensorboard") is LoggerType.TENSORBOARD
+    assert ComputeEnvironment("LOCAL_MACHINE") is ComputeEnvironment.LOCAL_MACHINE
+    assert isinstance(is_peft_available(), bool)
+
+    assert DistributedDataParallelKwargs().reduce_dtype is None
+    assert DistributedDataParallelKwargs(comm_hook="bf16").reduce_dtype == jnp.bfloat16
+    for bad in (
+        dict(comm_hook="powersgd"),
+        dict(static_graph=True),
+        dict(find_unused_parameters=True),
+        dict(bucket_cap_mb=50),
+    ):
+        with pytest.raises(ValueError):
+            DistributedDataParallelKwargs(**bad)
+
+    ds = PrefixedDataset([{"a": 1, "b": 2}, {"a": 3}], "x_")
+    assert len(ds) == 2 and ds[0] == {"x_a": 1, "x_b": 2}
+
+
+def test_ddp_comm_hook_applies_to_policy():
+    """Passing DistributedDataParallelKwargs(comm_hook=...) through kwargs_handlers must
+    land on the state's MixedPrecisionPolicy.reduce_dtype (the DDP-hook analog)."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import DistributedDataParallelKwargs
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc = Accelerator(
+        kwargs_handlers=[DistributedDataParallelKwargs(comm_hook="bf16")]
+    )
+    assert acc.mixed_precision_policy.reduce_dtype == jnp.bfloat16
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
