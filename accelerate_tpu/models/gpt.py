@@ -56,7 +56,12 @@ class GPTConfig:
     max_seq: int = 2048
     pos: str = "learned"          # "learned" (gpt2) | "rotary" (gpt-j/neox)
     rope_theta: float = 10000.0
+    rotary_dim: Optional[int] = None  # partial rotary: rope the first N dims only
+                                      # (gpt-j rotary_dim, neox rotary_pct); None = full
+    rope_style: str = "half"      # "half" (neox rotate-half) | "interleaved" (gpt-j)
     parallel_residual: bool = False  # gpt-j/neox style
+    activation: str = "gelu_new"  # "gelu_new" (gpt2/gpt-j tanh approx) | "gelu" (neox exact)
+    lm_head_bias: bool = False    # gpt-j's lm_head carries a bias
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
     remat: bool = True
@@ -73,11 +78,13 @@ CONFIGS = {
     "gpt2-xl": GPTConfig(d_model=1600, n_layers=48, n_heads=25, d_ff=6400),
     "gptj-6b": GPTConfig(
         vocab_size=50400, d_model=4096, n_layers=28, n_heads=16, d_ff=16384,
-        pos="rotary", parallel_residual=True, tie_embeddings=False,
+        pos="rotary", rotary_dim=64, rope_style="interleaved",
+        parallel_residual=True, tie_embeddings=False, lm_head_bias=True,
     ),
     "gpt-neox-20b": GPTConfig(
         vocab_size=50432, d_model=6144, n_layers=44, n_heads=64, d_ff=24576,
-        pos="rotary", parallel_residual=True, tie_embeddings=False,
+        pos="rotary", rotary_dim=24, rope_style="half", activation="gelu",
+        parallel_residual=True, tie_embeddings=False,
     ),
     # OPT-30B shape (the reference's biggest offload baseline, README.md:36-37): OPT is a
     # plain GPT decoder with learned positions, sequential residual, ReLU-family MLP —
@@ -134,6 +141,8 @@ def init_params(cfg: GPTConfig, key: Optional[jax.Array] = None) -> dict:
         params["lm_head"] = (
             jax.random.normal(keys[-1], (cfg.d_model, cfg.vocab_size), jnp.float32) * scale
         )
+        if cfg.lm_head_bias:
+            params["b_lm_head"] = jnp.zeros((cfg.vocab_size,), jnp.float32)
     return params
 
 
@@ -168,6 +177,8 @@ def partition_specs(cfg: GPTConfig) -> dict:
         specs["wpe"] = P(None, None)
     if not cfg.tie_embeddings:
         specs["lm_head"] = P(None, (TENSOR_AXIS, FSDP_AXIS))
+        if cfg.lm_head_bias:
+            specs["b_lm_head"] = P((TENSOR_AXIS, FSDP_AXIS))
     return specs
 
 
@@ -179,13 +190,28 @@ def _layer_norm(x, ln, eps):
     return (out * ln["scale"] + ln["bias"]).astype(x.dtype)
 
 
-def _rope(x, positions, theta):
+def _rope(x, positions, theta, style="half", rotary_dim=None):
+    """Rotary embedding, both lineages: "half" rotates [x1|x2] halves (GPT-NeoX
+    rotate_half), "interleaved" rotates (even, odd) pairs (GPT-J rotate_every_two).
+    ``rotary_dim`` < head_dim ropes only the leading dims (gpt-j 64/256, neox pct)."""
     hd = x.shape[-1]
-    freqs = 1.0 / (theta ** (jnp.arange(0, hd, 2, dtype=jnp.float32) / hd))
+    rd = rotary_dim or hd
+    x_pass = None
+    if rd < hd:
+        x, x_pass = x[..., :rd], x[..., rd:]
+    freqs = 1.0 / (theta ** (jnp.arange(0, rd, 2, dtype=jnp.float32) / rd))
     angles = positions[..., None].astype(jnp.float32) * freqs
     cos, sin = jnp.cos(angles)[:, :, None, :], jnp.sin(angles)[:, :, None, :]
-    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
-    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1).astype(x.dtype)
+    xf = x.astype(jnp.float32)
+    if style == "interleaved":
+        x1, x2 = xf[..., 0::2], xf[..., 1::2]
+        rot = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+        out = rot.reshape(*x.shape)
+    else:
+        x1, x2 = jnp.split(xf, 2, axis=-1)
+        out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    out = out.astype(x.dtype)
+    return out if x_pass is None else jnp.concatenate([out, x_pass], axis=-1)
 
 
 def _qkv(h, layer, positions, cfg: GPTConfig):
@@ -197,7 +223,8 @@ def _qkv(h, layer, positions, cfg: GPTConfig):
     k = k.reshape(B, T, cfg.n_heads, hd)
     v = v.reshape(B, T, cfg.n_heads, hd)
     if cfg.pos == "rotary":
-        q, k = _rope(q, positions, cfg.rope_theta), _rope(k, positions, cfg.rope_theta)
+        q = _rope(q, positions, cfg.rope_theta, cfg.rope_style, cfg.rotary_dim)
+        k = _rope(k, positions, cfg.rope_theta, cfg.rope_style, cfg.rotary_dim)
     return q, k, v
 
 
@@ -214,9 +241,10 @@ def _attention(q, k, v, mask):
     return jnp.einsum("bhst,bthd->bshd", probs, v)
 
 
-def _mlp(h, layer, dtype):
+def _mlp(h, layer, dtype, activation="gelu_new"):
     up = h @ layer["w_up"].astype(dtype) + layer["b_up"].astype(dtype)
-    return jax.nn.gelu(up) @ layer["w_down"].astype(dtype) + layer["b_down"].astype(dtype)
+    act = jax.nn.gelu(up, approximate=(activation == "gelu_new"))
+    return act @ layer["w_down"].astype(dtype) + layer["b_down"].astype(dtype)
 
 
 def _block(x, layer, positions, mask, cfg: GPTConfig):
@@ -227,10 +255,10 @@ def _block(x, layer, positions, mask, cfg: GPTConfig):
     if cfg.parallel_residual:
         # GPT-J/NeoX: MLP reads the SAME pre-norm stream; both branches add at once.
         h2 = _layer_norm(x, layer["ln_mlp"], cfg.norm_eps)
-        return x + attn + _mlp(h2, layer, x.dtype)
+        return x + attn + _mlp(h2, layer, x.dtype, cfg.activation)
     x = x + attn
     h2 = _layer_norm(x, layer["ln_mlp"], cfg.norm_eps)
-    return x + _mlp(h2, layer, x.dtype)
+    return x + _mlp(h2, layer, x.dtype, cfg.activation)
 
 
 def _embed(params, tokens, positions, cfg: GPTConfig):
@@ -291,7 +319,10 @@ def forward(
             x = block(x, layer, positions, mask, cfg)
     x = _layer_norm(x, params["ln_f"], cfg.norm_eps)
     head = params["wte"].T if cfg.tie_embeddings else params["lm_head"]
-    return (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+    logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+    if cfg.lm_head_bias and "b_lm_head" in params:
+        logits = logits + params["b_lm_head"].astype(jnp.float32)
+    return logits
 
 
 def loss_fn(params: dict, batch: dict, cfg: GPTConfig, rng=None) -> jax.Array:
@@ -383,11 +414,11 @@ def _block_cached(x, layer, kv, index, positions, valid, cfg: GPTConfig):
     attn = _attn_out(jnp.einsum("bhtc,bchd->bthd", probs, new_v), layer, cfg, B, T)
     if cfg.parallel_residual:
         h2 = _layer_norm(x, layer["ln_mlp"], cfg.norm_eps)
-        out = x + attn + _mlp(h2, layer, x.dtype)
+        out = x + attn + _mlp(h2, layer, x.dtype, cfg.activation)
     else:
         x = x + attn
         h2 = _layer_norm(x, layer["ln_mlp"], cfg.norm_eps)
-        out = x + _mlp(h2, layer, x.dtype)
+        out = x + _mlp(h2, layer, x.dtype, cfg.activation)
     return out, new_kv
 
 
@@ -421,6 +452,8 @@ def forward_cached(
         x = x[:, -1:, :]
     head = params["wte"].T if cfg.tie_embeddings else params["lm_head"]
     logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+    if cfg.lm_head_bias and "b_lm_head" in params:
+        logits = logits + params["b_lm_head"].astype(jnp.float32)
     return logits, {"layers": new_layers, "valid": valid, "index": index + T}
 
 
@@ -505,6 +538,11 @@ def generate_streamed(
     wpe = dispatched.fetch("wpe") if cfg.pos == "learned" else None
     ln_f = dispatched.fetch("ln_f")
     head = wte if cfg.tie_embeddings else dispatched.fetch("lm_head")
+    head_bias = (
+        dispatched.fetch("b_lm_head")
+        if cfg.lm_head_bias and not cfg.tie_embeddings and "b_lm_head" in dispatched.weights
+        else None
+    )
 
     def one_pass(tokens, cache, token_mask):
         if cache is None:
@@ -524,6 +562,8 @@ def generate_streamed(
             new_layers.append(new_kv)
         x = _layer_norm(x, ln_f, cfg.norm_eps)
         logits = _streamed_head_jit(x[:, -1, :], head, transpose=cfg.tie_embeddings)
+        if head_bias is not None:
+            logits = logits + jnp.asarray(head_bias, jnp.float32)
         return logits, {"layers": new_layers, "valid": valid, "index": index + tokens.shape[1]}
 
     return streamed_generate_loop(one_pass, prompt, prompt_mask, gen, rng)
@@ -549,4 +589,6 @@ def num_params(cfg: GPTConfig) -> int:
         total += cfg.max_seq * D
     if not cfg.tie_embeddings:
         total += D * V
+        if cfg.lm_head_bias:
+            total += V
     return total

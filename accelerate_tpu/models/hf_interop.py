@@ -28,9 +28,20 @@ __all__ = [
     "gemma2_from_hf",
     "gpt2_config_from_hf",
     "gpt2_from_hf",
+    "gptj_config_from_hf",
+    "gptj_from_hf",
+    "gpt_neox_config_from_hf",
+    "gpt_neox_from_hf",
     "t5_config_from_hf",
     "t5_from_hf",
 ]
+
+
+def _getter(hf_config: Any):
+    """Uniform accessor over a transformers config object or a plain dict."""
+    if isinstance(hf_config, Mapping):
+        return lambda k, d=None: hf_config.get(k, d)
+    return lambda k, d=None: getattr(hf_config, k, d)
 
 
 def _np(t) -> np.ndarray:
@@ -44,9 +55,7 @@ def llama_config_from_hf(hf_config: Any, **overrides):
     """LlamaConfig from a transformers LlamaConfig (object or dict)."""
     from .llama import LlamaConfig
 
-    get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, Mapping) else (
-        lambda k, d=None: getattr(hf_config, k, d)
-    )
+    get = _getter(hf_config)
     kwargs = dict(
         vocab_size=get("vocab_size"),
         d_model=get("hidden_size"),
@@ -153,9 +162,7 @@ def gemma2_config_from_hf(hf_config: Any, **overrides):
     """
     from .llama import LlamaConfig
 
-    get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, Mapping) else (
-        lambda k, d=None: getattr(hf_config, k, d)
-    )
+    get = _getter(hf_config)
     kwargs = dict(
         vocab_size=get("vocab_size"),
         d_model=get("hidden_size"),
@@ -229,9 +236,7 @@ def gpt2_config_from_hf(hf_config: Any, **overrides):
     """GPTConfig from a transformers GPT2Config (object or dict)."""
     from .gpt import GPTConfig
 
-    get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, Mapping) else (
-        lambda k, d=None: getattr(hf_config, k, d)
-    )
+    get = _getter(hf_config)
     kwargs = dict(
         vocab_size=get("vocab_size"),
         d_model=get("n_embd"),
@@ -285,6 +290,183 @@ def gpt2_from_hf(state_dict: Mapping[str, Any], cfg) -> dict:
     return _to_jnp(params)
 
 
+def gptj_config_from_hf(hf_config: Any, **overrides):
+    """GPTConfig from a transformers GPTJConfig: interleaved partial rotary (rotary_dim),
+    parallel residual off a single LN, biased lm_head (the reference's GPT-J-6B baseline,
+    ``/root/reference/benchmarks/big_model_inference/README.md:25-37``)."""
+    from .gpt import GPTConfig
+
+    get = _getter(hf_config)
+    kwargs = dict(
+        vocab_size=get("vocab_size"),
+        d_model=get("n_embd"),
+        n_layers=get("n_layer"),
+        n_heads=get("n_head"),
+        d_ff=get("n_inner") or 4 * get("n_embd"),
+        max_seq=get("n_positions", 2048),
+        pos="rotary",
+        rotary_dim=get("rotary_dim") or None,
+        rope_style="interleaved",
+        parallel_residual=True,
+        norm_eps=float(get("layer_norm_epsilon", 1e-5)),
+        tie_embeddings=False,
+        lm_head_bias=True,
+    )
+    kwargs.update(overrides)
+    return GPTConfig(**kwargs)
+
+
+def gptj_from_hf(state_dict: Mapping[str, Any], cfg) -> dict:
+    """transformers GPTJForCausalLM state dict → ``models.gpt`` params pytree.
+
+    GPT-J has a SINGLE pre-norm (``ln_1``) feeding both branches of the parallel
+    residual; our layout carries two LN slots, so ``ln_1`` maps to both (identical
+    math). torch Linear stores [out, in] → transposed; missing biases become zeros.
+    """
+    sd = {re.sub(r"^transformer\.", "", k): v for k, v in state_dict.items()}
+
+    def take(name):
+        return _np(sd[name])
+
+    D = cfg.d_model
+    params: dict = {
+        "wte": take("wte.weight"),
+        "ln_f": {"scale": take("ln_f.weight"), "bias": take("ln_f.bias")},
+        "layers": [],
+    }
+    for i in range(cfg.n_layers):
+        p = f"h.{i}."
+        ln = {"scale": take(p + "ln_1.weight"), "bias": take(p + "ln_1.bias")}
+        wqkv = np.concatenate(
+            [take(p + f"attn.{n}_proj.weight").T for n in ("q", "k", "v")], axis=1
+        )
+        params["layers"].append({
+            "ln_attn": dict(ln),
+            "wqkv": wqkv,
+            "b_qkv": np.zeros((3 * D,), np.float32),
+            "wo": take(p + "attn.out_proj.weight").T,
+            "b_o": np.zeros((D,), np.float32),
+            "ln_mlp": dict(ln),  # same tensors: GPT-J's one LN feeds both branches
+            "w_up": take(p + "mlp.fc_in.weight").T,
+            "b_up": take(p + "mlp.fc_in.bias"),
+            "w_down": take(p + "mlp.fc_out.weight").T,
+            "b_down": take(p + "mlp.fc_out.bias"),
+        })
+    if not cfg.tie_embeddings:
+        params["lm_head"] = take("lm_head.weight").T
+        if cfg.lm_head_bias and "lm_head.bias" in sd:
+            params["b_lm_head"] = take("lm_head.bias")
+    if cfg.scan_layers:
+        params["layers"] = _stack_layers(params["layers"])
+    return _to_jnp(params)
+
+
+def _map_gelu(hidden_act: str) -> str:
+    """HF activation name → GPTConfig.activation; raise on anything unmapped rather than
+    silently computing wrong logits with a different activation."""
+    table = {
+        "gelu": "gelu",                  # exact erf gelu (NeoX default)
+        "gelu_new": "gelu_new",          # tanh approximation (GPT-2/GPT-J)
+        "gelu_pytorch_tanh": "gelu_new",
+        "gelu_fast": "gelu_new",         # same tanh form, different constant folding
+    }
+    if hidden_act not in table:
+        raise NotImplementedError(
+            f"hidden_act={hidden_act!r}: models.gpt implements exact and tanh-approx GELU; "
+            "converting would silently change the activation."
+        )
+    return table[hidden_act]
+
+
+def gpt_neox_config_from_hf(hf_config: Any, **overrides):
+    """GPTConfig from a transformers GPTNeoXConfig: rotate-half partial rotary
+    (rotary_pct), two-LN parallel residual, exact GELU (the reference's GPT-NeoX-20B
+    baseline)."""
+    from .gpt import GPTConfig
+
+    get = _getter(hf_config)
+    hd = get("hidden_size") // get("num_attention_heads")
+    if not bool(get("use_parallel_residual", True)):
+        raise NotImplementedError(
+            "use_parallel_residual=False NeoX variants are not mapped (the 20B baseline "
+            "and all Pythia models use the parallel form)."
+        )
+    kwargs = dict(
+        vocab_size=get("vocab_size"),
+        d_model=get("hidden_size"),
+        n_layers=get("num_hidden_layers"),
+        n_heads=get("num_attention_heads"),
+        d_ff=get("intermediate_size"),
+        max_seq=get("max_position_embeddings", 2048),
+        pos="rotary",
+        rotary_dim=int(hd * float(get("rotary_pct", 1.0))) or None,
+        rope_style="half",
+        rope_theta=float(get("rotary_emb_base", 10000.0)),
+        parallel_residual=True,
+        activation=_map_gelu(str(get("hidden_act", "gelu"))),
+        norm_eps=float(get("layer_norm_eps", 1e-5)),
+        tie_embeddings=bool(get("tie_word_embeddings", False)),
+    )
+    kwargs.update(overrides)
+    return GPTConfig(**kwargs)
+
+
+def gpt_neox_from_hf(state_dict: Mapping[str, Any], cfg) -> dict:
+    """transformers GPTNeoXForCausalLM state dict → ``models.gpt`` params pytree.
+
+    NeoX's fused ``query_key_value`` is head-interleaved on the output axis
+    ([head, (q|k|v), head_dim]); our fused layout is role-major ([q_allheads |
+    k_allheads | v_allheads]) — the converter permutes accordingly.
+    """
+    sd = {re.sub(r"^gpt_neox\.", "", k): v for k, v in state_dict.items()}
+
+    def take(name):
+        return _np(sd[name])
+
+    D, H = cfg.d_model, cfg.n_heads
+    hd = D // H
+
+    def _dehead(w_qkv_out_axis):
+        # [..., 3D] with per-head (q,k,v) blocks → role-major [..., 3D]
+        x = w_qkv_out_axis.reshape(*w_qkv_out_axis.shape[:-1], H, 3, hd)
+        x = np.moveaxis(x, -2, -3)  # [..., 3, H, hd]
+        return x.reshape(*w_qkv_out_axis.shape[:-1], 3 * D)
+
+    params: dict = {
+        "wte": take("embed_in.weight"),
+        "ln_f": {
+            "scale": take("final_layer_norm.weight"),
+            "bias": take("final_layer_norm.bias"),
+        },
+        "layers": [],
+    }
+    for i in range(cfg.n_layers):
+        p = f"layers.{i}."
+        params["layers"].append({
+            "ln_attn": {
+                "scale": take(p + "input_layernorm.weight"),
+                "bias": take(p + "input_layernorm.bias"),
+            },
+            "wqkv": _dehead(take(p + "attention.query_key_value.weight").T),
+            "b_qkv": _dehead(take(p + "attention.query_key_value.bias")),
+            "wo": take(p + "attention.dense.weight").T,
+            "b_o": take(p + "attention.dense.bias"),
+            "ln_mlp": {
+                "scale": take(p + "post_attention_layernorm.weight"),
+                "bias": take(p + "post_attention_layernorm.bias"),
+            },
+            "w_up": take(p + "mlp.dense_h_to_4h.weight").T,
+            "b_up": take(p + "mlp.dense_h_to_4h.bias"),
+            "w_down": take(p + "mlp.dense_4h_to_h.weight").T,
+            "b_down": take(p + "mlp.dense_4h_to_h.bias"),
+        })
+    if not cfg.tie_embeddings:
+        params["lm_head"] = take("embed_out.weight").T
+    if cfg.scan_layers:
+        params["layers"] = _stack_layers(params["layers"])
+    return _to_jnp(params)
+
+
 def _stack_layers(layers):
     import jax
 
@@ -301,9 +483,7 @@ def t5_config_from_hf(hf_config: Any, **overrides):
     """T5Config from a transformers T5Config (object or dict)."""
     from .t5 import T5Config
 
-    get = (lambda k, d=None: hf_config.get(k, d)) if isinstance(hf_config, Mapping) else (
-        lambda k, d=None: getattr(hf_config, k, d)
-    )
+    get = _getter(hf_config)
     proj = str(get("feed_forward_proj", "relu"))
     if proj not in ("relu", "gated-gelu"):
         raise NotImplementedError(
