@@ -96,6 +96,9 @@ class LlamaConfig:
     # many tokens under remat instead of materializing fp32 [B,S,V] logits. 0 = auto
     # (chunk only when S*V is large enough to matter), -1 = never chunk.
     loss_chunk: int = 0
+    # "auto": loss_chunk logic above. "fused": ops/fused_xent Pallas kernel — the score
+    # tiles never leave VMEM (no [tokens, V] logits in HBM at all, fwd or bwd).
+    loss_impl: str = "auto"
     # int8 KV cache (inference): store cached k/v as int8 with a per-(token, kv-head)
     # scale — half the cache bytes of bf16, so decode (an HBM gather over the cache)
     # reads half the bytes and a serving engine fits 2× the slots. Dequantization fuses
@@ -794,6 +797,24 @@ def _ce_from_hidden(x, params, targets, mask, cfg: LlamaConfig) -> jax.Array:
     S = x.shape[1]
     denom = jnp.maximum(mask.sum(), 1.0)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    if cfg.loss_impl == "fused":
+        from ..ops._common import interpret_default
+        from ..ops.fused_xent import fused_cross_entropy
+
+        # Single-shard path: on a real multi-chip mesh the pallas_call would force
+        # GSPMD to gather the dp-sharded activations (a compiled-in slowdown), so fall
+        # through to the chunked path there. Interpret mode (CPU tests) lowers to
+        # partitionable XLA and stays on the kernel. TODO: shard_map over dp with a
+        # replicated-head psum'd dw for the multi-chip fused path.
+        if jax.device_count() == 1 or interpret_default():
+            B, _, D = x.shape
+            nll = fused_cross_entropy(
+                x.reshape(B * S, D),
+                head.astype(cfg.dtype),
+                targets.reshape(B * S),
+                softcap=cfg.final_softcap,
+            )
+            return (nll * mask.reshape(B * S)).sum() / denom
     chunk = _loss_chunk_size(cfg, S)  # may exceed/not divide S; _chunked_ce pads
     if chunk > 0:
         return _chunked_ce(

@@ -1,0 +1,10 @@
+"""Shared helpers for the Pallas kernel modules (flash/fused_optim/fused_xent)."""
+
+from __future__ import annotations
+
+import jax
+
+
+def interpret_default() -> bool:
+    """Run kernels in interpreter mode unless a real TPU backend is active."""
+    return jax.default_backend() not in ("tpu", "axon")

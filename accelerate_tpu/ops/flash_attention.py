@@ -35,6 +35,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._common import interpret_default as _interpret_default
+
 __all__ = ["flash_attention"]
 
 _NEG_INF = -1e30
@@ -71,10 +73,6 @@ def _dim_semantics(n_parallel: int, n_arbitrary: int):
         dimension_semantics=(pltpu.PARALLEL,) * n_parallel
         + (pltpu.ARBITRARY,) * n_arbitrary
     )
-
-
-def _interpret_default() -> bool:
-    return jax.default_backend() not in ("tpu", "axon")
 
 
 def _scalar(x) -> jax.Array:

@@ -37,13 +37,11 @@ import optax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._common import interpret_default as _interpret_default
+
 __all__ = ["FusedAdamW", "fused_adamw"]
 
 _LANES = 1024  # 8 sublanes x 128 lanes: the fp32 VMEM tile; every kernel row is one tile
-
-
-def _interpret_default() -> bool:
-    return jax.default_backend() not in ("tpu", "axon")
 
 
 def _adamw_kernel(

@@ -1,0 +1,274 @@
+"""Fused cross-entropy — logits never touch HBM (Pallas, custom VJP).
+
+The reference computes ``lm_head`` logits then ``torch.nn.CrossEntropyLoss`` — at
+V=32k, S=2048, B=4 that is a ~1 GB fp32 tensor materialized twice per step (forward
+and backward). ``models/llama._chunked_ce`` already bounds this by chunking over the
+sequence, but each [B, chunk, V] block still round-trips HBM. This kernel goes the rest
+of the way (the CCE / Liger-kernel idea, TPU-style): the score tile ``x_tile @ w_tile``
+lives only in VMEM, reduced on the fly into an online logsumexp (exactly the
+FlashAttention recurrence with the kv axis replaced by the vocab axis), and the
+backward recomputes score tiles while accumulating ``dx``/``dw`` in VMEM scratch —
+HBM traffic is just the inputs, outputs, and one fp32 [T] logsumexp residual.
+
+API: ``fused_cross_entropy(x, w, targets)`` → per-token nll ``[T]`` (fp32). Mask and
+mean OUTSIDE — autodiff threads the cotangent ``g = mask/denom`` into the kernels.
+Optional ``softcap`` matches Gemma-2's final-logit capping (exact 1−tanh² backward).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._common import interpret_default as _interpret_default
+
+__all__ = ["fused_cross_entropy"]
+
+_NEG_INF = -1e30
+
+
+def _raw_scores(x_ref, w_ref):
+    return jax.lax.dot_general(
+        x_ref[:], w_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+def _scores(x_ref, w_ref, softcap):
+    s = _raw_scores(x_ref, w_ref)
+    if softcap:
+        s = softcap * jnp.tanh(s / softcap)
+    return s
+
+
+def _col_mask(j, block_v, vocab, bt):
+    cols = j * block_v + jax.lax.broadcasted_iota(jnp.int32, (bt, block_v), 1)
+    return cols, cols < vocab
+
+
+def _fwd_kernel(t_ref, x_ref, w_ref, nll_ref, lse_ref, m_ref, l_ref, tgt_ref,
+                *, block_v, vocab, softcap):
+    j = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        tgt_ref[:] = jnp.zeros_like(tgt_ref)
+
+    s = _scores(x_ref, w_ref, softcap)                    # [bt, bv] fp32
+    bt = s.shape[0]
+    cols, valid = _col_mask(j, block_v, vocab, bt)
+    s = jnp.where(valid, s, _NEG_INF)
+
+    m_prev = m_ref[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    l_ref[:] = l_ref[:] * jnp.exp(m_prev - m_new) + jnp.sum(
+        jnp.where(valid, jnp.exp(s - m_new), 0.0), axis=1, keepdims=True
+    )
+    m_ref[:] = m_new
+    # The target column lands in exactly one vocab tile; accumulate its (capped) score.
+    match = cols == t_ref[:]                              # t_ref [bt, 1] broadcasts
+    tgt_ref[:] = tgt_ref[:] + jnp.sum(jnp.where(match, s, 0.0), axis=1, keepdims=True)
+
+    @pl.when(j == nv - 1)
+    def _finalize():
+        lse = m_ref[:] + jnp.log(l_ref[:])
+        lse_ref[:] = lse
+        nll_ref[:] = lse - tgt_ref[:]
+
+
+def _bwd_common(s_raw, lse, g, cols, t_ref, vocab, softcap):
+    """dlogits for one tile: ``(softmax − onehot) · g``, with the softcap chain rule."""
+    if softcap:
+        capped = softcap * jnp.tanh(s_raw / softcap)
+        chain = 1.0 - (capped / softcap) ** 2             # d(cap·tanh(s/cap))/ds
+    else:
+        capped, chain = s_raw, None
+    valid = cols < vocab
+    p = jnp.where(valid, jnp.exp(capped - lse), 0.0)
+    onehot = (cols == t_ref[:]).astype(jnp.float32)
+    d = (p - onehot) * g
+    if chain is not None:
+        d = d * chain
+    return d
+
+
+def _bwd_dx_kernel(t_ref, x_ref, w_ref, lse_ref, g_ref, dx_ref, acc_ref,
+                   *, block_v, vocab, softcap):
+    j = pl.program_id(1)
+    nv = pl.num_programs(1)
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    s = _raw_scores(x_ref, w_ref)
+    bt = s.shape[0]
+    cols, _ = _col_mask(j, block_v, vocab, bt)
+    d = _bwd_common(s, lse_ref[:], g_ref[:], cols, t_ref, vocab, softcap)
+    acc_ref[:] = acc_ref[:] + jax.lax.dot_general(
+        d.astype(w_ref.dtype), w_ref[:], (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(j == nv - 1)
+    def _finalize():
+        dx_ref[:] = acc_ref[:].astype(dx_ref.dtype)
+
+
+def _bwd_dw_kernel(t_ref, x_ref, w_ref, lse_ref, g_ref, dw_ref, acc_ref,
+                   *, block_v, vocab, softcap):
+    # grid (nv, nt): token tiles iterate INNER so dw accumulates in VMEM scratch.
+    j = pl.program_id(0)
+    i = pl.program_id(1)
+    nt = pl.num_programs(1)
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    s = _raw_scores(x_ref, w_ref)
+    bt = s.shape[0]
+    cols, _ = _col_mask(j, block_v, vocab, bt)
+    d = _bwd_common(s, lse_ref[:], g_ref[:], cols, t_ref, vocab, softcap)
+    acc_ref[:] = acc_ref[:] + jax.lax.dot_general(
+        x_ref[:], d.astype(x_ref.dtype), (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(i == nt - 1)
+    def _finalize():
+        dw_ref[:] = acc_ref[:].astype(dw_ref.dtype)
+
+
+def fused_cross_entropy(
+    x: jax.Array,
+    w: jax.Array,
+    targets: jax.Array,
+    softcap: float = 0.0,
+    block_t: int = 256,
+    block_v: int = 256,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Per-token ``-log p(target)`` for ``logits = x @ w`` without materializing logits.
+
+    x [T, D] (any float dtype; dots run in it), w [D, V], targets [T] int32 → nll [T]
+    fp32. Pad/ignored positions: mask the RESULT (a −1 target never matches any column,
+    its nll is just lse — finite, safe to mask).
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    T, D = x.shape
+    V = w.shape[1]
+    Tp = pl.cdiv(T, block_t) * block_t
+    Vp = pl.cdiv(V, block_v) * block_v
+    # Padding happens OUTSIDE the custom_vjp: jnp.pad is differentiable, so autodiff
+    # slices the padded cotangents back down and the kernels only see exact grids.
+    if Tp != T:
+        x = jnp.pad(x, ((0, Tp - T), (0, 0)))
+        targets = jnp.pad(jnp.asarray(targets, jnp.int32), (0, Tp - T),
+                          constant_values=-1)
+    if Vp != V:
+        w = jnp.pad(w, ((0, 0), (0, Vp - V)))
+    t2 = jnp.asarray(targets, jnp.int32).reshape(Tp, 1)
+    nll = _fce(x, w, t2, V, softcap, block_t, block_v, interpret)
+    return nll[:T]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _fce(x, w, t2, vocab, softcap, block_t, block_v, interpret):
+    nll, _ = _fce_fwd(x, w, t2, vocab, softcap, block_t, block_v, interpret)
+    return nll
+
+
+def _fce_fwd(x, w, t2, vocab, softcap, block_t, block_v, interpret):
+    Tp, D = x.shape
+    Vp = w.shape[1]
+    nt, nv = Tp // block_t, Vp // block_v
+
+    nll, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, block_v=block_v, vocab=vocab, softcap=softcap),
+        grid=(nt, nv),
+        in_specs=[
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_t, D), lambda i, j: (i, 0)),
+            pl.BlockSpec((D, block_v), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Tp, 1), jnp.float32),
+            jax.ShapeDtypeStruct((Tp, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_t, 1), jnp.float32),
+            pltpu.VMEM((block_t, 1), jnp.float32),
+            pltpu.VMEM((block_t, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(pltpu.PARALLEL, pltpu.ARBITRARY),
+        ),
+        interpret=interpret,
+    )(t2, x, w)
+    return nll[:, 0], (x, w, t2, lse)
+
+
+def _fce_bwd(vocab, softcap, block_t, block_v, interpret, res, g):
+    x, w, t2, lse = res                # padded shapes throughout
+    Tp, D = x.shape
+    Vp = w.shape[1]
+    nt, nv = Tp // block_t, Vp // block_v
+    g2 = jnp.asarray(g, jnp.float32).reshape(Tp, 1)
+
+    common = dict(block_v=block_v, vocab=vocab, softcap=softcap)
+    dx = pl.pallas_call(
+        functools.partial(_bwd_dx_kernel, **common),
+        grid=(nt, nv),
+        in_specs=[
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_t, D), lambda i, j: (i, 0)),
+            pl.BlockSpec((D, block_v), lambda i, j: (0, j)),
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_t, D), lambda i, j: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((Tp, D), x.dtype),
+        scratch_shapes=[pltpu.VMEM((block_t, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(pltpu.PARALLEL, pltpu.ARBITRARY),
+        ),
+        interpret=interpret,
+    )(t2, x, w, lse, g2)
+
+    dw = pl.pallas_call(
+        functools.partial(_bwd_dw_kernel, **common),
+        grid=(nv, nt),
+        in_specs=[
+            pl.BlockSpec((block_t, 1), lambda j, i: (i, 0)),
+            pl.BlockSpec((block_t, D), lambda j, i: (i, 0)),
+            pl.BlockSpec((D, block_v), lambda j, i: (0, j)),
+            pl.BlockSpec((block_t, 1), lambda j, i: (i, 0)),
+            pl.BlockSpec((block_t, 1), lambda j, i: (i, 0)),
+        ],
+        out_specs=pl.BlockSpec((D, block_v), lambda j, i: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((D, Vp), w.dtype),
+        scratch_shapes=[pltpu.VMEM((D, block_v), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(pltpu.PARALLEL, pltpu.ARBITRARY),
+        ),
+        interpret=interpret,
+    )(t2, x, w, lse, g2)
+
+    return dx, dw, None
+
+
+_fce.defvjp(_fce_fwd, _fce_bwd)

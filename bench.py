@@ -211,6 +211,7 @@ def _make_config(S: int, preset: str | None):
         scan_layers=True,
         scan_unroll=int(os.environ.get("BENCH_SCAN_UNROLL", "1")),
         loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "0")),  # 0 auto, -1 off
+        loss_impl=os.environ.get("BENCH_LOSS_IMPL", "auto"),  # auto | fused (Pallas CE)
         attn_impl=os.environ.get(
             "BENCH_ATTN",
             "flash" if jax.default_backend() in ("tpu", "axon") else "xla",
@@ -367,7 +368,7 @@ def _metric_label(B: int, S: int, fuse: int, preset: str | None, cfg=None) -> st
 _TUNING_KNOBS = {
     "ACCEL_FLASH_BLOCK_Q", "ACCEL_FLASH_BLOCK_K", "ACCEL_FLASH_DIMSEM", "BENCH_ATTN",
     "BENCH_REMAT_POLICY", "BENCH_SCAN_UNROLL", "BENCH_PREVENT_CSE", "BENCH_LOSS_CHUNK",
-    "XLA_FLAGS",
+    "BENCH_LOSS_IMPL", "XLA_FLAGS",
 }
 
 

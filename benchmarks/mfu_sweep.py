@@ -64,6 +64,9 @@ CONFIGS = [
     ("blocks512_mu_bf16", {"ACCEL_FLASH_BLOCK_Q": "512", "ACCEL_FLASH_BLOCK_K": "512",
                            "BENCH_OPT": "adamw_mu_bf16"}),
     ("opt_fused_adamw", {"BENCH_OPT": "fused_adamw"}),
+    ("loss_fused", {"BENCH_LOSS_IMPL": "fused"}),
+    ("blocks512_loss_fused", {"ACCEL_FLASH_BLOCK_Q": "512", "ACCEL_FLASH_BLOCK_K": "512",
+                              "BENCH_LOSS_IMPL": "fused"}),
     ("dimsem", {"ACCEL_FLASH_DIMSEM": "1"}),
     ("blocks512_dimsem", {"ACCEL_FLASH_BLOCK_Q": "512", "ACCEL_FLASH_BLOCK_K": "512",
                           "ACCEL_FLASH_DIMSEM": "1"}),

@@ -56,6 +56,7 @@ MODULES = [
     ("accelerate_tpu.ops.moe", "Mixture of experts"),
     ("accelerate_tpu.ops.fp8", "FP8"),
     ("accelerate_tpu.ops.fused_optim", "Fused optimizers"),
+    ("accelerate_tpu.ops.fused_xent", "Fused cross-entropy"),
     ("accelerate_tpu.ops.quantization", "Quantization"),
     ("accelerate_tpu.ops.packing", "Sample packing"),
     ("accelerate_tpu.ops.collectives", "Collective ops"),
