@@ -1,0 +1,96 @@
+"""by_feature: the fused Pallas kernel stack — flash attention + fused cross-entropy +
+fused AdamW in one training step.
+
+The three hot paths of a causal-LM step, each as an explicit single-pass TPU kernel
+instead of compiler-scheduled XLA ops:
+
+- attention: ``ops/flash_attention.py`` (``attn_impl="flash"``) — the [S, S] score
+  matrix never materializes in HBM;
+- loss head: ``ops/fused_xent.py`` (``loss_impl="fused"``) — the [tokens, vocab]
+  logits never materialize in HBM, forward or backward;
+- optimizer: ``ops/fused_optim.FusedAdamW`` — one HBM pass over params/moments/grads
+  with the global-norm clip factor folded in as a scalar.
+
+The example verifies the fused stack reaches the same losses as the unfused
+(XLA-scheduled) configuration, then reports the per-step timing of both.
+
+  accelerate-tpu launch examples/by_feature/fused_kernels.py --smoke
+"""
+
+import argparse
+import dataclasses
+import time
+
+import jax
+import numpy as np
+import optax
+
+from accelerate_tpu import Accelerator
+from accelerate_tpu.models import llama
+from accelerate_tpu.ops import fused_adamw
+from accelerate_tpu.utils import set_seed
+
+
+def build(accelerator, cfg, fused: bool):
+    tx = fused_adamw(1e-3) if fused else optax.adamw(1e-3)
+    state = accelerator.create_train_state(llama.init_params(cfg), tx)
+    step = accelerator.build_train_step(
+        lambda p, b: llama.loss_fn(p, b, cfg), optimizer=tx, max_grad_norm=1.0
+    )
+    return state, step
+
+
+def run(accelerator, cfg, batch, fused: bool, steps: int):
+    state, step = build(accelerator, cfg, fused)
+    state, metrics = step(state, batch)  # compile
+    losses = [metrics["loss"]]
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+        losses.append(metrics["loss"])  # device arrays — no host sync inside the loop
+    jax.block_until_ready(losses[-1])
+    dt = (time.perf_counter() - t0) / steps
+    return [float(l) for l in losses], dt
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--smoke", action="store_true")
+    parser.add_argument("--cpu", action="store_true")
+    parser.add_argument("--steps", type=int, default=4)
+    args = parser.parse_args()
+
+    accelerator = Accelerator(cpu=args.cpu)
+    set_seed(42)
+    cfg = dataclasses.replace(
+        llama.CONFIGS["tiny"],
+        vocab_size=512,
+        remat=False,
+        # Both configs share flash attention (compiled on TPU, interpret on CPU) so the
+        # fused-vs-unfused comparison isolates the CE + optimizer kernels.
+        attn_impl="flash",
+    )
+    rng = np.random.default_rng(0)
+    from accelerate_tpu.utils import send_to_device
+
+    batch = send_to_device(
+        {"tokens": rng.integers(0, cfg.vocab_size, (4, cfg.max_seq + 1)).astype("int32")},
+        accelerator.mesh,
+    )
+
+    fused_cfg = dataclasses.replace(cfg, loss_impl="fused")
+    fused_losses, fused_dt = run(accelerator, fused_cfg, batch, fused=True, steps=args.steps)
+    plain_losses, plain_dt = run(accelerator, cfg, batch, fused=False, steps=args.steps)
+
+    np.testing.assert_allclose(fused_losses, plain_losses, rtol=2e-2)
+    accelerator.print(
+        f"fused stack: {fused_dt * 1e3:.1f} ms/step | unfused: {plain_dt * 1e3:.1f} ms/step\n"
+        f"losses (fused)  : {[round(l, 4) for l in fused_losses]}\n"
+        f"losses (unfused): {[round(l, 4) for l in plain_losses]}\n"
+        "same trajectory, kernel-explicit HBM traffic"
+    )
+    accelerator.end_training()
+
+
+if __name__ == "__main__":
+    main()
