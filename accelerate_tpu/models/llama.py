@@ -97,7 +97,10 @@ class LlamaConfig:
     # (chunk only when S*V is large enough to matter), -1 = never chunk.
     loss_chunk: int = 0
     # "auto": loss_chunk logic above. "fused": ops/fused_xent Pallas kernel — the score
-    # tiles never leave VMEM (no [tokens, V] logits in HBM at all, fwd or bwd).
+    # tiles never leave VMEM (no [tokens, V] logits in HBM at all, fwd or bwd);
+    # single-device (multi-device meshes fall back to auto). "fused_dp": the multi-chip
+    # variant — shard_map over the batch axes with a replicated head (for dp/fsdp-batch
+    # layouts; needs an active mesh context).
     loss_impl: str = "auto"
     # int8 KV cache (inference): store cached k/v as int8 with a per-(token, kv-head)
     # scale — half the cache bytes of bf16, so decode (an HBM gather over the cache)
@@ -797,15 +800,54 @@ def _ce_from_hidden(x, params, targets, mask, cfg: LlamaConfig) -> jax.Array:
     S = x.shape[1]
     denom = jnp.maximum(mask.sum(), 1.0)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    if cfg.loss_impl not in ("auto", "fused", "fused_dp"):
+        raise ValueError(
+            f"loss_impl={cfg.loss_impl!r}: expected 'auto', 'fused', or 'fused_dp' "
+            "(a typo would otherwise silently run the chunked path)"
+        )
+    if cfg.loss_impl == "fused_dp":
+        # Multi-chip fused CE: shard_map over the batch axes — each device runs the
+        # kernel on ITS tokens against a replicated head (in_spec P() makes shard_map's
+        # transpose psum the head gradient). For batch-sharded layouts (dp/fsdp); under
+        # tp-sharded heads or sp-sharded sequences prefer the chunked path (this one
+        # would all-gather the head / sequence into every shard).
+        from jax.sharding import get_abstract_mesh
+
+        from ..ops.fused_xent import fused_cross_entropy
+        from ..utils.constants import BATCH_AXES
+
+        mesh = get_abstract_mesh()
+        if not getattr(mesh, "axis_names", ()):
+            raise ValueError(
+                "loss_impl='fused_dp' needs an active mesh context "
+                "(Accelerator.build_train_step provides one; or wrap in jax.set_mesh)."
+            )
+        D = x.shape[-1]
+
+        def _local(xl, tl, ml, hd):
+            Bl = xl.shape[0]
+            nll = fused_cross_entropy(
+                xl.reshape(Bl * S, D), hd, tl.reshape(Bl * S),
+                softcap=cfg.final_softcap,
+            )
+            return (nll * ml.reshape(Bl * S)).sum()[None]
+
+        partials = jax.shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=(P(BATCH_AXES), P(BATCH_AXES), P(BATCH_AXES), P()),
+            out_specs=P(BATCH_AXES),
+            check_vma=False,  # pallas_call outputs carry no vma info
+        )(x, targets, mask, head.astype(cfg.dtype))
+        return partials.sum() / denom
     if cfg.loss_impl == "fused":
         from ..ops._common import interpret_default
         from ..ops.fused_xent import fused_cross_entropy
 
         # Single-shard path: on a real multi-chip mesh the pallas_call would force
         # GSPMD to gather the dp-sharded activations (a compiled-in slowdown), so fall
-        # through to the chunked path there. Interpret mode (CPU tests) lowers to
-        # partitionable XLA and stays on the kernel. TODO: shard_map over dp with a
-        # replicated-head psum'd dw for the multi-chip fused path.
+        # through to the chunked path there (or use loss_impl="fused_dp"). Interpret
+        # mode (CPU tests) lowers to partitionable XLA and stays on the kernel.
         if jax.device_count() == 1 or interpret_default():
             B, _, D = x.shape
             nll = fused_cross_entropy(

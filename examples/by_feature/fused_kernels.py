@@ -60,6 +60,10 @@ def main():
     parser.add_argument("--steps", type=int, default=4)
     args = parser.parse_args()
 
+    if args.smoke or args.cpu:
+        # Repo-wide example convention: --smoke is the CPU-safe seconds-long CI run.
+        jax.config.update("jax_platforms", "cpu")
+
     accelerator = Accelerator(cpu=args.cpu)
     set_seed(42)
     cfg = dataclasses.replace(
@@ -73,12 +77,15 @@ def main():
     rng = np.random.default_rng(0)
     from accelerate_tpu.utils import send_to_device
 
+    B = max(4, jax.device_count())  # global batch must divide the batch mesh axes
     batch = send_to_device(
-        {"tokens": rng.integers(0, cfg.vocab_size, (4, cfg.max_seq + 1)).astype("int32")},
+        {"tokens": rng.integers(0, cfg.vocab_size, (B, cfg.max_seq + 1)).astype("int32")},
         accelerator.mesh,
     )
 
-    fused_cfg = dataclasses.replace(cfg, loss_impl="fused")
+    # Multi-device runs take the shard_map fused-CE path; single device the plain kernel.
+    fused_impl = "fused_dp" if jax.device_count() > 1 else "fused"
+    fused_cfg = dataclasses.replace(cfg, loss_impl=fused_impl)
     fused_losses, fused_dt = run(accelerator, fused_cfg, batch, fused=True, steps=args.steps)
     plain_losses, plain_dt = run(accelerator, cfg, batch, fused=False, steps=args.steps)
 

@@ -89,6 +89,50 @@ def test_llama_loss_fused_matches_auto():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-6)
 
 
+def test_llama_loss_fused_dp_matches_auto_on_mesh():
+    """fused_dp: shard_map over the batch axes on the 8-device sim — the full train
+    step (grads + adamw) must track the auto-CE trajectory step for step."""
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    base = dataclasses.replace(
+        llama.CONFIGS["tiny"], vocab_size=300, dtype=jnp.float32, remat=False
+    )
+    rng = np.random.default_rng(4)
+    batch = {"tokens": jnp.asarray(rng.integers(0, 300, (8, 33)), jnp.int32)}
+    runs = {}
+    for impl in ("auto", "fused_dp"):
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+        cfg = dataclasses.replace(base, loss_impl=impl)
+        acc = Accelerator()
+        state = acc.create_train_state(llama.init_params(cfg), optax.adamw(1e-3))
+        step = acc.build_train_step(lambda p, b: llama.loss_fn(p, b, cfg))
+        losses = []
+        for _ in range(3):
+            state, m = step(state, batch)
+            losses.append(float(m["loss"]))
+        runs[impl] = losses
+    np.testing.assert_allclose(runs["fused_dp"], runs["auto"], rtol=1e-4)
+
+
+def test_llama_loss_fused_dp_without_mesh_raises():
+    from accelerate_tpu.models import llama
+
+    cfg = dataclasses.replace(
+        llama.CONFIGS["tiny"], vocab_size=300, dtype=jnp.float32, remat=False,
+        loss_impl="fused_dp",
+    )
+    params = llama.init_params(cfg)
+    tokens = jnp.asarray(np.random.default_rng(5).integers(0, 300, (2, 17)), jnp.int32)
+    with pytest.raises(ValueError, match="mesh context"):
+        llama.loss_fn(params, {"tokens": tokens}, cfg)
+
+
 def test_llama_loss_fused_gemma_softcap():
     """final_softcap (Gemma-2) flows into the kernel."""
     from accelerate_tpu.models import llama
