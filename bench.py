@@ -257,7 +257,8 @@ def run(B: int, S: int, fuse: int, preset: str | None):
     n_params = llama.num_params(cfg)
     metric = _metric_label(B, S, fuse, preset, cfg)
 
-    acc = Accelerator(mixed_precision="bf16")
+    accum = int(os.environ.get("BENCH_ACCUM", "1"))
+    acc = Accelerator(mixed_precision="bf16", gradient_accumulation_steps=accum)
     state = acc.create_train_state(
         llama.init_params(cfg), _make_optimizer(os.environ.get("BENCH_OPT", "adamw"))
     )
@@ -358,7 +359,12 @@ def _metric_label(B: int, S: int, fuse: int, preset: str | None, cfg=None) -> st
         )
     opt = os.environ.get("BENCH_OPT", "adamw")
     opt_tag = "" if opt == "adamw" else f" {opt}"
-    return f"train_mfu (llama-0.9B b{B} seq{S} bf16 {attn} {remat} fused{fuse}{opt_tag})"
+    accum = os.environ.get("BENCH_ACCUM", "1")
+    accum_tag = "" if accum == "1" else f" accum{accum}"  # workload change: labeled
+    return (
+        f"train_mfu (llama-0.9B b{B} seq{S} bf16 {attn} {remat} fused{fuse}"
+        f"{opt_tag}{accum_tag})"
+    )
 
 
 # Only pure TUNING knobs may be auto-adopted from sweep results. Workload knobs

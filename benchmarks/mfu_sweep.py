@@ -65,6 +65,14 @@ CONFIGS = [
                            "BENCH_OPT": "adamw_mu_bf16"}),
     ("opt_fused_adamw", {"BENCH_OPT": "fused_adamw"}),
     ("loss_fused", {"BENCH_LOSS_IMPL": "fused"}),
+    # accumulation rows change the WORKLOAD (one apply per 4 micro-batches) — labeled,
+    # never auto-adopted; they bound the optimizer-apply share. Pinned to B=2: the fp32
+    # grad_accum buffer adds ~3.6 GB resident, which at the default B=4 would OOM the
+    # 16 GB chip and silently halve the batch mid-row. b2 is the matching baseline.
+    ("b2", {"BENCH_B": "2"}),
+    ("accum4_b2", {"BENCH_ACCUM": "4", "BENCH_B": "2"}),
+    ("accum4_b2_blocks512", {"BENCH_ACCUM": "4", "BENCH_B": "2",
+                             "ACCEL_FLASH_BLOCK_Q": "512", "ACCEL_FLASH_BLOCK_K": "512"}),
     ("blocks512_loss_fused", {"ACCEL_FLASH_BLOCK_Q": "512", "ACCEL_FLASH_BLOCK_K": "512",
                               "BENCH_LOSS_IMPL": "fused"}),
     ("dimsem", {"ACCEL_FLASH_DIMSEM": "1"}),

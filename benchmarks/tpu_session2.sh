@@ -19,7 +19,7 @@ echo "decompose rc=$?"; grep -a "opt_adamw" decompose2.json | head -2
 
 echo "=== 2. optimizer attribution rows (fused kernel first) ==="
 python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
-  --only opt_fused_adamw,blocks512_fused_adamw,opt_sgd,opt_mu_bf16,opt_adafactor
+  --only opt_fused_adamw,blocks512_fused_adamw,b2,accum4_b2,accum4_b2_blocks512,opt_sgd,opt_mu_bf16,opt_adafactor
 
 echo "=== 3. combo rows ==="
 python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
