@@ -23,6 +23,7 @@ from .generation import GenerationConfig, generate_loop, sample_logits
 from .inference import prepare_pippy
 from .launchers import debug_launcher, notebook_launcher
 from .local_sgd import LocalSGD
+from .lm_dataset import TokenDataset, write_token_file
 from .logging import get_logger
 from .utils.memory import find_executable_batch_size
 from .utils import (

@@ -1,0 +1,200 @@
+"""Indexed LM pretraining dataset — the Megatron indexed-dataset analog, TPU-native.
+
+The reference's Megatron integration consumes pretokenized corpora through Megatron's
+``IndexedDataset``/``GPTDataset`` machinery (reference ``utils/megatron_lm.py``
+MegatronLMDummyDataLoader — the real loaders live in Megatron-LM's C++/Python data
+pipeline). Here the same capability is a first-class component:
+
+- a corpus is ONE flat token array memmapped from a ``.bin`` file (documents
+  concatenated, EOD tokens marking boundaries — the standard GPT pretraining layout);
+- a sample is a ``[seq_len + 1]`` window at ``i * seq_len`` (the +1 provides the shifted
+  next-token target; consecutive windows overlap by one token so no target is lost);
+- per-epoch sample order is a deterministic native Fisher-Yates (splitmix64) — identical
+  across hosts for a given (seed, epoch), so every data-parallel rank derives the same
+  global order and ``BatchSamplerShard`` slices it disjointly;
+- batch assembly is a multithreaded C++ gather (``native/lmdata.cpp``) with a
+  behavior-identical numpy fallback.
+
+``TokenDataset`` is a map-style dataset: it composes with ``Accelerator.
+prepare_data_loader`` / ``BatchSamplerShard`` like any other dataset. ``iter_batches``
+is the fast path for tight host loops (one native call per batch).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+__all__ = ["TokenDataset", "write_token_file", "native_available"]
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "native")
+_SRC = os.path.join(_NATIVE_DIR, "lmdata.cpp")
+_SO = os.path.join(_NATIVE_DIR, "liblmdata.so")
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.lm_shuffle.restype = None
+    lib.lm_shuffle.argtypes = [
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_uint64,
+    ]
+    lib.lm_gather.restype = ctypes.c_int64
+    lib.lm_gather.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32),
+    ]
+
+
+def _load_native():
+    """Build (once) and load the native library; None when no toolchain is available."""
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        from .native import load_native
+
+        _lib = load_native(_SRC, _SO, _configure, extra_flags=("-pthread",))
+        if _lib is None:
+            _build_failed = True
+    return _lib
+
+
+def native_available() -> bool:
+    return _load_native() is not None
+
+
+def _splitmix64(state: int) -> tuple[int, int]:
+    """One splitmix64 step — mirrors native/lmdata.cpp exactly (python fallback RNG)."""
+    mask = (1 << 64) - 1
+    state = (state + 0x9E3779B97F4A7C15) & mask
+    z = state
+    z = ((z ^ (z >> 30)) * 0xBF58476D1CE4E5B9) & mask
+    z = ((z ^ (z >> 27)) * 0x94D049BB133111EB) & mask
+    return state, z ^ (z >> 31)
+
+
+def _shuffle_py(idx: np.ndarray, seed: int) -> None:
+    state = seed
+    for i in range(len(idx) - 1, 0, -1):
+        state, r = _splitmix64(state)
+        j = r % (i + 1)
+        idx[i], idx[j] = idx[j], idx[i]
+
+
+def write_token_file(tokens, path: str) -> None:
+    """Write a token id sequence as the flat int32 ``.bin`` layout ``TokenDataset`` reads."""
+    arr = np.ascontiguousarray(np.asarray(tokens, dtype=np.int32))
+    tmp = f"{path}.{os.getpid()}.tmp"
+    try:
+        arr.tofile(tmp)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):  # failed write: don't litter the output directory
+            os.unlink(tmp)
+
+
+class TokenDataset:
+    """Map-style dataset over a memmapped token corpus.
+
+    ``source``: path to a flat int32 ``.bin`` file (memmapped; corpus never loads into
+    RAM) or an in-memory integer array. Sample ``i`` is the ``[seq_len + 1]`` window at
+    shuffled offset ``order[i] * seq_len``; call :meth:`set_epoch` to reshuffle
+    deterministically (all ranks derive the same order — required for disjoint
+    ``BatchSamplerShard`` slices).
+    """
+
+    def __init__(self, source, seq_len: int, seed: int = 0, shuffle: bool = True):
+        if isinstance(source, (str, os.PathLike)):
+            self.tokens = np.memmap(source, dtype=np.int32, mode="r")
+        else:
+            self.tokens = np.ascontiguousarray(np.asarray(source, dtype=np.int32))
+        if seq_len < 1:
+            raise ValueError(f"seq_len must be >= 1, got {seq_len}")
+        self.seq_len = int(seq_len)
+        self.seed = int(seed)
+        self.shuffle = bool(shuffle)
+        n = (len(self.tokens) - 1) // self.seq_len
+        if n < 1:
+            raise ValueError(
+                f"corpus of {len(self.tokens)} tokens holds no [{seq_len + 1}] window"
+            )
+        self._n = n
+        self._order = np.arange(n, dtype=np.int64)
+        self._epoch: Optional[int] = None
+        if self.shuffle:
+            self.set_epoch(0)
+
+    # ------------------------------------------------------------------ epoch shuffle
+    def set_epoch(self, epoch: int) -> None:
+        """Deterministic per-epoch reshuffle (identical on every rank)."""
+        if not self.shuffle or epoch == self._epoch:
+            return
+        self._order = np.arange(self._n, dtype=np.int64)
+        seed = (self.seed * 1_000_003 + epoch + 1) & ((1 << 64) - 1)
+        lib = _load_native()
+        if lib is not None:
+            lib.lm_shuffle(
+                self._order.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                self._n, ctypes.c_uint64(seed),
+            )
+        else:
+            _shuffle_py(self._order, seed)
+        self._epoch = epoch
+
+    # ----------------------------------------------------------------- dataset protocol
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, index: int) -> dict:
+        start = int(self._order[index]) * self.seq_len
+        window = np.asarray(self.tokens[start : start + self.seq_len + 1])
+        return {"tokens": window}
+
+    # --------------------------------------------------------------------- fast batches
+    def iter_batches(
+        self, batch_size: int, rank: int = 0, world_size: int = 1, drop_last: bool = True
+    ) -> Iterator[dict]:
+        """One native gather per GLOBAL batch, sliced to this rank's rows.
+
+        Iteration follows the epoch order; every rank sees the same global batches and
+        takes rows ``[rank * per_rank, (rank+1) * per_rank)`` — the ``BatchSamplerShard``
+        contract without per-item Python overhead. With ``world_size > 1`` the final
+        partial global batch is always dropped (splitting it would hand the ranks
+        different — possibly empty — shapes into a compiled step).
+        """
+        if batch_size % world_size:
+            raise ValueError(f"batch_size {batch_size} not divisible by world {world_size}")
+        per_rank = batch_size // world_size
+        width = self.seq_len + 1
+        lib = _load_native()
+        tok = self.tokens
+        keep_partial = not drop_last and world_size == 1
+        stop = self._n if keep_partial else self._n - batch_size + 1
+        for base in range(0, stop, batch_size):
+            rows = self._order[base : base + batch_size]
+            starts = rows[rank * per_rank : (rank + 1) * per_rank] * self.seq_len
+            out = np.empty((len(starts), width), dtype=np.int32)
+            if lib is not None:
+                rc = lib.lm_gather(
+                    tok.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)), len(tok),
+                    np.ascontiguousarray(starts).ctypes.data_as(
+                        ctypes.POINTER(ctypes.c_int64)
+                    ),
+                    len(starts), width,
+                    out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                )
+                if rc != 0:
+                    raise IndexError("window out of corpus bounds")
+            else:
+                for r, s in enumerate(starts):
+                    out[r] = tok[s : s + width]
+            yield {"tokens": out}

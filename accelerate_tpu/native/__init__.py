@@ -2,6 +2,46 @@
 
 The reference delegates its native-performance concerns to external engines (NCCL,
 DeepSpeed, bitsandbytes, ...); here the device-side equivalents are XLA/Pallas programs,
-and the HOST-side hot loops that remain (data-path work like sequence packing) live in
-this package as small C-ABI libraries built on demand with g++ (``ops/packing.py``).
+and the HOST-side hot loops that remain (data-path work like sequence packing and corpus
+batch assembly) live in this package as small C-ABI libraries built on demand with g++
+(``ops/packing.py``, ``lm_dataset.py`` via :func:`load_native`).
 """
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Callable, Optional, Sequence
+
+
+def load_native(
+    src: str,
+    so: str,
+    configure: Callable[[ctypes.CDLL], None],
+    extra_flags: Sequence[str] = (),
+) -> Optional[ctypes.CDLL]:
+    """Build ``src`` → ``so`` (if stale) and CDLL it; None when the toolchain fails.
+
+    Build goes to a per-process temp name then renames atomically: concurrent processes
+    (multi-process launches, dataloader workers) would otherwise race g++ on the same
+    output path and CDLL a half-written file. ``configure`` sets restype/argtypes.
+    Callers hold their own once-lock and cache the handle / build-failed flag.
+    """
+    try:
+        if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+            tmp = f"{so}.{os.getpid()}.tmp"
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", src, "-o", tmp, *extra_flags],
+                    check=True, capture_output=True, timeout=120,
+                )
+                os.replace(tmp, so)
+            finally:
+                if os.path.exists(tmp):  # failed/partial build: don't litter the package
+                    os.unlink(tmp)
+        lib = ctypes.CDLL(so)
+        configure(lib)
+        return lib
+    except Exception:
+        return None

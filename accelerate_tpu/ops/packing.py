@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
 import threading
 from typing import Optional, Sequence
 
@@ -34,6 +33,16 @@ _lib = None
 _build_failed = False
 
 
+def _configure(lib: ctypes.CDLL) -> None:
+    lib.pack_sequences_ffit.restype = ctypes.c_longlong
+    lib.pack_sequences_ffit.argtypes = [
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+        ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+        ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
+    ]
+
+
 def _load_native():
     """Build (once) and load the native packer; None when no toolchain is available."""
     global _lib, _build_failed
@@ -42,31 +51,10 @@ def _load_native():
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
-        try:
-            if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-                # Build to a per-process temp name and rename atomically: concurrent
-                # processes (multi-process launches, dataloader workers) would otherwise
-                # race g++ on the same output path and CDLL a half-written file.
-                tmp = f"{_SO}.{os.getpid()}.tmp"
-                try:
-                    subprocess.run(
-                        ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", tmp],
-                        check=True, capture_output=True, timeout=120,
-                    )
-                    os.replace(tmp, _SO)
-                finally:
-                    if os.path.exists(tmp):  # failed/partial build: don't litter the package
-                        os.unlink(tmp)
-            lib = ctypes.CDLL(_SO)
-            lib.pack_sequences_ffit.restype = ctypes.c_longlong
-            lib.pack_sequences_ffit.argtypes = [
-                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
-                ctypes.c_int64, ctypes.c_int64,
-                ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
-                ctypes.POINTER(ctypes.c_int32), ctypes.c_int64,
-            ]
-            _lib = lib
-        except Exception:
+        from ..native import load_native
+
+        _lib = load_native(_SRC, _SO, _configure)
+        if _lib is None:
             _build_failed = True
         return _lib
 

@@ -59,6 +59,7 @@ MODULES = [
     ("accelerate_tpu.ops.fused_xent", "Fused cross-entropy"),
     ("accelerate_tpu.ops.quantization", "Quantization"),
     ("accelerate_tpu.ops.packing", "Sample packing"),
+    ("accelerate_tpu.lm_dataset", "Indexed LM dataset"),
     ("accelerate_tpu.ops.collectives", "Collective ops"),
     ("accelerate_tpu.utils.dataclasses", "Plugins & kwargs handlers"),
     ("accelerate_tpu.utils.operations", "Pytree operations"),
