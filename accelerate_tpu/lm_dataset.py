@@ -156,7 +156,9 @@ class TokenDataset:
 
     def __getitem__(self, index: int) -> dict:
         start = int(self._order[index]) * self.seq_len
-        window = np.asarray(self.tokens[start : start + self.seq_len + 1])
+        # A fresh copy, not a memmap view: torch's default collate wraps the returned
+        # array without copying, and an in-place edit of a read-only mmap page segfaults.
+        window = np.array(self.tokens[start : start + self.seq_len + 1])
         return {"tokens": window}
 
     # --------------------------------------------------------------------- fast batches

@@ -2,7 +2,7 @@
 
 from .fp8 import DelayedScalingState, delayed_scales, fp8_dot, fp8_linear
 from .fused_optim import FusedAdamW, fused_adamw
-from .fused_xent import fused_cross_entropy
+from .fused_xent import fused_cross_entropy, fused_cross_entropy_tp
 from .quantization import (
     BnbQuantizationConfig,
     QuantizedWeight,

@@ -27,7 +27,7 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ._common import interpret_default as _interpret_default
 
-__all__ = ["fused_cross_entropy"]
+__all__ = ["fused_cross_entropy", "fused_cross_entropy_tp"]
 
 _NEG_INF = -1e30
 
@@ -51,10 +51,8 @@ def _col_mask(j, block_v, vocab, bt):
     return cols, cols < vocab
 
 
-def _fwd_kernel(t_ref, x_ref, w_ref, nll_ref, lse_ref, m_ref, l_ref, tgt_ref,
-                *, block_v, vocab, softcap):
-    j = pl.program_id(1)
-    nv = pl.num_programs(1)
+def _online_tile(j, t_ref, x_ref, w_ref, m_ref, l_ref, tgt_ref, *, block_v, vocab, softcap):
+    """Shared forward tile: fold one [bt, bv] score tile into the online (m, l, tgt)."""
 
     @pl.when(j == 0)
     def _init():
@@ -74,14 +72,40 @@ def _fwd_kernel(t_ref, x_ref, w_ref, nll_ref, lse_ref, m_ref, l_ref, tgt_ref,
     )
     m_ref[:] = m_new
     # The target column lands in exactly one vocab tile; accumulate its (capped) score.
-    match = cols == t_ref[:]                              # t_ref [bt, 1] broadcasts
+    # `valid` matters for the tp variant: a target id outside this shard's vocab slice
+    # must not match a padded column (whose masked score is -inf).
+    match = jnp.logical_and(cols == t_ref[:], valid)      # t_ref [bt, 1] broadcasts
     tgt_ref[:] = tgt_ref[:] + jnp.sum(jnp.where(match, s, 0.0), axis=1, keepdims=True)
+
+
+def _fwd_kernel(t_ref, x_ref, w_ref, nll_ref, lse_ref, m_ref, l_ref, tgt_ref,
+                *, block_v, vocab, softcap):
+    j = pl.program_id(1)
+    nv = pl.num_programs(1)
+    _online_tile(j, t_ref, x_ref, w_ref, m_ref, l_ref, tgt_ref,
+                 block_v=block_v, vocab=vocab, softcap=softcap)
 
     @pl.when(j == nv - 1)
     def _finalize():
         lse = m_ref[:] + jnp.log(l_ref[:])
         lse_ref[:] = lse
         nll_ref[:] = lse - tgt_ref[:]
+
+
+def _fwd_partial_kernel(t_ref, x_ref, w_ref, m_out, l_out, tgt_out, m_ref, l_ref, tgt_ref,
+                        *, block_v, vocab, softcap):
+    """Partial-statistics variant for vocab-sharded heads: emits the raw online
+    (max, sumexp-at-max, target-score) so the caller can merge across shards."""
+    j = pl.program_id(1)
+    nv = pl.num_programs(1)
+    _online_tile(j, t_ref, x_ref, w_ref, m_ref, l_ref, tgt_ref,
+                 block_v=block_v, vocab=vocab, softcap=softcap)
+
+    @pl.when(j == nv - 1)
+    def _finalize():
+        m_out[:] = m_ref[:]
+        l_out[:] = l_ref[:]
+        tgt_out[:] = tgt_ref[:]
 
 
 def _bwd_common(s_raw, lse, g, cols, t_ref, vocab, softcap):
@@ -93,7 +117,7 @@ def _bwd_common(s_raw, lse, g, cols, t_ref, vocab, softcap):
         capped, chain = s_raw, None
     valid = cols < vocab
     p = jnp.where(valid, jnp.exp(capped - lse), 0.0)
-    onehot = (cols == t_ref[:]).astype(jnp.float32)
+    onehot = jnp.logical_and(cols == t_ref[:], valid).astype(jnp.float32)
     d = (p - onehot) * g
     if chain is not None:
         d = d * chain
@@ -272,3 +296,112 @@ def _fce_bwd(vocab, softcap, block_t, block_v, interpret, res, g):
 
 
 _fce.defvjp(_fce_fwd, _fce_bwd)
+
+
+# ------------------------------------------------------------ vocab-sharded (tp) variant
+def fused_cross_entropy_tp(
+    x: jax.Array,
+    w_shard: jax.Array,
+    targets: jax.Array,
+    axis_name,
+    softcap: float = 0.0,
+    block_t: int = 256,
+    block_v: int = 256,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Fused CE for a TENSOR-PARALLEL (vocab-sharded) head — call INSIDE shard_map,
+    which MUST be built with ``check_vma=False`` (pallas outputs carry no vma info, and
+    the backward compensates for that mode's split-cotangent adjoint convention — under
+    ``check_vma=True`` gradients would come back scaled by the axis size).
+
+    Each shard holds ``w_shard`` [D, V/ntp] (vocab-major order along ``axis_name``) and
+    the full ``targets`` (global ids). Shards compute local online statistics with the
+    kernel, then merge across ``axis_name``: ``lse = pmax/psum`` logsumexp merge, target
+    score via psum (exactly one shard owns each target id). The backward runs the local
+    dx/dw kernels against the GLOBAL lse — dw stays shard-local, dx partials are summed
+    by shard_map's transpose (x enters replicated over ``axis_name``).
+    """
+    if interpret is None:
+        interpret = _interpret_default()
+    T, D = x.shape
+    Vl = w_shard.shape[1]
+    idx = jax.lax.axis_index(axis_name)
+    t_local = jnp.asarray(targets, jnp.int32) - idx * Vl  # non-owners go out of range
+    Tp = pl.cdiv(T, block_t) * block_t
+    Vp = pl.cdiv(Vl, block_v) * block_v
+    if Tp != T:
+        x = jnp.pad(x, ((0, Tp - T), (0, 0)))
+        t_local = jnp.pad(t_local, (0, Tp - T), constant_values=-1)
+    if Vp != Vl:
+        w_shard = jnp.pad(w_shard, ((0, 0), (0, Vp - Vl)))
+    t2 = t_local.reshape(Tp, 1)
+    nll = _fce_tp(x, w_shard, t2, Vl, softcap, block_t, block_v, interpret, axis_name)
+    return nll[:T]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _fce_tp(x, w, t2, vocab, softcap, block_t, block_v, interpret, axis_name):
+    nll, _ = _fce_tp_fwd(x, w, t2, vocab, softcap, block_t, block_v, interpret, axis_name)
+    return nll
+
+
+def _fce_tp_fwd(x, w, t2, vocab, softcap, block_t, block_v, interpret, axis_name):
+    Tp, D = x.shape
+    Vp = w.shape[1]
+    nt, nv = Tp // block_t, Vp // block_v
+
+    m, l, tgt = pl.pallas_call(
+        functools.partial(
+            _fwd_partial_kernel, block_v=block_v, vocab=vocab, softcap=softcap
+        ),
+        grid=(nt, nv),
+        in_specs=[
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_t, D), lambda i, j: (i, 0)),
+            pl.BlockSpec((D, block_v), lambda i, j: (0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
+            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Tp, 1), jnp.float32),
+            jax.ShapeDtypeStruct((Tp, 1), jnp.float32),
+            jax.ShapeDtypeStruct((Tp, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_t, 1), jnp.float32),
+            pltpu.VMEM((block_t, 1), jnp.float32),
+            pltpu.VMEM((block_t, 1), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=(pltpu.PARALLEL, pltpu.ARBITRARY),
+        ),
+        interpret=interpret,
+    )(t2, x, w)
+
+    # Cross-shard logsumexp merge (the ring-attention recurrence over the tp axis).
+    m_g = jax.lax.pmax(m, axis_name)
+    l_g = jax.lax.psum(l * jnp.exp(m - m_g), axis_name)
+    lse = m_g + jnp.log(l_g)
+    tgt_g = jax.lax.psum(tgt, axis_name)  # exactly one shard owns each target id
+    nll = (lse - tgt_g)[:, 0]
+    return nll, (x, w, t2, lse)
+
+
+def _fce_tp_bwd(vocab, softcap, block_t, block_v, interpret, axis_name, res, g):
+    # The local backward is IDENTICAL to the single-shard one once lse is global:
+    # each shard differentiates only its vocab slice; shard_map's transpose psums the
+    # x-cotangents (x is replicated over axis_name), dw stays local.
+    #
+    # check_vma=False adjoint convention: a replicated (out_specs P()) output's
+    # cotangent arrives SPLIT across the axis (g/n per shard — the psum adjoint).
+    # Scale it back so dx = psum(partials·g) and the shard-local dw see the true g.
+    # tests/test_fused_xent.py::test_tp_variant_matches_dense pins this convention.
+    g = g * jax.lax.axis_size(axis_name)
+    dx, dw, _ = _fce_bwd(vocab, softcap, block_t, block_v, interpret, res, g)
+    return dx, dw, None
+
+
+_fce_tp.defvjp(_fce_tp_fwd, _fce_tp_bwd)

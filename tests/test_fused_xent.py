@@ -133,6 +133,48 @@ def test_llama_loss_fused_dp_without_mesh_raises():
         llama.loss_fn(params, {"tokens": tokens}, cfg)
 
 
+@pytest.mark.parametrize("softcap", [0.0, 25.0])
+def test_tp_variant_matches_dense(softcap):
+    """Vocab-sharded fused CE under shard_map (tp=8): nll and BOTH grads must match the
+    dense reference — incl. the cross-shard logsumexp merge and the psum'd dx."""
+    import jax.sharding as shd
+
+    from accelerate_tpu.ops.fused_xent import fused_cross_entropy_tp
+
+    devs = np.array(jax.devices()[:8]).reshape(8)
+    mesh = shd.Mesh(devs, ("tp",))
+    T, D, V = 64, 64, 320  # V/8 = 40 per shard (pads to block_v inside)
+    x, w, t = _data(T=T, D=D, V=V, seed=6)
+    m = jnp.asarray(np.random.default_rng(7).normal(size=(T,)), jnp.float32)
+
+    def sharded_loss(x, w, t):
+        def local(xl, wl, tl):
+            return fused_cross_entropy_tp(
+                xl, wl, tl, axis_name="tp", softcap=softcap, block_t=32, block_v=32
+            )
+
+        nll = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(shd.PartitionSpec(), shd.PartitionSpec(None, "tp"),
+                      shd.PartitionSpec()),
+            out_specs=shd.PartitionSpec(),
+            check_vma=False,
+        )(x, w, t)
+        return (nll * m).sum()
+
+    def dense_loss(x, w, t):
+        return (_ref_nll(x, w, t, softcap) * m).sum()
+
+    with jax.set_mesh(mesh):
+        ours = float(sharded_loss(x, w, t))
+        go = jax.grad(sharded_loss, argnums=(0, 1))(x, w, t)
+    ref = float(dense_loss(x, w, t))
+    gr = jax.grad(dense_loss, argnums=(0, 1))(x, w, t)
+    assert ours == pytest.approx(ref, rel=2e-5)
+    for a, b in zip(go, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-6)
+
+
 def test_llama_loss_fused_gemma_softcap():
     """final_softcap (Gemma-2) flows into the kernel."""
     from accelerate_tpu.models import llama
