@@ -212,37 +212,37 @@ def _fce(x, w, t2, vocab, softcap, block_t, block_v, interpret):
     return nll
 
 
-def _fce_fwd(x, w, t2, vocab, softcap, block_t, block_v, interpret):
+def _launch_fwd(kernel_fn, n_outputs, x, w, t2, *, vocab, softcap, block_t, block_v,
+                interpret):
+    """Shared forward launch (same grid/specs/scratch for both fwd kernel variants —
+    they differ only in the kernel fn and how many [Tp, 1] statistics they emit)."""
     Tp, D = x.shape
     Vp = w.shape[1]
     nt, nv = Tp // block_t, Vp // block_v
-
-    nll, lse = pl.pallas_call(
-        functools.partial(_fwd_kernel, block_v=block_v, vocab=vocab, softcap=softcap),
+    stat_spec = pl.BlockSpec((block_t, 1), lambda i, j: (i, 0))
+    return pl.pallas_call(
+        functools.partial(kernel_fn, block_v=block_v, vocab=vocab, softcap=softcap),
         grid=(nt, nv),
         in_specs=[
-            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
+            stat_spec,
             pl.BlockSpec((block_t, D), lambda i, j: (i, 0)),
             pl.BlockSpec((D, block_v), lambda i, j: (0, j)),
         ],
-        out_specs=[
-            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((Tp, 1), jnp.float32),
-            jax.ShapeDtypeStruct((Tp, 1), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_t, 1), jnp.float32),
-            pltpu.VMEM((block_t, 1), jnp.float32),
-            pltpu.VMEM((block_t, 1), jnp.float32),
-        ],
+        out_specs=[stat_spec] * n_outputs,
+        out_shape=[jax.ShapeDtypeStruct((Tp, 1), jnp.float32)] * n_outputs,
+        scratch_shapes=[pltpu.VMEM((block_t, 1), jnp.float32)] * 3,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=(pltpu.PARALLEL, pltpu.ARBITRARY),
         ),
         interpret=interpret,
     )(t2, x, w)
+
+
+def _fce_fwd(x, w, t2, vocab, softcap, block_t, block_v, interpret):
+    nll, lse = _launch_fwd(
+        _fwd_kernel, 2, x, w, t2, vocab=vocab, softcap=softcap,
+        block_t=block_t, block_v=block_v, interpret=interpret,
+    )
     return nll[:, 0], (x, w, t2, lse)
 
 
@@ -350,36 +350,10 @@ def _fce_tp_fwd(x, w, t2, vocab, softcap, block_t, block_v, interpret, axis_name
     Vp = w.shape[1]
     nt, nv = Tp // block_t, Vp // block_v
 
-    m, l, tgt = pl.pallas_call(
-        functools.partial(
-            _fwd_partial_kernel, block_v=block_v, vocab=vocab, softcap=softcap
-        ),
-        grid=(nt, nv),
-        in_specs=[
-            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((block_t, D), lambda i, j: (i, 0)),
-            pl.BlockSpec((D, block_v), lambda i, j: (0, j)),
-        ],
-        out_specs=[
-            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
-            pl.BlockSpec((block_t, 1), lambda i, j: (i, 0)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((Tp, 1), jnp.float32),
-            jax.ShapeDtypeStruct((Tp, 1), jnp.float32),
-            jax.ShapeDtypeStruct((Tp, 1), jnp.float32),
-        ],
-        scratch_shapes=[
-            pltpu.VMEM((block_t, 1), jnp.float32),
-            pltpu.VMEM((block_t, 1), jnp.float32),
-            pltpu.VMEM((block_t, 1), jnp.float32),
-        ],
-        compiler_params=pltpu.CompilerParams(
-            dimension_semantics=(pltpu.PARALLEL, pltpu.ARBITRARY),
-        ),
-        interpret=interpret,
-    )(t2, x, w)
+    m, l, tgt = _launch_fwd(
+        _fwd_partial_kernel, 3, x, w, t2, vocab=vocab, softcap=softcap,
+        block_t=block_t, block_v=block_v, interpret=interpret,
+    )
 
     # Cross-shard logsumexp merge (the ring-attention recurrence over the tp axis).
     m_g = jax.lax.pmax(m, axis_name)

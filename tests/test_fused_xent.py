@@ -143,7 +143,8 @@ def test_tp_variant_matches_dense(softcap):
 
     devs = np.array(jax.devices()[:8]).reshape(8)
     mesh = shd.Mesh(devs, ("tp",))
-    T, D, V = 64, 64, 320  # V/8 = 40 per shard (pads to block_v inside)
+    # T=60 exercises the token-padding path (block_t=32); V/8 = 40 pads to block_v.
+    T, D, V = 60, 64, 320
     x, w, t = _data(T=T, D=D, V=V, seed=6)
     m = jnp.asarray(np.random.default_rng(7).normal(size=(T,)), jnp.float32)
 
