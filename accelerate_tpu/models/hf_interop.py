@@ -22,6 +22,8 @@ import jax.numpy as jnp
 __all__ = [
     "llama_config_from_hf",
     "llama_from_hf",
+    "mistral_config_from_hf",
+    "mistral_from_hf",
     "qwen2_config_from_hf",
     "qwen2_from_hf",
     "gemma2_config_from_hf",
@@ -125,6 +127,27 @@ def llama_from_hf(state_dict: Mapping[str, Any], cfg) -> dict:
     if cfg.scan_layers:
         params["layers"] = _stack_layers(params["layers"])
     return _to_jnp(params)
+
+
+def mistral_config_from_hf(hf_config: Any, **overrides):
+    """LlamaConfig from a transformers MistralConfig — Mistral is the llama architecture
+    with sliding-window attention on EVERY layer (``window_every=1``); weights convert
+    via :func:`mistral_from_hf` (same tensor layout as llama)."""
+    get = _getter(hf_config)
+    window = int(get("sliding_window") or 0)
+    return llama_config_from_hf(
+        hf_config,
+        **{
+            "sliding_window": window,
+            "window_every": 1,
+            # Mistral-Nemo sets an explicit head_dim != d_model // n_heads.
+            "head_dim_override": get("head_dim"),
+            **overrides,
+        },
+    )
+
+
+mistral_from_hf = llama_from_hf  # identical state-dict layout
 
 
 def qwen2_config_from_hf(hf_config: Any, **overrides):

@@ -346,10 +346,6 @@ def _fce_tp(x, w, t2, vocab, softcap, block_t, block_v, interpret, axis_name):
 
 
 def _fce_tp_fwd(x, w, t2, vocab, softcap, block_t, block_v, interpret, axis_name):
-    Tp, D = x.shape
-    Vp = w.shape[1]
-    nt, nv = Tp // block_t, Vp // block_v
-
     m, l, tgt = _launch_fwd(
         _fwd_partial_kernel, 3, x, w, t2, vocab=vocab, softcap=softcap,
         block_t=block_t, block_v=block_v, interpret=interpret,

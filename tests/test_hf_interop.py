@@ -333,3 +333,26 @@ def test_t5_greedy_generate_matches_transformers():
         ).numpy()[0, 1:]  # drop the decoder_start token
     n = min(len(ours[0]), len(theirs))
     np.testing.assert_array_equal(ours[0][:n], theirs[:n])
+
+
+def test_mistral_logits_match_transformers():
+    """Mistral = llama + all-layer sliding window; parity vs transformers itself."""
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=96, hidden_size=48, num_hidden_layers=2, num_attention_heads=4,
+        num_key_value_heads=2, intermediate_size=96, sliding_window=8,
+        max_position_embeddings=64,
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.MistralForCausalLM(hf_cfg).eval()
+    from accelerate_tpu.models import llama
+
+    cfg = hf_interop.mistral_config_from_hf(hf_cfg, dtype=jnp.float32, remat=False)
+    assert cfg.sliding_window == 8 and cfg.window_every == 1
+    params = hf_interop.mistral_from_hf(hf_model.state_dict(), cfg)
+    tokens = np.random.default_rng(9).integers(0, 96, size=(2, 24)).astype(np.int32)
+    with torch.no_grad():
+        hf_logits = hf_model(torch.from_numpy(tokens.astype(np.int64))).logits.numpy()
+    ours = np.asarray(
+        llama.forward(params, jnp.asarray(tokens), cfg, shard_activations=False)
+    )
+    np.testing.assert_allclose(ours, hf_logits, atol=3e-4, rtol=1e-3)
