@@ -108,3 +108,26 @@ def read_kv(new_kv: dict, name: str, dtype) -> jax.Array:
     if f"{name}_scale" in new_kv:
         return new_kv[name].astype(dtype) * new_kv[f"{name}_scale"].astype(dtype)
     return new_kv[name]
+
+
+def fused_ce_single_shard(x, head, targets, mask, softcap: float = 0.0):
+    """Masked-mean fused cross-entropy over [B, S, D] hidden states, or None.
+
+    Shared dispatch for the model families' ``loss_impl="fused"`` branches: returns None
+    when the single-shard kernel must not run (a real multi-device mesh — the pallas_call
+    would force GSPMD to gather the batch-sharded activations; interpret mode lowers to
+    partitionable XLA and stays on the kernel). ``mask`` [B, S] float; ``head`` [D, V]
+    already in compute dtype.
+    """
+    from ..ops._common import interpret_default
+
+    if not (jax.device_count() == 1 or interpret_default()):
+        return None
+    from ..ops.fused_xent import fused_cross_entropy
+
+    B, S, D = x.shape
+    nll = fused_cross_entropy(
+        x.reshape(B * S, D), head, targets.reshape(B * S), softcap=softcap
+    )
+    mask1d = mask.reshape(B * S)
+    return (nll * mask1d).sum() / jnp.maximum(mask1d.sum(), 1.0)

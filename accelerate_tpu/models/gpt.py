@@ -71,6 +71,9 @@ class GPTConfig:
     scan_unroll: int = 1                  # lax.scan unroll for the layer stack
     tie_embeddings: bool = True   # gpt2 ties lm_head to wte
     kv_quant: bool = False        # int8 KV cache (see models/common.py kv helpers)
+    # "auto": dense CE. "fused": ops/fused_xent Pallas kernel (single-device; falls back
+    # to dense under multi-device meshes or a biased lm_head, which the kernel lacks).
+    loss_impl: str = "auto"
 
 
 CONFIGS = {
@@ -275,8 +278,10 @@ def forward(
     positions: Optional[jax.Array] = None,
     shard_activations: bool = True,
     segment_ids: Optional[jax.Array] = None,
+    return_hidden: bool = False,
 ) -> jax.Array:
-    """Causal LM: tokens [B, S] → logits [B, S, V] fp32.
+    """Causal LM: tokens [B, S] → logits [B, S, V] fp32 (post-ln_f hidden states when
+    ``return_hidden`` — the fused-CE path applies the head inside its kernel).
 
     ``segment_ids`` (sample packing, ``ops/packing.py``): attention restricts to the
     per-segment causal block diagonal and positions default to per-segment restarts —
@@ -318,8 +323,17 @@ def forward(
         for layer in params["layers"]:
             x = block(x, layer, positions, mask, cfg)
     x = _layer_norm(x, params["ln_f"], cfg.norm_eps)
-    head = params["wte"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+    if return_hidden:
+        return x
+    return _head_logits(x, params, cfg)
+
+
+def _head_weight(params: dict, cfg: GPTConfig) -> jax.Array:
+    return params["wte"].T if cfg.tie_embeddings else params["lm_head"]
+
+
+def _head_logits(x, params: dict, cfg: GPTConfig) -> jax.Array:
+    logits = (x @ _head_weight(params, cfg).astype(cfg.dtype)).astype(jnp.float32)
     if cfg.lm_head_bias and "b_lm_head" in params:
         logits = logits + params["b_lm_head"].astype(jnp.float32)
     return logits
@@ -338,12 +352,31 @@ def loss_fn(params: dict, batch: dict, cfg: GPTConfig, rng=None) -> jax.Array:
         if user_mask is not None:
             m = m * user_mask
         positions = batch["positions"][:, :-1] if "positions" in batch else None
-        logits = forward(
-            params, inputs, cfg, positions=positions, segment_ids=seg[:, :-1]
-        )
+        seg_in = seg[:, :-1]
     else:
         m = user_mask
-        logits = forward(params, inputs, cfg)
+        positions = None
+        seg_in = None
+    if cfg.loss_impl not in ("auto", "fused"):
+        raise ValueError(f"loss_impl={cfg.loss_impl!r}: expected 'auto' or 'fused'")
+    use_kernel = (
+        cfg.loss_impl == "fused"
+        and not (cfg.lm_head_bias and "b_lm_head" in params)  # kernel has no bias term
+    )
+    if use_kernel:
+        from .common import fused_ce_single_shard
+
+        x = forward(
+            params, inputs, cfg, positions=positions, segment_ids=seg_in,
+            return_hidden=True,
+        )
+        mask2d = m if m is not None else jnp.ones(targets.shape, jnp.float32)
+        loss = fused_ce_single_shard(
+            x, _head_weight(params, cfg).astype(cfg.dtype), targets, mask2d
+        )
+        if loss is not None:
+            return loss
+    logits = forward(params, inputs, cfg, positions=positions, segment_ids=seg_in)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
     if m is None:

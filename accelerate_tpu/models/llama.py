@@ -841,22 +841,15 @@ def _ce_from_hidden(x, params, targets, mask, cfg: LlamaConfig) -> jax.Array:
         )(x, targets, mask, head.astype(cfg.dtype))
         return partials.sum() / denom
     if cfg.loss_impl == "fused":
-        from ..ops._common import interpret_default
-        from ..ops.fused_xent import fused_cross_entropy
+        # Single-shard path (shared dispatch in models/common.py): on a real multi-chip
+        # mesh this returns None — fall through to the chunked path (or use "fused_dp").
+        from .common import fused_ce_single_shard
 
-        # Single-shard path: on a real multi-chip mesh the pallas_call would force
-        # GSPMD to gather the dp-sharded activations (a compiled-in slowdown), so fall
-        # through to the chunked path there (or use loss_impl="fused_dp"). Interpret
-        # mode (CPU tests) lowers to partitionable XLA and stays on the kernel.
-        if jax.device_count() == 1 or interpret_default():
-            B, _, D = x.shape
-            nll = fused_cross_entropy(
-                x.reshape(B * S, D),
-                head.astype(cfg.dtype),
-                targets.reshape(B * S),
-                softcap=cfg.final_softcap,
-            )
-            return (nll * mask.reshape(B * S)).sum() / denom
+        loss = fused_ce_single_shard(
+            x, head.astype(cfg.dtype), targets, mask, softcap=cfg.final_softcap
+        )
+        if loss is not None:
+            return loss
     chunk = _loss_chunk_size(cfg, S)  # may exceed/not divide S; _chunked_ce pads
     if chunk > 0:
         return _chunked_ce(

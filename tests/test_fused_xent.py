@@ -176,6 +176,42 @@ def test_tp_variant_matches_dense(softcap):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-6)
 
 
+def test_gpt_loss_fused_matches_auto():
+    """gpt family: fused CE loss + grads track the dense path (masked batch included);
+    a biased lm_head (GPT-J) falls back to dense rather than dropping the bias."""
+    from accelerate_tpu.models import gpt
+
+    base = dataclasses.replace(
+        gpt.CONFIGS["tiny"], vocab_size=300, dtype=jnp.float32, remat=False
+    )
+    params = gpt.init_params(base)
+    rng = np.random.default_rng(8)
+    batch = {
+        "tokens": jnp.asarray(rng.integers(0, 300, (2, 21)), jnp.int32),
+        "mask": jnp.asarray(rng.integers(0, 2, (2, 21)), jnp.float32).at[:, 0].set(1.0),
+    }
+    cfg_fused = dataclasses.replace(base, loss_impl="fused")
+    l_auto = float(gpt.loss_fn(params, batch, base))
+    l_fused = float(gpt.loss_fn(params, batch, cfg_fused))
+    assert l_fused == pytest.approx(l_auto, rel=1e-5)
+    g_auto = jax.grad(lambda p: gpt.loss_fn(p, batch, base))(params)
+    g_fused = jax.grad(lambda p: gpt.loss_fn(p, batch, cfg_fused))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_auto), jax.tree_util.tree_leaves(g_fused)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-6)
+
+    # Biased-head config: fused must take the dense path (bias honored, same loss).
+    bias_cfg = dataclasses.replace(
+        base, tie_embeddings=False, lm_head_bias=True, loss_impl="fused"
+    )
+    bias_params = gpt.init_params(bias_cfg)
+    bias_params["b_lm_head"] = jnp.asarray(rng.normal(size=(300,)), jnp.float32)
+    l_bias_fused = float(gpt.loss_fn(bias_params, batch, bias_cfg))
+    l_bias_auto = float(
+        gpt.loss_fn(bias_params, batch, dataclasses.replace(bias_cfg, loss_impl="auto"))
+    )
+    assert l_bias_fused == pytest.approx(l_bias_auto, rel=1e-6)
+
+
 def test_llama_loss_fused_gemma_softcap():
     """final_softcap (Gemma-2) flows into the kernel."""
     from accelerate_tpu.models import llama
