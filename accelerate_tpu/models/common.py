@@ -1,5 +1,8 @@
 """Shared model-family machinery: remat policy resolution + KV-cache plane helpers.
 
+Also home to the cross-family fused-CE dispatch (``fused_ce_allowed`` /
+``fused_ce_single_shard``) used by the ``loss_impl="fused"`` branches of llama/gpt/t5.
+
 One implementation of the remat knobs every family config exposes (``remat``,
 ``remat_policy``, ``remat_prevent_cse``), so llama/gpt/t5 cannot drift: the reference
 gets the analogous single point from torch's ``checkpoint_wrapper`` applied in
@@ -19,7 +22,10 @@ from typing import Callable, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
-__all__ = ["remat_wrap", "kv_planes", "write_kv", "read_kv", "quant_kv"]
+__all__ = [
+    "remat_wrap", "kv_planes", "write_kv", "read_kv", "quant_kv",
+    "fused_ce_allowed", "fused_ce_single_shard",
+]
 
 
 def remat_wrap(
@@ -110,18 +116,23 @@ def read_kv(new_kv: dict, name: str, dtype) -> jax.Array:
     return new_kv[name]
 
 
+def fused_ce_allowed() -> bool:
+    """True when the single-shard fused-CE kernel may run: one device, or interpret
+    mode (CPU tests — lowers to partitionable XLA). On a real multi-device mesh the
+    pallas_call would force GSPMD to gather the batch-sharded activations."""
+    from ..ops._common import interpret_default
+
+    return jax.device_count() == 1 or interpret_default()
+
+
 def fused_ce_single_shard(x, head, targets, mask, softcap: float = 0.0):
     """Masked-mean fused cross-entropy over [B, S, D] hidden states, or None.
 
     Shared dispatch for the model families' ``loss_impl="fused"`` branches: returns None
-    when the single-shard kernel must not run (a real multi-device mesh — the pallas_call
-    would force GSPMD to gather the batch-sharded activations; interpret mode lowers to
-    partitionable XLA and stays on the kernel). ``mask`` [B, S] float; ``head`` [D, V]
-    already in compute dtype.
+    when :func:`fused_ce_allowed` says the kernel must not run. ``mask`` [B, S] float;
+    ``head`` [D, V] already in compute dtype.
     """
-    from ..ops._common import interpret_default
-
-    if not (jax.device_count() == 1 or interpret_default()):
+    if not fused_ce_allowed():
         return None
     from ..ops.fused_xent import fused_cross_entropy
 

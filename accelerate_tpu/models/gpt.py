@@ -359,9 +359,12 @@ def loss_fn(params: dict, batch: dict, cfg: GPTConfig, rng=None) -> jax.Array:
         seg_in = None
     if cfg.loss_impl not in ("auto", "fused"):
         raise ValueError(f"loss_impl={cfg.loss_impl!r}: expected 'auto' or 'fused'")
+    from .common import fused_ce_allowed
+
     use_kernel = (
         cfg.loss_impl == "fused"
         and not (cfg.lm_head_bias and "b_lm_head" in params)  # kernel has no bias term
+        and fused_ce_allowed()  # up-front gate: never trace the forward twice
     )
     if use_kernel:
         from .common import fused_ce_single_shard
@@ -371,11 +374,10 @@ def loss_fn(params: dict, batch: dict, cfg: GPTConfig, rng=None) -> jax.Array:
             return_hidden=True,
         )
         mask2d = m if m is not None else jnp.ones(targets.shape, jnp.float32)
-        loss = fused_ce_single_shard(
+        # use_kernel implies fused_ce_allowed(): the helper cannot return None here.
+        return fused_ce_single_shard(
             x, _head_weight(params, cfg).astype(cfg.dtype), targets, mask2d
         )
-        if loss is not None:
-            return loss
     logits = forward(params, inputs, cfg, positions=positions, segment_ids=seg_in)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)

@@ -61,6 +61,9 @@ class T5Config:
     norm_eps: float = 1e-6
     dtype: Any = jnp.bfloat16
     tie_embeddings: bool = True
+    # "auto": dense CE. "fused": ops/fused_xent kernel (single device; multi-device
+    # meshes fall back to dense).
+    loss_impl: str = "auto"
     remat: bool = False                       # jax.checkpoint each enc/dec block
     remat_policy: str = "full"                # "full" | "dots" | "offload" (models/common.py)
     remat_prevent_cse: Optional[bool] = None  # None = auto (True: python-loop stack)
@@ -307,7 +310,8 @@ def encode(params: dict, input_ids: jax.Array, cfg: T5Config,
 def decode(params: dict, decoder_input_ids: jax.Array, enc_out: jax.Array, cfg: T5Config,
            enc_mask: Optional[jax.Array] = None,
            dec_segment_ids: Optional[jax.Array] = None,
-           enc_segment_ids: Optional[jax.Array] = None) -> jax.Array:
+           enc_segment_ids: Optional[jax.Array] = None,
+           return_hidden: bool = False) -> jax.Array:
     """Decoder: ids [B, T] + encoder hidden → logits [B, T, V] fp32.
 
     Packed rows (``dec_segment_ids``/``enc_segment_ids``): self-attention restricts to
@@ -343,11 +347,14 @@ def decode(params: dict, decoder_input_ids: jax.Array, enc_out: jax.Array, cfg: 
         x = dec_block(x, blk, enc_out, bias, causal, cmask, cfg)
     x = _t5_norm(x, params["decoder"]["ln_f"], cfg.norm_eps)
     if cfg.tie_embeddings:
-        x = x * (cfg.d_model**-0.5)
-        head = params["shared"].T
-    else:
-        head = params["lm_head"]
-    return (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+        x = x * (cfg.d_model**-0.5)  # tied-head scaling lives on the hidden side
+    if return_hidden:
+        return x
+    return (x @ _t5_head(params, cfg).astype(cfg.dtype)).astype(jnp.float32)
+
+
+def _t5_head(params: dict, cfg: T5Config) -> jax.Array:
+    return params["shared"].T if cfg.tie_embeddings else params["lm_head"]
 
 
 def forward(params: dict, input_ids: jax.Array, decoder_input_ids: jax.Array,
@@ -372,6 +379,11 @@ def loss_fn(params: dict, batch: dict, cfg: T5Config, rng=None) -> jax.Array:
             "seq2seq packing uses pack_seq2seq ('enc_segment_ids'/'dec_segment_ids'), "
             "not the decoder-only 'segment_ids' layout"
         )
+    if cfg.loss_impl not in ("auto", "fused"):
+        raise ValueError(f"loss_impl={cfg.loss_impl!r}: expected 'auto' or 'fused'")
+    from .common import fused_ce_allowed
+
+    want_fused = cfg.loss_impl == "fused" and fused_ce_allowed()
     labels = batch["labels"]
     start = jnp.full((labels.shape[0], 1), cfg.decoder_start_token_id, labels.dtype)
     if "dec_segment_ids" in batch:
@@ -386,17 +398,29 @@ def loss_fn(params: dict, batch: dict, cfg: T5Config, rng=None) -> jax.Array:
         enc_out = encode(
             params, batch["input_ids"], cfg, batch.get("attention_mask"), segment_ids=enc_seg
         )
-        logits = decode(
+        out = decode(
             params, dec_in, enc_out, cfg, batch.get("attention_mask"),
-            dec_segment_ids=dec_seg, enc_segment_ids=enc_seg,
+            dec_segment_ids=dec_seg, enc_segment_ids=enc_seg, return_hidden=want_fused,
         )
         mask = ((labels >= 0) & (dec_seg != 0)).astype(jnp.float32)
     else:
         dec_in = jnp.concatenate([start, jnp.maximum(labels[:, :-1], 0)], axis=1)
-        logits = forward(params, batch["input_ids"], dec_in, cfg, batch.get("attention_mask"))
+        enc_out = encode(params, batch["input_ids"], cfg, batch.get("attention_mask"))
+        out = decode(
+            params, dec_in, enc_out, cfg, batch.get("attention_mask"),
+            return_hidden=want_fused,
+        )
         mask = (labels >= 0).astype(jnp.float32)
     safe = jnp.maximum(labels, 0)
-    logp = jax.nn.log_softmax(logits, axis=-1)
+    if want_fused:
+        # want_fused == fused_ce_allowed(), so the helper cannot return None here
+        # (and `out` is hidden states, not logits — the dense tail must not run).
+        from .common import fused_ce_single_shard
+
+        return fused_ce_single_shard(
+            out, _t5_head(params, cfg).astype(cfg.dtype), safe, mask
+        )
+    logp = jax.nn.log_softmax(out, axis=-1)
     ll = jnp.take_along_axis(logp, safe[..., None], axis=-1).squeeze(-1)
     return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
 

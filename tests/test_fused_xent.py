@@ -212,6 +212,32 @@ def test_gpt_loss_fused_matches_auto():
     assert l_bias_fused == pytest.approx(l_bias_auto, rel=1e-6)
 
 
+def test_t5_loss_fused_matches_auto():
+    """t5 family: fused decoder CE (tied head incl. the d_model**-0.5 hidden scaling)
+    tracks the dense path for loss and grads, with -100 label masking."""
+    from accelerate_tpu.models import t5
+
+    base = dataclasses.replace(
+        t5.CONFIGS["tiny"], vocab_size=300, dtype=jnp.float32, remat=False
+    )
+    params = t5.init_params(base)
+    rng = np.random.default_rng(11)
+    labels = rng.integers(0, 300, (2, 12)).astype(np.int32)
+    labels[:, -3:] = -100  # ignored positions
+    batch = {
+        "input_ids": jnp.asarray(rng.integers(0, 300, (2, 15)), jnp.int32),
+        "labels": jnp.asarray(labels),
+    }
+    cfg_fused = dataclasses.replace(base, loss_impl="fused")
+    l_auto = float(t5.loss_fn(params, batch, base))
+    l_fused = float(t5.loss_fn(params, batch, cfg_fused))
+    assert l_fused == pytest.approx(l_auto, rel=1e-5)
+    g_auto = jax.grad(lambda p: t5.loss_fn(p, batch, base))(params)
+    g_fused = jax.grad(lambda p: t5.loss_fn(p, batch, cfg_fused))(params)
+    for a, b in zip(jax.tree_util.tree_leaves(g_auto), jax.tree_util.tree_leaves(g_fused)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-6)
+
+
 def test_llama_loss_fused_gemma_softcap():
     """final_softcap (Gemma-2) flows into the kernel."""
     from accelerate_tpu.models import llama
