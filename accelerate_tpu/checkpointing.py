@@ -123,7 +123,17 @@ def save_accelerator_state(
     project = accelerator.project_configuration
     automatic = output_dir is None and project.automatic_checkpoint_naming
     if automatic:
-        _rotate_checkpoints(accelerator, Path(project.project_dir) / "checkpoints" / "x")
+        # Rotation must be single-writer: every rank pruning concurrently races the
+        # directory listing against the other ranks' in-progress saves and over-deletes
+        # (observed: total_limit=2 leaving ONE checkpoint under 2 processes).
+        # Barrier BEFORE the prune: every rank has then entered save_state and joined its
+        # own async writer (wait_for_async_save above), so no straggler is still writing
+        # shards into a directory the main rank is about to rmtree. Barrier after keeps
+        # ranks from writing the new snapshot into a directory mid-prune.
+        accelerator.wait_for_everyone()
+        if accelerator.is_main_process:
+            _rotate_checkpoints(accelerator, Path(project.project_dir) / "checkpoints" / "x")
+        accelerator.wait_for_everyone()
     path = _checkpoint_dir(accelerator, output_dir, for_save=True)
     path.mkdir(parents=True, exist_ok=True)
 

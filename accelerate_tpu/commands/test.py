@@ -39,6 +39,10 @@ _SUITES = {
     "sync": "test_sync.py",
     "data": "test_distributed_data_loop.py",
     "perf": "test_performance.py",
+    "ops": "test_ops.py",
+    "merge": "test_merge_weights.py",
+    "checkpoint": "test_checkpointing.py",
+    "metrics": "test_metrics.py",
 }
 
 

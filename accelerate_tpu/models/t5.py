@@ -312,7 +312,9 @@ def decode(params: dict, decoder_input_ids: jax.Array, enc_out: jax.Array, cfg: 
            dec_segment_ids: Optional[jax.Array] = None,
            enc_segment_ids: Optional[jax.Array] = None,
            return_hidden: bool = False) -> jax.Array:
-    """Decoder: ids [B, T] + encoder hidden → logits [B, T, V] fp32.
+    """Decoder: ids [B, T] + encoder hidden → logits [B, T, V] fp32 (or the post-ln_f
+    [B, T, D] compute-dtype hidden states — tied-head scaling included — when
+    ``return_hidden``; the fused-CE path applies the head inside its kernel).
 
     Packed rows (``dec_segment_ids``/``enc_segment_ids``): self-attention restricts to
     per-segment causal; cross-attention lets decoder segment k attend ONLY encoder

@@ -1,0 +1,133 @@
+"""Bundled checkpointing self-test (reference
+``test_utils/scripts/external_deps/test_checkpointing.py``).
+
+The reference trains, checkpoints, resumes, and requires the resumed run to land on the
+same losses; plus automatic checkpoint naming/rotation. Same invariants against the mesh
+runtime: mid-training ``save_state`` → keep training → restore → retrain reaches
+IDENTICAL losses step for step, and ``ProjectConfiguration(total_limit)`` prunes old
+automatic checkpoints.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+
+from accelerate_tpu.test_utils.scripts.test_script import _ensure_backend
+
+_ensure_backend()
+
+import numpy as np  # noqa: E402
+
+
+def _reset():
+    # Resetting the singletons in a live multi-process child would tear down the
+    # distributed context mid-run; only reset when single-process.
+    import jax
+
+    try:
+        if jax.process_count() > 1:
+            return
+    except Exception:
+        pass
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+
+
+def _shared_tmpdir(acc):
+    """One directory ALL ranks agree on (orbax sharded saves need a common path)."""
+    from accelerate_tpu.utils import broadcast_object_list
+
+    local = tempfile.mkdtemp() if acc.is_main_process else None
+    return broadcast_object_list([local])[0]
+
+
+def _build(acc):
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu.test_utils.training import RegressionDataset
+
+    ds = RegressionDataset(length=64, seed=3)
+    xs = jnp.asarray(np.stack([e["x"] for e in ds])[:, None].astype(np.float32))
+    ys = jnp.asarray(np.stack([e["y"] for e in ds])[:, None].astype(np.float32))
+
+    def loss_fn(params, batch):
+        pred = batch["x"] * params["a"] + params["b"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    params = {"a": jnp.zeros(()), "b": jnp.zeros(())}
+    state = acc.create_train_state(params, optax.adam(5e-2))
+    step = acc.build_train_step(loss_fn)
+    batches = [
+        {"x": xs[i : i + 16], "y": ys[i : i + 16]} for i in range(0, 64, 16)
+    ]
+    return state, step, batches
+
+
+def test_resume_parity():
+    from accelerate_tpu import Accelerator
+
+    _reset()
+    acc = Accelerator()
+    d = _shared_tmpdir(acc)
+    state, step, batches = _build(acc)
+    for b in batches[:2]:
+        state, _ = step(state, b)
+    acc.save_state(f"{d}/mid", state)
+    tail_a = []
+    for b in batches[2:]:
+        state, m = step(state, b)
+        tail_a.append(float(m["loss"]))
+
+    restored = acc.load_state(f"{d}/mid", state)
+    assert int(restored.step) == 2, int(restored.step)
+    tail_b = []
+    for b in batches[2:]:
+        restored, m = step(restored, b)
+        tail_b.append(float(m["loss"]))
+    assert tail_a == tail_b, (tail_a, tail_b)
+    print("save -> train -> restore -> retrain loss parity: OK")
+
+
+def test_automatic_naming_and_rotation():
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.utils import ProjectConfiguration
+
+    _reset()
+    probe = Accelerator()
+    d = _shared_tmpdir(probe)
+    acc = Accelerator(
+        project_config=ProjectConfiguration(
+            project_dir=d, automatic_checkpoint_naming=True, total_limit=2
+        )
+    )
+    state, step, batches = _build(acc)
+    for b in batches[:3]:
+        state, _ = step(state, b)
+        acc.save_state(train_state=state)  # automatic checkpoint_<n> naming
+    ckpts = sorted(os.listdir(os.path.join(d, "checkpoints")))
+    assert len(ckpts) == 2, f"total_limit=2 must prune to 2, got {ckpts}"
+    assert ckpts[-1].endswith("2"), ckpts  # newest kept
+    print("automatic naming + rotation (total_limit): OK")
+
+
+def main():
+    import jax
+
+    print(
+        f"checkpointing self-test: backend={jax.default_backend()} "
+        f"devices={jax.device_count()} processes={jax.process_count()}"
+    )
+    test_resume_parity()
+    test_automatic_naming_and_rotation()
+    print("All checkpointing self-tests passed.")
+
+
+if __name__ == "__main__":
+    sys.argv = sys.argv[:1]
+    main()

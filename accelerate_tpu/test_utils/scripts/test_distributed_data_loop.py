@@ -92,8 +92,8 @@ def test_uneven_batches_and_join():
     from accelerate_tpu.utils import gather_object
 
     mine = _collect(dl)
-    all_ranks = gather_object(mine)
-    flat = [i for rank in all_ranks for batch in rank for i in batch]
+    all_batches = gather_object(mine)  # flattened: every rank's batches concatenated
+    flat = [i for batch in all_batches for i in batch]
     assert sorted(flat) == list(range(total)), (
         f"even_batches=False must deliver each sample exactly once: {sorted(flat)}"
     )
@@ -168,8 +168,8 @@ def test_shard_vs_dispatch_same_samples():
     dispatch = prepare_data_loader(
         DataLoader(_IdxDataset(total), batch_size=3), put_on_device=False, dispatch_batches=True
     )
-    seen_shard = sorted(set(i for rank in gather_object(sum(_collect(shard), [])) for i in rank))
-    seen_dispatch = sorted(set(i for rank in gather_object(sum(_collect(dispatch), [])) for i in rank))
+    seen_shard = sorted(set(gather_object(sum(_collect(shard), []))))
+    seen_dispatch = sorted(set(gather_object(sum(_collect(dispatch), []))))
     assert seen_shard == seen_dispatch == list(range(total)), "shard/dispatch sample sets differ"
     print("shard == dispatch sample coverage: OK")
 

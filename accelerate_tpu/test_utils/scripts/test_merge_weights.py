@@ -1,0 +1,74 @@
+"""Bundled merge-weights self-test (reference ``test_utils/scripts/test_merge_weights.py``).
+
+The reference trains an FSDP model, saves a SHARDED_STATE_DICT checkpoint, merges it with
+``merge_fsdp_weights`` and checks the consolidated weights. Same flow here: an
+fsdp-sharded TrainState saves through the checkpoint engine, ``merge_weights`` (the
+``accelerate-tpu merge-weights`` CLI core) consolidates to safetensors, and the result
+must equal the live params exactly.
+"""
+
+from __future__ import annotations
+
+import sys
+import tempfile
+
+from accelerate_tpu.test_utils.scripts.test_script import _ensure_backend
+
+_ensure_backend()
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.commands.merge import merge_weights
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+    from accelerate_tpu.utils import FullyShardedDataParallelPlugin
+    from accelerate_tpu.utils.serialization import load_flat_safetensors
+
+    print(
+        f"merge-weights self-test: backend={jax.default_backend()} "
+        f"devices={jax.device_count()} processes={jax.process_count()}"
+    )
+    if jax.process_count() == 1:
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+    acc = Accelerator(
+        fsdp_plugin=FullyShardedDataParallelPlugin(zero_stage=3, min_weight_size=0)
+    )
+    params = {
+        "w": jnp.arange(64 * 16, dtype=jnp.float32).reshape(64, 16) / 100.0,
+        "b": jnp.arange(16, dtype=jnp.float32),
+    }
+    state = acc.create_train_state(params, optax.adam(1e-3))
+    if acc.mesh.size > 1:
+        assert not state.params["w"].sharding.is_fully_replicated, "fsdp must shard w"
+
+    from accelerate_tpu.utils import broadcast_object_list
+
+    d = broadcast_object_list([tempfile.mkdtemp() if acc.is_main_process else None])[0]
+    acc.save_state(f"{d}/ckpt", state)
+    acc.wait_for_everyone()
+    manifest = merge_weights(f"{d}/ckpt", f"{d}/merged")
+    assert manifest, "merge produced no files"
+    import glob
+
+    merged: dict = {}
+    for f in glob.glob(f"{d}/merged/*.safetensors"):
+        merged.update(load_flat_safetensors(f))
+    for key in ("w", "b"):
+        np.testing.assert_array_equal(
+            np.asarray(merged[key]), np.asarray(state.params[key])
+        )
+    print("sharded checkpoint -> merge-weights -> consolidated parity: OK")
+    print("All merge-weights self-tests passed.")
+
+
+if __name__ == "__main__":
+    sys.argv = sys.argv[:1]
+    main()

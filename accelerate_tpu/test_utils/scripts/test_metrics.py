@@ -1,0 +1,96 @@
+"""Bundled metrics self-test (reference
+``test_utils/scripts/external_deps/test_metrics.py``).
+
+The reference computes a metric distributed (gather_for_metrics over an uneven eval set)
+and requires it to equal the serial computation — the duplicate tail samples the
+even_batches padding introduces must be trimmed, for tensors AND for object payloads.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from accelerate_tpu.test_utils.scripts.test_script import _ensure_backend
+
+_ensure_backend()
+
+import numpy as np  # noqa: E402
+
+
+class _Dataset:
+    """Length deliberately NOT divisible by (batch × world): forces tail duplicates."""
+
+    def __init__(self, n):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return {"x": np.float32(i), "label": np.int32(i % 3)}
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.data_loader import DataLoader
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    print(
+        f"metrics self-test: backend={jax.default_backend()} devices={jax.device_count()} "
+        f"processes={jax.process_count()}"
+    )
+    if jax.process_count() == 1:
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+    acc = Accelerator()
+    n_samples = 22  # not divisible by batch 4 (nor 4 × world)
+    dl = acc.prepare_data_loader(DataLoader(_Dataset(n_samples), batch_size=4))
+
+    # "Model": prediction = x is even → metric = accuracy of (pred == label parity)
+    gathered_preds, gathered_labels = [], []
+    for batch in dl:
+        preds = jnp.asarray(batch["x"]) * 2.0  # arbitrary deterministic fn
+        p, l = acc.gather_for_metrics((preds, jnp.asarray(batch["label"])))
+        gathered_preds.extend(np.asarray(p).reshape(-1).tolist())
+        gathered_labels.extend(np.asarray(l).reshape(-1).tolist())
+
+    assert len(gathered_preds) == n_samples, (
+        f"gather_for_metrics must trim tail duplicates: {len(gathered_preds)} != {n_samples}"
+    )
+    serial = [float(i) * 2.0 for i in range(n_samples)]
+    assert sorted(gathered_preds) == serial, "distributed metric inputs != serial"
+    assert sorted(set(int(x) for x in gathered_labels)) == [0, 1, 2]
+    print("tensor gather_for_metrics trim parity: OK")
+
+    # Object payloads take the gather_object path (use_gather_object).
+    if jax.process_count() == 1:
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+    acc = Accelerator()
+    dl = acc.prepare_data_loader(DataLoader(_Dataset(n_samples), batch_size=4))
+    def _local_rows(arr):
+        """This process's rows of a dim-0-sharded global array (dedup replicas)."""
+        uniq = {}
+        for s in arr.addressable_shards:
+            start = s.index[0].start or 0
+            uniq[start] = np.asarray(s.data)
+        return np.concatenate([uniq[k] for k in sorted(uniq)], axis=0)
+
+    texts = []
+    for batch in dl:
+        local = [f"sample-{int(i)}" for i in _local_rows(batch["x"]).reshape(-1)]
+        texts.extend(acc.gather_for_metrics(local, use_gather_object=True))
+    assert len(texts) == n_samples, (len(texts), n_samples)
+    assert sorted(texts) == sorted(f"sample-{i}" for i in range(n_samples)), texts[:5]
+    print("object gather_for_metrics trim parity: OK")
+    print("All metrics self-tests passed.")
+
+
+if __name__ == "__main__":
+    sys.argv = sys.argv[:1]
+    main()

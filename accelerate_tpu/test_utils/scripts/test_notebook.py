@@ -73,5 +73,23 @@ def run_sync_and_data_loop_self_tests():
     test_performance.main()
 
 
+def run_ops_and_metrics_self_tests():
+    """Child body: the bundled ops/metrics/checkpointing suites under process_count()>1 —
+    real cross-process gather/reduce/broadcast/gather_object, duplicate-trimmed metrics,
+    and a multi-process checkpoint resume (reference test_ops.py / external_deps
+    test_metrics.py / test_checkpointing.py)."""
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from accelerate_tpu import PartialState
+    from accelerate_tpu.test_utils.scripts import test_checkpointing, test_metrics, test_ops
+
+    PartialState()
+    assert jax.process_count() > 1, "multi-process tier ran single-process"
+    test_ops.main()
+    test_metrics.main()
+    test_checkpointing.main()
+
+
 if __name__ == "__main__":
     basic_function()

@@ -71,7 +71,7 @@ def test_rng_sync(acc):
     # (reference test_script.py:174 rng_sync_check).
     set_seed(1000 + acc.process_index)
     synchronize_rng_states(["numpy", "python"])
-    draws = gather_object(np.random.random(4).tolist())
+    draws = gather_object([np.random.random(4).tolist()])  # list-in, flattened-out
     assert all(d == draws[0] for d in draws), f"numpy RNG desynced after sync: {draws}"
     print("rng sync: OK")
 
@@ -113,7 +113,7 @@ def test_ops(acc):
     p = pad_across_processes(jnp.ones((2, 3 + acc.process_index)), dim=1)
     assert p.shape[1] == 3 + (n - 1), "pad_across_processes wrong target length"
     # Object (pickle) collectives over the distributed KV store / allgather transport.
-    objs = gather_object({"rank": acc.process_index, "payload": [acc.process_index] * 2})
+    objs = gather_object([{"rank": acc.process_index, "payload": [acc.process_index] * 2}])
     assert [o["rank"] for o in objs] == list(range(n)), objs
     blist = broadcast_object_list(
         ["from-rank-0", acc.process_index] if acc.is_main_process else [None, None]
@@ -143,13 +143,13 @@ def test_dataloader_sharding(acc):
         seen.extend(np.asarray(batch["idx"]).reshape(-1).tolist())
     # Every sample must be seen across the union of ranks (each rank may also carry
     # even_batches padding duplicates at the tail).
-    union = sorted(set(i for rank in gather_object(seen) for i in rank))
+    union = sorted(set(gather_object(seen)))  # flattened across ranks
     assert union == list(range(30)), f"shard mode lost samples: {union[:10]}"
     dispatched = prepare_data_loader(dl, device=acc.device, dispatch_batches=True, put_on_device=False)
     seen_d = []
     for batch in dispatched:
         seen_d.extend(np.asarray(batch["idx"]).reshape(-1).tolist())
-    union_d = sorted(set(i for rank in gather_object(seen_d) for i in rank))
+    union_d = sorted(set(gather_object(seen_d)))
     assert union_d == list(range(30)), "dispatch mode lost samples"
     print("dataloader shard + dispatch: OK")
 

@@ -294,11 +294,19 @@ def gather(tensor):
 
 
 def gather_object(object: Any):
-    """Pickle-level all-gather of arbitrary objects (reference ``operations.py:474``)."""
+    """Pickle-level all-gather of arbitrary objects (reference ``operations.py:445``).
+
+    Reference contract: each process passes a LIST of objects; the result is the
+    concatenation of every process's list (``all_gather_object`` then flatten,
+    reference ``:438-442``). Single process returns the object unchanged (the
+    reference's non-distributed path). ``gather_for_metrics`` relies on this
+    flattening to trim duplicate tail SAMPLES, not per-rank payloads.
+    """
     if _process_count() == 1:
-        return [object]
+        return object
     payloads = _allgather_bytes(pickle.dumps(object))
-    return [pickle.loads(p) for p in payloads]
+    per_rank = [pickle.loads(p) for p in payloads]
+    return [x for y in per_rank for x in y]
 
 
 def reduce(tensor, reduction: str = "mean", scale: float = 1.0):
@@ -546,7 +554,9 @@ class _VerifyOperation:
         if not state.get("debug", False) or _process_count() == 1:
             return self
         shapes = get_shape(self.tensor)
-        all_shapes = gather_object(shapes)
+        # gather_object follows the reference list-in/flattened-out contract, so wrap:
+        # one structure per rank comes back as a list of per-rank structures.
+        all_shapes = gather_object([shapes])
         if not all(s == all_shapes[0] for s in all_shapes):
             raise DistributedOperationException(
                 f"Mismatch in operands for `{self.operation}` across processes: "

@@ -15,6 +15,7 @@ import pytest
 from accelerate_tpu import notebook_launcher
 from accelerate_tpu.test_utils.scripts.test_notebook import (
     run_full_self_test,
+    run_ops_and_metrics_self_tests,
     run_sync_and_data_loop_self_tests,
 )
 from accelerate_tpu.test_utils.testing import slow
@@ -35,4 +36,15 @@ def test_sync_and_data_loop_two_processes():
     with patch_environment(ACCELERATE_USE_CPU="true", JAX_PLATFORMS="cpu"):
         notebook_launcher(
             run_sync_and_data_loop_self_tests, num_processes=2, devices_per_process=4
+        )
+
+
+@slow
+def test_ops_metrics_checkpointing_two_processes():
+    """The shipped ops/metrics/checkpointing suites over real 2-process transport —
+    cross-process gather_object flattening, gather_for_metrics duplicate trimming, and
+    checkpoint resume parity all exercised with process_count() == 2."""
+    with patch_environment(ACCELERATE_USE_CPU="true", JAX_PLATFORMS="cpu"):
+        notebook_launcher(
+            run_ops_and_metrics_self_tests, num_processes=2, devices_per_process=4
         )

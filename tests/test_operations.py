@@ -68,7 +68,9 @@ def test_gather_numpy_single_process():
 
 
 def test_gather_object_single():
-    assert ops.gather_object({"k": 1}) == [{"k": 1}]
+    # Reference contract (operations.py:445): single process returns the object
+    # unchanged; multi-process concatenates each rank's LIST of objects.
+    assert ops.gather_object([{"k": 1}]) == [{"k": 1}]
 
 
 def test_reduce_sharded(mesh8):
