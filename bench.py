@@ -278,6 +278,20 @@ def run(B: int, S: int, fuse: int, preset: str | None):
     _ = float(np.asarray(metrics["loss"])[-1])
 
     n_rounds = 3
+    profile_dir = os.environ.get("BENCH_PROFILE")
+    if profile_dir:
+        # One traced round for attribution (the xplane shows where the step time goes —
+        # e.g. whether the adamw apply is compute, HBM stalls, or allocator churn).
+        # Traced separately from the timed rounds so profiling overhead never pollutes
+        # the reported MFU; a profiler failure must not sink the measurement either.
+        try:
+            with jax.profiler.trace(profile_dir):
+                state, metrics = step(state, stacked)
+                _ = float(np.asarray(metrics["loss"])[-1])
+            print(f"bench: profiler trace written to {profile_dir}", file=sys.stderr)
+        except Exception as e:  # noqa: BLE001 — attribution is optional, the metric is not
+            print(f"bench: profiler trace failed ({type(e).__name__}: "
+                  f"{str(e).splitlines()[0][:160]}); continuing untraced", file=sys.stderr)
     t0 = time.perf_counter()
     for _ in range(n_rounds):
         state, metrics = step(state, stacked)
