@@ -14,6 +14,15 @@ automatically** (activations rematerialized per ``jax.checkpoint`` policy), so t
 machinery trains — the torch version needs a separate runtime for that.
 
 Bubble fraction is the GPipe (n-1)/(M+n-1); raise ``num_microbatches`` to amortize.
+
+Why no interleaved "virtual pipeline" (Megatron ``dataclasses.py:2024``) variant: its bubble
+reduction comes from 1F1B-interleaving forward and backward chunk work, which requires a
+hand-scheduled backward pipeline. Here the backward IS derived by jax AD from the forward
+scan — all forwards complete before backwards begin (GPipe semantics) — so holding v
+stage-chunks per device would add wraparound ppermutes without shrinking the bubble.
+The honest levers in this formulation are ``num_microbatches`` and remat policy; a manual
+1F1B would mean a custom VJP with its own reverse schedule (see
+``PipelineParallelPlugin.schedule`` which raises on "1f1b" for exactly this reason).
 """
 
 from __future__ import annotations

@@ -283,15 +283,26 @@ def run(B: int, S: int, fuse: int, preset: str | None):
         # One traced round for attribution (the xplane shows where the step time goes —
         # e.g. whether the adamw apply is compute, HBM stalls, or allocator churn).
         # Traced separately from the timed rounds so profiling overhead never pollutes
-        # the reported MFU; a profiler failure must not sink the measurement either.
+        # the reported MFU. Only PROFILER failures are swallowed: a failure of the step
+        # itself must propagate (its input state was donated — the timed loop could not
+        # run on deleted buffers), letting run()'s restart logic handle it.
         try:
-            with jax.profiler.trace(profile_dir):
+            jax.profiler.start_trace(profile_dir)
+            tracing = True
+        except Exception as e:  # noqa: BLE001 — attribution is optional, the metric is not
+            tracing = False
+            print(f"bench: profiler start failed ({type(e).__name__}: "
+                  f"{str(e).splitlines()[0][:160]}); continuing untraced", file=sys.stderr)
+        if tracing:
+            try:
                 state, metrics = step(state, stacked)
                 _ = float(np.asarray(metrics["loss"])[-1])
-            print(f"bench: profiler trace written to {profile_dir}", file=sys.stderr)
-        except Exception as e:  # noqa: BLE001 — attribution is optional, the metric is not
-            print(f"bench: profiler trace failed ({type(e).__name__}: "
-                  f"{str(e).splitlines()[0][:160]}); continuing untraced", file=sys.stderr)
+            finally:
+                try:
+                    jax.profiler.stop_trace()
+                    print(f"bench: profiler trace written to {profile_dir}", file=sys.stderr)
+                except Exception as e:  # noqa: BLE001
+                    print(f"bench: profiler stop failed ({type(e).__name__})", file=sys.stderr)
     t0 = time.perf_counter()
     for _ in range(n_rounds):
         state, metrics = step(state, stacked)

@@ -14,7 +14,10 @@ Times each piece of the bench workload in isolation so the MFU gap can be attrib
   fwd_bwd_remat   — loss value_and_grad, remat full
   fwd_bwd_dots    — loss value_and_grad, remat dots policy
   opt_adamw       — adamw update + global-norm clip alone (effective GB/s)
+  opt_fused_adamw — the Pallas fused kernel, identical grads + clip work
   opt_adamw_scan4 — 4 chained applies under lax.scan (the fused-path memory pattern)
+  xent_chunked    — loss head fwd+bwd, chunked CE (models/llama._chunked_ce)
+  xent_fused      — loss head fwd+bwd, fused Pallas CE (ops/fused_xent)
 
 Each row prints achieved TFLOP/s against its own analytic FLOP count, so the slow
 component is directly visible.  Run on the real chip: `python benchmarks/decompose.py`.
@@ -112,12 +115,8 @@ def main() -> int:
         _materialize(p)
         return (time.perf_counter() - t0) / n
 
-    params32 = jax.tree_util.tree_map(
-        lambda p: p.astype(jnp.float32), llama.init_params(cfg)
-    )
-    p_bytes = sum(x.size * 4 for x in jax.tree_util.tree_leaves(params32))
+    p_bytes = n_params * 4  # fp32 master params; moments match leaf-for-leaf
     tx = optax.adamw(1e-4)
-    opt_state = tx.init(params32)
 
     def one_opt(p, s):
         # Clip formula matches Accelerator.build_train_step's apply_step exactly
@@ -129,16 +128,36 @@ def main() -> int:
         u, s = tx.update(grads, s, p)
         return optax.apply_updates(p, u), s
 
-    try:
-        opt_jit = jax.jit(one_opt, donate_argnums=(0, 1))
-        dt = timed_state2(opt_jit, params32, opt_state)
-        # adamw traffic ≈ read p,m,v,g + write p,m,v (7 × p_bytes with fp32 moments)
-        print(f"opt_adamw          {dt*1e3:9.2f} ms   {7*p_bytes/dt/1e9:8.1f} GB/s eff",
-              flush=True)
-        rows.append({"name": "opt_adamw", "ms": round(dt * 1e3, 2),
-                     "gbps": round(7 * p_bytes / dt / 1e9, 1)})
-    except Exception as e:
-        print(f"opt_adamw: {type(e).__name__}: {str(e).splitlines()[0][:120]}")
+    def report_opt(name, apply_fn, init_state):
+        """Time one donated apply; adamw traffic ≈ read p,m,v,g + write p,m,v = 7·p_bytes."""
+        try:
+            fresh = jax.tree_util.tree_map(
+                lambda p: p.astype(jnp.float32), llama.init_params(cfg)
+            )
+            jitted = jax.jit(apply_fn, donate_argnums=(0, 1))
+            dt = timed_state2(jitted, fresh, init_state(fresh))
+            print(f"{name:18s} {dt*1e3:9.2f} ms   {7*p_bytes/dt/1e9:8.1f} GB/s eff",
+                  flush=True)
+            rows.append({"name": name, "ms": round(dt * 1e3, 2),
+                         "gbps": round(7 * p_bytes / dt / 1e9, 1)})
+        except Exception as e:
+            print(f"{name}: {type(e).__name__}: {str(e).splitlines()[0][:120]}")
+
+    report_opt("opt_adamw", one_opt, tx.init)
+
+    # Fused Pallas kernel, like-for-like: same synthetic grads, same global-norm clip
+    # work (the real build_train_step also computes gnorm, then folds it as a scalar).
+    from accelerate_tpu.ops.fused_optim import fused_adamw
+
+    fa = fused_adamw(1e-4)
+
+    def one_fused(p, s):
+        grads = jax.tree_util.tree_map(lambda x: jnp.full_like(x, 1e-3), p)
+        gnorm = optax.global_norm(grads)
+        scale = jnp.minimum(1.0, 1.0 / (gnorm + 1e-6))
+        return fa.fused_apply(grads, s, p, grad_scale=scale)
+
+    report_opt("opt_fused_adamw", one_fused, fa.init)
 
     try:
         def scan4(p, s):
@@ -210,6 +229,40 @@ def main() -> int:
             report(f"fwd_bwd_{name}", dt, fwd_flops * 3)
         except Exception as e:  # OOM for noremat at large B
             print(f"fwd_bwd_{name}: {type(e).__name__}: {str(e).splitlines()[0][:120]}")
+
+    # --- loss head in isolation: chunked CE vs the fused Pallas kernel, fwd+bwd at bench
+    # shapes (hidden [B*S, D] @ head [D, V] + softmax-CE; flops = 3 x 2 x T x D x V).
+    try:
+        from accelerate_tpu.ops.fused_xent import fused_cross_entropy
+
+        Tn = B * S
+        hid = jnp.ones((Tn, cfg.d_model), jnp.bfloat16) * 0.01
+        headw = jnp.ones((cfg.d_model, cfg.vocab_size), jnp.bfloat16) * 0.01
+        tgt = jnp.asarray(
+            np.random.default_rng(1).integers(0, cfg.vocab_size, (Tn,)), jnp.int32
+        )
+        ce_flops = 3 * 2 * Tn * cfg.d_model * cfg.vocab_size
+
+        def chunked_ce(h, w):
+            from accelerate_tpu.models.llama import _chunked_ce
+
+            h3 = h.reshape(B, S, cfg.d_model)
+            return _chunked_ce(
+                h3, w, tgt.reshape(B, S), jnp.ones((B, S), jnp.float32), 512, jnp.bfloat16
+            )
+
+        g = jax.jit(jax.grad(chunked_ce, argnums=(0, 1)))
+        dt = timed(g, hid, headw)
+        report("xent_chunked", dt, ce_flops)
+
+        def fused_ce(h, w):
+            return fused_cross_entropy(h, w, tgt).sum()
+
+        g = jax.jit(jax.grad(fused_ce, argnums=(0, 1)))
+        dt = timed(g, hid, headw)
+        report("xent_fused", dt, ce_flops)
+    except Exception as e:
+        print(f"xent rows: {type(e).__name__}: {str(e).splitlines()[0][:120]}")
 
     print(json.dumps({"rows": rows, "config": {"B": B, "S": S, "n_params": n_params}}))
     return 0
