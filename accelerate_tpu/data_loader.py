@@ -57,6 +57,7 @@ __all__ = [
     "prepare_data_loader",
     "skip_first_batches",
     "default_collate",
+    "assemble_global_batch",
 ]
 
 
@@ -436,6 +437,14 @@ def _make_global_batch(batch, device):
             return jax.device_put(t, NamedSharding(sharding.mesh, PartitionSpec()))
 
     return recursively_apply(_put, batch)
+
+
+def assemble_global_batch(batch, device):
+    """Public alias of the per-host -> global-array assembly used by the prepared
+    dataloaders: single-host sharded ``device_put``; multi-host
+    ``make_array_from_process_local_data`` (each host contributes its local rows).
+    For custom data paths (e.g. ``lm_dataset.TokenDataset.iter_batches``)."""
+    return _make_global_batch(batch, device)
 
 
 class DataLoaderShard(_PreparedDataLoader):
