@@ -183,7 +183,7 @@ def get_tpu_info() -> dict:
             except Exception:
                 pass
     except Exception as e:  # pragma: no cover - no backend in exotic environments
-        info["backend_error"] = str(e).splitlines()[0][:200]
+        info["backend_error"] = (str(e).splitlines() or [type(e).__name__])[0][:200]
 
     tpu_env = {
         k: v

@@ -52,6 +52,11 @@ PEAK_TFLOPS = {
 _TRANSIENT = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "Unable to initialize backend", "Connection reset")
 
 
+def exc_line(e: BaseException, width: int = 160) -> str:
+    """First line of an exception message, safe for empty messages (bare MemoryError)."""
+    return (str(e).splitlines() or [type(e).__name__])[0][:width]
+
+
 def _is_transient(exc: BaseException) -> bool:
     return any(s in f"{type(exc).__name__}: {exc}" for s in _TRANSIENT)
 
@@ -115,7 +120,7 @@ def _init_backend(attempts: int = 4, base_delay: float = 3.0, init_timeout: floa
                 raise
             delay = base_delay * (2**i)
             print(f"bench: backend init failed (attempt {i + 1}/{attempts}): "
-                  f"{str(e).splitlines()[0][:200]}; retrying in {delay:.0f}s", file=sys.stderr)
+                  f"{exc_line(e, 200)}; retrying in {delay:.0f}s", file=sys.stderr)
             time.sleep(delay)
             try:
                 jax.clear_backends()
@@ -150,7 +155,7 @@ def _fail_json(metric: str, stage: str, exc: BaseException) -> None:
         "value": None,
         "unit": "MFU",
         "vs_baseline": None,
-        "error": f"{stage}: {type(exc).__name__}: {str(exc).splitlines()[0][:300]}",
+        "error": f"{stage}: {type(exc).__name__}: {exc_line(exc, 300)}",
     }
     # The remote-TPU tunnel in this environment goes down for hours at a time (it took out
     # round 1's bench the same way). Attach the last successful self-recorded run so a
@@ -266,7 +271,11 @@ def run(B: int, S: int, fuse: int, preset: str | None):
     # makes the scan-backward gradient carries bf16 too — net ~1.5 GB cheaper at 0.9B params
     # than fp32 grad carries (measured: 15.9G vs 17.3G peak).
     step = acc.build_train_step(
-        lambda p, b: llama.loss_fn(p, b, cfg), max_grad_norm=1.0, fused_steps=fuse
+        lambda p, b: llama.loss_fn(p, b, cfg), max_grad_norm=1.0, fused_steps=fuse,
+        # cast_params=False skips the whole-tree bf16 pre-cast (the model casts each
+        # weight at point of use): ~1.8 GB less standing HBM, at the cost of fp32 scan
+        # grad carries. Sweepable — near the 16 GB ceiling the tradeoff may flip.
+        cast_params=os.environ.get("BENCH_CAST_PARAMS", "1") == "1",
     )
 
     rng = np.random.default_rng(0)
@@ -292,7 +301,7 @@ def run(B: int, S: int, fuse: int, preset: str | None):
         except Exception as e:  # noqa: BLE001 — attribution is optional, the metric is not
             tracing = False
             print(f"bench: profiler start failed ({type(e).__name__}: "
-                  f"{str(e).splitlines()[0][:160]}); continuing untraced", file=sys.stderr)
+                  f"{exc_line(e, 160)}); continuing untraced", file=sys.stderr)
         if tracing:
             try:
                 state, metrics = step(state, stacked)
@@ -399,7 +408,7 @@ def _metric_label(B: int, S: int, fuse: int, preset: str | None, cfg=None) -> st
 _TUNING_KNOBS = {
     "ACCEL_FLASH_BLOCK_Q", "ACCEL_FLASH_BLOCK_K", "ACCEL_FLASH_DIMSEM", "BENCH_ATTN",
     "BENCH_REMAT_POLICY", "BENCH_SCAN_UNROLL", "BENCH_PREVENT_CSE", "BENCH_LOSS_CHUNK",
-    "BENCH_LOSS_IMPL", "XLA_FLAGS",
+    "BENCH_LOSS_IMPL", "BENCH_CAST_PARAMS", "XLA_FLAGS",
 }
 
 
@@ -510,7 +519,7 @@ def main():
                 transient_left -= 1
                 print(f"bench: transient failure, restarting run "
                       f"({transient_left} restarts left): "
-                      f"{str(e).splitlines()[0][:200]}", file=sys.stderr)
+                      f"{exc_line(e, 200)}", file=sys.stderr)
                 time.sleep(10)
                 continue
             _fail_json(metric, "bench run", e)

@@ -44,3 +44,8 @@ def timed(fn, *args, n=3, warmup=1):
         out = fn(*args)
     materialize(out)
     return (time.perf_counter() - t0) / n
+
+
+def exc_line(e: BaseException, width: int = 160) -> str:
+    """First line of an exception message, safe for empty messages (bare MemoryError)."""
+    return (str(e).splitlines() or [type(e).__name__])[0][:width]

@@ -40,6 +40,7 @@ sys.path.insert(0, REPO)
 
 from bench_timing import materialize as _materialize  # noqa: E402  (tunnel-safe fence)
 from bench_timing import timed  # noqa: E402
+from bench_timing import exc_line  # noqa: E402
 
 
 def main() -> int:
@@ -141,7 +142,7 @@ def main() -> int:
             rows.append({"name": name, "ms": round(dt * 1e3, 2),
                          "gbps": round(7 * p_bytes / dt / 1e9, 1)})
         except Exception as e:
-            print(f"{name}: {type(e).__name__}: {str(e).splitlines()[0][:120]}")
+            print(f"{name}: {type(e).__name__}: {exc_line(e, 120)}")
 
     report_opt("opt_adamw", one_opt, tx.init)
 
@@ -160,7 +161,7 @@ def main() -> int:
 
         report_opt("opt_fused_adamw", one_fused, fa.init)
     except Exception as e:  # per-row failure scoping, like every other section
-        print(f"opt_fused_adamw: {type(e).__name__}: {str(e).splitlines()[0][:120]}")
+        print(f"opt_fused_adamw: {type(e).__name__}: {exc_line(e, 120)}")
 
     try:
         def scan4(p, s):
@@ -181,7 +182,7 @@ def main() -> int:
               flush=True)
         rows.append({"name": "opt_adamw_scan4", "ms_per_step": round(dt / 4 * 1e3, 2)})
     except Exception as e:
-        print(f"opt_adamw_scan4: {type(e).__name__}: {str(e).splitlines()[0][:120]}")
+        print(f"opt_adamw_scan4: {type(e).__name__}: {exc_line(e, 120)}")
     params32 = opt_state = None  # release before the activation-heavy sections
 
     # --- attention at bench shapes (per layer): q [B,S,H,hd]
@@ -231,7 +232,7 @@ def main() -> int:
             dt = timed(g, params, {"tokens": tokens})
             report(f"fwd_bwd_{name}", dt, fwd_flops * 3)
         except Exception as e:  # OOM for noremat at large B
-            print(f"fwd_bwd_{name}: {type(e).__name__}: {str(e).splitlines()[0][:120]}")
+            print(f"fwd_bwd_{name}: {type(e).__name__}: {exc_line(e, 120)}")
 
     # --- loss head in isolation: chunked CE vs the fused Pallas kernel, fwd+bwd at bench
     # shapes (hidden [B*S, D] @ head [D, V] + softmax-CE; flops = 3 x 2 x T x D x V).
@@ -265,7 +266,7 @@ def main() -> int:
         dt = timed(g, hid, headw)
         report("xent_fused", dt, ce_flops)
     except Exception as e:
-        print(f"xent rows: {type(e).__name__}: {str(e).splitlines()[0][:120]}")
+        print(f"xent rows: {type(e).__name__}: {exc_line(e, 120)}")
 
     print(json.dumps({"rows": rows, "config": {"B": B, "S": S, "n_params": n_params}}))
     return 0

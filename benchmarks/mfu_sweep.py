@@ -76,6 +76,8 @@ CONFIGS = [
     ("blocks512_loss_fused", {"ACCEL_FLASH_BLOCK_Q": "512", "ACCEL_FLASH_BLOCK_K": "512",
                               "BENCH_LOSS_IMPL": "fused"}),
     ("dimsem", {"ACCEL_FLASH_DIMSEM": "1"}),
+    ("cast_off", {"BENCH_CAST_PARAMS": "0"}),
+    ("cast_off_loss_fused", {"BENCH_CAST_PARAMS": "0", "BENCH_LOSS_IMPL": "fused"}),
     ("blocks512_dimsem", {"ACCEL_FLASH_BLOCK_Q": "512", "ACCEL_FLASH_BLOCK_K": "512",
                           "ACCEL_FLASH_DIMSEM": "1"}),
     ("blocks512_fused_adamw", {"ACCEL_FLASH_BLOCK_Q": "512", "ACCEL_FLASH_BLOCK_K": "512",

@@ -23,7 +23,7 @@ python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeo
 
 echo "=== 3. combo rows ==="
 python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
-  --only loss_fused,blocks512_loss_fused,blocks512_lc1024,blocks512_dimsem,blocks512_mu_bf16,fuse16,blocks512_fuse16,blocks512_b8,dimsem
+  --only loss_fused,blocks512_loss_fused,cast_off,cast_off_loss_fused,blocks512_lc1024,blocks512_dimsem,blocks512_mu_bf16,fuse16,blocks512_fuse16,blocks512_b8,dimsem
 
 echo "=== 4. adopt best + final scoring run ==="
 timeout 900 python bench.py
