@@ -24,11 +24,13 @@ def test_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("--on-device", "--on_device", action="store_true",
                         help="Run on the real backend instead of the 8-device CPU simulator.")
     parser.add_argument("--suite", default="script",
-                        choices=["script", "sync", "data", "perf", "all"],
+                        choices=[*_SUITES, "all"],  # single source of truth: _SUITES
                         help="Which bundled self-test to run: 'script' (state/ops/dataloader/"
                              "training parity), 'sync' (gradient accumulation semantics), "
                              "'data' (distributed data loop), 'perf' (metric parity across "
-                             "parallelism layouts + steps/s), or 'all'.")
+                             "parallelism layouts + steps/s), 'ops' (collectives), 'metrics' "
+                             "(gather_for_metrics trim parity), 'checkpoint' (resume + "
+                             "rotation), 'merge' (sharded→consolidated weights), or 'all'.")
     if subparsers is not None:
         parser.set_defaults(func=test_command)
     return parser

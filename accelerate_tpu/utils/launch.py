@@ -47,6 +47,17 @@ def mesh_env_from_args(args: Any) -> dict[str, str]:
 
 def _common_env(args: Any) -> dict[str, str]:
     env: dict[str, str] = {}
+    # Dev-checkout robustness: children are plain `python script.py` subprocesses whose
+    # sys.path[0] is the script's own directory — when accelerate_tpu is imported from a
+    # source tree (not pip-installed), the package root must ride PYTHONPATH or every
+    # launched script dies on `import accelerate_tpu` (axon-style sitecustomize paths in
+    # the existing PYTHONPATH are preserved).
+    import accelerate_tpu
+
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(accelerate_tpu.__file__)))
+    existing = os.environ.get("PYTHONPATH", "")
+    if pkg_root not in existing.split(os.pathsep):
+        env["PYTHONPATH"] = f"{pkg_root}{os.pathsep}{existing}" if existing else pkg_root
     if getattr(args, "mixed_precision", None):
         env[f"{ENV_PREFIX}MIXED_PRECISION"] = str(args.mixed_precision).lower()
     if getattr(args, "cpu", False) or getattr(args, "use_cpu", False):

@@ -26,11 +26,15 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os as _os
 import sys
 import time
 import traceback
 
 import numpy as np
+
+sys.path.insert(0, _os.path.join(_os.path.dirname(_os.path.abspath(__file__)), "benchmarks"))
+from bench_timing import exc_line  # noqa: E402  (single source of truth)
 
 NORTH_STAR_MFU = 0.40  # BASELINE.md: Llama-3-8B FSDP fine-tune target on v5e
 
@@ -50,11 +54,6 @@ PEAK_TFLOPS = {
 }
 
 _TRANSIENT = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "Unable to initialize backend", "Connection reset")
-
-
-def exc_line(e: BaseException, width: int = 160) -> str:
-    """First line of an exception message, safe for empty messages (bare MemoryError)."""
-    return (str(e).splitlines() or [type(e).__name__])[0][:width]
 
 
 def _is_transient(exc: BaseException) -> bool:
