@@ -1,11 +1,11 @@
 #!/bin/bash
-# Second TPU work session (round 2): optimizer-apply attribution + second-wave sweep.
-# Context: fwd_bwd alone reaches ~112 model-TFLOP/s on the chip but the full adamw step
-# only ~38 — ~790 ms/step is outside the model math. Value order:
-#   1. decompose (now times opt_adamw / opt_adamw_scan4 FIRST, memory-clean)
-#   2. optimizer-variant sweep rows (sgd / mu_bf16 / adafactor) — direct attribution
-#   3. combo rows on the best tuning config (blocks 512x512)
-#   4. final scoring run (auto-adopts best pure-tuning row)
+# Second TPU work session (round 2): fused-kernel rows + optimizer attribution.
+# Ordered by value-per-chip-minute under the assumption the tunnel window may be SHORT:
+#   1. the two fused-optimizer bench rows + fused-CE row (the candidate 2x lever)
+#   2. immediate adopt-best scoring run (locks any win into BENCH_SELF.json)
+#   3. decompose (opt/xent kernel isolation + fwd/bwd attribution)
+#   4. remaining attribution + combo rows
+#   5. final adopt-best scoring run
 # Each stage tolerates the tunnel dying: own subprocess + timeout; sweep re-polls.
 set -u
 cd "$(dirname "$0")/.."
@@ -13,18 +13,21 @@ cd "$(dirname "$0")/.."
 echo "=== waiting for TPU ==="
 python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
 
-echo "=== 1. decompose (opt rows first) ==="
-timeout 1500 python benchmarks/decompose.py > decompose2.json 2>decompose2.err
-echo "decompose rc=$?"; grep -a "opt_adamw" decompose2.json | head -2
-
-echo "=== 2. optimizer attribution rows (fused kernel first) ==="
+echo "=== 1. highest-value rows ==="
 python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
-  --only opt_fused_adamw,blocks512_fused_adamw,b2,accum4_b2,accum4_b2_blocks512,opt_sgd,opt_mu_bf16,opt_adafactor
+  --only blocks512_fused_adamw,opt_fused_adamw,blocks512_loss_fused,loss_fused
 
-echo "=== 3. combo rows ==="
-python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
-  --only loss_fused,blocks512_loss_fused,cast_off,cast_off_loss_fused,blocks512_lc1024,blocks512_dimsem,blocks512_mu_bf16,fuse16,blocks512_fuse16,blocks512_b8,dimsem
-
-echo "=== 4. adopt best + final scoring run ==="
+echo "=== 2. early adopt-best scoring run ==="
 timeout 900 python bench.py
+
+echo "=== 3. decompose (kernel isolation) ==="
+timeout 1500 python benchmarks/decompose.py > decompose2.json 2>decompose2.err
+echo "decompose rc=$?"; grep -a "opt_\|xent_" decompose2.json | head -4
+
+echo "=== 4. attribution + combo rows ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
+  --only b2,accum4_b2,accum4_b2_blocks512,opt_sgd,opt_mu_bf16,opt_adafactor,cast_off,cast_off_loss_fused,blocks512_lc1024,blocks512_dimsem,blocks512_mu_bf16,fuse16,blocks512_fuse16,blocks512_b8,dimsem
+
+echo "=== 5. final adopt-best scoring run (with profile trace) ==="
+BENCH_PROFILE=bench_trace timeout 900 python bench.py
 echo "=== session2 done ==="
