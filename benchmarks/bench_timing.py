@@ -24,6 +24,19 @@ def enable_compile_cache(repo_root: str) -> None:
     os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "5")
 
 
+def force_cpu_for_smoke() -> bool:
+    """BENCH_PRESET=smoke is a CPU logic check by definition — pin the CPU backend past
+    the sitecustomize platform preset so it can never hang on a dead TPU tunnel.
+    Returns whether smoke mode is active. Call before any other jax use."""
+    smoke = os.environ.get("BENCH_PRESET") == "smoke"
+    if smoke:
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    return smoke
+
+
 def materialize(out):
     """Force completion by fetching a single element of (the first leaf of) ``out``."""
     import jax
@@ -49,3 +62,65 @@ def timed(fn, *args, n=3, warmup=1):
 def exc_line(e: BaseException, width: int = 160) -> str:
     """First line of an exception message, safe for empty messages (bare MemoryError)."""
     return (str(e).splitlines() or [type(e).__name__])[0][:width]
+
+
+class RowRunner:
+    """Failure-scoped benchmark rows: one crashing row (OOM, remote-compile HTTP 500,
+    Mosaic lowering error) is recorded and skipped, never aborts the section. The
+    session scripts run these harnesses unattended in short tunnel windows — a partial
+    JSON beats a traceback every time."""
+
+    def __init__(self):
+        self.rows = []
+        self.failed = []
+
+    def row(self, name, thunk):
+        """Run thunk() -> dict of fields; record `{"name", **fields}` or the error."""
+        import gc
+
+        failed = False
+        try:
+            rec = thunk() or {}
+            self.rows.append({"name": name, **rec})
+            return rec
+        except Exception as e:
+            msg = f"{type(e).__name__}: {exc_line(e, 160)}"
+            print(f"{name}: {msg}", flush=True)
+            self.rows.append({"name": name, "error": msg})
+            self.failed.append(name)
+            failed = True
+            return None
+        finally:
+            if failed:
+                # Outside the except block the exception (and its traceback's grip on
+                # the thunk frame's device buffers) is dead, so this collect actually
+                # frees them before the next row.
+                gc.collect()
+
+    def section(self, name, thunk):
+        """Guard shared setup for a group of rows: failure is recorded as `<name>`
+        (the inner rows never ran); success adds no row of its own."""
+        import gc
+
+        failed = False
+        try:
+            thunk()
+        except Exception as e:
+            msg = f"{type(e).__name__}: {exc_line(e, 160)}"
+            print(f"{name}: {msg}", flush=True)
+            self.rows.append({"name": name, "error": msg})
+            self.failed.append(name)
+            failed = True
+        finally:
+            if failed:
+                gc.collect()
+
+    def finish(self, **config):
+        """Always emit the JSON line (partial rows included); return exit code 0."""
+        import json
+
+        out = {"rows": self.rows, "config": config}
+        if self.failed:
+            out["failed_rows"] = self.failed
+        print(json.dumps(out), flush=True)
+        return 0

@@ -19,16 +19,15 @@ Times each piece of the bench workload in isolation so the MFU gap can be attrib
   xent_chunked    — loss head fwd+bwd, chunked CE (models/llama._chunked_ce)
   xent_fused      — loss head fwd+bwd, fused Pallas CE (ops/fused_xent)
 
-Each row prints achieved TFLOP/s against its own analytic FLOP count, so the slow
-component is directly visible.  Run on the real chip: `python benchmarks/decompose.py`.
+Every row is failure-scoped (bench_timing.RowRunner): an OOM or a remote-compile
+error records that row as failed and the section continues; the final JSON always
+prints and the script always exits 0 so the chained session scripts keep going.
+Run on the real chip: `python benchmarks/decompose.py`.
 """
 
 from __future__ import annotations
 
 import dataclasses
-import functools
-import json
-import math
 import sys
 import time
 
@@ -38,24 +37,18 @@ REPO = __import__("os").path.dirname(__import__("os").path.dirname(__import__("o
 sys.path.insert(0, REPO)
 
 
+from bench_timing import RowRunner  # noqa: E402
 from bench_timing import materialize as _materialize  # noqa: E402  (tunnel-safe fence)
 from bench_timing import timed  # noqa: E402
-from bench_timing import exc_line  # noqa: E402
 
 
 def main() -> int:
     import os
 
-    from bench_timing import enable_compile_cache
+    from bench_timing import enable_compile_cache, force_cpu_for_smoke
 
     enable_compile_cache(REPO)
-    if os.environ.get("BENCH_PRESET") == "smoke":
-        # The smoke preset is a CPU logic check by definition — force the CPU backend past
-        # the sitecustomize platform pin so it can never hang on a dead TPU tunnel.
-        os.environ["JAX_PLATFORMS"] = "cpu"
-        import jax
-
-        jax.config.update("jax_platforms", "cpu")
+    smoke = force_cpu_for_smoke()  # CPU logic check, not a perf number
     import jax
     import jax.numpy as jnp
     import optax
@@ -63,9 +56,6 @@ def main() -> int:
     from accelerate_tpu.models import llama
     from accelerate_tpu.ops.flash_attention import flash_attention
 
-    import os
-
-    smoke = os.environ.get("BENCH_PRESET") == "smoke"  # CPU logic check, not a perf number
     B = int(os.environ.get("BENCH_B", "1" if smoke else "4"))
     S = int(os.environ.get("BENCH_S", "256" if smoke else "2048"))
     cfg = dataclasses.replace(
@@ -80,27 +70,36 @@ def main() -> int:
         attn_impl="xla" if smoke else "flash",
     )
     n_params = llama.num_params(cfg)
-    rows = []
+    rr = RowRunner()
 
-    def report(name, dt, flops):
-        tf = flops / dt / 1e12
-        rows.append({"name": name, "ms": round(dt * 1e3, 2), "tflops": round(tf, 2)})
-        print(f"{name:18s} {dt*1e3:9.2f} ms   {tf:8.2f} TFLOP/s", flush=True)
+    def flops_row(name, fn, flops, *args):
+        def thunk():
+            dt = timed(fn, *args)
+            tf = flops / dt / 1e12
+            print(f"{name:18s} {dt*1e3:9.2f} ms   {tf:8.2f} TFLOP/s", flush=True)
+            return {"ms": round(dt * 1e3, 2), "tflops": round(tf, 2)}
+
+        rr.row(name, thunk)
 
     # --- matmul peak: k chained [M,M]x[M,M] bf16 matmuls
     M = 256 if smoke else 8192
-    a = jnp.ones((M, M), jnp.bfloat16)
-    w = jnp.ones((M, M), jnp.bfloat16)
 
-    @jax.jit
-    def chain(a, w):
-        for _ in range(8):
-            a = a @ w
-        return a
+    def matmul_peak():
+        a = jnp.ones((M, M), jnp.bfloat16)
+        w = jnp.ones((M, M), jnp.bfloat16)
 
-    dt = timed(chain, a, w)
-    report("matmul_peak", dt, 8 * 2 * M * M * M)
-    del a, w
+        @jax.jit
+        def chain(a, w):
+            for _ in range(8):
+                a = a @ w
+            return a
+
+        dt = timed(chain, a, w)
+        tf = 8 * 2 * M * M * M / dt / 1e12
+        print(f"{'matmul_peak':18s} {dt*1e3:9.2f} ms   {tf:8.2f} TFLOP/s", flush=True)
+        return {"ms": round(dt * 1e3, 2), "tflops": round(tf, 2)}
+
+    rr.row("matmul_peak", matmul_peak)
 
     # --- optimizer apply alone, FIRST (cleanest memory: nothing else resident).
     # The full train step runs ~790 ms/step slower than fwd_bwd on the chip (r2
@@ -129,26 +128,22 @@ def main() -> int:
         u, s = tx.update(grads, s, p)
         return optax.apply_updates(p, u), s
 
-    def report_opt(name, apply_fn, init_state):
+    def measure_opt(name, apply_fn, init_state):
         """Time one donated apply; adamw traffic ≈ read p,m,v,g + write p,m,v = 7·p_bytes."""
-        try:
-            fresh = jax.tree_util.tree_map(
-                lambda p: p.astype(jnp.float32), llama.init_params(cfg)
-            )
-            jitted = jax.jit(apply_fn, donate_argnums=(0, 1))
-            dt = timed_state2(jitted, fresh, init_state(fresh))
-            print(f"{name:18s} {dt*1e3:9.2f} ms   {7*p_bytes/dt/1e9:8.1f} GB/s eff",
-                  flush=True)
-            rows.append({"name": name, "ms": round(dt * 1e3, 2),
-                         "gbps": round(7 * p_bytes / dt / 1e9, 1)})
-        except Exception as e:
-            print(f"{name}: {type(e).__name__}: {exc_line(e, 120)}")
+        fresh = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.float32), llama.init_params(cfg)
+        )
+        jitted = jax.jit(apply_fn, donate_argnums=(0, 1))
+        dt = timed_state2(jitted, fresh, init_state(fresh))
+        gbps = 7 * p_bytes / dt / 1e9
+        print(f"{name:18s} {dt*1e3:9.2f} ms   {gbps:8.1f} GB/s eff", flush=True)
+        return {"ms": round(dt * 1e3, 2), "gbps": round(gbps, 1)}
 
-    report_opt("opt_adamw", one_opt, tx.init)
+    rr.row("opt_adamw", lambda: measure_opt("opt_adamw", one_opt, tx.init))
 
     # Fused Pallas kernel, like-for-like: same synthetic grads, same global-norm clip
     # work (the real build_train_step also computes gnorm, then folds it as a scalar).
-    try:
+    def fused_thunk():
         from accelerate_tpu.ops.fused_optim import fused_adamw
 
         fa = fused_adamw(1e-4)
@@ -159,11 +154,11 @@ def main() -> int:
             scale = jnp.minimum(1.0, 1.0 / (gnorm + 1e-6))
             return fa.fused_apply(grads, s, p, grad_scale=scale)
 
-        report_opt("opt_fused_adamw", one_fused, fa.init)
-    except Exception as e:  # per-row failure scoping, like every other section
-        print(f"opt_fused_adamw: {type(e).__name__}: {exc_line(e, 120)}")
+        return measure_opt("opt_fused_adamw", one_fused, fa.init)
 
-    try:
+    rr.row("opt_fused_adamw", fused_thunk)
+
+    def scan4_row():
         def scan4(p, s):
             def body(carry, _):
                 p, s = carry
@@ -176,69 +171,67 @@ def main() -> int:
         params32 = jax.tree_util.tree_map(
             lambda p: p.astype(jnp.float32), llama.init_params(cfg)
         )
-        opt_state = tx.init(params32)
-        dt = timed_state2(scan_jit, params32, opt_state)
+        dt = timed_state2(scan_jit, params32, tx.init(params32))
         print(f"opt_adamw_scan4    {dt/4*1e3:9.2f} ms/step  (fused-path memory pattern)",
               flush=True)
-        rows.append({"name": "opt_adamw_scan4", "ms_per_step": round(dt / 4 * 1e3, 2)})
-    except Exception as e:
-        print(f"opt_adamw_scan4: {type(e).__name__}: {exc_line(e, 120)}")
-    params32 = opt_state = None  # release before the activation-heavy sections
+        return {"ms_per_step": round(dt / 4 * 1e3, 2)}
+
+    rr.row("opt_adamw_scan4", scan4_row)
 
     # --- attention at bench shapes (per layer): q [B,S,H,hd]
-    H, K, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
-    q = jnp.ones((B, S, H, hd), jnp.bfloat16)
-    k = jnp.ones((B, S, K, hd), jnp.bfloat16)
-    v = jnp.ones((B, S, K, hd), jnp.bfloat16)
-    # causal attention flops fwd: 2 matmuls * B*H*S*S*hd, halved by causality
-    attn_flops = 2 * 2 * B * H * S * S * hd / 2
+    def attn_rows():
+        H, K, hd = cfg.n_heads, cfg.n_kv_heads, cfg.head_dim
+        q = jnp.ones((B, S, H, hd), jnp.bfloat16)
+        k = jnp.ones((B, S, K, hd), jnp.bfloat16)
+        v = jnp.ones((B, S, K, hd), jnp.bfloat16)
+        # causal attention flops fwd: 2 matmuls * B*H*S*S*hd, halved by causality
+        attn_flops = 2 * 2 * B * H * S * S * hd / 2
 
-    f_fwd = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
-    dt = timed(f_fwd, q, k, v)
-    report("attn_flash_fwd", dt, attn_flops)
+        flops_row("attn_flash_fwd",
+                  jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True)),
+                  attn_flops, q, k, v)
+        flops_row("attn_flash_bwd",
+                  jax.jit(jax.grad(lambda q, k, v: flash_attention(q, k, v, causal=True).astype(jnp.float32).sum(), argnums=(0, 1, 2))),
+                  attn_flops * 3.5, q, k, v)  # fwd recompute + 2.5x bwd
 
-    f_bwd = jax.jit(jax.grad(lambda q, k, v: flash_attention(q, k, v, causal=True).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
-    dt = timed(f_bwd, q, k, v)
-    report("attn_flash_bwd", dt, attn_flops * 3.5)  # fwd recompute + 2.5x bwd
+        mask = jnp.tril(jnp.ones((S, S), jnp.bool_))[None]
+        flops_row("attn_xla_fwd",
+                  jax.jit(lambda q, k, v: llama._attention_xla(q, k, v, mask, cfg)),
+                  attn_flops * 2, q, k, v)  # xla does the full square
+        flops_row("attn_xla_bwd",
+                  jax.jit(jax.grad(lambda q, k, v: llama._attention_xla(q, k, v, mask, cfg).astype(jnp.float32).sum(), argnums=(0, 1, 2))),
+                  attn_flops * 2 * 3, q, k, v)
 
-    mask = jnp.tril(jnp.ones((S, S), jnp.bool_))[None]
-    x_fwd = jax.jit(lambda q, k, v: llama._attention_xla(q, k, v, mask, cfg))
-    dt = timed(x_fwd, q, k, v)
-    report("attn_xla_fwd", dt, attn_flops * 2)  # xla does the full square
-
-    x_bwd = jax.jit(jax.grad(lambda q, k, v: llama._attention_xla(q, k, v, mask, cfg).astype(jnp.float32).sum(), argnums=(0, 1, 2)))
-    dt = timed(x_bwd, q, k, v)
-    report("attn_xla_bwd", dt, attn_flops * 2 * 3)
+    rr.section("attn_setup", attn_rows)
 
     # --- full model forward (no remat) + loss
-    params = llama.init_params(cfg)
-    params = jax.tree_util.tree_map(lambda p: p.astype(jnp.bfloat16), params)
-    tokens = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab_size, (B, S + 1)), jnp.int32)
-    # 2N matmul + causal-attention 2·L·S·D FLOPs per token (bench.py's 6N+6LSD, fwd third).
-    fwd_flops = (2 * n_params + 2 * cfg.n_layers * S * cfg.d_model) * B * S
+    def fwd_rows():
+        params = jax.tree_util.tree_map(
+            lambda p: p.astype(jnp.bfloat16), llama.init_params(cfg)
+        )
+        tokens = jnp.asarray(np.random.default_rng(0).integers(0, cfg.vocab_size, (B, S + 1)), jnp.int32)
+        # 2N matmul + causal-attention 2·L·S·D FLOPs per token (bench.py's 6N+6LSD, fwd third).
+        fwd_flops = (2 * n_params + 2 * cfg.n_layers * S * cfg.d_model) * B * S
 
-    fwd = jax.jit(lambda p, t: llama.forward_hidden(p, t[:, :-1], cfg)[0])
-    dt = timed(fwd, params, tokens)
-    report("fwd_hidden", dt, fwd_flops)
+        flops_row("fwd_hidden",
+                  jax.jit(lambda p, t: llama.forward_hidden(p, t[:, :-1], cfg)[0]),
+                  fwd_flops, params, tokens)
+        flops_row("loss_fwd",
+                  jax.jit(lambda p, b: llama.loss_fn(p, b, cfg)),
+                  fwd_flops, params, {"tokens": tokens})
 
-    lfn = jax.jit(lambda p, b: llama.loss_fn(p, b, cfg))
-    dt = timed(lfn, params, {"tokens": tokens})
-    report("loss_fwd", dt, fwd_flops)
+        for name, c in (("noremat", cfg),
+                        ("remat_full", dataclasses.replace(cfg, remat=True, remat_policy="full")),
+                        ("remat_dots", dataclasses.replace(cfg, remat=True, remat_policy="dots"))):
+            flops_row(f"fwd_bwd_{name}",
+                      jax.jit(jax.grad(lambda p, b, c=c: llama.loss_fn(p, b, c))),
+                      fwd_flops * 3, params, {"tokens": tokens})
 
-    for name, policy in (("noremat", cfg), ("remat_full", dataclasses.replace(cfg, remat=True, remat_policy="full")), ("remat_dots", dataclasses.replace(cfg, remat=True, remat_policy="dots"))):
-        c = policy
-        try:
-            g = jax.jit(jax.grad(lambda p, b: llama.loss_fn(p, b, c)))
-            dt = timed(g, params, {"tokens": tokens})
-            report(f"fwd_bwd_{name}", dt, fwd_flops * 3)
-        except Exception as e:  # OOM for noremat at large B
-            print(f"fwd_bwd_{name}: {type(e).__name__}: {exc_line(e, 120)}")
+    rr.section("fwd_setup", fwd_rows)
 
     # --- loss head in isolation: chunked CE vs the fused Pallas kernel, fwd+bwd at bench
     # shapes (hidden [B*S, D] @ head [D, V] + softmax-CE; flops = 3 x 2 x T x D x V).
-    try:
-        from accelerate_tpu.ops.fused_xent import fused_cross_entropy
-
+    def xent_rows():
         Tn = B * S
         hid = jnp.ones((Tn, cfg.d_model), jnp.bfloat16) * 0.01
         headw = jnp.ones((cfg.d_model, cfg.vocab_size), jnp.bfloat16) * 0.01
@@ -255,21 +248,20 @@ def main() -> int:
                 h3, w, tgt.reshape(B, S), jnp.ones((B, S), jnp.float32), 512, jnp.bfloat16
             )
 
-        g = jax.jit(jax.grad(chunked_ce, argnums=(0, 1)))
-        dt = timed(g, hid, headw)
-        report("xent_chunked", dt, ce_flops)
+        flops_row("xent_chunked", jax.jit(jax.grad(chunked_ce, argnums=(0, 1))),
+                  ce_flops, hid, headw)
 
         def fused_ce(h, w):
+            from accelerate_tpu.ops.fused_xent import fused_cross_entropy
+
             return fused_cross_entropy(h, w, tgt).sum()
 
-        g = jax.jit(jax.grad(fused_ce, argnums=(0, 1)))
-        dt = timed(g, hid, headw)
-        report("xent_fused", dt, ce_flops)
-    except Exception as e:
-        print(f"xent rows: {type(e).__name__}: {exc_line(e, 120)}")
+        flops_row("xent_fused", jax.jit(jax.grad(fused_ce, argnums=(0, 1))),
+                  ce_flops, hid, headw)
 
-    print(json.dumps({"rows": rows, "config": {"B": B, "S": S, "n_params": n_params}}))
-    return 0
+    rr.section("xent_setup", xent_rows)
+
+    return rr.finish(B=B, S=S, n_params=n_params)
 
 
 if __name__ == "__main__":

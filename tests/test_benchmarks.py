@@ -59,6 +59,28 @@ def test_decompose_smoke():
     data = json.loads(out.stdout.strip().splitlines()[-1])
     names = {r["name"] for r in data["rows"]}
     assert {"matmul_peak", "fwd_bwd_remat_full", "opt_adamw", "opt_adamw_scan4"} <= names
+    # RowRunner records failures instead of crashing — on the CPU smoke path every row
+    # must still SUCCEED, or a broken benchmark would hide behind the scoping.
+    errored = [r["name"] for r in data["rows"] if "error" in r]
+    assert not errored, f"smoke rows failed: {errored}"
+
+
+@slow
+def test_step_attrib_smoke():
+    env = dict(os.environ, BENCH_PRESET="smoke")
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    out = subprocess.run(
+        [sys.executable, "benchmarks/step_attrib.py"], capture_output=True, text=True,
+        timeout=900, env=env, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    data = json.loads(out.stdout.strip().splitlines()[-1])
+    names = {r["name"] for r in data["rows"]}
+    fuse = data["config"]["FUSE"]
+    assert {"grad_bf16", "full_sgd_f1", f"full_fused_adamw_f{fuse}",
+            f"full_fused_adamw_lossfused_f{fuse}"} <= names
+    errored = [r["name"] for r in data["rows"] if "error" in r]
+    assert not errored, f"smoke rows failed: {errored}"
 
 
 @slow
