@@ -18,9 +18,26 @@ these exact kernels per ring step with correct cross-device causal masking. The 
 ``_bwd_dq`` / ``_bwd_dkv`` entry points (returning/consuming lse and delta) are the building
 blocks for the ring; ``flash_attention`` is the single-device public API.
 
+TPU-specific structure (the r2 on-chip decompose showed the first version of this kernel
+running at ~1/5 the throughput of plain XLA attention; these three choices close it):
+
+- **Lane-replicated softmax state.** The running max ``m`` and sum ``l`` live in VMEM as
+  [block_q, 128] with every lane carrying the same value, so the per-step rescale math runs
+  on full native (8,128) VPU registers and broadcasting into the [block_q, block_k] score
+  tile is a cheap ``jnp.tile`` of a native register instead of a 1-lane → 128-lane relayout.
+  The backward kernels read lse/delta lane-replicated the same way.
+- **Mask-free interior tiles.** For causal attention only the tiles the diagonal actually
+  crosses need the iota row/col mask; tiles entirely below the diagonal (the majority at
+  long S) skip mask construction, the select, and the zero-fill entirely — splash-attention
+  style tile classing, decided per grid step from the SMEM offsets.
+- **Grid semantics + cost estimate.** (batch, head, q-block) grid dimensions are declared
+  PARALLEL (only the kv dimension carries scratch state and stays ARBITRARY), and each
+  ``pallas_call`` carries a ``pl.CostEstimate`` so XLA's scheduler sees the real arithmetic
+  intensity. ``ACCEL_FLASH_DIMSEM=0`` disables the semantics for A/B measurement.
+
 Runs in interpreter mode on CPU (tests) and compiled on TPU. Block sizes default to 256×512
 (see ``_DEFAULT_BLOCK_Q/K``); hd should be a multiple of 128 for peak efficiency (llama3:
-hd=128).
+hd=128). Sweep overrides: ACCEL_FLASH_BLOCK_Q / ACCEL_FLASH_BLOCK_K.
 """
 
 from __future__ import annotations
@@ -40,6 +57,8 @@ from ._common import interpret_default as _interpret_default
 __all__ = ["flash_attention"]
 
 _NEG_INF = -1e30
+_LANES = 128  # native VPU lane count: softmax state is replicated across lanes
+
 
 # Default tile sizes. The grid iterates sequentially on the TensorCore, so per-step fixed
 # overhead (semaphores, block DMA setup) is paid nq*nk times per (batch, head): 128x128 tiles
@@ -65,13 +84,19 @@ def _dim_semantics(n_parallel: int, n_arbitrary: int):
     """Mosaic grid-dimension semantics: the leading (batch/head/row-block) dims carry no
     scratch state and may be reordered/pipelined freely (PARALLEL); the trailing dims
     accumulate into VMEM scratch across iterations and must stay sequential (ARBITRARY).
-    Env-gated (ACCEL_FLASH_DIMSEM=1) so the bench sweep can measure it per chip before it
-    becomes a default."""
-    if os.environ.get("ACCEL_FLASH_DIMSEM", "0") != "1":
+    Default ON (the official jax flash kernel ships this unconditionally);
+    ACCEL_FLASH_DIMSEM=0 turns it off for A/B rows in the bench sweep."""
+    if os.environ.get("ACCEL_FLASH_DIMSEM", "1") == "0":
         return None
     return pltpu.CompilerParams(
-        dimension_semantics=(pltpu.PARALLEL,) * n_parallel
-        + (pltpu.ARBITRARY,) * n_arbitrary
+        dimension_semantics=("parallel",) * n_parallel + ("arbitrary",) * n_arbitrary
+    )
+
+
+def _cost(flops: float, bytes_accessed: float, transcendentals: float):
+    return pl.CostEstimate(
+        flops=int(flops), bytes_accessed=int(bytes_accessed),
+        transcendentals=int(transcendentals),
     )
 
 
@@ -83,15 +108,55 @@ def _smem_scalar_spec():
     return pl.BlockSpec(memory_space=pltpu.SMEM)
 
 
+def _lane_tile(x, cols):
+    """Broadcast lane-replicated state [rows, _LANES] across a tile [rows, cols] —
+    full-register tile then slice, never a 1-lane relayout. Handles any cols (ceil-tile
+    + slice for non-multiples of 128, e.g. head_dim 192)."""
+    if cols == _LANES:
+        return x
+    if cols < _LANES:
+        return x[:, :cols]
+    tiled = jnp.tile(x, (1, pl.cdiv(cols, _LANES)))
+    return tiled if tiled.shape[1] == cols else tiled[:, :cols]
+
+
+def _tile_mask(*, causal, window, has_segments, kv_pad, block_q, block_k,
+               q_global, k_global, k_local, kv_len, q_seg_ref=None, kv_seg_ref=None):
+    """Build the [block_q, block_k] validity mask for a tile whose top-left element sits at
+    global (q_global, k_global) and local kv column ``k_local`` (padding is local).
+    Returns None when no constraint applies (interior tile)."""
+    mask = None
+
+    def _and(m, c):
+        return c if m is None else jnp.logical_and(m, c)
+
+    if kv_pad:
+        col_local = k_local + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = _and(mask, col_local < kv_len)
+    if causal or window:
+        row = q_global + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        col = k_global + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        if causal:
+            mask = _and(mask, col <= row)
+        if window:
+            mask = _and(mask, col > row - window)
+    if has_segments:
+        sq = q_seg_ref[0][:, None]
+        sk = kv_seg_ref[0][None, :]
+        mask = _and(mask, jnp.logical_and(sq == sk, sk != 0))
+    return mask
+
+
 # ------------------------------------------------------------------------------ forward
 def _fwd_kernel(
     q_off_ref, kv_off_ref, *refs,
-    sm_scale, causal, block_q, block_k, kv_len, has_segments, window, softcap,
+    sm_scale, causal, block_q, block_k, kv_len, kv_pad, has_segments, window, softcap,
 ):
     if has_segments:
         (q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref,
          o_ref, lse_ref, acc_ref, m_ref, l_ref) = refs
     else:
+        q_seg_ref = kv_seg_ref = None
         q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref = refs
     i = pl.program_id(2)  # q block
     j = pl.program_id(3)  # kv block
@@ -107,70 +172,82 @@ def _fwd_kernel(
     k_start = j * block_k
     q_off = q_off_ref[0, 0]
     kv_off = kv_off_ref[0, 0]
-    # Causal: skip kv blocks strictly above the diagonal band (in global positions).
+    q_global = q_off + q_start        # global position of this tile's first row
+    k_global = kv_off + k_start       # global position of this tile's first col
+    # Causal: skip kv tiles strictly above the diagonal band (in global positions).
     needed = jnp.logical_or(
-        jnp.asarray(not causal),
-        kv_off + k_start <= q_off + q_start + block_q - 1,
+        jnp.asarray(not causal), k_global <= q_global + block_q - 1
     )
     if window:
-        # Sliding window: also skip kv blocks entirely BELOW the band (col <= row - window
-        # for every pair in the block) — long-context Mistral-style attention never touches
+        # Sliding window: also skip kv tiles entirely BELOW the band (col <= row - window
+        # for every pair in the tile) — long-context Mistral-style attention never touches
         # those tiles at all.
-        needed = jnp.logical_and(
-            needed, kv_off + k_start + block_k - 1 > q_off + q_start - window
-        )
+        needed = jnp.logical_and(needed, k_global + block_k - 1 > q_global - window)
 
-    @pl.when(needed)
-    def _compute():
+    # Tile classing: interior tiles (diagonal doesn't cross, window band doesn't clip,
+    # no kv padding, no segment ids) take the mask-free fast path.
+    interior = jnp.asarray(not (has_segments or kv_pad))
+    if causal:
+        interior = jnp.logical_and(interior, k_global + block_k - 1 <= q_global)
+    if window:
+        interior = jnp.logical_and(interior, k_global > q_global + block_q - 1 - window)
+
+    def _accumulate(s, mask):
+        """Online-softmax update; all state lane-replicated [block_q, _LANES]."""
+        if mask is not None:
+            s = jnp.where(mask, s, _NEG_INF)
+        m_prev = m_ref[:]                                   # [bq, LANES]
+        m_curr = jnp.max(s, axis=1)[:, None]                # [bq, 1]
+        m_next = jnp.maximum(m_prev, m_curr)                # [bq, LANES]
+        p = jnp.exp(s - _lane_tile(m_next, block_k))        # [bq, bk] fp32
+        if mask is not None:
+            # On a FULLY-masked row (packed-padding slots) every s equals _NEG_INF and so
+            # does m_next, making exp(s - m_next) = 1 — the row sum l must still be 0 so
+            # the finalize step emits zeros / -inf lse.
+            p = jnp.where(mask, p, 0.0)
+        alpha = jnp.exp(m_prev - m_next)                    # [bq, LANES]
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1)[:, None]
+        v = v_ref[0, 0]
+        acc_ref[:] = acc_ref[:] * _lane_tile(alpha, acc_ref.shape[1]) + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[:] = m_next
+
+    def _scores():
         # Dots run in the INPUT dtype with fp32 accumulation (preferred_element_type):
         # bf16 inputs hit the MXU at full bf16 rate (an upfront fp32 cast would halve it);
         # fp32 inputs keep full-precision parity with the XLA reference path.
         q = q_ref[0, 0]                      # [block_q, hd]
         k = k_ref[0, 0]                      # [block_k, hd]
-        v = v_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale  # [block_q, block_k] fp32
         if softcap:  # Gemma-style capping: s = cap*tanh(s/cap)
             s = softcap * jnp.tanh(s / softcap)
+        return s
 
-        col_local = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        mask = col_local < kv_len
-        if causal or window:
-            row = q_off + q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            if causal:
-                mask = jnp.logical_and(mask, kv_off + col_local <= row)
-            if window:
-                mask = jnp.logical_and(mask, kv_off + col_local > row - window)
-        if has_segments:
-            # Packed rows: attend only within the same segment; segment 0 is padding.
-            sq = q_seg_ref[0][:, None]
-            sk = kv_seg_ref[0][None, :]
-            mask = jnp.logical_and(mask, jnp.logical_and(sq == sk, sk != 0))
-        s = jnp.where(mask, s, _NEG_INF)
+    @pl.when(jnp.logical_and(needed, interior))
+    def _compute_fast():
+        _accumulate(_scores(), None)
 
-        m_prev = m_ref[:]                       # [block_q, 1]
-        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
-        alpha = jnp.exp(m_prev - m_new)
-        # Mask p explicitly: on a FULLY-masked row (packed-padding slots) every s equals
-        # _NEG_INF and so does m_new, making exp(s - m_new) = 1 — the row sum l must still
-        # be 0 so the finalize step emits zeros / -inf lse.
-        p = jnp.where(mask, jnp.exp(s - m_new), 0.0)  # fp32; row-sum in fp32 pre-cast
-        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1, keepdims=True)
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
+    @pl.when(jnp.logical_and(needed, jnp.logical_not(interior)))
+    def _compute_masked():
+        mask = _tile_mask(
+            causal=causal, window=window, has_segments=has_segments, kv_pad=kv_pad,
+            block_q=block_q, block_k=block_k, q_global=q_global, k_global=k_global,
+            k_local=k_start, kv_len=kv_len, q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref,
         )
-        m_ref[:] = m_new
+        _accumulate(_scores(), mask)
 
     @pl.when(j == nk - 1)
     def _finalize():
-        l = l_ref[:]
+        l = l_ref[:]                                        # [bq, LANES] replicated
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        o_ref[0, 0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+        o_ref[0, 0] = (acc_ref[:] / _lane_tile(l_safe, acc_ref.shape[1])).astype(o_ref.dtype)
         # lse = -inf where no key attended (fully-masked row) so ring merging ignores it.
         lse = jnp.where(l == 0.0, _NEG_INF, m_ref[:] + jnp.log(l_safe))
-        lse_ref[0, 0] = lse  # [block_q, 1]
+        lse_ref[0, 0] = lse                                  # [bq, LANES] replicated
 
 
 def _seg_blocks(segments, Sp, Tp):
@@ -201,7 +278,7 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, q_offset=0, kv_
     kernel = functools.partial(
         _fwd_kernel,
         sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k, kv_len=T,
-        has_segments=has_segments, window=window, softcap=softcap,
+        kv_pad=(Tp != T), has_segments=has_segments, window=window, softcap=softcap,
     )
     seg_specs, seg_args = [], []
     if has_segments:
@@ -211,6 +288,8 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, q_offset=0, kv_
             pl.BlockSpec((1, block_k), lambda b, h, i, j: (b, j)),
         ]
         seg_args = [q_seg, kv_seg]
+    # fwd cost: qk^T + pv dots (causal ≈ half the tiles), exp over the score tiles.
+    dot_flops = 4 * B * H * Sp * Tp * hd * (0.5 if causal else 1.0)
     o, lse = pl.pallas_call(
         kernel,
         grid=(B, H, nq, nk),
@@ -224,18 +303,24 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, q_offset=0, kv_
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Sp, hd), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Sp, 1), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Sp, _LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, hd), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
-            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
+            pltpu.VMEM((block_q, _LANES), jnp.float32),
         ],
         compiler_params=_dim_semantics(3, 1),
+        cost_estimate=_cost(
+            dot_flops,
+            q.size * q.dtype.itemsize + (k.size + v.size) * k.dtype.itemsize * reps
+            + B * H * Sp * hd * q.dtype.itemsize,
+            B * H * Sp * Tp * (0.5 if causal else 1.0),
+        ),
         interpret=interpret,
     )(_scalar(q_offset), _scalar(kv_offset), *seg_args, q, k, v)
     return o[:, :, :S], lse[:, :, :S, 0]
@@ -244,12 +329,13 @@ def _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret, q_offset=0, kv_
 # ------------------------------------------------------------------------------ backward
 def _bwd_dq_kernel(
     q_off_ref, kv_off_ref, *refs,
-    sm_scale, causal, block_q, block_k, kv_len, has_segments, window, softcap,
+    sm_scale, causal, block_q, block_k, kv_len, kv_pad, has_segments, window, softcap,
 ):
     if has_segments:
         (q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dq_ref, dq_acc) = refs
     else:
+        q_seg_ref = kv_seg_ref = None
         q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_acc = refs
     i = pl.program_id(2)
     j = pl.program_id(3)
@@ -263,52 +349,57 @@ def _bwd_dq_kernel(
     k_start = j * block_k
     q_off = q_off_ref[0, 0]
     kv_off = kv_off_ref[0, 0]
+    q_global = q_off + q_start
+    k_global = kv_off + k_start
     needed = jnp.logical_or(
-        jnp.asarray(not causal),
-        kv_off + k_start <= q_off + q_start + block_q - 1,
+        jnp.asarray(not causal), k_global <= q_global + block_q - 1
     )
     if window:
-        needed = jnp.logical_and(
-            needed, kv_off + k_start + block_k - 1 > q_off + q_start - window
-        )
+        needed = jnp.logical_and(needed, k_global + block_k - 1 > q_global - window)
+    interior = jnp.asarray(not (has_segments or kv_pad))
+    if causal:
+        interior = jnp.logical_and(interior, k_global + block_k - 1 <= q_global)
+    if window:
+        interior = jnp.logical_and(interior, k_global > q_global + block_q - 1 - window)
 
-    @pl.when(needed)
-    def _compute():
+    def _compute(mask):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0]                    # [block_q, 1]
-        delta = delta_ref[0, 0]
+        lse = lse_ref[0, 0]                    # [block_q, LANES] lane-replicated
+        delta = delta_ref[0, 0]                # [block_q, LANES] lane-replicated
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         ) * sm_scale
         if softcap:  # recompute the capped scores AND the cap's local slope
             t = jnp.tanh(s / softcap)
             s = softcap * t
-        col_local = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        mask = col_local < kv_len
-        if causal or window:
-            row = q_off + q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-            if causal:
-                mask = jnp.logical_and(mask, kv_off + col_local <= row)
-            if window:
-                mask = jnp.logical_and(mask, kv_off + col_local > row - window)
-        if has_segments:
-            sq = q_seg_ref[0][:, None]
-            sk = kv_seg_ref[0][None, :]
-            mask = jnp.logical_and(mask, jnp.logical_and(sq == sk, sk != 0))
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        p = jnp.exp(s - _lane_tile(lse, block_k))
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * sm_scale
+        ds = p * (dp - _lane_tile(delta, block_k)) * sm_scale
         if softcap:  # chain rule through s = cap*tanh(s_raw/cap): d/ds_raw = 1 - t^2
             ds = ds * (1.0 - t * t)
         ds = ds.astype(k.dtype)
         dq_acc[:] += jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
+
+    @pl.when(jnp.logical_and(needed, interior))
+    def _compute_fast():
+        _compute(None)
+
+    @pl.when(jnp.logical_and(needed, jnp.logical_not(interior)))
+    def _compute_masked():
+        _compute(_tile_mask(
+            causal=causal, window=window, has_segments=has_segments, kv_pad=kv_pad,
+            block_q=block_q, block_k=block_k, q_global=q_global, k_global=k_global,
+            k_local=k_start, kv_len=kv_len, q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref,
+        ))
 
     @pl.when(j == nk - 1)
     def _finalize():
@@ -317,12 +408,14 @@ def _bwd_dq_kernel(
 
 def _bwd_dkv_kernel(
     q_off_ref, kv_off_ref, *refs,
-    sm_scale, causal, block_q, block_k, kv_len, q_len, nq, has_segments, window, softcap,
+    sm_scale, causal, block_q, block_k, kv_len, kv_pad, q_len, q_pad, nq,
+    has_segments, window, softcap,
 ):
     if has_segments:
         (q_seg_ref, kv_seg_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dk_ref, dv_ref, dk_acc, dv_acc) = refs
     else:
+        q_seg_ref = kv_seg_ref = None
         (q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
          dk_ref, dv_ref, dk_acc, dv_acc) = refs
     j = pl.program_id(2)  # kv block (outer)
@@ -341,22 +434,27 @@ def _bwd_dkv_kernel(
     k_start = j * block_k
     q_off = q_off_ref[0, 0]
     kv_off = kv_off_ref[0, 0]
+    q_global = q_off + q_start
+    k_global = kv_off + k_start
     needed = jnp.logical_or(
-        jnp.asarray(not causal),
-        q_off + q_start + block_q - 1 >= kv_off + k_start,
+        jnp.asarray(not causal), q_global + block_q - 1 >= k_global
     )
     if window:
-        needed = jnp.logical_and(
-            needed, kv_off + k_start + block_k - 1 > q_off + q_start - window
-        )
+        needed = jnp.logical_and(needed, k_global + block_k - 1 > q_global - window)
+    # Padded q rows (q_pad) matter here: ds/p for padded rows must be zero before they
+    # accumulate into dk/dv, so those tiles are never "interior".
+    interior = jnp.asarray(not (has_segments or kv_pad or q_pad))
+    if causal:
+        interior = jnp.logical_and(interior, k_global + block_k - 1 <= q_global)
+    if window:
+        interior = jnp.logical_and(interior, k_global > q_global + block_q - 1 - window)
 
-    @pl.when(needed)
-    def _compute():
+    def _compute(mask):
         q = q_ref[0, 0]
         k = k_ref[0, 0]
         v = v_ref[0, 0]
         do = do_ref[0, 0]
-        lse = lse_ref[0, 0]
+        lse = lse_ref[0, 0]                    # [block_q, LANES]
         delta = delta_ref[0, 0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
@@ -364,18 +462,9 @@ def _bwd_dkv_kernel(
         if softcap:
             t = jnp.tanh(s / softcap)
             s = softcap * t
-        col_local = k_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
-        row_local = q_start + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
-        mask = jnp.logical_and(col_local < kv_len, row_local < q_len)
-        if causal:
-            mask = jnp.logical_and(mask, kv_off + col_local <= q_off + row_local)
-        if window:
-            mask = jnp.logical_and(mask, kv_off + col_local > q_off + row_local - window)
-        if has_segments:
-            sq = q_seg_ref[0][:, None]
-            sk = kv_seg_ref[0][None, :]
-            mask = jnp.logical_and(mask, jnp.logical_and(sq == sk, sk != 0))
-        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        p = jnp.exp(s - _lane_tile(lse, block_k))
+        if mask is not None:
+            p = jnp.where(mask, p, 0.0)
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -383,7 +472,7 @@ def _bwd_dkv_kernel(
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
         )
-        ds = p * (dp - delta) * sm_scale
+        ds = p * (dp - _lane_tile(delta, block_k)) * sm_scale
         if softcap:  # chain rule through s = cap*tanh(s_raw/cap)
             ds = ds * (1.0 - t * t)
         ds = ds.astype(q.dtype)
@@ -391,10 +480,38 @@ def _bwd_dkv_kernel(
             ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
         )
 
+    def _mask_with_qpad():
+        mask = _tile_mask(
+            causal=causal, window=window, has_segments=has_segments, kv_pad=kv_pad,
+            block_q=block_q, block_k=block_k, q_global=q_global, k_global=k_global,
+            k_local=k_start, kv_len=kv_len, q_seg_ref=q_seg_ref, kv_seg_ref=kv_seg_ref,
+        )
+        if q_pad:
+            row_local = q_start + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            qmask = row_local < q_len
+            mask = qmask if mask is None else jnp.logical_and(mask, qmask)
+        return mask
+
+    @pl.when(jnp.logical_and(needed, interior))
+    def _compute_fast():
+        _compute(None)
+
+    @pl.when(jnp.logical_and(needed, jnp.logical_not(interior)))
+    def _compute_masked():
+        _compute(_mask_with_qpad())
+
     @pl.when(g == ni - 1)
     def _finalize():
         dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
         dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _rep_lanes(x, Sp):
+    """[B,H,S] fp32 → [B,H,Sp,_LANES] lane-replicated (for in-kernel full-register math)."""
+    x = _pad_seq(x[..., None], Sp)
+    return jnp.broadcast_to(x, (*x.shape[:3], _LANES))
 
 
 def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
@@ -410,8 +527,8 @@ def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpr
     Sp, Tp = nq * block_q, nk * block_k
     qp, dop = _pad_seq(q, Sp), _pad_seq(do, Sp)
     kp, vp = _pad_seq(k, Tp), _pad_seq(v, Tp)
-    lsep = _pad_seq(lse[..., None], Sp)
-    deltap = _pad_seq(delta[..., None], Sp)
+    lsep = _rep_lanes(lse, Sp)
+    deltap = _rep_lanes(delta, Sp)
     has_segments = segments is not None
     seg_specs, seg_args = [], []
     if has_segments:
@@ -424,8 +541,9 @@ def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpr
     kernel = functools.partial(
         _bwd_dq_kernel,
         sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k, kv_len=T,
-        has_segments=has_segments, window=window, softcap=softcap,
+        kv_pad=(Tp != T), has_segments=has_segments, window=window, softcap=softcap,
     )
+    dot_flops = 8 * B * H * Sp * Tp * hd * (0.5 if causal else 1.0)
     dq = pl.pallas_call(
         kernel,
         grid=(B, H, nq, nk),
@@ -437,13 +555,20 @@ def _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpr
             pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h // reps, j, 0)),
             pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h // reps, j, 0)),
             pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANES), lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0)),
         out_shape=jax.ShapeDtypeStruct((B, H, Sp, hd), jnp.float32),
         scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
         compiler_params=_dim_semantics(3, 1),
+        cost_estimate=_cost(
+            dot_flops,
+            (qp.size + dop.size) * q.dtype.itemsize
+            + (kp.size + vp.size) * k.dtype.itemsize * reps
+            + B * H * Sp * hd * 4,
+            B * H * Sp * Tp * (0.5 if causal else 1.0),
+        ),
         interpret=interpret,
     )(_scalar(q_offset), _scalar(kv_offset), *seg_args, qp, kp, vp, dop, lsep, deltap)
     return dq[:, :, :S]
@@ -465,8 +590,8 @@ def _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interp
     Sp, Tp = nq * block_q, nk * block_k
     qp, dop = _pad_seq(q, Sp), _pad_seq(do, Sp)
     kp, vp = _pad_seq(k, Tp), _pad_seq(v, Tp)
-    lsep = _pad_seq(lse[..., None], Sp)
-    deltap = _pad_seq(delta[..., None], Sp)
+    lsep = _rep_lanes(lse, Sp)
+    deltap = _rep_lanes(delta, Sp)
     has_segments = segments is not None
     seg_specs, seg_args = [], []
     if has_segments:
@@ -480,9 +605,10 @@ def _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interp
     kernel = functools.partial(
         _bwd_dkv_kernel,
         sm_scale=sm_scale, causal=causal, block_q=block_q, block_k=block_k,
-        kv_len=T, q_len=S, nq=nq,
+        kv_len=T, kv_pad=(Tp != T), q_len=S, q_pad=(Sp != S), nq=nq,
         has_segments=has_segments, window=window, softcap=softcap,
     )
+    dot_flops = 10 * B * H * Sp * Tp * hd * (0.5 if causal else 1.0)
     dk, dv = pl.pallas_call(
         kernel,
         grid=(B, K, nk, reps * nq),
@@ -494,8 +620,8 @@ def _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interp
             pl.BlockSpec((1, 1, block_k, hd), lambda b, kh, j, g: (b, kh, j, 0)),
             pl.BlockSpec((1, 1, block_k, hd), lambda b, kh, j, g: (b, kh, j, 0)),
             pl.BlockSpec((1, 1, block_q, hd), lambda b, kh, j, g: (b, kh * reps + g // nq, g % nq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, kh, j, g: (b, kh * reps + g // nq, g % nq, 0)),
-            pl.BlockSpec((1, 1, block_q, 1), lambda b, kh, j, g: (b, kh * reps + g // nq, g % nq, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANES), lambda b, kh, j, g: (b, kh * reps + g // nq, g % nq, 0)),
+            pl.BlockSpec((1, 1, block_q, _LANES), lambda b, kh, j, g: (b, kh * reps + g // nq, g % nq, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, hd), lambda b, kh, j, g: (b, kh, j, 0)),
@@ -510,6 +636,13 @@ def _bwd_dkv(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interp
             pltpu.VMEM((block_k, hd), jnp.float32),
         ],
         compiler_params=_dim_semantics(3, 1),
+        cost_estimate=_cost(
+            dot_flops,
+            (qp.size + dop.size) * q.dtype.itemsize
+            + (kp.size + vp.size) * k.dtype.itemsize
+            + 2 * B * K * Tp * hd * 4,
+            B * H * Sp * Tp * (0.5 if causal else 1.0),
+        ),
         interpret=interpret,
     )(_scalar(q_offset), _scalar(kv_offset), *seg_args, qp, kp, vp, dop, lsep, deltap)
     return dk[:, :, :T], dv[:, :, :T]

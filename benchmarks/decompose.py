@@ -72,14 +72,15 @@ def main() -> int:
     n_params = llama.num_params(cfg)
     rr = RowRunner()
 
-    def flops_row(name, fn, flops, *args):
-        def thunk():
-            dt = timed(fn, *args)
-            tf = flops / dt / 1e12
-            print(f"{name:18s} {dt*1e3:9.2f} ms   {tf:8.2f} TFLOP/s", flush=True)
-            return {"ms": round(dt * 1e3, 2), "tflops": round(tf, 2)}
+    def measure_flops(name, fn, flops, *args):
+        """Shared timing/record recipe for every TFLOP/s row (keep the schema in ONE place)."""
+        dt = timed(fn, *args)
+        tf = flops / dt / 1e12
+        print(f"{name:18s} {dt*1e3:9.2f} ms   {tf:8.2f} TFLOP/s", flush=True)
+        return {"ms": round(dt * 1e3, 2), "tflops": round(tf, 2)}
 
-        rr.row(name, thunk)
+    def flops_row(name, fn, flops, *args):
+        rr.row(name, lambda: measure_flops(name, fn, flops, *args))
 
     # --- matmul peak: k chained [M,M]x[M,M] bf16 matmuls
     M = 256 if smoke else 8192
@@ -94,10 +95,7 @@ def main() -> int:
                 a = a @ w
             return a
 
-        dt = timed(chain, a, w)
-        tf = 8 * 2 * M * M * M / dt / 1e12
-        print(f"{'matmul_peak':18s} {dt*1e3:9.2f} ms   {tf:8.2f} TFLOP/s", flush=True)
-        return {"ms": round(dt * 1e3, 2), "tflops": round(tf, 2)}
+        return measure_flops("matmul_peak", chain, 8 * 2 * M * M * M, a, w)
 
     rr.row("matmul_peak", matmul_peak)
 
@@ -201,6 +199,25 @@ def main() -> int:
         flops_row("attn_xla_bwd",
                   jax.jit(jax.grad(lambda q, k, v: llama._attention_xla(q, k, v, mask, cfg).astype(jnp.float32).sum(), argnums=(0, 1, 2))),
                   attn_flops * 2 * 3, q, k, v)
+
+        if not smoke:
+            # A/B comparator: the official jax pallas flash kernel at the same shapes.
+            # If this row is fast while attn_flash_fwd is slow, our kernel structure is
+            # the problem; if both are slow, it's the chip/tunnel environment. (The
+            # official kernel has no GQA — repeat kv heads for the measurement only.)
+            def jaxref():
+                from jax.experimental.pallas.ops.tpu.flash_attention import (
+                    BlockSizes, flash_attention as jax_flash)
+
+                qh = q.transpose(0, 2, 1, 3)                       # [B,H,S,hd]
+                kh = jnp.repeat(k.transpose(0, 2, 1, 3), H // K, axis=1)
+                vh = jnp.repeat(v.transpose(0, 2, 1, 3), H // K, axis=1)
+                bs = BlockSizes.get_default(B, H, S, S, hd)
+                f = jax.jit(lambda q, k, v: jax_flash(
+                    q, k, v, causal=True, sm_scale=1.0, block_sizes=bs))
+                return measure_flops("attn_jaxref_fwd", f, attn_flops, qh, kh, vh)
+
+            rr.row("attn_jaxref_fwd", jaxref)
 
     rr.section("attn_setup", attn_rows)
 

@@ -49,8 +49,9 @@ CONFIGS = [
     # --- round-2 second wave: optimizer attribution + combos on the best tuning row.
     # decompose/step_attrib localized ~790 ms/step outside fwd_bwd; BENCH_OPT rows measure
     # the optimizer's share directly on the real step (sgd ≈ no opt state, adafactor ≈
-    # factored state, mu_bf16 ≈ 25% less moment traffic). Optimizer rows are labeled
-    # distinctly and never auto-adopted.
+    # factored state, mu_bf16 ≈ 25% less moment traffic). Rule-changing optimizer rows
+    # are labeled distinctly and never auto-adopted; fused_adamw (identical AdamW math
+    # as a Pallas kernel) is the one adoptable exception — see bench._ADOPTABLE_VALUES.
     ("opt_sgd", {"BENCH_OPT": "sgd"}),
     ("opt_mu_bf16", {"BENCH_OPT": "adamw_mu_bf16"}),
     ("opt_adafactor", {"BENCH_OPT": "adafactor"}),
@@ -82,6 +83,19 @@ CONFIGS = [
                           "ACCEL_FLASH_DIMSEM": "1"}),
     ("blocks512_fused_adamw", {"ACCEL_FLASH_BLOCK_Q": "512", "ACCEL_FLASH_BLOCK_K": "512",
                                "BENCH_OPT": "fused_adamw"}),
+    # --- round-3 wave: restructured flash kernel (lane-replicated softmax state,
+    # mask-free interior tiles, parallel grid semantics ON by default, cost estimates).
+    # dimsem_off measures the r2 behavior for A/B; the *_r3 combos stack the restructured
+    # kernel with the fused AdamW + fused CE levers at the two candidate tilings.
+    ("dimsem_off", {"ACCEL_FLASH_DIMSEM": "0"}),
+    ("r3_fused_all", {"BENCH_OPT": "fused_adamw", "BENCH_LOSS_IMPL": "fused"}),
+    ("r3_fused_all_blocks512", {"ACCEL_FLASH_BLOCK_Q": "512",
+                                "ACCEL_FLASH_BLOCK_K": "512",
+                                "BENCH_OPT": "fused_adamw", "BENCH_LOSS_IMPL": "fused"}),
+    ("r3_fused_all_b8", {"BENCH_B": "8", "BENCH_OPT": "fused_adamw",
+                         "BENCH_LOSS_IMPL": "fused"}),
+    ("r3_fused_all_mu_bf16", {"BENCH_OPT": "fused_adamw_mu_bf16",
+                              "BENCH_LOSS_IMPL": "fused"}),
 ]
 
 

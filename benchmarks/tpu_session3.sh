@@ -1,0 +1,40 @@
+#!/bin/bash
+# Third TPU work session (round 3): restructured-flash + fused-kernel measurements.
+# Chained behind inference_session.sh (pass its PID as $1) the same way that session
+# chains behind tpu_session2.sh — never edit a running bash script.
+#
+# Ordered by value-per-chip-minute for a short tunnel window:
+#   1. the restructured-kernel A/B + fused-combo sweep rows (the r3 levers)
+#   2. immediate adopt-best scoring run (locks any win into BENCH_SELF.json)
+#   3. decompose2 (now includes attn_jaxref_fwd comparator + fused opt/xent rows)
+#   4. step_attrib2 (facade-level fused-AdamW/fused-CE rows)
+#   5. final adopt-best scoring run with profile trace
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (inference session) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 60; done
+fi
+
+echo "=== waiting for TPU ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+
+echo "=== 1. r3 kernel + fused-combo rows ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
+  --only r3_fused_all,r3_fused_all_blocks512,dimsem_off,r3_fused_all_b8,r3_fused_all_mu_bf16,blocks_512x512,baseline_b4_flash_full_f4
+
+echo "=== 2. early adopt-best scoring run ==="
+timeout 900 python bench.py
+
+echo "=== 3. decompose (kernel isolation + jaxref A/B) ==="
+timeout 1800 python benchmarks/decompose.py > decompose3.json 2>decompose3.err
+echo "decompose rc=$?"; tail -1 decompose3.json | head -c 400
+
+echo "=== 4. step_attrib (facade fused rows) ==="
+timeout 1800 python benchmarks/step_attrib.py > step_attrib3.json 2>step_attrib3.err
+echo "step_attrib rc=$?"; tail -1 step_attrib3.json | head -c 400
+
+echo "=== 5. final adopt-best scoring run (with profile trace) ==="
+BENCH_PROFILE=bench_trace timeout 900 python bench.py
+echo "=== session3 done ==="
