@@ -66,10 +66,6 @@ def prepare_pippy(
     n_stages = mesh.shape[PIPELINE_AXIS]
     if split_points != "auto":
         raise ValueError("only split_points='auto' (even layer split) is supported")
-    if getattr(cfg, "moe_experts", 0) > 0:
-        # Fail BEFORE stage-stacking + device_put commits HBM for every expert weight
-        # (forward_pp would reject it anyway, but only at first call).
-        raise NotImplementedError("pipeline inference currently supports dense MLPs only")
 
     if not cfg.scan_layers:
         cfg = dataclasses.replace(cfg, scan_layers=True)

@@ -357,13 +357,18 @@ def partition_specs(cfg: LlamaConfig, pp: bool = False) -> dict:
     # auto-composition would pick) makes the token-lookup gather unshardable — XLA's SPMD
     # partitioner falls back to "involuntary full rematerialization" (replicate + repartition)
     # on every embedding lookup under a dp×fsdp×tp×sp mesh.
+    # Under pp, fold the pipeline axis into the same vocab sharding: embed/head sit
+    # OUTSIDE the pipeline (every stage runs them), and replicating the untied head costs
+    # ~1 GB/device at 8B scale — vocab-sharding over pp makes them cost HBM like one
+    # shard, with GSPMD inserting the gather/psum at the lookup / logits matmul.
+    vocab_axes = (TENSOR_AXIS, FSDP_AXIS, PIPELINE_AXIS) if pp else (TENSOR_AXIS, FSDP_AXIS)
     specs = {
-        "embed": P((TENSOR_AXIS, FSDP_AXIS), None),
+        "embed": P(vocab_axes, None),
         "layers": layers,
         "ln_f": P(),
     }
     if not cfg.tie_embeddings:
-        specs["lm_head"] = P(None, (TENSOR_AXIS, FSDP_AXIS))
+        specs["lm_head"] = P(None, vocab_axes)
     return specs
 
 
@@ -952,23 +957,32 @@ def forward_pp(
     mesh,
     num_microbatches: Optional[int] = None,
     shard_activations: bool = True,
-) -> jax.Array:
+    return_aux: bool = False,
+):
     """Causal LM forward with the transformer blocks run as a GPipe pipeline over ``pp``.
 
     ``params["layers"]`` must be stage-stacked ``[n_stages, L/n, ...]`` (scan_layers params
     through ``parallel.pp.split_params_into_stages``; specs from ``partition_specs(cfg,
-    pp=True)``). Embed and head run outside the pipeline on every device (cheap vs blocks).
-    The whole schedule is one differentiable scan, so the same function trains — unlike the
-    reference, whose pipelining is inference-only (``inference.py:82-121``).
-    MoE aux losses are not collected on this path (dense MLP configs only for now).
+    pp=True)``). Embed and head run outside the pipeline on every device (vocab-dim sharded
+    over pp×tp by ``partition_specs(pp=True)`` so they cost HBM like one shard, not one
+    replica). The whole schedule is one differentiable scan, so the same function trains —
+    unlike the reference, whose pipelining is inference-only (``inference.py:82-121``).
+
+    MoE configs run through the pipeline too (the reference's engine runs MoE models,
+    ``/root/reference/src/accelerate/utils/dataclasses.py:1105``): the expert dispatch
+    lives inside the stage body with ``ep``/``tp`` left to GSPMD (the pp shard_map is
+    manual over ``pp`` only), and per-(stage, microbatch) load-balancing aux losses are
+    masked to real ticks and summed across the pipeline. Routing/capacity are
+    per-microbatch, so MoE aux/dropping match a non-pipelined run only in the no-drop
+    regime (capacity_factor high enough) — same caveat as any GPipe MoE.
+    Returns hidden states [B, S, D]; MoE aux is returned when ``return_aux``.
     """
     from ..parallel.pp import make_pipeline_fn
 
-    if cfg.moe_experts > 0:
-        raise NotImplementedError("pipeline parallelism currently supports dense MLPs only")
     B, S = tokens.shape
     dtype = cfg.dtype
     block = _maybe_remat_block(cfg)
+    is_moe = cfg.moe_experts > 0
 
     def stage_fn(stage_layers, x):
         # x: one microbatch [B_m, S, D]; positions/mask rebuilt locally (identical rows).
@@ -976,18 +990,36 @@ def forward_pp(
         mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
 
         def body(carry, layer):
-            out, _ = block(carry, layer, pos, mask, cfg)
-            return out, None
+            out, aux = block(carry, layer, pos, mask, cfg)
+            return out, aux
 
-        out, _ = jax.lax.scan(body, x, stage_layers)
+        out, auxes = jax.lax.scan(body, x, stage_layers)
+        if is_moe:
+            return out, jnp.sum(auxes)
         return out
 
     x = params["embed"].astype(dtype)[tokens]
     if shard_activations:
         x = _maybe_shard(x, P(BATCH_AXES, None, None))
-    pipe = make_pipeline_fn(mesh, stage_fn, num_microbatches=num_microbatches)
-    x = pipe(params["layers"], x)
-    x = _rms_norm(x, params["ln_f"], cfg.norm_eps)
+    pipe = make_pipeline_fn(
+        mesh, stage_fn, num_microbatches=num_microbatches, with_aux=is_moe
+    )
+    if is_moe:
+        x, aux = pipe(params["layers"], x)
+        # load_balancing_loss is a batch-size-invariant MEAN statistic (~1 at balance):
+        # the pipeline sums one value per (stage, microbatch), so divide by M to keep
+        # moe_aux_weight meaning the same thing as the non-pipelined path — otherwise
+        # retuning num_microbatches (a throughput knob) would silently rescale the
+        # training objective.
+        from ..utils.constants import PIPELINE_AXIS as _PP
+
+        M = num_microbatches if num_microbatches is not None else mesh.shape[_PP]
+        aux = aux / M
+    else:
+        x, aux = pipe(params["layers"], x), jnp.zeros((), jnp.float32)
+    x = _rms_norm(x, params["ln_f"], cfg.norm_eps, cfg.norm_plus_one)
+    if return_aux:
+        return x, aux
     return x
 
 
@@ -1013,8 +1045,13 @@ def loss_fn_pp(
         if "mask" in batch
         else jnp.ones((B, S), jnp.float32)
     )
-    x = forward_pp(params, inputs, cfg, mesh, num_microbatches=num_microbatches)
-    return _ce_from_hidden(x, params, targets, mask, cfg)
+    x, aux = forward_pp(
+        params, inputs, cfg, mesh, num_microbatches=num_microbatches, return_aux=True
+    )
+    ce = _ce_from_hidden(x, params, targets, mask, cfg)
+    if cfg.moe_experts > 0:
+        return ce + cfg.moe_aux_weight * aux
+    return ce
 
 
 @partial(jax.jit, static_argnames=("cfg",))

@@ -58,19 +58,25 @@ def split_params_into_stages(layer_params: Any, n_stages: int) -> Any:
 
 
 def pipeline_apply(
-    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_fn: Callable[[Any, jax.Array], Any],
     stage_params: Any,
     microbatches: jax.Array,
     axis_name: str = PIPELINE_AXIS,
+    with_aux: bool = False,
 ):
     """Run the GPipe schedule inside shard_map.
 
     - ``stage_fn(params_for_one_stage, x) -> y`` with y.shape == x.shape (inter-stage
-      activations must be shape-stable; wrap embed/head outside the pipeline).
+      activations must be shape-stable; wrap embed/head outside the pipeline). With
+      ``with_aux``, stage_fn returns ``(y, aux_scalar)`` (e.g. MoE load-balancing loss)
+      and the pipeline returns ``(out, aux_total)``.
     - ``stage_params``: local slice, leading dim 1 (shard_map over P('pp', ...)).
     - ``microbatches``: [M, B_m, ...] replicated across pp.
 
-    Returns [M, B_m, ...] outputs (replicated across pp after a masked psum).
+    Returns [M, B_m, ...] outputs (replicated across pp after a masked psum). Aux values
+    from bubble ticks (a stage computing on garbage before its first / after its last real
+    microbatch) are masked out before the cross-stage psum, so ``aux_total`` sums exactly
+    the M · n_stages real (microbatch, stage) pairs.
     """
     idx = lax.axis_index(axis_name)
     n = lax.axis_size(axis_name)
@@ -80,13 +86,21 @@ def pipeline_apply(
 
     x0 = jnp.zeros_like(microbatches[0])
     out_buf0 = jnp.zeros_like(microbatches)
+    aux0 = jnp.zeros((), jnp.float32)
 
     def tick(carry, t):
-        recv, out_buf = carry
+        recv, out_buf, aux_acc = carry
         # Stage 0 ingests microbatch t (clamped; masked out-of-range ticks are dead compute).
         ingest = microbatches[jnp.clip(t, 0, M - 1)]
         x = jnp.where(idx == 0, ingest, recv)
-        y = stage_fn(local_params, x)
+        if with_aux:
+            y, aux = stage_fn(local_params, x)
+            # Stage idx works on microbatch (t - idx); only in-range ticks are real work.
+            mb = t - idx
+            live = jnp.logical_and(mb >= 0, mb < M)
+            aux_acc = aux_acc + jnp.where(live, aux.astype(jnp.float32), 0.0)
+        else:
+            y = stage_fn(local_params, x)
         # Last stage banks microbatch (t - n + 1) when valid.
         out_t = t - (n - 1)
         valid = jnp.logical_and(idx == n - 1, jnp.logical_and(out_t >= 0, out_t < M))
@@ -96,21 +110,27 @@ def pipeline_apply(
             out_buf,
         )
         nxt = lax.ppermute(y, axis_name, perm)
-        return (nxt, out_buf), None
+        return (nxt, out_buf, aux_acc), None
 
-    (last, out_buf), _ = lax.scan(tick, (x0, out_buf0), jnp.arange(M + n - 1))
+    (last, out_buf, aux_acc), _ = lax.scan(
+        tick, (x0, out_buf0, aux0), jnp.arange(M + n - 1)
+    )
     # Replicate the last stage's banked outputs to every stage.
     out = lax.psum(jnp.where(idx == n - 1, out_buf, jnp.zeros_like(out_buf)), axis_name)
+    if with_aux:
+        return out, lax.psum(aux_acc, axis_name)
     return out
 
 
 def make_pipeline_fn(
     mesh,
-    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_fn: Callable[[Any, jax.Array], Any],
     axis_name: str = PIPELINE_AXIS,
     num_microbatches: Optional[int] = None,
+    with_aux: bool = False,
 ):
-    """GSPMD-embeddable pipeline: ``fn(stacked_stage_params, x [B, ...]) -> y [B, ...]``.
+    """GSPMD-embeddable pipeline: ``fn(stacked_stage_params, x [B, ...]) -> y [B, ...]``
+    (``(y, aux_total)`` with ``with_aux`` — see ``pipeline_apply``).
 
     Splits the batch into microbatches, runs the GPipe schedule manual-over-``pp`` only
     (other mesh axes stay auto), and reassembles. ``stacked_stage_params`` leading dim =
@@ -128,13 +148,18 @@ def make_pipeline_fn(
 
         specs_params = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
         mapped = jax.shard_map(
-            functools.partial(pipeline_apply, stage_fn, axis_name=axis_name),
+            functools.partial(
+                pipeline_apply, stage_fn, axis_name=axis_name, with_aux=with_aux
+            ),
             mesh=mesh,
             in_specs=(specs_params, P()),
-            out_specs=P(),
+            out_specs=(P(), P()) if with_aux else P(),
             axis_names={axis_name},
             check_vma=False,
         )
+        if with_aux:
+            out, aux = mapped(stage_params, mb)
+            return out.reshape(B, *out.shape[2:]), aux
         out = mapped(stage_params, mb)
         return out.reshape(B, *out.shape[2:])
 
