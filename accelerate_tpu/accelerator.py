@@ -343,8 +343,21 @@ class Accelerator:
 
         if ddp_kwargs is not None and ddp_kwargs.reduce_dtype is not None:
             # DDP comm_hook analog: compress cross-device gradient reductions.
+            # build_train_step only honors it when it EQUALS the compute dtype (the
+            # compressed reduce is exact there); per this handler's own
+            # accepted-but-ignored-is-worse-than-an-error policy, any other combination
+            # raises instead of silently running uncompressed.
             import dataclasses as _dc
 
+            compute_dtype = self.state.mixed_precision_policy.compute_dtype
+            if ddp_kwargs.reduce_dtype != compute_dtype:
+                raise ValueError(
+                    f"DistributedDataParallelKwargs comm_hook compression dtype "
+                    f"{ddp_kwargs.reduce_dtype.__name__} does not match the mixed-"
+                    f"precision compute dtype {compute_dtype.__name__}: the hook would "
+                    "be accepted but never applied. Use the comm_hook matching "
+                    "mixed_precision (bf16 ↔ 'bf16'), or drop the handler."
+                )
             self.state.mixed_precision_policy = _dc.replace(
                 self.state.mixed_precision_policy, reduce_dtype=ddp_kwargs.reduce_dtype
             )
@@ -463,6 +476,22 @@ class Accelerator:
         if env_mb:
             return int(env_mb)
         return self.mesh.shape[PIPELINE_AXIS]
+
+    @property
+    def pp_schedule(self) -> str:
+        """Pipeline schedule from the plugin ("gpipe" | "1f1b") — pass to the model's
+        ``loss_fn_pp(..., schedule=accelerator.pp_schedule)`` so
+        ``PipelineParallelPlugin(schedule=...)`` actually takes effect; env override
+        ACCELERATE_PP_SCHEDULE mirrors the launcher protocol."""
+        env_s = os.environ.get("ACCELERATE_PP_SCHEDULE")
+        if env_s:
+            if env_s not in ("gpipe", "1f1b"):
+                raise ValueError(
+                    f"ACCELERATE_PP_SCHEDULE={env_s!r}: expected 'gpipe' or '1f1b'"
+                )
+            return env_s
+        plugin = self.state.pp_plugin
+        return plugin.schedule if plugin is not None else "gpipe"
 
     @property
     def gradient_accumulation_steps(self) -> int:
@@ -942,15 +971,12 @@ class Accelerator:
                     if fused_specs is None:
                         fused_opt = None
                 elif self._params_cross_sharded is None:
-                    # User-managed TrainState (no create_train_state record): only run the
-                    # unmapped kernel when no multi-device sharding machinery could have
-                    # produced cross-device leaves.
-                    if (
-                        self.mesh is not None
-                        and self.mesh.size > 1
-                        and plugin is not None
-                        and plugin.shards_params
-                    ):
+                    # User-managed TrainState (no create_train_state record): the layout
+                    # is unknown, so on ANY multi-device mesh assume leaves may be
+                    # cross-device sharded (manual NamedShardings, TP without the plugin,
+                    # ...) and fall back to tx.update — an unmapped pallas_call would
+                    # force GSPMD to gather the full param+moment trees onto one device.
+                    if self.mesh is not None and self.mesh.size > 1:
                         fused_opt = None
             grad_scale = None
             if max_grad_norm is not None:

@@ -805,11 +805,46 @@ def _ce_from_hidden(x, params, targets, mask, cfg: LlamaConfig) -> jax.Array:
     S = x.shape[1]
     denom = jnp.maximum(mask.sum(), 1.0)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    if cfg.loss_impl not in ("auto", "fused", "fused_dp"):
+    if cfg.loss_impl not in ("auto", "fused", "fused_dp", "fused_tp"):
         raise ValueError(
-            f"loss_impl={cfg.loss_impl!r}: expected 'auto', 'fused', or 'fused_dp' "
-            "(a typo would otherwise silently run the chunked path)"
+            f"loss_impl={cfg.loss_impl!r}: expected 'auto', 'fused', 'fused_dp', or "
+            "'fused_tp' (a typo would otherwise silently run the chunked path)"
         )
+    if cfg.loss_impl == "fused_tp":
+        # Megatron-layout fused CE: the head stays VOCAB-SHARDED over tp (never
+        # gathered), each tp shard runs the Pallas kernel on its vocab slice, and the
+        # logsumexp merges across tp in fp32 (ops/fused_xent.fused_cross_entropy_tp).
+        # Tokens stay sharded over the batch axes. For batch-only layouts use
+        # "fused_dp"; single device "fused".
+        from jax.sharding import get_abstract_mesh
+
+        from ..ops.fused_xent import fused_cross_entropy_tp
+        from ..utils.constants import BATCH_AXES, TENSOR_AXIS as _TP
+
+        mesh = get_abstract_mesh()
+        if not getattr(mesh, "axis_names", ()):
+            raise ValueError(
+                "loss_impl='fused_tp' needs an active mesh context "
+                "(Accelerator.build_train_step provides one; or wrap in jax.set_mesh)."
+            )
+        D = x.shape[-1]
+
+        def _local(xl, tl, ml, hd):
+            Bl = xl.shape[0]
+            nll = fused_cross_entropy_tp(
+                xl.reshape(Bl * S, D), hd, tl.reshape(Bl * S), axis_name=_TP,
+                softcap=cfg.final_softcap,
+            )
+            return (nll * ml.reshape(Bl * S)).sum()[None]
+
+        partials = jax.shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=(P(BATCH_AXES), P(BATCH_AXES), P(BATCH_AXES), P(None, _TP)),
+            out_specs=P(BATCH_AXES),
+            check_vma=False,  # pallas_call outputs carry no vma info (kernel contract)
+        )(x, targets, mask, head.astype(cfg.dtype))
+        return partials.sum() / denom
     if cfg.loss_impl == "fused_dp":
         # Multi-chip fused CE: shard_map over the batch axes — each device runs the
         # kernel on ITS tokens against a replicated head (in_spec P() makes shard_map's
@@ -950,6 +985,29 @@ def perplexity(
 
 
 # --------------------------------------------------------------- pipeline-parallel training
+def _pp_stage_fn(cfg: LlamaConfig, S: int, with_aux: bool):
+    """One pipeline stage body, shared by the GPipe (forward_pp) and 1F1B (loss_fn_pp)
+    schedules so their numerics cannot drift: scan this stage's blocks over one
+    microbatch [B_m, S, D], positions/causal mask rebuilt locally (identical rows).
+    ``with_aux`` returns the stage's summed MoE aux alongside the activation."""
+    block = _maybe_remat_block(cfg)
+
+    def stage_fn(stage_layers, x):
+        pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (x.shape[0], S))
+        mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
+
+        def body(carry, layer):
+            out, aux = block(carry, layer, pos, mask, cfg)
+            return out, aux
+
+        out, auxes = jax.lax.scan(body, x, stage_layers)
+        if with_aux:
+            return out, jnp.sum(auxes)
+        return out
+
+    return stage_fn
+
+
 def forward_pp(
     params: dict,
     tokens: jax.Array,
@@ -981,22 +1039,8 @@ def forward_pp(
 
     B, S = tokens.shape
     dtype = cfg.dtype
-    block = _maybe_remat_block(cfg)
     is_moe = cfg.moe_experts > 0
-
-    def stage_fn(stage_layers, x):
-        # x: one microbatch [B_m, S, D]; positions/mask rebuilt locally (identical rows).
-        pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (x.shape[0], S))
-        mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
-
-        def body(carry, layer):
-            out, aux = block(carry, layer, pos, mask, cfg)
-            return out, aux
-
-        out, auxes = jax.lax.scan(body, x, stage_layers)
-        if is_moe:
-            return out, jnp.sum(auxes)
-        return out
+    stage_fn = _pp_stage_fn(cfg, S, with_aux=is_moe)
 
     x = params["embed"].astype(dtype)[tokens]
     if shard_activations:
@@ -1023,6 +1067,24 @@ def forward_pp(
     return x
 
 
+def _head_ce_sum(hp: dict, y: jax.Array, ex: dict, cfg: LlamaConfig) -> jax.Array:
+    """SUM-style ln_f + CE head over one microbatch (the 1F1B last-stage loss):
+    ``hp = {"ln_f", "head" [D, V]}``, ``ex = {"targets", "mask"}``. Sums across
+    microbatches add up to the full-batch numerator; normalization stays outside."""
+    x = _rms_norm(y, hp["ln_f"], cfg.norm_eps, cfg.norm_plus_one)
+    chunk = _loss_chunk_size(cfg, x.shape[1])
+    if chunk > 0:
+        return _chunked_ce(
+            x, hp["head"], ex["targets"], ex["mask"], chunk, cfg.dtype,
+            final_softcap=cfg.final_softcap,
+        )
+    logits = (x @ hp["head"].astype(cfg.dtype)).astype(jnp.float32)
+    logits = _softcap(logits, cfg.final_softcap)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, ex["targets"][..., None], axis=-1).squeeze(-1)
+    return -(ll * ex["mask"]).sum()
+
+
 def loss_fn_pp(
     params: dict,
     batch: dict,
@@ -1030,13 +1092,23 @@ def loss_fn_pp(
     mesh,
     num_microbatches: Optional[int] = None,
     rng: Optional[jax.Array] = None,
+    schedule: str = "gpipe",
 ) -> jax.Array:
     """Pipeline-parallel next-token cross-entropy (same contract as ``loss_fn``, except
-    sample packing: ``forward_pp`` has no segment-mask plumbing yet)."""
+    sample packing: ``forward_pp`` has no segment-mask plumbing yet).
+
+    ``schedule="1f1b"`` routes through ``parallel.pp.make_pipeline_loss_fn``: the custom
+    VJP's hand-scheduled one-forward-one-backward keeps in-flight activations bounded by
+    the stage count instead of ``num_microbatches`` (dense configs only; ln_f + the CE
+    head run inside the last stage's schedule)."""
     if "segment_ids" in batch:
         raise NotImplementedError(
             "sample packing (segment_ids) is not supported on the pipeline-parallel path"
         )
+    if schedule not in ("gpipe", "1f1b"):
+        # Mirrors PipelineParallelPlugin's validation: an unrecognized schedule (e.g. a
+        # typo'd ACCELERATE_PP_SCHEDULE) must not silently run GPipe.
+        raise ValueError(f"schedule={schedule!r}: expected 'gpipe' or '1f1b'")
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     B, S = inputs.shape
@@ -1045,6 +1117,28 @@ def loss_fn_pp(
         if "mask" in batch
         else jnp.ones((B, S), jnp.float32)
     )
+    if schedule == "1f1b":
+        if cfg.moe_experts > 0:
+            raise NotImplementedError(
+                "schedule='1f1b' supports dense configs only (MoE aux collection runs "
+                "on the GPipe path; pass schedule='gpipe')"
+            )
+        from ..parallel.pp import make_pipeline_loss_fn
+
+        dtype = cfg.dtype
+        stage_fn = _pp_stage_fn(cfg, S, with_aux=False)
+        head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+        hp = {"ln_f": params["ln_f"], "head": head}
+        pipe_loss = make_pipeline_loss_fn(
+            mesh, stage_fn, partial(_head_ce_sum, cfg=cfg),
+            num_microbatches=num_microbatches, schedule="1f1b",
+        )
+        x = params["embed"].astype(dtype)[inputs]
+        denom = jnp.maximum(mask.sum(), 1.0)
+        total = pipe_loss(
+            params["layers"], hp, x, {"targets": targets, "mask": mask}
+        )
+        return total / denom
     x, aux = forward_pp(
         params, inputs, cfg, mesh, num_microbatches=num_microbatches, return_aux=True
     )

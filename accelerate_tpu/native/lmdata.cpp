@@ -48,9 +48,14 @@ int64_t lm_gather(const int32_t* tokens, int64_t n_tokens, const int64_t* starts
     if (starts[b] < 0 || starts[b] + width > n_tokens) return -1;
   }
   const int64_t bytes = width * static_cast<int64_t>(sizeof(int32_t));
+  // Thread only when the copy is big enough to amortize spawn/join (~10s of us): for
+  // small batches or narrow windows the single-thread memcpy loop wins outright.
+  constexpr int64_t kMinBytesForThreads = 1 << 20;  // 1 MiB total
   const unsigned hw = std::thread::hardware_concurrency();
   const int64_t n_threads =
-      (batch >= 8 && hw > 1) ? std::min<int64_t>(batch, hw) : 1;
+      (batch >= 8 && hw > 1 && batch * bytes >= kMinBytesForThreads)
+          ? std::min<int64_t>(batch, hw)
+          : 1;
   if (n_threads == 1) {
     for (int64_t b = 0; b < batch; ++b) {
       std::memcpy(out + b * width, tokens + starts[b], bytes);

@@ -77,17 +77,32 @@ def _adamw_kernel(
 
 
 def _leaf_fused(p, m, v, g, scalars, *, b1, b2, eps, wd, block_rows, interpret):
-    """Run the kernel over one leaf reshaped to [rows, 1024]."""
+    """Run the kernel over one leaf reshaped to [rows, 1024].
+
+    Rows that don't divide by a near-``block_rows`` factor are PADDED up to a multiple
+    (the update math is elementwise, so padded rows compute garbage that is sliced off) —
+    the old largest-divisor rule degraded to block_rows=1 for prime row counts, turning
+    one launch into thousands of [1, 1024] grid steps."""
     shape, dtype = p.shape, p.dtype
     rows = p.size // _LANES
     br = min(block_rows, rows)
+    pad = 0
     while rows % br:  # largest divisor <= block_rows keeps the grid exact (no masking)
         br -= 1
-    grid = (rows // br,)
-    p2 = p.reshape(rows, _LANES)
-    m2 = m.reshape(rows, _LANES)
-    v2 = v.reshape(rows, _LANES)
-    g2 = g.reshape(rows, _LANES)
+    if br < min(block_rows, rows) // 4:
+        # No decent divisor (prime-ish rows): pad to a block_rows multiple instead.
+        br = min(block_rows, rows)
+        pad = (-rows) % br
+    grid = ((rows + pad) // br,)
+
+    def _prep(a):
+        a2 = a.reshape(rows, _LANES)
+        if pad:
+            a2 = jnp.pad(a2, ((0, pad), (0, 0)))
+        return a2
+
+    p2, m2, v2, g2 = _prep(p), _prep(m), _prep(v), _prep(g)
+    rows += pad
     kernel = functools.partial(_adamw_kernel, b1=b1, b2=b2, eps=eps, wd=wd)
     spec = pl.BlockSpec((br, _LANES), lambda i: (i, 0))
     po, mo, vo = pl.pallas_call(
@@ -108,6 +123,8 @@ def _leaf_fused(p, m, v, g, scalars, *, b1, b2, eps, wd, block_rows, interpret):
         ),
         interpret=interpret,
     )(scalars, p2, m2, v2, g2)
+    if pad:
+        po, mo, vo = po[:-pad], mo[:-pad], vo[:-pad]
     return po.reshape(shape), mo.reshape(shape), vo.reshape(shape)
 
 

@@ -1,34 +1,40 @@
-"""Pipeline parallelism over the ``pp`` mesh axis (GPipe schedule).
+"""Pipeline parallelism over the ``pp`` mesh axis (GPipe and 1F1B schedules).
 
 TPU-native analog of the reference's pipeline path (reference ``inference.py``: torch
 ``ScheduleGPipe`` :82-96, microbatch forward ``pippy_forward`` :99-121, split-point
 auto-balancing :164-168) — but usable for TRAINING too, which the reference never supports
 (its pipelining is inference-only).
 
-Formulation: SPMD circular pipeline. Stage params are stacked on a leading ``n_stages`` dim
-sharded over ``pp``; inside shard_map every device runs the same per-tick program for
-``M + n - 1`` ticks (M microbatches): stage 0 ingests microbatch t, others consume the
-activation ``ppermute``d from their predecessor; the last stage banks its outputs. Because the
-whole schedule is one differentiable ``lax.scan``, **jax AD derives the backward pipeline
-automatically** (activations rematerialized per ``jax.checkpoint`` policy), so the same
-machinery trains — the torch version needs a separate runtime for that.
+**GPipe** (``pipeline_apply`` / ``make_pipeline_fn``): SPMD circular pipeline. Stage params
+are stacked on a leading ``n_stages`` dim sharded over ``pp``; inside shard_map every device
+runs the same per-tick program for ``M + n - 1`` ticks (M microbatches): stage 0 ingests
+microbatch t, others consume the activation ``ppermute``d from their predecessor; the last
+stage banks its outputs. Because the whole schedule is one differentiable ``lax.scan``,
+**jax AD derives the backward pipeline automatically** (activations rematerialized per
+``jax.checkpoint`` policy), so the same machinery trains — the torch version needs a
+separate runtime for that. Bubble fraction is the GPipe (n-1)/(M+n-1); raise
+``num_microbatches`` to amortize — but jax AD runs ALL forwards before ANY backward, so the
+saved stage inputs grow with M and the bubble lever fights the memory ceiling.
 
-Bubble fraction is the GPipe (n-1)/(M+n-1); raise ``num_microbatches`` to amortize.
-
-Why no interleaved "virtual pipeline" (Megatron ``dataclasses.py:2024``) variant: its bubble
-reduction comes from 1F1B-interleaving forward and backward chunk work, which requires a
-hand-scheduled backward pipeline. Here the backward IS derived by jax AD from the forward
-scan — all forwards complete before backwards begin (GPipe semantics) — so holding v
-stage-chunks per device would add wraparound ppermutes without shrinking the bubble.
-The honest levers in this formulation are ``num_microbatches`` and remat policy; a manual
-1F1B would mean a custom VJP with its own reverse schedule (see
-``PipelineParallelPlugin.schedule`` which raises on "1f1b" for exactly this reason).
+**1F1B** (``make_pipeline_loss_fn(schedule="1f1b")``): the custom-VJP hand-scheduled
+variant (Megatron ``dataclasses.py:2024`` intent). The primal runs a cheap forward-only
+pipeline for the loss value, saving NO per-tick activations; the custom backward replays
+forward and backward TOGETHER under a statically simulated one-forward-one-backward
+schedule (``_simulate_1f1b``): each stage keeps at most ``n_stages + 2`` microbatch inputs
+in flight (vs M for AD-GPipe) and rematerializes its stage forward inside the per-tick VJP.
+Compute cost equals remat-full GPipe (2F + B per microbatch); the win is the activation
+ceiling, which is what lets M grow to amortize the bubble. The schedule tables (which
+stage forwards/backwards which microbatch at which tick, and when activations/grad
+cotangents arrive) are built in numpy at trace time, and the simulator *proves* the
+circular-buffer slots are collision-free before the scan is ever traced.
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Any, Callable, Optional
+from typing import Any, Callable, NamedTuple, Optional
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -37,7 +43,13 @@ from jax.sharding import PartitionSpec as P
 
 from ..utils.constants import PIPELINE_AXIS
 
-__all__ = ["pipeline_apply", "make_pipeline_fn", "stack_stage_params", "split_params_into_stages"]
+__all__ = [
+    "pipeline_apply",
+    "make_pipeline_fn",
+    "make_pipeline_loss_fn",
+    "stack_stage_params",
+    "split_params_into_stages",
+]
 
 
 def stack_stage_params(stage_param_list: list[Any]) -> Any:
@@ -164,3 +176,367 @@ def make_pipeline_fn(
         return out.reshape(B, *out.shape[2:])
 
     return fn
+
+
+# --------------------------------------------------------------------------- 1F1B schedule
+class _Schedule(NamedTuple):
+    """Static 1F1B schedule tables, all [T, n_stages] int32 with -1 = idle.
+
+    fwd[t, s]   — microbatch stage s FORWARDS at tick t (storing its input).
+    bwd[t, s]   — microbatch stage s BACKWARDS at tick t (VJP w/ remat of its forward).
+    arr_f[t, s] — microbatch whose activation (sent by s-1's forward at t-1) lands at s.
+    arr_b[t, s] — microbatch whose grad cotangent (sent by s+1's backward at t-1) lands.
+    """
+
+    fwd: np.ndarray
+    bwd: np.ndarray
+    arr_f: np.ndarray
+    arr_b: np.ndarray
+    n_buf: int
+    g_buf: int
+
+
+@functools.lru_cache(maxsize=None)
+def _simulate_1f1b(n: int, M: int) -> _Schedule:
+    """Greedy event simulation of non-interleaved 1F1B (backward-priority, per-stage
+    in-flight cap = n). Produces the per-tick action tables AND statically verifies that
+    the circular activation / grad buffers (indexed ``mb % depth``) are never overwritten
+    while live — a schedule bug fails here at trace time, not as silent corruption."""
+    next_f = [0] * n
+    next_b = [0] * n
+    f_tick = [[-1] * M for _ in range(n)]      # tick stage s forwarded mb m
+    b_tick = [[-1] * M for _ in range(n)]
+    fwd_rows, bwd_rows = [], []
+    t = 0
+    while any(next_b[s] < M for s in range(n)):
+        frow, brow = [-1] * n, [-1] * n
+        for s in range(n):
+            # Backward first (the "1B" priority drains in-flight activations).
+            m = next_b[s]
+            if m < M:
+                ready = (
+                    f_tick[s][m] >= 0 and f_tick[s][m] <= t
+                    if s == n - 1
+                    else b_tick[s + 1][m] >= 0 and b_tick[s + 1][m] < t
+                )
+                # Last stage may backward the mb it forwards THIS tick (input stored
+                # intra-tick); but its own forward must then actually happen below.
+                if s == n - 1 and f_tick[s][m] == -1 and next_f[s] == m:
+                    pred_ok = s == 0 or (f_tick[s - 1][m] >= 0 and f_tick[s - 1][m] < t)
+                    if pred_ok and next_f[s] - next_b[s] < n:
+                        frow[s] = m
+                        f_tick[s][m] = t
+                        next_f[s] += 1
+                        ready = True
+                if ready:
+                    brow[s] = m
+                    b_tick[s][m] = t
+                    next_b[s] += 1
+            # Forward (if not already scheduled above).
+            m = next_f[s]
+            if frow[s] == -1 and m < M:
+                pred_ok = s == 0 or (f_tick[s - 1][m] >= 0 and f_tick[s - 1][m] < t)
+                if pred_ok and next_f[s] - next_b[s] < n:
+                    frow[s] = m
+                    f_tick[s][m] = t
+                    next_f[s] += 1
+        fwd_rows.append(frow)
+        bwd_rows.append(brow)
+        t += 1
+        if t > 4 * (M + n) + 16:
+            raise AssertionError(f"1f1b simulation did not converge (n={n}, M={M})")
+
+    T = len(fwd_rows)
+    fwd = np.asarray(fwd_rows, np.int32)
+    bwd = np.asarray(bwd_rows, np.int32)
+    arr_f = np.full((T, n), -1, np.int32)
+    arr_b = np.full((T, n), -1, np.int32)
+    for t in range(1, T):
+        for s in range(1, n):
+            arr_f[t, s] = fwd[t - 1, s - 1]
+        for s in range(n - 1):
+            arr_b[t, s] = bwd[t - 1, s + 1]
+
+    # Buffer-depth verification: activation slot for mb m at stage s is live from its
+    # write (arrival for s>0, forward tick for s==0) until its backward tick; grad slot
+    # from arrival until the backward tick. Any modular collision in that window is fatal.
+    n_buf, g_depth = n + 2, 4
+
+    def _check(depth, write_tick, free_tick, what):
+        # Explicit raises, not assert: this is the module's advertised trace-time proof
+        # of buffer safety and must survive python -O.
+        for s in range(n):
+            for m in range(M):
+                w, f = write_tick(s, m), free_tick(s, m)
+                if not 0 <= w <= f:
+                    raise AssertionError(f"{what}: bad window s={s} m={m} ({w}..{f})")
+                for m2 in range(M):
+                    if m2 != m and m2 % depth == m % depth:
+                        w2 = write_tick(s, m2)
+                        if w < w2 <= f:
+                            raise AssertionError(
+                                f"{what}: slot collision s={s} mb {m} (live {w}..{f}) "
+                                f"overwritten by mb {m2} at {w2} (depth {depth})"
+                            )
+
+    def _act_write(s, m):
+        return f_tick[s][m] if s == 0 else f_tick[s - 1][m] + 1
+
+    _check(n_buf, _act_write, lambda s, m: b_tick[s][m], "activation buffer")
+    _check(
+        g_depth,
+        lambda s, m: b_tick[s + 1][m] + 1 if s < n - 1 else b_tick[s][m],
+        lambda s, m: b_tick[s][m],
+        "grad buffer",
+    )
+    return _Schedule(fwd, bwd, arr_f, arr_b, n_buf, g_depth)
+
+
+def _mb_index(tree, i):
+    return jax.tree_util.tree_map(lambda a: lax.dynamic_index_in_dim(a, i, 0, False), tree)
+
+
+def _where_tree(pred, a, b):
+    return jax.tree_util.tree_map(lambda x, y: jnp.where(pred, x, y), a, b)
+
+
+def _zeros_f32(tree):
+    return jax.tree_util.tree_map(lambda a: jnp.zeros(a.shape, jnp.float32), tree)
+
+
+def _pipeline_1f1b_bwd_kernel(
+    stage_fn, head_loss_fn, sched: _Schedule, axis_name,
+    stage_params, head_params, x_mb, extras_mb, ct,
+):
+    """The combined fwd+bwd 1F1B schedule, run inside shard_map (manual over pp only).
+
+    Per tick every device unconditionally runs one stage forward (garbage on idle ticks,
+    masked on store) and one stage VJP (zero contribution on idle ticks via jnp.where —
+    never multiply-by-mask, which would propagate NaN from garbage compute). Collectives
+    (the two ppermutes) are OUTSIDE all conditionals, so no device can deadlock a peer.
+    """
+    idx = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+    M = x_mb.shape[0]
+    is_last = idx == n - 1
+    p_local = jax.tree_util.tree_map(lambda x: x[0], stage_params)
+    perm_f = [(i, i + 1) for i in range(n - 1)]
+    perm_b = [(i + 1, i) for i in range(n - 1)]
+
+    mb_shape = x_mb.shape[1:]
+    in_buf0 = jnp.zeros((sched.n_buf, *mb_shape), x_mb.dtype)
+    g_buf0 = jnp.zeros((sched.g_buf, *mb_shape), jnp.float32)
+    dx_buf0 = jnp.zeros_like(x_mb, jnp.float32)
+    dp0 = _zeros_f32(p_local)
+    dh0 = _zeros_f32(head_params)
+
+    fwd_t = jnp.asarray(sched.fwd)
+    bwd_t = jnp.asarray(sched.bwd)
+    arr_f_t = jnp.asarray(sched.arr_f)
+    arr_b_t = jnp.asarray(sched.arr_b)
+
+    def head_branch(p, hp, x_b, _dy, ex):
+        def f(p, hp, x):
+            return head_loss_fn(hp, stage_fn(p, x), ex).astype(jnp.float32)
+
+        loss, grads = jax.value_and_grad(f, argnums=(0, 1, 2))(p, hp, x_b)
+        dp, dhp, dx = grads
+        return loss, dp, dhp, dx.astype(jnp.float32)
+
+    def plain_branch(p, hp, x_b, dy, _ex):
+        def f(p, x):
+            y = stage_fn(p, x)
+            return jnp.sum(y.astype(jnp.float32) * dy)
+
+        dp, dx = jax.grad(f, argnums=(0, 1))(p, x_b)
+        # Zeros in hp's OWN dtypes: lax.cond requires both branches to produce identical
+        # types, and head_branch's dhp arrives in the head params' dtype (e.g. bf16).
+        dhp = jax.tree_util.tree_map(jnp.zeros_like, hp)
+        return jnp.zeros((), jnp.float32), dp, dhp, dx.astype(jnp.float32)
+
+    def tick(carry, rows):
+        recv_f, recv_b, in_buf, g_buf, dx_buf, dp_acc, dh_acc, loss_acc = carry
+        f_row, b_row, af_row, ab_row = rows
+        af = af_row[idx]
+        ab = ab_row[idx]
+        fm = f_row[idx]
+        bm = b_row[idx]
+
+        # 1) Bank arrivals from last tick's ppermutes (masked writes).
+        in_buf = jnp.where(
+            af >= 0,
+            lax.dynamic_update_index_in_dim(
+                in_buf, recv_f, jnp.clip(af, 0, M - 1) % sched.n_buf, 0
+            ),
+            in_buf,
+        )
+        g_buf = jnp.where(
+            ab >= 0,
+            lax.dynamic_update_index_in_dim(
+                g_buf, recv_b, jnp.clip(ab, 0, M - 1) % sched.g_buf, 0
+            ),
+            g_buf,
+        )
+
+        # 2) Forward: stage 0 ingests, others read the banked arrival. Stage 0 must also
+        # save its input for the later backward.
+        fm_c = jnp.clip(fm, 0, M - 1)
+        x_in = jnp.where(
+            idx == 0,
+            lax.dynamic_index_in_dim(x_mb, fm_c, 0, False),
+            lax.dynamic_index_in_dim(in_buf, fm_c % sched.n_buf, 0, False),
+        )
+        in_buf = jnp.where(
+            jnp.logical_and(fm >= 0, idx == 0),
+            lax.dynamic_update_index_in_dim(in_buf, x_in, fm_c % sched.n_buf, 0),
+            in_buf,
+        )
+        y = stage_fn(p_local, x_in)
+
+        # 3) Backward (remat): recompute this stage's forward inside the VJP.
+        bm_c = jnp.clip(bm, 0, M - 1)
+        x_b = lax.dynamic_index_in_dim(in_buf, bm_c % sched.n_buf, 0, False)
+        dy = lax.dynamic_index_in_dim(g_buf, bm_c % sched.g_buf, 0, False)
+        ex = _mb_index(extras_mb, bm_c)
+        loss_m, dp, dhp, dx = lax.cond(
+            is_last, head_branch, plain_branch, p_local, head_params, x_b, dy, ex
+        )
+        live = bm >= 0
+        dp_acc = _where_tree(live, jax.tree_util.tree_map(jnp.add, dp_acc, dp), dp_acc)
+        dh_acc = _where_tree(live, jax.tree_util.tree_map(jnp.add, dh_acc, dhp), dh_acc)
+        loss_acc = jnp.where(live, loss_acc + loss_m, loss_acc)
+        dx_buf = jnp.where(
+            jnp.logical_and(live, idx == 0),
+            lax.dynamic_update_index_in_dim(dx_buf, dx, bm_c, 0),
+            dx_buf,
+        )
+
+        # 4) Sends — unconditional collectives (receivers bank only per their tables).
+        recv_f = lax.ppermute(y, axis_name, perm_f)
+        recv_b = lax.ppermute(dx, axis_name, perm_b)
+        return (recv_f, recv_b, in_buf, g_buf, dx_buf, dp_acc, dh_acc, loss_acc), None
+
+    carry0 = (
+        jnp.zeros(mb_shape, x_mb.dtype), jnp.zeros(mb_shape, jnp.float32),
+        in_buf0, g_buf0, dx_buf0, dp0, dh0, jnp.zeros((), jnp.float32),
+    )
+    rows = (fwd_t, bwd_t, arr_f_t, arr_b_t)
+    (_, _, _, _, dx_buf, dp_acc, dh_acc, _loss), _ = lax.scan(tick, carry0, rows)
+
+    ctf = ct.astype(jnp.float32)
+    # dp is per-stage (stays sharded over pp, leading dim re-added); dh and dx are psum'd
+    # across stages (head grads live only on the last stage, dx only on stage 0).
+    dp_out = jax.tree_util.tree_map(lambda a: (a * ctf)[None], dp_acc)
+    dh_out = jax.tree_util.tree_map(
+        lambda a: lax.psum(a * ctf, axis_name), dh_acc
+    )
+    dx_out = lax.psum(
+        jnp.where(idx == 0, dx_buf * ctf, jnp.zeros_like(dx_buf)), axis_name
+    )
+    return dp_out, dh_out, dx_out
+
+
+def make_pipeline_loss_fn(
+    mesh,
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    head_loss_fn: Callable[[Any, jax.Array, Any], jax.Array],
+    axis_name: str = PIPELINE_AXIS,
+    num_microbatches: Optional[int] = None,
+    schedule: str = "1f1b",
+):
+    """Build ``loss(stage_params, head_params, x [B, ...], extras) -> scalar`` with a
+    hand-scheduled 1F1B backward (``schedule="1f1b"``) or AD-GPipe (``"gpipe"``).
+
+    - ``stage_fn(stage_params_one_stage, x_mb) -> y_mb`` (shape-stable, like
+      ``pipeline_apply``; no aux returns — MoE configs use the GPipe path).
+    - ``head_loss_fn(head_params, y_mb, extras_mb) -> scalar`` must be SUM-style over its
+      microbatch (sums across microbatches add up to the full-batch loss; put any
+      normalization outside). It runs on the LAST stage only under 1f1b.
+    - ``extras`` is a pytree of [B, ...] arrays (targets, masks); integer leaves get
+      ``float0`` cotangents.
+
+    Head-param placement in the backward: the shard_map is manual over ``pp`` only, so
+    head params enter replicated along pp (in_spec ``P()``) — GSPMD all-gathers JUST the
+    pp factor of any pp-sharded head leaf for the backward and psums ``d_head`` back.
+    Shardings on the AUTO axes (tp/fsdp vocab sharding from
+    ``partition_specs(pp=True)``) pass straight through, so the transient per-device
+    head bytes are head/(tp·fsdp), not a full replica; the resident layout keeps the
+    full (tp, fsdp, pp) sharding.
+
+    The 1f1b loss is a scalar differentiable via ``jax.grad`` like any other: the primal
+    is a forward-only pipeline (no per-tick residuals), the custom backward replays
+    forward+backward together with at most ``n_stages + 2`` in-flight microbatch inputs
+    per stage (AD-GPipe holds all M). Compute cost is identical to remat-full GPipe.
+    """
+    if schedule not in ("1f1b", "gpipe"):
+        raise ValueError(f"schedule={schedule!r}: expected '1f1b' or 'gpipe'")
+    n_stages = mesh.shape[axis_name]
+    M = num_microbatches if num_microbatches is not None else n_stages
+
+    if schedule == "gpipe":
+        pipe = make_pipeline_fn(mesh, stage_fn, axis_name, M)
+
+        def gpipe_loss(stage_params, head_params, x, extras):
+            y = pipe(stage_params, x)
+            return head_loss_fn(head_params, y, extras)
+
+        return gpipe_loss
+
+    sched = _simulate_1f1b(n_stages, M)
+
+    def _split_mb(tree, B):
+        return jax.tree_util.tree_map(
+            lambda a: a.reshape(M, B // M, *a.shape[1:]), tree
+        )
+
+    @jax.custom_vjp
+    def loss(stage_params, head_params, x, extras):
+        # Primal: forward-only pipeline + full-batch head loss; saves nothing per-tick.
+        pipe = make_pipeline_fn(mesh, stage_fn, axis_name, M)
+        y = pipe(stage_params, x)
+        return head_loss_fn(head_params, y, extras)
+
+    def loss_fwd(stage_params, head_params, x, extras):
+        return loss(stage_params, head_params, x, extras), (
+            stage_params, head_params, x, extras
+        )
+
+    def loss_bwd(res, ct):
+        stage_params, head_params, x, extras = res
+        B = x.shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        x_mb = x.reshape(M, B // M, *x.shape[1:])
+        extras_mb = _split_mb(extras, B)
+
+        specs_params = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
+        rep = jax.tree_util.tree_map(lambda _: P(), head_params)
+        mapped = jax.shard_map(
+            functools.partial(
+                _pipeline_1f1b_bwd_kernel, stage_fn, head_loss_fn, sched, axis_name
+            ),
+            mesh=mesh,
+            in_specs=(specs_params, rep, P(), jax.tree_util.tree_map(lambda _: P(), extras_mb), P()),
+            out_specs=(specs_params, rep, P()),
+            # Manual over pp ONLY (like make_pipeline_fn): on composed meshes the other
+            # axes (dp/fsdp/tp) stay auto so GSPMD keeps the batch dp-sharded and the
+            # stage/head params tp/fsdp-sharded instead of gathering them everywhere.
+            axis_names={axis_name},
+            check_vma=False,
+        )
+        dp, dh, dx_mb = mapped(stage_params, head_params, x_mb, extras_mb, jnp.asarray(ct))
+        dp = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), dp, stage_params)
+        dh = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), dh, head_params)
+        dx = dx_mb.reshape(B, *x.shape[1:]).astype(x.dtype)
+        d_extras = jax.tree_util.tree_map(
+            lambda a: (
+                np.zeros(a.shape, jax.dtypes.float0)
+                if not jnp.issubdtype(a.dtype, jnp.floating)
+                else jnp.zeros_like(a)
+            ),
+            extras,
+        )
+        return dp, dh, dx, d_extras
+
+    loss.defvjp(loss_fwd, loss_bwd)
+    return loss

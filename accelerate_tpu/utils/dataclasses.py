@@ -443,13 +443,17 @@ class TensorParallelPlugin(KwargsHandler):
 
 @dataclass
 class PipelineParallelPlugin(KwargsHandler):
-    """GPipe-style pipeline parallelism along the ``pp`` axis (reference ``inference.py``).
+    """Pipeline parallelism along the ``pp`` axis (reference ``inference.py``; Megatron
+    schedule intent ``dataclasses.py:2024``).
 
-    Only the GPipe schedule exists: the pipeline is one differentiable ``lax.scan``
-    (``parallel/pp.py``) whose backward schedule jax AD derives, so a hand-written 1F1B
-    interleave has no seam to plug into — its memory benefit is obtained with
-    ``remat``/offload policies instead. Requesting "1f1b" raises rather than silently
-    running GPipe.
+    Two schedules (``parallel/pp.py``):
+
+    - ``"gpipe"`` — one differentiable ``lax.scan`` whose backward jax AD derives;
+      activation residuals grow with ``num_microbatches``.
+    - ``"1f1b"`` — hand-scheduled custom-VJP one-forward-one-backward: in-flight
+      activations bounded by ``pp_size + 2`` per stage regardless of
+      ``num_microbatches``, which is what lets M grow to amortize the (n-1)/(M+n-1)
+      bubble. Dense models only (MoE aux collection runs on the GPipe path).
     """
 
     pp_size: int = 1
@@ -457,11 +461,10 @@ class PipelineParallelPlugin(KwargsHandler):
     schedule: str = "gpipe"
 
     def __post_init__(self):
-        if self.schedule != "gpipe":
+        if self.schedule not in ("gpipe", "1f1b"):
             raise ValueError(
-                f"schedule={self.schedule!r} is not supported: the scan-based pipeline "
-                "derives its backward schedule via jax AD (GPipe); bound activation memory "
-                "with remat/offload policies instead of 1F1B."
+                f"schedule={self.schedule!r} is not supported: expected 'gpipe' or '1f1b' "
+                "(parallel/pp.py; interleaved virtual-pipeline stages are not implemented)"
             )
 
 

@@ -252,3 +252,55 @@ def test_llama_loss_fused_gemma_softcap():
     l_auto = float(llama.loss_fn(params, batch, base))
     l_fused = float(llama.loss_fn(params, batch, dataclasses.replace(base, loss_impl="fused")))
     assert l_fused == pytest.approx(l_auto, rel=1e-5)
+
+
+def test_llama_loss_fused_tp_matches_auto_on_tp_mesh():
+    """loss_impl='fused_tp': the Megatron-layout path — head vocab-sharded over tp,
+    each shard runs the Pallas kernel on its slice, lse merged across tp. Loss and
+    gradients must match the auto (chunked) path on a dp2 x tp4 mesh."""
+    from jax.sharding import NamedSharding
+
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.parallel import MeshConfig, build_mesh
+
+    cfg_tp = dataclasses.replace(
+        llama.CONFIGS["tiny"], dtype=jnp.float32, attn_impl="xla",
+        tie_embeddings=False, loss_impl="fused_tp",
+    )
+    cfg_auto = dataclasses.replace(cfg_tp, loss_impl="auto")
+    params = llama.init_params(cfg_tp)
+    rng = np.random.default_rng(0)
+    batch = {"tokens": jnp.asarray(
+        rng.integers(0, cfg_tp.vocab_size, (8, 17)), jnp.int32)}
+    base_loss = float(llama.loss_fn(params, batch, cfg_auto))
+    base_g = jax.grad(lambda p: llama.loss_fn(p, batch, cfg_auto))(params)
+
+    mesh = build_mesh(MeshConfig(dp=2, tp=4))
+    specs = llama.partition_specs(cfg_tp)
+    sharded = jax.tree_util.tree_map(
+        lambda l, s: jax.device_put(l, NamedSharding(mesh, s)), params, specs
+    )
+    with jax.set_mesh(mesh):
+        l, g = jax.jit(jax.value_and_grad(
+            lambda p, b: llama.loss_fn(p, b, cfg_tp)
+        ))(sharded, batch)
+    np.testing.assert_allclose(float(l), base_loss, rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-4, rtol=1e-3
+        ),
+        dict(g), dict(base_g),
+    )
+
+
+def test_llama_loss_fused_tp_without_mesh_raises():
+    from accelerate_tpu.models import llama
+
+    cfg = dataclasses.replace(
+        llama.CONFIGS["tiny"], vocab_size=300, dtype=jnp.float32, remat=False,
+        tie_embeddings=False, loss_impl="fused_tp",
+    )
+    params = llama.init_params(cfg)
+    tokens = jnp.asarray(np.random.default_rng(5).integers(0, 300, (2, 17)), jnp.int32)
+    with pytest.raises(ValueError, match="mesh context"):
+        llama.loss_fn(params, {"tokens": tokens}, cfg)

@@ -205,20 +205,29 @@ def test_parity_enums_and_ddp_kwargs():
 
 def test_ddp_comm_hook_applies_to_policy():
     """Passing DistributedDataParallelKwargs(comm_hook=...) through kwargs_handlers must
-    land on the state's MixedPrecisionPolicy.reduce_dtype (the DDP-hook analog)."""
+    land on the state's MixedPrecisionPolicy.reduce_dtype (the DDP-hook analog) — and a
+    hook dtype that the train step would silently never apply (it compresses only when
+    reduce_dtype == compute_dtype) must RAISE, per the handler's accepted-but-ignored-
+    is-worse-than-an-error policy (advisor r2)."""
     import jax.numpy as jnp
+    import pytest as _pytest
 
     from accelerate_tpu import Accelerator
     from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
     from accelerate_tpu.utils import DistributedDataParallelKwargs
 
-    AcceleratorState._reset_state()
-    GradientState._reset_state()
-    PartialState._reset_state()
+    def _reset():
+        AcceleratorState._reset_state()
+        GradientState._reset_state()
+        PartialState._reset_state()
+
+    _reset()
     acc = Accelerator(
-        kwargs_handlers=[DistributedDataParallelKwargs(comm_hook="bf16")]
+        mixed_precision="bf16",
+        kwargs_handlers=[DistributedDataParallelKwargs(comm_hook="bf16")],
     )
     assert acc.mixed_precision_policy.reduce_dtype == jnp.bfloat16
-    AcceleratorState._reset_state()
-    GradientState._reset_state()
-    PartialState._reset_state()
+    _reset()
+    with _pytest.raises(ValueError, match="never applied"):
+        Accelerator(kwargs_handlers=[DistributedDataParallelKwargs(comm_hook="bf16")])
+    _reset()
