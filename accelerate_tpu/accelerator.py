@@ -361,6 +361,11 @@ class Accelerator:
             self.state.mixed_precision_policy = _dc.replace(
                 self.state.mixed_precision_policy, reduce_dtype=ddp_kwargs.reduce_dtype
             )
+            # Distinguishes an EXPLICIT comm_hook from the bf16/fp16 policy's default
+            # reduce_dtype: only the former hard-errors when a build_train_step option
+            # later disables compression (the default silently not compressing under
+            # cast_params=False is expected behavior, not a dropped user request).
+            self._explicit_comm_hook = True
 
         if gradient_accumulation_plugin is None:
             # Priority: explicit Python arg (any int, including 1) > env wire protocol > 1.
@@ -847,6 +852,18 @@ class Accelerator:
             and policy.reduce_dtype == policy.compute_dtype
             and policy.compute_dtype != jnp.float32
         )
+        if not cast_params and getattr(self, "_explicit_comm_hook", False):
+            # The comm_hook passed __init__'s dtype check, but compression rides the
+            # whole-tree pre-cast — with cast_params=False it cannot apply. Same
+            # accepted-but-ignored policy as the constructor: raise, don't silently
+            # reduce uncompressed. (The bf16/fp16 policy's DEFAULT reduce_dtype is not
+            # a user request and does not trigger this.)
+            raise ValueError(
+                "a gradient-compression comm_hook is configured (reduce_dtype="
+                f"{policy.reduce_dtype.__name__}) but build_train_step(cast_params="
+                "False) disables the parameter pre-cast it rides on — drop the "
+                "comm_hook or keep cast_params=True"
+            )
         self._reduce_compressed = compress_reduce  # introspection/testing
 
         def compute(state: TrainState, batch):

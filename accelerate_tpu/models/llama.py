@@ -890,16 +890,24 @@ def _ce_from_hidden(x, params, targets, mask, cfg: LlamaConfig) -> jax.Array:
         )
         if loss is not None:
             return loss
+    return _ce_sum(x, head, targets, mask, cfg) / denom
+
+
+def _ce_sum(x, head, targets, mask, cfg: LlamaConfig) -> jax.Array:
+    """SUM-style chunked/dense CE core — the ONE copy of the softcap + log_softmax +
+    target-gather math, shared by ``_ce_from_hidden`` (which normalizes) and the 1F1B
+    last-stage head (``_head_ce_sum``, which sums across microbatches)."""
+    S = x.shape[1]
     chunk = _loss_chunk_size(cfg, S)  # may exceed/not divide S; _chunked_ce pads
     if chunk > 0:
         return _chunked_ce(
             x, head, targets, mask, chunk, cfg.dtype, final_softcap=cfg.final_softcap
-        ) / denom
+        )
     logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
     logits = _softcap(logits, cfg.final_softcap)
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
-    return -(ll * mask).sum() / denom
+    return -(ll * mask).sum()
 
 
 def loss_fn(
@@ -1070,19 +1078,11 @@ def forward_pp(
 def _head_ce_sum(hp: dict, y: jax.Array, ex: dict, cfg: LlamaConfig) -> jax.Array:
     """SUM-style ln_f + CE head over one microbatch (the 1F1B last-stage loss):
     ``hp = {"ln_f", "head" [D, V]}``, ``ex = {"targets", "mask"}``. Sums across
-    microbatches add up to the full-batch numerator; normalization stays outside."""
+    microbatches add up to the full-batch numerator; normalization stays outside.
+    Delegates to ``_ce_sum`` so the CE math cannot drift from the GPipe/sequential
+    paths."""
     x = _rms_norm(y, hp["ln_f"], cfg.norm_eps, cfg.norm_plus_one)
-    chunk = _loss_chunk_size(cfg, x.shape[1])
-    if chunk > 0:
-        return _chunked_ce(
-            x, hp["head"], ex["targets"], ex["mask"], chunk, cfg.dtype,
-            final_softcap=cfg.final_softcap,
-        )
-    logits = (x @ hp["head"].astype(cfg.dtype)).astype(jnp.float32)
-    logits = _softcap(logits, cfg.final_softcap)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, ex["targets"][..., None], axis=-1).squeeze(-1)
-    return -(ll * ex["mask"]).sum()
+    return _ce_sum(x, hp["head"], ex["targets"], ex["mask"], cfg)
 
 
 def loss_fn_pp(
@@ -1122,6 +1122,16 @@ def loss_fn_pp(
             raise NotImplementedError(
                 "schedule='1f1b' supports dense configs only (MoE aux collection runs "
                 "on the GPipe path; pass schedule='gpipe')"
+            )
+        if cfg.loss_impl in ("fused_dp", "fused_tp"):
+            # Those variants are shard_map programs over the batch/tp axes; the 1F1B
+            # head runs inside an already-manual-over-pp shard_map on per-microbatch
+            # slices, where they cannot be nested. Raising beats silently running the
+            # chunked path the user specifically configured away.
+            raise NotImplementedError(
+                f"loss_impl={cfg.loss_impl!r} is not supported under schedule='1f1b' "
+                "(the CE head runs inside the pipeline's shard_map); use loss_impl="
+                "'auto' with 1f1b, or schedule='gpipe' with this loss_impl"
             )
         from ..parallel.pp import make_pipeline_loss_fn
 

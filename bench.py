@@ -323,7 +323,14 @@ def run(B: int, S: int, fuse: int, preset: str | None):
     n_chips = jax.device_count()
     tokens_per_sec = B * S * n_steps / dt / n_chips
     samples_per_sec = B * n_steps / dt / n_chips
-    # 6N matmul + causal-attention 6·L·S·D FLOPs per token.
+    # FLOP model (keep stable round-over-round; MFU history depends on it):
+    #   6N per token = fwd (2N) + bwd (4N) matmul MACs over all params, plus
+    #   6·L·S·D causal attention = 2 score+context matmuls · 3 (fwd+bwd) · S/2
+    #   (causal halves the square; written as 6·L·S·D per token with D=d_model and
+    #   hd·H=D absorbed). DELIBERATELY conservative: no remat recompute credit, no
+    #   vocab-head CE flops beyond the 6N share, no exp/softmax vector work — reported
+    #   MFU errs LOW. peak_tflops_assumed is the datasheet bf16 number (196.6 v5e),
+    #   not the measured matmul ceiling (~153, benchmarks/decompose.py matmul_peak).
     flops_per_token = 6 * n_params + 6 * cfg.n_layers * S * cfg.d_model
     peak = _peak_tflops(jax.devices()[0]) * 1e12
     tflops = tokens_per_sec * flops_per_token / 1e12

@@ -1,0 +1,21 @@
+#!/bin/bash
+# Fourth TPU work session (round 3): the BASELINE.md north-star nlp_example row
+# (BERT-base samples/sec/chip) + RESULTS.md assembly. Chained behind tpu_session3.sh.
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (session3) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 60; done
+fi
+
+echo "=== waiting for TPU ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+
+echo "=== nlp_example samples/sec/chip (north-star row) ==="
+timeout 900 python benchmarks/nlp_bench.py
+echo "nlp rc=$?"
+
+echo "=== assemble big-model-inference RESULTS.md (if rows landed) ==="
+python benchmarks/big_model_inference/collect_results.py || true
+echo "=== session4 done ==="

@@ -306,3 +306,27 @@ def test_fused_step_checkpoint_roundtrip(tmp_path):
     restored = acc.load_state(str(tmp_path / "ckpt"), state)
     for a, b in zip(jax.tree_util.tree_leaves(state), jax.tree_util.tree_leaves(restored)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_prime_row_leaf_takes_pad_branch_and_matches_optax():
+    """A leaf whose row count (size/1024) is prime has no divisor near block_rows:
+    _leaf_fused must PAD to a block multiple (not degrade to block_rows=1) and stay
+    bit-equivalent to optax. rows=127 (prime) with the default block_rows forces the
+    pad branch; rows=16 rides the exact-divisor branch as control."""
+    k = jax.random.PRNGKey(9)
+    params = {
+        "prime_rows": jax.random.normal(k, (127, 1024), jnp.float32),  # rows=127, prime
+        "even_rows": jax.random.normal(k, (16, 1024), jnp.float32),
+    }
+    lr, wd = 3e-3, 1e-2
+    ours = fused_adamw(lr, weight_decay=wd)
+    ref = optax.adamw(lr, weight_decay=wd)
+    s_ours, s_ref = ours.init(params), ref.init(params)
+    p_ours = p_ref = params
+    for step in range(3):
+        g = _grads_like(params, seed=step)
+        p_ours, s_ours = jax.jit(ours.fused_apply)(g, s_ours, p_ours)
+        u, s_ref = ref.update(g, s_ref, p_ref)
+        p_ref = optax.apply_updates(p_ref, u)
+    for a, b in zip(jax.tree_util.tree_leaves(p_ours), jax.tree_util.tree_leaves(p_ref)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6)
