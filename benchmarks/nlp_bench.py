@@ -18,13 +18,11 @@ import time
 import numpy as np
 
 REPO = __import__("os").path.dirname(__import__("os").path.dirname(__import__("os").path.abspath(__file__)))
-for p in (REPO, REPO + "/examples"):
+for p in (REPO, REPO + "/examples", REPO + "/benchmarks"):
     if p not in sys.path:
         sys.path.insert(0, p)
 
-from bench_timing import RowRunner, enable_compile_cache, force_cpu_for_smoke  # noqa: E402
-
-sys.path.insert(0, REPO + "/benchmarks")
+from bench_timing import enable_compile_cache, force_cpu_for_smoke  # noqa: E402
 
 
 def main() -> int:

@@ -78,6 +78,7 @@ def test_complete_nlp_example(tmp_path, capsys, monkeypatch):
         ("schedule_free.py", "schedule-free eval params"),
         ("ddp_comm_hook.py", "gradient reduction dtype: bfloat16"),
         ("sequence_parallelism.py", "long-context training OK"),
+        ("pipeline_parallelism.py", "pipeline training OK"),
         ("megatron_lm_gpt_pretraining.py", "3D pretraining OK"),
         ("sample_packing.py", "packed rows"),
     ],
