@@ -268,6 +268,7 @@ class Accelerator:
                 pp_plugin = PipelineParallelPlugin(
                     pp_size=megatron_lm_plugin.pp_degree,
                     num_microbatches=megatron_lm_plugin.num_micro_batches,
+                    schedule=megatron_lm_plugin.pp_schedule,
                 )
             if sp_plugin is None and megatron_lm_plugin.sp_degree > 1:
                 sp_plugin = SequenceParallelPlugin(sp_size=megatron_lm_plugin.sp_degree)

@@ -38,6 +38,8 @@ __all__ = [
     "score",
     "perplexity",
     "partition_specs",
+    "forward_pp",
+    "loss_fn_pp",
     "init_cache",
     "forward_cached",
     "generate",
@@ -149,8 +151,12 @@ def init_params(cfg: GPTConfig, key: Optional[jax.Array] = None) -> dict:
     return params
 
 
-def partition_specs(cfg: GPTConfig) -> dict:
-    """Megatron layout: qkv/up column-parallel, o/down row-parallel, vocab over (tp, fsdp)."""
+def partition_specs(cfg: GPTConfig, pp: bool = False) -> dict:
+    """Megatron layout: qkv/up column-parallel, o/down row-parallel, vocab over (tp, fsdp).
+
+    ``pp=True``: layer specs gain the stage-stacked leading dims sharded over ``pp``
+    (``parallel.pp.split_params_into_stages`` layout) and embed/head fold the pipeline
+    axis into the vocab sharding — same design as ``llama.partition_specs(pp=True)``."""
     ln = {"scale": P(), "bias": P()}
     layer = {
         "ln_attn": dict(ln),
@@ -164,24 +170,36 @@ def partition_specs(cfg: GPTConfig) -> dict:
         "w_down": P(TENSOR_AXIS, None),
         "b_down": P(),
     }
-    if cfg.scan_layers:
+    from ..utils.constants import PIPELINE_AXIS
+
+    if pp:
+        if not cfg.scan_layers:
+            raise ValueError("pipeline parallelism requires cfg.scan_layers=True")
+        layer = jax.tree_util.tree_map(
+            lambda spec: P(PIPELINE_AXIS, None, *spec),
+            layer,
+            is_leaf=lambda s: isinstance(s, P),
+        )
+        layers: Any = layer
+    elif cfg.scan_layers:
         layer = jax.tree_util.tree_map(
             lambda spec: P(None, *spec), layer, is_leaf=lambda s: isinstance(s, P)
         )
-        layers: Any = layer
+        layers = layer
     else:
         layers = [dict(layer) for _ in range(cfg.n_layers)]
+    vocab_axes = (TENSOR_AXIS, FSDP_AXIS, PIPELINE_AXIS) if pp else (TENSOR_AXIS, FSDP_AXIS)
     specs = {
-        "wte": P((TENSOR_AXIS, FSDP_AXIS), None),
+        "wte": P(vocab_axes, None),
         "layers": layers,
         "ln_f": dict(ln),
     }
     if cfg.pos == "learned":
         specs["wpe"] = P(None, None)
     if not cfg.tie_embeddings:
-        specs["lm_head"] = P(None, (TENSOR_AXIS, FSDP_AXIS))
+        specs["lm_head"] = P(None, vocab_axes)
         if cfg.lm_head_bias:
-            specs["b_lm_head"] = P((TENSOR_AXIS, FSDP_AXIS))
+            specs["b_lm_head"] = P(vocab_axes)
     return specs
 
 
@@ -384,6 +402,127 @@ def loss_fn(params: dict, batch: dict, cfg: GPTConfig, rng=None) -> jax.Array:
     if m is None:
         return -jnp.mean(ll)
     return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+
+# --------------------------------------------------------------- pipeline-parallel training
+def _pp_stage_fn(cfg: GPTConfig, S: int):
+    """One pipeline stage body (gpt analog of ``llama._pp_stage_fn``): scan this stage's
+    blocks over one microbatch [B_m, S, D]; positions/causal mask rebuilt locally."""
+    from .common import remat_wrap
+
+    block = remat_wrap(
+        _block, remat=cfg.remat, policy=cfg.remat_policy,
+        prevent_cse=cfg.remat_prevent_cse, scan_layers=True, static_argnums=(4,),
+    )
+
+    def stage_fn(stage_layers, x):
+        pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (x.shape[0], S))
+        mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
+
+        def body(carry, layer):
+            return block(carry, layer, pos, mask, cfg), None
+
+        out, _ = jax.lax.scan(body, x, stage_layers)
+        return out
+
+    return stage_fn
+
+
+def forward_pp(
+    params: dict,
+    tokens: jax.Array,
+    cfg: GPTConfig,
+    mesh,
+    num_microbatches: Optional[int] = None,
+    shard_activations: bool = True,
+) -> jax.Array:
+    """Causal LM hidden states with the transformer blocks as a GPipe pipeline over
+    ``pp`` (reference Megatron engine runs GPT with pp; its own pipelining is
+    inference-only). ``params["layers"]`` stage-stacked [n_stages, L/n, ...]; embed and
+    ln_f/head outside the pipe, vocab-sharded over (tp, fsdp, pp) by
+    ``partition_specs(pp=True)``. Dense attention path (no packing)."""
+    from .llama import _maybe_shard
+    from ..parallel.pp import make_pipeline_fn
+
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    x = _embed(params, tokens, positions, cfg)
+    if shard_activations:
+        x = _maybe_shard(x, P(BATCH_AXES, None, None))
+    pipe = make_pipeline_fn(mesh, _pp_stage_fn(cfg, S), num_microbatches=num_microbatches)
+    x = pipe(params["layers"], x)
+    return _layer_norm(x, params["ln_f"], cfg.norm_eps)
+
+
+def _ce_sum_gpt(x, head, bias, targets, mask, cfg: GPTConfig) -> jax.Array:
+    """SUM-style dense CE from post-ln_f hidden states, honoring the optional lm_head
+    bias — the ONE copy of the gpt head math shared by loss_fn_pp (both schedules) and
+    the 1F1B head so the paths cannot drift."""
+    logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    return -(ll * mask).sum()
+
+
+def _head_ce_sum_gpt(hp: dict, y: jax.Array, ex: dict, cfg: GPTConfig) -> jax.Array:
+    """SUM-style ln_f + head CE over one microbatch group (1F1B last-stage loss)."""
+    x = _layer_norm(y, hp["ln_f"], cfg.norm_eps)
+    return _ce_sum_gpt(x, hp["head"], hp.get("b_lm_head"), ex["targets"], ex["mask"], cfg)
+
+
+def loss_fn_pp(
+    params: dict,
+    batch: dict,
+    cfg: GPTConfig,
+    mesh,
+    num_microbatches: Optional[int] = None,
+    rng=None,
+    schedule: str = "gpipe",
+) -> jax.Array:
+    """Pipeline-parallel next-token CE for the gpt family (same contract as
+    ``llama.loss_fn_pp``; dense CE only — fused variants and packing raise)."""
+    if "segment_ids" in batch:
+        raise NotImplementedError(
+            "sample packing (segment_ids) is not supported on the pipeline-parallel path"
+        )
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"schedule={schedule!r}: expected 'gpipe' or '1f1b'")
+    if cfg.loss_impl != "auto":
+        raise NotImplementedError(
+            f"loss_impl={cfg.loss_impl!r} is not supported on the gpt pipeline path "
+            "(dense CE only); use loss_impl='auto'"
+        )
+    tokens = batch["tokens"]
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    B, S = inputs.shape
+    mask = (
+        batch["mask"][:, 1:].astype(jnp.float32)
+        if "mask" in batch
+        else jnp.ones((B, S), jnp.float32)
+    )
+    denom = jnp.maximum(mask.sum(), 1.0)
+    if schedule == "1f1b":
+        from ..parallel.pp import make_pipeline_loss_fn
+
+        hp = {"ln_f": params["ln_f"], "head": _head_weight(params, cfg)}
+        if cfg.lm_head_bias and "b_lm_head" in params:
+            hp["b_lm_head"] = params["b_lm_head"]
+        pipe_loss = make_pipeline_loss_fn(
+            mesh, _pp_stage_fn(cfg, S),
+            lambda h, y, ex: _head_ce_sum_gpt(h, y, ex, cfg),
+            num_microbatches=num_microbatches, schedule="1f1b",
+        )
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        x = _embed(params, inputs, positions, cfg)
+        total = pipe_loss(
+            params["layers"], hp, x, {"targets": targets, "mask": mask}
+        )
+        return total / denom
+    x = forward_pp(params, inputs, cfg, mesh, num_microbatches=num_microbatches)
+    bias = params.get("b_lm_head") if cfg.lm_head_bias else None
+    return _ce_sum_gpt(x, _head_weight(params, cfg), bias, targets, mask, cfg) / denom
 
 
 def score(params: dict, tokens, cfg: GPTConfig, mask=None) -> jax.Array:

@@ -802,9 +802,16 @@ def _chunked_ce(x, head, targets, mask, chunk: int, dtype, final_softcap: float 
 
 def _ce_from_hidden(x, params, targets, mask, cfg: LlamaConfig) -> jax.Array:
     """Cross-entropy from post-ln_f hidden states (chunked when ``cfg.loss_chunk`` says so)."""
-    S = x.shape[1]
-    denom = jnp.maximum(mask.sum(), 1.0)
     head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    denom = jnp.maximum(mask.sum(), 1.0)
+    return _ce_sum_impl(x, head, targets, mask, cfg) / denom
+
+
+def _ce_sum_impl(x, head, targets, mask, cfg: LlamaConfig) -> jax.Array:
+    """SUM-style CE dispatcher — the ONE place every loss_impl routes through, used by
+    both the normalized single/GPipe path (``_ce_from_hidden``) and the 1F1B head
+    (``_head_ce_sum``, where sums across microbatch groups must add up exactly)."""
+    S = x.shape[1]
     if cfg.loss_impl not in ("auto", "fused", "fused_dp", "fused_tp"):
         raise ValueError(
             f"loss_impl={cfg.loss_impl!r}: expected 'auto', 'fused', 'fused_dp', or "
@@ -844,7 +851,7 @@ def _ce_from_hidden(x, params, targets, mask, cfg: LlamaConfig) -> jax.Array:
             out_specs=P(BATCH_AXES),
             check_vma=False,  # pallas_call outputs carry no vma info (kernel contract)
         )(x, targets, mask, head.astype(cfg.dtype))
-        return partials.sum() / denom
+        return partials.sum()
     if cfg.loss_impl == "fused_dp":
         # Multi-chip fused CE: shard_map over the batch axes — each device runs the
         # kernel on ITS tokens against a replicated head (in_spec P() makes shard_map's
@@ -879,7 +886,7 @@ def _ce_from_hidden(x, params, targets, mask, cfg: LlamaConfig) -> jax.Array:
             out_specs=P(BATCH_AXES),
             check_vma=False,  # pallas_call outputs carry no vma info
         )(x, targets, mask, head.astype(cfg.dtype))
-        return partials.sum() / denom
+        return partials.sum()
     if cfg.loss_impl == "fused":
         # Single-shard path (shared dispatch in models/common.py): on a real multi-chip
         # mesh this returns None — fall through to the chunked path (or use "fused_dp").
@@ -889,8 +896,10 @@ def _ce_from_hidden(x, params, targets, mask, cfg: LlamaConfig) -> jax.Array:
             x, head.astype(cfg.dtype), targets, mask, softcap=cfg.final_softcap
         )
         if loss is not None:
-            return loss
-    return _ce_sum(x, head, targets, mask, cfg) / denom
+            # fused_ce_single_shard returns the masked MEAN; convert back to SUM so
+            # every branch of this dispatcher has identical (sum) semantics.
+            return loss * jnp.maximum(mask.sum(), 1.0)
+    return _ce_sum(x, head, targets, mask, cfg)
 
 
 def _ce_sum(x, head, targets, mask, cfg: LlamaConfig) -> jax.Array:
@@ -1079,10 +1088,10 @@ def _head_ce_sum(hp: dict, y: jax.Array, ex: dict, cfg: LlamaConfig) -> jax.Arra
     """SUM-style ln_f + CE head over one microbatch (the 1F1B last-stage loss):
     ``hp = {"ln_f", "head" [D, V]}``, ``ex = {"targets", "mask"}``. Sums across
     microbatches add up to the full-batch numerator; normalization stays outside.
-    Delegates to ``_ce_sum`` so the CE math cannot drift from the GPipe/sequential
-    paths."""
+    Delegates to ``_ce_sum_impl`` so the CE math (including the fused kernel variants)
+    cannot drift from the GPipe/sequential paths."""
     x = _rms_norm(y, hp["ln_f"], cfg.norm_eps, cfg.norm_plus_one)
-    return _ce_sum(x, hp["head"], ex["targets"], ex["mask"], cfg)
+    return _ce_sum_impl(x, hp["head"], ex["targets"], ex["mask"], cfg)
 
 
 def loss_fn_pp(
@@ -1099,8 +1108,9 @@ def loss_fn_pp(
 
     ``schedule="1f1b"`` routes through ``parallel.pp.make_pipeline_loss_fn``: the custom
     VJP's hand-scheduled one-forward-one-backward keeps in-flight activations bounded by
-    the stage count instead of ``num_microbatches`` (dense configs only; ln_f + the CE
-    head run inside the last stage's schedule)."""
+    the stage count instead of ``num_microbatches``. ln_f + the CE head run OUTSIDE the
+    pipeline on the full batch (ordinary GSPMD — every ``loss_impl`` incl. the fused
+    kernels works); dense configs only (MoE uses GPipe)."""
     if "segment_ids" in batch:
         raise NotImplementedError(
             "sample packing (segment_ids) is not supported on the pipeline-parallel path"
@@ -1122,16 +1132,6 @@ def loss_fn_pp(
             raise NotImplementedError(
                 "schedule='1f1b' supports dense configs only (MoE aux collection runs "
                 "on the GPipe path; pass schedule='gpipe')"
-            )
-        if cfg.loss_impl in ("fused_dp", "fused_tp"):
-            # Those variants are shard_map programs over the batch/tp axes; the 1F1B
-            # head runs inside an already-manual-over-pp shard_map on per-microbatch
-            # slices, where they cannot be nested. Raising beats silently running the
-            # chunked path the user specifically configured away.
-            raise NotImplementedError(
-                f"loss_impl={cfg.loss_impl!r} is not supported under schedule='1f1b' "
-                "(the CE head runs inside the pipeline's shard_map); use loss_impl="
-                "'auto' with 1f1b, or schedule='gpipe' with this loss_impl"
             )
         from ..parallel.pp import make_pipeline_loss_fn
 

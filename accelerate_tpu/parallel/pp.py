@@ -305,15 +305,19 @@ def _zeros_f32(tree):
 
 
 def _pipeline_1f1b_bwd_kernel(
-    stage_fn, head_loss_fn, sched: _Schedule, axis_name,
-    stage_params, head_params, x_mb, extras_mb, ct,
+    stage_fn, sched: _Schedule, axis_name,
+    stage_params, x_mb, dy_mb,
 ):
-    """The combined fwd+bwd 1F1B schedule, run inside shard_map (manual over pp only).
-
-    Per tick every device unconditionally runs one stage forward (garbage on idle ticks,
+    """The combined fwd+bwd 1F1B replay for the STAGE STACK, run inside shard_map
+    (manual over pp only). The head's cotangents ``dy_mb`` [M, B_m, ...] arrive
+    precomputed (the head VJP runs OUTSIDE the pipeline on the full batch), so every
+    tick is the same program on every device: one stage forward (garbage on idle ticks,
     masked on store) and one stage VJP (zero contribution on idle ticks via jnp.where —
-    never multiply-by-mask, which would propagate NaN from garbage compute). Collectives
-    (the two ppermutes) are OUTSIDE all conditionals, so no device can deadlock a peer.
+    never multiply-by-mask, which would propagate NaN from garbage compute). That
+    uniformity is load-bearing: stage_fn may contain auto-axis collectives (tp psums)
+    inserted by GSPMD, and a per-stage branch around them would deadlock the mesh —
+    there are NO conditionals around compute here, and the two ppermutes per tick run
+    unconditionally.
     """
     idx = lax.axis_index(axis_name)
     n = lax.axis_size(axis_name)
@@ -328,34 +332,22 @@ def _pipeline_1f1b_bwd_kernel(
     g_buf0 = jnp.zeros((sched.g_buf, *mb_shape), jnp.float32)
     dx_buf0 = jnp.zeros_like(x_mb, jnp.float32)
     dp0 = _zeros_f32(p_local)
-    dh0 = _zeros_f32(head_params)
 
     fwd_t = jnp.asarray(sched.fwd)
     bwd_t = jnp.asarray(sched.bwd)
     arr_f_t = jnp.asarray(sched.arr_f)
     arr_b_t = jnp.asarray(sched.arr_b)
 
-    def head_branch(p, hp, x_b, _dy, ex):
-        def f(p, hp, x):
-            return head_loss_fn(hp, stage_fn(p, x), ex).astype(jnp.float32)
-
-        loss, grads = jax.value_and_grad(f, argnums=(0, 1, 2))(p, hp, x_b)
-        dp, dhp, dx = grads
-        return loss, dp, dhp, dx.astype(jnp.float32)
-
-    def plain_branch(p, hp, x_b, dy, _ex):
+    def stage_vjp(p, x_b, dy):
         def f(p, x):
             y = stage_fn(p, x)
             return jnp.sum(y.astype(jnp.float32) * dy)
 
         dp, dx = jax.grad(f, argnums=(0, 1))(p, x_b)
-        # Zeros in hp's OWN dtypes: lax.cond requires both branches to produce identical
-        # types, and head_branch's dhp arrives in the head params' dtype (e.g. bf16).
-        dhp = jax.tree_util.tree_map(jnp.zeros_like, hp)
-        return jnp.zeros((), jnp.float32), dp, dhp, dx.astype(jnp.float32)
+        return dp, dx.astype(jnp.float32)
 
     def tick(carry, rows):
-        recv_f, recv_b, in_buf, g_buf, dx_buf, dp_acc, dh_acc, loss_acc = carry
+        recv_f, recv_b, in_buf, g_buf, dx_buf, dp_acc = carry
         f_row, b_row, af_row, ab_row = rows
         af = af_row[idx]
         ab = ab_row[idx]
@@ -393,18 +385,19 @@ def _pipeline_1f1b_bwd_kernel(
         )
         y = stage_fn(p_local, x_in)
 
-        # 3) Backward (remat): recompute this stage's forward inside the VJP.
+        # 3) Backward (remat): recompute this stage's forward inside the VJP. The last
+        # stage takes its cotangent from the precomputed head-VJP table; others from
+        # the grad arriving up the chain. Uniform program either way.
         bm_c = jnp.clip(bm, 0, M - 1)
         x_b = lax.dynamic_index_in_dim(in_buf, bm_c % sched.n_buf, 0, False)
-        dy = lax.dynamic_index_in_dim(g_buf, bm_c % sched.g_buf, 0, False)
-        ex = _mb_index(extras_mb, bm_c)
-        loss_m, dp, dhp, dx = lax.cond(
-            is_last, head_branch, plain_branch, p_local, head_params, x_b, dy, ex
+        dy = jnp.where(
+            is_last,
+            lax.dynamic_index_in_dim(dy_mb, bm_c, 0, False),
+            lax.dynamic_index_in_dim(g_buf, bm_c % sched.g_buf, 0, False),
         )
+        dp, dx = stage_vjp(p_local, x_b, dy)
         live = bm >= 0
         dp_acc = _where_tree(live, jax.tree_util.tree_map(jnp.add, dp_acc, dp), dp_acc)
-        dh_acc = _where_tree(live, jax.tree_util.tree_map(jnp.add, dh_acc, dhp), dh_acc)
-        loss_acc = jnp.where(live, loss_acc + loss_m, loss_acc)
         dx_buf = jnp.where(
             jnp.logical_and(live, idx == 0),
             lax.dynamic_update_index_in_dim(dx_buf, dx, bm_c, 0),
@@ -414,26 +407,22 @@ def _pipeline_1f1b_bwd_kernel(
         # 4) Sends — unconditional collectives (receivers bank only per their tables).
         recv_f = lax.ppermute(y, axis_name, perm_f)
         recv_b = lax.ppermute(dx, axis_name, perm_b)
-        return (recv_f, recv_b, in_buf, g_buf, dx_buf, dp_acc, dh_acc, loss_acc), None
+        return (recv_f, recv_b, in_buf, g_buf, dx_buf, dp_acc), None
 
     carry0 = (
         jnp.zeros(mb_shape, x_mb.dtype), jnp.zeros(mb_shape, jnp.float32),
-        in_buf0, g_buf0, dx_buf0, dp0, dh0, jnp.zeros((), jnp.float32),
+        in_buf0, g_buf0, dx_buf0, dp0,
     )
     rows = (fwd_t, bwd_t, arr_f_t, arr_b_t)
-    (_, _, _, _, dx_buf, dp_acc, dh_acc, _loss), _ = lax.scan(tick, carry0, rows)
+    (_, _, _, _, dx_buf, dp_acc), _ = lax.scan(tick, carry0, rows)
 
-    ctf = ct.astype(jnp.float32)
-    # dp is per-stage (stays sharded over pp, leading dim re-added); dh and dx are psum'd
-    # across stages (head grads live only on the last stage, dx only on stage 0).
-    dp_out = jax.tree_util.tree_map(lambda a: (a * ctf)[None], dp_acc)
-    dh_out = jax.tree_util.tree_map(
-        lambda a: lax.psum(a * ctf, axis_name), dh_acc
-    )
+    # dp is per-stage (stays sharded over pp, leading dim re-added); dx lives only on
+    # stage 0 — psum replicates it across stages.
+    dp_out = jax.tree_util.tree_map(lambda a: a[None], dp_acc)
     dx_out = lax.psum(
-        jnp.where(idx == 0, dx_buf * ctf, jnp.zeros_like(dx_buf)), axis_name
+        jnp.where(idx == 0, dx_buf, jnp.zeros_like(dx_buf)), axis_name
     )
-    return dp_out, dh_out, dx_out
+    return dp_out, dx_out
 
 
 def make_pipeline_loss_fn(
@@ -449,32 +438,30 @@ def make_pipeline_loss_fn(
 
     - ``stage_fn(stage_params_one_stage, x_mb) -> y_mb`` (shape-stable, like
       ``pipeline_apply``; no aux returns — MoE configs use the GPipe path).
-    - ``head_loss_fn(head_params, y_mb, extras_mb) -> scalar`` must be SUM-style over its
-      microbatch (sums across microbatches add up to the full-batch loss; put any
-      normalization outside). It runs on the LAST stage only under 1f1b.
+    - ``head_loss_fn(head_params, y, extras) -> scalar`` must be SUM-style (sums across
+      microbatches add up to the full-batch loss; put any normalization outside). It
+      runs on the FULL batch outside the pipeline, both in the primal and in the
+      backward's head VJP — so it keeps ordinary GSPMD semantics (a tp-sharded head
+      stays sharded; no gather, no shard_map nesting).
     - ``extras`` is a pytree of [B, ...] arrays (targets, masks); integer leaves get
       ``float0`` cotangents.
 
-    Head-param placement in the backward: the shard_map is manual over ``pp`` only, so
-    head params enter replicated along pp (in_spec ``P()``) — GSPMD all-gathers JUST the
-    pp factor of any pp-sharded head leaf for the backward and psums ``d_head`` back.
-    Shardings on the AUTO axes (tp/fsdp vocab sharding from
-    ``partition_specs(pp=True)``) pass straight through, so the transient per-device
-    head bytes are head/(tp·fsdp), not a full replica; the resident layout keeps the
-    full (tp, fsdp, pp) sharding.
-
-    The 1f1b loss is a scalar differentiable via ``jax.grad`` like any other: the primal
-    is a forward-only pipeline (no per-tick residuals), the custom backward replays
-    forward+backward together with at most ``n_stages + 2`` in-flight microbatch inputs
-    per stage (AD-GPipe holds all M). Compute cost is identical to remat-full GPipe.
+    The 1f1b loss is a scalar differentiable via ``jax.grad`` like any other. The
+    primal runs a forward-only pipeline and saves the last-stage output ``y`` [B, ..]
+    (ONE activation tensor) as a residual; the backward first differentiates the head
+    on the full batch (uniform GSPMD program → ``dy`` per microbatch + ``d_head``),
+    then replays forward+backward of the stage stack together under the static 1F1B
+    schedule with at most ``n_stages + 2`` in-flight microbatch inputs per stage
+    (AD-GPipe holds all M). Compute cost equals remat-full GPipe.
     """
     if schedule not in ("1f1b", "gpipe"):
         raise ValueError(f"schedule={schedule!r}: expected '1f1b' or 'gpipe'")
     n_stages = mesh.shape[axis_name]
     M = num_microbatches if num_microbatches is not None else n_stages
 
+    pipe = make_pipeline_fn(mesh, stage_fn, axis_name, M)
+
     if schedule == "gpipe":
-        pipe = make_pipeline_fn(mesh, stage_fn, axis_name, M)
 
         def gpipe_loss(stage_params, head_params, x, extras):
             y = pipe(stage_params, x)
@@ -484,47 +471,47 @@ def make_pipeline_loss_fn(
 
     sched = _simulate_1f1b(n_stages, M)
 
-    def _split_mb(tree, B):
-        return jax.tree_util.tree_map(
-            lambda a: a.reshape(M, B // M, *a.shape[1:]), tree
-        )
-
     @jax.custom_vjp
     def loss(stage_params, head_params, x, extras):
         # Primal: forward-only pipeline + full-batch head loss; saves nothing per-tick.
-        pipe = make_pipeline_fn(mesh, stage_fn, axis_name, M)
         y = pipe(stage_params, x)
         return head_loss_fn(head_params, y, extras)
 
     def loss_fwd(stage_params, head_params, x, extras):
-        return loss(stage_params, head_params, x, extras), (
-            stage_params, head_params, x, extras
+        y = pipe(stage_params, x)
+        return head_loss_fn(head_params, y, extras), (
+            stage_params, head_params, x, extras, y
         )
 
     def loss_bwd(res, ct):
-        stage_params, head_params, x, extras = res
+        stage_params, head_params, x, extras, y = res
         B = x.shape[0]
         if B % M:
             raise ValueError(f"batch {B} not divisible by {M} microbatches")
-        x_mb = x.reshape(M, B // M, *x.shape[1:])
-        extras_mb = _split_mb(extras, B)
 
+        # 1) Head VJP on the full batch, OUTSIDE the pipeline: ordinary auto-sharded
+        # GSPMD (tp-sharded heads keep their layout and collectives run uniformly).
+        (dh, dy) = jax.vjp(
+            lambda hp, yy: head_loss_fn(hp, yy, extras), head_params, y
+        )[1](jnp.asarray(ct, jnp.float32))
+        dy_mb = dy.astype(jnp.float32).reshape(M, B // M, *y.shape[1:])
+        x_mb = x.reshape(M, B // M, *x.shape[1:])
+
+        # 2) 1F1B replay over the stage stack with the precomputed cotangents.
         specs_params = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
-        rep = jax.tree_util.tree_map(lambda _: P(), head_params)
         mapped = jax.shard_map(
             functools.partial(
-                _pipeline_1f1b_bwd_kernel, stage_fn, head_loss_fn, sched, axis_name
+                _pipeline_1f1b_bwd_kernel, stage_fn, sched, axis_name
             ),
             mesh=mesh,
-            in_specs=(specs_params, rep, P(), jax.tree_util.tree_map(lambda _: P(), extras_mb), P()),
-            out_specs=(specs_params, rep, P()),
-            # Manual over pp ONLY (like make_pipeline_fn): on composed meshes the other
-            # axes (dp/fsdp/tp) stay auto so GSPMD keeps the batch dp-sharded and the
-            # stage/head params tp/fsdp-sharded instead of gathering them everywhere.
+            in_specs=(specs_params, P(), P()),
+            out_specs=(specs_params, P()),
+            # Manual over pp ONLY (like make_pipeline_fn): other axes stay auto so the
+            # batch keeps its dp sharding and stage params their tp/fsdp sharding.
             axis_names={axis_name},
             check_vma=False,
         )
-        dp, dh, dx_mb = mapped(stage_params, head_params, x_mb, extras_mb, jnp.asarray(ct))
+        dp, dx_mb = mapped(stage_params, x_mb, dy_mb)
         dp = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), dp, stage_params)
         dh = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), dh, head_params)
         dx = dx_mb.reshape(B, *x.shape[1:]).astype(x.dtype)

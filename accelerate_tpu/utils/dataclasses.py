@@ -516,6 +516,10 @@ class MegatronLMPlugin(KwargsHandler):
     pp_degree: int = 1
     sp_degree: int = 1
     num_micro_batches: Optional[int] = None
+    # Pipeline schedule for pp_degree > 1 ("gpipe" | "1f1b") — the knob behind the
+    # reference's virtual-pipeline/1F1B intent (``dataclasses.py:2024``); validated by
+    # the expanded PipelineParallelPlugin.
+    pp_schedule: str = "gpipe"
     gradient_clipping: Optional[float] = 1.0
     use_distributed_optimizer: bool = True  # == ZeRO-1 on the data axis
 

@@ -30,6 +30,8 @@ def main():
     parser.add_argument("--smoke", action="store_true")
     parser.add_argument("--cpu", action="store_true")
     parser.add_argument("--tp", type=int, default=2)
+    parser.add_argument("--pp", type=int, default=1, help="pipeline stages (>1 pipelines the blocks)")
+    parser.add_argument("--pp_schedule", default="gpipe", choices=["gpipe", "1f1b"])
     parser.add_argument("--num_micro_batches", type=int, default=2)
     parser.add_argument("--steps", type=int, default=6)
     parser.add_argument("--lr", type=float, default=3e-3)
@@ -37,9 +39,11 @@ def main():
 
     plugin = MegatronLMPlugin(
         tp_degree=args.tp,
+        pp_degree=args.pp,
+        pp_schedule=args.pp_schedule,
         num_micro_batches=args.num_micro_batches,  # pp=1 → becomes gradient accumulation
         gradient_clipping=1.0,
-        use_distributed_optimizer=True,            # ZeRO-1 over the data axis
+        use_distributed_optimizer=args.pp == 1,    # ZeRO-1 over the data axis
     )
     accelerator = Accelerator(cpu=args.cpu, megatron_lm_plugin=plugin)
     set_seed(42)
@@ -52,21 +56,38 @@ def main():
     cfg = dataclasses.replace(
         gpt.CONFIGS["tiny"], dtype=jnp.float32,
         pos="rotary", parallel_residual=True,      # NeoX-style, the Megatron GPT shape
+        scan_layers=args.pp > 1, n_layers=2 * max(args.pp, 1),
     )
     params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    if args.pp > 1:
+        from accelerate_tpu.parallel.pp import split_params_into_stages
+
+        params["layers"] = split_params_into_stages(params["layers"], args.pp)
     tx = accelerator.prepare(optax.adamw(args.lr))
     state = accelerator.create_train_state(
-        params, tx, partition_specs=gpt.partition_specs(cfg)
+        params, tx, partition_specs=gpt.partition_specs(cfg, pp=args.pp > 1)
     )
-    # ZeRO-1 proof on a DISCRIMINATING leaf: w_up's param spec is P(None, "tp") — no fsdp
-    # axis — so its optimizer moment only acquires "fsdp" through the distributed-optimizer
-    # (ZeRO-1) sharding. (wte would be vacuous: its param spec already includes fsdp.)
-    mu = state.opt_state[0].mu
-    mu_spec = mu["layers"][0]["w_up"].sharding.spec
-    flat_axes = [a for entry in mu_spec for a in (entry if isinstance(entry, tuple) else (entry,))]
-    assert "fsdp" in flat_axes, f"ZeRO-1 did not shard the optimizer state: {mu_spec}"
-
-    step = accelerator.build_train_step(lambda p, b: gpt.loss_fn(p, b, cfg))
+    if args.pp == 1:
+        # ZeRO-1 proof on a DISCRIMINATING leaf: w_up's param spec is P(None, "tp") — no
+        # fsdp axis — so its optimizer moment only acquires "fsdp" through the
+        # distributed-optimizer (ZeRO-1) sharding. (wte would be vacuous: its param spec
+        # already includes fsdp.)
+        mu = state.opt_state[0].mu
+        mu_spec = mu["layers"][0]["w_up"].sharding.spec
+        flat_axes = [a for entry in mu_spec for a in (entry if isinstance(entry, tuple) else (entry,))]
+        assert "fsdp" in flat_axes, f"ZeRO-1 did not shard the optimizer state: {mu_spec}"
+        step = accelerator.build_train_step(lambda p, b: gpt.loss_fn(p, b, cfg))
+    else:
+        # tp×pp in one job — the reference's integrated Megatron engine composition
+        # (megatron_lm.py:926), schedule from the plugin (gpipe or 1f1b).
+        assert state.params["layers"]["wqkv"].sharding.spec[0] == "pp"
+        step = accelerator.build_train_step(
+            lambda p, b: gpt.loss_fn_pp(
+                p, b, cfg, accelerator.mesh,
+                num_microbatches=accelerator.num_microbatches,
+                schedule=accelerator.pp_schedule,
+            )
+        )
     rng = np.random.default_rng(0)
     seq = 33 if args.smoke else 129
 

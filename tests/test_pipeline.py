@@ -531,3 +531,95 @@ def test_prepare_pippy_logits_match_plain_forward():
     assert pp_params["layers"]["wq"].sharding.spec[0] == "pp"
     piped = forward(tokens)
     np.testing.assert_allclose(np.asarray(piped), np.asarray(plain), atol=2e-4, rtol=1e-4)
+
+
+# --------------------------------------------------------------------- gpt family pp
+@slow
+@pytest.mark.parametrize("schedule,M", [("gpipe", 4), ("1f1b", 8)])
+def test_gpt_pp_matches_single(schedule, M):
+    """The reference's Megatron engine runs GPT with pp; our gpt family gets the same
+    pipeline contract as llama (both schedules), including the gpt-j-style untied,
+    BIASED lm_head through the 1F1B last-stage loss."""
+    import dataclasses as _dc
+
+    from accelerate_tpu.models import gpt
+
+    cfg = _dc.replace(
+        gpt.CONFIGS["tiny"], dtype=jnp.float32, scan_layers=True, n_layers=4,
+        tie_embeddings=False, lm_head_bias=True, pos="rotary",
+        parallel_residual=True,
+    )
+    params = gpt.init_params(cfg)
+    # A nonzero head bias so the biased path is actually load-bearing in the parity.
+    params["b_lm_head"] = jnp.asarray(
+        np.random.default_rng(2).normal(size=(cfg.vocab_size,)) * 0.1, jnp.float32
+    )
+    rng = np.random.default_rng(0)
+    batch = {"tokens": jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (8, 17)), jnp.int32)}
+    base = float(gpt.loss_fn(params, batch, cfg))
+    base_g = jax.grad(lambda p: gpt.loss_fn(p, batch, cfg))(params)
+
+    mesh = build_mesh(MeshConfig(dp=2, pp=4))
+    sp = dict(params)
+    sp["layers"] = split_params_into_stages(params["layers"], 4)
+    with jax.set_mesh(mesh):
+        l, g = jax.jit(jax.value_and_grad(
+            lambda p, b: gpt.loss_fn_pp(
+                p, b, cfg, mesh, num_microbatches=M, schedule=schedule)
+        ))(sp, batch)
+    np.testing.assert_allclose(float(l), base, rtol=1e-5)
+    expected = dict(base_g)
+    expected["layers"] = split_params_into_stages(base_g["layers"], 4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5
+        ),
+        dict(g), expected,
+    )
+
+
+@slow
+def test_llama_pp_1f1b_with_tensor_parallel():
+    """Regression: 1F1B on a tp x pp mesh. The first 1F1B kernel branched the head/stage
+    VJP per stage with lax.cond; GSPMD's tp collectives inside the branch then
+    deadlocked the mesh (only last-stage devices arrived at the rendezvous). The
+    restructure runs the head VJP OUTSIDE the pipeline and keeps the per-tick program
+    uniform — this test deadlocks (times out) if that regresses. The head loss is the
+    vocab-sharded fused_tp kernel, legal under 1f1b since that restructure."""
+    import dataclasses as _dc
+    import optax as _optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.parallel.pp import split_params_into_stages
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    cfg = _dc.replace(
+        llama.CONFIGS["tiny"], dtype=jnp.float32, attn_impl="xla", scan_layers=True,
+        n_layers=4, tie_embeddings=False, loss_impl="fused_tp",
+    )
+    cfg_base = _dc.replace(cfg, loss_impl="auto")
+    params = llama.init_params(cfg)
+    rng = np.random.default_rng(0)
+    jbatch = {"tokens": jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (8, 17)).astype(np.int32))}
+    base_loss = float(llama.loss_fn(params, jbatch, cfg_base))
+
+    for s in (AcceleratorState, GradientState, PartialState):
+        s._reset_state()
+    acc = Accelerator(mesh_config=MeshConfig(dp=2, tp=2, pp=2))
+    stage_params = dict(params)
+    stage_params["layers"] = split_params_into_stages(params["layers"], 2)
+    state = acc.create_train_state(
+        stage_params, _optax.sgd(0.1),
+        partition_specs=llama.partition_specs(cfg, pp=True),
+    )
+    assert state.params["layers"]["wq"].sharding.spec[3] == "tp"
+    step = acc.build_train_step(
+        lambda p, b: llama.loss_fn_pp(
+            p, b, cfg, acc.mesh, num_microbatches=4, schedule="1f1b"
+        )
+    )
+    state, metrics = step(state, jbatch)
+    np.testing.assert_allclose(float(metrics["loss"]), base_loss, rtol=1e-5)
