@@ -42,9 +42,13 @@ def prepare_pippy(
     num_microbatches: Optional[int] = None,
     split_points: str = "auto",
 ):
-    """llama-family params → (stage-sharded params, jitted pipelined logits fn).
+    """Model params → (stage-sharded params, jitted pipelined logits fn).
 
-    - ``params``: ``models.llama`` params with per-layer list OR scan-stacked layers; they
+    - ``cfg`` selects the family by type: ``models.llama.LlamaConfig`` or
+      ``models.gpt.GPTConfig`` (both expose the same pp contract —
+      ``partition_specs(pp=True)`` / ``forward_pp``; the reference's ``prepare_pippy``
+      is likewise model-generic, ``inference.py:124``).
+    - ``params``: family params with per-layer list OR scan-stacked layers; they
       are stage-stacked ``[n_stages, L/n, ...]`` and placed with
       ``partition_specs(cfg, pp=True)`` (stage dim over the mesh ``pp`` axis).
     - ``split_points="auto"``: layers divide evenly over stages (the reference's
@@ -56,8 +60,17 @@ def prepare_pippy(
     import dataclasses
 
     from jax.sharding import NamedSharding
-    from .models import llama
+    from .models import gpt, llama
     from .parallel.pp import split_params_into_stages, stack_stage_params
+
+    if isinstance(cfg, gpt.GPTConfig):
+        family = gpt
+    elif isinstance(cfg, llama.LlamaConfig):
+        family = llama
+    else:
+        raise TypeError(
+            f"prepare_pippy supports llama/gpt family configs, got {type(cfg).__name__}"
+        )
 
     if mesh is None:
         from .state import AcceleratorState
@@ -77,17 +90,18 @@ def prepare_pippy(
         layers if _leading(layers) == n_stages and _second_dim_known(layers, cfg, n_stages)
         else split_params_into_stages(layers, n_stages)
     )
-    specs = llama.partition_specs(cfg, pp=True)
+    specs = family.partition_specs(cfg, pp=True)
     pp_params = jax.tree_util.tree_map(
         lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)), pp_params, specs
     )
 
     def forward(tokens: jax.Array) -> jax.Array:
-        x = llama.forward_pp(
+        x = family.forward_pp(
             pp_params, tokens, cfg, mesh, num_microbatches=num_microbatches
         )
-        head = pp_params["embed"].T if cfg.tie_embeddings else pp_params["lm_head"]
-        return (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+        # head_logits is part of the family contract (applies softcap / head bias),
+        # so the pipelined logits match the family's single-device forward exactly.
+        return family.head_logits(x, pp_params, cfg)
 
     jitted = jax.jit(forward)
 

@@ -40,6 +40,7 @@ __all__ = [
     "partition_specs",
     "forward_pp",
     "loss_fn_pp",
+    "head_logits",
     "init_cache",
     "forward_cached",
     "generate",
@@ -348,6 +349,12 @@ def forward(
 
 def _head_weight(params: dict, cfg: GPTConfig) -> jax.Array:
     return params["wte"].T if cfg.tie_embeddings else params["lm_head"]
+
+
+def head_logits(x, params: dict, cfg: GPTConfig) -> jax.Array:
+    """Final-hidden → fp32 logits incl. the optional lm_head bias — family pipeline
+    contract (see ``llama.head_logits``)."""
+    return _head_logits(x, params, cfg)
 
 
 def _head_logits(x, params: dict, cfg: GPTConfig) -> jax.Array:

@@ -39,6 +39,7 @@ __all__ = [
     "forward",
     "forward_hidden",
     "forward_pp",
+    "head_logits",
     "forward_streamed",
     "loss_fn",
     "loss_fn_pp",
@@ -738,12 +739,18 @@ def forward(
     """Causal LM: tokens [B, S] → logits [B, S, V] (fp32); with ``return_aux``, also the summed
     MoE load-balancing loss."""
     x, aux_total = forward_hidden(params, tokens, cfg, positions, shard_activations)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
-    logits = _softcap(logits, cfg.final_softcap)
+    logits = head_logits(x, params, cfg)
     if return_aux:
         return logits, aux_total
     return logits
+
+
+def head_logits(x, params: dict, cfg: LlamaConfig) -> jax.Array:
+    """Final-hidden → fp32 logits, incl. the Gemma final softcap — part of the model
+    family's pipeline contract (``inference.prepare_pippy`` calls it per family)."""
+    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+    return _softcap(logits, cfg.final_softcap)
 
 
 def _loss_chunk_size(cfg: LlamaConfig, S: int) -> int:

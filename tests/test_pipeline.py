@@ -623,3 +623,57 @@ def test_llama_pp_1f1b_with_tensor_parallel():
     )
     state, metrics = step(state, jbatch)
     np.testing.assert_allclose(float(metrics["loss"]), base_loss, rtol=1e-5)
+
+
+def test_prepare_pippy_gpt_logits_match_plain_forward():
+    """prepare_pippy is family-generic (the reference's is model-generic): gpt params
+    route to gpt.forward_pp + biased head."""
+    import dataclasses
+
+    from accelerate_tpu import prepare_pippy
+    from accelerate_tpu.models import gpt
+
+    cfg = dataclasses.replace(
+        gpt.CONFIGS["tiny"], dtype=jnp.float32, n_layers=4,
+        scan_layers=False,  # per-layer list input: prepare_pippy stage-stacks it
+        tie_embeddings=False, lm_head_bias=True,
+    )
+    params = gpt.init_params(cfg)
+    params["b_lm_head"] = jnp.asarray(
+        np.random.default_rng(3).normal(size=(cfg.vocab_size,)) * 0.1, jnp.float32
+    )
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(8, 16)).astype(np.int32)
+    plain = gpt.forward(params, jnp.asarray(tokens), cfg, shard_activations=False)
+
+    mesh = build_mesh(MeshConfig(dp=2, pp=4))
+    pp_params, forward = prepare_pippy(params, cfg, mesh=mesh, num_microbatches=4)
+    assert pp_params["layers"]["wqkv"].sharding.spec[0] == "pp"
+    piped = forward(tokens)
+    np.testing.assert_allclose(np.asarray(piped), np.asarray(plain), atol=2e-4, rtol=1e-4)
+
+
+def test_prepare_pippy_softcap_and_unknown_config():
+    """Gemma-style final_softcap must survive the pipelined head (regression: the old
+    inline head skipped it), and non-llama/gpt configs fail fast with a clear error."""
+    import dataclasses
+
+    from accelerate_tpu import prepare_pippy
+    from accelerate_tpu.models import llama
+
+    cfg = dataclasses.replace(
+        llama.CONFIGS["tiny"], dtype=jnp.float32, attn_impl="xla", n_layers=4,
+        scan_layers=True, final_softcap=5.0,
+    )
+    params = llama.init_params(cfg)
+    tokens = np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(8, 16)).astype(np.int32)
+    plain = llama.forward(params, jnp.asarray(tokens), cfg, shard_activations=False)
+    mesh = build_mesh(MeshConfig(dp=2, pp=4))
+    _, forward = prepare_pippy(params, cfg, mesh=mesh, num_microbatches=4)
+    np.testing.assert_allclose(
+        np.asarray(forward(tokens)), np.asarray(plain), atol=2e-4, rtol=1e-4
+    )
+
+    with pytest.raises(TypeError, match="llama/gpt"):
+        prepare_pippy({}, object(), mesh=mesh)
