@@ -344,7 +344,7 @@ def forward(
     x = _layer_norm(x, params["ln_f"], cfg.norm_eps)
     if return_hidden:
         return x
-    return _head_logits(x, params, cfg)
+    return head_logits(x, params, cfg)
 
 
 def _head_weight(params: dict, cfg: GPTConfig) -> jax.Array:
@@ -354,10 +354,6 @@ def _head_weight(params: dict, cfg: GPTConfig) -> jax.Array:
 def head_logits(x, params: dict, cfg: GPTConfig) -> jax.Array:
     """Final-hidden → fp32 logits incl. the optional lm_head bias — family pipeline
     contract (see ``llama.head_logits``)."""
-    return _head_logits(x, params, cfg)
-
-
-def _head_logits(x, params: dict, cfg: GPTConfig) -> jax.Array:
     logits = (x @ _head_weight(params, cfg).astype(cfg.dtype)).astype(jnp.float32)
     if cfg.lm_head_bias and "b_lm_head" in params:
         logits = logits + params["b_lm_head"].astype(jnp.float32)

@@ -1413,8 +1413,7 @@ def forward_cached(
     x = _rms_norm(x, params["ln_f"], cfg.norm_eps, cfg.norm_plus_one)
     if last_only:
         x = x[:, -1:, :]
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = _softcap((x @ head.astype(dtype)).astype(jnp.float32), cfg.final_softcap)
+    logits = head_logits(x, params, cfg)
     new_cache = {"layers": new_layers, "valid": valid, "index": index + T}
     return logits, new_cache
 

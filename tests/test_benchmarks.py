@@ -13,13 +13,23 @@ from accelerate_tpu.test_utils.testing import slow
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def _run(args, timeout=600):
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "cpu"
+def _smoke_env(**extra):
+    """Child env for benchmark subprocesses: single CPU device. The parent pytest
+    process carries conftest's --xla_force_host_platform_device_count=8, which would
+    otherwise leak in and hand facade-based rows an 8-device mesh."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", **extra)
+    env["XLA_FLAGS"] = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_force_host_platform_device_count" not in f
+    )
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def _run(args, timeout=600):
     out = subprocess.run(
         [sys.executable, *args], capture_output=True, text=True, timeout=timeout,
-        env=env, cwd=REPO,
+        env=_smoke_env(), cwd=REPO,
     )
     assert out.returncode == 0, out.stderr[-2000:]
     return json.loads(out.stdout.strip().splitlines()[-1])
@@ -48,9 +58,7 @@ def test_big_model_inference_t5_smoke(tmp_path):
 
 @slow
 def test_decompose_smoke():
-    env_extra = {"BENCH_PRESET": "smoke"}
-    env = dict(os.environ, **env_extra)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env = _smoke_env(BENCH_PRESET="smoke")
     out = subprocess.run(
         [sys.executable, "benchmarks/decompose.py"], capture_output=True, text=True,
         timeout=600, env=env, cwd=REPO,
@@ -67,8 +75,7 @@ def test_decompose_smoke():
 
 @slow
 def test_step_attrib_smoke():
-    env = dict(os.environ, BENCH_PRESET="smoke")
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env = _smoke_env(BENCH_PRESET="smoke")
     out = subprocess.run(
         [sys.executable, "benchmarks/step_attrib.py"], capture_output=True, text=True,
         timeout=900, env=env, cwd=REPO,
