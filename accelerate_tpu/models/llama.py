@@ -454,6 +454,13 @@ def _attention_xla(q, k, v, mask, cfg: LlamaConfig):
     return jnp.einsum("bkgst,btkd->bskgd", probs, v).reshape(B, S, H, hd)
 
 
+def _sp_active(mesh) -> bool:
+    """Does this mesh (concrete or abstract; may be None) engage the sp axis? The ONE
+    copy of the sequence-parallel activation predicate — shared by ``_attention`` (on
+    the ambient mesh) and ``loss_fn_pp``'s sp-under-pp guard (on its mesh argument)."""
+    return mesh is not None and not mesh.empty and mesh.shape.get(SEQUENCE_AXIS, 1) > 1
+
+
 def _attention(q, k, v, mask, cfg: LlamaConfig, segment_ids=None):
     impl = cfg.attn_impl
     if impl in ("ring", "ulysses", "allgather"):
@@ -462,7 +469,7 @@ def _attention(q, k, v, mask, cfg: LlamaConfig, segment_ids=None):
         # and score capping flow into the kernels with GLOBAL offsets, so they stay
         # correct across the sequence shards.
         mesh = jax.sharding.get_abstract_mesh()
-        if mesh is not None and not mesh.empty and mesh.shape.get(SEQUENCE_AXIS, 1) > 1:
+        if _sp_active(mesh):
             from ..parallel.sequence import make_sp_attention
 
             attn = make_sp_attention(
@@ -1126,6 +1133,20 @@ def loss_fn_pp(
         # Mirrors PipelineParallelPlugin's validation: an unrecognized schedule (e.g. a
         # typo'd ACCELERATE_PP_SCHEDULE) must not silently run GPipe.
         raise ValueError(f"schedule={schedule!r}: expected 'gpipe' or '1f1b'")
+    if cfg.attn_impl in ("ring", "ulysses", "allgather"):
+        # Check the mesh ARGUMENT (the one the pipeline's shard_map will run under),
+        # not just the ambient context — callers may pass it without jax.set_mesh.
+        if _sp_active(mesh) or _sp_active(jax.sharding.get_abstract_mesh()):
+            # The sp-attention shard_map nests inside the pipeline's shard_map; the
+            # FORWARD lowers and matches (prepare_pippy inference works), but jax
+            # cannot lower the nested structure's backward (MLIR verification failure).
+            # Raise here rather than crash opaquely at grad time.
+            raise NotImplementedError(
+                f"attn_impl={cfg.attn_impl!r} (sequence-parallel attention) cannot "
+                "TRAIN inside the pipeline today: the nested shard_map backward fails "
+                "to lower. Use attn_impl='flash'/'xla' within pp stages, or sp without "
+                "pp (forward-only pipelining via prepare_pippy does work)."
+            )
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     B, S = inputs.shape

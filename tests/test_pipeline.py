@@ -677,3 +677,27 @@ def test_prepare_pippy_softcap_and_unknown_config():
 
     with pytest.raises(TypeError, match="llama/gpt"):
         prepare_pippy({}, object(), mesh=mesh)
+
+
+def test_llama_pp_training_rejects_sp_attention():
+    """sp attention modes cannot TRAIN inside the pipeline (the nested shard_map
+    backward fails to lower in XLA); loss_fn_pp raises a clear error instead of
+    crashing opaquely at grad time. Forward-only pipelining (prepare_pippy) still
+    works for these modes."""
+    import dataclasses as _dc
+
+    from accelerate_tpu.models import llama
+
+    cfg = _dc.replace(
+        llama.CONFIGS["tiny"], dtype=jnp.float32, attn_impl="ring", scan_layers=True,
+        n_layers=4,
+    )
+    params = llama.init_params(cfg)
+    sp = dict(params)
+    sp["layers"] = split_params_into_stages(params["layers"], 2)
+    batch = {"tokens": jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 17)), jnp.int32)}
+    mesh = build_mesh(MeshConfig(dp=2, sp=2, pp=2))
+    with jax.set_mesh(mesh):
+        with pytest.raises(NotImplementedError, match="cannot TRAIN inside the pipeline"):
+            llama.loss_fn_pp(sp, batch, cfg, mesh, num_microbatches=4)
