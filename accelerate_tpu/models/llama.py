@@ -1016,6 +1016,15 @@ def perplexity(
 
 
 # --------------------------------------------------------------- pipeline-parallel training
+def _pp_microbatches(mesh, num_microbatches) -> int:
+    """Resolve M (None → n_stages, make_pipeline_fn's default) — the ONE copy of the
+    default both forward_pp's aux normalization and loss_fn_pp's 1f1b aux_weight use,
+    so GPipe and 1F1B cannot drift to differently-scaled MoE aux objectives."""
+    from ..utils.constants import PIPELINE_AXIS as _PP
+
+    return num_microbatches if num_microbatches is not None else mesh.shape[_PP]
+
+
 def _pp_stage_fn(cfg: LlamaConfig, S: int, with_aux: bool):
     """One pipeline stage body, shared by the GPipe (forward_pp) and 1F1B (loss_fn_pp)
     schedules so their numerics cannot drift: scan this stage's blocks over one
@@ -1086,10 +1095,7 @@ def forward_pp(
         # moe_aux_weight meaning the same thing as the non-pipelined path — otherwise
         # retuning num_microbatches (a throughput knob) would silently rescale the
         # training objective.
-        from ..utils.constants import PIPELINE_AXIS as _PP
-
-        M = num_microbatches if num_microbatches is not None else mesh.shape[_PP]
-        aux = aux / M
+        aux = aux / _pp_microbatches(mesh, num_microbatches)
     else:
         x, aux = pipe(params["layers"], x), jnp.zeros((), jnp.float32)
     x = _rms_norm(x, params["ln_f"], cfg.norm_eps, cfg.norm_plus_one)
@@ -1124,7 +1130,8 @@ def loss_fn_pp(
     VJP's hand-scheduled one-forward-one-backward keeps in-flight activations bounded by
     the stage count instead of ``num_microbatches``. ln_f + the CE head run OUTSIDE the
     pipeline on the full batch (ordinary GSPMD — every ``loss_impl`` incl. the fused
-    kernels works); dense configs only (MoE uses GPipe)."""
+    kernels works); MoE stages carry their load-balancing aux through the replay with
+    the same /num_microbatches normalization as GPipe."""
     if "segment_ids" in batch:
         raise NotImplementedError(
             "sample packing (segment_ids) is not supported on the pipeline-parallel path"
@@ -1144,8 +1151,10 @@ def loss_fn_pp(
             raise NotImplementedError(
                 f"attn_impl={cfg.attn_impl!r} (sequence-parallel attention) cannot "
                 "TRAIN inside the pipeline today: the nested shard_map backward fails "
-                "to lower. Use attn_impl='flash'/'xla' within pp stages, or sp without "
-                "pp (forward-only pipelining via prepare_pippy does work)."
+                "to lower (both schedules). Use attn_impl='flash'/'xla' within pp "
+                "stages, or sp without pp. Forward-only use (the nested forward lowers "
+                "and matches) is available via forward_pp + head_logits or "
+                "prepare_pippy."
             )
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
@@ -1156,27 +1165,33 @@ def loss_fn_pp(
         else jnp.ones((B, S), jnp.float32)
     )
     if schedule == "1f1b":
-        if cfg.moe_experts > 0:
-            raise NotImplementedError(
-                "schedule='1f1b' supports dense configs only (MoE aux collection runs "
-                "on the GPipe path; pass schedule='gpipe')"
-            )
         from ..parallel.pp import make_pipeline_loss_fn
 
         dtype = cfg.dtype
-        stage_fn = _pp_stage_fn(cfg, S, with_aux=False)
+        is_moe = cfg.moe_experts > 0
+        M = _pp_microbatches(mesh, num_microbatches)
+        stage_fn = _pp_stage_fn(cfg, S, with_aux=is_moe)
         head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
         hp = {"ln_f": params["ln_f"], "head": head}
+
+        def head_loss(h, y, ex):
+            # MEAN-normalized inside (the head runs on the FULL batch, so the denom is
+            # exact here) — the aux term must NOT be divided by the token count.
+            return _head_ce_sum(h, y, ex, cfg=cfg) / jnp.maximum(ex["mask"].sum(), 1.0)
+
         pipe_loss = make_pipeline_loss_fn(
-            mesh, stage_fn, partial(_head_ce_sum, cfg=cfg),
+            mesh, stage_fn, head_loss,
             num_microbatches=num_microbatches, schedule="1f1b",
+            with_aux=is_moe,
+            # Same normalization as the GPipe path: aux is a mean statistic summed over
+            # (stage, microbatch) pairs → divide by M so moe_aux_weight keeps its
+            # non-pipelined meaning.
+            aux_weight=(cfg.moe_aux_weight / M) if is_moe else 0.0,
         )
         x = params["embed"].astype(dtype)[inputs]
-        denom = jnp.maximum(mask.sum(), 1.0)
-        total = pipe_loss(
+        return pipe_loss(
             params["layers"], hp, x, {"targets": targets, "mask": mask}
         )
-        return total / denom
     x, aux = forward_pp(
         params, inputs, cfg, mesh, num_microbatches=num_microbatches, return_aux=True
     )

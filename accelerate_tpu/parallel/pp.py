@@ -305,8 +305,8 @@ def _zeros_f32(tree):
 
 
 def _pipeline_1f1b_bwd_kernel(
-    stage_fn, sched: _Schedule, axis_name,
-    stage_params, x_mb, dy_mb,
+    stage_fn, sched: _Schedule, axis_name, with_aux,
+    stage_params, x_mb, dy_mb, aux_ct,
 ):
     """The combined fwd+bwd 1F1B replay for the STAGE STACK, run inside shard_map
     (manual over pp only). The head's cotangents ``dy_mb`` [M, B_m, ...] arrive
@@ -338,10 +338,19 @@ def _pipeline_1f1b_bwd_kernel(
     arr_f_t = jnp.asarray(sched.arr_f)
     arr_b_t = jnp.asarray(sched.arr_b)
 
+    def run_stage(p, x):
+        """stage_fn normalized to (y, aux) — aux is 0.0 for dense stages."""
+        if with_aux:
+            return stage_fn(p, x)
+        return stage_fn(p, x), jnp.zeros((), jnp.float32)
+
     def stage_vjp(p, x_b, dy):
         def f(p, x):
-            y = stage_fn(p, x)
-            return jnp.sum(y.astype(jnp.float32) * dy)
+            y, aux = run_stage(p, x)
+            # The aux term (MoE load balancing) contributes ct·aux_weight directly per
+            # real (stage, microbatch) pair — aux_ct carries that scalar; masked ticks
+            # discard the whole dp/dx anyway.
+            return jnp.sum(y.astype(jnp.float32) * dy) + aux_ct * aux.astype(jnp.float32)
 
         dp, dx = jax.grad(f, argnums=(0, 1))(p, x_b)
         return dp, dx.astype(jnp.float32)
@@ -383,7 +392,7 @@ def _pipeline_1f1b_bwd_kernel(
             lax.dynamic_update_index_in_dim(in_buf, x_in, fm_c % sched.n_buf, 0),
             in_buf,
         )
-        y = stage_fn(p_local, x_in)
+        y, _ = run_stage(p_local, x_in)
 
         # 3) Backward (remat): recompute this stage's forward inside the VJP. The last
         # stage takes its cotangent from the precomputed head-VJP table; others from
@@ -427,22 +436,29 @@ def _pipeline_1f1b_bwd_kernel(
 
 def make_pipeline_loss_fn(
     mesh,
-    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_fn: Callable[[Any, jax.Array], Any],
     head_loss_fn: Callable[[Any, jax.Array, Any], jax.Array],
     axis_name: str = PIPELINE_AXIS,
     num_microbatches: Optional[int] = None,
     schedule: str = "1f1b",
+    with_aux: bool = False,
+    aux_weight: float = 0.0,
 ):
     """Build ``loss(stage_params, head_params, x [B, ...], extras) -> scalar`` with a
     hand-scheduled 1F1B backward (``schedule="1f1b"``) or AD-GPipe (``"gpipe"``).
 
     - ``stage_fn(stage_params_one_stage, x_mb) -> y_mb`` (shape-stable, like
-      ``pipeline_apply``; no aux returns — MoE configs use the GPipe path).
-    - ``head_loss_fn(head_params, y, extras) -> scalar`` must be SUM-style (sums across
-      microbatches add up to the full-batch loss; put any normalization outside). It
-      runs on the FULL batch outside the pipeline, both in the primal and in the
-      backward's head VJP — so it keeps ordinary GSPMD semantics (a tp-sharded head
-      stays sharded; no gather, no shard_map nesting).
+      ``pipeline_apply``). With ``with_aux``, stage_fn returns ``(y_mb, aux_scalar)``
+      (MoE load balancing) and the loss adds ``aux_weight * aux_total`` where
+      ``aux_total`` sums the real (stage, microbatch) pairs exactly like the GPipe
+      path (callers normalize via aux_weight, e.g. ``moe_aux_weight / M``).
+    - ``head_loss_fn(head_params, y, extras) -> scalar`` runs on the FULL batch outside
+      the pipeline, both in the primal and in the backward's head VJP — any scalar is
+      fine, including mean-normalized losses (llama passes CE / mask.sum(); the batch
+      is whole here, so the denominator is exact), and it keeps ordinary GSPMD
+      semantics (a tp-sharded head stays sharded; no gather, no shard_map nesting).
+      Note the aux term is added OUTSIDE head_loss_fn — normalize it via
+      ``aux_weight`` only.
     - ``extras`` is a pytree of [B, ...] arrays (targets, masks); integer leaves get
       ``float0`` cotangents.
 
@@ -459,13 +475,18 @@ def make_pipeline_loss_fn(
     n_stages = mesh.shape[axis_name]
     M = num_microbatches if num_microbatches is not None else n_stages
 
-    pipe = make_pipeline_fn(mesh, stage_fn, axis_name, M)
+    pipe = make_pipeline_fn(mesh, stage_fn, axis_name, M, with_aux=with_aux)
+
+    def _forward(stage_params, x):
+        if with_aux:
+            return pipe(stage_params, x)
+        return pipe(stage_params, x), jnp.zeros((), jnp.float32)
 
     if schedule == "gpipe":
 
         def gpipe_loss(stage_params, head_params, x, extras):
-            y = pipe(stage_params, x)
-            return head_loss_fn(head_params, y, extras)
+            y, aux_total = _forward(stage_params, x)
+            return head_loss_fn(head_params, y, extras) + aux_weight * aux_total
 
         return gpipe_loss
 
@@ -474,13 +495,14 @@ def make_pipeline_loss_fn(
     @jax.custom_vjp
     def loss(stage_params, head_params, x, extras):
         # Primal: forward-only pipeline + full-batch head loss; saves nothing per-tick.
-        y = pipe(stage_params, x)
-        return head_loss_fn(head_params, y, extras)
+        y, aux_total = _forward(stage_params, x)
+        return head_loss_fn(head_params, y, extras) + aux_weight * aux_total
 
     def loss_fwd(stage_params, head_params, x, extras):
-        y = pipe(stage_params, x)
-        return head_loss_fn(head_params, y, extras), (
-            stage_params, head_params, x, extras, y
+        y, aux_total = _forward(stage_params, x)
+        return (
+            head_loss_fn(head_params, y, extras) + aux_weight * aux_total,
+            (stage_params, head_params, x, extras, y),
         )
 
     def loss_bwd(res, ct):
@@ -501,17 +523,18 @@ def make_pipeline_loss_fn(
         specs_params = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
         mapped = jax.shard_map(
             functools.partial(
-                _pipeline_1f1b_bwd_kernel, stage_fn, sched, axis_name
+                _pipeline_1f1b_bwd_kernel, stage_fn, sched, axis_name, with_aux
             ),
             mesh=mesh,
-            in_specs=(specs_params, P(), P()),
+            in_specs=(specs_params, P(), P(), P()),
             out_specs=(specs_params, P()),
             # Manual over pp ONLY (like make_pipeline_fn): other axes stay auto so the
             # batch keeps its dp sharding and stage params their tp/fsdp sharding.
             axis_names={axis_name},
             check_vma=False,
         )
-        dp, dx_mb = mapped(stage_params, x_mb, dy_mb)
+        aux_ct = jnp.asarray(ct, jnp.float32) * aux_weight
+        dp, dx_mb = mapped(stage_params, x_mb, dy_mb, aux_ct)
         dp = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), dp, stage_params)
         dh = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), dh, head_params)
         dx = dx_mb.reshape(B, *x.shape[1:]).astype(x.dtype)

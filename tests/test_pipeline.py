@@ -701,3 +701,61 @@ def test_llama_pp_training_rejects_sp_attention():
     with jax.set_mesh(mesh):
         with pytest.raises(NotImplementedError, match="cannot TRAIN inside the pipeline"):
             llama.loss_fn_pp(sp, batch, cfg, mesh, num_microbatches=4)
+
+
+@slow
+def test_llama_pp_moe_1f1b_matches_single():
+    """MoE under the 1F1B schedule: exact CE parity in the no-drop regime, aux term at
+    the non-pipelined SCALE (masked per-tick aux, /M normalization), and router grads
+    actually flowing through the replay's aux_ct term."""
+    import dataclasses
+
+    from accelerate_tpu.models import llama
+
+    cfg = dataclasses.replace(
+        llama.CONFIGS["moe-tiny"], dtype=jnp.float32, attn_impl="xla", scan_layers=True,
+        moe_aux_weight=0.0, moe_capacity_factor=8.0,
+    )
+    params = llama.init_params(cfg)
+    rng = np.random.default_rng(0)
+    batch = {"tokens": jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (8, 17)), jnp.int32)}
+    base = float(llama.loss_fn(params, batch, cfg))
+    base_g = jax.grad(lambda p: llama.loss_fn(p, batch, cfg))(params)
+
+    mesh = build_mesh(MeshConfig(dp=2, ep=2, pp=2))
+    sp = dict(params)
+    sp["layers"] = split_params_into_stages(params["layers"], 2)
+    with jax.set_mesh(mesh):
+        l, g = jax.jit(jax.value_and_grad(
+            lambda p, b: llama.loss_fn_pp(
+                p, b, cfg, mesh, num_microbatches=4, schedule="1f1b")
+        ))(sp, batch)
+    np.testing.assert_allclose(float(l), base, rtol=1e-5)
+    expected = dict(base_g)
+    expected["layers"] = split_params_into_stages(base_g["layers"], 2)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5
+        ),
+        dict(g), expected,
+    )
+
+    # Aux scale + gradient flow with a real weight: the aux term stays ~1x the
+    # non-pipelined value (never ~M x), and the router weights get nonzero grads
+    # through the replay (they only touch the loss via the aux term here... via CE too,
+    # so check the aux-specific DELTA of the router grad instead of absolute).
+    cfg_aux = dataclasses.replace(cfg, moe_aux_weight=1.0)
+    base_aux_term = float(llama.loss_fn(params, batch, cfg_aux)) - base
+    with jax.set_mesh(mesh):
+        l_aux, g_aux = jax.jit(jax.value_and_grad(
+            lambda p, b: llama.loss_fn_pp(
+                p, b, cfg_aux, mesh, num_microbatches=4, schedule="1f1b")
+        ))(sp, batch)
+    ratio = (float(l_aux) - float(l)) / base_aux_term
+    assert 0.7 < ratio < 1.3, f"aux scale ratio {ratio}"
+    router_delta = np.abs(
+        np.asarray(g_aux["layers"]["moe"]["w_router"], np.float64)
+        - np.asarray(g["layers"]["moe"]["w_router"], np.float64)
+    ).max()
+    assert router_delta > 1e-6, "aux gradient did not flow through the 1F1B replay"
