@@ -1363,6 +1363,40 @@ class Accelerator:
             for tracker in self.trackers:
                 tracker.log(values, step=step, **(log_kwargs or {}).get(tracker.name, {}))
 
+    def log_images(self, values: dict, step: Optional[int] = None, log_kwargs: dict = None):
+        """Fan ``{name: image array}`` out to every tracker that supports images
+        (reference ``tracking.py:251``; unsupported backends warn and skip)."""
+        if self.is_main_process:
+            for tracker in self.trackers:
+                tracker.log_images(
+                    values, step=step, **(log_kwargs or {}).get(tracker.name, {})
+                )
+
+    def log_table(
+        self,
+        table_name: str,
+        columns: Optional[list] = None,
+        data: Optional[list] = None,
+        dataframe=None,
+        step: Optional[int] = None,
+        log_kwargs: dict = None,
+    ):
+        """Fan a table (``columns`` + ``data`` rows, or a pandas ``dataframe``) out to
+        every tracker that supports tables (reference ``tracking.py:360``)."""
+        if self.is_main_process:
+            for tracker in self.trackers:
+                tracker.log_table(
+                    table_name, columns=columns, data=data, dataframe=dataframe,
+                    step=step, **(log_kwargs or {}).get(tracker.name, {})
+                )
+
+    def log_artifact(self, file_path: str, name: Optional[str] = None):
+        """Upload a file to every tracker with an artifact store (MLflow/ClearML/WandB
+        analog of the reference's artifact logging)."""
+        if self.is_main_process:
+            for tracker in self.trackers:
+                tracker.log_artifact(file_path, name=name)
+
     def get_tracker(self, name: str, unwrap: bool = False):
         for tracker in self.trackers:
             if tracker.name == name:

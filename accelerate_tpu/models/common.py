@@ -25,6 +25,7 @@ import jax.numpy as jnp
 __all__ = [
     "remat_wrap", "kv_planes", "write_kv", "read_kv", "quant_kv",
     "fused_ce_allowed", "fused_ce_single_shard",
+    "resolve_loss_chunk", "chunked_ce", "ce_sum", "ce_sum_dispatch",
 ]
 
 
@@ -114,6 +115,188 @@ def read_kv(new_kv: dict, name: str, dtype) -> jax.Array:
     if f"{name}_scale" in new_kv:
         return new_kv[name].astype(dtype) * new_kv[f"{name}_scale"].astype(dtype)
     return new_kv[name]
+
+
+def _softcap(scores: jax.Array, cap: float) -> jax.Array:
+    """Gemma-style logit capping: cap·tanh(x/cap) (identity when cap == 0)."""
+    return cap * jnp.tanh(scores / cap) if cap else scores
+
+
+def resolve_loss_chunk(loss_chunk: int, S: int, vocab_size: int) -> int:
+    """Resolve the chunked-CE chunk length (0 tokens = don't chunk).
+
+    An explicit ``loss_chunk`` is always honored (``chunked_ce`` pads S up to a chunk
+    multiple, so divisibility never silently disables it). Auto mode (``loss_chunk=0``)
+    chunks at 512 only when the fp32 logits would be large enough to matter (> 64 MB per
+    example row); ``-1`` disables chunking outright.
+    """
+    if loss_chunk == -1:
+        return 0
+    if loss_chunk > 0:
+        return min(loss_chunk, S)
+    # auto: threshold on S*V; 2**24 elements = 64 MB of fp32 logits per example row.
+    if S * vocab_size <= 2**24:
+        return 0
+    return min(512, S)
+
+
+def chunked_ce(x, head, targets, mask, chunk: int, dtype, final_softcap: float = 0.0,
+               bias=None):
+    """Memory-efficient cross-entropy: per-chunk head matmul + logsumexp under remat.
+
+    ``x`` [B,S,D] (post-final-norm hidden), ``head`` [D,V]; returns the sum of
+    -log p(target) over unmasked positions. The fp32 [B,S,V] logits are never
+    materialized — each scan step computes one [B,chunk,V] block and the backward pass
+    recomputes it (``jax.checkpoint``), so peak memory drops from O(S·V) to O(chunk·V).
+    S is padded up to a chunk multiple with masked positions, so any chunk works for any
+    sequence length. ``bias`` [V] (gpt-j's lm_head bias) is added pre-softmax.
+    """
+    B, S, D = x.shape
+    if S % chunk:
+        pad = chunk - S % chunk
+        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+        mask = jnp.pad(mask, ((0, 0), (0, pad)))
+        S += pad
+    n = S // chunk
+    xs = x.reshape(B, n, chunk, D).swapaxes(0, 1)            # [n, B, c, D]
+    ts = targets.reshape(B, n, chunk).swapaxes(0, 1)         # [n, B, c]
+    ms = mask.reshape(B, n, chunk).swapaxes(0, 1)            # [n, B, c]
+
+    @jax.checkpoint
+    def chunk_loss(xc, tc, mc):
+        logits = (xc @ head.astype(dtype)).astype(jnp.float32)   # [B, c, V]
+        if bias is not None:
+            logits = logits + bias.astype(jnp.float32)
+        logits = _softcap(logits, final_softcap)
+        lse = jax.nn.logsumexp(logits, axis=-1)                  # [B, c]
+        tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1).squeeze(-1)
+        return -((tgt - lse) * mc).sum()
+
+    def body(carry, xtm):
+        xc, tc, mc = xtm
+        return carry + chunk_loss(xc, tc, mc), None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xs, ts, ms))
+    return total
+
+
+def ce_sum(x, head, targets, mask, *, dtype, chunk: int = 0, softcap: float = 0.0,
+           bias=None) -> jax.Array:
+    """SUM-style chunked/dense CE core — the ONE copy of the softcap + log_softmax +
+    target-gather math shared by the model families' normalized loss paths and the 1F1B
+    last-stage heads (where sums across microbatch groups must add up exactly)."""
+    if chunk > 0:
+        return chunked_ce(x, head, targets, mask, chunk, dtype, final_softcap=softcap,
+                          bias=bias)
+    logits = (x @ head.astype(dtype)).astype(jnp.float32)
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    logits = _softcap(logits, softcap)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
+    return -(ll * mask).sum()
+
+
+def ce_sum_dispatch(x, head, targets, mask, *, loss_impl: str, dtype,
+                    chunk: int = 0, softcap: float = 0.0, bias=None) -> jax.Array:
+    """SUM-style CE dispatcher — the ONE place every ``loss_impl`` routes through,
+    shared across model families (llama/gpt) and across execution modes (single, GPipe,
+    and the 1F1B last-stage head, where sums across microbatch groups must add up
+    exactly).
+
+    ``bias`` (gpt-j's lm_head bias): the fused kernels have no bias term, so a non-None
+    bias always takes the chunked/dense path regardless of ``loss_impl`` — the same
+    silent-fallback contract as ``gpt.loss_fn``'s single-device kernel gate.
+    """
+    S = x.shape[1]
+    if loss_impl not in ("auto", "fused", "fused_dp", "fused_tp"):
+        raise ValueError(
+            f"loss_impl={loss_impl!r}: expected 'auto', 'fused', 'fused_dp', or "
+            "'fused_tp' (a typo would otherwise silently run the chunked path)"
+        )
+    if bias is not None:
+        loss_impl = "auto"
+    if loss_impl == "fused_tp":
+        # Megatron-layout fused CE: the head stays VOCAB-SHARDED over tp (never
+        # gathered), each tp shard runs the Pallas kernel on its vocab slice, and the
+        # logsumexp merges across tp in fp32 (ops/fused_xent.fused_cross_entropy_tp).
+        # Tokens stay sharded over the batch axes. For batch-only layouts use
+        # "fused_dp"; single device "fused".
+        from jax.sharding import PartitionSpec as P, get_abstract_mesh
+
+        from ..ops.fused_xent import fused_cross_entropy_tp
+        from ..utils.constants import BATCH_AXES, TENSOR_AXIS as _TP
+
+        mesh = get_abstract_mesh()
+        if not getattr(mesh, "axis_names", ()):
+            raise ValueError(
+                "loss_impl='fused_tp' needs an active mesh context "
+                "(Accelerator.build_train_step provides one; or wrap in jax.set_mesh)."
+            )
+        D = x.shape[-1]
+
+        def _local(xl, tl, ml, hd):
+            Bl = xl.shape[0]
+            nll = fused_cross_entropy_tp(
+                xl.reshape(Bl * S, D), hd, tl.reshape(Bl * S), axis_name=_TP,
+                softcap=softcap,
+            )
+            return (nll * ml.reshape(Bl * S)).sum()[None]
+
+        partials = jax.shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=(P(BATCH_AXES), P(BATCH_AXES), P(BATCH_AXES), P(None, _TP)),
+            out_specs=P(BATCH_AXES),
+            check_vma=False,  # pallas_call outputs carry no vma info (kernel contract)
+        )(x, targets, mask, head.astype(dtype))
+        return partials.sum()
+    if loss_impl == "fused_dp":
+        # Multi-chip fused CE: shard_map over the batch axes — each device runs the
+        # kernel on ITS tokens against a replicated head (in_spec P() makes shard_map's
+        # transpose psum the head gradient). For batch-sharded layouts (dp/fsdp); under
+        # tp-sharded heads or sp-sharded sequences prefer the chunked path (this one
+        # would all-gather the head / sequence into every shard).
+        from jax.sharding import PartitionSpec as P, get_abstract_mesh
+
+        from ..ops.fused_xent import fused_cross_entropy
+        from ..utils.constants import BATCH_AXES
+
+        mesh = get_abstract_mesh()
+        if not getattr(mesh, "axis_names", ()):
+            raise ValueError(
+                "loss_impl='fused_dp' needs an active mesh context "
+                "(Accelerator.build_train_step provides one; or wrap in jax.set_mesh)."
+            )
+        D = x.shape[-1]
+
+        def _local(xl, tl, ml, hd):
+            Bl = xl.shape[0]
+            nll = fused_cross_entropy(
+                xl.reshape(Bl * S, D), hd, tl.reshape(Bl * S), softcap=softcap,
+            )
+            return (nll * ml.reshape(Bl * S)).sum()[None]
+
+        partials = jax.shard_map(
+            _local,
+            mesh=mesh,
+            in_specs=(P(BATCH_AXES), P(BATCH_AXES), P(BATCH_AXES), P()),
+            out_specs=P(BATCH_AXES),
+            check_vma=False,  # pallas_call outputs carry no vma info
+        )(x, targets, mask, head.astype(dtype))
+        return partials.sum()
+    if loss_impl == "fused":
+        # Single-shard path: on a real multi-chip mesh fused_ce_single_shard returns
+        # None — fall through to the chunked path (or use "fused_dp").
+        loss = fused_ce_single_shard(x, head.astype(dtype), targets, mask,
+                                     softcap=softcap)
+        if loss is not None:
+            # fused_ce_single_shard returns the masked MEAN; convert back to SUM so
+            # every branch of this dispatcher has identical (sum) semantics.
+            return loss * jnp.maximum(mask.sum(), 1.0)
+    return ce_sum(x, head, targets, mask, dtype=dtype, chunk=chunk, softcap=softcap,
+                  bias=bias)
 
 
 def fused_ce_allowed() -> bool:

@@ -74,9 +74,12 @@ class GPTConfig:
     scan_unroll: int = 1                  # lax.scan unroll for the layer stack
     tie_embeddings: bool = True   # gpt2 ties lm_head to wte
     kv_quant: bool = False        # int8 KV cache (see models/common.py kv helpers)
-    # "auto": dense CE. "fused": ops/fused_xent Pallas kernel (single-device; falls back
-    # to dense under multi-device meshes or a biased lm_head, which the kernel lacks).
+    # "auto": dense/chunked CE. "fused": ops/fused_xent Pallas kernel (single-device);
+    # "fused_dp"/"fused_tp": the batch-sharded / vocab-sharded multi-chip kernels (same
+    # contract as llama, via common.ce_sum_dispatch). A biased lm_head (gpt-j) always
+    # falls back to the dense/chunked path — the kernels have no bias term.
     loss_impl: str = "auto"
+    loss_chunk: int = 0           # chunked-CE length: 0 auto, -1 off (common.resolve_loss_chunk)
 
 
 CONFIGS = {
@@ -378,39 +381,29 @@ def loss_fn(params: dict, batch: dict, cfg: GPTConfig, rng=None) -> jax.Array:
         m = user_mask
         positions = None
         seg_in = None
-    if cfg.loss_impl not in ("auto", "fused"):
-        raise ValueError(f"loss_impl={cfg.loss_impl!r}: expected 'auto' or 'fused'")
-    from .common import fused_ce_allowed
+    from .common import ce_sum_dispatch, resolve_loss_chunk
 
-    use_kernel = (
-        cfg.loss_impl == "fused"
-        and not (cfg.lm_head_bias and "b_lm_head" in params)  # kernel has no bias term
-        and fused_ce_allowed()  # up-front gate: never trace the forward twice
+    x = forward(
+        params, inputs, cfg, positions=positions, segment_ids=seg_in,
+        return_hidden=True,
     )
-    if use_kernel:
-        from .common import fused_ce_single_shard
-
-        x = forward(
-            params, inputs, cfg, positions=positions, segment_ids=seg_in,
-            return_hidden=True,
-        )
-        mask2d = m if m is not None else jnp.ones(targets.shape, jnp.float32)
-        # use_kernel implies fused_ce_allowed(): the helper cannot return None here.
-        return fused_ce_single_shard(
-            x, _head_weight(params, cfg).astype(cfg.dtype), targets, mask2d
-        )
-    logits = forward(params, inputs, cfg, positions=positions, segment_ids=seg_in)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
-    if m is None:
-        return -jnp.mean(ll)
-    return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
+    mask2d = m if m is not None else jnp.ones(targets.shape, jnp.float32)
+    bias = params.get("b_lm_head") if cfg.lm_head_bias else None
+    total = ce_sum_dispatch(
+        x, _head_weight(params, cfg), targets, mask2d,
+        loss_impl=cfg.loss_impl, dtype=cfg.dtype,
+        chunk=resolve_loss_chunk(cfg.loss_chunk, targets.shape[1], cfg.vocab_size),
+        bias=bias,
+    )
+    return total / jnp.maximum(mask2d.sum(), 1.0)
 
 
 # --------------------------------------------------------------- pipeline-parallel training
-def _pp_stage_fn(cfg: GPTConfig, S: int):
+def _pp_stage_fn(cfg: GPTConfig, S: int, packed: bool = False):
     """One pipeline stage body (gpt analog of ``llama._pp_stage_fn``): scan this stage's
-    blocks over one microbatch [B_m, S, D]; positions/causal mask rebuilt locally."""
+    blocks over one microbatch [B_m, S, D]; positions/causal mask rebuilt locally.
+    ``packed``: 3-arg form taking the pipeline's ``{"positions", "segment_ids"}`` side
+    constants (sample packing — block-diagonal per-segment attention)."""
     from .common import remat_wrap
 
     block = remat_wrap(
@@ -418,15 +411,27 @@ def _pp_stage_fn(cfg: GPTConfig, S: int):
         prevent_cse=cfg.remat_prevent_cse, scan_layers=True, static_argnums=(4,),
     )
 
-    def stage_fn(stage_layers, x):
-        pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (x.shape[0], S))
-        mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
-
+    def body_scan(x, stage_layers, pos, mask):
         def body(carry, layer):
             return block(carry, layer, pos, mask, cfg), None
 
         out, _ = jax.lax.scan(body, x, stage_layers)
         return out
+
+    if packed:
+        from .llama import segment_mask
+
+        def stage_fn(stage_layers, x, side):
+            return body_scan(
+                x, stage_layers, side["positions"], segment_mask(side["segment_ids"])
+            )
+
+        return stage_fn
+
+    def stage_fn(stage_layers, x):
+        pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (x.shape[0], S))
+        mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
+        return body_scan(x, stage_layers, pos, mask)
 
     return stage_fn
 
@@ -438,6 +443,8 @@ def forward_pp(
     mesh,
     num_microbatches: Optional[int] = None,
     shard_activations: bool = True,
+    segment_ids: Optional[jax.Array] = None,
+    positions: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Causal LM hidden states with the transformer blocks as a GPipe pipeline over
     ``pp`` (reference Megatron engine runs GPT with pp; its own pipelining is
@@ -448,25 +455,40 @@ def forward_pp(
     from ..parallel.pp import make_pipeline_fn
 
     B, S = tokens.shape
-    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    packed = segment_ids is not None
+    if positions is None:
+        if packed:
+            from .llama import segment_positions
+
+            # Continuous arange positions would run learned/rotary positions across
+            # packed segment boundaries.
+            positions = segment_positions(segment_ids)
+        else:
+            positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    side = {"positions": positions, "segment_ids": segment_ids} if packed else None
     x = _embed(params, tokens, positions, cfg)
     if shard_activations:
         x = _maybe_shard(x, P(BATCH_AXES, None, None))
-    pipe = make_pipeline_fn(mesh, _pp_stage_fn(cfg, S), num_microbatches=num_microbatches)
-    x = pipe(params["layers"], x)
+    pipe = make_pipeline_fn(
+        mesh, _pp_stage_fn(cfg, S, packed=packed), num_microbatches=num_microbatches
+    )
+    x = pipe(params["layers"], x, side=side)
     return _layer_norm(x, params["ln_f"], cfg.norm_eps)
 
 
 def _ce_sum_gpt(x, head, bias, targets, mask, cfg: GPTConfig) -> jax.Array:
-    """SUM-style dense CE from post-ln_f hidden states, honoring the optional lm_head
-    bias — the ONE copy of the gpt head math shared by loss_fn_pp (both schedules) and
-    the 1F1B head so the paths cannot drift."""
-    logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
-    if bias is not None:
-        logits = logits + bias.astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
-    return -(ll * mask).sum()
+    """SUM-style CE from post-ln_f hidden states, honoring the optional lm_head bias —
+    the ONE copy of the gpt head math shared by loss_fn, loss_fn_pp (both schedules) and
+    the 1F1B head so the paths cannot drift. Routes through ``common.ce_sum_dispatch``,
+    so every ``loss_impl`` (incl. the fused_dp/fused_tp multi-chip kernels) works; a
+    non-None bias falls back to the dense/chunked path (the kernels lack a bias term)."""
+    from .common import ce_sum_dispatch, resolve_loss_chunk
+
+    return ce_sum_dispatch(
+        x, head, targets, mask, loss_impl=cfg.loss_impl, dtype=cfg.dtype,
+        chunk=resolve_loss_chunk(cfg.loss_chunk, x.shape[1], cfg.vocab_size),
+        bias=bias,
+    )
 
 
 def _head_ce_sum_gpt(hp: dict, y: jax.Array, ex: dict, cfg: GPTConfig) -> jax.Array:
@@ -485,26 +507,37 @@ def loss_fn_pp(
     schedule: str = "gpipe",
 ) -> jax.Array:
     """Pipeline-parallel next-token CE for the gpt family (same contract as
-    ``llama.loss_fn_pp``; dense CE only — fused variants and packing raise)."""
-    if "segment_ids" in batch:
-        raise NotImplementedError(
-            "sample packing (segment_ids) is not supported on the pipeline-parallel path"
-        )
+    ``llama.loss_fn_pp``). Every ``loss_impl`` works — ln_f + the CE head run OUTSIDE
+    the pipeline (1F1B) or after it (GPipe) on the full batch, ordinary GSPMD, so the
+    fused kernel variants dispatch exactly as on the non-pipelined path. Sample packing
+    (``segment_ids``) rides the pipeline as per-microbatch side constants, exactly like
+    ``llama.loss_fn_pp``."""
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"schedule={schedule!r}: expected 'gpipe' or '1f1b'")
-    if cfg.loss_impl != "auto":
-        raise NotImplementedError(
-            f"loss_impl={cfg.loss_impl!r} is not supported on the gpt pipeline path "
-            "(dense CE only); use loss_impl='auto'"
-        )
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     B, S = inputs.shape
-    mask = (
-        batch["mask"][:, 1:].astype(jnp.float32)
-        if "mask" in batch
-        else jnp.ones((B, S), jnp.float32)
-    )
+    if "segment_ids" in batch:
+        from .llama import packed_target_mask, segment_positions
+
+        seg = batch["segment_ids"]
+        mask = packed_target_mask(seg)
+        if "mask" in batch:
+            mask = mask * batch["mask"][:, 1:].astype(jnp.float32)
+        positions = (
+            batch["positions"][:, :-1]
+            if "positions" in batch
+            else segment_positions(seg[:, :-1])
+        )
+        side = {"positions": positions, "segment_ids": seg[:, :-1]}
+    else:
+        mask = (
+            batch["mask"][:, 1:].astype(jnp.float32)
+            if "mask" in batch
+            else jnp.ones((B, S), jnp.float32)
+        )
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+        side = None
     denom = jnp.maximum(mask.sum(), 1.0)
     if schedule == "1f1b":
         from ..parallel.pp import make_pipeline_loss_fn
@@ -513,17 +546,20 @@ def loss_fn_pp(
         if cfg.lm_head_bias and "b_lm_head" in params:
             hp["b_lm_head"] = params["b_lm_head"]
         pipe_loss = make_pipeline_loss_fn(
-            mesh, _pp_stage_fn(cfg, S),
+            mesh, _pp_stage_fn(cfg, S, packed=side is not None),
             lambda h, y, ex: _head_ce_sum_gpt(h, y, ex, cfg),
             num_microbatches=num_microbatches, schedule="1f1b",
         )
-        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
         x = _embed(params, inputs, positions, cfg)
         total = pipe_loss(
-            params["layers"], hp, x, {"targets": targets, "mask": mask}
+            params["layers"], hp, x, {"targets": targets, "mask": mask}, side=side
         )
         return total / denom
-    x = forward_pp(params, inputs, cfg, mesh, num_microbatches=num_microbatches)
+    x = forward_pp(
+        params, inputs, cfg, mesh, num_microbatches=num_microbatches,
+        segment_ids=side["segment_ids"] if side else None,
+        positions=positions if side else None,
+    )
     bias = params.get("b_lm_head") if cfg.lm_head_bias else None
     return _ce_sum_gpt(x, _head_weight(params, cfg), bias, targets, mask, cfg) / denom
 

@@ -457,8 +457,20 @@ def _attention_xla(q, k, v, mask, cfg: LlamaConfig):
 def _sp_active(mesh) -> bool:
     """Does this mesh (concrete or abstract; may be None) engage the sp axis? The ONE
     copy of the sequence-parallel activation predicate — shared by ``_attention`` (on
-    the ambient mesh) and ``loss_fn_pp``'s sp-under-pp guard (on its mesh argument)."""
+    the ambient mesh) and ``loss_fn_pp``'s sp-under-pp dispatch (on its mesh argument)."""
     return mesh is not None and not mesh.empty and mesh.shape.get(SEQUENCE_AXIS, 1) > 1
+
+
+def _sp_manual(mesh) -> bool:
+    """Is the sp axis already MANUAL in this context — i.e. are we inside a shard_map
+    whose manual axes include sp (the pipeline's sp×pp composition)? Then the sp
+    collectives (``lax.ppermute`` KV rotation / all_to_all) must be issued directly;
+    wrapping another shard_map would nest, which fails to lower on the backward."""
+    try:
+        types = dict(zip(mesh.axis_names, mesh.axis_types))
+        return types.get(SEQUENCE_AXIS) == jax.sharding.AxisType.Manual
+    except Exception:
+        return False
 
 
 def _attention(q, k, v, mask, cfg: LlamaConfig, segment_ids=None):
@@ -470,6 +482,16 @@ def _attention(q, k, v, mask, cfg: LlamaConfig, segment_ids=None):
         # correct across the sequence shards.
         mesh = jax.sharding.get_abstract_mesh()
         if _sp_active(mesh):
+            if _sp_manual(mesh):
+                # Already inside a manual-sp shard_map (the pipeline made sp manual):
+                # issue the ring/ulysses collectives directly — one flat shard_map.
+                from ..parallel.sequence import sequence_parallel_attention
+
+                return sequence_parallel_attention(
+                    q, k, v, mode=impl, axis_name=SEQUENCE_AXIS, causal=True,
+                    window=cfg.sliding_window, softcap=cfg.attn_softcap,
+                    sm_scale=_sm_scale(cfg),
+                )
             from ..parallel.sequence import make_sp_attention
 
             attn = make_sp_attention(
@@ -761,57 +783,19 @@ def head_logits(x, params: dict, cfg: LlamaConfig) -> jax.Array:
 
 
 def _loss_chunk_size(cfg: LlamaConfig, S: int) -> int:
-    """Resolve the chunked-CE chunk length (0 tokens = don't chunk).
+    """Resolve the chunked-CE chunk length for this config (see
+    ``common.resolve_loss_chunk`` — the shared single copy of the auto rule)."""
+    from .common import resolve_loss_chunk
 
-    An explicit ``loss_chunk`` is always honored (``_chunked_ce`` pads S up to a chunk
-    multiple, so divisibility never silently disables it). Auto mode chunks at 512 only when
-    the fp32 logits would be large enough to matter (> 64 MB per example row).
-    """
-    if cfg.loss_chunk == -1:
-        return 0
-    if cfg.loss_chunk > 0:
-        return min(cfg.loss_chunk, S)
-    # auto: threshold on S*V; 2**24 elements = 64 MB of fp32 logits per example row.
-    if S * cfg.vocab_size <= 2**24:
-        return 0
-    return min(512, S)
+    return resolve_loss_chunk(cfg.loss_chunk, S, cfg.vocab_size)
 
 
 def _chunked_ce(x, head, targets, mask, chunk: int, dtype, final_softcap: float = 0.0):
-    """Memory-efficient cross-entropy: per-chunk head matmul + logsumexp under remat.
+    """Memory-efficient chunked CE (moved to ``common.chunked_ce``; kept as the
+    family-local name for callers like ``benchmarks/decompose.py``)."""
+    from .common import chunked_ce
 
-    ``x`` [B,S,D] (post-ln_f hidden), ``head`` [D,V]; returns the sum of -log p(target) over
-    unmasked positions. The fp32 [B,S,V] logits are never materialized — each scan step
-    computes one [B,chunk,V] block and the backward pass recomputes it (``jax.checkpoint``),
-    so peak memory drops from O(S·V) to O(chunk·V). S is padded up to a chunk multiple with
-    masked positions, so any chunk works for any sequence length.
-    """
-    B, S, D = x.shape
-    if S % chunk:
-        pad = chunk - S % chunk
-        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
-        targets = jnp.pad(targets, ((0, 0), (0, pad)))
-        mask = jnp.pad(mask, ((0, 0), (0, pad)))
-        S += pad
-    n = S // chunk
-    xs = x.reshape(B, n, chunk, D).swapaxes(0, 1)            # [n, B, c, D]
-    ts = targets.reshape(B, n, chunk).swapaxes(0, 1)         # [n, B, c]
-    ms = mask.reshape(B, n, chunk).swapaxes(0, 1)            # [n, B, c]
-
-    @jax.checkpoint
-    def chunk_loss(xc, tc, mc):
-        logits = (xc @ head.astype(dtype)).astype(jnp.float32)   # [B, c, V]
-        logits = _softcap(logits, final_softcap)
-        lse = jax.nn.logsumexp(logits, axis=-1)                  # [B, c]
-        tgt = jnp.take_along_axis(logits, tc[..., None], axis=-1).squeeze(-1)
-        return -((tgt - lse) * mc).sum()
-
-    def body(carry, xtm):
-        xc, tc, mc = xtm
-        return carry + chunk_loss(xc, tc, mc), None
-
-    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (xs, ts, ms))
-    return total
+    return chunked_ce(x, head, targets, mask, chunk, dtype, final_softcap=final_softcap)
 
 
 def _ce_from_hidden(x, params, targets, mask, cfg: LlamaConfig) -> jax.Array:
@@ -822,115 +806,16 @@ def _ce_from_hidden(x, params, targets, mask, cfg: LlamaConfig) -> jax.Array:
 
 
 def _ce_sum_impl(x, head, targets, mask, cfg: LlamaConfig) -> jax.Array:
-    """SUM-style CE dispatcher — the ONE place every loss_impl routes through, used by
+    """SUM-style CE dispatcher for this family — delegates to the cross-family
+    ``common.ce_sum_dispatch`` (the ONE place every loss_impl routes through), used by
     both the normalized single/GPipe path (``_ce_from_hidden``) and the 1F1B head
     (``_head_ce_sum``, where sums across microbatch groups must add up exactly)."""
-    S = x.shape[1]
-    if cfg.loss_impl not in ("auto", "fused", "fused_dp", "fused_tp"):
-        raise ValueError(
-            f"loss_impl={cfg.loss_impl!r}: expected 'auto', 'fused', 'fused_dp', or "
-            "'fused_tp' (a typo would otherwise silently run the chunked path)"
-        )
-    if cfg.loss_impl == "fused_tp":
-        # Megatron-layout fused CE: the head stays VOCAB-SHARDED over tp (never
-        # gathered), each tp shard runs the Pallas kernel on its vocab slice, and the
-        # logsumexp merges across tp in fp32 (ops/fused_xent.fused_cross_entropy_tp).
-        # Tokens stay sharded over the batch axes. For batch-only layouts use
-        # "fused_dp"; single device "fused".
-        from jax.sharding import get_abstract_mesh
+    from .common import ce_sum_dispatch
 
-        from ..ops.fused_xent import fused_cross_entropy_tp
-        from ..utils.constants import BATCH_AXES, TENSOR_AXIS as _TP
-
-        mesh = get_abstract_mesh()
-        if not getattr(mesh, "axis_names", ()):
-            raise ValueError(
-                "loss_impl='fused_tp' needs an active mesh context "
-                "(Accelerator.build_train_step provides one; or wrap in jax.set_mesh)."
-            )
-        D = x.shape[-1]
-
-        def _local(xl, tl, ml, hd):
-            Bl = xl.shape[0]
-            nll = fused_cross_entropy_tp(
-                xl.reshape(Bl * S, D), hd, tl.reshape(Bl * S), axis_name=_TP,
-                softcap=cfg.final_softcap,
-            )
-            return (nll * ml.reshape(Bl * S)).sum()[None]
-
-        partials = jax.shard_map(
-            _local,
-            mesh=mesh,
-            in_specs=(P(BATCH_AXES), P(BATCH_AXES), P(BATCH_AXES), P(None, _TP)),
-            out_specs=P(BATCH_AXES),
-            check_vma=False,  # pallas_call outputs carry no vma info (kernel contract)
-        )(x, targets, mask, head.astype(cfg.dtype))
-        return partials.sum()
-    if cfg.loss_impl == "fused_dp":
-        # Multi-chip fused CE: shard_map over the batch axes — each device runs the
-        # kernel on ITS tokens against a replicated head (in_spec P() makes shard_map's
-        # transpose psum the head gradient). For batch-sharded layouts (dp/fsdp); under
-        # tp-sharded heads or sp-sharded sequences prefer the chunked path (this one
-        # would all-gather the head / sequence into every shard).
-        from jax.sharding import get_abstract_mesh
-
-        from ..ops.fused_xent import fused_cross_entropy
-        from ..utils.constants import BATCH_AXES
-
-        mesh = get_abstract_mesh()
-        if not getattr(mesh, "axis_names", ()):
-            raise ValueError(
-                "loss_impl='fused_dp' needs an active mesh context "
-                "(Accelerator.build_train_step provides one; or wrap in jax.set_mesh)."
-            )
-        D = x.shape[-1]
-
-        def _local(xl, tl, ml, hd):
-            Bl = xl.shape[0]
-            nll = fused_cross_entropy(
-                xl.reshape(Bl * S, D), hd, tl.reshape(Bl * S),
-                softcap=cfg.final_softcap,
-            )
-            return (nll * ml.reshape(Bl * S)).sum()[None]
-
-        partials = jax.shard_map(
-            _local,
-            mesh=mesh,
-            in_specs=(P(BATCH_AXES), P(BATCH_AXES), P(BATCH_AXES), P()),
-            out_specs=P(BATCH_AXES),
-            check_vma=False,  # pallas_call outputs carry no vma info
-        )(x, targets, mask, head.astype(cfg.dtype))
-        return partials.sum()
-    if cfg.loss_impl == "fused":
-        # Single-shard path (shared dispatch in models/common.py): on a real multi-chip
-        # mesh this returns None — fall through to the chunked path (or use "fused_dp").
-        from .common import fused_ce_single_shard
-
-        loss = fused_ce_single_shard(
-            x, head.astype(cfg.dtype), targets, mask, softcap=cfg.final_softcap
-        )
-        if loss is not None:
-            # fused_ce_single_shard returns the masked MEAN; convert back to SUM so
-            # every branch of this dispatcher has identical (sum) semantics.
-            return loss * jnp.maximum(mask.sum(), 1.0)
-    return _ce_sum(x, head, targets, mask, cfg)
-
-
-def _ce_sum(x, head, targets, mask, cfg: LlamaConfig) -> jax.Array:
-    """SUM-style chunked/dense CE core — the ONE copy of the softcap + log_softmax +
-    target-gather math, shared by ``_ce_from_hidden`` (which normalizes) and the 1F1B
-    last-stage head (``_head_ce_sum``, which sums across microbatches)."""
-    S = x.shape[1]
-    chunk = _loss_chunk_size(cfg, S)  # may exceed/not divide S; _chunked_ce pads
-    if chunk > 0:
-        return _chunked_ce(
-            x, head, targets, mask, chunk, cfg.dtype, final_softcap=cfg.final_softcap
-        )
-    logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
-    logits = _softcap(logits, cfg.final_softcap)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1).squeeze(-1)
-    return -(ll * mask).sum()
+    return ce_sum_dispatch(
+        x, head, targets, mask, loss_impl=cfg.loss_impl, dtype=cfg.dtype,
+        chunk=_loss_chunk_size(cfg, x.shape[1]), softcap=cfg.final_softcap,
+    )
 
 
 def loss_fn(
@@ -1025,25 +910,64 @@ def _pp_microbatches(mesh, num_microbatches) -> int:
     return num_microbatches if num_microbatches is not None else mesh.shape[_PP]
 
 
-def _pp_stage_fn(cfg: LlamaConfig, S: int, with_aux: bool):
+def _pp_stage_fn(
+    cfg: LlamaConfig, S: int, with_aux: bool, packed: bool = False,
+    sp_manual: bool = False,
+):
     """One pipeline stage body, shared by the GPipe (forward_pp) and 1F1B (loss_fn_pp)
     schedules so their numerics cannot drift: scan this stage's blocks over one
     microbatch [B_m, S, D], positions/causal mask rebuilt locally (identical rows).
-    ``with_aux`` returns the stage's summed MoE aux alongside the activation."""
+    ``with_aux`` returns the stage's summed MoE aux alongside the activation.
+
+    ``packed`` (sample packing): the stage takes a third ``side`` argument — the
+    pipeline's per-microbatch constants ``{"positions", "segment_ids"}`` [B_m, S]
+    (``parallel.pp``'s side-input contract: indexed by microbatch id inside the
+    schedule, never ppermuted, non-differentiable) — and restricts attention to the
+    block-diagonal per-segment causal mask exactly like ``forward_hidden``."""
     block = _maybe_remat_block(cfg)
 
-    def stage_fn(stage_layers, x):
-        pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (x.shape[0], S))
-        mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
-
+    def body_scan(x, stage_layers, pos, mask, seg):
         def body(carry, layer):
-            out, aux = block(carry, layer, pos, mask, cfg)
+            out, aux = block(carry, layer, pos, mask, cfg, seg)
             return out, aux
 
         out, auxes = jax.lax.scan(body, x, stage_layers)
         if with_aux:
             return out, jnp.sum(auxes)
         return out
+
+    if packed:
+        if cfg.attn_impl in ("ring", "ulysses", "allgather"):
+            # Same fallback as forward_hidden: the sp attention modes take no mask and
+            # would silently attend across packed segments.
+            cfg = dataclasses.replace(cfg, attn_impl="auto")
+            block = _maybe_remat_block(cfg)
+
+        def stage_fn(stage_layers, x, side):
+            seg = side["segment_ids"]
+            return body_scan(x, stage_layers, side["positions"], segment_mask(seg), seg)
+
+        return stage_fn
+
+    if sp_manual:
+        # sp×pp: the pipeline's shard_map is manual over sp too, so x arrives
+        # SEQUENCE-SLICED [B_m, S/sp, D]. RoPE needs the slice's global positions;
+        # attention dispatches to the flat ring/ulysses collectives inside _attention
+        # (no mask — the sp kernels handle causality with global offsets in-kernel).
+        def stage_fn(stage_layers, x):
+            S_loc = x.shape[1]
+            offs = jax.lax.axis_index(SEQUENCE_AXIS) * S_loc
+            pos = jnp.broadcast_to(
+                offs + jnp.arange(S_loc, dtype=jnp.int32), (x.shape[0], S_loc)
+            )
+            return body_scan(x, stage_layers, pos, None, None)
+
+        return stage_fn
+
+    def stage_fn(stage_layers, x):
+        pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (x.shape[0], S))
+        mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
+        return body_scan(x, stage_layers, pos, mask, None)
 
     return stage_fn
 
@@ -1056,6 +980,8 @@ def forward_pp(
     num_microbatches: Optional[int] = None,
     shard_activations: bool = True,
     return_aux: bool = False,
+    segment_ids: Optional[jax.Array] = None,
+    positions: Optional[jax.Array] = None,
 ):
     """Causal LM forward with the transformer blocks run as a GPipe pipeline over ``pp``.
 
@@ -1080,7 +1006,13 @@ def forward_pp(
     B, S = tokens.shape
     dtype = cfg.dtype
     is_moe = cfg.moe_experts > 0
-    stage_fn = _pp_stage_fn(cfg, S, with_aux=is_moe)
+    packed = segment_ids is not None
+    stage_fn = _pp_stage_fn(cfg, S, with_aux=is_moe, packed=packed)
+    side = None
+    if packed:
+        if positions is None:
+            positions = segment_positions(segment_ids)
+        side = {"positions": positions, "segment_ids": segment_ids}
 
     x = params["embed"].astype(dtype)[tokens]
     if shard_activations:
@@ -1089,7 +1021,7 @@ def forward_pp(
         mesh, stage_fn, num_microbatches=num_microbatches, with_aux=is_moe
     )
     if is_moe:
-        x, aux = pipe(params["layers"], x)
+        x, aux = pipe(params["layers"], x, side=side)
         # load_balancing_loss is a batch-size-invariant MEAN statistic (~1 at balance):
         # the pipeline sums one value per (stage, microbatch), so divide by M to keep
         # moe_aux_weight meaning the same thing as the non-pipelined path — otherwise
@@ -1097,7 +1029,7 @@ def forward_pp(
         # training objective.
         aux = aux / _pp_microbatches(mesh, num_microbatches)
     else:
-        x, aux = pipe(params["layers"], x), jnp.zeros((), jnp.float32)
+        x, aux = pipe(params["layers"], x, side=side), jnp.zeros((), jnp.float32)
     x = _rms_norm(x, params["ln_f"], cfg.norm_eps, cfg.norm_plus_one)
     if return_aux:
         return x, aux
@@ -1123,8 +1055,10 @@ def loss_fn_pp(
     rng: Optional[jax.Array] = None,
     schedule: str = "gpipe",
 ) -> jax.Array:
-    """Pipeline-parallel next-token cross-entropy (same contract as ``loss_fn``, except
-    sample packing: ``forward_pp`` has no segment-mask plumbing yet).
+    """Pipeline-parallel next-token cross-entropy (same contract as ``loss_fn``,
+    including sample packing: ``segment_ids`` ride the pipeline as per-microbatch side
+    constants — ``parallel.pp``'s side-input contract — restricting attention to the
+    block-diagonal per-segment mask with per-segment RoPE restarts, both schedules).
 
     ``schedule="1f1b"`` routes through ``parallel.pp.make_pipeline_loss_fn``: the custom
     VJP's hand-scheduled one-forward-one-backward keeps in-flight activations bounded by
@@ -1132,45 +1066,59 @@ def loss_fn_pp(
     pipeline on the full batch (ordinary GSPMD — every ``loss_impl`` incl. the fused
     kernels works); MoE stages carry their load-balancing aux through the replay with
     the same /num_microbatches normalization as GPipe."""
-    if "segment_ids" in batch:
-        raise NotImplementedError(
-            "sample packing (segment_ids) is not supported on the pipeline-parallel path"
-        )
     if schedule not in ("gpipe", "1f1b"):
         # Mirrors PipelineParallelPlugin's validation: an unrecognized schedule (e.g. a
         # typo'd ACCELERATE_PP_SCHEDULE) must not silently run GPipe.
         raise ValueError(f"schedule={schedule!r}: expected 'gpipe' or '1f1b'")
-    if cfg.attn_impl in ("ring", "ulysses", "allgather"):
+    sp_pipeline = False
+    if cfg.attn_impl in ("ring", "ulysses", "allgather") and "segment_ids" not in batch:
         # Check the mesh ARGUMENT (the one the pipeline's shard_map will run under),
         # not just the ambient context — callers may pass it without jax.set_mesh.
         if _sp_active(mesh) or _sp_active(jax.sharding.get_abstract_mesh()):
-            # The sp-attention shard_map nests inside the pipeline's shard_map; the
-            # FORWARD lowers and matches (prepare_pippy inference works), but jax
-            # cannot lower the nested structure's backward (MLIR verification failure).
-            # Raise here rather than crash opaquely at grad time.
-            raise NotImplementedError(
-                f"attn_impl={cfg.attn_impl!r} (sequence-parallel attention) cannot "
-                "TRAIN inside the pipeline today: the nested shard_map backward fails "
-                "to lower (both schedules). Use attn_impl='flash'/'xla' within pp "
-                "stages, or sp without pp. Forward-only use (the nested forward lowers "
-                "and matches) is available via forward_pp + head_logits or "
-                "prepare_pippy."
-            )
+            # sp×pp (VERDICT r3 #10): nesting the sp attention's own shard_map inside
+            # the pipeline's fails to lower on the backward (MLIR verification), so the
+            # PIPELINE makes sp manual instead — activations ride sequence-sliced, the
+            # stage body issues the ring/ulysses collectives directly (flat shard_map,
+            # no nesting; see parallel/pp.py extra_manual_axes).
+            if cfg.moe_experts > 0:
+                raise NotImplementedError(
+                    "sp-attention x pp with MoE is not supported: the per-(stage, "
+                    "microbatch) aux psums assume sp-replicated stage bodies"
+                )
+            sp_pipeline = True
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     B, S = inputs.shape
-    mask = (
-        batch["mask"][:, 1:].astype(jnp.float32)
-        if "mask" in batch
-        else jnp.ones((B, S), jnp.float32)
-    )
-    if schedule == "1f1b":
+    if "segment_ids" in batch:
+        # Packed rows — same target-mask / per-segment-position semantics as loss_fn.
+        seg = batch["segment_ids"]
+        mask = packed_target_mask(seg)
+        if "mask" in batch:
+            mask = mask * batch["mask"][:, 1:].astype(jnp.float32)
+        positions = (
+            batch["positions"][:, :-1]
+            if "positions" in batch
+            else segment_positions(seg[:, :-1])
+        )
+        seg_in = seg[:, :-1]
+        side = {"positions": positions, "segment_ids": seg_in}
+    else:
+        mask = (
+            batch["mask"][:, 1:].astype(jnp.float32)
+            if "mask" in batch
+            else jnp.ones((B, S), jnp.float32)
+        )
+        seg_in = None
+        side = None
+    if schedule == "1f1b" or sp_pipeline:
         from ..parallel.pp import make_pipeline_loss_fn
 
         dtype = cfg.dtype
         is_moe = cfg.moe_experts > 0
         M = _pp_microbatches(mesh, num_microbatches)
-        stage_fn = _pp_stage_fn(cfg, S, with_aux=is_moe)
+        stage_fn = _pp_stage_fn(
+            cfg, S, with_aux=is_moe, packed=side is not None, sp_manual=sp_pipeline
+        )
         head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
         hp = {"ln_f": params["ln_f"], "head": head}
 
@@ -1181,19 +1129,24 @@ def loss_fn_pp(
 
         pipe_loss = make_pipeline_loss_fn(
             mesh, stage_fn, head_loss,
-            num_microbatches=num_microbatches, schedule="1f1b",
+            num_microbatches=num_microbatches, schedule=schedule,
             with_aux=is_moe,
             # Same normalization as the GPipe path: aux is a mean statistic summed over
             # (stage, microbatch) pairs → divide by M so moe_aux_weight keeps its
             # non-pipelined meaning.
             aux_weight=(cfg.moe_aux_weight / M) if is_moe else 0.0,
+            # sp×pp: activations ride sequence-sliced through a pipeline that is manual
+            # over sp too (microbatch layout [M, B_m, S, D] → sp on dim 2).
+            act_spec=P(None, None, SEQUENCE_AXIS, None) if sp_pipeline else None,
+            extra_manual_axes=(SEQUENCE_AXIS,) if sp_pipeline else (),
         )
         x = params["embed"].astype(dtype)[inputs]
         return pipe_loss(
-            params["layers"], hp, x, {"targets": targets, "mask": mask}
+            params["layers"], hp, x, {"targets": targets, "mask": mask}, side=side
         )
     x, aux = forward_pp(
-        params, inputs, cfg, mesh, num_microbatches=num_microbatches, return_aux=True
+        params, inputs, cfg, mesh, num_microbatches=num_microbatches, return_aux=True,
+        segment_ids=seg_in, positions=side["positions"] if side else None,
     )
     ce = _ce_from_hidden(x, params, targets, mask, cfg)
     if cfg.moe_experts > 0:

@@ -23,13 +23,27 @@ lanes of 128 (any leaf with ``size % 1024 == 0`` — all matmul weights; stacked
 included).  Small/odd leaves (norm gains, biases) fall back to the identical jnp math —
 negligible traffic.  ``mu_dtype=bfloat16`` stores the first moment in bf16 (t5x-style),
 cutting standing optimizer HBM by 25%.
+
+Low-precision optimizer STATE (the MS-AMP analog — the reference's third fp8 backend
+keeps fp8 master weights / optimizer state, ``/root/reference/src/accelerate/accelerator.py:2164``,
+``dataclasses.py:1235-1242``): ``mu_dtype``/``nu_dtype`` may be ``float8_e4m3fn`` /
+``float8_e5m2``.  fp8 moments are stored with a per-tensor fp32 scale living beside them
+in :class:`ScaledAdamState` (the ``DelayedScalingState`` pattern from ``ops/fp8.py``,
+but with CURRENT scaling — the true amax of the freshly computed moment, available for
+free since the moment is in registers when quantizing).  fp8-stated leaves take the
+plain-XLA path rather than the Pallas kernel: the per-leaf math is a single fused
+map+amax-reduce XLA program (one read of p/m/v/g, one write of p/m/v + a scalar), and
+GSPMD partitions it under any sharding — including FSDP/TP layouts — without shard_map.
+At 0.9B params, fp8 mu + fp8 nu cut standing optimizer HBM from ~7.1 GB (fp32) to
+~1.8 GB and the apply's moment traffic by 4x, directly attacking the bandwidth-bound
+apply the decompose isolated (~790 ms/step).
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Any, Callable, Optional, Union
+from typing import Any, Callable, NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -39,7 +53,46 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ._common import interpret_default as _interpret_default
 
-__all__ = ["FusedAdamW", "fused_adamw"]
+__all__ = ["FusedAdamW", "fused_adamw", "ScaledAdamState"]
+
+
+class ScaledAdamState(NamedTuple):
+    """AdamW state whose moments may be stored in fp8 with per-tensor fp32 scales
+    living beside them (the MS-AMP low-precision-optimizer-state analog; reference
+    ``accelerator.py:2164``). ``mu_scale``/``nu_scale`` mirror the param tree with one
+    fp32 scalar per leaf, or are ``None`` when that moment is full/bf16 precision.
+    Same leading fields as ``optax.ScaleByAdamState`` so ``state[0].mu``-style
+    introspection and checkpointing (a plain pytree) work unchanged."""
+
+    count: Any
+    mu: Any
+    nu: Any
+    mu_scale: Any = None
+    nu_scale: Any = None
+
+
+_F8_MAX = {
+    jnp.dtype(jnp.float8_e4m3fn): 448.0,
+    jnp.dtype(jnp.float8_e5m2): 57344.0,
+}
+
+
+def _is_f8(dt) -> bool:
+    return dt is not None and jnp.dtype(dt) in _F8_MAX
+
+
+def _quant_f8(x32: jax.Array, dt) -> tuple[jax.Array, jax.Array]:
+    """Per-tensor CURRENT scaling: scale = amax/emax of the value being stored (the
+    value is already in registers — no extra HBM pass, unlike delayed scaling which
+    exists to avoid exactly that pass for activations)."""
+    emax = _F8_MAX[jnp.dtype(dt)]
+    amax = jnp.max(jnp.abs(x32))
+    scale = (jnp.maximum(amax, 1e-30) / emax).astype(jnp.float32)
+    return (x32 / scale).astype(dt), scale
+
+
+def _dequant_f8(x: jax.Array, scale: jax.Array) -> jax.Array:
+    return x.astype(jnp.float32) * scale
 
 _LANES = 1024  # 8 sublanes x 128 lanes: the fp32 VMEM tile; every kernel row is one tile
 
@@ -140,6 +193,34 @@ def _leaf_xla(p, m, v, g, scalars, *, b1, b2, eps, wd):
     return p_new, m_new.astype(m.dtype), v_new.astype(v.dtype)
 
 
+def _leaf_xla_scaled(p, m, v, g, scalars, m_scale, v_scale, *, b1, b2, eps, wd):
+    """AdamW update for a leaf whose moments are stored scaled-fp8.
+
+    One fused XLA map+amax-reduce over the leaf (GSPMD-partitionable under any layout,
+    so fp8-stated leaves never need shard_map): dequantize the incoming moments with
+    last step's per-tensor scale, do the fp32 update, requantize with the fresh amax.
+    Returns ``(p', m', v', m_scale', v_scale')`` — scale entries are None for a moment
+    that isn't fp8."""
+    gscale, lr, bc1, bc2 = scalars[0], scalars[1], scalars[2], scalars[3]
+    g = g.astype(jnp.float32) * gscale
+    p32 = p.astype(jnp.float32)
+    m32 = _dequant_f8(m, m_scale) if m_scale is not None else m
+    v32 = _dequant_f8(v, v_scale) if v_scale is not None else v
+    m_new = (1.0 - b1) * g + b1 * m32
+    v_new = (1.0 - b2) * (g * g) + b2 * v32
+    update = (m_new / bc1) / (jnp.sqrt(v_new / bc2) + eps) + wd * p32
+    p_new = (p32 - lr * update).astype(p.dtype)
+    if m_scale is not None:
+        m_out, m_scale_out = _quant_f8(m_new, m.dtype)
+    else:
+        m_out, m_scale_out = m_new.astype(m.dtype), None
+    if v_scale is not None:
+        v_out, v_scale_out = _quant_f8(v_new, v.dtype)
+    else:
+        v_out, v_scale_out = v_new.astype(v.dtype), None
+    return p_new, m_out, v_out, m_scale_out, v_scale_out
+
+
 @dataclasses.dataclass
 class FusedAdamW:
     """Drop-in AdamW with a fused Pallas apply.
@@ -156,22 +237,34 @@ class FusedAdamW:
     eps: float = 1e-8
     weight_decay: float = 1e-4
     mu_dtype: Optional[Any] = None
+    nu_dtype: Optional[Any] = None
     block_rows: int = 512
     interpret: Optional[bool] = None
 
     # -------------------------------------------------------------- optax-compatible API
     def init(self, params):
         mu_dtype = self.mu_dtype or None
+        nu_dtype = self.nu_dtype or None
 
         # zeros_LIKE, not zeros: each moment leaf must inherit its param's sharding —
         # create_train_state relies on that invariant, and at 0.9B params an unsharded
         # fp32 mu+nu is ~7 GB landing on one device.
-        return optax.ScaleByAdamState(
-            count=jnp.zeros((), jnp.int32),
-            mu=jax.tree_util.tree_map(
-                lambda p: jnp.zeros_like(p, dtype=mu_dtype or p.dtype), params
-            ),
-            nu=jax.tree_util.tree_map(jnp.zeros_like, params),
+        mu = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=mu_dtype or p.dtype), params
+        )
+        nu = jax.tree_util.tree_map(
+            lambda p: jnp.zeros_like(p, dtype=nu_dtype or p.dtype), params
+        )
+        count = jnp.zeros((), jnp.int32)
+        if not (_is_f8(mu_dtype) or _is_f8(nu_dtype)):
+            return optax.ScaleByAdamState(count=count, mu=mu, nu=nu)
+        ones = lambda: jax.tree_util.tree_map(  # noqa: E731
+            lambda _: jnp.ones((), jnp.float32), params
+        )
+        return ScaledAdamState(
+            count=count, mu=mu, nu=nu,
+            mu_scale=ones() if _is_f8(mu_dtype) else None,
+            nu_scale=ones() if _is_f8(nu_dtype) else None,
         )
 
     def _scalars(self, count, grad_scale):
@@ -197,31 +290,62 @@ class FusedAdamW:
         scalars = self._scalars(state.count, 1.0)
         kw = dict(b1=self.b1, b2=self.b2, eps=self.eps, wd=self.weight_decay)
 
-        def one(p, m, v, g):
-            return _leaf_xla(p, m, v, g, scalars, **kw)
-
         flat_p, treedef = jax.tree_util.tree_flatten(params)
+        flat_ms, flat_vs = self._flat_scales(state, treedef, len(flat_p))
+
+        def one(p, m, v, g, ms, vs):
+            if ms is not None or vs is not None:
+                return _leaf_xla_scaled(p, m, v, g, scalars, ms, vs, **kw)
+            return (*_leaf_xla(p, m, v, g, scalars, **kw), None, None)
+
         out = [
-            one(p, m, v, g)
-            for p, m, v, g in zip(
+            one(p, m, v, g, ms, vs)
+            for p, m, v, g, ms, vs in zip(
                 flat_p,
                 treedef.flatten_up_to(state.mu),
                 treedef.flatten_up_to(state.nu),
                 treedef.flatten_up_to(grads),
+                flat_ms, flat_vs,
             )
         ]
         updates = treedef.unflatten(
             [
                 (n.astype(jnp.float32) - p.astype(jnp.float32)).astype(p.dtype)
-                for (n, _, _), p in zip(out, flat_p)
+                for (n, *_), p in zip(out, flat_p)
             ]
         )
-        new_state = optax.ScaleByAdamState(
-            count=state.count + 1,
-            mu=treedef.unflatten([o[1] for o in out]),
-            nu=treedef.unflatten([o[2] for o in out]),
+        return updates, self._rebuild_state(state, treedef, out)
+
+    def _flat_scales(self, state, treedef, n):
+        """Per-leaf (mu_scale, nu_scale) lists — all-None for plain ScaleByAdamState."""
+        mu_scale = getattr(state, "mu_scale", None)
+        nu_scale = getattr(state, "nu_scale", None)
+        flat_ms = treedef.flatten_up_to(mu_scale) if mu_scale is not None else [None] * n
+        flat_vs = treedef.flatten_up_to(nu_scale) if nu_scale is not None else [None] * n
+        return flat_ms, flat_vs
+
+    def _rebuild_state(self, state, treedef, out):
+        """Reassemble the state from per-leaf (p', m', v', m_scale', v_scale') rows,
+        preserving the incoming state's type (plain vs scaled)."""
+        mu = treedef.unflatten([o[1] for o in out])
+        nu = treedef.unflatten([o[2] for o in out])
+        if getattr(state, "mu_scale", None) is None and getattr(
+            state, "nu_scale", None
+        ) is None and not isinstance(state, ScaledAdamState):
+            return optax.ScaleByAdamState(count=state.count + 1, mu=mu, nu=nu)
+        return ScaledAdamState(
+            count=state.count + 1, mu=mu, nu=nu,
+            mu_scale=(
+                treedef.unflatten([o[3] for o in out])
+                if getattr(state, "mu_scale", None) is not None
+                else None
+            ),
+            nu_scale=(
+                treedef.unflatten([o[4] for o in out])
+                if getattr(state, "nu_scale", None) is not None
+                else None
+            ),
         )
-        return updates, new_state
 
     # ------------------------------------------------------------------ fused fast path
     def fused_apply(self, grads, state, params, grad_scale=1.0, specs=None, mesh=None):
@@ -262,14 +386,19 @@ class FusedAdamW:
                     return False
             return True
 
-        def one(p, m, v, g, spec=None):
+        def one(p, m, v, g, spec=None, ms=None, vs=None):
+            if ms is not None or vs is not None:
+                # fp8-stated leaf: one fused XLA map+amax-reduce — GSPMD partitions it
+                # under any spec (the amax collective included), so no shard_map and no
+                # Pallas here by design (see module docstring).
+                return _leaf_xla_scaled(p, m, v, g, scalars, ms, vs, **kw)
             if isinstance(spec, str):  # "opaque": un-expressible layout — plain XLA only
-                return _leaf_xla(p, m, v, g, scalars, **kw)
+                return (*_leaf_xla(p, m, v, g, scalars, **kw), None, None)
             if spec is not None and mesh is not None and any(a for a in spec):
                 if not _evenly_divisible(p.shape, spec):
                     # shard_map needs even shards; GSPMD pads NamedShardings (legal), so
                     # uneven leaves take the identical partitionable XLA math instead.
-                    return _leaf_xla(p, m, v, g, scalars, **kw)
+                    return (*_leaf_xla(p, m, v, g, scalars, **kw), None, None)
                 from jax.sharding import PartitionSpec
 
                 mapped = jax.shard_map(
@@ -279,8 +408,8 @@ class FusedAdamW:
                     out_specs=(spec, spec, spec),
                     check_vma=False,  # pallas_call outputs carry no vma info
                 )
-                return mapped(scalars, p, m, v, g)
-            return local(scalars, p, m, v, g)
+                return (*mapped(scalars, p, m, v, g), None, None)
+            return (*local(scalars, p, m, v, g), None, None)
 
         flat_p, treedef = jax.tree_util.tree_flatten(params)
         flat_m = treedef.flatten_up_to(state.mu)
@@ -289,16 +418,15 @@ class FusedAdamW:
         flat_s = (
             treedef.flatten_up_to(specs) if specs is not None else [None] * len(flat_p)
         )
+        flat_ms, flat_vs = self._flat_scales(state, treedef, len(flat_p))
         out = [
-            one(p, m, v, g, s)
-            for p, m, v, g, s in zip(flat_p, flat_m, flat_v, flat_g, flat_s)
+            one(p, m, v, g, s, ms, vs)
+            for p, m, v, g, s, ms, vs in zip(
+                flat_p, flat_m, flat_v, flat_g, flat_s, flat_ms, flat_vs
+            )
         ]
         new_params = treedef.unflatten([o[0] for o in out])
-        new_mu = treedef.unflatten([o[1] for o in out])
-        new_nu = treedef.unflatten([o[2] for o in out])
-        return new_params, optax.ScaleByAdamState(
-            count=state.count + 1, mu=new_mu, nu=new_nu
-        )
+        return new_params, self._rebuild_state(state, treedef, out)
 
 
 def fused_adamw(
@@ -308,9 +436,14 @@ def fused_adamw(
     eps: float = 1e-8,
     weight_decay: float = 1e-4,
     mu_dtype=None,
+    nu_dtype=None,
 ) -> FusedAdamW:
-    """``optax.adamw``-shaped constructor for the fused kernel optimizer."""
+    """``optax.adamw``-shaped constructor for the fused kernel optimizer.
+
+    ``mu_dtype``/``nu_dtype`` accept ``jnp.bfloat16`` (plain low-precision moment) or
+    ``jnp.float8_e4m3fn``/``float8_e5m2`` (scaled-fp8 moment with a per-tensor scale in
+    :class:`ScaledAdamState` — the MS-AMP low-precision-optimizer-state analog)."""
     return FusedAdamW(
         learning_rate=learning_rate, b1=b1, b2=b2, eps=eps,
-        weight_decay=weight_decay, mu_dtype=mu_dtype,
+        weight_decay=weight_decay, mu_dtype=mu_dtype, nu_dtype=nu_dtype,
     )

@@ -73,6 +73,7 @@ def pipeline_apply(
     stage_fn: Callable[[Any, jax.Array], Any],
     stage_params: Any,
     microbatches: jax.Array,
+    side_mb: Any = None,
     axis_name: str = PIPELINE_AXIS,
     with_aux: bool = False,
 ):
@@ -84,6 +85,11 @@ def pipeline_apply(
       and the pipeline returns ``(out, aux_total)``.
     - ``stage_params``: local slice, leading dim 1 (shard_map over P('pp', ...)).
     - ``microbatches``: [M, B_m, ...] replicated across pp.
+    - ``side_mb`` (optional): pytree of [M, B_m, ...] per-microbatch CONSTANTS
+      (positions, segment ids for sample packing). Every stage sees the same replicated
+      tables, and stage s at tick t works on microbatch (t - s) — so the slice is
+      INDEXED locally by that microbatch id, never ppermuted, and carries no gradient.
+      When given, stage_fn is called as ``stage_fn(params, x, side_slice)``.
 
     Returns [M, B_m, ...] outputs (replicated across pp after a masked psum). Aux values
     from bubble ticks (a stage computing on garbage before its first / after its last real
@@ -100,19 +106,27 @@ def pipeline_apply(
     out_buf0 = jnp.zeros_like(microbatches)
     aux0 = jnp.zeros((), jnp.float32)
 
+    def run(p, x, t):
+        if side_mb is None:
+            return stage_fn(p, x)
+        # Stage idx works on microbatch (t - idx); bubble ticks index a clamped slot
+        # (dead compute, masked on store like the activation itself).
+        side = _mb_index(side_mb, jnp.clip(t - idx, 0, M - 1))
+        return stage_fn(p, x, side)
+
     def tick(carry, t):
         recv, out_buf, aux_acc = carry
         # Stage 0 ingests microbatch t (clamped; masked out-of-range ticks are dead compute).
         ingest = microbatches[jnp.clip(t, 0, M - 1)]
         x = jnp.where(idx == 0, ingest, recv)
         if with_aux:
-            y, aux = stage_fn(local_params, x)
+            y, aux = run(local_params, x, t)
             # Stage idx works on microbatch (t - idx); only in-range ticks are real work.
             mb = t - idx
             live = jnp.logical_and(mb >= 0, mb < M)
             aux_acc = aux_acc + jnp.where(live, aux.astype(jnp.float32), 0.0)
         else:
-            y = stage_fn(local_params, x)
+            y = run(local_params, x, t)
         # Last stage banks microbatch (t - n + 1) when valid.
         out_t = t - (n - 1)
         valid = jnp.logical_and(idx == n - 1, jnp.logical_and(out_t >= 0, out_t < M))
@@ -140,6 +154,8 @@ def make_pipeline_fn(
     axis_name: str = PIPELINE_AXIS,
     num_microbatches: Optional[int] = None,
     with_aux: bool = False,
+    act_spec: Optional[P] = None,
+    extra_manual_axes: tuple = (),
 ):
     """GSPMD-embeddable pipeline: ``fn(stacked_stage_params, x [B, ...]) -> y [B, ...]``
     (``(y, aux_total)`` with ``with_aux`` — see ``pipeline_apply``).
@@ -147,32 +163,64 @@ def make_pipeline_fn(
     Splits the batch into microbatches, runs the GPipe schedule manual-over-``pp`` only
     (other mesh axes stay auto), and reassembles. ``stacked_stage_params`` leading dim =
     n_stages, sharded P('pp', ...).
+
+    ``extra_manual_axes`` + ``act_spec``: make additional axes manual inside the
+    pipeline — the sp×pp composition. Sequence-parallel attention is itself built on
+    ``lax.ppermute``/``all_to_all`` over ``sp``; nesting its own shard_map inside the
+    pipeline's fails to lower (backward MLIR verification), but making ``sp`` manual
+    HERE lets the stage body call the ring/ulysses collectives directly — one flat
+    shard_map, no nesting. ``act_spec`` is the activation PartitionSpec in MICROBATCH
+    layout [M, B_m, ...] (e.g. ``P(None, None, 'sp', None)`` to shard the sequence
+    dim); stage bodies then see sequence-sliced activations.
     """
     n_stages = mesh.shape[axis_name]
     if num_microbatches is None:
         num_microbatches = n_stages
+    if extra_manual_axes and with_aux:
+        raise NotImplementedError(
+            "with_aux under extra_manual_axes is not plumbed (MoE aux psums assume "
+            "sp-replicated stage bodies)"
+        )
+    x_spec = act_spec if act_spec is not None else P()
+    manual = {axis_name, *extra_manual_axes}
 
-    def fn(stage_params, x):
+    def fn(stage_params, x, side=None):
+        if side is not None and extra_manual_axes and jax.tree_util.tree_leaves(side):
+            raise NotImplementedError(
+                "side inputs (sample packing) under extra_manual_axes are not "
+                "supported — packed batches fall back from the sp attention modes"
+            )
         B = x.shape[0]
         if B % num_microbatches != 0:
             raise ValueError(f"batch {B} not divisible by {num_microbatches} microbatches")
         mb = x.reshape(num_microbatches, B // num_microbatches, *x.shape[1:])
 
         specs_params = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
+        in_specs = [specs_params, x_spec]
+        args = [stage_params, mb]
+        if side is not None:
+            # Per-microbatch constants (positions / segment ids): [B, ...] → [M, B_m, ...],
+            # replicated over pp and indexed inside (see pipeline_apply's side_mb).
+            side_mb = jax.tree_util.tree_map(
+                lambda a: a.reshape(num_microbatches, B // num_microbatches, *a.shape[1:]),
+                side,
+            )
+            in_specs.append(P())
+            args.append(side_mb)
         mapped = jax.shard_map(
             functools.partial(
                 pipeline_apply, stage_fn, axis_name=axis_name, with_aux=with_aux
             ),
             mesh=mesh,
-            in_specs=(specs_params, P()),
-            out_specs=(P(), P()) if with_aux else P(),
-            axis_names={axis_name},
+            in_specs=tuple(in_specs),
+            out_specs=(x_spec, P()) if with_aux else x_spec,
+            axis_names=manual,
             check_vma=False,
         )
+        out = mapped(*args)
         if with_aux:
-            out, aux = mapped(stage_params, mb)
+            out, aux = out
             return out.reshape(B, *out.shape[2:]), aux
-        out = mapped(stage_params, mb)
         return out.reshape(B, *out.shape[2:])
 
     return fn
@@ -306,7 +354,7 @@ def _zeros_f32(tree):
 
 def _pipeline_1f1b_bwd_kernel(
     stage_fn, sched: _Schedule, axis_name, with_aux,
-    stage_params, x_mb, dy_mb, aux_ct,
+    stage_params, x_mb, dy_mb, aux_ct, side_mb=None, extra_manual_axes=(),
 ):
     """The combined fwd+bwd 1F1B replay for the STAGE STACK, run inside shard_map
     (manual over pp only). The head's cotangents ``dy_mb`` [M, B_m, ...] arrive
@@ -338,15 +386,18 @@ def _pipeline_1f1b_bwd_kernel(
     arr_f_t = jnp.asarray(sched.arr_f)
     arr_b_t = jnp.asarray(sched.arr_b)
 
-    def run_stage(p, x):
-        """stage_fn normalized to (y, aux) — aux is 0.0 for dense stages."""
+    def run_stage(p, x, mb_id):
+        """stage_fn normalized to (y, aux) — aux is 0.0 for dense stages. ``mb_id`` (a
+        clamped microbatch index) selects the per-microbatch side constants; side slices
+        are indexed, never ppermuted, and carry no gradient."""
+        args = (p, x) if side_mb is None else (p, x, _mb_index(side_mb, mb_id))
         if with_aux:
-            return stage_fn(p, x)
-        return stage_fn(p, x), jnp.zeros((), jnp.float32)
+            return stage_fn(*args)
+        return stage_fn(*args), jnp.zeros((), jnp.float32)
 
-    def stage_vjp(p, x_b, dy):
+    def stage_vjp(p, x_b, dy, mb_id):
         def f(p, x):
-            y, aux = run_stage(p, x)
+            y, aux = run_stage(p, x, mb_id)
             # The aux term (MoE load balancing) contributes ct·aux_weight directly per
             # real (stage, microbatch) pair — aux_ct carries that scalar; masked ticks
             # discard the whole dp/dx anyway.
@@ -392,7 +443,7 @@ def _pipeline_1f1b_bwd_kernel(
             lax.dynamic_update_index_in_dim(in_buf, x_in, fm_c % sched.n_buf, 0),
             in_buf,
         )
-        y, _ = run_stage(p_local, x_in)
+        y, _ = run_stage(p_local, x_in, fm_c)
 
         # 3) Backward (remat): recompute this stage's forward inside the VJP. The last
         # stage takes its cotangent from the precomputed head-VJP table; others from
@@ -404,7 +455,7 @@ def _pipeline_1f1b_bwd_kernel(
             lax.dynamic_index_in_dim(dy_mb, bm_c, 0, False),
             lax.dynamic_index_in_dim(g_buf, bm_c % sched.g_buf, 0, False),
         )
-        dp, dx = stage_vjp(p_local, x_b, dy)
+        dp, dx = stage_vjp(p_local, x_b, dy, bm_c)
         live = bm >= 0
         dp_acc = _where_tree(live, jax.tree_util.tree_map(jnp.add, dp_acc, dp), dp_acc)
         dx_buf = jnp.where(
@@ -427,6 +478,15 @@ def _pipeline_1f1b_bwd_kernel(
 
     # dp is per-stage (stays sharded over pp, leading dim re-added); dx lives only on
     # stage 0 — psum replicates it across stages.
+    if extra_manual_axes:
+        # Stage params are REPLICATED over the extra manual axes (sp): each sp member
+        # computed a partial dp from its sequence slice, and the replicated out_spec
+        # needs the true sum. The AD-GPipe path gets this psum from shard_map's
+        # transpose automatically; the hand-written replay must issue it itself.
+        # (dx needs no psum over sp — it stays sequence-sharded, one slice per member.)
+        dp_acc = jax.tree_util.tree_map(
+            lambda a: lax.psum(a, tuple(extra_manual_axes)), dp_acc
+        )
     dp_out = jax.tree_util.tree_map(lambda a: a[None], dp_acc)
     dx_out = lax.psum(
         jnp.where(idx == 0, dx_buf, jnp.zeros_like(dx_buf)), axis_name
@@ -443,6 +503,8 @@ def make_pipeline_loss_fn(
     schedule: str = "1f1b",
     with_aux: bool = False,
     aux_weight: float = 0.0,
+    act_spec: Optional[P] = None,
+    extra_manual_axes: tuple = (),
 ):
     """Build ``loss(stage_params, head_params, x [B, ...], extras) -> scalar`` with a
     hand-scheduled 1F1B backward (``schedule="1f1b"``) or AD-GPipe (``"gpipe"``).
@@ -460,7 +522,15 @@ def make_pipeline_loss_fn(
       Note the aux term is added OUTSIDE head_loss_fn — normalize it via
       ``aux_weight`` only.
     - ``extras`` is a pytree of [B, ...] arrays (targets, masks); integer leaves get
-      ``float0`` cotangents.
+      ``float0`` cotangents and floating leaves get their TRUE cotangent from the head
+      VJP (the loss depends on extras only through ``head_loss_fn`` — differentiating
+      w.r.t. a float loss mask works).
+    - ``side`` (optional trailing argument): pytree of [B, ...] per-microbatch constants
+      delivered to a 3-arg ``stage_fn(params, x_mb, side_mb_slice)`` — positions /
+      segment ids for sample packing. Side inputs are indexed by microbatch id inside
+      the schedule (never ppermuted) and are NON-differentiable by contract: their
+      cotangent is ``float0``/zeros regardless of dtype (they parameterize attention
+      masking/RoPE, not the data path).
 
     The 1f1b loss is a scalar differentiable via ``jax.grad`` like any other. The
     primal runs a forward-only pipeline and saves the last-stage output ``y`` [B, ..]
@@ -474,18 +544,24 @@ def make_pipeline_loss_fn(
         raise ValueError(f"schedule={schedule!r}: expected '1f1b' or 'gpipe'")
     n_stages = mesh.shape[axis_name]
     M = num_microbatches if num_microbatches is not None else n_stages
+    x_spec = act_spec if act_spec is not None else P()
+    manual = {axis_name, *extra_manual_axes}
 
-    pipe = make_pipeline_fn(mesh, stage_fn, axis_name, M, with_aux=with_aux)
+    pipe = make_pipeline_fn(
+        mesh, stage_fn, axis_name, M, with_aux=with_aux,
+        act_spec=act_spec, extra_manual_axes=extra_manual_axes,
+    )
 
-    def _forward(stage_params, x):
+    def _forward(stage_params, x, side):
+        out = pipe(stage_params, x, side=side if side else None)
         if with_aux:
-            return pipe(stage_params, x)
-        return pipe(stage_params, x), jnp.zeros((), jnp.float32)
+            return out
+        return out, jnp.zeros((), jnp.float32)
 
     if schedule == "gpipe":
 
-        def gpipe_loss(stage_params, head_params, x, extras):
-            y, aux_total = _forward(stage_params, x)
+        def gpipe_loss(stage_params, head_params, x, extras, side=None):
+            y, aux_total = _forward(stage_params, x, side)
             return head_loss_fn(head_params, y, extras) + aux_weight * aux_total
 
         return gpipe_loss
@@ -493,60 +569,81 @@ def make_pipeline_loss_fn(
     sched = _simulate_1f1b(n_stages, M)
 
     @jax.custom_vjp
-    def loss(stage_params, head_params, x, extras):
+    def loss(stage_params, head_params, x, extras, side):
         # Primal: forward-only pipeline + full-batch head loss; saves nothing per-tick.
-        y, aux_total = _forward(stage_params, x)
+        y, aux_total = _forward(stage_params, x, side)
         return head_loss_fn(head_params, y, extras) + aux_weight * aux_total
 
-    def loss_fwd(stage_params, head_params, x, extras):
-        y, aux_total = _forward(stage_params, x)
+    def loss_fwd(stage_params, head_params, x, extras, side):
+        y, aux_total = _forward(stage_params, x, side)
         return (
             head_loss_fn(head_params, y, extras) + aux_weight * aux_total,
-            (stage_params, head_params, x, extras, y),
+            (stage_params, head_params, x, extras, side, y),
         )
 
     def loss_bwd(res, ct):
-        stage_params, head_params, x, extras, y = res
+        stage_params, head_params, x, extras, side, y = res
         B = x.shape[0]
         if B % M:
             raise ValueError(f"batch {B} not divisible by {M} microbatches")
 
         # 1) Head VJP on the full batch, OUTSIDE the pipeline: ordinary auto-sharded
         # GSPMD (tp-sharded heads keep their layout and collectives run uniformly).
-        (dh, dy) = jax.vjp(
-            lambda hp, yy: head_loss_fn(hp, yy, extras), head_params, y
+        # Differentiates w.r.t. extras too: float extras (a loss mask) get their TRUE
+        # cotangent — the loss depends on extras only through this head term; integer
+        # leaves come back float0 from jax automatically.
+        (dh, dy, d_extras) = jax.vjp(
+            head_loss_fn, head_params, y, extras
         )[1](jnp.asarray(ct, jnp.float32))
         dy_mb = dy.astype(jnp.float32).reshape(M, B // M, *y.shape[1:])
         x_mb = x.reshape(M, B // M, *x.shape[1:])
 
         # 2) 1F1B replay over the stage stack with the precomputed cotangents.
         specs_params = jax.tree_util.tree_map(lambda _: P(axis_name), stage_params)
-        mapped = jax.shard_map(
-            functools.partial(
-                _pipeline_1f1b_bwd_kernel, stage_fn, sched, axis_name, with_aux
-            ),
-            mesh=mesh,
-            in_specs=(specs_params, P(), P(), P()),
-            out_specs=(specs_params, P()),
-            # Manual over pp ONLY (like make_pipeline_fn): other axes stay auto so the
-            # batch keeps its dp sharding and stage params their tp/fsdp sharding.
-            axis_names={axis_name},
-            check_vma=False,
+        kernel = functools.partial(
+            _pipeline_1f1b_bwd_kernel, stage_fn, sched, axis_name, with_aux,
+            extra_manual_axes=tuple(extra_manual_axes),
         )
         aux_ct = jnp.asarray(ct, jnp.float32) * aux_weight
-        dp, dx_mb = mapped(stage_params, x_mb, dy_mb, aux_ct)
+        in_specs = [specs_params, x_spec, x_spec, P()]
+        args = [stage_params, x_mb, dy_mb, aux_ct]
+        if side:
+            side_mb = jax.tree_util.tree_map(
+                lambda a: a.reshape(M, B // M, *a.shape[1:]), side
+            )
+            in_specs.append(P())
+            args.append(side_mb)
+        mapped = jax.shard_map(
+            kernel, mesh=mesh,
+            in_specs=tuple(in_specs),
+            out_specs=(specs_params, x_spec),
+            # Manual over pp (plus any extra_manual_axes — sp for the sp×pp
+            # composition); other axes stay auto so the batch keeps its dp sharding
+            # and stage params their tp/fsdp sharding.
+            axis_names=manual,
+            check_vma=False,
+        )
+        dp, dx_mb = mapped(*args)
         dp = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), dp, stage_params)
         dh = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), dh, head_params)
         dx = dx_mb.reshape(B, *x.shape[1:]).astype(x.dtype)
-        d_extras = jax.tree_util.tree_map(
+        # Side inputs are non-differentiable BY CONTRACT (positions / segment ids
+        # parameterize masking and RoPE, not the data path): float0 for integer leaves,
+        # zeros for float leaves — documented above, unlike extras whose float leaves
+        # now carry the true head-VJP cotangent.
+        d_side = jax.tree_util.tree_map(
             lambda a: (
                 np.zeros(a.shape, jax.dtypes.float0)
                 if not jnp.issubdtype(a.dtype, jnp.floating)
                 else jnp.zeros_like(a)
             ),
-            extras,
+            side,
         )
-        return dp, dh, dx, d_extras
+        return dp, dh, dx, d_extras, d_side
 
     loss.defvjp(loss_fwd, loss_bwd)
-    return loss
+
+    def loss_with_optional_side(stage_params, head_params, x, extras, side=None):
+        return loss(stage_params, head_params, x, extras, {} if side is None else side)
+
+    return loss_with_optional_side

@@ -84,8 +84,70 @@ class GeneralTracker(ABC):
     def log(self, values: dict, step: Optional[int] = None, **kwargs):
         ...
 
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        """Log ``{name: image}`` where each image is an [H, W], [H, W, C] or [N, H, W, C]
+        array (numpy/jax; float in [0, 1] or uint8). Reference ``tracking.py:251`` —
+        backends without image support inherit this warn-and-skip no-op."""
+        logger.warning(
+            f"Tracker {self.name!r} does not support log_images; skipping {list(values)}"
+        )
+
+    def log_table(
+        self,
+        table_name: str,
+        columns: Optional[list] = None,
+        data: Optional[list] = None,
+        dataframe=None,
+        step: Optional[int] = None,
+        **kwargs,
+    ):
+        """Log a table either as ``columns`` + ``data`` rows or as a pandas
+        ``dataframe`` (reference ``tracking.py:360``). Backends without table support
+        inherit this warn-and-skip no-op."""
+        logger.warning(
+            f"Tracker {self.name!r} does not support log_table; skipping {table_name!r}"
+        )
+
+    def log_artifact(self, file_path: str, name: Optional[str] = None, **kwargs):
+        """Upload/copy a file into the tracking backend's artifact store (reference
+        MLflow/ClearML artifact APIs, ``tracking.py:734``)."""
+        logger.warning(
+            f"Tracker {self.name!r} does not support log_artifact; skipping {file_path}"
+        )
+
     def finish(self):
         pass
+
+
+def _table_rows(columns, data, dataframe):
+    """Normalize the log_table input contract to (columns, rows)."""
+    if dataframe is not None:
+        return list(dataframe.columns), dataframe.values.tolist()
+    if data is None:
+        raise ValueError("log_table needs either `data` (+ optional `columns`) or `dataframe`")
+    if columns is None:
+        columns = [f"col{i}" for i in range(len(data[0]))] if data else []
+    return list(columns), [list(r) for r in data]
+
+
+def _image_array(img):
+    """Normalize an image to uint8 [H, W, C] (accepts jax arrays, floats in [0,1],
+    grayscale [H, W]; a batched [N, H, W, C] stacks vertically into one image grid —
+    the log_images contract promises batches never crash a training run)."""
+    import numpy as np
+
+    a = np.asarray(img)
+    if a.ndim == 2:
+        a = a[:, :, None]
+    if a.ndim == 4:
+        a = a.reshape(a.shape[0] * a.shape[1], *a.shape[2:])
+    if a.ndim != 3:
+        raise ValueError(
+            f"expected [H, W], [H, W, C] or [N, H, W, C] image, got shape {a.shape}"
+        )
+    if a.dtype != np.uint8:
+        a = (np.clip(a.astype(np.float64), 0.0, 1.0) * 255).astype(np.uint8)
+    return a
 
 
 class JSONLTracker(GeneralTracker):
@@ -115,6 +177,37 @@ class JSONLTracker(GeneralTracker):
         record = {"_step": step, "_time": time.time(), **values}
         self._file.write(json.dumps(record, default=float) + "\n")
         self._file.flush()
+
+    @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        # Dependency-free: images land as .npy under <dir>/media/ with a pointer row in
+        # the metrics stream (the offline analog of a media panel).
+        import numpy as np
+
+        media = self.logging_dir / "media"
+        media.mkdir(exist_ok=True)
+        paths = {}
+        for k, v in values.items():
+            arr = _image_array(v)
+            fname = f"{k.replace('/', '_')}_step{step if step is not None else 'NA'}.npy"
+            np.save(media / fname, arr)
+            paths[k] = str(media / fname)
+        self.log({"_images": paths}, step=step)
+
+    @on_main_process
+    def log_table(
+        self, table_name, columns=None, data=None, dataframe=None, step=None, **kwargs
+    ):
+        cols, rows = _table_rows(columns, data, dataframe)
+        self.log({"_table": {"name": table_name, "columns": cols, "data": rows}}, step=step)
+
+    @on_main_process
+    def log_artifact(self, file_path: str, name: Optional[str] = None, **kwargs):
+        import shutil
+
+        artifacts = self.logging_dir / "artifacts"
+        artifacts.mkdir(exist_ok=True)
+        shutil.copy2(file_path, artifacts / (name or os.path.basename(file_path)))
 
     @on_main_process
     def finish(self):
@@ -159,6 +252,27 @@ class TensorBoardTracker(GeneralTracker):
         self.writer.flush()
 
     @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        for k, v in values.items():
+            self.writer.add_image(k, _image_array(v), global_step=step,
+                                  dataformats="HWC", **kwargs)
+        self.writer.flush()
+
+    @on_main_process
+    def log_table(
+        self, table_name, columns=None, data=None, dataframe=None, step=None, **kwargs
+    ):
+        # TensorBoard has no table panel; render as a markdown text summary (same
+        # fallback HF trainer integrations use).
+        cols, rows = _table_rows(columns, data, dataframe)
+        md = "| " + " | ".join(str(c) for c in cols) + " |\n"
+        md += "|" + "---|" * len(cols) + "\n"
+        for r in rows:
+            md += "| " + " | ".join(str(c) for c in r) + " |\n"
+        self.writer.add_text(table_name, md, global_step=step)
+        self.writer.flush()
+
+    @on_main_process
     def finish(self):
         self.writer.close()
 
@@ -190,6 +304,32 @@ class WandBTracker(GeneralTracker):
     @on_main_process
     def log(self, values: dict, step: Optional[int] = None, **kwargs):
         self.run.log(values, step=step, **kwargs)
+
+    @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        import wandb
+
+        self.run.log(
+            {k: wandb.Image(_image_array(v), **kwargs) for k, v in values.items()},
+            step=step,
+        )
+
+    @on_main_process
+    def log_table(
+        self, table_name, columns=None, data=None, dataframe=None, step=None, **kwargs
+    ):
+        import wandb
+
+        if dataframe is not None:
+            table = wandb.Table(dataframe=dataframe, **kwargs)
+        else:
+            cols, rows = _table_rows(columns, data, None)
+            table = wandb.Table(columns=cols, data=rows, **kwargs)
+        self.run.log({table_name: table}, step=step)
+
+    @on_main_process
+    def log_artifact(self, file_path: str, name: Optional[str] = None, **kwargs):
+        self.run.save(file_path, **kwargs)
 
     @on_main_process
     def finish(self):
@@ -230,6 +370,26 @@ class MLflowTracker(GeneralTracker):
         self._mlflow.log_metrics(metrics, step=step)
 
     @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        for k, v in values.items():
+            self._mlflow.log_image(
+                _image_array(v), artifact_file=f"{k.replace('/', '_')}_{step}.png", **kwargs
+            )
+
+    @on_main_process
+    def log_table(
+        self, table_name, columns=None, data=None, dataframe=None, step=None, **kwargs
+    ):
+        if dataframe is None:
+            cols, rows = _table_rows(columns, data, None)
+            dataframe = {c: [r[i] for r in rows] for i, c in enumerate(cols)}
+        self._mlflow.log_table(data=dataframe, artifact_file=f"{table_name}.json", **kwargs)
+
+    @on_main_process
+    def log_artifact(self, file_path: str, name: Optional[str] = None, **kwargs):
+        self._mlflow.log_artifact(file_path, artifact_path=name, **kwargs)
+
+    @on_main_process
     def finish(self):
         self._mlflow.end_run()
 
@@ -261,6 +421,23 @@ class CometMLTracker(GeneralTracker):
         self.writer.log_metrics(values, step=step, **kwargs)
 
     @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        for k, v in values.items():
+            self.writer.log_image(_image_array(v), name=k, step=step, **kwargs)
+
+    @on_main_process
+    def log_table(
+        self, table_name, columns=None, data=None, dataframe=None, step=None, **kwargs
+    ):
+        if dataframe is not None:
+            self.writer.log_table(f"{table_name}.csv", tabular_data=dataframe, **kwargs)
+        else:
+            cols, rows = _table_rows(columns, data, None)
+            self.writer.log_table(
+                f"{table_name}.csv", tabular_data=rows, headers=cols, **kwargs
+            )
+
+    @on_main_process
     def finish(self):
         self.writer.end()
 
@@ -289,6 +466,13 @@ class AimTracker(GeneralTracker):
     def log(self, values: dict, step: Optional[int] = None, **kwargs):
         for key, value in values.items():
             self.writer.track(value, name=key, step=step, **kwargs)
+
+    @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        from aim import Image
+
+        for k, v in values.items():
+            self.writer.track(Image(_image_array(v)), name=k, step=step, **kwargs)
 
     @on_main_process
     def finish(self):
@@ -328,6 +512,34 @@ class ClearMLTracker(GeneralTracker):
                     )
 
     @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        clearml_logger = self.task.get_logger()
+        for k, v in values.items():
+            title, _, series = k.partition("/")
+            clearml_logger.report_image(
+                title=title, series=series or title, iteration=step,
+                image=_image_array(v), **kwargs
+            )
+
+    @on_main_process
+    def log_table(
+        self, table_name, columns=None, data=None, dataframe=None, step=None, **kwargs
+    ):
+        clearml_logger = self.task.get_logger()
+        if dataframe is None:
+            cols, rows = _table_rows(columns, data, None)
+            dataframe = [cols, *rows]  # clearml accepts a list-of-rows table
+        title, _, series = table_name.partition("/")
+        clearml_logger.report_table(
+            title=title, series=series or title, iteration=step,
+            table_plot=dataframe, **kwargs
+        )
+
+    @on_main_process
+    def log_artifact(self, file_path: str, name: Optional[str] = None, **kwargs):
+        self.task.upload_artifact(name or os.path.basename(file_path), file_path, **kwargs)
+
+    @on_main_process
     def finish(self):
         self.task.close()
 
@@ -358,6 +570,17 @@ class DVCLiveTracker(GeneralTracker):
         for k, v in values.items():
             self.live.log_metric(k, v, **kwargs)
         self.live.next_step()
+
+    @on_main_process
+    def log_images(self, values: dict, step: Optional[int] = None, **kwargs):
+        if step is not None:
+            self.live.step = step
+        for k, v in values.items():
+            self.live.log_image(f"{k}.png", _image_array(v), **kwargs)
+
+    @on_main_process
+    def log_artifact(self, file_path: str, name: Optional[str] = None, **kwargs):
+        self.live.log_artifact(file_path, name=name, **kwargs)
 
     @on_main_process
     def finish(self):

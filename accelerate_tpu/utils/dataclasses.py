@@ -453,7 +453,10 @@ class PipelineParallelPlugin(KwargsHandler):
     - ``"1f1b"`` — hand-scheduled custom-VJP one-forward-one-backward: in-flight
       activations bounded by ``pp_size + 2`` per stage regardless of
       ``num_microbatches``, which is what lets M grow to amortize the (n-1)/(M+n-1)
-      bubble. Dense models only (MoE aux collection runs on the GPipe path).
+      bubble. MoE models are supported on BOTH schedules: per-(stage, microbatch)
+      load-balancing aux is carried through the 1f1b replay with the same /M
+      normalization as GPipe (``llama.loss_fn_pp`` with_aux/aux_weight;
+      ``tests/test_pipeline.py::test_llama_pp_moe_1f1b_matches_single``).
     """
 
     pp_size: int = 1

@@ -180,6 +180,32 @@ def _fail_json(metric: str, stage: str, exc: BaseException) -> None:
                 out["vs_baseline"] = rec.get("vs_baseline")
                 out["cached"] = True
                 out["recorded_at"] = rec.get("recorded_at")
+                # Staleness must be unmissable (VERDICT r3 weak #1): rc=0 with a cached
+                # value must not read as round-over-round progress. age_hours says how old
+                # the measurement is; stale_rounds counts the driver artifacts (BENCH_r*.json)
+                # that already replayed this same recorded_at, +1 for this emission.
+                out["age_hours"] = round(_record_age_hours(rec), 1)
+                prior = 0
+                try:
+                    import glob
+
+                    here = os.path.dirname(os.path.abspath(__file__))
+                    for p in sorted(glob.glob(os.path.join(here, "BENCH_r*.json"))):
+                        try:
+                            with open(p) as pf:
+                                prev = json.load(pf)
+                            row = prev.get("parsed", prev) if isinstance(prev, dict) else {}
+                            if isinstance(row, str):
+                                row = json.loads(row)
+                            if row.get("cached") and row.get("recorded_at") == rec.get(
+                                "recorded_at"
+                            ):
+                                prior += 1
+                        except Exception:
+                            continue
+                except Exception:
+                    pass
+                out["stale_rounds"] = prior + 1
         else:
             out["last_known_good_other_config"] = rec
     except Exception:
@@ -245,6 +271,12 @@ def _make_optimizer(name: str):
         "adamw_mu_bf16": lambda: optax.adamw(1e-4, mu_dtype=jnp.bfloat16),
         "fused_adamw": lambda: fused_adamw(1e-4),
         "fused_adamw_mu_bf16": lambda: fused_adamw(1e-4, mu_dtype=jnp.bfloat16),
+        # MS-AMP analog: scaled-fp8 moments (ScaledAdamState) — 4x less moment traffic
+        # in the bandwidth-bound apply; state dtype changes the update trajectory, so
+        # the row is labeled and never auto-adopted.
+        "fused_adamw_f8": lambda: fused_adamw(
+            1e-4, mu_dtype=jnp.float8_e4m3fn, nu_dtype=jnp.float8_e4m3fn
+        ),
         "sgd": lambda: optax.sgd(1e-4),
         "adafactor": lambda: optax.adafactor(1e-4),
         "lion": lambda: optax.lion(1e-5),

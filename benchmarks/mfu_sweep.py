@@ -96,6 +96,12 @@ CONFIGS = [
                          "BENCH_LOSS_IMPL": "fused"}),
     ("r3_fused_all_mu_bf16", {"BENCH_OPT": "fused_adamw_mu_bf16",
                               "BENCH_LOSS_IMPL": "fused"}),
+    # --- round-4 wave: fp8 optimizer state (MS-AMP analog, ops/fused_optim
+    # ScaledAdamState) — the apply is bandwidth-bound over the moment traffic, so fp8
+    # mu+nu cuts that 4x; workload-changing (state dtype), so labeled, never adopted.
+    ("r4_opt_f8_state", {"BENCH_OPT": "fused_adamw_f8", "BENCH_LOSS_IMPL": "fused"}),
+    ("r4_opt_f8_state_b8", {"BENCH_B": "8", "BENCH_OPT": "fused_adamw_f8",
+                            "BENCH_LOSS_IMPL": "fused"}),
 ]
 
 

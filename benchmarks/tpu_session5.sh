@@ -1,0 +1,27 @@
+#!/bin/bash
+# Fifth TPU work session (round 4): fp8 optimizer state (MS-AMP analog) rows + a final
+# adopt-best scoring run. Chained behind tpu_session4.sh (pass its PID as $1) — never
+# edit a running bash script.
+#
+# Ordered by value-per-chip-minute for a short tunnel window:
+#   1. the two fp8-optimizer-state rows (candidate apply-bandwidth lever, VERDICT r3 #6)
+#   2. adopt-best scoring run (locks any adoptable win into BENCH_SELF.json; the f8
+#      rows are labeled/never adopted but the run re-scores whatever IS adoptable)
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (session4) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 60; done
+fi
+
+echo "=== waiting for TPU ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+
+echo "=== 1. r4 fp8-optimizer-state rows ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
+  --only r4_opt_f8_state,r4_opt_f8_state_b8
+
+echo "=== 2. final adopt-best scoring run ==="
+timeout 900 python bench.py
+echo "=== session5 done ==="

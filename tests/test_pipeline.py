@@ -467,6 +467,36 @@ def test_1f1b_grads_match_sequential(pp_mesh):
     np.testing.assert_allclose(np.asarray(gx), np.asarray(rx), atol=1e-5)
 
 
+def test_1f1b_float_extras_cotangent_matches_sequential(pp_mesh):
+    """ADVICE r3: the loss genuinely depends on float extras (targets, loss masks) —
+    differentiating w.r.t. them must give the TRUE head-VJP cotangent (the custom VJP
+    used to return silent zeros)."""
+    from accelerate_tpu.parallel.pp import make_pipeline_loss_fn
+
+    d, L, B, n, M = 8, 8, 16, 4, 8
+    rng = np.random.default_rng(7)
+    layer_params = make_layer_params(L, d)
+    head_params = {"wout": jnp.asarray(rng.normal(size=(d, d)) * 0.1, jnp.float32)}
+    x = jnp.asarray(rng.normal(size=(B, d)), jnp.float32)
+    tgt = jnp.asarray(rng.normal(size=(B, d)), jnp.float32)
+
+    def head_loss(hp, y, extras):
+        return jnp.sum((y @ hp["wout"] - extras["tgt"]) ** 2)
+
+    ref = jax.grad(
+        lambda ex: head_loss(head_params, sequential_apply(layer_params, x), ex)
+    )({"tgt": tgt})
+    loss_fn = make_pipeline_loss_fn(
+        pp_mesh, mlp_stage, head_loss, num_microbatches=M, schedule="1f1b"
+    )
+    with jax.set_mesh(pp_mesh):
+        got = jax.jit(jax.grad(loss_fn, argnums=3))(
+            split_params_into_stages(layer_params, n), head_params, x, {"tgt": tgt}
+        )
+    assert float(jnp.abs(got["tgt"]).sum()) > 0  # the old contract returned zeros
+    np.testing.assert_allclose(np.asarray(got["tgt"]), np.asarray(ref["tgt"]), atol=1e-5)
+
+
 @slow
 def test_llama_pp_1f1b_matches_single():
     """llama loss_fn_pp(schedule='1f1b') == plain loss_fn, loss and one full train step
@@ -579,6 +609,111 @@ def test_gpt_pp_matches_single(schedule, M):
     )
 
 
+def _packed_batch(vocab: int, B: int, seq_len: int, seed: int) -> dict:
+    """A sample-packed batch (ops/packing.py) tiled/truncated to exactly B rows (the
+    pipeline needs B % num_microbatches == 0, which raw packing doesn't guarantee)."""
+    from accelerate_tpu.ops import packing
+
+    rng = np.random.default_rng(seed)
+    seqs = [
+        rng.integers(1, vocab, size=int(n)).astype(np.int32)
+        for n in rng.integers(3, seq_len, size=4 * B)
+    ]
+    packed = packing.pack_sequences(seqs, seq_len=seq_len, use_native=False)
+    return {
+        k: jnp.asarray(np.resize(v, (B, v.shape[1]))) for k, v in packed.items()
+    }
+
+
+@slow
+@pytest.mark.parametrize("family", ["llama", "gpt"])
+@pytest.mark.parametrize("schedule,M", [("gpipe", 4), ("1f1b", 8)])
+def test_pp_packed_matches_single(family, schedule, M):
+    """Sample packing composes with pipeline parallelism (VERDICT r3 #7): segment ids /
+    per-segment positions ride the pipeline as per-microbatch side constants (indexed by
+    microbatch id, never ppermuted), restricting attention to the block-diagonal mask in
+    every stage. Parity of loss AND grads vs the non-pipelined packed path, both
+    schedules, llama + gpt."""
+    import dataclasses as _dc
+
+    import importlib
+
+    mod = importlib.import_module(f"accelerate_tpu.models.{family}")
+    cfg = _dc.replace(
+        mod.CONFIGS["tiny"], dtype=jnp.float32, scan_layers=True, n_layers=4,
+        **({"attn_impl": "xla"} if family == "llama" else {}),
+    )
+    params = mod.init_params(cfg)
+    batch = _packed_batch(cfg.vocab_size, 8, 17, seed=5)
+    base = float(mod.loss_fn(params, batch, cfg))
+    base_g = jax.grad(lambda p: mod.loss_fn(p, batch, cfg))(params)
+
+    mesh = build_mesh(MeshConfig(dp=2, pp=4))
+    sp = dict(params)
+    sp["layers"] = split_params_into_stages(params["layers"], 4)
+    with jax.set_mesh(mesh):
+        l, g = jax.jit(jax.value_and_grad(
+            lambda p, b: mod.loss_fn_pp(
+                p, b, cfg, mesh, num_microbatches=M, schedule=schedule)
+        ))(sp, batch)
+    np.testing.assert_allclose(float(l), base, rtol=1e-5)
+    expected = dict(base_g)
+    expected["layers"] = split_params_into_stages(base_g["layers"], 4)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5
+        ),
+        dict(g), expected,
+    )
+
+
+@slow
+@pytest.mark.parametrize("schedule,M", [("gpipe", 4), ("1f1b", 8)])
+@pytest.mark.parametrize("loss_impl", ["fused", "fused_tp"])
+def test_gpt_pp_fused_loss_matches_single(schedule, M, loss_impl):
+    """gpt's pipeline carries the FULL loss_impl contract (VERDICT r3 #4 — llama got
+    the every-loss-impl-under-pp treatment first): the fused Pallas CE kernels dispatch
+    from the gpt head on both schedules, because ln_f + head run outside the pipe on the
+    full batch. fused_tp keeps the head vocab-sharded over tp (Megatron layout,
+    reference megatron_lm.py:588's GPT loss)."""
+    import dataclasses as _dc
+
+    from accelerate_tpu.models import gpt
+
+    cfg = _dc.replace(
+        gpt.CONFIGS["tiny"], dtype=jnp.float32, scan_layers=True, n_layers=4,
+        tie_embeddings=False, loss_impl=loss_impl,
+    )
+    cfg_base = _dc.replace(cfg, loss_impl="auto")
+    params = gpt.init_params(cfg)
+    rng = np.random.default_rng(0)
+    batch = {"tokens": jnp.asarray(rng.integers(0, cfg.vocab_size, (8, 17)), jnp.int32)}
+    base = float(gpt.loss_fn(params, batch, cfg_base))
+    base_g = jax.grad(lambda p: gpt.loss_fn(p, batch, cfg_base))(params)
+
+    mesh = build_mesh(
+        MeshConfig(dp=2, tp=2, pp=2) if loss_impl == "fused_tp"
+        else MeshConfig(dp=2, pp=4)
+    )
+    n_stages = mesh.shape["pp"]
+    sp = dict(params)
+    sp["layers"] = split_params_into_stages(params["layers"], n_stages)
+    with jax.set_mesh(mesh):
+        l, g = jax.jit(jax.value_and_grad(
+            lambda p, b: gpt.loss_fn_pp(
+                p, b, cfg, mesh, num_microbatches=M, schedule=schedule)
+        ))(sp, batch)
+    np.testing.assert_allclose(float(l), base, rtol=1e-5)
+    expected = dict(base_g)
+    expected["layers"] = split_params_into_stages(base_g["layers"], n_stages)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5
+        ),
+        dict(g), expected,
+    )
+
+
 @slow
 def test_llama_pp_1f1b_with_tensor_parallel():
     """Regression: 1F1B on a tp x pp mesh. The first 1F1B kernel branched the head/stage
@@ -679,18 +814,62 @@ def test_prepare_pippy_softcap_and_unknown_config():
         prepare_pippy({}, object(), mesh=mesh)
 
 
-def test_llama_pp_training_rejects_sp_attention():
-    """sp attention modes cannot TRAIN inside the pipeline (the nested shard_map
-    backward fails to lower in XLA); loss_fn_pp raises a clear error instead of
-    crashing opaquely at grad time. Forward-only pipelining (prepare_pippy) still
-    works for these modes."""
+@slow
+@pytest.mark.parametrize(
+    "mode,schedule,M",
+    [("ring", "gpipe", 4), ("ring", "1f1b", 4),
+     ("ulysses", "gpipe", 4), ("allgather", "1f1b", 4)],
+)
+def test_llama_pp_sp_attention_matches_single(mode, schedule, M):
+    """sp attention TRAINS inside the pipeline (VERDICT r3 #10 — formerly a
+    NotImplementedError): the pipeline's shard_map goes manual over sp too, activations
+    ride sequence-sliced, and the stage body issues the ring/ulysses collectives
+    directly (flat shard_map, no nesting — the nested form failed MLIR verification on
+    the backward). Loss and ALL grads match the non-pipelined, non-sp run at
+    dp2 x sp2 x pp2, both schedules."""
     import dataclasses as _dc
 
     from accelerate_tpu.models import llama
 
     cfg = _dc.replace(
-        llama.CONFIGS["tiny"], dtype=jnp.float32, attn_impl="ring", scan_layers=True,
+        llama.CONFIGS["tiny"], dtype=jnp.float32, attn_impl=mode, scan_layers=True,
         n_layers=4,
+    )
+    # Baseline: same math, no mesh context → the sp modes fall back to local attention.
+    params = llama.init_params(cfg)
+    batch = {"tokens": jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 17)), jnp.int32)}
+    base = float(llama.loss_fn(params, batch, cfg))
+    base_g = jax.grad(lambda p: llama.loss_fn(p, batch, cfg))(params)
+
+    sp = dict(params)
+    sp["layers"] = split_params_into_stages(params["layers"], 2)
+    mesh = build_mesh(MeshConfig(dp=2, sp=2, pp=2))
+    with jax.set_mesh(mesh):
+        l, g = jax.jit(jax.value_and_grad(
+            lambda p, b: llama.loss_fn_pp(
+                p, b, cfg, mesh, num_microbatches=M, schedule=schedule)
+        ))(sp, batch)
+    np.testing.assert_allclose(float(l), base, rtol=1e-5)
+    expected = dict(base_g)
+    expected["layers"] = split_params_into_stages(base_g["layers"], 2)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5
+        ),
+        dict(g), expected,
+    )
+
+
+def test_llama_pp_sp_moe_rejected_with_rationale():
+    """The one remaining sp×pp hole (MoE aux psums assume sp-replicated stages) must
+    fail loudly."""
+    import dataclasses as _dc
+
+    from accelerate_tpu.models import llama
+
+    cfg = _dc.replace(
+        llama.CONFIGS["moe-tiny"], dtype=jnp.float32, attn_impl="ring", scan_layers=True,
     )
     params = llama.init_params(cfg)
     sp = dict(params)
@@ -699,7 +878,7 @@ def test_llama_pp_training_rejects_sp_attention():
         np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 17)), jnp.int32)}
     mesh = build_mesh(MeshConfig(dp=2, sp=2, pp=2))
     with jax.set_mesh(mesh):
-        with pytest.raises(NotImplementedError, match="cannot TRAIN inside the pipeline"):
+        with pytest.raises(NotImplementedError, match="MoE"):
             llama.loss_fn_pp(sp, batch, cfg, mesh, num_microbatches=4)
 
 
