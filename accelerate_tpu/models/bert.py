@@ -24,7 +24,10 @@ from jax.sharding import PartitionSpec as P
 
 from ..utils.constants import BATCH_AXES, TENSOR_AXIS
 
-__all__ = ["BertConfig", "init_params", "forward", "loss_fn", "partition_specs", "CONFIGS"]
+__all__ = [
+    "BertConfig", "init_params", "forward", "loss_fn", "partition_specs", "CONFIGS",
+    "stack_pp_params", "forward_pp", "loss_fn_pp",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -102,8 +105,12 @@ def init_params(cfg: BertConfig, key: Optional[jax.Array] = None) -> dict:
     }
 
 
-def partition_specs(cfg: BertConfig) -> dict:
-    """Megatron TP layout: QKV/in column-parallel, O/out row-parallel."""
+def partition_specs(cfg: BertConfig, pp: bool = False) -> dict:
+    """Megatron TP layout: QKV/in column-parallel, O/out row-parallel.
+
+    ``pp=True``: specs for the :func:`stack_pp_params` layout — blocks stage-stacked
+    ``[n_stages, L/n, ...]`` with the stage dim over ``pp``; embed/pooler/classifier
+    stay outside the pipeline (replicated over pp — they are tiny next to the stack)."""
     col, row = P(None, TENSOR_AXIS), P(TENSOR_AXIS, None)
     ln = {"gamma": P(), "beta": P()}
     layer = {
@@ -113,9 +120,18 @@ def partition_specs(cfg: BertConfig) -> dict:
         "w_in": col, "b_in": P(TENSOR_AXIS), "w_out": row, "b_out": P(),
         "ln2": dict(ln),
     }
+    if pp:
+        from ..utils.constants import PIPELINE_AXIS
+
+        layers = jax.tree_util.tree_map(
+            lambda s: P(PIPELINE_AXIS, None, *s), layer,
+            is_leaf=lambda s: isinstance(s, P),
+        )
+    else:
+        layers = [dict(layer) for _ in range(cfg.n_layers)]
     return {
         "embed": {"tokens": P(TENSOR_AXIS, None), "positions": P(), "types": P(), "ln": dict(ln)},
-        "layers": [dict(layer) for _ in range(cfg.n_layers)],
+        "layers": layers,
         "pooler": {"w": P(), "b": P()},
         "classifier": {"w": P(), "b": P()},
     }
@@ -199,4 +215,132 @@ def loss_fn(params: dict, batch: dict, cfg: BertConfig) -> jax.Array:
     )
     logp = jax.nn.log_softmax(logits, axis=-1)
     ll = jnp.take_along_axis(logp, batch["labels"][:, None], axis=-1).squeeze(-1)
+    return -jnp.mean(ll)
+
+
+# --------------------------------------------------------------- pipeline-parallel training
+def stack_pp_params(params: dict, cfg: BertConfig, n_stages: int) -> dict:
+    """Canonical params → pipeline layout: the (homogeneous) block list stacks to
+    ``[n_stages, L/n, ...]``; embed/pooler/classifier pass through unchanged (they run
+    outside the pipeline). Specs: ``partition_specs(cfg, pp=True)``. Reference bar: the
+    Megatron engine drives Bert through pp (``megatron_lm.py:446``)."""
+    if cfg.n_layers % n_stages:
+        raise ValueError(
+            f"n_layers={cfg.n_layers} must be divisible by n_stages={n_stages}"
+        )
+    from ..parallel.pp import split_params_into_stages
+
+    stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params["layers"])
+    return {**{k: v for k, v in params.items() if k != "layers"},
+            "layers": split_params_into_stages(stacked, n_stages)}
+
+
+def _pp_stage_fn(cfg: BertConfig):
+    """One pipeline stage: scan this stage's blocks over a microbatch; the attention
+    mask rides as a per-microbatch side constant (``parallel.pp`` side contract —
+    boolean, correctly non-differentiable)."""
+    block = _block
+    if cfg.remat:
+        block = jax.checkpoint(_block, static_argnums=(3,))
+
+    def stage_fn(stage_layers, x, side):
+        def body(carry, layer):
+            return block(carry, layer, side["attention_mask"], cfg), None
+
+        out, _ = jax.lax.scan(body, x, stage_layers)
+        return out
+
+    return stage_fn
+
+
+def _embed(params: dict, input_ids, attention_mask, token_type_ids, cfg: BertConfig):
+    B, S = input_ids.shape
+    if attention_mask is None:
+        attention_mask = jnp.ones((B, S), jnp.bool_)
+    else:
+        attention_mask = attention_mask.astype(jnp.bool_)
+    if token_type_ids is None:
+        token_type_ids = jnp.zeros((B, S), jnp.int32)
+    emb = params["embed"]
+    x = (
+        emb["tokens"][input_ids]
+        + emb["positions"][jnp.arange(S)][None, :, :]
+        + emb["types"][token_type_ids]
+    ).astype(cfg.dtype)
+    return _layer_norm(x, emb["ln"], cfg.layer_norm_eps), attention_mask
+
+
+def _head_logits(hp: dict, x, cfg: BertConfig):
+    dtype = cfg.dtype
+    pooled = jnp.tanh(x[:, 0, :] @ hp["pooler"]["w"].astype(dtype) + hp["pooler"]["b"].astype(dtype))
+    logits = pooled @ hp["classifier"]["w"].astype(dtype) + hp["classifier"]["b"].astype(dtype)
+    return logits.astype(jnp.float32)
+
+
+def forward_pp(
+    params: dict,
+    input_ids: jax.Array,
+    cfg: BertConfig,
+    mesh,
+    num_microbatches: Optional[int] = None,
+    attention_mask: Optional[jax.Array] = None,
+    token_type_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Classification logits with the encoder blocks as a GPipe pipeline over ``pp``
+    (params in :func:`stack_pp_params` layout)."""
+    from ..parallel.pp import make_pipeline_fn
+
+    x, attention_mask = _embed(params, input_ids, attention_mask, token_type_ids, cfg)
+    x = _maybe_shard(x)
+    pipe = make_pipeline_fn(mesh, _pp_stage_fn(cfg), num_microbatches=num_microbatches)
+    x = pipe(params["layers"], x, side={"attention_mask": attention_mask})
+    return _head_logits(params, x, cfg)
+
+
+def loss_fn_pp(
+    params: dict,
+    batch: dict,
+    cfg: BertConfig,
+    mesh,
+    num_microbatches: Optional[int] = None,
+    rng=None,
+    schedule: str = "gpipe",
+) -> jax.Array:
+    """Pipeline-parallel classification CE (same batch contract as ``loss_fn``; params
+    in :func:`stack_pp_params` layout; both schedules — the pooler/classifier head runs
+    OUTSIDE the pipeline on the full batch)."""
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"schedule={schedule!r}: expected 'gpipe' or '1f1b'")
+    labels = batch["labels"]
+    if schedule == "1f1b":
+        from ..parallel.pp import make_pipeline_loss_fn
+
+        x, attention_mask = _embed(
+            params, batch["input_ids"], batch.get("attention_mask"),
+            batch.get("token_type_ids"), cfg,
+        )
+        hp = {"pooler": params["pooler"], "classifier": params["classifier"]}
+
+        def head_loss(h, y, ex):
+            logits = _head_logits(h, y, cfg)
+            logp = jax.nn.log_softmax(logits, axis=-1)
+            ll = jnp.take_along_axis(logp, ex["labels"][:, None], axis=-1).squeeze(-1)
+            return -jnp.mean(ll)
+
+        pipe_loss = make_pipeline_loss_fn(
+            mesh, _pp_stage_fn(cfg), head_loss,
+            num_microbatches=num_microbatches, schedule="1f1b",
+        )
+        x = _maybe_shard(x)
+        return pipe_loss(
+            params["layers"], hp, x, {"labels": labels},
+            side={"attention_mask": attention_mask},
+        )
+    logits_x = forward_pp(
+        params, batch["input_ids"], cfg, mesh, num_microbatches=num_microbatches,
+        attention_mask=batch.get("attention_mask"),
+        token_type_ids=batch.get("token_type_ids"),
+    )
+    logp = jax.nn.log_softmax(logits_x, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[:, None], axis=-1).squeeze(-1)
     return -jnp.mean(ll)

@@ -475,7 +475,7 @@ def stack_pp_params(params: dict, cfg: T5Config, n_stages: int) -> dict:
     if cfg.n_layers % n_stages or cfg.dec_layers % n_stages:
         raise ValueError(
             f"encoder ({cfg.n_layers}) and decoder ({cfg.dec_layers}) depths must both "
-            f"divide n_stages={n_stages}"
+            f"be divisible by n_stages={n_stages}"
         )
 
     def strip_stack(blocks):
@@ -513,9 +513,13 @@ def _enc_stage_fn(cfg: T5Config):
     )
 
     def stage_fn(sp, x, side):
-        mask = (
-            side["enc_mask"][:, None, None, :].astype(bool) if "enc_mask" in side else None
-        )
+        mask = None
+        if "enc_seg" in side:
+            # seq2seq packing: bidirectional attention restricted to same-segment pairs.
+            mask = _segment_pair_mask(side["enc_seg"], side["enc_seg"])
+        if "enc_mask" in side:
+            am = side["enc_mask"][:, None, None, :].astype(bool)
+            mask = am if mask is None else mask & am
 
         def body(carry, blk):
             # sp["bias"] is [1, H, S, S] here: pipeline_apply already stripped the
@@ -542,9 +546,15 @@ def _dec_stage_fn(cfg: T5Config, T: int):
 
     def stage_fn(sp, x, side):
         causal = jnp.tril(jnp.ones((T, T), bool))[None, None]
-        cmask = (
-            side["enc_mask"][:, None, None, :].astype(bool) if "enc_mask" in side else None
-        )
+        cmask = None
+        if "dec_seg" in side:
+            # seq2seq packing: per-segment causal self-attention; cross-attention pairs
+            # decoder segment k with encoder segment k only (pack_seq2seq numbering).
+            causal = causal & _segment_pair_mask(side["dec_seg"], side["dec_seg"])
+            cmask = _segment_pair_mask(side["dec_seg"], side["enc_seg"])
+        if "enc_mask" in side:
+            am = side["enc_mask"][:, None, None, :].astype(bool)
+            cmask = am if cmask is None else cmask & am
 
         def body(carry, blk):
             return block(carry, blk, side["enc_out"], sp["bias"], causal, cmask, cfg), None
@@ -564,6 +574,8 @@ def forward_pp(
     num_microbatches: Optional[int] = None,
     attention_mask: Optional[jax.Array] = None,
     return_hidden: bool = False,
+    enc_segment_ids: Optional[jax.Array] = None,
+    dec_segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Seq2seq forward with BOTH stacks pipelined over ``pp`` — the enc-dec pipeline
     shape the reference's Megatron engine drives for T5 (``megatron_lm.py:720``).
@@ -594,7 +606,11 @@ def forward_pp(
         # the stage body. Broadcast inside the traced fn → AD sums per-stage grads.
         "bias": jnp.broadcast_to(bias_e[None], (n, *bias_e.shape)),
     }
+    if (dec_segment_ids is None) != (enc_segment_ids is None):
+        raise ValueError("packed forward_pp requires BOTH enc_ and dec_segment_ids")
     side_e = {"enc_mask": attention_mask} if attention_mask is not None else {}
+    if enc_segment_ids is not None:
+        side_e["enc_seg"] = enc_segment_ids
     pipe_e = make_pipeline_fn(mesh, _enc_stage_fn(cfg), num_microbatches=num_microbatches)
     # side={} still routes through the side path (3-arg stage_fn), just with no leaves.
     enc_out = pipe_e(sp_e, x, side=side_e)
@@ -608,7 +624,12 @@ def forward_pp(
         "blocks": params["decoder"]["stages"],
         "bias": jnp.broadcast_to(bias_d[None], (n, *bias_d.shape)),
     }
-    side_d = {"enc_out": enc_out, **side_e}
+    side_d = {"enc_out": enc_out}
+    if attention_mask is not None:
+        side_d["enc_mask"] = attention_mask
+    if dec_segment_ids is not None:
+        side_d["dec_seg"] = dec_segment_ids
+        side_d["enc_seg"] = enc_segment_ids
     pipe_d = make_pipeline_fn(
         mesh, _dec_stage_fn(cfg, T), num_microbatches=num_microbatches
     )
@@ -631,8 +652,9 @@ def loss_fn_pp(
     schedule: str = "gpipe",
 ) -> jax.Array:
     """Pipeline-parallel seq2seq CE (params in :func:`stack_pp_params` layout; same
-    batch contract as ``loss_fn`` minus seq2seq packing). Every ``loss_impl`` works —
-    the head runs after the pipelines via ``common.ce_sum_dispatch``.
+    batch contract as ``loss_fn``, INCLUDING seq2seq packing — enc/dec segment ids ride
+    both pipelines as per-microbatch side constants). Every ``loss_impl`` works — the
+    head runs after the pipelines via ``common.ce_sum_dispatch``.
 
     Only ``schedule="gpipe"`` exists for the enc-dec shape: the 1F1B custom VJP
     delivers side inputs NON-differentiably by contract, but the decoder pipeline's
@@ -646,21 +668,39 @@ def loss_fn_pp(
             "pipeline's enc_out side input must be differentiable, which the 1F1B "
             "custom VJP's side contract excludes (parallel/pp.py make_pipeline_loss_fn)."
         )
-    if "dec_segment_ids" in batch or "segment_ids" in batch:
-        raise NotImplementedError(
-            "seq2seq packing is not supported on the t5 pipeline path"
+    if "segment_ids" in batch:
+        raise ValueError(
+            "seq2seq packing uses pack_seq2seq ('enc_segment_ids'/'dec_segment_ids'), "
+            "not the decoder-only 'segment_ids' layout"
         )
     from .common import ce_sum_dispatch, resolve_loss_chunk
 
     labels = batch["labels"]
     start = jnp.full((labels.shape[0], 1), cfg.decoder_start_token_id, labels.dtype)
-    dec_in = jnp.concatenate([start, jnp.maximum(labels[:, :-1], 0)], axis=1)
+    if "dec_segment_ids" in batch:
+        # Same packed conventions as loss_fn: the shift-right restarts at every decoder
+        # segment boundary, and targets count only inside real decoder segments.
+        dec_seg = batch["dec_segment_ids"]
+        enc_seg = batch["enc_segment_ids"]
+        prev = jnp.concatenate([start, jnp.maximum(labels[:, :-1], 0)], axis=1)
+        is_start = jnp.concatenate(
+            [jnp.ones((labels.shape[0], 1), bool), dec_seg[:, 1:] != dec_seg[:, :-1]],
+            axis=1,
+        )
+        dec_in = jnp.where(
+            is_start, jnp.asarray(cfg.decoder_start_token_id, labels.dtype), prev
+        )
+        mask = ((labels >= 0) & (dec_seg != 0)).astype(jnp.float32)
+    else:
+        dec_seg = enc_seg = None
+        dec_in = jnp.concatenate([start, jnp.maximum(labels[:, :-1], 0)], axis=1)
+        mask = (labels >= 0).astype(jnp.float32)
     hidden = forward_pp(
         params, batch["input_ids"], dec_in, cfg, mesh,
         num_microbatches=num_microbatches,
         attention_mask=batch.get("attention_mask"), return_hidden=True,
+        enc_segment_ids=enc_seg, dec_segment_ids=dec_seg,
     )
-    mask = (labels >= 0).astype(jnp.float32)
     safe = jnp.maximum(labels, 0)
     total = ce_sum_dispatch(
         hidden, _t5_head(params, cfg), safe, mask,

@@ -112,11 +112,11 @@ def test_f8_state_convergence_matches_fp32_state():
         GradientState._reset_state()
         PartialState._reset_state()
         acc = Accelerator()
+        rng = np.random.default_rng(1)  # reset BEFORE drawing: identical init both runs
         params = {
             "w1": jnp.asarray(rng.normal(size=(8, 64)) * 0.3, jnp.float32),
             "w2": jnp.zeros((64, 128), jnp.float32),
         }
-        rng = np.random.default_rng(0)  # identical init both runs
         state = acc.create_train_state(params, tx)
         step = acc.build_train_step(loss_fn, max_grad_norm=1.0)
         losses = []
