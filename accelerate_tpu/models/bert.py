@@ -175,21 +175,7 @@ def forward(
     cfg: BertConfig = CONFIGS["bert-base"],
 ) -> jax.Array:
     """[B, S] ids → [B, num_labels] classification logits (fp32)."""
-    B, S = input_ids.shape
-    dtype = cfg.dtype
-    if attention_mask is None:
-        attention_mask = jnp.ones((B, S), jnp.bool_)
-    else:
-        attention_mask = attention_mask.astype(jnp.bool_)
-    if token_type_ids is None:
-        token_type_ids = jnp.zeros((B, S), jnp.int32)
-    emb = params["embed"]
-    x = (
-        emb["tokens"][input_ids]
-        + emb["positions"][jnp.arange(S)][None, :, :]
-        + emb["types"][token_type_ids]
-    ).astype(dtype)
-    x = _layer_norm(x, emb["ln"], cfg.layer_norm_eps)
+    x, attention_mask = _embed(params, input_ids, attention_mask, token_type_ids, cfg)
     x = _maybe_shard(x)
 
     block = _block
@@ -198,10 +184,7 @@ def forward(
     for layer in params["layers"]:
         x = block(x, layer, attention_mask, cfg)
         x = _maybe_shard(x)
-
-    pooled = jnp.tanh(x[:, 0, :] @ params["pooler"]["w"].astype(dtype) + params["pooler"]["b"].astype(dtype))
-    logits = pooled @ params["classifier"]["w"].astype(dtype) + params["classifier"]["b"].astype(dtype)
-    return logits.astype(jnp.float32)
+    return _head_logits(params, x, cfg)
 
 
 def loss_fn(params: dict, batch: dict, cfg: BertConfig) -> jax.Array:

@@ -36,6 +36,8 @@ __all__ = [
     "gpt_neox_from_hf",
     "t5_config_from_hf",
     "t5_from_hf",
+    "bert_config_from_hf",
+    "bert_from_hf",
 ]
 
 
@@ -585,4 +587,78 @@ def t5_from_hf(state_dict: Mapping[str, Any], cfg) -> dict:
     if not cfg.tie_embeddings:
         head = sd.get("lm_head.weight")
         params["lm_head"] = _np(head).T if head is not None else params["shared"].T.copy()
+    return _to_jnp(params)
+
+
+def bert_config_from_hf(hf_config: Any, **overrides):
+    """BertConfig from a transformers BertConfig (object or dict) — the reference's
+    flagship ``nlp_example.py`` model family (bert-base on GLUE/MRPC)."""
+    from .bert import BertConfig
+
+    get = _getter(hf_config)
+    act = str(get("hidden_act", "gelu"))
+    if act != "gelu":
+        # models.bert._block hardcodes exact GELU; converting a relu/gelu_new
+        # checkpoint would silently compute wrong logits (same guard as _map_gelu).
+        raise NotImplementedError(
+            f"hidden_act={act!r}: models.bert implements exact GELU only; converting "
+            "would silently change the activation."
+        )
+    kwargs = dict(
+        vocab_size=get("vocab_size"),
+        d_model=get("hidden_size"),
+        n_layers=get("num_hidden_layers"),
+        n_heads=get("num_attention_heads"),
+        d_ff=get("intermediate_size"),
+        max_seq=get("max_position_embeddings", 512),
+        type_vocab_size=get("type_vocab_size", 2),
+        num_labels=get("num_labels", 2),
+        layer_norm_eps=float(get("layer_norm_eps", 1e-12)),
+    )
+    kwargs.update(overrides)
+    return BertConfig(**kwargs)
+
+
+def bert_from_hf(state_dict: Mapping[str, Any], cfg) -> dict:
+    """transformers ``BertForSequenceClassification`` state dict → ``models.bert``
+    params pytree (torch Linear stores [out, in] — transposed to [in, out])."""
+    sd = {re.sub(r"^bert\.", "", k): v for k, v in state_dict.items()}
+
+    def take(name):
+        return _np(sd[name])
+
+    def lin(prefix):
+        return take(prefix + ".weight").T, take(prefix + ".bias")
+
+    def ln(prefix):
+        return {"gamma": take(prefix + ".weight"), "beta": take(prefix + ".bias")}
+
+    params: dict = {
+        "embed": {
+            "tokens": take("embeddings.word_embeddings.weight"),
+            "positions": take("embeddings.position_embeddings.weight"),
+            "types": take("embeddings.token_type_embeddings.weight"),
+            "ln": ln("embeddings.LayerNorm"),
+        },
+        "layers": [],
+    }
+    for i in range(cfg.n_layers):
+        p = f"encoder.layer.{i}."
+        wq, bq = lin(p + "attention.self.query")
+        wk, bk = lin(p + "attention.self.key")
+        wv, bv = lin(p + "attention.self.value")
+        wo, bo = lin(p + "attention.output.dense")
+        w_in, b_in = lin(p + "intermediate.dense")
+        w_out, b_out = lin(p + "output.dense")
+        params["layers"].append({
+            "wq": wq, "bq": bq, "wk": wk, "bk": bk, "wv": wv, "bv": bv,
+            "wo": wo, "bo": bo,
+            "ln1": ln(p + "attention.output.LayerNorm"),
+            "w_in": w_in, "b_in": b_in, "w_out": w_out, "b_out": b_out,
+            "ln2": ln(p + "output.LayerNorm"),
+        })
+    pw, pb = lin("pooler.dense")
+    params["pooler"] = {"w": pw, "b": pb}
+    cw, cb = lin("classifier")  # classifier sits OUTSIDE the bert.* prefix in HF
+    params["classifier"] = {"w": cw, "b": cb}
     return _to_jnp(params)

@@ -587,17 +587,44 @@ def forward_pp(
     Params in :func:`stack_pp_params` layout; embed/ln_f/head outside the pipelines,
     vocab-sharded over (tp, fsdp, pp) by ``partition_specs(pp=True)``.
     """
+    enc_out = _encode_pp(
+        params, input_ids, cfg, mesh, num_microbatches, attention_mask, enc_segment_ids,
+        dec_segment_ids,
+    )
+    xd, sp_d, side_d = _dec_pp_inputs(
+        params, decoder_input_ids, cfg, mesh, enc_out, attention_mask,
+        enc_segment_ids, dec_segment_ids,
+    )
+    from ..parallel.pp import make_pipeline_fn
+
+    T = decoder_input_ids.shape[1]
+    pipe_d = make_pipeline_fn(
+        mesh, _dec_stage_fn(cfg, T), num_microbatches=num_microbatches
+    )
+    xd = pipe_d(sp_d, xd, side=side_d)
+    xd = _t5_norm(xd, params["decoder"]["ln_f"], cfg.norm_eps)
+    if cfg.tie_embeddings:
+        xd = xd * (cfg.d_model**-0.5)
+    if return_hidden:
+        return xd
+    return (xd @ _t5_head(params, cfg).astype(cfg.dtype)).astype(jnp.float32)
+
+
+def _encode_pp(
+    params, input_ids, cfg: T5Config, mesh, num_microbatches, attention_mask,
+    enc_segment_ids, dec_segment_ids,
+):
+    """The encoder half of the t5 pipeline: GPipe over the encoder stages → post-ln_f
+    encoder output (shared by the GPipe and 1F1B decoder paths)."""
     from ..parallel.pp import make_pipeline_fn
     from ..utils.constants import PIPELINE_AXIS
     from .llama import _maybe_shard
 
+    if (dec_segment_ids is None) != (enc_segment_ids is None):
+        raise ValueError("packed forward_pp requires BOTH enc_ and dec_segment_ids")
     n = mesh.shape[PIPELINE_AXIS]
     B, S = input_ids.shape
-    T = decoder_input_ids.shape[1]
-    dtype = cfg.dtype
-
-    # Encoder pipeline.
-    x = params["shared"].astype(dtype)[input_ids]
+    x = params["shared"].astype(cfg.dtype)[input_ids]
     x = _maybe_shard(x, P(BATCH_AXES, None, None))
     bias_e = _rel_bias(params["enc_rel"], S, S, bidirectional=True, cfg=cfg)
     sp_e = {
@@ -606,18 +633,29 @@ def forward_pp(
         # the stage body. Broadcast inside the traced fn → AD sums per-stage grads.
         "bias": jnp.broadcast_to(bias_e[None], (n, *bias_e.shape)),
     }
-    if (dec_segment_ids is None) != (enc_segment_ids is None):
-        raise ValueError("packed forward_pp requires BOTH enc_ and dec_segment_ids")
     side_e = {"enc_mask": attention_mask} if attention_mask is not None else {}
     if enc_segment_ids is not None:
         side_e["enc_seg"] = enc_segment_ids
     pipe_e = make_pipeline_fn(mesh, _enc_stage_fn(cfg), num_microbatches=num_microbatches)
     # side={} still routes through the side path (3-arg stage_fn), just with no leaves.
     enc_out = pipe_e(sp_e, x, side=side_e)
-    enc_out = _t5_norm(enc_out, params["encoder"]["ln_f"], cfg.norm_eps)
+    return _t5_norm(enc_out, params["encoder"]["ln_f"], cfg.norm_eps)
 
-    # Decoder pipeline (enc_out rides as a differentiable side constant under AD).
-    xd = params["shared"].astype(dtype)[decoder_input_ids]
+
+def _dec_pp_inputs(
+    params, decoder_input_ids, cfg: T5Config, mesh, enc_out, attention_mask,
+    enc_segment_ids, dec_segment_ids,
+):
+    """Decoder-pipeline inputs shared by the GPipe and 1F1B paths: embedded decoder
+    activations, decoder stage params (blocks + broadcast rel bias), and the side tree
+    (enc_out + masks/segments — enc_out is the FLOAT side leaf whose cotangent both
+    schedules propagate back into the encoder pipeline)."""
+    from ..utils.constants import PIPELINE_AXIS
+    from .llama import _maybe_shard
+
+    n = mesh.shape[PIPELINE_AXIS]
+    T = decoder_input_ids.shape[1]
+    xd = params["shared"].astype(cfg.dtype)[decoder_input_ids]
     xd = _maybe_shard(xd, P(BATCH_AXES, None, None))
     bias_d = _rel_bias(params["dec_rel"], T, T, bidirectional=False, cfg=cfg)
     sp_d = {
@@ -630,16 +668,7 @@ def forward_pp(
     if dec_segment_ids is not None:
         side_d["dec_seg"] = dec_segment_ids
         side_d["enc_seg"] = enc_segment_ids
-    pipe_d = make_pipeline_fn(
-        mesh, _dec_stage_fn(cfg, T), num_microbatches=num_microbatches
-    )
-    xd = pipe_d(sp_d, xd, side=side_d)
-    xd = _t5_norm(xd, params["decoder"]["ln_f"], cfg.norm_eps)
-    if cfg.tie_embeddings:
-        xd = xd * (cfg.d_model**-0.5)
-    if return_hidden:
-        return xd
-    return (xd @ _t5_head(params, cfg).astype(dtype)).astype(jnp.float32)
+    return xd, sp_d, side_d
 
 
 def loss_fn_pp(
@@ -656,18 +685,15 @@ def loss_fn_pp(
     both pipelines as per-microbatch side constants). Every ``loss_impl`` works — the
     head runs after the pipelines via ``common.ce_sum_dispatch``.
 
-    Only ``schedule="gpipe"`` exists for the enc-dec shape: the 1F1B custom VJP
-    delivers side inputs NON-differentiably by contract, but the decoder pipeline's
-    ``enc_out`` side input must carry gradients back into the encoder pipeline. A
-    t5-specific 1F1B would need per-microbatch enc_out cotangent accumulation across
-    the decoder replay — measure GPipe-with-remat first (same compute, higher
-    activation ceiling)."""
-    if schedule != "gpipe":
-        raise NotImplementedError(
-            "t5 pipeline training supports schedule='gpipe' only: the decoder "
-            "pipeline's enc_out side input must be differentiable, which the 1F1B "
-            "custom VJP's side contract excludes (parallel/pp.py make_pipeline_loss_fn)."
-        )
+    ``schedule="1f1b"`` hand-schedules the DECODER pipeline (the deeper, heavier half —
+    self + cross attention per block) through ``make_pipeline_loss_fn``; the replay
+    computes the TRUE ``enc_out`` cotangent (float side leaves accumulate across stages
+    and microbatches), which jax AD then chains back through the encoder's GPipe
+    pipeline. The encoder half stays AD-GPipe — its activations are the cheap half, and
+    a fully hand-scheduled enc+dec interleave would buy little for the added table
+    complexity."""
+    if schedule not in ("gpipe", "1f1b"):
+        raise ValueError(f"schedule={schedule!r}: expected 'gpipe' or '1f1b'")
     if "segment_ids" in batch:
         raise ValueError(
             "seq2seq packing uses pack_seq2seq ('enc_segment_ids'/'dec_segment_ids'), "
@@ -695,13 +721,45 @@ def loss_fn_pp(
         dec_seg = enc_seg = None
         dec_in = jnp.concatenate([start, jnp.maximum(labels[:, :-1], 0)], axis=1)
         mask = (labels >= 0).astype(jnp.float32)
+    safe = jnp.maximum(labels, 0)
+    if schedule == "1f1b":
+        from ..parallel.pp import make_pipeline_loss_fn
+
+        T = labels.shape[1]
+        am = batch.get("attention_mask")
+        enc_out = _encode_pp(
+            params, batch["input_ids"], cfg, mesh, num_microbatches, am,
+            enc_seg, dec_seg,
+        )
+        xd, sp_d, side_d = _dec_pp_inputs(
+            params, dec_in, cfg, mesh, enc_out, am, enc_seg, dec_seg
+        )
+        hp = {"ln_f": params["decoder"]["ln_f"], "head": _t5_head(params, cfg)}
+
+        def head_loss(h, y, ex):
+            xh = _t5_norm(y, h["ln_f"], cfg.norm_eps)
+            if cfg.tie_embeddings:
+                xh = xh * (cfg.d_model**-0.5)
+            total = ce_sum_dispatch(
+                xh, h["head"], ex["targets"], ex["mask"],
+                loss_impl=cfg.loss_impl, dtype=cfg.dtype,
+                chunk=resolve_loss_chunk(0, T, cfg.vocab_size),
+            )
+            return total / jnp.maximum(ex["mask"].sum(), 1.0)
+
+        pipe_loss = make_pipeline_loss_fn(
+            mesh, _dec_stage_fn(cfg, T), head_loss,
+            num_microbatches=num_microbatches, schedule="1f1b",
+        )
+        return pipe_loss(
+            sp_d, hp, xd, {"targets": safe, "mask": mask}, side=side_d
+        )
     hidden = forward_pp(
         params, batch["input_ids"], dec_in, cfg, mesh,
         num_microbatches=num_microbatches,
         attention_mask=batch.get("attention_mask"), return_hidden=True,
         enc_segment_ids=enc_seg, dec_segment_ids=dec_seg,
     )
-    safe = jnp.maximum(labels, 0)
     total = ce_sum_dispatch(
         hidden, _t5_head(params, cfg), safe, mask,
         loss_impl=cfg.loss_impl, dtype=cfg.dtype,

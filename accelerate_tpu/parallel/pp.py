@@ -381,33 +381,67 @@ def _pipeline_1f1b_bwd_kernel(
     dx_buf0 = jnp.zeros_like(x_mb, jnp.float32)
     dp0 = _zeros_f32(p_local)
 
+    # Side inputs split by dtype: FLOAT leaves are differentiable (t5's enc_out — every
+    # decoder stage consumes it, so its cotangent accumulates across stages and
+    # microbatches in ds_buf); integer/bool leaves (positions, segment ids, masks) are
+    # constants with float0 cotangents, matching the AD-GPipe path's semantics.
+    if side_mb is None:
+        side_leaves, side_treedef, side_is_f = [], None, []
+    else:
+        side_leaves, side_treedef = jax.tree_util.tree_flatten(side_mb)
+        side_is_f = [jnp.issubdtype(l.dtype, jnp.floating) for l in side_leaves]
+    side_f = [l for l, f in zip(side_leaves, side_is_f) if f]
+    side_i = [l for l, f in zip(side_leaves, side_is_f) if not f]
+
+    def _merge_side(fs, is_):
+        fit, iit = iter(fs), iter(is_)
+        return side_treedef.unflatten(
+            [next(fit) if f else next(iit) for f in side_is_f]
+        )
+
+    def _slice_side(leaves, mb_id):
+        return [lax.dynamic_index_in_dim(l, mb_id, 0, False) for l in leaves]
+
+    ds_buf0 = [jnp.zeros(l.shape, jnp.float32) for l in side_f]
+
     fwd_t = jnp.asarray(sched.fwd)
     bwd_t = jnp.asarray(sched.bwd)
     arr_f_t = jnp.asarray(sched.arr_f)
     arr_b_t = jnp.asarray(sched.arr_b)
 
-    def run_stage(p, x, mb_id):
-        """stage_fn normalized to (y, aux) — aux is 0.0 for dense stages. ``mb_id`` (a
-        clamped microbatch index) selects the per-microbatch side constants; side slices
-        are indexed, never ppermuted, and carry no gradient."""
-        args = (p, x) if side_mb is None else (p, x, _mb_index(side_mb, mb_id))
+    def run_with(p, x, side):
+        """stage_fn normalized to (y, aux) — aux is 0.0 for dense stages."""
+        args = (p, x) if side_mb is None else (p, x, side)
         if with_aux:
             return stage_fn(*args)
         return stage_fn(*args), jnp.zeros((), jnp.float32)
 
+    def run_stage(p, x, mb_id):
+        """``mb_id`` (a clamped microbatch index) selects the per-microbatch side
+        constants; side slices are indexed, never ppermuted."""
+        side = (
+            None if side_mb is None
+            else _merge_side(_slice_side(side_f, mb_id), _slice_side(side_i, mb_id))
+        )
+        return run_with(p, x, side)
+
     def stage_vjp(p, x_b, dy, mb_id):
-        def f(p, x):
-            y, aux = run_stage(p, x, mb_id)
+        sf = _slice_side(side_f, mb_id)
+        si = _slice_side(side_i, mb_id)
+
+        def f(p, x, sf_):
+            side = None if side_mb is None else _merge_side(sf_, si)
+            y, aux = run_with(p, x, side)
             # The aux term (MoE load balancing) contributes ct·aux_weight directly per
             # real (stage, microbatch) pair — aux_ct carries that scalar; masked ticks
             # discard the whole dp/dx anyway.
             return jnp.sum(y.astype(jnp.float32) * dy) + aux_ct * aux.astype(jnp.float32)
 
-        dp, dx = jax.grad(f, argnums=(0, 1))(p, x_b)
-        return dp, dx.astype(jnp.float32)
+        dp, dx, ds = jax.grad(f, argnums=(0, 1, 2))(p, x_b, sf)
+        return dp, dx.astype(jnp.float32), [d.astype(jnp.float32) for d in ds]
 
     def tick(carry, rows):
-        recv_f, recv_b, in_buf, g_buf, dx_buf, dp_acc = carry
+        recv_f, recv_b, in_buf, g_buf, dx_buf, dp_acc, ds_buf = carry
         f_row, b_row, af_row, ab_row = rows
         af = af_row[idx]
         ab = ab_row[idx]
@@ -455,7 +489,7 @@ def _pipeline_1f1b_bwd_kernel(
             lax.dynamic_index_in_dim(dy_mb, bm_c, 0, False),
             lax.dynamic_index_in_dim(g_buf, bm_c % sched.g_buf, 0, False),
         )
-        dp, dx = stage_vjp(p_local, x_b, dy, bm_c)
+        dp, dx, ds = stage_vjp(p_local, x_b, dy, bm_c)
         live = bm >= 0
         dp_acc = _where_tree(live, jax.tree_util.tree_map(jnp.add, dp_acc, dp), dp_acc)
         dx_buf = jnp.where(
@@ -463,18 +497,31 @@ def _pipeline_1f1b_bwd_kernel(
             lax.dynamic_update_index_in_dim(dx_buf, dx, bm_c, 0),
             dx_buf,
         )
+        # Float side cotangents: READ-ADD-WRITE at the microbatch slot — every stage
+        # backwards every microbatch (at different ticks), and their contributions to
+        # the shared side input (t5's enc_out) must all land.
+        ds_buf = [
+            jnp.where(
+                live,
+                lax.dynamic_update_index_in_dim(
+                    buf, lax.dynamic_index_in_dim(buf, bm_c, 0, False) + d, bm_c, 0
+                ),
+                buf,
+            )
+            for buf, d in zip(ds_buf, ds)
+        ]
 
         # 4) Sends — unconditional collectives (receivers bank only per their tables).
         recv_f = lax.ppermute(y, axis_name, perm_f)
         recv_b = lax.ppermute(dx, axis_name, perm_b)
-        return (recv_f, recv_b, in_buf, g_buf, dx_buf, dp_acc), None
+        return (recv_f, recv_b, in_buf, g_buf, dx_buf, dp_acc, ds_buf), None
 
     carry0 = (
         jnp.zeros(mb_shape, x_mb.dtype), jnp.zeros(mb_shape, jnp.float32),
-        in_buf0, g_buf0, dx_buf0, dp0,
+        in_buf0, g_buf0, dx_buf0, dp0, ds_buf0,
     )
     rows = (fwd_t, bwd_t, arr_f_t, arr_b_t)
-    (_, _, _, _, dx_buf, dp_acc), _ = lax.scan(tick, carry0, rows)
+    (_, _, _, _, dx_buf, dp_acc, ds_buf), _ = lax.scan(tick, carry0, rows)
 
     # dp is per-stage (stays sharded over pp, leading dim re-added); dx lives only on
     # stage 0 — psum replicates it across stages.
@@ -491,7 +538,9 @@ def _pipeline_1f1b_bwd_kernel(
     dx_out = lax.psum(
         jnp.where(idx == 0, dx_buf, jnp.zeros_like(dx_buf)), axis_name
     )
-    return dp_out, dx_out
+    # Float-side cotangents: each stage holds its own contributions — sum across pp.
+    ds_out = [lax.psum(b, axis_name) for b in ds_buf]
+    return dp_out, dx_out, ds_out
 
 
 def make_pipeline_loss_fn(
@@ -525,12 +574,14 @@ def make_pipeline_loss_fn(
       ``float0`` cotangents and floating leaves get their TRUE cotangent from the head
       VJP (the loss depends on extras only through ``head_loss_fn`` — differentiating
       w.r.t. a float loss mask works).
-    - ``side`` (optional trailing argument): pytree of [B, ...] per-microbatch constants
+    - ``side`` (optional trailing argument): pytree of [B, ...] per-microbatch inputs
       delivered to a 3-arg ``stage_fn(params, x_mb, side_mb_slice)`` — positions /
-      segment ids for sample packing. Side inputs are indexed by microbatch id inside
-      the schedule (never ppermuted) and are NON-differentiable by contract: their
-      cotangent is ``float0``/zeros regardless of dtype (they parameterize attention
-      masking/RoPE, not the data path).
+      segment ids for sample packing, or t5's encoder output for cross-attention.
+      Side inputs are indexed by microbatch id inside the schedule (never ppermuted).
+      FLOAT side leaves are fully differentiable — the 1F1B replay grads each stage's
+      side slice and accumulates across stages and microbatches (this is what lets
+      t5's decoder 1F1B chain gradients back into the encoder pipeline); integer/bool
+      leaves get ``float0`` cotangents, jax's own convention.
 
     The 1f1b loss is a scalar differentiable via ``jax.grad`` like any other. The
     primal runs a forward-only pipeline and saves the last-stage output ``y`` [B, ..]
@@ -616,29 +667,32 @@ def make_pipeline_loss_fn(
         mapped = jax.shard_map(
             kernel, mesh=mesh,
             in_specs=tuple(in_specs),
-            out_specs=(specs_params, x_spec),
+            out_specs=(specs_params, x_spec, P()),
             # Manual over pp (plus any extra_manual_axes — sp for the sp×pp
             # composition); other axes stay auto so the batch keeps its dp sharding
             # and stage params their tp/fsdp sharding.
             axis_names=manual,
             check_vma=False,
         )
-        dp, dx_mb = mapped(*args)
+        dp, dx_mb, ds_list = mapped(*args)
         dp = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), dp, stage_params)
         dh = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), dh, head_params)
         dx = dx_mb.reshape(B, *x.shape[1:]).astype(x.dtype)
-        # Side inputs are non-differentiable BY CONTRACT (positions / segment ids
-        # parameterize masking and RoPE, not the data path): float0 for integer leaves,
-        # zeros for float leaves — documented above, unlike extras whose float leaves
-        # now carry the true head-VJP cotangent.
-        d_side = jax.tree_util.tree_map(
-            lambda a: (
-                np.zeros(a.shape, jax.dtypes.float0)
-                if not jnp.issubdtype(a.dtype, jnp.floating)
-                else jnp.zeros_like(a)
-            ),
-            side,
-        )
+        # Side cotangents: FLOAT leaves get the true accumulated cotangent from the
+        # replay (t5's enc_out — the stage VJPs grad w.r.t. their side slice and the
+        # kernel sums across stages and microbatches); integer/bool leaves (positions,
+        # segment ids, masks) are float0, same as jax's own convention.
+        side_leaves, side_treedef = jax.tree_util.tree_flatten(side)
+        ds_iter = iter(ds_list)
+        d_side_leaves = [
+            (
+                next(ds_iter).reshape(a.shape).astype(a.dtype)
+                if jnp.issubdtype(a.dtype, jnp.floating)
+                else np.zeros(a.shape, jax.dtypes.float0)
+            )
+            for a in side_leaves
+        ]
+        d_side = side_treedef.unflatten(d_side_leaves)
         return dp, dh, dx, d_extras, d_side
 
     loss.defvjp(loss_fwd, loss_bwd)

@@ -169,3 +169,30 @@ def test_async_save_roundtrip(tmp_path):
     restored = acc.load_state(str(tmp_path / "ck"), train_state=state)
     for a, b in zip(jax.tree_util.tree_leaves(want), jax.tree_util.tree_leaves(restored.params)):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_f8_optimizer_state_roundtrip(tmp_path):
+    """ScaledAdamState (fp8 moments + per-tensor scales) must survive save/load
+    bit-exactly — fp8 leaves and scalar fp32 scales through the orbax path."""
+    from accelerate_tpu.ops.fused_optim import ScaledAdamState, fused_adamw
+
+    acc = Accelerator()
+    ds = RegressionDataset(32)
+    dl = acc.prepare(DataLoader(ds, batch_size=16))
+    state = acc.create_train_state(
+        init_params(), fused_adamw(1e-2, mu_dtype=jnp.float8_e4m3fn,
+                                   nu_dtype=jnp.float8_e4m3fn)
+    )
+    step = acc.build_train_step(loss_fn)
+    state, _ = train_some(acc, state, step, dl)
+    assert isinstance(state.opt_state, ScaledAdamState)
+
+    ckpt = acc.save_state(str(tmp_path / "ckpt_f8"), train_state=state)
+    saved_opt = jax.device_get(state.opt_state)
+    state2, _ = train_some(acc, state, step, dl)
+    assert not tree_equal(saved_opt, state2.opt_state)
+
+    restored = acc.load_state(ckpt, train_state=state2)
+    assert isinstance(restored.opt_state, ScaledAdamState)
+    assert jax.tree_util.tree_leaves(restored.opt_state.mu)[0].dtype == jnp.float8_e4m3fn
+    assert tree_equal(restored.opt_state, saved_opt)

@@ -356,3 +356,37 @@ def test_mistral_logits_match_transformers():
         llama.forward(params, jnp.asarray(tokens), cfg, shard_activations=False)
     )
     np.testing.assert_allclose(ours, hf_logits, atol=3e-4, rtol=1e-3)
+
+
+@slow
+def test_bert_logits_match_transformers():
+    """BertForSequenceClassification (the reference nlp_example family) converts with
+    classification-logits parity, attention mask load-bearing."""
+    from accelerate_tpu.models import bert
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=128, max_position_embeddings=64, type_vocab_size=2,
+        num_labels=3, hidden_act="gelu",
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.BertForSequenceClassification(hf_cfg).eval()
+
+    cfg = hf_interop.bert_config_from_hf(hf_cfg, dtype=jnp.float32)
+    params = hf_interop.bert_from_hf(hf_model.state_dict(), cfg)
+
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 128, size=(2, 12)).astype(np.int32)
+    am = np.ones((2, 12), np.int32)
+    am[:, -4:] = 0
+    tt = rng.integers(0, 2, size=(2, 12)).astype(np.int32)
+    with torch.no_grad():
+        hf_logits = hf_model(
+            torch.from_numpy(ids.astype(np.int64)),
+            attention_mask=torch.from_numpy(am.astype(np.int64)),
+            token_type_ids=torch.from_numpy(tt.astype(np.int64)),
+        ).logits.numpy()
+    ours = np.asarray(bert.forward(
+        params, jnp.asarray(ids), jnp.asarray(am), jnp.asarray(tt), cfg
+    ))
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-4, rtol=1e-3)

@@ -44,8 +44,8 @@ def test_training_decreases_loss():
 
 @slow
 @pytest.mark.parametrize("with_mask", [False, True])
-@pytest.mark.parametrize("M", [2, 4])
-def test_t5_pp_matches_single(with_mask, M):
+@pytest.mark.parametrize("schedule,M", [("gpipe", 2), ("gpipe", 4), ("1f1b", 4)])
+def test_t5_pp_matches_single(with_mask, schedule, M):
     """T5 through the pipeline (VERDICT r3 #5 — reference Megatron pipelines T5,
     megatron_lm.py:720): encoder stages then decoder stages chained over the same pp
     axis, enc_out delivered to cross-attention as a differentiable side constant.
@@ -66,11 +66,14 @@ def test_t5_pp_matches_single(with_mask, M):
     pp_params = t5.stack_pp_params(params, CFG, 2)
     with jax.set_mesh(mesh):
         l, g = jax.jit(jax.value_and_grad(
-            lambda p, b: t5.loss_fn_pp(p, b, CFG, mesh, num_microbatches=M)
+            lambda p, b: t5.loss_fn_pp(
+                p, b, CFG, mesh, num_microbatches=M, schedule=schedule)
         ))(pp_params, batch)
     np.testing.assert_allclose(float(l), base, rtol=1e-5)
     # stack_pp_params is structural — applying it to the grad tree yields exactly the
-    # expected pipeline-layout grads (rel tables lifted, blocks stage-stacked).
+    # expected pipeline-layout grads (rel tables lifted, blocks stage-stacked). Under
+    # 1f1b the encoder grads exist only because the replay computed the TRUE enc_out
+    # cotangent (float side leaves) and AD chained it through the encoder pipeline.
     expected = t5.stack_pp_params(base_g, CFG, 2)
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(
@@ -81,8 +84,8 @@ def test_t5_pp_matches_single(with_mask, M):
 
 
 @slow
-@pytest.mark.parametrize("M", [2, 4])
-def test_t5_pp_seq2seq_packed_matches_single(M):
+@pytest.mark.parametrize("schedule,M", [("gpipe", 2), ("gpipe", 4), ("1f1b", 4)])
+def test_t5_pp_seq2seq_packed_matches_single(schedule, M):
     """Seq2seq packing composes with the enc-dec pipeline: enc/dec segment ids ride
     both pipelines as side constants (per-segment bidirectional, per-segment causal,
     and segment-paired cross-attention), matching the non-pipelined packed loss AND
@@ -108,7 +111,8 @@ def test_t5_pp_seq2seq_packed_matches_single(M):
     pp_params = t5.stack_pp_params(params, CFG, 2)
     with jax.set_mesh(mesh):
         l, g = jax.jit(jax.value_and_grad(
-            lambda p, b: t5.loss_fn_pp(p, b, CFG, mesh, num_microbatches=M)
+            lambda p, b: t5.loss_fn_pp(
+                p, b, CFG, mesh, num_microbatches=M, schedule=schedule)
         ))(pp_params, batch)
     np.testing.assert_allclose(float(l), base, rtol=1e-5)
     expected = t5.stack_pp_params(base_g, CFG, 2)
@@ -118,18 +122,6 @@ def test_t5_pp_seq2seq_packed_matches_single(M):
         ),
         g, expected,
     )
-
-
-def test_t5_pp_1f1b_raises_with_rationale():
-    """The enc-dec shape has no 1F1B schedule (enc_out side input must be
-    differentiable); the guard must fail loudly, not train silently wrong."""
-    from accelerate_tpu.parallel.mesh import build_mesh
-
-    params = t5.stack_pp_params(t5.init_params(CFG), CFG, 2)
-    mesh = build_mesh(MeshConfig(dp=4, pp=2))
-    batch = {k: jnp.asarray(v) for k, v in make_batch().items()}
-    with pytest.raises(NotImplementedError, match="gpipe"):
-        t5.loss_fn_pp(params, batch, CFG, mesh, schedule="1f1b")
 
 
 @slow
