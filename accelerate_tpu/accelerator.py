@@ -269,6 +269,7 @@ class Accelerator:
                     pp_size=megatron_lm_plugin.pp_degree,
                     num_microbatches=megatron_lm_plugin.num_micro_batches,
                     schedule=megatron_lm_plugin.pp_schedule,
+                    virtual_stages=megatron_lm_plugin.virtual_pipeline_stages,
                 )
             if sp_plugin is None and megatron_lm_plugin.sp_degree > 1:
                 sp_plugin = SequenceParallelPlugin(sp_size=megatron_lm_plugin.sp_degree)
@@ -498,6 +499,23 @@ class Accelerator:
             return env_s
         plugin = self.state.pp_plugin
         return plugin.schedule if plugin is not None else "gpipe"
+
+    @property
+    def virtual_stages(self) -> int:
+        """Interleaved virtual-pipeline chunks per device from the plugin (the Megatron
+        ``virtual_pipeline`` analog) — pass to the model's
+        ``loss_fn_pp(..., virtual_stages=accelerator.virtual_stages)``; env override
+        ACCELERATE_PP_VIRTUAL_STAGES mirrors the launcher protocol."""
+        env_v = os.environ.get("ACCELERATE_PP_VIRTUAL_STAGES")
+        if env_v:
+            v = int(env_v)
+            if v < 1:
+                # Mirror PipelineParallelPlugin.__post_init__ — an invalid env value
+                # must fail here, not as an opaque modulo-by-zero at split time.
+                raise ValueError(f"ACCELERATE_PP_VIRTUAL_STAGES={env_v!r} must be >= 1")
+            return v
+        plugin = self.state.pp_plugin
+        return plugin.virtual_stages if plugin is not None else 1
 
     @property
     def gradient_accumulation_steps(self) -> int:

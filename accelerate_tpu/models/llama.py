@@ -285,7 +285,7 @@ def init_params(cfg: LlamaConfig, key: Optional[jax.Array] = None) -> dict:
     return params
 
 
-def partition_specs(cfg: LlamaConfig, pp: bool = False) -> dict:
+def partition_specs(cfg: LlamaConfig, pp: bool = False, virtual_stages: int = 1) -> dict:
     """Megatron-layout PartitionSpecs, same structure as the params pytree.
 
     Column-parallel: wq/wk/wv/w_gate/w_up split their output dim over ``tp``.
@@ -337,8 +337,14 @@ def partition_specs(cfg: LlamaConfig, pp: bool = False) -> dict:
             raise ValueError("pipeline parallelism requires cfg.scan_layers=True")
         from ..utils.constants import PIPELINE_AXIS
 
+        # virtual_stages > 1 → interleaved layout [v, n_stages, L/(n·v), ...]: the pp
+        # axis on dim 1 so device s hosts the STRIDED virtual stages (see
+        # split_params_into_stages).
+        prefix = (
+            (None, PIPELINE_AXIS, None) if virtual_stages > 1 else (PIPELINE_AXIS, None)
+        )
         layer = jax.tree_util.tree_map(
-            lambda spec: P(PIPELINE_AXIS, None, *spec),
+            lambda spec: P(*prefix, *spec),
             layer,
             is_leaf=lambda s: isinstance(s, P),
         )
@@ -1054,11 +1060,16 @@ def loss_fn_pp(
     num_microbatches: Optional[int] = None,
     rng: Optional[jax.Array] = None,
     schedule: str = "gpipe",
+    virtual_stages: int = 1,
 ) -> jax.Array:
     """Pipeline-parallel next-token cross-entropy (same contract as ``loss_fn``,
     including sample packing: ``segment_ids`` ride the pipeline as per-microbatch side
     constants — ``parallel.pp``'s side-input contract — restricting attention to the
     block-diagonal per-segment mask with per-segment RoPE restarts, both schedules).
+
+    ``virtual_stages=v > 1`` (interleaved virtual pipeline, 1f1b only): layers in the
+    ``split_params_into_stages(..., virtual_stages=v)`` layout with specs from
+    ``partition_specs(pp=True, virtual_stages=v)`` — the bubble amortizes ≈ v×.
 
     ``schedule="1f1b"`` routes through ``parallel.pp.make_pipeline_loss_fn``: the custom
     VJP's hand-scheduled one-forward-one-backward keeps in-flight activations bounded by
@@ -1110,6 +1121,11 @@ def loss_fn_pp(
         )
         seg_in = None
         side = None
+    if virtual_stages > 1 and (schedule != "1f1b" or side is not None or sp_pipeline):
+        raise NotImplementedError(
+            "virtual_stages > 1 requires schedule='1f1b' and composes with neither "
+            "sample packing nor sp-attention-in-pp yet (parallel/pp.py)"
+        )
     if schedule == "1f1b" or sp_pipeline:
         from ..parallel.pp import make_pipeline_loss_fn
 
@@ -1139,6 +1155,7 @@ def loss_fn_pp(
             # over sp too (microbatch layout [M, B_m, S, D] → sp on dim 2).
             act_spec=P(None, None, SEQUENCE_AXIS, None) if sp_pipeline else None,
             extra_manual_axes=(SEQUENCE_AXIS,) if sp_pipeline else (),
+            virtual_stages=virtual_stages,
         )
         x = params["embed"].astype(dtype)[inputs]
         return pipe_loss(

@@ -57,14 +57,28 @@ def stack_stage_params(stage_param_list: list[Any]) -> Any:
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *stage_param_list)
 
 
-def split_params_into_stages(layer_params: Any, n_stages: int) -> Any:
-    """Group a stacked-layers pytree [L, ...] into [n_stages, L/n_stages, ...]."""
+def split_params_into_stages(
+    layer_params: Any, n_stages: int, virtual_stages: int = 1
+) -> Any:
+    """Group a stacked-layers pytree [L, ...] into [n_stages, L/n_stages, ...].
+
+    ``virtual_stages=v > 1`` (interleaved/virtual pipeline): [v, n_stages, L/(n·v), ...]
+    — global virtual stage ``vs = c·n + s`` holds layer block ``vs``, so device ``s``
+    hosts the STRIDED set {s, n+s, 2n+s, ...} (dim 1 shards over pp; a contiguous
+    [n·v, ...] sharding would assign consecutive blocks to one device, which is the
+    non-interleaved layout)."""
 
     def _split(leaf):
         L = leaf.shape[0]
-        if L % n_stages != 0:
-            raise ValueError(f"layer count {L} not divisible by {n_stages} stages")
-        return leaf.reshape(n_stages, L // n_stages, *leaf.shape[1:])
+        total = n_stages * virtual_stages
+        if L % total != 0:
+            raise ValueError(
+                f"layer count {L} not divisible by {n_stages} stages x "
+                f"{virtual_stages} virtual stages"
+            )
+        if virtual_stages == 1:
+            return leaf.reshape(n_stages, L // n_stages, *leaf.shape[1:])
+        return leaf.reshape(virtual_stages, n_stages, L // total, *leaf.shape[1:])
 
     return jax.tree_util.tree_map(_split, layer_params)
 
@@ -340,6 +354,155 @@ def _simulate_1f1b(n: int, M: int) -> _Schedule:
     return _Schedule(fwd, bwd, arr_f, arr_b, n_buf, g_depth)
 
 
+# ------------------------------------------------------- interleaved (virtual) 1F1B
+class _InterleavedSchedule(NamedTuple):
+    """Static interleaved-1F1B tables, all [T, n] int32 with -1 = idle. Virtual stage
+    ``vs = chunk*n + device`` (global layer order); per tick a device forwards one
+    (chunk, mb) and backwards one (chunk, mb)."""
+
+    f_c: np.ndarray
+    f_m: np.ndarray
+    b_c: np.ndarray
+    b_m: np.ndarray
+    af_c: np.ndarray
+    af_m: np.ndarray
+    ab_c: np.ndarray
+    ab_m: np.ndarray
+    n_buf: int
+    g_buf: int
+
+
+@functools.lru_cache(maxsize=None)
+def _simulate_interleaved(n: int, v: int, M: int) -> _InterleavedSchedule:
+    """Greedy event simulation of INTERLEAVED 1F1B — the Megatron virtual-pipeline
+    schedule shape (reference ``dataclasses.py:2024``): each device hosts ``v`` model
+    chunks (virtual stages ``vs = c*n + s``), activations flow circularly (device n-1
+    chunk c → device 0 chunk c+1), and the (n-1)/(M+n-1) bubble shrinks ≈ v× because a
+    device fills idle ticks with other chunks' work. Policy: backward-priority on the
+    deepest ready chunk; forwards also pick the deepest ready chunk (shallow-first
+    starves the tail into deadlock); per-device in-flight cap n·v+2. Buffer depths are
+    DERIVED from the schedule (per-vs live sets are contiguous [next_b, next_f) windows,
+    so modular slots of depth = max live count suffice) and then statically verified —
+    a schedule bug fails here at trace time, not as silent corruption."""
+    VS = n * v
+    cap = n * v + 2
+    next_f = [0] * VS
+    next_b = [0] * VS
+    f_tick = [[-1] * M for _ in range(VS)]
+    b_tick = [[-1] * M for _ in range(VS)]
+    rows = []
+    t = 0
+    while any(next_b[vs] < M for vs in range(VS)):
+        frow_c, frow_m = [-1] * n, [-1] * n
+        brow_c, brow_m = [-1] * n, [-1] * n
+        for s in range(n):
+            for c in reversed(range(v)):
+                vs = c * n + s
+                m = next_b[vs]
+                if m >= M:
+                    continue
+                if vs == VS - 1:
+                    ready = 0 <= f_tick[vs][m] < t
+                else:
+                    ready = 0 <= b_tick[vs + 1][m] < t
+                if ready:
+                    brow_c[s], brow_m[s] = c, m
+                    b_tick[vs][m] = t
+                    next_b[vs] += 1
+                    break
+            inflight = sum(next_f[c2 * n + s] - next_b[c2 * n + s] for c2 in range(v))
+            if inflight >= cap:
+                continue
+            for c in reversed(range(v)):
+                vs = c * n + s
+                m = next_f[vs]
+                if m >= M:
+                    continue
+                if vs == 0 or 0 <= f_tick[vs - 1][m] < t:
+                    frow_c[s], frow_m[s] = c, m
+                    f_tick[vs][m] = t
+                    next_f[vs] += 1
+                    break
+        rows.append((frow_c, frow_m, brow_c, brow_m))
+        t += 1
+        if t > 8 * (M * v + n) + 16:
+            raise AssertionError(f"interleaved sim did not converge (n={n}, v={v}, M={M})")
+
+    T = len(rows)
+    f_c = np.array([r[0] for r in rows], np.int32)
+    f_m = np.array([r[1] for r in rows], np.int32)
+    b_c = np.array([r[2] for r in rows], np.int32)
+    b_m = np.array([r[3] for r in rows], np.int32)
+    af_c = np.full((T, n), -1, np.int32)
+    af_m = np.full((T, n), -1, np.int32)
+    ab_c = np.full((T, n), -1, np.int32)
+    ab_m = np.full((T, n), -1, np.int32)
+    for t0 in range(1, T):
+        for s in range(n):
+            src = (s - 1) % n
+            c_src, m_src = f_c[t0 - 1, src], f_m[t0 - 1, src]
+            if m_src >= 0:
+                vs_src = c_src * n + src
+                if vs_src + 1 < VS and (vs_src + 1) % n == s:
+                    af_c[t0, s], af_m[t0, s] = (vs_src + 1) // n, m_src
+            srcb = (s + 1) % n
+            c_srcb, m_srcb = b_c[t0 - 1, srcb], b_m[t0 - 1, srcb]
+            if m_srcb >= 0:
+                vs_srcb = c_srcb * n + srcb
+                if vs_srcb - 1 >= 0 and (vs_srcb - 1) % n == s:
+                    ab_c[t0, s], ab_m[t0, s] = (vs_srcb - 1) // n, m_srcb
+
+    def act_write(vs, m):
+        return f_tick[vs][m] if vs == 0 else f_tick[vs - 1][m] + 1
+
+    n_buf, g_depth = 1, 1
+    for vs in range(VS):
+        for m in range(M):
+            live = sum(
+                1 for m2 in range(M)
+                if act_write(vs, m2) <= b_tick[vs][m] and b_tick[vs][m2] >= b_tick[vs][m]
+            )
+            n_buf = max(n_buf, live)
+    for vs in range(VS - 1):
+        for m in range(M):
+            live = sum(
+                1 for m2 in range(M)
+                if b_tick[vs + 1][m2] + 1 <= b_tick[vs][m]
+                and b_tick[vs][m2] >= b_tick[vs][m]
+            )
+            g_depth = max(g_depth, live)
+
+    # Explicit raises (not assert — must survive python -O): the advertised trace-time
+    # proof that the modular buffer slots never collide while live.
+    for vs in range(VS):
+        for m in range(M):
+            w, f = act_write(vs, m), b_tick[vs][m]
+            if not 0 <= w <= f:
+                raise AssertionError(f"interleaved act: bad window vs={vs} m={m}")
+            for m2 in range(M):
+                if m2 != m and m2 % n_buf == m % n_buf:
+                    w2 = act_write(vs, m2)
+                    if w < w2 <= f:
+                        raise AssertionError(
+                            f"interleaved act: slot collision vs={vs} {m}<-{m2}"
+                        )
+    for vs in range(VS - 1):
+        for m in range(M):
+            w, f = b_tick[vs + 1][m] + 1, b_tick[vs][m]
+            if not 0 <= w <= f:
+                raise AssertionError(f"interleaved grad: bad window vs={vs} m={m}")
+            for m2 in range(M):
+                if m2 != m and m2 % g_depth == m % g_depth:
+                    w2 = b_tick[vs + 1][m2] + 1
+                    if w < w2 <= f:
+                        raise AssertionError(
+                            f"interleaved grad: slot collision vs={vs} {m}<-{m2}"
+                        )
+    return _InterleavedSchedule(
+        f_c, f_m, b_c, b_m, af_c, af_m, ab_c, ab_m, n_buf, g_depth
+    )
+
+
 def _mb_index(tree, i):
     return jax.tree_util.tree_map(lambda a: lax.dynamic_index_in_dim(a, i, 0, False), tree)
 
@@ -543,6 +706,261 @@ def _pipeline_1f1b_bwd_kernel(
     return dp_out, dx_out, ds_out
 
 
+def _interleaved_fwd_kernel(
+    stage_fn, sched: _InterleavedSchedule, axis_name, v: int, stage_params, x_mb
+):
+    """Forward-only interleaved pipeline (the primal of the interleaved loss): per tick
+    every device forwards one (chunk, mb) per the static tables; activations ride ONE
+    circular ppermute (device n-1 chunk c wraps to device 0 chunk c+1)."""
+    idx = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+    M = x_mb.shape[0]
+    p_local = jax.tree_util.tree_map(lambda a: a[:, 0], stage_params)  # [v, ...]
+    perm = [(i, (i + 1) % n) for i in range(n)]  # circular: wraps chunk boundaries
+
+    mb_shape = x_mb.shape[1:]
+    in_buf0 = jnp.zeros((v, sched.n_buf, *mb_shape), x_mb.dtype)
+    out_buf0 = jnp.zeros_like(x_mb)
+
+    def tick(carry, rows):
+        recv, in_buf, out_buf = carry
+        fc_r, fm_r, afc_r, afm_r = rows
+        fc, fm = fc_r[idx], fm_r[idx]
+        afc, afm = afc_r[idx], afm_r[idx]
+
+        # 1) Bank the arrival from last tick's circular send.
+        afc_c = jnp.clip(afc, 0, v - 1)
+        afm_c = jnp.clip(afm, 0, M - 1)
+        in_buf = jnp.where(
+            afm >= 0, in_buf.at[afc_c, afm_c % sched.n_buf].set(recv), in_buf
+        )
+        # 2) Forward one (chunk, mb): global stage 0 (device 0, chunk 0) ingests.
+        fc_c = jnp.clip(fc, 0, v - 1)
+        fm_c = jnp.clip(fm, 0, M - 1)
+        is_vs0 = jnp.logical_and(idx == 0, fc_c == 0)
+        x_in = jnp.where(
+            is_vs0,
+            lax.dynamic_index_in_dim(x_mb, fm_c, 0, False),
+            in_buf[fc_c, fm_c % sched.n_buf],
+        )
+        p_f = jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, fc_c, 0, False), p_local
+        )
+        y = stage_fn(p_f, x_in)
+        # 3) The LAST virtual stage (device n-1, chunk v-1) banks its output.
+        bank = jnp.logical_and(
+            fm >= 0, jnp.logical_and(idx == n - 1, fc_c == v - 1)
+        )
+        out_buf = jnp.where(
+            bank, lax.dynamic_update_index_in_dim(out_buf, y, fm_c, 0), out_buf
+        )
+        recv = lax.ppermute(y, axis_name, perm)
+        return (recv, in_buf, out_buf), None
+
+    rows = (
+        jnp.asarray(sched.f_c), jnp.asarray(sched.f_m),
+        jnp.asarray(sched.af_c), jnp.asarray(sched.af_m),
+    )
+    carry0 = (jnp.zeros(mb_shape, x_mb.dtype), in_buf0, out_buf0)
+    (_, _, out_buf), _ = lax.scan(tick, carry0, rows)
+    return lax.psum(
+        jnp.where(idx == n - 1, out_buf, jnp.zeros_like(out_buf)), axis_name
+    )
+
+
+def _pipeline_interleaved_bwd_kernel(
+    stage_fn, sched: _InterleavedSchedule, axis_name, v: int,
+    stage_params, x_mb, dy_mb,
+):
+    """Combined fwd+bwd interleaved-1F1B replay (virtual-pipeline analog of
+    ``_pipeline_1f1b_bwd_kernel``): per tick one chunk forward and one chunk backward
+    per the static tables, chunk params dynamically indexed from the [v, ...] stack,
+    per-(chunk, slot) circular activation/grad buffers, circular ppermutes in both
+    directions. Same uniform-program discipline: no conditionals around compute."""
+    idx = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+    M = x_mb.shape[0]
+    VS = n * v
+    p_local = jax.tree_util.tree_map(lambda a: a[:, 0], stage_params)  # [v, ...]
+    perm_f = [(i, (i + 1) % n) for i in range(n)]
+    perm_b = [((i + 1) % n, i) for i in range(n)]
+
+    mb_shape = x_mb.shape[1:]
+    in_buf0 = jnp.zeros((v, sched.n_buf, *mb_shape), x_mb.dtype)
+    g_buf0 = jnp.zeros((v, sched.g_buf, *mb_shape), jnp.float32)
+    dx_buf0 = jnp.zeros_like(x_mb, jnp.float32)
+    dp0 = _zeros_f32(p_local)
+
+    def chunk_params(c):
+        return jax.tree_util.tree_map(
+            lambda a: lax.dynamic_index_in_dim(a, c, 0, False), p_local
+        )
+
+    def stage_vjp(c, x_b, dy):
+        p = chunk_params(c)
+
+        def f(p, x):
+            return jnp.sum(stage_fn(p, x).astype(jnp.float32) * dy)
+
+        dp, dx = jax.grad(f, argnums=(0, 1))(p, x_b)
+        return dp, dx.astype(jnp.float32)
+
+    def tick(carry, rows):
+        recv_f, recv_b, in_buf, g_buf, dx_buf, dp_acc = carry
+        fc_r, fm_r, bc_r, bm_r, afc_r, afm_r, abc_r, abm_r = rows
+        fc, fm = fc_r[idx], fm_r[idx]
+        bc, bm = bc_r[idx], bm_r[idx]
+        afc, afm = afc_r[idx], afm_r[idx]
+        abc, abm = abc_r[idx], abm_r[idx]
+
+        # 1) Bank arrivals (masked).
+        afc_c, afm_c = jnp.clip(afc, 0, v - 1), jnp.clip(afm, 0, M - 1)
+        in_buf = jnp.where(
+            afm >= 0, in_buf.at[afc_c, afm_c % sched.n_buf].set(recv_f), in_buf
+        )
+        abc_c, abm_c = jnp.clip(abc, 0, v - 1), jnp.clip(abm, 0, M - 1)
+        g_buf = jnp.where(
+            abm >= 0, g_buf.at[abc_c, abm_c % sched.g_buf].set(recv_b), g_buf
+        )
+
+        # 2) Forward one (chunk, mb); global stage 0 ingests AND stores its input.
+        fc_c, fm_c = jnp.clip(fc, 0, v - 1), jnp.clip(fm, 0, M - 1)
+        is_vs0 = jnp.logical_and(idx == 0, fc_c == 0)
+        x_in = jnp.where(
+            is_vs0,
+            lax.dynamic_index_in_dim(x_mb, fm_c, 0, False),
+            in_buf[fc_c, fm_c % sched.n_buf],
+        )
+        in_buf = jnp.where(
+            jnp.logical_and(fm >= 0, is_vs0),
+            in_buf.at[fc_c, fm_c % sched.n_buf].set(x_in),
+            in_buf,
+        )
+        y = stage_fn(chunk_params(fc_c), x_in)
+
+        # 3) Backward one (chunk, mb) with remat; last virtual stage reads the head's
+        # precomputed cotangent table, everything else the grad chain.
+        bc_c, bm_c = jnp.clip(bc, 0, v - 1), jnp.clip(bm, 0, M - 1)
+        x_b = in_buf[bc_c, bm_c % sched.n_buf]
+        vs_b = bc_c * n + idx
+        dy = jnp.where(
+            vs_b == VS - 1,
+            lax.dynamic_index_in_dim(dy_mb, bm_c, 0, False),
+            g_buf[bc_c, bm_c % sched.g_buf],
+        )
+        dp, dx = stage_vjp(bc_c, x_b, dy)
+        live = bm >= 0
+        # Scatter-add dp into the chunk slot (masked).
+        dp_acc = jax.tree_util.tree_map(
+            lambda acc, d: jnp.where(
+                live,
+                acc.at[bc_c].set(lax.dynamic_index_in_dim(acc, bc_c, 0, False) + d),
+                acc,
+            ),
+            dp_acc, dp,
+        )
+        dx_buf = jnp.where(
+            jnp.logical_and(live, jnp.logical_and(idx == 0, bc_c == 0)),
+            lax.dynamic_update_index_in_dim(dx_buf, dx, bm_c, 0),
+            dx_buf,
+        )
+
+        # 4) Circular sends, unconditional.
+        recv_f = lax.ppermute(y, axis_name, perm_f)
+        recv_b = lax.ppermute(dx, axis_name, perm_b)
+        return (recv_f, recv_b, in_buf, g_buf, dx_buf, dp_acc), None
+
+    rows = tuple(
+        jnp.asarray(a)
+        for a in (sched.f_c, sched.f_m, sched.b_c, sched.b_m,
+                  sched.af_c, sched.af_m, sched.ab_c, sched.ab_m)
+    )
+    carry0 = (
+        jnp.zeros(mb_shape, x_mb.dtype), jnp.zeros(mb_shape, jnp.float32),
+        in_buf0, g_buf0, dx_buf0, dp0,
+    )
+    (_, _, _, _, dx_buf, dp_acc), _ = lax.scan(tick, carry0, rows)
+    dp_out = jax.tree_util.tree_map(lambda a: a[:, None], dp_acc)  # re-add the pp dim
+    dx_out = lax.psum(jnp.where(idx == 0, dx_buf, jnp.zeros_like(dx_buf)), axis_name)
+    return dp_out, dx_out
+
+
+def _make_interleaved_loss_fn(mesh, stage_fn, head_loss_fn, axis_name, M, v):
+    """Interleaved-1F1B loss: ``loss(stage_params, head_params, x, extras)`` with
+    stage params chunk-stacked ``[v, n, L/(n·v), ...]`` (dim 1 over pp — device s hosts
+    the STRIDED virtual stages {s, n+s, ...}). The primal runs the forward-only
+    interleaved kernel; the custom backward replays fwd+bwd under the static
+    interleaved tables. The (n-1)-tick bubble amortizes ≈ v× (each device fills idle
+    ticks with its other chunks), at the cost of (v-1) extra circular-ppermute hops
+    per microbatch — the Megatron virtual-pipeline tradeoff."""
+    n_stages = mesh.shape[axis_name]
+    sched = _simulate_interleaved(n_stages, v, M)
+
+    def specs_of(stage_params):
+        return jax.tree_util.tree_map(lambda _: P(None, axis_name), stage_params)
+
+    def fwd_pipe(stage_params, x):
+        B = x.shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        x_mb = x.reshape(M, B // M, *x.shape[1:])
+        mapped = jax.shard_map(
+            functools.partial(_interleaved_fwd_kernel, stage_fn, sched, axis_name, v),
+            mesh=mesh,
+            in_specs=(specs_of(stage_params), P()),
+            out_specs=P(),
+            axis_names={axis_name},
+            check_vma=False,
+        )
+        out = mapped(stage_params, x_mb)
+        return out.reshape(B, *out.shape[2:])
+
+    @jax.custom_vjp
+    def loss(stage_params, head_params, x, extras):
+        return head_loss_fn(head_params, fwd_pipe(stage_params, x), extras)
+
+    def loss_fwd(stage_params, head_params, x, extras):
+        y = fwd_pipe(stage_params, x)
+        return head_loss_fn(head_params, y, extras), (
+            stage_params, head_params, x, extras, y,
+        )
+
+    def loss_bwd(res, ct):
+        stage_params, head_params, x, extras, y = res
+        B = x.shape[0]
+        (dh, dy, d_extras) = jax.vjp(
+            head_loss_fn, head_params, y, extras
+        )[1](jnp.asarray(ct, jnp.float32))
+        dy_mb = dy.astype(jnp.float32).reshape(M, B // M, *y.shape[1:])
+        x_mb = x.reshape(M, B // M, *x.shape[1:])
+        mapped = jax.shard_map(
+            functools.partial(
+                _pipeline_interleaved_bwd_kernel, stage_fn, sched, axis_name, v
+            ),
+            mesh=mesh,
+            in_specs=(specs_of(stage_params), P(), P()),
+            out_specs=(specs_of(stage_params), P()),
+            axis_names={axis_name},
+            check_vma=False,
+        )
+        dp, dx_mb = mapped(stage_params, x_mb, dy_mb)
+        dp = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), dp, stage_params)
+        dh = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), dh, head_params)
+        dx = dx_mb.reshape(B, *x.shape[1:]).astype(x.dtype)
+        return dp, dh, dx, d_extras
+
+    loss.defvjp(loss_fwd, loss_bwd)
+
+    def loss_no_side(stage_params, head_params, x, extras, side=None):
+        if side is not None and jax.tree_util.tree_leaves(side):
+            raise NotImplementedError(
+                "side inputs are not supported with virtual_stages > 1 yet"
+            )
+        return loss(stage_params, head_params, x, extras)
+
+    return loss_no_side
+
+
 def make_pipeline_loss_fn(
     mesh,
     stage_fn: Callable[[Any, jax.Array], Any],
@@ -554,6 +972,7 @@ def make_pipeline_loss_fn(
     aux_weight: float = 0.0,
     act_spec: Optional[P] = None,
     extra_manual_axes: tuple = (),
+    virtual_stages: int = 1,
 ):
     """Build ``loss(stage_params, head_params, x [B, ...], extras) -> scalar`` with a
     hand-scheduled 1F1B backward (``schedule="1f1b"``) or AD-GPipe (``"gpipe"``).
@@ -597,6 +1016,19 @@ def make_pipeline_loss_fn(
     M = num_microbatches if num_microbatches is not None else n_stages
     x_spec = act_spec if act_spec is not None else P()
     manual = {axis_name, *extra_manual_axes}
+
+    if virtual_stages > 1:
+        # Interleaved/virtual pipeline (Megatron virtual_pipeline analog, reference
+        # dataclasses.py:2024): stage params in the [v, n_stages, L/(n·v), ...] layout
+        # of ``split_params_into_stages(..., virtual_stages=v)``.
+        if schedule != "1f1b" or with_aux or extra_manual_axes:
+            raise NotImplementedError(
+                "virtual_stages > 1 requires schedule='1f1b' and composes with "
+                "neither MoE aux nor extra_manual_axes (sp) yet"
+            )
+        return _make_interleaved_loss_fn(
+            mesh, stage_fn, head_loss_fn, axis_name, M, virtual_stages
+        )
 
     pipe = make_pipeline_fn(
         mesh, stage_fn, axis_name, M, with_aux=with_aux,

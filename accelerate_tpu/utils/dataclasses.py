@@ -462,12 +462,23 @@ class PipelineParallelPlugin(KwargsHandler):
     pp_size: int = 1
     num_microbatches: Optional[int] = None  # None → n_stages (min for a full pipe)
     schedule: str = "gpipe"
+    # Interleaved virtual-pipeline chunks per device (Megatron virtual_pipeline analog,
+    # reference dataclasses.py:2024): >1 requires schedule="1f1b"; device s hosts the
+    # strided virtual stages {s, n+s, ...} and the bubble amortizes ~v x.
+    virtual_stages: int = 1
 
     def __post_init__(self):
         if self.schedule not in ("gpipe", "1f1b"):
             raise ValueError(
                 f"schedule={self.schedule!r} is not supported: expected 'gpipe' or '1f1b' "
-                "(parallel/pp.py; interleaved virtual-pipeline stages are not implemented)"
+                "(parallel/pp.py)"
+            )
+        if self.virtual_stages < 1:
+            raise ValueError(f"virtual_stages={self.virtual_stages} must be >= 1")
+        if self.virtual_stages > 1 and self.schedule != "1f1b":
+            raise ValueError(
+                "virtual_stages > 1 (interleaved virtual pipeline) requires "
+                "schedule='1f1b' (parallel/pp.py _simulate_interleaved)"
             )
 
 
@@ -523,6 +534,9 @@ class MegatronLMPlugin(KwargsHandler):
     # reference's virtual-pipeline/1F1B intent (``dataclasses.py:2024``); validated by
     # the expanded PipelineParallelPlugin.
     pp_schedule: str = "gpipe"
+    # Interleaved virtual-pipeline chunks per device (reference virtual_pipeline,
+    # ``dataclasses.py:2024``); >1 requires pp_schedule="1f1b".
+    virtual_pipeline_stages: int = 1
     gradient_clipping: Optional[float] = 1.0
     use_distributed_optimizer: bool = True  # == ZeRO-1 on the data axis
 
