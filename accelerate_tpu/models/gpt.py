@@ -155,12 +155,13 @@ def init_params(cfg: GPTConfig, key: Optional[jax.Array] = None) -> dict:
     return params
 
 
-def partition_specs(cfg: GPTConfig, pp: bool = False) -> dict:
+def partition_specs(cfg: GPTConfig, pp: bool = False, virtual_stages: int = 1) -> dict:
     """Megatron layout: qkv/up column-parallel, o/down row-parallel, vocab over (tp, fsdp).
 
     ``pp=True``: layer specs gain the stage-stacked leading dims sharded over ``pp``
     (``parallel.pp.split_params_into_stages`` layout) and embed/head fold the pipeline
-    axis into the vocab sharding — same design as ``llama.partition_specs(pp=True)``."""
+    axis into the vocab sharding — same design as ``llama.partition_specs(pp=True)``.
+    ``virtual_stages=v > 1``: the interleaved [v, n, L/(n·v), ...] layout (pp on dim 1)."""
     ln = {"scale": P(), "bias": P()}
     layer = {
         "ln_attn": dict(ln),
@@ -179,8 +180,11 @@ def partition_specs(cfg: GPTConfig, pp: bool = False) -> dict:
     if pp:
         if not cfg.scan_layers:
             raise ValueError("pipeline parallelism requires cfg.scan_layers=True")
+        prefix = (
+            (None, PIPELINE_AXIS, None) if virtual_stages > 1 else (PIPELINE_AXIS, None)
+        )
         layer = jax.tree_util.tree_map(
-            lambda spec: P(PIPELINE_AXIS, None, *spec),
+            lambda spec: P(*prefix, *spec),
             layer,
             is_leaf=lambda s: isinstance(s, P),
         )
@@ -505,15 +509,22 @@ def loss_fn_pp(
     num_microbatches: Optional[int] = None,
     rng=None,
     schedule: str = "gpipe",
+    virtual_stages: int = 1,
 ) -> jax.Array:
     """Pipeline-parallel next-token CE for the gpt family (same contract as
-    ``llama.loss_fn_pp``). Every ``loss_impl`` works — ln_f + the CE head run OUTSIDE
+    ``llama.loss_fn_pp``, including ``virtual_stages`` — the interleaved virtual
+    pipeline, 1f1b only). Every ``loss_impl`` works — ln_f + the CE head run OUTSIDE
     the pipeline (1F1B) or after it (GPipe) on the full batch, ordinary GSPMD, so the
     fused kernel variants dispatch exactly as on the non-pipelined path. Sample packing
     (``segment_ids``) rides the pipeline as per-microbatch side constants, exactly like
     ``llama.loss_fn_pp``."""
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"schedule={schedule!r}: expected 'gpipe' or '1f1b'")
+    if virtual_stages > 1 and (schedule != "1f1b" or "segment_ids" in batch):
+        raise NotImplementedError(
+            "virtual_stages > 1 requires schedule='1f1b' and does not compose with "
+            "sample packing yet (parallel/pp.py)"
+        )
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     B, S = inputs.shape
@@ -549,6 +560,7 @@ def loss_fn_pp(
             mesh, _pp_stage_fn(cfg, S, packed=side is not None),
             lambda h, y, ex: _head_ce_sum_gpt(h, y, ex, cfg),
             num_microbatches=num_microbatches, schedule="1f1b",
+            virtual_stages=virtual_stages,
         )
         x = _embed(params, inputs, positions, cfg)
         total = pipe_loss(

@@ -1090,12 +1090,10 @@ def loss_fn_pp(
             # the pipeline's fails to lower on the backward (MLIR verification), so the
             # PIPELINE makes sp manual instead — activations ride sequence-sliced, the
             # stage body issues the ring/ulysses collectives directly (flat shard_map,
-            # no nesting; see parallel/pp.py extra_manual_axes).
-            if cfg.moe_experts > 0:
-                raise NotImplementedError(
-                    "sp-attention x pp with MoE is not supported: the per-(stage, "
-                    "microbatch) aux psums assume sp-replicated stage bodies"
-                )
+            # no nesting; see parallel/pp.py extra_manual_axes). MoE composes too: each
+            # sp member routes/dispatches its OWN sequence slice (per-slice capacity —
+            # exact parity in the no-drop regime, the standard MoE-under-resharding
+            # caveat) and the aux statistic is psum-meaned over sp.
             sp_pipeline = True
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]

@@ -90,6 +90,7 @@ def pipeline_apply(
     side_mb: Any = None,
     axis_name: str = PIPELINE_AXIS,
     with_aux: bool = False,
+    aux_extra_axes: tuple = (),
 ):
     """Run the GPipe schedule inside shard_map.
 
@@ -108,7 +109,10 @@ def pipeline_apply(
     Returns [M, B_m, ...] outputs (replicated across pp after a masked psum). Aux values
     from bubble ticks (a stage computing on garbage before its first / after its last real
     microbatch) are masked out before the cross-stage psum, so ``aux_total`` sums exactly
-    the M · n_stages real (microbatch, stage) pairs.
+    the M · n_stages real (microbatch, stage) pairs. With ``aux_extra_axes`` (the sp×pp
+    composition: sp is manual and each member computes the aux statistic on its OWN
+    sequence slice), that sum is additionally psum-MEANED over the extra axes — one
+    batch-level statistic, still M · n_stages pairs in scale, never sp× larger.
     """
     idx = lax.axis_index(axis_name)
     n = lax.axis_size(axis_name)
@@ -158,7 +162,16 @@ def pipeline_apply(
     # Replicate the last stage's banked outputs to every stage.
     out = lax.psum(jnp.where(idx == n - 1, out_buf, jnp.zeros_like(out_buf)), axis_name)
     if with_aux:
-        return out, lax.psum(aux_acc, axis_name)
+        aux = lax.psum(aux_acc, axis_name)
+        if aux_extra_axes:
+            # Under extra manual axes (sp) each member computed the aux statistic on
+            # its OWN sequence slice — the batch-level stat is their MEAN (equal-size
+            # slices), so psum then divide by the member count.
+            size = 1
+            for a in aux_extra_axes:
+                size *= lax.axis_size(a)
+            aux = lax.psum(aux, tuple(aux_extra_axes)) / size
+        return out, aux
     return out
 
 
@@ -190,11 +203,6 @@ def make_pipeline_fn(
     n_stages = mesh.shape[axis_name]
     if num_microbatches is None:
         num_microbatches = n_stages
-    if extra_manual_axes and with_aux:
-        raise NotImplementedError(
-            "with_aux under extra_manual_axes is not plumbed (MoE aux psums assume "
-            "sp-replicated stage bodies)"
-        )
     x_spec = act_spec if act_spec is not None else P()
     manual = {axis_name, *extra_manual_axes}
 
@@ -223,7 +231,8 @@ def make_pipeline_fn(
             args.append(side_mb)
         mapped = jax.shard_map(
             functools.partial(
-                pipeline_apply, stage_fn, axis_name=axis_name, with_aux=with_aux
+                pipeline_apply, stage_fn, axis_name=axis_name, with_aux=with_aux,
+                aux_extra_axes=tuple(extra_manual_axes),
             ),
             mesh=mesh,
             in_specs=tuple(in_specs),
@@ -1087,7 +1096,14 @@ def make_pipeline_loss_fn(
             _pipeline_1f1b_bwd_kernel, stage_fn, sched, axis_name, with_aux,
             extra_manual_axes=tuple(extra_manual_axes),
         )
-        aux_ct = jnp.asarray(ct, jnp.float32) * aux_weight
+        # Under extra manual axes (sp), the primal's aux is the MEAN over members
+        # (pipeline_apply aux_extra_axes) while the replay's dp psum over sp SUMS the
+        # per-member aux contributions — scale the cotangent down by the member count
+        # so the two compose to the same gradient.
+        extra_size = 1
+        for a in extra_manual_axes:
+            extra_size *= mesh.shape[a]
+        aux_ct = jnp.asarray(ct, jnp.float32) * aux_weight / extra_size
         in_specs = [specs_params, x_spec, x_spec, P()]
         args = [stage_params, x_mb, dy_mb, aux_ct]
         if side:
