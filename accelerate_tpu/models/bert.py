@@ -105,12 +105,13 @@ def init_params(cfg: BertConfig, key: Optional[jax.Array] = None) -> dict:
     }
 
 
-def partition_specs(cfg: BertConfig, pp: bool = False) -> dict:
+def partition_specs(cfg: BertConfig, pp: bool = False, virtual_stages: int = 1) -> dict:
     """Megatron TP layout: QKV/in column-parallel, O/out row-parallel.
 
     ``pp=True``: specs for the :func:`stack_pp_params` layout — blocks stage-stacked
     ``[n_stages, L/n, ...]`` with the stage dim over ``pp``; embed/pooler/classifier
-    stay outside the pipeline (replicated over pp — they are tiny next to the stack)."""
+    stay outside the pipeline (replicated over pp — they are tiny next to the stack).
+    ``virtual_stages=v > 1``: the interleaved [v, n, L/(n·v), ...] layout (pp dim 1)."""
     col, row = P(None, TENSOR_AXIS), P(TENSOR_AXIS, None)
     ln = {"gamma": P(), "beta": P()}
     layer = {
@@ -123,8 +124,11 @@ def partition_specs(cfg: BertConfig, pp: bool = False) -> dict:
     if pp:
         from ..utils.constants import PIPELINE_AXIS
 
+        prefix = (
+            (None, PIPELINE_AXIS, None) if virtual_stages > 1 else (PIPELINE_AXIS, None)
+        )
         layers = jax.tree_util.tree_map(
-            lambda s: P(PIPELINE_AXIS, None, *s), layer,
+            lambda s: P(*prefix, *s), layer,
             is_leaf=lambda s: isinstance(s, P),
         )
     else:
@@ -202,20 +206,26 @@ def loss_fn(params: dict, batch: dict, cfg: BertConfig) -> jax.Array:
 
 
 # --------------------------------------------------------------- pipeline-parallel training
-def stack_pp_params(params: dict, cfg: BertConfig, n_stages: int) -> dict:
+def stack_pp_params(
+    params: dict, cfg: BertConfig, n_stages: int, virtual_stages: int = 1
+) -> dict:
     """Canonical params → pipeline layout: the (homogeneous) block list stacks to
-    ``[n_stages, L/n, ...]``; embed/pooler/classifier pass through unchanged (they run
-    outside the pipeline). Specs: ``partition_specs(cfg, pp=True)``. Reference bar: the
-    Megatron engine drives Bert through pp (``megatron_lm.py:446``)."""
-    if cfg.n_layers % n_stages:
+    ``[n_stages, L/n, ...]`` (``[v, n, L/(n·v), ...]`` with ``virtual_stages``);
+    embed/pooler/classifier pass through unchanged (they run outside the pipeline).
+    Specs: ``partition_specs(cfg, pp=True)``. Reference bar: the Megatron engine
+    drives Bert through pp (``megatron_lm.py:446``)."""
+    if cfg.n_layers % (n_stages * virtual_stages):
         raise ValueError(
-            f"n_layers={cfg.n_layers} must be divisible by n_stages={n_stages}"
+            f"n_layers={cfg.n_layers} must be divisible by n_stages={n_stages} x "
+            f"virtual_stages={virtual_stages}"
         )
     from ..parallel.pp import split_params_into_stages
 
     stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params["layers"])
     return {**{k: v for k, v in params.items() if k != "layers"},
-            "layers": split_params_into_stages(stacked, n_stages)}
+            "layers": split_params_into_stages(
+                stacked, n_stages, virtual_stages=virtual_stages
+            )}
 
 
 def _pp_stage_fn(cfg: BertConfig):
@@ -288,12 +298,18 @@ def loss_fn_pp(
     num_microbatches: Optional[int] = None,
     rng=None,
     schedule: str = "gpipe",
+    virtual_stages: int = 1,
 ) -> jax.Array:
     """Pipeline-parallel classification CE (same batch contract as ``loss_fn``; params
     in :func:`stack_pp_params` layout; both schedules — the pooler/classifier head runs
-    OUTSIDE the pipeline on the full batch)."""
+    OUTSIDE the pipeline on the full batch; ``virtual_stages`` with 1f1b = the
+    interleaved pipeline, the attention mask riding as an int side constant)."""
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"schedule={schedule!r}: expected 'gpipe' or '1f1b'")
+    if virtual_stages > 1 and schedule != "1f1b":
+        raise NotImplementedError(
+            "virtual_stages > 1 requires schedule='1f1b' (parallel/pp.py)"
+        )
     labels = batch["labels"]
     if schedule == "1f1b":
         from ..parallel.pp import make_pipeline_loss_fn
@@ -313,6 +329,7 @@ def loss_fn_pp(
         pipe_loss = make_pipeline_loss_fn(
             mesh, _pp_stage_fn(cfg), head_loss,
             num_microbatches=num_microbatches, schedule="1f1b",
+            virtual_stages=virtual_stages,
         )
         x = _maybe_shard(x)
         return pipe_loss(

@@ -520,10 +520,9 @@ def loss_fn_pp(
     ``llama.loss_fn_pp``."""
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"schedule={schedule!r}: expected 'gpipe' or '1f1b'")
-    if virtual_stages > 1 and (schedule != "1f1b" or "segment_ids" in batch):
+    if virtual_stages > 1 and schedule != "1f1b":
         raise NotImplementedError(
-            "virtual_stages > 1 requires schedule='1f1b' and does not compose with "
-            "sample packing yet (parallel/pp.py)"
+            "virtual_stages > 1 requires schedule='1f1b' (parallel/pp.py)"
         )
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]

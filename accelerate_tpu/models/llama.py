@@ -1119,10 +1119,11 @@ def loss_fn_pp(
         )
         seg_in = None
         side = None
-    if virtual_stages > 1 and (schedule != "1f1b" or side is not None or sp_pipeline):
+    if virtual_stages > 1 and (schedule != "1f1b" or sp_pipeline):
         raise NotImplementedError(
-            "virtual_stages > 1 requires schedule='1f1b' and composes with neither "
-            "sample packing nor sp-attention-in-pp yet (parallel/pp.py)"
+            "virtual_stages > 1 requires schedule='1f1b' and does not compose with "
+            "sp-attention-in-pp yet (parallel/pp.py; sample packing DOES compose — "
+            "segment ids ride as int side constants)"
         )
     if schedule == "1f1b" or sp_pipeline:
         from ..parallel.pp import make_pipeline_loss_fn

@@ -716,11 +716,14 @@ def _pipeline_1f1b_bwd_kernel(
 
 
 def _interleaved_fwd_kernel(
-    stage_fn, sched: _InterleavedSchedule, axis_name, v: int, stage_params, x_mb
+    stage_fn, sched: _InterleavedSchedule, axis_name, v: int, stage_params, x_mb,
+    side_mb=None,
 ):
     """Forward-only interleaved pipeline (the primal of the interleaved loss): per tick
     every device forwards one (chunk, mb) per the static tables; activations ride ONE
-    circular ppermute (device n-1 chunk c wraps to device 0 chunk c+1)."""
+    circular ppermute (device n-1 chunk c wraps to device 0 chunk c+1). ``side_mb``:
+    per-microbatch INT/BOOL constants (masks, segment ids) indexed by microbatch id —
+    float side leaves are rejected upstream (no cotangent accumulation here)."""
     idx = lax.axis_index(axis_name)
     n = lax.axis_size(axis_name)
     M = x_mb.shape[0]
@@ -730,6 +733,11 @@ def _interleaved_fwd_kernel(
     mb_shape = x_mb.shape[1:]
     in_buf0 = jnp.zeros((v, sched.n_buf, *mb_shape), x_mb.dtype)
     out_buf0 = jnp.zeros_like(x_mb)
+
+    def run(p, x, mb_id):
+        if side_mb is None:
+            return stage_fn(p, x)
+        return stage_fn(p, x, _mb_index(side_mb, mb_id))
 
     def tick(carry, rows):
         recv, in_buf, out_buf = carry
@@ -755,7 +763,7 @@ def _interleaved_fwd_kernel(
         p_f = jax.tree_util.tree_map(
             lambda a: lax.dynamic_index_in_dim(a, fc_c, 0, False), p_local
         )
-        y = stage_fn(p_f, x_in)
+        y = run(p_f, x_in, fm_c)
         # 3) The LAST virtual stage (device n-1, chunk v-1) banks its output.
         bank = jnp.logical_and(
             fm >= 0, jnp.logical_and(idx == n - 1, fc_c == v - 1)
@@ -779,7 +787,7 @@ def _interleaved_fwd_kernel(
 
 def _pipeline_interleaved_bwd_kernel(
     stage_fn, sched: _InterleavedSchedule, axis_name, v: int,
-    stage_params, x_mb, dy_mb,
+    stage_params, x_mb, dy_mb, side_mb=None,
 ):
     """Combined fwd+bwd interleaved-1F1B replay (virtual-pipeline analog of
     ``_pipeline_1f1b_bwd_kernel``): per tick one chunk forward and one chunk backward
@@ -805,11 +813,16 @@ def _pipeline_interleaved_bwd_kernel(
             lambda a: lax.dynamic_index_in_dim(a, c, 0, False), p_local
         )
 
-    def stage_vjp(c, x_b, dy):
+    def run(p, x, mb_id):
+        if side_mb is None:
+            return stage_fn(p, x)
+        return stage_fn(p, x, _mb_index(side_mb, mb_id))
+
+    def stage_vjp(c, x_b, dy, mb_id):
         p = chunk_params(c)
 
         def f(p, x):
-            return jnp.sum(stage_fn(p, x).astype(jnp.float32) * dy)
+            return jnp.sum(run(p, x, mb_id).astype(jnp.float32) * dy)
 
         dp, dx = jax.grad(f, argnums=(0, 1))(p, x_b)
         return dp, dx.astype(jnp.float32)
@@ -845,7 +858,7 @@ def _pipeline_interleaved_bwd_kernel(
             in_buf.at[fc_c, fm_c % sched.n_buf].set(x_in),
             in_buf,
         )
-        y = stage_fn(chunk_params(fc_c), x_in)
+        y = run(chunk_params(fc_c), x_in, fm_c)
 
         # 3) Backward one (chunk, mb) with remat; last virtual stage reads the head's
         # precomputed cotangent table, everything else the grad chain.
@@ -857,7 +870,7 @@ def _pipeline_interleaved_bwd_kernel(
             lax.dynamic_index_in_dim(dy_mb, bm_c, 0, False),
             g_buf[bc_c, bm_c % sched.g_buf],
         )
-        dp, dx = stage_vjp(bc_c, x_b, dy)
+        dp, dx = stage_vjp(bc_c, x_b, dy, bm_c)
         live = bm >= 0
         # Scatter-add dp into the chunk slot (masked).
         dp_acc = jax.tree_util.tree_map(
@@ -908,66 +921,92 @@ def _make_interleaved_loss_fn(mesh, stage_fn, head_loss_fn, axis_name, M, v):
     def specs_of(stage_params):
         return jax.tree_util.tree_map(lambda _: P(None, axis_name), stage_params)
 
-    def fwd_pipe(stage_params, x):
+    def _side_mb(side, B):
+        return jax.tree_util.tree_map(
+            lambda a: a.reshape(M, B // M, *a.shape[1:]), side
+        )
+
+    def fwd_pipe(stage_params, x, side):
         B = x.shape[0]
         if B % M:
             raise ValueError(f"batch {B} not divisible by {M} microbatches")
         x_mb = x.reshape(M, B // M, *x.shape[1:])
+        in_specs = [specs_of(stage_params), P()]
+        args = [stage_params, x_mb]
+        if side:
+            in_specs.append(P())
+            args.append(_side_mb(side, B))
         mapped = jax.shard_map(
             functools.partial(_interleaved_fwd_kernel, stage_fn, sched, axis_name, v),
             mesh=mesh,
-            in_specs=(specs_of(stage_params), P()),
+            in_specs=tuple(in_specs),
             out_specs=P(),
             axis_names={axis_name},
             check_vma=False,
         )
-        out = mapped(stage_params, x_mb)
+        out = mapped(*args)
         return out.reshape(B, *out.shape[2:])
 
     @jax.custom_vjp
-    def loss(stage_params, head_params, x, extras):
-        return head_loss_fn(head_params, fwd_pipe(stage_params, x), extras)
+    def loss(stage_params, head_params, x, extras, side):
+        return head_loss_fn(head_params, fwd_pipe(stage_params, x, side), extras)
 
-    def loss_fwd(stage_params, head_params, x, extras):
-        y = fwd_pipe(stage_params, x)
+    def loss_fwd(stage_params, head_params, x, extras, side):
+        y = fwd_pipe(stage_params, x, side)
         return head_loss_fn(head_params, y, extras), (
-            stage_params, head_params, x, extras, y,
+            stage_params, head_params, x, extras, side, y,
         )
 
     def loss_bwd(res, ct):
-        stage_params, head_params, x, extras, y = res
+        stage_params, head_params, x, extras, side, y = res
         B = x.shape[0]
         (dh, dy, d_extras) = jax.vjp(
             head_loss_fn, head_params, y, extras
         )[1](jnp.asarray(ct, jnp.float32))
         dy_mb = dy.astype(jnp.float32).reshape(M, B // M, *y.shape[1:])
         x_mb = x.reshape(M, B // M, *x.shape[1:])
+        in_specs = [specs_of(stage_params), P(), P()]
+        args = [stage_params, x_mb, dy_mb]
+        if side:
+            in_specs.append(P())
+            args.append(_side_mb(side, B))
         mapped = jax.shard_map(
             functools.partial(
                 _pipeline_interleaved_bwd_kernel, stage_fn, sched, axis_name, v
             ),
             mesh=mesh,
-            in_specs=(specs_of(stage_params), P(), P()),
+            in_specs=tuple(in_specs),
             out_specs=(specs_of(stage_params), P()),
             axis_names={axis_name},
             check_vma=False,
         )
-        dp, dx_mb = mapped(stage_params, x_mb, dy_mb)
+        dp, dx_mb = mapped(*args)
         dp = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), dp, stage_params)
         dh = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), dh, head_params)
         dx = dx_mb.reshape(B, *x.shape[1:]).astype(x.dtype)
-        return dp, dh, dx, d_extras
+        # Int/bool side only on this path (floats rejected below) → float0 cotangents.
+        d_side = jax.tree_util.tree_map(
+            lambda a: np.zeros(a.shape, jax.dtypes.float0), side
+        )
+        return dp, dh, dx, d_extras, d_side
 
     loss.defvjp(loss_fwd, loss_bwd)
 
-    def loss_no_side(stage_params, head_params, x, extras, side=None):
-        if side is not None and jax.tree_util.tree_leaves(side):
+    def loss_with_side(stage_params, head_params, x, extras, side=None):
+        side = {} if side is None else side
+        if any(
+            jnp.issubdtype(a.dtype, jnp.floating)
+            for a in jax.tree_util.tree_leaves(side)
+        ):
+            # Float side leaves need cotangent accumulation (t5's enc_out), which the
+            # interleaved replay does not implement — the non-virtual 1f1b does.
             raise NotImplementedError(
-                "side inputs are not supported with virtual_stages > 1 yet"
+                "FLOAT side inputs are not supported with virtual_stages > 1; int/bool "
+                "side constants (masks, segment ids) are"
             )
-        return loss(stage_params, head_params, x, extras)
+        return loss(stage_params, head_params, x, extras, side)
 
-    return loss_no_side
+    return loss_with_side
 
 
 def make_pipeline_loss_fn(

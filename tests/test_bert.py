@@ -49,6 +49,38 @@ def test_loss_fn_finite_and_mask_load_bearing():
 
 
 @slow
+def test_bert_pp_interleaved_matches_single():
+    """Interleaved virtual pipeline with an int side constant (the attention mask):
+    bert at pp=2 v=2 under 1f1b matches the non-pipelined run."""
+    cfg = dataclasses.replace(CFG, n_layers=4)
+    params = bert.init_params(cfg)
+    rng = np.random.default_rng(1)
+    params["classifier"]["w"] = jnp.asarray(
+        rng.normal(size=(cfg.d_model, cfg.num_labels)) * 0.1, jnp.float32
+    )
+    batch = make_batch()
+    base = float(bert.loss_fn(params, batch, cfg))
+    base_g = jax.grad(lambda p: bert.loss_fn(p, batch, cfg))(params)
+
+    mesh = build_mesh(MeshConfig(dp=4, pp=2))
+    pp_params = bert.stack_pp_params(params, cfg, 2, virtual_stages=2)
+    with jax.set_mesh(mesh):
+        l, g = jax.jit(jax.value_and_grad(
+            lambda p, b: bert.loss_fn_pp(
+                p, b, cfg, mesh, num_microbatches=8, schedule="1f1b",
+                virtual_stages=2)
+        ))(pp_params, batch)
+    np.testing.assert_allclose(float(l), base, rtol=1e-5)
+    expected = bert.stack_pp_params(base_g, cfg, 2, virtual_stages=2)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5
+        ),
+        g, expected,
+    )
+
+
+@slow
 @pytest.mark.parametrize("schedule,M", [("gpipe", 4), ("1f1b", 8)])
 def test_bert_pp_matches_single(schedule, M):
     """Encoder pipeline parity: loss and ALL grads (incl. embed + pooler/classifier
