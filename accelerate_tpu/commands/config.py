@@ -73,8 +73,10 @@ class ClusterConfig:
     fsdp_state_dict_type: str = "SHARDED_STATE_DICT"
     # Sequence parallelism flavor (ring attention / Ulysses all-to-all / allgather).
     sp_mode: str = "ring"
-    # Pipeline microbatching.
+    # Pipeline microbatching / schedule / interleaved virtual stages.
     pp_num_microbatches: Optional[int] = None
+    pp_schedule: Optional[str] = None       # None = gpipe; "1f1b" for the custom-VJP schedule
+    pp_virtual_stages: Optional[int] = None  # >1 = interleaved (requires 1f1b)
     # fp8 recipe (when mixed_precision == fp8).
     fp8_format: str = "HYBRID"
     fp8_margin: int = 0
@@ -250,6 +252,11 @@ def _interactive_config() -> ClusterConfig:
     if cfg.pp > 1:
         mb = ask_int("Pipeline microbatches (0 = one per stage)", 0)
         cfg.pp_num_microbatches = mb or None
+        sched = select("Pipeline schedule?", ["gpipe", "1f1b"])
+        cfg.pp_schedule = sched if sched != "gpipe" else None
+        if sched == "1f1b":
+            v = ask_int("Interleaved virtual stages per device (1 = off)", 1)
+            cfg.pp_virtual_stages = v if v > 1 else None
     cfg.ep = ask_int("Expert-parallel degree (MoE)", 1)
 
     # ---- training loop --------------------------------------------------------

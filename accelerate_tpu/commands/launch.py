@@ -83,6 +83,12 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
                       choices=[None, "ring", "ulysses", "allgather"])
     mesh.add_argument("--pp-num-microbatches", "--pp_num_microbatches", type=int, default=None,
                       help="GPipe microbatch count for the pp axis.")
+    mesh.add_argument("--pp-schedule", "--pp_schedule", default=None,
+                      choices=[None, "gpipe", "1f1b"],
+                      help="Pipeline schedule (ACCELERATE_PP_SCHEDULE).")
+    mesh.add_argument("--pp-virtual-stages", "--pp_virtual_stages", type=int, default=None,
+                      help="Interleaved virtual-pipeline chunks per device "
+                           "(requires --pp-schedule 1f1b; ACCELERATE_PP_VIRTUAL_STAGES).")
 
     fp8 = parser.add_argument_group("FP8 recipe")
     fp8.add_argument("--fp8-format", "--fp8_format", default=None,
@@ -168,6 +174,8 @@ def _apply_config_defaults(args) -> None:
         "fp8_amax_history_len": cfg.fp8_amax_history_len if cfg.fp8_amax_history_len != 16 else None,
         "fp8_use_delayed_scaling": cfg.fp8_use_delayed_scaling or None,
         "pp_num_microbatches": cfg.pp_num_microbatches,
+        "pp_schedule": getattr(cfg, "pp_schedule", None),
+        "pp_virtual_stages": getattr(cfg, "pp_virtual_stages", None),
         "dispatch_batches": cfg.dispatch_batches,
         "even_batches": cfg.even_batches if cfg.even_batches is not True else None,
         "use_seedable_sampler": (
@@ -337,6 +345,8 @@ _FORWARDED = [
     ("fsdp_min_weight_size", "--fsdp-min-weight-size", True),
     ("sp_mode", "--sp-mode", True),
     ("pp_num_microbatches", "--pp-num-microbatches", True),
+    ("pp_schedule", "--pp-schedule", True),
+    ("pp_virtual_stages", "--pp-virtual-stages", True),
     ("fp8_format", "--fp8-format", True),
     ("fp8_margin", "--fp8-margin", True),
     ("fp8_amax_history_len", "--fp8-amax-history-len", True),

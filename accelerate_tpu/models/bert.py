@@ -122,13 +122,10 @@ def partition_specs(cfg: BertConfig, pp: bool = False, virtual_stages: int = 1) 
         "ln2": dict(ln),
     }
     if pp:
-        from ..utils.constants import PIPELINE_AXIS
+        from ..parallel.pp import stage_spec_prefix
 
-        prefix = (
-            (None, PIPELINE_AXIS, None) if virtual_stages > 1 else (PIPELINE_AXIS, None)
-        )
         layers = jax.tree_util.tree_map(
-            lambda s: P(*prefix, *s), layer,
+            lambda s: P(*stage_spec_prefix(virtual_stages), *s), layer,
             is_leaf=lambda s: isinstance(s, P),
         )
     else:

@@ -180,11 +180,10 @@ def partition_specs(cfg: GPTConfig, pp: bool = False, virtual_stages: int = 1) -
     if pp:
         if not cfg.scan_layers:
             raise ValueError("pipeline parallelism requires cfg.scan_layers=True")
-        prefix = (
-            (None, PIPELINE_AXIS, None) if virtual_stages > 1 else (PIPELINE_AXIS, None)
-        )
+        from ..parallel.pp import stage_spec_prefix
+
         layer = jax.tree_util.tree_map(
-            lambda spec: P(*prefix, *spec),
+            lambda spec: P(*stage_spec_prefix(virtual_stages), *spec),
             layer,
             is_leaf=lambda s: isinstance(s, P),
         )

@@ -340,11 +340,10 @@ def partition_specs(cfg: LlamaConfig, pp: bool = False, virtual_stages: int = 1)
         # virtual_stages > 1 → interleaved layout [v, n_stages, L/(n·v), ...]: the pp
         # axis on dim 1 so device s hosts the STRIDED virtual stages (see
         # split_params_into_stages).
-        prefix = (
-            (None, PIPELINE_AXIS, None) if virtual_stages > 1 else (PIPELINE_AXIS, None)
-        )
+        from ..parallel.pp import stage_spec_prefix
+
         layer = jax.tree_util.tree_map(
-            lambda spec: P(*prefix, *spec),
+            lambda spec: P(*stage_spec_prefix(virtual_stages), *spec),
             layer,
             is_leaf=lambda s: isinstance(s, P),
         )

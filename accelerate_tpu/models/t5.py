@@ -148,7 +148,7 @@ def init_params(cfg: T5Config, key: Optional[jax.Array] = None) -> dict:
     return params
 
 
-def partition_specs(cfg: T5Config, pp: bool = False) -> dict:
+def partition_specs(cfg: T5Config, pp: bool = False, virtual_stages: int = 1) -> dict:
     """Megatron layout: q/k/v/wi column-parallel, o/wo row-parallel, vocab over (tp,fsdp).
 
     ``pp=True``: specs for the :func:`stack_pp_params` layout — encoder/decoder block
@@ -173,10 +173,12 @@ def partition_specs(cfg: T5Config, pp: bool = False) -> dict:
     if pp:
         from ..utils.constants import PIPELINE_AXIS
 
-        def stage_stack(spec_tree):
-            # [n_stages, L/n, ...] — stage dim over pp, stacked-layer dim unsharded.
+        from ..parallel.pp import stage_spec_prefix
+
+        def stage_stack(spec_tree, v=1):
+            # [n_stages, L/n, ...] (or interleaved [v, n, L/(n·v), ...] — pp on dim 1).
             return jax.tree_util.tree_map(
-                lambda s: P(PIPELINE_AXIS, None, *s), spec_tree,
+                lambda s: P(*stage_spec_prefix(v), *s), spec_tree,
                 is_leaf=lambda s: isinstance(s, P),
             )
 
@@ -189,7 +191,7 @@ def partition_specs(cfg: T5Config, pp: bool = False) -> dict:
             "enc_rel": P(None, TENSOR_AXIS),
             "dec_rel": P(None, TENSOR_AXIS),
             "encoder": {"stages": stage_stack(enc_blk), "ln_f": P()},
-            "decoder": {"stages": stage_stack(dec_blk), "ln_f": P()},
+            "decoder": {"stages": stage_stack(dec_blk, virtual_stages), "ln_f": P()},
         }
         if not cfg.tie_embeddings:
             specs["lm_head"] = P(None, vocab_axes)
@@ -461,7 +463,9 @@ def loss_fn(params: dict, batch: dict, cfg: T5Config, rng=None) -> jax.Array:
 
 
 # --------------------------------------------------------------- pipeline-parallel training
-def stack_pp_params(params: dict, cfg: T5Config, n_stages: int) -> dict:
+def stack_pp_params(
+    params: dict, cfg: T5Config, n_stages: int, virtual_stages: int = 1
+) -> dict:
     """Canonical params → the pipeline layout (the enc-dec analog of llama's
     stage-stacked layers; reference Megatron pipelines T5 too,
     ``/root/reference/src/accelerate/utils/megatron_lm.py:720``).
@@ -471,20 +475,25 @@ def stack_pp_params(params: dict, cfg: T5Config, n_stages: int) -> dict:
     ``enc_rel``/``dec_rel`` leaves (shared by all blocks anyway), and the now-homogeneous
     blocks stack to ``[n_stages, L/n, ...]`` under ``encoder.stages``/``decoder.stages``.
     Specs: ``partition_specs(cfg, pp=True)``.
+
+    ``virtual_stages=v > 1`` (interleaved, 1f1b): the DECODER stacks to the
+    interleaved ``[v, n, L/(n·v), ...]`` layout (its pipeline is the hand-scheduled
+    half); the encoder keeps ``[n, L/n, ...]`` (it runs AD-GPipe either way).
     """
-    if cfg.n_layers % n_stages or cfg.dec_layers % n_stages:
+    if cfg.n_layers % n_stages or cfg.dec_layers % (n_stages * virtual_stages):
         raise ValueError(
-            f"encoder ({cfg.n_layers}) and decoder ({cfg.dec_layers}) depths must both "
-            f"be divisible by n_stages={n_stages}"
+            f"encoder depth ({cfg.n_layers}) must be divisible by n_stages={n_stages} "
+            f"and decoder depth ({cfg.dec_layers}) by n_stages x "
+            f"virtual_stages={virtual_stages}"
         )
 
-    def strip_stack(blocks):
+    def strip_stack(blocks, v=1):
         first = dict(blocks[0])
-        first["attn"] = {k: v for k, v in first["attn"].items() if k != "rel_bias"}
+        first["attn"] = {k: v2 for k, v2 in first["attn"].items() if k != "rel_bias"}
         stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), first, *blocks[1:])
         from ..parallel.pp import split_params_into_stages
 
-        return split_params_into_stages(stacked, n_stages)
+        return split_params_into_stages(stacked, n_stages, virtual_stages=v)
 
     out = {
         "shared": params["shared"],
@@ -492,7 +501,7 @@ def stack_pp_params(params: dict, cfg: T5Config, n_stages: int) -> dict:
         "dec_rel": params["decoder"]["blocks"][0]["attn"]["rel_bias"],
         "encoder": {"stages": strip_stack(params["encoder"]["blocks"]),
                     "ln_f": params["encoder"]["ln_f"]},
-        "decoder": {"stages": strip_stack(params["decoder"]["blocks"]),
+        "decoder": {"stages": strip_stack(params["decoder"]["blocks"], virtual_stages),
                     "ln_f": params["decoder"]["ln_f"]},
     }
     if not cfg.tie_embeddings:
@@ -644,7 +653,7 @@ def _encode_pp(
 
 def _dec_pp_inputs(
     params, decoder_input_ids, cfg: T5Config, mesh, enc_out, attention_mask,
-    enc_segment_ids, dec_segment_ids,
+    enc_segment_ids, dec_segment_ids, virtual_stages: int = 1,
 ):
     """Decoder-pipeline inputs shared by the GPipe and 1F1B paths: embedded decoder
     activations, decoder stage params (blocks + broadcast rel bias), and the side tree
@@ -658,9 +667,16 @@ def _dec_pp_inputs(
     xd = params["shared"].astype(cfg.dtype)[decoder_input_ids]
     xd = _maybe_shard(xd, P(BATCH_AXES, None, None))
     bias_d = _rel_bias(params["dec_rel"], T, T, bidirectional=False, cfg=cfg)
+    # One (identical) bias slice per stage — per (chunk, stage) in the interleaved
+    # layout; AD sums the broadcast's per-slice grads back into the one table.
+    bias_st = (
+        jnp.broadcast_to(bias_d[None, None], (virtual_stages, n, *bias_d.shape))
+        if virtual_stages > 1
+        else jnp.broadcast_to(bias_d[None], (n, *bias_d.shape))
+    )
     sp_d = {
         "blocks": params["decoder"]["stages"],
-        "bias": jnp.broadcast_to(bias_d[None], (n, *bias_d.shape)),
+        "bias": bias_st,
     }
     side_d = {"enc_out": enc_out}
     if attention_mask is not None:
@@ -679,11 +695,16 @@ def loss_fn_pp(
     num_microbatches: Optional[int] = None,
     rng=None,
     schedule: str = "gpipe",
+    virtual_stages: int = 1,
 ) -> jax.Array:
     """Pipeline-parallel seq2seq CE (params in :func:`stack_pp_params` layout; same
     batch contract as ``loss_fn``, INCLUDING seq2seq packing — enc/dec segment ids ride
     both pipelines as per-microbatch side constants). Every ``loss_impl`` works — the
     head runs after the pipelines via ``common.ce_sum_dispatch``.
+
+    ``virtual_stages=v > 1`` (with 1f1b): the DECODER pipeline runs interleaved
+    (params from ``stack_pp_params(..., virtual_stages=v)``) — enc_out's cotangent
+    accumulates through the virtual-stage replay exactly as in the flat 1f1b.
 
     ``schedule="1f1b"`` hand-schedules the DECODER pipeline (the deeper, heavier half —
     self + cross attention per block) through ``make_pipeline_loss_fn``; the replay
@@ -694,6 +715,10 @@ def loss_fn_pp(
     complexity."""
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"schedule={schedule!r}: expected 'gpipe' or '1f1b'")
+    if virtual_stages > 1 and schedule != "1f1b":
+        raise NotImplementedError(
+            "virtual_stages > 1 requires schedule='1f1b' (parallel/pp.py)"
+        )
     if "segment_ids" in batch:
         raise ValueError(
             "seq2seq packing uses pack_seq2seq ('enc_segment_ids'/'dec_segment_ids'), "
@@ -732,7 +757,8 @@ def loss_fn_pp(
             enc_seg, dec_seg,
         )
         xd, sp_d, side_d = _dec_pp_inputs(
-            params, dec_in, cfg, mesh, enc_out, am, enc_seg, dec_seg
+            params, dec_in, cfg, mesh, enc_out, am, enc_seg, dec_seg,
+            virtual_stages=virtual_stages,
         )
         hp = {"ln_f": params["decoder"]["ln_f"], "head": _t5_head(params, cfg)}
 
@@ -750,6 +776,7 @@ def loss_fn_pp(
         pipe_loss = make_pipeline_loss_fn(
             mesh, _dec_stage_fn(cfg, T), head_loss,
             num_microbatches=num_microbatches, schedule="1f1b",
+            virtual_stages=virtual_stages,
         )
         return pipe_loss(
             sp_d, hp, xd, {"targets": safe, "mask": mask}, side=side_d

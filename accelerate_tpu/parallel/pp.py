@@ -512,6 +512,74 @@ def _simulate_interleaved(n: int, v: int, M: int) -> _InterleavedSchedule:
     )
 
 
+def stage_spec_prefix(virtual_stages: int = 1) -> tuple:
+    """Leading PartitionSpec entries for a stage-stacked layer-spec leaf, matching
+    :func:`split_params_into_stages`' layout: ``(pp, None)`` for [n, L/n, ...], or
+    ``(None, pp, None)`` for the interleaved [v, n, L/(n·v), ...]. The ONE copy model
+    families build their ``partition_specs(pp=True)`` prefixes from — the prefix must
+    stay in lockstep with the split layout defined here."""
+    return (
+        (None, PIPELINE_AXIS, None) if virtual_stages > 1 else (PIPELINE_AXIS, None)
+    )
+
+
+# ------------------------------------------------- side-input split/merge (shared)
+def _side_split(side_mb):
+    """Flatten a side pytree into (float_leaves, int_leaves, treedef, is_float):
+    float leaves are differentiable (cotangents accumulated by the replay kernels),
+    int/bool leaves are constants. The ONE copy both the flat-1F1B and interleaved
+    replay kernels use — their gradient-accumulation semantics must not drift."""
+    if side_mb is None:
+        return [], [], None, []
+    leaves, treedef = jax.tree_util.tree_flatten(side_mb)
+    is_f = [jnp.issubdtype(l.dtype, jnp.floating) for l in leaves]
+    return (
+        [l for l, f in zip(leaves, is_f) if f],
+        [l for l, f in zip(leaves, is_f) if not f],
+        treedef,
+        is_f,
+    )
+
+
+def _side_merge(treedef, is_f, fs, is_):
+    fit, iit = iter(fs), iter(is_)
+    return treedef.unflatten([next(fit) if f else next(iit) for f in is_f])
+
+
+def _side_slice(leaves, mb_id):
+    return [lax.dynamic_index_in_dim(l, mb_id, 0, False) for l in leaves]
+
+
+def _ds_accumulate(ds_buf, ds, bm_c, live):
+    """READ-ADD-WRITE each float-side cotangent at the microbatch slot (every stage /
+    chunk backwards every microbatch at different ticks; all contributions must land)."""
+    return [
+        jnp.where(
+            live,
+            lax.dynamic_update_index_in_dim(
+                buf, lax.dynamic_index_in_dim(buf, bm_c, 0, False) + d, bm_c, 0
+            ),
+            buf,
+        )
+        for buf, d in zip(ds_buf, ds)
+    ]
+
+
+def _d_side_assemble(side, ds_list):
+    """Custom-VJP side cotangents: float leaves take the kernel's accumulated [M, B_m,
+    ...] rows (reshaped to [B, ...]); int/bool leaves get float0."""
+    side_leaves, side_treedef = jax.tree_util.tree_flatten(side)
+    ds_iter = iter(ds_list)
+    return side_treedef.unflatten([
+        (
+            next(ds_iter).reshape(a.shape).astype(a.dtype)
+            if jnp.issubdtype(a.dtype, jnp.floating)
+            else np.zeros(a.shape, jax.dtypes.float0)
+        )
+        for a in side_leaves
+    ])
+
+
 def _mb_index(tree, i):
     return jax.tree_util.tree_map(lambda a: lax.dynamic_index_in_dim(a, i, 0, False), tree)
 
@@ -553,27 +621,12 @@ def _pipeline_1f1b_bwd_kernel(
     dx_buf0 = jnp.zeros_like(x_mb, jnp.float32)
     dp0 = _zeros_f32(p_local)
 
-    # Side inputs split by dtype: FLOAT leaves are differentiable (t5's enc_out — every
-    # decoder stage consumes it, so its cotangent accumulates across stages and
-    # microbatches in ds_buf); integer/bool leaves (positions, segment ids, masks) are
-    # constants with float0 cotangents, matching the AD-GPipe path's semantics.
-    if side_mb is None:
-        side_leaves, side_treedef, side_is_f = [], None, []
-    else:
-        side_leaves, side_treedef = jax.tree_util.tree_flatten(side_mb)
-        side_is_f = [jnp.issubdtype(l.dtype, jnp.floating) for l in side_leaves]
-    side_f = [l for l, f in zip(side_leaves, side_is_f) if f]
-    side_i = [l for l, f in zip(side_leaves, side_is_f) if not f]
-
-    def _merge_side(fs, is_):
-        fit, iit = iter(fs), iter(is_)
-        return side_treedef.unflatten(
-            [next(fit) if f else next(iit) for f in side_is_f]
-        )
-
-    def _slice_side(leaves, mb_id):
-        return [lax.dynamic_index_in_dim(l, mb_id, 0, False) for l in leaves]
-
+    # Side inputs split by dtype (shared _side_split contract): FLOAT leaves are
+    # differentiable (t5's enc_out — every decoder stage consumes it, so its cotangent
+    # accumulates across stages and microbatches in ds_buf); integer/bool leaves
+    # (positions, segment ids, masks) are constants with float0 cotangents, matching
+    # the AD-GPipe path's semantics.
+    side_f, side_i, side_treedef, side_is_f = _side_split(side_mb)
     ds_buf0 = [jnp.zeros(l.shape, jnp.float32) for l in side_f]
 
     fwd_t = jnp.asarray(sched.fwd)
@@ -593,16 +646,19 @@ def _pipeline_1f1b_bwd_kernel(
         constants; side slices are indexed, never ppermuted."""
         side = (
             None if side_mb is None
-            else _merge_side(_slice_side(side_f, mb_id), _slice_side(side_i, mb_id))
+            else _side_merge(
+                side_treedef, side_is_f,
+                _side_slice(side_f, mb_id), _side_slice(side_i, mb_id),
+            )
         )
         return run_with(p, x, side)
 
     def stage_vjp(p, x_b, dy, mb_id):
-        sf = _slice_side(side_f, mb_id)
-        si = _slice_side(side_i, mb_id)
+        sf = _side_slice(side_f, mb_id)
+        si = _side_slice(side_i, mb_id)
 
         def f(p, x, sf_):
-            side = None if side_mb is None else _merge_side(sf_, si)
+            side = None if side_mb is None else _side_merge(side_treedef, side_is_f, sf_, si)
             y, aux = run_with(p, x, side)
             # The aux term (MoE load balancing) contributes ct·aux_weight directly per
             # real (stage, microbatch) pair — aux_ct carries that scalar; masked ticks
@@ -669,19 +725,7 @@ def _pipeline_1f1b_bwd_kernel(
             lax.dynamic_update_index_in_dim(dx_buf, dx, bm_c, 0),
             dx_buf,
         )
-        # Float side cotangents: READ-ADD-WRITE at the microbatch slot — every stage
-        # backwards every microbatch (at different ticks), and their contributions to
-        # the shared side input (t5's enc_out) must all land.
-        ds_buf = [
-            jnp.where(
-                live,
-                lax.dynamic_update_index_in_dim(
-                    buf, lax.dynamic_index_in_dim(buf, bm_c, 0, False) + d, bm_c, 0
-                ),
-                buf,
-            )
-            for buf, d in zip(ds_buf, ds)
-        ]
+        ds_buf = _ds_accumulate(ds_buf, ds, bm_c, live)
 
         # 4) Sends — unconditional collectives (receivers bank only per their tables).
         recv_f = lax.ppermute(y, axis_name, perm_f)
@@ -722,8 +766,8 @@ def _interleaved_fwd_kernel(
     """Forward-only interleaved pipeline (the primal of the interleaved loss): per tick
     every device forwards one (chunk, mb) per the static tables; activations ride ONE
     circular ppermute (device n-1 chunk c wraps to device 0 chunk c+1). ``side_mb``:
-    per-microbatch INT/BOOL constants (masks, segment ids) indexed by microbatch id —
-    float side leaves are rejected upstream (no cotangent accumulation here)."""
+    per-microbatch constants (masks, segment ids, t5's enc_out) indexed by microbatch
+    id — the bwd kernel accumulates float-side cotangents; this primal just reads."""
     idx = lax.axis_index(axis_name)
     n = lax.axis_size(axis_name)
     M = x_mb.shape[0]
@@ -808,27 +852,49 @@ def _pipeline_interleaved_bwd_kernel(
     dx_buf0 = jnp.zeros_like(x_mb, jnp.float32)
     dp0 = _zeros_f32(p_local)
 
+    # Side split by dtype (shared _side_split contract, identical semantics to the
+    # non-virtual 1F1B replay): FLOAT leaves (t5's enc_out) are differentiable with
+    # cotangents accumulated per microbatch across chunks and devices.
+    side_f, side_i, side_treedef, side_is_f = _side_split(side_mb)
+    ds_buf0 = [jnp.zeros(l.shape, jnp.float32) for l in side_f]
+
     def chunk_params(c):
         return jax.tree_util.tree_map(
             lambda a: lax.dynamic_index_in_dim(a, c, 0, False), p_local
         )
 
-    def run(p, x, mb_id):
+    def run_with(p, x, side):
         if side_mb is None:
             return stage_fn(p, x)
-        return stage_fn(p, x, _mb_index(side_mb, mb_id))
+        return stage_fn(p, x, side)
+
+    def run(p, x, mb_id):
+        side = (
+            None if side_mb is None
+            else _side_merge(
+                side_treedef, side_is_f,
+                _side_slice(side_f, mb_id), _side_slice(side_i, mb_id),
+            )
+        )
+        return run_with(p, x, side)
 
     def stage_vjp(c, x_b, dy, mb_id):
         p = chunk_params(c)
+        sf = _side_slice(side_f, mb_id)
+        si = _side_slice(side_i, mb_id)
 
-        def f(p, x):
-            return jnp.sum(run(p, x, mb_id).astype(jnp.float32) * dy)
+        def f(p, x, sf_):
+            side = (
+                None if side_mb is None
+                else _side_merge(side_treedef, side_is_f, sf_, si)
+            )
+            return jnp.sum(run_with(p, x, side).astype(jnp.float32) * dy)
 
-        dp, dx = jax.grad(f, argnums=(0, 1))(p, x_b)
-        return dp, dx.astype(jnp.float32)
+        dp, dx, ds = jax.grad(f, argnums=(0, 1, 2))(p, x_b, sf)
+        return dp, dx.astype(jnp.float32), [d.astype(jnp.float32) for d in ds]
 
     def tick(carry, rows):
-        recv_f, recv_b, in_buf, g_buf, dx_buf, dp_acc = carry
+        recv_f, recv_b, in_buf, g_buf, dx_buf, dp_acc, ds_buf = carry
         fc_r, fm_r, bc_r, bm_r, afc_r, afm_r, abc_r, abm_r = rows
         fc, fm = fc_r[idx], fm_r[idx]
         bc, bm = bc_r[idx], bm_r[idx]
@@ -870,7 +936,7 @@ def _pipeline_interleaved_bwd_kernel(
             lax.dynamic_index_in_dim(dy_mb, bm_c, 0, False),
             g_buf[bc_c, bm_c % sched.g_buf],
         )
-        dp, dx = stage_vjp(bc_c, x_b, dy, bm_c)
+        dp, dx, ds = stage_vjp(bc_c, x_b, dy, bm_c)
         live = bm >= 0
         # Scatter-add dp into the chunk slot (masked).
         dp_acc = jax.tree_util.tree_map(
@@ -886,11 +952,12 @@ def _pipeline_interleaved_bwd_kernel(
             lax.dynamic_update_index_in_dim(dx_buf, dx, bm_c, 0),
             dx_buf,
         )
+        ds_buf = _ds_accumulate(ds_buf, ds, bm_c, live)
 
         # 4) Circular sends, unconditional.
         recv_f = lax.ppermute(y, axis_name, perm_f)
         recv_b = lax.ppermute(dx, axis_name, perm_b)
-        return (recv_f, recv_b, in_buf, g_buf, dx_buf, dp_acc), None
+        return (recv_f, recv_b, in_buf, g_buf, dx_buf, dp_acc, ds_buf), None
 
     rows = tuple(
         jnp.asarray(a)
@@ -899,12 +966,13 @@ def _pipeline_interleaved_bwd_kernel(
     )
     carry0 = (
         jnp.zeros(mb_shape, x_mb.dtype), jnp.zeros(mb_shape, jnp.float32),
-        in_buf0, g_buf0, dx_buf0, dp0,
+        in_buf0, g_buf0, dx_buf0, dp0, ds_buf0,
     )
-    (_, _, _, _, dx_buf, dp_acc), _ = lax.scan(tick, carry0, rows)
+    (_, _, _, _, dx_buf, dp_acc, ds_buf), _ = lax.scan(tick, carry0, rows)
     dp_out = jax.tree_util.tree_map(lambda a: a[:, None], dp_acc)  # re-add the pp dim
     dx_out = lax.psum(jnp.where(idx == 0, dx_buf, jnp.zeros_like(dx_buf)), axis_name)
-    return dp_out, dx_out
+    ds_out = [lax.psum(b, axis_name) for b in ds_buf]
+    return dp_out, dx_out, ds_out
 
 
 def _make_interleaved_loss_fn(mesh, stage_fn, head_loss_fn, axis_name, M, v):
@@ -976,35 +1044,23 @@ def _make_interleaved_loss_fn(mesh, stage_fn, head_loss_fn, axis_name, M, v):
             ),
             mesh=mesh,
             in_specs=tuple(in_specs),
-            out_specs=(specs_of(stage_params), P()),
+            out_specs=(specs_of(stage_params), P(), P()),
             axis_names={axis_name},
             check_vma=False,
         )
-        dp, dx_mb = mapped(*args)
+        dp, dx_mb, ds_list = mapped(*args)
         dp = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), dp, stage_params)
         dh = jax.tree_util.tree_map(lambda g, p: g.astype(p.dtype), dh, head_params)
         dx = dx_mb.reshape(B, *x.shape[1:]).astype(x.dtype)
-        # Int/bool side only on this path (floats rejected below) → float0 cotangents.
-        d_side = jax.tree_util.tree_map(
-            lambda a: np.zeros(a.shape, jax.dtypes.float0), side
-        )
+        # Float side leaves get true accumulated cotangents (same contract as the
+        # non-virtual 1F1B replay); int/bool leaves float0.
+        d_side = _d_side_assemble(side, ds_list)
         return dp, dh, dx, d_extras, d_side
 
     loss.defvjp(loss_fwd, loss_bwd)
 
     def loss_with_side(stage_params, head_params, x, extras, side=None):
-        side = {} if side is None else side
-        if any(
-            jnp.issubdtype(a.dtype, jnp.floating)
-            for a in jax.tree_util.tree_leaves(side)
-        ):
-            # Float side leaves need cotangent accumulation (t5's enc_out), which the
-            # interleaved replay does not implement — the non-virtual 1f1b does.
-            raise NotImplementedError(
-                "FLOAT side inputs are not supported with virtual_stages > 1; int/bool "
-                "side constants (masks, segment ids) are"
-            )
-        return loss(stage_params, head_params, x, extras, side)
+        return loss(stage_params, head_params, x, extras, {} if side is None else side)
 
     return loss_with_side
 
@@ -1169,17 +1225,7 @@ def make_pipeline_loss_fn(
         # replay (t5's enc_out — the stage VJPs grad w.r.t. their side slice and the
         # kernel sums across stages and microbatches); integer/bool leaves (positions,
         # segment ids, masks) are float0, same as jax's own convention.
-        side_leaves, side_treedef = jax.tree_util.tree_flatten(side)
-        ds_iter = iter(ds_list)
-        d_side_leaves = [
-            (
-                next(ds_iter).reshape(a.shape).astype(a.dtype)
-                if jnp.issubdtype(a.dtype, jnp.floating)
-                else np.zeros(a.shape, jax.dtypes.float0)
-            )
-            for a in side_leaves
-        ]
-        d_side = side_treedef.unflatten(d_side_leaves)
+        d_side = _d_side_assemble(side, ds_list)
         return dp, dh, dx, d_extras, d_side
 
     loss.defvjp(loss_fwd, loss_bwd)

@@ -89,6 +89,10 @@ def _common_env(args: Any) -> dict[str, str]:
         env[f"{ENV_PREFIX}FP8_DELAYED_SCALING"] = "true"
     if getattr(args, "pp_num_microbatches", None):
         env[f"{ENV_PREFIX}PP_MICROBATCHES"] = str(args.pp_num_microbatches)
+    if getattr(args, "pp_schedule", None):
+        env[f"{ENV_PREFIX}PP_SCHEDULE"] = str(args.pp_schedule)
+    if getattr(args, "pp_virtual_stages", None):
+        env[f"{ENV_PREFIX}PP_VIRTUAL_STAGES"] = str(args.pp_virtual_stages)
     if getattr(args, "dispatch_batches", None) is not None:
         env[f"{ENV_PREFIX}DISPATCH_BATCHES"] = _str_flag(args.dispatch_batches)
     if getattr(args, "even_batches", None) is not None:

@@ -110,6 +110,38 @@ def test_simple_launcher_env():
     assert env["ACCELERATE_GRADIENT_ACCUMULATION_STEPS"] == "4"
 
 
+def test_pp_schedule_wire_protocol(monkeypatch):
+    """--pp-schedule / --pp-virtual-stages ride the env wire protocol into the
+    Accelerator properties (the launcher half of PipelineParallelPlugin)."""
+    args = _launch_args(
+        ["--pp", "2", "--pp-schedule", "1f1b", "--pp-virtual-stages", "2",
+         "--pp-num-microbatches", "8"]
+    )
+    _, env = prepare_simple_launcher_cmd_env(args)
+    assert env["ACCELERATE_PP_SCHEDULE"] == "1f1b"
+    assert env["ACCELERATE_PP_VIRTUAL_STAGES"] == "2"
+    assert env["ACCELERATE_PP_MICROBATCHES"] == "8"
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.parallel import MeshConfig
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    for s in (AcceleratorState, GradientState, PartialState):
+        s._reset_state()
+    monkeypatch.setenv("ACCELERATE_PP_SCHEDULE", "1f1b")
+    monkeypatch.setenv("ACCELERATE_PP_VIRTUAL_STAGES", "2")
+    monkeypatch.setenv("ACCELERATE_PP_MICROBATCHES", "8")
+    acc = Accelerator(mesh_config=MeshConfig(dp=4, pp=2))
+    assert acc.pp_schedule == "1f1b"
+    assert acc.virtual_stages == 2
+    assert acc.num_microbatches == 8
+    monkeypatch.setenv("ACCELERATE_PP_VIRTUAL_STAGES", "0")
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="VIRTUAL_STAGES"):
+        _ = acc.virtual_stages
+
+
 def test_virtual_device_env():
     args = _launch_args(["--num-virtual-devices", "8"])
     _, env = prepare_simple_launcher_cmd_env(args)

@@ -84,6 +84,37 @@ def test_t5_pp_matches_single(with_mask, schedule, M):
 
 
 @slow
+def test_t5_pp_interleaved_matches_single():
+    """t5's decoder pipeline runs INTERLEAVED (virtual_stages=2) under 1f1b, with the
+    float enc_out cotangent accumulated through the virtual-stage replay — loss and
+    full grads (incl. encoder params, reached only via that cotangent) match."""
+    from accelerate_tpu.parallel.mesh import build_mesh
+
+    cfg = dataclasses.replace(CFG, n_layers=4)
+    params = t5.init_params(cfg)
+    batch = {k: jnp.asarray(v) for k, v in make_batch(n=8, src=12, tgt=8).items()}
+    base = float(t5.loss_fn(params, batch, cfg))
+    base_g = jax.grad(lambda p: t5.loss_fn(p, batch, cfg))(params)
+
+    mesh = build_mesh(MeshConfig(dp=4, pp=2))
+    pp_params = t5.stack_pp_params(params, cfg, 2, virtual_stages=2)
+    with jax.set_mesh(mesh):
+        l, g = jax.jit(jax.value_and_grad(
+            lambda p, b: t5.loss_fn_pp(
+                p, b, cfg, mesh, num_microbatches=4, schedule="1f1b",
+                virtual_stages=2)
+        ))(pp_params, batch)
+    np.testing.assert_allclose(float(l), base, rtol=1e-5)
+    expected = t5.stack_pp_params(base_g, cfg, 2, virtual_stages=2)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=3e-5
+        ),
+        g, expected,
+    )
+
+
+@slow
 @pytest.mark.parametrize("schedule,M", [("gpipe", 2), ("gpipe", 4), ("1f1b", 4)])
 def test_t5_pp_seq2seq_packed_matches_single(schedule, M):
     """Seq2seq packing composes with the enc-dec pipeline: enc/dec segment ids ride
