@@ -1094,6 +1094,17 @@ def loss_fn_pp(
             # exact parity in the no-drop regime, the standard MoE-under-resharding
             # caveat) and the aux statistic is psum-meaned over sp.
             sp_pipeline = True
+            if cfg.attn_impl == "ulysses" and (schedule == "1f1b" or virtual_stages > 1):
+                # Empirical (r4): the all_to_all pair inside the hand-scheduled
+                # replay's per-tick jax.grad does not finish lowering (ring/allgather
+                # compile in seconds on the same config; ulysses hangs >9 min). Fail
+                # loudly rather than hang the job; ulysses works on the GPipe (AD)
+                # schedule, and ring covers the 1f1b/interleaved long-context case.
+                raise NotImplementedError(
+                    "attn_impl='ulysses' inside the hand-scheduled pipeline replay "
+                    "(schedule='1f1b' or virtual_stages>1) hangs at lowering — use "
+                    "schedule='gpipe' with ulysses, or attn_impl='ring' with 1f1b."
+                )
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     B, S = inputs.shape
@@ -1118,11 +1129,11 @@ def loss_fn_pp(
         )
         seg_in = None
         side = None
-    if virtual_stages > 1 and (schedule != "1f1b" or sp_pipeline):
+    if virtual_stages > 1 and schedule != "1f1b":
+        # (MoE × virtual stages raises in make_pipeline_loss_fn — with_aux is not
+        # plumbed through the interleaved replay; packing and sp-in-pp both compose.)
         raise NotImplementedError(
-            "virtual_stages > 1 requires schedule='1f1b' and does not compose with "
-            "sp-attention-in-pp yet (parallel/pp.py; sample packing DOES compose — "
-            "segment ids ride as int side constants)"
+            "virtual_stages > 1 requires schedule='1f1b' (parallel/pp.py)"
         )
     if schedule == "1f1b" or sp_pipeline:
         from ..parallel.pp import make_pipeline_loss_fn

@@ -831,7 +831,7 @@ def _interleaved_fwd_kernel(
 
 def _pipeline_interleaved_bwd_kernel(
     stage_fn, sched: _InterleavedSchedule, axis_name, v: int,
-    stage_params, x_mb, dy_mb, side_mb=None,
+    stage_params, x_mb, dy_mb, side_mb=None, extra_manual_axes=(),
 ):
     """Combined fwd+bwd interleaved-1F1B replay (virtual-pipeline analog of
     ``_pipeline_1f1b_bwd_kernel``): per tick one chunk forward and one chunk backward
@@ -969,13 +969,22 @@ def _pipeline_interleaved_bwd_kernel(
         in_buf0, g_buf0, dx_buf0, dp0, ds_buf0,
     )
     (_, _, _, _, dx_buf, dp_acc, ds_buf), _ = lax.scan(tick, carry0, rows)
+    if extra_manual_axes:
+        # Stage params replicated over the extra manual axes (sp): sum the per-member
+        # partial dp — same reasoning as the flat 1F1B replay.
+        dp_acc = jax.tree_util.tree_map(
+            lambda a: lax.psum(a, tuple(extra_manual_axes)), dp_acc
+        )
     dp_out = jax.tree_util.tree_map(lambda a: a[:, None], dp_acc)  # re-add the pp dim
     dx_out = lax.psum(jnp.where(idx == 0, dx_buf, jnp.zeros_like(dx_buf)), axis_name)
     ds_out = [lax.psum(b, axis_name) for b in ds_buf]
     return dp_out, dx_out, ds_out
 
 
-def _make_interleaved_loss_fn(mesh, stage_fn, head_loss_fn, axis_name, M, v):
+def _make_interleaved_loss_fn(
+    mesh, stage_fn, head_loss_fn, axis_name, M, v,
+    act_spec=None, extra_manual_axes=(),
+):
     """Interleaved-1F1B loss: ``loss(stage_params, head_params, x, extras)`` with
     stage params chunk-stacked ``[v, n, L/(n·v), ...]`` (dim 1 over pp — device s hosts
     the STRIDED virtual stages {s, n+s, ...}). The primal runs the forward-only
@@ -985,6 +994,8 @@ def _make_interleaved_loss_fn(mesh, stage_fn, head_loss_fn, axis_name, M, v):
     per microbatch — the Megatron virtual-pipeline tradeoff."""
     n_stages = mesh.shape[axis_name]
     sched = _simulate_interleaved(n_stages, v, M)
+    x_spec = act_spec if act_spec is not None else P()
+    manual = {axis_name, *extra_manual_axes}
 
     def specs_of(stage_params):
         return jax.tree_util.tree_map(lambda _: P(None, axis_name), stage_params)
@@ -999,7 +1010,7 @@ def _make_interleaved_loss_fn(mesh, stage_fn, head_loss_fn, axis_name, M, v):
         if B % M:
             raise ValueError(f"batch {B} not divisible by {M} microbatches")
         x_mb = x.reshape(M, B // M, *x.shape[1:])
-        in_specs = [specs_of(stage_params), P()]
+        in_specs = [specs_of(stage_params), x_spec]
         args = [stage_params, x_mb]
         if side:
             in_specs.append(P())
@@ -1008,8 +1019,8 @@ def _make_interleaved_loss_fn(mesh, stage_fn, head_loss_fn, axis_name, M, v):
             functools.partial(_interleaved_fwd_kernel, stage_fn, sched, axis_name, v),
             mesh=mesh,
             in_specs=tuple(in_specs),
-            out_specs=P(),
-            axis_names={axis_name},
+            out_specs=x_spec,
+            axis_names=manual,
             check_vma=False,
         )
         out = mapped(*args)
@@ -1033,19 +1044,20 @@ def _make_interleaved_loss_fn(mesh, stage_fn, head_loss_fn, axis_name, M, v):
         )[1](jnp.asarray(ct, jnp.float32))
         dy_mb = dy.astype(jnp.float32).reshape(M, B // M, *y.shape[1:])
         x_mb = x.reshape(M, B // M, *x.shape[1:])
-        in_specs = [specs_of(stage_params), P(), P()]
+        in_specs = [specs_of(stage_params), x_spec, x_spec]
         args = [stage_params, x_mb, dy_mb]
         if side:
             in_specs.append(P())
             args.append(_side_mb(side, B))
         mapped = jax.shard_map(
             functools.partial(
-                _pipeline_interleaved_bwd_kernel, stage_fn, sched, axis_name, v
+                _pipeline_interleaved_bwd_kernel, stage_fn, sched, axis_name, v,
+                extra_manual_axes=tuple(extra_manual_axes),
             ),
             mesh=mesh,
             in_specs=tuple(in_specs),
-            out_specs=(specs_of(stage_params), P(), P()),
-            axis_names={axis_name},
+            out_specs=(specs_of(stage_params), x_spec, P()),
+            axis_names=manual,
             check_vma=False,
         )
         dp, dx_mb, ds_list = mapped(*args)
@@ -1060,7 +1072,13 @@ def _make_interleaved_loss_fn(mesh, stage_fn, head_loss_fn, axis_name, M, v):
     loss.defvjp(loss_fwd, loss_bwd)
 
     def loss_with_side(stage_params, head_params, x, extras, side=None):
-        return loss(stage_params, head_params, x, extras, {} if side is None else side)
+        side = {} if side is None else side
+        if extra_manual_axes and jax.tree_util.tree_leaves(side):
+            raise NotImplementedError(
+                "side inputs under extra_manual_axes are not supported — same contract "
+                "as the flat pipeline (packed batches fall back from the sp modes)"
+            )
+        return loss(stage_params, head_params, x, extras, side)
 
     return loss_with_side
 
@@ -1125,13 +1143,14 @@ def make_pipeline_loss_fn(
         # Interleaved/virtual pipeline (Megatron virtual_pipeline analog, reference
         # dataclasses.py:2024): stage params in the [v, n_stages, L/(n·v), ...] layout
         # of ``split_params_into_stages(..., virtual_stages=v)``.
-        if schedule != "1f1b" or with_aux or extra_manual_axes:
+        if schedule != "1f1b" or with_aux:
             raise NotImplementedError(
-                "virtual_stages > 1 requires schedule='1f1b' and composes with "
-                "neither MoE aux nor extra_manual_axes (sp) yet"
+                "virtual_stages > 1 requires schedule='1f1b' and does not compose "
+                "with MoE aux yet"
             )
         return _make_interleaved_loss_fn(
-            mesh, stage_fn, head_loss_fn, axis_name, M, virtual_stages
+            mesh, stage_fn, head_loss_fn, axis_name, M, virtual_stages,
+            act_spec=act_spec, extra_manual_axes=extra_manual_axes,
         )
 
     pipe = make_pipeline_fn(

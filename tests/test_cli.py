@@ -136,10 +136,16 @@ def test_pp_schedule_wire_protocol(monkeypatch):
     assert acc.virtual_stages == 2
     assert acc.num_microbatches == 8
     monkeypatch.setenv("ACCELERATE_PP_VIRTUAL_STAGES", "0")
-    import pytest as _pytest
-
-    with _pytest.raises(ValueError, match="VIRTUAL_STAGES"):
+    with pytest.raises(ValueError, match="VIRTUAL_STAGES"):
         _ = acc.virtual_stages
+
+    # Launcher-side validation: the env-only path never constructs the plugin, so the
+    # launcher must reject the invalid combo up front, not deep in the training job.
+    from accelerate_tpu.commands.launch import launch_command
+
+    bad = _launch_args(["--pp", "2", "--pp-virtual-stages", "2"])
+    with pytest.raises(SystemExit, match="1f1b"):
+        launch_command(bad)
 
 
 def test_virtual_device_env():

@@ -843,6 +843,68 @@ def test_pp_interleaved_packed_matches_single(family):
     )
 
 
+def test_llama_pp_sp_ulysses_1f1b_raises_with_rationale():
+    """ulysses inside the hand-scheduled replay hangs at lowering (empirical, r4) —
+    the guard must fail loudly instead of hanging the job."""
+    import dataclasses as _dc
+
+    from accelerate_tpu.models import llama
+
+    cfg = _dc.replace(
+        llama.CONFIGS["tiny"], dtype=jnp.float32, attn_impl="ulysses", scan_layers=True,
+        n_layers=4,
+    )
+    params = llama.init_params(cfg)
+    sp = dict(params)
+    sp["layers"] = split_params_into_stages(params["layers"], 2)
+    batch = {"tokens": jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 17)), jnp.int32)}
+    mesh = build_mesh(MeshConfig(dp=2, sp=2, pp=2))
+    with jax.set_mesh(mesh):
+        with pytest.raises(NotImplementedError, match="ulysses"):
+            llama.loss_fn_pp(sp, batch, cfg, mesh, num_microbatches=4, schedule="1f1b")
+
+
+@slow
+@pytest.mark.parametrize("mode", ["ring", "allgather"])
+def test_llama_pp_sp_interleaved_matches_single(mode):
+    """sp-attention composes with the interleaved pipeline: sequence-sliced
+    activations through the virtual-stage replay, sp collectives issued flat inside
+    each chunk's stage body, dp psum'd over sp — parity at dp2 x sp2 x pp2 with v=2."""
+    import dataclasses as _dc
+
+    from accelerate_tpu.models import llama
+
+    cfg = _dc.replace(
+        llama.CONFIGS["tiny"], dtype=jnp.float32, attn_impl=mode, scan_layers=True,
+        n_layers=8,
+    )
+    params = llama.init_params(cfg)
+    batch = {"tokens": jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 17)), jnp.int32)}
+    base = float(llama.loss_fn(params, batch, cfg))
+    base_g = jax.grad(lambda p: llama.loss_fn(p, batch, cfg))(params)
+
+    sp = dict(params)
+    sp["layers"] = split_params_into_stages(params["layers"], 2, virtual_stages=2)
+    mesh = build_mesh(MeshConfig(dp=2, sp=2, pp=2))
+    with jax.set_mesh(mesh):
+        l, g = jax.jit(jax.value_and_grad(
+            lambda p, b: llama.loss_fn_pp(
+                p, b, cfg, mesh, num_microbatches=8, schedule="1f1b",
+                virtual_stages=2)
+        ))(sp, batch)
+    np.testing.assert_allclose(float(l), base, rtol=1e-5)
+    expected = dict(base_g)
+    expected["layers"] = split_params_into_stages(base_g["layers"], 2, virtual_stages=2)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5
+        ),
+        dict(g), expected,
+    )
+
+
 @slow
 def test_gpt_pp_interleaved_matches_single():
     """gpt carries virtual_stages too (llama is not special): pp=2 v=2 strided chunks
