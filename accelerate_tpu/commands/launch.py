@@ -392,15 +392,24 @@ def _print_plan(plans) -> None:
 
 def launch_command(args) -> int:
     _apply_config_defaults(args)
-    if (getattr(args, "pp_virtual_stages", None) or 1) > 1 and (
-        getattr(args, "pp_schedule", None) or "gpipe"
-    ) != "1f1b":
-        # Mirror PipelineParallelPlugin.__post_init__ at the launcher: the env-only
-        # path never constructs the plugin, so without this the combo would only fail
-        # deep inside the training job's first loss_fn_pp call.
+    v_stages = (
+        getattr(args, "pp_virtual_stages", None)
+        or int(os.environ.get("ACCELERATE_PP_VIRTUAL_STAGES", "1") or 1)
+        or 1
+    )
+    schedule = (
+        getattr(args, "pp_schedule", None)
+        or os.environ.get("ACCELERATE_PP_SCHEDULE")
+        or "gpipe"
+    )
+    if v_stages > 1 and schedule != "1f1b":
+        # Mirror PipelineParallelPlugin.__post_init__ at the launcher — flag AND
+        # env-var routes both checked: neither constructs the plugin, so without this
+        # the combo would only fail deep inside the training job's first loss_fn_pp.
         raise SystemExit(
-            "--pp-virtual-stages > 1 requires --pp-schedule 1f1b "
-            "(interleaved virtual pipeline runs on the 1f1b schedule)"
+            "--pp-virtual-stages > 1 (or ACCELERATE_PP_VIRTUAL_STAGES) requires "
+            "--pp-schedule 1f1b (interleaved virtual pipeline runs on the 1f1b "
+            "schedule)"
         )
     if args.tpu_pod:
         return tpu_pod_launcher(args)

@@ -1130,8 +1130,8 @@ def loss_fn_pp(
         seg_in = None
         side = None
     if virtual_stages > 1 and schedule != "1f1b":
-        # (MoE × virtual stages raises in make_pipeline_loss_fn — with_aux is not
-        # plumbed through the interleaved replay; packing and sp-in-pp both compose.)
+        # (packing, sp-in-pp, and MoE all compose with virtual stages — only the
+        # schedule restriction remains.)
         raise NotImplementedError(
             "virtual_stages > 1 requires schedule='1f1b' (parallel/pp.py)"
         )

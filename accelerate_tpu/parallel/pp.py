@@ -162,16 +162,7 @@ def pipeline_apply(
     # Replicate the last stage's banked outputs to every stage.
     out = lax.psum(jnp.where(idx == n - 1, out_buf, jnp.zeros_like(out_buf)), axis_name)
     if with_aux:
-        aux = lax.psum(aux_acc, axis_name)
-        if aux_extra_axes:
-            # Under extra manual axes (sp) each member computed the aux statistic on
-            # its OWN sequence slice — the batch-level stat is their MEAN (equal-size
-            # slices), so psum then divide by the member count.
-            size = 1
-            for a in aux_extra_axes:
-                size *= lax.axis_size(a)
-            aux = lax.psum(aux, tuple(aux_extra_axes)) / size
-        return out, aux
+        return out, _psum_mean_extra(aux_acc, axis_name, aux_extra_axes)
     return out
 
 
@@ -580,6 +571,30 @@ def _d_side_assemble(side, ds_list):
     ])
 
 
+# --------------------------------------------------- aux normalization (shared)
+def _psum_mean_extra(aux, axis_name, extra_axes):
+    """psum the per-device aux over pp, then psum-MEAN over the extra manual axes
+    (sp members compute the statistic on equal-size sequence slices). The ONE copy
+    both the GPipe primal and the interleaved primal use."""
+    aux = lax.psum(aux, axis_name)
+    if extra_axes:
+        size = 1
+        for a in extra_axes:
+            size *= lax.axis_size(a)
+        aux = lax.psum(aux, tuple(extra_axes)) / size
+    return aux
+
+
+def _aux_cotangent(ct, aux_weight, mesh, extra_axes):
+    """Replay-side aux cotangent: the primal MEANS over extra-axis members while the
+    replay's dp psum SUMS their contributions — scale down by the member count so the
+    two compose to the same gradient. The ONE copy both loss_bwds use."""
+    extra_size = 1
+    for a in extra_axes:
+        extra_size *= mesh.shape[a]
+    return jnp.asarray(ct, jnp.float32) * aux_weight / extra_size
+
+
 def _mb_index(tree, i):
     return jax.tree_util.tree_map(lambda a: lax.dynamic_index_in_dim(a, i, 0, False), tree)
 
@@ -761,13 +776,16 @@ def _pipeline_1f1b_bwd_kernel(
 
 def _interleaved_fwd_kernel(
     stage_fn, sched: _InterleavedSchedule, axis_name, v: int, stage_params, x_mb,
-    side_mb=None,
+    side_mb=None, with_aux: bool = False, aux_extra_axes: tuple = (),
 ):
     """Forward-only interleaved pipeline (the primal of the interleaved loss): per tick
     every device forwards one (chunk, mb) per the static tables; activations ride ONE
     circular ppermute (device n-1 chunk c wraps to device 0 chunk c+1). ``side_mb``:
     per-microbatch constants (masks, segment ids, t5's enc_out) indexed by microbatch
-    id — the bwd kernel accumulates float-side cotangents; this primal just reads."""
+    id — the bwd kernel accumulates float-side cotangents; this primal just reads.
+    ``with_aux``: stage_fn returns (y, aux); live-tick auxes accumulate and psum —
+    M · n · v real (chunk-stage, microbatch) pairs, same total as the flat schedule's
+    M · n since each chunk holds 1/v of a flat stage's layers."""
     idx = lax.axis_index(axis_name)
     n = lax.axis_size(axis_name)
     M = x_mb.shape[0]
@@ -779,12 +797,13 @@ def _interleaved_fwd_kernel(
     out_buf0 = jnp.zeros_like(x_mb)
 
     def run(p, x, mb_id):
-        if side_mb is None:
-            return stage_fn(p, x)
-        return stage_fn(p, x, _mb_index(side_mb, mb_id))
+        args = (p, x) if side_mb is None else (p, x, _mb_index(side_mb, mb_id))
+        if with_aux:
+            return stage_fn(*args)
+        return stage_fn(*args), jnp.zeros((), jnp.float32)
 
     def tick(carry, rows):
-        recv, in_buf, out_buf = carry
+        recv, in_buf, out_buf, aux_acc = carry
         fc_r, fm_r, afc_r, afm_r = rows
         fc, fm = fc_r[idx], fm_r[idx]
         afc, afm = afc_r[idx], afm_r[idx]
@@ -807,7 +826,8 @@ def _interleaved_fwd_kernel(
         p_f = jax.tree_util.tree_map(
             lambda a: lax.dynamic_index_in_dim(a, fc_c, 0, False), p_local
         )
-        y = run(p_f, x_in, fm_c)
+        y, aux = run(p_f, x_in, fm_c)
+        aux_acc = aux_acc + jnp.where(fm >= 0, aux.astype(jnp.float32), 0.0)
         # 3) The LAST virtual stage (device n-1, chunk v-1) banks its output.
         bank = jnp.logical_and(
             fm >= 0, jnp.logical_and(idx == n - 1, fc_c == v - 1)
@@ -816,22 +836,29 @@ def _interleaved_fwd_kernel(
             bank, lax.dynamic_update_index_in_dim(out_buf, y, fm_c, 0), out_buf
         )
         recv = lax.ppermute(y, axis_name, perm)
-        return (recv, in_buf, out_buf), None
+        return (recv, in_buf, out_buf, aux_acc), None
 
     rows = (
         jnp.asarray(sched.f_c), jnp.asarray(sched.f_m),
         jnp.asarray(sched.af_c), jnp.asarray(sched.af_m),
     )
-    carry0 = (jnp.zeros(mb_shape, x_mb.dtype), in_buf0, out_buf0)
-    (_, _, out_buf), _ = lax.scan(tick, carry0, rows)
-    return lax.psum(
+    carry0 = (
+        jnp.zeros(mb_shape, x_mb.dtype), in_buf0, out_buf0,
+        jnp.zeros((), jnp.float32),
+    )
+    (_, _, out_buf, aux_acc), _ = lax.scan(tick, carry0, rows)
+    out = lax.psum(
         jnp.where(idx == n - 1, out_buf, jnp.zeros_like(out_buf)), axis_name
     )
+    if with_aux:
+        return out, _psum_mean_extra(aux_acc, axis_name, aux_extra_axes)
+    return out
 
 
 def _pipeline_interleaved_bwd_kernel(
     stage_fn, sched: _InterleavedSchedule, axis_name, v: int,
-    stage_params, x_mb, dy_mb, side_mb=None, extra_manual_axes=(),
+    stage_params, x_mb, dy_mb, aux_ct, side_mb=None, extra_manual_axes=(),
+    with_aux: bool = False,
 ):
     """Combined fwd+bwd interleaved-1F1B replay (virtual-pipeline analog of
     ``_pipeline_1f1b_bwd_kernel``): per tick one chunk forward and one chunk backward
@@ -864,9 +891,10 @@ def _pipeline_interleaved_bwd_kernel(
         )
 
     def run_with(p, x, side):
-        if side_mb is None:
-            return stage_fn(p, x)
-        return stage_fn(p, x, side)
+        args = (p, x) if side_mb is None else (p, x, side)
+        if with_aux:
+            return stage_fn(*args)
+        return stage_fn(*args), jnp.zeros((), jnp.float32)
 
     def run(p, x, mb_id):
         side = (
@@ -888,7 +916,10 @@ def _pipeline_interleaved_bwd_kernel(
                 None if side_mb is None
                 else _side_merge(side_treedef, side_is_f, sf_, si)
             )
-            return jnp.sum(run_with(p, x, side).astype(jnp.float32) * dy)
+            y, aux = run_with(p, x, side)
+            # MoE load-balancing aux contributes ct·aux_weight per real (chunk-stage,
+            # microbatch) pair, same as the flat replay.
+            return jnp.sum(y.astype(jnp.float32) * dy) + aux_ct * aux.astype(jnp.float32)
 
         dp, dx, ds = jax.grad(f, argnums=(0, 1, 2))(p, x_b, sf)
         return dp, dx.astype(jnp.float32), [d.astype(jnp.float32) for d in ds]
@@ -924,7 +955,7 @@ def _pipeline_interleaved_bwd_kernel(
             in_buf.at[fc_c, fm_c % sched.n_buf].set(x_in),
             in_buf,
         )
-        y = run(chunk_params(fc_c), x_in, fm_c)
+        y, _ = run(chunk_params(fc_c), x_in, fm_c)
 
         # 3) Backward one (chunk, mb) with remat; last virtual stage reads the head's
         # precomputed cotangent table, everything else the grad chain.
@@ -983,7 +1014,7 @@ def _pipeline_interleaved_bwd_kernel(
 
 def _make_interleaved_loss_fn(
     mesh, stage_fn, head_loss_fn, axis_name, M, v,
-    act_spec=None, extra_manual_axes=(),
+    act_spec=None, extra_manual_axes=(), with_aux: bool = False, aux_weight: float = 0.0,
 ):
     """Interleaved-1F1B loss: ``loss(stage_params, head_params, x, extras)`` with
     stage params chunk-stacked ``[v, n, L/(n·v), ...]`` (dim 1 over pp — device s hosts
@@ -1016,23 +1047,30 @@ def _make_interleaved_loss_fn(
             in_specs.append(P())
             args.append(_side_mb(side, B))
         mapped = jax.shard_map(
-            functools.partial(_interleaved_fwd_kernel, stage_fn, sched, axis_name, v),
+            functools.partial(
+                _interleaved_fwd_kernel, stage_fn, sched, axis_name, v,
+                with_aux=with_aux, aux_extra_axes=tuple(extra_manual_axes),
+            ),
             mesh=mesh,
             in_specs=tuple(in_specs),
-            out_specs=x_spec,
+            out_specs=(x_spec, P()) if with_aux else x_spec,
             axis_names=manual,
             check_vma=False,
         )
         out = mapped(*args)
-        return out.reshape(B, *out.shape[2:])
+        if with_aux:
+            out, aux = out
+            return out.reshape(B, *out.shape[2:]), aux
+        return out.reshape(B, *out.shape[2:]), jnp.zeros((), jnp.float32)
 
     @jax.custom_vjp
     def loss(stage_params, head_params, x, extras, side):
-        return head_loss_fn(head_params, fwd_pipe(stage_params, x, side), extras)
+        y, aux_total = fwd_pipe(stage_params, x, side)
+        return head_loss_fn(head_params, y, extras) + aux_weight * aux_total
 
     def loss_fwd(stage_params, head_params, x, extras, side):
-        y = fwd_pipe(stage_params, x, side)
-        return head_loss_fn(head_params, y, extras), (
+        y, aux_total = fwd_pipe(stage_params, x, side)
+        return head_loss_fn(head_params, y, extras) + aux_weight * aux_total, (
             stage_params, head_params, x, extras, side, y,
         )
 
@@ -1044,15 +1082,16 @@ def _make_interleaved_loss_fn(
         )[1](jnp.asarray(ct, jnp.float32))
         dy_mb = dy.astype(jnp.float32).reshape(M, B // M, *y.shape[1:])
         x_mb = x.reshape(M, B // M, *x.shape[1:])
-        in_specs = [specs_of(stage_params), x_spec, x_spec]
-        args = [stage_params, x_mb, dy_mb]
+        aux_ct = _aux_cotangent(ct, aux_weight, mesh, extra_manual_axes)
+        in_specs = [specs_of(stage_params), x_spec, x_spec, P()]
+        args = [stage_params, x_mb, dy_mb, aux_ct]
         if side:
             in_specs.append(P())
             args.append(_side_mb(side, B))
         mapped = jax.shard_map(
             functools.partial(
                 _pipeline_interleaved_bwd_kernel, stage_fn, sched, axis_name, v,
-                extra_manual_axes=tuple(extra_manual_axes),
+                extra_manual_axes=tuple(extra_manual_axes), with_aux=with_aux,
             ),
             mesh=mesh,
             in_specs=tuple(in_specs),
@@ -1143,14 +1182,14 @@ def make_pipeline_loss_fn(
         # Interleaved/virtual pipeline (Megatron virtual_pipeline analog, reference
         # dataclasses.py:2024): stage params in the [v, n_stages, L/(n·v), ...] layout
         # of ``split_params_into_stages(..., virtual_stages=v)``.
-        if schedule != "1f1b" or with_aux:
+        if schedule != "1f1b":
             raise NotImplementedError(
-                "virtual_stages > 1 requires schedule='1f1b' and does not compose "
-                "with MoE aux yet"
+                "virtual_stages > 1 requires schedule='1f1b'"
             )
         return _make_interleaved_loss_fn(
             mesh, stage_fn, head_loss_fn, axis_name, M, virtual_stages,
             act_spec=act_spec, extra_manual_axes=extra_manual_axes,
+            with_aux=with_aux, aux_weight=aux_weight,
         )
 
     pipe = make_pipeline_fn(
@@ -1210,14 +1249,7 @@ def make_pipeline_loss_fn(
             _pipeline_1f1b_bwd_kernel, stage_fn, sched, axis_name, with_aux,
             extra_manual_axes=tuple(extra_manual_axes),
         )
-        # Under extra manual axes (sp), the primal's aux is the MEAN over members
-        # (pipeline_apply aux_extra_axes) while the replay's dp psum over sp SUMS the
-        # per-member aux contributions — scale the cotangent down by the member count
-        # so the two compose to the same gradient.
-        extra_size = 1
-        for a in extra_manual_axes:
-            extra_size *= mesh.shape[a]
-        aux_ct = jnp.asarray(ct, jnp.float32) * aux_weight / extra_size
+        aux_ct = _aux_cotangent(ct, aux_weight, mesh, extra_manual_axes)
         in_specs = [specs_params, x_spec, x_spec, P()]
         args = [stage_params, x_mb, dy_mb, aux_ct]
         if side:

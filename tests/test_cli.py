@@ -140,12 +140,18 @@ def test_pp_schedule_wire_protocol(monkeypatch):
         _ = acc.virtual_stages
 
     # Launcher-side validation: the env-only path never constructs the plugin, so the
-    # launcher must reject the invalid combo up front, not deep in the training job.
+    # launcher must reject the invalid combo up front, not deep in the training job —
+    # via the flag AND via a bare env var (clear the 1f1b env set above first).
     from accelerate_tpu.commands.launch import launch_command
 
+    monkeypatch.delenv("ACCELERATE_PP_SCHEDULE")
     bad = _launch_args(["--pp", "2", "--pp-virtual-stages", "2"])
     with pytest.raises(SystemExit, match="1f1b"):
         launch_command(bad)
+    monkeypatch.setenv("ACCELERATE_PP_VIRTUAL_STAGES", "2")
+    bad_env = _launch_args(["--pp", "2"])
+    with pytest.raises(SystemExit, match="1f1b"):
+        launch_command(bad_env)
 
 
 def test_virtual_device_env():

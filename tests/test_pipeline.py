@@ -906,6 +906,116 @@ def test_llama_pp_sp_interleaved_matches_single(mode):
 
 
 @slow
+def test_llama_pp_moe_interleaved_matches_single():
+    """MoE through the interleaved pipeline: exact CE parity in the no-drop regime
+    with aux_weight=0, and the aux term at ~1x the non-pipelined scale with a real
+    weight (aux accumulates over M * n * v live (chunk-stage, microbatch) pairs)."""
+    import dataclasses as _dc
+
+    from accelerate_tpu.models import llama
+
+    cfg = _dc.replace(
+        llama.CONFIGS["moe-tiny"], dtype=jnp.float32, attn_impl="xla", scan_layers=True,
+        n_layers=4, moe_aux_weight=0.0, moe_capacity_factor=8.0,
+    )
+    params = llama.init_params(cfg)
+    batch = {"tokens": jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 17)), jnp.int32)}
+    base = float(llama.loss_fn(params, batch, cfg))
+    base_g = jax.grad(lambda p: llama.loss_fn(p, batch, cfg))(params)
+
+    mesh = build_mesh(MeshConfig(dp=2, ep=2, pp=2))
+    sp = dict(params)
+    sp["layers"] = split_params_into_stages(params["layers"], 2, virtual_stages=2)
+    with jax.set_mesh(mesh):
+        l, g = jax.jit(jax.value_and_grad(
+            lambda p, b: llama.loss_fn_pp(
+                p, b, cfg, mesh, num_microbatches=4, schedule="1f1b",
+                virtual_stages=2)
+        ))(sp, batch)
+    np.testing.assert_allclose(float(l), base, rtol=1e-5)
+    expected = dict(base_g)
+    expected["layers"] = split_params_into_stages(base_g["layers"], 2, virtual_stages=2)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5
+        ),
+        dict(g), expected,
+    )
+
+    # Aux scale with a real weight stays ~1x the non-pipelined value, and the router
+    # weights get nonzero grads THROUGH the interleaved replay's aux_ct term (they
+    # also touch the loss via CE, so check the aux-specific DELTA of the router grad).
+    cfg_aux = _dc.replace(cfg, moe_aux_weight=1.0)
+    base_aux_term = float(llama.loss_fn(params, batch, cfg_aux)) - base
+    with jax.set_mesh(mesh):
+        l_aux, g_aux = jax.jit(jax.value_and_grad(
+            lambda p, b: llama.loss_fn_pp(
+                p, b, cfg_aux, mesh, num_microbatches=4, schedule="1f1b",
+                virtual_stages=2)
+        ))(sp, batch)
+    ratio = (float(l_aux) - float(l)) / base_aux_term
+    assert 0.7 < ratio < 1.4, f"aux scale ratio {ratio}"
+    router_delta = np.abs(
+        np.asarray(g_aux["layers"]["moe"]["w_gate"])
+        - np.asarray(g["layers"]["moe"]["w_gate"])
+    ).max()
+    assert router_delta > 1e-6, "aux cotangent dropped from the interleaved replay"
+
+
+@slow
+def test_llama_pp_moe_sp_interleaved_matches_single():
+    """The full stack in one job: MoE x sp-attention x interleaved virtual pipeline
+    (with_aux + extra_manual_axes + v>1 together — the aux psum-mean over sp and the
+    /sp aux cotangent interact only here). Exact CE parity in the no-drop regime."""
+    import dataclasses as _dc
+
+    from accelerate_tpu.models import llama
+
+    cfg = _dc.replace(
+        llama.CONFIGS["moe-tiny"], dtype=jnp.float32, attn_impl="ring", scan_layers=True,
+        n_layers=8, moe_aux_weight=0.0, moe_capacity_factor=8.0,
+    )
+    params = llama.init_params(cfg)
+    batch = {"tokens": jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 17)), jnp.int32)}
+    base = float(llama.loss_fn(params, batch, cfg))
+    base_g = jax.grad(lambda p: llama.loss_fn(p, batch, cfg))(params)
+
+    sp = dict(params)
+    sp["layers"] = split_params_into_stages(params["layers"], 2, virtual_stages=2)
+    mesh = build_mesh(MeshConfig(dp=2, sp=2, pp=2))
+    with jax.set_mesh(mesh):
+        l, g = jax.jit(jax.value_and_grad(
+            lambda p, b: llama.loss_fn_pp(
+                p, b, cfg, mesh, num_microbatches=4, schedule="1f1b",
+                virtual_stages=2)
+        ))(sp, batch)
+    np.testing.assert_allclose(float(l), base, rtol=1e-5)
+    expected = dict(base_g)
+    expected["layers"] = split_params_into_stages(base_g["layers"], 2, virtual_stages=2)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5
+        ),
+        dict(g), expected,
+    )
+
+    # Aux scale: with the /sp cotangent and psum-mean both active, the aux term still
+    # reads ~1x (a double /sp would read ~0.5x, a missing one ~2x).
+    cfg_aux = _dc.replace(cfg, moe_aux_weight=1.0)
+    base_aux_term = float(llama.loss_fn(params, batch, cfg_aux)) - base
+    with jax.set_mesh(mesh):
+        l_aux = jax.jit(
+            lambda p, b: llama.loss_fn_pp(
+                p, b, cfg_aux, mesh, num_microbatches=4, schedule="1f1b",
+                virtual_stages=2)
+        )(sp, batch)
+    ratio = (float(l_aux) - float(l)) / base_aux_term
+    assert 0.7 < ratio < 1.4, f"aux scale ratio {ratio}"
+
+
+@slow
 def test_gpt_pp_interleaved_matches_single():
     """gpt carries virtual_stages too (llama is not special): pp=2 v=2 strided chunks
     under 1f1b match the non-pipelined run."""
