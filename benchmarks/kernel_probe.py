@@ -1,0 +1,103 @@
+"""Tiny-shape compile probes for the fused Pallas kernels, on the real chip.
+
+Purpose: the 2026-08-01 tunnel window died with `opt_fused_adamw` failing at
+remote-compile (HTTP 500 from the axon tpu_compile_helper) while the plain flash
+config compiled fine in earlier windows.  That leaves two hypotheses:
+(a) the fused-AdamW Pallas program crashes the compile helper (program-specific), or
+(b) the tunnel was already degrading when the row ran (transient).
+
+This probe answers it in ~2 chip-minutes instead of burning a 15-minute sweep row
+per kernel: compile + run each fused kernel at tiny shapes and print one verdict
+line per kernel.  Run FIRST in any new tunnel window, right after the fresh
+scoring run.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+_here = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _here)
+sys.path.insert(0, os.path.dirname(_here))
+
+from bench_timing import enable_compile_cache  # noqa: E402
+
+enable_compile_cache(os.path.dirname(_here))
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+
+def _verdict(name: str, fn) -> bool:
+    try:
+        fn()
+        print(f"kernel_probe {name}: OK")
+        return True
+    except Exception as e:  # noqa: BLE001 — verdict line must always print
+        line = str(e).strip().splitlines()
+        print(f"kernel_probe {name}: FAIL ({type(e).__name__}: {line[0] if line else ''})")
+        traceback.print_exc(file=sys.stderr)
+        return False
+
+
+def probe_fused_adamw() -> None:
+    from accelerate_tpu.ops.fused_optim import FusedAdamW
+
+    opt = FusedAdamW(learning_rate=1e-3)
+    params = {"w": jnp.ones((512, 256), jnp.float32), "b": jnp.zeros((256,), jnp.float32)}
+    state = opt.init(params)
+    grads = jax.tree.map(lambda p: jnp.full_like(p, 0.01), params)
+
+    @jax.jit
+    def step(g, s, p):
+        return opt.fused_apply(g, s, p)
+
+    new_params, _ = step(grads, state, params)
+    jax.block_until_ready(new_params)
+    np.testing.assert_array_less(np.asarray(new_params["w"])[0, 0], 1.0)
+
+
+def probe_fused_xent() -> None:
+    from accelerate_tpu.ops.fused_xent import fused_cross_entropy
+
+    x = jnp.ones((256, 128), jnp.bfloat16) * 0.1
+    w = jnp.ones((128, 512), jnp.bfloat16) * 0.02
+    t = jnp.zeros((256,), jnp.int32)
+
+    @jax.jit
+    def loss_and_grad(x, w, t):
+        def f(x, w):
+            return fused_cross_entropy(x, w, t).mean()
+
+        l, g = jax.value_and_grad(f, argnums=(0, 1))(x, w)
+        return l, g
+
+    l, _ = loss_and_grad(x, w, t)
+    jax.block_until_ready(l)
+    assert np.isfinite(float(l))
+
+
+def probe_flash() -> None:
+    from accelerate_tpu.ops.flash_attention import flash_attention
+
+    q = jnp.ones((1, 512, 4, 64), jnp.bfloat16) * 0.1
+    o = jax.jit(lambda q: flash_attention(q, q, q, causal=True))(q)
+    jax.block_until_ready(o)
+
+
+def main() -> int:
+    print(f"devices: {jax.devices()}")
+    results = {
+        "fused_adamw": _verdict("fused_adamw", probe_fused_adamw),
+        "fused_xent": _verdict("fused_xent", probe_fused_xent),
+        "flash": _verdict("flash", probe_flash),
+    }
+    print(f"kernel_probe summary: {results}")
+    return 0 if all(results.values()) else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

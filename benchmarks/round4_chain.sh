@@ -1,0 +1,12 @@
+#!/bin/bash
+# Round-4 relaunch of the armed session chain (pollers died in the round reset).
+# Single claimant for the TPU window; each stage tolerates tunnel death internally.
+set -u
+cd "$(dirname "$0")/.."
+echo "=== round4 chain start: $(date -u) ==="
+bash benchmarks/tpu_session2.sh
+bash benchmarks/inference_session.sh
+bash benchmarks/tpu_session3.sh
+bash benchmarks/tpu_session4.sh
+bash benchmarks/tpu_session5.sh
+echo "=== round4 chain done: $(date -u) ==="

@@ -1,0 +1,63 @@
+#!/bin/bash
+# Round-4 window plan, v2 — reordered after the 2026-08-01 08:30–08:47 UTC window died
+# with zero rows landed (fused-adamw remote-compile HTTP 500, loss_fused compile hang,
+# then tunnel gone).  Lesson: two consecutive windows spent their first minutes on
+# never-before-compiled programs and closed before ANY fresh number landed.  This
+# ordering locks the cheapest fresh evidence first:
+#   1. bench.py on the ADOPTED config (compiled successfully in the r2 window) — a
+#      fresh, non-cached BENCH row with today's timestamp, ~5 min.
+#   2. kernel_probe.py — tiny-shape compile verdict on fused_adamw / fused_xent /
+#      flash (~2 min each): answers whether the HTTP 500 is program-specific.
+#   3. the fused-kernel sweep rows (the candidate 2x lever) + adopt-best scoring run.
+#   4. big-model inference table (gptj-6b in-HBM first — the cheapest row).
+#   5. decompose (fused isolation + attn jaxref A/B verdict) + step_attrib.
+#   6. nlp_bench north-star row + RESULTS.md assembly.
+#   7. remaining attribution/combo rows incl. r4 fp8-state, then final adopt-best run.
+# Each sweep stage re-polls for the TPU, so the chain survives tunnel flaps.
+set -u
+cd "$(dirname "$0")/.."
+echo "=== round4 chain2 start: $(date -u) ==="
+
+wait_tpu() {
+  python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+}
+
+echo "=== 0. waiting for TPU ==="
+wait_tpu
+
+echo "=== 1. fresh scoring run (adopted config) ==="
+timeout 900 python bench.py
+echo "bench rc=$?"
+
+echo "=== 2. kernel compile probes ==="
+timeout 600 python benchmarks/kernel_probe.py
+echo "probe rc=$?"
+
+echo "=== 3. fused-kernel rows ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
+  --only blocks512_fused_adamw,opt_fused_adamw,blocks512_loss_fused,loss_fused,r3_fused_all,r3_fused_all_blocks512
+echo "=== 3b. adopt-best scoring run ==="
+timeout 900 python bench.py
+
+echo "=== 4. big-model inference table ==="
+ROW_TIMEOUT=1500 bash benchmarks/inference_session.sh
+
+echo "=== 5. decompose + step_attrib ==="
+wait_tpu
+timeout 1800 python benchmarks/decompose.py > decompose4.json 2>decompose4.err
+echo "decompose rc=$?"; grep -a "opt_\|xent_\|attn_" decompose4.json | head -8
+timeout 1200 python benchmarks/step_attrib.py > step_attrib4.json 2>step_attrib4.err
+echo "step_attrib rc=$?"
+
+echo "=== 6. nlp north-star row ==="
+wait_tpu
+timeout 900 python benchmarks/nlp_bench.py
+echo "nlp rc=$?"
+python benchmarks/big_model_inference/collect_results.py || true
+
+echo "=== 7. remaining rows ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
+  --only r4_opt_f8_state,r4_opt_f8_state_b8,b2,accum4_b2,opt_sgd,opt_mu_bf16,blocks512_lc1024,blocks512_mu_bf16,r3_fused_all_b8,r3_fused_all_mu_bf16,dimsem_off,blocks_512x512
+echo "=== 7b. final adopt-best scoring run (with profile) ==="
+BENCH_PROFILE=bench_trace timeout 900 python bench.py
+echo "=== round4 chain2 done: $(date -u) ==="

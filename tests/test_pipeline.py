@@ -957,8 +957,8 @@ def test_llama_pp_moe_interleaved_matches_single():
     ratio = (float(l_aux) - float(l)) / base_aux_term
     assert 0.7 < ratio < 1.4, f"aux scale ratio {ratio}"
     router_delta = np.abs(
-        np.asarray(g_aux["layers"]["moe"]["w_gate"])
-        - np.asarray(g["layers"]["moe"]["w_gate"])
+        np.asarray(g_aux["layers"]["moe"]["w_router"])
+        - np.asarray(g["layers"]["moe"]["w_router"])
     ).max()
     assert router_delta > 1e-6, "aux cotangent dropped from the interleaved replay"
 
