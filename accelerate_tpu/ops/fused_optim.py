@@ -240,6 +240,11 @@ class FusedAdamW:
     nu_dtype: Optional[Any] = None
     block_rows: int = 512
     interpret: Optional[bool] = None
+    # ``False`` routes every leaf of ``fused_apply`` through the identical-math XLA
+    # update (``_leaf_xla``) while keeping the single-call donation/shard_map framing —
+    # an A/B lever for transports whose compile service rejects the Pallas program
+    # (2026-08-01 window: remote-compile HTTP 500 on the kernel, flash compiled fine).
+    use_kernel: Optional[bool] = None
 
     # -------------------------------------------------------------- optax-compatible API
     def init(self, params):
@@ -367,7 +372,7 @@ class FusedAdamW:
 
         def local(sc, p, m, v, g):
             # Kernel-vs-fallback decided on the LOCAL (per-shard) shape.
-            if p.size % _LANES == 0 and p.size > 0:
+            if self.use_kernel is not False and p.size % _LANES == 0 and p.size > 0:
                 return _leaf_fused(
                     p, m, v, g, sc,
                     block_rows=self.block_rows, interpret=interpret, **kw,
@@ -437,13 +442,17 @@ def fused_adamw(
     weight_decay: float = 1e-4,
     mu_dtype=None,
     nu_dtype=None,
+    use_kernel: Optional[bool] = None,
 ) -> FusedAdamW:
     """``optax.adamw``-shaped constructor for the fused kernel optimizer.
 
     ``mu_dtype``/``nu_dtype`` accept ``jnp.bfloat16`` (plain low-precision moment) or
     ``jnp.float8_e4m3fn``/``float8_e5m2`` (scaled-fp8 moment with a per-tensor scale in
-    :class:`ScaledAdamState` — the MS-AMP low-precision-optimizer-state analog)."""
+    :class:`ScaledAdamState` — the MS-AMP low-precision-optimizer-state analog).
+    ``use_kernel=False`` keeps the fused_apply structure but runs the identical-math
+    XLA update on every leaf (no Pallas program — see FusedAdamW.use_kernel)."""
     return FusedAdamW(
         learning_rate=learning_rate, b1=b1, b2=b2, eps=eps,
         weight_decay=weight_decay, mu_dtype=mu_dtype, nu_dtype=nu_dtype,
+        use_kernel=use_kernel,
     )

@@ -270,6 +270,10 @@ def _make_optimizer(name: str):
         "adamw": lambda: optax.adamw(1e-4),
         "adamw_mu_bf16": lambda: optax.adamw(1e-4, mu_dtype=jnp.bfloat16),
         "fused_adamw": lambda: fused_adamw(1e-4),
+        # Identical AdamW math through fused_apply's donation framing but with the
+        # Pallas kernel disabled (pure XLA per leaf) — insurance row for transports
+        # whose compile helper rejects the Pallas program (r4 window 1 HTTP 500).
+        "fused_adamw_xla": lambda: fused_adamw(1e-4, use_kernel=False),
         "fused_adamw_mu_bf16": lambda: fused_adamw(1e-4, mu_dtype=jnp.bfloat16),
         # MS-AMP analog: scaled-fp8 moments (ScaledAdamState) — 4x less moment traffic
         # in the bandwidth-bound apply; state dtype changes the update trajectory, so
@@ -435,7 +439,7 @@ def _metric_label(B: int, S: int, fuse: int, preset: str | None, cfg=None) -> st
     # — same workload, same metric series, so it keeps the default label and the tracked
     # b4/seq2048 history stays comparable when the scoring run adopts it from a sweep.
     opt = os.environ.get("BENCH_OPT", "adamw")
-    opt_tag = "" if opt in ("adamw", "fused_adamw") else f" {opt}"
+    opt_tag = "" if opt in ("adamw", "fused_adamw", "fused_adamw_xla") else f" {opt}"
     accum = os.environ.get("BENCH_ACCUM", "1")
     accum_tag = "" if accum == "1" else f" accum{accum}"  # workload change: labeled
     return (
@@ -457,7 +461,7 @@ _TUNING_KNOBS = {
 # BENCH_OPT is workload-changing in general (sgd/adafactor/mu_bf16 alter the update rule
 # or its state dtype) — EXCEPT "fused_adamw", which is the identical AdamW math as a
 # Pallas kernel: a pure implementation swap, adoptable like BENCH_LOSS_IMPL.
-_ADOPTABLE_VALUES = {"BENCH_OPT": {"fused_adamw"}}
+_ADOPTABLE_VALUES = {"BENCH_OPT": {"fused_adamw", "fused_adamw_xla"}}
 
 
 def _env_adoptable(env: dict) -> bool:

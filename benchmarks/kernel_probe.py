@@ -6,15 +6,25 @@ config compiled fine in earlier windows.  That leaves two hypotheses:
 (a) the fused-AdamW Pallas program crashes the compile helper (program-specific), or
 (b) the tunnel was already degrading when the row ran (transient).
 
-This probe answers it in ~2 chip-minutes instead of burning a 15-minute sweep row
-per kernel: compile + run each fused kernel at tiny shapes and print one verdict
-line per kernel.  Run FIRST in any new tunnel window, right after the fresh
-scoring run.
+This probe answers it in a few chip-minutes instead of burning a 15-minute sweep
+row per kernel: compile + run each fused kernel at tiny shapes and print one
+verdict line per kernel.  Run FIRST in any new tunnel window, right after the
+fresh scoring run.
+
+Each probe runs in its OWN subprocess with its own timeout: the observed failure
+modes include compile HANGS (loss_fused hung 870 s in the same window), and a hang
+in probe 1 must not starve the remaining verdicts.  All verdict lines are flushed
+immediately so an outer `timeout` killing the process cannot eat completed results.
+
+Usage:
+  python benchmarks/kernel_probe.py               # all probes, subprocess-isolated
+  python benchmarks/kernel_probe.py --one flash   # a single probe, in-process
 """
 
 from __future__ import annotations
 
 import os
+import subprocess
 import sys
 import traceback
 
@@ -26,24 +36,14 @@ from bench_timing import enable_compile_cache  # noqa: E402
 
 enable_compile_cache(os.path.dirname(_here))
 
-import jax  # noqa: E402
-import jax.numpy as jnp  # noqa: E402
-import numpy as np  # noqa: E402
-
-
-def _verdict(name: str, fn) -> bool:
-    try:
-        fn()
-        print(f"kernel_probe {name}: OK")
-        return True
-    except Exception as e:  # noqa: BLE001 — verdict line must always print
-        line = str(e).strip().splitlines()
-        print(f"kernel_probe {name}: FAIL ({type(e).__name__}: {line[0] if line else ''})")
-        traceback.print_exc(file=sys.stderr)
-        return False
+PER_PROBE_TIMEOUT_S = int(os.environ.get("KERNEL_PROBE_TIMEOUT_S", "240"))
 
 
 def probe_fused_adamw() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     from accelerate_tpu.ops.fused_optim import FusedAdamW
 
     opt = FusedAdamW(learning_rate=1e-3)
@@ -61,6 +61,10 @@ def probe_fused_adamw() -> None:
 
 
 def probe_fused_xent() -> None:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
     from accelerate_tpu.ops.fused_xent import fused_cross_entropy
 
     x = jnp.ones((256, 128), jnp.bfloat16) * 0.1
@@ -81,6 +85,9 @@ def probe_fused_xent() -> None:
 
 
 def probe_flash() -> None:
+    import jax
+    import jax.numpy as jnp
+
     from accelerate_tpu.ops.flash_attention import flash_attention
 
     q = jnp.ones((1, 512, 4, 64), jnp.bfloat16) * 0.1
@@ -88,15 +95,50 @@ def probe_flash() -> None:
     jax.block_until_ready(o)
 
 
+PROBES = {
+    "fused_adamw": probe_fused_adamw,
+    "fused_xent": probe_fused_xent,
+    "flash": probe_flash,
+}
+
+
+def _run_one_inprocess(name: str) -> int:
+    try:
+        PROBES[name]()
+        print(f"kernel_probe {name}: OK", flush=True)
+        return 0
+    except Exception as e:  # noqa: BLE001 — verdict line must always print
+        line = str(e).strip().splitlines()
+        print(
+            f"kernel_probe {name}: FAIL ({type(e).__name__}: {line[0] if line else ''})",
+            flush=True,
+        )
+        traceback.print_exc(file=sys.stderr)
+        sys.stderr.flush()
+        return 1
+
+
 def main() -> int:
-    print(f"devices: {jax.devices()}")
-    results = {
-        "fused_adamw": _verdict("fused_adamw", probe_fused_adamw),
-        "fused_xent": _verdict("fused_xent", probe_fused_xent),
-        "flash": _verdict("flash", probe_flash),
-    }
-    print(f"kernel_probe summary: {results}")
-    return 0 if all(results.values()) else 1
+    if len(sys.argv) == 3 and sys.argv[1] == "--one":
+        return _run_one_inprocess(sys.argv[2])
+
+    results = {}
+    for name in PROBES:
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--one", name],
+                timeout=PER_PROBE_TIMEOUT_S,
+            )
+            results[name] = "ok" if proc.returncode == 0 else "fail"
+        except subprocess.TimeoutExpired:
+            print(
+                f"kernel_probe {name}: HANG (no verdict within {PER_PROBE_TIMEOUT_S}s"
+                " — killed; same failure mode as the loss_fused compile hang)",
+                flush=True,
+            )
+            results[name] = "hang"
+    print(f"kernel_probe summary: {results}", flush=True)
+    return 0 if all(v == "ok" for v in results.values()) else 1
 
 
 if __name__ == "__main__":

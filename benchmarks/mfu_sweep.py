@@ -83,6 +83,14 @@ CONFIGS = [
                           "ACCEL_FLASH_DIMSEM": "1"}),
     ("blocks512_fused_adamw", {"ACCEL_FLASH_BLOCK_Q": "512", "ACCEL_FLASH_BLOCK_K": "512",
                                "BENCH_OPT": "fused_adamw"}),
+    # Identical AdamW math through fused_apply's donation framing with the Pallas
+    # kernel disabled (pure XLA per leaf): insurance rows for the r4 window-1 failure
+    # mode where the remote compile helper 500s on the Pallas optimizer program.
+    # Adoptable (bench._ADOPTABLE_VALUES) — same math, same metric series.
+    ("opt_fused_adamw_xla", {"BENCH_OPT": "fused_adamw_xla"}),
+    ("blocks512_fused_adamw_xla", {"ACCEL_FLASH_BLOCK_Q": "512",
+                                   "ACCEL_FLASH_BLOCK_K": "512",
+                                   "BENCH_OPT": "fused_adamw_xla"}),
     # --- round-3 wave: restructured flash kernel (lane-replicated softmax state,
     # mask-free interior tiles, parallel grid semantics ON by default, cost estimates).
     # dimsem_off measures the r2 behavior for A/B; the *_r3 combos stack the restructured

@@ -1,0 +1,57 @@
+#!/bin/bash
+# Round-4 window plan, v3 (supersedes round4_chain2.sh — killed while still polling,
+# never edit a running bash script).  Changes from v2:
+#   - stage 3 and stage 7 include the opt_fused_adamw_xla / blocks512_fused_adamw_xla
+#     insurance rows (identical AdamW math, fused_apply framing, NO Pallas program —
+#     adoptable, so stage 3b/7b can lock them in if the Pallas rows keep 500ing).
+#   - kernel_probe.py now isolates each probe in its own subprocess with a per-probe
+#     timeout and flushed verdicts, so one compile hang can't starve the others.
+# Ordering rationale unchanged (see round4_chain2.sh header): cheapest fresh evidence
+# first, then verdicts, then the levers, then the tables.
+set -u
+cd "$(dirname "$0")/.."
+echo "=== round4 chain3 start: $(date -u) ==="
+
+wait_tpu() {
+  python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+}
+
+echo "=== 0. waiting for TPU ==="
+wait_tpu
+
+echo "=== 1. fresh scoring run (adopted config) ==="
+timeout 900 python bench.py
+echo "bench rc=$?"
+
+echo "=== 2. kernel compile probes ==="
+timeout 900 python benchmarks/kernel_probe.py
+echo "probe rc=$?"
+
+echo "=== 3. fused-kernel + xla-insurance rows ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
+  --only opt_fused_adamw_xla,blocks512_fused_adamw_xla,blocks512_fused_adamw,opt_fused_adamw,blocks512_loss_fused,loss_fused,r3_fused_all,r3_fused_all_blocks512
+echo "=== 3b. adopt-best scoring run ==="
+timeout 900 python bench.py
+
+echo "=== 4. big-model inference table ==="
+ROW_TIMEOUT=1500 bash benchmarks/inference_session.sh
+
+echo "=== 5. decompose + step_attrib ==="
+wait_tpu
+timeout 1800 python benchmarks/decompose.py > decompose4.json 2>decompose4.err
+echo "decompose rc=$?"; grep -a "opt_\|xent_\|attn_" decompose4.json | head -8
+timeout 1200 python benchmarks/step_attrib.py > step_attrib4.json 2>step_attrib4.err
+echo "step_attrib rc=$?"
+
+echo "=== 6. nlp north-star row ==="
+wait_tpu
+timeout 900 python benchmarks/nlp_bench.py
+echo "nlp rc=$?"
+python benchmarks/big_model_inference/collect_results.py || true
+
+echo "=== 7. remaining rows ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
+  --only r4_opt_f8_state,r4_opt_f8_state_b8,b2,accum4_b2,opt_sgd,opt_mu_bf16,blocks512_lc1024,blocks512_mu_bf16,r3_fused_all_b8,r3_fused_all_mu_bf16,dimsem_off,blocks_512x512
+echo "=== 7b. final adopt-best scoring run (with profile) ==="
+BENCH_PROFILE=bench_trace timeout 900 python bench.py
+echo "=== round4 chain3 done: $(date -u) ==="
