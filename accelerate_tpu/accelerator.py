@@ -412,6 +412,7 @@ class Accelerator:
         self._max_grad_norm: Optional[float] = (
             float(self._megatron_grad_clip) if self._megatron_grad_clip is not None else None
         )
+        self._max_grad_value: Optional[float] = None
         self._models: list = []
         self._optimizers: list[AcceleratedOptimizer] = []
         self._schedulers: list = []
@@ -831,6 +832,7 @@ class Accelerator:
         loss_fn: Callable,
         optimizer: Optional[Union[AcceleratedOptimizer, Any]] = None,
         max_grad_norm: Optional[float] = None,
+        max_grad_value: Optional[float] = None,
         has_aux: bool = False,
         donate: bool = True,
         fused_steps: int = 1,
@@ -859,6 +861,8 @@ class Accelerator:
         policy = self.mixed_precision_policy
         if max_grad_norm is None:
             max_grad_norm = self._max_grad_norm
+        if max_grad_value is None:
+            max_grad_value = self._max_grad_value
         accum_steps = self.gradient_accumulation_steps
         wants_rng = _loss_fn_wants_rng(loss_fn)
         # Low-precision cross-device gradient reduction (DDP comm-hook analog): honored
@@ -1015,6 +1019,16 @@ class Accelerator:
                     if self.mesh is not None and self.mesh.size > 1:
                         fused_opt = None
             grad_scale = None
+            if max_grad_value is not None:
+                # Elementwise clamp BEFORE the norm clip (a torch user calls
+                # clip_grad_value_ then clip_grad_norm_ in that order between backward
+                # and step; the norm below is the norm of the clamped tree). Unlike the
+                # norm clip this cannot fold into the fused apply's scalar grad_scale —
+                # it materializes a clipped tree either way.
+                v = jnp.asarray(max_grad_value, jnp.float32)
+                grads = jax.tree_util.tree_map(
+                    lambda g: jnp.clip(g, -v.astype(g.dtype), v.astype(g.dtype)), grads
+                )
             if max_grad_norm is not None:
                 gnorm = _global_norm(grads)
                 scale = jnp.minimum(1.0, max_grad_norm / (gnorm + 1e-6))
@@ -1246,8 +1260,13 @@ class Accelerator:
         (reference ``:2485``; returns None — the realized norm is in step metrics)."""
         self._max_grad_norm = float(max_grad_norm)
 
-    def clip_grad_value_(self, *args, **kwargs):
-        raise NotImplementedError("Use clip_grad_norm_; value clipping is not yet implemented.")
+    def clip_grad_value_(self, clip_value: float):
+        """Record an elementwise gradient clamp to ``[-clip_value, clip_value]`` applied
+        inside subsequently-built train steps (reference ``accelerator.py:2542``
+        ``clip_grad_value_`` → ``torch.nn.utils.clip_grad_value_``; here the clamp is
+        traced into the step, before any ``clip_grad_norm_`` norm scaling — the order a
+        torch user would call the pair in)."""
+        self._max_grad_value = float(clip_value)
 
     # ---------------------------------------------------------------------- metrics / ops
     def set_trigger(self):

@@ -241,6 +241,32 @@ def test_clip_grad_norm_in_step():
     assert delta <= 2e-4
 
 
+def test_clip_grad_value_in_step():
+    """clip_grad_value_ (reference accelerator.py:2542): elementwise clamp traced into
+    the step, exact parity with manually clamping the grad tree before the sgd apply."""
+    acc = make_accelerator()
+    acc.clip_grad_value_(1e-3)
+    ds = RegressionDataset(16)
+    dl = acc.prepare(DataLoader(ds, batch_size=16))
+    p0 = init_params()
+    state = acc.create_train_state(p0, optax.sgd(1.0))
+    step = acc.build_train_step(loss_fn)
+    batch = next(iter(dl))
+    state, _ = step(state, batch)
+    # manual reference: same grads, clamped, applied with the same sgd
+    ref_p = acc.prepare_params(init_params())
+    g = jax.grad(loss_fn)(ref_p, batch)
+    g = jax.tree_util.tree_map(lambda x: jnp.clip(x, -1e-3, 1e-3), g)
+    ref_p = jax.tree_util.tree_map(lambda p, gg: p - 1.0 * gg, ref_p, g)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(state.params), jax.tree_util.tree_leaves(ref_p)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-7)
+    # and every element moved at most clip_value (sgd lr 1.0)
+    delta = float(jnp.max(jnp.abs(state.params["w"] - acc.prepare_params(p0)["w"])))
+    assert delta <= 1e-3 + 1e-7
+
+
 def test_mixed_precision_bf16_compute():
     acc = make_accelerator(mixed_precision="bf16")
     seen_dtypes = {}
