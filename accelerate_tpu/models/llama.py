@@ -490,12 +490,14 @@ def _attention(q, k, v, mask, cfg: LlamaConfig, segment_ids=None):
             if _sp_manual(mesh):
                 # Already inside a manual-sp shard_map (the pipeline made sp manual):
                 # issue the ring/ulysses collectives directly — one flat shard_map.
+                # segment_ids here are the LOCAL sequence slice (the caller sliced
+                # activations and sides alike).
                 from ..parallel.sequence import sequence_parallel_attention
 
                 return sequence_parallel_attention(
                     q, k, v, mode=impl, axis_name=SEQUENCE_AXIS, causal=True,
                     window=cfg.sliding_window, softcap=cfg.attn_softcap,
-                    sm_scale=_sm_scale(cfg),
+                    sm_scale=_sm_scale(cfg), segment_ids=segment_ids,
                 )
             from ..parallel.sequence import make_sp_attention
 
@@ -504,7 +506,9 @@ def _attention(q, k, v, mask, cfg: LlamaConfig, segment_ids=None):
                 window=cfg.sliding_window, softcap=cfg.attn_softcap,
                 sm_scale=_sm_scale(cfg),
             )
-            return attn(q, k, v)
+            # Packed rows ride along: the GLOBAL [B, S] segment ids shard over sp inside
+            # make_sp_attention (ring rotates the kv slice, ulysses/allgather gather).
+            return attn(q, k, v, segment_ids=segment_ids)
         impl = "auto"
     if impl == "auto":
         impl = "flash" if jax.default_backend() in ("tpu", "axon") else "xla"
@@ -685,11 +689,10 @@ def forward_hidden(
     if shard_activations:
         x = _maybe_shard(x, P(BATCH_AXES, SEQUENCE_AXIS, None))
     if segment_ids is not None:
+        # Packing composes with every attention impl: flash takes segment ids IN-KERNEL,
+        # xla takes the block-diagonal mask, and the sp modes shard the ids over the sp
+        # axis (ring rotates the kv-side slice with its kv block).
         mask = segment_mask(segment_ids)
-        if cfg.attn_impl in ("ring", "ulysses", "allgather"):
-            # The sp attention modes take no mask and would silently attend across packed
-            # segments; flash handles segments IN-KERNEL, xla takes the mask.
-            cfg = dataclasses.replace(cfg, attn_impl="auto")
     else:
         mask = jnp.tril(jnp.ones((S, S), dtype=jnp.bool_))[None, :, :]
     full_mask = mask
@@ -941,13 +944,20 @@ def _pp_stage_fn(
             return out, jnp.sum(auxes)
         return out
 
-    if packed:
-        if cfg.attn_impl in ("ring", "ulysses", "allgather"):
-            # Same fallback as forward_hidden: the sp attention modes take no mask and
-            # would silently attend across packed segments.
-            cfg = dataclasses.replace(cfg, attn_impl="auto")
-            block = _maybe_remat_block(cfg)
+    if packed and sp_manual:
+        # packing × sp × pp: activations AND the side constants arrive sequence-sliced
+        # ([B_m, S/sp, D] and [B_m, S/sp] — loss_fn_pp passes the matching side_spec).
+        # Positions are the pre-computed per-segment RoPE restarts (global array,
+        # sliced); attention dispatches to the flat ring/ulysses collectives inside
+        # _attention with the LOCAL segment slice (ring rotates the kv-side ids).
+        def stage_fn(stage_layers, x, side):
+            return body_scan(
+                x, stage_layers, side["positions"], None, side["segment_ids"]
+            )
 
+        return stage_fn
+
+    if packed:
         def stage_fn(stage_layers, x, side):
             seg = side["segment_ids"]
             return body_scan(x, stage_layers, side["positions"], segment_mask(seg), seg)
@@ -1081,7 +1091,7 @@ def loss_fn_pp(
         # typo'd ACCELERATE_PP_SCHEDULE) must not silently run GPipe.
         raise ValueError(f"schedule={schedule!r}: expected 'gpipe' or '1f1b'")
     sp_pipeline = False
-    if cfg.attn_impl in ("ring", "ulysses", "allgather") and "segment_ids" not in batch:
+    if cfg.attn_impl in ("ring", "ulysses", "allgather"):
         # Check the mesh ARGUMENT (the one the pipeline's shard_map will run under),
         # not just the ambient context — callers may pass it without jax.set_mesh.
         if _sp_active(mesh) or _sp_active(jax.sharding.get_abstract_mesh()):
@@ -1161,10 +1171,18 @@ def loss_fn_pp(
             # non-pipelined meaning.
             aux_weight=(cfg.moe_aux_weight / M) if is_moe else 0.0,
             # sp×pp: activations ride sequence-sliced through a pipeline that is manual
-            # over sp too (microbatch layout [M, B_m, S, D] → sp on dim 2).
+            # over sp too (microbatch layout [M, B_m, S, D] → sp on dim 2). Packed
+            # batches slice their side constants the same way (side_spec): each sp
+            # member's stage sees its own [B_m, S/sp] positions/segment ids, and the
+            # ring rotates the kv-side segment slice with its kv block.
             act_spec=P(None, None, SEQUENCE_AXIS, None) if sp_pipeline else None,
             extra_manual_axes=(SEQUENCE_AXIS,) if sp_pipeline else (),
             virtual_stages=virtual_stages,
+            side_spec=(
+                {"positions": P(None, None, SEQUENCE_AXIS),
+                 "segment_ids": P(None, None, SEQUENCE_AXIS)}
+                if (sp_pipeline and side is not None) else None
+            ),
         )
         x = params["embed"].astype(dtype)[inputs]
         return pipe_loss(

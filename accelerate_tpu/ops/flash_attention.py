@@ -251,10 +251,19 @@ def _fwd_kernel(
 
 
 def _seg_blocks(segments, Sp, Tp):
-    """Pad + split packed segment ids into (q_seg [B,Sp], kv_seg [B,Tp]) int32 (pad = 0)."""
-    seg = jnp.asarray(segments, jnp.int32)
-    q_seg = jnp.pad(seg, ((0, 0), (0, Sp - seg.shape[1])))
-    kv_seg = jnp.pad(seg, ((0, 0), (0, Tp - seg.shape[1])))
+    """Pad + split packed segment ids into (q_seg [B,Sp], kv_seg [B,Tp]) int32 (pad = 0).
+
+    ``segments`` is either one [B,S] array (self-attention: both sides share it) or a
+    ``(q_seg [B,S], kv_seg [B,T])`` pair — the ring/allgather SP case, where the kv block
+    comes from another sequence shard and carries its own segment ids."""
+    if isinstance(segments, (tuple, list)):
+        q_raw, kv_raw = segments
+    else:
+        q_raw = kv_raw = segments
+    q_raw = jnp.asarray(q_raw, jnp.int32)
+    kv_raw = jnp.asarray(kv_raw, jnp.int32)
+    q_seg = jnp.pad(q_raw, ((0, 0), (0, Sp - q_raw.shape[1])))
+    kv_seg = jnp.pad(kv_raw, ((0, 0), (0, Tp - kv_raw.shape[1])))
     return q_seg, kv_seg
 
 
@@ -665,10 +674,29 @@ def _fit_block(block: int, seq: int) -> int:
 # Offsets travel as float32 scalars so the custom_vjp has well-defined (zero) cotangents for
 # them; kernels receive them as int32. This is what lets shard_map callers (ring/allgather SP)
 # pass traced global positions.
+def _seg_pair_f32(segments):
+    """Normalize ``segments`` (None | [B,S] array | (q_seg, kv_seg) pair) to the fixed
+    (q, kv) float32 pair the custom_vjp carries, plus the has_segments flag."""
+    if segments is None:
+        return (jnp.zeros((1, 1), jnp.float32),) * 2, False
+    if not isinstance(segments, (tuple, list)):
+        segments = (segments, segments)
+    return tuple(jnp.asarray(s, jnp.float32) for s in segments), True
+
+
+def _seg_pair_i32(seg_f32, has_segments):
+    """``seg_f32`` travels through the custom_vjp as a (q_seg, kv_seg) float32 pair
+    (identical arrays in the self-attention case) so the cotangent structure is fixed;
+    kernels receive int32."""
+    if not has_segments:
+        return None
+    return tuple(s.astype(jnp.int32) for s in seg_f32)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11, 12, 13))
 def _flash_bhsd(q, k, v, q_off, kv_off, seg_f32, causal, sm_scale, block_q, block_k,
                 interpret, has_segments, window, softcap):
-    segs = seg_f32.astype(jnp.int32) if has_segments else None
+    segs = _seg_pair_i32(seg_f32, has_segments)
     o, _ = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
                 q_offset=q_off.astype(jnp.int32), kv_offset=kv_off.astype(jnp.int32),
                 segments=segs, window=window, softcap=softcap)
@@ -677,7 +705,7 @@ def _flash_bhsd(q, k, v, q_off, kv_off, seg_f32, causal, sm_scale, block_q, bloc
 
 def _flash_bhsd_fwd(q, k, v, q_off, kv_off, seg_f32, causal, sm_scale, block_q, block_k,
                     interpret, has_segments, window, softcap):
-    segs = seg_f32.astype(jnp.int32) if has_segments else None
+    segs = _seg_pair_i32(seg_f32, has_segments)
     o, lse = _fwd(q, k, v, causal, sm_scale, block_q, block_k, interpret,
                   q_offset=q_off.astype(jnp.int32), kv_offset=kv_off.astype(jnp.int32),
                   segments=segs, window=window, softcap=softcap)
@@ -689,7 +717,7 @@ def _flash_bhsd_bwd(causal, sm_scale, block_q, block_k, interpret, has_segments,
     q, k, v, q_off, kv_off, seg_f32, o, lse = residuals
     qo = q_off.astype(jnp.int32)
     ko = kv_off.astype(jnp.int32)
-    segs = seg_f32.astype(jnp.int32) if has_segments else None
+    segs = _seg_pair_i32(seg_f32, has_segments)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)  # [B,H,S]
     dq = _bwd_dq(q, k, v, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
                  q_offset=qo, kv_offset=ko, segments=segs, window=window, softcap=softcap)
@@ -698,15 +726,19 @@ def _flash_bhsd_bwd(causal, sm_scale, block_q, block_k, interpret, has_segments,
                       softcap=softcap)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
             jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32),
-            jnp.zeros_like(seg_f32))
+            jax.tree_util.tree_map(jnp.zeros_like, seg_f32))
 
 
 _flash_bhsd.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
 
 
 def _flash_bhsd_offset(q, k, v, q_offset=0, kv_offset=0, causal=True, sm_scale=None,
-                       block_q=None, block_k=None, interpret=None, window=0, softcap=0.0):
-    """Offset-aware flash attention over user layout [B, S, H, hd] (shard_map helper)."""
+                       block_q=None, block_k=None, interpret=None, window=0, softcap=0.0,
+                       segments=None):
+    """Offset-aware flash attention over user layout [B, S, H, hd] (shard_map helper).
+
+    ``segments``: None, a shared [B,S] array, or a ``(q_seg [B,S], kv_seg [B,T])`` pair —
+    the pair form is how the SP modes keep packing exact when kv spans other shards."""
     B, S, H, hd = q.shape
     if sm_scale is None:
         sm_scale = 1.0 / math.sqrt(hd)
@@ -717,10 +749,12 @@ def _flash_bhsd_offset(q, k, v, q_offset=0, kv_offset=0, causal=True, sm_scale=N
     vT = v.transpose(0, 2, 1, 3)
     bq = _fit_block(block_q or _DEFAULT_BLOCK_Q, S)
     bk = _fit_block(block_k or _DEFAULT_BLOCK_K, k.shape[1])
+    seg_f32, has_segments = _seg_pair_f32(segments)
     o = _flash_bhsd(qT, kT, vT,
                     jnp.asarray(q_offset, jnp.float32), jnp.asarray(kv_offset, jnp.float32),
-                    jnp.zeros((1, 1), jnp.float32),
-                    causal, sm_scale, bq, bk, interpret, False, int(window), float(softcap))
+                    seg_f32,
+                    causal, sm_scale, bq, bk, interpret, has_segments, int(window),
+                    float(softcap))
     return o.transpose(0, 2, 1, 3)
 
 
@@ -773,11 +807,7 @@ def flash_attention(
     block_q = _fit_block(block_q or _DEFAULT_BLOCK_Q, S)
     block_k = _fit_block(block_k or _DEFAULT_BLOCK_K, k.shape[1])
     zero = jnp.zeros((), jnp.float32)
-    has_segments = segment_ids is not None
-    seg_f32 = (
-        jnp.asarray(segment_ids, jnp.float32) if has_segments
-        else jnp.zeros((1, 1), jnp.float32)
-    )
+    seg_f32, has_segments = _seg_pair_f32(segment_ids)
     o = _flash_bhsd(qT, kT, vT, zero, zero, seg_f32, causal, sm_scale, block_q, block_k,
                     interpret, has_segments, int(window), float(softcap))
     return o.transpose(0, 2, 1, 3)

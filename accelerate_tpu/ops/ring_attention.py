@@ -43,89 +43,110 @@ def _merge(o_run, lse_run, o_b, lse_b):
     return o, m + jnp.log(denom_safe)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _ring_bhsd(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret, window,
-               softcap):
-    o, _ = _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret,
-                          window, softcap)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11))
+def _ring_bhsd(q, k, v, seg_f32, axis_name, causal, sm_scale, block_sizes, interpret,
+               window, softcap, has_segments):
+    o, _ = _ring_fwd_impl(q, k, v, seg_f32, axis_name, causal, sm_scale, block_sizes,
+                          interpret, window, softcap, has_segments)
     return o
 
 
-def _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret,
-                   window=0, softcap=0.0):
+def _ring_fwd_impl(q, k, v, seg_f32, axis_name, causal, sm_scale, block_sizes, interpret,
+                   window=0, softcap=0.0, has_segments=False):
     block_q, block_k = block_sizes
     B, H, S_local, hd = q.shape
     idx = lax.axis_index(axis_name)
     n = lax.axis_size(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     q_off = idx * S_local
+    # Packing: q keeps its LOCAL segment-id slice; the kv-side slice rotates around the
+    # ring WITH its k/v block so every visiting block carries matching segment ids.
+    q_seg = seg_f32.astype(jnp.int32) if has_segments else None
 
     def body(carry, t):
-        k_cur, v_cur, o_run, lse_run = carry
+        k_cur, v_cur, kv_seg_cur, o_run, lse_run = carry
         kv_idx = (idx - t) % n
         # The kernels take GLOBAL offsets, so sliding-window masking (and its tile
         # skipping) is correct across ring steps without any extra logic here.
         o_b, lse_b = _fwd(
             q, k_cur, v_cur, causal, sm_scale, block_q, block_k, interpret,
             q_offset=q_off, kv_offset=kv_idx * S_local, window=window, softcap=softcap,
+            segments=(q_seg, kv_seg_cur) if has_segments else None,
         )
         o_run, lse_run = _merge(o_run, lse_run, o_b, lse_b)
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
-        return (k_next, v_next, o_run, lse_run), None
+        kv_seg_next = (
+            lax.ppermute(kv_seg_cur, axis_name, perm) if has_segments else kv_seg_cur
+        )
+        return (k_next, v_next, kv_seg_next, o_run, lse_run), None
 
     o0 = jnp.zeros((B, H, S_local, hd), jnp.float32)
     lse0 = jnp.full((B, H, S_local), -1e30, jnp.float32)
-    (k_home, v_home, o, lse), _ = lax.scan(body, (k, v, o0, lse0), jnp.arange(n))
+    kv_seg0 = q_seg if has_segments else jnp.zeros((), jnp.int32)
+    (k_home, v_home, _seg_home, o, lse), _ = lax.scan(
+        body, (k, v, kv_seg0, o0, lse0), jnp.arange(n)
+    )
     return o.astype(q.dtype), lse
 
 
-def _ring_fwd(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret, window,
-              softcap):
-    o, lse = _ring_fwd_impl(q, k, v, axis_name, causal, sm_scale, block_sizes, interpret,
-                            window, softcap)
-    return o, (q, k, v, o, lse)
+def _ring_fwd(q, k, v, seg_f32, axis_name, causal, sm_scale, block_sizes, interpret,
+              window, softcap, has_segments):
+    o, lse = _ring_fwd_impl(q, k, v, seg_f32, axis_name, causal, sm_scale, block_sizes,
+                            interpret, window, softcap, has_segments)
+    return o, (q, k, v, seg_f32, o, lse)
 
 
 def _ring_bwd(axis_name, causal, sm_scale, block_sizes, interpret, window, softcap,
-              residuals, do):
+              has_segments, residuals, do):
     block_q, block_k = block_sizes
-    q, k, v, o, lse = residuals
+    q, k, v, seg_f32, o, lse = residuals
     B, H, S_local, hd = q.shape
     idx = lax.axis_index(axis_name)
     n = lax.axis_size(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     q_off = idx * S_local
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    q_seg = seg_f32.astype(jnp.int32) if has_segments else None
 
     def body(carry, t):
-        k_cur, v_cur, dk_cur, dv_cur, dq_run = carry
+        k_cur, v_cur, kv_seg_cur, dk_cur, dv_cur, dq_run = carry
         kv_idx = (idx - t) % n
         kv_off = kv_idx * S_local
+        segs = (q_seg, kv_seg_cur) if has_segments else None
         dq_b = _bwd_dq(
             q, k_cur, v_cur, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
             q_offset=q_off, kv_offset=kv_off, window=window, softcap=softcap,
+            segments=segs,
         )
         dk_b, dv_b = _bwd_dkv(
             q, k_cur, v_cur, do, lse, delta, causal, sm_scale, block_q, block_k, interpret,
             q_offset=q_off, kv_offset=kv_off, window=window, softcap=softcap,
+            segments=segs,
         )
         dq_run = dq_run + dq_b
         dk_cur = dk_cur + dk_b
         dv_cur = dv_cur + dv_b
-        # Rotate kv AND its gradient accumulators together: after n steps they're home.
+        # Rotate kv (and its segment ids) AND its gradient accumulators together: after
+        # n steps they're home.
         k_next = lax.ppermute(k_cur, axis_name, perm)
         v_next = lax.ppermute(v_cur, axis_name, perm)
+        kv_seg_next = (
+            lax.ppermute(kv_seg_cur, axis_name, perm) if has_segments else kv_seg_cur
+        )
         dk_next = lax.ppermute(dk_cur, axis_name, perm)
         dv_next = lax.ppermute(dv_cur, axis_name, perm)
-        return (k_next, v_next, dk_next, dv_next, dq_run), None
+        return (k_next, v_next, kv_seg_next, dk_next, dv_next, dq_run), None
 
     zeros_kv = jnp.zeros(k.shape, jnp.float32)  # [B, K, S_local, hd] — K kv heads, unrepeated
-    (k_home, v_home, dk, dv, dq), _ = lax.scan(
-        body, (k, v, zeros_kv, zeros_kv, jnp.zeros((B, H, S_local, hd), jnp.float32)),
+    kv_seg0 = q_seg if has_segments else jnp.zeros((), jnp.int32)
+    (k_home, v_home, _seg_home, dk, dv, dq), _ = lax.scan(
+        body,
+        (k, v, kv_seg0, zeros_kv, zeros_kv, jnp.zeros((B, H, S_local, hd), jnp.float32)),
         jnp.arange(n),
     )
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            jnp.zeros_like(seg_f32))
 
 
 _ring_bhsd.defvjp(_ring_fwd, _ring_bwd)
@@ -143,12 +164,18 @@ def ring_attention(
     interpret: Optional[bool] = None,
     window: int = 0,
     softcap: float = 0.0,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Exact ring attention for use inside shard_map; user layout q [B, S_loc, H, hd].
 
     k/v [B, S_loc, K, hd] with K dividing H — GQA is native in the flash kernels, so the
     ring rotates the UNREPEATED [B, K, S_loc, hd] k/v (and dk/dv): for 16q/8kv that halves
     the per-step ppermute bytes on the ICI ring. Returns [B, S_loc, H, hd].
+
+    ``segment_ids``: this shard's LOCAL [B, S_loc] slice of the packed segment ids
+    (``ops/packing.py`` layout: 0 = pad). The kv-side slice rotates around the ring with
+    its k/v block, so same-segment masking stays exact across shard boundaries — packing
+    and long-context sequence parallelism compose.
     """
     B, S_local, H, hd = q.shape
     K = k.shape[2]
@@ -158,6 +185,11 @@ def ring_attention(
         interpret = _interpret_default()
     if H % K:
         raise ValueError(f"q heads ({H}) must be a multiple of kv heads ({K})")
+    if segment_ids is not None and segment_ids.shape != (B, S_local):
+        raise ValueError(
+            f"segment_ids must be the local [B, S_local] slice {(B, S_local)}, "
+            f"got {segment_ids.shape}"
+        )
     qT = q.transpose(0, 2, 1, 3)
     kT = k.transpose(0, 2, 1, 3)
     vT = v.transpose(0, 2, 1, 3)
@@ -165,6 +197,11 @@ def ring_attention(
 
     bq = _fit_block(block_q or _DEFAULT_BLOCK_Q, S_local)
     bk = _fit_block(block_k or _DEFAULT_BLOCK_K, S_local)
-    o = _ring_bhsd(qT, kT, vT, axis_name, causal, sm_scale, (bq, bk), interpret,
-                   int(window), float(softcap))
+    has_segments = segment_ids is not None
+    seg_f32 = (
+        jnp.asarray(segment_ids, jnp.float32) if has_segments
+        else jnp.zeros((1, 1), jnp.float32)
+    )
+    o = _ring_bhsd(qT, kT, vT, seg_f32, axis_name, causal, sm_scale, (bq, bk), interpret,
+                   int(window), float(softcap), has_segments)
     return o.transpose(0, 2, 1, 3)

@@ -174,9 +174,16 @@ def make_pipeline_fn(
     with_aux: bool = False,
     act_spec: Optional[P] = None,
     extra_manual_axes: tuple = (),
+    side_spec: Optional[Any] = None,
 ):
     """GSPMD-embeddable pipeline: ``fn(stacked_stage_params, x [B, ...]) -> y [B, ...]``
     (``(y, aux_total)`` with ``with_aux`` — see ``pipeline_apply``).
+
+    ``side_spec``: per-leaf PartitionSpec pytree for the side inputs in MICROBATCH
+    layout [M, B_m, ...] (like ``act_spec``). Required when sides are used together
+    with ``extra_manual_axes`` — e.g. packing under sp×pp passes
+    ``P(None, None, 'sp')`` so each sp member's stage body sees its own sequence
+    slice of the segment ids, matching the sequence-sliced activations.
 
     Splits the batch into microbatches, runs the GPipe schedule manual-over-``pp`` only
     (other mesh axes stay auto), and reassembles. ``stacked_stage_params`` leading dim =
@@ -198,10 +205,12 @@ def make_pipeline_fn(
     manual = {axis_name, *extra_manual_axes}
 
     def fn(stage_params, x, side=None):
-        if side is not None and extra_manual_axes and jax.tree_util.tree_leaves(side):
+        if (side is not None and extra_manual_axes and side_spec is None
+                and jax.tree_util.tree_leaves(side)):
             raise NotImplementedError(
-                "side inputs (sample packing) under extra_manual_axes are not "
-                "supported — packed batches fall back from the sp attention modes"
+                "side inputs under extra_manual_axes need a side_spec (the per-leaf "
+                "microbatch-layout PartitionSpec) so stage bodies see slices matching "
+                "the manual activations"
             )
         B = x.shape[0]
         if B % num_microbatches != 0:
@@ -213,12 +222,13 @@ def make_pipeline_fn(
         args = [stage_params, mb]
         if side is not None:
             # Per-microbatch constants (positions / segment ids): [B, ...] → [M, B_m, ...],
-            # replicated over pp and indexed inside (see pipeline_apply's side_mb).
+            # indexed inside (see pipeline_apply's side_mb). Replicated over pp; sliced
+            # per side_spec over any extra manual axes (packing under sp×pp).
             side_mb = jax.tree_util.tree_map(
                 lambda a: a.reshape(num_microbatches, B // num_microbatches, *a.shape[1:]),
                 side,
             )
-            in_specs.append(P())
+            in_specs.append(P() if side_spec is None else side_spec)
             args.append(side_mb)
         mapped = jax.shard_map(
             functools.partial(
@@ -553,6 +563,22 @@ def _ds_accumulate(ds_buf, ds, bm_c, live):
             buf,
         )
         for buf, d in zip(ds_buf, ds)
+    ]
+
+
+def _ds_out_specs(side, side_spec):
+    """out_specs entry for the replay kernels' float-side cotangent buffers: one spec
+    per FLOAT side leaf (matching ``_side_split``'s float-leaf order), mirroring the
+    leaf's ``side_spec`` slicing. ``side_spec is None`` → replicated (P())."""
+    if side_spec is None:
+        return P()
+    leaves = jax.tree_util.tree_leaves(side)
+    spec_leaves = jax.tree_util.tree_leaves(
+        side_spec, is_leaf=lambda s: isinstance(s, P)
+    )
+    return [
+        s for l, s in zip(leaves, spec_leaves)
+        if jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
     ]
 
 
@@ -1015,6 +1041,7 @@ def _pipeline_interleaved_bwd_kernel(
 def _make_interleaved_loss_fn(
     mesh, stage_fn, head_loss_fn, axis_name, M, v,
     act_spec=None, extra_manual_axes=(), with_aux: bool = False, aux_weight: float = 0.0,
+    side_spec=None,
 ):
     """Interleaved-1F1B loss: ``loss(stage_params, head_params, x, extras)`` with
     stage params chunk-stacked ``[v, n, L/(n·v), ...]`` (dim 1 over pp — device s hosts
@@ -1044,7 +1071,7 @@ def _make_interleaved_loss_fn(
         in_specs = [specs_of(stage_params), x_spec]
         args = [stage_params, x_mb]
         if side:
-            in_specs.append(P())
+            in_specs.append(P() if side_spec is None else side_spec)
             args.append(_side_mb(side, B))
         mapped = jax.shard_map(
             functools.partial(
@@ -1086,7 +1113,7 @@ def _make_interleaved_loss_fn(
         in_specs = [specs_of(stage_params), x_spec, x_spec, P()]
         args = [stage_params, x_mb, dy_mb, aux_ct]
         if side:
-            in_specs.append(P())
+            in_specs.append(P() if side_spec is None else side_spec)
             args.append(_side_mb(side, B))
         mapped = jax.shard_map(
             functools.partial(
@@ -1095,7 +1122,7 @@ def _make_interleaved_loss_fn(
             ),
             mesh=mesh,
             in_specs=tuple(in_specs),
-            out_specs=(specs_of(stage_params), x_spec, P()),
+            out_specs=(specs_of(stage_params), x_spec, _ds_out_specs(side, side_spec)),
             axis_names=manual,
             check_vma=False,
         )
@@ -1112,10 +1139,10 @@ def _make_interleaved_loss_fn(
 
     def loss_with_side(stage_params, head_params, x, extras, side=None):
         side = {} if side is None else side
-        if extra_manual_axes and jax.tree_util.tree_leaves(side):
+        if extra_manual_axes and side_spec is None and jax.tree_util.tree_leaves(side):
             raise NotImplementedError(
-                "side inputs under extra_manual_axes are not supported — same contract "
-                "as the flat pipeline (packed batches fall back from the sp modes)"
+                "side inputs under extra_manual_axes need a side_spec — same contract "
+                "as the flat pipeline (make_pipeline_fn)"
             )
         return loss(stage_params, head_params, x, extras, side)
 
@@ -1134,6 +1161,7 @@ def make_pipeline_loss_fn(
     act_spec: Optional[P] = None,
     extra_manual_axes: tuple = (),
     virtual_stages: int = 1,
+    side_spec: Optional[Any] = None,
 ):
     """Build ``loss(stage_params, head_params, x [B, ...], extras) -> scalar`` with a
     hand-scheduled 1F1B backward (``schedule="1f1b"``) or AD-GPipe (``"gpipe"``).
@@ -1189,12 +1217,12 @@ def make_pipeline_loss_fn(
         return _make_interleaved_loss_fn(
             mesh, stage_fn, head_loss_fn, axis_name, M, virtual_stages,
             act_spec=act_spec, extra_manual_axes=extra_manual_axes,
-            with_aux=with_aux, aux_weight=aux_weight,
+            with_aux=with_aux, aux_weight=aux_weight, side_spec=side_spec,
         )
 
     pipe = make_pipeline_fn(
         mesh, stage_fn, axis_name, M, with_aux=with_aux,
-        act_spec=act_spec, extra_manual_axes=extra_manual_axes,
+        act_spec=act_spec, extra_manual_axes=extra_manual_axes, side_spec=side_spec,
     )
 
     def _forward(stage_params, x, side):
@@ -1256,12 +1284,12 @@ def make_pipeline_loss_fn(
             side_mb = jax.tree_util.tree_map(
                 lambda a: a.reshape(M, B // M, *a.shape[1:]), side
             )
-            in_specs.append(P())
+            in_specs.append(P() if side_spec is None else side_spec)
             args.append(side_mb)
         mapped = jax.shard_map(
             kernel, mesh=mesh,
             in_specs=tuple(in_specs),
-            out_specs=(specs_params, x_spec, P()),
+            out_specs=(specs_params, x_spec, _ds_out_specs(side, side_spec)),
             # Manual over pp (plus any extra_manual_axes — sp for the sp×pp
             # composition); other axes stay auto so the batch keeps its dp sharding
             # and stage params their tp/fsdp sharding.

@@ -54,6 +54,7 @@ def ulysses_attention(
     interpret: Optional[bool] = None,
     window: int = 0,
     softcap: float = 0.0,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """DeepSpeed-Ulysses: all-to-all seq↔head reshard, then full-sequence flash attention.
 
@@ -76,8 +77,15 @@ def ulysses_attention(
     qg = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
     kg = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
     vg = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    # Packing: after the seq->head reshard every device holds the FULL sequence, so the
+    # full segment-id row (one cheap [B, S_loc] int all-gather) keeps same-segment
+    # masking exact in the local flash call.
+    seg_full = (
+        lax.all_gather(segment_ids, axis_name, axis=1, tiled=True)
+        if segment_ids is not None else None
+    )
     og = flash_attention(qg, kg, vg, causal=causal, sm_scale=sm_scale, interpret=interpret,
-                         window=window, softcap=softcap)
+                         window=window, softcap=softcap, segment_ids=seg_full)
     # back: split sequence, gather heads.
     return lax.all_to_all(og, axis_name, split_axis=1, concat_axis=2, tiled=True)
 
@@ -92,6 +100,7 @@ def allgather_attention(
     interpret: Optional[bool] = None,
     window: int = 0,
     softcap: float = 0.0,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Naive SP: all-gather kv, attend local q chunk against the full sequence.
 
@@ -101,7 +110,13 @@ def allgather_attention(
     S_local = q.shape[1]
     kg = lax.all_gather(k, axis_name, axis=1, tiled=True)
     vg = lax.all_gather(v, axis_name, axis=1, tiled=True)
-    if not causal and not window:
+    # Packing: local q segment slice vs the all-gathered full kv segment row — the
+    # (q_seg, kv_seg) pair form of the kernels keeps same-segment masking exact.
+    segments = None
+    if segment_ids is not None:
+        seg_full = lax.all_gather(segment_ids, axis_name, axis=1, tiled=True)
+        segments = (segment_ids, seg_full)
+    if not causal and not window and segments is None:
         return flash_attention(q, kg, vg, causal=False, sm_scale=sm_scale, interpret=interpret,
                                softcap=softcap)
     # Causal (or windowed) with a global row offset: flash_attention assumes q starts at
@@ -111,7 +126,7 @@ def allgather_attention(
 
     return _flash_bhsd_offset(
         q, kg, vg, q_offset=idx * S_local, causal=causal, sm_scale=sm_scale,
-        interpret=interpret, window=window, softcap=softcap,
+        interpret=interpret, window=window, softcap=softcap, segments=segments,
     )
 
 
@@ -126,6 +141,7 @@ def sequence_parallel_attention(
     interpret: Optional[bool] = None,
     window: int = 0,
     softcap: float = 0.0,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Dispatch by mode ("ring" | "ulysses" | "allgather"); shard_map-context required.
 
@@ -133,7 +149,8 @@ def sequence_parallel_attention(
     sliding-window (Mistral) and score-capped (Gemma) attention work across the
     sequence-sharded mesh axis too."""
     kwargs = dict(axis_name=axis_name, causal=causal, sm_scale=sm_scale,
-                  interpret=interpret, window=window, softcap=softcap)
+                  interpret=interpret, window=window, softcap=softcap,
+                  segment_ids=segment_ids)
     if mode == "ring":
         return ring_attention(q, k, v, **kwargs)
     if mode == "ulysses":
@@ -153,21 +170,26 @@ def make_sp_attention(mesh, mode: str = "ring", axis_name: str = SEQUENCE_AXIS, 
     from jax.sharding import PartitionSpec as P
 
     spec = P(None, axis_name, None, None)
+    seg_spec = P(None, axis_name)
 
-    def attn(q, k, v):
+    def attn(q, k, v, segment_ids=None):
         fn = functools.partial(
             sequence_parallel_attention, mode=mode, axis_name=axis_name, causal=causal,
             window=window, softcap=softcap, sm_scale=sm_scale,
         )
+        # Packing: the GLOBAL [B, S] segment ids shard along sp like the sequence; each
+        # mode re-derives what it needs (ring rotates the kv slice, ulysses/allgather
+        # gather the full row) from its local slice.
+        packed = segment_ids is not None
         mapped = jax.shard_map(
-            fn,
+            (lambda q, k, v, seg: fn(q, k, v, segment_ids=seg)) if packed else fn,
             mesh=mesh,
-            in_specs=(spec, spec, spec),
+            in_specs=(spec, spec, spec) + ((seg_spec,) if packed else ()),
             out_specs=spec,
             axis_names={axis_name},
             # pallas_call out_shapes don't carry vma annotations; skip the check.
             check_vma=False,
         )
-        return mapped(q, k, v)
+        return mapped(q, k, v, segment_ids) if packed else mapped(q, k, v)
 
     return attn

@@ -1361,3 +1361,61 @@ def test_llama_pp_moe_1f1b_matches_single():
         - np.asarray(g["layers"]["moe"]["w_router"], np.float64)
     ).max()
     assert router_delta > 1e-6, "aux gradient did not flow through the 1F1B replay"
+
+
+@slow
+@pytest.mark.parametrize("schedule,virtual_stages", [
+    ("gpipe", 1), ("1f1b", 1), ("1f1b", 2),
+])
+def test_llama_pp_sp_packed_matches_single(schedule, virtual_stages):
+    """Sample packing x sp attention x pipeline, every schedule (formerly raised: side
+    inputs under extra_manual_axes): the side constants (per-segment positions +
+    segment ids) ride SEQUENCE-SLICED through the manual-sp pipeline via
+    make_pipeline_fn's side_spec, each sp member's stage attends its own slice with
+    the local segment ids, and the ring rotates the kv-side ids with its kv block.
+    Loss and ALL grads match the packed, non-pipelined, non-sp run at dp2 x sp2 x pp2."""
+    import dataclasses as _dc
+
+    from accelerate_tpu.models import llama
+
+    cfg = _dc.replace(
+        llama.CONFIGS["tiny"], dtype=jnp.float32, attn_impl="ring", scan_layers=True,
+        n_layers=4,
+    )
+    params = llama.init_params(cfg)
+    rng = np.random.default_rng(0)
+    B, S = 8, 33  # inputs S-1 = 32 → sp2 slices of 16
+    tokens = rng.integers(0, cfg.vocab_size, (B, S))
+    seg = np.zeros((B, S), np.int32)
+    for b in range(B):
+        cut = int(rng.integers(8, 24))
+        seg[b, :cut] = 1
+        seg[b, cut:28] = 2  # slots 28: stay 0 = pad
+    batch = {"tokens": jnp.asarray(tokens, jnp.int32), "segment_ids": jnp.asarray(seg)}
+
+    # Baseline: packed, no mesh context → ring falls back to local flash with segments.
+    base = float(llama.loss_fn(params, batch, cfg))
+    base_g = jax.grad(lambda p: llama.loss_fn(p, batch, cfg))(params)
+
+    sp = dict(params)
+    sp["layers"] = split_params_into_stages(
+        params["layers"], 2, virtual_stages=virtual_stages
+    ) if virtual_stages > 1 else split_params_into_stages(params["layers"], 2)
+    mesh = build_mesh(MeshConfig(dp=2, sp=2, pp=2))
+    with jax.set_mesh(mesh):
+        l, g = jax.jit(jax.value_and_grad(
+            lambda p, b: llama.loss_fn_pp(
+                p, b, cfg, mesh, num_microbatches=4, schedule=schedule,
+                virtual_stages=virtual_stages)
+        ))(sp, batch)
+    np.testing.assert_allclose(float(l), base, rtol=1e-5)
+    expected = dict(base_g)
+    expected["layers"] = split_params_into_stages(
+        base_g["layers"], 2, virtual_stages=virtual_stages
+    ) if virtual_stages > 1 else split_params_into_stages(base_g["layers"], 2)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5
+        ),
+        dict(g), expected,
+    )
