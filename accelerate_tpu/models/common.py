@@ -122,6 +122,78 @@ def _softcap(scores: jax.Array, cap: float) -> jax.Array:
     return cap * jnp.tanh(scores / cap) if cap else scores
 
 
+# ------------------------------------------------------------- attention dispatch (shared)
+def sp_active(mesh) -> bool:
+    """Does this mesh (concrete or abstract; may be None) engage the sp axis? The ONE
+    copy of the sequence-parallel activation predicate — shared by the family attention
+    dispatchers (on the ambient mesh) and the pp sp-under-pp routing (on the mesh arg)."""
+    from ..utils.constants import SEQUENCE_AXIS
+
+    return mesh is not None and not mesh.empty and mesh.shape.get(SEQUENCE_AXIS, 1) > 1
+
+
+def sp_manual(mesh) -> bool:
+    """Is the sp axis already MANUAL in this context — i.e. are we inside a shard_map
+    whose manual axes include sp (the pipeline's sp×pp composition)? Then the sp
+    collectives (``lax.ppermute`` KV rotation / all_to_all) must be issued directly;
+    wrapping another shard_map would nest, which fails to lower on the backward."""
+    from ..utils.constants import SEQUENCE_AXIS
+
+    try:
+        types = dict(zip(mesh.axis_names, mesh.axis_types))
+        return types.get(SEQUENCE_AXIS) == jax.sharding.AxisType.Manual
+    except Exception:
+        return False
+
+
+def attention_dispatch(q, k, v, mask, *, impl: str, sm_scale: float, window: int = 0,
+                       softcap: float = 0.0, segment_ids=None, xla_attention=None):
+    """Family-shared causal self-attention dispatch (llama/gpt): ``impl`` in
+    ``auto | flash | xla | ring | ulysses | allgather`` over q [B,S,H,hd],
+    k/v [B,S,K,hd] (GQA: K ≤ H).
+
+    - sp modes need an active mesh with sp > 1; inside a manual-sp shard_map (the
+      pipeline's sp×pp composition) the collectives are issued flat, else the call is
+      wrapped in ``make_sp_attention``'s own shard_map. Without sp, they fall back to
+      local attention. Packed rows (``segment_ids``) compose with every impl.
+    - ``xla_attention(q, k, v, mask)`` is the family's reference path (fallback)."""
+    from ..utils.constants import SEQUENCE_AXIS
+
+    if impl in ("ring", "ulysses", "allgather"):
+        mesh = jax.sharding.get_abstract_mesh()
+        if sp_active(mesh):
+            if sp_manual(mesh):
+                from ..parallel.sequence import sequence_parallel_attention
+
+                return sequence_parallel_attention(
+                    q, k, v, mode=impl, axis_name=SEQUENCE_AXIS, causal=True,
+                    window=window, softcap=softcap, sm_scale=sm_scale,
+                    segment_ids=segment_ids,
+                )
+            from ..parallel.sequence import make_sp_attention
+
+            attn = make_sp_attention(
+                mesh, mode=impl, axis_name=SEQUENCE_AXIS, causal=True,
+                window=window, softcap=softcap, sm_scale=sm_scale,
+            )
+            return attn(q, k, v, segment_ids=segment_ids)
+        impl = "auto"
+    if impl == "auto":
+        impl = "flash" if jax.default_backend() in ("tpu", "axon") else "xla"
+    if impl == "flash":
+        try:
+            from ..ops.flash_attention import flash_attention
+
+            # Packed rows stay on the flash path: the kernels take segment ids directly.
+            return flash_attention(
+                q, k, v, causal=True, segment_ids=segment_ids, window=window,
+                sm_scale=sm_scale, softcap=softcap,
+            )
+        except Exception:  # pragma: no cover - kernel unavailable on this backend
+            pass
+    return xla_attention(q, k, v, mask)
+
+
 def resolve_loss_chunk(loss_chunk: int, S: int, vocab_size: int) -> int:
     """Resolve the chunked-CE chunk length (0 tokens = don't chunk).
 

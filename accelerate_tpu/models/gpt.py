@@ -67,6 +67,11 @@ class GPTConfig:
     lm_head_bias: bool = False    # gpt-j's lm_head carries a bias
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
+    # "auto": flash on TPU, xla elsewhere. "ring"/"ulysses"/"allgather": sequence-
+    # parallel attention over an sp mesh axis (same dispatcher as llama; packing
+    # composes). sp modes are flat-path only for gpt — loss_fn_pp raises under an
+    # active sp mesh rather than nesting shard_maps (use the llama family for sp x pp).
+    attn_impl: str = "auto"
     remat: bool = True
     remat_policy: str = "full"            # "full" | "dots" | "offload" (see models/common.py)
     remat_prevent_cse: Optional[bool] = None  # None = auto (False under scan_layers)
@@ -261,12 +266,25 @@ def _attn_out(probs_v, layer, cfg: GPTConfig, B, T):
     return out @ layer["wo"].astype(out.dtype) + layer["b_o"].astype(out.dtype)
 
 
-def _attention(q, k, v, mask):
+def _attention_xla(q, k, v, mask):
+    """gpt's reference attention path (H == K, no GQA): q/k/v [B,S,H,hd]."""
     hd = q.shape[-1]
     scores = jnp.einsum("bshd,bthd->bhst", q, k) / math.sqrt(hd)
-    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    scores = jnp.where(mask[:, None, :, :], scores, jnp.finfo(scores.dtype).min)
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
     return jnp.einsum("bhst,bthd->bshd", probs, v)
+
+
+def _attention(q, k, v, mask, cfg: "GPTConfig", segment_ids=None):
+    """Family attention via the shared dispatcher (``common.attention_dispatch``):
+    flash on TPU (segment ids in-kernel for packed rows), the sp modes over an sp
+    mesh, xla fallback elsewhere."""
+    from .common import attention_dispatch
+
+    return attention_dispatch(
+        q, k, v, mask, impl=cfg.attn_impl, sm_scale=1.0 / math.sqrt(q.shape[-1]),
+        segment_ids=segment_ids, xla_attention=_attention_xla,
+    )
 
 
 def _mlp(h, layer, dtype, activation="gelu_new"):
@@ -275,11 +293,11 @@ def _mlp(h, layer, dtype, activation="gelu_new"):
     return act @ layer["w_down"].astype(dtype) + layer["b_down"].astype(dtype)
 
 
-def _block(x, layer, positions, mask, cfg: GPTConfig):
+def _block(x, layer, positions, mask, cfg: GPTConfig, segment_ids=None):
     B, T, D = x.shape
     h = _layer_norm(x, layer["ln_attn"], cfg.norm_eps)
     q, k, v = _qkv(h, layer, positions, cfg)
-    attn = _attn_out(_attention(q, k, v, mask[:, None, :, :]), layer, cfg, B, T)
+    attn = _attn_out(_attention(q, k, v, mask, cfg, segment_ids), layer, cfg, B, T)
     if cfg.parallel_residual:
         # GPT-J/NeoX: MLP reads the SAME pre-norm stream; both branches add at once.
         h2 = _layer_norm(x, layer["ln_mlp"], cfg.norm_eps)
@@ -338,7 +356,7 @@ def forward(
     )
     if cfg.scan_layers:
         def body(carry, layer):
-            out = block(carry, layer, positions, mask, cfg)
+            out = block(carry, layer, positions, mask, cfg, segment_ids)
             if shard_activations:
                 out = _maybe_shard(out, P(BATCH_AXES, SEQUENCE_AXIS, None))
             return out, None
@@ -346,7 +364,7 @@ def forward(
         x, _ = jax.lax.scan(body, x, params["layers"], unroll=cfg.scan_unroll)
     else:
         for layer in params["layers"]:
-            x = block(x, layer, positions, mask, cfg)
+            x = block(x, layer, positions, mask, cfg, segment_ids)
     x = _layer_norm(x, params["ln_f"], cfg.norm_eps)
     if return_hidden:
         return x
@@ -414,9 +432,9 @@ def _pp_stage_fn(cfg: GPTConfig, S: int, packed: bool = False):
         prevent_cse=cfg.remat_prevent_cse, scan_layers=True, static_argnums=(4,),
     )
 
-    def body_scan(x, stage_layers, pos, mask):
+    def body_scan(x, stage_layers, pos, mask, seg=None):
         def body(carry, layer):
-            return block(carry, layer, pos, mask, cfg), None
+            return block(carry, layer, pos, mask, cfg, seg), None
 
         out, _ = jax.lax.scan(body, x, stage_layers)
         return out
@@ -425,9 +443,8 @@ def _pp_stage_fn(cfg: GPTConfig, S: int, packed: bool = False):
         from .llama import segment_mask
 
         def stage_fn(stage_layers, x, side):
-            return body_scan(
-                x, stage_layers, side["positions"], segment_mask(side["segment_ids"])
-            )
+            seg = side["segment_ids"]
+            return body_scan(x, stage_layers, side["positions"], segment_mask(seg), seg)
 
         return stage_fn
 
@@ -437,6 +454,23 @@ def _pp_stage_fn(cfg: GPTConfig, S: int, packed: bool = False):
         return body_scan(x, stage_layers, pos, mask)
 
     return stage_fn
+
+
+def _guard_sp_under_pp(cfg: "GPTConfig", mesh) -> None:
+    """gpt's pipeline does not go manual over sp (the llama family does — see
+    llama.loss_fn_pp's sp_pipeline): an sp attention mode inside the pipeline's
+    shard_map would nest make_sp_attention's own shard_map, which fails to lower on
+    the backward. Fail loudly with the supported alternatives."""
+    from .common import sp_active
+
+    if cfg.attn_impl in ("ring", "ulysses", "allgather") and (
+        sp_active(mesh) or sp_active(jax.sharding.get_abstract_mesh())
+    ):
+        raise NotImplementedError(
+            "gpt attn_impl sp modes (ring/ulysses/allgather) are flat-path only: the "
+            "gpt pipeline does not go manual over sp. Drop the pp axis, use "
+            "attn_impl='auto' under pp, or use the llama family for sp x pp."
+        )
 
 
 def forward_pp(
@@ -454,6 +488,7 @@ def forward_pp(
     inference-only). ``params["layers"]`` stage-stacked [n_stages, L/n, ...]; embed and
     ln_f/head outside the pipe, vocab-sharded over (tp, fsdp, pp) by
     ``partition_specs(pp=True)``. Dense attention path (no packing)."""
+    _guard_sp_under_pp(cfg, mesh)
     from .llama import _maybe_shard
     from ..parallel.pp import make_pipeline_fn
 
@@ -523,6 +558,7 @@ def loss_fn_pp(
         raise NotImplementedError(
             "virtual_stages > 1 requires schedule='1f1b' (parallel/pp.py)"
         )
+    _guard_sp_under_pp(cfg, mesh)
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     B, S = inputs.shape

@@ -459,72 +459,20 @@ def _attention_xla(q, k, v, mask, cfg: LlamaConfig):
     return jnp.einsum("bkgst,btkd->bskgd", probs, v).reshape(B, S, H, hd)
 
 
-def _sp_active(mesh) -> bool:
-    """Does this mesh (concrete or abstract; may be None) engage the sp axis? The ONE
-    copy of the sequence-parallel activation predicate — shared by ``_attention`` (on
-    the ambient mesh) and ``loss_fn_pp``'s sp-under-pp dispatch (on its mesh argument)."""
-    return mesh is not None and not mesh.empty and mesh.shape.get(SEQUENCE_AXIS, 1) > 1
-
-
-def _sp_manual(mesh) -> bool:
-    """Is the sp axis already MANUAL in this context — i.e. are we inside a shard_map
-    whose manual axes include sp (the pipeline's sp×pp composition)? Then the sp
-    collectives (``lax.ppermute`` KV rotation / all_to_all) must be issued directly;
-    wrapping another shard_map would nest, which fails to lower on the backward."""
-    try:
-        types = dict(zip(mesh.axis_names, mesh.axis_types))
-        return types.get(SEQUENCE_AXIS) == jax.sharding.AxisType.Manual
-    except Exception:
-        return False
+from .common import sp_active as _sp_active, sp_manual as _sp_manual  # noqa: E402
 
 
 def _attention(q, k, v, mask, cfg: LlamaConfig, segment_ids=None):
-    impl = cfg.attn_impl
-    if impl in ("ring", "ulysses", "allgather"):
-        # Sequence-parallel attention over the sp mesh axis (requires an active mesh
-        # context with sp > 1; falls back to local attention otherwise). Sliding windows
-        # and score capping flow into the kernels with GLOBAL offsets, so they stay
-        # correct across the sequence shards.
-        mesh = jax.sharding.get_abstract_mesh()
-        if _sp_active(mesh):
-            if _sp_manual(mesh):
-                # Already inside a manual-sp shard_map (the pipeline made sp manual):
-                # issue the ring/ulysses collectives directly — one flat shard_map.
-                # segment_ids here are the LOCAL sequence slice (the caller sliced
-                # activations and sides alike).
-                from ..parallel.sequence import sequence_parallel_attention
+    """Family attention via the shared dispatcher (``common.attention_dispatch``):
+    sliding windows, Gemma score capping, packing, and the sp modes all flow through;
+    the XLA fallback keeps llama's grouped-GQA einsum."""
+    from .common import attention_dispatch
 
-                return sequence_parallel_attention(
-                    q, k, v, mode=impl, axis_name=SEQUENCE_AXIS, causal=True,
-                    window=cfg.sliding_window, softcap=cfg.attn_softcap,
-                    sm_scale=_sm_scale(cfg), segment_ids=segment_ids,
-                )
-            from ..parallel.sequence import make_sp_attention
-
-            attn = make_sp_attention(
-                mesh, mode=impl, axis_name=SEQUENCE_AXIS, causal=True,
-                window=cfg.sliding_window, softcap=cfg.attn_softcap,
-                sm_scale=_sm_scale(cfg),
-            )
-            # Packed rows ride along: the GLOBAL [B, S] segment ids shard over sp inside
-            # make_sp_attention (ring rotates the kv slice, ulysses/allgather gather).
-            return attn(q, k, v, segment_ids=segment_ids)
-        impl = "auto"
-    if impl == "auto":
-        impl = "flash" if jax.default_backend() in ("tpu", "axon") else "xla"
-    if impl == "flash":
-        try:
-            from ..ops.flash_attention import flash_attention
-
-            # Packed rows stay on the flash path: the kernels take segment ids directly.
-            # Gemma score capping is in-kernel too (with its exact backward chain rule).
-            return flash_attention(
-                q, k, v, causal=True, segment_ids=segment_ids, window=cfg.sliding_window,
-                sm_scale=_sm_scale(cfg), softcap=cfg.attn_softcap,
-            )
-        except Exception:  # pragma: no cover - kernel unavailable on this backend
-            pass
-    return _attention_xla(q, k, v, mask, cfg)
+    return attention_dispatch(
+        q, k, v, mask, impl=cfg.attn_impl, sm_scale=_sm_scale(cfg),
+        window=cfg.sliding_window, softcap=cfg.attn_softcap, segment_ids=segment_ids,
+        xla_attention=lambda q, k, v, m: _attention_xla(q, k, v, m, cfg),
+    )
 
 
 def _proj(h, w, cfg: LlamaConfig):

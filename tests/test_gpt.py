@@ -134,3 +134,75 @@ def test_score_matches_loss_fn():
     np.testing.assert_allclose(
         -float(np.asarray(ll).mean()), float(np.asarray(loss)), rtol=1e-5
     )
+
+
+def test_flash_attention_matches_xla():
+    """attn_impl='flash' (interpret mode on CPU) == the xla reference path — forward
+    logits and loss grads, dense AND packed (segment ids in-kernel)."""
+    params = gpt.init_params(CFG)
+    cfg_flash = dataclasses.replace(CFG, attn_impl="flash")
+    cfg_xla = dataclasses.replace(CFG, attn_impl="xla")
+    tokens = jnp.asarray(make_batch(2, 32)["tokens"])
+    batches = [{"tokens": tokens}]
+    seg = np.zeros((2, 33), np.int32)
+    seg[:, :20] = 1
+    seg[:, 20:29] = 2  # trailing 4 slots pad
+    batches.append({"tokens": tokens, "segment_ids": jnp.asarray(seg)})
+    for batch in batches:
+        l_f = float(gpt.loss_fn(params, batch, cfg_flash))
+        l_x = float(gpt.loss_fn(params, batch, cfg_xla))
+        np.testing.assert_allclose(l_f, l_x, rtol=2e-5)
+        g_f = jax.grad(lambda p: gpt.loss_fn(p, batch, cfg_flash))(params)
+        g_x = jax.grad(lambda p: gpt.loss_fn(p, batch, cfg_xla))(params)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=3e-5
+            ),
+            g_f, g_x,
+        )
+
+
+@slow
+def test_ring_attention_matches_local():
+    """gpt attn_impl='ring' on a dp2 x sp4 mesh == the local xla baseline (the shared
+    dispatcher gives the gpt family the sp modes on the flat path), packed included."""
+    from accelerate_tpu.parallel import build_mesh
+
+    cfg_ring = dataclasses.replace(CFG, attn_impl="ring")
+    cfg_ref = dataclasses.replace(CFG, attn_impl="xla")
+    params = gpt.init_params(CFG)
+    tokens = jnp.asarray(make_batch(4, 64)["tokens"])
+    seg = np.zeros((4, 65), np.int32)
+    seg[:, :40] = 1
+    seg[:, 40:60] = 2
+    batch = {"tokens": tokens, "segment_ids": jnp.asarray(seg)}
+    base = float(gpt.loss_fn(params, batch, cfg_ref))
+    base_g = jax.grad(lambda p: gpt.loss_fn(p, batch, cfg_ref))(params)
+    mesh = build_mesh(MeshConfig(dp=2, sp=4))
+    with jax.set_mesh(mesh):
+        l = float(jax.jit(lambda p, b: gpt.loss_fn(p, b, cfg_ring))(params, batch))
+        g = jax.jit(jax.grad(lambda p, b: gpt.loss_fn(p, b, cfg_ring)))(params, batch)
+    np.testing.assert_allclose(l, base, rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5
+        ),
+        g, base_g,
+    )
+
+
+def test_sp_under_pp_raises_with_rationale():
+    """gpt's pipeline does not go manual over sp: an sp attn_impl with an active sp
+    mesh must fail loudly at the pipeline entry points, not hang at lowering."""
+    from accelerate_tpu.parallel import build_mesh
+    from accelerate_tpu.parallel.pp import split_params_into_stages
+
+    cfg = dataclasses.replace(CFG, attn_impl="ring", scan_layers=True, n_layers=4)
+    params = gpt.init_params(cfg)
+    sp = dict(params)
+    sp["layers"] = split_params_into_stages(params["layers"], 2)
+    mesh = build_mesh(MeshConfig(sp=2, pp=2, dp=2))
+    batch = {"tokens": jnp.asarray(make_batch(4, 32)["tokens"])}
+    with pytest.raises(NotImplementedError, match="flat-path only"):
+        with jax.set_mesh(mesh):
+            gpt.loss_fn_pp(sp, batch, cfg, mesh)
