@@ -44,10 +44,13 @@ def prepare_pippy(
 ):
     """Model params → (stage-sharded params, jitted pipelined logits fn).
 
-    - ``cfg`` selects the family by type: ``models.llama.LlamaConfig`` or
-      ``models.gpt.GPTConfig`` (both expose the same pp contract —
-      ``partition_specs(pp=True)`` / ``forward_pp``; the reference's ``prepare_pippy``
-      is likewise model-generic, ``inference.py:124``).
+    - ``cfg`` selects the family by type — llama, gpt, bert, or t5 (the reference's
+      pippy examples cover the same four: ``examples/inference/pippy/{llama,gpt2,
+      bert,t5}.py``; its ``prepare_pippy`` is likewise model-generic,
+      ``inference.py:124``). Decoder families return ``forward(tokens)``; bert
+      returns ``forward(input_ids, attention_mask=None, token_type_ids=None)``
+      (classification logits); t5 returns ``forward(input_ids, decoder_input_ids)``
+      (seq2seq LM logits).
     - ``params``: family params with per-layer list OR scan-stacked layers; they
       are stage-stacked ``[n_stages, L/n, ...]`` and placed with
       ``partition_specs(cfg, pp=True)`` (stage dim over the mesh ``pp`` axis).
@@ -60,16 +63,21 @@ def prepare_pippy(
     import dataclasses
 
     from jax.sharding import NamedSharding
-    from .models import gpt, llama
+    from .models import bert as bert_mod, gpt, llama, t5 as t5_mod
     from .parallel.pp import split_params_into_stages, stack_stage_params
 
     if isinstance(cfg, gpt.GPTConfig):
         family = gpt
     elif isinstance(cfg, llama.LlamaConfig):
         family = llama
+    elif isinstance(cfg, bert_mod.BertConfig):
+        family = bert_mod
+    elif isinstance(cfg, t5_mod.T5Config):
+        family = t5_mod
     else:
         raise TypeError(
-            f"prepare_pippy supports llama/gpt family configs, got {type(cfg).__name__}"
+            f"prepare_pippy supports llama/gpt/bert/t5 family configs, "
+            f"got {type(cfg).__name__}"
         )
 
     if mesh is None:
@@ -79,6 +87,40 @@ def prepare_pippy(
     n_stages = mesh.shape[PIPELINE_AXIS]
     if split_points != "auto":
         raise ValueError("only split_points='auto' (even layer split) is supported")
+
+    if family in (bert_mod, t5_mod):
+        # Encoder / enc-dec families: stack_pp_params handles their layouts (bert's
+        # homogeneous block list; t5's rel-bias lift + per-stack stages).
+        pp_params = family.stack_pp_params(params, cfg, n_stages)
+        specs = family.partition_specs(cfg, pp=True)
+        pp_params = jax.tree_util.tree_map(
+            lambda leaf, spec: jax.device_put(leaf, NamedSharding(mesh, spec)),
+            pp_params, specs,
+        )
+        if family is bert_mod:
+            def fwd(input_ids, attention_mask=None, token_type_ids=None):
+                return bert_mod.forward_pp(
+                    pp_params, input_ids, cfg, mesh,
+                    num_microbatches=num_microbatches,
+                    attention_mask=attention_mask, token_type_ids=token_type_ids,
+                )
+        else:
+            def fwd(input_ids, decoder_input_ids):
+                return t5_mod.forward_pp(
+                    pp_params, input_ids, decoder_input_ids, cfg, mesh,
+                    num_microbatches=num_microbatches,
+                )
+        jitted_fwd = jax.jit(fwd)
+
+        def with_mesh_multi(*args, **kwargs):
+            with jax.set_mesh(mesh):
+                return jitted_fwd(
+                    *(jnp.asarray(a, jnp.int32) if a is not None else None for a in args),
+                    **{k: (jnp.asarray(v, jnp.int32) if v is not None else None)
+                       for k, v in kwargs.items()},
+                )
+
+        return pp_params, with_mesh_multi
 
     if not cfg.scan_layers:
         cfg = dataclasses.replace(cfg, scan_layers=True)
