@@ -63,7 +63,7 @@ class GPTConfig:
                                       # (gpt-j rotary_dim, neox rotary_pct); None = full
     rope_style: str = "half"      # "half" (neox rotate-half) | "interleaved" (gpt-j)
     parallel_residual: bool = False  # gpt-j/neox style
-    activation: str = "gelu_new"  # "gelu_new" (gpt2/gpt-j tanh approx) | "gelu" (neox exact)
+    activation: str = "gelu_new"  # "gelu_new" (gpt2/gpt-j tanh) | "gelu" (neox exact) | "relu" (OPT)
     lm_head_bias: bool = False    # gpt-j's lm_head carries a bias
     norm_eps: float = 1e-5
     dtype: Any = jnp.bfloat16
@@ -289,7 +289,10 @@ def _attention(q, k, v, mask, cfg: "GPTConfig", segment_ids=None):
 
 def _mlp(h, layer, dtype, activation="gelu_new"):
     up = h @ layer["w_up"].astype(dtype) + layer["b_up"].astype(dtype)
-    act = jax.nn.gelu(up, approximate=(activation == "gelu_new"))
+    if activation == "relu":
+        act = jax.nn.relu(up)  # OPT's MLP nonlinearity
+    else:
+        act = jax.nn.gelu(up, approximate=(activation == "gelu_new"))
     return act @ layer["w_down"].astype(dtype) + layer["b_down"].astype(dtype)
 
 

@@ -386,6 +386,89 @@ def gptj_from_hf(state_dict: Mapping[str, Any], cfg) -> dict:
     return _to_jnp(params)
 
 
+def opt_config_from_hf(hf_config: Any, **overrides):
+    """GPTConfig from a transformers OPTConfig — the reference's 30B inference
+    baseline family (``/root/reference/benchmarks/big_model_inference/README.md:36``):
+    pre-LN decoder, learned positions (offset baked out by the converter), separate
+    biased q/k/v projections, ReLU MLP, tied head."""
+    from .gpt import GPTConfig
+
+    get = _getter(hf_config)
+    if get("word_embed_proj_dim", get("hidden_size")) != get("hidden_size"):
+        raise NotImplementedError(
+            "OPT word_embed_proj (the 350m in/out projection) is not supported"
+        )
+    if not get("do_layer_norm_before", True):
+        raise NotImplementedError("post-norm OPT (350m) is not supported")
+    kwargs = dict(
+        vocab_size=get("vocab_size"),
+        d_model=get("hidden_size"),
+        n_layers=get("num_hidden_layers"),
+        n_heads=get("num_attention_heads"),
+        d_ff=get("ffn_dim"),
+        max_seq=get("max_position_embeddings", 2048),
+        pos="learned",
+        activation=get("activation_function", "relu"),
+        tie_embeddings=True,
+    )
+    kwargs.update(overrides)
+    return GPTConfig(**kwargs)
+
+
+def opt_from_hf(state_dict: Mapping[str, Any], cfg) -> dict:
+    """transformers OPTForCausalLM state dict → ``models.gpt`` params pytree.
+
+    OPT's learned positional table carries a +2 row offset
+    (``OPTLearnedPositionalEmbedding``: position i reads row i+2 for a pad-free
+    sequence); the converter slices those two rows off so our 0-based ``positions``
+    index the table directly. Separate q/k/v torch Linears concatenate role-major
+    into the fused ``wqkv`` layout."""
+    sd = {re.sub(r"^(model\.)?decoder\.", "", k): v for k, v in state_dict.items()}
+
+    def take(name):
+        return _np(sd[name])
+
+    params: dict = {
+        "wte": take("embed_tokens.weight"),
+        "wpe": take("embed_positions.weight")[2:],
+        "ln_f": {
+            "scale": take("final_layer_norm.weight"),
+            "bias": take("final_layer_norm.bias"),
+        },
+        "layers": [],
+    }
+    for i in range(cfg.n_layers):
+        p = f"layers.{i}."
+        wq = take(p + "self_attn.q_proj.weight").T
+        wk = take(p + "self_attn.k_proj.weight").T
+        wv = take(p + "self_attn.v_proj.weight").T
+        params["layers"].append({
+            "ln_attn": {
+                "scale": take(p + "self_attn_layer_norm.weight"),
+                "bias": take(p + "self_attn_layer_norm.bias"),
+            },
+            "wqkv": np.concatenate([wq, wk, wv], axis=1),
+            "b_qkv": np.concatenate([
+                take(p + "self_attn.q_proj.bias"),
+                take(p + "self_attn.k_proj.bias"),
+                take(p + "self_attn.v_proj.bias"),
+            ]),
+            "wo": take(p + "self_attn.out_proj.weight").T,
+            "b_o": take(p + "self_attn.out_proj.bias"),
+            "ln_mlp": {
+                "scale": take(p + "final_layer_norm.weight"),
+                "bias": take(p + "final_layer_norm.bias"),
+            },
+            "w_up": take(p + "fc1.weight").T,
+            "b_up": take(p + "fc1.bias"),
+            "w_down": take(p + "fc2.weight").T,
+            "b_down": take(p + "fc2.bias"),
+        })
+    if cfg.scan_layers:
+        params["layers"] = _stack_layers(params["layers"])
+    return _to_jnp(params)
+
+
 def _map_gelu(hidden_act: str) -> str:
     """HF activation name → GPTConfig.activation; raise on anything unmapped rather than
     silently computing wrong logits with a different activation."""

@@ -390,3 +390,26 @@ def test_bert_logits_match_transformers():
         params, jnp.asarray(ids), jnp.asarray(am), jnp.asarray(tt), cfg
     ))
     np.testing.assert_allclose(ours, hf_logits, atol=2e-4, rtol=1e-3)
+
+
+def test_opt_logits_match_transformers():
+    """OPT (pre-LN decoder, learned positions with the +2 table offset, separate
+    biased qkv Linears, ReLU MLP, tied head) — the reference's 30B disk-offload
+    baseline family, checked against transformers itself."""
+    hf_cfg = transformers.OPTConfig(
+        vocab_size=96, hidden_size=48, num_hidden_layers=2, num_attention_heads=4,
+        ffn_dim=96, max_position_embeddings=64, word_embed_proj_dim=48,
+        do_layer_norm_before=True, activation_function="relu",
+    )
+    torch.manual_seed(0)
+    hf_model = transformers.OPTForCausalLM(hf_cfg).eval()
+
+    cfg = hf_interop.opt_config_from_hf(hf_cfg, dtype=jnp.float32, remat=False)
+    assert cfg.activation == "relu" and cfg.tie_embeddings
+    params = hf_interop.opt_from_hf(hf_model.state_dict(), cfg)
+
+    tokens = np.random.default_rng(5).integers(0, 96, size=(2, 12)).astype(np.int32)
+    with torch.no_grad():
+        hf_logits = hf_model(torch.from_numpy(tokens.astype(np.int64))).logits.numpy()
+    ours = np.asarray(gpt.forward(params, jnp.asarray(tokens), cfg, shard_activations=False))
+    np.testing.assert_allclose(ours, hf_logits, atol=2e-4, rtol=1e-3)
