@@ -105,7 +105,7 @@ CONFIGS = {
     # architecturally GPT-2-shaped at 30B scale.
     "opt-30b": GPTConfig(
         vocab_size=50272, d_model=7168, n_layers=48, n_heads=56, d_ff=28672,
-        pos="learned", tie_embeddings=True, max_seq=2048,
+        pos="learned", activation="relu", tie_embeddings=True, max_seq=2048,
     ),
     "tiny": GPTConfig(
         vocab_size=256, d_model=128, n_layers=2, n_heads=4, d_ff=256, max_seq=128,
@@ -765,6 +765,16 @@ def generate(
     _GEN_FNS.move_to_end(key)
     prefill_fn, decode_fn = _GEN_FNS[key]
     return generate_loop(prefill_fn, decode_fn, params, prompt, prompt_mask, gen, rng)
+
+
+def generate_speculative(target_params, target_cfg, draft_params, draft_cfg, prompt,
+                         **kwargs):
+    """Speculative decoding for gpt-family targets/drafts — delegates to the
+    family-generic implementation (``llama.generate_speculative``; both families
+    share the cached-decode contract). Cross-family pairs work too."""
+    from .llama import generate_speculative as _generic
+
+    return _generic(target_params, target_cfg, draft_params, draft_cfg, prompt, **kwargs)
 
 
 def generate_streamed(

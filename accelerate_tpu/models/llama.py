@@ -1536,6 +1536,21 @@ def num_params(cfg: LlamaConfig) -> int:
 
 
 # -------------------------------------------------------------------- speculative decoding
+def _cached_family(cfg):
+    """Family module for a config — llama or gpt, which share the cached-decode
+    contract (``init_cache`` / ``forward_cached`` over ``{layers, valid, index}``;
+    gpt reuses llama's ``_cache_advance``). Lets the speculative decoder drive
+    either family, including cross-family draft/target pairs (e.g. an OPT target
+    with a gpt2 draft) as long as the vocabularies match."""
+    import sys
+
+    from . import gpt as _gpt
+
+    if isinstance(cfg, _gpt.GPTConfig):
+        return _gpt
+    return sys.modules[__name__]
+
+
 def _cache_rewind(cache: dict, to_index) -> dict:
     """Roll a cache back to ``to_index`` written tokens: later slots become invalid (their
     k/v are garbage from rejected drafts and are masked; the next writes overwrite them)."""
@@ -1552,7 +1567,7 @@ def _cache_rewind(cache: dict, to_index) -> dict:
 def _spec_forward_jit(params, tokens, cache, cfg):
     """forward_cached + per-position argmax (used for both the T=K verify and T=1 steps).
     The input cache is donated — callers always replace their reference with the output."""
-    logits, cache = forward_cached(params, tokens, cache, cfg)
+    logits, cache = _cached_family(cfg).forward_cached(params, tokens, cache, cfg)
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
 
@@ -1565,7 +1580,7 @@ def _spec_probs_jit(params, tokens, cache, cfg, temperature, top_p, top_k, apply
     sampling-irrelevant GenerationConfig fields never recompiles the model."""
     from ..generation import filtered_logits
 
-    logits, cache = forward_cached(params, tokens, cache, cfg)
+    logits, cache = _cached_family(cfg).forward_cached(params, tokens, cache, cfg)
     fl = filtered_logits(logits, temperature, top_p, top_k, apply_top_p)
     return jax.nn.softmax(fl, axis=-1), cache
 
@@ -1595,6 +1610,10 @@ def generate_speculative(
     distribution asserted in tests). The draft only changes how many target forwards it
     takes. The reference has no speculative path. Single sequence (B=1): speculation is a
     latency tool for individual streams; batch throughput is ``serving.ContinuousBatcher``.
+
+    Family-generic over the shared cached-decode contract (``_cached_family``): target
+    and draft may each be llama or gpt configs — including cross-family pairs (an OPT
+    target with a gpt2 draft) — as long as the vocabularies match.
 
     Round invariant: both caches hold the emitted sequence EXCEPT the newest token
     (``pending``), which rides as the first input of the next round's forwards — so the
@@ -1634,12 +1653,14 @@ def generate_speculative(
     # program per token shape (the valid-mask machinery makes an over-long cache identical).
     max_len = -(-(S0 + max_new_tokens + k + 1) // 64) * 64
 
-    t_cache = init_cache(target_cfg, 1, max_len)
-    d_cache = init_cache(draft_cfg, 1, max_len)
-    t_logits, t_cache = forward_cached(
+    fam_t = _cached_family(target_cfg)
+    fam_d = _cached_family(draft_cfg)
+    t_cache = fam_t.init_cache(target_cfg, 1, max_len)
+    d_cache = fam_d.init_cache(draft_cfg, 1, max_len)
+    t_logits, t_cache = fam_t.forward_cached(
         target_params, prompt, t_cache, target_cfg, token_mask=prompt_mask, last_only=True
     )
-    _, d_cache = forward_cached(
+    _, d_cache = fam_d.forward_cached(
         draft_params, prompt, d_cache, draft_cfg, token_mask=prompt_mask, last_only=True
     )
     # ``pending``: emitted but not yet written to either cache.

@@ -284,3 +284,56 @@ class TestSpeculative:
             rng=jax.random.PRNGKey(11),
         ))
         np.testing.assert_array_equal(a, b)
+
+
+class TestSpeculativeGPT:
+    """Speculative decoding is family-generic: gpt targets/drafts (and cross-family
+    pairs) ride the same cached-decode contract."""
+
+    def _gpt_models(self):
+        from accelerate_tpu.models import gpt
+
+        tc = dataclasses.replace(gpt.CONFIGS["tiny"], dtype=jnp.float32)
+        dc = dataclasses.replace(
+            gpt.CONFIGS["tiny"], dtype=jnp.float32, n_layers=1, d_model=64, n_heads=2,
+            d_ff=128,
+        )
+        return (gpt.init_params(tc, jax.random.PRNGKey(0)), tc,
+                gpt.init_params(dc, jax.random.PRNGKey(1)), dc)
+
+    def test_gpt_matches_plain_greedy(self):
+        from accelerate_tpu.models import gpt
+
+        tp, tc, dp, dc = self._gpt_models()
+        rng = np.random.default_rng(0)
+        prompt = rng.integers(1, tc.vocab_size, 7).astype(np.int32)
+        got = np.asarray(gpt.generate_speculative(
+            tp, tc, dp, dc, prompt, max_new_tokens=10, k=3
+        ))[0].tolist()
+        want = np.asarray(gpt.generate(
+            tp, prompt[None], tc, GenerationConfig(max_new_tokens=10, temperature=0.0)
+        ))[0].tolist()
+        assert got == want
+
+    @slow
+    def test_cross_family_llama_draft(self):
+        """A llama draft speculating for a gpt target (vocabularies match at 256):
+        greedy output still equals the gpt target's own greedy decode."""
+        from accelerate_tpu.models import gpt
+
+        tp, tc, _, _ = self._gpt_models()
+        dc = dataclasses.replace(
+            llama.CONFIGS["tiny"], dtype=jnp.float32, n_layers=1, d_model=64,
+            n_heads=2, n_kv_heads=1, d_ff=128,
+        )
+        assert dc.vocab_size == tc.vocab_size
+        dp = llama.init_params(dc, jax.random.PRNGKey(2))
+        rng = np.random.default_rng(1)
+        prompt = rng.integers(1, tc.vocab_size, 6).astype(np.int32)
+        got = np.asarray(gpt.generate_speculative(
+            tp, tc, dp, dc, prompt, max_new_tokens=9, k=3
+        ))[0].tolist()
+        want = np.asarray(gpt.generate(
+            tp, prompt[None], tc, GenerationConfig(max_new_tokens=9, temperature=0.0)
+        ))[0].tolist()
+        assert got == want
