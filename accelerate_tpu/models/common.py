@@ -122,6 +122,24 @@ def _softcap(scores: jax.Array, cap: float) -> jax.Array:
     return cap * jnp.tanh(scores / cap) if cap else scores
 
 
+def cached_decode_family(cfg):
+    """Resolve the family module owning a config's cached-decode contract
+    (``init_cache`` / ``forward_cached`` over ``{layers, valid, index}``): llama or
+    gpt. Raises for families without one (bert/t5) — the same loud failure
+    ``inference.prepare_pippy`` gives unknown configs."""
+    from . import gpt as _gpt
+    from . import llama as _llama
+
+    if isinstance(cfg, _gpt.GPTConfig):
+        return _gpt
+    if isinstance(cfg, _llama.LlamaConfig):
+        return _llama
+    raise TypeError(
+        f"no cached-decode family for {type(cfg).__name__}: expected a LlamaConfig "
+        "or GPTConfig (bert/t5 have no KV-cache decode contract)"
+    )
+
+
 # ------------------------------------------------------------- attention dispatch (shared)
 def sp_active(mesh) -> bool:
     """Does this mesh (concrete or abstract; may be None) engage the sp axis? The ONE

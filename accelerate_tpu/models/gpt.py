@@ -40,6 +40,7 @@ __all__ = [
     "partition_specs",
     "forward_pp",
     "loss_fn_pp",
+    "generate_speculative",
     "head_logits",
     "init_cache",
     "forward_cached",

@@ -1537,18 +1537,14 @@ def num_params(cfg: LlamaConfig) -> int:
 
 # -------------------------------------------------------------------- speculative decoding
 def _cached_family(cfg):
-    """Family module for a config — llama or gpt, which share the cached-decode
-    contract (``init_cache`` / ``forward_cached`` over ``{layers, valid, index}``;
-    gpt reuses llama's ``_cache_advance``). Lets the speculative decoder drive
-    either family, including cross-family draft/target pairs (e.g. an OPT target
-    with a gpt2 draft) as long as the vocabularies match."""
-    import sys
+    """Family module for a config — ``common.cached_decode_family`` (llama or gpt,
+    which share the cached-decode contract; gpt reuses llama's ``_cache_advance``).
+    Lets the speculative decoder drive either family, including cross-family
+    draft/target pairs (e.g. a gpt target with a small llama draft) as long as the
+    vocabularies match. Raises TypeError for families without a decode contract."""
+    from .common import cached_decode_family
 
-    from . import gpt as _gpt
-
-    if isinstance(cfg, _gpt.GPTConfig):
-        return _gpt
-    return sys.modules[__name__]
+    return cached_decode_family(cfg)
 
 
 def _cache_rewind(cache: dict, to_index) -> dict:
@@ -1587,9 +1583,9 @@ def _spec_probs_jit(params, tokens, cache, cfg, temperature, top_p, top_k, apply
 
 def generate_speculative(
     target_params: dict,
-    target_cfg: LlamaConfig,
+    target_cfg,   # LlamaConfig | GPTConfig (see _cached_family)
     draft_params: dict,
-    draft_cfg: LlamaConfig,
+    draft_cfg,    # LlamaConfig | GPTConfig
     prompt: jax.Array,
     max_new_tokens: int = 32,
     k: int = 4,
@@ -1612,8 +1608,9 @@ def generate_speculative(
     latency tool for individual streams; batch throughput is ``serving.ContinuousBatcher``.
 
     Family-generic over the shared cached-decode contract (``_cached_family``): target
-    and draft may each be llama or gpt configs — including cross-family pairs (an OPT
-    target with a gpt2 draft) — as long as the vocabularies match.
+    and draft may each be llama or gpt configs — including cross-family pairs (e.g. a
+    gpt-family target speculated by a small llama draft, as the tests do) — as long as
+    the vocabularies match.
 
     Round invariant: both caches hold the emitted sequence EXCEPT the newest token
     (``pending``), which rides as the first input of the next round's forwards — so the
