@@ -558,6 +558,7 @@ def main():
         return 0  # structured output was produced; don't fail the driver parse
 
     transient_left = 3
+    xla_retry_done = False
     while True:
         try:
             run(B, S, fuse, preset)
@@ -575,6 +576,28 @@ def main():
                 # could never match a later failure's label.
                 metric = _metric_label(B, S, fuse, preset)
                 print(f"bench: OOM, retrying with batch {B}", file=sys.stderr)
+                continue
+            msg = f"{type(e).__name__}: {e}"
+            compile_service_failure = (
+                "remote_compile" in msg or "tpu_compile_helper" in msg
+                or "Mosaic" in msg
+            )
+            if (compile_service_failure and not xla_retry_done
+                    and _os.environ.get("BENCH_ATTN") is None):
+                # 2026-08-01 window: the compile helper 500'd on never-before-compiled
+                # Pallas programs while plain XLA compiled fine. A fresh pure-XLA row
+                # (honestly labeled "xla" by _metric_label) beats another stale round —
+                # one retry, only when the caller didn't pin BENCH_ATTN themselves.
+                xla_retry_done = True
+                _os.environ["BENCH_ATTN"] = "xla"
+                # The xla row is the LIVE result (fresh, honestly "xla"-labeled) but
+                # must not stomp the flash-labeled last-known-good record that the
+                # flash-config fallback path matches by metric label.
+                _os.environ["BENCH_NO_SELF_RECORD"] = "1"
+                metric = _metric_label(B, S, fuse, preset)
+                print("bench: compile-service failure on the flash path; retrying once "
+                      f"with BENCH_ATTN=xla for a fresh pure-XLA row ({exc_line(e, 150)})",
+                      file=sys.stderr)
                 continue
             if _is_transient(e) and transient_left > 0:
                 transient_left -= 1
