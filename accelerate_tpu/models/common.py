@@ -177,7 +177,7 @@ def attention_dispatch(q, k, v, mask, *, impl: str, sm_scale: float, window: int
     - ``xla_attention(q, k, v, mask)`` is the family's reference path (fallback)."""
     from ..utils.constants import SEQUENCE_AXIS
 
-    if impl in ("ring", "ulysses", "allgather"):
+    if impl in ("ring", "ulysses", "ulysses_ppermute", "allgather"):
         mesh = jax.sharding.get_abstract_mesh()
         if sp_active(mesh):
             if sp_manual(mesh):

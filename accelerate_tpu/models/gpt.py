@@ -467,7 +467,7 @@ def _guard_sp_under_pp(cfg: "GPTConfig", mesh) -> None:
     the backward. Fail loudly with the supported alternatives."""
     from .common import sp_active
 
-    if cfg.attn_impl in ("ring", "ulysses", "allgather") and (
+    if cfg.attn_impl in ("ring", "ulysses", "ulysses_ppermute", "allgather") and (
         sp_active(mesh) or sp_active(jax.sharding.get_abstract_mesh())
     ):
         raise NotImplementedError(

@@ -1039,7 +1039,7 @@ def loss_fn_pp(
         # typo'd ACCELERATE_PP_SCHEDULE) must not silently run GPipe.
         raise ValueError(f"schedule={schedule!r}: expected 'gpipe' or '1f1b'")
     sp_pipeline = False
-    if cfg.attn_impl in ("ring", "ulysses", "allgather"):
+    if cfg.attn_impl in ("ring", "ulysses", "ulysses_ppermute", "allgather"):
         # Check the mesh ARGUMENT (the one the pipeline's shard_map will run under),
         # not just the ambient context — callers may pass it without jax.set_mesh.
         if _sp_active(mesh) or _sp_active(jax.sharding.get_abstract_mesh()):
@@ -1053,16 +1053,14 @@ def loss_fn_pp(
             # caveat) and the aux statistic is psum-meaned over sp.
             sp_pipeline = True
             if cfg.attn_impl == "ulysses" and (schedule == "1f1b" or virtual_stages > 1):
-                # Empirical (r4): the all_to_all pair inside the hand-scheduled
+                # Empirical (r4): the all_to_all PRIMITIVE inside the hand-scheduled
                 # replay's per-tick jax.grad does not finish lowering (ring/allgather
-                # compile in seconds on the same config; ulysses hangs >9 min). Fail
-                # loudly rather than hang the job; ulysses works on the GPipe (AD)
-                # schedule, and ring covers the 1f1b/interleaved long-context case.
-                raise NotImplementedError(
-                    "attn_impl='ulysses' inside the hand-scheduled pipeline replay "
-                    "(schedule='1f1b' or virtual_stages>1) hangs at lowering — use "
-                    "schedule='gpipe' with ulysses, or attn_impl='ring' with 1f1b."
-                )
+                # compile in seconds on the same config; ulysses hangs >9 min). The
+                # ppermute-decomposed all-to-all (sequence._a2a_ppermute) lowers fine
+                # — substitute it. Same math (equivalence-tested), ~2x the minimal
+                # ring bytes; users who want the primitive's comm schedule can stay on
+                # gpipe or ring.
+                cfg = dataclasses.replace(cfg, attn_impl="ulysses_ppermute")
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     B, S = inputs.shape

@@ -44,6 +44,37 @@ def _repeat_gqa(q, k, v):
     return q, k, v
 
 
+def _a2a_ppermute(x, axis_name, split_axis: int, concat_axis: int):
+    """``lax.all_to_all(tiled=True)`` decomposed into n-1 neighbor ``ppermute`` hops.
+
+    Semantically identical (member i's output chunk k along ``concat_axis`` is member
+    k's chunk i along ``split_axis``) and bandwidth-equivalent on a ring ICI topology
+    (an all-to-all decomposes into ring steps anyway). Exists because the all_to_all
+    PRIMITIVE fails to finish lowering inside the hand-scheduled pipeline replay's
+    per-tick VJP (>9 min; ``ppermute`` — which the ring schedule and the replay itself
+    use — lowers in seconds): this is the workaround that lets ulysses run under
+    schedule='1f1b' and virtual stages.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    chunks = jnp.stack(jnp.split(x, n, axis=split_axis))  # [n, ...chunk...]
+    # Rotate the full stack around the ring. After hop s, member i holds the stack that
+    # ORIGINATED at k = (i - s) mod n; the all_to_all contract (out chunk k = member
+    # k's chunk i) means we take the visiting stack's row i and file it under k.
+    # Bandwidth: n hops x full stack ≈ 2x a minimal-distance ring all-to-all — fine
+    # for the lowering-workaround role; the primitive stays the default elsewhere.
+    def body(carry, s):
+        visiting, out = carry
+        origin = (idx - s) % n
+        row = jnp.take(visiting, idx, axis=0)
+        out = jax.lax.dynamic_update_index_in_dim(out, row, origin, axis=0)
+        nxt = lax.ppermute(visiting, axis_name, [(i, (i + 1) % n) for i in range(n)])
+        return (nxt, out), None
+
+    (_, out), _ = lax.scan(body, (chunks, jnp.zeros_like(chunks)), jnp.arange(n))
+    return jnp.concatenate([out[i] for i in range(n)], axis=concat_axis)
+
+
 def ulysses_attention(
     q: jax.Array,
     k: jax.Array,
@@ -55,8 +86,14 @@ def ulysses_attention(
     window: int = 0,
     softcap: float = 0.0,
     segment_ids: Optional[jax.Array] = None,
+    via_ppermute: bool = False,
 ) -> jax.Array:
     """DeepSpeed-Ulysses: all-to-all seq↔head reshard, then full-sequence flash attention.
+
+    ``via_ppermute`` replaces the ``lax.all_to_all`` primitive with the
+    ppermute-decomposed equivalent (``_a2a_ppermute``) — the form that lowers inside
+    the hand-scheduled pipeline replay where the primitive hangs (mode
+    "ulysses_ppermute" in the dispatchers).
 
     Inside shard_map: q/k/v [B, S_local, H, hd] (seq-sharded) → out [B, S_local, H, hd].
     Requires n_heads % axis_size == 0.
@@ -74,9 +111,14 @@ def ulysses_attention(
     if K % n != 0:
         q, k, v = _repeat_gqa(q, k, v)
     # [B, S_loc, H, hd] → [B, S_global, H/n, hd]: split heads, gather sequence.
-    qg = lax.all_to_all(q, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    kg = lax.all_to_all(k, axis_name, split_axis=2, concat_axis=1, tiled=True)
-    vg = lax.all_to_all(v, axis_name, split_axis=2, concat_axis=1, tiled=True)
+    a2a = (
+        (lambda x, sa, ca: _a2a_ppermute(x, axis_name, sa, ca)) if via_ppermute
+        else (lambda x, sa, ca: lax.all_to_all(
+            x, axis_name, split_axis=sa, concat_axis=ca, tiled=True))
+    )
+    qg = a2a(q, 2, 1)
+    kg = a2a(k, 2, 1)
+    vg = a2a(v, 2, 1)
     # Packing: after the seq->head reshard every device holds the FULL sequence, so the
     # full segment-id row (one cheap [B, S_loc] int all-gather) keeps same-segment
     # masking exact in the local flash call.
@@ -87,7 +129,7 @@ def ulysses_attention(
     og = flash_attention(qg, kg, vg, causal=causal, sm_scale=sm_scale, interpret=interpret,
                          window=window, softcap=softcap, segment_ids=seg_full)
     # back: split sequence, gather heads.
-    return lax.all_to_all(og, axis_name, split_axis=1, concat_axis=2, tiled=True)
+    return a2a(og, 1, 2)
 
 
 def allgather_attention(
@@ -155,6 +197,8 @@ def sequence_parallel_attention(
         return ring_attention(q, k, v, **kwargs)
     if mode == "ulysses":
         return ulysses_attention(q, k, v, **kwargs)
+    if mode == "ulysses_ppermute":
+        return ulysses_attention(q, k, v, via_ppermute=True, **kwargs)
     if mode == "allgather":
         return allgather_attention(q, k, v, **kwargs)
     raise ValueError(f"unknown sequence-parallel mode {mode!r}")

@@ -843,9 +843,13 @@ def test_pp_interleaved_packed_matches_single(family):
     )
 
 
-def test_llama_pp_sp_ulysses_1f1b_raises_with_rationale():
-    """ulysses inside the hand-scheduled replay hangs at lowering (empirical, r4) —
-    the guard must fail loudly instead of hanging the job."""
+@slow
+@pytest.mark.parametrize("virtual_stages", [1, 2])
+def test_llama_pp_sp_ulysses_replay_matches_single(virtual_stages):
+    """ulysses inside the hand-scheduled replay (formerly a NotImplementedError: the
+    all_to_all PRIMITIVE hangs at lowering there) now runs via the ppermute-decomposed
+    all-to-all (sequence._a2a_ppermute, substituted automatically): loss + all grads
+    match the non-pipelined, non-sp run at dp2 x sp2 x pp2, flat AND interleaved."""
     import dataclasses as _dc
 
     from accelerate_tpu.models import llama
@@ -855,14 +859,33 @@ def test_llama_pp_sp_ulysses_1f1b_raises_with_rationale():
         n_layers=4,
     )
     params = llama.init_params(cfg)
-    sp = dict(params)
-    sp["layers"] = split_params_into_stages(params["layers"], 2)
     batch = {"tokens": jnp.asarray(
         np.random.default_rng(0).integers(0, cfg.vocab_size, (8, 17)), jnp.int32)}
+    base = float(llama.loss_fn(params, batch, cfg))
+    base_g = jax.grad(lambda p: llama.loss_fn(p, batch, cfg))(params)
+
+    sp = dict(params)
+    sp["layers"] = split_params_into_stages(
+        params["layers"], 2, virtual_stages=virtual_stages
+    ) if virtual_stages > 1 else split_params_into_stages(params["layers"], 2)
     mesh = build_mesh(MeshConfig(dp=2, sp=2, pp=2))
     with jax.set_mesh(mesh):
-        with pytest.raises(NotImplementedError, match="ulysses"):
-            llama.loss_fn_pp(sp, batch, cfg, mesh, num_microbatches=4, schedule="1f1b")
+        l, g = jax.jit(jax.value_and_grad(
+            lambda p, b: llama.loss_fn_pp(
+                p, b, cfg, mesh, num_microbatches=4, schedule="1f1b",
+                virtual_stages=virtual_stages)
+        ))(sp, batch)
+    np.testing.assert_allclose(float(l), base, rtol=1e-5)
+    expected = dict(base_g)
+    expected["layers"] = split_params_into_stages(
+        base_g["layers"], 2, virtual_stages=virtual_stages
+    ) if virtual_stages > 1 else split_params_into_stages(base_g["layers"], 2)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), atol=5e-5
+        ),
+        dict(g), expected,
+    )
 
 
 @slow
