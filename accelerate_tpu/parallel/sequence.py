@@ -60,18 +60,23 @@ def _a2a_ppermute(x, axis_name, split_axis: int, concat_axis: int):
     chunks = jnp.stack(jnp.split(x, n, axis=split_axis))  # [n, ...chunk...]
     # Rotate the full stack around the ring. After hop s, member i holds the stack that
     # ORIGINATED at k = (i - s) mod n; the all_to_all contract (out chunk k = member
-    # k's chunk i) means we take the visiting stack's row i and file it under k.
-    # Bandwidth: n hops x full stack ≈ 2x a minimal-distance ring all-to-all — fine
-    # for the lowering-workaround role; the primitive stays the default elsewhere.
+    # k's chunk i) means we take the visiting stack's row i and file it under k. The
+    # s=0 row is local (no comm), so exactly n-1 hops run. Bandwidth: (n-1) hops x
+    # full stack ≈ 2x a minimal-distance ring all-to-all — fine for the
+    # lowering-workaround role; the primitive stays the default elsewhere.
+    out0 = jax.lax.dynamic_update_index_in_dim(
+        jnp.zeros_like(chunks), jnp.take(chunks, idx, axis=0), idx, axis=0
+    )
+
     def body(carry, s):
         visiting, out = carry
+        visiting = lax.ppermute(visiting, axis_name, [(i, (i + 1) % n) for i in range(n)])
         origin = (idx - s) % n
         row = jnp.take(visiting, idx, axis=0)
         out = jax.lax.dynamic_update_index_in_dim(out, row, origin, axis=0)
-        nxt = lax.ppermute(visiting, axis_name, [(i, (i + 1) % n) for i in range(n)])
-        return (nxt, out), None
+        return (visiting, out), None
 
-    (_, out), _ = lax.scan(body, (chunks, jnp.zeros_like(chunks)), jnp.arange(n))
+    (_, out), _ = lax.scan(body, (chunks, out0), jnp.arange(1, n))
     return jnp.concatenate([out[i] for i in range(n)], axis=concat_axis)
 
 

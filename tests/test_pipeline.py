@@ -867,7 +867,7 @@ def test_llama_pp_sp_ulysses_replay_matches_single(virtual_stages):
     sp = dict(params)
     sp["layers"] = split_params_into_stages(
         params["layers"], 2, virtual_stages=virtual_stages
-    ) if virtual_stages > 1 else split_params_into_stages(params["layers"], 2)
+    )
     mesh = build_mesh(MeshConfig(dp=2, sp=2, pp=2))
     with jax.set_mesh(mesh):
         l, g = jax.jit(jax.value_and_grad(
@@ -879,7 +879,7 @@ def test_llama_pp_sp_ulysses_replay_matches_single(virtual_stages):
     expected = dict(base_g)
     expected["layers"] = split_params_into_stages(
         base_g["layers"], 2, virtual_stages=virtual_stages
-    ) if virtual_stages > 1 else split_params_into_stages(base_g["layers"], 2)
+    )
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), atol=5e-5
