@@ -549,6 +549,8 @@ def _block(x, layer, positions, mask, cfg: LlamaConfig, segment_ids=None):
             h, layer["moe"], layer["moe"]["w_router"],
             top_k=cfg.moe_top_k, capacity_factor=cfg.moe_capacity_factor,
             compute_dtype=cfg.dtype,
+            # Packing: pad slots neither claim expert capacity nor bias the aux stat.
+            token_mask=None if segment_ids is None else (segment_ids != 0),
         )
         return x + y, aux
     gate = _mlp_gate_act(_proj_l(h, layer, "w_gate", cfg), cfg)

@@ -40,16 +40,28 @@ def router_topk(
     return logits, gates, idx
 
 
-def load_balancing_loss(logits: jax.Array, idx: jax.Array, num_experts: int) -> jax.Array:
+def load_balancing_loss(
+    logits: jax.Array, idx: jax.Array, num_experts: int,
+    token_mask: Optional[jax.Array] = None,
+) -> jax.Array:
     """Switch-Transformer auxiliary loss: E · Σ_e f_e · p_e.
 
     f_e = fraction of tokens whose top-1 lands on expert e; p_e = mean router probability of
-    e. Minimized (=1) at uniform balance.
+    e. Minimized (=1) at uniform balance. ``token_mask`` [T] bool (sample packing: False on
+    pad slots) restricts both means to REAL tokens — pads would otherwise bias the balance
+    statistic toward whatever experts they happen to route to.
     """
     probs = jax.nn.softmax(logits, axis=-1)
     top1 = idx[..., 0]
-    f = jnp.mean(jax.nn.one_hot(top1, num_experts, dtype=jnp.float32), axis=0)
-    p = jnp.mean(probs, axis=0)
+    oh = jax.nn.one_hot(top1, num_experts, dtype=jnp.float32)
+    if token_mask is not None:
+        m = token_mask.astype(jnp.float32)[:, None]
+        denom = jnp.maximum(m.sum(), 1.0)
+        f = jnp.sum(oh * m, axis=0) / denom
+        p = jnp.sum(probs * m, axis=0) / denom
+    else:
+        f = jnp.mean(oh, axis=0)
+        p = jnp.mean(probs, axis=0)
     return num_experts * jnp.sum(f * p)
 
 
@@ -66,12 +78,17 @@ def moe_mlp(
     capacity_factor: float = 1.25,
     compute_dtype=jnp.bfloat16,
     shard: bool = True,
+    token_mask: Optional[jax.Array] = None,
 ) -> tuple[jax.Array, jax.Array]:
     """MoE SwiGLU FFN. x [B, S, D]; experts {w_gate/w_up [E, D, F], w_down [E, F, D]}.
 
     Returns (y [B, S, D], aux_loss scalar). Tokens beyond an expert's capacity are dropped
     (contribute zero through that expert) — the standard fixed-shape TPU formulation; with
     ``capacity_factor ≥ top_k·E/…`` nothing drops.
+
+    ``token_mask`` [B, S] bool (sample packing: False on pad slots): pad tokens neither
+    claim expert-capacity slots (they would crowd out REAL tokens and increase dropping)
+    nor enter the load-balancing statistic; their output rows are zero.
     """
     B, S, D = x.shape
     T = B * S
@@ -80,11 +97,16 @@ def moe_mlp(
 
     flat = x.reshape(T, D)
     logits, gates, idx = router_topk(flat, w_router, top_k)
-    aux = load_balancing_loss(logits, idx, E)
+    live = None if token_mask is None else token_mask.reshape(T).astype(bool)
+    aux = load_balancing_loss(logits, idx, E, token_mask=live)
 
     # Position of each (token, choice) in its expert's buffer, via cumulative count over the
     # flattened (k-major) assignment order; entries beyond capacity are dropped.
     onehot = jax.nn.one_hot(idx, E, dtype=jnp.int32)           # [T, k, E]
+    if live is not None:
+        # Pads claim no slots: zeroing their assignment BEFORE the cumsum removes them
+        # from capacity competition entirely (and from dispatch/combine below).
+        onehot = onehot * live[:, None, None].astype(jnp.int32)
     flat_oh = onehot.transpose(1, 0, 2).reshape(T * top_k, E)  # k-major: top-1s claim slots first
     pos_flat = jnp.cumsum(flat_oh, axis=0) - flat_oh           # [T*k, E]
     pos = pos_flat.reshape(top_k, T, E).transpose(1, 0, 2)     # [T, k, E]

@@ -168,3 +168,55 @@ def test_moe_num_params_counts_experts():
     params = llama.init_params(moe, jax.random.PRNGKey(0))
     actual = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
     assert actual == llama.num_params(moe)
+
+
+def test_token_mask_excludes_pads_from_aux():
+    """Packing: the load-balancing statistic is computed over REAL tokens only — the
+    masked aux equals the aux of the real-token subset run on its own."""
+    from accelerate_tpu.ops.moe import load_balancing_loss, router_topk
+
+    rng = np.random.default_rng(0)
+    D, E, T = 16, 4, 24
+    x = jnp.asarray(rng.normal(size=(T, D)), jnp.float32)
+    w_r = jnp.asarray(rng.normal(size=(D, E)), jnp.float32)
+    live = jnp.asarray(rng.integers(0, 2, T).astype(bool)).at[0].set(True)
+
+    logits, gates, idx = router_topk(x, w_r, 2)
+    masked = float(load_balancing_loss(logits, idx, E, token_mask=live))
+
+    xr = x[np.asarray(live)]
+    lr, _, ir = router_topk(xr, w_r, 2)
+    subset = float(load_balancing_loss(lr, ir, E))
+    np.testing.assert_allclose(masked, subset, rtol=1e-6)
+
+
+def test_token_mask_pads_claim_no_capacity():
+    """A pad token must not crowd a REAL token out of an expert's capacity buffer:
+    with capacity 1 and a pad occupying the earlier slot position, the real token
+    keeps its expert only when the mask is passed."""
+    from accelerate_tpu.ops.moe import moe_mlp
+
+    rng = np.random.default_rng(1)
+    D, F, E = 8, 16, 2
+    experts = {
+        "w_gate": jnp.asarray(rng.normal(size=(E, D, F)) * 0.3, jnp.float32),
+        "w_up": jnp.asarray(rng.normal(size=(E, D, F)) * 0.3, jnp.float32),
+        "w_down": jnp.asarray(rng.normal(size=(E, F, D)) * 0.3, jnp.float32),
+    }
+    # Router forced: every token to expert 0 (top-1) — identical rows, tiny capacity.
+    w_router = jnp.zeros((D, E), jnp.float32)
+    w_router = w_router.at[:, 0].set(1.0)
+    x = jnp.broadcast_to(jnp.asarray(rng.normal(size=(1, 1, D)), jnp.float32), (1, 4, D))
+    mask = jnp.asarray([[False, False, False, True]])  # only the LAST token is real
+
+    # top_k=1, capacity_factor chosen so C = 4*1*0.25/2 = 0 → floor 1: one slot total.
+    y_masked, _ = moe_mlp(x, experts, w_router, top_k=1, capacity_factor=0.25,
+                          compute_dtype=jnp.float32, shard=False, token_mask=mask)
+    y_unmasked, _ = moe_mlp(x, experts, w_router, top_k=1, capacity_factor=0.25,
+                            compute_dtype=jnp.float32, shard=False)
+    # Masked: pads claim nothing, the real token gets the slot → nonzero output there,
+    # zero rows at pads. Unmasked: the first (pad) token eats the slot, the real token
+    # is dropped to zero.
+    assert float(jnp.abs(y_masked[0, 3]).sum()) > 0
+    np.testing.assert_allclose(np.asarray(y_masked[0, :3]), 0.0, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(y_unmasked[0, 3]), 0.0, atol=1e-7)
