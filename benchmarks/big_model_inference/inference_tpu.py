@@ -27,9 +27,17 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import resource
 import sys
 import time
+
+# Launched as a script from the repo root (the armed session chain): the interpreter
+# puts THIS file's directory on sys.path, not the repo root — bootstrap it or every
+# `import accelerate_tpu` dies with ModuleNotFoundError on the chip.
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
 
 import numpy as np
 
@@ -109,6 +117,14 @@ def main() -> int:
 
     dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
     cfg = dataclasses.replace(mod.CONFIGS[model], dtype=dtype)
+    if family in ("gpt", "llama") and os.environ.get("ACCEL_INFER_ATTN") != "auto":
+        # The table's metric is decode-bound (cached attention, no flash); prefill via
+        # the flash kernels is a minor win ONLY IF the remote compile service accepts
+        # the Pallas program — which the 2026-08-01 window showed it sometimes doesn't
+        # (HTTP 500 on first-compile Pallas). Default to the proven-compilable XLA
+        # prefill so a compile-service flake can't kill a whole s/token row;
+        # ACCEL_INFER_ATTN=auto re-enables the flash path.
+        cfg = dataclasses.replace(cfg, attn_impl="xla")
     if args.kv_quant:
         if family == "t5":
             raise SystemExit("--kv-quant applies to the decoder families (gpt/llama)")

@@ -26,6 +26,12 @@ import json
 import os
 import sys
 
+# Launched as a script (python benchmarks/fp8/convergence.py): the interpreter puts
+# THIS file's directory on sys.path, not the repo root — bootstrap it.
+_REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
 
 def main() -> int:
     p = argparse.ArgumentParser()

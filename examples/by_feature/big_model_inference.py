@@ -5,6 +5,13 @@ double-buffered streamed forward.
   python examples/by_feature/big_model_inference.py --smoke
 """
 
+# Dev-checkout bootstrap: make `python examples/by_feature/big_model_inference.py` work without installing the
+# package (the launcher sets PYTHONPATH for child processes; bare python does not).
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
 import argparse
 import dataclasses
 import tempfile

@@ -17,6 +17,13 @@ The example verifies the fused stack reaches the same losses as the unfused
   accelerate-tpu launch examples/by_feature/fused_kernels.py --smoke
 """
 
+# Dev-checkout bootstrap: make `python examples/by_feature/fused_kernels.py` work without installing the
+# package (the launcher sets PYTHONPATH for child processes; bare python does not).
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
 import argparse
 import dataclasses
 import time

@@ -10,6 +10,13 @@ equals the one a single big batch would produce.
   accelerate-tpu launch examples/by_feature/gradient_accumulation_for_autoregressive_models.py --smoke
 """
 
+# Dev-checkout bootstrap: make `python examples/by_feature/gradient_accumulation_for_autoregressive_models.py` work without installing the
+# package (the launcher sets PYTHONPATH for child processes; bare python does not).
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
 import argparse
 import dataclasses
 

@@ -8,6 +8,13 @@ exists only for adapter leaves, the base carries no Adam moments, and the adapte
   accelerate-tpu launch examples/by_feature/lora_finetuning.py --smoke
 """
 
+# Dev-checkout bootstrap: make `python examples/by_feature/lora_finetuning.py` work without installing the
+# package (the launcher sets PYTHONPATH for child processes; bare python does not).
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
 import argparse
 import dataclasses
 

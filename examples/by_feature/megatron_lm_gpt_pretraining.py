@@ -10,6 +10,13 @@ step derives every collective from the shardings.
   accelerate-tpu launch examples/by_feature/megatron_lm_gpt_pretraining.py --smoke
 """
 
+# Dev-checkout bootstrap: make `python examples/by_feature/megatron_lm_gpt_pretraining.py` work without installing the
+# package (the launcher sets PYTHONPATH for child processes; bare python does not).
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
 import argparse
 import dataclasses
 

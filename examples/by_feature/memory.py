@@ -5,6 +5,13 @@ RESOURCE_EXHAUSTED, clearing compilation caches between attempts.
   accelerate-tpu launch examples/by_feature/memory.py --smoke
 """
 
+# Dev-checkout bootstrap: make `python examples/by_feature/memory.py` work without installing the
+# package (the launcher sets PYTHONPATH for child processes; bare python does not).
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
 import argparse
 
 import jax

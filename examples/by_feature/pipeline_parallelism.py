@@ -13,6 +13,13 @@ are stage-stacked and sharded over the ``pp`` mesh axis and the whole schedule t
   accelerate-tpu launch examples/by_feature/pipeline_parallelism.py --smoke --schedule 1f1b
 """
 
+# Dev-checkout bootstrap: make `python examples/by_feature/pipeline_parallelism.py` work without installing the
+# package (the launcher sets PYTHONPATH for child processes; bare python does not).
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
 import argparse
 import dataclasses
 

@@ -9,6 +9,13 @@ causal block diagonal (in-kernel on the flash path) and restarts RoPE per segmen
   accelerate-tpu launch examples/by_feature/sample_packing.py --smoke
 """
 
+# Dev-checkout bootstrap: make `python examples/by_feature/sample_packing.py` work without installing the
+# package (the launcher sets PYTHONPATH for child processes; bare python does not).
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
 import argparse
 import dataclasses
 

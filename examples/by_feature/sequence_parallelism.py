@@ -9,6 +9,13 @@ kernel) or Ulysses (all-to-all heads↔sequence reshard).
   accelerate-tpu launch examples/by_feature/sequence_parallelism.py --smoke --sp-mode ring
 """
 
+# Dev-checkout bootstrap: make `python examples/by_feature/sequence_parallelism.py` work without installing the
+# package (the launcher sets PYTHONPATH for child processes; bare python does not).
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
 import argparse
 import dataclasses
 

@@ -4,6 +4,13 @@ prints the `AcceleratorState` for the chosen config). Run via:
     accelerate-tpu launch --config-file <template>.yaml run_me.py
 """
 
+# Dev-checkout bootstrap: make `python examples/config_yaml_templates/run_me.py` work without installing the
+# package (the launcher sets PYTHONPATH for child processes; bare python does not).
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
 from accelerate_tpu import Accelerator
 
 accelerator = Accelerator()

@@ -16,6 +16,13 @@ Run:  python examples/inference/generation.py [--config tiny|debug|1b] [--mode a
 
 from __future__ import annotations
 
+# Dev-checkout bootstrap: make `python examples/inference/generation.py` work without installing the
+# package (the launcher sets PYTHONPATH for child processes; bare python does not).
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
 import argparse
 import dataclasses
 import json

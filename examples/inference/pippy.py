@@ -19,6 +19,13 @@ the last stage's output the same way, ``inference.py:99-121``).
 
 from __future__ import annotations
 
+# Dev-checkout bootstrap: make `python examples/inference/pippy.py` work without installing the
+# package (the launcher sets PYTHONPATH for child processes; bare python does not).
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
 import argparse
 import dataclasses
 import time
@@ -86,6 +93,11 @@ def main():
         import os
 
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        prev = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in prev:
+            os.environ["XLA_FLAGS"] = (
+                f"{prev} --xla_force_host_platform_device_count=8".strip()
+            )
         import jax
 
         jax.config.update("jax_platforms", "cpu")

@@ -7,6 +7,13 @@ at a time; here requests admitted mid-flight share one compiled decode program (
   python examples/inference/serving.py --smoke
 """
 
+# Dev-checkout bootstrap: make `python examples/inference/serving.py` work without installing the
+# package (the launcher sets PYTHONPATH for child processes; bare python does not).
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
 import argparse
 import dataclasses
 

@@ -8,6 +8,13 @@ and asserts the output equals plain greedy decode token-for-token.
   python examples/inference/speculative.py --smoke
 """
 
+# Dev-checkout bootstrap: make `python examples/inference/speculative.py` work without installing the
+# package (the launcher sets PYTHONPATH for child processes; bare python does not).
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..", "..")))
+
 import argparse
 import dataclasses
 import time

@@ -16,6 +16,13 @@ environment can provide them; otherwise a deterministic synthetic paraphrase-det
 the same schema (offline-friendly — this environment has no egress).
 """
 
+# Dev-checkout bootstrap: make `python examples/nlp_example.py` work without installing the
+# package (the launcher sets PYTHONPATH for child processes; bare python does not).
+import os as _os
+import sys as _sys
+
+_sys.path.insert(0, _os.path.abspath(_os.path.join(_os.path.dirname(__file__), "..")))
+
 import argparse
 
 import numpy as np
