@@ -94,3 +94,26 @@ def test_step_attrib_smoke():
 def test_fp8_convergence_smoke():
     out = _run(["benchmarks/fp8/convergence.py", "--steps", "8"])
     assert out["pass"] is True
+
+
+@slow
+def test_scripts_run_without_repo_on_pythonpath(tmp_path):
+    """The armed session chain launches these as bare ``python <script>`` from the
+    repo root with only the environment's own PYTHONPATH — python then puts the
+    SCRIPT'S directory on sys.path, not the repo root. Every entry point must
+    bootstrap the repo root itself (r4 regression: the big-model-inference table
+    died with ModuleNotFoundError in exactly this configuration)."""
+    env = _smoke_env()
+    env["PYTHONPATH"] = os.pathsep.join(
+        p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+        if p and os.path.abspath(p) != REPO
+    )
+    out = subprocess.run(
+        [sys.executable, "benchmarks/big_model_inference/inference_tpu.py",
+         "tiny", "--smoke"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600,
+    )
+    assert out.returncode == 0, out.stderr[-800:]
+    assert "ModuleNotFoundError" not in out.stderr
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["model"] == "tiny"
