@@ -62,8 +62,11 @@ _LANES = 128  # native VPU lane count: softmax state is replicated across lanes
 
 # Default tile sizes. The grid iterates sequentially on the TensorCore, so per-step fixed
 # overhead (semaphores, block DMA setup) is paid nq*nk times per (batch, head): 128x128 tiles
-# at S=2048 mean 256 steps/head of mostly overhead. 256x512 cuts the step count 8x while the
-# working set (q 64KB + k/v 2x128KB bf16 + fp32 acc/s ~0.7MB) stays far under VMEM.
+# at S=2048 mean 256 steps/head of mostly overhead. 512x512 is the r2 ON-CHIP sweep best
+# (v5e, llama-0.9B b4 seq2048: blocks512 0.1937 MFU vs blocks128 0.135, blocks256x1024
+# 0.161 — PERF_NOTES.md); the working set (q/k/v 3x512KB bf16 + fp32 acc/s ~1.3MB) stays
+# well under VMEM. Baked in as the default because the round driver resets the sweep
+# output the auto-adoption would otherwise replay the tuning from.
 # Env overrides allow per-chip tuning without code changes (used by bench sweeps).
 def _env_block(name: str, default: int) -> int:
     raw = os.environ.get(name, "")
@@ -76,7 +79,7 @@ def _env_block(name: str, default: int) -> int:
         return default
 
 
-_DEFAULT_BLOCK_Q = _env_block("ACCEL_FLASH_BLOCK_Q", 256)
+_DEFAULT_BLOCK_Q = _env_block("ACCEL_FLASH_BLOCK_Q", 512)
 _DEFAULT_BLOCK_K = _env_block("ACCEL_FLASH_BLOCK_K", 512)
 
 
