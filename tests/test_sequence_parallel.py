@@ -330,3 +330,52 @@ def test_a2a_ppermute_matches_primitive(sp_mesh):
         ga = jax.jit(jax.grad(lambda x: (m_prim(x) ** 2).sum()))(x)
         gb = jax.jit(jax.grad(lambda x: (m_pp(x) ** 2).sum()))(x)
         np.testing.assert_allclose(np.asarray(ga), np.asarray(gb), atol=1e-6)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_ring_packed_fuzz(sp_mesh, seed):
+    """Randomized packed layouts through ring attention vs single-device flash: segment
+    boundaries landing exactly on shard boundaries, segments spanning several shards,
+    rows that are entirely pad, and single-segment rows — the cases where the rotating
+    kv-side id slice could desync from its kv block."""
+    from accelerate_tpu.ops.flash_attention import flash_attention
+
+    rng = np.random.default_rng(seed)
+    S = 128  # 8 shards of 16
+    B = 2
+    q, k, v = make_qkv(B=B, S=S, H=4, K=2, hd=16, seed=seed)
+    seg = np.zeros((B, S), np.int32)
+    for b in range(B):
+        style = (seed + b) % 4
+        if style == 0:      # boundaries exactly on the 16-token shard edges
+            seg[b, :48] = 1; seg[b, 48:96] = 2; seg[b, 96:112] = 3
+        elif style == 1:    # one segment spanning every shard, no pad
+            seg[b, :] = 1
+        elif style == 2:    # all pad
+            pass
+        else:               # random cuts
+            cuts = np.sort(rng.choice(np.arange(4, S - 4), size=3, replace=False))
+            prev, sid = 0, 1
+            for c in list(cuts) + [S - int(rng.integers(0, 12))]:
+                if c > prev:
+                    seg[b, prev:c] = sid
+                    sid += 1
+                    prev = c
+    seg = jnp.asarray(seg)
+
+    ref = flash_attention(q, k, v, causal=True, segment_ids=seg)
+    attn = make_sp_attention(sp_mesh, mode="ring", causal=True)
+    with jax.set_mesh(sp_mesh):
+        out = jax.jit(lambda q, k, v, s: attn(q, k, v, segment_ids=s))(q, k, v, seg)
+        g = jax.jit(jax.grad(
+            lambda q, k, v: (attn(q, k, v, segment_ids=seg) ** 2).sum(),
+            argnums=(0, 1, 2),
+        ))(q, k, v)
+        rg = jax.grad(
+            lambda q, k, v: (flash_attention(
+                q, k, v, causal=True, segment_ids=seg) ** 2).sum(),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+    for a, b in zip(g, rg):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=3e-3, atol=3e-3)
