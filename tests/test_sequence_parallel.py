@@ -243,30 +243,37 @@ def _packed_segments(B, S, seed=1):
     return jnp.asarray(seg)
 
 
+def _assert_packed_parity(mesh, mode, q, k, v, seg):
+    """Shared fwd+grad parity scaffold: mode under sp vs single-device flash with the
+    same segment ids (segment ids ride as jit ARGUMENTS so shape-identical cases share
+    one compiled program)."""
+    from accelerate_tpu.ops.flash_attention import flash_attention
+
+    ref = flash_attention(q, k, v, causal=True, segment_ids=seg)
+    rg = jax.grad(
+        lambda q, k, v, s: (flash_attention(q, k, v, causal=True, segment_ids=s) ** 2).sum(),
+        argnums=(0, 1, 2),
+    )(q, k, v, seg)
+
+    attn = make_sp_attention(mesh, mode=mode, causal=True)
+    with jax.set_mesh(mesh):
+        out = jax.jit(lambda q, k, v, s: attn(q, k, v, segment_ids=s))(q, k, v, seg)
+        g = jax.jit(jax.grad(
+            lambda q, k, v, s: (attn(q, k, v, segment_ids=s) ** 2).sum(),
+            argnums=(0, 1, 2),
+        ))(q, k, v, seg)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
+    for a, b in zip(g, rg):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=3e-3, atol=3e-3)
+
+
 @pytest.mark.parametrize("mode", ["ring", "ulysses", "allgather"])
 def test_sp_attention_packed_parity(sp_mesh, mode):
     """Sample packing composes with every sp mode: segment ids shard over sp (the ring
     rotates the kv-side slice with its kv block; ulysses/allgather gather the row) and
     fwd + grads match single-device flash with the same segment ids."""
-    from accelerate_tpu.ops.flash_attention import flash_attention
-
     q, k, v = make_qkv(S=128, H=8, K=4)
-    seg = _packed_segments(2, 128)
-    ref = flash_attention(q, k, v, causal=True, segment_ids=seg)
-    rg = jax.grad(
-        lambda q, k, v: (flash_attention(q, k, v, causal=True, segment_ids=seg) ** 2).sum(),
-        argnums=(0, 1, 2),
-    )(q, k, v)
-
-    attn = make_sp_attention(sp_mesh, mode=mode, causal=True)
-    with jax.set_mesh(sp_mesh):
-        out = jax.jit(lambda q, k, v, s: attn(q, k, v, segment_ids=s))(q, k, v, seg)
-        g = jax.jit(jax.grad(
-            lambda q, k, v: (attn(q, k, v, segment_ids=seg) ** 2).sum(), argnums=(0, 1, 2)
-        ))(q, k, v)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
-    for a, b in zip(g, rg):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=3e-3, atol=3e-3)
+    _assert_packed_parity(sp_mesh, mode, q, k, v, _packed_segments(2, 128))
 
 
 def test_llama_packed_ring_attention_parity():
@@ -332,18 +339,17 @@ def test_a2a_ppermute_matches_primitive(sp_mesh):
         np.testing.assert_allclose(np.asarray(ga), np.asarray(gb), atol=1e-6)
 
 
+@pytest.mark.parametrize("mode", ["ring", "ulysses", "allgather"])
 @pytest.mark.parametrize("seed", range(4))
-def test_ring_packed_fuzz(sp_mesh, seed):
-    """Randomized packed layouts through ring attention vs single-device flash: segment
+def test_sp_packed_fuzz(sp_mesh, mode, seed):
+    """Randomized packed layouts through every sp mode vs single-device flash: segment
     boundaries landing exactly on shard boundaries, segments spanning several shards,
-    rows that are entirely pad, and single-segment rows — the cases where the rotating
-    kv-side id slice could desync from its kv block."""
-    from accelerate_tpu.ops.flash_attention import flash_attention
-
+    rows that are entirely pad, and single-segment rows — the cases where a mode's
+    segment plumbing (the ring's rotating kv-side slice, the gathers) could desync."""
     rng = np.random.default_rng(seed)
     S = 128  # 8 shards of 16
     B = 2
-    q, k, v = make_qkv(B=B, S=S, H=4, K=2, hd=16, seed=seed)
+    q, k, v = make_qkv(B=B, S=S, H=8, K=4, hd=16, seed=seed)
     seg = np.zeros((B, S), np.int32)
     for b in range(B):
         style = (seed + b) % 4
@@ -353,29 +359,12 @@ def test_ring_packed_fuzz(sp_mesh, seed):
             seg[b, :] = 1
         elif style == 2:    # all pad
             pass
-        else:               # random cuts
-            cuts = np.sort(rng.choice(np.arange(4, S - 4), size=3, replace=False))
+        else:               # random interior cuts + a trailing segment ending near S
+            cuts = np.sort(rng.choice(np.arange(4, S - 16), size=3, replace=False))
+            end = S - int(rng.integers(0, 12))  # > cuts[-1] by construction
             prev, sid = 0, 1
-            for c in list(cuts) + [S - int(rng.integers(0, 12))]:
-                if c > prev:
-                    seg[b, prev:c] = sid
-                    sid += 1
-                    prev = c
-    seg = jnp.asarray(seg)
-
-    ref = flash_attention(q, k, v, causal=True, segment_ids=seg)
-    attn = make_sp_attention(sp_mesh, mode="ring", causal=True)
-    with jax.set_mesh(sp_mesh):
-        out = jax.jit(lambda q, k, v, s: attn(q, k, v, segment_ids=s))(q, k, v, seg)
-        g = jax.jit(jax.grad(
-            lambda q, k, v: (attn(q, k, v, segment_ids=seg) ** 2).sum(),
-            argnums=(0, 1, 2),
-        ))(q, k, v)
-        rg = jax.grad(
-            lambda q, k, v: (flash_attention(
-                q, k, v, causal=True, segment_ids=seg) ** 2).sum(),
-            argnums=(0, 1, 2),
-        )(q, k, v)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
-    for a, b in zip(g, rg):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=3e-3, atol=3e-3)
+            for c in [*cuts, end]:
+                seg[b, prev:c] = sid
+                sid += 1
+                prev = c
+    _assert_packed_parity(sp_mesh, mode, q, k, v, jnp.asarray(seg))
