@@ -26,6 +26,7 @@ __all__ = [
     "remat_wrap", "kv_planes", "write_kv", "read_kv", "quant_kv",
     "fused_ce_allowed", "fused_ce_single_shard",
     "resolve_loss_chunk", "chunked_ce", "ce_sum", "ce_sum_dispatch",
+    "sp_active", "sp_manual", "resolve_sp_pipeline", "attention_dispatch",
 ]
 
 
@@ -162,6 +163,35 @@ def sp_manual(mesh) -> bool:
         return types.get(SEQUENCE_AXIS) == jax.sharding.AxisType.Manual
     except Exception:
         return False
+
+
+def resolve_sp_pipeline(cfg, mesh, schedule: str, virtual_stages: int):
+    """Family-shared sp×pp routing decision for ``loss_fn_pp`` → ``(sp_pipeline, cfg)``.
+
+    ``sp_pipeline=True`` when ``cfg.attn_impl`` is an sp mode AND the sp axis is live —
+    checked on the mesh ARGUMENT (the one the pipeline's shard_map will run under, which
+    callers may pass without ``jax.set_mesh``) and on the ambient context. The pipeline
+    then goes manual over sp: activations ride sequence-sliced, the stage body issues
+    the ring/ulysses collectives flat (nesting ``make_sp_attention``'s own shard_map
+    inside the pipeline's fails MLIR verification on the backward).
+
+    Empirical lowering wall (r4, shared by every family): the ``all_to_all`` PRIMITIVE
+    inside the hand-scheduled replay's per-tick ``jax.grad`` does not finish lowering
+    (ring/allgather compile in seconds on the same config; ulysses hangs >9 min), so
+    under 1f1b or virtual stages the returned cfg substitutes the ppermute-decomposed
+    all-to-all (``sequence._a2a_ppermute``) — same math (equivalence-tested), ~2x the
+    minimal ring bytes. Users who want the primitive's comm schedule can stay on gpipe
+    or ring. ONE copy of both the predicate and the substitution, so the families
+    cannot drift when the wall moves."""
+    import dataclasses
+
+    if cfg.attn_impl not in ("ring", "ulysses", "ulysses_ppermute", "allgather"):
+        return False, cfg
+    if not (sp_active(mesh) or sp_active(jax.sharding.get_abstract_mesh())):
+        return False, cfg
+    if cfg.attn_impl == "ulysses" and (schedule == "1f1b" or virtual_stages > 1):
+        cfg = dataclasses.replace(cfg, attn_impl="ulysses_ppermute")
+    return True, cfg
 
 
 def attention_dispatch(q, k, v, mask, *, impl: str, sm_scale: float, window: int = 0,

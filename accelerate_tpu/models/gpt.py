@@ -70,8 +70,8 @@ class GPTConfig:
     dtype: Any = jnp.bfloat16
     # "auto": flash on TPU, xla elsewhere. "ring"/"ulysses"/"allgather": sequence-
     # parallel attention over an sp mesh axis (same dispatcher as llama; packing
-    # composes). sp modes are flat-path only for gpt — loss_fn_pp raises under an
-    # active sp mesh rather than nesting shard_maps (use the llama family for sp x pp).
+    # composes). sp modes train under pp too — loss_fn_pp goes manual over sp exactly
+    # like llama's sp_pipeline (forward_pp's hidden-state path is the one sp×pp hole).
     attn_impl: str = "auto"
     remat: bool = True
     remat_policy: str = "full"            # "full" | "dots" | "offload" (see models/common.py)
@@ -424,11 +424,16 @@ def loss_fn(params: dict, batch: dict, cfg: GPTConfig, rng=None) -> jax.Array:
 
 
 # --------------------------------------------------------------- pipeline-parallel training
-def _pp_stage_fn(cfg: GPTConfig, S: int, packed: bool = False):
+def _pp_stage_fn(cfg: GPTConfig, S: int, packed: bool = False, sp_manual: bool = False):
     """One pipeline stage body (gpt analog of ``llama._pp_stage_fn``): scan this stage's
     blocks over one microbatch [B_m, S, D]; positions/causal mask rebuilt locally.
     ``packed``: 3-arg form taking the pipeline's ``{"positions", "segment_ids"}`` side
-    constants (sample packing — block-diagonal per-segment attention)."""
+    constants (sample packing — block-diagonal per-segment attention). ``sp_manual``
+    (sp×pp): the pipeline's shard_map is manual over sp too, activations arrive
+    sequence-sliced [B_m, S/sp, D]; attention dispatches to the flat ring/ulysses
+    collectives inside ``_attention`` (rotary variants rebuild the slice's GLOBAL
+    positions; gpt2's learned positions were already added at the embed, outside the
+    pipeline, on the full sequence)."""
     from .common import remat_wrap
 
     block = remat_wrap(
@@ -443,12 +448,38 @@ def _pp_stage_fn(cfg: GPTConfig, S: int, packed: bool = False):
         out, _ = jax.lax.scan(body, x, stage_layers)
         return out
 
+    if packed and sp_manual:
+        # packing × sp × pp: activations AND the side constants arrive sequence-sliced
+        # ([B_m, S/sp, D] and [B_m, S/sp] — loss_fn_pp passes the matching side_spec).
+        # No mask — the sp kernels take the LOCAL segment slice (ring rotates the
+        # kv-side ids with its kv block); positions are the pre-computed per-segment
+        # restarts (global array, sliced).
+        def stage_fn(stage_layers, x, side):
+            return body_scan(
+                x, stage_layers, side["positions"], None, side["segment_ids"]
+            )
+
+        return stage_fn
+
     if packed:
         from .llama import segment_mask
 
         def stage_fn(stage_layers, x, side):
             seg = side["segment_ids"]
             return body_scan(x, stage_layers, side["positions"], segment_mask(seg), seg)
+
+        return stage_fn
+
+    if sp_manual:
+        # sp×pp: x arrives SEQUENCE-SLICED; rotary needs the slice's global positions,
+        # and the sp kernels handle causality with global offsets in-kernel (no mask).
+        def stage_fn(stage_layers, x):
+            S_loc = x.shape[1]
+            offs = jax.lax.axis_index(SEQUENCE_AXIS) * S_loc
+            pos = jnp.broadcast_to(
+                offs + jnp.arange(S_loc, dtype=jnp.int32), (x.shape[0], S_loc)
+            )
+            return body_scan(x, stage_layers, pos, None)
 
         return stage_fn
 
@@ -461,19 +492,20 @@ def _pp_stage_fn(cfg: GPTConfig, S: int, packed: bool = False):
 
 
 def _guard_sp_under_pp(cfg: "GPTConfig", mesh) -> None:
-    """gpt's pipeline does not go manual over sp (the llama family does — see
-    llama.loss_fn_pp's sp_pipeline): an sp attention mode inside the pipeline's
-    shard_map would nest make_sp_attention's own shard_map, which fails to lower on
-    the backward. Fail loudly with the supported alternatives."""
+    """``forward_pp``'s GPipe hidden-state path does not go manual over sp: an sp
+    attention mode inside its shard_map would nest ``make_sp_attention``'s own
+    shard_map, which fails to lower on the backward. Training composes sp×pp through
+    ``loss_fn_pp`` (which routes through the manual-over-sp ``make_pipeline_loss_fn``
+    exactly like llama); fail loudly here with the supported alternatives."""
     from .common import sp_active
 
     if cfg.attn_impl in ("ring", "ulysses", "ulysses_ppermute", "allgather") and (
         sp_active(mesh) or sp_active(jax.sharding.get_abstract_mesh())
     ):
         raise NotImplementedError(
-            "gpt attn_impl sp modes (ring/ulysses/allgather) are flat-path only: the "
-            "gpt pipeline does not go manual over sp. Drop the pp axis, use "
-            "attn_impl='auto' under pp, or use the llama family for sp x pp."
+            "gpt forward_pp does not go manual over sp. For sp x pp training use "
+            "loss_fn_pp (any schedule); for this forward, drop the pp axis or use "
+            "attn_impl='auto'."
         )
 
 
@@ -555,14 +587,19 @@ def loss_fn_pp(
     the pipeline (1F1B) or after it (GPipe) on the full batch, ordinary GSPMD, so the
     fused kernel variants dispatch exactly as on the non-pipelined path. Sample packing
     (``segment_ids``) rides the pipeline as per-microbatch side constants, exactly like
-    ``llama.loss_fn_pp``."""
+    ``llama.loss_fn_pp``. sp attention modes (ring/ulysses/allgather over an active sp
+    mesh) train inside the pipeline exactly like llama's sp_pipeline: the pipeline's
+    shard_map goes manual over sp, activations ride sequence-sliced, and the stage
+    body issues the collectives flat (no shard_map nesting)."""
     if schedule not in ("gpipe", "1f1b"):
         raise ValueError(f"schedule={schedule!r}: expected 'gpipe' or '1f1b'")
     if virtual_stages > 1 and schedule != "1f1b":
         raise NotImplementedError(
             "virtual_stages > 1 requires schedule='1f1b' (parallel/pp.py)"
         )
-    _guard_sp_under_pp(cfg, mesh)
+    from .common import resolve_sp_pipeline
+
+    sp_pipeline, cfg = resolve_sp_pipeline(cfg, mesh, schedule, virtual_stages)
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     B, S = inputs.shape
@@ -588,17 +625,26 @@ def loss_fn_pp(
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
         side = None
     denom = jnp.maximum(mask.sum(), 1.0)
-    if schedule == "1f1b":
+    if schedule == "1f1b" or sp_pipeline:
         from ..parallel.pp import make_pipeline_loss_fn
 
         hp = {"ln_f": params["ln_f"], "head": _head_weight(params, cfg)}
         if cfg.lm_head_bias and "b_lm_head" in params:
             hp["b_lm_head"] = params["b_lm_head"]
         pipe_loss = make_pipeline_loss_fn(
-            mesh, _pp_stage_fn(cfg, S, packed=side is not None),
+            mesh, _pp_stage_fn(cfg, S, packed=side is not None, sp_manual=sp_pipeline),
             lambda h, y, ex: _head_ce_sum_gpt(h, y, ex, cfg),
-            num_microbatches=num_microbatches, schedule="1f1b",
+            num_microbatches=num_microbatches, schedule=schedule,
             virtual_stages=virtual_stages,
+            # sp×pp: microbatch layout [M, B_m, S, D] → sequence on dim 2; packed side
+            # constants slice the same way (same contract as llama.loss_fn_pp).
+            act_spec=P(None, None, SEQUENCE_AXIS, None) if sp_pipeline else None,
+            extra_manual_axes=(SEQUENCE_AXIS,) if sp_pipeline else (),
+            side_spec=(
+                {"positions": P(None, None, SEQUENCE_AXIS),
+                 "segment_ids": P(None, None, SEQUENCE_AXIS)}
+                if (sp_pipeline and side is not None) else None
+            ),
         )
         x = _embed(params, inputs, positions, cfg)
         total = pipe_loss(

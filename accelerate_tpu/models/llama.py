@@ -459,9 +459,6 @@ def _attention_xla(q, k, v, mask, cfg: LlamaConfig):
     return jnp.einsum("bkgst,btkd->bskgd", probs, v).reshape(B, S, H, hd)
 
 
-from .common import sp_active as _sp_active, sp_manual as _sp_manual  # noqa: E402
-
-
 def _attention(q, k, v, mask, cfg: LlamaConfig, segment_ids=None):
     """Family attention via the shared dispatcher (``common.attention_dispatch``):
     sliding windows, Gemma score capping, packing, and the sp modes all flow through;
@@ -1040,29 +1037,14 @@ def loss_fn_pp(
         # Mirrors PipelineParallelPlugin's validation: an unrecognized schedule (e.g. a
         # typo'd ACCELERATE_PP_SCHEDULE) must not silently run GPipe.
         raise ValueError(f"schedule={schedule!r}: expected 'gpipe' or '1f1b'")
-    sp_pipeline = False
-    if cfg.attn_impl in ("ring", "ulysses", "ulysses_ppermute", "allgather"):
-        # Check the mesh ARGUMENT (the one the pipeline's shard_map will run under),
-        # not just the ambient context — callers may pass it without jax.set_mesh.
-        if _sp_active(mesh) or _sp_active(jax.sharding.get_abstract_mesh()):
-            # sp×pp (VERDICT r3 #10): nesting the sp attention's own shard_map inside
-            # the pipeline's fails to lower on the backward (MLIR verification), so the
-            # PIPELINE makes sp manual instead — activations ride sequence-sliced, the
-            # stage body issues the ring/ulysses collectives directly (flat shard_map,
-            # no nesting; see parallel/pp.py extra_manual_axes). MoE composes too: each
-            # sp member routes/dispatches its OWN sequence slice (per-slice capacity —
-            # exact parity in the no-drop regime, the standard MoE-under-resharding
-            # caveat) and the aux statistic is psum-meaned over sp.
-            sp_pipeline = True
-            if cfg.attn_impl == "ulysses" and (schedule == "1f1b" or virtual_stages > 1):
-                # Empirical (r4): the all_to_all PRIMITIVE inside the hand-scheduled
-                # replay's per-tick jax.grad does not finish lowering (ring/allgather
-                # compile in seconds on the same config; ulysses hangs >9 min). The
-                # ppermute-decomposed all-to-all (sequence._a2a_ppermute) lowers fine
-                # — substitute it. Same math (equivalence-tested), ~2x the minimal
-                # ring bytes; users who want the primitive's comm schedule can stay on
-                # gpipe or ring.
-                cfg = dataclasses.replace(cfg, attn_impl="ulysses_ppermute")
+    # sp×pp (VERDICT r3 #10): family-shared routing (see common.resolve_sp_pipeline for
+    # the full rationale + the ulysses→ppermute substitution under 1f1b). MoE composes
+    # too: each sp member routes/dispatches its OWN sequence slice (per-slice capacity —
+    # exact parity in the no-drop regime, the standard MoE-under-resharding caveat) and
+    # the aux statistic is psum-meaned over sp.
+    from .common import resolve_sp_pipeline
+
+    sp_pipeline, cfg = resolve_sp_pipeline(cfg, mesh, schedule, virtual_stages)
     tokens = batch["tokens"]
     inputs, targets = tokens[:, :-1], tokens[:, 1:]
     B, S = inputs.shape
