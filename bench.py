@@ -131,6 +131,15 @@ def _init_backend(attempts: int = 4, base_delay: float = 3.0, init_timeout: floa
 
 _SELF_RECORD = "BENCH_SELF.json"  # last successful real-chip result (written on success)
 
+# Sweep env vars _adopt_best_sweep_config applied this run (empty = default config).
+# Recorded into BENCH_SELF so _default_config_baseline can tell default-config scores
+# apart from adopted-config ones — the two share a metric label by design.
+_ADOPTED_ENV: dict = {}
+
+# Default-config scores ALSO persist here (never overwritten by adopted runs), so the
+# adoption guard's bar survives an adopted run's BENCH_SELF overwrite.
+_DEFAULT_RECORD = "BENCH_DEFAULT.json"
+
 import threading as _threading
 
 # Set the instant a result line (success or structured failure) hits stdout: the watchdog
@@ -401,12 +410,28 @@ def run(B: int, S: int, fuse: int, preset: str | None):
 
         rec = dict(out)
         rec["recorded_at"] = datetime.datetime.now(datetime.timezone.utc).isoformat()
-        path = os.path.join(os.path.dirname(os.path.abspath(__file__)), _SELF_RECORD)
-        try:
-            with open(path, "w") as f:
-                json.dump(rec, f)
-        except OSError:
-            pass
+        if _ADOPTED_ENV:
+            rec["sweep_adopted"] = dict(_ADOPTED_ENV)
+        here = os.path.dirname(os.path.abspath(__file__))
+        targets = [_SELF_RECORD]
+        # The default-config bar is only allowed to come from a PRISTINE default run:
+        # no adopted env, no config env knobs set (label-invisible ones like
+        # ACCEL_FLASH_BLOCK_Q would silently replace the bar with a non-default score),
+        # and the label actually scored (OOM-halving changes B mid-run) must equal the
+        # env-derived default label.
+        if _pristine_default_config() and out["metric"] == _metric_label(
+            int(_os.environ.get("BENCH_B", "4")),
+            int(_os.environ.get("BENCH_S", "2048")),
+            int(_os.environ.get("BENCH_FUSE", "4")),
+            None,
+        ):
+            targets.append(_DEFAULT_RECORD)
+        for name in targets:
+            try:
+                with open(os.path.join(here, name), "w") as f:
+                    json.dump(rec, f)
+            except OSError:
+                pass
 
 
 def _metric_label(B: int, S: int, fuse: int, preset: str | None, cfg=None) -> str:
@@ -463,6 +488,18 @@ _TUNING_KNOBS = {
 # Pallas kernel: a pure implementation swap, adoptable like BENCH_LOSS_IMPL.
 _ADOPTABLE_VALUES = {"BENCH_OPT": {"fused_adamw", "fused_adamw_xla"}}
 
+# Every env knob that changes what bench.py runs (tuning OR workload). A run with any of
+# these set is not a pristine default-config run and must not write _DEFAULT_RECORD.
+_CONFIG_ENV_KNOBS = _TUNING_KNOBS | {
+    "BENCH_B", "BENCH_S", "BENCH_FUSE", "BENCH_REMAT", "BENCH_OPT", "BENCH_ACCUM",
+}
+
+
+def _pristine_default_config() -> bool:
+    import os
+
+    return not _ADOPTED_ENV and not any(k in os.environ for k in _CONFIG_ENV_KNOBS)
+
 
 def _env_adoptable(env: dict) -> bool:
     for k, v in env.items():
@@ -473,12 +510,47 @@ def _env_adoptable(env: dict) -> bool:
     return True
 
 
-def _adopt_best_sweep_config() -> None:
+def _default_config_baseline(default_metric: str) -> dict | None:
+    """The last real-chip score of the DEFAULT config (no sweep env adopted): the bar a
+    sweep row must clear before its env is worth adopting. 2026-08-01 window lesson:
+    the sweep best (loss_fused, 0.178) was BELOW the default config's fresh 0.1848,
+    and unconditional adoption turned the next scoring run into a 0.1429 regression.
+
+    Reads the dedicated ``BENCH_DEFAULT.json`` record (written only by non-adopted
+    scoring runs, so an adopted run overwriting ``BENCH_SELF.json`` cannot erase the
+    bar), falling back to a non-adopted ``BENCH_SELF.json``. The record must carry the
+    same metric label as this run's DEFAULT config — an OOM-halved-batch or
+    BENCH_B/S-overridden record scored a different workload and would set a wrong bar
+    (same gate as the cached-fallback path in ``_fail_json``)."""
+    import os
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    max_age_h = float(os.environ.get("BENCH_CACHED_MAX_AGE_H", "48"))
+    for name in (_DEFAULT_RECORD, _SELF_RECORD):
+        try:
+            with open(os.path.join(here, name)) as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if rec.get("value") is None or rec.get("sweep_adopted"):
+            continue
+        if rec.get("metric") != default_metric:
+            continue
+        if _record_age_hours(rec) > max_age_h:
+            continue
+        return rec
+    return None
+
+
+def _adopt_best_sweep_config(default_metric: str) -> None:
     """If an MFU sweep left results (benchmarks/mfu_sweep.py → sweep_results.jsonl), adopt
     the best-scoring config's env overrides for any TUNING knob not explicitly set — so the
     scoring run automatically benefits from a sweep that completed earlier. Rows whose
     sweep_env touches workload knobs are skipped entirely (they scored a different
-    workload, so their MFU is not comparable)."""
+    workload, so their MFU is not comparable). The best row must BEAT the default
+    config's own last real-chip score (``_default_config_baseline``) — a sweep whose
+    winner is below the baseline means the default config is already the best known,
+    and adopting anything from it would be a measured regression."""
     import os
 
     if os.environ.get("BENCH_AUTO_BEST", "1") != "1":
@@ -504,9 +576,17 @@ def _adopt_best_sweep_config() -> None:
         return
     if best is None or not best.get("sweep_env"):
         return
+    baseline = _default_config_baseline(default_metric)
+    if baseline is not None and best["value"] <= baseline["value"]:
+        print(f"bench: sweep best '{best.get('sweep_config')}' (MFU {best['value']}) "
+              f"does not beat the default config's last real-chip score "
+              f"(MFU {baseline['value']}, {baseline.get('recorded_at', '?')}) — "
+              "keeping the default config", file=sys.stderr)
+        return
     applied = {k: v for k, v in best["sweep_env"].items() if k not in os.environ}
     os.environ.update(applied)
     if applied:
+        _ADOPTED_ENV.update(applied)
         print(f"bench: adopting sweep best '{best.get('sweep_config')}' "
               f"(MFU {best['value']}): {applied}", file=sys.stderr)
 
@@ -524,11 +604,13 @@ def main():
     enable_compile_cache(_here)
 
     preset = os.environ.get("BENCH_PRESET")
-    if not preset:
-        _adopt_best_sweep_config()
     B = int(os.environ.get("BENCH_B", "4"))
     S = int(os.environ.get("BENCH_S", "2048"))
     fuse = int(os.environ.get("BENCH_FUSE", "4"))
+    if not preset:
+        # The PRE-adoption label is what a default-config run of this workload would
+        # be called — the key _default_config_baseline matches its bar against.
+        _adopt_best_sweep_config(_metric_label(B, S, fuse, preset))
     metric = _metric_label(B, S, fuse, preset)
 
     if preset == "smoke":

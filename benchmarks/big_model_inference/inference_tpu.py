@@ -99,9 +99,8 @@ def main() -> int:
     if args.smoke:
         # CI/CPU: the environment's sitecustomize may pin the platform list to a remote TPU
         # plugin at interpreter start; the env var alone cannot override it (same fix as
-        # tests/conftest.py).
-        import os
-
+        # tests/conftest.py).  NB: uses the module-level ``import os`` — a local import
+        # here would shadow it for the WHOLE function and break the branches below.
         os.environ["JAX_PLATFORMS"] = "cpu"
         jax.config.update("jax_platforms", "cpu")
     import jax.numpy as jnp
