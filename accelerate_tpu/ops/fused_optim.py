@@ -129,22 +129,39 @@ def _adamw_kernel(
     vo_ref[:] = v_new.astype(vo_ref.dtype)
 
 
+_VMEM_BUDGET = 12 * 2**20  # bytes a block's refs may claim; v5e VMEM is ~16 MB total
+
+
 def _leaf_fused(p, m, v, g, scalars, *, b1, b2, eps, wd, block_rows, interpret):
     """Run the kernel over one leaf reshaped to [rows, 1024].
 
     Rows that don't divide by a near-``block_rows`` factor are PADDED up to a multiple
     (the update math is elementwise, so padded rows compute garbage that is sliced off) —
     the old largest-divisor rule degraded to block_rows=1 for prime row counts, turning
-    one launch into thousands of [1, 1024] grid steps."""
+    one launch into thousands of [1, 1024] grid steps.
+
+    ``block_rows`` is additionally capped by a VMEM budget: the grid streams 7 refs
+    (p/m/v/g in, p/m/v out) and Pallas double-buffers each, so an all-fp32 512-row
+    block claims 2 x 512 x 1024 x 28 B ~= 29 MB — past the v5e's ~16 MB VMEM. That is
+    what 500'd the 2026-08-01 window's ``opt_fused_adamw`` rows at bench shapes while
+    the small-leaf probe (rows=128, 7.3 MB) compiled fine: the remote compile helper
+    reports any Mosaic failure as a bare 'subprocess exit code 1'. The cap is
+    dtype-aware, so bf16 moments earn proportionally taller blocks."""
     shape, dtype = p.shape, p.dtype
     rows = p.size // _LANES
-    br = min(block_rows, rows)
+    bytes_per_row = _LANES * (
+        2 * p.dtype.itemsize + 2 * m.dtype.itemsize + 2 * v.dtype.itemsize
+        + g.dtype.itemsize
+    )
+    vmem_rows = max(8, _VMEM_BUDGET // (2 * bytes_per_row) // 8 * 8)
+    cap = min(block_rows, rows, vmem_rows)
+    br = cap
     pad = 0
-    while rows % br:  # largest divisor <= block_rows keeps the grid exact (no masking)
+    while rows % br:  # largest divisor <= cap keeps the grid exact (no masking)
         br -= 1
-    if br < min(block_rows, rows) // 4:
-        # No decent divisor (prime-ish rows): pad to a block_rows multiple instead.
-        br = min(block_rows, rows)
+    if br < cap // 4:
+        # No decent divisor (prime-ish rows): pad to a cap multiple instead.
+        br = cap
         pad = (-rows) % br
     grid = ((rows + pad) // br,)
 

@@ -296,7 +296,7 @@ def _make_optimizer(name: str):
     }[name]()
 
 
-def run(B: int, S: int, fuse: int, preset: str | None):
+def run(B: int, S: int, fuse: int, preset: str | None, default_metric: str | None = None):
     import os
 
     import jax
@@ -417,14 +417,11 @@ def run(B: int, S: int, fuse: int, preset: str | None):
         # The default-config bar is only allowed to come from a PRISTINE default run:
         # no adopted env, no config env knobs set (label-invisible ones like
         # ACCEL_FLASH_BLOCK_Q would silently replace the bar with a non-default score),
-        # and the label actually scored (OOM-halving changes B mid-run) must equal the
-        # env-derived default label.
-        if _pristine_default_config() and out["metric"] == _metric_label(
-            int(_os.environ.get("BENCH_B", "4")),
-            int(_os.environ.get("BENCH_S", "2048")),
-            int(_os.environ.get("BENCH_FUSE", "4")),
-            None,
-        ):
+        # and the label actually scored must equal main()'s pre-run default label
+        # (OOM-halving changes B mid-run, shifting out["metric"] off default_metric).
+        if (_pristine_default_config() and default_metric is not None
+                and out["metric"] == default_metric):
+            rec["pristine"] = True
             targets.append(_DEFAULT_RECORD)
         for name in targets:
             try:
@@ -518,8 +515,11 @@ def _default_config_baseline(default_metric: str) -> dict | None:
 
     Reads the dedicated ``BENCH_DEFAULT.json`` record (written only by non-adopted
     scoring runs, so an adopted run overwriting ``BENCH_SELF.json`` cannot erase the
-    bar), falling back to a non-adopted ``BENCH_SELF.json``. The record must carry the
-    same metric label as this run's DEFAULT config — an OOM-halved-batch or
+    bar), falling back to a pristine-stamped ``BENCH_SELF.json``. The record must carry
+    the POSITIVE ``pristine`` stamp — absence of ``sweep_adopted`` is not proof, since
+    records written by older bench.py versions after adopting label-invisible knobs
+    (BENCH_LOSS_IMPL et al. keep the default label by design) have neither field — and
+    the same metric label as this run's DEFAULT config: an OOM-halved-batch or
     BENCH_B/S-overridden record scored a different workload and would set a wrong bar
     (same gate as the cached-fallback path in ``_fail_json``)."""
     import os
@@ -532,7 +532,7 @@ def _default_config_baseline(default_metric: str) -> dict | None:
                 rec = json.load(f)
         except (OSError, json.JSONDecodeError):
             continue
-        if rec.get("value") is None or rec.get("sweep_adopted"):
+        if rec.get("value") is None or not rec.get("pristine"):
             continue
         if rec.get("metric") != default_metric:
             continue
@@ -556,6 +556,19 @@ def _adopt_best_sweep_config(default_metric: str) -> None:
     if os.environ.get("BENCH_AUTO_BEST", "1") != "1":
         return
     path = os.path.join(os.path.dirname(os.path.abspath(__file__)), "sweep_results.jsonl")
+    max_age_h = float(os.environ.get("BENCH_CACHED_MAX_AGE_H", "48"))
+    try:
+        import time as _time
+
+        sweep_age_h = (_time.time() - os.path.getmtime(path)) / 3600
+    except OSError:
+        return
+    if sweep_age_h > max_age_h:
+        # Sweep rows carry no timestamps; gate on file mtime so a days-old sweep can't
+        # drive adoption against current-code perf (same bound as the cached fallback).
+        print(f"bench: sweep_results.jsonl is {sweep_age_h:.0f}h old (> {max_age_h:.0f}h)"
+              " — ignoring it", file=sys.stderr)
+        return
     best = None
     try:
         with open(path) as f:
@@ -583,6 +596,11 @@ def _adopt_best_sweep_config(default_metric: str) -> None:
               f"(MFU {baseline['value']}, {baseline.get('recorded_at', '?')}) — "
               "keeping the default config", file=sys.stderr)
         return
+    if baseline is None:
+        # Disarmed-guard visibility: adopting with no bar is the pre-guard behavior;
+        # say so instead of failing silent either way.
+        print("bench: no pristine default-config bar (missing, stale, or pre-stamp "
+              "record) — adopting the sweep best unguarded", file=sys.stderr)
     applied = {k: v for k, v in best["sweep_env"].items() if k not in os.environ}
     os.environ.update(applied)
     if applied:
@@ -607,10 +625,12 @@ def main():
     B = int(os.environ.get("BENCH_B", "4"))
     S = int(os.environ.get("BENCH_S", "2048"))
     fuse = int(os.environ.get("BENCH_FUSE", "4"))
+    # The PRE-adoption label is what a default-config run of this workload would be
+    # called — the key _default_config_baseline matches its bar against, and the ONE
+    # label run()'s BENCH_DEFAULT write gate compares to (no re-derived literals).
+    default_metric = _metric_label(B, S, fuse, preset)
     if not preset:
-        # The PRE-adoption label is what a default-config run of this workload would
-        # be called — the key _default_config_baseline matches its bar against.
-        _adopt_best_sweep_config(_metric_label(B, S, fuse, preset))
+        _adopt_best_sweep_config(default_metric)
     metric = _metric_label(B, S, fuse, preset)
 
     if preset == "smoke":
@@ -643,7 +663,7 @@ def main():
     xla_retry_done = False
     while True:
         try:
-            run(B, S, fuse, preset)
+            run(B, S, fuse, preset, default_metric=default_metric)
             return 0
         except Exception as e:  # noqa: BLE001
             from accelerate_tpu.state import AcceleratorState, GradientState, PartialState

@@ -95,8 +95,36 @@ def probe_flash() -> None:
     jax.block_until_ready(o)
 
 
+def probe_fused_adamw_bench_shape() -> None:
+    """The 2026-08-01 lesson: the small-leaf probe compiled while bench shapes 500'd —
+    the kernel's default block was 2x over VMEM once the grid got real (double-buffered
+    7-ref blocks; see fused_optim._leaf_fused). This probe compiles the kernel at an
+    embed-sized fp32 leaf (rows=65536, the largest leaf the 0.9B bench applies), so a
+    shape-dependent compile failure shows up HERE in chip-seconds, not as a dead
+    15-minute sweep row."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.ops.fused_optim import FusedAdamW
+
+    opt = FusedAdamW(learning_rate=1e-3)
+    params = {"embed": jnp.ones((32768, 2048), jnp.float32)}
+    state = opt.init(params)
+    grads = jax.tree.map(lambda p: jnp.full_like(p, 0.01), params)
+
+    @jax.jit
+    def step(g, s, p):
+        return opt.fused_apply(g, s, p)
+
+    new_params, _ = step(grads, state, params)
+    jax.block_until_ready(new_params)
+    np.testing.assert_array_less(np.asarray(new_params["embed"])[0, 0], 1.0)
+
+
 PROBES = {
     "fused_adamw": probe_fused_adamw,
+    "fused_adamw_bench_shape": probe_fused_adamw_bench_shape,
     "fused_xent": probe_fused_xent,
     "flash": probe_flash,
 }

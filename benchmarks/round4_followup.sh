@@ -1,0 +1,38 @@
+#!/bin/bash
+# Round-4 follow-up: re-run the two inference rows the 2026-08-01 17:xx window lost —
+# gptj6b-bf16 died on the (since-fixed) UnboundLocalError in inference_tpu.py main();
+# t0pp-bf16-host hit the 1500s row timeout (host-streamed 11B enc-dec + host
+# contention from a concurrently running test suite; the suite is gone and the
+# timeout is doubled — s/token itself is timeout-independent).  Chained behind the
+# main chain's pid because editing or re-entering a running bash script corrupts it.
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (round4 chain3) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 60; done
+fi
+
+echo "=== round4 followup start: $(date -u) ==="
+echo "=== waiting for TPU ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+
+run_row() {
+  name="$1"; shift
+  echo "=== inference row: $name ==="
+  timeout "${ROW_TIMEOUT:-3000}" python benchmarks/big_model_inference/inference_tpu.py "$@" --markdown
+  echo "row $name rc=$?"
+  python benchmarks/mfu_sweep.py --per-run-timeout 1 --only __none__ >/dev/null 2>&1 || {
+    echo "TPU went away after $name; re-arming wait"; \
+    python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true; }
+}
+
+run_row gptj6b-bf16      gptj-6b --dtype bf16
+run_row t0pp-bf16-host   t0pp --dtype bf16 --offload host
+
+python benchmarks/big_model_inference/collect_results.py || true
+
+echo "=== final pristine scoring run ==="
+timeout 900 python bench.py
+echo "bench rc=$?"
+echo "=== round4 followup done: $(date -u) ==="
