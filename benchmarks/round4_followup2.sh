@@ -1,0 +1,51 @@
+#!/bin/bash
+# Round-4 follow-up v2 (supersedes round4_followup.sh, which was killed while still
+# waiting — never edit a running bash script). Runs after the main chain exits:
+#  1. kernel probes incl. the NEW bench-shape fused-AdamW probe — the VMEM-cap fix
+#     (ops/fused_optim.py ee9b7b2) gets its compile verdict in chip-seconds.
+#  2. The fused-AdamW sweep rows the 17:1x window lost to the VMEM 500s (stage 7 of
+#     the main chain re-runs the r3_fused_all_* stacks but NOT the plain opt rows).
+#  3. The two inference rows the window lost: gptj6b (UnboundLocalError, since fixed)
+#     and t0pp-host (1500s timeout under host contention; ROW_TIMEOUT doubled).
+#  4. collect_results + a final adopt-best scoring run (guarded adoption: only a row
+#     that BEAT the pristine default bar can change the config).
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (round4 chain3) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 60; done
+fi
+
+echo "=== round4 followup2 start: $(date -u) ==="
+echo "=== waiting for TPU ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+
+echo "=== 1. kernel probes (VMEM-cap verdict) ==="
+timeout 1200 python benchmarks/kernel_probe.py
+echo "probe rc=$?"
+
+echo "=== 2. fused-AdamW rows lost to the VMEM 500s ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
+  --only opt_fused_adamw,blocks512_fused_adamw,r3_fused_all,r3_fused_all_blocks512
+
+run_row() {
+  name="$1"; shift
+  echo "=== inference row: $name ==="
+  timeout "${ROW_TIMEOUT:-3000}" python benchmarks/big_model_inference/inference_tpu.py "$@" --markdown
+  echo "row $name rc=$?"
+  python benchmarks/mfu_sweep.py --per-run-timeout 1 --only __none__ >/dev/null 2>&1 || {
+    echo "TPU went away after $name; re-arming wait"; \
+    python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true; }
+}
+
+echo "=== 3. inference rows lost in the 17:1x window ==="
+run_row gptj6b-bf16      gptj-6b --dtype bf16
+run_row t0pp-bf16-host   t0pp --dtype bf16 --offload host
+
+python benchmarks/big_model_inference/collect_results.py || true
+
+echo "=== 4. final adopt-best scoring run ==="
+timeout 900 python bench.py
+echo "bench rc=$?"
+echo "=== round4 followup2 done: $(date -u) ==="
