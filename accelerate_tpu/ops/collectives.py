@@ -47,8 +47,6 @@ def maybe_shard(x, spec, require_axis: Optional[str] = None):
     """Apply a sharding constraint only when a mesh context is active (``jax.set_mesh``) —
     and, if ``require_axis`` is given, only when that axis exists in the mesh. Lets the same
     model code run in plain single-device baselines."""
-    import jax
-
     mesh = jax.sharding.get_abstract_mesh()
     if mesh is None or mesh.empty:
         return x

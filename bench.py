@@ -399,7 +399,6 @@ def run(B: int, S: int, fuse: int, preset: str | None, default_metric: str | Non
         out["preset"] = preset
     print(json.dumps(out))
     _RESULT_PRINTED.set()
-    import os as _os
 
     if not preset and jax.default_backend() != "cpu" and _os.environ.get(
         "BENCH_NO_SELF_RECORD"

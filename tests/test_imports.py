@@ -55,3 +55,44 @@ def test_import_time_budget(attempts):
     with_pkg = min(_wall("import accelerate_tpu") for _ in range(attempts))
     delta = with_pkg - base
     assert delta < 2.0, f"import delta {delta:.2f}s exceeds the 2s budget"
+
+
+def test_no_local_import_shadows_module_level():
+    """A function-local ``import X`` of a name also imported at module level makes X
+    function-local for the WHOLE function — any use on a path that skips the import
+    raises UnboundLocalError. This killed the gptj6b s/token row in the 2026-08-01
+    TPU window: ``inference_tpu.py::main`` locally imported ``os`` inside its CPU
+    branch, so the real-TPU branch (which no CPU test walks) crashed at
+    ``os.environ``. AST-scan every entry point and package module for the pattern."""
+    import ast
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    targets = (
+        sorted((root / "accelerate_tpu").rglob("*.py"))
+        + sorted((root / "benchmarks").rglob("*.py"))
+        + sorted((root / "examples").rglob("*.py"))
+        + [root / "bench.py", root / "__graft_entry__.py"]
+    )
+    offenders = []
+    for path in targets:
+        tree = ast.parse(path.read_text())
+        top = set()
+        for n in tree.body:
+            if isinstance(n, ast.Import):
+                top.update(a.asname or a.name.split(".")[0] for a in n.names)
+            elif isinstance(n, ast.ImportFrom):
+                top.update(a.asname or a.name for a in n.names)
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for n in ast.walk(fn):
+                if isinstance(n, ast.Import):
+                    for a in n.names:
+                        name = a.asname or a.name.split(".")[0]
+                        if name in top:
+                            offenders.append(
+                                f"{path.relative_to(root)}:{n.lineno} "
+                                f"{fn.name}() shadows module-level '{name}'"
+                            )
+    assert not offenders, "\n".join(offenders)
