@@ -13,6 +13,7 @@ __version__ = "0.1.0"
 from .state import AcceleratorState, GradientState, PartialState
 from .big_modeling import (
     cpu_offload,
+    cpu_offload_with_hook,
     disk_offload,
     dispatch_model,
     init_empty_weights,

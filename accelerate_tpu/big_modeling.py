@@ -44,11 +44,13 @@ __all__ = [
     "init_empty_weights",
     "init_on_device",
     "cpu_offload",
+    "cpu_offload_with_hook",
     "disk_offload",
     "dispatch_model",
     "load_checkpoint_and_dispatch",
     "DispatchedParams",
     "stream_blocks",
+    "UserOffloadHook",
 ]
 
 
@@ -235,6 +237,73 @@ def disk_offload(tree: Any, offload_dir: Union[str, Path], main_device=None) -> 
     """Spill every weight to the memmap store; stream per block (reference ``:260``)."""
     device_map = {p: "disk" for p in _top_prefixes(tree)}
     return DispatchedParams.from_tree(tree, device_map, offload_dir=offload_dir, main_device=main_device)
+
+
+class UserOffloadHook:
+    """Manual-control offload handle for one model's params (reference ``hooks.py:726``).
+
+    ``fetch()`` returns a device-resident copy of the params (transferring from the
+    pinned host copy on first call, cached until offloaded); ``offload()`` frees the
+    HBM copy NOW — jax buffer ``delete()``, not GC — invalidating every previously
+    fetched tree (fetch again for a fresh one). A ``prev_module_hook`` is offloaded
+    automatically when this hook fetches, which is what chains a multi-model pipeline
+    through one chip's HBM."""
+
+    def __init__(self, host_tree: Any, main_device=None, prev_module_hook: "UserOffloadHook" = None):
+        self._host = host_tree
+        self._main_device = main_device
+        self._prev = prev_module_hook
+        self._on_device: Any = None
+
+    def fetch(self) -> Any:
+        import jax
+
+        if self._prev is not None:
+            self._prev.offload()
+        if self._on_device is None:
+            device = self._main_device or jax.devices()[0]
+            self._on_device = jax.device_put(self._host, device)
+        return self._on_device
+
+    def offload(self) -> None:
+        if self._on_device is not None:
+            import jax
+
+            for leaf in jax.tree_util.tree_leaves(self._on_device):
+                if hasattr(leaf, "delete"):
+                    leaf.delete()
+            self._on_device = None
+
+
+def cpu_offload_with_hook(
+    tree: Any, main_device=None, prev_module_hook: Optional[UserOffloadHook] = None,
+) -> tuple[Callable[[], Any], UserOffloadHook]:
+    """Offload a whole model's params to host RAM with MANUAL reload control — the
+    multi-model-pipeline variant of :func:`cpu_offload` (reference ``big_modeling.py:216``).
+
+    Unlike :func:`cpu_offload` (which streams block-by-block every forward), the params
+    move to the device **whole** on ``fetch()`` and STAY until ``hook.offload()`` — the
+    right trade when a model is invoked many times in a row before the pipeline moves
+    on (the reference's example is exactly this). Chain hooks via ``prev_module_hook``
+    so fetching stage N+1 evicts stage N::
+
+        fetch_1, hook_1 = cpu_offload_with_hook(encoder_params)
+        fetch_2, hook_2 = cpu_offload_with_hook(unet_params, prev_module_hook=hook_1)
+        fetch_3, hook_3 = cpu_offload_with_hook(vae_params,  prev_module_hook=hook_2)
+        enc = encode(fetch_1(), batch)       # encoder in HBM
+        for _ in range(steps):
+            x = denoise(fetch_2(), enc)      # first fetch_2() evicts the encoder
+        img = decode(fetch_3(), x)           # evicts the unet
+        hook_3.offload()
+
+    Returns ``(fetch, hook)``: ``fetch()`` is the device-params getter to pass into the
+    model's functional forward; ``hook`` exposes ``offload()`` (and is what you thread
+    into the next stage's ``prev_module_hook``)."""
+    import jax
+
+    host = jax.tree_util.tree_map(lambda x: np.asarray(x), tree)
+    hook = UserOffloadHook(host, main_device=main_device, prev_module_hook=prev_module_hook)
+    return hook.fetch, hook
 
 
 def dispatch_model(

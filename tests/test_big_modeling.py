@@ -327,3 +327,36 @@ def test_load_checkpoint_shape_mismatch_raises(tmp_path):
     abstract = init_empty_weights(llama.init_params, bad_cfg, jax.random.PRNGKey(0))
     with pytest.raises(ValueError, match="Shape mismatch"):
         load_checkpoint_in_model(abstract, tmp_path, device_map={"": 0})
+
+
+def test_cpu_offload_with_hook_chain():
+    """The manual-control offload variant (reference big_modeling.py:216 /
+    hooks.py:726): fetch() moves a model's params on-device WHOLE and caches them;
+    offload() frees the HBM copy immediately (buffer delete — previously fetched trees
+    are invalidated); fetching a hook with a prev_module_hook evicts the previous
+    stage first, chaining a multi-model pipeline through one device's memory."""
+    from accelerate_tpu import cpu_offload_with_hook
+
+    p1 = {"w": jnp.ones((8, 8), jnp.float32)}
+    p2 = {"w": jnp.full((8, 8), 2.0, jnp.float32)}
+
+    fetch1, hook1 = cpu_offload_with_hook(p1)
+    fetch2, hook2 = cpu_offload_with_hook(p2, prev_module_hook=hook1)
+
+    d1 = fetch1()
+    assert float(jnp.sum(d1["w"] @ d1["w"])) == 8 * 8 * 8
+    assert fetch1() is d1  # cached while resident — repeated invocations don't re-transfer
+
+    d2 = fetch2()  # evicts stage 1
+    assert hook1._on_device is None
+    with pytest.raises(RuntimeError):
+        _ = np.asarray(d1["w"])  # stage-1 buffers were deleted, not GC'd
+    assert float(d2["w"][0, 0]) == 2.0
+
+    d1b = fetch1()  # re-fetch after eviction works (fresh transfer from the host copy)
+    assert float(d1b["w"][0, 0]) == 1.0
+
+    hook2.offload()
+    hook1.offload()
+    assert hook1._on_device is None and hook2._on_device is None
+    hook1.offload()  # idempotent

@@ -74,25 +74,42 @@ def test_no_local_import_shadows_module_level():
         + sorted((root / "examples").rglob("*.py"))
         + [root / "bench.py", root / "__graft_entry__.py"]
     )
+    def bound_names(node):
+        for a in node.names:
+            if a.name == "*":
+                continue
+            yield a.asname or (
+                a.name.split(".")[0] if isinstance(node, ast.Import) else a.name
+            )
+
+    def own_imports(fn):
+        # This function's OWN import statements only: a nested def/lambda is its own
+        # scope (it is scanned as its own FunctionDef), so its imports must be neither
+        # attributed to the enclosing function nor reported twice.
+        stack = list(ast.iter_child_nodes(fn))
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            if isinstance(n, (ast.Import, ast.ImportFrom)):
+                yield n
+            stack.extend(ast.iter_child_nodes(n))
+
     offenders = []
     for path in targets:
         tree = ast.parse(path.read_text())
         top = set()
         for n in tree.body:
-            if isinstance(n, ast.Import):
-                top.update(a.asname or a.name.split(".")[0] for a in n.names)
-            elif isinstance(n, ast.ImportFrom):
-                top.update(a.asname or a.name for a in n.names)
+            if isinstance(n, (ast.Import, ast.ImportFrom)):
+                top.update(bound_names(n))
         for fn in ast.walk(tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
-            for n in ast.walk(fn):
-                if isinstance(n, ast.Import):
-                    for a in n.names:
-                        name = a.asname or a.name.split(".")[0]
-                        if name in top:
-                            offenders.append(
-                                f"{path.relative_to(root)}:{n.lineno} "
-                                f"{fn.name}() shadows module-level '{name}'"
-                            )
+            for n in own_imports(fn):
+                for name in bound_names(n):
+                    if name in top:
+                        offenders.append(
+                            f"{path.relative_to(root)}:{n.lineno} "
+                            f"{fn.name}() shadows module-level '{name}'"
+                        )
     assert not offenders, "\n".join(offenders)
