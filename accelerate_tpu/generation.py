@@ -27,6 +27,7 @@ magnitude on models that fit.
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Callable, Optional
 
@@ -176,6 +177,7 @@ def streamed_generate_loop(
     prompt_mask: Optional[jax.Array],
     gen: GenerationConfig,
     rng: Optional[jax.Array] = None,
+    pass_times: Optional[list] = None,
 ) -> jax.Array:
     """Host-driven decode loop for weight-streamed models (shared by the llama/gpt
     ``generate_streamed`` paths).
@@ -185,7 +187,22 @@ def streamed_generate_loop(
     None) is the prefill. Unlike ``generate_loop``, this cannot be one compiled scan —
     weights arrive per block per pass — so EOS handling early-exits the Python loop once
     every row has finished.
+
+    ``pass_times``: pass a list to receive per-pass wall seconds (prefill first, then one
+    entry per decode step, each blocked on its logits). Streamed decode re-streams the
+    whole model every pass, so steady-state s/token is measurable from ONE call's tail
+    entries — the big-model bench uses this instead of paying a second full-streaming run.
     """
+
+    def timed(*args):
+        if pass_times is None:
+            return one_pass(*args)
+        t0 = time.perf_counter()
+        out = one_pass(*args)
+        jax.block_until_ready(out[0])
+        pass_times.append(time.perf_counter() - t0)
+        return out
+
     prompt = jnp.asarray(prompt, jnp.int32)
     B, S0 = prompt.shape
     if prompt_mask is None:
@@ -193,7 +210,7 @@ def streamed_generate_loop(
     if rng is None:
         rng = jax.random.PRNGKey(0)
     step_rngs = jax.random.split(rng, gen.max_new_tokens)
-    logits, cache = one_pass(prompt, None, prompt_mask)
+    logits, cache = timed(prompt, None, prompt_mask)
     token = sample_logits(logits, gen, step_rngs[0])
     done = (
         token == gen.eos_token_id if gen.eos_token_id is not None
@@ -201,7 +218,7 @@ def streamed_generate_loop(
     )
     out = [token]
     for t in range(1, gen.max_new_tokens):
-        logits, cache = one_pass(token[:, None], cache, jnp.ones((B, 1), jnp.bool_))
+        logits, cache = timed(token[:, None], cache, jnp.ones((B, 1), jnp.bool_))
         nxt = sample_logits(logits, gen, step_rngs[t])
         if gen.eos_token_id is not None:
             out.append(jnp.where(done, jnp.int32(gen.pad_token_id), nxt))

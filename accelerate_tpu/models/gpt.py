@@ -832,6 +832,7 @@ def generate_streamed(
     rng: Optional[jax.Array] = None,
     prompt_mask: Optional[jax.Array] = None,
     prefetch: int = 2,
+    pass_times: Optional[list] = None,
 ) -> jax.Array:
     """Generation for GPT models bigger than HBM (gpt-neox-20b bf16 = 40 GB, opt-30b = 60 GB):
     block weights stream from host RAM / disk with double-buffered prefetch.
@@ -885,7 +886,8 @@ def generate_streamed(
             logits = logits + jnp.asarray(head_bias, jnp.float32)
         return logits, {"layers": new_layers, "valid": valid, "index": index + tokens.shape[1]}
 
-    return streamed_generate_loop(one_pass, prompt, prompt_mask, gen, rng)
+    return streamed_generate_loop(one_pass, prompt, prompt_mask, gen, rng,
+                                  pass_times=pass_times)
 
 
 @partial(jax.jit, static_argnames=("cfg",))

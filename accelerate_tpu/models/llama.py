@@ -1447,6 +1447,7 @@ def generate_streamed(
     rng: Optional[jax.Array] = None,
     prompt_mask: Optional[jax.Array] = None,
     prefetch: int = 2,
+    pass_times: Optional[list] = None,
 ) -> jax.Array:
     """Generation for models bigger than HBM: every forward streams blocks from host/disk.
 
@@ -1489,7 +1490,8 @@ def generate_streamed(
         logits = _streamed_head_jit(x[:, -1, :], head, transpose=cfg.tie_embeddings)
         return logits, {"layers": new_layers, "valid": valid, "index": index + tokens.shape[1]}
 
-    return streamed_generate_loop(one_pass, prompt, prompt_mask, gen, rng)
+    return streamed_generate_loop(one_pass, prompt, prompt_mask, gen, rng,
+                                  pass_times=pass_times)
 
 
 @partial(jax.jit, static_argnames=("transpose",))

@@ -846,6 +846,7 @@ def generate_streamed(
     attention_mask: Optional[jax.Array] = None,
     eos_token_id: int = 1,
     prefetch: int = 2,
+    pass_times: Optional[list] = None,
 ) -> jax.Array:
     """Greedy seq2seq generation with encoder/decoder blocks streamed from host/disk.
 
@@ -860,6 +861,9 @@ def generate_streamed(
     from ..big_modeling import stream_blocks
     from .llama import _streamed_head_jit
 
+    import time as _time
+
+    t_pass = _time.perf_counter()
     input_ids = jnp.asarray(input_ids, jnp.int32)
     B, S = input_ids.shape
     shared = dispatched.fetch("shared")
@@ -877,6 +881,11 @@ def generate_streamed(
             bias = _rel_bias(blk["attn"]["rel_bias"], S, S, bidirectional=True, cfg=cfg)
         x = _enc_block_jit(x, blk, bias, mask, cfg=cfg)
     enc_out = _t5_norm(x, dispatched.fetch("encoder/ln_f"), cfg.norm_eps)
+    if pass_times is not None:
+        # Same contract as streamed_generate_loop: entry 0 is the prefill analog (the
+        # streamed encoder), then one entry per decode step, each blocked on its tokens.
+        jax.block_until_ready(enc_out)
+        pass_times.append(_time.perf_counter() - t_pass)
 
     T = 1 + max_new_tokens
     dec = jnp.full((B, T), cfg.decoder_start_token_id, jnp.int32)
@@ -889,6 +898,7 @@ def generate_streamed(
     out = []
     dbias = None
     for t in range(max_new_tokens):
+        t_pass = _time.perf_counter()
         y = shared[dec].astype(cfg.dtype)
         for name, blk in stream_blocks(dispatched, dec_prefixes, prefetch=prefetch):
             if dbias is None:
@@ -901,6 +911,9 @@ def generate_streamed(
         nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
         nxt = jnp.where(done, eos_token_id, nxt)
         done = done | (nxt == eos_token_id)
+        if pass_times is not None:
+            jax.block_until_ready(nxt)
+            pass_times.append(_time.perf_counter() - t_pass)
         out.append(nxt)
         dec = dec.at[:, t + 1].set(nxt)
         if bool(jnp.all(done)):
