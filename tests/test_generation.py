@@ -337,3 +337,42 @@ class TestSpeculativeGPT:
             tp, prompt[None], tc, GenerationConfig(max_new_tokens=9, temperature=0.0)
         ))[0].tolist()
         assert got == want
+
+
+class TestStreamedPassTimes:
+    def test_pass_times_contract(self, tiny, tmp_path):
+        """The streamed-timing contract the big-model bench relies on (single-run
+        s/token from the decode tail): pass_times receives prefill + one entry per
+        decode pass, every entry positive, and collecting times does not change the
+        decoded tokens."""
+        cfg, params = tiny
+        from accelerate_tpu.big_modeling import cpu_offload
+
+        dispatched = cpu_offload(params)
+        prompt = jnp.asarray(
+            np.random.default_rng(6).integers(1, cfg.vocab_size, size=(2, 5)), jnp.int32
+        )
+        gen = GenerationConfig(max_new_tokens=4, temperature=0.0)
+        want = llama.generate_streamed(dispatched, prompt, cfg, gen)
+        times: list = []
+        got = llama.generate_streamed(dispatched, prompt, cfg, gen, pass_times=times)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+        # llama/gpt loop: prefill emits token 1, then max_new_tokens-1 decode passes.
+        assert len(times) == gen.max_new_tokens
+        assert all(t > 0 for t in times)
+
+    def test_pass_times_contract_t5(self):
+        """t5's own loop: encoder pass first, then one entry per decode step."""
+        from accelerate_tpu.big_modeling import cpu_offload
+        from accelerate_tpu.models import t5
+
+        cfg = dataclasses.replace(t5.CONFIGS["tiny"], dtype=jnp.float32)
+        params = t5.init_params(cfg)
+        inp = jnp.asarray(
+            np.random.default_rng(0).integers(2, cfg.vocab_size, size=(1, 7)), jnp.int32
+        )
+        times: list = []
+        out = t5.generate_streamed(cpu_offload(params), inp, cfg, max_new_tokens=5,
+                                   pass_times=times)
+        assert out.shape == (1, 5)
+        assert len(times) == 1 + 5 and all(t > 0 for t in times)
