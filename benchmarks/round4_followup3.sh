@@ -1,0 +1,36 @@
+#!/bin/bash
+# Round-4 follow-up v3: the two HUGE streamed rows (neox20b 40 GB host, opt30b 60 GB
+# disk), chained behind followup2. Both lost their first attempts to the old
+# two-full-runs protocol at ROW_TIMEOUT=1500. With the single-run decode-tail
+# protocol, bytes scale with (1 + new_tokens); --new-tokens 4 keeps the s/token
+# metric identical (every decode pass streams the same byte volume) while cutting a
+# 680 GB neox session to ~200 GB. Skips a row if results.md already has it.
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (followup2) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 60; done
+fi
+
+echo "=== round4 followup3 start: $(date -u) ==="
+RESULTS=benchmarks/big_model_inference/results.md
+
+run_row() {
+  name="$1"; marker="$2"; shift 2
+  if [ -f "$RESULTS" ] && grep -q "$marker" "$RESULTS"; then
+    echo "=== inference row: $name already recorded; skipping ==="
+    return
+  fi
+  echo "=== waiting for TPU ==="
+  python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+  echo "=== inference row: $name ==="
+  timeout "${ROW_TIMEOUT:-3000}" python benchmarks/big_model_inference/inference_tpu.py "$@" --markdown
+  echo "row $name rc=$?"
+}
+
+run_row neox20b-host '| gpt-neox-20b |' gpt-neox-20b --dtype bf16 --offload host --new-tokens 4
+run_row opt30b-disk  '| opt-30b |'      opt-30b --dtype bf16 --offload disk --new-tokens 4
+
+python benchmarks/big_model_inference/collect_results.py || true
+echo "=== round4 followup3 done: $(date -u) ==="
