@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-4 follow-up v4: tuning rows the compile helper used to 500 on, now worth
+# fresh attempts (chained behind followup3).  Motivation from decompose4 (18:44 UTC):
+#   - fwd_bwd_remat_dots measured 341 ms vs remat_full's 394 (and now COMPILES) —
+#     remat_dots / dots_unroll2 / unroll2 are adoptable end-to-end candidates;
+#   - attn_xla gets a fresh uncontaminated end-to-end row (kernel-level XLA attention
+#     is 5x faster than flash — incl. the OFFICIAL jax kernel at identical 2.46
+#     TFLOP/s — but r2's end-to-end row had flash ahead; settle it on a quiet host);
+#   - vmem_128m: scoped-vmem XLA flag, adoptable;
+#   - b8_dots / combo_b8_dots_unroll2: workload-labeled best-achievable probes.
+# Ends with a guarded adopt-best scoring run (only rows beating the pristine
+# default-config bar can change the config).
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (followup3) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 60; done
+fi
+
+echo "=== round4 followup4 start: $(date -u) ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
+  --only remat_dots,dots_unroll2,unroll2,attn_xla,vmem_128m,b8_dots,combo_b8_dots_unroll2
+
+echo "=== followup4 guarded adopt-best scoring run ==="
+timeout 900 python bench.py
+echo "bench rc=$?"
+echo "=== round4 followup4 done: $(date -u) ==="
