@@ -110,6 +110,25 @@ CONFIGS = [
     ("r4_opt_f8_state", {"BENCH_OPT": "fused_adamw_f8", "BENCH_LOSS_IMPL": "fused"}),
     ("r4_opt_f8_state_b8", {"BENCH_B": "8", "BENCH_OPT": "fused_adamw_f8",
                             "BENCH_LOSS_IMPL": "fused"}),
+    # --- round-4 second wave (2026-08-01 window, quiet-host singles measured first):
+    # the adoptable single-knob wins — remat_dots (+13% in decompose4 isolation),
+    # loss_chunk 1024 (+0.009), dimsem off (+0.008), fused AdamW (VMEM-capped, now
+    # compiling) — have never been measured STACKED at the scoring workload. All-tuning
+    # combos (adoptable); the b8 variants chase r3_fused_all_b8's 0.3038 (workload-
+    # labeled best-achievable).
+    ("r4_combo_dots_lc", {"BENCH_REMAT_POLICY": "dots", "BENCH_LOSS_CHUNK": "1024"}),
+    ("r4_combo_dots_lc_dimoff", {"BENCH_REMAT_POLICY": "dots", "BENCH_LOSS_CHUNK": "1024",
+                                 "ACCEL_FLASH_DIMSEM": "0"}),
+    ("r4_combo_dots_fused", {"BENCH_REMAT_POLICY": "dots", "BENCH_OPT": "fused_adamw"}),
+    ("r4_combo_dots_lc_fused", {"BENCH_REMAT_POLICY": "dots", "BENCH_LOSS_CHUNK": "1024",
+                                "BENCH_OPT": "fused_adamw"}),
+    ("r4_combo_all", {"BENCH_REMAT_POLICY": "dots", "BENCH_LOSS_CHUNK": "1024",
+                      "ACCEL_FLASH_DIMSEM": "0", "BENCH_OPT": "fused_adamw",
+                      "BENCH_LOSS_IMPL": "fused"}),
+    ("r4_fuse8_quiet", {"BENCH_FUSE": "8"}),
+    ("r4_fuse16_quiet", {"BENCH_FUSE": "16"}),
+    ("r4_b8_dots_fused", {"BENCH_B": "8", "BENCH_REMAT_POLICY": "dots",
+                          "BENCH_OPT": "fused_adamw", "BENCH_LOSS_IMPL": "fused"}),
 ]
 
 

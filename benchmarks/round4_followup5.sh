@@ -1,0 +1,23 @@
+#!/bin/bash
+# Round-4 follow-up v5: stack the measured single-knob wins (chained behind
+# followup4). Quiet-host singles from the 2026-08-01 window: default 0.2042,
+# lc1024 0.2135, dimsem_off 0.2121, mu_bf16 0.2307 (labeled), sgd ceiling 0.5792,
+# r3_fused_all_b8 0.3038. The combos have never been measured together at the
+# scoring workload; every r4_combo_* row is pure-tuning (adoptable), so a winner
+# carries into the final guarded scoring run automatically.
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (followup4) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 60; done
+fi
+
+echo "=== round4 followup5 start: $(date -u) ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
+  --only r4_combo_dots_lc,r4_combo_dots_lc_dimoff,r4_combo_dots_fused,r4_combo_dots_lc_fused,r4_combo_all,r4_fuse8_quiet,r4_fuse16_quiet,r4_b8_dots_fused
+
+echo "=== followup5 final guarded adopt-best scoring run ==="
+timeout 900 python bench.py
+echo "bench rc=$?"
+echo "=== round4 followup5 done: $(date -u) ==="
