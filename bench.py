@@ -332,6 +332,26 @@ def run(B: int, S: int, fuse: int, preset: str | None, default_metric: str | Non
     state, metrics = step(state, stacked)
     _ = float(np.asarray(metrics["loss"])[-1])
 
+    # Warm until steady (2026-08-01 discovery): the first 1-2 post-compile apply rounds
+    # pay a large one-time allocator/settling cost — at 0.9B-param AdamW the first timed
+    # round ran ~5x slower than steady state, which is why every earlier scoring run
+    # reported ~0.19-0.21 MFU while the SAME config measured 0.5076 the one time a
+    # profiling round happened to absorb the transient (the decompose's full_adamw_f1
+    # 5213 ms/step vs the 55 ms isolated apply is the same transient). Training runs for
+    # hours; a seconds-scale process-start transient doesn't belong in the metric. Warm
+    # until two consecutive rounds agree within 10% (cap 5), then time.
+    prev = None
+    settle_rounds = 0 if preset else int(os.environ.get("BENCH_MAX_SETTLE_ROUNDS", "5"))
+    for _ in range(settle_rounds):
+        t0 = time.perf_counter()
+        state, metrics = step(state, stacked)
+        _ = float(np.asarray(metrics["loss"])[-1])
+        dt_round = time.perf_counter() - t0
+        settled = prev is not None and abs(dt_round - prev) <= 0.1 * max(dt_round, prev)
+        prev = dt_round
+        if settled:
+            break
+
     n_rounds = 3
     profile_dir = os.environ.get("BENCH_PROFILE")
     if profile_dir:
@@ -409,6 +429,7 @@ def run(B: int, S: int, fuse: int, preset: str | None, default_metric: str | Non
 
         rec = dict(out)
         rec["recorded_at"] = datetime.datetime.now(datetime.timezone.utc).isoformat()
+        rec["bench_rev"] = _BENCH_REV
         if _ADOPTED_ENV:
             rec["sweep_adopted"] = dict(_ADOPTED_ENV)
         here = os.path.dirname(os.path.abspath(__file__))
@@ -472,12 +493,22 @@ def _metric_label(B: int, S: int, fuse: int, preset: str | None, cfg=None) -> st
 # Only pure TUNING knobs may be auto-adopted from sweep results. Workload knobs
 # (BENCH_B/S/FUSE/REMAT) change what is being measured — adopting a bigger batch would
 # report an MFU jump attributable to the workload, not the framework, and break
-# comparability with the tracked b4/seq2048 history.
+# comparability with the tracked b4/seq2048 history. LABEL-VISIBLE knobs (BENCH_ATTN,
+# BENCH_REMAT_POLICY — _metric_label embeds them) are likewise excluded even though
+# they are pure tuning: silently adopting one forks the tracked metric series and
+# breaks every label-matched record lookup; changing attention impl or remat policy is
+# a deliberate, committed default change, not a sweep adoption.
 _TUNING_KNOBS = {
-    "ACCEL_FLASH_BLOCK_Q", "ACCEL_FLASH_BLOCK_K", "ACCEL_FLASH_DIMSEM", "BENCH_ATTN",
-    "BENCH_REMAT_POLICY", "BENCH_SCAN_UNROLL", "BENCH_PREVENT_CSE", "BENCH_LOSS_CHUNK",
+    "ACCEL_FLASH_BLOCK_Q", "ACCEL_FLASH_BLOCK_K", "ACCEL_FLASH_DIMSEM",
+    "BENCH_SCAN_UNROLL", "BENCH_PREVENT_CSE", "BENCH_LOSS_CHUNK",
     "BENCH_LOSS_IMPL", "BENCH_CAST_PARAMS", "XLA_FLAGS",
 }
+
+# Measurement-methodology revision, stamped into BENCH_SELF/BENCH_DEFAULT records. A
+# bar measured under an older methodology is not comparable (rev 2 = warm-until-steady:
+# pre-rev-2 default-config records understated MFU ~2.4x by timing the allocator
+# settling transient) — _default_config_baseline only trusts same-rev records.
+_BENCH_REV = 2
 
 # BENCH_OPT is workload-changing in general (sgd/adafactor/mu_bf16 alter the update rule
 # or its state dtype) — EXCEPT "fused_adamw", which is the identical AdamW math as a
@@ -532,6 +563,8 @@ def _default_config_baseline(default_metric: str) -> dict | None:
         except (OSError, json.JSONDecodeError):
             continue
         if rec.get("value") is None or not rec.get("pristine"):
+            continue
+        if rec.get("bench_rev") != _BENCH_REV:
             continue
         if rec.get("metric") != default_metric:
             continue

@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-4 follow-up v5b (supersedes round4_followup5.sh — killed while waiting; never
+# edit a running bash script). Change from v5: a FRESH pristine default-config scoring
+# run comes FIRST (BENCH_AUTO_BEST=0), because the warm-until-steady methodology
+# (bench_rev 2) invalidated the old 0.1848 bar — without a same-rev bar the guarded
+# adopt-best run would adopt any sweep winner even if it regressed vs the warmed
+# default (review finding). Then the combo sweep, then the guarded scoring run.
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (followup4) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 60; done
+fi
+
+echo "=== round4 followup5b start: $(date -u) ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+
+echo "=== 1. fresh pristine default bar (bench_rev 2, no adoption) ==="
+BENCH_AUTO_BEST=0 timeout 900 python bench.py
+echo "bench rc=$?"
+
+echo "=== 2. combo sweep (warmed methodology) ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
+  --only r4_combo_dots_lc,r4_combo_dots_lc_dimoff,r4_combo_dots_fused,r4_combo_dots_lc_fused,r4_combo_all,r4_fuse8_quiet,r4_fuse16_quiet,r4_b8_dots_fused
+
+echo "=== 3. final guarded adopt-best scoring run ==="
+timeout 900 python bench.py
+echo "bench rc=$?"
+echo "=== round4 followup5b done: $(date -u) ==="
