@@ -417,6 +417,8 @@ def run(B: int, S: int, fuse: int, preset: str | None, default_metric: str | Non
     }
     if preset:
         out["preset"] = preset
+    out["bench_rev"] = _BENCH_REV  # in the printed row too: sweep rows must carry the
+    # methodology rev, or adoption would compare values across incompatible timing.
     print(json.dumps(out))
     _RESULT_PRINTED.set()
 
@@ -612,6 +614,10 @@ def _adopt_best_sweep_config(default_metric: str) -> None:
                 if row.get("cached"):
                     # A cached fallback line is the BASELINE config's number surfacing
                     # through a failed row — zero evidence about this row's env.
+                    continue
+                if row.get("bench_rev") != _BENCH_REV:
+                    # Pre-warm-up-methodology rows understated MFU ~2.4x; comparing
+                    # them against same-rev rows or the rev-gated bar is meaningless.
                     continue
                 if row.get("value") is not None and (
                     best is None or row["value"] > best["value"]

@@ -78,6 +78,45 @@ def hbm_limit_gb() -> float:
     return 16.0  # v5e
 
 
+def _numpy_random_init(mod, cfg, dtype):
+    """init_params-shaped pytree filled by numpy's PCG64 instead of jax.random.
+
+    jax.random on a single host core is the hidden load-time sink at these scales —
+    the 2026-08-01 gptj-6b row spent ~700 s of its 785 s load generating threefry
+    normals on one CPU (a 30B row would blow its whole budget before streaming a
+    byte). The serving metric (s/token) is invariant to the weight VALUES, only the
+    shapes/dtypes matter; keep the same safe magnitudes init_params uses — norm
+    'scale'-like leaves = 1, biases = 0, matrices = N(0, 1/sqrt(fan_in)), embeddings
+    = N(0, 0.02) — so random-weight forwards stay finite through deep stacks."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    abstract = jax.eval_shape(lambda: mod.init_params(cfg))
+    rng = np.random.default_rng(0)
+
+    def fill(path, leaf):
+        name = "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in path
+        ).lower()
+        shape, ld = leaf.shape, leaf.dtype
+        if not jnp.issubdtype(ld, jnp.floating):
+            return jnp.zeros(shape, ld)
+        out_dtype = dtype
+        if "scale" in name.rsplit("/", 1)[-1]:
+            return jnp.ones(shape, out_dtype)
+        if len(shape) <= 1 or name.rsplit("/", 1)[-1].startswith(("b_", "bias")):
+            return jnp.zeros(shape, out_dtype)
+        if any(k in name for k in ("embed", "wte", "wpe", "shared", "rel_bias")):
+            std = 0.02
+        else:
+            std = 1.0 / float(np.sqrt(shape[-2] if len(shape) >= 2 else shape[0]))
+        a = rng.standard_normal(size=shape, dtype=np.float32) * std
+        return jnp.asarray(a, dtype=out_dtype)
+
+    return jax.tree_util.tree_map_with_path(fill, abstract)
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("model", nargs="?", default="gptj-6b", choices=sorted(FAMILIES))
@@ -88,6 +127,10 @@ def main() -> int:
     p.add_argument("--offload", default="auto", choices=["auto", "none", "host", "disk"])
     p.add_argument("--offload-dir", default="/tmp/accel_tpu_offload")
     p.add_argument("--checkpoint", default=None, help="safetensors dir (else random init)")
+    p.add_argument("--init", default="numpy", choices=["numpy", "model"],
+                   help="random-init generator: 'numpy' (fast PCG64 host fill; s/token-"
+                        "invariant) or 'model' (the family's jax init_params — ~12 min "
+                        "of single-core threefry at 6B)")
     p.add_argument("--smoke", action="store_true", help="tiny shapes (CI / CPU)")
     p.add_argument("--kv-quant", action="store_true",
                    help="int8 KV cache (half the decode cache bytes; in-HBM path only)")
@@ -161,10 +204,13 @@ def main() -> int:
         params = dispatched.fetch("") if offload == "none" else None
     else:
         with jax.default_device(jax.devices("cpu")[0]):
-            params = jax.tree.map(
-                lambda x: x.astype(dtype) if x.dtype == jnp.float32 else x,
-                mod.init_params(cfg),
-            )
+            if args.init == "model":
+                params = jax.tree.map(
+                    lambda x: x.astype(dtype) if x.dtype == jnp.float32 else x,
+                    mod.init_params(cfg),
+                )
+            else:
+                params = _numpy_random_init(mod, cfg, dtype)
         if offload == "none":
             params = jax.device_put(params, jax.devices()[0])
             jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])

@@ -129,6 +129,13 @@ CONFIGS = [
     ("r4_fuse16_quiet", {"BENCH_FUSE": "16"}),
     ("r4_b8_dots_fused", {"BENCH_B": "8", "BENCH_REMAT_POLICY": "dots",
                           "BENCH_OPT": "fused_adamw", "BENCH_LOSS_IMPL": "fused"}),
+    # Label-INVISIBLE combos (every knob adoptable post-narrowing — BENCH_REMAT_POLICY
+    # rows above stay informative/labeled; changing the remat default is a deliberate
+    # code change, not a sweep adoption):
+    ("r4_combo_inv", {"BENCH_LOSS_CHUNK": "1024", "ACCEL_FLASH_DIMSEM": "0",
+                      "BENCH_OPT": "fused_adamw"}),
+    ("r4_combo_inv_fce", {"BENCH_LOSS_CHUNK": "1024", "ACCEL_FLASH_DIMSEM": "0",
+                          "BENCH_OPT": "fused_adamw", "BENCH_LOSS_IMPL": "fused"}),
 ]
 
 

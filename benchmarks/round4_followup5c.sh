@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-4 follow-up v5c (supersedes 5b, killed while waiting — never edit a running
+# bash script). Changes per review: (1) the combo candidates for adoption are now the
+# LABEL-INVISIBLE rows (r4_combo_inv*, loss_chunk_1024, dimsem_off, opt_fused_adamw,
+# loss_fused) — the dots/b8 rows stay in the list as labeled, informative series;
+# (2) rows now carry bench_rev, and the guard only compares same-rev rows, so the
+# fresh pristine bar from step 1 guards step 3 correctly.
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (followup4) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 60; done
+fi
+
+echo "=== round4 followup5c start: $(date -u) ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+
+echo "=== 1. fresh pristine default bar (bench_rev 2, no adoption) ==="
+BENCH_AUTO_BEST=0 timeout 900 python bench.py
+echo "bench rc=$?"
+
+echo "=== 2. combo sweep (warmed methodology) ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
+  --only loss_chunk_1024,dimsem_off,opt_fused_adamw,loss_fused,r4_combo_inv,r4_combo_inv_fce,r4_combo_dots_lc,r4_combo_all,r4_fuse8_quiet,r4_b8_dots_fused
+
+echo "=== 3. final guarded adopt-best scoring run ==="
+timeout 900 python bench.py
+echo "bench rc=$?"
+echo "=== round4 followup5c done: $(date -u) ==="
