@@ -1,0 +1,31 @@
+#!/bin/bash
+# Round-4 follow-up v6: the t0pp row one more time — its 20:19 attempt launched ten
+# minutes before the numpy-init fix landed and burned ~1300 s of its 3000 s budget on
+# single-core jax threefry init. With numpy init (~80 s at 11B) + the single-run
+# decode-tail protocol (+ --new-tokens 4: identical s/token, 4x less streaming) the
+# row fits comfortably. Also re-run gptj6b for an honest load_s under numpy init
+# (the recorded 785 s was ~700 s of threefry; the --force flag overwrites the row).
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (followup5c) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 60; done
+fi
+
+echo "=== round4 followup6 start: $(date -u) ==="
+
+run_row() {
+  name="$1"; shift
+  echo "=== waiting for TPU ==="
+  python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+  echo "=== inference row: $name ==="
+  timeout "${ROW_TIMEOUT:-3000}" python benchmarks/big_model_inference/inference_tpu.py "$@" --markdown
+  echo "row $name rc=$?"
+}
+
+run_row t0pp-bf16-host   t0pp --dtype bf16 --offload host --new-tokens 4
+run_row gptj6b-bf16-v2   gptj-6b --dtype bf16
+
+python benchmarks/big_model_inference/collect_results.py || true
+echo "=== round4 followup6 done: $(date -u) ==="
