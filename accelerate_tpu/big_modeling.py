@@ -204,17 +204,45 @@ def stream_blocks(
     """Yield ``(prefix, on_device_params)`` with background double-buffered prefetch.
 
     While block *i* computes, a worker thread reads block *i+1* (memmap → host → HBM via
-    ``jax.device_put``, which is itself asynchronous), hiding host/disk latency behind MXU time.
-    ``prefetch`` bounds resident off-schedule blocks so HBM use stays ≈ ``prefetch`` blocks.
+    ``jax.device_put``), hiding host/disk latency behind MXU time. ``prefetch`` bounds
+    resident off-schedule blocks so HBM use stays ≈ ``prefetch`` blocks.
+
+    The worker BLOCKS until its transfer has actually landed (``block_until_ready``) —
+    this is the backpressure that makes the bound real. ``jax.device_put`` is
+    asynchronous: without the fence, a host-driven consumer loop (whose per-block
+    compute dispatch is also asynchronous) laps the transport and every remaining
+    block's staged host copy + HBM allocation piles up in flight. Measured 2026-08-01:
+    a gpt-neox-20b host-streamed decode reached 130 GB RSS and was OOM-killed exactly
+    this way through the slow tunneled device; with the fence the python loop advances
+    at transfer speed and in-flight memory stays ≈ ``prefetch`` blocks on both sides.
     """
+    import jax
+
     device = device or dispatched.main_device
+
+    def fetch_sync(p):
+        params = dispatched.fetch(p, device)
+        jax.block_until_ready(params)
+        # Through the tunneled relay block_until_ready can return early (see the
+        # timing caveats in bench_timing.materialize); a one-element read-back is a
+        # guaranteed per-buffer fence. Fence EVERY leaf — tree_leaves order is
+        # sorted-key order, not enqueue order, so no single leaf is "the last
+        # transfer"; at ~ms per read-back vs multi-second block transfers the cost is
+        # noise. Zero-size leaves have nothing to fence (and would IndexError).
+        for leaf in jax.tree_util.tree_leaves(params):
+            if getattr(leaf, "ndim", None) is not None and all(
+                d > 0 for d in leaf.shape
+            ):
+                np.asarray(leaf[(0,) * leaf.ndim])
+        return params
+
     with ThreadPoolExecutor(max_workers=1) as pool:
         futures = []
         it = iter(block_prefixes)
         try:
             for _ in range(max(1, prefetch)):
                 p = next(it)
-                futures.append((p, pool.submit(dispatched.fetch, p, device)))
+                futures.append((p, pool.submit(fetch_sync, p)))
         except StopIteration:
             pass
         while futures:
@@ -222,7 +250,7 @@ def stream_blocks(
             params = fut.result()
             nxt = next(it, None)
             if nxt is not None:
-                futures.append((nxt, pool.submit(dispatched.fetch, nxt, device)))
+                futures.append((nxt, pool.submit(fetch_sync, nxt)))
             yield prefix, params
 
 

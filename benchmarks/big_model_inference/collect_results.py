@@ -32,13 +32,28 @@ def main() -> int:
     if not raw.exists():
         print("no results.md yet — run inference_tpu.py --markdown rows first", file=sys.stderr)
         return 1
-    rows = [
+    all_rows = [
         line.strip() for line in raw.read_text().splitlines()
         if line.startswith("|") and "Model" not in line and "---" not in line
     ]
-    if not rows:
+    if not all_rows:
         print("results.md has no data rows", file=sys.stderr)
         return 1
+    # Re-measured rows (same model+dtype+placement) supersede earlier attempts — the
+    # LAST appended row wins (e.g. the gptj-6b re-run with numpy init replaces the
+    # 785 s-load threefry-init row). Order of first appearance is preserved.
+    latest: dict = {}
+    for line in all_rows:
+        cells = [c.strip() for c in line.strip("|").split("|")]
+        latest[(cells[0], cells[1], cells[2])] = line
+    seen = set()
+    rows = []
+    for line in all_rows:
+        cells = [c.strip() for c in line.strip("|").split("|")]
+        key = (cells[0], cells[1], cells[2])
+        if key not in seen:
+            seen.add(key)
+            rows.append(latest[key])
 
     out = ["# Big-model inference results (TPU v5e, 16 GB HBM, single chip)", ""]
     out.append(

@@ -4,7 +4,7 @@
 # single-core jax threefry init. With numpy init (~80 s at 11B) + the single-run
 # decode-tail protocol (+ --new-tokens 4: identical s/token, 4x less streaming) the
 # row fits comfortably. Also re-run gptj6b for an honest load_s under numpy init
-# (the recorded 785 s was ~700 s of threefry; the --force flag overwrites the row).
+# (the recorded 785 s was ~700 s of threefry; collect_results.py keeps the LAST row per model+dtype+placement, superseding it).
 set -u
 cd "$(dirname "$0")/.."
 

@@ -1,0 +1,35 @@
+#!/bin/bash
+# Round-4 follow-up v7: neox20b-host and opt30b-disk one more time, now with REAL
+# streaming backpressure (stream_blocks fetch fence — the 20:52 neox attempt was
+# OOM-killed at 130 GB RSS because async device_puts outran the tunnel and staged
+# host copies piled up) plus numpy init and the single-run decode tail. Skips rows
+# already recorded in results.md.
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (followup6) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 60; done
+fi
+
+echo "=== round4 followup7 start: $(date -u) ==="
+RESULTS=benchmarks/big_model_inference/results.md
+
+run_row() {
+  name="$1"; marker="$2"; shift 2
+  if [ -f "$RESULTS" ] && grep -q "$marker" "$RESULTS"; then
+    echo "=== inference row: $name already recorded; skipping ==="
+    return
+  fi
+  echo "=== waiting for TPU ==="
+  python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+  echo "=== inference row: $name ==="
+  timeout "${ROW_TIMEOUT:-3000}" python benchmarks/big_model_inference/inference_tpu.py "$@" --markdown
+  echo "row $name rc=$?"
+}
+
+run_row neox20b-host '| gpt-neox-20b |' gpt-neox-20b --dtype bf16 --offload host --new-tokens 4
+run_row opt30b-disk  '| opt-30b |'      opt-30b --dtype bf16 --offload disk --new-tokens 4
+
+python benchmarks/big_model_inference/collect_results.py || true
+echo "=== round4 followup7 done: $(date -u) ==="
