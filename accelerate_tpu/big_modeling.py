@@ -50,6 +50,7 @@ __all__ = [
     "load_checkpoint_and_dispatch",
     "DispatchedParams",
     "stream_blocks",
+    "consume_block",
     "UserOffloadHook",
 ]
 
@@ -195,6 +196,16 @@ def _listify_int_dicts(node):
 
 
 # ------------------------------------------------------------------------ streaming executor
+def _fence_leaf(leaf: Any) -> None:
+    """Guaranteed single-buffer completion fence: materialize one element.
+
+    ``jax.block_until_ready`` can return early through the tunneled relay, so every
+    fence in this module reads one element back instead (D2H round trip ≈ ms).
+    Zero-size leaves have nothing to fence (and would IndexError)."""
+    if getattr(leaf, "ndim", None) is not None and all(d > 0 for d in leaf.shape):
+        np.asarray(leaf[(0,) * leaf.ndim])
+
+
 def stream_blocks(
     dispatched: DispatchedParams,
     block_prefixes: list[str],
@@ -228,12 +239,9 @@ def stream_blocks(
         # guaranteed per-buffer fence. Fence EVERY leaf — tree_leaves order is
         # sorted-key order, not enqueue order, so no single leaf is "the last
         # transfer"; at ~ms per read-back vs multi-second block transfers the cost is
-        # noise. Zero-size leaves have nothing to fence (and would IndexError).
+        # noise.
         for leaf in jax.tree_util.tree_leaves(params):
-            if getattr(leaf, "ndim", None) is not None and all(
-                d > 0 for d in leaf.shape
-            ):
-                np.asarray(leaf[(0,) * leaf.ndim])
+            _fence_leaf(leaf)
         return params
 
     with ThreadPoolExecutor(max_workers=1) as pool:
@@ -252,6 +260,53 @@ def stream_blocks(
             if nxt is not None:
                 futures.append((nxt, pool.submit(fetch_sync, nxt)))
             yield prefix, params
+
+
+def consume_block(
+    x_like: Any, block_params: Any,
+    dispatched: Optional[DispatchedParams] = None, prefix: Optional[str] = None,
+) -> None:
+    """Fence compute through this block, then free the block's device buffers NOW.
+
+    The companion discipline to :func:`stream_blocks` for host-driven streamed loops:
+    after dispatching block *i*'s compute, call ``consume_block(x, layer, dispatched,
+    prefix)`` before moving on. It (1) materializes one element of ``x_like`` —
+    forcing block *i*'s compute (and therefore its transfer) to complete, at ~ms cost
+    against multi-second block transfers — and (2) explicitly ``delete()``s the
+    block's param buffers.
+
+    Dropping the python reference is NOT enough on relay-attached devices when the
+    async frontier runs ahead: before :func:`stream_blocks` gained its transfer fence,
+    20B/30B host- and disk-streamed decodes retained ~0.4x of every byte they had
+    ever transferred (staged copies + client-side mirrors of still-queued buffers)
+    and were OOM-killed at 130 GB RSS (2026-08-01, twice). The fence bounds the
+    transfer side; THIS call is the compute-side complement and defense-in-depth
+    against lazy client GC: explicit deletion bounds retention to ~prefetch blocks
+    regardless of GC behavior, and transfer/compute overlap is preserved because the
+    prefetch worker keeps fetching while the consumer fences.
+
+    ``dispatched``/``prefix``: for DEVICE-RESIDENT placements ``fetch`` returns the
+    store's own array (same-device ``device_put`` is an identity), and deleting it
+    would corrupt the resident weights for every later pass — passing the store lets
+    the fence skip any leaf the store itself owns. Streamed (host/disk) leaves are
+    always fresh per-fetch copies and safe to free."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(x_like)
+    if leaves:
+        _fence_leaf(leaves[0])
+    owned: set = set()
+    if dispatched is not None and prefix is not None:
+        for key in dispatched.subkeys(prefix):
+            stored = dispatched.weights[key]
+            if not isinstance(stored, (np.ndarray, OffloadedWeight)):
+                owned.add(id(stored))
+    for leaf in jax.tree_util.tree_leaves(block_params):
+        if hasattr(leaf, "delete") and id(leaf) not in owned:
+            try:
+                leaf.delete()
+            except Exception:  # pragma: no cover - already deleted / not deletable
+                pass
 
 
 # ------------------------------------------------------------------------- user-facing API

@@ -843,7 +843,7 @@ def generate_streamed(
     (``benchmarks/big_model_inference/README.md:33-37``).
     """
     from .llama import _cache_advance, _streamed_head_jit
-    from ..big_modeling import stream_blocks
+    from ..big_modeling import consume_block, stream_blocks
     from ..generation import GenerationConfig, streamed_generate_loop
 
     if cfg.scan_layers:
@@ -879,6 +879,9 @@ def generate_streamed(
             x, new_kv = _block_cached_jit(
                 x, layer, cache["layers"][idx], index, positions, valid, cfg=cfg
             )
+            # Fence + free this block's buffers NOW (relay clients retain host
+            # mirrors of lazily-GC'd device buffers — big_modeling.consume_block).
+            consume_block(x, layer, dispatched, i)
             new_layers.append(new_kv)
         x = _layer_norm(x, ln_f, cfg.norm_eps)
         logits = _streamed_head_jit(x[:, -1, :], head, transpose=cfg.tie_embeddings)

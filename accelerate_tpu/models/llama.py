@@ -1150,7 +1150,7 @@ def forward_streamed(
     role to the reference's ``AlignDevicesHook`` forward (``hooks.py:329``), functional instead
     of module-patching. Requires ``cfg.scan_layers=False`` (blocks addressed as ``layers/<i>``).
     """
-    from ..big_modeling import stream_blocks
+    from ..big_modeling import consume_block, stream_blocks
 
     if cfg.scan_layers:
         raise ValueError("forward_streamed requires per-layer (non-scanned) params.")
@@ -1163,8 +1163,9 @@ def forward_streamed(
     embed = dispatched.fetch("embed")
     x = embed[tokens].astype(dtype)  # gather then cast (host-driven loop; see generate_streamed)
     prefixes = [f"layers/{i}" for i in range(cfg.n_layers)]
-    for _, layer in stream_blocks(dispatched, prefixes, prefetch=prefetch):
+    for name, layer in stream_blocks(dispatched, prefixes, prefetch=prefetch):
         x, _ = _block_jit(x, layer, positions, mask, cfg=cfg)
+        consume_block(x, layer, dispatched, name)  # fence + free (big_modeling.consume_block)
     ln_f = dispatched.fetch("ln_f")
     x = _rms_norm(x, ln_f, cfg.norm_eps)
     head = embed if cfg.tie_embeddings else dispatched.fetch("lm_head")
@@ -1457,7 +1458,7 @@ def generate_streamed(
     copy with the previous block's compute (``stream_blocks`` double-buffering).  Use
     ``generate`` whenever the params fit — streamed decode is HBM-bandwidth-bound by design.
     """
-    from ..big_modeling import stream_blocks
+    from ..big_modeling import consume_block, stream_blocks
     from ..generation import GenerationConfig, streamed_generate_loop
 
     if cfg.scan_layers:
@@ -1485,6 +1486,9 @@ def generate_streamed(
             x, new_kv = _block_cached_jit(
                 x, layer, cache["layers"][idx], index, positions, valid, cfg=cfg
             )
+            # Fence + free this block's buffers NOW (relay clients retain host
+            # mirrors of lazily-GC'd device buffers — see big_modeling.consume_block).
+            consume_block(x, layer, dispatched, i)
             new_layers.append(new_kv)
         x = _rms_norm(x, ln_f, cfg.norm_eps)
         logits = _streamed_head_jit(x[:, -1, :], head, transpose=cfg.tie_embeddings)

@@ -858,7 +858,7 @@ def generate_streamed(
     causality makes the garbage tail positions unobservable to position t. Weight streaming,
     not the O(T²) prefix recompute, dominates at these scales.
     """
-    from ..big_modeling import stream_blocks
+    from ..big_modeling import consume_block, stream_blocks
     from .llama import _streamed_head_jit
 
     import time as _time
@@ -880,6 +880,10 @@ def generate_streamed(
         if bias is None:  # block 0 carries the shared relative-position table
             bias = _rel_bias(blk["attn"]["rel_bias"], S, S, bidirectional=True, cfg=cfg)
         x = _enc_block_jit(x, blk, bias, mask, cfg=cfg)
+        # Fence + free (relay clients retain host mirrors of lazily-GC'd device
+        # buffers — big_modeling.consume_block). bias survives: _rel_bias built a NEW
+        # array from block 0's table before this point.
+        consume_block(x, blk, dispatched, name)
     enc_out = _t5_norm(x, dispatched.fetch("encoder/ln_f"), cfg.norm_eps)
     if pass_times is not None:
         # Same contract as streamed_generate_loop: entry 0 is the prefill analog (the
@@ -904,6 +908,7 @@ def generate_streamed(
             if dbias is None:
                 dbias = _rel_bias(blk["attn"]["rel_bias"], T, T, bidirectional=False, cfg=cfg)
             y = _dec_block_jit(y, blk, enc_out, dbias, causal, cmask, cfg=cfg)
+            consume_block(y, blk, dispatched, name)  # fence + free (see encoder loop note)
         y_t = _t5_norm(y[:, t, :], dec_ln_f, cfg.norm_eps)
         if cfg.tie_embeddings:
             y_t = y_t * (cfg.d_model**-0.5)

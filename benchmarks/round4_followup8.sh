@@ -1,0 +1,35 @@
+#!/bin/bash
+# Round-4 follow-up v8: the big streamed rows under the FULL memory discipline —
+# stream_blocks transfer fence AND the consume_block compute-side fence+delete
+# (the 22:31 neox attempt had the transfer fence alone and still crawled to
+# 124 GB RSS over 40 min: client-side buffer mirrors free on explicit delete, not
+# timely GC). Skips rows already recorded in results.md.
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (followup6) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 60; done
+fi
+
+echo "=== round4 followup8 start: $(date -u) ==="
+RESULTS=benchmarks/big_model_inference/results.md
+
+run_row() {
+  name="$1"; marker="$2"; shift 2
+  if [ -f "$RESULTS" ] && grep -q "$marker" "$RESULTS"; then
+    echo "=== inference row: $name already recorded; skipping ==="
+    return
+  fi
+  echo "=== waiting for TPU ==="
+  python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+  echo "=== inference row: $name ==="
+  timeout "${ROW_TIMEOUT:-3000}" python benchmarks/big_model_inference/inference_tpu.py "$@" --markdown
+  echo "row $name rc=$?"
+}
+
+run_row neox20b-host '| gpt-neox-20b |' gpt-neox-20b --dtype bf16 --offload host --new-tokens 4
+run_row opt30b-disk  '| opt-30b |'      opt-30b --dtype bf16 --offload disk --new-tokens 4
+
+python benchmarks/big_model_inference/collect_results.py || true
+echo "=== round4 followup8 done: $(date -u) ==="

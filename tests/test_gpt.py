@@ -191,18 +191,21 @@ def test_ring_attention_matches_local():
     )
 
 
-def test_sp_under_pp_raises_with_rationale():
-    """gpt's pipeline does not go manual over sp: an sp attn_impl with an active sp
-    mesh must fail loudly at the pipeline entry points, not hang at lowering."""
+def test_sp_under_pp_guard_scope():
+    """gpt sp×pp TRAINS through loss_fn_pp (r4 — the parity tests live in
+    tests/test_pipeline.py::test_gpt_pp_sp_*); the one remaining hole is
+    forward_pp's GPipe hidden-state path, which must still fail loudly with the
+    supported alternatives instead of hanging at lowering."""
     from accelerate_tpu.parallel import build_mesh
-    from accelerate_tpu.parallel.pp import split_params_into_stages
 
     cfg = dataclasses.replace(CFG, attn_impl="ring", scan_layers=True, n_layers=4)
     params = gpt.init_params(cfg)
-    sp = dict(params)
-    sp["layers"] = split_params_into_stages(params["layers"], 2)
     mesh = build_mesh(MeshConfig(sp=2, pp=2, dp=2))
     batch = {"tokens": jnp.asarray(make_batch(4, 32)["tokens"])}
-    with pytest.raises(NotImplementedError, match="flat-path only"):
+    from accelerate_tpu.parallel.pp import split_params_into_stages
+
+    pp_params = dict(params)
+    pp_params["layers"] = split_params_into_stages(params["layers"], 2)
+    with pytest.raises(NotImplementedError, match="loss_fn_pp"):
         with jax.set_mesh(mesh):
-            gpt.loss_fn_pp(sp, batch, cfg, mesh)
+            gpt.forward_pp(pp_params, batch["tokens"][:, :-1], cfg, mesh)
