@@ -90,7 +90,6 @@ def _numpy_random_init(mod, cfg, dtype):
     = N(0, 0.02) — so random-weight forwards stay finite through deep stacks."""
     import jax
     import jax.numpy as jnp
-    import numpy as np
 
     abstract = jax.eval_shape(lambda: mod.init_params(cfg))
     rng = np.random.default_rng(0)
