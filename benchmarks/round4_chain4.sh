@@ -1,0 +1,46 @@
+#!/bin/bash
+# Round-4 window #3 chain (2026-08-02). Chained behind the fresh bench.py scoring run
+# (pass its PID as $1). Remaining on-chip evidence, ordered by value-per-chip-minute:
+#   1. fp8-optimizer-state rows under the warmed rev-2 protocol (the pre-fix reads
+#      were 0.3008; PERF_NOTES flags them as will-read-higher)
+#   2. r3_fused_all_b8 rev-2 re-read (same flag)
+#   3. the two big streamed inference rows (neox20b host, opt30b disk) under the full
+#      streaming memory discipline (transfer fence + consume_block free)
+#   4. final scoring run so the round ends with a fresh-dated cache
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (fresh bench) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 30; done
+fi
+
+echo "=== round4 chain4 start: $(date -u) ==="
+
+echo "=== 1+2. rev-2 re-reads: fp8-state rows + fused-stack b8 ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 900 \
+  --only r4_opt_f8_state,r4_opt_f8_state_b8,r3_fused_all_b8
+
+RESULTS=benchmarks/big_model_inference/results.md
+run_row() {
+  name="$1"; marker="$2"; shift 2
+  if [ -f "$RESULTS" ] && grep -q "$marker" "$RESULTS"; then
+    echo "=== inference row: $name already recorded; skipping ==="
+    return
+  fi
+  echo "=== waiting for TPU ==="
+  python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+  echo "=== inference row: $name ==="
+  timeout "${ROW_TIMEOUT:-4500}" python benchmarks/big_model_inference/inference_tpu.py "$@" --markdown
+  echo "row $name rc=$?"
+}
+
+echo "=== 3. big streamed inference rows ==="
+run_row neox20b-host '| gpt-neox-20b |' gpt-neox-20b --dtype bf16 --offload host --new-tokens 4
+run_row opt30b-disk  '| opt-30b |'      opt-30b --dtype bf16 --offload disk --new-tokens 4
+python benchmarks/big_model_inference/collect_results.py || true
+
+echo "=== 4. final scoring run ==="
+timeout 900 python bench.py
+echo "bench rc=$?"
+echo "=== round4 chain4 done: $(date -u) ==="

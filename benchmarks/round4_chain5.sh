@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-4 window #3 chain, part 2 (supersedes round4_chain4.sh's inference stages —
+# that chain's bash was killed after launching the sweep stage so the row timeouts
+# could be fixed without editing a running script; its sweep python keeps running
+# and this chain waits on its PID, passed as $1).
+#
+# Fix applied (code-review finding): opt-30b streams ~60 GB/pass over the ~0.11 GB/s
+# tunnel — prefill + 4 decode passes + disk load ≈ 3600+ s, so the old 4500 s default
+# left no contention margin. neox (40 GB host) keeps 4500 s; opt30b gets 7200 s.
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (chain4 sweep stage) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 30; done
+fi
+
+echo "=== round4 chain5 start: $(date -u) ==="
+
+RESULTS=benchmarks/big_model_inference/results.md
+run_row() {
+  name="$1"; marker="$2"; row_timeout="$3"; shift 3
+  if [ -f "$RESULTS" ] && grep -q "$marker" "$RESULTS"; then
+    echo "=== inference row: $name already recorded; skipping ==="
+    return
+  fi
+  echo "=== waiting for TPU ==="
+  python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+  echo "=== inference row: $name (timeout ${row_timeout}s) ==="
+  timeout "$row_timeout" python benchmarks/big_model_inference/inference_tpu.py "$@" --markdown
+  echo "row $name rc=$?"
+}
+
+echo "=== 1. big streamed inference rows ==="
+run_row neox20b-host '| gpt-neox-20b |' 4500 gpt-neox-20b --dtype bf16 --offload host --new-tokens 4
+run_row opt30b-disk  '| opt-30b |'      7200 opt-30b --dtype bf16 --offload disk --new-tokens 4
+python benchmarks/big_model_inference/collect_results.py || true
+
+echo "=== 2. final scoring run ==="
+timeout 900 python bench.py
+echo "bench rc=$?"
+echo "=== round4 chain5 done: $(date -u) ==="
