@@ -79,6 +79,7 @@ class ClusterConfig:
     pp_virtual_stages: Optional[int] = None  # >1 = interleaved (requires 1f1b)
     # fp8 recipe (when mixed_precision == fp8).
     fp8_format: str = "HYBRID"
+    fp8_opt_level: str = "O1"
     fp8_margin: int = 0
     fp8_amax_history_len: int = 16
     fp8_use_delayed_scaling: bool = False
@@ -215,6 +216,10 @@ def _interactive_config() -> ClusterConfig:
         cfg.fp8_use_delayed_scaling = ask_bool("Use delayed (history-based) scaling?", False)
         if cfg.fp8_use_delayed_scaling:
             cfg.fp8_amax_history_len = ask_int("fp8 amax history length", 16)
+        cfg.fp8_opt_level = select(
+            "MS-AMP opt level? (O2 = scaled-fp8 AdamW moments, needs fused_adamw)",
+            ["O1", "O2"],
+        )
 
     # ---- ZeRO / FSDP ----------------------------------------------------------
     stage = select(

@@ -100,6 +100,10 @@ def launch_command_parser(subparsers=None) -> argparse.ArgumentParser:
     fp8.add_argument("--fp8-use-delayed-scaling", "--fp8_use_delayed_scaling",
                      action="store_true", default=None,
                      help="TE-style delayed scaling instead of per-call current scaling.")
+    fp8.add_argument("--fp8-opt-level", "--fp8_opt_level", default=None,
+                     choices=[None, "O1", "O2"],
+                     help="MS-AMP analog: O2 stores AdamW moments as scaled-fp8 "
+                          "(requires the fused optimizer; ACCELERATE_FP8_OPT_LEVEL).")
 
     train = parser.add_argument_group("Training")
     train.add_argument("--mixed-precision", "--mixed_precision", default=None,
@@ -173,6 +177,7 @@ def _apply_config_defaults(args) -> None:
         "fp8_margin": cfg.fp8_margin or None,
         "fp8_amax_history_len": cfg.fp8_amax_history_len if cfg.fp8_amax_history_len != 16 else None,
         "fp8_use_delayed_scaling": cfg.fp8_use_delayed_scaling or None,
+        "fp8_opt_level": cfg.fp8_opt_level if cfg.fp8_opt_level != "O1" else None,
         "pp_num_microbatches": cfg.pp_num_microbatches,
         "pp_schedule": getattr(cfg, "pp_schedule", None),
         "pp_virtual_stages": getattr(cfg, "pp_virtual_stages", None),
@@ -351,6 +356,7 @@ _FORWARDED = [
     ("fp8_margin", "--fp8-margin", True),
     ("fp8_amax_history_len", "--fp8-amax-history-len", True),
     ("fp8_use_delayed_scaling", "--fp8-use-delayed-scaling", False),
+    ("fp8_opt_level", "--fp8-opt-level", True),
     ("project_dir", "--project-dir", True),
     ("checkpoint_total_limit", "--checkpoint-total-limit", True),
     ("log_with", "--log-with", True),

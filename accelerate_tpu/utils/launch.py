@@ -87,6 +87,8 @@ def _common_env(args: Any) -> dict[str, str]:
         env[f"{ENV_PREFIX}FP8_AMAX_HISTORY_LEN"] = str(args.fp8_amax_history_len)
     if getattr(args, "fp8_use_delayed_scaling", None):
         env[f"{ENV_PREFIX}FP8_DELAYED_SCALING"] = "true"
+    if getattr(args, "fp8_opt_level", None) and args.fp8_opt_level != "O1":
+        env[f"{ENV_PREFIX}FP8_OPT_LEVEL"] = str(args.fp8_opt_level)
     if getattr(args, "pp_num_microbatches", None):
         env[f"{ENV_PREFIX}PP_MICROBATCHES"] = str(args.pp_num_microbatches)
     if getattr(args, "pp_schedule", None):

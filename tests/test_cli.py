@@ -110,6 +110,16 @@ def test_simple_launcher_env():
     assert env["ACCELERATE_GRADIENT_ACCUMULATION_STEPS"] == "4"
 
 
+def test_fp8_opt_level_env_serialization():
+    args = _launch_args(["--mixed-precision", "fp8", "--fp8-opt-level", "O2"])
+    _, env = prepare_simple_launcher_cmd_env(args)
+    assert env["ACCELERATE_FP8_OPT_LEVEL"] == "O2"
+    # O1 is the default — not serialized, so child env stays minimal
+    args = _launch_args(["--mixed-precision", "fp8", "--fp8-opt-level", "O1"])
+    _, env = prepare_simple_launcher_cmd_env(args)
+    assert "ACCELERATE_FP8_OPT_LEVEL" not in env
+
+
 def test_pp_schedule_wire_protocol(monkeypatch):
     """--pp-schedule / --pp-virtual-stages ride the env wire protocol into the
     Accelerator properties (the launcher half of PipelineParallelPlugin)."""
@@ -376,6 +386,7 @@ def test_interactive_config_deep_tree(tmp_path, monkeypatch):
         "1",        # fp8 margin
         "yes",      # delayed scaling
         "32",       # amax history
+        "1",        # opt level: O2
         "2",        # zero stage 2
         "-1",       # fsdp axis
         "yes",      # cpu offload
@@ -398,6 +409,7 @@ def test_interactive_config_deep_tree(tmp_path, monkeypatch):
     cfg = _interactive_config()
     assert cfg.mixed_precision == "fp8" and cfg.fp8_margin == 1
     assert cfg.fp8_use_delayed_scaling and cfg.fp8_amax_history_len == 32
+    assert cfg.fp8_opt_level == "O2"
     assert cfg.fsdp_zero_stage == 2 and cfg.fsdp_cpu_offload
     assert cfg.fsdp_min_weight_size == 2048
     assert cfg.fsdp_state_dict_type == "FULL_STATE_DICT"

@@ -161,6 +161,54 @@ def test_accelerator_fp8_recipe_handler_override():
     assert acc.fp8_recipe.use_delayed_scaling
 
 
+def test_fp8_opt_level_validation(monkeypatch):
+    monkeypatch.delenv("ACCELERATE_FP8_OPT_LEVEL", raising=False)
+    assert FP8RecipeKwargs().opt_level == "O1"
+    assert FP8RecipeKwargs(opt_level="o2").opt_level == "O2"
+    with pytest.raises(ValueError):
+        FP8RecipeKwargs(opt_level="O3")
+    monkeypatch.setenv("ACCELERATE_FP8_OPT_LEVEL", "O2")
+    assert FP8RecipeKwargs().opt_level == "O2"
+
+
+def test_fp8_opt_level_o2_upgrades_fused_adamw(monkeypatch):
+    """MS-AMP opt_level analog (reference dataclasses.py:1235-1242): O2 turns an
+    unset-dtype FusedAdamW into a scaled-fp8-moment one at prepare() time; explicit
+    user dtypes and non-fused optimizers are left alone (the latter with a warning)."""
+    monkeypatch.delenv("ACCELERATE_FP8_OPT_LEVEL", raising=False)
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.ops.fused_optim import ScaledAdamState, fused_adamw
+
+    acc = Accelerator(
+        mixed_precision="fp8", kwargs_handlers=[FP8RecipeKwargs(opt_level="O2")]
+    )
+    wrapped = acc.prepare_optimizer(fused_adamw(1e-3))
+    assert wrapped.optimizer.mu_dtype == jnp.float8_e4m3fn
+    assert wrapped.optimizer.nu_dtype == jnp.float8_e4m3fn
+    state = wrapped.init({"w": jnp.zeros((8, 1024), jnp.float32)})
+    assert isinstance(state, ScaledAdamState)
+
+    # explicit user dtype wins over the recipe
+    explicit = acc.prepare_optimizer(fused_adamw(1e-3, mu_dtype=jnp.bfloat16))
+    assert explicit.optimizer.mu_dtype == jnp.bfloat16
+    assert explicit.optimizer.nu_dtype is None
+
+    # non-fused optimizers keep fp32 state (warning logged, not raised)
+    plain = acc.prepare_optimizer(optax.adamw(1e-3))
+    state = plain.init({"w": jnp.zeros((8,), jnp.float32)})
+    assert not isinstance(state, ScaledAdamState)
+
+    # re-preparing an already-wrapped optimizer is a no-op (no spurious warning)
+    rewrapped = acc.prepare_optimizer(wrapped)
+    assert rewrapped.optimizer.mu_dtype == jnp.float8_e4m3fn
+
+    # O1 (the default) never rewrites the optimizer
+    acc_o1 = Accelerator(mixed_precision="fp8")
+    assert acc_o1.prepare_optimizer(fused_adamw(1e-3)).optimizer.mu_dtype is None
+
+
 # ---------------------------------------------------------------------- llama end-to-end
 @slow
 def test_llama_fp8_forward_and_training_step():
