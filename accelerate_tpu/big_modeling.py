@@ -162,7 +162,16 @@ class DispatchedParams:
             elif isinstance(value, np.ndarray):
                 value = jax.device_put(value, device)
             elif hasattr(value, "sharding"):  # jax array, possibly on another device
-                value = jax.device_put(value, device)
+                # Already on the target device: return the store's own array UNCHANGED.
+                # device_put can return a fresh wrapper aliasing the same buffer, and
+                # consume_block's owned-leaf protection is by object identity — an alias
+                # would be deleted, killing the resident weight for every later pass.
+                try:
+                    on_target = value.devices() == {device}
+                except Exception:
+                    on_target = False
+                if not on_target:
+                    value = jax.device_put(value, device)
             rel = key[len(prefix) + 1 :] if prefix and key != prefix else ("" if key == prefix else key)
             sub[rel] = value
         if list(sub) == [""]:
@@ -286,7 +295,9 @@ def consume_block(
     prefetch worker keeps fetching while the consumer fences.
 
     ``dispatched``/``prefix``: for DEVICE-RESIDENT placements ``fetch`` returns the
-    store's own array (same-device ``device_put`` is an identity), and deleting it
+    store's own array UNCHANGED — deliberately, not via ``device_put``, which may
+    return a fresh wrapper aliasing the same buffer and so defeat the id()-based
+    ownership check below — and deleting it
     would corrupt the resident weights for every later pass — passing the store lets
     the fence skip any leaf the store itself owns. Streamed (host/disk) leaves are
     always fresh per-fetch copies and safe to free."""

@@ -237,6 +237,25 @@ def test_streamed_forward_matches_plain(tmp_path, mode):
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), rtol=0, atol=0.1)
 
 
+def test_streamed_forward_repeats_with_device_resident_blocks():
+    """Regression: a second streamed pass over a MIXED placement must not hit deleted
+    resident weights. fetch() must return the store's own array for device-resident
+    leaves (a device_put alias would be freed by consume_block's explicit delete,
+    killing the resident block for every later pass — found via the by_feature
+    big_model_inference example, which streams twice)."""
+    params = tiny_params()
+    dm = {"embed": 0, "layers/0": 0, "layers/1": "cpu", "ln_f": 0, "lm_head": 0}
+    dp = dispatch_model(params, dm)
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(0, TINY.vocab_size, size=(2, 16)), dtype=jnp.int32
+    )
+    expected = llama.forward(params, tokens, TINY, shard_activations=False)
+    first = llama.forward_streamed(dp, tokens, TINY)
+    second = llama.forward_streamed(dp, tokens, TINY)  # raised "Array has been deleted"
+    np.testing.assert_allclose(np.asarray(first), np.asarray(expected), rtol=0, atol=0.1)
+    np.testing.assert_array_equal(np.asarray(first), np.asarray(second))
+
+
 def test_dispatch_model_auto_policy(tmp_path):
     params = tiny_params()
     sizes = compute_module_sizes(params)
