@@ -136,6 +136,15 @@ CONFIGS = [
                       "BENCH_OPT": "fused_adamw"}),
     ("r4_combo_inv_fce", {"BENCH_LOSS_CHUNK": "1024", "ACCEL_FLASH_DIMSEM": "0",
                           "BENCH_OPT": "fused_adamw", "BENCH_LOSS_IMPL": "fused"}),
+    # --- round-4 third wave: the two best measured levers — fp8 optimizer state
+    # (0.5584) and fuse8 (0.5105) — were never stacked; and r4_opt_f8_state was only
+    # measured WITH the fused Pallas CE, never with the default chunked-auto CE
+    # (loss_fused alone read 0.5025 vs default 0.507, so the CE choice may be worth
+    # ~1% inside the fp8-state config too). Labeled (state dtype), never adopted.
+    ("r4_f8_state_default_ce", {"BENCH_OPT": "fused_adamw_f8"}),
+    ("r4_f8_state_fuse8", {"BENCH_OPT": "fused_adamw_f8", "BENCH_LOSS_IMPL": "fused",
+                           "BENCH_FUSE": "8"}),
+    ("r4_f8_state_dce_fuse8", {"BENCH_OPT": "fused_adamw_f8", "BENCH_FUSE": "8"}),
 ]
 
 

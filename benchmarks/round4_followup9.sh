@@ -1,0 +1,19 @@
+#!/bin/bash
+# Round-4 window #4 third-wave sweep: stack the two best measured levers.
+# Waits for the chain5 inference rows + scoring run to finish (pid $1), then runs
+# the three new labeled fp8-state combo rows. Each row is rev-2 warmed (~3-6 min
+# on a quiet host) + the uncached remote compile; budget ~45 min total.
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (chain5) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 30; done
+fi
+
+echo "=== round4 followup9 start: $(date -u) ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 \
+  --per-run-timeout 900 \
+  --only r4_f8_state_default_ce,r4_f8_state_fuse8,r4_f8_state_dce_fuse8
+echo "sweep rc=$?"
+echo "=== round4 followup9 done: $(date -u) ==="
