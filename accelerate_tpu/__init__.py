@@ -20,6 +20,7 @@ from .big_modeling import (
     init_on_device,
     load_checkpoint_and_dispatch,
 )
+from .data_loader import skip_first_batches
 from .generation import GenerationConfig, generate_loop, sample_logits
 from .inference import prepare_pippy
 from .launchers import debug_launcher, notebook_launcher
@@ -34,7 +35,14 @@ from .utils import (
     GradientAccumulationPlugin,
     MixedPrecisionPolicy,
     ProjectConfiguration,
+    infer_auto_device_map,
+    is_rich_available,
+    load_checkpoint_in_model,
+    synchronize_rng_states,
 )
+
+if is_rich_available():
+    from .utils import rich  # noqa: F401
 from .parallel import MeshConfig, build_mesh
 
 # Facade import is deliberately lazy-tolerant during early build stages.

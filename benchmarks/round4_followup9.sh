@@ -15,5 +15,7 @@ echo "=== round4 followup9 start: $(date -u) ==="
 python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 \
   --per-run-timeout 900 \
   --only r4_f8_state_default_ce,r4_f8_state_fuse8,r4_f8_state_dce_fuse8
-echo "sweep rc=$?"
+rc=$?
+echo "sweep rc=$rc"
 echo "=== round4 followup9 done: $(date -u) ==="
+exit $rc

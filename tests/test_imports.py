@@ -47,6 +47,31 @@ def test_import_does_not_pull_heavy_deps():
     assert r.returncode == 0, f"heavy modules imported at package import: {r.stderr}"
 
 
+def test_top_level_migration_surface():
+    """Every name a migrating user can import from the reference's package root
+    (``/root/reference/src/accelerate/__init__.py``) has a top-level analog here,
+    modulo the documented non-ports (DeepSpeed/Megatron torch engines ride plugins,
+    ddp_kwargs handlers live in utils). Caught live: ``skip_first_batches`` was
+    importable only from ``accelerate_tpu.data_loader``, not the package root."""
+    import accelerate_tpu as at
+
+    surface = [
+        "Accelerator", "PartialState", "AcceleratorState", "GradientState",
+        "skip_first_batches", "notebook_launcher", "debug_launcher",
+        "cpu_offload", "cpu_offload_with_hook", "disk_offload", "dispatch_model",
+        "init_empty_weights", "init_on_device", "load_checkpoint_and_dispatch",
+        "prepare_pippy", "find_executable_batch_size", "DistributedType",
+        "DataLoaderConfiguration", "FullyShardedDataParallelPlugin",
+        "GradientAccumulationPlugin", "ProjectConfiguration", "get_logger",
+        "LocalSGD", "infer_auto_device_map", "load_checkpoint_in_model",
+        "synchronize_rng_states", "is_rich_available",
+    ]
+    if at.is_rich_available():  # reference exports `rich` conditionally the same way
+        surface.append("rich")
+    missing = [n for n in surface if not hasattr(at, n)]
+    assert not missing, f"top-level names missing from accelerate_tpu: {missing}"
+
+
 @pytest.mark.parametrize("attempts", [3])
 def test_import_time_budget(attempts):
     """``import accelerate_tpu`` adds < 2 s over bare interpreter startup (measured
