@@ -79,7 +79,7 @@ def hbm_limit_gb() -> float:
 
 
 def _numpy_random_init(mod, cfg, dtype):
-    """init_params-shaped pytree filled by numpy's PCG64 instead of jax.random.
+    """init_params-shaped pytree of NUMPY leaves filled by numpy's PCG64.
 
     jax.random on a single host core is the hidden load-time sink at these scales —
     the 2026-08-01 gptj-6b row spent ~700 s of its 785 s load generating threefry
@@ -87,12 +87,22 @@ def _numpy_random_init(mod, cfg, dtype):
     byte). The serving metric (s/token) is invariant to the weight VALUES, only the
     shapes/dtypes matter; keep the same safe magnitudes init_params uses — norm
     'scale'-like leaves = 1, biases = 0, matrices = N(0, 1/sqrt(fan_in)), embeddings
-    = N(0, 0.02) — so random-weight forwards stay finite through deep stacks."""
+    = N(0, 0.02) — so random-weight forwards stay finite through deep stacks.
+
+    The leaves are numpy (ml_dtypes bf16), NOT jax arrays: under the axon platform
+    every ``jnp`` materialization routes through the remote-plugin client, and the
+    2026-08-02 window measured ~3.5x host-RSS amplification + a >6x slowdown vs the
+    identical path on the pure-CPU backend (t0pp-host: 76.5 GB RSS for 22 GB of
+    weights; neox20b: loader still unfinished at 4500 s / ~106 GB RSS on a 125 GB
+    host, vs 749 s / 40.8 GB offline). ``DispatchedParams.from_tree`` stores host
+    placements via ``np.asarray`` (zero-copy for numpy) and ``jax.device_put``
+    accepts numpy bf16 directly, so nothing downstream needs jax-array leaves."""
     import jax
     import jax.numpy as jnp
 
     abstract = jax.eval_shape(lambda: mod.init_params(cfg))
     rng = np.random.default_rng(0)
+    np_out = np.dtype(dtype)  # jnp.bfloat16 -> ml_dtypes.bfloat16
 
     def fill(path, leaf):
         name = "/".join(
@@ -100,18 +110,18 @@ def _numpy_random_init(mod, cfg, dtype):
         ).lower()
         shape, ld = leaf.shape, leaf.dtype
         if not jnp.issubdtype(ld, jnp.floating):
-            return jnp.zeros(shape, ld)
-        out_dtype = dtype
+            return np.zeros(shape, np.dtype(ld))
         if "scale" in name.rsplit("/", 1)[-1]:
-            return jnp.ones(shape, out_dtype)
+            return np.ones(shape, np_out)
         if len(shape) <= 1 or name.rsplit("/", 1)[-1].startswith(("b_", "bias")):
-            return jnp.zeros(shape, out_dtype)
+            return np.zeros(shape, np_out)
         if any(k in name for k in ("embed", "wte", "wpe", "shared", "rel_bias")):
             std = 0.02
         else:
             std = 1.0 / float(np.sqrt(shape[-2] if len(shape) >= 2 else shape[0]))
-        a = rng.standard_normal(size=shape, dtype=np.float32) * std
-        return jnp.asarray(a, dtype=out_dtype)
+        a = rng.standard_normal(size=shape, dtype=np.float32)
+        a *= std
+        return a.astype(np_out, copy=False)
 
     return jax.tree_util.tree_map_with_path(fill, abstract)
 
@@ -202,17 +212,25 @@ def main() -> int:
         # whole tree on the chip (fetch("") = full pytree on the main device).
         params = dispatched.fetch("") if offload == "none" else None
     else:
-        with jax.default_device(jax.devices("cpu")[0]):
-            if args.init == "model":
+        if args.init == "model":
+            with jax.default_device(jax.devices("cpu")[0]):
                 params = jax.tree.map(
                     lambda x: x.astype(dtype) if x.dtype == jnp.float32 else x,
                     mod.init_params(cfg),
                 )
-            else:
-                params = _numpy_random_init(mod, cfg, dtype)
+        else:
+            # numpy leaves on purpose — see _numpy_random_init: any jnp
+            # materialization here routes through the axon remote client.
+            params = _numpy_random_init(mod, cfg, dtype)
         if offload == "none":
+            from accelerate_tpu.big_modeling import _fence_leaf
+
             params = jax.device_put(params, jax.devices()[0])
-            jax.block_until_ready(jax.tree_util.tree_leaves(params)[0])
+            # Fence EVERY leaf: block_until_ready can return early through the
+            # tunneled relay, and an unfenced multi-GB H2D lands inside the first
+            # generate call — load_s must own the transfer, not first_call_s.
+            for leaf in jax.tree_util.tree_leaves(params):
+                _fence_leaf(leaf)
             dispatched = None
         elif offload == "host":
             dispatched = cpu_offload(params)
