@@ -1,0 +1,28 @@
+#!/bin/bash
+# Round-4 window #4, part 3: the two streamed rows that died in the old loader,
+# re-run on the numpy-leaf load path (load now ~12 min offline-measured for neox).
+# Budgets: load ~750 s + prefill + 4 decode passes over the ~0.11 GB/s tunnel
+# (neox 40 GB/pass ≈ 370 s/pass -> ~45 min total; opt 60 GB/pass ≈ 550 s/pass
+# -> ~70 min total + disk write) — keep 4500/7200 s.
+set -u
+cd "$(dirname "$0")/.."
+
+RESULTS=benchmarks/big_model_inference/results.md
+run_row() {
+  name="$1"; marker="$2"; row_timeout="$3"; shift 3
+  if [ -f "$RESULTS" ] && grep -q "$marker" "$RESULTS"; then
+    echo "=== inference row: $name already recorded; skipping ==="
+    return
+  fi
+  echo "=== waiting for TPU ==="
+  python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+  echo "=== inference row: $name (timeout ${row_timeout}s) ==="
+  timeout "$row_timeout" python benchmarks/big_model_inference/inference_tpu.py "$@" --markdown
+  echo "row $name rc=$?"
+}
+
+echo "=== round4 chain6 start: $(date -u) ==="
+run_row neox20b-host '| gpt-neox-20b |' 4500 gpt-neox-20b --dtype bf16 --offload host --new-tokens 4
+run_row opt30b-disk  '| opt-30b |'      7200 opt-30b --dtype bf16 --offload disk --new-tokens 4
+python benchmarks/big_model_inference/collect_results.py || true
+echo "=== round4 chain6 done: $(date -u) ==="
