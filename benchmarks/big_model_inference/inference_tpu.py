@@ -320,8 +320,9 @@ def main() -> int:
             if new:
                 f.write("| Model | dtype | Placement | Load | s/token | HBM | Host RSS |\n")
                 f.write("|---|---|---|---|---|---|---|\n")
+            label = model + ("-kvq" if args.kv_quant else "")
             f.write(
-                f"| {model} | {args.dtype} | {offload} | {row['load_s']}s "
+                f"| {label} | {args.dtype} | {offload} | {row['load_s']}s "
                 f"| {row['s_per_token']}s | {row['hbm_in_use_gb']}GB "
                 f"| {row['host_rss_gb']}GB |\n"
             )

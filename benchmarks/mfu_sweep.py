@@ -145,6 +145,12 @@ CONFIGS = [
     ("r4_f8_state_fuse8", {"BENCH_OPT": "fused_adamw_f8", "BENCH_LOSS_IMPL": "fused",
                            "BENCH_FUSE": "8"}),
     ("r4_f8_state_dce_fuse8", {"BENCH_OPT": "fused_adamw_f8", "BENCH_FUSE": "8"}),
+    # --- round-4 fourth wave: long-context training rows (workload-labeled; the
+    # seq4096_b2 row exists from r2 — these extend the curve to show the flash +
+    # remat-full path holds MFU at long sequence on ONE chip, the single-chip
+    # anchor of the sp/ring long-context story).
+    ("r4_seq8192_b1", {"BENCH_S": "8192", "BENCH_B": "1"}),
+    ("r4_seq16384_b1", {"BENCH_S": "16384", "BENCH_B": "1"}),
 ]
 
 
@@ -201,6 +207,17 @@ def main() -> int:
     p.add_argument("--only", default=None, help="Comma-separated config-name filter.")
     args = p.parse_args()
 
+    names = set(args.only.split(",")) if args.only else None
+    if names:
+        # "__none__" is the documented wait-only sentinel (the session chains use
+        # `--wait-for-tpu --only __none__` as a pure TPU-availability poll). Any
+        # OTHER unknown name is a typo that would otherwise run zero configs and
+        # exit 0 as if it had measured. Checked before any chip probe.
+        unknown = names - {n for n, _ in CONFIGS} - {"__none__"}
+        if unknown:
+            print(f"sweep: unknown --only config(s): {sorted(unknown)}", file=sys.stderr)
+            return 2
+
     if args.wait_for_tpu:
         deadline = time.time() + args.max_wait_hours * 3600
         while not tpu_alive():
@@ -214,7 +231,6 @@ def main() -> int:
         print("sweep: TPU not reachable (use --wait-for-tpu to poll)", file=sys.stderr)
         return 1
 
-    names = set(args.only.split(",")) if args.only else None
     best = None
     for name, env_over in CONFIGS:
         if names and name not in names:

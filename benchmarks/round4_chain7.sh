@@ -1,0 +1,30 @@
+#!/bin/bash
+# Round-4 window #4, part 4 (waits on chain6 pid $1): long-context training rows
+# + the int8-KV-cache gptj row (a decode-bytes lever the reference table lacks).
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (chain6) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 30; done
+fi
+
+echo "=== round4 chain7 start: $(date -u) ==="
+
+echo "=== 1. long-context training rows ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 \
+  --per-run-timeout 900 --only r4_seq8192_b1,r4_seq16384_b1
+echo "sweep rc=$?"
+
+echo "=== 2. gptj-6b int8 KV cache row ==="
+RESULTS=benchmarks/big_model_inference/results.md
+if grep -q "gptj-6b-kvq" "$RESULTS" 2>/dev/null; then
+  echo "=== kvq row already recorded; skipping ==="
+else
+  python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+  timeout 2400 python benchmarks/big_model_inference/inference_tpu.py gptj-6b \
+    --dtype bf16 --offload none --kv-quant --new-tokens 16 --markdown
+  echo "kvq row rc=$?"
+fi
+python benchmarks/big_model_inference/collect_results.py || true
+echo "=== round4 chain7 done: $(date -u) ==="
