@@ -122,6 +122,57 @@ def probe_fused_adamw_bench_shape() -> None:
     np.testing.assert_array_less(np.asarray(new_params["embed"])[0, 0], 1.0)
 
 
+def probe_flash_16k() -> None:
+    """Long-context isolation (2026-08-02): the r4_seq16384_b1 sweep row died at
+    remote-compile (HTTP 500, same class as remat_dots).  This compiles the flash
+    kernel fwd+bwd ALONE at the failing shape (b1 s16384, bench GQA 16q/8kv d128):
+    if it fails here the wall is the kernel at long seq; if it passes, the wall is
+    the composed train-step program."""
+    import jax
+    import jax.numpy as jnp
+
+    from accelerate_tpu.ops.flash_attention import flash_attention
+
+    q = jnp.ones((1, 16384, 16, 128), jnp.bfloat16) * 0.02
+    kv = jnp.ones((1, 16384, 8, 128), jnp.bfloat16) * 0.02
+
+    @jax.jit
+    def fwd_bwd(q, kv):
+        def f(q, kv):
+            return flash_attention(q, kv, kv, causal=True).astype(jnp.float32).sum()
+
+        return jax.grad(f, argnums=(0, 1))(q, kv)
+
+    g = fwd_bwd(q, kv)
+    jax.block_until_ready(g)
+
+
+def probe_xent_16k() -> None:
+    """Companion to probe_flash_16k: the default chunked-auto CE fwd+bwd ALONE at
+    the failing row's token count (16384 tokens, bench d_model 2048 / vocab 32768)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from accelerate_tpu.models.common import chunked_ce
+
+    x = jnp.ones((1, 16384, 2048), jnp.bfloat16) * 0.1
+    w = jnp.ones((2048, 32768), jnp.bfloat16) * 0.01
+    t = jnp.zeros((1, 16384), jnp.int32)
+    m = jnp.ones((1, 16384), jnp.float32)
+
+    @jax.jit
+    def loss_and_grad(x, w):
+        def f(x, w):
+            return chunked_ce(x, w, t, m, 1024, jnp.bfloat16) / m.sum()
+
+        return jax.value_and_grad(f, argnums=(0, 1))(x, w)
+
+    l, _ = loss_and_grad(x, w)
+    jax.block_until_ready(l)
+    assert np.isfinite(float(l))
+
+
 PROBES = {
     "fused_adamw": probe_fused_adamw,
     "fused_adamw_bench_shape": probe_fused_adamw_bench_shape,
@@ -129,10 +180,19 @@ PROBES = {
     "flash": probe_flash,
 }
 
+# Diagnostic one-offs, NOT part of the default window-start health check (they are
+# long-compile shapes, and flash_16k is EXPECTED to fail while the 16k compile-helper
+# wall stands — including them would flip the health verdict red and can blow the
+# callers' outer timeouts). Addressable via --one only.
+DIAG_PROBES = {
+    "flash_16k": probe_flash_16k,
+    "xent_16k": probe_xent_16k,
+}
+
 
 def _run_one_inprocess(name: str) -> int:
     try:
-        PROBES[name]()
+        {**PROBES, **DIAG_PROBES}[name]()
         print(f"kernel_probe {name}: OK", flush=True)
         return 0
     except Exception as e:  # noqa: BLE001 — verdict line must always print

@@ -151,6 +151,10 @@ CONFIGS = [
     # anchor of the sp/ring long-context story).
     ("r4_seq8192_b1", {"BENCH_S": "8192", "BENCH_B": "1"}),
     ("r4_seq16384_b1", {"BENCH_S": "16384", "BENCH_B": "1"}),
+    # 32k: the single-chip edge of the curve (b1, remat-full; flash never
+    # materializes S x T, so HBM holds params/opt-state + layer-boundary
+    # activations only — the shape a v5e-256 sp=16 job sees per chip at 512k).
+    ("r4_seq32768_b1", {"BENCH_S": "32768", "BENCH_B": "1"}),
 ]
 
 

@@ -1,0 +1,42 @@
+#!/bin/bash
+# Round-4 window #5, part 3 (waits on the chain6 wrapper pid $1):
+#   1. seq-32k long-context row (the single-chip edge of the curve)
+#   2. the BASELINE.md north-star nlp_example row (BERT-base MRPC b32 s128) —
+#      never recorded on-chip in any window so far
+#   3. RESULTS.md reassembly + a closing fresh-dated scoring run
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (chain6 wrapper) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 30; done
+fi
+
+echo "=== round4 chain8 start: $(date -u) ==="
+
+echo "=== 0. 16k isolation probes (who crashes the compile helper at long seq?) ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+timeout 450 python benchmarks/kernel_probe.py --one flash_16k
+echo "flash_16k rc=$?"
+timeout 450 python benchmarks/kernel_probe.py --one xent_16k
+echo "xent_16k rc=$?"
+
+echo "=== 1. seq-32k long-context row ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 \
+  --per-run-timeout 1200 --only r4_seq32768_b1
+echo "sweep rc=$?"
+
+echo "=== 2. nlp_example north-star row ==="
+if [ -f nlp_bench_results.jsonl ] && grep -q '"smoke": false' nlp_bench_results.jsonl; then
+  echo "=== nlp row already recorded; skipping ==="
+else
+  python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+  timeout 1200 python benchmarks/nlp_bench.py
+  echo "nlp rc=$?"
+fi
+
+echo "=== 3. collect + closing scoring run ==="
+python benchmarks/big_model_inference/collect_results.py || true
+timeout 1200 python bench.py
+echo "bench rc=$?"
+echo "=== round4 chain8 done: $(date -u) ==="
