@@ -154,17 +154,21 @@ def probe_xent_16k() -> None:
     import jax.numpy as jnp
     import numpy as np
 
-    from accelerate_tpu.models.common import chunked_ce
+    from accelerate_tpu.models.common import chunked_ce, resolve_loss_chunk
 
     x = jnp.ones((1, 16384, 2048), jnp.bfloat16) * 0.1
     w = jnp.ones((2048, 32768), jnp.bfloat16) * 0.01
     t = jnp.zeros((1, 16384), jnp.int32)
     m = jnp.ones((1, 16384), jnp.float32)
+    # The EXACT chunk the failing row's auto mode resolves (512 at S=16384 V=32768) —
+    # a different chunk would compile a different program than the one that 500'd.
+    chunk = resolve_loss_chunk(0, 16384, 32768)
+    assert chunk == 512, chunk
 
     @jax.jit
     def loss_and_grad(x, w):
         def f(x, w):
-            return chunked_ce(x, w, t, m, 1024, jnp.bfloat16) / m.sum()
+            return chunked_ce(x, w, t, m, chunk, jnp.bfloat16) / m.sum()
 
         return jax.value_and_grad(f, argnums=(0, 1))(x, w)
 

@@ -155,6 +155,10 @@ CONFIGS = [
     # materializes S x T, so HBM holds params/opt-state + layer-boundary
     # activations only — the shape a v5e-256 sp=16 job sees per chip at 512k).
     ("r4_seq32768_b1", {"BENCH_S": "32768", "BENCH_B": "1"}),
+    # 16k retry with fused_steps=1: the plain 16k row dies at the compile helper
+    # (HTTP 500); if the wall is compile-side resource exhaustion, the smallest
+    # program variant is the likeliest to clear it.
+    ("r4_seq16384_b1_f1", {"BENCH_S": "16384", "BENCH_B": "1", "BENCH_FUSE": "1"}),
 ]
 
 

@@ -40,6 +40,14 @@ def main() -> int:
 
     from nlp_example import SyntheticMRPC  # the example's own dataset fallback
 
+    if not smoke and jax.default_backend() == "cpu":
+        # Same guard as mfu_sweep.tpu_alive: a dead tunnel silently falls back to the
+        # CPU backend, and a CPU row with "smoke": false would anchor the skip guards
+        # in the window chains forever. Refuse to record it.
+        print("nlp_bench: refusing non-smoke run on the cpu backend (tunnel down?)",
+              file=sys.stderr, flush=True)
+        return 2
+
     B = int(os.environ.get("BENCH_NLP_B", "4" if smoke else "32"))
     seq = int(os.environ.get("BENCH_NLP_SEQ", "32" if smoke else "128"))
     n_steps = 3 if smoke else 30

@@ -1,0 +1,26 @@
+#!/bin/bash
+# Round-4 window #5, part 5 (waits on chain9 pid $1): kvq retry at a real budget.
+# The 2400 s first attempt hit rc=124: gptj load (~250 s) + prefill/decode compile
+# over the remote-compile transport (no local cache persists) + two timed runs did
+# not fit. int8-KV decode is pure XLA (models/common.py write_kv/read_kv), so the
+# Pallas compile-hang class is not in play — give it 3600 s.
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (chain9) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 30; done
+fi
+
+echo "=== round4 chain10 start: $(date -u) ==="
+RESULTS=benchmarks/big_model_inference/results.md
+if grep -q "gptj-6b-kvq" "$RESULTS" 2>/dev/null; then
+  echo "=== kvq row already recorded; skipping ==="
+else
+  python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+  timeout 3600 python benchmarks/big_model_inference/inference_tpu.py gptj-6b \
+    --dtype bf16 --offload none --kv-quant --new-tokens 16 --markdown
+  echo "kvq row rc=$?"
+fi
+python benchmarks/big_model_inference/collect_results.py || true
+echo "=== round4 chain10 done: $(date -u) ==="

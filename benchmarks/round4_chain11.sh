@@ -1,0 +1,25 @@
+#!/bin/bash
+# Round-4 window #5, tail (waits on chain10 pid $1): opt30b-disk LAST.
+# The row is transport-bound (~60 GB/pass over the ~0.11 GB/s tunnel, caveat
+# documented in RESULTS.md) — it goes at the end of the queue so a window drop
+# can only cost the least-informative row, not the north-star ones.
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (chain10) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 30; done
+fi
+
+echo "=== round4 chain11 start: $(date -u) ==="
+RESULTS=benchmarks/big_model_inference/results.md
+if grep -q "| opt-30b |" "$RESULTS" 2>/dev/null; then
+  echo "=== opt30b row already recorded; skipping ==="
+else
+  python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+  timeout 7200 python benchmarks/big_model_inference/inference_tpu.py opt-30b \
+    --dtype bf16 --offload disk --new-tokens 4 --markdown
+  echo "opt30b row rc=$?"
+fi
+python benchmarks/big_model_inference/collect_results.py || true
+echo "=== round4 chain11 done: $(date -u) ==="

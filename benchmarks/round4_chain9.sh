@@ -1,0 +1,29 @@
+#!/bin/bash
+# Round-4 window #5, part 4 (waits on chain8 pid $1):
+#   1. seq-16k fuse1 retry (smallest program variant vs the compile-helper 500)
+#   2. speculative-decoding cycle-cost row (gptj-6b target + gpt2 draft) —
+#      mechanism cost + break-even acceptance; the reference has no such path.
+set -u
+cd "$(dirname "$0")/.."
+
+if [ -n "${1:-}" ]; then
+  echo "=== waiting for pid $1 (chain8) to exit ==="
+  while kill -0 "$1" 2>/dev/null; do sleep 30; done
+fi
+
+echo "=== round4 chain9 start: $(date -u) ==="
+
+echo "=== 1. seq-16k fuse1 retry ==="
+python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 \
+  --per-run-timeout 1200 --only r4_seq16384_b1_f1
+echo "sweep rc=$?"
+
+echo "=== 2. speculative cycle-cost row ==="
+if [ -f benchmarks/big_model_inference/speculative_results.jsonl ]; then
+  echo "=== speculative row already recorded; skipping ==="
+else
+  python benchmarks/mfu_sweep.py --wait-for-tpu --poll-interval 60 --per-run-timeout 1 --only __none__ || true
+  timeout 2500 python benchmarks/big_model_inference/speculative_tpu.py
+  echo "spec rc=$?"
+fi
+echo "=== round4 chain9 done: $(date -u) ==="
