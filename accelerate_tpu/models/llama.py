@@ -1555,6 +1555,66 @@ def _spec_forward_jit(params, tokens, cache, cfg):
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache
 
 
+@partial(jax.jit, static_argnames=("t_cfg", "d_cfg", "k"), donate_argnums=(2, 3))
+def _spec_round_greedy_jit(t_params, d_params, t_cache, d_cache, pending, *, t_cfg, d_cfg, k):
+    """ONE fused greedy speculative round: k-1 draft steps (``lax.scan``), the T=k
+    target verify, prefix acceptance, both cache rewinds, and the full-acceptance
+    draft catch-up — a single compiled program per round.
+
+    The unfused loop costs ~k+3 host->device dispatches per round, each a round-trip
+    (ruinous through a network-attached device, and measurable even host-attached:
+    the CPU smoke of ``benchmarks/big_model_inference/speculative_tpu.py`` put
+    per-round host overhead at ~50x the tiny-model step cost). Fused, the Python
+    loop makes ONE dispatch and ONE result read per round. Control flow lives
+    on-device: acceptance length ``n`` = leading-match count via ``cumprod``; the
+    draft catch-up runs under ``lax.cond``. Token-for-token identical to the
+    unfused greedy path (same argmax/accept math; parity-tested).
+
+    Returns ``(emitted[k], count, t_cache, d_cache)``: ``emitted[:count]`` =
+    accepted drafts + the target's correction (the new pending token is
+    ``emitted[count-1]``, sliced on-device by the caller's next round)."""
+    fam_t, fam_d = _cached_family(t_cfg), _cached_family(d_cfg)
+    base_t = t_cache["index"]            # emitted length - 1 (pending unwritten)
+    base_d = d_cache["index"]
+
+    def draft_step(carry, _):
+        tok, cache = carry
+        logits, cache = fam_d.forward_cached(d_params, tok[None, None], cache, d_cfg)
+        nxt = jnp.argmax(logits[0, -1]).astype(jnp.int32)
+        return (nxt, cache), nxt
+
+    pending = jnp.asarray(pending, jnp.int32)
+    (_, d_cache), drafts = jax.lax.scan(draft_step, (pending, d_cache), None, length=k - 1)
+
+    seq = jnp.concatenate([pending[None], drafts])[None]          # [1, k]
+    logits, t_cache = fam_t.forward_cached(t_params, seq, t_cache, t_cfg)
+    ys = jnp.argmax(logits[0], axis=-1).astype(jnp.int32)         # [k]
+    matches = (drafts == ys[: k - 1]).astype(jnp.int32)
+    n = jnp.sum(jnp.cumprod(matches))                             # leading agreements
+    correction = ys[n]
+    emitted = jnp.where(
+        jnp.arange(k) < n, jnp.concatenate([drafts, jnp.zeros((1,), jnp.int32)]), 0
+    )
+    emitted = emitted.at[n].set(correction)
+    t_cache = _cache_rewind(t_cache, base_t + 1 + n)
+
+    def full_acceptance(cache):
+        # The draft never processed its own last proposal (it wrote pending +
+        # drafts[:-1]); one catch-up step so the next round's cache has no hole.
+        cache = _cache_rewind(cache, base_d + n)
+        _, cache = fam_d.forward_cached(d_params, drafts[-1][None, None], cache, d_cfg)
+        return cache
+
+    d_cache = jax.lax.cond(
+        n == k - 1, full_acceptance, lambda c: _cache_rewind(c, base_d + 1 + n), d_cache
+    )
+    # Pack emitted+count into one vector: the caller reads the round result in a
+    # single device->host transfer; ``correction`` feeds the next round's pending
+    # as a device scalar (never synced).
+    packed = jnp.concatenate([emitted, (n + 1)[None]])
+    return packed, correction, t_cache, d_cache
+
+
 @partial(jax.jit, static_argnames=("cfg", "top_k", "apply_top_p"), donate_argnums=(2,))
 def _spec_probs_jit(params, tokens, cache, cfg, temperature, top_p, top_k, apply_top_p):
     """forward_cached + the SAME temperature/top-k/top-p filtering ``generate`` samples
@@ -1667,28 +1727,43 @@ def generate_speculative(
     if eos_token_id is not None and pending == eos_token_id:
         return finish()
 
+    pending_dev = jnp.asarray(pending, jnp.int32)  # greedy path: device-resident pending
     while len(out) < max_new_tokens:
         rounds += 1
+        if not sampled:
+            # Greedy: the WHOLE round is one fused program (_spec_round_greedy_jit —
+            # draft scan + T=k verify + acceptance + rewinds + catch-up); the loop
+            # makes one dispatch and one packed result read per round.
+            packed, pending_dev, t_cache, d_cache = _spec_round_greedy_jit(
+                target_params, draft_params, t_cache, d_cache, pending_dev,
+                t_cfg=target_cfg, d_cfg=draft_cfg, k=k,
+            )
+            arr = np.asarray(packed)  # [k+1]: emitted slots + count
+            for tok in arr[: int(arr[k])].tolist():
+                out.append(int(tok))
+                if len(out) >= max_new_tokens or (
+                    eos_token_id is not None and tok == eos_token_id
+                ):
+                    return finish()
+            continue
+        # ---- lossless speculative sampling: host-side sequential accept (each accept
+        # consumes an rng key and can end the round, so this path keeps the unfused
+        # per-step dispatches; fusing it needs the accept chain as a lax.scan over
+        # carried keys — future work, the greedy path above shows the shape).
         # 1. draft k-1 proposals; the draft's first input is the pending token itself.
         drafts: list[int] = []
-        q_rows = []  # sampled mode: the draft's filtered distribution per proposal
+        q_rows = []  # the draft's filtered distribution per proposal
         tok = pending
         for _ in range(k - 1):
-            if sampled:
-                qp, d_cache = _spec_probs_jit(
-                    draft_params, jnp.asarray([[tok]], jnp.int32), d_cache,
-                    cfg=draft_cfg, temperature=gen.temperature, top_p=gen.top_p,
-                    top_k=gen.top_k, apply_top_p=gen.top_p < 1.0,
-                )
-                q_rows.append(qp[0, -1])
-                tok = int(np.asarray(jax.random.categorical(
-                    next_key(), jnp.log(jnp.maximum(qp[0, -1], 1e-30))
-                )))
-            else:
-                nxt, d_cache = _spec_forward_jit(
-                    draft_params, jnp.asarray([[tok]], jnp.int32), d_cache, cfg=draft_cfg
-                )
-                tok = int(np.asarray(nxt[0, -1]))
+            qp, d_cache = _spec_probs_jit(
+                draft_params, jnp.asarray([[tok]], jnp.int32), d_cache,
+                cfg=draft_cfg, temperature=gen.temperature, top_p=gen.top_p,
+                top_k=gen.top_k, apply_top_p=gen.top_p < 1.0,
+            )
+            q_rows.append(qp[0, -1])
+            tok = int(np.asarray(jax.random.categorical(
+                next_key(), jnp.log(jnp.maximum(qp[0, -1], 1e-30))
+            )))
             drafts.append(tok)
         base_t = int(np.asarray(t_cache["index"]))      # emitted length - 1 (pending unwritten)
         base_d = int(np.asarray(d_cache["index"])) - (k - 1)  # draft wrote pending + drafts[:-1]
@@ -1696,39 +1771,27 @@ def generate_speculative(
         # output is the target's prediction after input i — it checks drafts[i] for
         # i < k-1, and position k-1 (after the last proposal) backs the bonus token on
         # full acceptance.
-        if sampled:
-            pp, t_cache = _spec_probs_jit(
-                target_params, jnp.asarray([[pending, *drafts]], jnp.int32), t_cache,
-                cfg=target_cfg, temperature=gen.temperature, top_p=gen.top_p,
-                top_k=gen.top_k, apply_top_p=gen.top_p < 1.0,
+        pp, t_cache = _spec_probs_jit(
+            target_params, jnp.asarray([[pending, *drafts]], jnp.int32), t_cache,
+            cfg=target_cfg, temperature=gen.temperature, top_p=gen.top_p,
+            top_k=gen.top_k, apply_top_p=gen.top_p < 1.0,
+        )
+        # 3. stochastic prefix acceptance: accept proposal n w.p. min(1, p/q);
+        # first rejection re-draws from the residual and ends the round.
+        n = 0
+        correction = None
+        while n < k - 1:
+            acc, token = speculative_accept(
+                pp[0, n], q_rows[n], drafts[n], next_key()
             )
-            # 3. stochastic prefix acceptance: accept proposal n w.p. min(1, p/q);
-            # first rejection re-draws from the residual and ends the round.
-            n = 0
-            correction = None
-            while n < k - 1:
-                acc, token = speculative_accept(
-                    pp[0, n], q_rows[n], drafts[n], next_key()
-                )
-                if not bool(np.asarray(acc)):
-                    correction = int(np.asarray(token))
-                    break
-                n += 1
-            if correction is None:  # full acceptance: bonus token from the target's own row
-                correction = int(np.asarray(jax.random.categorical(
-                    next_key(), jnp.log(jnp.maximum(pp[0, k - 1], 1e-30))
-                )))
-        else:
-            ys, t_cache = _spec_forward_jit(
-                target_params, jnp.asarray([[pending, *drafts]], jnp.int32), t_cache,
-                cfg=target_cfg,
-            )
-            ys = np.asarray(ys[0]).tolist()
-            # 3. accept the longest prefix of proposals agreeing with the target.
-            n = 0
-            while n < k - 1 and drafts[n] == ys[n]:
-                n += 1
-            correction = ys[n]
+            if not bool(np.asarray(acc)):
+                correction = int(np.asarray(token))
+                break
+            n += 1
+        if correction is None:  # full acceptance: bonus token from the target's own row
+            correction = int(np.asarray(jax.random.categorical(
+                next_key(), jnp.log(jnp.maximum(pp[0, k - 1], 1e-30))
+            )))
         emitted = drafts[:n] + [correction]  # correction becomes the new pending token
         # 4. rewind to written-emitted length: target wrote pending+accepted (base_t+1+n);
         # draft wrote the same prefix (its extra proposal writes are invalidated).
