@@ -326,11 +326,18 @@ def run(B: int, S: int, fuse: int, preset: str | None, default_metric: str | Non
 
     rng = np.random.default_rng(0)
     stacked = {"tokens": rng.integers(0, cfg.vocab_size, size=(fuse, B, S + 1)).astype(np.int32)}
+    # fused_steps=1 builds the NON-fused _TrainStep, whose contract is a single
+    # {'tokens': [B, S+1]} batch (no leading dispatch dim) and a scalar loss.
+    if fuse == 1:
+        stacked = {k: v[0] for k, v in stacked.items()}
+
+    def _force_loss(metrics):
+        return float(np.asarray(metrics["loss"]).reshape(-1)[-1])
 
     # Warmup / compile.  No in-place retry here: the step donates its input state, so a
     # half-executed dispatch cannot be replayed — transient failures restart run() from main().
     state, metrics = step(state, stacked)
-    _ = float(np.asarray(metrics["loss"])[-1])
+    _ = _force_loss(metrics)
 
     # Warm until steady (2026-08-01 discovery): the first 1-2 post-compile apply rounds
     # pay a large one-time allocator/settling cost — at 0.9B-param AdamW the first timed
@@ -345,7 +352,7 @@ def run(B: int, S: int, fuse: int, preset: str | None, default_metric: str | Non
     for _ in range(settle_rounds):
         t0 = time.perf_counter()
         state, metrics = step(state, stacked)
-        _ = float(np.asarray(metrics["loss"])[-1])
+        _ = _force_loss(metrics)
         dt_round = time.perf_counter() - t0
         settled = prev is not None and abs(dt_round - prev) <= 0.1 * max(dt_round, prev)
         prev = dt_round
@@ -371,7 +378,7 @@ def run(B: int, S: int, fuse: int, preset: str | None, default_metric: str | Non
         if tracing:
             try:
                 state, metrics = step(state, stacked)
-                _ = float(np.asarray(metrics["loss"])[-1])
+                _ = _force_loss(metrics)
             finally:
                 try:
                     jax.profiler.stop_trace()
@@ -381,7 +388,7 @@ def run(B: int, S: int, fuse: int, preset: str | None, default_metric: str | Non
     t0 = time.perf_counter()
     for _ in range(n_rounds):
         state, metrics = step(state, stacked)
-    _ = float(np.asarray(metrics["loss"])[-1])  # forces the full chain through the tunnel
+    _ = _force_loss(metrics)  # forces the full chain through the tunnel
     dt = time.perf_counter() - t0
 
     n_steps = n_rounds * fuse
