@@ -9,6 +9,7 @@ the whole timed loop. Keep that rule here, in exactly one place.
 from __future__ import annotations
 
 import os
+import sys
 import time
 
 
@@ -35,6 +36,22 @@ def force_cpu_for_smoke() -> bool:
 
         jax.config.update("jax_platforms", "cpu")
     return smoke
+
+
+def refuse_non_smoke_cpu(tool: str, smoke: bool) -> bool:
+    """True → caller must bail (rc 2) BEFORE writing any results row.
+
+    A dead TPU tunnel makes JAX fall back to the CPU backend silently; a non-smoke
+    row recorded from such a run would permanently anchor the window chains' skip
+    guards and the real TPU row would never be measured (ADVICE r4, medium). Shared
+    so every row-writing bench script gets the guard by default."""
+    import jax
+
+    if smoke or jax.default_backend() != "cpu":
+        return False
+    print(f"{tool}: refusing non-smoke run on the cpu backend (TPU tunnel down?) — "
+          "no row written", file=sys.stderr, flush=True)
+    return True
 
 
 def materialize(out):

@@ -57,14 +57,30 @@ def main() -> int:
     from accelerate_tpu.big_modeling import _fence_leaf
     from accelerate_tpu.models import gpt
 
+    from bench_timing import refuse_non_smoke_cpu
+
+    if refuse_non_smoke_cpu("speculative_tpu", smoke):
+        return 2
+
     target_name = "tiny" if smoke else "gptj-6b"
     t_cfg = dataclasses.replace(gpt.CONFIGS[target_name], dtype=jnp.bfloat16, attn_impl="xla")
     # Draft: gpt2-124M-shaped, vocab forced to the target's (speculative_accept needs one
     # token space; a real deployment pads gpt2's 50257 head to gpt-j's 50400 the same way).
-    d_cfg = dataclasses.replace(
-        gpt.CONFIGS["tiny" if smoke else "gpt2"],
-        dtype=jnp.bfloat16, attn_impl="xla", vocab_size=t_cfg.vocab_size,
-    )
+    # Smoke uses a STRUCTURALLY different draft (half-depth tiny): identical target/draft
+    # params would measure accept=1.0 and exercise only the full-acceptance branch.
+    if smoke:
+        draft_name = "tiny-half"
+        d_base = gpt.CONFIGS["tiny"]
+        d_cfg = dataclasses.replace(
+            d_base, dtype=jnp.bfloat16, attn_impl="xla", vocab_size=t_cfg.vocab_size,
+            n_layers=max(1, d_base.n_layers // 2),
+        )
+    else:
+        draft_name = "gpt2"
+        d_cfg = dataclasses.replace(
+            gpt.CONFIGS["gpt2"],
+            dtype=jnp.bfloat16, attn_impl="xla", vocab_size=t_cfg.vocab_size,
+        )
 
     t0 = time.perf_counter()
     dev = jax.devices()[0]
@@ -105,11 +121,15 @@ def main() -> int:
     tokens = int(stats["tokens"])
     rounds = max(int(stats["rounds"]), 1)
     round_s = spec_s / rounds  # prefill amortized into the round cost (noted in docs)
-    accept = max((tokens / rounds - 1.0) / (k - 1), 0.0)
+    # ADVICE r4: stats["tokens"] includes the prefill-emitted first token, which is not
+    # a round-accepted proposal — count round-emitted tokens (tokens - 1) or accept is
+    # inflated by ~1/(rounds*(k-1)).
+    accept = max(((tokens - 1) / rounds - 1.0) / (k - 1), 0.0)
     breakeven = (round_s / plain_s_per_token - 1.0) / (k - 1)
 
     row = {
-        "metric": f"speculative_cycle ({target_name} target + gpt2 draft, k={k}, greedy)",
+        "metric": f"speculative_cycle ({target_name} target + {draft_name} draft, "
+                  f"k={k}, greedy)",
         "plain_s_per_token": round(plain_s_per_token, 4),
         "round_s": round(round_s, 4),
         "spec_s_per_token_at_measured_accept": round(spec_s / max(tokens, 1), 4),

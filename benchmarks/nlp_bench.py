@@ -40,12 +40,9 @@ def main() -> int:
 
     from nlp_example import SyntheticMRPC  # the example's own dataset fallback
 
-    if not smoke and jax.default_backend() == "cpu":
-        # Same guard as mfu_sweep.tpu_alive: a dead tunnel silently falls back to the
-        # CPU backend, and a CPU row with "smoke": false would anchor the skip guards
-        # in the window chains forever. Refuse to record it.
-        print("nlp_bench: refusing non-smoke run on the cpu backend (tunnel down?)",
-              file=sys.stderr, flush=True)
+    from bench_timing import refuse_non_smoke_cpu
+
+    if refuse_non_smoke_cpu("nlp_bench", smoke):
         return 2
 
     B = int(os.environ.get("BENCH_NLP_B", "4" if smoke else "32"))
