@@ -255,7 +255,14 @@ def main() -> int:
             row["value"] = None
             row["vs_baseline"] = None
             row.pop("cached", None)
-            row.pop("recorded_at", None)
+            row.pop("recorded_at", None)  # the BASELINE record's old stamp, not ours
+        # Ledger key (VERDICT r4 item 7): every row carries its own UTC timestamp so
+        # the committed append-only ledger is self-describing — adoption ages rows
+        # individually, and BENCH_*.json snapshots trace back to a ledger row. Stamped
+        # AFTER the cached cleanup so that pop can never strip the sweep's own stamp.
+        import datetime
+
+        row["recorded_at"] = datetime.datetime.now(datetime.timezone.utc).isoformat()
         with open(args.out, "a") as f:
             f.write(json.dumps(row) + "\n")
         mfu = row.get("value")
