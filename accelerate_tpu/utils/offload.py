@@ -165,10 +165,15 @@ class OffloadedWeightsLoader(Mapping):
             raise KeyError(key)
         info = self.index[key]
         if "safetensors_file" in info:  # weight lives inside a safetensors shard
-            from safetensors import safe_open
+            from .modeling import iter_safetensors
 
-            with safe_open(info["safetensors_file"], framework="np") as f:
-                return f.get_tensor(info.get("weight_name", key))
+            want = info.get("weight_name", key)
+            # device_map=[want] filters at the header level: only the wanted tensor's
+            # view is ever constructed, however many tensors share the shard.
+            for name, view in iter_safetensors(info["safetensors_file"], device_map=[want]):
+                if name == want:
+                    return view
+            raise KeyError(f"{want!r} not in {info['safetensors_file']}")
         weight_file = os.path.join(str(self.save_folder), f"{_safe_name(key)}.dat")
         return load_offloaded_weight(weight_file, info)
 

