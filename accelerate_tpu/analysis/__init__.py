@@ -1,0 +1,31 @@
+"""graftlint — AST-based JAX/TPU correctness & performance linter.
+
+A standalone static-analysis pass over the package source: pure stdlib ``ast``,
+no runtime import of the analyzed modules, so it runs without a TPU in well under
+five seconds. Every rule descends from a bug class this repo has actually hit —
+see ``docs/graftlint.md`` for the incident catalog.
+
+The modules in this package import nothing outside the stdlib. Entry points:
+
+- ``python graftlint.py`` (repo root) — fully standalone, works with no jax
+  installed: loads this package under a stub parent so ``accelerate_tpu/__init__``
+  (and its jax import) never runs
+- ``python -m accelerate_tpu lint [--check] [--baseline]`` (CLI, via ``commands/lint.py``)
+  and ``python -m accelerate_tpu.analysis`` — convenience entries; importing any
+  ``accelerate_tpu.*`` module executes the package root, which imports jax (CPU)
+- ``from accelerate_tpu.analysis import run_lint`` (library use; tests)
+"""
+
+from .engine import Finding, FileUnit, Rule, collect_units, run_lint
+from .baseline import apply_baseline, load_baseline, write_baseline
+
+__all__ = [
+    "Finding",
+    "FileUnit",
+    "Rule",
+    "collect_units",
+    "run_lint",
+    "apply_baseline",
+    "load_baseline",
+    "write_baseline",
+]

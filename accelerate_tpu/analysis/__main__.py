@@ -1,0 +1,10 @@
+"""``python -m accelerate_tpu.analysis`` — the graftlint CLI.
+
+Note: like any ``accelerate_tpu.*`` import, this executes the package root's
+``__init__`` (which imports jax on the CPU backend). For the genuinely
+dependency-free entry — no jax installed at all — use ``python graftlint.py``
+at the repo root, which loads this package under a stub parent instead."""
+
+from .cli import main
+
+raise SystemExit(main())

@@ -1,0 +1,176 @@
+"""Shared AST helpers for graftlint rules (stdlib-only, no jax import)."""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Sequence
+
+#: Spellings under which ``jax.jit`` appears in this codebase.
+JIT_NAMES = frozenset({"jax.jit", "jit", "jax.pjit", "pjit"})
+PARTIAL_NAMES = frozenset({"partial", "functools.partial"})
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """``jax.random.PRNGKey`` for a Name/Attribute chain, else None.
+
+    Calls and subscripts in the chain break it (``a().b`` is not a static name).
+    """
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def const_str_seq(node: Optional[ast.AST]) -> list:
+    """String constants from ``"x"``, ``("x", "y")`` or ``["x", "y"]`` (best effort)."""
+    if node is None:
+        return []
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, str)
+        ]
+    return []
+
+
+def const_int_seq(node: Optional[ast.AST]) -> list:
+    """Int constants from ``0``, ``(0, 2)`` or ``[0, 2]`` (best effort)."""
+    if node is None:
+        return []
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [
+            e.value
+            for e in node.elts
+            if isinstance(e, ast.Constant) and isinstance(e.value, int)
+        ]
+    return []
+
+
+def jit_wrap_info(call: ast.Call) -> Optional[dict]:
+    """If ``call`` is ``jax.jit(fn, **kw)``, return ``{"fn": node, "kwargs": {...}}``.
+
+    Returns None for anything else. Used for ``step = jax.jit(step_fn, donate_argnums=(0,))``
+    assignment sites.
+    """
+    if dotted(call.func) not in JIT_NAMES:
+        return None
+    kwargs = {kw.arg: kw.value for kw in call.keywords if kw.arg}
+    fn = call.args[0] if call.args else None
+    return {"fn": fn, "kwargs": kwargs}
+
+
+def decorator_jit_kwargs(dec: ast.AST) -> Optional[dict]:
+    """Jit keyword nodes if ``dec`` marks the function as jitted, else None.
+
+    Recognizes ``@jax.jit``, ``@jax.jit(...)`` and ``@partial(jax.jit, ...)``
+    (the dominant spelling in this package).
+    """
+    if dotted(dec) in JIT_NAMES:
+        return {}
+    if isinstance(dec, ast.Call):
+        if dotted(dec.func) in JIT_NAMES:
+            return {kw.arg: kw.value for kw in dec.keywords if kw.arg}
+        if dotted(dec.func) in PARTIAL_NAMES and dec.args and dotted(dec.args[0]) in JIT_NAMES:
+            return {kw.arg: kw.value for kw in dec.keywords if kw.arg}
+    return None
+
+
+def func_param_names(fn: ast.AST) -> list:
+    """Positional parameter names of a FunctionDef (posonly + args)."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return []
+    a = fn.args
+    return [p.arg for p in list(a.posonlyargs) + list(a.args)]
+
+
+def func_all_param_names(fn: ast.AST) -> list:
+    """Every named parameter, keyword-only included (for static_argnames membership)."""
+    if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return []
+    return func_param_names(fn) + [p.arg for p in fn.args.kwonlyargs]
+
+
+def assigned_names(stmt: ast.stmt) -> set:
+    """All plain names a statement (re)binds: assignment targets, for-targets, withitems."""
+    out = set()
+
+    def _targets(t):
+        if isinstance(t, ast.Name):
+            out.add(t.id)
+        elif isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                _targets(e)
+        elif isinstance(t, ast.Starred):
+            _targets(t.value)
+
+    if isinstance(stmt, ast.Assign):
+        for t in stmt.targets:
+            _targets(t)
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        _targets(stmt.target)
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        _targets(stmt.target)
+    elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+        for item in stmt.items:
+            if item.optional_vars is not None:
+                _targets(item.optional_vars)
+    elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        out.add(stmt.name)
+    return out
+
+
+def walk_in_order(node: ast.AST):
+    """``ast.walk`` but depth-first in source order (walk() is breadth-first)."""
+    yield node
+    for child in ast.iter_child_nodes(node):
+        yield from walk_in_order(child)
+
+
+def parent_map(tree: ast.AST) -> dict:
+    """node -> parent for every node in the tree."""
+    parents = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def enclosing(node: ast.AST, parents: dict, kinds) -> Optional[ast.AST]:
+    """Nearest ancestor of one of ``kinds`` (a type or tuple of types)."""
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, kinds):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def is_dataclass_def(cls: ast.ClassDef) -> bool:
+    """True for ``@dataclass`` / ``@dataclasses.dataclass`` / ``@dataclass(...)``."""
+    for dec in cls.decorator_list:
+        name = dotted(dec.func) if isinstance(dec, ast.Call) else dotted(dec)
+        if name in ("dataclass", "dataclasses.dataclass"):
+            return True
+    return False
+
+
+def dataclass_fields(cls: ast.ClassDef) -> list:
+    """(name, AnnAssign) for every field of a dataclass body (ClassVars excluded)."""
+    fields = []
+    for stmt in cls.body:
+        if not isinstance(stmt, ast.AnnAssign) or not isinstance(stmt.target, ast.Name):
+            continue
+        ann = ast.dump(stmt.annotation)
+        if "ClassVar" in ann:
+            continue
+        fields.append((stmt.target.id, stmt))
+    return fields

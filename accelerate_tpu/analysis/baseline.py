@@ -1,0 +1,82 @@
+"""Ratcheting baseline: grandfather existing findings, fail any new one.
+
+``graftlint_baseline.json`` (repo root, committed) records the findings that existed
+when a rule landed. ``lint --check`` fails only on findings *not* in the baseline, so a
+new rule can ship without a repo-wide cleanup — and the baseline can only shrink:
+``lint --baseline`` rewrites it from the current findings, and a stale entry (code that
+was fixed or deleted) is reported so it gets dropped rather than silently hoarded.
+
+Keys are ``(rule, path, stripped-source-line)`` with a count — line *numbers* are
+deliberately absent so unrelated edits don't churn the file (see ``Finding.key``).
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import os
+from typing import Dict, List, Sequence, Tuple
+
+from .engine import REPO_ROOT, Finding
+
+BASELINE_FILE = os.path.join(REPO_ROOT, "graftlint_baseline.json")
+
+
+def load_baseline(path: str = BASELINE_FILE) -> Dict[tuple, int]:
+    """key -> grandfathered count. Missing file means an empty baseline."""
+    if not os.path.isfile(path):
+        return {}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    out: Dict[tuple, int] = {}
+    for row in data.get("findings", []):
+        key = (row["rule"], row["path"], row["code"])
+        out[key] = out.get(key, 0) + int(row.get("count", 1))
+    return out
+
+
+def write_baseline(findings: Sequence[Finding], path: str = BASELINE_FILE) -> int:
+    """Rewrite the baseline from current findings; returns the entry count."""
+    counts = collections.Counter(f.key() for f in findings)
+    rows = [
+        {"rule": rule, "path": p, "code": code, "count": n}
+        for (rule, p, code), n in sorted(counts.items())
+    ]
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(
+            {
+                "version": 1,
+                "tool": "graftlint",
+                "note": "Grandfathered findings. This file only shrinks: fix or suppress "
+                "(with a reason) instead of adding entries; regenerate with "
+                "`python -m accelerate_tpu lint --baseline`.",
+                "findings": rows,
+            },
+            f,
+            indent=1,
+            sort_keys=False,
+        )
+        f.write("\n")
+    return len(rows)
+
+
+def apply_baseline(
+    findings: Sequence[Finding], baseline: Dict[tuple, int]
+) -> Tuple[List[Finding], int, List[tuple]]:
+    """Split current findings against the baseline.
+
+    Returns ``(new_findings, grandfathered_count, stale_keys)`` where ``stale_keys``
+    are baseline entries no longer observed (the ratchet: these should be deleted).
+    """
+    budget = dict(baseline)
+    new: List[Finding] = []
+    grandfathered = 0
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            grandfathered += 1
+        else:
+            new.append(f)
+    stale = [k for k, n in budget.items() if n > 0]
+    return new, grandfathered, stale

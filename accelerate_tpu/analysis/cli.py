@@ -1,0 +1,167 @@
+"""graftlint CLI: ``python -m accelerate_tpu lint`` / ``python -m accelerate_tpu.analysis``.
+
+Exit codes: 0 clean (no findings beyond the baseline), 1 new findings or stale docs,
+2 usage error (e.g. a nonexistent lint path). This module and the analysis engine
+import only the stdlib — the analyzed modules are never executed (use
+``python graftlint.py`` for the jax-free guarantee end to end). The optional
+``--check`` docs-freshness gate regenerates ``docs/api`` in a *subprocess* (which does
+import the package, on the CPU backend) and diffs against the committed tree — a stale
+regen fails the same gate as a lint finding (ISSUE 1 satellite)."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import tempfile
+from typing import Optional, Sequence
+
+from .baseline import BASELINE_FILE, apply_baseline, load_baseline, write_baseline
+from .engine import DEFAULT_PATHS, REPO_ROOT, run_lint
+
+
+def build_arg_parser(parser: Optional[argparse.ArgumentParser] = None) -> argparse.ArgumentParser:
+    if parser is None:
+        parser = argparse.ArgumentParser(
+            "graftlint",
+            description="AST-based JAX/TPU correctness & performance linter "
+            "(no TPU, no jax import, <5 s).",
+        )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=None,
+        help=f"files/dirs to lint (default: {' '.join(DEFAULT_PATHS)})",
+    )
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="CI gate: fail on findings beyond the baseline AND on stale docs/api",
+    )
+    parser.add_argument(
+        "--baseline",
+        action="store_true",
+        help="rewrite graftlint_baseline.json from the current findings (ratchet reset)",
+    )
+    parser.add_argument(
+        "--baseline-file",
+        default=BASELINE_FILE,
+        help="alternate baseline path (default: repo-root graftlint_baseline.json)",
+    )
+    parser.add_argument(
+        "--skip-docs",
+        action="store_true",
+        help="with --check: skip the docs/api freshness verification",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog and exit"
+    )
+    return parser
+
+
+def docs_are_fresh(root: str = REPO_ROOT, out=None) -> bool:
+    """Regenerate docs/api into a tmpdir via subprocess and diff against the committed tree."""
+    out = out if out is not None else sys.stderr  # resolve per call, not at import
+    gen = os.path.join(root, "docs", "gen_api.py")
+    committed = os.path.join(root, "docs", "api")
+    if not os.path.isfile(gen):
+        print("graftlint: docs/gen_api.py not found; skipping docs check", file=out)
+        return True
+    with tempfile.TemporaryDirectory(prefix="graftlint_docs_") as tmp:
+        proc = subprocess.run(
+            [sys.executable, gen, tmp],
+            cwd=root,
+            capture_output=True,
+            text=True,
+            timeout=300,
+        )
+        if proc.returncode != 0:
+            print(
+                f"graftlint: docs regen failed (rc={proc.returncode}):\n{proc.stderr[-2000:]}",
+                file=out,
+            )
+            return False
+        fresh = sorted(f for f in os.listdir(tmp) if f.endswith(".md"))
+        have = sorted(f for f in os.listdir(committed) if f.endswith(".md"))
+        if fresh != have:
+            print(
+                f"graftlint: docs/api page set drifted (run python docs/gen_api.py): "
+                f"missing={sorted(set(fresh) - set(have))} "
+                f"orphaned={sorted(set(have) - set(fresh))}",
+                file=out,
+            )
+            return False
+        stale = []
+        for name in fresh:
+            with open(os.path.join(tmp, name)) as f1, open(
+                os.path.join(committed, name)
+            ) as f2:
+                if f1.read() != f2.read():
+                    stale.append(name)
+        if stale:
+            print(
+                f"graftlint: stale docs/api pages (run python docs/gen_api.py): {stale}",
+                file=out,
+            )
+            return False
+    return True
+
+
+def main(argv: Optional[Sequence[str]] = None, out=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    return run_cli(args, out=out)
+
+
+def run_cli(args, out=None) -> int:
+    """Shared implementation for the standalone and ``accelerate-tpu lint`` entries."""
+    # Resolve the stream per call: a default bound at import time would pin whatever
+    # sys.stdout was then (pytest capture objects, since closed).
+    out = out if out is not None else sys.stdout
+    from .rules import all_rules
+
+    if args.list_rules:
+        for r in all_rules():
+            print(f"{r.id:24s} {r.severity:8s} {r.description}", file=out)
+        return 0
+
+    paths = args.paths or DEFAULT_PATHS
+    try:
+        findings = run_lint(paths=paths)
+    except FileNotFoundError as e:
+        print(str(e), file=out)
+        return 2
+
+    if args.baseline:
+        n = write_baseline(findings, args.baseline_file)
+        print(
+            f"graftlint: wrote {n} grandfathered entr{'y' if n == 1 else 'ies'} "
+            f"({len(findings)} findings) to {os.path.relpath(args.baseline_file, REPO_ROOT)}",
+            file=out,
+        )
+        return 0
+
+    baseline = load_baseline(args.baseline_file)
+    new, grandfathered, stale = apply_baseline(findings, baseline)
+
+    for f in new:
+        print(f.format(), file=out)
+    if stale:
+        print(
+            f"graftlint: {len(stale)} baseline entries no longer observed — ratchet down "
+            "with `python -m accelerate_tpu lint --baseline`",
+            file=out,
+        )
+    summary = (
+        f"graftlint: {len(new)} new finding{'s' if len(new) != 1 else ''}, "
+        f"{grandfathered} grandfathered, {len(findings)} total"
+    )
+    print(summary, file=out)
+
+    rc = 1 if new else 0
+    if args.check and not args.skip_docs:
+        if not docs_are_fresh():
+            rc = max(rc, 1)
+        else:
+            print("graftlint: docs/api is fresh", file=out)
+    return rc

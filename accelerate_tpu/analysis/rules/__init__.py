@@ -1,0 +1,37 @@
+"""graftlint rule registry — one module per rule, each grounded in a real incident.
+
+Adding a rule: subclass ``engine.Rule`` in a new module here, list it in
+``all_rules``, run ``python -m accelerate_tpu lint --baseline`` to grandfather the
+existing findings, then burn the baseline down (fix or suppress-with-reason) in
+follow-up commits. See docs/graftlint.md for the full workflow.
+"""
+
+from __future__ import annotations
+
+from .jit_impurity import JitImpurityRule
+from .host_sync import HostSyncRule
+from .rng_reuse import RngReuseRule
+from .recompile_hazard import RecompileHazardRule
+from .donation_safety import DonationSafetyRule
+from .dead_knob import DeadKnobRule
+
+__all__ = ["all_rules", "rule_by_id"]
+
+
+def all_rules():
+    """Fresh rule instances (rules may carry per-run state in ``finalize``)."""
+    return [
+        JitImpurityRule(),
+        HostSyncRule(),
+        RngReuseRule(),
+        RecompileHazardRule(),
+        DonationSafetyRule(),
+        DeadKnobRule(),
+    ]
+
+
+def rule_by_id(rule_id: str):
+    for r in all_rules():
+        if r.id == rule_id:
+            return r
+    raise KeyError(f"unknown graftlint rule: {rule_id}")

@@ -1,0 +1,190 @@
+"""donation-safety: a donated buffer is dead after the call — reading it is UB.
+
+Incident: the aliasing concern hand-noted in ``accelerator.py`` (distinct replicated
+scalar buffers so donated leaves never alias) — ``donate_argnums`` hands the argument's
+buffer to XLA for reuse, so any later read of the same Python name sees freed (or
+overwritten) device memory. jax only warns when the donation isn't used; it cannot see
+a host-side re-read. Two checks:
+
+1. a donated argument's name read again in a statement after the call, before any
+   rebind (``state2 = step(state, x); loss_of(state)``);
+2. a donor called inside a loop whose donated argument is never rebound in the loop
+   body — iteration 2 passes a dead buffer (``for x in xs: metrics = step(state, x)``)."""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import (
+    assigned_names,
+    const_int_seq,
+    const_str_seq,
+    decorator_jit_kwargs,
+    func_param_names,
+    jit_wrap_info,
+)
+from ..engine import FileUnit, Rule
+
+
+class DonationSafetyRule(Rule):
+    id = "donation-safety"
+    severity = "error"
+    description = "argument donated to a jitted call is read again afterwards"
+
+    def check_file(self, unit: FileUnit):
+        donors = self._collect_donors(unit.tree)
+        if not donors:
+            return []
+        findings = []
+        for scope in ast.walk(unit.tree):
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)):
+                self._scan_body(unit, scope.body, donors, findings, enclosing_loop=None)
+        return findings
+
+    # -------------------------------------------------------------- donor table
+
+    def _collect_donors(self, tree: ast.AST) -> dict:
+        """name -> {"nums": [int], "names": [str], "params": [str] or None}"""
+        donors = {}
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    kw = decorator_jit_kwargs(dec)
+                    if kw is None:
+                        continue
+                    nums = const_int_seq(kw.get("donate_argnums"))
+                    names = const_str_seq(kw.get("donate_argnames"))
+                    if nums or names:
+                        donors[node.name] = {
+                            "nums": nums,
+                            "names": names,
+                            "params": func_param_names(node),
+                        }
+            elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                info = jit_wrap_info(node.value)
+                if info is None:
+                    continue
+                nums = const_int_seq(info["kwargs"].get("donate_argnums"))
+                names = const_str_seq(info["kwargs"].get("donate_argnames"))
+                if not (nums or names):
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        donors[t.id] = {"nums": nums, "names": names, "params": None}
+        return donors
+
+    # -------------------------------------------------------------- scope scan
+
+    def _donated_arg_names(self, call: ast.Call, spec: dict) -> list:
+        out = []
+        for i in spec["nums"]:
+            if i < len(call.args) and isinstance(call.args[i], ast.Name):
+                out.append(call.args[i].id)
+        if spec["names"]:
+            for kw in call.keywords:
+                if kw.arg in spec["names"] and isinstance(kw.value, ast.Name):
+                    out.append(kw.value.id)
+            if spec["params"]:
+                for i, a in enumerate(call.args):
+                    if (
+                        i < len(spec["params"])
+                        and spec["params"][i] in spec["names"]
+                        and isinstance(a, ast.Name)
+                    ):
+                        out.append(a.id)
+        return out
+
+    def _scan_body(self, unit, body, donors, findings, enclosing_loop):
+        for i, stmt in enumerate(body):
+            # Recurse into nested statement lists first (loops carry themselves down);
+            # nested function bodies are separate scopes, scanned by check_file.
+            if not isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for attr in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, attr, None)
+                    if sub:
+                        loop = (
+                            stmt
+                            if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While))
+                            else enclosing_loop
+                        )
+                        self._scan_body(unit, sub, donors, findings, loop)
+            for call in _calls_in_stmt_head(stmt):
+                if not isinstance(call.func, ast.Name):
+                    continue
+                spec = donors.get(call.func.id)
+                if spec is None:
+                    continue
+                for vname in self._donated_arg_names(call, spec):
+                    rebound_here = vname in assigned_names(stmt)
+                    if not rebound_here:
+                        hit = self._first_read_after(body[i + 1 :], vname)
+                        if hit is not None:
+                            findings.append(
+                                self.make(
+                                    unit,
+                                    hit,
+                                    f"'{vname}' was donated to '{call.func.id}' "
+                                    f"(line {call.lineno}) and is read again here — the "
+                                    "buffer is dead after donation",
+                                )
+                            )
+                    if enclosing_loop is not None and not self._rebound_in_loop(
+                        enclosing_loop, vname, stmt
+                    ):
+                        findings.append(
+                            self.make(
+                                unit,
+                                call,
+                                f"'{vname}' is donated to '{call.func.id}' inside a loop but "
+                                "never rebound in the loop body — iteration 2 passes a "
+                                "dead buffer",
+                            )
+                        )
+
+    def _first_read_after(self, rest, vname):
+        """First Name-load of vname in subsequent statements, None if rebound first."""
+        for stmt in rest:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Name) and node.id == vname and isinstance(
+                    node.ctx, ast.Load
+                ):
+                    return node
+            if vname in assigned_names(stmt):
+                return None
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.stmt) and vname in assigned_names(sub):
+                    return None
+        return None
+
+    def _rebound_in_loop(self, loop, vname, _call_stmt) -> bool:
+        if vname in assigned_names(loop):  # the loop target itself
+            return True
+        for stmt in ast.walk(loop):
+            if isinstance(stmt, ast.stmt) and vname in assigned_names(stmt):
+                return True
+        return False
+
+
+def _calls_in_stmt_head(stmt: ast.stmt):
+    """Call nodes in a statement's own expressions, not in nested statement lists.
+
+    ``for b in xs: m = step(s, b)`` must attribute ``step`` to the inner Assign (seen
+    by recursion), not also to the For — otherwise every finding doubles.
+    """
+    stack = []
+    for field, value in ast.iter_fields(stmt):
+        if isinstance(value, list):
+            stack.extend(
+                v for v in value if isinstance(v, ast.AST) and not isinstance(v, ast.stmt)
+            )
+        elif isinstance(value, ast.AST):
+            stack.append(value)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue  # separate scope / deferred execution
+        if isinstance(node, ast.Call):
+            yield node
+        stack.extend(
+            c for c in ast.iter_child_nodes(node) if not isinstance(c, ast.stmt)
+        )

@@ -1,0 +1,123 @@
+"""recompile-hazard: static args that defeat the jit cache (or crash it).
+
+Incident: every jit cache miss on the tunnel costs seconds of XLA compile plus RPC
+round-trips; a static arg bound to a value that varies per call recompiles on *every*
+step, and an unhashable static (list/dict/set) is a ``TypeError`` at the first call.
+Three checks, all within one module:
+
+1. a static parameter receiving a list/dict/set (or comprehension) at a call site;
+2. a static parameter bound to the induction variable of an enclosing loop —
+   a guaranteed recompile per iteration;
+3. ``static_argnames`` naming a parameter the wrapped function doesn't have
+   (silently ignored by jax < 0.4.27, TypeError after — dead knob either way)."""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import (
+    const_str_seq,
+    decorator_jit_kwargs,
+    dotted,
+    func_all_param_names,
+    func_param_names,
+    jit_wrap_info,
+)
+from ..engine import FileUnit, Rule
+
+_UNHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp, ast.SetComp)
+
+
+class RecompileHazardRule(Rule):
+    id = "recompile-hazard"
+    severity = "error"
+    description = "per-call-varying or unhashable value bound to a jit static argument"
+
+    def check_file(self, unit: FileUnit):
+        findings = []
+        # jitted name -> {"static_names": [...], "params": [...] or None, "line": int}
+        jitted = {}
+
+        for node in ast.walk(unit.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    kw = decorator_jit_kwargs(dec)
+                    if kw is None:
+                        continue
+                    statics = const_str_seq(kw.get("static_argnames"))
+                    params = func_param_names(node)
+                    jitted[node.name] = {"static_names": statics, "params": params}
+                    all_params = func_all_param_names(node)
+                    for s in statics:
+                        if s not in all_params:
+                            findings.append(
+                                self.make(
+                                    unit,
+                                    node,
+                                    f"static_argnames names '{s}' but '{node.name}' has no "
+                                    "such parameter — the static marking is a dead knob",
+                                )
+                            )
+            elif isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+                info = jit_wrap_info(node.value)
+                if info is None:
+                    continue
+                statics = const_str_seq(info["kwargs"].get("static_argnames"))
+                if not statics:
+                    continue
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        jitted[t.id] = {"static_names": statics, "params": None}
+
+        if jitted:
+            findings.extend(self._scan_call_sites(unit, jitted))
+        return findings
+
+    def _scan_call_sites(self, unit: FileUnit, jitted: dict):
+        findings = []
+
+        def visit(node: ast.AST, loop_vars: frozenset):
+            for child in ast.iter_child_nodes(node):
+                child_loops = loop_vars
+                if isinstance(child, (ast.For, ast.AsyncFor)):
+                    new = set()
+                    for n in ast.walk(child.target):
+                        if isinstance(n, ast.Name):
+                            new.add(n.id)
+                    child_loops = loop_vars | frozenset(new)
+                if isinstance(child, ast.Call) and isinstance(child.func, ast.Name):
+                    spec = jitted.get(child.func.id)
+                    if spec:
+                        findings.extend(
+                            self._check_site(unit, child, child.func.id, spec, loop_vars)
+                        )
+                visit(child, child_loops)
+
+        visit(unit.tree, frozenset())
+        return findings
+
+    def _check_site(self, unit: FileUnit, call: ast.Call, name: str, spec, loop_vars):
+        bound = {}
+        for kw in call.keywords:
+            if kw.arg in spec["static_names"]:
+                bound[kw.arg] = kw.value
+        if spec["params"]:
+            for i, arg in enumerate(call.args):
+                if i < len(spec["params"]) and spec["params"][i] in spec["static_names"]:
+                    bound[spec["params"][i]] = arg
+        for pname, value in bound.items():
+            if isinstance(value, _UNHASHABLE):
+                yield self.make(
+                    unit,
+                    call,
+                    f"unhashable {type(value).__name__.lower()} passed to static arg "
+                    f"'{pname}' of jitted '{name}' — TypeError at call time; pass a tuple "
+                    "or mark the arg non-static",
+                )
+            elif isinstance(value, ast.Name) and value.id in loop_vars:
+                yield self.make(
+                    unit,
+                    call,
+                    f"static arg '{pname}' of jitted '{name}' bound to loop variable "
+                    f"'{value.id}' — recompiles every iteration",
+                )

@@ -1,0 +1,146 @@
+"""rng-key-reuse: a PRNG key consumed twice produces identical "random" numbers.
+
+Incidents: fixed seeds hard-wired into library paths mask real entropy plumbing
+(``GenerationConfig`` callers silently all sample the same stream), and a key passed
+to two samplers — or to one sampler inside a loop without a per-iteration
+``jax.random.split`` — repeats its draw exactly. Two checks:
+
+1. literal ``PRNGKey(<int>)`` in non-test library code (tests may pin seeds freely);
+2. a key variable used as a call argument more than once (or once but inside a loop
+   that never re-splits it) without an intervening reassignment."""
+
+from __future__ import annotations
+
+import ast
+
+from ..astutil import dotted
+from ..engine import FileUnit, Rule
+
+#: Consuming a key through these is fine — they derive fresh keys, not samples.
+_KEY_DERIVING = ("split", "fold_in", "key_data", "wrap_key_data", "clone")
+#: Host-side inspection of a key object consumes no randomness.
+_NON_CONSUMERS = frozenset(
+    {"len", "bool", "int", "float", "str", "repr", "print", "isinstance", "type",
+     "hash", "list", "tuple", "sorted", "enumerate", "zip"}
+)
+
+
+def _is_prngkey_call(call: ast.Call) -> bool:
+    name = dotted(call.func)
+    return name is not None and (name == "PRNGKey" or name.endswith(".PRNGKey"))
+
+
+def _is_key_source(call: ast.Call) -> bool:
+    """PRNGKey/key/split/fold_in from a random namespace — NOT ``"a/b".split``."""
+    name = dotted(call.func)
+    if name is None:
+        return False
+    if name == "PRNGKey" or name.endswith(".PRNGKey"):
+        return True
+    short = name.rsplit(".", 1)[-1]
+    if short in ("split", "fold_in", "key"):
+        # Qualified: require a random-looking namespace. Bare `split(k)` is accepted
+        # (`from jax.random import split`); `path.split("/")` is not.
+        return name == short or "random" in name or name.startswith(("jr.", "jrandom."))
+    return False
+
+
+class RngReuseRule(Rule):
+    id = "rng-key-reuse"
+    severity = "error"
+    description = "literal PRNGKey seed in library code, or a key consumed twice without split"
+
+    def check_file(self, unit: FileUnit):
+        findings = []
+        if not unit.is_test:
+            for node in ast.walk(unit.tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and _is_prngkey_call(node)
+                    and node.args
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, int)
+                ):
+                    findings.append(
+                        self.make(
+                            unit,
+                            node,
+                            f"literal PRNGKey({node.args[0].value!r}) in library code — "
+                            "accept a key argument or derive via utils.random",
+                        )
+                    )
+        for fn in ast.walk(unit.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                findings.extend(self._scan_function(unit, fn))
+        return findings
+
+    def _scan_function(self, unit: FileUnit, fn: ast.AST):
+        """Track key-typed names; flag a second consuming use without reassignment."""
+        findings = []
+        # name -> {"uses": int, "loop_depth_at_assign": int}
+        keys = {}
+
+        def consume(name_node: ast.Name, call: ast.Call, loop_depth: int):
+            st = keys.get(name_node.id)
+            if st is None:
+                return
+            callee = dotted(call.func) or "<call>"
+            short = callee.rsplit(".", 1)[-1]
+            if short in _KEY_DERIVING or short in _NON_CONSUMERS:
+                return
+            st["uses"] += 1
+            if st["uses"] > 1:
+                findings.append(
+                    self.make(
+                        unit,
+                        name_node,
+                        f"rng key '{name_node.id}' consumed again (by '{callee}') without a "
+                        "split — identical randomness to its previous use",
+                    )
+                )
+            elif loop_depth > st["assign_depth"]:
+                findings.append(
+                    self.make(
+                        unit,
+                        name_node,
+                        f"rng key '{name_node.id}' consumed (by '{callee}') inside a loop but "
+                        "assigned outside it — every iteration reuses the same key; "
+                        "jax.random.split per iteration",
+                    )
+                )
+
+        def track_assign(stmt, loop_depth: int):
+            if not isinstance(stmt, ast.Assign) or not isinstance(stmt.value, ast.Call):
+                return False
+            if not _is_key_source(stmt.value):
+                return False
+            for t in stmt.targets:
+                targets = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                for el in targets:
+                    if isinstance(el, ast.Name):
+                        keys[el.id] = {"uses": 0, "assign_depth": loop_depth}
+            return True
+
+        def clear_rebinds(stmt):
+            from ..astutil import assigned_names
+
+            for n in assigned_names(stmt):
+                keys.pop(n, None)
+
+        def visit(node: ast.AST, loop_depth: int):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue  # nested defs get their own scan
+                if isinstance(child, ast.stmt) and not track_assign(child, loop_depth):
+                    clear_rebinds(child)
+                if isinstance(child, ast.Call):
+                    for arg in list(child.args) + [kw.value for kw in child.keywords]:
+                        if isinstance(arg, ast.Name):
+                            consume(arg, child, loop_depth)
+                if isinstance(child, (ast.For, ast.AsyncFor, ast.While)):
+                    visit(child, loop_depth + 1)
+                else:
+                    visit(child, loop_depth)
+
+        visit(fn, 0)
+        return findings

@@ -242,7 +242,7 @@ def stream_blocks(
 
     def fetch_sync(p):
         params = dispatched.fetch(p, device)
-        jax.block_until_ready(params)
+        jax.block_until_ready(params)  # graftlint: disable=host-sync-in-hot-path(prefetch handoff fence; blocks the worker thread, not the compute stream)
         # Through the tunneled relay block_until_ready can return early (see the
         # timing caveats in bench_timing.materialize); a one-element read-back is a
         # guaranteed per-buffer fence. Fence EVERY leaf — tree_leaves order is

@@ -211,8 +211,15 @@ def save_accelerator_state(
         (path / SCHEDULER_STATE_NAME).write_text(json.dumps(meta, indent=2))
         (path / SAMPLER_STATE_NAME).write_text(json.dumps(samplers))
 
+    # Custom objects are host-replicated: one copy suffices on a shared filesystem;
+    # ProjectConfiguration.save_on_each_node asks each node's local-main process to
+    # write its own copy (node-local disks, reference checkpointing.py:303). The
+    # per-process gate lives inside save_custom_state (utils.other.save idiom).
+    save_each = getattr(
+        getattr(accelerator, "project_configuration", None), "save_on_each_node", False
+    )
     for i, obj in enumerate(accelerator._custom_objects):
-        save_custom_state(obj, str(path), i)
+        save_custom_state(obj, str(path), i, save_on_each_node=save_each)
 
     # 3. Per-process host RNG states (reference checkpointing.py:148-171).
     states: dict[str, Any] = {
@@ -333,7 +340,18 @@ def _export_safetensors(params, file_path: Path) -> None:
 
 
 def save_custom_state(obj, path: str, index: int = 0, save_on_each_node: bool = False) -> None:
-    """Pickle ``obj.state_dict()`` (reference ``checkpointing.py:303``)."""
+    """Pickle ``obj.state_dict()`` (reference ``checkpointing.py:303``).
+
+    Writes once globally (main process), or once per node (local-main process) when
+    ``save_on_each_node`` — the ``utils.other.save`` gate: concurrent same-path writers
+    on a multi-process host would corrupt the pickle.
+    """
+    from .state import PartialState
+
+    state = PartialState()
+    should_write = state.is_local_main_process if save_on_each_node else state.is_main_process
+    if not should_write:
+        return
     load_location = Path(path) / f"{CUSTOM_OBJECT_NAME}_{index}.pkl"
     with open(load_location, "wb") as f:
         pickle.dump(obj.state_dict(), f)

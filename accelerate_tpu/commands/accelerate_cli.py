@@ -8,6 +8,7 @@ from .config import config_command_parser
 from .env import env_command_parser
 from .estimate import estimate_command_parser
 from .launch import launch_command_parser
+from .lint import lint_command_parser
 from .merge import merge_command_parser
 from .test import test_command_parser
 from .tpu import tpu_command_parser
@@ -26,6 +27,7 @@ def get_parser() -> argparse.ArgumentParser:
     env_command_parser(subparsers=subparsers)
     estimate_command_parser(subparsers=subparsers)
     launch_command_parser(subparsers=subparsers)
+    lint_command_parser(subparsers=subparsers)
     merge_command_parser(subparsers=subparsers)
     test_command_parser(subparsers=subparsers)
     tpu_command_parser(subparsers=subparsers)

@@ -51,6 +51,7 @@ def _registry_model_sizes(name: str):
             from ..big_modeling import init_empty_weights
 
             cfg = family.CONFIGS[name]
+            # graftlint: disable=rng-key-reuse(abstract shape-only init; the key is never consumed)
             abstract = init_empty_weights(family.init_params, cfg, jax.random.PRNGKey(0))
             total, (largest, _) = calculate_maximum_sizes(abstract)
             return total, largest

@@ -1,0 +1,31 @@
+"""``accelerate-tpu lint`` — run graftlint (see ``accelerate_tpu/analysis/``).
+
+Thin wrapper so the linter rides the standard CLI root alongside ``env``/``launch``/
+etc.; the heavy lifting (and the no-jax-import guarantee) lives in ``analysis.cli``."""
+
+from __future__ import annotations
+
+import argparse
+
+from ..analysis.cli import build_arg_parser, run_cli
+
+__all__ = ["lint_command", "lint_command_parser"]
+
+
+def lint_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = (
+        "Static AST lint of the package for JAX/TPU hazards (jit impurity, host syncs "
+        "in hot loops, rng reuse, recompile hazards, donation safety, dead knobs)."
+    )
+    if subparsers is not None:
+        parser = subparsers.add_parser("lint", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu lint", description=description)
+    build_arg_parser(parser)
+    if subparsers is not None:
+        parser.set_defaults(func=lint_command)
+    return parser
+
+
+def lint_command(args) -> int:
+    return run_cli(args)

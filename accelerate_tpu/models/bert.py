@@ -82,7 +82,7 @@ def _layer_params(cfg: BertConfig, key) -> dict:
 
 def init_params(cfg: BertConfig, key: Optional[jax.Array] = None) -> dict:
     if key is None:
-        key = jax.random.PRNGKey(0)
+        key = jax.random.PRNGKey(0)  # graftlint: disable=rng-key-reuse(deterministic default init; callers pass a key for real entropy)
     keys = jax.random.split(key, cfg.n_layers + 4)
     s = 0.02
     D = cfg.d_model

@@ -135,7 +135,7 @@ def _layer_params(cfg: GPTConfig, key) -> dict:
 
 def init_params(cfg: GPTConfig, key: Optional[jax.Array] = None) -> dict:
     if key is None:
-        key = jax.random.PRNGKey(0)
+        key = jax.random.PRNGKey(0)  # graftlint: disable=rng-key-reuse(deterministic default init; callers pass a key for real entropy)
     keys = jax.random.split(key, cfg.n_layers + 3)
     scale = 1.0 / math.sqrt(cfg.d_model)
     params: dict = {

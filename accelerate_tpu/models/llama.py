@@ -266,7 +266,7 @@ def _lora_target_names(cfg: LlamaConfig) -> tuple:
 
 def init_params(cfg: LlamaConfig, key: Optional[jax.Array] = None) -> dict:
     if key is None:
-        key = jax.random.PRNGKey(0)
+        key = jax.random.PRNGKey(0)  # graftlint: disable=rng-key-reuse(deterministic default init; callers pass a key for real entropy)
     keys = jax.random.split(key, cfg.n_layers + 2)
     scale = 1.0 / math.sqrt(cfg.d_model)
     params = {
@@ -1738,8 +1738,9 @@ def generate_speculative(
                 target_params, draft_params, t_cache, d_cache, pending_dev,
                 t_cfg=target_cfg, d_cfg=draft_cfg, k=k,
             )
+            # graftlint: disable=host-sync-in-hot-path(one fused round = ONE result read; the host must see the accepted tokens)
             arr = np.asarray(packed)  # [k+1]: emitted slots + count
-            for tok in arr[: int(arr[k])].tolist():
+            for tok in arr[: int(arr[k])].tolist():  # graftlint: disable=host-sync-in-hot-path(arr is host-side numpy already; no device fetch here)
                 out.append(int(tok))
                 if len(out) >= max_new_tokens or (
                     eos_token_id is not None and tok == eos_token_id
@@ -1761,12 +1762,13 @@ def generate_speculative(
                 top_k=gen.top_k, apply_top_p=gen.top_p < 1.0,
             )
             q_rows.append(qp[0, -1])
+            # graftlint: disable=host-sync-in-hot-path(sampled accept chain is host-side by design; see the future-work note above)
             tok = int(np.asarray(jax.random.categorical(
                 next_key(), jnp.log(jnp.maximum(qp[0, -1], 1e-30))
             )))
             drafts.append(tok)
-        base_t = int(np.asarray(t_cache["index"]))      # emitted length - 1 (pending unwritten)
-        base_d = int(np.asarray(d_cache["index"])) - (k - 1)  # draft wrote pending + drafts[:-1]
+        base_t = int(np.asarray(t_cache["index"]))      # emitted length - 1 (pending unwritten)  # graftlint: disable=host-sync-in-hot-path(rewind bookkeeping; 4-byte reads once per round)
+        base_d = int(np.asarray(d_cache["index"])) - (k - 1)  # draft wrote pending + drafts[:-1]  # graftlint: disable=host-sync-in-hot-path(rewind bookkeeping; 4-byte reads once per round)
         # 2. ONE target dispatch (T=k): verify pending + ALL proposals. Position i of the
         # output is the target's prediction after input i — it checks drafts[i] for
         # i < k-1, and position k-1 (after the last proposal) backs the bonus token on
@@ -1784,11 +1786,12 @@ def generate_speculative(
             acc, token = speculative_accept(
                 pp[0, n], q_rows[n], drafts[n], next_key()
             )
-            if not bool(np.asarray(acc)):
-                correction = int(np.asarray(token))
+            if not bool(np.asarray(acc)):  # graftlint: disable=host-sync-in-hot-path(accept verdict must reach the host to end the round)
+                correction = int(np.asarray(token))  # graftlint: disable=host-sync-in-hot-path(rejected-draft correction token crosses to host once)
                 break
             n += 1
         if correction is None:  # full acceptance: bonus token from the target's own row
+            # graftlint: disable=host-sync-in-hot-path(bonus-token draw; one 4-byte read per fully-accepted round)
             correction = int(np.asarray(jax.random.categorical(
                 next_key(), jnp.log(jnp.maximum(pp[0, k - 1], 1e-30))
             )))

@@ -75,7 +75,7 @@ def _block_params(cfg: ResNetConfig, key, cin: int, cout: int) -> dict:
 
 def init_params(cfg: ResNetConfig, key: Optional[jax.Array] = None) -> dict:
     if key is None:
-        key = jax.random.PRNGKey(0)
+        key = jax.random.PRNGKey(0)  # graftlint: disable=rng-key-reuse(deterministic default init; callers pass a key for real entropy)
     n_blocks = sum(cfg.blocks_per_stage)
     keys = jax.random.split(key, n_blocks + 2)
     params: dict = {

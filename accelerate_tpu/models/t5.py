@@ -116,7 +116,7 @@ def _ff_params(cfg: T5Config, key) -> dict:
 
 def init_params(cfg: T5Config, key: Optional[jax.Array] = None) -> dict:
     if key is None:
-        key = jax.random.PRNGKey(0)
+        key = jax.random.PRNGKey(0)  # graftlint: disable=rng-key-reuse(deterministic default init; callers pass a key for real entropy)
     n_enc, n_dec = cfg.n_layers, cfg.dec_layers
     keys = jax.random.split(key, 2 + 2 * n_enc + 3 * n_dec)
     ki = iter(range(len(keys)))
@@ -917,7 +917,7 @@ def generate_streamed(
         nxt = jnp.where(done, eos_token_id, nxt)
         done = done | (nxt == eos_token_id)
         if pass_times is not None:
-            jax.block_until_ready(nxt)
+            jax.block_until_ready(nxt)  # graftlint: disable=host-sync-in-hot-path(pass_times contract: per-pass wall time blocked on the step output)
             pass_times.append(_time.perf_counter() - t_pass)
         out.append(nxt)
         dec = dec.at[:, t + 1].set(nxt)

@@ -57,7 +57,7 @@ class BnbQuantizationConfig:
     load_in_4bit: bool = False
     bnb_4bit_quant_type: str = "int4"  # int4 | nf4
     block_size: int = 64               # int4/nf4 scaling-block length
-    torch_dtype: Any = jnp.bfloat16    # compute dtype after dequant (name kept for parity)
+    torch_dtype: Any = jnp.bfloat16  # graftlint: disable=dead-knob(HF BnB config parity; dequant compute dtype follows the param tree)
     skip_modules: Optional[list[str]] = None
     keep_in_fp32_modules: Optional[list[str]] = None
     min_weight_size: int = 4096        # leaves smaller than this stay unquantized

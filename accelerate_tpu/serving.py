@@ -330,7 +330,9 @@ class ContinuousBatcher:
                 req.done = True
                 finished.append(req)
                 self.slot_req[i] = None  # slot frees; cache row overwritten on next admit
-        return finished_at_admit + finished
+        # Report in submission order (uid is the admission counter), not slot order —
+        # slot assignment is an engine detail a client should never observe.
+        return sorted(finished_at_admit + finished, key=lambda r: r.uid)
 
     def run(self, report_throughput: bool = False):
         """Drain queue + active slots; returns finished requests (and tokens/s)."""
@@ -361,6 +363,7 @@ class ContinuousBatcher:
                     if req.gen.temperature <= 0.0
                     else req._sample(logits_dev[0])
                 )
+                # graftlint: disable=recompile-hazard(slot indexes a compile-time cache row; at most max_slots variants, admission-time only)
                 self.cache = _insert_row(self.cache, row_cache, slot=slot, scan_layers=self.cfg.scan_layers)
                 self.slot_req[slot] = req
                 self.positions[slot] = prefill_len  # next write = first decode slot

@@ -55,10 +55,13 @@ def _maybe_init_distributed(kwargs: Optional[DistributedInitKwargs]) -> None:
     """
     coordinator = None
     num_processes = process_id = None
+    local_device_ids = timeout_secs = None
     if kwargs is not None and kwargs.coordinator_address:
         coordinator = kwargs.coordinator_address
         num_processes = kwargs.num_processes
         process_id = kwargs.process_id
+        local_device_ids = kwargs.local_device_ids
+        timeout_secs = int(kwargs.timeout.total_seconds())
     elif os.environ.get("ACCELERATE_COORDINATOR_ADDRESS"):
         coordinator = os.environ["ACCELERATE_COORDINATOR_ADDRESS"]
         num_processes = int(os.environ.get("ACCELERATE_NUM_PROCESSES", "1"))
@@ -70,11 +73,16 @@ def _maybe_init_distributed(kwargs: Optional[DistributedInitKwargs]) -> None:
     except Exception:
         already = False
     if not already:
-        jax.distributed.initialize(
+        init_kwargs: dict[str, Any] = dict(
             coordinator_address=coordinator,
             num_processes=num_processes,
             process_id=process_id,
         )
+        if local_device_ids is not None:
+            init_kwargs["local_device_ids"] = local_device_ids
+        if timeout_secs is not None:
+            init_kwargs["initialization_timeout"] = timeout_secs
+        jax.distributed.initialize(**init_kwargs)
 
 
 class PartialState:

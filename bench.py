@@ -282,13 +282,22 @@ def _measured_matmul_ceiling() -> float:
             a = a @ w
         return a
 
+    def _fence(x):
+        # Time ON DEVICE only (VERDICT r5 weak #2: `np.asarray(out)[0,0]` fetched the
+        # full 128 MB result over the tunnel and recorded the fetch as the matmul —
+        # 9.3 "TF/s" under a 99.7 TF/s run). block_until_ready completes the dispatch
+        # chain without moving data; the 1-element read-back below covers the tunneled
+        # relay's early-return caveat (big_modeling._fence_leaf) at ~4 bytes of D2H.
+        jax.block_until_ready(x)
+        np.asarray(x[0, 0])
+
     # Warm until two consecutive rounds agree within 10% (cap 4): at cold process start
     # the first dispatches pay the allocator-settling transient (the r4 bench_rev-2
     # discovery) — an unsettled probe reported a 2.3 TF/s "ceiling" under a 99 TF/s run.
     prev = None
     for _ in range(4):
         t0 = time.perf_counter()
-        _ = np.asarray(chain(a, w))[0, 0]  # value fetch fences the chained dispatches
+        _fence(chain(a, w))
         dt = time.perf_counter() - t0
         if prev is not None and abs(dt - prev) <= 0.1 * max(dt, prev):
             break
@@ -298,7 +307,7 @@ def _measured_matmul_ceiling() -> float:
     out = None
     for _ in range(n):
         out = chain(a, w)
-    _ = np.asarray(out)[0, 0]
+    _fence(out)
     dt = time.perf_counter() - t0
     return n * k * 2 * M**3 / dt / 1e12
 
@@ -475,8 +484,21 @@ def run(B: int, S: int, fuse: int, preset: str | None, default_metric: str | Non
         "device_kind": str(getattr(jax.devices()[0], "device_kind", "unknown")),
     }
     if ceiling is not None:
-        out["matmul_peak_measured_tflops"] = round(ceiling, 1)
-        out["mfu_of_measured_peak"] = round(tflops / ceiling, 4)
+        mfu_measured = tflops / ceiling
+        if mfu_measured > 1.0:
+            # Physically impossible: the run cannot beat the chip's own measured matmul
+            # ceiling. The probe mis-measured (cold allocator, tunnel fetch in the timed
+            # region, ...) — refuse to record a bogus ceiling row (VERDICT r5 weak #2
+            # recorded mfu_of_measured_peak: 10.7 this way).
+            out["matmul_peak_measured_tflops"] = None
+            out["mfu_of_measured_peak"] = None
+            out["ceiling_probe_warning"] = (
+                f"probe measured {ceiling:.1f} TF/s but the run achieved {tflops:.1f} "
+                "TF/s (mfu_of_measured_peak > 1.0); ceiling discarded as mis-measured"
+            )
+        else:
+            out["matmul_peak_measured_tflops"] = round(ceiling, 1)
+            out["mfu_of_measured_peak"] = round(mfu_measured, 4)
     if preset:
         out["preset"] = preset
     out["bench_rev"] = _BENCH_REV  # in the printed row too: sweep rows must carry the

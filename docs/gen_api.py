@@ -67,6 +67,8 @@ MODULES = [
     ("accelerate_tpu.utils.offload", "Disk offload"),
     ("accelerate_tpu.utils.memory", "Memory utilities"),
     ("accelerate_tpu.utils.random", "RNG control"),
+    ("accelerate_tpu.analysis.engine", "Static analysis (graftlint) engine"),
+    ("accelerate_tpu.analysis.baseline", "Static analysis ratcheting baseline"),
     ("accelerate_tpu.models.llama", "Llama family"),
     ("accelerate_tpu.models.lora", "LoRA fine-tuning"),
     ("accelerate_tpu.models.gpt", "GPT family"),

@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""graftlint, truly standalone: runs without jax installed.
+
+``python -m accelerate_tpu lint`` and ``python -m accelerate_tpu.analysis`` are the
+convenience entries, but any ``accelerate_tpu.*`` import executes the package root's
+``__init__`` — which imports jax. This script loads ``accelerate_tpu/analysis/`` under
+a synthetic parent package instead, so the analysis modules' relative imports resolve
+while the package root never runs: stdlib only, end to end.
+
+    python graftlint.py [--check] [--baseline] [paths ...]
+
+Set ``GRAFTLINT_ASSERT_NO_JAX=1`` to make the process fail if jax ever lands in
+``sys.modules`` (the guarantee tests/test_lint_clean.py holds in CI).
+"""
+
+import os
+import sys
+import types
+
+ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def _load_analysis():
+    """Register a stub ``accelerate_tpu`` parent so the analysis subpackage imports
+    without executing ``accelerate_tpu/__init__.py`` (and its jax import)."""
+    if "accelerate_tpu" not in sys.modules:
+        stub = types.ModuleType("accelerate_tpu")
+        stub.__path__ = [os.path.join(ROOT, "accelerate_tpu")]
+        sys.modules["accelerate_tpu"] = stub
+    sys.path.insert(0, ROOT)
+    from accelerate_tpu.analysis.cli import main
+
+    return main
+
+
+if __name__ == "__main__":
+    main = _load_analysis()
+    rc = main()
+    if os.environ.get("GRAFTLINT_ASSERT_NO_JAX") and "jax" in sys.modules:
+        sys.exit("graftlint.py leaked a jax import — the standalone guarantee broke")
+    sys.exit(rc)

@@ -1,0 +1,446 @@
+"""Per-rule fixture tests for graftlint (``accelerate_tpu/analysis/``).
+
+For every rule: one known-bad snippet that MUST fire, one fixed/suppressed snippet
+that MUST NOT, plus engine-level suppression semantics (an unknown rule id in a
+suppression comment is itself an error). Snippets are written to tmp files — the
+linter never imports them, so no jax/TPU is exercised here.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from accelerate_tpu.analysis import run_lint
+from accelerate_tpu.analysis.baseline import apply_baseline, load_baseline, write_baseline
+from accelerate_tpu.analysis.rules import all_rules, rule_by_id
+
+
+def lint_snippet(tmp_path, source, rules=None, name="snippet.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return run_lint(paths=(str(f),), root=str(tmp_path), rules=rules)
+
+
+def rule_hits(findings, rule_id):
+    return [f for f in findings if f.rule == rule_id]
+
+
+# --------------------------------------------------------------------- jit-impurity
+
+BAD_JIT_IMPURITY = """
+    import time
+    import numpy as np
+    import jax
+
+    @jax.jit
+    def step(x):
+        t0 = time.perf_counter()
+        print("tracing at", t0)
+        return x + np.random.randn()
+
+    def build_train_step(fn):
+        def micro(x):
+            global COUNT
+            COUNT += 1
+            return fn(x)
+        return micro
+"""
+
+GOOD_JIT_IMPURITY = """
+    import time
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x, key):
+        return x + jax.random.normal(key, x.shape)  # traced rng is pure
+
+    def run(step, x, key):
+        t0 = time.perf_counter()  # timing OUTSIDE the jitted function is fine
+        print("host-side log")
+        return step(x, key), time.perf_counter() - t0
+"""
+
+
+def test_jit_impurity_fires(tmp_path):
+    hits = rule_hits(lint_snippet(tmp_path, BAD_JIT_IMPURITY), "jit-impurity")
+    msgs = " ".join(f.message for f in hits)
+    assert len(hits) == 4, hits
+    assert "time.perf_counter" in msgs and "print" in msgs
+    assert "np.random.randn" in msgs and "global COUNT" in msgs
+
+
+def test_jit_impurity_clean(tmp_path):
+    assert not rule_hits(lint_snippet(tmp_path, GOOD_JIT_IMPURITY), "jit-impurity")
+
+
+# ------------------------------------------------------------- host-sync-in-hot-path
+
+BAD_HOST_SYNC = """
+    import numpy as np
+    import jax
+
+    def decode_loop(step, tokens, cache):
+        out = []
+        for t in tokens:
+            logits, cache = step(t, cache)
+            out.append(int(np.asarray(logits)[0]))   # device fetch per token
+            jax.block_until_ready(logits)
+            val = logits.item()
+            idx = int(logits[0])
+        return out
+"""
+
+GOOD_HOST_SYNC = """
+    import numpy as np
+    import jax
+
+    def decode_loop(step, tokens, cache):
+        out = []
+        for t in tokens:
+            logits, cache = step(t, cache)
+            out.append(logits)            # stays on device
+        return np.asarray(jax.block_until_ready(out))  # ONE fetch after the loop
+
+    def checkpoint_save(leaves):          # not a hot-path name: syncs are fine
+        for leaf in leaves:
+            np.asarray(leaf)
+"""
+
+SUPPRESSED_HOST_SYNC = """
+    import numpy as np
+
+    def decode_loop(step, tokens, cache):
+        out = []
+        for t in tokens:
+            logits, cache = step(t, cache)
+            out.append(int(np.asarray(logits)[0]))  # graftlint: disable=host-sync-in-hot-path(the host consumes each token as it is produced)
+        return out
+"""
+
+
+def test_host_sync_fires(tmp_path):
+    hits = rule_hits(lint_snippet(tmp_path, BAD_HOST_SYNC), "host-sync-in-hot-path")
+    msgs = " ".join(f.message for f in hits)
+    assert len(hits) >= 4, hits
+    assert "np.asarray" in msgs and "block_until_ready" in msgs
+    assert ".item()" in msgs and "int(...[...])" in msgs
+
+
+def test_host_sync_clean(tmp_path):
+    assert not rule_hits(lint_snippet(tmp_path, GOOD_HOST_SYNC), "host-sync-in-hot-path")
+
+
+def test_host_sync_suppressed_with_reason(tmp_path):
+    findings = lint_snippet(tmp_path, SUPPRESSED_HOST_SYNC)
+    assert not rule_hits(findings, "host-sync-in-hot-path")
+    assert not rule_hits(findings, "bad-suppression")
+
+
+# ----------------------------------------------------------------------- rng-key-reuse
+
+BAD_RNG = """
+    import jax
+
+    def sample_pair(shape):
+        key = jax.random.PRNGKey(0)
+        a = jax.random.normal(key, shape)
+        b = jax.random.normal(key, shape)   # identical to a
+        return a, b
+
+    def sample_loop(shape, n):
+        key = jax.random.PRNGKey(1)
+        out = []
+        for _ in range(n):
+            out.append(jax.random.normal(key, shape))  # same draw every iteration
+        return out
+"""
+
+GOOD_RNG = """
+    import jax
+
+    def sample_pair(key, shape):
+        k1, k2 = jax.random.split(key)
+        a = jax.random.normal(k1, shape)
+        b = jax.random.normal(k2, shape)
+        return a, b
+
+    def sample_loop(key, shape, n):
+        out = []
+        for _ in range(n):
+            key, sub = jax.random.split(key)
+            out.append(jax.random.normal(sub, shape))
+        return out
+"""
+
+
+def test_rng_reuse_fires(tmp_path):
+    hits = rule_hits(lint_snippet(tmp_path, BAD_RNG), "rng-key-reuse")
+    msgs = " ".join(f.message for f in hits)
+    assert "literal PRNGKey" in msgs
+    assert "consumed again" in msgs
+    assert "inside a loop" in msgs
+
+
+def test_rng_reuse_clean(tmp_path):
+    assert not rule_hits(lint_snippet(tmp_path, GOOD_RNG), "rng-key-reuse")
+
+
+def test_rng_literal_allowed_in_test_files(tmp_path):
+    # Test files may pin seeds freely: same snippet under a test_ name is clean.
+    src = """
+    import jax
+
+    def make_fixture():
+        return jax.random.PRNGKey(0)
+    """
+    assert rule_hits(lint_snippet(tmp_path, src, name="lib.py"), "rng-key-reuse")
+    assert not rule_hits(lint_snippet(tmp_path, src, name="test_lib.py"), "rng-key-reuse")
+
+
+# -------------------------------------------------------------------- recompile-hazard
+
+BAD_RECOMPILE = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("width", "missing"))
+    def pad(x, width):
+        return x
+
+    def run(pad, xs):
+        for width in range(1, 9):
+            pad(xs, width=width)        # loop var bound to a static arg
+        pad(xs, width=[1, 2])           # unhashable static
+"""
+
+GOOD_RECOMPILE = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("width",))
+    def pad(x, width):
+        return x
+
+    BUCKETS = (128, 256, 512)
+
+    def run(pad, xs):
+        width = BUCKETS[-1]
+        return pad(xs, width=width)     # one bucketed variant, hashable
+"""
+
+
+def test_recompile_hazard_fires(tmp_path):
+    hits = rule_hits(lint_snippet(tmp_path, BAD_RECOMPILE), "recompile-hazard")
+    msgs = " ".join(f.message for f in hits)
+    assert "loop variable" in msgs
+    assert "unhashable" in msgs
+    assert "no such parameter" in msgs  # 'missing' is not a param of pad
+
+
+def test_recompile_hazard_clean(tmp_path):
+    assert not rule_hits(lint_snippet(tmp_path, GOOD_RECOMPILE), "recompile-hazard")
+
+
+def test_recompile_kwonly_static_is_known(tmp_path):
+    # llama._spec_round_greedy_jit regression: keyword-only statics are real params.
+    src = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("cfg",))
+    def fwd(params, tokens, *, cfg):
+        return tokens
+    """
+    assert not rule_hits(lint_snippet(tmp_path, src), "recompile-hazard")
+
+
+# --------------------------------------------------------------------- donation-safety
+
+BAD_DONATION = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def update(state, grads):
+        return state
+
+    def run(state, grads):
+        new = update(state, grads)
+        return state, new              # donated buffer read after the call
+
+    def run_loop(state, batches):
+        for b in batches:
+            metrics = update(state, b)  # never rebound: iteration 2 reuses a dead buffer
+        return metrics
+"""
+
+GOOD_DONATION = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, donate_argnums=(0,))
+    def update(state, grads):
+        return state
+
+    def run_loop(state, batches):
+        for b in batches:
+            state = update(state, b)    # rebound each iteration — donation-safe
+        return state
+"""
+
+
+def test_donation_safety_fires(tmp_path):
+    hits = rule_hits(lint_snippet(tmp_path, BAD_DONATION), "donation-safety")
+    msgs = " ".join(f.message for f in hits)
+    assert "read again" in msgs
+    assert "never rebound" in msgs
+
+
+def test_donation_safety_clean(tmp_path):
+    assert not rule_hits(lint_snippet(tmp_path, GOOD_DONATION), "donation-safety")
+
+
+# --------------------------------------------------------------------------- dead-knob
+
+BAD_DEAD_KNOB = """
+    import dataclasses
+
+    @dataclasses.dataclass
+    class TrainConfig:
+        lr: float = 1e-3
+        unuse_me: int = 7        # defined, never read anywhere
+
+    def run(cfg: TrainConfig):
+        return cfg.lr
+"""
+
+GOOD_DEAD_KNOB = """
+    import dataclasses
+
+    @dataclasses.dataclass
+    class TrainConfig:
+        lr: float = 1e-3
+        warmup: int = 100
+
+    def run(cfg: TrainConfig):
+        return cfg.lr * cfg.warmup
+"""
+
+
+def test_dead_knob_fires(tmp_path):
+    hits = rule_hits(lint_snippet(tmp_path, BAD_DEAD_KNOB), "dead-knob")
+    assert len(hits) == 1
+    assert "unuse_me" in hits[0].message
+
+
+def test_dead_knob_clean(tmp_path):
+    assert not rule_hits(lint_snippet(tmp_path, GOOD_DEAD_KNOB), "dead-knob")
+
+
+# ------------------------------------------------------------- suppression semantics
+
+def test_unknown_rule_in_suppression_is_error(tmp_path):
+    src = """
+    x = 1  # graftlint: disable=no-such-rule(whatever)
+    """
+    hits = rule_hits(lint_snippet(tmp_path, src), "bad-suppression")
+    assert len(hits) == 1
+    assert "unknown rule 'no-such-rule'" in hits[0].message
+
+
+def test_suppression_without_reason_is_error(tmp_path):
+    src = """
+    import jax
+
+    def f():
+        return jax.random.PRNGKey(0)  # graftlint: disable=rng-key-reuse
+    """
+    findings = lint_snippet(tmp_path, src)
+    bad = rule_hits(findings, "bad-suppression")
+    assert len(bad) == 1 and "no reason" in bad[0].message
+    # ...and the reasonless suppression does NOT silence the finding.
+    assert rule_hits(findings, "rng-key-reuse")
+
+
+def test_suppression_syntax_in_docstring_is_ignored(tmp_path):
+    src = '''
+    def f():
+        """Suppress with ``# graftlint: disable=not-a-rule(text)`` on the line."""
+        return 1
+    '''
+    assert not lint_snippet(tmp_path, src)
+
+
+def test_whole_line_suppression_covers_next_line(tmp_path):
+    src = """
+    import jax
+
+    def f():
+        # graftlint: disable=rng-key-reuse(deterministic by contract)
+        return jax.random.PRNGKey(0)
+    """
+    assert not lint_snippet(tmp_path, src)
+
+
+# ----------------------------------------------------------------- baseline ratchet
+
+def test_baseline_grandfathers_then_ratchets(tmp_path):
+    findings = lint_snippet(tmp_path, BAD_DEAD_KNOB)
+    assert findings
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(findings, str(bl_path))
+    baseline = load_baseline(str(bl_path))
+
+    # Same findings again: all grandfathered, nothing new.
+    new, grandfathered, stale = apply_baseline(findings, baseline)
+    assert not new and grandfathered == len(findings) and not stale
+
+    # A NEW finding (different code line) is not absorbed by the baseline.
+    worse = lint_snippet(
+        tmp_path,
+        BAD_DEAD_KNOB.replace(
+            "unuse_me: int = 7        # defined, never read anywhere",
+            "unuse_me: int = 7        # defined, never read anywhere\n"
+            "        also_dead: str = 'x'",
+        ),
+        name="snippet2.py",
+    )
+    assert len(worse) == 2
+    new, _, _ = apply_baseline(
+        [dataclasses_replace_path(f, "snippet.py") for f in worse], baseline
+    )
+    assert len(new) == 1  # only the truly new line fails
+
+    # Fixing the original finding leaves a stale entry — the ratchet reports it.
+    new, grandfathered, stale = apply_baseline([], baseline)
+    assert not new and not grandfathered and len(stale) == len(findings)
+
+
+def dataclasses_replace_path(f, name):
+    import dataclasses
+
+    return dataclasses.replace(f, path=name)
+
+
+def test_baseline_file_round_trip(tmp_path):
+    findings = lint_snippet(tmp_path, BAD_DEAD_KNOB)
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(findings, str(bl_path))
+    data = json.loads(bl_path.read_text())
+    assert data["tool"] == "graftlint" and data["version"] == 1
+    assert data["findings"][0]["rule"] == "dead-knob"
+    assert load_baseline(str(bl_path))
+
+
+# ------------------------------------------------------------------------ registry
+
+def test_every_rule_has_id_and_description():
+    rules = all_rules()
+    assert len(rules) >= 6
+    for r in rules:
+        assert r.id and r.description and r.severity in ("error", "warning")
+        assert rule_by_id(r.id).__class__ is r.__class__
+    with pytest.raises(KeyError):
+        rule_by_id("nope")

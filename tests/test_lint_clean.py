@@ -1,0 +1,75 @@
+"""Tier-1 gate: graftlint over the package stays clean beyond the committed baseline.
+
+Runs the engine (not a subprocess) over ``accelerate_tpu/``, ``benchmarks/`` and
+``bench.py`` — the same set the CLI defaults to — and fails on any finding not
+grandfathered in ``graftlint_baseline.json``. The ratchet direction is enforced too:
+at HEAD the baseline is fully burned down (every historical finding fixed or
+suppressed with a reason), so it must never grow back.
+"""
+
+from accelerate_tpu.analysis import run_lint
+from accelerate_tpu.analysis.baseline import BASELINE_FILE, apply_baseline, load_baseline
+from accelerate_tpu.analysis.engine import DEFAULT_PATHS
+
+
+def test_lint_clean_beyond_baseline():
+    findings = run_lint(paths=DEFAULT_PATHS)
+    baseline = load_baseline(BASELINE_FILE)
+    new, _grandfathered, _stale = apply_baseline(findings, baseline)
+    listing = "\n".join(f.format() for f in new)
+    assert not new, (
+        f"{len(new)} graftlint finding(s) beyond graftlint_baseline.json:\n{listing}\n"
+        "Fix the code, or suppress ON THE FINDING'S LINE with "
+        "`# graftlint: disable=<rule>(<reason>)`. Do not add baseline entries — the "
+        "ratchet only shrinks (docs/graftlint.md)."
+    )
+
+
+def test_nonexistent_lint_path_fails_loudly(capsys):
+    """A typo'd CI target must not report a clean lint of zero files forever."""
+    import pytest
+
+    from accelerate_tpu.analysis.cli import main
+    from accelerate_tpu.analysis.engine import iter_py_files
+
+    with pytest.raises(FileNotFoundError):
+        list(iter_py_files(["no/such/dir"]))
+    assert main(["no/such/dir"]) == 2
+    assert "no such lint path" in capsys.readouterr().out
+
+
+def test_standalone_entry_never_imports_jax():
+    """`python graftlint.py` is the jax-free entry: the package root never runs."""
+    import os
+    import subprocess
+    import sys
+
+    from accelerate_tpu.analysis.engine import REPO_ROOT
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "graftlint.py"), "--list-rules"],
+        env={**os.environ, "GRAFTLINT_ASSERT_NO_JAX": "1"},
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr or proc.stdout
+    assert "dead-knob" in proc.stdout
+
+
+def test_cli_smoke(capsys):
+    """The `accelerate-tpu lint` plumbing parses args and reaches the engine."""
+    from accelerate_tpu.analysis.cli import main
+
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in (
+        "jit-impurity",
+        "host-sync-in-hot-path",
+        "rng-key-reuse",
+        "recompile-hazard",
+        "donation-safety",
+        "dead-knob",
+    ):
+        assert rule_id in out
