@@ -41,7 +41,7 @@ from .data_loader import DataLoaderDispatcher, DataLoaderShard, prepare_data_loa
 from .logging import get_logger
 from .optimizer import AcceleratedOptimizer
 from .parallel.fsdp import shard_params
-from .parallel.mesh import MeshConfig, replicated as _mesh_replicated
+from .parallel.mesh import MeshConfig, mesh_context, replicated as _mesh_replicated
 from .scheduler import AcceleratedScheduler
 from .state import AcceleratorState, GradientState
 from .utils.constants import BATCH_AXES
@@ -121,6 +121,21 @@ class _TrainStep:
 
     def __call__(self, state: TrainState, batch) -> tuple[TrainState, Any]:
         acc = self.accelerator
+        # Telemetry bracket: when off this is two attribute reads — no syncs, no
+        # allocation. When on, the record fences on the 1-element loss (telemetry.fence
+        # never fetches the full result) so step time includes the device work.
+        tel = acc.telemetry
+        tel_on = tel is not None and tel.enabled
+        if tel_on:
+            tel._step_begin()
+        try:
+            return self._dispatch(acc, tel if tel_on else None, state, batch)
+        except BaseException:
+            if tel_on:
+                tel._step_abort()  # a failed step must not leak the compile label
+            raise
+
+    def _dispatch(self, acc, tel, state: TrainState, batch) -> tuple[TrainState, Any]:
         gs = acc.gradient_state
         if acc._in_accumulate_ctx:
             do_sync = gs.sync_gradients  # accumulate() ctx already decided
@@ -130,7 +145,7 @@ class _TrainStep:
             gs._set_sync_gradients(do_sync)
         offload = acc._opt_device_shardings is not None
         # Mesh context lets model code use bare PartitionSpecs in sharding constraints.
-        with jax.set_mesh(acc.mesh):
+        with mesh_context(acc.mesh):
             state = acc._offload_fetch(state, opt=do_sync)
             if do_sync:
                 state, metrics = self.apply_fn(state, batch)
@@ -150,6 +165,8 @@ class _TrainStep:
         acc.step += 1
         if self.optimizer is not None:
             self.optimizer.step()
+        if tel is not None:
+            tel._step_end(fence_on=metrics, batch=batch)
         return state, metrics
 
 
@@ -202,11 +219,20 @@ class _FusedTrainStep:
 
     def __call__(self, state: TrainState, batches) -> tuple[TrainState, Any]:
         acc = self.accelerator
-        stacked = self._stack(batches)
-        with jax.set_mesh(acc.mesh):
-            state = acc._offload_fetch(state, opt=True)
-            state, metrics = self.fused_fn(state, stacked)
-            state = acc._offload_stash(state, opt=True)
+        tel = acc.telemetry
+        tel_on = tel is not None and tel.enabled
+        if tel_on:
+            tel._step_begin()
+        try:
+            stacked = self._stack(batches)
+            with mesh_context(acc.mesh):
+                state = acc._offload_fetch(state, opt=True)
+                state, metrics = self.fused_fn(state, stacked)
+                state = acc._offload_stash(state, opt=True)
+        except BaseException:
+            if tel_on:
+                tel._step_abort()  # a failed step must not leak the compile label
+            raise
         acc.step += self.fused_steps
         applies = self.fused_steps // acc.gradient_accumulation_steps
         if self.optimizer is not None:
@@ -214,6 +240,12 @@ class _FusedTrainStep:
         acc.gradient_state._set_sync_gradients(
             self.fused_steps % acc.gradient_accumulation_steps == 0
         )
+        if tel_on:
+            # One record per dispatch window of M steps; batch shapes sit behind the
+            # stacked [M, B, ...] leading dim.
+            tel._step_end(
+                fence_on=metrics, batch=stacked, n_steps=self.fused_steps, drop_leading=1
+            )
         return state, metrics
 
 
@@ -243,6 +275,7 @@ class Accelerator:
         step_scheduler_with_optimizer: bool = True,
         kwargs_handlers: Optional[list] = None,
         dynamo_plugin=None,
+        telemetry_config=None,
     ):
         self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
         if project_dir is not None and self.project_configuration.project_dir is None:
@@ -341,7 +374,17 @@ class Accelerator:
             sp_plugin=sp_plugin,
             ep_plugin=ep_plugin,
             megatron_lm_plugin=megatron_lm_plugin,
+            telemetry_config=telemetry_config,
         )
+
+        # Step-level telemetry (off by default; ACCELERATE_TELEMETRY=1 or an enabled
+        # TelemetryConfig turns it on). The disabled object costs two attribute reads
+        # per train step — no listeners, no files, no host syncs.
+        from .telemetry import Telemetry
+
+        self.telemetry = Telemetry(self.state.telemetry_config)
+        if self.telemetry.enabled:
+            self.telemetry.sinks.append(self._telemetry_tracker_sink)
 
         if ddp_kwargs is not None and ddp_kwargs.reduce_dtype is not None:
             # DDP comm_hook analog: compress cross-device gradient reductions.
@@ -1183,7 +1226,7 @@ class Accelerator:
 
         @functools.wraps(wrapped)
         def with_mesh(params, batch):
-            with jax.set_mesh(mesh):
+            with mesh_context(mesh):
                 return jitted(params, batch)
 
         return with_mesh
@@ -1234,14 +1277,37 @@ class Accelerator:
     def profile(self, profile_handler=None):
         """Profile the enclosed block with ``jax.profiler`` (reference ``:3614``).
 
-        The reference builds a ``torch.profiler.profile`` from ``ProfileKwargs`` and exports a
-        Chrome trace to ``output_trace_dir``. Here the block is captured with
-        ``jax.profiler.trace`` (TensorBoard/perfetto-compatible, includes XLA HLO + TPU
-        device timelines); ``on_trace_ready(trace_dir)`` fires on exit when provided.
+        Two modes, decided by ``ProfileKwargs.schedule_option``:
+
+        - **Scheduled** (``schedule_option`` set): yields a
+          ``telemetry.ScheduledProfiler`` — call its ``step()`` once per train step
+          and traces cover exactly the wait/warmup/active/repeat windows (one
+          ``cycle<N>`` trace directory per repeat), the torch
+          ``torch.profiler.schedule`` semantics. ``on_trace_ready(path)`` fires per
+          window.
+        - **Whole-block** (no schedule): the block is captured with one
+          ``jax.profiler`` trace (TensorBoard/perfetto-compatible, includes XLA HLO +
+          TPU device timelines); ``on_trace_ready(trace_dir)`` fires on exit.
+
+        ``profile_memory`` writes a pprof device-memory profile beside each trace in
+        both modes.
         """
         from .utils.dataclasses import ProfileKwargs
 
         handler = profile_handler or getattr(self, "profile_handler", None) or ProfileKwargs()
+        if handler.schedule_option is not None:
+            from .telemetry import ScheduledProfiler
+
+            profiler = ScheduledProfiler.from_profile_kwargs(handler)
+            if not self.is_main_process:
+                # Same contract as the whole-block branch below: the user callback
+                # fires once per window, not once per process.
+                profiler.on_trace_ready = None
+            try:
+                yield profiler
+            finally:
+                profiler.close()
+            return
         trace_dir = handler.output_trace_dir
         if trace_dir is None:
             import tempfile
@@ -1253,6 +1319,13 @@ class Accelerator:
             yield handler
         finally:
             jax.profiler.stop_trace()
+            if handler.profile_memory:
+                try:
+                    jax.profiler.save_device_memory_profile(
+                        os.path.join(trace_dir, "device_memory.prof")
+                    )
+                except Exception:  # backends without a memory profile: trace stands
+                    pass
             if handler.on_trace_ready is not None and self.is_main_process:
                 handler.on_trace_ready(trace_dir)
 
@@ -1430,7 +1503,21 @@ class Accelerator:
     def logging_dir(self):
         return self.project_configuration.logging_dir
 
+    def _telemetry_tracker_sink(self, record: dict) -> None:
+        """Fan a telemetry record out to every configured tracker (JSONL gets the raw
+        record; scalar backends get it flattened — see tracking.log_telemetry_record)."""
+        if self.is_main_process and self.trackers:
+            from .tracking import log_telemetry_record
+
+            log_telemetry_record(self.trackers, record, step=record.get("step"))
+
     def log(self, values: dict, step: Optional[int] = None, log_kwargs: dict = None):
+        if self.telemetry.enabled and self.telemetry.config.merge_into_log:
+            # Auto-merge the latest step's telemetry columns (prefixed telemetry/, so
+            # user keys can never collide; explicit values always win regardless).
+            merged = self.telemetry.log_columns()
+            if merged:
+                values = {**merged, **values}
         if self.is_main_process:
             for tracker in self.trackers:
                 tracker.log(values, step=step, **(log_kwargs or {}).get(tracker.name, {}))
@@ -1483,6 +1570,7 @@ class Accelerator:
 
     def end_training(self):
         self.wait_for_checkpoint()
+        self.telemetry.close()
         for tracker in self.trackers:
             tracker.finish()
         self.wait_for_everyone()

@@ -34,6 +34,22 @@ SYNC_METHODS = frozenset({"item", "block_until_ready"})
 #: ``int(name.split("/")[1])`` subscripts a host string, not a device array.
 _HOST_STR_METHODS = frozenset({"split", "rsplit", "partition", "rpartition", "groups", "findall"})
 
+#: The telemetry package implements the sanctioned fence helpers — its internal
+#: ``block_until_ready``/``np.asarray`` ARE the one correct sync (1-element target,
+#: ~4-byte read-back; ``telemetry/timing.py``), so the rule skips the package.
+SANCTIONED_PATH_PREFIX = "accelerate_tpu/telemetry/"
+
+
+def _is_sanctioned_sync(name: str) -> bool:
+    """Telemetry fence helpers, allowlisted by qualified name: ``fence(...)`` (the
+    bare import), or any ``...telemetry.fence`` / ``...timing.fence`` qualification
+    (``telemetry.fence(out)``, ``acc.telemetry.fence(out)``). Fenced timing built on
+    these is correct by construction — instrumented hot loops need no suppressions."""
+    parts = name.split(".")
+    if parts[-1] != "fence":
+        return False
+    return len(parts) == 1 or "telemetry" in parts or "timing" in parts
+
 
 def _is_host_string_subscript(sub: ast.Subscript) -> bool:
     base = sub.value
@@ -44,6 +60,16 @@ def _is_host_string_subscript(sub: ast.Subscript) -> bool:
     )
 
 
+def _is_fenced_subscript(sub: ast.Subscript) -> bool:
+    """``int(fence(x)[0])``: the value was already synced by the sanctioned fence —
+    the subscript fetch is the ~4-byte post-fence read, not a hidden full-tree pull."""
+    base = sub.value
+    if not isinstance(base, ast.Call):
+        return False
+    name = dotted(base.func)
+    return bool(name) and _is_sanctioned_sync(name)
+
+
 class HostSyncRule(Rule):
     id = "host-sync-in-hot-path"
     severity = "warning"
@@ -52,6 +78,8 @@ class HostSyncRule(Rule):
     def check_file(self, unit: FileUnit):
         if unit.is_test:  # test scripts fetch values to assert on them — that's the point
             return []
+        if unit.path.startswith(SANCTIONED_PATH_PREFIX):
+            return []  # the fence helpers' own implementation (see SANCTIONED_PATH_PREFIX)
         findings = []
         for fn in ast.walk(unit.tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -112,6 +140,7 @@ class HostSyncRule(Rule):
             and len(call.args) == 1
             and isinstance(call.args[0], ast.Subscript)
             and not _is_host_string_subscript(call.args[0])
+            and not _is_fenced_subscript(call.args[0])
         ):
             return self.make(
                 unit,

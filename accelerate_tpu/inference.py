@@ -19,6 +19,7 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
+from .parallel.mesh import mesh_context
 from .utils.constants import PIPELINE_AXIS
 
 __all__ = ["prepare_pippy", "pipeline_forward_fn"]
@@ -113,7 +114,7 @@ def prepare_pippy(
         jitted_fwd = jax.jit(fwd)
 
         def with_mesh_multi(*args, **kwargs):
-            with jax.set_mesh(mesh):
+            with mesh_context(mesh):
                 return jitted_fwd(
                     *(jnp.asarray(a, jnp.int32) if a is not None else None for a in args),
                     **{k: (jnp.asarray(v, jnp.int32) if v is not None else None)
@@ -148,7 +149,7 @@ def prepare_pippy(
     jitted = jax.jit(forward)
 
     def with_mesh(tokens):
-        with jax.set_mesh(mesh):
+        with mesh_context(mesh):
             return jitted(jnp.asarray(tokens, jnp.int32))
 
     return pp_params, with_mesh

@@ -22,6 +22,8 @@ from typing import Callable, Optional, Sequence
 import jax
 import jax.numpy as jnp
 
+from ..utils.jax_compat import current_abstract_mesh, shard_map as _shard_map
+
 __all__ = [
     "remat_wrap", "kv_planes", "write_kv", "read_kv", "quant_kv",
     "fused_ce_allowed", "fused_ce_single_shard",
@@ -187,7 +189,7 @@ def resolve_sp_pipeline(cfg, mesh, schedule: str, virtual_stages: int):
 
     if cfg.attn_impl not in ("ring", "ulysses", "ulysses_ppermute", "allgather"):
         return False, cfg
-    if not (sp_active(mesh) or sp_active(jax.sharding.get_abstract_mesh())):
+    if not (sp_active(mesh) or sp_active(current_abstract_mesh())):
         return False, cfg
     if cfg.attn_impl == "ulysses" and (schedule == "1f1b" or virtual_stages > 1):
         cfg = dataclasses.replace(cfg, attn_impl="ulysses_ppermute")
@@ -208,7 +210,7 @@ def attention_dispatch(q, k, v, mask, *, impl: str, sm_scale: float, window: int
     from ..utils.constants import SEQUENCE_AXIS
 
     if impl in ("ring", "ulysses", "ulysses_ppermute", "allgather"):
-        mesh = jax.sharding.get_abstract_mesh()
+        mesh = current_abstract_mesh()
         if sp_active(mesh):
             if sp_manual(mesh):
                 from ..parallel.sequence import sequence_parallel_attention
@@ -343,12 +345,12 @@ def ce_sum_dispatch(x, head, targets, mask, *, loss_impl: str, dtype,
         # logsumexp merges across tp in fp32 (ops/fused_xent.fused_cross_entropy_tp).
         # Tokens stay sharded over the batch axes. For batch-only layouts use
         # "fused_dp"; single device "fused".
-        from jax.sharding import PartitionSpec as P, get_abstract_mesh
+        from jax.sharding import PartitionSpec as P
 
         from ..ops.fused_xent import fused_cross_entropy_tp
         from ..utils.constants import BATCH_AXES, TENSOR_AXIS as _TP
 
-        mesh = get_abstract_mesh()
+        mesh = current_abstract_mesh()
         if not getattr(mesh, "axis_names", ()):
             raise ValueError(
                 "loss_impl='fused_tp' needs an active mesh context "
@@ -364,7 +366,7 @@ def ce_sum_dispatch(x, head, targets, mask, *, loss_impl: str, dtype,
             )
             return (nll * ml.reshape(Bl * S)).sum()[None]
 
-        partials = jax.shard_map(
+        partials = _shard_map(
             _local,
             mesh=mesh,
             in_specs=(P(BATCH_AXES), P(BATCH_AXES), P(BATCH_AXES), P(None, _TP)),
@@ -378,12 +380,12 @@ def ce_sum_dispatch(x, head, targets, mask, *, loss_impl: str, dtype,
         # transpose psum the head gradient). For batch-sharded layouts (dp/fsdp); under
         # tp-sharded heads or sp-sharded sequences prefer the chunked path (this one
         # would all-gather the head / sequence into every shard).
-        from jax.sharding import PartitionSpec as P, get_abstract_mesh
+        from jax.sharding import PartitionSpec as P
 
         from ..ops.fused_xent import fused_cross_entropy
         from ..utils.constants import BATCH_AXES
 
-        mesh = get_abstract_mesh()
+        mesh = current_abstract_mesh()
         if not getattr(mesh, "axis_names", ()):
             raise ValueError(
                 "loss_impl='fused_dp' needs an active mesh context "
@@ -398,7 +400,7 @@ def ce_sum_dispatch(x, head, targets, mask, *, loss_impl: str, dtype,
             )
             return (nll * ml.reshape(Bl * S)).sum()[None]
 
-        partials = jax.shard_map(
+        partials = _shard_map(
             _local,
             mesh=mesh,
             in_specs=(P(BATCH_AXES), P(BATCH_AXES), P(BATCH_AXES), P()),

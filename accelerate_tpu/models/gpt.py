@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..utils.constants import BATCH_AXES, FSDP_AXIS, SEQUENCE_AXIS, TENSOR_AXIS
+from ..utils.jax_compat import current_abstract_mesh
 
 __all__ = [
     "GPTConfig",
@@ -500,7 +501,7 @@ def _guard_sp_under_pp(cfg: "GPTConfig", mesh) -> None:
     from .common import sp_active
 
     if cfg.attn_impl in ("ring", "ulysses", "ulysses_ppermute", "allgather") and (
-        sp_active(mesh) or sp_active(jax.sharding.get_abstract_mesh())
+        sp_active(mesh) or sp_active(current_abstract_mesh())
     ):
         raise NotImplementedError(
             "gpt forward_pp does not go manual over sp. For sp x pp training use "

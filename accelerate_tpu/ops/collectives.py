@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..utils.constants import BATCH_AXES
+from ..utils.jax_compat import axis_size as _axis_size
 
 __all__ = [
     "psum",
@@ -47,7 +48,9 @@ def maybe_shard(x, spec, require_axis: Optional[str] = None):
     """Apply a sharding constraint only when a mesh context is active (``jax.set_mesh``) —
     and, if ``require_axis`` is given, only when that axis exists in the mesh. Lets the same
     model code run in plain single-device baselines."""
-    mesh = jax.sharding.get_abstract_mesh()
+    from ..utils.jax_compat import current_abstract_mesh
+
+    mesh = current_abstract_mesh()
     if mesh is None or mesh.empty:
         return x
     if require_axis is not None and require_axis not in mesh.shape:
@@ -95,7 +98,7 @@ def axis_index(axis_name: Optional[AxisNames] = None):
 
 
 def axis_size(axis_name: Optional[AxisNames] = None):
-    return lax.axis_size(_axes(axis_name))
+    return _axis_size(_axes(axis_name))
 
 
 def grad_psum(grads, axis_name: Optional[AxisNames] = None, reduce_dtype=None):

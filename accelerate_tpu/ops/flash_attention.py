@@ -44,6 +44,7 @@ from __future__ import annotations
 
 import functools
 import math
+from ..utils.jax_compat import tpu_compiler_params as _tpu_compiler_params
 import os
 from typing import Optional
 
@@ -91,7 +92,7 @@ def _dim_semantics(n_parallel: int, n_arbitrary: int):
     ACCEL_FLASH_DIMSEM=0 turns it off for A/B rows in the bench sweep."""
     if os.environ.get("ACCEL_FLASH_DIMSEM", "1") == "0":
         return None
-    return pltpu.CompilerParams(
+    return _tpu_compiler_params(
         dimension_semantics=("parallel",) * n_parallel + ("arbitrary",) * n_arbitrary
     )
 

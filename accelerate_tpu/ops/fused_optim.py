@@ -52,6 +52,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ._common import interpret_default as _interpret_default
+from ..utils.jax_compat import shard_map as _shard_map, tpu_compiler_params as _tpu_compiler_params
 
 __all__ = ["FusedAdamW", "fused_adamw", "ScaledAdamState"]
 
@@ -188,7 +189,7 @@ def _leaf_fused(p, m, v, g, scalars, *, b1, b2, eps, wd, block_rows, interpret):
             jax.ShapeDtypeStruct((rows, _LANES), m.dtype),
             jax.ShapeDtypeStruct((rows, _LANES), v.dtype),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             dimension_semantics=(pltpu.PARALLEL,),
         ),
         interpret=interpret,
@@ -423,7 +424,7 @@ class FusedAdamW:
                     return (*_leaf_xla(p, m, v, g, scalars, **kw), None, None)
                 from jax.sharding import PartitionSpec
 
-                mapped = jax.shard_map(
+                mapped = _shard_map(
                     local,
                     mesh=mesh,
                     in_specs=(PartitionSpec(), spec, spec, spec, spec),

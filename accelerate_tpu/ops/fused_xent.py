@@ -26,6 +26,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ._common import interpret_default as _interpret_default
+from ..utils.jax_compat import axis_size as _axis_size, tpu_compiler_params as _tpu_compiler_params
 
 __all__ = ["fused_cross_entropy", "fused_cross_entropy_tp"]
 
@@ -231,7 +232,7 @@ def _launch_fwd(kernel_fn, n_outputs, x, w, t2, *, vocab, softcap, block_t, bloc
         out_specs=[stat_spec] * n_outputs,
         out_shape=[jax.ShapeDtypeStruct((Tp, 1), jnp.float32)] * n_outputs,
         scratch_shapes=[pltpu.VMEM((block_t, 1), jnp.float32)] * 3,
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             dimension_semantics=(pltpu.PARALLEL, pltpu.ARBITRARY),
         ),
         interpret=interpret,
@@ -267,7 +268,7 @@ def _fce_bwd(vocab, softcap, block_t, block_v, interpret, res, g):
         out_specs=pl.BlockSpec((block_t, D), lambda i, j: (i, 0)),
         out_shape=jax.ShapeDtypeStruct((Tp, D), x.dtype),
         scratch_shapes=[pltpu.VMEM((block_t, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             dimension_semantics=(pltpu.PARALLEL, pltpu.ARBITRARY),
         ),
         interpret=interpret,
@@ -286,7 +287,7 @@ def _fce_bwd(vocab, softcap, block_t, block_v, interpret, res, g):
         out_specs=pl.BlockSpec((D, block_v), lambda j, i: (0, j)),
         out_shape=jax.ShapeDtypeStruct((D, Vp), w.dtype),
         scratch_shapes=[pltpu.VMEM((D, block_v), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_tpu_compiler_params(
             dimension_semantics=(pltpu.PARALLEL, pltpu.ARBITRARY),
         ),
         interpret=interpret,
@@ -369,7 +370,7 @@ def _fce_tp_bwd(vocab, softcap, block_t, block_v, interpret, axis_name, res, g):
     # cotangent arrives SPLIT across the axis (g/n per shard — the psum adjoint).
     # Scale it back so dx = psum(partials·g) and the shard-local dw see the true g.
     # tests/test_fused_xent.py::test_tp_variant_matches_dense pins this convention.
-    g = g * jax.lax.axis_size(axis_name)
+    g = g * _axis_size(axis_name)
     dx, dw, _ = _fce_bwd(vocab, softcap, block_t, block_v, interpret, res, g)
     return dx, dw, None
 

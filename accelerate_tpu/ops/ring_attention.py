@@ -28,6 +28,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .flash_attention import _bwd_dq, _bwd_dkv, _fwd, _interpret_default
+from ..utils.jax_compat import axis_size as _axis_size
 
 __all__ = ["ring_attention"]
 
@@ -56,7 +57,7 @@ def _ring_fwd_impl(q, k, v, seg_f32, axis_name, causal, sm_scale, block_sizes, i
     block_q, block_k = block_sizes
     B, H, S_local, hd = q.shape
     idx = lax.axis_index(axis_name)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     q_off = idx * S_local
     # Packing: q keeps its LOCAL segment-id slice; the kv-side slice rotates around the
@@ -103,7 +104,7 @@ def _ring_bwd(axis_name, causal, sm_scale, block_sizes, interpret, window, softc
     q, k, v, seg_f32, o, lse = residuals
     B, H, S_local, hd = q.shape
     idx = lax.axis_index(axis_name)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     perm = [(i, (i + 1) % n) for i in range(n)]
     q_off = idx * S_local
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)

@@ -42,6 +42,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..utils.constants import PIPELINE_AXIS
+from ..utils.jax_compat import axis_size as _axis_size, shard_map as _shard_map
 
 __all__ = [
     "pipeline_apply",
@@ -115,7 +116,7 @@ def pipeline_apply(
     batch-level statistic, still M · n_stages pairs in scale, never sp× larger.
     """
     idx = lax.axis_index(axis_name)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     M = microbatches.shape[0]
     local_params = jax.tree_util.tree_map(lambda x: x[0], stage_params)
     perm = [(i, i + 1) for i in range(n - 1)]  # forward chain, no wraparound
@@ -230,7 +231,7 @@ def make_pipeline_fn(
             )
             in_specs.append(P() if side_spec is None else side_spec)
             args.append(side_mb)
-        mapped = jax.shard_map(
+        mapped = _shard_map(
             functools.partial(
                 pipeline_apply, stage_fn, axis_name=axis_name, with_aux=with_aux,
                 aux_extra_axes=tuple(extra_manual_axes),
@@ -606,7 +607,7 @@ def _psum_mean_extra(aux, axis_name, extra_axes):
     if extra_axes:
         size = 1
         for a in extra_axes:
-            size *= lax.axis_size(a)
+            size *= _axis_size(a)
         aux = lax.psum(aux, tuple(extra_axes)) / size
     return aux
 
@@ -649,7 +650,7 @@ def _pipeline_1f1b_bwd_kernel(
     unconditionally.
     """
     idx = lax.axis_index(axis_name)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     M = x_mb.shape[0]
     is_last = idx == n - 1
     p_local = jax.tree_util.tree_map(lambda x: x[0], stage_params)
@@ -813,7 +814,7 @@ def _interleaved_fwd_kernel(
     M · n · v real (chunk-stage, microbatch) pairs, same total as the flat schedule's
     M · n since each chunk holds 1/v of a flat stage's layers."""
     idx = lax.axis_index(axis_name)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     M = x_mb.shape[0]
     p_local = jax.tree_util.tree_map(lambda a: a[:, 0], stage_params)  # [v, ...]
     perm = [(i, (i + 1) % n) for i in range(n)]  # circular: wraps chunk boundaries
@@ -892,7 +893,7 @@ def _pipeline_interleaved_bwd_kernel(
     per-(chunk, slot) circular activation/grad buffers, circular ppermutes in both
     directions. Same uniform-program discipline: no conditionals around compute."""
     idx = lax.axis_index(axis_name)
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     M = x_mb.shape[0]
     VS = n * v
     p_local = jax.tree_util.tree_map(lambda a: a[:, 0], stage_params)  # [v, ...]
@@ -1073,7 +1074,7 @@ def _make_interleaved_loss_fn(
         if side:
             in_specs.append(P() if side_spec is None else side_spec)
             args.append(_side_mb(side, B))
-        mapped = jax.shard_map(
+        mapped = _shard_map(
             functools.partial(
                 _interleaved_fwd_kernel, stage_fn, sched, axis_name, v,
                 with_aux=with_aux, aux_extra_axes=tuple(extra_manual_axes),
@@ -1115,7 +1116,7 @@ def _make_interleaved_loss_fn(
         if side:
             in_specs.append(P() if side_spec is None else side_spec)
             args.append(_side_mb(side, B))
-        mapped = jax.shard_map(
+        mapped = _shard_map(
             functools.partial(
                 _pipeline_interleaved_bwd_kernel, stage_fn, sched, axis_name, v,
                 extra_manual_axes=tuple(extra_manual_axes), with_aux=with_aux,
@@ -1286,7 +1287,7 @@ def make_pipeline_loss_fn(
             )
             in_specs.append(P() if side_spec is None else side_spec)
             args.append(side_mb)
-        mapped = jax.shard_map(
+        mapped = _shard_map(
             kernel, mesh=mesh,
             in_specs=tuple(in_specs),
             out_specs=(specs_params, x_spec, _ds_out_specs(side, side_spec)),

@@ -26,6 +26,7 @@ from jax import lax
 from ..ops.flash_attention import flash_attention
 from ..ops.ring_attention import ring_attention
 from ..utils.constants import SEQUENCE_AXIS
+from ..utils.jax_compat import axis_size as _axis_size, shard_map as _shard_map
 
 __all__ = [
     "ulysses_attention",
@@ -55,7 +56,7 @@ def _a2a_ppermute(x, axis_name, split_axis: int, concat_axis: int):
     use — lowers in seconds): this is the workaround that lets ulysses run under
     schedule='1f1b' and virtual stages.
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     chunks = jnp.stack(jnp.split(x, n, axis=split_axis))  # [n, ...chunk...]
     # Rotate the full stack around the ring. After hop s, member i holds the stack that
@@ -109,7 +110,7 @@ def ulysses_attention(
     indexing, so the payload shrinks by H/K vs repeating. Otherwise (K < n after split)
     kv is repeated up to H first — correct, just bigger.
     """
-    n = lax.axis_size(axis_name)
+    n = _axis_size(axis_name)
     H, K = q.shape[2], k.shape[2]
     if H % n != 0:
         raise ValueError(f"ulysses needs n_heads ({H}) divisible by sp size ({n})")
@@ -230,7 +231,7 @@ def make_sp_attention(mesh, mode: str = "ring", axis_name: str = SEQUENCE_AXIS, 
         # mode re-derives what it needs (ring rotates the kv slice, ulysses/allgather
         # gather the full row) from its local slice.
         packed = segment_ids is not None
-        mapped = jax.shard_map(
+        mapped = _shard_map(
             (lambda q, k, v, seg: fn(q, k, v, segment_ids=seg)) if packed else fn,
             mesh=mesh,
             in_specs=(spec, spec, spec) + ((seg_spec,) if packed else ()),

@@ -224,7 +224,7 @@ class ContinuousBatcher:
     """
 
     def __init__(self, params, cfg, max_slots: int = 8, max_len: int = 512,
-                 prompt_bucket: int = 64, prefix_cache: int = 0):
+                 prompt_bucket: int = 64, prefix_cache: int = 0, telemetry=None):
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -246,18 +246,47 @@ class ContinuousBatcher:
         self._prefix_reg: "OrderedDict[bytes, object]" = OrderedDict()
         self.prefix_hits = 0
         self.prefix_misses = 0
+        # Admission/eviction counters + the step-level telemetry pipeline
+        # (``accelerate_tpu.telemetry.Telemetry``): when attached, every decode step
+        # emits a serving record through the SAME sinks the train step uses —
+        # stats() stops being fire-and-forget.
+        self.telemetry = telemetry
+        self.admitted = 0   # requests that entered a slot (prefill ran)
+        self.evicted = 0    # slot frees: finished (EOS/max_new_tokens) requests
 
     # ------------------------------------------------------------------ user API
     def stats(self) -> dict:
-        """Engine observability snapshot: queue depth, busy lanes, prefix-cache counters."""
+        """Engine observability snapshot: queue depth, busy lanes, admission/eviction
+        totals, prefix-cache counters."""
+        active = sum(r is not None for r in self.slot_req)
         return {
             "queued": len(self.queue),
-            "active_slots": sum(r is not None for r in self.slot_req),
+            "active_slots": active,
             "max_slots": self.max_slots,
+            "slot_occupancy": active / self.max_slots,
+            "admitted": self.admitted,
+            "evicted": self.evicted,
             "prefix_entries": len(self._prefix_reg),
             "prefix_hits": self.prefix_hits,
             "prefix_misses": self.prefix_misses,
         }
+
+    def _emit_telemetry(self, extra: Optional[dict] = None) -> None:
+        """Push a serving counter record through the telemetry pipeline (no-op when
+        no enabled Telemetry is attached — the hot loop pays one attribute check)."""
+        tel = self.telemetry
+        if tel is None or not tel.enabled:
+            return
+        from .telemetry import TELEMETRY_REV
+
+        record = {
+            "schema": "accelerate_tpu.telemetry.serving/v1",
+            "telemetry_rev": TELEMETRY_REV,
+            **self.stats(),
+        }
+        if extra:
+            record.update(extra)
+        tel.emit(record)
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = None,
@@ -304,6 +333,8 @@ class ContinuousBatcher:
         finished_at_admit = self._admit()
         active = [i for i, r in enumerate(self.slot_req) if r is not None]
         if not active:
+            if finished_at_admit:
+                self._emit_telemetry()  # admissions alone still move the counters
             return finished_at_admit
         greedy, logits, self.cache = _decode_step(
             self.params, self.cache, jnp.asarray(self.tokens),
@@ -330,12 +361,20 @@ class ContinuousBatcher:
                 req.done = True
                 finished.append(req)
                 self.slot_req[i] = None  # slot frees; cache row overwritten on next admit
+        self.evicted += len(finished)
+        self._emit_telemetry()
         # Report in submission order (uid is the admission counter), not slot order —
         # slot assignment is an engine detail a client should never observe.
         return sorted(finished_at_admit + finished, key=lambda r: r.uid)
 
     def run(self, report_throughput: bool = False):
-        """Drain queue + active slots; returns finished requests (and tokens/s)."""
+        """Drain queue + active slots; returns finished requests (and tokens/s).
+
+        ``report_throughput`` routes the aggregate through the telemetry pipeline
+        (a ``serving.throughput/v1`` record alongside the per-step counter records)
+        when one is attached, instead of any caller-side printing — and still
+        returns ``(requests, tokens_per_sec)`` for direct use.
+        """
         import time
 
         out = []
@@ -345,7 +384,19 @@ class ContinuousBatcher:
         dt = time.perf_counter() - t0
         if report_throughput:
             n_tokens = sum(len(r.tokens) for r in out)  # every request drains in run()
-            return out, (n_tokens / dt if dt > 0 else float("inf"))
+            tokens_per_sec = n_tokens / dt if dt > 0 else float("inf")
+            self._emit_telemetry(
+                {
+                    "schema": "accelerate_tpu.telemetry.serving.throughput/v1",
+                    "wall_s": round(dt, 6),
+                    "tokens_generated": n_tokens,
+                    "requests_finished": len(out),
+                    "tokens_per_sec": round(tokens_per_sec, 3)
+                    if tokens_per_sec != float("inf")
+                    else None,
+                }
+            )
+            return out, tokens_per_sec
         return out
 
     # ------------------------------------------------------------------ internals
@@ -365,6 +416,7 @@ class ContinuousBatcher:
                 )
                 # graftlint: disable=recompile-hazard(slot indexes a compile-time cache row; at most max_slots variants, admission-time only)
                 self.cache = _insert_row(self.cache, row_cache, slot=slot, scan_layers=self.cfg.scan_layers)
+                self.admitted += 1
                 self.slot_req[slot] = req
                 self.positions[slot] = prefill_len  # next write = first decode slot
                 self.tokens[slot] = first
@@ -374,6 +426,7 @@ class ContinuousBatcher:
                     req.done = True
                     finished.append(req)
                     self.slot_req[slot] = None
+                    self.evicted += 1  # finished AT admission still cycled the slot
         return finished
 
     def _prefill(self, prompt: np.ndarray):

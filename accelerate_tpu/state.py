@@ -39,6 +39,7 @@ from .utils.dataclasses import (
     GradientAccumulationPlugin,
     MixedPrecisionPolicy,
     PrecisionType,
+    TelemetryConfig,
 )
 from .utils.environment import parse_choice_from_env, parse_flag_from_env
 
@@ -338,6 +339,7 @@ class AcceleratorState:
         sp_plugin=None,
         ep_plugin=None,
         megatron_lm_plugin=None,
+        telemetry_config: Optional[TelemetryConfig] = None,
         _from_accelerator: bool = False,
         **kwargs,
     ):
@@ -362,6 +364,12 @@ class AcceleratorState:
         self.sp_plugin = sp_plugin
         self.ep_plugin = ep_plugin
         self.megatron_lm_plugin = megatron_lm_plugin
+        # Telemetry rides on the state singleton (like the precision policy) so every
+        # layer — Accelerator, serving, bench consumers — reads ONE resolved config;
+        # the default constructor applies the ACCELERATE_TELEMETRY env override.
+        self.telemetry_config = (
+            telemetry_config if telemetry_config is not None else TelemetryConfig()
+        )
         from .parallel.mesh import MeshConfig, build_mesh
 
         no_plugins = all(

@@ -1,0 +1,49 @@
+"""Step-level telemetry: trustworthy in-framework metrics (L9).
+
+This package turns the hard-won bench_rev-2 measurement lessons (PERF_NOTES.md: a
+post-compile allocator transient understated every round-1..4 scoring number ~2.4x;
+a 128 MB host fetch was once timed as device work) into a reusable pipeline instead
+of bench-script folklore:
+
+- :func:`fence` / :class:`StepTimer` — timing correct by construction (1-element
+  fenced sync, monotonic clock, wall/dispatch/fence split).
+- :class:`SteadyStateDetector` — the rev-2 warm-until-steady rule; transients are
+  labeled (``warmup_steps_detected``), never averaged in.
+- :class:`CompileMonitor` — XLA recompile count + cumulative compile seconds via
+  ``jax.monitoring`` (graceful no-op where unsupported).
+- :func:`device_memory_stats` — live/peak HBM bytes from the allocator ledger.
+- :func:`derived_rates` / :data:`PEAK_TFLOPS` — MFU, tokens/sec, examples/sec from a
+  static FLOP model (bench.py consumes the same table).
+- :class:`ScheduledProfiler` — ``ProfileKwargs.schedule_option`` wait/warmup/active/
+  repeat windows over ``jax.profiler.start_trace``/``stop_trace``.
+- :class:`Telemetry` — the aggregate the ``Accelerator`` carries; per-step records
+  flow to JSONL + all configured trackers. Off by default; zero host syncs when off.
+
+Enable via ``Accelerator(telemetry_config=TelemetryConfig(enabled=True, ...))`` or
+``ACCELERATE_TELEMETRY=1`` in the environment (docs/telemetry.md).
+"""
+
+from .compile_monitor import CompileMonitor, compile_label
+from .core import STEP_RECORD_SCHEMA, Telemetry
+from .derived import PEAK_TFLOPS, derived_rates, peak_tflops
+from .memory import device_memory_stats
+from .profiler import ScheduledProfiler
+from .steady import SteadyStateDetector, TELEMETRY_REV
+from .timing import StepTimer, StepTiming, fence
+
+__all__ = [
+    "CompileMonitor",
+    "compile_label",
+    "STEP_RECORD_SCHEMA",
+    "Telemetry",
+    "PEAK_TFLOPS",
+    "derived_rates",
+    "peak_tflops",
+    "device_memory_stats",
+    "ScheduledProfiler",
+    "SteadyStateDetector",
+    "TELEMETRY_REV",
+    "StepTimer",
+    "StepTiming",
+    "fence",
+]

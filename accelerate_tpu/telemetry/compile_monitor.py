@@ -1,0 +1,141 @@
+"""XLA compile-event counters via ``jax.monitoring`` listeners.
+
+Recompiles are the silent throughput killer under jit: a shape or dtype drifting
+per step turns every step into a multi-second compile, and nothing in the training
+loop says so. This monitor counts backend-compile events and their cumulative
+seconds, with optional per-label attribution (the telemetry step scope labels the
+train step, so a recompile storm points at the function that caused it).
+
+``jax.monitoring`` has no public unregister, so ONE module-level dispatcher is
+registered lazily and live monitors subscribe/unsubscribe from it — starting and
+stopping monitors never leaks listeners. Environments whose jax lacks the
+monitoring API degrade to a no-op monitor (``supported=False``, all counters 0).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+__all__ = ["CompileMonitor", "compile_label"]
+
+#: The duration event jax records around every XLA backend compile (traced-jit cache
+#: misses fire it; cache hits do not).
+COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_lock = threading.Lock()
+_monitors: list = []  # live CompileMonitor instances
+_dispatcher_registered = False
+_label_local = threading.local()  # .value: current attribution label or None
+
+
+def _current_label() -> Optional[str]:
+    return getattr(_label_local, "value", None)
+
+
+class compile_label:
+    """Context manager attributing compile events fired inside it to ``name``."""
+
+    def __init__(self, name: Optional[str]):
+        self.name = name
+        self._prev: Optional[str] = None
+
+    def __enter__(self):
+        self._prev = _current_label()
+        _label_local.value = self.name
+        return self
+
+    def __exit__(self, *exc):
+        _label_local.value = self._prev
+        return False
+
+
+def _dispatch(event: str, duration_s: float, **kwargs) -> None:
+    if event != COMPILE_EVENT:
+        return
+    label = _current_label()
+    with _lock:
+        for mon in _monitors:
+            mon._record(duration_s, label)
+
+
+def _ensure_dispatcher() -> bool:
+    """Register the module dispatcher once; False when jax.monitoring is unusable.
+
+    Check and registration happen under ONE lock hold: jax.monitoring has no
+    unregister, so a check-then-act race would leave a second listener doubling
+    every compile count for the process lifetime.
+    """
+    global _dispatcher_registered
+    with _lock:
+        if _dispatcher_registered:
+            return True
+        try:
+            from jax import monitoring
+
+            monitoring.register_event_duration_secs_listener(_dispatch)
+        except Exception:  # ImportError / missing API / anything: graceful no-op
+            return False
+        _dispatcher_registered = True
+        return True
+
+
+class CompileMonitor:
+    """Counts XLA backend compiles (count + cumulative seconds, per label).
+
+    ``start()`` begins listening, ``stop()`` detaches; counters persist across stop
+    so end-of-run records can still report totals. When the running jax exposes no
+    ``jax.monitoring`` API the monitor is inert: ``supported`` is False and every
+    counter stays 0 — callers never need to branch.
+    """
+
+    def __init__(self):
+        self.count = 0
+        self.seconds = 0.0
+        self.by_label: Dict[str, Dict[str, float]] = {}
+        self.supported: Optional[bool] = None  # unknown until start()
+        self._active = False
+
+    def start(self) -> "CompileMonitor":
+        if self._active:
+            return self
+        self.supported = _ensure_dispatcher()
+        if self.supported:
+            with _lock:
+                _monitors.append(self)
+            self._active = True
+        return self
+
+    def stop(self) -> None:
+        if not self._active:
+            return
+        with _lock:
+            if self in _monitors:
+                _monitors.remove(self)
+        self._active = False
+
+    def _record(self, duration_s: float, label: Optional[str]) -> None:
+        self.count += 1
+        self.seconds += duration_s
+        if label is not None:
+            slot = self.by_label.setdefault(label, {"count": 0, "seconds": 0.0})
+            slot["count"] += 1
+            slot["seconds"] += duration_s
+
+    def snapshot(self) -> dict:
+        """Counter state as plain JSON-serializable values."""
+        return {
+            "compiles_total": self.count,
+            "compile_s_total": round(self.seconds, 6),
+            "compiles_by_label": {
+                k: {"count": v["count"], "seconds": round(v["seconds"], 6)}
+                for k, v in self.by_label.items()
+            },
+        }
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False

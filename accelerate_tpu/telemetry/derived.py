@@ -1,0 +1,83 @@
+"""Derived throughput rates: MFU, tokens/sec, examples/sec.
+
+The numbers TPU training/serving reports lead with (pjit-scaling and Gemma-serving
+papers both headline MFU and tokens/sec) — computed from a *static* per-step FLOP
+cost and a fenced step time, never from device-side counters (which would add host
+syncs to the hot path).
+
+``PEAK_TFLOPS`` is the single source of truth for datasheet bf16 peaks; bench.py
+imports it from here. Deliberately jax-free at module level so the table is usable
+before (or without) backend init — a dead TPU tunnel hangs on first device touch.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["PEAK_TFLOPS", "peak_tflops", "derived_rates"]
+
+#: Peak dense bf16 TFLOP/s per chip by device kind (public cloud.google.com/tpu docs;
+#: per-chip, i.e. both cores/tensorcores of the chip where applicable).
+PEAK_TFLOPS = {
+    "TPU v2": 22.5,
+    "TPU v3": 61.5,
+    "TPU v4": 275.0,
+    "TPU v5 lite": 196.6,
+    "TPU v5e": 196.6,
+    "TPU v5p": 459.0,
+    "TPU v5": 459.0,
+    "TPU v6 lite": 918.0,
+    "TPU v6e": 918.0,
+    "cpu": 0.5,  # so a CPU fallback run still yields a finite (meaningless) MFU
+}
+
+#: The BASELINE.md hardware assumed when the device kind matches nothing (v5e).
+DEFAULT_PEAK_TFLOPS = 196.6
+
+
+def peak_tflops(device=None, device_kind: Optional[str] = None) -> float:
+    """Datasheet bf16 peak for a device (longest device-kind match wins:
+    "TPU v5 lite" over "TPU v5")."""
+    if device_kind is None:
+        device_kind = str(getattr(device, "device_kind", "cpu"))
+    kind = device_kind.lower()
+    best = None
+    for key, val in PEAK_TFLOPS.items():
+        if key.lower() in kind and (best is None or len(key) > best[0]):
+            best = (len(key), val)
+    return best[1] if best else DEFAULT_PEAK_TFLOPS
+
+
+def derived_rates(
+    step_time_s: float,
+    *,
+    tokens_per_step: Optional[float] = None,
+    examples_per_step: Optional[float] = None,
+    flops_per_step: Optional[float] = None,
+    peak_flops: Optional[float] = None,
+    device=None,
+    n_chips: int = 1,
+) -> dict:
+    """Per-chip rates for one step window; absent inputs yield absent columns.
+
+    ``flops_per_step`` is the static model cost (e.g. ``6N + 6LSD`` per token times
+    tokens/step — the caller's accounting convention, kept out of this module so the
+    MFU history stays tied to one documented FLOP model). ``peak_flops`` (FLOP/s)
+    defaults to the datasheet peak of ``device``.
+    """
+    out: dict = {}
+    if step_time_s <= 0:
+        return out
+    chips = max(n_chips, 1)
+    if tokens_per_step is not None:
+        out["tokens_per_sec_per_chip"] = tokens_per_step / step_time_s / chips
+    if examples_per_step is not None:
+        out["examples_per_sec_per_chip"] = examples_per_step / step_time_s / chips
+    if flops_per_step is not None:
+        tflops = flops_per_step / step_time_s / chips / 1e12
+        out["achieved_tflops_per_chip"] = tflops
+        if peak_flops is None:
+            peak_flops = peak_tflops(device) * 1e12
+        out["peak_tflops_assumed"] = peak_flops / 1e12
+        out["mfu"] = tflops * 1e12 / peak_flops
+    return out

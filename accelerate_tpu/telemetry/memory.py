@@ -1,0 +1,58 @@
+"""Device memory counters — live/peak HBM bytes from the runtime allocator.
+
+``jax.Device.memory_stats()`` is the allocator's own ledger (bytes_in_use,
+peak_bytes_in_use, bytes_limit on TPU). Reading it is a host-side RPC-free call —
+no device sync, safe to sample per step. Backends without the ledger (the CPU
+simulator returns None or raises) degrade to an empty dict, so records simply omit
+memory columns there instead of breaking the pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["device_memory_stats"]
+
+#: The allocator keys worth a per-step column (full stats() has ~15 noisy pool keys).
+_KEYS = (
+    "bytes_in_use",
+    "peak_bytes_in_use",
+    "bytes_limit",
+    "num_allocs",
+    "largest_alloc_size",
+)
+
+
+def device_memory_stats(device=None, device_index: int = 0) -> dict:
+    """Allocator counters for one local device; ``{}`` when the backend has none."""
+    import jax
+
+    if device is None:
+        local = jax.local_devices()
+        if not local or device_index >= len(local):
+            return {}
+        device = local[device_index]
+    try:
+        stats = device.memory_stats()
+    except Exception:  # CPU/interpret backends: no ledger
+        return {}
+    if not stats:
+        return {}
+    out = {k: int(stats[k]) for k in _KEYS if k in stats}
+    # Some backends use slightly different peak key names; keep the record schema stable.
+    if "peak_bytes_in_use" not in out:
+        for alt in ("peak_bytes", "max_bytes_in_use"):
+            if alt in stats:
+                out["peak_bytes_in_use"] = int(stats[alt])
+                break
+    return out
+
+
+def memory_fraction_used(stats: Optional[dict] = None, device=None) -> Optional[float]:
+    """live/limit fraction when both counters exist (None otherwise)."""
+    if stats is None:
+        stats = device_memory_stats(device)
+    used, limit = stats.get("bytes_in_use"), stats.get("bytes_limit")
+    if used is None or not limit:
+        return None
+    return used / limit

@@ -1,0 +1,195 @@
+"""Scheduled profiler windows: ``ProfileKwargs.schedule_option`` made real.
+
+The reference drives ``torch.profiler.profile`` with a
+``schedule(skip_first/wait/warmup/active/repeat)``; until this module, our
+``ProfileKwargs.schedule_option`` was a dead knob (the old ``Accelerator.profile``
+traced the whole block unconditionally). ``ScheduledProfiler`` implements the same
+step-counted windows over ``jax.profiler.start_trace``/``stop_trace``: call
+:meth:`step` once per train step and traces cover exactly the active windows —
+each cycle's trace lands in its own ``cycle<N>`` subdirectory (TensorBoard/
+perfetto-compatible, XLA HLO + device timelines included).
+
+jax's profiler has no warmup phase to arm, so ``warmup`` steps are counted but
+untraced — they exist to keep schedules copy-pastable from torch code and to hold
+the active window off the still-settling steps (see :mod:`.steady`).
+
+``profile_memory`` is also real here: at the end of each active window a device
+memory profile (pprof format, ``jax.profiler.save_device_memory_profile``) is
+written next to the trace.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+__all__ = ["ScheduledProfiler"]
+
+#: schedule_option keys accepted (the torch ``torch.profiler.schedule`` signature).
+SCHEDULE_KEYS = ("wait", "warmup", "active", "repeat", "skip_first")
+
+
+def validate_schedule_option(schedule: dict) -> dict:
+    """Normalize/validate a ``schedule_option`` dict; raises on unknown keys or
+    non-sensible values (an accepted-but-ignored schedule is worse than an error)."""
+    unknown = sorted(set(schedule) - set(SCHEDULE_KEYS))
+    if unknown:
+        raise ValueError(
+            f"schedule_option keys {unknown} are not supported; expected a subset of "
+            f"{list(SCHEDULE_KEYS)} (torch.profiler.schedule semantics)"
+        )
+    out = {k: int(schedule.get(k, 0)) for k in SCHEDULE_KEYS}
+    if not schedule.get("active"):
+        raise ValueError("schedule_option needs active >= 1 (steps per traced window)")
+    for key in ("wait", "warmup", "active", "repeat", "skip_first"):
+        if out[key] < 0:
+            raise ValueError(f"schedule_option[{key!r}] must be >= 0, got {out[key]}")
+    return out
+
+
+class ScheduledProfiler:
+    """Windowed ``jax.profiler`` traces around a step loop.
+
+    ``skip_first`` steps are ignored once, then cycles of ``wait`` idle + ``warmup``
+    untraced + ``active`` traced steps run ``repeat`` times (``repeat=0`` = cycle
+    until :meth:`close`). Call :meth:`step` AFTER each train step — trace start/stop
+    happen between steps, so a window always covers whole steps.
+    """
+
+    def __init__(
+        self,
+        trace_dir: str,
+        wait: int = 0,
+        warmup: int = 0,
+        active: int = 1,
+        repeat: int = 1,
+        skip_first: int = 0,
+        profile_memory: bool = False,
+        on_trace_ready: Optional[Callable[[str], None]] = None,
+    ):
+        validate_schedule_option(
+            {"wait": wait, "warmup": warmup, "active": active, "repeat": repeat,
+             "skip_first": skip_first}
+        )
+        self.trace_dir = trace_dir
+        self.wait = wait
+        self.warmup = warmup
+        self.active = active
+        self.repeat = repeat
+        self.skip_first = skip_first
+        self.profile_memory = profile_memory
+        self.on_trace_ready = on_trace_ready
+        self._step = 0          # completed steps observed
+        self._cycle = 0         # completed + current cycle index
+        self._tracing = False
+        self._closed = False
+        self.traces_written: list[str] = []
+        self._sync_to_next_phase()
+
+    @classmethod
+    def from_profile_kwargs(cls, handler, trace_dir: Optional[str] = None):
+        """Build from a ``ProfileKwargs`` whose ``schedule_option`` is set."""
+        schedule = validate_schedule_option(handler.schedule_option or {})
+        trace_dir = trace_dir or handler.output_trace_dir
+        if trace_dir is None:
+            import tempfile
+
+            trace_dir = tempfile.mkdtemp(prefix="accelerate_tpu_trace_")
+        return cls(
+            trace_dir=trace_dir,
+            profile_memory=handler.profile_memory,
+            on_trace_ready=handler.on_trace_ready,
+            **{k: v for k, v in schedule.items()},
+        )
+
+    # ------------------------------------------------------------------ internals
+    @property
+    def _cycle_len(self) -> int:
+        return self.wait + self.warmup + self.active
+
+    def _phase_of(self, step_index: int) -> str:
+        """Phase of step ``step_index`` (0-based, after skip_first removal)."""
+        if step_index < self.skip_first:
+            return "skip"
+        idx = step_index - self.skip_first
+        cycle, pos = divmod(idx, self._cycle_len)
+        if self.repeat and cycle >= self.repeat:
+            return "done"
+        if pos < self.wait:
+            return "wait"
+        if pos < self.wait + self.warmup:
+            return "warmup"
+        return "active"
+
+    def _cycle_of(self, step_index: int) -> int:
+        return max(step_index - self.skip_first, 0) // self._cycle_len
+
+    def _start(self) -> None:
+        import jax
+
+        path = os.path.join(self.trace_dir, f"cycle{self._cycle}")
+        os.makedirs(path, exist_ok=True)
+        jax.profiler.start_trace(path)
+        self._tracing = True
+        self._active_path = path
+
+    def _stop(self) -> None:
+        import jax
+
+        jax.profiler.stop_trace()
+        self._tracing = False
+        path = self._active_path
+        if self.profile_memory:
+            try:
+                jax.profiler.save_device_memory_profile(
+                    os.path.join(path, "device_memory.prof")
+                )
+            except Exception:  # backends without a memory profile: trace still stands
+                pass
+        self.traces_written.append(path)
+        if self.on_trace_ready is not None:
+            self.on_trace_ready(path)
+
+    def _sync_to_next_phase(self) -> None:
+        """Open/close the trace so the NEXT step executes under the right phase."""
+        phase = self._phase_of(self._step)
+        if phase == "active" and self._tracing and self._cycle != self._cycle_of(self._step):
+            # wait=warmup=0 back-to-back cycles: split the trace at the cycle edge.
+            self._stop()
+        if phase == "active" and not self._tracing and not self._closed:
+            self._cycle = self._cycle_of(self._step)
+            self._start()
+        elif phase != "active" and self._tracing:
+            self._stop()
+
+    # ------------------------------------------------------------------- user API
+    @property
+    def tracing(self) -> bool:
+        return self._tracing
+
+    @property
+    def done(self) -> bool:
+        """All ``repeat`` cycles completed (never True for repeat=0)."""
+        return self._phase_of(self._step) == "done"
+
+    def step(self) -> None:
+        """Advance one completed train step; starts/stops traces at window edges."""
+        if self._closed:
+            return
+        self._step += 1
+        self._sync_to_next_phase()
+
+    def close(self) -> None:
+        """Stop any open trace; further ``step`` calls are no-ops."""
+        if self._closed:
+            return
+        if self._tracing:
+            self._stop()
+        self._closed = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

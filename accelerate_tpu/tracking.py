@@ -41,6 +41,7 @@ __all__ = [
     "ClearMLTracker",
     "DVCLiveTracker",
     "filter_trackers",
+    "log_telemetry_record",
     "on_main_process",
 ]
 
@@ -657,6 +658,37 @@ def filter_trackers(
             tracker.store_init_configuration(config)
         trackers.append(tracker)
     return trackers
+
+
+def log_telemetry_record(
+    trackers: list, record: dict, step: Optional[int] = None
+) -> None:
+    """Fan one telemetry record (``accelerate_tpu.telemetry``) out to ``trackers``.
+
+    The JSONL tracker receives the raw record — its file round-trips the full nested
+    schema (the run-directory artifact the telemetry pipeline promises). Scalar
+    backends (tensorboard/wandb/mlflow/...) receive it flattened to
+    ``telemetry/<column>`` float/int keys, dropping non-scalar fields their APIs
+    would reject. A tracker raising never kills the training loop — observability
+    must not take down the thing it observes.
+    """
+    flat = {
+        k: v
+        for k, v in _flatten_scalars(record, prefix="telemetry/").items()
+        if isinstance(v, (int, float, bool)) and k != "telemetry/schema"
+    }
+    for tracker in trackers:
+        try:
+            if isinstance(tracker, JSONLTracker):
+                tracker.log(dict(record), step=step)
+            elif flat:
+                tracker.log(flat, step=step)
+        except Exception:  # noqa: BLE001 — a sink failure is a log line, not a crash
+            logger.warning(
+                "tracker %r failed to log a telemetry record; continuing",
+                getattr(tracker, "name", tracker),
+                exc_info=True,
+            )
 
 
 def _flatten_scalars(values: dict, prefix: str = "") -> dict:

@@ -282,20 +282,78 @@ class GradientAccumulationPlugin(KwargsHandler):
 class ProfileKwargs(KwargsHandler):
     """Profiler configuration → ``jax.profiler`` (reference ``dataclasses.py:436``).
 
-    The reference builds a ``torch.profiler.profile`` with a wait/warmup/active schedule; we
-    drive ``jax.profiler.start_trace``/``stop_trace`` with the same schedule semantics and write
-    a TensorBoard/perfetto-compatible trace directory.
+    ``schedule_option`` is the torch ``torch.profiler.schedule`` dict
+    (``{"wait", "warmup", "active", "repeat", "skip_first"}``): when set,
+    ``Accelerator.profile`` yields a ``telemetry.ScheduledProfiler`` — call its
+    ``step()`` once per train step and ``jax.profiler`` traces cover exactly the
+    active windows, one ``cycle<N>`` trace directory per repeat. Without a schedule
+    the whole block is traced (the pre-schedule behavior). ``profile_memory``
+    additionally writes a pprof device-memory profile at each window end.
     """
 
-    activities: Optional[list[str]] = None  # graftlint: disable=dead-knob(torch-profiler parity; jax.profiler trace captures device timelines unconditionally)
-    schedule_option: Optional[dict[str, int]] = None  # graftlint: disable=dead-knob(torch-profiler parity; jax.profiler trace captures device timelines unconditionally)
+    activities: Optional[list[str]] = None  # graftlint: disable=dead-knob(torch-profiler parity; a jax trace always captures host+device+HLO — there is no activity selection to apply)
+    schedule_option: Optional[dict[str, int]] = None
     on_trace_ready: Optional[Callable] = None
-    record_shapes: bool = False  # graftlint: disable=dead-knob(torch-profiler parity; jax.profiler trace captures device timelines unconditionally)
-    profile_memory: bool = False  # graftlint: disable=dead-knob(torch-profiler parity; jax.profiler trace captures device timelines unconditionally)
-    with_stack: bool = False  # graftlint: disable=dead-knob(torch-profiler parity; jax.profiler trace captures device timelines unconditionally)
-    with_flops: bool = False  # graftlint: disable=dead-knob(torch-profiler parity; jax.profiler trace captures device timelines unconditionally)
-    with_modules: bool = False  # graftlint: disable=dead-knob(torch-profiler parity; jax.profiler trace captures device timelines unconditionally)
+    record_shapes: bool = False  # graftlint: disable=dead-knob(torch-profiler parity; the xplane trace records shapes unconditionally)
+    profile_memory: bool = False
+    with_stack: bool = False  # graftlint: disable=dead-knob(torch-profiler parity; jax traces have no python-stack mode to toggle)
+    with_flops: bool = False  # graftlint: disable=dead-knob(torch-profiler parity; the xplane trace carries HLO cost analysis unconditionally)
+    with_modules: bool = False  # graftlint: disable=dead-knob(torch-profiler parity; module attribution is a torch.nn concept with no pytree analog)
     output_trace_dir: Optional[str] = None
+
+    def __post_init__(self):
+        if self.schedule_option is not None:
+            # Fail at construction, not at the first profiled step: an invalid
+            # schedule silently accepted is the dead-knob bug in a new costume.
+            from ..telemetry.profiler import validate_schedule_option
+
+            validate_schedule_option(self.schedule_option)
+
+
+@dataclass
+class TelemetryConfig(KwargsHandler):
+    """Step-level telemetry pipeline config (``accelerate_tpu.telemetry``).
+
+    **Off by default and free when off**: the disabled path adds two attribute reads
+    per train step — no host syncs, no listeners, no files (asserted by
+    ``tests/test_telemetry.py``). Enable explicitly or via ``ACCELERATE_TELEMETRY=1``
+    (explicit arg > env > built-in, the §5 priority order; ``None`` is the unset
+    sentinel). ``jsonl_dir`` (env ``ACCELERATE_TELEMETRY_DIR``) makes the pipeline
+    self-sufficient: records land in ``<jsonl_dir>/telemetry.jsonl`` even with no
+    tracker configured.
+
+    ``steady_*`` parameterize the rev-2 steady-state rule (PERF_NOTES.md): warm
+    until ``steady_k`` consecutive steps agree within ``steady_rtol``, cap
+    ``steady_cap`` steps. ``flops_per_step``/``tokens_per_step``/``examples_per_step``
+    are static per-step costs for the derived rates; tokens/examples fall back to
+    host-visible batch shapes, MFU stays absent until a FLOP cost is declared.
+    """
+
+    enabled: Optional[bool] = None          # None → env ACCELERATE_TELEMETRY > False
+    jsonl_dir: Optional[str] = None         # None → env ACCELERATE_TELEMETRY_DIR
+    steady_k: int = 2
+    steady_rtol: float = 0.10
+    steady_cap: int = 50                    # 0 = never cap the warmup
+    compile_events: bool = True             # jax.monitoring compile counters
+    memory_stats: bool = True               # device allocator live/peak bytes
+    device_index: int = 0                   # which local device to sample
+    max_records: int = 4096                 # in-memory history cap (JSONL is unbounded)
+    merge_into_log: bool = True             # Accelerator.log gains telemetry/ columns
+    flops_per_step: Optional[float] = None
+    tokens_per_step: Optional[float] = None
+    examples_per_step: Optional[float] = None
+
+    def __post_init__(self):
+        if self.enabled is None:
+            self.enabled = parse_flag_from_env("ACCELERATE_TELEMETRY")
+        if self.jsonl_dir is None:
+            self.jsonl_dir = os.environ.get("ACCELERATE_TELEMETRY_DIR") or None
+        if self.steady_k < 2:
+            raise ValueError(f"steady_k={self.steady_k}: agreement needs >= 2 windows")
+        if self.steady_rtol <= 0:
+            raise ValueError(f"steady_rtol={self.steady_rtol} must be > 0")
+        if self.steady_cap < 0:
+            raise ValueError(f"steady_cap={self.steady_cap} must be >= 0 (0 = no cap)")
 
 
 @dataclass

@@ -38,21 +38,6 @@ from bench_timing import exc_line  # noqa: E402  (single source of truth)
 
 NORTH_STAR_MFU = 0.40  # BASELINE.md: Llama-3-8B FSDP fine-tune target on v5e
 
-# Peak dense bf16 TFLOP/s per chip by device kind (public cloud.google.com/tpu docs;
-# per-chip, i.e. both cores/tensorcores of the chip where applicable).
-PEAK_TFLOPS = {
-    "TPU v2": 22.5,
-    "TPU v3": 61.5,
-    "TPU v4": 275.0,
-    "TPU v5 lite": 196.6,
-    "TPU v5e": 196.6,
-    "TPU v5p": 459.0,
-    "TPU v5": 459.0,
-    "TPU v6 lite": 918.0,
-    "TPU v6e": 918.0,
-    "cpu": 0.5,  # so a CPU fallback run still yields a finite (meaningless) MFU
-}
-
 _TRANSIENT = ("UNAVAILABLE", "DEADLINE_EXCEEDED", "Unable to initialize backend", "Connection reset")
 
 
@@ -61,12 +46,12 @@ def _is_transient(exc: BaseException) -> bool:
 
 
 def _peak_tflops(device) -> float:
-    kind = str(getattr(device, "device_kind", "cpu")).lower()
-    best = None
-    for key, val in PEAK_TFLOPS.items():
-        if key.lower() in kind and (best is None or len(key) > best[0]):
-            best = (len(key), val)  # longest match wins ("TPU v5 lite" over "TPU v5")
-    return best[1] if best else 196.6  # assume v5e, the BASELINE.md hardware
+    """Datasheet bf16 peak — single source of truth is telemetry's table (importing
+    it loads jax modules but never initializes a backend, and this helper only runs
+    after a successful ``_init_backend`` anyway)."""
+    from accelerate_tpu.telemetry.derived import peak_tflops
+
+    return peak_tflops(device)
 
 
 class _InitTimeout(RuntimeError):
@@ -294,14 +279,14 @@ def _measured_matmul_ceiling() -> float:
     # Warm until two consecutive rounds agree within 10% (cap 4): at cold process start
     # the first dispatches pay the allocator-settling transient (the r4 bench_rev-2
     # discovery) — an unsettled probe reported a 2.3 TF/s "ceiling" under a 99 TF/s run.
-    prev = None
-    for _ in range(4):
+    # The rev-2 rule lives in ONE place now: telemetry.SteadyStateDetector.
+    from accelerate_tpu.telemetry import SteadyStateDetector
+
+    det = SteadyStateDetector(k=2, rtol=0.10, max_windows=4)
+    while not det.steady:
         t0 = time.perf_counter()
         _fence(chain(a, w))
-        dt = time.perf_counter() - t0
-        if prev is not None and abs(dt - prev) <= 0.1 * max(dt, prev):
-            break
-        prev = dt
+        det.observe(time.perf_counter() - t0)
     t0 = time.perf_counter()
     n = 3
     out = None
@@ -406,19 +391,21 @@ def run(B: int, S: int, fuse: int, preset: str | None, default_metric: str | Non
     # reported ~0.19-0.21 MFU while the SAME config measured 0.5076 the one time a
     # profiling round happened to absorb the transient (the decompose's full_adamw_f1
     # 5213 ms/step vs the 55 ms isolated apply is the same transient). Training runs for
-    # hours; a seconds-scale process-start transient doesn't belong in the metric. Warm
-    # until two consecutive rounds agree within 10% (cap 5), then time.
-    prev = None
+    # hours; a seconds-scale process-start transient doesn't belong in the metric.
+    # The warm-until-steady rule (two consecutive rounds within 10%, cap 5) is the
+    # library's SteadyStateDetector — one rev-2 implementation shared with the
+    # in-framework telemetry; tests/test_telemetry.py pins bench/library agreement.
+    from accelerate_tpu.telemetry import TELEMETRY_REV, SteadyStateDetector
+
     settle_rounds = 0 if preset else int(os.environ.get("BENCH_MAX_SETTLE_ROUNDS", "5"))
-    for _ in range(settle_rounds):
-        t0 = time.perf_counter()
-        state, metrics = step(state, stacked)
-        _ = _force_loss(metrics)
-        dt_round = time.perf_counter() - t0
-        settled = prev is not None and abs(dt_round - prev) <= 0.1 * max(dt_round, prev)
-        prev = dt_round
-        if settled:
-            break
+    settle = None
+    if settle_rounds:
+        settle = SteadyStateDetector(k=2, rtol=0.10, max_windows=settle_rounds)
+        while not settle.steady:
+            t0 = time.perf_counter()
+            state, metrics = step(state, stacked)
+            _ = _force_loss(metrics)
+            settle.observe(time.perf_counter() - t0)
 
     n_rounds = 3
     profile_dir = os.environ.get("BENCH_PROFILE")
@@ -503,6 +490,13 @@ def run(B: int, S: int, fuse: int, preset: str | None, default_metric: str | Non
         out["preset"] = preset
     out["bench_rev"] = _BENCH_REV  # in the printed row too: sweep rows must carry the
     # methodology rev, or adoption would compare values across incompatible timing.
+    # The library detector now owns the rev-2 semantics; stamp its revision so a
+    # telemetry-methodology bump is visible in every row independently of bench_rev.
+    out["telemetry_rev"] = TELEMETRY_REV
+    if settle is not None:
+        out["warmup_rounds_detected"] = settle.warmup_steps_detected
+        if settle.capped:
+            out["warmup_capped"] = True  # never settled within the cap: label, don't hide
     print(json.dumps(out))
     _RESULT_PRINTED.set()
 

@@ -67,8 +67,15 @@ MODULES = [
     ("accelerate_tpu.utils.offload", "Disk offload"),
     ("accelerate_tpu.utils.memory", "Memory utilities"),
     ("accelerate_tpu.utils.random", "RNG control"),
+    ("accelerate_tpu.utils.jax_compat", "JAX version compatibility"),
     ("accelerate_tpu.analysis.engine", "Static analysis (graftlint) engine"),
     ("accelerate_tpu.analysis.baseline", "Static analysis ratcheting baseline"),
+    ("accelerate_tpu.telemetry.core", "Telemetry pipeline"),
+    ("accelerate_tpu.telemetry.timing", "Fenced step timing"),
+    ("accelerate_tpu.telemetry.steady", "Steady-state detection"),
+    ("accelerate_tpu.telemetry.compile_monitor", "Compile-event counters"),
+    ("accelerate_tpu.telemetry.derived", "Derived throughput rates"),
+    ("accelerate_tpu.telemetry.profiler", "Scheduled profiler windows"),
     ("accelerate_tpu.models.llama", "Llama family"),
     ("accelerate_tpu.models.lora", "LoRA fine-tuning"),
     ("accelerate_tpu.models.gpt", "GPT family"),

@@ -22,6 +22,25 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Back-fill the modern mesh/shard_map API names when the host runs the 0.4.x LTS
+# line, so the suite (written against modern jax) runs on both lineages. The library
+# itself routes through accelerate_tpu/utils/jax_compat.py and parallel.mesh
+# .mesh_context — these shims exist only for the tests' direct jax.* calls.
+if not hasattr(jax, "set_mesh"):
+    jax.set_mesh = lambda mesh: mesh  # a Mesh is itself the legacy ambient context
+
+if not hasattr(jax, "shard_map"):
+    def _shard_map_compat(f, **kwargs):
+        # Delegate to the library's shim (handles check_vma→check_rep and
+        # axis_names→auto); jax_compat only imports jax, safe this early. The
+        # marker tells the shim this back-fill is NOT the modern API.
+        from accelerate_tpu.utils.jax_compat import shard_map
+
+        return shard_map(f, **kwargs)
+
+    _shard_map_compat._accelerate_tpu_compat = True
+    jax.shard_map = _shard_map_compat
+
 # Persistent compilation cache: identical HLO recompiled across tests (and across suite
 # runs) hits disk instead of XLA. First run pays full compile; reruns of the compile-heavy
 # model tests drop from tens of seconds to milliseconds (VERDICT r1 weak #7).

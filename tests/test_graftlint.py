@@ -138,6 +138,79 @@ def test_host_sync_suppressed_with_reason(tmp_path):
     assert not rule_hits(findings, "bad-suppression")
 
 
+# The telemetry fence helpers are the SANCTIONED sync points (ISSUE 2 satellite):
+# hot loops instrumented through them need no suppressions, while a raw
+# block_until_ready in the same position still fires.
+
+RAW_SYNC_IN_HOT_LOOP = """
+    import jax
+
+    def train_loop(step, state, batches):
+        for batch in batches:
+            state, metrics = step(state, batch)
+            jax.block_until_ready(metrics)     # raw sync: flagged
+            last = int(metrics["loss"][0])     # raw device subscript fetch: flagged
+        return state
+"""
+
+FENCED_TELEMETRY_HOT_LOOP = """
+    from accelerate_tpu.telemetry import fence, Telemetry
+
+    def train_loop(step, state, batches, telemetry):
+        for batch in batches:
+            state, metrics = step(state, batch)
+            fence(metrics)                          # bare import of the helper
+            last = int(fence(metrics["loss"])[0])   # post-fence 1-element read
+        return state
+
+    def decode_loop(step, tokens, cache, acc):
+        out = []
+        for t in tokens:
+            logits, cache = step(t, cache)
+            tok = int(acc.telemetry.fence(logits)[0])   # attribute-qualified
+            out.append(tok)
+        return out
+"""
+
+
+def test_host_sync_raw_block_in_hot_loop_fires(tmp_path):
+    hits = rule_hits(lint_snippet(tmp_path, RAW_SYNC_IN_HOT_LOOP), "host-sync-in-hot-path")
+    msgs = " ".join(f.message for f in hits)
+    assert len(hits) == 2
+    assert "block_until_ready" in msgs and "int(...[...])" in msgs
+
+
+def test_host_sync_telemetry_fence_is_sanctioned(tmp_path):
+    """The same int(...[0]) fetch that fires above is sanctioned when the value went
+    through the telemetry fence first (qualified-name allowlist)."""
+    findings = lint_snippet(tmp_path, FENCED_TELEMETRY_HOT_LOOP)
+    assert not rule_hits(findings, "host-sync-in-hot-path")
+
+
+def test_host_sync_skips_telemetry_package_internals(tmp_path):
+    """The fence implementation itself (block_until_ready + 1-element np.asarray)
+    lives under accelerate_tpu/telemetry/ and is allowlisted by that qualified
+    path; the same code anywhere else still fires."""
+    src = """
+    import numpy as np
+    import jax
+
+    def fence_train_hot(x):
+        for _ in range(3):
+            jax.block_until_ready(x)
+            np.asarray(x)
+        return x
+    """
+    sanctioned_dir = tmp_path / "accelerate_tpu" / "telemetry"
+    sanctioned_dir.mkdir(parents=True)
+    inside = lint_snippet(
+        tmp_path, src, name="accelerate_tpu/telemetry/timing_impl.py"
+    )
+    assert not rule_hits(inside, "host-sync-in-hot-path")
+    outside = lint_snippet(tmp_path, src, name="elsewhere.py")
+    assert rule_hits(outside, "host-sync-in-hot-path")
+
+
 # ----------------------------------------------------------------------- rng-key-reuse
 
 BAD_RNG = """
