@@ -35,6 +35,7 @@ from .utils import (
     GradientAccumulationPlugin,
     MixedPrecisionPolicy,
     ProjectConfiguration,
+    CompileCacheConfig,
     TelemetryConfig,
     infer_auto_device_map,
     is_rich_available,

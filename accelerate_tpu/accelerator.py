@@ -169,6 +169,26 @@ class _TrainStep:
             tel._step_end(fence_on=metrics, batch=batch)
         return state, metrics
 
+    def warm(self, state: TrainState, batch) -> list:
+        """Prime the AOT compile cache for this step's programs without executing
+        (``compile_cache.warmup``). Mirrors ``_dispatch``'s argument shaping — the
+        cpu_offload opt-state detach included — so the fingerprints match live
+        steps. Returns the manifest entries (empty when the cache is disabled)."""
+        acc = self.accelerator
+        if not hasattr(self.apply_fn, "warm"):
+            return []
+        offload = acc._opt_device_shardings is not None
+        entries = []
+        with mesh_context(acc.mesh):
+            apply_state = acc._offload_fetch(state, opt=True)
+            entries.append(self.apply_fn.warm(apply_state, batch))
+            if acc.gradient_accumulation_steps > 1:
+                micro_state = acc._offload_fetch(state, opt=False)
+                if offload:
+                    micro_state = micro_state.replace(opt_state=None)
+                entries.append(self.micro_fn.warm(micro_state, batch))
+        return entries
+
 
 class _FusedTrainStep:
     """M train steps per dispatch via ``lax.scan`` (``build_train_step(fused_steps=M)``).
@@ -248,6 +268,18 @@ class _FusedTrainStep:
             )
         return state, metrics
 
+    def warm(self, state: TrainState, batches) -> list:
+        """Prime the AOT compile cache for the fused program without executing
+        (``compile_cache.warmup``); batches take the same list/stacked forms as
+        ``__call__``. Returns the manifest entries (empty when cache disabled)."""
+        acc = self.accelerator
+        if not hasattr(self.fused_fn, "warm"):
+            return []
+        stacked = self._stack(batches)
+        with mesh_context(acc.mesh):
+            fetched = acc._offload_fetch(state, opt=True)
+            return [self.fused_fn.warm(fetched, stacked)]
+
 
 class Accelerator:
     """One facade for device placement, parallelism, precision, accumulation and IO."""
@@ -276,6 +308,7 @@ class Accelerator:
         kwargs_handlers: Optional[list] = None,
         dynamo_plugin=None,
         telemetry_config=None,
+        compile_cache_config=None,
     ):
         self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
         if project_dir is not None and self.project_configuration.project_dir is None:
@@ -375,6 +408,7 @@ class Accelerator:
             ep_plugin=ep_plugin,
             megatron_lm_plugin=megatron_lm_plugin,
             telemetry_config=telemetry_config,
+            compile_cache_config=compile_cache_config,
         )
 
         # Step-level telemetry (off by default; ACCELERATE_TELEMETRY=1 or an enabled
@@ -385,6 +419,13 @@ class Accelerator:
         self.telemetry = Telemetry(self.state.telemetry_config)
         if self.telemetry.enabled:
             self.telemetry.sinks.append(self._telemetry_tracker_sink)
+
+        # Persistent AOT executable cache (off by default; ACCELERATE_COMPILE_CACHE=1
+        # or an enabled CompileCacheConfig turns it on). Disabled, wrap() is the
+        # identity and every step dispatches through plain jax.jit as before.
+        from .compile_cache import AotCache
+
+        self.compile_cache = AotCache(self.state.compile_cache_config)
 
         if ddp_kwargs is not None and ddp_kwargs.reduce_dtype is not None:
             # DDP comm_hook analog: compress cross-device gradient reductions.
@@ -687,6 +728,7 @@ class Accelerator:
             data_seed=cfg.data_seed,
             non_blocking=cfg.non_blocking,
             use_stateful_dataloader=cfg.use_stateful_dataloader,
+            prefetch_depth=cfg.prefetch_depth,
         )
         self._dataloaders.append(prepared)
         return prepared
@@ -790,6 +832,20 @@ class Accelerator:
             optimizer = self.prepare_optimizer(optimizer)
         params = self.prepare_params(params, partition_specs=partition_specs)
         opt_state = optimizer.init(params)
+        # Scalar opt-state leaves (optax step counts) come out of init on ONE device
+        # while the compiled step returns them mesh-replicated — without this commit
+        # the second step call would silently retrace (found by the ISSUE-3
+        # compiles-exactly-once regression guard: every train loop paid the compile
+        # twice). Array-valued leaves already inherit their param's sharding.
+        _replicated_scalar = _mesh_replicated(self.mesh)
+        opt_state = jax.tree_util.tree_map(
+            lambda l: jax.device_put(l, _replicated_scalar)
+            if isinstance(l, jax.Array)
+            and l.ndim == 0
+            and not isinstance(l.sharding, NamedSharding)
+            else l,
+            opt_state,
+        )
 
         from .utils.constants import FSDP_AXIS
 
@@ -1203,11 +1259,17 @@ class Accelerator:
 
                 return jax.lax.scan(body, state, batches)
 
-            jit_fused = jax.jit(fused, donate_argnums=donate_args)
+            jit_fused = self.compile_cache.wrap(
+                jax.jit(fused, donate_argnums=donate_args), "train_step.fused"
+            )
             return _FusedTrainStep(self, jit_fused, fused_steps, optimizer=optimizer)
 
-        jit_micro = jax.jit(micro_step, donate_argnums=donate_args)
-        jit_apply = jax.jit(apply_step, donate_argnums=donate_args)
+        jit_micro = self.compile_cache.wrap(
+            jax.jit(micro_step, donate_argnums=donate_args), "train_step.micro"
+        )
+        jit_apply = self.compile_cache.wrap(
+            jax.jit(apply_step, donate_argnums=donate_args), "train_step.apply"
+        )
         return _TrainStep(self, jit_micro, jit_apply, optimizer=optimizer)
 
     def build_eval_step(self, eval_fn: Callable, donate: bool = False) -> Callable:
@@ -1221,7 +1283,7 @@ class Accelerator:
                 out = cast_floating(out, jnp.float32)
             return out
 
-        jitted = jax.jit(wrapped)
+        jitted = self.compile_cache.wrap(jax.jit(wrapped), "eval_step")
         mesh = self.mesh
 
         @functools.wraps(wrapped)
@@ -1229,6 +1291,15 @@ class Accelerator:
             with mesh_context(mesh):
                 return jitted(params, batch)
 
+        def warm(params, batch):
+            # Warmup-manifest hook: prime the AOT cache for this signature without
+            # executing the eval (no-op live entry when the cache is disabled).
+            if not hasattr(jitted, "warm"):
+                return {"label": "eval_step", "key": None, "status": "live", "seconds": 0.0}
+            with mesh_context(mesh):
+                return jitted.warm(params, batch)
+
+        with_mesh.warm = warm
         return with_mesh
 
     # -------------------------------------------------------- accumulation / sync contexts

@@ -12,6 +12,7 @@ from .lint import lint_command_parser
 from .merge import merge_command_parser
 from .test import test_command_parser
 from .tpu import tpu_command_parser
+from .warmup import warmup_command_parser
 
 __all__ = ["main", "get_parser"]
 
@@ -31,6 +32,7 @@ def get_parser() -> argparse.ArgumentParser:
     merge_command_parser(subparsers=subparsers)
     test_command_parser(subparsers=subparsers)
     tpu_command_parser(subparsers=subparsers)
+    warmup_command_parser(subparsers=subparsers)
     return parser
 
 

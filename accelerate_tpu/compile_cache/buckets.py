@@ -1,0 +1,21 @@
+"""Shape-bucket helpers for serving prefill.
+
+A prompt padded to the smallest bucket of a geometric ladder compiles one
+prefill executable per *bucket* instead of one per *length* — the ladder is
+the whole compile surface, enumerable ahead of time by the warmup manifest.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+__all__ = ["pick_bucket"]
+
+
+def pick_bucket(length: int, ladder: Sequence[int]) -> Optional[int]:
+    """Smallest ladder bucket that fits ``length`` tokens, or None when the
+    prompt exceeds the largest bucket (caller falls back to chunked prefill)."""
+    for bucket in ladder:
+        if length <= bucket:
+            return bucket
+    return None

@@ -1,0 +1,83 @@
+"""Deterministic cache keys for AOT-compiled executables.
+
+The key must change whenever the *compiled artifact* could differ and must NOT
+change otherwise — a false hit executes the wrong program, a false miss just
+re-pays compile. Content-addressing on the lowered StableHLO text gets both
+almost for free: the jaxpr, abstract shapes/dtypes and sharding annotations are
+all in the text, so any change to the traced program or its layout moves the
+key. What the text does NOT carry is the environment the executable was built
+against — jax/jaxlib versions, backend platform and device kind, topology
+(device/process counts — a 4-chip executable must never load on 8), and the
+compiler flag surface — so those are hashed in alongside.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+
+import jax
+
+__all__ = ["backend_environment", "fingerprint", "signature_key"]
+
+#: Env vars that change what XLA emits; part of every fingerprint.
+_COMPILER_ENV_VARS = ("XLA_FLAGS", "LIBTPU_INIT_ARGS")
+
+
+def backend_environment() -> dict:
+    """The environment facts an executable is only valid under."""
+    import jaxlib
+
+    device = jax.devices()[0]
+    env = {
+        "jax": jax.__version__,
+        "jaxlib": jaxlib.__version__,
+        "backend": jax.default_backend(),
+        "device_kind": str(getattr(device, "device_kind", "unknown")),
+        "device_count": jax.device_count(),
+        "process_count": jax.process_count(),
+    }
+    for var in _COMPILER_ENV_VARS:
+        env[var.lower()] = os.environ.get(var, "")
+    return env
+
+
+def fingerprint(lowered_text: str, extra: str = "") -> str:
+    """Hex key for a lowered program under the current backend environment."""
+    h = hashlib.blake2b(digest_size=20)
+    h.update(lowered_text.encode())
+    for key, value in sorted(backend_environment().items()):
+        h.update(f"{key}={value};".encode())
+    if extra:
+        h.update(extra.encode())
+    return h.hexdigest()
+
+
+def signature_key(args, kwargs) -> tuple:
+    """Hashable per-call signature: abstract (aval, sharding) per array leaf
+    plus the leaf itself (or its repr when unhashable) for everything else,
+    with the pytree structure.
+
+    This is the in-memory dispatch key a :class:`~.cache.CachedFunction` pays
+    on EVERY call (so lowering/fingerprinting runs once per distinct
+    signature). Avals, shardings and treedefs hash at C level — the same
+    objects jax's own jit dispatch keys on — so no per-call string building.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs))
+    sig = []
+    for leaf in leaves:
+        if isinstance(leaf, jax.Array):
+            sig.append((leaf.aval, leaf.sharding))
+        elif hasattr(leaf, "shape") and hasattr(leaf, "dtype"):
+            # numpy arrays and ShapeDtypeStructs: shape+dtype is their full
+            # identity (checked BEFORE hashability — hash(ndarray) raises but
+            # repr'ing a large array would be the real cost).
+            sig.append((tuple(leaf.shape), str(leaf.dtype)))
+        else:
+            try:
+                hash(leaf)
+            except TypeError:
+                sig.append(repr(leaf))
+            else:
+                sig.append(leaf)
+    return (treedef, tuple(sig))

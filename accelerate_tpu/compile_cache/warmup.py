@@ -1,0 +1,180 @@
+"""Warmup manifests: enumerate + pre-compile a config's programs into the cache.
+
+``python -m accelerate_tpu warmup`` drives this. For one (model config, batch
+geometry, serving geometry) it builds the exact programs a training run or a
+serving replica would compile lazily — train micro/apply (or fused) step, eval
+step, one prefill per shape bucket, the chunk-append program, the decode step,
+the per-slot row inserts — and pushes each through ``AotCache`` WITHOUT
+executing them (``lower().compile()`` + serialize, never dispatch). A tunnel
+window or replica that starts afterwards deserializes instead of compiling:
+cold start stops scaling with program count.
+
+The resulting manifest (``<cache_dir>/warmup_manifest.json`` by default) lists
+every program's label, cache key and status — the auditable record of what a
+cache directory is warm FOR.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Optional
+
+import numpy as np
+
+from ..logging import get_logger
+from ..utils.dataclasses import CompileCacheConfig
+
+logger = get_logger(__name__)
+
+__all__ = ["build_model_config", "run_warmup", "write_manifest"]
+
+MANIFEST_SCHEMA = "accelerate_tpu.compile_cache.warmup/v1"
+MANIFEST_NAME = "warmup_manifest.json"
+
+
+def build_model_config(preset: str, seq_len: int):
+    """A llama config for ``preset`` (a ``llama.CONFIGS`` key, or ``smoke`` — the
+    bench.py CI shape) with ``max_seq`` set for the warmed geometry."""
+    import jax.numpy as jnp
+
+    from ..models import llama
+
+    if preset == "smoke":
+        cfg = dataclasses.replace(
+            llama.CONFIGS["tiny"], vocab_size=512, d_model=128, n_layers=2,
+            n_heads=4, n_kv_heads=2, d_ff=256,
+        )
+    elif preset in llama.CONFIGS:
+        cfg = llama.CONFIGS[preset]
+    else:
+        raise ValueError(
+            f"unknown preset {preset!r}; expected 'smoke' or one of "
+            f"{sorted(llama.CONFIGS)}"
+        )
+    if cfg.dtype == jnp.bfloat16 and preset == "smoke":
+        cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    return dataclasses.replace(cfg, max_seq=seq_len)
+
+
+def run_warmup(
+    *,
+    preset: str = "smoke",
+    batch_size: int = 8,
+    seq_len: int = 128,
+    fused_steps: int = 1,
+    grad_accum: int = 1,
+    mixed_precision: Optional[str] = None,
+    train: bool = True,
+    eval_step: bool = False,
+    serve: bool = False,
+    max_slots: int = 4,
+    max_len: Optional[int] = None,
+    max_new_tokens: int = 32,
+    cache_config: Optional[CompileCacheConfig] = None,
+    manifest_path: Optional[str] = None,
+) -> dict:
+    """Pre-compile the programs for one config into the AOT cache.
+
+    Returns the manifest dict (also written to ``manifest_path`` /
+    ``<cache_dir>/warmup_manifest.json``). Uses concrete dummy inputs placed
+    through the SAME data paths the real run uses (mesh-sharded batches, engine
+    cache layouts), so the fingerprints match what ``Accelerator`` /
+    ``ContinuousBatcher`` will look up.
+    """
+    from ..accelerator import Accelerator
+    from ..models import llama
+
+    config = cache_config or CompileCacheConfig(enabled=True)
+    if not config.enabled:
+        raise ValueError("warmup needs an enabled CompileCacheConfig")
+
+    cfg = build_model_config(preset, seq_len)
+    entries: list = []
+
+    accelerator = Accelerator(
+        mixed_precision=mixed_precision,
+        gradient_accumulation_steps=grad_accum,
+        compile_cache_config=config,
+    )
+    cache = accelerator.compile_cache
+    if not cache.enabled:
+        # An unsupported jax degrades the cache to live compiles — fine for a
+        # training run, but a warmup whose whole purpose is priming the cache
+        # must fail loudly, not exit 0 with an empty manifest.
+        raise RuntimeError(
+            "warmup cannot populate the compile cache: this jax exposes no "
+            "executable serialization API (jax.experimental.serialize_executable)"
+        )
+    params = llama.init_params(cfg)
+
+    eval_params = None
+    if train:
+        import optax
+
+        state = accelerator.create_train_state(params, optax.adamw(1e-4))
+        step = accelerator.build_train_step(
+            lambda p, b: llama.loss_fn(p, b, cfg),
+            max_grad_norm=1.0,
+            fused_steps=fused_steps,
+        )
+        tokens = np.zeros((batch_size, seq_len + 1), np.int32)
+        if fused_steps > 1:
+            batches = [{"tokens": tokens} for _ in range(fused_steps)]
+            entries.extend(step.warm(state, batches))
+        else:
+            from ..data_loader import assemble_global_batch
+
+            batch = assemble_global_batch({"tokens": tokens}, accelerator.mesh)
+            entries.extend(step.warm(state, batch))
+        eval_params = state.params
+    if eval_step:
+        from ..data_loader import assemble_global_batch
+
+        if eval_params is None:
+            # --no-train: prepare params exactly as create_train_state would, so
+            # the eval fingerprint matches a real run's state.params.
+            eval_params = accelerator.prepare_params(params)
+        evaluate = accelerator.build_eval_step(lambda p, b: llama.loss_fn(p, b, cfg))
+        batch = assemble_global_batch(
+            {"tokens": np.zeros((batch_size, seq_len + 1), np.int32)},
+            accelerator.mesh,
+        )
+        entries.append(evaluate.warm(eval_params, batch))
+
+    if serve:
+        from ..serving import ContinuousBatcher
+
+        engine_len = max_len if max_len is not None else seq_len
+        engine = ContinuousBatcher(
+            params, cfg, max_slots=max_slots, max_len=engine_len,
+            compile_cache=cache,
+        )
+        entries.extend(engine.warm_programs(max_new_tokens=max_new_tokens))
+
+    manifest = {
+        "schema": MANIFEST_SCHEMA,
+        "preset": preset,
+        "batch_size": batch_size,
+        "seq_len": seq_len,
+        "fused_steps": fused_steps,
+        "grad_accum": grad_accum,
+        "mixed_precision": mixed_precision,
+        "serve": serve,
+        "max_slots": max_slots,
+        "max_len": max_len if max_len is not None else seq_len,
+        "cache_dir": cache.cache_dir,
+        "cache_stats": cache.stats(),
+        "programs": [e for e in entries if e],
+    }
+    write_manifest(manifest, manifest_path or os.path.join(cache.cache_dir, MANIFEST_NAME))
+    return manifest
+
+
+def write_manifest(manifest: dict, path: str) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=2)
+    logger.info("warmup manifest written to %s (%d programs)",
+                path, len(manifest["programs"]))

@@ -20,6 +20,7 @@ are reused; torch tensors are converted to numpy on the way out).
 from __future__ import annotations
 
 import math
+from collections import deque
 from typing import Any, Callable, Iterable, Iterator, Optional, Sequence, Union
 
 import numpy as np
@@ -450,10 +451,14 @@ def assemble_global_batch(batch, device):
 class DataLoaderShard(_PreparedDataLoader):
     """Per-process sharded dataloader (reference ``data_loader.py:499``).
 
-    Iterates the underlying (already index-sharded) dataloader with a one-batch prefetch so
-    ``end_of_dataloader`` is known *before* the final batch is yielded (the reference's trick
-    at :557-587) — GradientState consumers (optimizer skip logic, ``gather_for_metrics``)
-    depend on it.
+    Iterates the underlying (already index-sharded) dataloader with a device prefetch
+    of ``prefetch_depth`` batches (default 1), so ``end_of_dataloader`` is known
+    *before* the final batch is yielded (the reference's trick at :557-587) —
+    GradientState consumers (optimizer skip logic, ``gather_for_metrics``) depend on
+    it. ``jax.device_put`` is asynchronous, so each prefetched batch's H2D transfer
+    overlaps the consumer's compute; deeper prefetch trades device memory for more
+    overlap when per-batch host work (tokenize/collate) is bursty. At most
+    ``prefetch_depth`` batches are in flight (placed but not yet yielded).
     """
 
     def __init__(
@@ -465,6 +470,7 @@ class DataLoaderShard(_PreparedDataLoader):
         skip_batches: int = 0,
         _non_blocking: bool = False,
         stateful: bool = False,
+        prefetch_depth: int = 1,
         **kwargs,
     ):
         super().__init__(
@@ -475,6 +481,9 @@ class DataLoaderShard(_PreparedDataLoader):
         )
         self.dataloader = dataloader
         self.skip_batches = skip_batches
+        if prefetch_depth < 1:
+            raise ValueError(f"prefetch_depth={prefetch_depth} must be >= 1")
+        self.prefetch_depth = prefetch_depth
         self.iteration = 0
         # Stateful-resume bookkeeping (the torchdata StatefulDataLoader analog, reference
         # checkpointing.py:135-139): ``batches_yielded`` tracks position within the CURRENT
@@ -535,38 +544,47 @@ class DataLoaderShard(_PreparedDataLoader):
                 self._resume_batches = 0
             self.batches_yielded = 0
             dataloader_iter = iter(self.dataloader)
-            # Prefetch one batch ahead to detect the end before yielding the last batch.
-            try:
-                current_batch = next(dataloader_iter)
-            except StopIteration:
-                return
-            batch_index = 0
-            if skip == 0:
-                current_batch = self._place(current_batch)
+            depth = self.prefetch_depth
+            # Device placement at FETCH time, up to ``depth`` batches ahead of the
+            # yield: jax.device_put is asynchronous, so prefetched batches' H2D
+            # transfers overlap the consumer's current step even when the consumer
+            # blocks on metrics between steps (the MpDeviceLoaderWrapper
+            # background-transfer analog, reference data_loader.py:646). The
+            # ≥1-batch lookahead also detects the end before the final batch is
+            # yielded (end_of_dataloader contract).
+            buffered: deque = deque()  # (index, placed batch), yielded from the left
+            batch_index = 0  # index of the next batch to FETCH from the inner loader
+            exhausted = False
+            any_fetched = False
             while True:
-                try:
-                    next_batch = next(dataloader_iter)
-                except StopIteration:
-                    next_batch = None
-                if next_batch is None:
+                # Top up so the head batch has ``depth`` placed successors in flight.
+                while not exhausted and len(buffered) < depth + 1:
+                    try:
+                        fetched = next(dataloader_iter)
+                    except StopIteration:
+                        exhausted = True
+                        break
+                    any_fetched = True
+                    if batch_index >= skip:
+                        buffered.append((batch_index, self._place(fetched)))
+                    batch_index += 1
+                if not buffered:
+                    if any_fetched and not self.end_of_dataloader:
+                        # Every batch was skipped: the epoch still ended (parity with
+                        # the historical one-batch-lookahead loop).
+                        self.end_of_dataloader = True
+                        self.remainder = self._final_remainder()
+                    break
+                index, batch = buffered.popleft()
+                if exhausted and not buffered:
                     self.end_of_dataloader = True
                     self.remainder = self._final_remainder()
-                elif batch_index + 1 >= skip:
-                    # Device placement at FETCH time, one batch ahead of the yield:
-                    # jax.device_put is asynchronous, so the next batch's H2D transfer
-                    # overlaps the consumer's current step even when the consumer blocks on
-                    # metrics between steps (the MpDeviceLoaderWrapper background-transfer
-                    # analog, reference data_loader.py:646).
-                    next_batch = self._place(next_batch)
-                if batch_index >= skip:
-                    # Count BEFORE the yield: the generator suspends there, so a state_dict
-                    # taken between batches must already include the batch just handed out.
-                    self.batches_yielded = batch_index + 1
-                    yield current_batch
-                if next_batch is None:
-                    break
-                current_batch = next_batch
-                batch_index += 1
+                # Count BEFORE the yield: the generator suspends there, so a state_dict
+                # taken between batches must already include the batch just handed out.
+                self.batches_yielded = index + 1
+                yield batch
+            if not any_fetched:
+                return
             self.iteration += 1
             self.batches_yielded = 0
         finally:
@@ -784,6 +802,7 @@ def skip_first_batches(dataloader, num_batches: int = 0):
             skip_batches=num_batches,
             _non_blocking=dataloader.non_blocking,
             stateful=dataloader.stateful,
+            prefetch_depth=dataloader.prefetch_depth,
         )
     return SkipDataLoader(dataloader, skip_batches=num_batches)
 
@@ -820,6 +839,7 @@ def prepare_data_loader(
     data_seed: Optional[int] = None,
     non_blocking: bool = False,
     use_stateful_dataloader: bool = False,
+    prefetch_depth: int = 1,
 ) -> Union[DataLoaderShard, DataLoaderDispatcher]:
     """Shard any dataloader across host processes (reference ``data_loader.py:988``).
 
@@ -877,6 +897,15 @@ def prepare_data_loader(
             pass
 
     if dispatch_batches:
+        if prefetch_depth > 1:
+            # Accepted-but-ignored is worse than a warning: the dispatcher's
+            # broadcast protocol is lock-step one batch at a time.
+            logger.warning(
+                "prefetch_depth=%d is not supported by dispatch_batches=True loaders "
+                "(main-process broadcast is one batch at a time); running with the "
+                "built-in one-batch lookahead",
+                prefetch_depth,
+            )
         return DataLoaderDispatcher(
             dataloader,
             device=device if put_on_device else None,
@@ -895,6 +924,7 @@ def prepare_data_loader(
             synchronized_generator=synchronized_generator,
             _non_blocking=non_blocking,
             stateful=use_stateful_dataloader,
+            prefetch_depth=prefetch_depth,
         )
 
     if is_map_style and hasattr(dataloader, "batch_sampler"):
@@ -917,6 +947,7 @@ def prepare_data_loader(
             synchronized_generator=synchronized_generator,
             _non_blocking=non_blocking,
             stateful=use_stateful_dataloader,
+            prefetch_depth=prefetch_depth,
         )
 
     # Iterable dataset path.
@@ -936,6 +967,7 @@ def prepare_data_loader(
         rng_types=rng_types,
         _non_blocking=non_blocking,
         stateful=use_stateful_dataloader,
+        prefetch_depth=prefetch_depth,
     )
 
 

@@ -36,9 +36,11 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .compile_cache import AotCache, as_cached, pick_bucket
 from .generation import GenerationConfig, sampling_core
 from .models import llama
 from .models.llama import _block_cached, _rms_norm, init_cache
+from .utils.dataclasses import CompileCacheConfig
 
 __all__ = ["ContinuousBatcher", "Request"]
 
@@ -224,12 +226,58 @@ class ContinuousBatcher:
     """
 
     def __init__(self, params, cfg, max_slots: int = 8, max_len: int = 512,
-                 prompt_bucket: int = 64, prefix_cache: int = 0, telemetry=None):
+                 prompt_bucket: int = 64, prefix_cache: int = 0, telemetry=None,
+                 compile_cache=None, prompt_buckets=None):
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
         self.max_len = max_len
         self.prompt_bucket = prompt_bucket
+        # Persistent AOT executable cache (``accelerate_tpu.compile_cache``): accepts
+        # a shared AotCache (e.g. ``accelerator.compile_cache``) or a
+        # CompileCacheConfig. Disabled/None leaves every program on the plain
+        # module-level jits — identical behavior and dispatch cost.
+        if isinstance(compile_cache, CompileCacheConfig):
+            compile_cache = AotCache(compile_cache)
+        self.compile_cache = compile_cache if (
+            compile_cache is not None and compile_cache.enabled
+        ) else None
+        cc = self.compile_cache
+        self._decode_fn = as_cached(_decode_step, cc, "serving.decode", ("cfg",))
+        self._prefill_fn = as_cached(
+            _prefill_jit, cc, "serving.prefill", ("cfg", "max_len"))
+        self._prefill_chunk_fn = as_cached(
+            _prefill_chunk_jit, cc, "serving.prefill_chunk", ("cfg",))
+        self._prefill_full_logits_fn = as_cached(
+            _prefill_full_logits_jit, cc, "serving.prefill_full_logits",
+            ("cfg", "max_len"))
+        self._prefill_chunk_keep_fn = as_cached(
+            _prefill_chunk_keep_jit, cc, "serving.prefill_chunk_keep", ("cfg",))
+        self._insert_row_fn = as_cached(
+            _insert_row, cc, "serving.insert_row", ("slot", "scan_layers"))
+        # Shape-bucketed prefill: pad each prompt to the smallest rung of a geometric
+        # ladder so prefill compiles once per BUCKET instead of once per chunk count
+        # (and the warmup manifest can enumerate the whole compile surface). Explicit
+        # ``prompt_buckets`` wins; else the compile-cache config's ladder; else the
+        # historical chunked prefill. The ladder is capped so a bucket always fits the
+        # engine cache. Prefix caching keeps its right-aligned chunk layout (snapshots
+        # must align across prompt lengths), so it takes precedence over bucketing.
+        if prompt_buckets is not None:
+            self.prompt_buckets = tuple(sorted({int(b) for b in prompt_buckets}))
+        elif cc is not None and cc.config.bucket_serving:
+            # An empty ladder (bucket_min >= max_len) means bucketing is off.
+            self.prompt_buckets = cc.config.ladder(max_len) or None
+        else:
+            self.prompt_buckets = None
+        if self.prompt_buckets is not None and any(
+            b < 1 or b > max_len for b in self.prompt_buckets
+        ):
+            raise ValueError(
+                f"prompt_buckets={self.prompt_buckets} must lie in [1, max_len={max_len}]"
+            )
+        self.bucket_hits = 0    # prompt admitted into an already-compiled bucket
+        self.bucket_misses = 0  # first prompt of a bucket (compiles/loads its program)
+        self._buckets_seen: set = set()
         self.cache = init_cache(cfg, max_slots, max_len)
         self.tokens = np.zeros((max_slots,), np.int32)  # host-side; uploaded per decode
         self.positions = np.zeros((max_slots,), np.int32)  # next write slot per lane
@@ -269,6 +317,8 @@ class ContinuousBatcher:
             "prefix_entries": len(self._prefix_reg),
             "prefix_hits": self.prefix_hits,
             "prefix_misses": self.prefix_misses,
+            "bucket_hits": self.bucket_hits,
+            "bucket_misses": self.bucket_misses,
         }
 
     def _emit_telemetry(self, extra: Optional[dict] = None) -> None:
@@ -284,6 +334,8 @@ class ContinuousBatcher:
             "telemetry_rev": TELEMETRY_REV,
             **self.stats(),
         }
+        if self.compile_cache is not None:
+            record["compile_cache"] = self.compile_cache.stats()
         if extra:
             record.update(extra)
         tel.emit(record)
@@ -312,15 +364,9 @@ class ContinuousBatcher:
             )
         if gen.max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1 (the prefill emits the first token)")
-        # Long prompts prefill in bucket-width chunks (one shared compiled program);
-        # the request just needs its chunks + generation budget to fit the cache.
-        n_chunks = max(1, -(-len(prompt) // self.prompt_bucket))
-        if n_chunks * self.prompt_bucket + gen.max_new_tokens > self.max_len:
-            raise ValueError(
-                f"prompt ({len(prompt)} tokens → {n_chunks} chunks of "
-                f"{self.prompt_bucket}) + max_new_tokens={gen.max_new_tokens} exceeds "
-                f"max_len={self.max_len}"
-            )
+        # The prompt's padded prefill width + generation budget must fit the cache;
+        # _plan_prefill picks the bucket (or chunked) layout and validates it.
+        self._plan_prefill(len(prompt), gen.max_new_tokens)
         if gen.temperature > 0.0 and rng is None:
             raise ValueError("temperature sampling needs a per-request rng key")
         req = Request(self._uid, prompt, gen, rng)
@@ -336,7 +382,7 @@ class ContinuousBatcher:
             if finished_at_admit:
                 self._emit_telemetry()  # admissions alone still move the counters
             return finished_at_admit
-        greedy, logits, self.cache = _decode_step(
+        greedy, logits, self.cache = self._decode_fn(
             self.params, self.cache, jnp.asarray(self.tokens),
             jnp.asarray(self.positions), cfg=self.cfg,
         )
@@ -399,7 +445,95 @@ class ContinuousBatcher:
             return out, tokens_per_sec
         return out
 
+    def warm_programs(self, max_new_tokens: int = 32) -> list:
+        """Pre-compile this engine's whole program surface into the AOT cache
+        WITHOUT executing anything (``python -m accelerate_tpu warmup --serve``).
+
+        Covers: the decode step, one prefill per bucket that ``_plan_prefill``
+        can actually route a ``max_new_tokens``-budget request to, the
+        first-chunk + chunk-append pair (the fallback for prompts/budgets no
+        bucket fits — always part of the live surface), and the per-slot row
+        inserts. Returns warmup-manifest entries; empty when no enabled compile
+        cache is attached."""
+        if self.compile_cache is None:
+            return []
+        entries = []
+        lanes = jnp.zeros((self.max_slots,), jnp.int32)
+        entries.append(self._decode_fn.warm(
+            self.params, self.cache, lanes, lanes, cfg=self.cfg
+        ))
+        if self.prompt_buckets is not None and not self.prefix_cache_size:
+            # Only buckets a request with this generation budget can land in —
+            # a bucket with b + max_new > max_len is unreachable via _plan_prefill.
+            widths = [b for b in self.prompt_buckets
+                      if b + max_new_tokens <= self.max_len]
+        else:
+            widths = []
+        row_cache = None
+        if self.prefix_cache_size:
+            row = jnp.zeros((1, self.prompt_bucket), jnp.int32)
+            mask = jnp.zeros((1, self.prompt_bucket), bool)
+            entries.append(self._prefill_full_logits_fn.warm(
+                self.params, row, mask, cfg=self.cfg, max_len=self.max_len
+            ))
+            row_cache = init_cache(self.cfg, 1, self.max_len)
+            entries.append(self._prefill_chunk_keep_fn.warm(
+                self.params, row, mask, row_cache, cfg=self.cfg
+            ))
+        else:
+            for width in widths:
+                row = jnp.zeros((1, width), jnp.int32)
+                mask = jnp.zeros((1, width), bool)
+                entries.append(self._prefill_fn.warm(
+                    self.params, row, mask, cfg=self.cfg, max_len=self.max_len
+                ))
+            if self.prompt_bucket + max_new_tokens <= self.max_len:
+                # The chunked pair serves every prompt the ladder can't (and ALL
+                # prompts when no ladder is configured). Skipped when even one
+                # chunk + budget overflows the cache — _plan_prefill would reject
+                # every such request, so the programs are unreachable.
+                row = jnp.zeros((1, self.prompt_bucket), jnp.int32)
+                mask = jnp.zeros((1, self.prompt_bucket), bool)
+                entries.append(self._prefill_fn.warm(
+                    self.params, row, mask, cfg=self.cfg, max_len=self.max_len
+                ))
+                row_cache = init_cache(self.cfg, 1, self.max_len)
+                entries.append(self._prefill_chunk_fn.warm(
+                    self.params, row, mask, row_cache, cfg=self.cfg
+                ))
+        if row_cache is None:
+            row_cache = init_cache(self.cfg, 1, self.max_len)
+        for slot in range(self.max_slots):
+            entries.append(self._insert_row_fn.warm(
+                self.cache, row_cache, slot=slot, scan_layers=self.cfg.scan_layers
+            ))
+        return entries
+
     # ------------------------------------------------------------------ internals
+    def _plan_prefill(self, prompt_len: int, max_new: int):
+        """Pick the prefill layout for one prompt: ``("bucket", width)`` when the
+        bucket ladder is active and a rung fits prompt + generation budget,
+        ``("chunk", total)`` for the chunked path; raises when neither fits.
+
+        Prompts that overflow every bucket (or whose budget only fits under the
+        tighter chunk padding) quietly fall back to chunked prefill — bucketing
+        bounds the compile surface for the common case, it must never shrink the
+        admissible request set.
+        """
+        if self.prompt_buckets is not None and not self.prefix_cache_size:
+            bucket = pick_bucket(prompt_len, self.prompt_buckets)
+            if bucket is not None and bucket + max_new <= self.max_len:
+                return "bucket", bucket
+        n_chunks = max(1, -(-prompt_len // self.prompt_bucket))
+        total = n_chunks * self.prompt_bucket
+        if total + max_new > self.max_len:
+            raise ValueError(
+                f"prompt ({prompt_len} tokens → {n_chunks} chunks of "
+                f"{self.prompt_bucket}) + max_new_tokens={max_new} exceeds "
+                f"max_len={self.max_len}"
+            )
+        return "chunk", total
+
     def _admit(self) -> list[Request]:
         finished = []
         for slot in range(self.max_slots):
@@ -408,14 +542,16 @@ class ContinuousBatcher:
             # the inner loop per slot, and such requests are reported like any other.
             while self.slot_req[slot] is None and self.queue:
                 req = self.queue.popleft()
-                row_cache, greedy_dev, logits_dev, prefill_len = self._prefill(req.prompt)
+                row_cache, greedy_dev, logits_dev, prefill_len = self._prefill(
+                    req.prompt, req.gen.max_new_tokens
+                )
                 first = (
                     int(np.asarray(greedy_dev)[0])       # fused on-device argmax (4 bytes)
                     if req.gen.temperature <= 0.0
                     else req._sample(logits_dev[0])
                 )
                 # graftlint: disable=recompile-hazard(slot indexes a compile-time cache row; at most max_slots variants, admission-time only)
-                self.cache = _insert_row(self.cache, row_cache, slot=slot, scan_layers=self.cfg.scan_layers)
+                self.cache = self._insert_row_fn(self.cache, row_cache, slot=slot, scan_layers=self.cfg.scan_layers)
                 self.admitted += 1
                 self.slot_req[slot] = req
                 self.positions[slot] = prefill_len  # next write = first decode slot
@@ -429,30 +565,44 @@ class ContinuousBatcher:
                     self.evicted += 1  # finished AT admission still cycled the slot
         return finished
 
-    def _prefill(self, prompt: np.ndarray):
-        """Single-row prefill in bucket-width chunks → (cache row, on-device greedy token
-        [1], on-device logits row [1, V], decode start position).
-        Compiled: one bucket-width executable per (cfg, max_len) plus one shared
-        chunk-append executable — a 10-chunk prompt compiles nothing new. With
-        ``prefix_cache`` enabled, prompts sharing registered full-chunk prefixes skip
-        straight to the first uncached chunk."""
+    def _prefill(self, prompt: np.ndarray, max_new: int):
+        """Single-row prefill → (cache row, on-device greedy token [1], on-device
+        logits row [1, V], decode start position).
+
+        Layout comes from ``_plan_prefill``: **bucketed** (one executable per
+        ladder rung — the prompt is left-padded to its bucket and prefilled in one
+        dispatch) or **chunked** (one bucket-width executable plus one shared
+        chunk-append executable — a 10-chunk prompt compiles nothing new). With
+        ``prefix_cache`` enabled, prompts sharing registered full-chunk prefixes
+        skip straight to the first uncached chunk."""
         if self.prefix_cache_size:
             return self._prefill_prefix_cached(prompt)
-        bucket = self.prompt_bucket
-        n_chunks = max(1, -(-len(prompt) // bucket))
-        total = n_chunks * bucket
+        mode, total = self._plan_prefill(len(prompt), max_new)
         pad = total - len(prompt)
         row = np.zeros((1, total), np.int32)
         row[0, pad:] = prompt
         mask = np.zeros((1, total), bool)
         mask[0, pad:] = True
-        greedy, logits, cache = _prefill_jit(
+        if mode == "bucket":
+            if total in self._buckets_seen:
+                self.bucket_hits += 1
+            else:
+                self.bucket_misses += 1
+                self._buckets_seen.add(total)
+            greedy, logits, cache = self._prefill_fn(
+                self.params, jnp.asarray(row), jnp.asarray(mask),
+                cfg=self.cfg, max_len=self.max_len,
+            )
+            return cache, greedy, logits, total
+        bucket = self.prompt_bucket
+        n_chunks = total // bucket
+        greedy, logits, cache = self._prefill_fn(
             self.params, jnp.asarray(row[:, :bucket]), jnp.asarray(mask[:, :bucket]),
             cfg=self.cfg, max_len=self.max_len,
         )
         for c in range(1, n_chunks):
             sl = slice(c * bucket, (c + 1) * bucket)
-            greedy, logits, cache = _prefill_chunk_jit(
+            greedy, logits, cache = self._prefill_chunk_fn(
                 self.params, jnp.asarray(row[:, sl]), jnp.asarray(mask[:, sl]), cache,
                 cfg=self.cfg,
             )
@@ -495,12 +645,12 @@ class ContinuousBatcher:
         for c in range(start, n_chunks):
             sl = slice(c * bucket, (c + 1) * bucket)
             if cache is None:
-                logits, cache = _prefill_full_logits_jit(
+                logits, cache = self._prefill_full_logits_fn(
                     self.params, jnp.asarray(row[:, sl]), jnp.asarray(mask[:, sl]),
                     cfg=self.cfg, max_len=self.max_len,
                 )
             else:
-                logits, cache = _prefill_chunk_keep_jit(
+                logits, cache = self._prefill_chunk_keep_fn(
                     self.params, jnp.asarray(row[:, sl]), jnp.asarray(mask[:, sl]),
                     cache, cfg=self.cfg,
                 )
@@ -514,12 +664,12 @@ class ContinuousBatcher:
             prev_key = prompt[: (start - 1) * bucket].tobytes() if start > 1 else None
             prev = self._prefix_reg.get(prev_key) if prev_key else None
             if prev is not None:
-                logits, cache = _prefill_chunk_keep_jit(
+                logits, cache = self._prefill_chunk_keep_fn(
                     self.params, jnp.asarray(row[:, sl]), jnp.asarray(mask[:, sl]),
                     prev, cfg=self.cfg,
                 )
             else:
-                logits, cache = _prefill_full_logits_jit(
+                logits, cache = self._prefill_full_logits_fn(
                     self.params, jnp.asarray(row[:, sl]), jnp.asarray(mask[:, sl]),
                     cfg=self.cfg, max_len=self.max_len,
                 ) if start == 1 else self._recompute_all(row, mask, n_chunks)
@@ -531,13 +681,13 @@ class ContinuousBatcher:
 
     def _recompute_all(self, row, mask, n_chunks):
         bucket = self.prompt_bucket
-        logits, cache = _prefill_full_logits_jit(
+        logits, cache = self._prefill_full_logits_fn(
             self.params, jnp.asarray(row[:, :bucket]), jnp.asarray(mask[:, :bucket]),
             cfg=self.cfg, max_len=self.max_len,
         )
         for c in range(1, n_chunks):
             sl = slice(c * bucket, (c + 1) * bucket)
-            logits, cache = _prefill_chunk_keep_jit(
+            logits, cache = self._prefill_chunk_keep_fn(
                 self.params, jnp.asarray(row[:, sl]), jnp.asarray(mask[:, sl]), cache,
                 cfg=self.cfg,
             )

@@ -34,6 +34,7 @@ from .utils.constants import (
     TENSOR_AXIS,
 )
 from .utils.dataclasses import (
+    CompileCacheConfig,
     DistributedInitKwargs,
     DistributedType,
     GradientAccumulationPlugin,
@@ -340,6 +341,7 @@ class AcceleratorState:
         ep_plugin=None,
         megatron_lm_plugin=None,
         telemetry_config: Optional[TelemetryConfig] = None,
+        compile_cache_config: Optional[CompileCacheConfig] = None,
         _from_accelerator: bool = False,
         **kwargs,
     ):
@@ -369,6 +371,14 @@ class AcceleratorState:
         # the default constructor applies the ACCELERATE_TELEMETRY env override.
         self.telemetry_config = (
             telemetry_config if telemetry_config is not None else TelemetryConfig()
+        )
+        # Like telemetry, the AOT compile-cache config is state-resident so the
+        # Accelerator, serving engines and warmup CLI all resolve ONE config; the
+        # default constructor applies the ACCELERATE_COMPILE_CACHE env override.
+        self.compile_cache_config = (
+            compile_cache_config
+            if compile_cache_config is not None
+            else CompileCacheConfig()
         )
         from .parallel.mesh import MeshConfig, build_mesh
 

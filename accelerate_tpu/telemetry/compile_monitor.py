@@ -17,7 +17,7 @@ from __future__ import annotations
 import threading
 from typing import Dict, Optional
 
-__all__ = ["CompileMonitor", "compile_label"]
+__all__ = ["CompileMonitor", "compile_label", "dispatch_cache_event"]
 
 #: The duration event jax records around every XLA backend compile (traced-jit cache
 #: misses fire it; cache hits do not).
@@ -59,6 +59,16 @@ def _dispatch(event: str, duration_s: float, **kwargs) -> None:
             mon._record(duration_s, label)
 
 
+def dispatch_cache_event(hit: bool, deserialize_s: float = 0.0) -> None:
+    """Feed an AOT compile-cache event (``compile_cache.AotCache``) to live
+    monitors. Unlike XLA compile events this is called directly by the cache —
+    jax.monitoring has no event for "a compile was AVOIDED", which is exactly
+    the number a cold-start post-mortem needs."""
+    with _lock:
+        for mon in _monitors:
+            mon._record_cache(hit, deserialize_s)
+
+
 def _ensure_dispatcher() -> bool:
     """Register the module dispatcher once; False when jax.monitoring is unusable.
 
@@ -93,6 +103,12 @@ class CompileMonitor:
         self.count = 0
         self.seconds = 0.0
         self.by_label: Dict[str, Dict[str, float]] = {}
+        # AOT compile-cache events (compile_cache.AotCache via dispatch_cache_event):
+        # a hit is a compile AVOIDED (deserialize instead), a miss is a compile paid
+        # and persisted for the next process.
+        self.cache_hits = 0
+        self.cache_misses = 0
+        self.deserialize_s = 0.0
         self.supported: Optional[bool] = None  # unknown until start()
         self._active = False
 
@@ -122,6 +138,13 @@ class CompileMonitor:
             slot["count"] += 1
             slot["seconds"] += duration_s
 
+    def _record_cache(self, hit: bool, deserialize_s: float) -> None:
+        if hit:
+            self.cache_hits += 1
+            self.deserialize_s += deserialize_s
+        else:
+            self.cache_misses += 1
+
     def snapshot(self) -> dict:
         """Counter state as plain JSON-serializable values."""
         return {
@@ -131,6 +154,9 @@ class CompileMonitor:
                 k: {"count": v["count"], "seconds": round(v["seconds"], 6)}
                 for k, v in self.by_label.items()
             },
+            "cache_hit": self.cache_hits,
+            "cache_miss": self.cache_misses,
+            "deserialize_ms": round(self.deserialize_s * 1e3, 3),
         }
 
     def __enter__(self):

@@ -356,6 +356,100 @@ class TelemetryConfig(KwargsHandler):
             raise ValueError(f"steady_cap={self.steady_cap} must be >= 0 (0 = no cap)")
 
 
+#: Env values that toggle ACCELERATE_COMPILE_CACHE on/off; anything else is a path.
+_CACHE_ENV_TRUE = frozenset({"1", "true", "yes", "on"})
+_CACHE_ENV_FALSE = frozenset({"", "0", "false", "no", "off"})
+
+
+@dataclass
+class CompileCacheConfig(KwargsHandler):
+    """AOT compile-cache config (``accelerate_tpu.compile_cache``).
+
+    **Off by default and free when off**: a disabled config makes
+    ``AotCache.wrap`` the identity, so train/eval/serving steps dispatch through
+    plain ``jax.jit`` exactly as before. Enable explicitly or via
+    ``ACCELERATE_COMPILE_CACHE=1`` (explicit arg > env > built-in, the §5 priority
+    order; a path-valued env both enables the cache and names its directory).
+
+    When enabled, every executable the ``Accelerator`` builds (train step, eval
+    step, serving prefill/decode) is content-addressed by a fingerprint of its
+    lowered program + jax/jaxlib versions + backend topology + compiler flags and
+    serialized to ``cache_dir`` — a later process start deserializes instead of
+    re-paying XLA compile. Any stale/poisoned/mismatched entry falls back to live
+    compile (never fails a step).
+
+    ``serving_buckets`` / ``bucket_min`` / ``bucket_growth`` parameterize
+    shape-bucketed serving: ``ContinuousBatcher`` prefill pads prompts up to a
+    geometric bucket ladder (``bucket_min``, ``bucket_min*growth``, ... capped at
+    the engine ``max_len``) so prefill compiles once per bucket instead of once
+    per prompt length; explicit ``serving_buckets`` override the ladder.
+    """
+
+    enabled: Optional[bool] = None      # None → env ACCELERATE_COMPILE_CACHE > False
+    cache_dir: Optional[str] = None     # None → env ACCELERATE_COMPILE_CACHE_DIR > default
+    serving_buckets: Optional[tuple] = None  # explicit prefill bucket ladder (ascending)
+    bucket_min: int = 64                # geometric ladder start
+    bucket_growth: float = 2.0          # geometric ladder ratio
+    bucket_serving: bool = True         # batcher uses the ladder when cache config attached
+
+    def __post_init__(self):
+        raw = os.environ.get("ACCELERATE_COMPILE_CACHE")
+        raw_is_path = raw is not None and raw.strip().lower() not in (
+            _CACHE_ENV_TRUE | _CACHE_ENV_FALSE
+        )
+        if self.enabled is None:
+            if raw is None:
+                self.enabled = False
+            else:
+                self.enabled = raw_is_path or raw.strip().lower() in _CACHE_ENV_TRUE
+        if self.cache_dir is None:
+            self.cache_dir = (
+                os.environ.get("ACCELERATE_COMPILE_CACHE_DIR")
+                or (raw if raw_is_path else None)
+                or os.path.join(
+                    os.path.expanduser("~"), ".cache", "accelerate_tpu", "aot_cache"
+                )
+            )
+        if self.bucket_min < 1:
+            raise ValueError(f"bucket_min={self.bucket_min} must be >= 1")
+        if self.bucket_growth <= 1.0:
+            raise ValueError(
+                f"bucket_growth={self.bucket_growth} must be > 1 (the ladder must grow)"
+            )
+        if self.serving_buckets is not None:
+            buckets = tuple(int(b) for b in self.serving_buckets)
+            if not buckets or any(b < 1 for b in buckets) or list(buckets) != sorted(set(buckets)):
+                raise ValueError(
+                    f"serving_buckets={self.serving_buckets!r} must be a strictly "
+                    "ascending sequence of positive ints"
+                )
+            self.serving_buckets = buckets
+
+    def ladder(self, max_len: int) -> tuple:
+        """The prefill bucket ladder for an engine of cache length ``max_len``.
+
+        Rungs stay strictly BELOW ``max_len``: a bucket is also the decode start
+        position, so a ``max_len``-wide rung leaves no room for even one
+        generated token and could never be selected (``bucket + max_new_tokens
+        <= max_len``). Prompts beyond the top rung use the chunked-prefill
+        fallback. May be EMPTY (``bucket_min >= max_len``) — the engine then
+        treats bucketing as off rather than carrying an unreachable rung.
+        Explicit ``serving_buckets`` are the user's to cap (rungs > max_len are
+        dropped; a rung == max_len is kept as stated even though only
+        ``max_new_tokens == 0`` requests could use it — none exist)."""
+        if self.serving_buckets is not None:
+            return tuple(b for b in self.serving_buckets if b <= max_len)
+        buckets = []
+        b = self.bucket_min
+        while b < max_len:
+            buckets.append(b)
+            # int truncation under growth < 2 could repeat a rung; always advance
+            # so the ladder keeps the strictly-ascending invariant the explicit
+            # serving_buckets path enforces.
+            b = max(int(b * self.bucket_growth), b + 1)
+        return tuple(buckets)
+
+
 @dataclass
 class DataLoaderConfiguration(KwargsHandler):
     """Reference ``dataclasses.py:762``. None-sentinel fields resolve launcher env
@@ -368,9 +462,20 @@ class DataLoaderConfiguration(KwargsHandler):
     data_seed: Optional[int] = None
     non_blocking: bool = False      # async host→device transfer
     use_stateful_dataloader: bool = False
-    prefetch_size: int = 2  # graftlint: disable=dead-knob(reference-launcher config compat; shard loader lookahead is fixed at one batch by the end_of_dataloader contract)
+    prefetch_size: int = 2  # graftlint: disable=dead-knob(reference-launcher config compat; prefetch_depth below is the live knob)
+    # Device-prefetch lookahead of the prepared shard loader: up to ``prefetch_depth``
+    # batches are placed on device ahead of the one being consumed (depth 1 = the
+    # historical one-batch lookahead the end_of_dataloader contract needs; deeper
+    # overlaps more H2D transfer with compute at the cost of extra device memory).
+    prefetch_depth: int = 1
 
     def __post_init__(self):
+        if self.prefetch_depth < 1:
+            raise ValueError(
+                f"prefetch_depth={self.prefetch_depth} must be >= 1 (the one-batch "
+                "lookahead is required to detect end_of_dataloader before the final "
+                "batch is yielded)"
+            )
         if self.dispatch_batches is None and "ACCELERATE_DISPATCH_BATCHES" in os.environ:
             self.dispatch_batches = parse_flag_from_env("ACCELERATE_DISPATCH_BATCHES")
         if self.even_batches is None:

@@ -18,7 +18,15 @@ from __future__ import annotations
 
 import jax
 
-__all__ = ["axis_size", "current_abstract_mesh", "shard_map", "tpu_compiler_params"]
+__all__ = [
+    "axis_size",
+    "current_abstract_mesh",
+    "deserialize_executable",
+    "executable_serialization_supported",
+    "serialize_executable",
+    "shard_map",
+    "tpu_compiler_params",
+]
 
 
 def current_abstract_mesh():
@@ -72,6 +80,52 @@ def axis_size(axis_name):
     if fn is not None:
         return fn(axis_name)
     return jax.lax.psum(1, axis_name)
+
+
+def _serialize_executable_module():
+    """The executable (de)serialization module across lineages, or None.
+
+    Both lineages currently spell it ``jax.experimental.serialize_executable``
+    (0.4.x and modern); it moved out of ``jax.interpreters`` before 0.4 and may
+    graduate again — keep every resolution path here so a rename strands only
+    this function. Returns None when no serializer exists: the AOT compile cache
+    then degrades to live compiles (``AotCache.enabled`` False) instead of
+    failing imports.
+    """
+    try:
+        from jax.experimental import serialize_executable as mod
+    except ImportError:
+        return None
+    if hasattr(mod, "serialize") and hasattr(mod, "deserialize_and_load"):
+        return mod
+    return None
+
+
+def executable_serialization_supported() -> bool:
+    """True when this jax can serialize compiled executables to bytes."""
+    return _serialize_executable_module() is not None
+
+
+def serialize_executable(compiled):
+    """``(payload_bytes, in_tree, out_tree)`` for a ``jax.stages.Compiled``.
+
+    Raises ``RuntimeError`` when the running jax has no serializer — callers that
+    want graceful degradation should gate on
+    :func:`executable_serialization_supported` first.
+    """
+    mod = _serialize_executable_module()
+    if mod is None:
+        raise RuntimeError("this jax exposes no executable serialization API")
+    return mod.serialize(compiled)
+
+
+def deserialize_executable(payload, in_tree, out_tree):
+    """Load a serialized executable back into a callable ``Compiled`` (no XLA
+    compile happens — the point of the AOT cache)."""
+    mod = _serialize_executable_module()
+    if mod is None:
+        raise RuntimeError("this jax exposes no executable serialization API")
+    return mod.deserialize_and_load(payload, in_tree, out_tree)
 
 
 def tpu_compiler_params(**kwargs):

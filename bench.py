@@ -355,35 +355,50 @@ def run(B: int, S: int, fuse: int, preset: str | None, default_metric: str | Non
             print(f"bench: matmul-ceiling probe failed ({exc_line(e, 120)}); "
                   "emitting datasheet peak only", file=sys.stderr)
 
-    acc = Accelerator(mixed_precision="bf16", gradient_accumulation_steps=accum)
-    state = acc.create_train_state(
-        llama.init_params(cfg), _make_optimizer(os.environ.get("BENCH_OPT", "adamw"))
-    )
-    # cast_params=True (default): the whole-tree bf16 pre-cast costs one bf16 param copy but
-    # makes the scan-backward gradient carries bf16 too — net ~1.5 GB cheaper at 0.9B params
-    # than fp32 grad carries (measured: 15.9G vs 17.3G peak).
-    step = acc.build_train_step(
-        lambda p, b: llama.loss_fn(p, b, cfg), max_grad_norm=1.0, fused_steps=fuse,
-        # cast_params=False skips the whole-tree bf16 pre-cast (the model casts each
-        # weight at point of use): ~1.8 GB less standing HBM, at the cost of fp32 scan
-        # grad carries. Sweepable — near the 16 GB ceiling the tradeoff may flip.
-        cast_params=os.environ.get("BENCH_CAST_PARAMS", "1") == "1",
-    )
+    # Cold-start attribution window: everything from Accelerator construction through
+    # the first completed step (compiles included) is the per-process tax the AOT
+    # compile cache (ACCELERATE_COMPILE_CACHE=1) exists to kill — stamp it on every
+    # row so the next TPU window's compile spend is attributable (ISSUE 3).
+    from accelerate_tpu.telemetry import CompileMonitor
 
-    rng = np.random.default_rng(0)
-    stacked = {"tokens": rng.integers(0, cfg.vocab_size, size=(fuse, B, S + 1)).astype(np.int32)}
-    # fused_steps=1 builds the NON-fused _TrainStep, whose contract is a single
-    # {'tokens': [B, S+1]} batch (no leading dispatch dim) and a scalar loss.
-    if fuse == 1:
-        stacked = {k: v[0] for k, v in stacked.items()}
+    # try/finally: run() restarts on transient first-step failures — a leaked
+    # monitor would stay registered (and counting) for the process lifetime.
+    cold_monitor = CompileMonitor().start()
+    t_cold = time.perf_counter()
+    try:
+        acc = Accelerator(mixed_precision="bf16", gradient_accumulation_steps=accum)
+        state = acc.create_train_state(
+            llama.init_params(cfg), _make_optimizer(os.environ.get("BENCH_OPT", "adamw"))
+        )
+        # cast_params=True (default): the whole-tree bf16 pre-cast costs one bf16 param copy but
+        # makes the scan-backward gradient carries bf16 too — net ~1.5 GB cheaper at 0.9B params
+        # than fp32 grad carries (measured: 15.9G vs 17.3G peak).
+        step = acc.build_train_step(
+            lambda p, b: llama.loss_fn(p, b, cfg), max_grad_norm=1.0, fused_steps=fuse,
+            # cast_params=False skips the whole-tree bf16 pre-cast (the model casts each
+            # weight at point of use): ~1.8 GB less standing HBM, at the cost of fp32 scan
+            # grad carries. Sweepable — near the 16 GB ceiling the tradeoff may flip.
+            cast_params=os.environ.get("BENCH_CAST_PARAMS", "1") == "1",
+        )
 
-    def _force_loss(metrics):
-        return float(np.asarray(metrics["loss"]).reshape(-1)[-1])
+        rng = np.random.default_rng(0)
+        stacked = {"tokens": rng.integers(0, cfg.vocab_size, size=(fuse, B, S + 1)).astype(np.int32)}
+        # fused_steps=1 builds the NON-fused _TrainStep, whose contract is a single
+        # {'tokens': [B, S+1]} batch (no leading dispatch dim) and a scalar loss.
+        if fuse == 1:
+            stacked = {k: v[0] for k, v in stacked.items()}
 
-    # Warmup / compile.  No in-place retry here: the step donates its input state, so a
-    # half-executed dispatch cannot be replayed — transient failures restart run() from main().
-    state, metrics = step(state, stacked)
-    _ = _force_loss(metrics)
+        def _force_loss(metrics):
+            return float(np.asarray(metrics["loss"]).reshape(-1)[-1])
+
+        # Warmup / compile.  No in-place retry here: the step donates its input state, so a
+        # half-executed dispatch cannot be replayed — transient failures restart run() from main().
+        state, metrics = step(state, stacked)
+        _ = _force_loss(metrics)
+        cold_start_s = time.perf_counter() - t_cold
+    finally:
+        cold_monitor.stop()
+    cold = cold_monitor.snapshot()
 
     # Warm until steady (2026-08-01 discovery): the first 1-2 post-compile apply rounds
     # pay a large one-time allocator/settling cost — at 0.9B-param AdamW the first timed
@@ -469,6 +484,12 @@ def run(B: int, S: int, fuse: int, preset: str | None, default_metric: str | Non
         "achieved_tflops_per_chip": round(tflops, 2),
         "peak_tflops_assumed": round(peak / 1e12, 1),
         "device_kind": str(getattr(jax.devices()[0], "device_kind", "unknown")),
+        # Cold-start attribution (setup → first step done): with a warm AOT cache
+        # the compile seconds collapse and cache hits account for the difference.
+        "cold_start_s": round(cold_start_s, 3),
+        "cold_compiles": cold["compiles_total"],
+        "cold_compile_s": cold["compile_s_total"],
+        "compile_cache": acc.compile_cache.stats(),
     }
     if ceiling is not None:
         mfu_measured = tflops / ceiling

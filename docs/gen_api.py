@@ -70,6 +70,10 @@ MODULES = [
     ("accelerate_tpu.utils.jax_compat", "JAX version compatibility"),
     ("accelerate_tpu.analysis.engine", "Static analysis (graftlint) engine"),
     ("accelerate_tpu.analysis.baseline", "Static analysis ratcheting baseline"),
+    ("accelerate_tpu.compile_cache.cache", "AOT compile cache"),
+    ("accelerate_tpu.compile_cache.fingerprint", "Compile-cache fingerprints"),
+    ("accelerate_tpu.compile_cache.buckets", "Serving shape buckets"),
+    ("accelerate_tpu.compile_cache.warmup", "Warmup manifests"),
     ("accelerate_tpu.telemetry.core", "Telemetry pipeline"),
     ("accelerate_tpu.telemetry.timing", "Fenced step timing"),
     ("accelerate_tpu.telemetry.steady", "Steady-state detection"),

@@ -432,3 +432,90 @@ def test_stateful_restore_refused_on_skip_wrapped_loader():
     skipped = skip_first_batches(prepared, 2)
     with pytest.raises(ValueError, match="ambiguous"):
         skipped.load_state_dict({"iteration": 0, "batches_yielded": 1})
+
+
+# ------------------------------------------------------------------- prefetch depth
+class _CountingShard(DataLoaderShard):
+    """Instrumented shard: counts device placements; the consumer counts yields.
+    ``in_flight`` = batches placed but not yet handed to the consumer."""
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.placed = 0
+        self.consumed = 0
+        self.max_in_flight_at_place = 0
+
+    def _place(self, batch):
+        self.placed += 1
+        self.max_in_flight_at_place = max(
+            self.max_in_flight_at_place, self.placed - self.consumed
+        )
+        return super()._place(batch)
+
+
+def _counting_loader(n_batches, depth):
+    class DS:
+        def __len__(self):
+            return n_batches * 2
+
+        def __getitem__(self, i):
+            return {"idx": np.int32(i)}
+
+    return _CountingShard(DataLoader(DS(), batch_size=2), prefetch_depth=depth)
+
+
+@pytest.mark.parametrize("depth", [1, 2, 3, 8])
+def test_prefetch_depth_bounds_batches_in_flight(depth):
+    """prefetch_depth=N keeps at most N batches in flight ahead of the consumer —
+    for any N, including N larger than the dataset and the historical default 1."""
+    loader = _counting_loader(6, depth)
+    seen = []
+    for batch in loader:
+        loader.consumed += 1
+        # After receiving batch i, exactly the lookahead may be placed: never
+        # more than N batches ahead of the consumer.
+        assert loader.placed - loader.consumed <= depth
+        seen.append(int(np.asarray(batch["idx"]).reshape(-1)[0]))
+    assert seen == [0, 2, 4, 6, 8, 10]
+    assert loader.placed == 6  # every batch placed exactly once, none duplicated
+    # At placement time the batch en route to the consumer is still uncounted,
+    # hence the +1.
+    assert loader.max_in_flight_at_place <= depth + 1
+
+
+def test_prefetch_depth_one_matches_historical_lookahead():
+    """Depth 1 = the seed behavior: exactly one batch placed beyond the yield."""
+    loader = _counting_loader(4, 1)
+    for _ in loader:
+        loader.consumed += 1
+        assert loader.placed - loader.consumed <= 1
+    assert loader.max_in_flight_at_place == 2
+
+
+def test_prefetch_depth_preserves_end_of_dataloader_contract():
+    GradientState()
+    for depth in (1, 3):
+        loader = _counting_loader(5, depth)
+        flags = [loader.end_of_dataloader for _ in loader]
+        # end_of_dataloader must be True at (and only at) the final yield.
+        assert flags == [False] * 4 + [True], (depth, flags)
+
+
+def test_prefetch_depth_flows_from_configuration():
+    from accelerate_tpu.utils.dataclasses import DataLoaderConfiguration
+
+    with pytest.raises(ValueError, match="prefetch_depth"):
+        DataLoaderConfiguration(prefetch_depth=0)
+
+    class DS:
+        def __len__(self):
+            return 8
+
+        def __getitem__(self, i):
+            return {"idx": np.int32(i)}
+
+    prepared = prepare_data_loader(
+        DataLoader(DS(), batch_size=2), put_on_device=False, prefetch_depth=3
+    )
+    assert prepared.prefetch_depth == 3
+    assert skip_first_batches(prepared, 1).prefetch_depth == 3

@@ -316,6 +316,147 @@ def test_recompile_hazard_clean(tmp_path):
     assert not rule_hits(lint_snippet(tmp_path, GOOD_RECOMPILE), "recompile-hazard")
 
 
+BAD_JIT_IN_LOOP = """
+    import jax
+    from functools import partial
+
+    def serve(requests, model):
+        results = []
+        for req in requests:
+            step = jax.jit(lambda p, x: model(p, x))   # fresh wrapper per request
+            results.append(step(req.params, req.x))
+        while requests:
+            fn = partial(jax.jit, static_argnames=("n",))(model)  # same hazard
+            requests.pop()
+        return results
+"""
+
+GOOD_JIT_IN_LOOP = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("n",))
+    def step(p, x, n):
+        return p
+
+    def serve(requests):
+        # jit hoisted to module scope: the loop reuses ONE wrapper/cache.
+        return [step(r.params, r.x, n=2) for r in requests]
+
+    def factory(model):
+        for cfg in (1, 2):
+            def body(p, x):
+                return model(p, x)
+            fns = [body]   # defs in loops delay execution; not a jit construction
+        return fns
+"""
+
+BAD_STATIC_ARGNUMS = """
+    import jax
+
+    @jax.jit
+    def base(x, shape):
+        return x
+
+    pad = jax.jit(base, static_argnums=(1,))
+
+    def run(xs):
+        pad(xs, [8, 8])                 # unhashable value at a static_argnums slot
+        for width in range(4):
+            pad(xs, width)              # loop var bound to a static_argnums slot
+"""
+
+GOOD_STATIC_ARGNUMS = """
+    import jax
+
+    @jax.jit
+    def base(x, shape):
+        return x
+
+    pad = jax.jit(base, static_argnums=(1,))
+
+    def run(xs):
+        return pad(xs, (8, 8))          # hashable tuple, fixed across calls
+"""
+
+
+def test_recompile_jit_in_loop_fires(tmp_path):
+    hits = rule_hits(lint_snippet(tmp_path, BAD_JIT_IN_LOOP), "recompile-hazard")
+    assert len(hits) == 2, [f.message for f in hits]
+    assert all("inside a loop body" in f.message for f in hits)
+
+
+def test_recompile_jit_in_loop_clean(tmp_path):
+    assert not rule_hits(lint_snippet(tmp_path, GOOD_JIT_IN_LOOP), "recompile-hazard")
+
+
+def test_recompile_jit_in_for_iter_is_exempt(tmp_path):
+    # A for-loop's iterator expression evaluates ONCE — not a per-iteration
+    # construction; a decorated def inside the body re-runs its decorator and IS.
+    src = """
+    import jax
+    from functools import partial
+
+    def run(f, g, xs):
+        for step in (jax.jit(f), jax.jit(g)):   # built once, before the loop runs
+            step(xs)
+
+    def bad(model, xs):
+        for _ in range(3):
+            @partial(jax.jit, static_argnames=("n",))
+            def body(x, n=1):
+                return model(x)
+            body(xs)
+
+    def bad_bare(xs):
+        while xs:
+            @jax.jit
+            def g(x):
+                return x
+            xs = g(xs)
+
+    def else_clause(xs):
+        for x in xs:
+            pass
+        else:
+            f = jax.jit(lambda a: a)   # runs at most once, after the loop
+        return f
+    """
+    hits = rule_hits(lint_snippet(tmp_path, src), "recompile-hazard")
+    assert len(hits) == 2, [f.message for f in hits]
+    assert all("inside a loop body" in h.message for h in hits)
+
+
+def test_recompile_static_argnums_fires(tmp_path):
+    hits = rule_hits(lint_snippet(tmp_path, BAD_STATIC_ARGNUMS), "recompile-hazard")
+    msgs = " ".join(f.message for f in hits)
+    assert "unhashable" in msgs
+    assert "loop variable" in msgs
+
+
+def test_recompile_static_argnums_decorator_positional(tmp_path):
+    # static_argnums on a decorator resolves to the parameter NAME, so both
+    # positional and keyword call sites are covered.
+    src = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=(1,))
+    def pad(x, width):
+        return x
+
+    def run(pad_fn, xs):
+        for w in range(4):
+            pad(xs, width=w)
+    """
+    hits = rule_hits(lint_snippet(tmp_path, src), "recompile-hazard")
+    assert hits and "loop variable" in hits[0].message
+
+
+def test_recompile_static_argnums_clean(tmp_path):
+    assert not rule_hits(lint_snippet(tmp_path, GOOD_STATIC_ARGNUMS), "recompile-hazard")
+
+
 def test_recompile_kwonly_static_is_known(tmp_path):
     # llama._spec_round_greedy_jit regression: keyword-only statics are real params.
     src = """
