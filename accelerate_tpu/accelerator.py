@@ -1216,7 +1216,11 @@ class Accelerator:
                     opt_state=new_opt_state,
                     step=state.step + 1,
                     grad_accum=new_accum,
-                    micro=jnp.zeros((), jnp.int32) if state.micro is not None else None,
+                    # Reset derived from the input, not a fresh constant: XLA cannot
+                    # alias a constant output into the donated buffer, so zeros(())
+                    # here left state.micro's donation dead (graftaudit dead-donation).
+                    # int32 counter — multiply-by-zero is exact.
+                    micro=state.micro * 0 if state.micro is not None else None,
                     fp8_state=new_fp8,
                 ),
                 metrics,

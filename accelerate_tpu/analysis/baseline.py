@@ -35,8 +35,15 @@ def load_baseline(path: str = BASELINE_FILE) -> Dict[tuple, int]:
     return out
 
 
-def write_baseline(findings: Sequence[Finding], path: str = BASELINE_FILE) -> int:
-    """Rewrite the baseline from current findings; returns the entry count."""
+def write_baseline(
+    findings: Sequence[Finding], path: str = BASELINE_FILE, tool: str = "graftlint"
+) -> int:
+    """Rewrite the baseline from current findings; returns the entry count.
+
+    ``tool`` labels the producing tier ("graftlint" for the AST pass,
+    "graftaudit" for the program pass) — both share this format and ratchet.
+    """
+    command = "lint" if tool == "graftlint" else "audit"
     counts = collections.Counter(f.key() for f in findings)
     rows = [
         {"rule": rule, "path": p, "code": code, "count": n}
@@ -46,10 +53,10 @@ def write_baseline(findings: Sequence[Finding], path: str = BASELINE_FILE) -> in
         json.dump(
             {
                 "version": 1,
-                "tool": "graftlint",
+                "tool": tool,
                 "note": "Grandfathered findings. This file only shrinks: fix or suppress "
                 "(with a reason) instead of adding entries; regenerate with "
-                "`python -m accelerate_tpu lint --baseline`.",
+                f"`python -m accelerate_tpu {command} --baseline`.",
                 "findings": rows,
             },
             f,

@@ -1,0 +1,51 @@
+"""graftaudit — program-level (jaxpr/StableHLO) audit tier.
+
+graftlint (``analysis/``, PR 1) reads Python source; the incidents that cost
+real TPU windows — silent f32 upcasts in a bf16 path, fully-replicated
+gradients, donation that never fires, host transfers inside a hot program —
+only exist in the *traced program*. This package lowers the exact program set
+the compile-cache warmup enumerates (no TPU, no execution) and runs rules over
+the jaxpr + StableHLO, with findings flowing through the same
+Finding/suppression/ratcheting-baseline engine. Entry points:
+
+- ``python -m accelerate_tpu audit [--check|--baseline]`` (CLI; imports jax on
+  the CPU backend)
+- ``lint --check`` runs the audit gate too (in a subprocess — the lint process
+  itself stays jax-free)
+- ``from accelerate_tpu.analysis.program import run_audit`` (library; tests)
+
+Unlike ``analysis/``'s stdlib-only modules, this package imports jax — it must,
+to trace. Keep anything jax-free in the parent package.
+"""
+
+from .audit import (
+    AUDIT_BASELINE_FILE,
+    audit_findings,
+    audit_summaries,
+    known_audit_rule_ids,
+    run_audit,
+)
+from .capture import ProgramCapture, capture_lowering
+from .inventory import collective_inventory
+from .lowering import LowerOnlyCache, capture_default_programs
+from .rules import ProgramRule, all_program_rules, program_rule_by_id
+from .suppressions import SUPPRESSIONS, AuditSuppression, apply_audit_suppressions
+
+__all__ = [
+    "AUDIT_BASELINE_FILE",
+    "AuditSuppression",
+    "LowerOnlyCache",
+    "ProgramCapture",
+    "ProgramRule",
+    "SUPPRESSIONS",
+    "all_program_rules",
+    "apply_audit_suppressions",
+    "audit_findings",
+    "audit_summaries",
+    "capture_default_programs",
+    "capture_lowering",
+    "collective_inventory",
+    "known_audit_rule_ids",
+    "program_rule_by_id",
+    "run_audit",
+]

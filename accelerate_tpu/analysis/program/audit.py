@@ -1,0 +1,129 @@
+"""graftaudit driver: captures → rules → suppressions → sorted findings.
+
+The program-tier analog of ``engine.run_lint``. Reuses the engine's
+:class:`~..engine.Finding` and the ratcheting baseline
+(``graftaudit_baseline.json``, same format and semantics as graftlint's — and
+the same contract: empty at HEAD, every finding fixed or suppressed with a
+reason in ``suppressions.SUPPRESSIONS``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+from ..engine import REPO_ROOT, Finding
+from .capture import ProgramCapture
+from .inventory import collective_inventory
+from .rules import all_program_rules
+from .suppressions import SUPPRESSIONS, apply_audit_suppressions
+
+__all__ = [
+    "AUDIT_BASELINE_FILE",
+    "run_audit",
+    "audit_findings",
+    "audit_summaries",
+    "known_audit_rule_ids",
+]
+
+AUDIT_BASELINE_FILE = os.path.join(REPO_ROOT, "graftaudit_baseline.json")
+
+
+def known_audit_rule_ids(rules=None) -> set:
+    if rules is None:
+        rules = all_program_rules()
+    return {r.id for r in rules} | {"bad-suppression"}
+
+
+def audit_findings(
+    captures: Sequence[ProgramCapture],
+    rules=None,
+    suppressions=SUPPRESSIONS,
+) -> Tuple[List[Finding], list]:
+    """(findings, stale_suppressions) over already-captured programs."""
+    if rules is None:
+        rules = all_program_rules()
+    findings: List[Finding] = []
+    for rule in rules:
+        for prog in captures:
+            findings.extend(rule.check_program(prog))
+    kept, errors, stale = apply_audit_suppressions(
+        findings, suppressions, known_rules=known_audit_rule_ids(rules)
+    )
+    kept.extend(errors)
+    kept.sort(key=lambda f: (f.path, f.rule, f.code, f.message))
+    return kept, stale
+
+
+def audit_summaries(captures: Sequence[ProgramCapture]) -> List[dict]:
+    """Per-program audit provenance: collectives + donation effectiveness.
+
+    This is what ``run_warmup`` stamps into the warmup manifest (and emits as
+    telemetry records) so a cache directory carries the comms/donation profile
+    of the executables it holds.
+    """
+    from .capture import main_arg_attributes
+
+    out = []
+    for c in captures:
+        donated = c.donate_argnums
+        attrs = main_arg_attributes(c.hlo_text)
+        aliased = deferred = 0
+        for i in donated:
+            attr = attrs.get(i, "")
+            if "tf.aliasing_output" in attr:
+                aliased += 1
+            elif "jax.buffer_donor" in attr:
+                # Multi-device lowering: XLA assigns the alias at compile time.
+                # When the capture went through a compiling path, count the
+                # compiled module's input_output_alias entries as the ground
+                # truth for how many donations actually landed.
+                deferred += 1
+        compiled_aliases = _compiled_alias_count(c.compiled_text)
+        if deferred and compiled_aliases is not None:
+            landed = min(deferred, max(compiled_aliases - aliased, 0))
+            aliased += landed
+            deferred -= landed
+        out.append({
+            "label": c.label,
+            "collectives": collective_inventory(c),
+            "donation": {
+                "donated": len(donated),
+                "aliased": aliased,
+                "deferred": deferred,
+                "dead": len(donated) - aliased - deferred,
+            },
+            "lower_warnings": list(c.warnings),
+        })
+    return out
+
+
+def _compiled_alias_count(compiled_text) -> Optional[int]:
+    """Number of input/output alias pairs in compiled-HLO text (None if absent)."""
+    if not compiled_text:
+        return None
+    import re
+
+    m = re.search(r"input_output_alias=\{([^}]*(?:\{[^}]*\}[^}]*)*)\}", compiled_text)
+    if m is None:
+        return 0
+    return m.group(1).count("alias")
+
+
+def run_audit(
+    captures: Optional[Sequence[ProgramCapture]] = None,
+    rules=None,
+    **geometry,
+) -> Tuple[List[Finding], List[dict], list]:
+    """(findings, summaries, stale_suppressions) for one config's programs.
+
+    With no ``captures``, lowers the default warmup geometry (see
+    ``lowering.DEFAULT_AUDIT_GEOMETRY``; ``geometry`` overrides it). No TPU,
+    no execution — tracing and lowering only.
+    """
+    if captures is None:
+        from .lowering import capture_default_programs
+
+        captures = capture_default_programs(**geometry)
+    findings, stale = audit_findings(captures, rules=rules)
+    return findings, audit_summaries(captures), stale

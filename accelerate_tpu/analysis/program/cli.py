@@ -1,0 +1,127 @@
+"""graftaudit CLI: ``python -m accelerate_tpu audit [--check|--baseline]``.
+
+Exit codes mirror graftlint: 0 clean beyond the baseline, 1 new findings,
+2 usage error. Unlike ``lint``, this entry DOES import jax (it traces and
+lowers the real programs) — it runs on the CPU backend, no TPU, and the
+default geometry finishes well inside a minute.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+from ..baseline import apply_baseline, load_baseline, write_baseline
+from ..engine import REPO_ROOT
+from .audit import AUDIT_BASELINE_FILE, run_audit
+from .rules import all_program_rules
+
+__all__ = ["build_arg_parser", "main", "run_cli"]
+
+
+def build_arg_parser(parser: Optional[argparse.ArgumentParser] = None) -> argparse.ArgumentParser:
+    if parser is None:
+        parser = argparse.ArgumentParser(
+            "graftaudit",
+            description="jaxpr/StableHLO-level program auditor: lowers the warmup "
+            "program set (no TPU, no execution) and checks dtype promotion, "
+            "sharding/replication, donation, host transfers; inventories collectives.",
+        )
+    parser.add_argument(
+        "--check", action="store_true",
+        help="CI gate: exit 1 on findings beyond graftaudit_baseline.json",
+    )
+    parser.add_argument(
+        "--baseline", action="store_true",
+        help="rewrite graftaudit_baseline.json from current findings (ratchet reset)",
+    )
+    parser.add_argument(
+        "--baseline-file", default=AUDIT_BASELINE_FILE,
+        help="alternate baseline path (default: repo-root graftaudit_baseline.json)",
+    )
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the program-rule catalog and exit")
+    parser.add_argument("--json", action="store_true",
+                        help="emit findings + per-program summaries as JSON")
+    parser.add_argument("--preset", default="smoke",
+                        help="model preset to lower (warmup presets; default smoke)")
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--fused-steps", type=int, default=1)
+    parser.add_argument("--grad-accum", type=int, default=1)
+    parser.add_argument("--mixed-precision", default=None,
+                        choices=(None, "no", "bf16", "fp16", "fp8"))
+    parser.add_argument("--no-serve", action="store_true",
+                        help="skip the serving programs (audited by default)")
+    parser.add_argument("--no-eval", action="store_true",
+                        help="skip the eval-step program (audited by default)")
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None, out=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    return run_cli(args, out=out)
+
+
+def run_cli(args, out=None) -> int:
+    out = out if out is not None else sys.stdout
+    if args.list_rules:
+        for r in all_program_rules():
+            print(f"{r.id:24s} {r.severity:8s} {r.description}", file=out)
+        return 0
+
+    findings, summaries, stale_sups = run_audit(
+        preset=args.preset,
+        batch_size=args.batch_size,
+        seq_len=args.seq_len,
+        fused_steps=args.fused_steps,
+        grad_accum=args.grad_accum,
+        mixed_precision=args.mixed_precision,
+        serve=not args.no_serve,
+        eval_step=not args.no_eval,
+    )
+
+    if args.baseline:
+        n = write_baseline(findings, args.baseline_file, tool="graftaudit")
+        print(
+            f"graftaudit: wrote {n} grandfathered entr{'y' if n == 1 else 'ies'} "
+            f"({len(findings)} findings) to "
+            f"{os.path.relpath(args.baseline_file, REPO_ROOT)}",
+            file=out,
+        )
+        return 0
+
+    baseline = load_baseline(args.baseline_file)
+    new, grandfathered, stale = apply_baseline(findings, baseline)
+
+    if args.json:
+        print(json.dumps({
+            "findings": [f.__dict__ for f in new],
+            "grandfathered": grandfathered,
+            "programs": summaries,
+        }, indent=2, default=str), file=out)
+    else:
+        for f in new:
+            print(f.format(), file=out)
+    if stale:
+        print(
+            f"graftaudit: {len(stale)} baseline entries no longer observed — ratchet "
+            "down with `python -m accelerate_tpu audit --baseline`", file=out,
+        )
+    for s in stale_sups:
+        print(
+            f"graftaudit: stale suppression (matched nothing): {s.rule} on "
+            f"'{s.program}' — delete it from analysis/program/suppressions.py",
+            file=out,
+        )
+    total_coll = sum(s["collectives"]["total_count"] for s in summaries)
+    print(
+        f"graftaudit: {len(new)} new finding{'s' if len(new) != 1 else ''}, "
+        f"{grandfathered} grandfathered, {len(summaries)} programs lowered, "
+        f"{total_coll} collectives inventoried",
+        file=out,
+    )
+    return 1 if new else 0

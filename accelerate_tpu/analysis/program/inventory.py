@@ -1,0 +1,159 @@
+"""Collective inventory: count + bytes of cross-device communication per program.
+
+Two complementary views, because collectives exist at different levels
+depending on how the program was parallelized:
+
+- **jaxpr level** — collectives the code wrote explicitly (``psum`` /
+  ``all_gather`` / ``psum_scatter`` / ``all_to_all`` / ``ppermute`` inside
+  ``shard_map``/``pmap`` bodies). Visible without compiling.
+- **compiled-HLO level** — collectives the GSPMD partitioner *inserted* for
+  ``jit``-with-sharding programs. These do not exist in the jaxpr or the
+  pre-partitioning StableHLO at all; they only appear in the post-compile
+  executable text, which the warmup path has anyway (it compiles), so the
+  warmup manifest stamps this view.
+
+Bytes are the summed output sizes of the collective ops — the payload a bench
+row wants to diff across PRs ("did this change add an all-gather to the
+step?").
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Optional
+
+from .capture import ProgramCapture
+
+__all__ = ["collective_inventory", "jaxpr_collectives", "hlo_collectives"]
+
+#: jaxpr primitive name -> canonical collective kind.
+_PRIM_KINDS = {
+    "psum": "all_reduce",
+    "psum2": "all_reduce",  # shard_map's psum on the 0.4.x line
+    "pmax": "all_reduce",
+    "pmin": "all_reduce",
+    "all_gather": "all_gather",
+    "psum_scatter": "reduce_scatter",
+    "reduce_scatter": "reduce_scatter",
+    "all_to_all": "all_to_all",
+    "ppermute": "collective_permute",
+}
+
+#: Compiled-HLO op spellings (post-SPMD text uses dashes; StableHLO underscores).
+_HLO_OP_RE = re.compile(
+    r"=\s*(?:\([^)]*\)|[a-z0-9]+\[[0-9,]*\][^ ]*)\s+"
+    r"(all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute)\(",
+)
+_HLO_SHAPE_RE = re.compile(r"([a-z0-9]+)\[([0-9,]*)\]")
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "f8e4m3fn": 1, "f8e5m2": 1,
+    "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4,
+    "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16,
+}
+
+
+def _empty() -> dict:
+    return {"count": 0, "bytes": 0}
+
+
+def _add(summary: dict, kind: str, nbytes: int) -> None:
+    slot = summary.setdefault(kind, _empty())
+    slot["count"] += 1
+    slot["bytes"] += int(nbytes)
+
+
+def _walk_jaxprs(jaxpr):
+    """Yield every (sub)jaxpr reachable through eqn params (scan/while/cond/pjit)."""
+    yield jaxpr
+    for eqn in jaxpr.eqns:
+        for val in eqn.params.values():
+            for sub in _as_jaxprs(val):
+                yield from _walk_jaxprs(sub)
+
+
+def _as_jaxprs(val):
+    inner = getattr(val, "jaxpr", None)  # ClosedJaxpr -> Jaxpr
+    if inner is not None and hasattr(inner, "eqns"):
+        return [inner]
+    if hasattr(val, "eqns"):
+        return [val]
+    if isinstance(val, (tuple, list)):
+        out = []
+        for v in val:
+            out.extend(_as_jaxprs(v))
+        return out
+    return []
+
+
+def jaxpr_collectives(closed_jaxpr) -> dict:
+    """kind -> {count, bytes} for explicitly-written collectives in a jaxpr."""
+    summary: dict = {}
+    if closed_jaxpr is None:
+        return summary
+    root = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    for jaxpr in _walk_jaxprs(root):
+        for eqn in jaxpr.eqns:
+            kind = _PRIM_KINDS.get(eqn.primitive.name)
+            if kind is None:
+                continue
+            nbytes = 0
+            for var in eqn.outvars:
+                aval = getattr(var, "aval", None)
+                if aval is not None and hasattr(aval, "size"):
+                    nbytes += aval.size * getattr(aval.dtype, "itemsize", 4)
+            _add(summary, kind, nbytes)
+    return summary
+
+
+def hlo_collectives(text: Optional[str]) -> dict:
+    """kind -> {count, bytes} for collective ops in compiled-HLO text."""
+    summary: dict = {}
+    if not text:
+        return summary
+    for line in text.splitlines():
+        m = _HLO_OP_RE.search(line)
+        if m is None:
+            continue
+        kind = m.group(1).replace("-", "_")
+        nbytes = 0
+        # Result shapes sit left of the op name; tuple results list several.
+        for dm in _HLO_SHAPE_RE.finditer(line[: m.start(1)]):
+            dtype, dims = dm.group(1), dm.group(2)
+            if dtype not in _DTYPE_BYTES:
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            nbytes += n * _DTYPE_BYTES[dtype]
+        _add(summary, kind, nbytes)
+    return summary
+
+
+def collective_inventory(capture: ProgramCapture) -> dict:
+    """Merged inventory for one captured program (manifest/telemetry shape).
+
+    ``source`` records which views contributed: jaxpr-level counts are always
+    available after lowering; ``compiled`` appears only when the capture went
+    through a compiling path (warmup). The two views are NOT summed into one
+    number — a psum inside shard_map lowers INTO a compiled all-reduce, so
+    adding them would double-count; report both and let the reader diff like
+    against like.
+    """
+    jx = jaxpr_collectives(capture.jaxpr)
+    hlo = hlo_collectives(capture.compiled_text)
+    # Totals come from the compiled view whenever one EXISTS — including a
+    # compiled program with zero collectives ({} is a real answer, not a
+    # missing one: a shard_map psum compiled on a 1-device mesh performs no
+    # comms, and reporting its jaxpr psum as compiled traffic would be the
+    # view-conflation warned about above).
+    primary = hlo if capture.compiled_text is not None else jx
+    return {
+        "label": capture.label,
+        "jaxpr": jx,
+        "compiled": hlo if capture.compiled_text is not None else None,
+        "total_count": sum(v["count"] for v in primary.values()),
+        "total_bytes": sum(v["bytes"] for v in primary.values()),
+    }

@@ -1,0 +1,83 @@
+"""Lower-only program enumeration: every warmed program, no XLA, no execution.
+
+The audit tier must see exactly the programs a real run compiles — not
+hand-picked toy functions — so it reuses the compile-cache warmup enumerator
+(``compile_cache/warmup.py``): the same train/eval/prefill-bucket/decode/insert
+signatures, built through the same ``Accelerator``/``ContinuousBatcher`` data
+paths. The only difference is the cache handed to that enumerator:
+:class:`LowerOnlyCache` traces + lowers each program (cheap: no XLA compile)
+and records a :class:`~.capture.ProgramCapture`, instead of compiling and
+serializing executables.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from ...utils.dataclasses import CompileCacheConfig
+from ...compile_cache.cache import AotCache
+from .capture import ProgramCapture
+
+__all__ = ["LowerOnlyCache", "capture_default_programs", "DEFAULT_AUDIT_GEOMETRY"]
+
+#: The geometry ``audit`` lowers when none is given: the warmup CLI's default
+#: config with eval and serving enabled, so the audited surface is the full
+#: program set a warmed cache directory would hold.
+DEFAULT_AUDIT_GEOMETRY = dict(
+    preset="smoke",
+    batch_size=8,
+    seq_len=128,
+    train=True,
+    eval_step=True,
+    serve=True,
+    max_slots=4,
+    max_new_tokens=32,
+)
+
+
+class LowerOnlyCache(AotCache):
+    """An ``AotCache`` that lowers and captures but never compiles or stores.
+
+    ``enabled``/``supported`` are forced on so the warmup enumerator accepts it
+    even on a jax without executable serialization — nothing is ever
+    serialized. Every ``CachedFunction.warm`` routed here returns status
+    ``lowered`` (or ``lower-failed``) and leaves no cache entry behind.
+    """
+
+    def __init__(self, config: Optional[CompileCacheConfig] = None):
+        super().__init__(config or CompileCacheConfig(enabled=True))
+        self.supported = True
+        self.enabled = True
+        self.capture: List[ProgramCapture] = []
+
+    def _load_or_compile(self, jitted, args, kwargs, label):
+        t0 = time.perf_counter()
+        try:
+            self._lower(jitted, args, kwargs, label)
+        except Exception as exc:  # noqa: BLE001 - surface, don't crash the sweep
+            return None, {
+                "label": label, "key": None, "status": "lower-failed",
+                "seconds": 0.0, "error": f"{type(exc).__name__}: {exc}",
+            }
+        return None, {
+            "label": label, "key": None, "status": "lowered",
+            "seconds": round(time.perf_counter() - t0, 6),
+        }
+
+
+def capture_default_programs(**overrides) -> List[ProgramCapture]:
+    """Lower every program the warmup path enumerates for one config.
+
+    Keyword overrides are ``run_warmup`` parameters (preset, batch_size,
+    mixed_precision, serve, ...) on top of :data:`DEFAULT_AUDIT_GEOMETRY`.
+    Runs the REAL enumerator — Accelerator construction, mesh placement, model
+    init — but stops at lowering, so the whole sweep is tracing-bound (seconds
+    on CPU, no TPU needed).
+    """
+    from ...compile_cache.warmup import run_warmup
+
+    geometry = {**DEFAULT_AUDIT_GEOMETRY, **overrides}
+    cache = LowerOnlyCache()
+    run_warmup(cache=cache, emit_manifest=False, **geometry)
+    return cache.capture

@@ -14,6 +14,7 @@ from .rng_reuse import RngReuseRule
 from .recompile_hazard import RecompileHazardRule
 from .donation_safety import DonationSafetyRule
 from .dead_knob import DeadKnobRule
+from .pspec_mesh import PspecMeshMismatchRule
 
 __all__ = ["all_rules", "rule_by_id"]
 
@@ -27,6 +28,7 @@ def all_rules():
         RecompileHazardRule(),
         DonationSafetyRule(),
         DeadKnobRule(),
+        PspecMeshMismatchRule(),
     ]
 
 

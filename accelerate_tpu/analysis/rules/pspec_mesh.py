@@ -1,0 +1,100 @@
+"""pspec-mesh-mismatch: a PartitionSpec axis name the mesh does not define.
+
+Incident class: a ``PartitionSpec("model")`` against a mesh whose axes are
+``(dp, fsdp, tp, ...)`` fails only when the constraint is actually applied —
+deep inside a traced function, often only on the multi-chip path that CI never
+runs. The axis *vocabulary* is static in this codebase (``utils/constants.py``
+``*_AXIS`` strings + any literal ``Mesh(..., ("a", "b"))``), so the check is a
+pure AST pass: every string literal inside a ``PartitionSpec(...)`` call must
+name a declared axis.
+
+Scope guard: if the linted file set declares NO axis names at all, the rule
+stays silent — there is no vocabulary to check against (keeps the rule inert
+on foreign code snippets).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Sequence
+
+from ..astutil import dotted
+from ..engine import FileUnit, Finding, Rule
+
+#: Spellings of the PartitionSpec constructor in this codebase.
+_PSPEC_NAMES = frozenset({
+    "PartitionSpec", "P", "jax.sharding.PartitionSpec", "sharding.PartitionSpec",
+})
+#: Mesh constructors whose axis-name argument declares the vocabulary.
+_MESH_NAMES = frozenset({
+    "Mesh", "jax.sharding.Mesh", "sharding.Mesh", "jax.make_mesh", "make_mesh",
+    "AbstractMesh", "jax.sharding.AbstractMesh",
+})
+
+
+def _literal_strs(node: ast.AST) -> List[str]:
+    """String constants in ``"x"`` / ``("x", "y")`` / ``["x", "y"]`` (nested ok)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for e in node.elts:
+            out.extend(_literal_strs(e))
+        return out
+    return []
+
+
+class PspecMeshMismatchRule(Rule):
+    id = "pspec-mesh-mismatch"
+    severity = "error"
+    description = "PartitionSpec names an axis no mesh defines"
+
+    def finalize(self, units: Sequence[FileUnit]) -> Iterable[Finding]:
+        axes = self._declared_axes(units)
+        if not axes:
+            return []
+        findings = []
+        for unit in units:
+            for node in ast.walk(unit.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if dotted(node.func) not in _PSPEC_NAMES:
+                    continue
+                for arg in node.args:
+                    for name in _literal_strs(arg):
+                        if name not in axes:
+                            findings.append(
+                                self.make(
+                                    unit,
+                                    node,
+                                    f"PartitionSpec axis '{name}' is not a declared "
+                                    f"mesh axis (known: {', '.join(sorted(axes))}) — "
+                                    "the constraint will fail at trace time on the "
+                                    "multi-chip path",
+                                )
+                            )
+        return findings
+
+    def _declared_axes(self, units: Sequence[FileUnit]) -> set:
+        """Axis vocabulary: ``*_AXIS = "name"`` constants, axis-name tuples
+        (``MESH_AXIS_NAMES = (...)``), and literal Mesh(...) axis arguments."""
+        axes: set = set()
+        for unit in units:
+            for node in ast.walk(unit.tree):
+                if isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if (
+                            isinstance(t, ast.Name)
+                            and "AXIS" in t.id
+                            and t.id.isupper()
+                        ):
+                            axes.update(_literal_strs(node.value))
+                elif isinstance(node, ast.Call) and dotted(node.func) in _MESH_NAMES:
+                    # Mesh(devices, ("dp", "tp")) / make_mesh(shape, ("dp",)) —
+                    # the axis-name tuple is the 2nd positional or a keyword.
+                    if len(node.args) >= 2:
+                        axes.update(_literal_strs(node.args[1]))
+                    for kw in node.keywords:
+                        if kw.arg in ("axis_names", "axis_name"):
+                            axes.update(_literal_strs(kw.value))
+        return axes

@@ -26,6 +26,7 @@ from typing import Any, Optional
 
 import numpy as np
 import jax
+import jax.numpy as jnp
 
 from .logging import get_logger
 from .utils.constants import (
@@ -177,7 +178,19 @@ def save_accelerator_state(
             if full_file.exists() and accelerator.is_main_process:
                 full_file.unlink()  # same: a stale FULL file would shadow this save on load
             if async_save:
-                _async_checkpointer().save(sharded_dir, train_state)
+                # Snapshot BEFORE handing off: orbax's background threads read the
+                # buffers after save() returns, but the caller immediately resumes
+                # (donating) training — on the CPU backend the donated buffers are
+                # then overwritten IN PLACE and the background write would persist
+                # post-step values (observed: async roundtrip restoring a state
+                # 3 steps newer than the save point). jnp.copy allocates fresh
+                # device buffers with the same shardings (multi-host safe); the
+                # transient 2x state memory lives only until the write commits.
+                snapshot = jax.tree_util.tree_map(
+                    lambda l: jnp.copy(l) if isinstance(l, jax.Array) else l,
+                    train_state,
+                )
+                _async_checkpointer().save(sharded_dir, snapshot)
             else:
                 with ocp.StandardCheckpointer() as ckptr:
                     ckptr.save(sharded_dir, train_state)

@@ -1,0 +1,33 @@
+"""``accelerate-tpu audit`` — run graftaudit (see ``accelerate_tpu/analysis/program/``).
+
+Thin wrapper like ``commands/lint.py``; the program enumeration, rules and
+baseline live in ``analysis.program.cli``. This command imports jax (CPU
+backend) — it traces and lowers real programs, unlike ``lint``."""
+
+from __future__ import annotations
+
+import argparse
+
+from ..analysis.program.cli import build_arg_parser, run_cli
+
+__all__ = ["audit_command", "audit_command_parser"]
+
+
+def audit_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = (
+        "Program-level (jaxpr/StableHLO) audit of the warmup program set: dtype "
+        "promotion, replicated sharding, dead donation, host transfers, plus a "
+        "collective inventory. CPU backend, no execution, ratcheting baseline."
+    )
+    if subparsers is not None:
+        parser = subparsers.add_parser("audit", description=description)
+    else:
+        parser = argparse.ArgumentParser("accelerate-tpu audit", description=description)
+    build_arg_parser(parser)
+    if subparsers is not None:
+        parser.set_defaults(func=audit_command)
+    return parser
+
+
+def audit_command(args) -> int:
+    return run_cli(args)

@@ -84,6 +84,10 @@ class AotCache:
         self.deserialize_ms = 0.0
         self.compile_s = 0.0
         self._memo: dict = {}      # fingerprint -> loaded executable (cross-wrapper)
+        #: When a list, every lowering routed through this cache appends a
+        #: ``analysis.program.ProgramCapture`` — the hook the program auditor
+        #: (graftaudit) and the warmup manifest's audit stamp hang off.
+        self.capture = None
 
     # ------------------------------------------------------------------ public API
     def stats(self) -> dict:
@@ -122,13 +126,29 @@ class AotCache:
     def entry_path(self, key: str) -> str:
         return os.path.join(self.cache_dir, f"{key}.aotx")
 
+    # ------------------------------------------------------------------ lowering
+    def _lower(self, jitted, args, kwargs, label: str):
+        """Lower one call signature, feeding the program-capture hook when armed.
+
+        With ``self.capture`` set (a list), the traced jaxpr and any lower-time
+        warnings (jax's "donated buffers were not usable" fires here) are
+        recorded alongside the lowered program — the raw material of the
+        graftaudit rules (``analysis/program/``)."""
+        if self.capture is None:
+            return jitted.lower(*args, **kwargs)
+        from ..analysis.program.capture import capture_lowering
+
+        lowered, entry = capture_lowering(jitted, args, kwargs, label)
+        self.capture.append(entry)
+        return lowered
+
     # ------------------------------------------------------------------ internals
     def _load_or_compile(self, jitted, args, kwargs, label: str):
         """(executable_or_None, manifest_info). Never raises: every failure path
         degrades to live compile (None) or a fresh compile overwriting the bad
         entry."""
         try:
-            lowered = jitted.lower(*args, **kwargs)
+            lowered = self._lower(jitted, args, kwargs, label)
             key = fingerprint(lowered.as_text())
         except Exception as exc:  # noqa: BLE001 - any unlowerable call goes live
             logger.warning("compile cache: lowering %s failed (%s); using live jit",
@@ -153,6 +173,7 @@ class AotCache:
                 self.hits += 1
                 self.deserialize_ms += dt * 1e3
                 self._memo[key] = exe
+                self._attach_compiled(lowered, exe)
                 _dispatch_cache_event(hit=True, deserialize_s=dt)
                 return exe, {
                     "label": label, "key": key, "status": "hit",
@@ -176,10 +197,24 @@ class AotCache:
         self.compile_s += dt
         _dispatch_cache_event(hit=False)
         self._memo[key] = compiled
+        self._attach_compiled(lowered, compiled)
         self._store(key, label, compiled)
         return compiled, {
             "label": label, "key": key, "status": "miss", "seconds": round(dt, 6),
         }
+
+    def _attach_compiled(self, lowered, executable) -> None:
+        """Hand the post-SPMD executable text to the matching capture entry —
+        the only representation in which GSPMD-inserted collectives exist."""
+        if self.capture is None:
+            return
+        for entry in reversed(self.capture):
+            if entry.lowered is lowered and entry.compiled_text is None:
+                try:
+                    entry.compiled_text = executable.as_text()
+                except Exception:  # noqa: BLE001 - e.g. deserialized exe w/o HLO
+                    pass
+                return
 
     def _store(self, key: str, label: str, compiled) -> None:
         """Serialize + atomic-write one entry; storage failures only cost

@@ -74,6 +74,8 @@ def run_warmup(
     max_new_tokens: int = 32,
     cache_config: Optional[CompileCacheConfig] = None,
     manifest_path: Optional[str] = None,
+    cache=None,
+    emit_manifest: bool = True,
 ) -> dict:
     """Pre-compile the programs for one config into the AOT cache.
 
@@ -82,11 +84,20 @@ def run_warmup(
     through the SAME data paths the real run uses (mesh-sharded batches, engine
     cache layouts), so the fingerprints match what ``Accelerator`` /
     ``ContinuousBatcher`` will look up.
+
+    ``cache`` injects a pre-built ``AotCache`` (the program auditor passes a
+    ``LowerOnlyCache`` so the SAME enumeration feeds graftaudit without
+    compiling anything); ``emit_manifest=False`` skips the manifest file for
+    such in-memory uses. Every program's audit provenance (collective
+    inventory, donation effectiveness) is stamped into the manifest under
+    ``program_audit`` and emitted as telemetry records when telemetry is on.
     """
     from ..accelerator import Accelerator
     from ..models import llama
 
-    config = cache_config or CompileCacheConfig(enabled=True)
+    config = cache.config if cache is not None else (
+        cache_config or CompileCacheConfig(enabled=True)
+    )
     if not config.enabled:
         raise ValueError("warmup needs an enabled CompileCacheConfig")
 
@@ -98,7 +109,14 @@ def run_warmup(
         gradient_accumulation_steps=grad_accum,
         compile_cache_config=config,
     )
-    cache = accelerator.compile_cache
+    if cache is not None:
+        # Injected cache (audit / tests): every jit the accelerator wraps from
+        # here on routes through it instead of the one built from the config.
+        accelerator.compile_cache = cache
+    else:
+        cache = accelerator.compile_cache
+    if cache.capture is None:
+        cache.capture = []  # arm program capture: the manifest stamps audit provenance
     if not cache.enabled:
         # An unsupported jax degrades the cache to live compiles — fine for a
         # training run, but a warmup whose whole purpose is priming the cache
@@ -153,6 +171,15 @@ def run_warmup(
         )
         entries.extend(engine.warm_programs(max_new_tokens=max_new_tokens))
 
+    # Per-program audit provenance: the captures recorded at lowering carry the
+    # jaxpr + StableHLO (and compiled HLO on misses), so the manifest records
+    # what the cached executables actually DO — collective counts/bytes and
+    # whether donation aliased — not just that they exist.
+    from ..analysis.program.audit import audit_summaries
+
+    summaries = audit_summaries(cache.capture)
+    _emit_audit_telemetry(accelerator, summaries)
+
     manifest = {
         "schema": MANIFEST_SCHEMA,
         "preset": preset,
@@ -167,9 +194,28 @@ def run_warmup(
         "cache_dir": cache.cache_dir,
         "cache_stats": cache.stats(),
         "programs": [e for e in entries if e],
+        "program_audit": summaries,
     }
-    write_manifest(manifest, manifest_path or os.path.join(cache.cache_dir, MANIFEST_NAME))
+    if emit_manifest:
+        write_manifest(
+            manifest, manifest_path or os.path.join(cache.cache_dir, MANIFEST_NAME)
+        )
     return manifest
+
+
+def _emit_audit_telemetry(accelerator, summaries: list) -> None:
+    """Route per-program audit summaries into telemetry (bench rows diff comms
+    across PRs from these records). No-op when telemetry is off."""
+    telemetry = getattr(accelerator, "telemetry", None)
+    if telemetry is None or not getattr(telemetry, "enabled", False):
+        return
+    for s in summaries:
+        telemetry.emit({
+            "schema": "accelerate_tpu.telemetry.audit.program/v1",
+            "label": s["label"],
+            "collectives": s["collectives"],
+            "donation": s["donation"],
+        })
 
 
 def write_manifest(manifest: dict, path: str) -> None:
@@ -178,3 +224,4 @@ def write_manifest(manifest: dict, path: str) -> None:
         json.dump(manifest, f, indent=2)
     logger.info("warmup manifest written to %s (%d programs)",
                 path, len(manifest["programs"]))
+

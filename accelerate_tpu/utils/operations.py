@@ -33,6 +33,7 @@ from .constants import BATCH_AXES
 from .dataclasses import TensorInformation
 
 __all__ = [
+    "host_snapshot",
     "is_tensor",
     "is_namedtuple",
     "honor_type",
@@ -144,6 +145,27 @@ def find_device(data):
         if devices:
             return next(iter(devices))
     return None
+
+
+def host_snapshot(tree):
+    """Deep-copying device→host snapshot of a pytree — safe across donation.
+
+    ``jax.device_get``/``np.asarray`` on the CPU backend return ZERO-COPY views
+    of the device buffer. A train step built with ``donate=True`` then reuses
+    that buffer in place, and every "host snapshot" taken before the step
+    silently becomes the post-step values (whether XLA actually reuses the
+    buffer depends on how the executable was compiled/loaded — the graftaudit
+    donation case study, docs/graftaudit.md). ``np.array(..., copy=True)``
+    severs the aliasing; use this for any host-side value that must survive
+    further (donating) training.
+    """
+
+    def _leaf(x):
+        if isinstance(x, jax.Array):
+            return np.array(jax.device_get(x), copy=True)
+        return x
+
+    return jax.tree_util.tree_map(_leaf, tree)
 
 
 def send_to_device(tensor, device, non_blocking: bool = False, skip_keys=None):

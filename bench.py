@@ -367,6 +367,11 @@ def run(B: int, S: int, fuse: int, preset: str | None, default_metric: str | Non
     t_cold = time.perf_counter()
     try:
         acc = Accelerator(mixed_precision="bf16", gradient_accumulation_steps=accum)
+        # Arm graftaudit program capture: when the AOT compile cache is enabled
+        # (ACCELERATE_COMPILE_CACHE) every lowered program records its jaxpr +
+        # StableHLO, and the row below stamps collective counts/bytes + donation
+        # effectiveness — bench rows then diff comms across PRs (ISSUE 4).
+        acc.compile_cache.capture = []
         state = acc.create_train_state(
             llama.init_params(cfg), _make_optimizer(os.environ.get("BENCH_OPT", "adamw"))
         )
@@ -491,6 +496,24 @@ def run(B: int, S: int, fuse: int, preset: str | None, default_metric: str | Non
         "cold_compile_s": cold["compile_s_total"],
         "compile_cache": acc.compile_cache.stats(),
     }
+    if acc.compile_cache.capture:
+        from accelerate_tpu.analysis.program import audit_summaries
+
+        out["program_audit"] = [
+            {
+                "label": s["label"],
+                # Compiled view when it exists ({} = compiled, genuinely no
+                # comms); jaxpr view only for lower-only captures.
+                "collectives": (
+                    s["collectives"]["compiled"]
+                    if s["collectives"]["compiled"] is not None
+                    else s["collectives"]["jaxpr"]
+                ),
+                "collective_bytes": s["collectives"]["total_bytes"],
+                "donation": s["donation"],
+            }
+            for s in audit_summaries(acc.compile_cache.capture)
+        ]
     if ceiling is not None:
         mfu_measured = tflops / ceiling
         if mfu_measured > 1.0:

@@ -70,6 +70,12 @@ MODULES = [
     ("accelerate_tpu.utils.jax_compat", "JAX version compatibility"),
     ("accelerate_tpu.analysis.engine", "Static analysis (graftlint) engine"),
     ("accelerate_tpu.analysis.baseline", "Static analysis ratcheting baseline"),
+    ("accelerate_tpu.analysis.program.capture", "Program audit: lowering capture"),
+    ("accelerate_tpu.analysis.program.lowering", "Program audit: lower-only enumeration"),
+    ("accelerate_tpu.analysis.program.rules", "Program audit rules (graftaudit)"),
+    ("accelerate_tpu.analysis.program.inventory", "Program audit: collective inventory"),
+    ("accelerate_tpu.analysis.program.suppressions", "Program audit suppressions"),
+    ("accelerate_tpu.analysis.program.audit", "Program audit driver"),
     ("accelerate_tpu.compile_cache.cache", "AOT compile cache"),
     ("accelerate_tpu.compile_cache.fingerprint", "Compile-cache fingerprints"),
     ("accelerate_tpu.compile_cache.buckets", "Serving shape buckets"),

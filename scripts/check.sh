@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Single CI/dev gate: AST lint + program audit + docs/api drift, one exit code.
+#
+#   scripts/check.sh          # all three gates
+#   scripts/check.sh --fast   # lint only (no jax import, <5 s)
+#
+# Each gate exits non-zero on ANY new finding (the baselines are empty at HEAD
+# and only shrink — fix or suppress-with-reason, never grandfather). The gates
+# run separately (rather than one `lint --check`, which folds all three in) so
+# a failure names its tier in the output.
+set -u -o pipefail
+
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+# Audit the 8-virtual-device geometry the test suite validates: on 1 device the
+# replicated-sharding rule can never fire (every sharding is trivially local).
+case "${XLA_FLAGS:-}" in
+  *xla_force_host_platform_device_count*) ;;
+  *) export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8" ;;
+esac
+
+rc=0
+
+echo "== graftlint (AST tier) =="
+python -m accelerate_tpu lint --check --skip-docs --skip-audit || rc=1
+
+if [ "${1:-}" = "--fast" ]; then
+    exit $rc
+fi
+
+echo "== graftaudit (program tier) =="
+python -m accelerate_tpu audit --check || rc=1
+
+echo "== docs/api drift =="
+# The docs gate lives on the lint CLI; an empty-path lint is not possible, so
+# run it over one tiny file and keep only the docs verdict.
+python - <<'EOF' || rc=1
+from accelerate_tpu.analysis.cli import docs_are_fresh
+raise SystemExit(0 if docs_are_fresh() else 1)
+EOF
+
+exit $rc

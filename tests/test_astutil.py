@@ -1,0 +1,275 @@
+"""Coverage for ``analysis/astutil.py`` and engine suppression edge cases (ISSUE 4).
+
+The astutil helpers are load-bearing for every graftlint rule; until now they
+were only exercised indirectly. Plus the suppression-parser edges: several
+rules on one line, unknown rules inside fixture files, and the baseline
+ratchet refusing to regrow.
+"""
+
+import ast
+import textwrap
+
+from accelerate_tpu.analysis import run_lint
+from accelerate_tpu.analysis.astutil import (
+    assigned_names,
+    const_int_seq,
+    const_str_seq,
+    dataclass_fields,
+    decorator_jit_kwargs,
+    dotted,
+    enclosing,
+    func_all_param_names,
+    func_param_names,
+    is_dataclass_def,
+    jit_wrap_info,
+    parent_map,
+    walk_in_order,
+)
+from accelerate_tpu.analysis.baseline import apply_baseline, load_baseline, write_baseline
+from accelerate_tpu.analysis.engine import parse_suppressions, load_unit
+
+
+def parse_expr(src):
+    return ast.parse(textwrap.dedent(src)).body[0].value
+
+
+def parse_mod(src):
+    return ast.parse(textwrap.dedent(src))
+
+
+# ------------------------------------------------------------------------- dotted
+
+def test_dotted_resolves_attribute_chains():
+    assert dotted(parse_expr("jax.random.PRNGKey")) == "jax.random.PRNGKey"
+    assert dotted(parse_expr("x")) == "x"
+
+
+def test_dotted_breaks_on_calls_and_subscripts():
+    assert dotted(parse_expr("a().b")) is None
+    assert dotted(parse_expr("a[0].b")) is None
+    assert dotted(parse_expr("(a + b).c")) is None
+
+
+# ------------------------------------------------------------------- const sequences
+
+def test_const_str_seq_forms():
+    assert const_str_seq(parse_expr('"x"')) == ["x"]
+    assert const_str_seq(parse_expr('("x", "y")')) == ["x", "y"]
+    assert const_str_seq(parse_expr('["x", "y"]')) == ["x", "y"]
+    assert const_str_seq(None) == []
+    assert const_str_seq(parse_expr("(name, 'y')")) == ["y"]  # non-consts skipped
+
+
+def test_const_int_seq_forms():
+    assert const_int_seq(parse_expr("0")) == [0]
+    assert const_int_seq(parse_expr("(0, 2)")) == [0, 2]
+    assert const_int_seq(parse_expr("[1]")) == [1]
+    assert const_int_seq(None) == []
+
+
+# ----------------------------------------------------------------- jit detection
+
+def test_jit_wrap_info_and_decorator_kwargs():
+    call = parse_expr("jax.jit(fn, donate_argnums=(0,), static_argnames=('n',))")
+    info = jit_wrap_info(call)
+    assert info is not None and const_int_seq(info["kwargs"]["donate_argnums"]) == [0]
+    assert jit_wrap_info(parse_expr("other(fn)")) is None
+
+    mod = parse_mod("""
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("n",))
+    def f(x, n):
+        return x
+
+    @jax.jit
+    def g(x):
+        return x
+
+    @other
+    def h(x):
+        return x
+    """)
+    f, g, h = [n for n in mod.body if isinstance(n, ast.FunctionDef)]
+    assert "static_argnames" in decorator_jit_kwargs(f.decorator_list[0])
+    assert decorator_jit_kwargs(g.decorator_list[0]) == {}
+    assert decorator_jit_kwargs(h.decorator_list[0]) is None
+
+
+def test_func_param_names_cover_kwonly():
+    mod = parse_mod("""
+    def f(a, b, *, cfg, scale=1.0):
+        return a
+    """)
+    fn = mod.body[0]
+    assert func_param_names(fn) == ["a", "b"]
+    assert func_all_param_names(fn) == ["a", "b", "cfg", "scale"]
+
+
+# ----------------------------------------------------------------- assigned names
+
+def test_assigned_names_statement_kinds():
+    mod = parse_mod("""
+    a = 1
+    b, (c, *d) = x
+    e += 1
+    f: int = 2
+    for g, h in items:
+        pass
+    with open(p) as fh:
+        pass
+    def fn():
+        pass
+    class K:
+        pass
+    """)
+    stmts = mod.body
+    assert assigned_names(stmts[0]) == {"a"}
+    assert assigned_names(stmts[1]) == {"b", "c", "d"}
+    assert assigned_names(stmts[2]) == {"e"}
+    assert assigned_names(stmts[3]) == {"f"}
+    assert assigned_names(stmts[4]) == {"g", "h"}
+    assert assigned_names(stmts[5]) == {"fh"}
+    assert assigned_names(stmts[6]) == {"fn"}
+    assert assigned_names(stmts[7]) == {"K"}
+
+
+# ------------------------------------------------------------------- tree walking
+
+def test_walk_in_order_is_depth_first_source_order():
+    mod = parse_mod("""
+    def outer():
+        inner_first = 1
+        def inner():
+            deep = 2
+        later = 3
+    """)
+    names = [n.id for n in walk_in_order(mod) if isinstance(n, ast.Name)]
+    assert names == ["inner_first", "deep", "later"]  # bfs would put 'later' before 'deep'
+
+
+def test_parent_map_and_enclosing():
+    mod = parse_mod("""
+    def f():
+        for i in range(3):
+            x = i
+    """)
+    parents = parent_map(mod)
+    assign = mod.body[0].body[0].body[0]
+    assert isinstance(enclosing(assign, parents, ast.For), ast.For)
+    assert isinstance(enclosing(assign, parents, ast.FunctionDef), ast.FunctionDef)
+    assert enclosing(assign, parents, ast.While) is None
+
+
+# -------------------------------------------------------------------- dataclasses
+
+def test_dataclass_detection_and_fields():
+    mod = parse_mod("""
+    import dataclasses
+    from typing import ClassVar
+
+    @dataclasses.dataclass(frozen=True)
+    class Cfg:
+        lr: float = 1e-3
+        tag: ClassVar[str] = "x"
+        steps: int = 10
+
+    class Plain:
+        lr: float = 1.0
+    """)
+    cfg, plain = [n for n in mod.body if isinstance(n, ast.ClassDef)]
+    assert is_dataclass_def(cfg) and not is_dataclass_def(plain)
+    assert [name for name, _ in dataclass_fields(cfg)] == ["lr", "steps"]
+
+
+# --------------------------------------------------- suppression parser edge cases
+
+def write_unit(tmp_path, src, name="snippet.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(src))
+    return load_unit(str(f), root=str(tmp_path))
+
+
+def test_multiple_rules_suppressed_on_one_line(tmp_path):
+    unit = write_unit(tmp_path, """
+    import jax
+
+    def f():
+        return jax.random.PRNGKey(0)  # graftlint: disable=rng-key-reuse(pinned seed by contract), jit-impurity(not actually jitted)
+    """)
+    sups = parse_suppressions(unit)
+    assert {(s.rule, s.reason) for s in sups} == {
+        ("rng-key-reuse", "pinned seed by contract"),
+        ("jit-impurity", "not actually jitted"),
+    }
+    # Both suppressions validate (known rules, reasons given) and the rng
+    # finding is silenced — no bad-suppression, no rng-key-reuse.
+    findings = run_lint(paths=(str(tmp_path / "snippet.py"),), root=str(tmp_path))
+    assert not findings
+
+
+def test_mixed_known_unknown_rules_on_one_line(tmp_path):
+    unit_path = tmp_path / "s.py"
+    unit_path.write_text(textwrap.dedent("""
+    import jax
+
+    def f():
+        return jax.random.PRNGKey(0)  # graftlint: disable=rng-key-reuse(ok reason), not-a-rule(whatever)
+    """))
+    findings = run_lint(paths=(str(unit_path),), root=str(tmp_path))
+    rules = sorted(f.rule for f in findings)
+    # The known suppression still works; the unknown one is its own error.
+    assert rules == ["bad-suppression"]
+    assert "not-a-rule" in findings[0].message
+
+
+def test_suppression_of_unknown_rule_inside_fixture_dir(tmp_path):
+    """Fixture files are linted like any other: an unknown rule id in a
+    suppression comment is an error even under tests/ paths."""
+    p = tmp_path / "tests" / "fixtures"
+    p.mkdir(parents=True)
+    (p / "fixture_snip.py").write_text(
+        "x = 1  # graftlint: disable=made-up-rule(because)\n"
+    )
+    findings = run_lint(paths=(str(p),), root=str(tmp_path))
+    assert [f.rule for f in findings] == ["bad-suppression"]
+
+
+# ------------------------------------------------------------------ ratchet refusal
+
+def test_baseline_ratchet_refuses_regrowth(tmp_path):
+    """A baseline written at N findings absorbs at most N: the N+1th instance of
+    the SAME keyed finding fails, and clearing the code reports stale entries."""
+    src = """
+    import dataclasses
+
+    @dataclasses.dataclass
+    class Cfg:
+        dead_one: int = 1
+    """
+    f = tmp_path / "cfg.py"
+    f.write_text(textwrap.dedent(src))
+    findings = run_lint(paths=(str(f),), root=str(tmp_path))
+    assert len(findings) == 1
+    bl = tmp_path / "bl.json"
+    write_baseline(findings, str(bl))
+
+    # Same finding twice (the keyed line duplicated in another class) exceeds
+    # the grandfathered count — exactly one comes back as new.
+    worse_src = src + """
+    @dataclasses.dataclass
+    class Cfg2:
+        dead_one: int = 1
+    """
+    f.write_text(textwrap.dedent(worse_src))
+    worse = run_lint(paths=(str(f),), root=str(tmp_path))
+    assert len(worse) == 2
+    new, grandfathered, stale = apply_baseline(worse, load_baseline(str(bl)))
+    assert len(new) == 1 and grandfathered == 1 and not stale
+
+    # Fixing everything leaves the baseline entry stale — the ratchet-down signal.
+    f.write_text("")
+    clean = run_lint(paths=(str(f),), root=str(tmp_path))
+    new, grandfathered, stale = apply_baseline(clean, load_baseline(str(bl)))
+    assert not new and not grandfathered and len(stale) == 1

@@ -1,0 +1,103 @@
+"""Tier-1 gate: graftaudit over the real program set stays clean (ISSUE 4).
+
+Lowers every program the warmup path enumerates for the default config —
+train, eval, prefill buckets, chunk-append, decode, row inserts — through the
+SAME enumerator the AOT cache warmup uses, and fails on any finding beyond the
+committed (empty) ``graftaudit_baseline.json``. The contract mirrors
+graftlint's: the baseline only shrinks; fix the program or add a reasoned
+entry to ``analysis/program/suppressions.SUPPRESSIONS``.
+"""
+
+import json
+import os
+
+import pytest
+
+from accelerate_tpu.analysis.baseline import apply_baseline, load_baseline
+from accelerate_tpu.analysis.program import (
+    AUDIT_BASELINE_FILE,
+    audit_findings,
+    capture_default_programs,
+)
+
+
+@pytest.fixture(scope="module")
+def default_captures():
+    return capture_default_programs()
+
+
+def test_audit_clean_beyond_baseline(default_captures):
+    findings, stale_sups = audit_findings(default_captures)
+    baseline = load_baseline(AUDIT_BASELINE_FILE)
+    new, _grandfathered, _stale = apply_baseline(findings, baseline)
+    listing = "\n".join(f.format() for f in new)
+    assert not new, (
+        f"{len(new)} graftaudit finding(s) beyond graftaudit_baseline.json:\n{listing}\n"
+        "Fix the program, or add a reasoned entry to "
+        "analysis/program/suppressions.SUPPRESSIONS. Do not add baseline entries — "
+        "the ratchet only shrinks (docs/graftaudit.md)."
+    )
+    assert not stale_sups, (
+        f"stale audit suppressions (matched nothing): {stale_sups}"
+    )
+
+
+def test_audit_baseline_is_empty_at_head():
+    with open(AUDIT_BASELINE_FILE) as f:
+        data = json.load(f)
+    assert data["tool"] == "graftaudit"
+    assert data["findings"] == [], (
+        "graftaudit_baseline.json must stay empty: fix or suppress with a reason"
+    )
+
+
+def test_default_enumeration_covers_the_warmup_surface(default_captures):
+    """The audit lowers the SAME labels the warmup path compiles: both train
+    step variants' coverage comes from the same enumerator, so auditing the
+    default geometry means auditing what a warm cache directory serves."""
+    labels = {c.label for c in default_captures}
+    assert "train_step.apply" in labels
+    assert "eval_step" in labels
+    assert "serving.decode" in labels
+    assert any(l.startswith("serving.prefill") for l in labels), labels
+    assert any("insert" in l for l in labels), labels
+    # Every capture actually lowered: the StableHLO text parses a @main.
+    for c in default_captures:
+        assert "@main" in c.hlo_text, c.label
+
+
+def test_warmup_manifest_stamps_audit_provenance(tmp_path):
+    """run_warmup writes per-program collective counts + donation effectiveness
+    into the manifest (cached executables carry their audit provenance)."""
+    from accelerate_tpu.analysis.program import LowerOnlyCache
+    from accelerate_tpu.compile_cache.warmup import run_warmup
+
+    cache = LowerOnlyCache()
+    manifest = run_warmup(
+        cache=cache,
+        manifest_path=str(tmp_path / "m.json"),
+        preset="smoke", batch_size=4, seq_len=32, serve=False, eval_step=False,
+    )
+    audit = manifest["program_audit"]
+    assert audit, "manifest carries no program_audit entries"
+    by_label = {a["label"]: a for a in audit}
+    apply = by_label["train_step.apply"]
+    assert apply["donation"]["donated"] > 0
+    assert apply["donation"]["dead"] == 0, (
+        "train-step donation regressed: "
+        f"{apply['donation']} — see the micro-counter incident in docs/graftaudit.md"
+    )
+    assert "collectives" in apply and "jaxpr" in apply["collectives"]
+    with open(tmp_path / "m.json") as f:
+        on_disk = json.load(f)
+    assert on_disk["program_audit"] == audit
+
+
+def test_cli_smoke(capsys):
+    from accelerate_tpu.analysis.program.cli import main
+
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in ("dtype-promotion", "replicated-sharding", "dead-donation",
+                    "host-transfer"):
+        assert rule_id in out

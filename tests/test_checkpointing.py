@@ -13,7 +13,7 @@ from accelerate_tpu import Accelerator
 from accelerate_tpu.parallel import MeshConfig
 from accelerate_tpu.data_loader import DataLoader
 from accelerate_tpu.utils import FullyShardedDataParallelPlugin, ProjectConfiguration
-from accelerate_tpu.utils import send_to_device
+from accelerate_tpu.utils import host_snapshot, send_to_device
 
 from test_accelerator import RegressionDataset, init_params, loss_fn
 
@@ -40,10 +40,11 @@ def test_save_load_roundtrip(tmp_path):
     state, _ = train_some(acc, state, step, dl)
 
     ckpt = acc.save_state(str(tmp_path / "ckpt"), train_state=state)
-    # Snapshot host copies: the train step donates state buffers, so the old `state`
-    # object is consumed by further training.
-    saved_params = jax.device_get(state.params)
-    saved_opt = jax.device_get(state.opt_state)
+    # DEEP-COPYING snapshot: the train step donates state buffers and jax.device_get
+    # on CPU returns zero-copy views that would mutate in place under further
+    # (donating) training — the graftaudit donation case study.
+    saved_params = host_snapshot(state.params)
+    saved_opt = host_snapshot(state.opt_state)
     saved_step = int(state.step)
     # Mutate: keep training.
     state2, _ = train_some(acc, state, step, dl)
@@ -160,7 +161,9 @@ def test_async_save_roundtrip(tmp_path):
         acc.mesh,
     )
     state, _ = step(state, batch)
-    want = jax.tree_util.tree_map(np.asarray, state.params)
+    # np.asarray here would be a zero-copy VIEW of the donated buffers — the very
+    # bug this test guards against on the library side.
+    want = host_snapshot(state.params)
     acc.save_state(str(tmp_path / "ck"), train_state=state, async_save=True)
     # Immediately train on (donate) the state while the disk write is in flight.
     for _ in range(3):
@@ -188,7 +191,7 @@ def test_f8_optimizer_state_roundtrip(tmp_path):
     assert isinstance(state.opt_state, ScaledAdamState)
 
     ckpt = acc.save_state(str(tmp_path / "ckpt_f8"), train_state=state)
-    saved_opt = jax.device_get(state.opt_state)
+    saved_opt = host_snapshot(state.opt_state)  # deep copy: survives donated steps
     state2, _ = train_some(acc, state, step, dl)
     assert not tree_equal(saved_opt, state2.opt_state)
 

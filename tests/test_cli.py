@@ -305,7 +305,10 @@ def test_cli_help_lists_subcommands(capsys):
     with pytest.raises(SystemExit):
         parser.parse_args(["--help"])
     out = capsys.readouterr().out
-    for sub in ("config", "env", "estimate-memory", "launch", "merge-weights", "test", "tpu-config"):
+    for sub in (
+        "audit", "config", "env", "estimate-memory", "launch", "lint",
+        "merge-weights", "test", "tpu-config", "warmup",
+    ):
         assert sub in out
 
 

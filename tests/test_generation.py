@@ -20,7 +20,16 @@ from accelerate_tpu.test_utils.testing import slow, slow_mark
 
 @pytest.fixture(scope="module")
 def tiny():
-    cfg = dataclasses.replace(llama.CONFIGS["tiny"], attn_impl="xla")
+    # f32, not the config default bf16: these tests assert EXACT token equality
+    # between different programs (cached vs uncached, padded vs unpadded). That
+    # equality holds in exact arithmetic (rope is relative), but under bf16 the
+    # rotation tables round differently at shifted absolute positions (~3e-2
+    # logit noise on this config) and greedy argmax near-ties flip — the
+    # left-padded parity failure root-caused in ISSUE 4. Exactness contracts get
+    # f32; bf16 behavior is covered by the tolerance-based tests.
+    cfg = dataclasses.replace(
+        llama.CONFIGS["tiny"], attn_impl="xla", dtype=jnp.float32
+    )
     params = llama.init_params(cfg, jax.random.PRNGKey(7))
     return cfg, params
 

@@ -1,0 +1,287 @@
+"""Per-rule regression fixtures for graftaudit (``analysis/program/``).
+
+Every program rule gets a known-bad program that MUST fire and a fixed program
+that MUST NOT — built as real jitted functions, traced and lowered through the
+same ``capture_lowering`` the production enumerator uses (no execution, no
+TPU; the conftest 8-device CPU mesh makes the sharding fixtures real). Plus:
+collective-inventory accounting, declarative-suppression semantics, and the
+warmup-manifest audit stamp.
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from accelerate_tpu.analysis.program import (
+    AuditSuppression,
+    apply_audit_suppressions,
+    audit_findings,
+    audit_summaries,
+    capture_lowering,
+    collective_inventory,
+    known_audit_rule_ids,
+)
+from accelerate_tpu.analysis.program.rules import (
+    DeadDonationRule,
+    DtypePromotionRule,
+    HostTransferRule,
+    ReplicatedShardingRule,
+    all_program_rules,
+    program_rule_by_id,
+)
+
+
+def cap(fn, *args, label="prog", **jit_kwargs):
+    """Trace+lower ``fn`` into a ProgramCapture, exactly like the enumerator."""
+    _, capture = capture_lowering(jax.jit(fn, **jit_kwargs), args, {}, label)
+    return capture
+
+
+def hits(findings, rule_id):
+    return [f for f in findings if f.rule == rule_id]
+
+
+# ------------------------------------------------------------------ dtype-promotion
+
+def test_dtype_promotion_fires_on_upcast_compute():
+    def bad(w, x):
+        h = (x @ w).astype(jnp.float32)  # [256,256] bf16 -> f32
+        return h * 2.0                   # full-width elementwise compute
+
+    w = jnp.zeros((256, 256), jnp.bfloat16)
+    x = jnp.zeros((256, 256), jnp.bfloat16)
+    rule = DtypePromotionRule(min_elements=1024)
+    found = list(rule.check_program(cap(bad, w, x)))
+    assert found and "bfloat16->float32 [256x256]" in found[0].code
+
+
+def test_dtype_promotion_allows_upcast_then_reduce():
+    def good(w, x):
+        h = (x @ w).astype(jnp.float32)
+        return jnp.sum(h)  # the sanctioned f32-accumulation pattern
+
+    w = jnp.zeros((256, 256), jnp.bfloat16)
+    x = jnp.zeros((256, 256), jnp.bfloat16)
+    rule = DtypePromotionRule(min_elements=1024)
+    assert not list(rule.check_program(cap(good, w, x)))
+
+
+def test_dtype_promotion_ignores_small_tensors():
+    def loss_scalarize(x):
+        return x.astype(jnp.float32) * 3.0
+
+    x = jnp.zeros((8, 8), jnp.bfloat16)  # far under the threshold
+    assert not list(DtypePromotionRule().check_program(cap(loss_scalarize, x)))
+
+
+# -------------------------------------------------------------- replicated-sharding
+
+def test_replicated_large_param_fires(mesh8):
+    big = jax.device_put(
+        jnp.zeros((512, 512), jnp.float32), NamedSharding(mesh8, P())
+    )  # 1 MiB fully replicated on 8 devices
+    rule = ReplicatedShardingRule(min_bytes=1 << 20)
+    found = list(rule.check_program(cap(lambda p: p * 2, big)))
+    assert found and "replicated" in found[0].code
+    assert "8 devices" in found[0].message
+
+
+def test_replicated_gradient_accumulator_fires(mesh8):
+    """The replicated-GRADIENT case: a grad-accum buffer (the gradient pytree's
+    persistent twin) fully replicated under the mesh."""
+    # dp is the only >1 axis on the default 8-device test mesh.
+    params = jax.device_put(
+        jnp.zeros((512, 512), jnp.float32), NamedSharding(mesh8, P("dp", None))
+    )
+    grad_accum = jax.device_put(
+        jnp.zeros((512, 512), jnp.float32), NamedSharding(mesh8, P())
+    )
+
+    def micro(state, batch):
+        g = jax.grad(lambda p: jnp.sum((batch @ p) ** 2))(state["params"])
+        return {"params": state["params"], "grad_accum": state["grad_accum"] + g}
+
+    batch = jax.device_put(
+        jnp.zeros((16, 512), jnp.float32), NamedSharding(mesh8, P(None, None))
+    )
+    rule = ReplicatedShardingRule(min_bytes=1 << 20)
+    found = list(rule.check_program(
+        cap(micro, {"params": params, "grad_accum": grad_accum}, batch)
+    ))
+    assert len(found) == 1, [f.code for f in found]  # sharded params stay silent
+    assert "grad_accum" in found[0].code
+
+
+def test_sharded_param_is_clean(mesh8):
+    sharded = jax.device_put(
+        jnp.zeros((512, 512), jnp.float32), NamedSharding(mesh8, P("dp", None))
+    )
+    rule = ReplicatedShardingRule(min_bytes=1 << 20)
+    assert not list(rule.check_program(cap(lambda p: p * 2, sharded)))
+
+
+def test_replicated_small_scalar_is_clean(mesh8):
+    tiny = jax.device_put(jnp.zeros((), jnp.float32), NamedSharding(mesh8, P()))
+    assert not list(ReplicatedShardingRule().check_program(cap(lambda p: p + 1, tiny)))
+
+
+# ------------------------------------------------------------------- dead-donation
+
+def test_dead_donation_fires():
+    def reduce_only(x):  # donated [4,4] can never alias the scalar output
+        return jnp.sum(x)
+
+    capture = cap(reduce_only, jnp.zeros((4, 4)), donate_argnums=(0,))
+    found = list(DeadDonationRule().check_program(capture))
+    assert found and "dead donation" in found[0].code
+    assert any("donated buffers were not usable" in w for w in capture.warnings)
+
+
+def test_live_donation_is_clean():
+    def update(x, g):
+        return x - 0.1 * g
+
+    capture = cap(update, jnp.zeros((4, 4)), jnp.ones((4, 4)), donate_argnums=(0,))
+    assert not list(DeadDonationRule().check_program(capture))
+
+
+def test_constant_reset_is_dead_donation_like_the_micro_counter():
+    """The accelerator.py incident this rule shipped with: resetting a donated
+    counter to a fresh CONSTANT kills the alias; deriving the reset from the
+    input keeps it."""
+    def const_reset(s):
+        return {"a": s["a"] + 1, "m": jnp.zeros((), jnp.int32)}
+
+    def derived_reset(s):
+        return {"a": s["a"] + 1, "m": s["m"] * 0}
+
+    s = {"a": jnp.zeros((4,), jnp.int32), "m": jnp.array(3, jnp.int32)}
+    assert list(DeadDonationRule().check_program(cap(const_reset, s, donate_argnums=(0,))))
+    assert not list(DeadDonationRule().check_program(cap(derived_reset, s, donate_argnums=(0,))))
+
+
+# ------------------------------------------------------------------- host-transfer
+
+def test_host_transfer_fires_on_debug_print():
+    def chatty(x):
+        jax.debug.print("x={x}", x=jnp.sum(x))
+        return x * 2
+
+    found = list(HostTransferRule().check_program(cap(chatty, jnp.zeros((8,)))))
+    assert found and "callback" in found[0].code
+
+
+def test_pure_device_program_is_clean(mesh8):
+    x = jax.device_put(jnp.zeros((16, 8)), NamedSharding(mesh8, P("dp", None)))
+    found = list(HostTransferRule().check_program(cap(lambda x: jnp.tanh(x) @ x.T, x)))
+    assert not found  # @Sharding custom calls are allowlisted
+
+
+# ------------------------------------------------------------- collective inventory
+
+def test_inventory_counts_shard_map_psum(mesh8):
+    from accelerate_tpu.utils.jax_compat import shard_map
+
+    def summed(x):
+        return shard_map(
+            lambda b: jax.lax.psum(b, "dp"),
+            mesh=mesh8, in_specs=P("dp", None), out_specs=P(None, None),
+        )(x)
+
+    x = jax.device_put(
+        jnp.zeros((16, 32), jnp.float32), NamedSharding(mesh8, P("dp", None))
+    )
+    inv = collective_inventory(cap(summed, x))
+    assert inv["jaxpr"]["all_reduce"]["count"] == 1
+    # psum output inside the shard_map body is the [2, 32] per-shard block.
+    assert inv["jaxpr"]["all_reduce"]["bytes"] == 2 * 32 * 4
+    assert inv["total_count"] == 1
+
+
+def test_inventory_empty_for_local_program():
+    inv = collective_inventory(cap(lambda x: x * 2, jnp.zeros((4,))))
+    assert inv["jaxpr"] == {} and inv["total_count"] == 0
+
+
+def test_hlo_inventory_parses_compiled_text():
+    from accelerate_tpu.analysis.program.inventory import hlo_collectives
+
+    text = """
+  %ar = f32[128,256]{1,0} all-reduce(f32[128,256]{1,0} %p0), replica_groups={}
+  %ag = bf16[64]{0} all-gather(bf16[8]{0} %p1), dimensions={0}
+    """
+    inv = hlo_collectives(text)
+    assert inv["all_reduce"] == {"count": 1, "bytes": 128 * 256 * 4}
+    assert inv["all_gather"] == {"count": 1, "bytes": 64 * 2}
+
+
+# ------------------------------------------------------------ suppression semantics
+
+def _one_finding():
+    capture = cap(lambda x: jnp.sum(x), jnp.zeros((4, 4)), label="train_step.apply",
+                  donate_argnums=(0,))
+    findings, _ = audit_findings([capture], rules=[DeadDonationRule()],
+                                 suppressions=())
+    assert hits(findings, "dead-donation")
+    return capture, findings
+
+
+def test_audit_suppression_with_reason_silences():
+    capture, _ = _one_finding()
+    sup = AuditSuppression("dead-donation", "train_step.*", "", "fixture: reduction-only program")
+    findings, stale = audit_findings([capture], rules=[DeadDonationRule()],
+                                     suppressions=(sup,))
+    assert not hits(findings, "dead-donation")
+    assert not stale
+
+
+def test_audit_suppression_unknown_rule_is_error():
+    capture, _ = _one_finding()
+    sup = AuditSuppression("no-such-rule", "*", "", "whatever")
+    kept, errors, stale = apply_audit_suppressions(
+        [], (sup,), known_rules=known_audit_rule_ids()
+    )
+    assert errors and "unknown rule 'no-such-rule'" in errors[0].message
+
+
+def test_audit_suppression_without_reason_is_error():
+    sup = AuditSuppression("dead-donation", "*", "", "   ")
+    kept, errors, stale = apply_audit_suppressions(
+        [], (sup,), known_rules=known_audit_rule_ids()
+    )
+    assert errors and "no reason" in errors[0].message
+
+
+def test_audit_stale_suppression_reported():
+    capture = cap(lambda x: x * 2, jnp.zeros((4,)))
+    sup = AuditSuppression("dead-donation", "never-matches-*", "", "left over")
+    _, stale = audit_findings([capture], rules=[DeadDonationRule()],
+                              suppressions=(sup,))
+    assert stale == [sup]
+
+
+# -------------------------------------------------------------- summaries & stamping
+
+def test_audit_summaries_record_donation_effectiveness():
+    live = cap(lambda x: x + 1, jnp.zeros((4, 4)), label="live", donate_argnums=(0,))
+    dead = cap(lambda x: jnp.sum(x), jnp.zeros((4, 4)), label="dead", donate_argnums=(0,))
+    s_live, s_dead = audit_summaries([live, dead])
+    assert s_live["donation"] == {"donated": 1, "aliased": 1, "deferred": 0, "dead": 0}
+    assert s_dead["donation"] == {"donated": 1, "aliased": 0, "deferred": 0, "dead": 1}
+    assert any("donated buffers were not usable" in w for w in s_dead["lower_warnings"])
+
+
+def test_registry_ids_and_catalog():
+    rules = all_program_rules()
+    assert {r.id for r in rules} == {
+        "dtype-promotion", "replicated-sharding", "dead-donation", "host-transfer",
+    }
+    for r in rules:
+        assert r.description and r.severity in ("error", "warning")
+        assert program_rule_by_id(r.id).__class__ is r.__class__
+    with pytest.raises(KeyError):
+        program_rule_by_id("nope")

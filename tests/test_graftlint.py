@@ -553,6 +553,69 @@ def test_dead_knob_clean(tmp_path):
     assert not rule_hits(lint_snippet(tmp_path, GOOD_DEAD_KNOB), "dead-knob")
 
 
+# --------------------------------------------------------------- pspec-mesh-mismatch
+
+BAD_PSPEC = """
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    mesh = Mesh(np.array(jax.devices()), ("dp", "tp"))
+
+    def shard(x):
+        good = NamedSharding(mesh, P("dp", None))
+        bad = NamedSharding(mesh, P("data", "model"))   # neither axis exists
+        also_bad = jax.sharding.PartitionSpec(("dp", "modle"))  # typo'd axis in a tuple
+        return good, bad, also_bad
+"""
+
+GOOD_PSPEC = """
+    import numpy as np
+    import jax
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+    MESH_AXIS_NAMES = ("dp", "fsdp", "tp")
+    mesh = Mesh(np.array(jax.devices()).reshape(-1, 1, 1), MESH_AXIS_NAMES)
+
+    def shard(x):
+        return NamedSharding(mesh, PartitionSpec(("dp", "fsdp"), "tp"))
+"""
+
+NO_MESH_PSPEC = """
+    from jax.sharding import PartitionSpec as P
+
+    SPEC = P("anything")   # no axis vocabulary declared anywhere: rule stays silent
+"""
+
+
+def test_pspec_mesh_mismatch_fires(tmp_path):
+    hits = rule_hits(lint_snippet(tmp_path, BAD_PSPEC), "pspec-mesh-mismatch")
+    axes = sorted(h.message.split("'")[1] for h in hits)
+    assert axes == ["data", "model", "modle"], [h.message for h in hits]
+    assert all("dp" in h.message for h in hits)  # known axes listed for the fix
+
+
+def test_pspec_mesh_mismatch_clean(tmp_path):
+    assert not rule_hits(lint_snippet(tmp_path, GOOD_PSPEC), "pspec-mesh-mismatch")
+
+
+def test_pspec_without_declared_axes_is_silent(tmp_path):
+    assert not rule_hits(lint_snippet(tmp_path, NO_MESH_PSPEC), "pspec-mesh-mismatch")
+
+
+def test_pspec_vocabulary_is_crossfile(tmp_path):
+    """Axis constants declared in one linted file cover PartitionSpecs in another
+    (the repo pattern: utils/constants.py declares, models consume)."""
+    (tmp_path / "constants.py").write_text('DATA_AXIS = "dp"\nTENSOR_AXIS = "tp"\n')
+    (tmp_path / "model.py").write_text(
+        "from jax.sharding import PartitionSpec as P\n"
+        'SPEC = P("dp", "tp")\nBAD = P("mp")\n'
+    )
+    findings = run_lint(paths=(str(tmp_path),), root=str(tmp_path))
+    hits = rule_hits(findings, "pspec-mesh-mismatch")
+    assert len(hits) == 1 and "'mp'" in hits[0].message
+
+
 # ------------------------------------------------------------- suppression semantics
 
 def test_unknown_rule_in_suppression_is_error(tmp_path):

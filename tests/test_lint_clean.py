@@ -71,5 +71,6 @@ def test_cli_smoke(capsys):
         "recompile-hazard",
         "donation-safety",
         "dead-knob",
+        "pspec-mesh-mismatch",
     ):
         assert rule_id in out

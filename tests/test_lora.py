@@ -56,8 +56,12 @@ def test_training_updates_only_adapters():
         params, lora.lora_optimizer(optax.adamw(1e-2)),
         partition_specs=llama.partition_specs(LORA_CFG),
     )
-    base_before = jax.device_get(state.params["layers"][0]["wq"])
-    adapter_before = jax.device_get(state.params["layers"][0]["wq_lora_b"])
+    # Deep copies, not jax.device_get: device_get on CPU returns zero-copy views
+    # that the donated train step mutates in place (graftaudit donation case study).
+    from accelerate_tpu.utils import host_snapshot
+
+    base_before = host_snapshot(state.params["layers"][0]["wq"])
+    adapter_before = host_snapshot(state.params["layers"][0]["wq_lora_b"])
     step = acc.build_train_step(lambda p, b: llama.loss_fn(p, b, LORA_CFG))
     losses = []
     batch = make_batch(seed=0)  # fixed batch: adapters must be able to memorize it
