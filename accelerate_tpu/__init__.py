@@ -36,6 +36,7 @@ from .utils import (
     MixedPrecisionPolicy,
     ProjectConfiguration,
     CompileCacheConfig,
+    GatewayConfig,
     TelemetryConfig,
     infer_auto_device_map,
     is_rich_available,

@@ -309,6 +309,7 @@ class Accelerator:
         dynamo_plugin=None,
         telemetry_config=None,
         compile_cache_config=None,
+        gateway_config=None,
     ):
         self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
         if project_dir is not None and self.project_configuration.project_dir is None:
@@ -409,6 +410,7 @@ class Accelerator:
             megatron_lm_plugin=megatron_lm_plugin,
             telemetry_config=telemetry_config,
             compile_cache_config=compile_cache_config,
+            gateway_config=gateway_config,
         )
 
         # Step-level telemetry (off by default; ACCELERATE_TELEMETRY=1 or an enabled
@@ -1403,6 +1405,22 @@ class Accelerator:
                     pass
             if handler.on_trace_ready is not None and self.is_main_process:
                 handler.on_trace_ready(trace_dir)
+
+    def build_serving_gateway(self, engine, clock=None):
+        """Front a ``ContinuousBatcher`` with the SLO-aware request gateway
+        (``serving_gateway.ServingGateway``), resolved from the state-resident
+        ``GatewayConfig`` (``Accelerator(gateway_config=...)`` or
+        ``ACCELERATE_GATEWAY`` env) and sharing this accelerator's telemetry
+        pipeline. With the config disabled (the default) the engine is returned
+        unchanged — callers drive one object either way (both expose
+        ``submit``/``step``/``run``/``stats``)."""
+        config = self.state.gateway_config
+        if not config.enabled:
+            return engine
+        from .serving_gateway import ServingGateway
+
+        kwargs = {} if clock is None else {"clock": clock}
+        return ServingGateway(engine, config, telemetry=self.telemetry, **kwargs)
 
     @contextlib.contextmanager
     def join_uneven_inputs(self, joinables, even_batches: Optional[bool] = None):

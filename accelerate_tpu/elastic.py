@@ -53,6 +53,10 @@ class ElasticSupervisor:
       triggers a group teardown + restart.
     - ``grace_period``: SIGTERM the survivors, escalate to SIGKILL after this many seconds.
     - ``on_restart(attempt, codes)``: hook for logging/metrics (tested for invocation).
+    - ``telemetry``: an enabled ``telemetry.Telemetry`` makes every restart a
+      ``telemetry.elastic.restart/v1`` record (attempt index, exit codes, budget) —
+      restart history flows to the same sinks as every other metric instead of
+      being log-only.
     """
 
     def __init__(
@@ -64,6 +68,7 @@ class ElasticSupervisor:
         coordinator_host: str = "127.0.0.1",
         coordinator_port: Optional[int] = None,
         on_restart: Optional[Callable[[int, list], None]] = None,
+        telemetry=None,
     ):
         self.make_plan = make_plan
         self.max_restarts = max_restarts
@@ -72,7 +77,22 @@ class ElasticSupervisor:
         self.coordinator_host = coordinator_host
         self.coordinator_port = coordinator_port
         self.on_restart = on_restart
+        self.telemetry = telemetry
         self.attempts_used = 0
+
+    def _emit_restart_record(self, attempt: int, codes: list) -> None:
+        tel = self.telemetry
+        if tel is None or not getattr(tel, "enabled", False):
+            return
+        from .telemetry.slo import ELASTIC_RESTART_SCHEMA
+
+        tel.emit({
+            "schema": ELASTIC_RESTART_SCHEMA,
+            "attempt": attempt,
+            "attempts_used": self.attempts_used,
+            "max_restarts": self.max_restarts,
+            "exit_codes": list(codes),
+        })
 
     def _coordinator(self) -> str:
         port = self.coordinator_port or get_free_port()
@@ -120,8 +140,10 @@ class ElasticSupervisor:
                 f"worker group failed with exit codes {codes} "
                 f"(attempt {attempt + 1}/{self.max_restarts + 1})"
             )
-            if self.on_restart is not None and attempt < self.max_restarts:
-                self.on_restart(attempt, codes)
+            if attempt < self.max_restarts:
+                self._emit_restart_record(attempt, codes)
+                if self.on_restart is not None:
+                    self.on_restart(attempt, codes)
         raise WorkerFailure(
             f"worker group failed after {self.max_restarts + 1} attempts "
             f"(last exit codes {codes})",

@@ -28,9 +28,10 @@ shape-sensitive, so parity is defined at matching padded shapes).
 from __future__ import annotations
 
 import dataclasses
+import time
 from collections import OrderedDict, deque
 from functools import partial
-from typing import Optional
+from typing import Callable, Optional
 
 import numpy as np
 import jax
@@ -42,7 +43,7 @@ from .models import llama
 from .models.llama import _block_cached, _rms_norm, init_cache
 from .utils.dataclasses import CompileCacheConfig
 
-__all__ = ["ContinuousBatcher", "Request"]
+__all__ = ["ContinuousBatcher", "Request", "normalize_submit"]
 
 
 @partial(jax.jit, static_argnames=("top_k",))
@@ -54,15 +55,67 @@ def _draw(logits_row, key, temperature, top_p, top_k: int):
     return sampling_core(logits_row[None], key, temperature, top_p, top_k)[0]
 
 
+def normalize_submit(prompt, max_new_tokens=None, eos_token_id=None, gen=None,
+                     rng=None):
+    """Validate and normalize one submit() call's request arguments →
+    ``(prompt int32 [L], GenerationConfig)``.
+
+    The ONE copy of the argument contract shared by ``ContinuousBatcher.submit``
+    and the gateway's admission path (``serving_gateway``), so the two can never
+    drift: either ``max_new_tokens``/``eos_token_id`` or a full ``gen`` (not
+    both), rng only with temperature sampling, an integral positive generation
+    budget (a fractional/bool budget would slip past range checks, overrun its
+    validated cache window and silently truncate at the decode position clamp),
+    and a non-empty prompt. All violations raise — they are caller bugs, unlike
+    engine-geometry overflow which each caller handles itself
+    (``_plan_prefill``)."""
+    prompt = np.asarray(prompt, np.int32).ravel()
+    if prompt.size == 0:
+        raise ValueError("empty prompt: prefill needs at least one token")
+    if gen is not None and (max_new_tokens is not None or eos_token_id is not None):
+        raise ValueError(
+            "pass either gen= or max_new_tokens/eos_token_id, not both"
+        )
+    if rng is not None and (gen is None or gen.temperature <= 0.0):
+        raise ValueError(
+            "rng was given but the request is greedy (no gen / temperature<=0): the "
+            "key would be silently ignored — pass gen=GenerationConfig(temperature=...)"
+        )
+    if gen is None:
+        gen = GenerationConfig(
+            max_new_tokens=32 if max_new_tokens is None else max_new_tokens,
+            temperature=0.0, eos_token_id=eos_token_id,
+        )
+    mnt = gen.max_new_tokens
+    if isinstance(mnt, bool) or not isinstance(mnt, (int, np.integer)):
+        raise TypeError(
+            f"max_new_tokens must be an int, got {type(mnt).__name__} ({mnt!r}): "
+            "a fractional budget would overrun the validated cache window and "
+            "silently truncate at the slot boundary"
+        )
+    if mnt < 1:
+        raise ValueError(
+            f"max_new_tokens={mnt} must be >= 1 (the prefill emits the first token)"
+        )
+    if gen.temperature > 0.0 and rng is None:
+        raise ValueError("temperature sampling needs a per-request rng key")
+    return prompt, gen
+
+
 @dataclasses.dataclass
 class Request:
     uid: int
     prompt: np.ndarray
     gen: GenerationConfig
     rng: Optional[jax.Array] = None      # per-request key schedule (None → greedy-determined)
+    #: Streaming hook: called as ``on_token(token_id)`` the moment each token is
+    #: appended (prefill's first token included) — tokens arrive in exactly the order
+    #: ``tokens`` records them, so a streamed transcript equals the final list.
+    on_token: Optional[Callable[[int], None]] = None
     # filled by the engine
     tokens: list = dataclasses.field(default_factory=list)
     done: bool = False
+    enqueued_at: float = 0.0             # time.monotonic() at submit (queue-wait metrics)
 
     def __post_init__(self):
         if self.rng is not None and self.gen.temperature > 0.0:
@@ -301,19 +354,28 @@ class ContinuousBatcher:
         self.telemetry = telemetry
         self.admitted = 0   # requests that entered a slot (prefill ran)
         self.evicted = 0    # slot frees: finished (EOS/max_new_tokens) requests
+        self.evicted_external = 0  # slot frees forced by evict() (deadline/cancel/preempt)
 
     # ------------------------------------------------------------------ user API
     def stats(self) -> dict:
         """Engine observability snapshot: queue depth, busy lanes, admission/eviction
-        totals, prefix-cache counters."""
+        totals, prefix-cache counters. ``queue_wait_s`` is the age of the OLDEST queued
+        request (0.0 when the queue is empty) — queue latency stays observable even
+        without the gateway tier (``serving_gateway``) on top."""
         active = sum(r is not None for r in self.slot_req)
+        queue_wait_s = 0.0
+        if self.queue:
+            now = time.monotonic()
+            queue_wait_s = max(0.0, now - min(r.enqueued_at for r in self.queue))
         return {
             "queued": len(self.queue),
+            "queue_wait_s": queue_wait_s,
             "active_slots": active,
             "max_slots": self.max_slots,
             "slot_occupancy": active / self.max_slots,
             "admitted": self.admitted,
             "evicted": self.evicted,
+            "evicted_external": self.evicted_external,
             "prefix_entries": len(self._prefix_reg),
             "prefix_hits": self.prefix_hits,
             "prefix_misses": self.prefix_misses,
@@ -343,36 +405,47 @@ class ContinuousBatcher:
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = None,
                gen: Optional[GenerationConfig] = None,
-               rng: Optional[jax.Array] = None) -> Request:
+               rng: Optional[jax.Array] = None,
+               on_token: Optional[Callable[[int], None]] = None) -> Request:
         """Queue a request. Either pass ``max_new_tokens``/``eos_token_id`` (greedy), or a
         full ``GenerationConfig`` via ``gen`` — not both (silently preferring one would
-        drop the caller's limits). Temperature sampling needs ``rng``."""
-        prompt = np.asarray(prompt, np.int32).ravel()
-        if gen is not None and (max_new_tokens is not None or eos_token_id is not None):
-            raise ValueError(
-                "pass either gen= or max_new_tokens/eos_token_id, not both"
-            )
-        if rng is not None and (gen is None or gen.temperature <= 0.0):
-            raise ValueError(
-                "rng was given but the request is greedy (no gen / temperature<=0): the "
-                "key would be silently ignored — pass gen=GenerationConfig(temperature=...)"
-            )
-        if gen is None:
-            gen = GenerationConfig(
-                max_new_tokens=32 if max_new_tokens is None else max_new_tokens,
-                temperature=0.0, eos_token_id=eos_token_id,
-            )
-        if gen.max_new_tokens < 1:
-            raise ValueError("max_new_tokens must be >= 1 (the prefill emits the first token)")
+        drop the caller's limits). Temperature sampling needs ``rng``. ``on_token``
+        streams each generated token id as it is produced."""
+        prompt, gen = normalize_submit(prompt, max_new_tokens, eos_token_id, gen, rng)
         # The prompt's padded prefill width + generation budget must fit the cache;
         # _plan_prefill picks the bucket (or chunked) layout and validates it.
         self._plan_prefill(len(prompt), gen.max_new_tokens)
-        if gen.temperature > 0.0 and rng is None:
-            raise ValueError("temperature sampling needs a per-request rng key")
-        req = Request(self._uid, prompt, gen, rng)
+        req = Request(self._uid, prompt, gen, rng, on_token=on_token,
+                      enqueued_at=time.monotonic())
         self._uid += 1
         self.queue.append(req)
         return req
+
+    def cancel(self, uid: int) -> bool:
+        """Cooperatively withdraw a request by uid, wherever it is.
+
+        Queued: removed before it ever touches a slot. In flight: its lane is freed
+        immediately — the next ``step()`` admits into it and the stale cache row is
+        simply overwritten (idle lanes keep computing ignored output, so no compiled
+        program changes shape). Returns False when the uid is unknown or already
+        finished; the request object is left exactly as far as it got (``tokens``
+        keeps the prefix generated so far, ``done`` stays False)."""
+        for req in self.queue:
+            if req.uid == uid:
+                self.queue.remove(req)
+                return True
+        return self.evict_slot(uid)
+
+    def evict_slot(self, uid: int) -> bool:
+        """Free the decode lane holding request ``uid`` (deadline enforcement /
+        preemption / cancellation). The slot is reusable by the very next ``step()``;
+        the evicted request is NOT marked done and keeps its partial ``tokens``."""
+        for slot, req in enumerate(self.slot_req):
+            if req is not None and req.uid == uid:
+                self.slot_req[slot] = None
+                self.evicted_external += 1
+                return True
+        return False
 
     def step(self) -> list[Request]:
         """Admit queued requests, decode one token on every active slot."""
@@ -402,6 +475,8 @@ class ContinuousBatcher:
             )
             self.tokens[i] = tok
             req.tokens.append(tok)
+            if req.on_token is not None:
+                req.on_token(tok)
             hit_eos = req.gen.eos_token_id is not None and tok == req.gen.eos_token_id
             if hit_eos or len(req.tokens) >= req.gen.max_new_tokens:
                 req.done = True
@@ -421,8 +496,6 @@ class ContinuousBatcher:
         when one is attached, instead of any caller-side printing — and still
         returns ``(requests, tokens_per_sec)`` for direct use.
         """
-        import time
-
         out = []
         t0 = time.perf_counter()
         while self.queue or any(r is not None for r in self.slot_req):
@@ -557,6 +630,8 @@ class ContinuousBatcher:
                 self.positions[slot] = prefill_len  # next write = first decode slot
                 self.tokens[slot] = first
                 req.tokens.append(int(first))
+                if req.on_token is not None:
+                    req.on_token(int(first))
                 hit_eos = req.gen.eos_token_id is not None and int(first) == req.gen.eos_token_id
                 if hit_eos or len(req.tokens) >= req.gen.max_new_tokens:
                     req.done = True

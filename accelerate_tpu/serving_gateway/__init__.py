@@ -1,0 +1,63 @@
+"""SLO-aware serving gateway: admission control, scheduling, deadlines, streaming.
+
+A request-scheduling tier that wraps (never replaces) the continuous-batching
+engine (``accelerate_tpu.serving.ContinuousBatcher``). The engine stays a pure
+throughput machine; the gateway owns queue policy (fifo / priority-with-aging /
+EDF / weighted fair queueing), bounded-queue admission with explicit REJECTED
+results and shed-lowest-priority-first overload handling, per-request deadlines
+with mid-decode eviction, cooperative cancellation, bounded retry-on-preemption,
+token streaming, and p50/p95/p99 SLO summaries through the telemetry pipeline.
+
+Off by default: nothing here is imported by the engine, and a gateway-fronted
+run compiles exactly the programs an engine-only run does (docs/serving_gateway.md).
+
+Enable via ``GatewayConfig`` / ``ACCELERATE_GATEWAY`` and build with::
+
+    gw = ServingGateway(engine, GatewayConfig(enabled=True, policy="edf"))
+    req = gw.submit(prompt, max_new_tokens=64, deadline_s=0.5, on_token=print)
+    gw.run()
+"""
+
+from .gateway import (
+    CANCELLED,
+    DONE,
+    EVICTED,
+    EXPIRED,
+    QUEUED,
+    REJECTED,
+    RUNNING,
+    SHED,
+    TERMINAL_STATUSES,
+    GatewayRequest,
+    ServingGateway,
+)
+from .policies import (
+    POLICIES,
+    EdfPolicy,
+    FifoPolicy,
+    PriorityPolicy,
+    SchedulerPolicy,
+    WfqPolicy,
+    make_policy,
+)
+
+__all__ = [
+    "ServingGateway",
+    "GatewayRequest",
+    "SchedulerPolicy",
+    "FifoPolicy",
+    "PriorityPolicy",
+    "EdfPolicy",
+    "WfqPolicy",
+    "POLICIES",
+    "make_policy",
+    "QUEUED",
+    "RUNNING",
+    "DONE",
+    "REJECTED",
+    "SHED",
+    "CANCELLED",
+    "EVICTED",
+    "EXPIRED",
+    "TERMINAL_STATUSES",
+]

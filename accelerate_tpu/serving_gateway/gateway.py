@@ -1,0 +1,558 @@
+"""The SLO-aware request gateway: admission, scheduling, lifecycle, streaming.
+
+``ServingGateway`` wraps — never replaces — a :class:`~..serving.ContinuousBatcher`.
+The engine stays a pure throughput machine (slots, compiled prefill/decode); the
+gateway owns everything a loaded service needs above it:
+
+- **Admission control / backpressure** — a bounded queue (``max_queue``) and a
+  cost-estimated token budget (``max_queued_tokens``). Over a bound, either the
+  newcomer is REJECTED with a machine-readable reason, or (``overload="shed"``)
+  the least-urgent queued request is shed in its favor, lowest-priority-first.
+  Cost estimation calls the engine's own ``_plan_prefill`` — the same bucket
+  ladder the compile cache warms — so admission can never route a request to a
+  compile shape the engine wouldn't itself pick.
+- **Scheduling** — one pluggable :class:`~.policies.SchedulerPolicy` (fifo /
+  priority-with-aging / EDF / WFQ) decides admission order into free slots. The
+  gateway only hands the engine as many requests as it has free lanes, so the
+  engine's internal FIFO never reorders a policy decision.
+- **Lifecycle** — per-request deadlines (queued requests expire, running ones are
+  evicted mid-decode and their lane admits new work on the very next ``step()``),
+  cooperative ``cancel(uid)``, optional priority preemption with a bounded
+  retry-on-eviction budget, and an ``on_token`` streaming callback fed in exact
+  generation order.
+- **SLO observability** — per-request queue-wait/TTFT/TPOT and gateway
+  p50/p95/p99 summaries (``telemetry.slo``), emitted as telemetry records and
+  surfaced in ``stats()``.
+
+The gateway adds no device programs: every jit dispatch still happens inside the
+engine, so a gateway-fronted run compiles exactly what an engine-only run does
+(asserted by ``tests/test_serving_gateway.py`` via ``CompileMonitor``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from ..generation import GenerationConfig
+from ..serving import normalize_submit
+from ..telemetry.slo import (
+    GATEWAY_REQUEST_SCHEMA,
+    GATEWAY_SLO_SCHEMA,
+    slo_summary,
+)
+from ..utils.dataclasses import GatewayConfig
+from .policies import make_policy
+
+__all__ = [
+    "GatewayRequest",
+    "ServingGateway",
+    "QUEUED",
+    "RUNNING",
+    "DONE",
+    "REJECTED",
+    "SHED",
+    "CANCELLED",
+    "EVICTED",
+    "EXPIRED",
+    "TERMINAL_STATUSES",
+]
+
+# ---------------------------------------------------------------- status model
+QUEUED = "queued"        # held by the scheduler policy
+RUNNING = "running"      # admitted into an engine slot
+DONE = "done"            # finished normally (EOS / max_new_tokens)
+REJECTED = "rejected"    # refused at admission (reason: queue_full/token_budget/unservable)
+SHED = "shed"            # removed from the queue by overload shedding
+CANCELLED = "cancelled"  # withdrawn by cancel(uid) (reason says queued vs running)
+EVICTED = "evicted"      # lost its slot (preemption) with no retry budget left
+EXPIRED = "expired"      # deadline passed (reason says queued vs running)
+
+TERMINAL_STATUSES = frozenset({DONE, REJECTED, SHED, CANCELLED, EVICTED, EXPIRED})
+
+_UNSET = object()  # submit() sentinel: "apply the config default"
+
+
+@dataclasses.dataclass
+class GatewayRequest:
+    """One request's full gateway lifecycle (scheduling inputs, state, SLO times).
+
+    ``status`` walks queued → running → one of :data:`TERMINAL_STATUSES`; requests
+    refused at admission are born terminal (``rejected``/``shed`` with a
+    machine-readable ``reason``) rather than raising — overload is an operating
+    condition, not a caller bug. Timestamps come from the gateway's clock;
+    ``ttft_s`` includes queue wait AND prefill (the client-visible first-token
+    latency), ``tpot_s`` is the mean inter-token gap after the first."""
+
+    uid: int
+    prompt: np.ndarray
+    gen: GenerationConfig
+    rng: Optional[object] = None
+    priority: int = 0
+    deadline_at: Optional[float] = None   # absolute, on the gateway clock
+    tenant: str = "default"
+    on_token: Optional[Callable[[int], None]] = None
+    on_retry: Optional[Callable[[], None]] = None  # stream-reset signal on preemption retry
+    max_retries: int = 0
+    cost: int = 0                         # estimated cache tokens (padded prefill + budget)
+    # lifecycle
+    status: str = QUEUED
+    reason: Optional[str] = None
+    tokens: list = dataclasses.field(default_factory=list)
+    retries_used: int = 0
+    # SLO timestamps (gateway clock)
+    t_submit: float = 0.0
+    t_admit: Optional[float] = None
+    t_first_token: Optional[float] = None
+    t_last_token: Optional[float] = None
+    t_done: Optional[float] = None
+    n_streamed: int = 0
+    _engine_req: Optional[object] = dataclasses.field(default=None, repr=False)
+
+    # ------------------------------------------------------------ SLO metrics
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        if self.t_admit is None:
+            return None
+        return self.t_admit - self.t_submit
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.t_first_token is None:
+            return None
+        return self.t_first_token - self.t_submit
+
+    @property
+    def tpot_s(self) -> Optional[float]:
+        if self.t_first_token is None or self.t_last_token is None or self.n_streamed < 2:
+            return None
+        return (self.t_last_token - self.t_first_token) / (self.n_streamed - 1)
+
+    @property
+    def deadline_met(self) -> Optional[bool]:
+        if self.deadline_at is None or self.t_done is None:
+            return None
+        return self.status == DONE and self.t_done <= self.deadline_at
+
+    @property
+    def terminal(self) -> bool:
+        return self.status in TERMINAL_STATUSES
+
+
+class ServingGateway:
+    """Admission + scheduling + lifecycle tier above one ``ContinuousBatcher``.
+
+    ``clock`` defaults to ``time.monotonic``; tests inject a manual clock to make
+    deadlines/aging deterministic. ``telemetry`` accepts the same ``Telemetry``
+    object the engine takes (records share its sinks)."""
+
+    def __init__(self, engine, config: Optional[GatewayConfig] = None,
+                 telemetry=None, clock: Callable[[], float] = time.monotonic):
+        if config is None:
+            config = GatewayConfig(enabled=True)
+        self.engine = engine
+        self.config = config
+        self.telemetry = telemetry
+        self._clock = clock
+        self._policy = make_policy(config)
+        self._uid = 0
+        self._queued_cost = 0
+        self._running: Dict[int, GatewayRequest] = {}  # engine uid → gateway request
+        self._all: Dict[int, GatewayRequest] = {}      # gateway uid → request
+        self._terminal: List[GatewayRequest] = []      # terminal order (SLO summaries)
+        self.counters = {
+            "submitted": 0, "admitted": 0, "done": 0, "rejected": 0, "shed": 0,
+            "cancelled": 0, "expired": 0, "evicted": 0, "retried": 0,
+        }
+
+    # ------------------------------------------------------------------ submit
+    def submit(self, prompt, max_new_tokens: Optional[int] = None,
+               eos_token_id: Optional[int] = None,
+               gen: Optional[GenerationConfig] = None,
+               rng=None, priority: Optional[int] = None,
+               deadline_s=_UNSET, tenant: str = "default",
+               on_token: Optional[Callable[[int], None]] = None,
+               on_retry: Optional[Callable[[], None]] = None,
+               max_retries: Optional[int] = None) -> GatewayRequest:
+        """Queue a request under the gateway's policy; ALWAYS returns a
+        ``GatewayRequest`` — admission refusals come back as a terminal
+        ``rejected`` status with a machine-readable ``reason``, never an
+        exception. API misuse raises the engine's exact exceptions (one shared
+        ``serving.normalize_submit``, so gateway and engine cannot drift).
+
+        ``deadline_s`` is relative to now (``None`` disables even the config
+        default); ``priority``: higher = more urgent; ``tenant`` feeds WFQ;
+        ``max_retries`` bounds retry-on-preemption for this request;
+        ``on_retry`` fires when a preemption retry restarts the stream (the
+        signal for a streaming consumer to reset its buffer before ``on_token``
+        replays from the first token)."""
+        now = self._clock()
+        prompt, gen = normalize_submit(prompt, max_new_tokens, eos_token_id, gen, rng)
+
+        if deadline_s is _UNSET:
+            deadline_s = self.config.deadline_s
+        greq = GatewayRequest(
+            uid=self._uid, prompt=prompt, gen=gen, rng=rng,
+            priority=self.config.default_priority if priority is None else priority,
+            deadline_at=None if deadline_s is None else now + float(deadline_s),
+            tenant=tenant, on_token=on_token, on_retry=on_retry,
+            max_retries=self.config.max_retries if max_retries is None else max_retries,
+            t_submit=now,
+        )
+        self._uid += 1
+        self._all[greq.uid] = greq
+        self.counters["submitted"] += 1
+
+        # Servability + cost: the engine's own prefill planner (bucket ladder /
+        # chunk layout) is the single source of shape truth — its padded width
+        # plus the generation budget is the cache-token cost the queue budget
+        # accounts. Unservable geometry is an admission refusal, not a crash.
+        try:
+            _, total = self.engine._plan_prefill(len(prompt), gen.max_new_tokens)
+        except ValueError as e:
+            return self._refuse(greq, now, "unservable", str(e))
+        greq.cost = int(total) + int(gen.max_new_tokens)
+
+        if not self._make_room(greq, now):
+            return greq  # _make_room already marked it rejected
+        self._policy.push(greq)
+        self._queued_cost += greq.cost
+        return greq
+
+    def _refuse(self, greq: GatewayRequest, now: float, reason: str,
+                detail: Optional[str] = None) -> GatewayRequest:
+        """Mark an incoming request terminally REJECTED (shedding of already-queued
+        requests is finalized inline by ``_make_room``)."""
+        self.counters["rejected"] += 1
+        self._finalize(greq, REJECTED, reason if detail is None else f"{reason}:{detail}", now)
+        return greq
+
+    def _over_budget(self, incoming_cost: int) -> Optional[str]:
+        if self.config.max_queue and len(self._policy) + 1 > self.config.max_queue:
+            return "queue_full"
+        if (self.config.max_queued_tokens
+                and self._queued_cost + incoming_cost > self.config.max_queued_tokens):
+            return "token_budget"
+        return None
+
+    def _make_room(self, greq: GatewayRequest, now: float) -> bool:
+        """Enforce the admission bounds for one incoming request. Returns True when
+        it may be queued; False after marking it rejected. ``overload="shed"``
+        sheds strictly-less-urgent queued requests (lowest first) — **atomically**:
+        the victim set is planned first and shed only if it actually makes room,
+        so a blocked newcomer can never destroy queued work and then be rejected
+        anyway. A newcomer can never shed its equal."""
+        reason = self._over_budget(greq.cost)
+        if reason is None:
+            return True
+        if (self.config.overload != "shed"
+                or (self.config.max_queued_tokens
+                    and greq.cost > self.config.max_queued_tokens)):
+            # reject mode, or a newcomer over the budget even against an EMPTY
+            # queue — no victim set could ever make room.
+            self._refuse(greq, now, reason)
+            return False
+        new_urgency = self._policy.urgency(greq, now)
+        pool = sorted(
+            (i for i in self._policy.items()
+             if self._policy.urgency(i, now) < new_urgency),
+            key=lambda i: (self._policy.urgency(i, now), -i.uid),
+        )
+        victims = []
+        qlen, qcost = len(self._policy), self._queued_cost
+
+        def fits():
+            len_ok = not self.config.max_queue or qlen + 1 <= self.config.max_queue
+            tok_ok = (not self.config.max_queued_tokens
+                      or qcost + greq.cost <= self.config.max_queued_tokens)
+            return len_ok, tok_ok
+        for victim in pool:
+            len_ok, tok_ok = fits()
+            if len_ok and tok_ok:
+                break
+            victims.append(victim)
+            qlen -= 1
+            qcost -= victim.cost
+        len_ok, tok_ok = fits()
+        if not (len_ok and tok_ok):
+            self._refuse(greq, now, "queue_full" if not len_ok else "token_budget")
+            return False
+        for victim in victims:
+            self._policy.remove(victim.uid)
+            self._queued_cost -= victim.cost
+            self.counters["shed"] += 1
+            self._finalize(victim, SHED, "overload_shed", now)
+        return True
+
+    # ------------------------------------------------------------------ control
+    def cancel(self, uid: int) -> bool:
+        """Cooperatively withdraw request ``uid``. Queued requests never reach a
+        slot; a running request's lane is freed immediately (reusable by the next
+        ``step()``). Returns False for unknown/already-terminal uids."""
+        greq = self._all.get(uid)
+        if greq is None or greq.terminal:
+            return False
+        now = self._clock()
+        if greq.status == QUEUED:
+            self._policy.remove(greq.uid)
+            self._queued_cost -= greq.cost
+            self.counters["cancelled"] += 1
+            self._finalize(greq, CANCELLED, "cancelled_queued", now)
+            return True
+        # running — engine.cancel, not evict_slot: a reentrant cancel (from
+        # another request's on_token mid-step) can catch the engine Request
+        # still in the engine's internal queue, where only cancel() finds it.
+        self.engine.cancel(greq._engine_req.uid)
+        self._running.pop(greq._engine_req.uid, None)
+        greq.tokens = list(greq._engine_req.tokens)
+        self.counters["cancelled"] += 1
+        self._finalize(greq, CANCELLED, "cancelled_running", now)
+        return True
+
+    # ------------------------------------------------------------------ stepping
+    def step(self) -> List[GatewayRequest]:
+        """One gateway cycle: expire/evict deadline violators, preempt, admit into
+        free lanes, advance the engine one decode step. Returns every request that
+        reached a terminal state during this call (submission order)."""
+        now = self._clock()
+        events: List[GatewayRequest] = []
+
+        # 1) queued deadline expiry — never occupies a slot.
+        for item in self._policy.items():
+            if item.deadline_at is not None and now > item.deadline_at:
+                self._policy.remove(item.uid)
+                self._queued_cost -= item.cost
+                self.counters["expired"] += 1
+                self._finalize(item, EXPIRED, "deadline_queued", now)
+                events.append(item)
+
+        # 2) running deadline eviction — the lane frees NOW, so this same step's
+        #    admission (below) can refill it: eviction-to-reuse is one step().
+        for greq in list(self._running.values()):
+            if greq.deadline_at is not None and now > greq.deadline_at:
+                self.engine.evict_slot(greq._engine_req.uid)
+                self._running.pop(greq._engine_req.uid, None)
+                greq.tokens = list(greq._engine_req.tokens)
+                self.counters["expired"] += 1
+                self._finalize(greq, EXPIRED, "deadline_running", now)
+                events.append(greq)
+
+        # 3) priority preemption (opt-in): a strictly more urgent queued request
+        #    may take the lane of the least urgent running one; the evictee
+        #    retries from scratch while its budget lasts.
+        if self.config.preempt:
+            events.extend(self._preempt(now))
+
+        # 4) admit exactly as many requests as there are free lanes, in policy
+        #    order — the engine's internal FIFO then admits them all this step.
+        free = self._free_lanes()
+        while free > 0 and len(self._policy):
+            item = self._policy.pop(now)
+            self._queued_cost -= item.cost
+            self._admit(item, now)
+            free -= 1
+
+        # 5) one engine decode step; map engine completions back to gateway state.
+        for ereq in self.engine.step():
+            greq = self._running.pop(ereq.uid, None)
+            if greq is None:
+                continue  # engine-direct submission, not gateway-managed
+            greq.tokens = list(ereq.tokens)
+            self.counters["done"] += 1
+            self._finalize(greq, DONE, None, self._clock())
+            events.append(greq)
+        return sorted(events, key=lambda r: r.uid)
+
+    def _free_lanes(self) -> int:
+        """Lanes the engine can fill this step: open slots minus requests already
+        sitting in the engine's internal queue (admitted this step, e.g. by a
+        preemption) — those lanes are spoken for."""
+        return (
+            self.engine.max_slots
+            - sum(r is not None for r in self.engine.slot_req)
+            - len(self.engine.queue)
+        )
+
+    @property
+    def queue_depth(self) -> int:
+        """Queued request count — cheap (no SLO summary built, unlike ``stats()``)."""
+        return len(self._policy)
+
+    @property
+    def running_count(self) -> int:
+        """Requests currently holding an engine lane — cheap."""
+        return len(self._running)
+
+    def run(self, report_slo: bool = False):
+        """Drain the queue and every lane. Returns all requests that reached a
+        terminal state during the drain; with ``report_slo`` also emits the
+        aggregate ``gateway.slo/v1`` telemetry record and returns
+        ``(requests, summary)``."""
+        out: List[GatewayRequest] = []
+        while self.queue_depth or self.running_count:
+            out.extend(self.step())
+        if report_slo:
+            return out, self.emit_slo_record()
+        return out
+
+    def _admit(self, greq: GatewayRequest, now: float) -> None:
+        greq.status = RUNNING
+        greq.t_admit = now
+        self.counters["admitted"] += 1
+        ereq = self.engine.submit(
+            greq.prompt, gen=greq.gen,
+            rng=greq.rng if greq.gen.temperature > 0.0 else None,
+            on_token=self._stream_cb(greq),
+        )
+        greq._engine_req = ereq
+        self._running[ereq.uid] = greq
+
+    def _stream_cb(self, greq: GatewayRequest) -> Callable[[int], None]:
+        def deliver(tok: int) -> None:
+            t = self._clock()
+            if greq.t_first_token is None:
+                greq.t_first_token = t
+            greq.t_last_token = t
+            greq.n_streamed += 1
+            if greq.on_token is not None:
+                greq.on_token(tok)
+
+        return deliver
+
+    def _preempt(self, now: float) -> List[GatewayRequest]:
+        """Evict the least-urgent running request when a strictly higher-priority
+        one is queued and no lane is free — and admit the preemptor into the freed
+        lane DIRECTLY. (Leaving the lane to the normal admission pass would let a
+        non-priority policy pop the just-requeued victim back into it — an
+        evict-readmit churn that burns the victim's retry budget and a prefill
+        per step while the preemptor waits.) Raw ``priority`` is the preemption
+        currency under every policy — preempting on queue-discipline urgency
+        would let mere aging evict live work."""
+        events: List[GatewayRequest] = []
+        while len(self._policy) and self._running:
+            if self._free_lanes() > 0:
+                break
+            top = max(self._policy.items(), key=lambda i: (i.priority, -i.uid))
+            victim = min(self._running.values(), key=lambda r: (r.priority, -r.uid))
+            if victim.priority >= top.priority:
+                break
+            self.engine.evict_slot(victim._engine_req.uid)
+            self._running.pop(victim._engine_req.uid, None)
+            # take(), not remove(): the preemptor is being SERVED — WFQ must
+            # charge its tenant and advance the virtual clock, not refund it.
+            self._policy.take(top.uid, now)
+            self._queued_cost -= top.cost
+            self._admit(top, now)
+            if victim.retries_used < victim.max_retries:
+                victim.retries_used += 1
+                self.counters["retried"] += 1
+                victim.status = QUEUED
+                victim.tokens = []
+                victim._engine_req = None
+                victim.t_admit = victim.t_first_token = victim.t_last_token = None
+                victim.n_streamed = 0
+                if victim.on_retry is not None:
+                    # Stream-reset signal: on_token is about to replay from the
+                    # first token; without this a streaming consumer's transcript
+                    # would contain the pre-eviction prefix twice.
+                    victim.on_retry()
+                self._policy.push(victim)
+                self._queued_cost += victim.cost
+            else:
+                # Terminal eviction keeps the partial transcript — it was already
+                # streamed to the client and the SLO record must account for it
+                # (same contract as cancel/deadline eviction).
+                victim.tokens = list(victim._engine_req.tokens)
+                self.counters["evicted"] += 1
+                self._finalize(victim, EVICTED, "preempted", now)
+                events.append(victim)
+        return events
+
+    # ------------------------------------------------------------------ reporting
+    def _finalize(self, greq: GatewayRequest, status: str, reason: Optional[str],
+                  now: float) -> None:
+        greq.status = status
+        greq.reason = reason
+        greq.t_done = now
+        greq._engine_req = None  # release the engine Request (and its prompt/cache refs)
+        self._terminal.append(greq)
+        self._emit_request_record(greq)
+        # Bounded history (TelemetryConfig.max_records analog): a long-running
+        # service must not grow per-request state forever. Counters stay
+        # cumulative; slo_summary() covers the retained window.
+        cap = self.config.max_terminal
+        if cap and len(self._terminal) > cap:
+            for old in self._terminal[: len(self._terminal) - cap]:
+                self._all.pop(old.uid, None)
+            del self._terminal[: len(self._terminal) - cap]
+
+    def _emit_request_record(self, greq: GatewayRequest) -> None:
+        tel = self.telemetry
+        if tel is None or not tel.enabled or not self.config.emit_per_request:
+            return
+        tel.emit({
+            "schema": GATEWAY_REQUEST_SCHEMA,
+            "uid": greq.uid,
+            "status": greq.status,
+            "reason": greq.reason,
+            "tenant": greq.tenant,
+            "priority": greq.priority,
+            "n_tokens": len(greq.tokens),
+            "retries_used": greq.retries_used,
+            "queue_wait_s": greq.queue_wait_s,
+            "ttft_s": greq.ttft_s,
+            "tpot_s": greq.tpot_s,
+            "deadline_met": greq.deadline_met,
+        })
+
+    def slo_summary(self) -> dict:
+        """p50/p95/p99 (+count/mean) blocks over the retained terminal requests'
+        queue-wait/TTFT/TPOT (the last ``max_terminal``, a sliding SLO window),
+        plus terminal counts by status within that window. Requests that never
+        produced a token simply don't contribute latencies (count says how many
+        did); the cumulative totals live in ``counters``."""
+        done = self._terminal
+        summary = slo_summary({
+            "queue_wait_s": [r.queue_wait_s for r in done],
+            "ttft_s": [r.ttft_s for r in done],
+            "tpot_s": [r.tpot_s for r in done],
+        })
+        summary["by_status"] = {
+            s: sum(r.status == s for r in done)
+            for s in sorted(TERMINAL_STATUSES)
+        }
+        return summary
+
+    def emit_slo_record(self) -> dict:
+        """Build (and, when telemetry is attached, emit) the aggregate SLO record."""
+        record = {
+            "schema": GATEWAY_SLO_SCHEMA,
+            "policy": self._policy.name,
+            **{k: v for k, v in self.counters.items()},
+            "slo": self.slo_summary(),
+        }
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            tel.emit(record)
+        return record
+
+    def stats(self) -> dict:
+        """Gateway + nested engine observability snapshot."""
+        return {
+            "policy": self._policy.name,
+            "queued": len(self._policy),
+            "queued_cost_tokens": self._queued_cost,
+            "running": len(self._running),
+            **dict(self.counters),
+            "slo": self.slo_summary(),
+            "engine": self.engine.stats(),
+        }
+
+    def __repr__(self) -> str:
+        return (
+            f"ServingGateway(policy={self._policy.name!r}, queued={len(self._policy)}, "
+            f"running={len(self._running)}, terminal={len(self._terminal)})"
+        )

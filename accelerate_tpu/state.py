@@ -37,6 +37,7 @@ from .utils.dataclasses import (
     CompileCacheConfig,
     DistributedInitKwargs,
     DistributedType,
+    GatewayConfig,
     GradientAccumulationPlugin,
     MixedPrecisionPolicy,
     PrecisionType,
@@ -342,6 +343,7 @@ class AcceleratorState:
         megatron_lm_plugin=None,
         telemetry_config: Optional[TelemetryConfig] = None,
         compile_cache_config: Optional[CompileCacheConfig] = None,
+        gateway_config: Optional[GatewayConfig] = None,
         _from_accelerator: bool = False,
         **kwargs,
     ):
@@ -379,6 +381,13 @@ class AcceleratorState:
             compile_cache_config
             if compile_cache_config is not None
             else CompileCacheConfig()
+        )
+        # And the serving-gateway config: every serving layer (gateway builder,
+        # serve-bench CLI, bench serving rows) resolves the ONE state-resident
+        # config; the default constructor applies the ACCELERATE_GATEWAY env
+        # override (a policy-name value both enables and selects the policy).
+        self.gateway_config = (
+            gateway_config if gateway_config is not None else GatewayConfig()
         )
         from .parallel.mesh import MeshConfig, build_mesh
 

@@ -28,6 +28,15 @@ from .core import STEP_RECORD_SCHEMA, Telemetry
 from .derived import PEAK_TFLOPS, derived_rates, peak_tflops
 from .memory import device_memory_stats
 from .profiler import ScheduledProfiler
+from .slo import (
+    ELASTIC_RESTART_SCHEMA,
+    GATEWAY_REQUEST_SCHEMA,
+    GATEWAY_SLO_SCHEMA,
+    latency_summary,
+    percentile,
+    slo_attainment,
+    slo_summary,
+)
 from .steady import SteadyStateDetector, TELEMETRY_REV
 from .timing import StepTimer, StepTiming, fence
 
@@ -41,6 +50,13 @@ __all__ = [
     "peak_tflops",
     "device_memory_stats",
     "ScheduledProfiler",
+    "ELASTIC_RESTART_SCHEMA",
+    "GATEWAY_REQUEST_SCHEMA",
+    "GATEWAY_SLO_SCHEMA",
+    "latency_summary",
+    "percentile",
+    "slo_attainment",
+    "slo_summary",
     "SteadyStateDetector",
     "TELEMETRY_REV",
     "StepTimer",

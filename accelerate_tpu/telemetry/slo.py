@@ -1,0 +1,91 @@
+"""SLO record schemas and latency summaries for the serving tier.
+
+The gateway (``accelerate_tpu.serving_gateway``) measures three per-request
+latencies — queue wait (submit → slot), TTFT (submit → first token, prefill
+included) and TPOT (mean inter-token gap after the first) — and reports them as
+p50/p95/p99 summaries. The summary math lives here, beside the other derived
+rates, so bench.py, ``serve-bench`` and the gateway all stamp identical numbers
+from one implementation (the telemetry package's founding rule: measurement code
+is shared, not folklore).
+
+All helpers are pure host-side float math — no jax imports, no device syncs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, Mapping, Optional, Sequence
+
+__all__ = [
+    "GATEWAY_REQUEST_SCHEMA",
+    "GATEWAY_SLO_SCHEMA",
+    "ELASTIC_RESTART_SCHEMA",
+    "percentile",
+    "latency_summary",
+    "slo_summary",
+    "slo_attainment",
+]
+
+#: One record per request reaching a terminal state (done/rejected/shed/expired/
+#: cancelled/evicted): uid, status, machine-readable reason, tenant, priority,
+#: queue_wait_s / ttft_s / tpot_s, tokens generated, deadline_met.
+GATEWAY_REQUEST_SCHEMA = "accelerate_tpu.telemetry.gateway.request/v1"
+
+#: Aggregate gateway summary: terminal counts by status plus the per-metric
+#: p50/p95/p99 blocks produced by :func:`slo_summary`.
+GATEWAY_SLO_SCHEMA = "accelerate_tpu.telemetry.gateway.slo/v1"
+
+#: Emitted by ``ElasticSupervisor`` on every gang restart (attempt index, the
+#: exit codes that triggered the teardown, the restart budget).
+ELASTIC_RESTART_SCHEMA = "accelerate_tpu.telemetry.elastic.restart/v1"
+
+#: The percentiles every summary block carries.
+SLO_PERCENTILES = (50, 95, 99)
+
+
+def percentile(values: Sequence[float], q: float) -> float:
+    """The ``q``-th percentile of ``values`` (linear interpolation, the numpy
+    default), without importing numpy — summaries must work in stripped CLI
+    contexts. ``values`` need not be sorted; it must be non-empty."""
+    if not values:
+        raise ValueError("percentile of an empty sequence")
+    if not 0 <= q <= 100:
+        raise ValueError(f"q={q} must lie in [0, 100]")
+    ordered = sorted(values)
+    if len(ordered) == 1:
+        return float(ordered[0])
+    rank = (q / 100.0) * (len(ordered) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(ordered) - 1)
+    frac = rank - lo
+    return float(ordered[lo] * (1.0 - frac) + ordered[hi] * frac)
+
+
+def latency_summary(
+    values: Iterable[Optional[float]], percentiles: Sequence[float] = SLO_PERCENTILES
+) -> dict:
+    """``{count, mean, p50, p95, p99}`` over the non-None entries; ``{"count": 0}``
+    when nothing was measured (a request rejected at admission has no TTFT —
+    absence is the honest value, not 0.0)."""
+    vals = [float(v) for v in values if v is not None]
+    if not vals:
+        return {"count": 0}
+    out = {"count": len(vals), "mean": round(sum(vals) / len(vals), 6)}
+    for q in percentiles:
+        out[f"p{q:g}"] = round(percentile(vals, q), 6)
+    return out
+
+
+def slo_summary(latencies: Mapping[str, Iterable[Optional[float]]]) -> Dict[str, dict]:
+    """One :func:`latency_summary` block per metric name, e.g.
+    ``{"ttft_s": {...}, "tpot_s": {...}, "queue_wait_s": {...}}``."""
+    return {name: latency_summary(vals) for name, vals in latencies.items()}
+
+
+def slo_attainment(values: Iterable[Optional[float]], target_s: float) -> Optional[float]:
+    """Fraction of measured values at or under ``target_s`` (None when nothing was
+    measured). The classic SLO statement "p95 TTFT <= 200 ms" is
+    ``slo_attainment(ttfts, 0.2) >= 0.95``."""
+    vals = [float(v) for v in values if v is not None]
+    if not vals:
+        return None
+    return sum(v <= target_s for v in vals) / len(vals)

@@ -450,6 +450,107 @@ class CompileCacheConfig(KwargsHandler):
         return tuple(buckets)
 
 
+#: Env values that toggle ACCELERATE_GATEWAY on/off; anything else must be a policy name.
+_GATEWAY_POLICIES = frozenset({"fifo", "priority", "edf", "wfq"})
+
+
+@dataclass
+class GatewayConfig(KwargsHandler):
+    """SLO-aware serving-gateway config (``accelerate_tpu.serving_gateway``).
+
+    **Off by default and invisible when off**: the gateway is a wrapper *above*
+    ``ContinuousBatcher`` — with no gateway constructed, the engine's behavior and
+    compile counts are exactly the pre-gateway ones (asserted by
+    ``tests/test_serving_gateway.py`` via ``CompileMonitor``). Enable explicitly or
+    via ``ACCELERATE_GATEWAY=1`` (explicit arg > env > built-in, the §5 priority
+    order); a policy-name-valued env (``ACCELERATE_GATEWAY=edf``) both enables the
+    gateway and selects the policy.
+
+    ``policy`` picks the queue discipline (``serving_gateway.policies``):
+    ``fifo`` (seed-equivalent default), ``priority`` (strict priority with aging —
+    a request gains one effective priority level per ``aging_s`` seconds waited, so
+    low-priority work is starvation-free), ``edf`` (earliest deadline first) or
+    ``wfq`` (start-time weighted fair queueing across tenants,
+    ``tenant_weights``). ``max_queue`` / ``max_queued_tokens`` bound admission
+    (0 = unbounded); over the bound, ``overload`` picks between rejecting the new
+    request (``"reject"``) and shedding the least-urgent queued one
+    (``"shed"``, lowest-priority-first). ``deadline_s`` applies a default relative
+    deadline to every request; ``preempt`` lets a strictly more urgent queued
+    request evict the least urgent running one (evictees retry up to
+    ``max_retries`` times, from scratch). ``emit_per_request`` controls the
+    per-terminal-request telemetry record (the aggregate SLO record is always
+    emitted by ``ServingGateway.emit_slo_record``).
+    """
+
+    enabled: Optional[bool] = None      # None → env ACCELERATE_GATEWAY > False
+    policy: Optional[str] = None        # None → env policy name > "fifo"
+    max_queue: int = 0                  # queued-request cap; 0 = unbounded
+    max_queued_tokens: int = 0          # cost-estimated queued-token budget; 0 = unbounded
+    overload: str = "reject"            # "reject" the newcomer | "shed" least-urgent queued
+    aging_s: float = 10.0               # priority policy: +1 effective level per aging_s waited
+    default_priority: int = 0
+    tenant_weights: Optional[dict] = None  # wfq: tenant → weight (missing tenants weigh 1.0)
+    deadline_s: Optional[float] = None  # default relative deadline applied at submit
+    preempt: bool = False               # evict least-urgent running for more urgent queued
+    max_retries: int = 0                # default retry budget for preemption-evicted requests
+    emit_per_request: bool = True       # telemetry record per terminal request
+    max_terminal: int = 4096            # terminal-request history cap (SLO window; 0 = unbounded)
+
+    def __post_init__(self):
+        raw = os.environ.get("ACCELERATE_GATEWAY")
+        raw_norm = raw.strip().lower() if raw is not None else None
+        raw_is_policy = raw_norm in _GATEWAY_POLICIES
+        if raw_norm is not None and not raw_is_policy and raw_norm not in (
+            _CACHE_ENV_TRUE | _CACHE_ENV_FALSE
+        ):
+            # A typo'd policy name must not silently run with the gateway OFF —
+            # that disables admission control/deadlines in production with no error.
+            raise ValueError(
+                f"ACCELERATE_GATEWAY={raw!r}: expected a boolean "
+                f"({'/'.join(sorted(_CACHE_ENV_TRUE))} or "
+                f"{'/'.join(sorted(v for v in _CACHE_ENV_FALSE if v))}) "
+                f"or a policy name ({'/'.join(sorted(_GATEWAY_POLICIES))})"
+            )
+        if self.enabled is None:
+            if raw_norm is None:
+                self.enabled = False
+            else:
+                self.enabled = raw_is_policy or raw_norm in _CACHE_ENV_TRUE
+        if self.policy is None:
+            self.policy = raw_norm if raw_is_policy else "fifo"
+        if self.policy not in _GATEWAY_POLICIES:
+            raise ValueError(
+                f"policy={self.policy!r} must be one of {sorted(_GATEWAY_POLICIES)}"
+            )
+        if self.max_queue < 0:
+            raise ValueError(f"max_queue={self.max_queue} must be >= 0 (0 = unbounded)")
+        if self.max_queued_tokens < 0:
+            raise ValueError(
+                f"max_queued_tokens={self.max_queued_tokens} must be >= 0 (0 = unbounded)"
+            )
+        if self.overload not in ("reject", "shed"):
+            raise ValueError(f"overload={self.overload!r} must be 'reject' or 'shed'")
+        if self.aging_s <= 0:
+            raise ValueError(
+                f"aging_s={self.aging_s} must be > 0 (aging is what makes the "
+                "priority policy starvation-free; disable aging by raising it, not zeroing it)"
+            )
+        if self.deadline_s is not None and self.deadline_s <= 0:
+            raise ValueError(f"deadline_s={self.deadline_s} must be > 0 when set")
+        if self.max_retries < 0:
+            raise ValueError(f"max_retries={self.max_retries} must be >= 0")
+        if self.max_terminal < 0:
+            raise ValueError(
+                f"max_terminal={self.max_terminal} must be >= 0 (0 = unbounded)"
+            )
+        if self.tenant_weights is not None:
+            for tenant, weight in self.tenant_weights.items():
+                if weight <= 0:
+                    raise ValueError(
+                        f"tenant_weights[{tenant!r}]={weight} must be > 0"
+                    )
+
+
 @dataclass
 class DataLoaderConfiguration(KwargsHandler):
     """Reference ``dataclasses.py:762``. None-sentinel fields resolve launcher env

@@ -785,6 +785,33 @@ def _adopt_best_sweep_config(default_metric: str) -> None:
               f"(MFU {best['value']}): {applied}", file=sys.stderr)
 
 
+def _run_serving_rows(preset: str | None) -> int:
+    """Serving-tier SLO rows (``BENCH_SERVE=1``): replay the serve-bench synthetic
+    overload once per gateway policy and print one JSON row each — the SAME
+    percentile blocks ``accelerate-tpu serve-bench`` stamps (ttft/tpot/queue_wait
+    p50/p95/p99, admission accounting), from the one shared implementation
+    (``commands.serve_bench.run_serve_bench``). The smoke preset pins the CPU
+    backend exactly like the training smoke row does."""
+    if (preset or "smoke") == "smoke":
+        _os.environ["JAX_PLATFORMS"] = "cpu"
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+    from accelerate_tpu.commands.serve_bench import run_serve_bench
+
+    rows = run_serve_bench(
+        preset=preset or "smoke",
+        requests=int(_os.environ.get("BENCH_SERVE_REQUESTS", "48")),
+        max_slots=int(_os.environ.get("BENCH_SERVE_SLOTS", "4")),
+        max_len=int(_os.environ.get("BENCH_SERVE_LEN", "128")),
+        max_new=int(_os.environ.get("BENCH_SERVE_NEW", "16")),
+        overload=float(_os.environ.get("BENCH_SERVE_OVERLOAD", "4.0")),
+    )
+    for row in rows:
+        print(json.dumps(row))
+    return 0
+
+
 def main():
     import os
     import threading
@@ -798,6 +825,10 @@ def main():
     enable_compile_cache(_here)
 
     preset = os.environ.get("BENCH_PRESET")
+    if os.environ.get("BENCH_SERVE"):
+        # Serving rows are a separate, self-contained mode: no train state, no
+        # watchdog/OOM machinery — the gateway drains deterministically or raises.
+        return _run_serving_rows(preset)
     B = int(os.environ.get("BENCH_B", "4"))
     S = int(os.environ.get("BENCH_S", "2048"))
     fuse = int(os.environ.get("BENCH_FUSE", "4"))

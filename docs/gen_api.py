@@ -38,6 +38,8 @@ MODULES = [
     ("accelerate_tpu.big_modeling", "Big-model inference"),
     ("accelerate_tpu.generation", "Generation"),
     ("accelerate_tpu.serving", "Serving engine"),
+    ("accelerate_tpu.serving_gateway.gateway", "Serving gateway"),
+    ("accelerate_tpu.serving_gateway.policies", "Gateway scheduling policies"),
     ("accelerate_tpu.inference", "Pipeline inference"),
     ("accelerate_tpu.checkpointing", "Checkpointing"),
     ("accelerate_tpu.tracking", "Experiment trackers"),
@@ -86,6 +88,7 @@ MODULES = [
     ("accelerate_tpu.telemetry.compile_monitor", "Compile-event counters"),
     ("accelerate_tpu.telemetry.derived", "Derived throughput rates"),
     ("accelerate_tpu.telemetry.profiler", "Scheduled profiler windows"),
+    ("accelerate_tpu.telemetry.slo", "SLO summaries and record schemas"),
     ("accelerate_tpu.models.llama", "Llama family"),
     ("accelerate_tpu.models.lora", "LoRA fine-tuning"),
     ("accelerate_tpu.models.gpt", "GPT family"),

@@ -193,3 +193,47 @@ def test_preemption_resume_loss_parity(tmp_path):
     assert int(resumed["step"]) == int(base["step"]) == 8
     np.testing.assert_allclose(resumed["a"], base["a"], rtol=0, atol=0)
     np.testing.assert_allclose(resumed["b"], base["b"], rtol=0, atol=0)
+
+
+def test_restart_emits_telemetry_record(tmp_path):
+    """A gang restart is a telemetry event, not just a log line: with an enabled
+    Telemetry attached, each restart emits an elastic.restart/v1 record carrying
+    the attempt index and the exit codes that triggered the teardown."""
+    from accelerate_tpu.telemetry import ELASTIC_RESTART_SCHEMA, Telemetry
+    from accelerate_tpu.utils.dataclasses import TelemetryConfig
+
+    flag = str(tmp_path / "crashed_once")
+    tel = Telemetry(TelemetryConfig(
+        enabled=True, compile_events=False, memory_stats=False
+    ))
+
+    def make_plan(coordinator):
+        return [(_worker_cmd(CRASH_ONCE, flag, str(rank)), None) for rank in range(2)]
+
+    sup = ElasticSupervisor(
+        make_plan, max_restarts=2, monitor_interval=0.05, telemetry=tel
+    )
+    assert sup.run() == 0
+    records = [r for r in tel.records if r.get("schema") == ELASTIC_RESTART_SCHEMA]
+    assert len(records) == 1, records
+    assert records[0]["attempt"] == 0
+    assert 17 in records[0]["exit_codes"]
+    assert records[0]["max_restarts"] == 2
+
+
+def test_no_restart_no_telemetry_record(tmp_path):
+    """A clean run emits no restart records; a disabled Telemetry is never written to."""
+    from accelerate_tpu.telemetry import ELASTIC_RESTART_SCHEMA, Telemetry
+    from accelerate_tpu.utils.dataclasses import TelemetryConfig
+
+    tel = Telemetry(TelemetryConfig(
+        enabled=True, compile_events=False, memory_stats=False
+    ))
+
+    def make_plan(coordinator):
+        return [(_worker_cmd("import sys; sys.exit(0)"), None)]
+
+    sup = ElasticSupervisor(make_plan, max_restarts=1, monitor_interval=0.05,
+                            telemetry=tel)
+    assert sup.run() == 0
+    assert not [r for r in tel.records if r.get("schema") == ELASTIC_RESTART_SCHEMA]

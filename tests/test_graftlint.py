@@ -721,3 +721,27 @@ def test_every_rule_has_id_and_description():
         assert rule_by_id(r.id).__class__ is r.__class__
     with pytest.raises(KeyError):
         rule_by_id("nope")
+
+
+def test_host_sync_skips_serving_gateway_package(tmp_path):
+    """The serving gateway's timing path rides the same path-prefix sanction as the
+    telemetry fence internals (its per-token reads are the engine's sanctioned
+    4-byte fetches); identical code outside the package still fires."""
+    src = """
+    import numpy as np
+    import jax
+
+    def serve_timing_loop(x):
+        for _ in range(3):
+            jax.block_until_ready(x)
+            np.asarray(x)
+        return x
+    """
+    sanctioned_dir = tmp_path / "accelerate_tpu" / "serving_gateway"
+    sanctioned_dir.mkdir(parents=True)
+    inside = lint_snippet(
+        tmp_path, src, name="accelerate_tpu/serving_gateway/slo_timing.py"
+    )
+    assert not rule_hits(inside, "host-sync-in-hot-path")
+    outside = lint_snippet(tmp_path, src, name="gateway_elsewhere.py")
+    assert rule_hits(outside, "host-sync-in-hot-path")

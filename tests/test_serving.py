@@ -296,3 +296,75 @@ def test_prefix_eviction_mid_flight_recompute(setup):
     stats = engine.stats()
     assert stats["prefix_hits"] >= 1  # the x[:32] whole-prompt hit
     assert stats["prefix_entries"] <= 2  # capacity respected under churn
+
+
+def test_stats_queue_wait_and_enqueue_timestamps(setup):
+    """Queue latency is observable without the gateway: every request records its
+    enqueue time and stats() reports the oldest queued request's age."""
+    params, prompts = setup
+    engine = ContinuousBatcher(params, CFG, max_slots=1, max_len=64, prompt_bucket=16)
+    assert engine.stats()["queue_wait_s"] == 0.0
+    r0 = engine.submit(prompts[0], max_new_tokens=3)
+    r1 = engine.submit(prompts[1], max_new_tokens=3)
+    assert r0.enqueued_at > 0.0 and r1.enqueued_at >= r0.enqueued_at
+    # Backdate the OLDEST request: stats must report ITS age, not the newest's.
+    r0.enqueued_at -= 5.0
+    wait = engine.stats()["queue_wait_s"]
+    assert wait >= 5.0, wait
+    engine.run()
+    assert engine.stats()["queue_wait_s"] == 0.0  # empty queue again
+
+
+def test_non_integral_max_new_tokens_rejected(setup):
+    """A fractional/bool budget must raise at submit, not silently overrun its
+    validated cache window and truncate at the slot boundary."""
+    params, prompts = setup
+    engine = ContinuousBatcher(params, CFG, max_slots=1, max_len=64, prompt_bucket=16)
+    gen = GenerationConfig(max_new_tokens=3.5, temperature=0.0)
+    with pytest.raises(TypeError, match="must be an int"):
+        engine.submit(prompts[0], gen=gen)
+    with pytest.raises(TypeError, match="must be an int"):
+        engine.submit(prompts[0], gen=GenerationConfig(max_new_tokens=True))
+    with pytest.raises(ValueError, match="max_new_tokens=-2"):
+        engine.submit(prompts[0], max_new_tokens=-2)
+    with pytest.raises(ValueError, match="empty prompt"):
+        engine.submit(np.zeros((0,), np.int32), max_new_tokens=3)
+
+
+def test_engine_cancel_queued_and_inflight(setup):
+    """cancel(): queued requests never touch a slot; an in-flight request frees its
+    lane for the very next step and keeps its partial tokens (done stays False)."""
+    params, prompts = setup
+    engine = ContinuousBatcher(params, CFG, max_slots=1, max_len=64, prompt_bucket=16)
+    r0 = engine.submit(prompts[0], max_new_tokens=8)
+    r1 = engine.submit(prompts[1], max_new_tokens=4)
+    engine.step()  # r0 in flight, r1 queued
+    assert engine.cancel(r1.uid)            # queued: removed outright
+    assert engine.stats()["queued"] == 0
+    engine.step()
+    partial = len(r0.tokens)
+    assert engine.cancel(r0.uid)            # in flight: lane freed immediately
+    assert engine.stats()["active_slots"] == 0
+    assert engine.stats()["evicted_external"] == 1
+    assert not r0.done and len(r0.tokens) == partial
+    assert not engine.cancel(r0.uid)        # already gone
+    # The freed lane serves new work correctly.
+    r2 = engine.submit(prompts[2], max_new_tokens=3)
+    engine.run()
+    assert r2.tokens == reference_greedy(params, prompts[2], 3)
+
+
+def test_engine_on_token_streaming_parity(setup):
+    """on_token delivers every token in generation order: the streamed transcript
+    equals the final tokens list equals the standalone greedy decode."""
+    params, prompts = setup
+    engine = ContinuousBatcher(params, CFG, max_slots=2, max_len=64, prompt_bucket=16)
+    streamed = {}
+    reqs = []
+    for i, (p, n) in enumerate(zip(prompts[:4], (6, 4, 8, 3))):
+        streamed[i] = []
+        reqs.append(engine.submit(p, max_new_tokens=n,
+                                  on_token=streamed[i].append))
+    engine.run()
+    for i, (req, p, n) in enumerate(zip(reqs, prompts[:4], (6, 4, 8, 3))):
+        assert streamed[i] == req.tokens == reference_greedy(params, p, n)
