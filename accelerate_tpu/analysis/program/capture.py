@@ -55,6 +55,20 @@ class ProgramCapture:
         """Flat indices of donated arguments (empty on jax builds without it)."""
         return tuple(getattr(self.lowered, "donate_argnums", ()) or ())
 
+    @property
+    def kept_var_idx(self) -> Optional[tuple]:
+        """Sorted flat indices of call leaves KEPT as lowered-main parameters, or
+        None when this jax doesn't expose them. jax prunes inputs that don't feed
+        any output (e.g. the lm_head of a program that discards its logits), so
+        ``@main``'s arg numbering is positions within THIS list, not flat call
+        order — every rule matching flat indices against ``main_arg_attributes``
+        must translate through it or it misreads any pruned program."""
+        try:
+            kept = self.lowered._lowering.compile_args["kept_var_idx"]
+        except Exception:  # noqa: BLE001 - private API; absent on some jax builds
+            return None
+        return tuple(sorted(kept))
+
 
 def capture_lowering(jitted, args, kwargs, label: str) -> Tuple[Any, ProgramCapture]:
     """Trace + lower one call, recording the jaxpr and all lowering warnings.

@@ -22,8 +22,9 @@ from .capture import ProgramCapture
 __all__ = ["LowerOnlyCache", "capture_default_programs", "DEFAULT_AUDIT_GEOMETRY"]
 
 #: The geometry ``audit`` lowers when none is given: the warmup CLI's default
-#: config with eval and serving enabled, so the audited surface is the full
-#: program set a warmed cache directory would hold.
+#: config with eval and serving enabled — including the speculative-decoding
+#: surface (fused verify + half-depth draft model programs) — so the audited
+#: surface is the full program set a warmed cache directory would hold.
 DEFAULT_AUDIT_GEOMETRY = dict(
     preset="smoke",
     batch_size=8,
@@ -33,6 +34,8 @@ DEFAULT_AUDIT_GEOMETRY = dict(
     serve=True,
     max_slots=4,
     max_new_tokens=32,
+    spec_k=2,
+    spec_draft="half",
 )
 
 

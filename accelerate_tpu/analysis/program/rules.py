@@ -216,11 +216,28 @@ class DeadDonationRule(ProgramRule):
             return []
         attrs = main_arg_attributes(prog.hlo_text)
         findings = []
-        # Flat call leaves line up with lowered arg numbering (donate_argnums
-        # are flat indices), giving pytree paths instead of bare arg numbers.
+        # Flat call leaves give pytree paths instead of bare arg numbers;
+        # donate_argnums are flat indices. @main's parameters, however, are only
+        # the KEPT inputs — jax prunes args no output depends on (a program that
+        # discards its logits drops the whole lm_head) — so flat indices must be
+        # translated to kept positions before reading arg attributes, or every
+        # donated arg after a pruned one is misread as unaliased.
         leaves = flat_inputs(prog)
+        kept = prog.kept_var_idx
+        kept_pos = (
+            {flat: pos for pos, flat in enumerate(kept)} if kept is not None else None
+        )
         for i in donated:
-            attr = attrs.get(i, "")
+            if kept_pos is None:
+                attr = attrs.get(i, "")
+            elif i in kept_pos:
+                attr = attrs.get(kept_pos[i], "")
+            else:
+                # Donated AND pruned: the program never reads the buffer, yet jit
+                # dispatch still consumes (deletes) donated inputs — the caller
+                # loses the array for a program that ignores it. Dead by
+                # construction; fall through with no attributes.
+                attr = ""
             if "tf.aliasing_output" in attr:
                 continue  # lowering established the alias
             if "jax.buffer_donor" in attr:

@@ -19,6 +19,8 @@ from __future__ import annotations
 
 import argparse
 
+from ..spec_decode import DraftSource
+
 __all__ = ["run_serve_bench", "serve_bench_command", "serve_bench_command_parser"]
 
 #: Policy rows a plain run emits, in order.
@@ -60,6 +62,25 @@ def serve_bench_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("--deadline-loose", type=float, default=120.0,
                         help="relative deadline (s) of the low class")
     parser.add_argument("--seed", type=int, default=0, help="workload rng seed")
+    parser.add_argument("--spec-k", type=int, default=0,
+                        help="speculative proposals per slot per step (0 = plain "
+                             "decode); every policy row then stamps spec_accept_rate "
+                             "and tokens_per_step")
+    parser.add_argument("--spec-draft", default="ngram",
+                        choices=("ngram", "half", "oracle"),
+                        help="draft source when --spec-k > 0: 'ngram' (model-free "
+                             "prompt lookup), 'half' (half-depth draft model), or "
+                             "'oracle' (proposals from precomputed greedy references "
+                             "— acceptance-1.0 CEILING isolating the engine's verify "
+                             "mechanism; random smoke weights make real acceptance "
+                             "meaningless-by-construction, same rationale as "
+                             "benchmarks/big_model_inference/speculative_tpu.py)")
+    parser.add_argument("--workload", default="mixed", choices=("mixed", "repeat"),
+                        help="'mixed' = the classic random burst; 'repeat' = "
+                             "low-entropy repeated-token prompts (the "
+                             "extraction/echo-shaped traffic prompt-lookup drafting "
+                             "is for). Applies with or without --spec-k, so "
+                             "spec/non-spec rows stay apples-to-apples")
     parser.add_argument("--smoke", action="store_true",
                         help="tiny fast shape (CI tier-1): 20 requests, 2 slots, "
                              "8-token budget")
@@ -68,19 +89,60 @@ def serve_bench_command_parser(subparsers=None) -> argparse.ArgumentParser:
     return parser
 
 
-def _workload(n: int, vocab: int, bucket: int, high_frac: float, seed: int):
-    """The deterministic burst every policy row replays: (prompt, is_high, tenant)."""
+def _workload(n: int, vocab: int, bucket: int, high_frac: float, seed: int,
+              kind: str = "mixed"):
+    """The deterministic burst every policy row replays: (prompt, is_high, tenant).
+
+    ``kind="repeat"`` draws low-entropy prompts (one or two tokens tiled) — the
+    token-level shape of extraction/echo traffic, which tends to drive greedy decode
+    into repetitive attractors that prompt-lookup drafting can actually predict;
+    ``"mixed"`` is the classic uniform-random burst (near-incompressible, the
+    n-gram drafter's worst case)."""
     import numpy as np
 
     rng = np.random.default_rng(seed)
     out = []
     for i in range(n):
         length = int(rng.integers(3, bucket + 1))
-        prompt = rng.integers(1, vocab, length).astype(np.int32)
+        if kind == "repeat":
+            base = rng.integers(1, vocab, int(rng.integers(1, 3)))
+            prompt = np.tile(base, length)[:length].astype(np.int32)
+        else:
+            prompt = rng.integers(1, vocab, length).astype(np.int32)
         is_high = bool(rng.random() < high_frac)
         tenant = f"tenant{int(rng.integers(0, 3))}"
         out.append((prompt, is_high, tenant))
     return out
+
+
+class _OracleDrafter(DraftSource):
+    """Bench-only ``DraftSource``: proposes each request's PRECOMPUTED greedy
+    continuation — an always-accepted draft at zero draft cost, i.e. the engine's
+    verify-side throughput CEILING at acceptance 1.0.
+
+    Random smoke weights make any real drafter's measured acceptance
+    meaningless-by-construction (the ``speculative_tpu.py`` rationale); this row
+    isolates what the batched verify mechanism delivers when acceptance is there,
+    and real deployments interpolate by their measured acceptance (the
+    ``spec_accept_rate`` column the ngram/half rows stamp)."""
+
+    def __init__(self, refs: dict):
+        self.refs = refs  # prompt bytes -> np.ndarray reference continuation
+
+    def propose(self, lanes, pending, positions, k):
+        import numpy as np
+
+        out = np.zeros((len(lanes), k), np.int32)
+        for i, req in enumerate(lanes):
+            if req is None:
+                continue
+            ref = self.refs[req.prompt.tobytes()]
+            t = len(req.tokens)
+            cont = ref[t:t + k]
+            out[i, :len(cont)] = cont
+            if len(cont) < k:
+                out[i, len(cont):] = ref[-1] if len(ref) else 0
+        return out
 
 
 def run_serve_bench(
@@ -96,12 +158,22 @@ def run_serve_bench(
     deadline_tight: float = 15.0,
     deadline_loose: float = 120.0,
     seed: int = 0,
+    spec_k: int = 0,
+    spec_draft: str = "ngram",
+    workload: str = "mixed",
     telemetry=None,
 ) -> list:
-    """Run the burst once per policy; returns one SLO row dict per policy."""
+    """Run the burst once per policy; returns one SLO row dict per policy.
+
+    ``spec_k > 0`` runs every policy row with batched speculative decoding
+    (output-identical by construction — the parity contract tested in
+    tests/test_serving_spec.py) and stamps ``spec_accept_rate`` /
+    ``tokens_per_step`` next to TTFT/TPOT, so the speculative TPOT claim lands
+    in artifacts rather than prose."""
     import time
 
-    from ..compile_cache.warmup import build_model_config
+    from ..compile_cache.warmup import build_drafter, build_model_config
+    from ..generation import GenerationConfig
     from ..models import llama
     from ..serving import ContinuousBatcher
     from ..serving_gateway import ServingGateway
@@ -110,21 +182,46 @@ def run_serve_bench(
 
     cfg = build_model_config(preset, max_len)
     params = llama.init_params(cfg)
-    burst = _workload(requests, cfg.vocab_size, prompt_bucket, high_frac, seed)
+    burst = _workload(requests, cfg.vocab_size, prompt_bucket, high_frac, seed,
+                      kind=workload)
     max_queue = max(1, int(overload * max_slots))
 
+    oracle_refs = None
+    if spec_k and spec_draft == "oracle":
+        # Reference continuations for the oracle ceiling row, computed BEFORE any
+        # timed row (greedy decode is deterministic; the engine's parity contract
+        # makes generate() == served output token-for-token).
+        oracle_refs = {}
+        import numpy as np
+
+        for prompt, _, _ in burst:
+            key = prompt.tobytes()
+            if key not in oracle_refs:
+                out = llama.generate(
+                    params, prompt[None], cfg,
+                    GenerationConfig(max_new_tokens=max_new, temperature=0.0),
+                )
+                oracle_refs[key] = np.asarray(out)[0]  # graftlint: disable=host-sync-in-hot-path(one-time reference precompute before any timed row; the drafter needs host arrays)
+
     def fresh_engine():
+        if not spec_k:
+            drafter = None
+        elif spec_draft == "oracle":
+            drafter = _OracleDrafter(oracle_refs)
+        else:
+            # A drafter binds to ONE engine (per-slot draft cache): fresh per row.
+            drafter = build_drafter(spec_draft, params, cfg)
         return ContinuousBatcher(
             params, cfg, max_slots=max_slots, max_len=max_len,
-            prompt_bucket=prompt_bucket,
+            prompt_bucket=prompt_bucket, spec_k=spec_k, drafter=drafter,
         )
 
-    # Warm every program variant (prefill, decode, each slot's row insert) on a
-    # throwaway engine so no policy row pays XLA compile — jit caches are
+    # Warm every program variant (prefill, decode/verify, each slot's row insert)
+    # on a throwaway engine so no policy row pays XLA compile — jit caches are
     # process-wide for identical shapes.
     warm = fresh_engine()
     for prompt, _, _ in burst[: max_slots * 2]:
-        warm.submit(prompt, max_new_tokens=2)
+        warm.submit(prompt, max_new_tokens=max(2, min(max_new, spec_k + 2)))
     warm.run()
 
     rows = []
@@ -163,14 +260,20 @@ def run_serve_bench(
         high_done = [r for r in done if r.priority > 0]
         summary = gw.slo_summary()
         counters = gw.counters
+        estats = gw.engine.stats()
         rows.append({
-            "metric": f"serve/{policy}",
+            "metric": f"serve/{policy}" + (f"/spec{spec_k}" if spec_k else ""),
             "policy": policy,
             "preset": preset,
             "requests": requests,
             "max_slots": max_slots,
             "max_queue": max_queue,
             "overload": overload,
+            "workload": workload,
+            "spec_k": spec_k,
+            "spec_draft": spec_draft if spec_k else None,
+            "spec_accept_rate": estats["spec_accept_rate"],
+            "tokens_per_step": estats["tokens_per_step"],
             "wall_s": round(wall_s, 3),
             "tokens_generated": sum(len(r.tokens) for r in done),
             "tokens_per_sec": round(
@@ -214,6 +317,9 @@ def serve_bench_command(args) -> int:
         deadline_tight=args.deadline_tight,
         deadline_loose=args.deadline_loose,
         seed=args.seed,
+        spec_k=args.spec_k,
+        spec_draft=args.spec_draft,
+        workload=args.workload,
     )
     for row in rows:
         print(json.dumps(row))

@@ -48,6 +48,13 @@ def warmup_command_parser(subparsers=None) -> argparse.ArgumentParser:
                         help="serving cache length (default: --seq-len)")
     parser.add_argument("--max-new-tokens", type=int, default=32,
                         help="serving generation budget used for bucket validation")
+    parser.add_argument("--spec-k", type=int, default=0,
+                        help="speculative proposals per slot per step (adds the fused "
+                             "[B, k+1] verify program; 0 = plain decode only)")
+    parser.add_argument("--spec-draft", default=None, choices=("ngram", "half"),
+                        help="draft source for the speculative surface: 'ngram' "
+                             "(model-free, default) or 'half' (half-depth draft model "
+                             "— also warms its prefill/decode/insert programs)")
     parser.add_argument("--cache-dir", default=None,
                         help="AOT cache directory (default: ACCELERATE_COMPILE_CACHE_DIR "
                              "or ~/.cache/accelerate_tpu/aot_cache)")
@@ -84,6 +91,8 @@ def warmup_command(args) -> int:
         max_slots=args.max_slots,
         max_len=args.max_len,
         max_new_tokens=args.max_new_tokens,
+        spec_k=args.spec_k,
+        spec_draft=args.spec_draft,
         cache_config=config,
         manifest_path=args.manifest,
     )

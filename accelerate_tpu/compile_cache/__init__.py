@@ -23,7 +23,7 @@ from ..utils.dataclasses import CompileCacheConfig
 from .buckets import pick_bucket
 from .cache import AotCache, CachedFunction, as_cached
 from .fingerprint import backend_environment, fingerprint, signature_key
-from .warmup import build_model_config, run_warmup
+from .warmup import build_drafter, build_model_config, run_warmup
 
 __all__ = [
     "AotCache",
@@ -31,6 +31,7 @@ __all__ = [
     "CompileCacheConfig",
     "as_cached",
     "backend_environment",
+    "build_drafter",
     "build_model_config",
     "fingerprint",
     "pick_bucket",

@@ -28,7 +28,7 @@ from ..utils.dataclasses import CompileCacheConfig
 
 logger = get_logger(__name__)
 
-__all__ = ["build_model_config", "run_warmup", "write_manifest"]
+__all__ = ["build_model_config", "build_drafter", "run_warmup", "write_manifest"]
 
 MANIFEST_SCHEMA = "accelerate_tpu.compile_cache.warmup/v1"
 MANIFEST_NAME = "warmup_manifest.json"
@@ -58,6 +58,26 @@ def build_model_config(preset: str, seq_len: int):
     return dataclasses.replace(cfg, max_seq=seq_len)
 
 
+def build_drafter(spec_draft: Optional[str], target_params, target_cfg):
+    """A ``spec_decode.DraftSource`` for one warmup/bench geometry: ``None``/"ngram"
+    → the model-free prompt-lookup drafter (no extra programs); ``"half"`` → a
+    half-depth copy of the target config with fresh params (vocabulary-compatible by
+    construction — the standard CI shape for exercising the draft-model program
+    surface without a second checkpoint)."""
+    from ..spec_decode import ModelDrafter, NgramDrafter
+
+    if spec_draft in (None, "ngram"):
+        return NgramDrafter()
+    if spec_draft == "half":
+        from ..models import llama
+
+        d_cfg = dataclasses.replace(
+            target_cfg, n_layers=max(1, target_cfg.n_layers // 2)
+        )
+        return ModelDrafter(llama.init_params(d_cfg), d_cfg)
+    raise ValueError(f"spec_draft={spec_draft!r}: expected 'ngram' or 'half'")
+
+
 def run_warmup(
     *,
     preset: str = "smoke",
@@ -72,6 +92,8 @@ def run_warmup(
     max_slots: int = 4,
     max_len: Optional[int] = None,
     max_new_tokens: int = 32,
+    spec_k: int = 0,
+    spec_draft: Optional[str] = None,
     cache_config: Optional[CompileCacheConfig] = None,
     manifest_path: Optional[str] = None,
     cache=None,
@@ -101,6 +123,12 @@ def run_warmup(
     if not config.enabled:
         raise ValueError("warmup needs an enabled CompileCacheConfig")
 
+    if spec_k and not serve:
+        raise ValueError(
+            "spec_k was given but serve=False: no verify/draft programs would be "
+            "warmed and the manifest would silently stamp spec_k=0 — pass "
+            "serve=True (--serve) to warm the speculative surface"
+        )
     cfg = build_model_config(preset, seq_len)
     entries: list = []
 
@@ -165,9 +193,14 @@ def run_warmup(
         from ..serving import ContinuousBatcher
 
         engine_len = max_len if max_len is not None else seq_len
+        # Speculative serving surface: ``spec_k > 0`` adds the fused [B, spec_k+1]
+        # verify program and — with ``spec_draft="half"`` — a half-depth draft model's
+        # prefill/decode/insert programs. Both ride the same bucket ladder and land in
+        # this manifest, so a spec-enabled replica restart compiles nothing.
+        drafter = build_drafter(spec_draft, params, cfg) if spec_k else None
         engine = ContinuousBatcher(
             params, cfg, max_slots=max_slots, max_len=engine_len,
-            compile_cache=cache,
+            compile_cache=cache, spec_k=spec_k, drafter=drafter,
         )
         entries.extend(engine.warm_programs(max_new_tokens=max_new_tokens))
 
@@ -191,6 +224,8 @@ def run_warmup(
         "serve": serve,
         "max_slots": max_slots,
         "max_len": max_len if max_len is not None else seq_len,
+        "spec_k": spec_k if serve else 0,
+        "spec_draft": (spec_draft or "ngram") if serve and spec_k else None,
         "cache_dir": cache.cache_dir,
         "cache_stats": cache.stats(),
         "programs": [e for e in entries if e],

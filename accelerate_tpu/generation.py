@@ -34,7 +34,15 @@ from typing import Callable, Optional
 import jax
 import jax.numpy as jnp
 
-__all__ = ["GenerationConfig", "sample_logits", "sampling_core", "generate_loop", "streamed_generate_loop"]
+__all__ = [
+    "GenerationConfig",
+    "sample_logits",
+    "sampling_core",
+    "speculative_accept",
+    "speculative_accept_batch",
+    "generate_loop",
+    "streamed_generate_loop",
+]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -112,6 +120,22 @@ def speculative_accept(p_probs: jax.Array, q_probs: jax.Array, draft_token,
     resid_tok = jax.random.categorical(k_resid, jnp.log(jnp.maximum(safe, 1e-30)))
     token = jnp.where(accepted, draft_token, resid_tok).astype(jnp.int32)
     return accepted, token
+
+
+def speculative_accept_batch(p_probs: jax.Array, q_probs: jax.Array, draft_tokens,
+                             keys: jax.Array):
+    """Vectorized :func:`speculative_accept`: N independent accept/reject tests in ONE
+    dispatch — ``p_probs``/``q_probs`` [N, V], ``draft_tokens`` [N], ``keys`` [N] →
+    (accepted bool[N], tokens int32[N]). Each row's marginal output distribution is
+    exactly its target row p (the scalar function vmapped, so the math cannot drift).
+
+    This is the serving engine's residual accept mode: all k proposals of a slot (or a
+    whole batch of slots) are tested at once, and the caller takes the leading-accept
+    prefix — test j's token is the residual re-draw that ends the round when j is the
+    first rejection. Tokens at positions AFTER the first rejection are computed but
+    discarded; their keys are never consumed by any retained draw, so the sequential
+    accept-chain semantics (and the losslessness proof) are unchanged."""
+    return jax.vmap(speculative_accept)(p_probs, q_probs, draft_tokens, keys)
 
 
 def sample_logits(logits: jax.Array, gen: GenerationConfig, rng: Optional[jax.Array]) -> jax.Array:

@@ -94,7 +94,11 @@ def quant_kv(x: jax.Array) -> tuple[jax.Array, jax.Array]:
 
 def write_kv(kv: dict, name: str, val: jax.Array, index) -> dict:
     """Write ``val`` [B,T,...] into cache plane ``name`` at ``index`` (scalar slot for all
-    rows, or per-row vector with T == 1), quantizing when the cache is int8."""
+    rows, or per-row vector: row b's tokens land at slots ``index[b] .. index[b]+T-1`` —
+    the continuous-batching decode (T == 1) and the batched speculative verify (T == k)
+    share this path), quantizing when the cache is int8. Per-row writes past the cache
+    end are dropped (jax scatter OOB semantics); the serving engine's budget capping
+    guarantees no emitted token ever depends on a dropped slot."""
     out = {}
     if f"{name}_scale" in kv:
         q, scale = quant_kv(val)
@@ -108,7 +112,14 @@ def write_kv(kv: dict, name: str, val: jax.Array, index) -> dict:
             )
         else:
             rows = jnp.arange(plane.shape[0])
-            out[key] = kv[key].at[rows, index].set(plane[:, 0].astype(kv[key].dtype))
+            T = plane.shape[1]
+            if T == 1:
+                out[key] = kv[key].at[rows, index].set(plane[:, 0].astype(kv[key].dtype))
+            else:
+                slots = index[:, None] + jnp.arange(T, dtype=index.dtype)[None, :]
+                out[key] = kv[key].at[rows[:, None], slots].set(
+                    plane.astype(kv[key].dtype)
+                )
     return out
 
 

@@ -45,6 +45,7 @@ __all__ = [
     "head_logits",
     "init_cache",
     "forward_cached",
+    "forward_slots",
     "generate",
     "generate_streamed",
     "num_params",
@@ -765,6 +766,47 @@ def forward_cached(
     if cfg.lm_head_bias and "b_lm_head" in params:
         logits = logits + params["b_lm_head"].astype(jnp.float32)
     return logits, {"layers": new_layers, "valid": valid, "index": index + T}
+
+
+def forward_slots(
+    params: dict,
+    tokens: jax.Array,
+    cache: dict,
+    positions: jax.Array,
+    cfg: GPTConfig,
+) -> tuple[jax.Array, dict]:
+    """Per-slot cached forward, llama-identical contract (``llama.forward_slots``):
+    ``tokens`` [B,T] written at each row's own slots ``positions[b] ..
+    positions[b]+T-1`` → (logits fp32 [B,T,V], new cache). T == 1 is continuous-batching
+    decode; T == k+1 is the batched speculative verify. Lets a gpt-family draft model
+    ride the serving engine's speculative decoder (cross-family draft/target pairs share
+    this contract through ``common.cached_decode_family``)."""
+    B, T = tokens.shape
+    rows = jnp.arange(B)
+    pos_grid = positions[:, None] + jnp.arange(T, dtype=positions.dtype)[None, :]
+    if T == 1:
+        valid = cache["valid"].at[rows, positions].set(True)
+    else:
+        valid = cache["valid"].at[rows[:, None], pos_grid].set(True)
+    x = _embed(params, tokens, pos_grid, cfg)
+    if cfg.scan_layers:
+        def body(carry, layer_and_kv):
+            layer, kv = layer_and_kv
+            out, new_kv = _block_cached(carry, layer, kv, positions, pos_grid, valid, cfg)
+            return out, new_kv
+
+        x, new_layers = jax.lax.scan(body, x, (params["layers"], cache["layers"]))
+    else:
+        new_layers = []
+        for layer, kv in zip(params["layers"], cache["layers"]):
+            x, new_kv = _block_cached(x, layer, kv, positions, pos_grid, valid, cfg)
+            new_layers.append(new_kv)
+    x = _layer_norm(x, params["ln_f"], cfg.norm_eps)
+    head = params["wte"].T if cfg.tie_embeddings else params["lm_head"]
+    logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
+    if cfg.lm_head_bias and "b_lm_head" in params:
+        logits = logits + params["b_lm_head"].astype(jnp.float32)
+    return logits, {"layers": new_layers, "valid": valid, "index": cache["index"]}
 
 
 def _make_gen_fns(cfg: GPTConfig, max_len: int):

@@ -52,6 +52,7 @@ __all__ = [
     "CONFIGS",
     "init_cache",
     "forward_cached",
+    "forward_slots",
     "generate",
     "generate_speculative",
     "generate_streamed",
@@ -1235,14 +1236,23 @@ def _attention_cached(q, ck, cv, q_positions, valid, cfg: LlamaConfig):
     return jnp.einsum("bkgtc,bckd->btkgd", probs, cv).reshape(B, T, H, hd)
 
 
-def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig):
+def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig,
+                  moe_dense: Optional[bool] = None):
     """One block with KV-cache read/write → (x, new_kv).
 
     ``index`` is the write slot: a SCALAR advances every row together (generate's
     prefill/decode), a VECTOR [B] gives each row its own slot (the continuous-batching
-    engine, ``serving.py`` — requires T == 1).
+    engine, ``serving.py`` — T == 1 decode, or T == k for the batched speculative
+    verify, where row b writes slots ``index[b] .. index[b]+T-1``).
+
+    ``moe_dense`` forces the drop-free dense MoE routing regardless of T (default:
+    dense iff T == 1). The speculative verify passes True — every verified position
+    must route exactly like the T == 1 decode it replaces, or acceptance would compare
+    against capacity-pooled logits and break decode parity.
     """
     B, T, D = x.shape
+    if moe_dense is None:
+        moe_dense = T == 1
     p1 = cfg.norm_plus_one
     h = _rms_norm(x, layer["ln_attn"], cfg.norm_eps, p1)
     q, k, v = _qkv_proj(h, layer, cfg)
@@ -1264,7 +1274,7 @@ def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig):
     if cfg.moe_experts > 0:
         from ..ops.moe import moe_mlp, moe_mlp_dense
 
-        if T == 1:
+        if moe_dense:
             # Decode: drop-free dense routing — capacity pooling over a single-token step
             # would drop tokens whenever a step's rows collide on an expert (training's
             # fixed-shape load-management artifact, wrong for inference).
@@ -1380,6 +1390,91 @@ def forward_cached(
     logits = head_logits(x, params, cfg)
     new_cache = {"layers": new_layers, "valid": valid, "index": index + T}
     return logits, new_cache
+
+
+def forward_slots(
+    params: dict,
+    tokens: jax.Array,
+    cache: dict,
+    positions: jax.Array,
+    cfg: LlamaConfig,
+) -> tuple[jax.Array, dict]:
+    """Per-slot cached forward: ``tokens`` [B,T] written at each row's own cache slots
+    ``positions[b] .. positions[b]+T-1`` → (logits fp32 [B,T,V], new cache).
+
+    The continuous-batching counterpart of :func:`forward_cached` (whose single scalar
+    ``index`` advances all rows together): every lane carries its own write position, so
+    one compiled program serves a batch of requests at arbitrary, different sequence
+    lengths. T == 1 is the engine's decode step; T == k+1 is the batched speculative
+    VERIFY — one fused target forward scoring a pending token plus k draft proposals
+    per lane, each position's logits exactly the distribution the T == 1 decode would
+    have produced there (same rope positions, same causal/valid masking, dense MoE
+    routing — decode-parity is what makes speculative acceptance lossless). Slots past
+    a lane's rewound position may hold garbage K/V from rejected drafts; the causal
+    mask (``slot <= q_position``) makes them unreachable until overwritten.
+    """
+    B, T = tokens.shape
+    rows = jnp.arange(B)
+    pos_grid = positions[:, None] + jnp.arange(T, dtype=positions.dtype)[None, :]  # [B,T]
+    if T == 1:
+        valid = cache["valid"].at[rows, positions].set(True)
+    else:
+        valid = cache["valid"].at[rows[:, None], pos_grid].set(True)
+    x = params["embed"][tokens].astype(cfg.dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(math.sqrt(cfg.d_model), cfg.dtype)
+    alternating = bool(cfg.sliding_window) and cfg.window_every > 1
+    if cfg.scan_layers and alternating:
+        # Mirror forward_cached's grouped scan: layer j of each window_every-group is
+        # banded iff j == 0 (without this, decode would band-limit the full-attention
+        # layers and diverge from generate()).
+        per = cfg.window_every
+        full_cfg = dataclasses.replace(cfg, sliding_window=0)
+        regroup = lambda a: a.reshape(cfg.n_layers // per, per, *a.shape[1:])  # noqa: E731
+        grouped = jax.tree_util.tree_map(regroup, (params["layers"], cache["layers"]))
+
+        def body(carry, group):
+            layers_g, kv_g = group
+            out = carry
+            new_kvs = []
+            for j in range(per):
+                layer_j = jax.tree_util.tree_map(lambda a, j=j: a[j], layers_g)
+                kv_j = jax.tree_util.tree_map(lambda a, j=j: a[j], kv_g)
+                out, new_kv = _block_cached(
+                    out, layer_j, kv_j, positions, pos_grid, valid,
+                    cfg if j == 0 else full_cfg, moe_dense=True,
+                )
+                new_kvs.append(new_kv)
+            return out, jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *new_kvs)
+
+        x, new_grouped = jax.lax.scan(body, x, grouped)
+        new_layers = jax.tree_util.tree_map(
+            lambda a: a.reshape(cfg.n_layers, *a.shape[2:]), new_grouped
+        )
+    elif cfg.scan_layers:
+        def body(carry, layer_and_kv):
+            layer, kv = layer_and_kv
+            # vector index → per-row write slots (_block_cached handles both)
+            out, new_kv = _block_cached(
+                carry, layer, kv, positions, pos_grid, valid, cfg, moe_dense=True
+            )
+            return out, new_kv
+
+        x, new_layers = jax.lax.scan(body, x, (params["layers"], cache["layers"]))
+    else:
+        # Mirror forward_cached's per-layer banded/full alternation (cfg.window_every).
+        full_cfg = dataclasses.replace(cfg, sliding_window=0)
+        new_layers = []
+        for i, (layer, kv) in enumerate(zip(params["layers"], cache["layers"])):
+            banded = cfg.sliding_window and i % cfg.window_every == 0
+            x, new_kv = _block_cached(
+                x, layer, kv, positions, pos_grid, valid,
+                cfg if banded else full_cfg, moe_dense=True,
+            )
+            new_layers.append(new_kv)
+    x = _rms_norm(x, params["ln_f"], cfg.norm_eps, cfg.norm_plus_one)
+    logits = head_logits(x, params, cfg)
+    return logits, {"layers": new_layers, "valid": valid, "index": cache["index"]}
 
 
 def _make_gen_fns(cfg: LlamaConfig, max_len: int):

@@ -13,16 +13,27 @@ Design (static shapes throughout):
 - Prefill runs the existing single-row compiled path (``llama.forward_cached`` with the
   prompt left-padded to a bucketed width — one executable per bucket) and the resulting
   cache ROW is scattered into the engine cache at the freed slot (one compiled insert).
-- Decode is ``_decode_step``: embed [B,1] tokens, per-layer scatter-write at
-  ``positions``, attend over each slot's valid prefix. Greedy argmax stays fused
-  on-device; sampled requests (per-request ``GenerationConfig`` + private key schedule)
-  draw host-side from the logits row. Finished/inactive slots keep computing (their
-  output is ignored) — static shapes beat branchy savings.
+- Decode is ``_decode_step`` (one token per slot per call) or — with ``spec_k > 0`` —
+  the batched SPECULATIVE step: a ``spec_decode.DraftSource`` proposes k tokens per
+  active slot, ONE fused target forward over ``[B, k+1]`` (``_spec_verify_step``, the
+  per-slot ``llama.forward_slots``) verifies them, and each slot accepts a
+  variable-length prefix (1..k+1 tokens per step). Greedy slots accept by exact token
+  match against the fused argmax; sampled slots either REPLAY the target's own sampler
+  over the shared filtered-softmax path with the request's per-step key schedule
+  (default — emitted tokens are then BITWISE what ``spec_k=0`` would have drawn) or run
+  the vectorized Leviathan accept/reject (``spec_accept="residual"``,
+  ``generation.speculative_accept_batch`` — lossless in distribution, higher
+  acceptance). Rejected drafts leave garbage K/V above each slot's rewound position;
+  the per-slot ``positions``/``valid`` causal masking makes it unreachable until the
+  next step's writes overwrite it. The draft NEVER changes outputs, only how many
+  target forwards a sequence costs (``stats()["tokens_per_step"]``).
 
 Correctness contract (tested): with requests submitted at staggered times, every finished
 sequence equals ``llama.generate``'s greedy output for that prompt alone (for MoE configs,
 for that prompt left-padded to the engine's bucket width — capacity-pooled MoE routing is
-shape-sensitive, so parity is defined at matching padded shapes).
+shape-sensitive, so parity is defined at matching padded shapes) — with ``spec_k > 0``
+token-for-token identical to ``spec_k = 0``, greedy and sampled alike
+(docs/speculative_serving.md).
 """
 
 from __future__ import annotations
@@ -38,9 +49,14 @@ import jax
 import jax.numpy as jnp
 
 from .compile_cache import AotCache, as_cached, pick_bucket
-from .generation import GenerationConfig, sampling_core
+from .generation import (
+    GenerationConfig,
+    filtered_logits,
+    sampling_core,
+    speculative_accept_batch,
+)
 from .models import llama
-from .models.llama import _block_cached, _rms_norm, init_cache
+from .models.llama import init_cache
 from .utils.dataclasses import CompileCacheConfig
 
 __all__ = ["ContinuousBatcher", "Request", "normalize_submit"]
@@ -139,75 +155,74 @@ class Request:
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
 def _decode_step(params, cache, tokens, positions, cfg):
-    """Advance every slot: (greedy_token [B] int32, logits [B, V] fp32, new cache).
+    """Advance every slot one token: (greedy_token [B] int32, logits [B, V] fp32, new
+    cache) — the T == 1 instance of ``llama.forward_slots`` (per-slot write positions,
+    per-slot causal/valid masking).
 
     The greedy argmax stays fused on-device; the logits matrix is only fetched host-side
     when a sampled (temperature > 0) request is active."""
-    import dataclasses as _dc
-    import math as _math
+    logits, cache = llama.forward_slots(params, tokens[:, None], cache, positions, cfg)
+    logits = logits[:, -1, :]
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), logits, cache
 
-    from .models.llama import _softcap
 
-    B = tokens.shape[0]
-    rows = jnp.arange(B)
-    valid = cache["valid"].at[rows, positions].set(True)
-    x = params["embed"][tokens].astype(cfg.dtype)[:, None, :]
-    if cfg.embed_scale:
-        x = x * jnp.asarray(_math.sqrt(cfg.d_model), cfg.dtype)
-    pos2 = positions[:, None]
-    alternating = bool(cfg.sliding_window) and cfg.window_every > 1
-    if cfg.scan_layers and alternating:
-        # Mirror forward_cached's grouped scan: layer j of each window_every-group is
-        # banded iff j == 0 (without this, decode would band-limit the full-attention
-        # layers and diverge from generate()).
-        per = cfg.window_every
-        full_cfg = _dc.replace(cfg, sliding_window=0)
-        regroup = lambda a: a.reshape(cfg.n_layers // per, per, *a.shape[1:])  # noqa: E731
-        grouped = jax.tree_util.tree_map(regroup, (params["layers"], cache["layers"]))
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))
+def _spec_verify_step(params, cache, tokens, positions, cfg):
+    """Batched speculative VERIFY: score ``tokens`` [B, k+1] (each lane's pending token
+    + k draft proposals) in ONE fused target forward → (greedy [B, k+1] int32, logits
+    [B, k+1, V] fp32, new cache).
 
-        def body(carry, group):
-            layers_g, kv_g = group
-            out = carry
-            new_kvs = []
-            for j in range(per):
-                layer_j = jax.tree_util.tree_map(lambda a, j=j: a[j], layers_g)
-                kv_j = jax.tree_util.tree_map(lambda a, j=j: a[j], kv_g)
-                out, new_kv = _block_cached(
-                    out, layer_j, kv_j, positions, pos2, valid,
-                    cfg if j == 0 else full_cfg,
-                )
-                new_kvs.append(new_kv)
-            return out, jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *new_kvs)
+    Column j of the output is the target's next-token distribution AFTER input j given
+    that lane's accepted context — exactly what j sequential ``_decode_step`` calls
+    would have produced (same rope positions, same masking, dense MoE routing), which
+    is what makes prefix acceptance lossless. Rejected proposals leave garbage K/V
+    above the lane's rewound position; the causal mask hides it until the next step's
+    writes land on those very slots."""
+    logits, cache = llama.forward_slots(params, tokens, cache, positions, cfg)
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), logits, cache
 
-        x, new_grouped = jax.lax.scan(body, x, grouped)
-        new_layers = jax.tree_util.tree_map(
-            lambda a: a.reshape(cfg.n_layers, *a.shape[2:]), new_grouped
-        )
-    elif cfg.scan_layers:
-        def body(carry, layer_and_kv):
-            layer, kv = layer_and_kv
-            # vector index → per-row write slots (llama._block_cached handles both)
-            out, new_kv = _block_cached(carry, layer, kv, positions, pos2, valid, cfg)
-            return out, new_kv
 
-        x, new_layers = jax.lax.scan(body, x, (params["layers"], cache["layers"]))
-    else:
-        # Mirror forward_cached's per-layer banded/full alternation (cfg.window_every).
-        full_cfg = _dc.replace(cfg, sliding_window=0)
-        new_layers = []
-        for i, (layer, kv) in enumerate(zip(params["layers"], cache["layers"])):
-            banded = cfg.sliding_window and i % cfg.window_every == 0
-            x, new_kv = _block_cached(
-                x, layer, kv, positions, pos2, valid, cfg if banded else full_cfg
-            )
-            new_layers.append(new_kv)
-    x = _rms_norm(x, params["ln_f"], cfg.norm_eps, cfg.norm_plus_one)
-    head = params["embed"].T if cfg.tie_embeddings else params["lm_head"]
-    logits = _softcap(
-        (x[:, -1, :] @ head.astype(cfg.dtype)).astype(jnp.float32), cfg.final_softcap
+@partial(jax.jit, static_argnames=("top_k",))
+def _replay_draws(logits_rows, keys, temperature, top_p, top_k: int):
+    """Replay the plain sampler at every verify position of ONE sampled slot in one
+    dispatch: ``logits_rows`` [T, V] + per-emission keys [T] → the tokens [T] that
+    ``spec_k = 0`` decode would draw at each position (``generation.sampling_core`` —
+    the same filtered-softmax path, so replay-mode speculative output is BITWISE the
+    plain sampled output). Only the drawn int32 vector crosses to host."""
+    return jax.vmap(
+        lambda row, key: sampling_core(row[None], key, temperature, top_p, top_k)[0]
+    )(logits_rows, keys)
+
+
+@partial(jax.jit, static_argnames=("top_k",))
+def _spec_residual_jit(logits_rows, drafts, keys, temperature, top_p, top_k: int):
+    """Leviathan accept/reject for ONE sampled slot's round, fully on device →
+    (emitted [k+1] int32, count int32): ``emitted[:count]`` = accepted draft prefix +
+    the correction (residual re-draw at the first rejection) or the bonus draw on full
+    acceptance.
+
+    Target probs come from the SAME ``filtered_logits`` path ``generate()`` samples
+    from; all k accept tests run at once through the vectorized
+    ``speculative_accept_batch`` (the deterministic drafter's q is a point mass on its
+    proposal, under which min(1, p/q) reduces to accept-with-prob p(draft) and the
+    residual to p minus the draft's mass, renormalized). Tests after the first
+    rejection are computed and discarded — their keys are never consumed by a retained
+    draw, so the sequential accept-chain distribution (exactly the target's own
+    sampling distribution, per ``generation.speculative_accept``) is unchanged."""
+    k = drafts.shape[0]
+    p = jax.nn.softmax(filtered_logits(logits_rows, temperature, top_p, top_k), axis=-1)
+    q = jax.nn.one_hot(drafts, logits_rows.shape[-1], dtype=jnp.float32)
+    acc, toks = speculative_accept_batch(p[:-1], q, drafts, keys[:-1])
+    n = jnp.sum(jnp.cumprod(acc.astype(jnp.int32)))  # leading accepts
+    bonus = jax.random.categorical(
+        keys[-1], jnp.log(jnp.maximum(p[-1], 1e-30))
+    ).astype(jnp.int32)
+    correction = jnp.where(n == k, bonus, toks[jnp.minimum(n, k - 1)])
+    emitted = jnp.where(
+        jnp.arange(k + 1) < n, jnp.concatenate([drafts, jnp.zeros((1,), jnp.int32)]), 0
     )
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return greedy, logits, {"layers": new_layers, "valid": valid, "index": cache["index"]}
+    emitted = emitted.at[n].set(correction)
+    return emitted, n + 1
 
 
 @partial(jax.jit, static_argnames=("slot", "scan_layers"), donate_argnums=(0,))
@@ -280,12 +295,41 @@ class ContinuousBatcher:
 
     def __init__(self, params, cfg, max_slots: int = 8, max_len: int = 512,
                  prompt_bucket: int = 64, prefix_cache: int = 0, telemetry=None,
-                 compile_cache=None, prompt_buckets=None):
+                 compile_cache=None, prompt_buckets=None, spec_k: int = 0,
+                 drafter=None, spec_accept: str = "replay"):
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
         self.max_len = max_len
         self.prompt_bucket = prompt_bucket
+        # Batched speculative decoding: ``spec_k`` draft proposals per active slot per
+        # step, verified by ONE fused [B, spec_k+1] target forward; each slot accepts a
+        # variable-length prefix. 0 (default) = the classic one-token decode step,
+        # byte-identical to the pre-speculative engine. ``drafter`` is a
+        # ``spec_decode.DraftSource`` (default: the model-free NgramDrafter).
+        # ``spec_accept`` picks the sampled-slot acceptance test: "replay" (bitwise
+        # parity with spec_k=0 under a fixed key schedule) or "residual" (vectorized
+        # Leviathan accept/reject — lossless in distribution, higher acceptance).
+        if not isinstance(spec_k, (int, np.integer)) or isinstance(spec_k, bool):
+            raise TypeError(f"spec_k must be an int, got {type(spec_k).__name__}")
+        if spec_k < 0:
+            raise ValueError(f"spec_k={spec_k} must be >= 0 (0 disables speculation)")
+        if spec_accept not in ("replay", "residual"):
+            raise ValueError(
+                f"spec_accept={spec_accept!r}: expected 'replay' or 'residual'"
+            )
+        self.spec_k = int(spec_k)
+        self.spec_accept = spec_accept
+        if drafter is not None and not self.spec_k:
+            raise ValueError(
+                "a drafter was given but spec_k=0: it would be silently ignored — "
+                "pass spec_k>=1 to enable speculative decoding"
+            )
+        if self.spec_k and drafter is None:
+            from .spec_decode import NgramDrafter
+
+            drafter = NgramDrafter()
+        self.drafter = drafter
         # Persistent AOT executable cache (``accelerate_tpu.compile_cache``): accepts
         # a shared AotCache (e.g. ``accelerator.compile_cache``) or a
         # CompileCacheConfig. Disabled/None leaves every program on the plain
@@ -297,6 +341,8 @@ class ContinuousBatcher:
         ) else None
         cc = self.compile_cache
         self._decode_fn = as_cached(_decode_step, cc, "serving.decode", ("cfg",))
+        self._spec_verify_fn = as_cached(
+            _spec_verify_step, cc, "serving.spec_verify", ("cfg",))
         self._prefill_fn = as_cached(
             _prefill_jit, cc, "serving.prefill", ("cfg", "max_len"))
         self._prefill_chunk_fn = as_cached(
@@ -355,13 +401,26 @@ class ContinuousBatcher:
         self.admitted = 0   # requests that entered a slot (prefill ran)
         self.evicted = 0    # slot frees: finished (EOS/max_new_tokens) requests
         self.evicted_external = 0  # slot frees forced by evict() (deadline/cancel/preempt)
+        # Decode-throughput accounting: tokens emitted per decode dispatch is THE
+        # speculative-decoding headline metric (TPOT ∝ 1/tokens_per_step when decode
+        # dominates); proposed/accepted drive the acceptance rate.
+        self.decode_steps = 0    # decode/verify dispatches (admission prefills excluded)
+        self.decode_tokens = 0   # tokens emitted by those dispatches
+        self.spec_proposed = 0   # draft tokens proposed (spec_k × active lanes per step)
+        self.spec_accepted = 0   # proposed tokens that were emitted (match/accept)
+        if self.drafter is not None:
+            self.drafter.bind(self)
 
     # ------------------------------------------------------------------ user API
     def stats(self) -> dict:
         """Engine observability snapshot: queue depth, busy lanes, admission/eviction
-        totals, prefix-cache counters. ``queue_wait_s`` is the age of the OLDEST queued
-        request (0.0 when the queue is empty) — queue latency stays observable even
-        without the gateway tier (``serving_gateway``) on top."""
+        totals, prefix-cache counters, decode-throughput counters. ``queue_wait_s`` is
+        the age of the OLDEST queued request (0.0 when the queue is empty) — queue
+        latency stays observable even without the gateway tier (``serving_gateway``)
+        on top. ``tokens_per_step`` (emitted tokens per decode dispatch — >1 only with
+        speculation accepting drafts) and ``spec_accept_rate`` (accepted/proposed
+        drafts) are the speculative headline numbers serve-bench and bench rows
+        stamp; both are None before any decode step / proposal."""
         active = sum(r is not None for r in self.slot_req)
         queue_wait_s = 0.0
         if self.queue:
@@ -381,6 +440,19 @@ class ContinuousBatcher:
             "prefix_misses": self.prefix_misses,
             "bucket_hits": self.bucket_hits,
             "bucket_misses": self.bucket_misses,
+            "spec_k": self.spec_k,
+            "decode_steps": self.decode_steps,
+            "decode_tokens": self.decode_tokens,
+            "tokens_per_step": (
+                round(self.decode_tokens / self.decode_steps, 4)
+                if self.decode_steps else None
+            ),
+            "spec_proposed": self.spec_proposed,
+            "spec_accepted": self.spec_accepted,
+            "spec_accept_rate": (
+                round(self.spec_accepted / self.spec_proposed, 4)
+                if self.spec_proposed else None
+            ),
         }
 
     def _emit_telemetry(self, extra: Optional[dict] = None) -> None:
@@ -448,13 +520,25 @@ class ContinuousBatcher:
         return False
 
     def step(self) -> list[Request]:
-        """Admit queued requests, decode one token on every active slot."""
+        """Admit queued requests, then advance every active slot: one token each
+        (``spec_k == 0``) or a verified 1..spec_k+1-token prefix each (speculative)."""
         finished_at_admit = self._admit()
         active = [i for i, r in enumerate(self.slot_req) if r is not None]
         if not active:
             if finished_at_admit:
                 self._emit_telemetry()  # admissions alone still move the counters
             return finished_at_admit
+        finished = (
+            self._spec_step(active) if self.spec_k else self._plain_step(active)
+        )
+        self.evicted += len(finished)
+        self._emit_telemetry()
+        # Report in submission order (uid is the admission counter), not slot order —
+        # slot assignment is an engine detail a client should never observe.
+        return sorted(finished_at_admit + finished, key=lambda r: r.uid)
+
+    def _plain_step(self, active: list[int]) -> list[Request]:
+        """Classic decode: ONE compiled dispatch advances every lane one token."""
         greedy, logits, self.cache = self._decode_fn(
             self.params, self.cache, jnp.asarray(self.tokens),
             jnp.asarray(self.positions), cfg=self.cfg,
@@ -482,11 +566,137 @@ class ContinuousBatcher:
                 req.done = True
                 finished.append(req)
                 self.slot_req[i] = None  # slot frees; cache row overwritten on next admit
-        self.evicted += len(finished)
-        self._emit_telemetry()
-        # Report in submission order (uid is the admission counter), not slot order —
-        # slot assignment is an engine detail a client should never observe.
-        return sorted(finished_at_admit + finished, key=lambda r: r.uid)
+        self.decode_steps += 1
+        self.decode_tokens += len(active)
+        return finished
+
+    def _spec_step(self, active: list[int]) -> list[Request]:
+        """Speculative decode: propose → ONE fused verify → per-slot prefix acceptance.
+
+        Per active slot the emitted tokens are exactly the first ``n_emit`` columns of
+        that slot's reference row (fused argmax for greedy, sampler replay or Leviathan
+        accept for sampled): accepted proposals EQUAL their reference tokens, and the
+        first mismatch column already holds the correction — so emission is a single
+        slice, with EOS truncation and the generation budget applied on top. The budget
+        cap also bounds every load-bearing cache write to ``prefill + max_new - 2 <
+        max_len``, so lanes near their window end can never depend on a dropped
+        out-of-bounds draft write."""
+        k = self.spec_k
+        T = k + 1
+        proposals = np.asarray(
+            self.drafter.propose(self.slot_req, self.tokens, self.positions, k),
+            np.int32,
+        )
+        seq = np.zeros((self.max_slots, T), np.int32)
+        seq[:, 0] = self.tokens  # pending token: emitted last step, not yet written
+        seq[:, 1:] = proposals
+        greedy, logits, self.cache = self._spec_verify_fn(
+            self.params, self.cache, jnp.asarray(seq),
+            jnp.asarray(self.positions), cfg=self.cfg,
+        )
+        greedy_host = np.asarray(greedy)  # [B, T]
+        finished = []
+        step_tokens = step_accepted = 0
+        for i in active:
+            req = self.slot_req[i]
+            # Budget cap: emitting more would overrun the validated cache window.
+            limit = min(T, req.gen.max_new_tokens - len(req.tokens))
+            if req.gen.temperature <= 0.0:
+                ref = greedy_host[i]
+                n = 0
+                while n < k and proposals[i, n] == ref[n]:
+                    n += 1
+                emitted = [int(t) for t in ref[: min(n + 1, limit)]]
+            elif self.spec_accept == "residual":
+                emitted_vec, count = self._residual_round(req, logits[i], proposals[i])
+                emitted = [int(t) for t in emitted_vec[: min(int(count), limit)]]
+            else:
+                ref = self._replay_round(req, logits[i])
+                n = 0
+                while n < k and proposals[i, n] == ref[n]:
+                    n += 1
+                emitted = [int(t) for t in ref[: min(n + 1, limit)]]
+            eos = req.gen.eos_token_id
+            if eos is not None and eos in emitted:
+                emitted = emitted[: emitted.index(eos) + 1]
+            # Accepted = emitted tokens that were draft proposals (the trailing
+            # correction/bonus is the target's own, never a proposal credit).
+            step_accepted += sum(
+                1 for j, t in enumerate(emitted) if j < k and t == int(proposals[i, j])
+            )
+            step_tokens += len(emitted)
+            self.tokens[i] = emitted[-1]
+            self.positions[i] += len(emitted)
+            for tok in emitted:
+                req.tokens.append(tok)
+                if req.on_token is not None:
+                    req.on_token(tok)
+            hit_eos = eos is not None and emitted[-1] == eos
+            if hit_eos or len(req.tokens) >= req.gen.max_new_tokens:
+                req.done = True
+                finished.append(req)
+                self.slot_req[i] = None  # slot frees; cache row overwritten on next admit
+        self.positions = np.minimum(self.positions, self.max_len - 1)
+        self.decode_steps += 1
+        self.decode_tokens += step_tokens
+        self.spec_proposed += k * len(active)
+        self.spec_accepted += step_accepted
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            from .telemetry import TELEMETRY_REV
+
+            tel.emit({
+                "schema": "accelerate_tpu.telemetry.serving.spec/v1",
+                "telemetry_rev": TELEMETRY_REV,
+                "spec_k": k,
+                "active_slots": len(active),
+                "step_proposed": k * len(active),
+                "step_accepted": step_accepted,
+                "step_tokens": step_tokens,
+                "proposed_total": self.spec_proposed,
+                "accepted_total": self.spec_accepted,
+                "spec_accept_rate": (
+                    round(self.spec_accepted / self.spec_proposed, 4)
+                    if self.spec_proposed else None
+                ),
+                "tokens_per_step": (
+                    round(self.decode_tokens / self.decode_steps, 4)
+                    if self.decode_steps else None
+                ),
+            })
+        return finished
+
+    def _step_keys_window(self, req: Request, start: int, T: int):
+        """[T] slice of the request's per-emission key schedule beginning at emission
+        ``start``, clamped at the final key — positions past the generation budget are
+        verify-row surplus whose draws are computed and discarded (never emitted, and
+        their keys are never consumed by a retained draw)."""
+        ks = req._step_keys
+        idx = np.minimum(start + np.arange(T), ks.shape[0] - 1)
+        return ks[idx]
+
+    def _replay_round(self, req: Request, logits_rows) -> np.ndarray:
+        """Sampled-slot REPLAY reference row: the tokens plain ``spec_k=0`` decode
+        would draw at each verify position, using the request's own key schedule
+        (emission m consumes key m — the invariant that makes speculative sampled
+        output bitwise identical to the plain engine's)."""
+        keys = self._step_keys_window(req, len(req.tokens), self.spec_k + 1)
+        return np.asarray(_replay_draws(
+            logits_rows, keys, req.gen.temperature, req.gen.top_p, top_k=req.gen.top_k
+        ))
+
+    def _residual_round(self, req: Request, logits_rows, drafts):
+        """Sampled-slot Leviathan accept/reject (``spec_accept="residual"``): one
+        fused dispatch returns (emitted row, count). Lossless in DISTRIBUTION (each
+        emitted token is marginally the target's own sampling distribution), not
+        bitwise — emission m still consumes key m, but through accept/residual draws
+        instead of a direct categorical."""
+        keys = self._step_keys_window(req, len(req.tokens), self.spec_k + 1)
+        emitted, count = _spec_residual_jit(
+            logits_rows, jnp.asarray(drafts), keys,
+            req.gen.temperature, req.gen.top_p, top_k=req.gen.top_k,
+        )
+        return np.asarray(emitted), int(count)
 
     def run(self, report_throughput: bool = False):
         """Drain queue + active slots; returns finished requests (and tokens/s).
@@ -522,19 +732,31 @@ class ContinuousBatcher:
         """Pre-compile this engine's whole program surface into the AOT cache
         WITHOUT executing anything (``python -m accelerate_tpu warmup --serve``).
 
-        Covers: the decode step, one prefill per bucket that ``_plan_prefill``
-        can actually route a ``max_new_tokens``-budget request to, the
-        first-chunk + chunk-append pair (the fallback for prompts/budgets no
-        bucket fits — always part of the live surface), and the per-slot row
-        inserts. Returns warmup-manifest entries; empty when no enabled compile
-        cache is attached."""
+        Covers: the decode step (``spec_k == 0``) or the fused [B, spec_k+1]
+        speculative verify plus the draft source's own programs (``spec_k > 0`` —
+        draft AND verify ride the same bucket ladder and warmup manifest, so a
+        spec-enabled replica restart compiles nothing), one prefill per bucket
+        that ``_plan_prefill`` can actually route a ``max_new_tokens``-budget
+        request to, the first-chunk + chunk-append pair (the fallback for
+        prompts/budgets no bucket fits — always part of the live surface), and
+        the per-slot row inserts. Returns warmup-manifest entries; empty when no
+        enabled compile cache is attached."""
         if self.compile_cache is None:
             return []
         entries = []
         lanes = jnp.zeros((self.max_slots,), jnp.int32)
+        # The plain decode step is warmed in BOTH modes: a spec-enabled replica only
+        # dispatches the verify, but warming decode keeps the same cache directory
+        # serving a spec_k=0 restart (toggling speculation off must not cost compiles).
         entries.append(self._decode_fn.warm(
             self.params, self.cache, lanes, lanes, cfg=self.cfg
         ))
+        if self.spec_k:
+            seq = jnp.zeros((self.max_slots, self.spec_k + 1), jnp.int32)
+            entries.append(self._spec_verify_fn.warm(
+                self.params, self.cache, seq, lanes, cfg=self.cfg
+            ))
+            entries.extend(self.drafter.warm_programs(self, max_new_tokens))
         if self.prompt_buckets is not None and not self.prefix_cache_size:
             # Only buckets a request with this generation budget can land in —
             # a bucket with b + max_new > max_len is unreachable via _plan_prefill.
@@ -615,8 +837,15 @@ class ContinuousBatcher:
             # the inner loop per slot, and such requests are reported like any other.
             while self.slot_req[slot] is None and self.queue:
                 req = self.queue.popleft()
+                # ONE plan decision per admission, threaded to the engine prefill AND
+                # the drafter — the draft cache layout must mirror the engine row's,
+                # so the two must never derive it independently.
+                plan = (
+                    None if self.prefix_cache_size
+                    else self._plan_prefill(len(req.prompt), req.gen.max_new_tokens)
+                )
                 row_cache, greedy_dev, logits_dev, prefill_len = self._prefill(
-                    req.prompt, req.gen.max_new_tokens
+                    req.prompt, req.gen.max_new_tokens, plan
                 )
                 first = (
                     int(np.asarray(greedy_dev)[0])       # fused on-device argmax (4 bytes)
@@ -625,6 +854,10 @@ class ContinuousBatcher:
                 )
                 # graftlint: disable=recompile-hazard(slot indexes a compile-time cache row; at most max_slots variants, admission-time only)
                 self.cache = self._insert_row_fn(self.cache, row_cache, slot=slot, scan_layers=self.cfg.scan_layers)
+                if self.drafter is not None:
+                    # Same lane, same padded layout: the draft cache row must mirror
+                    # the engine row so engine positions index both.
+                    self.drafter.admit(slot, req.prompt, plan)
                 self.admitted += 1
                 self.slot_req[slot] = req
                 self.positions[slot] = prefill_len  # next write = first decode slot
@@ -640,19 +873,20 @@ class ContinuousBatcher:
                     self.evicted += 1  # finished AT admission still cycled the slot
         return finished
 
-    def _prefill(self, prompt: np.ndarray, max_new: int):
+    def _prefill(self, prompt: np.ndarray, max_new: int, plan=None):
         """Single-row prefill → (cache row, on-device greedy token [1], on-device
         logits row [1, V], decode start position).
 
-        Layout comes from ``_plan_prefill``: **bucketed** (one executable per
-        ladder rung — the prompt is left-padded to its bucket and prefilled in one
-        dispatch) or **chunked** (one bucket-width executable plus one shared
-        chunk-append executable — a 10-chunk prompt compiles nothing new). With
-        ``prefix_cache`` enabled, prompts sharing registered full-chunk prefixes
-        skip straight to the first uncached chunk."""
+        Layout comes from ``_plan_prefill`` (``plan`` passes a precomputed decision
+        so admission computes it once and hands the SAME one to the drafter):
+        **bucketed** (one executable per ladder rung — the prompt is left-padded to
+        its bucket and prefilled in one dispatch) or **chunked** (one bucket-width
+        executable plus one shared chunk-append executable — a 10-chunk prompt
+        compiles nothing new). With ``prefix_cache`` enabled, prompts sharing
+        registered full-chunk prefixes skip straight to the first uncached chunk."""
         if self.prefix_cache_size:
             return self._prefill_prefix_cached(prompt)
-        mode, total = self._plan_prefill(len(prompt), max_new)
+        mode, total = plan if plan is not None else self._plan_prefill(len(prompt), max_new)
         pad = total - len(prompt)
         row = np.zeros((1, total), np.int32)
         row[0, pad:] = prompt

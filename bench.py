@@ -806,6 +806,12 @@ def _run_serving_rows(preset: str | None) -> int:
         max_len=int(_os.environ.get("BENCH_SERVE_LEN", "128")),
         max_new=int(_os.environ.get("BENCH_SERVE_NEW", "16")),
         overload=float(_os.environ.get("BENCH_SERVE_OVERLOAD", "4.0")),
+        # Speculative rows: BENCH_SERVE_SPEC_K=3 re-runs every policy with batched
+        # speculative decoding (output-identical; rows stamp spec_accept_rate and
+        # tokens_per_step). Drafter: ngram (default) / half / oracle.
+        spec_k=int(_os.environ.get("BENCH_SERVE_SPEC_K", "0")),
+        spec_draft=_os.environ.get("BENCH_SERVE_DRAFTER", "ngram"),
+        workload=_os.environ.get("BENCH_SERVE_WORKLOAD", "mixed"),
     )
     for row in rows:
         print(json.dumps(row))

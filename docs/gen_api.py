@@ -38,6 +38,7 @@ MODULES = [
     ("accelerate_tpu.big_modeling", "Big-model inference"),
     ("accelerate_tpu.generation", "Generation"),
     ("accelerate_tpu.serving", "Serving engine"),
+    ("accelerate_tpu.spec_decode", "Speculative-decoding draft sources"),
     ("accelerate_tpu.serving_gateway.gateway", "Serving gateway"),
     ("accelerate_tpu.serving_gateway.policies", "Gateway scheduling policies"),
     ("accelerate_tpu.inference", "Pipeline inference"),

@@ -61,6 +61,12 @@ def test_default_enumeration_covers_the_warmup_surface(default_captures):
     assert "serving.decode" in labels
     assert any(l.startswith("serving.prefill") for l in labels), labels
     assert any("insert" in l for l in labels), labels
+    # The speculative surface (ISSUE 6): the fused [B, k+1] verify and the draft
+    # model's programs are lowered and inventoried like everything else — the
+    # clean-beyond-baseline gate above therefore covers them too.
+    assert "serving.spec_verify" in labels, labels
+    assert "serving.draft.decode" in labels, labels
+    assert "serving.draft.prefill" in labels, labels
     # Every capture actually lowered: the StableHLO text parses a @main.
     for c in default_captures:
         assert "@main" in c.hlo_text, c.label

@@ -73,6 +73,26 @@ def test_decompose_smoke():
     assert not errored, f"smoke rows failed: {errored}"
 
 
+def test_speculative_tpu_smoke_cli():
+    """Tier-1 (ISSUE 6 satellite, promoted from the slow tier): the speculative
+    cost-model bench runs end-to-end on the CPU smoke shape and emits its
+    mechanism row — plain s/token, per-round cost, and the breakeven acceptance
+    that makes speculation pay on the measured hardware."""
+    env = _smoke_env(BENCH_PRESET="smoke")
+    out = subprocess.run(
+        [sys.executable, "benchmarks/big_model_inference/speculative_tpu.py",
+         "--k", "3", "--new-tokens", "8", "--prompt-len", "8"],
+        capture_output=True, text=True, timeout=600, env=env, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stderr[-2000:]
+    row = json.loads(out.stdout.strip().splitlines()[-1])
+    assert row["smoke"] is True
+    assert row["plain_s_per_token"] > 0 and row["round_s"] > 0
+    assert row["rounds"] >= 1 and row["tokens"] >= 1
+    assert row["k"] == 3
+    assert "breakeven_accept" in row and "measured_accept" in row
+
+
 @slow
 def test_step_attrib_smoke():
     env = _smoke_env(BENCH_PRESET="smoke")

@@ -289,6 +289,76 @@ def test_serving_bucketed_matches_greedy_reference():
     assert engine.stats()["bucket_misses"] == 2  # 24-token prompt went chunked
 
 
+def test_spec_serving_compiles_once_and_second_run_zero():
+    """Spec-mode regression guard (ISSUE 6 satellite): a speculative engine
+    compiles one fused verify + one prefill per bucket + one insert per slot on
+    its first varied workload, and a second varied workload compiles ZERO new
+    programs — per-request k or proposal contents must never mint a new shape."""
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.serving import ContinuousBatcher
+
+    # Distinct geometry so no other serving test's executables are reused.
+    cfg = dataclasses.replace(
+        llama.CONFIGS["tiny"], dtype=jnp.float32, d_model=56, n_heads=2, n_kv_heads=2
+    )
+    params = llama.init_params(cfg)
+    buckets = (8, 16, 32)
+    engine = ContinuousBatcher(
+        params, cfg, max_slots=2, max_len=64, prompt_buckets=buckets, spec_k=2
+    )
+    rng = np.random.default_rng(1)
+    mon = CompileMonitor().start()
+    try:
+        for n in (3, 5, 9, 12, 20, 30):
+            engine.submit(rng.integers(1, cfg.vocab_size, n).astype(np.int32),
+                          max_new_tokens=3)
+        engine.run()
+        if not mon.supported:
+            pytest.skip("this jax exposes no jax.monitoring API")
+        first_workload = mon.count
+        for n in (2, 7, 11, 19, 28, 31):
+            engine.submit(rng.integers(1, cfg.vocab_size, n).astype(np.int32),
+                          max_new_tokens=5)
+        engine.run()
+    finally:
+        mon.stop()
+    bound = len(buckets) + 1 + engine.max_slots  # prefill/bucket + verify + inserts
+    assert first_workload <= bound, (first_workload, bound)
+    assert mon.count == first_workload, (
+        f"second spec workload recompiled {mon.count - first_workload} programs"
+    )
+    # Output still the plain engine's: every request equals standalone greedy.
+    assert engine.stats()["spec_k"] == 2
+
+
+def test_warmup_enumerates_spec_and_draft_programs(tmp_path):
+    """run_warmup(spec_k=2, spec_draft='half') lists the fused verify AND the
+    draft model's prefill/decode/insert programs in the manifest — a spec-enabled
+    replica restart consumes them instead of compiling (CompileMonitor-gated via
+    the zero-compile guard above; this asserts the manifest surface)."""
+    from accelerate_tpu.analysis.program import LowerOnlyCache
+    from accelerate_tpu.compile_cache.warmup import run_warmup
+
+    cache = LowerOnlyCache()
+    manifest = run_warmup(
+        cache=cache, manifest_path=str(tmp_path / "m.json"),
+        preset="smoke", batch_size=2, seq_len=16, train=False, eval_step=False,
+        serve=True, max_slots=2, max_len=128, max_new_tokens=4,
+        spec_k=2, spec_draft="half",
+    )
+    assert manifest["spec_k"] == 2 and manifest["spec_draft"] == "half"
+    labels = {e["label"] for e in manifest["programs"]}
+    assert "serving.spec_verify" in labels, labels
+    assert "serving.decode" in labels  # spec-off restarts stay warm too
+    assert {"serving.draft.decode", "serving.draft.prefill",
+            "serving.draft.prefill_chunk", "serving.draft.insert_row"} <= labels, labels
+    # spec_k without serve would warm nothing and stamp spec_k=0 — must be loud.
+    with pytest.raises(ValueError, match="serve"):
+        run_warmup(cache=LowerOnlyCache(), emit_manifest=False,
+                   preset="smoke", batch_size=2, seq_len=16, train=False,
+                   serve=False, spec_k=2)
+
+
 # ------------------------------------------------------------------ warmup manifest
 
 

@@ -386,6 +386,55 @@ def test_recompile_jit_in_loop_fires(tmp_path):
     assert all("inside a loop body" in f.message for f in hits)
 
 
+BAD_SPEC_JIT_PER_K = """
+    import jax
+    from functools import partial
+
+    def serve_speculative(engine, params, cfg):
+        # The obvious way to get batched speculative decoding wrong: build the
+        # verify jit inside the step loop — a fresh wrapper (and compile cache)
+        # per decode step.
+        while engine.has_work():
+            k = engine.spec_k
+            verify = partial(jax.jit, static_argnames=("cfg",))(
+                lambda p, c, t, pos, cfg: cfg
+            )
+            engine.cache = verify(params, engine.cache, engine.tokens,
+                                  engine.positions, cfg=cfg)
+"""
+
+GOOD_SPEC_JIT_MODULE_LEVEL = """
+    import jax
+    from functools import partial
+
+    @partial(jax.jit, static_argnames=("cfg",))
+    def _spec_verify_step(params, cache, tokens, positions, cfg):
+        # k lives in tokens.shape[1]: one executable per engine spec_k, hoisted
+        # to module scope — per-step dispatch reuses it.
+        return cache
+
+    def serve_speculative(engine, params, cfg):
+        while engine.has_work():
+            engine.cache = _spec_verify_step(params, engine.cache, engine.tokens,
+                                             engine.positions, cfg=cfg)
+"""
+
+
+def test_recompile_spec_verify_jit_per_step_fires(tmp_path):
+    """ISSUE 6 satellite: a per-k/per-step jit constructed in the speculative
+    step loop is the canonical way to lose the zero-compile contract — the
+    in-loop-construction check must catch the serve-shaped variant."""
+    hits = rule_hits(lint_snippet(tmp_path, BAD_SPEC_JIT_PER_K), "recompile-hazard")
+    assert len(hits) == 1, [f.message for f in hits]
+    assert "inside a loop body" in hits[0].message
+
+
+def test_recompile_spec_verify_module_level_clean(tmp_path):
+    assert not rule_hits(
+        lint_snippet(tmp_path, GOOD_SPEC_JIT_MODULE_LEVEL), "recompile-hazard"
+    )
+
+
 def test_recompile_jit_in_loop_clean(tmp_path):
     assert not rule_hits(lint_snippet(tmp_path, GOOD_JIT_IN_LOOP), "recompile-hazard")
 

@@ -587,3 +587,35 @@ def test_serve_bench_smoke_cli(capsys):
             assert "count" in row[block]
         if row["ttft"]["count"]:
             assert row["ttft"]["p95"] >= row["ttft"]["p50"] > 0
+        # Speculative columns ride every row (null/plain values when spec is off).
+        assert row["spec_k"] == 0 and row["spec_draft"] is None
+        assert row["spec_accept_rate"] is None
+        assert row["tokens_per_step"] is not None
+
+
+def test_serve_bench_spec_cli(capsys):
+    """`serve-bench --spec-k` (tier-1): speculative rows stamp acceptance rate and
+    tokens-per-step next to TTFT/TPOT, with identical admission accounting — the
+    2-3× TPOT claim lands in artifacts, not prose."""
+    import json
+
+    from accelerate_tpu.commands.accelerate_cli import main
+
+    assert main(["serve-bench", "--smoke", "--requests", "10", "--policy", "fifo",
+                 "--spec-k", "3", "--workload", "repeat"]) == 0
+    row = [json.loads(l) for l in capsys.readouterr().out.splitlines() if l][-1]
+    assert row["metric"] == "serve/fifo/spec3"
+    assert row["spec_k"] == 3 and row["spec_draft"] == "ngram"
+    assert row["workload"] == "repeat"
+    assert row["spec_accept_rate"] is not None and 0.0 <= row["spec_accept_rate"] <= 1.0
+    assert row["tokens_per_step"] >= 1.0
+    assert row["done"] == 10  # speculation changes cost, never admission/output
+
+    # The oracle ceiling row: acceptance 1.0 by construction, tokens/step well
+    # above the plain engine's slot count — the verify mechanism itself delivers.
+    capsys.readouterr()
+    assert main(["serve-bench", "--smoke", "--requests", "10", "--policy", "fifo",
+                 "--spec-k", "3", "--spec-draft", "oracle"]) == 0
+    row = [json.loads(l) for l in capsys.readouterr().out.splitlines() if l][-1]
+    assert row["spec_accept_rate"] == 1.0
+    assert row["tokens_per_step"] > row["max_slots"]

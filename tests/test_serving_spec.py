@@ -1,0 +1,403 @@
+"""Batched speculative serving: lossless parity with the plain engine (ISSUE 6).
+
+The contract under test: ``spec_k > 0`` NEVER changes emitted tokens — greedy and
+sampled (fixed PRNG, replay accept) decode are token-for-token identical to
+``spec_k = 0``, across staggered admission, mid-stream eviction/cancel, same-step
+lane reuse, EOS mid-round, and budget boundaries. The draft source only changes how
+many target forwards a sequence costs (``tokens_per_step``/``spec_accept_rate``).
+
+Parity fixtures are f32 (the bf16-rope greedy-tie lesson, CHANGES PR 4: exactness
+contracts don't survive bf16 rounding noise).
+"""
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from accelerate_tpu.generation import GenerationConfig
+from accelerate_tpu.models import gpt, llama
+from accelerate_tpu.serving import ContinuousBatcher
+from accelerate_tpu.spec_decode import ModelDrafter, NgramDrafter
+
+CFG = dataclasses.replace(llama.CONFIGS["tiny"], dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = llama.init_params(CFG)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, CFG.vocab_size, int(n)).astype(np.int32)
+               for n in (5, 9, 3, 7, 6, 4)]
+    return params, prompts
+
+
+def reference_greedy(params, prompt, n):
+    gen = GenerationConfig(max_new_tokens=n, temperature=0.0)
+    return np.asarray(llama.generate(params, prompt[None], CFG, gen))[0].tolist()
+
+
+def test_spec_greedy_staggered_matches_plain(setup):
+    """More requests than slots, ngram drafter, varied budgets: every output equals
+    the standalone greedy decode — the spec_k=0 parity contract verbatim."""
+    params, prompts = setup
+    engine = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                               prompt_bucket=16, spec_k=3)
+    n_new = [6, 4, 8, 3, 5, 7]
+    reqs = [engine.submit(p, max_new_tokens=n) for p, n in zip(prompts, n_new)]
+    done = engine.run()
+    assert len(done) == len(reqs)
+    for req, prompt, n in zip(reqs, prompts, n_new):
+        assert req.done
+        assert len(req.tokens) == n
+        assert req.tokens == reference_greedy(params, prompt, n), req.uid
+    stats = engine.stats()
+    assert stats["decode_steps"] > 0
+    assert stats["spec_proposed"] > 0  # proposals flowed through the verify
+    assert stats["tokens_per_step"] is not None
+
+
+def test_spec_sampled_replay_matches_plain(setup):
+    """Sampled slots (fixed PRNG, default replay accept) emit BITWISE the plain
+    engine's tokens: the verify replays the same sampling_core with the same
+    per-emission key schedule."""
+    params, prompts = setup
+    gen = GenerationConfig(max_new_tokens=6, temperature=0.8, top_k=12)
+    rngs = [jax.random.PRNGKey(s) for s in (11, 22)]
+    engine = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                               prompt_bucket=16, spec_k=2)
+    reqs = [engine.submit(p, gen=gen, rng=r) for p, r in zip(prompts[:2], rngs)]
+    engine.run()
+    for req, prompt, rng in zip(reqs, prompts[:2], rngs):
+        pad = 16 - len(prompt)
+        padded = np.zeros((1, 16), np.int32); padded[0, pad:] = prompt
+        pmask = np.zeros((1, 16), bool); pmask[0, pad:] = True
+        want = np.asarray(llama.generate(
+            params, jnp.asarray(padded), CFG, gen,
+            rng=rng, prompt_mask=jnp.asarray(pmask),
+        ))[0].tolist()
+        assert req.tokens == want, (req.tokens, want)
+
+
+def test_spec_sampled_top_p_matches_plain(setup):
+    """top_p < 1 exercises the nucleus filter through the replay path."""
+    params, prompts = setup
+    gen = GenerationConfig(max_new_tokens=5, temperature=0.7, top_p=0.8)
+    rng = jax.random.PRNGKey(77)
+    engine = ContinuousBatcher(params, CFG, max_slots=1, max_len=64,
+                               prompt_bucket=16, spec_k=3)
+    req = engine.submit(prompts[0], gen=gen, rng=rng)
+    engine.run()
+    pad = 16 - len(prompts[0])
+    padded = np.zeros((1, 16), np.int32); padded[0, pad:] = prompts[0]
+    pmask = np.zeros((1, 16), bool); pmask[0, pad:] = True
+    want = np.asarray(llama.generate(
+        params, jnp.asarray(padded), CFG, gen, rng=rng,
+        prompt_mask=jnp.asarray(pmask),
+    ))[0].tolist()
+    assert req.tokens == want
+
+
+def test_spec_mixed_greedy_and_sampled_lanes(setup):
+    """Greedy and sampled requests share one verify dispatch; each lane's
+    acceptance path is independent and both keep parity."""
+    params, prompts = setup
+    gen = GenerationConfig(max_new_tokens=6, temperature=0.9, top_k=8)
+    key = jax.random.PRNGKey(5)
+    engine = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                               prompt_bucket=16, spec_k=2)
+    r_greedy = engine.submit(prompts[0], max_new_tokens=7)
+    r_sampled = engine.submit(prompts[1], gen=gen, rng=key)
+    engine.run()
+    assert r_greedy.tokens == reference_greedy(params, prompts[0], 7)
+    pad = 16 - len(prompts[1])
+    padded = np.zeros((1, 16), np.int32); padded[0, pad:] = prompts[1]
+    pmask = np.zeros((1, 16), bool); pmask[0, pad:] = True
+    want = np.asarray(llama.generate(
+        params, jnp.asarray(padded), CFG, gen, rng=key,
+        prompt_mask=jnp.asarray(pmask),
+    ))[0].tolist()
+    assert r_sampled.tokens == want
+
+
+def test_spec_perfect_model_drafter_accepts_everything(setup):
+    """A draft model with the TARGET's own weights proposes exactly the target's
+    greedy continuation: acceptance rate 1.0 and tokens_per_step == lanes × (k+1)
+    at full occupancy — the mechanism's measured ceiling."""
+    params, prompts = setup
+    engine = ContinuousBatcher(params, CFG, max_slots=1, max_len=64,
+                               prompt_bucket=16, spec_k=3,
+                               drafter=ModelDrafter(params, CFG))
+    req = engine.submit(prompts[0], max_new_tokens=9)
+    engine.run()
+    assert req.tokens == reference_greedy(params, prompts[0], 9)
+    stats = engine.stats()
+    assert stats["spec_accept_rate"] == 1.0
+    # 9 tokens: 1 at prefill + 8 from decode; k+1 = 4 per step → 2 steps.
+    assert stats["decode_steps"] == 2
+    assert stats["tokens_per_step"] == 4.0
+
+
+def test_spec_cross_family_gpt_draft(setup):
+    """A gpt-family draft drives a llama-family target (shared cached-decode
+    contract, matching vocabularies) — parity holds regardless of what the draft
+    proposes."""
+    params, prompts = setup
+    d_cfg = dataclasses.replace(
+        gpt.CONFIGS["tiny"], dtype=jnp.float32, vocab_size=CFG.vocab_size,
+        n_layers=1, attn_impl="xla",
+    )
+    d_params = gpt.init_params(d_cfg)
+    engine = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                               prompt_bucket=16, spec_k=2,
+                               drafter=ModelDrafter(d_params, d_cfg))
+    reqs = [engine.submit(p, max_new_tokens=7) for p in prompts[:3]]
+    engine.run()
+    for req, prompt in zip(reqs, prompts[:3]):
+        assert req.tokens == reference_greedy(params, prompt, 7)
+
+
+def test_spec_model_drafter_chunked_prefill(setup):
+    """A prompt on the chunked-prefill path (overflows the bucket) also mirrors
+    its layout into the draft cache."""
+    params, _ = setup
+    rng = np.random.default_rng(42)
+    prompt = rng.integers(1, CFG.vocab_size, 20).astype(np.int32)  # 2.5 chunks of 8
+    engine = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                               prompt_bucket=8, spec_k=2,
+                               drafter=ModelDrafter(params, CFG))
+    req = engine.submit(prompt, max_new_tokens=6)
+    engine.run()
+    assert req.tokens == reference_greedy(params, prompt, 6)
+
+
+def test_spec_eos_mid_round_truncates(setup):
+    """An EOS inside an accepted prefix ends the request AT the EOS — tokens after
+    it in the verified round are discarded, exactly like plain decode."""
+    params, prompts = setup
+    ref = reference_greedy(params, prompts[2], 4)
+    engine = ContinuousBatcher(params, CFG, max_slots=1, max_len=64,
+                               prompt_bucket=16, spec_k=3,
+                               drafter=ModelDrafter(params, CFG))
+    req = engine.submit(prompts[2], max_new_tokens=10, eos_token_id=ref[3])
+    r_next = engine.submit(prompts[3], max_new_tokens=4)
+    done = engine.run()
+    assert req.done and req.tokens == ref  # stopped at the EOS, mid-round
+    # Same-step lane reuse: the freed lane admitted and finished the next request.
+    assert r_next.done and r_next.tokens == reference_greedy(params, prompts[3], 4)
+    assert len(done) == 2
+
+
+def test_spec_budget_never_overruns(setup):
+    """Acceptance is capped by max_new_tokens even when the verify accepted more —
+    a full-acceptance round at the budget boundary must not overshoot."""
+    params, prompts = setup
+    for budget in (2, 3, 5):
+        engine = ContinuousBatcher(params, CFG, max_slots=1, max_len=64,
+                                   prompt_bucket=16, spec_k=4,
+                                   drafter=ModelDrafter(params, CFG))
+        req = engine.submit(prompts[1], max_new_tokens=budget)
+        engine.run()
+        assert len(req.tokens) == budget
+        assert req.tokens == reference_greedy(params, prompts[1], budget)
+
+
+def test_spec_cancel_and_evict_mid_stream(setup):
+    """cancel() of queued and in-flight requests under spec decode: freed lanes
+    readmit, partial tokens stay a correct prefix, and later requests keep parity."""
+    params, prompts = setup
+    engine = ContinuousBatcher(params, CFG, max_slots=1, max_len=64,
+                               prompt_bucket=16, spec_k=2)
+    r0 = engine.submit(prompts[0], max_new_tokens=8)
+    r1 = engine.submit(prompts[1], max_new_tokens=4)
+    engine.step()  # r0 in flight, r1 queued
+    assert engine.cancel(r1.uid)
+    engine.step()
+    partial = list(r0.tokens)
+    assert engine.cancel(r0.uid)  # in-flight: lane freed, partial prefix kept
+    assert not r0.done and r0.tokens == partial
+    assert partial == reference_greedy(params, prompts[0], 8)[:len(partial)]
+    r2 = engine.submit(prompts[2], max_new_tokens=5)
+    engine.run()
+    assert r2.tokens == reference_greedy(params, prompts[2], 5)
+    assert engine.stats()["evicted_external"] == 1
+
+
+def test_spec_with_prefix_cache(setup):
+    """The ngram drafter composes with prefix-cached engines (right-aligned
+    layout): shared-prefix prompts still reuse snapshots and keep parity."""
+    params, _ = setup
+    rng = np.random.default_rng(7)
+    system = rng.integers(1, CFG.vocab_size, 16).astype(np.int32)
+    suffix = rng.integers(1, CFG.vocab_size, 5).astype(np.int32)
+    engine = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                               prompt_bucket=8, prefix_cache=4, spec_k=2)
+    pa = np.concatenate([system, suffix])
+    ra = engine.submit(pa, max_new_tokens=5)
+    engine.run()
+    assert ra.tokens == reference_greedy(params, pa, 5)
+    rb = engine.submit(system, max_new_tokens=5)
+    engine.run()
+    assert engine.prefix_hits >= 1
+    assert rb.tokens == reference_greedy(params, system, 5)
+
+
+def test_spec_on_token_streaming_order(setup):
+    """on_token fires once per token in exact generation order even when a round
+    emits several tokens at once."""
+    params, prompts = setup
+    engine = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                               prompt_bucket=16, spec_k=3,
+                               drafter=ModelDrafter(params, CFG))
+    streamed = {}
+    reqs = []
+    for i, p in enumerate(prompts[:3]):
+        streamed[i] = []
+        reqs.append(engine.submit(p, max_new_tokens=6, on_token=streamed[i].append))
+    engine.run()
+    for i, (req, p) in enumerate(zip(reqs, prompts[:3])):
+        assert streamed[i] == req.tokens == reference_greedy(params, p, 6)
+
+
+def test_spec_residual_mode_runs_and_is_deterministic_per_key(setup):
+    """Residual (Leviathan) accept: runs end-to-end, emits exactly the budget, and
+    is deterministic for a fixed key (distribution-losslessness itself is asserted
+    on speculative_accept_batch in test_generation.py)."""
+    params, prompts = setup
+    gen = GenerationConfig(max_new_tokens=6, temperature=0.8, top_k=12)
+
+    def run_once():
+        engine = ContinuousBatcher(params, CFG, max_slots=1, max_len=64,
+                                   prompt_bucket=16, spec_k=2,
+                                   spec_accept="residual")
+        req = engine.submit(prompts[0], gen=gen, rng=jax.random.PRNGKey(3))
+        engine.run()
+        return req.tokens
+
+    a, b = run_once(), run_once()
+    assert a == b and len(a) == 6
+
+
+def test_spec_telemetry_record(setup, tmp_path):
+    """Spec steps emit accelerate_tpu.telemetry.serving.spec/v1 with proposed /
+    accepted counters and the acceptance rate."""
+    import json
+
+    from accelerate_tpu.telemetry import Telemetry
+    from accelerate_tpu.utils.dataclasses import TelemetryConfig
+
+    params, prompts = setup
+    tel = Telemetry(TelemetryConfig(enabled=True, jsonl_dir=str(tmp_path)))
+    engine = ContinuousBatcher(params, CFG, max_slots=1, max_len=64,
+                               prompt_bucket=16, spec_k=2, telemetry=tel)
+    engine.submit(prompts[0], max_new_tokens=5)
+    engine.run()
+    tel.close()
+    records = []
+    for f in tmp_path.glob("*.jsonl"):
+        with open(f) as fh:
+            records += [json.loads(line) for line in fh if line.strip()]
+    spec = [r for r in records
+            if r.get("schema") == "accelerate_tpu.telemetry.serving.spec/v1"]
+    assert spec, "no serving.spec/v1 records emitted"
+    for r in spec:
+        assert r["spec_k"] == 2
+        assert r["step_proposed"] >= r["step_accepted"] >= 0
+        assert r["proposed_total"] >= r["accepted_total"]
+        assert "spec_accept_rate" in r and "tokens_per_step" in r
+    # The regular serving record now carries the throughput counters too.
+    serving = [r for r in records
+               if r.get("schema") == "accelerate_tpu.telemetry.serving/v1"]
+    assert serving and all("tokens_per_step" in r for r in serving)
+
+
+def test_spec_stats_counters(setup):
+    """stats() gains tokens_per_step and spec_accept_rate; both None/0 before any
+    decode, populated after."""
+    params, prompts = setup
+    engine = ContinuousBatcher(params, CFG, max_slots=1, max_len=64,
+                               prompt_bucket=16, spec_k=2)
+    s0 = engine.stats()
+    assert s0["tokens_per_step"] is None and s0["spec_accept_rate"] is None
+    assert s0["spec_k"] == 2
+    engine.submit(prompts[0], max_new_tokens=5)
+    engine.run()
+    s1 = engine.stats()
+    assert s1["decode_steps"] >= 1
+    assert s1["tokens_per_step"] >= 1.0
+    assert s1["spec_proposed"] == 2 * s1["decode_steps"]  # one lane active
+    assert s1["spec_accept_rate"] is not None
+
+
+def test_spec_plain_engine_counters_too(setup):
+    """spec_k=0 engines also report decode throughput (tokens_per_step <= lanes) —
+    the serve-bench comparison baseline comes from the same counters."""
+    params, prompts = setup
+    engine = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                               prompt_bucket=16)
+    reqs = [engine.submit(p, max_new_tokens=4) for p in prompts[:2]]
+    engine.run()
+    s = engine.stats()
+    assert s["spec_k"] == 0 and s["spec_proposed"] == 0
+    assert s["spec_accept_rate"] is None
+    assert 0 < s["tokens_per_step"] <= 2.0
+    assert all(r.done for r in reqs)
+
+
+def test_spec_validation_errors(setup):
+    params, prompts = setup
+    with pytest.raises(ValueError, match="spec_k=-1"):
+        ContinuousBatcher(params, CFG, max_slots=1, max_len=64, spec_k=-1)
+    with pytest.raises(TypeError, match="spec_k must be an int"):
+        ContinuousBatcher(params, CFG, max_slots=1, max_len=64, spec_k=2.5)
+    with pytest.raises(ValueError, match="spec_accept"):
+        ContinuousBatcher(params, CFG, max_slots=1, max_len=64, spec_k=2,
+                          spec_accept="bogus")
+    with pytest.raises(ValueError, match="silently ignored"):
+        ContinuousBatcher(params, CFG, max_slots=1, max_len=64,
+                          drafter=NgramDrafter())  # drafter without spec_k
+    bad_vocab = dataclasses.replace(CFG, vocab_size=CFG.vocab_size * 2)
+    with pytest.raises(ValueError, match="vocab"):
+        ContinuousBatcher(params, CFG, max_slots=1, max_len=64, spec_k=2,
+                          drafter=ModelDrafter(llama.init_params(bad_vocab),
+                                               bad_vocab))
+    with pytest.raises(ValueError, match="prefix"):
+        ContinuousBatcher(params, CFG, max_slots=1, max_len=64, prefix_cache=2,
+                          spec_k=2, drafter=ModelDrafter(params, CFG))
+
+
+def test_ngram_drafter_lookup():
+    """Prompt-lookup proposals: longest suffix n-gram, latest occurrence, with the
+    repeat-last fallback when nothing matches."""
+    d = NgramDrafter(max_ngram=3)
+    ctx = np.asarray([1, 2, 3, 9, 1, 2, 3], np.int32)
+    # suffix [1,2,3] matches at 0 → continuation 9, then re-search extends.
+    assert d._propose_one(ctx, 1).tolist() == [9]
+    assert d._propose_one(ctx, 4).tolist() == [9, 1, 2, 3]
+    # no match anywhere: repeat the last token
+    flat = np.asarray([4, 5, 6], np.int32)
+    assert d._propose_one(flat, 3).tolist() == [6, 6, 6]
+    # latest occurrence wins over earlier ones
+    ctx2 = np.asarray([7, 8, 7, 9, 7], np.int32)
+    assert d._propose_one(ctx2, 1).tolist() == [9]  # 7 at idx 2 is latest with continuation
+    with pytest.raises(ValueError):
+        NgramDrafter(max_ngram=0)
+
+
+def test_spec_moe_dense_routing(setup):
+    """MoE configs verify through the DENSE decode routing — parity against the
+    engine's own spec_k=0 output (both use dense per-token routing at decode)."""
+    _, prompts = setup
+    moe_cfg = dataclasses.replace(llama.CONFIGS["moe-tiny"], dtype=jnp.float32)
+    moe_params = llama.init_params(moe_cfg)
+
+    def run(spec_k):
+        eng = ContinuousBatcher(moe_params, moe_cfg, max_slots=2, max_len=48,
+                                prompt_bucket=8, spec_k=spec_k)
+        reqs = [eng.submit(p[:6], max_new_tokens=4) for p in prompts[:2]]
+        eng.run()
+        return [r.tokens for r in reqs]
+
+    assert run(2) == run(0)
