@@ -19,7 +19,8 @@ from ...utils.dataclasses import CompileCacheConfig
 from ...compile_cache.cache import AotCache
 from .capture import ProgramCapture
 
-__all__ = ["LowerOnlyCache", "capture_default_programs", "DEFAULT_AUDIT_GEOMETRY"]
+__all__ = ["LowerOnlyCache", "capture_default_programs", "DEFAULT_AUDIT_GEOMETRY",
+           "PAGED_AUDIT_GEOMETRY"]
 
 #: The geometry ``audit`` lowers when none is given: the warmup CLI's default
 #: config with eval and serving enabled — including the speculative-decoding
@@ -36,6 +37,26 @@ DEFAULT_AUDIT_GEOMETRY = dict(
     max_new_tokens=32,
     spec_k=2,
     spec_draft="half",
+)
+
+#: Second serving-only pass over the PAGED KV surface (block-table decode/verify,
+#: dynamic-slot page scatter, prefix gather + partial-page copy): the dense and
+#: paged engines are alternative replica layouts, so the default audit lowers BOTH
+#: — one ``run_warmup`` per layout, captures concatenated. ``page_size`` is chosen
+#: to not divide the prompt bucket (64), keeping the COW copy program reachable.
+PAGED_AUDIT_GEOMETRY = dict(
+    preset="smoke",
+    batch_size=8,
+    seq_len=128,
+    train=False,
+    eval_step=False,
+    serve=True,
+    max_slots=4,
+    max_new_tokens=32,
+    spec_k=2,
+    spec_draft="ngram",
+    page_size=24,
+    prefix_cache=2,
 )
 
 
@@ -77,10 +98,22 @@ def capture_default_programs(**overrides) -> List[ProgramCapture]:
     Runs the REAL enumerator — Accelerator construction, mesh placement, model
     init — but stops at lowering, so the whole sweep is tracing-bound (seconds
     on CPU, no TPU needed).
+
+    Whenever the geometry serves (and no explicit ``page_size`` pins the layout),
+    a second serving-only pass lowers the paged-KV surface
+    (:data:`PAGED_AUDIT_GEOMETRY`, inheriting preset/shape overrides) into the
+    same capture list — the dense and paged engines are alternative replica
+    layouts, and BOTH stay under the ratchet.
     """
     from ...compile_cache.warmup import run_warmup
 
     geometry = {**DEFAULT_AUDIT_GEOMETRY, **overrides}
     cache = LowerOnlyCache()
     run_warmup(cache=cache, emit_manifest=False, **geometry)
+    if geometry.get("serve") and "page_size" not in overrides:
+        inherit = {k: v for k, v in overrides.items()
+                   if k in ("preset", "batch_size", "seq_len", "max_slots",
+                            "max_len", "max_new_tokens")}
+        run_warmup(cache=cache, emit_manifest=False,
+                   **{**PAGED_AUDIT_GEOMETRY, **inherit})
     return cache.capture

@@ -81,6 +81,20 @@ def serve_bench_command_parser(subparsers=None) -> argparse.ArgumentParser:
                              "extraction/echo-shaped traffic prompt-lookup drafting "
                              "is for). Applies with or without --spec-k, so "
                              "spec/non-spec rows stay apples-to-apples")
+    parser.add_argument("--page-size", type=int, default=0,
+                        help="paged KV cache page size (tokens per page; 0 = dense "
+                             "layout). Every policy row then stamps page-pool "
+                             "occupancy and kv_bytes_per_request")
+    parser.add_argument("--kv-pages", type=int, default=None,
+                        help="page-pool size for --page-size (default: dense-"
+                             "equivalent capacity)")
+    parser.add_argument("--paged-compare", default=None, metavar="OUT_JSON",
+                        help="instead of policy rows, run the fixed-KV-budget "
+                             "dense-vs-paged comparison and write the artifact "
+                             "(BENCH_PAGED.json) to this path. Uses compare-tuned "
+                             "geometry (256-token rows, 16 paged lanes) unless "
+                             "--max-len/--max-slots are explicitly set; --kv-pages "
+                             "is always derived from the byte budget")
     parser.add_argument("--smoke", action="store_true",
                         help="tiny fast shape (CI tier-1): 20 requests, 2 slots, "
                              "8-token budget")
@@ -161,6 +175,8 @@ def run_serve_bench(
     spec_k: int = 0,
     spec_draft: str = "ngram",
     workload: str = "mixed",
+    page_size: int = 0,
+    kv_pages=None,
     telemetry=None,
 ) -> list:
     """Run the burst once per policy; returns one SLO row dict per policy.
@@ -214,6 +230,7 @@ def run_serve_bench(
         return ContinuousBatcher(
             params, cfg, max_slots=max_slots, max_len=max_len,
             prompt_bucket=prompt_bucket, spec_k=spec_k, drafter=drafter,
+            page_size=page_size, kv_pages=kv_pages,
         )
 
     # Warm every program variant (prefill, decode/verify, each slot's row insert)
@@ -287,12 +304,233 @@ def run_serve_bench(
             "ttft_high": latency_summary([r.ttft_s for r in high_done]),
             "tpot": summary["tpot_s"],
             "queue_wait": summary["queue_wait_s"],
+            **_kv_columns(gw.engine, estats),
         })
     return rows
 
 
+def _paged_bytes_per_request(estats: dict) -> int:
+    """Measured KV bytes one request charged the page pool (pages actually
+    allocated, averaged over admissions) — the ONE definition behind both the
+    policy-row columns and the paged-compare artifact."""
+    return round(
+        estats["kv_alloc_count"] * estats["kv_page_bytes"]
+        / max(1, estats["admitted"])
+    )
+
+
+def _kv_columns(engine, estats: dict) -> dict:
+    """Per-row KV-memory columns: peak concurrency actually reached at this KV
+    budget and the measured bytes one request charged the cache — the dense row
+    cost (max_len × per-token bytes, occupancy-independent) vs the paged
+    pages-actually-allocated cost. Byte sums come from ``engine.cache_bytes()``
+    — the engine's own accounting — so bench columns can never drift from
+    ``stats()``'s kv_bytes columns."""
+    if estats["paged"]:
+        return {
+            "page_size": estats["page_size"],
+            "kv_pages": estats["pages_total"],
+            "kv_bytes_total": estats["kv_bytes_total"],
+            "kv_bytes_per_request": _paged_bytes_per_request(estats),
+            "max_concurrent_at_fixed_mem": estats["peak_active_slots"],
+            "kv_defer_count": estats["kv_defer_count"],
+            "kv_shared_pages": estats["kv_shared_pages"],
+        }
+    cache_bytes = engine.cache_bytes()
+    return {
+        "page_size": 0,
+        "kv_pages": None,
+        "kv_bytes_total": cache_bytes,
+        "kv_bytes_per_request": cache_bytes // engine.max_slots,
+        "max_concurrent_at_fixed_mem": estats["peak_active_slots"],
+        "kv_defer_count": 0,
+        "kv_shared_pages": 0,
+    }
+
+
+def run_paged_compare(
+    preset: str = "smoke",
+    max_len: int = 256,
+    prompt_bucket: int = 16,
+    max_new: int = 16,
+    requests: int = 48,
+    budget_rows: int = 2,
+    page_size: int = 16,
+    max_slots: int = 16,
+    prefix_cache: int = 4,
+    seed: int = 0,
+) -> dict:
+    """Dense vs paged at a FIXED KV byte budget: the acceptance artifact
+    (BENCH_PAGED.json).
+
+    The budget is ``budget_rows`` dense cache rows. The dense engine can field
+    exactly that many lanes (each lane owns a full ``max_len`` row, occupancy be
+    damned); the paged engine gets the SAME bytes as a page pool (per-token bytes
+    are identical, so ``kv_pages = budget_rows × max_len / page_size``) and
+    ``max_slots`` lanes — concurrency then ends where the workload's ACTUAL
+    sequence lengths exhaust the pool, not where padded maxima would. Both engines
+    replay the same short-request burst (prompt ≤ one bucket + ``max_new`` budget —
+    chat-shaped traffic) and a prefix-heavy burst (shared system prompt, prefix
+    cache on), measuring peak concurrency, decode throughput at high occupancy,
+    per-request KV bytes, and the prefix registry's memory cost (whole row-cache
+    snapshots vs refcounted page lists)."""
+    import time
+
+    import numpy as np
+
+    from ..compile_cache.warmup import build_model_config
+    from ..models import llama
+    from ..serving import ContinuousBatcher
+
+    if page_size < 1:
+        raise ValueError(f"page_size={page_size} must be >= 1")
+    if page_size > max_len:
+        raise ValueError(f"page_size={page_size} must be <= max_len={max_len}")
+    cfg = build_model_config(preset, max_len)
+    params = llama.init_params(cfg)
+    rng = np.random.default_rng(seed)
+    # Per-token KV bytes are identical in both layouts, so the paged pool that
+    # fits the dense budget is budget_rows × max_len tokens' worth of pages —
+    # FLOORED when page_size doesn't divide max_len (the paged side never gets
+    # more bytes than the dense budget; the comparison can only understate it).
+    kv_pages = budget_rows * max_len // page_size
+
+    prompts = [
+        rng.integers(1, cfg.vocab_size, int(n)).astype(np.int32)
+        for n in rng.integers(3, prompt_bucket + 1, requests)
+    ]
+    sys_prompt = rng.integers(1, cfg.vocab_size, 2 * prompt_bucket).astype(np.int32)
+    prefix_prompts = [
+        np.concatenate([sys_prompt,
+                        rng.integers(1, cfg.vocab_size, int(n)).astype(np.int32)])
+        for n in rng.integers(3, prompt_bucket + 1, requests // 2)
+    ]
+
+    def build(paged: bool, prefix: int = 0):
+        return ContinuousBatcher(
+            params, cfg,
+            max_slots=max_slots if paged else budget_rows,
+            max_len=max_len, prompt_bucket=prompt_bucket,
+            page_size=page_size if paged else 0,
+            kv_pages=kv_pages if paged else None,
+            prefix_cache=prefix,
+        )
+
+    def replay(engine, workload):
+        """Drain ``workload`` → (wall_s, total tokens, decode-only wall_s,
+        decode-only tokens). The decode-only pair accumulates ONLY steps that
+        admitted nothing — pure decode dispatches at the prevailing occupancy —
+        so `decode_tokens_per_sec` is not polluted by prefill FLOPs or
+        admission-path host work (which the two layouts amortize over very
+        different lane counts)."""
+        for p in workload:
+            engine.submit(p, max_new_tokens=max_new)
+        t0 = time.perf_counter()
+        decode_wall = 0.0
+        decode_tokens = 0
+        while engine.queue or any(r is not None for r in engine.slot_req):
+            admitted_before = engine.admitted
+            tokens_before = engine.decode_tokens
+            s0 = time.perf_counter()
+            engine.step()
+            s1 = time.perf_counter()
+            emitted = engine.decode_tokens - tokens_before
+            if engine.admitted == admitted_before and emitted:
+                decode_wall += s1 - s0
+                decode_tokens += emitted
+        wall = time.perf_counter() - t0
+        tokens = engine.decode_tokens + engine.admitted  # +1 prefill token each
+        return wall, tokens, decode_wall, decode_tokens
+
+    # Warm both program surfaces so neither timed replay pays XLA compiles.
+    for paged in (False, True):
+        w = build(paged)
+        w.submit(prompts[0], max_new_tokens=2)
+        w.run()
+
+    rows = []
+    for paged in (False, True):
+        eng = build(paged)
+        budget_bytes = eng.cache_bytes()
+        wall, tokens, decode_wall, decode_tokens = replay(eng, prompts)
+        s = eng.stats()
+        # Prefix-memory pass: same budget, shared system prompt, registry on.
+        peng = build(paged, prefix=prefix_cache)
+        replay(peng, prefix_prompts)
+        ps_ = peng.stats()
+        if paged:
+            prefix_bytes = ps_["kv_bytes_in_use"]  # drained: only registry pages remain
+            per_request = _paged_bytes_per_request(s)
+        else:
+            row_bytes = budget_bytes // eng.max_slots
+            prefix_bytes = ps_["prefix_entries"] * row_bytes
+            per_request = row_bytes
+        rows.append({
+            "layout": "paged" if paged else "dense",
+            "kv_budget_bytes": budget_bytes,
+            "page_size": page_size if paged else 0,
+            "kv_pages": kv_pages if paged else None,
+            "max_slots": eng.max_slots,
+            "requests": requests,
+            "max_new": max_new,
+            "max_concurrent_at_fixed_mem": s["peak_active_slots"],
+            "tokens_per_sec": round(tokens / wall, 1) if wall > 0 else None,
+            "decode_tokens_per_sec": round(decode_tokens / decode_wall, 1)
+            if decode_wall > 0 else None,
+            "tokens_per_step": s["tokens_per_step"],
+            "kv_bytes_per_request": per_request,
+            "kv_defer_count": s.get("kv_defer_count", 0),
+            "prefix_hit_memory_bytes": prefix_bytes,
+            "prefix_entries": ps_["prefix_entries"],
+            "prefix_hits": ps_["prefix_hits"],
+            "kv_shared_pages": ps_.get("kv_shared_pages", 0),
+        })
+    dense_row, paged_row = rows
+    return {
+        "schema": "accelerate_tpu.bench.paged/v1",
+        "preset": preset,
+        "kv_budget_bytes": dense_row["kv_budget_bytes"],
+        "rows": rows,
+        "concurrency_ratio": round(
+            paged_row["max_concurrent_at_fixed_mem"]
+            / max(1, dense_row["max_concurrent_at_fixed_mem"]), 2
+        ),
+        "prefix_memory_ratio": round(
+            dense_row["prefix_hit_memory_bytes"]
+            / max(1, paged_row["prefix_hit_memory_bytes"]), 2
+        ),
+    }
+
+
 def serve_bench_command(args) -> int:
     import json
+
+    if args.paged_compare:
+        # Compare-tuned geometry defaults (256-len rows, 16 lanes) unless the
+        # user explicitly moved a shared flag off its parser default — the
+        # policy-row defaults are tuned for the overload replay, not for the
+        # fixed-budget memory comparison. --kv-pages stays derived from the
+        # budget (honoring it would break the fixed-budget semantics).
+        parser_defaults = serve_bench_command_parser()
+        compare_kw = dict(
+            preset=args.preset,
+            prompt_bucket=args.prompt_bucket,
+            max_new=args.max_new,
+            requests=args.requests,
+            page_size=args.page_size or 16,
+            seed=args.seed,
+        )
+        if args.max_len != parser_defaults.get_default("max_len"):
+            compare_kw["max_len"] = args.max_len
+        if args.max_slots != parser_defaults.get_default("max_slots"):
+            compare_kw["max_slots"] = args.max_slots
+        artifact = run_paged_compare(**compare_kw)
+        with open(args.paged_compare, "w") as f:
+            json.dump(artifact, f, indent=2)
+        print(json.dumps({k: artifact[k] for k in
+                          ("schema", "kv_budget_bytes", "concurrency_ratio",
+                           "prefix_memory_ratio")}))
+        return 0
 
     if args.smoke:
         # CI tier-1 shape: small enough for the CPU simulator, still overloaded
@@ -320,6 +558,8 @@ def serve_bench_command(args) -> int:
         spec_k=args.spec_k,
         spec_draft=args.spec_draft,
         workload=args.workload,
+        page_size=args.page_size,
+        kv_pages=args.kv_pages,
     )
     for row in rows:
         print(json.dumps(row))

@@ -55,6 +55,18 @@ def warmup_command_parser(subparsers=None) -> argparse.ArgumentParser:
                         help="draft source for the speculative surface: 'ngram' "
                              "(model-free, default) or 'half' (half-depth draft model "
                              "— also warms its prefill/decode/insert programs)")
+    parser.add_argument("--page-size", type=int, default=0,
+                        help="paged KV cache page size (tokens per page); > 0 warms "
+                             "the paged serving surface — block-table decode/verify, "
+                             "page scatter, prefix gather/copy — and stamps the page "
+                             "geometry into the manifest (0 = dense layout)")
+    parser.add_argument("--kv-pages", type=int, default=None,
+                        help="page-pool size for --page-size (default: dense-"
+                             "equivalent capacity, max_slots × pages-per-row)")
+    parser.add_argument("--prefix-cache", type=int, default=0,
+                        help="prefix-cache capacity: > 0 warms the prefix-serving "
+                             "programs (right-aligned prefill/chunk pair; with "
+                             "--page-size also the page gather/copy programs)")
     parser.add_argument("--cache-dir", default=None,
                         help="AOT cache directory (default: ACCELERATE_COMPILE_CACHE_DIR "
                              "or ~/.cache/accelerate_tpu/aot_cache)")
@@ -93,6 +105,9 @@ def warmup_command(args) -> int:
         max_new_tokens=args.max_new_tokens,
         spec_k=args.spec_k,
         spec_draft=args.spec_draft,
+        page_size=args.page_size,
+        kv_pages=args.kv_pages,
+        prefix_cache=args.prefix_cache,
         cache_config=config,
         manifest_path=args.manifest,
     )

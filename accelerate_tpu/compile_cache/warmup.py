@@ -94,6 +94,9 @@ def run_warmup(
     max_new_tokens: int = 32,
     spec_k: int = 0,
     spec_draft: Optional[str] = None,
+    page_size: int = 0,
+    kv_pages: Optional[int] = None,
+    prefix_cache: int = 0,
     cache_config: Optional[CompileCacheConfig] = None,
     manifest_path: Optional[str] = None,
     cache=None,
@@ -128,6 +131,11 @@ def run_warmup(
             "spec_k was given but serve=False: no verify/draft programs would be "
             "warmed and the manifest would silently stamp spec_k=0 — pass "
             "serve=True (--serve) to warm the speculative surface"
+        )
+    if (page_size or prefix_cache) and not serve:
+        raise ValueError(
+            "page_size/prefix_cache were given but serve=False: no paged/prefix "
+            "serving programs would be warmed — pass serve=True (--serve)"
         )
     cfg = build_model_config(preset, seq_len)
     entries: list = []
@@ -198,9 +206,14 @@ def run_warmup(
         # prefill/decode/insert programs. Both ride the same bucket ladder and land in
         # this manifest, so a spec-enabled replica restart compiles nothing.
         drafter = build_drafter(spec_draft, params, cfg) if spec_k else None
+        # ``page_size > 0`` warms the PAGED serving surface (block-table decode/
+        # verify, dynamic-slot page scatter, prefix gather/copy) — the manifest
+        # stamps the page geometry so a cache directory is auditable for which
+        # KV layout it is warm FOR.
         engine = ContinuousBatcher(
             params, cfg, max_slots=max_slots, max_len=engine_len,
             compile_cache=cache, spec_k=spec_k, drafter=drafter,
+            page_size=page_size, kv_pages=kv_pages, prefix_cache=prefix_cache,
         )
         entries.extend(engine.warm_programs(max_new_tokens=max_new_tokens))
 
@@ -226,6 +239,11 @@ def run_warmup(
         "max_len": max_len if max_len is not None else seq_len,
         "spec_k": spec_k if serve else 0,
         "spec_draft": (spec_draft or "ngram") if serve and spec_k else None,
+        "page_size": page_size if serve else 0,
+        "kv_pages": (
+            engine.block_mgr.num_pages if serve and page_size else None
+        ),
+        "prefix_cache": prefix_cache if serve else 0,
         "cache_dir": cache.cache_dir,
         "cache_stats": cache.stats(),
         "programs": [e for e in entries if e],

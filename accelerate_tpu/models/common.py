@@ -26,6 +26,8 @@ from ..utils.jax_compat import current_abstract_mesh, shard_map as _shard_map
 
 __all__ = [
     "remat_wrap", "kv_planes", "write_kv", "read_kv", "quant_kv",
+    "paged_kv_planes", "write_kv_paged", "read_kv_paged", "paged_write_coords",
+    "paged_attention_dispatch",
     "fused_ce_allowed", "fused_ce_single_shard",
     "resolve_loss_chunk", "chunked_ce", "ce_sum", "ce_sum_dispatch",
     "sp_active", "sp_manual", "resolve_sp_pipeline", "attention_dispatch",
@@ -129,6 +131,121 @@ def read_kv(new_kv: dict, name: str, dtype) -> jax.Array:
     if f"{name}_scale" in new_kv:
         return new_kv[name].astype(dtype) * new_kv[f"{name}_scale"].astype(dtype)
     return new_kv[name]
+
+
+# ---------------------------------------------------------------- paged KV cache planes
+def paged_kv_planes(num_pages: int, page_size: int, heads: int, head_dim: int, dtype,
+                    quantized: bool):
+    """One layer's empty paged pool: {k, v} [P, page_size, K, hd] (+ fp32 scales
+    [P, page_size, K, 1] when int8) — the shared-pool counterpart of
+    :func:`kv_planes`, indexed by (physical page, slot) instead of (lane, position).
+    ``paged_kv.BlockManager`` owns which lane references which page."""
+    shape = (num_pages, page_size, heads, head_dim)
+    if quantized:
+        scale = (num_pages, page_size, heads, 1)
+        return {
+            "k": jnp.zeros(shape, jnp.int8), "v": jnp.zeros(shape, jnp.int8),
+            "k_scale": jnp.zeros(scale, jnp.float32),
+            "v_scale": jnp.zeros(scale, jnp.float32),
+        }
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def write_kv_paged(kv: dict, name: str, val: jax.Array, pages: jax.Array,
+                   offs: jax.Array) -> dict:
+    """Write ``val`` [B,T,K,hd] into pool plane ``name`` at physical slots
+    ``(pages[b,t], offs[b,t])``, quantizing when the pool is int8 (same per-slot
+    quantization as the dense :func:`write_kv`, so paged and dense caches hold
+    bit-identical values). Sentinel page ids (== num_pages) are out of bounds and
+    the scatter DROPS them — stale/unallocated block-table entries and past-budget
+    draft writes vanish instead of corrupting another lane's pages."""
+    out = {}
+    if f"{name}_scale" in kv:
+        q, scale = quant_kv(val)
+        planes = ((name, q), (f"{name}_scale", scale))
+    else:
+        planes = ((name, val.astype(kv[name].dtype)),)
+    for key, plane in planes:
+        out[key] = kv[key].at[pages, offs].set(plane.astype(kv[key].dtype))
+    return out
+
+
+def read_kv_paged(new_kv: dict, name: str, tables: jax.Array, length: int,
+                  dtype) -> jax.Array:
+    """Dense ``[B, length, K, hd]`` compute-dtype view of pool plane ``name``
+    gathered through block tables [B, MP] — the jnp fallback read the CPU tier-1
+    suite exercises (sentinel entries clamp to a real page; the caller's
+    valid/causal mask hides those slots). int8 pools dequantize like
+    :func:`read_kv`. ONE implementation shared with the kernel's test oracle
+    (``ops.paged_attention.gather_pages``) — the CPU fallback and the reference
+    the kernel is pinned against can never diverge."""
+    from ..ops.paged_attention import gather_pages
+
+    return gather_pages(new_kv, name, tables, length, dtype)
+
+
+def paged_write_coords(tables: jax.Array, pos_grid: jax.Array, page_size: int,
+                       max_len: int, num_pages: int):
+    """Physical (page, slot) write coordinates for logical positions
+    ``pos_grid`` [B,T] through block tables [B,MP] — the ONE copy of the
+    logical→physical routing both decoder families' paged forwards share.
+    Positions at/past ``max_len`` (idle-lane clamps, past-budget draft tails) and
+    unallocated logical pages route to the SENTINEL page id (== ``num_pages``,
+    out of bounds for the pool's page axis) so the scatter DROPS them — the
+    paged spelling of the dense out-of-bounds-write contract."""
+    logical = jnp.minimum(pos_grid // page_size, tables.shape[1] - 1)
+    pages = jnp.where(
+        pos_grid < max_len,
+        jnp.take_along_axis(tables, logical, axis=1),
+        jnp.int32(num_pages),
+    )
+    return pages, pos_grid % page_size
+
+
+def paged_attention_dispatch(q, pool, tables, positions, valid, *, page_size: int,
+                             sm_scale: float, window: int = 0, softcap: float = 0.0,
+                             dtype, dense_attention):
+    """Family-shared paged-attention read: the Pallas kernel on TPU backends (or when
+    forced), else gather-through-the-table into the family's own dense cached-attention
+    math — which makes CPU paged decode BITWISE the dense engine (the tier-1 parity
+    contract; the kernel path matches to fp32 accumulation order).
+
+    ``ACCEL_PAGED_ATTN`` ∈ {auto, kernel, gather} picks the path (trace-time, like the
+    backend probe in :func:`attention_dispatch`); ``dense_attention(ck, cv)`` is the
+    family's fallback closure over its q/positions/valid/cfg."""
+    import os
+
+    impl = os.environ.get("ACCEL_PAGED_ATTN", "auto")
+    if impl not in ("auto", "kernel", "gather"):
+        raise ValueError(
+            f"ACCEL_PAGED_ATTN={impl!r}: expected 'auto', 'kernel' or 'gather'"
+        )
+    if impl == "kernel" or (impl == "auto"
+                            and jax.default_backend() in ("tpu", "axon")):
+        try:
+            from ..ops.paged_attention import paged_attention
+
+            return paged_attention(
+                q, pool, tables, positions, valid, page_size=page_size,
+                sm_scale=sm_scale, window=window, softcap=softcap,
+            )
+        except Exception as exc:  # pragma: no cover - backend-dependent
+            if impl == "kernel":
+                raise
+            # auto mode degrades to the gather path (a serving replica must not
+            # crash on a kernel lowering regression) — but NEVER silently: the
+            # fallback reads every table-covered page densely, so an unnoticed
+            # degrade costs real HBM bandwidth on every decode step.
+            import warnings
+
+            warnings.warn(
+                "paged-attention kernel failed; falling back to the gather path "
+                f"(set ACCEL_PAGED_ATTN=kernel to make this fatal): "
+                f"{type(exc).__name__}: {exc}"
+            )
+    ck = read_kv_paged(pool, "k", tables, valid.shape[1], dtype)
+    cv = read_kv_paged(pool, "v", tables, valid.shape[1], dtype)
+    return dense_attention(ck, cv)
 
 
 def _softcap(scores: jax.Array, cap: float) -> jax.Array:

@@ -44,8 +44,10 @@ __all__ = [
     "generate_speculative",
     "head_logits",
     "init_cache",
+    "init_paged_cache",
     "forward_cached",
     "forward_slots",
+    "forward_slots_paged",
     "generate",
     "generate_streamed",
     "num_params",
@@ -705,24 +707,77 @@ def init_cache(
     }
 
 
-def _block_cached(x, layer, kv, index, positions, valid, cfg: GPTConfig):
-    from .common import read_kv, write_kv
+def init_paged_cache(
+    cfg: GPTConfig, batch_size: int, max_len: int, num_pages: int, page_size: int,
+    dtype=None, quantized: Optional[bool] = None,
+) -> dict:
+    """Paged pool cache, llama-identical contract (``llama.init_paged_cache``):
+    ``{"layers": [{k,v: [P,ps,H,hd]}, ...], "valid": [B,max_len]}`` — page ownership
+    lives in the host-side ``paged_kv.BlockManager``."""
+    from .common import paged_kv_planes
 
-    B, T, D = x.shape
-    h = _layer_norm(x, layer["ln_attn"], cfg.norm_eps)
-    q, k, v = _qkv(h, layer, positions, cfg)
-    new_kv = {**write_kv(kv, "k", k, index), **write_kv(kv, "v", v, index)}
-    new_k = read_kv(new_kv, "k", cfg.dtype)
-    new_v = read_kv(new_kv, "v", cfg.dtype)
+    quantized = cfg.kv_quant if quantized is None else quantized
+    dtype = dtype or cfg.dtype
+    hd = cfg.d_model // cfg.n_heads
+    one = lambda: paged_kv_planes(  # noqa: E731
+        num_pages, page_size, cfg.n_heads, hd, dtype, quantized
+    )
+    layers = (
+        jax.tree_util.tree_map(lambda x: jnp.broadcast_to(x, (cfg.n_layers, *x.shape)), one())
+        if cfg.scan_layers
+        else [one() for _ in range(cfg.n_layers)]
+    )
+    return {
+        "layers": layers,
+        "valid": jnp.zeros((batch_size, max_len), jnp.bool_),
+    }
+
+
+def _attention_cached(q, new_k, new_v, positions, valid, cfg: GPTConfig):
+    """Attention probabilities [B,H,T,C] for q [B,T,H,hd] against the full dense
+    cache view [B,C,H,hd] (``valid`` [B,C] marks live keys) — the one copy of gpt's
+    cached-attention masking/softmax, shared by the dense write path and the paged
+    gather fallback (bitwise parity between them)."""
     C = new_k.shape[1]
     hd = q.shape[-1]
     scores = jnp.einsum("bthd,bchd->bhtc", q, new_k) / math.sqrt(hd)
     causal = jnp.arange(C)[None, None, :] <= positions[:, :, None]
     m = (causal & valid[:, None, :])[:, None, :, :]
-    probs = jax.nn.softmax(
+    return jax.nn.softmax(
         jnp.where(m, scores, jnp.finfo(scores.dtype).min).astype(jnp.float32), axis=-1
     ).astype(q.dtype)
-    attn = _attn_out(jnp.einsum("bhtc,bchd->bthd", probs, new_v), layer, cfg, B, T)
+
+
+def _block_cached(x, layer, kv, index, positions, valid, cfg: GPTConfig, paged=None):
+    from .common import paged_attention_dispatch, read_kv, write_kv, write_kv_paged
+
+    B, T, D = x.shape
+    h = _layer_norm(x, layer["ln_attn"], cfg.norm_eps)
+    q, k, v = _qkv(h, layer, positions, cfg)
+    hd = q.shape[-1]
+    if paged is not None:
+        # Paged pool layout (llama._block_cached's paged contract): scatter writes
+        # through the precomputed physical (page, slot) grid, read via the paged
+        # dispatch (Pallas kernel on TPU, gather into this family's own
+        # _attention_cached on CPU).
+        tables, pages, offs, start_pos, page_size = paged
+        new_kv = {**write_kv_paged(kv, "k", k, pages, offs),
+                  **write_kv_paged(kv, "v", v, pages, offs)}
+        probs_v = paged_attention_dispatch(
+            q, new_kv, tables, start_pos, valid, page_size=page_size,
+            sm_scale=1.0 / math.sqrt(hd), dtype=cfg.dtype,
+            dense_attention=lambda ck, cv: jnp.einsum(
+                "bhtc,bchd->bthd",
+                _attention_cached(q, ck, cv, positions, valid, cfg), cv,
+            ),
+        )
+        attn = _attn_out(probs_v, layer, cfg, B, T)
+    else:
+        new_kv = {**write_kv(kv, "k", k, index), **write_kv(kv, "v", v, index)}
+        new_k = read_kv(new_kv, "k", cfg.dtype)
+        new_v = read_kv(new_kv, "v", cfg.dtype)
+        probs = _attention_cached(q, new_k, new_v, positions, valid, cfg)
+        attn = _attn_out(jnp.einsum("bhtc,bchd->bthd", probs, new_v), layer, cfg, B, T)
     if cfg.parallel_residual:
         h2 = _layer_norm(x, layer["ln_mlp"], cfg.norm_eps)
         out = x + attn + _mlp(h2, layer, x.dtype, cfg.activation)
@@ -774,13 +829,18 @@ def forward_slots(
     cache: dict,
     positions: jax.Array,
     cfg: GPTConfig,
+    tables: Optional[jax.Array] = None,
+    page_size: int = 0,
 ) -> tuple[jax.Array, dict]:
     """Per-slot cached forward, llama-identical contract (``llama.forward_slots``):
     ``tokens`` [B,T] written at each row's own slots ``positions[b] ..
     positions[b]+T-1`` → (logits fp32 [B,T,V], new cache). T == 1 is continuous-batching
     decode; T == k+1 is the batched speculative verify. Lets a gpt-family draft model
     ride the serving engine's speculative decoder (cross-family draft/target pairs share
-    this contract through ``common.cached_decode_family``)."""
+    this contract through ``common.cached_decode_family``). ``tables``/``page_size``
+    switch the KV side to the paged pool layout — one forward for both layouts."""
+    from .common import paged_write_coords
+
     B, T = tokens.shape
     rows = jnp.arange(B)
     pos_grid = positions[:, None] + jnp.arange(T, dtype=positions.dtype)[None, :]
@@ -788,25 +848,58 @@ def forward_slots(
         valid = cache["valid"].at[rows, positions].set(True)
     else:
         valid = cache["valid"].at[rows[:, None], pos_grid].set(True)
+    paged = None
+    if tables is not None:
+        num_pages = jax.tree_util.tree_leaves(cache["layers"])[0].shape[
+            1 if cfg.scan_layers else 0
+        ]
+        pages, offs = paged_write_coords(
+            tables, pos_grid, page_size, cache["valid"].shape[1], num_pages
+        )
+        paged = (tables, pages, offs, positions, page_size)
     x = _embed(params, tokens, pos_grid, cfg)
     if cfg.scan_layers:
         def body(carry, layer_and_kv):
             layer, kv = layer_and_kv
-            out, new_kv = _block_cached(carry, layer, kv, positions, pos_grid, valid, cfg)
+            out, new_kv = _block_cached(
+                carry, layer, kv, positions, pos_grid, valid, cfg, paged=paged
+            )
             return out, new_kv
 
         x, new_layers = jax.lax.scan(body, x, (params["layers"], cache["layers"]))
     else:
         new_layers = []
         for layer, kv in zip(params["layers"], cache["layers"]):
-            x, new_kv = _block_cached(x, layer, kv, positions, pos_grid, valid, cfg)
+            x, new_kv = _block_cached(
+                x, layer, kv, positions, pos_grid, valid, cfg, paged=paged
+            )
             new_layers.append(new_kv)
     x = _layer_norm(x, params["ln_f"], cfg.norm_eps)
     head = params["wte"].T if cfg.tie_embeddings else params["lm_head"]
     logits = (x @ head.astype(cfg.dtype)).astype(jnp.float32)
     if cfg.lm_head_bias and "b_lm_head" in params:
         logits = logits + params["b_lm_head"].astype(jnp.float32)
+    if paged is not None:
+        return logits, {"layers": new_layers, "valid": valid}
     return logits, {"layers": new_layers, "valid": valid, "index": cache["index"]}
+
+
+def forward_slots_paged(
+    params: dict,
+    tokens: jax.Array,
+    cache: dict,
+    tables: jax.Array,
+    positions: jax.Array,
+    cfg: GPTConfig,
+    page_size: int,
+) -> tuple[jax.Array, dict]:
+    """:func:`forward_slots` over the paged pool cache, llama-identical contract
+    (``llama.forward_slots_paged``) — a thin delegate into the ONE shared forward,
+    so the dense and paged layouts cannot drift. Keeps a gpt-family draft/target
+    viable on a paged serving engine."""
+    return forward_slots(
+        params, tokens, cache, positions, cfg, tables=tables, page_size=page_size
+    )
 
 
 def _make_gen_fns(cfg: GPTConfig, max_len: int):

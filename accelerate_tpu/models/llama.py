@@ -29,9 +29,12 @@ from jax.sharding import PartitionSpec as P
 
 from ..utils.constants import BATCH_AXES, SEQUENCE_AXIS, TENSOR_AXIS
 from .common import kv_planes as _kv_planes
+from .common import paged_attention_dispatch as _paged_attention
+from .common import paged_kv_planes as _paged_kv_planes
 from .common import quant_kv as _quant_kv
 from .common import read_kv as _read_cache
 from .common import write_kv as _write_cache
+from .common import write_kv_paged as _write_cache_paged
 
 __all__ = [
     "LlamaConfig",
@@ -51,8 +54,10 @@ __all__ = [
     "partition_specs",
     "CONFIGS",
     "init_cache",
+    "init_paged_cache",
     "forward_cached",
     "forward_slots",
+    "forward_slots_paged",
     "generate",
     "generate_speculative",
     "generate_streamed",
@@ -1210,6 +1215,39 @@ def init_cache(
     }
 
 
+def init_paged_cache(
+    cfg: LlamaConfig, batch_size: int, max_len: int, num_pages: int, page_size: int,
+    dtype=None, quantized: Optional[bool] = None,
+) -> dict:
+    """Allocate an empty PAGED KV cache: a shared pool of ``num_pages`` fixed-size
+    pages instead of a dense ``[B, max_len]`` row per lane.
+
+    Layout: ``{"layers": [{"k": [P,ps,K,hd], "v": ...}, ...], "valid": [B,max_len]
+    bool}`` — per-layer pool planes (stacked on a leading layer dim under
+    ``cfg.scan_layers``), plus the per-lane valid mask, which stays DENSE by logical
+    position (bools are ~1/2(head_dim·heads·bytes·layers)00th of the K/V bytes; the
+    pool is where the memory goes). Which lane owns which page lives OUTSIDE the
+    pytree in the host-side ``paged_kv.BlockManager`` block table, uploaded per step —
+    so page allocation/release never rebuilds device state. ``quantized`` (default
+    ``cfg.kv_quant``): int8 pages with per-slot fp32 scale pages — half the pool HBM.
+    """
+    quantized = cfg.kv_quant if quantized is None else quantized
+    dtype = dtype or cfg.dtype
+    one = lambda: _paged_kv_planes(  # noqa: E731
+        num_pages, page_size, cfg.n_kv_heads, cfg.head_dim, dtype, quantized
+    )
+    if cfg.scan_layers:
+        layers = jax.tree_util.tree_map(
+            lambda x: jnp.broadcast_to(x, (cfg.n_layers, *x.shape)), one()
+        )
+    else:
+        layers = [one() for _ in range(cfg.n_layers)]
+    return {
+        "layers": layers,
+        "valid": jnp.zeros((batch_size, max_len), jnp.bool_),
+    }
+
+
 def _attention_cached(q, ck, cv, q_positions, valid, cfg: LlamaConfig):
     """q [B,T,H,hd] against the full cache ck/cv [B,C,K,hd]; ``valid`` [B,C] marks live keys.
 
@@ -1237,7 +1275,7 @@ def _attention_cached(q, ck, cv, q_positions, valid, cfg: LlamaConfig):
 
 
 def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig,
-                  moe_dense: Optional[bool] = None):
+                  moe_dense: Optional[bool] = None, paged=None):
     """One block with KV-cache read/write → (x, new_kv).
 
     ``index`` is the write slot: a SCALAR advances every row together (generate's
@@ -1249,6 +1287,13 @@ def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig,
     dense iff T == 1). The speculative verify passes True — every verified position
     must route exactly like the T == 1 decode it replaces, or acceptance would compare
     against capacity-pooled logits and break decode parity.
+
+    ``paged`` — ``(tables, pages, offs, start_positions, page_size)`` switches the KV
+    side to the paged pool layout (``kv`` then holds [P, page_size, K, hd] pool planes;
+    ``index`` is unused): writes scatter through the precomputed physical (page, slot)
+    grid, reads go through ``common.paged_attention_dispatch`` (Pallas kernel on TPU,
+    gather into THIS function's own ``_attention_cached`` on CPU — bitwise the dense
+    path there).
     """
     B, T, D = x.shape
     if moe_dense is None:
@@ -1261,11 +1306,24 @@ def _block_cached(x, layer, kv, index, positions, valid, cfg: LlamaConfig,
     v = v.reshape(B, T, cfg.n_kv_heads, cfg.head_dim)
     q = _rope(q, positions, cfg)
     k = _rope(k, positions, cfg)
-    new_kv = {**_write_cache(kv, "k", k, index), **_write_cache(kv, "v", v, index)}
-    attn = _attention_cached(
-        q, _read_cache(new_kv, "k", cfg.dtype), _read_cache(new_kv, "v", cfg.dtype),
-        positions, valid, cfg,
-    )
+    if paged is not None:
+        tables, pages, offs, start_pos, page_size = paged
+        new_kv = {**_write_cache_paged(kv, "k", k, pages, offs),
+                  **_write_cache_paged(kv, "v", v, pages, offs)}
+        attn = _paged_attention(
+            q, new_kv, tables, start_pos, valid, page_size=page_size,
+            sm_scale=_sm_scale(cfg), window=cfg.sliding_window,
+            softcap=cfg.attn_softcap, dtype=cfg.dtype,
+            dense_attention=lambda ck, cv: _attention_cached(
+                q, ck, cv, positions, valid, cfg
+            ),
+        )
+    else:
+        new_kv = {**_write_cache(kv, "k", k, index), **_write_cache(kv, "v", v, index)}
+        attn = _attention_cached(
+            q, _read_cache(new_kv, "k", cfg.dtype), _read_cache(new_kv, "v", cfg.dtype),
+            positions, valid, cfg,
+        )
     attn_out = _proj_l(attn.reshape(B, T, cfg.n_heads * cfg.head_dim), layer, "wo", cfg)
     if cfg.post_norm:
         attn_out = _rms_norm(attn_out, layer["ln_attn_post"], cfg.norm_eps, p1)
@@ -1398,6 +1456,8 @@ def forward_slots(
     cache: dict,
     positions: jax.Array,
     cfg: LlamaConfig,
+    tables: Optional[jax.Array] = None,
+    page_size: int = 0,
 ) -> tuple[jax.Array, dict]:
     """Per-slot cached forward: ``tokens`` [B,T] written at each row's own cache slots
     ``positions[b] .. positions[b]+T-1`` → (logits fp32 [B,T,V], new cache).
@@ -1412,7 +1472,17 @@ def forward_slots(
     routing — decode-parity is what makes speculative acceptance lossless). Slots past
     a lane's rewound position may hold garbage K/V from rejected drafts; the causal
     mask (``slot <= q_position``) makes them unreachable until overwritten.
+
+    ``tables``/``page_size`` switch the KV side to the PAGED layout (``cache`` from
+    :func:`init_paged_cache`): writes scatter through each lane's block-table row into
+    shared pool pages (sentinel/out-of-range positions drop), reads go through the
+    paged-attention dispatch. ONE forward implementation for both layouts — the
+    alternating-sliding-window grouping, per-layer banding and MoE routing literally
+    cannot drift between them (the dense/paged token-parity contract,
+    tests/test_serving_paged.py).
     """
+    from .common import paged_write_coords
+
     B, T = tokens.shape
     rows = jnp.arange(B)
     pos_grid = positions[:, None] + jnp.arange(T, dtype=positions.dtype)[None, :]  # [B,T]
@@ -1420,6 +1490,15 @@ def forward_slots(
         valid = cache["valid"].at[rows, positions].set(True)
     else:
         valid = cache["valid"].at[rows[:, None], pos_grid].set(True)
+    paged = None
+    if tables is not None:
+        num_pages = jax.tree_util.tree_leaves(cache["layers"])[0].shape[
+            1 if cfg.scan_layers else 0
+        ]
+        pages, offs = paged_write_coords(
+            tables, pos_grid, page_size, cache["valid"].shape[1], num_pages
+        )
+        paged = (tables, pages, offs, positions, page_size)
     x = params["embed"][tokens].astype(cfg.dtype)
     if cfg.embed_scale:
         x = x * jnp.asarray(math.sqrt(cfg.d_model), cfg.dtype)
@@ -1442,7 +1521,7 @@ def forward_slots(
                 kv_j = jax.tree_util.tree_map(lambda a, j=j: a[j], kv_g)
                 out, new_kv = _block_cached(
                     out, layer_j, kv_j, positions, pos_grid, valid,
-                    cfg if j == 0 else full_cfg, moe_dense=True,
+                    cfg if j == 0 else full_cfg, moe_dense=True, paged=paged,
                 )
                 new_kvs.append(new_kv)
             return out, jax.tree_util.tree_map(lambda *ls: jnp.stack(ls), *new_kvs)
@@ -1456,7 +1535,8 @@ def forward_slots(
             layer, kv = layer_and_kv
             # vector index → per-row write slots (_block_cached handles both)
             out, new_kv = _block_cached(
-                carry, layer, kv, positions, pos_grid, valid, cfg, moe_dense=True
+                carry, layer, kv, positions, pos_grid, valid, cfg, moe_dense=True,
+                paged=paged,
             )
             return out, new_kv
 
@@ -1469,12 +1549,34 @@ def forward_slots(
             banded = cfg.sliding_window and i % cfg.window_every == 0
             x, new_kv = _block_cached(
                 x, layer, kv, positions, pos_grid, valid,
-                cfg if banded else full_cfg, moe_dense=True,
+                cfg if banded else full_cfg, moe_dense=True, paged=paged,
             )
             new_layers.append(new_kv)
     x = _rms_norm(x, params["ln_f"], cfg.norm_eps, cfg.norm_plus_one)
     logits = head_logits(x, params, cfg)
+    if paged is not None:
+        return logits, {"layers": new_layers, "valid": valid}
     return logits, {"layers": new_layers, "valid": valid, "index": cache["index"]}
+
+
+def forward_slots_paged(
+    params: dict,
+    tokens: jax.Array,
+    cache: dict,
+    tables: jax.Array,
+    positions: jax.Array,
+    cfg: LlamaConfig,
+    page_size: int,
+) -> tuple[jax.Array, dict]:
+    """:func:`forward_slots` over the PAGED cache (``init_paged_cache``) — a thin
+    delegate: the serving engine's stable entry point for the paged layout.
+    ``tables`` [B, MP] int32 maps each lane's logical pages to physical pool pages
+    (SENTINEL == num_pages marks unallocated entries; writes through them, and any
+    position at/past max_len, DROP). The forward itself is the ONE shared
+    implementation in :func:`forward_slots`, so the two layouts cannot drift."""
+    return forward_slots(
+        params, tokens, cache, positions, cfg, tables=tables, page_size=page_size
+    )
 
 
 def _make_gen_fns(cfg: LlamaConfig, max_len: int):

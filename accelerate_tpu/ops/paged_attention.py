@@ -1,0 +1,262 @@
+"""Paged-attention decode kernel for TPU in Pallas (+ a pure-jnp gather reference).
+
+Dense continuous-batching decode reads a ``[B, max_len, K, hd]`` cache row per lane even
+when the lane holds a 40-token chat turn. With the paged KV layout
+(``models.common.paged_kv_planes`` / ``paged_kv.BlockManager``) K/V lives in a shared pool
+``[num_pages, page_size, K, hd]`` and each lane maps logical pages to physical pages
+through an int32 **block table** — this module is the attention read through that
+indirection.
+
+``paged_attention`` is the Pallas kernel: grid ``(batch, kv_head, logical_page)``, the
+block table rides as a **scalar-prefetch** operand so each grid step's BlockSpec index map
+resolves ``table[b, i]`` to the physical pool page whose ``[page_size, hd]`` tile the
+pipeline DMAs next (double-buffered by the pipeline machinery itself — the classic
+manual-DMA formulation buys batched page fetches on top, at ~4× the kernel complexity;
+this formulation keeps the whole indirection in the index map). Online-softmax state
+(running max / sum, lane-replicated like ``flash_attention``) accumulates in VMEM scratch
+across the sequential page dimension. Queries are the decode shapes: ``T == 1`` (the
+engine's one-token step) or ``T == spec_k+1`` (the batched speculative verify) — all
+``T×G`` query rows of a lane ride one tile, with per-row causal masking against the
+lane's scalar-prefetched start position. int8 pools (``kv_quant``) dequantize in-kernel
+from per-slot scale pages, so the fp32 cache never exists in HBM *or* VMEM.
+
+``paged_attention_reference`` is the same contract in pure jnp (gather through the table,
+mask, softmax) — the kernel's test oracle and the CPU fallback for direct users. The
+serving engine's own CPU fallback instead gathers into the family's ``_attention_cached``
+(``models.common.paged_attention_dispatch``) so paged decode stays BITWISE the dense
+engine on the tier-1 host; this reference exists so ops-level kernel tests need no model.
+
+Sentinel table entries (== num_pages, unallocated logical pages) are clamped into range
+for the fetch and masked out of the softmax by the valid/causal mask — the kernel never
+reads through an uninitialized indirection. Runs in interpreter mode on CPU (tests) and
+compiled on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..utils.jax_compat import tpu_compiler_params as _tpu_compiler_params
+from ._common import interpret_default as _interpret_default
+
+__all__ = ["paged_attention", "paged_attention_reference", "gather_pages"]
+
+_NEG_INF = -1e30
+_LANES = 128  # native VPU lane count: softmax state is replicated across lanes
+
+
+def _lane_tile(x, cols):
+    """Broadcast lane-replicated state [rows, _LANES] across [rows, cols] (tile+slice,
+    never a 1-lane relayout) — same trick as ``flash_attention``."""
+    if cols == _LANES:
+        return x
+    reps = -(-cols // _LANES)
+    return jnp.tile(x, (1, reps))[:, :cols]
+
+
+def gather_pages(pool: dict, name: str, tables: jax.Array, length: int, dtype):
+    """Dense ``[B, length, K, hd]`` view of pool plane ``name`` through block tables
+    ``[B, MP]`` — sentinel entries clamp to a real page (callers mask those slots).
+    int8 planes dequantize against their scale pages (the convert+scale fuses into the
+    consuming einsum, so the fp32 copy never lands in HBM)."""
+    P, ps = pool[name].shape[0], pool[name].shape[1]
+    ids = jnp.minimum(tables, P - 1)
+    pages = jnp.take(pool[name], ids, axis=0)                  # [B, MP, ps, K, hd]
+    B, MP = ids.shape
+    x = pages.reshape(B, MP * ps, *pages.shape[3:])[:, :length]
+    if f"{name}_scale" in pool:
+        scales = jnp.take(pool[f"{name}_scale"], ids, axis=0)
+        scales = scales.reshape(B, MP * ps, *scales.shape[3:])[:, :length]
+        return x.astype(dtype) * scales.astype(dtype)
+    return x.astype(dtype)
+
+
+def paged_attention_reference(q, pool, tables, positions, valid, *, page_size,
+                              sm_scale, window: int = 0, softcap: float = 0.0):
+    """Pure-jnp oracle: q [B,T,H,hd] against the paged pool via gather — identical
+    math to the dense cached-attention path (GQA contraction against the unrepeated
+    cache, fp32 softmax). ``positions`` [B] is each lane's first query position;
+    ``valid`` [B,C] marks live, non-pad cache slots."""
+    B, T, H, hd = q.shape
+    C = valid.shape[1]
+    ck = gather_pages(pool, "k", tables, C, q.dtype)
+    cv = gather_pages(pool, "v", tables, C, q.dtype)
+    K = ck.shape[2]
+    G = H // K
+    qg = q.reshape(B, T, K, G, hd)
+    scores = jnp.einsum("btkgd,bckd->bkgtc", qg, ck) * sm_scale
+    if softcap:
+        scores = softcap * jnp.tanh(scores / softcap)
+    q_pos = positions[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]   # [B,T]
+    slots = jnp.arange(C)[None, None, :]
+    causal = slots <= q_pos[:, :, None]                                    # [B,T,C]
+    if window:
+        causal = causal & (slots > q_pos[:, :, None] - window)
+    mask = (causal & valid[:, None, :])[:, None, None, :, :]               # [B,1,1,T,C]
+    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bkgtc,bckd->btkgd", probs, cv).reshape(B, T, H, hd)
+
+
+def _kernel(tab_ref, pos_ref, *refs, page_size, max_pages, T, G, num_pages,
+            sm_scale, window, softcap, quantized):
+    if quantized:
+        (q_ref, k_ref, ks_ref, v_ref, vs_ref, valid_ref,
+         o_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        ks_ref = vs_ref = None
+        q_ref, k_ref, v_ref, valid_ref, o_ref, acc_ref, m_ref, l_ref = refs
+    b = pl.program_id(0)
+    i = pl.program_id(2)
+    R = T * G
+    hd = q_ref.shape[-1]
+
+    @pl.when(i == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    q = q_ref[0, :, 0].reshape(R, hd)                      # [T*G, hd]
+    k = k_ref[0, :, 0]                                     # [ps, hd]
+    v = v_ref[0, :, 0]
+    if quantized:
+        k = k.astype(jnp.float32) * ks_ref[0, :, 0]
+        v = v.astype(jnp.float32) * vs_ref[0, :, 0]
+    s = jax.lax.dot_general(
+        q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+    ) * sm_scale                                           # [R, ps] fp32
+    if softcap:
+        s = softcap * jnp.tanh(s / softcap)
+
+    # Mask: key slot j (global position i*ps + j) is visible to query row r
+    # (query index t = r // G) iff j <= pos[b] + t, inside the window, and marked
+    # valid — sentinel-table garbage pages land here too and mask out entirely.
+    key_pos = i * page_size + jax.lax.broadcasted_iota(jnp.int32, (R, page_size), 1)
+    q_pos = pos_ref[b] + jax.lax.broadcasted_iota(jnp.int32, (R, page_size), 0) // G
+    mask = (key_pos <= q_pos) & (valid_ref[...] > 0)
+    if window:
+        mask = mask & (key_pos > q_pos - window)
+    s = jnp.where(mask, s, _NEG_INF)
+
+    m_prev = m_ref[:]                                      # [R, LANES] replicated
+    m_curr = jnp.max(s, axis=1)[:, None]
+    m_next = jnp.maximum(m_prev, m_curr)
+    p = jnp.exp(s - _lane_tile(m_next, page_size))
+    # Fully-masked rows have every s == _NEG_INF == m_next, making exp() == 1; the
+    # row sum must still be 0 so finalize emits zeros for never-written lanes.
+    p = jnp.where(mask, p, 0.0)
+    alpha = jnp.exp(m_prev - m_next)
+    l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=1)[:, None]
+    acc_ref[:] = acc_ref[:] * _lane_tile(alpha, hd) + jax.lax.dot_general(
+        p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    m_ref[:] = m_next
+
+    @pl.when(i == max_pages - 1)
+    def _finalize():
+        l = l_ref[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, :, 0] = (
+            acc_ref[:] / _lane_tile(l_safe, hd)
+        ).reshape(T, G, hd).astype(o_ref.dtype)
+
+
+def paged_attention(q, pool, tables, positions, valid, *, page_size, sm_scale,
+                    window: int = 0, softcap: float = 0.0, interpret=None):
+    """Paged-attention decode: q [B,T,H,hd] against pool pages through block tables.
+
+    - ``pool``: ``{"k","v": [P, page_size, K, hd]}`` (+ ``k_scale``/``v_scale``
+      [P, page_size, K, 1] fp32 when int8-quantized).
+    - ``tables`` [B, MP] int32 physical page per logical page (sentinel == P for
+      unallocated entries — clamped for the fetch, masked from the softmax).
+    - ``positions`` [B] int32: the lane's first query position (query t sits at
+      ``positions[b] + t``); ``valid`` [B, C] bool marks live cache slots.
+
+    Returns [B, T, H, hd] in q's dtype. T is 1 for plain decode, spec_k+1 for the
+    speculative verify; every (lane, kv-head) processes its pages sequentially with
+    online-softmax scratch, so output matches the dense one-shot softmax to fp32
+    accumulation order."""
+    B, T, H, hd = q.shape
+    P, ps, K = pool["k"].shape[0], pool["k"].shape[1], pool["k"].shape[2]
+    if ps != page_size:
+        raise ValueError(f"pool page_size {ps} != page_size argument {page_size}")
+    if H % K:
+        raise ValueError(f"H={H} must be a multiple of KV heads K={K}")
+    G = H // K
+    MP = tables.shape[1]
+    C = valid.shape[1]
+    quantized = "k_scale" in pool
+    if interpret is None:
+        interpret = _interpret_default()
+
+    # Valid mask padded to the table-covered extent (logical slots past max_len can
+    # never be written; they mask out like any other dead slot).
+    valid_i32 = jnp.pad(valid.astype(jnp.int32), ((0, 0), (0, MP * ps - C)))
+    q5 = q.reshape(B, T, K, G, hd)
+
+    def _q_idx(b, h, i, tabs, pos):
+        return (b, 0, h, 0, 0)
+
+    def _kv_idx(b, h, i, tabs, pos):
+        return (jnp.minimum(tabs[b * MP + i], P - 1), 0, h, 0)
+
+    def _valid_idx(b, h, i, tabs, pos):
+        return (b, i)
+
+    in_specs = [pl.BlockSpec((1, T, 1, G, hd), _q_idx),
+                pl.BlockSpec((1, ps, 1, hd), _kv_idx)]
+    args = [q5, pool["k"]]
+    if quantized:
+        in_specs.append(pl.BlockSpec((1, ps, 1, 1), _kv_idx))
+        args.append(pool["k_scale"])
+    in_specs.append(pl.BlockSpec((1, ps, 1, hd), _kv_idx))
+    args.append(pool["v"])
+    if quantized:
+        in_specs.append(pl.BlockSpec((1, ps, 1, 1), _kv_idx))
+        args.append(pool["v_scale"])
+    in_specs.append(pl.BlockSpec((1, ps), _valid_idx))
+    args.append(valid_i32)
+
+    kernel = functools.partial(
+        _kernel, page_size=ps, max_pages=MP, T=T, G=G, num_pages=P,
+        sm_scale=sm_scale, window=window, softcap=softcap, quantized=quantized,
+    )
+    R = T * G
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, K, MP),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, T, 1, G, hd), _q_idx),
+        scratch_shapes=[
+            pltpu.VMEM((R, hd), jnp.float32),
+            pltpu.VMEM((R, _LANES), jnp.float32),
+            pltpu.VMEM((R, _LANES), jnp.float32),
+        ],
+    )
+    # Decode is HBM-bound: bytes = every pool page each lane's table covers (+q/out);
+    # flops = the two dots over the covered extent.
+    kv_itemsize = pool["k"].dtype.itemsize
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, T, K, G, hd), q.dtype),
+        compiler_params=_tpu_compiler_params(
+            dimension_semantics=("parallel", "parallel", "arbitrary")
+        ),
+        cost_estimate=pl.CostEstimate(
+            flops=int(4 * B * K * R * MP * ps * hd),
+            bytes_accessed=int(
+                B * K * MP * ps * hd * kv_itemsize * 2 + 2 * q.size * q.dtype.itemsize
+            ),
+            transcendentals=int(B * K * R * MP * ps),
+        ),
+        interpret=interpret,
+    )(tables.reshape(-1).astype(jnp.int32), positions.astype(jnp.int32), *args)
+    return out.reshape(B, T, H, hd)

@@ -28,12 +28,21 @@ Design (static shapes throughout):
   next step's writes overwrite it. The draft NEVER changes outputs, only how many
   target forwards a sequence costs (``stats()["tokens_per_step"]``).
 
+Paged KV cache (``page_size > 0``, docs/paged_kv.md): the dense per-lane rows are replaced
+by a shared pool of fixed-size pages + per-lane block tables (``paged_kv.BlockManager`` on
+the host, ``models.*.forward_slots_paged`` + the Pallas ``ops/paged_attention`` kernel on
+the device) — KV memory then costs what admitted requests ACTUALLY occupy, admission
+defers (FIFO) on pool pressure instead of overcommitting, and the prefix cache becomes
+refcounted page lists with copy-on-write at divergence instead of whole row-cache
+snapshots. ``kv_demand`` prices requests page-granularly for the gateway.
+
 Correctness contract (tested): with requests submitted at staggered times, every finished
 sequence equals ``llama.generate``'s greedy output for that prompt alone (for MoE configs,
 for that prompt left-padded to the engine's bucket width — capacity-pooled MoE routing is
 shape-sensitive, so parity is defined at matching padded shapes) — with ``spec_k > 0``
 token-for-token identical to ``spec_k = 0``, greedy and sampled alike
-(docs/speculative_serving.md).
+(docs/speculative_serving.md), and with ``page_size > 0`` token-for-token identical to
+the dense layout (tests/test_serving_paged.py).
 """
 
 from __future__ import annotations
@@ -57,9 +66,10 @@ from .generation import (
 )
 from .models import llama
 from .models.llama import init_cache
+from .paged_kv import BlockManager, KVBudgetError, pages_for
 from .utils.dataclasses import CompileCacheConfig
 
-__all__ = ["ContinuousBatcher", "Request", "normalize_submit"]
+__all__ = ["ContinuousBatcher", "KVBudgetError", "Request", "normalize_submit"]
 
 
 @partial(jax.jit, static_argnames=("top_k",))
@@ -116,6 +126,16 @@ def normalize_submit(prompt, max_new_tokens=None, eos_token_id=None, gen=None,
     if gen.temperature > 0.0 and rng is None:
         raise ValueError("temperature sampling needs a per-request rng key")
     return prompt, gen
+
+
+@dataclasses.dataclass
+class _PagedPrefix:
+    """One paged prefix-registry entry: the physical pages covering the registered
+    prefix (full shared pages, plus — when the boundary cuts a page — an immutable
+    registry-owned copy of the partial page). The prefix length itself is derived
+    from the registry key at lookup; the entry holds one refcount on every id in
+    ``pages``, and eviction releases them."""
+    pages: np.ndarray  # [n] int32 physical page ids
 
 
 @dataclasses.dataclass
@@ -244,6 +264,110 @@ def _insert_row(cache, row_cache, slot: int, scan_layers: bool):
     }
 
 
+@partial(jax.jit, static_argnames=("cfg", "page_size"), donate_argnums=(1,))
+def _decode_step_paged(params, cache, tables, tokens, positions, cfg, page_size: int):
+    """:func:`_decode_step` over the PAGED cache: K/V writes route through each lane's
+    block-table row into shared pool pages, attention reads through the paged dispatch
+    (Pallas kernel on TPU, gather + the same dense math on CPU — bitwise the dense
+    engine there). ``tables`` [B, MP] is uploaded per step (host-side page allocation
+    never rebuilds device state)."""
+    logits, cache = llama.forward_slots_paged(
+        params, tokens[:, None], cache, tables, positions, cfg, page_size
+    )
+    logits = logits[:, -1, :]
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), logits, cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "page_size"), donate_argnums=(1,))
+def _spec_verify_step_paged(params, cache, tables, tokens, positions, cfg,
+                            page_size: int):
+    """:func:`_spec_verify_step` over the paged cache — ONE fused [B, k+1] verify
+    whose K/V lives in pool pages. Draft writes past a lane's allocated pages route
+    through the SENTINEL table entry and drop (the paged spelling of the dense
+    path's out-of-bounds-scatter contract for non-load-bearing draft tails)."""
+    logits, cache = llama.forward_slots_paged(
+        params, tokens, cache, tables, positions, cfg, page_size
+    )
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), logits, cache
+
+
+@partial(jax.jit, static_argnames=("page_size", "scan_layers"), donate_argnums=(0,))
+def _insert_row_paged(cache, row_cache, write_ids, slot, page_size: int,
+                      scan_layers: bool):
+    """Scatter a single-row prefill cache into pool pages.
+
+    ``write_ids`` [MP] maps the row's logical pages to physical pool pages; SENTINEL
+    entries (adopted shared-prefix pages, or pages past the row) are out of bounds
+    and the scatter drops them — a lane can never write a page it doesn't own. One
+    compiled program serves every slot and row width (``slot`` is a traced scalar —
+    unlike the dense ``_insert_row``'s per-slot static scatter, the paged layout
+    makes the lane index data)."""
+    MP = write_ids.shape[0]
+
+    def put(pool, row):
+        if scan_layers:
+            r = row[:, 0]                                        # [L, C, ...]
+            pad = MP * page_size - r.shape[1]
+            r = jnp.pad(r, ((0, 0), (0, pad)) + ((0, 0),) * (r.ndim - 2))
+            r = r.reshape(r.shape[0], MP, page_size, *r.shape[2:])
+            return pool.at[:, write_ids].set(r.astype(pool.dtype))
+        r = row[0]                                               # [C, ...]
+        pad = MP * page_size - r.shape[0]
+        r = jnp.pad(r, ((0, pad),) + ((0, 0),) * (r.ndim - 1))
+        r = r.reshape(MP, page_size, *r.shape[1:])
+        return pool.at[write_ids].set(r.astype(pool.dtype))
+
+    layers = jax.tree_util.tree_map(put, cache["layers"], row_cache["layers"])
+    valid = jax.lax.dynamic_update_slice(
+        cache["valid"], row_cache["valid"], (slot, 0)
+    )
+    return {"layers": layers, "valid": valid}
+
+
+@partial(jax.jit, static_argnames=("page_size", "scan_layers"))
+def _gather_row_paged(cache, read_ids, prefix_len, page_size: int, scan_layers: bool):
+    """Reassemble a single-row DENSE cache from pool pages (paged prefix-cache
+    resume): gather ``read_ids`` [MP] (sentinel entries clamp; slots past
+    ``prefix_len`` are marked invalid) into the ``[1, max_len]`` row layout the
+    chunked-prefill programs consume, with the row's write index at ``prefix_len``.
+    Does NOT donate the pool — the registered pages stay live for other adopters."""
+    MP = read_ids.shape[0]
+    max_len = cache["valid"].shape[1]
+
+    def get(pool):
+        P = pool.shape[1] if scan_layers else pool.shape[0]
+        ids = jnp.minimum(read_ids, P - 1)
+        if scan_layers:
+            pages = pool[:, ids]                                 # [L, MP, ps, ...]
+            r = pages.reshape(pool.shape[0], MP * page_size, *pages.shape[3:])
+            return r[:, :max_len][:, None]                       # [L, 1, C, ...]
+        pages = pool[ids]                                        # [MP, ps, ...]
+        r = pages.reshape(MP * page_size, *pages.shape[2:])
+        return r[:max_len][None]                                 # [1, C, ...]
+
+    return {
+        "layers": jax.tree_util.tree_map(get, cache["layers"]),
+        "valid": (jnp.arange(max_len) < prefix_len)[None, :],
+        "index": jnp.asarray(prefix_len, jnp.int32),
+    }
+
+
+@partial(jax.jit, static_argnames=("scan_layers",), donate_argnums=(0,))
+def _copy_page(cache, src, dst, scan_layers: bool):
+    """Copy pool page ``src`` → ``dst`` (the registry-side COW: an immutable snapshot
+    of a partial boundary page whose owning lane keeps writing its own copy)."""
+    axis = 1 if scan_layers else 0
+
+    def cp(pool):
+        page = jax.lax.dynamic_index_in_dim(pool, src, axis=axis)
+        return jax.lax.dynamic_update_slice_in_dim(pool, page, dst, axis=axis)
+
+    return {
+        "layers": jax.tree_util.tree_map(cp, cache["layers"]),
+        "valid": cache["valid"],
+    }
+
+
 @partial(jax.jit, static_argnames=("cfg", "max_len"))
 def _prefill_jit(params, row, mask, cfg, max_len: int):
     cache = init_cache(cfg, 1, max_len)
@@ -296,12 +420,33 @@ class ContinuousBatcher:
     def __init__(self, params, cfg, max_slots: int = 8, max_len: int = 512,
                  prompt_bucket: int = 64, prefix_cache: int = 0, telemetry=None,
                  compile_cache=None, prompt_buckets=None, spec_k: int = 0,
-                 drafter=None, spec_accept: str = "replay"):
+                 drafter=None, spec_accept: str = "replay", page_size: int = 0,
+                 kv_pages: Optional[int] = None):
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
         self.max_len = max_len
         self.prompt_bucket = prompt_bucket
+        # Paged KV cache: ``page_size > 0`` replaces the dense per-lane
+        # ``[max_slots, max_len]`` cache with a shared pool of ``kv_pages`` fixed-size
+        # pages and per-lane block tables (``paged_kv.BlockManager``) — KV memory then
+        # costs what admitted requests ACTUALLY occupy, the prefix cache shares pages
+        # by refcount instead of snapshotting whole rows, and max concurrency at a
+        # fixed KV budget becomes a function of real sequence lengths (docs/
+        # paged_kv.md). ``kv_pages`` defaults to dense-equivalent capacity
+        # (max_slots × pages-per-row); size it smaller to cap KV memory — admission
+        # then DEFERS when the pool is exhausted and resumes as pages free.
+        if not isinstance(page_size, (int, np.integer)) or isinstance(page_size, bool):
+            raise TypeError(f"page_size must be an int, got {type(page_size).__name__}")
+        if page_size < 0:
+            raise ValueError(f"page_size={page_size} must be >= 0 (0 = dense cache)")
+        self.page_size = int(page_size)
+        self.paged = self.page_size > 0
+        if kv_pages is not None and not self.paged:
+            raise ValueError(
+                "kv_pages was given but page_size=0: the pool size would be silently "
+                "ignored — pass page_size>=1 to enable the paged KV cache"
+            )
         # Batched speculative decoding: ``spec_k`` draft proposals per active slot per
         # step, verified by ONE fused [B, spec_k+1] target forward; each slot accepts a
         # variable-length prefix. 0 (default) = the classic one-token decode step,
@@ -354,6 +499,19 @@ class ContinuousBatcher:
             _prefill_chunk_keep_jit, cc, "serving.prefill_chunk_keep", ("cfg",))
         self._insert_row_fn = as_cached(
             _insert_row, cc, "serving.insert_row", ("slot", "scan_layers"))
+        self._decode_paged_fn = as_cached(
+            _decode_step_paged, cc, "serving.decode_paged", ("cfg", "page_size"))
+        self._spec_verify_paged_fn = as_cached(
+            _spec_verify_step_paged, cc, "serving.spec_verify_paged",
+            ("cfg", "page_size"))
+        self._insert_paged_fn = as_cached(
+            _insert_row_paged, cc, "serving.insert_paged",
+            ("page_size", "scan_layers"))
+        self._gather_row_fn = as_cached(
+            _gather_row_paged, cc, "serving.gather_row_paged",
+            ("page_size", "scan_layers"))
+        self._copy_page_fn = as_cached(
+            _copy_page, cc, "serving.copy_page", ("scan_layers",))
         # Shape-bucketed prefill: pad each prompt to the smallest rung of a geometric
         # ladder so prefill compiles once per BUCKET instead of once per chunk count
         # (and the warmup manifest can enumerate the whole compile surface). Explicit
@@ -377,7 +535,20 @@ class ContinuousBatcher:
         self.bucket_hits = 0    # prompt admitted into an already-compiled bucket
         self.bucket_misses = 0  # first prompt of a bucket (compiles/loads its program)
         self._buckets_seen: set = set()
-        self.cache = init_cache(cfg, max_slots, max_len)
+        if self.paged:
+            if kv_pages is None:
+                kv_pages = max_slots * pages_for(max_len, self.page_size)
+            self.block_mgr = BlockManager(
+                int(kv_pages), self.page_size, max_slots, max_len
+            )
+            self.cache = llama.init_paged_cache(
+                cfg, max_slots, max_len, int(kv_pages), self.page_size
+            )
+            self.kv_page_bytes = self.cache_bytes() // int(kv_pages)
+        else:
+            self.block_mgr = None
+            self.kv_page_bytes = 0
+            self.cache = init_cache(cfg, max_slots, max_len)
         self.tokens = np.zeros((max_slots,), np.int32)  # host-side; uploaded per decode
         self.positions = np.zeros((max_slots,), np.int32)  # next write slot per lane
         self.slot_req: list[Optional[Request]] = [None] * max_slots
@@ -393,6 +564,19 @@ class ContinuousBatcher:
         self._prefix_reg: "OrderedDict[bytes, object]" = OrderedDict()
         self.prefix_hits = 0
         self.prefix_misses = 0
+        # Prefix-eviction observability: LRU drops used to be silent, making
+        # "cache too small" indistinguishable from "cold key" in production stats.
+        # ``prefix_evictions`` counts drops; misses split into capacity misses (the
+        # key WAS registered and got evicted — remembered in a bounded key set) vs
+        # key misses (never seen). In paged mode eviction also releases the entry's
+        # page references (pages free when their refcount reaches zero).
+        self.prefix_evictions = 0
+        self.prefix_capacity_misses = 0
+        self.prefix_key_misses = 0
+        self._evicted_keys: "OrderedDict[bytes, bool]" = OrderedDict()
+        self._evicted_keys_cap = max(64, 8 * prefix_cache)
+        self.peak_active_slots = 0  # high-water concurrent lanes (bench: max
+        #                             concurrency actually reached at this KV budget)
         # Admission/eviction counters + the step-level telemetry pipeline
         # (``accelerate_tpu.telemetry.Telemetry``): when attached, every decode step
         # emits a serving record through the SAME sinks the train step uses —
@@ -420,13 +604,44 @@ class ContinuousBatcher:
         on top. ``tokens_per_step`` (emitted tokens per decode dispatch — >1 only with
         speculation accepting drafts) and ``spec_accept_rate`` (accepted/proposed
         drafts) are the speculative headline numbers serve-bench and bench rows
-        stamp; both are None before any decode step / proposal."""
+        stamp; both are None before any decode step / proposal.
+
+        Paged engines (``page_size > 0``) additionally report the page pool:
+        occupancy, ``kv_bytes_in_use``/``kv_bytes_total``, prefix-share refcounts
+        (``kv_shared_pages``) and alloc/free/COW/adopt/defer counters — the same
+        fields the ``serving.kv/v1`` telemetry record carries per step. Prefix-cache
+        eviction is observable in both layouts: ``prefix_evictions`` plus the
+        capacity-vs-key miss split."""
         active = sum(r is not None for r in self.slot_req)
         queue_wait_s = 0.0
         if self.queue:
             now = time.monotonic()
             queue_wait_s = max(0.0, now - min(r.enqueued_at for r in self.queue))
+        kv = {"paged": self.paged}
+        if self.paged:
+            ms = self.block_mgr.stats()
+            kv.update({
+                "page_size": self.page_size,
+                "pages_total": ms["pages_total"],
+                "pages_free": ms["pages_free"],
+                "pages_in_use": ms["pages_in_use"],
+                "page_occupancy": ms["page_occupancy"],
+                "kv_page_bytes": self.kv_page_bytes,
+                "kv_bytes_in_use": ms["pages_in_use"] * self.kv_page_bytes,
+                "kv_bytes_total": ms["pages_total"] * self.kv_page_bytes,
+                "kv_shared_pages": ms["shared_pages"],
+                "kv_alloc_count": ms["alloc_count"],
+                "kv_free_count": ms["free_count"],
+                "kv_cow_count": ms["cow_count"],
+                "kv_adopt_count": ms["adopt_count"],
+                "kv_defer_count": ms["defer_count"],
+            })
         return {
+            **kv,
+            "peak_active_slots": self.peak_active_slots,
+            "prefix_evictions": self.prefix_evictions,
+            "prefix_capacity_misses": self.prefix_capacity_misses,
+            "prefix_key_misses": self.prefix_key_misses,
             "queued": len(self.queue),
             "queue_wait_s": queue_wait_s,
             "active_slots": active,
@@ -473,6 +688,29 @@ class ContinuousBatcher:
         if extra:
             record.update(extra)
         tel.emit(record)
+        if self.paged:
+            # Dedicated page-pool record: the serving-memory story as a first-class
+            # stream (pool occupancy, bytes, sharing, churn) — dashboards watch this
+            # without parsing the full engine counter record.
+            ms = self.block_mgr.stats()
+            tel.emit({
+                "schema": "accelerate_tpu.telemetry.serving.kv/v1",
+                "telemetry_rev": TELEMETRY_REV,
+                "page_size": self.page_size,
+                "pages_total": ms["pages_total"],
+                "pages_in_use": ms["pages_in_use"],
+                "page_occupancy": ms["page_occupancy"],
+                "kv_bytes_in_use": ms["pages_in_use"] * self.kv_page_bytes,
+                "kv_bytes_total": ms["pages_total"] * self.kv_page_bytes,
+                "kv_shared_pages": ms["shared_pages"],
+                "kv_alloc_count": ms["alloc_count"],
+                "kv_free_count": ms["free_count"],
+                "kv_cow_count": ms["cow_count"],
+                "kv_adopt_count": ms["adopt_count"],
+                "kv_defer_count": ms["defer_count"],
+                "prefix_entries": len(self._prefix_reg),
+                "prefix_evictions": self.prefix_evictions,
+            })
 
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
                eos_token_id: Optional[int] = None,
@@ -484,14 +722,50 @@ class ContinuousBatcher:
         drop the caller's limits). Temperature sampling needs ``rng``. ``on_token``
         streams each generated token id as it is produced."""
         prompt, gen = normalize_submit(prompt, max_new_tokens, eos_token_id, gen, rng)
-        # The prompt's padded prefill width + generation budget must fit the cache;
-        # _plan_prefill picks the bucket (or chunked) layout and validates it.
-        self._plan_prefill(len(prompt), gen.max_new_tokens)
+        # The prompt's padded prefill width + generation budget must fit the cache
+        # (and, paged, the whole page pool): kv_demand runs _plan_prefill's layout
+        # validation and raises KVBudgetError for a request the pool could NEVER
+        # hold — deferring it would deadlock the FIFO queue forever.
+        self.kv_demand(len(prompt), gen.max_new_tokens)
         req = Request(self._uid, prompt, gen, rng, on_token=on_token,
                       enqueued_at=time.monotonic())
         self._uid += 1
         self.queue.append(req)
         return req
+
+    def kv_demand(self, prompt_len: int, max_new: int) -> int:
+        """Cache-token cost of one request under THIS engine's layout — the number
+        the gateway's admission budget accounts.
+
+        Dense: the planned padded prefill width plus the generation budget (every
+        admitted token reserves a dense slot whether or not it is ever reached).
+        Paged: the PAGE-granular worst case — ``pages × page_size`` for the pages
+        covering prompt + budget — so admission prices real memory, not padded
+        maxima. Raises ``ValueError`` for unservable geometry (via
+        ``_plan_prefill``) and :class:`KVBudgetError` when the demand exceeds the
+        whole page pool."""
+        _, total = self._plan_prefill(prompt_len, max_new)
+        if self.paged:
+            return self.block_mgr.demand(total + max_new) * self.page_size
+        return total + max_new
+
+    def kv_capacity_tokens(self) -> int:
+        """Total cache-token capacity of this engine's KV layout (the denominator
+        for ``kv_demand``-priced admission): pool pages × page_size when paged,
+        max_slots × max_len dense."""
+        if self.paged:
+            return self.block_mgr.num_pages * self.page_size
+        return self.max_slots * self.max_len
+
+    def cache_bytes(self) -> int:
+        """Total bytes of the KV cache planes (page pool or dense rows, scale
+        planes included) — the ONE byte accounting behind ``kv_page_bytes``,
+        ``stats()``'s kv_bytes columns, and serve-bench's budget math, so they can
+        never disagree on what 'KV bytes' means."""
+        return sum(
+            leaf.size * leaf.dtype.itemsize
+            for leaf in jax.tree_util.tree_leaves(self.cache["layers"])
+        )
 
     def cancel(self, uid: int) -> bool:
         """Cooperatively withdraw a request by uid, wherever it is.
@@ -515,15 +789,24 @@ class ContinuousBatcher:
         for slot, req in enumerate(self.slot_req):
             if req is not None and req.uid == uid:
                 self.slot_req[slot] = None
+                self._release_lane(slot)
                 self.evicted_external += 1
                 return True
         return False
+
+    def _release_lane(self, slot: int) -> None:
+        """Return a freed lane's page references to the pool (paged mode; pages a
+        prefix entry still references survive). Dense lanes have nothing to do —
+        their cache row is overwritten at the next admit."""
+        if self.paged:
+            self.block_mgr.release_slot(slot)
 
     def step(self) -> list[Request]:
         """Admit queued requests, then advance every active slot: one token each
         (``spec_k == 0``) or a verified 1..spec_k+1-token prefix each (speculative)."""
         finished_at_admit = self._admit()
         active = [i for i, r in enumerate(self.slot_req) if r is not None]
+        self.peak_active_slots = max(self.peak_active_slots, len(active))
         if not active:
             if finished_at_admit:
                 self._emit_telemetry()  # admissions alone still move the counters
@@ -539,10 +822,17 @@ class ContinuousBatcher:
 
     def _plain_step(self, active: list[int]) -> list[Request]:
         """Classic decode: ONE compiled dispatch advances every lane one token."""
-        greedy, logits, self.cache = self._decode_fn(
-            self.params, self.cache, jnp.asarray(self.tokens),
-            jnp.asarray(self.positions), cfg=self.cfg,
-        )
+        if self.paged:
+            greedy, logits, self.cache = self._decode_paged_fn(
+                self.params, self.cache, jnp.asarray(self.block_mgr.tables),
+                jnp.asarray(self.tokens), jnp.asarray(self.positions),
+                cfg=self.cfg, page_size=self.page_size,
+            )
+        else:
+            greedy, logits, self.cache = self._decode_fn(
+                self.params, self.cache, jnp.asarray(self.tokens),
+                jnp.asarray(self.positions), cfg=self.cfg,
+            )
         greedy_host = np.asarray(greedy)
         finished = []
         # Every lane wrote one slot (idle lanes too — static shapes); clamp so an idle
@@ -566,6 +856,7 @@ class ContinuousBatcher:
                 req.done = True
                 finished.append(req)
                 self.slot_req[i] = None  # slot frees; cache row overwritten on next admit
+                self._release_lane(i)
         self.decode_steps += 1
         self.decode_tokens += len(active)
         return finished
@@ -590,10 +881,17 @@ class ContinuousBatcher:
         seq = np.zeros((self.max_slots, T), np.int32)
         seq[:, 0] = self.tokens  # pending token: emitted last step, not yet written
         seq[:, 1:] = proposals
-        greedy, logits, self.cache = self._spec_verify_fn(
-            self.params, self.cache, jnp.asarray(seq),
-            jnp.asarray(self.positions), cfg=self.cfg,
-        )
+        if self.paged:
+            greedy, logits, self.cache = self._spec_verify_paged_fn(
+                self.params, self.cache, jnp.asarray(self.block_mgr.tables),
+                jnp.asarray(seq), jnp.asarray(self.positions),
+                cfg=self.cfg, page_size=self.page_size,
+            )
+        else:
+            greedy, logits, self.cache = self._spec_verify_fn(
+                self.params, self.cache, jnp.asarray(seq),
+                jnp.asarray(self.positions), cfg=self.cfg,
+            )
         greedy_host = np.asarray(greedy)  # [B, T]
         finished = []
         step_tokens = step_accepted = 0
@@ -636,6 +934,7 @@ class ContinuousBatcher:
                 req.done = True
                 finished.append(req)
                 self.slot_req[i] = None  # slot frees; cache row overwritten on next admit
+                self._release_lane(i)
         self.positions = np.minimum(self.positions, self.max_len - 1)
         self.decode_steps += 1
         self.decode_tokens += step_tokens
@@ -739,24 +1038,60 @@ class ContinuousBatcher:
         that ``_plan_prefill`` can actually route a ``max_new_tokens``-budget
         request to, the first-chunk + chunk-append pair (the fallback for
         prompts/budgets no bucket fits — always part of the live surface), and
-        the per-slot row inserts. Returns warmup-manifest entries; empty when no
-        enabled compile cache is attached."""
+        the row-insert programs — per-slot scatters dense, the single
+        dynamic-slot page scatter (plus prefix gather/copy) paged. A paged
+        engine warms ITS surface; the manifest's page geometry records which
+        layout the cache directory is warm for. Returns warmup-manifest
+        entries; empty when no enabled compile cache is attached."""
         if self.compile_cache is None:
             return []
         entries = []
         lanes = jnp.zeros((self.max_slots,), jnp.int32)
-        # The plain decode step is warmed in BOTH modes: a spec-enabled replica only
-        # dispatches the verify, but warming decode keeps the same cache directory
-        # serving a spec_k=0 restart (toggling speculation off must not cost compiles).
-        entries.append(self._decode_fn.warm(
-            self.params, self.cache, lanes, lanes, cfg=self.cfg
-        ))
-        if self.spec_k:
-            seq = jnp.zeros((self.max_slots, self.spec_k + 1), jnp.int32)
-            entries.append(self._spec_verify_fn.warm(
-                self.params, self.cache, seq, lanes, cfg=self.cfg
+        if self.paged:
+            # Paged surface: the block-table-indirected decode/verify pair plus the
+            # dynamic-slot page scatter (ONE program for every slot/row — the table
+            # made the lane index data) and, with prefix caching, the page gather +
+            # partial-page copy. Prefill programs below are layout-shared with dense.
+            tables = jnp.asarray(self.block_mgr.tables)
+            entries.append(self._decode_paged_fn.warm(
+                self.params, self.cache, tables, lanes, lanes,
+                cfg=self.cfg, page_size=self.page_size,
             ))
-            entries.extend(self.drafter.warm_programs(self, max_new_tokens))
+            if self.spec_k:
+                seq = jnp.zeros((self.max_slots, self.spec_k + 1), jnp.int32)
+                entries.append(self._spec_verify_paged_fn.warm(
+                    self.params, self.cache, tables, seq, lanes,
+                    cfg=self.cfg, page_size=self.page_size,
+                ))
+                entries.extend(self.drafter.warm_programs(self, max_new_tokens))
+            write_ids = jnp.zeros((self.block_mgr.max_pages,), jnp.int32)
+            row0 = init_cache(self.cfg, 1, self.max_len)
+            entries.append(self._insert_paged_fn.warm(
+                self.cache, row0, write_ids, 0,
+                page_size=self.page_size, scan_layers=self.cfg.scan_layers,
+            ))
+            if self.prefix_cache_size:
+                entries.append(self._gather_row_fn.warm(
+                    self.cache, write_ids, 0,
+                    page_size=self.page_size, scan_layers=self.cfg.scan_layers,
+                ))
+                entries.append(self._copy_page_fn.warm(
+                    self.cache, 0, 0, scan_layers=self.cfg.scan_layers,
+                ))
+        else:
+            # The plain decode step is warmed for spec engines too: a spec-enabled
+            # replica only dispatches the verify, but warming decode keeps the same
+            # cache directory serving a spec_k=0 restart (toggling speculation off
+            # must not cost compiles).
+            entries.append(self._decode_fn.warm(
+                self.params, self.cache, lanes, lanes, cfg=self.cfg
+            ))
+            if self.spec_k:
+                seq = jnp.zeros((self.max_slots, self.spec_k + 1), jnp.int32)
+                entries.append(self._spec_verify_fn.warm(
+                    self.params, self.cache, seq, lanes, cfg=self.cfg
+                ))
+                entries.extend(self.drafter.warm_programs(self, max_new_tokens))
         if self.prompt_buckets is not None and not self.prefix_cache_size:
             # Only buckets a request with this generation budget can land in —
             # a bucket with b + max_new > max_len is unreachable via _plan_prefill.
@@ -796,12 +1131,13 @@ class ContinuousBatcher:
                 entries.append(self._prefill_chunk_fn.warm(
                     self.params, row, mask, row_cache, cfg=self.cfg
                 ))
-        if row_cache is None:
-            row_cache = init_cache(self.cfg, 1, self.max_len)
-        for slot in range(self.max_slots):
-            entries.append(self._insert_row_fn.warm(
-                self.cache, row_cache, slot=slot, scan_layers=self.cfg.scan_layers
-            ))
+        if not self.paged:
+            if row_cache is None:
+                row_cache = init_cache(self.cfg, 1, self.max_len)
+            for slot in range(self.max_slots):
+                entries.append(self._insert_row_fn.warm(
+                    self.cache, row_cache, slot=slot, scan_layers=self.cfg.scan_layers
+                ))
         return entries
 
     # ------------------------------------------------------------------ internals
@@ -836,7 +1172,10 @@ class ContinuousBatcher:
             # max_new_tokens == 1), freeing the slot for the next queued request — hence
             # the inner loop per slot, and such requests are reported like any other.
             while self.slot_req[slot] is None and self.queue:
-                req = self.queue.popleft()
+                # PEEK, don't pop: a paged admission can defer on pool pressure, and
+                # the head request must keep its place (FIFO — later arrivals never
+                # jump a request waiting for pages).
+                req = self.queue[0]
                 # ONE plan decision per admission, threaded to the engine prefill AND
                 # the drafter — the draft cache layout must mirror the engine row's,
                 # so the two must never derive it independently.
@@ -844,16 +1183,18 @@ class ContinuousBatcher:
                     None if self.prefix_cache_size
                     else self._plan_prefill(len(req.prompt), req.gen.max_new_tokens)
                 )
-                row_cache, greedy_dev, logits_dev, prefill_len = self._prefill(
-                    req.prompt, req.gen.max_new_tokens, plan
-                )
+                prefilled = self._prefill_into_slot(slot, req, plan)
+                if prefilled is None:
+                    # Page pool exhausted: every admission waits until lanes finish
+                    # and free pages (the defer counter moved). Nothing was consumed.
+                    return finished
+                self.queue.popleft()
+                greedy_dev, logits_dev, prefill_len = prefilled
                 first = (
                     int(np.asarray(greedy_dev)[0])       # fused on-device argmax (4 bytes)
                     if req.gen.temperature <= 0.0
                     else req._sample(logits_dev[0])
                 )
-                # graftlint: disable=recompile-hazard(slot indexes a compile-time cache row; at most max_slots variants, admission-time only)
-                self.cache = self._insert_row_fn(self.cache, row_cache, slot=slot, scan_layers=self.cfg.scan_layers)
                 if self.drafter is not None:
                     # Same lane, same padded layout: the draft cache row must mirror
                     # the engine row so engine positions index both.
@@ -870,8 +1211,212 @@ class ContinuousBatcher:
                     req.done = True
                     finished.append(req)
                     self.slot_req[slot] = None
+                    self._release_lane(slot)
                     self.evicted += 1  # finished AT admission still cycled the slot
         return finished
+
+    def _prefill_into_slot(self, slot: int, req: Request, plan):
+        """Run one request's prefill and land its KV in lane ``slot`` →
+        ``(greedy_dev, logits_dev, prefill_len)``, or None when a paged admission
+        must defer on pool pressure (nothing consumed; the request stays queued).
+
+        Dense: the historical path — single-row prefill, compiled per-slot row
+        scatter. Paged: allocate pages (adopting refcounted shared-prefix pages on a
+        registry hit), prefill the SAME dense row (identical compute → identical
+        tokens), scatter it into the owned pages through the write-id map, then
+        register this prompt's prefixes as page lists."""
+        if not self.paged:
+            row_cache, greedy_dev, logits_dev, prefill_len = self._prefill(
+                req.prompt, req.gen.max_new_tokens, plan
+            )
+            # graftlint: disable=recompile-hazard(slot indexes a compile-time cache row; at most max_slots variants, admission-time only)
+            self.cache = self._insert_row_fn(self.cache, row_cache, slot=slot, scan_layers=self.cfg.scan_layers)
+            return greedy_dev, logits_dev, prefill_len
+        return self._prefill_into_slot_paged(slot, req, plan)
+
+    # ---------------------------------------------------------------- paged admission
+    def _prefill_into_slot_paged(self, slot: int, req: Request, plan):
+        mgr = self.block_mgr
+        ps = self.page_size
+        max_new = req.gen.max_new_tokens
+        hit_len, entry = 0, None
+        lookup_chunks = 0
+        if self.prefix_cache_size:
+            bucket = self.prompt_bucket
+            n_chunks = max(1, -(-len(req.prompt) // bucket))
+            total = n_chunks * bucket
+            hit_len, entry, lookup_chunks = self._lookup_prefix_paged(
+                req.prompt, n_chunks
+            )
+        else:
+            _, total = plan
+        # Full pages of the shared prefix are ADOPTED (refcount++, read-only); a
+        # prefix boundary cutting a page mid-way re-materializes that partial page
+        # as an owned fresh one — copy-on-write at the divergence point (the row
+        # scatter below fills it, so no device copy runs on this direction).
+        adopted = [] if entry is None else list(entry.pages[: hit_len // ps])
+        cow_partial = hit_len > 0 and hit_len % ps != 0
+        n_tokens = total + max_new
+        # Pool pressure: the prefix registry is a CACHE and yields to live
+        # traffic — evict LRU entries (releasing their page references) before
+        # deferring. Without this, registry-held pages could starve admission
+        # FOREVER once every lane drains (deferral waits on lanes to free pages,
+        # and none are active). Last resort: the adopted entry itself yields and
+        # the request retries as a cold miss — the submit-time KVBudgetError
+        # bound guarantees the bare request fits an otherwise-empty pool.
+        while not mgr.can_admit(n_tokens, n_adopted=len(adopted)):
+            if self._evict_prefix_lru(keep=entry):
+                continue
+            if entry is not None:
+                hit_len, entry, adopted, cow_partial = 0, None, [], False
+                self._evict_prefix_lru()
+                continue
+            mgr.defer_count += 1
+            return None
+        # Count the prefix outcome only now, when this admission actually
+        # proceeds: a deferred request re-runs the lookup every step() while it
+        # waits, and counting there would inflate hits/misses N-fold under
+        # exactly the pool-pressure conditions these stats exist to diagnose.
+        # The count also reflects what was SERVED: an adoption dropped by the
+        # pressure loop above lands as a miss, not the hit it briefly found.
+        if lookup_chunks:
+            if entry is not None:
+                self.prefix_hits += 1
+                self._prefix_reg.move_to_end(req.prompt[:hit_len].tobytes())
+            else:
+                self._classify_prefix_miss(req.prompt, lookup_chunks)
+        if self.prefix_cache_size:
+            # hit_len == 0 and entry is None on a miss — the same call covers both.
+            row_cache, greedy_dev, logits_dev, prefill_len = self._prefill_prefix_paged(
+                req.prompt, hit_len, entry, n_chunks, total
+            )
+        else:
+            row_cache, greedy_dev, logits_dev, prefill_len = self._prefill(
+                req.prompt, max_new, plan
+            )
+        ids = mgr.admit(slot, n_tokens, adopted=adopted, cow_partial=cow_partial)
+        # Row scatter: sentinel out the adopted pages (never written) and everything
+        # past the row's own extent; decode writes continue directly into the
+        # remaining allocated pages.
+        n_adopted = len(adopted)
+        n_row_pages = pages_for(total, ps)
+        write_ids = np.full((mgr.max_pages,), mgr.SENTINEL, np.int32)
+        write_ids[n_adopted:n_row_pages] = ids[n_adopted:n_row_pages]
+        self.cache = self._insert_paged_fn(
+            self.cache, row_cache, jnp.asarray(write_ids), slot,
+            page_size=ps, scan_layers=self.cfg.scan_layers,
+        )
+        if self.prefix_cache_size:
+            self._register_prefixes_paged(slot, req.prompt)
+        return greedy_dev, logits_dev, prefill_len
+
+    def _lookup_prefix_paged(self, prompt: np.ndarray, n_chunks: int):
+        """Longest registered full-chunk prefix of ``prompt`` →
+        ``(hit length, entry, lookup_chunks)``.
+
+        Capped at ``n_chunks - 1`` chunks: the final chunk is always recomputed so
+        its logits exist (the dense path replays it from the shorter snapshot —
+        identical compute, without needing that shorter entry to still be live).
+        Counter-free and LRU-neutral: a deferred admission repeats this lookup
+        every step, so hit/miss accounting (and the LRU touch) happen at the ONE
+        point the admission proceeds (``_prefill_into_slot_paged``);
+        ``lookup_chunks`` > 0 tells the caller a countable lookup happened."""
+        bucket = self.prompt_bucket
+        full_chunks = min(len(prompt) // bucket, n_chunks - 1)
+        for k in range(full_chunks, 0, -1):
+            hit = self._prefix_reg.get(prompt[: k * bucket].tobytes())
+            if hit is not None:
+                return k * bucket, hit, full_chunks
+        return 0, None, full_chunks
+
+    def _classify_prefix_miss(self, prompt: np.ndarray, full_chunks: int) -> None:
+        """Count one prefix miss, split capacity (key was evicted) vs cold key."""
+        self.prefix_misses += 1
+        bucket = self.prompt_bucket
+        if any(
+            prompt[: k * bucket].tobytes() in self._evicted_keys
+            for k in range(full_chunks, 0, -1)
+        ):
+            self.prefix_capacity_misses += 1
+        else:
+            self.prefix_key_misses += 1
+
+    def _prefill_prefix_paged(self, prompt: np.ndarray, hit_len: int, entry,
+                              n_chunks: int, total: int):
+        """Right-aligned chunked prefill resuming from a page-list prefix entry.
+
+        On a hit, the entry's pages (full pages + the registry's immutable partial
+        boundary copy, if any) are gathered back into the dense row layout — a
+        bandwidth-only copy that skips the prefix's prefill FLOPs — and the
+        remaining chunks run the ordinary keep-alive chunk program. The caller
+        scatters the finished row into the lane's own pages."""
+        bucket = self.prompt_bucket
+        row = np.zeros((1, total), np.int32)
+        row[0, : len(prompt)] = prompt
+        mask = np.zeros((1, total), bool)
+        mask[0, : len(prompt)] = True
+        start = hit_len // bucket
+        cache = None
+        if entry is not None:
+            read_ids = np.full((self.block_mgr.max_pages,), self.block_mgr.SENTINEL,
+                               np.int32)
+            read_ids[: len(entry.pages)] = entry.pages
+            cache = self._gather_row_fn(
+                self.cache, jnp.asarray(read_ids), hit_len,
+                page_size=self.page_size, scan_layers=self.cfg.scan_layers,
+            )
+        logits = None
+        for c in range(start, n_chunks):
+            sl = slice(c * bucket, (c + 1) * bucket)
+            if cache is None:
+                logits, cache = self._prefill_full_logits_fn(
+                    self.params, jnp.asarray(row[:, sl]), jnp.asarray(mask[:, sl]),
+                    cfg=self.cfg, max_len=self.max_len,
+                )
+            else:
+                logits, cache = self._prefill_chunk_keep_fn(
+                    self.params, jnp.asarray(row[:, sl]), jnp.asarray(mask[:, sl]),
+                    cache, cfg=self.cfg,
+                )
+        last_col = (len(prompt) - 1) % bucket
+        last = logits[:, last_col, :]
+        greedy = jnp.argmax(last, axis=-1).astype(jnp.int32)
+        return cache, greedy, last, len(prompt)
+
+    def _register_prefixes_paged(self, slot: int, prompt: np.ndarray) -> None:
+        """Register every full-chunk prefix of ``prompt`` as a refcounted page list.
+
+        Unlike the dense registry (whole row-cache snapshots — max_len × layers
+        bytes per ENTRY), a paged entry is the page ids covering the prefix: full
+        pages are shared with the lane by refcount, and a boundary cutting a page
+        mid-way gets an immutable device COPY of just that page (the lane keeps
+        writing its own) — so N entries over one system prompt cost its pages once
+        plus at most one partial page each."""
+        mgr = self.block_mgr
+        ps = self.page_size
+        bucket = self.prompt_bucket
+        lane_ids = mgr.lane_pages(slot)
+        for c in range(1, len(prompt) // bucket + 1):
+            key = prompt[: c * bucket].tobytes()
+            if key in self._prefix_reg:
+                self._prefix_reg.move_to_end(key)
+                continue
+            p_len = c * bucket
+            n_full = p_len // ps
+            pages = [int(p) for p in lane_ids[:n_full]]
+            if p_len % ps:
+                dst = mgr.take_copy_page()
+                if dst is None:
+                    continue  # pool too tight for a registry copy — skip, not fail
+                self.cache = self._copy_page_fn(
+                    self.cache, int(lane_ids[n_full]), dst,
+                    scan_layers=self.cfg.scan_layers,
+                )
+                mgr.retain(pages)
+                pages = pages + [dst]
+            else:
+                mgr.retain(pages)
+            self._register_prefix(key, _PagedPrefix(np.asarray(pages, np.int32)))
 
     def _prefill(self, prompt: np.ndarray, max_new: int, plan=None):
         """Single-row prefill → (cache row, on-device greedy token [1], on-device
@@ -948,7 +1493,7 @@ class ContinuousBatcher:
                 self.prefix_hits += 1
                 break
         if cache is None and full_chunks:
-            self.prefix_misses += 1
+            self._classify_prefix_miss(prompt, full_chunks)
 
         logits = None
         for c in range(start, n_chunks):
@@ -1002,8 +1547,38 @@ class ContinuousBatcher:
             )
         return logits, cache
 
-    def _register_prefix(self, key: bytes, cache) -> None:
-        self._prefix_reg[key] = cache
+    def _register_prefix(self, key: bytes, value) -> None:
+        """Insert/refresh one prefix entry (dense row-cache snapshot, or a
+        ``_PagedPrefix`` page list) and enforce the LRU capacity — with the
+        eviction OBSERVABLE: each drop counts in ``prefix_evictions`` and the key
+        lands in a bounded evicted-key set so later misses on it report as
+        capacity misses, not cold keys. Paged entries release their page
+        references on eviction (pages free when nothing else holds them)."""
+        self._prefix_reg[key] = value
         self._prefix_reg.move_to_end(key)
         while len(self._prefix_reg) > self.prefix_cache_size:
-            self._prefix_reg.popitem(last=False)
+            self._evict_prefix_lru()
+
+    def _evict_prefix_lru(self, keep=None) -> bool:
+        """Evict the least-recently-used prefix entry (skipping ``keep``, the
+        entry an in-progress admission is adopting), with the drop OBSERVABLE:
+        counted in ``prefix_evictions`` and remembered in the bounded
+        evicted-key set so later misses on it classify as capacity misses.
+        Paged entries release their page references (pages free when nothing
+        else holds them). Returns False when nothing evictable remains."""
+        victim = None
+        for key in self._prefix_reg:  # OrderedDict: oldest first
+            if self._prefix_reg[key] is not keep:
+                victim = key
+                break
+        if victim is None:
+            return False
+        old = self._prefix_reg.pop(victim)
+        self.prefix_evictions += 1
+        self._evicted_keys[victim] = True
+        self._evicted_keys.move_to_end(victim)
+        while len(self._evicted_keys) > self._evicted_keys_cap:
+            self._evicted_keys.popitem(last=False)
+        if isinstance(old, _PagedPrefix):
+            self.block_mgr.release(old.pages)
+        return True

@@ -38,7 +38,7 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from ..generation import GenerationConfig
-from ..serving import normalize_submit
+from ..serving import KVBudgetError, normalize_submit
 from ..telemetry.slo import (
     GATEWAY_REQUEST_SCHEMA,
     GATEWAY_SLO_SCHEMA,
@@ -65,7 +65,8 @@ __all__ = [
 QUEUED = "queued"        # held by the scheduler policy
 RUNNING = "running"      # admitted into an engine slot
 DONE = "done"            # finished normally (EOS / max_new_tokens)
-REJECTED = "rejected"    # refused at admission (reason: queue_full/token_budget/unservable)
+REJECTED = "rejected"    # refused at admission (reason: queue_full/token_budget/
+#                          kv_budget/unservable)
 SHED = "shed"            # removed from the queue by overload shedding
 CANCELLED = "cancelled"  # withdrawn by cancel(uid) (reason says queued vs running)
 EVICTED = "evicted"      # lost its slot (preemption) with no retry budget left
@@ -206,15 +207,19 @@ class ServingGateway:
         self._all[greq.uid] = greq
         self.counters["submitted"] += 1
 
-        # Servability + cost: the engine's own prefill planner (bucket ladder /
-        # chunk layout) is the single source of shape truth — its padded width
-        # plus the generation budget is the cache-token cost the queue budget
-        # accounts. Unservable geometry is an admission refusal, not a crash.
+        # Servability + cost: the engine's own KV pricing (``kv_demand`` — the
+        # prefill planner's padded width + budget on a dense engine, PAGE-granular
+        # demand on a paged one) is the single source of memory truth, so the
+        # queue budget accounts what the cache will actually charge. Unservable
+        # geometry is an admission refusal, not a crash; a request whose demand
+        # exceeds the paged engine's whole page pool gets the machine-readable
+        # ``kv_budget`` reason (it could never be admitted, no matter the queue).
         try:
-            _, total = self.engine._plan_prefill(len(prompt), gen.max_new_tokens)
+            greq.cost = int(self.engine.kv_demand(len(prompt), gen.max_new_tokens))
+        except KVBudgetError as e:
+            return self._refuse(greq, now, "kv_budget", str(e))
         except ValueError as e:
             return self._refuse(greq, now, "unservable", str(e))
-        greq.cost = int(total) + int(gen.max_new_tokens)
 
         if not self._make_room(greq, now):
             return greq  # _make_room already marked it rejected

@@ -812,9 +812,45 @@ def _run_serving_rows(preset: str | None) -> int:
         spec_k=int(_os.environ.get("BENCH_SERVE_SPEC_K", "0")),
         spec_draft=_os.environ.get("BENCH_SERVE_DRAFTER", "ngram"),
         workload=_os.environ.get("BENCH_SERVE_WORKLOAD", "mixed"),
+        # Paged-KV rows: BENCH_SERVE_PAGE_SIZE=16 re-runs every policy on the
+        # paged engine (token-identical; rows stamp page-pool occupancy,
+        # kv_bytes_per_request and max_concurrent_at_fixed_mem).
+        page_size=int(_os.environ.get("BENCH_SERVE_PAGE_SIZE", "0")),
+        kv_pages=(int(_os.environ["BENCH_SERVE_KV_PAGES"])
+                  if _os.environ.get("BENCH_SERVE_KV_PAGES") else None),
     )
     for row in rows:
         print(json.dumps(row))
+    return 0
+
+
+def _run_paged_compare_row() -> int:
+    """Fixed-KV-budget dense-vs-paged artifact (``BENCH_PAGED=1``): one
+    ``run_paged_compare`` pass written to ``BENCH_PAGED.json`` (override with
+    ``BENCH_PAGED_OUT``) — max concurrency at fixed memory, decode tokens/s at
+    high occupancy, per-request KV bytes, prefix-hit memory cost."""
+    _os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from accelerate_tpu.commands.serve_bench import run_paged_compare
+
+    artifact = run_paged_compare(
+        requests=int(_os.environ.get("BENCH_PAGED_REQUESTS", "48")),
+        page_size=int(_os.environ.get("BENCH_PAGED_PAGE_SIZE", "16")),
+        budget_rows=int(_os.environ.get("BENCH_PAGED_BUDGET_ROWS", "2")),
+    )
+    out = _os.environ.get("BENCH_PAGED_OUT", "BENCH_PAGED.json")
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=2)
+    for row in artifact["rows"]:
+        print(json.dumps(row))
+    print(json.dumps({
+        "metric": "serve/paged_compare",
+        "concurrency_ratio": artifact["concurrency_ratio"],
+        "prefix_memory_ratio": artifact["prefix_memory_ratio"],
+        "kv_budget_bytes": artifact["kv_budget_bytes"],
+    }))
     return 0
 
 
@@ -831,6 +867,8 @@ def main():
     enable_compile_cache(_here)
 
     preset = os.environ.get("BENCH_PRESET")
+    if os.environ.get("BENCH_PAGED"):
+        return _run_paged_compare_row()
     if os.environ.get("BENCH_SERVE"):
         # Serving rows are a separate, self-contained mode: no train state, no
         # watchdog/OOM machinery — the gateway drains deterministically or raises.

@@ -54,7 +54,9 @@ MODULES = [
     ("accelerate_tpu.parallel.tp", "Tensor parallelism"),
     ("accelerate_tpu.parallel.pp", "Pipeline parallelism"),
     ("accelerate_tpu.parallel.sequence", "Sequence parallelism"),
+    ("accelerate_tpu.paged_kv", "Paged KV block manager"),
     ("accelerate_tpu.ops.flash_attention", "Flash attention"),
+    ("accelerate_tpu.ops.paged_attention", "Paged attention"),
     ("accelerate_tpu.ops.ring_attention", "Ring attention"),
     ("accelerate_tpu.ops.moe", "Mixture of experts"),
     ("accelerate_tpu.ops.fp8", "FP8"),

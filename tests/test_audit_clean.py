@@ -67,6 +67,13 @@ def test_default_enumeration_covers_the_warmup_surface(default_captures):
     assert "serving.spec_verify" in labels, labels
     assert "serving.draft.decode" in labels, labels
     assert "serving.draft.prefill" in labels, labels
+    # The paged-KV surface (ISSUE 7): the default sweep lowers the paged replica
+    # layout alongside the dense one — block-table decode/verify, the
+    # dynamic-slot page scatter, and the prefix gather/copy pair — so the empty
+    # ratchet baselines cover both layouts.
+    assert {"serving.decode_paged", "serving.spec_verify_paged",
+            "serving.insert_paged", "serving.gather_row_paged",
+            "serving.copy_page"} <= labels, labels
     # Every capture actually lowered: the StableHLO text parses a @main.
     for c in default_captures:
         assert "@main" in c.hlo_text, c.label

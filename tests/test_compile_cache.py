@@ -331,6 +331,78 @@ def test_spec_serving_compiles_once_and_second_run_zero():
     assert engine.stats()["spec_k"] == 2
 
 
+def test_paged_serving_second_varied_workload_compiles_zero():
+    """Paged-engine compile surface (ISSUE 7): per-request page allocation, block
+    tables, slot choice and pool occupancy are DATA — a second varied workload on
+    a paged engine (different prompts, lengths, budgets, lane churn) compiles
+    zero new programs. First-workload bound: one paged decode + one prefill per
+    touched bucket + ONE dynamic-slot page scatter (the paged insert needs no
+    per-slot variants)."""
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.serving import ContinuousBatcher
+
+    # Distinct geometry so no other serving test's executables are reused.
+    cfg = dataclasses.replace(
+        llama.CONFIGS["tiny"], dtype=jnp.float32, d_model=40, n_heads=2, n_kv_heads=2
+    )
+    params = llama.init_params(cfg)
+    buckets = (8, 16, 32)
+    engine = ContinuousBatcher(
+        params, cfg, max_slots=2, max_len=64, prompt_buckets=buckets, page_size=8
+    )
+    rng = np.random.default_rng(2)
+    mon = CompileMonitor().start()
+    try:
+        for n in (3, 5, 9, 12, 20, 30):
+            engine.submit(rng.integers(1, cfg.vocab_size, n).astype(np.int32),
+                          max_new_tokens=3)
+        engine.run()
+        if not mon.supported:
+            pytest.skip("this jax exposes no jax.monitoring API")
+        first_workload = mon.count
+        for n in (2, 7, 11, 19, 28, 31):
+            engine.submit(rng.integers(1, cfg.vocab_size, n).astype(np.int32),
+                          max_new_tokens=5)
+        engine.run()
+    finally:
+        mon.stop()
+    bound = len(buckets) + 1 + 1  # prefill/bucket + paged decode + page scatter
+    assert first_workload <= bound, (first_workload, bound)
+    assert mon.count == first_workload, (
+        f"second paged workload recompiled {mon.count - first_workload} programs"
+    )
+    assert engine.stats()["paged"] is True
+
+
+def test_warmup_enumerates_paged_programs(tmp_path):
+    """run_warmup(page_size=...) lists the paged decode/verify, the dynamic-slot
+    page scatter, and (with prefix_cache) the page gather + partial-page copy in
+    the manifest — and stamps the page geometry, so a cache directory is
+    auditable for which KV layout it is warm FOR."""
+    from accelerate_tpu.analysis.program import LowerOnlyCache
+    from accelerate_tpu.compile_cache.warmup import run_warmup
+
+    cache = LowerOnlyCache()
+    manifest = run_warmup(
+        cache=cache, manifest_path=str(tmp_path / "m.json"),
+        preset="smoke", batch_size=2, seq_len=16, train=False, eval_step=False,
+        serve=True, max_slots=2, max_len=128, max_new_tokens=4,
+        spec_k=2, spec_draft="ngram", page_size=24, prefix_cache=2,
+    )
+    assert manifest["page_size"] == 24
+    assert manifest["kv_pages"] == 2 * -(-128 // 24)
+    assert manifest["prefix_cache"] == 2
+    labels = {e["label"] for e in manifest["programs"]}
+    assert {"serving.decode_paged", "serving.spec_verify_paged",
+            "serving.insert_paged", "serving.gather_row_paged",
+            "serving.copy_page"} <= labels, labels
+    # paged args without serve would warm nothing — must be loud.
+    with pytest.raises(ValueError, match="serve"):
+        run_warmup(cache=LowerOnlyCache(), emit_manifest=False,
+                   preset="smoke", batch_size=2, seq_len=16, train=False,
+                   serve=False, page_size=8)
+
+
 def test_warmup_enumerates_spec_and_draft_programs(tmp_path):
     """run_warmup(spec_k=2, spec_draft='half') lists the fused verify AND the
     draft model's prefill/decode/insert programs in the manifest — a spec-enabled

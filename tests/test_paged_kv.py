@@ -1,0 +1,329 @@
+"""Paged KV plumbing: block manager (host), pool planes, and the Pallas kernel.
+
+The engine-level parity suite lives in tests/test_serving_paged.py; this file covers
+the pieces in isolation — free-list/refcount/COW accounting without jax, paged
+write/read round-trips against the dense planes, and the paged-attention kernel
+(interpret mode) against its jnp reference across GQA/quantized/window/softcap/T>1.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from accelerate_tpu.paged_kv import (
+    BlockManager,
+    KVBudgetError,
+    PagePoolExhausted,
+    pages_for,
+)
+
+
+# ------------------------------------------------------------------ block manager
+def test_pages_for_ceil():
+    assert pages_for(1, 8) == 1
+    assert pages_for(8, 8) == 1
+    assert pages_for(9, 8) == 2
+    assert pages_for(0, 8) == 0
+
+
+def test_admit_release_roundtrip():
+    mgr = BlockManager(num_pages=8, page_size=4, max_slots=2, max_len=32)
+    ids = mgr.admit(0, 10)  # 3 pages
+    assert len(ids) == 3 and mgr.pages_in_use == 3
+    assert (mgr.tables[0, :3] == ids).all()
+    assert (mgr.tables[0, 3:] == mgr.SENTINEL).all()
+    assert mgr.release_slot(0) == 3
+    assert mgr.pages_in_use == 0 and (mgr.tables[0] == mgr.SENTINEL).all()
+    # released pages are reusable
+    ids2 = mgr.admit(1, 32)  # 8 pages — the whole pool
+    assert len(ids2) == 8 and mgr.free_pages == 0
+
+
+def test_free_list_exhaustion():
+    mgr = BlockManager(num_pages=4, page_size=4, max_slots=3, max_len=32)
+    mgr.admit(0, 12)  # 3 pages
+    assert not mgr.can_admit(8)          # needs 2, has 1
+    assert mgr.can_admit(4)              # needs 1
+    with pytest.raises(PagePoolExhausted):
+        mgr.admit(1, 8)
+    # a request bigger than the whole pool is a budget error, not a wait
+    with pytest.raises(KVBudgetError):
+        mgr.demand(17)                   # 5 pages > 4
+    with pytest.raises(KVBudgetError):
+        mgr.can_admit(17)
+
+
+def test_double_admit_same_slot_rejected():
+    mgr = BlockManager(num_pages=4, page_size=4, max_slots=2, max_len=16)
+    mgr.admit(0, 4)
+    with pytest.raises(RuntimeError, match="still holds"):
+        mgr.admit(0, 4)
+
+
+def test_refcount_sharing_and_release():
+    """Registry retain/release: shared pages survive lane release and free only
+    when the last reference drops."""
+    mgr = BlockManager(num_pages=8, page_size=4, max_slots=2, max_len=32)
+    ids = mgr.admit(0, 16)               # 4 pages
+    shared = ids[:2]
+    mgr.retain(shared)                   # registry entry holds the first 2
+    assert mgr.shared_pages() == 2
+    assert mgr.release_slot(0) == 2      # only the unshared 2 freed
+    assert mgr.pages_in_use == 2
+    # an adopter increfs again; its release keeps the registry's pages live
+    mgr.admit(1, 16, adopted=list(shared))
+    assert mgr.shared_pages() == 2 and mgr.adopt_count == 2
+    mgr.release_slot(1)
+    assert mgr.pages_in_use == 2
+    assert mgr.release(shared) == 2      # registry eviction frees them
+    assert mgr.pages_in_use == 0
+
+
+def test_cow_accounting():
+    mgr = BlockManager(num_pages=8, page_size=4, max_slots=2, max_len=32)
+    ids = mgr.admit(0, 16)
+    mgr.retain(ids[:2])
+    # adoption across a mid-page divergence counts a COW re-materialization
+    mgr.release_slot(0)
+    mgr.admit(1, 16, adopted=list(ids[:1]), cow_partial=True)
+    assert mgr.cow_count == 1
+    # registry-side partial copy draws a fresh owned page and counts too
+    page = mgr.take_copy_page()
+    assert page is not None and mgr.refcount[page] == 1
+    assert mgr.cow_count == 2
+
+
+def test_stats_shape():
+    mgr = BlockManager(num_pages=4, page_size=8, max_slots=1, max_len=32)
+    s = mgr.stats()
+    for key in ("pages_total", "pages_free", "pages_in_use", "page_occupancy",
+                "shared_pages", "alloc_count", "free_count", "cow_count",
+                "adopt_count", "defer_count"):
+        assert key in s, key
+
+
+# ------------------------------------------------------------------ pool planes
+def test_paged_write_read_roundtrip_matches_dense():
+    """write_kv_paged + read_kv_paged reconstruct exactly what the dense planes
+    hold at the same logical positions — including int8 quantization (bit-identical
+    quantized values, same quant path)."""
+    from accelerate_tpu.models.common import (
+        kv_planes, paged_kv_planes, read_kv, read_kv_paged, write_kv,
+        write_kv_paged,
+    )
+
+    rng = np.random.default_rng(0)
+    B, C, K, hd, ps = 2, 24, 2, 8, 8
+    P = B * C // ps
+    for quantized in (False, True):
+        dense = kv_planes(B, C, K, hd, jnp.float32, quantized)
+        pool = paged_kv_planes(P, ps, K, hd, jnp.float32, quantized)
+        tables = np.arange(P, dtype=np.int32).reshape(B, C // ps)
+        positions = np.array([5, 11], np.int32)
+        val = jnp.asarray(rng.standard_normal((B, 1, K, hd)).astype(np.float32))
+        dense = write_kv(dense, "k", val, jnp.asarray(positions))
+        pages = jnp.asarray(tables[np.arange(B), positions // ps])[:, None]
+        offs = jnp.asarray(positions % ps)[:, None]
+        pool = write_kv_paged(pool, "k", val, pages, offs)
+        want = read_kv(dense, "k", jnp.float32)
+        got = read_kv_paged(pool, "k", jnp.asarray(tables), C, jnp.float32)
+        rows = np.arange(B)
+        assert np.array_equal(np.asarray(want)[rows, positions],
+                              np.asarray(got)[rows, positions]), quantized
+
+
+def test_paged_write_sentinel_drops():
+    """Writes through a SENTINEL table entry (unallocated logical page) must drop
+    instead of corrupting page 0 — the engine's stale-entry safety contract."""
+    from accelerate_tpu.models.common import paged_kv_planes, write_kv_paged
+
+    pool = paged_kv_planes(2, 4, 1, 4, jnp.float32, False)
+    val = jnp.ones((1, 1, 1, 4), jnp.float32)
+    out = write_kv_paged(pool, "k", val, jnp.full((1, 1), 2, jnp.int32),
+                         jnp.zeros((1, 1), jnp.int32))
+    assert float(jnp.abs(out["k"]).sum()) == 0.0
+
+
+# ------------------------------------------------------------------ Pallas kernel
+def _build_pool(rng, B, K, hd, ps, P, MP, lens, quantized):
+    from accelerate_tpu.models.common import paged_kv_planes, write_kv_paged
+
+    C = MP * ps
+    pool = paged_kv_planes(P, ps, K, hd, jnp.float32, quantized)
+    tables = np.full((B, MP), P, np.int32)
+    free = list(range(P))
+    valid = np.zeros((B, C), bool)
+    for b, L in enumerate(lens):
+        for j in range(pages_for(L, ps)):
+            tables[b, j] = free.pop()
+        valid[b, :L] = True
+    kv_k = rng.standard_normal((B, C, K, hd)).astype(np.float32)
+    kv_v = rng.standard_normal((B, C, K, hd)).astype(np.float32)
+    pos = np.arange(C)
+    pages = np.where(valid, tables[np.arange(B)[:, None],
+                                   np.minimum(pos // ps, MP - 1)], P)
+    offs = (pos % ps)[None, :].repeat(B, 0)
+    pool = {
+        **write_kv_paged(pool, "k", jnp.asarray(kv_k), jnp.asarray(pages),
+                         jnp.asarray(offs)),
+        **write_kv_paged(pool, "v", jnp.asarray(kv_v), jnp.asarray(pages),
+                         jnp.asarray(offs)),
+    }
+    return pool, jnp.asarray(tables), jnp.asarray(valid)
+
+
+@pytest.mark.parametrize("T", [1, 3])
+@pytest.mark.parametrize("quantized", [False, True])
+def test_kernel_matches_reference(T, quantized):
+    from accelerate_tpu.ops.paged_attention import (
+        paged_attention, paged_attention_reference,
+    )
+
+    rng = np.random.default_rng(0)
+    B, H, K, hd, ps, P, MP = 3, 4, 2, 16, 8, 10, 3
+    lens = np.array([5, 20, 11])
+    pool, tables, valid = _build_pool(rng, B, K, hd, ps, P, MP, lens, quantized)
+    q = jnp.asarray(rng.standard_normal((B, T, H, hd)).astype(np.float32))
+    positions = jnp.asarray((lens - T).astype(np.int32))
+    kw = dict(page_size=ps, sm_scale=hd ** -0.5)
+    ref = paged_attention_reference(q, pool, tables, positions, valid, **kw)
+    out = paged_attention(q, pool, tables, positions, valid, **kw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
+
+
+@pytest.mark.parametrize("window,softcap", [(7, 0.0), (0, 30.0), (5, 20.0)])
+def test_kernel_window_and_softcap(window, softcap):
+    from accelerate_tpu.ops.paged_attention import (
+        paged_attention, paged_attention_reference,
+    )
+
+    rng = np.random.default_rng(1)
+    B, H, K, hd, ps, P, MP = 2, 2, 1, 8, 8, 8, 4
+    lens = np.array([9, 29])
+    pool, tables, valid = _build_pool(rng, B, K, hd, ps, P, MP, lens, False)
+    q = jnp.asarray(rng.standard_normal((B, 1, H, hd)).astype(np.float32))
+    positions = jnp.asarray((lens - 1).astype(np.int32))
+    kw = dict(page_size=ps, sm_scale=0.25, window=window, softcap=softcap)
+    ref = paged_attention_reference(q, pool, tables, positions, valid, **kw)
+    out = paged_attention(q, pool, tables, positions, valid, **kw)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-6)
+
+
+def test_reference_matches_dense_attention_exactly():
+    """The gather fallback is BITWISE the dense cached-attention math on the
+    occupied slots — the foundation of the engine-level paged/dense parity."""
+    import dataclasses
+
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.ops.paged_attention import gather_pages
+
+    cfg = dataclasses.replace(llama.CONFIGS["tiny"], dtype=jnp.float32)
+    rng = np.random.default_rng(2)
+    K, hd = cfg.n_kv_heads, cfg.head_dim
+    B, ps, MP, P = 2, 8, 3, 6
+    C = MP * ps
+    lens = np.array([7, 19])
+    pool, tables, valid = _build_pool(rng, B, K, hd, ps, P, MP, lens, False)
+    q = jnp.asarray(rng.standard_normal((B, 1, cfg.n_heads, hd)).astype(np.float32))
+    positions = jnp.asarray((lens - 1).astype(np.int32))
+    ck = gather_pages(pool, "k", tables, C, jnp.float32)
+    cv = gather_pages(pool, "v", tables, C, jnp.float32)
+    got = llama._attention_cached(q, ck, cv, positions[:, None], valid, cfg)
+    # dense layout of the same values
+    dense_k = np.zeros((B, C, K, hd), np.float32)
+    dense_v = np.zeros((B, C, K, hd), np.float32)
+    dense_k[np.asarray(valid)] = np.asarray(ck)[np.asarray(valid)]
+    dense_v[np.asarray(valid)] = np.asarray(cv)[np.asarray(valid)]
+    want = llama._attention_cached(
+        q, jnp.asarray(dense_k), jnp.asarray(dense_v), positions[:, None], valid, cfg
+    )
+    assert np.array_equal(np.asarray(got)[:, 0], np.asarray(want)[:, 0])
+
+
+def test_forward_slots_paged_bitwise_dense():
+    """llama.forward_slots_paged == forward_slots bitwise on CPU (gather path),
+    T = 1 and T = 3, fp32 — the model-layer parity contract."""
+    import dataclasses
+
+    from accelerate_tpu.models import llama
+
+    cfg = dataclasses.replace(llama.CONFIGS["tiny"], dtype=jnp.float32)
+    params = llama.init_params(cfg, jax.random.PRNGKey(0))
+    B, max_len, ps = 2, 32, 8
+    MP = max_len // ps
+    dense = llama.init_cache(cfg, B, max_len)
+    paged = llama.init_paged_cache(cfg, B, max_len, B * MP, ps)
+    tables = np.arange(B * MP, dtype=np.int32).reshape(B, MP)
+    rng = np.random.default_rng(0)
+    pos = np.zeros((B,), np.int32)
+    for _ in range(4):
+        tok = rng.integers(1, cfg.vocab_size, (B, 1)).astype(np.int32)
+        ld, dense = llama.forward_slots(params, tok, dense, jnp.asarray(pos), cfg)
+        lp, paged = llama.forward_slots_paged(
+            params, tok, paged, jnp.asarray(tables), jnp.asarray(pos), cfg, ps)
+        assert np.array_equal(np.asarray(ld), np.asarray(lp))
+        pos += 1
+    seq = rng.integers(1, cfg.vocab_size, (B, 3)).astype(np.int32)
+    ld, _ = llama.forward_slots(params, seq, dense, jnp.asarray(pos), cfg)
+    lp, _ = llama.forward_slots_paged(
+        params, seq, paged, jnp.asarray(tables), jnp.asarray(pos), cfg, ps)
+    assert np.array_equal(np.asarray(ld), np.asarray(lp))
+
+
+def test_sliding_window_paged_bitwise_dense():
+    """Alternating banded/full layers (sliding_window + window_every) through the
+    paged layout: the shared forward must band-limit exactly the layers the dense
+    path bands — bitwise, both per-layer-loop and grouped-scan variants."""
+    import dataclasses
+
+    from accelerate_tpu.models import llama
+
+    base = dataclasses.replace(
+        llama.CONFIGS["tiny"], dtype=jnp.float32, sliding_window=8, window_every=2,
+    )
+    for scan in (False, True):
+        cfg = dataclasses.replace(base, scan_layers=scan)
+        params = llama.init_params(cfg, jax.random.PRNGKey(1))
+        B, max_len, ps = 2, 32, 8
+        MP = max_len // ps
+        dense = llama.init_cache(cfg, B, max_len)
+        paged = llama.init_paged_cache(cfg, B, max_len, B * MP, ps)
+        tables = np.arange(B * MP, dtype=np.int32).reshape(B, MP)
+        rng = np.random.default_rng(4)
+        pos = np.zeros((B,), np.int32)
+        for _ in range(12):  # run past the window so banding actually bites
+            tok = rng.integers(1, cfg.vocab_size, (B, 1)).astype(np.int32)
+            ld, dense = llama.forward_slots(params, tok, dense, jnp.asarray(pos), cfg)
+            lp, paged = llama.forward_slots_paged(
+                params, tok, paged, jnp.asarray(tables), jnp.asarray(pos), cfg, ps)
+            assert np.array_equal(np.asarray(ld), np.asarray(lp)), scan
+            pos += 1
+
+
+def test_gpt_forward_slots_paged_bitwise_dense():
+    """The gpt family shares the paged contract (cross-family drafts stay viable
+    on a paged engine)."""
+    import dataclasses
+
+    from accelerate_tpu.models import gpt
+
+    cfg = dataclasses.replace(
+        gpt.CONFIGS["tiny"] if "tiny" in gpt.CONFIGS else gpt.GPTConfig(
+            vocab_size=128, d_model=32, n_layers=2, n_heads=2, max_seq=64),
+        dtype=jnp.float32)
+    params = gpt.init_params(cfg, jax.random.PRNGKey(0))
+    B, max_len, ps = 2, 16, 4
+    MP = max_len // ps
+    dense = gpt.init_cache(cfg, B, max_len)
+    paged = gpt.init_paged_cache(cfg, B, max_len, B * MP, ps)
+    tables = np.arange(B * MP, dtype=np.int32).reshape(B, MP)
+    rng = np.random.default_rng(3)
+    pos = np.zeros((B,), np.int32)
+    for _ in range(3):
+        tok = rng.integers(1, cfg.vocab_size, (B, 1)).astype(np.int32)
+        ld, dense = gpt.forward_slots(params, tok, dense, jnp.asarray(pos), cfg)
+        lp, paged = gpt.forward_slots_paged(
+            params, tok, paged, jnp.asarray(tables), jnp.asarray(pos), cfg, ps)
+        assert np.array_equal(np.asarray(ld), np.asarray(lp))
+        pos += 1
