@@ -1406,21 +1406,27 @@ class Accelerator:
             if handler.on_trace_ready is not None and self.is_main_process:
                 handler.on_trace_ready(trace_dir)
 
-    def build_serving_gateway(self, engine, clock=None):
+    def build_serving_gateway(self, engine, clock=None, tracer=None):
         """Front a ``ContinuousBatcher`` with the SLO-aware request gateway
         (``serving_gateway.ServingGateway``), resolved from the state-resident
         ``GatewayConfig`` (``Accelerator(gateway_config=...)`` or
         ``ACCELERATE_GATEWAY`` env) and sharing this accelerator's telemetry
         pipeline. With the config disabled (the default) the engine is returned
         unchanged — callers drive one object either way (both expose
-        ``submit``/``step``/``run``/``stats``)."""
+        ``submit``/``step``/``run``/``stats``).
+
+        ``tracer`` threads a request-scoped ``telemetry.tracing.Tracer``
+        through gateway AND engine (the gateway hands it to an engine that has
+        none), so per-request spans cover the whole lifecycle
+        (docs/telemetry.md)."""
         config = self.state.gateway_config
         if not config.enabled:
             return engine
         from .serving_gateway import ServingGateway
 
         kwargs = {} if clock is None else {"clock": clock}
-        return ServingGateway(engine, config, telemetry=self.telemetry, **kwargs)
+        return ServingGateway(engine, config, telemetry=self.telemetry,
+                              tracer=tracer, **kwargs)
 
     @contextlib.contextmanager
     def join_uneven_inputs(self, joinables, even_batches: Optional[bool] = None):

@@ -15,6 +15,7 @@ from .recompile_hazard import RecompileHazardRule
 from .donation_safety import DonationSafetyRule
 from .dead_knob import DeadKnobRule
 from .pspec_mesh import PspecMeshMismatchRule
+from .telemetry_schema import TelemetrySchemaLiteralRule
 
 __all__ = ["all_rules", "rule_by_id"]
 
@@ -29,6 +30,7 @@ def all_rules():
         DonationSafetyRule(),
         DeadKnobRule(),
         PspecMeshMismatchRule(),
+        TelemetrySchemaLiteralRule(),
     ]
 
 

@@ -1,0 +1,78 @@
+"""telemetry-schema-literal: telemetry schema ids must come from the registry.
+
+Incident: ISSUE 8's schema-registry satellite found every serving emit site
+stamping its ``"schema"`` column from an inline string literal — four different
+files each spelling ``accelerate_tpu.telemetry.serving.*`` by hand. A typo'd
+stream name ships silently (consumers filter on exact ids), and nothing
+enumerated what a JSONL run directory could contain until
+``telemetry/schemas.py`` centralized the ids with required-key sets and a
+docs-drift gate. This rule keeps it that way: emitting a record with a bare
+``accelerate_tpu.telemetry.*`` string literal — or minting a schema-id constant
+outside the registry module — is a finding. Import the constant instead.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileUnit, Rule
+
+#: The one module allowed to spell telemetry schema ids as literals.
+REGISTRY_PATH = "accelerate_tpu/telemetry/schemas.py"
+
+#: Namespace the registry owns. Non-telemetry ids (bench artifact schemas,
+#: workload trace headers) are intentionally out of scope.
+_PREFIX = "accelerate_tpu.telemetry."
+
+
+def _is_schema_literal(node) -> bool:
+    return (
+        isinstance(node, ast.Constant)
+        and isinstance(node.value, str)
+        and node.value.startswith(_PREFIX)
+        and "/v" in node.value
+    )
+
+
+class TelemetrySchemaLiteralRule(Rule):
+    id = "telemetry-schema-literal"
+    severity = "error"
+    description = (
+        "telemetry record schema spelled as a string literal instead of a "
+        "registered constant from telemetry/schemas.py"
+    )
+
+    def check_file(self, unit: FileUnit):
+        if unit.is_test or unit.path == REGISTRY_PATH:
+            return
+        for node in ast.walk(unit.tree):
+            if isinstance(node, ast.Dict):
+                # {"schema": "accelerate_tpu.telemetry.…/v1", ...} at an emit site.
+                for key, value in zip(node.keys, node.values):
+                    if (
+                        isinstance(key, ast.Constant)
+                        and key.value == "schema"
+                        and _is_schema_literal(value)
+                    ):
+                        yield self.make(
+                            unit,
+                            value,
+                            f"record schema {value.value!r} is a bare string "
+                            "literal — import the registered constant from "
+                            "accelerate_tpu.telemetry.schemas (typo'd stream "
+                            "ids ship silently; the registry carries the "
+                            "required-key contract)",
+                        )
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                # X = "accelerate_tpu.telemetry.…/v1" outside the registry mints
+                # a parallel constant the registry (and its docs table) never
+                # sees — the un-enumerated-stream bug with extra steps.
+                value = node.value
+                if _is_schema_literal(value):
+                    yield self.make(
+                        unit,
+                        node,
+                        f"schema id {value.value!r} defined outside the "
+                        "registry — declare it in telemetry/schemas.py (with "
+                        "its required keys) and import it",
+                    )

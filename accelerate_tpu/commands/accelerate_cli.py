@@ -14,6 +14,7 @@ from .merge import merge_command_parser
 from .serve_bench import serve_bench_command_parser
 from .test import test_command_parser
 from .tpu import tpu_command_parser
+from .trace_report import trace_report_command_parser
 from .warmup import warmup_command_parser
 
 __all__ = ["main", "get_parser"]
@@ -36,6 +37,7 @@ def get_parser() -> argparse.ArgumentParser:
     serve_bench_command_parser(subparsers=subparsers)
     test_command_parser(subparsers=subparsers)
     tpu_command_parser(subparsers=subparsers)
+    trace_report_command_parser(subparsers=subparsers)
     warmup_command_parser(subparsers=subparsers)
     return parser
 

@@ -1,0 +1,217 @@
+"""``accelerate-tpu trace-report`` — reconstruct request timelines from trace spans.
+
+Reads a telemetry JSONL file (``TelemetryConfig.jsonl_dir``/telemetry.jsonl, or
+any file of records), keeps the ``accelerate_tpu.telemetry.trace.span/v1``
+records, groups them by ``trace_id`` and answers the question the aggregate SLO
+records cannot: **where did each request's latency go?**
+
+Per request, the span set decomposes end-to-end latency into:
+
+- ``queue_s`` — scheduler queue wait (every ``queue`` span; retry waits after a
+  preemption are the ``attempt > 0`` spans, reported separately as ``retry_s``)
+- ``prefill_s`` — admission prefill (bucket/chunk/prefix compute)
+- ``decode_s`` — decode rounds this request participated in
+- ``stall_s`` — time spent HOLDING a lane but not inside its own prefill/decode
+  spans: the host loop serving other requests' admissions — invisible in any
+  aggregate, and exactly the number the disaggregated-prefill design
+  (ROADMAP item 3) needs to justify itself
+- ``ttft_s`` — reconstructed from spans alone (``first_token.t1 − queue.t0``;
+  the gateway's first-token event reuses the clock read its own ``ttft_s``
+  derives from, so the reconstruction is exact — tested)
+
+The report aggregates p50/p95/p99 of each component over terminal requests
+(``telemetry.slo.latency_summary`` — the same percentile math the gateway
+stamps), a critical-path share per component, and terminal counts by status.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Dict, List, Optional
+
+__all__ = ["trace_report", "load_spans", "trace_report_command",
+           "trace_report_command_parser"]
+
+
+def trace_report_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = (
+        "Reconstruct per-request timelines and a critical-path latency breakdown "
+        "(queue / prefill / decode / stall / retry) from trace.span/v1 records."
+    )
+    if subparsers is not None:
+        parser = subparsers.add_parser("trace-report", description=description)
+    else:
+        parser = argparse.ArgumentParser(
+            "accelerate-tpu trace-report", description=description
+        )
+    parser.add_argument("jsonl", help="telemetry JSONL file containing trace spans")
+    parser.add_argument("--uid", type=int, default=None,
+                        help="print one request's full span timeline")
+    parser.add_argument("--timelines", type=int, default=0, metavar="N",
+                        help="also print the N slowest requests' timelines")
+    if subparsers is not None:
+        parser.set_defaults(func=trace_report_command)
+    return parser
+
+
+def load_spans(path: str) -> List[dict]:
+    """The trace.span/v1 records of one JSONL file (other records are skipped —
+    a telemetry run directory mixes streams by design)."""
+    from ..telemetry.schemas import TRACE_SPAN_SCHEMA
+
+    spans = []
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            if rec.get("schema") == TRACE_SPAN_SCHEMA:
+                spans.append(rec)
+    return spans
+
+
+def _reconstruct(spans: List[dict]) -> dict:
+    """One trace's component breakdown from its span set (times relative to the
+    trace's first queue-span start)."""
+    spans = sorted(spans, key=lambda s: (s["t0"], s["t1"]))
+    t_submit = min(s["t0"] for s in spans)
+    by_kind: Dict[str, List[dict]] = {}
+    for s in spans:
+        by_kind.setdefault(s["span"], []).append(s)
+
+    queue_first = [s for s in by_kind.get("queue", ()) if s.get("attempt", 0) == 0]
+    queue_retry = [s for s in by_kind.get("queue", ()) if s.get("attempt", 0) > 0]
+    prefill = by_kind.get("prefill", ())
+    decode = by_kind.get("decode", ())
+    first_token = by_kind.get("first_token", ())
+    terminal = by_kind.get("terminal", ())
+    admits = by_kind.get("admit", ())
+
+    queue_s = sum(s["dur_s"] for s in queue_first)
+    retry_s = sum(s["dur_s"] for s in queue_retry)
+    prefill_s = sum(s["dur_s"] for s in prefill)
+    decode_s = sum(s["dur_s"] for s in decode)
+    # TTFT from spans ALONE: first token instant minus submit instant.
+    ttft_s = (first_token[0]["t1"] - t_submit) if first_token else None
+    t_done = terminal[-1]["t1"] if terminal else max(s["t1"] for s in spans)
+    status = terminal[-1].get("status") if terminal else None
+    n_tokens = terminal[-1].get("n_tokens") if terminal else None
+    # Stall: lane-holding time not inside this request's own prefill/decode
+    # spans — the host loop was admitting/prefilling OTHER requests.
+    stall_s = None
+    if admits:
+        running = t_done - admits[0]["t0"] - retry_s
+        stall_s = max(0.0, running - prefill_s - decode_s)
+    tpot_s = None
+    if first_token and decode and n_tokens and n_tokens > 1:
+        tpot_s = max(0.0, decode[-1]["t1"] - first_token[0]["t1"]) / (n_tokens - 1)
+    out = {
+        "uid": spans[0]["uid"],
+        "trace_id": spans[0]["trace_id"],
+        "tenant": spans[0].get("tenant"),
+        "status": status,
+        "reason": terminal[-1].get("reason") if terminal else None,
+        "n_tokens": n_tokens,
+        "total_s": t_done - t_submit,
+        "queue_s": queue_s,
+        "retry_s": retry_s,
+        "prefill_s": prefill_s,
+        "decode_s": decode_s,
+        "stall_s": stall_s,
+        "ttft_s": ttft_s,
+        "tpot_s": tpot_s,
+        "retries": max((s.get("attempt", 0) for s in by_kind.get("queue", ())),
+                       default=0),
+        "spans": spans,
+    }
+    return out
+
+
+def trace_report(records: List[dict]) -> dict:
+    """Aggregate report over span records: per-component p50/p95/p99, critical-
+    path shares, terminal counts — plus the per-trace breakdowns under
+    ``"traces"`` (span lists stripped; use :func:`_reconstruct` for one trace's
+    raw timeline)."""
+    from ..telemetry.slo import latency_summary
+
+    by_trace: Dict[str, List[dict]] = {}
+    for rec in records:
+        tid = rec.get("trace_id")
+        if tid is not None:
+            by_trace.setdefault(tid, []).append(rec)
+    traces = [_reconstruct(spans) for spans in by_trace.values()]
+    traces.sort(key=lambda t: t["uid"])
+
+    done = [t for t in traces if t["status"] == "done"]
+    components = ("queue_s", "retry_s", "prefill_s", "decode_s", "stall_s")
+    breakdown = {
+        c: latency_summary([t[c] for t in done]) for c in components
+    }
+    totals = {c: sum(t[c] or 0.0 for t in done) for c in components}
+    grand = sum(totals.values())
+    by_status: Dict[str, int] = {}
+    for t in traces:
+        key = t["status"] or "unknown"
+        by_status[key] = by_status.get(key, 0) + 1
+    return {
+        "n_traces": len(traces),
+        "by_status": by_status,
+        "ttft": latency_summary([t["ttft_s"] for t in done]),
+        "tpot": latency_summary([t["tpot_s"] for t in done]),
+        "breakdown": breakdown,
+        "critical_path_share": {
+            c: round(totals[c] / grand, 4) if grand > 0 else None
+            for c in components
+        },
+        "traces": [
+            {k: v for k, v in t.items() if k != "spans"} for t in traces
+        ],
+    }
+
+
+def _print_timeline(trace: dict, out) -> None:
+    t0 = min(s["t0"] for s in trace["spans"])
+    print(f"-- uid={trace['uid']} trace={trace['trace_id']} "
+          f"status={trace['status']} tokens={trace['n_tokens']}", file=out)
+    for s in trace["spans"]:
+        attrs = {k: v for k, v in s.items()
+                 if k not in ("schema", "trace_id", "uid", "tenant", "span",
+                              "t0", "t1", "dur_s")}
+        print(f"  {s['t0'] - t0:10.4f}s +{s['dur_s']:.4f}s "
+              f"{s['span']:<12} {attrs}", file=out)
+
+
+def trace_report_command(args) -> int:
+    import sys
+
+    spans = load_spans(args.jsonl)
+    if not spans:
+        print(f"trace-report: no trace.span/v1 records in {args.jsonl}",
+              file=sys.stderr)
+        return 1
+    report = trace_report(spans)
+    if args.uid is not None:
+        mine = [s for s in spans if s["uid"] == args.uid]
+        if not mine:
+            print(f"trace-report: no spans for uid {args.uid}", file=sys.stderr)
+            return 1
+        _print_timeline(_reconstruct(mine), sys.stdout)
+        return 0
+    if args.timelines:
+        slowest = sorted(
+            (t for t in report["traces"] if t["status"] == "done"),
+            key=lambda t: -(t["total_s"] or 0.0),
+        )[: args.timelines]
+        by_trace: Dict[str, List[dict]] = {}
+        for s in spans:
+            by_trace.setdefault(s["trace_id"], []).append(s)
+        for t in slowest:
+            _print_timeline(_reconstruct(by_trace[t["trace_id"]]), sys.stdout)
+    summary = {k: v for k, v in report.items() if k != "traces"}
+    print(json.dumps(summary, indent=2))
+    return 0

@@ -262,9 +262,11 @@ def _emit_audit_telemetry(accelerator, summaries: list) -> None:
     telemetry = getattr(accelerator, "telemetry", None)
     if telemetry is None or not getattr(telemetry, "enabled", False):
         return
+    from ..telemetry.schemas import AUDIT_PROGRAM_SCHEMA
+
     for s in summaries:
         telemetry.emit({
-            "schema": "accelerate_tpu.telemetry.audit.program/v1",
+            "schema": AUDIT_PROGRAM_SCHEMA,
             "label": s["label"],
             "collectives": s["collectives"],
             "donation": s["donation"],

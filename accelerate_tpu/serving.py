@@ -67,6 +67,13 @@ from .generation import (
 from .models import llama
 from .models.llama import init_cache
 from .paged_kv import BlockManager, KVBudgetError, pages_for
+from .telemetry.schemas import (
+    SERVING_KV_SCHEMA,
+    SERVING_SCHEMA,
+    SERVING_SPEC_SCHEMA,
+    SERVING_THROUGHPUT_SCHEMA,
+)
+from .telemetry.slo import latency_summary
 from .utils.dataclasses import CompileCacheConfig
 
 __all__ = ["ContinuousBatcher", "KVBudgetError", "Request", "normalize_submit"]
@@ -421,7 +428,7 @@ class ContinuousBatcher:
                  prompt_bucket: int = 64, prefix_cache: int = 0, telemetry=None,
                  compile_cache=None, prompt_buckets=None, spec_k: int = 0,
                  drafter=None, spec_accept: str = "replay", page_size: int = 0,
-                 kv_pages: Optional[int] = None):
+                 kv_pages: Optional[int] = None, tracer=None):
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -582,6 +589,15 @@ class ContinuousBatcher:
         # emits a serving record through the SAME sinks the train step uses —
         # stats() stops being fire-and-forget.
         self.telemetry = telemetry
+        # Request-scoped tracing (``telemetry.tracing.Tracer``): when attached AND
+        # enabled, admission emits admit/prefill spans and every decode dispatch
+        # emits one span per active traced lane — disabled, the hot path pays the
+        # same two attribute reads as the telemetry check (tests/test_tracing.py).
+        self.tracer = tracer
+        # Per-request queue wait measured AT admission (submit → slot), so the
+        # bare-engine path reports the same latency percentiles the gateway does
+        # (bounded window; ``queue_wait_s`` keeps the oldest-queued age).
+        self.queue_waits: deque[float] = deque(maxlen=1024)
         self.admitted = 0   # requests that entered a slot (prefill ran)
         self.evicted = 0    # slot frees: finished (EOS/max_new_tokens) requests
         self.evicted_external = 0  # slot frees forced by evict() (deadline/cancel/preempt)
@@ -644,6 +660,7 @@ class ContinuousBatcher:
             "prefix_key_misses": self.prefix_key_misses,
             "queued": len(self.queue),
             "queue_wait_s": queue_wait_s,
+            "queue_wait": latency_summary(self.queue_waits),
             "active_slots": active,
             "max_slots": self.max_slots,
             "slot_occupancy": active / self.max_slots,
@@ -679,7 +696,7 @@ class ContinuousBatcher:
         from .telemetry import TELEMETRY_REV
 
         record = {
-            "schema": "accelerate_tpu.telemetry.serving/v1",
+            "schema": SERVING_SCHEMA,
             "telemetry_rev": TELEMETRY_REV,
             **self.stats(),
         }
@@ -694,8 +711,12 @@ class ContinuousBatcher:
             # without parsing the full engine counter record.
             ms = self.block_mgr.stats()
             tel.emit({
-                "schema": "accelerate_tpu.telemetry.serving.kv/v1",
+                "schema": SERVING_KV_SCHEMA,
                 "telemetry_rev": TELEMETRY_REV,
+                # Causality key: trace.span/v1 decode spans of the same request
+                # carry this step index, so a span joins to the pool state that
+                # step saw (same contract as serving.spec/v1 below).
+                "step": self.decode_steps,
                 "page_size": self.page_size,
                 "pages_total": ms["pages_total"],
                 "pages_in_use": ms["pages_in_use"],
@@ -822,6 +843,10 @@ class ContinuousBatcher:
 
     def _plain_step(self, active: list[int]) -> list[Request]:
         """Classic decode: ONE compiled dispatch advances every lane one token."""
+        tracer = self.tracer
+        tracing = tracer is not None and tracer.enabled  # the two-attr-read contract
+        t0 = tracer._clock() if tracing else 0.0
+        traced = [self.slot_req[i] for i in active] if tracing else ()
         if self.paged:
             greedy, logits, self.cache = self._decode_paged_fn(
                 self.params, self.cache, jnp.asarray(self.block_mgr.tables),
@@ -859,6 +884,16 @@ class ContinuousBatcher:
                 self._release_lane(i)
         self.decode_steps += 1
         self.decode_tokens += len(active)
+        if tracing:
+            # One span per traced lane, all sharing this dispatch's [t0, t1] and
+            # step index — the index joins these spans to the serving/kv records
+            # the same step emits.
+            t1 = tracer._clock()
+            for req in traced:
+                tracer.span(
+                    tracer.handle_for(req.uid), "decode", t0, t1,
+                    step=self.decode_steps, occupancy=len(active), tokens=1,
+                )
         return finished
 
     def _spec_step(self, active: list[int]) -> list[Request]:
@@ -874,6 +909,10 @@ class ContinuousBatcher:
         out-of-bounds draft write."""
         k = self.spec_k
         T = k + 1
+        tracer = self.tracer
+        tracing = tracer is not None and tracer.enabled  # the two-attr-read contract
+        t0 = tracer._clock() if tracing else 0.0
+        traced: list = []
         proposals = np.asarray(
             self.drafter.propose(self.slot_req, self.tokens, self.positions, k),
             np.int32,
@@ -919,9 +958,12 @@ class ContinuousBatcher:
                 emitted = emitted[: emitted.index(eos) + 1]
             # Accepted = emitted tokens that were draft proposals (the trailing
             # correction/bonus is the target's own, never a proposal credit).
-            step_accepted += sum(
+            accepted_i = sum(
                 1 for j, t in enumerate(emitted) if j < k and t == int(proposals[i, j])
             )
+            if tracing:
+                traced.append((req, len(emitted), accepted_i))
+            step_accepted += accepted_i
             step_tokens += len(emitted)
             self.tokens[i] = emitted[-1]
             self.positions[i] += len(emitted)
@@ -940,13 +982,24 @@ class ContinuousBatcher:
         self.decode_tokens += step_tokens
         self.spec_proposed += k * len(active)
         self.spec_accepted += step_accepted
+        if tracing:
+            t1 = tracer._clock()
+            for req, n_emitted, n_accepted in traced:
+                tracer.span(
+                    tracer.handle_for(req.uid), "decode", t0, t1,
+                    step=self.decode_steps, occupancy=len(active),
+                    tokens=n_emitted, proposed=k, accepted=n_accepted,
+                )
         tel = self.telemetry
         if tel is not None and tel.enabled:
             from .telemetry import TELEMETRY_REV
 
             tel.emit({
-                "schema": "accelerate_tpu.telemetry.serving.spec/v1",
+                "schema": SERVING_SPEC_SCHEMA,
                 "telemetry_rev": TELEMETRY_REV,
+                # Causality key shared with trace.span/v1 decode spans (and the
+                # serving.kv/v1 record) of this same dispatch.
+                "step": self.decode_steps,
                 "spec_k": k,
                 "active_slots": len(active),
                 "step_proposed": k * len(active),
@@ -1015,7 +1068,7 @@ class ContinuousBatcher:
             tokens_per_sec = n_tokens / dt if dt > 0 else float("inf")
             self._emit_telemetry(
                 {
-                    "schema": "accelerate_tpu.telemetry.serving.throughput/v1",
+                    "schema": SERVING_THROUGHPUT_SCHEMA,
                     "wall_s": round(dt, 6),
                     "tokens_generated": n_tokens,
                     "requests_finished": len(out),
@@ -1183,12 +1236,22 @@ class ContinuousBatcher:
                     None if self.prefix_cache_size
                     else self._plan_prefill(len(req.prompt), req.gen.max_new_tokens)
                 )
+                tracer = self.tracer
+                tracing = tracer is not None and tracer.enabled
+                if tracing:
+                    t_pf0 = tracer._clock()
+                    hits0 = self.prefix_hits
+                    cow0 = self.block_mgr.cow_count if self.paged else 0
+                    adopt0 = self.block_mgr.adopt_count if self.paged else 0
                 prefilled = self._prefill_into_slot(slot, req, plan)
                 if prefilled is None:
                     # Page pool exhausted: every admission waits until lanes finish
                     # and free pages (the defer counter moved). Nothing was consumed.
+                    if tracing:
+                        tracer.count_defer(req.uid)
                     return finished
                 self.queue.popleft()
+                self.queue_waits.append(max(0.0, time.monotonic() - req.enqueued_at))
                 greedy_dev, logits_dev, prefill_len = prefilled
                 first = (
                     int(np.asarray(greedy_dev)[0])       # fused on-device argmax (4 bytes)
@@ -1206,6 +1269,35 @@ class ContinuousBatcher:
                 req.tokens.append(int(first))
                 if req.on_token is not None:
                     req.on_token(int(first))
+                if tracing:
+                    # Span closes AFTER the first token is extracted and streamed:
+                    # the device sync that produces it is prefill cost the client
+                    # waits on, so queue.dur + prefill.dur reconstructs TTFT.
+                    handle = tracer.handle_for(req.uid)
+                    t_pf1 = tracer._clock()
+                    hit = self.prefix_hits > hits0
+                    # plan is None on a prefix-cache engine (_plan_prefill is
+                    # skipped): the path actually run is a prefix-snapshot
+                    # resume only when the registry hit — a cold prompt ran the
+                    # right-aligned chunked prefill.
+                    mode, width = plan if plan is not None else (
+                        "prefix" if hit else "chunk",
+                        max(1, -(-len(req.prompt) // self.prompt_bucket))
+                        * self.prompt_bucket,
+                    )
+                    tracer.event(
+                        handle, "admit", t=t_pf0, lane=slot,
+                        kv_defer_retries=handle.kv_defers if handle else 0,
+                    )
+                    tracer.span(
+                        handle, "prefill", t_pf0, t_pf1,
+                        mode=mode, width=int(width), prompt_len=len(req.prompt),
+                        prefix_hit=hit,
+                        cow=(self.block_mgr.cow_count - cow0) if self.paged else 0,
+                        adopted_pages=(
+                            (self.block_mgr.adopt_count - adopt0) if self.paged else 0
+                        ),
+                    )
                 hit_eos = req.gen.eos_token_id is not None and int(first) == req.gen.eos_token_id
                 if hit_eos or len(req.tokens) >= req.gen.max_new_tokens:
                     req.done = True

@@ -40,8 +40,28 @@ from .policies import (
     WfqPolicy,
     make_policy,
 )
+from .workload import (
+    GENERATORS,
+    WORKLOAD_TRACE_SCHEMA,
+    TraceRequest,
+    VirtualClock,
+    generate_workload,
+    load_trace,
+    replay_trace,
+    save_trace,
+    trace_hash,
+)
 
 __all__ = [
+    "GENERATORS",
+    "WORKLOAD_TRACE_SCHEMA",
+    "TraceRequest",
+    "VirtualClock",
+    "generate_workload",
+    "load_trace",
+    "replay_trace",
+    "save_trace",
+    "trace_hash",
     "ServingGateway",
     "GatewayRequest",
     "SchedulerPolicy",

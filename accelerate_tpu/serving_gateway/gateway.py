@@ -106,12 +106,15 @@ class GatewayRequest:
     retries_used: int = 0
     # SLO timestamps (gateway clock)
     t_submit: float = 0.0
+    t_enqueued: float = 0.0               # this attempt's queue entry (== t_submit
+    #                                       until a preemption retry requeues)
     t_admit: Optional[float] = None
     t_first_token: Optional[float] = None
     t_last_token: Optional[float] = None
     t_done: Optional[float] = None
     n_streamed: int = 0
     _engine_req: Optional[object] = dataclasses.field(default=None, repr=False)
+    _trace: Optional[object] = dataclasses.field(default=None, repr=False)
 
     # ------------------------------------------------------------ SLO metrics
     @property
@@ -151,12 +154,30 @@ class ServingGateway:
     object the engine takes (records share its sinks)."""
 
     def __init__(self, engine, config: Optional[GatewayConfig] = None,
-                 telemetry=None, clock: Callable[[], float] = time.monotonic):
+                 telemetry=None, clock: Callable[[], float] = time.monotonic,
+                 tracer=None):
         if config is None:
             config = GatewayConfig(enabled=True)
         self.engine = engine
         self.config = config
         self.telemetry = telemetry
+        # Request-scoped tracing (``telemetry.tracing``): the gateway OPENS the
+        # trace at submit (trace_id = gateway uid + monotonic start) and emits the
+        # scheduling-side spans (queue, shed, preempt/retry, terminal); the engine
+        # — handed the SAME tracer — emits the execution-side spans (admit,
+        # prefill, decode rounds) against the binding made at admission.
+        self.tracer = tracer
+        if tracer is not None:
+            if getattr(engine, "tracer", None) is None:
+                engine.tracer = tracer  # one tracer threads the whole lifecycle
+            # Spans must share the gateway's timeline: deadlines, ttft_s and
+            # every gateway-side span time come from this clock, and the engine
+            # stamps its prefill/decode spans off the tracer's. A tracer left on
+            # a different clock (e.g. default monotonic vs an injected virtual
+            # clock) would split one trace across two time domains. (A disabled
+            # tracer never reads its clock — leave it as built.)
+            if tracer.enabled:
+                tracer._clock = clock
         self._clock = clock
         self._policy = make_policy(config)
         self._uid = 0
@@ -201,11 +222,15 @@ class ServingGateway:
             deadline_at=None if deadline_s is None else now + float(deadline_s),
             tenant=tenant, on_token=on_token, on_retry=on_retry,
             max_retries=self.config.max_retries if max_retries is None else max_retries,
-            t_submit=now,
+            t_submit=now, t_enqueued=now,
         )
         self._uid += 1
         self._all[greq.uid] = greq
         self.counters["submitted"] += 1
+        if self.tracer is not None:
+            # Trace opens HERE — queue wait is client-visible latency, so the
+            # trace must start before admission control can refuse or defer.
+            greq._trace = self.tracer.start(greq.uid, tenant=tenant, t=now)
 
         # Servability + cost: the engine's own KV pricing (``kv_demand`` — the
         # prefill planner's padded width + budget on a dense engine, PAGE-granular
@@ -289,6 +314,8 @@ class ServingGateway:
             self._policy.remove(victim.uid)
             self._queued_cost -= victim.cost
             self.counters["shed"] += 1
+            if self.tracer is not None:
+                self.tracer.event(victim._trace, "shed", t=now, shed_for=greq.uid)
             self._finalize(victim, SHED, "overload_shed", now)
         return True
 
@@ -414,12 +441,28 @@ class ServingGateway:
         )
         greq._engine_req = ereq
         self._running[ereq.uid] = greq
+        tr = self.tracer
+        if tr is not None:
+            # Queue span covers THIS attempt's wait (t_enqueued, not t_submit:
+            # a retry's span must measure the re-queue wait alone, or
+            # trace-report's retry_s would re-count the first wait plus the
+            # pre-preemption running time) and closes at the scheduling
+            # decision; the engine-side binding lets prefill/decode spans
+            # attribute to this trace.
+            tr.span(greq._trace, "queue", greq.t_enqueued, now,
+                    attempt=greq.retries_used, outcome="admitted")
+            tr.bind_engine(greq._trace, ereq.uid)
 
     def _stream_cb(self, greq: GatewayRequest) -> Callable[[int], None]:
         def deliver(tok: int) -> None:
             t = self._clock()
             if greq.t_first_token is None:
                 greq.t_first_token = t
+                if self.tracer is not None:
+                    # The SAME clock read ttft_s derives from — trace-report's
+                    # reconstructed TTFT (first_token.t1 - queue.t0) equals the
+                    # gateway's to the digit.
+                    self.tracer.event(greq._trace, "first_token", t=t)
             greq.t_last_token = t
             greq.n_streamed += 1
             if greq.on_token is not None:
@@ -446,6 +489,10 @@ class ServingGateway:
                 break
             self.engine.evict_slot(victim._engine_req.uid)
             self._running.pop(victim._engine_req.uid, None)
+            if self.tracer is not None:
+                self.tracer.event(victim._trace, "preempt", t=now,
+                                  preempted_by=top.uid,
+                                  tokens_lost=len(victim._engine_req.tokens))
             # take(), not remove(): the preemptor is being SERVED — WFQ must
             # charge its tenant and advance the virtual clock, not refund it.
             self._policy.take(top.uid, now)
@@ -458,12 +505,17 @@ class ServingGateway:
                 victim.tokens = []
                 victim._engine_req = None
                 victim.t_admit = victim.t_first_token = victim.t_last_token = None
+                victim.t_enqueued = now  # the retry's queue wait starts HERE
                 victim.n_streamed = 0
                 if victim.on_retry is not None:
                     # Stream-reset signal: on_token is about to replay from the
                     # first token; without this a streaming consumer's transcript
                     # would contain the pre-eviction prefix twice.
                     victim.on_retry()
+                if self.tracer is not None and victim._trace is not None:
+                    victim._trace.attempt = victim.retries_used
+                    self.tracer.event(victim._trace, "retry", t=now,
+                                      attempt=victim.retries_used)
                 self._policy.push(victim)
                 self._queued_cost += victim.cost
             else:
@@ -483,6 +535,20 @@ class ServingGateway:
         greq.reason = reason
         greq.t_done = now
         greq._engine_req = None  # release the engine Request (and its prompt/cache refs)
+        tr = self.tracer
+        if tr is not None and greq._trace is not None:
+            if greq.t_admit is None:
+                # Still queued at its end: close this attempt's queue span
+                # (t_enqueued — the retry requeue time after a preemption) so
+                # every trace has one, whatever its fate.
+                tr.span(greq._trace, "queue", greq.t_enqueued, now,
+                        attempt=greq.retries_used, outcome=status)
+            tr.event(greq._trace, "terminal", t=now, status=status,
+                     reason=reason, n_tokens=len(greq.tokens),
+                     retries_used=greq.retries_used,
+                     queue_wait_s=greq.queue_wait_s, ttft_s=greq.ttft_s,
+                     tpot_s=greq.tpot_s)
+            tr.finish(greq._trace)
         self._terminal.append(greq)
         self._emit_request_record(greq)
         # Bounded history (TelemetryConfig.max_records analog): a long-running

@@ -28,6 +28,18 @@ from .core import STEP_RECORD_SCHEMA, Telemetry
 from .derived import PEAK_TFLOPS, derived_rates, peak_tflops
 from .memory import device_memory_stats
 from .profiler import ScheduledProfiler
+from .provenance import config_fingerprint, git_commit, provenance_stamp
+from .schemas import (
+    AUDIT_PROGRAM_SCHEMA,
+    SCHEMA_REGISTRY,
+    SERVING_KV_SCHEMA,
+    SERVING_SCHEMA,
+    SERVING_SPEC_SCHEMA,
+    SERVING_THROUGHPUT_SCHEMA,
+    TRACE_SPAN_SCHEMA,
+    registered_schemas,
+    validate_record,
+)
 from .slo import (
     ELASTIC_RESTART_SCHEMA,
     GATEWAY_REQUEST_SCHEMA,
@@ -39,6 +51,7 @@ from .slo import (
 )
 from .steady import SteadyStateDetector, TELEMETRY_REV
 from .timing import StepTimer, StepTiming, fence
+from .tracing import Tracer, TraceHandle
 
 __all__ = [
     "CompileMonitor",
@@ -50,6 +63,18 @@ __all__ = [
     "peak_tflops",
     "device_memory_stats",
     "ScheduledProfiler",
+    "config_fingerprint",
+    "git_commit",
+    "provenance_stamp",
+    "AUDIT_PROGRAM_SCHEMA",
+    "SCHEMA_REGISTRY",
+    "SERVING_KV_SCHEMA",
+    "SERVING_SCHEMA",
+    "SERVING_SPEC_SCHEMA",
+    "SERVING_THROUGHPUT_SCHEMA",
+    "TRACE_SPAN_SCHEMA",
+    "registered_schemas",
+    "validate_record",
     "ELASTIC_RESTART_SCHEMA",
     "GATEWAY_REQUEST_SCHEMA",
     "GATEWAY_SLO_SCHEMA",
@@ -62,4 +87,6 @@ __all__ = [
     "StepTimer",
     "StepTiming",
     "fence",
+    "Tracer",
+    "TraceHandle",
 ]

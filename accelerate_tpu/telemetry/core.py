@@ -22,13 +22,11 @@ from typing import Any, Callable, List, Optional
 from .compile_monitor import CompileMonitor, compile_label
 from .derived import derived_rates
 from .memory import device_memory_stats
+from .schemas import STEP_RECORD_SCHEMA
 from .steady import SteadyStateDetector, TELEMETRY_REV
 from .timing import StepTimer
 
 __all__ = ["Telemetry", "STEP_RECORD_SCHEMA"]
-
-#: Schema id stamped into every step record; bump on breaking column changes.
-STEP_RECORD_SCHEMA = "accelerate_tpu.telemetry.step/v1"
 
 #: Columns every step record carries (derived-rate and memory columns are
 #: best-effort: absent when their inputs are unknown on this backend/workload).

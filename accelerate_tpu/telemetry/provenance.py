@@ -1,0 +1,75 @@
+"""Artifact provenance: which code, config and workload produced this number?
+
+Every BENCH_*.json artifact and serve-bench row is a claim about a specific
+(commit, model config, backend, workload) tuple — but until this module nothing
+recorded the tuple, so two artifacts could silently disagree because they were
+built from different states. :func:`provenance_stamp` is the one shared stamp:
+
+- ``git_commit`` — HEAD of the repo the package runs from (None outside a
+  checkout; a dirty tree is flagged with ``-dirty``).
+- ``config_fingerprint`` — a content hash of the model config *under the current
+  backend environment*, reusing ``compile_cache.fingerprint`` (the same
+  jax/jaxlib/backend/topology/XLA_FLAGS facts that decide whether two compiled
+  artifacts are comparable decide whether two bench rows are).
+- ``jax``/``backend`` — the headline environment facts inlined for humans.
+
+Workload-trace replays additionally stamp the trace content hash
+(``serving_gateway.workload.trace_hash``) so a curve can be reproduced from the
+exact same arrival process, not a same-named file.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import subprocess
+from typing import Optional
+
+__all__ = ["git_commit", "config_fingerprint", "provenance_stamp"]
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def git_commit(root: str = _REPO_ROOT) -> Optional[str]:
+    """Short HEAD hash of the checkout at ``root`` (``-dirty`` suffixed when the
+    working tree differs), or None when ``root`` is not a git repo / git is
+    unavailable — artifacts built from a tarball honestly say so."""
+    try:
+        head = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=root, capture_output=True, text=True, timeout=10,
+        )
+        if head.returncode != 0:
+            return None
+        commit = head.stdout.strip()
+        dirty = subprocess.run(
+            ["git", "status", "--porcelain"],
+            cwd=root, capture_output=True, text=True, timeout=10,
+        )
+        if dirty.returncode == 0 and dirty.stdout.strip():
+            commit += "-dirty"
+        return commit or None
+    except (OSError, subprocess.SubprocessError):
+        return None
+
+
+def config_fingerprint(cfg=None, extra: str = "") -> str:
+    """Content hash of ``cfg`` (its repr — dataclass reprs enumerate every
+    field) under the current backend environment, via the compile cache's own
+    fingerprint so "same config" means the same thing for bench rows as it does
+    for cached executables. Works with ``cfg=None`` (environment-only hash)."""
+    from ..compile_cache.fingerprint import fingerprint
+
+    return fingerprint(repr(cfg), extra=extra)[:20]
+
+
+def provenance_stamp(cfg=None) -> dict:
+    """The provenance block bench.py / serve-bench stamp into every artifact."""
+    import jax
+
+    return {
+        "git_commit": git_commit(),
+        "config_fingerprint": config_fingerprint(cfg),
+        "jax": jax.__version__,
+        "backend": jax.default_backend(),
+    }

@@ -1,0 +1,289 @@
+"""The telemetry schema registry: every record schema id, in ONE place.
+
+Every record the pipeline emits carries a ``"schema"`` column naming its format
+(``accelerate_tpu.telemetry.<stream>/v<rev>``). Before this module those ids were
+string literals scattered across the emit sites — a typo'd stream name shipped
+silently, and nothing enumerated what a consumer could expect to find in a JSONL
+run directory. This registry is the single source of truth:
+
+- Every schema id is a **constant here** (emit sites import it; graftlint's
+  ``telemetry-schema-literal`` rule flags a bare string-literal schema anywhere
+  else in the library sources).
+- Each registration carries its **required key set** — the columns a consumer may
+  rely on unconditionally — plus the emitter and a one-line description.
+  :func:`validate_record` checks a record against its registration (tests pin
+  every emit site through it).
+- The schema table in ``docs/telemetry.md`` is **generated** from this registry
+  (:func:`schema_table_markdown`) and drift-gated by ``scripts/check.sh``
+  (``python -m accelerate_tpu.telemetry.schemas --check``; ``--write`` refreshes
+  the docs block).
+
+Stdlib-only by design: the registry must be importable from stripped CLI
+contexts (trace-report, the docs gate) without jax.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Mapping
+
+__all__ = [
+    "STEP_RECORD_SCHEMA",
+    "SERVING_SCHEMA",
+    "SERVING_THROUGHPUT_SCHEMA",
+    "SERVING_KV_SCHEMA",
+    "SERVING_SPEC_SCHEMA",
+    "GATEWAY_REQUEST_SCHEMA",
+    "GATEWAY_SLO_SCHEMA",
+    "ELASTIC_RESTART_SCHEMA",
+    "AUDIT_PROGRAM_SCHEMA",
+    "TRACE_SPAN_SCHEMA",
+    "RecordSchema",
+    "SCHEMA_REGISTRY",
+    "registered_schemas",
+    "validate_record",
+    "schema_table_markdown",
+]
+
+# --------------------------------------------------------------------- schema ids
+#: Per-step training/eval record (``Telemetry._step_end``); bump on breaking
+#: column changes.
+STEP_RECORD_SCHEMA = "accelerate_tpu.telemetry.step/v1"
+
+#: Per-decode-step serving engine counter record (``ContinuousBatcher``).
+SERVING_SCHEMA = "accelerate_tpu.telemetry.serving/v1"
+
+#: One aggregate per ``ContinuousBatcher.run(report_throughput=True)`` drain.
+SERVING_THROUGHPUT_SCHEMA = "accelerate_tpu.telemetry.serving.throughput/v1"
+
+#: Per-decode-step page-pool record (paged KV engines only).
+SERVING_KV_SCHEMA = "accelerate_tpu.telemetry.serving.kv/v1"
+
+#: Per-decode-step speculative-decoding record (``spec_k > 0`` engines only).
+SERVING_SPEC_SCHEMA = "accelerate_tpu.telemetry.serving.spec/v1"
+
+#: One record per gateway request reaching a terminal state (done/rejected/shed/
+#: expired/cancelled/evicted): uid, status, machine-readable reason, tenant,
+#: priority, queue_wait_s / ttft_s / tpot_s, tokens generated, deadline_met.
+GATEWAY_REQUEST_SCHEMA = "accelerate_tpu.telemetry.gateway.request/v1"
+
+#: Aggregate gateway summary: terminal counts by status plus the per-metric
+#: p50/p95/p99 blocks produced by ``telemetry.slo.slo_summary``.
+GATEWAY_SLO_SCHEMA = "accelerate_tpu.telemetry.gateway.slo/v1"
+
+#: Emitted by ``ElasticSupervisor`` on every gang restart (attempt index, the
+#: exit codes that triggered the teardown, the restart budget).
+ELASTIC_RESTART_SCHEMA = "accelerate_tpu.telemetry.elastic.restart/v1"
+
+#: One record per warmup-precompiled program: graftaudit collective inventory
+#: and donation effectiveness (``compile_cache.warmup``).
+AUDIT_PROGRAM_SCHEMA = "accelerate_tpu.telemetry.audit.program/v1"
+
+#: One span per request-lifecycle phase (``telemetry.tracing``): queue wait,
+#: admission, prefill, each decode round, retries/preemptions, terminal state —
+#: causally linked to the step/kv/spec records via the engine ``step`` index.
+TRACE_SPAN_SCHEMA = "accelerate_tpu.telemetry.trace.span/v1"
+
+
+# --------------------------------------------------------------------- registry
+@dataclasses.dataclass(frozen=True)
+class RecordSchema:
+    """One registered record format: id, the key set a consumer may rely on
+    unconditionally, who emits it, and what it is for. Emitters may add optional
+    columns freely (memory stats, derived rates, kind-specific span attrs);
+    required keys only ratchet UP within a ``/v<rev>``."""
+
+    schema: str
+    required: frozenset
+    emitter: str
+    description: str
+
+
+def _reg(schema: str, required, emitter: str, description: str) -> RecordSchema:
+    return RecordSchema(schema, frozenset(required) | {"schema"}, emitter, description)
+
+
+#: Every record format the pipeline emits, keyed by schema id.
+SCHEMA_REGISTRY: Dict[str, RecordSchema] = {
+    s.schema: s
+    for s in (
+        _reg(
+            STEP_RECORD_SCHEMA,
+            ("telemetry_rev", "step", "wall_s", "dispatch_s", "fence_s", "steady",
+             "warmup_steps_detected", "compiles_total", "compile_s_total",
+             "compiles_delta"),
+            "Telemetry._step_end",
+            "fenced per-step timing, steadiness, compile counters",
+        ),
+        _reg(
+            SERVING_SCHEMA,
+            ("telemetry_rev", "queued", "active_slots", "max_slots",
+             "slot_occupancy", "admitted", "evicted", "decode_steps",
+             "decode_tokens"),
+            "ContinuousBatcher.step",
+            "per-decode-step engine counters (queue, lanes, prefix cache)",
+        ),
+        _reg(
+            SERVING_THROUGHPUT_SCHEMA,
+            ("wall_s", "tokens_generated", "requests_finished", "tokens_per_sec"),
+            "ContinuousBatcher.run",
+            "aggregate tokens/s for one drained workload",
+        ),
+        _reg(
+            SERVING_KV_SCHEMA,
+            ("telemetry_rev", "step", "page_size", "pages_total", "pages_in_use",
+             "page_occupancy", "kv_bytes_in_use", "kv_bytes_total",
+             "kv_shared_pages", "kv_alloc_count", "kv_free_count", "kv_cow_count",
+             "kv_adopt_count", "kv_defer_count"),
+            "ContinuousBatcher.step (paged)",
+            "page-pool occupancy/bytes/sharing/churn per decode step",
+        ),
+        _reg(
+            SERVING_SPEC_SCHEMA,
+            ("telemetry_rev", "step", "spec_k", "active_slots", "step_proposed",
+             "step_accepted", "step_tokens", "proposed_total", "accepted_total"),
+            "ContinuousBatcher._spec_step",
+            "speculative proposal/acceptance per decode step",
+        ),
+        _reg(
+            GATEWAY_REQUEST_SCHEMA,
+            ("uid", "status", "reason", "tenant", "priority", "n_tokens",
+             "retries_used", "queue_wait_s", "ttft_s", "tpot_s", "deadline_met"),
+            "ServingGateway._finalize",
+            "one record per request reaching a terminal state",
+        ),
+        _reg(
+            GATEWAY_SLO_SCHEMA,
+            ("policy", "submitted", "admitted", "done", "rejected", "shed",
+             "cancelled", "expired", "evicted", "retried", "slo"),
+            "ServingGateway.emit_slo_record",
+            "aggregate SLO percentiles + admission accounting",
+        ),
+        _reg(
+            ELASTIC_RESTART_SCHEMA,
+            ("attempt", "attempts_used", "max_restarts", "exit_codes"),
+            "ElasticSupervisor",
+            "one record per gang restart",
+        ),
+        _reg(
+            AUDIT_PROGRAM_SCHEMA,
+            ("label", "collectives", "donation"),
+            "compile_cache.warmup",
+            "per-program graftaudit inventory (collectives, donation)",
+        ),
+        _reg(
+            TRACE_SPAN_SCHEMA,
+            ("trace_id", "uid", "span", "t0", "t1", "dur_s"),
+            "telemetry.tracing.Tracer",
+            "request-scoped lifecycle span (queue/admit/prefill/decode/terminal)",
+        ),
+    )
+}
+
+
+def registered_schemas() -> List[str]:
+    """Every registered schema id, sorted."""
+    return sorted(SCHEMA_REGISTRY)
+
+
+def validate_record(record: Mapping) -> List[str]:
+    """Problems with one record against its registration (empty = valid):
+    unknown/missing schema id, or registered required keys the record lacks."""
+    schema = record.get("schema")
+    if schema is None:
+        return ["record has no 'schema' key"]
+    reg = SCHEMA_REGISTRY.get(schema)
+    if reg is None:
+        return [f"unregistered schema {schema!r} (register it in telemetry/schemas.py)"]
+    missing = sorted(reg.required - set(record))
+    return [f"{schema}: missing required keys {missing}"] if missing else []
+
+
+# ------------------------------------------------------------------- docs drift
+#: Markers bounding the generated block in docs/telemetry.md.
+_DOCS_BEGIN = "<!-- BEGIN GENERATED SCHEMA TABLE (python -m accelerate_tpu.telemetry.schemas --write) -->"
+_DOCS_END = "<!-- END GENERATED SCHEMA TABLE -->"
+
+
+def schema_table_markdown() -> str:
+    """The generated registry table (including its drift-gate markers)."""
+    lines = [
+        _DOCS_BEGIN,
+        "| schema | emitter | required keys | purpose |",
+        "|---|---|---|---|",
+    ]
+    for sid in registered_schemas():
+        reg = SCHEMA_REGISTRY[sid]
+        keys = ", ".join(f"`{k}`" for k in sorted(reg.required - {"schema"}))
+        lines.append(f"| `{sid}` | {reg.emitter} | {keys} | {reg.description} |")
+    lines.append(_DOCS_END)
+    return "\n".join(lines) + "\n"
+
+
+def _docs_path() -> str:
+    import os
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    return os.path.join(root, "docs", "telemetry.md")
+
+
+def docs_table_is_fresh(path: str = None) -> bool:
+    """True when docs/telemetry.md's generated block matches this registry."""
+    return _splice_docs(path or _docs_path(), write=False)
+
+
+def write_docs_table(path: str = None) -> None:
+    """Refresh docs/telemetry.md's generated block in place."""
+    _splice_docs(path or _docs_path(), write=True)
+
+
+def _splice_docs(path: str, write: bool) -> bool:
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    begin = text.find(_DOCS_BEGIN)
+    end = text.find(_DOCS_END)
+    if begin < 0 or end < 0:
+        raise RuntimeError(
+            f"{path} lacks the generated schema-table markers "
+            f"({_DOCS_BEGIN!r} ... {_DOCS_END!r})"
+        )
+    end += len(_DOCS_END) + 1  # the block's trailing newline
+    fresh = text[:begin] + schema_table_markdown() + text[end:]
+    if write:
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(fresh)
+        return True
+    return fresh == text
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        "python -m accelerate_tpu.telemetry.schemas",
+        description="Telemetry schema registry: list, check or regenerate the "
+        "generated table in docs/telemetry.md.",
+    )
+    parser.add_argument("--check", action="store_true",
+                        help="exit 1 when the docs table drifted from the registry")
+    parser.add_argument("--write", action="store_true",
+                        help="rewrite the docs table from the registry")
+    args = parser.parse_args(argv)
+    if args.write:
+        write_docs_table()
+        print(f"schema table written to {_docs_path()}")
+        return 0
+    if args.check:
+        if docs_table_is_fresh():
+            print(f"schema table: {len(SCHEMA_REGISTRY)} registered schemas, docs fresh")
+            return 0
+        print("schema table in docs/telemetry.md drifted — run "
+              "`python -m accelerate_tpu.telemetry.schemas --write`")
+        return 1
+    for sid in registered_schemas():
+        print(f"{sid}  [{SCHEMA_REGISTRY[sid].emitter}]")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -15,6 +15,12 @@ from __future__ import annotations
 
 from typing import Dict, Iterable, Mapping, Optional, Sequence
 
+from .schemas import (
+    ELASTIC_RESTART_SCHEMA,
+    GATEWAY_REQUEST_SCHEMA,
+    GATEWAY_SLO_SCHEMA,
+)
+
 __all__ = [
     "GATEWAY_REQUEST_SCHEMA",
     "GATEWAY_SLO_SCHEMA",
@@ -25,21 +31,19 @@ __all__ = [
     "slo_attainment",
 ]
 
-#: One record per request reaching a terminal state (done/rejected/shed/expired/
-#: cancelled/evicted): uid, status, machine-readable reason, tenant, priority,
-#: queue_wait_s / ttft_s / tpot_s, tokens generated, deadline_met.
-GATEWAY_REQUEST_SCHEMA = "accelerate_tpu.telemetry.gateway.request/v1"
-
-#: Aggregate gateway summary: terminal counts by status plus the per-metric
-#: p50/p95/p99 blocks produced by :func:`slo_summary`.
-GATEWAY_SLO_SCHEMA = "accelerate_tpu.telemetry.gateway.slo/v1"
-
-#: Emitted by ``ElasticSupervisor`` on every gang restart (attempt index, the
-#: exit codes that triggered the teardown, the restart budget).
-ELASTIC_RESTART_SCHEMA = "accelerate_tpu.telemetry.elastic.restart/v1"
-
 #: The percentiles every summary block carries.
 SLO_PERCENTILES = (50, 95, 99)
+
+
+def _percentile_sorted(ordered: Sequence[float], q: float) -> float:
+    """Linear-interpolated percentile of an ALREADY-SORTED non-empty sequence."""
+    if len(ordered) == 1:
+        return float(ordered[0])
+    rank = (q / 100.0) * (len(ordered) - 1)
+    lo = int(rank)
+    hi = min(lo + 1, len(ordered) - 1)
+    frac = rank - lo
+    return float(ordered[lo] * (1.0 - frac) + ordered[hi] * frac)
 
 
 def percentile(values: Sequence[float], q: float) -> float:
@@ -50,14 +54,7 @@ def percentile(values: Sequence[float], q: float) -> float:
         raise ValueError("percentile of an empty sequence")
     if not 0 <= q <= 100:
         raise ValueError(f"q={q} must lie in [0, 100]")
-    ordered = sorted(values)
-    if len(ordered) == 1:
-        return float(ordered[0])
-    rank = (q / 100.0) * (len(ordered) - 1)
-    lo = int(rank)
-    hi = min(lo + 1, len(ordered) - 1)
-    frac = rank - lo
-    return float(ordered[lo] * (1.0 - frac) + ordered[hi] * frac)
+    return _percentile_sorted(sorted(values), q)
 
 
 def latency_summary(
@@ -65,13 +62,18 @@ def latency_summary(
 ) -> dict:
     """``{count, mean, p50, p95, p99}`` over the non-None entries; ``{"count": 0}``
     when nothing was measured (a request rejected at admission has no TTFT —
-    absence is the honest value, not 0.0)."""
-    vals = [float(v) for v in values if v is not None]
+    absence is the honest value, not 0.0).
+
+    Sorts ONCE and reads every percentile off the ordered list — this runs per
+    decode step inside ``ContinuousBatcher.stats()`` when telemetry is enabled,
+    so the per-percentile re-sort ``percentile()`` would pay is not acceptable
+    there."""
+    vals = sorted(float(v) for v in values if v is not None)
     if not vals:
         return {"count": 0}
     out = {"count": len(vals), "mean": round(sum(vals) / len(vals), 6)}
     for q in percentiles:
-        out[f"p{q:g}"] = round(percentile(vals, q), 6)
+        out[f"p{q:g}"] = round(_percentile_sorted(vals, q), 6)
     return out
 
 

@@ -1,0 +1,189 @@
+"""Request-scoped tracing: where did THIS request's latency go?
+
+The serving stack's observability used to stop at aggregates — one terminal
+``gateway.request/v1`` row per request, per-step pool counters — so "where did
+this request's 400 ms go: queue, prefill padding, decode stalls behind another
+lane's verify round, a COW re-materialization, or a preemption retry?" had no
+answer. This module is the per-request layer: a :class:`Tracer` rides the
+gateway + engine and emits one ``accelerate_tpu.telemetry.trace.span/v1`` record
+per lifecycle phase, all carrying the same ``trace_id``:
+
+===========  =================================================================
+span kind    meaning / extra attributes
+===========  =================================================================
+``queue``    submit → admission (or → terminal, for requests that never ran)
+``admit``    the admission decision: lane, ``kv_defer_retries`` (paged pool
+             pressure re-tries before pages freed)
+``prefill``  the admission prefill: ``mode`` (bucket/chunk/prefix), padded
+             ``width`` vs actual ``prompt_len``, prefix ``hit``/``cow``/
+             ``adopted_pages``
+``decode``   one per decode round the request participated in: engine ``step``
+             index (the causal link to ``serving.kv/v1``/``serving.spec/v1``
+             records of the same step), batch ``occupancy``, ``tokens``
+             emitted, spec ``proposed``/``accepted``
+``first_token``  zero-duration: the client-visible first token (TTFT anchor)
+``preempt``  the request lost its lane to a higher-priority one
+``retry``    its retry was requeued (stream reset; attempt index)
+``shed``     removed from the queue by overload shedding
+``terminal`` final state: status, reason, ``ttft_s``/``tpot_s``/``n_tokens``
+===========  =================================================================
+
+Reconstruction: ``accelerate-tpu trace-report`` (``commands/trace_report.py``)
+groups spans by ``trace_id`` into per-request timelines and a critical-path
+breakdown (queue vs prefill vs decode vs decode-stall vs retry). TTFT is
+recoverable from spans alone (``first_token.t1 - queue.t0``), and the stall
+component is what spans uniquely expose: time spent RUNNING but not advancing,
+i.e. admitted lanes waiting while other requests' prefills hold the host loop.
+
+Overhead contract (same as :class:`~.core.Telemetry`): **disabled tracing costs
+two attribute reads per engine step** — no clock calls, no dict lookups, no
+records (asserted by ``tests/test_tracing.py``). A ``Tracer`` is enabled iff its
+``Telemetry`` is (or an explicit ``sink`` is given); spans flow through the same
+``Telemetry.emit`` pipeline (JSONL + trackers) as every other record.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from typing import Callable, Dict, Optional
+
+from .schemas import TRACE_SPAN_SCHEMA
+
+__all__ = ["Tracer", "TraceHandle", "TRACE_SPAN_SCHEMA"]
+
+#: Process-wide trace sequence: uid + submit time alone would collide when
+#: several gateways run on injectable VIRTUAL clocks against one telemetry sink
+#: (e.g. serve-bench replaying one trace per policy — every policy's request 0
+#: would share "0:0.000000000" and trace-report would merge them).
+_TRACE_SEQ = itertools.count()
+
+
+class TraceHandle:
+    """One live request's trace state (identity + the counters spans stamp).
+
+    ``trace_id`` is gateway uid + submit time + a process-wide sequence number —
+    unique within a process even across gateways/virtual clocks, and stable
+    across the request's whole lifecycle, including preemption retries (a retry
+    is a new attempt inside the SAME trace)."""
+
+    __slots__ = ("trace_id", "uid", "tenant", "t_start", "kv_defers", "attempt")
+
+    def __init__(self, uid: int, tenant: str, t_start: float):
+        self.trace_id = f"{uid}:{t_start:.9f}:{next(_TRACE_SEQ):x}"
+        self.uid = uid
+        self.tenant = tenant
+        self.t_start = t_start
+        self.kv_defers = 0   # paged-pool admission defers observed for this request
+        self.attempt = 0     # preemption retries re-admit under attempt n+1
+
+
+class Tracer:
+    """Span emitter threaded through gateway + engine.
+
+    The gateway opens a trace per submit (:meth:`start`), binds it to the engine
+    request uid after ``engine.submit`` (:meth:`bind_engine`) so the engine's
+    prefill/decode instrumentation can attribute device work to the right trace,
+    and closes it at the terminal state (:meth:`finish`). ``clock`` is injectable
+    (tests and trace replay use a manual virtual clock — spans then share the
+    gateway's deadline clock, so timelines and deadlines agree)."""
+
+    def __init__(self, telemetry=None, clock: Callable[[], float] = time.monotonic,
+                 sink: Optional[Callable[[dict], None]] = None):
+        self.telemetry = telemetry
+        self._sink = sink
+        #: The ONE flag the hot path reads; spans are dropped wholesale when off.
+        self.enabled = bool(sink) or (
+            telemetry is not None and getattr(telemetry, "enabled", False)
+        )
+        self._clock = clock
+        self.spans_emitted = 0
+        self._traces: Dict[int, TraceHandle] = {}      # gateway uid → handle
+        self._by_engine: Dict[int, TraceHandle] = {}   # engine uid → handle
+
+    # ------------------------------------------------------------------ lifecycle
+    def start(self, uid: int, tenant: str = "default",
+              t: Optional[float] = None) -> Optional[TraceHandle]:
+        """Open a trace for request ``uid``; returns None while disabled (callers
+        store the handle wherever they track the request — a None handle makes
+        every later emit a no-op)."""
+        if not self.enabled:
+            return None
+        handle = TraceHandle(uid, tenant, self._clock() if t is None else t)
+        self._traces[uid] = handle
+        return handle
+
+    def bind_engine(self, handle: Optional[TraceHandle], engine_uid: int) -> None:
+        """Associate an engine request uid with ``handle`` so engine-side spans
+        (prefill, decode rounds, pool defers) land in the right trace."""
+        if handle is not None:
+            self._by_engine[engine_uid] = handle
+
+    def handle_for(self, engine_uid: int) -> Optional[TraceHandle]:
+        """The handle bound to ``engine_uid`` (None when unbound — engine-direct
+        submissions trace nothing)."""
+        return self._by_engine.get(engine_uid)
+
+    def finish(self, handle: Optional[TraceHandle]) -> None:
+        """Drop a terminal trace's state (its spans are already emitted)."""
+        if handle is None:
+            return
+        self._traces.pop(handle.uid, None)
+        stale = [k for k, v in self._by_engine.items() if v is handle]
+        for k in stale:
+            self._by_engine.pop(k, None)
+
+    # ------------------------------------------------------------------ emission
+    def span(self, handle: Optional[TraceHandle], kind: str, t0: float, t1: float,
+             step: Optional[int] = None, **attrs) -> None:
+        """Emit one span record on ``handle``'s trace. ``step`` is the engine
+        decode-step index — the causal key joining this span to the
+        ``serving/v1``/``serving.kv/v1``/``serving.spec/v1`` record of the same
+        step. No-op on a None handle or while disabled."""
+        if handle is None or not self.enabled:
+            return
+        record = {
+            "schema": TRACE_SPAN_SCHEMA,
+            "trace_id": handle.trace_id,
+            "uid": handle.uid,
+            "tenant": handle.tenant,
+            "span": kind,
+            "t0": round(t0, 9),
+            "t1": round(t1, 9),
+            "dur_s": round(t1 - t0, 9),
+        }
+        if step is not None:
+            record["step"] = step
+        if attrs:
+            record.update(attrs)
+        self.spans_emitted += 1
+        if self.telemetry is not None:
+            self.telemetry.emit(record)
+        if self._sink is not None:
+            self._sink(record)
+
+    def event(self, handle: Optional[TraceHandle], kind: str,
+              t: Optional[float] = None, step: Optional[int] = None,
+              **attrs) -> None:
+        """A zero-duration span (``first_token``, ``preempt``, ``shed``...).
+        ``t`` lets the caller reuse a timestamp it already took — the gateway's
+        first-token event shares the exact clock read its ``ttft_s`` uses, so
+        trace-reconstructed TTFT equals the gateway's to the digit."""
+        if handle is None or not self.enabled:
+            return
+        if t is None:
+            t = self._clock()
+        self.span(handle, kind, t, t, step=step, **attrs)
+
+    def count_defer(self, engine_uid: int) -> None:
+        """One paged-pool admission defer observed for this engine request; the
+        count lands on the eventual ``admit`` span as ``kv_defer_retries``."""
+        handle = self._by_engine.get(engine_uid)
+        if handle is not None:
+            handle.kv_defers += 1
+
+    def __repr__(self) -> str:
+        return (
+            f"Tracer(enabled={self.enabled}, live={len(self._traces)}, "
+            f"spans_emitted={self.spans_emitted})"
+        )

@@ -495,6 +495,10 @@ def run(B: int, S: int, fuse: int, preset: str | None, default_metric: str | Non
         "cold_compiles": cold["compiles_total"],
         "cold_compile_s": cold["compile_s_total"],
         "compile_cache": acc.compile_cache.stats(),
+        # Reproducibility stamp (ISSUE 8 provenance satellite): which commit,
+        # config and backend produced this number — same block serve-bench rows
+        # and BENCH_TRACE.json curves carry.
+        "provenance": _provenance(cfg),
     }
     if acc.compile_cache.capture:
         from accelerate_tpu.analysis.program import audit_summaries
@@ -785,6 +789,45 @@ def _adopt_best_sweep_config(default_metric: str) -> None:
               f"(MFU {best['value']}): {applied}", file=sys.stderr)
 
 
+def _provenance(cfg=None) -> dict:
+    """The shared provenance block (git commit + config fingerprint + backend),
+    from the ONE implementation serve-bench and the trace curves use."""
+    from accelerate_tpu.telemetry.provenance import provenance_stamp
+
+    return provenance_stamp(cfg)
+
+
+def _run_trace_curves_row() -> int:
+    """SLO-attainment-vs-offered-load artifact (``BENCH_TRACE=1``): one
+    ``run_trace_curves`` sweep (bursty Poisson + adversarial tenant-flood
+    generators × every gateway policy × the load ladder) written to
+    ``BENCH_TRACE.json`` (override with ``BENCH_TRACE_OUT``); every curve is
+    stamped with the workload-trace hash and run provenance."""
+    _os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from accelerate_tpu.commands.serve_bench import run_trace_curves
+
+    artifact = run_trace_curves(
+        requests=int(_os.environ.get("BENCH_TRACE_REQUESTS", "64")),
+        max_slots=int(_os.environ.get("BENCH_TRACE_SLOTS", "4")),
+        seed=int(_os.environ.get("BENCH_TRACE_SEED", "0")),
+    )
+    out = _os.environ.get("BENCH_TRACE_OUT", "BENCH_TRACE.json")
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=2)
+    for curve in artifact["curves"]:
+        print(json.dumps({
+            "metric": f"serve_trace/{curve['generator']}/{curve['policy']}",
+            "workload_trace_hash": curve["workload_trace_hash"],
+            "loads": artifact["loads"],
+            "attainment": [p["attainment"] for p in curve["points"]],
+            "attainment_high": [p["attainment_high"] for p in curve["points"]],
+        }))
+    return 0
+
+
 def _run_serving_rows(preset: str | None) -> int:
     """Serving-tier SLO rows (``BENCH_SERVE=1``): replay the serve-bench synthetic
     overload once per gateway policy and print one JSON row each — the SAME
@@ -867,6 +910,8 @@ def main():
     enable_compile_cache(_here)
 
     preset = os.environ.get("BENCH_PRESET")
+    if os.environ.get("BENCH_TRACE"):
+        return _run_trace_curves_row()
     if os.environ.get("BENCH_PAGED"):
         return _run_paged_compare_row()
     if os.environ.get("BENCH_SERVE"):

@@ -92,6 +92,11 @@ MODULES = [
     ("accelerate_tpu.telemetry.derived", "Derived throughput rates"),
     ("accelerate_tpu.telemetry.profiler", "Scheduled profiler windows"),
     ("accelerate_tpu.telemetry.slo", "SLO summaries and record schemas"),
+    ("accelerate_tpu.telemetry.schemas", "Telemetry schema registry"),
+    ("accelerate_tpu.telemetry.tracing", "Request-scoped tracing"),
+    ("accelerate_tpu.telemetry.provenance", "Artifact provenance"),
+    ("accelerate_tpu.serving_gateway.workload", "Workload traces & replay"),
+    ("accelerate_tpu.commands.trace_report", "Trace report CLI"),
     ("accelerate_tpu.models.llama", "Llama family"),
     ("accelerate_tpu.models.lora", "LoRA fine-tuning"),
     ("accelerate_tpu.models.gpt", "GPT family"),

@@ -31,6 +31,12 @@ fi
 echo "== graftaudit (program tier) =="
 python -m accelerate_tpu audit --check || rc=1
 
+echo "== telemetry schema registry =="
+# The generated schema table in docs/telemetry.md must match the registry
+# (telemetry/schemas.py); regen with `python -m accelerate_tpu.telemetry.schemas --write`.
+# (Invoked via -c rather than -m to avoid runpy's found-in-sys.modules warning.)
+python -c "from accelerate_tpu.telemetry import schemas; raise SystemExit(schemas.main(['--check']))" || rc=1
+
 echo "== docs/api drift =="
 # The docs gate lives on the lint CLI; an empty-path lint is not possible, so
 # run it over one tiny file and keep only the docs verdict.

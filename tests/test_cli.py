@@ -307,7 +307,8 @@ def test_cli_help_lists_subcommands(capsys):
     out = capsys.readouterr().out
     for sub in (
         "audit", "config", "env", "estimate-memory", "launch", "lint",
-        "merge-weights", "serve-bench", "test", "tpu-config", "warmup",
+        "merge-weights", "serve-bench", "test", "tpu-config", "trace-report",
+        "warmup",
     ):
         assert sub in out
 

@@ -665,6 +665,58 @@ def test_pspec_vocabulary_is_crossfile(tmp_path):
     assert len(hits) == 1 and "'mp'" in hits[0].message
 
 
+# ------------------------------------------------------- telemetry-schema-literal
+
+BAD_SCHEMA_LITERAL = """
+    MY_SCHEMA = "accelerate_tpu.telemetry.mystream/v1"
+
+    def emit(tel):
+        tel.emit({
+            "schema": "accelerate_tpu.telemetry.serving.custom/v1",
+            "value": 1,
+        })
+"""
+
+GOOD_SCHEMA_LITERAL = """
+    from accelerate_tpu.telemetry.schemas import SERVING_SCHEMA
+
+    BENCH_SCHEMA = "accelerate_tpu.bench.paged/v1"  # non-telemetry namespace: fine
+
+    def emit(tel):
+        tel.emit({"schema": SERVING_SCHEMA, "value": 1})
+        tel.emit({"schema": BENCH_SCHEMA, "rows": []})
+        print("accelerate_tpu.telemetry.serving/v1")  # prose mention, not a schema key
+"""
+
+
+def test_telemetry_schema_literal_fires(tmp_path):
+    hits = rule_hits(lint_snippet(tmp_path, BAD_SCHEMA_LITERAL),
+                     "telemetry-schema-literal")
+    assert len(hits) == 2, hits
+    msgs = " ".join(f.message for f in hits)
+    assert "registry" in msgs and "mystream" in msgs
+
+
+def test_telemetry_schema_literal_clean(tmp_path):
+    assert not rule_hits(lint_snippet(tmp_path, GOOD_SCHEMA_LITERAL),
+                         "telemetry-schema-literal")
+
+
+def test_telemetry_schema_literal_exempts_registry_and_tests(tmp_path):
+    src = 'STEP = "accelerate_tpu.telemetry.step/v1"\n'
+    # The registry module itself is the ONE place literals are legal.
+    reg_dir = tmp_path / "accelerate_tpu" / "telemetry"
+    reg_dir.mkdir(parents=True)
+    (reg_dir / "schemas.py").write_text(src)
+    findings = run_lint(paths=(str(reg_dir / "schemas.py"),), root=str(tmp_path))
+    assert not rule_hits(findings, "telemetry-schema-literal")
+    # Test files pin schema strings freely.
+    assert not rule_hits(lint_snippet(tmp_path, src, name="test_schemas.py"),
+                         "telemetry-schema-literal")
+    assert rule_hits(lint_snippet(tmp_path, src, name="lib.py"),
+                     "telemetry-schema-literal")
+
+
 # ------------------------------------------------------------- suppression semantics
 
 def test_unknown_rule_in_suppression_is_error(tmp_path):

@@ -531,6 +531,112 @@ def test_gateway_telemetry_records(setup):
         assert q in slos[0]["slo"]["ttft_s"]
 
 
+def test_request_record_emitted_for_every_terminal_state(setup):
+    """ISSUE 8 satellite: EVERY terminal path emits exactly one
+    ``gateway.request/v1`` record — done, rejected (all three reasons incl.
+    kv_budget), shed, deadline-expired (queued AND running), cancelled (queued
+    AND in-flight), and preempt-retry-exhausted — and the cumulative counters
+    agree with the per-status record totals."""
+    from accelerate_tpu.telemetry import GATEWAY_REQUEST_SCHEMA, Telemetry
+    from accelerate_tpu.utils.dataclasses import TelemetryConfig
+
+    params, prompts = setup
+
+    def records(tel):
+        return [r for r in tel.records if r.get("schema") == GATEWAY_REQUEST_SCHEMA]
+
+    def fresh(clock=None, paged=False, **cfg_kwargs):
+        tel = Telemetry(TelemetryConfig(enabled=True, compile_events=False,
+                                        memory_stats=False))
+        engine_kw = dict(max_slots=1, max_len=64, prompt_bucket=16)
+        if paged:
+            engine_kw.update(page_size=8, kv_pages=4)  # pool = 32 cache tokens
+        engine = ContinuousBatcher(params, CFG, **engine_kw)
+        kw = {} if clock is None else {"clock": clock}
+        gw = ServingGateway(engine, GatewayConfig(enabled=True, **cfg_kwargs),
+                            telemetry=tel, **kw)
+        return gw, tel
+
+    # --- done + rejected:queue_full -------------------------------------
+    gw, tel = fresh(policy="fifo", max_queue=1)
+    done_r = gw.submit(prompts[0], max_new_tokens=2)
+    gw.step()                       # running; queue empty again
+    gw.submit(prompts[1], max_new_tokens=2)         # queued
+    qfull = gw.submit(prompts[2], max_new_tokens=2)  # queue_full
+    gw.run()
+    recs = records(tel)
+    assert {r["uid"]: r["status"] for r in recs}[qfull.uid] == "rejected"
+    assert next(r for r in recs if r["uid"] == qfull.uid)["reason"] == "queue_full"
+    assert next(r for r in recs if r["uid"] == done_r.uid)["status"] == "done"
+    assert len(recs) == gw.counters["done"] + gw.counters["rejected"] == 3
+
+    # --- rejected:token_budget ------------------------------------------
+    gw, tel = fresh(policy="fifo", max_queued_tokens=8)
+    tb = gw.submit(prompts[0], max_new_tokens=32)
+    assert tb.status == "rejected" and tb.reason == "token_budget"
+    (rec,) = records(tel)
+    assert rec["status"] == "rejected" and rec["reason"] == "token_budget"
+    assert rec["ttft_s"] is None and rec["queue_wait_s"] is None
+
+    # --- rejected:kv_budget (paged pool smaller than one request) -------
+    gw, tel = fresh(policy="fifo", paged=True)
+    kv = gw.submit(prompts[1], max_new_tokens=40)   # 16 + 40 > 32-token pool
+    assert kv.status == "rejected" and kv.reason.startswith("kv_budget")
+    (rec,) = records(tel)
+    assert rec["reason"].startswith("kv_budget")
+
+    # --- shed ------------------------------------------------------------
+    gw, tel = fresh(policy="priority", max_queue=1, overload="shed")
+    gw.submit(prompts[0], max_new_tokens=4)
+    gw.step()
+    low = gw.submit(prompts[1], max_new_tokens=4, priority=0)
+    gw.submit(prompts[2], max_new_tokens=4, priority=5)
+    assert low.status == "shed"
+    shed_rec = next(r for r in records(tel) if r["uid"] == low.uid)
+    assert shed_rec["status"] == "shed" and shed_rec["reason"] == "overload_shed"
+    gw.run()
+    assert len(records(tel)) == gw.counters["done"] + gw.counters["shed"]
+
+    # --- expired: queued AND running (manual clock) ----------------------
+    clock = ManualClock()
+    gw, tel = fresh(clock=clock, policy="fifo")
+    running = gw.submit(prompts[0], max_new_tokens=32, deadline_s=5.0)
+    queued = gw.submit(prompts[1], max_new_tokens=4, deadline_s=5.0)
+    gw.step()
+    assert running.status == "running" and queued.status == "queued"
+    clock.advance(10.0)
+    gw.step()
+    assert running.status == "expired" and queued.status == "expired"
+    by_uid = {r["uid"]: r for r in records(tel)}
+    assert by_uid[queued.uid]["reason"] == "deadline_queued"
+    assert by_uid[running.uid]["reason"] == "deadline_running"
+    assert gw.counters["expired"] == 2 == len(records(tel))
+
+    # --- cancelled: queued AND in-flight ---------------------------------
+    gw, tel = fresh(policy="fifo")
+    run_r = gw.submit(prompts[0], max_new_tokens=16)
+    q_r = gw.submit(prompts[1], max_new_tokens=4)
+    gw.step()
+    assert gw.cancel(q_r.uid) and gw.cancel(run_r.uid)
+    by_uid = {r["uid"]: r for r in records(tel)}
+    assert by_uid[q_r.uid]["reason"] == "cancelled_queued"
+    assert by_uid[run_r.uid]["reason"] == "cancelled_running"
+    assert by_uid[run_r.uid]["n_tokens"] == len(run_r.tokens) >= 1
+    assert gw.counters["cancelled"] == 2 == len(records(tel))
+
+    # --- evicted: preempt with retry budget exhausted --------------------
+    gw, tel = fresh(policy="priority", preempt=True, max_retries=0)
+    low = gw.submit(prompts[0], max_new_tokens=16, priority=0)
+    gw.step()
+    gw.submit(prompts[1], max_new_tokens=2, priority=5)
+    gw.step()
+    assert low.status == "evicted"
+    ev = next(r for r in records(tel) if r["uid"] == low.uid)
+    assert ev["status"] == "evicted" and ev["reason"] == "preempted"
+    gw.run()
+    assert len(records(tel)) == gw.counters["done"] + gw.counters["evicted"]
+
+
 def test_slo_percentile_math():
     from accelerate_tpu.telemetry.slo import latency_summary, percentile, slo_attainment
 

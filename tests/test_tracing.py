@@ -1,0 +1,605 @@
+"""Request-scoped tracing, the schema registry, workload traces and trace-report.
+
+ISSUE 8 acceptance pins: disabled tracing adds zero clock calls/records to the
+decode loop (the Telemetry contract); enabled, a multi-tenant replay produces
+spans whose per-request sums match the terminal TTFT/TPOT within tolerance and
+``trace-report`` reproduces the gateway's p95 TTFT from spans alone; the
+attainment curves show priority/EDF >= FIFO at overload; a workload-trace replay
+round-trips through the warmup bucket ladder with zero new compiles.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from accelerate_tpu.generation import GenerationConfig
+from accelerate_tpu.models import llama
+from accelerate_tpu.serving import ContinuousBatcher
+from accelerate_tpu.serving_gateway import ServingGateway
+from accelerate_tpu.serving_gateway.workload import (
+    GENERATORS,
+    TraceRequest,
+    VirtualClock,
+    generate_workload,
+    load_trace,
+    replay_trace,
+    save_trace,
+    trace_hash,
+)
+from accelerate_tpu.telemetry import Telemetry, Tracer
+from accelerate_tpu.telemetry.schemas import (
+    SCHEMA_REGISTRY,
+    TRACE_SPAN_SCHEMA,
+    docs_table_is_fresh,
+    validate_record,
+)
+from accelerate_tpu.utils.dataclasses import GatewayConfig, TelemetryConfig
+
+CFG = dataclasses.replace(llama.CONFIGS["tiny"], dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = llama.init_params(CFG)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, CFG.vocab_size, int(n)).astype(np.int32)
+               for n in (5, 9, 3, 7, 6, 4)]
+    return params, prompts
+
+
+def _tel():
+    return Telemetry(TelemetryConfig(enabled=True, compile_events=False,
+                                     memory_stats=False))
+
+
+def _spans(tel):
+    return [r for r in tel.records if r.get("schema") == TRACE_SPAN_SCHEMA]
+
+
+# ------------------------------------------------------------------ schema registry
+def test_registry_covers_every_known_stream():
+    ids = set(SCHEMA_REGISTRY)
+    for expect in (
+        "accelerate_tpu.telemetry.step/v1",
+        "accelerate_tpu.telemetry.serving/v1",
+        "accelerate_tpu.telemetry.serving.kv/v1",
+        "accelerate_tpu.telemetry.serving.spec/v1",
+        "accelerate_tpu.telemetry.serving.throughput/v1",
+        "accelerate_tpu.telemetry.gateway.request/v1",
+        "accelerate_tpu.telemetry.gateway.slo/v1",
+        "accelerate_tpu.telemetry.elastic.restart/v1",
+        "accelerate_tpu.telemetry.audit.program/v1",
+        "accelerate_tpu.telemetry.trace.span/v1",
+    ):
+        assert expect in ids, f"{expect} missing from SCHEMA_REGISTRY"
+    for reg in SCHEMA_REGISTRY.values():
+        assert "schema" in reg.required and len(reg.required) > 1
+
+
+def test_validate_record_flags_problems():
+    assert validate_record({"no": "schema"})
+    assert validate_record({"schema": "accelerate_tpu.telemetry.bogus/v9"})
+    missing = validate_record({"schema": "accelerate_tpu.telemetry.gateway.request/v1"})
+    assert missing and "missing required keys" in missing[0]
+
+
+def test_schema_docs_table_is_fresh():
+    """The generated table in docs/telemetry.md matches the registry (the same
+    gate scripts/check.sh runs)."""
+    assert docs_table_is_fresh(), (
+        "docs/telemetry.md schema table drifted — run "
+        "`python -m accelerate_tpu.telemetry.schemas --write`"
+    )
+
+
+def test_engine_serving_records_validate_against_registry(setup):
+    """Every record the engine emits satisfies its registration's required keys."""
+    params, prompts = setup
+    tel = _tel()
+    eng = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                            prompt_bucket=16, telemetry=tel,
+                            page_size=8, spec_k=2)
+    for p in prompts[:3]:
+        eng.submit(p, max_new_tokens=4)
+    eng.run(report_throughput=True)
+    assert len(tel.records) > 0
+    for rec in tel.records:
+        assert validate_record(rec) == [], rec["schema"]
+    kinds = {r["schema"] for r in tel.records}
+    assert "accelerate_tpu.telemetry.serving.kv/v1" in kinds
+    assert "accelerate_tpu.telemetry.serving.spec/v1" in kinds
+
+
+# --------------------------------------------------------------- disabled overhead
+def test_disabled_tracer_zero_clock_calls_zero_spans(setup):
+    """Acceptance: tracing disabled costs the decode loop two attribute reads —
+    no clock reads, no span records (mirrors Telemetry's disabled-mode test)."""
+    params, prompts = setup
+    tel_off = Telemetry(TelemetryConfig())        # disabled (the default)
+    assert tel_off.enabled is False
+    clock_calls = []
+
+    def counting_clock():
+        clock_calls.append(1)
+        return 0.0
+
+    tracer = Tracer(tel_off, clock=counting_clock)
+    assert tracer.enabled is False
+    eng = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                            prompt_bucket=16, tracer=tracer)
+    gw = ServingGateway(eng, GatewayConfig(enabled=True), tracer=tracer)
+    for p in prompts[:3]:
+        gw.submit(p, max_new_tokens=5)
+    out = gw.run()
+    assert all(r.status == "done" for r in out)
+    assert clock_calls == []                      # not one clock read while disabled
+    assert tracer.spans_emitted == 0
+    assert tel_off.records == []
+    # start() while disabled returns None handles; nothing accumulates.
+    assert tracer.start(0) is None
+
+
+def test_gateway_aligns_tracer_clock(setup):
+    """A tracer left on its default monotonic clock adopts the gateway's
+    injected virtual clock, so gateway-side and engine-side spans share one
+    timeline (mixed domains would make trace-report's reconstruction garbage)."""
+    params, prompts = setup
+    tel = _tel()
+    tracer = Tracer(tel)                          # default monotonic clock
+    clock = VirtualClock()
+    eng = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                            prompt_bucket=16, tracer=tracer)
+    gw = ServingGateway(eng, GatewayConfig(enabled=True), telemetry=tel,
+                        clock=clock, tracer=tracer)
+    assert tracer._clock is clock
+    gw.submit(prompts[0], max_new_tokens=3)
+    clock.advance(1.0)
+    gw.run()
+    # Every span — gateway queue/terminal AND engine prefill/decode — lands on
+    # the virtual timeline (monotonic would stamp wall times in the thousands).
+    assert all(0.0 <= s["t0"] <= s["t1"] < 100.0 for s in _spans(tel))
+
+
+def test_prefix_engine_prefill_span_mode(setup):
+    """On a prefix-cache engine the prefill span's mode says which path RAN:
+    a cold prompt is a chunked prefill (prefix_hit False), only a registry hit
+    labels ``prefix``."""
+    params, _ = setup
+    tel = _tel()
+    tracer = Tracer(tel)
+    eng = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                            prompt_bucket=8, prefix_cache=4, tracer=tracer)
+    gw = ServingGateway(eng, GatewayConfig(enabled=True), telemetry=tel,
+                        tracer=tracer)
+    rng = np.random.default_rng(3)
+    shared = rng.integers(1, CFG.vocab_size, 16).astype(np.int32)  # two full chunks
+    cold = gw.submit(np.concatenate([shared, [5, 6, 7]]), max_new_tokens=2)
+    gw.run()
+    warm = gw.submit(np.concatenate([shared, [9, 8]]), max_new_tokens=2)
+    gw.run()
+    by_uid = {s["uid"]: s for s in _spans(tel) if s["span"] == "prefill"}
+    assert by_uid[cold.uid]["mode"] == "chunk"
+    assert by_uid[cold.uid]["prefix_hit"] is False
+    assert by_uid[warm.uid]["mode"] == "prefix"
+    assert by_uid[warm.uid]["prefix_hit"] is True
+
+
+# --------------------------------------------------------------- span reconstruction
+def test_span_sums_match_terminal_ttft_tpot(setup):
+    """Acceptance: per-request span sums reconstruct the request's own terminal
+    TTFT (queue + prefill) and TPOT (decode window / (n-1)) within tolerance,
+    on the real monotonic clock."""
+    params, prompts = setup
+    tel = _tel()
+    tracer = Tracer(tel)
+    eng = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                            prompt_bucket=16, tracer=tracer)
+    gw = ServingGateway(
+        eng, GatewayConfig(enabled=True, policy="priority", max_queue=16),
+        telemetry=tel, tracer=tracer,
+    )
+    greqs = [gw.submit(p, max_new_tokens=6, tenant=f"t{i % 2}", priority=i % 3)
+             for i, p in enumerate(prompts)]
+    gw.run()
+    assert all(r.status == "done" for r in greqs)
+    spans = _spans(tel)
+    assert spans and all(validate_record(s) == [] for s in spans)
+    by_uid = {}
+    for s in spans:
+        by_uid.setdefault(s["uid"], []).append(s)
+    for greq in greqs:
+        mine = by_uid[greq.uid]
+        kinds = {s["span"] for s in mine}
+        assert {"queue", "admit", "prefill", "decode", "first_token",
+                "terminal"} <= kinds
+        queue = sum(s["dur_s"] for s in mine if s["span"] == "queue")
+        prefill = sum(s["dur_s"] for s in mine if s["span"] == "prefill")
+        # TTFT = queue wait + prefill (the prefill span closes after the first
+        # token is extracted and streamed). Tolerance covers the host's
+        # bookkeeping between spans.
+        assert abs((queue + prefill) - greq.ttft_s) < 0.05, (
+            queue, prefill, greq.ttft_s)
+        decode = [s for s in mine if s["span"] == "decode"]
+        assert len(decode) == len(greq.tokens) - 1  # one span per post-first token
+        first_t = next(s["t1"] for s in mine if s["span"] == "first_token")
+        span_tpot = (max(s["t1"] for s in decode) - first_t) / (len(greq.tokens) - 1)
+        assert abs(span_tpot - greq.tpot_s) < 0.05
+        # decode spans carry the causality step index into the per-step records.
+        assert all(s["step"] >= 1 for s in decode)
+
+
+def test_trace_report_reproduces_gateway_p95_ttft(tmp_path, setup):
+    """Acceptance: trace-report reproduces the gateway's p95 TTFT from spans
+    ALONE (exactly — the first-token event reuses the gateway's clock read)."""
+    from accelerate_tpu.commands.trace_report import load_spans, trace_report
+    from accelerate_tpu.telemetry.slo import percentile
+
+    params, _ = setup
+    jdir = str(tmp_path / "run")
+    tel = Telemetry(TelemetryConfig(enabled=True, jsonl_dir=jdir,
+                                    compile_events=False, memory_stats=False))
+    clock = VirtualClock()
+    tracer = Tracer(tel, clock=clock)
+    eng = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                            prompt_bucket=16, tracer=tracer)
+    gw = ServingGateway(
+        eng, GatewayConfig(enabled=True, policy="edf", max_queue=8,
+                           overload="shed"),
+        telemetry=tel, clock=clock, tracer=tracer,
+    )
+    trace = generate_workload("tenant_flood", 24, seed=3, mean_iat_s=3.0)
+    greqs = replay_trace(gw, trace, CFG.vocab_size, clock, seed=3)
+    tel.close()
+
+    spans = load_spans(os.path.join(jdir, "telemetry.jsonl"))
+    report = trace_report(spans)
+    gw_ttfts = [r.ttft_s for r in greqs if r.status == "done"]
+    assert report["ttft"]["count"] == len(gw_ttfts)
+    assert report["ttft"]["p95"] == round(percentile(gw_ttfts, 95), 6)
+    assert report["by_status"]["done"] == sum(r.status == "done" for r in greqs)
+    # Critical-path shares cover the decomposition and sum to ~1.
+    shares = [v for v in report["critical_path_share"].values() if v is not None]
+    assert abs(sum(shares) - 1.0) < 1e-6
+
+
+def test_preempt_retry_spans(setup):
+    """A preempted-then-retried request's trace records the disruption: preempt
+    + retry events, a second queue span with attempt=1, and a terminal span."""
+    params, prompts = setup
+    tel = _tel()
+    tracer = Tracer(tel)
+    eng = ContinuousBatcher(params, CFG, max_slots=1, max_len=64,
+                            prompt_bucket=16, tracer=tracer)
+    gw = ServingGateway(
+        eng, GatewayConfig(enabled=True, policy="priority", preempt=True,
+                           max_retries=1),
+        telemetry=tel, tracer=tracer,
+    )
+    low = gw.submit(prompts[0], max_new_tokens=8, priority=0)
+    gw.step()
+    gw.submit(prompts[1], max_new_tokens=3, priority=5)
+    gw.step()
+    gw.run()
+    assert low.status == "done" and low.retries_used == 1
+    mine = [s for s in _spans(tel) if s["uid"] == low.uid]
+    kinds = [s["span"] for s in mine]
+    assert "preempt" in kinds and "retry" in kinds
+    queue_spans = [s for s in mine if s["span"] == "queue"]
+    assert [s["attempt"] for s in queue_spans] == [0, 1]
+    assert mine[-1]["span"] == "terminal" and mine[-1]["status"] == "done"
+
+
+def test_shed_and_rejected_traces_close(setup):
+    """Requests that never run still get complete traces: a queue span covering
+    submit → terminal and the terminal event with the machine-readable reason."""
+    params, prompts = setup
+    tel = _tel()
+    tracer = Tracer(tel)
+    eng = ContinuousBatcher(params, CFG, max_slots=1, max_len=64,
+                            prompt_bucket=16, tracer=tracer)
+    gw = ServingGateway(
+        eng, GatewayConfig(enabled=True, policy="priority", max_queue=1,
+                           overload="shed"),
+        telemetry=tel, tracer=tracer,
+    )
+    gw.submit(prompts[0], max_new_tokens=8)           # takes the lane
+    gw.step()
+    low = gw.submit(prompts[1], max_new_tokens=4, priority=0)   # queued
+    high = gw.submit(prompts[2], max_new_tokens=4, priority=5)  # sheds low
+    assert low.status == "shed" and high.status == "queued"
+    shed_spans = [s for s in _spans(tel) if s["uid"] == low.uid]
+    kinds = [s["span"] for s in shed_spans]
+    assert "shed" in kinds and "queue" in kinds and "terminal" in kinds
+    term = next(s for s in shed_spans if s["span"] == "terminal")
+    assert term["status"] == "shed" and term["reason"] == "overload_shed"
+    shed_ev = next(s for s in shed_spans if s["span"] == "shed")
+    assert shed_ev["shed_for"] == high.uid
+    # No live trace state leaks for closed traces.
+    assert low.uid not in tracer._traces
+
+
+def test_spec_decode_spans_account_every_token(setup):
+    """Speculative + paged engines emit decode spans with proposal/acceptance
+    attrs whose per-request token sums (+1 prefill token) equal the transcript,
+    and whose step indices join the serving.spec/v1 records."""
+    params, prompts = setup
+    tel = _tel()
+    tracer = Tracer(tel)
+    eng = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                            prompt_bucket=16, spec_k=2, page_size=8,
+                            tracer=tracer, telemetry=tel)
+    gw = ServingGateway(eng, GatewayConfig(enabled=True), telemetry=tel,
+                        tracer=tracer)
+    greqs = [gw.submit(p, max_new_tokens=6) for p in prompts[:4]]
+    gw.run()
+    assert all(r.status == "done" for r in greqs)
+    decode = [s for s in _spans(tel) if s["span"] == "decode"]
+    assert decode and all({"proposed", "accepted", "step"} <= set(s)
+                          for s in decode)
+    per_uid = {}
+    for s in decode:
+        per_uid[s["uid"]] = per_uid.get(s["uid"], 0) + s["tokens"]
+    for greq in greqs:
+        assert per_uid[greq.uid] + 1 == len(greq.tokens)
+    spec_steps = {r["step"] for r in tel.records
+                  if r.get("schema") == "accelerate_tpu.telemetry.serving.spec/v1"}
+    assert {s["step"] for s in decode} <= spec_steps
+
+
+# -------------------------------------------------------------- engine queue waits
+def test_engine_queue_wait_percentiles(setup):
+    """Satellite: the bare engine (no gateway) reports per-request queue-wait
+    p50/p95/p99 measured at admission, not just the oldest queued age."""
+    params, prompts = setup
+    eng = ContinuousBatcher(params, CFG, max_slots=1, max_len=64, prompt_bucket=16)
+    for p in prompts[:4]:
+        eng.submit(p, max_new_tokens=2)
+    # Backdate the enqueue stamps so waits are deterministic and distinct.
+    import time as _time
+
+    now = _time.monotonic()
+    for i, req in enumerate(eng.queue):
+        req.enqueued_at = now - (i + 1)
+    eng.run()
+    qw = eng.stats()["queue_wait"]
+    assert qw["count"] == 4
+    for key in ("mean", "p50", "p95", "p99"):
+        assert qw[key] > 0
+    assert qw["p99"] >= qw["p50"]
+    # Empty engine still answers with an honest zero-count block.
+    fresh = ContinuousBatcher(params, CFG, max_slots=1, max_len=64, prompt_bucket=16)
+    assert fresh.stats()["queue_wait"] == {"count": 0}
+
+
+# -------------------------------------------------------------------- workload layer
+def test_generators_deterministic_and_distinct():
+    for kind in GENERATORS:
+        a = generate_workload(kind, 32, seed=7)
+        b = generate_workload(kind, 32, seed=7)
+        c = generate_workload(kind, 32, seed=8)
+        assert [r.to_json() for r in a] == [r.to_json() for r in b]
+        assert trace_hash(a) == trace_hash(b)
+        assert trace_hash(a) != trace_hash(c)
+        assert len(a) == 32
+        arrivals = [r.arrival_s for r in a]
+        assert arrivals == sorted(arrivals)
+        assert all(r.prompt_len >= 1 and r.output_len >= 1 for r in a)
+    with pytest.raises(ValueError, match="unknown workload generator"):
+        generate_workload("nope", 4)
+
+
+def test_tenant_flood_contains_flood_window():
+    trace = generate_workload("tenant_flood", 40, seed=1)
+    flood = [r for r in trace if r.tenant == "flood"]
+    assert len(flood) == 16  # 40% of the trace
+    span = max(r.arrival_s for r in flood) - min(r.arrival_s for r in flood)
+    assert span <= 2.0  # the flood lands inside its configured window
+
+
+def test_trace_save_load_roundtrip(tmp_path):
+    trace = generate_workload("heavy_tail", 16, seed=2)
+    path = str(tmp_path / "trace.jsonl")
+    save_trace(path, trace, generator="heavy_tail", seed=2)
+    back = load_trace(path)
+    assert [r.to_json() for r in back] == [r.to_json() for r in trace]
+    assert trace_hash(back) == trace_hash(trace)
+    with open(path) as f:
+        header = json.loads(f.readline())
+    assert header["schema"] == "accelerate_tpu.serving.workload/v1"
+    assert header["generator"] == "heavy_tail" and header["n"] == 16
+    # A corrupted header fails loudly, not as an empty trace.
+    bad = str(tmp_path / "bad.jsonl")
+    with open(bad, "w") as f:
+        f.write(json.dumps({"schema": "something/else"}) + "\n")
+    with pytest.raises(ValueError, match="unknown workload trace schema"):
+        load_trace(bad)
+
+
+def test_replay_offered_load_compresses_arrivals(setup):
+    """The same trace at higher offered load finishes in fewer virtual steps and
+    degrades deadline attainment — load means what the curves say it means."""
+    params, _ = setup
+    trace = generate_workload("poisson", 16, seed=5, mean_iat_s=4.0)
+
+    def one(load):
+        clock = VirtualClock()
+        eng = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                                prompt_bucket=16)
+        gw = ServingGateway(
+            eng, GatewayConfig(enabled=True, policy="fifo", max_queue=8,
+                               overload="shed"),
+            clock=clock,
+        )
+        greqs = replay_trace(gw, trace, CFG.vocab_size, clock, load=load, seed=5)
+        met = [r for r in greqs if r.deadline_met]
+        return clock.t, len(met)
+
+    t_low, met_low = one(0.5)
+    t_high, met_high = one(4.0)
+    assert t_high < t_low          # compressed arrivals drain sooner
+    assert met_high <= met_low     # and meet no more deadlines
+    with pytest.raises(ValueError, match="load"):
+        replay_trace(None, trace, CFG.vocab_size, VirtualClock(), load=0)
+
+
+def test_attainment_curves_show_policy_separation(setup):
+    """Acceptance: at overload, priority/EDF high-priority deadline attainment
+    >= FIFO's, on both required generators (small in-test sweep; the committed
+    BENCH_TRACE.json carries the full ladder)."""
+    from accelerate_tpu.commands.serve_bench import run_trace_curves
+
+    art = run_trace_curves(
+        generators=("poisson", "tenant_flood"),
+        policies=("fifo", "priority", "edf"),
+        loads=(4.0,),
+        requests=32,
+        max_slots=2,
+        max_len=64,
+        prompt_bucket=16,
+    )
+    assert art["schema"] == "accelerate_tpu.bench.trace/v1"
+    by = {(c["generator"], c["policy"]): c for c in art["curves"]}
+    for gen in ("poisson", "tenant_flood"):
+        fifo = by[(gen, "fifo")]["points"][0]["attainment_high"]
+        for pol in ("priority", "edf"):
+            assert by[(gen, pol)]["points"][0]["attainment_high"] >= fifo, (
+                gen, pol)
+    for c in art["curves"]:
+        assert c["workload_trace_hash"]
+        assert "git_commit" in c["provenance"]
+        assert "config_fingerprint" in c["provenance"]
+        for p in c["points"]:
+            assert p["attainment"] is not None
+            assert {"done", "rejected", "shed", "expired"} <= set(p)
+
+
+def test_trace_replay_rows_stamp_hash_and_provenance(setup):
+    from accelerate_tpu.commands.serve_bench import run_trace_replay
+
+    trace = generate_workload("poisson", 10, seed=4, mean_iat_s=3.0)
+    rows = run_trace_replay(trace, policies=("fifo",), max_slots=2, max_len=64,
+                            prompt_bucket=16, generator="poisson")
+    (row,) = rows
+    assert row["workload_trace_hash"] == trace_hash(trace)
+    assert row["provenance"]["config_fingerprint"]
+    assert row["metric"] == "serve_trace/poisson/fifo"
+    assert row["attainment"] is not None
+
+
+# ------------------------------------------------------------- provenance + compiles
+def test_provenance_stamp_contents():
+    from accelerate_tpu.telemetry.provenance import (
+        config_fingerprint, git_commit, provenance_stamp,
+    )
+
+    stamp = provenance_stamp(CFG)
+    assert stamp["jax"] and stamp["backend"]
+    assert len(stamp["config_fingerprint"]) == 20
+    # Fingerprint is config-sensitive, commit is repo state (may be None in a
+    # tarball — but in this checkout it resolves).
+    other = dataclasses.replace(CFG, n_layers=CFG.n_layers + 1)
+    assert config_fingerprint(other) != stamp["config_fingerprint"]
+    assert git_commit() == stamp["git_commit"]
+    assert git_commit(root="/nonexistent") is None
+
+
+def test_workload_trace_rides_bucket_ladder_zero_new_compiles(setup):
+    """Satellite: replaying a workload trace through a bucket-laddered engine
+    compiles nothing beyond the warmed surface — trace prompt lengths route
+    through the same `_plan_prefill` ladder warmup enumerates."""
+    from accelerate_tpu.telemetry import CompileMonitor
+
+    params, _ = setup
+    buckets = (8, 16, 32)
+    trace = generate_workload("poisson", 12, seed=6, mean_iat_s=2.0,
+                              prompt_range=(3, 24), output_range=(4, 8))
+
+    def build():
+        return ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                                 prompt_bucket=16, prompt_buckets=buckets)
+
+    # Warm every program the ladder can route to (jit caches are process-wide).
+    warm = build()
+    rng = np.random.default_rng(0)
+    for width in (3, 8, 16, 24, 32):
+        if width + 8 <= 64:
+            warm.submit(rng.integers(1, CFG.vocab_size, width).astype(np.int32),
+                        max_new_tokens=8)
+    warm.run()
+
+    mon = CompileMonitor()
+    mon.start()
+    try:
+        before = mon.count
+        clock = VirtualClock()
+        gw = ServingGateway(
+            eng := build(),
+            GatewayConfig(enabled=True, policy="fifo", max_queue=12),
+            clock=clock,
+        )
+        greqs = replay_trace(gw, trace, CFG.vocab_size, clock, seed=6)
+        assert sum(r.status == "done" for r in greqs) >= 10
+        assert mon.count - before == 0, "trace replay minted a new compile shape"
+    finally:
+        mon.stop()
+    assert eng.bucket_hits + eng.bucket_misses > 0  # replay used the ladder
+
+
+# ------------------------------------------------------------------------- CLI
+def test_trace_report_cli(tmp_path, capsys, setup):
+    """End-to-end CLI: spans JSONL in, critical-path summary + timeline out."""
+    from accelerate_tpu.commands.accelerate_cli import main as cli_main
+
+    params, prompts = setup
+    jdir = str(tmp_path / "run")
+    tel = Telemetry(TelemetryConfig(enabled=True, jsonl_dir=jdir,
+                                    compile_events=False, memory_stats=False))
+    tracer = Tracer(tel)
+    eng = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                            prompt_bucket=16, tracer=tracer)
+    gw = ServingGateway(eng, GatewayConfig(enabled=True), telemetry=tel,
+                        tracer=tracer)
+    for p in prompts[:3]:
+        gw.submit(p, max_new_tokens=4)
+    gw.run()
+    tel.close()
+    path = os.path.join(jdir, "telemetry.jsonl")
+
+    assert cli_main(["trace-report", path]) == 0
+    out = capsys.readouterr().out
+    summary = json.loads(out)
+    assert summary["n_traces"] == 3 and summary["by_status"]["done"] == 3
+    assert set(summary["breakdown"]) == {"queue_s", "retry_s", "prefill_s",
+                                         "decode_s", "stall_s"}
+
+    assert cli_main(["trace-report", path, "--uid", "0"]) == 0
+    out = capsys.readouterr().out
+    assert "prefill" in out and "terminal" in out
+
+    empty = str(tmp_path / "empty.jsonl")
+    open(empty, "w").close()
+    assert cli_main(["trace-report", empty]) == 1
+
+
+def test_serve_bench_trace_cli(tmp_path, capsys):
+    """serve-bench --save-trace / --workload-trace round-trip through the CLI."""
+    from accelerate_tpu.commands.accelerate_cli import main as cli_main
+
+    path = str(tmp_path / "flood.jsonl")
+    rc = cli_main(["serve-bench", "--save-trace", path, "--trace-gen",
+                   "tenant_flood", "--requests", "12", "--max-slots", "2"])
+    assert rc == 0
+    saved = json.loads(capsys.readouterr().out.strip())
+    assert saved["n"] == 12 and saved["workload_trace_hash"]
+
+    rc = cli_main(["serve-bench", "--workload-trace", path, "--policy", "fifo",
+                   "--max-slots", "2", "--max-len", "64",
+                   "--prompt-bucket", "16"])
+    assert rc == 0
+    row = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert row["workload_trace_hash"] == saved["workload_trace_hash"]
+    assert row["generator"] == "file" and row["policy"] == "fifo"
