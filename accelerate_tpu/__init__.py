@@ -36,6 +36,7 @@ from .utils import (
     MixedPrecisionPolicy,
     ProjectConfiguration,
     CompileCacheConfig,
+    FaultConfig,
     GatewayConfig,
     TelemetryConfig,
     infer_auto_device_map,

@@ -104,6 +104,18 @@ class TrainState:
         return dataclasses.replace(self, **kwargs)
 
 
+def _poison_float_leaves(batch):
+    """Fault-injection helper: NaN out every float leaf of a batch (integer
+    token ids pass through — NaN has no integer spelling)."""
+    def poison(leaf):
+        arr = np.asarray(leaf)
+        if np.issubdtype(arr.dtype, np.floating):
+            return np.full_like(arr, np.nan)
+        return leaf
+
+    return jax.tree_util.tree_map(poison, batch)
+
+
 class _TrainStep:
     """Callable produced by ``Accelerator.build_train_step``.
 
@@ -112,15 +124,36 @@ class _TrainStep:
     flow (XLA-friendly) while preserving the reference's ``sync_gradients`` semantics exactly.
     """
 
-    def __init__(self, accelerator: "Accelerator", micro_fn, apply_fn, optimizer=None):
+    def __init__(self, accelerator: "Accelerator", micro_fn, apply_fn, optimizer=None,
+                 skip_nonfinite_steps: int = 0):
         self.accelerator = accelerator
         self.micro_fn = micro_fn
         self.apply_fn = apply_fn
         self.optimizer = optimizer
         self.micro_count = 0
+        # Non-finite guard (docs/resilience.md): 0 = off (no host sync, byte-
+        # identical to the unguarded step). K > 0 = the compiled step gates its
+        # own update on all-finite loss+grads (params/opt state pass through
+        # unchanged on a bad apply; a bad micro's contribution is zeroed) and
+        # the host fetches ONE boolean per call. K consecutive non-finite
+        # calls — micro OR apply — raise NonFiniteStepError.
+        self.skip_nonfinite_steps = skip_nonfinite_steps
+        self.nonfinite_total = 0
+        self.nonfinite_consecutive = 0
 
     def __call__(self, state: TrainState, batch) -> tuple[TrainState, Any]:
         acc = self.accelerator
+        # Fault injection (disabled = one attribute read): a "nonfinite" fault
+        # poisons the batch's float leaves with NaN — the REAL guard path, not
+        # a simulated exception — and an "error" fault raises at the boundary.
+        plan = getattr(acc, "fault_plan", None)
+        if plan is not None:
+            spec = plan.draw("train.step")
+            if spec is not None:
+                if spec.kind == "nonfinite":
+                    batch = _poison_float_leaves(batch)
+                else:
+                    raise plan.fault_for(spec, "train.step")
         # Telemetry bracket: when off this is two attribute reads — no syncs, no
         # allocation. When on, the record fences on the 1-element loss (telemetry.fence
         # never fetches the full result) so step time includes the device work.
@@ -129,11 +162,38 @@ class _TrainStep:
         if tel_on:
             tel._step_begin()
         try:
-            return self._dispatch(acc, tel if tel_on else None, state, batch)
+            state, metrics = self._dispatch(acc, tel if tel_on else None, state, batch)
         except BaseException:
             if tel_on:
                 tel._step_abort()  # a failed step must not leak the compile label
             raise
+        if self.skip_nonfinite_steps:
+            self._check_nonfinite(acc, metrics)
+        return state, metrics
+
+    def _check_nonfinite(self, acc, metrics) -> None:
+        """One boolean fetch per guarded step: count skipped (non-finite)
+        updates, telemeter them, abort after the consecutive budget."""
+        nf = bool(np.asarray(metrics.get("nonfinite", False)))
+        if not nf:
+            self.nonfinite_consecutive = 0
+            return
+        self.nonfinite_total += 1
+        self.nonfinite_consecutive += 1
+        tel = acc.telemetry
+        if tel is not None and tel.enabled:
+            from .telemetry import FAULT_SCHEMA
+
+            tel.emit({
+                "schema": FAULT_SCHEMA, "site": "train.step",
+                "kind": "nonfinite", "step": acc.step,
+                "consecutive": self.nonfinite_consecutive,
+                "total": self.nonfinite_total,
+            })
+        if self.nonfinite_consecutive >= self.skip_nonfinite_steps:
+            from .resilience.faults import NonFiniteStepError
+
+            raise NonFiniteStepError(self.nonfinite_consecutive, self.nonfinite_total)
 
     def _dispatch(self, acc, tel, state: TrainState, batch) -> tuple[TrainState, Any]:
         gs = acc.gradient_state
@@ -310,6 +370,7 @@ class Accelerator:
         telemetry_config=None,
         compile_cache_config=None,
         gateway_config=None,
+        fault_config=None,
     ):
         self.project_configuration = project_config or ProjectConfiguration(project_dir=project_dir)
         if project_dir is not None and self.project_configuration.project_dir is None:
@@ -411,6 +472,7 @@ class Accelerator:
             telemetry_config=telemetry_config,
             compile_cache_config=compile_cache_config,
             gateway_config=gateway_config,
+            fault_config=fault_config,
         )
 
         # Step-level telemetry (off by default; ACCELERATE_TELEMETRY=1 or an enabled
@@ -428,6 +490,11 @@ class Accelerator:
         from .compile_cache import AotCache
 
         self.compile_cache = AotCache(self.state.compile_cache_config)
+
+        # Deterministic fault injection (off by default; ACCELERATE_FAULTS or an
+        # enabled FaultConfig turns it on). Disabled, the plan is None and every
+        # instrumented site pays one attribute read (docs/resilience.md).
+        self.fault_plan = self.state.fault_config.build_plan()
 
         if ddp_kwargs is not None and ddp_kwargs.reduce_dtype is not None:
             # DDP comm_hook analog: compress cross-device gradient reductions.
@@ -973,6 +1040,7 @@ class Accelerator:
         donate: bool = True,
         fused_steps: int = 1,
         cast_params: bool = True,
+        skip_nonfinite_steps: int = 0,
     ) -> _TrainStep:
         """Compile the training step (the reference hot loop, SURVEY.md §3.4, as one XLA program).
 
@@ -986,7 +1054,29 @@ class Accelerator:
         materializes a full low-precision copy of the parameters in HBM (and, with scanned
         layers, matching zero-init buffers in the scan backward), which on a 16 GB chip is the
         difference between fitting a ~1B-param adamw step and OOM.
+
+        ``skip_nonfinite_steps=K`` (0 = off, the byte-identical default) arms
+        the non-finite guard: an APPLY step whose loss or gradients contain
+        NaN/inf skips its update inside the compiled program (params and
+        optimizer state pass through unchanged); a non-finite MICRO step's
+        contribution is zeroed before it can poison the accumulation window.
+        The host counts every guarded call that observed non-finite compute —
+        micro or apply; consecutive non-finite COMPUTE is the divergence
+        signal, wherever the accumulation boundary falls — and ``K``
+        consecutive raise :class:`~.resilience.faults.NonFiniteStepError`
+        instead of silently training on divergence (docs/resilience.md). The
+        guard costs one boolean device fetch per step.
         """
+        if skip_nonfinite_steps < 0:
+            raise ValueError(
+                f"skip_nonfinite_steps={skip_nonfinite_steps} must be >= 0 (0 = off)"
+            )
+        if skip_nonfinite_steps and fused_steps > 1:
+            raise ValueError(
+                "skip_nonfinite_steps needs the per-step host check; with "
+                "fused_steps>1 the applies run inside one XLA program where the "
+                "host cannot abort between them — use fused_steps=1"
+            )
         if optimizer is None:
             if not self._optimizers:
                 raise ValueError("No optimizer prepared; pass one to build_train_step.")
@@ -1102,8 +1192,26 @@ class Accelerator:
                 )
             return loss, aux, grads, new_fp8
 
+        nonfinite_guard = skip_nonfinite_steps > 0
+
+        def _all_finite(loss, grads):
+            # One fused reduction over loss + every float grad leaf; int leaves
+            # (none today) cannot be non-finite and are skipped.
+            finite = jnp.isfinite(loss)
+            for leaf in jax.tree_util.tree_leaves(grads):
+                if jnp.issubdtype(leaf.dtype, jnp.inexact):
+                    finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(leaf)))
+            return finite
+
         def micro_step(state: TrainState, batch):
             loss, aux, grads, new_fp8 = compute(state, batch)
+            if nonfinite_guard:
+                # A non-finite micro contribution would poison the whole
+                # accumulation window: zero it out and flag the step.
+                finite = _all_finite(loss, grads)
+                grads = jax.tree_util.tree_map(
+                    lambda g: jnp.where(finite, g, jnp.zeros_like(g)), grads
+                )
             if state.grad_accum is None:
                 # First no_sync() use with accumulation disabled: adopt grads as the buffer
                 # (structure change → one retrace, then stable).
@@ -1111,6 +1219,8 @@ class Accelerator:
             else:
                 accum = jax.tree_util.tree_map(jnp.add, state.grad_accum, grads)
             metrics = {"loss": loss}
+            if nonfinite_guard:
+                metrics["nonfinite"] = jnp.logical_not(finite)
             if has_aux:
                 metrics["aux"] = aux
             micro = (state.micro if state.micro is not None else 0) + 1
@@ -1127,6 +1237,7 @@ class Accelerator:
                 grads = jax.tree_util.tree_map(jnp.add, state.grad_accum, grads)
             if accum_steps > 1:
                 grads = jax.tree_util.tree_map(lambda g: g / accum_steps, grads)
+            finite = _all_finite(loss, grads) if nonfinite_guard else None
             metrics = {"loss": loss}
             # Fused single-pass optimizers (ops/fused_optim.FusedAdamW) take the clip
             # factor as a scalar and fold it into their one HBM pass over the grads —
@@ -1212,11 +1323,29 @@ class Accelerator:
                     )
             if has_aux:
                 metrics["aux"] = aux
+            step_inc = 1
+            if nonfinite_guard:
+                # Skip-don't-apply: a non-finite update passes the old params/
+                # opt state (and fp8 scales) through unchanged inside the SAME
+                # compiled program — no second "skip" executable, no retrace.
+                # The window's accumulated garbage is dropped with the reset
+                # below; the host counts the skip off metrics["nonfinite"].
+                def _keep(new, old):
+                    return jax.tree_util.tree_map(
+                        lambda n, o: jnp.where(finite, n, o), new, old
+                    )
+
+                new_params = _keep(new_params, state.params)
+                new_opt_state = _keep(new_opt_state, state.opt_state)
+                if state.fp8_state is not None:
+                    new_fp8 = _keep(new_fp8, state.fp8_state)
+                step_inc = jnp.where(finite, 1, 0)
+                metrics["nonfinite"] = jnp.logical_not(finite)
             return (
                 state.replace(
                     params=new_params,
                     opt_state=new_opt_state,
-                    step=state.step + 1,
+                    step=state.step + step_inc,
                     grad_accum=new_accum,
                     # Reset derived from the input, not a fresh constant: XLA cannot
                     # alias a constant output into the donated buffer, so zeros(())
@@ -1276,7 +1405,8 @@ class Accelerator:
         jit_apply = self.compile_cache.wrap(
             jax.jit(apply_step, donate_argnums=donate_args), "train_step.apply"
         )
-        return _TrainStep(self, jit_micro, jit_apply, optimizer=optimizer)
+        return _TrainStep(self, jit_micro, jit_apply, optimizer=optimizer,
+                          skip_nonfinite_steps=skip_nonfinite_steps)
 
     def build_eval_step(self, eval_fn: Callable, donate: bool = False) -> Callable:
         """Jit an eval function ``eval_fn(params, batch) -> outputs`` with compute-dtype cast."""

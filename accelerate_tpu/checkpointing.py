@@ -48,7 +48,109 @@ __all__ = [
     "save_custom_state",
     "load_custom_state",
     "wait_for_async_save",
+    "verify_checkpoint",
+    "CheckpointCorruptError",
+    "MANIFEST_NAME",
+    "COMMIT_MARKER",
 ]
+
+# ------------------------------------------------------------ verified checkpoints
+#: Per-file sha256 manifest written after every file of a snapshot lands.
+MANIFEST_NAME = "manifest.sha256.json"
+#: Atomic validity marker written LAST (tmp + rename): its presence is the
+#: committed bit — a crash mid-save leaves no marker, and the loader treats
+#: the directory as garbage instead of restoring a torn snapshot.
+COMMIT_MARKER = "COMMITTED"
+#: Quarantine subdirectory invalid checkpoints are moved into on load fallback
+#: (outside the ``checkpoint_*`` glob, so rotation/iteration never sees them).
+QUARANTINE_DIR = "quarantined"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """An explicitly-named checkpoint failed integrity verification."""
+
+    def __init__(self, path, problems):
+        super().__init__(
+            f"checkpoint {path} failed verification: {'; '.join(problems)}"
+        )
+        self.path = str(path)
+        self.problems = list(problems)
+
+
+def _sha256_file(path: Path) -> str:
+    import hashlib
+
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _manifest_files(path: Path):
+    """Every snapshot file, checkpoint-relative, manifest/marker excluded."""
+    skip = {MANIFEST_NAME, COMMIT_MARKER}
+    return sorted(
+        p.relative_to(path).as_posix()
+        for p in path.rglob("*")
+        if p.is_file() and p.name not in skip
+    )
+
+
+def _write_commit_marker(path: Path) -> None:
+    """Hash every file, write the manifest, then the marker — atomically
+    (tmp + rename), and strictly LAST: a crash at any earlier point leaves an
+    uncommitted directory the loader skips."""
+    manifest = {rel: _sha256_file(path / rel) for rel in _manifest_files(path)}
+    (path / MANIFEST_NAME).write_text(json.dumps(manifest, indent=1, sort_keys=True))
+    import os
+
+    tmp = path / (COMMIT_MARKER + ".tmp")
+    tmp.write_text(json.dumps({"files": len(manifest)}))
+    os.replace(tmp, path / COMMIT_MARKER)
+
+
+def verify_checkpoint(path) -> list:
+    """Integrity problems of one checkpoint directory (empty = valid):
+    missing commit marker (crash mid-save), missing manifest, files that
+    disappeared, grew extra, or whose sha256 no longer matches."""
+    path = Path(path)
+    problems = []
+    if not (path / COMMIT_MARKER).exists():
+        return ["uncommitted (no COMMITTED marker — crash mid-save?)"]
+    if not (path / MANIFEST_NAME).exists():
+        return ["committed but manifest missing"]
+    try:
+        manifest = json.loads((path / MANIFEST_NAME).read_text())
+    except (json.JSONDecodeError, OSError) as e:
+        return [f"unreadable manifest: {e}"]
+    present = set(_manifest_files(path))
+    for rel, digest in manifest.items():
+        if rel not in present:
+            problems.append(f"missing file {rel}")
+            continue
+        try:
+            ok = _sha256_file(path / rel) == digest
+        except OSError as e:
+            # Another rank may be quarantining this very directory under our
+            # feet (multi-process load fallback) — a vanished file is an
+            # invalidity verdict, not a crash.
+            problems.append(f"unreadable file {rel}: {e}")
+            continue
+        if not ok:
+            problems.append(f"sha256 mismatch: {rel}")
+    for rel in sorted(present - set(manifest)):
+        problems.append(f"unmanifested file {rel}")
+    return problems
+
+
+def _list_checkpoints(base: Path) -> list:
+    """``checkpoint_*`` directories under ``base`` in numeric order — the ONE
+    listing behind latest-selection, rotation and the verified-load fallback,
+    so the three can never disagree on what the checkpoint set is."""
+    return sorted(
+        base.glob("checkpoint_*"), key=lambda p: int(p.name.split("_")[-1])
+    )
 
 
 def _checkpoint_dir(accelerator, output_dir: Optional[str], for_save: bool) -> Path:
@@ -61,9 +163,7 @@ def _checkpoint_dir(accelerator, output_dir: Optional[str], for_save: bool) -> P
             target = base / f"checkpoint_{project.iteration}"
         else:
             # Load the latest checkpoint (reference load_state default behavior :3290).
-            existing = sorted(
-                base.glob("checkpoint_*"), key=lambda p: int(p.name.split("_")[-1])
-            )
+            existing = _list_checkpoints(base)
             if not existing:
                 raise FileNotFoundError(f"No checkpoints found under {base}")
             target = existing[-1]
@@ -72,12 +172,23 @@ def _checkpoint_dir(accelerator, output_dir: Optional[str], for_save: bool) -> P
 
 
 def _rotate_checkpoints(accelerator, base: Path) -> None:
+    """Prune old snapshots to ``total_limit``, counting only COMMITTED
+    checkpoints and never deleting the newest valid one.
+
+    Uncommitted/corrupt directories (a crashed save's leftovers) neither count
+    toward the limit nor shield older valid snapshots from rotation — and the
+    newest committed checkpoint survives unconditionally: if the save about to
+    happen crashes mid-write, it is the only state the loader can fall back
+    to (regression-tested with an injected mid-save crash)."""
     limit = accelerator.project_configuration.total_limit
     if limit is None:
         return
-    existing = sorted(base.parent.glob("checkpoint_*"), key=lambda p: int(p.name.split("_")[-1]))
-    while len(existing) >= max(limit, 1) + 0 and len(existing) > limit - 1:
-        victim = existing.pop(0)
+    existing = _list_checkpoints(base.parent)
+    committed = [p for p in existing if (p / COMMIT_MARKER).exists()]
+    # Keep limit-1 committed snapshots (the incoming save is the limit-th),
+    # but never fewer than one: the newest valid checkpoint is sacred.
+    while len(committed) > max(max(limit, 1) - 1, 1):
+        victim = committed.pop(0)
         logger.info(f"Deleting old checkpoint {victim} (total_limit={limit})")
         shutil.rmtree(victim, ignore_errors=True)
 
@@ -85,6 +196,29 @@ def _rotate_checkpoints(accelerator, base: Path) -> None:
 # Persistent async checkpointer (orbax keeps a background thread pool; one per process).
 # Created lazily on the first async save; ``wait_for_async_save`` joins any in-flight write.
 _ASYNC_CKPTR = None
+
+# An async save defers its manifest + COMMITTED marker until the background
+# write joins: (path, write_marker, corrupt) — the marker lands in
+# wait_for_async_save, which every save AND load calls first, so no reader can
+# see the snapshot as committed before its bytes are durable. ``corrupt``
+# carries a deferred ckpt.save corruption injection (it must land AFTER the
+# manifest is hashed, or the manifest would faithfully describe corrupt bytes
+# and verification could never catch them).
+_PENDING_COMMIT = None
+
+
+def _corrupt_one_file(path: Path) -> None:
+    """Injected silent corruption: flip one byte of the first manifested file
+    — the bit-rot the marker alone cannot catch and manifest verification
+    must."""
+    files = _manifest_files(path)
+    if not files:
+        return
+    victim = path / files[0]
+    data = bytearray(victim.read_bytes())
+    if data:
+        data[len(data) // 2] ^= 0xFF
+        victim.write_bytes(bytes(data))
 
 
 def _async_checkpointer():
@@ -97,9 +231,19 @@ def _async_checkpointer():
 
 
 def wait_for_async_save() -> None:
-    """Block until any in-flight async checkpoint write has committed to disk."""
+    """Block until any in-flight async checkpoint write has committed to disk
+    (and stamp the deferred integrity manifest + COMMITTED marker — an async
+    snapshot is only *valid* once its background write joined)."""
+    global _PENDING_COMMIT
     if _ASYNC_CKPTR is not None:
         _ASYNC_CKPTR.wait_until_finished()
+    if _PENDING_COMMIT is not None:
+        path, write_marker, corrupt = _PENDING_COMMIT
+        _PENDING_COMMIT = None
+        if write_marker:
+            _write_commit_marker(Path(path))
+        if corrupt:
+            _corrupt_one_file(Path(path))
 
 
 def save_accelerator_state(
@@ -137,6 +281,13 @@ def save_accelerator_state(
         accelerator.wait_for_everyone()
     path = _checkpoint_dir(accelerator, output_dir, for_save=True)
     path.mkdir(parents=True, exist_ok=True)
+    # A re-used directory (overwriting a crashed save, or an explicit path
+    # saved twice) must lose its committed bit FIRST: the marker only ever
+    # describes bytes that are fully on disk.
+    marker = path / COMMIT_MARKER
+    if marker.exists():
+        marker.unlink()
+    pending_async = False
 
     for hook in accelerator._save_model_hooks:
         hook(accelerator._models, train_state, str(path))
@@ -191,6 +342,7 @@ def save_accelerator_state(
                     train_state,
                 )
                 _async_checkpointer().save(sharded_dir, snapshot)
+                pending_async = True
             else:
                 with ocp.StandardCheckpointer() as ckptr:
                     ckptr.save(sharded_dir, train_state)
@@ -246,10 +398,94 @@ def save_accelerator_state(
     with open(path / f"{RNG_STATE_NAME}_{accelerator.process_index}.pkl", "wb") as f:
         pickle.dump(states, f)
 
+    # ---- verified-checkpoint commit (docs/resilience.md): every file hashed
+    # into a sha256 manifest, then the atomic COMMITTED marker written LAST —
+    # a crash anywhere above leaves an uncommitted directory the loader skips.
+    plan = getattr(accelerator, "fault_plan", None)
+    spec = plan.draw("ckpt.save") if plan is not None else None
+    if spec is not None and spec.kind == "crash":
+        from .resilience.faults import InjectedFault
+
+        # Injected mid-save crash: the data files are on disk, the marker is
+        # NOT — exactly the torn state a preemption during save leaves behind.
+        raise InjectedFault("ckpt.save", "crash")
+    if accelerator.num_processes > 1:
+        # Every rank's files (RNG pickles, shards) must exist before the main
+        # process hashes the directory.
+        accelerator.wait_for_everyone()
+    corrupt = spec is not None and spec.kind == "corrupt"
+    if pending_async:
+        # The corruption injection rides the deferred commit: flipping a byte
+        # NOW would be hashed into the manifest at the join and read as valid.
+        global _PENDING_COMMIT
+        _PENDING_COMMIT = (str(path), accelerator.is_main_process, corrupt)
+    else:
+        if accelerator.is_main_process:
+            _write_commit_marker(path)
+        if corrupt:
+            # Injected silent corruption AFTER the commit: a bit flip the
+            # marker alone cannot catch — manifest verification at load must.
+            _corrupt_one_file(path)
     if automatic:
         project.iteration += 1
     logger.info(f"Saved accelerator state to {path}")
     return str(path)
+
+
+def _quarantine_checkpoint(accelerator, cand: Path, base: Path, problems) -> None:
+    """Move an invalid checkpoint out of the ``checkpoint_*`` namespace (so
+    rotation and latest-selection never see it again), count it, and telemeter
+    the fault — corruption must be observable, not silently skipped."""
+    logger.warning(
+        f"checkpoint {cand} failed verification ({'; '.join(problems)}) — "
+        f"quarantining and falling back to the previous valid snapshot"
+    )
+    if accelerator.is_main_process:
+        qdir = base / QUARANTINE_DIR
+        qdir.mkdir(parents=True, exist_ok=True)
+        dest = qdir / cand.name
+        if dest.exists():
+            shutil.rmtree(dest, ignore_errors=True)
+        shutil.move(str(cand), str(dest))
+    accelerator.checkpoints_quarantined = (
+        getattr(accelerator, "checkpoints_quarantined", 0) + 1
+    )
+    tel = getattr(accelerator, "telemetry", None)
+    if tel is not None and getattr(tel, "enabled", False):
+        from .telemetry.schemas import FAULT_SCHEMA, RECOVERY_SCHEMA
+
+        tel.emit({
+            "schema": FAULT_SCHEMA, "site": "ckpt.load", "kind": "corrupt",
+            "checkpoint": cand.name, "problems": list(problems),
+        })
+        tel.emit({
+            "schema": RECOVERY_SCHEMA, "action": "checkpoint_fallback",
+            "quarantined": cand.name,
+            "quarantined_total": accelerator.checkpoints_quarantined,
+        })
+
+
+def _select_valid_checkpoint(accelerator) -> Path:
+    """Newest checkpoint that passes integrity verification; invalid ones
+    (uncommitted mid-save crashes, corrupt files) are quarantined and the
+    search falls back to the next-newest — the automatic-naming load contract
+    (docs/resilience.md)."""
+    project = accelerator.project_configuration
+    if project.project_dir is None:
+        raise ValueError("No output_dir given and no project_dir configured.")
+    base = Path(project.project_dir) / "checkpoints"
+    existing = _list_checkpoints(base)
+    if not existing:
+        raise FileNotFoundError(f"No checkpoints found under {base}")
+    for cand in reversed(existing):
+        problems = verify_checkpoint(cand)
+        if not problems:
+            return cand
+        _quarantine_checkpoint(accelerator, cand, base, problems)
+    raise FileNotFoundError(
+        f"No VALID checkpoint under {base}: all {len(existing)} candidates "
+        f"failed verification (quarantined under {base / QUARANTINE_DIR})"
+    )
 
 
 def load_accelerator_state(
@@ -258,11 +494,29 @@ def load_accelerator_state(
     train_state=None,
     load_optimizer_states: bool = True,
 ):
-    """Restore a snapshot. Returns the restored TrainState (or None if none was given)."""
+    """Restore a snapshot. Returns the restored TrainState (or None if none was given).
+
+    With ``input_dir=None`` (automatic naming) the NEWEST checkpoint that
+    passes integrity verification wins — uncommitted or corrupt ones are
+    quarantined (moved under ``checkpoints/quarantined/``), counted on
+    ``accelerator.checkpoints_quarantined`` and telemetered. An explicit
+    ``input_dir`` that carries a commit marker is verified and raises
+    :class:`CheckpointCorruptError` on mismatch (an explicit path is caller
+    intent — falling back silently would restore the wrong state); marker-less
+    directories (external/interop snapshots) load as before."""
     wait_for_async_save()  # never read a directory whose write hasn't committed
-    path = _checkpoint_dir(accelerator, input_dir, for_save=False)
+    if input_dir is None and accelerator.project_configuration.project_dir is not None:
+        path = _select_valid_checkpoint(accelerator)
+    else:
+        path = _checkpoint_dir(accelerator, input_dir, for_save=False)
     if not path.exists():
         raise FileNotFoundError(f"Checkpoint {path} does not exist")
+    if input_dir is not None and (
+        (path / COMMIT_MARKER).exists() or (path / MANIFEST_NAME).exists()
+    ):
+        problems = verify_checkpoint(path)
+        if problems:
+            raise CheckpointCorruptError(path, problems)
 
     for hook in accelerator._load_model_hooks:
         hook(accelerator._models, train_state, str(path))

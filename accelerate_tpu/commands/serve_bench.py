@@ -118,6 +118,17 @@ def serve_bench_command_parser(subparsers=None) -> argparse.ArgumentParser:
                         help="run the SLO-attainment-vs-offered-load sweep "
                              "(generators x policies x loads) and write the "
                              "BENCH_TRACE.json artifact to this path")
+    parser.add_argument("--chaos", default=None, metavar="OUT_JSON",
+                        help="run the chaos proof: replay one workload trace "
+                             "clean AND under a seeded FaultPlan failing "
+                             "--chaos-rate of decode dispatches, assert zero "
+                             "silently-lost requests + byte-identical "
+                             "recovered streams, and write BENCH_CHAOS.json "
+                             "to this path")
+    parser.add_argument("--chaos-rate", type=float, default=0.15,
+                        help="per-dispatch decode failure probability for "
+                             "--chaos (default 0.15 — above the >=10%% "
+                             "acceptance floor)")
     parser.add_argument("--loads", default="0.5,1.0,2.0,4.0",
                         help="comma-separated offered-load sweep for "
                              "--trace-curves")
@@ -379,11 +390,14 @@ def _warm_serving_surface(params, cfg, max_slots, max_len, prompt_bucket,
 
 def _replay_one_policy(params, cfg, policy, trace, *, max_slots, max_len,
                        prompt_bucket, max_queue, load, step_dt, seed,
-                       page_size=0, kv_pages=None, telemetry=None):
+                       page_size=0, kv_pages=None, telemetry=None,
+                       faults=None, on_token_factory=None):
     """One fresh engine + gateway + virtual-clock replay of ``trace`` under
     ``policy`` → ``(gateway, gateway requests)``. The ONE construction both the
     per-policy rows and the attainment curves run, so they can never measure
-    different gateway configurations."""
+    different gateway configurations. ``faults`` arms the engine's fault
+    boundary with an injected plan (the chaos arm); ``on_token_factory(i)``
+    builds a per-request streaming callback (chaos stream-parity capture)."""
     from ..serving import ContinuousBatcher
     from ..serving_gateway import ServingGateway
     from ..serving_gateway.workload import VirtualClock, replay_trace
@@ -395,7 +409,7 @@ def _replay_one_policy(params, cfg, policy, trace, *, max_slots, max_len,
     engine = ContinuousBatcher(
         params, cfg, max_slots=max_slots, max_len=max_len,
         prompt_bucket=prompt_bucket, page_size=page_size, kv_pages=kv_pages,
-        tracer=tracer,
+        tracer=tracer, faults=faults,
     )
     gw = ServingGateway(
         engine,
@@ -404,7 +418,8 @@ def _replay_one_policy(params, cfg, policy, trace, *, max_slots, max_len,
         telemetry=telemetry, clock=clock, tracer=tracer,
     )
     greqs = replay_trace(gw, trace, cfg.vocab_size, clock,
-                         step_dt=step_dt, load=load, seed=seed)
+                         step_dt=step_dt, load=load, seed=seed,
+                         on_token_factory=on_token_factory)
     if telemetry is not None:
         gw.emit_slo_record()
     return gw, greqs
@@ -570,6 +585,156 @@ def run_trace_curves(
         "seed": seed,
         "provenance": prov,
         "curves": curves,
+    }
+
+
+def _chaos_arm_summary(gw, greqs) -> dict:
+    """One chaos-bench arm's accounting: terminal disposition of EVERY
+    submitted request (a uid with no terminal state would be a silent loss —
+    the thing the fault boundary exists to prevent), availability, latency
+    percentiles, and the engine's recovery counters."""
+    from ..telemetry.slo import latency_summary
+
+    counters = gw.counters
+    estats = gw.engine.stats()
+    submitted = len(greqs)
+    terminal = sum(1 for g in greqs if g.terminal)
+    done = [g for g in greqs if g.status == "done"]
+    return {
+        "submitted": submitted,
+        "terminal": terminal,
+        "silently_lost": submitted - terminal,
+        "done": counters["done"],
+        "failed": counters["failed"],
+        "shed": counters["shed"],
+        "rejected": counters["rejected"],
+        "expired": counters["expired"],
+        "availability": round(counters["done"] / max(1, submitted), 4),
+        "recovered_requests": sum(
+            1 for g in done if getattr(g, "recoveries", 0) > 0
+        ),
+        "ttft": latency_summary([g.ttft_s for g in done]),
+        "tpot": latency_summary([g.tpot_s for g in done]),
+        "engine": {
+            "decode_steps": estats["decode_steps"],
+            "step_failures": estats["step_failures"],
+            "step_fault_rate": round(
+                estats["step_failures"] / max(1, estats["decode_steps"]), 4
+            ),
+            "quarantined": estats["quarantined"],
+            "recovered_admissions": estats["recovered_admissions"],
+            "bisect_rounds": estats["bisect_rounds"],
+        },
+    }
+
+
+def run_chaos_bench(
+    preset: str = "smoke",
+    requests: int = 32,
+    max_slots: int = 4,
+    max_len: int = 128,
+    prompt_bucket: int = 16,
+    overload: float = 4.0,
+    load: float = 1.0,
+    step_dt: float = 1.0,
+    seed: int = 0,
+    policy: str = "fifo",
+    chaos_rate: float = 0.15,
+    generator: str = "poisson",
+    telemetry=None,
+) -> dict:
+    """The chaos proof (BENCH_CHAOS.json): replay ONE workload trace twice —
+    clean, then under a seeded ``FaultPlan`` failing ``chaos_rate`` of decode
+    dispatches — and stamp what recovery delivered: zero silently-lost
+    requests (every submitted uid reaches a machine-readable terminal state),
+    recovered-request token streams BYTE-IDENTICAL to the clean replay
+    (asserted per request, stamped as ``streams_identical``), availability,
+    and faulted-vs-clean p95 TTFT/TPOT on the shared virtual clock."""
+    from ..compile_cache.warmup import build_model_config
+    from ..models import llama
+    from ..resilience.faults import FaultPlan, FaultSpec
+    from ..serving_gateway.workload import generate_workload, trace_hash
+    from ..telemetry.provenance import provenance_stamp
+
+    if not 0.0 < chaos_rate <= 1.0:
+        raise ValueError(f"chaos_rate={chaos_rate} must be in (0, 1]")
+    cfg = build_model_config(preset, max_len)
+    params = llama.init_params(cfg)
+    max_queue = max(1, int(overload * max_slots))
+    mean_iat = _calibrated_iat(max_slots)
+    trace = generate_workload(generator, requests, seed=seed,
+                              mean_iat_s=mean_iat)
+    prov = provenance_stamp(cfg)
+    _warm_serving_surface(params, cfg, max_slots, max_len, prompt_bucket,
+                          seed=seed)
+
+    def stream_capture():
+        streams = {}
+
+        def factory(i):
+            streams[i] = []
+
+            def on_token(tok, i=i):
+                streams[i].append(int(tok))
+
+            def on_retry(i=i):
+                streams[i].clear()  # idempotent replay: reset, then re-deliver
+
+            return on_token, on_retry
+
+        return streams, factory
+
+    common = dict(max_slots=max_slots, max_len=max_len,
+                  prompt_bucket=prompt_bucket, max_queue=max_queue, load=load,
+                  step_dt=step_dt, seed=seed, telemetry=telemetry)
+    clean_streams, clean_factory = stream_capture()
+    gw_clean, greqs_clean = _replay_one_policy(
+        params, cfg, policy, trace, on_token_factory=clean_factory, **common
+    )
+    plan = FaultPlan(
+        [FaultSpec("serving.decode", "error", prob=chaos_rate,
+                   attributed=False)],
+        seed=seed,
+    )
+    chaos_streams, chaos_factory = stream_capture()
+    gw_chaos, greqs_chaos = _replay_one_policy(
+        params, cfg, policy, trace, faults=plan,
+        on_token_factory=chaos_factory, **common
+    )
+
+    # Stream parity: every request DONE in both arms must have produced the
+    # byte-identical token stream (greedy decode + deterministic prompts —
+    # recovery must never change WHAT is generated, only when).
+    compared = mismatched = 0
+    for i in range(len(trace)):
+        if (i < len(greqs_clean) and i < len(greqs_chaos)
+                and greqs_clean[i].status == "done"
+                and greqs_chaos[i].status == "done"):
+            compared += 1
+            if clean_streams.get(i) != chaos_streams.get(i):
+                mismatched += 1
+    clean_arm = _chaos_arm_summary(gw_clean, greqs_clean)
+    chaos_arm = _chaos_arm_summary(gw_chaos, greqs_chaos)
+    return {
+        "schema": "accelerate_tpu.bench.chaos/v1",
+        "preset": preset,
+        "policy": policy,
+        "generator": generator,
+        "requests": requests,
+        "max_slots": max_slots,
+        "max_queue": max_queue,
+        "load": load,
+        "chaos_rate": chaos_rate,
+        "fault_plan": {"seed": seed, "site": "serving.decode",
+                       "kind": "error", "prob": chaos_rate,
+                       "fired": len(plan.fired)},
+        "workload_trace_hash": trace_hash(trace),
+        "provenance": prov,
+        "streams_compared": compared,
+        "streams_identical": mismatched == 0,
+        "streams_mismatched": mismatched,
+        "clean": clean_arm,
+        "chaos": chaos_arm,
     }
 
 
@@ -768,6 +933,41 @@ def run_paged_compare(
 
 def serve_bench_command(args) -> int:
     import json
+
+    if args.chaos:
+        if args.smoke:
+            # CI tier-1 chaos shape: small trace, 2 lanes, still >=10% of
+            # decode dispatches failing.
+            args.requests = min(args.requests, 16)
+            args.max_slots = 2
+            args.max_len = 64
+            args.prompt_bucket = 16
+        artifact = run_chaos_bench(
+            preset=args.preset,
+            requests=args.requests,
+            max_slots=args.max_slots,
+            max_len=args.max_len,
+            prompt_bucket=args.prompt_bucket,
+            overload=args.overload,
+            load=args.load,
+            seed=args.seed,
+            policy=args.policy if args.policy != "all" else "fifo",
+            chaos_rate=args.chaos_rate,
+            generator=args.trace_gen or "poisson",
+        )
+        with open(args.chaos, "w") as f:
+            json.dump(artifact, f, indent=2)
+        print(json.dumps({k: artifact[k] for k in (
+            "schema", "chaos_rate", "workload_trace_hash",
+            "streams_compared", "streams_identical",
+        )} | {
+            "silently_lost": artifact["chaos"]["silently_lost"],
+            "availability_clean": artifact["clean"]["availability"],
+            "availability_chaos": artifact["chaos"]["availability"],
+            "step_fault_rate": artifact["chaos"]["engine"]["step_fault_rate"],
+        }))
+        return 1 if (artifact["chaos"]["silently_lost"]
+                     or not artifact["streams_identical"]) else 0
 
     if args.trace_curves:
         loads = tuple(float(x) for x in args.loads.split(",") if x.strip())

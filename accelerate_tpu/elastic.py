@@ -52,11 +52,19 @@ class ElasticSupervisor:
       non-zero or dies from a signal (preemption shows up as SIGKILL, returncode < 0)
       triggers a group teardown + restart.
     - ``grace_period``: SIGTERM the survivors, escalate to SIGKILL after this many seconds.
+    - ``attempt_timeout``: liveness horizon per attempt — a gang where one worker
+      exits 0 early and another then hangs forever would otherwise be monitored
+      forever; past the horizon the attempt is torn down and counted as failed
+      (the supervisor-level spelling of the serving step watchdog).
+    - ``restart_backoff``: exponential backoff between gang restarts
+      (``backoff × 2^attempt`` seconds, ± ``backoff_jitter`` fractional random
+      jitter so restarting gangs don't stampede a shared coordinator/filesystem).
+      Default 0 preserves the historical immediate restart.
     - ``on_restart(attempt, codes)``: hook for logging/metrics (tested for invocation).
-    - ``telemetry``: an enabled ``telemetry.Telemetry`` makes every restart a
-      ``telemetry.elastic.restart/v1`` record (attempt index, exit codes, budget) —
-      restart history flows to the same sinks as every other metric instead of
-      being log-only.
+    - ``telemetry``: an enabled ``telemetry.Telemetry`` makes every FAILED attempt a
+      ``telemetry.elastic.restart/v1`` record (attempt index, exit codes, budget,
+      ``final``/``timeout`` flags) — including the terminal attempt that exhausts
+      the budget, the one restart event an operator most needs to see.
     """
 
     def __init__(
@@ -69,7 +77,16 @@ class ElasticSupervisor:
         coordinator_port: Optional[int] = None,
         on_restart: Optional[Callable[[int, list], None]] = None,
         telemetry=None,
+        restart_backoff: float = 0.0,
+        backoff_jitter: float = 0.0,
+        attempt_timeout: Optional[float] = None,
     ):
+        if restart_backoff < 0:
+            raise ValueError(f"restart_backoff={restart_backoff} must be >= 0")
+        if not 0.0 <= backoff_jitter <= 1.0:
+            raise ValueError(f"backoff_jitter={backoff_jitter} must be in [0, 1]")
+        if attempt_timeout is not None and attempt_timeout <= 0:
+            raise ValueError(f"attempt_timeout={attempt_timeout} must be > 0")
         self.make_plan = make_plan
         self.max_restarts = max_restarts
         self.monitor_interval = monitor_interval
@@ -78,9 +95,14 @@ class ElasticSupervisor:
         self.coordinator_port = coordinator_port
         self.on_restart = on_restart
         self.telemetry = telemetry
+        self.restart_backoff = restart_backoff
+        self.backoff_jitter = backoff_jitter
+        self.attempt_timeout = attempt_timeout
         self.attempts_used = 0
+        self.attempt_timeouts = 0
 
-    def _emit_restart_record(self, attempt: int, codes: list) -> None:
+    def _emit_restart_record(self, attempt: int, codes: list,
+                             final: bool = False, timeout: bool = False) -> None:
         tel = self.telemetry
         if tel is None or not getattr(tel, "enabled", False):
             return
@@ -92,7 +114,21 @@ class ElasticSupervisor:
             "attempts_used": self.attempts_used,
             "max_restarts": self.max_restarts,
             "exit_codes": list(codes),
+            "final": final,
+            "timeout": timeout,
         })
+
+    def _backoff_delay(self, attempt: int) -> float:
+        """Exponential backoff before restart ``attempt + 1``, with fractional
+        random jitter (restarting gangs must not stampede in lockstep)."""
+        if self.restart_backoff <= 0:
+            return 0.0
+        import random
+
+        delay = self.restart_backoff * (2.0 ** attempt)
+        if self.backoff_jitter:
+            delay *= 1.0 + self.backoff_jitter * (2.0 * random.random() - 1.0)
+        return max(0.0, delay)
 
     def _coordinator(self) -> str:
         port = self.coordinator_port or get_free_port()
@@ -120,32 +156,54 @@ class ElasticSupervisor:
     def run(self) -> int:
         """Run the gang to completion. Returns 0, or raises ``WorkerFailure``."""
         codes: list[Optional[int]] = []
+        timed_out = False
         for attempt in range(self.max_restarts + 1):
             self.attempts_used = attempt + 1
             coordinator = self._coordinator()
             procs = self._spawn(self.make_plan(coordinator))
-            failed = False
+            started = time.monotonic()
+            timed_out = False
             while True:
                 codes = [p.poll() for p in procs]
                 if any(c is not None and c != 0 for c in codes):
-                    failed = True
                     break
                 if all(c == 0 for c in codes):
                     return 0
+                if (self.attempt_timeout is not None
+                        and time.monotonic() - started > self.attempt_timeout):
+                    # Liveness horizon: a gang with one worker exited 0 and
+                    # another hung would otherwise be monitored FOREVER.
+                    timed_out = True
+                    self.attempt_timeouts += 1
+                    break
                 time.sleep(self.monitor_interval)
-            # A worker died (crash or preemption): gang teardown, then maybe restart.
+            # A worker died (crash or preemption) or the attempt overran its
+            # horizon: gang teardown, then maybe restart.
             self._teardown(procs)
             codes = [p.returncode for p in procs]
+            final = attempt >= self.max_restarts
             logger.warning(
-                f"worker group failed with exit codes {codes} "
+                f"worker group {'timed out' if timed_out else 'failed'} with "
+                f"exit codes {codes} "
                 f"(attempt {attempt + 1}/{self.max_restarts + 1})"
             )
-            if attempt < self.max_restarts:
-                self._emit_restart_record(attempt, codes)
-                if self.on_restart is not None:
-                    self.on_restart(attempt, codes)
+            # The record is emitted for EVERY failed attempt — including the
+            # terminal one that exhausts the budget (previously skipped: the
+            # most important restart event never reached telemetry).
+            self._emit_restart_record(attempt, codes, final=final,
+                                      timeout=timed_out)
+            if self.on_restart is not None:
+                self.on_restart(attempt, codes)
+            if not final:
+                delay = self._backoff_delay(attempt)
+                if delay > 0:
+                    logger.warning(
+                        f"backing off {delay:.2f}s before restart "
+                        f"(restart_backoff={self.restart_backoff})"
+                    )
+                    time.sleep(delay)
         raise WorkerFailure(
-            f"worker group failed after {self.max_restarts + 1} attempts "
-            f"(last exit codes {codes})",
+            f"worker group {'timed out' if timed_out else 'failed'} after "
+            f"{self.max_restarts + 1} attempts (last exit codes {codes})",
             codes,
         )

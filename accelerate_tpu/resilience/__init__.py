@@ -1,0 +1,45 @@
+"""Fault tolerance: deterministic fault injection + recovery primitives.
+
+The serving/training stack built in PRs 5-8 assumed the happy path: one
+exception inside ``ContinuousBatcher.step()`` killed the process and every
+in-flight request with it, checkpoints had no integrity story, and the elastic
+supervisor hammered restarts back-to-back. This package is the failure-path
+counterpart (docs/resilience.md):
+
+- :mod:`~accelerate_tpu.resilience.faults` — a seed-driven :class:`FaultPlan`
+  that injects failures (step exceptions, dispatch hangs, non-finite values,
+  KV-pool allocation failures, checkpoint corruption) at named sites, so every
+  recovery path in the stack is exercised deterministically in CI instead of
+  discovered in production. Threaded via ``ACCELERATE_FAULTS`` / a
+  ``FaultConfig`` riding ``AcceleratorState`` like the telemetry/gateway
+  configs; zero overhead when disabled.
+
+The recovery machinery itself lives where the state lives: the serving engine's
+fault boundary + quarantine/bisection (``serving.ContinuousBatcher``), the
+gateway's circuit breaker + request replay (``serving_gateway``), verified
+checkpoints (``checkpointing``), and supervisor backoff/liveness
+(``elastic``). ``serve-bench --chaos`` replays a workload trace under an
+injected plan and stamps the recovery evidence into ``BENCH_CHAOS.json``.
+"""
+
+from .faults import (
+    FaultError,
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    NonFiniteStepError,
+    StepTimeout,
+    StepWatchdog,
+    parse_fault_spec,
+)
+
+__all__ = [
+    "FaultError",
+    "FaultPlan",
+    "FaultSpec",
+    "InjectedFault",
+    "NonFiniteStepError",
+    "StepTimeout",
+    "StepWatchdog",
+    "parse_fault_spec",
+]

@@ -67,7 +67,10 @@ from .generation import (
 from .models import llama
 from .models.llama import init_cache
 from .paged_kv import BlockManager, KVBudgetError, pages_for
+from .resilience.faults import StepWatchdog
 from .telemetry.schemas import (
+    FAULT_SCHEMA,
+    RECOVERY_SCHEMA,
     SERVING_KV_SCHEMA,
     SERVING_SCHEMA,
     SERVING_SPEC_SCHEMA,
@@ -159,6 +162,18 @@ class Request:
     tokens: list = dataclasses.field(default_factory=list)
     done: bool = False
     enqueued_at: float = 0.0             # time.monotonic() at submit (queue-wait metrics)
+    #: Machine-readable failure reason when the fault boundary quarantined this
+    #: request (``step_fault:<kind>`` / ``prefill_fault:<kind>`` /
+    #: ``recovery_unservable:<detail>``); None = never failed. A failed request
+    #: is ``done`` (terminal) with the tokens it got before the fault.
+    failed: Optional[str] = None
+    #: Times this request was re-admitted by crash recovery (each re-admission
+    #: replays prefill over prompt + already-emitted tokens).
+    recoveries: int = 0
+    #: Recovery context: prompt + already-emitted tokens, set when a rebuild
+    #: requeues this request; the next admission prefills THIS instead of the
+    #: prompt (and clears it), so generation resumes at the exact next token.
+    _recover_ctx: Optional[np.ndarray] = dataclasses.field(default=None, repr=False)
 
     def __post_init__(self):
         if self.rng is not None and self.gen.temperature > 0.0:
@@ -428,7 +443,9 @@ class ContinuousBatcher:
                  prompt_bucket: int = 64, prefix_cache: int = 0, telemetry=None,
                  compile_cache=None, prompt_buckets=None, spec_k: int = 0,
                  drafter=None, spec_accept: str = "replay", page_size: int = 0,
-                 kv_pages: Optional[int] = None, tracer=None):
+                 kv_pages: Optional[int] = None, tracer=None, faults=None,
+                 step_timeout_s: Optional[float] = None,
+                 recover: Optional[bool] = None):
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -610,6 +627,39 @@ class ContinuousBatcher:
         self.spec_accepted = 0   # proposed tokens that were emitted (match/accept)
         if self.drafter is not None:
             self.drafter.bind(self)
+        # Fault boundary (docs/resilience.md): ``faults`` is a
+        # ``resilience.FaultPlan`` injecting deterministic failures at the
+        # serving sites; ``step_timeout_s`` arms a StepWatchdog that converts
+        # an overlong dispatch (hang) into the same failure path.
+        # ``recover`` turns the boundary ON: a failed dispatch quarantines the
+        # poison request (terminal ``failed:<reason>``, bisection when
+        # attribution is ambiguous), releases its lane/pages, and rebuilds the
+        # survivors' engine state from prompt + already-emitted tokens so
+        # serving continues. Default: recovery is armed exactly when faults or
+        # a watchdog are (the undisturbed engine stays byte-identical — an
+        # unexpected exception then propagates as before).
+        self.faults = faults
+        self._watchdog = (
+            StepWatchdog(step_timeout_s) if step_timeout_s else None
+        )
+        self.recover = bool(
+            recover if recover is not None
+            else (faults is not None or self._watchdog is not None)
+        )
+        #: Pool size remembered for recovery rebuilds (paged engines).
+        self._kv_pages_total = int(kv_pages) if self.paged else 0
+        #: Speculative decoding master switch: the gateway's degradation rungs
+        #: flip it under pressure. Disabling mid-run is always output-safe
+        #: (verification guarantees correctness; a stale draft cache only
+        #: lowers acceptance), it just reverts decode to one token per step.
+        self.spec_enabled = True
+        self.step_failures = 0        # dispatches the fault boundary caught
+        self.quarantined = 0          # requests terminally failed by recovery
+        self.recovered_admissions = 0  # survivor re-admissions (prefill replays)
+        self.bisect_rounds = 0        # ambiguous-attribution probe rounds
+        self.recovered_uids: set = set()   # engine uids that survived ≥1 rebuild
+        self._suspects: Optional[set] = None  # narrowed poison candidates (uids)
+        self._bisect_hold: list[Request] = []  # suspects held out of admission
 
     # ------------------------------------------------------------------ user API
     def stats(self) -> dict:
@@ -684,6 +734,15 @@ class ContinuousBatcher:
             "spec_accept_rate": (
                 round(self.spec_accepted / self.spec_proposed, 4)
                 if self.spec_proposed else None
+            ),
+            "spec_enabled": self.spec_enabled,
+            "step_failures": self.step_failures,
+            "quarantined": self.quarantined,
+            "recovered_admissions": self.recovered_admissions,
+            "bisect_rounds": self.bisect_rounds,
+            "bisect_held": len(self._bisect_hold),
+            "watchdog_timeouts": (
+                self._watchdog.timeouts if self._watchdog is not None else 0
             ),
         }
 
@@ -801,7 +860,23 @@ class ContinuousBatcher:
             if req.uid == uid:
                 self.queue.remove(req)
                 return True
+        for req in self._bisect_hold:
+            if req.uid == uid:
+                self._bisect_hold.remove(req)
+                self._suspects = None if not self._bisect_hold else self._suspects
+                return True
         return self.evict_slot(uid)
+
+    def set_spec_enabled(self, enabled: bool) -> None:
+        """Toggle speculative decoding at runtime (the gateway degradation
+        rung). Always output-safe: speculation never changes emitted tokens,
+        only how many a dispatch produces — disabling reverts to the plain
+        one-token decode step (warmed alongside the verify program, so the
+        toggle costs no compiles); re-enabling resumes proposals (a
+        ModelDrafter's stale lane cache only lowers acceptance until its lanes
+        cycle)."""
+        if self.spec_k:
+            self.spec_enabled = bool(enabled)
 
     def evict_slot(self, uid: int) -> bool:
         """Free the decode lane holding request ``uid`` (deadline enforcement /
@@ -822,19 +897,221 @@ class ContinuousBatcher:
         if self.paged:
             self.block_mgr.release_slot(slot)
 
+    # ------------------------------------------------------------ fault boundary
+    def _pre_dispatch(self, site: str, active: list[int]) -> float:
+        """Guard hook before a decode/verify dispatch: opens the watchdog
+        window and fires any injected fault due at ``site``. Disabled
+        (no faults, no watchdog) this is two attribute reads."""
+        wd = self._watchdog
+        t0 = wd.open() if wd is not None else 0.0
+        fp = self.faults
+        if fp is not None:
+            uids = [self.slot_req[i].uid for i in active
+                    if self.slot_req[i] is not None]
+            spec = fp.draw(site, uids=uids)
+            if spec is not None:
+                if spec.kind == "hang":
+                    # The stall the watchdog exists to catch: dispatch still
+                    # runs, the post-dispatch check converts the overrun into
+                    # the step-failure path before any token is emitted.
+                    time.sleep(spec.hang_s)
+                else:
+                    raise fp.fault_for(spec, site)
+        return t0
+
+    def _post_dispatch(self, t0: float, site: str = "serving.decode") -> None:
+        if self._watchdog is not None:
+            self._watchdog.check(t0, site)
+
+    def _emit_fault(self, site: str, kind: str, uid, reason: str) -> None:
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            tel.emit({
+                "schema": FAULT_SCHEMA, "site": site, "kind": kind,
+                "uid": uid, "reason": reason, "step": self.decode_steps,
+            })
+
+    def _emit_recovery(self, action: str, **cols) -> None:
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            tel.emit({
+                "schema": RECOVERY_SCHEMA, "action": action,
+                "step": self.decode_steps, **cols,
+            })
+
+    def _quarantine(self, req: Request, reason: str) -> Request:
+        """Terminally fail one request at the boundary: machine-readable
+        ``failed`` reason, lane/pages released, partial tokens kept (they were
+        already streamed). Returned to the caller like any finished request."""
+        req.failed = reason
+        req.done = True
+        self.quarantined += 1
+        for slot, r in enumerate(self.slot_req):
+            if r is req:
+                self.slot_req[slot] = None
+                self._release_lane(slot)
+                break
+        tracer = self.tracer
+        if tracer is not None and tracer.enabled:
+            tracer.event(tracer.handle_for(req.uid), "fault",
+                         step=self.decode_steps, reason=reason)
+        self._emit_recovery("quarantine", uid=req.uid, reason=reason)
+        return req
+
+    def _detach_for_requeue(self, req: Request) -> None:
+        """Pull a live request off its lane (if any) and arm its recovery
+        context — the next admission prefills prompt + emitted tokens."""
+        for slot, r in enumerate(self.slot_req):
+            if r is req:
+                self.slot_req[slot] = None
+                self._release_lane(slot)
+                break
+        req._recover_ctx = (
+            np.concatenate([req.prompt, np.asarray(req.tokens, np.int32)])
+            if req.tokens else req.prompt
+        )
+
+    def _rebuild_survivors(self) -> None:
+        """Reset the device-side engine state (a failed donated dispatch may
+        have left the cache garbage) and requeue every surviving lane at the
+        FRONT of the queue for recovery re-admission. Zero new programs: the
+        fresh cache has the warmed shapes, and re-admission rides the same
+        prefill/insert executables as any admission."""
+        survivors = [r for r in self.slot_req if r is not None]
+        if self.paged:
+            # Drain the prefix registry against the OLD manager FIRST: its
+            # entries hold old-pool page ids, and releasing them against a
+            # fresh manager would drive refcounts negative. The keys land in
+            # the evicted set so re-registration classifies honestly.
+            while self._evict_prefix_lru():
+                pass
+            self.block_mgr = BlockManager(
+                self._kv_pages_total, self.page_size, self.max_slots,
+                self.max_len,
+            )
+            self.cache = llama.init_paged_cache(
+                self.cfg, self.max_slots, self.max_len, self._kv_pages_total,
+                self.page_size,
+            )
+        else:
+            # Dense prefix snapshots are independent row caches (the keep-alive
+            # chunk program never donates) — they survive a cache rebuild.
+            self.cache = init_cache(self.cfg, self.max_slots, self.max_len)
+        self.slot_req = [None] * self.max_slots
+        self.positions[:] = 0
+        self.tokens[:] = 0
+        for req in sorted(survivors, key=lambda r: r.uid, reverse=True):
+            self._detach_for_requeue(req)
+            self.queue.appendleft(req)
+        self._emit_recovery("rebuild", survivors=len(survivors))
+
+    def _recover_step_failure(self, error: Exception,
+                              active_reqs: list[Request]) -> list[Request]:
+        """The decode-dispatch failure path: attribute the poison (directly
+        when the error names a uid, else by bisection over the active set),
+        quarantine it, and rebuild the survivors so the next step continues.
+
+        Bisection contract: a data-poison request is assumed to fail every
+        dispatch it participates in (deterministic reproduction). The probe
+        half keeps running; the held half waits out one clean dispatch and is
+        then requeued as the sole suspect set — the candidate set halves per
+        failing round until one request remains."""
+        self.step_failures += 1
+        site = getattr(error, "site", "serving.decode")
+        kind = getattr(error, "kind", type(error).__name__)
+        uid = getattr(error, "uid", None)
+        self._emit_fault(site, kind, uid, reason=str(error))
+        live = [r for r in active_reqs if not r.done]
+        failed: list[Request] = []
+        if uid is not None and any(r.uid == uid for r in live):
+            victim = next(r for r in live if r.uid == uid)
+            failed.append(self._quarantine(victim, f"step_fault:{kind}"))
+            self._suspects = None
+        else:
+            cands = [r for r in live
+                     if self._suspects is None or r.uid in self._suspects]
+            if not cands:
+                cands = live
+            if len(cands) == 1:
+                failed.append(self._quarantine(cands[0], f"step_fault:{kind}"))
+                self._suspects = None
+            elif cands:
+                half = max(1, len(cands) // 2)
+                probe, hold = cands[:half], cands[half:]
+                for req in hold:
+                    self._detach_for_requeue(req)
+                    self._bisect_hold.append(req)
+                self._suspects = {r.uid for r in probe}
+                self.bisect_rounds += 1
+                self._emit_recovery("bisect", candidates=len(cands),
+                                    probing=len(probe), held=len(hold))
+        if not getattr(error, "pre_dispatch", False):
+            self._rebuild_survivors()
+        return failed
+
+    def _release_bisect_hold(self) -> None:
+        """Requeue the held suspects (FRONT, uid order) as the sole remaining
+        candidates — they carry their recovery context from the detach."""
+        self._suspects = {r.uid for r in self._bisect_hold}
+        for req in sorted(self._bisect_hold, key=lambda r: r.uid,
+                          reverse=True):
+            self.queue.appendleft(req)
+        self._bisect_hold = []
+
+    def _after_clean_step(self, active_reqs: list[Request]) -> None:
+        """Bisection bookkeeping after a clean decode dispatch: a clean probe
+        clears its half — the held suspects requeue as the remaining
+        candidates; a clean dispatch covering EVERY suspect clears the
+        suspicion entirely (the fault was transient, nobody is poisoned)."""
+        if self._bisect_hold:
+            self._release_bisect_hold()
+        elif self._suspects is not None:
+            active_uids = {r.uid for r in active_reqs if not r.done}
+            done_uids = {r.uid for r in active_reqs if r.done}
+            if self._suspects <= (active_uids | done_uids):
+                self._suspects = None
+
     def step(self) -> list[Request]:
         """Admit queued requests, then advance every active slot: one token each
-        (``spec_k == 0``) or a verified 1..spec_k+1-token prefix each (speculative)."""
+        (``spec_k == 0``) or a verified 1..spec_k+1-token prefix each (speculative).
+
+        With recovery armed (``faults``/``step_timeout_s``/``recover=True``) a
+        failed dispatch no longer kills the process: the poison request is
+        quarantined (terminal ``failed:<reason>``, returned like any finished
+        request), its lane/pages are released, and the survivors' state is
+        rebuilt from prompt + already-emitted tokens so the next ``step()``
+        continues the workload (docs/resilience.md)."""
         finished_at_admit = self._admit()
         active = [i for i, r in enumerate(self.slot_req) if r is not None]
         self.peak_active_slots = max(self.peak_active_slots, len(active))
         if not active:
+            if self._bisect_hold:
+                # No probe can run (every lane drained — e.g. the whole probe
+                # half was quarantined or finished): the held suspects are the
+                # only remaining work, and nothing else can exonerate them.
+                # Release them or they would be stranded forever — run()'s
+                # drain would exit (queue and lanes empty) with live requests
+                # parked in the hold, a silent loss.
+                self._release_bisect_hold()
             if finished_at_admit:
                 self._emit_telemetry()  # admissions alone still move the counters
             return finished_at_admit
-        finished = (
-            self._spec_step(active) if self.spec_k else self._plain_step(active)
-        )
+        use_spec = self.spec_k and self.spec_enabled
+        if not self.recover:
+            finished = (
+                self._spec_step(active) if use_spec else self._plain_step(active)
+            )
+        else:
+            active_reqs = [self.slot_req[i] for i in active]
+            try:
+                finished = (
+                    self._spec_step(active) if use_spec
+                    else self._plain_step(active)
+                )
+            except Exception as e:  # the fault boundary: quarantine + rebuild
+                finished = self._recover_step_failure(e, active_reqs)
+            else:
+                self._after_clean_step(active_reqs)
         self.evicted += len(finished)
         self._emit_telemetry()
         # Report in submission order (uid is the admission counter), not slot order —
@@ -847,6 +1124,7 @@ class ContinuousBatcher:
         tracing = tracer is not None and tracer.enabled  # the two-attr-read contract
         t0 = tracer._clock() if tracing else 0.0
         traced = [self.slot_req[i] for i in active] if tracing else ()
+        t_guard = self._pre_dispatch("serving.decode", active)
         if self.paged:
             greedy, logits, self.cache = self._decode_paged_fn(
                 self.params, self.cache, jnp.asarray(self.block_mgr.tables),
@@ -859,6 +1137,7 @@ class ContinuousBatcher:
                 jnp.asarray(self.positions), cfg=self.cfg,
             )
         greedy_host = np.asarray(greedy)
+        self._post_dispatch(t_guard)  # watchdog check BEFORE any token lands
         finished = []
         # Every lane wrote one slot (idle lanes too — static shapes); clamp so an idle
         # lane's position can never run past the cache (its writes then drop out of bounds
@@ -920,6 +1199,7 @@ class ContinuousBatcher:
         seq = np.zeros((self.max_slots, T), np.int32)
         seq[:, 0] = self.tokens  # pending token: emitted last step, not yet written
         seq[:, 1:] = proposals
+        t_guard = self._pre_dispatch("serving.decode", active)
         if self.paged:
             greedy, logits, self.cache = self._spec_verify_paged_fn(
                 self.params, self.cache, jnp.asarray(self.block_mgr.tables),
@@ -932,6 +1212,7 @@ class ContinuousBatcher:
                 jnp.asarray(self.positions), cfg=self.cfg,
             )
         greedy_host = np.asarray(greedy)  # [B, T]
+        self._post_dispatch(t_guard)  # watchdog check BEFORE any token lands
         finished = []
         step_tokens = step_accepted = 0
         for i in active:
@@ -1060,7 +1341,8 @@ class ContinuousBatcher:
         """
         out = []
         t0 = time.perf_counter()
-        while self.queue or any(r is not None for r in self.slot_req):
+        while (self.queue or self._bisect_hold
+               or any(r is not None for r in self.slot_req)):
             out.extend(self.step())
         dt = time.perf_counter() - t0
         if report_throughput:
@@ -1229,13 +1511,57 @@ class ContinuousBatcher:
                 # the head request must keep its place (FIFO — later arrivals never
                 # jump a request waiting for pages).
                 req = self.queue[0]
+                # Recovery re-admission: the context is prompt + already-emitted
+                # tokens and the budget is what REMAINS — for a first admission
+                # both reduce to the historical prompt/max_new values exactly.
+                ctx = req._recover_ctx if req._recover_ctx is not None else req.prompt
+                remaining = req.gen.max_new_tokens - len(req.tokens)
                 # ONE plan decision per admission, threaded to the engine prefill AND
                 # the drafter — the draft cache layout must mirror the engine row's,
                 # so the two must never derive it independently.
-                plan = (
-                    None if self.prefix_cache_size
-                    else self._plan_prefill(len(req.prompt), req.gen.max_new_tokens)
-                )
+                try:
+                    if self.prefix_cache_size:
+                        plan = None
+                        if req._recover_ctx is not None:
+                            # Prefix engines skip _plan_prefill; a recovery
+                            # context that outgrew the cache must still fail
+                            # machine-readably, not scribble past max_len.
+                            chunks = max(1, -(-len(ctx) // self.prompt_bucket))
+                            total = chunks * self.prompt_bucket
+                            if total + remaining > self.max_len:
+                                raise ValueError(
+                                    f"recovery context ({len(ctx)} tokens → "
+                                    f"{total} padded) + remaining budget "
+                                    f"{remaining} exceeds max_len={self.max_len}"
+                                )
+                    else:
+                        plan = self._plan_prefill(len(ctx), remaining)
+                except ValueError as e:
+                    if req._recover_ctx is None or not self.recover:
+                        raise
+                    # Recovery geometry can overflow where the original prompt
+                    # fit (chunk padding of the grown context): fail THIS
+                    # request machine-readably, keep serving the rest.
+                    self.queue.popleft()
+                    finished.append(
+                        self._quarantine(req, f"recovery_unservable:{e}")
+                    )
+                    continue
+                fp = self.faults
+                if fp is not None and self.recover:
+                    spec = fp.draw("serving.prefill", uid=req.uid)
+                    if spec is not None:
+                        # A prefill failure is ALWAYS attributable: the fault
+                        # fired admitting exactly this request. Nothing was
+                        # dispatched, so no rebuild — quarantine and continue.
+                        self.queue.popleft()
+                        self.step_failures += 1
+                        self._emit_fault("serving.prefill", spec.kind, req.uid,
+                                         reason=f"injected:{spec.kind}")
+                        finished.append(
+                            self._quarantine(req, f"prefill_fault:{spec.kind}")
+                        )
+                        continue
                 tracer = self.tracer
                 tracing = tracer is not None and tracer.enabled
                 if tracing:
@@ -1243,7 +1569,26 @@ class ContinuousBatcher:
                     hits0 = self.prefix_hits
                     cow0 = self.block_mgr.cow_count if self.paged else 0
                     adopt0 = self.block_mgr.adopt_count if self.paged else 0
-                prefilled = self._prefill_into_slot(slot, req, plan)
+                try:
+                    prefilled = self._prefill_into_slot(slot, req, plan, ctx,
+                                                        remaining)
+                except Exception as e:
+                    if not self.recover:
+                        raise
+                    # Real prefill failure: quarantine the admitting request
+                    # (attribution is certain), and — since the row insert may
+                    # have consumed the donated cache — rebuild the survivors.
+                    self.queue.popleft()
+                    self.step_failures += 1
+                    kind = getattr(e, "kind", type(e).__name__)
+                    self._emit_fault(getattr(e, "site", "serving.prefill"),
+                                     kind, req.uid, reason=str(e))
+                    finished.append(
+                        self._quarantine(req, f"prefill_fault:{kind}")
+                    )
+                    if not getattr(e, "pre_dispatch", False):
+                        self._rebuild_survivors()
+                    return finished
                 if prefilled is None:
                     # Page pool exhausted: every admission waits until lanes finish
                     # and free pages (the defer counter moved). Nothing was consumed.
@@ -1251,7 +1596,10 @@ class ContinuousBatcher:
                         tracer.count_defer(req.uid)
                     return finished
                 self.queue.popleft()
-                self.queue_waits.append(max(0.0, time.monotonic() - req.enqueued_at))
+                if req._recover_ctx is None:
+                    self.queue_waits.append(
+                        max(0.0, time.monotonic() - req.enqueued_at)
+                    )
                 greedy_dev, logits_dev, prefill_len = prefilled
                 first = (
                     int(np.asarray(greedy_dev)[0])       # fused on-device argmax (4 bytes)
@@ -1261,8 +1609,17 @@ class ContinuousBatcher:
                 if self.drafter is not None:
                     # Same lane, same padded layout: the draft cache row must mirror
                     # the engine row so engine positions index both.
-                    self.drafter.admit(slot, req.prompt, plan)
+                    self.drafter.admit(slot, ctx, plan)
                 self.admitted += 1
+                if req._recover_ctx is not None:
+                    # Recovery re-admission succeeded: the prefill replayed
+                    # prompt + emitted tokens and `first` IS the next emission.
+                    req._recover_ctx = None
+                    req.recoveries += 1
+                    self.recovered_admissions += 1
+                    self.recovered_uids.add(req.uid)
+                    self._emit_recovery("readmit", uid=req.uid,
+                                        tokens_kept=len(req.tokens))
                 self.slot_req[slot] = req
                 self.positions[slot] = prefill_len  # next write = first decode slot
                 self.tokens[slot] = first
@@ -1282,7 +1639,7 @@ class ContinuousBatcher:
                     # right-aligned chunked prefill.
                     mode, width = plan if plan is not None else (
                         "prefix" if hit else "chunk",
-                        max(1, -(-len(req.prompt) // self.prompt_bucket))
+                        max(1, -(-len(ctx) // self.prompt_bucket))
                         * self.prompt_bucket,
                     )
                     tracer.event(
@@ -1291,7 +1648,7 @@ class ContinuousBatcher:
                     )
                     tracer.span(
                         handle, "prefill", t_pf0, t_pf1,
-                        mode=mode, width=int(width), prompt_len=len(req.prompt),
+                        mode=mode, width=int(width), prompt_len=len(ctx),
                         prefix_hit=hit,
                         cow=(self.block_mgr.cow_count - cow0) if self.paged else 0,
                         adopted_pages=(
@@ -1307,38 +1664,48 @@ class ContinuousBatcher:
                     self.evicted += 1  # finished AT admission still cycled the slot
         return finished
 
-    def _prefill_into_slot(self, slot: int, req: Request, plan):
+    def _prefill_into_slot(self, slot: int, req: Request, plan, ctx=None,
+                           remaining: Optional[int] = None):
         """Run one request's prefill and land its KV in lane ``slot`` →
         ``(greedy_dev, logits_dev, prefill_len)``, or None when a paged admission
         must defer on pool pressure (nothing consumed; the request stays queued).
+
+        ``ctx``/``remaining`` are the admission context and generation budget —
+        the request's prompt and full budget normally, prompt + emitted tokens
+        and the residual budget on a recovery re-admission.
 
         Dense: the historical path — single-row prefill, compiled per-slot row
         scatter. Paged: allocate pages (adopting refcounted shared-prefix pages on a
         registry hit), prefill the SAME dense row (identical compute → identical
         tokens), scatter it into the owned pages through the write-id map, then
         register this prompt's prefixes as page lists."""
+        if ctx is None:
+            ctx = req.prompt
+        if remaining is None:
+            remaining = req.gen.max_new_tokens
         if not self.paged:
             row_cache, greedy_dev, logits_dev, prefill_len = self._prefill(
-                req.prompt, req.gen.max_new_tokens, plan
+                ctx, remaining, plan
             )
             # graftlint: disable=recompile-hazard(slot indexes a compile-time cache row; at most max_slots variants, admission-time only)
             self.cache = self._insert_row_fn(self.cache, row_cache, slot=slot, scan_layers=self.cfg.scan_layers)
             return greedy_dev, logits_dev, prefill_len
-        return self._prefill_into_slot_paged(slot, req, plan)
+        return self._prefill_into_slot_paged(slot, req, plan, ctx, remaining)
 
     # ---------------------------------------------------------------- paged admission
-    def _prefill_into_slot_paged(self, slot: int, req: Request, plan):
+    def _prefill_into_slot_paged(self, slot: int, req: Request, plan, ctx,
+                                 remaining: int):
         mgr = self.block_mgr
         ps = self.page_size
-        max_new = req.gen.max_new_tokens
+        max_new = remaining
         hit_len, entry = 0, None
         lookup_chunks = 0
         if self.prefix_cache_size:
             bucket = self.prompt_bucket
-            n_chunks = max(1, -(-len(req.prompt) // bucket))
+            n_chunks = max(1, -(-len(ctx) // bucket))
             total = n_chunks * bucket
             hit_len, entry, lookup_chunks = self._lookup_prefix_paged(
-                req.prompt, n_chunks
+                ctx, n_chunks
             )
         else:
             _, total = plan
@@ -1374,18 +1741,26 @@ class ContinuousBatcher:
         if lookup_chunks:
             if entry is not None:
                 self.prefix_hits += 1
-                self._prefix_reg.move_to_end(req.prompt[:hit_len].tobytes())
+                self._prefix_reg.move_to_end(ctx[:hit_len].tobytes())
             else:
-                self._classify_prefix_miss(req.prompt, lookup_chunks)
+                self._classify_prefix_miss(ctx, lookup_chunks)
         if self.prefix_cache_size:
             # hit_len == 0 and entry is None on a miss — the same call covers both.
             row_cache, greedy_dev, logits_dev, prefill_len = self._prefill_prefix_paged(
-                req.prompt, hit_len, entry, n_chunks, total
+                ctx, hit_len, entry, n_chunks, total
             )
         else:
             row_cache, greedy_dev, logits_dev, prefill_len = self._prefill(
-                req.prompt, max_new, plan
+                ctx, max_new, plan
             )
+        fp = self.faults
+        if fp is not None and self.recover:
+            spec = fp.draw("serving.kv_admit", uid=req.uid)
+            if spec is not None:
+                # Injected page-pool allocation failure: raised BEFORE admit
+                # touches the manager, so nothing leaks; the admission
+                # boundary quarantines this request (always attributable).
+                raise fp.fault_for(spec, "serving.kv_admit", uid=req.uid)
         ids = mgr.admit(slot, n_tokens, adopted=adopted, cow_partial=cow_partial)
         # Row scatter: sentinel out the adopted pages (never written) and everything
         # past the row's own extent; decode writes continue directly into the
@@ -1399,7 +1774,7 @@ class ContinuousBatcher:
             page_size=ps, scan_layers=self.cfg.scan_layers,
         )
         if self.prefix_cache_size:
-            self._register_prefixes_paged(slot, req.prompt)
+            self._register_prefixes_paged(slot, ctx)
         return greedy_dev, logits_dev, prefill_len
 
     def _lookup_prefix_paged(self, prompt: np.ndarray, n_chunks: int):

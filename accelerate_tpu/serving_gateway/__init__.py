@@ -23,6 +23,7 @@ from .gateway import (
     DONE,
     EVICTED,
     EXPIRED,
+    FAILED,
     QUEUED,
     REJECTED,
     RUNNING,
@@ -79,5 +80,6 @@ __all__ = [
     "CANCELLED",
     "EVICTED",
     "EXPIRED",
+    "FAILED",
     "TERMINAL_STATUSES",
 ]

@@ -58,6 +58,7 @@ __all__ = [
     "CANCELLED",
     "EVICTED",
     "EXPIRED",
+    "FAILED",
     "TERMINAL_STATUSES",
 ]
 
@@ -66,13 +67,17 @@ QUEUED = "queued"        # held by the scheduler policy
 RUNNING = "running"      # admitted into an engine slot
 DONE = "done"            # finished normally (EOS / max_new_tokens)
 REJECTED = "rejected"    # refused at admission (reason: queue_full/token_budget/
-#                          kv_budget/unservable)
+#                          kv_budget/unservable/circuit_open)
 SHED = "shed"            # removed from the queue by overload shedding
 CANCELLED = "cancelled"  # withdrawn by cancel(uid) (reason says queued vs running)
 EVICTED = "evicted"      # lost its slot (preemption) with no retry budget left
 EXPIRED = "expired"      # deadline passed (reason says queued vs running)
+FAILED = "failed"        # quarantined by the engine's fault boundary (reason:
+#                          step_fault:<kind>/prefill_fault:<kind>/...)
 
-TERMINAL_STATUSES = frozenset({DONE, REJECTED, SHED, CANCELLED, EVICTED, EXPIRED})
+TERMINAL_STATUSES = frozenset(
+    {DONE, REJECTED, SHED, CANCELLED, EVICTED, EXPIRED, FAILED}
+)
 
 _UNSET = object()  # submit() sentinel: "apply the config default"
 
@@ -104,6 +109,13 @@ class GatewayRequest:
     reason: Optional[str] = None
     tokens: list = dataclasses.field(default_factory=list)
     retries_used: int = 0
+    # Recovery accounting: in-engine crash-recovery re-admissions this request
+    # survived (copied off the engine request), and whole-gateway replay
+    # attempts after an engine restart (reattach_engine) — replays do NOT
+    # consume the preemption retry budget (a restart is not the request's
+    # fault), but they do advance the trace attempt index.
+    recoveries: int = 0
+    replays: int = 0
     # SLO timestamps (gateway clock)
     t_submit: float = 0.0
     t_enqueued: float = 0.0               # this attempt's queue entry (== t_submit
@@ -188,7 +200,26 @@ class ServingGateway:
         self.counters = {
             "submitted": 0, "admitted": 0, "done": 0, "rejected": 0, "shed": 0,
             "cancelled": 0, "expired": 0, "evicted": 0, "retried": 0,
+            "failed": 0, "replayed": 0,
         }
+        # Circuit breaker (docs/resilience.md): closed → open after
+        # breaker_threshold engine step-failures inside breaker_window_s;
+        # open → half_open after the cooldown (one probe request admitted);
+        # probe DONE closes it, probe FAILED re-opens. Failure signal = the
+        # engine's own step_failures counter, read as a delta after each step.
+        self._breaker_state = "closed"
+        self._fail_times: List[float] = []
+        self._breaker_opened_at = 0.0
+        self._probe_uid: Optional[int] = None
+        self._engine_failures_seen = getattr(engine, "step_failures", 0)
+        self.breaker_openings = 0
+        self.breaker_closings = 0
+        # Graceful degradation rungs (config.degrade): each breaker OPEN —
+        # including a re-open after a failed probe — escalates (1: speculative
+        # decoding off; 2: admission bounds halved); a CLOSE (proven-healthy
+        # probe) restores the full configuration.
+        self.degrade_level = 0
+        self._admission_scale = 1.0
 
     # ------------------------------------------------------------------ submit
     def submit(self, prompt, max_new_tokens: Optional[int] = None,
@@ -232,6 +263,23 @@ class ServingGateway:
             # trace must start before admission control can refuse or defer.
             greq._trace = self.tracer.start(greq.uid, tenant=tenant, t=now)
 
+        # Circuit breaker gate: while OPEN every submission is shed-and-
+        # rejected with the machine-readable reason ``circuit_open`` (an
+        # operating condition, like queue_full); after the cooldown ONE probe
+        # request passes through (half-open) and its fate decides the state.
+        if self.config.breaker_threshold and self._breaker_state != "closed":
+            if self._breaker_state == "open":
+                if now - self._breaker_opened_at >= self.config.breaker_cooldown_s:
+                    self._breaker_state = "half_open"
+                    self._probe_uid = None
+                else:
+                    return self._refuse(greq, now, "circuit_open")
+            if self._breaker_state == "half_open":
+                if self._probe_uid is None:
+                    self._probe_uid = greq.uid  # the probe — admitted below
+                else:
+                    return self._refuse(greq, now, "circuit_open")
+
         # Servability + cost: the engine's own KV pricing (``kv_demand`` — the
         # prefill planner's padded width + budget on a dense engine, PAGE-granular
         # demand on a paged one) is the single source of memory truth, so the
@@ -260,11 +308,22 @@ class ServingGateway:
         self._finalize(greq, REJECTED, reason if detail is None else f"{reason}:{detail}", now)
         return greq
 
+    def _effective_bounds(self) -> tuple:
+        """(max_queue, max_queued_tokens) after the degradation scale — rung 2
+        halves both; 0 (unbounded) stays unbounded."""
+        mq = self.config.max_queue
+        mt = self.config.max_queued_tokens
+        if self._admission_scale != 1.0:
+            mq = max(1, int(mq * self._admission_scale)) if mq else 0
+            mt = max(1, int(mt * self._admission_scale)) if mt else 0
+        return mq, mt
+
     def _over_budget(self, incoming_cost: int) -> Optional[str]:
-        if self.config.max_queue and len(self._policy) + 1 > self.config.max_queue:
+        max_queue, max_tokens = self._effective_bounds()
+        if max_queue and len(self._policy) + 1 > max_queue:
             return "queue_full"
-        if (self.config.max_queued_tokens
-                and self._queued_cost + incoming_cost > self.config.max_queued_tokens):
+        if (max_tokens
+                and self._queued_cost + incoming_cost > max_tokens):
             return "token_budget"
         return None
 
@@ -278,9 +337,9 @@ class ServingGateway:
         reason = self._over_budget(greq.cost)
         if reason is None:
             return True
+        max_queue, max_tokens = self._effective_bounds()
         if (self.config.overload != "shed"
-                or (self.config.max_queued_tokens
-                    and greq.cost > self.config.max_queued_tokens)):
+                or (max_tokens and greq.cost > max_tokens)):
             # reject mode, or a newcomer over the budget even against an EMPTY
             # queue — no victim set could ever make room.
             self._refuse(greq, now, reason)
@@ -295,9 +354,8 @@ class ServingGateway:
         qlen, qcost = len(self._policy), self._queued_cost
 
         def fits():
-            len_ok = not self.config.max_queue or qlen + 1 <= self.config.max_queue
-            tok_ok = (not self.config.max_queued_tokens
-                      or qcost + greq.cost <= self.config.max_queued_tokens)
+            len_ok = not max_queue or qlen + 1 <= max_queue
+            tok_ok = not max_tokens or qcost + greq.cost <= max_tokens
             return len_ok, tok_ok
         for victim in pool:
             len_ok, tok_ok = fits()
@@ -363,9 +421,13 @@ class ServingGateway:
 
         # 2) running deadline eviction — the lane frees NOW, so this same step's
         #    admission (below) can refill it: eviction-to-reuse is one step().
+        #    cancel(), not evict_slot(): engine recovery may have PARKED the
+        #    request back in its internal queue (rebuild requeue) or bisect
+        #    hold, where only cancel() finds it — evict_slot would miss it and
+        #    the engine would re-admit a request the gateway already finalized.
         for greq in list(self._running.values()):
             if greq.deadline_at is not None and now > greq.deadline_at:
-                self.engine.evict_slot(greq._engine_req.uid)
+                self.engine.cancel(greq._engine_req.uid)
                 self._running.pop(greq._engine_req.uid, None)
                 greq.tokens = list(greq._engine_req.tokens)
                 self.counters["expired"] += 1
@@ -388,15 +450,151 @@ class ServingGateway:
             free -= 1
 
         # 5) one engine decode step; map engine completions back to gateway state.
+        #    A request the engine's fault boundary quarantined comes back with a
+        #    machine-readable ``failed`` reason → terminal FAILED (retrying a
+        #    poison request would just re-poison the batch).
         for ereq in self.engine.step():
             greq = self._running.pop(ereq.uid, None)
             if greq is None:
                 continue  # engine-direct submission, not gateway-managed
             greq.tokens = list(ereq.tokens)
-            self.counters["done"] += 1
-            self._finalize(greq, DONE, None, self._clock())
+            greq.recoveries = getattr(ereq, "recoveries", 0)
+            t_done = self._clock()
+            failed_reason = getattr(ereq, "failed", None)
+            if failed_reason is not None:
+                self.counters["failed"] += 1
+                self._finalize(greq, FAILED, failed_reason, t_done)
+            else:
+                self.counters["done"] += 1
+                self._finalize(greq, DONE, None, t_done)
             events.append(greq)
+
+        # 6) circuit breaker: observe this step's engine failure delta.
+        if self.config.breaker_threshold:
+            self._breaker_observe(now)
         return sorted(events, key=lambda r: r.uid)
+
+    # ------------------------------------------------------------ circuit breaker
+    def _breaker_observe(self, now: float) -> None:
+        failures = getattr(self.engine, "step_failures", 0)
+        delta = failures - self._engine_failures_seen
+        self._engine_failures_seen = failures
+        if delta > 0:
+            self._fail_times.extend([now] * delta)
+            window = self.config.breaker_window_s
+            self._fail_times = [t for t in self._fail_times if now - t <= window]
+            if self._breaker_state == "half_open":
+                # The probe period saw a failure — whatever request tripped it,
+                # the engine is not healthy: re-open for another cooldown
+                # (and escalate another rung — a failed probe IS repeated
+                # pressure).
+                self._breaker_open(now)
+            elif (self._breaker_state == "closed"
+                  and len(self._fail_times) >= self.config.breaker_threshold):
+                self._breaker_open(now)
+
+    def _breaker_open(self, now: float) -> None:
+        self._breaker_state = "open"
+        self._breaker_opened_at = now
+        self._probe_uid = None
+        self.breaker_openings += 1
+        self._escalate()
+        self._emit_breaker_record("circuit_open", now)
+
+    def _breaker_close(self, now: float) -> None:
+        self._breaker_state = "closed"
+        self._fail_times = []
+        self._probe_uid = None
+        self.breaker_closings += 1
+        # A close is a PROVEN-healthy probe: restore the full configuration.
+        # (One-rung-per-close would ratchet permanently — re-opens can outnumber
+        # closes, so levels left over after the episode ends would never clear.)
+        while self.degrade_level:
+            self._deescalate()
+        self._emit_breaker_record("circuit_close", now)
+
+    def _emit_breaker_record(self, action: str, now: float) -> None:
+        tel = self.telemetry
+        if tel is None or not tel.enabled:
+            return
+        from ..telemetry.schemas import RECOVERY_SCHEMA
+
+        tel.emit({
+            "schema": RECOVERY_SCHEMA, "action": action, "t": now,
+            "openings": self.breaker_openings,
+            "closings": self.breaker_closings,
+            "degrade_level": self.degrade_level,
+        })
+
+    # ------------------------------------------------------- graceful degradation
+    def _escalate(self) -> None:
+        """One rung down under pressure: speculative decoding off first (pure
+        throughput machinery, zero correctness impact), admission bounds
+        halved second (shed load earlier) — each breaker OPEN steps one rung."""
+        if not self.config.degrade or self.degrade_level >= 2:
+            return
+        self.degrade_level += 1
+        if self.degrade_level == 1:
+            if getattr(self.engine, "spec_k", 0):
+                self.engine.set_spec_enabled(False)
+        else:
+            self._admission_scale = 0.5
+
+    def _deescalate(self) -> None:
+        """One rung back up, mirroring the escalation order (the breaker close
+        loops this until the full configuration is restored)."""
+        if not self.config.degrade or self.degrade_level == 0:
+            return
+        if self.degrade_level == 2:
+            self._admission_scale = 1.0
+        elif getattr(self.engine, "spec_k", 0):
+            self.engine.set_spec_enabled(True)
+        self.degrade_level -= 1
+
+    # ------------------------------------------------------------- request replay
+    def reattach_engine(self, engine=None, reason: str = "engine_restart") -> list:
+        """Recover from an engine death/restart: optionally swap in the fresh
+        engine, then re-queue every in-flight request for idempotent replay —
+        each fires its ``on_retry`` stream reset (the consumer drops its
+        buffer; ``on_token`` then re-delivers from the first token, so the
+        final transcript is byte-identical to an undisturbed run) and re-enters
+        the queue under the normal policy. Replays do NOT consume the
+        preemption retry budget; returns the replayed requests."""
+        now = self._clock()
+        if engine is not None:
+            if self.tracer is not None and getattr(engine, "tracer", None) is None:
+                engine.tracer = self.tracer
+            self.engine = engine
+            self._engine_failures_seen = getattr(engine, "step_failures", 0)
+        replayed = []
+        for greq in list(self._running.values()):
+            greq.replays += 1
+            self.counters["replayed"] += 1
+            greq.status = QUEUED
+            greq.tokens = []
+            greq._engine_req = None
+            greq.t_admit = greq.t_first_token = greq.t_last_token = None
+            greq.t_enqueued = now  # the replay's queue wait starts HERE
+            greq.n_streamed = 0
+            if greq.on_retry is not None:
+                greq.on_retry()
+            if self.tracer is not None and greq._trace is not None:
+                greq._trace.attempt = greq.retries_used + greq.replays
+                self.tracer.event(greq._trace, "retry", t=now,
+                                  attempt=greq._trace.attempt, cause=reason)
+            self._policy.push(greq)
+            self._queued_cost += greq.cost
+            replayed.append(greq)
+        self._running.clear()
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            from ..telemetry.schemas import RECOVERY_SCHEMA
+
+            tel.emit({
+                "schema": RECOVERY_SCHEMA, "action": "replay", "t": now,
+                "reason": reason, "replayed": len(replayed),
+            })
+        return replayed
 
     def _free_lanes(self) -> int:
         """Lanes the engine can fill this step: open slots minus requests already
@@ -450,7 +648,8 @@ class ServingGateway:
             # decision; the engine-side binding lets prefill/decode spans
             # attribute to this trace.
             tr.span(greq._trace, "queue", greq.t_enqueued, now,
-                    attempt=greq.retries_used, outcome="admitted")
+                    attempt=greq.retries_used + greq.replays,
+                    outcome="admitted")
             tr.bind_engine(greq._trace, ereq.uid)
 
     def _stream_cb(self, greq: GatewayRequest) -> Callable[[int], None]:
@@ -487,7 +686,10 @@ class ServingGateway:
             victim = min(self._running.values(), key=lambda r: (r.priority, -r.uid))
             if victim.priority >= top.priority:
                 break
-            self.engine.evict_slot(victim._engine_req.uid)
+            # cancel(), not evict_slot(): a recovery-parked victim (engine
+            # queue / bisect hold) would otherwise survive as a zombie copy
+            # generating tokens for a request the gateway requeued.
+            self.engine.cancel(victim._engine_req.uid)
             self._running.pop(victim._engine_req.uid, None)
             if self.tracer is not None:
                 self.tracer.event(victim._trace, "preempt", t=now,
@@ -535,6 +737,14 @@ class ServingGateway:
         greq.reason = reason
         greq.t_done = now
         greq._engine_req = None  # release the engine Request (and its prompt/cache refs)
+        # Half-open probe verdict: the probe's fate decides the breaker.
+        if self._probe_uid is not None and greq.uid == self._probe_uid:
+            if status == DONE:
+                self._breaker_close(now)
+            elif status == FAILED:
+                self._breaker_open(now)  # a failed probe re-opens + escalates
+            else:
+                self._probe_uid = None  # probe never ran (cancel/expiry): re-probe
         tr = self.tracer
         if tr is not None and greq._trace is not None:
             if greq.t_admit is None:
@@ -542,7 +752,7 @@ class ServingGateway:
                 # (t_enqueued — the retry requeue time after a preemption) so
                 # every trace has one, whatever its fate.
                 tr.span(greq._trace, "queue", greq.t_enqueued, now,
-                        attempt=greq.retries_used, outcome=status)
+                        attempt=greq.retries_used + greq.replays, outcome=status)
             tr.event(greq._trace, "terminal", t=now, status=status,
                      reason=reason, n_tokens=len(greq.tokens),
                      retries_used=greq.retries_used,
@@ -618,6 +828,10 @@ class ServingGateway:
             "queued_cost_tokens": self._queued_cost,
             "running": len(self._running),
             **dict(self.counters),
+            "breaker_state": self._breaker_state,
+            "breaker_openings": self.breaker_openings,
+            "breaker_closings": self.breaker_closings,
+            "degrade_level": self.degrade_level,
             "slo": self.slo_summary(),
             "engine": self.engine.stats(),
         }

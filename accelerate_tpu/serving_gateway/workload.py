@@ -315,6 +315,7 @@ def replay_trace(
     load: float = 1.0,
     seed: int = 0,
     max_steps: Optional[int] = None,
+    on_token_factory: Optional[Callable[[int], object]] = None,
 ) -> list:
     """Replay ``trace`` through ``gateway`` on the virtual clock; returns the
     ``GatewayRequest`` per trace row (submission order).
@@ -343,12 +344,22 @@ def replay_trace(
     while i < len(trace) or gateway.queue_depth or gateway.running_count:
         while i < len(trace) and trace[i].arrival_s / load <= clock.t:
             row = trace[i]
+            kwargs = {}
+            if on_token_factory is not None:
+                # Per-request streaming capture (and its on_retry stream
+                # reset): the chaos bench's byte-parity evidence hangs off it.
+                cbs = on_token_factory(i)
+                if isinstance(cbs, tuple):
+                    kwargs["on_token"], kwargs["on_retry"] = cbs
+                else:
+                    kwargs["on_token"] = cbs
             greqs.append(gateway.submit(
                 prompts[i],
                 max_new_tokens=row.output_len,
                 priority=row.priority,
                 deadline_s=row.deadline_s,
                 tenant=row.tenant,
+                **kwargs,
             ))
             i += 1
         gateway.step()

@@ -37,6 +37,7 @@ from .utils.dataclasses import (
     CompileCacheConfig,
     DistributedInitKwargs,
     DistributedType,
+    FaultConfig,
     GatewayConfig,
     GradientAccumulationPlugin,
     MixedPrecisionPolicy,
@@ -344,6 +345,7 @@ class AcceleratorState:
         telemetry_config: Optional[TelemetryConfig] = None,
         compile_cache_config: Optional[CompileCacheConfig] = None,
         gateway_config: Optional[GatewayConfig] = None,
+        fault_config: Optional[FaultConfig] = None,
         _from_accelerator: bool = False,
         **kwargs,
     ):
@@ -388,6 +390,13 @@ class AcceleratorState:
         # override (a policy-name value both enables and selects the policy).
         self.gateway_config = (
             gateway_config if gateway_config is not None else GatewayConfig()
+        )
+        # Fault-injection config rides the state singleton too: the train
+        # step, serving engines, checkpointing and chaos bench all resolve the
+        # ONE plan; the default constructor applies the ACCELERATE_FAULTS env
+        # override (a clause-string value both enables and defines the plan).
+        self.fault_config = (
+            fault_config if fault_config is not None else FaultConfig()
         )
         from .parallel.mesh import MeshConfig, build_mesh
 

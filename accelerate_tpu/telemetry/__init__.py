@@ -31,6 +31,8 @@ from .profiler import ScheduledProfiler
 from .provenance import config_fingerprint, git_commit, provenance_stamp
 from .schemas import (
     AUDIT_PROGRAM_SCHEMA,
+    FAULT_SCHEMA,
+    RECOVERY_SCHEMA,
     SCHEMA_REGISTRY,
     SERVING_KV_SCHEMA,
     SERVING_SCHEMA,
@@ -67,6 +69,8 @@ __all__ = [
     "git_commit",
     "provenance_stamp",
     "AUDIT_PROGRAM_SCHEMA",
+    "FAULT_SCHEMA",
+    "RECOVERY_SCHEMA",
     "SCHEMA_REGISTRY",
     "SERVING_KV_SCHEMA",
     "SERVING_SCHEMA",

@@ -38,6 +38,8 @@ __all__ = [
     "ELASTIC_RESTART_SCHEMA",
     "AUDIT_PROGRAM_SCHEMA",
     "TRACE_SPAN_SCHEMA",
+    "FAULT_SCHEMA",
+    "RECOVERY_SCHEMA",
     "RecordSchema",
     "SCHEMA_REGISTRY",
     "registered_schemas",
@@ -83,6 +85,16 @@ AUDIT_PROGRAM_SCHEMA = "accelerate_tpu.telemetry.audit.program/v1"
 #: admission, prefill, each decode round, retries/preemptions, terminal state —
 #: causally linked to the step/kv/spec records via the engine ``step`` index.
 TRACE_SPAN_SCHEMA = "accelerate_tpu.telemetry.trace.span/v1"
+
+#: One record per fault observed by a recovery boundary (injected OR real):
+#: the site it fired at, the fault kind/reason, the attributed request uid
+#: (None when attribution needed bisection) and the engine step index.
+FAULT_SCHEMA = "accelerate_tpu.telemetry.fault/v1"
+
+#: One record per recovery action: poison-request quarantine, survivor
+#: rebuild, bisection round, circuit-breaker transition, checkpoint fallback.
+#: ``action`` is machine-readable; the other columns are action-specific.
+RECOVERY_SCHEMA = "accelerate_tpu.telemetry.recovery/v1"
 
 
 # --------------------------------------------------------------------- registry
@@ -155,7 +167,8 @@ SCHEMA_REGISTRY: Dict[str, RecordSchema] = {
         _reg(
             GATEWAY_SLO_SCHEMA,
             ("policy", "submitted", "admitted", "done", "rejected", "shed",
-             "cancelled", "expired", "evicted", "retried", "slo"),
+             "cancelled", "expired", "evicted", "retried", "failed",
+             "replayed", "slo"),
             "ServingGateway.emit_slo_record",
             "aggregate SLO percentiles + admission accounting",
         ),
@@ -176,6 +189,18 @@ SCHEMA_REGISTRY: Dict[str, RecordSchema] = {
             ("trace_id", "uid", "span", "t0", "t1", "dur_s"),
             "telemetry.tracing.Tracer",
             "request-scoped lifecycle span (queue/admit/prefill/decode/terminal)",
+        ),
+        _reg(
+            FAULT_SCHEMA,
+            ("site", "kind"),
+            "recovery boundaries (serving/training/checkpointing)",
+            "one fault observed at a recovery boundary (injected or real)",
+        ),
+        _reg(
+            RECOVERY_SCHEMA,
+            ("action",),
+            "recovery boundaries (engine/gateway/checkpointing)",
+            "one recovery action (quarantine/rebuild/bisect/circuit/fallback)",
         ),
     )
 }

@@ -450,6 +450,64 @@ class CompileCacheConfig(KwargsHandler):
         return tuple(buckets)
 
 
+@dataclass
+class FaultConfig(KwargsHandler):
+    """Deterministic fault-injection config (``accelerate_tpu.resilience``).
+
+    **Off by default and free when off**: with the config disabled nothing is
+    constructed and every instrumented site pays one ``is None`` attribute
+    read (the Telemetry contract). Enable explicitly or via
+    ``ACCELERATE_FAULTS`` (explicit arg > env > built-in, the §5 priority
+    order): any non-boolean env value is parsed as the fault clause string
+    (``resilience.faults.parse_fault_spec`` grammar, e.g.
+    ``"seed=7; serving.decode:error:0.1,max=3"``) and both enables injection
+    and defines the plan.
+
+    ``spec`` is the clause string; ``seed`` seeds the plan's per-spec RNG
+    streams (a ``seed=N`` clause inside ``spec`` wins). Build the resolved
+    plan with :meth:`build_plan` — the ``Accelerator`` does this once and
+    exposes it as ``accelerator.fault_plan``.
+    """
+
+    enabled: Optional[bool] = None   # None → env ACCELERATE_FAULTS > False
+    spec: Optional[str] = None       # None → env clause string (when non-boolean)
+    seed: int = 0
+
+    def __post_init__(self):
+        raw = os.environ.get("ACCELERATE_FAULTS")
+        raw_norm = raw.strip().lower() if raw is not None else None
+        raw_is_spec = raw_norm is not None and raw_norm not in (
+            _CACHE_ENV_TRUE | _CACHE_ENV_FALSE
+        )
+        if self.enabled is None:
+            if raw_norm is None:
+                self.enabled = False
+            else:
+                self.enabled = raw_is_spec or raw_norm in _CACHE_ENV_TRUE
+        if self.spec is None and raw_is_spec:
+            self.spec = raw
+        if self.enabled and not self.spec:
+            raise ValueError(
+                "fault injection enabled with no fault clauses: pass spec= "
+                "(or set ACCELERATE_FAULTS to a clause string like "
+                "'serving.decode:error:0.1') — an empty plan would silently "
+                "inject nothing"
+            )
+        if self.spec:
+            # Validate the grammar at construction, not at the first draw.
+            from ..resilience.faults import parse_fault_spec
+
+            parse_fault_spec(self.spec)
+
+    def build_plan(self):
+        """The resolved ``FaultPlan`` (None when disabled)."""
+        if not self.enabled:
+            return None
+        from ..resilience.faults import FaultPlan
+
+        return FaultPlan.from_spec(self.spec, seed=self.seed)
+
+
 #: Env values that toggle ACCELERATE_GATEWAY on/off; anything else must be a policy name.
 _GATEWAY_POLICIES = frozenset({"fifo", "priority", "edf", "wfq"})
 
@@ -495,6 +553,21 @@ class GatewayConfig(KwargsHandler):
     max_retries: int = 0                # default retry budget for preemption-evicted requests
     emit_per_request: bool = True       # telemetry record per terminal request
     max_terminal: int = 4096            # terminal-request history cap (SLO window; 0 = unbounded)
+    # Circuit breaker (docs/resilience.md): after ``breaker_threshold`` engine
+    # step-failures inside ``breaker_window_s``, the breaker OPENS — new
+    # submissions are shed-and-rejected with the machine-readable reason
+    # ``circuit_open`` until ``breaker_cooldown_s`` passes, then ONE probe
+    # request is admitted (half-open); its success closes the breaker, its
+    # failure re-opens. 0 disables the breaker entirely.
+    breaker_threshold: int = 0          # step failures in the window that trip it; 0 = off
+    breaker_window_s: float = 60.0      # sliding failure-count window
+    breaker_cooldown_s: float = 30.0    # open → half-open probe delay
+    # Graceful degradation rungs: each breaker OPEN (re-opens included)
+    # escalates one rung (1: disable speculative decoding on the engine;
+    # 2: halve the admission bounds); a CLOSE — a proven-healthy probe —
+    # restores the full configuration. Repeated pressure sheds optional
+    # throughput machinery before it sheds requests.
+    degrade: bool = False
 
     def __post_init__(self):
         raw = os.environ.get("ACCELERATE_GATEWAY")
@@ -542,6 +615,18 @@ class GatewayConfig(KwargsHandler):
         if self.max_terminal < 0:
             raise ValueError(
                 f"max_terminal={self.max_terminal} must be >= 0 (0 = unbounded)"
+            )
+        if self.breaker_threshold < 0:
+            raise ValueError(
+                f"breaker_threshold={self.breaker_threshold} must be >= 0 (0 = off)"
+            )
+        if self.breaker_window_s <= 0:
+            raise ValueError(
+                f"breaker_window_s={self.breaker_window_s} must be > 0"
+            )
+        if self.breaker_cooldown_s <= 0:
+            raise ValueError(
+                f"breaker_cooldown_s={self.breaker_cooldown_s} must be > 0"
             )
         if self.tenant_weights is not None:
             for tenant, weight in self.tenant_weights.items():

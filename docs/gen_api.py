@@ -97,6 +97,7 @@ MODULES = [
     ("accelerate_tpu.telemetry.provenance", "Artifact provenance"),
     ("accelerate_tpu.serving_gateway.workload", "Workload traces & replay"),
     ("accelerate_tpu.commands.trace_report", "Trace report CLI"),
+    ("accelerate_tpu.resilience.faults", "Fault injection & recovery primitives"),
     ("accelerate_tpu.models.llama", "Llama family"),
     ("accelerate_tpu.models.lora", "LoRA fine-tuning"),
     ("accelerate_tpu.models.gpt", "GPT family"),

@@ -221,6 +221,112 @@ def test_restart_emits_telemetry_record(tmp_path):
     assert records[0]["max_restarts"] == 2
 
 
+def test_terminal_attempt_emits_final_record(tmp_path):
+    """ISSUE 9 satellite: the restart record is emitted for the attempt that
+    EXHAUSTS the budget too (previously skipped — the most important restart
+    event never reached telemetry), flagged ``final``; on_restart fires for it
+    as well."""
+    from accelerate_tpu.telemetry import ELASTIC_RESTART_SCHEMA, Telemetry
+    from accelerate_tpu.utils.dataclasses import TelemetryConfig
+
+    tel = Telemetry(TelemetryConfig(
+        enabled=True, compile_events=False, memory_stats=False
+    ))
+    hooks = []
+
+    def make_plan(coordinator):
+        return [(_worker_cmd("import sys; sys.exit(3)"), None)]
+
+    sup = ElasticSupervisor(
+        make_plan, max_restarts=1, monitor_interval=0.05, telemetry=tel,
+        on_restart=lambda attempt, codes: hooks.append((attempt, codes)),
+    )
+    with pytest.raises(WorkerFailure):
+        sup.run()
+    records = [r for r in tel.records if r.get("schema") == ELASTIC_RESTART_SCHEMA]
+    assert len(records) == 2, records
+    assert [r["final"] for r in records] == [False, True]
+    assert all(3 in r["exit_codes"] for r in records)
+    assert [h[0] for h in hooks] == [0, 1]
+
+
+def test_restart_backoff_spacing(tmp_path, monkeypatch):
+    """restart_backoff sleeps exponentially (backoff x 2^attempt) BETWEEN
+    restarts — never after the terminal attempt — and default 0 preserves the
+    historical immediate restart."""
+    sleeps = []
+
+    import accelerate_tpu.elastic as elastic_mod
+
+    orig_sleep = elastic_mod.time.sleep
+
+    def record_sleep(s):
+        if s >= 0.5:  # backoff sleeps only (monitor interval is 0.05)
+            sleeps.append(s)
+        else:
+            orig_sleep(s)
+
+    monkeypatch.setattr(elastic_mod.time, "sleep", record_sleep)
+
+    def make_plan(coordinator):
+        return [(_worker_cmd("import sys; sys.exit(3)"), None)]
+
+    sup = ElasticSupervisor(make_plan, max_restarts=2, monitor_interval=0.05,
+                            restart_backoff=0.5)
+    with pytest.raises(WorkerFailure):
+        sup.run()
+    # 3 attempts -> 2 restarts -> 2 backoff sleeps: 0.5, 1.0 (no jitter)
+    assert sleeps == [0.5, 1.0], sleeps
+
+    sleeps.clear()
+    sup = ElasticSupervisor(make_plan, max_restarts=1, monitor_interval=0.05)
+    with pytest.raises(WorkerFailure):
+        sup.run()
+    assert sleeps == []  # default: immediate restart, unchanged
+
+
+def test_backoff_jitter_bounds():
+    sup = ElasticSupervisor(lambda c: [], restart_backoff=1.0,
+                            backoff_jitter=0.5)
+    for attempt in range(3):
+        for _ in range(20):
+            d = sup._backoff_delay(attempt)
+            base = 1.0 * 2 ** attempt
+            assert 0.5 * base <= d <= 1.5 * base
+    with pytest.raises(ValueError, match="backoff_jitter"):
+        ElasticSupervisor(lambda c: [], backoff_jitter=2.0)
+    with pytest.raises(ValueError, match="restart_backoff"):
+        ElasticSupervisor(lambda c: [], restart_backoff=-1.0)
+
+
+def test_attempt_timeout_tears_down_hung_gang(tmp_path):
+    """ISSUE 9 satellite: a gang where one worker exits 0 and another hangs
+    forever used to be monitored forever — attempt_timeout is the liveness
+    horizon that tears it down and counts the attempt as failed."""
+    from accelerate_tpu.telemetry import ELASTIC_RESTART_SCHEMA, Telemetry
+    from accelerate_tpu.utils.dataclasses import TelemetryConfig
+
+    tel = Telemetry(TelemetryConfig(
+        enabled=True, compile_events=False, memory_stats=False
+    ))
+
+    def make_plan(coordinator):
+        return [
+            (_worker_cmd("import sys; sys.exit(0)"), None),  # exits 0 early
+            (_worker_cmd(HANG), None),                       # hangs forever
+        ]
+
+    sup = ElasticSupervisor(make_plan, max_restarts=0, monitor_interval=0.05,
+                            grace_period=1.0, attempt_timeout=1.0,
+                            telemetry=tel)
+    with pytest.raises(WorkerFailure, match="timed out"):
+        sup.run()
+    assert sup.attempt_timeouts == 1
+    records = [r for r in tel.records if r.get("schema") == ELASTIC_RESTART_SCHEMA]
+    assert len(records) == 1 and records[0]["timeout"] is True
+    assert records[0]["final"] is True
+
+
 def test_no_restart_no_telemetry_record(tmp_path):
     """A clean run emits no restart records; a disabled Telemetry is never written to."""
     from accelerate_tpu.telemetry import ELASTIC_RESTART_SCHEMA, Telemetry

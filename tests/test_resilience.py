@@ -1,0 +1,934 @@
+"""Fault-tolerance layer (docs/resilience.md): deterministic injection, serving
+crash recovery with bitwise survivor/replay parity, circuit breaker, verified
+checkpoints, non-finite training guard, chaos bench artifact."""
+
+import dataclasses
+import json
+import subprocess
+import sys
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from accelerate_tpu.models import llama
+from accelerate_tpu.resilience.faults import (
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    NonFiniteStepError,
+    StepTimeout,
+    StepWatchdog,
+    parse_fault_spec,
+)
+from accelerate_tpu.serving import ContinuousBatcher
+from accelerate_tpu.serving_gateway import FAILED, ServingGateway
+from accelerate_tpu.utils.dataclasses import FaultConfig, GatewayConfig
+
+CFG = dataclasses.replace(llama.CONFIGS["tiny"], dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = llama.init_params(CFG)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, CFG.vocab_size, int(n)).astype(np.int32)
+               for n in (5, 9, 3, 7, 6, 4)]
+    return params, prompts
+
+
+def make_engine(params, **kw):
+    kw.setdefault("max_slots", 3)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prompt_bucket", 16)
+    return ContinuousBatcher(params, CFG, **kw)
+
+
+def clean_reference(params, prompts, n_new=8):
+    eng = make_engine(params)
+    reqs = [eng.submit(p, max_new_tokens=n_new) for p in prompts]
+    eng.run()
+    return [r.tokens for r in reqs]
+
+
+class ManualClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# ------------------------------------------------------------------ fault plan
+def test_fault_plan_deterministic_by_seed():
+    """Same (seed, site-invocation) → same firing pattern, independent of other
+    sites' interleaving."""
+    def pattern(seed, interleave):
+        plan = FaultPlan(
+            [FaultSpec("serving.decode", "error", prob=0.3)], seed=seed
+        )
+        out = []
+        for i in range(40):
+            if interleave:
+                plan.draw("serving.prefill")  # other-site traffic
+            out.append(plan.draw("serving.decode") is not None)
+        return out
+
+    assert pattern(7, False) == pattern(7, True)
+    assert pattern(7, False) != pattern(8, False)
+
+
+def test_fault_plan_window_budget_and_match():
+    plan = FaultPlan([
+        FaultSpec("s", "error", prob=1.0, start=2, stop=4),
+        FaultSpec("s", "hang", prob=1.0, start=10, max_fires=1),
+    ])
+    fired = [plan.draw("s") for _ in range(12)]
+    kinds = [None if s is None else s.kind for s in fired]
+    assert kinds[:6] == [None, None, "error", "error", None, None]
+    assert kinds[10] == "hang" and kinds[11] is None  # budget spent
+
+    plan = FaultPlan([FaultSpec("s", "error", match_uid=5)])
+    assert plan.draw("s", uids=[1, 2]) is None
+    assert plan.draw("s", uids=[1, 5]) is not None
+    assert plan.fired[0]["uid"] == 5
+
+
+def test_fault_spec_parse_roundtrip():
+    specs, seed = parse_fault_spec(
+        "seed=7; serving.decode:error:0.1,max=3,uid=5 ;"
+        "ckpt.save:crash,start=2; serving.decode:hang,hang_s=0.5,attributed=false"
+    )
+    assert seed == 7 and len(specs) == 3
+    assert specs[0].prob == 0.1 and specs[0].max_fires == 3 and specs[0].match_uid == 5
+    assert specs[1].kind == "crash" and specs[1].start == 2
+    assert specs[2].hang_s == 0.5 and specs[2].attributed is False
+    with pytest.raises(ValueError, match="unknown key"):
+        parse_fault_spec("s:error,bogus=1")
+    with pytest.raises(ValueError, match="kind"):
+        parse_fault_spec("s:explode")
+
+
+def test_fault_config_env(monkeypatch):
+    monkeypatch.delenv("ACCELERATE_FAULTS", raising=False)
+    assert FaultConfig().enabled is False
+    assert FaultConfig().build_plan() is None
+    monkeypatch.setenv("ACCELERATE_FAULTS", "serving.decode:error:0.5")
+    fc = FaultConfig()
+    assert fc.enabled and fc.spec == "serving.decode:error:0.5"
+    plan = fc.build_plan()
+    assert isinstance(plan, FaultPlan) and plan.specs[0].prob == 0.5
+    monkeypatch.setenv("ACCELERATE_FAULTS", "0")
+    assert FaultConfig().enabled is False
+    monkeypatch.setenv("ACCELERATE_FAULTS", "1")
+    with pytest.raises(ValueError, match="no fault clauses"):
+        FaultConfig()
+
+
+def test_watchdog():
+    clock = ManualClock()
+    wd = StepWatchdog(0.5, clock=clock)
+    t0 = wd.open()
+    clock.advance(0.4)
+    wd.check(t0)  # within budget
+    t0 = wd.open()
+    clock.advance(0.6)
+    with pytest.raises(StepTimeout):
+        wd.check(t0)
+    assert wd.timeouts == 1
+
+
+# ------------------------------------------------------- engine crash recovery
+def test_poison_quarantine_preserves_survivors_bitwise(setup):
+    """An attributed decode fault quarantines exactly the poison request
+    (terminal failed:<reason>); every survivor's tokens are BITWISE the
+    undisturbed run's."""
+    params, prompts = setup
+    clean = clean_reference(params, prompts)
+    plan = FaultPlan([FaultSpec("serving.decode", "error", prob=1.0,
+                                match_uid=1, max_fires=1)])
+    eng = make_engine(params, faults=plan)
+    reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    done = eng.run()
+    assert len(done) == len(reqs)  # failed requests are returned too
+    assert reqs[1].done and reqs[1].failed == "step_fault:error"
+    for i, r in enumerate(reqs):
+        if i != 1:
+            assert r.failed is None
+            assert r.tokens == clean[i], f"survivor {i} diverged"
+    s = eng.stats()
+    assert s["step_failures"] == 1 and s["quarantined"] == 1
+
+
+def test_unattributed_fault_bisects_to_the_poison(setup):
+    """A fault that reproduces whenever request 2 is active but names no uid
+    forces the bisection fallback — it must converge on exactly that request,
+    with survivors bitwise intact."""
+    params, prompts = setup
+    clean = clean_reference(params, prompts)
+    plan = FaultPlan([FaultSpec("serving.decode", "error", prob=1.0,
+                                match_uid=2, attributed=False)])
+    eng = make_engine(params, faults=plan)
+    reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.run()
+    assert reqs[2].failed is not None and reqs[2].done
+    assert eng.bisect_rounds >= 1
+    for i, r in enumerate(reqs):
+        if i != 2:
+            assert r.failed is None and r.tokens == clean[i]
+
+
+def test_watchdog_converts_hang_into_recovery(setup):
+    """An injected dispatch hang over the step budget takes the SAME failure
+    path (no token emitted by the timed-out step); a transient hang quarantines
+    nobody — every request still finishes with clean-run tokens."""
+    params, prompts = setup
+    clean = clean_reference(params, prompts)
+    plan = FaultPlan([FaultSpec("serving.decode", "hang", prob=1.0,
+                                max_fires=1, hang_s=0.1, attributed=False)])
+    eng = make_engine(params, faults=plan, step_timeout_s=0.02)
+    reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.run()
+    assert eng.stats()["watchdog_timeouts"] == 1
+    assert eng.step_failures == 1
+    for i, r in enumerate(reqs):
+        assert r.failed is None and r.tokens == clean[i]
+
+
+def test_prefill_fault_quarantines_admitting_request(setup):
+    params, prompts = setup
+    clean = clean_reference(params, prompts)
+    plan = FaultPlan([FaultSpec("serving.prefill", "error", prob=1.0,
+                                max_fires=1, start=2)])
+    eng = make_engine(params, faults=plan)
+    reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.run()
+    failed = [r for r in reqs if r.failed]
+    assert len(failed) == 1 and failed[0].failed == "prefill_fault:error"
+    assert failed[0].tokens == []  # failed AT admission, nothing streamed
+    for i, r in enumerate(reqs):
+        if r.failed is None:
+            assert r.tokens == clean[i]
+
+
+def test_paged_kv_admit_fault_releases_cleanly(setup):
+    """An injected page-pool allocation failure quarantines the admitting
+    request without leaking pages; survivors drain and the pool returns to
+    empty."""
+    params, prompts = setup
+    plan = FaultPlan([FaultSpec("serving.kv_admit", "error", prob=1.0,
+                                max_fires=1, start=1)])
+    eng = make_engine(params, faults=plan, page_size=8)
+    ref = make_engine(params, page_size=8)
+    ref_reqs = [ref.submit(p, max_new_tokens=8) for p in prompts]
+    ref.run()
+    reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.run()
+    failed = [r for r in reqs if r.failed]
+    assert len(failed) == 1
+    for i, r in enumerate(reqs):
+        if r.failed is None:
+            assert r.tokens == ref_reqs[i].tokens
+    assert eng.block_mgr.stats()["pages_in_use"] == 0
+
+
+def test_recovery_with_prefix_cache_engine(setup):
+    """Recovery on a prefix-cache engine: the rebuild keeps the dense snapshot
+    registry (keep-alive chunk programs never donate), re-admission replays
+    through the right-aligned chunked path, survivors bitwise intact."""
+    params, prompts = setup
+    ref = make_engine(params, prefix_cache=4)
+    ref_reqs = [ref.submit(p, max_new_tokens=8) for p in prompts]
+    ref.run()
+    plan = FaultPlan([FaultSpec("serving.decode", "error", prob=1.0,
+                                max_fires=1, attributed=False)])
+    eng = make_engine(params, prefix_cache=4, faults=plan)
+    reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.run()
+    # One transient unattributed fault: bisection must convict NOBODY (the
+    # probe runs clean and suspicion clears); every request recovers with
+    # reference-identical tokens through the chunked re-prefill.
+    assert eng.step_failures == 1 and eng.quarantined == 0
+    assert eng.recovered_admissions > 0
+    for i in range(len(prompts)):
+        assert reqs[i].failed is None and reqs[i].tokens == ref_reqs[i].tokens
+
+
+def test_paged_prefix_recovery_rebuild(setup):
+    """Regression (review): a rebuild on a paged engine with REGISTERED prefix
+    entries must drain the registry against the OLD pool before replacing the
+    manager — releasing old page ids against the fresh manager drove refcounts
+    negative and the recovery path itself crashed."""
+    params, prompts = setup
+    long = np.tile(prompts[1], 4)[:32].astype(np.int32)  # registers full chunks
+    ref = make_engine(params, page_size=8, prefix_cache=4)
+    ref_reqs = [ref.submit(p, max_new_tokens=8) for p in [long] + list(prompts[:3])]
+    ref.run()
+    # start=3: fire AFTER the prefix registry has entries, unattributed with a
+    # real rebuild (hang + watchdog → pre_dispatch False).
+    plan = FaultPlan([FaultSpec("serving.decode", "hang", prob=1.0, start=3,
+                                max_fires=1, hang_s=0.1, attributed=False)])
+    eng = make_engine(params, page_size=8, prefix_cache=4, faults=plan,
+                      step_timeout_s=0.02)
+    reqs = [eng.submit(p, max_new_tokens=8) for p in [long] + list(prompts[:3])]
+    eng.run()
+    assert eng.step_failures == 1 and eng.recovered_admissions > 0
+    for i, r in enumerate(reqs):
+        assert r.failed is None and r.tokens == ref_reqs[i].tokens, i
+    assert eng.block_mgr.stats()["pages_in_use"] >= 0  # no refcount underflow
+
+
+def test_bisect_hold_released_when_no_lanes_active(setup):
+    """Regression (review): with the whole probe half quarantined and the
+    queue empty, held suspects used to be stranded forever (run() drained with
+    live requests parked in the hold — a silent loss)."""
+    params, prompts = setup
+    clean = clean_reference(params, prompts[:2], n_new=6)
+    # Two requests, two lanes; two consecutive unattributed failures: round 1
+    # bisects (hold one, probe one), round 2 convicts the probe as the sole
+    # candidate — leaving no active lanes and the survivor in the hold.
+    plan = FaultPlan([FaultSpec("serving.decode", "error", prob=1.0,
+                                max_fires=2, attributed=False)])
+    eng = make_engine(params, max_slots=2, faults=plan)
+    reqs = [eng.submit(p, max_new_tokens=6) for p in prompts[:2]]
+    done = eng.run()
+    assert len(done) == 2  # nobody stranded: every request reached terminal
+    assert not eng._bisect_hold
+    survivors = [r for r in reqs if r.failed is None]
+    assert survivors, [r.failed for r in reqs]
+    for r in survivors:
+        i = reqs.index(r)
+        assert r.tokens == clean[i]
+
+
+def test_recovery_sampled_request_resumes_key_schedule(setup):
+    """A sampled request that survives a rebuild keeps emitting with its own
+    per-emission key schedule (emission m consumes key m) — recovery output is
+    token-identical to the undisturbed sampled run."""
+    import jax
+
+    from accelerate_tpu.generation import GenerationConfig
+
+    params, prompts = setup
+    gen = GenerationConfig(max_new_tokens=8, temperature=0.8, top_k=5)
+
+    def run(faults=None):
+        eng = make_engine(params, faults=faults)
+        reqs = [
+            eng.submit(p, gen=gen, rng=jax.random.PRNGKey(100 + i))
+            for i, p in enumerate(prompts[:4])
+        ]
+        eng.run()
+        return reqs
+
+    clean = run()
+    plan = FaultPlan([FaultSpec("serving.decode", "error", prob=1.0,
+                                match_uid=1, max_fires=1)])
+    faulted = run(plan)
+    assert faulted[1].failed is not None
+    for i in (0, 2, 3):
+        assert faulted[i].tokens == clean[i].tokens, i
+
+
+def test_recovery_zero_extra_compiles(setup):
+    """Recovery rides the existing program surface: quarantine + rebuild +
+    re-prefill of survivors compiles NOTHING once the engine's programs are
+    warm (CompileMonitor-gated — the acceptance criterion)."""
+    from accelerate_tpu.telemetry import CompileMonitor
+
+    params, prompts = setup
+    mon = CompileMonitor()
+    mon.start()
+    try:
+        warm = make_engine(params)
+        for p in prompts:
+            warm.submit(p, max_new_tokens=8)
+        warm.run()
+        seen = mon.count
+        plan = FaultPlan([FaultSpec("serving.decode", "error", prob=1.0,
+                                    match_uid=2, attributed=False)])
+        eng = make_engine(params, faults=plan)
+        for p in prompts:
+            eng.submit(p, max_new_tokens=8)
+        eng.run()
+        assert eng.step_failures >= 1  # recovery actually exercised
+        assert mon.count - seen == 0, (
+            f"recovery compiled {mon.count - seen} new programs"
+        )
+    finally:
+        mon.stop()
+
+
+def test_fault_and_recovery_telemetry_records(setup):
+    from accelerate_tpu.telemetry import (
+        FAULT_SCHEMA,
+        RECOVERY_SCHEMA,
+        Telemetry,
+        validate_record,
+    )
+    from accelerate_tpu.utils.dataclasses import TelemetryConfig
+
+    params, prompts = setup
+    tel = Telemetry(TelemetryConfig(enabled=True, compile_events=False,
+                                    memory_stats=False))
+    plan = FaultPlan([FaultSpec("serving.decode", "error", prob=1.0,
+                                match_uid=1, max_fires=1)])
+    eng = make_engine(params, faults=plan, telemetry=tel)
+    for p in prompts[:4]:
+        eng.submit(p, max_new_tokens=6)
+    eng.run()
+    faults = [r for r in tel.records if r.get("schema") == FAULT_SCHEMA]
+    recov = [r for r in tel.records if r.get("schema") == RECOVERY_SCHEMA]
+    assert faults and recov
+    for r in faults + recov:
+        assert validate_record(r) == [], r
+    assert any(r["action"] == "quarantine" and r["uid"] == 1 for r in recov)
+
+
+def test_recovery_trace_shows_two_attempts(setup):
+    """A recovered request's trace carries the fault event AND a second
+    admit/prefill pair — the full two-attempt timeline trace-report renders."""
+    from accelerate_tpu.telemetry.tracing import Tracer
+
+    params, prompts = setup
+    spans = []
+    tracer = Tracer(sink=spans.append)
+    clock = ManualClock()
+    plan = FaultPlan([FaultSpec("serving.decode", "error", prob=1.0,
+                                max_fires=1, start=1, attributed=False)])
+    eng = make_engine(params, max_slots=2, faults=plan, tracer=tracer)
+    gw = ServingGateway(eng, GatewayConfig(enabled=True), clock=clock,
+                        tracer=tracer)
+    greqs = [gw.submit(p, max_new_tokens=6) for p in prompts[:2]]
+    while gw.queue_depth or gw.running_count:
+        gw.step()
+        clock.advance(1.0)
+    assert all(g.terminal for g in greqs)
+    recovered = [g for g in greqs if g.status == "done" and g.recoveries > 0]
+    assert recovered, [  # at least one survivor was rebuilt and re-admitted
+        (g.status, g.recoveries) for g in greqs
+    ]
+    uid = recovered[0].uid
+    mine = [s for s in spans if s["uid"] == uid]
+    kinds = [s["span"] for s in mine]
+    assert "fault" in kinds or kinds.count("prefill") >= 2
+    assert kinds.count("prefill") >= 2, kinds  # attempt 1 + recovery re-admit
+    assert kinds[-1] == "terminal"
+
+
+# --------------------------------------------------------------- gateway layer
+def test_gateway_failed_terminal_status_and_record(setup):
+    from accelerate_tpu.telemetry import GATEWAY_REQUEST_SCHEMA, Telemetry, validate_record
+    from accelerate_tpu.utils.dataclasses import TelemetryConfig
+
+    params, prompts = setup
+    tel = Telemetry(TelemetryConfig(enabled=True, compile_events=False,
+                                    memory_stats=False))
+    plan = FaultPlan([FaultSpec("serving.decode", "error", prob=1.0,
+                                match_uid=0, max_fires=1)])
+    eng = make_engine(params, faults=plan)
+    gw = ServingGateway(eng, GatewayConfig(enabled=True), telemetry=tel)
+    greqs = [gw.submit(p, max_new_tokens=6) for p in prompts[:3]]
+    gw.run()
+    failed = [g for g in greqs if g.status == FAILED]
+    assert len(failed) == 1
+    assert failed[0].reason == "step_fault:error"
+    assert gw.counters["failed"] == 1
+    recs = [r for r in tel.records
+            if r.get("schema") == GATEWAY_REQUEST_SCHEMA
+            and r["status"] == FAILED]
+    assert len(recs) == 1 and validate_record(recs[0]) == []
+    assert gw.slo_summary()["by_status"]["failed"] == 1
+
+
+def test_circuit_breaker_transitions_manual_clock(setup):
+    """closed → open (K failures in window, submits reject with circuit_open)
+    → half-open after cooldown (one probe admitted, others rejected) → closed
+    on probe success."""
+    params, prompts = setup
+    clock = ManualClock()
+    plan = FaultPlan([FaultSpec("serving.decode", "error", prob=1.0,
+                                max_fires=2, attributed=False)])
+    eng = make_engine(params, max_slots=2, faults=plan)
+    gw = ServingGateway(
+        eng,
+        GatewayConfig(enabled=True, breaker_threshold=2,
+                      breaker_window_s=100.0, breaker_cooldown_s=5.0),
+        clock=clock,
+    )
+    greqs = [gw.submit(p, max_new_tokens=6) for p in prompts[:4]]
+    for _ in range(40):
+        gw.step()
+        clock.advance(1.0)
+        if gw._breaker_state == "open":
+            break
+    assert gw._breaker_state == "open" and gw.breaker_openings == 1
+    rejected = gw.submit(prompts[4], max_new_tokens=4)
+    assert rejected.status == "rejected" and rejected.reason == "circuit_open"
+    clock.advance(10.0)  # past the cooldown
+    probe = gw.submit(prompts[4], max_new_tokens=4)
+    assert probe.status == "queued" and gw._breaker_state == "half_open"
+    blocked = gw.submit(prompts[5], max_new_tokens=4)
+    assert blocked.reason == "circuit_open"
+    while gw.queue_depth or gw.running_count:
+        gw.step()
+        clock.advance(1.0)
+    assert probe.status == "done"
+    assert gw._breaker_state == "closed" and gw.breaker_closings == 1
+    assert all(g.terminal for g in greqs)
+
+
+def test_breaker_reopens_on_failure_during_half_open(setup):
+    params, prompts = setup
+    clock = ManualClock()
+    plan = FaultPlan([FaultSpec("serving.decode", "error", prob=1.0,
+                                attributed=False)])  # unbounded failures
+    eng = make_engine(params, max_slots=2, faults=plan)
+    gw = ServingGateway(
+        eng,
+        GatewayConfig(enabled=True, breaker_threshold=1,
+                      breaker_window_s=100.0, breaker_cooldown_s=5.0),
+        clock=clock,
+    )
+    gw.submit(prompts[0], max_new_tokens=6)
+    gw.submit(prompts[1], max_new_tokens=6)
+    for _ in range(10):
+        gw.step()
+        clock.advance(1.0)
+        if gw._breaker_state == "open":
+            break
+    assert gw._breaker_state == "open"
+    clock.advance(10.0)
+    probe = gw.submit(prompts[2], max_new_tokens=6)
+    assert gw._breaker_state == "half_open"
+    for _ in range(30):
+        gw.step()
+        clock.advance(1.0)
+        if gw._breaker_state == "open":
+            break
+    assert gw._breaker_state == "open" and gw.breaker_openings >= 2
+    assert probe.terminal or probe.status in ("queued", "running")
+
+
+def test_degradation_rungs(setup):
+    """Rung 1: breaker open disables speculative decoding; rung 2 (a re-open =
+    repeated pressure): admission bounds halve; a close — a proven-healthy
+    probe — restores the FULL configuration (one-rung-per-close would ratchet
+    permanently, since re-opens can outnumber closes)."""
+    params, prompts = setup
+    clock = ManualClock()
+    eng = make_engine(params, spec_k=2)
+    gw = ServingGateway(
+        eng,
+        GatewayConfig(enabled=True, breaker_threshold=1, degrade=True,
+                      max_queue=8, breaker_window_s=100.0,
+                      breaker_cooldown_s=5.0),
+        clock=clock,
+    )
+    assert eng.spec_enabled
+    gw._breaker_open(clock())
+    assert gw.degrade_level == 1 and eng.spec_enabled is False
+    gw._breaker_open(clock())  # failed-probe re-open: escalates further
+    assert gw.degrade_level == 2 and gw._effective_bounds()[0] == 4
+    gw._breaker_close(clock())
+    assert gw.degrade_level == 0 and gw._effective_bounds()[0] == 8
+    assert eng.spec_enabled is True  # no permanent ratchet: fully restored
+
+
+def test_engine_restart_replay_streams_identical(setup):
+    """In-flight requests that die with the engine are requeued and replayed
+    idempotently: on_retry resets the stream, and the final transcripts are
+    byte-identical to an undisturbed run."""
+    params, prompts = setup
+
+    def run_with(restart_after=None):
+        eng = make_engine(params, max_slots=2)
+        gw = ServingGateway(eng, GatewayConfig(enabled=True))
+        streams = {}
+        greqs = []
+        for i, p in enumerate(prompts):
+            streams[i] = []
+
+            def on_token(tok, i=i):
+                streams[i].append(tok)
+
+            def on_retry(i=i):
+                streams[i].clear()
+
+            greqs.append(gw.submit(p, max_new_tokens=6, on_token=on_token,
+                                   on_retry=on_retry))
+        steps = 0
+        while gw.queue_depth or gw.running_count:
+            gw.step()
+            steps += 1
+            if restart_after is not None and steps == restart_after:
+                replayed = gw.reattach_engine(make_engine(params, max_slots=2))
+                assert replayed  # something was actually in flight
+        return gw, greqs, streams
+
+    _, clean_reqs, clean_streams = run_with()
+    gw, reqs, streams = run_with(restart_after=3)
+    assert gw.counters["replayed"] >= 1
+    for i in range(len(prompts)):
+        assert reqs[i].status == "done"
+        assert streams[i] == clean_streams[i], i
+        assert reqs[i].tokens == clean_reqs[i].tokens
+        assert reqs[i].retries_used == 0  # replay spends no preemption budget
+
+
+def test_deadline_eviction_reaches_recovery_parked_requests(setup):
+    """Regression (review): deadline eviction used evict_slot(), which only
+    scans lanes — a request recovery parked in the engine's internal queue or
+    bisect hold survived as a zombie, generating tokens after the gateway
+    finalized it EXPIRED. cancel() finds it wherever it is."""
+    params, prompts = setup
+    clock = ManualClock()
+    # Unattributed fault on the second dispatch: bisection holds one request,
+    # the rebuild parks the other in the engine queue.
+    plan = FaultPlan([FaultSpec("serving.decode", "error", prob=1.0, start=1,
+                                max_fires=1, attributed=False)])
+    eng = make_engine(params, max_slots=2, faults=plan)
+    gw = ServingGateway(eng, GatewayConfig(enabled=True), clock=clock)
+    greqs = [gw.submit(p, max_new_tokens=8, deadline_s=50.0)
+             for p in prompts[:2]]
+    for _ in range(3):  # run into the fault: requests now parked engine-side
+        gw.step()
+        clock.advance(1.0)
+    parked = len(eng.queue) + len(eng._bisect_hold)
+    assert parked >= 1, "scenario must park at least one request engine-side"
+    clock.advance(100.0)  # blow every deadline
+    gw.step()
+    assert all(g.status == "expired" for g in greqs if g.terminal)
+    assert all(g.terminal for g in greqs)
+    # the engine must not keep zombie copies anywhere
+    assert not eng.queue and not eng._bisect_hold
+    assert all(r is None for r in eng.slot_req)
+    before = [list(g.tokens) for g in greqs]
+    for _ in range(5):
+        assert gw.step() == []
+        clock.advance(1.0)
+    assert [list(g.tokens) for g in greqs] == before  # nothing generated after
+
+
+# ----------------------------------------------------------- training guard
+def test_skip_nonfinite_steps_guard():
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.data_loader import DataLoader
+    from accelerate_tpu.test_utils.training import (
+        RegressionDataset,
+        linear_regression_loss,
+        make_regression_state,
+    )
+
+    acc = Accelerator()
+    dl = acc.prepare(DataLoader(RegressionDataset(length=16), batch_size=4))
+    batches = list(dl)
+    state = acc.create_train_state(make_regression_state(), optax.sgd(0.1))
+    step = acc.build_train_step(linear_regression_loss, skip_nonfinite_steps=2)
+
+    state, m = step(state, batches[0])
+    assert not bool(np.asarray(m["nonfinite"]))
+    params_before = {k: np.asarray(v) for k, v in state.params.items()}
+    step_before = int(np.asarray(state.step))
+
+    def poison(batch):
+        return {k: np.asarray(v) * np.nan if np.issubdtype(
+            np.asarray(v).dtype, np.floating) else v for k, v in batch.items()}
+
+    state, m = step(state, poison(batches[1]))
+    assert bool(np.asarray(m["nonfinite"]))
+    assert step.nonfinite_total == 1 and step.nonfinite_consecutive == 1
+    # skipped: params and the device step counter unchanged
+    for k in params_before:
+        np.testing.assert_array_equal(np.asarray(state.params[k]), params_before[k])
+    assert int(np.asarray(state.step)) == step_before
+
+    # a clean step resets the consecutive counter
+    state, m = step(state, batches[2])
+    assert step.nonfinite_consecutive == 0
+    assert int(np.asarray(state.step)) == step_before + 1
+
+    # K consecutive non-finite steps abort
+    state, _ = step(state, poison(batches[0]))
+    with pytest.raises(NonFiniteStepError):
+        step(state, poison(batches[1]))
+
+
+def test_skip_nonfinite_rejects_fused():
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.test_utils.training import (
+        linear_regression_loss,
+        make_regression_state,
+    )
+
+    acc = Accelerator(gradient_accumulation_steps=1)
+    acc.create_train_state(make_regression_state(), optax.sgd(0.1))
+    with pytest.raises(ValueError, match="fused_steps"):
+        acc.build_train_step(linear_regression_loss, fused_steps=2,
+                             skip_nonfinite_steps=1)
+
+
+def test_train_step_fault_injection_nonfinite():
+    """ACCELERATE_FAULTS-style injection at train.step poisons the batch's
+    float leaves with REAL NaN — exercising the actual guard path."""
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.data_loader import DataLoader
+    from accelerate_tpu.test_utils.training import (
+        RegressionDataset,
+        linear_regression_loss,
+        make_regression_state,
+    )
+
+    acc = Accelerator()
+    acc.fault_plan = FaultPlan(
+        [FaultSpec("train.step", "nonfinite", prob=1.0, start=1, max_fires=1)]
+    )
+    try:
+        dl = acc.prepare(DataLoader(RegressionDataset(length=16), batch_size=4))
+        batches = list(dl)
+        state = acc.create_train_state(make_regression_state(), optax.sgd(0.1))
+        step = acc.build_train_step(linear_regression_loss,
+                                    skip_nonfinite_steps=3)
+        state, m0 = step(state, batches[0])
+        assert not bool(np.asarray(m0["nonfinite"]))
+        state, m1 = step(state, batches[1])  # injection fires here
+        assert bool(np.asarray(m1["nonfinite"]))
+        assert step.nonfinite_total == 1
+        state, m2 = step(state, batches[2])
+        assert not bool(np.asarray(m2["nonfinite"]))
+    finally:
+        acc.fault_plan = None
+
+
+# ------------------------------------------------------- verified checkpoints
+def _train_and_save(tmp_path, n_saves=3, total_limit=None, fault_plan=None):
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.data_loader import DataLoader
+    from accelerate_tpu.test_utils.training import (
+        RegressionDataset,
+        linear_regression_loss,
+        make_regression_state,
+    )
+    from accelerate_tpu.utils import ProjectConfiguration
+
+    acc = Accelerator(project_config=ProjectConfiguration(
+        project_dir=str(tmp_path), automatic_checkpoint_naming=True,
+        total_limit=total_limit,
+    ))
+    if fault_plan is not None:
+        acc.fault_plan = fault_plan
+    dl = acc.prepare(DataLoader(RegressionDataset(length=32), batch_size=4))
+    state = acc.create_train_state(make_regression_state(), optax.sgd(0.1))
+    step = acc.build_train_step(linear_regression_loss)
+    saved = 0
+    for batch in dl:
+        if saved >= n_saves:
+            break
+        state, _ = step(state, batch)
+        try:
+            acc.save_state(train_state=state)
+        except InjectedFault:
+            pass  # the simulated mid-save crash
+        saved += 1
+    return acc, state
+
+
+def test_checkpoint_manifest_and_marker(tmp_path):
+    from accelerate_tpu.checkpointing import (
+        COMMIT_MARKER,
+        MANIFEST_NAME,
+        verify_checkpoint,
+    )
+
+    acc, state = _train_and_save(tmp_path, n_saves=2)
+    ckpts = sorted((tmp_path / "checkpoints").glob("checkpoint_*"))
+    assert len(ckpts) == 2
+    for c in ckpts:
+        assert (c / COMMIT_MARKER).exists()
+        manifest = json.loads((c / MANIFEST_NAME).read_text())
+        assert manifest  # every data file hashed
+        assert verify_checkpoint(c) == []
+
+
+def test_corrupt_checkpoint_falls_back_to_previous_valid(tmp_path):
+    from accelerate_tpu.checkpointing import COMMIT_MARKER, MANIFEST_NAME
+
+    acc, state = _train_and_save(tmp_path, n_saves=3)
+    ckpts = sorted((tmp_path / "checkpoints").glob("checkpoint_*"))
+    newest = ckpts[-1]
+    victim = next(p for p in sorted(newest.rglob("*"))
+                  if p.is_file() and p.name not in (COMMIT_MARKER, MANIFEST_NAME))
+    data = bytearray(victim.read_bytes())
+    data[len(data) // 2] ^= 0xFF
+    victim.write_bytes(bytes(data))
+
+    restored = acc.load_state(train_state=state)
+    # fell back to the SECOND-newest (step 2 of 3)
+    assert int(np.asarray(restored.step)) == 2
+    assert acc.checkpoints_quarantined == 1
+    assert (tmp_path / "checkpoints" / "quarantined" / newest.name).exists()
+    assert not newest.exists()
+
+
+def test_uncommitted_checkpoint_skipped_on_load(tmp_path):
+    from accelerate_tpu.checkpointing import COMMIT_MARKER
+
+    acc, state = _train_and_save(tmp_path, n_saves=2)
+    ckpts = sorted((tmp_path / "checkpoints").glob("checkpoint_*"))
+    (ckpts[-1] / COMMIT_MARKER).unlink()  # simulate a crash before commit
+    restored = acc.load_state(train_state=state)
+    assert int(np.asarray(restored.step)) == 1
+    assert acc.checkpoints_quarantined == 1
+
+
+def test_explicit_corrupt_checkpoint_raises(tmp_path):
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.checkpointing import (
+        COMMIT_MARKER,
+        CheckpointCorruptError,
+        MANIFEST_NAME,
+    )
+    from accelerate_tpu.test_utils.training import (
+        linear_regression_loss,
+        make_regression_state,
+    )
+
+    acc = Accelerator()
+    state = acc.create_train_state(make_regression_state(), optax.sgd(0.1))
+    path = tmp_path / "ckpt"
+    acc.save_state(str(path), train_state=state)
+    victim = next(p for p in sorted(path.rglob("*"))
+                  if p.is_file() and p.name not in (COMMIT_MARKER, MANIFEST_NAME))
+    victim.write_bytes(victim.read_bytes() + b"garbage")
+    with pytest.raises(CheckpointCorruptError):
+        acc.load_state(str(path), train_state=state)
+
+
+def test_rotation_never_deletes_newest_valid_after_midsave_crash(tmp_path):
+    """Regression (ISSUE 9 satellite): total_limit=1, save 2 commits then save
+    3 crashes mid-write (no marker). Rotation before save 4 must NOT delete
+    checkpoint_1 — it is the newest VALID state and the only fallback if save
+    4 crashes too. The loader then restores from it."""
+    from accelerate_tpu.checkpointing import COMMIT_MARKER
+
+    plan = FaultPlan([FaultSpec("ckpt.save", "crash", prob=1.0, start=2,
+                                max_fires=1)])
+    acc, state = _train_and_save(tmp_path, n_saves=3, total_limit=1,
+                                 fault_plan=plan)
+    base = tmp_path / "checkpoints"
+    names = sorted(p.name for p in base.glob("checkpoint_*"))
+    # save 3 crashed: checkpoint_2 exists but is UNCOMMITTED; the newest valid
+    # (checkpoint_1) must have survived rotation.
+    assert "checkpoint_2" in names and "checkpoint_1" in names, names
+    assert not (base / "checkpoint_2" / COMMIT_MARKER).exists()
+    assert (base / "checkpoint_1" / COMMIT_MARKER).exists()
+    restored = acc.load_state(train_state=state)
+    assert int(np.asarray(restored.step)) == 2  # the step checkpoint_1 saved
+    assert acc.checkpoints_quarantined == 1  # checkpoint_2 quarantined
+
+
+def test_corrupt_fault_injection_is_caught_at_load(tmp_path):
+    """kind=corrupt flips bytes AFTER the commit marker lands — the manifest
+    verification (not the marker) must catch it."""
+    from accelerate_tpu.checkpointing import verify_checkpoint
+
+    plan = FaultPlan([FaultSpec("ckpt.save", "corrupt", prob=1.0, start=1,
+                                max_fires=1)])
+    acc, state = _train_and_save(tmp_path, n_saves=2, fault_plan=plan)
+    ckpts = sorted((tmp_path / "checkpoints").glob("checkpoint_*"))
+    problems = verify_checkpoint(ckpts[-1])
+    assert any("sha256 mismatch" in p for p in problems), problems
+    restored = acc.load_state(train_state=state)
+    assert int(np.asarray(restored.step)) == 1  # fell back
+    assert acc.checkpoints_quarantined == 1
+
+
+def test_async_save_commit_marker_lands_at_join(tmp_path):
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.checkpointing import (
+        COMMIT_MARKER,
+        verify_checkpoint,
+        wait_for_async_save,
+    )
+    from accelerate_tpu.test_utils.training import make_regression_state
+
+    acc = Accelerator()
+    state = acc.create_train_state(make_regression_state(), optax.sgd(0.1))
+    path = tmp_path / "async_ckpt"
+    acc.save_state(str(path), train_state=state, async_save=True)
+    wait_for_async_save()
+    assert (path / COMMIT_MARKER).exists()
+    assert verify_checkpoint(path) == []
+    restored = acc.load_state(str(path), train_state=state)
+    assert restored is not None
+
+
+# ------------------------------------------------------------------ chaos bench
+def test_chaos_bench_artifact(setup):
+    """The acceptance geometry: a seeded plan killing >=10% of decode steps
+    over a replayed trace; zero silently-lost requests, recovered streams
+    byte-identical to the clean replay, availability + faulted-vs-clean
+    latency stamped with provenance."""
+    from accelerate_tpu.commands.serve_bench import run_chaos_bench
+
+    artifact = run_chaos_bench(requests=12, max_slots=2, max_len=64,
+                               prompt_bucket=16, seed=0, chaos_rate=0.15)
+    assert artifact["schema"] == "accelerate_tpu.bench.chaos/v1"
+    assert artifact["chaos"]["silently_lost"] == 0
+    assert artifact["chaos"]["terminal"] == artifact["chaos"]["submitted"]
+    assert artifact["streams_identical"] is True
+    assert artifact["streams_compared"] > 0
+    assert artifact["chaos"]["engine"]["step_fault_rate"] >= 0.10
+    assert artifact["chaos"]["engine"]["step_failures"] >= 1
+    assert artifact["clean"]["engine"]["step_failures"] == 0
+    assert "ttft" in artifact["chaos"] and "ttft" in artifact["clean"]
+    assert artifact["provenance"] and artifact["workload_trace_hash"]
+
+
+def test_chaos_bench_cli_smoke(tmp_path):
+    """serve-bench --chaos --smoke is a tier-1 gate like --trace-curves."""
+    out = tmp_path / "BENCH_CHAOS.json"
+    result = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu", "serve-bench",
+         "--chaos", str(out), "--smoke", "--seed", "0"],
+        capture_output=True, text=True, timeout=600,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    artifact = json.loads(out.read_text())
+    assert artifact["chaos"]["silently_lost"] == 0
+    assert artifact["streams_identical"] is True
+    summary = json.loads(result.stdout.strip().splitlines()[-1])
+    assert summary["schema"] == "accelerate_tpu.bench.chaos/v1"
+
+
+def test_new_schemas_registered():
+    from accelerate_tpu.telemetry.schemas import (
+        FAULT_SCHEMA,
+        RECOVERY_SCHEMA,
+        SCHEMA_REGISTRY,
+        validate_record,
+    )
+
+    assert FAULT_SCHEMA in SCHEMA_REGISTRY
+    assert RECOVERY_SCHEMA in SCHEMA_REGISTRY
+    assert validate_record(
+        {"schema": FAULT_SCHEMA, "site": "serving.decode", "kind": "error"}
+    ) == []
+    assert validate_record({"schema": RECOVERY_SCHEMA, "action": "rebuild"}) == []
+    assert validate_record({"schema": FAULT_SCHEMA}) != []
