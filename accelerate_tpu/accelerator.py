@@ -1536,7 +1536,8 @@ class Accelerator:
             if handler.on_trace_ready is not None and self.is_main_process:
                 handler.on_trace_ready(trace_dir)
 
-    def build_serving_gateway(self, engine, clock=None, tracer=None):
+    def build_serving_gateway(self, engine, clock=None, tracer=None,
+                              engine_factory=None):
         """Front a ``ContinuousBatcher`` with the SLO-aware request gateway
         (``serving_gateway.ServingGateway``), resolved from the state-resident
         ``GatewayConfig`` (``Accelerator(gateway_config=...)`` or
@@ -1545,16 +1546,36 @@ class Accelerator:
         unchanged — callers drive one object either way (both expose
         ``submit``/``step``/``run``/``stats``).
 
+        ``engine`` may also be a LIST of engine replicas: the result is then a
+        ``serving_gateway.fleet.FleetRouter`` — the same submit/step/run
+        contract over the whole fleet, with health-driven routing, per-replica
+        circuit breakers and lossless failover (docs/resilience.md).
+        ``engine_factory(rid)`` (fleet only) builds replacement engines for
+        replica restarts.
+
         ``tracer`` threads a request-scoped ``telemetry.tracing.Tracer``
         through gateway AND engine (the gateway hands it to an engine that has
         none), so per-request spans cover the whole lifecycle
         (docs/telemetry.md)."""
         config = self.state.gateway_config
+        is_fleet = isinstance(engine, (list, tuple))
         if not config.enabled:
+            if is_fleet:
+                raise ValueError(
+                    "a fleet of engines needs the gateway enabled: there is no "
+                    "bare-engine equivalent of a multi-replica router (set "
+                    "GatewayConfig(enabled=True) or ACCELERATE_GATEWAY=1)"
+                )
             return engine
+        kwargs = {} if clock is None else {"clock": clock}
+        if is_fleet:
+            from .serving_gateway import FleetRouter
+
+            return FleetRouter(list(engine), config, telemetry=self.telemetry,
+                               tracer=tracer, engine_factory=engine_factory,
+                               **kwargs)
         from .serving_gateway import ServingGateway
 
-        kwargs = {} if clock is None else {"clock": clock}
         return ServingGateway(engine, config, telemetry=self.telemetry,
                               tracer=tracer, **kwargs)
 

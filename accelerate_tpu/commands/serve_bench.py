@@ -21,7 +21,8 @@ import argparse
 
 from ..spec_decode import DraftSource
 
-__all__ = ["run_serve_bench", "serve_bench_command", "serve_bench_command_parser"]
+__all__ = ["run_serve_bench", "run_chaos_bench", "run_fleet_chaos_bench",
+           "serve_bench_command", "serve_bench_command_parser"]
 
 #: Policy rows a plain run emits, in order.
 ALL_POLICIES = ("fifo", "priority", "edf", "wfq")
@@ -129,6 +130,28 @@ def serve_bench_command_parser(subparsers=None) -> argparse.ArgumentParser:
                         help="per-dispatch decode failure probability for "
                              "--chaos (default 0.15 — above the >=10%% "
                              "acceptance floor)")
+    parser.add_argument("--chaos-sites", default="decode",
+                        help="comma-separated fault sites for the --chaos "
+                             "plan: decode (dispatch failures), prefill "
+                             "(admission failures), kv_admit (paged page-pool "
+                             "allocation failures — forces a paged engine when "
+                             "--page-size is 0). Per-site fire counts are "
+                             "stamped into the artifact")
+    parser.add_argument("--fleet", type=int, default=0, metavar="N",
+                        help="with --chaos: run the FLEET chaos proof instead "
+                             "(N engine replicas behind the FleetRouter, a "
+                             "seeded plan killing replicas mid-trace) and "
+                             "write BENCH_FLEET.json — zero silently-lost, "
+                             "migrated streams byte-identical, availability "
+                             "above a single engine of the same total "
+                             "capacity at the same kill rate")
+    parser.add_argument("--kill-rate", type=float, default=0.05,
+                        help="per-decode-dispatch replica crash probability "
+                             "for --fleet --chaos (each replica draws from "
+                             "its own seeded stream)")
+    parser.add_argument("--kills-per-replica", type=int, default=2,
+                        help="fire budget of each replica's crash clause "
+                             "(--fleet --chaos)")
     parser.add_argument("--loads", default="0.5,1.0,2.0,4.0",
                         help="comma-separated offered-load sweep for "
                              "--trace-curves")
@@ -628,6 +651,35 @@ def _chaos_arm_summary(gw, greqs) -> dict:
     }
 
 
+#: Fault sites ``--chaos-sites`` may include, mapped to the FaultSpec site
+#: names (docs/resilience.md site catalog).
+CHAOS_SITES = {
+    "decode": "serving.decode",
+    "prefill": "serving.prefill",
+    "kv_admit": "serving.kv_admit",
+}
+
+
+def _chaos_plan(sites, chaos_rate: float, seed: int):
+    """The seeded chaos plan: one ``error`` clause per requested site, all at
+    the same per-invocation rate. Decode failures are unattributed (they
+    exercise bisection); prefill/kv_admit failures are attributable by
+    construction (the fault fires admitting exactly one request)."""
+    from ..resilience.faults import FaultPlan, FaultSpec
+
+    specs = []
+    for site in sites:
+        if site not in CHAOS_SITES:
+            raise ValueError(
+                f"unknown chaos site {site!r} (known: {sorted(CHAOS_SITES)})"
+            )
+        specs.append(FaultSpec(
+            CHAOS_SITES[site], "error", prob=chaos_rate,
+            attributed=site != "decode",
+        ))
+    return FaultPlan(specs, seed=seed)
+
+
 def run_chaos_bench(
     preset: str = "smoke",
     requests: int = 32,
@@ -641,23 +693,33 @@ def run_chaos_bench(
     policy: str = "fifo",
     chaos_rate: float = 0.15,
     generator: str = "poisson",
+    chaos_sites=("decode",),
+    page_size: int = 0,
+    kv_pages=None,
     telemetry=None,
 ) -> dict:
     """The chaos proof (BENCH_CHAOS.json): replay ONE workload trace twice —
-    clean, then under a seeded ``FaultPlan`` failing ``chaos_rate`` of decode
-    dispatches — and stamp what recovery delivered: zero silently-lost
-    requests (every submitted uid reaches a machine-readable terminal state),
-    recovered-request token streams BYTE-IDENTICAL to the clean replay
-    (asserted per request, stamped as ``streams_identical``), availability,
-    and faulted-vs-clean p95 TTFT/TPOT on the shared virtual clock."""
+    clean, then under a seeded ``FaultPlan`` failing ``chaos_rate`` of the
+    dispatches at each requested fault site (``chaos_sites``: decode, and
+    optionally prefill admissions and paged kv_admit allocations) — and stamp
+    what recovery delivered: zero silently-lost requests (every submitted uid
+    reaches a machine-readable terminal state), recovered-request token
+    streams BYTE-IDENTICAL to the clean replay (asserted per request, stamped
+    as ``streams_identical``), availability, per-site fire counts, and
+    faulted-vs-clean p95 TTFT/TPOT on the shared virtual clock."""
     from ..compile_cache.warmup import build_model_config
     from ..models import llama
-    from ..resilience.faults import FaultPlan, FaultSpec
     from ..serving_gateway.workload import generate_workload, trace_hash
     from ..telemetry.provenance import provenance_stamp
 
     if not 0.0 < chaos_rate <= 1.0:
         raise ValueError(f"chaos_rate={chaos_rate} must be in (0, 1]")
+    chaos_sites = tuple(chaos_sites)
+    if "kv_admit" in chaos_sites and not page_size:
+        # The kv_admit site only exists on a paged engine; CPU-paged decode is
+        # bitwise the dense layout, so opting the whole bench into pages keeps
+        # the stream-parity contract intact.
+        page_size = 8
     cfg = build_model_config(preset, max_len)
     params = llama.init_params(cfg)
     max_queue = max(1, int(overload * max_slots))
@@ -666,7 +728,7 @@ def run_chaos_bench(
                               mean_iat_s=mean_iat)
     prov = provenance_stamp(cfg)
     _warm_serving_surface(params, cfg, max_slots, max_len, prompt_bucket,
-                          seed=seed)
+                          page_size=page_size, kv_pages=kv_pages, seed=seed)
 
     def stream_capture():
         streams = {}
@@ -686,16 +748,13 @@ def run_chaos_bench(
 
     common = dict(max_slots=max_slots, max_len=max_len,
                   prompt_bucket=prompt_bucket, max_queue=max_queue, load=load,
-                  step_dt=step_dt, seed=seed, telemetry=telemetry)
+                  step_dt=step_dt, seed=seed, page_size=page_size,
+                  kv_pages=kv_pages, telemetry=telemetry)
     clean_streams, clean_factory = stream_capture()
     gw_clean, greqs_clean = _replay_one_policy(
         params, cfg, policy, trace, on_token_factory=clean_factory, **common
     )
-    plan = FaultPlan(
-        [FaultSpec("serving.decode", "error", prob=chaos_rate,
-                   attributed=False)],
-        seed=seed,
-    )
+    plan = _chaos_plan(chaos_sites, chaos_rate, seed)
     chaos_streams, chaos_factory = stream_capture()
     gw_chaos, greqs_chaos = _replay_one_policy(
         params, cfg, policy, trace, faults=plan,
@@ -725,9 +784,13 @@ def run_chaos_bench(
         "max_queue": max_queue,
         "load": load,
         "chaos_rate": chaos_rate,
-        "fault_plan": {"seed": seed, "site": "serving.decode",
+        "chaos_sites": list(chaos_sites),
+        "page_size": page_size,
+        "fault_plan": {"seed": seed,
+                       "sites": [CHAOS_SITES[s] for s in chaos_sites],
                        "kind": "error", "prob": chaos_rate,
-                       "fired": len(plan.fired)},
+                       "fired": len(plan.fired),
+                       "fired_by_site": plan.stats()["by_site"]},
         "workload_trace_hash": trace_hash(trace),
         "provenance": prov,
         "streams_compared": compared,
@@ -735,6 +798,237 @@ def run_chaos_bench(
         "streams_mismatched": mismatched,
         "clean": clean_arm,
         "chaos": chaos_arm,
+    }
+
+
+def _replay_fleet(params, cfg, policy, trace, *, n_replicas, max_slots,
+                  max_len, prompt_bucket, max_queue, load, step_dt, seed,
+                  plans=None, restart_backoff=0.0, replica_restarts=4,
+                  telemetry=None, on_token_factory=None):
+    """One fresh N-replica FleetRouter + virtual-clock replay of ``trace`` →
+    ``(router, gateway requests)``. ``plans[rid]`` arms replica ``rid``'s
+    engine with its own seeded FaultPlan (the kill schedule); restarted
+    replicas keep their plan, so the whole chaos run stays deterministic."""
+    from ..serving import ContinuousBatcher
+    from ..serving_gateway import FleetRouter
+    from ..serving_gateway.workload import VirtualClock, replay_trace
+    from ..utils.dataclasses import GatewayConfig
+
+    clock = VirtualClock()
+
+    def build_engine(rid):
+        return ContinuousBatcher(
+            params, cfg, max_slots=max_slots, max_len=max_len,
+            prompt_bucket=prompt_bucket,
+            faults=None if plans is None else plans[rid],
+        )
+
+    router = FleetRouter(
+        [build_engine(rid) for rid in range(n_replicas)],
+        GatewayConfig(enabled=True, policy=policy, max_queue=max_queue,
+                      overload="shed", aging_s=5.0, breaker_threshold=3,
+                      replica_restarts=replica_restarts,
+                      replica_restart_backoff=restart_backoff),
+        telemetry=telemetry, clock=clock, engine_factory=build_engine,
+    )
+    greqs = replay_trace(router, trace, cfg.vocab_size, clock,
+                         step_dt=step_dt, load=load, seed=seed,
+                         on_token_factory=on_token_factory)
+    return router, greqs
+
+
+def _fleet_arm_summary(router, greqs) -> dict:
+    """One fleet-bench arm's accounting: terminal disposition of EVERY
+    submitted request, availability, latency percentiles, migration/restart
+    counters and the per-replica kill/restart history — plus the count of
+    circuit-reason rejections, which the per-replica-isolation contract pins
+    at zero while any replica stays healthy."""
+    from ..telemetry.slo import latency_summary
+
+    counters = router.counters
+    submitted = len(greqs)
+    terminal = sum(1 for g in greqs if g.terminal)
+    done = [g for g in greqs if g.status == "done"]
+    circuit_rejections = sum(
+        1 for g in greqs if g.status == "rejected"
+        and (g.reason or "").startswith(("circuit", "fleet_down"))
+    )
+    return {
+        "submitted": submitted,
+        "terminal": terminal,
+        "silently_lost": submitted - terminal,
+        "done": counters["done"],
+        "failed": counters["failed"],
+        "shed": counters["shed"],
+        "rejected": counters["rejected"],
+        "circuit_rejections": circuit_rejections,
+        "expired": counters["expired"],
+        "availability": round(counters["done"] / max(1, submitted), 4),
+        "migrated": counters["migrated"],
+        "replica_kills": counters["replica_kills"],
+        "replica_restarts": counters["replica_restarts"],
+        "replica_retired": counters["replica_retired"],
+        "replayed_requests": sum(1 for g in greqs if g.replays > 0),
+        "ttft": latency_summary([g.ttft_s for g in done]),
+        "tpot": latency_summary([g.tpot_s for g in done]),
+        "replicas": [
+            {"replica": r["replica"], "state": r["state"],
+             "restarts": r["restarts"],
+             "breaker_openings": r["breaker_openings"]}
+            for r in router.stats()["replicas"]
+        ],
+    }
+
+
+def run_fleet_chaos_bench(
+    n_replicas: int = 3,
+    preset: str = "smoke",
+    requests: int = 32,
+    max_slots: int = 2,
+    max_len: int = 128,
+    prompt_bucket: int = 16,
+    overload: float = 4.0,
+    load: float = 1.0,
+    step_dt: float = 1.0,
+    seed: int = 0,
+    policy: str = "fifo",
+    kill_rate: float = 0.05,
+    kills_per_replica: int = 2,
+    restart_backoff: float = 2.0,
+    generator: str = "poisson",
+    telemetry=None,
+) -> dict:
+    """The fleet resilience proof (BENCH_FLEET.json): replay ONE workload
+    trace three ways on the shared virtual clock —
+
+    1. **fleet_clean**: ``n_replicas`` replicas, no faults (the baseline);
+    2. **fleet_chaos**: the same fleet, each replica armed with its OWN seeded
+       crash clause (``kill_rate`` per decode dispatch, ``kills_per_replica``
+       fire budget) — replicas die mid-trace, in-flight requests migrate via
+       the replay path, the supervisor restarts them after ``restart_backoff``
+       virtual seconds;
+    3. **single_chaos**: ONE engine with the same TOTAL lane count and the
+       same per-dispatch kill rate behind a 1-replica router — same capacity,
+       same fault rate, one failure domain instead of N.
+
+    Stamps: zero ``silently_lost``, migrated streams byte-identical to the
+    undisturbed fleet (per-request capture with on_retry reset), availability
+    per arm (the fleet must beat the single engine — the reason the router
+    exists), zero circuit-reason rejections while a healthy replica remained,
+    per-class deadline attainment, and the failover p95 TTFT penalty."""
+    from ..compile_cache.warmup import build_model_config
+    from ..models import llama
+    from ..resilience.faults import FaultPlan, FaultSpec
+    from ..serving_gateway.workload import generate_workload, trace_hash
+    from ..telemetry.provenance import provenance_stamp
+
+    if n_replicas < 2:
+        raise ValueError(f"n_replicas={n_replicas} must be >= 2 (the single-"
+                         "engine comparison arm is built automatically)")
+    if not 0.0 < kill_rate <= 1.0:
+        raise ValueError(f"kill_rate={kill_rate} must be in (0, 1]")
+    cfg = build_model_config(preset, max_len)
+    params = llama.init_params(cfg)
+    total_lanes = n_replicas * max_slots
+    max_queue = max(1, int(overload * total_lanes))
+    mean_iat = _calibrated_iat(total_lanes)
+    trace = generate_workload(generator, requests, seed=seed,
+                              mean_iat_s=mean_iat)
+    prov = provenance_stamp(cfg)
+    _warm_serving_surface(params, cfg, max_slots, max_len, prompt_bucket,
+                          seed=seed)
+    _warm_serving_surface(params, cfg, total_lanes, max_len, prompt_bucket,
+                          seed=seed)
+
+    def kill_plans(n):
+        # Each replica draws its crash schedule from its own stream keyed off
+        # (seed, rid): which replica dies, and when, depends only on the seed.
+        return [
+            FaultPlan([FaultSpec("serving.decode", "crash", prob=kill_rate,
+                                 max_fires=kills_per_replica)],
+                      seed=seed * 7919 + rid + 1)
+            for rid in range(n)
+        ]
+
+    def stream_capture():
+        streams = {}
+
+        def factory(i):
+            streams[i] = []
+
+            def on_token(tok, i=i):
+                streams[i].append(int(tok))
+
+            def on_retry(i=i):
+                streams[i].clear()
+
+            return on_token, on_retry
+
+        return streams, factory
+
+    common = dict(max_len=max_len, prompt_bucket=prompt_bucket,
+                  max_queue=max_queue, load=load, step_dt=step_dt, seed=seed,
+                  restart_backoff=restart_backoff, telemetry=telemetry)
+    clean_streams, clean_factory = stream_capture()
+    r_clean, g_clean = _replay_fleet(
+        params, cfg, policy, trace, n_replicas=n_replicas,
+        max_slots=max_slots, on_token_factory=clean_factory, **common)
+    chaos_streams, chaos_factory = stream_capture()
+    chaos_plans = kill_plans(n_replicas)
+    r_chaos, g_chaos = _replay_fleet(
+        params, cfg, policy, trace, n_replicas=n_replicas,
+        max_slots=max_slots, plans=chaos_plans,
+        on_token_factory=chaos_factory, **common)
+    single_plans = kill_plans(1)
+    r_single, g_single = _replay_fleet(
+        params, cfg, policy, trace, n_replicas=1, max_slots=total_lanes,
+        plans=single_plans, **common)
+
+    compared = mismatched = 0
+    for i in range(len(trace)):
+        if (g_clean[i].status == "done" and g_chaos[i].status == "done"):
+            compared += 1
+            if clean_streams.get(i) != chaos_streams.get(i):
+                mismatched += 1
+    clean_arm = {**_fleet_arm_summary(r_clean, g_clean),
+                 **_attainment_point(r_clean, g_clean, load)}
+    chaos_arm = {**_fleet_arm_summary(r_chaos, g_chaos),
+                 **_attainment_point(r_chaos, g_chaos, load)}
+    single_arm = {**_fleet_arm_summary(r_single, g_single),
+                  **_attainment_point(r_single, g_single, load)}
+    p95_clean = (clean_arm["ttft"] or {}).get("p95")
+    p95_chaos = (chaos_arm["ttft"] or {}).get("p95")
+    return {
+        "schema": "accelerate_tpu.bench.fleet/v1",
+        "preset": preset,
+        "policy": policy,
+        "generator": generator,
+        "requests": requests,
+        "n_replicas": n_replicas,
+        "max_slots_per_replica": max_slots,
+        "total_lanes": total_lanes,
+        "max_queue": max_queue,
+        "load": load,
+        "kill_plan": {"seed": seed, "site": "serving.decode", "kind": "crash",
+                      "prob": kill_rate, "max_fires": kills_per_replica,
+                      "restart_backoff_s": restart_backoff,
+                      "fleet_fired": sum(len(p.fired) for p in chaos_plans),
+                      "single_fired": sum(len(p.fired) for p in single_plans)},
+        "workload_trace_hash": trace_hash(trace),
+        "provenance": prov,
+        "streams_compared": compared,
+        "streams_identical": mismatched == 0,
+        "streams_mismatched": mismatched,
+        "failover_ttft_p95_penalty": (
+            round(p95_chaos / p95_clean, 4)
+            if p95_clean and p95_chaos else None
+        ),
+        "fleet_availability_above_single": (
+            chaos_arm["availability"] > single_arm["availability"]
+        ),
+        "fleet_clean": clean_arm,
+        "fleet_chaos": chaos_arm,
+        "single_chaos": single_arm,
     }
 
 
@@ -934,6 +1228,45 @@ def run_paged_compare(
 def serve_bench_command(args) -> int:
     import json
 
+    if args.chaos and args.fleet:
+        if args.smoke:
+            # CI tier-1 fleet chaos shape: small trace, 2 lanes per replica.
+            args.requests = min(args.requests, 16)
+            args.max_slots = 2
+            args.max_len = 64
+            args.prompt_bucket = 16
+        artifact = run_fleet_chaos_bench(
+            n_replicas=args.fleet,
+            preset=args.preset,
+            requests=args.requests,
+            max_slots=args.max_slots,
+            max_len=args.max_len,
+            prompt_bucket=args.prompt_bucket,
+            overload=args.overload,
+            load=args.load,
+            seed=args.seed,
+            policy=args.policy if args.policy != "all" else "fifo",
+            kill_rate=args.kill_rate,
+            kills_per_replica=args.kills_per_replica,
+            generator=args.trace_gen or "poisson",
+        )
+        with open(args.chaos, "w") as f:
+            json.dump(artifact, f, indent=2)
+        print(json.dumps({k: artifact[k] for k in (
+            "schema", "n_replicas", "workload_trace_hash",
+            "streams_compared", "streams_identical",
+            "failover_ttft_p95_penalty", "fleet_availability_above_single",
+        )} | {
+            "silently_lost": artifact["fleet_chaos"]["silently_lost"],
+            "availability_fleet": artifact["fleet_chaos"]["availability"],
+            "availability_single": artifact["single_chaos"]["availability"],
+            "circuit_rejections": artifact["fleet_chaos"]["circuit_rejections"],
+            "replica_kills": artifact["fleet_chaos"]["replica_kills"],
+        }))
+        return 1 if (artifact["fleet_chaos"]["silently_lost"]
+                     or not artifact["streams_identical"]
+                     or not artifact["fleet_availability_above_single"]) else 0
+
     if args.chaos:
         if args.smoke:
             # CI tier-1 chaos shape: small trace, 2 lanes, still >=10% of
@@ -954,6 +1287,11 @@ def serve_bench_command(args) -> int:
             policy=args.policy if args.policy != "all" else "fifo",
             chaos_rate=args.chaos_rate,
             generator=args.trace_gen or "poisson",
+            chaos_sites=tuple(
+                s.strip() for s in args.chaos_sites.split(",") if s.strip()
+            ),
+            page_size=args.page_size,
+            kv_pages=args.kv_pages,
         )
         with open(args.chaos, "w") as f:
             json.dump(artifact, f, indent=2)
@@ -965,6 +1303,7 @@ def serve_bench_command(args) -> int:
             "availability_clean": artifact["clean"]["availability"],
             "availability_chaos": artifact["chaos"]["availability"],
             "step_fault_rate": artifact["chaos"]["engine"]["step_fault_rate"],
+            "fired_by_site": artifact["fault_plan"]["fired_by_site"],
         }))
         return 1 if (artifact["chaos"]["silently_lost"]
                      or not artifact["streams_identical"]) else 0

@@ -30,7 +30,22 @@ from .utils.other import get_free_port
 
 logger = get_logger(__name__)
 
-__all__ = ["ElasticSupervisor", "WorkerFailure"]
+__all__ = ["ElasticSupervisor", "FleetSupervisor", "WorkerFailure"]
+
+
+def backoff_delay(base: float, jitter: float, attempt: int) -> float:
+    """Exponential restart backoff shared by :class:`ElasticSupervisor` and
+    :class:`FleetSupervisor`: ``base × 2^attempt`` seconds ± ``jitter``
+    fractional random jitter (restarting gangs must not stampede a shared
+    coordinator/filesystem in lockstep). ``base <= 0`` = immediate."""
+    if base <= 0:
+        return 0.0
+    import random
+
+    delay = base * (2.0 ** attempt)
+    if jitter:
+        delay *= 1.0 + jitter * (2.0 * random.random() - 1.0)
+    return max(0.0, delay)
 
 
 class WorkerFailure(RuntimeError):
@@ -80,6 +95,7 @@ class ElasticSupervisor:
         restart_backoff: float = 0.0,
         backoff_jitter: float = 0.0,
         attempt_timeout: Optional[float] = None,
+        gang_id: str = "gang0",
     ):
         if restart_backoff < 0:
             raise ValueError(f"restart_backoff={restart_backoff} must be >= 0")
@@ -98,6 +114,10 @@ class ElasticSupervisor:
         self.restart_backoff = restart_backoff
         self.backoff_jitter = backoff_jitter
         self.attempt_timeout = attempt_timeout
+        #: Which gang this supervisor owns — stamped into every
+        #: ``elastic.restart/v1`` record so one telemetry stream can carry a
+        #: whole fleet's restart history (``FleetSupervisor`` runs many).
+        self.gang_id = str(gang_id)
         self.attempts_used = 0
         self.attempt_timeouts = 0
 
@@ -110,6 +130,7 @@ class ElasticSupervisor:
 
         tel.emit({
             "schema": ELASTIC_RESTART_SCHEMA,
+            "gang_id": self.gang_id,
             "attempt": attempt,
             "attempts_used": self.attempts_used,
             "max_restarts": self.max_restarts,
@@ -119,16 +140,7 @@ class ElasticSupervisor:
         })
 
     def _backoff_delay(self, attempt: int) -> float:
-        """Exponential backoff before restart ``attempt + 1``, with fractional
-        random jitter (restarting gangs must not stampede in lockstep)."""
-        if self.restart_backoff <= 0:
-            return 0.0
-        import random
-
-        delay = self.restart_backoff * (2.0 ** attempt)
-        if self.backoff_jitter:
-            delay *= 1.0 + self.backoff_jitter * (2.0 * random.random() - 1.0)
-        return max(0.0, delay)
+        return backoff_delay(self.restart_backoff, self.backoff_jitter, attempt)
 
     def _coordinator(self) -> str:
         port = self.coordinator_port or get_free_port()
@@ -207,3 +219,102 @@ class ElasticSupervisor:
             f"{self.max_restarts + 1} attempts (last exit codes {codes})",
             codes,
         )
+
+
+class FleetSupervisor:
+    """Per-gang restart accounting for a fleet of replicas — the multi-gang
+    generalization of :class:`ElasticSupervisor`'s budget/backoff machinery.
+
+    ``ElasticSupervisor.run()`` owns ONE subprocess gang end to end; a fleet
+    router instead owns N in-process replicas whose deaths arrive as events
+    (crashes, tripped breakers, drains). This class gives each gang an
+    INDEPENDENT restart budget and exponential-backoff schedule (one flapping
+    replica must never consume its neighbors' restart budget), the same
+    ``backoff_delay`` math and the same ``elastic.restart/v1`` telemetry
+    records (with ``gang_id`` naming which gang) — so the fleet supervises
+    replicas through the supervisor layer's accounting instead of an ad-hoc
+    restart loop.
+
+    The clock is injectable so a virtual-clock replay (serve-bench) gets
+    deterministic restart timing; backoff here is a *schedule* (``restart_at``)
+    rather than a sleep — the router keeps serving other replicas while a
+    dead one waits out its delay."""
+
+    def __init__(self, max_restarts: int = 1, restart_backoff: float = 0.0,
+                 backoff_jitter: float = 0.0, telemetry=None,
+                 clock: Callable[[], float] = time.monotonic):
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts={max_restarts} must be >= 0")
+        if restart_backoff < 0:
+            raise ValueError(f"restart_backoff={restart_backoff} must be >= 0")
+        if not 0.0 <= backoff_jitter <= 1.0:
+            raise ValueError(f"backoff_jitter={backoff_jitter} must be in [0, 1]")
+        self.max_restarts = int(max_restarts)
+        self.restart_backoff = float(restart_backoff)
+        self.backoff_jitter = float(backoff_jitter)
+        self.telemetry = telemetry
+        self._clock = clock
+        self._attempts: dict = {}    # gang_id → failed attempts recorded
+        self._restart_at: dict = {}  # gang_id → earliest allowed restart time
+
+    def attempts_used(self, gang_id: str) -> int:
+        return self._attempts.get(gang_id, 0)
+
+    def budget_left(self, gang_id: str) -> bool:
+        """Does this gang still have restart budget? (Independent per gang.)"""
+        return self._attempts.get(gang_id, 0) <= self.max_restarts
+
+    def record_failure(self, gang_id: str, exit_codes=(),
+                       reason: str = "failed") -> bool:
+        """Record one gang death; returns True when a restart is still in
+        budget (the restart becomes allowed at :meth:`restart_at` after the
+        backoff). Emits the ``elastic.restart/v1`` record either way — the
+        terminal budget-exhausting failure is the one an operator most needs
+        to see (the ElasticSupervisor lesson)."""
+        attempt = self._attempts.get(gang_id, 0)
+        self._attempts[gang_id] = attempt + 1
+        final = attempt >= self.max_restarts
+        if not final:
+            self._restart_at[gang_id] = self._clock() + backoff_delay(
+                self.restart_backoff, self.backoff_jitter, attempt
+            )
+        tel = self.telemetry
+        if tel is not None and getattr(tel, "enabled", False):
+            from .telemetry.slo import ELASTIC_RESTART_SCHEMA
+
+            tel.emit({
+                "schema": ELASTIC_RESTART_SCHEMA,
+                "gang_id": gang_id,
+                "attempt": attempt,
+                "attempts_used": self._attempts[gang_id],
+                "max_restarts": self.max_restarts,
+                "exit_codes": list(exit_codes),
+                "final": final,
+                "timeout": False,
+                "reason": reason,
+            })
+        logger.warning(
+            f"gang {gang_id} {reason} "
+            f"(attempt {attempt + 1}/{self.max_restarts + 1}"
+            f"{', budget exhausted' if final else ''})"
+        )
+        return not final
+
+    def restart_at(self, gang_id: str) -> float:
+        """Earliest time the gang's next restart is allowed (-inf = never
+        failed, so immediately)."""
+        return self._restart_at.get(gang_id, float("-inf"))
+
+    def may_restart(self, gang_id: str) -> bool:
+        """Budget left AND the backoff delay has elapsed."""
+        return (self.budget_left(gang_id)
+                and self._clock() >= self.restart_at(gang_id))
+
+    def stats(self) -> dict:
+        return {
+            "max_restarts": self.max_restarts,
+            "attempts": dict(self._attempts),
+            "exhausted": sorted(
+                g for g, n in self._attempts.items() if n > self.max_restarts
+            ),
+        }

@@ -23,6 +23,7 @@ injected plan and stamps the recovery evidence into ``BENCH_CHAOS.json``.
 """
 
 from .faults import (
+    EngineCrashed,
     FaultError,
     FaultPlan,
     FaultSpec,
@@ -34,6 +35,7 @@ from .faults import (
 )
 
 __all__ = [
+    "EngineCrashed",
     "FaultError",
     "FaultPlan",
     "FaultSpec",

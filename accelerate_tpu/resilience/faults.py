@@ -12,9 +12,12 @@ fire budget, and optional request matching). The instrumented sites are:
 site                   instrumented in
 =====================  ======================================================
 ``serving.decode``     ``ContinuousBatcher`` decode/verify dispatch (kinds:
-                       ``error``, ``hang``, ``nonfinite``)
+                       ``error``, ``hang``, ``nonfinite``; ``crash`` = whole-
+                       engine death — raises :class:`EngineCrashed` PAST the
+                       engine's recovery boundary, the fleet router's failover
+                       signal)
 ``serving.prefill``    admission prefill (``error`` — always attributable to
-                       the admitting request)
+                       the admitting request; ``crash`` as above)
 ``serving.kv_admit``   paged page-pool allocation (``error``)
 ``train.step``         ``_TrainStep`` (kind ``nonfinite`` poisons the batch's
                        float leaves with NaN — the REAL non-finite guard path,
@@ -46,6 +49,7 @@ from typing import List, Optional, Sequence
 __all__ = [
     "FaultError",
     "InjectedFault",
+    "EngineCrashed",
     "StepTimeout",
     "NonFiniteStepError",
     "FaultSpec",
@@ -80,6 +84,24 @@ class InjectedFault(FaultError):
         self.kind = kind
         self.uid = uid
         self.pre_dispatch = pre_dispatch
+
+
+class EngineCrashed(FaultError):
+    """A whole-engine (replica) death — the in-process stand-in for a killed
+    serving process.
+
+    Unlike :class:`InjectedFault`, the engine's own recovery boundary must NOT
+    catch this: there is no process left to quarantine a request in, so the
+    crash propagates out of ``step()`` to whoever owns the replica (the fleet
+    router, which migrates the in-flight requests to another replica and hands
+    the corpse to the supervisor for restart). Injected via fault kind
+    ``crash`` at the serving sites (``serving.decode`` / ``serving.prefill``)."""
+
+    def __init__(self, site: str, uid: Optional[int] = None):
+        super().__init__(f"engine crashed at {site}")
+        self.site = site
+        self.kind = "crash"
+        self.uid = uid
 
 
 class StepTimeout(FaultError):

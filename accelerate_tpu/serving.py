@@ -67,7 +67,7 @@ from .generation import (
 from .models import llama
 from .models.llama import init_cache
 from .paged_kv import BlockManager, KVBudgetError, pages_for
-from .resilience.faults import StepWatchdog
+from .resilience.faults import EngineCrashed, StepWatchdog
 from .telemetry.schemas import (
     FAULT_SCHEMA,
     RECOVERY_SCHEMA,
@@ -653,6 +653,10 @@ class ContinuousBatcher:
         #: (verification guarantees correctness; a stale draft cache only
         #: lowers acceptance), it just reverts decode to one token per step.
         self.spec_enabled = True
+        #: Set when an injected ``crash`` killed this engine (EngineCrashed
+        #: escaped a dispatch): the object must not serve again — the fleet
+        #: router replaces it via its restart path.
+        self.crashed = False
         self.step_failures = 0        # dispatches the fault boundary caught
         self.quarantined = 0          # requests terminally failed by recovery
         self.recovered_admissions = 0  # survivor re-admissions (prefill replays)
@@ -915,6 +919,12 @@ class ContinuousBatcher:
                     # runs, the post-dispatch check converts the overrun into
                     # the step-failure path before any token is emitted.
                     time.sleep(spec.hang_s)
+                elif spec.kind == "crash":
+                    # Whole-engine death: marks this engine unusable and
+                    # escapes the recovery boundary — there is no in-engine
+                    # recovery from a dead process; the fleet router owns it.
+                    self.crashed = True
+                    raise EngineCrashed(site)
                 else:
                     raise fp.fault_for(spec, site)
         return t0
@@ -1108,6 +1118,11 @@ class ContinuousBatcher:
                     self._spec_step(active) if use_spec
                     else self._plain_step(active)
                 )
+            except EngineCrashed:
+                # A crash is the death of the whole engine, not a step fault:
+                # no in-engine quarantine/rebuild is possible — it propagates
+                # to the replica's owner (the fleet router's failover path).
+                raise
             except Exception as e:  # the fault boundary: quarantine + rebuild
                 finished = self._recover_step_failure(e, active_reqs)
             else:
@@ -1550,6 +1565,9 @@ class ContinuousBatcher:
                 fp = self.faults
                 if fp is not None and self.recover:
                     spec = fp.draw("serving.prefill", uid=req.uid)
+                    if spec is not None and spec.kind == "crash":
+                        self.crashed = True
+                        raise EngineCrashed("serving.prefill", uid=req.uid)
                     if spec is not None:
                         # A prefill failure is ALWAYS attributable: the fault
                         # fired admitting exactly this request. Nothing was
@@ -1572,6 +1590,8 @@ class ContinuousBatcher:
                 try:
                     prefilled = self._prefill_into_slot(slot, req, plan, ctx,
                                                         remaining)
+                except EngineCrashed:
+                    raise  # whole-engine death: the fleet router's problem
                 except Exception as e:
                     if not self.recover:
                         raise

@@ -11,6 +11,10 @@ token streaming, and p50/p95/p99 SLO summaries through the telemetry pipeline.
 Off by default: nothing here is imported by the engine, and a gateway-fronted
 run compiles exactly the programs an engine-only run does (docs/serving_gateway.md).
 
+Fleet tier (``fleet.FleetRouter``, docs/resilience.md): the same machinery over
+N engine replicas — health-driven routing, per-replica circuit breakers,
+lossless failover via request replay, drain-on-restart / rolling restart.
+
 Enable via ``GatewayConfig`` / ``ACCELERATE_GATEWAY`` and build with::
 
     gw = ServingGateway(engine, GatewayConfig(enabled=True, policy="edf"))
@@ -18,6 +22,14 @@ Enable via ``GatewayConfig`` / ``ACCELERATE_GATEWAY`` and build with::
     gw.run()
 """
 
+from .fleet import (
+    ACTIVE,
+    DRAINING,
+    RESTARTING,
+    RETIRED,
+    FleetRouter,
+    Replica,
+)
 from .gateway import (
     CANCELLED,
     DONE,
@@ -29,6 +41,7 @@ from .gateway import (
     RUNNING,
     SHED,
     TERMINAL_STATUSES,
+    CircuitBreaker,
     GatewayRequest,
     ServingGateway,
 )
@@ -65,6 +78,13 @@ __all__ = [
     "trace_hash",
     "ServingGateway",
     "GatewayRequest",
+    "CircuitBreaker",
+    "FleetRouter",
+    "Replica",
+    "ACTIVE",
+    "DRAINING",
+    "RESTARTING",
+    "RETIRED",
     "SchedulerPolicy",
     "FifoPolicy",
     "PriorityPolicy",

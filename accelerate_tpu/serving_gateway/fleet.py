@@ -1,0 +1,731 @@
+"""Fleet-level serving resilience: a router over N engine replicas.
+
+``ServingGateway`` made ONE ``ContinuousBatcher`` a production front door, and
+PR 9 made that engine survive its own faults — but one wedged or killed engine
+was still a total outage. :class:`FleetRouter` is the missing tier (ROADMAP
+item 3(b)): the same policy/admission machinery (it IS a ``ServingGateway``
+subclass — one queue, one policy, the same submit contract and SLO records),
+dispatching into a FLEET of engine replicas with:
+
+- **Health-driven routing** — a per-replica health score computed from the
+  telemetry the stack already emits (recent step-failure rate incl. watchdog
+  timeouts, lane occupancy, engine-internal queue depth, paged-KV pool
+  occupancy); admission dispatches to the healthiest least-loaded routable
+  replica, and every decision is a ``fleet.route/v1`` record. Per-replica
+  health goes out as ``replica.health/v1`` each router step.
+- **Per-replica circuit breakers** — the single-engine gateway's breaker
+  (one shared :class:`~.gateway.CircuitBreaker` implementation), instantiated
+  per replica: OPEN isolates one replica from routing while the rest keep
+  serving; after the cooldown the replica earns routing back through a
+  half-open probe. A submission is never refused while any replica could
+  serve it (the per-replica-isolation acceptance contract).
+- **Lossless failover** — a replica death (injected ``crash`` fault →
+  :class:`~..resilience.faults.EngineCrashed`, or an operator
+  :meth:`FleetRouter.kill`) or a tripped breaker migrates its in-flight
+  requests to the queue via the PR-9 replay path (``on_retry`` stream reset,
+  byte-identical transcripts, zero preemption-retry-budget spend); the next
+  step re-admits them on a healthy replica.
+- **Drain-on-restart / rolling restart** — :meth:`drain` stops routing new
+  admissions to a replica, lets in-flight requests finish (or migrates them
+  past the drain deadline), restarts the engine through the per-gang
+  :class:`~..elastic.FleetSupervisor` budgets, and re-admits the fresh replica
+  through a half-open probe warm-up. :meth:`rolling_restart` walks the whole
+  fleet one replica at a time so capacity never drops by more than one.
+
+Proof: ``serve-bench --fleet N --chaos`` (``commands/serve_bench.
+run_fleet_chaos_bench``) replays one workload trace against the fleet while a
+seeded plan kills replicas, and stamps ``BENCH_FLEET.json`` — zero
+``silently_lost``, migrated streams byte-identical to the undisturbed fleet,
+availability above the single-replica run at the same fault rate, and the
+failover p95 TTFT penalty (docs/resilience.md).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..elastic import FleetSupervisor
+from ..resilience.faults import EngineCrashed
+from ..telemetry.schemas import (
+    FLEET_ROUTE_SCHEMA,
+    RECOVERY_SCHEMA,
+    REPLICA_HEALTH_SCHEMA,
+)
+from ..utils.dataclasses import GatewayConfig
+from .gateway import (
+    CANCELLED,
+    DONE,
+    EVICTED,
+    EXPIRED,
+    FAILED,
+    QUEUED,
+    RUNNING,
+    CircuitBreaker,
+    GatewayRequest,
+    ServingGateway,
+)
+
+__all__ = [
+    "FleetRouter",
+    "Replica",
+    "ACTIVE",
+    "DRAINING",
+    "RESTARTING",
+    "RETIRED",
+]
+
+# ------------------------------------------------------------- replica states
+ACTIVE = "active"          # routable (subject to its breaker)
+DRAINING = "draining"      # no new admissions; in-flight finishing (→ restart)
+RESTARTING = "restarting"  # dead/stopped; waiting out supervisor backoff
+RETIRED = "retired"        # restart budget exhausted: permanently out
+
+
+class Replica:
+    """One engine replica's routing state: the engine, its circuit breaker,
+    the requests it is serving (engine uid → gateway request — engine uids are
+    only unique per engine, so the map is per replica), and the failure-recency
+    window the health score reads."""
+
+    def __init__(self, rid: int, engine, breaker: CircuitBreaker):
+        self.rid = rid
+        self.engine = engine
+        self.breaker = breaker
+        self.state = ACTIVE
+        self.running: Dict[int, GatewayRequest] = {}
+        self.failures_seen = getattr(engine, "step_failures", 0)
+        self.fail_times: List[float] = []  # recency window for the health score
+        self.drain_deadline: Optional[float] = None
+        self.restarts = 0
+
+    @property
+    def gang_id(self) -> str:
+        return f"replica{self.rid}"
+
+    def free_lanes(self) -> int:
+        eng = self.engine
+        return (eng.max_slots
+                - sum(r is not None for r in eng.slot_req)
+                - len(eng.queue))
+
+    def __repr__(self) -> str:
+        return (f"Replica({self.rid}, state={self.state!r}, "
+                f"running={len(self.running)}, breaker={self.breaker.state!r})")
+
+
+#: Seconds of failure history the health score weighs (independent of the
+#: breaker window so health-driven routing works with the breaker disabled).
+HEALTH_WINDOW_S = 60.0
+
+
+class FleetRouter(ServingGateway):
+    """Health-routed, failover-capable gateway over N ``ContinuousBatcher``
+    replicas (see module docstring).
+
+    ``engines`` must be homogeneous (same slot/length/page geometry — the
+    admission cost model prices one layout). ``engine_factory(rid)`` builds a
+    fresh replacement engine for restarts; without one, a dead replica simply
+    retires. ``supervisor`` (a :class:`~..elastic.FleetSupervisor`) owns the
+    per-replica restart budgets/backoff; a default one is built from the
+    gateway config on the router's own clock."""
+
+    def __init__(self, engines: Sequence, config: Optional[GatewayConfig] = None,
+                 telemetry=None, clock: Callable[[], float] = time.monotonic,
+                 tracer=None, engine_factory: Optional[Callable[[int], object]] = None,
+                 supervisor: Optional[FleetSupervisor] = None):
+        engines = list(engines)
+        if not engines:
+            raise ValueError("FleetRouter needs at least one engine replica")
+        geo = [(e.max_slots, e.max_len, e.prompt_bucket, e.page_size)
+               for e in engines]
+        if len(set(geo)) > 1:
+            raise ValueError(
+                f"fleet replicas must share one engine geometry "
+                f"(max_slots/max_len/prompt_bucket/page_size), got {geo}: the "
+                "admission cost model prices ONE layout"
+            )
+        if config is not None and config.degrade:
+            raise ValueError(
+                "degrade=True is a single-engine breaker rung ladder; the fleet "
+                "degrades by ISOLATING replicas instead — disable it"
+            )
+        super().__init__(engines[0], config, telemetry=telemetry, clock=clock,
+                         tracer=tracer)
+        self.engine_factory = engine_factory
+        cfg = self.config
+        self.supervisor = supervisor if supervisor is not None else FleetSupervisor(
+            max_restarts=cfg.replica_restarts,
+            restart_backoff=cfg.replica_restart_backoff,
+            telemetry=telemetry, clock=clock,
+        )
+        self._replicas: List[Replica] = []
+        for rid, eng in enumerate(engines):
+            if tracer is not None and getattr(eng, "tracer", None) is None:
+                eng.tracer = tracer
+            self._replicas.append(Replica(rid, eng, CircuitBreaker(
+                cfg.breaker_threshold, cfg.breaker_window_s,
+                cfg.breaker_cooldown_s,
+            )))
+        self.counters.update({
+            "migrated": 0, "replica_kills": 0, "replica_restarts": 0,
+            "replica_retired": 0,
+        })
+        self._steps = 0
+        #: Replica ids still awaiting their turn in a rolling restart.
+        self._rolling: List[int] = []
+        self._rolling_deadline_s: Optional[float] = None
+        #: Requests finalized OUTSIDE a step's event collection (the all-
+        #: retired backlog flush, possibly triggered by an out-of-band
+        #: ``kill()``) — drained into the next ``step()``'s return so the
+        #: documented every-terminal-is-returned contract holds.
+        self._pending_events: List[GatewayRequest] = []
+
+    # ------------------------------------------------------------- introspection
+    @property
+    def replicas(self) -> List[Replica]:
+        return list(self._replicas)
+
+    @property
+    def running_count(self) -> int:
+        return sum(len(rep.running) for rep in self._replicas)
+
+    def replica_health(self, rid: int) -> float:
+        return self._health(self._replicas[rid], self._clock())
+
+    # ---------------------------------------------------------------- admission
+    def _admission_gate(self, greq: GatewayRequest, now: float) -> Optional[str]:
+        """Fleet front door: refuse ONLY when no replica could ever serve the
+        request — every replica permanently retired. A replica with an open
+        breaker, mid-drain or mid-restart keeps the request QUEUED (deadlines
+        still protect the caller); rejecting there would refuse work a healthy
+        replica could pick up the very next step (the per-replica-isolation
+        acceptance contract)."""
+        if all(rep.state == RETIRED for rep in self._replicas):
+            return "fleet_down"
+        return None
+
+    def _free_lanes(self) -> int:
+        """Lanes the fleet can fill this step (routable replicas only) — feeds
+        the preemption trigger exactly like the single-engine count."""
+        now = self._clock()
+        return sum(rep.free_lanes() for rep in self._replicas
+                   if self._routable(rep, now))
+
+    def _routable(self, rep: Replica, now: float) -> bool:
+        """May ``rep`` receive a NEW admission right now? (Read-only: the
+        probe assignment happens at dispatch, through ``breaker.gate``.)"""
+        if rep.state != ACTIVE:
+            return False
+        br = rep.breaker
+        if br.enabled and br.state != "closed":
+            if br.state == "open":
+                return now - br._opened_at >= br.cooldown_s  # will half-open
+            return br.probe_uid is None  # half-open: one outstanding probe
+        return True
+
+    def _health(self, rep: Replica, now: float) -> float:
+        """Health score in [0, 1] from signals the stack already tracks:
+        recent step failures (quarantines, watchdog timeouts — everything the
+        engine's fault boundary counts), lane occupancy, engine-internal queue
+        depth (paged pool-pressure deferrals park requests there), and paged
+        page-pool occupancy. Dead/retired replicas score 0; a replica whose
+        breaker is not closed is capped low so routing prefers proven-healthy
+        peers even when the sick one has free lanes."""
+        if rep.state in (RESTARTING, RETIRED):
+            return 0.0
+        eng = rep.engine
+        rep.fail_times = [t for t in rep.fail_times
+                          if now - t <= HEALTH_WINDOW_S]
+        fail_scale = max(1, rep.breaker.threshold or 3)
+        score = 1.0
+        score -= 0.5 * min(1.0, len(rep.fail_times) / fail_scale)
+        active = sum(r is not None for r in eng.slot_req)
+        score -= 0.2 * (active / eng.max_slots)
+        score -= 0.1 * min(1.0, len(eng.queue) / eng.max_slots)
+        if eng.paged:
+            ms = eng.block_mgr
+            score -= 0.2 * (ms.pages_in_use / ms.num_pages)
+        if rep.breaker.enabled and rep.breaker.state != "closed":
+            score = min(score, 0.25)
+        return max(0.0, round(score, 4))
+
+    def _pick_replica(self, now: float) -> Optional[Replica]:
+        """Routing decision for the next admission: any half-open replica with
+        no outstanding probe gets it FIRST (one probe resolves its state — a
+        restarted replica earns full routing back, a still-sick one re-opens
+        after a single request); otherwise the healthiest routable replica
+        with free lanes, ties to most free lanes then lowest rid."""
+        probes = [rep for rep in self._replicas
+                  if rep.state == ACTIVE and rep.breaker.enabled
+                  and rep.breaker.state != "closed"
+                  and self._routable(rep, now) and rep.free_lanes() > 0]
+        if probes:
+            return probes[0]
+        candidates = [rep for rep in self._replicas
+                      if self._routable(rep, now) and rep.free_lanes() > 0]
+        if not candidates:
+            return None
+        return max(candidates,
+                   key=lambda r: (self._health(r, now), r.free_lanes(), -r.rid))
+
+    def _dispatch(self, greq: GatewayRequest, rep: Replica, now: float) -> None:
+        """Admit ``greq`` into ``rep``'s engine (the fleet spelling of the base
+        ``_admit``), recording the routing decision as ``fleet.route/v1``."""
+        probe = False
+        if rep.breaker.enabled:
+            gate = rep.breaker.gate(greq.uid, now)
+            # _routable said yes, so the only mutation here is assigning the
+            # half-open probe; a refusal would be a bookkeeping bug.
+            assert gate is None, (rep, gate)
+            probe = rep.breaker.probe_uid == greq.uid
+        greq.status = RUNNING
+        greq.t_admit = now
+        greq._rid = rep.rid
+        self.counters["admitted"] += 1
+        ereq = rep.engine.submit(
+            greq.prompt, gen=greq.gen,
+            rng=greq.rng if greq.gen.temperature > 0.0 else None,
+            on_token=self._stream_cb(greq),
+        )
+        greq._engine_req = ereq
+        rep.running[ereq.uid] = greq
+        tr = self.tracer
+        if tr is not None:
+            tr.span(greq._trace, "queue", greq.t_enqueued, now,
+                    attempt=greq.retries_used + greq.replays,
+                    outcome="admitted")
+            tr.bind_engine(greq._trace, ereq.uid)
+        self._emit_route(greq.uid, rep, "probe" if probe else "dispatch", now)
+
+    # ------------------------------------------------------------------ stepping
+    def step(self) -> List[GatewayRequest]:
+        """One fleet cycle: expire deadline violators, advance replica
+        lifecycle (drain completion, restart backoff expiry, rolling restart),
+        preempt, admit queued requests to routable replicas in policy order,
+        step every live replica engine (a crash fails over instead of
+        propagating), observe per-replica failures into the breakers, and emit
+        the per-replica ``replica.health/v1`` records."""
+        now = self._clock()
+        self._steps += 1
+        # Terminals finalized between steps (out-of-band kill → backlog flush)
+        # are reported by THIS step — never silently dropped.
+        events: List[GatewayRequest] = self._pending_events
+        self._pending_events = []
+
+        # 1) queued deadline expiry — never occupies a lane.
+        for item in self._policy.items():
+            if item.deadline_at is not None and now > item.deadline_at:
+                self._policy.remove(item.uid)
+                self._queued_cost -= item.cost
+                self.counters["expired"] += 1
+                self._finalize(item, EXPIRED, "deadline_queued", now)
+                events.append(item)
+
+        # 2) running deadline eviction, per replica (lane frees for this same
+        #    step's admission pass; engine.cancel finds recovery-parked copies).
+        for rep in self._replicas:
+            for greq in list(rep.running.values()):
+                if greq.deadline_at is not None and now > greq.deadline_at:
+                    rep.engine.cancel(greq._engine_req.uid)
+                    rep.running.pop(greq._engine_req.uid, None)
+                    greq.tokens = list(greq._engine_req.tokens)
+                    self.counters["expired"] += 1
+                    self._finalize(greq, EXPIRED, "deadline_running", now)
+                    events.append(greq)
+
+        # 3) replica lifecycle: drains that completed/overran, restarts whose
+        #    backoff elapsed, the next rung of a rolling restart.
+        self._advance_replicas(now, events)
+
+        # 4) priority preemption (opt-in), fleet-wide.
+        if self.config.preempt:
+            events.extend(self._preempt(now))
+
+        # 5) admit in policy order while some replica can take the work.
+        while len(self._policy):
+            rep = self._pick_replica(now)
+            if rep is None:
+                break
+            item = self._policy.pop(now)
+            self._queued_cost -= item.cost
+            self._dispatch(item, rep, now)
+
+        # 6) advance every live replica engine; map completions; a crash is
+        #    the failover signal, not an exception the caller sees.
+        for rep in self._replicas:
+            if rep.state in (RESTARTING, RETIRED):
+                continue
+            try:
+                finished = rep.engine.step()
+            except EngineCrashed as e:
+                self._replica_died(rep, f"crash:{e.site}", now)
+                continue
+            t_done = self._clock()
+            for ereq in finished:
+                greq = rep.running.pop(ereq.uid, None)
+                if greq is None:
+                    continue
+                greq.tokens = list(ereq.tokens)
+                greq.recoveries = getattr(ereq, "recoveries", 0)
+                failed_reason = getattr(ereq, "failed", None)
+                if failed_reason is not None:
+                    self.counters["failed"] += 1
+                    self._finalize(greq, FAILED, failed_reason, t_done)
+                else:
+                    self.counters["done"] += 1
+                    self._finalize(greq, DONE, None, t_done)
+                events.append(greq)
+            self._observe_replica(rep, now)
+
+        # 7) replica lifecycle again, with this step's completions applied: a
+        #    drain whose last in-flight request just finished restarts NOW —
+        #    otherwise a drain that completes on the workload's final step
+        #    would strand the replica DRAINING until some future step.
+        self._advance_replicas(self._clock(), events)
+        # Terminals finalized DURING this step outside the event collection
+        # (a mid-step retire flushing the backlog) belong to this step too.
+        events.extend(self._pending_events)
+        self._pending_events = []
+        self._emit_health(now)
+        return sorted(events, key=lambda r: r.uid)
+
+    def run(self, report_slo: bool = False):
+        """Base drain loop, plus: keep stepping while out-of-band terminals
+        (an all-retired backlog flush after ``kill()``) wait in the pending
+        buffer — they must be RETURNED, not just finalized."""
+        out: List[GatewayRequest] = []
+        while self.queue_depth or self.running_count or self._pending_events:
+            out.extend(self.step())
+        if report_slo:
+            return out, self.emit_slo_record()
+        return out
+
+    def _observe_replica(self, rep: Replica, now: float) -> None:
+        """Read the replica's step-failure delta into its breaker and health
+        window; a breaker trip isolates the replica AND migrates its in-flight
+        requests (a replica misbehaving enough to trip the breaker should not
+        keep holding requests healthy peers could finish)."""
+        failures = getattr(rep.engine, "step_failures", 0)
+        delta = failures - rep.failures_seen
+        rep.failures_seen = failures
+        if delta > 0:
+            rep.fail_times.extend([now] * delta)
+        if rep.breaker.record_failures(delta, now):
+            rep.breaker.open(now)
+            self._emit_fleet_recovery("circuit_open", rep, now)
+            self._migrate(rep, f"breaker_open:replica{rep.rid}", now,
+                          engine_alive=True)
+
+    # ------------------------------------------------------------------ failover
+    def _migrate(self, rep: Replica, cause: str, now: float,
+                 engine_alive: bool) -> List[GatewayRequest]:
+        """Move every in-flight request off ``rep`` back into the queue via the
+        replay path (byte-identical transcripts, zero retry-budget spend). With
+        the engine still alive its lanes are cancelled first; a crashed engine
+        is simply abandoned."""
+        migrated = []
+        for greq in list(rep.running.values()):
+            if engine_alive:
+                rep.engine.cancel(greq._engine_req.uid)
+            self._replay_requeue(greq, now, cause)
+            self.counters["migrated"] += 1
+            self._emit_route(greq.uid, rep, "migrate", now)
+            migrated.append(greq)
+        rep.running.clear()
+        return migrated
+
+    def _replica_died(self, rep: Replica, reason: str, now: float) -> None:
+        """A replica's engine is gone (crash fault or operator kill): migrate
+        its requests, then hand the gang to the supervisor — restart when the
+        per-gang budget and backoff allow, retire when the budget is spent."""
+        self.counters["replica_kills"] += 1
+        self._migrate(rep, reason, now, engine_alive=False)
+        allowed = self.supervisor.record_failure(rep.gang_id, reason=reason)
+        if allowed and self.engine_factory is not None:
+            rep.state = RESTARTING
+        else:
+            self._retire(rep, now)
+        self._emit_fleet_recovery("replica_died", rep, now, reason=reason)
+
+    def _retire(self, rep: Replica, now: float) -> None:
+        rep.state = RETIRED
+        self.counters["replica_retired"] += 1
+        self._emit_fleet_recovery("replica_retired", rep, now)
+        if all(r.state == RETIRED for r in self._replicas):
+            # Nothing left to serve with: fail the backlog machine-readably
+            # rather than stranding it queued forever (a silent loss). The
+            # finalized requests ride the pending-event buffer into the next
+            # step()'s return — run()'s every-terminal contract holds.
+            for item in self._policy.items():
+                self._policy.remove(item.uid)
+                self._queued_cost -= item.cost
+                self.counters["failed"] += 1
+                self._finalize(item, FAILED, "fleet_down", now)
+                self._pending_events.append(item)
+
+    def kill(self, rid: int, reason: str = "killed") -> None:
+        """Operator/test hook: treat replica ``rid`` as dead right now (the
+        out-of-band spelling of an injected ``crash`` fault)."""
+        rep = self._replicas[rid]
+        if rep.state in (RESTARTING, RETIRED):
+            return
+        rep.engine.crashed = True
+        self._replica_died(rep, reason, self._clock())
+
+    # ------------------------------------------------------------ drain / restart
+    def drain(self, rid: int, deadline_s: Optional[float] = None) -> Replica:
+        """Stop routing new admissions to replica ``rid``; in-flight requests
+        keep running until they finish or the drain deadline passes (then they
+        migrate), after which the replica restarts and re-admits through a
+        half-open probe warm-up. The rolling-restart primitive."""
+        rep = self._replicas[rid]
+        if rep.state != ACTIVE:
+            raise ValueError(f"replica {rid} is {rep.state}, not active")
+        if deadline_s is None:
+            deadline_s = self.config.drain_deadline_s
+        rep.state = DRAINING
+        rep.drain_deadline = (
+            None if deadline_s is None else self._clock() + float(deadline_s)
+        )
+        self._emit_fleet_recovery("drain", rep, self._clock())
+        return rep
+
+    def rolling_restart(self, deadline_s: Optional[float] = None) -> None:
+        """Restart every replica, one at a time: drain the first; each next
+        replica drains only once the previous one is back (ACTIVE with a
+        closed/disabled breaker), so fleet capacity never drops by more than
+        one replica."""
+        self._rolling = [rep.rid for rep in self._replicas
+                         if rep.state == ACTIVE]
+        self._rolling_deadline_s = deadline_s
+        if self._rolling:
+            self.drain(self._rolling.pop(0), deadline_s)
+
+    def _advance_replicas(self, now: float, events: List[GatewayRequest]) -> None:
+        for rep in self._replicas:
+            if rep.state == DRAINING:
+                overdue = (rep.drain_deadline is not None
+                           and now > rep.drain_deadline)
+                if overdue and rep.running:
+                    self._migrate(rep, f"drain_deadline:replica{rep.rid}", now,
+                                  engine_alive=True)
+                if not rep.running:
+                    self._restart(rep, now)
+            elif rep.state == RESTARTING:
+                if (self.engine_factory is not None
+                        and self.supervisor.may_restart(rep.gang_id)):
+                    self._restart(rep, now)
+        # Rolling restart: start the next drain once no replica is mid-cycle —
+        # the drained one is back to ACTIVE and fully routable. RETIRED
+        # replicas are out of the fleet for good: they neither block the gate
+        # (a mid-cycle retirement must not stall the remaining restarts
+        # forever) nor take a turn (drain() would refuse them).
+        if self._rolling and all(
+            rep.state == RETIRED
+            or (rep.state == ACTIVE and (not rep.breaker.enabled
+                                         or rep.breaker.state == "closed"))
+            for rep in self._replicas
+        ):
+            while self._rolling:
+                rid = self._rolling.pop(0)
+                if self._replicas[rid].state == ACTIVE:
+                    self.drain(rid, self._rolling_deadline_s)
+                    break
+
+    def _restart(self, rep: Replica, now: float) -> None:
+        """Bring a drained/dead replica back: fresh engine from the factory
+        (or the drained engine itself when no factory is configured — a drain
+        cycle without replacement still re-proves health), then the half-open
+        probe warm-up: the replica serves ONE probe request before regaining
+        full routing."""
+        if self.engine_factory is not None:
+            rep.engine = self.engine_factory(rep.rid)
+            if self.tracer is not None and getattr(rep.engine, "tracer", None) is None:
+                rep.engine.tracer = self.tracer
+            if rep.rid == 0:
+                # Base-class machinery (kv_demand cost model) reads self.engine.
+                self.engine = rep.engine
+        rep.failures_seen = getattr(rep.engine, "step_failures", 0)
+        rep.fail_times = []
+        rep.drain_deadline = None
+        rep.restarts += 1
+        rep.state = ACTIVE
+        self.counters["replica_restarts"] += 1
+        if rep.breaker.enabled:
+            rep.breaker.force_half_open()  # one probe earns full routing back
+        self._emit_fleet_recovery("replica_restart", rep, now)
+
+    def _probe_verdict(self, greq: GatewayRequest, status: str,
+                       now: float) -> None:
+        """Per-replica probe fate (overrides the single-breaker hook): DONE
+        closes that replica's breaker (full routing restored), FAILED re-opens
+        it for another cooldown; any other terminal (cancel/expiry) releases
+        the probe slot so the next admission re-probes."""
+        for rep in self._replicas:
+            br = rep.breaker
+            if br.probe_uid is None or br.probe_uid != greq.uid:
+                continue
+            if status == DONE:
+                br.close(now)
+                self._emit_fleet_recovery("circuit_close", rep, now)
+            elif status == FAILED:
+                br.open(now)
+                self._emit_fleet_recovery("circuit_open", rep, now)
+            else:
+                br.probe_uid = None
+            return
+
+    # ------------------------------------------------------------------- control
+    def cancel(self, uid: int) -> bool:
+        greq = self._all.get(uid)
+        if greq is None or greq.terminal:
+            return False
+        now = self._clock()
+        if greq.status == QUEUED:
+            self._policy.remove(greq.uid)
+            self._queued_cost -= greq.cost
+            self.counters["cancelled"] += 1
+            self._finalize(greq, CANCELLED, "cancelled_queued", now)
+            return True
+        rep = self._replicas[greq._rid]
+        rep.engine.cancel(greq._engine_req.uid)
+        rep.running.pop(greq._engine_req.uid, None)
+        greq.tokens = list(greq._engine_req.tokens)
+        self.counters["cancelled"] += 1
+        self._finalize(greq, CANCELLED, "cancelled_running", now)
+        return True
+
+    def _preempt(self, now: float) -> List[GatewayRequest]:
+        """Fleet-wide preemption: the globally least-urgent running request
+        yields its lane to a strictly higher-priority queued one, which is
+        admitted into that same replica directly — the base-class semantics,
+        with the victim lookup spanning replicas. Victims are taken ONLY from
+        replicas whose breaker is closed (or disabled): a half-open replica's
+        lane may hold its probe — cancelling it and dispatching the preemptor
+        there would corrupt the probe bookkeeping, and a sick replica is the
+        wrong home for the most urgent request anyway."""
+        events: List[GatewayRequest] = []
+        while len(self._policy):
+            running = [(rep, greq) for rep in self._replicas
+                       if rep.state == ACTIVE
+                       and (not rep.breaker.enabled
+                            or rep.breaker.state == "closed")
+                       for greq in rep.running.values()]
+            if not running or self._free_lanes() > 0:
+                break
+            top = max(self._policy.items(), key=lambda i: (i.priority, -i.uid))
+            rep, victim = min(running,
+                              key=lambda rg: (rg[1].priority, -rg[1].uid))
+            if victim.priority >= top.priority:
+                break
+            rep.engine.cancel(victim._engine_req.uid)
+            rep.running.pop(victim._engine_req.uid, None)
+            if self.tracer is not None:
+                self.tracer.event(victim._trace, "preempt", t=now,
+                                  preempted_by=top.uid,
+                                  tokens_lost=len(victim._engine_req.tokens))
+            self._policy.take(top.uid, now)
+            self._queued_cost -= top.cost
+            self._dispatch(top, rep, now)
+            evicted = self._preempt_victim_requeue(victim, now)
+            if evicted is not None:
+                events.append(evicted)
+        return events
+
+    def reattach_engine(self, engine=None, reason: str = "engine_restart"):
+        raise NotImplementedError(
+            "the fleet router owns replica recovery itself — use kill()/drain()/"
+            "rolling_restart(); single-engine replay is ServingGateway's"
+        )
+
+    # ---------------------------------------------------------------- telemetry
+    def _emit_route(self, uid: int, rep: Replica, reason: str,
+                    now: float) -> None:
+        tel = self.telemetry
+        if tel is None or not tel.enabled:
+            return
+        tel.emit({
+            "schema": FLEET_ROUTE_SCHEMA,
+            "uid": uid,
+            "replica": rep.rid,
+            "reason": reason,
+            "health": self._health(rep, now),
+            "free_lanes": rep.free_lanes(),
+            "step": self._steps,
+            "t": now,
+        })
+
+    def _emit_health(self, now: float) -> None:
+        tel = self.telemetry
+        if tel is None or not tel.enabled:
+            return
+        for rep in self._replicas:
+            eng = rep.engine
+            record = {
+                "schema": REPLICA_HEALTH_SCHEMA,
+                "replica": rep.rid,
+                "state": rep.state,
+                "health": self._health(rep, now),
+                "breaker_state": rep.breaker.state,
+                "active_slots": sum(r is not None for r in eng.slot_req),
+                "queued": len(eng.queue),
+                "step_failures": getattr(eng, "step_failures", 0),
+                "watchdog_timeouts": (
+                    eng._watchdog.timeouts
+                    if getattr(eng, "_watchdog", None) is not None else 0
+                ),
+                "restarts": rep.restarts,
+                "step": self._steps,
+                "t": now,
+            }
+            if eng.paged:
+                record["page_occupancy"] = round(
+                    eng.block_mgr.pages_in_use / eng.block_mgr.num_pages, 4
+                )
+            tel.emit(record)
+
+    def _emit_fleet_recovery(self, action: str, rep: Replica, now: float,
+                             **cols) -> None:
+        tel = self.telemetry
+        if tel is None or not tel.enabled:
+            return
+        tel.emit({
+            "schema": RECOVERY_SCHEMA, "action": action, "t": now,
+            "replica": rep.rid, "replica_state": rep.state,
+            "breaker_state": rep.breaker.state, **cols,
+        })
+
+    # ------------------------------------------------------------------ reporting
+    def stats(self) -> dict:
+        """Fleet + per-replica observability snapshot (replaces the base's
+        single nested engine block with one block per replica)."""
+        now = self._clock()
+        return {
+            "policy": self._policy.name,
+            "queued": len(self._policy),
+            "queued_cost_tokens": self._queued_cost,
+            "running": self.running_count,
+            **dict(self.counters),
+            "replicas": [
+                {
+                    "replica": rep.rid,
+                    "state": rep.state,
+                    "health": self._health(rep, now),
+                    "breaker_state": rep.breaker.state,
+                    "breaker_openings": rep.breaker.openings,
+                    "breaker_closings": rep.breaker.closings,
+                    "running": len(rep.running),
+                    "restarts": rep.restarts,
+                    "engine": rep.engine.stats(),
+                }
+                for rep in self._replicas
+            ],
+            "supervisor": self.supervisor.stats(),
+            "slo": self.slo_summary(),
+        }
+
+    def __repr__(self) -> str:
+        states = ",".join(f"{r.rid}:{r.state}" for r in self._replicas)
+        return (f"FleetRouter(policy={self._policy.name!r}, replicas=[{states}], "
+                f"queued={len(self._policy)}, running={self.running_count})")

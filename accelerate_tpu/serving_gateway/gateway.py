@@ -48,6 +48,7 @@ from ..utils.dataclasses import GatewayConfig
 from .policies import make_policy
 
 __all__ = [
+    "CircuitBreaker",
     "GatewayRequest",
     "ServingGateway",
     "QUEUED",
@@ -67,7 +68,8 @@ QUEUED = "queued"        # held by the scheduler policy
 RUNNING = "running"      # admitted into an engine slot
 DONE = "done"            # finished normally (EOS / max_new_tokens)
 REJECTED = "rejected"    # refused at admission (reason: queue_full/token_budget/
-#                          kv_budget/unservable/circuit_open)
+#                          kv_budget/unservable/circuit_open/circuit_probe/
+#                          fleet_down)
 SHED = "shed"            # removed from the queue by overload shedding
 CANCELLED = "cancelled"  # withdrawn by cancel(uid) (reason says queued vs running)
 EVICTED = "evicted"      # lost its slot (preemption) with no retry budget left
@@ -127,6 +129,9 @@ class GatewayRequest:
     n_streamed: int = 0
     _engine_req: Optional[object] = dataclasses.field(default=None, repr=False)
     _trace: Optional[object] = dataclasses.field(default=None, repr=False)
+    #: Replica id currently serving this request (fleet routing only; None on
+    #: a single-engine gateway and while queued).
+    _rid: Optional[int] = dataclasses.field(default=None, repr=False)
 
     # ------------------------------------------------------------ SLO metrics
     @property
@@ -156,6 +161,99 @@ class GatewayRequest:
     @property
     def terminal(self) -> bool:
         return self.status in TERMINAL_STATUSES
+
+
+class CircuitBreaker:
+    """The closed → open → half-open failure-isolation state machine, extracted
+    so ONE implementation fronts both the single-engine gateway (where OPEN
+    gates the whole front door) and each fleet replica (where OPEN isolates one
+    replica while the router keeps dispatching to the healthy ones —
+    ``serving_gateway.fleet``).
+
+    Pure state over an injected notion of time: the owner feeds it failure
+    deltas (:meth:`record_failures`) and admission attempts (:meth:`gate`) and
+    acts on the verdicts — the breaker never touches engines, queues or
+    telemetry, so the owner's side effects (records, degradation rungs,
+    failover) ride the transitions it reports rather than hiding inside it."""
+
+    def __init__(self, threshold: int, window_s: float, cooldown_s: float):
+        self.threshold = int(threshold)
+        self.window_s = float(window_s)
+        self.cooldown_s = float(cooldown_s)
+        self.state = "closed"
+        #: The one request admitted while half-open; its terminal fate decides
+        #: the next state (owner calls back through its probe-verdict hook).
+        self.probe_uid: Optional[int] = None
+        self.openings = 0
+        self.closings = 0
+        self._fail_times: List[float] = []
+        self._opened_at = 0.0
+
+    @property
+    def enabled(self) -> bool:
+        return self.threshold > 0
+
+    def gate(self, uid: int, now: float) -> Optional[str]:
+        """Gate one admission/routing decision for request ``uid``: None admits
+        (assigning ``uid`` as the probe when half-open with none outstanding);
+        otherwise the machine-readable refusal reason — ``circuit_open`` while
+        the cooldown runs, ``circuit_probe`` while another request IS the
+        outstanding probe. The reasons are distinct on purpose: probe
+        contention (healthy-looking, waiting on one verdict) and a hard-open
+        breaker (cooling down after failures) call for different operator
+        responses, and a shared reason string hid which one was happening."""
+        if not self.enabled or self.state == "closed":
+            return None
+        if self.state == "open":
+            if now - self._opened_at >= self.cooldown_s:
+                self.state = "half_open"
+                self.probe_uid = None
+            else:
+                return "circuit_open"
+        if self.probe_uid is None:
+            self.probe_uid = uid
+            return None
+        return "circuit_probe"
+
+    def record_failures(self, delta: int, now: float) -> bool:
+        """Feed the failure delta observed since the last read; True when the
+        observation crossed the open threshold (>= ``threshold`` failures
+        inside ``window_s`` while closed, or ANY failure during a half-open
+        probe period) — the caller then performs :meth:`open` so its own
+        side effects ride the transition."""
+        if not self.enabled or delta <= 0:
+            return False
+        self._fail_times.extend([now] * delta)
+        self._fail_times = [t for t in self._fail_times
+                            if now - t <= self.window_s]
+        if self.state == "half_open":
+            return True
+        return (self.state == "closed"
+                and len(self._fail_times) >= self.threshold)
+
+    def open(self, now: float) -> None:
+        self.state = "open"
+        self._opened_at = now
+        self.probe_uid = None
+        self.openings += 1
+
+    def close(self, now: float) -> None:
+        self.state = "closed"
+        self._fail_times = []
+        self.probe_uid = None
+        self.closings += 1
+
+    def force_half_open(self) -> None:
+        """Jump straight to half-open with a clean slate — the fleet's restart
+        re-admission warm-up: a freshly restarted replica earns full routing by
+        completing one probe request, exactly like a cooled-down breaker."""
+        self.state = "half_open"
+        self.probe_uid = None
+        self._fail_times = []
+
+    def __repr__(self) -> str:
+        return (f"CircuitBreaker(state={self.state!r}, "
+                f"openings={self.openings}, closings={self.closings})")
 
 
 class ServingGateway:
@@ -207,13 +305,11 @@ class ServingGateway:
         # open → half_open after the cooldown (one probe request admitted);
         # probe DONE closes it, probe FAILED re-opens. Failure signal = the
         # engine's own step_failures counter, read as a delta after each step.
-        self._breaker_state = "closed"
-        self._fail_times: List[float] = []
-        self._breaker_opened_at = 0.0
-        self._probe_uid: Optional[int] = None
+        self._breaker = CircuitBreaker(
+            config.breaker_threshold, config.breaker_window_s,
+            config.breaker_cooldown_s,
+        )
         self._engine_failures_seen = getattr(engine, "step_failures", 0)
-        self.breaker_openings = 0
-        self.breaker_closings = 0
         # Graceful degradation rungs (config.degrade): each breaker OPEN —
         # including a re-open after a failed probe — escalates (1: speculative
         # decoding off; 2: admission bounds halved); a CLOSE (proven-healthy
@@ -263,22 +359,16 @@ class ServingGateway:
             # trace must start before admission control can refuse or defer.
             greq._trace = self.tracer.start(greq.uid, tenant=tenant, t=now)
 
-        # Circuit breaker gate: while OPEN every submission is shed-and-
-        # rejected with the machine-readable reason ``circuit_open`` (an
-        # operating condition, like queue_full); after the cooldown ONE probe
-        # request passes through (half-open) and its fate decides the state.
-        if self.config.breaker_threshold and self._breaker_state != "closed":
-            if self._breaker_state == "open":
-                if now - self._breaker_opened_at >= self.config.breaker_cooldown_s:
-                    self._breaker_state = "half_open"
-                    self._probe_uid = None
-                else:
-                    return self._refuse(greq, now, "circuit_open")
-            if self._breaker_state == "half_open":
-                if self._probe_uid is None:
-                    self._probe_uid = greq.uid  # the probe — admitted below
-                else:
-                    return self._refuse(greq, now, "circuit_open")
+        # Health gate: while the breaker is OPEN every submission is
+        # shed-and-rejected with the machine-readable reason ``circuit_open``
+        # (an operating condition, like queue_full); after the cooldown ONE
+        # probe request passes through (half-open, its fate decides the state)
+        # and the others are refused as ``circuit_probe``. The fleet router
+        # overrides this hook: its breakers are per-replica and gate ROUTING,
+        # so a submission is only refused when no replica could ever serve it.
+        gate_reason = self._admission_gate(greq, now)
+        if gate_reason is not None:
+            return self._refuse(greq, now, gate_reason)
 
         # Servability + cost: the engine's own KV pricing (``kv_demand`` — the
         # prefill planner's padded width + budget on a dense engine, PAGE-granular
@@ -475,37 +565,43 @@ class ServingGateway:
         return sorted(events, key=lambda r: r.uid)
 
     # ------------------------------------------------------------ circuit breaker
+    def _admission_gate(self, greq: GatewayRequest, now: float) -> Optional[str]:
+        """Pre-queue health gate: a machine-readable refusal reason, or None to
+        let the request queue. The single-engine implementation is the breaker;
+        the fleet router replaces it with replica routability (its per-replica
+        breakers gate dispatch instead of the front door)."""
+        return self._breaker.gate(greq.uid, now)
+
+    @property
+    def _breaker_state(self) -> str:
+        return self._breaker.state
+
+    @property
+    def breaker_openings(self) -> int:
+        return self._breaker.openings
+
+    @property
+    def breaker_closings(self) -> int:
+        return self._breaker.closings
+
     def _breaker_observe(self, now: float) -> None:
         failures = getattr(self.engine, "step_failures", 0)
         delta = failures - self._engine_failures_seen
         self._engine_failures_seen = failures
-        if delta > 0:
-            self._fail_times.extend([now] * delta)
-            window = self.config.breaker_window_s
-            self._fail_times = [t for t in self._fail_times if now - t <= window]
-            if self._breaker_state == "half_open":
-                # The probe period saw a failure — whatever request tripped it,
-                # the engine is not healthy: re-open for another cooldown
-                # (and escalate another rung — a failed probe IS repeated
-                # pressure).
-                self._breaker_open(now)
-            elif (self._breaker_state == "closed"
-                  and len(self._fail_times) >= self.config.breaker_threshold):
-                self._breaker_open(now)
+        if self._breaker.record_failures(delta, now):
+            # Threshold crossed — or the half-open probe period saw a failure
+            # (whatever request tripped it, the engine is not healthy: re-open
+            # for another cooldown, and escalate another rung — a failed probe
+            # IS repeated pressure).
+            self._breaker_open(now)
 
     def _breaker_open(self, now: float) -> None:
-        self._breaker_state = "open"
-        self._breaker_opened_at = now
-        self._probe_uid = None
-        self.breaker_openings += 1
+        self._breaker.open(now)
         self._escalate()
         self._emit_breaker_record("circuit_open", now)
 
     def _breaker_close(self, now: float) -> None:
-        self._breaker_state = "closed"
-        self._fail_times = []
-        self._probe_uid = None
-        self.breaker_closings += 1
+        self._breaker.close(now)
         # A close is a PROVEN-healthy probe: restore the full configuration.
         # (One-rung-per-close would ratchet permanently — re-opens can outnumber
         # closes, so levels left over after the episode ends would never clear.)
@@ -568,22 +664,7 @@ class ServingGateway:
             self._engine_failures_seen = getattr(engine, "step_failures", 0)
         replayed = []
         for greq in list(self._running.values()):
-            greq.replays += 1
-            self.counters["replayed"] += 1
-            greq.status = QUEUED
-            greq.tokens = []
-            greq._engine_req = None
-            greq.t_admit = greq.t_first_token = greq.t_last_token = None
-            greq.t_enqueued = now  # the replay's queue wait starts HERE
-            greq.n_streamed = 0
-            if greq.on_retry is not None:
-                greq.on_retry()
-            if self.tracer is not None and greq._trace is not None:
-                greq._trace.attempt = greq.retries_used + greq.replays
-                self.tracer.event(greq._trace, "retry", t=now,
-                                  attempt=greq._trace.attempt, cause=reason)
-            self._policy.push(greq)
-            self._queued_cost += greq.cost
+            self._replay_requeue(greq, now, reason)
             replayed.append(greq)
         self._running.clear()
         tel = self.telemetry
@@ -595,6 +676,34 @@ class ServingGateway:
                 "reason": reason, "replayed": len(replayed),
             })
         return replayed
+
+    def _replay_requeue(self, greq: GatewayRequest, now: float,
+                        cause: str) -> None:
+        """Reset one in-flight request for idempotent replay and requeue it
+        under the normal policy: the ``on_retry`` stream reset fires (the
+        consumer drops its buffer; ``on_token`` then re-delivers from the first
+        token, so the final transcript is byte-identical to an undisturbed
+        run). Shared by ``reattach_engine`` (whole-engine restart) and the
+        fleet router's per-replica failover/drain migration. Replays do NOT
+        consume the preemption retry budget — a replica death is not the
+        request's fault."""
+        greq.replays += 1
+        self.counters["replayed"] += 1
+        greq.status = QUEUED
+        greq.tokens = []
+        greq._engine_req = None
+        greq._rid = None
+        greq.t_admit = greq.t_first_token = greq.t_last_token = None
+        greq.t_enqueued = now  # the replay's queue wait starts HERE
+        greq.n_streamed = 0
+        if greq.on_retry is not None:
+            greq.on_retry()
+        if self.tracer is not None and greq._trace is not None:
+            greq._trace.attempt = greq.retries_used + greq.replays
+            self.tracer.event(greq._trace, "retry", t=now,
+                              attempt=greq._trace.attempt, cause=cause)
+        self._policy.push(greq)
+        self._queued_cost += greq.cost
 
     def _free_lanes(self) -> int:
         """Lanes the engine can fill this step: open slots minus requests already
@@ -700,35 +809,61 @@ class ServingGateway:
             self._policy.take(top.uid, now)
             self._queued_cost -= top.cost
             self._admit(top, now)
-            if victim.retries_used < victim.max_retries:
-                victim.retries_used += 1
-                self.counters["retried"] += 1
-                victim.status = QUEUED
-                victim.tokens = []
-                victim._engine_req = None
-                victim.t_admit = victim.t_first_token = victim.t_last_token = None
-                victim.t_enqueued = now  # the retry's queue wait starts HERE
-                victim.n_streamed = 0
-                if victim.on_retry is not None:
-                    # Stream-reset signal: on_token is about to replay from the
-                    # first token; without this a streaming consumer's transcript
-                    # would contain the pre-eviction prefix twice.
-                    victim.on_retry()
-                if self.tracer is not None and victim._trace is not None:
-                    victim._trace.attempt = victim.retries_used
-                    self.tracer.event(victim._trace, "retry", t=now,
-                                      attempt=victim.retries_used)
-                self._policy.push(victim)
-                self._queued_cost += victim.cost
-            else:
-                # Terminal eviction keeps the partial transcript — it was already
-                # streamed to the client and the SLO record must account for it
-                # (same contract as cancel/deadline eviction).
-                victim.tokens = list(victim._engine_req.tokens)
-                self.counters["evicted"] += 1
-                self._finalize(victim, EVICTED, "preempted", now)
-                events.append(victim)
+            evicted = self._preempt_victim_requeue(victim, now)
+            if evicted is not None:
+                events.append(evicted)
         return events
+
+    def _preempt_victim_requeue(self, victim: GatewayRequest,
+                                now: float) -> Optional[GatewayRequest]:
+        """A preempted victim's fate: retry (requeued under the policy, stream
+        reset) while its budget lasts, else terminal eviction. Returns the
+        victim when it was terminally evicted (a step event), None when
+        requeued. ONE copy shared by the single-engine and fleet preempt paths
+        so the retry bookkeeping cannot drift between them."""
+        if victim.retries_used < victim.max_retries:
+            victim.retries_used += 1
+            self.counters["retried"] += 1
+            victim.status = QUEUED
+            victim.tokens = []
+            victim._engine_req = None
+            victim._rid = None
+            victim.t_admit = victim.t_first_token = victim.t_last_token = None
+            victim.t_enqueued = now  # the retry's queue wait starts HERE
+            victim.n_streamed = 0
+            if victim.on_retry is not None:
+                # Stream-reset signal: on_token is about to replay from the
+                # first token; without this a streaming consumer's transcript
+                # would contain the pre-eviction prefix twice.
+                victim.on_retry()
+            if self.tracer is not None and victim._trace is not None:
+                victim._trace.attempt = victim.retries_used
+                self.tracer.event(victim._trace, "retry", t=now,
+                                  attempt=victim.retries_used)
+            self._policy.push(victim)
+            self._queued_cost += victim.cost
+            return None
+        # Terminal eviction keeps the partial transcript — it was already
+        # streamed to the client and the SLO record must account for it
+        # (same contract as cancel/deadline eviction).
+        if victim._engine_req is not None:
+            victim.tokens = list(victim._engine_req.tokens)
+        self.counters["evicted"] += 1
+        self._finalize(victim, EVICTED, "preempted", now)
+        return victim
+
+    def _probe_verdict(self, greq: GatewayRequest, status: str,
+                       now: float) -> None:
+        """Terminal-state hook deciding a half-open breaker's fate when the
+        finished request was its probe (fleet: checked per replica)."""
+        if self._breaker.probe_uid is None or greq.uid != self._breaker.probe_uid:
+            return
+        if status == DONE:
+            self._breaker_close(now)
+        elif status == FAILED:
+            self._breaker_open(now)  # a failed probe re-opens + escalates
+        else:
+            self._breaker.probe_uid = None  # probe never ran (cancel/expiry): re-probe
 
     # ------------------------------------------------------------------ reporting
     def _finalize(self, greq: GatewayRequest, status: str, reason: Optional[str],
@@ -738,13 +873,7 @@ class ServingGateway:
         greq.t_done = now
         greq._engine_req = None  # release the engine Request (and its prompt/cache refs)
         # Half-open probe verdict: the probe's fate decides the breaker.
-        if self._probe_uid is not None and greq.uid == self._probe_uid:
-            if status == DONE:
-                self._breaker_close(now)
-            elif status == FAILED:
-                self._breaker_open(now)  # a failed probe re-opens + escalates
-            else:
-                self._probe_uid = None  # probe never ran (cancel/expiry): re-probe
+        self._probe_verdict(greq, status, now)
         tr = self.tracer
         if tr is not None and greq._trace is not None:
             if greq.t_admit is None:

@@ -32,7 +32,9 @@ from .provenance import config_fingerprint, git_commit, provenance_stamp
 from .schemas import (
     AUDIT_PROGRAM_SCHEMA,
     FAULT_SCHEMA,
+    FLEET_ROUTE_SCHEMA,
     RECOVERY_SCHEMA,
+    REPLICA_HEALTH_SCHEMA,
     SCHEMA_REGISTRY,
     SERVING_KV_SCHEMA,
     SERVING_SCHEMA,
@@ -70,7 +72,9 @@ __all__ = [
     "provenance_stamp",
     "AUDIT_PROGRAM_SCHEMA",
     "FAULT_SCHEMA",
+    "FLEET_ROUTE_SCHEMA",
     "RECOVERY_SCHEMA",
+    "REPLICA_HEALTH_SCHEMA",
     "SCHEMA_REGISTRY",
     "SERVING_KV_SCHEMA",
     "SERVING_SCHEMA",

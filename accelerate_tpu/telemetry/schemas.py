@@ -35,6 +35,8 @@ __all__ = [
     "SERVING_SPEC_SCHEMA",
     "GATEWAY_REQUEST_SCHEMA",
     "GATEWAY_SLO_SCHEMA",
+    "REPLICA_HEALTH_SCHEMA",
+    "FLEET_ROUTE_SCHEMA",
     "ELASTIC_RESTART_SCHEMA",
     "AUDIT_PROGRAM_SCHEMA",
     "TRACE_SPAN_SCHEMA",
@@ -73,8 +75,21 @@ GATEWAY_REQUEST_SCHEMA = "accelerate_tpu.telemetry.gateway.request/v1"
 #: p50/p95/p99 blocks produced by ``telemetry.slo.slo_summary``.
 GATEWAY_SLO_SCHEMA = "accelerate_tpu.telemetry.gateway.slo/v1"
 
-#: Emitted by ``ElasticSupervisor`` on every gang restart (attempt index, the
-#: exit codes that triggered the teardown, the restart budget).
+#: One record per fleet replica per router step: health score, replica state
+#: (active/draining/restarting/retired), breaker state, load (active lanes,
+#: internal queue) and the failure counters the score is computed from —
+#: the per-replica signal behind health-driven routing (``serving_gateway.fleet``).
+REPLICA_HEALTH_SCHEMA = "accelerate_tpu.telemetry.replica.health/v1"
+
+#: One record per fleet routing decision: which replica got the request and why
+#: (``dispatch``/``probe``), plus the health/free-lane snapshot it won on —
+#: and one per migration (``migrate``) when failover moves a request away.
+FLEET_ROUTE_SCHEMA = "accelerate_tpu.telemetry.fleet.route/v1"
+
+#: Emitted on every gang restart (attempt index, the exit codes that triggered
+#: the teardown, the restart budget) by ``ElasticSupervisor`` — ``gang_id``
+#: names WHICH gang, so one record stream can carry a whole fleet's restarts
+#: (``FleetSupervisor`` keeps independent per-gang budgets).
 ELASTIC_RESTART_SCHEMA = "accelerate_tpu.telemetry.elastic.restart/v1"
 
 #: One record per warmup-precompiled program: graftaudit collective inventory
@@ -173,10 +188,24 @@ SCHEMA_REGISTRY: Dict[str, RecordSchema] = {
             "aggregate SLO percentiles + admission accounting",
         ),
         _reg(
+            REPLICA_HEALTH_SCHEMA,
+            ("replica", "state", "health", "breaker_state", "active_slots",
+             "queued", "step_failures"),
+            "FleetRouter.step",
+            "per-replica health score, state and load per router step",
+        ),
+        _reg(
+            FLEET_ROUTE_SCHEMA,
+            ("uid", "replica", "reason", "health", "free_lanes"),
+            "FleetRouter",
+            "one routing decision: request -> replica (dispatch/probe/migrate)",
+        ),
+        _reg(
             ELASTIC_RESTART_SCHEMA,
-            ("attempt", "attempts_used", "max_restarts", "exit_codes"),
-            "ElasticSupervisor",
-            "one record per gang restart",
+            ("gang_id", "attempt", "attempts_used", "max_restarts",
+             "exit_codes"),
+            "ElasticSupervisor / FleetSupervisor",
+            "one record per gang restart (gang_id names which gang)",
         ),
         _reg(
             AUDIT_PROGRAM_SCHEMA,

@@ -568,6 +568,15 @@ class GatewayConfig(KwargsHandler):
     # restores the full configuration. Repeated pressure sheds optional
     # throughput machinery before it sheds requests.
     degrade: bool = False
+    # Fleet routing (``serving_gateway.fleet.FleetRouter`` — ignored by the
+    # single-engine gateway): ``drain_deadline_s`` bounds how long drain()
+    # waits for in-flight requests before migrating them (None = wait
+    # forever); ``replica_restarts`` / ``replica_restart_backoff`` are the
+    # per-replica (per-gang) restart budget and base backoff handed to the
+    # default ``elastic.FleetSupervisor``.
+    drain_deadline_s: Optional[float] = 30.0
+    replica_restarts: int = 2
+    replica_restart_backoff: float = 0.0
 
     def __post_init__(self):
         raw = os.environ.get("ACCELERATE_GATEWAY")
@@ -627,6 +636,20 @@ class GatewayConfig(KwargsHandler):
         if self.breaker_cooldown_s <= 0:
             raise ValueError(
                 f"breaker_cooldown_s={self.breaker_cooldown_s} must be > 0"
+            )
+        if self.drain_deadline_s is not None and self.drain_deadline_s <= 0:
+            raise ValueError(
+                f"drain_deadline_s={self.drain_deadline_s} must be > 0 "
+                "(None = wait for in-flight requests forever)"
+            )
+        if self.replica_restarts < 0:
+            raise ValueError(
+                f"replica_restarts={self.replica_restarts} must be >= 0"
+            )
+        if self.replica_restart_backoff < 0:
+            raise ValueError(
+                f"replica_restart_backoff={self.replica_restart_backoff} "
+                "must be >= 0"
             )
         if self.tenant_weights is not None:
             for tenant, weight in self.tenant_weights.items():

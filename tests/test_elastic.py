@@ -219,6 +219,11 @@ def test_restart_emits_telemetry_record(tmp_path):
     assert records[0]["attempt"] == 0
     assert 17 in records[0]["exit_codes"]
     assert records[0]["max_restarts"] == 2
+    # ISSUE 10 satellite: the record names WHICH gang (registry-required key).
+    from accelerate_tpu.telemetry.schemas import validate_record
+
+    assert records[0]["gang_id"] == "gang0"
+    assert validate_record(records[0]) == []
 
 
 def test_terminal_attempt_emits_final_record(tmp_path):
@@ -343,3 +348,82 @@ def test_no_restart_no_telemetry_record(tmp_path):
                             telemetry=tel)
     assert sup.run() == 0
     assert not [r for r in tel.records if r.get("schema") == ELASTIC_RESTART_SCHEMA]
+
+
+# ---------------------------------------------------------------- fleet supervisor
+def test_fleet_supervisor_independent_per_gang_budgets():
+    """ISSUE 10 satellite: each gang owns its restart budget and backoff
+    schedule — one flapping replica cannot consume its neighbors' budget, and
+    every failure (including the budget-exhausting one) emits an
+    elastic.restart/v1 record carrying the gang_id."""
+    from accelerate_tpu.elastic import FleetSupervisor
+    from accelerate_tpu.telemetry import ELASTIC_RESTART_SCHEMA, Telemetry
+    from accelerate_tpu.telemetry.schemas import validate_record
+    from accelerate_tpu.utils.dataclasses import TelemetryConfig
+
+    class Clock:
+        t = 100.0
+
+        def __call__(self):
+            return self.t
+
+    clock = Clock()
+    tel = Telemetry(TelemetryConfig(enabled=True, compile_events=False,
+                                    memory_stats=False))
+    sup = FleetSupervisor(max_restarts=1, restart_backoff=2.0,
+                          telemetry=tel, clock=clock)
+    assert sup.may_restart("replica0") and sup.may_restart("replica1")
+
+    # First failure of replica0: restart in budget, gated by the backoff.
+    assert sup.record_failure("replica0", reason="crash") is True
+    assert not sup.may_restart("replica0")         # backoff (2s) not elapsed
+    assert sup.restart_at("replica0") == 102.0     # base * 2^0
+    clock.t = 102.5
+    assert sup.may_restart("replica0")
+    # replica1 is untouched by replica0's history.
+    assert sup.attempts_used("replica1") == 0 and sup.may_restart("replica1")
+
+    # Second failure exhausts replica0's budget; replica1 keeps its own.
+    assert sup.record_failure("replica0", reason="crash") is False
+    assert not sup.budget_left("replica0")
+    assert not sup.may_restart("replica0")
+    assert sup.budget_left("replica1")
+    assert sup.stats()["exhausted"] == ["replica0"]
+
+    records = [r for r in tel.records
+               if r.get("schema") == ELASTIC_RESTART_SCHEMA]
+    assert [r["gang_id"] for r in records] == ["replica0", "replica0"]
+    assert [r["attempt"] for r in records] == [0, 1]
+    assert [r["final"] for r in records] == [False, True]
+    assert all(validate_record(r) == [] for r in records)
+
+
+def test_fleet_supervisor_validation():
+    from accelerate_tpu.elastic import FleetSupervisor
+
+    with pytest.raises(ValueError, match="max_restarts"):
+        FleetSupervisor(max_restarts=-1)
+    with pytest.raises(ValueError, match="restart_backoff"):
+        FleetSupervisor(restart_backoff=-0.1)
+    with pytest.raises(ValueError, match="backoff_jitter"):
+        FleetSupervisor(backoff_jitter=1.5)
+
+
+def test_supervisor_gang_id_param(tmp_path):
+    """A non-default gang_id threads into the restart record."""
+    from accelerate_tpu.telemetry import ELASTIC_RESTART_SCHEMA, Telemetry
+    from accelerate_tpu.utils.dataclasses import TelemetryConfig
+
+    flag = str(tmp_path / "crashed_once")
+    tel = Telemetry(TelemetryConfig(enabled=True, compile_events=False,
+                                    memory_stats=False))
+
+    def make_plan(coordinator):
+        return [(_worker_cmd(CRASH_ONCE, flag, "0"), None)]
+
+    sup = ElasticSupervisor(make_plan, max_restarts=1, monitor_interval=0.05,
+                            telemetry=tel, gang_id="train-gang-3")
+    assert sup.run() == 0
+    (record,) = [r for r in tel.records
+                 if r.get("schema") == ELASTIC_RESTART_SCHEMA]
+    assert record["gang_id"] == "train-gang-3"

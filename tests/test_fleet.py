@@ -1,0 +1,491 @@
+"""Fleet router (serving_gateway/fleet.py): health-driven routing, per-replica
+circuit breakers, lossless failover, drain/rolling restart, fleet chaos bench.
+
+ISSUE 10 acceptance pins: killing one replica mid-trace never rejects requests
+a healthy replica could serve (the per-replica-isolation regression test below
+reverts to a GLOBAL breaker and shows the failure mode), migrated streams are
+byte-identical to an undisturbed run at zero preemption-retry-budget spend,
+and the new replica.health/v1 / fleet.route/v1 records validate against the
+schema registry.
+"""
+
+import dataclasses
+import json
+import subprocess
+import sys
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from accelerate_tpu.models import llama
+from accelerate_tpu.resilience.faults import EngineCrashed, FaultPlan, FaultSpec
+from accelerate_tpu.serving import ContinuousBatcher
+from accelerate_tpu.serving_gateway import (
+    ACTIVE,
+    RETIRED,
+    FleetRouter,
+    ServingGateway,
+)
+from accelerate_tpu.utils.dataclasses import GatewayConfig
+
+CFG = dataclasses.replace(llama.CONFIGS["tiny"], dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = llama.init_params(CFG)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, CFG.vocab_size, int(n)).astype(np.int32)
+               for n in (5, 9, 3, 7, 6, 4)]
+    return params, prompts
+
+
+class ManualClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_engine(params, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prompt_bucket", 16)
+    return ContinuousBatcher(params, CFG, **kw)
+
+
+def make_fleet(params, n=2, clock=None, telemetry=None, factory=True,
+               plans=None, **cfg_kwargs):
+    cfg_kwargs.setdefault("enabled", True)
+    cfg_kwargs.setdefault("breaker_threshold", 2)
+    cfg_kwargs.setdefault("breaker_window_s", 100.0)
+    cfg_kwargs.setdefault("breaker_cooldown_s", 5.0)
+    engines = [
+        make_engine(params, faults=None if plans is None else plans[i])
+        for i in range(n)
+    ]
+    kw = {} if clock is None else {"clock": clock}
+    return FleetRouter(
+        engines, GatewayConfig(**cfg_kwargs), telemetry=telemetry,
+        engine_factory=(lambda rid: make_engine(params)) if factory else None,
+        **kw,
+    )
+
+
+def submit_with_streams(gw, prompts, max_new=8, **kw):
+    """Submit every prompt with a capture stream + on_retry reset; returns
+    (requests, streams)."""
+    streams = {}
+    greqs = []
+    for i, p in enumerate(prompts):
+        streams[i] = []
+
+        def on_token(tok, i=i):
+            streams[i].append(int(tok))
+
+        def on_retry(i=i):
+            streams[i].clear()
+
+        greqs.append(gw.submit(p, max_new_tokens=max_new, on_token=on_token,
+                               on_retry=on_retry, **kw))
+    return greqs, streams
+
+
+# ------------------------------------------------------------------- basic routing
+def test_fleet_matches_single_engine_outputs(setup):
+    """An undisturbed fleet is output-transparent: every request's tokens equal
+    the single-engine gateway's for the same prompt/budget."""
+    params, prompts = setup
+    single = ServingGateway(make_engine(params), GatewayConfig(enabled=True))
+    sreqs = [single.submit(p, max_new_tokens=8) for p in prompts]
+    single.run()
+    fleet = make_fleet(params, n=3)
+    freqs = [fleet.submit(p, max_new_tokens=8) for p in prompts]
+    fleet.run()
+    assert [g.status for g in freqs] == ["done"] * len(prompts)
+    for s, f in zip(sreqs, freqs):
+        assert s.tokens == f.tokens
+    # with 6 requests into 3x2 lanes, routing actually spread the work
+    used = {g._engine_req for g in freqs}
+    assert fleet.counters["done"] == len(prompts)
+
+
+def test_fleet_routes_to_least_loaded_and_emits_records(setup):
+    from accelerate_tpu.telemetry import (
+        FLEET_ROUTE_SCHEMA,
+        REPLICA_HEALTH_SCHEMA,
+        Telemetry,
+    )
+    from accelerate_tpu.telemetry.schemas import validate_record
+    from accelerate_tpu.utils.dataclasses import TelemetryConfig
+
+    params, prompts = setup
+    tel = Telemetry(TelemetryConfig(enabled=True, compile_events=False,
+                                    memory_stats=False))
+    fleet = make_fleet(params, n=2, telemetry=tel)
+    greqs = [fleet.submit(p, max_new_tokens=6) for p in prompts]
+    fleet.run()
+    routes = [r for r in tel.records if r.get("schema") == FLEET_ROUTE_SCHEMA]
+    health = [r for r in tel.records if r.get("schema") == REPLICA_HEALTH_SCHEMA]
+    assert len(routes) == fleet.counters["admitted"]
+    assert all(validate_record(r) == [] for r in routes + health)
+    # every replica served something (least-loaded dispatch spreads 6 requests
+    # over 2x2 lanes) and health spans both replicas each step
+    assert {r["replica"] for r in routes} == {0, 1}
+    assert {r["replica"] for r in health} == {0, 1}
+    assert all(0.0 <= r["health"] <= 1.0 for r in health)
+
+
+def test_fleet_validates_geometry_and_degrade(setup):
+    params, _ = setup
+    with pytest.raises(ValueError, match="geometry"):
+        FleetRouter([make_engine(params), make_engine(params, max_len=128)],
+                    GatewayConfig(enabled=True))
+    with pytest.raises(ValueError, match="degrade"):
+        FleetRouter([make_engine(params)],
+                    GatewayConfig(enabled=True, degrade=True))
+    with pytest.raises(ValueError, match="at least one"):
+        FleetRouter([], GatewayConfig(enabled=True))
+
+
+# ---------------------------------------------------------------------- failover
+def test_kill_migrates_inflight_lossless(setup):
+    """Killing a replica mid-decode replays its in-flight requests on the
+    survivor: on_retry resets streams, transcripts are byte-identical to an
+    undisturbed fleet, and no preemption retry budget is spent."""
+    params, prompts = setup
+
+    def run(kill_at=None):
+        fleet = make_fleet(params, n=2)
+        greqs, streams = submit_with_streams(fleet, prompts)
+        steps = 0
+        while fleet.queue_depth or fleet.running_count:
+            fleet.step()
+            steps += 1
+            if kill_at is not None and steps == kill_at:
+                fleet.kill(0)
+        return fleet, greqs, streams
+
+    _, clean_reqs, clean_streams = run()
+    fleet, reqs, streams = run(kill_at=2)
+    assert fleet.counters["replica_kills"] == 1
+    assert fleet.counters["migrated"] >= 1
+    assert fleet.counters["rejected"] == 0
+    for i in range(len(prompts)):
+        assert reqs[i].status == "done"
+        assert streams[i] == clean_streams[i], i
+        assert reqs[i].tokens == clean_reqs[i].tokens
+        assert reqs[i].retries_used == 0  # replay spends no preemption budget
+    # the killed replica came back through the supervisor + probe warm-up
+    assert fleet.replicas[0].restarts == 1
+
+
+def test_injected_crash_fault_fails_over(setup):
+    """A seeded ``crash`` clause raises EngineCrashed past the engine's own
+    recovery boundary; the router converts it into migration + restart instead
+    of an exception reaching the caller."""
+    params, prompts = setup
+    plan = FaultPlan([FaultSpec("serving.decode", "crash", prob=1.0,
+                                start=2, max_fires=1)], seed=3)
+    fleet = make_fleet(params, n=2, plans=[plan, None])
+    greqs = [fleet.submit(p, max_new_tokens=8) for p in prompts]
+    fleet.run()
+    assert fleet.counters["replica_kills"] == 1
+    assert [g.status for g in greqs] == ["done"] * len(prompts)
+    assert plan.fired and plan.fired[0]["kind"] == "crash"
+    # the bare engine (no fleet) must surface the same crash as an exception
+    eng = make_engine(params, faults=FaultPlan(
+        [FaultSpec("serving.decode", "crash", prob=1.0)], seed=0))
+    eng.submit(prompts[0], max_new_tokens=8)
+    with pytest.raises(EngineCrashed):
+        eng.run()
+    assert eng.crashed
+
+
+def test_breaker_trip_isolates_replica_keeps_serving(setup):
+    """A wedged replica (every dispatch faults) trips ITS breaker only: its
+    in-flight requests migrate, the healthy replica serves everything, and no
+    request is rejected for a circuit reason — the acceptance criterion."""
+    params, prompts = setup
+    plan = FaultPlan([FaultSpec("serving.decode", "error", prob=1.0,
+                                attributed=False)], seed=0)
+    fleet = make_fleet(params, n=2, plans=[plan, None],
+                       breaker_cooldown_s=1e9)
+    greqs = [fleet.submit(p, max_new_tokens=6) for p in prompts]
+    fleet.run()
+    assert fleet.counters["rejected"] == 0
+    assert fleet.replicas[0].breaker.state == "open"
+    assert fleet.replicas[1].breaker.state == "closed"
+    # at most the in-engine-quarantined poison suspect fails; the rest finish
+    assert sum(g.status == "done" for g in greqs) >= len(prompts) - 1
+    assert all(g.terminal for g in greqs)
+
+
+def test_breaker_isolation_regression_global_breaker(setup):
+    """REGRESSION GUARD: revert per-replica breakers to one GLOBAL breaker
+    (all replicas sharing a single CircuitBreaker) and the wedged-replica
+    scenario rejects/expires requests the healthy replica could have served —
+    the exact failure mode per-replica isolation exists to prevent. The
+    per-replica configuration (previous test) serves them all."""
+    params, prompts = setup
+
+    def run(share_breaker):
+        clock = ManualClock()
+        plan = FaultPlan([FaultSpec("serving.decode", "error", prob=1.0,
+                                    attributed=False)], seed=0)
+        fleet = make_fleet(params, n=2, clock=clock, plans=[plan, None],
+                           breaker_cooldown_s=1e9)
+        if share_breaker:
+            shared = fleet.replicas[0].breaker
+            for rep in fleet.replicas:
+                rep.breaker = shared
+        greqs = [fleet.submit(p, max_new_tokens=6, deadline_s=40.0)
+                 for p in prompts]
+        for _ in range(80):
+            if not (fleet.queue_depth or fleet.running_count):
+                break
+            fleet.step()
+            clock.advance(1.0)
+        return fleet, greqs
+
+    fleet, greqs = run(share_breaker=False)
+    served = sum(g.status == "done" for g in greqs)
+    assert served >= len(prompts) - 1
+    assert fleet.counters["expired"] == 0 and fleet.counters["rejected"] == 0
+
+    fleet_g, greqs_g = run(share_breaker=True)
+    served_g = sum(g.status == "done" for g in greqs_g)
+    # the global breaker takes the healthy replica down with the wedged one:
+    # queued work a healthy replica could serve strands until deadlines kill it
+    assert served_g < served
+    assert fleet_g.counters["expired"] > 0
+
+
+# --------------------------------------------------------------- drain / restart
+def test_drain_finishes_inflight_then_probes(setup):
+    """drain(): no new admissions to the draining replica, in-flight requests
+    finish, the replica restarts and earns routing back through a half-open
+    probe (the first post-restart admission)."""
+    params, prompts = setup
+    fleet = make_fleet(params, n=2)
+    greqs = [fleet.submit(p, max_new_tokens=8) for p in prompts[:4]]
+    fleet.step()  # fill both replicas' lanes
+    running_on_0 = len(fleet.replicas[0].running)
+    assert running_on_0 > 0
+    fleet.drain(0, deadline_s=1000.0)
+    fleet.run()
+    assert all(g.status == "done" for g in greqs)
+    rep0 = fleet.replicas[0]
+    assert rep0.restarts == 1 and rep0.state == ACTIVE
+    assert rep0.breaker.state == "half_open"  # awaiting its probe
+    assert fleet.counters["migrated"] == 0    # deadline never forced migration
+    # the next admission IS the probe (probe-first routing), and its success
+    # closes the breaker — full routing restored
+    probe = fleet.submit(prompts[4], max_new_tokens=4)
+    fleet.run()
+    assert probe.status == "done"
+    assert rep0.breaker.state == "closed"
+    assert fleet.counters["replica_restarts"] == 1
+
+
+def test_drain_deadline_migrates_remainder(setup):
+    """A drain whose deadline passes migrates the stragglers (replay path) so
+    the restart is never blocked on a long-running request."""
+    params, prompts = setup
+    clock = ManualClock()
+    fleet = make_fleet(params, n=2, clock=clock)
+    greqs, streams = submit_with_streams(fleet, prompts, max_new=12)
+    fleet.step()
+    fleet.drain(0, deadline_s=2.0)
+    clock.advance(5.0)  # past the drain deadline before anything finishes
+    fleet.run()
+    assert fleet.counters["migrated"] >= 1
+    assert all(g.status == "done" for g in greqs)
+    assert fleet.replicas[0].restarts == 1
+    # migrated transcripts are complete (replayed from token 0 post-reset)
+    for i, g in enumerate(greqs):
+        assert streams[i] == g.tokens
+
+
+def test_rolling_restart_cycles_every_replica(setup):
+    """rolling_restart walks the fleet one replica at a time under live
+    traffic; every replica restarts exactly once and every request completes."""
+    params, prompts = setup
+    fleet = make_fleet(params, n=2)
+    fleet.rolling_restart(deadline_s=1000.0)
+    greqs = []
+    pending = [p for p in prompts for _ in range(2)]  # sustained traffic
+    for _ in range(200):
+        if pending:
+            greqs.append(fleet.submit(pending.pop(0), max_new_tokens=4))
+        fleet.step()
+        if not pending and not fleet.queue_depth and not fleet.running_count \
+                and all(r.restarts == 1 and r.breaker.state == "closed"
+                        for r in fleet.replicas):
+            break
+    assert all(r.restarts == 1 for r in fleet.replicas)
+    assert all(r.state == ACTIVE for r in fleet.replicas)
+    assert all(g.status == "done" for g in greqs)
+
+
+def test_all_replicas_retired_fails_backlog_machine_readably(setup):
+    """With no engine factory a dead replica retires; when the LAST replica
+    retires the queued backlog is finalized FAILED reason=fleet_down (never
+    silently stranded) AND those terminals are RETURNED by step()/run() like
+    every other terminal — a caller collecting run()'s output sees them."""
+    params, prompts = setup
+    fleet = make_fleet(params, n=2, factory=False, replica_restarts=0)
+    greqs = [fleet.submit(p, max_new_tokens=8) for p in prompts]
+    fleet.step()
+    fleet.kill(0)
+    fleet.kill(1)   # out-of-band: backlog flushes between steps
+    returned = fleet.run()
+    assert all(r.state == RETIRED for r in fleet.replicas)
+    assert all(g.terminal for g in greqs)
+    down = [g for g in greqs if g.status == "failed" and g.reason == "fleet_down"]
+    assert down
+    # the every-terminal-is-returned contract covers the backlog flush
+    assert {g.uid for g in down} <= {g.uid for g in returned}
+    late = fleet.submit(prompts[0], max_new_tokens=4)
+    assert late.status == "rejected" and late.reason == "fleet_down"
+
+
+def test_preempt_never_dispatches_into_probe_replica(setup):
+    """REGRESSION (review finding): with a half-open replica holding its
+    outstanding probe and zero routable free lanes, preemption must pick its
+    victim from a closed-breaker replica — dispatching the preemptor into the
+    probe-holding replica crashed step() (and corrupted the probe
+    bookkeeping)."""
+    params, prompts = setup
+    fleet = make_fleet(params, n=2, preempt=True, max_retries=1)
+    rep0 = fleet.replicas[0]
+    rep0.breaker.force_half_open()
+    # First admission probe-routes to rep0 (one lane, probe outstanding);
+    # the rest fill rep1's two lanes; the fourth queues (no routable lane).
+    low = [fleet.submit(p, max_new_tokens=16, priority=0) for p in prompts[:4]]
+    fleet.step()
+    assert rep0.breaker.probe_uid is not None
+    probe_uid = rep0.breaker.probe_uid
+    assert len(rep0.running) == 1 and len(fleet.replicas[1].running) == 2
+    high = fleet.submit(prompts[4], max_new_tokens=2, priority=5)
+    fleet.step()  # crashes with AssertionError before the fix
+    assert high.status in ("running", "done")
+    assert high._rid != 0 if high.status == "running" else True
+    assert rep0.breaker.probe_uid == probe_uid  # probe undisturbed
+    fleet.run()
+    assert high.status == "done"
+    assert all(g.terminal for g in low)
+
+
+def test_rolling_restart_survives_midcycle_retirement(setup):
+    """REGRESSION (review finding): a replica retiring mid-rolling-restart
+    must neither stall the cycle forever nor take a drain turn — the
+    remaining replicas still restart."""
+    params, prompts = setup
+    fleet = make_fleet(params, n=3, replica_restarts=0, factory=True)
+    # replica_restarts=0: the first death exhausts the budget → RETIRED even
+    # with a factory available.
+    fleet.rolling_restart(deadline_s=1000.0)
+    fleet.kill(2)  # retires mid-cycle while replica 0 drains
+    greqs = []
+    backlog = [p for p in prompts for _ in range(2)]
+    for _ in range(200):
+        if backlog:
+            greqs.append(fleet.submit(backlog.pop(0), max_new_tokens=4))
+        fleet.step()
+        if (not backlog and not fleet.queue_depth and not fleet.running_count
+                and not fleet._rolling
+                and all(r.state != "draining" for r in fleet.replicas)):
+            break
+    assert fleet.replicas[2].state == RETIRED
+    assert fleet.replicas[0].restarts == 1
+    assert fleet.replicas[1].restarts == 1  # the cycle reached it despite 2
+    assert not fleet._rolling
+    assert all(g.terminal for g in greqs)
+
+
+def test_fleet_preempt_across_replicas(setup):
+    """Opt-in preemption spans replicas: the globally least-urgent running
+    request yields its lane to a strictly higher-priority queued one."""
+    params, prompts = setup
+    fleet = make_fleet(params, n=2, preempt=True, max_retries=1)
+    low = [fleet.submit(p, max_new_tokens=16, priority=0) for p in prompts[:4]]
+    fleet.step()  # all four lanes busy
+    high = fleet.submit(prompts[4], max_new_tokens=2, priority=5)
+    fleet.run()
+    assert high.status == "done"
+    assert fleet.counters["retried"] >= 1
+    assert all(g.status == "done" for g in low)  # retried victim completes
+
+
+# ------------------------------------------------------------- accelerator builder
+def test_accelerator_builds_fleet_router(setup):
+    from accelerate_tpu import Accelerator
+
+    params, prompts = setup
+    acc = Accelerator(cpu=True, gateway_config=GatewayConfig(enabled=True))
+    engines = [make_engine(params), make_engine(params)]
+    fleet = acc.build_serving_gateway(engines)
+    assert isinstance(fleet, FleetRouter)
+    greq = fleet.submit(prompts[0], max_new_tokens=4)
+    fleet.run()
+    assert greq.status == "done"
+
+    from accelerate_tpu.state import AcceleratorState, GradientState, PartialState
+
+    AcceleratorState._reset_state()
+    GradientState._reset_state()
+    PartialState._reset_state()
+    acc_off = Accelerator(cpu=True)  # gateway off by default
+    with pytest.raises(ValueError, match="fleet"):
+        acc_off.build_serving_gateway([make_engine(params)])
+
+
+# ------------------------------------------------------------------- chaos bench
+def test_fleet_chaos_bench_artifact(setup):
+    """The acceptance geometry: seeded replica kills over a replayed trace —
+    zero silently-lost, migrated streams byte-identical to the undisturbed
+    fleet, fleet availability strictly above the single-engine arm at the same
+    kill rate, zero circuit-reason rejections."""
+    from accelerate_tpu.commands.serve_bench import run_fleet_chaos_bench
+
+    artifact = run_fleet_chaos_bench(
+        n_replicas=3, requests=16, max_slots=2, max_len=64, prompt_bucket=16,
+        seed=0, kill_rate=0.05, kills_per_replica=2,
+    )
+    assert artifact["schema"] == "accelerate_tpu.bench.fleet/v1"
+    assert artifact["fleet_chaos"]["silently_lost"] == 0
+    assert artifact["fleet_chaos"]["terminal"] == artifact["fleet_chaos"]["submitted"]
+    assert artifact["streams_identical"] is True
+    assert artifact["streams_compared"] > 0
+    assert artifact["fleet_chaos"]["replica_kills"] >= 1
+    assert artifact["kill_plan"]["single_fired"] >= 1  # same rate actually fired
+    assert artifact["fleet_availability_above_single"] is True
+    assert artifact["fleet_chaos"]["circuit_rejections"] == 0
+    assert artifact["fleet_chaos"]["availability"] > artifact["single_chaos"]["availability"]
+    assert artifact["provenance"] and artifact["workload_trace_hash"]
+
+
+def test_fleet_chaos_cli_smoke(tmp_path):
+    """serve-bench --fleet 3 --chaos --smoke is a tier-1 gate alongside the
+    single-engine chaos smoke (ISSUE 10 satellite)."""
+    out = tmp_path / "BENCH_FLEET.json"
+    result = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu", "serve-bench",
+         "--fleet", "3", "--chaos", str(out), "--smoke", "--seed", "0"],
+        capture_output=True, text=True, timeout=600,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    artifact = json.loads(out.read_text())
+    assert artifact["fleet_chaos"]["silently_lost"] == 0
+    assert artifact["streams_identical"] is True
+    assert artifact["fleet_availability_above_single"] is True
+    summary = json.loads(result.stdout.strip().splitlines()[-1])
+    assert summary["schema"] == "accelerate_tpu.bench.fleet/v1"
+    assert summary["circuit_rejections"] == 0

@@ -327,3 +327,100 @@ def test_gpt_forward_slots_paged_bitwise_dense():
             params, tok, paged, jnp.asarray(tables), jnp.asarray(pos), cfg, ps)
         assert np.array_equal(np.asarray(ld), np.asarray(lp))
         pos += 1
+
+
+# ---------------------------------------------------------------------- soak
+def test_block_manager_soak_randomized_lifecycle():
+    """ISSUE 10 satellite: randomized property test driving thousands of
+    admit / prefix-register (retain + COW copy) / adopt / release / registry-
+    evict / recovery-rebuild ops against one BlockManager, asserting after
+    EVERY op: refcount conservation (each page's refcount equals exactly the
+    references the mirrored lanes + registry hold), zero leaked pages (every
+    page is free xor referenced; the free list and refcounts agree), and
+    free-list integrity (no duplicates, all ids in range, nothing referenced).
+    After every recovery rebuild — the registry drained FIRST, then the lanes,
+    the ordering whose inversion caused the PR-9 negative-refcount regression
+    — the pool must be exactly fully free."""
+    rng = np.random.default_rng(7)
+    mgr = BlockManager(num_pages=24, page_size=4, max_slots=4, max_len=48)
+    lanes = {}      # slot → mirrored page-id list (what the lane references)
+    registry = []   # mirrored page-id lists (what prefix entries reference)
+    rebuilds = 0
+
+    def check_invariants():
+        free = mgr._free
+        assert len(set(free)) == len(free), "free list holds duplicates"
+        assert all(0 <= p < mgr.num_pages for p in free)
+        assert all(mgr.refcount[p] == 0 for p in free), "referenced page in free list"
+        expect = np.zeros(mgr.num_pages, np.int64)
+        for ids in lanes.values():
+            for p in ids:
+                expect[p] += 1
+        for ids in registry:
+            for p in ids:
+                expect[p] += 1
+        assert (mgr.refcount == expect).all(), (
+            f"refcount drift: manager {mgr.refcount.tolist()} vs "
+            f"mirror {expect.tolist()}"
+        )
+        assert len(free) + int((expect > 0).sum()) == mgr.num_pages, "leaked pages"
+
+    for step in range(4000):
+        op = rng.choice(
+            ["admit", "release", "register", "evict", "rebuild"],
+            p=[0.3, 0.25, 0.2, 0.2, 0.05],
+        )
+        if op == "admit":
+            free_slots = [s for s in range(mgr.max_slots) if s not in lanes]
+            if free_slots:
+                slot = int(rng.choice(free_slots))
+                n_tokens = int(rng.integers(1, mgr.max_len + 1))
+                adopted = []
+                cow = False
+                if registry and rng.random() < 0.5:
+                    entry = registry[int(rng.integers(len(registry)))]
+                    max_adopt = min(len(entry),
+                                    mgr.pages_for(n_tokens))
+                    if max_adopt:
+                        adopted = list(entry[: int(rng.integers(1, max_adopt + 1))])
+                        cow = bool(rng.random() < 0.3)
+                try:
+                    if mgr.can_admit(n_tokens, n_adopted=len(adopted)):
+                        ids = mgr.admit(slot, n_tokens, adopted=adopted,
+                                        cow_partial=cow)
+                        lanes[slot] = [int(p) for p in ids]
+                except KVBudgetError:
+                    pass
+        elif op == "release" and lanes:
+            slot = int(rng.choice(list(lanes)))
+            mgr.release_slot(slot)
+            del lanes[slot]
+        elif op == "register" and lanes:
+            slot = int(rng.choice(list(lanes)))
+            lane = lanes[slot]
+            k = int(rng.integers(1, len(lane) + 1))
+            pages = lane[:k]
+            mgr.retain(pages)
+            entry = list(pages)
+            if rng.random() < 0.4:
+                dst = mgr.take_copy_page()  # partial-boundary COW copy
+                if dst is not None:
+                    entry.append(int(dst))
+            registry.append(entry)
+        elif op == "evict" and registry:
+            entry = registry.pop(int(rng.integers(len(registry))))
+            mgr.release(entry)
+        elif op == "rebuild":
+            # The engine's recovery ordering: drain the registry against the
+            # OLD pool FIRST, then the lanes — then nothing may remain in use.
+            rebuilds += 1
+            while registry:
+                mgr.release(registry.pop())
+            for slot in list(lanes):
+                mgr.release_slot(slot)
+                del lanes[slot]
+            assert mgr.pages_in_use == 0, "recovery leaked pages"
+            assert len(mgr._free) == mgr.num_pages
+            assert (mgr.refcount == 0).all()
+        check_invariants()
+    assert rebuilds >= 50  # the 0.05 arm actually exercised recovery

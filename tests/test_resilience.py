@@ -446,8 +446,9 @@ def test_gateway_failed_terminal_status_and_record(setup):
 
 def test_circuit_breaker_transitions_manual_clock(setup):
     """closed → open (K failures in window, submits reject with circuit_open)
-    → half-open after cooldown (one probe admitted, others rejected) → closed
-    on probe success."""
+    → half-open after cooldown (one probe admitted, others rejected with the
+    DISTINCT reason circuit_probe — ISSUE 10 satellite) → closed on probe
+    success."""
     params, prompts = setup
     clock = ManualClock()
     plan = FaultPlan([FaultSpec("serving.decode", "error", prob=1.0,
@@ -472,7 +473,7 @@ def test_circuit_breaker_transitions_manual_clock(setup):
     probe = gw.submit(prompts[4], max_new_tokens=4)
     assert probe.status == "queued" and gw._breaker_state == "half_open"
     blocked = gw.submit(prompts[5], max_new_tokens=4)
-    assert blocked.reason == "circuit_open"
+    assert blocked.reason == "circuit_probe"  # probe contention, not hard-open
     while gw.queue_depth or gw.running_count:
         gw.step()
         clock.advance(1.0)

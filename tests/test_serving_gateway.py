@@ -532,10 +532,12 @@ def test_gateway_telemetry_records(setup):
 
 
 def test_request_record_emitted_for_every_terminal_state(setup):
-    """ISSUE 8 satellite: EVERY terminal path emits exactly one
-    ``gateway.request/v1`` record — done, rejected (all three reasons incl.
-    kv_budget), shed, deadline-expired (queued AND running), cancelled (queued
-    AND in-flight), and preempt-retry-exhausted — and the cumulative counters
+    """ISSUE 8 satellite (extended by ISSUE 10): EVERY terminal path emits
+    exactly one ``gateway.request/v1`` record — done, rejected (queue_full/
+    token_budget/kv_budget AND both breaker reasons: circuit_open while the
+    breaker cools down, circuit_probe while another request is the half-open
+    probe), shed, deadline-expired (queued AND running), cancelled (queued AND
+    in-flight), and preempt-retry-exhausted — and the cumulative counters
     agree with the per-status record totals."""
     from accelerate_tpu.telemetry import GATEWAY_REQUEST_SCHEMA, Telemetry
     from accelerate_tpu.utils.dataclasses import TelemetryConfig
@@ -623,6 +625,24 @@ def test_request_record_emitted_for_every_terminal_state(setup):
     assert by_uid[run_r.uid]["reason"] == "cancelled_running"
     assert by_uid[run_r.uid]["n_tokens"] == len(run_r.tokens) >= 1
     assert gw.counters["cancelled"] == 2 == len(records(tel))
+
+    # --- rejected: circuit_open AND circuit_probe (distinct reasons) -----
+    clock = ManualClock()
+    gw, tel = fresh(clock=clock, policy="fifo", breaker_threshold=1,
+                    breaker_window_s=100.0, breaker_cooldown_s=5.0)
+    gw._breaker_open(clock())
+    opened = gw.submit(prompts[0], max_new_tokens=2)
+    assert opened.status == "rejected" and opened.reason == "circuit_open"
+    clock.advance(10.0)  # past the cooldown: half-open
+    probe = gw.submit(prompts[1], max_new_tokens=2)   # THE probe — queued
+    blocked = gw.submit(prompts[2], max_new_tokens=2)
+    assert blocked.status == "rejected" and blocked.reason == "circuit_probe"
+    by_uid = {r["uid"]: r for r in records(tel)}
+    assert by_uid[opened.uid]["reason"] == "circuit_open"
+    assert by_uid[blocked.uid]["reason"] == "circuit_probe"
+    gw.run()
+    assert probe.status == "done" and gw._breaker_state == "closed"
+    assert len(records(tel)) == gw.counters["done"] + gw.counters["rejected"] == 3
 
     # --- evicted: preempt with retry budget exhausted --------------------
     gw, tel = fresh(policy="priority", preempt=True, max_retries=0)
