@@ -152,6 +152,15 @@ class _TrainStep:
             if spec is not None:
                 if spec.kind == "nonfinite":
                     batch = _poison_float_leaves(batch)
+                elif spec.kind == "crash":
+                    # Whole-gang death: raises PAST the step boundary the way
+                    # EngineCrashed does for serving — nothing in-process may
+                    # catch it; the gang-of-gangs supervisor converts it into a
+                    # budgeted gang restart + checkpoint replay.
+                    from .resilience.faults import StageCrashed
+
+                    raise StageCrashed("train.step",
+                                       gang_id=plan.scope or "gang0")
                 else:
                     raise plan.fault_for(spec, "train.step")
         # Telemetry bracket: when off this is two attribute reads — no syncs, no

@@ -24,7 +24,8 @@ from typing import Optional
 
 from .capture import ProgramCapture
 
-__all__ = ["collective_inventory", "jaxpr_collectives", "hlo_collectives"]
+__all__ = ["collective_inventory", "jaxpr_collectives", "hlo_collectives",
+           "stage_transfer_bytes"]
 
 #: jaxpr primitive name -> canonical collective kind.
 _PRIM_KINDS = {
@@ -132,6 +133,50 @@ def hlo_collectives(text: Optional[str]) -> dict:
     return summary
 
 
+def _aval_bytes(aval) -> int:
+    if aval is None or not hasattr(aval, "size"):
+        return 0
+    return int(aval.size) * int(getattr(aval.dtype, "itemsize", 4))
+
+
+def stage_transfer_bytes(capture: ProgramCapture):
+    """Inter-stage DCN transfer payload of one MPMD stage program, or ``None``
+    for non-MPMD programs.
+
+    MPMD stage programs (``parallel/mpmd.py``) move their payloads OUTSIDE any
+    jit — ``ops.collectives.stage_transfer`` is a host-level ``device_put``
+    across meshes — so no collective HLO ever records the bytes. The payload
+    is, however, fixed by the stage-program output contracts (the label table
+    in ``parallel/mpmd.py``):
+
+    - ``mpmd.stage<i>.fwd`` — EVERY output is the forward activation payload;
+    - ``mpmd.stage<i>.bwd`` / ``.loss_bwd`` — the TRAILING outputs are
+      ``ct_out``, the backward cotangent payload (grads and loss stay
+      stage-local). ``ct_out`` mirrors the stage-input pytree, so the leaf
+      count comes from the capture's concrete call args (``args[1]`` is ``x``
+      in both signatures) — counting only the last aval would under-report
+      any stage whose activation is a pytree;
+    - ``.apply`` / ``.zero`` — no transfer (0).
+
+    Auditing these bytes from the lowered jaxpr keeps the DCN payload under
+    the same ratchet as in-jit collective bytes: a refactor that silently
+    fattens an activation boundary shows up as a diff here."""
+    label = capture.label or ""
+    if not label.startswith("mpmd."):
+        return None
+    suffix = label.rsplit(".", 1)[-1]
+    jaxpr = capture.jaxpr
+    out_avals = list(getattr(jaxpr, "out_avals", []) or [])
+    if suffix == "fwd":
+        return sum(_aval_bytes(a) for a in out_avals)
+    if suffix in ("bwd", "loss_bwd"):
+        import jax as _jax
+
+        n_ct = len(_jax.tree_util.tree_leaves(capture.args[1]))
+        return sum(_aval_bytes(a) for a in out_avals[-n_ct:]) if n_ct else 0
+    return 0
+
+
 def collective_inventory(capture: ProgramCapture) -> dict:
     """Merged inventory for one captured program (manifest/telemetry shape).
 
@@ -156,4 +201,10 @@ def collective_inventory(capture: ProgramCapture) -> dict:
         "compiled": hlo if capture.compiled_text is not None else None,
         "total_count": sum(v["count"] for v in primary.values()),
         "total_bytes": sum(v["bytes"] for v in primary.values()),
+        # Host-level DCN payload of MPMD stage programs (None for everything
+        # else). Deliberately NOT folded into total_bytes: these bytes cross
+        # the wire outside the program, and summing host transfers into
+        # compiled-collective totals would be the same view-conflation the
+        # jaxpr/compiled split guards against.
+        "stage_transfer_bytes": stage_transfer_bytes(capture),
     }

@@ -20,7 +20,7 @@ from ...compile_cache.cache import AotCache
 from .capture import ProgramCapture
 
 __all__ = ["LowerOnlyCache", "capture_default_programs", "DEFAULT_AUDIT_GEOMETRY",
-           "PAGED_AUDIT_GEOMETRY"]
+           "PAGED_AUDIT_GEOMETRY", "MPMD_AUDIT_GEOMETRY"]
 
 #: The geometry ``audit`` lowers when none is given: the warmup CLI's default
 #: config with eval and serving enabled — including the speculative-decoding
@@ -57,6 +57,17 @@ PAGED_AUDIT_GEOMETRY = dict(
     spec_draft="ngram",
     page_size=24,
     prefix_cache=2,
+)
+
+#: Third pass: the MPMD stage-program surface (``parallel/mpmd.py`` demo
+#: pipeline — 2 stages, the chaos-train smoke shape) lowered whenever the
+#: default geometry trains, so inter-stage DCN transfer bytes ride the same
+#: ratchet as in-jit collective bytes.
+MPMD_AUDIT_GEOMETRY = dict(
+    n_stages=2,
+    width=8,
+    batch=4,
+    n_microbatches=2,
 )
 
 
@@ -104,6 +115,14 @@ def capture_default_programs(**overrides) -> List[ProgramCapture]:
     (:data:`PAGED_AUDIT_GEOMETRY`, inheriting preset/shape overrides) into the
     same capture list — the dense and paged engines are alternative replica
     layouts, and BOTH stay under the ratchet.
+
+    Whenever the geometry trains, a third pass lowers the MPMD stage-program
+    surface (``parallel/mpmd.py``, :data:`MPMD_AUDIT_GEOMETRY`): the per-stage
+    fwd/bwd/loss_bwd/apply/zero programs of the demo pipeline, so the
+    inter-stage DCN transfer payload is audited
+    (``collective_inventory(...)["stage_transfer_bytes"]``) alongside in-jit
+    collective bytes — MPMD training is the alternative TRAINING layout the
+    same way paged KV is the alternative serving layout.
     """
     from ...compile_cache.warmup import run_warmup
 
@@ -116,4 +135,8 @@ def capture_default_programs(**overrides) -> List[ProgramCapture]:
                             "max_len", "max_new_tokens")}
         run_warmup(cache=cache, emit_manifest=False,
                    **{**PAGED_AUDIT_GEOMETRY, **inherit})
+    if geometry.get("train"):
+        from ...parallel.mpmd import lower_stage_programs
+
+        lower_stage_programs(cache, **MPMD_AUDIT_GEOMETRY)
     return cache.capture

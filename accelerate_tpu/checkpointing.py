@@ -52,6 +52,11 @@ __all__ = [
     "CheckpointCorruptError",
     "MANIFEST_NAME",
     "COMMIT_MARKER",
+    "PIPELINE_META",
+    "save_pipeline_checkpoint",
+    "load_pipeline_checkpoint",
+    "select_pipeline_checkpoint",
+    "rotate_pipeline_checkpoints",
 ]
 
 # ------------------------------------------------------------ verified checkpoints
@@ -64,6 +69,14 @@ COMMIT_MARKER = "COMMITTED"
 #: Quarantine subdirectory invalid checkpoints are moved into on load fallback
 #: (outside the ``checkpoint_*`` glob, so rotation/iteration never sees them).
 QUARANTINE_DIR = "quarantined"
+
+#: Epoch-level metadata of a COORDINATED multi-stage (MPMD pipeline) snapshot:
+#: written FIRST, before any stage saves, naming how many ``stage_<i>/``
+#: subdirectories a complete snapshot must carry. Its presence switches
+#: :func:`verify_checkpoint` to pipeline semantics — the epoch is committed
+#: only when EVERY declared stage's own marker landed and verifies; a
+#: partial-commit epoch (one stage crashed mid-save) is invalid AS A UNIT.
+PIPELINE_META = "pipeline.json"
 
 
 class CheckpointCorruptError(RuntimeError):
@@ -113,8 +126,30 @@ def _write_commit_marker(path: Path) -> None:
 def verify_checkpoint(path) -> list:
     """Integrity problems of one checkpoint directory (empty = valid):
     missing commit marker (crash mid-save), missing manifest, files that
-    disappeared, grew extra, or whose sha256 no longer matches."""
+    disappeared, grew extra, or whose sha256 no longer matches.
+
+    A directory carrying :data:`PIPELINE_META` is a COORDINATED multi-stage
+    snapshot: every declared ``stage_<i>/`` subdirectory is verified with its
+    own manifest+marker, problems prefixed with the stage. One stage missing
+    its marker (a stage process killed mid-save) makes the WHOLE epoch
+    invalid — a pipeline restore mixing epochs across stages would silently
+    train a Frankenstein state."""
     path = Path(path)
+    meta_file = path / PIPELINE_META
+    if meta_file.exists():
+        try:
+            meta = json.loads(meta_file.read_text())
+            n_stages = int(meta["n_stages"])
+        except (json.JSONDecodeError, OSError, KeyError, TypeError, ValueError) as e:
+            return [f"unreadable {PIPELINE_META}: {e}"]
+        problems = []
+        for i in range(n_stages):
+            sdir = path / f"stage_{i}"
+            if not sdir.is_dir():
+                problems.append(f"stage_{i}: missing (partial pipeline save)")
+                continue
+            problems.extend(f"stage_{i}: {p}" for p in verify_checkpoint(sdir))
+        return problems
     problems = []
     if not (path / COMMIT_MARKER).exists():
         return ["uncommitted (no COMMITTED marker — crash mid-save?)"]
@@ -153,6 +188,25 @@ def _list_checkpoints(base: Path) -> list:
     )
 
 
+def _checkpoint_committed(path: Path) -> bool:
+    """Cheap committed-bit check (marker presence, no hashing) that rotation
+    shares with the pipeline helpers. A :data:`PIPELINE_META` epoch is
+    committed only when EVERY declared stage's marker landed — a
+    partial-commit epoch must neither count toward ``total_limit`` nor shield
+    older complete snapshots from rotation."""
+    meta_file = path / PIPELINE_META
+    if meta_file.exists():
+        try:
+            n_stages = int(json.loads(meta_file.read_text())["n_stages"])
+        except (json.JSONDecodeError, OSError, KeyError, TypeError, ValueError):
+            return False
+        return all(
+            (path / f"stage_{i}" / COMMIT_MARKER).exists()
+            for i in range(n_stages)
+        )
+    return (path / COMMIT_MARKER).exists()
+
+
 def _checkpoint_dir(accelerator, output_dir: Optional[str], for_save: bool) -> Path:
     project = accelerator.project_configuration
     if output_dir is None:
@@ -184,7 +238,7 @@ def _rotate_checkpoints(accelerator, base: Path) -> None:
     if limit is None:
         return
     existing = _list_checkpoints(base.parent)
-    committed = [p for p in existing if (p / COMMIT_MARKER).exists()]
+    committed = [p for p in existing if _checkpoint_committed(p)]
     # Keep limit-1 committed snapshots (the incoming save is the limit-th),
     # but never fewer than one: the newest valid checkpoint is sacred.
     while len(committed) > max(max(limit, 1) - 1, 1):
@@ -630,3 +684,155 @@ def load_custom_state(obj, path: str, index: int = 0) -> None:
     if load_location.exists():
         with open(load_location, "rb") as f:
             obj.load_state_dict(pickle.load(f))
+
+
+# ------------------------------------------- coordinated pipeline (MPMD) checkpoints
+# MPMD multi-slice training (parallel/mpmd.py) has no single writer: each stage
+# is an independent process saving its OWN state, and a consistent restore must
+# take every stage from the SAME epoch. The coordination contract:
+#
+#   checkpoint_<step>/pipeline.json        written FIRST ({"n_stages": N, "step": s})
+#   checkpoint_<step>/stage_<i>/           one verified snapshot per stage
+#       stage_state.pkl                    host pytree (params/opt_state/step)
+#       manifest.sha256.json + COMMITTED   the PR-9 verified-checkpoint machinery
+#
+# The epoch is committed IFF every declared stage's marker landed and verifies;
+# a stage killed mid-save leaves a partial epoch that is quarantined AS A UNIT
+# (never stage-by-stage — mixing epochs across stages would restore a pipeline
+# state no run ever produced).
+
+STAGE_STATE_NAME = "stage_state.pkl"
+
+
+def save_pipeline_checkpoint(base, step: int, stage_states, faults=None) -> str:
+    """Write one coordinated pipeline snapshot at ``base/checkpoint_<step>``.
+
+    ``stage_states`` is the per-stage list of HOST pytrees (numpy leaves —
+    callers snapshot via ``utils.host_snapshot`` / ``StageProcess.state()``).
+    ``faults`` is an optional per-stage list of :class:`FaultPlan`-likes; each
+    stage draws the ``ckpt.save`` site exactly as ``save_accelerator_state``
+    does — a ``crash`` spec raises after the stage's data landed but BEFORE its
+    marker (the torn mid-save state), a ``corrupt`` spec flips a byte after the
+    marker (caught by manifest verification at load). Returns the epoch path.
+    """
+    base = Path(base)
+    n_stages = len(stage_states)
+    path = base / f"checkpoint_{int(step)}"
+    path.mkdir(parents=True, exist_ok=True)
+    # Meta FIRST: from this point the directory declares how many stages a
+    # complete snapshot needs, so a crash after any subset of stage saves is
+    # detectable as partial (verify_checkpoint's pipeline branch).
+    (path / PIPELINE_META).write_text(
+        json.dumps({"n_stages": n_stages, "step": int(step)})
+    )
+    for i, state in enumerate(stage_states):
+        plan = faults[i] if faults is not None else None
+        _save_stage_snapshot(path, i, state, plan)
+    return str(path)
+
+
+def _save_stage_snapshot(epoch_path: Path, stage_id: int, host_state,
+                         plan=None) -> None:
+    """One stage's verified snapshot under ``epoch_path/stage_<i>/`` (data →
+    manifest → atomic marker, the save_accelerator_state ordering)."""
+    sdir = epoch_path / f"stage_{stage_id}"
+    sdir.mkdir(parents=True, exist_ok=True)
+    marker = sdir / COMMIT_MARKER
+    if marker.exists():  # re-used dir: lose the stale committed bit first
+        marker.unlink()
+    with open(sdir / STAGE_STATE_NAME, "wb") as f:
+        pickle.dump(host_state, f)
+    spec = plan.draw("ckpt.save") if plan is not None else None
+    if spec is not None and spec.kind == "crash":
+        from .resilience.faults import InjectedFault
+
+        # Injected mid-save stage death: data on disk, marker NOT — the whole
+        # epoch is now partial and must never be selected by the fallback.
+        raise InjectedFault("ckpt.save", "crash")
+    _write_commit_marker(sdir)
+    if spec is not None and spec.kind == "corrupt":
+        _corrupt_one_file(sdir)
+
+
+def load_pipeline_checkpoint(path, verify: bool = True):
+    """Restore one coordinated snapshot → ``(step, [host_state, ...])``.
+
+    Verifies first and raises :class:`CheckpointCorruptError` on any problem —
+    an explicit epoch path is caller intent, exactly like
+    ``load_accelerator_state(input_dir=...)``; the silent-fallback path is
+    :func:`select_pipeline_checkpoint`. ``verify=False`` skips the hash pass
+    for callers that JUST verified the path (the selection fallback hands an
+    already-verified epoch straight to the load — hashing every stage twice
+    back to back buys nothing)."""
+    path = Path(path)
+    if verify:
+        problems = verify_checkpoint(path)
+        if problems:
+            raise CheckpointCorruptError(path, problems)
+    meta = json.loads((path / PIPELINE_META).read_text())
+    states = []
+    for i in range(int(meta["n_stages"])):
+        with open(path / f"stage_{i}" / STAGE_STATE_NAME, "rb") as f:
+            states.append(pickle.load(f))
+    return int(meta["step"]), states
+
+
+def select_pipeline_checkpoint(base, quarantine: bool = True,
+                               telemetry=None):
+    """Newest epoch under ``base`` whose EVERY stage verifies, or ``None``.
+
+    Invalid epochs — partial commits (a stage killed mid-save), corrupt files —
+    are quarantined AS A UNIT under ``base/quarantined/`` (never one stage at a
+    time: the surviving stages of a torn epoch are exactly as unusable as the
+    missing one) and telemetered like the accelerator fallback path, then the
+    search falls back to the next-newest epoch on ALL stages."""
+    base = Path(base)
+    for cand in reversed(_list_checkpoints(base)):
+        problems = verify_checkpoint(cand)
+        if not problems:
+            return cand
+        logger.warning(
+            f"pipeline checkpoint {cand} failed verification "
+            f"({'; '.join(problems)}) — "
+            + ("quarantining the whole epoch and " if quarantine else "")
+            + "falling back to the previous consistent snapshot"
+        )
+        if telemetry is not None and getattr(telemetry, "enabled", False):
+            from .telemetry.schemas import FAULT_SCHEMA, RECOVERY_SCHEMA
+
+            telemetry.emit({
+                "schema": FAULT_SCHEMA, "site": "ckpt.load", "kind": "corrupt",
+                "checkpoint": cand.name, "problems": list(problems),
+            })
+            telemetry.emit({
+                "schema": RECOVERY_SCHEMA, "action": "checkpoint_fallback",
+                "quarantined": cand.name,
+            })
+        if quarantine:
+            qdir = base / QUARANTINE_DIR
+            qdir.mkdir(parents=True, exist_ok=True)
+            dest = qdir / cand.name
+            if dest.exists():
+                shutil.rmtree(dest, ignore_errors=True)
+            shutil.move(str(cand), str(dest))
+    return None
+
+
+def rotate_pipeline_checkpoints(base, total_limit) -> None:
+    """Prune old pipeline epochs to ``total_limit``, with the
+    ``_rotate_checkpoints`` guarantees generalized to coordinated snapshots:
+    only FULLY-committed epochs (every stage's marker landed) count toward the
+    limit, and the newest fully-committed epoch is never deleted — it is the
+    only state a post-crash replay can fall back to."""
+    if total_limit is None:
+        return
+    committed = [
+        p for p in _list_checkpoints(Path(base)) if _checkpoint_committed(p)
+    ]
+    while len(committed) > max(int(total_limit), 1):
+        victim = committed.pop(0)
+        logger.info(
+            f"Deleting old pipeline checkpoint {victim} "
+            f"(total_limit={total_limit})"
+        )
+        shutil.rmtree(victim, ignore_errors=True)

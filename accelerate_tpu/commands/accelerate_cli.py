@@ -5,6 +5,7 @@ from __future__ import annotations
 import argparse
 
 from .audit import audit_command_parser
+from .chaos_train import chaos_train_command_parser
 from .config import config_command_parser
 from .env import env_command_parser
 from .estimate import estimate_command_parser
@@ -28,6 +29,7 @@ def get_parser() -> argparse.ArgumentParser:
     )
     subparsers = parser.add_subparsers(help="accelerate-tpu command helpers", dest="command")
     audit_command_parser(subparsers=subparsers)
+    chaos_train_command_parser(subparsers=subparsers)
     config_command_parser(subparsers=subparsers)
     env_command_parser(subparsers=subparsers)
     estimate_command_parser(subparsers=subparsers)

@@ -1,0 +1,313 @@
+"""``accelerate-tpu chaos-train`` — the elastic MPMD training chaos proof.
+
+The training-side sibling of ``serve-bench --chaos`` (PR 9): run the SAME
+deterministic MPMD pipeline training twice on a CPU 2-process-mesh
+simulation — once undisturbed, once under seeded per-gang ``train.step``
+``crash`` clauses (stage-scoped :class:`~..resilience.faults.FaultPlan`,
+streams keyed ``(seed, gang_id)``) supervised by the gang-of-gangs
+orchestrator (``elastic.GangOfGangs``: hold peers at the barrier, restart the
+crashed gang under its ``FleetSupervisor`` budget/backoff schedule, replay the
+whole pipeline from the last verified coordinated checkpoint) — and stamp what
+recovery delivered into ``BENCH_ELASTIC.json``:
+
+- **zero lost or double-applied steps** — the exactly-once ledger of the
+  recovered run is exactly ``range(n_steps)``;
+- **post-recovery state bitwise identical** — final params AND optimizer
+  state of every stage equal the undisturbed run's, leaf for leaf, bit for
+  bit; the recovered loss curve equals the clean one float-for-float;
+- **restart accounting matches the supervisor** — per-gang restart counts
+  stay within the ``FleetSupervisor`` budget and every crash appears in the
+  fault plans' fire records; backoff waits follow the schedule (virtual
+  clock, so the artifact is deterministic).
+
+The CLI exits non-zero when ANY invariant fails — the artifact is an
+acceptance gate, not a report. ``--smoke`` is the tier-1 CI shape
+(``tests/test_mpmd.py::test_chaos_train_cli_smoke``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from typing import Optional
+
+__all__ = ["run_chaos_train", "chaos_train_command", "chaos_train_command_parser"]
+
+
+def chaos_train_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = (
+        "Elastic MPMD training chaos proof: clean vs crash-injected gang-of-gangs "
+        "run, asserting exactly-once steps and bitwise recovery (BENCH_ELASTIC.json)."
+    )
+    if subparsers is not None:
+        parser = subparsers.add_parser("chaos-train", description=description)
+    else:
+        parser = argparse.ArgumentParser(
+            "accelerate-tpu chaos-train", description=description
+        )
+    parser.add_argument("--out", default="BENCH_ELASTIC.json",
+                        help="artifact path (default: BENCH_ELASTIC.json)")
+    parser.add_argument("--steps", type=int, default=24,
+                        help="global training steps per arm")
+    parser.add_argument("--stages", type=int, default=2,
+                        help="MPMD pipeline stages (one gang each)")
+    parser.add_argument("--microbatches", type=int, default=2,
+                        help="microbatches per step (the F-then-B schedule depth)")
+    parser.add_argument("--batch", type=int, default=4,
+                        help="per-microbatch batch size")
+    parser.add_argument("--width", type=int, default=8,
+                        help="demo model width")
+    parser.add_argument("--crash-rate", type=float, default=0.12,
+                        help="per-(stage, step-attempt) crash probability")
+    parser.add_argument("--checkpoint-every", type=int, default=4,
+                        help="coordinated pipeline snapshot period (steps)")
+    parser.add_argument("--max-restarts", type=int, default=16,
+                        help="per-gang FleetSupervisor restart budget")
+    parser.add_argument("--restart-backoff", type=float, default=0.5,
+                        help="per-gang exponential backoff base (virtual seconds)")
+    parser.add_argument("--total-limit", type=int, default=3,
+                        help="checkpoint rotation limit (fully-committed epochs)")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="data/init/fault seed")
+    parser.add_argument("--smoke", action="store_true",
+                        help="tier-1 CI shape (small steps/model, higher crash rate)")
+    if subparsers is not None:
+        parser.set_defaults(func=chaos_train_command)
+    return parser
+
+
+class _VirtualClock:
+    """Deterministic time for the backoff schedule: ``sleep`` advances instead
+    of waiting, so the artifact's restart/backoff accounting is reproducible
+    and the bench never actually stalls."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += float(dt)
+
+
+def _bitwise_equal_tree(a, b) -> bool:
+    import numpy as np
+
+    import jax
+
+    leaves_a = jax.tree_util.tree_leaves(a)
+    leaves_b = jax.tree_util.tree_leaves(b)
+    if len(leaves_a) != len(leaves_b):
+        return False
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y))
+        for x, y in zip(leaves_a, leaves_b)
+    )
+
+
+def run_chaos_train(
+    steps: int = 24,
+    stages: int = 2,
+    microbatches: int = 2,
+    batch: int = 4,
+    width: int = 8,
+    crash_rate: float = 0.12,
+    checkpoint_every: int = 4,
+    max_restarts: int = 16,
+    restart_backoff: float = 0.5,
+    total_limit: Optional[int] = 3,
+    seed: int = 0,
+    workdir: Optional[str] = None,
+    telemetry=None,
+) -> dict:
+    """The elastic-training proof (BENCH_ELASTIC.json): one deterministic MPMD
+    workload trained twice — clean, then under seeded per-gang stage crashes
+    with gang-of-gangs recovery — asserting the ISSUE-11 invariants (zero
+    lost/double-applied steps, bitwise-identical recovered state, restart
+    accounting within the per-gang budget). Returns the artifact dict; the
+    ``invariants`` block carries each verdict so the CLI can gate on them."""
+    import functools
+    import tempfile
+
+    from ..elastic import FleetSupervisor, GangOfGangs
+    from ..parallel.mpmd import build_demo_stage, demo_data_fn
+    from ..resilience.faults import FaultPlan, FaultSpec
+    from ..telemetry.provenance import provenance_stamp
+
+    if not 0.0 < crash_rate < 1.0:
+        raise ValueError(f"crash_rate={crash_rate} must be in (0, 1)")
+    if steps < 1:
+        raise ValueError(f"steps={steps} must be >= 1")
+    # A caller-provided workdir is theirs to keep (post-mortem inspection);
+    # the default one holds nothing the artifact doesn't, so it is removed on
+    # the way out — bench/test loops must not leak checkpoint trees into /tmp.
+    own_workdir = workdir is None
+    workdir = workdir or tempfile.mkdtemp(prefix="chaos_train_")
+    import os
+
+    try:
+        data_fn = demo_data_fn(seed, microbatches, batch, width)
+        gang_ids = [f"stage{i}" for i in range(stages)]
+
+        def build_arm(arm: str, plans, supervisor, clock, sleep):
+            ckpt_dir = os.path.join(workdir, arm)
+
+            def factory(i):
+                return build_demo_stage(
+                    i, n_stages=stages, width=width, n_microbatches=microbatches,
+                    seed=seed, faults=None if plans is None else plans[i],
+                    telemetry=telemetry,
+                )
+
+            return GangOfGangs(
+                factory, stages, checkpoint_dir=ckpt_dir, supervisor=supervisor,
+                checkpoint_every=checkpoint_every, total_limit=total_limit,
+                telemetry=telemetry, clock=clock, sleep=sleep,
+            )
+
+        # ---- clean arm: the undisturbed reference lineage.
+        clean_clock = _VirtualClock()
+        clean = build_arm("clean", None, None, clean_clock, clean_clock.advance)
+        clean_summary = clean.run(data_fn, steps)
+
+        # ---- chaos arm: one persistent crash plan per gang, keyed (seed, gang_id)
+        # — which stage crashes at which step-attempt depends only on the seed and
+        # the gang, never on how the stages interleave. Plans OUTLIVE restarts
+        # (the factory re-attaches them), so the whole run is deterministic.
+        plans = {
+            i: FaultPlan(
+                [FaultSpec("train.step", "crash", prob=crash_rate)],
+                seed=seed, scope=gang_ids[i],
+            )
+            for i in range(stages)
+        }
+        vclock = _VirtualClock()
+        supervisor = FleetSupervisor(
+            max_restarts=max_restarts, restart_backoff=restart_backoff,
+            telemetry=telemetry, clock=vclock,
+        )
+        chaos = build_arm("chaos", plans, supervisor, vclock, vclock.advance)
+        from ..elastic import WorkerFailure
+
+        budget_exhausted = False
+        try:
+            chaos_summary = chaos.run(data_fn, steps)
+        except WorkerFailure:
+            budget_exhausted = True
+            chaos_summary = chaos.summary(steps)
+
+        # ---- invariants (the acceptance gate).
+        restarts = chaos_summary["restarts"]
+        invariants = {
+            "zero_lost_steps": not chaos_summary["lost_steps"],
+            "zero_double_applied_steps": not chaos_summary["double_applied_steps"],
+            "loss_curve_identical": (
+                chaos_summary["losses"] == clean_summary["losses"]
+            ),
+            "params_bitwise_identical": _bitwise_equal_tree(
+                chaos.pipeline.state(), clean.pipeline.state()
+            ),
+            "restarts_within_budget": (
+                not budget_exhausted
+                and all(n <= max_restarts for n in restarts.values())
+            ),
+            "restarts_match_crashes": (
+                sum(restarts.values()) == chaos_summary["stage_crashes"]
+                == sum(len(p.fired) for p in plans.values())
+            ),
+        }
+        artifact = {
+            "schema": "accelerate_tpu.bench.elastic/v1",
+            "steps": steps,
+            "stages": stages,
+            "microbatches": microbatches,
+            "batch": batch,
+            "width": width,
+            "crash_rate": crash_rate,
+            "checkpoint_every": checkpoint_every,
+            "seed": seed,
+            "fault_plan": {
+                "seed": seed,
+                "site": "train.step",
+                "kind": "crash",
+                "prob": crash_rate,
+                "fired_by_gang": {
+                    gang_ids[i]: len(plans[i].fired) for i in range(stages)
+                },
+            },
+            "supervisor": {
+                "max_restarts": max_restarts,
+                "restart_backoff": restart_backoff,
+                "restarts_by_gang": dict(restarts),
+                "budget_exhausted": budget_exhausted,
+                "backoff_virtual_s": chaos_summary["backoff_s"],
+            },
+            "invariants": invariants,
+            "clean": _arm_columns(clean_summary),
+            "chaos": _arm_columns(chaos_summary),
+            "provenance": provenance_stamp(),
+        }
+    finally:
+        if own_workdir:
+            import shutil
+
+            shutil.rmtree(workdir, ignore_errors=True)
+    return artifact
+
+
+def _arm_columns(summary: dict) -> dict:
+    """One arm's artifact block: accounting without the full ledger/loss dumps
+    (first/last losses pin the curve; the invariants already compared every
+    float)."""
+    losses = summary["losses"]
+    return {
+        "applied_steps": len(summary["ledger"]),
+        "lost_steps": len(summary["lost_steps"]),
+        "double_applied_steps": len(summary["double_applied_steps"]),
+        "stage_crashes": summary["stage_crashes"],
+        "replayed_steps": summary["replayed_steps"],
+        "checkpoints_saved": summary["checkpoints_saved"],
+        "torn_saves": summary["torn_saves"],
+        "barrier_holds": summary["barrier_holds"],
+        "loss_first": losses[0] if losses else None,
+        "loss_last": losses[-1] if losses else None,
+        "transfer": summary["transfer"],
+    }
+
+
+def chaos_train_command(args) -> int:
+    if args.smoke:
+        # The tier-1 CI shape: a few seconds on CPU, still several injected
+        # crashes (higher rate over fewer steps) and at least one replay.
+        args.steps = min(args.steps, 10)
+        args.width = min(args.width, 8)
+        args.crash_rate = max(args.crash_rate, 0.2)
+        args.checkpoint_every = min(args.checkpoint_every, 3)
+    artifact = run_chaos_train(
+        steps=args.steps,
+        stages=args.stages,
+        microbatches=args.microbatches,
+        batch=args.batch,
+        width=args.width,
+        crash_rate=args.crash_rate,
+        checkpoint_every=args.checkpoint_every,
+        max_restarts=args.max_restarts,
+        restart_backoff=args.restart_backoff,
+        total_limit=args.total_limit,
+        seed=args.seed,
+    )
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(json.dumps({
+        "schema": artifact["schema"],
+        "steps": artifact["steps"],
+        "stages": artifact["stages"],
+        "stage_crashes": artifact["chaos"]["stage_crashes"],
+        "replayed_steps": artifact["chaos"]["replayed_steps"],
+        "restarts_by_gang": artifact["supervisor"]["restarts_by_gang"],
+        "invariants": artifact["invariants"],
+    }))
+    # The artifact is an acceptance gate: ANY failed invariant is a non-zero
+    # exit, exactly like serve-bench --chaos's silently_lost contract.
+    return 0 if all(artifact["invariants"].values()) else 1

@@ -30,7 +30,7 @@ from .utils.other import get_free_port
 
 logger = get_logger(__name__)
 
-__all__ = ["ElasticSupervisor", "FleetSupervisor", "WorkerFailure"]
+__all__ = ["ElasticSupervisor", "FleetSupervisor", "GangOfGangs", "WorkerFailure"]
 
 
 def backoff_delay(base: float, jitter: float, attempt: int) -> float:
@@ -317,4 +317,243 @@ class FleetSupervisor:
             "exhausted": sorted(
                 g for g, n in self._attempts.items() if n > self.max_restarts
             ),
+        }
+
+
+class GangOfGangs:
+    """Gang-of-gangs MPMD training orchestration: hold / restart / replay.
+
+    An MPMD pipeline (``parallel/mpmd.py``) is N independent stage gangs — N
+    separate failure domains. This orchestrator supervises them as one
+    training job with the protocol ROADMAP item 4 names:
+
+    1. **Hold.** A stage crash (:class:`~.resilience.faults.StageCrashed`
+       escaping ``MPMDPipeline.train_step`` — the ``train.step`` ``crash``
+       fault kind, or a real worker death in a subprocess deployment) halts
+       the schedule; every HEALTHY gang holds at the recovery barrier (one
+       ``mpmd.barrier/v1`` ``hold`` record each — peers keep their process and
+       device state, they just stop consuming the schedule).
+    2. **Restart (budgeted).** The failure charges ONLY the crashed gang's
+       :class:`FleetSupervisor` budget (``record_failure``); its
+       exponential-backoff *schedule* decides when the rebuild may proceed
+       (deterministic under an injected clock). Budget exhausted →
+       :class:`WorkerFailure`, the whole job tears down. Otherwise the crashed
+       stage process is REBUILT through ``stage_factory(stage_id)`` — never
+       resurrected from live Python state (the factory re-attaches the stage's
+       persistent scoped FaultPlan, so chaos runs stay deterministic across
+       restarts).
+    3. **Replay.** The whole pipeline reloads the newest coordinated
+       checkpoint that verifies on EVERY stage
+       (``checkpointing.select_pipeline_checkpoint`` — partial-commit epochs
+       quarantined as a unit), the exactly-once step ledger is truncated to
+       the restored step, and the schedule resumes. Because stage init and
+       per-step data are pure functions of ``(seed, stage_id)`` /
+       ``(seed, step)``, the recovered run is **bitwise identical** to the
+       undisturbed one (``chaos-train`` asserts it).
+
+    A step-0 snapshot is saved before the first step, so replay ALWAYS has a
+    verified target — a crash before the first periodic checkpoint rewinds to
+    init, not to an undefined state. ``clock``/``sleep`` are injectable so the
+    chaos bench runs backoff schedules on virtual time.
+    """
+
+    def __init__(
+        self,
+        stage_factory: Callable[[int], object],
+        n_stages: int,
+        *,
+        checkpoint_dir,
+        supervisor: Optional[FleetSupervisor] = None,
+        checkpoint_every: int = 0,
+        total_limit: Optional[int] = None,
+        telemetry=None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+    ):
+        if n_stages < 1:
+            raise ValueError(f"n_stages={n_stages} must be >= 1")
+        if checkpoint_every < 0:
+            raise ValueError(f"checkpoint_every={checkpoint_every} must be >= 0")
+        self.stage_factory = stage_factory
+        self.n_stages = int(n_stages)
+        self.checkpoint_dir = checkpoint_dir
+        self.supervisor = supervisor if supervisor is not None else FleetSupervisor(
+            max_restarts=1, telemetry=telemetry, clock=clock
+        )
+        self.checkpoint_every = int(checkpoint_every)
+        self.total_limit = total_limit
+        self.telemetry = telemetry
+        self._clock = clock
+        self._sleep = sleep
+        self.pipeline = None
+        #: Exactly-once lineage: global step ids applied in the SURVIVING
+        #: history (truncated on every replay). The chaos-train invariant is
+        #: ``ledger == range(n_steps)`` — zero lost, zero double-applied.
+        self.ledger: list = []
+        self.losses: list = []
+        self.stage_crashes = 0
+        self.replayed_steps = 0
+        self.checkpoints_saved = 0
+        self.torn_saves = 0
+        self.backoff_s = 0.0
+        self.holds = 0
+
+    # ------------------------------------------------------------ internals
+    def _emit_barrier(self, action: str, peer: str, step: int) -> None:
+        tel = self.telemetry
+        for st in self.pipeline.stages:
+            if st.gang_id == peer:
+                continue
+            if action == "hold":
+                self.holds += 1
+            if tel is not None and getattr(tel, "enabled", False):
+                from .telemetry.schemas import MPMD_BARRIER_SCHEMA
+
+                tel.emit({
+                    "schema": MPMD_BARRIER_SCHEMA,
+                    "gang_id": st.gang_id,
+                    "peer": peer,
+                    "action": action,
+                    "step": int(step),
+                })
+
+    def _save(self, step: int) -> None:
+        from .checkpointing import (
+            rotate_pipeline_checkpoints,
+            save_pipeline_checkpoint,
+        )
+        from .resilience.faults import InjectedFault
+
+        try:
+            save_pipeline_checkpoint(
+                self.checkpoint_dir, step, self.pipeline.state(),
+                faults=[st.faults for st in self.pipeline.stages],
+            )
+        except InjectedFault:
+            # A stage died mid-save: the epoch is torn (some stages committed,
+            # one did not). Training continues — the partial epoch is
+            # quarantined AS A UNIT by the next replay's fallback, which
+            # restores the previous consistent snapshot on ALL stages.
+            self.torn_saves += 1
+            logger.warning(
+                f"pipeline checkpoint at step {step} torn mid-save — "
+                f"the partial epoch will never be selected for replay"
+            )
+            return
+        self.checkpoints_saved += 1
+        if self.total_limit is not None:
+            rotate_pipeline_checkpoints(self.checkpoint_dir, self.total_limit)
+
+    def _replay(self, require: bool = True) -> Optional[int]:
+        """Restore every stage from the newest fully-verified epoch; returns
+        the restored step (or None when no epoch exists and ``require`` is
+        False — the fresh-directory start path). The selection pass already
+        sha256-verifies the chosen epoch, so the load skips its own re-verify
+        — one hash pass per recovery, not two."""
+        from .checkpointing import (
+            load_pipeline_checkpoint,
+            select_pipeline_checkpoint,
+        )
+
+        cand = select_pipeline_checkpoint(
+            self.checkpoint_dir, telemetry=self.telemetry
+        )
+        if cand is None:
+            if not require:
+                return None
+            raise WorkerFailure(
+                "no verified pipeline checkpoint to replay from "
+                f"(under {self.checkpoint_dir})", []
+            )
+        step, states = load_pipeline_checkpoint(cand, verify=False)
+        self.pipeline.load_state(states)
+        return step
+
+    def _recover(self, exc, crashed_at: int) -> None:
+        gang = exc.gang_id
+        idx = next(
+            (i for i, st in enumerate(self.pipeline.stages)
+             if st.gang_id == gang), None
+        )
+        if idx is None:
+            raise exc  # a crash naming an unknown gang is not ours to absorb
+        self.stage_crashes += 1
+        self._emit_barrier("hold", gang, crashed_at)
+        if not self.supervisor.record_failure(gang, reason="crash"):
+            raise WorkerFailure(
+                f"gang {gang} exhausted its restart budget "
+                f"({self.supervisor.max_restarts + 1} attempts) at step "
+                f"{crashed_at}", []
+            ) from exc
+        delay = self.supervisor.restart_at(gang) - self._clock()
+        if delay > 0:
+            self.backoff_s += delay
+            self._sleep(delay)
+        # Restart ONLY the crashed gang's process; peers held and keep theirs.
+        self.pipeline.stages[idx] = self.stage_factory(idx)
+        restored = self._replay()
+        self.replayed_steps += max(0, crashed_at - restored)
+        del self.ledger[restored:]
+        del self.losses[restored:]
+        self._emit_barrier("release", gang, restored)
+
+    # ------------------------------------------------------------ driving
+    def run(self, data_fn: Callable[[int], tuple], n_steps: int) -> dict:
+        """Train ``n_steps`` steps under supervision; returns the accounting
+        summary (ledger, losses, restart/backoff/checkpoint counters, final
+        per-stage states). ``data_fn(step) -> (microbatches, targets)`` must
+        be a pure function of the step index — the replay contract."""
+        from .parallel.mpmd import MPMDPipeline
+        from .resilience.faults import StageCrashed
+
+        self.pipeline = MPMDPipeline(
+            [self.stage_factory(i) for i in range(self.n_stages)],
+            telemetry=self.telemetry,
+        )
+        restored = self._replay(require=False)
+        if restored is None:
+            # The step-0 baseline: replay must always have a verified target.
+            self._save(0)
+            restored = 0
+        self.ledger = list(range(restored))
+        # The ledger and losses are BOTH indexed by global step, so replay
+        # truncation (`del self.losses[step:]`) stays aligned: steps restored
+        # from disk (whose losses this session never observed) hold None
+        # placeholders — a fresh run (restored == 0) pads nothing.
+        self.losses = [None] * restored
+        step = restored
+        while step < n_steps:
+            microbatches, targets = data_fn(step)
+            try:
+                metrics = self.pipeline.train_step(microbatches, targets)
+            except StageCrashed as exc:
+                self._recover(exc, step)
+                step = self.pipeline.step
+                continue
+            self.ledger.append(metrics["step"])
+            self.losses.append(metrics["loss"])
+            step += 1
+            if self.checkpoint_every and step % self.checkpoint_every == 0:
+                self._save(step)
+        return self.summary(n_steps)
+
+    def summary(self, n_steps: int) -> dict:
+        sup = self.supervisor.stats()
+        return {
+            "steps": int(n_steps),
+            "ledger": list(self.ledger),
+            "losses": list(self.losses),
+            "lost_steps": sorted(set(range(n_steps)) - set(self.ledger)),
+            "double_applied_steps": sorted(
+                s for s in set(self.ledger) if self.ledger.count(s) > 1
+            ),
+            "stage_crashes": self.stage_crashes,
+            "restarts": sup["attempts"],
+            "max_restarts": sup["max_restarts"],
+            "replayed_steps": self.replayed_steps,
+            "checkpoints_saved": self.checkpoints_saved,
+            "torn_saves": self.torn_saves,
+            "backoff_s": round(self.backoff_s, 6),
+            "barrier_holds": self.holds,
+            "transfer": self.pipeline.transfer_summary(),
         }

@@ -5,10 +5,22 @@ These are thin, named wrappers over XLA collective HLOs (``psum``/``all_gather``
 They are meaningful only inside ``shard_map``/``pmap``-style traced code where mesh axis names
 are bound. Defaults target the batch axes ``("dp", "fsdp")`` so a plain ``grad_psum`` matches
 DDP's gradient all-reduce (reference ``optimizer.py:148-154`` / torch DDP reducer).
+
+**Inter-stage (DCN) transfers** — :func:`stage_transfer` — are the one
+HOST-level op here: MPMD multi-slice training (``parallel/mpmd.py``) runs each
+pipeline stage as an independent program on its own mesh, so activations and
+cotangents cross stage boundaries outside any jit, over the data-center
+network rather than ICI (arxiv 2204.06514's multi-slice DCN regime). The op
+is first-class on purpose: every transfer is byte- and latency-accounted
+(:class:`TransferStats`, ``mpmd.transfer/v1`` telemetry records), and
+graftaudit's collective inventory audits the per-program transfer payload the
+same way it audits in-jit collective bytes.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import time
 from typing import Any, Optional, Sequence
 
 import jax
@@ -31,6 +43,9 @@ __all__ = [
     "axis_size",
     "grad_psum",
     "grad_pmean",
+    "TransferStats",
+    "tree_bytes",
+    "stage_transfer",
 ]
 
 AxisNames = Any  # str | tuple[str, ...]
@@ -128,3 +143,97 @@ def grad_pmean(grads, axis_name: Optional[AxisNames] = None, reduce_dtype=None):
         return g.astype(orig)
 
     return jax.tree_util.tree_map(_reduce, grads)
+
+
+# --------------------------------------------------------- inter-stage (DCN) transfers
+@dataclasses.dataclass
+class TransferStats:
+    """Running byte/latency accounting for one transfer edge (or one stage's
+    whole transfer history — the caller picks the granularity). ``record`` is
+    what :func:`stage_transfer` calls; ``summary()`` is the stats()-shaped
+    dict bench rows stamp."""
+
+    count: int = 0
+    bytes: int = 0
+    seconds: float = 0.0
+
+    def record(self, nbytes: int, dur_s: float) -> None:
+        self.count += 1
+        self.bytes += int(nbytes)
+        self.seconds += float(dur_s)
+
+    def summary(self) -> dict:
+        return {
+            "transfers": self.count,
+            "transfer_bytes": self.bytes,
+            "transfer_s": round(self.seconds, 6),
+        }
+
+
+def tree_bytes(tree) -> int:
+    """Total payload bytes of a pytree of arrays (the DCN wire cost of
+    transferring it, compression aside)."""
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is None:
+            import numpy as np
+
+            nbytes = np.asarray(leaf).nbytes
+        total += int(nbytes)
+    return total
+
+
+def stage_transfer(
+    x,
+    *,
+    src_stage: int,
+    dst_stage: int,
+    direction: str = "fwd",
+    sharding=None,
+    step: Optional[int] = None,
+    microbatch: Optional[int] = None,
+    stats: Optional[TransferStats] = None,
+    telemetry=None,
+):
+    """Ship one inter-stage payload (activation or cotangent) across the MPMD
+    stage boundary — the DCN-shaped transfer between two independent stage
+    programs (``parallel/mpmd.py``).
+
+    This is deliberately a HOST-level first-class op, not an in-jit collective:
+    the two stages are separate programs on separate meshes (separate slices on
+    real hardware), so the payload leaves one program, crosses DCN, and enters
+    the other — ``jax.device_put`` onto ``sharding`` (the destination stage's
+    placement; ``None`` keeps the default device, the single-host simulation).
+    The copy is synchronously waited on so the recorded latency is the
+    transfer, not dispatch overhead.
+
+    ``direction`` is ``"fwd"`` (activation, stage i → i+1) or ``"bwd"``
+    (cotangent, stage i+1 → i). Every call records into ``stats`` (a
+    :class:`TransferStats`) and — when ``telemetry`` is enabled — emits one
+    ``accelerate_tpu.telemetry.mpmd.transfer/v1`` record, so chaos-train and
+    trace tooling can account every byte that crossed a stage boundary.
+    """
+    if direction not in ("fwd", "bwd"):
+        raise ValueError(f"direction={direction!r} must be 'fwd' or 'bwd'")
+    nbytes = tree_bytes(x)
+    t0 = time.perf_counter()
+    out = jax.device_put(x) if sharding is None else jax.device_put(x, sharding)
+    jax.block_until_ready(out)
+    dur = time.perf_counter() - t0
+    if stats is not None:
+        stats.record(nbytes, dur)
+    if telemetry is not None and getattr(telemetry, "enabled", False):
+        from ..telemetry.schemas import MPMD_TRANSFER_SCHEMA
+
+        telemetry.emit({
+            "schema": MPMD_TRANSFER_SCHEMA,
+            "src_stage": int(src_stage),
+            "dst_stage": int(dst_stage),
+            "direction": direction,
+            "nbytes": nbytes,
+            "dur_s": round(dur, 6),
+            "step": step,
+            "microbatch": microbatch,
+        })
+    return out

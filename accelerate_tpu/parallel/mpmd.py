@@ -1,0 +1,562 @@
+"""MPMD multi-slice pipeline training: independent per-stage programs.
+
+``parallel/pp.py`` is the SPMD spelling of pipeline parallelism: ONE program,
+stage params stacked over the ``pp`` mesh axis, every device running the same
+per-tick schedule. That is the right shape *within* a slice, where ICI makes
+``ppermute`` cheap — but across slices (multi-pod TPU, or any deployment where
+stages are separate failure domains) the single-program spelling breaks down:
+one preempted slice kills the whole program, and the compiler cannot overlap
+DCN transfers it cannot see.
+
+This module is the MPMD spelling (PAPERS.md: arxiv 2412.14374 MPMD pipeline
+parallelism; arxiv 2204.06514 multi-slice pjit over DCN): each pipeline stage
+is an INDEPENDENT program — its own process in a real deployment, its own
+:class:`StageProcess` with its own mesh in the CPU simulation — and
+activations/cotangents cross stage boundaries as first-class host-level DCN
+transfers (``ops.collectives.stage_transfer``, byte- and latency-accounted,
+telemetered as ``mpmd.transfer/v1``). Because stages share no program, one
+stage crashing is survivable: the gang-of-gangs orchestrator
+(``elastic.GangOfGangs``) restarts only that gang under its
+``FleetSupervisor`` budget while peers hold at a barrier, then replays the
+whole pipeline from the last verified coordinated checkpoint
+(``checkpointing.save_pipeline_checkpoint``) — and converges bitwise to the
+undisturbed run (proven by ``accelerate-tpu chaos-train``).
+
+Per-stage programs (labels ride the AOT compile cache and the graftaudit
+lowering surface):
+
+==========================  =====================================================
+label                       signature
+==========================  =====================================================
+``mpmd.stage<i>.fwd``       ``(params, x) -> y`` — forward, activation OUT is the
+                            DCN transfer payload (non-last stages)
+``mpmd.stage<i>.bwd``       ``(params, x, ct, gacc) -> (gacc', ct_out)`` —
+                            recompute-forward VJP; ``ct_out`` (LAST output) is
+                            the backward transfer payload
+``mpmd.stage<i>.loss_bwd``  ``(params, x, targets, gacc) -> (loss, gacc', ct_out)``
+                            — the last stage fuses loss + backward
+``mpmd.stage<i>.apply``     ``(params, opt_state, gacc) -> (params, opt_state)``
+                            — optimizer update on the microbatch-averaged grads
+``mpmd.stage<i>.zero``      ``(params) -> zeros`` — per-step grad accumulator
+==========================  =====================================================
+
+The schedule (:class:`MPMDPipeline.train_step`) is F-then-B GPipe over M
+microbatches with recompute-based backward (each stage keeps only its
+microbatch INPUTS in flight — the 1F1B activation-ceiling lesson from
+``parallel/pp.py`` carries over; the stage forward is rematerialized inside
+the VJP). Gradients accumulate in fixed (reverse-microbatch) order and the
+optimizer applies once per step, so two runs fed the same per-step batches are
+**bitwise identical** — the property crash-recovery replay is built on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..logging import get_logger
+from ..ops.collectives import TransferStats, stage_transfer
+from ..utils.operations import host_snapshot
+
+logger = get_logger(__name__)
+
+__all__ = [
+    "StageProcess",
+    "MPMDPipeline",
+    "build_demo_stage",
+    "build_demo_pipeline",
+    "demo_data_fn",
+    "lower_stage_programs",
+]
+
+
+def _tree_add(a, b):
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+class StageProcess:
+    """One MPMD pipeline stage: an independent program with its own mesh,
+    params, optimizer state and compiled step programs.
+
+    The process-boundary discipline is enforced by construction: a
+    ``StageProcess`` shares NO jit program with its peers and exchanges data
+    only through ``stage_transfer`` payloads (the coordinator moves them), so
+    the in-process simulation exercises exactly the interfaces a real
+    multi-process deployment has — restartability included: a crashed stage is
+    RE-BUILT from its factory and restored from the coordinated checkpoint,
+    never resurrected from live Python state.
+
+    - ``stage_fn(params, x) -> y`` for non-last stages;
+      ``loss_fn(params, x, targets) -> scalar`` for the last stage.
+    - ``mesh``: the stage's own mesh. Default: a 1-device mesh on device
+      ``stage_id % device_count`` — on a CPU host with forced device count the
+      stages land on distinct devices and every transfer is a real
+      cross-device copy.
+    - ``faults``: a stage-scoped :class:`~..resilience.faults.FaultPlan`
+      (``scope=gang_id``) drawn at the ``train.step`` site once per step —
+      kind ``crash`` raises :class:`~..resilience.faults.StageCrashed` past
+      the step boundary (the gang supervisor's restart signal).
+    - ``compile_cache``: an ``AotCache`` (or ``LowerOnlyCache`` for the
+      graftaudit pass) every stage program is wrapped through.
+    """
+
+    def __init__(
+        self,
+        stage_id: int,
+        n_stages: int,
+        *,
+        stage_fn: Optional[Callable] = None,
+        loss_fn: Optional[Callable] = None,
+        params: Any = None,
+        optimizer: Any = None,
+        n_microbatches: int = 1,
+        mesh=None,
+        faults=None,
+        telemetry=None,
+        gang_id: Optional[str] = None,
+        compile_cache=None,
+    ):
+        if not 0 <= stage_id < n_stages:
+            raise ValueError(f"stage_id={stage_id} must be in [0, {n_stages})")
+        self.stage_id = int(stage_id)
+        self.n_stages = int(n_stages)
+        self.is_last = stage_id == n_stages - 1
+        if self.is_last:
+            if loss_fn is None:
+                raise ValueError("the last stage needs loss_fn(params, x, targets)")
+        elif stage_fn is None:
+            raise ValueError(f"stage {stage_id} needs stage_fn(params, x)")
+        if n_microbatches < 1:
+            raise ValueError(f"n_microbatches={n_microbatches} must be >= 1")
+        self.stage_fn = stage_fn
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+        self.n_microbatches = int(n_microbatches)
+        self.faults = faults
+        self.telemetry = telemetry
+        self.gang_id = str(gang_id) if gang_id is not None else f"stage{stage_id}"
+        if mesh is None:
+            devices = jax.devices()
+            mesh = jax.sharding.Mesh(
+                np.array([devices[stage_id % len(devices)]]), ("stage",)
+            )
+        self.mesh = mesh
+        #: Where this stage's arrays live — the destination placement peers'
+        #: transfers target (replicated over the stage's own mesh).
+        self.sharding = jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec()
+        )
+        #: DCN accounting for every payload RECEIVED by this stage.
+        self.transfer_stats = TransferStats()
+        self.step = 0
+
+        self.params = jax.device_put(params, self.sharding)
+        self.opt_state = (
+            jax.device_put(optimizer.init(self.params), self.sharding)
+            if optimizer is not None else None
+        )
+        self._build_programs(compile_cache)
+        self._saved: List[Any] = []
+        self._gacc = None
+        self._losses: List[Any] = []
+
+    # ------------------------------------------------------------ programs
+    def _build_programs(self, cache) -> None:
+        label = f"mpmd.stage{self.stage_id}"
+        wrap = (lambda fn, suffix: cache.wrap(fn, f"{label}.{suffix}")) if (
+            cache is not None and getattr(cache, "enabled", False)
+        ) else (lambda fn, suffix: fn)
+        inv_m = 1.0 / float(self.n_microbatches)
+        optimizer = self.optimizer
+
+        def zero(params):
+            return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+        def apply(params, opt_state, gacc):
+            import optax
+
+            grads = jax.tree_util.tree_map(lambda g: g * inv_m, gacc)
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._zero = wrap(jax.jit(zero), "zero")
+        self._apply = wrap(jax.jit(apply), "apply") if optimizer is not None else None
+
+        if self.is_last:
+            loss_fn = self.loss_fn
+
+            def loss_bwd(params, x, targets, gacc):
+                loss, vjp = jax.vjp(lambda p, xx: loss_fn(p, xx, targets), params, x)
+                gp, ct_out = vjp(jnp.ones_like(loss))
+                return loss, _tree_add(gacc, gp), ct_out
+
+            self._loss_bwd = wrap(jax.jit(loss_bwd), "loss_bwd")
+        else:
+            stage_fn = self.stage_fn
+
+            def fwd(params, x):
+                return stage_fn(params, x)
+
+            def bwd(params, x, ct, gacc):
+                _, vjp = jax.vjp(stage_fn, params, x)
+                gp, ct_out = vjp(ct)
+                return _tree_add(gacc, gp), ct_out
+
+            self._fwd = wrap(jax.jit(fwd), "fwd")
+            self._bwd = wrap(jax.jit(bwd), "bwd")
+
+    # ------------------------------------------------------------ step protocol
+    def start_step(self) -> None:
+        """Open one training step: the fault-injection draw (one ``train.step``
+        site invocation per stage per step-attempt — kind ``crash`` raises
+        :class:`StageCrashed` before any compute, so a crashed attempt leaves
+        this stage's device state untouched) and fresh per-step buffers."""
+        plan = self.faults
+        if plan is not None:
+            spec = plan.draw("train.step")
+            if spec is not None:
+                if spec.kind == "crash":
+                    from ..resilience.faults import StageCrashed
+
+                    raise StageCrashed("train.step", gang_id=self.gang_id)
+                raise plan.fault_for(spec, "train.step")
+        self._saved = []
+        self._losses = []
+        self._gacc = self._zero(self.params)
+
+    def forward(self, x):
+        """Forward one microbatch (non-last stages); the input is SAVED for
+        the recompute-based backward, the returned activation is the caller's
+        transfer payload."""
+        self._saved.append(x)
+        return self._fwd(self.params, x)
+
+    def stash(self, x, targets) -> None:
+        """Bank the last stage's microbatch input — its forward, loss and
+        backward are fused into one ``loss_bwd`` program at backward time."""
+        self._saved.append((x, targets))
+
+    def backward(self, ct=None):
+        """Backward the most recent un-backpropped microbatch; returns the
+        cotangent payload for the previous stage. The last stage ignores
+        ``ct`` (it owns the loss) and records the microbatch loss."""
+        if self.is_last:
+            x, targets = self._saved.pop()
+            loss, self._gacc, ct_out = self._loss_bwd(
+                self.params, x, targets, self._gacc
+            )
+            self._losses.append(loss)
+            return ct_out
+        x = self._saved.pop()
+        self._gacc, ct_out = self._bwd(self.params, x, ct, self._gacc)
+        return ct_out
+
+    def apply_step(self) -> None:
+        """Apply the microbatch-averaged accumulated grads, advance the
+        stage-local step counter."""
+        if self._apply is not None:
+            self.params, self.opt_state = self._apply(
+                self.params, self.opt_state, self._gacc
+            )
+        self._gacc = None
+        self.step += 1
+
+    def take_losses(self) -> List[float]:
+        """This step's microbatch losses in FORWARD microbatch order (backward
+        ran in reverse)."""
+        losses = [float(l) for l in reversed(self._losses)]
+        self._losses = []
+        return losses
+
+    # ------------------------------------------------------------ state
+    def state(self) -> dict:
+        """Host snapshot of everything a restart must restore — the payload
+        one ``stage_<i>/`` checkpoint directory holds."""
+        return {
+            "stage_id": self.stage_id,
+            "step": self.step,
+            "params": host_snapshot(self.params),
+            "opt_state": host_snapshot(self.opt_state),
+        }
+
+    def load_state(self, state: dict) -> None:
+        """Restore from a :meth:`state` snapshot (device_put onto this stage's
+        own mesh — restore works across a stage-process rebuild)."""
+        if state["stage_id"] != self.stage_id:
+            raise ValueError(
+                f"stage {self.stage_id} handed stage {state['stage_id']}'s state"
+            )
+        self.step = int(state["step"])
+        self.params = jax.device_put(state["params"], self.sharding)
+        self.opt_state = (
+            jax.device_put(state["opt_state"], self.sharding)
+            if state["opt_state"] is not None else None
+        )
+        self._saved, self._losses, self._gacc = [], [], None
+
+    # ------------------------------------------------------------ warmup/audit
+    def warm_programs(self, x, targets=None) -> list:
+        """Trace+lower (or compile, depending on the cache) every program of
+        this stage against representative inputs — the enumeration hook the
+        graftaudit lowering pass and AOT warmup share. No-op (``[]``) without
+        a compile cache."""
+        entries = []
+        gacc = jax.tree_util.tree_map(np.zeros_like, host_snapshot(self.params))
+        for fn, args in self._warm_calls(x, targets, gacc):
+            if hasattr(fn, "warm"):
+                entries.append(fn.warm(*args))
+        return entries
+
+    def _warm_calls(self, x, targets, gacc):
+        calls = [(self._zero, (self.params,))]
+        if self.is_last:
+            calls.append((self._loss_bwd, (self.params, x, targets, gacc)))
+        else:
+            # The bwd cotangent is shaped like the stage OUTPUT, which need
+            # not match the input (projection stages, pytree activations) —
+            # derive it from the abstract forward, never from x.
+            y_shape = jax.eval_shape(self.stage_fn, self.params, x)
+            ct = jax.tree_util.tree_map(
+                lambda s: np.zeros(s.shape, s.dtype), y_shape
+            )
+            calls.extend([
+                (self._fwd, (self.params, x)),
+                (self._bwd, (self.params, x, ct, gacc)),
+            ])
+        if self._apply is not None:
+            calls.append((self._apply, (self.params, self.opt_state, gacc)))
+        return calls
+
+
+class MPMDPipeline:
+    """The MPMD schedule coordinator: drives F-then-B GPipe microbatch rounds
+    across :class:`StageProcess` instances, moving every inter-stage payload
+    through ``stage_transfer``.
+
+    In a real multi-slice deployment this loop is what each stage's host
+    process runs against its recv queue; the simulation centralizes it so the
+    schedule, the transfers and the failure protocol are testable on one CPU
+    host (ROADMAP item 4: the interfaces matter more than the hardware).
+    """
+
+    def __init__(self, stages: List[StageProcess], telemetry=None):
+        if not stages:
+            raise ValueError("MPMDPipeline needs at least one stage")
+        ids = [st.stage_id for st in stages]
+        if ids != list(range(len(stages))):
+            raise ValueError(f"stage ids must be contiguous from 0, got {ids}")
+        if not stages[-1].is_last:
+            raise ValueError("the final stage must be the loss stage")
+        micro = {st.n_microbatches for st in stages}
+        if len(micro) != 1:
+            raise ValueError(f"stages disagree on n_microbatches: {sorted(micro)}")
+        self.stages = list(stages)
+        self.telemetry = telemetry
+        self.n_microbatches = stages[0].n_microbatches
+
+    @property
+    def step(self) -> int:
+        return self.stages[0].step
+
+    def train_step(self, microbatches, targets) -> dict:
+        """One global step: M forward rounds (activations hopping stage to
+        stage over DCN), M backward rounds in reverse (cotangents hopping
+        back), one optimizer apply per stage.
+
+        ``microbatches``/``targets`` carry a leading microbatch dim of size
+        ``n_microbatches``. Raises :class:`StageCrashed` (or any injected
+        fault) PAST this boundary — step accounting is the orchestrator's job.
+        """
+        M = self.n_microbatches
+        if len(microbatches) != M or len(targets) != M:
+            raise ValueError(
+                f"expected {M} microbatches, got {len(microbatches)}/{len(targets)}"
+            )
+        step = self.step
+        # Fault draws first and for EVERY stage: a crashed attempt charges the
+        # crashing gang before any stage has mutated device state.
+        for st in self.stages:
+            st.start_step()
+        last = self.stages[-1]
+        for m in range(M):
+            x = jax.device_put(microbatches[m], self.stages[0].sharding)
+            for st in self.stages[:-1]:
+                y = st.forward(x)
+                nxt = self.stages[st.stage_id + 1]
+                x = stage_transfer(
+                    y, src_stage=st.stage_id, dst_stage=nxt.stage_id,
+                    direction="fwd", sharding=nxt.sharding, step=step,
+                    microbatch=m, stats=nxt.transfer_stats,
+                    telemetry=self.telemetry,
+                )
+            last.stash(x, jax.device_put(targets[m], last.sharding))
+        for m in reversed(range(M)):
+            ct = last.backward()
+            for st in reversed(self.stages[:-1]):
+                ct = stage_transfer(
+                    ct, src_stage=st.stage_id + 1, dst_stage=st.stage_id,
+                    direction="bwd", sharding=st.sharding, step=step,
+                    microbatch=m, stats=st.transfer_stats,
+                    telemetry=self.telemetry,
+                )
+                ct = st.backward(ct)
+        losses = last.take_losses()
+        for st in self.stages:
+            st.apply_step()
+        return {
+            "step": step,
+            "loss": float(np.mean(losses)),
+            "microbatch_losses": losses,
+        }
+
+    # ------------------------------------------------------------ state
+    def state(self) -> List[dict]:
+        """Per-stage host snapshots, in stage order — what
+        ``checkpointing.save_pipeline_checkpoint`` writes."""
+        return [st.state() for st in self.stages]
+
+    def load_state(self, states: List[dict]) -> None:
+        if len(states) != len(self.stages):
+            raise ValueError(
+                f"{len(states)} stage states for {len(self.stages)} stages"
+            )
+        for st, state in zip(self.stages, states):
+            st.load_state(state)
+
+    def transfer_summary(self) -> dict:
+        """Aggregate DCN accounting across every stage boundary."""
+        total = TransferStats()
+        for st in self.stages:
+            total.count += st.transfer_stats.count
+            total.bytes += st.transfer_stats.bytes
+            total.seconds += st.transfer_stats.seconds
+        return total.summary()
+
+
+# ----------------------------------------------------------------- demo shape
+# The CI/smoke pipeline: a tiny per-stage MLP regression model shared by the
+# chaos-train bench, the tier-1 tests and the graftaudit lowering pass — small
+# enough that a 2-process simulation with replay runs in seconds on CPU, real
+# enough that every program in the label table above is exercised.
+
+def _demo_stage_params(key, width: int, is_last: bool) -> dict:
+    k1, k2, k3 = jax.random.split(key, 3)
+    params = {
+        "w1": jax.random.normal(k1, (width, width), jnp.float32) / np.sqrt(width),
+        "b1": jnp.zeros((width,), jnp.float32),
+        "w2": jax.random.normal(k2, (width, width), jnp.float32) / np.sqrt(width),
+        "b2": jnp.zeros((width,), jnp.float32),
+    }
+    if is_last:
+        params["wo"] = jax.random.normal(k3, (width, 1), jnp.float32) / np.sqrt(width)
+    return params
+
+
+def _demo_stage_fn(params, x):
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    return jnp.tanh(h @ params["w2"] + params["b2"])
+
+
+def _demo_loss_fn(params, x, targets):
+    h = _demo_stage_fn(params, x)
+    pred = (h @ params["wo"])[..., 0]
+    return jnp.mean((pred - targets) ** 2)
+
+
+def build_demo_stage(
+    stage_id: int,
+    n_stages: int = 2,
+    width: int = 8,
+    n_microbatches: int = 2,
+    seed: int = 0,
+    learning_rate: float = 1e-2,
+    faults=None,
+    telemetry=None,
+    compile_cache=None,
+) -> StageProcess:
+    """ONE demo stage — the ``stage_factory(stage_id)`` the gang-of-gangs
+    orchestrator rebuilds crashed gangs through. Init is a pure function of
+    ``(seed, stage_id)``, so a rebuilt stage process starts bitwise where a
+    fresh one would — which is what makes factory-rebuild + checkpoint-replay
+    converge to the undisturbed run."""
+    import optax
+
+    is_last = stage_id == n_stages - 1
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), stage_id)
+    return StageProcess(
+        stage_id, n_stages,
+        stage_fn=None if is_last else _demo_stage_fn,
+        loss_fn=_demo_loss_fn if is_last else None,
+        params=_demo_stage_params(key, width, is_last),
+        optimizer=optax.adamw(learning_rate),
+        n_microbatches=n_microbatches,
+        faults=faults,
+        telemetry=telemetry,
+        compile_cache=compile_cache,
+    )
+
+
+def build_demo_pipeline(
+    n_stages: int = 2,
+    width: int = 8,
+    n_microbatches: int = 2,
+    seed: int = 0,
+    learning_rate: float = 1e-2,
+    stage_faults=None,
+    telemetry=None,
+    compile_cache=None,
+) -> MPMDPipeline:
+    """The deterministic demo pipeline (every stage via
+    :func:`build_demo_stage`). ``stage_faults`` maps stage_id → its scoped
+    FaultPlan."""
+    if n_stages < 1:
+        raise ValueError(f"n_stages={n_stages} must be >= 1")
+    stages = [
+        build_demo_stage(
+            i, n_stages, width=width, n_microbatches=n_microbatches,
+            seed=seed, learning_rate=learning_rate,
+            faults=None if stage_faults is None else stage_faults.get(i),
+            telemetry=telemetry, compile_cache=compile_cache,
+        )
+        for i in range(n_stages)
+    ]
+    return MPMDPipeline(stages, telemetry=telemetry)
+
+
+def demo_data_fn(seed: int, n_microbatches: int, batch: int, width: int):
+    """``data_fn(step) -> (microbatches, targets)`` keyed by ``(seed, step)``
+    ONLY — the replay contract: a step re-executed after crash recovery sees
+    the identical batch, so the recovered run can be bitwise the undisturbed
+    one."""
+
+    def data_fn(step: int):
+        rng = np.random.default_rng([seed, step])
+        x = rng.standard_normal((n_microbatches, batch, width)).astype(np.float32)
+        t = rng.standard_normal((n_microbatches, batch)).astype(np.float32)
+        return x, t
+
+    return data_fn
+
+
+def lower_stage_programs(cache, n_stages: int = 2, width: int = 8,
+                         batch: int = 4, n_microbatches: int = 2) -> list:
+    """Route every demo-pipeline stage program through ``cache`` — the
+    graftaudit enumeration hook (a ``LowerOnlyCache`` traces+lowers each
+    ``mpmd.stage<i>.*`` label so the collective inventory can audit the
+    inter-stage transfer payload bytes alongside in-jit collective bytes).
+    Returns the per-program manifest entries."""
+    pipeline = build_demo_pipeline(
+        n_stages=n_stages, width=width, n_microbatches=n_microbatches,
+        compile_cache=cache,
+    )
+    x = np.zeros((batch, width), np.float32)
+    targets = np.zeros((batch,), np.float32)
+    entries = []
+    for st in pipeline.stages:
+        entries.extend(st.warm_programs(x, targets))
+    return entries

@@ -29,6 +29,7 @@ from .faults import (
     FaultSpec,
     InjectedFault,
     NonFiniteStepError,
+    StageCrashed,
     StepTimeout,
     StepWatchdog,
     parse_fault_spec,
@@ -41,6 +42,7 @@ __all__ = [
     "FaultSpec",
     "InjectedFault",
     "NonFiniteStepError",
+    "StageCrashed",
     "StepTimeout",
     "StepWatchdog",
     "parse_fault_spec",

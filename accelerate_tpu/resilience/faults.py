@@ -19,9 +19,13 @@ site                   instrumented in
 ``serving.prefill``    admission prefill (``error`` — always attributable to
                        the admitting request; ``crash`` as above)
 ``serving.kv_admit``   paged page-pool allocation (``error``)
-``train.step``         ``_TrainStep`` (kind ``nonfinite`` poisons the batch's
-                       float leaves with NaN — the REAL non-finite guard path,
-                       not a simulated exception)
+``train.step``         ``_TrainStep`` and the MPMD ``StageProcess`` (kind
+                       ``nonfinite`` poisons the batch's float leaves with NaN
+                       — the REAL non-finite guard path, not a simulated
+                       exception; ``crash`` = whole-gang death — raises
+                       :class:`StageCrashed` PAST the step boundary, the
+                       gang-of-gangs supervisor's restart signal, exactly as
+                       ``EngineCrashed`` is the fleet router's)
 ``ckpt.save``          ``save_accelerator_state`` (``crash`` raises before the
                        commit marker lands; ``corrupt`` flips bytes in a saved
                        file after the marker — caught by manifest verification
@@ -50,6 +54,7 @@ __all__ = [
     "FaultError",
     "InjectedFault",
     "EngineCrashed",
+    "StageCrashed",
     "StepTimeout",
     "NonFiniteStepError",
     "FaultSpec",
@@ -101,6 +106,28 @@ class EngineCrashed(FaultError):
         super().__init__(f"engine crashed at {site}")
         self.site = site
         self.kind = "crash"
+        self.uid = uid
+
+
+class StageCrashed(FaultError):
+    """A whole-training-gang (MPMD stage) death — the training analog of
+    :class:`EngineCrashed`.
+
+    The step boundary must NOT catch this: there is no process left to skip a
+    step in, so the crash propagates past ``train.step`` to whoever owns the
+    gang (the gang-of-gangs orchestrator, ``elastic.GangOfGangs``, which holds
+    the peer stages at a barrier, hands the corpse to the ``FleetSupervisor``
+    for a budgeted restart, and replays the pipeline from the last verified
+    checkpoint). ``gang_id`` is machine-readable — it names WHICH gang's
+    restart budget the failure charges. Injected via fault kind ``crash`` at
+    the ``train.step`` site."""
+
+    def __init__(self, site: str, gang_id: str = "gang0",
+                 uid: Optional[int] = None):
+        super().__init__(f"stage gang {gang_id} crashed at {site}")
+        self.site = site
+        self.kind = "crash"
+        self.gang_id = str(gang_id)
         self.uid = uid
 
 
@@ -176,14 +203,26 @@ class FaultPlan:
     invocation) so tests and the chaos bench can assert exactly which faults
     landed. Determinism: spec ``i`` owns the RNG stream ``(seed, i)`` and
     consumes one uniform per invocation of its site — whether it fires at the
-    site's n-th invocation is independent of every other site and spec."""
+    site's n-th invocation is independent of every other site and spec.
 
-    def __init__(self, specs: Sequence[FaultSpec], seed: int = 0):
+    ``scope`` keys the streams ``(seed, scope, i)`` instead — the stage-scoped
+    spelling for gang-of-gangs training: every MPMD stage process holds its OWN
+    plan built from the SAME seed and clause string but scoped by its
+    ``gang_id``, so which stage crashes at which step is a pure function of
+    ``(seed, gang_id)`` and never of how the stages interleave."""
+
+    def __init__(self, specs: Sequence[FaultSpec], seed: int = 0,
+                 scope: Optional[str] = None):
+        import zlib
+
         import numpy as np
 
         self.specs = list(specs)
         self.seed = int(seed)
-        self._rngs = [np.random.default_rng([self.seed, i])
+        self.scope = scope
+        scope_key = ([] if scope is None
+                     else [zlib.crc32(str(scope).encode("utf-8"))])
+        self._rngs = [np.random.default_rng([self.seed, *scope_key, i])
                       for i in range(len(self.specs))]
         self._site_counts: dict = {}
         self._fires_left = [
@@ -192,11 +231,14 @@ class FaultPlan:
         self.fired: List[dict] = []
 
     @classmethod
-    def from_spec(cls, spec: str, seed: int = 0) -> "FaultPlan":
+    def from_spec(cls, spec: str, seed: int = 0,
+                  scope: Optional[str] = None) -> "FaultPlan":
         """Build a plan from the compact ``ACCELERATE_FAULTS`` string form
-        (:func:`parse_fault_spec`)."""
+        (:func:`parse_fault_spec`). ``scope`` stage-scopes the RNG streams
+        (one plan per gang from one clause string)."""
         specs, parsed_seed = parse_fault_spec(spec)
-        return cls(specs, seed=parsed_seed if parsed_seed is not None else seed)
+        return cls(specs, seed=parsed_seed if parsed_seed is not None else seed,
+                   scope=scope)
 
     def draw(self, site: str, uids: Optional[Sequence[int]] = None,
              uid: Optional[int] = None) -> Optional[FaultSpec]:
@@ -252,6 +294,7 @@ class FaultPlan:
     def stats(self) -> dict:
         return {
             "seed": self.seed,
+            "scope": self.scope,
             "specs": len(self.specs),
             "fired": len(self.fired),
             "by_site": {
@@ -262,7 +305,8 @@ class FaultPlan:
         }
 
     def __repr__(self) -> str:
-        return (f"FaultPlan(seed={self.seed}, specs={len(self.specs)}, "
+        scope = f", scope={self.scope!r}" if self.scope is not None else ""
+        return (f"FaultPlan(seed={self.seed}{scope}, specs={len(self.specs)}, "
                 f"fired={len(self.fired)})")
 
 

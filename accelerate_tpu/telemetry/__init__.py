@@ -33,6 +33,8 @@ from .schemas import (
     AUDIT_PROGRAM_SCHEMA,
     FAULT_SCHEMA,
     FLEET_ROUTE_SCHEMA,
+    MPMD_BARRIER_SCHEMA,
+    MPMD_TRANSFER_SCHEMA,
     RECOVERY_SCHEMA,
     REPLICA_HEALTH_SCHEMA,
     SCHEMA_REGISTRY,
@@ -73,6 +75,8 @@ __all__ = [
     "AUDIT_PROGRAM_SCHEMA",
     "FAULT_SCHEMA",
     "FLEET_ROUTE_SCHEMA",
+    "MPMD_BARRIER_SCHEMA",
+    "MPMD_TRANSFER_SCHEMA",
     "RECOVERY_SCHEMA",
     "REPLICA_HEALTH_SCHEMA",
     "SCHEMA_REGISTRY",

@@ -38,6 +38,8 @@ __all__ = [
     "REPLICA_HEALTH_SCHEMA",
     "FLEET_ROUTE_SCHEMA",
     "ELASTIC_RESTART_SCHEMA",
+    "MPMD_TRANSFER_SCHEMA",
+    "MPMD_BARRIER_SCHEMA",
     "AUDIT_PROGRAM_SCHEMA",
     "TRACE_SPAN_SCHEMA",
     "FAULT_SCHEMA",
@@ -91,6 +93,20 @@ FLEET_ROUTE_SCHEMA = "accelerate_tpu.telemetry.fleet.route/v1"
 #: names WHICH gang, so one record stream can carry a whole fleet's restarts
 #: (``FleetSupervisor`` keeps independent per-gang budgets).
 ELASTIC_RESTART_SCHEMA = "accelerate_tpu.telemetry.elastic.restart/v1"
+
+#: One record per inter-stage DCN transfer in MPMD multi-slice training
+#: (``ops.collectives.stage_transfer``): which stage boundary the payload
+#: crossed (``src_stage``/``dst_stage``), the direction (``fwd`` activation /
+#: ``bwd`` cotangent), bytes and synchronously-measured latency, causally
+#: joined to the training step/microbatch.
+MPMD_TRANSFER_SCHEMA = "accelerate_tpu.telemetry.mpmd.transfer/v1"
+
+#: One record per gang-of-gangs barrier action (``elastic.GangOfGangs``): a
+#: healthy stage gang HOLDING at the recovery barrier while a crashed peer
+#: restarts, and its RELEASE when the pipeline replays — ``gang_id`` names the
+#: holding gang, ``peer`` the crashed one, ``action`` is ``hold``/``release``,
+#: ``step`` the global training step the pipeline held at.
+MPMD_BARRIER_SCHEMA = "accelerate_tpu.telemetry.mpmd.barrier/v1"
 
 #: One record per warmup-precompiled program: graftaudit collective inventory
 #: and donation effectiveness (``compile_cache.warmup``).
@@ -206,6 +222,19 @@ SCHEMA_REGISTRY: Dict[str, RecordSchema] = {
              "exit_codes"),
             "ElasticSupervisor / FleetSupervisor",
             "one record per gang restart (gang_id names which gang)",
+        ),
+        _reg(
+            MPMD_TRANSFER_SCHEMA,
+            ("src_stage", "dst_stage", "direction", "nbytes", "dur_s", "step",
+             "microbatch"),
+            "ops.collectives.stage_transfer",
+            "one inter-stage DCN transfer (activation fwd / cotangent bwd)",
+        ),
+        _reg(
+            MPMD_BARRIER_SCHEMA,
+            ("gang_id", "peer", "action", "step"),
+            "elastic.GangOfGangs",
+            "a healthy gang holding at / released from the recovery barrier",
         ),
         _reg(
             AUDIT_PROGRAM_SCHEMA,

@@ -897,6 +897,37 @@ def _run_paged_compare_row() -> int:
     return 0
 
 
+def _run_elastic_row() -> int:
+    """Elastic MPMD training chaos artifact (``BENCH_ELASTIC=1``): one
+    ``run_chaos_train`` pass — clean vs crash-injected gang-of-gangs training
+    on the CPU 2-process-mesh simulation — written to ``BENCH_ELASTIC.json``
+    (override with ``BENCH_ELASTIC_OUT``). Non-zero when any invariant (zero
+    lost/double-applied steps, bitwise recovery, budgeted restarts) fails."""
+    _os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from accelerate_tpu.commands.chaos_train import run_chaos_train
+
+    artifact = run_chaos_train(
+        steps=int(_os.environ.get("BENCH_ELASTIC_STEPS", "24")),
+        stages=int(_os.environ.get("BENCH_ELASTIC_STAGES", "2")),
+        crash_rate=float(_os.environ.get("BENCH_ELASTIC_CRASH_RATE", "0.12")),
+        seed=int(_os.environ.get("BENCH_ELASTIC_SEED", "0")),
+    )
+    out = _os.environ.get("BENCH_ELASTIC_OUT", "BENCH_ELASTIC.json")
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(json.dumps({
+        "metric": "train/elastic_chaos",
+        "stage_crashes": artifact["chaos"]["stage_crashes"],
+        "replayed_steps": artifact["chaos"]["replayed_steps"],
+        "restarts_by_gang": artifact["supervisor"]["restarts_by_gang"],
+        "invariants": artifact["invariants"],
+    }))
+    return 0 if all(artifact["invariants"].values()) else 1
+
+
 def main():
     import os
     import threading
@@ -910,6 +941,8 @@ def main():
     enable_compile_cache(_here)
 
     preset = os.environ.get("BENCH_PRESET")
+    if os.environ.get("BENCH_ELASTIC"):
+        return _run_elastic_row()
     if os.environ.get("BENCH_TRACE"):
         return _run_trace_curves_row()
     if os.environ.get("BENCH_PAGED"):

@@ -54,6 +54,7 @@ MODULES = [
     ("accelerate_tpu.parallel.fsdp", "FSDP / ZeRO sharding"),
     ("accelerate_tpu.parallel.tp", "Tensor parallelism"),
     ("accelerate_tpu.parallel.pp", "Pipeline parallelism"),
+    ("accelerate_tpu.parallel.mpmd", "MPMD multi-slice pipeline training"),
     ("accelerate_tpu.parallel.sequence", "Sequence parallelism"),
     ("accelerate_tpu.paged_kv", "Paged KV block manager"),
     ("accelerate_tpu.ops.flash_attention", "Flash attention"),
@@ -99,6 +100,7 @@ MODULES = [
     ("accelerate_tpu.serving_gateway.workload", "Workload traces & replay"),
     ("accelerate_tpu.commands.trace_report", "Trace report CLI"),
     ("accelerate_tpu.resilience.faults", "Fault injection & recovery primitives"),
+    ("accelerate_tpu.commands.chaos_train", "Elastic training chaos bench (chaos-train)"),
     ("accelerate_tpu.models.llama", "Llama family"),
     ("accelerate_tpu.models.lora", "LoRA fine-tuning"),
     ("accelerate_tpu.models.gpt", "GPT family"),

@@ -74,6 +74,16 @@ def test_default_enumeration_covers_the_warmup_surface(default_captures):
     assert {"serving.decode_paged", "serving.spec_verify_paged",
             "serving.insert_paged", "serving.gather_row_paged",
             "serving.copy_page"} <= labels, labels
+    # The MPMD stage-program surface (ISSUE 11): the alternative TRAINING
+    # layout is lowered alongside the SPMD step, and the inventory audits the
+    # inter-stage DCN payload bytes of every transfer-bearing program.
+    assert {"mpmd.stage0.fwd", "mpmd.stage0.bwd", "mpmd.stage1.loss_bwd",
+            "mpmd.stage0.apply", "mpmd.stage1.zero"} <= labels, labels
+    from accelerate_tpu.analysis.program.inventory import collective_inventory
+
+    for c in default_captures:
+        if c.label == "mpmd.stage0.fwd":
+            assert collective_inventory(c)["stage_transfer_bytes"] > 0
     # Every capture actually lowered: the StableHLO text parses a @main.
     for c in default_captures:
         assert "@main" in c.hlo_text, c.label

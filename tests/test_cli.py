@@ -306,9 +306,9 @@ def test_cli_help_lists_subcommands(capsys):
         parser.parse_args(["--help"])
     out = capsys.readouterr().out
     for sub in (
-        "audit", "config", "env", "estimate-memory", "launch", "lint",
-        "merge-weights", "serve-bench", "test", "tpu-config", "trace-report",
-        "warmup",
+        "audit", "chaos-train", "config", "env", "estimate-memory", "launch",
+        "lint", "merge-weights", "serve-bench", "test", "tpu-config",
+        "trace-report", "warmup",
     ):
         assert sub in out
 

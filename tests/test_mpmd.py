@@ -1,0 +1,510 @@
+"""Elastic MPMD multi-slice training (ISSUE 11, docs/resilience.md):
+independent per-stage programs over DCN-shaped transfers, gang-of-gangs crash
+recovery with verified-checkpoint replay, coordinated pipeline snapshots, and
+the chaos-train acceptance artifact."""
+
+import functools
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from accelerate_tpu.elastic import FleetSupervisor, GangOfGangs, WorkerFailure
+from accelerate_tpu.parallel.mpmd import (
+    MPMDPipeline,
+    StageProcess,
+    build_demo_pipeline,
+    build_demo_stage,
+    demo_data_fn,
+)
+from accelerate_tpu.resilience.faults import (
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    StageCrashed,
+)
+
+N_STAGES, MICRO, BATCH, WIDTH, SEED = 2, 2, 4, 8, 0
+
+
+def _data():
+    return demo_data_fn(SEED, MICRO, BATCH, WIDTH)
+
+
+def _pipeline(**kw):
+    return build_demo_pipeline(
+        n_stages=N_STAGES, width=WIDTH, n_microbatches=MICRO, seed=SEED, **kw
+    )
+
+
+def _bitwise_equal(a, b):
+    la, lb = jax.tree_util.tree_leaves(a), jax.tree_util.tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+class _VClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _telemetry():
+    from accelerate_tpu.telemetry import Telemetry
+    from accelerate_tpu.utils.dataclasses import TelemetryConfig
+
+    return Telemetry(TelemetryConfig(
+        enabled=True, compile_events=False, memory_stats=False
+    ))
+
+
+# ------------------------------------------------------------------ pipeline core
+def test_pipeline_trains_deterministically():
+    """Two identical MPMD runs are BITWISE identical — the property the whole
+    crash-recovery replay protocol is built on."""
+    data = _data()
+    runs = []
+    for _ in range(2):
+        pipe = _pipeline()
+        losses = [pipe.train_step(*data(s))["loss"] for s in range(4)]
+        runs.append((losses, pipe.state()))
+    assert runs[0][0] == runs[1][0]
+    assert _bitwise_equal(runs[0][1], runs[1][1])
+    assert all(np.isfinite(l) for l in runs[0][0])
+
+
+def test_stage_processes_are_independent_programs():
+    """Each stage owns its own mesh/sharding and its own program set — no
+    stage shares a jit with a peer (the MPMD contract pp.py cannot offer)."""
+    pipe = _pipeline()
+    st0, st1 = pipe.stages
+    assert st0.mesh is not st1.mesh
+    assert not st0.is_last and st1.is_last
+    assert hasattr(st0, "_fwd") and hasattr(st0, "_bwd")
+    assert hasattr(st1, "_loss_bwd") and not hasattr(st1, "_fwd")
+    assert st0.gang_id == "stage0" and st1.gang_id == "stage1"
+
+
+def test_transfer_stats_and_telemetry_records():
+    """Every inter-stage payload is byte/latency-accounted and emits a valid
+    mpmd.transfer/v1 record: M fwd + M bwd transfers per step per boundary."""
+    from accelerate_tpu.telemetry.schemas import (
+        MPMD_TRANSFER_SCHEMA,
+        validate_record,
+    )
+
+    tel = _telemetry()
+    pipe = _pipeline(telemetry=tel)
+    data = _data()
+    pipe.train_step(*data(0))
+    records = [r for r in tel.records if r.get("schema") == MPMD_TRANSFER_SCHEMA]
+    # One boundary (2 stages), MICRO fwd + MICRO bwd payloads.
+    assert len(records) == 2 * MICRO
+    for r in records:
+        assert validate_record(r) == []
+        assert r["nbytes"] == BATCH * WIDTH * 4  # f32 activation/cotangent
+    dirs = {r["direction"] for r in records}
+    assert dirs == {"fwd", "bwd"}
+    summary = pipe.transfer_summary()
+    assert summary["transfers"] == 2 * MICRO
+    assert summary["transfer_bytes"] == sum(r["nbytes"] for r in records)
+
+
+def test_pipeline_state_roundtrip_resumes_bitwise():
+    """Save at step k, restore into FRESH stage processes (the rebuild path),
+    run to N — bitwise equal to the undisturbed run at N."""
+    data = _data()
+    ref = _pipeline()
+    for s in range(5):
+        ref.train_step(*data(s))
+    half = _pipeline()
+    for s in range(2):
+        half.train_step(*data(s))
+    snap = half.state()
+    resumed = _pipeline()  # fresh processes, as after a gang restart
+    resumed.load_state(snap)
+    assert resumed.step == 2
+    for s in range(2, 5):
+        resumed.train_step(*data(s))
+    assert _bitwise_equal(resumed.state(), ref.state())
+
+
+def test_pipeline_validation():
+    with pytest.raises(ValueError, match="contiguous"):
+        MPMDPipeline([build_demo_stage(1, 2, width=WIDTH)])
+    with pytest.raises(ValueError, match="loss stage"):
+        MPMDPipeline([build_demo_stage(0, 2, width=WIDTH)])
+    with pytest.raises(ValueError, match="needs loss_fn"):
+        StageProcess(1, 2, params={})
+    with pytest.raises(ValueError, match="microbatches"):
+        pipe = _pipeline()
+        pipe.train_step(np.zeros((MICRO + 1, BATCH, WIDTH), np.float32),
+                        np.zeros((MICRO + 1, BATCH), np.float32))
+
+
+# ------------------------------------------------------------------ fault scoping
+def test_fault_plan_scope_keys_streams_by_gang():
+    """Stage-scoped clauses: same seed + same clause, different gang → a
+    DIFFERENT deterministic firing schedule; same (seed, gang) → identical."""
+    def draws(scope):
+        plan = FaultPlan([FaultSpec("train.step", "crash", prob=0.3)],
+                         seed=7, scope=scope)
+        return [plan.draw("train.step") is not None for _ in range(40)]
+
+    a, a2, b = draws("stage0"), draws("stage0"), draws("stage1")
+    assert a == a2
+    assert a != b
+    unscoped = FaultPlan([FaultSpec("train.step", "crash", prob=0.3)], seed=7)
+    assert unscoped.scope is None
+    assert "scope" in unscoped.stats()
+
+
+def test_stage_crash_raises_past_step_boundary():
+    """The crash kind at train.step raises StageCrashed with the machine-
+    readable gang_id — out of the stage, out of the pipeline step."""
+    plan = FaultPlan([FaultSpec("train.step", "crash")], seed=0, scope="stage1")
+    pipe = _pipeline(stage_faults={1: plan})
+    with pytest.raises(StageCrashed) as exc_info:
+        pipe.train_step(*_data()(0))
+    assert exc_info.value.gang_id == "stage1"
+    assert exc_info.value.kind == "crash"
+    assert plan.fired and plan.fired[0]["kind"] == "crash"
+
+
+def test_accelerator_train_step_crash_raises_stage_crashed():
+    """Satellite: the train.step crash kind on the SPMD Accelerator path too —
+    a training crash escapes the step boundary the way EngineCrashed escapes
+    serving, typed for the supervisor."""
+    import optax
+
+    from accelerate_tpu import Accelerator
+    from accelerate_tpu.data_loader import DataLoader
+    from accelerate_tpu.test_utils.training import (
+        RegressionDataset,
+        linear_regression_loss,
+        make_regression_state,
+    )
+
+    acc = Accelerator()
+    acc.fault_plan = FaultPlan(
+        [FaultSpec("train.step", "crash", start=1)], seed=0, scope="gangA"
+    )
+    try:
+        dl = acc.prepare(DataLoader(RegressionDataset(length=8), batch_size=4))
+        batches = list(dl)
+        state = acc.create_train_state(make_regression_state(), optax.sgd(0.1))
+        step = acc.build_train_step(linear_regression_loss)
+        state, _ = step(state, batches[0])  # window opens at invocation 1
+        with pytest.raises(StageCrashed) as exc_info:
+            step(state, batches[1])
+        assert exc_info.value.gang_id == "gangA"
+        assert exc_info.value.site == "train.step"
+    finally:
+        acc.fault_plan = None
+
+
+# ------------------------------------------------------------------ coordinated ckpts
+def test_pipeline_checkpoint_verify_and_partial_commit(tmp_path):
+    """A coordinated epoch is valid only when EVERY stage's marker landed —
+    one stage killed mid-save makes the whole epoch invalid, with problems
+    naming the torn stage."""
+    from accelerate_tpu.checkpointing import (
+        save_pipeline_checkpoint,
+        verify_checkpoint,
+    )
+
+    states = [{"w": np.arange(3.0)}, {"w": np.ones(2)}]
+    good = save_pipeline_checkpoint(tmp_path, 2, states)
+    assert verify_checkpoint(good) == []
+    plans = [None, FaultPlan([FaultSpec("ckpt.save", "crash")], seed=0)]
+    with pytest.raises(InjectedFault):
+        save_pipeline_checkpoint(tmp_path, 4, states, faults=plans)
+    problems = verify_checkpoint(tmp_path / "checkpoint_4")
+    assert problems and any("stage_1" in p for p in problems)
+    # stage_0 committed fine — the UNIT is still invalid.
+    assert verify_checkpoint(tmp_path / "checkpoint_4" / "stage_0") == []
+
+
+def test_midsave_crash_falls_back_to_consistent_epoch_on_all_stages(tmp_path):
+    """Satellite regression: kill one stage mid-save; the loader quarantines
+    the partial epoch AS A UNIT and restores the previous consistent epoch on
+    ALL stages — never a mix."""
+    from accelerate_tpu.checkpointing import (
+        load_pipeline_checkpoint,
+        save_pipeline_checkpoint,
+        select_pipeline_checkpoint,
+    )
+
+    epoch2 = [{"w": np.full(3, 2.0)}, {"v": np.full(2, 2.0)}]
+    epoch4 = [{"w": np.full(3, 4.0)}, {"v": np.full(2, 4.0)}]
+    save_pipeline_checkpoint(tmp_path, 2, epoch2)
+    plans = [None, FaultPlan([FaultSpec("ckpt.save", "crash")], seed=0)]
+    with pytest.raises(InjectedFault):
+        save_pipeline_checkpoint(tmp_path, 4, epoch4, faults=plans)
+    chosen = select_pipeline_checkpoint(tmp_path)
+    assert chosen.name == "checkpoint_2"
+    step, states = load_pipeline_checkpoint(chosen)
+    assert step == 2
+    assert _bitwise_equal(states, epoch2)  # BOTH stages from the same epoch
+    # The torn epoch left the checkpoint namespace entirely — as a unit.
+    assert not (tmp_path / "checkpoint_4").exists()
+    assert (tmp_path / "quarantined" / "checkpoint_4" / "stage_0").exists()
+
+
+def test_rotation_counts_only_fully_committed_epochs(tmp_path):
+    """Partial epochs neither count toward total_limit nor shield complete
+    ones; the newest fully-committed epoch is never deleted."""
+    from accelerate_tpu.checkpointing import (
+        rotate_pipeline_checkpoints,
+        save_pipeline_checkpoint,
+    )
+
+    states = [{"w": np.zeros(2)}, {"v": np.zeros(2)}]
+    save_pipeline_checkpoint(tmp_path, 1, states)
+    plans = [None, FaultPlan([FaultSpec("ckpt.save", "crash")], seed=0)]
+    with pytest.raises(InjectedFault):
+        save_pipeline_checkpoint(tmp_path, 2, states, faults=plans)
+    save_pipeline_checkpoint(tmp_path, 3, states)
+    rotate_pipeline_checkpoints(tmp_path, 2)
+    names = sorted(p.name for p in tmp_path.glob("checkpoint_*"))
+    # Both committed epochs fit the limit; the torn epoch_2 didn't count.
+    assert names == ["checkpoint_1", "checkpoint_2", "checkpoint_3"]
+    rotate_pipeline_checkpoints(tmp_path, 1)
+    names = sorted(p.name for p in tmp_path.glob("checkpoint_*"))
+    assert "checkpoint_3" in names and "checkpoint_1" not in names
+
+
+# ------------------------------------------------------------------ gang-of-gangs
+def _gang_of_gangs(tmp_path, arm, plans=None, supervisor=None, clock=None,
+                   telemetry=None, checkpoint_every=3):
+    def factory(i):
+        return build_demo_stage(
+            i, n_stages=N_STAGES, width=WIDTH, n_microbatches=MICRO,
+            seed=SEED, faults=None if plans is None else plans.get(i),
+        )
+
+    clock = clock or _VClock()
+    return GangOfGangs(
+        factory, N_STAGES, checkpoint_dir=str(tmp_path / arm),
+        supervisor=supervisor, checkpoint_every=checkpoint_every,
+        telemetry=telemetry, clock=clock, sleep=clock.advance,
+    )
+
+
+def test_restart_replay_determinism(tmp_path):
+    """Satellite: injected crash at step k on a 2-process CPU mesh — the
+    recovered run's params/opt state are BITWISE equal to the undisturbed run
+    at step N, zero steps lost or double-applied, and the elastic.restart/v1
+    records carry the correct gang_id/attempt sequence."""
+    from accelerate_tpu.telemetry import ELASTIC_RESTART_SCHEMA
+    from accelerate_tpu.telemetry.schemas import validate_record
+
+    N = 8
+    data = _data()
+    clean = _gang_of_gangs(tmp_path, "clean")
+    clean_summary = clean.run(data, N)
+    assert clean_summary["ledger"] == list(range(N))
+    assert clean_summary["stage_crashes"] == 0
+
+    # Crash stage 0 exactly at its 5th step-attempt (step index 4).
+    tel = _telemetry()
+    plans = {0: FaultPlan(
+        [FaultSpec("train.step", "crash", start=4, max_fires=1)],
+        seed=SEED, scope="stage0",
+    )}
+    vclock = _VClock()
+    sup = FleetSupervisor(max_restarts=2, restart_backoff=1.0,
+                          telemetry=tel, clock=vclock)
+    chaos = _gang_of_gangs(tmp_path, "chaos", plans=plans, supervisor=sup,
+                           clock=vclock, telemetry=tel)
+    summary = chaos.run(data, N)
+    assert summary["stage_crashes"] == 1
+    assert summary["restarts"] == {"stage0": 1}
+    assert summary["ledger"] == list(range(N))
+    assert summary["lost_steps"] == [] and summary["double_applied_steps"] == []
+    # Crash at step 4, checkpoint_every=3 → replay from step 3: one step redone.
+    assert summary["replayed_steps"] == 1
+    assert summary["backoff_s"] == 1.0  # base × 2^0 on the virtual clock
+    assert summary["losses"] == clean_summary["losses"]
+    assert _bitwise_equal(chaos.pipeline.state(), clean.pipeline.state())
+
+    restarts = [r for r in tel.records
+                if r.get("schema") == ELASTIC_RESTART_SCHEMA]
+    assert len(restarts) == 1
+    assert validate_record(restarts[0]) == []
+    assert restarts[0]["gang_id"] == "stage0"
+    assert restarts[0]["attempt"] == 0 and restarts[0]["final"] is False
+
+
+def test_barrier_records_hold_and_release_peers(tmp_path):
+    """While the crashed gang restarts, every HEALTHY gang emits a hold record
+    at the barrier and a release once the pipeline replays."""
+    from accelerate_tpu.telemetry.schemas import (
+        MPMD_BARRIER_SCHEMA,
+        validate_record,
+    )
+
+    tel = _telemetry()
+    plans = {1: FaultPlan(
+        [FaultSpec("train.step", "crash", start=2, max_fires=1)],
+        seed=SEED, scope="stage1",
+    )}
+    gog = _gang_of_gangs(tmp_path, "chaos", plans=plans, telemetry=tel)
+    summary = gog.run(_data(), 5)
+    assert summary["barrier_holds"] == N_STAGES - 1
+    barriers = [r for r in tel.records
+                if r.get("schema") == MPMD_BARRIER_SCHEMA]
+    assert [r["action"] for r in barriers] == ["hold", "release"]
+    for r in barriers:
+        assert validate_record(r) == []
+        assert r["gang_id"] == "stage0" and r["peer"] == "stage1"
+
+
+def test_budget_exhaustion_raises_worker_failure(tmp_path):
+    """A gang crashing past its INDEPENDENT FleetSupervisor budget tears the
+    job down with WorkerFailure; the terminal record is flagged final."""
+    from accelerate_tpu.telemetry import ELASTIC_RESTART_SCHEMA
+
+    tel = _telemetry()
+    plans = {0: FaultPlan(
+        [FaultSpec("train.step", "crash", prob=1.0)], seed=SEED, scope="stage0",
+    )}
+    sup = FleetSupervisor(max_restarts=1, telemetry=tel)
+    gog = _gang_of_gangs(tmp_path, "chaos", plans=plans, supervisor=sup)
+    with pytest.raises(WorkerFailure, match="stage0 exhausted"):
+        gog.run(_data(), 6)
+    records = [r for r in tel.records
+               if r.get("schema") == ELASTIC_RESTART_SCHEMA]
+    assert [r["attempt"] for r in records] == [0, 1]
+    assert records[-1]["final"] is True
+    assert gog.summary(6)["restarts"] == {"stage0": 2}
+
+
+def test_torn_periodic_save_never_replayed(tmp_path):
+    """A mid-save stage death during a PERIODIC snapshot leaves a torn epoch:
+    training continues, and a later crash replays from the previous consistent
+    epoch — still bitwise identical to the clean run."""
+    N = 8
+    data = _data()
+    clean = _gang_of_gangs(tmp_path, "clean")
+    clean.run(data, N)
+
+    # ckpt.save fires at the step-6 periodic save (draw #0 is the step-0
+    # baseline, draw #1 the step-3 save, draw #2 the step-6 save — stage 1
+    # tears exactly that one), then train.step crashes stage 0 at step 7.
+    plans = {
+        0: FaultPlan([FaultSpec("train.step", "crash", start=7, max_fires=1)],
+                     seed=SEED, scope="stage0"),
+        1: FaultPlan([FaultSpec("ckpt.save", "crash", start=2, max_fires=1)],
+                     seed=SEED, scope="stage1"),
+    }
+    gog = _gang_of_gangs(tmp_path, "chaos", plans=plans)
+    summary = gog.run(data, N)
+    assert summary["torn_saves"] == 1
+    assert summary["stage_crashes"] == 1
+    # Fallback skipped the torn step-6 epoch → replayed from step 3.
+    assert summary["replayed_steps"] == 7 - 3
+    assert summary["ledger"] == list(range(N))
+    assert summary["losses"] == clean.losses
+    assert _bitwise_equal(gog.pipeline.state(), clean.pipeline.state())
+
+
+# ------------------------------------------------------------------ chaos-train bench
+def test_chaos_train_artifact():
+    """The acceptance artifact: seeded stage crashes over a full gang-of-gangs
+    run — zero lost/double-applied steps, bitwise recovery, restart accounting
+    matching the supervisor budget, all stamped with provenance."""
+    from accelerate_tpu.commands.chaos_train import run_chaos_train
+
+    artifact = run_chaos_train(steps=10, crash_rate=0.2, checkpoint_every=3,
+                               seed=0)
+    assert artifact["schema"] == "accelerate_tpu.bench.elastic/v1"
+    inv = artifact["invariants"]
+    assert all(inv.values()), inv
+    assert artifact["chaos"]["stage_crashes"] >= 1
+    assert artifact["chaos"]["replayed_steps"] >= 1
+    assert artifact["chaos"]["applied_steps"] == artifact["steps"]
+    fired = artifact["fault_plan"]["fired_by_gang"]
+    assert sum(fired.values()) == artifact["chaos"]["stage_crashes"]
+    assert artifact["clean"]["stage_crashes"] == 0
+    assert artifact["chaos"]["transfer"]["transfer_bytes"] > 0
+    assert artifact["provenance"]
+
+
+def test_chaos_train_cli_smoke(tmp_path):
+    """chaos-train --smoke is a tier-1 gate beside the serving chaos smokes."""
+    out = tmp_path / "BENCH_ELASTIC.json"
+    result = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu", "chaos-train",
+         "--out", str(out), "--smoke", "--seed", "0"],
+        capture_output=True, text=True, timeout=600,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    artifact = json.loads(out.read_text())
+    assert all(artifact["invariants"].values()), artifact["invariants"]
+    assert artifact["chaos"]["stage_crashes"] >= 1
+    summary = json.loads(result.stdout.strip().splitlines()[-1])
+    assert summary["schema"] == "accelerate_tpu.bench.elastic/v1"
+
+
+def test_chaos_train_validation():
+    from accelerate_tpu.commands.chaos_train import run_chaos_train
+
+    with pytest.raises(ValueError, match="crash_rate"):
+        run_chaos_train(crash_rate=0.0)
+    with pytest.raises(ValueError, match="steps"):
+        run_chaos_train(steps=0)
+
+
+# ------------------------------------------------------------------ schemas/audit
+def test_new_schemas_registered():
+    from accelerate_tpu.telemetry.schemas import (
+        MPMD_BARRIER_SCHEMA,
+        MPMD_TRANSFER_SCHEMA,
+        SCHEMA_REGISTRY,
+        validate_record,
+    )
+
+    assert MPMD_TRANSFER_SCHEMA in SCHEMA_REGISTRY
+    assert MPMD_BARRIER_SCHEMA in SCHEMA_REGISTRY
+    assert validate_record({
+        "schema": MPMD_TRANSFER_SCHEMA, "src_stage": 0, "dst_stage": 1,
+        "direction": "fwd", "nbytes": 128, "dur_s": 0.0, "step": 0,
+        "microbatch": 0,
+    }) == []
+    assert validate_record({
+        "schema": MPMD_BARRIER_SCHEMA, "gang_id": "stage0", "peer": "stage1",
+        "action": "hold", "step": 3,
+    }) == []
+
+
+def test_stage_transfer_bytes_audited():
+    """graftaudit's inventory audits the DCN payload of every MPMD stage
+    program from its lowered jaxpr — fwd activations and bwd cotangents carry
+    bytes, stage-local programs carry zero, non-MPMD programs None."""
+    from accelerate_tpu.analysis.program.inventory import collective_inventory
+    from accelerate_tpu.analysis.program.lowering import LowerOnlyCache
+    from accelerate_tpu.parallel.mpmd import lower_stage_programs
+
+    cache = LowerOnlyCache()
+    entries = lower_stage_programs(cache)
+    assert all(e["status"] == "lowered" for e in entries), entries
+    by_label = {c.label: collective_inventory(c) for c in cache.capture}
+    payload = BATCH * WIDTH * 4
+    assert by_label["mpmd.stage0.fwd"]["stage_transfer_bytes"] == payload
+    assert by_label["mpmd.stage0.bwd"]["stage_transfer_bytes"] == payload
+    assert by_label["mpmd.stage1.loss_bwd"]["stage_transfer_bytes"] == payload
+    assert by_label["mpmd.stage0.apply"]["stage_transfer_bytes"] == 0
+    assert by_label["mpmd.stage0.zero"]["stage_transfer_bytes"] == 0
