@@ -1578,6 +1578,14 @@ class Accelerator:
             return engine
         kwargs = {} if clock is None else {"clock": clock}
         if is_fleet:
+            if config.replica_roles is not None:
+                # Role-split fleet (docs/disaggregated_serving.md): prefill
+                # replicas export KV page handoffs, decode replicas adopt them.
+                from .serving_gateway import DisaggRouter
+
+                return DisaggRouter(list(engine), config,
+                                    telemetry=self.telemetry, tracer=tracer,
+                                    engine_factory=engine_factory, **kwargs)
             from .serving_gateway import FleetRouter
 
             return FleetRouter(list(engine), config, telemetry=self.telemetry,

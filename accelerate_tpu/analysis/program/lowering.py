@@ -20,7 +20,8 @@ from ...compile_cache.cache import AotCache
 from .capture import ProgramCapture
 
 __all__ = ["LowerOnlyCache", "capture_default_programs", "DEFAULT_AUDIT_GEOMETRY",
-           "PAGED_AUDIT_GEOMETRY", "MPMD_AUDIT_GEOMETRY"]
+           "PAGED_AUDIT_GEOMETRY", "DISAGG_AUDIT_GEOMETRY",
+           "MPMD_AUDIT_GEOMETRY"]
 
 #: The geometry ``audit`` lowers when none is given: the warmup CLI's default
 #: config with eval and serving enabled — including the speculative-decoding
@@ -57,6 +58,25 @@ PAGED_AUDIT_GEOMETRY = dict(
     spec_draft="ngram",
     page_size=24,
     prefix_cache=2,
+)
+
+#: Disaggregated-serving passes: the role-sliced replica surfaces
+#: (docs/disaggregated_serving.md) — a prefill-role engine's programs (prefill
+#: buckets/chunk, dynamic-slot page scatter, the handoff page-export gather)
+#: and a decode-role engine's (block-table decode/verify, handoff page import,
+#: COW boundary copy, lane-valid setup — NO prefill programs, by construction:
+#: the audit proves the decode-only surface really is smaller). One
+#: ``run_warmup(role=...)`` per role, page geometry shared with the paged pass.
+DISAGG_AUDIT_GEOMETRY = dict(
+    preset="smoke",
+    batch_size=8,
+    seq_len=128,
+    train=False,
+    eval_step=False,
+    serve=True,
+    max_slots=4,
+    max_new_tokens=32,
+    page_size=24,
 )
 
 #: Third pass: the MPMD stage-program surface (``parallel/mpmd.py`` demo
@@ -135,6 +155,12 @@ def capture_default_programs(**overrides) -> List[ProgramCapture]:
                             "max_len", "max_new_tokens")}
         run_warmup(cache=cache, emit_manifest=False,
                    **{**PAGED_AUDIT_GEOMETRY, **inherit})
+        # The disagg role slices (prefill-role export surface, decode-role
+        # import/adopt surface) ride the same ratchet: role replicas are
+        # alternative SERVING layouts the way paged is.
+        for role in ("prefill", "decode"):
+            run_warmup(cache=cache, emit_manifest=False,
+                       **{**DISAGG_AUDIT_GEOMETRY, **inherit, "role": role})
     if geometry.get("train"):
         from ...parallel.mpmd import lower_stage_programs
 

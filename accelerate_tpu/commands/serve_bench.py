@@ -22,7 +22,8 @@ import argparse
 from ..spec_decode import DraftSource
 
 __all__ = ["run_serve_bench", "run_chaos_bench", "run_fleet_chaos_bench",
-           "serve_bench_command", "serve_bench_command_parser"]
+           "run_disagg_bench", "serve_bench_command",
+           "serve_bench_command_parser"]
 
 #: Policy rows a plain run emits, in order.
 ALL_POLICIES = ("fifo", "priority", "edf", "wfq")
@@ -112,9 +113,11 @@ def serve_bench_command_parser(subparsers=None) -> argparse.ArgumentParser:
                         help="with --trace-gen: write the generated trace JSONL "
                              "to FILE and exit (replay it later with "
                              "--workload-trace)")
-    parser.add_argument("--load", type=float, default=1.0,
-                        help="offered-load factor for trace replay (arrivals "
-                             "time-compressed by this factor)")
+    parser.add_argument("--load", type=float, default=None,
+                        help="offered-load factor (arrivals time-compressed/"
+                             "paced by this factor); default 1.0 for trace "
+                             "replay and chaos, 2.0 for --disagg (the >=2x "
+                             "overload acceptance geometry)")
     parser.add_argument("--trace-curves", default=None, metavar="OUT_JSON",
                         help="run the SLO-attainment-vs-offered-load sweep "
                              "(generators x policies x loads) and write the "
@@ -149,12 +152,26 @@ def serve_bench_command_parser(subparsers=None) -> argparse.ArgumentParser:
                         help="per-decode-dispatch replica crash probability "
                              "for --fleet --chaos (each replica draws from "
                              "its own seeded stream)")
-    parser.add_argument("--kills-per-replica", type=int, default=2,
-                        help="fire budget of each replica's crash clause "
-                             "(--fleet --chaos)")
+    parser.add_argument("--kills-per-replica", type=int, default=None,
+                        help="fire budget of each replica's crash clause; "
+                             "default 2 for --fleet --chaos, 1 for the "
+                             "--disagg chaos arm")
     parser.add_argument("--loads", default="0.5,1.0,2.0,4.0",
                         help="comma-separated offered-load sweep for "
                              "--trace-curves")
+    parser.add_argument("--disagg", default=None, metavar="P:D",
+                        help="run the disaggregated prefill/decode proof: P "
+                             "prefill + D decode replicas behind the "
+                             "DisaggRouter vs a same-chip (P+D)-replica MIXED "
+                             "fleet at --load offered load, plus a chaos arm "
+                             "(replica crash clauses) — write BENCH_DISAGG."
+                             "json to --disagg-out. Exit non-zero on any "
+                             "silently-lost request or stream mismatch (full "
+                             "runs also gate the decode-stall / TTFT "
+                             "improvements)")
+    parser.add_argument("--disagg-out", default="BENCH_DISAGG.json",
+                        metavar="OUT_JSON",
+                        help="artifact path for --disagg")
     if subparsers is not None:
         parser.set_defaults(func=serve_bench_command)
     return parser
@@ -1032,6 +1049,370 @@ def run_fleet_chaos_bench(
     }
 
 
+class _EngineMeter:
+    """Per-replica busy/stall accounting for the disagg bench, measured where
+    the claim lives: inside ONE replica's own host loop. ``stall_lane_s`` is
+    decode-lane-seconds held while THIS replica's host loop ran admission work
+    (prefill on a mixed replica, handoff adoption on a decode replica) — the
+    ROADMAP stall the disaggregation exists to remove; ``decode_lane_s`` is
+    lane-seconds inside actual decode dispatches. Cross-replica serialization
+    (a single-process simulation artifact — real replicas run in parallel) is
+    excluded by construction."""
+
+    def __init__(self, engine):
+        import time
+
+        self.engine = engine
+        self.admit_busy_s = 0.0   # prefill / adoption host+device work
+        self.decode_busy_s = 0.0  # decode/verify dispatch work
+        self.stall_lane_s = 0.0   # active-lane-seconds held during admissions
+        self.decode_lane_s = 0.0  # active-lane-seconds inside decode dispatches
+
+        def lanes():
+            return sum(r is not None for r in engine.slot_req)
+
+        def wrap(name, lane_kind):
+            orig = getattr(engine, name)
+
+            def timed(*args, **kwargs):
+                held = lanes()
+                t0 = time.perf_counter()
+                out = orig(*args, **kwargs)
+                dt = time.perf_counter() - t0
+                if lane_kind == "admit":
+                    self.admit_busy_s += dt
+                    self.stall_lane_s += held * dt
+                else:
+                    self.decode_busy_s += dt
+                    self.decode_lane_s += held * dt
+                return out
+
+            setattr(engine, name, timed)
+
+        wrap("_admit", "admit")
+        if getattr(engine, "role", "mixed") != "prefill":
+            wrap("_plain_step", "decode")
+            wrap("_spec_step", "decode")
+        if hasattr(engine, "adopt_handoff"):
+            wrap("adopt_handoff", "admit")
+
+    def row(self) -> dict:
+        eng = self.engine
+        busy = self.admit_busy_s + self.decode_busy_s
+        lane_total = self.stall_lane_s + self.decode_lane_s
+        return {
+            "role": getattr(eng, "role", "mixed"),
+            "admit_busy_s": round(self.admit_busy_s, 4),
+            "decode_busy_s": round(self.decode_busy_s, 4),
+            "stall_lane_s": round(self.stall_lane_s, 4),
+            "decode_lane_s": round(self.decode_lane_s, 4),
+            "stall_share": (
+                round(self.stall_lane_s / lane_total, 4) if lane_total else None
+            ),
+            "decode_tokens": eng.decode_tokens,
+            "decode_tokens_per_busy_s": (
+                round(eng.decode_tokens / busy, 1) if busy > 0 else None
+            ),
+        }
+
+
+def _disagg_stall_share(meters, decode_only: bool) -> float:
+    """Arm-level decode-lane stall share: lane-seconds held during the owning
+    replica's admission work over total lane-seconds, summed over the replicas
+    that HOLD decode lanes (all of a mixed fleet; the decode-capable side of a
+    disagg fleet)."""
+    picked = [m for m in meters
+              if not decode_only or getattr(m.engine, "role", "mixed") != "prefill"]
+    stall = sum(m.stall_lane_s for m in picked)
+    lane = sum(m.stall_lane_s + m.decode_lane_s for m in picked)
+    return round(stall / lane, 4) if lane > 0 else 0.0
+
+
+def run_disagg_bench(
+    prefill_replicas: int = 1,
+    decode_replicas: int = 2,
+    preset: str = "smoke",
+    requests: int = 48,
+    max_slots: int = 4,
+    max_len: int = 128,
+    prompt_bucket: int = 16,
+    max_new: int = 16,
+    load: float = 2.0,
+    seed: int = 0,
+    page_size: int = 8,
+    kv_pages=None,
+    kill_rate: float = 0.08,
+    kills_per_replica: int = 1,
+    telemetry=None,
+) -> dict:
+    """The disaggregation proof (BENCH_DISAGG.json): replay ONE deterministic
+    arrival schedule three ways —
+
+    1. **mixed**: a ``FleetRouter`` over P+D mixed replicas (every replica
+       pays prefill AND decode on the same lanes — the PR-10 fleet);
+    2. **disagg**: a ``DisaggRouter`` over P prefill + D decode replicas of
+       the SAME per-replica geometry (same chips, roles split);
+    3. **disagg_chaos**: the disagg fleet with seeded crash clauses on both
+       roles (prefill dies mid-handoff → re-prefill on restart; decode dies
+       mid-decode → re-adoption from the still-refcounted source pages).
+
+    Latencies are wall-clock (prefill genuinely blocks, which is the whole
+    point); arrivals are paced per router step at ``load ×`` the mixed fleet's
+    steady-state completion rate, so ``load=2.0`` is sustained 2× overload.
+    Stamps: decode-replica STALL share (lane-seconds held during the owning
+    replica's admission work — the per-replica measure, so single-process
+    serialization across replicas doesn't pollute it) vs the mixed fleet's,
+    TTFT p50/p95, decode tokens per replica-busy-second, handoff count/bytes/
+    latency, per-role trace-report breakdown, stream byte-parity disagg vs
+    mixed, and zero silently-lost requests under chaos."""
+    import time
+
+    from ..compile_cache.warmup import build_model_config
+    from ..models import llama
+    from ..resilience.faults import FaultPlan, FaultSpec
+    from ..serving import ContinuousBatcher
+    from ..serving_gateway import DisaggRouter, FleetRouter
+    from ..telemetry.provenance import provenance_stamp
+    from ..telemetry.slo import latency_summary
+    from ..telemetry.tracing import Tracer
+    from ..utils.dataclasses import GatewayConfig
+    from .trace_report import trace_report
+
+    import numpy as np
+
+    if prefill_replicas < 1 or decode_replicas < 1:
+        raise ValueError("--disagg needs at least 1 prefill and 1 decode replica")
+    if page_size < 1:
+        raise ValueError(f"page_size={page_size} must be >= 1 (handoffs are pages)")
+    cfg = build_model_config(preset, max_len)
+    params = llama.init_params(cfg)
+    n_total = prefill_replicas + decode_replicas
+    total_lanes = n_total * max_slots
+    roles = ["prefill"] * prefill_replicas + ["decode"] * decode_replicas
+    prov = provenance_stamp(cfg)
+
+    rng = np.random.default_rng(seed)
+    # Mixed lengths including multi-chunk prompts: prefill cost must be real
+    # for the stall/TTFT comparison to mean anything.
+    prompts = [
+        rng.integers(1, cfg.vocab_size, int(n)).astype(np.int32)
+        for n in rng.integers(3, 2 * prompt_bucket + 1, requests)
+    ]
+    # Offered load: the mixed fleet completes ~total_lanes/max_new requests
+    # per router step at full occupancy; load multiplies that arrival rate.
+    arrivals_per_step = load * total_lanes / max_new
+
+    def build(role, rid=0, plan=None):
+        return ContinuousBatcher(
+            params, cfg, max_slots=max_slots, max_len=max_len,
+            prompt_bucket=prompt_bucket, page_size=page_size,
+            kv_pages=kv_pages, role=role, faults=plan,
+        )
+
+    def stream_capture():
+        streams = {}
+
+        def factory(i):
+            streams[i] = []
+
+            def on_token(tok, i=i):
+                streams[i].append(int(tok))
+
+            def on_retry(i=i):
+                streams[i].clear()
+
+            return on_token, on_retry
+
+        return streams, factory
+
+    def replay(router, meters, factory):
+        greqs = []
+        i = 0
+        due = 0.0
+        guard = 0
+        t0 = time.perf_counter()
+        while i < len(prompts) or router.queue_depth or router.running_count:
+            if i < len(prompts):
+                due += arrivals_per_step
+                while due >= 1.0 and i < len(prompts):
+                    on_token, on_retry = factory(i)
+                    greqs.append(router.submit(
+                        prompts[i], max_new_tokens=max_new,
+                        on_token=on_token, on_retry=on_retry,
+                    ))
+                    due -= 1.0
+                    i += 1
+            router.step()
+            guard += 1
+            if guard > 500 * max(1, len(prompts)):
+                raise RuntimeError("disagg bench replay stalled")
+        return greqs, time.perf_counter() - t0
+
+    def arm_row(router, greqs, meters, spans, wall_s, decode_only: bool) -> dict:
+        done = [g for g in greqs if g.status == "done"]
+        counters = router.counters
+        row = {
+            "submitted": len(greqs),
+            "terminal": sum(1 for g in greqs if g.terminal),
+            "silently_lost": len(greqs) - sum(1 for g in greqs if g.terminal),
+            "done": counters["done"],
+            "failed": counters["failed"],
+            "wall_s": round(wall_s, 3),
+            "ttft": latency_summary([g.ttft_s for g in done]),
+            "tpot": latency_summary([g.tpot_s for g in done]),
+            "queue_wait": latency_summary([g.queue_wait_s for g in done]),
+            "decode_stall_share": _disagg_stall_share(meters, decode_only),
+            "decode_tokens_per_busy_s": (lambda picked: (
+                round(sum(m.engine.decode_tokens for m in picked)
+                      / max(1e-9, sum(m.admit_busy_s + m.decode_busy_s
+                                      for m in picked)), 1)
+            ))([m for m in meters
+                if not decode_only
+                or getattr(m.engine, "role", "mixed") != "prefill"]),
+            "replicas": [m.row() for m in meters],
+        }
+        if hasattr(router, "transfer_stats"):
+            row["handoffs"] = counters.get("handoffs", 0)
+            row["readopted"] = counters.get("readopted", 0)
+            row["migrated"] = counters.get("migrated", 0)
+            row["handoff_transfer"] = router.transfer_stats.summary()
+        if spans:
+            report = trace_report(spans)
+            row["trace"] = {k: report[k] for k in
+                            ("critical_path_share", "stall_by_role",
+                             "by_status")}
+        return row
+
+    gw_cfg = dict(enabled=True, policy="fifo", max_queue=0)
+
+    # Warm every program surface (mixed + both role slices + the handoff
+    # export/import pair) so no timed arm pays XLA compiles.
+    warm = DisaggRouter(
+        [build("prefill"), build("decode")],
+        GatewayConfig(**gw_cfg), roles=["prefill", "decode"],
+    )
+    for p in prompts[:4]:
+        warm.submit(p, max_new_tokens=2)
+    warm.run()
+    warm_mixed = build("mixed")
+    for p in prompts[:2]:
+        warm_mixed.submit(p, max_new_tokens=2)
+    warm_mixed.run()
+
+    # ---- arm 1: mixed fleet (same chips, no roles)
+    mixed_engines = [build("mixed") for _ in range(n_total)]
+    mixed_meters = [_EngineMeter(e) for e in mixed_engines]
+    mixed_spans: list = []
+    mixed_router = FleetRouter(
+        mixed_engines, GatewayConfig(**gw_cfg), telemetry=telemetry,
+        tracer=Tracer(sink=mixed_spans.append),
+    )
+    mixed_streams, mixed_factory = stream_capture()
+    mixed_greqs, mixed_wall = replay(mixed_router, mixed_meters, mixed_factory)
+
+    # ---- arm 2: disaggregated fleet
+    dis_engines = [build(r) for r in roles]
+    dis_meters = [_EngineMeter(e) for e in dis_engines]
+    dis_spans: list = []
+    dis_router = DisaggRouter(
+        dis_engines, GatewayConfig(**gw_cfg), telemetry=telemetry,
+        tracer=Tracer(sink=dis_spans.append), roles=roles,
+    )
+    dis_streams, dis_factory = stream_capture()
+    dis_greqs, dis_wall = replay(dis_router, dis_meters, dis_factory)
+
+    # ---- arm 3: disagg chaos (both roles crash mid-flight; restarts keep plans)
+    def kill_plan(rid):
+        site = "serving.prefill" if roles[rid] == "prefill" else "serving.decode"
+        return FaultPlan(
+            [FaultSpec(site, "crash", prob=kill_rate,
+                       max_fires=kills_per_replica)],
+            seed=seed * 6271 + rid + 1,
+        )
+
+    plans = [kill_plan(rid) for rid in range(n_total)]
+    chaos_engines = [build(roles[rid], plan=plans[rid])
+                     for rid in range(n_total)]
+    chaos_meters = [_EngineMeter(e) for e in chaos_engines]
+
+    def chaos_factory(rid, role):
+        # Restarted replicas get a fresh engine AND a fresh meter: the dead
+        # engine's meter keeps its pre-crash work, the replacement's work is
+        # measured too — the arm row aggregates both, so replica kills never
+        # silently undercount busy/stall time.
+        eng = build(role, plan=plans[rid])
+        chaos_meters.append(_EngineMeter(eng))
+        return eng
+
+    chaos_router = DisaggRouter(
+        chaos_engines,
+        GatewayConfig(**gw_cfg, replica_restarts=4),
+        telemetry=telemetry, roles=roles,
+        engine_factory=chaos_factory,
+    )
+    chaos_streams, chaos_stream_factory = stream_capture()
+    chaos_greqs, chaos_wall = replay(chaos_router, chaos_meters,
+                                     chaos_stream_factory)
+
+    def parity(a_streams, a_greqs, b_streams, b_greqs):
+        compared = mismatched = 0
+        for i in range(len(prompts)):
+            if a_greqs[i].status == "done" and b_greqs[i].status == "done":
+                compared += 1
+                if a_streams.get(i) != b_streams.get(i):
+                    mismatched += 1
+        return compared, mismatched
+
+    cmp_md, mm_md = parity(mixed_streams, mixed_greqs, dis_streams, dis_greqs)
+    cmp_dc, mm_dc = parity(dis_streams, dis_greqs, chaos_streams, chaos_greqs)
+
+    mixed_arm = arm_row(mixed_router, mixed_greqs, mixed_meters, mixed_spans,
+                        mixed_wall, decode_only=False)
+    dis_arm = arm_row(dis_router, dis_greqs, dis_meters, dis_spans, dis_wall,
+                      decode_only=True)
+    chaos_arm = arm_row(chaos_router, chaos_greqs, chaos_meters, None,
+                        chaos_wall, decode_only=True)
+    chaos_arm["replica_kills"] = chaos_router.counters["replica_kills"]
+    chaos_arm["replica_restarts"] = chaos_router.counters["replica_restarts"]
+    chaos_arm["fault_fires"] = sum(len(p.fired) for p in plans)
+
+    p95_mixed = (mixed_arm["ttft"] or {}).get("p95")
+    p95_dis = (dis_arm["ttft"] or {}).get("p95")
+    return {
+        "schema": "accelerate_tpu.bench.disagg/v1",
+        "preset": preset,
+        "prefill_replicas": prefill_replicas,
+        "decode_replicas": decode_replicas,
+        "max_slots_per_replica": max_slots,
+        "total_lanes": total_lanes,
+        "page_size": page_size,
+        "requests": requests,
+        "max_new": max_new,
+        "offered_load": load,
+        "arrivals_per_step": round(arrivals_per_step, 4),
+        "seed": seed,
+        "provenance": prov,
+        "streams_compared_vs_mixed": cmp_md,
+        "streams_identical_vs_mixed": mm_md == 0,
+        "chaos_streams_compared": cmp_dc,
+        "chaos_streams_identical": mm_dc == 0,
+        "ttft_p95_ratio_vs_mixed": (
+            round(p95_dis / p95_mixed, 4) if p95_mixed and p95_dis else None
+        ),
+        "decode_stall_share_mixed": mixed_arm["decode_stall_share"],
+        "decode_stall_share_disagg": dis_arm["decode_stall_share"],
+        "stall_improved": (
+            dis_arm["decode_stall_share"] < mixed_arm["decode_stall_share"]
+        ),
+        "ttft_p95_improved": (
+            bool(p95_mixed and p95_dis and p95_dis < p95_mixed)
+        ),
+        "mixed": mixed_arm,
+        "disagg": dis_arm,
+        "disagg_chaos": chaos_arm,
+    }
+
+
 def _paged_bytes_per_request(estats: dict) -> int:
     """Measured KV bytes one request charged the page pool (pages actually
     allocated, averaged over admissions) — the ONE definition behind both the
@@ -1228,6 +1609,63 @@ def run_paged_compare(
 def serve_bench_command(args) -> int:
     import json
 
+    if args.disagg:
+        try:
+            p_str, d_str = args.disagg.split(":")
+            n_prefill, n_decode = int(p_str), int(d_str)
+        except ValueError:
+            raise SystemExit(
+                f"--disagg {args.disagg!r}: expected P:D (e.g. --disagg 1:2)"
+            )
+        if args.smoke:
+            # CI tier-1 disagg shape: tiny trace, 1 prefill + 1 decode
+            # replica, 2 lanes each — the correctness gates (zero lost,
+            # byte-identical streams) still hold; the wall-clock improvement
+            # gates only apply to full runs (too noisy at smoke scale).
+            n_prefill, n_decode = 1, 1
+            args.requests = min(args.requests, 12)
+            args.max_slots = 2
+            args.max_len = 64
+            args.prompt_bucket = 16
+            args.max_new = 8
+        artifact = run_disagg_bench(
+            prefill_replicas=n_prefill,
+            decode_replicas=n_decode,
+            preset=args.preset,
+            requests=args.requests,
+            max_slots=args.max_slots,
+            max_len=args.max_len,
+            prompt_bucket=args.prompt_bucket,
+            max_new=args.max_new,
+            load=2.0 if args.load is None else args.load,
+            seed=args.seed,
+            page_size=args.page_size or 8,
+            kv_pages=args.kv_pages,
+            kill_rate=args.kill_rate,
+            kills_per_replica=(1 if args.kills_per_replica is None
+                               else args.kills_per_replica),
+        )
+        with open(args.disagg_out, "w") as f:
+            json.dump(artifact, f, indent=2)
+        print(json.dumps({k: artifact[k] for k in (
+            "schema", "prefill_replicas", "decode_replicas", "offered_load",
+            "streams_identical_vs_mixed", "chaos_streams_identical",
+            "decode_stall_share_mixed", "decode_stall_share_disagg",
+            "ttft_p95_ratio_vs_mixed", "stall_improved", "ttft_p95_improved",
+        )} | {
+            "silently_lost_chaos": artifact["disagg_chaos"]["silently_lost"],
+            "handoffs": artifact["disagg"]["handoffs"],
+            "replica_kills": artifact["disagg_chaos"]["replica_kills"],
+        }))
+        bad = (artifact["disagg"]["silently_lost"]
+               or artifact["disagg_chaos"]["silently_lost"]
+               or not artifact["streams_identical_vs_mixed"]
+               or not artifact["chaos_streams_identical"])
+        if not args.smoke:
+            bad = bad or not artifact["stall_improved"] \
+                or not artifact["ttft_p95_improved"]
+        return 1 if bad else 0
+
     if args.chaos and args.fleet:
         if args.smoke:
             # CI tier-1 fleet chaos shape: small trace, 2 lanes per replica.
@@ -1243,11 +1681,12 @@ def serve_bench_command(args) -> int:
             max_len=args.max_len,
             prompt_bucket=args.prompt_bucket,
             overload=args.overload,
-            load=args.load,
+            load=1.0 if args.load is None else args.load,
             seed=args.seed,
             policy=args.policy if args.policy != "all" else "fifo",
             kill_rate=args.kill_rate,
-            kills_per_replica=args.kills_per_replica,
+            kills_per_replica=(2 if args.kills_per_replica is None
+                               else args.kills_per_replica),
             generator=args.trace_gen or "poisson",
         )
         with open(args.chaos, "w") as f:
@@ -1282,7 +1721,7 @@ def serve_bench_command(args) -> int:
             max_len=args.max_len,
             prompt_bucket=args.prompt_bucket,
             overload=args.overload,
-            load=args.load,
+            load=1.0 if args.load is None else args.load,
             seed=args.seed,
             policy=args.policy if args.policy != "all" else "fifo",
             chaos_rate=args.chaos_rate,
@@ -1372,7 +1811,7 @@ def serve_bench_command(args) -> int:
             max_len=args.max_len,
             prompt_bucket=args.prompt_bucket,
             overload=args.overload,
-            load=args.load,
+            load=1.0 if args.load is None else args.load,
             seed=args.seed,
             generator=generator,
             page_size=args.page_size,

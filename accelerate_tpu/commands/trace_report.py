@@ -10,6 +10,10 @@ Per request, the span set decomposes end-to-end latency into:
 - ``queue_s`` — scheduler queue wait (every ``queue`` span; retry waits after a
   preemption are the ``attempt > 0`` spans, reported separately as ``retry_s``)
 - ``prefill_s`` — admission prefill (bucket/chunk/prefix compute)
+- ``handoff_s`` — cross-engine KV page handoffs (disaggregated serving:
+  prefill-replica export → transfer → decode-replica adoption); requests with
+  a handoff span also get their stall SPLIT per role (``stall_prefill_s`` /
+  ``stall_decode_s``), aggregated as ``stall_by_role``
 - ``decode_s`` — decode rounds this request participated in
 - ``stall_s`` — time spent HOLDING a lane but not inside its own prefill/decode
   spans: the host loop serving other requests' admissions — invisible in any
@@ -88,6 +92,7 @@ def _reconstruct(spans: List[dict]) -> dict:
     queue_retry = [s for s in by_kind.get("queue", ()) if s.get("attempt", 0) > 0]
     prefill = by_kind.get("prefill", ())
     decode = by_kind.get("decode", ())
+    handoff = by_kind.get("handoff", ())
     first_token = by_kind.get("first_token", ())
     terminal = by_kind.get("terminal", ())
     admits = by_kind.get("admit", ())
@@ -96,17 +101,41 @@ def _reconstruct(spans: List[dict]) -> dict:
     retry_s = sum(s["dur_s"] for s in queue_retry)
     prefill_s = sum(s["dur_s"] for s in prefill)
     decode_s = sum(s["dur_s"] for s in decode)
+    handoff_s = sum(s["dur_s"] for s in handoff)
     # TTFT from spans ALONE: first token instant minus submit instant.
     ttft_s = (first_token[0]["t1"] - t_submit) if first_token else None
     t_done = terminal[-1]["t1"] if terminal else max(s["t1"] for s in spans)
     status = terminal[-1].get("status") if terminal else None
     n_tokens = terminal[-1].get("n_tokens") if terminal else None
-    # Stall: lane-holding time not inside this request's own prefill/decode
-    # spans — the host loop was admitting/prefilling OTHER requests.
+    # Stall: lane-holding time not inside this request's own prefill/decode/
+    # handoff spans — the host loop was admitting/prefilling OTHER requests.
     stall_s = None
+    stall_prefill_s = stall_decode_s = None
     if admits:
         running = t_done - admits[0]["t0"] - retry_s
-        stall_s = max(0.0, running - prefill_s - decode_s)
+        stall_s = max(0.0, running - prefill_s - decode_s - handoff_s)
+        if handoff:
+            # Disaggregated request: the handoff span splits its residency —
+            # prefill-replica stall is lane time before the first handoff not
+            # inside prefill spans, decode-replica stall is lane time after
+            # the last handoff not inside decode spans — so the per-role STALL
+            # claim is readable from spans alone (docs/disaggregated_serving.md).
+            # Only spans INSIDE each window are subtracted: a re-adoption or
+            # src-dead replay puts an earlier stint's prefill/decode spans
+            # between the handoffs, and subtracting the request TOTALS would
+            # double-count them against the wrong window.
+            stall_prefill_s = max(
+                0.0,
+                (handoff[0]["t0"] - admits[0]["t0"])
+                - sum(s["dur_s"] for s in prefill
+                      if s["t0"] < handoff[0]["t0"]),
+            )
+            stall_decode_s = max(
+                0.0,
+                (t_done - handoff[-1]["t1"])
+                - sum(s["dur_s"] for s in decode
+                      if s["t0"] >= handoff[-1]["t1"]),
+            )
     tpot_s = None
     if first_token and decode and n_tokens and n_tokens > 1:
         tpot_s = max(0.0, decode[-1]["t1"] - first_token[0]["t1"]) / (n_tokens - 1)
@@ -121,8 +150,12 @@ def _reconstruct(spans: List[dict]) -> dict:
         "queue_s": queue_s,
         "retry_s": retry_s,
         "prefill_s": prefill_s,
+        "handoff_s": handoff_s,
         "decode_s": decode_s,
         "stall_s": stall_s,
+        "stall_prefill_s": stall_prefill_s,
+        "stall_decode_s": stall_decode_s,
+        "handoffs": len(handoff),
         "ttft_s": ttft_s,
         "tpot_s": tpot_s,
         "retries": max((s.get("attempt", 0) for s in by_kind.get("queue", ())),
@@ -148,7 +181,8 @@ def trace_report(records: List[dict]) -> dict:
     traces.sort(key=lambda t: t["uid"])
 
     done = [t for t in traces if t["status"] == "done"]
-    components = ("queue_s", "retry_s", "prefill_s", "decode_s", "stall_s")
+    components = ("queue_s", "retry_s", "prefill_s", "handoff_s", "decode_s",
+                  "stall_s")
     breakdown = {
         c: latency_summary([t[c] for t in done]) for c in components
     }
@@ -158,6 +192,24 @@ def trace_report(records: List[dict]) -> dict:
     for t in traces:
         key = t["status"] or "unknown"
         by_status[key] = by_status.get(key, 0) + 1
+    # Per-role stall (disaggregated traces only — requests with a handoff
+    # span): where the remaining lane-held-but-idle time lives, prefill
+    # replica vs decode replica. The decode share is the number the
+    # disaggregation exists to drive down.
+    split = [t for t in done if t["stall_prefill_s"] is not None]
+    stall_by_role = {
+        "n_requests": len(split),
+        "prefill_s": round(sum(t["stall_prefill_s"] for t in split), 6),
+        "decode_s": round(sum(t["stall_decode_s"] for t in split), 6),
+        "prefill_share": (
+            round(sum(t["stall_prefill_s"] for t in split) / grand, 4)
+            if split and grand > 0 else None
+        ),
+        "decode_share": (
+            round(sum(t["stall_decode_s"] for t in split) / grand, 4)
+            if split and grand > 0 else None
+        ),
+    }
     return {
         "n_traces": len(traces),
         "by_status": by_status,
@@ -168,6 +220,7 @@ def trace_report(records: List[dict]) -> dict:
             c: round(totals[c] / grand, 4) if grand > 0 else None
             for c in components
         },
+        "stall_by_role": stall_by_role,
         "traces": [
             {k: v for k, v in t.items() if k != "spans"} for t in traces
         ],

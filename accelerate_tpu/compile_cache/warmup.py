@@ -97,6 +97,7 @@ def run_warmup(
     page_size: int = 0,
     kv_pages: Optional[int] = None,
     prefix_cache: int = 0,
+    role: str = "mixed",
     cache_config: Optional[CompileCacheConfig] = None,
     manifest_path: Optional[str] = None,
     cache=None,
@@ -136,6 +137,11 @@ def run_warmup(
         raise ValueError(
             "page_size/prefix_cache were given but serve=False: no paged/prefix "
             "serving programs would be warmed — pass serve=True (--serve)"
+        )
+    if role != "mixed" and not serve:
+        raise ValueError(
+            f"role={role!r} was given but serve=False: no role-sliced serving "
+            "programs would be warmed — pass serve=True (--serve)"
         )
     cfg = build_model_config(preset, seq_len)
     entries: list = []
@@ -210,10 +216,16 @@ def run_warmup(
         # verify, dynamic-slot page scatter, prefix gather/copy) — the manifest
         # stamps the page geometry so a cache directory is auditable for which
         # KV layout it is warm FOR.
+        # ``role`` warms one DISAGG slice of the surface (docs/
+        # disaggregated_serving.md): a decode-role replica's directory holds
+        # NO prefill programs at all (handoff import + COW copy + lane-valid
+        # setup instead), a prefill-role one swaps decode/verify for the page
+        # export gather — the manifest records which slice it is warm FOR.
         engine = ContinuousBatcher(
             params, cfg, max_slots=max_slots, max_len=engine_len,
             compile_cache=cache, spec_k=spec_k, drafter=drafter,
             page_size=page_size, kv_pages=kv_pages, prefix_cache=prefix_cache,
+            role=role,
         )
         entries.extend(engine.warm_programs(max_new_tokens=max_new_tokens))
 
@@ -244,6 +256,7 @@ def run_warmup(
             engine.block_mgr.num_pages if serve and page_size else None
         ),
         "prefix_cache": prefix_cache if serve else 0,
+        "role": role if serve else "mixed",
         "cache_dir": cache.cache_dir,
         "cache_stats": cache.stats(),
         "programs": [e for e in entries if e],

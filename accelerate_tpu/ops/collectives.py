@@ -46,6 +46,7 @@ __all__ = [
     "TransferStats",
     "tree_bytes",
     "stage_transfer",
+    "kv_page_transfer",
 ]
 
 AxisNames = Any  # str | tuple[str, ...]
@@ -237,3 +238,55 @@ def stage_transfer(
             "microbatch": microbatch,
         })
     return out
+
+
+def kv_page_transfer(
+    block,
+    *,
+    src_replica: int,
+    dst_replica: int,
+    uid=None,
+    pages: Optional[int] = None,
+    sharding=None,
+    stats: Optional[TransferStats] = None,
+    telemetry=None,
+):
+    """Ship one KV page block across the prefill→decode replica boundary —
+    the serving counterpart of :func:`stage_transfer` (docs/
+    disaggregated_serving.md). ``block`` is the page pytree a prefill-role
+    engine gathered (``ContinuousBatcher.export_page_block``); the copy is
+    ``jax.device_put`` onto ``sharding`` (the decode replica's placement;
+    ``None`` keeps the default device — the same-process v1), synchronously
+    waited so the recorded latency is the transfer itself. The DCN-shaped path
+    between real slices is the SAME call with a cross-mesh sharding.
+
+    Every call records into ``stats`` and — when ``telemetry`` is enabled —
+    emits one ``accelerate_tpu.telemetry.serving.handoff/v1`` record (src/dst
+    replica, request uid, page count, bytes, latency), so trace tooling and
+    serve-bench account every byte a handoff moved. Returns
+    ``(block, nbytes, dur_s)``.
+
+    Note the block is table-width (one compiled gather/scatter per geometry,
+    whatever the handoff size): ``nbytes`` is the honest WIRE cost including
+    that padding; ``pages`` says how many entries carry real context.
+    """
+    nbytes = tree_bytes(block)
+    t0 = time.perf_counter()
+    out = jax.device_put(block) if sharding is None else jax.device_put(block, sharding)
+    jax.block_until_ready(out)
+    dur = time.perf_counter() - t0
+    if stats is not None:
+        stats.record(nbytes, dur)
+    if telemetry is not None and getattr(telemetry, "enabled", False):
+        from ..telemetry.schemas import SERVING_HANDOFF_SCHEMA
+
+        telemetry.emit({
+            "schema": SERVING_HANDOFF_SCHEMA,
+            "src_replica": int(src_replica),
+            "dst_replica": int(dst_replica),
+            "uid": uid,
+            "pages": pages,
+            "nbytes": nbytes,
+            "dur_s": round(dur, 6),
+        })
+    return out, nbytes, dur

@@ -85,6 +85,7 @@ class BlockManager:
         self.cow_count = 0        # partial-page copies (register + adopt divergence)
         self.adopt_count = 0      # shared prefix pages adopted by lanes (incref'd)
         self.defer_count = 0      # admissions deferred on pool pressure
+        self.detach_count = 0     # pages detached from lanes into handoff records
 
     # ------------------------------------------------------------------ queries
     @property
@@ -189,6 +190,32 @@ class BlockManager:
         lane = self._lanes[slot]
         return None if lane is None else np.asarray(lane, np.int32)
 
+    def detach_slot(self, slot: int) -> np.ndarray:
+        """Transfer lane ``slot``'s page references OUT of the lane without
+        dropping them: the lane empties (table row → SENTINEL) but every page
+        keeps its refcount — ownership moves to the caller (a
+        :class:`~..serving.KVHandoff` record shipping the prefix KV to a
+        decode-role engine). The caller MUST eventually :meth:`release` the
+        returned ids (handoff released at the request's terminal state) or the
+        pages leak. Returns the detached page ids in logical order."""
+        lane = self._lanes[slot]
+        if lane is None:
+            return np.zeros((0,), np.int32)
+        self._lanes[slot] = None
+        self.tables[slot, :] = self.SENTINEL
+        self.detach_count += len(lane)
+        return np.asarray(lane, np.int32)
+
+    def import_pages(self, n: int) -> list:
+        """``n`` fresh pages (refcount 1 each) owned by a handoff IMPORT — the
+        destination-side staging of a cross-engine page transfer, before a lane
+        adopts the full pages read-only and re-materializes the partial
+        boundary page (COW). The importer releases its references after
+        adoption; pages nobody adopted then free. Raises
+        :class:`PagePoolExhausted` when the free list can't cover it — the
+        engine checks first and defers instead."""
+        return self._take(n)
+
     # ------------------------------------------------------------------ prefix sharing
     def retain(self, page_ids) -> None:
         """Registry-side incref (a prefix entry now references these pages)."""
@@ -228,4 +255,5 @@ class BlockManager:
             "cow_count": self.cow_count,
             "adopt_count": self.adopt_count,
             "defer_count": self.defer_count,
+            "detach_count": self.detach_count,
         }

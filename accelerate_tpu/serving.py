@@ -36,6 +36,13 @@ defers (FIFO) on pool pressure instead of overcommitting, and the prefix cache b
 refcounted page lists with copy-on-write at divergence instead of whole row-cache
 snapshots. ``kv_demand`` prices requests page-granularly for the gateway.
 
+Disaggregated roles (``role="prefill"|"decode"``, docs/disaggregated_serving.md): a
+prefill-role engine admits + prefills on TRANSIENT lanes and exports each request's KV
+as a refcounted page-list :class:`KVHandoff` instead of decoding; a decode-role engine
+never prefills — it adopts transferred handoffs (read-only full pages, COW at the write
+boundary — the prefix-cache adoption path generalized across engines) and runs
+decode-only lanes. ``serving_gateway.disagg.DisaggRouter`` routes between them.
+
 Correctness contract (tested): with requests submitted at staggered times, every finished
 sequence equals ``llama.generate``'s greedy output for that prompt alone (for MoE configs,
 for that prompt left-padded to the engine's bucket width — capacity-pooled MoE routing is
@@ -79,7 +86,17 @@ from .telemetry.schemas import (
 from .telemetry.slo import latency_summary
 from .utils.dataclasses import CompileCacheConfig
 
-__all__ = ["ContinuousBatcher", "KVBudgetError", "Request", "normalize_submit"]
+__all__ = ["ContinuousBatcher", "KVBudgetError", "KVHandoff", "Request",
+           "normalize_submit"]
+
+#: Replica roles (docs/disaggregated_serving.md): ``mixed`` is the historical
+#: engine (prefill AND decode on the same lanes); ``prefill`` chunk-prefills
+#: admitted requests and EXPORTS their KV as page-list handoffs instead of
+#: decoding (lanes are transient — freed the same step they prefill); ``decode``
+#: never prefills — work arrives as handoffs whose pages it adopts read-only
+#: (COW at the write boundary, the prefix-cache adoption semantics generalized
+#: across engines) and runs decode-only lanes at high occupancy.
+ENGINE_ROLES = ("mixed", "prefill", "decode")
 
 
 @partial(jax.jit, static_argnames=("top_k",))
@@ -146,6 +163,31 @@ class _PagedPrefix:
     from the registry key at lookup; the entry holds one refcount on every id in
     ``pages``, and eviction releases them."""
     pages: np.ndarray  # [n] int32 physical page ids
+
+
+@dataclasses.dataclass
+class KVHandoff:
+    """One prefilled request's transferable KV state (docs/disaggregated_serving.md).
+
+    Built by a prefill-role engine the step a request's prefill lands: the lane
+    is freed immediately, but its pages covering the prefill context
+    ``[0, prefill_len)`` move INTO this record (``BlockManager.detach_slot`` —
+    refcounts conserved, the handoff now owns them). A decode-role engine adopts
+    them via :meth:`ContinuousBatcher.adopt_handoff` after the page payload
+    crosses engines through ``ops.collectives.kv_page_transfer``. The record
+    stays alive (pages refcounted on the SOURCE pool) until the request reaches
+    a terminal state, so a dead decode replica can re-adopt from the
+    still-refcounted pages instead of re-prefilling; the router releases it via
+    :meth:`ContinuousBatcher.release_handoff`."""
+
+    uid: int                      # source-engine request uid (router bookkeeping)
+    prompt: np.ndarray
+    gen: GenerationConfig
+    rng: Optional[jax.Array]      # per-request key schedule (sampled requests)
+    tokens: list                  # already emitted (the prefill's first token)
+    pages: np.ndarray             # [n] int32 SOURCE-pool page ids covering the context
+    prefill_len: int              # next write position (= adopted context length)
+    valid_range: tuple            # (v0, v1): positions [v0, v1) hold real tokens
 
 
 @dataclasses.dataclass
@@ -390,6 +432,51 @@ def _copy_page(cache, src, dst, scan_layers: bool):
     }
 
 
+@partial(jax.jit, static_argnames=("scan_layers",))
+def _export_pages(cache, read_ids, scan_layers: bool):
+    """Gather pool pages ``read_ids`` [MP] into a transferable page BLOCK
+    (``[MP, ps, ...]`` per leaf, ``[L, MP, ps, ...]`` stacked): the device-side
+    half of a prefill→decode KV handoff. Sentinel/padding ids clamp — their
+    content is never imported (the destination scatter drops them through its
+    own sentinel entries). Does NOT donate: the source pages stay live in the
+    handoff record until the request is terminal (a dead decode replica
+    re-adopts from them)."""
+    def get(pool):
+        P = pool.shape[1] if scan_layers else pool.shape[0]
+        ids = jnp.minimum(read_ids, P - 1)
+        return pool[:, ids] if scan_layers else pool[ids]
+
+    return jax.tree_util.tree_map(get, cache["layers"])
+
+
+@partial(jax.jit, static_argnames=("scan_layers",), donate_argnums=(0,))
+def _import_pages(cache, block, write_ids, scan_layers: bool):
+    """Scatter a transferred page block into THIS pool's pages ``write_ids``
+    [MP] — the destination half of a KV handoff. SENTINEL entries (padding past
+    the handoff's real pages) are out of bounds and drop, exactly the
+    ``_insert_row_paged`` contract: an import can never write a page it wasn't
+    given."""
+    def put(pool, b):
+        if scan_layers:
+            return pool.at[:, write_ids].set(b.astype(pool.dtype))
+        return pool.at[write_ids].set(b.astype(pool.dtype))
+
+    return {
+        "layers": jax.tree_util.tree_map(put, cache["layers"], block),
+        "valid": cache["valid"],
+    }
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def _set_lane_valid(cache, slot, valid_row):
+    """Install one lane's valid mask (adoption-time lane setup: a handoff
+    admission has no prefill row to carry the mask, so the host computes it
+    from the handoff's layout and writes it directly). ``slot`` is traced —
+    one program serves every lane."""
+    valid = jax.lax.dynamic_update_slice(cache["valid"], valid_row[None], (slot, 0))
+    return {"layers": cache["layers"], "valid": valid}
+
+
 @partial(jax.jit, static_argnames=("cfg", "max_len"))
 def _prefill_jit(params, row, mask, cfg, max_len: int):
     cache = init_cache(cfg, 1, max_len)
@@ -445,7 +532,7 @@ class ContinuousBatcher:
                  drafter=None, spec_accept: str = "replay", page_size: int = 0,
                  kv_pages: Optional[int] = None, tracer=None, faults=None,
                  step_timeout_s: Optional[float] = None,
-                 recover: Optional[bool] = None):
+                 recover: Optional[bool] = None, role: str = "mixed"):
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -466,6 +553,44 @@ class ContinuousBatcher:
             raise ValueError(f"page_size={page_size} must be >= 0 (0 = dense cache)")
         self.page_size = int(page_size)
         self.paged = self.page_size > 0
+        # Disaggregated serving roles (docs/disaggregated_serving.md): the
+        # handoff unit is the KV page, so the prefill/decode roles require the
+        # paged layout; a prefill-role engine never decodes (spec_k would warm
+        # dead programs) and a decode-role engine never prefills (a prefix
+        # registry could never be filled). One deliberate exception to
+        # "never prefills": a decode-role engine with IN-ENGINE recovery armed
+        # (non-crash faults/watchdog) rebuilds survivors through the normal
+        # re-prefill admission — correctness-preserving (bitwise, like any
+        # recovery re-admission) but it compiles prefill programs outside the
+        # warmed decode-only slice; crash-kind faults instead escalate to the
+        # router, whose failover RE-ADOPTS without prefilling.
+        if role not in ENGINE_ROLES:
+            raise ValueError(f"role={role!r} must be one of {ENGINE_ROLES}")
+        if role != "mixed" and not self.paged:
+            raise ValueError(
+                f"role={role!r} needs the paged KV cache (page_size >= 1): the "
+                "cross-engine handoff unit is the page"
+            )
+        if role == "prefill" and spec_k:
+            raise ValueError(
+                "spec_k was given on a prefill-role engine: it never dispatches "
+                "decode, so the verify/draft programs would be dead weight"
+            )
+        if role == "decode" and prefix_cache:
+            raise ValueError(
+                "prefix_cache was given on a decode-role engine: it never runs "
+                "prefill, so the registry could never be populated"
+            )
+        self.role = role
+        #: Prefill-role export queue: KVHandoff records built the step their
+        #: request's prefill landed, drained by the router (``take_handoffs``).
+        self.handoffs: deque = deque()
+        self.handoffs_exported = 0
+        self.handoffs_adopted = 0
+        #: Per-lane (v0, v1) valid ranges recorded at paged admission — the
+        #: layout fact a handoff must carry (the dense row's mask is gone once
+        #: the lane is freed).
+        self._lane_valid: list = [(0, 0)] * max_slots
         if kv_pages is not None and not self.paged:
             raise ValueError(
                 "kv_pages was given but page_size=0: the pool size would be silently "
@@ -536,6 +661,12 @@ class ContinuousBatcher:
             ("page_size", "scan_layers"))
         self._copy_page_fn = as_cached(
             _copy_page, cc, "serving.copy_page", ("scan_layers",))
+        self._export_pages_fn = as_cached(
+            _export_pages, cc, "serving.export_pages", ("scan_layers",))
+        self._import_pages_fn = as_cached(
+            _import_pages, cc, "serving.import_pages", ("scan_layers",))
+        self._lane_valid_fn = as_cached(
+            _set_lane_valid, cc, "serving.lane_valid", ())
         # Shape-bucketed prefill: pad each prompt to the smallest rung of a geometric
         # ladder so prefill compiles once per BUCKET instead of once per chunk count
         # (and the warmup manifest can enumerate the whole compile surface). Explicit
@@ -708,6 +839,10 @@ class ContinuousBatcher:
             })
         return {
             **kv,
+            "role": self.role,
+            "handoffs_pending": len(self.handoffs),
+            "handoffs_exported": self.handoffs_exported,
+            "handoffs_adopted": self.handoffs_adopted,
             "peak_active_slots": self.peak_active_slots,
             "prefix_evictions": self.prefix_evictions,
             "prefix_capacity_misses": self.prefix_capacity_misses,
@@ -805,6 +940,12 @@ class ContinuousBatcher:
         full ``GenerationConfig`` via ``gen`` — not both (silently preferring one would
         drop the caller's limits). Temperature sampling needs ``rng``. ``on_token``
         streams each generated token id as it is produced."""
+        if self.role == "decode":
+            raise RuntimeError(
+                "decode-role engine takes no direct submissions: work arrives "
+                "as KV handoffs (adopt_handoff) from a prefill-role replica — "
+                "route through the DisaggRouter (docs/disaggregated_serving.md)"
+            )
         prompt, gen = normalize_submit(prompt, max_new_tokens, eos_token_id, gen, rng)
         # The prompt's padded prefill width + generation budget must fit the cache
         # (and, paged, the whole page pool): kv_demand runs _plan_prefill's layout
@@ -827,9 +968,29 @@ class ContinuousBatcher:
         covering prompt + budget — so admission prices real memory, not padded
         maxima. Raises ``ValueError`` for unservable geometry (via
         ``_plan_prefill``) and :class:`KVBudgetError` when the demand exceeds the
-        whole page pool."""
+        whole page pool.
+
+        **Role-aware** (the disagg admission-cost fix, docs/
+        disaggregated_serving.md): a prefill-role engine holds a request's
+        PROMPT pages only (its lanes never decode — budget pages would
+        double-count KV the decode replica charges, rejecting servable
+        requests as ``kv_budget``); a decode-role engine prices the adoption —
+        the adopted context pages plus the generation budget, with one extra
+        page for the transient COW import of a partial boundary page."""
         _, total = self._plan_prefill(prompt_len, max_new)
         if self.paged:
+            if self.role == "prefill":
+                return self.block_mgr.demand(total) * self.page_size
+            if self.role == "decode":
+                need = self.block_mgr.demand(total + max_new) + 1
+                if need > self.block_mgr.num_pages:
+                    raise KVBudgetError(
+                        f"adoption needs {need} pages ({total + max_new} cache "
+                        f"tokens + the transient boundary-page import at "
+                        f"page_size={self.page_size}) but the pool only has "
+                        f"{self.block_mgr.num_pages} — it can never be adopted"
+                    )
+                return need * self.page_size
             return self.block_mgr.demand(total + max_new) * self.page_size
         return total + max_new
 
@@ -1091,6 +1252,8 @@ class ContinuousBatcher:
         request), its lane/pages are released, and the survivors' state is
         rebuilt from prompt + already-emitted tokens so the next ``step()``
         continues the workload (docs/resilience.md)."""
+        if self.role == "prefill":
+            return self._prefill_role_step()
         finished_at_admit = self._admit()
         active = [i for i, r in enumerate(self.slot_req) if r is not None]
         self.peak_active_slots = max(self.peak_active_slots, len(active))
@@ -1132,6 +1295,185 @@ class ContinuousBatcher:
         # Report in submission order (uid is the admission counter), not slot order —
         # slot assignment is an engine detail a client should never observe.
         return sorted(finished_at_admit + finished, key=lambda r: r.uid)
+
+    # ------------------------------------------------------- disaggregated roles
+    def _prefill_role_step(self) -> list[Request]:
+        """Prefill-role ``step()``: admit queued requests (compiled prefill —
+        the normal admission path, fault boundary included), then EXPORT every
+        admitted lane as a :class:`KVHandoff` and free it. Lanes are transient:
+        one step can prefill up to ``max_slots`` requests, and the next step's
+        lanes are empty again — the replica is a prefill pump, never a decode
+        host. Returns only requests that finished AT admission (EOS or a
+        1-token budget — those never need a handoff)."""
+        finished = self._admit()
+        active = [i for i, r in enumerate(self.slot_req) if r is not None]
+        self.peak_active_slots = max(self.peak_active_slots, len(active))
+        for slot in active:
+            self._export_lane(slot)
+        if finished or active:
+            self._emit_telemetry()
+        return sorted(finished, key=lambda r: r.uid)
+
+    def _export_lane(self, slot: int) -> None:
+        """Detach lane ``slot`` into a handoff record: pages covering the
+        prefill context keep their refcounts (ownership moves to the record —
+        ``release_handoff`` drops them at the request's terminal state), pages
+        past the context (a prefix-layout row's invalid tail) release now, and
+        the lane frees for the next admission."""
+        req = self.slot_req[slot]
+        pages = self.block_mgr.detach_slot(slot)
+        n_ctx = int(self.positions[slot])
+        keep = pages_for(n_ctx, self.page_size)
+        if len(pages) > keep:
+            self.block_mgr.release(pages[keep:])
+        self.handoffs.append(KVHandoff(
+            uid=req.uid, prompt=req.prompt, gen=req.gen, rng=req.rng,
+            tokens=list(req.tokens), pages=pages[:keep], prefill_len=n_ctx,
+            valid_range=self._lane_valid[slot],
+        ))
+        self.handoffs_exported += 1
+        self.slot_req[slot] = None
+
+    def take_handoffs(self) -> list:
+        """Drain the export queue (router-facing): every handoff built since
+        the last call, in admission order."""
+        out = list(self.handoffs)
+        self.handoffs.clear()
+        return out
+
+    def export_page_block(self, h: KVHandoff):
+        """Gather one handoff's source pages into the transferable page block
+        the destination engine scatters (``adopt_handoff``). The block is
+        table-width (``max_pages`` — ONE compiled gather for every handoff
+        size); entries past the handoff's real pages are clamped padding the
+        import drops, so only ``h.pages`` ever lands anywhere."""
+        mgr = self.block_mgr
+        read_ids = np.zeros((mgr.max_pages,), np.int32)
+        read_ids[: len(h.pages)] = h.pages
+        return self._export_pages_fn(
+            self.cache, jnp.asarray(read_ids), scan_layers=self.cfg.scan_layers
+        )
+
+    def release_handoff(self, h: KVHandoff) -> int:
+        """Drop a handoff record's page references on THIS (source) engine;
+        pages free when nothing else holds them. Returns pages freed."""
+        return self.block_mgr.release(h.pages)
+
+    def can_adopt_handoff(self, h: KVHandoff) -> bool:
+        """Would :meth:`adopt_handoff` land right now? (A free lane AND the
+        pool covering the transient import peak.) The router checks this
+        BEFORE gathering/transferring the page block — a deferred adoption
+        must not pay (or telemeter) a device copy it then throws away."""
+        if self.role == "prefill" or not self.paged:
+            return False
+        if not any(r is None for r in self.slot_req):
+            return False
+        mgr = self.block_mgr
+        n_full = h.prefill_len // self.page_size
+        remaining = h.gen.max_new_tokens - len(h.tokens)
+        n_lane_pages = mgr.demand(h.prefill_len + remaining + 1)
+        return len(h.pages) + (n_lane_pages - n_full) <= mgr.free_pages
+
+    def adopt_handoff(self, h: KVHandoff, block, on_token=None,
+                      replay_tokens: bool = False):
+        """Decode-side handoff admission: land a transferred page block in this
+        engine's pool and start a decode lane EXACTLY where the prefill replica
+        left off — no prefill runs here, ever.
+
+        The adoption is the prefix-cache adoption path generalized across
+        engines: the block is staged into import-owned pages, the lane ADOPTS
+        the fully-covered context pages read-only (refcount++, never written —
+        decode writes start at ``prefill_len``), a partial boundary page is
+        re-materialized as an owned COPY (COW at the divergence point, the
+        ``_PagedPrefix`` semantics), and the import's references drop — full
+        pages then belong to the lane, the boundary original frees. Budget
+        pages are allocated fresh.
+
+        Returns the engine :class:`Request` occupying the lane, or ``None``
+        when the admission must DEFER (no free lane, or pool pressure — the
+        defer counter moves; nothing is consumed either way).
+        ``replay_tokens`` re-delivers the handoff's already-emitted tokens
+        through ``on_token`` (re-adoption after a decode-replica death, after
+        the router's ``on_retry`` stream reset)."""
+        if self.role == "prefill":
+            raise RuntimeError("a prefill-role engine cannot adopt handoffs")
+        if not self.paged:
+            raise RuntimeError("handoff adoption needs the paged KV cache")
+        slot = next(
+            (i for i, r in enumerate(self.slot_req) if r is None), None)
+        if slot is None:
+            return None
+        mgr = self.block_mgr
+        ps = self.page_size
+        n_src = len(h.pages)
+        n_full = h.prefill_len // ps
+        partial = h.prefill_len % ps != 0
+        remaining = h.gen.max_new_tokens - len(h.tokens)
+        if remaining <= 0 or not h.tokens:
+            raise ValueError(
+                f"handoff uid={h.uid} has no decode work (emitted "
+                f"{len(h.tokens)}/{h.gen.max_new_tokens}) — it should have "
+                "finished on the prefill replica"
+            )
+        # The lane's page reservation mirrors the mixed engine's worst case
+        # (context + full residual budget, so there is NO mid-decode OOM path);
+        # the transient import peak is the lane demand plus the boundary page's
+        # short-lived original (released right after its COW copy).
+        n_lane_tokens = h.prefill_len + remaining + 1
+        n_lane_pages = mgr.demand(n_lane_tokens)
+        if n_src + (n_lane_pages - n_full) > mgr.free_pages:
+            mgr.defer_count += 1
+            return None
+        import_ids = mgr.import_pages(n_src)
+        write_ids = np.full((mgr.max_pages,), mgr.SENTINEL, np.int32)
+        write_ids[:n_src] = import_ids
+        self.cache = self._import_pages_fn(
+            self.cache, block, jnp.asarray(write_ids),
+            scan_layers=self.cfg.scan_layers,
+        )
+        lane_ids = mgr.admit(slot, n_lane_tokens, adopted=import_ids[:n_full],
+                             cow_partial=partial)
+        if partial:
+            # COW: the lane's first writable page starts as a copy of the
+            # shared boundary page (context above it, fresh slots below).
+            self.cache = self._copy_page_fn(
+                self.cache, int(import_ids[n_full]), int(lane_ids[n_full]),
+                scan_layers=self.cfg.scan_layers,
+            )
+        # Import stage complete: drop the importer's references — full pages
+        # now belong solely to the lane, the boundary original frees.
+        mgr.release(import_ids)
+        v0, v1 = h.valid_range
+        valid_row = np.zeros((self.max_len,), bool)
+        valid_row[v0:v1] = True
+        self.cache = self._lane_valid_fn(self.cache, slot, jnp.asarray(valid_row))
+        req = Request(self._uid, h.prompt, h.gen, h.rng, on_token=on_token)
+        self._uid += 1
+        req.tokens = list(h.tokens)
+        self.slot_req[slot] = req
+        self.positions[slot] = h.prefill_len
+        self.tokens[slot] = int(h.tokens[-1])
+        self.admitted += 1
+        self.handoffs_adopted += 1
+        self._lane_valid[slot] = (v0, v1)
+        if self.drafter is not None:
+            # Mirror the engine lane's layout on the draft cache. Every
+            # handoff layout is "context left-padded to width prefill_len"
+            # (bucket/chunk: pad = total - len(prompt); prefix: pad = 0), so
+            # ONE synthesized bucket plan reproduces it exactly — the draft
+            # row's positions then index both caches, like any admission.
+            # The pending token (h.tokens[-1]) is written by the first draft
+            # decode step, exactly as after a normal admission.
+            self.drafter.admit(slot, np.asarray(h.prompt, np.int32),
+                               ("bucket", h.prefill_len))
+        if replay_tokens and on_token is not None:
+            # Re-adoption after a decode-replica death: the router already
+            # fired the on_retry stream reset, so the handoff's tokens (the
+            # prefill's first emission) re-deliver from position zero and the
+            # final transcript stays byte-identical.
+            for tok in h.tokens:
+                on_token(int(tok))
+        return req
 
     def _plain_step(self, active: list[int]) -> list[Request]:
         """Classic decode: ONE compiled dispatch advances every lane one token."""
@@ -1402,24 +1744,55 @@ class ContinuousBatcher:
             # dynamic-slot page scatter (ONE program for every slot/row — the table
             # made the lane index data) and, with prefix caching, the page gather +
             # partial-page copy. Prefill programs below are layout-shared with dense.
+            # Role engines warm THEIR slice of the surface: a decode-role replica
+            # has no prefill/insert programs at all (the handoff import + COW copy
+            # + lane-valid setup replace them), and a prefill-role replica warms
+            # the page-export gather instead of decode/verify.
             tables = jnp.asarray(self.block_mgr.tables)
-            entries.append(self._decode_paged_fn.warm(
-                self.params, self.cache, tables, lanes, lanes,
-                cfg=self.cfg, page_size=self.page_size,
-            ))
-            if self.spec_k:
-                seq = jnp.zeros((self.max_slots, self.spec_k + 1), jnp.int32)
-                entries.append(self._spec_verify_paged_fn.warm(
-                    self.params, self.cache, tables, seq, lanes,
+            if self.role != "prefill":
+                entries.append(self._decode_paged_fn.warm(
+                    self.params, self.cache, tables, lanes, lanes,
                     cfg=self.cfg, page_size=self.page_size,
                 ))
-                entries.extend(self.drafter.warm_programs(self, max_new_tokens))
+                if self.spec_k:
+                    seq = jnp.zeros((self.max_slots, self.spec_k + 1), jnp.int32)
+                    entries.append(self._spec_verify_paged_fn.warm(
+                        self.params, self.cache, tables, seq, lanes,
+                        cfg=self.cfg, page_size=self.page_size,
+                    ))
+                    entries.extend(self.drafter.warm_programs(self, max_new_tokens))
             write_ids = jnp.zeros((self.block_mgr.max_pages,), jnp.int32)
+            if self.role == "decode":
+                page_axis = 1 if self.cfg.scan_layers else 0
+                block = jax.tree_util.tree_map(
+                    lambda pool: jnp.zeros(
+                        pool.shape[:page_axis]
+                        + (self.block_mgr.max_pages,)
+                        + pool.shape[page_axis + 1:],
+                        pool.dtype,
+                    ),
+                    self.cache["layers"],
+                )
+                entries.append(self._import_pages_fn.warm(
+                    self.cache, block, write_ids,
+                    scan_layers=self.cfg.scan_layers,
+                ))
+                entries.append(self._copy_page_fn.warm(
+                    self.cache, 0, 0, scan_layers=self.cfg.scan_layers,
+                ))
+                entries.append(self._lane_valid_fn.warm(
+                    self.cache, 0, jnp.zeros((self.max_len,), bool),
+                ))
+                return entries  # no prefill surface, by construction
             row0 = init_cache(self.cfg, 1, self.max_len)
             entries.append(self._insert_paged_fn.warm(
                 self.cache, row0, write_ids, 0,
                 page_size=self.page_size, scan_layers=self.cfg.scan_layers,
             ))
+            if self.role == "prefill":
+                entries.append(self._export_pages_fn.warm(
+                    self.cache, write_ids, scan_layers=self.cfg.scan_layers,
+                ))
             if self.prefix_cache_size:
                 entries.append(self._gather_row_fn.warm(
                     self.cache, write_ids, 0,
@@ -1735,7 +2108,11 @@ class ContinuousBatcher:
         # scatter below fills it, so no device copy runs on this direction).
         adopted = [] if entry is None else list(entry.pages[: hit_len // ps])
         cow_partial = hit_len > 0 and hit_len % ps != 0
-        n_tokens = total + max_new
+        # A prefill-role engine never decodes: its lanes hold the CONTEXT pages
+        # only (the decode replica charges the budget pages at adoption —
+        # reserving them here too would double-count KV, the disagg admission
+        # fix in kv_demand).
+        n_tokens = total if self.role == "prefill" else total + max_new
         # Pool pressure: the prefix registry is a CACHE and yields to live
         # traffic — evict LRU entries (releasing their page references) before
         # deferring. Without this, registry-held pages could starve admission
@@ -1782,6 +2159,14 @@ class ContinuousBatcher:
                 # boundary quarantines this request (always attributable).
                 raise fp.fault_for(spec, "serving.kv_admit", uid=req.uid)
         ids = mgr.admit(slot, n_tokens, adopted=adopted, cow_partial=cow_partial)
+        # The lane's valid layout (what a handoff must carry — the dense row's
+        # mask is gone once a prefill-role lane exports): prefix layout is
+        # LEFT-aligned ([0, len)), bucket/chunk layouts are left-PADDED
+        # ([pad, total)).
+        self._lane_valid[slot] = (
+            (0, len(ctx)) if self.prefix_cache_size
+            else (total - len(ctx), total)
+        )
         # Row scatter: sentinel out the adopted pages (never written) and everything
         # past the row's own extent; decode writes continue directly into the
         # remaining allocated pages.

@@ -15,6 +15,13 @@ Fleet tier (``fleet.FleetRouter``, docs/resilience.md): the same machinery over
 N engine replicas — health-driven routing, per-replica circuit breakers,
 lossless failover via request replay, drain-on-restart / rolling restart.
 
+Disaggregated tier (``disagg.DisaggRouter``, docs/disaggregated_serving.md):
+replicas get ROLES — prefill replicas chunk-prefill and export KV page-list
+handoffs, decode replicas adopt them read-only (COW at the write boundary) and
+run decode-only lanes at high occupancy; failover stays lossless (re-prefill on
+a dead prefill replica, re-adoption from still-refcounted pages on a dead
+decode replica).
+
 Enable via ``GatewayConfig`` / ``ACCELERATE_GATEWAY`` and build with::
 
     gw = ServingGateway(engine, GatewayConfig(enabled=True, policy="edf"))
@@ -22,6 +29,10 @@ Enable via ``GatewayConfig`` / ``ACCELERATE_GATEWAY`` and build with::
     gw.run()
 """
 
+from .disagg import (
+    DisaggRouter,
+    parse_roles,
+)
 from .fleet import (
     ACTIVE,
     DRAINING,
@@ -79,6 +90,8 @@ __all__ = [
     "ServingGateway",
     "GatewayRequest",
     "CircuitBreaker",
+    "DisaggRouter",
+    "parse_roles",
     "FleetRouter",
     "Replica",
     "ACTIVE",

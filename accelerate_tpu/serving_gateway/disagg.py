@@ -1,0 +1,558 @@
+"""Disaggregated prefill/decode serving: role-aware routing over a split fleet.
+
+Every replica in the PR-10 fleet pays BOTH phases on the same lanes: a decode
+lane is held while the host loop chunk-prefills other admissions — exactly the
+STALL share ``trace-report``'s critical-path breakdown was built to expose
+(ROADMAP item 1). This module splits the two phases onto separately-provisioned
+replicas (the TPU serving comparison in PAPERS.md and the multi-slice DCN
+scaling work both argue the compute-bound prefill and HBM-bound decode phases
+want different provisioning):
+
+- **Replica roles** — each engine is ``prefill`` / ``decode`` / ``mixed``
+  (``ContinuousBatcher(role=...)``, threaded through ``GatewayConfig.
+  replica_roles`` and the restart ``engine_factory``). Prefill replicas
+  chunk-prefill admitted requests on TRANSIENT lanes (freed the same step) and
+  export each request's KV as a page-list :class:`~..serving.KVHandoff`;
+  decode replicas never prefill — they adopt handoffs read-only (COW at the
+  write boundary, the prefix-cache adoption semantics generalized across
+  engines) and run decode-only lanes at high occupancy.
+- **Cross-engine page handoff** — the page payload crosses engines through
+  ``ops.collectives.kv_page_transfer`` (``jax.device_put`` onto the decode
+  replica's placement, byte/latency-accounted, one ``serving.handoff/v1``
+  record per handoff). Handoff v1 is a same-process device copy between two
+  engines' pools; the DCN-shaped path between real slices is the same call.
+- **Role-aware routing** — :class:`DisaggRouter` (a ``FleetRouter`` subclass:
+  same policy queue, same submit/SLO contract) dispatches admissions to the
+  healthiest prefill-capable replica, collects completed prefills into a
+  handoff queue, and adopts them onto the healthiest decode-capable replica.
+  Admission cost is priced by the DECODE side's adoption demand (context +
+  budget) while the prefill side validates context-only servability — pricing
+  both phases at full prompt+budget would double-count KV and reject servable
+  requests (the ``kv_budget`` fix).
+- **Failover, still lossless** — a dead prefill replica's in-flight and
+  pending-handoff requests re-prefill on a peer via the PR-9 replay path; a
+  dead decode replica's requests RE-ADOPT from the still-refcounted source
+  pages (the handoff record keeps them alive until the request is terminal)
+  or fall back to replay when the source is gone too — streams byte-identical
+  either way, at zero preemption-retry-budget spend.
+
+Proof: ``serve-bench --disagg P:D`` (``commands/serve_bench.run_disagg_bench``)
+→ ``BENCH_DISAGG.json`` — decode-replica STALL share and TTFT p95 vs a
+same-chip mixed fleet at ≥2× offered load, disagg streams byte-identical to the
+mixed baseline, zero silently-lost requests under the chaos variant
+(docs/disaggregated_serving.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Callable, List, Optional, Sequence
+
+from ..ops.collectives import TransferStats, kv_page_transfer
+from ..resilience.faults import EngineCrashed
+from ..utils.dataclasses import GatewayConfig
+from .fleet import DRAINING, RESTARTING, RETIRED, FleetRouter, Replica
+from .gateway import (
+    CANCELLED,
+    EXPIRED,
+    FAILED,
+    RUNNING,
+    GatewayRequest,
+)
+
+__all__ = ["DisaggRouter", "parse_roles"]
+
+#: Roles that can take an ADMISSION (run prefill) / adopt a handoff (decode).
+PREFILL_CAPABLE = ("prefill", "mixed")
+DECODE_CAPABLE = ("decode", "mixed")
+
+
+def parse_roles(spec) -> List[str]:
+    """Normalize a role spec: a sequence of role names, or the
+    ``GatewayConfig.replica_roles`` comma string (``"prefill,decode,decode"``)."""
+    if isinstance(spec, str):
+        roles = [r.strip() for r in spec.split(",")]
+    else:
+        roles = [str(r) for r in spec]
+    bad = [r for r in roles if r not in ("prefill", "decode", "mixed")]
+    if bad or not roles:
+        raise ValueError(
+            f"replica roles {spec!r}: expected prefill/decode/mixed, one per "
+            "replica"
+        )
+    return roles
+
+
+@dataclasses.dataclass
+class _PendingHandoff:
+    """One exported-but-not-terminal handoff: the gateway request it serves,
+    the engine-side record (which OWNS refcounted pages on the source pool),
+    and the source identity the re-adoption / release guards check — a
+    restarted or rebuilt source invalidates the record (its pool is gone), and
+    releasing against a DIFFERENT BlockManager would corrupt refcounts."""
+
+    greq: GatewayRequest
+    handoff: object          # serving.KVHandoff
+    src_rid: int
+    src_engine: object
+    src_mgr: object          # the source BlockManager at export time
+    exported_at: float = 0.0  # router clock at export/requeue (the handoff
+    #                           span's t0 — adoption-queue wait is handoff
+    #                           time, not prefill-replica stall)
+    readoptions: int = 0     # decode-replica deaths this handoff survived
+
+
+class DisaggRouter(FleetRouter):
+    """Role-aware fleet router: prefill replicas feed decode replicas through
+    KV page handoffs (see module docstring).
+
+    ``roles`` (or ``config.replica_roles``) names each replica's role, matching
+    the engines' own ``role`` attributes; the fleet needs at least one
+    prefill-capable and one decode-capable replica. ``engine_factory`` may take
+    ``(rid)`` or ``(rid, role)`` — restarts rebuild the replica with its
+    original role either way. ``config.preempt`` is rejected: preemption
+    dispatches into an arbitrary lane, and disagg admissions flow through the
+    handoff pipeline instead."""
+
+    def __init__(self, engines: Sequence, config: Optional[GatewayConfig] = None,
+                 telemetry=None, clock: Callable[[], float] = time.monotonic,
+                 tracer=None, engine_factory: Optional[Callable] = None,
+                 supervisor=None, roles: Optional[Sequence] = None):
+        if config is None:
+            config = GatewayConfig(enabled=True)
+        if roles is None:
+            if config.replica_roles is None:
+                raise ValueError(
+                    "DisaggRouter needs replica roles: pass roles=[...] or set "
+                    "GatewayConfig.replica_roles"
+                )
+            roles = config.replica_roles
+        self.roles = parse_roles(roles)
+        if len(self.roles) != len(list(engines)):
+            raise ValueError(
+                f"{len(self.roles)} roles for {len(list(engines))} engines"
+            )
+        if not any(r in PREFILL_CAPABLE for r in self.roles):
+            raise ValueError("disagg fleet needs a prefill-capable replica")
+        if not any(r in DECODE_CAPABLE for r in self.roles):
+            raise ValueError("disagg fleet needs a decode-capable replica")
+        if config.preempt:
+            raise ValueError(
+                "preempt=True is a lane-level mechanism; disagg admissions "
+                "flow through the handoff pipeline — disable it"
+            )
+        for i, eng in enumerate(engines):
+            if getattr(eng, "role", "mixed") != self.roles[i]:
+                raise ValueError(
+                    f"replica {i}: engine role {getattr(eng, 'role', None)!r} "
+                    f"!= declared role {self.roles[i]!r} — build engines with "
+                    "ContinuousBatcher(role=...) matching replica_roles"
+                )
+            # Handoffs are pages: once any prefill replica exports, every
+            # decode-capable replica must be paged — reject at construction,
+            # not one adopt_fault per request at serve time. (The fleet
+            # geometry check also enforces one page_size, but a clear message
+            # beats a geometry-tuple mismatch.)
+            if (any(r == "prefill" for r in self.roles)
+                    and self.roles[i] in DECODE_CAPABLE
+                    and not getattr(eng, "paged", False)):
+                raise ValueError(
+                    f"replica {i} ({self.roles[i]}) is dense (page_size=0) in "
+                    "a fleet with prefill replicas: handoff adoption needs the "
+                    "paged KV cache on every decode-capable replica"
+                )
+        if engine_factory is not None:
+            # Thread the role through: a (rid, role) factory gets it handed,
+            # a (rid) factory is trusted to consult the same role table. Only
+            # a factory with exactly two REQUIRED positional parameters is
+            # wrapped — `lambda rid, cfg=...:` or `def f(rid, *, log=None)`
+            # must keep their single-arg call (handing the role string into a
+            # defaulted second parameter would build a corrupt replacement
+            # engine mid-failover).
+            import inspect
+
+            try:
+                required = [
+                    p for p in
+                    inspect.signature(engine_factory).parameters.values()
+                    if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                    and p.default is p.empty
+                ]
+                takes_role = len(required) >= 2
+            except (TypeError, ValueError):
+                takes_role = False
+            if takes_role:
+                user_factory, role_table = engine_factory, self.roles
+                engine_factory = lambda rid: user_factory(rid, role_table[rid])  # noqa: E731
+        super().__init__(engines, config, telemetry=telemetry, clock=clock,
+                         tracer=tracer, engine_factory=engine_factory,
+                         supervisor=supervisor)
+        self.counters.update({"handoffs": 0, "readopted": 0,
+                              "handoff_defers": 0})
+        #: Handoffs awaiting decode-side adoption, admission order (FIFO — the
+        #: policy already ordered them at dispatch).
+        self._handoffs: deque = deque()
+        #: gateway uid → live _PendingHandoff (released at the terminal state;
+        #: the re-adoption index for decode-replica deaths).
+        self._live_handoffs: dict = {}
+        #: Byte/latency accounting across every kv_page_transfer.
+        self.transfer_stats = TransferStats()
+
+    # ------------------------------------------------------------- introspection
+    @property
+    def running_count(self) -> int:
+        """In-flight requests INCLUDING handoff limbo (exported, not yet
+        adopted) — ``run()`` must not drain while a handoff still owes tokens."""
+        return (sum(len(rep.running) for rep in self._replicas)
+                + len(self._handoffs))
+
+    def _phase_reps(self, want) -> List[Replica]:
+        return [rep for rep in self._replicas
+                if getattr(rep.engine, "role", "mixed") in want]
+
+    # ------------------------------------------------------------------ pricing
+    def _admission_cost(self, prompt_len: int, max_new: int) -> int:
+        """The disagg admission-cost fix: price by the DECODE side's adoption
+        demand (adopted context pages + budget (+ the transient boundary-page
+        import)), and validate the prefill side can hold the context — NOT
+        prompt+budget on both phases, which double-counts KV and rejects
+        servable requests (``kv_budget``)."""
+        prefill_ref = next(
+            (rep.engine for rep in self._phase_reps(PREFILL_CAPABLE)), None)
+        decode_ref = next(
+            (rep.engine for rep in self._phase_reps(DECODE_CAPABLE)), None)
+        cost = 0
+        if prefill_ref is not None:
+            cost = int(prefill_ref.kv_demand(prompt_len, max_new))
+        if decode_ref is not None:
+            cost = max(cost, int(decode_ref.kv_demand(prompt_len, max_new)))
+        return cost
+
+    # ------------------------------------------------------------------ routing
+    def _pick_replica(self, now: float, reps=None) -> Optional[Replica]:
+        """Admissions go to prefill-capable replicas only (probe-first, then
+        healthiest-least-loaded — the ONE base heuristic over the role
+        subset)."""
+        return super()._pick_replica(
+            now, self._phase_reps(PREFILL_CAPABLE) if reps is None else reps)
+
+    def _pick_decode_replica(self, now: float) -> Optional[Replica]:
+        return super()._pick_replica(now, self._phase_reps(DECODE_CAPABLE))
+
+    def _admission_gate(self, greq: GatewayRequest,
+                        now: float) -> Optional[str]:
+        """The fleet can serve a request only while BOTH phases have a
+        non-retired replica — a fleet whose decode side is permanently gone
+        must refuse, not prefill into a queue nothing will ever drain."""
+        if all(rep.state == RETIRED
+               for rep in self._phase_reps(PREFILL_CAPABLE)):
+            return "fleet_down"
+        if all(rep.state == RETIRED
+               for rep in self._phase_reps(DECODE_CAPABLE)):
+            return "fleet_down"
+        return None
+
+    # ------------------------------------------------------------------ stepping
+    def step(self) -> List[GatewayRequest]:
+        """One disagg cycle: the base fleet cycle (deadlines, lifecycle,
+        role-filtered admission → prefill replicas, every engine stepped —
+        prefill replicas EXPORT during theirs), then the handoff pass: expire
+        limbo deadline violators, collect fresh exports, and adopt pending
+        handoffs onto decode-capable replicas."""
+        events = super().step()
+        now = self._clock()
+        extra = self._disagg_pass(now)
+        if extra:
+            events = sorted(events + extra, key=lambda r: r.uid)
+        return events
+
+    def _disagg_pass(self, now: float) -> List[GatewayRequest]:
+        events: List[GatewayRequest] = []
+        # Limbo deadline expiry: a handoff nobody adopted in time is a normal
+        # deadline miss, not a stranded request.
+        for ph in list(self._handoffs):
+            greq = ph.greq
+            if greq.deadline_at is not None and now > greq.deadline_at:
+                self._handoffs.remove(ph)
+                greq.tokens = list(ph.handoff.tokens)
+                self.counters["expired"] += 1
+                self._finalize(greq, EXPIRED, "deadline_handoff", now)
+                events.append(greq)
+        self._collect_handoffs(now)
+        events.extend(self._pump_handoffs(now))
+        return events
+
+    def _collect_handoffs(self, now: float) -> None:
+        """Drain every prefill replica's export queue into the router's
+        handoff queue: the request leaves the replica's running set (its lane
+        is already free) and enters handoff limbo."""
+        for rep in self._replicas:
+            if getattr(rep.engine, "role", "mixed") != "prefill":
+                continue
+            if rep.state in (RESTARTING, RETIRED):
+                continue
+            for h in rep.engine.take_handoffs():
+                greq = rep.running.pop(h.uid, None)
+                if greq is None or greq.terminal:
+                    # engine-direct submission, or finalized out-of-band
+                    # (cancel/expiry raced the export): nothing owes tokens.
+                    rep.engine.release_handoff(h)
+                    continue
+                ph = _PendingHandoff(greq, h, rep.rid, rep.engine,
+                                     rep.engine.block_mgr, exported_at=now)
+                greq._rid = None
+                greq._engine_req = None
+                self._handoffs.append(ph)
+                self._live_handoffs[greq.uid] = ph
+
+    def _handoff_alive(self, ph: _PendingHandoff) -> bool:
+        """May we still read/release ``ph``'s source pages? A crashed, replaced
+        (restart factory) or rebuilt (fault-recovery fresh pool) source engine
+        invalidates the record — its pages and content are gone."""
+        rep = self._replicas[ph.src_rid]
+        return (rep.engine is ph.src_engine
+                and not getattr(ph.src_engine, "crashed", False)
+                and ph.src_engine.block_mgr is ph.src_mgr
+                and rep.state not in (RESTARTING, RETIRED))
+
+    def _pump_handoffs(self, now: float) -> List[GatewayRequest]:
+        """Adopt pending handoffs onto decode-capable replicas, FIFO. Head-of-
+        line blocking is deliberate: a deferred adoption (pool pressure) holds
+        the queue exactly like the engine's own paged admission defers —
+        later arrivals never jump a request waiting for pages."""
+        events: List[GatewayRequest] = []
+        if self._handoffs and all(
+            rep.state == RETIRED for rep in self._phase_reps(DECODE_CAPABLE)
+        ):
+            # Nothing will ever adopt: fail the limbo machine-readably (the
+            # all-retired analog of the base fleet_down backlog flush).
+            events.extend(self._flush_handoffs_fleet_down(now))
+            return events
+        while self._handoffs:
+            ph = self._handoffs[0]
+            greq = ph.greq
+            if greq.terminal:
+                self._handoffs.popleft()
+                continue
+            if not self._handoff_alive(ph):
+                # Source died/rebuilt before adoption: the PR-9 fallback —
+                # full re-prefill on a peer, stream reset, zero losses.
+                self._handoffs.popleft()
+                self._drop_handoff_record(greq.uid)
+                self._replay_requeue(greq, now, "handoff_src_dead")
+                continue
+            rep = self._pick_decode_replica(now)
+            if rep is None:
+                break
+            if not rep.engine.can_adopt_handoff(ph.handoff):
+                # Pool pressure on the chosen replica: defer WITHOUT paying
+                # (or telemetering) the page-block transfer — a repeated
+                # export-then-throw-away would inflate the handoff byte
+                # accounting one copy per deferred step.
+                self.counters["handoff_defers"] += 1
+                break
+            probe = False
+            if rep.breaker.enabled:
+                gate = rep.breaker.gate(greq.uid, now)
+                assert gate is None, (rep, gate)
+                probe = rep.breaker.probe_uid == greq.uid
+            block = ph.src_engine.export_page_block(ph.handoff)
+            block, nbytes, _dur = kv_page_transfer(
+                block, src_replica=ph.src_rid, dst_replica=rep.rid,
+                uid=greq.uid, pages=len(ph.handoff.pages),
+                stats=self.transfer_stats, telemetry=self.telemetry,
+            )
+            try:
+                ereq = rep.engine.adopt_handoff(
+                    ph.handoff, block, on_token=self._stream_cb(greq),
+                    replay_tokens=ph.readoptions > 0,
+                )
+            except EngineCrashed as e:
+                if probe:
+                    rep.breaker.probe_uid = None
+                self._replica_died(rep, f"crash:{e.site}", now)
+                continue  # ph stays at the head; next pick skips the dead rep
+            except Exception as e:  # injected/real adoption fault: attributable
+                if probe:
+                    rep.breaker.probe_uid = None
+                self._handoffs.popleft()
+                greq.tokens = list(ph.handoff.tokens)
+                kind = getattr(e, "kind", type(e).__name__)
+                self.counters["failed"] += 1
+                self._finalize(greq, FAILED, f"adopt_fault:{kind}", now)
+                events.append(greq)
+                continue
+            if ereq is None:
+                # Pool pressure / lane race on the chosen replica: defer —
+                # retried next step, nothing consumed.
+                if probe:
+                    rep.breaker.probe_uid = None
+                break
+            self._handoffs.popleft()
+            greq._rid = rep.rid
+            greq._engine_req = ereq
+            rep.running[ereq.uid] = greq
+            self.counters["handoffs"] += 1
+            tr = self.tracer
+            if tr is not None and greq._trace is not None:
+                # Span opens at EXPORT (adoption-queue wait is handoff time,
+                # not prefill-replica lane stall — the prefill lane freed at
+                # export) and closes when the decode lane is live.
+                tr.span(greq._trace, "handoff", ph.exported_at, self._clock(),
+                        src_replica=ph.src_rid, dst_replica=rep.rid,
+                        pages=len(ph.handoff.pages), nbytes=nbytes)
+                tr.bind_engine(greq._trace, ereq.uid)
+            self._emit_route(greq.uid, rep,
+                             "probe" if probe else "handoff", now)
+        return events
+
+    # ------------------------------------------------------------------ failover
+    def _migrate(self, rep: Replica, cause: str, now: float,
+                 engine_alive: bool) -> List[GatewayRequest]:
+        """Role-aware failover: a request whose handoff record is still alive
+        on a DIFFERENT replica RE-ADOPTS from the still-refcounted source
+        pages (decode-replica death: prefill work is never repeated); anything
+        else falls back to the PR-9 replay path (full re-prefill). Streams are
+        byte-identical either way — greedy decode is deterministic and sampled
+        lanes keep their emission-indexed key schedule."""
+        migrated = []
+        for greq in list(rep.running.values()):
+            if engine_alive:
+                rep.engine.cancel(greq._engine_req.uid)
+            ph = self._live_handoffs.get(greq.uid)
+            if (ph is not None and ph.src_rid != rep.rid
+                    and self._handoff_alive(ph)):
+                self._readopt_requeue(greq, ph, now, cause)
+            else:
+                if ph is not None:
+                    self._drop_handoff_record(greq.uid)
+                self._replay_requeue(greq, now, cause)
+            self.counters["migrated"] += 1
+            self._emit_route(greq.uid, rep, "migrate", now)
+            migrated.append(greq)
+        rep.running.clear()
+        return migrated
+
+    def _readopt_requeue(self, greq: GatewayRequest, ph: _PendingHandoff,
+                         now: float, cause: str) -> None:
+        """Reset one request for idempotent RE-ADOPTION: the stream resets
+        (``on_retry``), the handoff re-enters the adoption queue, and the next
+        decode replica replays the handoff's tokens then regenerates the rest
+        — byte-identical, at zero preemption-retry-budget spend, without
+        paying prefill again."""
+        greq.replays += 1
+        self.counters["replayed"] += 1
+        self.counters["readopted"] += 1
+        greq.status = RUNNING  # mid-service: in the adoption queue, not the policy queue
+        greq.tokens = []
+        greq._engine_req = None
+        greq._rid = None
+        greq.t_first_token = greq.t_last_token = None
+        greq.n_streamed = 0
+        if greq.on_retry is not None:
+            greq.on_retry()
+        if self.tracer is not None and greq._trace is not None:
+            greq._trace.attempt = greq.retries_used + greq.replays
+            self.tracer.event(greq._trace, "retry", t=now,
+                              attempt=greq._trace.attempt, cause=cause)
+        ph.readoptions += 1
+        ph.exported_at = now  # the re-adoption span times the re-wait alone
+        self._handoffs.append(ph)
+
+    def _replica_died(self, rep: Replica, reason: str, now: float) -> None:
+        super()._replica_died(rep, reason, now)
+        # Handoffs pending adoption whose SOURCE died with this replica: the
+        # pages are gone — re-prefill on a peer (zero silent losses).
+        survivors: deque = deque()
+        for ph in self._handoffs:
+            if ph.src_rid == rep.rid and not self._handoff_alive(ph):
+                if not ph.greq.terminal:
+                    self._drop_handoff_record(ph.greq.uid)
+                    self._replay_requeue(ph.greq, now,
+                                         f"handoff_src_dead:{reason}")
+            else:
+                survivors.append(ph)
+        self._handoffs = survivors
+
+    def _flush_handoffs_fleet_down(self, now: float) -> List[GatewayRequest]:
+        """Fail every limbo handoff machine-readably (`fleet_down`) — the ONE
+        flush shared by the all-retired pump path and the last-replica retire
+        (destination buffers differ at the call sites, the semantics must
+        not)."""
+        failed: List[GatewayRequest] = []
+        while self._handoffs:
+            ph = self._handoffs.popleft()
+            if ph.greq.terminal:
+                continue
+            ph.greq.tokens = list(ph.handoff.tokens)
+            self.counters["failed"] += 1
+            self._finalize(ph.greq, FAILED, "fleet_down", now)
+            failed.append(ph.greq)
+        return failed
+
+    def _retire(self, rep: Replica, now: float) -> None:
+        super()._retire(rep, now)
+        if all(r.state == RETIRED for r in self._replicas):
+            self._pending_events.extend(self._flush_handoffs_fleet_down(now))
+
+    def _restart(self, rep: Replica, now: float) -> None:
+        """A draining PREFILL replica waits for its exported handoffs to reach
+        terminal states before the engine is torn down (their pages live in
+        its pool); past the drain deadline it restarts anyway and the
+        outstanding handoffs fall back to re-prefill on first touch."""
+        if (rep.state == DRAINING
+                and getattr(rep.engine, "role", "mixed") == "prefill"
+                and (rep.drain_deadline is None or now <= rep.drain_deadline)
+                and any(ph.src_rid == rep.rid and not ph.greq.terminal
+                        for ph in self._live_handoffs.values())):
+            return
+        super()._restart(rep, now)
+
+    # ------------------------------------------------------------------- control
+    def cancel(self, uid: int) -> bool:
+        greq = self._all.get(uid)
+        if (greq is not None and not greq.terminal and greq.status == RUNNING
+                and greq._rid is None and uid in self._live_handoffs):
+            # Handoff limbo: withdrawn before any decode replica adopted.
+            ph = self._live_handoffs[uid]
+            self._handoffs = deque(
+                p for p in self._handoffs if p.greq is not greq)
+            greq.tokens = list(ph.handoff.tokens)
+            self.counters["cancelled"] += 1
+            self._finalize(greq, CANCELLED, "cancelled_handoff", self._clock())
+            return True
+        return super().cancel(uid)
+
+    # ---------------------------------------------------------------- lifecycle
+    def _drop_handoff_record(self, uid: int) -> None:
+        """Forget a handoff whose source pool is GONE — nothing to release."""
+        self._live_handoffs.pop(uid, None)
+
+    def _finalize(self, greq: GatewayRequest, status: str,
+                  reason: Optional[str], now: float) -> None:
+        ph = self._live_handoffs.pop(greq.uid, None)
+        if ph is not None and self._handoff_alive(ph):
+            # The terminal state releases the source-side page references —
+            # the pool the prefill replica lent this request returns to it.
+            ph.src_engine.release_handoff(ph.handoff)
+        super()._finalize(greq, status, reason, now)
+
+    # ------------------------------------------------------------------ reporting
+    def stats(self) -> dict:
+        out = super().stats()
+        out["roles"] = list(self.roles)
+        out["handoffs_pending"] = len(self._handoffs)
+        out["handoffs_live"] = len(self._live_handoffs)
+        out["handoff_transfer"] = self.transfer_stats.summary()
+        return out
+
+    def __repr__(self) -> str:
+        states = ",".join(
+            f"{r.rid}:{self.roles[r.rid][0]}:{r.state}" for r in self._replicas
+        )
+        return (f"DisaggRouter(policy={self._policy.name!r}, "
+                f"replicas=[{states}], queued={len(self._policy)}, "
+                f"running={self.running_count}, "
+                f"handoffs_pending={len(self._handoffs)})")

@@ -250,19 +250,24 @@ class FleetRouter(ServingGateway):
             score = min(score, 0.25)
         return max(0.0, round(score, 4))
 
-    def _pick_replica(self, now: float) -> Optional[Replica]:
+    def _pick_replica(self, now: float,
+                      reps: Optional[List[Replica]] = None) -> Optional[Replica]:
         """Routing decision for the next admission: any half-open replica with
         no outstanding probe gets it FIRST (one probe resolves its state — a
         restarted replica earns full routing back, a still-sick one re-opens
         after a single request); otherwise the healthiest routable replica
-        with free lanes, ties to most free lanes then lowest rid."""
-        probes = [rep for rep in self._replicas
+        with free lanes, ties to most free lanes then lowest rid. ``reps``
+        restricts the candidate pool (the disagg router routes each phase over
+        its role subset through this ONE heuristic)."""
+        if reps is None:
+            reps = self._replicas
+        probes = [rep for rep in reps
                   if rep.state == ACTIVE and rep.breaker.enabled
                   and rep.breaker.state != "closed"
                   and self._routable(rep, now) and rep.free_lanes() > 0]
         if probes:
             return probes[0]
-        candidates = [rep for rep in self._replicas
+        candidates = [rep for rep in reps
                       if self._routable(rep, now) and rep.free_lanes() > 0]
         if not candidates:
             return None
@@ -666,6 +671,7 @@ class FleetRouter(ServingGateway):
                 "schema": REPLICA_HEALTH_SCHEMA,
                 "replica": rep.rid,
                 "state": rep.state,
+                "role": getattr(eng, "role", "mixed"),
                 "health": self._health(rep, now),
                 "breaker_state": rep.breaker.state,
                 "active_slots": sum(r is not None for r in eng.slot_req),

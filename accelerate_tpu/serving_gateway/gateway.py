@@ -378,7 +378,7 @@ class ServingGateway:
         # exceeds the paged engine's whole page pool gets the machine-readable
         # ``kv_budget`` reason (it could never be admitted, no matter the queue).
         try:
-            greq.cost = int(self.engine.kv_demand(len(prompt), gen.max_new_tokens))
+            greq.cost = self._admission_cost(len(prompt), gen.max_new_tokens)
         except KVBudgetError as e:
             return self._refuse(greq, now, "kv_budget", str(e))
         except ValueError as e:
@@ -389,6 +389,16 @@ class ServingGateway:
         self._policy.push(greq)
         self._queued_cost += greq.cost
         return greq
+
+    def _admission_cost(self, prompt_len: int, max_new: int) -> int:
+        """Cache-token cost one request charges the queue budget — the
+        engine's own KV pricing (``kv_demand``), so admission accounts what
+        the cache will actually charge. Raises ``KVBudgetError``/``ValueError``
+        for never-servable requests. The disagg router overrides this: a
+        request there is priced by the DECODE side's adoption demand (context
+        + budget) while the prefill side validates context-only servability —
+        pricing both phases at full prompt+budget would double-count KV."""
+        return int(self.engine.kv_demand(prompt_len, max_new))
 
     def _refuse(self, greq: GatewayRequest, now: float, reason: str,
                 detail: Optional[str] = None) -> GatewayRequest:

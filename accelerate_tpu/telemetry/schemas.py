@@ -33,6 +33,7 @@ __all__ = [
     "SERVING_THROUGHPUT_SCHEMA",
     "SERVING_KV_SCHEMA",
     "SERVING_SPEC_SCHEMA",
+    "SERVING_HANDOFF_SCHEMA",
     "GATEWAY_REQUEST_SCHEMA",
     "GATEWAY_SLO_SCHEMA",
     "REPLICA_HEALTH_SCHEMA",
@@ -67,6 +68,13 @@ SERVING_KV_SCHEMA = "accelerate_tpu.telemetry.serving.kv/v1"
 
 #: Per-decode-step speculative-decoding record (``spec_k > 0`` engines only).
 SERVING_SPEC_SCHEMA = "accelerate_tpu.telemetry.serving.spec/v1"
+
+#: One record per cross-engine KV page handoff (disaggregated serving,
+#: ``ops.collectives.kv_page_transfer``): which prefill replica exported, which
+#: decode replica adopted, the request uid, page count, wire bytes and
+#: synchronously-measured transfer latency — joined into trace-report timelines
+#: as the ``handoff`` span.
+SERVING_HANDOFF_SCHEMA = "accelerate_tpu.telemetry.serving.handoff/v1"
 
 #: One record per gateway request reaching a terminal state (done/rejected/shed/
 #: expired/cancelled/evicted): uid, status, machine-readable reason, tenant,
@@ -189,6 +197,12 @@ SCHEMA_REGISTRY: Dict[str, RecordSchema] = {
             "speculative proposal/acceptance per decode step",
         ),
         _reg(
+            SERVING_HANDOFF_SCHEMA,
+            ("src_replica", "dst_replica", "uid", "pages", "nbytes", "dur_s"),
+            "ops.collectives.kv_page_transfer",
+            "one cross-engine KV page handoff (prefill -> decode replica)",
+        ),
+        _reg(
             GATEWAY_REQUEST_SCHEMA,
             ("uid", "status", "reason", "tenant", "priority", "n_tokens",
              "retries_used", "queue_wait_s", "ttft_s", "tpot_s", "deadline_met"),
@@ -205,10 +219,10 @@ SCHEMA_REGISTRY: Dict[str, RecordSchema] = {
         ),
         _reg(
             REPLICA_HEALTH_SCHEMA,
-            ("replica", "state", "health", "breaker_state", "active_slots",
-             "queued", "step_failures"),
+            ("replica", "state", "role", "health", "breaker_state",
+             "active_slots", "queued", "step_failures"),
             "FleetRouter.step",
-            "per-replica health score, state and load per router step",
+            "per-replica health score, state, role and load per router step",
         ),
         _reg(
             FLEET_ROUTE_SCHEMA,

@@ -21,6 +21,9 @@ span kind    meaning / extra attributes
              index (the causal link to ``serving.kv/v1``/``serving.spec/v1``
              records of the same step), batch ``occupancy``, ``tokens``
              emitted, spec ``proposed``/``accepted``
+``handoff``  one cross-engine KV page handoff (disaggregated serving:
+             src/dst replica, pages, bytes — splits the trace into its
+             prefill-replica and decode-replica phases)
 ``first_token``  zero-duration: the client-visible first token (TTFT anchor)
 ``preempt``  the request lost its lane to a higher-priority one
 ``retry``    its retry was requeued (stream reset; attempt index)

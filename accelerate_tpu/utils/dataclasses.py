@@ -577,6 +577,14 @@ class GatewayConfig(KwargsHandler):
     drain_deadline_s: Optional[float] = 30.0
     replica_restarts: int = 2
     replica_restart_backoff: float = 0.0
+    # Disaggregated prefill/decode serving (``serving_gateway.disagg``): a
+    # comma-separated role per replica (``"prefill,decode,decode"``; roles:
+    # prefill / decode / mixed). When set, ``Accelerator.build_serving_gateway``
+    # with a LIST of engines builds a ``DisaggRouter`` — prefill replicas
+    # chunk-prefill and export KV page handoffs, decode replicas adopt them and
+    # run decode-only lanes (docs/disaggregated_serving.md). None = homogeneous
+    # FleetRouter.
+    replica_roles: Optional[str] = None
 
     def __post_init__(self):
         raw = os.environ.get("ACCELERATE_GATEWAY")
@@ -651,6 +659,14 @@ class GatewayConfig(KwargsHandler):
                 f"replica_restart_backoff={self.replica_restart_backoff} "
                 "must be >= 0"
             )
+        if self.replica_roles is not None:
+            roles = [r.strip() for r in self.replica_roles.split(",")]
+            bad = [r for r in roles if r not in ("prefill", "decode", "mixed")]
+            if bad or not roles:
+                raise ValueError(
+                    f"replica_roles={self.replica_roles!r}: expected a comma-"
+                    "separated list of prefill/decode/mixed, one per replica"
+                )
         if self.tenant_weights is not None:
             for tenant, weight in self.tenant_weights.items():
                 if weight <= 0:

@@ -928,6 +928,47 @@ def _run_elastic_row() -> int:
     return 0 if all(artifact["invariants"].values()) else 1
 
 
+def _run_disagg_row() -> int:
+    """Disaggregated prefill/decode artifact (``BENCH_DISAGG=1``): one
+    ``run_disagg_bench`` pass — P prefill + D decode replicas behind the
+    DisaggRouter vs a same-chip mixed fleet at sustained overload, plus the
+    chaos arm — written to ``BENCH_DISAGG.json`` (override with
+    ``BENCH_DISAGG_OUT``). Non-zero when any invariant fails (zero silent
+    losses, byte-identical streams, decode-stall/TTFT improvement)."""
+    _os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from accelerate_tpu.commands.serve_bench import run_disagg_bench
+
+    artifact = run_disagg_bench(
+        prefill_replicas=int(_os.environ.get("BENCH_DISAGG_PREFILL", "1")),
+        decode_replicas=int(_os.environ.get("BENCH_DISAGG_DECODE", "2")),
+        requests=int(_os.environ.get("BENCH_DISAGG_REQUESTS", "48")),
+        max_slots=int(_os.environ.get("BENCH_DISAGG_SLOTS", "4")),
+        load=float(_os.environ.get("BENCH_DISAGG_LOAD", "2.0")),
+        seed=int(_os.environ.get("BENCH_DISAGG_SEED", "0")),
+    )
+    out = _os.environ.get("BENCH_DISAGG_OUT", "BENCH_DISAGG.json")
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(json.dumps({
+        "metric": "serve/disagg",
+        "decode_stall_share_mixed": artifact["decode_stall_share_mixed"],
+        "decode_stall_share_disagg": artifact["decode_stall_share_disagg"],
+        "ttft_p95_ratio_vs_mixed": artifact["ttft_p95_ratio_vs_mixed"],
+        "handoffs": artifact["disagg"]["handoffs"],
+        "streams_identical_vs_mixed": artifact["streams_identical_vs_mixed"],
+        "chaos_streams_identical": artifact["chaos_streams_identical"],
+    }))
+    ok = (artifact["stall_improved"] and artifact["ttft_p95_improved"]
+          and artifact["streams_identical_vs_mixed"]
+          and artifact["chaos_streams_identical"]
+          and not artifact["disagg"]["silently_lost"]
+          and not artifact["disagg_chaos"]["silently_lost"])
+    return 0 if ok else 1
+
+
 def main():
     import os
     import threading
@@ -945,6 +986,8 @@ def main():
         return _run_elastic_row()
     if os.environ.get("BENCH_TRACE"):
         return _run_trace_curves_row()
+    if os.environ.get("BENCH_DISAGG"):
+        return _run_disagg_row()
     if os.environ.get("BENCH_PAGED"):
         return _run_paged_compare_row()
     if os.environ.get("BENCH_SERVE"):

@@ -41,6 +41,7 @@ MODULES = [
     ("accelerate_tpu.spec_decode", "Speculative-decoding draft sources"),
     ("accelerate_tpu.serving_gateway.gateway", "Serving gateway"),
     ("accelerate_tpu.serving_gateway.fleet", "Fleet router (multi-replica serving)"),
+    ("accelerate_tpu.serving_gateway.disagg", "Disaggregated prefill/decode router"),
     ("accelerate_tpu.serving_gateway.policies", "Gateway scheduling policies"),
     ("accelerate_tpu.inference", "Pipeline inference"),
     ("accelerate_tpu.checkpointing", "Checkpointing"),

@@ -79,6 +79,12 @@ def test_default_enumeration_covers_the_warmup_surface(default_captures):
     # inter-stage DCN payload bytes of every transfer-bearing program.
     assert {"mpmd.stage0.fwd", "mpmd.stage0.bwd", "mpmd.stage1.loss_bwd",
             "mpmd.stage0.apply", "mpmd.stage1.zero"} <= labels, labels
+    # The disaggregated-serving role slices (ISSUE 12): the handoff
+    # export/import pair + adoption lane setup are lowered and inventoried,
+    # and the decode-only surface really IS decode-only — lowering it never
+    # produces a prefill program.
+    assert {"serving.export_pages", "serving.import_pages",
+            "serving.lane_valid"} <= labels, labels
     from accelerate_tpu.analysis.program.inventory import collective_inventory
 
     for c in default_captures:

@@ -345,7 +345,9 @@ def test_block_manager_soak_randomized_lifecycle():
     mgr = BlockManager(num_pages=24, page_size=4, max_slots=4, max_len=48)
     lanes = {}      # slot → mirrored page-id list (what the lane references)
     registry = []   # mirrored page-id lists (what prefix entries reference)
+    handoffs = []   # mirrored page-id lists detached into handoff records
     rebuilds = 0
+    detaches = adoptions = 0
 
     def check_invariants():
         free = mgr._free
@@ -359,6 +361,9 @@ def test_block_manager_soak_randomized_lifecycle():
         for ids in registry:
             for p in ids:
                 expect[p] += 1
+        for ids in handoffs:
+            for p in ids:
+                expect[p] += 1
         assert (mgr.refcount == expect).all(), (
             f"refcount drift: manager {mgr.refcount.tolist()} vs "
             f"mirror {expect.tolist()}"
@@ -366,9 +371,14 @@ def test_block_manager_soak_randomized_lifecycle():
         assert len(free) + int((expect > 0).sum()) == mgr.num_pages, "leaked pages"
 
     for step in range(4000):
+        # ISSUE 12 satellite: the disagg handoff lifecycle rides the same
+        # ledger — detach (lane → handoff record, refcounts conserved),
+        # handoff release (terminal state), and the decode-side
+        # import → adopt-read-only → import-release cycle.
         op = rng.choice(
-            ["admit", "release", "register", "evict", "rebuild"],
-            p=[0.3, 0.25, 0.2, 0.2, 0.05],
+            ["admit", "release", "register", "evict", "detach",
+             "handoff_release", "import_adopt", "rebuild"],
+            p=[0.24, 0.2, 0.14, 0.14, 0.08, 0.06, 0.09, 0.05],
         )
         if op == "admit":
             free_slots = [s for s in range(mgr.max_slots) if s not in lanes]
@@ -410,12 +420,49 @@ def test_block_manager_soak_randomized_lifecycle():
         elif op == "evict" and registry:
             entry = registry.pop(int(rng.integers(len(registry))))
             mgr.release(entry)
+        elif op == "detach" and lanes:
+            # Prefill-role export: the lane empties, its pages move to a
+            # handoff record with refcounts CONSERVED (nothing freed).
+            slot = int(rng.choice(list(lanes)))
+            in_use_before = mgr.pages_in_use
+            pages = mgr.detach_slot(slot)
+            assert mgr.pages_in_use == in_use_before, "detach freed pages"
+            assert [int(p) for p in pages] == lanes[slot]
+            handoffs.append(lanes.pop(slot))
+            detaches += 1
+        elif op == "handoff_release" and handoffs:
+            mgr.release(handoffs.pop(int(rng.integers(len(handoffs)))))
+        elif op == "import_adopt":
+            # Decode-side adoption: stage an import, the lane adopts the full
+            # context pages read-only (+COW boundary), the import releases —
+            # exactly ContinuousBatcher.adopt_handoff's accounting.
+            free_slots = [s for s in range(mgr.max_slots) if s not in lanes]
+            if free_slots:
+                slot = int(rng.choice(free_slots))
+                n_ctx = int(rng.integers(1, mgr.max_len // 2 + 1))
+                n_src = mgr.pages_for(n_ctx)
+                n_lane_tokens = min(mgr.max_len,
+                                    n_ctx + int(rng.integers(1, 17)))
+                n_full = n_ctx // mgr.page_size
+                n_lane = mgr.pages_for(n_lane_tokens)
+                if n_src + (n_lane - n_full) <= mgr.free_pages:
+                    imp = mgr.import_pages(n_src)
+                    ids = mgr.admit(
+                        slot, n_lane_tokens, adopted=imp[:n_full],
+                        cow_partial=n_ctx % mgr.page_size != 0,
+                    )
+                    mgr.release(imp)
+                    lanes[slot] = [int(p) for p in ids]
+                    adoptions += 1
         elif op == "rebuild":
             # The engine's recovery ordering: drain the registry against the
-            # OLD pool FIRST, then the lanes — then nothing may remain in use.
+            # OLD pool FIRST, then handoff records, then the lanes — then
+            # nothing may remain in use.
             rebuilds += 1
             while registry:
                 mgr.release(registry.pop())
+            while handoffs:
+                mgr.release(handoffs.pop())
             for slot in list(lanes):
                 mgr.release_slot(slot)
                 del lanes[slot]
@@ -424,3 +471,4 @@ def test_block_manager_soak_randomized_lifecycle():
             assert (mgr.refcount == 0).all()
         check_invariants()
     assert rebuilds >= 50  # the 0.05 arm actually exercised recovery
+    assert detaches >= 50 and adoptions >= 50  # the handoff arms really ran
