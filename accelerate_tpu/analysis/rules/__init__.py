@@ -14,6 +14,7 @@ from .rng_reuse import RngReuseRule
 from .recompile_hazard import RecompileHazardRule
 from .donation_safety import DonationSafetyRule
 from .dead_knob import DeadKnobRule
+from .metric_name import MetricNameLiteralRule
 from .pspec_mesh import PspecMeshMismatchRule
 from .telemetry_schema import TelemetrySchemaLiteralRule
 
@@ -31,6 +32,7 @@ def all_rules():
         DeadKnobRule(),
         PspecMeshMismatchRule(),
         TelemetrySchemaLiteralRule(),
+        MetricNameLiteralRule(),
     ]
 
 

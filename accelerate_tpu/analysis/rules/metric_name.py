@@ -1,0 +1,90 @@
+"""metric-name-literal: metric names must come from the metrics registry.
+
+Incident lineage: the exact failure mode ``telemetry-schema-literal`` exists
+for, one layer up. ISSUE 13's metrics plane (``telemetry/metrics.py``) minted
+every live-metric name as a registered constant with a kind/label/source
+contract and a generated docs catalog; a call site spelling
+``accelerate_tpu_…`` by hand bypasses all of it — a typo'd name mints a
+parallel series Prometheus dashboards and alert rules never see, silently.
+(The plane's ``inc``/``set_gauge``/``observe`` reject unregistered names at
+RUNTIME; this rule catches the ones that would only be reached in production
+paths tests don't drive.) Import the ``M_*`` constant instead.
+
+Recognized shape: the ``accelerate_tpu_`` Prometheus namespace in
+``snake_case`` with no trailing underscore — which deliberately excludes the
+``accelerate_tpu_*_`` tempfile prefixes elsewhere in the tree.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from ..engine import FileUnit, Rule
+
+#: The one module allowed to spell metric names as literals.
+REGISTRY_PATH = "accelerate_tpu/telemetry/metrics.py"
+
+#: The Prometheus namespace the registry owns.
+_PREFIX = "accelerate_tpu_"
+
+
+def _is_metric_literal(node) -> bool:
+    if not (isinstance(node, ast.Constant) and isinstance(node.value, str)):
+        return False
+    value = node.value
+    return (
+        value.startswith(_PREFIX)
+        and len(value) > len(_PREFIX)
+        and not value.endswith("_")
+        and all(c.islower() or c.isdigit() or c == "_" for c in value)
+    )
+
+
+class MetricNameLiteralRule(Rule):
+    id = "metric-name-literal"
+    severity = "error"
+    description = (
+        "metrics-plane metric name spelled as a string literal instead of a "
+        "registered M_* constant from telemetry/metrics.py"
+    )
+
+    def check_file(self, unit: FileUnit):
+        if unit.is_test or unit.path == REGISTRY_PATH:
+            return
+        for node in ast.walk(unit.tree):
+            if isinstance(node, ast.Call):
+                # plane.inc("accelerate_tpu_…") / AlertRule(metric="…") —
+                # the call-site spelling the registry constants exist to kill.
+                for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                    if _is_metric_literal(arg):
+                        yield self.make(
+                            unit,
+                            arg,
+                            f"metric name {arg.value!r} is a bare string "
+                            "literal — import the registered M_* constant "
+                            "from accelerate_tpu.telemetry.metrics (a typo'd "
+                            "name mints a series no dashboard or alert rule "
+                            "ever reads)",
+                        )
+            elif isinstance(node, ast.Dict):
+                for key in node.keys:
+                    if _is_metric_literal(key):
+                        yield self.make(
+                            unit,
+                            key,
+                            f"metric name {key.value!r} used as a dict key — "
+                            "import the registered M_* constant from "
+                            "accelerate_tpu.telemetry.metrics",
+                        )
+            elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+                # X = "accelerate_tpu_…" outside the registry mints a parallel
+                # constant the registry (and its generated catalog) never sees.
+                if _is_metric_literal(node.value):
+                    yield self.make(
+                        unit,
+                        node,
+                        f"metric name {node.value.value!r} defined outside "
+                        "the registry — declare it in telemetry/metrics.py "
+                        "(METRIC_REGISTRY, with kind/labels/source) and "
+                        "import it",
+                    )

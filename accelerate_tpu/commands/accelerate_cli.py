@@ -12,6 +12,7 @@ from .estimate import estimate_command_parser
 from .launch import launch_command_parser
 from .lint import lint_command_parser
 from .merge import merge_command_parser
+from .metrics_dump import metrics_dump_command_parser
 from .serve_bench import serve_bench_command_parser
 from .test import test_command_parser
 from .tpu import tpu_command_parser
@@ -36,6 +37,7 @@ def get_parser() -> argparse.ArgumentParser:
     launch_command_parser(subparsers=subparsers)
     lint_command_parser(subparsers=subparsers)
     merge_command_parser(subparsers=subparsers)
+    metrics_dump_command_parser(subparsers=subparsers)
     serve_bench_command_parser(subparsers=subparsers)
     test_command_parser(subparsers=subparsers)
     tpu_command_parser(subparsers=subparsers)

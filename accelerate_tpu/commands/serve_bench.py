@@ -428,16 +428,78 @@ def _warm_serving_surface(params, cfg, max_slots, max_len, prompt_bucket,
     warm.run()
 
 
+class _ChaosObservability:
+    """One chaos-bench arm's live observability stack: a fresh enabled
+    ``Telemetry`` (forwarding to the caller's, when one was passed) and an
+    :class:`~..telemetry.alerts.AlertEngine` on the stock rule set over the
+    GATEWAY'S OWN metrics plane — the replay constructs its gateway/router
+    with ``GatewayConfig(metrics=True, metrics_window_s=...)``, so the plane
+    rides the production wiring on the replay's virtual clock (windows
+    measure virtual seconds, same time domain as deadlines and spans), and
+    :meth:`attach` only adds the rule engine. The proof surface: the chaos
+    arm must raise the expected ``alert/v1`` set, the clean arm must raise
+    none.
+
+    The thresholds are explicit, not the library defaults: the smoke traces
+    legitimately shed a few percent at their calibrated load, so the burn
+    gate is set where only injected-fault failure rates (>30% of the error
+    budget at objective 0.9) can reach it."""
+
+    #: The plane horizon the replay's gateway is configured with — the slow
+    #: burn window must fit inside it (AlertEngine validates this).
+    WINDOW_S = 120.0
+
+    def __init__(self, forward_to=None):
+        from ..telemetry import Telemetry
+        from ..utils.dataclasses import TelemetryConfig
+
+        self.telemetry = Telemetry(TelemetryConfig(
+            enabled=True, compile_events=False, memory_stats=False,
+        ))
+        if forward_to is not None and getattr(forward_to, "enabled", False):
+            self.telemetry.sinks.append(forward_to.emit)
+        self.plane = None
+        self.alerts = None
+
+    def attach(self, plane) -> None:
+        """Arm the rule engine on the gateway-built plane (called right after
+        gateway construction, before any record flows)."""
+        from ..telemetry.alerts import AlertEngine, default_alert_rules
+
+        self.plane = plane
+        self.alerts = AlertEngine(
+            plane,
+            default_alert_rules(objective=0.9, fast_window_s=30.0,
+                                slow_window_s=self.WINDOW_S,
+                                burn_threshold=3.0, fault_window_s=60.0),
+            eval_interval_s=1.0,
+        )
+
+    def summary(self) -> dict:
+        stats = self.plane.stats()
+        return {
+            "metrics": {k: stats[k] for k in
+                        ("records_consumed", "counters", "gauges", "slo")},
+            "alerts": self.alerts.summary(),
+        }
+
+    def fired_rules(self) -> set:
+        return {r["rule"] for r in self.alerts.fired if r["state"] == "firing"}
+
+
 def _replay_one_policy(params, cfg, policy, trace, *, max_slots, max_len,
                        prompt_bucket, max_queue, load, step_dt, seed,
                        page_size=0, kv_pages=None, telemetry=None,
-                       faults=None, on_token_factory=None):
+                       faults=None, on_token_factory=None,
+                       observability=None):
     """One fresh engine + gateway + virtual-clock replay of ``trace`` under
     ``policy`` → ``(gateway, gateway requests)``. The ONE construction both the
     per-policy rows and the attainment curves run, so they can never measure
     different gateway configurations. ``faults`` arms the engine's fault
     boundary with an injected plan (the chaos arm); ``on_token_factory(i)``
-    builds a per-request streaming callback (chaos stream-parity capture)."""
+    builds a per-request streaming callback (chaos stream-parity capture);
+    ``observability`` (a :class:`_ChaosObservability`) supplies the arm's
+    telemetry and is bound to the replay's virtual clock."""
     from ..serving import ContinuousBatcher
     from ..serving_gateway import ServingGateway
     from ..serving_gateway.workload import VirtualClock, replay_trace
@@ -445,18 +507,26 @@ def _replay_one_policy(params, cfg, policy, trace, *, max_slots, max_len,
     from ..utils.dataclasses import GatewayConfig
 
     clock = VirtualClock()
+    if observability is not None:
+        telemetry = observability.telemetry
     tracer = Tracer(telemetry, clock=clock) if telemetry is not None else None
     engine = ContinuousBatcher(
         params, cfg, max_slots=max_slots, max_len=max_len,
         prompt_bucket=prompt_bucket, page_size=page_size, kv_pages=kv_pages,
-        tracer=tracer, faults=faults,
+        tracer=tracer, faults=faults, telemetry=telemetry,
     )
     gw = ServingGateway(
         engine,
         GatewayConfig(enabled=True, policy=policy, max_queue=max_queue,
-                      overload="shed", aging_s=5.0),
+                      overload="shed", aging_s=5.0,
+                      metrics=observability is not None,
+                      metrics_window_s=(observability.WINDOW_S
+                                        if observability is not None
+                                        else 300.0)),
         telemetry=telemetry, clock=clock, tracer=tracer,
     )
+    if observability is not None:
+        observability.attach(gw.metrics)
     greqs = replay_trace(gw, trace, cfg.vocab_size, clock,
                          step_dt=step_dt, load=load, seed=seed,
                          on_token_factory=on_token_factory)
@@ -767,15 +837,22 @@ def run_chaos_bench(
                   prompt_bucket=prompt_bucket, max_queue=max_queue, load=load,
                   step_dt=step_dt, seed=seed, page_size=page_size,
                   kv_pages=kv_pages, telemetry=telemetry)
+    # Per-arm metrics plane + alert engine (the ISSUE-13 proof surface): the
+    # SAME rule set watches both arms; the chaos arm must fire the fault-burst
+    # (and, under enough injected failure, SLO-burn) alerts, the clean arm
+    # must stay silent.
+    obs_clean = _ChaosObservability(forward_to=telemetry)
+    obs_chaos = _ChaosObservability(forward_to=telemetry)
     clean_streams, clean_factory = stream_capture()
     gw_clean, greqs_clean = _replay_one_policy(
-        params, cfg, policy, trace, on_token_factory=clean_factory, **common
+        params, cfg, policy, trace, on_token_factory=clean_factory,
+        observability=obs_clean, **common
     )
     plan = _chaos_plan(chaos_sites, chaos_rate, seed)
     chaos_streams, chaos_factory = stream_capture()
     gw_chaos, greqs_chaos = _replay_one_policy(
         params, cfg, policy, trace, faults=plan,
-        on_token_factory=chaos_factory, **common
+        on_token_factory=chaos_factory, observability=obs_chaos, **common
     )
 
     # Stream parity: every request DONE in both arms must have produced the
@@ -789,8 +866,10 @@ def run_chaos_bench(
             compared += 1
             if clean_streams.get(i) != chaos_streams.get(i):
                 mismatched += 1
-    clean_arm = _chaos_arm_summary(gw_clean, greqs_clean)
-    chaos_arm = _chaos_arm_summary(gw_chaos, greqs_chaos)
+    clean_arm = {**_chaos_arm_summary(gw_clean, greqs_clean),
+                 **obs_clean.summary()}
+    chaos_arm = {**_chaos_arm_summary(gw_chaos, greqs_chaos),
+                 **obs_chaos.summary()}
     return {
         "schema": "accelerate_tpu.bench.chaos/v1",
         "preset": preset,
@@ -813,6 +892,12 @@ def run_chaos_bench(
         "streams_compared": compared,
         "streams_identical": mismatched == 0,
         "streams_mismatched": mismatched,
+        # Alert-plane invariants (gated by the CLI like the stream ones): the
+        # injected-fault arm must raise the fault-burst alert; the clean
+        # replay of the SAME trace under the SAME rules must raise nothing.
+        "alerts_clean_silent": not obs_clean.alerts.fired,
+        "alerts_chaos_fired": sorted(obs_chaos.fired_rules()),
+        "alerts_chaos_expected": "step-failure-burst" in obs_chaos.fired_rules(),
         "clean": clean_arm,
         "chaos": chaos_arm,
     }
@@ -821,23 +906,29 @@ def run_chaos_bench(
 def _replay_fleet(params, cfg, policy, trace, *, n_replicas, max_slots,
                   max_len, prompt_bucket, max_queue, load, step_dt, seed,
                   plans=None, restart_backoff=0.0, replica_restarts=4,
-                  telemetry=None, on_token_factory=None):
+                  telemetry=None, on_token_factory=None, observability=None):
     """One fresh N-replica FleetRouter + virtual-clock replay of ``trace`` →
     ``(router, gateway requests)``. ``plans[rid]`` arms replica ``rid``'s
     engine with its own seeded FaultPlan (the kill schedule); restarted
-    replicas keep their plan, so the whole chaos run stays deterministic."""
+    replicas keep their plan, so the whole chaos run stays deterministic.
+    ``observability`` binds a per-arm metrics plane + alert engine to the
+    replay's virtual clock (fault/recovery/health records flow from the
+    engines and router into it)."""
     from ..serving import ContinuousBatcher
     from ..serving_gateway import FleetRouter
     from ..serving_gateway.workload import VirtualClock, replay_trace
     from ..utils.dataclasses import GatewayConfig
 
     clock = VirtualClock()
+    if observability is not None:
+        telemetry = observability.telemetry
 
     def build_engine(rid):
         return ContinuousBatcher(
             params, cfg, max_slots=max_slots, max_len=max_len,
             prompt_bucket=prompt_bucket,
             faults=None if plans is None else plans[rid],
+            telemetry=telemetry,
         )
 
     router = FleetRouter(
@@ -845,9 +936,15 @@ def _replay_fleet(params, cfg, policy, trace, *, n_replicas, max_slots,
         GatewayConfig(enabled=True, policy=policy, max_queue=max_queue,
                       overload="shed", aging_s=5.0, breaker_threshold=3,
                       replica_restarts=replica_restarts,
-                      replica_restart_backoff=restart_backoff),
+                      replica_restart_backoff=restart_backoff,
+                      metrics=observability is not None,
+                      metrics_window_s=(observability.WINDOW_S
+                                        if observability is not None
+                                        else 300.0)),
         telemetry=telemetry, clock=clock, engine_factory=build_engine,
     )
+    if observability is not None:
+        observability.attach(router.metrics)
     greqs = replay_trace(router, trace, cfg.vocab_size, clock,
                          step_dt=step_dt, load=load, seed=seed,
                          on_token_factory=on_token_factory)
@@ -986,16 +1083,21 @@ def run_fleet_chaos_bench(
     common = dict(max_len=max_len, prompt_bucket=prompt_bucket,
                   max_queue=max_queue, load=load, step_dt=step_dt, seed=seed,
                   restart_backoff=restart_backoff, telemetry=telemetry)
+    # Per-arm alert planes: the kill sequence must trip the breaker-open (and
+    # fault-burst) alerts in the chaos arm; the clean fleet stays silent.
+    obs_clean = _ChaosObservability(forward_to=telemetry)
+    obs_chaos = _ChaosObservability(forward_to=telemetry)
     clean_streams, clean_factory = stream_capture()
     r_clean, g_clean = _replay_fleet(
         params, cfg, policy, trace, n_replicas=n_replicas,
-        max_slots=max_slots, on_token_factory=clean_factory, **common)
+        max_slots=max_slots, on_token_factory=clean_factory,
+        observability=obs_clean, **common)
     chaos_streams, chaos_factory = stream_capture()
     chaos_plans = kill_plans(n_replicas)
     r_chaos, g_chaos = _replay_fleet(
         params, cfg, policy, trace, n_replicas=n_replicas,
         max_slots=max_slots, plans=chaos_plans,
-        on_token_factory=chaos_factory, **common)
+        on_token_factory=chaos_factory, observability=obs_chaos, **common)
     single_plans = kill_plans(1)
     r_single, g_single = _replay_fleet(
         params, cfg, policy, trace, n_replicas=1, max_slots=total_lanes,
@@ -1008,9 +1110,11 @@ def run_fleet_chaos_bench(
             if clean_streams.get(i) != chaos_streams.get(i):
                 mismatched += 1
     clean_arm = {**_fleet_arm_summary(r_clean, g_clean),
-                 **_attainment_point(r_clean, g_clean, load)}
+                 **_attainment_point(r_clean, g_clean, load),
+                 **obs_clean.summary()}
     chaos_arm = {**_fleet_arm_summary(r_chaos, g_chaos),
-                 **_attainment_point(r_chaos, g_chaos, load)}
+                 **_attainment_point(r_chaos, g_chaos, load),
+                 **obs_chaos.summary()}
     single_arm = {**_fleet_arm_summary(r_single, g_single),
                   **_attainment_point(r_single, g_single, load)}
     p95_clean = (clean_arm["ttft"] or {}).get("p95")
@@ -1043,6 +1147,12 @@ def run_fleet_chaos_bench(
         "fleet_availability_above_single": (
             chaos_arm["availability"] > single_arm["availability"]
         ),
+        # Alert-plane invariants: the kill sequence must raise the
+        # replica-died alert (replica-unhealthy typically rides along while
+        # the dead replica restarts); the clean fleet must stay silent.
+        "alerts_clean_silent": not obs_clean.alerts.fired,
+        "alerts_chaos_fired": sorted(obs_chaos.fired_rules()),
+        "alerts_chaos_expected": "replica-died" in obs_chaos.fired_rules(),
         "fleet_clean": clean_arm,
         "fleet_chaos": chaos_arm,
         "single_chaos": single_arm,
@@ -1695,6 +1805,7 @@ def serve_bench_command(args) -> int:
             "schema", "n_replicas", "workload_trace_hash",
             "streams_compared", "streams_identical",
             "failover_ttft_p95_penalty", "fleet_availability_above_single",
+            "alerts_clean_silent", "alerts_chaos_fired",
         )} | {
             "silently_lost": artifact["fleet_chaos"]["silently_lost"],
             "availability_fleet": artifact["fleet_chaos"]["availability"],
@@ -1704,7 +1815,9 @@ def serve_bench_command(args) -> int:
         }))
         return 1 if (artifact["fleet_chaos"]["silently_lost"]
                      or not artifact["streams_identical"]
-                     or not artifact["fleet_availability_above_single"]) else 0
+                     or not artifact["fleet_availability_above_single"]
+                     or not artifact["alerts_clean_silent"]
+                     or not artifact["alerts_chaos_expected"]) else 0
 
     if args.chaos:
         if args.smoke:
@@ -1737,6 +1850,7 @@ def serve_bench_command(args) -> int:
         print(json.dumps({k: artifact[k] for k in (
             "schema", "chaos_rate", "workload_trace_hash",
             "streams_compared", "streams_identical",
+            "alerts_clean_silent", "alerts_chaos_fired",
         )} | {
             "silently_lost": artifact["chaos"]["silently_lost"],
             "availability_clean": artifact["clean"]["availability"],
@@ -1745,7 +1859,9 @@ def serve_bench_command(args) -> int:
             "fired_by_site": artifact["fault_plan"]["fired_by_site"],
         }))
         return 1 if (artifact["chaos"]["silently_lost"]
-                     or not artifact["streams_identical"]) else 0
+                     or not artifact["streams_identical"]
+                     or not artifact["alerts_clean_silent"]
+                     or not artifact["alerts_chaos_expected"]) else 0
 
     if args.trace_curves:
         loads = tuple(float(x) for x in args.loads.split(",") if x.strip())

@@ -26,22 +26,42 @@ Per request, the span set decomposes end-to-end latency into:
 The report aggregates p50/p95/p99 of each component over terminal requests
 (``telemetry.slo.latency_summary`` — the same percentile math the gateway
 stamps), a critical-path share per component, and terminal counts by status.
+
+``--train`` is the TRAINING twin: instead of trace spans it reads the MPMD
+record streams — ``mpmd.stage_step/v1`` (per-stage fenced busy time per
+step), ``mpmd.transfer/v1`` (DCN payloads), ``mpmd.barrier/v1`` +
+``elastic.restart/v1`` + the ``pipeline_replay`` recovery records — and
+answers the training question aggregates cannot: **where did each step's
+wall time go, per pipeline stage?** Per step it reconstructs the stage
+timeline (busy vs BUBBLE — lane-held-but-idle, the pipeline's stall),
+attributes stragglers (slowest-stage p95 busy vs the fleet median) and
+replays the crash→hold→restore timeline from records alone.
+
+Inputs may be one or many JSONL files (a rotated ``telemetry.*.jsonl`` set),
+gzip-compressed files (``.gz``), or a telemetry run DIRECTORY (reads the
+whole rotated set in chronological order).
 """
 
 from __future__ import annotations
 
 import argparse
+import glob
+import gzip
 import json
-from typing import Dict, List, Optional
+import os
+from typing import Dict, Iterable, List, Optional
 
-__all__ = ["trace_report", "load_spans", "trace_report_command",
-           "trace_report_command_parser"]
+__all__ = ["trace_report", "train_report", "load_spans", "load_records",
+           "trace_report_command", "trace_report_command_parser"]
 
 
 def trace_report_command_parser(subparsers=None) -> argparse.ArgumentParser:
     description = (
         "Reconstruct per-request timelines and a critical-path latency breakdown "
-        "(queue / prefill / decode / stall / retry) from trace.span/v1 records."
+        "(queue / prefill / decode / stall / retry) from trace.span/v1 records — "
+        "or, with --train, per-step MPMD pipeline timelines (stage busy vs "
+        "bubble, straggler attribution, crash/replay history) from the "
+        "mpmd.stage_step/transfer/barrier record streams."
     )
     if subparsers is not None:
         parser = subparsers.add_parser("trace-report", description=description)
@@ -49,34 +69,79 @@ def trace_report_command_parser(subparsers=None) -> argparse.ArgumentParser:
         parser = argparse.ArgumentParser(
             "accelerate-tpu trace-report", description=description
         )
-    parser.add_argument("jsonl", help="telemetry JSONL file containing trace spans")
+    parser.add_argument(
+        "jsonl", nargs="+",
+        help="telemetry JSONL input(s): files (.jsonl or .jsonl.gz, rotated "
+             "sets welcome) or a telemetry run directory",
+    )
+    parser.add_argument("--train", action="store_true",
+                        help="training mode: MPMD pipeline timeline report")
     parser.add_argument("--uid", type=int, default=None,
                         help="print one request's full span timeline")
     parser.add_argument("--timelines", type=int, default=0, metavar="N",
-                        help="also print the N slowest requests' timelines")
+                        help="also print the N slowest requests' (or, with "
+                             "--train, steps') timelines")
     if subparsers is not None:
         parser.set_defaults(func=trace_report_command)
     return parser
 
 
-def load_spans(path: str) -> List[dict]:
-    """The trace.span/v1 records of one JSONL file (other records are skipped —
-    a telemetry run directory mixes streams by design)."""
+def _expand_inputs(paths: Iterable[str]) -> List[str]:
+    """Files to read, in chronological order. A directory expands to its
+    rotated telemetry set: ``telemetry.<n>.jsonl`` ascending (zero-padded —
+    lexical order IS chronological), the active ``telemetry.jsonl`` last."""
+    out: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            rolled = sorted(
+                glob.glob(os.path.join(path, "telemetry.*.jsonl"))
+                + glob.glob(os.path.join(path, "telemetry.*.jsonl.gz"))
+            )
+            out.extend(rolled)
+            active = os.path.join(path, "telemetry.jsonl")
+            if os.path.exists(active):
+                out.append(active)
+        else:
+            out.append(path)
+    return out
+
+
+def _open_text(path: str):
+    if path.endswith(".gz"):
+        return gzip.open(path, "rt", encoding="utf-8")
+    return open(path, "r", encoding="utf-8")
+
+
+def load_records(paths, schemas=None) -> List[dict]:
+    """Records from one or many JSONL inputs (plain or gzip, file or run
+    directory), optionally filtered to a schema-id set. Order is file order —
+    rotated sets expand chronologically."""
+    if isinstance(paths, (str, os.PathLike)):
+        paths = [paths]
+    if schemas is not None:
+        schemas = frozenset(schemas)
+    records = []
+    for path in _expand_inputs(paths):
+        with _open_text(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if schemas is None or rec.get("schema") in schemas:
+                    records.append(rec)
+    return records
+
+
+def load_spans(path) -> List[dict]:
+    """The trace.span/v1 records of one (or many) JSONL input(s) — other
+    records are skipped; a telemetry run directory mixes streams by design."""
     from ..telemetry.schemas import TRACE_SPAN_SCHEMA
 
-    spans = []
-    with open(path, "r", encoding="utf-8") as f:
-        for line in f:
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                rec = json.loads(line)
-            except json.JSONDecodeError:
-                continue
-            if rec.get("schema") == TRACE_SPAN_SCHEMA:
-                spans.append(rec)
-    return spans
+    return load_records(path, schemas={TRACE_SPAN_SCHEMA})
 
 
 def _reconstruct(spans: List[dict]) -> dict:
@@ -227,6 +292,208 @@ def trace_report(records: List[dict]) -> dict:
     }
 
 
+# ------------------------------------------------------------------ train mode
+def train_report(records: List[dict]) -> dict:
+    """The MPMD pipeline timeline report, from records alone.
+
+    Per training step, the per-stage ``mpmd.stage_step/v1`` records decompose
+    the step's wall span (first ``t0`` → last ``t1`` across stages) into BUSY
+    (fenced fwd/bwd/apply compute, as measured by the stage itself) and
+    BUBBLE (span minus busy — the stage held its devices but ran nothing:
+    pipeline fill/drain, waiting on a peer's microbatch, a straggler's
+    backward). Per stage, ``busy_share + bubble_share == 1`` by construction.
+
+    Straggler attribution follows the multi-slice tuning playbook: the
+    straggler is the stage with the highest p95 busy time, reported against
+    the fleet median busy time (``straggler_p95_vs_fleet_median``) — >1 means
+    that stage bounds the pipeline.
+
+    A step re-executed after crash recovery appears twice in the stream; the
+    LAST record per (step, stage) — the surviving lineage — wins, and the
+    overwritten attempts are counted as ``replayed_cells``. The recovery
+    timeline itself (which gang crashed at which step, who held, where the
+    replay restored to) is rebuilt from the ``mpmd.barrier/v1`` +
+    ``pipeline_replay`` recovery + ``elastic.restart/v1`` records.
+    """
+    from ..telemetry.schemas import (
+        ELASTIC_RESTART_SCHEMA,
+        MPMD_BARRIER_SCHEMA,
+        MPMD_STAGE_STEP_SCHEMA,
+        MPMD_TRANSFER_SCHEMA,
+        RECOVERY_SCHEMA,
+    )
+    from ..telemetry.slo import latency_summary, percentile
+
+    cells: Dict[tuple, dict] = {}       # (step, stage) → last record
+    replayed_cells = 0
+    transfers: List[dict] = []
+    barriers: List[dict] = []
+    restarts: List[dict] = []
+    replays: List[dict] = []
+    for rec in records:
+        schema = rec.get("schema")
+        if schema == MPMD_STAGE_STEP_SCHEMA:
+            key = (rec["step"], rec["stage"])
+            if key in cells:
+                replayed_cells += 1
+            cells[key] = rec
+        elif schema == MPMD_TRANSFER_SCHEMA:
+            transfers.append(rec)
+        elif schema == MPMD_BARRIER_SCHEMA:
+            barriers.append(rec)
+        elif schema == ELASTIC_RESTART_SCHEMA:
+            restarts.append(rec)
+        elif schema == RECOVERY_SCHEMA and rec.get("action") == "pipeline_replay":
+            replays.append(rec)
+
+    stages = sorted({stage for _, stage in cells})
+    steps = sorted({step for step, _ in cells})
+    # Per-step spans and per-stage busy/bubble decomposition.
+    per_step: List[dict] = []
+    busy_by_stage: Dict[int, List[float]] = {s: [] for s in stages}
+    bubble_by_stage: Dict[int, float] = {s: 0.0 for s in stages}
+    for step in steps:
+        row = {s: cells[(step, s)] for s in stages if (step, s) in cells}
+        t0 = min(r["t0"] for r in row.values())
+        t1 = max(r["t1"] for r in row.values())
+        span = max(t1 - t0, 0.0)
+        stage_rows = {}
+        for s, r in row.items():
+            busy = min(r["busy_s"], span) if span > 0 else r["busy_s"]
+            busy_by_stage[s].append(r["busy_s"])
+            bubble_by_stage[s] += max(span - busy, 0.0)
+            stage_rows[s] = {
+                "busy_s": round(r["busy_s"], 9),
+                "bubble_s": round(max(span - busy, 0.0), 9),
+                "fwd_s": r.get("fwd_s"),
+                "bwd_s": r.get("bwd_s"),
+                "apply_s": r.get("apply_s"),
+            }
+        per_step.append({
+            "step": step,
+            "span_s": round(span, 9),
+            "stages": stage_rows,
+        })
+
+    stage_summary = {}
+    all_busy: List[float] = []
+    for s in stages:
+        busy_total = sum(busy_by_stage[s])
+        bubble_total = bubble_by_stage[s]
+        held = busy_total + bubble_total
+        all_busy.extend(busy_by_stage[s])
+        stage_summary[s] = {
+            "steps": len(busy_by_stage[s]),
+            "busy_s": round(busy_total, 9),
+            "bubble_s": round(bubble_total, 9),
+            # The per-stage decomposition: these two sum to 1 by construction
+            # (busy + bubble IS the stage's held span).
+            "busy_share": round(busy_total / held, 6) if held > 0 else None,
+            "bubble_share": round(bubble_total / held, 6) if held > 0 else None,
+            "busy": latency_summary(busy_by_stage[s]),
+        }
+    total_busy = sum(sum(v) for v in busy_by_stage.values())
+    total_bubble = sum(bubble_by_stage.values())
+    total_held = total_busy + total_bubble
+
+    straggler = None
+    if stages and all_busy:
+        p95_by_stage = {
+            s: percentile(busy_by_stage[s], 95)
+            for s in stages if busy_by_stage[s]
+        }
+        worst = max(p95_by_stage, key=p95_by_stage.get)
+        fleet_median = percentile(all_busy, 50)
+        straggler = {
+            "stage": worst,
+            "p95_busy_s": round(p95_by_stage[worst], 9),
+            "fleet_median_busy_s": round(fleet_median, 9),
+            "straggler_p95_vs_fleet_median": (
+                round(p95_by_stage[worst] / fleet_median, 4)
+                if fleet_median > 0 else None
+            ),
+        }
+
+    # DCN accounting by direction.
+    dcn = {}
+    for direction in ("fwd", "bwd"):
+        mine = [t for t in transfers if t.get("direction") == direction]
+        dcn[direction] = {
+            "transfers": len(mine),
+            "bytes": sum(int(t.get("nbytes") or 0) for t in mine),
+            "latency": latency_summary([t.get("dur_s") for t in mine]),
+        }
+
+    # Recovery timeline, in record order: a hold set (holding gangs + the
+    # crashed peer + crash step), the replay that resolved it, the restart
+    # accounting per gang.
+    timeline: List[dict] = []
+    hold_open: Dict[tuple, dict] = {}
+    for rec in barriers:
+        key = (rec["peer"], rec["step"]) if rec["action"] == "hold" else None
+        if rec["action"] == "hold":
+            event = hold_open.get(key)
+            if event is None:
+                event = {
+                    "event": "hold", "crashed": rec["peer"],
+                    "step": rec["step"], "holders": [],
+                }
+                hold_open[key] = event
+                timeline.append(event)
+            event["holders"].append(rec["gang_id"])
+        else:
+            timeline.append({
+                "event": "release", "crashed": rec["peer"],
+                "restored_step": rec["step"],
+                "holders": [rec["gang_id"]],
+            })
+    for rec in replays:
+        timeline.append({
+            "event": "replay", "gang": rec.get("gang_id"),
+            "crashed_at": rec.get("crashed_at"),
+            "restored_step": rec.get("restored_step"),
+        })
+    restarts_by_gang: Dict[str, int] = {}
+    for rec in restarts:
+        gang = rec.get("gang_id")
+        restarts_by_gang[gang] = restarts_by_gang.get(gang, 0) + 1
+
+    return {
+        "n_steps": len(steps),
+        "n_stages": len(stages),
+        "replayed_cells": replayed_cells,
+        "step_span": latency_summary([row["span_s"] for row in per_step]),
+        "pipeline": {
+            "busy_s": round(total_busy, 9),
+            "bubble_s": round(total_bubble, 9),
+            # Whole-pipeline decomposition over every (step, stage) cell —
+            # the two shares sum to 1 (the acceptance contract).
+            "busy_share": (round(total_busy / total_held, 6)
+                           if total_held > 0 else None),
+            "bubble_share": (round(total_bubble / total_held, 6)
+                             if total_held > 0 else None),
+        },
+        "stages": stage_summary,
+        "straggler": straggler,
+        "dcn": dcn,
+        "recovery": {
+            "stage_crashes": len(replays),
+            "restarts_by_gang": restarts_by_gang,
+            "timeline": timeline,
+        },
+        "steps": per_step,
+    }
+
+
+def _print_step_timeline(row: dict, out) -> None:
+    print(f"-- step={row['step']} span={row['span_s']:.6f}s", file=out)
+    for stage, cell in sorted(row["stages"].items()):
+        print(f"   stage {stage}: busy {cell['busy_s']:.6f}s "
+              f"(fwd {cell['fwd_s']:.6f} / bwd {cell['bwd_s']:.6f} / "
+              f"apply {cell['apply_s']:.6f})  bubble {cell['bubble_s']:.6f}s",
+              file=out)
+
+
 def _print_timeline(trace: dict, out) -> None:
     t0 = min(s["t0"] for s in trace["spans"])
     print(f"-- uid={trace['uid']} trace={trace['trace_id']} "
@@ -241,6 +508,22 @@ def _print_timeline(trace: dict, out) -> None:
 
 def trace_report_command(args) -> int:
     import sys
+
+    if args.train:
+        records = load_records(args.jsonl)
+        report = train_report(records)
+        if report["n_steps"] == 0:
+            print(f"trace-report --train: no mpmd.stage_step/v1 records in "
+                  f"{args.jsonl}", file=sys.stderr)
+            return 1
+        if args.timelines:
+            slowest = sorted(report["steps"],
+                             key=lambda r: -r["span_s"])[: args.timelines]
+            for row in slowest:
+                _print_step_timeline(row, sys.stdout)
+        summary = {k: v for k, v in report.items() if k != "steps"}
+        print(json.dumps(summary, indent=2))
+        return 0
 
     spans = load_spans(args.jsonl)
     if not spans:

@@ -495,6 +495,22 @@ class GangOfGangs:
         self.replayed_steps += max(0, crashed_at - restored)
         del self.ledger[restored:]
         del self.losses[restored:]
+        tel = self.telemetry
+        if tel is not None and getattr(tel, "enabled", False):
+            # One recovery record per completed replay: with the hold/release
+            # barrier pair this makes the whole crash→restore timeline (which
+            # gang, crashed at which step, replayed from which epoch)
+            # reconstructable from records alone — `trace-report --train`
+            # renders it, and the metrics plane counts it.
+            from .telemetry.schemas import RECOVERY_SCHEMA
+
+            tel.emit({
+                "schema": RECOVERY_SCHEMA,
+                "action": "pipeline_replay",
+                "gang_id": gang,
+                "crashed_at": int(crashed_at),
+                "restored_step": int(restored),
+            })
         self._emit_barrier("release", gang, restored)
 
     # ------------------------------------------------------------ driving

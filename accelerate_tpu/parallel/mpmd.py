@@ -52,6 +52,7 @@ optimizer applies once per step, so two runs fed the same per-step batches are
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable, List, Optional
 
 import numpy as np
@@ -164,6 +165,13 @@ class StageProcess:
         self._saved: List[Any] = []
         self._gacc = None
         self._losses: List[Any] = []
+        # Per-step phase timing (telemetry-enabled only): one
+        # ``mpmd.stage_step/v1`` record per stage per step — the per-stage
+        # busy timeline ``trace-report --train`` reconstructs pipeline
+        # bubbles and straggler attribution from. None while disabled: the
+        # hot path then pays one attribute read per call, no clock reads.
+        self._phase_s: Optional[dict] = None
+        self._t_step0 = 0.0
 
     # ------------------------------------------------------------ programs
     def _build_programs(self, cache) -> None:
@@ -227,14 +235,35 @@ class StageProcess:
                 raise plan.fault_for(spec, "train.step")
         self._saved = []
         self._losses = []
-        self._gacc = self._zero(self.params)
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            self._phase_s = {"fwd": 0.0, "bwd": 0.0, "apply": 0.0}
+            self._t_step0 = time.monotonic()
+        else:
+            self._phase_s = None
+        self._gacc = self._timed("apply", self._zero, self.params)
+
+    def _timed(self, phase: str, fn, *args):
+        """Run one stage program, attributing its fenced wall time to
+        ``phase`` when this step is being timed (``block_until_ready`` before
+        the second clock read — dispatch-only timing would credit the stage
+        with work the device hasn't done; the compute would then be mis-billed
+        to whichever call happens to synchronize, exactly the bench_rev-2
+        lesson ``telemetry.timing`` exists to prevent)."""
+        if self._phase_s is None:
+            return fn(*args)
+        t0 = time.monotonic()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        self._phase_s[phase] += time.monotonic() - t0
+        return out
 
     def forward(self, x):
         """Forward one microbatch (non-last stages); the input is SAVED for
         the recompute-based backward, the returned activation is the caller's
         transfer payload."""
         self._saved.append(x)
-        return self._fwd(self.params, x)
+        return self._timed("fwd", self._fwd, self.params, x)
 
     def stash(self, x, targets) -> None:
         """Bank the last stage's microbatch input — its forward, loss and
@@ -247,23 +276,44 @@ class StageProcess:
         ``ct`` (it owns the loss) and records the microbatch loss."""
         if self.is_last:
             x, targets = self._saved.pop()
-            loss, self._gacc, ct_out = self._loss_bwd(
-                self.params, x, targets, self._gacc
+            loss, self._gacc, ct_out = self._timed(
+                "bwd", self._loss_bwd, self.params, x, targets, self._gacc
             )
             self._losses.append(loss)
             return ct_out
         x = self._saved.pop()
-        self._gacc, ct_out = self._bwd(self.params, x, ct, self._gacc)
+        self._gacc, ct_out = self._timed(
+            "bwd", self._bwd, self.params, x, ct, self._gacc
+        )
         return ct_out
 
     def apply_step(self) -> None:
         """Apply the microbatch-averaged accumulated grads, advance the
-        stage-local step counter."""
+        stage-local step counter — and close this step's timing record."""
         if self._apply is not None:
-            self.params, self.opt_state = self._apply(
-                self.params, self.opt_state, self._gacc
+            self.params, self.opt_state = self._timed(
+                "apply", self._apply, self.params, self.opt_state, self._gacc
             )
         self._gacc = None
+        if self._phase_s is not None:
+            from ..telemetry.schemas import MPMD_STAGE_STEP_SCHEMA
+
+            t1 = time.monotonic()
+            phases = self._phase_s
+            self._phase_s = None
+            self.telemetry.emit({
+                "schema": MPMD_STAGE_STEP_SCHEMA,
+                "gang_id": self.gang_id,
+                "stage": self.stage_id,
+                "step": self.step,
+                "t0": round(self._t_step0, 9),
+                "t1": round(t1, 9),
+                "busy_s": round(sum(phases.values()), 9),
+                "fwd_s": round(phases["fwd"], 9),
+                "bwd_s": round(phases["bwd"], 9),
+                "apply_s": round(phases["apply"], 9),
+                "microbatches": self.n_microbatches,
+            })
         self.step += 1
 
     def take_losses(self) -> List[float]:
@@ -298,6 +348,7 @@ class StageProcess:
             if state["opt_state"] is not None else None
         )
         self._saved, self._losses, self._gacc = [], [], None
+        self._phase_s = None  # a restored stage never emits a half-timed step
 
     # ------------------------------------------------------------ warmup/audit
     def warm_programs(self, x, targets=None) -> list:

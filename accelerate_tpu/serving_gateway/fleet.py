@@ -729,6 +729,12 @@ class FleetRouter(ServingGateway):
             ],
             "supervisor": self.supervisor.stats(),
             "slo": self.slo_summary(),
+            # The live metrics plane (GatewayConfig.metrics, inherited from
+            # the base constructor): the per-replica health/route records this
+            # router emits every step land back here as labeled gauges — the
+            # fleet-wide signal surface the autoscaler polls.
+            **({"metrics": self.metrics.stats()} if self.metrics is not None
+               else {}),
         }
 
     def __repr__(self) -> str:

@@ -289,6 +289,21 @@ class ServingGateway:
             if tracer.enabled:
                 tracer._clock = clock
         self._clock = clock
+        # Live metrics plane (config.metrics): a Telemetry SINK folding the
+        # record stream — the records the gateway/engine/fleet already emit,
+        # zero new emit sites — into live counters/gauges/sliding-window
+        # histograms on the gateway's own clock (virtual-clock replays get
+        # virtual-time windows). ``stats()`` exposes the snapshot; alert
+        # engines (telemetry.alerts) attach to the plane, not the gateway.
+        self.metrics = None
+        if config.metrics and telemetry is not None and getattr(
+            telemetry, "enabled", False
+        ):
+            from ..telemetry.metrics import MetricsPlane
+
+            self.metrics = MetricsPlane(
+                telemetry, clock=clock, window_s=config.metrics_window_s
+            )
         self._policy = make_policy(config)
         self._uid = 0
         self._queued_cost = 0
@@ -961,7 +976,7 @@ class ServingGateway:
 
     def stats(self) -> dict:
         """Gateway + nested engine observability snapshot."""
-        return {
+        out = {
             "policy": self._policy.name,
             "queued": len(self._policy),
             "queued_cost_tokens": self._queued_cost,
@@ -974,6 +989,9 @@ class ServingGateway:
             "slo": self.slo_summary(),
             "engine": self.engine.stats(),
         }
+        if self.metrics is not None:
+            out["metrics"] = self.metrics.stats()
+        return out
 
     def __repr__(self) -> str:
         return (

@@ -23,17 +23,23 @@ Enable via ``Accelerator(telemetry_config=TelemetryConfig(enabled=True, ...))`` 
 ``ACCELERATE_TELEMETRY=1`` in the environment (docs/telemetry.md).
 """
 
+from .alerts import AlertEngine, AlertRule, default_alert_rules
 from .compile_monitor import CompileMonitor, compile_label
 from .core import STEP_RECORD_SCHEMA, Telemetry
 from .derived import PEAK_TFLOPS, derived_rates, peak_tflops
+from .exporter import MetricsExporter, prometheus_text
 from .memory import device_memory_stats
+from .metrics import METRIC_REGISTRY, MetricsPlane, registered_metrics
 from .profiler import ScheduledProfiler
 from .provenance import config_fingerprint, git_commit, provenance_stamp
 from .schemas import (
+    ALERT_SCHEMA,
     AUDIT_PROGRAM_SCHEMA,
     FAULT_SCHEMA,
     FLEET_ROUTE_SCHEMA,
+    METRICS_SNAPSHOT_SCHEMA,
     MPMD_BARRIER_SCHEMA,
+    MPMD_STAGE_STEP_SCHEMA,
     MPMD_TRANSFER_SCHEMA,
     RECOVERY_SCHEMA,
     REPLICA_HEALTH_SCHEMA,
@@ -60,8 +66,19 @@ from .timing import StepTimer, StepTiming, fence
 from .tracing import Tracer, TraceHandle
 
 __all__ = [
+    "AlertEngine",
+    "AlertRule",
+    "default_alert_rules",
     "CompileMonitor",
     "compile_label",
+    "MetricsExporter",
+    "prometheus_text",
+    "METRIC_REGISTRY",
+    "MetricsPlane",
+    "registered_metrics",
+    "ALERT_SCHEMA",
+    "METRICS_SNAPSHOT_SCHEMA",
+    "MPMD_STAGE_STEP_SCHEMA",
     "STEP_RECORD_SCHEMA",
     "Telemetry",
     "PEAK_TFLOPS",

@@ -108,14 +108,14 @@ class Telemetry:
         self.flops_per_step: Optional[float] = config.flops_per_step
         self.tokens_per_step: Optional[float] = config.tokens_per_step
         self.examples_per_step: Optional[float] = config.examples_per_step
+        self._jsonl_path = None
         if self.enabled:
             if config.compile_events:
                 self.compile_monitor.start()
             if config.jsonl_dir:
                 os.makedirs(config.jsonl_dir, exist_ok=True)
-                self._jsonl_file = open(
-                    os.path.join(config.jsonl_dir, "telemetry.jsonl"), "a"
-                )
+                self._jsonl_path = os.path.join(config.jsonl_dir, "telemetry.jsonl")
+                self._jsonl_file = open(self._jsonl_path, "a")
 
     # ------------------------------------------------------------------ hints
     def set_throughput_hints(
@@ -244,8 +244,33 @@ class Telemetry:
         if self._jsonl_file is not None:
             self._jsonl_file.write(json.dumps(record, default=float) + "\n")
             self._jsonl_file.flush()
+            # Size-based rotation (config.rotate_bytes > 0): the active file
+            # rolls to telemetry.<n>.jsonl once it crosses the bound, so a
+            # long chaos run never grows one unbounded file. Zero-padded n —
+            # lexical order IS chronological, which is the contract the
+            # multi-file readers (trace-report, metrics-dump) sort by.
+            rotate = self.config.rotate_bytes
+            if rotate and self._jsonl_file.tell() >= rotate:
+                self._rotate_jsonl()
         for sink in self.sinks:
             sink(record)
+
+    def _rotate_jsonl(self) -> None:
+        self._jsonl_file.close()
+        directory = os.path.dirname(self._jsonl_path)
+        # max(existing)+1, NOT first-free-slot: an operator deleting an old
+        # rotated file to reclaim disk must not make the next rotation reuse
+        # its low index — the readers sort lexically and the newest records
+        # would land first. (Also one listdir instead of an O(n) exists scan.)
+        taken = [-1]
+        for fname in os.listdir(directory):
+            if fname.startswith("telemetry.") and fname.endswith(".jsonl"):
+                mid = fname[len("telemetry."):-len(".jsonl")]
+                if mid.isdigit():
+                    taken.append(int(mid))
+        rolled = os.path.join(directory, f"telemetry.{max(taken) + 1:05d}.jsonl")
+        os.replace(self._jsonl_path, rolled)
+        self._jsonl_file = open(self._jsonl_path, "a")
 
     def log_columns(self, prefix: str = "telemetry/") -> dict:
         """The last step record flattened to scalar columns for tracker merging."""

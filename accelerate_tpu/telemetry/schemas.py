@@ -41,10 +41,13 @@ __all__ = [
     "ELASTIC_RESTART_SCHEMA",
     "MPMD_TRANSFER_SCHEMA",
     "MPMD_BARRIER_SCHEMA",
+    "MPMD_STAGE_STEP_SCHEMA",
     "AUDIT_PROGRAM_SCHEMA",
     "TRACE_SPAN_SCHEMA",
     "FAULT_SCHEMA",
     "RECOVERY_SCHEMA",
+    "ALERT_SCHEMA",
+    "METRICS_SNAPSHOT_SCHEMA",
     "RecordSchema",
     "SCHEMA_REGISTRY",
     "registered_schemas",
@@ -116,6 +119,13 @@ MPMD_TRANSFER_SCHEMA = "accelerate_tpu.telemetry.mpmd.transfer/v1"
 #: ``step`` the global training step the pipeline held at.
 MPMD_BARRIER_SCHEMA = "accelerate_tpu.telemetry.mpmd.barrier/v1"
 
+#: One record per MPMD stage per training step (``parallel.mpmd.StageProcess``):
+#: host-fenced per-phase compute seconds (``fwd_s``/``bwd_s``/``apply_s``,
+#: summed as ``busy_s``) between the step's wall-clock bounds ``t0``/``t1`` —
+#: the per-stage timeline ``trace-report --train`` reconstructs pipeline
+#: bubbles and straggler attribution from.
+MPMD_STAGE_STEP_SCHEMA = "accelerate_tpu.telemetry.mpmd.stage_step/v1"
+
 #: One record per warmup-precompiled program: graftaudit collective inventory
 #: and donation effectiveness (``compile_cache.warmup``).
 AUDIT_PROGRAM_SCHEMA = "accelerate_tpu.telemetry.audit.program/v1"
@@ -134,6 +144,19 @@ FAULT_SCHEMA = "accelerate_tpu.telemetry.fault/v1"
 #: rebuild, bisection round, circuit-breaker transition, checkpoint fallback.
 #: ``action`` is machine-readable; the other columns are action-specific.
 RECOVERY_SCHEMA = "accelerate_tpu.telemetry.recovery/v1"
+
+#: One record per alert-state transition (``telemetry.alerts.AlertEngine``):
+#: ``rule`` names the :class:`~.alerts.AlertRule`, ``state`` is
+#: ``firing``/``resolved``, ``kind`` is ``threshold``/``burn_rate``, ``value``
+#: the observed aggregate and ``threshold`` the bound it crossed — the live
+#: trigger surface an SLO-driven autoscaler subscribes to (ROADMAP item 5).
+ALERT_SCHEMA = "accelerate_tpu.telemetry.alert/v1"
+
+#: One point-in-time dump of the whole metrics plane
+#: (``telemetry.metrics.MetricsPlane.snapshot_record``): every counter, gauge
+#: and sliding-window histogram summary plus the SLO event-window block —
+#: what bench rows stamp and ``metrics-dump`` prints.
+METRICS_SNAPSHOT_SCHEMA = "accelerate_tpu.telemetry.metrics.snapshot/v1"
 
 
 # --------------------------------------------------------------------- registry
@@ -251,6 +274,13 @@ SCHEMA_REGISTRY: Dict[str, RecordSchema] = {
             "a healthy gang holding at / released from the recovery barrier",
         ),
         _reg(
+            MPMD_STAGE_STEP_SCHEMA,
+            ("gang_id", "stage", "step", "t0", "t1", "busy_s", "fwd_s",
+             "bwd_s", "apply_s", "microbatches"),
+            "parallel.mpmd.StageProcess",
+            "one stage's fenced per-phase compute seconds for one train step",
+        ),
+        _reg(
             AUDIT_PROGRAM_SCHEMA,
             ("label", "collectives", "donation"),
             "compile_cache.warmup",
@@ -273,6 +303,18 @@ SCHEMA_REGISTRY: Dict[str, RecordSchema] = {
             ("action",),
             "recovery boundaries (engine/gateway/checkpointing)",
             "one recovery action (quarantine/rebuild/bisect/circuit/fallback)",
+        ),
+        _reg(
+            ALERT_SCHEMA,
+            ("rule", "state", "severity", "kind", "t"),
+            "telemetry.alerts.AlertEngine",
+            "one alert-state transition (firing/resolved) over plane aggregates",
+        ),
+        _reg(
+            METRICS_SNAPSHOT_SCHEMA,
+            ("t", "counters", "gauges", "histograms", "slo"),
+            "telemetry.metrics.MetricsPlane.snapshot_record",
+            "one point-in-time dump of every live counter/gauge/histogram",
         ),
     )
 }

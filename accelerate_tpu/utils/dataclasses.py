@@ -331,6 +331,13 @@ class TelemetryConfig(KwargsHandler):
 
     enabled: Optional[bool] = None          # None → env ACCELERATE_TELEMETRY > False
     jsonl_dir: Optional[str] = None         # None → env ACCELERATE_TELEMETRY_DIR
+    # Size-based JSONL rotation: when > 0 and the active telemetry.jsonl
+    # crosses this many bytes, it is renamed telemetry.<n>.jsonl (n ascending,
+    # zero-padded — lexical sort IS chronological) and a fresh file opened, so
+    # a long chaos run never produces one unbounded file. 0 = never rotate
+    # (the historical behavior). Readers (trace-report, metrics-dump) accept
+    # the whole rotated set.
+    rotate_bytes: int = 0
     steady_k: int = 2
     steady_rtol: float = 0.10
     steady_cap: int = 50                    # 0 = never cap the warmup
@@ -354,6 +361,10 @@ class TelemetryConfig(KwargsHandler):
             raise ValueError(f"steady_rtol={self.steady_rtol} must be > 0")
         if self.steady_cap < 0:
             raise ValueError(f"steady_cap={self.steady_cap} must be >= 0 (0 = no cap)")
+        if self.rotate_bytes < 0:
+            raise ValueError(
+                f"rotate_bytes={self.rotate_bytes} must be >= 0 (0 = never rotate)"
+            )
 
 
 #: Env values that toggle ACCELERATE_COMPILE_CACHE on/off; anything else is a path.
@@ -585,6 +596,16 @@ class GatewayConfig(KwargsHandler):
     # run decode-only lanes (docs/disaggregated_serving.md). None = homogeneous
     # FleetRouter.
     replica_roles: Optional[str] = None
+    # Live metrics plane (``telemetry.metrics.MetricsPlane``): when True AND a
+    # telemetry object is attached and enabled, the gateway builds a plane as
+    # a telemetry sink (zero new emit sites) sharing the gateway's clock, and
+    # ``stats()``/bench rows expose its snapshot. Off by default; with
+    # telemetry disabled the knob is inert (the plane's disabled contract is
+    # the two-attr-read one, like Tracer's).
+    metrics: bool = False
+    # Sliding-window horizon (seconds, on the gateway clock) for the plane's
+    # histograms / SLO event window / counter-increase reads.
+    metrics_window_s: float = 300.0
 
     def __post_init__(self):
         raw = os.environ.get("ACCELERATE_GATEWAY")
@@ -649,6 +670,10 @@ class GatewayConfig(KwargsHandler):
             raise ValueError(
                 f"drain_deadline_s={self.drain_deadline_s} must be > 0 "
                 "(None = wait for in-flight requests forever)"
+            )
+        if self.metrics_window_s <= 0:
+            raise ValueError(
+                f"metrics_window_s={self.metrics_window_s} must be > 0"
             )
         if self.replica_restarts < 0:
             raise ValueError(

@@ -841,8 +841,20 @@ def _run_serving_rows(preset: str | None) -> int:
 
         jax.config.update("jax_platforms", "cpu")
     from accelerate_tpu.commands.serve_bench import run_serve_bench
+    from accelerate_tpu.telemetry import MetricsPlane, Telemetry
+    from accelerate_tpu.utils.dataclasses import TelemetryConfig
 
+    # Live metrics plane over the whole serving bench: every row additionally
+    # stamps the plane's end-of-bench snapshot (the ISSUE-13 surface) so a
+    # bench artifact carries the same aggregates a live scrape would. The
+    # default 300 s window covers the whole smoke bench on the wall clock, so
+    # the derived rates (tokens/s) are real recent-rates, not totals divided
+    # by an absurd horizon.
+    tel = Telemetry(TelemetryConfig(enabled=True, compile_events=False,
+                                    memory_stats=False))
+    plane = MetricsPlane(tel)
     rows = run_serve_bench(
+        telemetry=tel,
         preset=preset or "smoke",
         requests=int(_os.environ.get("BENCH_SERVE_REQUESTS", "48")),
         max_slots=int(_os.environ.get("BENCH_SERVE_SLOTS", "4")),
@@ -862,7 +874,9 @@ def _run_serving_rows(preset: str | None) -> int:
         kv_pages=(int(_os.environ["BENCH_SERVE_KV_PAGES"])
                   if _os.environ.get("BENCH_SERVE_KV_PAGES") else None),
     )
+    snapshot = plane.snapshot_record()
     for row in rows:
+        row["metrics_snapshot"] = snapshot
         print(json.dumps(row))
     return 0
 
@@ -908,13 +922,24 @@ def _run_elastic_row() -> int:
 
     jax.config.update("jax_platforms", "cpu")
     from accelerate_tpu.commands.chaos_train import run_chaos_train
+    from accelerate_tpu.telemetry import MetricsPlane, Telemetry
+    from accelerate_tpu.utils.dataclasses import TelemetryConfig
 
+    # Metrics plane over the chaos-train record stream: the artifact stamps
+    # the live-aggregate snapshot (MPMD stage-step latency windows, DCN bytes,
+    # per-gang restart budgets) beside the post-hoc invariants. Default
+    # window: the run fits inside it on the wall clock.
+    tel = Telemetry(TelemetryConfig(enabled=True, compile_events=False,
+                                    memory_stats=False))
+    plane = MetricsPlane(tel)
     artifact = run_chaos_train(
         steps=int(_os.environ.get("BENCH_ELASTIC_STEPS", "24")),
         stages=int(_os.environ.get("BENCH_ELASTIC_STAGES", "2")),
         crash_rate=float(_os.environ.get("BENCH_ELASTIC_CRASH_RATE", "0.12")),
         seed=int(_os.environ.get("BENCH_ELASTIC_SEED", "0")),
+        telemetry=tel,
     )
+    artifact["metrics_snapshot"] = plane.snapshot_record()
     out = _os.environ.get("BENCH_ELASTIC_OUT", "BENCH_ELASTIC.json")
     with open(out, "w") as f:
         json.dump(artifact, f, indent=2)

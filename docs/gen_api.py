@@ -97,9 +97,13 @@ MODULES = [
     ("accelerate_tpu.telemetry.slo", "SLO summaries and record schemas"),
     ("accelerate_tpu.telemetry.schemas", "Telemetry schema registry"),
     ("accelerate_tpu.telemetry.tracing", "Request-scoped tracing"),
+    ("accelerate_tpu.telemetry.metrics", "Live metrics plane & metric registry"),
+    ("accelerate_tpu.telemetry.alerts", "Alert rules & burn-rate engine"),
+    ("accelerate_tpu.telemetry.exporter", "Prometheus exporter"),
     ("accelerate_tpu.telemetry.provenance", "Artifact provenance"),
     ("accelerate_tpu.serving_gateway.workload", "Workload traces & replay"),
     ("accelerate_tpu.commands.trace_report", "Trace report CLI"),
+    ("accelerate_tpu.commands.metrics_dump", "Metrics dump CLI"),
     ("accelerate_tpu.resilience.faults", "Fault injection & recovery primitives"),
     ("accelerate_tpu.commands.chaos_train", "Elastic training chaos bench (chaos-train)"),
     ("accelerate_tpu.models.llama", "Llama family"),

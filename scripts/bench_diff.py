@@ -1,0 +1,231 @@
+#!/usr/bin/env python
+"""Perf-regression gate over the committed BENCH_*.json artifacts.
+
+The bench trajectory was unguarded: nothing stopped a regressed artifact —
+slower tokens/s, worse availability, a silently-lost request — from being
+committed as the new reference. This gate compares a FRESH artifact row set
+against a BASELINE with per-metric tolerance bands, failing only on
+*regressions* (a number getting better is progress, not drift):
+
+- **Invariants** (booleans like ``streams_identical``, zero-counters like
+  ``silently_lost``) are exact: a baseline that held must keep holding.
+- **Guarded numerics** match a path-pattern table (``GUARDS``), each with a
+  direction (higher/lower is better) and a relative band — e.g. fleet
+  availability may not drop more than 10%, chaos p95 TPOT may not grow more
+  than 60%. Unguarded numerics are ignored (fire counts, byte totals and
+  seeds move legitimately).
+
+Modes:
+
+- ``python scripts/bench_diff.py`` — diff every working-tree ``BENCH_*.json``
+  against the committed (``HEAD``) version via git; files identical to HEAD
+  are skipped. This is the ``BENCH_DIFF=1`` opt-in in ``scripts/check.sh``:
+  regenerate an artifact, and the gate tells you whether the new numbers are
+  a trajectory regression BEFORE you commit them.
+- ``--fresh A.json --baseline B.json`` — explicit two-file comparison (CI
+  against a fetched artifact, A/B experiments).
+
+Stdlib-only: runs in stripped CI contexts, no jax import.
+"""
+
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import glob
+import json
+import os
+import subprocess
+import sys
+from typing import Iterator, List, Optional, Tuple
+
+#: (path pattern, direction, relative band). Direction says which way is
+#: WORSE: "higher" = higher is better (fail when fresh < baseline*(1-band)),
+#: "lower" = lower is better (fail when fresh > baseline*(1+band)). First
+#: match wins; unmatched numerics are not compared.
+GUARDS: List[Tuple[str, str, float]] = [
+    # Correctness invariants ride _check_invariant, not bands — listed here
+    # only for --list discoverability.
+    ("*silently_lost", "zero", 0.0),
+    ("*streams_identical*", "true", 0.0),
+    ("*identical*", "true", 0.0),
+    ("*invariants.*", "true", 0.0),
+    ("*alerts_clean_silent", "true", 0.0),
+    ("*alerts_chaos_expected", "true", 0.0),
+    # Throughput family: fresh may not fall more than the band.
+    ("*tokens_per_sec*", "higher", 0.30),
+    ("*tokens_per_step*", "higher", 0.25),
+    ("*decode_tokens_per_busy_s", "higher", 0.35),
+    ("*availability", "higher", 0.10),
+    ("*attainment*", "higher", 0.10),
+    ("*accept_rate*", "higher", 0.25),
+    ("*concurrency_ratio", "higher", 0.20),
+    ("*speedup*", "higher", 0.25),
+    ("*mfu*", "higher", 0.15),
+    # Latency family: fresh may not grow more than the band (wall-clock
+    # percentiles are the noisiest rows — wide bands, regression-only).
+    ("*ttft.p95", "lower", 0.60),
+    ("*ttft.p50", "lower", 0.60),
+    ("*tpot.p95", "lower", 0.60),
+    ("*queue_wait.p95", "lower", 0.60),
+    ("*stall_share*", "lower", 0.50),
+]
+
+
+def walk(node, path: str = "") -> Iterator[Tuple[str, object]]:
+    """Every leaf of a JSON tree as (dotted.path, value). List indices use
+    a stable ``[i]`` spelling so rows align positionally."""
+    if isinstance(node, dict):
+        for key, value in node.items():
+            yield from walk(value, f"{path}.{key}" if path else str(key))
+    elif isinstance(node, list):
+        for i, value in enumerate(node):
+            yield from walk(value, f"{path}[{i}]")
+    else:
+        yield path, node
+
+
+def guard_for(path: str) -> Optional[Tuple[str, str, float]]:
+    for pattern, direction, band in GUARDS:
+        if fnmatch.fnmatch(path, pattern):
+            return pattern, direction, band
+    return None
+
+
+def compare(fresh: dict, baseline: dict, label: str = "") -> List[str]:
+    """Regressions of ``fresh`` against ``baseline`` (empty = clean)."""
+    fresh_leaves = dict(walk(fresh))
+    problems: List[str] = []
+    for path, base_value in walk(baseline):
+        g = guard_for(path)
+        if g is None:
+            continue
+        _, direction, band = g
+        new_value = fresh_leaves.get(path)
+        where = f"{label}:{path}" if label else path
+        if direction in ("zero", "true"):
+            ok_base = (base_value in (0, True)
+                       if direction == "zero" or isinstance(base_value, bool)
+                       else True)
+            if not ok_base:
+                continue  # the baseline itself never held — nothing to protect
+            if direction == "zero" and isinstance(new_value, (int, float)) \
+                    and new_value != 0:
+                problems.append(f"{where}: invariant broke ({base_value} -> "
+                                f"{new_value}, must stay 0)")
+            elif direction == "true" and base_value is True \
+                    and new_value is not True:
+                problems.append(f"{where}: invariant broke (True -> "
+                                f"{new_value!r})")
+            continue
+        if not isinstance(base_value, (int, float)) \
+                or isinstance(base_value, bool):
+            continue
+        if not isinstance(new_value, (int, float)) \
+                or isinstance(new_value, bool):
+            if new_value is None and base_value is not None:
+                problems.append(f"{where}: guarded metric vanished "
+                                f"(baseline {base_value})")
+            continue
+        if direction == "higher":
+            floor = base_value * (1.0 - band)
+            if new_value < floor:
+                problems.append(
+                    f"{where}: {base_value} -> {new_value} "
+                    f"(fell past the -{band:.0%} band, floor {floor:.6g})"
+                )
+        else:
+            ceiling = base_value * (1.0 + band)
+            if new_value > ceiling:
+                problems.append(
+                    f"{where}: {base_value} -> {new_value} "
+                    f"(grew past the +{band:.0%} band, ceiling {ceiling:.6g})"
+                )
+    return problems
+
+
+def _git_baseline(path: str, ref: str) -> Optional[dict]:
+    """The committed version of ``path`` at ``ref`` (None when absent)."""
+    try:
+        blob = subprocess.run(
+            ["git", "show", f"{ref}:{os.path.basename(path)}"],
+            cwd=os.path.dirname(os.path.abspath(path)) or ".",
+            capture_output=True, check=True,
+        ).stdout
+    except subprocess.CalledProcessError:
+        return None
+    return json.loads(blob)
+
+
+def diff_worktree(root: str, ref: str = "HEAD") -> int:
+    """Diff every working-tree BENCH_*.json against ``ref``; returns the
+    process exit code."""
+    artifacts = sorted(glob.glob(os.path.join(root, "BENCH_*.json")))
+    if not artifacts:
+        print("bench-diff: no BENCH_*.json artifacts found")
+        return 0
+    rc = 0
+    checked = skipped = 0
+    for path in artifacts:
+        name = os.path.basename(path)
+        baseline = _git_baseline(path, ref)
+        if baseline is None:
+            print(f"bench-diff: {name}: new artifact (no {ref} baseline), skipped")
+            continue
+        with open(path) as f:
+            fresh = json.load(f)
+        if fresh == baseline:
+            skipped += 1
+            continue
+        checked += 1
+        problems = compare(fresh, baseline, label=name)
+        if problems:
+            rc = 1
+            print(f"bench-diff: {name}: {len(problems)} regression(s) vs {ref}:")
+            for problem in problems:
+                print(f"  REGRESSION {problem}")
+        else:
+            print(f"bench-diff: {name}: changed, within bands")
+    print(f"bench-diff: {checked} changed artifact(s) checked, "
+          f"{skipped} identical to {ref}")
+    return rc
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        "scripts/bench_diff.py",
+        description="Per-metric tolerance-band regression gate over "
+                    "BENCH_*.json artifacts.",
+    )
+    parser.add_argument("--fresh", help="fresh artifact JSON")
+    parser.add_argument("--baseline", help="baseline artifact JSON")
+    parser.add_argument("--ref", default="HEAD",
+                        help="git ref for worktree mode (default HEAD)")
+    parser.add_argument("--root", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="repo root holding the BENCH_*.json set")
+    parser.add_argument("--list", action="store_true",
+                        help="print the guard table and exit")
+    args = parser.parse_args(argv)
+    if args.list:
+        for pattern, direction, band in GUARDS:
+            print(f"{pattern:<40} {direction:<7} band={band:.0%}")
+        return 0
+    if bool(args.fresh) != bool(args.baseline):
+        parser.error("--fresh and --baseline go together")
+    if args.fresh:
+        with open(args.fresh) as f:
+            fresh = json.load(f)
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+        problems = compare(fresh, baseline)
+        for problem in problems:
+            print(f"REGRESSION {problem}")
+        if not problems:
+            print("bench-diff: within bands")
+        return 1 if problems else 0
+    return diff_worktree(args.root, args.ref)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,6 +37,20 @@ echo "== telemetry schema registry =="
 # (Invoked via -c rather than -m to avoid runpy's found-in-sys.modules warning.)
 python -c "from accelerate_tpu.telemetry import schemas; raise SystemExit(schemas.main(['--check']))" || rc=1
 
+echo "== metric registry =="
+# Same contract for the metric catalog (telemetry/metrics.py);
+# regen with `python -m accelerate_tpu.telemetry.metrics --write`.
+python -c "from accelerate_tpu.telemetry import metrics; raise SystemExit(metrics.main(['--check']))" || rc=1
+
+if [ "${BENCH_DIFF:-0}" = "1" ]; then
+    echo "== bench trajectory (BENCH_DIFF=1) =="
+    # Opt-in perf-regression gate: any regenerated BENCH_*.json in the working
+    # tree is compared against its committed version with per-metric tolerance
+    # bands (scripts/bench_diff.py --list shows them). Opt-in because it only
+    # means something after a bench regeneration.
+    python scripts/bench_diff.py || rc=1
+fi
+
 echo "== docs/api drift =="
 # The docs gate lives on the lint CLI; an empty-path lint is not possible, so
 # run it over one tiny file and keep only the docs verdict.

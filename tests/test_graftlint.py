@@ -717,6 +717,57 @@ def test_telemetry_schema_literal_exempts_registry_and_tests(tmp_path):
                      "telemetry-schema-literal")
 
 
+# ----------------------------------------------------------- metric-name-literal
+
+BAD_METRIC_LITERAL = """
+    MY_METRIC = "accelerate_tpu_my_shiny_total"
+
+    def report(plane):
+        plane.inc("accelerate_tpu_gateway_requests_total", status="done")
+        plane.set_gauge("accelerate_tpu_serving_queue_depth", 3)
+        return {"accelerate_tpu_slo_attainment": 1.0}
+"""
+
+GOOD_METRIC_LITERAL = """
+    from accelerate_tpu.telemetry.metrics import M_QUEUE_DEPTH, M_REQUESTS_TOTAL
+
+    TMPDIR_PREFIX = "accelerate_tpu_trace_"   # trailing underscore: not a metric
+    SCHEMA = "accelerate_tpu.telemetry.serving/v1"  # schema namespace, not metric
+
+    def report(plane):
+        plane.inc(M_REQUESTS_TOTAL, status="done")
+        plane.set_gauge(M_QUEUE_DEPTH, 3)
+"""
+
+
+def test_metric_name_literal_fires(tmp_path):
+    hits = rule_hits(lint_snippet(tmp_path, BAD_METRIC_LITERAL),
+                     "metric-name-literal")
+    assert len(hits) == 4, hits
+    msgs = " ".join(f.message for f in hits)
+    assert "M_*" in msgs and "my_shiny" in msgs and "dict key" in msgs
+
+
+def test_metric_name_literal_clean(tmp_path):
+    assert not rule_hits(lint_snippet(tmp_path, GOOD_METRIC_LITERAL),
+                         "metric-name-literal")
+
+
+def test_metric_name_literal_exempts_registry_and_tests(tmp_path):
+    src = 'M_X = "accelerate_tpu_x_total"\n'
+    # The metrics registry module itself is the ONE place literals are legal.
+    reg_dir = tmp_path / "accelerate_tpu" / "telemetry"
+    reg_dir.mkdir(parents=True)
+    (reg_dir / "metrics.py").write_text(src)
+    findings = run_lint(paths=(str(reg_dir / "metrics.py"),), root=str(tmp_path))
+    assert not rule_hits(findings, "metric-name-literal")
+    # Test files pin metric strings freely.
+    assert not rule_hits(lint_snippet(tmp_path, src, name="test_metrics2.py"),
+                         "metric-name-literal")
+    assert rule_hits(lint_snippet(tmp_path, src, name="lib.py"),
+                     "metric-name-literal")
+
+
 # ------------------------------------------------------------- suppression semantics
 
 def test_unknown_rule_in_suppression_is_error(tmp_path):

@@ -1,0 +1,772 @@
+"""The live metrics plane, alert engine, Prometheus export and metrics-dump.
+
+ISSUE 13 acceptance pins: the disabled plane costs two attribute reads (zero
+clock calls, zero sink registration — the Telemetry/Tracer contract); a
+Prometheus scrape equals ``plane.stats()`` to the digit; the chaos serve-bench
+raises the expected ``alert/v1`` set while a clean replay raises none; and the
+registry-coverage matrix — every schema in ``SCHEMA_REGISTRY`` validated
+against a REAL emitted record, closing the synthetic-only gap.
+"""
+
+import dataclasses
+import gzip
+import json
+import os
+import urllib.request
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from accelerate_tpu.models import llama
+from accelerate_tpu.serving import ContinuousBatcher
+from accelerate_tpu.serving_gateway import ServingGateway
+from accelerate_tpu.telemetry import Telemetry, Tracer
+from accelerate_tpu.telemetry.alerts import (
+    AlertEngine,
+    AlertRule,
+    default_alert_rules,
+)
+from accelerate_tpu.telemetry.exporter import MetricsExporter, prometheus_text
+from accelerate_tpu.telemetry.metrics import (
+    METRIC_REGISTRY,
+    M_FAULTS_TOTAL,
+    M_PAGE_OCCUPANCY,
+    M_QUEUE_DEPTH,
+    M_REPLICA_HEALTH,
+    M_REQUESTS_TOTAL,
+    M_TTFT_SECONDS,
+    MetricsPlane,
+    docs_catalog_is_fresh,
+    registered_metrics,
+)
+from accelerate_tpu.telemetry.schemas import (
+    ALERT_SCHEMA,
+    GATEWAY_REQUEST_SCHEMA,
+    FAULT_SCHEMA,
+    METRICS_SNAPSHOT_SCHEMA,
+    MPMD_STAGE_STEP_SCHEMA,
+    SCHEMA_REGISTRY,
+    SERVING_SCHEMA,
+    validate_record,
+)
+from accelerate_tpu.utils.dataclasses import GatewayConfig, TelemetryConfig
+
+CFG = dataclasses.replace(llama.CONFIGS["tiny"], dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = llama.init_params(CFG)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, CFG.vocab_size, int(n)).astype(np.int32)
+               for n in (5, 9, 3, 7, 6, 4)]
+    return params, prompts
+
+
+def _tel(**kw):
+    return Telemetry(TelemetryConfig(enabled=True, compile_events=False,
+                                     memory_stats=False, **kw))
+
+
+def _request_record(uid, status="done", tokens=5, ttft=0.3, deadline_met=True):
+    return {
+        "schema": GATEWAY_REQUEST_SCHEMA, "uid": uid, "status": status,
+        "reason": None, "tenant": "default", "priority": 0,
+        "n_tokens": tokens, "retries_used": 0, "queue_wait_s": 0.1,
+        "ttft_s": ttft, "tpot_s": 0.02, "deadline_met": deadline_met,
+    }
+
+
+# ------------------------------------------------------------------- registry
+def test_metric_registry_names_and_catalog():
+    """Every registered metric follows the minted naming shape; the generated
+    docs catalog matches the registry (the same gate scripts/check.sh runs)."""
+    for name in registered_metrics():
+        spec = METRIC_REGISTRY[name]
+        assert name.startswith("accelerate_tpu_") and not name.endswith("_")
+        assert spec.kind in ("counter", "gauge", "histogram")
+        if spec.kind == "counter":
+            assert name.endswith("_total"), f"{name}: counters end in _total"
+    assert docs_catalog_is_fresh(), (
+        "docs/telemetry.md metric catalog drifted — run "
+        "`python -m accelerate_tpu.telemetry.metrics --write`"
+    )
+
+
+def test_plane_rejects_unregistered_and_wrong_kind():
+    plane = MetricsPlane(enabled=True, clock=lambda: 0.0)
+    with pytest.raises(KeyError, match="unregistered metric"):
+        plane.inc("accelerate_tpu_not_a_metric_total")
+    with pytest.raises(ValueError, match="gauge"):
+        plane.inc(M_QUEUE_DEPTH)  # gauge used as a counter
+    with pytest.raises(ValueError, match="counter"):
+        plane.set_gauge(M_FAULTS_TOTAL, 1.0)
+
+
+# ------------------------------------------------------------- disabled contract
+def test_disabled_plane_zero_clock_calls_no_sink():
+    """The Telemetry/Tracer contract: a plane over a disabled telemetry never
+    registers a sink, never reads the clock, and every method no-ops."""
+    tel_off = Telemetry(TelemetryConfig())
+    assert tel_off.enabled is False
+    calls = []
+
+    def counting_clock():
+        calls.append(1)
+        return 0.0
+
+    plane = MetricsPlane(tel_off, clock=counting_clock)
+    assert plane.enabled is False
+    assert tel_off.sinks == []
+    plane.inc(M_FAULTS_TOTAL, site="x")
+    plane.set_gauge(M_QUEUE_DEPTH, 3)
+    plane.observe(M_TTFT_SECONDS, 0.5)
+    plane.consume(_request_record(0))
+    assert calls == []
+    assert plane.records_consumed == 0
+    assert plane.stats() == {"enabled": False}
+    # An engine hooked to an AlertEngine stays quiet too: the engine refuses
+    # to register against a disabled plane.
+    eng = AlertEngine(plane, default_alert_rules())
+    assert plane.alert_engines == []
+    assert eng.active() == []
+    assert calls == []
+
+
+# ------------------------------------------------------------------ aggregation
+def test_plane_windows_counters_gauges():
+    t = [0.0]
+    tel = _tel()
+    plane = MetricsPlane(tel, clock=lambda: t[0], window_s=10.0)
+    for i in range(5):
+        t[0] = float(i)
+        tel.emit(_request_record(i, ttft=0.1 * (i + 1)))
+    stats = plane.stats()
+    assert stats["counters"][f'{M_REQUESTS_TOTAL}{{status="done"}}'] == 5
+    assert stats["slo"] == {"window_good": 5, "window_bad": 0,
+                            "attainment": 1.0}
+    hist = stats["histograms"]["accelerate_tpu_gateway_ttft_seconds"]
+    assert hist["count"] == 5 and hist["p50"] == pytest.approx(0.3)
+    # Sliding window: advance past the horizon — observations age out, the
+    # cumulative counter does not.
+    t[0] = 100.0
+    stats = plane.stats()
+    assert stats["counters"][f'{M_REQUESTS_TOTAL}{{status="done"}}'] == 5
+    assert stats["histograms"]["accelerate_tpu_gateway_ttft_seconds"] == {
+        "count": 0
+    }
+    assert plane.window_increase(M_REQUESTS_TOTAL, 10.0) == 0
+    assert plane.attainment() is None  # silence, not 1.0
+
+
+def test_plane_labeled_gauges_and_serving_records():
+    tel = _tel()
+    plane = MetricsPlane(tel, clock=lambda: 0.0)
+    tel.emit({"schema": SERVING_SCHEMA, "telemetry_rev": 2, "queued": 7,
+              "active_slots": 2, "max_slots": 4, "slot_occupancy": 0.5,
+              "admitted": 2, "evicted": 0, "decode_steps": 1,
+              "decode_tokens": 2})
+    assert plane.gauge_value(M_QUEUE_DEPTH) == 7
+    tel.emit({"schema": "accelerate_tpu.telemetry.replica.health/v1",
+              "replica": 0, "state": "active", "role": "mixed", "health": 0.9,
+              "breaker_state": "closed", "active_slots": 1, "queued": 0,
+              "step_failures": 0})
+    tel.emit({"schema": "accelerate_tpu.telemetry.replica.health/v1",
+              "replica": 1, "state": "active", "role": "mixed", "health": 0.4,
+              "breaker_state": "closed", "active_slots": 2, "queued": 3,
+              "step_failures": 1})
+    per_replica = plane.gauge_value(M_REPLICA_HEALTH)
+    assert per_replica == {
+        'accelerate_tpu_replica_health{replica="0"}': 0.9,
+        'accelerate_tpu_replica_health{replica="1"}': 0.4,
+    }
+
+
+# ----------------------------------------------------------------------- alerts
+def test_alert_rule_validation():
+    with pytest.raises(ValueError, match="unregistered metric"):
+        AlertRule("x", metric="accelerate_tpu_nope", threshold=1)
+    with pytest.raises(ValueError, match="histogram"):
+        AlertRule("x", metric=M_TTFT_SECONDS, threshold=1)
+    with pytest.raises(ValueError, match="name a metric"):
+        AlertRule("x")
+    with pytest.raises(ValueError, match="multiwindow"):
+        AlertRule("x", kind="burn_rate", fast_window_s=300, slow_window_s=60)
+    with pytest.raises(ValueError, match="objective"):
+        AlertRule("x", kind="burn_rate", objective=1.5)
+    with pytest.raises(ValueError, match="duplicate"):
+        plane = MetricsPlane(enabled=True, clock=lambda: 0.0)
+        AlertEngine(plane, [AlertRule("a", metric=M_QUEUE_DEPTH, threshold=1),
+                            AlertRule("a", metric=M_QUEUE_DEPTH, threshold=2)])
+
+
+def test_threshold_rules_fire_and_resolve():
+    t = [0.0]
+    tel = _tel()
+    plane = MetricsPlane(tel, clock=lambda: t[0], window_s=100.0)
+    engine = AlertEngine(plane, [
+        AlertRule("queue-deep", metric=M_QUEUE_DEPTH, threshold=5.0),
+        AlertRule("faults", metric=M_FAULTS_TOTAL, threshold=0.0,
+                  window_s=10.0),
+        AlertRule("replica-low", metric=M_REPLICA_HEALTH, op="<",
+                  threshold=0.5),
+    ], eval_interval_s=0.0)
+    # gauge over the bound → firing; back under → resolved.
+    tel.emit({"schema": SERVING_SCHEMA, "queued": 9, "slot_occupancy": 1.0})
+    assert engine.states["queue-deep"] == "firing"
+    tel.emit({"schema": SERVING_SCHEMA, "queued": 1, "slot_occupancy": 0.2})
+    assert engine.states["queue-deep"] == "ok"
+    # counter fires on WINDOWED increase and resolves when the window drains.
+    t[0] = 1.0
+    tel.emit({"schema": FAULT_SCHEMA, "site": "serving.decode",
+              "kind": "error"})
+    assert engine.states["faults"] == "firing"
+    t[0] = 50.0
+    engine.evaluate()
+    assert engine.states["faults"] == "ok"
+    # labeled gauge reduces to the WORST series for "<" rules.
+    tel.emit({"schema": "accelerate_tpu.telemetry.replica.health/v1",
+              "replica": 0, "state": "active", "role": "mixed", "health": 0.9,
+              "breaker_state": "closed", "active_slots": 0, "queued": 0,
+              "step_failures": 0})
+    tel.emit({"schema": "accelerate_tpu.telemetry.replica.health/v1",
+              "replica": 1, "state": "restarting", "role": "mixed",
+              "health": 0.0, "breaker_state": "closed", "active_slots": 0,
+              "queued": 0, "step_failures": 0})
+    assert engine.states["replica-low"] == "firing"
+    # transitions all validate and were mirrored back onto the plane.
+    for rec in engine.fired:
+        assert validate_record(rec) == []
+    assert plane.counter_value(
+        "accelerate_tpu_alerts_total", rule="queue-deep", state="firing"
+    ) == 1
+
+
+def test_burn_rate_multiwindow_semantics():
+    """Fires only when BOTH windows burn; resolves on the fast window alone;
+    an empty window yields no verdict (silence never flips state)."""
+    t = [0.0]
+    tel = _tel()
+    plane = MetricsPlane(tel, clock=lambda: t[0], window_s=400.0)
+    rule = AlertRule("burn", kind="burn_rate", objective=0.9,
+                     fast_window_s=30.0, slow_window_s=300.0,
+                     burn_threshold=2.0)  # error_rate > 0.2 in both windows
+    engine = AlertEngine(plane, [rule], eval_interval_s=0.0)
+    # A long healthy history fills the slow window.
+    for i in range(60):
+        t[0] = float(i)
+        tel.emit(_request_record(i))
+    assert engine.states["burn"] == "ok"
+    # A fast burst of failures: fast window over, slow window still diluted
+    # below the bound → NOT firing yet (the multiwindow point: a blip alone
+    # must not page).
+    for i in range(8):
+        t[0] = 60.0 + i
+        tel.emit(_request_record(100 + i, status="failed", tokens=0,
+                                 ttft=None))
+    fast = plane.error_rate(30.0)
+    slow = plane.error_rate(300.0)
+    assert fast > 0.2 and slow < 0.2
+    assert engine.states["burn"] == "ok"
+    # Sustained failures push the slow window over too → firing.
+    for i in range(20):
+        t[0] = 70.0 + i * 3
+        tel.emit(_request_record(200 + i, status="failed", tokens=0,
+                                 ttft=None))
+    assert engine.states["burn"] == "firing"
+    # Recovery: a clean fast window resolves even while the slow window
+    # still remembers the episode.
+    for i in range(20):
+        t[0] = 140.0 + i
+        tel.emit(_request_record(300 + i))
+    assert plane.error_rate(300.0) > 0.2  # slow window still burned
+    assert engine.states["burn"] == "ok"
+
+
+def test_threshold_rules_on_derived_gauges_fire():
+    """Derived gauges (attainment, SLO window counts, tokens/s) are computed
+    at read time — an alert rule naming one must see the live value, never a
+    permanent None (regression: they used to read the stored-gauge table,
+    which derived metrics never enter, so the rule could never fire)."""
+    from accelerate_tpu.telemetry.metrics import (
+        M_SLO_ATTAINMENT,
+        M_SLO_WINDOW_BAD,
+    )
+
+    t = [0.0]
+    tel = _tel()
+    plane = MetricsPlane(tel, clock=lambda: t[0], window_s=100.0)
+    engine = AlertEngine(plane, [
+        AlertRule("attainment-low", metric=M_SLO_ATTAINMENT, op="<",
+                  threshold=0.9),
+        AlertRule("bad-requests", metric=M_SLO_WINDOW_BAD, threshold=2.0),
+    ], eval_interval_s=0.0)
+    assert plane.gauge_value(M_SLO_ATTAINMENT) is None  # no traffic: no value
+    for i in range(4):
+        t[0] = float(i)
+        tel.emit(_request_record(i))
+    assert engine.active() == []
+    for i in range(6):
+        t[0] = 4.0 + i
+        tel.emit(_request_record(100 + i, status="failed", tokens=0,
+                                 ttft=None))
+    assert plane.gauge_value(M_SLO_ATTAINMENT) == pytest.approx(0.4)
+    assert plane.gauge_value(M_SLO_WINDOW_BAD) == 6.0
+    assert set(engine.active()) == {"attainment-low", "bad-requests"}
+
+
+def test_jsonl_rotation_indices_stay_monotonic(tmp_path):
+    """Rotation picks max(existing)+1, not the first free slot — deleting an
+    old rotated file to reclaim disk must not make newer records sort first
+    (the readers' lexical==chronological contract)."""
+    jsonl_dir = str(tmp_path / "run")
+    tel = _tel(jsonl_dir=jsonl_dir, rotate_bytes=200)
+    for i in range(6):
+        tel.emit(_request_record(i))
+    first = sorted(f for f in os.listdir(jsonl_dir) if f != "telemetry.jsonl")
+    assert len(first) >= 2
+    os.remove(os.path.join(jsonl_dir, first[0]))  # operator reclaims disk
+    for i in range(6):
+        tel.emit(_request_record(100 + i))
+    rolled = sorted(f for f in os.listdir(jsonl_dir) if f != "telemetry.jsonl")
+    assert first[0] not in rolled, "rotation reused a deleted low index"
+    indices = [int(f.split(".")[1]) for f in rolled]
+    assert indices == sorted(indices) and len(set(indices)) == len(indices)
+    assert max(indices) > int(first[-1].split(".")[1])
+
+
+# --------------------------------------------------------------------- exporter
+def test_prometheus_scrape_matches_stats_to_the_digit(setup):
+    """Acceptance: the endpoint's text equals ``stats()`` exactly — every
+    counter/gauge sample and every histogram quantile parses back to the
+    identical float."""
+    params, prompts = setup
+    tel = _tel()
+    gw = ServingGateway(
+        ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                          prompt_bucket=16, telemetry=tel, page_size=8),
+        GatewayConfig(enabled=True, metrics=True),
+        telemetry=tel,
+    )
+    assert gw.metrics is not None and gw.metrics.enabled
+    for p in prompts[:4]:
+        gw.submit(p, max_new_tokens=4)
+    gw.run(report_slo=True)
+    stats = gw.stats()["metrics"]
+    assert stats["counters"][f'{M_REQUESTS_TOTAL}{{status="done"}}'] == 4
+
+    exporter = MetricsExporter(gw.metrics, port=0)
+    with exporter:
+        url = f"http://127.0.0.1:{exporter.port}"
+        body = urllib.request.urlopen(f"{url}/metrics").read().decode()
+        health = json.loads(urllib.request.urlopen(f"{url}/healthz").read())
+    assert health["ok"] and health["records_consumed"] > 0
+
+    parsed = {}
+    for line in body.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        series, value = line.rsplit(" ", 1)
+        parsed[series] = float(value)
+    # Every counter/gauge sample matches stats() exactly. (The scrape and the
+    # stats call read the windows at different clock instants, so histogram
+    # quantiles are checked against a same-instant render below.)
+    for table in ("counters", "gauges"):
+        for series, value in stats[table].items():
+            if value is None:
+                continue
+            assert parsed[series] == pytest.approx(float(value), abs=0.0), series
+    text2 = prometheus_text(gw.metrics, now=0.0)
+    stats2 = gw.metrics.stats(now=0.0)
+    for series, block in stats2["histograms"].items():
+        if not block.get("count"):
+            continue
+        name = series.split("{", 1)[0]
+        for q, p in (("0.5", "p50"), ("0.95", "p95"), ("0.99", "p99")):
+            needle = f'{name}{{quantile="{q}"}} {repr(float(block[p]))}'
+            assert needle in text2, needle
+
+
+# ------------------------------------------------------------- offline parity
+def test_metrics_dump_offline_equals_live(setup, tmp_path):
+    """Replaying the recorded JSONL (rotated + gzip inputs included) through
+    the offline plane reproduces the live plane's counters exactly."""
+    from accelerate_tpu.commands.metrics_dump import aggregate_records
+    from accelerate_tpu.commands.trace_report import load_records
+
+    params, prompts = setup
+    jsonl_dir = str(tmp_path / "run")
+    tel = _tel(jsonl_dir=jsonl_dir, rotate_bytes=2048)
+    gw = ServingGateway(
+        ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                          prompt_bucket=16, telemetry=tel, page_size=8),
+        GatewayConfig(enabled=True, metrics=True),
+        telemetry=tel,
+    )
+    for p in prompts:
+        gw.submit(p, max_new_tokens=4)
+    gw.run(report_slo=True)
+    rotated = [f for f in os.listdir(jsonl_dir)
+               if f.startswith("telemetry.") and f != "telemetry.jsonl"]
+    assert rotated, "rotation never fired — shrink rotate_bytes"
+
+    # gzip one rotated file in place: the readers must take mixed inputs.
+    victim = os.path.join(jsonl_dir, sorted(rotated)[0])
+    with open(victim, "rb") as f:
+        blob = f.read()
+    with gzip.open(victim + ".gz", "wb") as f:
+        f.write(blob)
+    os.remove(victim)
+
+    records = load_records(jsonl_dir)
+    assert len(records) == len(tel.records)
+    offline = aggregate_records(records)
+    assert offline.stats()["counters"] == gw.metrics.stats()["counters"]
+
+
+def test_metrics_dump_cli_smoke(capsys):
+    """Tier-1 CLI smoke (the ISSUE-13 CI satellite): the self-contained
+    end-to-end run must reconcile and exit 0."""
+    from accelerate_tpu.commands.accelerate_cli import main
+
+    assert main(["metrics-dump", "--smoke"]) == 0
+    out = capsys.readouterr().out
+    assert "accelerate_tpu_gateway_requests_total" in out
+    assert "SMOKE FAILURE" not in out
+
+
+def test_metrics_dump_cli_on_files(tmp_path, capsys):
+    path = tmp_path / "t.jsonl"
+    with open(path, "w") as f:
+        for i in range(3):
+            f.write(json.dumps(_request_record(i)) + "\n")
+    from accelerate_tpu.commands.accelerate_cli import main
+
+    assert main(["metrics-dump", str(path), "--format", "json"]) == 0
+    stats = json.loads(capsys.readouterr().out)
+    assert stats["counters"][f'{M_REQUESTS_TOTAL}{{status="done"}}'] == 3
+    assert main(["metrics-dump"]) == 1  # no inputs, no --smoke
+
+
+# --------------------------------------------------------- gateway/bench wiring
+def test_gateway_metrics_knob_off_and_disabled_telemetry(setup):
+    params, _ = setup
+    eng = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                            prompt_bucket=16)
+    gw = ServingGateway(eng, GatewayConfig(enabled=True))
+    assert gw.metrics is None and "metrics" not in gw.stats()
+    # metrics=True over DISABLED telemetry stays inert (the knob never builds
+    # an enabled plane out of nothing).
+    tel_off = Telemetry(TelemetryConfig())
+    gw2 = ServingGateway(eng, GatewayConfig(enabled=True, metrics=True),
+                         telemetry=tel_off)
+    assert gw2.metrics is None
+    with pytest.raises(ValueError, match="metrics_window_s"):
+        GatewayConfig(enabled=True, metrics_window_s=0.0)
+
+
+def test_chaos_bench_alert_invariants(setup):
+    """Acceptance: the chaos serve-bench's injected kill sequence raises the
+    expected alert set and the clean replay raises none — read from the
+    artifact the CLI gates on."""
+    from accelerate_tpu.commands.serve_bench import run_chaos_bench
+
+    artifact = run_chaos_bench(requests=12, max_slots=2, max_len=64,
+                               prompt_bucket=16, chaos_rate=0.15, seed=0)
+    assert artifact["alerts_clean_silent"] is True
+    assert artifact["alerts_chaos_expected"] is True
+    assert "step-failure-burst" in artifact["alerts_chaos_fired"]
+    assert artifact["clean"]["alerts"]["transitions"] == 0
+    chaos_alerts = artifact["chaos"]["alerts"]
+    assert chaos_alerts["transitions"] >= 1
+    for fired in chaos_alerts["fired"]:
+        assert fired["rule"] in {r.name for r in default_alert_rules()}
+    # the plane snapshot rode the artifact: counters include the faults.
+    faults = [v for k, v in artifact["chaos"]["metrics"]["counters"].items()
+              if k.startswith(M_FAULTS_TOTAL)]
+    assert sum(faults) == artifact["fault_plan"]["fired"]
+
+
+# ------------------------------------------------------------------- mpmd plane
+def test_stage_step_records_and_disabled_cost():
+    from accelerate_tpu.parallel.mpmd import build_demo_pipeline, demo_data_fn
+
+    tel = _tel()
+    pipe = build_demo_pipeline(n_stages=2, width=8, n_microbatches=2,
+                               telemetry=tel)
+    data = demo_data_fn(0, 2, 4, 8)
+    for step in range(3):
+        pipe.train_step(*data(step))
+    steps = [r for r in tel.records
+             if r.get("schema") == MPMD_STAGE_STEP_SCHEMA]
+    assert len(steps) == 6  # 2 stages x 3 steps
+    for rec in steps:
+        assert validate_record(rec) == []
+        assert rec["busy_s"] == pytest.approx(
+            rec["fwd_s"] + rec["bwd_s"] + rec["apply_s"])
+        assert rec["t1"] >= rec["t0"]
+        assert rec["busy_s"] > 0
+    # Disabled: no records, and the per-call guard is the None check.
+    pipe_off = build_demo_pipeline(n_stages=2, width=8, n_microbatches=2)
+    pipe_off.train_step(*data(0))
+    assert pipe_off.stages[0]._phase_s is None
+
+
+def test_train_report_bubbles_stragglers_and_recovery(tmp_path):
+    """Acceptance: busy+bubble shares sum to 1 (per stage AND pipeline-wide),
+    straggler attribution is present, and the crash→hold→replay timeline is
+    reproduced from records alone, matching the run's own accounting."""
+    from accelerate_tpu.commands.trace_report import train_report
+    from accelerate_tpu.elastic import FleetSupervisor, GangOfGangs
+    from accelerate_tpu.parallel.mpmd import build_demo_stage, demo_data_fn
+    from accelerate_tpu.resilience.faults import FaultPlan, FaultSpec
+
+    tel = _tel()
+    plans = {
+        i: FaultPlan([FaultSpec("train.step", "crash", prob=0.2)],
+                     seed=3, scope=f"stage{i}")
+        for i in range(2)
+    }
+
+    def factory(i):
+        return build_demo_stage(i, n_stages=2, width=8, n_microbatches=2,
+                                seed=0, faults=plans[i], telemetry=tel)
+
+    clock = [0.0]
+    gog = GangOfGangs(
+        factory, 2, checkpoint_dir=str(tmp_path / "ckpt"),
+        supervisor=FleetSupervisor(max_restarts=8, telemetry=tel,
+                                   clock=lambda: clock[0]),
+        checkpoint_every=2, telemetry=tel,
+        clock=lambda: clock[0], sleep=lambda s: clock.__setitem__(0, clock[0] + s),
+    )
+    summary = gog.run(demo_data_fn(0, 2, 4, 8), 10)
+    assert summary["stage_crashes"] >= 1, "seed produced no crash — retune"
+
+    report = train_report(tel.records)
+    assert report["n_steps"] == 10 and report["n_stages"] == 2
+    pipeline = report["pipeline"]
+    assert pipeline["busy_share"] + pipeline["bubble_share"] == pytest.approx(1.0)
+    for blk in report["stages"].values():
+        assert blk["busy_share"] + blk["bubble_share"] == pytest.approx(1.0)
+        assert blk["steps"] == 10
+    assert report["straggler"]["stage"] in (0, 1)
+    assert report["straggler"]["straggler_p95_vs_fleet_median"] is not None
+    # Recovery timeline from records alone == the run's own accounting.
+    recovery = report["recovery"]
+    assert recovery["stage_crashes"] == summary["stage_crashes"]
+    assert recovery["restarts_by_gang"] == {
+        gang: n for gang, n in summary["restarts"].items() if n
+    }
+    holds = [e for e in recovery["timeline"] if e["event"] == "hold"]
+    replays = [e for e in recovery["timeline"] if e["event"] == "replay"]
+    assert len(holds) == summary["barrier_holds"]
+    assert len(replays) == summary["stage_crashes"]
+    for replay in replays:
+        assert replay["restored_step"] <= replay["crashed_at"]
+    # Every COMPLETED step the replay re-executed left one overwritten cell
+    # per stage behind — the report's dedup accounting must match the run's.
+    assert report["replayed_cells"] == summary["replayed_steps"] * 2
+
+
+def test_trace_report_train_cli(tmp_path, capsys):
+    """Tier-1 CLI smoke: trace-report --train over a recorded MPMD smoke run
+    (the chaos-train CLI path writes the records; the report reads them)."""
+    from accelerate_tpu.commands.accelerate_cli import main
+    from accelerate_tpu.commands.chaos_train import run_chaos_train
+
+    jsonl_dir = str(tmp_path / "run")
+    tel = _tel(jsonl_dir=jsonl_dir)
+    run_chaos_train(steps=6, stages=2, crash_rate=0.15, seed=0,
+                    checkpoint_every=2, telemetry=tel,
+                    workdir=str(tmp_path / "work"))
+    rc = main(["trace-report", jsonl_dir, "--train", "--timelines", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    summary = json.loads(out[out.index("{"):])
+    assert summary["n_stages"] == 2
+    assert summary["pipeline"]["busy_share"] + \
+        summary["pipeline"]["bubble_share"] == pytest.approx(1.0)
+    assert "-- step=" in out
+    # No spans recorded → the span mode must say so, not crash.
+    assert main(["trace-report", jsonl_dir]) == 1
+
+
+# ----------------------------------------------------------- registry coverage
+@pytest.fixture(scope="module")
+def record_corpus(setup, tmp_path_factory):
+    """REAL emitted records for every registered schema: each scenario below
+    drives the actual emitter (no synthetic dicts)."""
+    import jax.numpy as jnp
+
+    from accelerate_tpu.commands.chaos_train import run_chaos_train
+    from accelerate_tpu.resilience.faults import FaultPlan, FaultSpec
+    from accelerate_tpu.serving_gateway import FleetRouter
+
+    params, prompts = setup
+    tel = _tel()
+    plane = MetricsPlane(tel, window_s=1e9)
+    alerts = AlertEngine(plane, default_alert_rules(objective=0.9,
+                                                    burn_threshold=3.0),
+                         eval_interval_s=0.0)
+
+    # 1) training step record: the real emitter is the step bracket.
+    tel._step_begin()
+    tel._step_end(fence_on=jnp.zeros(()))
+
+    # 2) serving engine + gateway: paged + spec + tracer + an injected fault
+    #    (fault/v1 + recovery/v1 + FAILED terminal), throughput drain.
+    tracer = Tracer(tel)
+    plan = FaultPlan([FaultSpec("serving.decode", "error", prob=1.0,
+                                max_fires=1)], seed=0)
+    eng = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                            prompt_bucket=16, page_size=8, spec_k=2,
+                            telemetry=tel, tracer=tracer, faults=plan)
+    gw = ServingGateway(eng, GatewayConfig(enabled=True, metrics=False),
+                        telemetry=tel, tracer=tracer)
+    for p in prompts[:4]:
+        gw.submit(p, max_new_tokens=4)
+    gw.run(report_slo=True)
+    eng2 = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                             prompt_bucket=16, telemetry=tel)
+    eng2.submit(prompts[0], max_new_tokens=3)
+    eng2.run(report_throughput=True)
+
+    # 3) fleet: health/route records each step, a kill → replica_died +
+    #    migration + supervised restart (elastic.restart/v1).
+    def build_engine(rid):
+        return ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                                 prompt_bucket=16, telemetry=tel)
+
+    router = FleetRouter([build_engine(0), build_engine(1)],
+                         GatewayConfig(enabled=True, breaker_threshold=3,
+                                       replica_restarts=2),
+                         telemetry=tel, engine_factory=build_engine)
+    for p in prompts[:4]:
+        router.submit(p, max_new_tokens=4)
+    router.step()
+    router.kill(0)
+    router.run()
+
+    # 4) disagg: one prefill→decode handoff (serving.handoff/v1).
+    from accelerate_tpu.serving_gateway import DisaggRouter
+
+    def role_engine(rid, role):
+        return ContinuousBatcher(params, CFG, role=role, max_slots=2,
+                                 max_len=64, prompt_bucket=16, page_size=8,
+                                 telemetry=tel)
+
+    disagg = DisaggRouter(
+        [role_engine(0, "prefill"), role_engine(1, "decode")],
+        GatewayConfig(enabled=True), telemetry=tel,
+        roles=["prefill", "decode"],
+    )
+    disagg.submit(prompts[0], max_new_tokens=4)
+    disagg.run()
+
+    # 5) MPMD chaos: transfer/stage_step/barrier/restart + pipeline_replay.
+    # (steps=8, rate=0.15, seed=0 is a known-crashing shape: stage1 dies at
+    # step 5, so barrier hold/release records are guaranteed in the stream.)
+    tmp = tmp_path_factory.mktemp("chaos_train")
+    run_chaos_train(steps=8, stages=2, crash_rate=0.15, seed=0,
+                    checkpoint_every=2, telemetry=tel, workdir=str(tmp))
+
+    # 6) audit.program/v1: the warmup enumerator's real telemetry path.
+    from accelerate_tpu.analysis.program import LowerOnlyCache
+    from accelerate_tpu.compile_cache.warmup import run_warmup
+
+    from accelerate_tpu.commands.trace_report import load_records
+    from accelerate_tpu.telemetry.schemas import AUDIT_PROGRAM_SCHEMA
+
+    warmup_tel_dir = str(tmp / "warmup_tel")
+    os.environ["ACCELERATE_TELEMETRY"] = "1"
+    os.environ["ACCELERATE_TELEMETRY_DIR"] = warmup_tel_dir
+    try:
+        run_warmup(cache=LowerOnlyCache(),
+                   manifest_path=str(tmp / "m.json"),
+                   preset="smoke", batch_size=4, seq_len=32, serve=False,
+                   eval_step=False)
+    finally:
+        os.environ.pop("ACCELERATE_TELEMETRY", None)
+        os.environ.pop("ACCELERATE_TELEMETRY_DIR", None)
+    # The warmup Accelerator wrote to ITS OWN telemetry (the env-armed JSONL
+    # run dir); fold the real audit records into the corpus stream.
+    for rec in load_records(warmup_tel_dir, schemas={AUDIT_PROGRAM_SCHEMA}):
+        tel.emit(rec)
+
+    # 7) the plane's own snapshot record (alert/v1 transitions were emitted
+    #    live by the engine as the fault scenario above fired).
+    plane.snapshot_record(emit=True)
+    return tel.records
+
+
+def test_registry_coverage_matrix(record_corpus):
+    """Every schema in SCHEMA_REGISTRY has at least one REAL emitted record in
+    the corpus, and every corpus record validates against its registration —
+    the synthetic-only validation gap is closed."""
+    by_schema = {}
+    for rec in record_corpus:
+        by_schema.setdefault(rec.get("schema"), []).append(rec)
+    missing = sorted(set(SCHEMA_REGISTRY) - set(by_schema))
+    assert not missing, (
+        f"schemas with no real emitted record in the corpus: {missing} — "
+        "add a scenario to record_corpus"
+    )
+    for schema, recs in by_schema.items():
+        if schema not in SCHEMA_REGISTRY:
+            continue  # bench artifacts etc. are out of registry scope
+        for rec in recs:
+            assert validate_record(rec) == [], (schema, rec)
+    assert ALERT_SCHEMA in by_schema and METRICS_SNAPSHOT_SCHEMA in by_schema
+
+
+# ------------------------------------------------------------------ bench diff
+def _bench_diff():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_diff",
+        os.path.join(os.path.dirname(__file__), "..", "scripts",
+                     "bench_diff.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_diff_bands_and_invariants():
+    bd = _bench_diff()
+    baseline = {
+        "availability": 0.9, "tokens_per_sec": 100.0,
+        "ttft": {"p95": 1.0}, "silently_lost": 0,
+        "streams_identical": True, "fired": 7,
+    }
+    # within bands + ignored unguarded numeric drift → clean.
+    assert bd.compare({**baseline, "availability": 0.85,
+                       "tokens_per_sec": 80.0, "ttft": {"p95": 1.5},
+                       "fired": 900}, baseline) == []
+    # direction-aware: improvements never fail.
+    assert bd.compare({**baseline, "availability": 1.0,
+                       "tokens_per_sec": 500.0, "ttft": {"p95": 0.01}},
+                      baseline) == []
+    problems = bd.compare({**baseline, "availability": 0.5,
+                           "tokens_per_sec": 50.0, "ttft": {"p95": 2.5},
+                           "silently_lost": 3,
+                           "streams_identical": False}, baseline)
+    text = "\n".join(problems)
+    assert "availability" in text and "tokens_per_sec" in text
+    assert "ttft.p95" in text
+    assert "silently_lost" in text and "streams_identical" in text
+    assert len(problems) == 5
+    # a guarded metric vanishing is a regression, not a silent pass.
+    gone = bd.compare({"ttft": {}}, {"ttft": {"p95": 1.0}})
+    assert gone and "vanished" in gone[0]
+
+
+def test_bench_diff_worktree_clean_repo():
+    """Against the committed artifacts with an unchanged tree the gate is
+    green (the BENCH_DIFF=1 check.sh path)."""
+    bd = _bench_diff()
+    root = os.path.join(os.path.dirname(__file__), "..")
+    assert bd.diff_worktree(os.path.abspath(root)) == 0
