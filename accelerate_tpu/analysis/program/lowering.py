@@ -25,8 +25,11 @@ __all__ = ["LowerOnlyCache", "capture_default_programs", "DEFAULT_AUDIT_GEOMETRY
 
 #: The geometry ``audit`` lowers when none is given: the warmup CLI's default
 #: config with eval and serving enabled — including the speculative-decoding
-#: surface (fused verify + half-depth draft model programs) — so the audited
-#: surface is the full program set a warmed cache directory would hold.
+#: surface (fused verify + half-depth draft model programs) and the multi-step
+#: decode super-step pair (``decode_steps=4``, both sample variants: spec and
+#: multi-step COEXIST on one engine — speculation wins while enabled, the
+#: super-step is its degradation fallback) — so the audited surface is the
+#: full program set a warmed cache directory would hold.
 DEFAULT_AUDIT_GEOMETRY = dict(
     preset="smoke",
     batch_size=8,
@@ -38,6 +41,7 @@ DEFAULT_AUDIT_GEOMETRY = dict(
     max_new_tokens=32,
     spec_k=2,
     spec_draft="half",
+    decode_steps=4,
 )
 
 #: Second serving-only pass over the PAGED KV surface (block-table decode/verify,
@@ -58,6 +62,7 @@ PAGED_AUDIT_GEOMETRY = dict(
     spec_draft="ngram",
     page_size=24,
     prefix_cache=2,
+    decode_steps=4,
 )
 
 #: Disaggregated-serving passes: the role-sliced replica surfaces

@@ -90,6 +90,19 @@ def serve_bench_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("--kv-pages", type=int, default=None,
                         help="page-pool size for --page-size (default: dense-"
                              "equivalent capacity)")
+    parser.add_argument("--decode-steps", default="1",
+                        help="multi-step decode depth (docs/multistep_decode.md). "
+                             "Policy rows take a single int (every engine and "
+                             "its gateway run that super-step depth); with "
+                             "--multistep, a comma-separated sweep ladder "
+                             "starting at the N=1 baseline (default 1,2,4,8)")
+    parser.add_argument("--multistep", default=None, metavar="OUT_JSON",
+                        help="instead of policy rows, sweep --decode-steps at "
+                             "high occupancy (same burst per depth) and write "
+                             "the artifact (BENCH_MULTISTEP.json) to this "
+                             "path: decode-only tokens/s, host-time share from "
+                             "the decode spans' measured inter-dispatch gaps, "
+                             "and the bitwise identical-vs-N=1 gate per row")
     parser.add_argument("--paged-compare", default=None, metavar="OUT_JSON",
                         help="instead of policy rows, run the fixed-KV-budget "
                              "dense-vs-paged comparison and write the artifact "
@@ -251,6 +264,7 @@ def run_serve_bench(
     workload: str = "mixed",
     page_size: int = 0,
     kv_pages=None,
+    decode_steps: int = 1,
     telemetry=None,
 ) -> list:
     """Run the burst once per policy; returns one SLO row dict per policy.
@@ -307,7 +321,7 @@ def run_serve_bench(
         return ContinuousBatcher(
             params, cfg, max_slots=max_slots, max_len=max_len,
             prompt_bucket=prompt_bucket, spec_k=spec_k, drafter=drafter,
-            page_size=page_size, kv_pages=kv_pages,
+            page_size=page_size, kv_pages=kv_pages, decode_steps=decode_steps,
         )
 
     # Warm every program variant (prefill, decode/verify, each slot's row insert)
@@ -324,7 +338,7 @@ def run_serve_bench(
             fresh_engine(),
             GatewayConfig(
                 enabled=True, policy=policy, max_queue=max_queue,
-                overload="shed", aging_s=5.0,
+                overload="shed", aging_s=5.0, decode_steps=decode_steps,
             ),
             telemetry=telemetry,
         )
@@ -366,6 +380,7 @@ def run_serve_bench(
             "workload": workload,
             "spec_k": spec_k,
             "spec_draft": spec_draft if spec_k else None,
+            "decode_steps": decode_steps,
             "spec_accept_rate": estats["spec_accept_rate"],
             "tokens_per_step": estats["tokens_per_step"],
             "wall_s": round(wall_s, 3),
@@ -1716,6 +1731,168 @@ def run_paged_compare(
     }
 
 
+def run_multistep_bench(
+    preset: str = "smoke",
+    max_len: int = 256,
+    prompt_bucket: int = 16,
+    max_new: int = 32,
+    requests: int = 32,
+    max_slots: int = 8,
+    decode_steps=(1, 2, 4, 8),
+    page_size: int = 0,
+    sampled_frac: float = 0.25,
+    seed: int = 0,
+) -> dict:
+    """Multi-step decode sweep at high occupancy: the acceptance artifact
+    (BENCH_MULTISTEP.json, docs/multistep_decode.md).
+
+    One engine per ``decode_steps`` value replays the SAME saturating burst
+    (every lane busy for most of the run — the regime where per-dispatch host
+    overhead dominates decode). Each row measures decode-only tokens/s (steps
+    that admitted nothing, the ``run_paged_compare`` accounting) and the
+    host-time share of the decode phase, reconstructed from the decode trace
+    spans' measured ``host_s`` inter-dispatch gaps — the N=1 row is the
+    baseline, and the bitwise-parity contract rides along: every row's token
+    streams must be IDENTICAL to the N=1 row's (greedy and sampled lanes)."""
+    import time
+
+    import numpy as np
+
+    from ..compile_cache.warmup import build_model_config
+    from ..generation import GenerationConfig
+    from ..models import llama
+    from ..serving import ContinuousBatcher
+    from ..serving_gateway import ServingGateway
+    from ..telemetry import Telemetry
+    from ..telemetry.provenance import provenance_stamp
+    from ..telemetry.tracing import TRACE_SPAN_SCHEMA, Tracer
+    from ..utils.dataclasses import GatewayConfig, TelemetryConfig
+
+    steps_list = tuple(int(n) for n in decode_steps)
+    if not steps_list or steps_list[0] != 1:
+        raise ValueError(
+            f"decode_steps={decode_steps!r}: the sweep needs the N=1 baseline "
+            "first (parity and speedup are measured against it)"
+        )
+    cfg = build_model_config(preset, max_len)
+    params = llama.init_params(cfg)
+    rng = np.random.default_rng(seed)
+    prompts = [
+        rng.integers(1, cfg.vocab_size, int(n)).astype(np.int32)
+        for n in rng.integers(3, prompt_bucket + 1, requests)
+    ]
+    # A sampled minority rides every row (same PRNG keys across rows): parity
+    # must hold through the per-lane emission-indexed key schedule, not just
+    # the fused argmax.
+    import jax
+
+    gens = []
+    for i in range(requests):
+        if rng.random() < sampled_frac:
+            gens.append((GenerationConfig(max_new_tokens=max_new,
+                                          temperature=0.8, top_p=0.9, top_k=8),
+                         jax.random.PRNGKey(seed * 1000 + i)))
+        else:
+            gens.append((GenerationConfig(max_new_tokens=max_new), None))
+    prov = provenance_stamp(cfg)
+
+    def build(n):
+        return ContinuousBatcher(
+            params, cfg, max_slots=max_slots, max_len=max_len,
+            prompt_bucket=prompt_bucket, page_size=page_size,
+            decode_steps=n,
+        )
+
+    # Warm every program variant (greedy + sampled super-step per depth) on
+    # throwaway engines so no timed row pays XLA compile — jit caches are
+    # process-wide for identical shapes.
+    for n in steps_list:
+        w = build(n)
+        w.submit(prompts[0], max_new_tokens=2)
+        w.submit(prompts[1], gen=GenerationConfig(
+            max_new_tokens=2, temperature=0.8, top_p=0.9, top_k=8,
+        ), rng=jax.random.PRNGKey(seed * 1000 + len(prompts)))
+        w.run()
+
+    rows = []
+    baseline_streams = None
+    baseline_tps = None
+    baseline_host = None
+    for n in steps_list:
+        tel = Telemetry(TelemetryConfig(enabled=True, compile_events=False,
+                                        memory_stats=False))
+        gw = ServingGateway(build(n),
+                            GatewayConfig(enabled=True, decode_steps=n),
+                            telemetry=tel, tracer=Tracer(tel))
+        engine = gw.engine
+        greqs = [gw.submit(p, gen=g, rng=r)
+                 for p, (g, r) in zip(prompts, gens)]
+        t0 = time.perf_counter()
+        decode_wall = 0.0
+        decode_tokens = 0
+        decode_dispatch_steps = 0
+        while gw.queue_depth or gw.running_count:
+            admitted_before = engine.admitted
+            tokens_before = engine.decode_tokens
+            s0 = time.perf_counter()
+            gw.step()
+            s1 = time.perf_counter()
+            emitted = engine.decode_tokens - tokens_before
+            if engine.admitted == admitted_before and emitted:
+                decode_wall += s1 - s0
+                decode_tokens += emitted
+                decode_dispatch_steps += 1
+        wall = time.perf_counter() - t0
+        streams = [list(r.tokens) for r in greqs]
+        # Per-dispatch host accounting: lanes of one super-step share its
+        # (t0, t1, host_s) triple, so dedupe to dispatches before summing.
+        dispatches = {(s["t0"], s["t1"], s["host_s"]) for s in tel.records
+                      if s.get("schema") == TRACE_SPAN_SCHEMA
+                      and s["span"] == "decode"}
+        host_s = sum(d[2] for d in dispatches)
+        busy_s = sum(d[1] - d[0] for d in dispatches)
+        host_share = round(host_s / (host_s + busy_s), 4) \
+            if (host_s + busy_s) > 0 else None
+        tokens = sum(len(t) for t in streams)
+        tps = round(decode_tokens / decode_wall, 1) if decode_wall > 0 else None
+        if n == 1:
+            baseline_streams = streams
+            baseline_tps = tps
+            baseline_host = host_share
+        rows.append({
+            "decode_steps": n,
+            "requests": requests,
+            "max_slots": max_slots,
+            "max_new": max_new,
+            "page_size": page_size,
+            "tokens_generated": tokens,
+            "tokens_per_sec": round(tokens / wall, 1) if wall > 0 else None,
+            "decode_tokens_per_sec": tps,
+            "decode_dispatches": engine.decode_steps,
+            "decode_only_steps": decode_dispatch_steps,
+            "host_share": host_share,
+            "identical_vs_n1": streams == baseline_streams,
+            "provenance": prov,
+        })
+    best = max((r for r in rows[1:]),
+               key=lambda r: r["decode_tokens_per_sec"] or 0.0)
+    return {
+        "schema": "accelerate_tpu.bench.multistep/v1",
+        "preset": preset,
+        "max_slots": max_slots,
+        "requests": requests,
+        "page_size": page_size,
+        "rows": rows,
+        "all_identical": all(r["identical_vs_n1"] for r in rows),
+        "decode_speedup_best": round(
+            (best["decode_tokens_per_sec"] or 0.0) / baseline_tps, 2
+        ) if baseline_tps else None,
+        "best_decode_steps": best["decode_steps"],
+        "host_share_n1": baseline_host,
+        "host_share_best": best["host_share"],
+    }
+
+
 def serve_bench_command(args) -> int:
     import json
 
@@ -1937,6 +2114,36 @@ def serve_bench_command(args) -> int:
             print(json.dumps(row))
         return 0
 
+    if args.multistep:
+        steps = tuple(int(n) for n in str(args.decode_steps).split(","))
+        if steps == (1,):
+            steps = (1, 2, 4, 8)
+        parser_defaults = serve_bench_command_parser()
+        sweep_kw = dict(
+            preset=args.preset,
+            prompt_bucket=args.prompt_bucket,
+            requests=args.requests,
+            decode_steps=steps,
+            page_size=args.page_size,
+            seed=args.seed,
+        )
+        # Sweep-tuned geometry (256-len rows, 8 lanes, 32-token budgets keep
+        # lanes decode-bound) unless the user explicitly moved a shared flag.
+        if args.max_len != parser_defaults.get_default("max_len"):
+            sweep_kw["max_len"] = args.max_len
+        if args.max_slots != parser_defaults.get_default("max_slots"):
+            sweep_kw["max_slots"] = args.max_slots
+        if args.max_new != parser_defaults.get_default("max_new"):
+            sweep_kw["max_new"] = args.max_new
+        artifact = run_multistep_bench(**sweep_kw)
+        with open(args.multistep, "w") as f:
+            json.dump(artifact, f, indent=2)
+        print(json.dumps({k: artifact[k] for k in
+                          ("schema", "all_identical", "decode_speedup_best",
+                           "best_decode_steps", "host_share_n1",
+                           "host_share_best")}))
+        return 0 if artifact["all_identical"] else 1
+
     if args.paged_compare:
         # Compare-tuned geometry defaults (256-len rows, 16 lanes) unless the
         # user explicitly moved a shared flag off its parser default — the
@@ -1992,6 +2199,7 @@ def serve_bench_command(args) -> int:
         workload=args.workload,
         page_size=args.page_size,
         kv_pages=args.kv_pages,
+        decode_steps=int(args.decode_steps),
     )
     for row in rows:
         print(json.dumps(row))

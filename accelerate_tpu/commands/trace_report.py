@@ -15,10 +15,16 @@ Per request, the span set decomposes end-to-end latency into:
   a handoff span also get their stall SPLIT per role (``stall_prefill_s`` /
   ``stall_decode_s``), aggregated as ``stall_by_role``
 - ``decode_s`` — decode rounds this request participated in
-- ``stall_s`` — time spent HOLDING a lane but not inside its own prefill/decode
-  spans: the host loop serving other requests' admissions — invisible in any
-  aggregate, and exactly the number the disaggregated-prefill design
-  (ROADMAP item 3) needs to justify itself
+- ``host_s`` — host dead time between decode dispatches, MEASURED by the decode
+  spans' own ``host_s`` inter-dispatch-gap attribute (previous dispatch end →
+  this dispatch start) and carved out of the stall: the component multi-step
+  decode (``decode_steps=N``, docs/multistep_decode.md) exists to drive toward
+  zero — N tokens then share ONE gap, so the share shrinks with N
+- ``stall_s`` — the REMAINING lane-holding time not inside its own
+  prefill/decode spans and not measured as inter-dispatch gap: the host loop
+  serving other requests' admissions — invisible in any aggregate, and exactly
+  the number the disaggregated-prefill design (ROADMAP item 3) needs to
+  justify itself
 - ``ttft_s`` — reconstructed from spans alone (``first_token.t1 − queue.t0``;
   the gateway's first-token event reuses the clock read its own ``ttft_s``
   derives from, so the reconstruction is exact — tested)
@@ -58,7 +64,7 @@ __all__ = ["trace_report", "train_report", "load_spans", "load_records",
 def trace_report_command_parser(subparsers=None) -> argparse.ArgumentParser:
     description = (
         "Reconstruct per-request timelines and a critical-path latency breakdown "
-        "(queue / prefill / decode / stall / retry) from trace.span/v1 records — "
+        "(queue / prefill / decode / host / stall / retry) from trace.span/v1 records — "
         "or, with --train, per-step MPMD pipeline timelines (stage busy vs "
         "bubble, straggler attribution, crash/replay history) from the "
         "mpmd.stage_step/transfer/barrier record streams."
@@ -174,11 +180,22 @@ def _reconstruct(spans: List[dict]) -> dict:
     n_tokens = terminal[-1].get("n_tokens") if terminal else None
     # Stall: lane-holding time not inside this request's own prefill/decode/
     # handoff spans — the host loop was admitting/prefilling OTHER requests.
-    stall_s = None
+    # Host: the slice of that out-of-span time MEASURED as inter-dispatch gap
+    # by the decode spans' ``host_s`` attribute (previous dispatch end → this
+    # dispatch start — pure host dead time between HBM-bound dispatches, the
+    # component multi-step decode drives toward zero). host_s is CARVED OUT of
+    # the stall so host + stall equals the old stall and component shares
+    # still sum to 1; the clip to the available stall keeps overlapping-lane
+    # accounting honest (every active lane's spans carry the same gap, but a
+    # request only owns the part of it not already attributed elsewhere).
+    stall_s = host_s = None
     stall_prefill_s = stall_decode_s = None
+    host_raw = sum(s.get("host_s") or 0.0 for s in decode)
     if admits:
         running = t_done - admits[0]["t0"] - retry_s
-        stall_s = max(0.0, running - prefill_s - decode_s - handoff_s)
+        stall_raw = running - prefill_s - decode_s - handoff_s
+        host_s = min(host_raw, max(stall_raw, 0.0))
+        stall_s = max(0.0, stall_raw - host_s)
         if handoff:
             # Disaggregated request: the handoff span splits its residency —
             # prefill-replica stall is lane time before the first handoff not
@@ -217,6 +234,7 @@ def _reconstruct(spans: List[dict]) -> dict:
         "prefill_s": prefill_s,
         "handoff_s": handoff_s,
         "decode_s": decode_s,
+        "host_s": host_s,
         "stall_s": stall_s,
         "stall_prefill_s": stall_prefill_s,
         "stall_decode_s": stall_decode_s,
@@ -247,7 +265,7 @@ def trace_report(records: List[dict]) -> dict:
 
     done = [t for t in traces if t["status"] == "done"]
     components = ("queue_s", "retry_s", "prefill_s", "handoff_s", "decode_s",
-                  "stall_s")
+                  "host_s", "stall_s")
     breakdown = {
         c: latency_summary([t[c] for t in done]) for c in components
     }

@@ -63,6 +63,11 @@ def warmup_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("--kv-pages", type=int, default=None,
                         help="page-pool size for --page-size (default: dense-"
                              "equivalent capacity, max_slots × pages-per-row)")
+    parser.add_argument("--decode-steps", type=int, default=1,
+                        help="multi-step decode depth: > 1 warms the fused N-step "
+                             "super-step pair (both sample variants; dense or paged "
+                             "per --page-size) and stamps the depth into the "
+                             "manifest (1 = classic one-token decode)")
     parser.add_argument("--prefix-cache", type=int, default=0,
                         help="prefix-cache capacity: > 0 warms the prefix-serving "
                              "programs (right-aligned prefill/chunk pair; with "
@@ -108,6 +113,7 @@ def warmup_command(args) -> int:
         page_size=args.page_size,
         kv_pages=args.kv_pages,
         prefix_cache=args.prefix_cache,
+        decode_steps=args.decode_steps,
         cache_config=config,
         manifest_path=args.manifest,
     )

@@ -98,6 +98,7 @@ def run_warmup(
     kv_pages: Optional[int] = None,
     prefix_cache: int = 0,
     role: str = "mixed",
+    decode_steps: int = 1,
     cache_config: Optional[CompileCacheConfig] = None,
     manifest_path: Optional[str] = None,
     cache=None,
@@ -142,6 +143,11 @@ def run_warmup(
         raise ValueError(
             f"role={role!r} was given but serve=False: no role-sliced serving "
             "programs would be warmed — pass serve=True (--serve)"
+        )
+    if decode_steps > 1 and not serve:
+        raise ValueError(
+            f"decode_steps={decode_steps} was given but serve=False: no multi-"
+            "step super-step programs would be warmed — pass serve=True (--serve)"
         )
     cfg = build_model_config(preset, seq_len)
     entries: list = []
@@ -221,11 +227,13 @@ def run_warmup(
         # NO prefill programs at all (handoff import + COW copy + lane-valid
         # setup instead), a prefill-role one swaps decode/verify for the page
         # export gather — the manifest records which slice it is warm FOR.
+        # ``decode_steps > 1`` adds the multi-step super-step pair (both sample
+        # variants, dense or paged per the layout above) to the warmed surface.
         engine = ContinuousBatcher(
             params, cfg, max_slots=max_slots, max_len=engine_len,
             compile_cache=cache, spec_k=spec_k, drafter=drafter,
             page_size=page_size, kv_pages=kv_pages, prefix_cache=prefix_cache,
-            role=role,
+            role=role, decode_steps=decode_steps,
         )
         entries.extend(engine.warm_programs(max_new_tokens=max_new_tokens))
 
@@ -257,6 +265,7 @@ def run_warmup(
         ),
         "prefix_cache": prefix_cache if serve else 0,
         "role": role if serve else "mixed",
+        "decode_steps": decode_steps if serve else 1,
         "cache_dir": cache.cache_dir,
         "cache_stats": cache.stats(),
         "programs": [e for e in entries if e],

@@ -38,6 +38,7 @@ __all__ = [
     "GenerationConfig",
     "sample_logits",
     "sampling_core",
+    "sampling_core_dyn_k",
     "speculative_accept",
     "speculative_accept_batch",
     "generate_loop",
@@ -95,6 +96,36 @@ def sampling_core(logits: jax.Array, rng: jax.Array, temperature, top_p, top_k: 
     The serving engine keeps it on (its per-request top_p is traced)."""
     logits = filtered_logits(logits, temperature, top_p, top_k, apply_top_p)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def sampling_core_dyn_k(logits: jax.Array, rng: jax.Array, temperature, top_p,
+                        top_k: jax.Array) -> jax.Array:
+    """:func:`sampling_core` with a TRACED ``top_k`` (0 disables, like the static one).
+
+    The multi-step decode scan samples every lane inside ONE program, so per-lane
+    ``top_k`` cannot be a static trace constant without one compile per distinct k.
+    This variant filters bitwise-identically to the static path: the k-th threshold is
+    the (k−1)-th element of the descending sort — the exact value ``lax.top_k`` returns
+    as its last element (both are exact selections, no arithmetic) — and the mask is
+    gated by ``top_k > 0`` so a disabled filter matches the static path's skipped
+    branch. The top-p block is the same ops as :func:`filtered_logits` verbatim.
+    Asserted bitwise against ``sampling_core`` across k in tests/test_multistep_decode.py."""
+    x = logits.astype(jnp.float32) / temperature
+    sorted_desc = jnp.sort(x, axis=-1)[..., ::-1]
+    k_idx = jnp.broadcast_to(
+        (jnp.maximum(top_k, 1) - 1).astype(jnp.int32), x.shape[:-1]
+    )[..., None]
+    kth = jnp.take_along_axis(sorted_desc, k_idx, axis=-1)
+    x = jnp.where((top_k > 0) & (x < kth), -jnp.inf, x)
+    sorted_logits = jnp.sort(x, axis=-1)[..., ::-1]
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep_sorted = cum - probs < top_p
+    threshold = jnp.min(
+        jnp.where(keep_sorted, sorted_logits, jnp.inf), axis=-1, keepdims=True
+    )
+    x = jnp.where(x < threshold, -jnp.inf, x)
+    return jax.random.categorical(rng, x, axis=-1).astype(jnp.int32)
 
 
 def speculative_accept(p_probs: jax.Array, q_probs: jax.Array, draft_token,

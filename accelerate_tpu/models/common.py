@@ -27,7 +27,7 @@ from ..utils.jax_compat import current_abstract_mesh, shard_map as _shard_map
 __all__ = [
     "remat_wrap", "kv_planes", "write_kv", "read_kv", "quant_kv",
     "paged_kv_planes", "write_kv_paged", "read_kv_paged", "paged_write_coords",
-    "paged_attention_dispatch",
+    "paged_attention_dispatch", "multi_step_decode",
     "fused_ce_allowed", "fused_ce_single_shard",
     "resolve_loss_chunk", "chunked_ce", "ce_sum", "ce_sum_dispatch",
     "sp_active", "sp_manual", "resolve_sp_pipeline", "attention_dispatch",
@@ -200,6 +200,57 @@ def paged_write_coords(tables: jax.Array, pos_grid: jax.Array, page_size: int,
         jnp.int32(num_pages),
     )
     return pages, pos_grid % page_size
+
+
+def multi_step_decode(forward_one: Callable, cache, tokens: jax.Array,
+                      positions: jax.Array, active: jax.Array, budgets: jax.Array,
+                      eos_ids: jax.Array, select_token: Callable, xs, n_steps: int,
+                      max_len: int):
+    """N cached decode steps as ONE ``lax.scan`` — the device-resident super-step
+    both decoder families' ``forward_slots_multi`` wrappers share.
+
+    Per scan step the carried ``tokens`` [B] (each lane's PENDING token — emitted
+    by the previous step but not yet written, exactly the engine's host-loop
+    invariant) are written+attended at ``positions``, one new token per live lane
+    is selected by ``select_token(logits [B,V], x)`` (argmax for greedy; the
+    sampled program folds per-lane emission-indexed keys in via ``xs``), and
+    EOS/budget masking freezes finished lanes IN-SCAN: a frozen lane's write
+    position is clamped to ``max_len`` so the dense scatter and the paged
+    sentinel route both DROP the write (see :func:`write_kv` /
+    :func:`paged_write_coords`) — which is also why the final emitted token of a
+    finishing lane is never written, bitwise matching the N=1 loop where the
+    engine frees the lane before the next dispatch.
+
+    ``active`` bool[B] marks live lanes (idle lanes start frozen and never write
+    — their host-side position stays put, unlike the N=1 path's harmless
+    garbage write; both states are fully re-initialized at admit). ``budgets``
+    int32[B] is each lane's REMAINING token budget (emission stops at exactly
+    ``budgets`` tokens — the drain clamps again host-side, belt and braces).
+    ``eos_ids`` int32[B] uses −1 for "no EOS".
+
+    Returns ``(cache, tok_buf [N,B], counts [B])``: the token buffer is
+    step-major (drain order), ``counts[b]`` is how many of lane b's rows are
+    real emissions; the lane's final position is ``positions[b] + counts[b]``."""
+    done0 = ~active
+    count0 = jnp.zeros(tokens.shape, jnp.int32)
+
+    def body(carry, x):
+        cache, tok, pos, done, count = carry
+        write_pos = jnp.where(done, jnp.int32(max_len), pos)
+        logits, cache = forward_one(cache, tok, write_pos)
+        nxt = select_token(logits, x)
+        nxt = jnp.where(done, tok, nxt)
+        emit = ~done
+        count = count + emit.astype(jnp.int32)
+        hit_eos = (eos_ids >= 0) & (nxt == eos_ids)
+        done = done | (emit & (hit_eos | (count >= budgets)))
+        pos = jnp.where(emit, pos + 1, pos)
+        return (cache, nxt, pos, done, count), nxt
+
+    (cache, _, _, _, counts), tok_buf = jax.lax.scan(
+        body, (cache, tokens, positions, done0, count0), xs, length=n_steps
+    )
+    return cache, tok_buf, counts
 
 
 def paged_attention_dispatch(q, pool, tables, positions, valid, *, page_size: int,

@@ -902,6 +902,43 @@ def forward_slots_paged(
     )
 
 
+def forward_slots_multi(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,
+    positions: jax.Array,
+    active: jax.Array,
+    budgets: jax.Array,
+    eos_ids: jax.Array,
+    select_token,
+    xs,
+    n_steps: int,
+    cfg: GPTConfig,
+    tables: Optional[jax.Array] = None,
+    page_size: int = 0,
+) -> tuple[dict, jax.Array, jax.Array]:
+    """N T == 1 :func:`forward_slots` decode steps as ONE ``lax.scan``,
+    llama-identical contract (``llama.forward_slots_multi``) — the serving
+    engine's ``decode_steps=N`` super-step for a gpt-family model. See
+    :func:`~.common.multi_step_decode` for the freeze/emission contract.
+    Returns ``(cache, tok_buf [n_steps, B], counts [B])``."""
+    from .common import multi_step_decode
+
+    max_len = cache["valid"].shape[1]
+
+    def forward_one(c, tok, write_pos):
+        logits, c = forward_slots(
+            params, tok[:, None], c, write_pos, cfg, tables=tables,
+            page_size=page_size,
+        )
+        return logits[:, -1, :], c
+
+    return multi_step_decode(
+        forward_one, cache, tokens, positions, active, budgets, eos_ids,
+        select_token, xs, n_steps, max_len,
+    )
+
+
 def _make_gen_fns(cfg: GPTConfig, max_len: int):
     def prefill_fn(p, pr, pm):
         cache = init_cache(cfg, pr.shape[0], max_len)

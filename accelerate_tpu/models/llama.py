@@ -1579,6 +1579,45 @@ def forward_slots_paged(
     )
 
 
+def forward_slots_multi(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,
+    positions: jax.Array,
+    active: jax.Array,
+    budgets: jax.Array,
+    eos_ids: jax.Array,
+    select_token,
+    xs,
+    n_steps: int,
+    cfg: LlamaConfig,
+    tables: Optional[jax.Array] = None,
+    page_size: int = 0,
+) -> tuple[dict, jax.Array, jax.Array]:
+    """N :func:`forward_slots` decode steps (T == 1) as ONE ``lax.scan`` — the
+    scan-friendly super-step the serving engine's ``decode_steps=N`` path
+    dispatches. Each scan step is literally a T == 1 ``forward_slots`` call (same
+    rope positions, same valid/causal masking, same paged routing), so per-step
+    logits are bitwise the host-loop's; see
+    :func:`~.common.multi_step_decode` for the freeze/emission contract.
+    Returns ``(cache, tok_buf [n_steps, B], counts [B])``."""
+    from .common import multi_step_decode
+
+    max_len = cache["valid"].shape[1]
+
+    def forward_one(c, tok, write_pos):
+        logits, c = forward_slots(
+            params, tok[:, None], c, write_pos, cfg, tables=tables,
+            page_size=page_size,
+        )
+        return logits[:, -1, :], c
+
+    return multi_step_decode(
+        forward_one, cache, tokens, positions, active, budgets, eos_ids,
+        select_token, xs, n_steps, max_len,
+    )
+
+
 def _make_gen_fns(cfg: LlamaConfig, max_len: int):
     """Stable-identity (prefill, decode) pair for ``generation.generate_loop`` (jit-static)."""
 

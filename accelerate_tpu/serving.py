@@ -69,6 +69,7 @@ from .generation import (
     GenerationConfig,
     filtered_logits,
     sampling_core,
+    sampling_core_dyn_k,
     speculative_accept_batch,
 )
 from .models import llama
@@ -355,6 +356,67 @@ def _spec_verify_step_paged(params, cache, tables, tokens, positions, cfg,
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), logits, cache
 
 
+def _multi_select(sample: bool, keys, temps, top_ps, top_ks):
+    """(select_token, xs) for the multi-step scan body.
+
+    ``sample=False`` (every live lane greedy) is the fused argmax — the exact op
+    ``_decode_step`` returns. ``sample=True`` folds the host-built per-lane
+    EMISSION-INDEXED key windows in as scan xs (``keys`` [B, N, 2] → [N, B, 2]:
+    step j consumes each lane's key for emission ``len(tokens)+j``, exactly the
+    key :meth:`Request._sample` would hand ``_draw`` at that emission) and draws
+    every sampled lane via the vmapped ``sampling_core_dyn_k`` — the same
+    row[None]-shaped draw ``_draw``/``_replay_draws`` dispatch, so sampled
+    output is bitwise the N=1 path's. Greedy lanes ride along with a safe
+    temperature of 1.0 and their draw DISCARDED in favor of the argmax (a
+    divide-by-zero guard, not a semantic: the where picks the argmax)."""
+    if not sample:
+        return (lambda logits, _: jnp.argmax(logits, axis=-1).astype(jnp.int32)), None
+    safe_temps = jnp.where(temps > 0.0, temps, 1.0)
+
+    def select_token(logits, step_keys):
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        drawn = jax.vmap(
+            lambda row, key, t, p, k: sampling_core_dyn_k(row[None], key, t, p, k)[0]
+        )(logits, step_keys, safe_temps, top_ps, top_ks)
+        return jnp.where(temps > 0.0, drawn, greedy)
+
+    return select_token, jnp.moveaxis(keys, 1, 0)
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_steps", "sample"), donate_argnums=(1,))
+def _decode_multi_step(params, cache, tokens, positions, active, budgets, eos_ids,
+                       keys, temps, top_ps, top_ks, cfg, n_steps: int, sample: bool):
+    """``n_steps`` decode steps as ONE dispatched program (tok_buf [N, B] int32,
+    counts [B] int32, new cache) — the device-resident super-step
+    (docs/multistep_decode.md). Sampling, EOS/budget masking and lane freezing
+    all happen in-scan (``llama.forward_slots_multi``); the host drains the
+    token buffer once per super-step instead of once per token."""
+    select_token, xs = _multi_select(sample, keys, temps, top_ps, top_ks)
+    cache, tok_buf, counts = llama.forward_slots_multi(
+        params, cache, tokens, positions, active, budgets, eos_ids,
+        select_token, xs, n_steps, cfg,
+    )
+    return tok_buf, counts, cache
+
+
+@partial(jax.jit, static_argnames=("cfg", "n_steps", "sample", "page_size"),
+         donate_argnums=(1,))
+def _decode_multi_step_paged(params, cache, tables, tokens, positions, active,
+                             budgets, eos_ids, keys, temps, top_ps, top_ks, cfg,
+                             n_steps: int, sample: bool, page_size: int):
+    """:func:`_decode_multi_step` over the PAGED cache: every scan step's K/V
+    writes route through the DEVICE-RESIDENT block tables uploaded once per
+    super-step (admission reserves each lane's full residual budget up front —
+    ``BlockManager.admit`` — so no table entry can appear mid-scan; frozen/past-
+    budget positions route to the sentinel and drop)."""
+    select_token, xs = _multi_select(sample, keys, temps, top_ps, top_ks)
+    cache, tok_buf, counts = llama.forward_slots_multi(
+        params, cache, tokens, positions, active, budgets, eos_ids,
+        select_token, xs, n_steps, cfg, tables=tables, page_size=page_size,
+    )
+    return tok_buf, counts, cache
+
+
 @partial(jax.jit, static_argnames=("page_size", "scan_layers"), donate_argnums=(0,))
 def _insert_row_paged(cache, row_cache, write_ids, slot, page_size: int,
                       scan_layers: bool):
@@ -532,7 +594,8 @@ class ContinuousBatcher:
                  drafter=None, spec_accept: str = "replay", page_size: int = 0,
                  kv_pages: Optional[int] = None, tracer=None, faults=None,
                  step_timeout_s: Optional[float] = None,
-                 recover: Optional[bool] = None, role: str = "mixed"):
+                 recover: Optional[bool] = None, role: str = "mixed",
+                 decode_steps: int = 1):
         self.params = params
         self.cfg = cfg
         self.max_slots = max_slots
@@ -576,6 +639,28 @@ class ContinuousBatcher:
                 "spec_k was given on a prefill-role engine: it never dispatches "
                 "decode, so the verify/draft programs would be dead weight"
             )
+        # Multi-step decode (docs/multistep_decode.md): ``decode_steps=N`` fuses N
+        # decode steps into ONE dispatched lax.scan super-step — sampling,
+        # EOS/budget masking and lane freezing happen on-device, and the host
+        # drains a [N, B] token buffer once per super-step (bitwise the N=1
+        # output, greedy and sampled, dense and paged). Coexists with spec_k:
+        # speculation wins while ``spec_enabled``; the super-step is the decode
+        # path speculation degrades INTO when the gateway disables it (safe —
+        # both paths consume the same emission-indexed key schedule).
+        if not isinstance(decode_steps, (int, np.integer)) or isinstance(
+                decode_steps, bool):
+            raise TypeError(
+                f"decode_steps must be an int, got {type(decode_steps).__name__}")
+        if decode_steps < 1:
+            raise ValueError(
+                f"decode_steps={decode_steps} must be >= 1 (1 = the classic "
+                "one-token dispatch)")
+        if role == "prefill" and decode_steps > 1:
+            raise ValueError(
+                "decode_steps>1 was given on a prefill-role engine: it never "
+                "dispatches decode, so the super-step program would be dead weight"
+            )
+        self.multi_step = int(decode_steps)
         if role == "decode" and prefix_cache:
             raise ValueError(
                 "prefix_cache was given on a decode-role engine: it never runs "
@@ -650,6 +735,12 @@ class ContinuousBatcher:
             _insert_row, cc, "serving.insert_row", ("slot", "scan_layers"))
         self._decode_paged_fn = as_cached(
             _decode_step_paged, cc, "serving.decode_paged", ("cfg", "page_size"))
+        self._decode_multi_fn = as_cached(
+            _decode_multi_step, cc, "serving.decode_multi",
+            ("cfg", "n_steps", "sample"))
+        self._decode_multi_paged_fn = as_cached(
+            _decode_multi_step_paged, cc, "serving.decode_multi_paged",
+            ("cfg", "n_steps", "sample", "page_size"))
         self._spec_verify_paged_fn = as_cached(
             _spec_verify_step_paged, cc, "serving.spec_verify_paged",
             ("cfg", "page_size"))
@@ -754,6 +845,11 @@ class ContinuousBatcher:
         # dominates); proposed/accepted drive the acceptance rate.
         self.decode_steps = 0    # decode/verify dispatches (admission prefills excluded)
         self.decode_tokens = 0   # tokens emitted by those dispatches
+        #: End of the previous decode dispatch (tracer clock), for the measured
+        #: ``host_s`` inter-dispatch gap every decode span carries — the host
+        #: dead time multi-step decode exists to amortize. None until the first
+        #: dispatch of a trace-enabled run (and only maintained while tracing).
+        self._last_dispatch_end: Optional[float] = None
         self.spec_proposed = 0   # draft tokens proposed (spec_k × active lanes per step)
         self.spec_accepted = 0   # proposed tokens that were emitted (match/accept)
         if self.drafter is not None:
@@ -862,6 +958,7 @@ class ContinuousBatcher:
             "bucket_hits": self.bucket_hits,
             "bucket_misses": self.bucket_misses,
             "spec_k": self.spec_k,
+            "multi_step": self.multi_step,
             "decode_steps": self.decode_steps,
             "decode_tokens": self.decode_tokens,
             "tokens_per_step": (
@@ -1244,7 +1341,10 @@ class ContinuousBatcher:
 
     def step(self) -> list[Request]:
         """Admit queued requests, then advance every active slot: one token each
-        (``spec_k == 0``) or a verified 1..spec_k+1-token prefix each (speculative).
+        (``spec_k == 0``) or a verified 1..spec_k+1-token prefix each (speculative),
+        or up to ``decode_steps`` tokens each in one device-resident super-step
+        (``decode_steps > 1`` — admission, eviction and deadline checks then act
+        at SUPER-STEP boundaries; docs/multistep_decode.md).
 
         With recovery armed (``faults``/``step_timeout_s``/``recover=True``) a
         failed dispatch no longer kills the process: the poison request is
@@ -1269,18 +1369,24 @@ class ContinuousBatcher:
             if finished_at_admit:
                 self._emit_telemetry()  # admissions alone still move the counters
             return finished_at_admit
+        # Decode-path routing: speculation wins while enabled (it already emits
+        # multiple tokens per dispatch); the multi-step super-step is BOTH the
+        # standalone fused path and what speculation degrades into when the
+        # gateway's pressure rungs flip ``spec_enabled`` off — safe mid-request,
+        # because every path consumes the same emission-indexed key schedule.
         use_spec = self.spec_k and self.spec_enabled
+        if use_spec:
+            decode = self._spec_step
+        elif self.multi_step > 1:
+            decode = self._multi_step
+        else:
+            decode = self._plain_step
         if not self.recover:
-            finished = (
-                self._spec_step(active) if use_spec else self._plain_step(active)
-            )
+            finished = decode(active)
         else:
             active_reqs = [self.slot_req[i] for i in active]
             try:
-                finished = (
-                    self._spec_step(active) if use_spec
-                    else self._plain_step(active)
-                )
+                finished = decode(active)
             except EngineCrashed:
                 # A crash is the death of the whole engine, not a step fault:
                 # no in-engine quarantine/rebuild is possible — it propagates
@@ -1523,12 +1629,151 @@ class ContinuousBatcher:
         if tracing:
             # One span per traced lane, all sharing this dispatch's [t0, t1] and
             # step index — the index joins these spans to the serving/kv records
-            # the same step emits.
+            # the same step emits. ``host_s`` is the measured inter-dispatch gap
+            # (previous dispatch's end → this one's start): the host dead time
+            # trace-report's host-time column aggregates and multi-step decode
+            # exists to amortize.
             t1 = tracer._clock()
+            host_s = self._host_gap(t0, t1)
             for req in traced:
                 tracer.span(
                     tracer.handle_for(req.uid), "decode", t0, t1,
                     step=self.decode_steps, occupancy=len(active), tokens=1,
+                    host_s=host_s,
+                )
+        return finished
+
+    def _host_gap(self, t0: float, t1: float) -> float:
+        """Measured inter-dispatch gap for this decode dispatch's spans: previous
+        dispatch's end → this dispatch's start, on the tracer clock. 0.0 for the
+        first dispatch of a trace (no previous end to measure from) and clamped
+        at 0 (a virtual clock may not advance between steps). Only called while
+        tracing — the disabled hot path keeps its two-attribute-read contract."""
+        prev = self._last_dispatch_end
+        self._last_dispatch_end = t1
+        return round(max(0.0, t0 - prev), 9) if prev is not None else 0.0
+
+    def _multi_step(self, active: list[int]) -> list[Request]:
+        """Device-resident super-step: ``decode_steps=N`` decode steps in ONE
+        dispatched scan (``serving.decode_multi``/``decode_multi_paged``), then
+        ONE drain of the [N, B] token buffer.
+
+        The program freezes finishing lanes in-scan (EOS / remaining-budget
+        masking — a frozen lane's writes drop out of bounds, so the final
+        emitted token is never written, exactly the N=1 pending-token pattern),
+        which is what makes the emitted streams BITWISE the N=1 engine's:
+        greedy lanes ride the fused argmax, sampled lanes consume their
+        emission-indexed key windows through the same ``sampling_core`` filter
+        ops ``_draw`` dispatches (see ``_multi_select``). The drain is
+        step-major, lane-minor — exact generation order, so ``on_token``
+        streaming transcripts equal the final token lists — and clamps each
+        lane to its remaining budget (belt and braces over the in-scan mask:
+        a gateway deadline can act only at super-step boundaries, so emissions
+        past the budget must never surface). Admission/eviction/deadlines act
+        between super-steps; the fault boundary + watchdog wrap the whole
+        dispatch, so fault attribution and bisection run at super-step
+        granularity (docs/multistep_decode.md)."""
+        N = self.multi_step
+        B = self.max_slots
+        tracer = self.tracer
+        tracing = tracer is not None and tracer.enabled  # the two-attr-read contract
+        t0 = tracer._clock() if tracing else 0.0
+        traced = [(i, self.slot_req[i]) for i in active] if tracing else ()
+        active_mask = np.zeros((B,), bool)
+        budgets = np.ones((B,), np.int32)   # idle lanes: frozen at step 0, never read
+        eos_ids = np.full((B,), -1, np.int32)
+        temps = np.zeros((B,), np.float32)
+        top_ps = np.ones((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        sampled = False
+        key_rows: list = [None] * B
+        for i in active:
+            req = self.slot_req[i]
+            active_mask[i] = True
+            budgets[i] = req.gen.max_new_tokens - len(req.tokens)
+            if req.gen.eos_token_id is not None:
+                eos_ids[i] = req.gen.eos_token_id
+            if req.gen.temperature > 0.0:
+                sampled = True
+                temps[i] = req.gen.temperature
+                top_ps[i] = req.gen.top_p
+                top_ks[i] = req.gen.top_k
+                # Scan step j consumes this lane's key for emission
+                # len(tokens)+j — the exact key Request._sample would hand
+                # _draw at that emission (window clamped at the final key,
+                # like the spec verify surplus: past-budget draws are frozen).
+                key_rows[i] = self._step_keys_window(req, len(req.tokens), N)
+        if sampled:
+            filler = jnp.zeros_like(
+                next(k for k in key_rows if k is not None)
+            )  # greedy/idle lanes: key bits are never consumed (temp 0 → argmax)
+            keys = jnp.stack([k if k is not None else filler for k in key_rows])
+        else:
+            keys = jnp.zeros((B, N, 2), jnp.uint32)
+        t_guard = self._pre_dispatch("serving.decode", active)
+        if self.paged:
+            tok_buf, counts, self.cache = self._decode_multi_paged_fn(
+                self.params, self.cache, jnp.asarray(self.block_mgr.tables),
+                jnp.asarray(self.tokens), jnp.asarray(self.positions),
+                jnp.asarray(active_mask), jnp.asarray(budgets),
+                jnp.asarray(eos_ids), keys, jnp.asarray(temps),
+                jnp.asarray(top_ps), jnp.asarray(top_ks),
+                cfg=self.cfg, n_steps=N, sample=sampled,
+                page_size=self.page_size,
+            )
+        else:
+            tok_buf, counts, self.cache = self._decode_multi_fn(
+                self.params, self.cache, jnp.asarray(self.tokens),
+                jnp.asarray(self.positions), jnp.asarray(active_mask),
+                jnp.asarray(budgets), jnp.asarray(eos_ids), keys,
+                jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks),
+                cfg=self.cfg, n_steps=N, sample=sampled,
+            )
+        tok_host = np.asarray(tok_buf)     # [N, B]
+        counts_host = np.asarray(counts)   # [B]
+        self._post_dispatch(t_guard)  # watchdog check BEFORE any token lands
+        # Drain in exact generation order (step-major, lane-minor — the order N
+        # sequential _plain_step calls would have appended), clamped to each
+        # lane's remaining budget.
+        for j in range(N):
+            for i in active:
+                req = self.slot_req[i]
+                if j >= counts_host[i] or len(req.tokens) >= req.gen.max_new_tokens:
+                    continue
+                tok = int(tok_host[j, i])
+                req.tokens.append(tok)
+                if req.on_token is not None:
+                    req.on_token(tok)
+        finished = []
+        step_tokens = 0
+        for i in active:
+            req = self.slot_req[i]
+            c = int(counts_host[i])
+            step_tokens += c
+            self.tokens[i] = int(tok_host[c - 1, i])  # the new pending token
+            self.positions[i] += c
+            eos = req.gen.eos_token_id
+            hit_eos = eos is not None and req.tokens and req.tokens[-1] == eos
+            if hit_eos or len(req.tokens) >= req.gen.max_new_tokens:
+                req.done = True
+                finished.append(req)
+                self.slot_req[i] = None  # slot frees; cache row overwritten on next admit
+                self._release_lane(i)
+        self.positions = np.minimum(self.positions, self.max_len - 1)
+        self.decode_steps += 1
+        self.decode_tokens += step_tokens
+        if tracing:
+            # One span per traced lane for the whole super-step: ``tokens`` is
+            # that lane's real emission count, ``n_steps`` the fused depth, and
+            # ``host_s`` the measured inter-dispatch gap — N tokens now share
+            # ONE gap, which is the whole point.
+            t1 = tracer._clock()
+            host_s = self._host_gap(t0, t1)
+            for i, req in traced:
+                tracer.span(
+                    tracer.handle_for(req.uid), "decode", t0, t1,
+                    step=self.decode_steps, occupancy=len(active),
+                    tokens=int(counts_host[i]), n_steps=N, host_s=host_s,
                 )
         return finished
 
@@ -1622,11 +1867,13 @@ class ContinuousBatcher:
         self.spec_accepted += step_accepted
         if tracing:
             t1 = tracer._clock()
+            host_s = self._host_gap(t0, t1)
             for req, n_emitted, n_accepted in traced:
                 tracer.span(
                     tracer.handle_for(req.uid), "decode", t0, t1,
                     step=self.decode_steps, occupancy=len(active),
                     tokens=n_emitted, proposed=k, accepted=n_accepted,
+                    host_s=host_s,
                 )
         tel = self.telemetry
         if tel is not None and tel.enabled:
@@ -1719,6 +1966,21 @@ class ContinuousBatcher:
             return out, tokens_per_sec
         return out
 
+    def _multi_warm_args(self):
+        """(traced args, static kwargs) pairs covering the multi-step decode
+        surface for :meth:`warm_programs`: the per-lane vectors after the
+        ``params``/``cache``(/``tables``) prefix, for both ``sample`` variants
+        — shapes/dtypes exactly what ``_multi_step`` uploads at runtime."""
+        B, N = self.max_slots, self.multi_step
+        lanes = jnp.zeros((B,), jnp.int32)
+        args = (
+            lanes, lanes, jnp.zeros((B,), bool), jnp.ones((B,), jnp.int32),
+            jnp.full((B,), -1, jnp.int32), jnp.zeros((B, N, 2), jnp.uint32),
+            jnp.zeros((B,), jnp.float32), jnp.ones((B,), jnp.float32),
+            jnp.zeros((B,), jnp.int32),
+        )
+        return [(args, {"n_steps": N, "sample": s}) for s in (False, True)]
+
     def warm_programs(self, max_new_tokens: int = 32) -> list:
         """Pre-compile this engine's whole program surface into the AOT cache
         WITHOUT executing anything (``python -m accelerate_tpu warmup --serve``).
@@ -1726,7 +1988,9 @@ class ContinuousBatcher:
         Covers: the decode step (``spec_k == 0``) or the fused [B, spec_k+1]
         speculative verify plus the draft source's own programs (``spec_k > 0`` —
         draft AND verify ride the same bucket ladder and warmup manifest, so a
-        spec-enabled replica restart compiles nothing), one prefill per bucket
+        spec-enabled replica restart compiles nothing), the multi-step super-step
+        pair when ``decode_steps > 1`` (both ``sample`` variants — a mixed
+        workload alternates greedy-only and sampled super-steps), one prefill per bucket
         that ``_plan_prefill`` can actually route a ``max_new_tokens``-budget
         request to, the first-chunk + chunk-append pair (the fallback for
         prompts/budgets no bucket fits — always part of the live surface), and
@@ -1754,6 +2018,15 @@ class ContinuousBatcher:
                     self.params, self.cache, tables, lanes, lanes,
                     cfg=self.cfg, page_size=self.page_size,
                 ))
+                if self.multi_step > 1:
+                    # Both sample variants: the engine picks per super-step by
+                    # whether any live lane samples, so a mixed workload needs
+                    # the pair warm (greedy-only AND sampled super-steps).
+                    for args, statics in self._multi_warm_args():
+                        entries.append(self._decode_multi_paged_fn.warm(
+                            self.params, self.cache, tables, *args,
+                            cfg=self.cfg, page_size=self.page_size, **statics,
+                        ))
                 if self.spec_k:
                     seq = jnp.zeros((self.max_slots, self.spec_k + 1), jnp.int32)
                     entries.append(self._spec_verify_paged_fn.warm(
@@ -1809,6 +2082,11 @@ class ContinuousBatcher:
             entries.append(self._decode_fn.warm(
                 self.params, self.cache, lanes, lanes, cfg=self.cfg
             ))
+            if self.multi_step > 1:
+                for args, statics in self._multi_warm_args():
+                    entries.append(self._decode_multi_fn.warm(
+                        self.params, self.cache, *args, cfg=self.cfg, **statics,
+                    ))
             if self.spec_k:
                 seq = jnp.zeros((self.max_slots, self.spec_k + 1), jnp.int32)
                 entries.append(self._spec_verify_fn.warm(

@@ -270,6 +270,21 @@ class ServingGateway:
             config = GatewayConfig(enabled=True)
         self.engine = engine
         self.config = config
+        # Multi-step decode pairing (config.decode_steps, docs/
+        # multistep_decode.md): the engine owns the super-step depth — it
+        # shapes the compiled programs — so the gateway only verifies the
+        # config matches the engine it was handed. Failing here (not at first
+        # step) keeps a mis-stamped deployment from serving with the wrong
+        # streaming granularity/deadline overshoot characteristics.
+        if config.decode_steps > 1 and getattr(
+            engine, "multi_step", 1
+        ) != config.decode_steps:
+            raise ValueError(
+                f"GatewayConfig.decode_steps={config.decode_steps} but the "
+                f"engine runs decode_steps={getattr(engine, 'multi_step', 1)}: "
+                "construct the ContinuousBatcher with the same decode_steps "
+                "(the engine owns the knob; the gateway only validates it)"
+            )
         self.telemetry = telemetry
         # Request-scoped tracing (``telemetry.tracing``): the gateway OPENS the
         # trace at submit (trace_id = gateway uid + monotonic start) and emits the
@@ -536,6 +551,11 @@ class ServingGateway:
 
         # 2) running deadline eviction — the lane frees NOW, so this same step's
         #    admission (below) can refill it: eviction-to-reuse is one step().
+        #    SUPER-STEP granularity: with engine.multi_step = N > 1 this check
+        #    runs once per super-step, so a deadline that lands mid-dispatch is
+        #    observed up to N-1 tokens late — the documented streaming-
+        #    granularity trade (docs/multistep_decode.md). Budgets never
+        #    overshoot: the engine clamps drained emissions per request.
         #    cancel(), not evict_slot(): engine recovery may have PARKED the
         #    request back in its internal queue (rebuild requeue) or bisect
         #    hold, where only cancel() finds it — evict_slot would miss it and

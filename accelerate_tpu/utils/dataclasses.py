@@ -606,6 +606,17 @@ class GatewayConfig(KwargsHandler):
     # Sliding-window horizon (seconds, on the gateway clock) for the plane's
     # histograms / SLO event window / counter-increase reads.
     metrics_window_s: float = 300.0
+    # Streaming-granularity knob (docs/multistep_decode.md): the multi-step
+    # decode depth the gateway EXPECTS of its engine. The engine owns the knob
+    # (``ContinuousBatcher(decode_steps=N)`` — it shapes compiled programs);
+    # the gateway only validates the pairing at construction, so a config
+    # stamped ``decode_steps=4`` can never silently run against a classic
+    # one-token engine (or vice versa). 1 = inherit whatever the engine runs.
+    # Trade-off this stamps: tokens stream in bursts of up to N per dispatch
+    # (TPOT jitter), and a running deadline can overshoot by up to N-1 tokens
+    # mid-dispatch — the engine clamps emissions to each request's budget on
+    # drain, and the gateway checks deadlines at super-step boundaries.
+    decode_steps: int = 1
 
     def __post_init__(self):
         raw = os.environ.get("ACCELERATE_GATEWAY")
@@ -674,6 +685,11 @@ class GatewayConfig(KwargsHandler):
         if self.metrics_window_s <= 0:
             raise ValueError(
                 f"metrics_window_s={self.metrics_window_s} must be > 0"
+            )
+        if self.decode_steps < 1:
+            raise ValueError(
+                f"decode_steps={self.decode_steps} must be >= 1 "
+                "(1 = classic one-token decode)"
             )
         if self.replica_restarts < 0:
             raise ValueError(

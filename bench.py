@@ -871,6 +871,10 @@ def _run_serving_rows(preset: str | None) -> int:
         # paged engine (token-identical; rows stamp page-pool occupancy,
         # kv_bytes_per_request and max_concurrent_at_fixed_mem).
         page_size=int(_os.environ.get("BENCH_SERVE_PAGE_SIZE", "0")),
+        # Multi-step rows: BENCH_SERVE_DECODE_STEPS=4 re-runs every policy with
+        # the fused N-step decode super-step (bitwise-identical output by
+        # construction — tests/test_multistep_decode.py).
+        decode_steps=int(_os.environ.get("BENCH_SERVE_DECODE_STEPS", "1")),
         kv_pages=(int(_os.environ["BENCH_SERVE_KV_PAGES"])
                   if _os.environ.get("BENCH_SERVE_KV_PAGES") else None),
     )
@@ -909,6 +913,45 @@ def _run_paged_compare_row() -> int:
         "kv_budget_bytes": artifact["kv_budget_bytes"],
     }))
     return 0
+
+
+def _run_multistep_row() -> int:
+    """Multi-step decode sweep artifact (``BENCH_MULTISTEP=1``): one
+    ``run_multistep_bench`` pass — the N=1 baseline vs fused super-steps at
+    high occupancy, decode-only tokens/s + host-share columns per depth —
+    written to ``BENCH_MULTISTEP.json`` (override with ``BENCH_MULTISTEP_OUT``).
+    Non-zero when any row's token streams differ from the N=1 baseline (the
+    bitwise parity gate)."""
+    _os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from accelerate_tpu.commands.serve_bench import run_multistep_bench
+
+    steps = tuple(int(n) for n in
+                  _os.environ.get("BENCH_MULTISTEP_STEPS", "1,2,4,8").split(","))
+    artifact = run_multistep_bench(
+        requests=int(_os.environ.get("BENCH_MULTISTEP_REQUESTS", "32")),
+        max_slots=int(_os.environ.get("BENCH_MULTISTEP_SLOTS", "8")),
+        max_new=int(_os.environ.get("BENCH_MULTISTEP_NEW", "32")),
+        page_size=int(_os.environ.get("BENCH_MULTISTEP_PAGE_SIZE", "0")),
+        decode_steps=steps,
+        seed=int(_os.environ.get("BENCH_MULTISTEP_SEED", "0")),
+    )
+    out = _os.environ.get("BENCH_MULTISTEP_OUT", "BENCH_MULTISTEP.json")
+    with open(out, "w") as f:
+        json.dump(artifact, f, indent=2)
+    for row in artifact["rows"]:
+        print(json.dumps({k: row[k] for k in row if k != "provenance"}))
+    print(json.dumps({
+        "metric": "serve/multistep",
+        "decode_speedup_best": artifact["decode_speedup_best"],
+        "best_decode_steps": artifact["best_decode_steps"],
+        "host_share_n1": artifact["host_share_n1"],
+        "host_share_best": artifact["host_share_best"],
+        "all_identical": artifact["all_identical"],
+    }))
+    return 0 if artifact["all_identical"] else 1
 
 
 def _run_elastic_row() -> int:
@@ -1015,6 +1058,8 @@ def main():
         return _run_disagg_row()
     if os.environ.get("BENCH_PAGED"):
         return _run_paged_compare_row()
+    if os.environ.get("BENCH_MULTISTEP"):
+        return _run_multistep_row()
     if os.environ.get("BENCH_SERVE"):
         # Serving rows are a separate, self-contained mode: no train state, no
         # watchdog/OOM machinery — the gateway drains deterministically or raises.

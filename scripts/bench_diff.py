@@ -69,6 +69,7 @@ GUARDS: List[Tuple[str, str, float]] = [
     ("*tpot.p95", "lower", 0.60),
     ("*queue_wait.p95", "lower", 0.60),
     ("*stall_share*", "lower", 0.50),
+    ("*host_share*", "lower", 0.50),
 ]
 
 

@@ -374,6 +374,94 @@ def test_paged_serving_second_varied_workload_compiles_zero():
     assert engine.stats()["paged"] is True
 
 
+def test_multistep_serving_second_varied_workload_compiles_zero():
+    """Multi-step compile surface (docs/multistep_decode.md): super-step depth
+    N and the sample flag are STATIC (two programs per layout); lane count,
+    budgets, EOS, key schedules and admission order are DATA — a second varied
+    workload on a decode_steps=4 engine (different prompts, lengths, budgets,
+    sampled AND greedy lanes, lane churn) compiles zero new programs.
+
+    One pre-existing carve-out, shared with the N=1 engine: a sampled request's
+    key SCHEDULE (``jax.random.split(rng, max_new_tokens)`` + the window
+    gather) mints a few tiny host-side programs per distinct sampled budget —
+    so the second workload's sampled budgets reuse first-workload values while
+    everything else (prompts, lengths, greedy budgets, order) varies."""
+    import jax
+
+    from accelerate_tpu.generation import GenerationConfig
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.serving import ContinuousBatcher
+
+    # Distinct geometry so no other serving test's executables are reused.
+    cfg = dataclasses.replace(
+        llama.CONFIGS["tiny"], dtype=jnp.float32, d_model=48, n_heads=2, n_kv_heads=2
+    )
+    params = llama.init_params(cfg)
+    engine = ContinuousBatcher(
+        params, cfg, max_slots=2, max_len=64, prompt_buckets=(16,),
+        decode_steps=4,
+    )
+    rng = np.random.default_rng(5)
+
+    def workload(lens, budgets, seed):
+        for i, (n, b) in enumerate(zip(lens, budgets)):
+            prompt = rng.integers(1, cfg.vocab_size, n).astype(np.int32)
+            if i % 2:
+                engine.submit(prompt, gen=GenerationConfig(
+                    max_new_tokens=b, temperature=0.8, top_p=0.9, top_k=7,
+                ), rng=jax.random.PRNGKey(seed + i))
+            else:
+                engine.submit(prompt, max_new_tokens=b)
+        engine.run()
+
+    mon = CompileMonitor().start()
+    try:
+        workload((3, 5, 9, 12), (3, 6, 11, 2), seed=0)   # sampled budgets 6, 2
+        if not mon.supported:
+            pytest.skip("this jax exposes no jax.monitoring API")
+        first_workload = mon.count
+        workload((2, 7, 11, 6), (7, 2, 5, 6), seed=40)   # sampled budgets 2, 6
+    finally:
+        mon.stop()
+    # Loose first-workload bound (prefill + per-slot inserts + the two
+    # super-step variants + key-schedule plumbing); the pin is the ZERO below.
+    assert first_workload <= 30, first_workload
+    assert mon.count == first_workload, (
+        f"second multi-step workload recompiled {mon.count - first_workload} programs"
+    )
+    assert engine.stats()["multi_step"] == 4
+
+
+def test_warmup_enumerates_multistep_programs(tmp_path):
+    """run_warmup(decode_steps=4) lists BOTH super-step sample variants in the
+    manifest and stamps the depth — a cache directory is auditable for which
+    decode granularity it is warm FOR (dense here, paged via page_size)."""
+    from accelerate_tpu.analysis.program import LowerOnlyCache
+    from accelerate_tpu.compile_cache.warmup import run_warmup
+
+    manifest = run_warmup(
+        cache=LowerOnlyCache(), manifest_path=str(tmp_path / "m.json"),
+        preset="smoke", batch_size=2, seq_len=16, train=False, eval_step=False,
+        serve=True, max_slots=2, max_len=128, max_new_tokens=4, decode_steps=4,
+    )
+    assert manifest["decode_steps"] == 4
+    labels = {e["label"] for e in manifest["programs"]}
+    assert "serving.decode_multi" in labels, labels
+    assert "serving.decode" in labels  # one-token restarts stay warm too
+    paged = run_warmup(
+        cache=LowerOnlyCache(), emit_manifest=False,
+        preset="smoke", batch_size=2, seq_len=16, train=False, eval_step=False,
+        serve=True, max_slots=2, max_len=128, max_new_tokens=4, decode_steps=2,
+        page_size=24,
+    )
+    assert {e["label"] for e in paged["programs"]} >= {"serving.decode_multi_paged"}
+    # decode_steps without serve would warm nothing — must be loud.
+    with pytest.raises(ValueError, match="serve"):
+        run_warmup(cache=LowerOnlyCache(), emit_manifest=False,
+                   preset="smoke", batch_size=2, seq_len=16, train=False,
+                   serve=False, decode_steps=4)
+
+
 def test_warmup_enumerates_paged_programs(tmp_path):
     """run_warmup(page_size=...) lists the paged decode/verify, the dynamic-slot
     page scatter, and (with prefix_cache) the page gather + partial-page copy in

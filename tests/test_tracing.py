@@ -574,7 +574,8 @@ def test_trace_report_cli(tmp_path, capsys, setup):
     summary = json.loads(out)
     assert summary["n_traces"] == 3 and summary["by_status"]["done"] == 3
     assert set(summary["breakdown"]) == {"queue_s", "retry_s", "prefill_s",
-                                         "handoff_s", "decode_s", "stall_s"}
+                                         "handoff_s", "decode_s", "host_s",
+                                         "stall_s"}
 
     assert cli_main(["trace-report", path, "--uid", "0"]) == 0
     out = capsys.readouterr().out
