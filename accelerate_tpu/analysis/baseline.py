@@ -15,7 +15,7 @@ from __future__ import annotations
 import collections
 import json
 import os
-from typing import Dict, List, Sequence, Tuple
+from typing import Dict, List, Mapping, Optional, Sequence, Tuple
 
 from .engine import REPO_ROOT, Finding
 
@@ -35,34 +35,44 @@ def load_baseline(path: str = BASELINE_FILE) -> Dict[tuple, int]:
     return out
 
 
+#: tier name -> the CLI subcommand that regenerates its baseline. All three
+#: tiers share this file format and ratchet contract.
+_TOOL_COMMANDS = {"graftlint": "lint", "graftaudit": "audit", "memaudit": "memaudit"}
+
+
 def write_baseline(
-    findings: Sequence[Finding], path: str = BASELINE_FILE, tool: str = "graftlint"
+    findings: Sequence[Finding],
+    path: str = BASELINE_FILE,
+    tool: str = "graftlint",
+    estimates: Optional[Mapping] = None,
 ) -> int:
     """Rewrite the baseline from current findings; returns the entry count.
 
     ``tool`` labels the producing tier ("graftlint" for the AST pass,
-    "graftaudit" for the program pass) — both share this format and ratchet.
+    "graftaudit" for the program pass, "memaudit" for the memory/comms pass) —
+    all share this format and ratchet. ``estimates`` (memaudit only) adds the
+    ratcheted per-program-label estimate table
+    (``{label: {peak_bytes, ici_bytes, dcn_bytes}}``) the tolerance band
+    compares against.
     """
-    command = "lint" if tool == "graftlint" else "audit"
+    command = _TOOL_COMMANDS.get(tool, tool)
     counts = collections.Counter(f.key() for f in findings)
     rows = [
         {"rule": rule, "path": p, "code": code, "count": n}
         for (rule, p, code), n in sorted(counts.items())
     ]
+    payload = {
+        "version": 1,
+        "tool": tool,
+        "note": "Grandfathered findings. This file only shrinks: fix or suppress "
+        "(with a reason) instead of adding entries; regenerate with "
+        f"`python -m accelerate_tpu {command} --baseline`.",
+        "findings": rows,
+    }
+    if estimates is not None:
+        payload["estimates"] = {k: estimates[k] for k in sorted(estimates)}
     with open(path, "w", encoding="utf-8") as f:
-        json.dump(
-            {
-                "version": 1,
-                "tool": tool,
-                "note": "Grandfathered findings. This file only shrinks: fix or suppress "
-                "(with a reason) instead of adding entries; regenerate with "
-                f"`python -m accelerate_tpu {command} --baseline`.",
-                "findings": rows,
-            },
-            f,
-            indent=1,
-            sort_keys=False,
-        )
+        json.dump(payload, f, indent=1, sort_keys=False)
         f.write("\n")
     return len(rows)
 

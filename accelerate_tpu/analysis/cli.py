@@ -60,6 +60,11 @@ def build_arg_parser(parser: Optional[argparse.ArgumentParser] = None) -> argpar
         help="with --check: skip the graftaudit program-level gate",
     )
     parser.add_argument(
+        "--skip-memaudit",
+        action="store_true",
+        help="with --check: skip the graftmem memory/comms gate",
+    )
+    parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog and exit"
     )
     return parser
@@ -171,6 +176,8 @@ def run_cli(args, out=None) -> int:
             print("graftlint: docs/api is fresh", file=out)
     if args.check and not getattr(args, "skip_audit", False):
         rc = max(rc, audit_gate(out=out))
+    if args.check and not getattr(args, "skip_memaudit", False):
+        rc = max(rc, memaudit_gate(out=out))
     return rc
 
 
@@ -180,10 +187,22 @@ def audit_gate(root: str = REPO_ROOT, out=None, timeout: int = 300) -> int:
     A subprocess because the audit must trace real programs (jax, CPU backend)
     while this process keeps the lint tier's no-jax-import guarantee. Returns
     the gate's exit code (0 clean, 1 findings, 2 could-not-run)."""
+    return _program_gate("audit", "graftaudit", root=root, out=out, timeout=timeout)
+
+
+def memaudit_gate(root: str = REPO_ROOT, out=None, timeout: int = 300) -> int:
+    """Run the graftmem memory/comms gate in a subprocess (ISSUE 16 tentpole):
+    same isolation contract as :func:`audit_gate`."""
+    return _program_gate("memaudit", "graftmem", root=root, out=out, timeout=timeout)
+
+
+def _program_gate(
+    command: str, tier: str, root: str = REPO_ROOT, out=None, timeout: int = 300
+) -> int:
     out = out if out is not None else sys.stderr
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     # Audit the same 8-virtual-device geometry the test suite validates
-    # (tests/conftest.py): on a single device the replicated-sharding rule and
+    # (tests/conftest.py): on a single device the replicated-sharding rules and
     # the multi-device donation analysis can never fire, so a 1-device gate
     # would silently check a weaker program set than the tests do.
     if "xla_force_host_platform_device_count" not in env.get("XLA_FLAGS", ""):
@@ -192,7 +211,7 @@ def audit_gate(root: str = REPO_ROOT, out=None, timeout: int = 300) -> int:
         ).strip()
     try:
         proc = subprocess.run(
-            [sys.executable, "-m", "accelerate_tpu", "audit", "--check"],
+            [sys.executable, "-m", "accelerate_tpu", command, "--check"],
             cwd=root,
             capture_output=True,
             text=True,
@@ -200,12 +219,13 @@ def audit_gate(root: str = REPO_ROOT, out=None, timeout: int = 300) -> int:
             env=env,
         )
     except subprocess.TimeoutExpired:
-        print(f"graftlint: audit gate timed out after {timeout}s", file=out)
+        print(f"graftlint: {command} gate timed out after {timeout}s", file=out)
         return 2
     tail = (proc.stdout + proc.stderr)[-4000:]
     if proc.returncode != 0:
-        print(f"graftlint: audit gate failed (rc={proc.returncode}):\n{tail}", file=out)
+        print(f"graftlint: {command} gate failed (rc={proc.returncode}):\n{tail}",
+              file=out)
         return 1 if proc.returncode == 1 else 2  # 1 = findings, anything else = broken gate
     last = proc.stdout.strip().splitlines()
-    print(last[-1] if last else "graftaudit: clean", file=out)
+    print(last[-1] if last else f"{tier}: clean", file=out)
     return 0

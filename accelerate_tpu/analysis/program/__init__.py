@@ -10,9 +10,14 @@ Finding/suppression/ratcheting-baseline engine. Entry points:
 
 - ``python -m accelerate_tpu audit [--check|--baseline]`` (CLI; imports jax on
   the CPU backend)
-- ``lint --check`` runs the audit gate too (in a subprocess — the lint process
-  itself stays jax-free)
-- ``from accelerate_tpu.analysis.program import run_audit`` (library; tests)
+- ``python -m accelerate_tpu memaudit [--check|--baseline|--budget BYTES]`` —
+  the graftmem memory/comms tier over the same captures (``memory.py``):
+  static per-device peak-HBM estimates, priced ICI/DCN collective traffic,
+  chip-budget gate, ratcheted per-label estimate baseline
+- ``lint --check`` runs the audit and memaudit gates too (in subprocesses —
+  the lint process itself stays jax-free)
+- ``from accelerate_tpu.analysis.program import run_audit, run_memaudit``
+  (library; tests)
 
 Unlike ``analysis/``'s stdlib-only modules, this package imports jax — it must,
 to trace. Keep anything jax-free in the parent package.
@@ -26,18 +31,40 @@ from .audit import (
     run_audit,
 )
 from .capture import ProgramCapture, capture_lowering
-from .inventory import collective_inventory
+from .inventory import collective_inventory, replicated_input_bytes
 from .lowering import LowerOnlyCache, capture_default_programs
+from .memory import (
+    DEFAULT_CHIP_BUDGET_BYTES,
+    MEM_BASELINE_FILE,
+    all_memory_rules,
+    comms_cost,
+    estimate_program_memory,
+    known_memaudit_rule_ids,
+    memaudit_findings,
+    memory_rule_by_id,
+    program_estimates,
+    program_memory_summary,
+    run_memaudit,
+)
 from .rules import ProgramRule, all_program_rules, program_rule_by_id
-from .suppressions import SUPPRESSIONS, AuditSuppression, apply_audit_suppressions
+from .suppressions import (
+    MEM_SUPPRESSIONS,
+    SUPPRESSIONS,
+    AuditSuppression,
+    apply_audit_suppressions,
+)
 
 __all__ = [
     "AUDIT_BASELINE_FILE",
     "AuditSuppression",
+    "DEFAULT_CHIP_BUDGET_BYTES",
     "LowerOnlyCache",
+    "MEM_BASELINE_FILE",
+    "MEM_SUPPRESSIONS",
     "ProgramCapture",
     "ProgramRule",
     "SUPPRESSIONS",
+    "all_memory_rules",
     "all_program_rules",
     "apply_audit_suppressions",
     "audit_findings",
@@ -45,7 +72,16 @@ __all__ = [
     "capture_default_programs",
     "capture_lowering",
     "collective_inventory",
+    "comms_cost",
+    "estimate_program_memory",
     "known_audit_rule_ids",
+    "known_memaudit_rule_ids",
+    "memaudit_findings",
+    "memory_rule_by_id",
+    "program_estimates",
+    "program_memory_summary",
     "program_rule_by_id",
+    "replicated_input_bytes",
     "run_audit",
+    "run_memaudit",
 ]

@@ -56,13 +56,16 @@ def audit_findings(
 
 
 def audit_summaries(captures: Sequence[ProgramCapture]) -> List[dict]:
-    """Per-program audit provenance: collectives + donation effectiveness.
+    """Per-program audit provenance: collectives, donation effectiveness, and
+    the graftmem static memory/comms estimate.
 
     This is what ``run_warmup`` stamps into the warmup manifest (and emits as
-    telemetry records) so a cache directory carries the comms/donation profile
-    of the executables it holds.
+    telemetry records) so a cache directory carries the comms/donation/HBM
+    profile of the executables it holds — bench rows compare the stamped
+    ``memory.peak_bytes`` estimate against the allocator's measured peak.
     """
     from .capture import main_arg_attributes
+    from .memory import program_memory_summary
 
     out = []
     for c in captures:
@@ -93,6 +96,7 @@ def audit_summaries(captures: Sequence[ProgramCapture]) -> List[dict]:
                 "deferred": deferred,
                 "dead": len(donated) - aliased - deferred,
             },
+            "memory": program_memory_summary(c),
             "lower_warnings": list(c.warnings),
         })
     return out

@@ -98,14 +98,17 @@ def run_cli(args, out=None) -> int:
     new, grandfathered, stale = apply_baseline(findings, baseline)
 
     if args.json:
+        # Pure JSON on stdout — the human trailers below would break parsers.
         print(json.dumps({
             "findings": [f.__dict__ for f in new],
             "grandfathered": grandfathered,
             "programs": summaries,
+            "stale_baseline": len(stale),
+            "stale_suppressions": [s.__dict__ for s in stale_sups],
         }, indent=2, default=str), file=out)
-    else:
-        for f in new:
-            print(f.format(), file=out)
+        return 1 if new else 0
+    for f in new:
+        print(f.format(), file=out)
     if stale:
         print(
             f"graftaudit: {len(stale)} baseline entries no longer observed — ratchet "

@@ -25,7 +25,7 @@ from typing import Optional
 from .capture import ProgramCapture
 
 __all__ = ["collective_inventory", "jaxpr_collectives", "hlo_collectives",
-           "stage_transfer_bytes"]
+           "stage_transfer_bytes", "replicated_input_bytes"]
 
 #: jaxpr primitive name -> canonical collective kind.
 _PRIM_KINDS = {
@@ -177,6 +177,35 @@ def stage_transfer_bytes(capture: ProgramCapture):
     return 0
 
 
+def replicated_input_bytes(capture: ProgramCapture, min_bytes: int = 1 << 20) -> int:
+    """Total bytes of large fully-replicated inputs on a >1-device mesh.
+
+    The same population graftaudit's ``replicated-sharding`` rule flags
+    (``min_bytes`` defaults to its 1 MiB threshold), summed into ONE ratchet
+    number per program: the ZeRO-1 sharding work (ROADMAP item 2) drives this
+    to zero, and the inventory/manifest diff shows the progress per PR."""
+    from .capture import flat_inputs
+
+    total = 0
+    for _, leaf in flat_inputs(capture):
+        sharding = getattr(leaf, "sharding", None)
+        if sharding is None:
+            continue
+        try:
+            n_dev = len(sharding.device_set)
+            replicated = sharding.is_fully_replicated
+        except Exception:  # noqa: BLE001 - exotic sharding types
+            continue
+        size = getattr(leaf, "size", None)
+        dtype = getattr(leaf, "dtype", None)
+        if size is None or dtype is None:
+            continue
+        nbytes = int(size) * int(getattr(dtype, "itemsize", 4))
+        if n_dev > 1 and replicated and nbytes >= min_bytes:
+            total += nbytes
+    return total
+
+
 def collective_inventory(capture: ProgramCapture) -> dict:
     """Merged inventory for one captured program (manifest/telemetry shape).
 
@@ -207,4 +236,8 @@ def collective_inventory(capture: ProgramCapture) -> dict:
         # compiled-collective totals would be the same view-conflation the
         # jaxpr/compiled split guards against.
         "stage_transfer_bytes": stage_transfer_bytes(capture),
+        # The >=1 MiB fully-replicated input total (the replicated-sharding
+        # rule's flagged set, summed): the single number the ZeRO-1 sharding
+        # work ratchets down, diffable across PRs from any manifest.
+        "replicated_input_bytes": replicated_input_bytes(capture),
     }

@@ -1,0 +1,157 @@
+"""graftmem CLI: ``python -m accelerate_tpu memaudit [--check|--baseline]``.
+
+Exit codes mirror lint/audit: 0 clean beyond the baseline, 1 new findings,
+2 usage error. Imports jax (CPU backend) — it lowers the full default audit
+surface (train/eval/serving/paged/disagg/MPMD), then runs the static memory
+and comms estimators plus the memory rules over the captures. Seconds on CPU,
+no TPU, no execution.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Optional, Sequence
+
+from ..baseline import apply_baseline, load_baseline, write_baseline
+from ..engine import REPO_ROOT
+from .memory import (
+    DEFAULT_CHIP_BUDGET_BYTES,
+    DEFAULT_ESTIMATE_BAND,
+    MEM_BASELINE_FILE,
+    all_memory_rules,
+    load_estimates,
+    run_memaudit,
+)
+
+__all__ = ["build_arg_parser", "main", "run_cli"]
+
+
+def build_arg_parser(parser: Optional[argparse.ArgumentParser] = None) -> argparse.ArgumentParser:
+    if parser is None:
+        parser = argparse.ArgumentParser(
+            "graftmem",
+            description="Static per-device HBM + comms-cost audit: lowers the "
+            "warmup program set (no TPU, no execution), estimates per-program "
+            "peak HBM and priced ICI/DCN traffic, gates on the chip budget and "
+            "a ratcheted per-label estimate baseline.",
+        )
+    parser.add_argument(
+        "--check", action="store_true",
+        help="CI gate: exit 1 on findings beyond graftmem_baseline.json",
+    )
+    parser.add_argument(
+        "--baseline", action="store_true",
+        help="rewrite graftmem_baseline.json (findings + per-label estimate "
+        "table) from the current run (ratchet reset)",
+    )
+    parser.add_argument(
+        "--baseline-file", default=MEM_BASELINE_FILE,
+        help="alternate baseline path (default: repo-root graftmem_baseline.json)",
+    )
+    parser.add_argument(
+        "--budget", type=int, default=DEFAULT_CHIP_BUDGET_BYTES, metavar="BYTES",
+        help="chip_budget_bytes for the hbm-budget-exceeded rule "
+        f"(default {DEFAULT_CHIP_BUDGET_BYTES} = 16 GiB)",
+    )
+    parser.add_argument(
+        "--band", type=float, default=DEFAULT_ESTIMATE_BAND,
+        help="relative tolerance band on ratcheted estimates "
+        f"(default {DEFAULT_ESTIMATE_BAND})",
+    )
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the memory-rule catalog and exit")
+    parser.add_argument("--json", action="store_true",
+                        help="emit findings + per-label estimates as JSON")
+    parser.add_argument("--preset", default="smoke",
+                        help="model preset to lower (warmup presets; default smoke)")
+    parser.add_argument("--batch-size", type=int, default=8)
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--no-serve", action="store_true",
+                        help="skip the serving programs (audited by default)")
+    parser.add_argument("--no-eval", action="store_true",
+                        help="skip the eval-step program (audited by default)")
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None, out=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    return run_cli(args, out=out)
+
+
+def run_cli(args, out=None) -> int:
+    out = out if out is not None else sys.stdout
+    if args.list_rules:
+        for r in all_memory_rules():
+            print(f"{r.id:28s} {r.severity:8s} {r.description}", file=out)
+        return 0
+
+    baseline_estimates = None if args.baseline else load_estimates(args.baseline_file)
+    findings, estimates, stale_sups, notices = run_memaudit(
+        budget_bytes=args.budget,
+        band=args.band,
+        baseline_estimates=baseline_estimates,
+        preset=args.preset,
+        batch_size=args.batch_size,
+        seq_len=args.seq_len,
+        serve=not args.no_serve,
+        eval_step=not args.no_eval,
+    )
+
+    if args.baseline:
+        n = write_baseline(findings, args.baseline_file, tool="memaudit",
+                           estimates=estimates)
+        print(
+            f"graftmem: wrote {n} grandfathered entr{'y' if n == 1 else 'ies'} "
+            f"and {len(estimates)} program estimates to "
+            f"{os.path.relpath(args.baseline_file, REPO_ROOT)}",
+            file=out,
+        )
+        return 0
+
+    baseline = load_baseline(args.baseline_file)
+    new, grandfathered, stale = apply_baseline(findings, baseline)
+
+    if args.json:
+        # Pure JSON on stdout — the human trailers below would break parsers.
+        print(json.dumps({
+            "findings": [f.__dict__ for f in new],
+            "grandfathered": grandfathered,
+            "estimates": estimates,
+            "stale_baseline": len(stale),
+            "notices": notices,
+            "stale_suppressions": [s.__dict__ for s in stale_sups],
+        }, indent=2, default=str), file=out)
+        return 1 if new else 0
+    for f in new:
+        print(f.format(), file=out)
+    if stale:
+        print(
+            f"graftmem: {len(stale)} baseline entries no longer observed — ratchet "
+            "down with `python -m accelerate_tpu memaudit --baseline`", file=out,
+        )
+    for note in notices:
+        print(
+            f"graftmem: estimate shrank outside the band ({note}) — ratchet down "
+            "with `python -m accelerate_tpu memaudit --baseline`", file=out,
+        )
+    for s in stale_sups:
+        print(
+            f"graftmem: stale suppression (matched nothing): {s.rule} on "
+            f"'{s.program}' — delete it from analysis/program/suppressions.py",
+            file=out,
+        )
+    peak_label, peak = max(
+        estimates.items(), key=lambda kv: kv[1]["peak_bytes"], default=("-", None)
+    )
+    peak_mib = (peak["peak_bytes"] / (1 << 20)) if peak else 0.0
+    print(
+        f"graftmem: {len(new)} new finding{'s' if len(new) != 1 else ''}, "
+        f"{grandfathered} grandfathered, {len(estimates)} programs estimated, "
+        f"max peak {peak_mib:.1f} MiB ({peak_label}), "
+        f"budget {args.budget / (1 << 30):.1f} GiB",
+        file=out,
+    )
+    return 1 if new else 0

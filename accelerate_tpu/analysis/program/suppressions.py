@@ -21,7 +21,8 @@ from typing import Iterable, List, Sequence, Tuple
 
 from ..engine import Finding
 
-__all__ = ["AuditSuppression", "SUPPRESSIONS", "apply_audit_suppressions"]
+__all__ = ["AuditSuppression", "SUPPRESSIONS", "MEM_SUPPRESSIONS",
+           "apply_audit_suppressions"]
 
 
 @dataclasses.dataclass(frozen=True)
@@ -44,6 +45,12 @@ class AuditSuppression:
 #: check; delete entries the moment the underlying finding is fixed (stale
 #: entries are themselves reported).
 SUPPRESSIONS: Tuple[AuditSuppression, ...] = (
+)
+
+#: graftmem's table, separate because the tiers have different rule-id sets
+#: (an entry naming an audit rule would be flagged unknown by the memaudit
+#: validator, and vice versa). Same contract, same stale reporting.
+MEM_SUPPRESSIONS: Tuple[AuditSuppression, ...] = (
 )
 
 

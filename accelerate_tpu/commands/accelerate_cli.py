@@ -11,6 +11,7 @@ from .env import env_command_parser
 from .estimate import estimate_command_parser
 from .launch import launch_command_parser
 from .lint import lint_command_parser
+from .memaudit import memaudit_command_parser
 from .merge import merge_command_parser
 from .metrics_dump import metrics_dump_command_parser
 from .serve_bench import serve_bench_command_parser
@@ -36,6 +37,7 @@ def get_parser() -> argparse.ArgumentParser:
     estimate_command_parser(subparsers=subparsers)
     launch_command_parser(subparsers=subparsers)
     lint_command_parser(subparsers=subparsers)
+    memaudit_command_parser(subparsers=subparsers)
     merge_command_parser(subparsers=subparsers)
     metrics_dump_command_parser(subparsers=subparsers)
     serve_bench_command_parser(subparsers=subparsers)

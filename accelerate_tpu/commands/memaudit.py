@@ -1,0 +1,36 @@
+"""``accelerate-tpu memaudit`` — run graftmem (see ``analysis/program/memory.py``).
+
+Thin wrapper like ``commands/audit.py``; the estimators, rules and ratcheted
+baseline live in ``analysis.program.memcli``. This command imports jax (CPU
+backend) — it lowers real programs, unlike ``lint``."""
+
+from __future__ import annotations
+
+import argparse
+
+from ..analysis.program.memcli import build_arg_parser, run_cli
+
+__all__ = ["memaudit_command", "memaudit_command_parser"]
+
+
+def memaudit_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = (
+        "Static per-device HBM and comms-cost audit of the warmup program set: "
+        "sharding-aware peak-memory estimates, priced ICI/DCN collective "
+        "traffic, chip-budget gate, ratcheted per-program baseline. CPU "
+        "backend, no execution."
+    )
+    if subparsers is not None:
+        parser = subparsers.add_parser("memaudit", description=description)
+    else:
+        parser = argparse.ArgumentParser(
+            "accelerate-tpu memaudit", description=description
+        )
+    build_arg_parser(parser)
+    if subparsers is not None:
+        parser.set_defaults(func=memaudit_command)
+    return parser
+
+
+def memaudit_command(args) -> int:
+    return run_cli(args)

@@ -292,6 +292,7 @@ def _emit_audit_telemetry(accelerator, summaries: list) -> None:
             "label": s["label"],
             "collectives": s["collectives"],
             "donation": s["donation"],
+            "memory": s["memory"],
         })
 
 

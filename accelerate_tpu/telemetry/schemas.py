@@ -126,8 +126,9 @@ MPMD_BARRIER_SCHEMA = "accelerate_tpu.telemetry.mpmd.barrier/v1"
 #: bubbles and straggler attribution from.
 MPMD_STAGE_STEP_SCHEMA = "accelerate_tpu.telemetry.mpmd.stage_step/v1"
 
-#: One record per warmup-precompiled program: graftaudit collective inventory
-#: and donation effectiveness (``compile_cache.warmup``).
+#: One record per warmup-precompiled program: graftaudit collective inventory,
+#: donation effectiveness, and the graftmem static memory/comms estimate
+#: (``compile_cache.warmup``).
 AUDIT_PROGRAM_SCHEMA = "accelerate_tpu.telemetry.audit.program/v1"
 
 #: One span per request-lifecycle phase (``telemetry.tracing``): queue wait,
@@ -282,9 +283,11 @@ SCHEMA_REGISTRY: Dict[str, RecordSchema] = {
         ),
         _reg(
             AUDIT_PROGRAM_SCHEMA,
-            ("label", "collectives", "donation"),
+            # "memory" rode a required-key ratchet-UP within /v1 (the allowed
+            # direction): the graftmem static peak-HBM + priced ICI/DCN block.
+            ("label", "collectives", "donation", "memory"),
             "compile_cache.warmup",
-            "per-program graftaudit inventory (collectives, donation)",
+            "per-program graftaudit inventory (collectives, donation, memory)",
         ),
         _reg(
             TRACE_SPAN_SCHEMA,

@@ -503,6 +503,7 @@ def run(B: int, S: int, fuse: int, preset: str | None, default_metric: str | Non
     if acc.compile_cache.capture:
         from accelerate_tpu.analysis.program import audit_summaries
 
+        summaries = audit_summaries(acc.compile_cache.capture)
         out["program_audit"] = [
             {
                 "label": s["label"],
@@ -515,9 +516,26 @@ def run(B: int, S: int, fuse: int, preset: str | None, default_metric: str | Non
                 ),
                 "collective_bytes": s["collectives"]["total_bytes"],
                 "donation": s["donation"],
+                "memory": s["memory"],
             }
-            for s in audit_summaries(acc.compile_cache.capture)
+            for s in summaries
         ]
+        # graftmem estimate vs allocator ground truth (ISSUE 16): the worst
+        # per-program static peak beside the runtime's measured peak, plus the
+        # relative estimator error — bench_diff bands the error so the static
+        # model can't silently rot while TPU rows keep both columns honest.
+        # (CPU has no allocator ledger; measured columns are absent there.)
+        from accelerate_tpu.telemetry import device_memory_stats
+
+        out["hbm_peak_estimated_bytes"] = max(
+            (s["memory"]["peak_bytes"] for s in summaries), default=0
+        )
+        measured_peak = device_memory_stats().get("peak_bytes_in_use")
+        if measured_peak and out["hbm_peak_estimated_bytes"]:
+            out["hbm_peak_measured_bytes"] = int(measured_peak)
+            out["hbm_estimate_rel_error"] = round(
+                abs(out["hbm_peak_estimated_bytes"] - measured_peak) / measured_peak, 4
+            )
     if ceiling is not None:
         mfu_measured = tflops / ceiling
         if mfu_measured > 1.0:

@@ -84,6 +84,7 @@ MODULES = [
     ("accelerate_tpu.analysis.program.inventory", "Program audit: collective inventory"),
     ("accelerate_tpu.analysis.program.suppressions", "Program audit suppressions"),
     ("accelerate_tpu.analysis.program.audit", "Program audit driver"),
+    ("accelerate_tpu.analysis.program.memory", "Memory/comms estimator (graftmem)"),
     ("accelerate_tpu.compile_cache.cache", "AOT compile cache"),
     ("accelerate_tpu.compile_cache.fingerprint", "Compile-cache fingerprints"),
     ("accelerate_tpu.compile_cache.buckets", "Serving shape buckets"),

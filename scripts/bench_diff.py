@@ -70,6 +70,12 @@ GUARDS: List[Tuple[str, str, float]] = [
     ("*queue_wait.p95", "lower", 0.60),
     ("*stall_share*", "lower", 0.50),
     ("*host_share*", "lower", 0.50),
+    # graftmem estimator health: the estimate-vs-measured relative error may
+    # not grow more than 50% of itself across PRs — the static model drifting
+    # away from the allocator's ground truth is regression, not noise. The raw
+    # byte columns are deliberately unguarded (layout changes move them
+    # legitimately; the memaudit ratchet bands those per program instead).
+    ("*hbm_estimate_rel_error", "lower", 0.50),
 ]
 
 

@@ -1,7 +1,8 @@
 #!/usr/bin/env bash
-# Single CI/dev gate: AST lint + program audit + docs/api drift, one exit code.
+# Single CI/dev gate: AST lint + program audit + memory audit + docs/api
+# drift, one exit code.
 #
-#   scripts/check.sh          # all three gates
+#   scripts/check.sh          # all gates
 #   scripts/check.sh --fast   # lint only (no jax import, <5 s)
 #
 # Each gate exits non-zero on ANY new finding (the baselines are empty at HEAD
@@ -22,7 +23,7 @@ esac
 rc=0
 
 echo "== graftlint (AST tier) =="
-python -m accelerate_tpu lint --check --skip-docs --skip-audit || rc=1
+python -m accelerate_tpu lint --check --skip-docs --skip-audit --skip-memaudit || rc=1
 
 if [ "${1:-}" = "--fast" ]; then
     exit $rc
@@ -30,6 +31,9 @@ fi
 
 echo "== graftaudit (program tier) =="
 python -m accelerate_tpu audit --check || rc=1
+
+echo "== graftmem (memory/comms tier) =="
+python -m accelerate_tpu memaudit --check || rc=1
 
 echo "== telemetry schema registry =="
 # The generated schema table in docs/telemetry.md must match the registry

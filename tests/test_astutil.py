@@ -238,9 +238,17 @@ def test_suppression_of_unknown_rule_inside_fixture_dir(tmp_path):
 
 # ------------------------------------------------------------------ ratchet refusal
 
-def test_baseline_ratchet_refuses_regrowth(tmp_path):
+import pytest
+
+
+@pytest.mark.parametrize("tool,command", [
+    ("graftlint", "lint"), ("graftaudit", "audit"), ("memaudit", "memaudit"),
+])
+def test_baseline_ratchet_refuses_regrowth(tmp_path, tool, command):
     """A baseline written at N findings absorbs at most N: the N+1th instance of
-    the SAME keyed finding fails, and clearing the code reports stale entries."""
+    the SAME keyed finding fails, and clearing the code reports stale entries.
+    All three tiers (lint/audit/memaudit) share the format and the ratchet —
+    the written file names its tool and the regenerating subcommand."""
     src = """
     import dataclasses
 
@@ -253,7 +261,12 @@ def test_baseline_ratchet_refuses_regrowth(tmp_path):
     findings = run_lint(paths=(str(f),), root=str(tmp_path))
     assert len(findings) == 1
     bl = tmp_path / "bl.json"
-    write_baseline(findings, str(bl))
+    write_baseline(findings, str(bl), tool=tool)
+    import json
+
+    on_disk = json.loads(bl.read_text())
+    assert on_disk["tool"] == tool
+    assert f"accelerate_tpu {command} --baseline" in on_disk["note"]
 
     # Same finding twice (the keyed line duplicated in another class) exceeds
     # the grandfathered count — exactly one comes back as new.

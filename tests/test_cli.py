@@ -307,8 +307,8 @@ def test_cli_help_lists_subcommands(capsys):
     out = capsys.readouterr().out
     for sub in (
         "audit", "chaos-train", "config", "env", "estimate-memory", "launch",
-        "lint", "merge-weights", "metrics-dump", "serve-bench", "test",
-        "tpu-config", "trace-report", "warmup",
+        "lint", "memaudit", "merge-weights", "metrics-dump", "serve-bench",
+        "test", "tpu-config", "trace-report", "warmup",
     ):
         assert sub in out
 

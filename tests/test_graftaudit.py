@@ -205,6 +205,24 @@ def test_inventory_counts_shard_map_psum(mesh8):
 def test_inventory_empty_for_local_program():
     inv = collective_inventory(cap(lambda x: x * 2, jnp.zeros((4,))))
     assert inv["jaxpr"] == {} and inv["total_count"] == 0
+    assert inv["replicated_input_bytes"] == 0
+
+
+def test_inventory_replicated_input_bytes_total(mesh8):
+    """The ZeRO-1 ratchet number: the >=1 MiB fully-replicated inputs the
+    replicated-sharding rule flags, summed per program — sharded and small
+    leaves contribute nothing."""
+    big = jax.device_put(
+        jnp.zeros((512, 512), jnp.float32), NamedSharding(mesh8, P())
+    )  # 1 MiB replicated: counted in full
+    sharded = jax.device_put(
+        jnp.zeros((512, 512), jnp.float32), NamedSharding(mesh8, P("dp", None))
+    )
+    small = jax.device_put(jnp.zeros((8, 8), jnp.float32), NamedSharding(mesh8, P()))
+    inv = collective_inventory(
+        cap(lambda a, b, c: (a + b, c * 2), big, sharded, small)
+    )
+    assert inv["replicated_input_bytes"] == 512 * 512 * 4
 
 
 def test_hlo_inventory_parses_compiled_text():
