@@ -5,6 +5,7 @@ from __future__ import annotations
 import argparse
 
 from .audit import audit_command_parser
+from .capsule_report import capsule_report_command_parser
 from .chaos_train import chaos_train_command_parser
 from .config import config_command_parser
 from .env import env_command_parser
@@ -31,6 +32,7 @@ def get_parser() -> argparse.ArgumentParser:
     )
     subparsers = parser.add_subparsers(help="accelerate-tpu command helpers", dest="command")
     audit_command_parser(subparsers=subparsers)
+    capsule_report_command_parser(subparsers=subparsers)
     chaos_train_command_parser(subparsers=subparsers)
     config_command_parser(subparsers=subparsers)
     env_command_parser(subparsers=subparsers)

@@ -69,6 +69,11 @@ def chaos_train_command_parser(subparsers=None) -> argparse.ArgumentParser:
                         help="checkpoint rotation limit (fully-committed epochs)")
     parser.add_argument("--seed", type=int, default=0,
                         help="data/init/fault seed")
+    parser.add_argument("--capsule-dir", default=None, metavar="DIR",
+                        help="keep the flight-recorder incident capsules "
+                             "under DIR/{clean,chaos} (inspect with "
+                             "accelerate-tpu capsule-report); default: a temp "
+                             "dir, summarized into the artifact and deleted")
     parser.add_argument("--smoke", action="store_true",
                         help="tier-1 CI shape (small steps/model, higher crash rate)")
     if subparsers is not None:
@@ -120,20 +125,32 @@ def run_chaos_train(
     seed: int = 0,
     workdir: Optional[str] = None,
     telemetry=None,
+    capsule_dir=None,
 ) -> dict:
     """The elastic-training proof (BENCH_ELASTIC.json): one deterministic MPMD
     workload trained twice — clean, then under seeded per-gang stage crashes
     with gang-of-gangs recovery — asserting the ISSUE-11 invariants (zero
     lost/double-applied steps, bitwise-identical recovered state, restart
     accounting within the per-gang budget). Returns the artifact dict; the
-    ``invariants`` block carries each verdict so the CLI can gate on them."""
+    ``invariants`` block carries each verdict so the CLI can gate on them.
+
+    Both arms run with the flight recorder armed (``capsule_dir``, a temp dir
+    when not given): gang crashes surface as ``elastic.restart/v1`` records
+    (``StageCrashed`` carries no fault record — the supervisor's restart
+    accounting is the incident), so every crashed gang must yield a
+    ``restart:<gang_id>`` capsule and the clean arm must yield ZERO — both
+    stamped into ``invariants`` and therefore CLI-gated."""
     import functools
+    import shutil
     import tempfile
 
     from ..elastic import FleetSupervisor, GangOfGangs
     from ..parallel.mpmd import build_demo_stage, demo_data_fn
     from ..resilience.faults import FaultPlan, FaultSpec
+    from ..telemetry import Telemetry
     from ..telemetry.provenance import provenance_stamp
+    from ..utils.dataclasses import TelemetryConfig
+    from .serve_bench import _capsule_summary
 
     if not 0.0 < crash_rate < 1.0:
         raise ValueError(f"crash_rate={crash_rate} must be in (0, 1)")
@@ -144,31 +161,50 @@ def run_chaos_train(
     # the way out — bench/test loops must not leak checkpoint trees into /tmp.
     own_workdir = workdir is None
     workdir = workdir or tempfile.mkdtemp(prefix="chaos_train_")
+    own_capsules = capsule_dir is None
+    capsule_root = capsule_dir or tempfile.mkdtemp(prefix="elastic-capsules-")
     import os
+
+    def arm_telemetry(arm: str):
+        # Per-arm flight recorder (mirrors serve-bench's per-arm
+        # observability): a fresh enabled Telemetry with the recorder armed,
+        # forwarding to the caller's stream when one was passed. Per-arm is
+        # load-bearing — the capsule gate asserts the CLEAN arm wrote zero,
+        # which a shared recorder could never prove.
+        tel = Telemetry(TelemetryConfig(
+            enabled=True, compile_events=False, memory_stats=False,
+            recorder=True, capsule_dir=os.path.join(capsule_root, arm),
+        ))
+        if telemetry is not None and getattr(telemetry, "enabled", False):
+            tel.sinks.append(telemetry.emit)
+        return tel
 
     try:
         data_fn = demo_data_fn(seed, microbatches, batch, width)
         gang_ids = [f"stage{i}" for i in range(stages)]
 
-        def build_arm(arm: str, plans, supervisor, clock, sleep):
+        def build_arm(arm: str, plans, supervisor, clock, sleep, tel):
             ckpt_dir = os.path.join(workdir, arm)
 
             def factory(i):
                 return build_demo_stage(
                     i, n_stages=stages, width=width, n_microbatches=microbatches,
                     seed=seed, faults=None if plans is None else plans[i],
-                    telemetry=telemetry,
+                    telemetry=tel,
                 )
 
             return GangOfGangs(
                 factory, stages, checkpoint_dir=ckpt_dir, supervisor=supervisor,
                 checkpoint_every=checkpoint_every, total_limit=total_limit,
-                telemetry=telemetry, clock=clock, sleep=sleep,
+                telemetry=tel, clock=clock, sleep=sleep,
             )
 
         # ---- clean arm: the undisturbed reference lineage.
+        tel_clean = arm_telemetry("clean")
         clean_clock = _VirtualClock()
-        clean = build_arm("clean", None, None, clean_clock, clean_clock.advance)
+        tel_clean.recorder.bind_clock(clean_clock)
+        clean = build_arm("clean", None, None, clean_clock,
+                          clean_clock.advance, tel_clean)
         clean_summary = clean.run(data_fn, steps)
 
         # ---- chaos arm: one persistent crash plan per gang, keyed (seed, gang_id)
@@ -182,12 +218,15 @@ def run_chaos_train(
             )
             for i in range(stages)
         }
+        tel_chaos = arm_telemetry("chaos")
         vclock = _VirtualClock()
+        tel_chaos.recorder.bind_clock(vclock)
         supervisor = FleetSupervisor(
             max_restarts=max_restarts, restart_backoff=restart_backoff,
-            telemetry=telemetry, clock=vclock,
+            telemetry=tel_chaos, clock=vclock,
         )
-        chaos = build_arm("chaos", plans, supervisor, vclock, vclock.advance)
+        chaos = build_arm("chaos", plans, supervisor, vclock, vclock.advance,
+                          tel_chaos)
         from ..elastic import WorkerFailure
 
         budget_exhausted = False
@@ -196,6 +235,15 @@ def run_chaos_train(
         except WorkerFailure:
             budget_exhausted = True
             chaos_summary = chaos.summary(steps)
+
+        # ---- incident capsules: every gang that crashed must have dumped a
+        # restart:<gang_id> capsule; the clean arm's armed recorder must have
+        # dumped none. In the invariants block, so the CLI gates on them.
+        crashes = sum(len(p.fired) for p in plans.values())
+        capsules_clean = _capsule_summary(os.path.join(capsule_root, "clean"))
+        capsules_chaos = _capsule_summary(os.path.join(capsule_root, "chaos"))
+        crashed_gangs = {gang_ids[i] for i in range(stages) if plans[i].fired}
+        expected_triggers = {f"restart:{g}" for g in crashed_gangs}
 
         # ---- invariants (the acceptance gate).
         restarts = chaos_summary["restarts"]
@@ -214,7 +262,12 @@ def run_chaos_train(
             ),
             "restarts_match_crashes": (
                 sum(restarts.values()) == chaos_summary["stage_crashes"]
-                == sum(len(p.fired) for p in plans.values())
+                == crashes
+            ),
+            "capsules_clean_zero": capsules_clean["count"] == 0,
+            "capsules_chaos_expected": (
+                expected_triggers <= set(capsules_chaos["triggers"])
+                if crashes else capsules_chaos["count"] == 0
             ),
         }
         artifact = {
@@ -244,15 +297,17 @@ def run_chaos_train(
                 "backoff_virtual_s": chaos_summary["backoff_s"],
             },
             "invariants": invariants,
+            "capsules_clean": capsules_clean["count"],
+            "capsules": capsules_chaos,
             "clean": _arm_columns(clean_summary),
             "chaos": _arm_columns(chaos_summary),
             "provenance": provenance_stamp(),
         }
     finally:
         if own_workdir:
-            import shutil
-
             shutil.rmtree(workdir, ignore_errors=True)
+        if own_capsules:
+            shutil.rmtree(capsule_root, ignore_errors=True)
     return artifact
 
 
@@ -296,6 +351,7 @@ def chaos_train_command(args) -> int:
         restart_backoff=args.restart_backoff,
         total_limit=args.total_limit,
         seed=args.seed,
+        capsule_dir=args.capsule_dir,
     )
     with open(args.out, "w") as f:
         json.dump(artifact, f, indent=2)
@@ -306,6 +362,9 @@ def chaos_train_command(args) -> int:
         "stage_crashes": artifact["chaos"]["stage_crashes"],
         "replayed_steps": artifact["chaos"]["replayed_steps"],
         "restarts_by_gang": artifact["supervisor"]["restarts_by_gang"],
+        "capsules_clean": artifact["capsules_clean"],
+        "capsules_chaos": artifact["capsules"]["count"],
+        "capsule_triggers": artifact["capsules"]["triggers"],
         "invariants": artifact["invariants"],
     }))
     # The artifact is an acceptance gate: ANY failed invariant is a non-zero

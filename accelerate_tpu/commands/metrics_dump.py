@@ -54,6 +54,11 @@ def metrics_dump_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("--smoke", action="store_true",
                         help="self-contained end-to-end smoke: run a tiny "
                              "workload, dump it, verify the aggregates")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable mode: print ONE JSON document "
+                             "and nothing else (equivalent to --format json; "
+                             "with --smoke, the verdict + plane stats as JSON "
+                             "instead of prometheus text + trailer lines)")
     if subparsers is not None:
         parser.set_defaults(func=metrics_dump_command)
     return parser
@@ -74,7 +79,7 @@ def aggregate_records(records: List[dict], window: int = 0):
     return plane
 
 
-def run_metrics_smoke(verbose: bool = True) -> int:
+def run_metrics_smoke(verbose: bool = True, as_json: bool = False) -> int:
     """The ``--smoke`` body: tiny clean gateway workload with the plane and
     stock alert rules armed → record → offline re-aggregation → reconcile.
     Returns a process exit code (non-zero on any broken invariant)."""
@@ -139,6 +144,18 @@ def run_metrics_smoke(verbose: bool = True) -> int:
         text = prometheus_text(offline)
         if done_key not in text:
             failures.append("prometheus dump lacks the done-requests series")
+        if as_json:
+            # Pure machine mode: verdict + plane state as ONE document —
+            # the failures ride inside it, never as bare trailer lines.
+            print(json.dumps({
+                "ok": not failures,
+                "records_consumed": offline.records_consumed,
+                "requests": n_requests,
+                "alerts_fired": len(alert_engine.fired),
+                "failures": failures,
+                "stats": offline.stats(),
+            }, indent=2, default=float))
+            return 1 if failures else 0
         if verbose:
             print(text)
             print(f"metrics-dump --smoke: {offline.records_consumed} records, "
@@ -152,8 +169,9 @@ def run_metrics_smoke(verbose: bool = True) -> int:
 def metrics_dump_command(args) -> int:
     import sys
 
+    as_json = getattr(args, "json", False)
     if args.smoke:
-        return run_metrics_smoke()
+        return run_metrics_smoke(as_json=as_json)
     if not args.jsonl:
         print("metrics-dump: provide JSONL input(s) or --smoke",
               file=sys.stderr)
@@ -166,8 +184,8 @@ def metrics_dump_command(args) -> int:
         print(f"metrics-dump: no records in {args.jsonl}", file=sys.stderr)
         return 1
     plane = aggregate_records(records, window=args.window)
-    if args.format == "json":
-        print(json.dumps(plane.stats(), indent=2))
+    if as_json or args.format == "json":
+        print(json.dumps(plane.stats(), indent=2, default=float))
     else:
         sys.stdout.write(prometheus_text(plane))
     return 0

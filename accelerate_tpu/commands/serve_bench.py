@@ -169,6 +169,12 @@ def serve_bench_command_parser(subparsers=None) -> argparse.ArgumentParser:
                         help="fire budget of each replica's crash clause; "
                              "default 2 for --fleet --chaos, 1 for the "
                              "--disagg chaos arm")
+    parser.add_argument("--capsule-dir", default=None, metavar="DIR",
+                        help="keep the flight-recorder incident capsules the "
+                             "--chaos arms write under DIR/{clean,chaos} "
+                             "(inspect with accelerate-tpu capsule-report); "
+                             "default: a temp dir, summarized into the "
+                             "artifact and deleted")
     parser.add_argument("--loads", default="0.5,1.0,2.0,4.0",
                         help="comma-separated offered-load sweep for "
                              "--trace-curves")
@@ -464,12 +470,14 @@ class _ChaosObservability:
     #: burn window must fit inside it (AlertEngine validates this).
     WINDOW_S = 120.0
 
-    def __init__(self, forward_to=None):
+    def __init__(self, forward_to=None, capsule_dir=None):
         from ..telemetry import Telemetry
         from ..utils.dataclasses import TelemetryConfig
 
+        self.capsule_dir = capsule_dir
         self.telemetry = Telemetry(TelemetryConfig(
             enabled=True, compile_events=False, memory_stats=False,
+            recorder=capsule_dir is not None, capsule_dir=capsule_dir,
         ))
         if forward_to is not None and getattr(forward_to, "enabled", False):
             self.telemetry.sinks.append(forward_to.emit)
@@ -492,14 +500,47 @@ class _ChaosObservability:
 
     def summary(self) -> dict:
         stats = self.plane.stats()
-        return {
+        out = {
             "metrics": {k: stats[k] for k in
                         ("records_consumed", "counters", "gauges", "slo")},
             "alerts": self.alerts.summary(),
         }
+        recorder = getattr(self.telemetry, "recorder", None)
+        if recorder is not None:
+            out["recorder"] = recorder.stats()
+        return out
 
     def fired_rules(self) -> set:
         return {r["rule"] for r in self.alerts.fired if r["state"] == "firing"}
+
+
+def _capsule_summary(capsule_dir, expected_sites=(), expected_alerts=()):
+    """The capsule coverage block a chaos artifact carries: every capsule
+    under ``capsule_dir`` reconstructed via :func:`~.capsule_report.
+    capsule_report` and reduced to the gateable facts — how many capsules,
+    which triggers, whether every injected fault site and every fired alert
+    rule is named by at least one capsule's report. The bench gates on this
+    (``capsules_chaos_expected`` / ``capsules_clean_zero``), which makes the
+    capsule path a tier-1 proof surface, not best-effort debugging output."""
+    from ..telemetry.recorder import list_capsules, load_capsule
+    from .capsule_report import capsule_report
+
+    reports = [capsule_report(load_capsule(p))
+               for p in list_capsules(capsule_dir)]
+    sites, kinds, alerts = set(), set(), set()
+    for r in reports:
+        sites.update(r["fault_sites"])
+        kinds.update(r["fault_kinds"])
+        alerts.update(r["alerts_fired"])
+    return {
+        "count": len(reports),
+        "triggers": sorted({r["trigger"] for r in reports}),
+        "fault_sites": sorted(sites),
+        "fault_kinds": sorted(kinds),
+        "alerts": sorted(alerts),
+        "sites_covered": set(expected_sites) <= sites,
+        "alerts_covered": set(expected_alerts) <= alerts,
+    }
 
 
 def _replay_one_policy(params, cfg, policy, trace, *, max_slots, max_len,
@@ -799,6 +840,7 @@ def run_chaos_bench(
     page_size: int = 0,
     kv_pages=None,
     telemetry=None,
+    capsule_dir=None,
 ) -> dict:
     """The chaos proof (BENCH_CHAOS.json): replay ONE workload trace twice —
     clean, then under a seeded ``FaultPlan`` failing ``chaos_rate`` of the
@@ -808,7 +850,17 @@ def run_chaos_bench(
     reaches a machine-readable terminal state), recovered-request token
     streams BYTE-IDENTICAL to the clean replay (asserted per request, stamped
     as ``streams_identical``), availability, per-site fire counts, and
-    faulted-vs-clean p95 TTFT/TPOT on the shared virtual clock."""
+    faulted-vs-clean p95 TTFT/TPOT on the shared virtual clock.
+
+    Both arms run with the flight recorder armed (``capsule_dir``, a temp dir
+    when not given): the chaos arm must produce a capsule naming every
+    injected fault site and every fired alert rule; the clean arm must
+    produce ZERO. Stamped as ``capsules``/``capsules_clean_zero``/
+    ``capsules_chaos_expected`` and gated by the CLI."""
+    import os
+    import shutil
+    import tempfile
+
     from ..compile_cache.warmup import build_model_config
     from ..models import llama
     from ..serving_gateway.workload import generate_workload, trace_hash
@@ -856,8 +908,13 @@ def run_chaos_bench(
     # SAME rule set watches both arms; the chaos arm must fire the fault-burst
     # (and, under enough injected failure, SLO-burn) alerts, the clean arm
     # must stay silent.
-    obs_clean = _ChaosObservability(forward_to=telemetry)
-    obs_chaos = _ChaosObservability(forward_to=telemetry)
+    capsule_root = capsule_dir or tempfile.mkdtemp(prefix="chaos-capsules-")
+    obs_clean = _ChaosObservability(
+        forward_to=telemetry,
+        capsule_dir=os.path.join(capsule_root, "clean"))
+    obs_chaos = _ChaosObservability(
+        forward_to=telemetry,
+        capsule_dir=os.path.join(capsule_root, "chaos"))
     clean_streams, clean_factory = stream_capture()
     gw_clean, greqs_clean = _replay_one_policy(
         params, cfg, policy, trace, on_token_factory=clean_factory,
@@ -885,6 +942,19 @@ def run_chaos_bench(
                  **obs_clean.summary()}
     chaos_arm = {**_chaos_arm_summary(gw_chaos, greqs_chaos),
                  **obs_chaos.summary()}
+    # Incident capsules: every injected fault site must be named by at least
+    # one capsule's report (fault:<site> captures are never cooldown-
+    # suppressed on first fire), every fired alert rule by an alert:<rule>
+    # capsule; the clean arm — same trace, same rules, recorder armed — must
+    # write none.
+    capsules_clean = _capsule_summary(os.path.join(capsule_root, "clean"))
+    capsules_chaos = _capsule_summary(
+        os.path.join(capsule_root, "chaos"),
+        expected_sites=plan.stats()["by_site"],
+        expected_alerts=obs_chaos.fired_rules(),
+    )
+    if capsule_dir is None:
+        shutil.rmtree(capsule_root, ignore_errors=True)
     return {
         "schema": "accelerate_tpu.bench.chaos/v1",
         "preset": preset,
@@ -913,6 +983,15 @@ def run_chaos_bench(
         "alerts_clean_silent": not obs_clean.alerts.fired,
         "alerts_chaos_fired": sorted(obs_chaos.fired_rules()),
         "alerts_chaos_expected": "step-failure-burst" in obs_chaos.fired_rules(),
+        # Capsule invariants (gated by the CLI): the chaos arm's flight
+        # recorder must dump ≥1 capsule covering every injected site and
+        # fired rule; the clean arm's recorder must dump zero.
+        "capsules_clean": capsules_clean["count"],
+        "capsules_clean_zero": capsules_clean["count"] == 0,
+        "capsules": capsules_chaos,
+        "capsules_chaos_expected": (capsules_chaos["count"] >= 1
+                                    and capsules_chaos["sites_covered"]
+                                    and capsules_chaos["alerts_covered"]),
         "clean": clean_arm,
         "chaos": chaos_arm,
     }
@@ -1026,6 +1105,7 @@ def run_fleet_chaos_bench(
     restart_backoff: float = 2.0,
     generator: str = "poisson",
     telemetry=None,
+    capsule_dir=None,
 ) -> dict:
     """The fleet resilience proof (BENCH_FLEET.json): replay ONE workload
     trace three ways on the shared virtual clock —
@@ -1044,7 +1124,17 @@ def run_fleet_chaos_bench(
     undisturbed fleet (per-request capture with on_retry reset), availability
     per arm (the fleet must beat the single engine — the reason the router
     exists), zero circuit-reason rejections while a healthy replica remained,
-    per-class deadline attainment, and the failover p95 TTFT penalty."""
+    per-class deadline attainment, and the failover p95 TTFT penalty.
+
+    Both observed arms run with the flight recorder armed: every replica kill
+    must yield a capsule (``recovery:replica_died`` — crashes surface at the
+    router, not as engine fault records — plus ``alert:replica-died``), and
+    the clean fleet must write ZERO. Stamped and gated like the stream/alert
+    invariants."""
+    import os
+    import shutil
+    import tempfile
+
     from ..compile_cache.warmup import build_model_config
     from ..models import llama
     from ..resilience.faults import FaultPlan, FaultSpec
@@ -1100,8 +1190,13 @@ def run_fleet_chaos_bench(
                   restart_backoff=restart_backoff, telemetry=telemetry)
     # Per-arm alert planes: the kill sequence must trip the breaker-open (and
     # fault-burst) alerts in the chaos arm; the clean fleet stays silent.
-    obs_clean = _ChaosObservability(forward_to=telemetry)
-    obs_chaos = _ChaosObservability(forward_to=telemetry)
+    capsule_root = capsule_dir or tempfile.mkdtemp(prefix="fleet-capsules-")
+    obs_clean = _ChaosObservability(
+        forward_to=telemetry,
+        capsule_dir=os.path.join(capsule_root, "clean"))
+    obs_chaos = _ChaosObservability(
+        forward_to=telemetry,
+        capsule_dir=os.path.join(capsule_root, "chaos"))
     clean_streams, clean_factory = stream_capture()
     r_clean, g_clean = _replay_fleet(
         params, cfg, policy, trace, n_replicas=n_replicas,
@@ -1134,6 +1229,17 @@ def run_fleet_chaos_bench(
                   **_attainment_point(r_single, g_single, load)}
     p95_clean = (clean_arm["ttft"] or {}).get("p95")
     p95_chaos = (chaos_arm["ttft"] or {}).get("p95")
+    # Incident capsules: replica crashes raise EngineCrashed and surface at
+    # the router as recovery/replica_died records (NOT engine fault records),
+    # so the capsule gate here is count + fired-alert coverage — no fault-site
+    # expectation, by construction of the crash path.
+    capsules_clean = _capsule_summary(os.path.join(capsule_root, "clean"))
+    capsules_chaos = _capsule_summary(
+        os.path.join(capsule_root, "chaos"),
+        expected_alerts=obs_chaos.fired_rules(),
+    )
+    if capsule_dir is None:
+        shutil.rmtree(capsule_root, ignore_errors=True)
     return {
         "schema": "accelerate_tpu.bench.fleet/v1",
         "preset": preset,
@@ -1168,6 +1274,11 @@ def run_fleet_chaos_bench(
         "alerts_clean_silent": not obs_clean.alerts.fired,
         "alerts_chaos_fired": sorted(obs_chaos.fired_rules()),
         "alerts_chaos_expected": "replica-died" in obs_chaos.fired_rules(),
+        "capsules_clean": capsules_clean["count"],
+        "capsules_clean_zero": capsules_clean["count"] == 0,
+        "capsules": capsules_chaos,
+        "capsules_chaos_expected": (capsules_chaos["count"] >= 1
+                                    and capsules_chaos["alerts_covered"]),
         "fleet_clean": clean_arm,
         "fleet_chaos": chaos_arm,
         "single_chaos": single_arm,
@@ -1975,6 +2086,7 @@ def serve_bench_command(args) -> int:
             kills_per_replica=(2 if args.kills_per_replica is None
                                else args.kills_per_replica),
             generator=args.trace_gen or "poisson",
+            capsule_dir=args.capsule_dir,
         )
         with open(args.chaos, "w") as f:
             json.dump(artifact, f, indent=2)
@@ -1989,12 +2101,17 @@ def serve_bench_command(args) -> int:
             "availability_single": artifact["single_chaos"]["availability"],
             "circuit_rejections": artifact["fleet_chaos"]["circuit_rejections"],
             "replica_kills": artifact["fleet_chaos"]["replica_kills"],
+            "capsules_clean": artifact["capsules_clean"],
+            "capsules_chaos": artifact["capsules"]["count"],
+            "capsule_triggers": artifact["capsules"]["triggers"],
         }))
         return 1 if (artifact["fleet_chaos"]["silently_lost"]
                      or not artifact["streams_identical"]
                      or not artifact["fleet_availability_above_single"]
                      or not artifact["alerts_clean_silent"]
-                     or not artifact["alerts_chaos_expected"]) else 0
+                     or not artifact["alerts_chaos_expected"]
+                     or not artifact["capsules_clean_zero"]
+                     or not artifact["capsules_chaos_expected"]) else 0
 
     if args.chaos:
         if args.smoke:
@@ -2021,6 +2138,7 @@ def serve_bench_command(args) -> int:
             ),
             page_size=args.page_size,
             kv_pages=args.kv_pages,
+            capsule_dir=args.capsule_dir,
         )
         with open(args.chaos, "w") as f:
             json.dump(artifact, f, indent=2)
@@ -2034,11 +2152,16 @@ def serve_bench_command(args) -> int:
             "availability_chaos": artifact["chaos"]["availability"],
             "step_fault_rate": artifact["chaos"]["engine"]["step_fault_rate"],
             "fired_by_site": artifact["fault_plan"]["fired_by_site"],
+            "capsules_clean": artifact["capsules_clean"],
+            "capsules_chaos": artifact["capsules"]["count"],
+            "capsule_triggers": artifact["capsules"]["triggers"],
         }))
         return 1 if (artifact["chaos"]["silently_lost"]
                      or not artifact["streams_identical"]
                      or not artifact["alerts_clean_silent"]
-                     or not artifact["alerts_chaos_expected"]) else 0
+                     or not artifact["alerts_chaos_expected"]
+                     or not artifact["capsules_clean_zero"]
+                     or not artifact["capsules_chaos_expected"]) else 0
 
     if args.trace_curves:
         loads = tuple(float(x) for x in args.loads.split(",") if x.strip())

@@ -87,6 +87,11 @@ def trace_report_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("--timelines", type=int, default=0, metavar="N",
                         help="also print the N slowest requests' (or, with "
                              "--train, steps') timelines")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable mode: print ONE JSON document "
+                             "(the full report — per-trace/per-step rows "
+                             "included) and nothing else; with --uid, that "
+                             "request's reconstruction with its raw spans")
     if subparsers is not None:
         parser.set_defaults(func=trace_report_command)
     return parser
@@ -527,6 +532,7 @@ def _print_timeline(trace: dict, out) -> None:
 def trace_report_command(args) -> int:
     import sys
 
+    as_json = getattr(args, "json", False)
     if args.train:
         records = load_records(args.jsonl)
         report = train_report(records)
@@ -534,6 +540,11 @@ def trace_report_command(args) -> int:
             print(f"trace-report --train: no mpmd.stage_step/v1 records in "
                   f"{args.jsonl}", file=sys.stderr)
             return 1
+        if as_json:
+            # Pure machine mode: the FULL report (per-step rows included),
+            # one document, no human timelines interleaved before it.
+            print(json.dumps(report, indent=2, default=float))
+            return 0
         if args.timelines:
             slowest = sorted(report["steps"],
                              key=lambda r: -r["span_s"])[: args.timelines]
@@ -554,7 +565,13 @@ def trace_report_command(args) -> int:
         if not mine:
             print(f"trace-report: no spans for uid {args.uid}", file=sys.stderr)
             return 1
+        if as_json:
+            print(json.dumps(_reconstruct(mine), indent=2, default=float))
+            return 0
         _print_timeline(_reconstruct(mine), sys.stdout)
+        return 0
+    if as_json:
+        print(json.dumps(report, indent=2, default=float))
         return 0
     if args.timelines:
         slowest = sorted(

@@ -319,6 +319,19 @@ class ServingGateway:
             self.metrics = MetricsPlane(
                 telemetry, clock=clock, window_s=config.metrics_window_s
             )
+        # Flight-recorder wiring (config.capsule_state): when the telemetry
+        # carries a FlightRecorder, the gateway registers its own state
+        # snapshot as a capsule state provider — queue/counters, breaker,
+        # engine lane table, fault-plan firing log — and binds the recorder
+        # to the metrics plane so ring evictions are drop-accounted. Inert
+        # when no recorder is configured.
+        recorder = getattr(telemetry, "recorder", None)
+        if (config.capsule_state and recorder is not None
+                and getattr(recorder, "enabled", False)):
+            if self.metrics is not None:
+                recorder.bind_metrics(self.metrics)
+            recorder.bind_clock(self._clock)
+            recorder.add_state_provider("gateway", self._capsule_state)
         self._policy = make_policy(config)
         self._uid = 0
         self._queued_cost = 0
@@ -921,6 +934,16 @@ class ServingGateway:
         self._probe_verdict(greq, status, now)
         tr = self.tracer
         if tr is not None and greq._trace is not None:
+            if status in (FAILED, EXPIRED, SHED) or (
+                status == DONE and greq.deadline_met is False
+            ):
+                # Tail promotion: a request that ended badly (quarantined by
+                # the fault boundary, deadline-expired, shed, or done-but-
+                # deadline-breached) gets its buffered spans replayed BEFORE
+                # the closing queue span / terminal event below — the handle
+                # flips sampled, so the promoted stream is chronological and
+                # reconstructs TTFT to the digit from spans alone.
+                tr.promote(greq._trace)
             if greq.t_admit is None:
                 # Still queued at its end: close this attempt's queue span
                 # (t_enqueued — the retry requeue time after a preemption) so
@@ -993,6 +1016,22 @@ class ServingGateway:
         if tel is not None and tel.enabled:
             tel.emit(record)
         return record
+
+    def _capsule_state(self) -> dict:
+        """The incident-capsule state snapshot (flight-recorder state
+        provider): everything ``stats()`` exposes plus the raw engine lane
+        table and the fault-plan firing log — the post-hoc questions a capsule
+        must answer without the process alive ('which uid held lane 3 when the
+        breaker opened?', 'which injected faults had fired by then?')."""
+        state = self.stats()
+        state["lanes"] = [
+            None if r is None else getattr(r, "uid", None)
+            for r in getattr(self.engine, "slot_req", [])
+        ]
+        faults = getattr(self.engine, "faults", None)
+        if faults is not None:
+            state["faults"] = {**faults.stats(), "fired": list(faults.fired)}
+        return state
 
     def stats(self) -> dict:
         """Gateway + nested engine observability snapshot."""

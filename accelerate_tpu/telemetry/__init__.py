@@ -32,9 +32,11 @@ from .memory import device_memory_stats
 from .metrics import METRIC_REGISTRY, MetricsPlane, registered_metrics
 from .profiler import ScheduledProfiler
 from .provenance import config_fingerprint, git_commit, provenance_stamp
+from .recorder import FlightRecorder, list_capsules, load_capsule
 from .schemas import (
     ALERT_SCHEMA,
     AUDIT_PROGRAM_SCHEMA,
+    CAPSULE_SCHEMA,
     FAULT_SCHEMA,
     FLEET_ROUTE_SCHEMA,
     METRICS_SNAPSHOT_SCHEMA,
@@ -89,7 +91,11 @@ __all__ = [
     "config_fingerprint",
     "git_commit",
     "provenance_stamp",
+    "FlightRecorder",
+    "list_capsules",
+    "load_capsule",
     "AUDIT_PROGRAM_SCHEMA",
+    "CAPSULE_SCHEMA",
     "FAULT_SCHEMA",
     "FLEET_ROUTE_SCHEMA",
     "MPMD_BARRIER_SCHEMA",

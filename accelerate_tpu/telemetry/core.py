@@ -109,6 +109,9 @@ class Telemetry:
         self.tokens_per_step: Optional[float] = config.tokens_per_step
         self.examples_per_step: Optional[float] = config.examples_per_step
         self._jsonl_path = None
+        #: The flight recorder (telemetry/recorder.py) when config.recorder is
+        #: set — always None while disabled, so the attribute read stays free.
+        self.recorder = None
         if self.enabled:
             if config.compile_events:
                 self.compile_monitor.start()
@@ -116,6 +119,19 @@ class Telemetry:
                 os.makedirs(config.jsonl_dir, exist_ok=True)
                 self._jsonl_path = os.path.join(config.jsonl_dir, "telemetry.jsonl")
                 self._jsonl_file = open(self._jsonl_path, "a")
+            if getattr(config, "recorder", False):
+                from .recorder import FlightRecorder
+
+                capsule_dir = getattr(config, "capsule_dir", None)
+                if capsule_dir:
+                    os.makedirs(capsule_dir, exist_ok=True)
+                self.recorder = FlightRecorder(
+                    self,
+                    ring_size=getattr(config, "recorder_ring", 2048),
+                    snapshot_every=getattr(config, "recorder_snapshot_every", 256),
+                    capsule_dir=capsule_dir,
+                    capsule_cooldown_s=getattr(config, "capsule_cooldown_s", 30.0),
+                )
 
     # ------------------------------------------------------------------ hints
     def set_throughput_hints(

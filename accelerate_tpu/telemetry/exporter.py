@@ -30,7 +30,7 @@ import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from .metrics import METRIC_REGISTRY, MetricsPlane
+from .metrics import M_EXPORTER_SCRAPES_TOTAL, METRIC_REGISTRY, MetricsPlane
 
 __all__ = ["prometheus_text", "MetricsExporter"]
 
@@ -111,15 +111,20 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (http.server API)
         plane = self.server.plane  # type: ignore[attr-defined]
         if self.path.split("?", 1)[0] == "/metrics":
+            # Count the scrape BEFORE rendering so the exporter observes its
+            # own traffic — a scrape that reads 0 of its own counter would
+            # hide a misconfigured double-scraper forever.
+            plane.inc(M_EXPORTER_SCRAPES_TOTAL, endpoint="metrics")
             body = prometheus_text(plane).encode("utf-8")
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif self.path.split("?", 1)[0] == "/healthz":
+            plane.inc(M_EXPORTER_SCRAPES_TOTAL, endpoint="healthz")
             body = json.dumps({
                 "ok": True,
                 "enabled": plane.enabled,
                 "records_consumed": plane.records_consumed,
             }).encode("utf-8")
-            ctype = "application/json"
+            ctype = "application/json; charset=utf-8"
         else:
             self.send_error(404, "try /metrics or /healthz")
             return

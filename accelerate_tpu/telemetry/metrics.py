@@ -74,6 +74,9 @@ __all__ = [
     "metric_table_markdown",
     # counters
     "M_REQUESTS_TOTAL",
+    "M_TENANT_REQUESTS_TOTAL",
+    "M_TENANT_SLO_GOOD_TOTAL",
+    "M_TENANT_SLO_BAD_TOTAL",
     "M_TOKENS_TOTAL",
     "M_FAULTS_TOTAL",
     "M_RECOVERY_ACTIONS_TOTAL",
@@ -82,6 +85,8 @@ __all__ = [
     "M_DCN_BYTES_TOTAL",
     "M_HANDOFF_BYTES_TOTAL",
     "M_ALERTS_TOTAL",
+    "M_RECORDER_DROPPED_TOTAL",
+    "M_EXPORTER_SCRAPES_TOTAL",
     # gauges
     "M_QUEUE_DEPTH",
     "M_SLOT_OCCUPANCY",
@@ -112,6 +117,9 @@ __all__ = [
 # ONLY place the names are spelled (graftlint ``metric-name-literal``).
 
 M_REQUESTS_TOTAL = "accelerate_tpu_gateway_requests_total"
+M_TENANT_REQUESTS_TOTAL = "accelerate_tpu_gateway_tenant_requests_total"
+M_TENANT_SLO_GOOD_TOTAL = "accelerate_tpu_gateway_tenant_slo_good_total"
+M_TENANT_SLO_BAD_TOTAL = "accelerate_tpu_gateway_tenant_slo_bad_total"
 M_TOKENS_TOTAL = "accelerate_tpu_serving_tokens_total"
 M_FAULTS_TOTAL = "accelerate_tpu_faults_total"
 M_RECOVERY_ACTIONS_TOTAL = "accelerate_tpu_recovery_actions_total"
@@ -120,6 +128,8 @@ M_ROUTE_DECISIONS_TOTAL = "accelerate_tpu_fleet_route_decisions_total"
 M_DCN_BYTES_TOTAL = "accelerate_tpu_mpmd_dcn_bytes_total"
 M_HANDOFF_BYTES_TOTAL = "accelerate_tpu_kv_handoff_bytes_total"
 M_ALERTS_TOTAL = "accelerate_tpu_alerts_total"
+M_RECORDER_DROPPED_TOTAL = "accelerate_tpu_recorder_dropped_total"
+M_EXPORTER_SCRAPES_TOTAL = "accelerate_tpu_exporter_scrapes_total"
 
 M_QUEUE_DEPTH = "accelerate_tpu_serving_queue_depth"
 M_SLOT_OCCUPANCY = "accelerate_tpu_serving_slot_occupancy"
@@ -169,6 +179,15 @@ METRIC_REGISTRY: Dict[str, MetricSpec] = {
     for s in (
         _m(M_REQUESTS_TOTAL, "counter", ("status",), GATEWAY_REQUEST_SCHEMA,
            "terminal gateway requests by status"),
+        _m(M_TENANT_REQUESTS_TOTAL, "counter", ("tenant", "status"),
+           GATEWAY_REQUEST_SCHEMA,
+           "terminal gateway requests by tenant and status"),
+        _m(M_TENANT_SLO_GOOD_TOTAL, "counter", ("tenant",),
+           GATEWAY_REQUEST_SCHEMA,
+           "per-tenant terminal requests that met the SLO"),
+        _m(M_TENANT_SLO_BAD_TOTAL, "counter", ("tenant",),
+           GATEWAY_REQUEST_SCHEMA,
+           "per-tenant terminal requests that violated the SLO"),
         _m(M_TOKENS_TOTAL, "counter", (), GATEWAY_REQUEST_SCHEMA,
            "tokens delivered by terminal requests"),
         _m(M_FAULTS_TOTAL, "counter", ("site",), FAULT_SCHEMA,
@@ -185,6 +204,10 @@ METRIC_REGISTRY: Dict[str, MetricSpec] = {
            "cross-engine KV page handoff wire bytes"),
         _m(M_ALERTS_TOTAL, "counter", ("rule", "state"), ALERT_SCHEMA,
            "alert-state transitions seen on the record stream"),
+        _m(M_RECORDER_DROPPED_TOTAL, "counter", (), "derived",
+           "flight-ring records evicted before any capsule captured them"),
+        _m(M_EXPORTER_SCRAPES_TOTAL, "counter", ("endpoint",), "derived",
+           "HTTP scrapes served by the Prometheus exporter"),
         _m(M_QUEUE_DEPTH, "gauge", (), SERVING_SCHEMA,
            "engine-internal queued requests (last decode step)"),
         _m(M_SLOT_OCCUPANCY, "gauge", (), SERVING_SCHEMA,
@@ -415,7 +438,9 @@ class MetricsPlane:
     def _on_request(self, r: Mapping) -> None:
         now = self._clock()
         status = r.get("status")
+        tenant = r.get("tenant") or "default"
         self.inc(M_REQUESTS_TOTAL, t=now, status=status)
+        self.inc(M_TENANT_REQUESTS_TOTAL, t=now, tenant=tenant, status=status)
         tokens = r.get("n_tokens") or 0
         if tokens:
             self.inc(M_TOKENS_TOTAL, float(tokens), t=now)
@@ -428,9 +453,13 @@ class MetricsPlane:
                 self.observe(metric, value, t=now)
         if status == "done":
             # deadline_met None = no deadline declared: delivered = good.
-            self._slo_events.append((now, r.get("deadline_met") is not False))
+            good = r.get("deadline_met") is not False
+            self._slo_events.append((now, good))
+            self.inc(M_TENANT_SLO_GOOD_TOTAL if good else M_TENANT_SLO_BAD_TOTAL,
+                     t=now, tenant=tenant)
         elif status in self._SLO_BAD:
             self._slo_events.append((now, False))
+            self.inc(M_TENANT_SLO_BAD_TOTAL, t=now, tenant=tenant)
 
     def _on_replica_health(self, r: Mapping) -> None:
         rid = r.get("replica")

@@ -48,6 +48,7 @@ __all__ = [
     "RECOVERY_SCHEMA",
     "ALERT_SCHEMA",
     "METRICS_SNAPSHOT_SCHEMA",
+    "CAPSULE_SCHEMA",
     "RecordSchema",
     "SCHEMA_REGISTRY",
     "registered_schemas",
@@ -158,6 +159,15 @@ ALERT_SCHEMA = "accelerate_tpu.telemetry.alert/v1"
 #: and sliding-window histogram summary plus the SLO event-window block —
 #: what bench rows stamp and ``metrics-dump`` prints.
 METRICS_SNAPSHOT_SCHEMA = "accelerate_tpu.telemetry.metrics.snapshot/v1"
+
+#: The manifest of one incident capsule (``telemetry.recorder.FlightRecorder``):
+#: what triggered the dump (``trigger`` is a stable dedupe key like
+#: ``alert:step-failure-burst`` or ``fault:serving.decode``), the triggering
+#: record itself, when (recorder clock), how much of the flight ring was
+#: captured vs dropped, which state snapshots rode along and the provenance
+#: stamp — everything ``capsule-report`` needs to rebuild the incident from the
+#: capsule directory alone.
+CAPSULE_SCHEMA = "accelerate_tpu.telemetry.capsule/v1"
 
 
 # --------------------------------------------------------------------- registry
@@ -318,6 +328,13 @@ SCHEMA_REGISTRY: Dict[str, RecordSchema] = {
             ("t", "counters", "gauges", "histograms", "slo"),
             "telemetry.metrics.MetricsPlane.snapshot_record",
             "one point-in-time dump of every live counter/gauge/histogram",
+        ),
+        _reg(
+            CAPSULE_SCHEMA,
+            ("trigger", "t", "ring_records", "ring_dropped", "state_keys",
+             "provenance"),
+            "telemetry.recorder.FlightRecorder",
+            "one incident capsule manifest (trigger, ring/state accounting)",
         ),
     )
 }

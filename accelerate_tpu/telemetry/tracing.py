@@ -43,11 +43,26 @@ two attribute reads per engine step** — no clock calls, no dict lookups, no
 records (asserted by ``tests/test_tracing.py``). A ``Tracer`` is enabled iff its
 ``Telemetry`` is (or an explicit ``sink`` is given); spans flow through the same
 ``Telemetry.emit`` pipeline (JSONL + trackers) as every other record.
+
+**Sampling** (the flight-recorder tier, docs/telemetry.md): full per-request
+tracing is unaffordable at fleet scale, so :meth:`start` can make a
+deterministic HEAD decision per trace — every-Kth (``sample_every``) or seeded
+probability (``sample_prob``), both clock-free and reproducible under a fixed
+seed. An unsampled trace still produces every span record, but they are routed
+to the :class:`~.recorder.FlightRecorder` ring only (``recorder.buffer``) —
+no JSONL, no sinks, no per-trace side table. TAIL promotion
+(:meth:`promote`, called by the gateway when a request ends badly: failed /
+expired / shed / quarantined / deadline-breached) replays the buffered spans
+verbatim through ``Telemetry.emit``, so slow-and-broken requests are always
+fully traced while the happy path pays ring entries alone — and a promoted
+trace reconstructs TTFT to the digit, because the span records ARE the ones
+full tracing would have written.
 """
 
 from __future__ import annotations
 
 import itertools
+import random
 import time
 from typing import Callable, Dict, Optional
 
@@ -70,15 +85,18 @@ class TraceHandle:
     across the request's whole lifecycle, including preemption retries (a retry
     is a new attempt inside the SAME trace)."""
 
-    __slots__ = ("trace_id", "uid", "tenant", "t_start", "kv_defers", "attempt")
+    __slots__ = ("trace_id", "uid", "tenant", "t_start", "kv_defers", "attempt",
+                 "sampled")
 
-    def __init__(self, uid: int, tenant: str, t_start: float):
+    def __init__(self, uid: int, tenant: str, t_start: float,
+                 sampled: bool = True):
         self.trace_id = f"{uid}:{t_start:.9f}:{next(_TRACE_SEQ):x}"
         self.uid = uid
         self.tenant = tenant
         self.t_start = t_start
         self.kv_defers = 0   # paged-pool admission defers observed for this request
         self.attempt = 0     # preemption retries re-admit under attempt n+1
+        self.sampled = sampled  # head decision; tail promotion flips it True
 
 
 class Tracer:
@@ -92,7 +110,12 @@ class Tracer:
     gateway's deadline clock, so timelines and deadlines agree)."""
 
     def __init__(self, telemetry=None, clock: Callable[[], float] = time.monotonic,
-                 sink: Optional[Callable[[dict], None]] = None):
+                 sink: Optional[Callable[[dict], None]] = None,
+                 sample_every: Optional[int] = None,
+                 sample_prob: Optional[float] = None,
+                 sample_seed: Optional[int] = None,
+                 recorder=None):
+        cfg = getattr(telemetry, "config", None)
         self.telemetry = telemetry
         self._sink = sink
         #: The ONE flag the hot path reads; spans are dropped wholesale when off.
@@ -100,11 +123,42 @@ class Tracer:
             telemetry is not None and getattr(telemetry, "enabled", False)
         )
         self._clock = clock
+        # Head sampling: every-Kth (deterministic counter) or seeded
+        # probability — both resolvable from TelemetryConfig so production
+        # wiring needs no extra plumbing. Explicit kwargs win over config.
+        self.sample_every = int(
+            getattr(cfg, "trace_sample_every", 1) if sample_every is None
+            else sample_every
+        )
+        self.sample_prob = (
+            getattr(cfg, "trace_sample_prob", None) if sample_prob is None
+            else sample_prob
+        )
+        seed = (getattr(cfg, "trace_sample_seed", 0) if sample_seed is None
+                else sample_seed)
+        self._rng = (random.Random(seed) if self.sample_prob is not None
+                     else None)
+        #: Where unsampled spans buffer (tail-promotion source); defaults to
+        #: the telemetry-owned FlightRecorder when one is configured.
+        self.recorder = (getattr(telemetry, "recorder", None)
+                         if recorder is None else recorder)
         self.spans_emitted = 0
+        self.spans_buffered = 0
+        self.traces_started = 0
+        self.traces_sampled = 0
+        self.traces_promoted = 0
         self._traces: Dict[int, TraceHandle] = {}      # gateway uid → handle
         self._by_engine: Dict[int, TraceHandle] = {}   # engine uid → handle
 
     # ------------------------------------------------------------------ lifecycle
+    def _sample(self) -> bool:
+        """The clock-free head-sampling decision for the next trace."""
+        if self.sample_every > 1:
+            return self.traces_started % self.sample_every == 0
+        if self._rng is not None:
+            return self._rng.random() < self.sample_prob
+        return True
+
     def start(self, uid: int, tenant: str = "default",
               t: Optional[float] = None) -> Optional[TraceHandle]:
         """Open a trace for request ``uid``; returns None while disabled (callers
@@ -112,7 +166,12 @@ class Tracer:
         every later emit a no-op)."""
         if not self.enabled:
             return None
-        handle = TraceHandle(uid, tenant, self._clock() if t is None else t)
+        sampled = self._sample()
+        self.traces_started += 1
+        if sampled:
+            self.traces_sampled += 1
+        handle = TraceHandle(uid, tenant, self._clock() if t is None else t,
+                             sampled=sampled)
         self._traces[uid] = handle
         return handle
 
@@ -159,6 +218,14 @@ class Tracer:
             record["step"] = step
         if attrs:
             record.update(attrs)
+        if not handle.sampled:
+            # Unsampled trace: the span exists ONLY as a flight-ring entry
+            # (no JSONL, no sinks) until tail promotion replays it. With no
+            # recorder armed the span is dropped — head sampling alone.
+            self.spans_buffered += 1
+            if self.recorder is not None:
+                self.recorder.buffer(record)
+            return
         self.spans_emitted += 1
         if self.telemetry is not None:
             self.telemetry.emit(record)
@@ -178,6 +245,21 @@ class Tracer:
             t = self._clock()
         self.span(handle, kind, t, t, step=step, **attrs)
 
+    def promote(self, handle: Optional[TraceHandle]) -> int:
+        """Tail-promote an unsampled trace: flip its head decision so every
+        LATER span emits in full, and replay the spans already buffered in the
+        flight ring through ``Telemetry.emit`` (the gateway calls this before
+        emitting the terminal event of a request that ended badly, so the
+        promoted stream is chronological). No-op on sampled/None handles.
+        Returns the number of ring spans replayed."""
+        if handle is None or not self.enabled or handle.sampled:
+            return 0
+        handle.sampled = True
+        self.traces_promoted += 1
+        if self.recorder is None:
+            return 0
+        return self.recorder.promote(handle.trace_id)
+
     def count_defer(self, engine_uid: int) -> None:
         """One paged-pool admission defer observed for this engine request; the
         count lands on the eventual ``admit`` span as ``kv_defer_retries``."""
@@ -188,5 +270,7 @@ class Tracer:
     def __repr__(self) -> str:
         return (
             f"Tracer(enabled={self.enabled}, live={len(self._traces)}, "
-            f"spans_emitted={self.spans_emitted})"
+            f"spans_emitted={self.spans_emitted}, "
+            f"spans_buffered={self.spans_buffered}, "
+            f"promoted={self.traces_promoted})"
         )

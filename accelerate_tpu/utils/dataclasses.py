@@ -349,12 +349,30 @@ class TelemetryConfig(KwargsHandler):
     flops_per_step: Optional[float] = None
     tokens_per_step: Optional[float] = None
     examples_per_step: Optional[float] = None
+    # Flight-recorder tier (telemetry/recorder.py): an always-on bounded
+    # in-memory ring of recent records + periodic metrics snapshots, the
+    # buffer tail-sampled tracing promotes from, and — when ``capsule_dir``
+    # is set (env ACCELERATE_CAPSULE_DIR) — automatic incident capsules with
+    # per-trigger cooldown/dedupe. Free when the pipeline is disabled.
+    recorder: bool = False
+    recorder_ring: int = 2048               # flight-ring capacity (records)
+    recorder_snapshot_every: int = 256      # metrics snapshot period (records; 0 = never)
+    capsule_dir: Optional[str] = None       # None → env ACCELERATE_CAPSULE_DIR
+    capsule_cooldown_s: float = 30.0        # per-trigger capsule dedupe window
+    # Trace head sampling (telemetry/tracing.py): every-Kth (1 = trace all,
+    # the historical behavior) or seeded probability; unsampled requests
+    # buffer spans in the flight ring and tail-promote when they end badly.
+    trace_sample_every: int = 1
+    trace_sample_prob: Optional[float] = None
+    trace_sample_seed: int = 0
 
     def __post_init__(self):
         if self.enabled is None:
             self.enabled = parse_flag_from_env("ACCELERATE_TELEMETRY")
         if self.jsonl_dir is None:
             self.jsonl_dir = os.environ.get("ACCELERATE_TELEMETRY_DIR") or None
+        if self.capsule_dir is None:
+            self.capsule_dir = os.environ.get("ACCELERATE_CAPSULE_DIR") or None
         if self.steady_k < 2:
             raise ValueError(f"steady_k={self.steady_k}: agreement needs >= 2 windows")
         if self.steady_rtol <= 0:
@@ -364,6 +382,27 @@ class TelemetryConfig(KwargsHandler):
         if self.rotate_bytes < 0:
             raise ValueError(
                 f"rotate_bytes={self.rotate_bytes} must be >= 0 (0 = never rotate)"
+            )
+        if self.recorder_ring < 1:
+            raise ValueError(f"recorder_ring={self.recorder_ring} must be >= 1")
+        if self.recorder_snapshot_every < 0:
+            raise ValueError(
+                f"recorder_snapshot_every={self.recorder_snapshot_every} "
+                "must be >= 0 (0 = never snapshot)"
+            )
+        if self.capsule_cooldown_s < 0:
+            raise ValueError(
+                f"capsule_cooldown_s={self.capsule_cooldown_s} must be >= 0"
+            )
+        if self.trace_sample_every < 1:
+            raise ValueError(
+                f"trace_sample_every={self.trace_sample_every} must be >= 1 "
+                "(1 = trace every request)"
+            )
+        if self.trace_sample_prob is not None and not (
+                0.0 <= self.trace_sample_prob <= 1.0):
+            raise ValueError(
+                f"trace_sample_prob={self.trace_sample_prob} must be in [0, 1]"
             )
 
 
@@ -606,6 +645,13 @@ class GatewayConfig(KwargsHandler):
     # Sliding-window horizon (seconds, on the gateway clock) for the plane's
     # histograms / SLO event window / counter-increase reads.
     metrics_window_s: float = 300.0
+    # Incident-capsule state hook (``telemetry.recorder.FlightRecorder``):
+    # when True AND the attached telemetry carries a flight recorder, the
+    # gateway registers its ``stats()`` snapshot (queue/counters, engine lane
+    # table + BlockManager occupancy, breaker state, fault-plan fire history)
+    # as a capsule state provider and binds the recorder to its metrics plane.
+    # Inert without a recorder.
+    capsule_state: bool = True
     # Streaming-granularity knob (docs/multistep_decode.md): the multi-step
     # decode depth the gateway EXPECTS of its engine. The engine owns the knob
     # (``ContinuousBatcher(decode_steps=N)`` — it shapes compiled programs);

@@ -306,9 +306,10 @@ def test_cli_help_lists_subcommands(capsys):
         parser.parse_args(["--help"])
     out = capsys.readouterr().out
     for sub in (
-        "audit", "chaos-train", "config", "env", "estimate-memory", "launch",
-        "lint", "memaudit", "merge-weights", "metrics-dump", "serve-bench",
-        "test", "tpu-config", "trace-report", "warmup",
+        "audit", "capsule-report", "chaos-train", "config", "env",
+        "estimate-memory", "launch", "lint", "memaudit", "merge-weights",
+        "metrics-dump", "serve-bench", "test", "tpu-config", "trace-report",
+        "warmup",
     ):
         assert sub in out
 

@@ -1,0 +1,462 @@
+"""Flight recorder, trace sampling, incident capsules and capsule-report.
+
+The acceptance pins of the flight-recorder tier: disabled recorder AND
+disabled sampler cost zero clock calls (the Telemetry contract); head sampling
+is deterministic and clock-free; an unsampled happy-path request leaves
+NOTHING on the JSONL stream (ring entries only); tail promotion replays the
+buffered spans verbatim, so a promoted trace reconstructs TTFT to the digit;
+ring evictions are drop-accounted through the registered metric; capsules are
+written atomically, deduped per trigger under the cooldown, and reconstruct
+the incident (trigger, timeline, state) from the capsule directory alone —
+including when JSONL rotation rolls mid-incident.
+"""
+
+import dataclasses
+import gzip
+import json
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from accelerate_tpu.models import llama
+from accelerate_tpu.resilience.faults import FaultPlan, FaultSpec
+from accelerate_tpu.serving import ContinuousBatcher
+from accelerate_tpu.serving_gateway import ServingGateway
+from accelerate_tpu.serving_gateway.workload import (
+    VirtualClock,
+    generate_workload,
+    replay_trace,
+)
+from accelerate_tpu.telemetry import FlightRecorder, Telemetry, Tracer
+from accelerate_tpu.telemetry.metrics import (
+    M_RECORDER_DROPPED_TOTAL,
+    MetricsPlane,
+)
+from accelerate_tpu.telemetry.recorder import list_capsules, load_capsule
+from accelerate_tpu.telemetry.schemas import (
+    ALERT_SCHEMA,
+    CAPSULE_SCHEMA,
+    RECOVERY_SCHEMA,
+    TRACE_SPAN_SCHEMA,
+    validate_record,
+)
+from accelerate_tpu.utils.dataclasses import GatewayConfig, TelemetryConfig
+
+CFG = dataclasses.replace(llama.CONFIGS["tiny"], dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = llama.init_params(CFG)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, CFG.vocab_size, int(n)).astype(np.int32)
+               for n in (5, 9, 3, 7)]
+    return params, prompts
+
+
+def _tel(**kw):
+    return Telemetry(TelemetryConfig(enabled=True, compile_events=False,
+                                     memory_stats=False, **kw))
+
+
+def _alert(rule, state="firing", t=0.0):
+    return {"schema": ALERT_SCHEMA, "rule": rule, "state": state,
+            "severity": "page", "kind": "burn_rate", "t": t, "value": 1.0}
+
+
+# --------------------------------------------------------------- zero overhead
+def test_disabled_recorder_zero_clock_calls():
+    """Disabled = two attribute reads: over a disabled Telemetry the recorder
+    never registers its sink, holds nothing, and never reads the clock."""
+    tel_off = Telemetry(TelemetryConfig())          # disabled (the default)
+    assert tel_off.recorder is None                  # core never builds one
+    calls = []
+
+    def counting_clock():
+        calls.append(1)
+        return 0.0
+
+    rec = FlightRecorder(telemetry=tel_off, clock=counting_clock,
+                         capsule_dir="/nonexistent")
+    assert rec.enabled is False
+    assert rec._consume not in tel_off.sinks
+    rec.buffer({"schema": TRACE_SPAN_SCHEMA, "trace_id": "x"})
+    rec.add_state_provider("g", dict)
+    assert rec.capture("fault:x") is None
+    assert rec.promote("x") == 0
+    assert calls == [] and len(rec.ring) == 0 and rec.records_seen == 0
+
+
+def test_disabled_sampler_zero_clock_calls():
+    """A sampling-configured Tracer over disabled telemetry is still the
+    two-attribute-read no-op: start() returns None, zero clock reads."""
+    tel_off = Telemetry(TelemetryConfig(trace_sample_every=4,
+                                        trace_sample_seed=7))
+    calls = []
+
+    def counting_clock():
+        calls.append(1)
+        return 0.0
+
+    tracer = Tracer(tel_off, clock=counting_clock)
+    assert tracer.enabled is False
+    assert tracer.start(0) is None
+    tracer.span(None, "queue", 0.0, 1.0)
+    tracer.promote(None)
+    assert calls == [] and tracer.traces_started == 0
+
+
+def test_head_sampling_every_kth_and_seeded_prob():
+    """Head decisions are clock-free and deterministic: every-Kth follows the
+    trace counter exactly; seeded probability reproduces across tracers."""
+    calls = []
+
+    def counting_clock():
+        calls.append(1)
+        return 0.0
+
+    tracer = Tracer(sink=lambda r: None, clock=counting_clock, sample_every=3)
+    decisions = [tracer.start(i, t=float(i)).sampled for i in range(9)]
+    assert decisions == [True, False, False] * 3
+    assert tracer.traces_started == 9 and tracer.traces_sampled == 3
+    assert calls == []                       # t passed in: sampling reads no clock
+
+    a = Tracer(sink=lambda r: None, sample_every=1, sample_prob=0.5,
+               sample_seed=42)
+    b = Tracer(sink=lambda r: None, sample_every=1, sample_prob=0.5,
+               sample_seed=42)
+    da = [a.start(i, t=0.0).sampled for i in range(64)]
+    db = [b.start(i, t=0.0).sampled for i in range(64)]
+    assert da == db and True in da and False in da
+
+
+def test_sampling_config_resolves_from_telemetry(tmp_path):
+    """TelemetryConfig.trace_sample_* arms the tracer and Telemetry.recorder
+    becomes the buffer — production wiring needs no extra plumbing."""
+    tel = _tel(recorder=True, capsule_dir=str(tmp_path / "caps"),
+               trace_sample_every=5, trace_sample_seed=3)
+    tracer = Tracer(tel)
+    assert tel.recorder is not None and tel.recorder.enabled
+    assert tracer.sample_every == 5
+    assert tracer.recorder is tel.recorder
+
+
+# ----------------------------------------------------------- ring + drop metric
+def test_ring_drop_accounting():
+    """Evictions from a full ring are counted on the recorder AND the
+    registered drop metric when a plane is bound."""
+    tel = _tel()
+    plane = MetricsPlane(enabled=True, clock=lambda: 0.0)
+    rec = FlightRecorder(telemetry=tel, ring_size=4, snapshot_every=0,
+                         metrics=plane)
+    for i in range(10):
+        tel.emit({"schema": RECOVERY_SCHEMA, "action": "rebuild",
+                  "reason": f"r{i}", "t": float(i)})
+    assert len(rec.ring) == 4 and rec.records_seen == 10
+    assert rec.dropped == 6
+    assert plane.stats()["counters"][M_RECORDER_DROPPED_TOTAL] == 6
+    assert rec.stats()["dropped"] == 6
+
+
+# ------------------------------------------------------------- tail promotion
+def test_tail_promotion_ttft_parity_and_silent_happy_path(tmp_path, setup):
+    """Acceptance: with head sampling effectively off (every-10^9th), happy-
+    path requests leave ZERO span records on the JSONL stream — ring entries
+    only — while every request that ends badly (failed/expired/shed/deadline-
+    breached) is tail-promoted into a full trace whose reconstructed TTFT
+    matches the gateway's to the digit (the spans ARE the records full tracing
+    would have written)."""
+    from accelerate_tpu.commands.trace_report import _reconstruct, load_records
+
+    params, _ = setup
+    jdir = str(tmp_path / "run")
+    tel = _tel(jsonl_dir=jdir, recorder=True,
+               capsule_dir=str(tmp_path / "caps"),
+               trace_sample_prob=0.0)
+    clock = VirtualClock()
+    tracer = Tracer(tel, clock=clock)
+    eng = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                            prompt_bucket=16, tracer=tracer)
+    gw = ServingGateway(
+        eng, GatewayConfig(enabled=True, policy="edf", max_queue=8,
+                           overload="shed"),
+        telemetry=tel, clock=clock, tracer=tracer,
+    )
+    trace = generate_workload("tenant_flood", 24, seed=3, mean_iat_s=3.0)
+    greqs = replay_trace(gw, trace, CFG.vocab_size, clock, seed=3, load=4.0)
+
+    # One deadline breach caught mid-decode: the expired request streamed a
+    # first token before the deadline passed, so its promoted trace carries a
+    # first_token event — the TTFT-parity anchor below.
+    rng = np.random.default_rng(9)
+    breached = gw.submit(rng.integers(1, CFG.vocab_size, 6).astype(np.int32),
+                         max_new_tokens=20, deadline_s=5.0)
+    late_happy = gw.submit(rng.integers(1, CFG.vocab_size, 6).astype(np.int32),
+                           max_new_tokens=3)
+    gw.step()
+    assert breached.status == "running"
+    clock.advance(6.0)
+    gw.run()
+    assert breached.status == "expired" and breached.ttft_s is not None
+    assert late_happy.status == "done"
+    greqs = list(greqs) + [breached, late_happy]
+    tel.close()
+
+    bad = [r for r in greqs
+           if r.status in ("failed", "expired", "shed")
+           or (r.status == "done" and r.deadline_met is False)]
+    happy = [r for r in greqs
+             if r.status == "done" and r.deadline_met is not False]
+    assert bad and happy, "workload must produce both endings"
+
+    spans = [r for r in load_records([jdir])
+             if r.get("schema") == TRACE_SPAN_SCHEMA]
+    assert spans and tracer.spans_buffered > 0
+    # Happy-path silence: not one span of a clean request reached JSONL.
+    assert {s["uid"] for s in spans} <= {r.uid for r in bad}
+    assert not ({s["uid"] for s in spans} & {r.uid for r in happy})
+    assert tracer.traces_promoted == len({s["trace_id"] for s in spans})
+
+    by_uid = {}
+    for s in spans:
+        by_uid.setdefault(s["uid"], []).append(s)
+    checked = 0
+    for r in bad:
+        mine = by_uid.get(r.uid)
+        if not mine or r.ttft_s is None:
+            continue
+        rebuilt = _reconstruct(mine)
+        assert round(rebuilt["ttft_s"], 6) == round(r.ttft_s, 6), r.uid
+        assert rebuilt["status"] == r.status
+        checked += 1
+    assert checked >= 1, "need at least one promoted trace with a first token"
+
+
+# ------------------------------------------------------------------- capsules
+def test_capsule_write_cooldown_and_atomicity(tmp_path):
+    """One capsule per trigger key under the cooldown (the first capture per
+    key is NEVER suppressed), atomically committed (no .tmp ever visible),
+    round-tripping through load_capsule with a valid capsule/v1 manifest."""
+    caps = str(tmp_path / "caps")
+    tel = _tel()
+    t = [0.0]
+    rec = FlightRecorder(telemetry=tel, ring_size=32, snapshot_every=0,
+                         clock=lambda: t[0], capsule_dir=caps,
+                         capsule_cooldown_s=30.0)
+    rec.add_state_provider("table", lambda: {"lanes": [1, None]})
+    rec.add_state_provider("broken", lambda: 1 / 0)  # must not lose the dump
+
+    tel.emit(_alert("slo-burn-rate", t=0.0))          # capsule 1
+    t[0] = 1.0
+    tel.emit(_alert("slo-burn-rate", t=1.0))          # cooldown: suppressed
+    tel.emit(_alert("step-failure-burst", t=1.0))     # new key: capsule 2
+    t[0] = 100.0
+    tel.emit(_alert("slo-burn-rate", t=100.0))        # cooldown over: capsule 3
+    tel.emit(_alert("slo-burn-rate", state="resolved", t=100.0))  # not a trigger
+
+    assert rec.capsules_written == 3 and rec.capsules_suppressed == 1
+    paths = list_capsules(caps)
+    assert len(paths) == 3
+    assert not [p for p in os.listdir(caps) if p.endswith(".tmp")]
+    # A single capsule dir passes through list_capsules as itself.
+    assert list_capsules(paths[0]) == [paths[0]]
+
+    capsule = load_capsule(paths[0])
+    manifest = capsule["manifest"]
+    assert validate_record(manifest) == []
+    assert manifest["schema"] == CAPSULE_SCHEMA
+    assert manifest["trigger"] == "alert:slo-burn-rate"
+    assert manifest["state_keys"] == ["broken", "table"]
+    assert capsule["state"]["table"] == {"lanes": [1, None]}
+    assert "ZeroDivisionError" in capsule["state"]["broken"]["error"]
+    # The capsule contains its own trigger record (ring appended first).
+    assert capsule["ring"][-1]["rule"] == "slo-burn-rate"
+    # Capture is noted on the record stream itself (and never re-ingested).
+    cuts = [r for r in tel.records if r.get("schema") == CAPSULE_SCHEMA]
+    assert len(cuts) == 3 and all(r not in rec.ring for r in cuts)
+
+
+def test_rotation_recorder_interplay(tmp_path):
+    """Satellite: JSONL rotation rolling mid-incident changes nothing for the
+    flight tier — buffered spans promote into the CURRENT segment, the capsule
+    still holds the full ring, and a whole-directory read sees every promoted
+    span exactly once."""
+    from accelerate_tpu.commands.trace_report import load_records
+
+    jdir = str(tmp_path / "run")
+    caps = str(tmp_path / "caps")
+    tel = _tel(jsonl_dir=jdir, rotate_bytes=2048, recorder=True,
+               capsule_dir=caps, trace_sample_prob=0.0)
+    tracer = Tracer(tel, clock=lambda: 0.0)
+    handle = tracer.start(7, t=0.0)
+    assert handle is not None and handle.sampled is False
+    tracer.span(handle, "queue", 0.0, 1.0)
+    tracer.span(handle, "prefill", 1.0, 2.0)
+    # Force several rotations with routine (non-trigger) records.
+    for i in range(60):
+        tel.emit({"schema": RECOVERY_SCHEMA, "action": "rebuild",
+                  "reason": f"filler-{i:04d}" + "x" * 40, "t": float(i)})
+    tel.emit(_alert("slo-burn-rate", t=60.0))         # capsule mid-rotation
+    assert tracer.promote(handle) == 2                # replay into current segment
+    tracer.span(handle, "terminal", 2.0, 3.0)         # post-promotion: emits live
+    tel.close()
+
+    segments = [f for f in os.listdir(jdir) if f.startswith("telemetry.")]
+    assert len(segments) >= 3, "rotation never fired — shrink rotate_bytes"
+    spans = [r for r in load_records([jdir])
+             if r.get("schema") == TRACE_SPAN_SCHEMA]
+    assert [s["span"] for s in spans] == ["queue", "prefill", "terminal"]
+    assert all(s["trace_id"] == handle.trace_id for s in spans)
+
+    capsule = load_capsule(list_capsules(caps)[0])
+    ring_spans = [r for r in capsule["ring"]
+                  if r.get("schema") == TRACE_SPAN_SCHEMA]
+    # Captured BEFORE promotion: the buffered spans ride the capsule un-promoted.
+    assert [s["span"] for s in ring_spans] == ["queue", "prefill"]
+    assert tel.recorder.stats()["promoted_traces"] == 1
+
+
+def test_gateway_capsule_state_provider(tmp_path, setup):
+    """An injected engine fault cuts a fault:<site> capsule whose state block
+    carries the gateway's own snapshot — queue counters, the engine lane
+    table, and the fault plan's firing log naming the site."""
+    params, prompts = setup
+    caps = str(tmp_path / "caps")
+    tel = _tel(recorder=True, capsule_dir=caps)
+    plan = FaultPlan([FaultSpec("serving.decode", "error", prob=1.0,
+                                max_fires=1)], seed=0)
+    eng = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                            prompt_bucket=16, telemetry=tel, faults=plan)
+    gw = ServingGateway(eng, GatewayConfig(enabled=True, metrics=True),
+                        telemetry=tel)
+    for p in prompts[:3]:
+        gw.submit(p, max_new_tokens=4)
+    gw.run()
+    assert len(plan.fired) == 1
+    assert tel.recorder.capsules_written >= 1
+    # The recorder was bound to the gateway's plane on construction.
+    assert tel.recorder.metrics is gw.metrics
+
+    paths = list_capsules(caps)
+    fault_caps = [load_capsule(p) for p in paths
+                  if "fault-serving.decode" in p]
+    assert fault_caps, paths
+    state = fault_caps[0]["state"]["gateway"]
+    assert "lanes" in state and len(state["lanes"]) == 2
+    assert state["faults"]["fired"][0]["site"] == "serving.decode"
+    assert "queued" in state and "engine" in state
+
+
+def test_capsule_report_cli(tmp_path, capsys):
+    """capsule-report reconstructs the incident from the capsule dir alone:
+    trigger, timeline, alert set, snapshot deltas — human mode and one pure
+    JSON document with --json."""
+    from accelerate_tpu.commands.accelerate_cli import main
+
+    caps = str(tmp_path / "caps")
+    tel = _tel()
+    plane = MetricsPlane(enabled=True, clock=lambda: 0.0)
+    rec = FlightRecorder(telemetry=tel, ring_size=64, snapshot_every=0,
+                         clock=lambda: 5.0, capsule_dir=caps, metrics=plane)
+    plane.inc(M_RECORDER_DROPPED_TOTAL)     # any registered counter will do
+    rec._append(plane.snapshot_record(now=1.0))
+    plane.inc(M_RECORDER_DROPPED_TOTAL)
+    rec._append(plane.snapshot_record(now=3.0))
+    tel.emit({"schema": RECOVERY_SCHEMA, "action": "quarantine",
+              "reason": "step_fault:error", "t": 4.0})
+    tel.emit(_alert("step-failure-burst", t=5.0))
+
+    assert main(["capsule-report", caps]) == 0
+    human = capsys.readouterr().out
+    assert "recovery:quarantine" in human and "alert:step-failure-burst" in human
+
+    assert main(["capsule-report", caps, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)   # pure: nothing but the document
+    assert [c["trigger"] for c in doc["capsules"]] == [
+        "recovery:quarantine", "alert:step-failure-burst"]
+    alert_cap = doc["capsules"][1]
+    assert alert_cap["alerts_fired"] == ["step-failure-burst"]
+    assert [e["event"] for e in alert_cap["timeline"]] == ["recovery", "alert"]
+    deltas = alert_cap["deltas"]
+    assert deltas["window_s"] == 2.0
+    assert deltas["counters"][M_RECORDER_DROPPED_TOTAL]["delta"] == 1
+
+    assert main(["capsule-report", str(tmp_path / "empty")]) == 1
+
+
+# ------------------------------------------------------------- CLI json modes
+def test_trace_report_pure_json_mode(tmp_path, capsys, setup):
+    """--json prints ONE machine-readable document and nothing else."""
+    from accelerate_tpu.commands.accelerate_cli import main
+
+    params, prompts = setup
+    jdir = str(tmp_path / "run")
+    tel = _tel(jsonl_dir=jdir)
+    tracer = Tracer(tel)
+    eng = ContinuousBatcher(params, CFG, max_slots=2, max_len=64,
+                            prompt_bucket=16, tracer=tracer)
+    gw = ServingGateway(eng, GatewayConfig(enabled=True), telemetry=tel,
+                        tracer=tracer)
+    done = [gw.submit(p, max_new_tokens=3) for p in prompts[:2]]
+    gw.run()
+    tel.close()
+
+    assert main(["trace-report", jdir, "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["n_traces"] == 2 and doc["by_status"]["done"] == 2
+    assert len(doc["traces"]) == 2
+
+    assert main(["trace-report", jdir, "--json",
+                 "--uid", str(done[0].uid)]) == 0
+    one = json.loads(capsys.readouterr().out)
+    assert one["uid"] == done[0].uid and one["status"] == "done"
+
+
+def test_metrics_dump_pure_json_modes(tmp_path, capsys):
+    from accelerate_tpu.commands.accelerate_cli import main
+    from accelerate_tpu.telemetry.schemas import GATEWAY_REQUEST_SCHEMA
+
+    path = tmp_path / "t.jsonl"
+    with open(path, "w") as f:
+        f.write(json.dumps({
+            "schema": GATEWAY_REQUEST_SCHEMA, "uid": 0, "status": "done",
+            "reason": None, "tenant": "default", "priority": 0, "n_tokens": 4,
+            "retries_used": 0, "queue_wait_s": 0.1, "ttft_s": 0.3,
+            "tpot_s": 0.02, "deadline_met": True,
+        }) + "\n")
+    assert main(["metrics-dump", str(path), "--json"]) == 0
+    stats = json.loads(capsys.readouterr().out)
+    assert stats["records_consumed"] == 1
+
+    assert main(["metrics-dump", "--smoke", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)   # verdict + stats, one document
+    assert doc["ok"] is True and doc["failures"] == []
+    assert doc["records_consumed"] > 0
+
+
+# ------------------------------------------------------------------- exporter
+def test_exporter_scrape_counter_and_healthz_charset():
+    """The exporter observes its own traffic (scrape counter, counted BEFORE
+    rendering so a scrape sees itself) and healthz declares its charset."""
+    import urllib.request
+
+    from accelerate_tpu.telemetry.exporter import MetricsExporter
+    from accelerate_tpu.telemetry.metrics import M_EXPORTER_SCRAPES_TOTAL
+
+    plane = MetricsPlane(enabled=True, clock=lambda: 0.0)
+    with MetricsExporter(plane, port=0) as exporter:
+        url = f"http://127.0.0.1:{exporter.port}"
+        with urllib.request.urlopen(f"{url}/healthz") as resp:
+            assert resp.headers["Content-Type"] == (
+                "application/json; charset=utf-8")
+            assert json.loads(resp.read())["ok"] is True
+        body1 = urllib.request.urlopen(f"{url}/metrics").read().decode()
+        body2 = urllib.request.urlopen(f"{url}/metrics").read().decode()
+    key_m = f'{M_EXPORTER_SCRAPES_TOTAL}{{endpoint="metrics"}}'
+    key_h = f'{M_EXPORTER_SCRAPES_TOTAL}{{endpoint="healthz"}}'
+    assert f"{key_m} 1.0" in body1          # the first scrape sees itself
+    assert f"{key_m} 2.0" in body2
+    assert f"{key_h} 1.0" in body2
+    assert plane.stats()["counters"][key_m] == 2

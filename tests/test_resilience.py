@@ -901,12 +901,20 @@ def test_chaos_bench_artifact(setup):
     assert artifact["provenance"] and artifact["workload_trace_hash"]
 
 
-def test_chaos_bench_cli_smoke(tmp_path):
-    """serve-bench --chaos --smoke is a tier-1 gate like --trace-curves."""
+def test_chaos_bench_cli_smoke(tmp_path, capsys):
+    """serve-bench --chaos --smoke is a tier-1 gate like --trace-curves — and
+    since the flight-recorder tier, the CLI exit code also gates the capsule
+    invariants: every injected incident leaves >=1 capsule naming the fault
+    site and the fired alerts, the clean arm leaves ZERO, and capsule-report
+    can reconstruct the incident from the kept capsule directory alone."""
+    from accelerate_tpu.commands.accelerate_cli import main
+
     out = tmp_path / "BENCH_CHAOS.json"
+    caps = tmp_path / "caps"
     result = subprocess.run(
         [sys.executable, "-m", "accelerate_tpu", "serve-bench",
-         "--chaos", str(out), "--smoke", "--seed", "0"],
+         "--chaos", str(out), "--smoke", "--seed", "0",
+         "--capsule-dir", str(caps)],
         capture_output=True, text=True, timeout=600,
         env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
     )
@@ -914,8 +922,23 @@ def test_chaos_bench_cli_smoke(tmp_path):
     artifact = json.loads(out.read_text())
     assert artifact["chaos"]["silently_lost"] == 0
     assert artifact["streams_identical"] is True
+    assert artifact["capsules_clean_zero"] is True
+    assert artifact["capsules_chaos_expected"] is True
+    assert artifact["capsules"]["count"] >= 1
+    assert artifact["capsules"]["sites_covered"] is True
     summary = json.loads(result.stdout.strip().splitlines()[-1])
     assert summary["schema"] == "accelerate_tpu.bench.chaos/v1"
+
+    # The kept capsules are self-contained: capsule-report rebuilds the
+    # incident (trigger + fault sites) with no access to the bench run.
+    assert main(["capsule-report", str(caps / "chaos"), "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    reports = doc["capsules"]
+    assert len(reports) == artifact["capsules"]["count"]
+    sites = sorted({s for r in reports for s in r["fault_sites"]})
+    assert sites == artifact["capsules"]["fault_sites"]
+    assert not (tmp_path / "caps" / "clean").exists() or not any(
+        (tmp_path / "caps" / "clean").iterdir())
 
 
 def test_new_schemas_registered():
