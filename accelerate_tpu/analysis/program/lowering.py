@@ -20,8 +20,8 @@ from ...compile_cache.cache import AotCache
 from .capture import ProgramCapture
 
 __all__ = ["LowerOnlyCache", "capture_default_programs", "DEFAULT_AUDIT_GEOMETRY",
-           "PAGED_AUDIT_GEOMETRY", "DISAGG_AUDIT_GEOMETRY",
-           "MPMD_AUDIT_GEOMETRY"]
+           "PAGED_AUDIT_GEOMETRY", "SPEC_FUSED_AUDIT_GEOMETRY",
+           "DISAGG_AUDIT_GEOMETRY", "MPMD_AUDIT_GEOMETRY"]
 
 #: The geometry ``audit`` lowers when none is given: the warmup CLI's default
 #: config with eval and serving enabled — including the speculative-decoding
@@ -62,6 +62,27 @@ PAGED_AUDIT_GEOMETRY = dict(
     spec_draft="ngram",
     page_size=24,
     prefix_cache=2,
+    decode_steps=4,
+)
+
+#: Dense serving-only pass over the FUSED speculative super-step surface
+#: (``serving.spec_multi`` — spec_k > 0, decode_steps > 1, resident ngram
+#: drafter): the default pass keeps ``spec_draft="half"`` for the draft-model
+#: program coverage, and a half-depth ModelDrafter is NOT resident, so the
+#: fused dense program only lowers here. The paged twin
+#: (``serving.spec_multi_paged``) already rides :data:`PAGED_AUDIT_GEOMETRY`,
+#: whose ngram drafter makes that engine fused.
+SPEC_FUSED_AUDIT_GEOMETRY = dict(
+    preset="smoke",
+    batch_size=8,
+    seq_len=128,
+    train=False,
+    eval_step=False,
+    serve=True,
+    max_slots=4,
+    max_new_tokens=32,
+    spec_k=2,
+    spec_draft="ngram",
     decode_steps=4,
 )
 
@@ -136,10 +157,12 @@ def capture_default_programs(**overrides) -> List[ProgramCapture]:
     on CPU, no TPU needed).
 
     Whenever the geometry serves (and no explicit ``page_size`` pins the layout),
-    a second serving-only pass lowers the paged-KV surface
-    (:data:`PAGED_AUDIT_GEOMETRY`, inheriting preset/shape overrides) into the
-    same capture list — the dense and paged engines are alternative replica
-    layouts, and BOTH stay under the ratchet.
+    serving-only passes lower the dense FUSED speculative surface
+    (:data:`SPEC_FUSED_AUDIT_GEOMETRY` — ``serving.spec_multi``) and the
+    paged-KV surface (:data:`PAGED_AUDIT_GEOMETRY`, whose ngram-drafter engine
+    also lowers ``serving.spec_multi_paged``), both inheriting preset/shape
+    overrides, into the same capture list — the dense and paged engines are
+    alternative replica layouts, and BOTH stay under the ratchet.
 
     Whenever the geometry trains, a third pass lowers the MPMD stage-program
     surface (``parallel/mpmd.py``, :data:`MPMD_AUDIT_GEOMETRY`): the per-stage
@@ -158,6 +181,12 @@ def capture_default_programs(**overrides) -> List[ProgramCapture]:
         inherit = {k: v for k, v in overrides.items()
                    if k in ("preset", "batch_size", "seq_len", "max_slots",
                             "max_len", "max_new_tokens")}
+        # Fused speculative super-step, dense layout: the default pass's
+        # half-depth drafter is not resident, so serving.spec_multi only
+        # lowers through this ngram-drafter pass (the paged twin rides the
+        # paged pass below).
+        run_warmup(cache=cache, emit_manifest=False,
+                   **{**SPEC_FUSED_AUDIT_GEOMETRY, **inherit})
         run_warmup(cache=cache, emit_manifest=False,
                    **{**PAGED_AUDIT_GEOMETRY, **inherit})
         # The disagg role slices (prefill-role export surface, decode-role

@@ -50,7 +50,10 @@ def warmup_command_parser(subparsers=None) -> argparse.ArgumentParser:
                         help="serving generation budget used for bucket validation")
     parser.add_argument("--spec-k", type=int, default=0,
                         help="speculative proposals per slot per step (adds the fused "
-                             "[B, k+1] verify program; 0 = plain decode only)")
+                             "[B, k+1] verify program; combined with --decode-steps N "
+                             "and an ngram drafter also the fused speculative "
+                             "super-step pair serving.spec_multi[_paged]; 0 = plain "
+                             "decode only)")
     parser.add_argument("--spec-draft", default=None, choices=("ngram", "half"),
                         help="draft source for the speculative surface: 'ngram' "
                              "(model-free, default) or 'half' (half-depth draft model "
@@ -67,7 +70,9 @@ def warmup_command_parser(subparsers=None) -> argparse.ArgumentParser:
                         help="multi-step decode depth: > 1 warms the fused N-step "
                              "super-step pair (both sample variants; dense or paged "
                              "per --page-size) and stamps the depth into the "
-                             "manifest (1 = classic one-token decode)")
+                             "manifest; with --spec-k and an ngram drafter it also "
+                             "warms the fused speculative super-step pair and stamps "
+                             "spec_fused (1 = classic one-token decode)")
     parser.add_argument("--prefix-cache", type=int, default=0,
                         help="prefix-cache capacity: > 0 warms the prefix-serving "
                              "programs (right-aligned prefill/chunk pair; with "

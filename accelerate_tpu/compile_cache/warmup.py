@@ -228,7 +228,10 @@ def run_warmup(
         # setup instead), a prefill-role one swaps decode/verify for the page
         # export gather — the manifest records which slice it is warm FOR.
         # ``decode_steps > 1`` adds the multi-step super-step pair (both sample
-        # variants, dense or paged per the layout above) to the warmed surface.
+        # variants, dense or paged per the layout above) to the warmed surface;
+        # combined with ``spec_k > 0`` and a resident drafter it ALSO warms the
+        # fused speculative super-step pair (``serving.spec_multi[_paged]``) —
+        # the manifest's ``spec_fused`` records which geometry that is.
         engine = ContinuousBatcher(
             params, cfg, max_slots=max_slots, max_len=engine_len,
             compile_cache=cache, spec_k=spec_k, drafter=drafter,
@@ -259,6 +262,10 @@ def run_warmup(
         "max_len": max_len if max_len is not None else seq_len,
         "spec_k": spec_k if serve else 0,
         "spec_draft": (spec_draft or "ngram") if serve and spec_k else None,
+        # Fused speculative super-step geometry: True when this cache directory
+        # is warm for ``serving.spec_multi[_paged]`` (spec_k > 0, decode_steps
+        # > 1, resident drafter) — the program such an engine dispatches.
+        "spec_fused": engine._spec_fused() if serve and spec_k else False,
         "page_size": page_size if serve else 0,
         "kv_pages": (
             engine.block_mgr.num_pages if serve and page_size else None

@@ -41,6 +41,7 @@ __all__ = [
     "sampling_core_dyn_k",
     "speculative_accept",
     "speculative_accept_batch",
+    "speculative_prefix_accept",
     "generate_loop",
     "streamed_generate_loop",
 ]
@@ -167,6 +168,53 @@ def speculative_accept_batch(p_probs: jax.Array, q_probs: jax.Array, draft_token
     discarded; their keys are never consumed by any retained draw, so the sequential
     accept-chain semantics (and the losslessness proof) are unchanged."""
     return jax.vmap(speculative_accept)(p_probs, q_probs, draft_tokens, keys)
+
+
+def speculative_prefix_accept(proposals: jax.Array, ref: jax.Array, live: jax.Array,
+                              limits: jax.Array, eos_ids: jax.Array):
+    """Batched greedy-prefix acceptance as a scan-compatible primitive: the
+    accept/truncate walk of the serving engine's replay/greedy speculative round
+    (``serving._spec_step``), vectorized over lanes so it can run INSIDE the
+    fused multi-round decode scan with no host involvement.
+
+    ``proposals`` [B, k] int32 — the drafter's k proposed tokens per lane;
+    ``ref`` [B, k+1] int32 — the reference tokens the verify pass selected at
+    each of the k+1 positions (position j conditioned on proposals[:, :j]);
+    ``live`` bool[B] — lanes participating this round; ``limits`` int32[B] —
+    remaining generation budget per lane (emissions this round are capped at
+    ``min(k+1, max(limits, 1))``); ``eos_ids`` int32[B] — per-lane EOS id, −1
+    disables (matching the multi-step scan's convention).
+
+    Per lane: accept the longest prefix where proposal j == ref j, emit those
+    plus ref's correction/bonus token (so 1..k+1 emissions), truncate at the
+    budget, then truncate AT the first emitted EOS inclusive. Emitted tokens
+    never depend on proposals — position j is only emitted when proposals
+    [0..j−1] matched ref[0..j−1] exactly — which is the losslessness argument
+    that makes the fused path bitwise-identical to the host loop for ANY
+    deterministic drafter.
+
+    Returns ``(n_emit int32[B], last_tok int32[B], hit_eos bool[B],
+    n_accepted int32[B])``: emission count (0 for dead lanes), the last emitted
+    token (undefined where n_emit == 0), whether the lane's round ended on its
+    EOS, and how many of the emissions were accepted draft proposals (the
+    telemetry accept-rate numerator, identical to the host loop's count).
+    """
+    k = proposals.shape[1]
+    match = (proposals == ref[:, :k]).astype(jnp.int32)
+    # Longest all-match prefix: sum of the cumulative product over positions.
+    n_match = jnp.sum(jnp.cumprod(match, axis=1), axis=1)
+    m = jnp.minimum(n_match + 1, jnp.maximum(limits, 1))
+    is_eos = (eos_ids[:, None] >= 0) & (ref == eos_ids[:, None])
+    within = jnp.arange(k + 1)[None, :] < m[:, None]
+    has_eos = jnp.any(is_eos & within, axis=1)
+    first_eos = jnp.argmax(is_eos & within, axis=1).astype(jnp.int32)
+    m = jnp.where(has_eos, first_eos + 1, m)
+    n_emit = jnp.where(live, m, 0).astype(jnp.int32)
+    last_idx = jnp.clip(n_emit - 1, 0, k)
+    last_tok = jnp.take_along_axis(ref, last_idx[:, None], axis=1)[:, 0]
+    hit_eos = has_eos & live
+    n_accepted = jnp.minimum(n_match, n_emit).astype(jnp.int32)
+    return n_emit, last_tok.astype(jnp.int32), hit_eos, n_accepted
 
 
 def sample_logits(logits: jax.Array, gen: GenerationConfig, rng: Optional[jax.Array]) -> jax.Array:

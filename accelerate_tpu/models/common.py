@@ -253,6 +253,112 @@ def multi_step_decode(forward_one: Callable, cache, tokens: jax.Array,
     return cache, tok_buf, counts
 
 
+def spec_multi_step_decode(forward_verify: Callable, propose: Callable,
+                           select_ref: Callable, cache, tokens: jax.Array,
+                           positions: jax.Array, active: jax.Array,
+                           budgets: jax.Array, eos_ids: jax.Array,
+                           key_tab: jax.Array, history: jax.Array,
+                           hist_lens: jax.Array, n_steps: int, spec_k: int,
+                           max_len: int):
+    """N speculative rounds (draft → verify → accept) as ONE ``lax.scan`` — the
+    device-resident speculative super-step both decoder families'
+    ``forward_slots_spec_multi`` wrappers share. Composes :func:`multi_step_decode`'s
+    lane-freezing carry with the serving engine's host spec round
+    (``serving._spec_step``), eliminating the per-round host round-trip.
+
+    Per scan step: ``propose(history, hist_lens) -> proposals [B, spec_k]``
+    drafts on device from the carried token history (prompt + all emissions so
+    far, packed from column 0 — the resident NgramDrafter is a pure gather);
+    the carried pending ``tokens`` [B] and the proposals form the ``[B, spec_k+1]``
+    verify sequence, written+attended at ``positions`` via
+    ``forward_verify(cache, seq, write_pos) -> (logits [B, spec_k+1, V], cache)``;
+    ``select_ref(logits, keys) -> ref [B, spec_k+1]`` picks the reference tokens
+    (argmax for greedy lanes, the engine's replay sampler for sampled lanes);
+    acceptance is :func:`generation.speculative_prefix_accept`.
+
+    The bitwise-parity linchpin is the per-lane emission-key CURSOR: sampled
+    draws consume keys indexed by EMISSION count, and acceptance makes that
+    count lane-varying, so ``xs``-style key threading cannot work. Instead
+    ``key_tab`` [B, K, 2] holds each lane's next K emission keys (K ≥
+    n_steps·(spec_k+1) covers the worst case) and the carried ``count`` is the
+    cursor: round keys are ``key_tab[b, count[b] + j]`` — exactly the keys the
+    host loop's ``_step_keys_window(req, len(req.tokens), spec_k+1)`` would
+    fetch at the same point, because ``len(req.tokens)`` grows by the SAME
+    per-lane ``n_emit``.
+
+    Lane freezing, the pending-token invariant, and the frozen-lane write-drop
+    (position clamped to ``max_len`` → dense OOB scatter / paged sentinel both
+    drop) carry over from :func:`multi_step_decode` verbatim. Rejected-draft
+    writes above the accepted prefix leave garbage KV, masked by causality
+    until the NEXT round's window (which starts exactly at the first garbage
+    slot and spans ``spec_k+1 ≥`` the garbage run) overwrites it — the PR-6
+    garbage-above-rewind contract, now applied per scan round.
+
+    Accepted emissions are appended to the carried ``history`` in-scan (OOB
+    columns drop), so round r+1 drafts from a context that includes round r's
+    tokens — no host involvement at any point.
+
+    Returns ``(cache, tok_buf [N, B, spec_k+1], emits [N, B], counts [B],
+    proposed [B], accepted [B])``: per round, ``tok_buf[r, b, :emits[r, b]]``
+    are lane b's real emissions (drain round-major, lane-minor to match the
+    host loop's streaming order); ``counts`` is the per-lane emission total
+    (final position is ``positions[b] + counts[b]``); ``proposed``/``accepted``
+    are the telemetry accept-rate counters (spec_k per live lane per round /
+    accepted-prefix lengths), summed on device in the carry."""
+    from ..generation import speculative_prefix_accept
+
+    B = tokens.shape[0]
+    S = history.shape[1]
+    k1 = spec_k + 1
+    done0 = ~active
+    zeros = jnp.zeros((B,), jnp.int32)
+
+    def body(carry, _):
+        cache, hist, lens, tok, pos, done, count, proposed, accepted = carry
+        live = ~done
+        props = propose(hist, lens)
+        seq = jnp.concatenate([tok[:, None], props], axis=1)
+        write_pos = jnp.where(done, jnp.int32(max_len), pos)
+        logits, cache = forward_verify(cache, seq, write_pos)
+        # Emission-key cursor: lane b's j-th key this round is its (count+j)-th
+        # emission key. The clip only guards the table edge — a live lane never
+        # reads past n_steps*(spec_k+1)-1, and the window itself already clamps
+        # at the request's key-schedule end like the host loop's does.
+        ki = jnp.clip(
+            count[:, None] + jnp.arange(k1, dtype=jnp.int32)[None, :],
+            0, key_tab.shape[1] - 1,
+        )
+        keys = jnp.take_along_axis(key_tab, ki[:, :, None], axis=1)
+        ref = select_ref(logits, keys)
+        n_emit, last, hit_eos, n_acc = speculative_prefix_accept(
+            props, ref, live, budgets - count, eos_ids
+        )
+        # Append this round's emissions to the drafting history (columns past
+        # n_emit route to S — out of bounds, the scatter drops them).
+        wi = jnp.where(
+            jnp.arange(k1, dtype=jnp.int32)[None, :] < n_emit[:, None],
+            lens[:, None] + jnp.arange(k1, dtype=jnp.int32)[None, :],
+            jnp.int32(S),
+        )
+        hist = hist.at[jnp.arange(B)[:, None], wi].set(ref)
+        lens = lens + n_emit
+        tok = jnp.where(n_emit > 0, last, tok)
+        count = count + n_emit
+        pos = pos + n_emit
+        done = done | (live & (hit_eos | (count >= budgets)))
+        proposed = proposed + jnp.where(live, jnp.int32(spec_k), 0)
+        accepted = accepted + n_acc
+        carry = (cache, hist, lens, tok, pos, done, count, proposed, accepted)
+        return carry, (ref, n_emit)
+
+    carry0 = (cache, history, hist_lens, tokens, positions, done0, zeros,
+              zeros, zeros)
+    (cache, _, _, _, _, _, counts, proposed, accepted), (tok_buf, emits) = (
+        jax.lax.scan(body, carry0, None, length=n_steps)
+    )
+    return cache, tok_buf, emits, counts, proposed, accepted
+
+
 def paged_attention_dispatch(q, pool, tables, positions, valid, *, page_size: int,
                              sm_scale: float, window: int = 0, softcap: float = 0.0,
                              dtype, dense_attention):

@@ -1618,6 +1618,49 @@ def forward_slots_multi(
     )
 
 
+def forward_slots_spec_multi(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,
+    positions: jax.Array,
+    active: jax.Array,
+    budgets: jax.Array,
+    eos_ids: jax.Array,
+    propose,
+    select_ref,
+    key_tab: jax.Array,
+    history: jax.Array,
+    hist_lens: jax.Array,
+    n_steps: int,
+    spec_k: int,
+    cfg: LlamaConfig,
+    tables: Optional[jax.Array] = None,
+    page_size: int = 0,
+):
+    """N speculative draft→verify→accept rounds as ONE ``lax.scan`` — the fused
+    super-step the serving engine's ``spec_k > 0, decode_steps=N`` path
+    dispatches (``serving.spec_multi[_paged]``). Each scan round's verify is
+    literally a T == spec_k+1 :func:`forward_slots` call (the PR-6
+    ``_spec_verify_step`` body: same rope positions, same valid/causal masking,
+    same paged routing), so per-round logits are bitwise the host loop's; see
+    :func:`~.common.spec_multi_step_decode` for the accept/key-cursor/freeze
+    contract. Returns ``(cache, tok_buf [n_steps, B, spec_k+1], emits
+    [n_steps, B], counts [B], proposed [B], accepted [B])``."""
+    from .common import spec_multi_step_decode
+
+    max_len = cache["valid"].shape[1]
+
+    def forward_verify(c, seq, write_pos):
+        return forward_slots(
+            params, seq, c, write_pos, cfg, tables=tables, page_size=page_size
+        )
+
+    return spec_multi_step_decode(
+        forward_verify, propose, select_ref, cache, tokens, positions, active,
+        budgets, eos_ids, key_tab, history, hist_lens, n_steps, spec_k, max_len,
+    )
+
+
 def _make_gen_fns(cfg: LlamaConfig, max_len: int):
     """Stable-identity (prefill, decode) pair for ``generation.generate_loop`` (jit-static)."""
 

@@ -137,6 +137,10 @@ def _kernel(tab_ref, pos_ref, *refs, page_size, max_pages, T, G, num_pages,
     # Mask: key slot j (global position i*ps + j) is visible to query row r
     # (query index t = r // G) iff j <= pos[b] + t, inside the window, and marked
     # valid — sentinel-table garbage pages land here too and mask out entirely.
+    # This bound is also the speculative rewind contract: rejected drafts leave
+    # stale K/V at slots above pos[b] (once per round under the fused super-step,
+    # which rewinds and rewrites in-scan), and those slots are exactly the ones
+    # this mask makes unreachable until a later round's writes replace them.
     key_pos = i * page_size + jax.lax.broadcasted_iota(jnp.int32, (R, page_size), 1)
     q_pos = pos_ref[b] + jax.lax.broadcasted_iota(jnp.int32, (R, page_size), 0) // G
     mask = (key_pos <= q_pos) & (valid_ref[...] > 0)

@@ -22,6 +22,18 @@ Sharing model (copy-on-write at the divergence point):
   shared one. Both directions are counted as ``cow_copies``.
 - Pages free when their refcount returns to zero (lane finish/evict, registry eviction).
 
+Speculative writes ride the same reservation. ``admit`` covers a lane's FULL residual
+budget up front, and under the fused speculative super-step (``serving.spec_multi_paged``)
+that reservation must also absorb every round's k+1 verify writes: round r of the scan
+writes ``[pending, d₁ … d_k]`` at the lane's rewound position, so a rejected draft leaves
+garbage K/V *above the rewind* inside the lane's own already-reserved pages — per round,
+N times per dispatch, with no host between rounds to re-plan pages. That is safe for the
+same two reasons as the host-loop spec engine's single round: the block table uploaded at
+the super-step boundary already names every page any round can touch (nothing can appear
+mid-scan; frozen/past-budget coordinates map to ``SENTINEL`` and drop), and garbage above
+a lane's position is unreachable through the position mask until the next round's writes
+land on those very slots (``ops/paged_attention.py``).
+
 ``BlockManager`` deliberately knows nothing about models or devices: the engine asks it
 for page ids and mirrors them into the device block table it uploads per step.
 """

@@ -76,6 +76,7 @@ from .models import llama
 from .models.llama import init_cache
 from .paged_kv import BlockManager, KVBudgetError, pages_for
 from .resilience.faults import EngineCrashed, StepWatchdog
+from .telemetry.compile_monitor import compile_label
 from .telemetry.schemas import (
     FAULT_SCHEMA,
     RECOVERY_SCHEMA,
@@ -417,6 +418,93 @@ def _decode_multi_step_paged(params, cache, tables, tokens, positions, active,
     return tok_buf, counts, cache
 
 
+def _spec_multi_select(sample: bool, temps, top_ps, top_ks):
+    """``select_ref(logits [B, k+1, V], keys [B, k+1, 2]) -> ref [B, k+1]`` for
+    the fused speculative scan body: the reference tokens the accept walk
+    compares proposals against at every verify position.
+
+    ``sample=False`` is the fused argmax — the exact op ``_spec_verify_step``
+    returns. ``sample=True`` draws every (lane, position) via the same
+    row[None]-shaped vmapped ``sampling_core_dyn_k`` the multi-step scan uses
+    (bitwise ``sampling_core``, hence bitwise ``_replay_draws``' per-position
+    replay); the keys arrive CURSOR-indexed from the scan body, so position j
+    consumes lane b's key for emission ``count[b]+j`` — exactly the key the
+    host loop's ``_replay_round`` window would hand it. Greedy lanes ride along
+    with a safe temperature and their draw discarded in favor of the argmax."""
+    if not sample:
+        return lambda logits, _: jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_temps = jnp.where(temps > 0.0, temps, 1.0)
+
+    def select_ref(logits, keys):
+        B, T, V = logits.shape
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        drawn = jax.vmap(
+            lambda row, key, t, p, k: sampling_core_dyn_k(row[None], key, t, p, k)[0]
+        )(
+            logits.reshape(B * T, V), keys.reshape(B * T, 2),
+            jnp.repeat(safe_temps, T), jnp.repeat(top_ps, T),
+            jnp.repeat(top_ks, T),
+        ).reshape(B, T)
+        return jnp.where(temps[:, None] > 0.0, drawn, greedy)
+
+    return select_ref
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "n_steps", "spec_k", "max_ngram", "sample"),
+         donate_argnums=(1,))
+def _spec_multi_step(params, cache, tokens, positions, active, budgets, eos_ids,
+                     key_tab, temps, top_ps, top_ks, history, hist_lens, cfg,
+                     n_steps: int, spec_k: int, max_ngram: int, sample: bool):
+    """``n_steps`` speculative draft→verify→accept rounds as ONE dispatched
+    program (tok_buf [N, B, spec_k+1], emits [N, B], counts [B], proposed [B],
+    accepted [B], new cache) — the device-resident speculative super-step
+    (docs/speculative_serving.md). Drafting is the resident n-gram gather over
+    the carried ``history``/``hist_lens`` context; verify/accept/key-cursor
+    semantics live in ``models.common.spec_multi_step_decode``."""
+    from .spec_decode import ngram_propose_resident
+
+    propose = lambda hist, lens: ngram_propose_resident(  # noqa: E731
+        hist, lens, spec_k, max_ngram)
+    select_ref = _spec_multi_select(sample, temps, top_ps, top_ks)
+    cache, tok_buf, emits, counts, proposed, accepted = (
+        llama.forward_slots_spec_multi(
+            params, cache, tokens, positions, active, budgets, eos_ids,
+            propose, select_ref, key_tab, history, hist_lens, n_steps, spec_k,
+            cfg,
+        )
+    )
+    return tok_buf, emits, counts, proposed, accepted, cache
+
+
+@partial(jax.jit,
+         static_argnames=("cfg", "n_steps", "spec_k", "max_ngram", "sample",
+                          "page_size"),
+         donate_argnums=(1,))
+def _spec_multi_step_paged(params, cache, tables, tokens, positions, active,
+                           budgets, eos_ids, key_tab, temps, top_ps, top_ks,
+                           history, hist_lens, cfg, n_steps: int, spec_k: int,
+                           max_ngram: int, sample: bool, page_size: int):
+    """:func:`_spec_multi_step` over the PAGED cache: every round's [B, spec_k+1]
+    verify writes route through the device-resident block tables (admission
+    reserves the full residual budget up front, so no entry appears mid-scan);
+    rejected-draft and frozen-lane positions route to the sentinel and DROP —
+    the paged spelling of the per-round garbage-above-rewind contract."""
+    from .spec_decode import ngram_propose_resident
+
+    propose = lambda hist, lens: ngram_propose_resident(  # noqa: E731
+        hist, lens, spec_k, max_ngram)
+    select_ref = _spec_multi_select(sample, temps, top_ps, top_ks)
+    cache, tok_buf, emits, counts, proposed, accepted = (
+        llama.forward_slots_spec_multi(
+            params, cache, tokens, positions, active, budgets, eos_ids,
+            propose, select_ref, key_tab, history, hist_lens, n_steps, spec_k,
+            cfg, tables=tables, page_size=page_size,
+        )
+    )
+    return tok_buf, emits, counts, proposed, accepted, cache
+
+
 @partial(jax.jit, static_argnames=("page_size", "scan_layers"), donate_argnums=(0,))
 def _insert_row_paged(cache, row_cache, write_ids, slot, page_size: int,
                       scan_layers: bool):
@@ -744,6 +832,12 @@ class ContinuousBatcher:
         self._spec_verify_paged_fn = as_cached(
             _spec_verify_step_paged, cc, "serving.spec_verify_paged",
             ("cfg", "page_size"))
+        self._spec_multi_fn = as_cached(
+            _spec_multi_step, cc, "serving.spec_multi",
+            ("cfg", "n_steps", "spec_k", "max_ngram", "sample"))
+        self._spec_multi_paged_fn = as_cached(
+            _spec_multi_step_paged, cc, "serving.spec_multi_paged",
+            ("cfg", "n_steps", "spec_k", "max_ngram", "sample", "page_size"))
         self._insert_paged_fn = as_cached(
             _insert_row_paged, cc, "serving.insert_paged",
             ("page_size", "scan_layers"))
@@ -1133,12 +1227,25 @@ class ContinuousBatcher:
         """Toggle speculative decoding at runtime (the gateway degradation
         rung). Always output-safe: speculation never changes emitted tokens,
         only how many a dispatch produces — disabling reverts to the plain
-        one-token decode step (warmed alongside the verify program, so the
+        decode path for this engine's ``decode_steps`` (the one-token step, or
+        the fused ``decode_multi`` super-step when ``decode_steps > 1`` — never
+        N=1; both are warmed alongside the verify/fused-spec programs, so the
         toggle costs no compiles); re-enabling resumes proposals (a
         ModelDrafter's stale lane cache only lowers acceptance until its lanes
         cycle)."""
         if self.spec_k:
             self.spec_enabled = bool(enabled)
+
+    def _spec_fused(self) -> bool:
+        """Whether speculative decode dispatches as the FUSED multi-round scan
+        (``serving.spec_multi[_paged]``) instead of the host loop: needs
+        ``decode_steps > 1`` (the super-step geometry), replay acceptance (the
+        residual accept consumes keys data-dependently on device draws the scan
+        cannot replay), and a drafter with a device-resident propose
+        (``DraftSource.resident`` — the shipped NgramDrafter). Everything else
+        keeps the PR-6 host loop, bitwise-identically."""
+        return (self.multi_step > 1 and self.spec_accept == "replay"
+                and getattr(self.drafter, "resident", False))
 
     def evict_slot(self, uid: int) -> bool:
         """Free the decode lane holding request ``uid`` (deadline enforcement /
@@ -1375,7 +1482,12 @@ class ContinuousBatcher:
         # gateway's pressure rungs flip ``spec_enabled`` off — safe mid-request,
         # because every path consumes the same emission-indexed key schedule.
         use_spec = self.spec_k and self.spec_enabled
-        if use_spec:
+        if use_spec and self._spec_fused():
+            # Fused speculative super-step: N draft→verify→accept rounds in ONE
+            # dispatch (docs/speculative_serving.md). Flipping spec off lands on
+            # the plain decode_multi super-step below, never on N=1.
+            decode = self._spec_multi
+        elif use_spec:
             decode = self._spec_step
         elif self.multi_step > 1:
             decode = self._multi_step
@@ -1589,16 +1701,18 @@ class ContinuousBatcher:
         traced = [self.slot_req[i] for i in active] if tracing else ()
         t_guard = self._pre_dispatch("serving.decode", active)
         if self.paged:
-            greedy, logits, self.cache = self._decode_paged_fn(
-                self.params, self.cache, jnp.asarray(self.block_mgr.tables),
-                jnp.asarray(self.tokens), jnp.asarray(self.positions),
-                cfg=self.cfg, page_size=self.page_size,
-            )
+            with compile_label("serving.decode_paged"):
+                greedy, logits, self.cache = self._decode_paged_fn(
+                    self.params, self.cache, jnp.asarray(self.block_mgr.tables),
+                    jnp.asarray(self.tokens), jnp.asarray(self.positions),
+                    cfg=self.cfg, page_size=self.page_size,
+                )
         else:
-            greedy, logits, self.cache = self._decode_fn(
-                self.params, self.cache, jnp.asarray(self.tokens),
-                jnp.asarray(self.positions), cfg=self.cfg,
-            )
+            with compile_label("serving.decode"):
+                greedy, logits, self.cache = self._decode_fn(
+                    self.params, self.cache, jnp.asarray(self.tokens),
+                    jnp.asarray(self.positions), cfg=self.cfg,
+                )
         greedy_host = np.asarray(greedy)
         self._post_dispatch(t_guard)  # watchdog check BEFORE any token lands
         finished = []
@@ -1712,23 +1826,25 @@ class ContinuousBatcher:
             keys = jnp.zeros((B, N, 2), jnp.uint32)
         t_guard = self._pre_dispatch("serving.decode", active)
         if self.paged:
-            tok_buf, counts, self.cache = self._decode_multi_paged_fn(
-                self.params, self.cache, jnp.asarray(self.block_mgr.tables),
-                jnp.asarray(self.tokens), jnp.asarray(self.positions),
-                jnp.asarray(active_mask), jnp.asarray(budgets),
-                jnp.asarray(eos_ids), keys, jnp.asarray(temps),
-                jnp.asarray(top_ps), jnp.asarray(top_ks),
-                cfg=self.cfg, n_steps=N, sample=sampled,
-                page_size=self.page_size,
-            )
+            with compile_label("serving.decode_multi_paged"):
+                tok_buf, counts, self.cache = self._decode_multi_paged_fn(
+                    self.params, self.cache, jnp.asarray(self.block_mgr.tables),
+                    jnp.asarray(self.tokens), jnp.asarray(self.positions),
+                    jnp.asarray(active_mask), jnp.asarray(budgets),
+                    jnp.asarray(eos_ids), keys, jnp.asarray(temps),
+                    jnp.asarray(top_ps), jnp.asarray(top_ks),
+                    cfg=self.cfg, n_steps=N, sample=sampled,
+                    page_size=self.page_size,
+                )
         else:
-            tok_buf, counts, self.cache = self._decode_multi_fn(
-                self.params, self.cache, jnp.asarray(self.tokens),
-                jnp.asarray(self.positions), jnp.asarray(active_mask),
-                jnp.asarray(budgets), jnp.asarray(eos_ids), keys,
-                jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks),
-                cfg=self.cfg, n_steps=N, sample=sampled,
-            )
+            with compile_label("serving.decode_multi"):
+                tok_buf, counts, self.cache = self._decode_multi_fn(
+                    self.params, self.cache, jnp.asarray(self.tokens),
+                    jnp.asarray(self.positions), jnp.asarray(active_mask),
+                    jnp.asarray(budgets), jnp.asarray(eos_ids), keys,
+                    jnp.asarray(temps), jnp.asarray(top_ps), jnp.asarray(top_ks),
+                    cfg=self.cfg, n_steps=N, sample=sampled,
+                )
         tok_host = np.asarray(tok_buf)     # [N, B]
         counts_host = np.asarray(counts)   # [B]
         self._post_dispatch(t_guard)  # watchdog check BEFORE any token lands
@@ -1777,6 +1893,197 @@ class ContinuousBatcher:
                 )
         return finished
 
+    def _spec_multi(self, active: list[int]) -> list[Request]:
+        """Fused speculative super-step: ``decode_steps=N`` draft→verify→accept
+        rounds in ONE dispatched scan (``serving.spec_multi``/``spec_multi_paged``),
+        then ONE drain of the [N, B, spec_k+1] token buffer — speculation with
+        ZERO host involvement between rounds.
+
+        Drafting runs in-scan (the resident n-gram gather over each lane's
+        carried prompt+generated history), the verify is the PR-6 fused
+        [B, spec_k+1] forward as the scan body, and acceptance advances each
+        lane's emission-key CURSOR by its own ``n_emit`` — so sampled lanes
+        consume exactly the keys the host loop's ``_replay_round`` would, and
+        emitted streams are BITWISE the host-loop spec path's (hence bitwise
+        ``spec_k=0``; see docs/speculative_serving.md). The drain is
+        round-major, lane-minor — the exact order N sequential ``_spec_step``
+        calls would have appended, so ``on_token`` streaming transcripts equal
+        the final token lists. Admission/eviction/deadlines and the fault
+        boundary + watchdog act at super-step granularity, exactly as in
+        ``_multi_step``."""
+        N = self.multi_step
+        k = self.spec_k
+        T = k + 1
+        B = self.max_slots
+        tracer = self.tracer
+        tracing = tracer is not None and tracer.enabled  # the two-attr-read contract
+        t0 = tracer._clock() if tracing else 0.0
+        traced = [(i, self.slot_req[i]) for i in active] if tracing else ()
+        active_mask = np.zeros((B,), bool)
+        budgets = np.ones((B,), np.int32)   # idle lanes: frozen at step 0, never read
+        eos_ids = np.full((B,), -1, np.int32)
+        temps = np.zeros((B,), np.float32)
+        top_ps = np.ones((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
+        # Drafting history: prompt + generated so far, packed from column 0 —
+        # compact token order, so it works unchanged with prefix-cached and
+        # paged layouts (it is NOT the cache layout, just the token sequence).
+        history = np.zeros((B, self.max_len), np.int32)
+        hist_lens = np.zeros((B,), np.int32)
+        sampled = False
+        key_rows: list = [None] * B
+        for i in active:
+            req = self.slot_req[i]
+            active_mask[i] = True
+            budgets[i] = req.gen.max_new_tokens - len(req.tokens)
+            if req.gen.eos_token_id is not None:
+                eos_ids[i] = req.gen.eos_token_id
+            ctx = np.concatenate(
+                [np.asarray(req.prompt, np.int32),
+                 np.asarray(req.tokens, np.int32)]
+            )[-self.max_len:]
+            history[i, :len(ctx)] = ctx
+            hist_lens[i] = len(ctx)
+            if req.gen.temperature > 0.0:
+                sampled = True
+                temps[i] = req.gen.temperature
+                top_ps[i] = req.gen.top_p
+                top_ks[i] = req.gen.top_k
+                # Per-lane key TABLE: the next N*(k+1) emission keys from this
+                # lane's schedule (the worst case — N full acceptances). The
+                # scan's per-lane cursor (its emission count) indexes into it,
+                # so round r consumes exactly the keys _replay_round would at
+                # the same emission offsets (window clamped at the final key,
+                # like the host loop's).
+                key_rows[i] = self._step_keys_window(req, len(req.tokens), N * T)
+        if sampled:
+            filler = jnp.zeros_like(
+                next(kr for kr in key_rows if kr is not None)
+            )  # greedy/idle lanes: key bits are never consumed (temp 0 → argmax)
+            key_tab = jnp.stack([kr if kr is not None else filler
+                                 for kr in key_rows])
+        else:
+            key_tab = jnp.zeros((B, N * T, 2), jnp.uint32)
+        max_ngram = int(self.drafter.max_ngram)
+        t_guard = self._pre_dispatch("serving.decode", active)
+        if self.paged:
+            with compile_label("serving.spec_multi_paged"):
+                tok_buf, emits, counts, proposed, accepted, self.cache = (
+                    self._spec_multi_paged_fn(
+                        self.params, self.cache,
+                        jnp.asarray(self.block_mgr.tables),
+                        jnp.asarray(self.tokens), jnp.asarray(self.positions),
+                        jnp.asarray(active_mask), jnp.asarray(budgets),
+                        jnp.asarray(eos_ids), key_tab, jnp.asarray(temps),
+                        jnp.asarray(top_ps), jnp.asarray(top_ks),
+                        jnp.asarray(history), jnp.asarray(hist_lens),
+                        cfg=self.cfg, n_steps=N, spec_k=k, max_ngram=max_ngram,
+                        sample=sampled, page_size=self.page_size,
+                    )
+                )
+        else:
+            with compile_label("serving.spec_multi"):
+                tok_buf, emits, counts, proposed, accepted, self.cache = (
+                    self._spec_multi_fn(
+                        self.params, self.cache, jnp.asarray(self.tokens),
+                        jnp.asarray(self.positions), jnp.asarray(active_mask),
+                        jnp.asarray(budgets), jnp.asarray(eos_ids), key_tab,
+                        jnp.asarray(temps), jnp.asarray(top_ps),
+                        jnp.asarray(top_ks), jnp.asarray(history),
+                        jnp.asarray(hist_lens),
+                        cfg=self.cfg, n_steps=N, spec_k=k, max_ngram=max_ngram,
+                        sample=sampled,
+                    )
+                )
+        ref_host = np.asarray(tok_buf)      # [N, B, k+1]
+        emits_host = np.asarray(emits)      # [N, B]
+        counts_host = np.asarray(counts)    # [B]
+        prop_host = np.asarray(proposed)    # [B]
+        acc_host = np.asarray(accepted)     # [B]
+        self._post_dispatch(t_guard)  # watchdog check BEFORE any token lands
+        # Drain in exact generation order (round-major, lane-minor — the order N
+        # sequential _spec_step calls would have appended), clamped to each
+        # lane's remaining budget (belt and braces over the in-scan cap).
+        last_tok = [0] * B
+        for r in range(N):
+            for i in active:
+                req = self.slot_req[i]
+                m = int(emits_host[r, i])
+                for j in range(m):
+                    if len(req.tokens) >= req.gen.max_new_tokens:
+                        break
+                    tok = int(ref_host[r, i, j])
+                    last_tok[i] = tok
+                    req.tokens.append(tok)
+                    if req.on_token is not None:
+                        req.on_token(tok)
+        finished = []
+        step_tokens = 0
+        for i in active:
+            req = self.slot_req[i]
+            c = int(counts_host[i])
+            step_tokens += c
+            self.tokens[i] = last_tok[i]  # the new pending token (c >= 1 always)
+            self.positions[i] += c
+            eos = req.gen.eos_token_id
+            hit_eos = eos is not None and req.tokens and req.tokens[-1] == eos
+            if hit_eos or len(req.tokens) >= req.gen.max_new_tokens:
+                req.done = True
+                finished.append(req)
+                self.slot_req[i] = None  # slot frees; cache row overwritten on next admit
+                self._release_lane(i)
+        self.positions = np.minimum(self.positions, self.max_len - 1)
+        step_proposed = int(prop_host.sum())
+        step_accepted = int(acc_host.sum())
+        self.decode_steps += 1
+        self.decode_tokens += step_tokens
+        self.spec_proposed += step_proposed
+        self.spec_accepted += step_accepted
+        if tracing:
+            # One span per traced lane for the whole super-step: ``tokens`` is
+            # that lane's real emission count (every emitted token accounted),
+            # ``proposed``/``accepted`` its per-lane round totals, ``n_steps``
+            # the fused depth, ``host_s`` the measured inter-dispatch gap — all
+            # N rounds now share ONE gap, which is the whole point.
+            t1 = tracer._clock()
+            host_s = self._host_gap(t0, t1)
+            for i, req in traced:
+                tracer.span(
+                    tracer.handle_for(req.uid), "decode", t0, t1,
+                    step=self.decode_steps, occupancy=len(active),
+                    tokens=int(counts_host[i]), n_steps=N,
+                    proposed=int(prop_host[i]), accepted=int(acc_host[i]),
+                    host_s=host_s,
+                )
+        tel = self.telemetry
+        if tel is not None and tel.enabled:
+            from .telemetry import TELEMETRY_REV
+
+            tel.emit({
+                "schema": SERVING_SPEC_SCHEMA,
+                "telemetry_rev": TELEMETRY_REV,
+                # Causality key shared with trace.span/v1 decode spans (and the
+                # serving.kv/v1 record) of this same dispatch.
+                "step": self.decode_steps,
+                "spec_k": k,
+                "rounds": N,
+                "active_slots": len(active),
+                "step_proposed": step_proposed,
+                "step_accepted": step_accepted,
+                "step_tokens": step_tokens,
+                "proposed_total": self.spec_proposed,
+                "accepted_total": self.spec_accepted,
+                "spec_accept_rate": (
+                    round(self.spec_accepted / self.spec_proposed, 4)
+                    if self.spec_proposed else None
+                ),
+                "tokens_per_step": (
+                    round(self.decode_tokens / self.decode_steps, 4)
+                    if self.decode_steps else None
+                ),
+            })
+        return finished
+
     def _spec_step(self, active: list[int]) -> list[Request]:
         """Speculative decode: propose → ONE fused verify → per-slot prefix acceptance.
 
@@ -1803,16 +2110,18 @@ class ContinuousBatcher:
         seq[:, 1:] = proposals
         t_guard = self._pre_dispatch("serving.decode", active)
         if self.paged:
-            greedy, logits, self.cache = self._spec_verify_paged_fn(
-                self.params, self.cache, jnp.asarray(self.block_mgr.tables),
-                jnp.asarray(seq), jnp.asarray(self.positions),
-                cfg=self.cfg, page_size=self.page_size,
-            )
+            with compile_label("serving.spec_verify_paged"):
+                greedy, logits, self.cache = self._spec_verify_paged_fn(
+                    self.params, self.cache, jnp.asarray(self.block_mgr.tables),
+                    jnp.asarray(seq), jnp.asarray(self.positions),
+                    cfg=self.cfg, page_size=self.page_size,
+                )
         else:
-            greedy, logits, self.cache = self._spec_verify_fn(
-                self.params, self.cache, jnp.asarray(seq),
-                jnp.asarray(self.positions), cfg=self.cfg,
-            )
+            with compile_label("serving.spec_verify"):
+                greedy, logits, self.cache = self._spec_verify_fn(
+                    self.params, self.cache, jnp.asarray(seq),
+                    jnp.asarray(self.positions), cfg=self.cfg,
+                )
         greedy_host = np.asarray(greedy)  # [B, T]
         self._post_dispatch(t_guard)  # watchdog check BEFORE any token lands
         finished = []
@@ -1886,6 +2195,7 @@ class ContinuousBatcher:
                 # serving.kv/v1 record) of this same dispatch.
                 "step": self.decode_steps,
                 "spec_k": k,
+                "rounds": 1,  # the host loop is one round per dispatch
                 "active_slots": len(active),
                 "step_proposed": k * len(active),
                 "step_accepted": step_accepted,
@@ -1981,6 +2291,27 @@ class ContinuousBatcher:
         )
         return [(args, {"n_steps": N, "sample": s}) for s in (False, True)]
 
+    def _spec_multi_warm_args(self):
+        """(traced args, static kwargs) pairs covering the FUSED speculative
+        super-step surface for :meth:`warm_programs`: the per-lane vectors +
+        key table + drafting history after the ``params``/``cache``(/``tables``)
+        prefix, for both ``sample`` variants — shapes/dtypes exactly what
+        ``_spec_multi`` uploads at runtime."""
+        B, N, T = self.max_slots, self.multi_step, self.spec_k + 1
+        lanes = jnp.zeros((B,), jnp.int32)
+        args = (
+            lanes, lanes, jnp.zeros((B,), bool), jnp.ones((B,), jnp.int32),
+            jnp.full((B,), -1, jnp.int32),
+            jnp.zeros((B, N * T, 2), jnp.uint32),
+            jnp.zeros((B,), jnp.float32), jnp.ones((B,), jnp.float32),
+            jnp.zeros((B,), jnp.int32),
+            jnp.zeros((B, self.max_len), jnp.int32),
+            jnp.zeros((B,), jnp.int32),
+        )
+        statics = {"n_steps": N, "spec_k": self.spec_k,
+                   "max_ngram": int(self.drafter.max_ngram)}
+        return [(args, {**statics, "sample": s}) for s in (False, True)]
+
     def warm_programs(self, max_new_tokens: int = 32) -> list:
         """Pre-compile this engine's whole program surface into the AOT cache
         WITHOUT executing anything (``python -m accelerate_tpu warmup --serve``).
@@ -1990,7 +2321,11 @@ class ContinuousBatcher:
         draft AND verify ride the same bucket ladder and warmup manifest, so a
         spec-enabled replica restart compiles nothing), the multi-step super-step
         pair when ``decode_steps > 1`` (both ``sample`` variants — a mixed
-        workload alternates greedy-only and sampled super-steps), one prefill per bucket
+        workload alternates greedy-only and sampled super-steps), the FUSED
+        speculative super-step pair when both combine and the drafter is
+        resident (``serving.spec_multi[_paged]`` — the program such an engine
+        actually dispatches; verify + decode_multi stay warm as its degradation
+        targets), one prefill per bucket
         that ``_plan_prefill`` can actually route a ``max_new_tokens``-budget
         request to, the first-chunk + chunk-append pair (the fallback for
         prompts/budgets no bucket fits — always part of the live surface), and
@@ -2033,6 +2368,17 @@ class ContinuousBatcher:
                         self.params, self.cache, tables, seq, lanes,
                         cfg=self.cfg, page_size=self.page_size,
                     ))
+                    if self._spec_fused():
+                        # The fused spec super-step pair (both sample variants):
+                        # the program this engine actually dispatches while
+                        # spec_enabled; the host-loop verify above stays warm as
+                        # its degradation target alongside decode_multi.
+                        for args, statics in self._spec_multi_warm_args():
+                            entries.append(self._spec_multi_paged_fn.warm(
+                                self.params, self.cache, tables, *args,
+                                cfg=self.cfg, page_size=self.page_size,
+                                **statics,
+                            ))
                     entries.extend(self.drafter.warm_programs(self, max_new_tokens))
             write_ids = jnp.zeros((self.block_mgr.max_pages,), jnp.int32)
             if self.role == "decode":
@@ -2092,6 +2438,15 @@ class ContinuousBatcher:
                 entries.append(self._spec_verify_fn.warm(
                     self.params, self.cache, seq, lanes, cfg=self.cfg
                 ))
+                if self._spec_fused():
+                    # Fused spec super-step pair (both sample variants) — the
+                    # dispatched program while spec_enabled; the host-loop
+                    # verify stays warm as its degradation target.
+                    for args, statics in self._spec_multi_warm_args():
+                        entries.append(self._spec_multi_fn.warm(
+                            self.params, self.cache, *args, cfg=self.cfg,
+                            **statics,
+                        ))
                 entries.extend(self.drafter.warm_programs(self, max_new_tokens))
         if self.prompt_buckets is not None and not self.prefix_cache_size:
             # Only buckets a request with this generation budget can land in —

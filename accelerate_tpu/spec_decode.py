@@ -37,7 +37,7 @@ import jax.numpy as jnp
 
 from .models.common import cached_decode_family
 
-__all__ = ["DraftSource", "NgramDrafter", "ModelDrafter"]
+__all__ = ["DraftSource", "NgramDrafter", "ModelDrafter", "ngram_propose_resident"]
 
 
 class DraftSource:
@@ -54,7 +54,16 @@ class DraftSource:
     Proposals must be DETERMINISTIC given the lane context: the engine builds the
     residual-mode draft distribution as a point mass on the proposal (a stochastic
     drafter would need to surface its q rows; neither shipped drafter samples).
+
+    ``resident = True`` marks a drafter whose propose step has a device-resident
+    counterpart the engine may run INSIDE the fused multi-round decode scan
+    (``serving.spec_multi``) instead of calling :meth:`propose` on the host. The
+    fused path never calls ``propose`` — losslessness (replay/greedy emissions
+    do not depend on proposals) is what licenses the swap, so a resident device
+    proposer need not match its host twin token-for-token, only be deterministic.
     """
+
+    resident = False  # host-loop only unless a subclass opts in
 
     def bind(self, engine) -> None:  # noqa: B027 - optional hook
         pass
@@ -86,6 +95,8 @@ class NgramDrafter(DraftSource):
     cache, no compiled programs, works with prefix-cached engines — and makes
     speculative serving exercisable in CI on CPU.
     """
+
+    resident = True  # device twin: ngram_propose_resident (zero extra programs)
 
     def __init__(self, max_ngram: int = 3):
         if max_ngram < 1:
@@ -141,6 +152,61 @@ class NgramDrafter(DraftSource):
                 if cont.size:
                     return cont
         return None
+
+
+def ngram_propose_resident(history: jax.Array, lengths: jax.Array, k: int,
+                           max_ngram: int) -> jax.Array:
+    """Device-resident prompt-lookup drafting: :class:`NgramDrafter`'s propose
+    step as pure vectorized gathers, runnable INSIDE the fused decode scan
+    (``serving.spec_multi``) with zero extra programs and zero host round-trips.
+
+    ``history`` [B, S] int32 — each lane's prompt + generated tokens packed from
+    column 0 (the scan body appends accepted emissions in-carry); ``lengths``
+    [B] int32 — valid token count per lane; ``k``/``max_ngram`` static. Returns
+    proposals [B, k] int32.
+
+    Per lane: the longest suffix n-gram (n from ``max_ngram`` down to 1) is
+    matched against every earlier window of ``history[:length-1]`` (the suffix
+    never matches itself); the LATEST hit wins, and the k tokens following it
+    are proposed, clamped at the context end (positions past the last valid
+    token repeat it). No hit → repeat the last token. This is a deliberate
+    simplification of the host drafter's re-match-on-exhaustion refill loop:
+    emissions in replay/greedy acceptance do not depend on proposals, so the
+    two proposers may disagree token-for-token without affecting output — only
+    the accept rate. Deterministic given (history, lengths), as the DraftSource
+    contract requires.
+    """
+    B, S = history.shape
+    lengths = lengths.astype(jnp.int32)
+    starts = jnp.arange(S, dtype=jnp.int32)[None, :]
+    best_n = jnp.zeros((B,), jnp.int32)
+    best_h = jnp.zeros((B,), jnp.int32)
+    for n in range(max_ngram, 0, -1):
+        # Suffix pattern: the last n valid tokens (clip keeps short lanes in
+        # bounds; the validity mask below kills their matches anyway).
+        pat_idx = jnp.clip(
+            lengths[:, None] - n + jnp.arange(n, dtype=jnp.int32)[None, :], 0, S - 1
+        )
+        pat = jnp.take_along_axis(history, pat_idx, axis=1)
+        match = jnp.ones((B, S), bool)
+        for j in range(n):
+            shifted = jnp.concatenate(
+                [history[:, j:], jnp.zeros((B, j), history.dtype)], axis=1
+            )
+            match &= shifted == pat[:, j:j + 1]
+        # Host semantics: windows over ctx[:L-1] with starts 0..L-1-n, so the
+        # suffix itself is never its own match and n > L-1 finds nothing.
+        valid = (starts + n <= lengths[:, None] - 1) & (lengths[:, None] - 1 >= n)
+        h = jnp.max(jnp.where(match & valid, starts, -1), axis=1)
+        take = (h >= 0) & (best_n == 0)  # largest n wins; latest start within n
+        best_n = jnp.where(take, n, best_n)
+        best_h = jnp.where(take, h, best_h)
+    hit = best_n > 0
+    src = jnp.where(hit, best_h + best_n, lengths - 1)
+    step = hit.astype(jnp.int32)
+    idx = src[:, None] + jnp.arange(k, dtype=jnp.int32)[None, :] * step[:, None]
+    idx = jnp.clip(jnp.minimum(idx, lengths[:, None] - 1), 0, S - 1)
+    return jnp.take_along_axis(history, idx, axis=1).astype(jnp.int32)
 
 
 @partial(jax.jit, static_argnames=("cfg",), donate_argnums=(1,))

@@ -225,10 +225,12 @@ SCHEMA_REGISTRY: Dict[str, RecordSchema] = {
         ),
         _reg(
             SERVING_SPEC_SCHEMA,
-            ("telemetry_rev", "step", "spec_k", "active_slots", "step_proposed",
-             "step_accepted", "step_tokens", "proposed_total", "accepted_total"),
-            "ContinuousBatcher._spec_step",
-            "speculative proposal/acceptance per decode step",
+            ("telemetry_rev", "step", "spec_k", "rounds", "active_slots",
+             "step_proposed", "step_accepted", "step_tokens", "proposed_total",
+             "accepted_total"),
+            "ContinuousBatcher._spec_step / _spec_multi",
+            "speculative proposal/acceptance per dispatch (rounds=1 host loop; "
+            "rounds=N fused super-step)",
         ),
         _reg(
             SERVING_HANDOFF_SCHEMA,

@@ -74,6 +74,13 @@ def test_default_enumeration_covers_the_warmup_surface(default_captures):
     assert {"serving.decode_paged", "serving.spec_verify_paged",
             "serving.insert_paged", "serving.gather_row_paged",
             "serving.copy_page"} <= labels, labels
+    # The fused speculative super-step pair (ISSUE 18): the dense program rides
+    # the ngram-drafter SPEC_FUSED pass (the default pass's half-depth drafter
+    # is not resident), the paged twin rides the paged pass — both under the
+    # same empty ratchet baselines.
+    assert {"serving.spec_multi", "serving.spec_multi_paged"} <= labels, labels
+    # Multi-step decode fallback pair stays on the surface too.
+    assert {"serving.decode_multi", "serving.decode_multi_paged"} <= labels, labels
     # The MPMD stage-program surface (ISSUE 11): the alternative TRAINING
     # layout is lowered alongside the SPMD step, and the inventory audits the
     # inter-stage DCN payload bytes of every transfer-bearing program.

@@ -432,6 +432,64 @@ def test_multistep_serving_second_varied_workload_compiles_zero():
     assert engine.stats()["multi_step"] == 4
 
 
+def test_fused_spec_serving_second_varied_workload_compiles_zero():
+    """Fused speculative super-step compile surface (ISSUE 18): round count N,
+    spec_k, the drafter's max_ngram and the sample flag are STATIC (two
+    programs per layout); lane count, budgets, EOS, token history, key-cursor
+    tables and admission order are DATA — a second varied workload on a
+    spec_k=2 + decode_steps=4 engine (different prompts, lengths, budgets,
+    sampled AND greedy lanes, lane churn) compiles zero new programs.
+
+    Same sampled-budget carve-out as the plain multi-step test: the key
+    SCHEDULE mints a few tiny host-side programs per distinct sampled budget,
+    so the second workload reuses first-workload sampled budgets."""
+    import jax
+
+    from accelerate_tpu.generation import GenerationConfig
+    from accelerate_tpu.models import llama
+    from accelerate_tpu.serving import ContinuousBatcher
+
+    # Distinct geometry so no other serving test's executables are reused.
+    cfg = dataclasses.replace(
+        llama.CONFIGS["tiny"], dtype=jnp.float32, d_model=72, n_heads=2, n_kv_heads=2
+    )
+    params = llama.init_params(cfg)
+    engine = ContinuousBatcher(
+        params, cfg, max_slots=2, max_len=64, prompt_buckets=(16,),
+        spec_k=2, decode_steps=4,
+    )
+    assert engine._spec_fused()
+    rng = np.random.default_rng(9)
+
+    def workload(lens, budgets, seed):
+        for i, (n, b) in enumerate(zip(lens, budgets)):
+            prompt = rng.integers(1, cfg.vocab_size, n).astype(np.int32)
+            if i % 2:
+                engine.submit(prompt, gen=GenerationConfig(
+                    max_new_tokens=b, temperature=0.8, top_p=0.9, top_k=7,
+                ), rng=jax.random.PRNGKey(seed + i))
+            else:
+                engine.submit(prompt, max_new_tokens=b)
+        engine.run()
+
+    mon = CompileMonitor().start()
+    try:
+        workload((3, 5, 9, 12), (3, 6, 11, 2), seed=0)   # sampled budgets 6, 2
+        if not mon.supported:
+            pytest.skip("this jax exposes no jax.monitoring API")
+        first_workload = mon.count
+        workload((2, 7, 11, 6), (7, 2, 5, 6), seed=40)   # sampled budgets 2, 6
+    finally:
+        mon.stop()
+    # Loose first-workload bound (prefill + per-slot inserts + the two fused
+    # spec variants + key-schedule plumbing); the pin is the ZERO below.
+    assert first_workload <= 30, first_workload
+    assert mon.count == first_workload, (
+        f"second fused-spec workload recompiled {mon.count - first_workload} programs"
+    )
+    assert engine.stats()["multi_step"] == 4 and engine.stats()["spec_k"] == 2
+
+
 def test_warmup_enumerates_multistep_programs(tmp_path):
     """run_warmup(decode_steps=4) lists BOTH super-step sample variants in the
     manifest and stamps the depth — a cache directory is auditable for which
@@ -460,6 +518,46 @@ def test_warmup_enumerates_multistep_programs(tmp_path):
         run_warmup(cache=LowerOnlyCache(), emit_manifest=False,
                    preset="smoke", batch_size=2, seq_len=16, train=False,
                    serve=False, decode_steps=4)
+
+
+def test_warmup_enumerates_fused_spec_programs(tmp_path):
+    """run_warmup(spec_k, decode_steps>1, ngram drafter) lists BOTH sample
+    variants of the fused speculative super-step in the manifest and stamps
+    ``spec_fused`` — a cache directory is auditable for whether its spec
+    surface is the fused scan or the host round-trip loop. A half-depth
+    ModelDrafter is NOT device-resident, so the same geometry with
+    spec_draft='half' stamps spec_fused=False and warms no fused program."""
+    from accelerate_tpu.analysis.program import LowerOnlyCache
+    from accelerate_tpu.compile_cache.warmup import run_warmup
+
+    manifest = run_warmup(
+        cache=LowerOnlyCache(), manifest_path=str(tmp_path / "m.json"),
+        preset="smoke", batch_size=2, seq_len=16, train=False, eval_step=False,
+        serve=True, max_slots=2, max_len=128, max_new_tokens=4,
+        spec_k=2, spec_draft="ngram", decode_steps=4,
+    )
+    assert manifest["spec_fused"] is True
+    assert manifest["decode_steps"] == 4 and manifest["spec_k"] == 2
+    labels = [e["label"] for e in manifest["programs"]]
+    assert labels.count("serving.spec_multi") == 2, labels  # greedy + sampled
+    assert "serving.spec_verify" in labels   # host-loop fallback stays warm
+    assert "serving.decode_multi" in labels  # spec-off degradation target
+    paged = run_warmup(
+        cache=LowerOnlyCache(), emit_manifest=False,
+        preset="smoke", batch_size=2, seq_len=16, train=False, eval_step=False,
+        serve=True, max_slots=2, max_len=128, max_new_tokens=4,
+        spec_k=2, spec_draft="ngram", decode_steps=2, page_size=24,
+    )
+    assert paged["spec_fused"] is True
+    assert {e["label"] for e in paged["programs"]} >= {"serving.spec_multi_paged"}
+    half = run_warmup(
+        cache=LowerOnlyCache(), emit_manifest=False,
+        preset="smoke", batch_size=2, seq_len=16, train=False, eval_step=False,
+        serve=True, max_slots=2, max_len=128, max_new_tokens=4,
+        spec_k=2, spec_draft="half", decode_steps=4,
+    )
+    assert half["spec_fused"] is False
+    assert "serving.spec_multi" not in {e["label"] for e in half["programs"]}
 
 
 def test_warmup_enumerates_paged_programs(tmp_path):

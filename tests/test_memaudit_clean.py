@@ -81,6 +81,29 @@ def test_estimates_cover_the_default_surface(default_captures):
     assert estimates["train_step.apply"]["dcn_bytes"] == 0
 
 
+def test_fused_spec_budget_row_no_hbm_regression(default_captures):
+    """The fused speculative super-step's budget row (ISSUE 18): both fused
+    programs get a positive per-device estimate under the chip budget, and the
+    scan carry the fusion adds (token history, key-cursor table, per-round
+    counters — O(slots × max_len) int32) must not regress peak HBM against the
+    plain multi-step super-step it degrades into. 2% is the band: the carry is
+    bookkeeping, not a second activation footprint."""
+    _findings, estimates, _stale, _notices = run_memaudit(
+        captures=default_captures
+    )
+    for fused, fallback in (("serving.spec_multi", "serving.decode_multi"),
+                            ("serving.spec_multi_paged",
+                             "serving.decode_multi_paged")):
+        assert fused in estimates, sorted(estimates)
+        peak = estimates[fused]["peak_bytes"]
+        assert 0 < peak < DEFAULT_CHIP_BUDGET_BYTES, fused
+        base = estimates[fallback]["peak_bytes"]
+        assert peak <= 1.02 * base, (
+            f"{fused} peak {peak} regressed > 2% vs {fallback} peak {base}: "
+            "the fused carry should be bookkeeping-sized"
+        )
+
+
 def test_estimate_tracks_measured_peak(default_captures):
     """The stated estimate-vs-measured contract. Where the backend keeps an
     allocator ledger (TPU/GPU), the static estimate for the biggest program

@@ -539,6 +539,45 @@ def test_degradation_rungs(setup):
     assert eng.spec_enabled is True  # no permanent ratchet: fully restored
 
 
+def test_spec_off_mid_run_lands_on_decode_multi(setup):
+    """Rung-1 degradation on a FUSED-speculation engine (spec_k > 0 AND
+    decode_steps > 1): when the breaker disables speculation mid-run, the next
+    dispatches land on the plain multi-step super-step
+    (``serving.decode_multi``) — NOT the one-token N=1 path — and the finished
+    transcripts stay bitwise the undisturbed greedy output. Asserted by
+    compile-label attribution: every decode dispatch site runs under
+    ``compile_label``, so the programs each phase compiled are on the record."""
+    from accelerate_tpu.telemetry import CompileMonitor
+
+    params, prompts = setup
+    clock = ManualClock()
+    mon = CompileMonitor()
+    mon.start()
+    try:
+        eng = make_engine(params, spec_k=2, decode_steps=4)
+        assert eng._spec_fused()
+        gw = ServingGateway(
+            eng, GatewayConfig(enabled=True, degrade=True), clock=clock
+        )
+        reqs = [eng.submit(p, max_new_tokens=24) for p in prompts[:3]]
+        eng.step()  # admission + first fused spec super-step
+        assert "serving.spec_multi" in mon.by_label
+        gw._breaker_open(clock())  # rung 1: speculation off, engine keeps running
+        assert eng.spec_enabled is False
+        eng.run()
+        assert all(r.done and len(r.tokens) == 24 for r in reqs)
+        assert "serving.decode_multi" in mon.by_label, sorted(mon.by_label)
+        assert "serving.decode" not in mon.by_label, (
+            "degraded engine fell back to the N=1 decode path instead of the "
+            "multi-step super-step"
+        )
+    finally:
+        mon.stop()
+    clean = clean_reference(params, prompts[:3], n_new=24)
+    for r, ref in zip(reqs, clean):
+        assert r.tokens == ref
+
+
 def test_engine_restart_replay_streams_identical(setup):
     """In-flight requests that die with the engine are requeued and replayed
     idempotently: on_retry resets the stream, and the final transcripts are

@@ -386,6 +386,233 @@ def test_ngram_drafter_lookup():
         NgramDrafter(max_ngram=0)
 
 
+# --------------------------------------------------------------------------
+# Fused speculative super-steps (ISSUE 18): spec_k > 0 AND decode_steps > 1
+# with a device-resident drafter routes to ONE dispatched lax.scan that runs N
+# draft→verify→accept rounds per dispatch. The contract is the same
+# losslessness, twice over: fused output is BITWISE the host-loop spec engine
+# (decode_steps=1) AND bitwise spec_k=0 — greedy and sampled, dense and paged.
+# --------------------------------------------------------------------------
+
+def fused_engine(params, paged=False, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prompt_bucket", 16)
+    kw.setdefault("spec_k", 3)
+    kw.setdefault("decode_steps", 4)
+    if paged:
+        kw.setdefault("page_size", 8)
+    eng = ContinuousBatcher(params, CFG, **kw)
+    assert eng._spec_fused(), "workload would not exercise the fused path"
+    return eng
+
+
+def host_loop_tokens(params, workload, paged=False, **kw):
+    """The same workload through the host-loop spec engine (decode_steps=1)."""
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prompt_bucket", 16)
+    kw.setdefault("spec_k", 3)
+    if paged:
+        kw.setdefault("page_size", 8)
+    eng = ContinuousBatcher(params, CFG, **kw)
+    reqs = [eng.submit(*a, **k) for a, k in workload]
+    eng.run()
+    return [r.tokens for r in reqs]
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["dense", "paged"])
+def test_fused_spec_greedy_staggered_matches_host_loop_and_plain(setup, paged):
+    """More requests than slots, varied budgets, staggered admission and lane
+    churn: every fused output equals the host-loop spec engine's AND the
+    standalone greedy decode (spec_k=0) — token for token."""
+    params, prompts = setup
+    n_new = [6, 4, 8, 3, 5, 7]
+    workload = [((p,), dict(max_new_tokens=n)) for p, n in zip(prompts, n_new)]
+    engine = fused_engine(params, paged=paged)
+    reqs = [engine.submit(*a, **k) for a, k in workload]
+    done = engine.run()
+    assert len(done) == len(reqs)
+    host = host_loop_tokens(params, workload, paged=paged)
+    for req, got_host, prompt, n in zip(reqs, host, prompts, n_new):
+        assert req.done and len(req.tokens) == n
+        assert req.tokens == got_host, req.uid          # vs host-loop spec
+        assert req.tokens == reference_greedy(params, prompt, n), req.uid
+    stats = engine.stats()
+    assert stats["decode_steps"] > 0
+    assert stats["spec_proposed"] > 0    # proposals flowed through the scan
+    assert stats["spec_proposed"] >= stats["spec_accepted"] >= 0
+
+
+@pytest.mark.parametrize("paged", [False, True], ids=["dense", "paged"])
+def test_fused_spec_mixed_lanes_bitwise(setup, paged):
+    """Greedy, sampled (temperature+top_k) and nucleus (top_p) lanes share one
+    fused dispatch; each lane's per-emission key CURSOR advances by that lane's
+    own acceptance, so every lane stays bitwise the host-loop spec engine and
+    the plain engine — the key-cursor linchpin, asserted end-to-end."""
+    params, prompts = setup
+    gen_tk = GenerationConfig(max_new_tokens=6, temperature=0.8, top_k=12)
+    gen_tp = GenerationConfig(max_new_tokens=5, temperature=0.7, top_p=0.8)
+    workload = [
+        ((prompts[0],), dict(max_new_tokens=7)),
+        ((prompts[1],), dict(gen=gen_tk, rng=jax.random.PRNGKey(11))),
+        ((prompts[2],), dict(gen=gen_tp, rng=jax.random.PRNGKey(77))),
+        ((prompts[3],), dict(gen=gen_tk, rng=jax.random.PRNGKey(22))),
+    ]
+    engine = fused_engine(params, paged=paged, spec_k=2)
+    reqs = [engine.submit(*a, **k) for a, k in workload]
+    engine.run()
+    host = host_loop_tokens(params, workload, paged=paged, spec_k=2)
+    for req, got_host in zip(reqs, host):
+        assert req.tokens == got_host, req.uid
+    # And vs the plain (spec_k=0) reference: greedy lane via generate, sampled
+    # lanes via the padded prompt_mask generate (the engine's key schedule).
+    assert reqs[0].tokens == reference_greedy(params, prompts[0], 7)
+    for req, (args, kw) in [(reqs[1], workload[1]), (reqs[2], workload[2]),
+                            (reqs[3], workload[3])]:
+        prompt, gen, rng = args[0], kw["gen"], kw["rng"]
+        pad = 16 - len(prompt)
+        padded = np.zeros((1, 16), np.int32); padded[0, pad:] = prompt
+        pmask = np.zeros((1, 16), bool); pmask[0, pad:] = True
+        want = np.asarray(llama.generate(
+            params, jnp.asarray(padded), CFG, gen, rng=rng,
+            prompt_mask=jnp.asarray(pmask),
+        ))[0].tolist()
+        assert req.tokens == want, req.uid
+
+
+def test_fused_spec_eos_mid_round_and_same_step_lane_reuse(setup):
+    """An EOS inside a round's accepted prefix ends the request AT the EOS —
+    the scan freezes the lane for the remaining rounds (writes dropped, cursor
+    parked) and the host discards everything after it; the freed lane admits
+    and finishes the next request with full parity."""
+    params, prompts = setup
+    ref = reference_greedy(params, prompts[2], 4)
+    engine = fused_engine(params, max_slots=1)
+    req = engine.submit(prompts[2], max_new_tokens=10, eos_token_id=ref[3])
+    r_next = engine.submit(prompts[3], max_new_tokens=4)
+    done = engine.run()
+    assert req.done and req.tokens == ref  # stopped at the EOS, mid-scan
+    assert r_next.done and r_next.tokens == reference_greedy(params, prompts[3], 4)
+    assert len(done) == 2
+
+
+def test_fused_spec_budget_never_overruns(setup):
+    """The carried budget freeze: a round that accepted more than the remaining
+    budget emits exactly to the budget, and later rounds of the same super-step
+    stay frozen — no overshoot at any boundary N might straddle."""
+    params, prompts = setup
+    for budget in (2, 3, 5, 9):
+        engine = fused_engine(params, max_slots=1)
+        req = engine.submit(prompts[1], max_new_tokens=budget)
+        engine.run()
+        assert len(req.tokens) == budget
+        assert req.tokens == reference_greedy(params, prompts[1], budget)
+
+
+def test_fused_spec_streaming_order_and_off_switch(setup):
+    """on_token fires once per token in generation order even when one dispatch
+    emits up to N×(k+1) tokens; set_spec_enabled(False) mid-run falls back to
+    the PLAIN multi-step super-step (not N=1) and keeps parity."""
+    params, prompts = setup
+    engine = fused_engine(params)
+    streamed = {}
+    reqs = []
+    for i, p in enumerate(prompts[:3]):
+        streamed[i] = []
+        reqs.append(engine.submit(p, max_new_tokens=8,
+                                  on_token=streamed[i].append))
+    engine.step()
+    engine.set_spec_enabled(False)  # degradation rung 1, mid-flight
+    engine.run()
+    assert engine.multi_step == 4   # fallback stays the fused plain super-step
+    for i, (req, p) in enumerate(zip(reqs, prompts[:3])):
+        assert streamed[i] == req.tokens == reference_greedy(params, p, 8)
+
+
+def test_fused_spec_telemetry_rounds_per_super_step(setup, tmp_path):
+    """One serving.spec/v1 record per fused super-step with rounds=N (the host
+    loop stamps rounds=1), and the proposed/accepted counters survive the scan:
+    proposed counts spec_k per live lane per ROUND, never less than accepted."""
+    import json
+
+    from accelerate_tpu.telemetry import Telemetry
+    from accelerate_tpu.utils.dataclasses import TelemetryConfig
+
+    params, prompts = setup
+    tel = Telemetry(TelemetryConfig(enabled=True, jsonl_dir=str(tmp_path)))
+    engine = fused_engine(params, max_slots=1, spec_k=2, telemetry=tel)
+    engine.submit(prompts[0], max_new_tokens=9)
+    engine.run()
+    n_super_steps = engine.stats()["decode_steps"]
+    tel.close()
+    records = []
+    for f in tmp_path.glob("*.jsonl"):
+        with open(f) as fh:
+            records += [json.loads(line) for line in fh if line.strip()]
+    spec = [r for r in records
+            if r.get("schema") == "accelerate_tpu.telemetry.serving.spec/v1"]
+    assert len(spec) == n_super_steps, (len(spec), n_super_steps)
+    for r in spec:
+        assert r["rounds"] == 4 and r["spec_k"] == 2
+        assert r["step_proposed"] >= r["step_accepted"] >= 0
+        assert r["proposed_total"] >= r["accepted_total"]
+    # Every emitted token is accounted: budget == sum of per-step tokens.
+    assert sum(r["step_tokens"] for r in spec) + 1 == 9  # +1 from prefill
+
+
+def test_fused_spec_gpt_family_model_level():
+    """The fused scan body is model-agnostic (``forward_slots_spec_multi`` is
+    part of the shared cached-decode contract): gpt's delegate emits bitwise
+    the plain one-token greedy ``forward_slots`` loop, budgets respected."""
+    from accelerate_tpu.spec_decode import ngram_propose_resident
+
+    g_cfg = dataclasses.replace(gpt.CONFIGS["tiny"], dtype=jnp.float32,
+                                attn_impl="xla")
+    g_params = gpt.init_params(g_cfg)
+    rng = np.random.default_rng(3)
+    B, plen, max_len, n_steps, k = 2, 6, 32, 4, 3
+    prompts = jnp.asarray(rng.integers(1, g_cfg.vocab_size, (B, plen)), jnp.int32)
+    budgets = np.asarray([8, 5], np.int32)
+
+    def prefill():
+        cache = gpt.init_cache(g_cfg, B, max_len)
+        logits, cache = gpt.forward_slots(
+            g_params, prompts, cache, jnp.zeros((B,), jnp.int32), g_cfg)
+        return jnp.argmax(logits[:, -1, :], -1).astype(jnp.int32), cache
+
+    # Plain reference: one token per forward, batched, argmax.
+    tok, cache = prefill()
+    want = [[] for _ in range(B)]
+    pos = jnp.full((B,), plen, jnp.int32)
+    for _ in range(int(budgets.max())):
+        logits, cache = gpt.forward_slots(g_params, tok[:, None], cache, pos, g_cfg)
+        tok = jnp.argmax(logits[:, -1, :], -1).astype(jnp.int32)
+        pos = pos + 1
+        for b in range(B):
+            want[b].append(int(tok[b]))
+
+    # Fused: same post-prefill state through the spec super-step delegate.
+    tok, cache = prefill()
+    history = jnp.zeros((B, max_len), jnp.int32)
+    history = history.at[:, :plen].set(prompts).at[:, plen].set(tok)
+    _, tok_buf, emits, counts, proposed, accepted = gpt.forward_slots_spec_multi(
+        g_params, cache, tok, jnp.full((B,), plen, jnp.int32),
+        jnp.ones((B,), bool), jnp.asarray(budgets), jnp.full((B,), -1, jnp.int32),
+        lambda h, l: ngram_propose_resident(h, l, k, 3),
+        lambda logits, keys: jnp.argmax(logits, -1).astype(jnp.int32),
+        jnp.zeros((B, n_steps * (k + 1), 2), jnp.uint32),
+        history, jnp.full((B,), plen + 1, jnp.int32), n_steps, k, g_cfg,
+    )
+    tok_buf, emits = np.asarray(tok_buf), np.asarray(emits)
+    assert np.asarray(counts).tolist() == budgets.tolist()
+    assert int(np.asarray(proposed).sum()) >= int(np.asarray(accepted).sum()) >= 0
+    for b in range(B):
+        got = [int(t) for r in range(n_steps)
+               for t in tok_buf[r, b, :emits[r, b]]]
+        assert got == want[b][: int(budgets[b])], b
+
+
 def test_spec_moe_dense_routing(setup):
     """MoE configs verify through the DENSE decode routing — parity against the
     engine's own spec_k=0 output (both use dense per-token routing at decode)."""
