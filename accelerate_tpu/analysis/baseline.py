@@ -35,9 +35,14 @@ def load_baseline(path: str = BASELINE_FILE) -> Dict[tuple, int]:
     return out
 
 
-#: tier name -> the CLI subcommand that regenerates its baseline. All three
+#: tier name -> the CLI subcommand that regenerates its baseline. All four
 #: tiers share this file format and ratchet contract.
-_TOOL_COMMANDS = {"graftlint": "lint", "graftaudit": "audit", "memaudit": "memaudit"}
+_TOOL_COMMANDS = {
+    "graftlint": "lint",
+    "graftaudit": "audit",
+    "memaudit": "memaudit",
+    "graftflow": "flow",
+}
 
 
 def write_baseline(
@@ -49,8 +54,9 @@ def write_baseline(
     """Rewrite the baseline from current findings; returns the entry count.
 
     ``tool`` labels the producing tier ("graftlint" for the AST pass,
-    "graftaudit" for the program pass, "memaudit" for the memory/comms pass) —
-    all share this format and ratchet. ``estimates`` (memaudit only) adds the
+    "graftaudit" for the program pass, "memaudit" for the memory/comms pass,
+    "graftflow" for the interprocedural dataflow pass) — all share this
+    format and ratchet. ``estimates`` (memaudit only) adds the
     ratcheted per-program-label estimate table
     (``{label: {peak_bytes, ici_bytes, dcn_bytes}}``) the tolerance band
     compares against.

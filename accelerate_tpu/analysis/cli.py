@@ -65,6 +65,11 @@ def build_arg_parser(parser: Optional[argparse.ArgumentParser] = None) -> argpar
         help="with --check: skip the graftmem memory/comms gate",
     )
     parser.add_argument(
+        "--skip-flow",
+        action="store_true",
+        help="with --check: skip the graftflow interprocedural dataflow gate",
+    )
+    parser.add_argument(
         "--list-rules", action="store_true", help="print the rule catalog and exit"
     )
     return parser
@@ -174,11 +179,25 @@ def run_cli(args, out=None) -> int:
             rc = max(rc, 1)
         else:
             print("graftlint: docs/api is fresh", file=out)
+    if args.check and not getattr(args, "skip_flow", False):
+        rc = max(rc, flow_gate(out=out))
     if args.check and not getattr(args, "skip_audit", False):
         rc = max(rc, audit_gate(out=out))
     if args.check and not getattr(args, "skip_memaudit", False):
         rc = max(rc, memaudit_gate(out=out))
     return rc
+
+
+def flow_gate(out=None) -> int:
+    """Run the graftflow interprocedural dataflow gate in-process (ISSUE 19
+    tentpole). Unlike the audit/memaudit gates there is no subprocess: the
+    flow tier is stdlib ``ast`` like the lint tier itself, so running it here
+    preserves the no-jax-import guarantee."""
+    out = out if out is not None else sys.stderr
+    from .flow.cli import build_arg_parser as flow_arg_parser
+    from .flow.cli import run_cli as flow_run_cli
+
+    return flow_run_cli(flow_arg_parser().parse_args(["--check"]), out=out)
 
 
 def audit_gate(root: str = REPO_ROOT, out=None, timeout: int = 300) -> int:

@@ -94,7 +94,11 @@ class Rule:
 
 # --------------------------------------------------------------------- suppressions
 
-_SUPPRESS_RE = re.compile(r"#\s*graftlint:\s*disable=(.*)$")
+#: Both AST tiers share one suppression grammar: ``# graftlint: disable=...``
+#: and ``# graftflow: disable=...`` parse identically (each tier validates
+#: against the union of both tiers' rule ids, so a flow suppression is never
+#: a lint ``bad-suppression`` and vice versa).
+_SUPPRESS_RE = re.compile(r"#\s*graft(?:lint|flow):\s*disable=(.*)$")
 _ITEM_RE = re.compile(r"\s*([A-Za-z][\w-]*)\s*(?:\(([^()]*)\))?\s*(?:,|$)")
 
 
@@ -153,7 +157,7 @@ def _suppression_errors(unit: FileUnit, sups: List[Suppression], known: set) -> 
                     path=unit.path,
                     line=s.line,
                     message=f"suppression names unknown rule '{s.rule}' "
-                    f"(known: {', '.join(sorted(known))})",
+                    f"({format_rule_catalog()})",
                     code=unit.line_text(s.line),
                 )
             )
@@ -310,9 +314,66 @@ def run_lint(
 
 
 def known_rule_ids(rules: Optional[Sequence[Rule]] = None) -> set:
-    """Every id a suppression comment may legally name (registry + engine-level ids)."""
+    """Every id a suppression comment may legally name: the graftlint registry,
+    the graftflow registry (the two tiers share one comment grammar, so each
+    must recognize the other's ids), plus the engine-level ids."""
     if rules is None:
         from .rules import all_rules
 
         rules = all_rules()
-    return {r.id for r in rules} | {"parse-error", "bad-suppression"}
+    from .flow import flow_rules
+
+    return (
+        {r.id for r in rules}
+        | {r.id for r in flow_rules()}
+        | {"parse-error", "bad-suppression"}
+    )
+
+
+def rule_catalog() -> dict:
+    """tier name → sorted rule ids, across all four analysis tiers.
+
+    Stdlib-only by construction, so error messages anywhere in the stack can
+    point a misdirected suppression at the tier that owns the rule. The
+    program-tier registries (``program/rules.py``, ``program/memory.py``) are
+    themselves stdlib modules, but ``program/__init__`` imports jax via
+    ``.lowering`` — so when the package isn't already loaded, a stub package
+    (same trick as ``graftlint.py``'s repo-root stub) lets the registry
+    modules import without executing that ``__init__``.
+    """
+    import sys
+    import types
+
+    from .flow import flow_rules
+    from .rules import all_rules
+
+    pkg = __package__ + ".program"
+    stubbed = pkg not in sys.modules
+    if stubbed:
+        stub = types.ModuleType(pkg)
+        stub.__path__ = [os.path.join(os.path.dirname(__file__), "program")]
+        sys.modules[pkg] = stub
+    try:
+        from .program.memory import all_memory_rules
+        from .program.rules import all_program_rules
+    finally:
+        if stubbed:
+            # Drop the stub so a later real `import ...program` still runs the
+            # package __init__ (the cached registry submodules stay valid).
+            sys.modules.pop(pkg, None)
+
+    return {
+        "graftlint": sorted(
+            {r.id for r in all_rules()} | {"parse-error", "bad-suppression"}
+        ),
+        "graftflow": sorted(r.id for r in flow_rules()),
+        "graftaudit": sorted(r.id for r in all_program_rules()),
+        "graftmem": sorted(r.id for r in all_memory_rules()),
+    }
+
+
+def format_rule_catalog() -> str:
+    """One-line ``tier: id, id, ...; tier: ...`` listing for error messages."""
+    return "; ".join(
+        f"{tier}: {', '.join(ids)}" for tier, ids in rule_catalog().items()
+    )

@@ -1,0 +1,81 @@
+"""graftflow — interprocedural dataflow tier for the host control plane.
+
+The fourth analysis tier (after graftlint's per-file AST pass, graftaudit's
+traced-program pass, and graftmem's memory/comms pass): a module-level call
+graph + per-function CFGs with exception edges + a worklist abstract
+interpreter, running three incident-derived rule packs over the host-side
+serving/telemetry/elastic package:
+
+- ``flow-clock-domain``  — wall-clock reach & cross-domain value flow in
+  clock-injectable components (the PR-17 bug class), ``clock_domain.py``
+- ``flow-ownership``     — borrow-checker discipline for BlockManager pages
+  (PR-9 double releases, PR-10 zombie lanes), ``ownership.py``
+- ``flow-key-schedule``  — rng-key reuse across call boundaries,
+  ``key_schedule.py``
+
+Everything is stdlib ``ast`` over source text — no jax import, <10 s —
+and findings ride the graftlint engine (``run_lint`` with the flow rule
+set), so ``# graftflow: disable=<rule>(<reason>)`` comments, the
+``bad-suppression`` contract, and the ratcheted-baseline machinery
+(``graftflow_baseline.json``, empty at HEAD) all work identically.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ..engine import REPO_ROOT, FileUnit, Finding, run_lint
+
+__all__ = ["FLOW_PATHS", "flow_rules", "run_flow", "ProgramCache"]
+
+#: The host control plane graftflow covers: serving + paging + gateway +
+#: telemetry + supervision. Compiled-side code (parallel/, ops/, models/) is
+#: the program tiers' territory; commands/ and launchers are process entry
+#: points with no protocol state worth this machinery.
+FLOW_PATHS = (
+    "accelerate_tpu/serving.py",
+    "accelerate_tpu/paged_kv.py",
+    "accelerate_tpu/serving_gateway",
+    "accelerate_tpu/telemetry",
+    "accelerate_tpu/elastic.py",
+    "accelerate_tpu/resilience",
+    "accelerate_tpu/spec_decode.py",
+    "accelerate_tpu/generation.py",
+)
+
+
+class ProgramCache:
+    """One FlowProgram (symbol tables + call graph + CFGs) shared by the three
+    rule packs of a run — each pack's ``finalize`` receives the same unit list,
+    so the graph is built once, not three times."""
+
+    def __init__(self):
+        self._key = None
+        self._program = None
+
+    def get(self, units: Sequence[FileUnit]):
+        from .callgraph import FlowProgram
+
+        key = tuple((u.path, len(u.source)) for u in units)
+        if key != self._key:
+            self._key = key
+            self._program = FlowProgram(units)
+        return self._program
+
+
+def flow_rules(cache: Optional[ProgramCache] = None) -> list:
+    """Fresh flow rule instances sharing one program cache."""
+    from .clock_domain import ClockDomainRule
+    from .key_schedule import KeyScheduleRule
+    from .ownership import OwnershipRule
+
+    cache = cache or ProgramCache()
+    return [ClockDomainRule(cache), OwnershipRule(cache), KeyScheduleRule(cache)]
+
+
+def run_flow(
+    paths: Sequence[str] = FLOW_PATHS, root: str = REPO_ROOT
+) -> List[Finding]:
+    """Run the graftflow rule packs over ``paths``; suppression comments and
+    ``bad-suppression`` validation ride the shared engine."""
+    return run_lint(paths=paths, root=root, rules=flow_rules())

@@ -1,0 +1,80 @@
+"""Worklist abstract interpretation over the graftflow CFG.
+
+The engine is generic: a rule pack supplies a pure ``transfer(node, state)``
+and the state shape; the fixpoint machinery here is shared. States are plain
+dicts mapping variable names to *immutable* lattice values (frozensets /
+tuples), joined key-wise by set union — every pack's lattice is a finite
+powerset, so the fixpoint terminates by monotonicity.
+
+Edge semantics (see ``cfg.py``): a normal edge propagates the *post*-state
+(``transfer`` applied), an exception edge propagates the *pre*-state — an
+exception may fire before the statement's effect landed, and assuming the
+effect did NOT happen is the safe direction for every pack here (a leak
+check that assumed a release completed would miss the exception-path leak
+this tier exists to catch).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable, Dict, Tuple
+
+from .cfg import CFG, Node
+
+__all__ = ["run_dataflow", "join_states"]
+
+State = Dict[str, frozenset]
+
+
+def join_states(a: State, b: State) -> State:
+    """Key-wise union: a variable absent from one side keeps the other's value
+    (absence means "not tracked", not "bottom" — joining with untracked must
+    not erase what the tracked path knows)."""
+    out = dict(a)
+    for k, v in b.items():
+        prev = out.get(k)
+        out[k] = v if prev is None else _join_value(prev, v)
+    return out
+
+
+def _join_value(a, b):
+    if a == b:
+        return a
+    if isinstance(a, tuple) and isinstance(b, tuple) and len(a) == len(b) == 2:
+        # (statuses, first-line) pairs: union the statuses, keep the earliest line.
+        return (a[0] | b[0], min(a[1], b[1]))
+    return a | b
+
+
+def run_dataflow(
+    cfg: CFG,
+    init: State,
+    transfer: Callable[[Node, State], State],
+) -> Tuple[Dict[int, State], Dict[int, State]]:
+    """Forward fixpoint; returns ``(in_states, out_states)`` by node index.
+
+    Unreached nodes are absent from both maps. ``transfer`` must not mutate
+    its input state.
+    """
+    in_s: Dict[int, State] = {cfg.entry: dict(init)}
+    out_s: Dict[int, State] = {}
+    wl = deque([cfg.entry])
+    on_wl = {cfg.entry}
+    while wl:
+        i = wl.popleft()
+        on_wl.discard(i)
+        s = in_s.get(i)
+        if s is None:
+            continue
+        o = transfer(cfg.nodes[i], s)
+        out_s[i] = o
+        for j, is_exc in cfg.succs[i]:
+            carry = s if is_exc else o
+            cur = in_s.get(j)
+            new = dict(carry) if cur is None else join_states(cur, carry)
+            if cur is None or new != cur:
+                in_s[j] = new
+                if j not in on_wl:
+                    wl.append(j)
+                    on_wl.add(j)
+    return in_s, out_s

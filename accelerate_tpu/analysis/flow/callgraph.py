@@ -1,0 +1,297 @@
+"""Module-level call graph for the host control plane (stdlib ``ast`` only).
+
+Resolution is deliberately *best effort and in-package*: graftflow analyzes
+protocols between our own components, so a call that cannot be resolved to a
+function in the analyzed unit set simply yields ``None`` and the rule packs
+fall back to their conservative local story. What IS resolved:
+
+- ``f(...)``                 — module function, imported function, or class
+                               constructor (→ its ``__init__``)
+- ``self.m(...)``            — method on the enclosing class or its in-package
+                               bases
+- ``self.attr.m(...)``       — method on the class ``self.attr`` was
+                               constructed with (``self.attr = Cls(...)`` in
+                               any method, including via ``x or Cls(...)`` /
+                               ternary fallbacks)
+- ``mod.f(...)`` / ``Cls.m(...)`` — through the import table
+
+Instance-attribute types come from construction sites only — annotations are
+not trusted (they lie more often than constructors do in this codebase).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set
+
+from ..astutil import dotted
+from ..engine import FileUnit
+from .cfg import CFG, build_cfg
+
+__all__ = ["FlowProgram", "FuncInfo", "ClassInfo", "ModuleInfo", "module_name_for"]
+
+
+def module_name_for(path: str) -> str:
+    """Repo-relative posix path → dotted module name (``__init__`` folds up)."""
+    mod = path[:-3] if path.endswith(".py") else path
+    parts = mod.split("/")
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One function or method in the analyzed unit set."""
+
+    qualname: str  # "pkg.mod.func" or "pkg.mod.Cls.method"
+    module: str
+    cls: Optional[str]  # plain class name, None for module-level functions
+    name: str
+    node: ast.AST  # FunctionDef | AsyncFunctionDef
+    unit: FileUnit
+
+
+@dataclasses.dataclass
+class ClassInfo:
+    qualname: str
+    module: str
+    name: str
+    node: ast.ClassDef
+    unit: FileUnit
+    bases: List[str]  # dotted base expressions, unresolved
+    methods: Dict[str, FuncInfo]
+    attr_types: Dict[str, str]  # self.<attr> -> ClassInfo.qualname
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    name: str
+    unit: FileUnit
+    imports: Dict[str, str]  # local name -> dotted target
+    functions: Dict[str, FuncInfo]
+    classes: Dict[str, ClassInfo]
+
+
+def _relative_base(module: str, level: int, unit: FileUnit) -> str:
+    """Package prefix a ``from ...x import y`` resolves against."""
+    parts = module.split(".")
+    is_pkg = unit.path.endswith("/__init__.py")
+    # level 1 = current package: drop the module's own leaf unless it IS a package.
+    drop = level - (1 if is_pkg else 0)
+    if drop > 0:
+        parts = parts[:-drop] if drop < len(parts) else []
+    return ".".join(parts)
+
+
+class FlowProgram:
+    """Symbol tables + call resolution + memoized per-function CFGs."""
+
+    def __init__(self, units: Sequence[FileUnit]):
+        self.units = [u for u in units if not u.is_test]
+        self.modules: Dict[str, ModuleInfo] = {}
+        #: Every function/method, by qualname (reporting + summary keys).
+        self.functions: Dict[str, FuncInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self._cfgs: Dict[str, CFG] = {}
+        for u in self.units:
+            self._index_unit(u)
+        for m in self.modules.values():
+            for c in m.classes.values():
+                self._infer_attr_types(m, c)
+
+    # ------------------------------------------------------------------ indexing
+    def _index_unit(self, unit: FileUnit) -> None:
+        mod = module_name_for(unit.path)
+        info = ModuleInfo(mod, unit, {}, {}, {})
+        self.modules[mod] = info
+        for node in unit.tree.body:
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    info.imports[alias.asname or alias.name.split(".")[0]] = (
+                        alias.name if alias.asname else alias.name.split(".")[0]
+                    )
+                    if alias.asname:
+                        info.imports[alias.asname] = alias.name
+            elif isinstance(node, ast.ImportFrom):
+                base = (
+                    _relative_base(mod, node.level, unit) if node.level else ""
+                )
+                target_mod = ".".join(p for p in (base, node.module or "") if p)
+                for alias in node.names:
+                    if alias.name == "*":
+                        continue
+                    info.imports[alias.asname or alias.name] = (
+                        f"{target_mod}.{alias.name}" if target_mod else alias.name
+                    )
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FuncInfo(f"{mod}.{node.name}", mod, None, node.name, node, unit)
+                info.functions[node.name] = fi
+                self.functions[fi.qualname] = fi
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(
+                    qualname=f"{mod}.{node.name}",
+                    module=mod,
+                    name=node.name,
+                    node=node,
+                    unit=unit,
+                    bases=[d for d in (dotted(b) for b in node.bases) if d],
+                    methods={},
+                    attr_types={},
+                )
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        fi = FuncInfo(
+                            f"{ci.qualname}.{item.name}", mod, node.name,
+                            item.name, item, unit,
+                        )
+                        ci.methods[item.name] = fi
+                        self.functions[fi.qualname] = fi
+                info.classes[node.name] = ci
+                self.classes[ci.qualname] = ci
+
+    def _infer_attr_types(self, m: ModuleInfo, c: ClassInfo) -> None:
+        """``self.attr = Cls(...)`` anywhere in the class → attr_types entry."""
+        for fi in c.methods.values():
+            for stmt in ast.walk(fi.node):
+                if not isinstance(stmt, ast.Assign):
+                    continue
+                for t in stmt.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"
+                    ):
+                        ci = self._constructed_class(m, stmt.value)
+                        if ci is not None:
+                            c.attr_types.setdefault(t.attr, ci.qualname)
+
+    def _constructed_class(self, m: ModuleInfo, expr: ast.AST) -> Optional[ClassInfo]:
+        """The ClassInfo an expression constructs, looking through ``x or
+        Cls(...)`` and ``Cls(...) if c else other`` fallback shapes."""
+        if isinstance(expr, ast.Call):
+            target = self.resolve_symbol(m.name, dotted(expr.func) or "")
+            if isinstance(target, ClassInfo):
+                return target
+            return None
+        if isinstance(expr, ast.BoolOp):
+            for v in expr.values:
+                got = self._constructed_class(m, v)
+                if got is not None:
+                    return got
+        if isinstance(expr, ast.IfExp):
+            for v in (expr.body, expr.orelse):
+                got = self._constructed_class(m, v)
+                if got is not None:
+                    return got
+        return None
+
+    # ------------------------------------------------------------------ resolution
+    def resolve_symbol(self, module: str, name: str):
+        """Dotted name as seen from ``module`` → FuncInfo | ClassInfo | module
+        name string | None."""
+        if not name:
+            return None
+        m = self.modules.get(module)
+        if m is None:
+            return None
+        head, _, rest = name.partition(".")
+        if head in m.functions and not rest:
+            return m.functions[head]
+        if head in m.classes:
+            ci = m.classes[head]
+            return self._class_member(ci, rest) if rest else ci
+        target = m.imports.get(head)
+        if target is None:
+            return None
+        return self._resolve_dotted(target + (("." + rest) if rest else ""))
+
+    def _resolve_dotted(self, dotted_name: str):
+        """Absolute dotted name → FuncInfo | ClassInfo | module name | None."""
+        if dotted_name in self.modules:
+            return dotted_name
+        parts = dotted_name.split(".")
+        for cut in range(len(parts) - 1, 0, -1):
+            mod = ".".join(parts[:cut])
+            if mod not in self.modules:
+                continue
+            m = self.modules[mod]
+            leaf, rest = parts[cut], parts[cut + 1:]
+            if leaf in m.functions and not rest:
+                return m.functions[leaf]
+            if leaf in m.classes:
+                ci = m.classes[leaf]
+                return self._class_member(ci, ".".join(rest)) if rest else ci
+            # Re-exported name (pkg __init__ importing from a sibling).
+            if leaf in m.imports:
+                tail = ".".join([m.imports[leaf]] + rest)
+                if tail != dotted_name:
+                    return self._resolve_dotted(tail)
+            return None
+        return None
+
+    def _class_member(self, ci: ClassInfo, member: str) -> Optional[FuncInfo]:
+        if not member or "." in member:
+            return None
+        return self.method(ci, member)
+
+    def method(self, ci: ClassInfo, name: str, _seen: Optional[Set[str]] = None) -> Optional[FuncInfo]:
+        """Method lookup through in-package bases (cycle-guarded)."""
+        seen = _seen or set()
+        if ci.qualname in seen:
+            return None
+        seen.add(ci.qualname)
+        if name in ci.methods:
+            return ci.methods[name]
+        for b in ci.bases:
+            base = self.resolve_symbol(ci.module, b)
+            if isinstance(base, ClassInfo):
+                got = self.method(base, name, seen)
+                if got is not None:
+                    return got
+        return None
+
+    def resolve_call(self, caller: FuncInfo, call: ast.Call) -> Optional[FuncInfo]:
+        """Best-effort callee of ``call`` as written inside ``caller``."""
+        func = call.func
+        name = dotted(func)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if parts[0] == "self" and caller.cls is not None:
+            ci = self.classes.get(f"{caller.module}.{caller.cls}")
+            if ci is None:
+                return None
+            if len(parts) == 2:  # self.m()
+                return self.method(ci, parts[1])
+            if len(parts) == 3:  # self.attr.m()
+                attr_cls = ci.attr_types.get(parts[1])
+                if attr_cls is not None and attr_cls in self.classes:
+                    return self.method(self.classes[attr_cls], parts[2])
+            return None
+        got = self.resolve_symbol(caller.module, name)
+        if isinstance(got, FuncInfo):
+            return got
+        if isinstance(got, ClassInfo):
+            return self.method(got, "__init__")
+        return None
+
+    # ------------------------------------------------------------------ CFGs
+    def cfg(self, fi: FuncInfo) -> CFG:
+        got = self._cfgs.get(fi.qualname)
+        if got is None:
+            got = self._cfgs[fi.qualname] = build_cfg(fi.node)
+        return got
+
+    def class_of(self, fi: FuncInfo) -> Optional[ClassInfo]:
+        if fi.cls is None:
+            return None
+        return self.classes.get(f"{fi.module}.{fi.cls}")
+
+    def iter_functions(self):
+        """Deterministic iteration order (path, lineno)."""
+        return sorted(
+            self.functions.values(),
+            key=lambda f: (f.unit.path, f.node.lineno, f.qualname),
+        )

@@ -1,0 +1,246 @@
+"""Per-function control-flow graphs with exception edges (graftflow core).
+
+One CFG node per *statement header*: a compound statement (``if``/``while``/
+``for``/``with``/``try``/``match``) contributes a node for its header
+expression only — its body statements get their own nodes, wired by the
+builder. Three virtual nodes frame every function: ``entry``, ``exit``
+(normal return / fall-off-the-end) and ``exc_exit`` (an exception escaping
+the function).
+
+Exception edges are deliberately conservative in the *cheap* direction:
+
+- A statement gets an exception edge ONLY while lexically inside a ``try``
+  body (to the handler dispatch / finally). Outside a ``try`` nothing
+  observes the exception, so modelling it would only manufacture paths no
+  rule could act on (every call can raise; flagging every such path would
+  drown real findings).
+- An exception edge carries the statement's *pre*-state in the dataflow
+  (``absint.run_dataflow``): the statement may have raised before its
+  effect landed, so the safe assumption for leak detection is "nothing this
+  statement does happened yet".
+- A handler set without a catch-all (``except:`` / ``except Exception`` /
+  ``except BaseException``) also routes the exception outward (the raised
+  type may match no handler); with a catch-all, the outward edge is dropped
+  — that is what makes ``try: x = acquire() except Exception: return`` a
+  *clean* shape instead of a false leak.
+- ``finally`` is built once and joined from both the normal and the
+  exceptional side; its exit continues to both the fall-through successor
+  and the enclosing exception target. That over-approximates (a finally
+  reached normally cannot re-raise the absent exception) but every
+  over-approximate path carries a state some real path produced, so rules
+  stay sound for their must-analyses.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..astutil import dotted
+
+__all__ = ["CFG", "Node", "build_cfg", "header_exprs", "ENTRY", "EXIT", "EXC_EXIT"]
+
+ENTRY = "entry"
+EXIT = "exit"
+EXC_EXIT = "exc-exit"
+
+#: Handler types that catch ANY exception — their presence removes the
+#: "matched no handler" outward edge.
+_CATCH_ALL = ("Exception", "BaseException")
+
+
+@dataclasses.dataclass
+class Node:
+    """One CFG node: a statement header, an except-handler head, or a virtual
+    entry/exit marker (``stmt is None`` for virtual and join nodes)."""
+
+    idx: int
+    stmt: Optional[ast.AST]
+    tag: str  # "stmt" | "except" | "exc-join" | ENTRY | EXIT | EXC_EXIT
+
+
+class CFG:
+    """Nodes + labelled edges; ``succs[i]`` is ``[(succ_idx, is_exc_edge)]``."""
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.nodes: List[Node] = []
+        self.succs: Dict[int, List[Tuple[int, bool]]] = {}
+        self.entry = self.new_node(None, ENTRY)
+        self.exit = self.new_node(None, EXIT)
+        self.exc_exit = self.new_node(None, EXC_EXIT)
+
+    def new_node(self, stmt: Optional[ast.AST], tag: str = "stmt") -> int:
+        n = Node(len(self.nodes), stmt, tag)
+        self.nodes.append(n)
+        self.succs[n.idx] = []
+        return n.idx
+
+    def add_edge(self, a: int, b: int, exc: bool = False) -> None:
+        if (b, exc) not in self.succs[a]:
+            self.succs[a].append((b, exc))
+
+
+@dataclasses.dataclass
+class _Loop:
+    header: int
+    breaks: Set[int] = dataclasses.field(default_factory=set)
+
+
+class _Builder:
+    def __init__(self, g: CFG):
+        self.g = g
+        self.loops: List[_Loop] = []
+        #: Innermost exception target while inside a try body (an exc-join node).
+        self.exc_stack: List[int] = []
+
+    # ------------------------------------------------------------------ helpers
+    def _exc_target(self) -> int:
+        return self.exc_stack[-1] if self.exc_stack else self.g.exc_exit
+
+    def _place(self, s: ast.AST, preds: Set[int], tag: str = "stmt") -> int:
+        """New node for ``s``, wired from every pred; exception edge only when
+        lexically inside a try body (see module docstring)."""
+        n = self.g.new_node(s, tag)
+        for p in preds:
+            self.g.add_edge(p, n)
+        if self.exc_stack:
+            self.g.add_edge(n, self.exc_stack[-1], exc=True)
+        return n
+
+    # ------------------------------------------------------------------ sequencing
+    def seq(self, stmts: List[ast.stmt], preds: Set[int]) -> Set[int]:
+        out = set(preds)
+        for s in stmts:
+            out = self.stmt(s, out)
+        return out
+
+    def stmt(self, s: ast.stmt, preds: Set[int]) -> Set[int]:
+        if not preds:  # unreachable code after return/raise/break
+            return set()
+        if isinstance(s, ast.If):
+            n = self._place(s, preds)
+            t_out = self.seq(s.body, {n})
+            e_out = self.seq(s.orelse, {n}) if s.orelse else {n}
+            return t_out | e_out
+        if isinstance(s, (ast.While, ast.For, ast.AsyncFor)):
+            n = self._place(s, preds)
+            self.loops.append(_Loop(header=n))
+            body_out = self.seq(s.body, {n})
+            loop = self.loops.pop()
+            for o in body_out:
+                self.g.add_edge(o, n)
+            else_out = self.seq(s.orelse, {n}) if s.orelse else {n}
+            return else_out | loop.breaks
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            n = self._place(s, preds)
+            return self.seq(s.body, {n})
+        if isinstance(s, ast.Try):
+            return self._try(s, preds)
+        if isinstance(s, ast.Match):
+            n = self._place(s, preds)
+            outs: Set[int] = {n}  # no case may match — fall through
+            for case in s.cases:
+                outs |= self.seq(case.body, {n})
+            return outs
+        if isinstance(s, ast.Return):
+            n = self._place(s, preds)
+            self.g.add_edge(n, self.g.exit)
+            return set()
+        if isinstance(s, ast.Raise):
+            n = self._place(s, preds)
+            self.g.add_edge(n, self._exc_target())
+            return set()
+        if isinstance(s, ast.Break):
+            n = self._place(s, preds)
+            if self.loops:
+                self.loops[-1].breaks.add(n)
+            return set()
+        if isinstance(s, ast.Continue):
+            n = self._place(s, preds)
+            if self.loops:
+                self.g.add_edge(n, self.loops[-1].header)
+            return set()
+        # Simple statements (and nested def/class, opaque here): one node.
+        return {self._place(s, preds)}
+
+    def _try(self, s: ast.Try, preds: Set[int]) -> Set[int]:
+        # All body-statement exception edges meet at one virtual join; handler
+        # dispatch and the uncaught-propagation edge fan out from there.
+        exc_join = self.g.new_node(s, tag="exc-join")
+        self.exc_stack.append(exc_join)
+        body_out = self.seq(s.body, preds)
+        self.exc_stack.pop()
+        if s.orelse:
+            # orelse runs after a *clean* body; its exceptions belong to the
+            # ENCLOSING context (handlers of this try do not cover it), which
+            # is exactly what the popped exc_stack now expresses.
+            body_out = self.seq(s.orelse, body_out)
+
+        after: Set[int] = set(body_out)
+        uncaught: Set[int] = set()
+        catch_all = False
+        if s.handlers:
+            for h in s.handlers:
+                hn = self.g.new_node(h, tag="except")
+                self.g.add_edge(exc_join, hn)
+                after |= self.seq(h.body, {hn})
+                names = [dotted(t) or "" for t in (
+                    h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+                )] if h.type is not None else [""]
+                if h.type is None or any(
+                    n.split(".")[-1] in _CATCH_ALL for n in names
+                ):
+                    catch_all = True
+            if not catch_all:
+                uncaught.add(exc_join)
+        else:
+            uncaught.add(exc_join)
+
+        if s.finalbody:
+            fin_entry = self.g.new_node(s, tag="exc-join")  # stateless join
+            for src in after | uncaught:
+                self.g.add_edge(src, fin_entry)
+            fin_out = self.seq(s.finalbody, {fin_entry})
+            # The finally's exit continues BOTH ways: fall through (normal
+            # entry) and re-raise (exceptional entry). Over-approximate with
+            # both edges; states are honest either way.
+            for o in fin_out:
+                self.g.add_edge(o, self._exc_target())
+            return fin_out
+        for src in uncaught:
+            self.g.add_edge(src, self._exc_target())
+        return after
+
+
+def header_exprs(s: ast.AST) -> list:
+    """The expressions evaluated AT a statement's CFG node.
+
+    A compound statement's node represents its *header* only — the body
+    statements have their own nodes — so a transfer/reporting pass must walk
+    these sub-expressions, never ``ast.walk(stmt)`` (which would double-count
+    every body statement with the header's state). Nested function/class
+    definitions are opaque: their bodies run at call time, not here.
+    """
+    if isinstance(s, (ast.If, ast.While)):
+        return [s.test]
+    if isinstance(s, (ast.For, ast.AsyncFor)):
+        return [s.iter]
+    if isinstance(s, (ast.With, ast.AsyncWith)):
+        return [it.context_expr for it in s.items]
+    if isinstance(s, ast.Match):
+        return [s.subject]
+    if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+        return []
+    return [s]
+
+
+def build_cfg(fn: ast.AST) -> CFG:
+    """CFG for one ``FunctionDef`` / ``AsyncFunctionDef``."""
+    g = CFG(fn)
+    b = _Builder(g)
+    outs = b.seq(fn.body, {g.entry})
+    for o in outs:
+        g.add_edge(o, g.exit)
+    return g

@@ -1,0 +1,112 @@
+"""graftflow CLI: ``python -m accelerate_tpu flow [--check|--baseline]``.
+
+Same exit-code contract as the lint CLI (0 clean, 1 new findings, 2 usage
+error) and the same ratchet: ``graftflow_baseline.json`` is empty at HEAD and
+only shrinks. Stdlib-only — the analyzed modules are never imported (run via
+``python graftlint.py --flow`` for the jax-free guarantee end to end).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import Optional, Sequence
+
+from ..baseline import apply_baseline, load_baseline, write_baseline
+from ..engine import REPO_ROOT
+from . import FLOW_PATHS, flow_rules, run_flow
+
+__all__ = ["FLOW_BASELINE_FILE", "build_arg_parser", "main", "run_cli"]
+
+FLOW_BASELINE_FILE = os.path.join(REPO_ROOT, "graftflow_baseline.json")
+
+
+def build_arg_parser(
+    parser: Optional[argparse.ArgumentParser] = None,
+) -> argparse.ArgumentParser:
+    if parser is None:
+        parser = argparse.ArgumentParser(
+            "graftflow",
+            description="Interprocedural dataflow tier for the host control "
+            "plane: clock domains, page ownership, key schedules "
+            "(no TPU, no jax import, <10 s).",
+        )
+    parser.add_argument(
+        "paths",
+        nargs="*",
+        default=None,
+        help=f"files/dirs to analyze (default: {' '.join(FLOW_PATHS)})",
+    )
+    parser.add_argument(
+        "--check",
+        action="store_true",
+        help="CI gate: fail on findings beyond the baseline",
+    )
+    parser.add_argument(
+        "--baseline",
+        action="store_true",
+        help="rewrite graftflow_baseline.json from the current findings (ratchet reset)",
+    )
+    parser.add_argument(
+        "--baseline-file",
+        default=FLOW_BASELINE_FILE,
+        help="alternate baseline path (default: repo-root graftflow_baseline.json)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print the rule catalog and exit"
+    )
+    return parser
+
+
+def main(argv: Optional[Sequence[str]] = None, out=None) -> int:
+    args = build_arg_parser().parse_args(argv)
+    return run_cli(args, out=out)
+
+
+def run_cli(args, out=None) -> int:
+    """Shared implementation for the standalone and ``accelerate-tpu flow`` entries."""
+    out = out if out is not None else sys.stdout
+
+    if args.list_rules:
+        for r in flow_rules():
+            print(f"{r.id:24s} {r.severity:8s} {r.description}", file=out)
+        return 0
+
+    paths = args.paths or FLOW_PATHS
+    try:
+        findings = run_flow(paths=paths)
+    except FileNotFoundError as e:
+        print(str(e), file=out)
+        return 2
+
+    if args.baseline:
+        n = write_baseline(findings, args.baseline_file, tool="graftflow")
+        print(
+            f"graftflow: wrote {n} grandfathered entr{'y' if n == 1 else 'ies'} "
+            f"({len(findings)} findings) to "
+            f"{os.path.relpath(args.baseline_file, REPO_ROOT)}",
+            file=out,
+        )
+        return 0
+
+    baseline = load_baseline(args.baseline_file)
+    new, grandfathered, stale = apply_baseline(findings, baseline)
+    for f in new:
+        print(f.format(), file=out)
+    if stale:
+        print(
+            f"graftflow: {len(stale)} baseline entries no longer observed — "
+            "ratchet down with `python -m accelerate_tpu flow --baseline`",
+            file=out,
+        )
+    print(
+        f"graftflow: {len(new)} new finding{'s' if len(new) != 1 else ''}, "
+        f"{grandfathered} grandfathered, {len(findings)} total",
+        file=out,
+    )
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

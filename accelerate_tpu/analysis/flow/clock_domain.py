@@ -1,0 +1,340 @@
+"""flow-clock-domain: wall clock reach & cross-domain flow in clock-injectable code.
+
+Incident (PR 17): the flight recorder stamped ring entries with ``time.monotonic``
+while the metrics plane it fed ran on an injected virtual clock — the wall
+stamps landed in the plane's windowed stats and the window trim compared
+wall seconds against virtual seconds, silently purging everything. The class
+of bug is *domain mixing*: a component that accepts ``clock=`` is promising
+its callers that ALL of its time comes from that clock, and any ``time.*``
+reached on a call path — or any wall-stamped value flowing into a
+time-keyed operation — breaks the promise in a way no unit test on the wall
+clock can see.
+
+Three checks, all scoped to *clock components* (a class whose ``__init__``
+takes a ``clock``/``sleep`` parameter, or a module function with a ``clock``
+parameter):
+
+1. **wall default** — the ``clock``/``sleep`` parameter defaults to
+   ``time.monotonic``/``time.time``/``time.perf_counter``/``time.sleep``.
+   Default to ``None`` and resolve through
+   :mod:`accelerate_tpu.telemetry.clocks` instead, so composition (gateway →
+   metrics plane → recorder → tracer) inherits one domain.
+2. **wall reach** — a direct ``time.*`` reference in the component, or in
+   any function transitively reachable from it through ``self.*`` methods
+   and module-level functions (attribute calls on OTHER objects are a
+   domain boundary and deliberately not followed).
+3. **domain mixing** — abstract interpretation over each method's CFG tags
+   values WALL (from ``time.*``) or INJ (from ``self._clock()``/``clock()``);
+   a WALL-only value flowing into a time-keyed argument
+   (``now=``/``t=``/``deadline=``...) or compared/subtracted against an
+   INJ value is the PR-17 finding.
+
+The ONE sanctioned wall-clock source is ``accelerate_tpu/telemetry/clocks.py``
+(the analogue of graftlint's ``fence`` allowlist): that module is skipped and
+reaches into it are not followed.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..astutil import dotted
+from ..engine import FileUnit, Finding, Rule
+from .absint import run_dataflow
+from .callgraph import ClassInfo, FlowProgram, FuncInfo
+from .cfg import header_exprs
+
+__all__ = ["ClockDomainRule", "WALL_NAMES", "SANCTIONED_CLOCK_MODULE"]
+
+#: Wall-clock spellings; a reference to any of these inside a clock component
+#: is a finding (calls and bare references alike — a bare ``time.monotonic``
+#: is a wall fallback about to be stored).
+WALL_NAMES = frozenset({
+    "time.time", "time.monotonic", "time.perf_counter", "time.sleep",
+    "time.time_ns", "time.monotonic_ns", "time.perf_counter_ns",
+})
+#: Wall spellings that *produce a timestamp* (domain tagging).
+_WALL_STAMPS = frozenset({
+    "time.time", "time.monotonic", "time.perf_counter",
+    "time.time_ns", "time.monotonic_ns", "time.perf_counter_ns",
+})
+#: Injected-clock call spellings inside a component method.
+_INJ_CALLS = frozenset({"self._clock", "self.clock", "clock", "_clock", "self._now"})
+#: Argument names that key a window/trim/compare operation by time.
+_TIME_KEYS = frozenset({"now", "t", "t0", "t1", "timestamp", "deadline", "until", "ts"})
+#: Injectable parameter names that make a class/function a clock component.
+_CLOCK_PARAMS = ("clock", "sleep")
+
+#: The one module allowed to name the wall clock (see module docstring).
+SANCTIONED_CLOCK_MODULE = "accelerate_tpu/telemetry/clocks.py"
+
+WALL = "wall"
+INJ = "inj"
+
+
+def _params(fn: ast.AST) -> List[ast.arg]:
+    a = fn.args
+    return list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)
+
+
+def _param_defaults(fn: ast.AST) -> Dict[str, Optional[ast.AST]]:
+    """param name → default expr (None when required)."""
+    a = fn.args
+    out: Dict[str, Optional[ast.AST]] = {}
+    pos = list(a.posonlyargs) + list(a.args)
+    defaults = [None] * (len(pos) - len(a.defaults)) + list(a.defaults)
+    for p, d in zip(pos, defaults):
+        out[p.arg] = d
+    for p, d in zip(a.kwonlyargs, a.kw_defaults):
+        out[p.arg] = d
+    return out
+
+
+class ClockDomainRule(Rule):
+    id = "flow-clock-domain"
+    severity = "error"
+    description = (
+        "clock-injectable component reaches the wall clock, or mixes values "
+        "from different clock domains"
+    )
+
+    def __init__(self, cache):
+        self._cache = cache
+
+    def finalize(self, units: Sequence[FileUnit]):
+        program: FlowProgram = self._cache.get(units)
+        findings: List[Finding] = []
+        components = self._components(program)
+        reported: Set[Tuple[str, int]] = set()
+        for label, roots, clock_params in components:
+            findings.extend(
+                self._check_defaults(label, roots, clock_params, reported)
+            )
+            findings.extend(
+                self._check_wall_reach(program, label, roots, reported)
+            )
+            for fi in roots:
+                findings.extend(self._check_mixing(program, label, fi))
+        return findings
+
+    # --------------------------------------------------------------- components
+    def _components(self, program: FlowProgram):
+        """[(label, [root FuncInfo...], {param_name: default_expr})]."""
+        out = []
+        for fi in program.iter_functions():
+            if fi.unit.path == SANCTIONED_CLOCK_MODULE:
+                continue
+            if fi.cls is None:
+                defaults = _param_defaults(fi.node)
+                if "clock" in defaults:
+                    out.append((fi.qualname, [fi], {"clock": defaults["clock"]}))
+        seen_cls = set()
+        for ci in sorted(program.classes.values(), key=lambda c: (c.unit.path, c.node.lineno)):
+            if ci.unit.path == SANCTIONED_CLOCK_MODULE or ci.qualname in seen_cls:
+                continue
+            seen_cls.add(ci.qualname)
+            init = ci.methods.get("__init__")
+            if init is None:
+                continue
+            defaults = _param_defaults(init.node)
+            clock_params = {p: defaults[p] for p in _CLOCK_PARAMS if p in defaults}
+            if clock_params:
+                roots = [ci.methods[m] for m in sorted(ci.methods)]
+                out.append((ci.qualname, roots, clock_params))
+        return out
+
+    # ----------------------------------------------------------------- defaults
+    def _check_defaults(self, label, roots, clock_params, reported):
+        findings = []
+        fi0 = roots[0]
+        for pname, default in sorted(clock_params.items()):
+            name = dotted(default) if default is not None else None
+            if name in WALL_NAMES:
+                init = next((r for r in roots if r.name == "__init__"), fi0)
+                # One finding per wall default; the wall-reach scan would see
+                # the same expression again (it lives inside __init__'s AST).
+                reported.add((init.unit.path, default.lineno))
+                findings.append(self._make(
+                    init.unit, default,
+                    f"clock-injectable '{label}' defaults {pname}= to wall "
+                    f"'{name}' — default to None and resolve via "
+                    "telemetry.clocks so an injected domain survives "
+                    "composition",
+                ))
+        return findings
+
+    # --------------------------------------------------------------- wall reach
+    def _check_wall_reach(self, program, label, roots, reported):
+        findings = []
+        visited: Set[str] = set()
+        stack: List[Tuple[FuncInfo, Tuple[str, ...]]] = [(r, ()) for r in roots]
+        while stack:
+            fi, via = stack.pop()
+            if fi.qualname in visited:
+                continue
+            visited.add(fi.qualname)
+            if fi.unit.path == SANCTIONED_CLOCK_MODULE:
+                continue
+            for node in ast.walk(fi.node):
+                if isinstance(node, (ast.Attribute, ast.Name)):
+                    name = dotted(node)
+                    if name in WALL_NAMES and isinstance(
+                        getattr(node, "ctx", ast.Load()), ast.Load
+                    ):
+                        key = (fi.unit.path, node.lineno)
+                        if key in reported:
+                            continue
+                        reported.add(key)
+                        path = " -> ".join(via + (fi.name,))
+                        findings.append(self._make(
+                            fi.unit, node,
+                            f"wall '{name}' reached from clock-injectable "
+                            f"'{label}' (via {path}) — use the injected "
+                            "clock, or telemetry.clocks for a sanctioned "
+                            "wall source",
+                        ))
+                if isinstance(node, ast.Call):
+                    callee = self._follow(program, fi, node)
+                    if callee is not None and callee.qualname not in visited:
+                        stack.append((callee, via + (fi.name,)))
+        return findings
+
+    def _follow(self, program, fi, call) -> Optional[FuncInfo]:
+        """Reach follows self-methods and module-level functions ONLY (an
+        attribute call on another object is a domain boundary: that object
+        has its own clock contract and its own component entry)."""
+        name = dotted(call.func)
+        if name is None:
+            return None
+        parts = name.split(".")
+        if parts[0] == "self" and len(parts) == 2:
+            return program.resolve_call(fi, call)
+        if len(parts) <= 2 and parts[0] != "self":
+            got = program.resolve_call(fi, call)
+            if got is not None and got.cls is None:
+                return got
+        return None
+
+    # ------------------------------------------------------------------- mixing
+    def _check_mixing(self, program, label, fi):
+        findings = []
+        cfg = program.cfg(fi)
+        summaries = _ReturnDomains(program)
+
+        def expr_domain(expr, state) -> frozenset:
+            if isinstance(expr, ast.Call):
+                name = dotted(expr.func)
+                if name in _WALL_STAMPS:
+                    return frozenset({WALL})
+                if name in _INJ_CALLS:
+                    return frozenset({INJ})
+                callee = program.resolve_call(fi, expr)
+                if callee is not None:
+                    got = summaries.domain(callee)
+                    if got is not None:
+                        return frozenset({got})
+                return frozenset()
+            if isinstance(expr, ast.Name):
+                return state.get(expr.id, frozenset())
+            if isinstance(expr, ast.BinOp):
+                return expr_domain(expr.left, state) | expr_domain(expr.right, state)
+            if isinstance(expr, ast.IfExp):
+                return expr_domain(expr.body, state) | expr_domain(expr.orelse, state)
+            return frozenset()
+
+        def transfer(node, state):
+            s = node.stmt
+            if node.tag != "stmt" or not isinstance(s, ast.Assign):
+                return state
+            new = dict(state)
+            dom = expr_domain(s.value, state)
+            for t in s.targets:
+                if isinstance(t, ast.Name):
+                    if dom:
+                        new[t.id] = dom
+                    else:
+                        new.pop(t.id, None)
+            return new
+
+        in_states, _ = run_dataflow(cfg, {}, transfer)
+
+        for node in cfg.nodes:
+            state = in_states.get(node.idx)
+            if state is None or node.stmt is None or node.tag != "stmt":
+                continue
+            for expr in (
+                e for root in header_exprs(node.stmt) for e in ast.walk(root)
+            ):
+                if isinstance(expr, (ast.Compare, ast.BinOp)) and (
+                    not isinstance(expr, ast.BinOp)
+                    or isinstance(expr.op, ast.Sub)
+                ):
+                    sides = (
+                        [expr.left] + list(expr.comparators)
+                        if isinstance(expr, ast.Compare)
+                        else [expr.left, expr.right]
+                    )
+                    doms = [expr_domain(e, state) for e in sides]
+                    if (
+                        any(d == frozenset({WALL}) for d in doms)
+                        and any(d == frozenset({INJ}) for d in doms)
+                    ):
+                        findings.append(self._make(
+                            fi.unit, expr,
+                            f"'{label}.{fi.name}' compares/subtracts a wall-"
+                            "stamped value against an injected-clock value — "
+                            "two clock domains in one expression (the PR-17 "
+                            "window-trim bug shape)",
+                        ))
+                if isinstance(expr, ast.Call):
+                    for kw in expr.keywords:
+                        if kw.arg in _TIME_KEYS and expr_domain(
+                            kw.value, state
+                        ) == frozenset({WALL}):
+                            findings.append(self._make(
+                                fi.unit, expr,
+                                f"'{label}.{fi.name}' passes a wall-stamped "
+                                f"value as {kw.arg}= — this component's time "
+                                "authority is its injected clock; stamping "
+                                "from time.* leaks the wall domain into a "
+                                "time-keyed operation",
+                            ))
+        return findings
+
+    def _make(self, unit: FileUnit, node, message: str) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=self.id, severity=self.severity, path=unit.path,
+            line=line, message=message, code=unit.line_text(line),
+        )
+
+
+class _ReturnDomains:
+    """Memoized per-function return-domain summary: 'wall' when every return
+    is a wall stamp, 'inj' when every return reads the injected clock, else
+    None (mixed/unknown)."""
+
+    def __init__(self, program: FlowProgram):
+        self.program = program
+        self._memo: Dict[str, Optional[str]] = {}
+
+    def domain(self, fi: FuncInfo) -> Optional[str]:
+        if fi.qualname in self._memo:
+            return self._memo[fi.qualname]
+        self._memo[fi.qualname] = None  # cycle guard
+        doms = set()
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                if isinstance(node.value, ast.Call):
+                    name = dotted(node.value.func)
+                    if name in _WALL_STAMPS:
+                        doms.add(WALL)
+                        continue
+                    if name in _INJ_CALLS:
+                        doms.add(INJ)
+                        continue
+                doms.add("?")
+        got = doms.pop() if len(doms) == 1 and "?" not in doms else None
+        self._memo[fi.qualname] = got
+        return got

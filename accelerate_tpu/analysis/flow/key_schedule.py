@@ -1,0 +1,267 @@
+"""flow-key-schedule: the rng-key-reuse rule, made interprocedural.
+
+graftlint's local ``rng-key-reuse`` sees a key consumed twice *inside one
+function*. What it cannot see is the call-boundary variant: a caller samples
+with a key AND passes the same key to a helper that samples again — two
+functions, each individually clean, jointly replaying the exact same
+randomness. This pack computes per-callee *consume summaries* (does
+parameter ``p`` get consumed raw by ``jax.random.*`` — or by a deeper callee
+— without a ``split``/``fold_in`` first?) and runs a path-sensitive abstract
+interpretation in each caller: a key variable is FRESH when produced
+(``PRNGKey``/``split``/``fold_in``), and each consumption — local sampler
+call or CONSUMES-summary callee — moves it to consumed. A second consumption
+is a finding **only when at least one side of the pair crosses a call
+boundary**; the purely-local double consume stays the local rule's finding
+(one tier, one owner per finding class).
+
+Deriving is never consuming: ``split``/``fold_in``/indexing produce fresh
+keys, and a callee that only derives from its key parameter is safe to pass
+an already-used-for-derivation key into.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..astutil import dotted
+from ..engine import FileUnit, Finding, Rule
+from ..rules.rng_reuse import _is_key_source
+from .absint import run_dataflow
+from .callgraph import FlowProgram, FuncInfo
+from .cfg import header_exprs
+
+__all__ = ["KeyScheduleRule"]
+
+#: Short names that derive rather than consume (mirrors the local rule).
+_KEY_DERIVING = frozenset({"split", "fold_in", "key_data", "wrap_key_data", "clone"})
+#: Host-side reads that consume no randomness.
+_NON_CONSUMERS = frozenset({
+    "len", "bool", "int", "float", "str", "repr", "print", "isinstance",
+    "type", "hash", "list", "tuple", "sorted", "enumerate", "zip",
+})
+#: Parameter names treated as PRNG keys in callee summaries.
+_KEY_PARAM_NAMES = frozenset({"key", "rng", "rng_key", "prng_key", "sample_key"})
+
+FRESH = "fresh"
+USED_LOCAL = "used-local"    # consumed by a direct jax.random sampler here
+USED_CALL = "used-call"      # consumed inside a callee (summary)
+
+
+def _is_random_consumer(name: Optional[str]) -> bool:
+    """A ``jax.random.X`` (or ``jr.X`` / bare-from-import) sampler call."""
+    if name is None:
+        return False
+    short = name.rsplit(".", 1)[-1]
+    if short in _KEY_DERIVING or short in _NON_CONSUMERS or short == "PRNGKey":
+        return False
+    return "random" in name or name.startswith(("jr.", "jrandom."))
+
+
+class _KeySummaries:
+    """qualname+param → 'consumes' | 'derives' | None (untouched/unknown)."""
+
+    def __init__(self, program: FlowProgram):
+        self.program = program
+        self._memo: Dict[Tuple[str, str], Optional[str]] = {}
+
+    def usage(self, fi: FuncInfo, param: str) -> Optional[str]:
+        key = (fi.qualname, param)
+        if key in self._memo:
+            return self._memo[key]
+        self._memo[key] = None  # cycle guard: assume untouched
+        got = self._scan(fi, param)
+        self._memo[key] = got
+        return got
+
+    def _scan(self, fi: FuncInfo, param: str) -> Optional[str]:
+        derives = False
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            args = list(node.args) + [kw.value for kw in node.keywords]
+            if not any(isinstance(a, ast.Name) and a.id == param for a in args):
+                continue
+            name = dotted(node.func)
+            short = (name or "").rsplit(".", 1)[-1]
+            if short in _KEY_DERIVING:
+                derives = True
+                continue
+            if _is_random_consumer(name):
+                return "consumes"
+            callee = self.program.resolve_call(fi, node)
+            if callee is not None and callee.qualname != fi.qualname:
+                for pos, a in enumerate(node.args):
+                    if isinstance(a, ast.Name) and a.id == param:
+                        pname = _callee_param(callee, pos)
+                        if pname and self.usage(callee, pname) == "consumes":
+                            return "consumes"
+                for kw in node.keywords:
+                    if (
+                        isinstance(kw.value, ast.Name) and kw.value.id == param
+                        and kw.arg and self.usage(callee, kw.arg) == "consumes"
+                    ):
+                        return "consumes"
+        return "derives" if derives else None
+
+
+def _callee_param(fi: FuncInfo, pos: int) -> Optional[str]:
+    a = fi.node.args
+    params = [p.arg for p in list(a.posonlyargs) + list(a.args)]
+    if params and params[0] in ("self", "cls"):
+        params = params[1:]
+    return params[pos] if pos < len(params) else None
+
+
+class KeyScheduleRule(Rule):
+    id = "flow-key-schedule"
+    severity = "error"
+    description = (
+        "PRNG key consumed twice across a caller/callee pair — split or "
+        "index before the key crosses a call boundary"
+    )
+
+    def __init__(self, cache):
+        self._cache = cache
+
+    def finalize(self, units: Sequence[FileUnit]):
+        program: FlowProgram = self._cache.get(units)
+        summaries = _KeySummaries(program)
+        findings: List[Finding] = []
+        for fi in program.iter_functions():
+            findings.extend(self._check_function(program, summaries, fi))
+        return findings
+
+    def _check_function(self, program, summaries, fi):
+        cfg = program.cfg(fi)
+        findings: List[Finding] = []
+        flagged: Set[Tuple[int, str]] = set()
+
+        def consumption(call: ast.Call, var: str) -> Optional[str]:
+            """USED_LOCAL / USED_CALL / 'derive' / None for passing ``var``."""
+            name = dotted(call.func)
+            short = (name or "").rsplit(".", 1)[-1]
+            if short in _KEY_DERIVING:
+                return "derive"
+            if short in _NON_CONSUMERS:
+                return None
+            if _is_random_consumer(name):
+                return USED_LOCAL
+            callee = program.resolve_call(fi, call)
+            if callee is None:
+                return None
+            for pos, a in enumerate(call.args):
+                if isinstance(a, ast.Name) and a.id == var:
+                    pname = _callee_param(callee, pos)
+                    if pname and summaries.usage(callee, pname) == "consumes":
+                        return USED_CALL
+            for kw in call.keywords:
+                if (
+                    isinstance(kw.value, ast.Name) and kw.value.id == var
+                    and kw.arg and summaries.usage(callee, kw.arg) == "consumes"
+                ):
+                    return USED_CALL
+            return None
+
+        def key_events(s: ast.AST):
+            """Ordered (kind, var, call) events at this statement's node."""
+            events = []
+            sources = set()
+            if (
+                isinstance(s, ast.Assign)
+                and isinstance(s.value, ast.Call)
+                and _is_key_source(s.value)
+            ):
+                for t in s.targets:
+                    targets = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                    for el in targets:
+                        if isinstance(el, ast.Name):
+                            sources.add(el.id)
+            for root in header_exprs(s):
+                for node in ast.walk(root):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    seen = set()
+                    for a in list(node.args) + [kw.value for kw in node.keywords]:
+                        if isinstance(a, ast.Name) and a.id not in seen:
+                            seen.add(a.id)
+                            events.append(("use", a.id, node))
+            from ..astutil import assigned_names
+
+            for name in sorted(assigned_names(s)):
+                if name not in sources:
+                    events.append(("rebind", name, s))
+            for name in sorted(sources):
+                events.append(("source", name, s))
+            return events
+
+        def transfer(node, state):
+            if node.stmt is None or node.tag != "stmt":
+                return state
+            new = dict(state)
+            for kind, var, where in key_events(node.stmt):
+                if kind == "source":
+                    new[var] = frozenset({FRESH})
+                elif kind == "rebind":
+                    new.pop(var, None)
+                elif kind == "use" and var in new:
+                    got = consumption(where, var)
+                    if got == USED_LOCAL:
+                        new[var] = new[var] - {FRESH} | {USED_LOCAL}
+                    elif got == USED_CALL:
+                        new[var] = new[var] - {FRESH} | {USED_CALL}
+            return new
+
+        in_states, _ = run_dataflow(cfg, self._param_keys(fi), transfer)
+
+        for node in cfg.nodes:
+            state = in_states.get(node.idx)
+            if state is None or node.stmt is None or node.tag != "stmt":
+                continue
+            for kind, var, where in key_events(node.stmt):
+                if kind != "use":
+                    continue
+                statuses = state.get(var)
+                if not statuses:
+                    continue
+                got = consumption(where, var)
+                if got not in (USED_LOCAL, USED_CALL):
+                    continue
+                already = statuses & {USED_LOCAL, USED_CALL}
+                if not already:
+                    continue
+                # Purely-local double consume belongs to the local rule.
+                if got == USED_LOCAL and already == {USED_LOCAL}:
+                    continue
+                lineno = where.lineno
+                if (lineno, var) in flagged:
+                    continue
+                flagged.add((lineno, var))
+                via = (
+                    "inside a callee" if got == USED_CALL
+                    else "by a local sampler"
+                )
+                findings.append(Finding(
+                    rule=self.id, severity=self.severity, path=fi.unit.path,
+                    line=lineno,
+                    message=(
+                        f"'{fi.qualname}' consumes rng key '{var}' again "
+                        f"{via} after it was already consumed "
+                        f"{'across a call boundary' if USED_CALL in already else 'locally'}"
+                        " — identical randomness on both sides; "
+                        "jax.random.split before the key crosses the call"
+                    ),
+                    code=fi.unit.line_text(lineno),
+                ))
+        return findings
+
+    def _param_keys(self, fi: FuncInfo):
+        """Key-named parameters start FRESH (the caller's schedule hands this
+        function one key; consuming it twice here is still a cross-boundary
+        hazard the local rule misses when one consume is a callee's)."""
+        state = {}
+        a = fi.node.args
+        for p in list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs):
+            if p.arg in _KEY_PARAM_NAMES:
+                state[p.arg] = frozenset({FRESH})
+        return state

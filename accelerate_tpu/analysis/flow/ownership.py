@@ -1,0 +1,419 @@
+"""flow-ownership: borrow-checker discipline for BlockManager pages.
+
+Incidents: PR 9's negative refcounts (a rebuild released pages against the
+wrong pool — a double release the invariant checker only caught at runtime)
+and PR 10's zombie lanes (terminal paths that forgot to finalize, leaving
+pages invisible to ``evict_slot``). The ownership model is BlockManager's
+documented contract (``paged_kv.py``):
+
+- ``detach_slot`` / ``import_pages`` / ``take_copy_page`` / ``_take`` return
+  *owned* page values — the caller MUST consume them on every CFG path,
+  exception edges included, by releasing (``.release(...)``), transferring
+  (storing into an attribute/container/constructor, returning, or passing to
+  a callee whose own body consumes that parameter), or the pages leak.
+- Transfers are linear: using a value after it was released/transferred —
+  or releasing it twice — is a finding.
+- ``admit`` is lane-keyed (the manager owns the lane's pages), so it is not
+  value-tracked; instead a class that acquires pages but has NO reachable
+  release anywhere is flagged (the zombie-lane class).
+
+The analysis is a per-function abstract interpretation over the CFG with
+interprocedural consume summaries. Statuses per tracked variable:
+
+- ``owned``    — live acquisition, not yet consumed
+- ``released`` — fully released (arms double-release / use-after)
+- ``escaped``  — ownership transferred (store/return/consuming callee)
+- ``partial``  — a slice was consumed/stored (``release(pages[k:])``):
+                 satisfies the leak check, never arms use-after-transfer
+- ``maybe``    — passed whole to an unresolved call: conservatively assume
+                 the callee took responsibility (kills the leak report,
+                 arms nothing)
+
+Leaks are MUST-findings (reported only when every status on the path says
+``owned``), so a merge of released/owned stays silent — the runtime soak
+harness covers the may-leak tail (tests/test_paged_kv.py).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from ..astutil import assigned_names, dotted
+from ..engine import FileUnit, Finding, Rule
+from .absint import run_dataflow
+from .callgraph import FlowProgram, FuncInfo
+from .cfg import EXC_EXIT, EXIT, header_exprs
+
+__all__ = ["OwnershipRule", "ACQUIRE_METHODS", "RELEASE_METHODS"]
+
+#: Methods returning owned page values (BlockManager's value-owned acquires).
+ACQUIRE_METHODS = frozenset({"detach_slot", "import_pages", "take_copy_page", "_take"})
+#: Calls that consume an owned value passed as an argument.
+RELEASE_METHODS = frozenset({"release", "_drop"})
+#: Lane-keyed acquire/release spellings for the class-level pairing check.
+_LANE_ACQUIRES = frozenset({"admit"}) | ACQUIRE_METHODS
+_LANE_RELEASES = frozenset({"release", "release_slot", "_drop"})
+
+OWNED = "owned"
+RELEASED = "released"
+ESCAPED = "escaped"
+PARTIAL = "partial"
+MAYBE = "maybe"
+
+#: Builtins that read an owned value without taking any responsibility for it
+#: — passing pages to these neither consumes nor aliases them.
+_BENIGN_READS = frozenset({
+    "len", "bool", "int", "float", "str", "repr", "print", "isinstance",
+    "type", "min", "max", "sum",
+})
+
+
+def _is_acquire(call: ast.Call) -> bool:
+    name = dotted(call.func)
+    return name is not None and name.rsplit(".", 1)[-1] in ACQUIRE_METHODS
+
+
+def _release_args(call: ast.Call):
+    """(whole-name args, partial args) when ``call`` is a release, else None.
+
+    A bare ``lock.release()`` (no args) is NOT a page release — the consumed
+    value must be passed in.
+    """
+    name = dotted(call.func)
+    if name is None or name.rsplit(".", 1)[-1] not in RELEASE_METHODS:
+        return None
+    args = list(call.args) + [kw.value for kw in call.keywords]
+    if not args:
+        return None
+    whole = [a for a in args if isinstance(a, ast.Name)]
+    part = [
+        a.value for a in args
+        if isinstance(a, ast.Subscript) and isinstance(a.value, ast.Name)
+    ]
+    return whole, part
+
+
+class _ConsumeSummaries:
+    """Per-function, per-parameter: does the callee consume the value?
+
+    'consume' here means the callee releases it, stores it, or returns it —
+    any way responsibility demonstrably moves. Cycle-guarded one-level
+    recursion (a cycle answers False, the conservative direction for the
+    use-after checks and the MAYBE direction for leaks)."""
+
+    def __init__(self, program: FlowProgram):
+        self.program = program
+        self._memo: Dict[Tuple[str, str], bool] = {}
+
+    def consumes(self, fi: FuncInfo, param: str) -> bool:
+        key = (fi.qualname, param)
+        if key in self._memo:
+            return self._memo[key]
+        self._memo[key] = False  # cycle guard
+        got = self._scan(fi, param)
+        self._memo[key] = got
+        return got
+
+    def _scan(self, fi: FuncInfo, param: str) -> bool:
+        for node in ast.walk(fi.node):
+            if isinstance(node, ast.Call):
+                rel = _release_args(node)
+                if rel is not None and any(a.id == param for a in rel[0]):
+                    return True
+                callee = self.program.resolve_call(fi, node)
+                if callee is not None and callee.qualname != fi.qualname:
+                    for pos, a in enumerate(node.args):
+                        if isinstance(a, ast.Name) and a.id == param:
+                            pname = _param_at(callee, pos)
+                            if pname and self.consumes(callee, pname):
+                                return True
+                    for kw in node.keywords:
+                        if (
+                            isinstance(kw.value, ast.Name)
+                            and kw.value.id == param
+                            and kw.arg
+                            and self.consumes(callee, kw.arg)
+                        ):
+                            return True
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if (
+                        isinstance(t, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == param
+                    ):
+                        return True
+            if (
+                isinstance(node, ast.Return)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == param
+            ):
+                return True
+        return False
+
+
+def _param_at(fi: FuncInfo, pos: int) -> Optional[str]:
+    a = fi.node.args
+    params = [p.arg for p in list(a.posonlyargs) + list(a.args)]
+    if params and params[0] in ("self", "cls"):
+        params = params[1:]
+    return params[pos] if pos < len(params) else None
+
+
+class OwnershipRule(Rule):
+    id = "flow-ownership"
+    severity = "error"
+    description = (
+        "BlockManager page ownership: acquires not consumed on every path "
+        "(exception edges included), use-after-transfer, double release"
+    )
+
+    def __init__(self, cache):
+        self._cache = cache
+
+    def finalize(self, units: Sequence[FileUnit]):
+        program: FlowProgram = self._cache.get(units)
+        summaries = _ConsumeSummaries(program)
+        findings: List[Finding] = []
+        for fi in program.iter_functions():
+            findings.extend(self._check_function(program, summaries, fi))
+        findings.extend(self._check_class_pairing(program))
+        return findings
+
+    # ------------------------------------------------------------- per-function
+    def _check_function(self, program, summaries, fi):
+        if not any(_is_acquire(n) for n in ast.walk(fi.node) if isinstance(n, ast.Call)):
+            return []
+        cfg = program.cfg(fi)
+        findings: List[Finding] = []
+        flagged: Set[Tuple[int, str]] = set()
+
+        def consume_status(call: ast.Call, var: str) -> Optional[str]:
+            """What passing ``var`` whole to this call does to its state."""
+            rel = _release_args(call)
+            if rel is not None and any(a.id == var for a in rel[0]):
+                return RELEASED
+            if isinstance(call.func, ast.Name) and call.func.id in _BENIGN_READS:
+                return None
+            callee = program.resolve_call(fi, call)
+            if callee is not None:
+                for pos, a in enumerate(call.args):
+                    if isinstance(a, ast.Name) and a.id == var:
+                        pname = _param_at(callee, pos)
+                        if pname and summaries.consumes(callee, pname):
+                            return ESCAPED
+                for kw in call.keywords:
+                    if (
+                        isinstance(kw.value, ast.Name) and kw.value.id == var
+                        and kw.arg and summaries.consumes(callee, kw.arg)
+                    ):
+                        return ESCAPED
+            args = list(call.args) + [kw.value for kw in call.keywords]
+            if any(isinstance(a, ast.Name) and a.id == var for a in args):
+                return MAYBE
+            return None
+
+        def stmt_events(s: ast.AST):
+            """Ordered (kind, var, node) events this statement's CFG node
+            applies to the state — header expressions only (``header_exprs``);
+            the body of a compound statement belongs to other nodes."""
+            events = []
+            acquires: Dict[str, ast.Call] = {}
+            if (
+                isinstance(s, ast.Assign)
+                and isinstance(s.value, ast.Call)
+                and _is_acquire(s.value)
+            ):
+                for t in s.targets:
+                    targets = t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+                    for el in targets:
+                        if isinstance(el, ast.Name):
+                            acquires[el.id] = s.value
+            call_arg_names: set = set()
+            for root in header_exprs(s):
+                for node in ast.walk(root):
+                    if isinstance(node, ast.Call):
+                        rel = _release_args(node)
+                        if rel is not None:
+                            for a in rel[0]:
+                                events.append(("release", a.id, node))
+                                call_arg_names.add(a.id)
+                            for a in rel[1]:
+                                events.append(("partial", a.id, node))
+                                call_arg_names.add(a.id)
+                            continue
+                        seen = set()
+                        for a in list(node.args) + [kw.value for kw in node.keywords]:
+                            if isinstance(a, ast.Name) and a.id not in seen:
+                                seen.add(a.id)
+                                call_arg_names.add(a.id)
+                                events.append(("pass", a.id, node))
+                            elif (
+                                isinstance(a, ast.Subscript)
+                                and isinstance(a.value, ast.Name)
+                            ):
+                                call_arg_names.add(a.value.id)
+                                events.append(("partial", a.value.id, node))
+                    if isinstance(node, ast.Return) and isinstance(node.value, ast.Name):
+                        events.append(("escape", node.value.id, node))
+                    if isinstance(node, (ast.Yield, ast.YieldFrom)) and isinstance(
+                        getattr(node, "value", None), ast.Name
+                    ):
+                        events.append(("escape", node.value.id, node))
+            if isinstance(s, ast.Assign):
+                for t in s.targets:
+                    # Attribute stores move ownership to the object; subscript
+                    # stores (``tables[slot] = ids``) mirror page IDS into a
+                    # table — the holder keeps responsibility (adopt_handoff
+                    # stages ids into a device row, then releases).
+                    if isinstance(t, ast.Attribute) and isinstance(s.value, ast.Name):
+                        events.append(("escape", s.value.id, s))
+                    elif isinstance(t, ast.Subscript) and isinstance(s.value, ast.Name):
+                        events.append(("alias", s.value.id, s))
+                # Ownership spreads through aliases we do not track (slices,
+                # concatenations, plain renames): downgrade such sources to
+                # MAYBE so neither the leak nor the linearity checks lie.
+                for node in ast.walk(s.value):
+                    if (
+                        isinstance(node, ast.Name)
+                        and isinstance(node.ctx, ast.Load)
+                        and node.id not in call_arg_names
+                    ):
+                        events.append(("alias", node.id, node))
+            for name in sorted(assigned_names(s)):
+                if name not in acquires:
+                    events.append(("rebind", name, s))
+            for name, call in acquires.items():
+                events.append(("acquire", name, call))
+            return events
+
+        def transfer(node, state):
+            if node.stmt is None or node.tag not in ("stmt",):
+                return state
+            new = dict(state)
+            for kind, var, where in stmt_events(node.stmt):
+                cur = new.get(var)
+                if kind == "acquire":
+                    new[var] = (frozenset({OWNED}), where.lineno)
+                    continue
+                if cur is None:
+                    continue
+                statuses, line = cur
+                if kind == "release":
+                    new[var] = (frozenset({RELEASED}), line)
+                elif kind == "escape":
+                    new[var] = (frozenset({ESCAPED}), line)
+                elif kind == "partial":
+                    if OWNED in statuses:
+                        new[var] = (statuses - {OWNED} | {PARTIAL}, line)
+                elif kind == "alias":
+                    if OWNED in statuses:
+                        new[var] = (statuses - {OWNED} | {MAYBE}, line)
+                elif kind == "pass":
+                    if statuses == frozenset({OWNED}):
+                        st = consume_status(where, var)
+                        if st == RELEASED:
+                            new[var] = (frozenset({RELEASED}), line)
+                        elif st == ESCAPED:
+                            new[var] = (frozenset({ESCAPED}), line)
+                        elif st == MAYBE:
+                            new[var] = (frozenset({MAYBE}), line)
+                elif kind == "rebind":
+                    new.pop(var, None)
+            return new
+
+        in_states, _ = run_dataflow(cfg, {}, transfer)
+
+        # Reporting pass: linearity violations at each statement, leaks at exits.
+        for node in cfg.nodes:
+            state = in_states.get(node.idx)
+            if state is None:
+                continue
+            if node.tag in (EXIT, EXC_EXIT):
+                for var, (statuses, line) in sorted(state.items()):
+                    if statuses == frozenset({OWNED}):
+                        where = "an exception path" if node.tag == EXC_EXIT else "a normal path"
+                        key = (line, var)
+                        if key in flagged:
+                            continue
+                        flagged.add(key)
+                        findings.append(self._make(
+                            fi.unit, line,
+                            f"'{fi.qualname}' acquires owned pages into "
+                            f"'{var}' but {where} exits without releasing or "
+                            "transferring them — pages leak (release in a "
+                            "finally, or hand ownership off explicitly)",
+                        ))
+                continue
+            if node.stmt is None or node.tag != "stmt":
+                continue
+            for kind, var, where in stmt_events(node.stmt):
+                cur = state.get(var)
+                if cur is None:
+                    continue
+                statuses, _line = cur
+                lineno = getattr(where, "lineno", node.stmt.lineno)
+                if kind == "release" and RELEASED in statuses:
+                    if (lineno, var, "dbl") in flagged:
+                        continue
+                    flagged.add((lineno, var, "dbl"))
+                    findings.append(self._make(
+                        fi.unit, lineno,
+                        f"'{fi.qualname}' releases '{var}' again — it was "
+                        "already released on this path (PR-9 double-release "
+                        "class: refcounts go negative at runtime)",
+                    ))
+                elif kind in ("pass", "partial", "escape", "release") and (
+                    ESCAPED in statuses or (kind != "release" and RELEASED in statuses)
+                ):
+                    if (lineno, var, "uat") in flagged:
+                        continue
+                    flagged.add((lineno, var, "uat"))
+                    prior = "transferred" if ESCAPED in statuses else "released"
+                    findings.append(self._make(
+                        fi.unit, lineno,
+                        f"'{fi.qualname}' uses '{var}' after ownership was "
+                        f"{prior} — transfers are linear; the new owner's "
+                        "copy is the only live one",
+                    ))
+        return findings
+
+    # ---------------------------------------------------------- class pairing
+    def _check_class_pairing(self, program):
+        """A class that acquires pages/lanes but never releases ANY page is the
+        zombie-lane shape: its terminal paths cannot possibly finalize."""
+        findings = []
+        for ci in sorted(
+            program.classes.values(), key=lambda c: (c.unit.path, c.node.lineno)
+        ):
+            acquire_site = None
+            has_release = False
+            for fi in ci.methods.values():
+                for node in ast.walk(fi.node):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    name = dotted(node.func)
+                    if name is None or "." not in name:
+                        continue
+                    leaf = name.rsplit(".", 1)[-1]
+                    if leaf in _LANE_ACQUIRES and name.split(".")[0] == "self":
+                        if acquire_site is None or node.lineno < acquire_site[1]:
+                            acquire_site = (fi, node.lineno, leaf)
+                    if leaf in _LANE_RELEASES:
+                        has_release = True
+            if acquire_site is not None and not has_release:
+                fi, lineno, leaf = acquire_site
+                findings.append(self._make(
+                    fi.unit, lineno,
+                    f"class '{ci.qualname}' acquires pages ('{leaf}') but no "
+                    "method ever releases — terminal paths cannot finalize "
+                    "(PR-10 zombie-lane class)",
+                ))
+        return findings
+
+    def _make(self, unit: FileUnit, line: int, message: str) -> Finding:
+        return Finding(
+            rule=self.id, severity=self.severity, path=unit.path,
+            line=line, message=message, code=unit.line_text(line),
+        )

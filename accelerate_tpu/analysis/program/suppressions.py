@@ -70,13 +70,16 @@ def apply_audit_suppressions(
     usable: List[AuditSuppression] = []
     for s in suppressions:
         if known and s.rule not in known:
+            from ..engine import format_rule_catalog
+
             errors.append(Finding(
                 rule="bad-suppression",
                 severity="error",
                 path="analysis/program/suppressions.py",
                 line=0,
                 message=f"audit suppression names unknown rule '{s.rule}' "
-                f"(known: {', '.join(sorted(known))})",
+                f"(known here: {', '.join(sorted(known))}; "
+                f"all tiers — {format_rule_catalog()})",
                 code=f"suppression {s.rule}:{s.program}:{s.match}",
             ))
         elif not s.reason.strip():

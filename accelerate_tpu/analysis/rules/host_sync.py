@@ -46,6 +46,15 @@ SANCTIONED_PATH_PREFIXES = (
     "accelerate_tpu/serving_gateway/",
 )
 
+#: Step-loop scopes for the wall-sleep check: gateway/router/fleet classes and
+#: workload-replay functions are the code that must run on an injectable clock
+#: (virtual-clock replays, serve-bench) — a ``time.sleep`` in one of their
+#: loops stalls every replica the loop drives AND breaks virtual-time replay.
+#: Scoped by content, not path, so it applies INSIDE the sanctioned prefixes
+#: too (those were sanctioned for fence reads, not for blocking the loop).
+_STEP_LOOP_CLASS = re.compile(r"(Gateway|Router|Fleet)")
+_REPLAY_FN = re.compile(r"replay", re.IGNORECASE)
+
 
 def _is_sanctioned_sync(name: str) -> bool:
     """Telemetry fence helpers, allowlisted by qualified name: ``fence(...)`` (the
@@ -80,14 +89,19 @@ def _is_fenced_subscript(sub: ast.Subscript) -> bool:
 class HostSyncRule(Rule):
     id = "host-sync-in-hot-path"
     severity = "warning"
-    description = "host-device sync (np.asarray/device_get/.item()/block_until_ready) in a hot loop"
+    description = (
+        "host-device sync (np.asarray/device_get/.item()/block_until_ready) "
+        "in a hot loop, or wall time.sleep in a gateway/fleet/replay step loop"
+    )
 
     def check_file(self, unit: FileUnit):
         if unit.is_test:  # test scripts fetch values to assert on them — that's the point
             return []
+        # The wall-sleep check runs unconditionally — the sanctioned prefixes
+        # below cover fence-style reads, not blocking a serving/replay loop.
+        findings = list(self._scan_wall_sleep(unit))
         if unit.path.startswith(SANCTIONED_PATH_PREFIXES):
-            return []  # sanctioned timing internals (see SANCTIONED_PATH_PREFIXES)
-        findings = []
+            return findings  # sanctioned timing internals (see SANCTIONED_PATH_PREFIXES)
         for fn in ast.walk(unit.tree):
             if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
                 continue
@@ -99,6 +113,53 @@ class HostSyncRule(Rule):
         for f in findings:
             uniq[(f.line, f.message)] = f
         return [uniq[k] for k in sorted(uniq)]
+
+    def _scan_wall_sleep(self, unit: FileUnit):
+        """``time.sleep`` inside a loop of a gateway/router/fleet class or a
+        replay-named function: a step loop that blocks on the wall clock
+        stalls every request/replica it drives, and a virtual-clock replay of
+        the same loop deadlocks (virtual time never advances while the host
+        sleeps). Wait on the injected ``sleep``/``clock``
+        (``telemetry.clocks``) or turn the wait into a schedule the caller
+        polls (``FleetSupervisor.restart_at``)."""
+        scopes = []
+        for node in ast.walk(unit.tree):
+            if isinstance(node, ast.ClassDef) and _STEP_LOOP_CLASS.search(node.name):
+                scopes.append((node.name, node))
+            elif isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef)
+            ) and _REPLAY_FN.search(node.name):
+                scopes.append((node.name, node))
+        findings = {}
+        for scope_name, scope in scopes:
+            for call in self._loop_calls(scope):
+                if dotted(call.func) == "time.sleep":
+                    f = self.make(
+                        unit,
+                        call,
+                        f"wall 'time.sleep' in a step loop of '{scope_name}' — "
+                        "blocks the serving/replay loop and deadlocks "
+                        "virtual-clock replays; use the injected sleep "
+                        "(telemetry.clocks) or a restart_at-style schedule",
+                    )
+                    findings[(f.line, f.message)] = f
+        return [findings[k] for k in sorted(findings)]
+
+    def _loop_calls(self, root: ast.AST):
+        """Every Call node lexically inside a loop under ``root``."""
+        out = []
+
+        def visit(node: ast.AST, in_loop: bool):
+            for child in ast.iter_child_nodes(node):
+                inside = in_loop or isinstance(
+                    child, (ast.For, ast.AsyncFor, ast.While)
+                )
+                if inside and isinstance(child, ast.Call):
+                    out.append(child)
+                visit(child, inside)
+
+        visit(root, False)
+        return out
 
     def _scan_hot_function(self, unit: FileUnit, fn: ast.AST):
         findings = []

@@ -10,6 +10,7 @@ from .chaos_train import chaos_train_command_parser
 from .config import config_command_parser
 from .env import env_command_parser
 from .estimate import estimate_command_parser
+from .flow import flow_command_parser
 from .launch import launch_command_parser
 from .lint import lint_command_parser
 from .memaudit import memaudit_command_parser
@@ -37,6 +38,7 @@ def get_parser() -> argparse.ArgumentParser:
     config_command_parser(subparsers=subparsers)
     env_command_parser(subparsers=subparsers)
     estimate_command_parser(subparsers=subparsers)
+    flow_command_parser(subparsers=subparsers)
     launch_command_parser(subparsers=subparsers)
     lint_command_parser(subparsers=subparsers)
     memaudit_command_parser(subparsers=subparsers)

@@ -1,0 +1,36 @@
+"""``accelerate-tpu flow`` — run graftflow (see ``analysis/flow/``).
+
+Thin wrapper like ``commands/lint.py``; the call graph, CFGs, rule packs and
+ratcheted baseline live in ``analysis.flow``. Stdlib-ast only — no jax, no
+TPU, no module import of the analyzed code."""
+
+from __future__ import annotations
+
+import argparse
+
+from ..analysis.flow.cli import build_arg_parser, run_cli
+
+__all__ = ["flow_command", "flow_command_parser"]
+
+
+def flow_command_parser(subparsers=None) -> argparse.ArgumentParser:
+    description = (
+        "Interprocedural dataflow audit of the host control plane: clock-"
+        "domain coherence, BlockManager page-ownership discipline, rng-key "
+        "schedules across call boundaries. AST only, ratcheted baseline, "
+        "<10 s."
+    )
+    if subparsers is not None:
+        parser = subparsers.add_parser("flow", description=description)
+    else:
+        parser = argparse.ArgumentParser(
+            "accelerate-tpu flow", description=description
+        )
+    build_arg_parser(parser)
+    if subparsers is not None:
+        parser.set_defaults(func=flow_command)
+    return parser
+
+
+def flow_command(args) -> int:
+    return run_cli(args)

@@ -26,6 +26,7 @@ import time
 from typing import Callable, Optional, Sequence
 
 from .logging import get_logger
+from .telemetry.clocks import resolve_clock, resolve_sleep
 from .utils.other import get_free_port
 
 logger = get_logger(__name__)
@@ -242,7 +243,7 @@ class FleetSupervisor:
 
     def __init__(self, max_restarts: int = 1, restart_backoff: float = 0.0,
                  backoff_jitter: float = 0.0, telemetry=None,
-                 clock: Callable[[], float] = time.monotonic):
+                 clock: Optional[Callable[[], float]] = None):
         if max_restarts < 0:
             raise ValueError(f"max_restarts={max_restarts} must be >= 0")
         if restart_backoff < 0:
@@ -253,7 +254,7 @@ class FleetSupervisor:
         self.restart_backoff = float(restart_backoff)
         self.backoff_jitter = float(backoff_jitter)
         self.telemetry = telemetry
-        self._clock = clock
+        self._clock = resolve_clock(clock)
         self._attempts: dict = {}    # gang_id → failed attempts recorded
         self._restart_at: dict = {}  # gang_id → earliest allowed restart time
 
@@ -367,8 +368,8 @@ class GangOfGangs:
         checkpoint_every: int = 0,
         total_limit: Optional[int] = None,
         telemetry=None,
-        clock: Callable[[], float] = time.monotonic,
-        sleep: Callable[[float], None] = time.sleep,
+        clock: Optional[Callable[[], float]] = None,
+        sleep: Optional[Callable[[float], None]] = None,
     ):
         if n_stages < 1:
             raise ValueError(f"n_stages={n_stages} must be >= 1")
@@ -377,6 +378,10 @@ class GangOfGangs:
         self.stage_factory = stage_factory
         self.n_stages = int(n_stages)
         self.checkpoint_dir = checkpoint_dir
+        # Resolve once, then thread the SAME domain into the default
+        # supervisor — a gang's backoff schedule and its supervisor's restart
+        # accounting must not live on different clocks.
+        clock = resolve_clock(clock)
         self.supervisor = supervisor if supervisor is not None else FleetSupervisor(
             max_restarts=1, telemetry=telemetry, clock=clock
         )
@@ -384,7 +389,7 @@ class GangOfGangs:
         self.total_limit = total_limit
         self.telemetry = telemetry
         self._clock = clock
-        self._sleep = sleep
+        self._sleep = resolve_sleep(sleep)
         self.pipeline = None
         #: Exactly-once lineage: global step ids applied in the SURVIVING
         #: history (truncated on every replay). The chaos-train invariant is

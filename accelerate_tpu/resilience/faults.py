@@ -47,8 +47,9 @@ Plans thread through the stack like the other cross-cutting configs: the
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import List, Optional, Sequence
+
+from ..telemetry.clocks import resolve_clock
 
 __all__ = [
     "FaultError",
@@ -384,11 +385,11 @@ class StepWatchdog:
     is the supervisor layer's job (``ElasticSupervisor(attempt_timeout=...)``,
     which tears the whole gang down from outside)."""
 
-    def __init__(self, budget_s: float, clock=time.monotonic):
+    def __init__(self, budget_s: float, clock=None):
         if budget_s <= 0:
             raise ValueError(f"budget_s={budget_s} must be > 0")
         self.budget_s = float(budget_s)
-        self._clock = clock
+        self._clock = resolve_clock(clock)
         self.timeouts = 0
 
     def open(self) -> float:

@@ -46,7 +46,6 @@ mixed baseline, zero silently-lost requests under the chaos variant
 from __future__ import annotations
 
 import dataclasses
-import time
 from collections import deque
 from typing import Callable, List, Optional, Sequence
 
@@ -117,7 +116,7 @@ class DisaggRouter(FleetRouter):
     handoff pipeline instead."""
 
     def __init__(self, engines: Sequence, config: Optional[GatewayConfig] = None,
-                 telemetry=None, clock: Callable[[], float] = time.monotonic,
+                 telemetry=None, clock: Optional[Callable[[], float]] = None,
                  tracer=None, engine_factory: Optional[Callable] = None,
                  supervisor=None, roles: Optional[Sequence] = None):
         if config is None:

@@ -42,7 +42,6 @@ failover p95 TTFT penalty (docs/resilience.md).
 
 from __future__ import annotations
 
-import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 from ..elastic import FleetSupervisor
@@ -131,7 +130,7 @@ class FleetRouter(ServingGateway):
     gateway config on the router's own clock."""
 
     def __init__(self, engines: Sequence, config: Optional[GatewayConfig] = None,
-                 telemetry=None, clock: Callable[[], float] = time.monotonic,
+                 telemetry=None, clock: Optional[Callable[[], float]] = None,
                  tracer=None, engine_factory: Optional[Callable[[int], object]] = None,
                  supervisor: Optional[FleetSupervisor] = None):
         engines = list(engines)
@@ -157,7 +156,7 @@ class FleetRouter(ServingGateway):
         self.supervisor = supervisor if supervisor is not None else FleetSupervisor(
             max_restarts=cfg.replica_restarts,
             restart_backoff=cfg.replica_restart_backoff,
-            telemetry=telemetry, clock=clock,
+            telemetry=telemetry, clock=self._clock,
         )
         self._replicas: List[Replica] = []
         for rid, eng in enumerate(engines):

@@ -32,13 +32,13 @@ engine, so a gateway-fronted run compiles exactly what an engine-only run does
 from __future__ import annotations
 
 import dataclasses
-import time
 from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
 from ..generation import GenerationConfig
 from ..serving import KVBudgetError, normalize_submit
+from ..telemetry.clocks import resolve_clock
 from ..telemetry.slo import (
     GATEWAY_REQUEST_SCHEMA,
     GATEWAY_SLO_SCHEMA,
@@ -259,15 +259,20 @@ class CircuitBreaker:
 class ServingGateway:
     """Admission + scheduling + lifecycle tier above one ``ContinuousBatcher``.
 
-    ``clock`` defaults to ``time.monotonic``; tests inject a manual clock to make
-    deadlines/aging deterministic. ``telemetry`` accepts the same ``Telemetry``
-    object the engine takes (records share its sinks)."""
+    ``clock`` defaults to the sanctioned wall clock (``telemetry.clocks``);
+    tests inject a manual clock to make deadlines/aging deterministic.
+    ``telemetry`` accepts the same ``Telemetry`` object the engine takes
+    (records share its sinks)."""
 
     def __init__(self, engine, config: Optional[GatewayConfig] = None,
-                 telemetry=None, clock: Callable[[], float] = time.monotonic,
+                 telemetry=None, clock: Optional[Callable[[], float]] = None,
                  tracer=None):
         if config is None:
             config = GatewayConfig(enabled=True)
+        # Resolve the time domain FIRST: everything the gateway builds or
+        # adopts below (tracer, metrics plane, recorder, breakers, replicas)
+        # inherits this one clock.
+        clock = resolve_clock(clock)
         self.engine = engine
         self.config = config
         # Multi-step decode pairing (config.decode_steps, docs/

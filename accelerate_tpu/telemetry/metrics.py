@@ -42,10 +42,10 @@ stripped CLI contexts (``metrics-dump`` over a recorded run directory).
 from __future__ import annotations
 
 import dataclasses
-import time
 from collections import deque
 from typing import Callable, Dict, List, Mapping, Optional, Tuple
 
+from .clocks import resolve_clock
 from .schemas import (
     ALERT_SCHEMA,
     ELASTIC_RESTART_SCHEMA,
@@ -295,7 +295,7 @@ class MetricsPlane:
     asked (``emit=True``), so consuming and producing stay visibly separate.
     """
 
-    def __init__(self, telemetry=None, clock: Callable[[], float] = time.monotonic,
+    def __init__(self, telemetry=None, clock: Optional[Callable[[], float]] = None,
                  window_s: float = 300.0, window_cap: int = 4096,
                  enabled: Optional[bool] = None):
         self.telemetry = telemetry
@@ -303,7 +303,7 @@ class MetricsPlane:
         self.enabled = bool(enabled) if enabled is not None else (
             telemetry is not None and getattr(telemetry, "enabled", False)
         )
-        self._clock = clock
+        self._clock = resolve_clock(clock)
         self.window_s = float(window_s)
         self.window_cap = int(window_cap)
         self.records_consumed = 0

@@ -42,10 +42,10 @@ import itertools
 import json
 import os
 import re
-import time
 from collections import deque
 from typing import Callable, Dict, List, Mapping, Optional
 
+from .clocks import resolve_clock
 from .metrics import M_RECORDER_DROPPED_TOTAL
 from .schemas import (
     ALERT_SCHEMA,
@@ -77,7 +77,7 @@ class FlightRecorder:
     ``capsule_dir`` arms capsule capture (None = ring-only recorder).
     """
 
-    def __init__(self, telemetry=None, clock: Callable[[], float] = time.monotonic,
+    def __init__(self, telemetry=None, clock: Optional[Callable[[], float]] = None,
                  ring_size: int = 2048, snapshot_every: int = 256,
                  capsule_dir: Optional[str] = None,
                  capsule_cooldown_s: float = 30.0,
@@ -87,7 +87,12 @@ class FlightRecorder:
         self.enabled = bool(enabled) if enabled is not None else (
             telemetry is not None and getattr(telemetry, "enabled", False)
         )
-        self._clock = clock
+        # Inherit the bound plane's time domain when no clock is injected:
+        # capsule cooldowns and manifest timestamps must live in the same
+        # (possibly virtual) time as the snapshots the plane stamps — the
+        # PR-17 mixing bug started exactly here.
+        self._clock_injected = clock is not None
+        self._clock = resolve_clock(clock, getattr(metrics, "_clock", None))
         self.ring: deque = deque(maxlen=int(ring_size))
         self.snapshot_every = int(snapshot_every)
         self.capsule_dir = capsule_dir
@@ -151,6 +156,10 @@ class FlightRecorder:
             return
         if plane is not None and getattr(plane, "enabled", False):
             self.metrics = plane
+            # Late-bound plane: adopt its time domain unless a clock was
+            # explicitly injected (same coherence contract as construction).
+            if not self._clock_injected:
+                self._clock = resolve_clock(None, getattr(plane, "_clock", None))
 
     def bind_clock(self, clock: Callable[[], float]) -> None:
         """Late-bind the time domain (the gateway hands its own clock in, so
@@ -160,6 +169,7 @@ class FlightRecorder:
             return
         if clock is not None:
             self._clock = clock
+            self._clock_injected = True
 
     # ---------------------------------------------------------- tail promotion
     def promote(self, trace_id: str) -> int:

@@ -63,9 +63,9 @@ from __future__ import annotations
 
 import itertools
 import random
-import time
 from typing import Callable, Dict, Optional
 
+from .clocks import resolve_clock
 from .schemas import TRACE_SPAN_SCHEMA
 
 __all__ = ["Tracer", "TraceHandle", "TRACE_SPAN_SCHEMA"]
@@ -109,7 +109,7 @@ class Tracer:
     (tests and trace replay use a manual virtual clock — spans then share the
     gateway's deadline clock, so timelines and deadlines agree)."""
 
-    def __init__(self, telemetry=None, clock: Callable[[], float] = time.monotonic,
+    def __init__(self, telemetry=None, clock: Optional[Callable[[], float]] = None,
                  sink: Optional[Callable[[dict], None]] = None,
                  sample_every: Optional[int] = None,
                  sample_prob: Optional[float] = None,
@@ -122,7 +122,17 @@ class Tracer:
         self.enabled = bool(sink) or (
             telemetry is not None and getattr(telemetry, "enabled", False)
         )
-        self._clock = clock
+        #: Where unsampled spans buffer (tail-promotion source); defaults to
+        #: the telemetry-owned FlightRecorder when one is configured.
+        self.recorder = (getattr(telemetry, "recorder", None)
+                         if recorder is None else recorder)
+        # Inherit the bound recorder's time domain when no clock is injected:
+        # buffered spans replay through the recorder's ring and cooldowns, so
+        # a tracer stamping wall seconds against a virtual-clock recorder
+        # would split one trace across two domains.
+        self._clock = resolve_clock(
+            clock, getattr(self.recorder, "_clock", None)
+        )
         # Head sampling: every-Kth (deterministic counter) or seeded
         # probability — both resolvable from TelemetryConfig so production
         # wiring needs no extra plumbing. Explicit kwargs win over config.
@@ -138,10 +148,6 @@ class Tracer:
                 else sample_seed)
         self._rng = (random.Random(seed) if self.sample_prob is not None
                      else None)
-        #: Where unsampled spans buffer (tail-promotion source); defaults to
-        #: the telemetry-owned FlightRecorder when one is configured.
-        self.recorder = (getattr(telemetry, "recorder", None)
-                         if recorder is None else recorder)
         self.spans_emitted = 0
         self.spans_buffered = 0
         self.traces_started = 0

@@ -78,6 +78,13 @@ MODULES = [
     ("accelerate_tpu.utils.jax_compat", "JAX version compatibility"),
     ("accelerate_tpu.analysis.engine", "Static analysis (graftlint) engine"),
     ("accelerate_tpu.analysis.baseline", "Static analysis ratcheting baseline"),
+    ("accelerate_tpu.analysis.flow", "Interprocedural dataflow tier (graftflow)"),
+    ("accelerate_tpu.analysis.flow.callgraph", "graftflow: module call graph"),
+    ("accelerate_tpu.analysis.flow.cfg", "graftflow: CFGs with exception edges"),
+    ("accelerate_tpu.analysis.flow.absint", "graftflow: worklist abstract interpreter"),
+    ("accelerate_tpu.analysis.flow.clock_domain", "graftflow: clock-domain rule pack"),
+    ("accelerate_tpu.analysis.flow.ownership", "graftflow: page-ownership rule pack"),
+    ("accelerate_tpu.analysis.flow.key_schedule", "graftflow: key-schedule rule pack"),
     ("accelerate_tpu.analysis.program.capture", "Program audit: lowering capture"),
     ("accelerate_tpu.analysis.program.lowering", "Program audit: lower-only enumeration"),
     ("accelerate_tpu.analysis.program.rules", "Program audit rules (graftaudit)"),
@@ -90,6 +97,7 @@ MODULES = [
     ("accelerate_tpu.compile_cache.buckets", "Serving shape buckets"),
     ("accelerate_tpu.compile_cache.warmup", "Warmup manifests"),
     ("accelerate_tpu.telemetry.core", "Telemetry pipeline"),
+    ("accelerate_tpu.telemetry.clocks", "Clock-domain resolution protocol"),
     ("accelerate_tpu.telemetry.timing", "Fenced step timing"),
     ("accelerate_tpu.telemetry.steady", "Steady-state detection"),
     ("accelerate_tpu.telemetry.compile_monitor", "Compile-event counters"),

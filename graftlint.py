@@ -1,5 +1,5 @@
 #!/usr/bin/env python
-"""graftlint, truly standalone: runs without jax installed.
+"""graftlint (and graftflow), truly standalone: runs without jax installed.
 
 ``python -m accelerate_tpu lint`` and ``python -m accelerate_tpu.analysis`` are the
 convenience entries, but any ``accelerate_tpu.*`` import executes the package root's
@@ -8,9 +8,14 @@ a synthetic parent package instead, so the analysis modules' relative imports re
 while the package root never runs: stdlib only, end to end.
 
     python graftlint.py [--check] [--baseline] [paths ...]
+    python graftlint.py --flow [--check] [--baseline] [paths ...]
+
+``--flow`` (first argument) dispatches to the graftflow interprocedural
+dataflow tier instead — same stdlib-only guarantee, same exit codes.
 
 Set ``GRAFTLINT_ASSERT_NO_JAX=1`` to make the process fail if jax ever lands in
-``sys.modules`` (the guarantee tests/test_lint_clean.py holds in CI).
+``sys.modules`` (the guarantee tests/test_lint_clean.py and
+tests/test_flow_clean.py hold in CI).
 """
 
 import os
@@ -20,7 +25,7 @@ import types
 ROOT = os.path.dirname(os.path.abspath(__file__))
 
 
-def _load_analysis():
+def _load_analysis(flow: bool = False):
     """Register a stub ``accelerate_tpu`` parent so the analysis subpackage imports
     without executing ``accelerate_tpu/__init__.py`` (and its jax import)."""
     if "accelerate_tpu" not in sys.modules:
@@ -28,14 +33,19 @@ def _load_analysis():
         stub.__path__ = [os.path.join(ROOT, "accelerate_tpu")]
         sys.modules["accelerate_tpu"] = stub
     sys.path.insert(0, ROOT)
-    from accelerate_tpu.analysis.cli import main
+    if flow:
+        from accelerate_tpu.analysis.flow.cli import main
+    else:
+        from accelerate_tpu.analysis.cli import main
 
     return main
 
 
 if __name__ == "__main__":
-    main = _load_analysis()
-    rc = main()
+    argv = sys.argv[1:]
+    flow = bool(argv) and argv[0] == "--flow"
+    main = _load_analysis(flow=flow)
+    rc = main(argv[1:] if flow else argv)
     if os.environ.get("GRAFTLINT_ASSERT_NO_JAX") and "jax" in sys.modules:
         sys.exit("graftlint.py leaked a jax import — the standalone guarantee broke")
     sys.exit(rc)

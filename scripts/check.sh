@@ -1,14 +1,15 @@
 #!/usr/bin/env bash
-# Single CI/dev gate: AST lint + program audit + memory audit + docs/api
-# drift, one exit code.
+# Single CI/dev gate: AST lint + interprocedural dataflow + program audit +
+# memory audit + docs/api drift, one exit code.
 #
 #   scripts/check.sh          # all gates
-#   scripts/check.sh --fast   # lint only (no jax import, <5 s)
+#   scripts/check.sh --fast   # lint + flow only (no jax import, <15 s)
 #
-# Each gate exits non-zero on ANY new finding (the baselines are empty at HEAD
-# and only shrink — fix or suppress-with-reason, never grandfather). The gates
-# run separately (rather than one `lint --check`, which folds all three in) so
-# a failure names its tier in the output.
+# Each gate exits non-zero on ANY new finding (all four ratchet baselines —
+# graftlint, graftflow, graftaudit, graftmem — are empty at HEAD and only
+# shrink: fix or suppress-with-reason, never grandfather). The gates run
+# separately (rather than one `lint --check`, which folds all four in) so a
+# failure names its tier in the output.
 set -u -o pipefail
 
 cd "$(dirname "$0")/.."
@@ -23,7 +24,10 @@ esac
 rc=0
 
 echo "== graftlint (AST tier) =="
-python -m accelerate_tpu lint --check --skip-docs --skip-audit --skip-memaudit || rc=1
+python -m accelerate_tpu lint --check --skip-docs --skip-audit --skip-memaudit --skip-flow || rc=1
+
+echo "== graftflow (dataflow tier) =="
+python -m accelerate_tpu flow --check || rc=1
 
 if [ "${1:-}" = "--fast" ]; then
     exit $rc

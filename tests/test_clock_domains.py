@@ -1,0 +1,107 @@
+"""Clock-domain coherence across the telemetry composition chain.
+
+Regression pins for the PR-17 bug class: the flight recorder used to default
+to ``time.monotonic`` while the metrics plane it fed ran on an injected
+virtual clock — wall stamps landed in the plane's windowed stats and the
+window trim silently purged everything. The fix is the ``telemetry.clocks``
+resolution protocol: components default ``clock=None`` and resolve through
+``resolve_clock``, inheriting the bound component's domain (recorder ←
+metrics plane, tracer ← recorder) unless a clock is explicitly injected.
+These tests pin that inheritance; ``flow-clock-domain`` (graftflow) pins the
+static side.
+"""
+
+import time
+
+from accelerate_tpu.telemetry import FlightRecorder, Tracer
+from accelerate_tpu.telemetry.clocks import (
+    WALL_CLOCK,
+    WALL_SLEEP,
+    resolve_clock,
+    resolve_sleep,
+)
+from accelerate_tpu.telemetry.metrics import MetricsPlane
+
+
+class VirtualClock:
+    def __init__(self, start=1000.0):
+        self.now = start
+
+    def __call__(self):
+        return self.now
+
+
+def test_resolve_clock_precedence():
+    vc, inherited = VirtualClock(1.0), VirtualClock(2.0)
+    assert resolve_clock(vc, inherited) is vc          # explicit wins
+    assert resolve_clock(None, inherited) is inherited  # then inheritance
+    assert resolve_clock(None, None) is WALL_CLOCK      # then sanctioned wall
+    assert WALL_CLOCK is time.monotonic
+    assert resolve_sleep(None) is WALL_SLEEP
+    assert WALL_SLEEP is time.sleep
+
+
+def test_recorder_inherits_plane_clock_at_construction():
+    vc = VirtualClock(500.0)
+    plane = MetricsPlane(clock=vc, enabled=True)
+    rec = FlightRecorder(metrics=plane, enabled=True)
+    assert rec._clock is vc
+
+
+def test_recorder_adopts_late_bound_plane_clock():
+    """The gateway builds its plane after the recorder exists — bind_metrics
+    must carry the time domain across, or capsule cooldowns run on wall time
+    while the snapshots they frame run on virtual time."""
+    vc = VirtualClock(500.0)
+    rec = FlightRecorder(enabled=True)
+    assert rec._clock is WALL_CLOCK
+    rec.bind_metrics(MetricsPlane(clock=vc, enabled=True))
+    assert rec._clock is vc
+
+
+def test_explicitly_injected_recorder_clock_wins():
+    mine, planes = VirtualClock(1.0), VirtualClock(2.0)
+    rec = FlightRecorder(clock=mine, metrics=MetricsPlane(clock=planes, enabled=True), enabled=True)
+    assert rec._clock is mine
+    rec.bind_metrics(MetricsPlane(clock=planes, enabled=True))
+    assert rec._clock is mine  # late binding must not override an injection
+
+
+def test_bind_clock_marks_injection():
+    vc, late = VirtualClock(1.0), VirtualClock(2.0)
+    rec = FlightRecorder(enabled=True)
+    rec.bind_clock(vc)
+    rec.bind_metrics(MetricsPlane(clock=late, enabled=True))
+    assert rec._clock is vc
+
+
+def test_tracer_inherits_recorder_clock():
+    vc = VirtualClock(500.0)
+    plane = MetricsPlane(clock=vc, enabled=True)
+    rec = FlightRecorder(metrics=plane, enabled=True)
+    tracer = Tracer(sink=lambda r: None, recorder=rec)
+    assert tracer._clock is vc
+
+
+def test_tracer_explicit_clock_wins_over_recorder():
+    mine, recs = VirtualClock(1.0), VirtualClock(2.0)
+    rec = FlightRecorder(clock=recs, enabled=True)
+    tracer = Tracer(sink=lambda r: None, recorder=rec, clock=mine)
+    assert tracer._clock is mine
+
+
+def test_capsule_cooldown_runs_in_inherited_domain(tmp_path):
+    """End to end: the capsule cooldown ticks in the plane's virtual time.
+    Before the fix the recorder cooled down on wall seconds — a virtual-clock
+    replay that spanned simulated hours either wrote one capsule per alert
+    storm (wall barely advanced) or none at all."""
+    vc = VirtualClock(10_000.0)
+    plane = MetricsPlane(clock=vc, enabled=True)
+    rec = FlightRecorder(metrics=plane, enabled=True,
+                         capsule_dir=str(tmp_path), capsule_cooldown_s=30.0)
+    assert rec.capture("oom") is not None
+    vc.now = 10_010.0  # inside the cooldown *in virtual time*
+    assert rec.capture("oom") is None
+    assert rec.capsules_suppressed == 1
+    vc.now = 10_040.0  # cooldown elapsed in virtual time; wall barely moved
+    assert rec.capture("oom") is not None

@@ -1,0 +1,92 @@
+"""Tier-1 gate: graftflow over the host control plane stays clean beyond the baseline.
+
+Runs the dataflow tier in-process over ``FLOW_PATHS`` — the same set the CLI
+defaults to — and fails on any finding not grandfathered in
+``graftflow_baseline.json``. At HEAD that baseline is EMPTY (every launch
+finding was fixed, not grandfathered: the wall-clock defaults moved to the
+``telemetry.clocks`` resolution protocol), and the ratchet only shrinks.
+"""
+
+import time
+
+from accelerate_tpu.analysis.baseline import apply_baseline, load_baseline
+from accelerate_tpu.analysis.flow import FLOW_PATHS, run_flow
+from accelerate_tpu.analysis.flow.cli import FLOW_BASELINE_FILE
+
+
+def test_flow_clean_beyond_baseline():
+    t0 = time.monotonic()
+    findings = run_flow(paths=FLOW_PATHS)
+    elapsed = time.monotonic() - t0
+    baseline = load_baseline(FLOW_BASELINE_FILE)
+    new, _grandfathered, _stale = apply_baseline(findings, baseline)
+    listing = "\n".join(f.format() for f in new)
+    assert not new, (
+        f"{len(new)} graftflow finding(s) beyond graftflow_baseline.json:\n{listing}\n"
+        "Fix the code, or suppress ON THE FINDING'S LINE with "
+        "`# graftflow: disable=<rule>(<reason>)`. Do not add baseline entries — the "
+        "ratchet only shrinks (docs/graftflow.md)."
+    )
+    # The tier's contract is <10 s on the full control plane; a blowup here
+    # means the call-graph or CFG machinery regressed into something
+    # super-linear, not that the machine is slow.
+    assert elapsed < 10.0, f"graftflow took {elapsed:.1f}s (contract: <10s)"
+
+
+def test_flow_baseline_is_empty_at_head():
+    """The launch ratchet is fully burned down: nothing is grandfathered."""
+    baseline = load_baseline(FLOW_BASELINE_FILE)
+    assert baseline == {}, (
+        "graftflow_baseline.json grew entries — fix or suppress-with-reason "
+        "instead of grandfathering (docs/graftflow.md)"
+    )
+
+
+def test_nonexistent_flow_path_fails_loudly(capsys):
+    """A typo'd CI target must not report a clean flow run of zero files."""
+    from accelerate_tpu.analysis.flow.cli import main
+
+    assert main(["no/such/dir"]) == 2
+    assert "no such lint path" in capsys.readouterr().out
+
+
+def test_standalone_flow_entry_never_imports_jax():
+    """`python graftlint.py --flow` is the jax-free entry for this tier too."""
+    import os
+    import subprocess
+    import sys
+
+    from accelerate_tpu.analysis.engine import REPO_ROOT
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO_ROOT, "graftlint.py"), "--flow", "--check"],
+        env={**os.environ, "GRAFTLINT_ASSERT_NO_JAX": "1"},
+        cwd=REPO_ROOT,
+        capture_output=True,
+        text=True,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr or proc.stdout
+    assert "graftflow: 0 new findings" in proc.stdout
+
+
+def test_cli_smoke(capsys):
+    """The `accelerate-tpu flow` plumbing parses args and reaches the engine."""
+    from accelerate_tpu.analysis.flow.cli import main
+
+    assert main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule_id in ("flow-clock-domain", "flow-ownership", "flow-key-schedule"):
+        assert rule_id in out
+
+
+def test_lint_check_folds_flow_gate(capsys):
+    """`lint --check` runs the flow gate unless --skip-flow; the fold is how
+    a one-command CI keeps all the AST tiers honest."""
+    from accelerate_tpu.analysis.cli import flow_gate
+
+    import io
+
+    buf = io.StringIO()
+    assert flow_gate(out=buf) == 0
+    assert "graftflow: 0 new findings" in buf.getvalue()

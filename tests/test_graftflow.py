@@ -1,0 +1,373 @@
+"""Per-rule fixture tests for graftflow (``accelerate_tpu/analysis/flow/``).
+
+For every rule pack: known-bad snippets that MUST fire (including the
+exception-edge leak and use-after-transfer shapes from the incident history)
+and fixed snippets that MUST stay silent, plus the shared-suppression-grammar
+contract. Snippets are written to tmp files — the analyzer never imports
+them, so no jax/TPU is exercised here.
+"""
+
+import textwrap
+
+from accelerate_tpu.analysis import run_lint
+from accelerate_tpu.analysis.flow import flow_rules
+
+
+def flow_snippet(tmp_path, source, name="snippet.py"):
+    f = tmp_path / name
+    f.write_text(textwrap.dedent(source))
+    return run_lint(paths=(str(f),), root=str(tmp_path), rules=flow_rules())
+
+
+def rule_hits(findings, rule_id):
+    return [f for f in findings if f.rule == rule_id]
+
+
+# ----------------------------------------------------------- flow-clock-domain
+
+BAD_WALL_DEFAULT = """
+    import time
+
+    class Pacer:
+        def __init__(self, clock=time.monotonic):
+            self._clock = clock
+
+        def lap(self):
+            return self._clock()
+"""
+
+GOOD_CLOCK_COMPONENT = """
+    class Pacer:
+        def __init__(self, clock=None):
+            self._clock = clock or (lambda: 0.0)
+
+        def lap(self):
+            return self._clock()
+"""
+
+
+def test_wall_default_fires(tmp_path):
+    hits = rule_hits(
+        flow_snippet(tmp_path, BAD_WALL_DEFAULT), "flow-clock-domain"
+    )
+    assert len(hits) == 1
+    assert "defaults clock= to wall 'time.monotonic'" in hits[0].message
+    assert "telemetry.clocks" in hits[0].message
+
+
+def test_clean_clock_component_silent(tmp_path):
+    assert not rule_hits(
+        flow_snippet(tmp_path, GOOD_CLOCK_COMPONENT), "flow-clock-domain"
+    )
+
+
+BAD_WALL_REACH = """
+    import time
+
+    class Budget:
+        def __init__(self, clock=None):
+            self._clock = clock
+            self._t0 = 0.0
+
+        def remaining(self, limit):
+            return limit - self._elapsed()
+
+        def _elapsed(self):
+            return time.monotonic() - self._t0
+"""
+
+
+def test_wall_reach_through_self_method_fires(tmp_path):
+    hits = rule_hits(flow_snippet(tmp_path, BAD_WALL_REACH), "flow-clock-domain")
+    assert len(hits) == 1
+    assert "wall 'time.monotonic' reached from clock-injectable" in hits[0].message
+    assert "Budget" in hits[0].message
+    assert "via remaining -> _elapsed" in hits[0].message
+
+
+BAD_DOMAIN_MIXING = """
+    import time
+
+    def _wall_stamp():
+        return time.time()
+
+    class Window:
+        def __init__(self, clock=None):
+            self._clock = clock
+
+        def trim(self, horizon):
+            cutoff = self._clock()
+            stamp = _wall_stamp()
+            return stamp - cutoff > horizon
+"""
+
+GOOD_SINGLE_DOMAIN = """
+    class Window:
+        def __init__(self, clock=None):
+            self._clock = clock
+
+        def trim(self, horizon):
+            cutoff = self._clock()
+            stamp = self._clock()
+            return stamp - cutoff > horizon
+"""
+
+
+def test_domain_mixing_fires(tmp_path):
+    """A wall stamp (via a module helper's return summary) compared against an
+    injected-clock value — the PR-17 window-trim shape."""
+    hits = rule_hits(flow_snippet(tmp_path, BAD_DOMAIN_MIXING), "flow-clock-domain")
+    assert any("two clock domains in one expression" in f.message for f in hits)
+
+
+def test_single_domain_silent(tmp_path):
+    assert not rule_hits(
+        flow_snippet(tmp_path, GOOD_SINGLE_DOMAIN), "flow-clock-domain"
+    )
+
+
+# -------------------------------------------------------------- flow-ownership
+
+BAD_OWNERSHIP_LEAK = """
+    def rebuild(mgr, slot):
+        ids = mgr.detach_slot(slot)
+        count = len(ids)
+        return count
+"""
+
+BAD_EXCEPTION_EDGE_LEAK = """
+    def migrate(mgr, slot, table):
+        ids = mgr.detach_slot(slot)
+        try:
+            table.validate(slot)
+            mgr.release(ids)
+        except KeyError:
+            raise
+"""
+
+GOOD_FINALLY_RELEASE = """
+    def migrate(mgr, slot, table):
+        ids = mgr.detach_slot(slot)
+        try:
+            table.validate(slot)
+        finally:
+            mgr.release(ids)
+"""
+
+GOOD_TRANSFER_BY_RETURN = """
+    def carve(mgr, slot):
+        ids = mgr.detach_slot(slot)
+        return ids
+"""
+
+
+def test_ownership_leak_fires(tmp_path):
+    hits = rule_hits(flow_snippet(tmp_path, BAD_OWNERSHIP_LEAK), "flow-ownership")
+    assert len(hits) == 1
+    assert "a normal path exits without releasing" in hits[0].message
+    assert hits[0].line == 3  # reported at the acquire, where the fix goes
+
+
+def test_exception_edge_leak_fires(tmp_path):
+    """Normal path releases; the re-raising handler leaks — only the
+    exception edges in the CFG can see it."""
+    hits = rule_hits(
+        flow_snippet(tmp_path, BAD_EXCEPTION_EDGE_LEAK), "flow-ownership"
+    )
+    assert len(hits) == 1
+    assert "an exception path exits without releasing" in hits[0].message
+
+
+def test_finally_release_silent(tmp_path):
+    assert not rule_hits(
+        flow_snippet(tmp_path, GOOD_FINALLY_RELEASE), "flow-ownership"
+    )
+
+
+def test_transfer_by_return_silent(tmp_path):
+    assert not rule_hits(
+        flow_snippet(tmp_path, GOOD_TRANSFER_BY_RETURN), "flow-ownership"
+    )
+
+
+BAD_DOUBLE_RELEASE = """
+    def drain(mgr, slot):
+        ids = mgr.detach_slot(slot)
+        mgr.release(ids)
+        mgr.release(ids)
+"""
+
+BAD_USE_AFTER_TRANSFER = """
+    class PageCache:
+        def stash(self, mgr, slot):
+            ids = mgr.detach_slot(slot)
+            self.table = ids
+            mgr.release(ids)
+"""
+
+
+def test_double_release_fires(tmp_path):
+    hits = rule_hits(flow_snippet(tmp_path, BAD_DOUBLE_RELEASE), "flow-ownership")
+    assert len(hits) == 1
+    assert "releases 'ids' again" in hits[0].message
+    assert "PR-9" in hits[0].message
+    assert hits[0].line == 5
+
+
+def test_use_after_transfer_fires(tmp_path):
+    """Storing into an attribute moves ownership; the release that follows
+    touches a value this function no longer owns."""
+    hits = rule_hits(
+        flow_snippet(tmp_path, BAD_USE_AFTER_TRANSFER), "flow-ownership"
+    )
+    assert len(hits) == 1
+    assert "after ownership was transferred" in hits[0].message
+    assert hits[0].line == 6
+
+
+BAD_ZOMBIE_LANE_CLASS = """
+    class DecodeLane:
+        def start(self, request):
+            self.manager.admit(request.slot, request.pages)
+
+        def step(self):
+            return self.manager.stats()
+"""
+
+GOOD_LANE_WITH_FINALIZE = """
+    class DecodeLane:
+        def start(self, request):
+            self.manager.admit(request.slot, request.pages)
+
+        def finish(self, slot):
+            self.manager.release_slot(slot)
+"""
+
+
+def test_zombie_lane_class_fires(tmp_path):
+    hits = rule_hits(
+        flow_snippet(tmp_path, BAD_ZOMBIE_LANE_CLASS), "flow-ownership"
+    )
+    assert len(hits) == 1
+    assert "DecodeLane' acquires pages ('admit')" in hits[0].message
+    assert "zombie-lane" in hits[0].message
+
+
+def test_lane_with_finalize_silent(tmp_path):
+    assert not rule_hits(
+        flow_snippet(tmp_path, GOOD_LANE_WITH_FINALIZE), "flow-ownership"
+    )
+
+
+# ----------------------------------------------------------- flow-key-schedule
+
+BAD_KEY_CROSSES_BOUNDARY = """
+    import jax.random as jr
+
+    def helper_draw(key, n):
+        return jr.normal(key, (n,))
+
+    def sample_pair(key, shape):
+        noise = jr.normal(key, shape)
+        extra = helper_draw(key, 4)
+        return noise + extra
+"""
+
+GOOD_KEY_SPLIT_BEFORE_CALL = """
+    import jax.random as jr
+
+    def helper_draw(key, n):
+        return jr.normal(key, (n,))
+
+    def sample_pair(key, shape):
+        k1, k2 = jr.split(key)
+        noise = jr.normal(k1, shape)
+        extra = helper_draw(k2, 4)
+        return noise + extra
+"""
+
+LOCAL_DOUBLE_CONSUME = """
+    import jax.random as jr
+
+    def double_local(key, shape):
+        a = jr.normal(key, shape)
+        b = jr.normal(key, shape)
+        return a + b
+"""
+
+
+def test_key_reuse_across_call_boundary_fires(tmp_path):
+    hits = rule_hits(
+        flow_snippet(tmp_path, BAD_KEY_CROSSES_BOUNDARY), "flow-key-schedule"
+    )
+    assert len(hits) == 1
+    assert "consumes rng key 'key' again inside a callee" in hits[0].message
+    assert "split" in hits[0].message
+    assert hits[0].line == 9
+
+
+def test_key_split_before_call_silent(tmp_path):
+    assert not rule_hits(
+        flow_snippet(tmp_path, GOOD_KEY_SPLIT_BEFORE_CALL), "flow-key-schedule"
+    )
+
+
+def test_purely_local_double_consume_stays_local_rules(tmp_path):
+    """One tier owns each finding class: a double consume with no call
+    boundary involved is graftlint's rng-key-reuse, not graftflow's."""
+    assert not rule_hits(
+        flow_snippet(tmp_path, LOCAL_DOUBLE_CONSUME), "flow-key-schedule"
+    )
+
+
+# ------------------------------------------------------- suppressions & engine
+
+SUPPRESSED_LEAK = """
+    def rebuild(mgr, slot):
+        ids = mgr.detach_slot(slot)  # graftflow: disable=flow-ownership(fixture: leak is the point)
+        return len(ids)
+"""
+
+CROSS_TIER_SUPPRESSION = """
+    def rebuild(mgr, slot):
+        ids = mgr.detach_slot(slot)  # graftflow: disable=flow-ownership(fixture), host-sync-in-hot-path(shared grammar)
+        return len(ids)
+"""
+
+UNKNOWN_RULE_SUPPRESSION = """
+    def rebuild(mgr, slot):
+        ids = mgr.detach_slot(slot)  # graftflow: disable=flow-bogus(no such rule)
+        return len(ids)
+"""
+
+
+def test_graftflow_suppression_with_reason_honored(tmp_path):
+    findings = flow_snippet(tmp_path, SUPPRESSED_LEAK)
+    assert not rule_hits(findings, "flow-ownership")
+    assert not rule_hits(findings, "bad-suppression")
+
+
+def test_suppression_grammar_is_shared_across_tiers(tmp_path):
+    """A ``# graftflow:`` comment may name a graftlint rule id (and vice
+    versa) — the tiers validate against the union, never each other's noise."""
+    findings = flow_snippet(tmp_path, CROSS_TIER_SUPPRESSION)
+    assert not rule_hits(findings, "bad-suppression")
+
+
+def test_unknown_rule_in_suppression_lists_catalog(tmp_path):
+    hits = rule_hits(
+        flow_snippet(tmp_path, UNKNOWN_RULE_SUPPRESSION), "bad-suppression"
+    )
+    assert len(hits) == 1
+    # The error names every tier so a misdirected suppression finds its home.
+    for tier in ("graftlint:", "graftflow:", "graftaudit:", "graftmem:"):
+        assert tier in hits[0].message
+    assert "flow-ownership" in hits[0].message
+
+
+def test_flow_rule_catalog():
+    ids = {r.id for r in flow_rules()}
+    assert ids == {"flow-clock-domain", "flow-ownership", "flow-key-schedule"}
+    for r in flow_rules():
+        assert r.severity == "error"
+        assert r.description

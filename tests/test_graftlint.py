@@ -138,6 +138,59 @@ def test_host_sync_suppressed_with_reason(tmp_path):
     assert not rule_hits(findings, "bad-suppression")
 
 
+# --------------------------------------------- host-sync: wall sleep in step loops
+
+BAD_WALL_SLEEP = """
+    import time
+
+    class MiniFleetRouter:
+        def drive(self, requests):
+            while requests:
+                self.dispatch(requests.pop())
+                time.sleep(0.01)            # blocks every replica per step
+
+    def replay_workload(trace, gateway):
+        for event in trace:
+            gateway.submit(event)
+            time.sleep(event.gap_s)         # deadlocks a virtual-clock replay
+"""
+
+GOOD_WALL_SLEEP = """
+    import time
+
+    class MiniFleetRouter:
+        def __init__(self, sleep=None):
+            self._sleep = sleep or time.sleep   # resolution, outside any loop
+
+        def drive(self, requests):
+            while requests:
+                self.dispatch(requests.pop())
+                self._sleep(0.01)           # injected sleep: replayable
+
+    class ElasticSupervisor:                # not a gateway/router/fleet scope
+        def run(self):
+            while True:
+                time.sleep(0.05)
+
+    def warm_start(engine):                 # not a replay-named function
+        for _ in range(3):
+            time.sleep(0.1)
+"""
+
+
+def test_wall_sleep_in_step_loop_fires(tmp_path):
+    hits = rule_hits(lint_snippet(tmp_path, BAD_WALL_SLEEP), "host-sync-in-hot-path")
+    msgs = " ".join(f.message for f in hits)
+    assert len(hits) == 2, hits
+    assert "MiniFleetRouter" in msgs and "replay_workload" in msgs
+    assert "time.sleep" in msgs and "virtual-clock" in msgs
+
+
+def test_wall_sleep_clean_scopes(tmp_path):
+    hits = rule_hits(lint_snippet(tmp_path, GOOD_WALL_SLEEP), "host-sync-in-hot-path")
+    assert not [f for f in hits if "sleep" in f.message], hits
+
+
 # The telemetry fence helpers are the SANCTIONED sync points (ISSUE 2 satellite):
 # hot loops instrumented through them need no suppressions, while a raw
 # block_until_ready in the same position still fires.

@@ -472,3 +472,81 @@ def test_block_manager_soak_randomized_lifecycle():
         check_invariants()
     assert rebuilds >= 50  # the 0.05 arm actually exercised recovery
     assert detaches >= 50 and adoptions >= 50  # the handoff arms really ran
+
+
+# ----------------------------------------- ownership adversarial scenarios
+# Runtime twins of the graftflow flow-ownership fixtures (tests/
+# test_graftflow.py): each static finding shape, driven against a real
+# BlockManager to show the concrete damage the rule is guarding against.
+
+
+def test_exception_mid_handoff_finally_releases():
+    """The GOOD_FINALLY_RELEASE shape: a fault injected mid-handoff still
+    returns every page because the release sits on the exception edge too."""
+    mgr = BlockManager(num_pages=8, page_size=4, max_slots=2, max_len=32)
+    mgr.admit(0, 12)
+    with pytest.raises(RuntimeError):
+        ids = mgr.detach_slot(0)
+        try:
+            raise RuntimeError("fault injected mid-handoff")
+        finally:
+            mgr.release(ids)
+    assert mgr.pages_in_use == 0
+    assert len(mgr._free) == mgr.num_pages
+
+
+def test_exception_mid_handoff_without_release_leaks():
+    """The BAD_EXCEPTION_EDGE_LEAK shape at runtime: a handler that swallows
+    the fault without releasing leaves referenced pages no lane or record can
+    reach — exactly what the static exception-edge check reports."""
+    mgr = BlockManager(num_pages=8, page_size=4, max_slots=2, max_len=32)
+    mgr.admit(0, 12)
+    ids = mgr.detach_slot(0)
+    try:
+        raise RuntimeError("fault injected mid-handoff")
+    except RuntimeError:
+        pass  # forgot the release
+    assert mgr.pages_in_use == 3  # leaked: referenced, but ownerless
+    assert not mgr.can_admit(mgr.max_len)  # the pool is silently smaller
+    mgr.release(ids)  # only the leaked local could ever repair it
+    assert mgr.pages_in_use == 0
+
+
+def test_double_release_trips_refcount_invariant():
+    """The BAD_DOUBLE_RELEASE shape: the second release drives a refcount
+    negative and the PR-9 invariant assertion fires at runtime — graftflow
+    reports the same pair statically, before any pool sees it."""
+    mgr = BlockManager(num_pages=8, page_size=4, max_slots=2, max_len=32)
+    mgr.admit(0, 12)
+    ids = [int(p) for p in mgr.detach_slot(0)]
+    mgr.release(ids)
+    with pytest.raises(AssertionError):
+        mgr.release(ids)
+
+
+def test_use_after_transfer_steals_new_owners_reference():
+    """The BAD_USE_AFTER_TRANSFER shape: after ownership moved (registry
+    entry), the old holder's release consumes the new owner's reference —
+    the new owner's own legitimate finalize then corrupts the refcounts.
+    Transfers are linear; the new owner's copy is the only live one."""
+    mgr = BlockManager(num_pages=8, page_size=4, max_slots=2, max_len=32)
+    mgr.admit(0, 12)
+    ids = mgr.detach_slot(0)
+    registry_entry = list(int(p) for p in ids)  # ownership transferred
+    mgr.release(ids)  # old holder uses the moved value anyway
+    with pytest.raises(AssertionError):
+        mgr.release(registry_entry)  # new owner's finalize now goes negative
+
+
+def test_zombie_lane_starves_the_pool():
+    """The BAD_ZOMBIE_LANE_CLASS shape: lanes that admit but never finalize
+    hold the pool hostage — no fault, no error, just a pool that can never
+    admit again (PR-10). Finalizing restores every page."""
+    mgr = BlockManager(num_pages=8, page_size=4, max_slots=4, max_len=32)
+    mgr.admit(0, 16)
+    mgr.admit(1, 16)
+    assert mgr.free_pages == 0
+    assert not mgr.can_admit(1)
+    mgr.release_slot(0)
+    mgr.release_slot(1)
+    assert mgr.free_pages == mgr.num_pages
