@@ -22,8 +22,8 @@ import argparse
 from ..spec_decode import DraftSource
 
 __all__ = ["run_serve_bench", "run_chaos_bench", "run_fleet_chaos_bench",
-           "run_disagg_bench", "run_spec_bench", "serve_bench_command",
-           "serve_bench_command_parser"]
+           "run_autoscale_bench", "run_disagg_bench", "run_spec_bench",
+           "serve_bench_command", "serve_bench_command_parser"]
 
 #: Policy rows a plain run emits, in order.
 ALL_POLICIES = ("fifo", "priority", "edf", "wfq")
@@ -201,6 +201,24 @@ def serve_bench_command_parser(subparsers=None) -> argparse.ArgumentParser:
     parser.add_argument("--disagg-out", default="BENCH_DISAGG.json",
                         metavar="OUT_JSON",
                         help="artifact path for --disagg")
+    parser.add_argument("--autoscale", default=None, metavar="OUT_JSON",
+                        help="run the closed-loop autoscaling proof: one "
+                             "diurnal swing trace replayed static-small / "
+                             "static-peak / autoscaled on a shared virtual "
+                             "clock (plus steady no-thrash, tenant-flood "
+                             "bounded-events and crash-mid-scale-down chaos "
+                             "arms) and write BENCH_AUTOSCALE.json to this "
+                             "path. Gates: autoscaled attainment within band "
+                             "of the peak arm at strictly fewer replica-"
+                             "hours, zero silently-lost in every arm, "
+                             "byte-identical streams, bounded scale events")
+    parser.add_argument("--autoscale-min", type=int, default=1,
+                        help="autoscaler floor / static-small fleet size")
+    parser.add_argument("--autoscale-max", type=int, default=3,
+                        help="autoscaler ceiling / static-peak fleet size")
+    parser.add_argument("--swing-ratio", type=float, default=4.0,
+                        help="peak:trough offered-load ratio of the "
+                             "--autoscale swing trace")
     if subparsers is not None:
         parser.set_defaults(func=serve_bench_command)
     return parser
@@ -1295,6 +1313,353 @@ def run_fleet_chaos_bench(
     }
 
 
+def _replay_autoscaled(params, cfg, policy, trace, *, n_start, max_slots,
+                       max_len, prompt_bucket, max_queue, load, step_dt, seed,
+                       controller, metrics_window_s=60.0,
+                       on_token_factory=None, chaos=False):
+    """One autoscaled arm: a FleetRouter born at ``n_start`` replicas with a
+    live metrics plane and an :class:`Autoscaler` armed with the stock rule
+    pair, replayed on a virtual clock → ``(router, scaler, greqs, kill)``.
+    ``controller`` carries the Autoscaler kwargs plus a nested ``rules`` dict
+    for :func:`default_autoscale_rules`. ``chaos=True`` crashes one replica
+    the moment the FIRST scale-down decision lands — the drain victim itself
+    while it still holds in-flight work, else the busiest survivor — so the
+    arm proves a crash mid-scale-down still loses nothing."""
+    import numpy as np
+
+    from ..serving import ContinuousBatcher
+    from ..serving_gateway import (ACTIVE, DRAINING, Autoscaler, FleetRouter,
+                                   default_autoscale_rules)
+    from ..serving_gateway.workload import VirtualClock
+    from ..telemetry import Telemetry
+    from ..utils.dataclasses import GatewayConfig, TelemetryConfig
+
+    clock = VirtualClock()
+    telemetry = Telemetry(TelemetryConfig(enabled=True, compile_events=False,
+                                          memory_stats=False))
+
+    def build_engine(rid):
+        return ContinuousBatcher(
+            params, cfg, max_slots=max_slots, max_len=max_len,
+            prompt_bucket=prompt_bucket, telemetry=telemetry,
+        )
+
+    router = FleetRouter(
+        [build_engine(rid) for rid in range(n_start)],
+        GatewayConfig(enabled=True, policy=policy, max_queue=max_queue,
+                      overload="shed", aging_s=5.0, breaker_threshold=3,
+                      replica_restarts=4, replica_restart_backoff=0.0,
+                      metrics=True, metrics_window_s=metrics_window_s),
+        telemetry=telemetry, clock=clock, engine_factory=build_engine,
+    )
+    controller = dict(controller)
+    up, down = default_autoscale_rules(**controller.pop("rules", {}))
+    scaler = Autoscaler(router, up_rules=up, down_rules=down, **controller)
+
+    prompt_rng = np.random.default_rng(seed)
+    prompts = [
+        prompt_rng.integers(1, cfg.vocab_size, row.prompt_len).astype(np.int32)
+        for row in trace
+    ]
+    greqs = []
+    i = 0
+    steps = 0
+    cap = 200 * max(1, len(trace))
+    kill = None
+    # The replay_trace loop with one hook: after the router step (scale
+    # decisions land at the END of step(), inside the autoscaler poll), the
+    # chaos arm gets to crash a replica mid-scale-down.
+    while i < len(trace) or router.queue_depth or router.running_count:
+        while i < len(trace) and trace[i].arrival_s / load <= clock.t:
+            row = trace[i]
+            kwargs = {}
+            if on_token_factory is not None:
+                cbs = on_token_factory(i)
+                if isinstance(cbs, tuple):
+                    kwargs["on_token"], kwargs["on_retry"] = cbs
+                else:
+                    kwargs["on_token"] = cbs
+            greqs.append(router.submit(
+                prompts[i], max_new_tokens=row.output_len,
+                priority=row.priority, deadline_s=row.deadline_s,
+                tenant=row.tenant, **kwargs,
+            ))
+            i += 1
+        router.step()
+        if chaos and kill is None:
+            down_ev = next((e for e in scaler.events
+                            if e["action"] == "scale_down"), None)
+            if down_ev is not None:
+                victim = router._replicas[down_ev["replica"]]
+                target = victim if (victim.state == DRAINING
+                                    and victim.running) else None
+                if target is None:
+                    live = [rep for rep in router._replicas
+                            if rep.state in (ACTIVE, DRAINING)]
+                    target = max(live,
+                                 key=lambda rep: (len(rep.running), -rep.rid),
+                                 default=None)
+                if target is not None:
+                    in_flight = len(target.running)
+                    router.kill(target.rid, reason="chaos_mid_scale_down")
+                    kill = {"replica": target.rid, "in_flight": in_flight,
+                            "t": round(clock.t, 3),
+                            "was_drain_victim": target.rid == victim.rid}
+        clock.advance(step_dt)
+        steps += 1
+        if steps >= cap:
+            raise RuntimeError(
+                f"autoscale replay exceeded {cap} steps with work pending — "
+                "the fleet stopped making progress"
+            )
+    return router, scaler, greqs, kill
+
+
+def run_autoscale_bench(
+    preset: str = "smoke",
+    requests: int = 48,
+    max_slots: int = 2,
+    max_len: int = 128,
+    prompt_bucket: int = 16,
+    overload: float = 4.0,
+    load: float = 1.0,
+    step_dt: float = 1.0,
+    seed: int = 0,
+    policy: str = "fifo",
+    min_replicas: int = 1,
+    max_replicas: int = 3,
+    swing_ratio: float = 4.0,
+    mean_load: float = 1.5,
+    cooldown_s: float = 12.0,
+    down_cooldown_s: float = 10.0,
+    idle_window_s: float = 12.0,
+    forecast_window_s: float = 8.0,
+    attainment_band: float = 0.10,
+    telemetry=None,
+) -> dict:
+    """The autoscaling proof (BENCH_AUTOSCALE.json): ONE diurnal ``swing``
+    trace (``swing_ratio`` peak:trough, mean offered load ``mean_load`` × one
+    replica's calibrated capacity) replayed three ways on the shared virtual
+    clock —
+
+    1. **static_small**: ``min_replicas`` replicas, no controller (what the
+       trough needs — the peak overruns it);
+    2. **static_peak**: ``max_replicas`` replicas, no controller (provisioned
+       for the peak — the trough wastes it);
+    3. **autoscaled**: born at ``min_replicas`` with the :class:`Autoscaler`
+       closed loop (stock rule pair + predictive forecaster), bounds
+       ``[min_replicas, max_replicas]``.
+
+    Gates (CLI exits non-zero otherwise): the autoscaled arm's deadline
+    attainment within ``attainment_band`` of static_peak at STRICTLY fewer
+    replica-hours; zero silently-lost requests through every scale-down in
+    every arm; migrated/autoscaled streams byte-identical to static_peak for
+    every request done in both.
+
+    Plus three controller-integrity arms: **steady** (a flat poisson trace on
+    a fleet provisioned at its floor — the controller must fire ZERO scale
+    events: any event here is thrash or a broken capacity estimate),
+    **flood** (a tenant-flood burst — total scale events bounded by one ramp
+    up + one ramp down across the bounds, the no-oscillation proof), and
+    **chaos** (the swing trace where the first scale-down decision is
+    answered with a replica crash — still nothing lost, streams still
+    byte-identical)."""
+    from ..compile_cache.warmup import build_model_config
+    from ..models import llama
+    from ..serving_gateway.workload import generate_workload, trace_hash
+    from ..telemetry.provenance import provenance_stamp
+
+    if max_replicas < min_replicas + 1:
+        raise ValueError(
+            f"max_replicas={max_replicas} must exceed min_replicas="
+            f"{min_replicas} — a fixed-size fleet has nothing to autoscale")
+    cfg = build_model_config(preset, max_len)
+    params = llama.init_params(cfg)
+    # One queue bound for every arm (sized to the PEAK fleet): admission is
+    # apples-to-apples, so attainment differences are scheduling + capacity,
+    # never queue geometry.
+    max_queue = max(1, int(overload * max_replicas * max_slots))
+    mean_iat = _calibrated_iat(max_slots) / mean_load
+    duration = requests * mean_iat
+    period_s = duration / 1.25  # one full swing cycle + a quarter of the next
+    trace = generate_workload("swing", requests, seed=seed,
+                              mean_iat_s=mean_iat, period_s=period_s,
+                              swing_ratio=swing_ratio)
+    # The steady arm is CORRECTLY provisioned: flat load sized to half the
+    # floor fleet's capacity, so any scale event the controller fires there
+    # is thrash (or a broken capacity estimate), never a real need.
+    steady_trace = generate_workload("poisson", requests, seed=seed + 1,
+                                     mean_iat_s=_calibrated_iat(max_slots))
+    flood_trace = generate_workload("tenant_flood", requests, seed=seed + 2,
+                                    mean_iat_s=mean_iat)
+    prov = provenance_stamp(cfg)
+    _warm_serving_surface(params, cfg, max_slots, max_len, prompt_bucket,
+                          seed=seed)
+
+    # Rule windows scaled to the trace's timescale; the metrics plane horizon
+    # covers the widest of them (the burn rule's slow window).
+    controller = dict(
+        min_replicas=min_replicas, max_replicas=max_replicas,
+        cooldown_s=cooldown_s, down_cooldown_s=down_cooldown_s,
+        forecast_window_s=forecast_window_s,
+        rules=dict(queue_window_s=10.0, idle_lane_floor=float(max_slots),
+                   idle_clear=float(max_slots) + 1.0,
+                   idle_window_s=idle_window_s, objective=0.9,
+                   fast_window_s=10.0, slow_window_s=40.0,
+                   burn_threshold=2.0),
+    )
+
+    def stream_capture():
+        streams = {}
+
+        def factory(i):
+            streams[i] = []
+
+            def on_token(tok, i=i):
+                streams[i].append(int(tok))
+
+            def on_retry(i=i):
+                streams[i].clear()
+
+            return on_token, on_retry
+
+        return streams, factory
+
+    fleet_common = dict(max_slots=max_slots, max_len=max_len,
+                        prompt_bucket=prompt_bucket, max_queue=max_queue,
+                        load=load, step_dt=step_dt, seed=seed,
+                        telemetry=telemetry)
+    auto_common = dict(max_slots=max_slots, max_len=max_len,
+                       prompt_bucket=prompt_bucket, max_queue=max_queue,
+                       load=load, step_dt=step_dt, seed=seed,
+                       metrics_window_s=60.0)
+
+    r_small, g_small = _replay_fleet(
+        params, cfg, policy, trace, n_replicas=min_replicas, **fleet_common)
+    peak_streams, peak_factory = stream_capture()
+    r_peak, g_peak = _replay_fleet(
+        params, cfg, policy, trace, n_replicas=max_replicas,
+        on_token_factory=peak_factory, **fleet_common)
+    auto_streams, auto_factory = stream_capture()
+    r_auto, s_auto, g_auto, _ = _replay_autoscaled(
+        params, cfg, policy, trace, n_start=min_replicas,
+        controller=controller, on_token_factory=auto_factory, **auto_common)
+    # Steady arm: flat load, fleet born AT its floor (min == start), so the
+    # only possible events are spurious — the controller must stay silent.
+    steady_controller = dict(controller,
+                             min_replicas=min(2, max_replicas),
+                             max_replicas=max_replicas)
+    r_steady, s_steady, g_steady, _ = _replay_autoscaled(
+        params, cfg, policy, steady_trace,
+        n_start=steady_controller["min_replicas"],
+        controller=steady_controller, **auto_common)
+    r_flood, s_flood, g_flood, _ = _replay_autoscaled(
+        params, cfg, policy, flood_trace, n_start=min_replicas,
+        controller=controller, **auto_common)
+    chaos_streams, chaos_factory = stream_capture()
+    r_chaos, s_chaos, g_chaos, chaos_kill = _replay_autoscaled(
+        params, cfg, policy, trace, n_start=min_replicas,
+        controller=controller, on_token_factory=chaos_factory, chaos=True,
+        **auto_common)
+
+    def parity(streams, greqs):
+        compared = mismatched = 0
+        for i in range(len(trace)):
+            if g_peak[i].status == "done" and greqs[i].status == "done":
+                compared += 1
+                if peak_streams.get(i) != streams.get(i):
+                    mismatched += 1
+        return compared, mismatched
+
+    compared, mismatched = parity(auto_streams, g_auto)
+    chaos_compared, chaos_mismatched = parity(chaos_streams, g_chaos)
+
+    def arm(router, greqs, scaler=None):
+        row = {**_fleet_arm_summary(router, greqs),
+               **_attainment_point(router, greqs, load),
+               "replica_hours": round(router.replica_hours, 6),
+               "replica_spawned": router.counters["replica_spawned"]}
+        if scaler is not None:
+            stats = scaler.stats()
+            row["scale_events"] = stats["scale_events"]
+            row["scale_actions"] = stats["actions"]
+            row["service_rate_per_lane"] = stats["service_rate_per_lane"]
+            row["scale_records"] = list(scaler.events)
+        return row
+
+    small_arm = arm(r_small, g_small)
+    peak_arm = arm(r_peak, g_peak)
+    auto_arm = arm(r_auto, g_auto, s_auto)
+    steady_arm = arm(r_steady, g_steady, s_steady)
+    flood_arm = arm(r_flood, g_flood, s_flood)
+    chaos_arm = arm(r_chaos, g_chaos, s_chaos)
+
+    # One ramp up + one ramp down across the bounds, plus one event of slack:
+    # a controller that oscillates blows straight through this.
+    flood_bound = 2 * (max_replicas - min_replicas) + 1
+    att_peak = peak_arm["attainment"]
+    att_auto = auto_arm["attainment"]
+    lost = {name: a["silently_lost"]
+            for name, a in (("static_small", small_arm),
+                            ("static_peak", peak_arm),
+                            ("autoscaled", auto_arm),
+                            ("steady", steady_arm),
+                            ("flood", flood_arm),
+                            ("chaos", chaos_arm))}
+    return {
+        "schema": "accelerate_tpu.bench.autoscale/v1",
+        "preset": preset,
+        "policy": policy,
+        "generator": "swing",
+        "requests": requests,
+        "min_replicas": min_replicas,
+        "max_replicas": max_replicas,
+        "max_slots_per_replica": max_slots,
+        "max_queue": max_queue,
+        "swing_ratio": swing_ratio,
+        "mean_load": mean_load,
+        "mean_iat_s": round(mean_iat, 4),
+        "period_s": round(period_s, 2),
+        "load": load,
+        "controller": {k: v for k, v in controller.items() if k != "rules"},
+        "rules": controller["rules"],
+        "workload_trace_hash": trace_hash(trace),
+        "provenance": prov,
+        # The headline gates.
+        "attainment_band": attainment_band,
+        "attainment_within_band": (
+            att_peak is not None and att_auto is not None
+            and att_auto >= att_peak - attainment_band),
+        "replica_hours": {"static_small": small_arm["replica_hours"],
+                          "static_peak": peak_arm["replica_hours"],
+                          "autoscaled": auto_arm["replica_hours"]},
+        "replica_hours_fewer": (
+            auto_arm["replica_hours"] < peak_arm["replica_hours"]),
+        "silently_lost_by_arm": lost,
+        "zero_lost_all_arms": not any(lost.values()),
+        "streams_compared": compared,
+        "streams_identical": mismatched == 0,
+        "streams_mismatched": mismatched,
+        # Controller-integrity gates.
+        "steady_scale_events": steady_arm["scale_events"],
+        "steady_no_scale": steady_arm["scale_events"] == 0,
+        "flood_scale_events": flood_arm["scale_events"],
+        "flood_bound": flood_bound,
+        "flood_bounded": flood_arm["scale_events"] <= flood_bound,
+        "chaos_kill": chaos_kill,
+        "chaos_scale_down_observed": any(
+            e["action"] == "scale_down" for e in s_chaos.events),
+        "chaos_streams_compared": chaos_compared,
+        "chaos_streams_identical": chaos_mismatched == 0,
+        "static_small": small_arm,
+        "static_peak": peak_arm,
+        "autoscaled": auto_arm,
+        "steady": steady_arm,
+        "flood": flood_arm,
+        "chaos": chaos_arm,
+    }
+
+
 class _EngineMeter:
     """Per-replica busy/stall accounting for the disagg bench, measured where
     the claim lives: inside ONE replica's own host loop. ``stall_lane_s`` is
@@ -2281,6 +2646,55 @@ def serve_bench_command(args) -> int:
             bad = bad or not artifact["stall_improved"] \
                 or not artifact["ttft_p95_improved"]
         return 1 if bad else 0
+
+    if args.autoscale:
+        if args.smoke:
+            # CI tier-1 autoscale shape: short swing trace, 2 lanes/replica —
+            # the closed-loop gates (attainment within band at fewer replica-
+            # hours, zero lost, byte-identical streams, bounded events) hold
+            # at smoke scale because every clock is virtual.
+            args.requests = min(args.requests, 24)
+            args.max_slots = 2
+            args.max_len = 64
+            args.prompt_bucket = 16
+        artifact = run_autoscale_bench(
+            preset=args.preset,
+            requests=args.requests,
+            max_slots=args.max_slots,
+            max_len=args.max_len,
+            prompt_bucket=args.prompt_bucket,
+            overload=args.overload,
+            load=1.0 if args.load is None else args.load,
+            seed=args.seed,
+            policy=args.policy if args.policy != "all" else "fifo",
+            min_replicas=args.autoscale_min,
+            max_replicas=args.autoscale_max,
+            swing_ratio=args.swing_ratio,
+        )
+        with open(args.autoscale, "w") as f:
+            json.dump(artifact, f, indent=2)
+        print(json.dumps({k: artifact[k] for k in (
+            "schema", "min_replicas", "max_replicas", "workload_trace_hash",
+            "attainment_within_band", "replica_hours", "replica_hours_fewer",
+            "zero_lost_all_arms", "streams_compared", "streams_identical",
+            "steady_scale_events", "flood_scale_events", "flood_bound",
+            "chaos_streams_identical",
+        )} | {
+            "attainment_autoscaled": artifact["autoscaled"]["attainment"],
+            "attainment_peak": artifact["static_peak"]["attainment"],
+            "scale_events": artifact["autoscaled"]["scale_events"],
+            "scale_actions": artifact["autoscaled"]["scale_actions"],
+            "chaos_kill": artifact["chaos_kill"],
+        }))
+        return 1 if (not artifact["attainment_within_band"]
+                     or not artifact["replica_hours_fewer"]
+                     or not artifact["zero_lost_all_arms"]
+                     or not artifact["streams_identical"]
+                     or not artifact["steady_no_scale"]
+                     or not artifact["flood_bounded"]
+                     or artifact["autoscaled"]["scale_actions"]["scale_up"] < 1
+                     or not artifact["chaos_scale_down_observed"]
+                     or not artifact["chaos_streams_identical"]) else 0
 
     if args.chaos and args.fleet:
         if args.smoke:

@@ -22,6 +22,11 @@ run decode-only lanes at high occupancy; failover stays lossless (re-prefill on
 a dead prefill replica, re-adoption from still-refcounted pages on a dead
 decode replica).
 
+Autoscaling tier (``autoscaler.Autoscaler``, docs/autoscaling.md): alert
+transitions become scale actions — closed-loop fleet sizing with hysteresis
+scale-down, predictive scale-up and role-ratio control for disagg fleets,
+deterministic under virtual-clock replay.
+
 Enable via ``GatewayConfig`` / ``ACCELERATE_GATEWAY`` and build with::
 
     gw = ServingGateway(engine, GatewayConfig(enabled=True, policy="edf"))
@@ -29,6 +34,10 @@ Enable via ``GatewayConfig`` / ``ACCELERATE_GATEWAY`` and build with::
     gw.run()
 """
 
+from .autoscaler import (
+    Autoscaler,
+    default_autoscale_rules,
+)
 from .disagg import (
     DisaggRouter,
     parse_roles,
@@ -90,6 +99,8 @@ __all__ = [
     "ServingGateway",
     "GatewayRequest",
     "CircuitBreaker",
+    "Autoscaler",
+    "default_autoscale_rules",
     "DisaggRouter",
     "parse_roles",
     "FleetRouter",

@@ -97,6 +97,10 @@ class Replica:
         self.fail_times: List[float] = []  # recency window for the health score
         self.drain_deadline: Optional[float] = None
         self.restarts = 0
+        #: Autoscaler decommission flag: when the drain completes, RETIRE the
+        #: replica (charging no supervisor restart budget) instead of
+        #: restarting it — scale-down is a planned exit, not a failure.
+        self.retire_on_drain = False
 
     @property
     def gang_id(self) -> str:
@@ -168,9 +172,18 @@ class FleetRouter(ServingGateway):
             )))
         self.counters.update({
             "migrated": 0, "replica_kills": 0, "replica_restarts": 0,
-            "replica_retired": 0,
+            "replica_retired": 0, "replica_spawned": 0,
         })
         self._steps = 0
+        #: Cumulative replica-hours (ACTIVE + DRAINING replicas integrated
+        #: over router-clock time) — the cost axis of the autoscale bench's
+        #: attainment-per-replica-hour economics.
+        self.replica_hours = 0.0
+        self._last_step_t: Optional[float] = None
+        #: Attached :class:`~.autoscaler.Autoscaler` (polled at the end of
+        #: every step, AFTER health emission, so decisions read this step's
+        #: signals and land deterministically on the router clock).
+        self._autoscaler = None
         #: Replica ids still awaiting their turn in a rolling restart.
         self._rolling: List[int] = []
         self._rolling_deadline_s: Optional[float] = None
@@ -312,6 +325,11 @@ class FleetRouter(ServingGateway):
         the per-replica ``replica.health/v1`` records."""
         now = self._clock()
         self._steps += 1
+        if self._last_step_t is not None and now > self._last_step_t:
+            live = sum(1 for rep in self._replicas
+                       if rep.state in (ACTIVE, DRAINING))
+            self.replica_hours += (now - self._last_step_t) / 3600.0 * live
+        self._last_step_t = now
         # Terminals finalized between steps (out-of-band kill → backlog flush)
         # are reported by THIS step — never silently dropped.
         events: List[GatewayRequest] = self._pending_events
@@ -392,6 +410,8 @@ class FleetRouter(ServingGateway):
         events.extend(self._pending_events)
         self._pending_events = []
         self._emit_health(now)
+        if self._autoscaler is not None:
+            self._autoscaler.poll(self._clock())
         return sorted(events, key=lambda r: r.uid)
 
     def run(self, report_slo: bool = False):
@@ -477,6 +497,56 @@ class FleetRouter(ServingGateway):
         rep.engine.crashed = True
         self._replica_died(rep, reason, self._clock())
 
+    # ------------------------------------------------------------ scale up / down
+    def spawn_replica(self, role: Optional[str] = None) -> Replica:
+        """Scale-up actuator: append a fresh replica built by
+        ``engine_factory`` (same geometry as the fleet — validated), with its
+        own breaker started HALF-OPEN so the newcomer earns full routing
+        through one probe, exactly like a restarted replica. ``role`` is
+        rejected here; the disagg router's override grows its role table."""
+        if self.engine_factory is None:
+            raise ValueError(
+                "spawn_replica needs an engine_factory — a fleet that cannot "
+                "build engines cannot grow"
+            )
+        if role is not None:
+            raise ValueError(
+                "role-aware spawning is a DisaggRouter capability; a flat "
+                "fleet has no roles"
+            )
+        rid = len(self._replicas)
+        engine = self.engine_factory(rid)
+        ref = self._replicas[0].engine
+        geo = (engine.max_slots, engine.max_len, engine.prompt_bucket,
+               engine.page_size)
+        ref_geo = (ref.max_slots, ref.max_len, ref.prompt_bucket, ref.page_size)
+        if geo != ref_geo:
+            raise ValueError(
+                f"spawned replica geometry {geo} != fleet geometry {ref_geo}: "
+                "the admission cost model prices ONE layout"
+            )
+        if self.tracer is not None and getattr(engine, "tracer", None) is None:
+            engine.tracer = self.tracer
+        cfg = self.config
+        rep = Replica(rid, engine, CircuitBreaker(
+            cfg.breaker_threshold, cfg.breaker_window_s, cfg.breaker_cooldown_s,
+        ))
+        self._replicas.append(rep)
+        self.counters["replica_spawned"] += 1
+        if rep.breaker.enabled:
+            rep.breaker.force_half_open()  # one probe earns full routing
+        self._emit_fleet_recovery("replica_spawn", rep, self._clock())
+        return rep
+
+    def decommission(self, rid: int, deadline_s: Optional[float] = None) -> Replica:
+        """Scale-down actuator: drain replica ``rid`` (in-flight requests
+        finish, or migrate byte-identically past the deadline) and RETIRE it
+        when the drain completes instead of restarting — a planned exit that
+        charges no supervisor restart budget."""
+        rep = self.drain(rid, deadline_s)
+        rep.retire_on_drain = True
+        return rep
+
     # ------------------------------------------------------------ drain / restart
     def drain(self, rid: int, deadline_s: Optional[float] = None) -> Replica:
         """Stop routing new admissions to replica ``rid``; in-flight requests
@@ -543,6 +613,15 @@ class FleetRouter(ServingGateway):
         cycle without replacement still re-proves health), then the half-open
         probe warm-up: the replica serves ONE probe request before regaining
         full routing."""
+        if rep.retire_on_drain:
+            # Autoscaler decommission: the drain completing means the replica
+            # leaves the fleet for good — no supervisor budget charge (this
+            # is not a failure), no restart. Routed through _restart so the
+            # disagg override's live-handoff drain guard protects scale-down
+            # exactly like a rolling restart.
+            rep.drain_deadline = None
+            self._retire(rep, now)
+            return
         if self.engine_factory is not None:
             rep.engine = self.engine_factory(rep.rid)
             if self.tracer is not None and getattr(rep.engine, "tracer", None) is None:

@@ -14,8 +14,10 @@ ROADMAP item 5:
   (lengths and arrival structure are what serving performance depends on).
 - **Generators** — deterministic-by-seed builders of the canonical hard
   arrival processes: ``poisson`` (bursty Poisson arrivals), ``diurnal``
-  (sinusoidal rate ramp), ``heavy_tail`` (Pareto prompt/output lengths — the
-  long-context tail that wrecks padded-width admission), ``tenant_flood``
+  (sinusoidal rate ramp), ``swing`` (diurnal parameterized by peak:trough
+  ratio — the autoscale bench's 4× load swing), ``heavy_tail`` (Pareto
+  prompt/output lengths — the long-context tail that wrecks padded-width
+  admission), ``tenant_flood``
   (an adversarial tenant dumping a flood into otherwise-normal traffic — the
   WFQ isolation scenario).
 - **Replay** — :func:`replay_trace` drives a ``ServingGateway`` on a VIRTUAL
@@ -160,6 +162,28 @@ def diurnal_ramp(
     return out
 
 
+def swing(
+    n: int, seed: int = 0, mean_iat_s: float = 1.0, period_s: float = 120.0,
+    swing_ratio: float = 4.0, prompt_range=(3, 24), output_range=(4, 16),
+    high_frac: float = 0.25, tenants: int = 3,
+    deadline_tight: float = 30.0, deadline_loose: float = 240.0,
+) -> List[TraceRequest]:
+    """Diurnal ramp parameterized by PEAK:TROUGH ratio instead of modulation
+    depth — ``swing_ratio=4.0`` is the canonical 4× load swing the autoscale
+    bench replays (``serve-bench --autoscale``). A ratio R maps to
+    ``depth=(R-1)/(R+1)`` on :func:`diurnal_ramp`'s sinusoid, so the trace is
+    seeded, hash-stable and reproducible from ``--trace-gen swing`` alone."""
+    if swing_ratio < 1.0:
+        raise ValueError(f"swing_ratio={swing_ratio} must be >= 1.0")
+    depth = (swing_ratio - 1.0) / (swing_ratio + 1.0)
+    return diurnal_ramp(
+        n, seed=seed, mean_iat_s=mean_iat_s, period_s=period_s, depth=depth,
+        prompt_range=prompt_range, output_range=output_range,
+        high_frac=high_frac, tenants=tenants,
+        deadline_tight=deadline_tight, deadline_loose=deadline_loose,
+    )
+
+
 def heavy_tail(
     n: int, seed: int = 0, mean_iat_s: float = 1.0, alpha: float = 1.3,
     prompt_range=(3, 48), output_range=(4, 32), high_frac: float = 0.25,
@@ -230,6 +254,7 @@ def tenant_flood(
 GENERATORS: Dict[str, Callable[..., List[TraceRequest]]] = {
     "poisson": poisson_burst,
     "diurnal": diurnal_ramp,
+    "swing": swing,
     "heavy_tail": heavy_tail,
     "tenant_flood": tenant_flood,
 }

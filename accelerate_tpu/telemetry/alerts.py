@@ -9,7 +9,7 @@ trigger surface the ROADMAP-5 SLO-driven autoscaler subscribes to (a sink
 filtering on the alert schema sees every transition live, with the rule name
 and the aggregate value that crossed).
 
-Two rule kinds:
+Three rule kinds:
 
 - ``threshold`` — a bound on one registered metric. Gauges compare their
   current value (labeled gauges reduce with the WORST label: max for ``>``
@@ -25,6 +25,15 @@ Two rule kinds:
   asymmetry: page fast, un-page fast, let the slow window keep the budget
   accounting honest). No traffic in a window means no verdict (skip), never
   a fire: silence is not an outage.
+- ``sustained_low`` — the scale-DOWN shape: fires only after the metric has
+  stayed below ``threshold`` for the FULL ``window_s`` (one high sample
+  re-arms the timer), and resolves only once the value climbs back to
+  ``clear_threshold`` (distinct from — at or above — the fire threshold).
+  The asymmetric pair is hysteresis: without it the autoscaler would retire
+  a replica on the same bound that immediately re-fires when the survivors
+  absorb its load. Labeled gauges reduce per ``reduce`` (``max``/``min``/
+  ``sum`` — ``sum`` turns per-replica active-lane gauges into a fleet-wide
+  idleness signal).
 
 Rules fire on *observations*, so the engine is evaluated by the plane itself
 after every consumed record (:meth:`poll`, throttled by ``eval_interval_s``
@@ -51,8 +60,9 @@ from .schemas import ALERT_SCHEMA
 
 __all__ = ["AlertRule", "AlertEngine", "default_alert_rules", "ALERT_SCHEMA"]
 
-_KINDS = ("threshold", "burn_rate")
+_KINDS = ("threshold", "burn_rate", "sustained_low")
 _OPS = (">", "<")
+_REDUCES = ("max", "min", "sum")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -61,9 +71,12 @@ class AlertRule:
 
     ``threshold`` rules need ``metric`` + ``threshold`` (+ ``op``, and
     ``window_s`` for counters); ``burn_rate`` rules need ``objective`` +
-    ``burn_threshold`` + the two windows. ``labels`` restricts a labeled
-    metric to one series; without it, labeled gauges reduce to their worst
-    series and labeled counters sum across series."""
+    ``burn_threshold`` + the two windows; ``sustained_low`` rules need
+    ``metric`` + ``threshold`` + ``window_s`` (the fire dwell) and usually a
+    ``clear_threshold`` above the fire bound (the hysteresis gap). ``labels``
+    restricts a labeled metric to one series; without it, labeled gauges
+    reduce to their worst series (or per ``reduce`` for ``sustained_low``)
+    and labeled counters sum across series."""
 
     name: str
     kind: str = "threshold"
@@ -72,8 +85,11 @@ class AlertRule:
     metric: Optional[str] = None
     op: str = ">"
     threshold: float = 0.0
-    window_s: float = 60.0              # counter-increase window
+    window_s: float = 60.0              # counter-increase / sustained-low window
     labels: Optional[dict] = None
+    # sustained-low rules
+    clear_threshold: Optional[float] = None  # resolve bound; defaults to threshold
+    reduce: str = "max"                 # labeled-gauge reduction: max | min | sum
     # burn-rate rules
     objective: float = 0.99             # SLO target fraction of good events
     fast_window_s: float = 60.0
@@ -97,6 +113,36 @@ class AlertRule:
                 )
             if self.op not in _OPS:
                 raise ValueError(f"rule {self.name!r}: op={self.op!r} must be one of {_OPS}")
+        elif self.kind == "sustained_low":
+            if self.metric is None:
+                raise ValueError(
+                    f"rule {self.name!r}: sustained_low rules name a metric"
+                )
+            if self.metric not in METRIC_REGISTRY:
+                raise ValueError(
+                    f"rule {self.name!r}: unregistered metric {self.metric!r}"
+                )
+            if METRIC_REGISTRY[self.metric].kind == "histogram":
+                raise ValueError(
+                    f"rule {self.name!r}: sustained_low rules read gauges/"
+                    f"counters; {self.metric} is a histogram"
+                )
+            if self.window_s <= 0:
+                raise ValueError(
+                    f"rule {self.name!r}: window_s={self.window_s} must be > 0 "
+                    "(the dwell that makes the low SUSTAINED)"
+                )
+            if self.clear_threshold is not None and self.clear_threshold < self.threshold:
+                raise ValueError(
+                    f"rule {self.name!r}: clear_threshold={self.clear_threshold} "
+                    f"must be >= threshold={self.threshold} (hysteresis clears "
+                    "ABOVE where it fires, or it flaps)"
+                )
+            if self.reduce not in _REDUCES:
+                raise ValueError(
+                    f"rule {self.name!r}: reduce={self.reduce!r} must be one "
+                    f"of {_REDUCES}"
+                )
         else:
             if not 0.0 < self.objective < 1.0:
                 raise ValueError(
@@ -145,6 +191,11 @@ class AlertEngine:
         self.states: Dict[str, str] = {r.name: "ok" for r in self.rules}
         #: Every transition record emitted, in order (the bench/test surface).
         self.fired: List[dict] = []
+        #: sustained_low dwell state: rule name → plane time the value first
+        #: dipped below the fire threshold (None = not currently below).
+        self._below_since: Dict[str, Optional[float]] = {
+            r.name: None for r in self.rules
+        }
         self._last_eval: Optional[float] = None
         if plane.enabled:
             plane.alert_engines.append(self)
@@ -163,10 +214,12 @@ class AlertEngine:
         now = self.plane._clock() if now is None else now
         self._last_eval = now
         for rule in self.rules:
-            verdict, value, bound = (
-                self._eval_threshold(rule, now) if rule.kind == "threshold"
-                else self._eval_burn(rule, now)
-            )
+            if rule.kind == "threshold":
+                verdict, value, bound = self._eval_threshold(rule, now)
+            elif rule.kind == "sustained_low":
+                verdict, value, bound = self._eval_sustained_low(rule, now)
+            else:
+                verdict, value, bound = self._eval_burn(rule, now)
             state = self.states[rule.name]
             if verdict is None:
                 continue  # no data — hold the current state, never flap on silence
@@ -199,6 +252,41 @@ class AlertEngine:
                 return None, None, rule.threshold
         verdict = value > rule.threshold if rule.op == ">" else value < rule.threshold
         return verdict, value, rule.threshold
+
+    def _eval_sustained_low(self, rule: AlertRule, now: float):
+        spec = METRIC_REGISTRY[rule.metric]
+        labels = rule.labels or {}
+        if spec.kind == "counter":
+            value = self.plane.window_increase(
+                rule.metric, rule.window_s, now=now, **labels
+            )
+        else:
+            value = self.plane.gauge_value(rule.metric, **labels)
+            if isinstance(value, dict):
+                if not value:
+                    return None, None, rule.threshold
+                vals = value.values()
+                value = (sum(vals) if rule.reduce == "sum"
+                         else min(vals) if rule.reduce == "min" else max(vals))
+            if value is None:
+                return None, None, rule.threshold
+        clear = (rule.clear_threshold if rule.clear_threshold is not None
+                 else rule.threshold)
+        if self.states[rule.name] == "firing":
+            # Hysteresis: resolve only at/above the CLEAR bound, and re-arm
+            # the dwell so a refire needs a fresh full window below.
+            if value >= clear:
+                self._below_since[rule.name] = None
+                return False, value, clear
+            return True, value, clear
+        if value < rule.threshold:
+            if self._below_since[rule.name] is None:
+                self._below_since[rule.name] = now
+            if now - self._below_since[rule.name] >= rule.window_s:
+                return True, value, rule.threshold
+            return None, value, rule.threshold  # dwelling — hold state
+        self._below_since[rule.name] = None
+        return False, value, rule.threshold
 
     def _eval_burn(self, rule: AlertRule, now: float):
         budget = 1.0 - rule.objective

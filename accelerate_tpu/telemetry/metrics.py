@@ -51,6 +51,7 @@ from .schemas import (
     ELASTIC_RESTART_SCHEMA,
     FAULT_SCHEMA,
     FLEET_ROUTE_SCHEMA,
+    FLEET_SCALE_SCHEMA,
     GATEWAY_REQUEST_SCHEMA,
     GATEWAY_SLO_SCHEMA,
     METRICS_SNAPSHOT_SCHEMA,
@@ -85,10 +86,13 @@ __all__ = [
     "M_DCN_BYTES_TOTAL",
     "M_HANDOFF_BYTES_TOTAL",
     "M_ALERTS_TOTAL",
+    "M_FLEET_SCALE_EVENTS_TOTAL",
+    "M_FLEET_REPLICA_HOURS_TOTAL",
     "M_RECORDER_DROPPED_TOTAL",
     "M_EXPORTER_SCRAPES_TOTAL",
     # gauges
     "M_QUEUE_DEPTH",
+    "M_FLEET_REPLICAS_ACTIVE",
     "M_SLOT_OCCUPANCY",
     "M_PAGE_OCCUPANCY",
     "M_KV_BYTES_IN_USE",
@@ -128,10 +132,13 @@ M_ROUTE_DECISIONS_TOTAL = "accelerate_tpu_fleet_route_decisions_total"
 M_DCN_BYTES_TOTAL = "accelerate_tpu_mpmd_dcn_bytes_total"
 M_HANDOFF_BYTES_TOTAL = "accelerate_tpu_kv_handoff_bytes_total"
 M_ALERTS_TOTAL = "accelerate_tpu_alerts_total"
+M_FLEET_SCALE_EVENTS_TOTAL = "accelerate_tpu_fleet_scale_events_total"
+M_FLEET_REPLICA_HOURS_TOTAL = "accelerate_tpu_fleet_replica_hours_total"
 M_RECORDER_DROPPED_TOTAL = "accelerate_tpu_recorder_dropped_total"
 M_EXPORTER_SCRAPES_TOTAL = "accelerate_tpu_exporter_scrapes_total"
 
 M_QUEUE_DEPTH = "accelerate_tpu_serving_queue_depth"
+M_FLEET_REPLICAS_ACTIVE = "accelerate_tpu_fleet_replicas_active"
 M_SLOT_OCCUPANCY = "accelerate_tpu_serving_slot_occupancy"
 M_PAGE_OCCUPANCY = "accelerate_tpu_kv_page_occupancy"
 M_KV_BYTES_IN_USE = "accelerate_tpu_kv_bytes_in_use"
@@ -204,12 +211,21 @@ METRIC_REGISTRY: Dict[str, MetricSpec] = {
            "cross-engine KV page handoff wire bytes"),
         _m(M_ALERTS_TOTAL, "counter", ("rule", "state"), ALERT_SCHEMA,
            "alert-state transitions seen on the record stream"),
+        _m(M_FLEET_SCALE_EVENTS_TOTAL, "counter", ("action",),
+           FLEET_SCALE_SCHEMA,
+           "autoscaler decisions (scale_up/scale_down/rebalance)"),
+        _m(M_FLEET_REPLICA_HOURS_TOTAL, "counter", (), FLEET_SCALE_SCHEMA,
+           "cumulative replica-hours accrued by the fleet (the cost axis of "
+           "attainment-per-replica-hour)"),
         _m(M_RECORDER_DROPPED_TOTAL, "counter", (), "derived",
            "flight-ring records evicted before any capsule captured them"),
         _m(M_EXPORTER_SCRAPES_TOTAL, "counter", ("endpoint",), "derived",
            "HTTP scrapes served by the Prometheus exporter"),
         _m(M_QUEUE_DEPTH, "gauge", (), SERVING_SCHEMA,
            "engine-internal queued requests (last decode step)"),
+        _m(M_FLEET_REPLICAS_ACTIVE, "gauge", ("role",), FLEET_SCALE_SCHEMA,
+           "live (non-retired, non-draining-out) replicas per role after the "
+           "latest autoscaler decision"),
         _m(M_SLOT_OCCUPANCY, "gauge", (), SERVING_SCHEMA,
            "decode-lane occupancy in [0,1] (last decode step)"),
         _m(M_PAGE_OCCUPANCY, "gauge", (), SERVING_KV_SCHEMA,
@@ -335,7 +351,11 @@ class MetricsPlane:
             FAULT_SCHEMA: self._on_fault,
             RECOVERY_SCHEMA: self._on_recovery,
             ALERT_SCHEMA: self._on_alert,
+            FLEET_SCALE_SCHEMA: self._on_scale,
         }
+        #: Last cumulative replica-hours seen on a ``fleet.scale/v1`` record —
+        #: the counter is fed by DELTAS of the record's monotone value.
+        self._replica_hours_seen = 0.0
         if self.enabled and telemetry is not None:
             telemetry.sinks.append(self._consume)
 
@@ -508,6 +528,17 @@ class MetricsPlane:
 
     def _on_alert(self, r: Mapping) -> None:
         self.inc(M_ALERTS_TOTAL, rule=r.get("rule"), state=r.get("state"))
+
+    def _on_scale(self, r: Mapping) -> None:
+        self.inc(M_FLEET_SCALE_EVENTS_TOTAL, action=r.get("action"))
+        for role, count in (r.get("replicas_by_role") or {}).items():
+            self.set_gauge(M_FLEET_REPLICAS_ACTIVE, count, role=role)
+        hours = r.get("replica_hours")
+        if hours is not None and float(hours) > self._replica_hours_seen:
+            self.inc(M_FLEET_REPLICA_HOURS_TOTAL,
+                     float(hours) - self._replica_hours_seen,
+                     t=r.get("t"))
+            self._replica_hours_seen = float(hours)
 
     # ------------------------------------------------------------ aggregate reads
     def counter_value(self, name: str, **labels) -> float:

@@ -38,6 +38,7 @@ __all__ = [
     "GATEWAY_SLO_SCHEMA",
     "REPLICA_HEALTH_SCHEMA",
     "FLEET_ROUTE_SCHEMA",
+    "FLEET_SCALE_SCHEMA",
     "ELASTIC_RESTART_SCHEMA",
     "MPMD_TRANSFER_SCHEMA",
     "MPMD_BARRIER_SCHEMA",
@@ -99,6 +100,14 @@ REPLICA_HEALTH_SCHEMA = "accelerate_tpu.telemetry.replica.health/v1"
 #: (``dispatch``/``probe``), plus the health/free-lane snapshot it won on —
 #: and one per migration (``migrate``) when failover moves a request away.
 FLEET_ROUTE_SCHEMA = "accelerate_tpu.telemetry.fleet.route/v1"
+
+#: One record per autoscaler decision (``serving_gateway.autoscaler.
+#: Autoscaler``): ``action`` is ``scale_up``/``scale_down``/``rebalance``,
+#: ``reason`` the alert rule or forecast that triggered it, ``replicas`` the
+#: fleet size AFTER the action, plus the per-role census, cumulative
+#: replica-hours and the router-clock timestamp — the decision audit trail
+#: the autoscale bench replays deterministically under a virtual clock.
+FLEET_SCALE_SCHEMA = "accelerate_tpu.telemetry.fleet.scale/v1"
 
 #: Emitted on every gang restart (attempt index, the exit codes that triggered
 #: the teardown, the restart budget) by ``ElasticSupervisor`` — ``gang_id``
@@ -265,6 +274,13 @@ SCHEMA_REGISTRY: Dict[str, RecordSchema] = {
             ("uid", "replica", "reason", "health", "free_lanes"),
             "FleetRouter",
             "one routing decision: request -> replica (dispatch/probe/migrate)",
+        ),
+        _reg(
+            FLEET_SCALE_SCHEMA,
+            ("action", "reason", "replicas", "t"),
+            "serving_gateway.autoscaler.Autoscaler",
+            "one autoscaler decision (scale_up/scale_down/rebalance) with the "
+            "post-action fleet census",
         ),
         _reg(
             ELASTIC_RESTART_SCHEMA,

@@ -42,6 +42,7 @@ MODULES = [
     ("accelerate_tpu.serving_gateway.gateway", "Serving gateway"),
     ("accelerate_tpu.serving_gateway.fleet", "Fleet router (multi-replica serving)"),
     ("accelerate_tpu.serving_gateway.disagg", "Disaggregated prefill/decode router"),
+    ("accelerate_tpu.serving_gateway.autoscaler", "Autoscaler (closed-loop fleet sizing)"),
     ("accelerate_tpu.serving_gateway.policies", "Gateway scheduling policies"),
     ("accelerate_tpu.inference", "Pipeline inference"),
     ("accelerate_tpu.checkpointing", "Checkpointing"),

@@ -52,6 +52,17 @@ GUARDS: List[Tuple[str, str, float]] = [
     ("*invariants.*", "true", 0.0),
     ("*alerts_clean_silent", "true", 0.0),
     ("*alerts_chaos_expected", "true", 0.0),
+    # Autoscale closed-loop invariants (BENCH_AUTOSCALE.json): the headline
+    # gates must keep holding, and the autoscaled arm's replica-hours — the
+    # cost axis of attainment-per-replica-hour — may not grow past the band
+    # (attainment itself rides the *attainment* guard below).
+    ("*attainment_within_band", "true", 0.0),
+    ("*replica_hours_fewer", "true", 0.0),
+    ("*zero_lost_all_arms", "true", 0.0),
+    ("*steady_no_scale", "true", 0.0),
+    ("*flood_bounded", "true", 0.0),
+    ("*replica_hours.autoscaled", "lower", 0.15),
+    ("*autoscaled.replica_hours", "lower", 0.15),
     # Throughput family: fresh may not fall more than the band.
     ("*tokens_per_sec*", "higher", 0.30),
     ("*tokens_per_step*", "higher", 0.25),

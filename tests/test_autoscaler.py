@@ -1,0 +1,362 @@
+"""Autoscaler (serving_gateway/autoscaler.py): alert transitions become scale
+actions — closed-loop fleet sizing with role-ratio control (ISSUE 20).
+
+Acceptance pins: scale-up rides ``spawn_replica()`` behind the half-open probe
+warm-up and compiles ZERO new programs (spawned engines reuse the warmed
+bucket ladder); scale-down is always ``decommission()`` — a drain whose
+in-flight requests finish or migrate byte-identically, then a retirement that
+charges NO supervisor restart budget; the terminal-state ``gateway.request/v1``
+matrix extends to scale-down-migrated requests (exactly one terminal record
+each, counters reconcile); every decision is a validated ``fleet.scale/v1``
+record on the router's clock, and the whole loop is deterministic under
+virtual-clock replay (same seed → identical scale records).
+"""
+
+import dataclasses
+import json
+import subprocess
+import sys
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from accelerate_tpu.models import llama
+from accelerate_tpu.serving import ContinuousBatcher
+from accelerate_tpu.serving_gateway import (
+    ACTIVE,
+    RETIRED,
+    Autoscaler,
+    FleetRouter,
+    default_autoscale_rules,
+)
+from accelerate_tpu.serving_gateway.workload import diurnal_ramp, swing, trace_hash
+from accelerate_tpu.telemetry import Telemetry
+from accelerate_tpu.telemetry.schemas import (
+    FLEET_SCALE_SCHEMA,
+    GATEWAY_REQUEST_SCHEMA,
+    validate_record,
+)
+from accelerate_tpu.utils.dataclasses import GatewayConfig, TelemetryConfig
+
+CFG = dataclasses.replace(llama.CONFIGS["tiny"], dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def setup():
+    params = llama.init_params(CFG)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, CFG.vocab_size, int(n)).astype(np.int32)
+               for n in (5, 9, 3, 7, 6, 4, 8, 5, 11, 6, 4, 7)]
+    return params, prompts
+
+
+class ManualClock:
+    def __init__(self, t=0.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def make_engine(params, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("prompt_bucket", 16)
+    return ContinuousBatcher(params, CFG, **kw)
+
+
+def make_fleet(params, n=1, clock=None, telemetry=None, **cfg_kwargs):
+    cfg_kwargs.setdefault("enabled", True)
+    cfg_kwargs.setdefault("max_queue", 64)
+    cfg_kwargs.setdefault("breaker_threshold", 2)
+    cfg_kwargs.setdefault("breaker_window_s", 100.0)
+    cfg_kwargs.setdefault("breaker_cooldown_s", 5.0)
+    kw = {} if clock is None else {"clock": clock}
+    return FleetRouter(
+        [make_engine(params) for _ in range(n)],
+        GatewayConfig(**cfg_kwargs), telemetry=telemetry,
+        engine_factory=lambda rid: make_engine(params), **kw,
+    )
+
+
+def submit_with_streams(gw, prompts, max_new=8, **kw):
+    streams = {}
+    greqs = []
+    for i, p in enumerate(prompts):
+        streams[i] = []
+
+        def on_token(tok, i=i):
+            streams[i].append(int(tok))
+
+        def on_retry(i=i):
+            streams[i].clear()
+
+        greqs.append(gw.submit(p, max_new_tokens=max_new, on_token=on_token,
+                               on_retry=on_retry, **kw))
+    return greqs, streams
+
+
+# ------------------------------------------------------------------ validation
+def test_autoscaler_validates_bounds_and_factory(setup):
+    params, _ = setup
+    fleet = make_fleet(params, n=1)
+    with pytest.raises(ValueError, match="min_replicas"):
+        Autoscaler(fleet, min_replicas=0)
+    with pytest.raises(ValueError, match="max_replicas"):
+        Autoscaler(fleet, min_replicas=3, max_replicas=2)
+    no_factory = FleetRouter([make_engine(params)], GatewayConfig(enabled=True))
+    with pytest.raises(ValueError, match="engine_factory"):
+        Autoscaler(no_factory)
+    # AlertRule objects need the plane they are armed on.
+    up, down = default_autoscale_rules()
+    with pytest.raises(ValueError, match="metrics"):
+        Autoscaler(make_fleet(params, n=1, metrics=False),
+                   up_rules=up, down_rules=down)
+
+
+def test_spawn_replica_mechanics_and_geometry_guard(setup):
+    """spawn_replica(): the fresh replica enters half-open (one probe earns
+    full routing, exactly like a restart), geometry drift is rejected (the
+    admission cost model prices ONE layout), and flat fleets refuse roles."""
+    params, prompts = setup
+    fleet = make_fleet(params, n=1)
+    rep = fleet.spawn_replica()
+    assert rep.rid == 1 and rep.state == ACTIVE
+    assert rep.breaker.state == "half_open"
+    assert fleet.counters["replica_spawned"] == 1
+    with pytest.raises(ValueError, match="role-aware"):
+        fleet.spawn_replica("decode")
+    bad = FleetRouter([make_engine(params)], GatewayConfig(enabled=True),
+                      engine_factory=lambda rid: make_engine(params, max_len=128))
+    with pytest.raises(ValueError, match="geometry"):
+        bad.spawn_replica()
+    # the spawned replica actually serves: its probe admission completes
+    greqs = [fleet.submit(p, max_new_tokens=4) for p in prompts[:4]]
+    fleet.run()
+    assert all(g.status == "done" for g in greqs)
+    assert rep.breaker.state == "closed"
+
+
+# ------------------------------------------------------------------ closed loop
+def _closed_loop(params, prompts, idle_steps=40):
+    """One deterministic burst-then-idle episode under a manual clock: the
+    backlog scales the fleet up, the drained idle window scales it back down."""
+    clock = ManualClock()
+    tel = Telemetry(TelemetryConfig(enabled=True, compile_events=False,
+                                    memory_stats=False))
+    fleet = make_fleet(params, n=1, clock=clock, telemetry=tel,
+                       metrics=True, metrics_window_s=60.0)
+    up, down = default_autoscale_rules(
+        queue_window_s=5.0, idle_lane_floor=2.0, idle_clear=3.0,
+        idle_window_s=6.0, fast_window_s=5.0, slow_window_s=20.0,
+        burn_threshold=2.0,
+    )
+    scaler = Autoscaler(fleet, min_replicas=1, max_replicas=3,
+                        cooldown_s=4.0, down_cooldown_s=3.0,
+                        forecast_window_s=5.0, up_rules=up, down_rules=down)
+    greqs, streams = submit_with_streams(fleet, prompts, max_new=8)
+    for _ in range(200):
+        if not fleet.queue_depth and not fleet.running_count:
+            break
+        fleet.step()
+        clock.advance(1.0)
+    for _ in range(idle_steps):
+        fleet.step()
+        clock.advance(1.0)
+    return fleet, scaler, greqs, streams
+
+
+def test_closed_loop_scales_up_then_down(setup):
+    """The tentpole end to end: a 12-request burst into one 2-lane replica
+    trips the backlog signal → spawn; the idle tail trips sustained_low →
+    decommission back to the floor. Every decision is one validated
+    fleet.scale/v1 record and the scale-event counters mirror them."""
+    params, prompts = setup
+    fleet, scaler, greqs, _ = _closed_loop(params, prompts)
+    assert all(g.status == "done" for g in greqs)
+    stats = scaler.stats()
+    assert stats["actions"]["scale_up"] >= 1
+    assert stats["actions"]["scale_down"] >= 1
+    assert stats["replicas"] == scaler.min_replicas  # idled back to the floor
+    assert 1 <= len(fleet.replicas) - fleet.counters["replica_retired"] <= 3
+    for rec in scaler.events:
+        assert rec["schema"] == FLEET_SCALE_SCHEMA
+        assert validate_record(rec) == []
+        assert rec["replicas"] <= scaler.max_replicas
+    # decisions were mirrored onto the metrics plane (satellite: new metrics)
+    plane = fleet.metrics
+    ups = plane.counter_value("accelerate_tpu_fleet_scale_events_total",
+                              action="scale_up")
+    assert ups == stats["actions"]["scale_up"]
+    active = plane.gauge_value("accelerate_tpu_fleet_replicas_active")
+    assert sum(active.values()) == scaler.min_replicas
+    # the replica-hours counter advances with each decision record: it equals
+    # the LAST decision's cumulative figure, never overshooting the live value
+    hours = plane.counter_value("accelerate_tpu_fleet_replica_hours_total")
+    assert hours == pytest.approx(scaler.events[-1]["replica_hours"])
+    assert hours <= fleet.replica_hours + 1e-9
+
+
+def test_closed_loop_deterministic_replay(setup):
+    """Same seed, same trace, same clock → byte-identical scale decisions and
+    transcripts. The controller holds no wall-clock or random state."""
+    params, prompts = setup
+    _, s1, g1, st1 = _closed_loop(params, prompts)
+    _, s2, g2, st2 = _closed_loop(params, prompts)
+    assert s1.events == s2.events
+    assert [g.status for g in g1] == [g.status for g in g2]
+    assert st1 == st2
+
+
+# ------------------------------------------------- scale-down terminal matrix
+def test_scale_down_migrated_requests_terminal_matrix(setup):
+    """ISSUE 20 satellite (extends the ISSUE 8/10 terminal-record matrix):
+    requests migrated off a decommissioning replica still end in EXACTLY one
+    ``gateway.request/v1`` record each, the counters reconcile, and the
+    migrated transcripts are complete (replayed from token 0 post-reset)."""
+    params, prompts = setup
+    clock = ManualClock()
+    tel = Telemetry(TelemetryConfig(enabled=True, compile_events=False,
+                                    memory_stats=False))
+    fleet = make_fleet(params, n=2, clock=clock, telemetry=tel)
+    greqs, streams = submit_with_streams(fleet, prompts[:6], max_new=12)
+    fleet.step()  # fill both replicas' lanes
+    assert len(fleet.replicas[1].running) > 0
+    fleet.decommission(1, deadline_s=2.0)
+    clock.advance(5.0)  # past the drain deadline before anything finishes
+    fleet.run()
+    assert fleet.counters["migrated"] >= 1
+    assert fleet.replicas[1].state == RETIRED
+    assert all(g.status == "done" for g in greqs)
+    for i, g in enumerate(greqs):
+        assert streams[i] == g.tokens
+    recs = [r for r in tel.records
+            if r.get("schema") == GATEWAY_REQUEST_SCHEMA]
+    per_uid = {}
+    for r in recs:
+        per_uid[r["uid"]] = per_uid.get(r["uid"], 0) + 1
+    assert per_uid == {g.uid: 1 for g in greqs}  # exactly one terminal each
+    assert len(recs) == fleet.counters["done"] == len(greqs)
+
+
+def test_decommission_charges_no_restart_budget(setup):
+    """ISSUE 20 satellite (FleetSupervisor clause): an autoscaler-retired
+    replica is a PLANNED exit — zero supervisor attempts recorded, zero
+    restarts — while a genuine kill on the same fleet still charges its
+    gang's budget as before."""
+    params, prompts = setup
+    fleet = make_fleet(params, n=3)
+    greqs = [fleet.submit(p, max_new_tokens=6) for p in prompts[:6]]
+    fleet.step()
+    fleet.decommission(2)
+    fleet.run()
+    assert fleet.replicas[2].state == RETIRED
+    assert fleet.replicas[2].restarts == 0
+    assert fleet.supervisor.stats()["attempts"] == {}  # nothing charged
+    assert fleet.counters["replica_restarts"] == 0
+    assert all(g.status == "done" for g in greqs)
+    # a real death is still a failure: the supervisor budget moves
+    more = [fleet.submit(p, max_new_tokens=6) for p in prompts[:2]]
+    fleet.step()
+    fleet.kill(1)
+    fleet.run()
+    attempts = fleet.supervisor.stats()["attempts"]
+    assert len(attempts) == 1 and sum(attempts.values()) == 1
+    assert all(g.status == "done" for g in more)
+
+
+# ------------------------------------------------------------------- compiles
+def test_spawned_replica_adds_zero_compiles(setup):
+    """Growth is free at the compiler: a replica spawned after warm-up rides
+    the already-compiled bucket ladder — the autoscaled fleet compiles exactly
+    the programs the static fleet did."""
+    from accelerate_tpu.telemetry import CompileMonitor
+
+    params, prompts = setup
+    mon = CompileMonitor()
+    mon.start()
+    try:
+        fleet = make_fleet(params, n=1)
+        for p in prompts[:4]:
+            fleet.submit(p, max_new_tokens=4)
+        fleet.run()
+        seen = mon.count
+        rep = fleet.spawn_replica()
+        greqs = [fleet.submit(p, max_new_tokens=4) for p in prompts[4:10]]
+        fleet.run()
+        assert all(g.status == "done" for g in greqs)
+        assert rep.breaker.state == "closed"  # the newcomer actually served
+        assert mon.count - seen == 0, (
+            f"spawned replica compiled {mon.count - seen} new programs"
+        )
+    finally:
+        mon.stop()
+
+
+# ------------------------------------------------------------------- workload
+def test_swing_generator_is_ratio_parameterized_diurnal():
+    """ISSUE 20 satellite: ``swing`` is the diurnal ramp re-parameterized by
+    PEAK:TROUGH ratio — R=4 maps exactly to depth=0.6 — and stays seeded and
+    hash-stable (the bench's provenance line)."""
+    a = swing(64, seed=7, mean_iat_s=2.0, period_s=80.0, swing_ratio=4.0)
+    b = diurnal_ramp(64, seed=7, mean_iat_s=2.0, period_s=80.0, depth=0.6)
+    assert a == b
+    assert trace_hash(a) == trace_hash(swing(64, seed=7, mean_iat_s=2.0,
+                                             period_s=80.0, swing_ratio=4.0))
+    assert all(r1.arrival_s <= r2.arrival_s for r1, r2 in zip(a, a[1:]))
+    with pytest.raises(ValueError, match="swing_ratio"):
+        swing(8, swing_ratio=0.5)
+    from accelerate_tpu.serving_gateway import GENERATORS
+    assert "swing" in GENERATORS
+
+
+# ------------------------------------------------------------------ the bench
+def test_autoscale_bench_artifact(setup):
+    """The acceptance geometry in-process: one diurnal swing replayed
+    static-small / static-peak / autoscaled on a shared virtual clock —
+    attainment within the band of peak at strictly fewer replica-hours, zero
+    silently-lost everywhere, byte-identical streams, a silent steady arm, a
+    bounded flood arm, and a lossless chaos arm (crash mid-scale-down)."""
+    from accelerate_tpu.commands.serve_bench import run_autoscale_bench
+
+    artifact = run_autoscale_bench(
+        requests=24, max_slots=2, max_len=64, prompt_bucket=16, seed=0,
+    )
+    assert artifact["schema"] == "accelerate_tpu.bench.autoscale/v1"
+    assert artifact["attainment_within_band"] is True
+    assert artifact["replica_hours_fewer"] is True
+    assert artifact["replica_hours"]["autoscaled"] < artifact["replica_hours"]["static_peak"]
+    assert artifact["zero_lost_all_arms"] is True
+    assert artifact["streams_identical"] is True and artifact["streams_compared"] > 0
+    assert artifact["autoscaled"]["scale_actions"]["scale_up"] >= 1
+    assert artifact["steady_no_scale"] is True
+    assert artifact["flood_scale_events"] <= artifact["flood_bound"]
+    assert artifact["chaos_kill"] is not None
+    assert artifact["chaos_streams_identical"] is True
+    for rec in artifact["autoscaled"]["scale_records"]:
+        assert validate_record(rec) == []
+    assert artifact["provenance"] and artifact["workload_trace_hash"]
+
+
+def test_autoscale_cli_smoke(tmp_path):
+    """serve-bench --autoscale --smoke is a tier-1 gate beside the chaos
+    smokes (ISSUE 20 satellite): non-zero exit on any broken gate."""
+    out = tmp_path / "BENCH_AUTOSCALE.json"
+    result = subprocess.run(
+        [sys.executable, "-m", "accelerate_tpu", "serve-bench",
+         "--autoscale", str(out), "--smoke", "--seed", "0"],
+        capture_output=True, text=True, timeout=600,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
+    artifact = json.loads(out.read_text())
+    assert artifact["attainment_within_band"] is True
+    assert artifact["replica_hours_fewer"] is True
+    assert artifact["zero_lost_all_arms"] is True
+    assert artifact["steady_no_scale"] is True
+    summary = json.loads(result.stdout.strip().splitlines()[-1])
+    assert summary["schema"] == "accelerate_tpu.bench.autoscale/v1"

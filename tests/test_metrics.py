@@ -33,6 +33,7 @@ from accelerate_tpu.telemetry.metrics import (
     M_FAULTS_TOTAL,
     M_PAGE_OCCUPANCY,
     M_QUEUE_DEPTH,
+    M_REPLICA_ACTIVE_SLOTS,
     M_REPLICA_HEALTH,
     M_REQUESTS_TOTAL,
     M_TTFT_SECONDS,
@@ -199,6 +200,19 @@ def test_alert_rule_validation():
         plane = MetricsPlane(enabled=True, clock=lambda: 0.0)
         AlertEngine(plane, [AlertRule("a", metric=M_QUEUE_DEPTH, threshold=1),
                             AlertRule("a", metric=M_QUEUE_DEPTH, threshold=2)])
+    # sustained_low (ISSUE 20): hysteresis must clear ABOVE the fire bound,
+    # the dwell window must be positive, and the reduction must be known.
+    with pytest.raises(ValueError, match="name a metric"):
+        AlertRule("x", kind="sustained_low")
+    with pytest.raises(ValueError, match="clear_threshold"):
+        AlertRule("x", kind="sustained_low", metric=M_REPLICA_ACTIVE_SLOTS,
+                  threshold=2.0, clear_threshold=1.0)
+    with pytest.raises(ValueError, match="window_s"):
+        AlertRule("x", kind="sustained_low", metric=M_REPLICA_ACTIVE_SLOTS,
+                  threshold=2.0, window_s=0.0)
+    with pytest.raises(ValueError, match="reduce"):
+        AlertRule("x", kind="sustained_low", metric=M_REPLICA_ACTIVE_SLOTS,
+                  threshold=2.0, reduce="mean")
 
 
 def test_threshold_rules_fire_and_resolve():
@@ -282,6 +296,58 @@ def test_burn_rate_multiwindow_semantics():
         tel.emit(_request_record(300 + i))
     assert plane.error_rate(300.0) > 0.2  # slow window still burned
     assert engine.states["burn"] == "ok"
+
+
+def test_sustained_low_hysteresis_fire_clear_refire():
+    """ISSUE 20: the scale-down rule kind. Fires only after the value held
+    below the threshold for the FULL window (dwell), resolves only at/above
+    the DISTINCT clear bound (hysteresis — values between the two bounds keep
+    it firing), and a refire needs a fresh full window below: the autoscaler
+    cannot flap on the threshold that fired it."""
+    t = [0.0]
+    tel = _tel()
+    plane = MetricsPlane(tel, clock=lambda: t[0], window_s=100.0)
+    rule = AlertRule("idle", kind="sustained_low",
+                     metric=M_REPLICA_ACTIVE_SLOTS, threshold=2.0,
+                     clear_threshold=3.0, window_s=10.0, reduce="sum")
+    engine = AlertEngine(plane, [rule], eval_interval_s=0.0)
+
+    def lanes(r0, r1):
+        for rid, slots in ((0, r0), (1, r1)):
+            tel.emit({"schema": "accelerate_tpu.telemetry.replica.health/v1",
+                      "replica": rid, "state": "active", "role": "mixed",
+                      "health": 1.0, "breaker_state": "closed",
+                      "active_slots": slots, "queued": 0, "step_failures": 0})
+
+    lanes(0, 1)                            # sum=1 < 2: dwell starts
+    assert engine.states["idle"] == "ok"
+    t[0] = 5.0
+    lanes(0, 0)
+    assert engine.states["idle"] == "ok"   # half the window: still dwelling
+    t[0] = 10.0
+    lanes(0, 1)                            # full window below → fires
+    assert engine.states["idle"] == "firing"
+    t[0] = 12.0
+    lanes(1, 1)                            # sum=2: ≥ fire bound, < clear bound
+    assert engine.states["idle"] == "firing"
+    t[0] = 14.0
+    lanes(2, 1)                            # sum=3 ≥ clear → resolves
+    assert engine.states["idle"] == "ok"
+    # A refire re-arms the dwell: dipping below again fires only after
+    # ANOTHER full window, never instantly.
+    t[0] = 15.0
+    lanes(0, 0)
+    assert engine.states["idle"] == "ok"
+    t[0] = 20.0
+    lanes(0, 1)
+    assert engine.states["idle"] == "ok"   # 5s of the fresh dwell elapsed
+    t[0] = 25.0
+    lanes(0, 0)                            # 10s below again → refires
+    assert engine.states["idle"] == "firing"
+    assert [r["state"] for r in engine.fired
+            if r["rule"] == "idle"] == ["firing", "resolved", "firing"]
+    for rec in engine.fired:
+        assert validate_record(rec) == []
 
 
 def test_threshold_rules_on_derived_gauges_fire():
@@ -647,6 +713,20 @@ def record_corpus(setup, tmp_path_factory):
         router.submit(p, max_new_tokens=4)
     router.step()
     router.kill(0)
+    router.run()
+
+    # 3b) autoscaler: a standing backlog past the per-replica bound makes the
+    #     controller spawn through the factory — the real fleet.scale/v1
+    #     emitter (no synthetic dict).
+    from accelerate_tpu.serving_gateway import Autoscaler
+
+    scaler = Autoscaler(router, min_replicas=1, max_replicas=3,
+                        cooldown_s=0.0, predictive=False,
+                        queue_backlog_per_replica=1.0)
+    for p in prompts[:6]:
+        router.submit(p, max_new_tokens=3)
+    scaler.poll()
+    assert scaler.events, "backlog did not trigger a scale-up — retune"
     router.run()
 
     # 4) disagg: one prefill→decode handoff (serving.handoff/v1).
